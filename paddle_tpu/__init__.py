@@ -1,0 +1,16 @@
+"""paddle_tpu — a TPU-native deep learning framework with the capabilities of
+PaddlePaddle Fluid (reference: /root/reference, powermano/Paddle).
+
+Layout (SURVEY.md §7):
+  fluid/     Fluid-compatible user API: Program IR, layers, autodiff,
+             Executor/ParallelExecutor over XLA jit
+  ops/       the op registry — each op is a pure JAX lowering (the "kernel
+             layer"; XLA replaces per-device kernel dispatch)
+  parallel/  device meshes, collectives, distributed bootstrap
+  models/    reference model zoo (benchmark/fluid parity)
+  utils/     support code
+"""
+
+__version__ = "0.1.0"
+
+from . import fluid  # noqa: F401

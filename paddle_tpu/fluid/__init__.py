@@ -1,0 +1,62 @@
+"""paddle_tpu.fluid — the Fluid-compatible user API, TPU-native underneath.
+
+Mirrors python/paddle/fluid/__init__.py of the reference: Program/Executor/
+layers/optimizer/backward/io surface, with execution via XLA jit instead of
+per-op kernel dispatch. See SURVEY.md §7 for the design stance.
+"""
+
+from . import core
+from . import framework
+from .framework import (  # noqa: F401
+    Program, Operator, Variable, Parameter, default_startup_program,
+    default_main_program, program_guard, name_scope, in_dygraph_mode,
+)
+from . import executor
+from .executor import Executor, global_scope, scope_guard, Scope  # noqa: F401
+from . import layers
+from . import initializer
+from . import optimizer
+from . import backward
+from .backward import append_backward, gradients  # noqa: F401
+from . import regularizer
+from . import clip
+from .clip import (  # noqa: F401
+    ErrorClipByValue, GradientClipByValue, GradientClipByNorm,
+    GradientClipByGlobalNorm,
+)
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from .layer_helper import LayerHelper  # noqa: F401
+from . import unique_name
+from .core import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace  # noqa: F401
+from .initializer import Constant, Normal, Uniform, Xavier, MSRA  # noqa: F401
+
+# populated by later milestones; imported lazily to keep import cheap
+from . import lod  # noqa: F401
+from .lod import LoDTensor, create_lod_tensor  # noqa: F401
+from . import io
+from .io import (  # noqa: F401
+    save_vars, save_params, save_persistables, load_vars, load_params,
+    load_persistables, save_inference_model, load_inference_model,
+)
+from . import parallel_executor
+from .parallel_executor import (  # noqa: F401
+    ParallelExecutor, ExecutionStrategy, BuildStrategy,
+)
+from . import data_feeder
+from .data_feeder import DataFeeder  # noqa: F401
+from . import metrics
+from . import profiler
+from . import nets
+
+__all__ = [
+    "Program", "Operator", "Variable", "Parameter",
+    "default_startup_program", "default_main_program", "program_guard",
+    "name_scope", "Executor", "global_scope", "scope_guard", "Scope",
+    "layers", "initializer", "optimizer", "backward", "regularizer", "clip",
+    "append_backward", "gradients", "ParamAttr", "WeightNormParamAttr",
+    "LayerHelper", "unique_name", "CPUPlace", "TPUPlace", "CUDAPlace",
+    "CUDAPinnedPlace", "core", "io", "save_inference_model",
+    "load_inference_model", "ParallelExecutor", "ExecutionStrategy",
+    "BuildStrategy", "DataFeeder", "metrics", "profiler", "nets",
+    "LoDTensor", "create_lod_tensor",
+]

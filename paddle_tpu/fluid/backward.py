@@ -1,0 +1,239 @@
+"""Program-level autodiff: append_backward.
+
+Reference analogue: python/paddle/fluid/backward.py — append_backward (:469)
+walks ops in reverse calling C++ grad-op makers (core.get_grad_op_desc),
+dedups repeated grads (:135 _addup_repetitive_outputs_), prunes no-grad
+branches (:204), and calc_gradient (:685).
+
+TPU-native redesign: instead of ~300 hand-written grad kernels, every forward
+op gets ONE generic grad op `<type>_grad` carrying `fwd_uid`. At execution
+time the Executor runs forward ops under jax.vjp and hands the vjp closure to
+the matching grad op in the same trace (ops/registry.py) — exact gradients,
+no recompute, and the whole fwd+bwd block still fuses into one XLA
+computation. The *program structure* (grad vars named `X@GRAD`, sum ops for
+fan-in accumulation, fill_constant(1) seeding the loss grad) matches the
+reference so transpilers/tests that inspect programs keep working.
+"""
+
+from . import framework
+from .framework import Variable, grad_var_name
+
+__all__ = ["append_backward", "calc_gradient", "gradients"]
+
+
+def _create_grad_var(block, ref_var, grad_name):
+    if block.has_var(grad_name):
+        return block.var(grad_name)
+    return block.create_var(
+        name=grad_name, shape=ref_var.shape, dtype=ref_var.dtype,
+        lod_level=ref_var.lod_level, persistable=False)
+
+
+def _op_path(block, target_names, start_names, no_grad_set):
+    """Ops that lie on a path from `start_names` to the targets — forward
+    reachability from the start set intersected with the backward walk from
+    the targets. Mirrors the reference's _find_op_path_ pruning."""
+    # forward sweep: vars influenced by the start set
+    reachable = set(start_names)
+    fwd_ops = set()
+    for op in block.ops:
+        if set(op.input_arg_names) & reachable:
+            fwd_ops.add(id(op))
+            reachable.update(op.output_arg_names)
+    # backward sweep from the targets, restricted to forward-reachable ops
+    relevant = set(target_names)
+    path = []
+    for op in reversed(block.ops):
+        if id(op) not in fwd_ops:
+            continue
+        if set(op.output_arg_names) & relevant:
+            path.append(op)
+            for name in op.input_arg_names:
+                if name not in no_grad_set:
+                    relevant.add(name)
+    path.reverse()
+    return path
+
+
+def _append_grad_ops(block, path_ops, grad_map, no_grad_set):
+    """Walk `path_ops` in reverse emitting `<type>_grad` ops.
+
+    grad_map: var name -> grad var name currently accumulating. Fan-in (a var
+    consumed by several ops) is handled like the reference: each producer
+    writes a renamed grad, then a `sum` op merges them."""
+    from .. import ops as op_registry
+
+    # count how many path ops consume each var (fan-out in fwd = fan-in in bwd)
+    pending = {}
+    for op in path_ops:
+        for name in set(op.input_arg_names):
+            pending[name] = pending.get(name, 0) + 1
+
+    partials = {}  # var name -> list of partial grad var names
+
+    def finalize_grad(name):
+        """All contributions collected: emit sum if >1."""
+        parts = partials.pop(name, [])
+        if not parts:
+            return
+        gname = grad_var_name(name)
+        if len(parts) == 1:
+            if parts[0] != gname:
+                block.append_op(type="assign", inputs={"X": parts[0]},
+                                outputs={"Out": gname}, infer_shape=False)
+            grad_map[name] = gname
+        else:
+            block.append_op(type="sum", inputs={"X": parts},
+                            outputs={"Out": gname}, infer_shape=False)
+            grad_map[name] = gname
+
+    for op in reversed(path_ops):
+        # collect available output grads
+        out_grads_exist = False
+        for name in op.output_arg_names:
+            if name in grad_map:
+                out_grads_exist = True
+        if not out_grads_exist:
+            continue
+
+        od = op_registry.get_op_def(op.type) if op_registry.has_op(op.type) \
+            else None
+        if od is not None and od.grad_maker is not None:
+            new_ops = od.grad_maker(op, block, grad_map, no_grad_set)
+            _ = new_ops
+            continue
+
+        grad_inputs = {}
+        for slot, names in op.inputs.items():
+            grad_inputs[slot] = list(names)
+        for slot, names in op.outputs.items():
+            grad_inputs["Out:" + slot] = list(names)
+            grad_inputs["GRAD:" + slot] = [
+                grad_map.get(n, "") for n in names]
+
+        grad_outputs = {}
+        any_grad_out = False
+        for slot, names in op.inputs.items():
+            gnames = []
+            for n in names:
+                v = block._find_var_recursive(n)
+                if n in no_grad_set or v is None or \
+                        (v is not None and v.stop_gradient):
+                    gnames.append("")
+                    continue
+                gname = grad_var_name(n)
+                if pending.get(n, 0) > 1:
+                    gname = gname + "@RENAME@%d" % len(
+                        partials.setdefault(n, []))
+                    partials[n].append(gname)
+                else:
+                    partials.setdefault(n, []).append(gname)
+                _create_grad_var(block, v, gname)
+                gnames.append(gname)
+                any_grad_out = True
+            grad_outputs["GRAD:" + slot] = gnames
+        if not any_grad_out:
+            # still may need to decrement pending below
+            pass
+        else:
+            block.append_op(
+                type=op.type + "_grad",
+                inputs=grad_inputs, outputs=grad_outputs,
+                attrs={"fwd_uid": op.uid, "fwd_type": op.type,
+                       "fwd_attrs": dict(op.attrs)},
+                infer_shape=False)
+
+        # a consumer of each input var has now contributed its partial
+        for name in set(op.input_arg_names):
+            if name in pending:
+                pending[name] -= 1
+                if pending[name] == 0 and name in partials:
+                    finalize_grad(name)
+    # finalize any leftovers (vars consumed by ops off the path)
+    for name in list(partials):
+        finalize_grad(name)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Append grad ops for `loss` to its program; return
+    [(param, param_grad)] like the reference (backward.py:469)."""
+    assert isinstance(loss, Variable)
+    program = loss.block.program
+    block = program.global_block()
+    no_grad = set(no_grad_set or [])
+    for v in block.vars.values():
+        if v.stop_gradient:
+            no_grad.add(v.name)
+
+    if parameter_list is not None:
+        params = [p if isinstance(p, str) else p.name
+                  for p in parameter_list]
+    else:
+        params = [p.name for p in block.all_parameters()
+                  if getattr(p, "trainable", True)]
+
+    # seed: d loss / d loss = 1
+    loss_grad = grad_var_name(loss.name)
+    _create_grad_var(block, loss, loss_grad)
+    block.append_op(
+        type="fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={"shape": list(loss.shape) if loss.shape else [1],
+               "value": 1.0, "dtype": loss.dtype,
+               "op_role": "Backward"},
+        infer_shape=False)
+
+    grad_map = {loss.name: loss_grad}
+    path = _op_path(block, [loss.name], params, no_grad)
+    _append_grad_ops(block, path, grad_map, no_grad)
+
+    params_and_grads = []
+    for pname in params:
+        gname = grad_map.get(pname)
+        if gname is None or not block.has_var(gname):
+            continue
+        params_and_grads.append((block.var(pname), block.var(gname)))
+    return params_and_grads
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference backward.py:685 — grads of `targets` w.r.t. `inputs`."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    block = targets[0].block
+    program = block.program
+    no_grad = set(no_grad_set or [])
+
+    grad_map = {}
+    for i, t in enumerate(targets):
+        gname = grad_var_name(t.name)
+        _create_grad_var(block, t, gname)
+        if target_gradients is not None and target_gradients[i] is not None:
+            block.append_op(type="assign",
+                            inputs={"X": target_gradients[i].name},
+                            outputs={"Out": gname}, infer_shape=False)
+        else:
+            block.append_op(
+                type="fill_constant", outputs={"Out": [gname]},
+                attrs={"shape": list(t.shape) if t.shape else [1],
+                       "value": 1.0, "dtype": t.dtype},
+                infer_shape=False)
+        grad_map[t.name] = gname
+
+    input_names = [v.name for v in inputs]
+    path = _op_path(block, [t.name for t in targets], input_names, no_grad)
+    _append_grad_ops(block, path, grad_map, no_grad)
+
+    result = []
+    for v in inputs:
+        gname = grad_map.get(v.name)
+        result.append(block.var(gname) if gname and block.has_var(gname)
+                      else None)
+    return result
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    return calc_gradient(targets, inputs, target_gradients, no_grad_set)

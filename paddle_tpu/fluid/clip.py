@@ -1,0 +1,169 @@
+"""Gradient/error clipping (reference python/paddle/fluid/clip.py — value/norm/
+global_norm :212 clipping appended as ops before the optimizer update)."""
+
+from .framework import Variable, default_main_program
+from .layer_helper import LayerHelper
+from . import layers
+
+__all__ = ["ErrorClipByValue", "GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "set_gradient_clip",
+           "append_gradient_clip_ops"]
+
+
+class BaseErrorClipAttr:
+    def _append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        self.max = max
+        self.min = float(min) if min is not None else -max
+
+    def _append_clip_op(self, block, grad_name):
+        block.append_op(type="clip", inputs={"X": grad_name},
+                        outputs={"Out": grad_name},
+                        attrs={"min": self.min, "max": self.max},
+                        infer_shape=False)
+
+
+class BaseGradientClipAttr:
+    def _process_context(self, context, param, grad):
+        raise NotImplementedError
+
+    def _create_operators(self, param, grad):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def _process_context(self, context, param, grad):
+        pass
+
+    def _create_operators(self, param, grad):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        self.max = max
+        self.min = float(min) if min is not None else -max
+
+    def _process_context(self, context, param, grad):
+        pass
+
+    def _create_operators(self, param, grad):
+        new_grad = layers.clip(x=grad, min=self.min, max=self.max)
+        return param, new_grad
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process_context(self, context, param, grad):
+        pass
+
+    def _create_operators(self, param, grad):
+        new_grad = layers.clip_by_norm(x=grad, max_norm=self.clip_norm)
+        return param, new_grad
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    """reference clip.py:212 — scale all grads by
+    clip_norm / max(global_norm, clip_norm)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _process_context(self, context, param, grad):
+        if self.group_name not in context:
+            context[self.group_name] = []
+            context[self.group_name + "_clip_value"] = self.clip_norm
+        else:
+            if context[self.group_name + "_clip_value"] != self.clip_norm:
+                raise ValueError("all parameters in a group should share the "
+                                 "same clip norm")
+        sq = layers.squared_l2_norm_layer(grad) if hasattr(
+            layers, "squared_l2_norm_layer") else _squared_l2_norm(grad)
+        context[self.group_name].append(sq)
+        self.context = context
+
+    def _create_operators(self, param, grad):
+        group_scale_name = self.group_name + "_scale"
+        if group_scale_name not in self.context:
+            group_norm = layers.sums(input=self.context[self.group_name])
+            group_norm = layers.sqrt(x=group_norm)
+            clip_var = layers.fill_constant(shape=[1], dtype="float32",
+                                            value=self.clip_norm)
+            group_scale = layers.elementwise_div(
+                x=clip_var,
+                y=layers.elementwise_max(x=clip_var, y=group_norm))
+            self.context[group_scale_name] = group_scale
+        new_grad = layers.elementwise_mul(
+            x=grad, y=self.context[group_scale_name])
+        return param, new_grad
+
+
+def _squared_l2_norm(grad):
+    helper = LayerHelper("squared_l2_norm")
+    out = helper.create_variable_for_type_inference(grad.dtype)
+    helper.append_op(type="squared_l2_norm", inputs={"X": grad},
+                     outputs={"Out": out}, infer_shape=False)
+    return out
+
+
+_clip_attr_holder = {}
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    if program is None:
+        program = default_main_program()
+    if param_list is None:
+        param_list = program.global_block().all_parameters()
+    param_list = [p.name if isinstance(p, Variable) else p
+                  for p in param_list]
+    for name in param_list:
+        p = program.global_block().var(name)
+        p.gradient_clip_attr = clip
+
+
+def error_clip_callback(block, context):
+    for op in block.ops:
+        for grad_n in op.output_arg_names:
+            if grad_n.endswith("@GRAD"):
+                fwd_var = block._find_var_recursive(grad_n[:-5])
+                if fwd_var is None:
+                    continue
+                error_clip = getattr(fwd_var, "error_clip", None)
+                if error_clip is not None:
+                    error_clip._append_clip_op(block, grad_n)
+
+
+def append_gradient_clip_ops(param_grads):
+    context = {}
+    any_clip = False
+    for p, g in param_grads:
+        if g is None:
+            continue
+        clip_attr = getattr(p, "gradient_clip_attr", None)
+        if clip_attr is None:
+            clip_attr = NullGradientClipAttr()
+        if not isinstance(clip_attr, NullGradientClipAttr):
+            any_clip = True
+        clip_attr._process_context(context, p, g)
+    if not any_clip:
+        return param_grads
+    clipped = []
+    for p, g in param_grads:
+        if g is None:
+            clipped.append((p, g))
+            continue
+        clip_attr = getattr(p, "gradient_clip_attr", None)
+        if clip_attr is None or isinstance(clip_attr, NullGradientClipAttr):
+            clipped.append((p, g))
+        else:
+            clipped.append(clip_attr._create_operators(p, g))
+    return clipped

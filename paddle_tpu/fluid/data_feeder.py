@@ -1,0 +1,74 @@
+"""DataFeeder (reference python/paddle/fluid/data_feeder.py:83): converts
+lists/tuples of numpy samples into feed dicts, with multi-device split."""
+
+import numpy as np
+
+from .framework import Variable
+from . import core
+from .lod import LoDTensor
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                from .framework import default_main_program
+                each_var = (program or default_main_program()
+                            ).global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("feed_list should be a list of Variable")
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(each_var.shape)
+            self.feed_dtypes.append(core.convert_dtype_to_np(each_var.dtype))
+        self.place = place
+
+    def feed(self, iterable):
+        """iterable: list of samples; each sample is a tuple matching
+        feed_list order. Returns {name: ndarray-or-LoDTensor}."""
+        columns = list(zip(*iterable))
+        ret = {}
+        for name, dtype, shape, lod_level, col in zip(
+                self.feed_names, self.feed_dtypes, self.feed_shapes,
+                self.feed_lod_level, columns):
+            if lod_level == 0:
+                arr = np.asarray(col, dtype=dtype)
+                # restore static trailing dims (e.g. label [-1,1])
+                want = [d for d in shape if d is not None]
+                if len(shape) and shape[-1] == 1 and arr.ndim == 1:
+                    arr = arr.reshape(-1, 1)
+                ret[name] = arr
+            else:
+                seq_lens = [len(s) for s in col]
+                flat = np.concatenate(
+                    [np.asarray(s, dtype=dtype).reshape(len(s), -1)
+                     for s in col], axis=0)
+                if len(shape) and shape[-1] == 1 and flat.shape[-1] == 1:
+                    pass
+                ret[name] = LoDTensor(flat)
+                ret[name].set_recursive_sequence_lengths([seq_lens])
+        return ret
+
+    def feed_parallel(self, iterable, num_places=None):
+        """split one batch into per-device feeds (reference :83 multi-device
+        path); with the mesh-sharded ParallelExecutor a single dict is
+        preferred, but the API is kept."""
+        full = self.feed(iterable)
+        if num_places is None or num_places <= 1:
+            return [full]
+        out = []
+        n = len(iterable)
+        per = (n + num_places - 1) // num_places
+        for i in range(num_places):
+            part = {}
+            for k, v in full.items():
+                arr = np.asarray(v)
+                part[k] = arr[i * per:(i + 1) * per]
+            out.append(part)
+        return out

@@ -1,0 +1,201 @@
+"""Executor + Scope.
+
+Reference analogues: python/paddle/fluid/executor.py:256 (Executor: program
+cache, feed/fetch, as_numpy :66, scope_guard :47) over C++
+framework/executor.cc:183 (Executor::Run) and scope.h:41 (Scope).
+
+TPU redesign: `run(program, feed, fetch_list)` functionalizes the block
+(functionalizer.py), jits it once per (program version, feed signature,
+fetch list) and replays the compiled XLA computation per step — the analogue
+of the reference's ExecutorPrepareContext cache (executor.py:207) where the
+cached object is a compiled HLO module instead of an op list. Parameters and
+other persistable variables live in the Scope as jax Arrays and are threaded
+through the jitted step functionally; on TPU the state buffers are donated so
+updates are in-place at the XLA level.
+"""
+
+import warnings
+
+import numpy as np
+
+from . import core
+from .framework import Program, Variable, default_main_program
+from . import functionalizer
+
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard", "as_numpy"]
+
+
+class _TensorView:
+    """Mimics fluid's `scope.find_var(name).get_tensor()` protocol."""
+
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        return self._scope._vars[self._name]
+
+    def set(self, value, place=None):
+        import jax.numpy as jnp
+        self._scope._vars[self._name] = jnp.asarray(value)
+
+
+class Scope:
+    """name -> device array map (reference scope.h:41). Flat: the reference's
+    parent-scope chain existed for per-op temporary locals, which the
+    functional executor doesn't materialize."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        if name not in self._vars:
+            self._vars[name] = None
+        return _TensorView(self, name)
+
+    def find_var(self, name):
+        if name in self._vars:
+            return _TensorView(self, name)
+        return None
+
+    def has(self, name):
+        return name in self._vars
+
+    def get(self, name):
+        return self._vars.get(name)
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def drop_kids(self):
+        pass
+
+    def keys(self):
+        return self._vars.keys()
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *args):
+        _scope_stack.pop()
+
+
+def as_numpy(tensor):
+    """reference executor.py:66"""
+    if isinstance(tensor, (list, tuple)):
+        return [as_numpy(t) for t in tensor]
+    return np.asarray(tensor)
+
+
+def _fetch_name(f):
+    if isinstance(f, Variable):
+        return f.name
+    if isinstance(f, str):
+        return f
+    raise TypeError("bad fetch entry: %r" % (f,))
+
+
+class Executor:
+    """reference executor.py:256. `place` selects the jax backend; under jit
+    there is no per-op placement, so CPUPlace/TPUPlace only choose where the
+    compiled computation and the Scope arrays live."""
+
+    def __init__(self, place=None):
+        self.place = place if place is not None else core.TPUPlace(0)
+        self._cache = {}  # key -> jitted fn
+        self._step_counters = {}  # program cache id -> step
+
+    def _device(self):
+        try:
+            return self.place.jax_device()
+        except Exception:
+            return None
+
+    def close(self):
+        # reference: notifies pservers a trainer is leaving; collective-DP
+        # TPU path has no pserver connection to close by default.
+        self._cache.clear()
+
+    def _get_jitted(self, program, feed_names, fetch_names, state_names):
+        import jax
+        key = (id(program), program._version, feed_names, fetch_names,
+               tuple(state_names))
+        fn = self._cache.get(key)
+        if fn is None:
+            step_fn = functionalizer.build_step_fn(
+                program, feed_names, fetch_names, state_names)
+            donate = ()
+            dev = self._device()
+            if dev is not None and dev.platform == "tpu":
+                donate = (0,)
+            fn = jax.jit(step_fn, donate_argnums=donate)
+            self._cache[key] = fn
+        return fn
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            feed_var_name="feed", fetch_var_name="fetch", scope=None,
+            return_numpy=True, use_program_cache=True):
+        import jax
+        import jax.numpy as jnp
+
+        if program is None:
+            program = default_main_program()
+        if feed is None:
+            feed = {}
+        if fetch_list is None:
+            fetch_list = []
+        if scope is None:
+            scope = global_scope()
+
+        fetch_names = tuple(_fetch_name(f) for f in fetch_list)
+
+        # prepare feeds: numpy -> device arrays with var dtype
+        gb = program.global_block()
+        feeds = {}
+        for name, value in feed.items():
+            v = gb._find_var_recursive(name)
+            arr = np.asarray(value)
+            if v is not None and v.dtype is not None:
+                want = core.convert_dtype_to_np(v.dtype)
+                if arr.dtype != want and not (
+                        arr.dtype.kind in "iu" and want.kind in "iu"):
+                    arr = arr.astype(want)
+            feeds[name] = jnp.asarray(arr)
+        feed_key = tuple(sorted(feeds.keys()))
+
+        # output state covers ALL persistables (startup programs create
+        # params that are not yet in the scope); input state is whatever
+        # already exists. The jit signature keys on the input dict structure.
+        persistables = tuple(functionalizer.persistable_names(program))
+        fn = self._get_jitted(program, feed_key, fetch_names, persistables)
+
+        state_in = {n: scope.get(n) for n in persistables
+                    if scope.has(n) and scope.get(n) is not None}
+        step = self._step_counters.get(id(program), 0)
+        self._step_counters[id(program)] = step + 1
+
+        fetches, new_state = fn(state_in, feeds, np.uint32(step))
+        for n, val in new_state.items():
+            scope.set(n, val)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # ---- parity shims used by reference scripts ----
+    def _run_startup(self, startup_program, scope=None):
+        self.run(program=startup_program, scope=scope)

@@ -1,0 +1,555 @@
+"""Program/Block/Operator/Variable graph IR.
+
+This is the TPU-native re-design of the reference's two-layer IR:
+python/paddle/fluid/framework.py (Variable :204, Operator :494, Block :920,
+Program :1404, Parameter :1977) over paddle/fluid/framework/framework.proto
+(ProgramDesc :184, BlockDesc :171, OpDesc :43, VarDesc :165).
+
+Design difference from the reference (deliberate, see SURVEY.md §7): there is a
+single in-memory graph object — no separate protobuf "Desc" layer that Python
+mirrors — because the execution substrate is XLA: an entire block is
+functionalized at trace time into one HLO computation (see executor.py), so the
+IR's job is program *construction*, autodiff and serialization, not per-op
+dispatch. Serialization to/from a stable dict/JSON format replaces the
+protobuf round-trip (framework.py Program.desc / parse_from_string parity).
+
+Shape/dtype inference is delegated to the op registry, which runs the op's JAX
+lowering under jax.eval_shape (paddle_tpu/ops/registry.py) — the reference's
+per-op C++ InferShape (op_desc.cc:660) falls out of the lowering for free.
+"""
+
+import collections
+import contextlib
+import json
+
+import numpy as np
+
+from . import core, unique_name
+from .core import VarDesc, convert_np_dtype_to_dtype_
+
+__all__ = [
+    "Program", "Block", "Variable", "Operator", "Parameter",
+    "default_startup_program", "default_main_program", "program_guard",
+    "name_scope", "grad_var_name", "in_dygraph_mode",
+]
+
+GRAD_VAR_SUFFIX = "@GRAD"
+ZERO_VAR_SUFFIX = "@ZERO"
+
+
+def grad_var_name(var_name):
+    return var_name + GRAD_VAR_SUFFIX
+
+
+def in_dygraph_mode():
+    # The rebuild is graph-first; imperative mode is provided by the `imperative`
+    # module (later milestone), which never flips this global.
+    return False
+
+
+_name_scope_stack = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Debug name scoping (reference framework.py:80)."""
+    if prefix:
+        _name_scope_stack.append(prefix)
+    try:
+        yield
+    finally:
+        if prefix:
+            _name_scope_stack.pop()
+
+
+def _current_name_scope():
+    return "/".join(_name_scope_stack)
+
+
+class Variable:
+    """A named tensor slot in a Block (reference framework.py:204).
+
+    LoD (ragged sequence) support: `lod_level > 0` marks the variable as
+    carrying ragged rows; at runtime the value is a LoDArray (dense data +
+    row-split metadata) — see paddle_tpu/fluid/lod.py. This reproduces the
+    reference's LoDTensor capability (lod_tensor.h:110) in the dense
+    segment-id encoding idiomatic to XLA's static shapes.
+    """
+
+    def __init__(self, block, type=VarDesc.VarType.LOD_TENSOR, name=None,
+                 shape=None, dtype=None, lod_level=None, capacity=None,
+                 persistable=None, error_clip=None, stop_gradient=False,
+                 is_data=False, initializer=None, **kwargs):
+        self.block = block
+        if name is None:
+            name = unique_name.generate("_generated_var")
+        self.name = name
+        self.type = type
+        self.shape = tuple(shape) if shape is not None else None
+        if dtype is not None and not isinstance(dtype, int):
+            dtype = convert_np_dtype_to_dtype_(dtype)
+        self.dtype = dtype if dtype is not None else VarDesc.VarType.FP32
+        self.lod_level = lod_level if lod_level is not None else 0
+        self.persistable = bool(persistable) if persistable is not None else False
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.error_clip = error_clip
+        self.op = None  # generating op, set by Block.append_op
+
+    # ---- fluid API surface ----
+    def astype(self, dtype):
+        from .layers import tensor as tensor_layers
+        return tensor_layers.cast(self, dtype)
+
+    @property
+    def grad_name(self):
+        return grad_var_name(self.name)
+
+    @property
+    def np_dtype(self):
+        return core.convert_dtype_to_np(self.dtype)
+
+    def to_string(self, throw_on_error=True, with_details=False):
+        return repr(self)
+
+    def __repr__(self):
+        return "Variable(name=%s, shape=%s, dtype=%s, lod_level=%d%s)" % (
+            self.name, self.shape, self.np_dtype.name if self.dtype is not None
+            else None, self.lod_level,
+            ", persistable" if self.persistable else "")
+
+    __str__ = __repr__
+
+    def _serialize(self):
+        return {
+            "name": self.name, "type": self.type,
+            "shape": list(self.shape) if self.shape is not None else None,
+            "dtype": self.dtype, "lod_level": self.lod_level,
+            "persistable": self.persistable, "stop_gradient": self.stop_gradient,
+            "is_data": self.is_data,
+            "is_parameter": isinstance(self, Parameter),
+            "trainable": getattr(self, "trainable", None),
+        }
+
+
+class Parameter(Variable):
+    """A persistable, trainable Variable (reference framework.py:1977)."""
+
+    def __init__(self, block, shape, dtype, **kwargs):
+        if shape is None or dtype is None:
+            raise ValueError("Parameter needs shape and dtype")
+        kwargs.setdefault("persistable", True)
+        super().__init__(block, shape=shape, dtype=dtype, **kwargs)
+        self.trainable = kwargs.get("trainable", True)
+        self.optimize_attr = kwargs.get("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kwargs.get("regularizer", None)
+        self.gradient_clip_attr = kwargs.get("gradient_clip_attr", None)
+        self.do_model_average = kwargs.get("do_model_average", None)
+
+
+class Operator:
+    """One node in a Block (reference framework.py:494 / OpDesc framework.proto:43).
+
+    inputs/outputs map slot name -> list of variable names. Attributes are
+    plain python values (the protobuf Attr variants collapse to JSON types,
+    plus Block references for control-flow ops).
+    """
+
+    _uid_counter = [0]
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = type
+        self.inputs = {}   # slot -> [var names]
+        self.outputs = {}  # slot -> [var names]
+        self.attrs = dict(attrs) if attrs else {}
+        if _name_scope_stack:
+            self.attrs.setdefault("op_namescope", _current_name_scope())
+        Operator._uid_counter[0] += 1
+        self.uid = Operator._uid_counter[0]
+
+        def norm(d, target):
+            if d is None:
+                return
+            for slot, vs in d.items():
+                if vs is None:
+                    target[slot] = []
+                    continue
+                if not isinstance(vs, (list, tuple)):
+                    vs = [vs]
+                target[slot] = [v.name if isinstance(v, Variable) else v
+                                for v in vs]
+
+        norm(inputs, self.inputs)
+        norm(outputs, self.outputs)
+
+    # ---- accessors (fluid parity) ----
+    def input(self, slot):
+        return self.inputs.get(slot, [])
+
+    def output(self, slot):
+        return self.outputs.get(slot, [])
+
+    @property
+    def input_arg_names(self):
+        return [n for vs in self.inputs.values() for n in vs]
+
+    @property
+    def output_arg_names(self):
+        return [n for vs in self.outputs.values() for n in vs]
+
+    @property
+    def input_names(self):
+        return list(self.inputs.keys())
+
+    @property
+    def output_names(self):
+        return list(self.outputs.keys())
+
+    def attr(self, name):
+        return self.attrs[name]
+
+    def has_attr(self, name):
+        return name in self.attrs
+
+    def _set_attr(self, name, val):
+        self.attrs[name] = val
+
+    def all_attrs(self):
+        return dict(self.attrs)
+
+    def __repr__(self):
+        return "Operator(%s: %s -> %s)" % (self.type, self.inputs, self.outputs)
+
+    __str__ = __repr__
+
+    def _serialize(self):
+        attrs = {}
+        for k, v in self.attrs.items():
+            if isinstance(v, Block):
+                attrs[k] = {"__block__": v.idx}
+            elif isinstance(v, np.ndarray):
+                attrs[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+            elif isinstance(v, (np.integer,)):
+                attrs[k] = int(v)
+            elif isinstance(v, (np.floating,)):
+                attrs[k] = float(v)
+            else:
+                attrs[k] = v
+        return {"type": self.type, "inputs": self.inputs,
+                "outputs": self.outputs, "attrs": attrs}
+
+
+class Block:
+    """An ordered op list + var map (reference framework.py:920)."""
+
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars = collections.OrderedDict()  # name -> Variable
+        self.ops = []
+        self.forward_block_idx = -1
+
+    @property
+    def parent_block(self):
+        if self.parent_idx < 0:
+            return None
+        return self.program.blocks[self.parent_idx]
+
+    def var(self, name):
+        v = self.vars.get(name)
+        if v is None:
+            raise ValueError("var %s not in block %d" % (name, self.idx))
+        return v
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def _var_recursive(self, name):
+        """Look up through parent scopes (reference Block._var_recursive)."""
+        blk = self
+        while blk is not None:
+            if name in blk.vars:
+                return blk.vars[name]
+            blk = blk.parent_block
+        raise ValueError("var %s not found in block hierarchy" % name)
+
+    def _find_var_recursive(self, name):
+        try:
+            return self._var_recursive(name)
+        except ValueError:
+            return None
+
+    def create_var(self, **kwargs):
+        name = kwargs.get("name")
+        if name is not None and name in self.vars:
+            return self.vars[name]
+        v = Variable(self, **kwargs)
+        self.vars[v.name] = v
+        return v
+
+    def create_parameter(self, **kwargs):
+        p = Parameter(self, **kwargs)
+        # Parameters live in the global (root) block, like the reference.
+        gb = self.program.global_block()
+        gb.vars[p.name] = p
+        p.block = gb
+        return p
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    def append_op(self, type=None, inputs=None, outputs=None, attrs=None,
+                  infer_shape=True):
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs,
+                      attrs=attrs)
+        self.ops.append(op)
+        self.program._bump_version()
+        for vs in op.outputs.values():
+            for name in vs:
+                v = self._find_var_recursive(name)
+                if v is not None:
+                    v.op = op
+        if infer_shape:
+            from ..ops import registry
+            registry.infer_shape(op, self)
+        return op
+
+    def _prepend_op(self, type=None, inputs=None, outputs=None, attrs=None):
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs,
+                      attrs=attrs)
+        self.ops.insert(0, op)
+        self.program._bump_version()
+        return op
+
+    def _insert_op(self, index, type=None, inputs=None, outputs=None,
+                   attrs=None):
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs,
+                      attrs=attrs)
+        self.ops.insert(index, op)
+        self.program._bump_version()
+        return op
+
+    def _remove_op(self, index):
+        del self.ops[index]
+        self.program._bump_version()
+
+    def _serialize(self):
+        return {
+            "idx": self.idx, "parent_idx": self.parent_idx,
+            "forward_block_idx": self.forward_block_idx,
+            "vars": [v._serialize() for v in self.vars.values()],
+            "ops": [op._serialize() for op in self.ops],
+        }
+
+
+class Program:
+    """Whole-model IR: a list of Blocks (reference framework.py:1404 /
+    ProgramDesc framework.proto:184). Executors consume this directly."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self._seed = 0
+        self._version = 0
+        self._op_role = "Forward"
+        self._op_role_var = []
+        # executor cache invalidation token
+        self._cache_id = id(self)
+
+    # ---- version / cache token ----
+    def _bump_version(self):
+        self._version += 1
+
+    @property
+    def random_seed(self):
+        return self._seed
+
+    @random_seed.setter
+    def random_seed(self, seed):
+        self._seed = int(seed)
+
+    # ---- block management ----
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def _create_block(self, parent_idx=None):
+        new_idx = len(self.blocks)
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        self.blocks.append(Block(self, new_idx, parent))
+        self.current_block_idx = new_idx
+        self._bump_version()
+        return self.current_block()
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    @contextlib.contextmanager
+    def _block_guard(self, parent_idx=None):
+        self._create_block(parent_idx)
+        try:
+            yield self.current_block()
+        finally:
+            self._rollback()
+
+    # ---- parameters ----
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    def list_vars(self):
+        for blk in self.blocks:
+            for v in blk.vars.values():
+                yield v
+
+    # ---- clone / prune (reference framework.py Program.clone/prune) ----
+    def clone(self, for_test=False):
+        p = Program.parse_from_string(self.serialize_to_string())
+        if for_test:
+            for blk in p.blocks:
+                for op in blk.ops:
+                    if "is_test" in _default_test_attrs.get(op.type, ()):
+                        op.attrs["is_test"] = True
+                    if op.type == "dropout":
+                        op.attrs["is_test"] = True
+                    if op.type == "batch_norm":
+                        op.attrs["is_test"] = True
+        return p
+
+    def _prune(self, feeds, fetches):
+        """Return a clone containing only ops needed to compute `fetches`
+        from `feeds` (reference Program.prune, used by save_inference_model)."""
+        p = self.clone()
+        blk = p.global_block()
+        feed_names = set(feeds)
+        needed = set(fetches)
+        keep = []
+        for op in reversed(blk.ops):
+            if set(op.output_arg_names) & needed:
+                keep.append(op)
+                for n in op.input_arg_names:
+                    if n not in feed_names:
+                        needed.add(n)
+        keep.reverse()
+        blk.ops = keep
+        used = set()
+        for op in blk.ops:
+            used.update(op.input_arg_names)
+            used.update(op.output_arg_names)
+        used |= feed_names | set(fetches)
+        blk.vars = collections.OrderedDict(
+            (k, v) for k, v in blk.vars.items() if k in used)
+        p._bump_version()
+        return p
+
+    # ---- serialization (replaces protobuf round-trip) ----
+    def serialize_to_string(self):
+        return json.dumps({
+            "version": 1,
+            "seed": self._seed,
+            "blocks": [b._serialize() for b in self.blocks],
+        })
+
+    @staticmethod
+    def parse_from_string(s):
+        data = json.loads(s)
+        p = Program()
+        p._seed = data.get("seed", 0)
+        p.blocks = []
+        for bdata in data["blocks"]:
+            blk = Block(p, bdata["idx"], bdata["parent_idx"])
+            blk.forward_block_idx = bdata.get("forward_block_idx", -1)
+            p.blocks.append(blk)
+        for blk, bdata in zip(p.blocks, data["blocks"]):
+            for vd in bdata["vars"]:
+                cls = Parameter if vd.pop("is_parameter", False) else Variable
+                trainable = vd.pop("trainable", None)
+                v = cls(blk, **vd)
+                if trainable is not None:
+                    v.trainable = trainable
+                blk.vars[v.name] = v
+            for od in bdata["ops"]:
+                attrs = {}
+                for k, av in od["attrs"].items():
+                    if isinstance(av, dict) and "__block__" in av:
+                        attrs[k] = p.blocks[av["__block__"]]
+                    elif isinstance(av, dict) and "__ndarray__" in av:
+                        attrs[k] = np.array(av["__ndarray__"],
+                                            dtype=av["dtype"])
+                    else:
+                        attrs[k] = av
+                op = Operator(blk, od["type"], od["inputs"], od["outputs"],
+                              attrs)
+                blk.ops.append(op)
+        p._bump_version()
+        return p
+
+    def to_string(self, throw_on_error=True, with_details=False):
+        lines = []
+        for blk in self.blocks:
+            lines.append("-- block %d (parent %d) --" % (blk.idx,
+                                                         blk.parent_idx))
+            for v in blk.vars.values():
+                lines.append("  " + repr(v))
+            for op in blk.ops:
+                lines.append("  " + repr(op))
+        return "\n".join(lines)
+
+    __str__ = to_string
+
+
+_default_test_attrs = {
+    "dropout": ("is_test",),
+    "batch_norm": ("is_test",),
+}
+
+# ---- default programs & guards (reference framework.py:2061-:2129) ----
+
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_startup_program():
+    return _startup_program_
+
+
+def default_main_program():
+    return _main_program_
+
+
+def switch_main_program(program):
+    global _main_program_
+    prev = _main_program_
+    _main_program_ = program
+    return prev
+
+
+def switch_startup_program(program):
+    global _startup_program_
+    prev = _startup_program_
+    _startup_program_ = program
+    return prev
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    prev_main = switch_main_program(main_program)
+    prev_startup = None
+    if startup_program is not None:
+        prev_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(prev_main)
+        if prev_startup is not None:
+            switch_startup_program(prev_startup)

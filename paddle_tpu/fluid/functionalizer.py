@@ -1,0 +1,182 @@
+"""Block functionalization: Program -> pure JAX step function.
+
+This replaces the reference's per-op interpreter hot loop
+(framework/executor.cc:414 `for (auto& op : ctx->ops_) op->Run(...)`) with a
+*trace-time* interpreter: the op loop runs once inside a jax trace, each op's
+lowering contributes XLA HLO, and the result is ONE compiled computation per
+(program, feed-signature) — XLA fuses across op boundaries, so the reference's
+fusion passes (fc_fuse, conv_bn, fuse_elewise_add_act, ir/*.cc ~8k LoC) are
+subsumed by the compiler (SURVEY.md §7 design stance).
+
+Scope mutation semantics (reference scope.h:41 — ops mutate named Variables)
+become functional state threading: persistable vars go in as a dict and come
+out as a dict; the Executor writes them back to the Scope, and on TPU donates
+the input buffers so parameter updates stay in-place at the XLA level.
+
+Gradient ops: `<type>_grad` ops consume the jax.vjp closure stashed when their
+forward op was traced (ops/registry.make_forward_and_vjp) — see backward.py.
+"""
+
+import numpy as np
+
+from .. import ops as op_registry
+from ..ops.registry import ExecContext, make_forward_and_vjp
+
+_SKIP_OPS = frozenset(["feed", "fetch"])
+
+
+def _float0_zeros(primal_struct):
+    import jax
+    import jax.numpy as jnp
+    if jnp.issubdtype(primal_struct.dtype, jnp.floating):
+        return jnp.zeros(primal_struct.shape, primal_struct.dtype)
+    return np.zeros(primal_struct.shape, dtype=jax.dtypes.float0)
+
+
+def _normalize_outs(outs):
+    """lowering output -> {slot: [values]}"""
+    norm = {}
+    for slot, v in outs.items():
+        norm[slot] = list(v) if isinstance(v, (list, tuple)) else [v]
+    return norm
+
+
+class _FwdProxy:
+    """Stand-in op for the recompute fallback of generic grad ops (when the
+    forward op was not traced in the same call, e.g. calc_gradient on a
+    pruned program)."""
+    __slots__ = ("type", "attrs", "uid", "inputs", "outputs")
+
+    def __init__(self, type, attrs, uid, inputs):
+        self.type = type
+        self.attrs = attrs
+        self.uid = uid
+        self.inputs = inputs
+        self.outputs = {}
+
+
+def _gather_inputs(op, env):
+    vals = {}
+    for slot, names in op.inputs.items():
+        vals[slot] = [env.get(n) if n else None for n in names]
+    return vals
+
+
+def _write_outputs(op, outs, env):
+    norm = _normalize_outs(outs)
+    for slot, names in op.outputs.items():
+        produced = norm.get(slot, [])
+        for i, name in enumerate(names):
+            if name and i < len(produced) and produced[i] is not None:
+                env[name] = produced[i]
+
+
+def _run_forward_op(op, env, vjp_cache, needed_vjp, step, seed, mesh):
+    od = op_registry.get_op_def(op.type)
+    ctx = ExecContext(op, _gather_inputs(op, env), step=step, seed=seed,
+                      mesh=mesh)
+    if op.uid in needed_vjp:
+        outs, vjp_fn = make_forward_and_vjp(op, od, ctx)
+        norm = _normalize_outs(outs)
+        struct = {s: [_ShapeOf(v) for v in vs] for s, vs in norm.items()}
+        vjp_cache[op.uid] = (vjp_fn, struct)
+        _write_outputs(op, norm, env)
+    else:
+        outs = od.lower(ctx)
+        if outs:
+            _write_outputs(op, outs, env)
+
+
+class _ShapeOf:
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, v):
+        self.shape = v.shape
+        self.dtype = v.dtype
+
+
+def _run_grad_op(op, env, vjp_cache, step, seed, mesh):
+    fwd_uid = op.attrs["fwd_uid"]
+    entry = vjp_cache.get(fwd_uid)
+    if entry is None:
+        # fallback: re-run forward under vjp from the wired fwd inputs
+        fwd_inputs = {slot: [env.get(n) if n else None for n in names]
+                      for slot, names in op.inputs.items()
+                      if not slot.startswith(("Out:", "GRAD:"))}
+        proxy = _FwdProxy(op.attrs["fwd_type"], op.attrs["fwd_attrs"],
+                          fwd_uid, fwd_inputs)
+        od = op_registry.get_op_def(proxy.type)
+        ctx = ExecContext(proxy, fwd_inputs, step=step, seed=seed, mesh=mesh)
+        outs, vjp_fn = make_forward_and_vjp(proxy, od, ctx)
+        norm = _normalize_outs(outs)
+        struct = {s: [_ShapeOf(v) for v in vs] for s, vs in norm.items()}
+    else:
+        vjp_fn, struct = entry
+
+    import jax.numpy as jnp
+    cotangents = {}
+    for slot, parts in struct.items():
+        gnames = op.inputs.get("GRAD:" + slot, [])
+        cs = []
+        for i, p in enumerate(parts):
+            g = env.get(gnames[i]) if i < len(gnames) and gnames[i] else None
+            if g is None:
+                cs.append(_float0_zeros(p))
+            else:
+                cs.append(jnp.asarray(g, dtype=p.dtype).reshape(p.shape))
+        cotangents[slot] = cs
+    grads = vjp_fn(cotangents)
+    for slot, gvals in grads.items():
+        names = op.outputs.get("GRAD:" + slot, [])
+        for name, g in zip(names, gvals):
+            if name and g is not None:
+                env[name] = g
+
+
+def run_block(block, env, step=0, seed=0, mesh=None, vjp_cache=None):
+    """Interpret one block inside the current jax trace, mutating env.
+    Also used recursively by control-flow op lowerings."""
+    if vjp_cache is None:
+        vjp_cache = {}
+    needed_vjp = set()
+    for op in block.ops:
+        if op.type.endswith("_grad") and "fwd_uid" in op.attrs:
+            needed_vjp.add(op.attrs["fwd_uid"])
+    for op in block.ops:
+        if op.type in _SKIP_OPS:
+            continue
+        if op.type.endswith("_grad") and "fwd_uid" in op.attrs and \
+                not op_registry.has_op(op.type):
+            _run_grad_op(op, env, vjp_cache, step, seed, mesh)
+        else:
+            _run_forward_op(op, env, vjp_cache, needed_vjp, step, seed, mesh)
+    return env
+
+
+def build_step_fn(program, feed_names, fetch_names, state_names,
+                  block_idx=0, mesh=None):
+    """Return pure fn(state_dict, feed_dict, step) -> (fetches, new_state)."""
+    block = program.blocks[block_idx]
+    seed = program.random_seed
+    state_names = tuple(state_names)
+    fetch_names = tuple(fetch_names)
+
+    def step_fn(state, feeds, step):
+        env = {}
+        env.update(state)
+        env.update(feeds)
+        run_block(block, env, step=step, seed=seed, mesh=mesh)
+        fetches = [env[n] for n in fetch_names]
+        new_state = {n: env[n] for n in state_names if n in env}
+        return fetches, new_state
+
+    return step_fn
+
+
+def persistable_names(program):
+    names = []
+    for blk in program.blocks:
+        for v in blk.vars.values():
+            if v.persistable:
+                names.append(v.name)
+    return names

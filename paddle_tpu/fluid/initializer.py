@@ -1,0 +1,188 @@
+"""Initializers emitted as startup-program ops.
+
+Reference analogue: python/paddle/fluid/initializer.py:121-532 — Constant,
+Uniform, Normal, TruncatedNormal, Xavier, MSRA, Bilinear, NumpyArray. Each
+initializer appends an op (fill_constant / uniform_random / gaussian_random /
+assign_value) to the startup program; the RNG ops lower to deterministic
+threefry draws keyed by (seed, op uid) — see ops/tensor_ops.py.
+"""
+
+import numpy as np
+
+from .framework import default_startup_program
+
+__all__ = [
+    "Constant", "Uniform", "Normal", "TruncatedNormal", "Xavier", "MSRA",
+    "Bilinear", "NumpyArrayInitializer", "force_init_on_cpu",
+    "init_on_cpu",
+]
+
+
+def force_init_on_cpu():
+    # On TPU there is no init-on-GPU-vs-CPU distinction: startup programs are
+    # jitted like everything else. Kept for API parity.
+    return False
+
+
+class init_on_cpu:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *args):
+        pass
+
+
+class Initializer:
+    def __init__(self):
+        pass
+
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0, force_cpu=False):
+        super().__init__()
+        self._value = value
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="fill_constant", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "value": float(self._value)},
+            infer_shape=False)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        super().__init__()
+        self._low, self._high, self._seed = low, high, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="uniform_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "min": self._low, "max": self._high, "seed": self._seed},
+            infer_shape=False)
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        super().__init__()
+        self._mean, self._std, self._seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="gaussian_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": self._mean, "std": self._std, "seed": self._seed},
+            infer_shape=False)
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        super().__init__()
+        self._mean, self._std, self._seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="truncated_gaussian_random", outputs={"Out": var},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": self._mean, "std": self._std, "seed": self._seed},
+            infer_shape=False)
+
+
+def _fan_in_out(var):
+    shape = var.shape
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierInitializer(Initializer):
+    """Glorot. Matches reference initializer.py:276 fan computation."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        super().__init__()
+        self._uniform, self._seed = uniform, seed
+        self._fan_in, self._fan_out = fan_in, fan_out
+
+    def __call__(self, var, block):
+        f_in, f_out = _fan_in_out(var)
+        fan_in = f_in if self._fan_in is None else self._fan_in
+        fan_out = f_out if self._fan_out is None else self._fan_out
+        if self._uniform:
+            limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+            return UniformInitializer(-limit, limit, self._seed)(var, block)
+        std = float(np.sqrt(2.0 / (fan_in + fan_out)))
+        return NormalInitializer(0.0, std, self._seed)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    """Kaiming He init (reference initializer.py:364)."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        super().__init__()
+        self._uniform, self._seed, self._fan_in = uniform, seed, fan_in
+
+    def __call__(self, var, block):
+        f_in, _ = _fan_in_out(var)
+        fan_in = f_in if self._fan_in is None else self._fan_in
+        if self._uniform:
+            limit = float(np.sqrt(6.0 / fan_in))
+            return UniformInitializer(-limit, limit, self._seed)(var, block)
+        std = float(np.sqrt(2.0 / fan_in))
+        return NormalInitializer(0.0, std, self._seed)(var, block)
+
+
+class BilinearInitializer(Initializer):
+    """For conv-transpose upsampling kernels (reference initializer.py:459)."""
+
+    def __call__(self, var, block):
+        shape = var.shape
+        if len(shape) != 4:
+            raise ValueError("bilinear init needs rank-4 var")
+        weight = np.zeros(shape, dtype=np.float32)
+        size = shape[3]
+        factor = (size + 1) // 2
+        center = factor - 1 if size % 2 == 1 else factor - 0.5
+        og = np.ogrid[:size, :size]
+        filt = (1 - abs(og[0] - center) / factor) * \
+               (1 - abs(og[1] - center) / factor)
+        weight[range(shape[0]), range(shape[1]), :, :] = filt
+        return NumpyArrayInitializer(weight)(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        super().__init__()
+        self._value = np.asarray(value)
+
+    def __call__(self, var, block):
+        v = self._value.astype(np.float32)
+        return block.append_op(
+            type="assign_value", outputs={"Out": var},
+            attrs={"shape": list(v.shape), "dtype": var.dtype,
+                   "fp32_values": [float(x) for x in v.flatten()]},
+            infer_shape=False)
+
+
+# fluid aliases
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer
+
+
+def _global_weight_initializer():
+    return XavierInitializer()
+
+
+def _global_bias_initializer():
+    return ConstantInitializer(0.0)

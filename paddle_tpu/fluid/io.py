@@ -1,0 +1,174 @@
+"""Checkpoint / model save-load.
+
+Reference analogue: python/paddle/fluid/io.py — save/load_vars/params/
+persistables (:89-:505) driving in-graph save/load ops (operators/save_op.cc),
+save_inference_model (:544 prune + feed/fetch + serialize),
+load_inference_model (:674).
+
+TPU redesign: variables live in the Scope as jax Arrays; save/load is a host
+round-trip to .npz shards plus the serialized Program, which keeps the
+reference's directory layout (one file per var, or a single combined file
+with save_combine semantics). Orbax-style sharded checkpointing for the
+multi-chip path lands with the parallel milestone.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from .framework import Program, Parameter, default_main_program, Variable
+from .executor import global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "save_checkpoint", "load_checkpoint",
+]
+
+
+def _var_list(main_program, predicate):
+    return [v for v in main_program.global_block().vars.values()
+            if predicate(v)]
+
+
+def is_persistable(var):
+    return bool(var.persistable)
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference io.py:89. One .npy per var, or a single .npz when
+    `filename` is given (save_combine semantics)."""
+    if main_program is None:
+        main_program = default_main_program()
+    if vars is None:
+        vars = _var_list(main_program, predicate or is_persistable)
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        arrays = {}
+        for v in vars:
+            val = scope.get(v.name if isinstance(v, Variable) else v)
+            if val is not None:
+                arrays[v.name] = np.asarray(val)
+        np.savez(os.path.join(dirname, filename), **arrays)
+        return
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else v
+        val = scope.get(name)
+        if val is None:
+            continue
+        np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"),
+                np.asarray(val))
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if vars is None:
+        vars = _var_list(main_program, predicate or is_persistable)
+    scope = global_scope()
+    import jax.numpy as jnp
+    if filename is not None:
+        data = np.load(os.path.join(dirname, filename)
+                       if not filename.endswith(".npz")
+                       else os.path.join(dirname, filename))
+        for v in vars:
+            if v.name in data:
+                scope.set(v.name, jnp.asarray(data[v.name]))
+        return
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else v
+        path = os.path.join(dirname, name.replace("/", "__") + ".npy")
+        if os.path.exists(path):
+            scope.set(name, jnp.asarray(np.load(path)))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=is_persistable, filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    """reference io.py:544: prune program to the inference subgraph, save
+    program + params."""
+    if main_program is None:
+        main_program = default_main_program()
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    os.makedirs(dirname, exist_ok=True)
+    pruned = main_program.clone(for_test=True)
+    pruned = pruned._prune(feeded_var_names,
+                           [v.name for v in target_vars])
+    meta = {
+        "program": pruned.serialize_to_string(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [v.name for v in target_vars],
+    }
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename), "w") as f:
+        json.dump(meta, f)
+    save_params(executor, dirname, main_program,
+                filename=params_filename)
+    return [v.name for v in target_vars]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """reference io.py:674 -> (program, feed_names, fetch_vars)."""
+    model_filename = model_filename or "__model__"
+    with open(os.path.join(dirname, model_filename)) as f:
+        meta = json.load(f)
+    program = Program.parse_from_string(meta["program"])
+    # load params into scope under the program's var names
+    vars = [v for v in program.global_block().vars.values()
+            if isinstance(v, Parameter) or v.persistable]
+    load_vars(executor, dirname, program, vars=vars,
+              filename=params_filename)
+    fetch_vars = [program.global_block().var(n)
+                  for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars
+
+
+def save_checkpoint(executor, dirname, main_program=None, step=None):
+    """Checkpoint with metadata (reference CheckpointConfig/contrib
+    trainer.py:100 auto-save; Go pserver CRC checkpoint go/pserver/
+    service.go:119)."""
+    os.makedirs(dirname, exist_ok=True)
+    save_persistables(executor, dirname, main_program,
+                      filename="__checkpoint__.npz")
+    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
+        json.dump({"step": step}, f)
+
+
+def load_checkpoint(executor, dirname, main_program=None):
+    load_persistables(executor, dirname, main_program,
+                      filename="__checkpoint__.npz")
+    meta_path = os.path.join(dirname, "__meta__.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            return json.load(f).get("step")
+    return None

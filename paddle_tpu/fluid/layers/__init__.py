@@ -1,0 +1,24 @@
+"""fluid.layers namespace (reference python/paddle/fluid/layers/__init__.py)."""
+
+from . import nn
+from .nn import *  # noqa: F401,F403
+from . import tensor
+from .tensor import *  # noqa: F401,F403
+from . import ops
+from .ops import *  # noqa: F401,F403
+from . import io
+from .io import data, py_reader  # noqa: F401
+from . import math_op_patch
+from .math_op_patch import monkey_patch_variable
+
+monkey_patch_variable()
+
+from .learning_rate_scheduler import *  # noqa: F401,F403,E402
+from . import learning_rate_scheduler  # noqa: E402
+
+__all__ = []
+__all__ += nn.__all__
+__all__ += tensor.__all__
+__all__ += ops.__all__
+__all__ += ["data", "py_reader"]
+__all__ += learning_rate_scheduler.__all__

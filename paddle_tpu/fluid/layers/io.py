@@ -1,0 +1,58 @@
+"""Data-input layers (reference python/paddle/fluid/layers/io.py).
+
+`data` (:39) declares a feed variable. The py_reader pipeline (:633 — a
+Python thread feeding a C++ LoDTensorBlockingQueue, double-buffered onto the
+device) is rebuilt TPU-style in paddle_tpu/fluid/reader.py as a host-side
+prefetching iterator with jax.device_put overlap; the `py_reader` symbol here
+returns that object wrapped with the reference's decorate_paddle_reader /
+start / reset protocol.
+"""
+
+from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+from .. import core
+
+__all__ = ["data", "py_reader", "batch", "double_buffer", "read_file"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=None, stop_gradient=True):
+    """reference layers/io.py:39"""
+    helper = LayerHelper("data", name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper.create_global_variable(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        stop_gradient=stop_gradient, is_data=True)
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """TPU-native py_reader: returns (reader, input_vars). The reader object
+    implements decorate_paddle_reader/decorate_tensor_provider/start/reset
+    and the Executor consumes it by feeding (see fluid/reader.py)."""
+    from ..reader import PyReader
+    vars = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        lod = lod_levels[i] if lod_levels else 0
+        v = data(name="%s_slot_%d" % (name or "py_reader", i),
+                 shape=list(shape)[1:], dtype=dtype, lod_level=lod)
+        vars.append(v)
+    reader = PyReader(capacity=capacity, feed_vars=vars,
+                      use_double_buffer=use_double_buffer)
+    reader.output_vars = vars
+    return reader
+
+
+def batch(reader, batch_size):
+    import paddle_tpu.reader_decorators as rd
+    return rd.batch(reader, batch_size)
+
+
+def double_buffer(reader, place=None, name=None):
+    return reader
+
+
+def read_file(reader):
+    return reader.output_vars

@@ -1,0 +1,124 @@
+"""In-graph LR schedules (reference layers/learning_rate_scheduler.py:347 —
+piecewise/exponential/natural_exp/inverse_time/polynomial/cosine decay built
+as ops over a persistable global step counter @LR_DECAY_COUNTER@)."""
+
+import math
+
+from ..framework import default_main_program, Variable
+from ..layer_helper import LayerHelper
+from . import tensor, nn, ops
+
+__all__ = [
+    "exponential_decay", "natural_exp_decay", "inverse_time_decay",
+    "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
+    "linear_lr_warmup",
+]
+
+LR_COUNTER_NAME = "@LR_DECAY_COUNTER@"
+
+
+def _decay_step_counter(begin=0):
+    """Persistable int step counter incremented once per program run.
+    Reference: layers/learning_rate_scheduler.py autoincreased_step_counter;
+    the ParallelExecutor honors the same var name (parallel_executor.cc:259)."""
+    helper = LayerHelper("global_step_counter")
+    gb = helper.main_program.global_block()
+    if gb.has_var(LR_COUNTER_NAME):
+        counter = gb.var(LR_COUNTER_NAME)
+    else:
+        counter = helper.create_global_variable(
+            name=LR_COUNTER_NAME, dtype="float32", shape=[1],
+            persistable=True, stop_gradient=True)
+        from ..initializer import Constant
+        helper.set_variable_initializer(counter, Constant(float(begin - 1)))
+    helper.main_program.global_block()._prepend_op(
+        type="increment", inputs={"X": [counter.name]},
+        outputs={"Out": [counter.name]}, attrs={"step": 1.0})
+    counter.stop_gradient = True
+    return counter
+
+
+def noam_decay(d_model, warmup_steps):
+    global_step = _decay_step_counter(1)
+    a = global_step ** -0.5
+    b = (warmup_steps ** -1.5) * global_step
+    return (d_model ** -0.5) * nn.elementwise_min(a, b)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    global_step = _decay_step_counter()
+    div_res = global_step / float(decay_steps)
+    if staircase:
+        div_res = ops.floor(div_res)
+    return learning_rate * (float(decay_rate) ** div_res)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    global_step = _decay_step_counter()
+    div_res = global_step / float(decay_steps)
+    if staircase:
+        div_res = ops.floor(div_res)
+    return learning_rate * ops.exp(-1 * decay_rate * div_res)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    global_step = _decay_step_counter()
+    div_res = global_step / float(decay_steps)
+    if staircase:
+        div_res = ops.floor(div_res)
+    return learning_rate / (1 + float(decay_rate) * div_res)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    global_step = _decay_step_counter()
+    if cycle:
+        div_res = ops.ceil(global_step / float(decay_steps))
+        zero_var = tensor.fill_constant([1], "float32", 0.0)
+        one_var = tensor.fill_constant([1], "float32", 1.0)
+        div_res = nn.elementwise_max(div_res, one_var)
+        decay_steps_var = div_res * float(decay_steps)
+    else:
+        decay_steps_var = tensor.fill_constant([1], "float32",
+                                               float(decay_steps))
+        global_step = nn.elementwise_min(
+            global_step, decay_steps_var)
+    return (learning_rate - end_learning_rate) * \
+        ((1 - global_step / decay_steps_var) ** power) + end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    """Piecewise-constant LR. Built from compare+where ops so the whole
+    schedule lives inside the compiled step (no host round trip)."""
+    assert len(values) - len(boundaries) == 1
+    global_step = _decay_step_counter()
+    lr = tensor.fill_constant([1], "float32", float(values[-1]))
+    # walk from the last interval down, select with where()
+    for i in reversed(range(len(boundaries))):
+        bound = tensor.fill_constant([1], "float32", float(boundaries[i]))
+        cond = nn.where  # noqa: F841 (doc anchor)
+        is_before = global_step < bound
+        val = tensor.fill_constant([1], "float32", float(values[i]))
+        lr = nn.where(is_before, val, lr)
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    global_step = _decay_step_counter()
+    cur_epoch = ops.floor(global_step / step_each_epoch)
+    return learning_rate * 0.5 * (
+        ops.cos(cur_epoch * math.pi / epochs) + 1)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    global_step = _decay_step_counter()
+    warmup_var = tensor.fill_constant([1], "float32", float(warmup_steps))
+    before = global_step < warmup_var
+    warm_lr = start_lr + (end_lr - start_lr) * global_step / float(
+        warmup_steps)
+    if isinstance(learning_rate, (float, int)):
+        learning_rate = tensor.fill_constant([1], "float32",
+                                             float(learning_rate))
+    return nn.where(before, warm_lr, learning_rate)

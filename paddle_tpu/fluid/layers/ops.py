@@ -1,0 +1,46 @@
+"""Auto-generated-style activation/unary layers.
+
+Reference analogue: python/paddle/fluid/layers/ops.py, which generates layer
+functions from registered OpProtos via layer_function_generator.py:329. Here
+we generate a wrapper per registered unary op type.
+"""
+
+from ..layer_helper import LayerHelper
+
+_UNARY_OPS = [
+    "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "softshrink",
+    "sqrt", "rsqrt", "abs", "ceil", "floor", "cos", "sin", "round",
+    "reciprocal", "log", "square", "softplus", "softsign", "hard_shrink",
+    "gelu", "erf", "logical_not",
+]
+
+__all__ = list(_UNARY_OPS) + ["hard_shrink", "cumsum", "thresholded_relu"]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(type=op_type, inputs={"X": x},
+                         outputs={"Out": out}, attrs=attrs)
+        return out
+    layer.__name__ = op_type
+    layer.__doc__ = "%s activation (see ops/math_ops.py lowering)" % op_type
+    return layer
+
+
+for _op in _UNARY_OPS:
+    globals()[_op] = _make_unary(_op)
+
+
+def thresholded_relu(x, threshold=1.0):
+    helper = LayerHelper("thresholded_relu")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="thresholded_relu", inputs={"X": x},
+                     outputs={"Out": out}, attrs={"threshold": threshold})
+    return out
+
+
+def cumsum(x, axis=None, exclusive=None, reverse=None):
+    from . import nn
+    return nn.cumsum(x, axis, exclusive, reverse)

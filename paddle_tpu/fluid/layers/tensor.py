@@ -1,0 +1,221 @@
+"""Tensor creation/assignment layers (reference python/paddle/fluid/layers/tensor.py)."""
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..framework import Variable, default_main_program
+from ..initializer import Constant
+from .. import core
+
+__all__ = [
+    "create_tensor", "create_parameter", "create_global_var", "cast",
+    "concat", "sums", "assign", "fill_constant",
+    "fill_constant_batch_size_like", "ones", "zeros", "zeros_like",
+    "reverse", "has_inf", "has_nan", "isfinite", "range", "linspace",
+    "argmin", "argmax", "argsort",
+]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable(name=helper.name, dtype=dtype,
+                                  persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    helper = LayerHelper("create_parameter", name=name)
+    from ..param_attr import ParamAttr
+    if attr is None:
+        attr = ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(
+        dtype=dtype, shape=shape, persistable=persistable,
+        name=name, stop_gradient=True)
+    helper.set_variable_initializer(var, Constant(value))
+    return var
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    if not isinstance(dtype, int):
+        dtype = core.convert_np_dtype_to_dtype_(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="cast", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"in_dtype": x.dtype, "out_dtype": dtype})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    from . import nn
+    return nn.concat(input, axis, name)
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="sum", inputs={"X": input}, outputs={"Out": out})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(type="assign", inputs={"X": input},
+                         outputs={"Out": output})
+    elif isinstance(input, np.ndarray):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        if input.dtype == np.float32:
+            values = [float(v) for v in input.flat]
+            helper.append_op(type="assign_value", outputs={"Out": output},
+                             attrs={"dtype": core.VarDesc.VarType.FP32,
+                                    "shape": list(input.shape),
+                                    "fp32_values": values})
+        elif input.dtype in (np.int32, np.int64):
+            values = [int(v) for v in input.flat]
+            dtype_enum = (core.VarDesc.VarType.INT64
+                          if input.dtype == np.int64
+                          else core.VarDesc.VarType.INT32)
+            key = ("int64_values" if input.dtype == np.int64
+                   else "int32_values")
+            helper.append_op(type="assign_value", outputs={"Out": output},
+                             attrs={"dtype": dtype_enum,
+                                    "shape": list(input.shape),
+                                    key: values})
+        else:
+            raise TypeError("assign only accepts float32/int32/int64 arrays")
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="fill_constant", outputs={"Out": out},
+                     attrs={"shape": list(shape), "dtype": out.dtype,
+                            "value": float(value)})
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="fill_constant_batch_size_like",
+                     inputs={"Input": input}, outputs={"Out": out},
+                     attrs={"shape": list(shape), "dtype": out.dtype,
+                            "value": float(value),
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    out.stop_gradient = True
+    return out
+
+
+def ones(shape, dtype, force_cpu=False):
+    return fill_constant(shape=shape, dtype=dtype, value=1.0)
+
+
+def zeros(shape, dtype, force_cpu=False):
+    return fill_constant(shape=shape, dtype=dtype, value=0.0)
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="fill_zeros_like", inputs={"X": x},
+                     outputs={"Out": out})
+    out.stop_gradient = True
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    if isinstance(axis, int):
+        axis = [axis]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="reverse", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"axis": axis})
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite")
+    out = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.BOOL, stop_gradient=True)
+    helper.append_op(type="isfinite", inputs={"X": x}, outputs={"Out": out})
+    return out
+
+
+def _any_check(op_type, x):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.BOOL, stop_gradient=True)
+    helper.append_op(type=op_type, inputs={"X": x}, outputs={"Out": out})
+    return out
+
+
+def has_inf(x):
+    return _any_check("isinf", x)
+
+
+def has_nan(x):
+    return _any_check("isnan", x)
+
+
+def range(start, end, step, dtype):
+    helper = LayerHelper("range")
+
+    def _scalar(v):
+        if isinstance(v, Variable):
+            return v
+        return fill_constant([1], dtype, v)
+    start, end, step = _scalar(start), _scalar(end), _scalar(step)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="range",
+                     inputs={"Start": start, "End": end, "Step": step},
+                     outputs={"Out": out})
+    return out
+
+
+def linspace(start, stop, num, dtype):
+    helper = LayerHelper("linspace")
+
+    def _scalar(v, d):
+        if isinstance(v, Variable):
+            return v
+        return fill_constant([1], d, v)
+    start = _scalar(start, dtype)
+    stop = _scalar(stop, dtype)
+    num = _scalar(num, "int32")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="linspace",
+                     inputs={"Start": start, "Stop": stop, "Num": num},
+                     outputs={"Out": out})
+    return out
+
+
+def argmin(x, axis=0):
+    from . import nn
+    return nn.argmin(x, axis)
+
+
+def argmax(x, axis=0):
+    from . import nn
+    return nn.argmax(x, axis)
+
+
+def argsort(x, axis=-1, name=None):
+    from . import nn
+    return nn.argsort(x, axis, name)

@@ -1,0 +1,109 @@
+"""LoD (Level-of-Detail) ragged-sequence tensors.
+
+Reference analogue: paddle/fluid/framework/lod_tensor.h:58 (LoD =
+vector<Vector<size_t>>) and :110 (class LoDTensor) — the reference's signature
+capability: variable-length sequences carried without padding, consumed by
+the sequence_ops/ family.
+
+TPU-native encoding (SURVEY.md §5 long-context note): XLA requires static
+shapes, so a LoDTensor here is a *dense* array plus host-side LoD metadata.
+Sequence ops lower to segment-id reductions / masked ops over the dense
+rows (see ops/sequence_ops.py): rows of all sequences are concatenated along
+axis 0 exactly like the reference's packed layout, and `sequence lengths`
+become a segment-id vector fed alongside the data. This keeps the packed
+(no-padding) memory layout while every op remains a fixed-shape XLA program.
+"""
+
+import numpy as np
+
+__all__ = ["LoDTensor", "create_lod_tensor", "lod_to_segment_ids",
+           "recursive_seq_lens_to_lod"]
+
+
+def recursive_seq_lens_to_lod(recursive_seq_lens):
+    """[[2,3],[1,2,1,2,2]] -> offsets [[0,2,5],[0,1,3,4,6,8]]"""
+    lod = []
+    for lens in recursive_seq_lens:
+        offsets = [0]
+        for l in lens:
+            offsets.append(offsets[-1] + l)
+        lod.append(offsets)
+    return lod
+
+
+def lod_to_recursive_seq_lens(lod):
+    return [[offsets[i + 1] - offsets[i] for i in range(len(offsets) - 1)]
+            for offsets in lod]
+
+
+def lod_to_segment_ids(lod_level_offsets, total_rows):
+    """offsets [0,2,5] -> segment ids [0,0,1,1,1] (int32 np array)."""
+    seg = np.zeros(total_rows, dtype=np.int32)
+    for i in range(len(lod_level_offsets) - 1):
+        seg[lod_level_offsets[i]:lod_level_offsets[i + 1]] = i
+    return seg
+
+
+class LoDTensor:
+    """Dense ndarray + LoD offsets. Quacks like the pybind LoDTensor
+    (set/lod/recursive_sequence_lengths/shape/numpy)."""
+
+    def __init__(self, data=None, lod=None):
+        self._data = np.asarray(data) if data is not None else None
+        self._lod = lod or []
+
+    # -- fluid pybind API --
+    def set(self, data, place=None):
+        self._data = np.asarray(data)
+
+    def set_lod(self, lod):
+        self._lod = [list(l) for l in lod]
+
+    def lod(self):
+        return self._lod
+
+    def set_recursive_sequence_lengths(self, seq_lens):
+        self._lod = recursive_seq_lens_to_lod(seq_lens)
+
+    def recursive_sequence_lengths(self):
+        return lod_to_recursive_seq_lens(self._lod)
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._lod:
+            return True
+        if self._lod[-1][-1] != (self._data.shape[0] if self._data is not None
+                                 else 0):
+            return False
+        return True
+
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def __array__(self, dtype=None):
+        return np.asarray(self._data, dtype=dtype)
+
+    def segment_ids(self, level=-1):
+        """dense segment-id encoding of the chosen LoD level."""
+        offsets = self._lod[level]
+        return lod_to_segment_ids(offsets, self._data.shape[0])
+
+    def __repr__(self):
+        return "LoDTensor(shape=%s, lod=%s)" % (
+            None if self._data is None else self._data.shape, self._lod)
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """reference python/paddle/fluid/lod_tensor.py create_lod_tensor."""
+    if isinstance(data, list):
+        # list of per-sequence row arrays -> concatenate
+        flat = np.concatenate([np.asarray(x).reshape(len(x), -1)
+                               for x in data], axis=0)
+        t = LoDTensor(flat)
+    else:
+        t = LoDTensor(np.asarray(data))
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    assert t.has_valid_recursive_sequence_lengths()
+    return t

@@ -1,0 +1,175 @@
+"""Streaming Python-side metric accumulators (reference python/paddle/fluid/metrics.py)."""
+
+import numpy as np
+
+__all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy",
+           "ChunkEvaluator", "EditDistance", "Auc"]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = str(name) if name is not None else self.__class__.__name__
+
+    def reset(self):
+        for attr in self.__dict__:
+            if not attr.startswith("_"):
+                v = self.__dict__[attr]
+                if isinstance(v, int):
+                    setattr(self, attr, 0)
+                elif isinstance(v, float):
+                    setattr(self, attr, 0.0)
+
+    def update(self, preds, labels):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Accuracy(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += value * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("Accuracy: no updates")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype("int32").flatten()
+        labels = np.asarray(labels).astype("int32").flatten()
+        for p, l in zip(preds, labels):
+            if p == 1:
+                if p == l:
+                    self.tp += 1
+                else:
+                    self.fp += 1
+
+    def eval(self):
+        ap = self.tp + self.fp
+        return float(self.tp) / ap if ap != 0 else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype("int32").flatten()
+        labels = np.asarray(labels).astype("int32").flatten()
+        for p, l in zip(preds, labels):
+            if l == 1:
+                if p == l:
+                    self.tp += 1
+                else:
+                    self.fn += 1
+
+    def eval(self):
+        recall = self.tp + self.fn
+        return float(self.tp) / recall if recall != 0 else 0.0
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        seq_num = int(np.asarray(seq_num))
+        self.seq_num += seq_num
+        self.instance_error += int(np.sum(np.asarray(distances) > 0))
+        self.total_distance += float(np.sum(np.asarray(distances)))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("EditDistance: no updates")
+        return self.total_distance / self.seq_num, \
+            float(self.instance_error) / self.seq_num
+
+
+class Auc(MetricBase):
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        self._stat_pos = np.zeros(num_thresholds + 1)
+        self._stat_neg = np.zeros(num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).flatten()
+        for i, lbl in enumerate(labels):
+            value = preds[i, 1] if preds.ndim == 2 else preds[i]
+            bin_idx = int(value * self._num_thresholds)
+            bin_idx = min(max(bin_idx, 0), self._num_thresholds)
+            if lbl:
+                self._stat_pos[bin_idx] += 1.0
+            else:
+                self._stat_neg[bin_idx] += 1.0
+
+    def eval(self):
+        tot_pos = 0.0
+        tot_neg = 0.0
+        auc = 0.0
+        for idx in range(self._num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[idx]
+            new_neg = tot_neg + self._stat_neg[idx]
+            auc += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        return auc / (tot_pos * tot_neg) if tot_pos > 0 and tot_neg > 0 \
+            else 0.0
+
+
+class ChunkEvaluator(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks))
+        self.num_label_chunks += int(np.asarray(num_label_chunks))
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks))
+
+    def eval(self):
+        precision = float(self.num_correct_chunks) / self.num_infer_chunks \
+            if self.num_infer_chunks else 0.0
+        recall = float(self.num_correct_chunks) / self.num_label_chunks \
+            if self.num_label_chunks else 0.0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if self.num_correct_chunks else 0.0
+        return precision, recall, f1

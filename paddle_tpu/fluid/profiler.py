@@ -1,0 +1,72 @@
+"""Profiler (reference python/paddle/fluid/profiler.py:39-:221 over
+platform/profiler.cc + CUPTI device_tracer).
+
+TPU redesign: jax.profiler owns both host and device timelines (XPlane →
+Perfetto/TensorBoard), replacing the RecordEvent tables + CUPTI tracer +
+tools/timeline.py chrome-trace pipeline. The RAII named-region design is kept
+via profiler.scope()/RecordEvent."""
+
+import contextlib
+import os
+import time
+
+__all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
+           "stop_profiler", "RecordEvent"]
+
+_trace_dir = None
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    # accepted for API parity; routes to the jax trace
+    with profiler("All", "total", output_file):
+        yield
+
+
+def reset_profiler():
+    pass
+
+
+def start_profiler(state="All", tracer_option=None, output_dir=None):
+    global _trace_dir
+    import jax
+    _trace_dir = output_dir or os.environ.get(
+        "PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+    jax.profiler.start_trace(_trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    import jax
+    jax.profiler.stop_trace()
+    return _trace_dir
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option=None):
+    """with fluid.profiler.profiler(...): — wraps jax.profiler.trace."""
+    start_profiler(state, tracer_option,
+                   profile_path if os.path.isdir(str(profile_path))
+                   else None)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class RecordEvent:
+    """Named host-side region (reference platform/profiler.h:72 RAII marker);
+    shows up in the jax trace via TraceAnnotation."""
+
+    def __init__(self, name):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        import jax
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *args):
+        self._ctx.__exit__(*args)

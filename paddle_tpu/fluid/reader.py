@@ -1,0 +1,90 @@
+"""Host-side data pipeline: the TPU-native py_reader.
+
+Reference analogue: operators/reader/ — create_py_reader feeding a
+LoDTensorBlockingQueue (lod_tensor_blocking_queue.h:31) decorated with a
+double_buffer reader that prefetches to the device
+(create_double_buffer_reader_op.cc, buffered_reader.cc).
+
+TPU redesign: a background thread pulls numpy batches from the user's reader
+into a bounded queue (the blocking-queue analogue) and eagerly device_puts
+the next batch while the current step runs (the double-buffer analogue).
+The Executor drains it via next_feed().
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["PyReader"]
+
+
+class PyReader:
+    def __init__(self, capacity, feed_vars, use_double_buffer=True):
+        self.capacity = capacity
+        self.feed_vars = feed_vars
+        self.use_double_buffer = use_double_buffer
+        self._paddle_reader = None
+        self._queue = None
+        self._thread = None
+        self._stop = threading.Event()
+        self.output_vars = feed_vars
+
+    def decorate_paddle_reader(self, reader, places=None):
+        """reader: callable returning a generator of sample tuples."""
+        self._paddle_reader = reader
+
+    decorate_sample_list_generator = decorate_paddle_reader
+    decorate_tensor_provider = decorate_paddle_reader
+    decorate_batch_generator = decorate_paddle_reader
+
+    def start(self):
+        self._queue = queue.Queue(maxsize=self.capacity)
+        self._stop.clear()
+
+        def worker():
+            try:
+                for item in self._paddle_reader():
+                    if self._stop.is_set():
+                        return
+                    arrays = self._to_feed(item)
+                    self._queue.put(arrays)
+            finally:
+                self._queue.put(None)  # EOF sentinel
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def _to_feed(self, item):
+        feed = {}
+        if isinstance(item, dict):
+            return {k: np.asarray(v) for k, v in item.items()}
+        for var, value in zip(self.feed_vars, item):
+            feed[var.name] = np.asarray(value)
+        return feed
+
+    def next_feed(self):
+        """Next feed dict or None at EOF (raises like fluid's EOFException
+        protocol via StopIteration for for-loop use)."""
+        item = self._queue.get()
+        if item is None:
+            raise StopIteration
+        return item
+
+    def __iter__(self):
+        while True:
+            try:
+                yield self.next_feed()
+            except StopIteration:
+                return
+
+    def reset(self):
+        self._stop.set()
+        if self._queue is not None:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

@@ -1,0 +1,15 @@
+"""TPU op registry: every op type is a pure JAX lowering (see registry.py).
+
+Importing this package registers the full op set — the analogue of the static
+registrar objects REGISTER_OPERATOR produces in the reference
+(op_registry.h:185)."""
+
+from . import registry
+from . import math_ops       # noqa: F401
+from . import nn_ops         # noqa: F401
+from . import tensor_ops     # noqa: F401
+from . import optimizer_ops  # noqa: F401
+
+from .registry import (  # noqa: F401
+    register_op, get_op_def, has_op, registered_ops, infer_shape, ExecContext,
+)

@@ -1,0 +1,396 @@
+"""Math / elementwise / reduction / activation op lowerings.
+
+Reference analogues: paddle/fluid/operators/activation_op.cc (~30 functors),
+elementwise/*.cc, reduce_ops/*.cc, mul_op.cc, matmul_op.cc, sum_op.cc,
+scale_op.cc, softmax_op.cc, cast_op.cc, clip_op.cc, cumsum_op.cc, topk_op.cc.
+
+Each op is one pure jnp/lax function; XLA fuses chains of these into single
+kernels on TPU, which replaces the reference's hand-fused kernels
+(fused_elemwise_activation etc.) and the xbyak JIT codegen in operators/math.
+Gradients come from the registry's generic jax.vjp maker.
+"""
+
+import functools
+
+import numpy as np
+
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# activations (activation_op.cc)
+# ---------------------------------------------------------------------------
+
+def _register_activation(name, fn):
+    def lower(ctx, _fn=fn):
+        return {"Out": _fn(ctx, ctx.input("X"))}
+    register_op(name, lower)
+
+
+def _act(fn):
+    return lambda ctx, x: fn(x)
+
+
+def _make_activations():
+    import jax
+    import jax.numpy as jnp
+    from jax import nn as jnn
+    acts = {
+        "sigmoid": _act(jax.nn.sigmoid),
+        "logsigmoid": _act(jax.nn.log_sigmoid),
+        "exp": _act(jnp.exp),
+        "relu": _act(jax.nn.relu),
+        "tanh": _act(jnp.tanh),
+        "tanh_shrink": _act(lambda x: x - jnp.tanh(x)),
+        "sqrt": _act(jnp.sqrt),
+        "rsqrt": _act(lambda x: 1.0 / jnp.sqrt(x)),
+        "abs": _act(jnp.abs),
+        "ceil": _act(jnp.ceil),
+        "floor": _act(jnp.floor),
+        "cos": _act(jnp.cos),
+        "sin": _act(jnp.sin),
+        "round": _act(jnp.round),
+        "reciprocal": _act(lambda x: 1.0 / x),
+        "log": _act(jnp.log),
+        "square": _act(jnp.square),
+        "softplus": _act(jnn.softplus),
+        "softsign": _act(jnn.soft_sign),
+        "softshrink": lambda ctx, x: _softshrink(x, ctx.attr("lambda", 0.5)),
+        "hard_shrink": lambda ctx, x: jnp.where(
+            jnp.abs(x) > ctx.attr("threshold", 0.5), x, 0.0).astype(x.dtype),
+        "brelu": lambda ctx, x: jnp.clip(x, ctx.attr("t_min", 0.0),
+                                         ctx.attr("t_max", 24.0)),
+        "leaky_relu": lambda ctx, x: jnn.leaky_relu(
+            x, ctx.attr("alpha", 0.02)),
+        "soft_relu": lambda ctx, x: jnp.log1p(
+            jnp.exp(jnp.clip(x, -ctx.attr("threshold", 40.0),
+                             ctx.attr("threshold", 40.0)))),
+        "elu": lambda ctx, x: jnn.elu(x, ctx.attr("alpha", 1.0)),
+        "relu6": lambda ctx, x: jnp.clip(x, 0.0, ctx.attr("threshold", 6.0)),
+        "pow": lambda ctx, x: jnp.power(x, ctx.attr("factor", 1.0)).astype(
+            x.dtype),
+        "stanh": lambda ctx, x: ctx.attr("scale_b", 1.7159) * jnp.tanh(
+            ctx.attr("scale_a", 2.0 / 3.0) * x),
+        "hard_sigmoid": lambda ctx, x: jnp.clip(
+            ctx.attr("slope", 0.2) * x + ctx.attr("offset", 0.5), 0.0, 1.0),
+        "swish": lambda ctx, x: x * jax.nn.sigmoid(ctx.attr("beta", 1.0) * x),
+        "thresholded_relu": lambda ctx, x: jnp.where(
+            x > ctx.attr("threshold", 1.0), x, 0.0).astype(x.dtype),
+        "gelu": _act(jax.nn.gelu),
+        "erf": _act(jax.scipy.special.erf),
+        "sign": _act(jnp.sign),
+        "logical_not": _act(jnp.logical_not),
+    }
+    for name, fn in acts.items():
+        _register_activation(name, fn)
+
+
+def _softshrink(x, lam):
+    jnp = _jnp()
+    return jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0)
+                     ).astype(x.dtype)
+
+
+_make_activations()
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary ops with fluid `axis` broadcasting (elementwise/*.cc)
+# ---------------------------------------------------------------------------
+
+def _broadcast_y(x, y, axis):
+    """Fluid semantics: Y's shape matches a contiguous sub-sequence of X's
+    shape starting at `axis` (axis == -1 aligns trailing dims)."""
+    jnp = _jnp()
+    if x.ndim == y.ndim:
+        return y
+    if axis is None:
+        axis = -1
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    # strip trailing size-1 dims the reference tolerates (e.g. [N,1] bias)
+    yshape = list(y.shape)
+    while len(yshape) > 1 and yshape[-1] == 1 and \
+            axis + len(yshape) > x.ndim:
+        yshape = yshape[:-1]
+    new_shape = [1] * axis + list(yshape) + \
+        [1] * (x.ndim - axis - len(yshape))
+    return jnp.reshape(y, new_shape)
+
+
+def _register_elementwise(name, fn):
+    def lower(ctx, _fn=fn):
+        x, y = ctx.input("X"), ctx.input("Y")
+        y = _broadcast_y(x, y, ctx.attr("axis", -1))
+        return {"Out": _fn(x, y)}
+    register_op(name, lower)
+
+
+def _make_elementwise():
+    import jax.numpy as jnp
+    for name, fn in {
+        "elementwise_add": jnp.add,
+        "elementwise_sub": jnp.subtract,
+        "elementwise_mul": jnp.multiply,
+        "elementwise_div": jnp.divide,
+        "elementwise_min": jnp.minimum,
+        "elementwise_max": jnp.maximum,
+        "elementwise_pow": jnp.power,
+        "elementwise_mod": jnp.mod,
+        "elementwise_floordiv": jnp.floor_divide,
+    }.items():
+        _register_elementwise(name, fn)
+
+
+_make_elementwise()
+
+
+def _register_compare():
+    import jax.numpy as jnp
+    for name, fn in {
+        "less_than": jnp.less, "less_equal": jnp.less_equal,
+        "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+        "equal": jnp.equal, "not_equal": jnp.not_equal,
+        "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+        "logical_xor": jnp.logical_xor,
+    }.items():
+        def lower(ctx, _fn=fn):
+            x, y = ctx.input("X"), ctx.input("Y")
+            if y is not None and x.ndim != y.ndim:
+                y = _broadcast_y(x, y, ctx.attr("axis", -1))
+            return {"Out": _fn(x, y) if y is not None else _fn(x)}
+        register_op(name, lower)
+
+
+_register_compare()
+
+
+# ---------------------------------------------------------------------------
+# matmul family (mul_op.cc, matmul_op.cc) — these hit the MXU; keep them as
+# single dot_generals so XLA tiles them onto the systolic array.
+# ---------------------------------------------------------------------------
+
+def _flatten2d(x, num_col_dims):
+    jnp = _jnp()
+    lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims > 0 else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+@register_op("mul")
+def _mul(ctx):
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("Y")
+    xd = ctx.attr("x_num_col_dims", 1)
+    yd = ctx.attr("y_num_col_dims", 1)
+    x2 = _flatten2d(x, xd)
+    y2 = _flatten2d(y, yd)
+    out = jnp.matmul(x2, y2)
+    out_shape = x.shape[:xd] + y.shape[yd:]
+    return {"Out": jnp.reshape(out, out_shape)}
+
+
+@register_op("matmul")
+def _matmul(ctx):
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("Y")
+    if ctx.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ctx.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = ctx.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op("sum")
+def _sum(ctx):
+    xs = ctx.inputs("X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("scale")
+def _scale(ctx):
+    x = ctx.input("X")
+    s, b = ctx.attr("scale", 1.0), ctx.attr("bias", 0.0)
+    if ctx.attr("bias_after_scale", True):
+        return {"Out": x * s + b}
+    return {"Out": (x + b) * s}
+
+
+@register_op("clip")
+def _clip(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.clip(ctx.input("X"), ctx.attr("min"), ctx.attr("max"))}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    max_norm = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": x * scale}
+
+
+# ---------------------------------------------------------------------------
+# reductions (reduce_ops/*, mean_op.cc, argmax, topk, cumsum)
+# ---------------------------------------------------------------------------
+
+def _register_reduce(name, fn):
+    def lower(ctx, _fn=fn):
+        x = ctx.input("X")
+        if ctx.attr("reduce_all", False):
+            dim = None
+        else:
+            dim = ctx.attr("dim", [0])
+            if isinstance(dim, int):
+                dim = [dim]
+            dim = tuple(d % x.ndim for d in dim)
+        out = _fn(x, axis=dim, keepdims=ctx.attr("keep_dim", False))
+        return {"Out": out}
+    register_op(name, lower)
+
+
+def _make_reduces():
+    import jax.numpy as jnp
+    for name, fn in {
+        "reduce_sum": jnp.sum, "reduce_mean": jnp.mean,
+        "reduce_max": jnp.max, "reduce_min": jnp.min,
+        "reduce_prod": jnp.prod,
+        "reduce_all": jnp.all, "reduce_any": jnp.any,
+    }.items():
+        _register_reduce(name, fn)
+
+
+_make_reduces()
+
+
+@register_op("mean")
+def _mean(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.mean(ctx.input("X"))}
+
+
+@register_op("arg_max")
+def _arg_max(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.argmax(ctx.input("X"),
+                              axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+
+
+@register_op("arg_min")
+def _arg_min(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.argmin(ctx.input("X"),
+                              axis=ctx.attr("axis", -1)).astype(jnp.int64)}
+
+
+@register_op("argsort")
+def _argsort(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": jnp.sort(x, axis=axis), "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k")
+def _top_k(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    k = ctx.attr("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("cumsum")
+def _cumsum(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    reverse = ctx.attr("reverse", False)
+    y = jnp.flip(x, axis) if reverse else x
+    out = jnp.cumsum(y, axis=axis, dtype=x.dtype)
+    if ctx.attr("exclusive", False):
+        out = out - y  # exclusive prefix = inclusive - self
+    if reverse:
+        out = jnp.flip(out, axis)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# softmax / normalization-ish math (softmax_op.cc w/ cudnn variant — on TPU a
+# single jax.nn.softmax lowers to a fused stable exp-normalise)
+# ---------------------------------------------------------------------------
+
+@register_op("softmax")
+def _softmax(ctx):
+    import jax
+    return {"Out": jax.nn.softmax(ctx.input("X"), axis=-1)}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx):
+    import jax
+    return {"Out": jax.nn.log_softmax(ctx.input("X"), axis=-1)}
+
+
+@register_op("cast")
+def _cast(ctx):
+    from ..fluid import core as fcore
+    out_dtype = fcore.convert_dtype_to_np(ctx.attr("out_dtype"))
+    return {"Out": ctx.input("X").astype(out_dtype)}
+
+
+@register_op("isfinite")
+def _isfinite(ctx):
+    jnp = _jnp()
+    # reference isfinite_op reduces to a single bool: "is every element finite"
+    return {"Out": jnp.all(jnp.isfinite(ctx.input("X"))).reshape((1,))}
+
+
+@register_op("isinf")
+def _isinf(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.any(jnp.isinf(ctx.input("X"))).reshape((1,))}
+
+
+@register_op("isnan")
+def _isnan(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.any(jnp.isnan(ctx.input("X"))).reshape((1,))}
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    y = x / jnp.maximum(norm, eps)
+    return {"Out": y, "Norm": norm}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.sum(jnp.square(ctx.input("X"))).reshape((1,))}
+
+
+@register_op("increment")
+def _increment(ctx):
+    x = ctx.input("X")
+    return {"Out": x + np.asarray(ctx.attr("step", 1.0), dtype=x.dtype)}

@@ -1,0 +1,481 @@
+"""Neural-net op lowerings: conv/pool/norm/dropout/embedding/losses/metrics.
+
+Reference analogues: conv_op.cc + conv_cudnn_op.cu, pool_op.cc, batch_norm_op,
+layer_norm_op, dropout_op, lookup_table_op, cross_entropy_op,
+softmax_with_cross_entropy_op, sigmoid_cross_entropy_with_logits_op,
+accuracy_op (metrics/), one_hot_op, lrn_op, grid ops.
+
+TPU notes: convs lower to lax.conv_general_dilated which XLA tiles onto the
+MXU; the cuDNN-vs-plain kernel split in the reference collapses into one
+lowering. Data layout is kept NCHW at the IR level for fluid API parity —
+XLA's layout assignment transposes to the TPU-preferred layout internally.
+"""
+
+import numpy as np
+
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# ---------------------------------------------------------------------------
+# convolution (conv_op.cc; cudnn variant conv_cudnn_op.cu)
+# ---------------------------------------------------------------------------
+
+@register_op("conv2d")
+def _conv2d(ctx):
+    import jax
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp_acc_type(x))
+    out = out.astype(x.dtype)
+    if ctx.has_input("Bias"):
+        out = out + ctx.input("Bias").reshape((1, -1, 1, 1))
+    return {"Output": out}
+
+
+def jnp_acc_type(x):
+    jnp = _jnp()
+    # bf16 matmul/conv accumulate in fp32 on the MXU
+    if x.dtype == jnp.bfloat16:
+        return jnp.float32
+    return None
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx):
+    return _conv2d(ctx)
+
+
+@register_op("conv3d")
+def _conv3d(ctx):
+    import jax
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1, 1]), 3)
+    pads = _pair(ctx.attr("paddings", [0, 0, 0]), 3)
+    dilations = _pair(ctx.attr("dilations", [1, 1, 1]), 3)
+    groups = ctx.attr("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dilations, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": out.astype(x.dtype)}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx):
+    import jax
+    x, w = ctx.input("Input"), ctx.input("Filter")  # w: [in_c, out_c/g, kh, kw]
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True)
+    if groups != 1:
+        raise NotImplementedError("grouped conv2d_transpose")
+    return {"Output": out.astype(x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# pooling (pool_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("pool2d")
+def _pool2d(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = _pair(ctx.attr("ksize", [2, 2]))
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    if ctx.attr("global_pooling", False):
+        ksize = (x.shape[2], x.shape[3])
+        strides = ksize
+        pads = (0, 0)
+    if ctx.attr("adaptive", False) and tuple(ksize) == (1, 1):
+        # adaptive 1x1 == global pooling
+        ksize = (x.shape[2], x.shape[3])
+        strides, pads = ksize, (0, 0)
+    window = (1, 1) + ksize
+    stride = (1, 1) + strides
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, np.asarray(init, x.dtype), jax.lax.max,
+                                    window, stride, padding)
+    else:
+        summed = jax.lax.reduce_window(
+            x, np.asarray(0, x.dtype), jax.lax.add, window, stride, padding)
+        if ctx.attr("exclusive", True) and (pads[0] or pads[1]):
+            ones = jnp.ones(x.shape, x.dtype)
+            counts = jax.lax.reduce_window(
+                ones, np.asarray(0, x.dtype), jax.lax.add, window, stride,
+                padding)
+            out = summed / counts
+        else:
+            out = summed / np.asarray(ksize[0] * ksize[1], x.dtype)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# normalization (batch_norm_op.cc, layer_norm_op.cc, group_norm_op.cc, lrn)
+# ---------------------------------------------------------------------------
+
+@register_op("batch_norm", stateful=True)
+def _batch_norm(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    mean, var = ctx.input("Mean"), ctx.input("Variance")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    is_test = ctx.attr("is_test", False)
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    if is_test or ctx.attr("use_global_stats", False):
+        use_mean, use_var = mean, var
+        saved_mean = mean
+        saved_inv_std = 1.0 / jnp.sqrt(var + eps)
+        mean_out, var_out = mean, var
+    else:
+        xf = x.astype(jnp.float32)
+        use_mean = jnp.mean(xf, axis=axes)
+        use_var = jnp.var(xf, axis=axes)
+        mean_out = mean * momentum + use_mean * (1.0 - momentum)
+        var_out = var * momentum + use_var * (1.0 - momentum)
+        saved_mean = use_mean
+        saved_inv_std = 1.0 / jnp.sqrt(use_var + eps)
+    xhat = (x - use_mean.reshape(bshape).astype(x.dtype)) * \
+        saved_inv_std.reshape(bshape).astype(x.dtype)
+    y = xhat * scale.reshape(bshape).astype(x.dtype) + \
+        bias.reshape(bshape).astype(x.dtype)
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": saved_mean, "SavedVariance": saved_inv_std}
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    begin = ctx.attr("begin_norm_axis", 1)
+    eps = ctx.attr("epsilon", 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    inv = 1.0 / jnp.sqrt(var + eps)
+    y = (x - mean) * inv
+    shape = x.shape[begin:]
+    if ctx.has_input("Scale"):
+        y = y * ctx.input("Scale").reshape(shape)
+    if ctx.has_input("Bias"):
+        y = y + ctx.input("Bias").reshape(shape)
+    red = tuple(range(begin))
+    return {"Y": y, "Mean": jnp.reshape(mean, [int(np.prod(x.shape[:begin]))]),
+            "Variance": jnp.reshape(var, [int(np.prod(x.shape[:begin]))])}
+
+
+@register_op("group_norm")
+def _group_norm(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")  # NCHW
+    groups = ctx.attr("groups", 32)
+    eps = ctx.attr("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    if ctx.has_input("Scale"):
+        y = y * ctx.input("Scale").reshape(bshape)
+    if ctx.has_input("Bias"):
+        y = y + ctx.input("Bias").reshape(bshape)
+    return {"Y": y, "Mean": mean.reshape((n, groups)),
+            "Variance": var.reshape((n, groups))}
+
+
+@register_op("lrn")
+def _lrn(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")  # NCHW
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    acc = jax.lax.reduce_window(
+        sq, np.asarray(0, x.dtype), jax.lax.add,
+        (1, n, 1, 1), (1, 1, 1, 1), ((0, 0), (half, half), (0, 0), (0, 0)))
+    mid = (k + alpha * acc) ** beta
+    return {"Out": x / mid, "MidOut": mid}
+
+
+# ---------------------------------------------------------------------------
+# dropout (dropout_op.cc) — per-step PRNG threaded by the executor
+# ---------------------------------------------------------------------------
+
+@register_op("dropout")
+def _dropout(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    p = ctx.attr("dropout_prob", 0.5)
+    if ctx.attr("is_test", False) or p == 0.0:
+        imp = ctx.attr("dropout_implementation", "downgrade_in_infer")
+        if imp == "downgrade_in_infer" and ctx.attr("is_test", False):
+            return {"Out": x * np.asarray(1.0 - p, x.dtype),
+                    "Mask": jnp.ones_like(x)}
+        return {"Out": x, "Mask": jnp.ones_like(x)}
+    keep = jax.random.bernoulli(ctx.rng_key(), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    imp = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if imp == "upscale_in_train":
+        out = x * mask / np.asarray(1.0 - p, x.dtype)
+    else:
+        out = x * mask
+    return {"Out": out, "Mask": mask}
+
+
+# ---------------------------------------------------------------------------
+# embedding (lookup_table_op.cc). Sparse-grad (SelectedRows) path is realised
+# as a dense scatter-add under vjp — XLA turns it into an efficient TPU
+# scatter; the sharded-table path lives in parallel/.
+# ---------------------------------------------------------------------------
+
+@register_op("lookup_table")
+def _lookup_table(ctx):
+    jnp = _jnp()
+    w, ids = ctx.input("W"), ctx.input("Ids")
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    flat_ids = ids.reshape(ids.shape[:-1]) if squeeze_last else ids
+    padding_idx = ctx.attr("padding_idx", -1)
+    out = jnp.take(w, flat_ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (flat_ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return {"Out": out}
+
+
+@register_op("one_hot")
+def _one_hot(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    depth = ctx.attr("depth")
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x.reshape(x.shape[:-1])
+    return {"Out": jax.nn.one_hot(x.astype(jnp.int32), depth,
+                                  dtype=jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# losses (cross_entropy_op.cc, softmax_with_cross_entropy_op.cc, ...)
+# ---------------------------------------------------------------------------
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx):
+    jnp = _jnp()
+    x, label = ctx.input("X"), ctx.input("Label")
+    eps = 1e-8
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, eps)), axis=-1,
+                        keepdims=True)
+    else:
+        if label.ndim == x.ndim:
+            label = label.reshape(label.shape[:-1])
+        picked = jnp.take_along_axis(
+            x, label[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(jnp.maximum(picked, eps))
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_ce(ctx):
+    import jax
+    jnp = _jnp()
+    logits, label = ctx.input("Logits"), ctx.input("Label")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        if label.ndim == logits.ndim:
+            lab = label.reshape(label.shape[:-1])
+        else:
+            lab = label
+        picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32),
+                                     axis=-1)
+        ignore = ctx.attr("ignore_index", -100)
+        loss = -picked
+        if ignore >= 0:
+            loss = jnp.where(lab[..., None] == ignore, 0.0, loss)
+    return {"Softmax": jnp.exp(logp), "Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx):
+    import jax
+    jnp = _jnp()
+    x, label = ctx.input("X"), ctx.input("Label")
+    # stable: max(x,0) - x*z + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = ctx.attr("ignore_index", -100)
+    if ignore >= 0:
+        loss = jnp.where(label == ignore, 0.0, loss)
+    return {"Out": loss}
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.square(ctx.input("X") - ctx.input("Y"))}
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx):
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("Y")
+    delta = ctx.attr("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r,
+                     delta * (ar - 0.5 * delta))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx):
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("Y")
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    if ctx.has_input("InsideWeight"):
+        diff = diff * ctx.input("InsideWeight")
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    if ctx.has_input("OutsideWeight"):
+        loss = loss * ctx.input("OutsideWeight")
+    out = jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": out, "Diff": diff}
+
+
+@register_op("log_loss")
+def _log_loss(ctx):
+    jnp = _jnp()
+    p, label = ctx.input("Predicted"), ctx.input("Labels")
+    eps = ctx.attr("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": loss}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx):
+    jnp = _jnp()
+    logits, labels = ctx.input("Logits"), ctx.input("Labels")
+    return {"Loss": jnp.maximum(0.0, 1.0 - (2 * labels - 1) * logits)}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx):
+    jnp = _jnp()
+    x1, x2, label = ctx.input("X1"), ctx.input("X2"), ctx.input("Label")
+    margin = ctx.attr("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# metrics (metrics/accuracy_op.cc, auc_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("accuracy")
+def _accuracy(ctx):
+    jnp = _jnp()
+    pred_idx = ctx.input("Indices")
+    label = ctx.input("Label")
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label.reshape(-1)
+    correct = jnp.any(pred_idx == label[:, None], axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = np.float32(pred_idx.shape[0])
+    return {"Accuracy": (num_correct / total).reshape((1,)),
+            "Correct": num_correct.astype(jnp.int32).reshape((1,)),
+            "Total": jnp.asarray([total], jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# image ops used by detection/vision models
+# ---------------------------------------------------------------------------
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")  # NCHW
+    out_h = ctx.attr("out_h")
+    out_w = ctx.attr("out_w")
+    return {"Out": jax.image.resize(
+        x, (x.shape[0], x.shape[1], out_h, out_w), method="bilinear"
+    ).astype(x.dtype)}
+
+
+@register_op("nearest_interp")
+def _nearest_interp(ctx):
+    import jax
+    x = ctx.input("X")
+    out_h, out_w = ctx.attr("out_h"), ctx.attr("out_w")
+    return {"Out": jax.image.resize(
+        x, (x.shape[0], x.shape[1], out_h, out_w), method="nearest"
+    ).astype(x.dtype)}
+
+
+@register_op("pad2d")
+def _pad2d(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    p = ctx.attr("paddings", [0, 0, 0, 0])
+    mode = ctx.attr("mode", "constant")
+    value = ctx.attr("pad_value", 0.0)
+    pads = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]))
+    if mode == "constant":
+        return {"Out": jnp.pad(x, pads, constant_values=value)}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(x, pads, mode=jmode)}
+
+
+@register_op("pad")
+def _pad(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    p = ctx.attr("paddings")
+    pads = tuple((p[2 * i], p[2 * i + 1]) for i in range(x.ndim))
+    return {"Out": jnp.pad(x, pads,
+                           constant_values=ctx.attr("pad_value", 0.0))}

@@ -1,0 +1,198 @@
+"""Optimizer update op lowerings.
+
+Reference analogues: paddle/fluid/operators/optimizers/{sgd,momentum,adam,
+adagrad,adamax,adadelta,rmsprop,ftrl,decayed_adagrad,proximal_*,lars_momentum}
+_op.cc (+ .cu kernels). Each reference op has CPU+CUDA kernels and in-place
+Param/Moment outputs; here each is one pure update function — the executor's
+functional state-threading makes "in-place" an XLA buffer-donation concern,
+not an op concern.
+
+Sparse (SelectedRows) gradient paths in the reference collapse into the same
+dense update because embedding grads are produced as dense scatter-adds; a
+row-sparse update path can be added per-op via segment ops if profiling
+demands it.
+"""
+
+import numpy as np
+
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _lr(ctx):
+    lr = ctx.input("LearningRate")
+    return lr.reshape(()) if hasattr(lr, "reshape") else lr
+
+
+@register_op("sgd", stateful=True)
+def _sgd(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    return {"ParamOut": p - _lr(ctx).astype(p.dtype) * g.astype(p.dtype)}
+
+
+@register_op("momentum", stateful=True)
+def _momentum(ctx):
+    p, g, v = ctx.input("Param"), ctx.input("Grad"), ctx.input("Velocity")
+    mu = ctx.attr("mu")
+    lr = _lr(ctx).astype(p.dtype)
+    v_out = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register_op("lars_momentum", stateful=True)
+def _lars_momentum(ctx):
+    jnp = _jnp()
+    p, g, v = ctx.input("Param"), ctx.input("Grad"), ctx.input("Velocity")
+    mu = ctx.attr("mu")
+    lars_coeff = ctx.attr("lars_coeff", 0.001)
+    lars_weight_decay = ctx.attr("lars_weight_decay", 0.0005)
+    lr = _lr(ctx).astype(p.dtype)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + lars_weight_decay * p_norm),
+        lr)
+    v_out = mu * v + local_lr * (g + lars_weight_decay * p)
+    return {"ParamOut": p - v_out, "VelocityOut": v_out}
+
+
+@register_op("adam", stateful=True)
+def _adam(ctx):
+    jnp = _jnp()
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m1, m2 = ctx.input("Moment1"), ctx.input("Moment2")
+    b1p, b2p = ctx.input("Beta1Pow"), ctx.input("Beta2Pow")
+    beta1, beta2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ctx)
+    m1_out = beta1 * m1 + (1 - beta1) * g
+    m2_out = beta2 * m2 + (1 - beta2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_out = p - lr_t.astype(p.dtype) * (
+        m1_out / (jnp.sqrt(m2_out) + eps)).astype(p.dtype)
+    return {"ParamOut": p_out, "Moment1Out": m1_out, "Moment2Out": m2_out}
+
+
+@register_op("adamax", stateful=True)
+def _adamax(ctx):
+    jnp = _jnp()
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m, inf = ctx.input("Moment"), ctx.input("InfNorm")
+    b1p = ctx.input("Beta1Pow")
+    beta1, beta2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ctx)
+    m_out = beta1 * m + (1 - beta1) * g
+    inf_out = jnp.maximum(beta2 * inf, jnp.abs(g))
+    lr_t = lr / (1 - b1p.reshape(()))
+    p_out = p - lr_t.astype(p.dtype) * m_out / (inf_out + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out}
+
+
+@register_op("adagrad", stateful=True)
+def _adagrad(ctx):
+    jnp = _jnp()
+    p, g, m = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
+    eps = ctx.attr("epsilon", 1e-6)
+    m_out = m + jnp.square(g)
+    p_out = p - _lr(ctx).astype(p.dtype) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("decayed_adagrad", stateful=True)
+def _decayed_adagrad(ctx):
+    jnp = _jnp()
+    p, g, m = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    p_out = p - _lr(ctx).astype(p.dtype) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("adadelta", stateful=True)
+def _adadelta(ctx):
+    jnp = _jnp()
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    avg_sq_g, avg_sq_u = ctx.input("AvgSquaredGrad"), \
+        ctx.input("AvgSquaredUpdate")
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    avg_sq_g_out = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (avg_sq_g_out + eps)) * g
+    avg_sq_u_out = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    return {"ParamOut": p + update, "AvgSquaredGradOut": avg_sq_g_out,
+            "AvgSquaredUpdateOut": avg_sq_u_out}
+
+
+@register_op("rmsprop", stateful=True)
+def _rmsprop(ctx):
+    jnp = _jnp()
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    ms, mom = ctx.input("MeanSquare"), ctx.input("Moment")
+    rho = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    momentum = ctx.attr("momentum", 0.0)
+    lr = _lr(ctx).astype(p.dtype)
+    outs = {}
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    if ctx.attr("centered", False):
+        mg = ctx.input("MeanGrad")
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - jnp.square(mg_out) + eps
+        outs["MeanGradOut"] = mg_out
+    else:
+        denom = ms_out + eps
+    mom_out = momentum * mom + lr * g / jnp.sqrt(denom)
+    outs.update({"ParamOut": p - mom_out, "MeanSquareOut": ms_out,
+                 "MomentOut": mom_out})
+    return outs
+
+
+@register_op("ftrl", stateful=True)
+def _ftrl(ctx):
+    jnp = _jnp()
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    sq_accum, lin_accum = ctx.input("SquaredAccumulator"), \
+        ctx.input("LinearAccumulator")
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr_power = ctx.attr("lr_power", -0.5)
+    lr = _lr(ctx).astype(p.dtype)
+    new_accum = sq_accum + jnp.square(g)
+    if lr_power == -0.5:
+        lin_delta = g - (jnp.sqrt(new_accum) - jnp.sqrt(sq_accum)) / lr * p
+    else:
+        lin_delta = g - (new_accum ** (-lr_power) -
+                         sq_accum ** (-lr_power)) / lr * p
+    lin_out = lin_accum + lin_delta
+    if lr_power == -0.5:
+        x = l2 + jnp.sqrt(new_accum) / lr
+    else:
+        x = l2 + new_accum ** (-lr_power) / lr
+    pre_shrink = (jnp.sign(lin_out) * l1 - lin_out) / x
+    p_out = jnp.where(jnp.abs(lin_out) > l1, pre_shrink,
+                      jnp.zeros_like(p))
+    return {"ParamOut": p_out, "SquaredAccumOut": new_accum,
+            "LinearAccumOut": lin_out}
+
+
+@register_op("proximal_gd", stateful=True)
+def _proximal_gd(ctx):
+    jnp = _jnp()
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    l1, l2 = ctx.attr("l1", 0.0), ctx.attr("l2", 0.0)
+    lr = _lr(ctx).astype(p.dtype)
+    prox = p - lr * g
+    p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (
+        1.0 + lr * l2)
+    return {"ParamOut": p_out}

@@ -1,0 +1,256 @@
+"""Op registry — the TPU-native "kernel layer".
+
+Reference analogue: paddle/fluid/framework/op_registry.h:185 (REGISTER_OPERATOR,
+REGISTER_OP_CPU/CUDA_KERNEL) + operator.cc:700 (RunImpl kernel dispatch) +
+grad_op_desc_maker.h:34 (GradOpDescMakerBase).
+
+TPU-first redesign: instead of a per-device kernel map keyed by
+OpKernelType(place, dtype, layout, library), each op registers ONE pure JAX
+lowering `lower(ctx) -> {out_slot: value}`. The Executor interprets a Block by
+calling lowerings inside a single jax trace, so the whole block becomes one
+fused XLA computation — kernel selection, layout transforms and fusion all
+belong to the XLA compiler (SURVEY.md §7 design stance). Placement is chosen
+once per jit, not per op, so the reference's data-transform-between-kernels
+machinery (operator.cc:804) has no equivalent and none is needed.
+
+Autodiff: the reference generates grad OpDescs via per-op C++ GradOpDescMakers.
+Here every op gets a *generic* grad op `<type>_grad` whose lowering is
+`jax.vjp` of the forward lowering. Because forward and backward ops execute in
+the same trace, the executor stashes the vjp closure produced at the forward
+op and the grad op consumes it — zero recompute, numerically exact, and no
+per-op gradient code. Ops may still register a custom grad maker when the
+generic io signature is not right (e.g. ops with integer inputs only).
+
+Shape inference: `infer_shape(op, block)` runs the lowering under
+jax.eval_shape on ShapeDtypeStructs, substituting a dummy extent for the
+batch-dim placeholder -1 and restoring it on outputs. This replaces ~300
+hand-written C++ InferShape functions (op_desc.cc:660).
+"""
+
+import functools
+
+import numpy as np
+
+_REGISTRY = {}
+
+# dummy extents substituted for -1 during eval_shape; we recognise the value
+# in output shapes and map it back to -1, so it must not collide with any
+# real static dim of the op — pick per-op from unlikely primes.
+_DUMMY_CANDIDATES = (97, 811, 1327, 2957)
+
+
+class OpDef:
+    def __init__(self, type, lower, infer_shape=None, grad_maker=None,
+                 no_eval_shape_cache=False, stateful=False):
+        self.type = type
+        self.lower = lower
+        self.custom_infer_shape = infer_shape
+        self.grad_maker = grad_maker
+        self.stateful = stateful
+
+
+class ExecContext:
+    """What a lowering sees: attrs + resolved input values (+ rng/step)."""
+
+    __slots__ = ("op", "attrs", "_inputs", "step", "seed", "mesh")
+
+    def __init__(self, op, inputs, step=0, seed=0, mesh=None):
+        self.op = op
+        self.attrs = op.attrs
+        self._inputs = inputs  # slot -> [values]
+        self.step = step
+        self.seed = seed
+        self.mesh = mesh
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def input(self, slot):
+        """Single input value for slot (None if absent)."""
+        vs = self._inputs.get(slot)
+        if not vs:
+            return None
+        return vs[0]
+
+    def inputs(self, slot):
+        """List of input values for slot."""
+        return self._inputs.get(slot, [])
+
+    def has_input(self, slot):
+        return bool(self._inputs.get(slot))
+
+    def rng_key(self):
+        """Deterministic per-op, per-step PRNG key. Reproduces the reference's
+        per-op `seed` attr semantics (e.g. dropout_op) while staying functional:
+        the executor threads a step counter through the trace."""
+        import jax
+        base = jax.random.key(np.uint32(self.seed or 0))
+        return jax.random.fold_in(jax.random.fold_in(base, self.op.uid),
+                                  self.step)
+
+
+def register_op(type, lower=None, infer_shape=None, grad_maker=None,
+                stateful=False):
+    """Register an op. Usable as decorator: @register_op("relu")."""
+    def deco(fn):
+        _REGISTRY[type] = OpDef(type, fn, infer_shape=infer_shape,
+                                grad_maker=grad_maker, stateful=stateful)
+        return fn
+    if lower is not None:
+        return deco(lower)
+    return deco
+
+
+def set_grad_maker(type, maker):
+    _REGISTRY[type].grad_maker = maker
+
+
+def get_op_def(type):
+    od = _REGISTRY.get(type)
+    if od is None:
+        raise NotImplementedError(
+            "op '%s' is not registered in the TPU op registry" % type)
+    return od
+
+
+def has_op(type):
+    return type in _REGISTRY
+
+
+def registered_ops():
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# shape inference via jax.eval_shape
+# ---------------------------------------------------------------------------
+
+def _pick_dummy(op, block):
+    """A dummy batch extent that appears in no input's static dims."""
+    static = set()
+    for names in op.inputs.values():
+        for n in names:
+            v = block._find_var_recursive(n)
+            if v is not None and v.shape is not None:
+                static.update(int(d) for d in v.shape
+                              if d is not None and d >= 0)
+    for c in _DUMMY_CANDIDATES:
+        if c not in static:
+            return c
+    c = max(static) + 101
+    return c
+
+
+def _subst_dummy(shape, dummy):
+    return tuple(dummy if d is None or d < 0 else int(d) for d in shape)
+
+
+def _restore_dummy(shape, had_dynamic, dummy):
+    if not had_dynamic:
+        return tuple(int(d) for d in shape)
+    return tuple(-1 if d == dummy else int(d) for d in shape)
+
+
+def infer_shape(op, block):
+    """Fill in shape/dtype of op's output Variables by abstractly evaluating
+    the lowering. Best-effort: ops whose outputs are already shaped, or whose
+    lowering cannot run abstractly, are skipped silently (the executor will
+    still produce correct runtime shapes)."""
+    od = _REGISTRY.get(op.type)
+    if od is None:
+        return
+    if od.custom_infer_shape is not None:
+        od.custom_infer_shape(op, block)
+        return
+    import jax
+    from ..fluid import core as fcore
+
+    dummy = _pick_dummy(op, block)
+    in_structs = {}
+    had_dynamic = False
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            v = block._find_var_recursive(n)
+            if v is None or v.shape is None:
+                return
+            if any(d is None or d < 0 for d in v.shape):
+                had_dynamic = True
+            vals.append(jax.ShapeDtypeStruct(_subst_dummy(v.shape, dummy),
+                                             fcore.convert_dtype_to_np(v.dtype)))
+        in_structs[slot] = vals
+
+    try:
+        out = jax.eval_shape(
+            lambda ins: od.lower(ExecContext(op, ins, step=0, seed=0)),
+            in_structs)
+    except Exception:
+        return
+    if out is None:
+        return
+    for slot, vals in out.items():
+        names = op.outputs.get(slot, [])
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for n, s in zip(names, vals):
+            v = block._find_var_recursive(n)
+            if v is None or s is None:
+                continue
+            v.shape = _restore_dummy(s.shape, had_dynamic, dummy)
+            v.dtype = fcore.convert_np_dtype_to_dtype_(s.dtype)
+
+
+# ---------------------------------------------------------------------------
+# generic vjp-based gradients
+# ---------------------------------------------------------------------------
+
+def _is_float(x):
+    import jax.numpy as jnp
+    return jnp.issubdtype(jnp.result_type(x), jnp.floating)
+
+
+def make_forward_and_vjp(op, od, ctx):
+    """Run forward; also build the vjp closure over float inputs.
+
+    Returns (outputs_dict, vjp_fn, layout) where vjp_fn maps output cotangent
+    pytree -> grads for the float inputs (same dict-of-lists layout, None for
+    non-float entries)."""
+    import jax
+
+    in_layout = [(slot, len(vals)) for slot, vals in ctx._inputs.items()]
+    flat_in = [v for _, vals in ctx._inputs.items() for v in vals]
+    diff_idx = [i for i, v in enumerate(flat_in) if _is_float(v)]
+
+    def rebuild(flat):
+        d, i = {}, 0
+        for slot, n in in_layout:
+            d[slot] = list(flat[i:i + n])
+            i += n
+        return d
+
+    def f(*diff_vals):
+        flat = list(flat_in)
+        for i, v in zip(diff_idx, diff_vals):
+            flat[i] = v
+        c2 = ExecContext(op, rebuild(flat), step=ctx.step, seed=ctx.seed,
+                         mesh=ctx.mesh)
+        outs = od.lower(c2)
+        # normalized {slot: [vals]} so cotangent trees are predictable
+        return {s: list(v) if isinstance(v, (list, tuple)) else [v]
+                for s, v in outs.items()}
+
+    primals = [flat_in[i] for i in diff_idx]
+    outs, vjp = jax.vjp(f, *primals)
+
+    def vjp_to_slots(cotangents):
+        diff_grads = vjp(cotangents)
+        flat_grads = [None] * len(flat_in)
+        for i, g in zip(diff_idx, diff_grads):
+            flat_grads[i] = g
+        d, i = {}, 0
+        for slot, n in in_layout:
+            d[slot] = flat_grads[i:i + n]
+            i += n
+        return d
+
+    return outs, vjp_to_slots

@@ -1,0 +1,439 @@
+"""Tensor creation / manipulation / RNG op lowerings.
+
+Reference analogues: fill_constant_op, uniform_random_op, gaussian_random_op,
+truncated_gaussian_random_op, reshape_op, transpose_op, concat_op, split_op,
+squeeze/unsqueeze, flatten, stack/unstack, gather/scatter, slice, expand,
+reverse, shape, assign, cast (in math_ops), pad (nn_ops), range, linspace.
+"""
+
+import numpy as np
+
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _np_dtype(attr_dtype, default=np.float32):
+    from ..fluid import core as fcore
+    if attr_dtype is None:
+        return np.dtype(default)
+    return fcore.convert_dtype_to_np(attr_dtype)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+@register_op("fill_constant")
+def _fill_constant(ctx):
+    jnp = _jnp()
+    shape = ctx.attr("shape", [1])
+    dtype = _np_dtype(ctx.attr("dtype"))
+    return {"Out": jnp.full(tuple(int(d) for d in shape),
+                            ctx.attr("value", 0.0), dtype=dtype)}
+
+
+@register_op("fill_constant_batch_size_like")
+def _fill_constant_bsl(ctx):
+    jnp = _jnp()
+    ref = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = _np_dtype(ctx.attr("dtype"))
+    return {"Out": jnp.full(tuple(shape), ctx.attr("value", 0.0),
+                            dtype=dtype)}
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.zeros_like(ctx.input("X"))}
+
+
+@register_op("assign")
+def _assign(ctx):
+    return {"Out": ctx.input("X")}
+
+
+@register_op("assign_value")
+def _assign_value(ctx):
+    jnp = _jnp()
+    dtype = _np_dtype(ctx.attr("dtype"))
+    if ctx.attr("fp32_values"):
+        vals = np.array(ctx.attr("fp32_values"), dtype=np.float32)
+    elif ctx.attr("int64_values"):
+        vals = np.array(ctx.attr("int64_values"), dtype=np.int64)
+    else:
+        vals = np.array(ctx.attr("int32_values"), dtype=np.int32)
+    return {"Out": jnp.asarray(vals.reshape(ctx.attr("shape")), dtype=dtype)}
+
+
+@register_op("shape")
+def _shape(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.asarray(np.array(ctx.input("Input").shape,
+                                        dtype=np.int32))}
+
+
+@register_op("range")
+def _range(ctx):
+    jnp = _jnp()
+    start, end, step = ctx.input("Start"), ctx.input("End"), ctx.input("Step")
+    # dynamic arange is not XLA-friendly; require concrete python scalars
+    return {"Out": jnp.arange(float(start), float(end), float(step))}
+
+
+@register_op("linspace")
+def _linspace(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.linspace(float(ctx.input("Start")),
+                                float(ctx.input("Stop")),
+                                int(ctx.input("Num")))}
+
+
+# ---------------------------------------------------------------------------
+# RNG (uniform_random_op.cc etc.) — deterministic threefry keyed by (seed, op
+# uid, step), the functional replacement for the reference's per-op curand.
+# ---------------------------------------------------------------------------
+
+@register_op("uniform_random")
+def _uniform_random(ctx):
+    import jax
+    shape = tuple(int(d) for d in ctx.attr("shape"))
+    dtype = _np_dtype(ctx.attr("dtype"))
+    return {"Out": jax.random.uniform(
+        ctx.rng_key(), shape, minval=ctx.attr("min", -1.0),
+        maxval=ctx.attr("max", 1.0), dtype=dtype)}
+
+
+@register_op("uniform_random_batch_size_like")
+def _uniform_random_bsl(ctx):
+    import jax
+    ref = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    shape[ctx.attr("output_dim_idx", 0)] = ref.shape[ctx.attr(
+        "input_dim_idx", 0)]
+    dtype = _np_dtype(ctx.attr("dtype"))
+    return {"Out": jax.random.uniform(
+        ctx.rng_key(), tuple(shape), minval=ctx.attr("min", -1.0),
+        maxval=ctx.attr("max", 1.0), dtype=dtype)}
+
+
+@register_op("gaussian_random")
+def _gaussian_random(ctx):
+    import jax
+    shape = tuple(int(d) for d in ctx.attr("shape"))
+    dtype = _np_dtype(ctx.attr("dtype"))
+    out = jax.random.normal(ctx.rng_key(), shape, dtype=dtype)
+    return {"Out": out * ctx.attr("std", 1.0) + ctx.attr("mean", 0.0)}
+
+
+@register_op("truncated_gaussian_random")
+def _truncated_gaussian_random(ctx):
+    import jax
+    shape = tuple(int(d) for d in ctx.attr("shape"))
+    dtype = _np_dtype(ctx.attr("dtype"))
+    out = jax.random.truncated_normal(ctx.rng_key(), -2.0, 2.0, shape,
+                                      dtype=dtype)
+    return {"Out": out * ctx.attr("std", 1.0) + ctx.attr("mean", 0.0)}
+
+
+@register_op("randint")
+def _randint(ctx):
+    import jax
+    import jax.numpy as jnp
+    shape = tuple(int(d) for d in ctx.attr("shape"))
+    return {"Out": jax.random.randint(
+        ctx.rng_key(), shape, ctx.attr("low", 0), ctx.attr("high"),
+        dtype=jnp.int64)}
+
+
+@register_op("shuffle_batch")
+def _shuffle_batch(ctx):
+    import jax
+    x = ctx.input("X")
+    perm = jax.random.permutation(ctx.rng_key(), x.shape[0])
+    return {"Out": x[perm], "ShuffleIdx": perm}
+
+
+# ---------------------------------------------------------------------------
+# reshape family — reshape2/transpose2 also emit XShape (a shape-only var the
+# reference uses to reconstruct shapes in grad; we keep the contract).
+# ---------------------------------------------------------------------------
+
+def _target_shape(x, shape):
+    shape = list(shape)
+    neg = [i for i, d in enumerate(shape) if d == -1]
+    for i, d in enumerate(shape):
+        if d == 0:
+            shape[i] = x.shape[i]
+    if neg:
+        known = int(np.prod([d for d in shape if d > 0])) or 1
+        shape[neg[0]] = int(np.prod(x.shape)) // known
+    return tuple(shape)
+
+
+@register_op("reshape")
+def _reshape(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    if ctx.has_input("Shape"):
+        shape = [int(d) for d in np.asarray(ctx.input("Shape"))]
+    else:
+        shape = ctx.attr("shape")
+    return {"Out": jnp.reshape(x, _target_shape(x, shape))}
+
+
+@register_op("reshape2")
+def _reshape2(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    shape = ctx.attr("shape")
+    out = jnp.reshape(x, _target_shape(x, shape))
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("transpose")
+def _transpose(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.transpose(ctx.input("X"), ctx.attr("axis"))}
+
+
+@register_op("transpose2")
+def _transpose2(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    return {"Out": jnp.transpose(x, ctx.attr("axis")),
+            "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("squeeze")
+def _squeeze(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    axes = ctx.attr("axes", [])
+    if not axes:
+        return {"Out": jnp.squeeze(x)}
+    axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return {"Out": jnp.squeeze(x, axis=axes)}
+
+
+@register_op("squeeze2")
+def _squeeze2(ctx):
+    x = ctx.input("X")
+    jnp = _jnp()
+    out = _squeeze(ctx)["Out"]
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("unsqueeze")
+def _unsqueeze(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    for a in sorted(ctx.attr("axes")):
+        x = jnp.expand_dims(x, a)
+    return {"Out": x}
+
+
+@register_op("unsqueeze2")
+def _unsqueeze2(ctx):
+    jnp = _jnp()
+    x0 = ctx.input("X")
+    out = _unsqueeze(ctx)["Out"]
+    return {"Out": out, "XShape": jnp.zeros((0,) + x0.shape, x0.dtype)}
+
+
+@register_op("flatten")
+def _flatten(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": jnp.reshape(x, (lead, -1))}
+
+
+@register_op("flatten2")
+def _flatten2(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    out = _flatten(ctx)["Out"]
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# concat/split/stack/gather/scatter/slice/expand/reverse
+# ---------------------------------------------------------------------------
+
+@register_op("concat")
+def _concat(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.concatenate(ctx.inputs("X"), axis=ctx.attr("axis", 0))}
+
+
+@register_op("split")
+def _split(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    num = ctx.attr("num", 0)
+    sections = ctx.attr("sections", [])
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def _stack(ctx):
+    jnp = _jnp()
+    return {"Y": jnp.stack(ctx.inputs("X"), axis=ctx.attr("axis", 0))}
+
+
+@register_op("unstack")
+def _unstack(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("gather")
+def _gather(ctx):
+    jnp = _jnp()
+    x, idx = ctx.input("X"), ctx.input("Index")
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx.reshape(-1)
+    return {"Out": jnp.take(x, idx.astype(jnp.int32), axis=0)}
+
+
+@register_op("gather_nd")
+def _gather_nd(ctx):
+    jnp = _jnp()
+    x, idx = ctx.input("X"), ctx.input("Index")
+    idx = idx.astype(jnp.int32)
+    return {"Out": x[tuple(jnp.moveaxis(idx, -1, 0))]}
+
+
+@register_op("scatter")
+def _scatter(ctx):
+    jnp = _jnp()
+    x, ids, upd = ctx.input("X"), ctx.input("Ids"), ctx.input("Updates")
+    if ids.ndim == 2 and ids.shape[1] == 1:
+        ids = ids.reshape(-1)
+    ids = ids.astype(jnp.int32)
+    if ctx.attr("overwrite", True):
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].add(upd)
+    return {"Out": out}
+
+
+@register_op("slice")
+def _slice(ctx):
+    x = ctx.input("Input")
+    axes = ctx.attr("axes")
+    starts = ctx.attr("starts")
+    ends = ctx.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("strided_slice")
+def _strided_slice(ctx):
+    x = ctx.input("Input")
+    axes = ctx.attr("axes")
+    starts, ends = ctx.attr("starts"), ctx.attr("ends")
+    strides = ctx.attr("strides", [1] * len(axes))
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("expand")
+def _expand(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    times = ctx.attr("expand_times")
+    return {"Out": jnp.tile(x, tuple(times))}
+
+
+@register_op("expand_as")
+def _expand_as(ctx):
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("target_tensor")
+    times = tuple(t // s for t, s in zip(y.shape, x.shape))
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("reverse")
+def _reverse(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    out = x
+    for a in ctx.attr("axis"):
+        out = jnp.flip(out, a)
+    return {"Out": out}
+
+
+@register_op("tile")
+def _tile(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.tile(ctx.input("X"), tuple(ctx.attr("repeat_times")))}
+
+
+@register_op("where")
+def _where(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.where(ctx.input("Condition"), ctx.input("X"),
+                             ctx.input("Y"))}
+
+
+@register_op("space_to_depth")
+def _space_to_depth(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")  # NCHW
+    b = ctx.attr("blocksize")
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return {"Out": x.reshape(n, c * b * b, h // b, w // b)}
+
+
+@register_op("pixel_shuffle")
+def _pixel_shuffle(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    r = ctx.attr("upscale_factor")
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return {"Out": x.reshape(n, c // (r * r), h * r, w * r)}
+
+
+@register_op("lod_reset")
+def _lod_reset(ctx):
+    # LoD metadata is carried outside the traced values (see fluid/lod.py);
+    # dense value passes through unchanged.
+    return {"Out": ctx.input("X")}
+
+
+@register_op("print")
+def _print(ctx):
+    import jax
+    x = ctx.input("In")
+    jax.debug.print(ctx.attr("message", "") + " {}", x)
+    return {"Out": x}

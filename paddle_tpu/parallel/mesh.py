@@ -1,0 +1,61 @@
+"""Device-mesh helpers — the TPU analogue of the reference's device lists +
+NCCLContextMap (platform/nccl_helper.h:82, parallel_executor.cc:113).
+
+A Mesh over ICI replaces per-device CUDA streams and NCCL communicators:
+collectives are compiled into the step by XLA's SPMD partitioner. Axis
+conventions (used across the framework):
+
+  data   — batch/data parallelism (grad allreduce ≅ all_reduce_op_handle)
+  model  — tensor parallelism for sharded weights/embeddings
+  seq    — sequence/context parallelism (ring attention milestone)
+  pipe   — pipeline stages
+  expert — MoE expert parallelism
+"""
+
+import os
+
+import numpy as np
+
+__all__ = ["make_mesh", "data_parallel_mesh", "local_device_count",
+           "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS"]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+
+def local_device_count(use_cuda=True):
+    """Device count, honoring CPU_NUM like the reference's parallel_executor.py
+    (python wrapper :32 builds places from CUDA_VISIBLE_DEVICES / CPU_NUM)."""
+    import jax
+    if not use_cuda:
+        n_cpu = len(jax.devices("cpu"))
+        cpu_num = int(os.environ.get("CPU_NUM", n_cpu))
+        return min(cpu_num, n_cpu) or 1
+    return len(jax.devices())
+
+
+def make_mesh(axis_sizes, devices=None):
+    """axis_sizes: dict axis-name -> size (row-major over the device list)."""
+    import jax
+    from jax.sharding import Mesh
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(axis_sizes.values())
+    n = int(np.prod(sizes))
+    if n > len(devices):
+        raise ValueError("mesh needs %d devices, have %d" %
+                         (n, len(devices)))
+    dev_array = np.array(devices[:n]).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def data_parallel_mesh(num_devices=None, use_cuda=True):
+    import jax
+    devs = jax.devices() if use_cuda else jax.devices("cpu")
+    if num_devices is None:
+        num_devices = local_device_count(use_cuda)
+    return make_mesh({DATA_AXIS: num_devices}, devs[:num_devices])

@@ -1,0 +1,20 @@
+"""Test config: force a virtual 8-device CPU platform so multi-chip sharding
+paths run without TPU hardware (SURVEY.md §4 fixtures note — the analogue of
+the reference's fake multi-device contexts in op-handle tests).
+
+Note: the environment's axon site hook imports jax at interpreter start, so
+JAX_PLATFORMS in os.environ is read too early to help — we must go through
+jax.config. XLA_FLAGS is still honored at backend init, which happens later.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("CPU_NUM", "8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

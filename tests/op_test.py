@@ -1,0 +1,113 @@
+"""OpTest harness — numpy-reference op checks with numeric gradient checks.
+
+Reference analogue: python/paddle/fluid/tests/unittests/op_test.py:132 —
+build a one-op program from numpy inputs, execute, compare against a numpy
+reference (check_output_with_place :294), and compare analytic gradients
+against central finite differences (get_numeric_gradient :43, check_grad
+:403)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import backward as backward_mod
+
+
+class OpTest:
+    """Subclass sets: self.op_type, self.inputs {slot: np array or
+    [(name, arr), ...]}, self.outputs {slot: expected np array}, self.attrs."""
+
+    op_type = None
+    inputs = {}
+    outputs = {}
+    attrs = {}
+
+    def _build(self):
+        main = fluid.Program()
+        startup = fluid.Program()
+        in_vars = {}
+        feed = {}
+        with fluid.program_guard(main, startup):
+            blk = main.global_block()
+            for slot, value in self.inputs.items():
+                if isinstance(value, list):
+                    vs = []
+                    for name, arr in value:
+                        arr = np.asarray(arr)
+                        v = blk.create_var(name=name, shape=arr.shape,
+                                           dtype=arr.dtype)
+                        feed[name] = arr
+                        vs.append(v)
+                    in_vars[slot] = vs
+                else:
+                    arr = np.asarray(value)
+                    name = "in_" + slot
+                    v = blk.create_var(name=name, shape=arr.shape,
+                                       dtype=arr.dtype)
+                    feed[name] = arr
+                    in_vars[slot] = v
+            out_vars = {}
+            for slot in self.outputs:
+                out_vars[slot] = blk.create_var(name="out_" + slot,
+                                                dtype="float32")
+            blk.append_op(type=self.op_type, inputs=in_vars,
+                          outputs=out_vars, attrs=dict(self.attrs))
+        return main, startup, feed, in_vars, out_vars
+
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        main, startup, feed, _, out_vars = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        fetch = [out_vars[slot] for slot in self.outputs]
+        results = exe.run(main, feed=feed, fetch_list=fetch)
+        for (slot, expect), got in zip(self.outputs.items(), results):
+            expect = np.asarray(expect)
+            np.testing.assert_allclose(
+                np.asarray(got).astype(np.float64),
+                expect.astype(np.float64), atol=atol, rtol=rtol,
+                err_msg="output mismatch for %s.%s" % (self.op_type, slot))
+
+    def check_grad(self, inputs_to_check, output_name, atol=5e-3,
+                   rtol=5e-3, delta=1e-3):
+        """Compare program-built analytic grads vs central finite
+        differences of the jitted forward (reference check_grad :403)."""
+        main, startup, feed, in_vars, out_vars = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        out_var = None
+        for slot, v in out_vars.items():
+            if v.name == "out_" + output_name or slot == output_name:
+                out_var = v
+        assert out_var is not None
+        with fluid.program_guard(main, startup):
+            target = fluid.layers.reduce_sum(out_var)
+            check_vars = []
+            for slot in inputs_to_check:
+                v = in_vars[slot]
+                check_vars.append(v if not isinstance(v, list) else v[0])
+            grads = backward_mod.calc_gradient(target, check_vars)
+        analytic = exe.run(main, feed=feed,
+                           fetch_list=[g for g in grads if g is not None])
+
+        # numeric: rerun forward at perturbed inputs
+        def fwd_sum(feed_override):
+            f = dict(feed)
+            f.update(feed_override)
+            r = exe.run(main, feed=f, fetch_list=[out_var])
+            return float(np.sum(np.asarray(r[0], dtype=np.float64)))
+
+        for slot, g in zip(inputs_to_check, analytic):
+            base = np.asarray(feed["in_" + slot], dtype=np.float64)
+            num = np.zeros_like(base)
+            flat = base.flatten()
+            for i in range(flat.size):
+                plus = flat.copy()
+                plus[i] += delta
+                minus = flat.copy()
+                minus[i] -= delta
+                fp = fwd_sum({"in_" + slot:
+                              plus.reshape(base.shape).astype(np.float32)})
+                fm = fwd_sum({"in_" + slot:
+                              minus.reshape(base.shape).astype(np.float32)})
+                num.flat[i] = (fp - fm) / (2 * delta)
+            np.testing.assert_allclose(
+                np.asarray(g, dtype=np.float64), num, atol=atol, rtol=rtol,
+                err_msg="grad mismatch for %s input %s" %
+                        (self.op_type, slot))

@@ -1,0 +1,92 @@
+"""append_backward / calc_gradient tests (reference unittests/test_backward.py,
+test_calc_gradient.py): program structure + analytic-vs-numeric values."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.backward import append_backward, calc_gradient
+
+
+def test_append_backward_creates_grads():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3)
+        loss = fluid.layers.mean(y)
+        p_g = append_backward(loss)
+    names = {p.name for p, g in p_g}
+    params = {p.name for p in main.global_block().all_parameters()}
+    assert names == params
+    for p, g in p_g:
+        assert g.name == p.name + "@GRAD"
+    types = [op.type for op in main.global_block().ops]
+    assert "mean_grad" in types and "mul_grad" in types
+
+
+def test_grad_values_linear():
+    """loss = mean(x @ w + b); dloss/dw = x^T . 1/N, dloss/db = 1"""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=3,
+                            param_attr=fluid.ParamAttr(name="w"),
+                            bias_attr=fluid.ParamAttr(name="b"))
+        loss = fluid.layers.mean(y)
+        p_g = append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(6, 4).astype("float32")
+    grads = {p.name: g for p, g in p_g}
+    gw, gb = exe.run(main, feed={"x": xv},
+                     fetch_list=[grads["w"], grads["b"]])
+    expect_gw = np.repeat(xv.mean(axis=0).reshape(4, 1) / 3.0, 3, axis=1)
+    np.testing.assert_allclose(gw, expect_gw, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(gb, np.full(3, 1.0 / 3.0), atol=1e-5)
+
+
+def test_fanin_accumulation():
+    """x used twice -> grads from both paths must sum."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = blk.create_var(name="x", shape=[3], dtype="float32")
+        a = fluid.layers.scale(x, scale=2.0)
+        b = fluid.layers.scale(x, scale=3.0)
+        s = fluid.layers.elementwise_add(a, b)
+        loss = fluid.layers.reduce_sum(s)
+        (gx,) = calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones(3, dtype="float32")
+    (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, np.full(3, 5.0), atol=1e-6)
+
+
+def test_stop_gradient():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h1 = fluid.layers.fc(input=x, size=4,
+                             param_attr=fluid.ParamAttr(name="w1"))
+        h1.stop_gradient = True
+        h2 = fluid.layers.fc(input=h1, size=2,
+                             param_attr=fluid.ParamAttr(name="w2"))
+        loss = fluid.layers.mean(h2)
+        p_g = append_backward(loss)
+    names = {p.name for p, g in p_g}
+    assert "w2" in names
+    assert "w1" not in names
+
+
+def test_calc_gradient_chain():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        blk = main.global_block()
+        x = blk.create_var(name="x", shape=[5], dtype="float32")
+        y = fluid.layers.square(x)
+        z = fluid.layers.reduce_sum(y)
+        (gx,) = calc_gradient(z, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(5, dtype="float32")
+    (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xv, atol=1e-6)

@@ -1,0 +1,140 @@
+"""End-to-end training tests (reference tests/book/test_fit_a_line.py,
+test_recognize_digits.py pattern: build program, train, assert convergence)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+
+
+def _fit_a_line(optimizer, steps=60):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[13], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        optimizer.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype("float32")
+    losses = []
+    for _ in range(steps):
+        xb = rng.randn(32, 13).astype("float32")
+        yb = xb @ w_true
+        (l,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(l))
+    return losses
+
+
+@pytest.mark.parametrize("opt_fn", [
+    lambda: fluid.optimizer.SGD(learning_rate=0.01),
+    lambda: fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9),
+    lambda: fluid.optimizer.Adam(learning_rate=0.01),
+    lambda: fluid.optimizer.Adagrad(learning_rate=0.1),
+    lambda: fluid.optimizer.RMSPropOptimizer(learning_rate=0.005),
+], ids=["sgd", "momentum", "adam", "adagrad", "rmsprop"])
+def test_fit_a_line_optimizers(opt_fn):
+    losses = _fit_a_line(opt_fn())
+    assert losses[-1] < losses[0] * 0.5, losses[-5:]
+
+
+def test_mnist_cnn_converges():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[1, 28, 28], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        c1 = fluid.nets.simple_img_conv_pool(img, 8, 5, pool_size=2,
+                                             pool_stride=2, act="relu")
+        pred = fluid.layers.fc(input=c1, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        acc = fluid.layers.accuracy(input=pred, label=label)
+        fluid.optimizer.Adam(learning_rate=0.003).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    losses = []
+    for _ in range(40):
+        lab = rng.randint(0, 10, (32, 1)).astype("int64")
+        xb = rng.randn(32, 1, 28, 28).astype("float32") * 0.1
+        for j in range(32):
+            xb[j, 0, lab[j, 0]] += 1.0
+        l, a = exe.run(main, feed={"img": xb, "label": lab},
+                       fetch_list=[loss, acc])
+        losses.append(float(l))
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_batch_norm_updates_stats_and_test_mode():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.batch_norm(x)
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(learning_rate=0.0).minimize(loss)
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    bn_mean_name = [v for v in main.global_block().vars
+                    if ".mean" in v][0]
+    rng = np.random.RandomState(0)
+    xb = (rng.randn(64, 4) * 3 + 5).astype("float32")
+    for _ in range(20):
+        exe.run(main, feed={"x": xb}, fetch_list=[loss])
+    mean_val = np.asarray(fluid.global_scope().get(bn_mean_name))
+    # moving mean should be pulled toward ~5
+    assert np.all(mean_val > 2.0)
+    # test mode uses the moving stats: output differs from train mode
+    (test_out,) = exe.run(test_prog, feed={"x": xb}, fetch_list=[h.name])
+    assert np.isfinite(test_out).all()
+
+
+def test_dropout_train_vs_test():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[100], dtype="float32")
+        d = fluid.layers.dropout(x, dropout_prob=0.5)
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((8, 100), dtype="float32")
+    (train_out,) = exe.run(main, feed={"x": xv}, fetch_list=[d.name])
+    (test_out,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[d.name])
+    # train: ~half zeroed; test (downgrade_in_infer): x * (1-p)
+    assert (np.asarray(train_out) == 0).mean() > 0.25
+    np.testing.assert_allclose(test_out, xv * 0.5, atol=1e-6)
+
+
+def test_dropout_differs_across_steps():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[100], dtype="float32")
+        d = fluid.layers.dropout(x, dropout_prob=0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((4, 100), dtype="float32")
+    (o1,) = exe.run(main, feed={"x": xv}, fetch_list=[d.name])
+    (o2,) = exe.run(main, feed={"x": xv}, fetch_list=[d.name])
+    assert not np.array_equal(o1, o2)
+
+
+def test_lr_scheduler_piecewise():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(y)
+        lr = fluid.layers.piecewise_decay([3, 6], [0.1, 0.01, 0.001])
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((4, 2), dtype="float32")
+    lrs = []
+    for _ in range(8):
+        (lv,) = exe.run(main, feed={"x": xv}, fetch_list=[lr])
+        lrs.append(float(np.asarray(lv).flatten()[0]))
+    assert abs(lrs[0] - 0.1) < 1e-6
+    assert abs(lrs[4] - 0.01) < 1e-6
+    assert abs(lrs[7] - 0.001) < 1e-6

@@ -1,0 +1,224 @@
+"""Op unit tests: math/elementwise/reduction ops vs numpy (reference
+unittests/test_elementwise_*_op.py, test_mul_op.py, test_softmax_op.py...)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(*shape):
+    return np.random.RandomState(42).uniform(-1, 1, shape).astype("float32")
+
+
+class TestElementwiseAdd(OpTest):
+    def setup_method(self, m):
+        self.op_type = "elementwise_add"
+        x, y = _rand(3, 4), _rand(3, 4)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    def setup_method(self, m):
+        self.op_type = "elementwise_add"
+        x, y = _rand(2, 3, 4), _rand(3)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMul(OpTest):
+    def setup_method(self, m):
+        self.op_type = "mul"
+        x, y = _rand(4, 6), _rand(6, 5)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMulFlatten(OpTest):
+    def setup_method(self, m):
+        self.op_type = "mul"
+        x, y = _rand(3, 2, 4), _rand(8, 5)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x.reshape(3, 8) @ y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMatmulTranspose(OpTest):
+    def setup_method(self, m):
+        self.op_type = "matmul"
+        x, y = _rand(4, 6), _rand(5, 6)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y.T}
+        self.attrs = {"transpose_X": False, "transpose_Y": True}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    def setup_method(self, m):
+        self.op_type = "softmax"
+        x = _rand(5, 7)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceSum(OpTest):
+    def setup_method(self, m):
+        self.op_type = "reduce_sum"
+        x = _rand(3, 4, 5)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.sum(axis=1)}
+        self.attrs = {"dim": [1], "keep_dim": False}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMeanAll(OpTest):
+    def setup_method(self, m):
+        self.op_type = "reduce_mean"
+        x = _rand(3, 4)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray(x.mean())}
+        self.attrs = {"reduce_all": True}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestScale(OpTest):
+    def setup_method(self, m):
+        self.op_type = "scale"
+        x = _rand(4, 5)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x * 2.5 + 1.0}
+        self.attrs = {"scale": 2.5, "bias": 1.0}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSum3(OpTest):
+    def setup_method(self, m):
+        self.op_type = "sum"
+        a, b, c = _rand(3, 4), _rand(3, 4), _rand(3, 4)
+        self.inputs = {"X": [("a", a), ("b", b), ("c", c)]}
+        self.outputs = {"Out": a + b + c}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+
+@pytest.mark.parametrize("act,fn", [
+    ("relu", lambda x: np.maximum(x, 0)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("tanh", np.tanh),
+    ("exp", np.exp),
+    ("square", np.square),
+    ("abs", np.abs),
+    ("softsign", lambda x: x / (1 + np.abs(x))),
+    ("leaky_relu", lambda x: np.where(x >= 0, x, 0.02 * x)),
+])
+def test_activation(act, fn):
+    class T(OpTest):
+        pass
+    t = T()
+    t.op_type = act
+    x = _rand(4, 5)
+    t.inputs = {"X": x}
+    t.outputs = {"Out": fn(x)}
+    t.attrs = {}
+    t.check_output(atol=1e-5)
+
+
+def test_activation_grads():
+    for act in ["relu", "sigmoid", "tanh", "square"]:
+        class T(OpTest):
+            pass
+        t = T()
+        t.op_type = act
+        x = _rand(3, 4) + 0.1  # avoid relu kink at 0
+        t.inputs = {"X": x}
+        t.outputs = {}
+        t.outputs = {"Out": x}  # unused by check_grad
+        t.check_grad(["X"], "Out")
+
+
+class TestCrossEntropy(OpTest):
+    def setup_method(self, m):
+        self.op_type = "cross_entropy"
+        probs = np.random.RandomState(7).dirichlet(
+            np.ones(5), size=4).astype("float32")
+        label = np.array([[0], [2], [4], [1]], dtype=np.int64)
+        expect = -np.log(probs[np.arange(4), label.flatten()]).reshape(4, 1)
+        self.inputs = {"X": probs, "Label": label}
+        self.outputs = {"Y": expect}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSoftmaxWithCE(OpTest):
+    def setup_method(self, m):
+        self.op_type = "softmax_with_cross_entropy"
+        logits = _rand(4, 5)
+        label = np.array([[0], [2], [4], [1]], dtype=np.int64)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(4), label.flatten()]).reshape(4, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTopK(OpTest):
+    def setup_method(self, m):
+        self.op_type = "top_k"
+        x = _rand(4, 10)
+        idx = np.argsort(-x, axis=1)[:, :3]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": vals, "Indices": idx.astype(np.int64)}
+        self.attrs = {"k": 3}
+
+    def test_output(self):
+        self.check_output()

@@ -1,0 +1,200 @@
+"""Op unit tests: conv/pool/norm/dropout/embedding vs numpy references
+(reference unittests/test_conv2d_op.py, test_pool2d_op.py,
+test_batch_norm_op.py, test_lookup_table_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest
+
+
+def _rand(*shape, seed=3):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype("float32")
+
+
+def _conv2d_ref(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    oh = (h + 2 * pad[0] - kh) // stride[0] + 1
+    ow = (wd + 2 * pad[1] - kw) // stride[1] + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])))
+    out = np.zeros((n, oc, oh, ow), dtype=np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride[0]:i * stride[0] + kh,
+                       j * stride[1]:j * stride[1] + kw]
+            out[:, :, i, j] = np.tensordot(patch, w,
+                                           axes=([1, 2, 3], [1, 2, 3]))
+    return out.astype("float32")
+
+
+class TestConv2d(OpTest):
+    def setup_method(self, m):
+        self.op_type = "conv2d"
+        x = _rand(2, 3, 8, 8)
+        w = _rand(4, 3, 3, 3, seed=5)
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": _conv2d_ref(x, w, (1, 1), (1, 1))}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Filter"], "Output", atol=2e-2, rtol=2e-2)
+
+
+class TestPool2dMax(OpTest):
+    def setup_method(self, m):
+        self.op_type = "pool2d"
+        x = _rand(2, 3, 8, 8)
+        out = x.reshape(2, 3, 4, 2, 4, 2).max(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": out}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPool2dAvg(OpTest):
+    def setup_method(self, m):
+        self.op_type = "pool2d"
+        x = _rand(2, 3, 8, 8)
+        out = x.reshape(2, 3, 4, 2, 4, 2).mean(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": out}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestBatchNormTrain(OpTest):
+    def setup_method(self, m):
+        self.op_type = "batch_norm"
+        x = _rand(4, 3, 5, 5)
+        scale = _rand(3, seed=11)
+        bias = _rand(3, seed=12)
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        mu = x.mean(axis=(0, 2, 3))
+        v = x.var(axis=(0, 2, 3))
+        xhat = (x - mu.reshape(1, 3, 1, 1)) / np.sqrt(
+            v.reshape(1, 3, 1, 1) + 1e-5)
+        y = xhat * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.outputs = {"Y": y,
+                        "MeanOut": 0.9 * mean + 0.1 * mu,
+                        "VarianceOut": 0.9 * var + 0.1 * v}
+        self.attrs = {"momentum": 0.9, "epsilon": 1e-5, "is_test": False}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestLayerNorm(OpTest):
+    def setup_method(self, m):
+        self.op_type = "layer_norm"
+        x = _rand(4, 10)
+        scale = _rand(10, seed=21)
+        bias = _rand(10, seed=22)
+        mu = x.mean(-1, keepdims=True)
+        v = x.var(-1, keepdims=True)
+        y = (x - mu) / np.sqrt(v + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.outputs = {"Y": y}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestLookupTable(OpTest):
+    def setup_method(self, m):
+        self.op_type = "lookup_table"
+        w = _rand(10, 4)
+        ids = np.array([[1], [3], [5], [1]], dtype=np.int64)
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids.flatten()]}
+        self.attrs = {"padding_idx": -1}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestDropoutTestMode(OpTest):
+    def setup_method(self, m):
+        self.op_type = "dropout"
+        x = _rand(4, 5)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x * 0.7}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestOneHot(OpTest):
+    def setup_method(self, m):
+        self.op_type = "one_hot"
+        ids = np.array([[0], [2], [1]], dtype=np.int64)
+        out = np.eye(4, dtype=np.float32)[ids.flatten()]
+        self.inputs = {"X": ids}
+        self.outputs = {"Out": out}
+        self.attrs = {"depth": 4}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReshape(OpTest):
+    def setup_method(self, m):
+        self.op_type = "reshape"
+        x = _rand(2, 6)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(3, 4)}
+        self.attrs = {"shape": [3, -1]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTranspose(OpTest):
+    def setup_method(self, m):
+        self.op_type = "transpose"
+        x = _rand(2, 3, 4)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.transpose(2, 0, 1)}
+        self.attrs = {"axis": [2, 0, 1]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestConcat(OpTest):
+    def setup_method(self, m):
+        self.op_type = "concat"
+        a, b = _rand(2, 3), _rand(2, 5)
+        self.inputs = {"X": [("ca", a), ("cb", b)]}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestGather(OpTest):
+    def setup_method(self, m):
+        self.op_type = "gather"
+        x = _rand(6, 3)
+        idx = np.array([0, 2, 5], dtype=np.int64)
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+        self.attrs = {}
+
+    def test_output(self):
+        self.check_output()

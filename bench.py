@@ -1,0 +1,84 @@
+"""Benchmark: ResNet-50 training throughput on one TPU chip.
+
+Matches BASELINE.json's flagship config (benchmark/fluid/resnet.py,
+ImageNet-shape inputs, Momentum+L2, batch 256 global). The north star is
+v5e-16 >= 8xV100; published 8xV100 fp32 ResNet-50 throughput of that era is
+~2.9k images/s total, i.e. ~181 images/s per v5e chip at 16 chips. We report
+images/sec on ONE chip and vs_baseline = value / 181.25.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_PER_CHIP = 181.25  # 8xV100 fp32 (~2900 img/s) / 16 chips
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import functionalizer
+    from paddle_tpu.models import resnet
+
+    batch = int(os.environ.get("BENCH_BATCH", 128))
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        batch = 16  # CPU smoke mode
+
+    main_prog, startup, feeds, loss, acc, predict = resnet.get_model(
+        batch_size=batch, class_dim=1000, depth=50, dataset="imagenet",
+        lr=0.1, is_train=True)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    scope = fluid.global_scope()
+    state_names = tuple(functionalizer.persistable_names(main_prog))
+    step_fn = functionalizer.build_step_fn(
+        main_prog, ("data", "label"), (loss.name,), state_names)
+    jitted = jax.jit(step_fn, donate_argnums=(0,))
+
+    state = {n: scope.get(n) for n in state_names
+             if scope.get(n) is not None}
+    rng = np.random.RandomState(0)
+    # pre-staged rotating batches (the double-buffer reader's steady state)
+    n_batches = 4
+    images = [jax.device_put(rng.randn(batch, 3, 224, 224)
+                             .astype(np.float32)) for _ in range(n_batches)]
+    labels = [jax.device_put(rng.randint(0, 1000, (batch, 1))
+                             .astype(np.int32)) for _ in range(n_batches)]
+
+    # warmup / compile; force a host round-trip — through the axon relay,
+    # block_until_ready alone does not reliably fence remote execution
+    for i in range(2):
+        fetches, state = jitted(state, {"data": images[i % n_batches],
+                                        "label": labels[i % n_batches]},
+                                np.uint32(i))
+    warm_loss = float(np.asarray(fetches[0]))
+    assert np.isfinite(warm_loss)
+
+    iters = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for i in range(iters):
+        fetches, state = jitted(state, {"data": images[i % n_batches],
+                                        "label": labels[i % n_batches]},
+                                np.uint32(i + 2))
+    final_loss = float(np.asarray(fetches[0]))  # host transfer = real fence
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+
+    imgs_per_sec = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -163,11 +163,25 @@ class Executor:
 
         fetch_names = tuple(_fetch_name(f) for f in fetch_list)
 
-        # prepare feeds: numpy -> device arrays with var dtype
+        # prepare feeds: numpy -> device arrays with var dtype; LoDTensor
+        # (ragged) feeds become padded [B, T, ...] + <name>@LOD_LEN lengths,
+        # with T bucketed to a power of two to bound recompiles
         gb = program.global_block()
         feeds = {}
         for name, value in feed.items():
             v = gb._find_var_recursive(name)
+            from .lod import LoDTensor, pad_lod_feed
+            if isinstance(value, LoDTensor) and value.lod():
+                padded, lengths = pad_lod_feed(value)
+                if v is not None and v.dtype is not None:
+                    want = core.convert_dtype_to_np(v.dtype)
+                    if padded.dtype != want and not (
+                            padded.dtype.kind in "iu" and want.kind in "iu"):
+                        padded = padded.astype(want)
+                feeds[name] = jnp.asarray(padded)
+                feeds[name + functionalizer.LOD_LEN_SUFFIX] = \
+                    jnp.asarray(lengths)
+                continue
             arr = np.asarray(value)
             if v is not None and v.dtype is not None:
                 want = core.convert_dtype_to_np(v.dtype)
@@ -177,11 +191,17 @@ class Executor:
             feeds[name] = jnp.asarray(arr)
         feed_key = tuple(sorted(feeds.keys()))
 
+        # for ragged fetches, also fetch the companion lengths (present in
+        # env only when the value is actually ragged; None otherwise)
+        lod_fetch = tuple(n + functionalizer.LOD_LEN_SUFFIX
+                          for n in fetch_names)
+        fetch_ext = fetch_names + lod_fetch
+
         # output state covers ALL persistables (startup programs create
         # params that are not yet in the scope); input state is whatever
         # already exists. The jit signature keys on the input dict structure.
         persistables = tuple(functionalizer.persistable_names(program))
-        fn = self._get_jitted(program, feed_key, fetch_names, persistables)
+        fn = self._get_jitted(program, feed_key, fetch_ext, persistables)
 
         state_in = {n: scope.get(n) for n in persistables
                     if scope.has(n) and scope.get(n) is not None}
@@ -192,9 +212,20 @@ class Executor:
         for n, val in new_state.items():
             scope.set(n, val)
 
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+        lens_by_name = dict(zip(lod_fetch, fetches[len(fetch_names):]))
+        out = []
+        for i, n in enumerate(fetch_names):
+            val = fetches[i]
+            lens = lens_by_name.get(n + functionalizer.LOD_LEN_SUFFIX)
+            if lens is not None and val is not None:
+                from .lod import unpad_to_lod_tensor
+                out.append(unpad_to_lod_tensor(np.asarray(val),
+                                               np.asarray(lens)))
+            elif return_numpy:
+                out.append(np.asarray(val))
+            else:
+                out.append(val)
+        return out
 
     # ---- parity shims used by reference scripts ----
     def _run_startup(self, startup_program, scope=None):

@@ -24,6 +24,12 @@ from ..ops.registry import ExecContext, make_forward_and_vjp
 
 _SKIP_OPS = frozenset(["feed", "fetch"])
 
+# Companion-variable suffix carrying per-sequence lengths for LoD (ragged)
+# variables: a lod_level>0 var is a padded dense [B, T, ...] array in env
+# plus `<name>@LOD_LEN` holding int32 [B] lengths (see fluid/lod.py for the
+# encoding rationale — reference lod_tensor.h:58).
+LOD_LEN_SUFFIX = "@LOD_LEN"
+
 
 def _float0_zeros(primal_struct):
     import jax
@@ -59,16 +65,58 @@ def _gather_inputs(op, env):
     vals = {}
     for slot, names in op.inputs.items():
         vals[slot] = [env.get(n) if n else None for n in names]
+        lens = [env.get(n + LOD_LEN_SUFFIX) if n else None for n in names]
+        if any(l is not None for l in lens):
+            vals[slot + LOD_LEN_SUFFIX] = lens
     return vals
 
 
 def _write_outputs(op, outs, env):
     norm = _normalize_outs(outs)
-    for slot, names in op.outputs.items():
-        produced = norm.get(slot, [])
+    for slot, produced in norm.items():
+        if slot.endswith(LOD_LEN_SUFFIX):
+            base = slot[:-len(LOD_LEN_SUFFIX)]
+            names = op.outputs.get(base, [])
+            for i, name in enumerate(names):
+                if name and i < len(produced) and produced[i] is not None:
+                    env[name + LOD_LEN_SUFFIX] = produced[i]
+            continue
+        names = op.outputs.get(slot, [])
         for i, name in enumerate(names):
             if name and i < len(produced) and produced[i] is not None:
                 env[name] = produced[i]
+
+
+# ops whose outputs leave the ragged domain (reduce over time) — runtime
+# companion propagation must not re-attach lengths to their outputs
+_LOD_DROP_OPS = frozenset([
+    "sequence_pool", "sequence_first_step", "sequence_last_step",
+    "sequence_mask", "mean", "reduce_sum", "reduce_mean", "reduce_max",
+    "shape", "accuracy", "top_k",
+])
+
+
+def _propagate_lod(op, env):
+    """LoD-oblivious ops (elementwise, fc, activations...) keep ragged
+    structure: copy the first input companion to outputs that the lowering
+    didn't explicitly produce. Ops in _LOD_DROP_OPS reduce over time and are
+    excluded (mirrors the reference's per-op ShareLoD decisions)."""
+    if op.type in _LOD_DROP_OPS:
+        return
+    src = None
+    for names in op.inputs.values():
+        for n in names:
+            if n and (n + LOD_LEN_SUFFIX) in env:
+                src = env[n + LOD_LEN_SUFFIX]
+                break
+        if src is not None:
+            break
+    if src is None:
+        return
+    for names in op.outputs.values():
+        for n in names:
+            if n and (n + LOD_LEN_SUFFIX) not in env:
+                env[n + LOD_LEN_SUFFIX] = src
 
 
 def _run_forward_op(op, env, vjp_cache, needed_vjp, step, seed, mesh):
@@ -85,6 +133,7 @@ def _run_forward_op(op, env, vjp_cache, needed_vjp, step, seed, mesh):
         outs = od.lower(ctx)
         if outs:
             _write_outputs(op, outs, env)
+    _propagate_lod(op, env)
 
 
 class _ShapeOf:
@@ -166,7 +215,7 @@ def build_step_fn(program, feed_names, fetch_names, state_names,
         env.update(state)
         env.update(feeds)
         run_block(block, env, step=step, seed=seed, mesh=mesh)
-        fetches = [env[n] for n in fetch_names]
+        fetches = [env.get(n) for n in fetch_names]
         new_state = {n: env[n] for n in state_names if n in env}
         return fetches, new_state
 

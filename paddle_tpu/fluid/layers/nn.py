@@ -90,7 +90,12 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         helper.append_op(type="sum", inputs={"X": mul_results},
                          outputs={"Out": pre_bias})
     pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
-    return helper.append_activation(pre_act)
+    out = helper.append_activation(pre_act)
+    # ShareLoD: a row-wise fc keeps ragged structure (reference fc op)
+    first_in = helper.multiple_input()[0]
+    if first_in.lod_level > 0:
+        out.lod_level = first_in.lod_level
+    return out
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
@@ -109,6 +114,7 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         outputs={"Out": tmp},
         attrs={"is_sparse": is_sparse, "is_distributed": is_distributed,
                "padding_idx": padding_idx})
+    tmp.lod_level = input.lod_level  # ShareLoD (reference lookup_table op)
     return tmp
 
 

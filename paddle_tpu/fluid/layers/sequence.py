@@ -1,0 +1,242 @@
+"""Sequence layer functions (reference keeps these in layers/nn.py:
+dynamic_lstm, dynamic_gru, sequence_conv, sequence_pool, sequence_softmax,
+sequence_expand, sequence_first/last_step, sequence_reverse, sequence_pad/
+unpad, sequence_mask, sequence_enumerate, sequence_reshape, sequence_slice)."""
+
+from ..layer_helper import LayerHelper
+from ..framework import Variable
+from ..initializer import Constant
+from .. import core
+
+__all__ = [
+    "dynamic_lstm", "dynamic_gru", "gru_unit", "sequence_conv",
+    "sequence_pool", "sequence_softmax", "sequence_expand",
+    "sequence_first_step", "sequence_last_step", "sequence_reverse",
+    "sequence_pad", "sequence_unpad", "sequence_mask", "sequence_enumerate",
+    "sequence_reshape", "sequence_slice", "sequence_concat",
+]
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """reference layers/nn.py dynamic_lstm over lstm_op.cc. `input` is the
+    pre-projected [*, 4H] sequence (user applies fc first, like the
+    reference); returns (hidden, cell) ragged outputs."""
+    helper = LayerHelper("lstm", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    assert size % 4 == 0
+    H = size // 4
+    weight = helper.create_parameter(attr=helper.param_attr,
+                                     shape=[H, 4 * H], dtype=dtype)
+    bias_size = [1, 7 * H if use_peepholes else 4 * H]
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=bias_size,
+                                   dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    hidden.lod_level = max(input.lod_level, 1)
+    cell.lod_level = max(input.lod_level, 1)
+    batch_gate = helper.create_variable_for_type_inference(dtype, True)
+    batch_cell_pre_act = helper.create_variable_for_type_inference(
+        dtype, True)
+    inputs = {"Input": input, "Weight": weight, "Bias": bias}
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    helper.append_op(
+        type="lstm", inputs=inputs,
+        outputs={"Hidden": hidden, "Cell": cell, "BatchGate": batch_gate,
+                 "BatchCellPreAct": batch_cell_pre_act},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation})
+    return hidden, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, name=None):
+    helper = LayerHelper("gru", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dtype = input.dtype
+    weight = helper.create_parameter(attr=helper.param_attr,
+                                     shape=[size, 3 * size], dtype=dtype)
+    bias = helper.create_parameter(attr=helper.bias_attr,
+                                   shape=[1, 3 * size], dtype=dtype,
+                                   is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    hidden.lod_level = max(input.lod_level, 1)
+    bg = helper.create_variable_for_type_inference(dtype, True)
+    brhp = helper.create_variable_for_type_inference(dtype, True)
+    bh = helper.create_variable_for_type_inference(dtype, True)
+    inputs = {"Input": input, "Weight": weight, "Bias": bias}
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    helper.append_op(
+        type="gru", inputs=inputs,
+        outputs={"Hidden": hidden, "BatchGate": bg,
+                 "BatchResetHiddenPrev": brhp, "BatchHidden": bh},
+        attrs={"is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "activation": candidate_activation})
+    return hidden
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    H = size // 3
+    weight = helper.create_parameter(attr=helper.param_attr,
+                                     shape=[H, 3 * H], dtype=dtype)
+    bias = helper.create_parameter(attr=helper.bias_attr,
+                                   shape=[1, 3 * H], dtype=dtype,
+                                   is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    gate = helper.create_variable_for_type_inference(dtype, True)
+    reset = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(
+        type="gru_unit",
+        inputs={"Input": input, "HiddenPrev": hidden, "Weight": weight,
+                "Bias": bias},
+        outputs={"Hidden": out, "Gate": gate, "ResetHiddenPrev": reset},
+        attrs={"activation": activation,
+               "gate_activation": gate_activation})
+    return out, reset, gate
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    filter_param = helper.create_parameter(attr=helper.param_attr,
+                                           shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.lod_level = max(input.lod_level, 1)
+    helper.append_op(
+        type="sequence_conv",
+        inputs={"X": [input], "Filter": [filter_param]},
+        outputs={"Out": [out]},
+        attrs={"contextStride": filter_stride,
+               "contextStart": -int(filter_size // 2),
+               "contextLength": filter_size})
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(pre_act)
+
+
+def _seq_single(op_type, input, attrs=None, lod_out=False, out_slot="Out"):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if lod_out:
+        out.lod_level = max(input.lod_level, 1)
+    helper.append_op(type=op_type, inputs={"X": input},
+                     outputs={out_slot: out}, attrs=attrs or {})
+    return out
+
+
+def sequence_pool(input, pool_type, is_test=False):
+    return _seq_single("sequence_pool", input,
+                       {"pooltype": pool_type.upper()})
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    return _seq_single("sequence_softmax", input, lod_out=True)
+
+
+def sequence_first_step(input):
+    return _seq_single("sequence_first_step", input)
+
+
+def sequence_last_step(input):
+    return _seq_single("sequence_last_step", input)
+
+
+def sequence_reverse(x, name=None):
+    return _seq_single("sequence_reverse", x, lod_out=True, out_slot="Y")
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = max(y.lod_level, 1)
+    helper.append_op(type="sequence_expand", inputs={"X": x, "Y": y},
+                     outputs={"Out": out}, attrs={"ref_level": ref_level})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT64, stop_gradient=True)
+    helper.append_op(type="sequence_pad",
+                     inputs={"X": x, "PadValue": pad_value},
+                     outputs={"Out": out, "Length": length},
+                     attrs={"padded_length": maxlen if maxlen else -1})
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = 1
+    helper.append_op(type="sequence_unpad",
+                     inputs={"X": x, "Length": length},
+                     outputs={"Out": out})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="sequence_mask", inputs={"X": x},
+                     outputs={"Y": out},
+                     attrs={"maxlen": maxlen if maxlen else -1,
+                            "out_dtype": out.dtype})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, True)
+    out.lod_level = 1
+    helper.append_op(type="sequence_enumerate", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"win_size": win_size, "pad_value": pad_value})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.lod_level = 1
+    helper.append_op(type="sequence_reshape", inputs={"X": input},
+                     outputs={"Out": out}, attrs={"new_dim": new_dim})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.lod_level = 1
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": input, "Offset": offset,
+                             "Length": length},
+                     outputs={"Out": out})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    out.lod_level = 1
+    helper.append_op(type="sequence_concat", inputs={"X": input},
+                     outputs={"Out": out})
+    return out

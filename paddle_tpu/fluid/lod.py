@@ -95,6 +95,41 @@ class LoDTensor:
             None if self._data is None else self._data.shape, self._lod)
 
 
+def _bucket_len(n, minimum=16):
+    """next power of two >= n (bounded recompile count per program)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_lod_feed(lod_tensor, bucket=True):
+    """packed LoDTensor -> (padded [B, T, ...], lengths int32 [B]).
+    T is bucketed to a power of two so changing batch raggedness reuses
+    compiled programs (SURVEY.md §7 'segment ids + maxlen bucketing')."""
+    data = np.asarray(lod_tensor)
+    offsets = lod_tensor.lod()[-1]
+    lens = np.array([offsets[i + 1] - offsets[i]
+                     for i in range(len(offsets) - 1)], dtype=np.int32)
+    B = len(lens)
+    T = int(lens.max()) if B else 0
+    if bucket:
+        T = _bucket_len(max(T, 1))
+    padded = np.zeros((B, T) + data.shape[1:], dtype=data.dtype)
+    for i in range(B):
+        padded[i, :lens[i]] = data[offsets[i]:offsets[i + 1]]
+    return padded, lens
+
+
+def unpad_to_lod_tensor(padded, lens):
+    """(padded [B, T, ...], lengths [B]) -> packed LoDTensor."""
+    rows = [padded[i, :int(l)] for i, l in enumerate(lens)]
+    packed = np.concatenate(rows, axis=0) if rows else padded[:0, 0]
+    t = LoDTensor(packed)
+    t.set_recursive_sequence_lengths([[int(l) for l in lens]])
+    return t
+
+
 def create_lod_tensor(data, recursive_seq_lens, place=None):
     """reference python/paddle/fluid/lod_tensor.py create_lod_tensor."""
     if isinstance(data, list):

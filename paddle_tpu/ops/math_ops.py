@@ -126,7 +126,10 @@ def _broadcast_y(x, y, axis):
 def _register_elementwise(name, fn):
     def lower(ctx, _fn=fn):
         x, y = ctx.input("X"), ctx.input("Y")
-        y = _broadcast_y(x, y, ctx.attr("axis", -1))
+        axis = ctx.attr("axis", -1)
+        if ctx.lod_len("X") is not None and axis is not None and axis >= 1:
+            axis += 1  # padded ragged layout inserts the time dim at 1
+        y = _broadcast_y(x, y, axis)
         return {"Out": _fn(x, y)}
     register_op(name, lower)
 
@@ -187,6 +190,10 @@ def _mul(ctx):
     x, y = ctx.input("X"), ctx.input("Y")
     xd = ctx.attr("x_num_col_dims", 1)
     yd = ctx.attr("y_num_col_dims", 1)
+    if ctx.lod_len("X") is not None:
+        # ragged input arrives padded [B, T, ...] (one extra leading dim vs
+        # the build-time packed [rows, ...] convention) — shift the split
+        xd += 1
     x2 = _flatten2d(x, xd)
     y2 = _flatten2d(y, yd)
     out = jnp.matmul(x2, y2)

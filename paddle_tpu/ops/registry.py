@@ -79,6 +79,12 @@ class ExecContext:
     def has_input(self, slot):
         return bool(self._inputs.get(slot))
 
+    def lod_len(self, slot):
+        """Per-sequence length vector [B] for a ragged (LoD) input, or None.
+        See functionalizer.LOD_LEN_SUFFIX."""
+        vs = self._inputs.get(slot + "@LOD_LEN")
+        return vs[0] if vs else None
+
     def rng_key(self):
         """Deterministic per-op, per-step PRNG key. Reproduces the reference's
         per-op `seed` attr semantics (e.g. dropout_op) while staying functional:

@@ -1,0 +1,564 @@
+"""Sequence (LoD/ragged) op lowerings + recurrent ops.
+
+Reference analogues: paddle/fluid/operators/sequence_ops/ (17 op families, all
+honoring the packed LoD layout), lstm_op.cc (dynamic LSTM: gate order i,f,c,o
+per lstm_op.cc:187-:218, optional peepholes), gru_op.cc, and the
+math/sequence2batch machinery that re-batches ragged rows per timestep.
+
+TPU encoding (SURVEY.md §5 long-context): a ragged var is a padded dense
+[B, T, ...] array + an int32 lengths vector [B] carried as a companion env
+entry (functionalizer.LOD_LEN_SUFFIX). The reference's sequence2batch
+reordering disappears: recurrences are lax.scan over the padded time axis
+with per-step masks — static shapes, MXU-friendly batched matmuls, and the
+whole scan compiles into one fused loop. Padded positions are zeroed in op
+outputs so downstream reductions need no special casing.
+"""
+
+import numpy as np
+
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _mask(lens, T, dtype):
+    """[B] lengths -> [B, T] 0/1 mask."""
+    jnp = _jnp()
+    return (jnp.arange(T)[None, :] < lens[:, None]).astype(dtype)
+
+
+def _expand_mask(m, ref):
+    """[B, T] -> [B, T, 1, ...] broadcastable to ref."""
+    jnp = _jnp()
+    return m.reshape(m.shape + (1,) * (ref.ndim - 2))
+
+
+# ---------------------------------------------------------------------------
+# pooling / steps (sequence_pool_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")          # [B, T, ...]
+    lens = ctx.lod_len("X")
+    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    B, T = x.shape[0], x.shape[1]
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    m = _expand_mask(_mask(lens, T, x.dtype), x)
+    xm = x * m
+    denom = jnp.maximum(lens.astype(x.dtype), 1.0).reshape(
+        (B,) + (1,) * (x.ndim - 2))
+    if ptype == "AVERAGE":
+        out = jnp.sum(xm, axis=1) / denom
+    elif ptype == "SUM":
+        out = jnp.sum(xm, axis=1)
+    elif ptype == "SQRT":
+        out = jnp.sum(xm, axis=1) / jnp.sqrt(denom)
+    elif ptype == "MAX":
+        neg = jnp.where(m > 0, x, jnp.full_like(x, -1e30))
+        out = jnp.max(neg, axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((B, 1) + (1,) * (x.ndim - 2)).astype(jnp.int32),
+            axis=1)[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError("sequence_pool type %s" % ptype)
+    return {"Out": out}
+
+
+@register_op("sequence_last_step")
+def _sequence_last_step(ctx):
+    class _C:  # reuse pool lowering with LAST
+        pass
+    ctx.attrs = dict(ctx.attrs)
+    ctx.attrs["pooltype"] = "LAST"
+    return _sequence_pool(ctx)
+
+
+@register_op("sequence_first_step")
+def _sequence_first_step(ctx):
+    ctx.attrs = dict(ctx.attrs)
+    ctx.attrs["pooltype"] = "FIRST"
+    return _sequence_pool(ctx)
+
+
+# ---------------------------------------------------------------------------
+# masked softmax / mask / reverse / expand / concat / pad / unpad
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")  # [B, T] or [B, T, 1]
+    lens = ctx.lod_len("X")
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    xx = x[..., 0] if squeeze else x
+    B, T = xx.shape[0], xx.shape[1]
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    m = _mask(lens, T, xx.dtype)
+    logits = jnp.where(m > 0, xx, jnp.full_like(xx, -1e30))
+    out = jax.nn.softmax(logits, axis=1) * m
+    if squeeze:
+        out = out[..., None]
+    return {"Out": out}
+
+
+@register_op("sequence_mask")
+def _sequence_mask(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")  # lengths tensor
+    maxlen = ctx.attr("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise NotImplementedError(
+            "sequence_mask needs a static maxlen on XLA")
+    from ..fluid import core as fcore
+    dtype = fcore.convert_dtype_to_np(ctx.attr("out_dtype",
+                                               fcore.VarDesc.VarType.INT64))
+    flat = x.reshape(-1)
+    m = (jnp.arange(maxlen)[None, :] < flat[:, None]).astype(dtype)
+    return {"Y": m.reshape(tuple(x.shape) + (maxlen,))}
+
+
+@register_op("sequence_reverse")
+def _sequence_reverse(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    lens = ctx.lod_len("X")
+    B, T = x.shape[0], x.shape[1]
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    t = jnp.arange(T)[None, :]
+    idx = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+    out = jnp.take_along_axis(
+        x, idx.reshape((B, T) + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1)
+    return {"Y": out, "Y@LOD_LEN": lens}
+
+
+@register_op("sequence_expand")
+def _sequence_expand(ctx):
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("Y")
+    ylens = ctx.lod_len("Y")
+    if ylens is None:
+        ylens = jnp.full((y.shape[0],), y.shape[1], jnp.int32)
+    if x.ndim == y.ndim:  # already ragged: repeat rows — not needed yet
+        raise NotImplementedError("sequence_expand of ragged X")
+    # dense X [B, D] -> ragged [B, Ty, D] tiling each row along time
+    T = y.shape[1]
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    m = _expand_mask(_mask(ylens, T, x.dtype), out)
+    return {"Out": out * m, "Out@LOD_LEN": ylens}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx):
+    jnp = _jnp()
+    xs = ctx.inputs("X")
+    lens = ctx._inputs.get("X@LOD_LEN") or [None] * len(xs)
+    B = xs[0].shape[0]
+    lens = [l if l is not None else
+            jnp.full((B,), x.shape[1], jnp.int32)
+            for x, l in zip(xs, lens)]
+    T_out = sum(x.shape[1] for x in xs)
+    out = jnp.zeros((B, T_out) + xs[0].shape[2:], xs[0].dtype)
+    total = jnp.zeros((B,), jnp.int32)
+    t_idx = jnp.arange(T_out)[None, :]
+    for x, l in zip(xs, lens):
+        # place x's valid rows at offset `total` per batch row
+        src_t = jnp.clip(t_idx - total[:, None], 0, x.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            x, src_t.reshape((B, T_out) + (1,) * (x.ndim - 2)).astype(
+                jnp.int32), axis=1)
+        in_range = (t_idx >= total[:, None]) & \
+            (t_idx < (total + l)[:, None])
+        out = jnp.where(
+            in_range.reshape((B, T_out) + (1,) * (x.ndim - 2)),
+            gathered, out)
+        total = total + l
+    return {"Out": out, "Out@LOD_LEN": total}
+
+
+@register_op("sequence_pad")
+def _sequence_pad(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    lens = ctx.lod_len("X")
+    if lens is None:
+        lens = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    padded_length = ctx.attr("padded_length", -1)
+    pad_value = ctx.input("PadValue")
+    T = x.shape[1] if padded_length in (None, -1, 0) else padded_length
+    out = x[:, :T]
+    if T > x.shape[1]:
+        out = jnp.pad(x, ((0, 0), (0, T - x.shape[1])) +
+                      ((0, 0),) * (x.ndim - 2))
+    m = _expand_mask(_mask(lens, T, x.dtype), out)
+    if pad_value is not None:
+        out = out * m + (1 - m) * pad_value.reshape(
+            (1, 1) + (1,) * (out.ndim - 2))
+    return {"Out": out, "Length": lens.astype(jnp.int64)}
+
+
+@register_op("sequence_unpad")
+def _sequence_unpad(ctx):
+    jnp = _jnp()
+    x, length = ctx.input("X"), ctx.input("Length")
+    lens = length.reshape(-1).astype(jnp.int32)
+    m = _expand_mask(_mask(lens, x.shape[1], x.dtype), x)
+    return {"Out": x * m, "Out@LOD_LEN": lens}
+
+
+@register_op("sequence_enumerate")
+def _sequence_enumerate(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")  # [B, T] int ids (or [B,T,1])
+    lens = ctx.lod_len("X")
+    win = ctx.attr("win_size")
+    pad_value = ctx.attr("pad_value", 0)
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    xx = x[..., 0] if squeeze else x
+    B, T = xx.shape
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    cols = []
+    for k in range(win):
+        idx = jnp.arange(T) + k
+        valid = idx[None, :] < lens[:, None]
+        g = jnp.take(xx, jnp.clip(idx, 0, T - 1), axis=1)
+        cols.append(jnp.where(valid, g, pad_value))
+    out = jnp.stack(cols, axis=-1)
+    m = _mask(lens, T, out.dtype)[..., None]
+    return {"Out": (out * m).astype(xx.dtype), "Out@LOD_LEN": lens}
+
+
+@register_op("sequence_slice")
+def _sequence_slice(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    offset = ctx.input("Offset").reshape(-1).astype(jnp.int32)
+    length = ctx.input("Length").reshape(-1).astype(jnp.int32)
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T)[None, :]
+    idx = jnp.clip(offset[:, None] + t, 0, T - 1)
+    out = jnp.take_along_axis(
+        x, idx.reshape((B, T) + (1,) * (x.ndim - 2)), axis=1)
+    m = _expand_mask(_mask(length, T, x.dtype), out)
+    return {"Out": out * m, "Out@LOD_LEN": length}
+
+
+@register_op("sequence_erase")
+def _sequence_erase(ctx):
+    raise NotImplementedError(
+        "sequence_erase changes per-row lengths data-dependently; "
+        "host-side fallback lands with the tokenizer utilities")
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")  # [B, T, D]
+    lens = ctx.lod_len("X")
+    new_dim = ctx.attr("new_dim")
+    B, T, D = x.shape
+    factor = D // new_dim if D >= new_dim else 1
+    if D % new_dim == 0:
+        out = x.reshape(B, T * (D // new_dim), new_dim)
+        new_lens = (lens * (D // new_dim)) if lens is not None else None
+    else:
+        assert new_dim % D == 0
+        k = new_dim // D
+        out = x.reshape(B, T // k, new_dim)
+        new_lens = (lens // k) if lens is not None else None
+    r = {"Out": out}
+    if new_lens is not None:
+        r["Out@LOD_LEN"] = new_lens
+    return r
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx):
+    """Context-window projection (sequence_conv_op.cc): for each timestep,
+    concat rows [t+start, t+start+len) and multiply by Filter
+    [ctx_len*D, M] — one big MXU matmul after an unrolled shift-stack."""
+    jnp = _jnp()
+    x = ctx.input("X")              # [B, T, D]
+    w = ctx.input("Filter")         # [ctx_len*D, M]
+    lens = ctx.lod_len("X")
+    ctx_len = ctx.attr("contextLength", 3)
+    ctx_start = ctx.attr("contextStart", -1)
+    B, T, D = x.shape
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    m = _mask(lens, T, x.dtype)
+    xm = x * m[..., None]
+    shifted = []
+    t = jnp.arange(T)
+    for k in range(ctx_len):
+        src = t + ctx_start + k
+        valid = (src >= 0) & (src < T)
+        g = jnp.take(xm, jnp.clip(src, 0, T - 1), axis=1)
+        shifted.append(jnp.where(valid[None, :, None], g, 0))
+    stacked = jnp.concatenate(shifted, axis=-1)   # [B, T, ctx_len*D]
+    out = jnp.einsum("btd,dm->btm", stacked, w)
+    return {"Out": out * m[..., None], "Out@LOD_LEN": lens}
+
+
+# ---------------------------------------------------------------------------
+# recurrent ops: dynamic LSTM / GRU via lax.scan (lstm_op.cc, gru_op.cc)
+# ---------------------------------------------------------------------------
+
+def _lstm_scan(x, lens, w, bias, h0, c0, use_peepholes, is_reverse):
+    import jax
+    jnp = _jnp()
+    B, T, H4 = x.shape
+    H = H4 // 4
+    b_gate = bias[..., :4 * H].reshape(1, 4 * H)
+    if use_peepholes:
+        w_ic = bias[..., 4 * H:5 * H].reshape(1, H)
+        w_fc = bias[..., 5 * H:6 * H].reshape(1, H)
+        w_oc = bias[..., 6 * H:7 * H].reshape(1, H)
+    m = _mask(lens, T, x.dtype)  # [B, T]
+    xs = jnp.swapaxes(x, 0, 1)           # [T, B, 4H]
+    ms = jnp.swapaxes(m, 0, 1)[..., None]  # [T, B, 1]
+    if is_reverse:
+        # reverse valid region: scan over reversed-valid-order indices
+        t = jnp.arange(T)[None, :]
+        idx = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+        x_rev = jnp.take_along_axis(x, idx[..., None].astype(jnp.int32),
+                                    axis=1)
+        xs = jnp.swapaxes(x_rev, 0, 1)
+
+    def step(carry, inp):
+        h, c = carry
+        xt, mt = inp
+        gates = xt + h @ w + b_gate
+        i, f, cand, o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            i = i + c * w_ic
+            f = f + c * w_fc
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        cand = jnp.tanh(cand)
+        c_new = f * c + i * cand
+        if use_peepholes:
+            o = o + c_new * w_oc
+        o = jax.nn.sigmoid(o)
+        h_new = o * jnp.tanh(c_new)
+        h = mt * h_new + (1 - mt) * h
+        c = mt * c_new + (1 - mt) * c
+        return (h, c), (h * mt, c * mt)
+
+    (h_fin, c_fin), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, ms))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        t = jnp.arange(T)[None, :]
+        idx = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+        hidden = jnp.take_along_axis(hidden,
+                                     idx[..., None].astype(jnp.int32), axis=1)
+        cell = jnp.take_along_axis(cell,
+                                   idx[..., None].astype(jnp.int32), axis=1)
+    return hidden, cell
+
+
+@register_op("lstm")
+def _lstm(ctx):
+    jnp = _jnp()
+    x = ctx.input("Input")       # [B, T, 4H] (pre-projected, like reference)
+    w = ctx.input("Weight")      # [H, 4H]
+    bias = ctx.input("Bias")     # [1, 4H] or [1, 7H] with peepholes
+    lens = ctx.lod_len("Input")
+    B, T = x.shape[0], x.shape[1]
+    H = x.shape[2] // 4
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    if h0 is None:
+        h0 = jnp.zeros((B, H), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, H), x.dtype)
+    use_peepholes = ctx.attr("use_peepholes", True) and \
+        bias.shape[-1] == 7 * H
+    hidden, cell = _lstm_scan(x, lens, w, bias, h0, c0, use_peepholes,
+                              ctx.attr("is_reverse", False))
+    return {"Hidden": hidden, "Cell": cell,
+            "Hidden@LOD_LEN": lens, "Cell@LOD_LEN": lens}
+
+
+@register_op("gru")
+def _gru(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("Input")     # [B, T, 3H]
+    w = ctx.input("Weight")    # [H, 3H]: [:, :2H] update/reset, [:, 2H:] cand
+    bias = ctx.input("Bias")   # [1, 3H]
+    lens = ctx.lod_len("Input")
+    B, T = x.shape[0], x.shape[1]
+    H = x.shape[2] // 3
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    h0 = ctx.input("H0")
+    if h0 is None:
+        h0 = jnp.zeros((B, H), x.dtype)
+    if bias is not None:
+        x = x + bias.reshape(1, 1, 3 * H)
+    m = _mask(lens, T, x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(m, 0, 1)[..., None]
+    w_rz = w[:, :2 * H]
+    w_c = w[:, 2 * H:]
+
+    def step(h, inp):
+        xt, mt = inp
+        xrz, xc = xt[:, :2 * H], xt[:, 2 * H:]
+        rz = jax.nn.sigmoid(xrz + h @ w_rz)
+        # fluid gru layout: update gate u first, then reset gate r
+        u, r = jnp.split(rz, 2, axis=-1)
+        cand = jnp.tanh(xc + (r * h) @ w_c)
+        h_new = u * h + (1 - u) * cand
+        h = mt * h_new + (1 - mt) * h
+        return h, h * mt
+
+    h_fin, hs = jax.lax.scan(step, h0, (xs, ms))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    return {"Hidden": hidden, "Hidden@LOD_LEN": lens,
+            "BatchGate": x, "BatchResetHiddenPrev": hidden,
+            "BatchHidden": hidden}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")          # [B, 4H]
+    c_prev = ctx.input("C_prev")
+    forget_bias = ctx.attr("forget_bias", 0.0)
+    i, f, cand, o = jnp.split(x, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    cand = jnp.tanh(cand)
+    o = jax.nn.sigmoid(o)
+    c = f * c_prev + i * cand
+    h = o * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+# ---------------------------------------------------------------------------
+# build-time shape inference on the packed (rank-2) convention: at build
+# time ragged vars keep the reference's [total_rows, D] shapes while runtime
+# values are padded [B, T, D] — eval_shape can't bridge that, so these ops
+# get explicit InferShape functions (the one place the reference's per-op
+# InferShape survives).
+# ---------------------------------------------------------------------------
+
+def _set_out(block, op, slot, shape, dtype=None):
+    names = op.outputs.get(slot, [])
+    for n in names:
+        v = block._find_var_recursive(n)
+        if v is not None:
+            v.shape = tuple(shape)
+            if dtype is not None:
+                from ..fluid import core as fcore
+                v.dtype = fcore.convert_np_dtype_to_dtype_(dtype)
+
+
+def _in_shape(block, op, slot):
+    names = op.inputs.get(slot, [])
+    if not names:
+        return None
+    v = block._find_var_recursive(names[0])
+    return None if v is None or v.shape is None else tuple(v.shape)
+
+
+def _infer_lstm(op, block):
+    s = _in_shape(block, op, "Input")
+    if s:
+        H = s[-1] // 4
+        _set_out(block, op, "Hidden", (-1, H))
+        _set_out(block, op, "Cell", (-1, H))
+
+
+def _infer_gru(op, block):
+    s = _in_shape(block, op, "Input")
+    if s:
+        H = s[-1] // 3
+        _set_out(block, op, "Hidden", (-1, H))
+
+
+def _infer_same(slot_in, slot_out):
+    def fn(op, block):
+        s = _in_shape(block, op, slot_in)
+        if s:
+            _set_out(block, op, slot_out, s)
+    return fn
+
+
+def _infer_seq_conv(op, block):
+    s = _in_shape(block, op, "X")
+    w = _in_shape(block, op, "Filter")
+    if s and w:
+        _set_out(block, op, "Out", tuple(s[:-1]) + (w[1],))
+
+
+def _infer_seq_expand(op, block):
+    s = _in_shape(block, op, "X")
+    if s:
+        _set_out(block, op, "Out", (-1,) + tuple(s[1:]))
+
+
+def _infer_seq_mask(op, block):
+    s = _in_shape(block, op, "X")
+    if s:
+        maxlen = op.attrs.get("maxlen", -1)
+        _set_out(block, op, "Y", tuple(s) + (maxlen,))
+
+
+from .registry import _REGISTRY as _R  # noqa: E402
+
+_R["lstm"].custom_infer_shape = _infer_lstm
+_R["gru"].custom_infer_shape = _infer_gru
+_R["sequence_pool"].custom_infer_shape = _infer_same("X", "Out")
+_R["sequence_first_step"].custom_infer_shape = _infer_same("X", "Out")
+_R["sequence_last_step"].custom_infer_shape = _infer_same("X", "Out")
+_R["sequence_softmax"].custom_infer_shape = _infer_same("X", "Out")
+_R["sequence_reverse"].custom_infer_shape = _infer_same("X", "Y")
+_R["sequence_conv"].custom_infer_shape = _infer_seq_conv
+_R["sequence_expand"].custom_infer_shape = _infer_seq_expand
+_R["sequence_mask"].custom_infer_shape = _infer_seq_mask
+_R["sequence_pad"].custom_infer_shape = _infer_same("X", "Out")
+_R["sequence_unpad"].custom_infer_shape = _infer_same("X", "Out")
+_R["sequence_concat"].custom_infer_shape = _infer_same("X", "Out")
+_R["sequence_slice"].custom_infer_shape = _infer_same("X", "Out")
+_R["sequence_enumerate"].custom_infer_shape = _infer_same("X", "Out")
+_R["sequence_reshape"].custom_infer_shape = _infer_same("X", "Out")
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("Input")          # [B, 3H]
+    h_prev = ctx.input("HiddenPrev")
+    w = ctx.input("Weight")         # [H, 3H]
+    bias = ctx.input("Bias")
+    H = h_prev.shape[-1]
+    if bias is not None:
+        x = x + bias.reshape(1, -1)
+    xrz, xc = x[:, :2 * H], x[:, 2 * H:]
+    rz = jax.nn.sigmoid(xrz + h_prev @ w[:, :2 * H])
+    u, r = jnp.split(rz, 2, axis=-1)
+    cand = jnp.tanh(xc + (r * h_prev) @ w[:, 2 * H:])
+    h = u * h_prev + (1 - u) * cand
+    return {"Hidden": h, "Gate": rz, "ResetHiddenPrev": r * h_prev}

@@ -1,0 +1,175 @@
+"""Sequence (LoD) op + dynamic LSTM/GRU tests (reference
+unittests/test_sequence_pool.py, test_lstm_op.py, test_dyn_rnn.py family)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.lod import create_lod_tensor, LoDTensor
+
+
+def _run_seq_op(layer_fn, data_np, seq_lens, extra_fetch=None):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[data_np.shape[-1]],
+                              dtype="float32", lod_level=1)
+        out = layer_fn(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    lod_in = create_lod_tensor(data_np, [seq_lens])
+    (res,) = exe.run(main, feed={"x": lod_in}, fetch_list=[out])
+    return res
+
+
+def test_sequence_pool_sum_avg_max_last_first():
+    data = np.arange(12, dtype=np.float32).reshape(6, 2)
+    lens = [2, 1, 3]
+    rows = [data[0:2], data[2:3], data[3:6]]
+    for ptype, ref in [
+        ("sum", np.stack([r.sum(0) for r in rows])),
+        ("average", np.stack([r.mean(0) for r in rows])),
+        ("max", np.stack([r.max(0) for r in rows])),
+        ("last", np.stack([r[-1] for r in rows])),
+        ("first", np.stack([r[0] for r in rows])),
+        ("sqrt", np.stack([r.sum(0) / np.sqrt(len(r)) for r in rows])),
+    ]:
+        got = _run_seq_op(
+            lambda x, p=ptype: fluid.layers.sequence_pool(x, pool_type=p),
+            data, lens)
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-5,
+                                   err_msg=ptype)
+
+
+def test_sequence_softmax():
+    data = np.random.RandomState(0).randn(5, 1).astype(np.float32)
+    lens = [2, 3]
+    got = _run_seq_op(fluid.layers.sequence_softmax, data, lens)
+    packed = np.asarray(got.numpy() if isinstance(got, LoDTensor) else got)
+    for start, n in [(0, 2), (2, 3)]:
+        seg = data[start:start + n, 0]
+        e = np.exp(seg - seg.max())
+        np.testing.assert_allclose(packed[start:start + n, 0],
+                                   e / e.sum(), atol=1e-5)
+
+
+def test_sequence_reverse():
+    data = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lens = [2, 3]
+    got = _run_seq_op(fluid.layers.sequence_reverse, data, lens)
+    packed = np.asarray(got.numpy() if isinstance(got, LoDTensor) else got)
+    expect = np.concatenate([data[0:2][::-1], data[2:5][::-1]])
+    np.testing.assert_allclose(packed, expect)
+
+
+def test_sequence_fetch_returns_lod_tensor():
+    data = np.ones((4, 3), dtype=np.float32)
+    lens = [1, 3]
+    got = _run_seq_op(lambda x: fluid.layers.scale(x, scale=2.0), data, lens)
+    assert isinstance(got, LoDTensor)
+    assert got.recursive_sequence_lengths() == [[1, 3]]
+    np.testing.assert_allclose(got.numpy(), data * 2.0)
+
+
+def test_sequence_expand():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_expand(x, y)
+        pooled = fluid.layers.sequence_pool(out, "sum")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.array([[1, 2], [3, 4]], dtype=np.float32)
+    yv = create_lod_tensor(np.zeros((5, 1), np.float32), [[2, 3]])
+    (res,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[pooled])
+    np.testing.assert_allclose(np.asarray(res),
+                               [[2, 4], [9, 12]], atol=1e-5)
+
+
+def test_dynamic_lstm_shapes_and_grad():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32", lod_level=1)
+        proj = fluid.layers.fc(input=x, size=16)
+        h, c = fluid.layers.dynamic_lstm(input=proj, size=16)
+        pooled = fluid.layers.sequence_pool(h, "last")
+        loss = fluid.layers.mean(pooled)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    data = np.random.RandomState(0).randn(7, 8).astype(np.float32)
+    lod_in = create_lod_tensor(data, [[3, 4]])
+    l1 = exe.run(main, feed={"x": lod_in}, fetch_list=[loss])[0]
+    l2 = exe.run(main, feed={"x": lod_in}, fetch_list=[loss])[0]
+    assert np.isfinite(l1).all() and not np.allclose(l1, l2)
+
+
+def test_lstm_mask_invariance():
+    """padding must not affect results: same sequences, different bucket
+    sizes give identical pooled outputs."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32", lod_level=1)
+        proj = fluid.layers.fc(
+            input=x, size=8, param_attr=fluid.ParamAttr(name="w"),
+            bias_attr=fluid.ParamAttr(name="b"))
+        h, c = fluid.layers.dynamic_lstm(
+            input=proj, size=8, param_attr=fluid.ParamAttr(name="lw"),
+            bias_attr=fluid.ParamAttr(name="lb"))
+        pooled = fluid.layers.sequence_pool(h, "sum")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    data = rng.randn(5, 4).astype(np.float32)
+    from paddle_tpu.fluid import lod as lod_mod
+    r1 = exe.run(main, feed={"x": create_lod_tensor(data, [[2, 3]])},
+                 fetch_list=[pooled])[0]
+    # force a bigger bucket by adding a long dummy sequence
+    data2 = np.concatenate([data, rng.randn(40, 4).astype(np.float32)])
+    r2 = exe.run(main,
+                 feed={"x": create_lod_tensor(data2, [[2, 3, 40]])},
+                 fetch_list=[pooled])[0]
+    np.testing.assert_allclose(np.asarray(r1)[:2], np.asarray(r2)[:2],
+                               atol=1e-4)
+
+
+def test_dynamic_gru_runs():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6], dtype="float32", lod_level=1)
+        proj = fluid.layers.fc(input=x, size=12)
+        h = fluid.layers.dynamic_gru(input=proj, size=4)
+        pooled = fluid.layers.sequence_pool(h, "last")
+        loss = fluid.layers.mean(pooled)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    data = np.random.RandomState(0).randn(5, 6).astype(np.float32)
+    (l,) = exe.run(main, feed={"x": create_lod_tensor(data, [[2, 3]])},
+                   fetch_list=[loss])
+    assert np.isfinite(l).all()
+
+
+def test_stacked_lstm_model_trains():
+    from paddle_tpu.models import stacked_dynamic_lstm as m
+    main, startup, feeds, loss, acc, pred = m.get_model(
+        dict_dim=100, emb_dim=16, hid_dim=16, stacked_num=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for step in range(25):
+        seqs, labels = [], []
+        for b in range(16):
+            L = int(rng.randint(3, 10))
+            lab = int(rng.randint(0, 2))
+            ids = rng.randint(0, 50, (L, 1)) + lab * 50
+            seqs.append(ids.astype("int64"))
+            labels.append(lab)
+        data = create_lod_tensor(np.concatenate(seqs, 0),
+                                 [[len(s) for s in seqs]])
+        lab = np.array(labels, dtype="int64").reshape(-1, 1)
+        l, a = exe.run(main, feed={"words": data, "label": lab},
+                       fetch_list=[loss, acc])
+        losses.append(float(np.asarray(l)))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses

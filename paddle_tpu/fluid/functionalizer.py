@@ -119,10 +119,14 @@ def _propagate_lod(op, env):
                 env[n + LOD_LEN_SUFFIX] = src
 
 
+# ops that mutate the interpreter env directly (control flow / arrays)
+_ENV_OPS = frozenset(["while", "conditional_block", "write_to_array"])
+
+
 def _run_forward_op(op, env, vjp_cache, needed_vjp, step, seed, mesh):
     od = op_registry.get_op_def(op.type)
     ctx = ExecContext(op, _gather_inputs(op, env), step=step, seed=seed,
-                      mesh=mesh)
+                      mesh=mesh, env=env if op.type in _ENV_OPS else None)
     if op.uid in needed_vjp:
         outs, vjp_fn = make_forward_and_vjp(op, od, ctx)
         norm = _normalize_outs(outs)

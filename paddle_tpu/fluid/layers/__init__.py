@@ -10,6 +10,8 @@ from . import io
 from .io import data, py_reader  # noqa: F401
 from . import sequence
 from .sequence import *  # noqa: F401,F403
+from . import control_flow
+from .control_flow import *  # noqa: F401,F403
 from . import math_op_patch
 from .math_op_patch import monkey_patch_variable
 
@@ -21,6 +23,7 @@ from . import learning_rate_scheduler  # noqa: E402
 __all__ = []
 __all__ += nn.__all__
 __all__ += sequence.__all__
+__all__ += control_flow.__all__
 __all__ += tensor.__all__
 __all__ += ops.__all__
 __all__ += ["data", "py_reader"]

@@ -1,0 +1,230 @@
+"""Control-flow op lowerings.
+
+Reference analogues: operators/controlflow/while_op.cc:36 (runs a sub-block
+via a nested Executor per iteration, grad at :119), conditional_block_op.cc,
+recurrent_op (block-based StaticRNN runtime), tensor_array_read_write.
+
+TPU redesign: sub-blocks are interpreted at trace time by the same
+functionalizer (fluid/functionalizer.run_block), so
+- `while`       -> lax.while_loop whose carry is the sub-block's write-set
+- `conditional_block` -> lax.cond over the sub-block
+- `recurrent` (DynamicRNN) -> lax.scan over the padded time axis with masks
+- StaticRNN has NO op at all: the layer unrolls its step ops straight into
+  the parent block at build time (trace-time unrolling is free under XLA and
+  keeps the whole net differentiable by the generic vjp machinery).
+
+Gradient support: lax.while_loop is not differentiable (matching XLA
+semantics); training-time recurrences go through recurrent/scan or the
+unrolled StaticRNN, while `while` serves inference/decoding loops — the same
+split the reference's dynamic-RNN machinery effectively made.
+"""
+
+import numpy as np
+
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _subblock_io(block, env):
+    """(reads, writes): external var names the sub-block reads / vars it
+    writes, in deterministic order."""
+    produced = set()
+    reads, writes = [], []
+    for op in block.ops:
+        for n in op.input_arg_names:
+            if n and n not in produced and n in env and n not in reads:
+                reads.append(n)
+        for n in op.output_arg_names:
+            if n:
+                produced.add(n)
+                if n not in writes:
+                    writes.append(n)
+    return reads, writes
+
+
+@register_op("while")
+def _while(ctx):
+    import jax
+    from ..fluid import functionalizer
+    block = ctx.attr("sub_block")
+    cond_name = ctx.op.inputs["Condition"][0]
+    env = ctx.env  # threaded by the functionalizer
+    reads, writes = _subblock_io(block, env)
+    carry_names = [n for n in writes if n in env]
+    closure_names = [n for n in reads if n not in carry_names]
+    closure = {n: env[n] for n in closure_names}
+    init = tuple(env[n] for n in carry_names)
+
+    def overlay(carry):
+        e = dict(closure)
+        e.update(zip(carry_names, carry))
+        return e
+
+    def cond_fun(carry):
+        return overlay(carry)[cond_name].reshape(())
+
+    def body_fun(carry):
+        e = overlay(carry)
+        functionalizer.run_block(block, e, step=ctx.step, seed=ctx.seed,
+                                 mesh=ctx.mesh)
+        return tuple(e[n] for n in carry_names)
+
+    final = jax.lax.while_loop(cond_fun, body_fun, init)
+    for n, v in zip(carry_names, final):
+        env[n] = v
+    return {}
+
+
+@register_op("conditional_block")
+def _conditional_block(ctx):
+    import jax
+    from ..fluid import functionalizer
+    block = ctx.attr("sub_block")
+    env = ctx.env
+    cond = ctx.input("Cond")
+    reads, writes = _subblock_io(block, env)
+    carry_names = [n for n in writes if n in env]
+    closure = {n: env[n] for n in reads}
+
+    def true_fn(carry):
+        e = dict(closure)
+        e.update(zip(carry_names, carry))
+        functionalizer.run_block(block, e, step=ctx.step, seed=ctx.seed,
+                                 mesh=ctx.mesh)
+        return tuple(e[n] for n in carry_names)
+
+    def false_fn(carry):
+        return carry
+
+    init = tuple(env[n] for n in carry_names)
+    out = jax.lax.cond(cond.reshape(()).astype(bool), true_fn, false_fn,
+                       init)
+    for n, v in zip(carry_names, out):
+        env[n] = v
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# tensor array ops (tensor_array_read_write.cc; LoDTensorArray lod_tensor_
+# array.h). Arrays with static length are python lists at trace time — the
+# functionalizer stores them directly in env.
+# ---------------------------------------------------------------------------
+
+@register_op("write_to_array")
+def _write_to_array(ctx):
+    env = ctx.env
+    out_name = ctx.op.outputs["Out"][0]
+    arr = env.get(out_name)
+    if not isinstance(arr, list):
+        arr = []
+    i = int(ctx.input("I").reshape(())) if not hasattr(
+        ctx.input("I"), "aval") else None
+    x = ctx.input("X")
+    if i is None:
+        # traced index: only append-at-end pattern supported under jit
+        arr = arr + [x]
+    else:
+        arr = list(arr)
+        while len(arr) <= i:
+            arr.append(None)
+        arr[i] = x
+    env[out_name] = arr
+    return {}
+
+
+@register_op("read_from_array")
+def _read_from_array(ctx):
+    arr = ctx.input("X")
+    i = int(np.asarray(ctx.input("I")).reshape(()))
+    return {"Out": arr[i]}
+
+
+@register_op("array_length")
+def _array_length(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.asarray([len(ctx.input("X"))], jnp.int64)}
+
+
+@register_op("array_to_lod_tensor")
+def _array_to_lod_tensor(ctx):
+    jnp = _jnp()
+    arr = ctx.input("X")
+    return {"Out": jnp.stack(arr, axis=1)}  # [B, T, ...]
+
+
+@register_op("lod_tensor_to_array")
+def _lod_tensor_to_array(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    return {"Out": [x[:, t] for t in range(x.shape[1])]}
+
+
+@register_op("max_sequence_len")
+def _max_sequence_len(ctx):
+    jnp = _jnp()
+    lens = ctx.lod_len("RankTable")
+    return {"Out": jnp.max(lens).reshape((1,)).astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# recurrent op — DynamicRNN over the padded encoding via lax.scan
+# (reference recurrent_op.cc + layers/control_flow.py:1542 DynamicRNN)
+# ---------------------------------------------------------------------------
+
+@register_op("recurrent")
+def _recurrent(ctx):
+    """Inputs: sequence inputs [B, T, D...] (slot X, ragged), initial states
+    (slot InitStates), external params (slot Params). Sub-block computes one
+    step from per-step slices + state vars; attrs name the mapping."""
+    import jax
+    jnp = _jnp()
+    from ..fluid import functionalizer
+
+    block = ctx.attr("sub_block")
+    seq_names = ctx.attr("seq_input_names")      # sub-block step-slice names
+    state_names = ctx.attr("state_names")        # memory var names
+    state_prev_names = ctx.attr("state_prev_names")
+    out_names = ctx.attr("output_names")
+    xs_list = ctx.inputs("X")
+    lens = ctx.lod_len("X")
+    init_states = ctx.inputs("InitStates")
+    param_names = ctx.attr("param_names", [])
+    params = dict(zip(param_names, ctx.inputs("Params")))
+
+    B, T = xs_list[0].shape[0], xs_list[0].shape[1]
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    mask = (jnp.arange(T)[None, :] < lens[:, None]).astype(
+        xs_list[0].dtype)  # [B, T]
+
+    xs_t = [jnp.swapaxes(x, 0, 1) for x in xs_list]     # [T, B, ...]
+    mask_t = jnp.swapaxes(mask, 0, 1)[..., None]        # [T, B, 1]
+
+    def step(carry, inp):
+        mt = inp[-1]
+        slices = inp[:-1]
+        e = dict(params)
+        e.update(zip(seq_names, slices))
+        e.update(zip(state_prev_names, carry))
+        functionalizer.run_block(block, e, step=ctx.step, seed=ctx.seed,
+                                 mesh=ctx.mesh)
+        new_states = []
+        for prev, name in zip(carry, state_names):
+            new = e[name]
+            new_states.append(mt * new + (1 - mt) * prev)
+        outs = tuple(e[n] * mt for n in out_names)
+        return tuple(new_states), outs
+
+    init = tuple(init_states)
+    (final_states, outs) = jax.lax.scan(step, init,
+                                        tuple(xs_t) + (mask_t,))
+    result = {}
+    out_vals = [jnp.swapaxes(o, 0, 1) for o in outs]
+    result["Out"] = out_vals
+    result["Out@LOD_LEN"] = [lens] * len(out_vals)
+    result["FinalStates"] = list(final_states)
+    return result

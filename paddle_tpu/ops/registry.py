@@ -50,17 +50,20 @@ class OpDef:
 
 
 class ExecContext:
-    """What a lowering sees: attrs + resolved input values (+ rng/step)."""
+    """What a lowering sees: attrs + resolved input values (+ rng/step).
+    `env` is set only for env-mutating control-flow ops (while/cond/arrays),
+    which write their results into the interpreter environment directly."""
 
-    __slots__ = ("op", "attrs", "_inputs", "step", "seed", "mesh")
+    __slots__ = ("op", "attrs", "_inputs", "step", "seed", "mesh", "env")
 
-    def __init__(self, op, inputs, step=0, seed=0, mesh=None):
+    def __init__(self, op, inputs, step=0, seed=0, mesh=None, env=None):
         self.op = op
         self.attrs = op.attrs
         self._inputs = inputs  # slot -> [values]
         self.step = step
         self.seed = seed
         self.mesh = mesh
+        self.env = env
 
     def attr(self, name, default=None):
         return self.attrs.get(name, default)

@@ -431,6 +431,13 @@ def _lod_reset(ctx):
     return {"Out": ctx.input("X")}
 
 
+@register_op("is_empty")
+def _is_empty(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    return {"Out": jnp.asarray([x.size == 0])}
+
+
 @register_op("print")
 def _print(ctx):
     import jax

@@ -1,0 +1,174 @@
+"""Control-flow tests (reference unittests/test_while_op.py,
+test_conditional_block.py, test_dyn_rnn.py, test_rnn_memory_helper_op.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.lod import create_lod_tensor
+
+
+def test_while_loop_sums():
+    """while i < 10: s += i; i += 1"""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "float32", 0)
+        n = fluid.layers.fill_constant([1], "float32", 10)
+        s = fluid.layers.fill_constant([1], "float32", 0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            new_s = fluid.layers.elementwise_add(s, i)
+            fluid.layers.assign(new_s, s)
+            fluid.layers.increment(i, 1.0, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (res,) = exe.run(main, fetch_list=[s])
+    assert float(np.asarray(res)) == 45.0
+
+
+def test_conditional_block():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], dtype="float32",
+                              append_batch_size=False)
+        out = fluid.layers.fill_constant([1], "float32", 0)
+        limit = fluid.layers.fill_constant([1], "float32", 5.0)
+        cond = fluid.layers.less_than(x, limit)
+        cb = fluid.layers.ConditionalBlock([cond])
+        with cb.block():
+            doubled = fluid.layers.scale(x, scale=2.0)
+            fluid.layers.assign(doubled, out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (r1,) = exe.run(main, feed={"x": np.array([3.0], np.float32)},
+                    fetch_list=[out])
+    (r2,) = exe.run(main, feed={"x": np.array([7.0], np.float32)},
+                    fetch_list=[out])
+    assert float(np.asarray(r1)) == 6.0
+    assert float(np.asarray(r2)) == 0.0
+
+
+def test_static_rnn_accumulator():
+    """StaticRNN computing cumulative sums over [T, B, D]."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 2, 4], dtype="float32",
+                              append_batch_size=False)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(shape=[2, 4], batch_ref=x)
+            acc = fluid.layers.elementwise_add(mem, xt)
+            rnn.update_memory(mem, acc)
+            rnn.step_output(acc)
+        out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(3, 2, 4).astype("float32")
+    (res,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(res), np.cumsum(xv, axis=0),
+                               atol=1e-5)
+
+
+def test_static_rnn_trains():
+    """simple RNN classifier built from StaticRNN is differentiable."""
+    main, startup = Program(), Program()
+    T, B, D, H = 4, 8, 5, 6
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, B, D], dtype="float32",
+                              append_batch_size=False)
+        label = fluid.layers.data("label", shape=[B, 1], dtype="int64",
+                                  append_batch_size=False)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(shape=[B, H], batch_ref=x)
+            h = fluid.layers.fc(input=[xt, mem], size=H, act="tanh")
+            rnn.update_memory(mem, h)
+            rnn.step_output(h)
+        outs = rnn()
+        last = fluid.layers.slice(outs, axes=[0], starts=[T - 1], ends=[T])
+        last = fluid.layers.squeeze(last, axes=[0])
+        pred = fluid.layers.fc(input=last, size=3, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    lab = rng.randint(0, 3, (B, 1)).astype("int64")
+    xv = rng.randn(T, B, D).astype("float32") + lab.reshape(1, B, 1)
+    for _ in range(30):
+        (l,) = exe.run(main, feed={"x": xv, "label": lab},
+                       fetch_list=[loss])
+        losses.append(float(np.asarray(l)))
+    assert losses[-1] < 0.3 * losses[0], losses
+
+
+def test_dynamic_rnn_ragged_sum():
+    """DynamicRNN accumulating ragged sequences -> final states match
+    per-sequence sums."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32", lod_level=1)
+        init = fluid.layers.data("init", shape=[3], dtype="float32")
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(init=init)
+            acc = fluid.layers.elementwise_add(mem, xt)
+            rnn.update_memory(mem, acc)
+            rnn.output(acc)
+        outs = rnn()
+        pooled = fluid.layers.sequence_pool(outs, "last")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    data = np.random.RandomState(0).randn(5, 3).astype("float32")
+    lod_in = create_lod_tensor(data, [[2, 3]])
+    init_v = np.zeros((2, 3), np.float32)
+    (res,) = exe.run(main, feed={"x": lod_in, "init": init_v},
+                     fetch_list=[pooled])
+    expect = np.stack([data[0:2].sum(0), data[2:5].sum(0)])
+    np.testing.assert_allclose(np.asarray(res), expect, atol=1e-5)
+
+
+def test_dynamic_rnn_with_params_trains():
+    """DynamicRNN step using an fc (external params) gets gradients."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32", lod_level=1)
+        init = fluid.layers.data("init", shape=[6], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(init=init)
+            h = fluid.layers.fc(input=[xt, mem], size=6, act="tanh")
+            rnn.update_memory(mem, h)
+            rnn.output(h)
+        outs = rnn()
+        last = fluid.layers.sequence_pool(outs, "last")
+        pred = fluid.layers.fc(input=last, size=2, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(25):
+        seqs, labels = [], []
+        for b in range(8):
+            L = int(rng.randint(2, 6))
+            lab = int(rng.randint(0, 2))
+            seqs.append((rng.randn(L, 4) + 2 * lab).astype("float32"))
+            labels.append(lab)
+        lod_in = create_lod_tensor(np.concatenate(seqs, 0),
+                                   [[len(s) for s in seqs]])
+        (l,) = exe.run(main, feed={
+            "x": lod_in, "init": np.zeros((8, 6), np.float32),
+            "label": np.array(labels, "int64").reshape(-1, 1)},
+            fetch_list=[loss])
+        losses.append(float(np.asarray(l)))
+    assert losses[-1] < 0.6 * losses[0], losses

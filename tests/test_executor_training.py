@@ -34,7 +34,7 @@ def _fit_a_line(optimizer, steps=60):
     lambda: fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9),
     lambda: fluid.optimizer.Adam(learning_rate=0.01),
     lambda: fluid.optimizer.Adagrad(learning_rate=0.1),
-    lambda: fluid.optimizer.RMSPropOptimizer(learning_rate=0.005),
+    lambda: fluid.optimizer.RMSPropOptimizer(learning_rate=0.02),
 ], ids=["sgd", "momentum", "adam", "adagrad", "rmsprop"])
 def test_fit_a_line_optimizers(opt_fn):
     losses = _fit_a_line(opt_fn())

@@ -1,0 +1,103 @@
+// Bounded blocking byte-buffer queue.
+//
+// Reference analogue: operators/reader/lod_tensor_blocking_queue.h:31
+// (LoDTensorBlockingQueue) + blocking_queue.h — the Python->C++ handoff of
+// the py_reader pipeline. The queue holds serialized batches (bytes);
+// producers (Python feeder threads, which release the GIL inside ctypes
+// calls) block when full, the consumer blocks when empty — true parallelism
+// the pure-Python queue.Queue can't give while numpy serialization runs.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Queue {
+  std::deque<std::string> items;
+  size_t capacity;
+  bool closed = false;
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bq_create(long capacity) {
+  auto* q = new Queue();
+  q->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 1;
+  return q;
+}
+
+// 0 = pushed, -1 = closed, -2 = timeout
+int bq_push(void* handle, const uint8_t* buf, long len, long timeout_ms) {
+  auto* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [q] { return q->closed || q->items.size() < q->capacity; };
+  if (timeout_ms < 0) {
+    q->not_full.wait(lk, pred);
+  } else if (!q->not_full.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+    return -2;
+  }
+  if (q->closed) return -1;
+  q->items.emplace_back(reinterpret_cast<const char*>(buf),
+                        static_cast<size_t>(len));
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// Returns length >= 0 with *out = malloc'd buffer (free with bq_free);
+// -1 = closed and drained, -2 = timeout.
+long bq_pop(void* handle, uint8_t** out, long timeout_ms) {
+  auto* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [q] { return q->closed || !q->items.empty(); };
+  if (timeout_ms < 0) {
+    q->not_empty.wait(lk, pred);
+  } else if (!q->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                    pred)) {
+    return -2;
+  }
+  if (q->items.empty()) return -1;  // closed + drained
+  std::string item = std::move(q->items.front());
+  q->items.pop_front();
+  q->not_full.notify_one();
+  lk.unlock();
+  auto* buf = static_cast<uint8_t*>(malloc(item.size() ? item.size() : 1));
+  memcpy(buf, item.data(), item.size());
+  *out = buf;
+  return static_cast<long>(item.size());
+}
+
+void bq_free(uint8_t* buf) { free(buf); }
+
+long bq_size(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return static_cast<long>(q->items.size());
+}
+
+void bq_close(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->closed = true;
+  }
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+void bq_destroy(void* handle) {
+  bq_close(handle);
+  delete static_cast<Queue*>(handle);
+}
+
+}  // extern "C"

@@ -1,0 +1,203 @@
+// RecordIO: chunked, checksummed record file format.
+//
+// Reference analogue: paddle/fluid/recordio/ (chunk.h:27 Chunk,
+// scanner.h:26 Scanner; 711 LoC C++) — the dataset container the reference's
+// open_files/recordio reader ops consume. Re-designed, not ported: same
+// capability (appendable chunks, per-chunk CRC32, streaming scan), fresh
+// layout.
+//
+// File layout:
+//   [8-byte magic "PTRIO001"]
+//   chunk*:
+//     u32 num_records | u32 payload_len | u32 crc32(payload) | u32 reserved
+//     u32 len[num_records]
+//     payload (concatenated records)
+//
+// Exposed as a C API for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[8] = {'P', 'T', 'R', 'I', 'O', '0', '0', '1'};
+
+// CRC-32 (IEEE), table-driven.
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void init_crc_table() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32_buf(const uint8_t* buf, size_t len) {
+  init_crc_table();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    c = crc_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Writer {
+  FILE* f = nullptr;
+  std::vector<std::string> pending;
+  size_t pending_bytes = 0;
+  size_t max_chunk_records;
+  size_t max_chunk_bytes;
+
+  bool flush_chunk() {
+    if (pending.empty()) return true;
+    std::string payload;
+    payload.reserve(pending_bytes);
+    std::vector<uint32_t> lens;
+    lens.reserve(pending.size());
+    for (auto& r : pending) {
+      lens.push_back(static_cast<uint32_t>(r.size()));
+      payload += r;
+    }
+    uint32_t header[4] = {
+        static_cast<uint32_t>(pending.size()),
+        static_cast<uint32_t>(payload.size()),
+        crc32_buf(reinterpret_cast<const uint8_t*>(payload.data()),
+                  payload.size()),
+        0u};
+    if (fwrite(header, sizeof(header), 1, f) != 1) return false;
+    if (!lens.empty() &&
+        fwrite(lens.data(), sizeof(uint32_t), lens.size(), f) != lens.size())
+      return false;
+    if (!payload.empty() &&
+        fwrite(payload.data(), 1, payload.size(), f) != payload.size())
+      return false;
+    pending.clear();
+    pending_bytes = 0;
+    return true;
+  }
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  std::vector<std::string> chunk;  // records of current chunk
+  size_t next_idx = 0;
+  bool error = false;
+
+  bool load_chunk() {
+    uint32_t header[4];
+    if (fread(header, sizeof(header), 1, f) != 1) return false;  // EOF
+    uint32_t n = header[0], payload_len = header[1], crc = header[2];
+    std::vector<uint32_t> lens(n);
+    if (n && fread(lens.data(), sizeof(uint32_t), n, f) != n) {
+      error = true;
+      return false;
+    }
+    std::string payload(payload_len, '\0');
+    if (payload_len &&
+        fread(&payload[0], 1, payload_len, f) != payload_len) {
+      error = true;
+      return false;
+    }
+    if (crc32_buf(reinterpret_cast<const uint8_t*>(payload.data()),
+                  payload.size()) != crc) {
+      error = true;
+      return false;
+    }
+    chunk.clear();
+    size_t off = 0;
+    for (uint32_t i = 0; i < n; i++) {
+      chunk.emplace_back(payload.substr(off, lens[i]));
+      off += lens[i];
+    }
+    next_idx = 0;
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path, int max_chunk_records,
+                      long max_chunk_bytes) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  if (fwrite(kMagic, sizeof(kMagic), 1, f) != 1) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* w = new Writer();
+  w->f = f;
+  w->max_chunk_records =
+      max_chunk_records > 0 ? static_cast<size_t>(max_chunk_records) : 1000;
+  w->max_chunk_bytes =
+      max_chunk_bytes > 0 ? static_cast<size_t>(max_chunk_bytes)
+                          : (32u << 20);
+  return w;
+}
+
+int rio_writer_write(void* handle, const uint8_t* buf, long len) {
+  auto* w = static_cast<Writer*>(handle);
+  w->pending.emplace_back(reinterpret_cast<const char*>(buf),
+                          static_cast<size_t>(len));
+  w->pending_bytes += static_cast<size_t>(len);
+  if (w->pending.size() >= w->max_chunk_records ||
+      w->pending_bytes >= w->max_chunk_bytes) {
+    return w->flush_chunk() ? 0 : -1;
+  }
+  return 0;
+}
+
+int rio_writer_close(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  int rc = w->flush_chunk() ? 0 : -1;
+  fclose(w->f);
+  delete w;
+  return rc;
+}
+
+void* rio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  char magic[8];
+  if (fread(magic, sizeof(magic), 1, f) != 1 ||
+      memcmp(magic, kMagic, sizeof(kMagic)) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* s = new Scanner();
+  s->f = f;
+  return s;
+}
+
+// Returns record length (>=0) and sets *out to a malloc'd buffer the caller
+// frees with rio_free; returns -1 at EOF, -2 on corruption.
+long rio_scanner_next(void* handle, uint8_t** out) {
+  auto* s = static_cast<Scanner*>(handle);
+  if (s->next_idx >= s->chunk.size()) {
+    if (!s->load_chunk()) return s->error ? -2 : -1;
+  }
+  const std::string& rec = s->chunk[s->next_idx++];
+  auto* buf = static_cast<uint8_t*>(malloc(rec.size() ? rec.size() : 1));
+  memcpy(buf, rec.data(), rec.size());
+  *out = buf;
+  return static_cast<long>(rec.size());
+}
+
+void rio_free(uint8_t* buf) { free(buf); }
+
+void rio_scanner_close(void* handle) {
+  auto* s = static_cast<Scanner*>(handle);
+  fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,86 @@
+// Tensor binary serialization: the save/load op wire-and-disk format.
+//
+// Reference analogue: operators/save_op.cc / load_op.cc +
+// framework/lod_tensor.cc SerializeToStream (version header u32, dtype,
+// dims, raw data, then LoD levels). Re-designed: one self-describing record
+//   u32 version | u32 dtype_code | u32 ndim | u64 dims[ndim]
+//   u64 nbytes  | raw data
+//   u32 lod_levels | per level: u64 n | u64 offsets[n]
+// Used by the C++ recordio data path and the checkpoint code; Python side
+// reads/writes the same format via ctypes (native/__init__.py).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+constexpr uint32_t kVersion = 1;
+}
+
+extern "C" {
+
+// Serialize into a malloc'd buffer (caller frees with ts_free); returns
+// total length.
+long ts_serialize(uint32_t dtype_code, const uint64_t* dims, uint32_t ndim,
+                  const uint8_t* data, uint64_t nbytes,
+                  const uint64_t* lod_lens, uint32_t lod_levels,
+                  const uint64_t* lod_flat, uint8_t** out) {
+  size_t lod_elems = 0;
+  for (uint32_t i = 0; i < lod_levels; i++) lod_elems += lod_lens[i];
+  size_t total = 4 + 4 + 4 + 8ull * ndim + 8 + nbytes + 4 +
+                 lod_levels * 8ull + lod_elems * 8ull;
+  auto* buf = static_cast<uint8_t*>(malloc(total ? total : 1));
+  if (!buf) return -1;
+  uint8_t* p = buf;
+  auto put32 = [&p](uint32_t v) { memcpy(p, &v, 4); p += 4; };
+  auto put64 = [&p](uint64_t v) { memcpy(p, &v, 8); p += 8; };
+  put32(kVersion);
+  put32(dtype_code);
+  put32(ndim);
+  for (uint32_t i = 0; i < ndim; i++) put64(dims[i]);
+  put64(nbytes);
+  memcpy(p, data, nbytes);
+  p += nbytes;
+  put32(lod_levels);
+  size_t off = 0;
+  for (uint32_t i = 0; i < lod_levels; i++) {
+    put64(lod_lens[i]);
+    for (uint64_t j = 0; j < lod_lens[i]; j++) put64(lod_flat[off + j]);
+    off += lod_lens[i];
+  }
+  *out = buf;
+  return static_cast<long>(total);
+}
+
+// Parse header: fills dtype_code, ndim, dims (caller provides space for 16),
+// nbytes, data_offset. Returns 0 or -1 on malformed input.
+int ts_parse_header(const uint8_t* buf, long len, uint32_t* dtype_code,
+                    uint32_t* ndim, uint64_t* dims, uint64_t* nbytes,
+                    uint64_t* data_offset) {
+  if (len < 12) return -1;
+  const uint8_t* p = buf;
+  uint32_t version;
+  memcpy(&version, p, 4);
+  p += 4;
+  if (version != kVersion) return -1;
+  memcpy(dtype_code, p, 4);
+  p += 4;
+  memcpy(ndim, p, 4);
+  p += 4;
+  if (*ndim > 16 || len < 12 + 8l * (*ndim) + 8) return -1;
+  for (uint32_t i = 0; i < *ndim; i++) {
+    memcpy(&dims[i], p, 8);
+    p += 8;
+  }
+  memcpy(nbytes, p, 8);
+  p += 8;
+  *data_offset = static_cast<uint64_t>(p - buf);
+  if (static_cast<uint64_t>(len) < *data_offset + *nbytes) return -1;
+  return 0;
+}
+
+void ts_free(uint8_t* buf) { free(buf); }
+
+}  // extern "C"

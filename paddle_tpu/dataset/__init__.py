@@ -1,0 +1,20 @@
+"""Dataset loaders (reference python/paddle/dataset/).
+
+The reference downloads mnist/cifar/imdb/... to ~/.cache and exposes
+`train()/test()` reader creators. This environment has no network egress,
+so each dataset is generated *procedurally and deterministically* with the
+same sample types/shapes/vocab APIs — drop-in for the training scripts and
+tests; swap `paddle_tpu.dataset.common.synthetic_mode(False)` + a data dir
+to use real files laid out the same way.
+"""
+
+from . import common
+from . import mnist
+from . import cifar
+from . import uci_housing
+from . import imdb
+from . import wmt14
+from . import movielens
+
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "wmt14",
+           "movielens"]

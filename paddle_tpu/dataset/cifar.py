@@ -1,0 +1,45 @@
+"""CIFAR reader creators (reference python/paddle/dataset/cifar.py).
+
+Samples: (image float32[3072] in [0, 1], label). train10/test10 = CIFAR-10,
+train100/test100 = CIFAR-100."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+IMAGE_DIM = 3 * 32 * 32
+TRAIN_SIZE = 2048
+TEST_SIZE = 512
+
+
+def _creator(split, size, class_num):
+    def reader():
+        rng = common.split_rng("cifar%d" % class_num, split)
+        protos = common.split_rng("cifar%d" % class_num, "protos").randn(
+            class_num, IMAGE_DIM).astype(np.float32)
+        labels = rng.randint(0, class_num, size)
+        imgs = 0.5 * (1.0 + np.tanh(
+            0.6 * protos[labels] + 0.4 * rng.randn(size, IMAGE_DIM)))
+        imgs = imgs.astype(np.float32)
+        for i in range(size):
+            yield imgs[i], int(labels[i])
+
+    return reader
+
+
+def train10():
+    return _creator("train", TRAIN_SIZE, 10)
+
+
+def test10():
+    return _creator("test", TEST_SIZE, 10)
+
+
+def train100():
+    return _creator("train", TRAIN_SIZE, 100)
+
+
+def test100():
+    return _creator("test", TEST_SIZE, 100)

@@ -1,0 +1,57 @@
+"""Shared dataset plumbing (reference python/paddle/dataset/common.py).
+
+`download`/`md5file` exist for API parity; with no network egress they only
+resolve already-present files. Synthetic generation is deterministic per
+(dataset, split) so train/test don't overlap and runs are reproducible.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+
+__all__ = ["DATA_HOME", "download", "md5file", "split_rng",
+           "synthetic_mode", "is_synthetic"]
+
+DATA_HOME = os.path.expanduser(os.environ.get(
+    "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+_synthetic = [True]
+
+
+def synthetic_mode(on=True):
+    _synthetic[0] = bool(on)
+
+
+def is_synthetic():
+    return _synthetic[0]
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Resolve a dataset file. Network egress is unavailable: the file must
+    already exist under DATA_HOME (or synthetic mode serves generated
+    data and nothing is fetched)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split("/")[-1])
+    if os.path.exists(filename):
+        return filename
+    if _synthetic[0]:
+        return None
+    raise RuntimeError(
+        "dataset file %s not present and downloads are disabled" % filename)
+
+
+def split_rng(name, split):
+    """Deterministic generator per (dataset, split)."""
+    seed = int(hashlib.md5(("%s/%s" % (name, split)).encode())
+               .hexdigest()[:8], 16)
+    return np.random.RandomState(seed)

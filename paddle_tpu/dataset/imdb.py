@@ -1,0 +1,49 @@
+"""IMDB sentiment reader creators (reference python/paddle/dataset/imdb.py).
+
+Samples: (word-id sequence, label in {0,1}); `word_dict()` returns the
+vocab. Synthetic reviews are Markov-ish draws where some word ids are
+polarity-biased, so bag-of-words/LSTM models can learn the split."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "word_dict"]
+
+VOCAB_SIZE = 5148  # matches the reference's imdb.word_dict() size order
+TRAIN_SIZE = 1024
+TEST_SIZE = 256
+MIN_LEN, MAX_LEN = 8, 120
+
+
+def word_dict():
+    """word -> id; the last two ids are <unk> like the reference."""
+    return {"w%d" % i: i for i in range(VOCAB_SIZE)}
+
+
+def _creator(split, size):
+    def reader():
+        rng = common.split_rng("imdb", split)
+        # polarity-biased word banks
+        pos_bank = np.arange(0, VOCAB_SIZE // 3)
+        neg_bank = np.arange(VOCAB_SIZE // 3, 2 * VOCAB_SIZE // 3)
+        neutral = np.arange(2 * VOCAB_SIZE // 3, VOCAB_SIZE)
+        for _ in range(size):
+            label = int(rng.randint(0, 2))
+            n = int(rng.randint(MIN_LEN, MAX_LEN + 1))
+            bank = pos_bank if label == 1 else neg_bank
+            biased = rng.choice(bank, n)
+            neutral_draw = rng.choice(neutral, n)
+            mask = rng.rand(n) < 0.7
+            words = np.where(mask, biased, neutral_draw)
+            yield [int(w) for w in words], label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _creator("train", TRAIN_SIZE)
+
+
+def test(word_idx=None):
+    return _creator("test", TEST_SIZE)

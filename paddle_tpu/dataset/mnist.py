@@ -1,0 +1,44 @@
+"""MNIST reader creators (reference python/paddle/dataset/mnist.py).
+
+Samples: (image float32[784] scaled to [-1, 1], label int in [0, 10)).
+Synthetic digits are class-conditional gaussian blobs — separable enough
+that the convergence tests in tests/book can actually learn."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+IMAGE_DIM = 784
+CLASS_NUM = 10
+TRAIN_SIZE = 2048
+TEST_SIZE = 512
+
+
+def _make(split, size):
+    rng = common.split_rng("mnist", split)
+    protos = common.split_rng("mnist", "protos").randn(
+        CLASS_NUM, IMAGE_DIM).astype(np.float32)
+    labels = rng.randint(0, CLASS_NUM, size)
+    imgs = (0.6 * protos[labels] +
+            0.4 * rng.randn(size, IMAGE_DIM)).astype(np.float32)
+    imgs = np.tanh(imgs)  # into [-1, 1] like the reference normalization
+    return imgs, labels
+
+
+def _creator(split, size):
+    def reader():
+        imgs, labels = _make(split, size)
+        for i in range(size):
+            yield imgs[i], int(labels[i])
+
+    return reader
+
+
+def train():
+    return _creator("train", TRAIN_SIZE)
+
+
+def test():
+    return _creator("test", TEST_SIZE)

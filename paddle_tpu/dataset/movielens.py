@@ -1,0 +1,65 @@
+"""MovieLens reader creators (reference python/paddle/dataset/movielens.py).
+
+Samples: (user_id, gender_id, age_id, job_id, movie_id, category_ids,
+title_ids, score). Synthetic preferences come from a low-rank user x movie
+model so recommender tests converge."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
+           "age_table"]
+
+USER_NUM = 944
+MOVIE_NUM = 1683
+JOB_NUM = 21
+CATEGORY_NUM = 18
+TITLE_VOCAB = 1000
+age_table = [1, 18, 25, 35, 45, 50, 56]
+TRAIN_SIZE = 2048
+TEST_SIZE = 512
+
+
+def max_user_id():
+    return USER_NUM - 1
+
+
+def max_movie_id():
+    return MOVIE_NUM - 1
+
+
+def max_job_id():
+    return JOB_NUM - 1
+
+
+def _creator(split, size):
+    def reader():
+        rng = common.split_rng("movielens", split)
+        model = common.split_rng("movielens", "model")
+        u_emb = model.randn(USER_NUM, 8)
+        m_emb = model.randn(MOVIE_NUM, 8)
+        for _ in range(size):
+            u = int(rng.randint(1, USER_NUM))
+            m = int(rng.randint(1, MOVIE_NUM))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, len(age_table)))
+            job = int(rng.randint(0, JOB_NUM))
+            cats = [int(c) for c in
+                    rng.choice(CATEGORY_NUM, rng.randint(1, 4),
+                               replace=False)]
+            title = [int(t) for t in rng.randint(0, TITLE_VOCAB,
+                                                 rng.randint(1, 6))]
+            raw = u_emb[u].dot(m_emb[m]) * 0.5 + 3.0
+            score = float(np.clip(round(raw + 0.3 * rng.randn()), 1, 5))
+            yield u, gender, age, job, m, cats, title, score
+
+    return reader
+
+
+def train():
+    return _creator("train", TRAIN_SIZE)
+
+
+def test():
+    return _creator("test", TEST_SIZE)

@@ -1,0 +1,43 @@
+"""UCI housing reader creators (reference python/paddle/dataset/
+uci_housing.py). Samples: (features float32[13], price float32[1]) from a
+fixed linear model + noise, feature-normalized like the reference."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+FEATURE_DIM = 13
+TRAIN_SIZE = 404
+TEST_SIZE = 102
+
+
+def _make(split, size):
+    rng = common.split_rng("uci_housing", split)
+    w = common.split_rng("uci_housing", "model").randn(FEATURE_DIM, 1)
+    x = rng.randn(size, FEATURE_DIM).astype(np.float32)
+    y = (x.dot(w) + 0.1 * rng.randn(size, 1) + 22.5).astype(np.float32)
+    return x, y
+
+
+def _creator(split, size):
+    def reader():
+        x, y = _make(split, size)
+        for i in range(size):
+            yield x[i], y[i]
+
+    return reader
+
+
+def train():
+    return _creator("train", TRAIN_SIZE)
+
+
+def test():
+    return _creator("test", TEST_SIZE)

@@ -1,0 +1,41 @@
+"""WMT14 en-fr reader creators (reference python/paddle/dataset/wmt14.py).
+
+Samples: (src ids, trg ids with <s>, trg ids shifted with <e>). Synthetic
+"translation" pairs are id-mapped sequences (trg = f(src)) so seq2seq
+models have real signal. START=0, END=1, UNK=2 like the reference."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "N", "START", "END", "UNK"]
+
+N = 30  # default dict size knob in the reference API
+START, END, UNK = 0, 1, 2
+TRAIN_SIZE = 512
+TEST_SIZE = 128
+MIN_LEN, MAX_LEN = 4, 16
+
+
+def _creator(split, size, dict_size):
+    def reader():
+        rng = common.split_rng("wmt14", split)
+        shift = 7  # fixed "translation" mapping
+        for _ in range(size):
+            n = int(rng.randint(MIN_LEN, MAX_LEN + 1))
+            src = rng.randint(3, dict_size, n)
+            trg = (src + shift - 3) % (dict_size - 3) + 3
+            src_ids = [int(w) for w in src]
+            trg_in = [START] + [int(w) for w in trg]
+            trg_out = [int(w) for w in trg] + [END]
+            yield src_ids, trg_in, trg_out
+
+    return reader
+
+
+def train(dict_size):
+    return _creator("train", TRAIN_SIZE, dict_size)
+
+
+def test(dict_size):
+    return _creator("test", TEST_SIZE, dict_size)

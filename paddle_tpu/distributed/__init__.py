@@ -1,0 +1,4 @@
+from . import rpc
+from .rpc import VariableServer, RPCClient
+
+__all__ = ["rpc", "VariableServer", "RPCClient"]

@@ -44,6 +44,11 @@ from .parallel_executor import (  # noqa: F401
 )
 from . import data_feeder
 from .data_feeder import DataFeeder  # noqa: F401
+from . import transpiler
+from .transpiler import (  # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig, memory_optimize,
+    release_memory, InferenceTranspiler,
+)
 from . import metrics
 from . import profiler
 from . import nets
@@ -58,5 +63,7 @@ __all__ = [
     "CUDAPinnedPlace", "core", "io", "save_inference_model",
     "load_inference_model", "ParallelExecutor", "ExecutionStrategy",
     "BuildStrategy", "DataFeeder", "metrics", "profiler", "nets",
-    "LoDTensor", "create_lod_tensor",
+    "LoDTensor", "create_lod_tensor", "transpiler", "DistributeTranspiler",
+    "DistributeTranspilerConfig", "memory_optimize", "release_memory",
+    "InferenceTranspiler",
 ]

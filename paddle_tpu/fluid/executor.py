@@ -14,6 +14,7 @@ through the jitted step functionally; on TPU the state buffers are donated so
 updates are in-place at the XLA level.
 """
 
+import threading
 import warnings
 
 import numpy as np
@@ -75,11 +76,22 @@ class Scope:
 
 
 _global_scope = Scope()
-_scope_stack = [_global_scope]
+
+
+class _ScopeStack(threading.local):
+    """Per-thread scope stack rooted at the process-wide global scope —
+    concurrent executors (pserver thread + trainer threads, reference
+    test_dist_base style) must not see each other's scope_guard pushes."""
+
+    def __init__(self):
+        self.stack = [_global_scope]
+
+
+_scope_stack_tls = _ScopeStack()
 
 
 def global_scope():
-    return _scope_stack[-1]
+    return _scope_stack_tls.stack[-1]
 
 
 class scope_guard:
@@ -87,11 +99,11 @@ class scope_guard:
         self._scope = scope
 
     def __enter__(self):
-        _scope_stack.append(self._scope)
+        _scope_stack_tls.stack.append(self._scope)
         return self._scope
 
     def __exit__(self, *args):
-        _scope_stack.pop()
+        _scope_stack_tls.stack.pop()
 
 
 def as_numpy(tensor):
@@ -116,8 +128,9 @@ class Executor:
 
     def __init__(self, place=None):
         self.place = place if place is not None else core.TPUPlace(0)
-        self._cache = {}  # key -> jitted fn
+        self._cache = {}  # key -> jitted (or eager host-path) fn
         self._step_counters = {}  # program cache id -> step
+        self._host_op_cache = {}  # (id, version) -> program has host ops
 
     def _device(self):
         try:
@@ -182,6 +195,18 @@ class Executor:
                 feeds[name + functionalizer.LOD_LEN_SUFFIX] = \
                     jnp.asarray(lengths)
                 continue
+            if isinstance(value, jax.Array):
+                # already on device (PyReader double-buffer path) — do NOT
+                # round-trip through numpy, that would force D2H + H2D
+                arr = value
+                if v is not None and v.dtype is not None:
+                    want = core.convert_dtype_to_np(v.dtype)
+                    if arr.dtype != want and not (
+                            np.dtype(arr.dtype).kind in "iu"
+                            and want.kind in "iu"):
+                        arr = arr.astype(want)
+                feeds[name] = arr
+                continue
             arr = np.asarray(value)
             if v is not None and v.dtype is not None:
                 want = core.convert_dtype_to_np(v.dtype)
@@ -201,7 +226,24 @@ class Executor:
         # params that are not yet in the scope); input state is whatever
         # already exists. The jit signature keys on the input dict structure.
         persistables = tuple(functionalizer.persistable_names(program))
-        fn = self._get_jitted(program, feed_key, fetch_ext, persistables)
+        hkey = (id(program), program._version)
+        has_host = self._host_op_cache.get(hkey)
+        if has_host is None:
+            has_host = functionalizer.contains_host_ops(program)
+            self._host_op_cache[hkey] = has_host
+        if has_host:
+            # RPC / IO ops do host side effects — run the block eagerly
+            # (the reference ran these kernels on CPU outside any graph
+            # executor optimization; listen_and_serv blocks here just like
+            # ListenAndServOp::RunImpl did). Cached like the jitted path.
+            ekey = (hkey, feed_key, fetch_ext, persistables)
+            fn = self._cache.get(ekey)
+            if fn is None:
+                fn = functionalizer.build_step_fn(
+                    program, feed_key, fetch_ext, persistables)
+                self._cache[ekey] = fn
+        else:
+            fn = self._get_jitted(program, feed_key, fetch_ext, persistables)
 
         state_in = {n: scope.get(n) for n in persistables
                     if scope.has(n) and scope.get(n) is not None}

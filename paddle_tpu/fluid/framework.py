@@ -155,8 +155,6 @@ class Operator:
     plus Block references for control-flow ops).
     """
 
-    _uid_counter = [0]
-
     def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
         self.block = block
         self.type = type
@@ -165,8 +163,12 @@ class Operator:
         self.attrs = dict(attrs) if attrs else {}
         if _name_scope_stack:
             self.attrs.setdefault("op_namescope", _current_name_scope())
-        Operator._uid_counter[0] += 1
-        self.uid = Operator._uid_counter[0]
+        # uid is PER-PROGRAM creation order (not a process-global counter):
+        # the per-op RNG stream folds in uid, so two identically-built
+        # programs draw identical random values — the reference's
+        # deterministic per-op `seed` assignment under a fixed
+        # program.random_seed
+        self.uid = block.program._next_op_uid()
 
         def norm(d, target):
             if d is None:
@@ -236,8 +238,11 @@ class Operator:
                 attrs[k] = float(v)
             else:
                 attrs[k] = v
+        # uid round-trips so per-op RNG streams (registry.ExecContext.rng_key
+        # folds in op.uid) are identical in clones — the reference's per-op
+        # `seed` attr semantics under Program.clone
         return {"type": self.type, "inputs": self.inputs,
-                "outputs": self.outputs, "attrs": attrs}
+                "outputs": self.outputs, "attrs": attrs, "uid": self.uid}
 
 
 class Block:
@@ -355,8 +360,13 @@ class Program:
         self._version = 0
         self._op_role = "Forward"
         self._op_role_var = []
+        self._op_uid = 0
         # executor cache invalidation token
         self._cache_id = id(self)
+
+    def _next_op_uid(self):
+        self._op_uid += 1
+        return self._op_uid
 
     # ---- version / cache token ----
     def _bump_version(self):
@@ -490,6 +500,9 @@ class Program:
                         attrs[k] = av
                 op = Operator(blk, od["type"], od["inputs"], od["outputs"],
                               attrs)
+                if "uid" in od:
+                    op.uid = od["uid"]
+                    p._op_uid = max(p._op_uid, op.uid)
                 blk.ops.append(op)
         p._bump_version()
         return p

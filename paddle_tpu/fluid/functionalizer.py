@@ -120,7 +120,26 @@ def _propagate_lod(op, env):
 
 
 # ops that mutate the interpreter env directly (control flow / arrays)
-_ENV_OPS = frozenset(["while", "conditional_block", "write_to_array"])
+_ENV_OPS = frozenset(["while", "conditional_block", "write_to_array",
+                      "listen_and_serv"])
+
+# host-side ops (socket IO / process bootstrap / python callbacks): a block
+# containing any of these cannot be jitted as one computation — the Executor
+# runs it eagerly instead (reference: these ops' kernels ran on CPU with
+# RPC side effects; listen_and_serv_op.cc, send_op, recv_op)
+HOST_OPS = frozenset([
+    "send", "recv", "send_barrier", "fetch_barrier", "listen_and_serv",
+    "checkpoint_notify", "gen_collective_id", "save", "load",
+    "save_combine", "load_combine", "py_func",
+])
+
+
+def contains_host_ops(program):
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type in HOST_OPS:
+                return True
+    return False
 
 
 def _run_forward_op(op, env, vjp_cache, needed_vjp, step, seed, mesh):

@@ -56,7 +56,10 @@ def save_vars(executor, dirname, main_program=None, vars=None,
             val = scope.get(v.name if isinstance(v, Variable) else v)
             if val is not None:
                 arrays[v.name] = np.asarray(val)
-        np.savez(os.path.join(dirname, filename), **arrays)
+        # write through a file handle so np.savez cannot append '.npz' —
+        # the exact given filename must round-trip through load_vars
+        with open(os.path.join(dirname, filename), "wb") as f:
+            np.savez(f, **arrays)
         return
     for v in vars:
         name = v.name if isinstance(v, Variable) else v
@@ -86,9 +89,7 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     scope = global_scope()
     import jax.numpy as jnp
     if filename is not None:
-        data = np.load(os.path.join(dirname, filename)
-                       if not filename.endswith(".npz")
-                       else os.path.join(dirname, filename))
+        data = np.load(os.path.join(dirname, filename))
         for v in vars:
             if v.name in data:
                 scope.set(v.name, jnp.asarray(data[v.name]))
@@ -131,8 +132,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     model_filename = model_filename or "__model__"
     with open(os.path.join(dirname, model_filename), "w") as f:
         json.dump(meta, f)
-    save_params(executor, dirname, main_program,
-                filename=params_filename)
+    # persistables of the PRUNED program, not just Parameters: BN moving
+    # statistics must ship with the model, while optimizer accumulators
+    # (pruned away) must not (reference io.py:544)
+    save_persistables(executor, dirname, pruned,
+                      filename=params_filename)
     return [v.name for v in target_vars]
 
 

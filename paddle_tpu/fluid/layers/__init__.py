@@ -7,7 +7,7 @@ from .tensor import *  # noqa: F401,F403
 from . import ops
 from .ops import *  # noqa: F401,F403
 from . import io
-from .io import data, py_reader  # noqa: F401
+from .io import data, py_reader, batch, double_buffer, read_file  # noqa: F401
 from . import sequence
 from .sequence import *  # noqa: F401,F403
 from . import control_flow
@@ -26,5 +26,5 @@ __all__ += sequence.__all__
 __all__ += control_flow.__all__
 __all__ += tensor.__all__
 __all__ += ops.__all__
-__all__ += ["data", "py_reader"]
+__all__ += ["data", "py_reader", "batch", "double_buffer", "read_file"]
 __all__ += learning_rate_scheduler.__all__

@@ -46,7 +46,7 @@ def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
 
 
 def batch(reader, batch_size):
-    import paddle_tpu.reader_decorators as rd
+    import paddle_tpu.reader as rd
     return rd.batch(reader, batch_size)
 
 

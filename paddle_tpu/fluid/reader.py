@@ -48,6 +48,14 @@ class PyReader:
                     if self._stop.is_set():
                         return
                     arrays = self._to_feed(item)
+                    if self.use_double_buffer:
+                        # double_buffer analogue (buffered_reader.cc):
+                        # start the host->device copy NOW, from this
+                        # thread, so it overlaps the in-flight step;
+                        # device_put is async under jax
+                        import jax
+                        arrays = {k: jax.device_put(v)
+                                  for k, v in arrays.items()}
                     self._queue.put(arrays)
             finally:
                 self._queue.put(None)  # EOF sentinel

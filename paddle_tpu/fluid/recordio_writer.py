@@ -1,0 +1,55 @@
+"""RecordIO dataset conversion (reference python/paddle/fluid/
+recordio_writer.py: convert_reader_to_recordio_file) over the C++
+recordio/tensor-serde layer (native/recordio.cc, native/tensor_serde.cc)."""
+
+import numpy as np
+
+from ..native import (RecordIOWriter, RecordIOScanner, serialize_tensor,
+                      deserialize_tensor)
+
+__all__ = ["convert_reader_to_recordio_file", "recordio_reader"]
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder=None,
+                                    compressor=None, max_num_records=1000,
+                                    feed_order=None):
+    """Serialize every sample (tuple of arrays) from the reader into one
+    recordio file; one record = one sample = concatenated tensor records
+    with a count prefix. Returns number of records written."""
+    import struct
+    count = 0
+    with RecordIOWriter(filename, max_chunk_records=max_num_records) as w:
+        for sample in reader_creator():
+            if not isinstance(sample, (tuple, list)):
+                sample = (sample,)
+            parts = [struct.pack("<I", len(sample))]
+            for field in sample:
+                arr = np.asarray(field)
+                t = serialize_tensor(arr)
+                parts.append(struct.pack("<Q", len(t)))
+                parts.append(t)
+            w.write(b"".join(parts))
+            count += 1
+    return count
+
+
+def recordio_reader(filename):
+    """Reader creator over a recordio file (reference open_files /
+    recordio reader ops, operators/reader/)."""
+    import struct
+
+    def reader():
+        with RecordIOScanner(filename) as s:
+            for rec in s:
+                (n,) = struct.unpack_from("<I", rec, 0)
+                off = 4
+                fields = []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from("<Q", rec, off)
+                    off += 8
+                    arr, _lod = deserialize_tensor(rec[off:off + ln])
+                    off += ln
+                    fields.append(arr)
+                yield tuple(fields) if len(fields) > 1 else fields[0]
+
+    return reader

@@ -1,0 +1,21 @@
+"""Program->program rewrite layer (reference python/paddle/fluid/transpiler/).
+
+The transpilers keep the reference's contract — take a built Program, return
+rewritten Program(s) for a deployment role — while the execution substrate is
+XLA: collective ("nccl2") mode is the primary TPU path (grads reduced by XLA
+collectives over ICI/DCN under pjit), and the parameter-server mode performs
+the same structural split (trainer program with send/recv, pserver program
+with listen_and_serv + optimize blocks) executed by the eager host path.
+"""
+
+from .distribute_transpiler import (
+    DistributeTranspiler, DistributeTranspilerConfig, slice_variable)
+from .ps_dispatcher import PSDispatcher, RoundRobin, HashName
+from .memory_optimization_transpiler import memory_optimize, release_memory
+from .inference_transpiler import InferenceTranspiler
+
+__all__ = [
+    "DistributeTranspiler", "DistributeTranspilerConfig", "slice_variable",
+    "PSDispatcher", "RoundRobin", "HashName", "memory_optimize",
+    "release_memory", "InferenceTranspiler",
+]

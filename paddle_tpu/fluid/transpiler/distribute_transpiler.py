@@ -1,0 +1,424 @@
+"""DistributeTranspiler — rewrite a local training Program for distributed
+roles.
+
+Reference analogue: python/paddle/fluid/transpiler/distribute_transpiler.py —
+`transpile` (:239) slices params/grads into blocks (slice_variable :80),
+inserts send/recv/barrier ops into the trainer program, builds per-pserver
+programs (`get_pserver_program` :592) whose optimizer ops run inside a
+listen_and_serv event loop; nccl2 mode (`_transpile_nccl2` :212) only inserts
+gen_nccl_id for collective bootstrap.
+
+TPU redesign:
+- **Collective mode is primary** (config.mode == "collective" / "nccl2"):
+  the rewrite inserts one `gen_collective_id` bootstrap op (lowered to
+  jax.distributed.initialize — the gen_nccl_id analogue, SURVEY.md §2.3) and
+  tags the program with (num_trainers, trainer_id) so ParallelExecutor builds
+  a global device mesh; gradients are then reduced by XLA AllReduce over
+  ICI/DCN exactly where the reference used NCCL rings.
+- **PServer mode** performs the same structural split as the reference so
+  sparse/lookup-table workloads and the test strategy (test_dist_transpiler)
+  carry over. The produced programs contain host-side RPC ops (send/recv/
+  listen_and_serv) executed by the eager executor path over a TCP variable
+  server (paddle_tpu/distributed/rpc.py).
+"""
+
+import math
+
+from ..framework import Program, Parameter, default_main_program, Variable
+from .ps_dispatcher import RoundRobin, PSDispatcher
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "slice_variable"]
+
+# op types whose Param/Grad slots define the param<->grad pairing
+OPTIMIZER_OP_TYPES = frozenset([
+    "sgd", "momentum", "lars_momentum", "adam", "adamax", "adagrad",
+    "decayed_adagrad", "adadelta", "rmsprop", "ftrl", "proximal_gd",
+])
+
+RPC_OP_ROLE_ATTR = "op_role"
+RPC_OP_ROLE_VALUE = "RPC"
+
+
+class DistributeTranspilerConfig:
+    """reference distribute_transpiler.py:126.
+
+    slice_var_up: split large variables into blocks spread over pservers.
+    split_method: PSDispatcher subclass.
+    min_block_size: smallest slice, in elements (reference: 8192).
+    mode: "pserver" | "collective" ("nccl2" accepted as an alias).
+    """
+
+    slice_var_up = True
+    split_method = RoundRobin
+    min_block_size = 8192
+    mode = "pserver"
+    sync_mode = True
+
+
+class VarBlock:
+    def __init__(self, varname, offset, size):
+        self.varname = varname
+        self.offset = offset
+        self.size = size
+
+    def name(self):
+        return "%s:%d:%d" % (self.varname, self.offset, self.size)
+
+    def __repr__(self):
+        return self.name()
+
+
+def slice_variable(var_list, slice_count, min_block_size):
+    """Split each var into at most `slice_count` flat blocks of at least
+    `min_block_size` elements (reference distribute_transpiler.py:80).
+    Returns a list of lists of VarBlock."""
+    blocks = []
+    for var in var_list:
+        var_numel = 1
+        for d in var.shape:
+            var_numel *= max(int(d), 1)
+        max_pserver_count = min(slice_count,
+                                int(math.floor(var_numel / min_block_size)))
+        max_pserver_count = max(max_pserver_count, 1)
+        block_size = int(math.ceil(var_numel / float(max_pserver_count)))
+        if len(var.shape) >= 2:
+            # align by the fastest-varying dimension so each block holds
+            # whole rows (the reference's dim1 alignment)
+            dim1 = 1
+            for d in var.shape[1:]:
+                dim1 *= int(d)
+            remains = block_size % dim1
+            if remains != 0:
+                block_size += dim1 - remains
+        split_count = int(math.ceil(var_numel / float(block_size)))
+        var_blocks = []
+        for block_id in range(split_count):
+            curr_size = min(block_size, var_numel - block_id * block_size)
+            var_blocks.append(VarBlock(var.name, block_id * block_size,
+                                       curr_size))
+        blocks.append(var_blocks)
+    return blocks
+
+
+def _find_optimize_ops(program):
+    """(op, param_name, grad_name) for every optimizer op in the program."""
+    found = []
+    for op in program.global_block().ops:
+        if op.type in OPTIMIZER_OP_TYPES:
+            found.append((op, op.input("Param")[0], op.input("Grad")[0]))
+    return found
+
+
+def _is_lr_or_opt_support_op(op, opt_outputs):
+    """Ops whose outputs are consumed only by optimizer ops. Heuristic: op
+    writes only vars consumed by optimizer ops and not by forward/backward
+    compute."""
+    outs = set(op.output_arg_names)
+    return bool(outs) and outs <= opt_outputs
+
+
+def _find_lr_ops(program, opt_infos):
+    """The LR-schedule chain (reference _get_lr_ops): every op transitively
+    producing the optimizer ops' LearningRate inputs (decay math + the
+    @LR_DECAY_COUNTER@ increment). These move to the pserver, which runs
+    them once per global step — the reference ran them in a dedicated
+    lr_decay block inside listen_and_serv."""
+    gb = program.global_block()
+    needed = set()
+    for op, _p, _g in opt_infos:
+        needed.update(op.input("LearningRate"))
+    lr_ops = []
+    changed = True
+    seen = set()
+    while changed:
+        changed = False
+        for op in gb.ops:
+            if id(op) in seen or op.type in OPTIMIZER_OP_TYPES:
+                continue
+            if set(op.output_arg_names) & needed:
+                # stop if the op reads data/compute vars (LR must be a pure
+                # function of persistable state)
+                reads_data = any(
+                    (v := gb._find_var_recursive(n)) is not None and v.is_data
+                    for n in op.input_arg_names)
+                if reads_data:
+                    continue
+                seen.add(id(op))
+                lr_ops.append(op)
+                needed.update(op.input_arg_names)
+                changed = True
+    # preserve original program order
+    order = {id(op): i for i, op in enumerate(gb.ops)}
+    lr_ops.sort(key=lambda op: order[id(op)])
+    return lr_ops
+
+
+class DistributeTranspiler:
+    """reference distribute_transpiler.py:239."""
+
+    def __init__(self, config=None):
+        self.config = config if config is not None \
+            else DistributeTranspilerConfig()
+
+    # ------------------------------------------------------------------
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None,
+                  current_endpoint=""):
+        if program is None:
+            program = default_main_program()
+        self.origin_program = program
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode and self.config.sync_mode
+
+        if self.config.mode in ("collective", "nccl2"):
+            self._transpile_collective(trainer_id, program, trainers,
+                                       startup_program)
+            return
+
+        self.pserver_endpoints = [e for e in pservers.split(",") if e]
+        self._transpile_pserver(trainer_id, program, startup_program,
+                                current_endpoint)
+
+    # ---- collective ("nccl2") mode -----------------------------------
+    def _transpile_collective(self, trainer_id, program, trainers,
+                              startup_program):
+        """reference _transpile_nccl2 (distribute_transpiler.py:212): the
+        only graph change is bootstrap — gen_collective_id lowers to
+        jax.distributed.initialize (SURVEY §2.3 TPU row); gradient reduction
+        itself comes from running under a global mesh."""
+        if startup_program is not None:
+            gb = startup_program.global_block()
+            gb.create_var(name="CollectiveId", shape=(1,), dtype="int64",
+                          persistable=True)
+            gb.append_op(
+                type="gen_collective_id",
+                inputs={}, outputs={"Out": ["CollectiveId"]},
+                attrs={"trainer_id": trainer_id,
+                       "num_trainers": trainers,
+                       RPC_OP_ROLE_ATTR: RPC_OP_ROLE_VALUE},
+                infer_shape=False)
+        program._num_trainers = trainers
+        program._trainer_id = trainer_id
+        self.trainer_program = program
+
+    # ---- pserver mode -------------------------------------------------
+    def _transpile_pserver(self, trainer_id, program, startup_program,
+                           current_endpoint):
+        eps = self.pserver_endpoints
+        opt_infos = _find_optimize_ops(program)
+        if not opt_infos:
+            raise ValueError("no optimizer ops found; call minimize() "
+                             "before transpile()")
+        self.param_grad_ep_mapping = {ep: {"params": [], "grads": []}
+                                      for ep in eps}
+        self._opt_ops_by_param = {}
+        gb = program.global_block()
+
+        params, grads = [], []
+        for op, pname, gname in opt_infos:
+            params.append(gb.var(pname))
+            grads.append(gb._find_var_recursive(gname))
+            self._opt_ops_by_param[pname] = op
+        self._lr_ops = _find_lr_ops(program, opt_infos)
+        self._lr_op_uids = {op.uid for op in self._lr_ops}
+
+        # endpoint placement (whole-var granularity; slice metadata is
+        # published for API parity and used by the rpc layer for striping)
+        dispatcher = self.config.split_method(eps)
+        slice_count = len(eps) if self.config.slice_var_up else 1
+        grad_blocks = slice_variable(grads, slice_count,
+                                     self.config.min_block_size)
+        param_blocks = slice_variable(params, slice_count,
+                                      self.config.min_block_size)
+        self.grad_blocks = [b for bs in grad_blocks for b in bs]
+        self.param_blocks = [b for bs in param_blocks for b in bs]
+
+        self._ep_by_param = {}
+        eplist = dispatcher.dispatch(
+            [bs[0] for bs in param_blocks])  # one ep per var (first block)
+        for (p, g, ep) in zip(params, grads, eplist):
+            self._ep_by_param[p.name] = ep
+            self.param_grad_ep_mapping[ep]["params"].append(p)
+            self.param_grad_ep_mapping[ep]["grads"].append(g)
+
+        # ---- trainer program: strip optimizer (+ its support ops), insert
+        # send/barriers/recv
+        self.trainer_program = self._build_trainer_program(program)
+        if startup_program is not None:
+            self.startup_program = startup_program
+
+    def _build_trainer_program(self, program):
+        t = Program.parse_from_string(program.serialize_to_string())
+        t.random_seed = program.random_seed
+        gb = t.global_block()
+        opt_ops = [op for op in gb.ops if op.type in OPTIMIZER_OP_TYPES]
+        opt_outputs = set()
+        for op in opt_ops:
+            opt_outputs.update(op.output_arg_names)
+            opt_outputs.update(op.input("Param"))
+        keep = []
+        lr_uids = getattr(self, "_lr_op_uids", set())
+        for op in gb.ops:
+            if op.type in OPTIMIZER_OP_TYPES:
+                continue
+            if op.uid in lr_uids:  # LR schedule runs on the pserver
+                continue
+            if _is_lr_or_opt_support_op(op, opt_outputs):
+                continue
+            keep.append(op)
+        gb.ops = keep
+
+        eps = self.pserver_endpoints
+        epmap = self._ep_by_param
+        # send each grad to its endpoint
+        send_inputs = []
+        send_eps = []
+        for pname, ep in epmap.items():
+            op = self._opt_ops_by_param[pname]
+            gname = op.input("Grad")[0]
+            send_inputs.append(gname)
+            send_eps.append(ep)
+        gb.append_op(
+            type="send", inputs={"X": send_inputs}, outputs={},
+            attrs={"epmap": send_eps, "endpoints": eps,
+                   "sync_mode": self.sync_mode,
+                   "trainer_id": self.trainer_id,
+                   RPC_OP_ROLE_ATTR: RPC_OP_ROLE_VALUE},
+            infer_shape=False)
+        if self.sync_mode:
+            gb.append_op(
+                type="send_barrier", inputs={}, outputs={},
+                attrs={"endpoints": eps, "trainer_id": self.trainer_id,
+                       RPC_OP_ROLE_ATTR: RPC_OP_ROLE_VALUE},
+                infer_shape=False)
+        # recv updated params back
+        recv_outputs = list(epmap.keys())
+        gb.append_op(
+            type="recv", inputs={},
+            outputs={"Out": recv_outputs},
+            attrs={"epmap": [epmap[p] for p in recv_outputs],
+                   "endpoints": eps, "trainer_id": self.trainer_id,
+                   RPC_OP_ROLE_ATTR: RPC_OP_ROLE_VALUE},
+            infer_shape=False)
+        gb.append_op(
+            type="fetch_barrier", inputs={}, outputs={},
+            attrs={"endpoints": eps, "trainer_id": self.trainer_id,
+                   RPC_OP_ROLE_ATTR: RPC_OP_ROLE_VALUE},
+            infer_shape=False)
+        return t
+
+    def get_trainer_program(self):
+        """reference distribute_transpiler.py:473."""
+        return self.trainer_program
+
+    # ------------------------------------------------------------------
+    def get_pserver_program(self, endpoint):
+        """reference distribute_transpiler.py:592: per-endpoint program whose
+        root block holds listen_and_serv; each assigned param's optimizer op
+        lives in its own sub-block run on grad arrival."""
+        assigned = self.param_grad_ep_mapping[endpoint]["params"]
+        pserver_program = Program()
+        pgb = pserver_program.global_block()
+
+        origin_gb = self.origin_program.global_block()
+
+        # LR-schedule block: runs ONCE per global step, before the param
+        # optimize blocks (the reference's lr_decay block in
+        # listen_and_serv)
+        lr_block_id = -1
+        if getattr(self, "_lr_ops", None):
+            with pserver_program._block_guard() as lr_blk:
+                for op in self._lr_ops:
+                    for name in (op.input_arg_names + op.output_arg_names):
+                        src = origin_gb._find_var_recursive(name)
+                        if src is not None and name not in pgb.vars:
+                            pgb.create_var(name=name, shape=src.shape,
+                                           dtype=src.dtype, persistable=True)
+                    new_op = lr_blk.append_op(
+                        type=op.type, inputs=dict(op.inputs),
+                        outputs=dict(op.outputs), attrs=dict(op.attrs),
+                        infer_shape=False)
+                    new_op.uid = op.uid
+                    pserver_program._op_uid = max(
+                        pserver_program._op_uid, op.uid)
+                lr_block_id = lr_blk.idx
+
+        opt_block_ids = []
+        param_names = []
+        for p in assigned:
+            opt_op = self._opt_ops_by_param[p.name]
+            # recreate vars referenced by the optimizer op in the root block
+            with pserver_program._block_guard() as blk:
+                for name in (opt_op.input_arg_names +
+                             opt_op.output_arg_names):
+                    src = origin_gb._find_var_recursive(name)
+                    if src is None:
+                        continue
+                    pgb.create_var(
+                        name=name, shape=src.shape, dtype=src.dtype,
+                        persistable=True)
+                blk.append_op(type=opt_op.type, inputs=dict(opt_op.inputs),
+                              outputs=dict(opt_op.outputs),
+                              attrs=dict(opt_op.attrs), infer_shape=False)
+                opt_block_ids.append(blk.idx)
+                param_names.append(p.name)
+
+        pgb.append_op(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint,
+                   "optimize_blocks": opt_block_ids,
+                   "lr_decay_block_id": lr_block_id,
+                   "param_names": param_names,
+                   "grad_names": [
+                       self._opt_ops_by_param[p].input("Grad")[0]
+                       for p in param_names],
+                   "Fanin": self.trainer_num,
+                   "sync_mode": self.sync_mode,
+                   RPC_OP_ROLE_ATTR: RPC_OP_ROLE_VALUE},
+            infer_shape=False)
+        return pserver_program
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        """Startup program creating + initializing this endpoint's params
+        (reference distribute_transpiler.py get_startup_program)."""
+        if startup_program is None:
+            from ..framework import default_startup_program
+            startup_program = default_startup_program()
+        assigned = {p.name for p in
+                    self.param_grad_ep_mapping[endpoint]["params"]}
+        # also bring optimizer state (moments etc.) for assigned params and
+        # the LR-schedule chain's vars (counter init etc.)
+        aux = set()
+        for pname in assigned:
+            op = self._opt_ops_by_param[pname]
+            for n in op.input_arg_names + op.output_arg_names:
+                aux.add(n)
+        for op in getattr(self, "_lr_ops", []):
+            aux.update(op.input_arg_names)
+            aux.update(op.output_arg_names)
+        s = Program()
+        s.random_seed = startup_program.random_seed
+        sgb = s.global_block()
+        src_gb = startup_program.global_block()
+        for op in src_gb.ops:
+            outs = set(op.output_arg_names)
+            if not outs & (assigned | aux):
+                continue
+            for name in op.output_arg_names + op.input_arg_names:
+                v = src_gb._find_var_recursive(name)
+                if v is not None and name not in sgb.vars:
+                    sgb.create_var(name=name, shape=v.shape, dtype=v.dtype,
+                                   persistable=True)
+            new_op = sgb.append_op(type=op.type, inputs=dict(op.inputs),
+                                   outputs=dict(op.outputs),
+                                   attrs=dict(op.attrs), infer_shape=False)
+            # keep the source op's uid so random initializers draw the SAME
+            # values the trainers drew (per-op rng folds in op.uid) — the
+            # reference guaranteed this because pservers ran the original
+            # OpDescs; advance the counter so later appends can't collide
+            new_op.uid = op.uid
+            s._op_uid = max(s._op_uid, op.uid)
+        return s

@@ -1,0 +1,123 @@
+"""Inference-time graph rewrites.
+
+Reference analogue: transpiler/inference_transpiler.py (:24) — folds
+batch_norm into the preceding conv2d/fc (fuse_batch_norm), removes dropout,
+and flips is_test attrs, so the saved inference program runs the fused math.
+
+On TPU, XLA would fuse the scale/shift into the conv epilogue anyway, but
+folding *removes the BN statistics reads entirely* and shrinks the program,
+so the rewrite is still real work — it rewrites conv weights/bias using the
+frozen BN statistics at transpile time (constant folding into parameters).
+"""
+
+import numpy as np
+
+__all__ = ["InferenceTranspiler"]
+
+
+class InferenceTranspiler:
+    def transpile(self, program, place=None, scope=None):
+        """Apply inference rewrites in place (reference :24)."""
+        if scope is None:
+            from ..executor import global_scope
+            scope = global_scope()
+        self._remove_dropout(program)
+        self._fuse_batch_norm(program, scope)
+        self._set_is_test(program)
+        return program
+
+    # ------------------------------------------------------------------
+    def _set_is_test(self, program):
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type in ("dropout", "batch_norm", "lrn"):
+                    op.attrs["is_test"] = True
+
+    def _remove_dropout(self, program):
+        """dropout(is_test) is identity (upscale_in_train) or a fixed scale;
+        replace with scale op to keep downstream names intact."""
+        for block in program.blocks:
+            new_ops = []
+            for op in block.ops:
+                if op.type != "dropout":
+                    new_ops.append(op)
+                    continue
+                impl = op.attrs.get("dropout_implementation",
+                                    "downgrade_in_infer")
+                scale = 1.0 if impl == "upscale_in_train" else \
+                    1.0 - float(op.attrs.get("dropout_prob", 0.5))
+                sop = block.program  # keep handle for clarity
+                del sop
+                from ..framework import Operator
+                new_ops.append(Operator(
+                    block, "scale",
+                    inputs={"X": op.input("X")},
+                    outputs={"Out": op.output("Out")},
+                    attrs={"scale": scale, "bias": 0.0,
+                           "bias_after_scale": True}))
+            block.ops = new_ops
+
+    def _fuse_batch_norm(self, program, scope):
+        """conv2d (no act) -> batch_norm  ==>  conv2d with folded W', b'.
+
+        W' = W * gamma / sqrt(var + eps)   (per output channel)
+        b' = (b - mean) * gamma / sqrt(var + eps) + beta
+        """
+        for block in program.blocks:
+            producers = {}
+            for op in block.ops:
+                for name in op.output_arg_names:
+                    producers[name] = op
+            old_ops = list(block.ops)
+            result = []
+            for op in old_ops:
+                if op.type == "batch_norm":
+                    x = op.input("X")[0]
+                    prev = producers.get(x)
+                    if prev is not None and prev.type == "conv2d" and \
+                            self._only_consumer(old_ops, x, op):
+                        replacement = self._fold(block, scope, prev, op)
+                        if replacement is not None:
+                            result.append(replacement)
+                            continue
+                result.append(op)
+            block.ops = result
+
+    def _only_consumer(self, ops, name, consumer):
+        uses = 0
+        for op in ops:
+            if name in op.input_arg_names:
+                uses += 1
+        return uses == 1
+
+    def _fold(self, block, scope, conv_op, bn_op):
+        w_name = conv_op.input("Filter")[0]
+        w = scope.get(w_name)
+        scale = scope.get(bn_op.input("Scale")[0])
+        bias = scope.get(bn_op.input("Bias")[0])
+        mean = scope.get(bn_op.input("Mean")[0])
+        var = scope.get(bn_op.input("Variance")[0])
+        if any(v is None for v in (w, scale, bias, mean, var)):
+            return None
+        import jax.numpy as jnp
+        eps = float(bn_op.attrs.get("epsilon", 1e-5))
+        w = jnp.asarray(w)
+        inv_std = jnp.asarray(scale) / jnp.sqrt(jnp.asarray(var) + eps)
+        # conv filter layout OIHW: fold per output channel O
+        scope.set(w_name, w * inv_std.reshape(-1, 1, 1, 1))
+        new_bias = jnp.asarray(bias) - jnp.asarray(mean) * inv_std
+        bias_name = w_name + "@bn_folded_bias"
+        bias_var = block.create_var(
+            name=bias_name, shape=tuple(new_bias.shape), dtype="float32",
+            persistable=True)
+        bias_var.persistable = True
+        scope.set(bias_name, new_bias)
+        # BN becomes a per-channel bias add on the conv's raw output
+        from ..framework import Operator
+        conv_out = conv_op.output("Output")[0]
+        bn_out = bn_op.output("Y")[0]
+        return Operator(
+            block, "elementwise_add",
+            inputs={"X": [conv_out], "Y": [bias_name]},
+            outputs={"Out": [bn_out]},
+            attrs={"axis": 1})

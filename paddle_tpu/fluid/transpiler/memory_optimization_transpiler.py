@@ -1,0 +1,133 @@
+"""Liveness-based variable reuse planning.
+
+Reference analogue: transpiler/memory_optimization_transpiler.py —
+`ControlFlowGraph` (:112) computes per-op live-in/live-out sets by iterating
+dataflow equations, `memory_optimize` (:456) renames dead vars to reuse their
+buffers, `release_memory` (:494) inserts delete ops.
+
+TPU redesign: XLA's buffer assignment already performs in-place reuse inside
+a compiled step, so rewriting names buys nothing at runtime. The transpiler
+keeps the analysis (it feeds the debugger/memory estimator and preserves the
+public API): it computes liveness over the Program, returns the reuse plan,
+and records it on the program as `_memory_reuse_plan`. `release_memory`
+marks non-persistable fetch-dead vars so the eager host path can drop them
+early (the reference's eager-deletion GC, executor.cc:392)."""
+
+from collections import defaultdict
+
+__all__ = ["memory_optimize", "release_memory", "ControlFlowGraph"]
+
+_SKIP_OPS = frozenset(["feed", "fetch", "while", "conditional_block",
+                       "recurrent"])
+
+
+class ControlFlowGraph:
+    """Straight-line liveness over one block (reference :112). Successor of
+    op i is op i+1 — control-flow sub-blocks are analyzed independently."""
+
+    def __init__(self, block, skip_names=()):
+        self.block = block
+        self.skip = set(skip_names)
+        self.uses = []     # per op: vars read
+        self.defs = []     # per op: vars written
+        self.live_in = []
+        self.live_out = []
+        for op in block.ops:
+            self.uses.append(set(op.input_arg_names) - self.skip)
+            self.defs.append(set(op.output_arg_names) - self.skip)
+            self.live_in.append(set())
+            self.live_out.append(set())
+
+    def analyze(self):
+        n = len(self.block.ops)
+        changed = True
+        while changed:
+            changed = False
+            for i in reversed(range(n)):
+                out = set(self.live_in[i + 1]) if i + 1 < n else set()
+                inn = self.uses[i] | (out - self.defs[i])
+                if out != self.live_out[i] or inn != self.live_in[i]:
+                    self.live_out[i] = out
+                    self.live_in[i] = inn
+                    changed = True
+        return self
+
+    def dead_after(self, i):
+        """Vars whose last use is op i (not live after it)."""
+        return (self.uses[i] | self.defs[i]) - self.live_out[i]
+
+
+def _reusable(var):
+    if var is None:
+        return False
+    if var.persistable or var.is_data:
+        return False
+    if var.shape is None or any(d is None or int(d) < 0
+                                for d in var.shape):
+        return False
+    return True
+
+
+def _nbytes(var):
+    n = 1
+    for d in var.shape:
+        n *= int(d)
+    return n * var.np_dtype.itemsize
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0):
+    """Compute the buffer-reuse plan (reference :456). Returns a list of
+    (new_var, reused_var) pairs and stamps `_memory_reuse_plan` on the
+    program. The XLA executor treats the plan as advisory."""
+    skip = set(skip_opt_set or ())
+    plan = []
+    for block in input_program.blocks:
+        cfg = ControlFlowGraph(block, skip).analyze()
+        free_pool = []  # (nbytes, name) of dead buffers
+        mapped = set()
+        for i, op in enumerate(block.ops):
+            if op.type in _SKIP_OPS:
+                continue
+            for out_name in op.output_arg_names:
+                if out_name in skip or out_name in mapped:
+                    continue
+                var = block._find_var_recursive(out_name)
+                if not _reusable(var):
+                    continue
+                want = _nbytes(var)
+                for j, (sz, cand) in enumerate(free_pool):
+                    cv = block._find_var_recursive(cand)
+                    if cv is not None and sz == want and \
+                            cv.np_dtype == var.np_dtype:
+                        plan.append((out_name, cand))
+                        mapped.add(out_name)
+                        free_pool.pop(j)
+                        break
+            for dead in cfg.dead_after(i):
+                var = block._find_var_recursive(dead)
+                if _reusable(var) and dead not in mapped:
+                    free_pool.append((_nbytes(var), dead))
+        if print_log:
+            for new, old in plan:
+                print("memory_optimize: reuse %s <- %s" % (new, old))
+    input_program._memory_reuse_plan = plan
+    return plan
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Mark early-droppable vars (reference :494). Stamps
+    `_early_delete_vars`: op index -> [var names dead after it]."""
+    skip = set(skip_opt_set or ())
+    drop = defaultdict(list)
+    for block in input_program.blocks:
+        cfg = ControlFlowGraph(block, skip).analyze()
+        for i, op in enumerate(block.ops):
+            if op.type in _SKIP_OPS:
+                continue
+            for dead in cfg.dead_after(i):
+                var = block._find_var_recursive(dead)
+                if _reusable(var):
+                    drop[(block.idx, i)].append(dead)
+    input_program._early_delete_vars = dict(drop)
+    return input_program._early_delete_vars

@@ -1,0 +1,9 @@
+from .predictor import (
+    NativeConfig, AnalysisConfig, PaddleTensor, Predictor,
+    create_paddle_predictor,
+)
+
+__all__ = [
+    "NativeConfig", "AnalysisConfig", "PaddleTensor", "Predictor",
+    "create_paddle_predictor",
+]

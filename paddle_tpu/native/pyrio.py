@@ -1,0 +1,78 @@
+"""Pure-Python recordio fallback (same file format as native/recordio.cc).
+Used only when the C++ library cannot be built."""
+
+import struct
+import zlib
+
+_MAGIC = b"PTRIO001"
+_HDR = struct.Struct("<IIII")
+
+
+def _crc(payload):
+    return zlib.crc32(payload) & 0xFFFFFFFF
+
+
+class PyWriter:
+    def __init__(self, path, max_chunk_records=1000,
+                 max_chunk_bytes=32 << 20):
+        self._f = open(path, "wb")
+        self._f.write(_MAGIC)
+        self._pending = []
+        self._pending_bytes = 0
+        self._max_records = max_chunk_records
+        self._max_bytes = max_chunk_bytes
+
+    def write(self, record):
+        self._pending.append(record)
+        self._pending_bytes += len(record)
+        if len(self._pending) >= self._max_records or \
+                self._pending_bytes >= self._max_bytes:
+            self._flush()
+
+    def _flush(self):
+        if not self._pending:
+            return
+        payload = b"".join(self._pending)
+        self._f.write(_HDR.pack(len(self._pending), len(payload),
+                                _crc(payload), 0))
+        self._f.write(struct.pack("<%dI" % len(self._pending),
+                                  *[len(r) for r in self._pending]))
+        self._f.write(payload)
+        self._pending = []
+        self._pending_bytes = 0
+
+    def close(self):
+        self._flush()
+        self._f.close()
+
+
+class PyScanner:
+    def __init__(self, path):
+        self._f = open(path, "rb")
+        if self._f.read(8) != _MAGIC:
+            raise IOError("bad recordio magic in %s" % path)
+        self._chunk = []
+        self._idx = 0
+
+    def next(self):
+        if self._idx >= len(self._chunk):
+            hdr = self._f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                raise StopIteration
+            n, payload_len, crc, _ = _HDR.unpack(hdr)
+            lens = struct.unpack("<%dI" % n, self._f.read(4 * n))
+            payload = self._f.read(payload_len)
+            if _crc(payload) != crc:
+                raise IOError("recordio crc mismatch")
+            self._chunk = []
+            off = 0
+            for ln in lens:
+                self._chunk.append(payload[off:off + ln])
+                off += ln
+            self._idx = 0
+        rec = self._chunk[self._idx]
+        self._idx += 1
+        return rec
+
+    def close(self):
+        self._f.close()

@@ -1,0 +1,158 @@
+"""Distributed (host-side RPC) ops.
+
+Reference analogue: paddle/fluid/operators/distributed_ops/ — send_op,
+recv_op, send_barrier_op, fetch_barrier_op, listen_and_serv_op
+(listen_and_serv_op.cc:106 RunSyncLoop, :216 RunAsyncLoop, :318 RunImpl),
+gen_nccl_id_op (gen_nccl_id_op.cc:31), checkpoint_notify_op.
+
+These are HOST ops: they do socket IO / process bootstrap, so they never
+appear inside a jitted computation. The executor detects them
+(functionalizer.HOST_OPS) and runs the containing block eagerly; the dense
+collective path (XLA psum over ICI) never produces these ops.
+
+gen_collective_id is the gen_nccl_id analogue: NCCL's out-of-band unique-id
+broadcast (ncclGetUniqueId + ephemeral RPC, gen_nccl_id_op.cc:59,:84) maps
+to jax.distributed.initialize(coordinator, num_processes, process_id) which
+performs the same rendezvous for the XLA collective runtime.
+"""
+
+import numpy as np
+
+from .registry import register_op
+
+__all__ = []
+
+
+def _client():
+    from ..distributed.rpc import global_client
+    return global_client()
+
+
+@register_op("send")
+def _send(ctx):
+    """send_op: push grads to their endpoints (rpc_client.h AsyncSendVar)."""
+    names = ctx.op.input("X")
+    epmap = ctx.attr("epmap", [])
+    c = _client()
+    for (name, ep), val in zip(zip(names, epmap), ctx.inputs("X")):
+        if val is not None:
+            c.async_send_var(ep, name, np.asarray(val))
+    return {}
+
+
+@register_op("send_barrier")
+def _send_barrier(ctx):
+    c = _client()
+    for ep in ctx.attr("endpoints", []):
+        c.async_send_barrier(ep)
+    return {}
+
+
+@register_op("recv")
+def _recv(ctx):
+    names = ctx.op.output("Out")
+    epmap = ctx.attr("epmap", [])
+    c = _client()
+    out = []
+    for name, ep in zip(names, epmap):
+        out.append(c.async_get_var(ep, name))
+    return {"Out": out}
+
+
+@register_op("fetch_barrier")
+def _fetch_barrier(ctx):
+    c = _client()
+    for ep in ctx.attr("endpoints", []):
+        c.async_fetch_barrier(ep)
+    return {}
+
+
+@register_op("checkpoint_notify")
+def _checkpoint_notify(ctx):
+    c = _client()
+    dirname = ctx.attr("dir", ctx.attr("dirname", "checkpoint"))
+    for ep in ctx.attr("epmap", ctx.attr("endpoints", [])):
+        c.checkpoint_notify(ep, dirname)
+    return {}
+
+
+@register_op("gen_collective_id")
+def _gen_collective_id(ctx):
+    """Multi-host collective bootstrap. With PADDLE_COORDINATOR set (or the
+    standard JAX env), calls jax.distributed.initialize so all hosts join one
+    XLA collective world; single-process runs are a no-op."""
+    import os
+    coordinator = os.environ.get("PADDLE_COORDINATOR")
+    num = int(ctx.attr("num_trainers", 1) or 1)
+    tid = int(ctx.attr("trainer_id", 0) or 0)
+    if coordinator and num > 1:
+        import jax
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num, process_id=tid)
+        except RuntimeError:
+            pass  # already initialized
+    return {"Out": np.asarray([tid], np.int64)}
+
+
+@register_op("listen_and_serv")
+def _listen_and_serv(ctx):
+    """Pserver event loop (listen_and_serv_op.cc:318 RunImpl). Blocks the
+    executor, serving Send/Get/Barrier/Checkpoint until an exit message.
+
+    The optimize sub-blocks run against the server's store through the same
+    trace-time interpreter used for everything else — eagerly, on host."""
+    from ..distributed.rpc import VariableServer
+    from ..fluid import functionalizer
+
+    op = ctx.op
+    program = op.block.program
+    endpoint = ctx.attr("endpoint")
+    fanin = int(ctx.attr("Fanin", 1) or 1)
+    sync_mode = bool(ctx.attr("sync_mode", True))
+    param_names = list(ctx.attr("param_names", []))
+    grad_names = list(ctx.attr("grad_names", []))
+    block_ids = list(ctx.attr("optimize_blocks", []))
+    block_by_param = {p: program.blocks[b]
+                      for p, b in zip(param_names, block_ids)}
+    grad_to_param = dict(zip(grad_names, param_names))
+    lr_block_id = int(ctx.attr("lr_decay_block_id", -1))
+
+    def optimize_fn(pname, gname, avg_grad, store):
+        blk = block_by_param.get(pname)
+        if blk is None:
+            return
+        env = dict(store)
+        env[gname] = avg_grad
+        functionalizer.run_block(blk, env)
+        for k, v in env.items():
+            store[k] = np.asarray(v)
+
+    def pre_apply_fn(store):
+        # LR schedule: once per global step (reference lr_decay block)
+        if lr_block_id < 0:
+            return
+        env = dict(store)
+        functionalizer.run_block(program.blocks[lr_block_id], env)
+        for k, v in env.items():
+            store[k] = np.asarray(v)
+
+    server = VariableServer(endpoint, fanin=fanin, sync_mode=sync_mode,
+                            optimize_fn=optimize_fn,
+                            grad_to_param=grad_to_param,
+                            pre_apply_fn=pre_apply_fn)
+    # seed the store with every value the surrounding env already has
+    # (params + optimizer state + @LR_DECAY_COUNTER@ created by the pserver
+    # startup program); only the @LOD_LEN companion entries are internal
+    from ..fluid.functionalizer import LOD_LEN_SUFFIX
+    if ctx.env is not None:
+        for k, v in list(ctx.env.items()):
+            if v is not None and not k.endswith(LOD_LEN_SUFFIX):
+                server.store[k] = np.asarray(v)
+    server.start(background=False)  # blocks until exit
+    # propagate final values back so save_persistables sees trained params
+    if ctx.env is not None:
+        for k, v in server.store.items():
+            ctx.env[k] = v
+    return {}

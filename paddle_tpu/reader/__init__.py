@@ -1,0 +1,35 @@
+"""Reader composition toolkit (reference python/paddle/reader/).
+
+A *reader creator* is a zero-arg callable returning an iterator over
+samples; these decorators compose reader creators functionally —
+map_readers/shuffle/chain/compose/buffered/firstn (decorator.py:36-:230)
+plus the multithreaded xmap_readers and the batching wrapper
+(python/paddle/batch.py).
+"""
+
+from .decorator import (
+    map_readers, buffered, compose, chain, shuffle, firstn, xmap_readers,
+    cache, ComposeNotAligned,
+)
+
+__all__ = [
+    "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
+    "xmap_readers", "cache", "batch", "ComposeNotAligned",
+]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of `batch_size` (reference
+    python/paddle/batch.py)."""
+
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,204 @@
+"""Reader decorators (reference python/paddle/reader/decorator.py)."""
+
+import itertools
+import queue
+import random
+import threading
+
+__all__ = [
+    "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
+    "xmap_readers", "cache", "ComposeNotAligned",
+]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def map_readers(func, *readers):
+    """Apply func to the items of several readers zipped together
+    (reference decorator.py:36)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (reference decorator.py:60)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers (reference decorator.py:88)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for e in itertools.chain(*rs):
+            yield e
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into tuple samples (reference decorator.py:118);
+    check_alignment raises ComposeNotAligned on length mismatch."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch buffer (reference decorator.py:180)."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in r:
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                return
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """First n samples (reference decorator.py:230)."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                return
+            yield item
+
+    return firstn_reader
+
+
+def cache(reader):
+    """Materialize once, replay from memory."""
+    all_data = []
+    filled = []
+
+    def cache_reader():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        for d in all_data:
+            yield d
+
+    return cache_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads (reference
+    decorator.py xmap_readers). Order-preserving mode tags samples with
+    sequence ids and reorders on the output side."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _End:
+                    out_q.put(_End)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if not order:
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                yield item[1]
+        else:
+            next_id = 0
+            held = {}
+            while finished < process_num or held:
+                if next_id in held:
+                    yield held.pop(next_id)
+                    next_id += 1
+                    continue
+                if finished >= process_num:
+                    # drain remaining out-of-order items
+                    if not held:
+                        break
+                    continue
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                i, mapped = item
+                if i == next_id:
+                    yield mapped
+                    next_id += 1
+                else:
+                    held[i] = mapped
+
+    return data_reader

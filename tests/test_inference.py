@@ -1,0 +1,111 @@
+"""Inference tests: save_inference_model -> load -> Predictor parity.
+
+Mirrors the reference's book tests (train -> save_inference_model -> C++
+predictor round-trip, python/paddle/fluid/tests/book/) and the
+NativePaddlePredictor/AnalysisPredictor API (inference/api/api_impl.cc:95,
+analysis_predictor.cc).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.inference import (
+    NativeConfig, AnalysisConfig, PaddleTensor, create_paddle_predictor)
+
+
+@pytest.fixture
+def trained_model(tmp_path):
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 5
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 8, 8],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                   padding=1, act=None, bias_attr=False)
+        bn = fluid.layers.batch_norm(input=conv, act="relu")
+        pool = fluid.layers.pool2d(input=bn, pool_size=2, pool_stride=2)
+        pred = fluid.layers.fc(input=pool, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={
+                "img": rng.randn(8, 1, 8, 8).astype(np.float32),
+                "label": rng.randint(0, 10, (8, 1)).astype(np.int64)},
+                fetch_list=[loss])
+        model_dir = str(tmp_path / "model")
+        fluid.save_inference_model(model_dir, ["img"], [pred], exe,
+                                   main_program=main)
+        # reference output for parity checks
+        x = rng.randn(4, 1, 8, 8).astype(np.float32)
+        infer_prog = main.clone(for_test=True)._prune(["img"], [pred.name])
+        ref, = exe.run(infer_prog, feed={"img": x},
+                       fetch_list=[pred.name])
+    return model_dir, x, ref
+
+
+def test_load_inference_model_roundtrip(trained_model):
+    model_dir, x, ref = trained_model
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        program, feed_names, fetch_vars = fluid.load_inference_model(
+            model_dir, exe)
+        assert feed_names == ["img"]
+        got, = exe.run(program, feed={"img": x},
+                       fetch_list=[fetch_vars[0].name])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_native_predictor(trained_model):
+    model_dir, x, ref = trained_model
+    pred = create_paddle_predictor(NativeConfig(model_dir=model_dir))
+    out, = pred.run({"img": x})
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_native_predictor_positional_tensors(trained_model):
+    model_dir, x, ref = trained_model
+    pred = create_paddle_predictor(NativeConfig(model_dir=model_dir))
+    out, = pred.Run([PaddleTensor(x)])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_analysis_predictor_folds_bn(trained_model):
+    model_dir, x, ref = trained_model
+    pred = create_paddle_predictor(AnalysisConfig(model_dir=model_dir))
+    types = [op.type for op in pred._program.global_block().ops]
+    assert "batch_norm" not in types, "analysis pass should fold BN"
+    out, = pred.run({"img": x})
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=1e-5)
+
+
+def test_analysis_predictor_batch_bucketing(trained_model):
+    model_dir, x, ref = trained_model
+    pred = create_paddle_predictor(AnalysisConfig(model_dir=model_dir))
+    out3, = pred.run({"img": x[:3]})  # batch 3 pads to bucket 4
+    assert out3.shape[0] == 3
+    np.testing.assert_allclose(out3, ref[:3], rtol=2e-4, atol=1e-5)
+    # batch 4 lands in the same bucket as padded batch 3 -> no new compile
+    n_compiled = len(pred._compiled)
+    out4, = pred.run({"img": x})
+    assert out4.shape[0] == 4
+    assert len(pred._compiled) == n_compiled
+
+
+def test_predictor_clone(trained_model):
+    model_dir, x, ref = trained_model
+    pred = create_paddle_predictor(NativeConfig(model_dir=model_dir))
+    clone = pred.clone()
+    out, = clone.run({"img": x})
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)

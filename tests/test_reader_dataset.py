@@ -1,0 +1,158 @@
+"""Reader decorators + dataset package + py_reader pipeline tests.
+
+Mirrors reference python/paddle/reader/tests/decorator_test.py and
+dataset tests; the py_reader end-to-end mirrors
+test_py_reader_using_executor.py (reader feeds a training loop)."""
+
+import numpy as np
+
+import paddle_tpu.reader as rd
+import paddle_tpu.dataset as dataset
+import paddle_tpu.fluid as fluid
+
+
+def _counter(n):
+    def reader():
+        for i in range(n):
+            yield i
+
+    return reader
+
+
+class TestDecorators:
+    def test_map_readers(self):
+        got = list(rd.map_readers(lambda x, y: x + y,
+                                  _counter(3), _counter(3))())
+        assert got == [0, 2, 4]
+
+    def test_shuffle_preserves_multiset(self):
+        got = list(rd.shuffle(_counter(10), 4)())
+        assert sorted(got) == list(range(10))
+
+    def test_chain(self):
+        got = list(rd.chain(_counter(2), _counter(3))())
+        assert got == [0, 1, 0, 1, 2]
+
+    def test_compose(self):
+        got = list(rd.compose(_counter(3), _counter(3))())
+        assert got == [(0, 0), (1, 1), (2, 2)]
+
+    def test_compose_not_aligned(self):
+        import pytest
+        with pytest.raises(rd.ComposeNotAligned):
+            list(rd.compose(_counter(2), _counter(3))())
+
+    def test_buffered(self):
+        got = list(rd.buffered(_counter(100), 7)())
+        assert got == list(range(100))
+
+    def test_firstn(self):
+        assert list(rd.firstn(_counter(100), 5)()) == [0, 1, 2, 3, 4]
+
+    def test_cache(self):
+        calls = []
+
+        def reader():
+            calls.append(1)
+            yield from range(3)
+
+        c = rd.cache(reader)
+        assert list(c()) == [0, 1, 2]
+        assert list(c()) == [0, 1, 2]
+        assert len(calls) == 1
+
+    def test_xmap_unordered(self):
+        got = sorted(rd.xmap_readers(lambda x: x * 2, _counter(50),
+                                     4, 8)())
+        assert got == [2 * i for i in range(50)]
+
+    def test_xmap_ordered(self):
+        got = list(rd.xmap_readers(lambda x: x * 2, _counter(50),
+                                   4, 8, order=True)())
+        assert got == [2 * i for i in range(50)]
+
+    def test_batch(self):
+        b = list(rd.batch(_counter(5), 2)())
+        assert b == [[0, 1], [2, 3], [4]]
+        b = list(rd.batch(_counter(5), 2, drop_last=True)())
+        assert b == [[0, 1], [2, 3]]
+
+
+class TestDatasets:
+    def test_mnist_shapes(self):
+        img, label = next(dataset.mnist.train()())
+        assert img.shape == (784,) and img.dtype == np.float32
+        assert 0 <= label < 10
+        assert img.min() >= -1.0 and img.max() <= 1.0
+
+    def test_mnist_deterministic(self):
+        a = [l for _, l in list(dataset.mnist.train()())[:20]]
+        b = [l for _, l in list(dataset.mnist.train()())[:20]]
+        assert a == b
+
+    def test_cifar(self):
+        img, label = next(dataset.cifar.train10()())
+        assert img.shape == (3072,)
+        assert 0 <= label < 10
+        _, l100 = next(dataset.cifar.train100()())
+        assert 0 <= l100 < 100
+
+    def test_uci_housing(self):
+        x, y = next(dataset.uci_housing.train()())
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imdb(self):
+        words, label = next(dataset.imdb.train()())
+        assert all(0 <= w < 5148 for w in words)
+        assert label in (0, 1)
+        assert len(dataset.imdb.word_dict()) == 5148
+
+    def test_wmt14(self):
+        src, trg_in, trg_out = next(dataset.wmt14.train(1000)())
+        assert trg_in[0] == dataset.wmt14.START
+        assert trg_out[-1] == dataset.wmt14.END
+        assert len(trg_in) == len(trg_out)
+
+    def test_movielens(self):
+        s = next(dataset.movielens.train()())
+        assert len(s) == 8
+        assert 1.0 <= s[-1] <= 5.0
+
+
+class TestPyReaderTraining:
+    def test_py_reader_trains(self):
+        main = fluid.Program()
+        startup = fluid.Program()
+        main.random_seed = 1
+        startup.random_seed = 1
+        with fluid.program_guard(main, startup):
+            reader = fluid.layers.py_reader(
+                capacity=4, shapes=[(-1, 13), (-1, 1)],
+                dtypes=["float32", "float32"], name="uci")
+            x, y = fluid.layers.read_file(reader)
+            pred = fluid.layers.fc(input=x, size=1, act=None)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+        batched = rd.batch(dataset.uci_housing.train(), 32)
+
+        def feeder():
+            for batch in batched():
+                xs = np.stack([s[0] for s in batch])
+                ys = np.stack([s[1] for s in batch])
+                yield xs, ys
+
+        reader.decorate_paddle_reader(feeder)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            losses = []
+            for _ in range(3):  # epochs
+                reader.start()
+                for feed in reader:
+                    lv, = exe.run(main, feed=feed, fetch_list=[loss])
+                    losses.append(float(lv))
+                reader.reset()
+        assert losses[-1] < losses[0]

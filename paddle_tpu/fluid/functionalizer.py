@@ -93,6 +93,8 @@ _LOD_DROP_OPS = frozenset([
     "sequence_pool", "sequence_first_step", "sequence_last_step",
     "sequence_mask", "mean", "reduce_sum", "reduce_mean", "reduce_max",
     "shape", "accuracy", "top_k",
+    "linear_chain_crf", "warpctc", "edit_distance", "chunk_eval", "auc",
+    "mean_iou", "precision_recall",
 ])
 
 

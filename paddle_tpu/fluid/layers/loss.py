@@ -1,0 +1,230 @@
+"""Structured losses + metric layers.
+
+Reference analogue: python/paddle/fluid/layers/nn.py entries linear_chain_crf,
+crf_decoding, warpctc, ctc_greedy_decoder, edit_distance, nce, hsigmoid,
+chunk_eval, mean_iou, multiplex, sampling_id, rank_loss. Op lowerings live in
+paddle_tpu/ops/loss_ops.py.
+"""
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..initializer import Constant
+from .. import core
+
+__all__ = [
+    "linear_chain_crf", "crf_decoding", "warpctc", "ctc_greedy_decoder",
+    "edit_distance", "nce", "hsigmoid", "chunk_eval", "mean_iou",
+    "multiplex", "sampling_id", "rank_loss",
+]
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """CRF negative log-likelihood (reference layers/nn.py linear_chain_crf;
+    kernel linear_chain_crf_op.h). Creates the Transition parameter of shape
+    [size + 2, size] (row0 start, row1 end)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    emission_exps = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    transition_exps = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    log_likelihood = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": input, "Transition": transition, "Label": label},
+        outputs={"Alpha": alpha, "EmissionExps": emission_exps,
+                 "TransitionExps": transition_exps,
+                 "LogLikelihood": log_likelihood})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decode using a trained Transition parameter
+    (reference crf_decoding_op.h)."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    transition = helper.get_parameter(helper.param_attr.name)
+    viterbi_path = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT64, stop_gradient=True)
+    inputs = {"Emission": input, "Transition": transition}
+    if label is not None:
+        inputs["Label"] = label
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": viterbi_path})
+    return viterbi_path
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss (reference warpctc_op.cc; here a pure XLA forward pass)."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="warpctc", inputs={"Logits": input, "Label": label},
+        outputs={"Loss": loss},
+        attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """argmax over classes then merge-repeats/strip-blank
+    (reference ctc_align_op.cc pipeline)."""
+    from .nn import argmax
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    topk_idx = argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT64, stop_gradient=True)
+    helper.append_op(type="ctc_align", inputs={"Input": topk_idx},
+                     outputs={"Output": out},
+                     attrs={"blank": blank, "merge_repeated": True})
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None):
+    """Levenshtein distance (reference edit_distance_op.h). Returns
+    (distance [B,1], seq_num [1])."""
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    seq_num = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT64, stop_gradient=True)
+    helper.append_op(type="edit_distance",
+                     inputs={"Hyps": input, "Refs": label},
+                     outputs={"Out": out, "SequenceNum": seq_num},
+                     attrs={"normalized": normalized,
+                            "ignored_tokens": list(ignored_tokens or [])})
+    return out, seq_num
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference nce_op.h)."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[-1]
+    num_neg_samples = 10 if num_neg_samples is None else int(num_neg_samples)
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(attr=helper.bias_attr,
+                                shape=[num_total_classes, 1],
+                                dtype=input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sample_logits = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    sample_labels = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT64, stop_gradient=True)
+    helper.append_op(
+        type="nce",
+        inputs={"Input": input, "Label": label, "Weight": w, "Bias": b},
+        outputs={"Cost": cost, "SampleLogits": sample_logits,
+                 "SampleLabels": sample_labels},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples, "seed": seed,
+               "sampler": {"uniform": 0, "log_uniform": 1,
+                           "custom_dist": 2}.get(sampler, 0)})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference hierarchical_sigmoid_op.h)."""
+    helper = LayerHelper("hsigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(attr=helper.bias_attr,
+                                shape=[num_classes - 1, 1],
+                                dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(
+        input.dtype, stop_gradient=True)
+    helper.append_op(
+        type="hierarchical_sigmoid",
+        inputs={"X": input, "W": w, "Bias": b, "Label": label},
+        outputs={"Out": out, "PreOut": pre_out},
+        attrs={"num_classes": num_classes})
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """Chunk-level precision/recall/F1 for sequence labeling
+    (reference chunk_eval_op.h). Returns the 6-tuple of metric tensors."""
+    helper = LayerHelper("chunk_eval")
+
+    def _mk(dtype="float32"):
+        return helper.create_variable_for_type_inference(
+            dtype, stop_gradient=True)
+
+    precision, recall, f1 = _mk(), _mk(), _mk()
+    num_infer = _mk(core.VarDesc.VarType.INT64)
+    num_label = _mk(core.VarDesc.VarType.INT64)
+    num_correct = _mk(core.VarDesc.VarType.INT64)
+    helper.append_op(
+        type="chunk_eval", inputs={"Inference": input, "Label": label},
+        outputs={"Precision": precision, "Recall": recall, "F1-Score": f1,
+                 "NumInferChunks": num_infer, "NumLabelChunks": num_label,
+                 "NumCorrectChunks": num_correct},
+        attrs={"num_chunk_types": num_chunk_types,
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1, num_infer, num_label, num_correct
+
+
+def mean_iou(input, label, num_classes):
+    """Mean IoU (reference mean_iou_op.h). Returns (miou, wrong, correct)."""
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    wrong = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT32, stop_gradient=True)
+    correct = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT32, stop_gradient=True)
+    helper.append_op(type="mean_iou",
+                     inputs={"Predictions": input, "Labels": label},
+                     outputs={"OutMeanIou": miou, "OutWrong": wrong,
+                              "OutCorrect": correct},
+                     attrs={"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def multiplex(inputs, index):
+    """Row-select among candidate tensors by per-row index
+    (reference multiplex_op.cc)."""
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"X": list(inputs), "Ids": index},
+                     outputs={"Out": out})
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    """Sample a class id per row from a probability matrix
+    (reference sampling_id_op.cc). `min`/`max`/`dtype` are accepted for
+    signature parity but have no effect on the categorical draw; `seed`
+    is folded into the per-op PRNG key."""
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT64, stop_gradient=True)
+    helper.append_op(type="sampling_id", inputs={"X": x},
+                     outputs={"Out": out}, attrs={"seed": seed})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (reference rank_loss_op.h)."""
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(type="rank_loss",
+                     inputs={"Label": label, "Left": left, "Right": right},
+                     outputs={"Out": out})
+    return out

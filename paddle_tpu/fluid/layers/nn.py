@@ -435,8 +435,34 @@ def accuracy(input, label, k=1, correct=None, total=None):
 
 
 def auc(input, label, curve="ROC", num_thresholds=200, topk=1):
-    # streaming AUC lives in fluid.metrics; in-graph op returns batch AUC
-    raise NotImplementedError("auc op lands with the metrics milestone")
+    """Streaming in-graph AUC (reference metrics/auc_op.h). StatPos/StatNeg
+    are persistable state threaded through the op like batch_norm's moving
+    stats. Returns (auc_out, [stat_pos, stat_neg])."""
+    if topk != 1:
+        raise ValueError("auc: only topk=1 is supported (as in the "
+                         "reference kernel, metrics/auc_op.h)")
+    helper = LayerHelper("auc")
+    from .. import unique_name as _un
+    gb = helper.main_program.global_block()
+    stat_shape = [num_thresholds + 1]
+    stat_pos = gb.create_var(name=_un.generate("auc_stat_pos"),
+                             shape=stat_shape, dtype="float32",
+                             persistable=True, stop_gradient=True)
+    stat_neg = gb.create_var(name=_un.generate("auc_stat_neg"),
+                             shape=stat_shape, dtype="float32",
+                             persistable=True, stop_gradient=True)
+    helper.set_variable_initializer(stat_pos, Constant(0.0))
+    helper.set_variable_initializer(stat_neg, Constant(0.0))
+    auc_out = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    helper.append_op(
+        type="auc",
+        inputs={"Predict": input, "Label": label, "StatPos": stat_pos,
+                "StatNeg": stat_neg},
+        outputs={"AUC": auc_out, "StatPosOut": stat_pos,
+                 "StatNegOut": stat_neg},
+        attrs={"curve": curve, "num_thresholds": num_thresholds})
+    return auc_out, [stat_pos, stat_neg]
 
 
 def one_hot(input, depth):

@@ -12,6 +12,7 @@ from . import optimizer_ops  # noqa: F401
 from . import sequence_ops   # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import distributed_ops   # noqa: F401
+from . import loss_ops          # noqa: F401
 
 from .registry import (  # noqa: F401
     register_op, get_op_def, has_op, registered_ops, infer_shape, ExecContext,

@@ -1,0 +1,687 @@
+"""Structured-prediction losses and streaming metrics.
+
+Reference analogues: paddle/fluid/operators/linear_chain_crf_op.h (forward
+algorithm at :140 ForwardOneSequence, LogLikelihood = -(score - logZ), i.e.
+a cost), crf_decoding_op.h (:98 Viterbi backtrack; with Label given, output
+is 1 at correctly decoded positions, :62), warpctc_op.cc (CTC loss via
+dynloaded libwarpctc), ctc_align_op.cc (merge repeats, drop blank),
+edit_distance_op.h (Levenshtein DP), metrics/auc_op.h (threshold-bucketed
+streaming AUC), metrics/precision_recall_op.h, mean_iou_op.h,
+rank_loss_op.h, nce_op.h, hierarchical_sigmoid_op.h (MatrixBitCodeFunctor
+"SimpleCode": node id = label + num_classes, path = bits of the id),
+multiplex_op.cc, sampling_id_op.cc, chunk_eval_op.h.
+
+TPU-first notes: the reference dispatches CTC to a hand-written CUDA library
+(warpctc) and runs CRF/chunk_eval on CPU only; here every loss is a pure
+jnp/lax program — `lax.scan` over the padded time axis with per-sequence
+masks — so forward AND backward fuse into the surrounding XLA computation
+and gradients come from the registry's generic vjp, replacing warpctc's
+hand-written gradient kernel. Ragged inputs use the padded [B, T, ...] +
+lengths encoding from ops/sequence_ops.py.
+"""
+
+import numpy as np
+
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _left_pack(x, keep):
+    """Pack each row's kept entries to the left (zero fill); dropped entries
+    are routed to a discarded extra slot. Returns (packed, new_lens)."""
+    jnp = _jnp()
+    B, T = x.shape
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    pos = jnp.where(keep, pos, T)
+    out = jnp.zeros((B, T + 1), x.dtype)
+    out = out.at[jnp.arange(B)[:, None], pos].set(x)[:, :T]
+    return out, jnp.sum(keep.astype(jnp.int32), axis=1)
+
+
+def _op_key(ctx):
+    """Per-(op, step) PRNG key, additionally folding in the op's `seed` attr
+    so distinct seeds give distinct draws (reference per-op seed semantics)."""
+    key = ctx.rng_key()
+    seed = ctx.attr("seed", 0) or 0
+    if seed:
+        import jax
+        key = jax.random.fold_in(key, seed)
+    return key
+
+
+def _lens_or_full(ctx, slot, B, T):
+    jnp = _jnp()
+    lens = ctx.lod_len(slot)
+    if lens is None:
+        return jnp.full((B,), T, jnp.int32)
+    return lens.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF (linear_chain_crf_op.h)
+# ---------------------------------------------------------------------------
+
+@register_op("linear_chain_crf")
+def _linear_chain_crf(ctx):
+    """Emission [B,T,D]+lens, Transition [D+2,D] (row0=start, row1=end,
+    rows2..=pairwise), Label [B,T,1] int. LogLikelihood output is the
+    *cost* logZ - score, matching linear_chain_crf_op.h:193 `return -ll`."""
+    import jax
+    jnp = _jnp()
+    emission = ctx.input("Emission")
+    trans = ctx.input("Transition")
+    label = ctx.input("Label")
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)
+    B, T, D = emission.shape
+    lens = _lens_or_full(ctx, "Emission", B, T)
+    e = emission.astype(jnp.float32)
+    w = trans.astype(jnp.float32)
+    start, end, pair = w[0], w[1], w[2:]
+
+    # forward algorithm in log domain, masked beyond each sequence's length
+    a0 = start[None, :] + e[:, 0]                       # [B, D]
+
+    def step(a_prev, inp):
+        e_t, active = inp                               # [B,D], [B]
+        sc = a_prev[:, :, None] + pair[None, :, :]      # [B, D, D]
+        a_new = e_t + jax.nn.logsumexp(sc, axis=1)
+        a = jnp.where(active[:, None], a_new, a_prev)
+        return a, a
+
+    ts = jnp.arange(1, T)
+    active = ts[None, :] < lens[:, None]                # [B, T-1]
+    a_last, alphas = jax.lax.scan(
+        step, a0, (jnp.moveaxis(e[:, 1:], 1, 0), jnp.moveaxis(active, 1, 0)))
+    log_z = jax.nn.logsumexp(a_last + end[None, :], axis=-1)  # [B]
+
+    # gold path score
+    t_idx = jnp.arange(T)[None, :]
+    tok_mask = (t_idx < lens[:, None]).astype(jnp.float32)
+    emit_score = jnp.sum(
+        jnp.take_along_axis(e, label[..., None], axis=2)[..., 0] * tok_mask,
+        axis=1)
+    pair_scores = pair[label[:, :-1], label[:, 1:]]     # [B, T-1]
+    pair_mask = (jnp.arange(1, T)[None, :] < lens[:, None]).astype(jnp.float32)
+    trans_score = jnp.sum(pair_scores * pair_mask, axis=1)
+    last = jnp.maximum(lens - 1, 0)
+    y_last = jnp.take_along_axis(label, last[:, None], axis=1)[:, 0]
+    score = emit_score + trans_score + start[label[:, 0]] + end[y_last]
+
+    nll = (log_z - score)[:, None]                      # [B, 1] cost
+    # parity buffers (grad flows through nll via vjp, these are diagnostics)
+    alpha_full = jnp.concatenate([a0[:, None], jnp.moveaxis(alphas, 0, 1)],
+                                 axis=1)
+    row_max = jnp.max(e, axis=-1, keepdims=True)
+    return {"LogLikelihood": nll.astype(emission.dtype),
+            "Alpha": jax.nn.softmax(alpha_full, axis=-1),
+            "EmissionExps": jnp.exp(e - row_max),
+            "TransitionExps": jnp.exp(w)}
+
+
+@register_op("crf_decoding")
+def _crf_decoding(ctx):
+    """Viterbi decode (crf_decoding_op.h:70 Decode). Padded positions emit 0.
+    With Label given: 1 at positions decoded correctly (:62)."""
+    import jax
+    jnp = _jnp()
+    emission = ctx.input("Emission")
+    trans = ctx.input("Transition")
+    B, T, D = emission.shape
+    lens = _lens_or_full(ctx, "Emission", B, T)
+    e = emission.astype(jnp.float32)
+    w = trans.astype(jnp.float32)
+    start, end, pair = w[0], w[1], w[2:]
+
+    a0 = start[None, :] + e[:, 0]
+
+    def fwd(a_prev, inp):
+        e_t, active = inp
+        sc = a_prev[:, :, None] + pair[None, :, :]      # [B, from, to]
+        best = jnp.max(sc, axis=1)
+        track = jnp.argmax(sc, axis=1).astype(jnp.int32)
+        a_new = e_t + best
+        a = jnp.where(active[:, None], a_new, a_prev)
+        return a, track
+
+    ts = jnp.arange(1, T)
+    active = ts[None, :] < lens[:, None]
+    a_last, tracks = jax.lax.scan(
+        fwd, a0, (jnp.moveaxis(e[:, 1:], 1, 0), jnp.moveaxis(active, 1, 0)))
+    final_tag = jnp.argmax(a_last + end[None, :], axis=-1).astype(jnp.int32)
+
+    # backtrack from each sequence's last valid step; while t >= len the
+    # carried tag stays final_tag, so at t == len-1 it is the true last tag
+    def back(cur, inp):
+        track_t, t = inp                                # [B, D], scalar
+        prev = jnp.take_along_axis(track_t, cur[:, None], axis=1)[:, 0]
+        cur_new = jnp.where(t <= lens - 1, prev, cur)
+        return cur_new, cur
+
+    if T > 1:
+        carry0, path_rev = jax.lax.scan(
+            back, final_tag, (tracks[::-1], jnp.arange(T - 1, 0, -1)))
+        # emitted values are tags at positions T-1..1; carry0 is position 0
+        path = jnp.concatenate([carry0[:, None], jnp.flip(path_rev, 0).T],
+                               axis=1)
+    else:
+        path = final_tag[:, None]
+    tok_mask = jnp.arange(T)[None, :] < lens[:, None]
+    path = jnp.where(tok_mask, path, 0)
+    if ctx.has_input("Label"):
+        label = ctx.input("Label")
+        if label.ndim == 3:
+            label = label[..., 0]
+        out = jnp.where(tok_mask & (label.astype(jnp.int32) == path), 1, 0)
+        return {"ViterbiPath": out[..., None].astype(jnp.int64)}
+    return {"ViterbiPath": path[..., None].astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# CTC (warpctc_op.cc — here a pure lax.scan log-domain forward pass)
+# ---------------------------------------------------------------------------
+
+@register_op("warpctc")
+def _warpctc(ctx):
+    """Logits [B,T,C]+lens (unnormalised), Label [B,L]+label lens.
+    Loss [B,1] = -log p(label | logits) via the CTC forward algorithm.
+    The reference calls libwarpctc (warpctc_op.cc); gradient here is the
+    registry's generic vjp of this forward — exact, no custom kernel."""
+    import jax
+    jnp = _jnp()
+    logits = ctx.input("Logits")
+    label = ctx.input("Label")
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)
+    blank = ctx.attr("blank", 0)
+    B, T, C = logits.shape
+    L = label.shape[1]
+    in_lens = _lens_or_full(ctx, "Logits", B, T)
+    lab_lens = _lens_or_full(ctx, "Label", B, L)
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    # extended label sequence with interleaved blanks: length S = 2L+1
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)                    # [B, S]
+    ext_valid = jnp.arange(S)[None, :] < (2 * lab_lens + 1)[:, None]
+    neg_inf = jnp.float32(-1e30)
+
+    # can we skip from s-2 to s? only onto a non-blank differing from s-2
+    ext_prev2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_prev2)      # [B, S]
+
+    def emit(t):
+        return jnp.take_along_axis(logp[:, t], ext, axis=1)  # [B, S]
+
+    a = jnp.where((jnp.arange(S)[None, :] < 2), emit(0), neg_inf)
+    a = jnp.where(ext_valid, a, neg_inf)
+
+    def step(a_prev, t):
+        shift1 = jnp.concatenate(
+            [jnp.full((B, 1), neg_inf), a_prev[:, :-1]], axis=1)
+        shift2 = jnp.concatenate(
+            [jnp.full((B, 2), neg_inf), a_prev[:, :-2]], axis=1)
+        shift2 = jnp.where(can_skip, shift2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, shift1), shift2)
+        a_new = merged + emit(t)
+        a_new = jnp.where(ext_valid, a_new, neg_inf)
+        a = jnp.where((t < in_lens)[:, None], a_new, a_prev)
+        return a, None
+
+    a_last, _ = jax.lax.scan(step, a, jnp.arange(1, T))
+    # p(label) = alpha[S_eff-1] + alpha[S_eff-2], S_eff = 2*lab_len+1;
+    # an empty label has S_eff=1 — only the single blank state counts
+    idx_last = 2 * lab_lens                              # blank after last lab
+    idx_prev = jnp.maximum(2 * lab_lens - 1, 0)
+    at_last = jnp.take_along_axis(a_last, idx_last[:, None], axis=1)[:, 0]
+    at_prev = jnp.take_along_axis(a_last, idx_prev[:, None], axis=1)[:, 0]
+    ll = jnp.where(lab_lens > 0, jnp.logaddexp(at_last, at_prev), at_last)
+    loss = -ll[:, None]
+    if ctx.attr("norm_by_times", False):
+        loss = loss / jnp.maximum(in_lens, 1).astype(
+            jnp.float32)[:, None]
+    return {"Loss": loss.astype(logits.dtype)}
+
+
+@register_op("ctc_align")
+def _ctc_align(ctx):
+    """Greedy CTC decode post-step: merge repeats, drop blanks
+    (ctc_align_op.cc). Input [B,T]+lens int; output [B,T] left-packed,
+    zero-padded, with new lengths."""
+    jnp = _jnp()
+    x = ctx.input("Input")
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    if squeeze:
+        x = x[..., 0]
+    x = x.astype(jnp.int32)
+    B, T = x.shape
+    lens = _lens_or_full(ctx, "Input", B, T)
+    blank = ctx.attr("blank", 0)
+    merge = ctx.attr("merge_repeated", True)
+
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), x[:, :-1]],
+                           axis=1)
+    keep = (x != blank) & (jnp.arange(T)[None, :] < lens[:, None])
+    if merge:
+        keep = keep & (x != prev)
+    out, new_lens = _left_pack(x, keep)
+    out = out.astype(jnp.int64)
+    if squeeze:
+        out = out[..., None]
+    return {"Output": out, "Output@LOD_LEN": new_lens}
+
+
+@register_op("edit_distance")
+def _edit_distance(ctx):
+    """Levenshtein distance between ragged Hyps and Refs (edit_distance_op.h).
+    Out [B,1] float (normalized by ref length if `normalized`),
+    SequenceNum [1]."""
+    import jax
+    jnp = _jnp()
+    hyp = ctx.input("Hyps")
+    ref = ctx.input("Refs")
+    if hyp.ndim == 3:
+        hyp = hyp[..., 0]
+    if ref.ndim == 3:
+        ref = ref[..., 0]
+    hyp = hyp.astype(jnp.int32)
+    ref = ref.astype(jnp.int32)
+    B, Th = hyp.shape
+    Tr = ref.shape[1]
+    hlens = _lens_or_full(ctx, "Hyps", B, Th)
+    rlens = _lens_or_full(ctx, "Refs", B, Tr)
+
+    ignored = ctx.attr("ignored_tokens", []) or []
+    if ignored:
+        def erase(x, lens):
+            keep = jnp.arange(x.shape[1])[None, :] < lens[:, None]
+            for tok in ignored:
+                keep = keep & (x != tok)
+            return _left_pack(x, keep)
+
+        hyp, hlens = erase(hyp, hlens)
+        ref, rlens = erase(ref, rlens)
+
+    def one(h, r, hl, rl):
+        row0 = jnp.arange(Tr + 1, dtype=jnp.float32)
+
+        def outer(row, i):
+            def inner(carry, j):
+                # carry = new[j-1]; row[j] is d[i-1][j]
+                sub = row[j - 1] + (h[i - 1] != r[j - 1])
+                val = jnp.minimum(jnp.minimum(row[j] + 1, carry + 1), sub)
+                return val, val
+
+            first = jnp.float32(i)
+            _, rest = jax.lax.scan(inner, first, jnp.arange(1, Tr + 1))
+            new_row = jnp.concatenate([first[None], rest])
+            return jnp.where(i <= hl, new_row, row), None
+
+        final, _ = jax.lax.scan(outer, row0, jnp.arange(1, Th + 1))
+        d = final[rl]
+        # empty-ref convention (edit_distance_op.h): dist = hyp len
+        d = jnp.where(rl == 0, hl.astype(jnp.float32), d)
+        return d
+
+    dist = jax.vmap(one)(hyp, ref, hlens, rlens)
+    if ctx.attr("normalized", True):
+        dist = dist / jnp.maximum(rlens, 1).astype(jnp.float32)
+    return {"Out": dist[:, None],
+            "SequenceNum": jnp.asarray([B], jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# streaming metrics (metrics/auc_op.h, precision_recall_op.h, mean_iou_op.h)
+# ---------------------------------------------------------------------------
+
+@register_op("auc", stateful=True)
+def _auc(ctx):
+    """Threshold-bucketed streaming AUC. StatPos/StatNeg [num_thresholds+1]
+    persistable state threaded through like batch_norm's mean/var."""
+    jnp = _jnp()
+    pred = ctx.input("Predict")
+    label = ctx.input("Label")
+    stat_pos = ctx.input("StatPos")
+    stat_neg = ctx.input("StatNeg")
+    n = ctx.attr("num_thresholds", 200)
+    if label.ndim == 2:
+        label = label[:, 0]
+    p1 = pred[:, -1] if pred.ndim == 2 else pred
+    bucket = jnp.clip((p1 * n).astype(jnp.int32), 0, n)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    stat_pos = stat_pos.at[bucket].add(is_pos)
+    stat_neg = stat_neg.at[bucket].add(1 - is_pos)
+    # integrate: for threshold i, TP = sum_{b>=i} pos, FP = sum_{b>=i} neg
+    tp = jnp.cumsum(stat_pos[::-1])[::-1].astype(jnp.float32)
+    fp = jnp.cumsum(stat_neg[::-1])[::-1].astype(jnp.float32)
+    if ctx.attr("curve", "ROC") == "PR":
+        # trapezoid over (recall, precision) points i = 0..n
+        rec = tp / jnp.maximum(tp[0], 1.0)
+        prec = jnp.where(tp + fp > 0, tp / jnp.maximum(tp + fp, 1.0), 1.0)
+        auc_val = jnp.sum((rec[:-1] - rec[1:]) * (prec[:-1] + prec[1:]) / 2.0)
+    else:
+        # trapezoid over (fp, tp) curve points i = 0..n
+        area = jnp.sum((fp[:-1] - fp[1:]) * (tp[:-1] + tp[1:]) / 2.0)
+        denom = tp[0] * fp[0]
+        auc_val = jnp.where(denom > 0, area / jnp.maximum(denom, 1.0), 0.0)
+    return {"AUC": auc_val.astype(jnp.float32).reshape((1,)),
+            "StatPosOut": stat_pos, "StatNegOut": stat_neg}
+
+
+@register_op("precision_recall", stateful=True)
+def _precision_recall(ctx):
+    """Multi-class streaming precision/recall/F1 (macro + micro).
+    StatesInfo [C,4] = per-class TP, FP, TN, FN (precision_recall_op.h)."""
+    jnp = _jnp()
+    idx = ctx.input("Indices")
+    labels = ctx.input("Labels")
+    states = ctx.input("StatesInfo")
+    C = states.shape[0]
+    if idx.ndim == 2:
+        idx = idx[:, 0]
+    if labels.ndim == 2:
+        labels = labels[:, 0]
+    idx = idx.astype(jnp.int32)
+    labels = labels.astype(jnp.int32)
+    w = ctx.input("Weights")
+    wv = w[:, 0] if (w is not None and w.ndim == 2) else \
+        (w if w is not None else jnp.ones(idx.shape, jnp.float32))
+    pred_oh = (idx[:, None] == jnp.arange(C)[None, :]).astype(jnp.float32)
+    lab_oh = (labels[:, None] == jnp.arange(C)[None, :]).astype(jnp.float32)
+    wv = wv[:, None]
+    tp = jnp.sum(pred_oh * lab_oh * wv, axis=0)
+    fp = jnp.sum(pred_oh * (1 - lab_oh) * wv, axis=0)
+    fn = jnp.sum((1 - pred_oh) * lab_oh * wv, axis=0)
+    tn = jnp.sum((1 - pred_oh) * (1 - lab_oh) * wv, axis=0)
+    batch = jnp.stack([tp, fp, tn, fn], axis=1)
+
+    def metrics(st):
+        tp_, fp_, tn_, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12),
+                         1.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12),
+                        1.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0)
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        stp, sfp, sfn = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        mprec = jnp.where(stp + sfp > 0, stp / jnp.maximum(stp + sfp, 1e-12),
+                          1.0)
+        mrec = jnp.where(stp + sfn > 0, stp / jnp.maximum(stp + sfn, 1e-12),
+                         1.0)
+        mf1 = jnp.where(mprec + mrec > 0,
+                        2 * mprec * mrec / jnp.maximum(mprec + mrec, 1e-12),
+                        0.0)
+        return jnp.concatenate([macro, jnp.stack([mprec, mrec, mf1])])
+
+    accum = states.astype(jnp.float32) + batch
+    return {"BatchMetrics": metrics(batch).astype(jnp.float32),
+            "AccumMetrics": metrics(accum).astype(jnp.float32),
+            "AccumStatesInfo": accum}
+
+
+@register_op("mean_iou")
+def _mean_iou(ctx):
+    """Mean intersection-over-union over classes (mean_iou_op.h)."""
+    jnp = _jnp()
+    pred = ctx.input("Predictions").astype(jnp.int32).reshape(-1)
+    label = ctx.input("Labels").astype(jnp.int32).reshape(-1)
+    C = ctx.attr("num_classes")
+    cls = jnp.arange(C)[None, :]
+    p_oh = (pred[:, None] == cls)
+    l_oh = (label[:, None] == cls)
+    inter = jnp.sum(p_oh & l_oh, axis=0).astype(jnp.float32)
+    union = jnp.sum(p_oh | l_oh, axis=0).astype(jnp.float32)
+    # fold streaming accumulators in FIRST so the reported metric covers
+    # history too (reference mean_iou_op.h accumulates before dividing)
+    wrong = (union - inter).astype(jnp.int32)
+    correct = inter.astype(jnp.int32)
+    for extra_w in ctx.inputs("InWrongs"):
+        wrong = wrong + extra_w
+    for extra_c in ctx.inputs("InCorrects"):
+        correct = correct + extra_c
+    inter_t = correct.astype(jnp.float32)
+    union_t = inter_t + wrong.astype(jnp.float32)
+    valid = union_t > 0
+    iou = jnp.where(valid, inter_t / jnp.maximum(union_t, 1.0), 0.0)
+    mean_iou = jnp.sum(iou) / jnp.maximum(
+        jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return {"OutMeanIou": mean_iou.reshape((1,)),
+            "OutWrong": wrong, "OutCorrect": correct}
+
+
+# ---------------------------------------------------------------------------
+# pairwise / sampled losses (rank_loss_op.h, nce_op.h,
+# hierarchical_sigmoid_op.h)
+# ---------------------------------------------------------------------------
+
+@register_op("rank_loss")
+def _rank_loss(ctx):
+    jnp = _jnp()
+    label = ctx.input("Label")
+    left, right = ctx.input("Left"), ctx.input("Right")
+    o = left - right
+    return {"Out": jnp.logaddexp(0.0, o) - label * o}
+
+
+@register_op("nce")
+def _nce(ctx):
+    """Noise-contrastive estimation with a uniform sampler (nce_op.h).
+    Negatives drawn per step from ctx.rng_key() — deterministic per
+    (op, step) like the reference's per-op seed attr."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("Input")                              # [B, D]
+    label = ctx.input("Label")                          # [B, num_true]
+    w = ctx.input("Weight")                             # [C, D]
+    bias = ctx.input("Bias")
+    num_neg = ctx.attr("num_neg_samples", 10)
+    C = ctx.attr("num_total_classes", w.shape[0])
+    B = x.shape[0]
+    num_true = label.shape[1] if label.ndim == 2 else 1
+    label = label.reshape(B, num_true).astype(jnp.int32)
+
+    sampler = ctx.attr("sampler", 0)
+    if sampler == 1:
+        # log-uniform (Zipfian): P(k) = (log(k+2)-log(k+1)) / log(C+1);
+        # inverse-transform sample: k = floor(exp(u * log(C+1))) - 1
+        u = jax.random.uniform(_op_key(ctx), (B, num_neg))
+        neg = jnp.clip((jnp.exp(u * np.log(C + 1.0)) - 1.0)
+                       .astype(jnp.int32), 0, C - 1)
+
+        def log_q_of(cls):
+            k = cls.astype(jnp.float32)
+            q = (jnp.log(k + 2.0) - jnp.log(k + 1.0)) / np.log(C + 1.0)
+            return jnp.log(num_neg * q)
+    elif sampler == 2:
+        raise NotImplementedError(
+            "nce custom_dist sampler is not supported on the TPU build")
+    else:
+        neg = jax.random.randint(_op_key(ctx), (B, num_neg), 0, C)
+
+        def log_q_of(cls):
+            return jnp.full(cls.shape, np.log(num_neg / float(C)),
+                            jnp.float32)
+
+    samples = jnp.concatenate([label, neg], axis=1)     # [B, true+neg]
+    sw = jnp.take(w, samples, axis=0)                   # [B, S, D]
+    logits = jnp.einsum("bd,bsd->bs", x, sw)
+    if bias is not None:
+        logits = logits + jnp.take(bias.reshape(-1), samples)
+    adj = logits - log_q_of(samples)                    # s - log(k * q(y))
+    pos = -jax.nn.log_sigmoid(adj[:, :num_true]).sum(axis=1)
+    # -log(1 - sigmoid(z)) == softplus(z), exact and gradient-stable
+    negl = jnp.logaddexp(0.0, adj[:, num_true:]).sum(axis=1)
+    cost = (pos + negl)[:, None]
+    return {"Cost": cost, "SampleLogits": logits,
+            "SampleLabels": samples.astype(jnp.int64)}
+
+
+@register_op("hierarchical_sigmoid")
+def _hsigmoid(ctx):
+    """Default complete-binary-tree code (MatrixBitCodeFunctor SimpleCode,
+    hierarchical_sigmoid_op.h): node id c = label + num_classes; the path is
+    the bit prefix of c, internal node index at depth j is (c >> (len-1-j))-1
+    and the target bit is bit (len-1-j-1)... realised here as: walking c's
+    bits from below the MSB."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")                                  # [B, D]
+    w = ctx.input("W")                                  # [C-1, D]
+    bias = ctx.input("Bias")                            # [C-1] or [C-1,1]
+    label = ctx.input("Label").reshape(-1).astype(jnp.int32)
+    C = ctx.attr("num_classes")
+    max_len = int(np.floor(np.log2(max(C, 2)))) + 1     # max code length
+
+    c = label + C                                       # node ids, >= C
+    # code length = index of highest set bit
+    code_len = (jnp.floor(jnp.log2(c.astype(jnp.float32)) + 1e-6)
+                .astype(jnp.int32))                     # path edges count
+    loss = jnp.zeros(x.shape[0], jnp.float32)
+    for j in range(max_len):
+        # depth-j edge: parent node is c's bit-prefix above position `shift`,
+        # the branch taken is bit `shift` itself (SimpleCode calc_index(b) =
+        # (c >> (b+1)) - 1, calc_bit(b) = c & (1 << b))
+        shift = code_len - 1 - j
+        node = jnp.where(shift >= 0,
+                         (c >> (jnp.maximum(shift, 0) + 1)) - 1, 0)
+        bit = jnp.where(shift >= 0, (c >> jnp.maximum(shift, 0)) & 1, 0)
+        valid = (j < code_len)
+        wn = jnp.take(w, jnp.clip(node, 0, w.shape[0] - 1), axis=0)
+        pre = jnp.einsum("bd,bd->b", x, wn)
+        if bias is not None:
+            pre = pre + jnp.take(bias.reshape(-1),
+                                 jnp.clip(node, 0, w.shape[0] - 1))
+        # sigmoid cross entropy with target = bit
+        step_loss = jnp.logaddexp(0.0, pre) - bit.astype(jnp.float32) * pre
+        loss = loss + jnp.where(valid, step_loss, 0.0)
+    return {"Out": loss[:, None],
+            "PreOut": jnp.zeros((x.shape[0], max_len), jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# selection / sampling (multiplex_op.cc, sampling_id_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("multiplex")
+def _multiplex(ctx):
+    jnp = _jnp()
+    xs = ctx.inputs("X")
+    ids = ctx.input("Ids").reshape(-1).astype(jnp.int32)
+    stacked = jnp.stack(xs, axis=0)                     # [K, B, ...]
+    out = stacked[ids, jnp.arange(stacked.shape[1])]
+    return {"Out": out}
+
+
+@register_op("sampling_id")
+def _sampling_id(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")                                  # [B, C] probs
+    logp = jnp.log(jnp.maximum(x, 1e-20))
+    out = jax.random.categorical(_op_key(ctx), logp, axis=-1)
+    return {"Out": out.astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval (chunk_eval_op.h) — chunk F1 for sequence labeling
+# ---------------------------------------------------------------------------
+
+def _chunk_bounds(tags, mask, scheme, num_types, jnp):
+    """Per-position chunk start/end flags + chunk type, vectorised.
+    Tag encoding (chunk_eval_op.h): tag = type * num_tag + tag_pos where
+    IOB: {B=0, I=1}, IOE: {I=0, E=1}, IOBES: {B=0, I=1, E=2, S=3},
+    plain: every tag is a single-token chunk of its own type."""
+    if scheme == "plain":
+        typ = tags
+        inside = mask & (tags >= 0) & (tags < num_types)
+        start = inside
+        end = inside
+        return start, end, typ
+    ntag = {"IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    typ = jnp.where(tags >= 0, tags // ntag, -1)
+    pos = jnp.where(tags >= 0, tags % ntag, -1)
+    inside = mask & (typ >= 0) & (typ < num_types)
+    typ = jnp.where(inside, typ, -1)
+
+    prev_typ = jnp.concatenate([jnp.full_like(typ[:, :1], -1),
+                                typ[:, :-1]], axis=1)
+    prev_pos = jnp.concatenate([jnp.full_like(pos[:, :1], -1),
+                                pos[:, :-1]], axis=1)
+    next_typ = jnp.concatenate([typ[:, 1:],
+                                jnp.full_like(typ[:, :1], -1)], axis=1)
+    next_pos = jnp.concatenate([pos[:, 1:],
+                                jnp.full_like(pos[:, :1], -1)], axis=1)
+    if scheme == "IOB":
+        start = inside & ((pos == 0) | (prev_typ != typ))
+        end = inside & ((next_typ != typ) | (next_pos == 0))
+    elif scheme == "IOE":
+        start = inside & ((prev_typ != typ) | (prev_pos == 1))
+        end = inside & ((pos == 1) | (next_typ != typ))
+    else:  # IOBES
+        start = inside & ((pos == 0) | (pos == 3) |
+                          ((pos == 1) & (prev_typ != typ)))
+        end = inside & ((pos == 2) | (pos == 3) |
+                        ((pos == 1) & (next_typ != typ)))
+    return start, end, typ
+
+
+@register_op("chunk_eval")
+def _chunk_eval(ctx):
+    jnp = _jnp()
+    inf = ctx.input("Inference")
+    lab = ctx.input("Label")
+    if inf.ndim == 3:
+        inf = inf[..., 0]
+    if lab.ndim == 3:
+        lab = lab[..., 0]
+    inf = inf.astype(jnp.int32)
+    lab = lab.astype(jnp.int32)
+    B, T = inf.shape
+    lens = _lens_or_full(ctx, "Inference", B, T)
+    mask = jnp.arange(T)[None, :] < lens[:, None]
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    num_types = ctx.attr("num_chunk_types")
+    excluded = ctx.attr("excluded_chunk_types", []) or []
+
+    i_start, i_end, i_typ = _chunk_bounds(inf, mask, scheme, num_types, jnp)
+    l_start, l_end, l_typ = _chunk_bounds(lab, mask, scheme, num_types, jnp)
+    for ex in excluded:
+        i_start = i_start & (i_typ != ex)
+        l_start = l_start & (l_typ != ex)
+        i_end = i_end & (i_typ != ex)
+        l_end = l_end & (l_typ != ex)
+
+    import jax
+
+    # chunk end index for a chunk starting at s = first t >= s with end[t];
+    # computed as a reverse running-min of flagged indices
+    def next_end_idx(end_flags):
+        idx = jnp.where(end_flags, jnp.arange(T)[None, :], T + 1)
+        return jnp.flip(jax.lax.cummin(jnp.flip(idx, axis=1), axis=1), axis=1)
+
+    i_ends = next_end_idx(i_end)
+    l_ends = next_end_idx(l_end)
+    correct = (i_start & l_start & (i_typ == l_typ) &
+               (i_ends == l_ends))
+    num_i = jnp.sum(i_start.astype(jnp.int64))
+    num_l = jnp.sum(l_start.astype(jnp.int64))
+    num_c = jnp.sum(correct.astype(jnp.int64))
+    prec = jnp.where(num_i > 0, num_c / jnp.maximum(num_i, 1), 0.0)
+    rec = jnp.where(num_l > 0, num_c / jnp.maximum(num_l, 1), 0.0)
+    f1 = jnp.where(num_c > 0, 2 * prec * rec /
+                   jnp.maximum(prec + rec, 1e-12), 0.0)
+    return {"Precision": prec.astype(jnp.float32).reshape((1,)),
+            "Recall": rec.astype(jnp.float32).reshape((1,)),
+            "F1-Score": f1.astype(jnp.float32).reshape((1,)),
+            "NumInferChunks": num_i.reshape((1,)),
+            "NumLabelChunks": num_l.reshape((1,)),
+            "NumCorrectChunks": num_c.reshape((1,))}

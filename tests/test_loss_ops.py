@@ -1,0 +1,465 @@
+"""Structured losses + metrics tests (reference unittests
+test_linear_chain_crf_op.py, test_crf_decoding_op.py, test_warpctc_op.py,
+test_ctc_align.py, test_edit_distance_op.py, test_auc_op.py,
+test_mean_iou.py, test_chunk_eval_op.py, test_nce.py, test_hsigmoid_op.py,
+test_multiplex_op.py, test_rank_loss_op.py)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.lod import create_lod_tensor, LoDTensor
+
+
+def _logsumexp(xs):
+    m = np.max(xs)
+    return m + np.log(np.sum(np.exp(np.asarray(xs) - m)))
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+def _crf_brute(e_seq, w, labels):
+    """Brute-force logZ and gold score for one sequence [n, D]."""
+    n, D = e_seq.shape
+    start, end, pair = w[0], w[1], w[2:]
+
+    def score(path):
+        s = start[path[0]] + end[path[-1]] + sum(e_seq[t, path[t]]
+                                                 for t in range(n))
+        s += sum(pair[path[t - 1], path[t]] for t in range(1, n))
+        return s
+
+    log_z = _logsumexp([score(p)
+                        for p in itertools.product(range(D), repeat=n)])
+    return log_z - score(tuple(labels)), None
+
+
+def _crf_viterbi_brute(e_seq, w):
+    n, D = e_seq.shape
+    start, end, pair = w[0], w[1], w[2:]
+    best, best_p = -1e30, None
+    for p in itertools.product(range(D), repeat=n):
+        s = start[p[0]] + end[p[-1]] + sum(e_seq[t, p[t]] for t in range(n))
+        s += sum(pair[p[t - 1], p[t]] for t in range(1, n))
+        if s > best:
+            best, best_p = s, p
+    return list(best_p)
+
+
+def _build_crf_program(D):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        emission = fluid.layers.data("emission", shape=[D], dtype="float32",
+                                     lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64",
+                                  lod_level=1)
+        nll = fluid.layers.linear_chain_crf(
+            emission, label, param_attr=fluid.ParamAttr(name="crfw"))
+        decoded = fluid.layers.crf_decoding(
+            emission, param_attr=fluid.ParamAttr(name="crfw"))
+    return main, startup, nll, decoded
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(7)
+    D = 3
+    lens = [2, 3]
+    rows = sum(lens)
+    e = rng.randn(rows, D).astype(np.float32)
+    labels = rng.randint(0, D, (rows, 1)).astype(np.int64)
+    main, startup, nll, decoded = _build_crf_program(D)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    w = np.asarray(fluid.global_scope().get("crfw"))
+    res, dec = exe.run(
+        main,
+        feed={"emission": create_lod_tensor(e, [lens]),
+              "label": create_lod_tensor(labels, [lens])},
+        fetch_list=[nll, decoded])
+    res = np.asarray(res.numpy() if isinstance(res, LoDTensor) else res)
+    offs = [0, 2, 5]
+    for b in range(2):
+        seg = slice(offs[b], offs[b + 1])
+        expect, _ = _crf_brute(e[seg], w, labels[seg, 0])
+        np.testing.assert_allclose(res[b, 0], expect, rtol=1e-4,
+                                   err_msg="seq %d" % b)
+    dec = np.asarray(dec.numpy() if isinstance(dec, LoDTensor) else dec)
+    dec = dec.reshape(-1)
+    for b in range(2):
+        seg = slice(offs[b], offs[b + 1])
+        np.testing.assert_array_equal(dec[seg], _crf_viterbi_brute(e[seg], w))
+
+
+def test_crf_trains():
+    """nll decreases under SGD on a toy tagging problem."""
+    rng = np.random.RandomState(0)
+    D = 4
+    lens = [3, 4, 2]
+    rows = sum(lens)
+    e = rng.randn(rows, D).astype(np.float32)
+    labels = rng.randint(0, D, (rows, 1)).astype(np.int64)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        emission = fluid.layers.data("emission", shape=[D], dtype="float32",
+                                     lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64",
+                                  lod_level=1)
+        feat = fluid.layers.fc(emission, D)
+        nll = fluid.layers.linear_chain_crf(
+            feat, label, param_attr=fluid.ParamAttr(name="crfw2"))
+        avg = fluid.layers.mean(nll)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"emission": create_lod_tensor(e, [lens]),
+            "label": create_lod_tensor(labels, [lens])}
+    losses = []
+    for _ in range(25):
+        (l,) = exe.run(main, feed=feed, fetch_list=[avg])
+        losses.append(float(np.asarray(l)))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+def _ctc_brute(logits, label, blank=0):
+    """-log p(label) by enumerating all alignment paths. logits [T, C]."""
+    T, C = logits.shape
+    m = logits.max(axis=1, keepdims=True)
+    logp = logits - m - np.log(np.exp(logits - m).sum(1, keepdims=True))
+
+    def collapse(path):
+        out, prev = [], None
+        for p in path:
+            if p != prev and p != blank:
+                out.append(p)
+            prev = p
+        return tuple(out)
+
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(label):
+            total = np.logaddexp(total,
+                                 sum(logp[t, path[t]] for t in range(T)))
+    return -total
+
+
+def test_warpctc_matches_brute_force():
+    rng = np.random.RandomState(3)
+    C = 3
+    in_lens = [4, 3]
+    lab_lens = [2, 1]
+    logits = rng.randn(sum(in_lens), C).astype(np.float32)
+    label = np.array([[1], [2], [1]], dtype=np.int64)  # seqs: [1,2], [1]
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[C], dtype="float32", lod_level=1)
+        y = fluid.layers.data("y", shape=[1], dtype="int64", lod_level=1)
+        loss = fluid.layers.warpctc(x, y, blank=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    res, = exe.run(main,
+                   feed={"x": create_lod_tensor(logits, [in_lens]),
+                         "y": create_lod_tensor(label, [lab_lens])},
+                   fetch_list=[loss])
+    res = np.asarray(res.numpy() if isinstance(res, LoDTensor) else res)
+    expect0 = _ctc_brute(logits[:4], [1, 2])
+    expect1 = _ctc_brute(logits[4:7], [1])
+    np.testing.assert_allclose(res.reshape(-1), [expect0, expect1],
+                               rtol=1e-4)
+
+
+def test_warpctc_trains():
+    rng = np.random.RandomState(1)
+    C = 4
+    in_lens = [5, 5]
+    lab_lens = [2, 2]
+    feats = rng.randn(sum(in_lens), 6).astype(np.float32)
+    label = rng.randint(1, C, (sum(lab_lens), 1)).astype(np.int64)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6], dtype="float32", lod_level=1)
+        y = fluid.layers.data("y", shape=[1], dtype="int64", lod_level=1)
+        logits = fluid.layers.fc(x, C)
+        loss = fluid.layers.mean(fluid.layers.warpctc(logits, y))
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": create_lod_tensor(feats, [in_lens]),
+            "y": create_lod_tensor(label, [lab_lens])}
+    losses = [float(np.asarray(exe.run(main, feed=feed,
+                                       fetch_list=[loss])[0]))
+              for _ in range(20)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_ctc_align():
+    x = np.array([[0, 1, 1, 0, 2, 2, 0]], dtype=np.int64).T  # one seq len 7
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        inp = fluid.layers.data("x", shape=[1], dtype="int64", lod_level=1)
+        onehot = fluid.layers.one_hot(inp, 3)
+        decoded = fluid.layers.ctc_greedy_decoder(onehot, blank=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    res, = exe.run(main, feed={"x": create_lod_tensor(x, [[7]])},
+                   fetch_list=[decoded])
+    assert isinstance(res, LoDTensor)
+    np.testing.assert_array_equal(res.numpy().reshape(-1), [1, 2])
+    assert res.recursive_sequence_lengths() == [[2]]
+
+
+# ---------------------------------------------------------------------------
+# edit distance
+# ---------------------------------------------------------------------------
+
+def _lev(a, b):
+    d = np.zeros((len(a) + 1, len(b) + 1))
+    d[:, 0] = np.arange(len(a) + 1)
+    d[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return d[-1, -1]
+
+
+def test_edit_distance():
+    hyp = np.array([[1], [2], [3], [4], [5]], dtype=np.int64)
+    ref = np.array([[1], [3], [3], [7], [8], [9]], dtype=np.int64)
+    hyp_lens, ref_lens = [2, 3], [3, 3]
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        h = fluid.layers.data("h", shape=[1], dtype="int64", lod_level=1)
+        r = fluid.layers.data("r", shape=[1], dtype="int64", lod_level=1)
+        dist, seq_num = fluid.layers.edit_distance(h, r, normalized=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d, n = exe.run(main,
+                   feed={"h": create_lod_tensor(hyp, [hyp_lens]),
+                         "r": create_lod_tensor(ref, [ref_lens])},
+                   fetch_list=[dist, seq_num])
+    d = np.asarray(d.numpy() if isinstance(d, LoDTensor) else d)
+    expect = [_lev([1, 2], [1, 3, 3]), _lev([3, 4, 5], [7, 8, 9])]
+    np.testing.assert_allclose(d.reshape(-1), expect)
+    assert int(np.asarray(n)[0]) == 2
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_auc_streaming():
+    rng = np.random.RandomState(0)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        pred = fluid.layers.data("pred", shape=[2], dtype="float32")
+        lab = fluid.layers.data("lab", shape=[1], dtype="int64")
+        auc_out, _ = fluid.layers.auc(pred, lab, num_thresholds=4096)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # perfectly separable -> AUC ~ 1
+    p = np.array([[0.1, 0.9]] * 5 + [[0.9, 0.1]] * 5, dtype=np.float32)
+    y = np.array([[1]] * 5 + [[0]] * 5, dtype=np.int64)
+    (a,) = exe.run(main, feed={"pred": p, "lab": y}, fetch_list=[auc_out])
+    assert float(np.asarray(a)[0]) > 0.99
+    # feed opposite labels -> streaming AUC drops towards 0.5
+    (a2,) = exe.run(main, feed={"pred": p, "lab": 1 - y},
+                    fetch_list=[auc_out])
+    assert 0.3 < float(np.asarray(a2)[0]) < 0.7
+
+
+def test_mean_iou():
+    pred = np.array([0, 1, 1, 2], dtype=np.int32)
+    lab = np.array([0, 1, 2, 2], dtype=np.int32)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        p = fluid.layers.data("p", shape=[4], dtype="int32",
+                              append_batch_size=False)
+        l = fluid.layers.data("l", shape=[4], dtype="int32",
+                              append_batch_size=False)
+        miou, wrong, correct = fluid.layers.mean_iou(p, l, num_classes=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    m, w, c = exe.run(main, feed={"p": pred, "l": lab},
+                      fetch_list=[miou, wrong, correct])
+    # class ious: 0: 1/1, 1: 1/2, 2: 1/2 -> mean 2/3
+    np.testing.assert_allclose(float(np.asarray(m)[0]), 2.0 / 3, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(c), [1, 1, 1])
+
+
+def test_chunk_eval_iob():
+    # IOB, 1 chunk type: tags B=0, I=1, O=2(outside, >= num_types*2)
+    # label:  B I O B I  -> chunks (0-1), (3-4)
+    # infer:  B I O B O  -> chunks (0-1), (3-3)
+    lab = np.array([[0], [1], [2], [0], [1]], dtype=np.int64)
+    inf = np.array([[0], [1], [2], [0], [2]], dtype=np.int64)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.data("i", shape=[1], dtype="int64", lod_level=1)
+        l = fluid.layers.data("l", shape=[1], dtype="int64", lod_level=1)
+        prec, rec, f1, ni, nl, nc = fluid.layers.chunk_eval(
+            i, l, chunk_scheme="IOB", num_chunk_types=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    res = exe.run(main, feed={"i": create_lod_tensor(inf, [[5]]),
+                              "l": create_lod_tensor(lab, [[5]])},
+                  fetch_list=[prec, rec, f1, ni, nl, nc])
+    prec_v, rec_v = float(np.asarray(res[0])[0]), float(np.asarray(res[1])[0])
+    assert int(np.asarray(res[3])[0]) == 2     # inferred chunks
+    assert int(np.asarray(res[4])[0]) == 2     # label chunks
+    assert int(np.asarray(res[5])[0]) == 1     # correct (first chunk)
+    np.testing.assert_allclose([prec_v, rec_v], [0.5, 0.5])
+
+
+# ---------------------------------------------------------------------------
+# sampled / pairwise losses and selection ops
+# ---------------------------------------------------------------------------
+
+def test_rank_loss():
+    left = np.array([[0.5], [2.0]], dtype=np.float32)
+    right = np.array([[1.0], [1.0]], dtype=np.float32)
+    lab = np.array([[1.0], [0.0]], dtype=np.float32)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        l = fluid.layers.data("l", shape=[1], dtype="float32")
+        r = fluid.layers.data("r", shape=[1], dtype="float32")
+        t = fluid.layers.data("t", shape=[1], dtype="float32")
+        out = fluid.layers.rank_loss(t, l, r)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (res,) = exe.run(main, feed={"l": left, "r": right, "t": lab},
+                     fetch_list=[out])
+    o = left - right
+    expect = np.log1p(np.exp(o)) - lab * o
+    np.testing.assert_allclose(np.asarray(res), expect, rtol=1e-5)
+
+
+def test_multiplex():
+    x1 = np.arange(6, dtype=np.float32).reshape(3, 2)
+    x2 = -np.arange(6, dtype=np.float32).reshape(3, 2)
+    ids = np.array([[0], [1], [0]], dtype=np.int32)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[2], dtype="float32")
+        b = fluid.layers.data("b", shape=[2], dtype="float32")
+        i = fluid.layers.data("i", shape=[1], dtype="int32")
+        out = fluid.layers.multiplex([a, b], i)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (res,) = exe.run(main, feed={"a": x1, "b": x2, "i": ids},
+                     fetch_list=[out])
+    expect = np.stack([x1[0], x2[1], x1[2]])
+    np.testing.assert_allclose(np.asarray(res), expect)
+
+
+def test_nce_and_hsigmoid_train():
+    rng = np.random.RandomState(0)
+    B, D, C = 8, 6, 10
+    x_np = rng.randn(B, D).astype(np.float32)
+    y_np = rng.randint(0, C, (B, 1)).astype(np.int64)
+    for which in ("nce", "hsigmoid"):
+        main, startup = Program(), Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[D], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            if which == "nce":
+                cost = fluid.layers.nce(x, y, num_total_classes=C,
+                                        num_neg_samples=4)
+            else:
+                cost = fluid.layers.hsigmoid(x, y, num_classes=C)
+            loss = fluid.layers.mean(cost)
+            fluid.optimizer.SGD(learning_rate=0.2).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = [float(np.asarray(
+            exe.run(main, feed={"x": x_np, "y": y_np},
+                    fetch_list=[loss])[0])) for _ in range(15)]
+        assert np.isfinite(losses).all(), (which, losses)
+        assert losses[-1] < losses[0], (which, losses)
+
+
+def test_hsigmoid_matches_simple_code_reference():
+    rng = np.random.RandomState(2)
+    B, D, C = 4, 5, 6
+    x_np = rng.randn(B, D).astype(np.float32)
+    y_np = rng.randint(0, C, (B, 1)).astype(np.int64)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[D], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        cost = fluid.layers.hsigmoid(
+            x, y, num_classes=C, param_attr=fluid.ParamAttr(name="hs_w"),
+            bias_attr=fluid.ParamAttr(name="hs_b"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (res,) = exe.run(main, feed={"x": x_np, "y": y_np}, fetch_list=[cost])
+    w = np.asarray(fluid.global_scope().get("hs_w"))
+    b = np.asarray(fluid.global_scope().get("hs_b")).reshape(-1)
+
+    def ref_one(xv, lab):
+        c = lab + C
+        code_len = int(np.floor(np.log2(c)))
+        loss = 0.0
+        for shift in range(code_len - 1, -1, -1):
+            node = (c >> (shift + 1)) - 1       # SimpleCode calc_index
+            bit = (c >> shift) & 1              # SimpleCode calc_bit
+            pre = xv @ w[node] + b[node]
+            loss += np.logaddexp(0.0, pre) - bit * pre
+        return loss
+
+    expect = [ref_one(x_np[i], int(y_np[i, 0])) for i in range(B)]
+    np.testing.assert_allclose(np.asarray(res).reshape(-1), expect,
+                               rtol=1e-4)
+
+
+def test_edit_distance_ignored_tokens():
+    hyp = np.array([[0], [1], [2]], dtype=np.int64)   # -> [1,2] after erase
+    ref = np.array([[1], [0], [2]], dtype=np.int64)   # -> [1,2]
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        h = fluid.layers.data("h", shape=[1], dtype="int64", lod_level=1)
+        r = fluid.layers.data("r", shape=[1], dtype="int64", lod_level=1)
+        dist, _ = fluid.layers.edit_distance(h, r, normalized=False,
+                                             ignored_tokens=[0])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (d,) = exe.run(main, feed={"h": create_lod_tensor(hyp, [[3]]),
+                               "r": create_lod_tensor(ref, [[3]])},
+                   fetch_list=[dist])
+    d = np.asarray(d.numpy() if isinstance(d, LoDTensor) else d)
+    assert float(d.reshape(-1)[0]) == 0.0
+
+
+def test_auc_pr_curve_runs():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        pred = fluid.layers.data("pred", shape=[2], dtype="float32")
+        lab = fluid.layers.data("lab", shape=[1], dtype="int64")
+        auc_out, _ = fluid.layers.auc(pred, lab, curve="PR",
+                                      num_thresholds=1024)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    p = np.array([[0.1, 0.9]] * 5 + [[0.9, 0.1]] * 5, dtype=np.float32)
+    y = np.array([[1]] * 5 + [[0]] * 5, dtype=np.int64)
+    (a,) = exe.run(main, feed={"pred": p, "lab": y}, fetch_list=[auc_out])
+    assert float(np.asarray(a)[0]) > 0.95
+
+
+def test_sampling_id():
+    p = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], dtype=np.float32)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32")
+        out = fluid.layers.sampling_id(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (res,) = exe.run(main, feed={"x": p}, fetch_list=[out])
+    np.testing.assert_array_equal(np.asarray(res).reshape(-1), [1, 0])

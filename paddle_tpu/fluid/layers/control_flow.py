@@ -463,7 +463,19 @@ class DynamicRNN:
         self.seq_inputs.append((step_var, x))
         return step_var
 
-    def memory(self, init=None, shape=None, value=0.0, dtype="float32"):
+    def static_input(self, x):
+        """A full (possibly ragged) tensor visible unchanged at every step —
+        realised as an external read closed over by the scan body (the
+        reference's rank-table reordering is unnecessary in the padded
+        encoding; reference control_flow.py DynamicRNN.static_input)."""
+        if self.status != DynamicRNN.IN_RNN:
+            raise ValueError("static_input must be called in block()")
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32",
+               need_reorder=False):
+        # need_reorder is accepted for parity: the padded [B, T, ...]
+        # encoding keeps batch order fixed, so no rank-table reorder exists
         if self.status != DynamicRNN.IN_RNN:
             raise ValueError("memory must be called in block()")
         if init is None:

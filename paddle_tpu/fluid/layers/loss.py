@@ -15,7 +15,8 @@ from .. import core
 __all__ = [
     "linear_chain_crf", "crf_decoding", "warpctc", "ctc_greedy_decoder",
     "edit_distance", "nce", "hsigmoid", "chunk_eval", "mean_iou",
-    "multiplex", "sampling_id", "rank_loss",
+    "multiplex", "sampling_id", "rank_loss", "beam_search",
+    "beam_search_decode",
 ]
 
 
@@ -118,9 +119,12 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         input.dtype, stop_gradient=True)
     sample_labels = helper.create_variable_for_type_inference(
         core.VarDesc.VarType.INT64, stop_gradient=True)
+    nce_inputs = {"Input": input, "Label": label, "Weight": w, "Bias": b}
+    if sample_weight is not None:
+        nce_inputs["SampleWeight"] = sample_weight
     helper.append_op(
         type="nce",
-        inputs={"Input": input, "Label": label, "Weight": w, "Bias": b},
+        inputs=nce_inputs,
         outputs={"Cost": cost, "SampleLogits": sample_logits,
                  "SampleLabels": sample_labels},
         attrs={"num_total_classes": num_total_classes,
@@ -218,6 +222,55 @@ def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
     helper.append_op(type="sampling_id", inputs={"X": x},
                      outputs={"Out": out}, attrs={"seed": seed})
     return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, name=None, return_parent_idx=False):
+    """One beam-search expansion step (reference beam_search_op.cc; layer
+    layers/nn.py beam_search). Dense TPU encoding: every source keeps
+    exactly beam_size rows — see ops/beam_ops.py. Set return_parent_idx to
+    also get the selected beams' parent row indices (needed to decode)."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT64, stop_gradient=True)
+    sel_scores = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    parent_idx = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT32, stop_gradient=True)
+    inputs = {"pre_ids": pre_ids, "pre_scores": pre_scores, "scores": scores}
+    if ids is not None:
+        inputs["ids"] = ids
+    helper.append_op(
+        type="beam_search", inputs=inputs,
+        outputs={"selected_ids": sel_ids, "selected_scores": sel_scores,
+                 "parent_idx": parent_idx},
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level,
+               "is_accumulated": True})
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent_idx
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, parent_idx=None,
+                       name=None):
+    """Reconstruct full hypotheses from per-step beam selections
+    (reference beam_search_decode_op.cc). Takes the stacked [T, B*W] ids /
+    scores / parent pointers (the dense analogue of the reference's
+    TensorArrays+LoD) and returns (sentence_ids, sentence_scores)."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT64, stop_gradient=True)
+    sent_scores = helper.create_variable_for_type_inference(
+        "float32", stop_gradient=True)
+    sent_ids.lod_level = 1
+    inputs = {"Ids": ids, "Scores": scores}
+    if parent_idx is not None:
+        inputs["ParentIdx"] = parent_idx
+    helper.append_op(
+        type="beam_search_decode", inputs=inputs,
+        outputs={"SentenceIds": sent_ids, "SentenceScores": sent_scores},
+        attrs={"beam_size": beam_size, "end_id": end_id})
+    return sent_ids, sent_scores
 
 
 def rank_loss(label, left, right, name=None):

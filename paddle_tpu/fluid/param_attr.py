@@ -29,6 +29,8 @@ class ParamAttr:
             return ParamAttr(name=arg)
         if arg is False:
             return False
+        if arg is True:
+            return ParamAttr()  # reference: True selects the default attr
         from .initializer import Initializer
         if isinstance(arg, Initializer):
             return ParamAttr(initializer=arg)

@@ -1,0 +1,221 @@
+"""Seq2seq machine translation (WMT14-shaped).
+
+Parity with reference benchmark/fluid/models/machine_translation.py
+(seq_to_seq_net: bi-LSTM encoder -> attention LSTM decoder via DynamicRNN,
+cross-entropy, Adam) — the BASELINE.json ragged seq2seq config. The decoder
+is a DynamicRNN whose static_input closes the padded encoder states into the
+lax.scan body; attention is sequence_expand + masked sequence_softmax +
+sequence_pool over the ragged encoder axis.
+
+Generation: the reference decodes with beam_search ops inside a While loop
+over LoD beams; the TPU build unrolls `max_length` dense beam steps (every
+source keeps exactly beam_size rows — ops/beam_ops.py) conditioned on the
+encoder's final state, then beam_search_decode backtracks the stacked
+parent pointers.
+"""
+
+import paddle_tpu.fluid as fluid
+
+
+def lstm_step(x_t, hidden_t_prev, cell_t_prev, size, param_prefix=None):
+    """One LSTM cell step from fc gates (reference lstm_step in
+    benchmark/fluid/models/machine_translation.py). `param_prefix` pins the
+    gate parameter names so an unrolled decode loop shares one cell's
+    weights across all timesteps."""
+    gate_idx = [0]
+
+    def linear(inputs):
+        if param_prefix is None:
+            return fluid.layers.fc(input=inputs, size=size, bias_attr=True)
+        g = gate_idx[0]
+        gate_idx[0] += 1
+        return fluid.layers.fc(
+            input=inputs, size=size,
+            param_attr=[fluid.ParamAttr(name="%s_g%d_w%d" %
+                                        (param_prefix, g, i))
+                        for i in range(len(inputs))],
+            bias_attr=fluid.ParamAttr(name="%s_g%d_b" % (param_prefix, g)))
+
+    forget_gate = fluid.layers.sigmoid(linear([hidden_t_prev, x_t]))
+    input_gate = fluid.layers.sigmoid(linear([hidden_t_prev, x_t]))
+    output_gate = fluid.layers.sigmoid(linear([hidden_t_prev, x_t]))
+    cell_tilde = fluid.layers.tanh(linear([hidden_t_prev, x_t]))
+    cell_t = fluid.layers.sums(input=[
+        fluid.layers.elementwise_mul(x=forget_gate, y=cell_t_prev),
+        fluid.layers.elementwise_mul(x=input_gate, y=cell_tilde)])
+    hidden_t = fluid.layers.elementwise_mul(
+        x=output_gate, y=fluid.layers.tanh(cell_t))
+    return hidden_t, cell_t
+
+
+def bi_lstm_encoder(input_seq, gate_size):
+    fwd_proj = fluid.layers.fc(input=input_seq, size=gate_size * 4,
+                               bias_attr=False)
+    forward, _ = fluid.layers.dynamic_lstm(
+        input=fwd_proj, size=gate_size * 4, use_peepholes=False)
+    rev_proj = fluid.layers.fc(input=input_seq, size=gate_size * 4,
+                               bias_attr=False)
+    reversed_, _ = fluid.layers.dynamic_lstm(
+        input=rev_proj, size=gate_size * 4, is_reverse=True,
+        use_peepholes=False)
+    return forward, reversed_
+
+
+def simple_attention(encoder_vec, encoder_proj, decoder_state, decoder_size):
+    state_proj = fluid.layers.fc(input=decoder_state, size=decoder_size,
+                                 bias_attr=False)
+    state_expand = fluid.layers.sequence_expand(x=state_proj, y=encoder_proj)
+    concated = fluid.layers.concat(input=[encoder_proj, state_expand], axis=1)
+    weights = fluid.layers.fc(input=concated, size=1, act="tanh",
+                              bias_attr=False)
+    weights = fluid.layers.sequence_softmax(input=weights)
+    weights = fluid.layers.reshape(x=weights, shape=[-1])
+    scaled = fluid.layers.elementwise_mul(x=encoder_vec, y=weights, axis=0)
+    return fluid.layers.sequence_pool(input=scaled, pool_type="sum")
+
+
+def seq_to_seq_net(embedding_dim, encoder_size, decoder_size,
+                   source_dict_dim, target_dict_dim, is_generating=False,
+                   beam_size=3, max_length=8):
+    src_word_idx = fluid.layers.data(name="source_sequence", shape=[1],
+                                     dtype="int64", lod_level=1)
+    src_embedding = fluid.layers.embedding(
+        input=src_word_idx, size=[source_dict_dim, embedding_dim],
+        dtype="float32")
+    src_forward, src_reversed = bi_lstm_encoder(src_embedding, encoder_size)
+    encoded_vector = fluid.layers.concat(
+        input=[src_forward, src_reversed], axis=1)
+    encoded_proj = fluid.layers.fc(input=encoded_vector, size=decoder_size,
+                                   bias_attr=False)
+    backward_first = fluid.layers.sequence_pool(input=src_reversed,
+                                                pool_type="first")
+    decoder_boot = fluid.layers.fc(input=backward_first, size=decoder_size,
+                                   bias_attr=False, act="tanh")
+
+    if not is_generating:
+        trg_word_idx = fluid.layers.data(name="target_sequence", shape=[1],
+                                         dtype="int64", lod_level=1)
+        trg_embedding = fluid.layers.embedding(
+            input=trg_word_idx, size=[target_dict_dim, embedding_dim],
+            dtype="float32")
+
+        rnn = fluid.layers.DynamicRNN()
+        cell_init = fluid.layers.fill_constant_batch_size_like(
+            input=decoder_boot, value=0.0, shape=[-1, decoder_size],
+            dtype="float32")
+        cell_init.stop_gradient = False
+        with rnn.block():
+            current_word = rnn.step_input(trg_embedding)
+            encoder_vec = rnn.static_input(encoded_vector)
+            encoder_proj = rnn.static_input(encoded_proj)
+            hidden_mem = rnn.memory(init=decoder_boot, need_reorder=True)
+            cell_mem = rnn.memory(init=cell_init)
+            context = simple_attention(encoder_vec, encoder_proj,
+                                       hidden_mem, decoder_size)
+            decoder_inputs = fluid.layers.concat(
+                input=[context, current_word], axis=1)
+            h, c = lstm_step(decoder_inputs, hidden_mem, cell_mem,
+                             decoder_size)
+            rnn.update_memory(hidden_mem, h)
+            rnn.update_memory(cell_mem, c)
+            out = fluid.layers.fc(input=h, size=target_dict_dim,
+                                  bias_attr=True, act="softmax")
+            rnn.output(out)
+        prediction = rnn()
+
+        label = fluid.layers.data(name="label_sequence", shape=[1],
+                                  dtype="int64", lod_level=1)
+        cost = fluid.layers.cross_entropy(input=prediction, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        feeding_list = ["source_sequence", "target_sequence",
+                        "label_sequence"]
+        return avg_cost, prediction, feeding_list
+
+    # -- generation: dense beam search conditioned on the encoder state --
+    W = beam_size
+    # context [B, D] -> repeat-interleave to [B*W, D] (unsqueeze/expand)
+    ctx0 = fluid.layers.unsqueeze(decoder_boot, axes=[1])      # [B, 1, D]
+    ctx0 = fluid.layers.expand(ctx0, expand_times=[1, W, 1])   # [B, W, D]
+    context = fluid.layers.reshape(ctx0, shape=[-1, decoder_size])
+
+    start_id = 0
+    end_id = 1
+    pre_ids = fluid.layers.fill_constant_batch_size_like(
+        input=context, shape=[-1, 1], value=start_id, dtype="int64")
+    pre_scores = fluid.layers.fill_constant_batch_size_like(
+        input=context, shape=[-1, 1], value=0.0, dtype="float32")
+
+    step_ids, step_scores, step_parents = [], [], []
+    hidden = context
+    cell = fluid.layers.fill_constant_batch_size_like(
+        input=context, shape=[-1, decoder_size], value=0.0, dtype="float32")
+    first = True
+    for t in range(max_length):
+        word_emb = fluid.layers.embedding(
+            input=pre_ids, size=[target_dict_dim, embedding_dim],
+            dtype="float32", param_attr=fluid.ParamAttr(name="trg_emb"))
+        word_emb = fluid.layers.reshape(word_emb,
+                                        shape=[-1, embedding_dim])
+        dec_in = fluid.layers.concat(input=[context, word_emb], axis=1)
+        hidden, cell = lstm_step(dec_in, hidden, cell, decoder_size,
+                                 param_prefix="gen_lstm")
+        probs = fluid.layers.fc(input=hidden, size=target_dict_dim,
+                                act="softmax",
+                                param_attr=fluid.ParamAttr(name="gen_out_w"),
+                                bias_attr=fluid.ParamAttr(name="gen_out_b"))
+        log_probs = fluid.layers.log(probs)
+        accu = fluid.layers.elementwise_add(log_probs, pre_scores, axis=0)
+        if first:
+            # deactivate the W-1 duplicate start beams per source so the
+            # first expansion selects from one beam only (the reference
+            # starts with a single LoD beam per source)
+            first = False
+            accu = fluid.layers.elementwise_add(
+                accu, _beam_slot_mask(context, W), axis=0)
+        sel_ids, sel_scores, parent_idx = fluid.layers.beam_search(
+            pre_ids, pre_scores, None, accu, beam_size=W, end_id=end_id,
+            return_parent_idx=True)
+        step_ids.append(sel_ids)
+        step_scores.append(sel_scores)
+        step_parents.append(parent_idx)
+        pre_ids, pre_scores = sel_ids, sel_scores
+        # reorder recurrent state by parent pointers
+        hidden = fluid.layers.gather(hidden, parent_idx)
+        cell = fluid.layers.gather(cell, parent_idx)
+
+    ids_arr = fluid.layers.stack([fluid.layers.reshape(i, shape=[-1])
+                                  for i in step_ids], axis=0)
+    scores_arr = fluid.layers.stack([fluid.layers.reshape(s, shape=[-1])
+                                     for s in step_scores], axis=0)
+    parents_arr = fluid.layers.stack(step_parents, axis=0)
+    sent_ids, sent_scores = fluid.layers.beam_search_decode(
+        ids_arr, scores_arr, beam_size=W, end_id=end_id,
+        parent_idx=parents_arr)
+    return sent_ids, sent_scores, ["source_sequence"]
+
+
+def _beam_slot_mask(context, W):
+    """[B*W, 1] additive mask: 0 for each source's beam slot 0, -1e9 for
+    the duplicate slots. Rows are grouped per source (row % W = slot)."""
+    ones = fluid.layers.fill_constant_batch_size_like(
+        input=context, shape=[-1, 1], value=1.0, dtype="float32")
+    ramp = fluid.layers.cumsum(ones, axis=0, exclusive=True)   # 0,1,2,...
+    slot = fluid.layers.elementwise_sub(
+        ramp, fluid.layers.scale(
+            fluid.layers.floor(fluid.layers.scale(ramp, scale=1.0 / W)),
+            scale=float(W)))
+    # slot==0 -> 0, else -1e9 (slots are non-negative integers)
+    return fluid.layers.scale(fluid.layers.elementwise_min(slot, ones),
+                              scale=-1e9)
+
+
+def get_model(batch_size=16, embedding_dim=512, encoder_size=512,
+              decoder_size=512, dict_size=30000, lr=0.0002):
+    """Training program (reference get_model: Adam, dict 30k, dim 512)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        avg_cost, prediction, feeding_list = seq_to_seq_net(
+            embedding_dim, encoder_size, decoder_size, dict_size, dict_size,
+            is_generating=False)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(avg_cost)
+    return main, startup, feeding_list, avg_cost, None, prediction

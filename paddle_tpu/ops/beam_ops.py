@@ -1,0 +1,121 @@
+"""Beam search ops.
+
+Reference analogues: paddle/fluid/operators/beam_search_op.cc (per-source
+top-k over candidate expansions with end_id pruning) and
+beam_search_decode_op.cc (backtracking the saved per-step ids/parents into
+full hypotheses).
+
+TPU-first redesign: the reference keeps a *variable* number of live beams
+per source encoded in LoD and shrinks finished beams out of the tensor; XLA
+needs static shapes, so here every source keeps exactly `beam_size` rows at
+all times. Finished beams (pre_id == end_id) contribute one candidate — the
+end token carrying their frozen score — so they survive selection unchanged
+while unfinished beams expand K candidates each. Inactive slots are seeded
+with -inf scores by the caller at step 0 (see layers/beam_search). Decoding
+is a reverse lax.scan over the stacked parent pointers instead of the
+reference's per-sentence pointer chase.
+"""
+
+import numpy as np
+
+from .registry import register_op
+
+_NEG_INF = -1e9
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register_op("beam_search")
+def _beam_search(ctx):
+    """pre_ids/pre_scores [B*W, 1]; ids [B*W, K] (optional — defaults to
+    0..K-1), scores [B*W, K] log-probs (accumulated if is_accumulated).
+    Outputs selected_ids/selected_scores [B*W, 1] and parent_idx [B*W]
+    (global row index of each selected beam's parent)."""
+    jnp = _jnp()
+    pre_ids = ctx.input("pre_ids")
+    pre_scores = ctx.input("pre_scores")
+    scores = ctx.input("scores")
+    ids = ctx.input("ids")
+    W = ctx.attr("beam_size")
+    end_id = ctx.attr("end_id")
+    rows, K = scores.shape
+    B = rows // W
+    if ids is None:
+        ids = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int64)[None, :],
+                               (rows, K))
+    ids = ids.astype(jnp.int32)
+    pre_ids_f = pre_ids.reshape(-1).astype(jnp.int32)
+    pre_scores_f = pre_scores.reshape(-1).astype(jnp.float32)
+    scores = scores.astype(jnp.float32)
+
+    if not ctx.attr("is_accumulated", True):
+        scores = jnp.log(jnp.maximum(scores, 1e-20)) + pre_scores_f[:, None]
+
+    finished = pre_ids_f == end_id
+    # unfinished beams expand K candidates; finished beams contribute one
+    # frozen candidate (the end token at the parent's score)
+    cand_scores = jnp.where(finished[:, None], _NEG_INF, scores)
+    frozen = jnp.where(finished, pre_scores_f, _NEG_INF)[:, None]
+    all_scores = jnp.concatenate([cand_scores, frozen], axis=1)  # [BW, K+1]
+    all_ids = jnp.concatenate(
+        [ids, jnp.full((rows, 1), end_id, jnp.int32)], axis=1)
+
+    flat = all_scores.reshape(B, W * (K + 1))
+    top_scores, top_idx = _topk(flat, W)
+    parent_beam = top_idx // (K + 1)                    # [B, W]
+    cand = top_idx % (K + 1)
+    parent_row = (jnp.arange(B)[:, None] * W + parent_beam)  # [B, W] global
+    sel_ids = jnp.take_along_axis(
+        all_ids.reshape(B, W * (K + 1)), top_idx, axis=1)
+    return {"selected_ids": sel_ids.reshape(-1, 1).astype(jnp.int64),
+            "selected_scores": top_scores.reshape(-1, 1),
+            "parent_idx": parent_row.reshape(-1).astype(jnp.int32)}
+
+
+def _topk(x, k):
+    import jax
+    return jax.lax.top_k(x, k)
+
+
+@register_op("beam_search_decode")
+def _beam_search_decode(ctx):
+    """Ids/ParentIdx stacked [T, B*W] (+ Scores [T, B*W]): backtrack parent
+    pointers from the last step to reconstruct each surviving beam's token
+    sequence. Outputs SentenceIds [B*W, T] (+lens up to and including
+    end_id) and SentenceScores [B*W, 1] (final accumulated score)."""
+    import jax
+    jnp = _jnp()
+    ids = ctx.input("Ids").astype(jnp.int32)            # [T, BW]
+    scores = ctx.input("Scores")                        # [T, BW]
+    end_id = ctx.attr("end_id")
+    T, BW = ids.shape
+    parents = ctx.input("ParentIdx")                    # [T, BW] or absent
+    if parents is None:
+        # no reordering happened: each row is its own chain
+        parents = jnp.broadcast_to(jnp.arange(BW, dtype=jnp.int32)[None, :],
+                                   (T, BW))
+    else:
+        parents = parents.astype(jnp.int32)
+
+    def back(cur_row, inp):
+        ids_t, par_t = inp
+        tok = jnp.take(ids_t, cur_row)
+        prev = jnp.take(par_t, cur_row)
+        return prev, tok
+
+    start = jnp.arange(BW, dtype=jnp.int32)
+    _, toks_rev = jax.lax.scan(back, start, (ids[::-1], parents[::-1]))
+    seq = jnp.flip(toks_rev, axis=0).T                  # [BW, T]
+    # length = first end_id position + 1 (end token kept, as the reference
+    # appends end ids to finished hypotheses), else T
+    is_end = seq == end_id
+    first_end = jnp.argmax(is_end, axis=1)
+    has_end = jnp.any(is_end, axis=1)
+    lens = jnp.where(has_end, first_end + 1, T).astype(jnp.int32)
+    final_scores = scores[-1].reshape(-1, 1)
+    return {"SentenceIds": seq.astype(jnp.int64),
+            "SentenceIds@LOD_LEN": lens,
+            "SentenceScores": final_scores}

@@ -194,6 +194,12 @@ def _recurrent(ctx):
     init_states = ctx.inputs("InitStates")
     param_names = ctx.attr("param_names", [])
     params = dict(zip(param_names, ctx.inputs("Params")))
+    # ragged external reads (DynamicRNN static_input): carry their length
+    # companions into the step env so sequence ops inside the block mask
+    # correctly (attention over the padded encoder output)
+    for name, ln in zip(param_names, ctx.lod_lens("Params")):
+        if ln is not None:
+            params[name + functionalizer.LOD_LEN_SUFFIX] = ln
 
     B, T = xs_list[0].shape[0], xs_list[0].shape[1]
     if lens is None:

@@ -521,6 +521,9 @@ def _nce(ctx):
     # -log(1 - sigmoid(z)) == softplus(z), exact and gradient-stable
     negl = jnp.logaddexp(0.0, adj[:, num_true:]).sum(axis=1)
     cost = (pos + negl)[:, None]
+    sw = ctx.input("SampleWeight")
+    if sw is not None:
+        cost = cost * sw.reshape(-1, 1)
     return {"Cost": cost, "SampleLogits": logits,
             "SampleLabels": samples.astype(jnp.int64)}
 

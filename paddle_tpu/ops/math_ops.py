@@ -286,7 +286,18 @@ _make_reduces()
 @register_op("mean")
 def _mean(ctx):
     jnp = _jnp()
-    return {"Out": jnp.mean(ctx.input("X"))}
+    x = ctx.input("X")
+    lens = ctx.lod_len("X")
+    if lens is not None:
+        # ragged mean = mean over real rows only (packed semantics):
+        # padded positions are excluded from both sum and count
+        B, T = x.shape[0], x.shape[1]
+        m = (jnp.arange(T)[None, :] < lens[:, None]).astype(x.dtype)
+        m = m.reshape((B, T) + (1,) * (x.ndim - 2))
+        per_step = int(np.prod(x.shape[2:])) if x.ndim > 2 else 1
+        return {"Out": jnp.sum(x * m) /
+                jnp.maximum(jnp.sum(lens).astype(x.dtype) * per_step, 1)}
+    return {"Out": jnp.mean(x)}
 
 
 @register_op("arg_max")

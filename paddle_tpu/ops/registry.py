@@ -88,6 +88,12 @@ class ExecContext:
         vs = self._inputs.get(slot + "@LOD_LEN")
         return vs[0] if vs else None
 
+    def lod_lens(self, slot):
+        """Length companions for EVERY input in a multi-input slot (list
+        aligned with inputs(slot); entries are None for dense inputs)."""
+        vs = self._inputs.get(slot + "@LOD_LEN")
+        return vs if vs else [None] * len(self._inputs.get(slot, []))
+
     def rng_key(self):
         """Deterministic per-op, per-step PRNG key. Reproduces the reference's
         per-op `seed` attr semantics (e.g. dropout_op) while staying functional:

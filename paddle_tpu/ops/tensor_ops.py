@@ -178,6 +178,15 @@ def _target_shape(x, shape):
     return tuple(shape)
 
 
+def _ragged_target(ctx, x, shape):
+    """Build-time shapes for ragged vars use the packed [rows, ...] layout;
+    at runtime they are padded [B, T, ...]. A reshape whose leading dim is
+    the ragged -1 therefore maps to [B, T] + rest."""
+    if ctx.lod_len("X") is not None and shape and shape[0] == -1:
+        return tuple(x.shape[:2]) + tuple(int(d) for d in shape[1:])
+    return _target_shape(x, shape)
+
+
 @register_op("reshape")
 def _reshape(ctx):
     jnp = _jnp()
@@ -186,7 +195,7 @@ def _reshape(ctx):
         shape = [int(d) for d in np.asarray(ctx.input("Shape"))]
     else:
         shape = ctx.attr("shape")
-    return {"Out": jnp.reshape(x, _target_shape(x, shape))}
+    return {"Out": jnp.reshape(x, _ragged_target(ctx, x, shape))}
 
 
 @register_op("reshape2")
@@ -194,7 +203,7 @@ def _reshape2(ctx):
     jnp = _jnp()
     x = ctx.input("X")
     shape = ctx.attr("shape")
-    out = jnp.reshape(x, _target_shape(x, shape))
+    out = jnp.reshape(x, _ragged_target(ctx, x, shape))
     return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
 
 
@@ -272,7 +281,10 @@ def _flatten2(ctx):
 @register_op("concat")
 def _concat(ctx):
     jnp = _jnp()
-    return {"Out": jnp.concatenate(ctx.inputs("X"), axis=ctx.attr("axis", 0))}
+    axis = ctx.attr("axis", 0)
+    if ctx.lod_len("X") is not None and axis >= 1:
+        axis += 1  # padded ragged layout inserts the time dim at 1
+    return {"Out": jnp.concatenate(ctx.inputs("X"), axis=axis)}
 
 
 @register_op("split")

@@ -1,0 +1,130 @@
+"""Seq2seq attention model + beam search tests (reference
+unittests/test_machine_translation.py book test, test_beam_search_op.py,
+test_beam_search_decode_op.py)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.lod import create_lod_tensor, LoDTensor
+from paddle_tpu.models import machine_translation as mt
+
+
+def _rand_seq_batch(rng, lens, vocab):
+    rows = sum(lens)
+    return create_lod_tensor(
+        rng.randint(1, vocab, (rows, 1)).astype(np.int64), [lens])
+
+
+def test_mt_attention_train_converges():
+    rng = np.random.RandomState(0)
+    dict_size = 30
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        avg_cost, prediction, feeds = mt.seq_to_seq_net(
+            embedding_dim=16, encoder_size=16, decoder_size=16,
+            source_dict_dim=dict_size, target_dict_dim=dict_size,
+            is_generating=False)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    src_lens, trg_lens = [3, 4], [4, 3]
+    feed = {"source_sequence": _rand_seq_batch(rng, src_lens, dict_size),
+            "target_sequence": _rand_seq_batch(rng, trg_lens, dict_size),
+            "label_sequence": _rand_seq_batch(rng, trg_lens, dict_size)}
+    losses = []
+    for _ in range(30):
+        (l,) = exe.run(main, feed=feed, fetch_list=[avg_cost])
+        losses.append(float(np.asarray(l).flatten()[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_beam_search_op_selects_top_candidates():
+    """1 source, beam 2, vocab 4: hand-checked expansion with a finished
+    beam (reference beam_search_op.cc semantics)."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = fluid.layers.data("pre_ids", shape=[1], dtype="int64")
+        pre_scores = fluid.layers.data("pre_scores", shape=[1],
+                                       dtype="float32")
+        scores = fluid.layers.data("scores", shape=[4], dtype="float32")
+        sel_ids, sel_scores, parent = fluid.layers.beam_search(
+            pre_ids, pre_scores, None, scores, beam_size=2, end_id=0,
+            return_parent_idx=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # beam 0 unfinished (id 2), beam 1 finished (id 0 == end_id, score -0.5)
+    res = exe.run(
+        main,
+        feed={"pre_ids": np.array([[2], [0]], np.int64),
+              "pre_scores": np.array([[-1.0], [-0.5]], np.float32),
+              "scores": np.array([[-9., -2., -3., -1.5],
+                                  [-9., -9., -9., -9.]], np.float32)},
+        fetch_list=[sel_ids, sel_scores, parent])
+    ids_v = np.asarray(res[0]).reshape(-1)
+    scores_v = np.asarray(res[1]).reshape(-1)
+    parent_v = np.asarray(res[2]).reshape(-1)
+    # candidates: beam0 expands {-1.5 (id 3), -2.0 (id 1), ...}; beam1 is
+    # frozen at -0.5 with end token. top2 = frozen -0.5, then -1.5.
+    np.testing.assert_array_equal(ids_v, [0, 3])
+    np.testing.assert_allclose(scores_v, [-0.5, -1.5])
+    np.testing.assert_array_equal(parent_v, [1, 0])
+
+
+def test_beam_search_decode_backtracks():
+    T, BW = 3, 2
+    ids = np.array([[5, 5], [6, 7], [8, 1]], np.int64)       # [T, BW]
+    parents = np.array([[0, 1], [0, 0], [1, 0]], np.int32)
+    scores = np.array([[-1, -1], [-2, -2], [-3, -2.5]], np.float32)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.data("i", shape=[T, BW], dtype="int64",
+                              append_batch_size=False)
+        p = fluid.layers.data("p", shape=[T, BW], dtype="int32",
+                              append_batch_size=False)
+        s = fluid.layers.data("s", shape=[T, BW], dtype="float32",
+                              append_batch_size=False)
+        sent_ids, sent_scores = fluid.layers.beam_search_decode(
+            i, s, beam_size=2, end_id=1, parent_idx=p)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    res_ids, res_scores = exe.run(main, feed={"i": ids, "p": parents,
+                                              "s": scores},
+                                  fetch_list=[sent_ids, sent_scores])
+    assert isinstance(res_ids, LoDTensor)
+    # fetch returns the packed LoD form: rows of all beams concatenated
+    # beam 0 at t2: token 8, parent 1 -> t1 token 7, parent(t1,1)=0 ->
+    # t0 token 5. beam 1 at t2: token 1(end), parent 0 -> t1 token 6 -> 5.
+    np.testing.assert_array_equal(res_ids.numpy().reshape(-1),
+                                  [5, 7, 8, 5, 6, 1])
+    assert res_ids.recursive_sequence_lengths() == [[3, 3]]
+    np.testing.assert_allclose(np.asarray(res_scores).reshape(-1),
+                               [-3.0, -2.5])
+
+
+def test_mt_generation_beam_search():
+    """The unrolled dense beam-search generator runs and emits beam_size
+    ranked hypotheses per source."""
+    rng = np.random.RandomState(1)
+    dict_size = 12
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        sent_ids, sent_scores, feeds = mt.seq_to_seq_net(
+            embedding_dim=8, encoder_size=8, decoder_size=8,
+            source_dict_dim=dict_size, target_dict_dim=dict_size,
+            is_generating=True, beam_size=3, max_length=5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    src = _rand_seq_batch(rng, [3, 2], dict_size)
+    res_ids, res_scores = exe.run(main, feed={"source_sequence": src},
+                                  fetch_list=[sent_ids, sent_scores])
+    assert isinstance(res_ids, LoDTensor)
+    lens = res_ids.recursive_sequence_lengths()[0]
+    scores_np = np.asarray(res_scores).reshape(-1)
+    assert len(lens) == 6                # 2 sources x 3 beams
+    assert all(1 <= l <= 5 for l in lens)
+    assert np.isfinite(scores_np).all()
+    # per-source beams come out ranked best-first
+    assert scores_np[0] >= scores_np[1] >= scores_np[2]
+    assert scores_np[3] >= scores_np[4] >= scores_np[5]

@@ -95,6 +95,10 @@ _LOD_DROP_OPS = frozenset([
     "shape", "accuracy", "top_k",
     "linear_chain_crf", "warpctc", "edit_distance", "chunk_eval", "auc",
     "mean_iou", "precision_recall",
+    # detection ops whose outputs are per-prior (dense), not per-gt (ragged);
+    # NMS-style ops emit their own @LOD_LEN companions explicitly
+    "bipartite_match", "target_assign", "mine_hard_examples",
+    "multiclass_nms", "generate_proposals",
 ])
 
 

@@ -14,6 +14,8 @@ from . import control_flow
 from .control_flow import *  # noqa: F401,F403
 from . import loss
 from .loss import *  # noqa: F401,F403
+from . import detection
+from .detection import *  # noqa: F401,F403
 from . import math_op_patch
 from .math_op_patch import monkey_patch_variable
 
@@ -29,5 +31,6 @@ __all__ += control_flow.__all__
 __all__ += tensor.__all__
 __all__ += ops.__all__
 __all__ += loss.__all__
+__all__ += detection.__all__
 __all__ += ["data", "py_reader", "batch", "double_buffer", "read_file"]
 __all__ += learning_rate_scheduler.__all__

@@ -1,0 +1,437 @@
+"""Detection layer API (SSD / RPN heads).
+
+Reference analogue: python/paddle/fluid/layers/detection.py (1.7k LoC) —
+prior_box, multi_box_head, bipartite_match, target_assign, ssd_loss,
+detection_output, iou_similarity, box_coder, roi_pool/align,
+anchor_generator, generate_proposals, rpn_target_assign,
+polygon_box_transform. Each function appends ops whose lowerings live in
+paddle_tpu/ops/detection_ops.py.
+
+Ragged outputs (NMS results, proposals) follow the framework-wide padded +
+`@LOD_LEN` companion encoding instead of the reference's LoDTensor.
+"""
+
+from ..layer_helper import LayerHelper
+from ..framework import Variable
+from .. import core
+from . import nn
+from . import tensor as tensor_layers
+
+__all__ = [
+    "prior_box", "density_prior_box", "multi_box_head", "bipartite_match",
+    "target_assign", "detection_output", "ssd_loss", "iou_similarity",
+    "box_coder", "roi_pool", "roi_align", "anchor_generator",
+    "generate_proposals", "rpn_target_assign", "polygon_box_transform",
+    "box_clip", "multiclass_nms",
+]
+
+
+def _two_outputs(helper, op_type, inputs, attrs, names=("Out", "Out2"),
+                 dtypes=None):
+    outs = []
+    dtypes = dtypes or ["float32"] * len(names)
+    outputs = {}
+    for slot, dt in zip(names, dtypes):
+        v = helper.create_variable_for_type_inference(dtype=dt)
+        outputs[slot] = v
+        outs.append(v)
+    helper.append_op(type=op_type, inputs=inputs, outputs=outputs,
+                     attrs=attrs)
+    return outs
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    """reference layers/detection.py prior_box."""
+    helper = LayerHelper("prior_box", name=name)
+    if not isinstance(min_sizes, (list, tuple)):
+        min_sizes = [min_sizes]
+    attrs = {"min_sizes": [float(s) for s in min_sizes],
+             "aspect_ratios": [float(a) for a in aspect_ratios],
+             "variances": [float(v) for v in variance],
+             "flip": flip, "clip": clip,
+             "step_w": float(steps[0]), "step_h": float(steps[1]),
+             "offset": float(offset),
+             "min_max_aspect_ratios_order": bool(min_max_aspect_ratios_order)}
+    if max_sizes:
+        if not isinstance(max_sizes, (list, tuple)):
+            max_sizes = [max_sizes]
+        attrs["max_sizes"] = [float(s) for s in max_sizes]
+    boxes, var = _two_outputs(helper, "prior_box",
+                              {"Input": input, "Image": image}, attrs,
+                              names=("Boxes", "Variances"),
+                              dtypes=[input.dtype, input.dtype])
+    boxes.stop_gradient = True
+    var.stop_gradient = True
+    return boxes, var
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper("density_prior_box", name=name)
+    attrs = {"densities": [int(d) for d in densities],
+             "fixed_sizes": [float(s) for s in fixed_sizes],
+             "fixed_ratios": [float(r) for r in fixed_ratios],
+             "variances": [float(v) for v in variance],
+             "clip": clip, "step_w": float(steps[0]),
+             "step_h": float(steps[1]), "offset": float(offset)}
+    boxes, var = _two_outputs(helper, "density_prior_box",
+                              {"Input": input, "Image": image}, attrs,
+                              names=("Boxes", "Variances"),
+                              dtypes=[input.dtype, input.dtype])
+    boxes.stop_gradient = True
+    var.stop_gradient = True
+    return boxes, var
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": x, "Y": y},
+                     outputs={"Out": out},
+                     attrs={"box_normalized": box_normalized})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    helper = LayerHelper("box_coder", name=name)
+    out = helper.create_variable_for_type_inference(dtype=target_box.dtype)
+    inputs = {"PriorBox": prior_box, "TargetBox": target_box}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized}
+    if isinstance(prior_box_var, Variable):
+        inputs["PriorBoxVar"] = prior_box_var
+    elif prior_box_var is not None:
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    helper.append_op(type="box_coder", inputs=inputs,
+                     outputs={"OutputBox": out}, attrs=attrs)
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    midx = helper.create_variable_for_type_inference(dtype="int32")
+    mdist = helper.create_variable_for_type_inference(
+        dtype=dist_matrix.dtype)
+    helper.append_op(type="bipartite_match",
+                     inputs={"DistMat": dist_matrix},
+                     outputs={"ColToRowMatchIndices": midx,
+                              "ColToRowMatchDist": mdist},
+                     attrs={"match_type": match_type,
+                            "dist_threshold": dist_threshold})
+    midx.stop_gradient = True
+    mdist.stop_gradient = True
+    return midx, mdist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    out_weight = helper.create_variable_for_type_inference(dtype="float32")
+    inputs = {"X": input, "MatchIndices": matched_indices}
+    if negative_indices is not None:
+        inputs["NegIndices"] = negative_indices
+    helper.append_op(type="target_assign", inputs=inputs,
+                     outputs={"Out": out, "OutWeight": out_weight},
+                     attrs={"mismatch_value": mismatch_value})
+    out.stop_gradient = True
+    out_weight.stop_gradient = True
+    return out, out_weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(dtype=bboxes.dtype)
+    helper.append_op(type="multiclass_nms",
+                     inputs={"BBoxes": bboxes, "Scores": scores},
+                     outputs={"Out": out},
+                     attrs={"background_label": background_label,
+                            "score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "nms_threshold": nms_threshold,
+                            "keep_top_k": keep_top_k,
+                            "normalized": normalized,
+                            "nms_eta": float(nms_eta)})
+    out.stop_gradient = True
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """reference layers/detection.py detection_output: decode + softmax +
+    class-wise NMS. loc [N, P, 4], scores [N, P, C] logits."""
+    decoded = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
+                        target_box=loc, code_type="decode_center_size")
+    probs = nn.softmax(scores)
+    probs_t = nn.transpose(probs, perm=[0, 2, 1])   # [N, C, P]
+    return multiclass_nms(bboxes=decoded, scores=probs_t,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, nms_eta=nms_eta,
+                          background_label=background_label)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None):
+    """reference layers/detection.py ssd_loss — full SSD multibox loss:
+    match priors to gt (bipartite + per-prediction), mine hard negatives,
+    assign loc/conf targets, smooth-l1 + softmax losses.
+
+    location [N, P, 4]; confidence [N, P, C]; gt_box [N, G, 4] padded
+    (lod companion carries per-image counts); gt_label [N, G, 1]."""
+    helper = LayerHelper("ssd_loss")
+    P = location.shape[1]
+    C = confidence.shape[-1]
+
+    def _to_2d(v, k):
+        return nn.reshape(v, shape=[-1, k])
+
+    def _per_prior(v):          # [N*P, 1] -> [N, P]
+        return nn.reshape(v, shape=[-1, P])
+
+    # 1. similarity + matching
+    iou = iou_similarity(x=gt_box, y=prior_box)
+    matched_indices, matched_dist = bipartite_match(
+        iou, match_type, overlap_threshold)
+
+    # 2. conf loss over all priors (for mining)
+    target_label_all, _ = target_assign(
+        gt_label, matched_indices, mismatch_value=background_label)
+    conf_all = nn.softmax_with_cross_entropy(
+        _to_2d(confidence, C),
+        tensor_layers.cast(_to_2d(target_label_all, 1), "int64"))
+    conf_all = _per_prior(conf_all)
+
+    # 3. hard-negative mining
+    neg_indices = helper.create_variable_for_type_inference(dtype="int32")
+    updated_match = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="mine_hard_examples",
+        inputs={"ClsLoss": conf_all, "MatchIndices": matched_indices,
+                "MatchDist": matched_dist},
+        outputs={"NegIndices": neg_indices,
+                 "UpdatedMatchIndices": updated_match},
+        attrs={"neg_pos_ratio": float(neg_pos_ratio),
+               "neg_dist_threshold": float(neg_overlap),
+               "sample_size": int(sample_size or 0),
+               "mining_type": mining_type})
+    neg_indices.stop_gradient = True
+    updated_match.stop_gradient = True
+
+    # 4. targets: location (encoded gt) and confidence (labels + negatives)
+    encoded = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
+                        target_box=gt_box, code_type="encode_center_size")
+    loc_target, loc_weight = target_assign(
+        encoded, updated_match, mismatch_value=0)
+    label_target, conf_weight = target_assign(
+        gt_label, updated_match, negative_indices=neg_indices,
+        mismatch_value=background_label)
+
+    # 5. losses (reference reshapes everything to 2-D first)
+    loc_target.stop_gradient = True
+    loc_loss = nn.smooth_l1(_to_2d(location, 4), _to_2d(loc_target, 4))
+    loc_loss = _per_prior(loc_loss)                    # [N, P]
+    loc_loss = loc_loss * _per_prior(loc_weight)
+    conf_loss = nn.softmax_with_cross_entropy(
+        _to_2d(confidence, C),
+        tensor_layers.cast(_to_2d(label_target, 1), "int64"))
+    conf_loss = _per_prior(conf_loss)
+    conf_loss = conf_loss * _per_prior(conf_weight)
+    loss = loc_loss_weight * loc_loss + conf_loss_weight * conf_loss
+    if normalize:
+        # normalize by number of matched (positive) priors, >= 1
+        denom = nn.reduce_sum(nn.reduce_sum(loc_weight, dim=1), dim=0)
+        denom = nn.elementwise_max(
+            denom, tensor_layers.fill_constant([1], "float32", 1.0))
+        loss = nn.elementwise_div(nn.reduce_sum(loss, dim=1, keep_dim=True),
+                                  denom)
+    return loss
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """reference layers/detection.py multi_box_head: per-feature-map prior
+    boxes + conv loc/conf heads, concatenated over maps.
+    Returns (mbox_locs [N,P,4], mbox_confs [N,P,C], boxes [P,4], vars [P,4])
+    """
+    import numpy as np
+    if min_sizes is None:
+        num_layer = len(inputs)
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_list, vars_list = [], [], [], []
+    for i, input in enumerate(inputs):
+        min_size = min_sizes[i]
+        max_size = max_sizes[i] if max_sizes else None
+        if not isinstance(min_size, list):
+            min_size = [min_size]
+        if max_size is not None and not isinstance(max_size, list):
+            max_size = [max_size]
+        ar = aspect_ratios[i]
+        if not isinstance(ar, list):
+            ar = [ar]
+        step = [float(steps[i][0]), float(steps[i][1])] if steps else \
+            [step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0]
+        box, var = prior_box(input, image, min_size, max_size, ar,
+                             variance, flip, clip, step, offset)
+        # box is [H, W, num_priors, 4]; feature-map extent is static so the
+        # per-map prior count H*W*num_priors is a compile-time constant —
+        # reshapes below stay fully static even with a dynamic batch dim
+        H, W, num_priors = box.shape[0], box.shape[1], box.shape[2]
+        map_priors = H * W * num_priors
+        box = nn.reshape(box, shape=[-1, 4])
+        var = nn.reshape(var, shape=[-1, 4])
+        boxes_list.append(box)
+        vars_list.append(var)
+
+        num_loc_output = num_priors * 4
+        mbox_loc = nn.conv2d(input=input, num_filters=num_loc_output,
+                             filter_size=kernel_size, padding=pad,
+                             stride=stride)
+        mbox_loc = nn.transpose(mbox_loc, perm=[0, 2, 3, 1])
+        mbox_loc = nn.reshape(mbox_loc, shape=[-1, map_priors, 4])
+        locs.append(mbox_loc)
+
+        num_conf_output = num_priors * num_classes
+        conf = nn.conv2d(input=input, num_filters=num_conf_output,
+                         filter_size=kernel_size, padding=pad, stride=stride)
+        conf = nn.transpose(conf, perm=[0, 2, 3, 1])
+        conf = nn.reshape(conf, shape=[-1, map_priors, num_classes])
+        confs.append(conf)
+
+    mbox_locs = nn.concat(locs, axis=1)
+    mbox_confs = nn.concat(confs, axis=1)
+    boxes = nn.concat(boxes_list, axis=0)
+    vars = nn.concat(vars_list, axis=0)
+    return mbox_locs, mbox_confs, boxes, vars
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    attrs = {"anchor_sizes": [float(s) for s in anchor_sizes],
+             "aspect_ratios": [float(a) for a in aspect_ratios],
+             "variances": [float(v) for v in variance],
+             "stride": [float(s) for s in stride], "offset": float(offset)}
+    anchors, var = _two_outputs(helper, "anchor_generator",
+                                {"Input": input}, attrs,
+                                names=("Anchors", "Variances"),
+                                dtypes=[input.dtype, input.dtype])
+    anchors.stop_gradient = True
+    var.stop_gradient = True
+    return anchors, var
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    helper = LayerHelper("roi_pool")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="roi_pool",
+                     inputs={"X": input, "ROIs": rois},
+                     outputs={"Out": out},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="roi_align",
+                     inputs={"X": input, "ROIs": rois},
+                     outputs={"Out": out},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale,
+                            "sampling_ratio": sampling_ratio})
+    return out
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference(dtype=scores.dtype)
+    probs = helper.create_variable_for_type_inference(dtype=scores.dtype)
+    helper.append_op(type="generate_proposals",
+                     inputs={"Scores": scores, "BboxDeltas": bbox_deltas,
+                             "ImInfo": im_info, "Anchors": anchors,
+                             "Variances": variances},
+                     outputs={"RpnRois": rois, "RpnRoiProbs": probs},
+                     attrs={"pre_nms_topN": pre_nms_top_n,
+                            "post_nms_topN": post_nms_top_n,
+                            "nms_thresh": nms_thresh, "min_size": min_size})
+    rois.stop_gradient = True
+    probs.stop_gradient = True
+    return rois, probs
+
+
+def rpn_target_assign(loc, scores, anchor_box, anchor_var, gt_box,
+                      rpn_batch_size_per_im=256, fg_fraction=0.25,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3):
+    """RPN anchor labeling + fg/bg-balanced sampling (reference
+    rpn_target_assign). Returns (predicted_loc, predicted_scores,
+    target_label, target_bbox) gathered at the sampled anchor positions,
+    padded to rpn_batch_size_per_im rows per image with real counts in the
+    @LOD_LEN companion (fetched as packed LoDTensors). Sampling is
+    deterministic (IoU-ranked) instead of random so it reproduces under jit;
+    fg/bg counts match the reference scheme."""
+    helper = LayerHelper("rpn_target_assign")
+    pl = helper.create_variable_for_type_inference(dtype=loc.dtype)
+    ps = helper.create_variable_for_type_inference(dtype=scores.dtype)
+    lab = helper.create_variable_for_type_inference(dtype="int32")
+    tb = helper.create_variable_for_type_inference(dtype=loc.dtype)
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"Loc": loc, "Scores": scores, "Anchor": anchor_box,
+                "AnchorVar": anchor_var, "GtBox": gt_box},
+        outputs={"PredictedLocation": pl, "PredictedScores": ps,
+                 "TargetLabel": lab, "TargetBBox": tb},
+        attrs={"rpn_batch_size_per_im": int(rpn_batch_size_per_im),
+               "fg_fraction": float(fg_fraction),
+               "rpn_positive_overlap": float(rpn_positive_overlap),
+               "rpn_negative_overlap": float(rpn_negative_overlap)})
+    for v in (lab, tb):
+        v.stop_gradient = True
+    return pl, ps, lab, tb
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="polygon_box_transform", inputs={"Input": input},
+                     outputs={"Output": out})
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type="box_clip",
+                     inputs={"Input": input, "ImInfo": im_info},
+                     outputs={"Output": out})
+    return out

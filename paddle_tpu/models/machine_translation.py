@@ -97,7 +97,7 @@ def seq_to_seq_net(embedding_dim, encoder_size, decoder_size,
                                          dtype="int64", lod_level=1)
         trg_embedding = fluid.layers.embedding(
             input=trg_word_idx, size=[target_dict_dim, embedding_dim],
-            dtype="float32")
+            dtype="float32", param_attr=fluid.ParamAttr(name="trg_emb"))
 
         rnn = fluid.layers.DynamicRNN()
         cell_init = fluid.layers.fill_constant_batch_size_like(
@@ -115,11 +115,15 @@ def seq_to_seq_net(embedding_dim, encoder_size, decoder_size,
             decoder_inputs = fluid.layers.concat(
                 input=[context, current_word], axis=1)
             h, c = lstm_step(decoder_inputs, hidden_mem, cell_mem,
-                             decoder_size)
+                             decoder_size, param_prefix="decoder_lstm")
             rnn.update_memory(hidden_mem, h)
             rnn.update_memory(cell_mem, c)
-            out = fluid.layers.fc(input=h, size=target_dict_dim,
-                                  bias_attr=True, act="softmax")
+            # shared names with the generation decoder so trained weights
+            # drive beam-search decoding
+            out = fluid.layers.fc(
+                input=h, size=target_dict_dim, act="softmax",
+                param_attr=fluid.ParamAttr(name="decoder_out_w"),
+                bias_attr=fluid.ParamAttr(name="decoder_out_b"))
             rnn.output(out)
         prediction = rnn()
 
@@ -158,11 +162,11 @@ def seq_to_seq_net(embedding_dim, encoder_size, decoder_size,
                                         shape=[-1, embedding_dim])
         dec_in = fluid.layers.concat(input=[context, word_emb], axis=1)
         hidden, cell = lstm_step(dec_in, hidden, cell, decoder_size,
-                                 param_prefix="gen_lstm")
-        probs = fluid.layers.fc(input=hidden, size=target_dict_dim,
-                                act="softmax",
-                                param_attr=fluid.ParamAttr(name="gen_out_w"),
-                                bias_attr=fluid.ParamAttr(name="gen_out_b"))
+                                 param_prefix="decoder_lstm")
+        probs = fluid.layers.fc(
+            input=hidden, size=target_dict_dim, act="softmax",
+            param_attr=fluid.ParamAttr(name="decoder_out_w"),
+            bias_attr=fluid.ParamAttr(name="decoder_out_b"))
         log_probs = fluid.layers.log(probs)
         accu = fluid.layers.elementwise_add(log_probs, pre_scores, axis=0)
         if first:

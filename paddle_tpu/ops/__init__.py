@@ -14,6 +14,7 @@ from . import control_flow_ops  # noqa: F401
 from . import distributed_ops   # noqa: F401
 from . import loss_ops          # noqa: F401
 from . import beam_ops          # noqa: F401
+from . import detection_ops     # noqa: F401
 
 from .registry import (  # noqa: F401
     register_op, get_op_def, has_op, registered_ops, infer_shape, ExecContext,

@@ -66,7 +66,6 @@ def _beam_search(ctx):
     flat = all_scores.reshape(B, W * (K + 1))
     top_scores, top_idx = _topk(flat, W)
     parent_beam = top_idx // (K + 1)                    # [B, W]
-    cand = top_idx % (K + 1)
     parent_row = (jnp.arange(B)[:, None] * W + parent_beam)  # [B, W] global
     sel_ids = jnp.take_along_axis(
         all_ids.reshape(B, W * (K + 1)), top_idx, axis=1)

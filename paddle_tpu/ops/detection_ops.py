@@ -1,0 +1,890 @@
+"""Detection op lowerings (SSD / Faster-RCNN families).
+
+Reference analogues: paddle/fluid/operators/detection/ — prior_box_op.cc,
+density_prior_box_op.cc, box_coder_op.cc, iou_similarity_op.cc,
+bipartite_match_op.cc, target_assign_op.cc, mine_hard_examples_op.cc,
+multiclass_nms_op.cc, anchor_generator_op.cc, generate_proposals_op.cc,
+roi_pool_op.cc (operators/), roi_align_op.cc, polygon_box_transform_op.cc,
+box_clip (and SURVEY.md §2.2 "Detection" row).
+
+TPU-first redesign: the reference emits LoD (ragged) outputs for NMS-style
+ops, with data-dependent row counts computed on the host. XLA requires static
+shapes, so every "variable number of boxes" output here is a fixed-capacity
+padded tensor plus an int32 count carried as the `@LOD_LEN` companion (the
+framework-wide ragged encoding, see fluid/lod.py). Greedy algorithms
+(bipartite match, NMS) become fixed-trip-count `lax.fori_loop`s over
+precomputed pairwise IoU matrices — O(M^2) matrices are small (M = boxes per
+class) and map onto the VPU/MXU far better than the reference's host-side
+pointer chasing.
+"""
+
+import numpy as np
+
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# geometry helpers
+# ---------------------------------------------------------------------------
+
+def box_area(boxes, normalized=True):
+    jnp = _jnp()
+    off = 0.0 if normalized else 1.0
+    w = jnp.maximum(boxes[..., 2] - boxes[..., 0] + off, 0.0)
+    h = jnp.maximum(boxes[..., 3] - boxes[..., 1] + off, 0.0)
+    return w * h
+
+
+def iou_matrix(a, b, normalized=True):
+    """a [N,4], b [M,4] -> IoU [N,M] (reference iou_similarity_op.h)."""
+    jnp = _jnp()
+    off = 0.0 if normalized else 1.0
+    xmin = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    ymin = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    xmax = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    ymax = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(xmax - xmin + off, 0.0)
+    ih = jnp.maximum(ymax - ymin + off, 0.0)
+    inter = iw * ih
+    union = box_area(a, normalized)[:, None] + \
+        box_area(b, normalized)[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op("iou_similarity")
+def _iou_similarity(ctx):
+    x = ctx.input("X")      # [N,4] or [B,N,4]
+    y = ctx.input("Y")      # [M,4]
+    normalized = bool(ctx.attr("box_normalized", True))
+    if x.ndim == 3:
+        import jax
+        out = jax.vmap(lambda xb: iou_matrix(xb, y, normalized))(x)
+    else:
+        out = iou_matrix(x, y, normalized)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# prior / anchor generation (prior_box_op.h, anchor_generator_op.h)
+# ---------------------------------------------------------------------------
+
+def _prior_cell_sizes(min_sizes, max_sizes, aspect_ratios, flip,
+                      min_max_order=False):
+    """Per-cell (w, h) half-extent list in the reference's emission order
+    (prior_box_op.h: per min_size -> each aspect ratio -> the max_size
+    prior; with min_max_aspect_ratios_order=True: min, max, then the non-1
+    aspect ratios), with aspect_ratios expanded to include 1.0 first."""
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - e) > 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip and abs(ar) > 1e-6:
+                inv = 1.0 / float(ar)
+                if all(abs(inv - e) > 1e-6 for e in ars):
+                    ars.append(inv)
+    sizes = []
+    for i, ms in enumerate(min_sizes):
+        if min_max_order:
+            sizes.append((ms, ms))
+            if max_sizes:
+                s = np.sqrt(ms * max_sizes[i])
+                sizes.append((s, s))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                s = np.sqrt(ms * max_sizes[i])
+                sizes.append((s, s))
+    return sizes
+
+
+@register_op("prior_box")
+def _prior_box(ctx):
+    jnp = _jnp()
+    feat = ctx.input("Input")   # [N, C, H, W]
+    image = ctx.input("Image")  # [N, C, imH, imW]
+    H, W = feat.shape[2], feat.shape[3]
+    im_h, im_w = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in ctx.attr("min_sizes")]
+    max_sizes = [float(s) for s in ctx.attr("max_sizes", []) or []]
+    ars = [float(a) for a in ctx.attr("aspect_ratios", [1.0]) or [1.0]]
+    flip = bool(ctx.attr("flip", False))
+    clip = bool(ctx.attr("clip", False))
+    variances = [float(v) for v in
+                 ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(ctx.attr("step_w", 0.0) or 0.0)
+    step_h = float(ctx.attr("step_h", 0.0) or 0.0)
+    offset = float(ctx.attr("offset", 0.5))
+    if step_w <= 0:
+        step_w = im_w / float(W)
+    if step_h <= 0:
+        step_h = im_h / float(H)
+
+    sizes = _prior_cell_sizes(
+        min_sizes, max_sizes, ars, flip,
+        bool(ctx.attr("min_max_aspect_ratios_order", False)))
+    P = len(sizes)
+    half = np.asarray(sizes, np.float32) / 2.0          # [P, 2] (w/2, h/2)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w   # [W]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h   # [H]
+    cxg = jnp.broadcast_to(cx[None, :, None], (H, W, P))
+    cyg = jnp.broadcast_to(cy[:, None, None], (H, W, P))
+    hw = jnp.asarray(half[:, 0])[None, None, :]
+    hh = jnp.asarray(half[:, 1])[None, None, :]
+    boxes = jnp.stack([(cxg - hw) / im_w, (cyg - hh) / im_h,
+                       (cxg + hw) / im_w, (cyg + hh) / im_h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, boxes.dtype),
+                           (H, W, P, 4))
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("density_prior_box")
+def _density_prior_box(ctx):
+    """density_prior_box_op.cc: densified fixed-size priors."""
+    jnp = _jnp()
+    feat = ctx.input("Input")
+    image = ctx.input("Image")
+    H, W = feat.shape[2], feat.shape[3]
+    im_h, im_w = image.shape[2], image.shape[3]
+    fixed_sizes = [float(s) for s in ctx.attr("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in ctx.attr("fixed_ratios", [])]
+    densities = [int(d) for d in ctx.attr("densities", [])]
+    variances = [float(v) for v in
+                 ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(ctx.attr("clip", False))
+    offset = float(ctx.attr("offset", 0.5))
+    step_w = float(ctx.attr("step_w", 0.0) or 0.0) or im_w / float(W)
+    step_h = float(ctx.attr("step_h", 0.0) or 0.0) or im_h / float(H)
+
+    # per-cell offsets/sizes computed in numpy (static), broadcast on device
+    offs = []  # (dx, dy, w/2, h/2) relative to cell center
+    for k, fs in enumerate(fixed_sizes):
+        d = densities[k]
+        shift = fs / d
+        for ar in fixed_ratios:
+            bw = fs * np.sqrt(ar)
+            bh = fs / np.sqrt(ar)
+            for di in range(d):
+                for dj in range(d):
+                    dx = -fs / 2.0 + shift / 2.0 + dj * shift
+                    dy = -fs / 2.0 + shift / 2.0 + di * shift
+                    offs.append((dx, dy, bw / 2.0, bh / 2.0))
+    offs = np.asarray(offs, np.float32)   # [P, 4]
+    P = len(offs)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg = jnp.broadcast_to(
+        cx[None, :, None] + jnp.asarray(offs[:, 0])[None, None, :],
+        (H, W, P))
+    cyg = jnp.broadcast_to(
+        cy[:, None, None] + jnp.asarray(offs[:, 1])[None, None, :],
+        (H, W, P))
+    hw = jnp.broadcast_to(jnp.asarray(offs[:, 2])[None, None, :], (H, W, P))
+    hh = jnp.broadcast_to(jnp.asarray(offs[:, 3])[None, None, :], (H, W, P))
+    boxes = jnp.stack([(cxg - hw) / im_w, (cyg - hh) / im_h,
+                       (cxg + hw) / im_w, (cyg + hh) / im_h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, boxes.dtype),
+                           (H, W, P, 4))
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("anchor_generator")
+def _anchor_generator(ctx):
+    """anchor_generator_op.h: anchors from sizes x aspect ratios on a stride
+    grid, in input-image (pixel) coordinates."""
+    jnp = _jnp()
+    feat = ctx.input("Input")
+    H, W = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in ctx.attr("anchor_sizes")]
+    ars = [float(a) for a in ctx.attr("aspect_ratios")]
+    stride = [float(s) for s in ctx.attr("stride")]
+    variances = [float(v) for v in
+                 ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])]
+    offset = float(ctx.attr("offset", 0.5))
+
+    half = []
+    for ar in ars:
+        for s in sizes:
+            area = stride[0] * stride[1]
+            area_ratios = area / ar
+            base_w = np.round(np.sqrt(area_ratios))
+            base_h = np.round(base_w * ar)
+            scale_w = s / stride[0]
+            scale_h = s / stride[1]
+            half.append((scale_w * base_w / 2.0, scale_h * base_h / 2.0))
+    half = np.asarray(half, np.float32)
+    A = len(half)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    cxg = jnp.broadcast_to(cx[None, :, None], (H, W, A))
+    cyg = jnp.broadcast_to(cy[:, None, None], (H, W, A))
+    hw = jnp.asarray(half[:, 0])[None, None, :]
+    hh = jnp.asarray(half[:, 1])[None, None, :]
+    anchors = jnp.stack([cxg - hw, cyg - hh, cxg + hw, cyg + hh], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, anchors.dtype),
+                           (H, W, A, 4))
+    return {"Anchors": anchors, "Variances": var}
+
+
+# ---------------------------------------------------------------------------
+# box coder (box_coder_op.h)
+# ---------------------------------------------------------------------------
+
+def _encode_center_size(target, prior, pvar, wh_offset=0.0):
+    """target [N,4] gt, prior [M,4] -> [N,M,4] deltas. wh_offset=1 for
+    pixel-coordinate boxes (reference box_coder_op.h +1 widths)."""
+    jnp = _jnp()
+    pw = prior[:, 2] - prior[:, 0] + wh_offset
+    ph = prior[:, 3] - prior[:, 1] + wh_offset
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    tw = target[:, None, 2] - target[:, None, 0] + wh_offset
+    th = target[:, None, 3] - target[:, None, 1] + wh_offset
+    tcx = target[:, None, 0] + tw * 0.5
+    tcy = target[:, None, 1] + th * 0.5
+    ox = (tcx - pcx[None, :]) / pw[None, :]
+    oy = (tcy - pcy[None, :]) / ph[None, :]
+    ow = jnp.log(jnp.abs(tw / pw[None, :]))
+    oh = jnp.log(jnp.abs(th / ph[None, :]))
+    out = jnp.stack([ox, oy, ow, oh], axis=-1)
+    if pvar is not None:
+        out = out / pvar[None, :, :]
+    return out
+
+
+def _decode_center_size(target, prior, pvar, wh_offset=0.0):
+    """target [N,M,4] (or [M,4]) deltas, prior [M,4] -> corner boxes of the
+    same rank. wh_offset=1 for pixel coordinates: +1 widths and -1 on the
+    decoded xmax/ymax (reference box_coder_op.h)."""
+    jnp = _jnp()
+    squeeze = target.ndim == 2
+    if squeeze:
+        target = target[None]
+    pw = prior[:, 2] - prior[:, 0] + wh_offset
+    ph = prior[:, 3] - prior[:, 1] + wh_offset
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if pvar is not None:
+        target = target * pvar[None, :, :]
+    cx = target[..., 0] * pw[None, :] + pcx[None, :]
+    cy = target[..., 1] * ph[None, :] + pcy[None, :]
+    w = jnp.exp(target[..., 2]) * pw[None, :]
+    h = jnp.exp(target[..., 3]) * ph[None, :]
+    out = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                     cx + w * 0.5 - wh_offset,
+                     cy + h * 0.5 - wh_offset], axis=-1)
+    return out[0] if squeeze else out
+
+
+@register_op("box_coder")
+def _box_coder(ctx):
+    jnp = _jnp()
+    prior = ctx.input("PriorBox")       # [M, 4]
+    pvar = ctx.input("PriorBoxVar")     # [M, 4] or None
+    target = ctx.input("TargetBox")
+    code_type = ctx.attr("code_type", "encode_center_size")
+    if pvar is None:
+        v = ctx.attr("variance", []) or []
+        if v:
+            pvar = jnp.broadcast_to(jnp.asarray(v, prior.dtype),
+                                    (prior.shape[0], 4))
+    norm = bool(ctx.attr("box_normalized", True))
+    wh_offset = 0.0 if norm else 1.0
+    if code_type.lower() == "encode_center_size":
+        if target.ndim == 3:       # [B, G, 4] padded batch of gt boxes
+            import jax
+            out = jax.vmap(
+                lambda t: _encode_center_size(t, prior, pvar,
+                                              wh_offset))(target)
+        else:
+            out = _encode_center_size(target, prior, pvar, wh_offset)
+    else:
+        out = _decode_center_size(target, prior, pvar, wh_offset)
+    return {"OutputBox": out}
+
+
+# ---------------------------------------------------------------------------
+# bipartite matching (bipartite_match_op.cc)
+# ---------------------------------------------------------------------------
+
+def _bipartite_match_one(dist):
+    """dist [N, M] -> (match_idx [M] int32, match_dist [M]).
+    Greedy global-max matching: repeatedly take the largest remaining entry,
+    match its row/col, until nothing positive is left."""
+    import jax
+    jnp = _jnp()
+    N, M = dist.shape
+    steps = min(N, M)
+
+    def body(_, state):
+        d, midx, mdist = state
+        flat = jnp.argmax(d)
+        r, c = flat // M, flat % M
+        val = d[r, c]
+        do = val > 0
+        midx = jnp.where(do, midx.at[c].set(r.astype(jnp.int32)), midx)
+        mdist = jnp.where(do, mdist.at[c].set(val), mdist)
+        d = jnp.where(do, d.at[r, :].set(-1.0).at[:, c].set(-1.0), d)
+        return d, midx, mdist
+
+    midx = jnp.full((M,), -1, jnp.int32)
+    mdist = jnp.zeros((M,), dist.dtype)
+    _, midx, mdist = jax.lax.fori_loop(
+        0, steps, body, (dist, midx, mdist))
+    return midx, mdist
+
+
+@register_op("bipartite_match")
+def _bipartite_match(ctx):
+    """DistMat [B, N, M] (padded batch; reference uses LoD rows). Per-image
+    greedy bipartite match + optional per_prediction augmentation."""
+    import jax
+    jnp = _jnp()
+    dist = ctx.input("DistMat")
+    lens = ctx.lod_len("DistMat")       # rows per image, or None
+    if dist.ndim == 2:
+        dist = dist[None]
+    B, N, M = dist.shape
+    if lens is not None:
+        row_ok = jnp.arange(N)[None, :] < lens[:, None]
+        dist = jnp.where(row_ok[:, :, None], dist, -1.0)
+    midx, mdist = jax.vmap(_bipartite_match_one)(dist)
+    if ctx.attr("match_type", "bipartite") == "per_prediction":
+        thr = float(ctx.attr("dist_threshold", 0.5))
+        best_row = jnp.argmax(dist, axis=1).astype(jnp.int32)   # [B, M]
+        best_val = jnp.max(dist, axis=1)
+        fill = (midx < 0) & (best_val > thr)
+        midx = jnp.where(fill, best_row, midx)
+        mdist = jnp.where(fill, best_val, mdist)
+    return {"ColToRowMatchIndices": midx, "ColToRowMatchDist": mdist}
+
+
+# ---------------------------------------------------------------------------
+# target assign (target_assign_op.h)
+# ---------------------------------------------------------------------------
+
+@register_op("target_assign")
+def _target_assign(ctx):
+    """X [B, N, K] per-image gt rows (padded, lens companion; reference: LoD
+    [M, P, K] with the rows-per-image grouping in the LoD), MatchIndices
+    [B, P] -> Out [B, P, K], OutWeight [B, P, 1]. X may also be
+    [B, N, P, K] (per-prior targets, e.g. encoded gt boxes): out[b,p] =
+    x[b, match[b,p], p]. NegIndices [B, Q] padded (lens companion) marks
+    negatives whose weight is forced to 1."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    midx = ctx.input("MatchIndices")
+    B, P = midx.shape
+    K = x.shape[-1]
+    mismatch = jnp.asarray(ctx.attr("mismatch_value", 0), x.dtype)
+    safe = jnp.maximum(midx, 0).astype(jnp.int32)
+    if x.ndim == 4:
+        out = jax.vmap(lambda xb, mb: xb[mb, jnp.arange(P)])(x, safe)
+    else:
+        out = jnp.take_along_axis(
+            x, safe[:, :, None].repeat(K, axis=2), axis=1)
+    matched = (midx >= 0)[:, :, None]
+    out = jnp.where(matched, out, mismatch)
+    w = matched.astype(x.dtype)
+    neg = ctx.input("NegIndices")
+    if neg is not None:
+        nlens = ctx.lod_len("NegIndices")
+        Q = neg.shape[1]
+        valid = jnp.ones((B, Q), bool) if nlens is None else \
+            jnp.arange(Q)[None, :] < nlens[:, None]
+        onehot = (jnp.arange(P)[None, None, :] ==
+                  neg[:, :, None]) & valid[:, :, None]
+        negmask = jnp.any(onehot, axis=1)[:, :, None]
+        w = jnp.where(negmask, jnp.asarray(1.0, x.dtype), w)
+    return {"Out": out, "OutWeight": w}
+
+
+# ---------------------------------------------------------------------------
+# hard-negative mining (mine_hard_examples_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("mine_hard_examples")
+def _mine_hard_examples(ctx):
+    """Hard-example mining. ClsLoss [B, P], MatchIndices [B, P],
+    MatchDist [B, P] -> NegIndices [B, P] padded + lens, UpdatedMatchIndices.
+
+    max_negative (default): negatives = unmatched priors with dist <
+    neg_dist_threshold, ranked by loss desc, capped at
+    neg_pos_ratio * num_pos (or sample_size). Match indices unchanged.
+
+    hard_example: ALL priors ranked by loss desc, the top sample_size
+    selected; selected unmatched priors become the negatives, and positives
+    that were NOT selected are dropped from UpdatedMatchIndices
+    (mine_hard_examples_op.cc kHardExample)."""
+    jnp = _jnp()
+    cls_loss = ctx.input("ClsLoss")
+    loc_loss = ctx.input("LocLoss")
+    midx = ctx.input("MatchIndices")
+    mdist = ctx.input("MatchDist")
+    ratio = float(ctx.attr("neg_pos_ratio", 3.0))
+    dist_thr = float(ctx.attr("neg_dist_threshold", 0.5))
+    sample_size = int(ctx.attr("sample_size", 0))
+    mining_type = ctx.attr("mining_type", "max_negative")
+    loss = cls_loss if loc_loss is None else cls_loss + loc_loss
+    B, P = midx.shape
+    is_neg_cand = (midx < 0) & (mdist < dist_thr)
+
+    if mining_type == "hard_example":
+        S = sample_size if sample_size > 0 else P
+        order = jnp.argsort(-loss, axis=1).astype(jnp.int32)   # [B, P]
+        sel_rank = jnp.arange(P)[None, :] < S
+        import jax
+        selected = jax.vmap(
+            lambda o, r: jnp.zeros((P,), bool).at[o].set(r))(order, sel_rank)
+        neg_sel = selected & is_neg_cand
+        cap = jnp.sum(neg_sel.astype(jnp.int32), axis=1)
+        masked = jnp.where(neg_sel, loss, -jnp.inf)
+        neg_order = jnp.argsort(-masked, axis=1).astype(jnp.int32)
+        keep = jnp.arange(P)[None, :] < cap[:, None]
+        neg_idx = jnp.where(keep, neg_order, 0)
+        updated = jnp.where(selected | (midx < 0), midx, -1)
+        return {"NegIndices": neg_idx, "NegIndices@LOD_LEN": cap,
+                "UpdatedMatchIndices": updated}
+
+    num_pos = jnp.sum((midx >= 0).astype(jnp.int32), axis=1)
+    cap = (num_pos.astype(jnp.float32) * ratio).astype(jnp.int32)
+    if sample_size > 0:
+        cap = jnp.full_like(cap, sample_size)
+    cap = jnp.minimum(cap, jnp.sum(is_neg_cand.astype(jnp.int32), axis=1))
+    masked = jnp.where(is_neg_cand, loss, -jnp.inf)
+    order = jnp.argsort(-masked, axis=1).astype(jnp.int32)    # [B, P]
+    keep = jnp.arange(P)[None, :] < cap[:, None]
+    neg_idx = jnp.where(keep, order, 0)
+    return {"NegIndices": neg_idx, "NegIndices@LOD_LEN": cap,
+            "UpdatedMatchIndices": midx}
+
+
+# ---------------------------------------------------------------------------
+# NMS (multiclass_nms_op.cc)
+# ---------------------------------------------------------------------------
+
+def nms_mask(boxes, scores, valid, iou_threshold, top_k, normalized=True,
+             eta=1.0):
+    """Greedy NMS. boxes [M,4], scores [M], valid [M] bool -> keep [M] bool.
+    Classic O(M^2): precompute the IoU matrix, walk boxes in score order with
+    a fori_loop, suppressing later overlaps. eta < 1 decays the threshold
+    after each kept box once it exceeds 0.5 (adaptive NMS, multiclass_nms_op
+    nms_eta)."""
+    import jax
+    jnp = _jnp()
+    M = boxes.shape[0]
+    order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+    bs = boxes[order]
+    vs = valid[order]
+    if top_k is not None and top_k > 0:
+        vs = vs & (jnp.arange(M) < top_k)
+    iou = iou_matrix(bs, bs, normalized)
+    eta = float(eta)
+
+    def body(i, state):
+        keep, sup, thr = state
+        ok = vs[i] & ~sup[i]
+        keep = keep.at[i].set(ok)
+        row_sup = (iou[i] > thr) & (jnp.arange(M) > i) & ok
+        if eta < 1.0:
+            thr = jnp.where(ok & (thr > 0.5), thr * eta, thr)
+        return keep, sup | row_sup, thr
+
+    keep0 = jnp.zeros((M,), bool)
+    sup0 = jnp.zeros((M,), bool)
+    thr0 = jnp.asarray(iou_threshold, jnp.float32)
+    keep_sorted, _, _ = jax.lax.fori_loop(0, M, body, (keep0, sup0, thr0))
+    keep = jnp.zeros((M,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+def _multiclass_nms_one(scores, bboxes, background_label, score_threshold,
+                        nms_top_k, nms_threshold, keep_top_k, normalized,
+                        eta=1.0):
+    """scores [C, M], bboxes [M, 4] -> out [keep_top_k, 6], count scalar."""
+    import jax
+    jnp = _jnp()
+    C, M = scores.shape
+
+    def per_class(c_scores):
+        valid = c_scores > score_threshold
+        return nms_mask(bboxes, c_scores, valid, nms_threshold,
+                        nms_top_k, normalized, eta)
+
+    keep = jax.vmap(per_class)(scores)                        # [C, M]
+    if background_label >= 0:
+        keep = keep.at[background_label].set(False)
+    flat_keep = keep.reshape(-1)
+    flat_scores = jnp.where(flat_keep, scores.reshape(-1), -jnp.inf)
+    K = int(keep_top_k) if keep_top_k > 0 else C * M
+    K = min(K, C * M)
+    top_scores, top_idx = jax.lax.top_k(flat_scores, K)
+    sel_class = (top_idx // M).astype(jnp.float32)
+    sel_box = bboxes[top_idx % M]
+    valid_out = top_scores > -jnp.inf
+    out = jnp.concatenate([
+        jnp.where(valid_out, sel_class, -1.0)[:, None],
+        jnp.where(valid_out, top_scores, 0.0)[:, None],
+        jnp.where(valid_out[:, None], sel_box, 0.0)], axis=1)
+    count = jnp.sum(valid_out.astype(jnp.int32))
+    return out, count
+
+
+@register_op("multiclass_nms")
+def _multiclass_nms(ctx):
+    """Scores [B, C, M], BBoxes [B, M, 4] -> Out [B, keep_top_k, 6] padded
+    (rows are [label, score, xmin, ymin, xmax, ymax]) + per-image counts as
+    the LoD companion (reference emits an LoD tensor)."""
+    import jax
+    scores = ctx.input("Scores")
+    bboxes = ctx.input("BBoxes")
+    bg = int(ctx.attr("background_label", 0))
+    score_thr = float(ctx.attr("score_threshold", 0.0))
+    nms_top_k = int(ctx.attr("nms_top_k", -1))
+    nms_thr = float(ctx.attr("nms_threshold", 0.3))
+    keep_top_k = int(ctx.attr("keep_top_k", -1))
+    normalized = bool(ctx.attr("normalized", True))
+    eta = float(ctx.attr("nms_eta", 1.0))
+    out, count = jax.vmap(
+        lambda s, b: _multiclass_nms_one(s, b, bg, score_thr, nms_top_k,
+                                         nms_thr, keep_top_k, normalized,
+                                         eta)
+    )(scores, bboxes)
+    return {"Out": out, "Out@LOD_LEN": count}
+
+
+# ---------------------------------------------------------------------------
+# proposals (generate_proposals_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("generate_proposals")
+def _generate_proposals(ctx):
+    """Scores [B, A, H, W], BboxDeltas [B, 4A, H, W], ImInfo [B, 3],
+    Anchors [H, W, A, 4], Variances [H, W, A, 4] ->
+    RpnRois [B, post_nms_topN, 4] + counts, RpnRoiProbs [B, post_nms_topN, 1].
+    """
+    import jax
+    jnp = _jnp()
+    scores = ctx.input("Scores")
+    deltas = ctx.input("BboxDeltas")
+    im_info = ctx.input("ImInfo")
+    anchors = ctx.input("Anchors").reshape(-1, 4)
+    variances = ctx.input("Variances").reshape(-1, 4)
+    pre_n = int(ctx.attr("pre_nms_topN", 6000))
+    post_n = int(ctx.attr("post_nms_topN", 1000))
+    nms_thr = float(ctx.attr("nms_thresh", 0.7))
+    min_size = float(ctx.attr("min_size", 0.1))
+    B, A, H, W = scores.shape
+    M = A * H * W
+    pre_n = min(pre_n, M)
+    post_n = min(post_n, pre_n)
+
+    def one(sc, dl, info):
+        # to [M] / [M, 4]: scores laid out [A,H,W]; deltas [4A,H,W] with
+        # 4 consecutive channels per anchor (reference transposes to HWA);
+        # anchors/variances arrive [H,W,A,4] and were flattened above in
+        # the same HWA order
+        s = sc.transpose(1, 2, 0).reshape(-1)                 # [H,W,A]->[M]
+        d = dl.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        anc = anchors
+        var = variances
+        top_s, top_i = jax.lax.top_k(s, pre_n)
+        d = d[top_i]
+        anc = anc[top_i]
+        var = var[top_i]
+        # decode (pixel-coordinate center-size decode, +1 widths)
+        pw = anc[:, 2] - anc[:, 0] + 1.0
+        ph = anc[:, 3] - anc[:, 1] + 1.0
+        pcx = anc[:, 0] + pw * 0.5
+        pcy = anc[:, 1] + ph * 0.5
+        dx, dy, dw, dh = (d * var).T
+        cx = dx * pw + pcx
+        cy = dy * ph + pcy
+        w = jnp.exp(jnp.minimum(dw, 10.0)) * pw
+        h = jnp.exp(jnp.minimum(dh, 10.0)) * ph
+        boxes = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                           cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], axis=1)
+        # clip to image
+        imh, imw = info[0], info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0.0, imw - 1.0),
+            jnp.clip(boxes[:, 1], 0.0, imh - 1.0),
+            jnp.clip(boxes[:, 2], 0.0, imw - 1.0),
+            jnp.clip(boxes[:, 3], 0.0, imh - 1.0)], axis=1)
+        # filter boxes smaller than min_size (scaled by im scale info[2])
+        ms = jnp.maximum(min_size * info[2], 1.0)
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1.0) >= ms) & \
+                  ((boxes[:, 3] - boxes[:, 1] + 1.0) >= ms)
+        keep = nms_mask(boxes, top_s, keep_sz, nms_thr, -1, normalized=False)
+        sc_kept = jnp.where(keep, top_s, -jnp.inf)
+        out_s, out_i = jax.lax.top_k(sc_kept, post_n)
+        rois = boxes[out_i]
+        ok = out_s > -jnp.inf
+        rois = jnp.where(ok[:, None], rois, 0.0)
+        probs = jnp.where(ok, out_s, 0.0)[:, None]
+        return rois, probs, jnp.sum(ok.astype(jnp.int32))
+
+    rois, probs, counts = jax.vmap(one)(scores, deltas, im_info)
+    return {"RpnRois": rois, "RpnRois@LOD_LEN": counts,
+            "RpnRoiProbs": probs, "RpnRoiProbs@LOD_LEN": counts}
+
+
+# ---------------------------------------------------------------------------
+# RoI pooling (roi_pool_op.cc, roi_align_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("roi_pool")
+def _roi_pool(ctx):
+    """X [B, C, H, W], ROIs [B, R, 4] (padded per-image, lens companion;
+    reference: LoD [K, 4]) -> Out [B, R, C, ph, pw]. Max pool over integer
+    bin grids, matching roi_pool_op.h quantization."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    rois = ctx.input("ROIs")
+    lens = ctx.lod_len("ROIs")
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    B, C, H, W = x.shape
+    squeeze = rois.ndim == 2
+    if squeeze:
+        rois = rois[None]
+    R = rois.shape[1]
+
+    hi = jnp.arange(H)
+    wi = jnp.arange(W)
+
+    def one_roi(feat, roi):
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        ib = jnp.arange(ph, dtype=feat.dtype)
+        jb = jnp.arange(pw, dtype=feat.dtype)
+        hstart = jnp.clip(jnp.floor(ib * bin_h) + y1, 0, H)     # [ph]
+        hend = jnp.clip(jnp.ceil((ib + 1) * bin_h) + y1, 0, H)
+        wstart = jnp.clip(jnp.floor(jb * bin_w) + x1, 0, W)
+        wend = jnp.clip(jnp.ceil((jb + 1) * bin_w) + x1, 0, W)
+        hmask = (hi[None, :] >= hstart[:, None]) & \
+                (hi[None, :] < hend[:, None])                   # [ph, H]
+        wmask = (wi[None, :] >= wstart[:, None]) & \
+                (wi[None, :] < wend[:, None])                   # [pw, W]
+        m = hmask[:, None, :, None] & wmask[None, :, None, :]   # [ph,pw,H,W]
+        big = jnp.where(m[None], feat[:, None, None, :, :],
+                        jnp.asarray(-np.inf, feat.dtype))
+        out = jnp.max(big, axis=(3, 4))                          # [C, ph, pw]
+        empty = ~jnp.any(m, axis=(2, 3))                         # [ph, pw]
+        return jnp.where(empty[None], 0.0, out)
+
+    out = jax.vmap(lambda feat, rs: jax.vmap(
+        lambda r: one_roi(feat, r))(rs))(x, rois)
+    if lens is not None:
+        valid = (jnp.arange(R)[None, :] < lens[:, None])
+        out = jnp.where(valid[:, :, None, None, None], out, 0.0)
+    if squeeze:
+        out = out[0]
+    return {"Out": out, "Argmax": None}
+
+
+@register_op("roi_align")
+def _roi_align(ctx):
+    """RoI Align (roi_align_op.cc): average of bilinear samples per bin."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    rois = ctx.input("ROIs")
+    lens = ctx.lod_len("ROIs")
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    ratio = int(ctx.attr("sampling_ratio", -1))
+    B, C, H, W = x.shape
+    squeeze = rois.ndim == 2
+    if squeeze:
+        rois = rois[None]
+    R = rois.shape[1]
+    S = ratio if ratio > 0 else 2
+
+    def bilinear(feat, ys, xs):
+        """feat [C, H, W]; ys/xs [...]: bilinear sample -> [C, ...]"""
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        y1 = y0 + 1
+        x1 = x0 + 1
+        wy1 = ys - y0
+        wx1 = xs - x0
+        wy0 = 1.0 - wy1
+        wx0 = 1.0 - wx1
+
+        def at(yy, xx):
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            return feat[:, yi, xi]
+
+        oob = (ys < -1.0) | (ys > H) | (xs < -1.0) | (xs > W)
+        val = (at(y0, x0) * (wy0 * wx0) + at(y0, x1) * (wy0 * wx1) +
+               at(y1, x0) * (wy1 * wx0) + at(y1, x1) * (wy1 * wx1))
+        return jnp.where(oob[None], 0.0, val)
+
+    def one_roi(feat, roi):
+        x1 = roi[0] * scale
+        y1 = roi[1] * scale
+        rw = jnp.maximum(roi[2] * scale - x1, 1.0)
+        rh = jnp.maximum(roi[3] * scale - y1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        ib = jnp.arange(ph, dtype=feat.dtype)[:, None, None, None]
+        jb = jnp.arange(pw, dtype=feat.dtype)[None, :, None, None]
+        si = jnp.arange(S, dtype=feat.dtype)[None, None, :, None]
+        sj = jnp.arange(S, dtype=feat.dtype)[None, None, None, :]
+        ys = y1 + ib * bin_h + (si + 0.5) * bin_h / S    # [ph,pw,S,S]
+        xs = x1 + jb * bin_w + (sj + 0.5) * bin_w / S
+        vals = bilinear(feat, ys, xs)                     # [C,ph,pw,S,S]
+        return jnp.mean(vals, axis=(3, 4))
+
+    out = jax.vmap(lambda feat, rs: jax.vmap(
+        lambda r: one_roi(feat, r))(rs))(x, rois)
+    if lens is not None:
+        valid = (jnp.arange(R)[None, :] < lens[:, None])
+        out = jnp.where(valid[:, :, None, None, None], out, 0.0)
+    if squeeze:
+        out = out[0]
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# RPN target assign (rpn_target_assign_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("rpn_target_assign")
+def _rpn_target_assign(ctx):
+    """Loc [N,A,4], Scores [N,A,1], Anchor [A,4], AnchorVar [A,4],
+    GtBox [N,G,4] (padded, lens companion) ->
+    (PredictedLocation [N,S,4], PredictedScores [N,S,1],
+     TargetLabel [N,S,1], TargetBBox [N,S,4]) + counts; S =
+    rpn_batch_size_per_im.
+
+    Sampling is the reference's fg/bg-balanced scheme made deterministic for
+    jit: positives (IoU > pos_overlap, plus the best anchor per gt) ranked by
+    IoU desc capped at fg_fraction*S; negatives (IoU < neg_overlap) ranked by
+    IoU asc fill the remainder. The reference samples randomly; ranking keeps
+    identical fg/bg counts with reproducible selection (documented
+    deviation)."""
+    import jax
+    jnp = _jnp()
+    loc = ctx.input("Loc")
+    scores = ctx.input("Scores")
+    anchor = ctx.input("Anchor")
+    avar = ctx.input("AnchorVar")
+    gt = ctx.input("GtBox")
+    lens = ctx.lod_len("GtBox")
+    S = int(ctx.attr("rpn_batch_size_per_im", 256))
+    fg_frac = float(ctx.attr("fg_fraction", 0.25))
+    pos_thr = float(ctx.attr("rpn_positive_overlap", 0.7))
+    neg_thr = float(ctx.attr("rpn_negative_overlap", 0.3))
+    N, A = loc.shape[0], loc.shape[1]
+    G = gt.shape[1]
+    S = min(S, A)
+    fg_cap = int(S * fg_frac)
+
+    def one(loc_i, sc_i, gt_i, n_gt):
+        iou = iou_matrix(gt_i, anchor)                     # [G, A]
+        gt_ok = jnp.arange(G) < n_gt
+        iou = jnp.where(gt_ok[:, None], iou, 0.0)
+        best = jnp.max(iou, axis=0)                        # [A]
+        best_gt = jnp.argmax(iou, axis=0).astype(jnp.int32)
+        # best anchor per gt is positive too; padded gt rows must not
+        # scatter (their argmax is a bogus 0) — route them out of range
+        best_anchor = jnp.argmax(iou, axis=1)              # [G]
+        safe_anchor = jnp.where(gt_ok, best_anchor, A)
+        per_gt_pos = jnp.zeros((A,), bool).at[safe_anchor].set(
+            True, mode="drop")
+        is_pos = (best > pos_thr) | per_gt_pos
+        is_neg = (best < neg_thr) & ~is_pos
+        # deterministic fg: top IoU positives
+        fg_rank = jnp.argsort(-jnp.where(is_pos, best, -jnp.inf))
+        n_fg = jnp.minimum(jnp.sum(is_pos.astype(jnp.int32)), fg_cap)
+        # deterministic bg: lowest-IoU negatives
+        bg_rank = jnp.argsort(jnp.where(is_neg, best, jnp.inf))
+        n_bg = jnp.minimum(jnp.sum(is_neg.astype(jnp.int32)), S - n_fg)
+        pick_fg = jnp.arange(S) < n_fg
+        idx = jnp.where(pick_fg, fg_rank[jnp.arange(S) % A],
+                        bg_rank[jnp.maximum(jnp.arange(S) - n_fg, 0) % A])
+        idx = idx.astype(jnp.int32)
+        count = n_fg + n_bg
+        valid = jnp.arange(S) < count
+        lab = jnp.where(pick_fg, 1, 0).astype(jnp.int32)[:, None]
+        enc = _encode_center_size(gt_i, anchor, avar)      # [G, A, 4]
+        tb = enc[best_gt[idx], idx]                        # [S, 4]
+        tb = jnp.where((pick_fg & valid)[:, None], tb, 0.0)
+        pl = jnp.where(valid[:, None], loc_i[idx], 0.0)
+        ps = jnp.where(valid[:, None], sc_i[idx], 0.0)
+        return pl, ps, jnp.where(valid[:, None], lab, 0), tb, count
+
+    if lens is None:
+        lens = jnp.full((N,), G, jnp.int32)
+    if scores.ndim == 2:
+        scores = scores[:, :, None]
+    pl, ps, lab, tb, counts = jax.vmap(one)(loc, scores, gt, lens)
+    return {"PredictedLocation": pl, "PredictedLocation@LOD_LEN": counts,
+            "PredictedScores": ps, "PredictedScores@LOD_LEN": counts,
+            "TargetLabel": lab, "TargetLabel@LOD_LEN": counts,
+            "TargetBBox": tb, "TargetBBox@LOD_LEN": counts}
+
+
+# ---------------------------------------------------------------------------
+# misc (polygon_box_transform_op.cc, box_clip)
+# ---------------------------------------------------------------------------
+
+@register_op("polygon_box_transform")
+def _polygon_box_transform(ctx):
+    """out[n,c,h,w] = 4*w - x for even c (x offsets), 4*h - x for odd c."""
+    jnp = _jnp()
+    x = ctx.input("Input")
+    N, C, H, W = x.shape
+    wgrid = jnp.broadcast_to(jnp.arange(W, dtype=x.dtype), (H, W))
+    hgrid = jnp.broadcast_to(jnp.arange(H, dtype=x.dtype)[:, None], (H, W))
+    even = (jnp.arange(C) % 2 == 0)[None, :, None, None]
+    base = jnp.where(even, wgrid[None, None], hgrid[None, None])
+    return {"Output": 4.0 * base - x}
+
+
+@register_op("box_clip")
+def _box_clip(ctx):
+    jnp = _jnp()
+    boxes = ctx.input("Input")          # [..., 4] or [B, R, 4]
+    im_info = ctx.input("ImInfo")       # [B, 3] (h, w, scale)
+    if boxes.ndim == 2:
+        h = im_info[0, 0] / im_info[0, 2] - 1.0
+        w = im_info[0, 1] / im_info[0, 2] - 1.0
+        out = jnp.stack([jnp.clip(boxes[:, 0], 0, w),
+                         jnp.clip(boxes[:, 1], 0, h),
+                         jnp.clip(boxes[:, 2], 0, w),
+                         jnp.clip(boxes[:, 3], 0, h)], axis=1)
+    else:
+        h = (im_info[:, 0] / im_info[:, 2] - 1.0)[:, None]
+        w = (im_info[:, 1] / im_info[:, 2] - 1.0)[:, None]
+        out = jnp.stack([jnp.clip(boxes[..., 0], 0, w),
+                         jnp.clip(boxes[..., 1], 0, h),
+                         jnp.clip(boxes[..., 2], 0, w),
+                         jnp.clip(boxes[..., 3], 0, h)], axis=-1)
+    return {"Output": out}

@@ -282,7 +282,7 @@ def _flatten2(ctx):
 def _concat(ctx):
     jnp = _jnp()
     axis = ctx.attr("axis", 0)
-    if ctx.lod_len("X") is not None and axis >= 1:
+    if any(l is not None for l in ctx.lod_lens("X")) and axis >= 1:
         axis += 1  # padded ragged layout inserts the time dim at 1
     return {"Out": jnp.concatenate(ctx.inputs("X"), axis=axis)}
 

@@ -1,0 +1,544 @@
+"""Detection op tests (reference unittests/test_prior_box_op.py,
+test_bipartite_match_op.py, test_multiclass_nms_op.py, test_roi_pool_op.py,
+test_iou_similarity_op.py, test_ssd_loss.py family) — numpy references."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.lod import create_lod_tensor
+
+
+def _run(build_fn, feed):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        fetches = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=list(fetches))
+
+
+def _np_iou(a, b):
+    xmin = np.maximum(a[:, None, 0], b[None, :, 0])
+    ymin = np.maximum(a[:, None, 1], b[None, :, 1])
+    xmax = np.minimum(a[:, None, 2], b[None, :, 2])
+    ymax = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.maximum(xmax - xmin, 0) * np.maximum(ymax - ymin, 0)
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = area_a[:, None] + area_b[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-10), 0.0)
+
+
+def test_iou_similarity():
+    rng = np.random.RandomState(0)
+    a = np.sort(rng.rand(4, 4).astype(np.float32), axis=-1)[:, [0, 2, 1, 3]]
+    b = np.sort(rng.rand(6, 4).astype(np.float32), axis=-1)[:, [0, 2, 1, 3]]
+    # canonical (xmin, ymin, xmax, ymax)
+    a = np.stack([a[:, 0], a[:, 1], a[:, 2], a[:, 3]], axis=1)
+
+    def build():
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[4], dtype="float32",
+                              append_batch_size=False)
+        return [fluid.layers.iou_similarity(x, y)]
+
+    (out,) = _run(build, {"x": a, "y": b})
+    np.testing.assert_allclose(np.asarray(out), _np_iou(a, b), atol=1e-5)
+
+
+def test_prior_box_values():
+    im = np.zeros((1, 3, 32, 32), np.float32)
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+
+    def build():
+        f = fluid.layers.data("feat", shape=[8, 4, 4], dtype="float32")
+        i = fluid.layers.data("im", shape=[3, 32, 32], dtype="float32")
+        boxes, var = fluid.layers.prior_box(
+            f, i, min_sizes=[8.0], max_sizes=[16.0],
+            aspect_ratios=[1.0, 2.0], flip=True, clip=True)
+        return [boxes, var]
+
+    boxes, var = _run(build, {"feat": feat, "im": im})
+    boxes, var = np.asarray(boxes), np.asarray(var)
+    # aspect ratios expand to [1, 2, 0.5] -> 3 + 1 max_size prior = 4
+    assert boxes.shape == (4, 4, 4, 4)
+    assert var.shape == (4, 4, 4, 4)
+    # cell (0,0): center (0.5*8, 0.5*8) = (4, 4); ar=1 prior is 8x8
+    np.testing.assert_allclose(
+        boxes[0, 0, 0], [0.0, 0.0, 8.0 / 32, 8.0 / 32], atol=1e-5)
+    # max_size prior: sqrt(8*16) = 11.31
+    s = np.sqrt(8.0 * 16.0) / 2
+    np.testing.assert_allclose(
+        boxes[0, 0, 3], [0.0, 0.0, (4 + s) / 32, (4 + s) / 32], atol=1e-4)
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    assert boxes.min() >= 0.0 and boxes.max() <= 1.0
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(1)
+    prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.3, 0.7, 0.8]],
+                     np.float32)
+    var = np.array([[0.1, 0.1, 0.2, 0.2]] * 2, np.float32)
+    gt = np.array([[0.15, 0.2, 0.45, 0.6], [0.3, 0.3, 0.6, 0.7],
+                   [0.05, 0.1, 0.4, 0.45]], np.float32)
+
+    def build():
+        p = fluid.layers.data("p", shape=[2, 4], dtype="float32",
+                              append_batch_size=False)
+        pv = fluid.layers.data("pv", shape=[2, 4], dtype="float32",
+                               append_batch_size=False)
+        t = fluid.layers.data("t", shape=[4], dtype="float32")
+        enc = fluid.layers.box_coder(p, pv, t, "encode_center_size")
+        dec = fluid.layers.box_coder(p, pv, enc, "decode_center_size")
+        return [enc, dec]
+
+    enc, dec = _run(build, {"p": prior, "pv": var, "t": gt})
+    enc, dec = np.asarray(enc), np.asarray(dec)
+    assert enc.shape == (3, 2, 4)
+    # numpy reference encode for (gt0, prior0)
+    pw, ph = 0.4, 0.4
+    pcx, pcy = 0.3, 0.3
+    tw, th = 0.3, 0.4
+    tcx, tcy = 0.3, 0.4
+    ref = [(tcx - pcx) / pw / 0.1, (tcy - pcy) / ph / 0.1,
+           np.log(tw / pw) / 0.2, np.log(th / ph) / 0.2]
+    np.testing.assert_allclose(enc[0, 0], ref, atol=1e-5)
+    # decode(encode(gt)) == gt for every (gt, prior) pair
+    np.testing.assert_allclose(dec, np.broadcast_to(gt[:, None, :], dec.shape),
+                               atol=1e-5)
+
+
+def _np_bipartite(dist):
+    d = dist.copy()
+    M = d.shape[1]
+    midx = np.full(M, -1, np.int32)
+    mdist = np.zeros(M, np.float32)
+    while True:
+        r, c = np.unravel_index(np.argmax(d), d.shape)
+        if d[r, c] <= 0:
+            break
+        midx[c] = r
+        mdist[c] = d[r, c]
+        d[r, :] = -1
+        d[:, c] = -1
+    return midx, mdist
+
+
+def test_bipartite_match():
+    rng = np.random.RandomState(2)
+    dist = rng.rand(2, 3, 5).astype(np.float32)
+
+    def build():
+        d = fluid.layers.data("d", shape=[3, 5], dtype="float32")
+        mi, md = fluid.layers.bipartite_match(d)
+        return [mi, md]
+
+    mi, md = _run(build, {"d": dist})
+    for b in range(2):
+        ref_i, ref_d = _np_bipartite(dist[b])
+        np.testing.assert_array_equal(np.asarray(mi)[b], ref_i)
+        np.testing.assert_allclose(np.asarray(md)[b], ref_d, atol=1e-6)
+
+
+def test_bipartite_match_per_prediction():
+    dist = np.array([[[0.9, 0.1, 0.6, 0.55],
+                      [0.2, 0.8, 0.3, 0.1]]], np.float32)
+
+    def build():
+        d = fluid.layers.data("d", shape=[2, 4], dtype="float32")
+        mi, md = fluid.layers.bipartite_match(d, "per_prediction", 0.5)
+        return [mi, md]
+
+    mi, md = _run(build, {"d": dist})
+    mi = np.asarray(mi)[0]
+    # bipartite: col0->row0 (0.9), col1->row1 (0.8); per_prediction fills
+    # col2 (best row 0, 0.6>0.5) and col3 (0.55>0.5)
+    np.testing.assert_array_equal(mi, [0, 1, 0, 0])
+
+
+def test_target_assign():
+    # X [B=2, G=2, K=1] labels; matches [B, P=3]
+    x = np.array([[[1.0], [2.0]], [[3.0], [4.0]]], np.float32)
+    midx = np.array([[0, -1, 1], [1, 0, -1]], np.int32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[2, 1], dtype="float32")
+        mv = fluid.layers.data("m", shape=[3], dtype="int32")
+        out, w = fluid.layers.target_assign(xv, mv, mismatch_value=9)
+        return [out, w]
+
+    out, w = _run(build, {"x": x, "m": midx})
+    np.testing.assert_allclose(np.asarray(out)[..., 0],
+                               [[1, 9, 2], [4, 3, 9]])
+    np.testing.assert_allclose(np.asarray(w)[..., 0],
+                               [[1, 0, 1], [1, 1, 0]])
+
+
+def _np_nms(boxes, scores, thresh, top_k):
+    order = np.argsort(-scores)
+    if top_k > 0:
+        order = order[:top_k]
+    keep = []
+    sup = np.zeros(len(order), bool)
+    for ii, i in enumerate(order):
+        if sup[ii]:
+            continue
+        keep.append(i)
+        for jj in range(ii + 1, len(order)):
+            if _np_iou(boxes[i:i + 1], boxes[order[jj]:order[jj] + 1])[0, 0] \
+                    > thresh:
+                sup[jj] = True
+    return keep
+
+
+def test_multiclass_nms():
+    rng = np.random.RandomState(3)
+    M, C = 12, 3
+    boxes = np.zeros((1, M, 4), np.float32)
+    centers = rng.rand(M, 2) * 0.8 + 0.1
+    wh = rng.rand(M, 2) * 0.2 + 0.05
+    boxes[0, :, 0] = centers[:, 0] - wh[:, 0]
+    boxes[0, :, 1] = centers[:, 1] - wh[:, 1]
+    boxes[0, :, 2] = centers[:, 0] + wh[:, 0]
+    boxes[0, :, 3] = centers[:, 1] + wh[:, 1]
+    scores = rng.rand(1, C, M).astype(np.float32)
+
+    def build():
+        b = fluid.layers.data("b", shape=[M, 4], dtype="float32")
+        s = fluid.layers.data("s", shape=[C, M], dtype="float32")
+        out = fluid.layers.multiclass_nms(b, s, score_threshold=0.3,
+                                          nms_top_k=10, keep_top_k=8,
+                                          nms_threshold=0.4,
+                                          background_label=0)
+        return [out]
+
+    (out,) = _run(build, {"b": boxes, "s": scores})
+    # NMS output is ragged: fetched as a packed LoDTensor (valid rows only)
+    got_valid = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+    # numpy reference: classes 1..C-1, score>0.3, NMS 0.4, keep top 8
+    cand = []
+    for c in range(1, C):
+        sc = scores[0, c].copy()
+        valid = sc > 0.3
+        sc_m = np.where(valid, sc, -np.inf)
+        keep = _np_nms(boxes[0], sc_m, 0.4, 10)
+        for i in keep:
+            if valid[i]:
+                cand.append((c, sc[i], boxes[0, i]))
+    cand.sort(key=lambda t: -t[1])
+    cand = cand[:8]
+    assert len(got_valid) == len(cand)
+    for row, (c, sc, bx) in zip(got_valid, cand):
+        assert int(row[0]) == c
+        np.testing.assert_allclose(row[1], sc, atol=1e-5)
+        np.testing.assert_allclose(row[2:], bx, atol=1e-5)
+
+
+def test_roi_pool():
+    x = np.arange(1 * 1 * 6 * 6, dtype=np.float32).reshape(1, 1, 6, 6)
+    rois = np.array([[[0.0, 0.0, 3.0, 3.0], [2.0, 2.0, 5.0, 5.0]]],
+                    np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[1, 6, 6], dtype="float32")
+        rv = fluid.layers.data("r", shape=[2, 4], dtype="float32")
+        out = fluid.layers.roi_pool(xv, rv, pooled_height=2, pooled_width=2,
+                                    spatial_scale=1.0)
+        return [out]
+
+    (out,) = _run(build, {"x": x, "r": rois})
+    out = np.asarray(out)
+    assert out.shape == (1, 2, 1, 2, 2)
+    # roi0 covers rows 0..3, cols 0..3 (4x4), 2x2 max pool of x[0..3,0..3]
+    img = x[0, 0]
+    np.testing.assert_allclose(
+        out[0, 0, 0], [[img[0:2, 0:2].max(), img[0:2, 2:4].max()],
+                       [img[2:4, 0:2].max(), img[2:4, 2:4].max()]])
+
+
+def test_roi_align_constant_map():
+    # constant feature map -> every aligned value equals the constant
+    x = np.full((1, 2, 8, 8), 5.0, np.float32)
+    rois = np.array([[[1.0, 1.0, 6.0, 6.0]]], np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[2, 8, 8], dtype="float32")
+        rv = fluid.layers.data("r", shape=[1, 4], dtype="float32")
+        out = fluid.layers.roi_align(xv, rv, pooled_height=3, pooled_width=3,
+                                     spatial_scale=1.0, sampling_ratio=2)
+        return [out]
+
+    (out,) = _run(build, {"x": x, "r": rois})
+    np.testing.assert_allclose(np.asarray(out), 5.0, atol=1e-5)
+
+
+def test_anchor_generator():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+
+    def build():
+        f = fluid.layers.data("f", shape=[8, 2, 2], dtype="float32")
+        a, v = fluid.layers.anchor_generator(
+            f, anchor_sizes=[32.0, 64.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])
+        return [a, v]
+
+    a, v = _run(build, {"f": feat})
+    a = np.asarray(a)
+    assert a.shape == (2, 2, 2, 4)
+    # ar=1, size 32, stride 16: base 16x16 scaled by 2 -> 32x32 at center 8,8
+    np.testing.assert_allclose(a[0, 0, 0], [8 - 16, 8 - 16, 8 + 16, 8 + 16])
+    widths = a[..., 2] - a[..., 0]
+    assert set(np.unique(widths)) == {32.0, 64.0}
+
+
+def test_generate_proposals_shapes():
+    rng = np.random.RandomState(4)
+    B, A, H, W = 1, 3, 4, 4
+    scores = rng.rand(B, A, H, W).astype(np.float32)
+    deltas = (rng.rand(B, 4 * A, H, W).astype(np.float32) - 0.5) * 0.2
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for h in range(H):
+        for w in range(W):
+            for a in range(A):
+                cx, cy = w * 16 + 8, h * 16 + 8
+                s = 8 * (a + 1)
+                anchors[h, w, a] = [cx - s, cy - s, cx + s, cy + s]
+    variances = np.full((H, W, A, 4), 0.1, np.float32)
+
+    def build():
+        s = fluid.layers.data("s", shape=[A, H, W], dtype="float32")
+        d = fluid.layers.data("d", shape=[4 * A, H, W], dtype="float32")
+        ii = fluid.layers.data("ii", shape=[3], dtype="float32")
+        an = fluid.layers.data("an", shape=[H, W, A, 4], dtype="float32",
+                               append_batch_size=False)
+        va = fluid.layers.data("va", shape=[H, W, A, 4], dtype="float32",
+                               append_batch_size=False)
+        rois, probs = fluid.layers.generate_proposals(
+            s, d, ii, an, va, pre_nms_top_n=30, post_nms_top_n=10,
+            nms_thresh=0.7, min_size=4.0)
+        return [rois, probs]
+
+    rois, probs = _run(build, {"s": scores, "d": deltas, "ii": im_info,
+                               "an": anchors, "va": variances})
+    # ragged outputs fetched as packed LoDTensors (valid rows only)
+    rois = rois.numpy() if hasattr(rois, "numpy") else np.asarray(rois)
+    probs = probs.numpy() if hasattr(probs, "numpy") else np.asarray(probs)
+    assert rois.shape[1] == 4 and 1 <= rois.shape[0] <= 10
+    assert probs.shape == (rois.shape[0], 1)
+    # all boxes inside image
+    assert rois.min() >= 0.0 and rois.max() <= 63.0
+    # probs sorted desc
+    assert np.all(np.diff(probs[:, 0]) <= 1e-6)
+
+
+def test_polygon_box_transform():
+    x = np.ones((1, 4, 2, 3), np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[4, 2, 3], dtype="float32")
+        return [fluid.layers.polygon_box_transform(xv)]
+
+    (out,) = _run(build, {"x": x})
+    out = np.asarray(out)
+    for h in range(2):
+        for w in range(3):
+            np.testing.assert_allclose(out[0, 0, h, w], 4 * w - 1)  # even c
+            np.testing.assert_allclose(out[0, 1, h, w], 4 * h - 1)  # odd c
+
+
+def test_box_clip():
+    boxes = np.array([[[-5.0, -5.0, 70.0, 30.0]]], np.float32)
+    im_info = np.array([[40.0, 60.0, 1.0]], np.float32)
+
+    def build():
+        b = fluid.layers.data("b", shape=[1, 4], dtype="float32")
+        ii = fluid.layers.data("ii", shape=[3], dtype="float32")
+        return [fluid.layers.box_clip(b, ii)]
+
+    (out,) = _run(build, {"b": boxes, "ii": im_info})
+    np.testing.assert_allclose(np.asarray(out)[0, 0], [0, 0, 59, 30])
+
+
+def test_ssd_loss_end_to_end():
+    """Full SSD loss: match + mine + assign + losses; check finite loss and
+    that gradients flow to the conv head params (reference test_ssd_loss)."""
+    rng = np.random.RandomState(5)
+    N, P, C, G = 2, 10, 4, 3
+    prior = np.sort(rng.rand(P, 4).astype(np.float32), axis=1)
+    pvar = np.full((P, 4), 0.1, np.float32)
+    gt_rows = np.sort(rng.rand(5, 4).astype(np.float32), axis=1)
+    gt_label_rows = rng.randint(1, C, (5, 1)).astype(np.int32)
+    lens = [2, 3]
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data("feat", shape=[8], dtype="float32")
+        loc = fluid.layers.fc(feat, size=P * 4)
+        loc = fluid.layers.reshape(loc, [-1, P, 4])
+        conf = fluid.layers.fc(feat, size=P * C)
+        conf = fluid.layers.reshape(conf, [-1, P, C])
+        gt_box = fluid.layers.data("gt_box", shape=[4], dtype="float32",
+                                   lod_level=1)
+        gt_label = fluid.layers.data("gt_label", shape=[1], dtype="int32",
+                                     lod_level=1)
+        pb = fluid.layers.data("pb", shape=[P, 4], dtype="float32",
+                               append_batch_size=False)
+        pbv = fluid.layers.data("pbv", shape=[P, 4], dtype="float32",
+                                append_batch_size=False)
+        loss = fluid.layers.ssd_loss(loc, conf, gt_box, gt_label, pb, pbv)
+        avg = fluid.layers.mean(loss)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(avg)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"feat": rng.randn(N, 8).astype(np.float32),
+            "gt_box": create_lod_tensor(gt_rows, [lens]),
+            "gt_label": create_lod_tensor(gt_label_rows, [lens]),
+            "pb": prior, "pbv": pvar}
+    losses = []
+    for _ in range(4):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[avg])
+        lv = float(np.asarray(lv))
+        assert np.isfinite(lv)
+        losses.append(lv)
+    assert losses[-1] < losses[0]  # training reduces the loss
+
+
+def test_detection_output_end_to_end():
+    rng = np.random.RandomState(6)
+    N, P, C = 1, 6, 3
+    prior = np.zeros((P, 4), np.float32)
+    centers = rng.rand(P, 2) * 0.6 + 0.2
+    prior[:, 0] = centers[:, 0] - 0.1
+    prior[:, 1] = centers[:, 1] - 0.1
+    prior[:, 2] = centers[:, 0] + 0.1
+    prior[:, 3] = centers[:, 1] + 0.1
+    pvar = np.full((P, 4), 0.1, np.float32)
+    loc = (rng.rand(N, P, 4).astype(np.float32) - 0.5) * 0.1
+    scores = rng.rand(N, P, C).astype(np.float32)
+
+    def build():
+        l = fluid.layers.data("l", shape=[P, 4], dtype="float32")
+        s = fluid.layers.data("s", shape=[P, C], dtype="float32")
+        pb = fluid.layers.data("pb", shape=[P, 4], dtype="float32",
+                               append_batch_size=False)
+        pbv = fluid.layers.data("pbv", shape=[P, 4], dtype="float32",
+                                append_batch_size=False)
+        out = fluid.layers.detection_output(l, s, pb, pbv,
+                                            score_threshold=0.01,
+                                            nms_threshold=0.45,
+                                            keep_top_k=5)
+        return [out]
+
+    (out,) = _run(build, {"l": loc, "s": scores, "pb": prior, "pbv": pvar})
+    valid = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+    assert valid.shape[1] == 6 and 1 <= valid.shape[0] <= 5
+    # labels exclude background 0; scores in (0, 1)
+    assert np.all(valid[:, 0] >= 1)
+    assert np.all((valid[:, 1] > 0) & (valid[:, 1] <= 1))
+
+
+def test_density_prior_box():
+    im = np.zeros((1, 3, 32, 32), np.float32)
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+
+    def build():
+        f = fluid.layers.data("feat", shape=[8, 4, 4], dtype="float32")
+        i = fluid.layers.data("im", shape=[3, 32, 32], dtype="float32")
+        boxes, var = fluid.layers.density_prior_box(
+            f, i, densities=[2], fixed_sizes=[8.0], fixed_ratios=[1.0],
+            clip=True)
+        return [boxes, var]
+
+    boxes, var = _run(build, {"feat": feat, "im": im})
+    boxes = np.asarray(boxes)
+    # density 2 * 1 ratio -> 4 priors per cell
+    assert boxes.shape == (4, 4, 4, 4)
+    assert boxes.min() >= 0.0 and boxes.max() <= 1.0
+    # all priors are 8x8 (before clipping) centred on a 2x2 sub-grid
+    w = (boxes[1, 1, :, 2] - boxes[1, 1, :, 0]) * 32
+    np.testing.assert_allclose(w, 8.0, atol=1e-4)
+
+
+def test_box_coder_decode_2d():
+    prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.3, 0.7, 0.8]],
+                     np.float32)
+    deltas = np.zeros((2, 4), np.float32)   # zero deltas -> decode == prior
+
+    def build():
+        p = fluid.layers.data("p", shape=[2, 4], dtype="float32",
+                              append_batch_size=False)
+        t = fluid.layers.data("t", shape=[2, 4], dtype="float32",
+                              append_batch_size=False)
+        dec = fluid.layers.box_coder(p, [0.1, 0.1, 0.2, 0.2], t,
+                                     "decode_center_size")
+        return [dec]
+
+    (dec,) = _run(build, {"p": prior, "t": deltas})
+    dec = np.asarray(dec)
+    assert dec.shape == (2, 4)   # no spurious leading dim
+    np.testing.assert_allclose(dec, prior, atol=1e-5)
+
+
+def test_box_coder_pixel_roundtrip():
+    # non-normalized (pixel) boxes: +1 widths on encode, -1 on decode
+    prior = np.array([[4.0, 4.0, 11.0, 11.0]], np.float32)
+    gt = np.array([[2.0, 3.0, 9.0, 12.0]], np.float32)
+
+    def build():
+        p = fluid.layers.data("p", shape=[1, 4], dtype="float32",
+                              append_batch_size=False)
+        t = fluid.layers.data("t", shape=[4], dtype="float32")
+        enc = fluid.layers.box_coder(p, None, t, "encode_center_size",
+                                     box_normalized=False)
+        dec = fluid.layers.box_coder(p, None, enc, "decode_center_size",
+                                     box_normalized=False)
+        return [enc, dec]
+
+    enc, dec = _run(build, {"p": prior, "t": gt})
+    enc, dec = np.asarray(enc), np.asarray(dec)
+    # reference semantics: tw = xmax-xmin+1 = 8, pw = 8
+    np.testing.assert_allclose(enc[0, 0, 2], np.log(8.0 / 8.0), atol=1e-5)
+    np.testing.assert_allclose(dec[0, 0], gt[0], atol=1e-4)
+
+
+def test_rpn_target_assign():
+    rng = np.random.RandomState(7)
+    N, A, G, S = 1, 20, 2, 8
+    anchors = np.zeros((A, 4), np.float32)
+    c = rng.rand(A, 2).astype(np.float32)
+    anchors[:, :2] = c - 0.1
+    anchors[:, 2:] = c + 0.1
+    avar = np.full((A, 4), 0.1, np.float32)
+    # gt boxes exactly equal to two anchors -> those anchors are fg
+    gt = np.stack([anchors[3], anchors[11]])[None]
+    loc = rng.randn(N, A, 4).astype(np.float32)
+    scores = rng.rand(N, A, 1).astype(np.float32)
+
+    def build():
+        l = fluid.layers.data("l", shape=[A, 4], dtype="float32")
+        s = fluid.layers.data("s", shape=[A, 1], dtype="float32")
+        ab = fluid.layers.data("ab", shape=[A, 4], dtype="float32",
+                               append_batch_size=False)
+        av = fluid.layers.data("av", shape=[A, 4], dtype="float32",
+                               append_batch_size=False)
+        g = fluid.layers.data("g", shape=[G, 4], dtype="float32")
+        return fluid.layers.rpn_target_assign(
+            l, s, ab, av, g, rpn_batch_size_per_im=S, fg_fraction=0.25,
+            rpn_positive_overlap=0.7, rpn_negative_overlap=0.3)
+
+    pl, ps, lab, tb = _run(build, {"l": loc, "s": scores, "ab": anchors,
+                                   "av": avar, "g": gt})
+    lab_np = lab.numpy() if hasattr(lab, "numpy") else np.asarray(lab)
+    tb_np = tb.numpy() if hasattr(tb, "numpy") else np.asarray(tb)
+    pl_np = pl.numpy() if hasattr(pl, "numpy") else np.asarray(pl)
+    n_fg = int((lab_np[:, 0] == 1).sum())
+    assert n_fg == 2                       # both gt-matching anchors sampled
+    assert lab_np.shape[0] <= S
+    # fg rows decode to (near-)zero offsets since gt == anchor
+    np.testing.assert_allclose(tb_np[:n_fg], 0.0, atol=1e-4)
+    assert pl_np.shape[1] == 4

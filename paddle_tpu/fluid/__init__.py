@@ -52,6 +52,10 @@ from .transpiler import (  # noqa: F401
 from . import metrics
 from . import profiler
 from . import nets
+from . import average
+from . import evaluator
+from . import debugger
+from . import contrib
 
 __all__ = [
     "Program", "Operator", "Variable", "Parameter",
@@ -65,5 +69,5 @@ __all__ = [
     "BuildStrategy", "DataFeeder", "metrics", "profiler", "nets",
     "LoDTensor", "create_lod_tensor", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "memory_optimize", "release_memory",
-    "InferenceTranspiler",
+    "InferenceTranspiler", "average", "evaluator", "debugger", "contrib",
 ]

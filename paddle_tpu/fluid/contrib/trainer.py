@@ -1,0 +1,168 @@
+"""High-level Trainer with events + checkpointing.
+
+Reference analogue: python/paddle/fluid/contrib/trainer.py — Trainer (:169),
+train loop with events (BeginEpochEvent/EndEpochEvent/BeginStepEvent/
+EndStepEvent :40-:94), CheckpointConfig auto-save/resume (:100), Tester, and
+env-driven distributed transpile (:324).
+"""
+
+import os
+
+import numpy as np
+
+from .. import core
+from ..framework import Program, default_main_program, default_startup_program
+from ..executor import Executor, global_scope
+from .. import io as fluid_io
+
+__all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "CheckpointConfig", "Trainer"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """reference contrib/trainer.py:100."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            ".", "checkpoints")
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(int(epoch_interval), 1)
+        self.step_interval = max(int(step_interval), 1)
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+
+
+class Trainer:
+    """reference contrib/trainer.py:169. `train_func` builds the loss (and
+    optionally extra metrics) in the current program; `optimizer_func`
+    returns an optimizer."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self.checkpoint_cfg = checkpoint_config
+        self.place = place if place is not None else core.TPUPlace(0)
+        self.parallel = parallel
+        self.train_program = Program()
+        self.startup_program = Program()
+        from ..framework import program_guard
+        with program_guard(self.train_program, self.startup_program):
+            ret = train_func()
+            if isinstance(ret, (list, tuple)):
+                self.train_outputs = list(ret)
+            else:
+                self.train_outputs = [ret]
+            loss = self.train_outputs[0]
+            optimizer = optimizer_func()
+            optimizer.minimize(loss)
+        self.loss = loss
+        self.exe = Executor(self.place)
+        self.exe.run(self.startup_program)
+        if param_path:
+            fluid_io.load_persistables(self.exe, param_path,
+                                       main_program=self.train_program)
+        if self.checkpoint_cfg and os.path.isdir(
+                self.checkpoint_cfg.checkpoint_dir):
+            try:
+                meta = fluid_io.load_checkpoint(
+                    self.exe, self.checkpoint_cfg.checkpoint_dir,
+                    main_program=self.train_program)
+                if meta:
+                    self.checkpoint_cfg.epoch_id = int(
+                        meta.get("epoch", 0))
+                    self.checkpoint_cfg.step_id = int(meta.get("step", 0))
+            except FileNotFoundError:
+                pass
+        self._stop = False
+
+    def stop(self):
+        self._stop = True
+
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        from ..data_feeder import DataFeeder
+        feeder = DataFeeder(feed_list=[
+            self.train_program.global_block().var(n) for n in feed_order],
+            place=self.place, program=self.train_program) \
+            if feed_order else None
+        start_epoch = (self.checkpoint_cfg.epoch_id
+                       if self.checkpoint_cfg else 0)
+        global_step = (self.checkpoint_cfg.step_id
+                       if self.checkpoint_cfg else 0)
+        for epoch_id in range(start_epoch, num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            for step_id, data in enumerate(reader()):
+                if self._stop:
+                    return
+                begin = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin)
+                fetch = self.train_outputs if begin.fetch_metrics else []
+                feed = feeder.feed(data) if feeder else data
+                metrics = self.exe.run(self.train_program, feed=feed,
+                                       fetch_list=fetch)
+                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                global_step += 1
+                if self.checkpoint_cfg and \
+                        global_step % self.checkpoint_cfg.step_interval == 0:
+                    self._save_checkpoint(epoch_id, global_step)
+            event_handler(EndEpochEvent(epoch_id))
+            if self.checkpoint_cfg and \
+                    (epoch_id + 1) % self.checkpoint_cfg.epoch_interval == 0:
+                self._save_checkpoint(epoch_id + 1, global_step)
+
+    def test(self, reader, feed_order):
+        test_program = self.train_program.clone(for_test=True)
+        from ..data_feeder import DataFeeder
+        feeder = DataFeeder(feed_list=[
+            test_program.global_block().var(n) for n in feed_order],
+            place=self.place, program=test_program)
+        accum, count = None, 0
+        for data in reader():
+            res = self.exe.run(test_program, feed=feeder.feed(data),
+                               fetch_list=self.train_outputs)
+            vals = [np.asarray(r).astype(np.float64) for r in res]
+            accum = vals if accum is None else [
+                a + v for a, v in zip(accum, vals)]
+            count += 1
+        return [a / max(count, 1) for a in accum] if accum else []
+
+    def save_params(self, param_path):
+        fluid_io.save_persistables(self.exe, param_path,
+                                   main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        fluid_io.save_inference_model(
+            param_path, feeded_var_names,
+            [self.train_outputs[i] for i in target_var_indexes],
+            self.exe, main_program=self.train_program)
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        fluid_io.save_checkpoint(
+            self.exe, self.checkpoint_cfg.checkpoint_dir,
+            main_program=self.train_program,
+            step={"epoch": epoch_id, "step": step_id})

@@ -1,0 +1,61 @@
+"""Program visualization / pretty-printing.
+
+Reference analogue: python/paddle/fluid/debugger.py (+ graphviz.py,
+net_drawer.py, and the C++ graph_viz_pass ir/graph_viz_pass.cc) — renders a
+Program's op/var graph to graphviz dot text and pretty-prints program code.
+"""
+
+__all__ = ["pprint_program_codes", "pprint_block_codes",
+           "draw_block_graphviz"]
+
+
+def pprint_program_codes(program):
+    return "\n".join(pprint_block_codes(b) for b in program.blocks)
+
+
+def pprint_block_codes(block):
+    lines = ["# block %d (parent %d)" % (block.idx, block.parent_idx)]
+    for var in block.vars.values():
+        lines.append("var %s : %s shape=%s%s" % (
+            var.name, var.dtype, var.shape,
+            " persistable" if var.persistable else ""))
+    for op in block.ops:
+        ins = ", ".join("%s=%s" % (k, v) for k, v in op.inputs.items())
+        outs = ", ".join("%s=%s" % (k, v) for k, v in op.outputs.items())
+        lines.append("%s(%s) -> %s" % (op.type, ins, outs))
+    return "\n".join(lines)
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Write the op/var graph of `block` as graphviz dot (reference
+    debugger.py draw_block_graphviz; C++ analogue graph_viz_pass)."""
+    highlights = set(highlights or [])
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_ids = {}
+
+    def vid(name):
+        if name not in var_ids:
+            var_ids[name] = "var_%d" % len(var_ids)
+            color = ', style=filled, fillcolor="lightblue"' \
+                if name in highlights else ""
+            lines.append('  %s [label="%s", shape=oval%s];' %
+                         (var_ids[name], name, color))
+        return var_ids[name]
+
+    for i, op in enumerate(block.ops):
+        op_id = "op_%d" % i
+        lines.append('  %s [label="%s", shape=box, style=filled, '
+                     'fillcolor="lightgray"];' % (op_id, op.type))
+        for names in op.inputs.values():
+            for n in names:
+                if n:
+                    lines.append("  %s -> %s;" % (vid(n), op_id))
+        for names in op.outputs.values():
+            for n in names:
+                if n:
+                    lines.append("  %s -> %s;" % (op_id, vid(n)))
+    lines.append("}")
+    dot = "\n".join(lines)
+    with open(path, "w") as f:
+        f.write(dot)
+    return dot

@@ -1,0 +1,113 @@
+"""In-graph streaming evaluators.
+
+Reference analogue: python/paddle/fluid/evaluator.py — Evaluator base keeps
+accumulator state variables in the program, `reset` zeroes them and `eval`
+computes the final metric; ChunkEvaluator and EditDistance mirror the
+reference's two concrete evaluators (DetectionMAP lives with the detection
+suite).
+"""
+
+import numpy as np
+
+from .framework import Program, Variable, default_main_program, program_guard
+from . import layers
+from .layer_helper import LayerHelper
+from .executor import global_scope
+from .initializer import Constant
+
+__all__ = ["ChunkEvaluator", "EditDistance"]
+
+
+class Evaluator:
+    def __init__(self, name, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        from . import core
+        scope = global_scope()
+        for var in self.states:
+            dtype = core.convert_dtype_to_np(var.dtype) \
+                if var.dtype is not None else np.float32
+            scope.set(var.name, np.zeros(
+                [1 if d is None or d < 0 else d for d in (var.shape or [1])],
+                dtype=dtype))
+
+    def _create_state(self, suffix, dtype, shape):
+        state = self.helper.create_variable(
+            name="_".join([self.helper.name, suffix]), persistable=True,
+            dtype=dtype, shape=shape)
+        self.states.append(state)
+        return state
+
+
+class ChunkEvaluator(Evaluator):
+    """Streaming chunk F1 (reference evaluator.py ChunkEvaluator): counts
+    inferred/label/correct chunks via the chunk_eval op and accumulates."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super().__init__("chunk_eval")
+        main_program = self.helper.main_program
+        (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
+         num_correct_chunks) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        self.num_infer_chunks = self._create_state(
+            "num_infer_chunks", "int64", [1])
+        self.num_label_chunks = self._create_state(
+            "num_label_chunks", "int64", [1])
+        self.num_correct_chunks = self._create_state(
+            "num_correct_chunks", "int64", [1])
+        layers.sums(input=[self.num_infer_chunks, num_infer_chunks],
+                    out=self.num_infer_chunks)
+        layers.sums(input=[self.num_label_chunks, num_label_chunks],
+                    out=self.num_label_chunks)
+        layers.sums(input=[self.num_correct_chunks, num_correct_chunks],
+                    out=self.num_correct_chunks)
+        self.metrics.extend([precision, recall, f1_score])
+
+    def eval(self, executor, eval_program=None):
+        scope = global_scope()
+        num_infer = float(np.asarray(scope.get(self.num_infer_chunks.name)))
+        num_label = float(np.asarray(scope.get(self.num_label_chunks.name)))
+        num_correct = float(np.asarray(
+            scope.get(self.num_correct_chunks.name)))
+        precision = num_correct / num_infer if num_infer else 0.0
+        recall = num_correct / num_label if num_label else 0.0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if num_correct else 0.0
+        return np.array([precision]), np.array([recall]), np.array([f1])
+
+
+class EditDistance(Evaluator):
+    """Streaming edit distance (reference evaluator.py EditDistance)."""
+
+    def __init__(self, input, label, ignored_tokens=None):
+        super().__init__("edit_distance")
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, ignored_tokens=ignored_tokens)
+        self.total_distance = self._create_state(
+            "total_distance", "float32", [1])
+        self.seq_num = self._create_state("seq_num", "int64", [1])
+        self.instance_error = self._create_state(
+            "instance_error", "int64", [1])
+        dist_sum = layers.reduce_sum(distances)
+        err = layers.cast(distances > layers.fill_constant(
+            [1], "float32", 0.0), "int64")
+        err_sum = layers.reduce_sum(err)
+        layers.sums(input=[self.total_distance, dist_sum],
+                    out=self.total_distance)
+        layers.sums(input=[self.seq_num, seq_num], out=self.seq_num)
+        layers.sums(input=[self.instance_error, err_sum],
+                    out=self.instance_error)
+
+    def eval(self, executor, eval_program=None):
+        scope = global_scope()
+        total = float(np.asarray(scope.get(self.total_distance.name)))
+        n = float(np.asarray(scope.get(self.seq_num.name)))
+        errs = float(np.asarray(scope.get(self.instance_error.name)))
+        avg = total / n if n else 0.0
+        return np.array([avg]), np.array([errs / n if n else 0.0])

@@ -99,6 +99,8 @@ _LOD_DROP_OPS = frozenset([
     # NMS-style ops emit their own @LOD_LEN companions explicitly
     "bipartite_match", "target_assign", "mine_hard_examples",
     "multiclass_nms", "generate_proposals",
+    # per-sequence scatter writes into a dense [B, D] tensor
+    "sequence_scatter",
 ])
 
 

@@ -33,6 +33,11 @@ __all__ = [
     "cast", "leaky_relu", "soft_relu", "prelu", "brelu", "elu", "relu6",
     "pow", "hard_sigmoid", "swish", "grid_sampler", "maxout",
     "sampled_softmax_with_cross_entropy", "where", "sign", "unique_with_counts",
+    "affine_grid", "affine_channel", "random_crop", "pool3d",
+    "conv3d_transpose", "im2sequence", "unpool", "row_conv", "label_smooth",
+    "bilinear_tensor_product", "crop", "selu", "spp", "shuffle_channel",
+    "psroi_pool", "scatter_nd_add", "scatter_nd", "squared_l2_distance",
+    "l2_norm_layer", "fsp_matrix", "gather_tree", "pad_constant_like",
 ]
 
 
@@ -1030,7 +1035,299 @@ def resize_nearest(input, out_shape=None, scale=None, name=None,
 
 
 def grid_sampler(x, grid, name=None):
-    raise NotImplementedError("grid_sampler lands with the detection ops")
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="grid_sampler", inputs={"X": x, "Grid": grid},
+                     outputs={"Output": out})
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    if isinstance(out_shape, Variable):
+        # output H/W set array shapes; XLA needs them static at trace time
+        raise NotImplementedError(
+            "affine_grid on TPU requires a static (list) out_shape; a "
+            "tensor out_shape would make the grid shape data-dependent")
+    attrs = {"output_shape": [int(d) for d in out_shape]}
+    helper.append_op(type="affine_grid", inputs={"Theta": theta},
+                     outputs={"Output": out}, attrs=attrs)
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("affine_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x}
+    if scale is not None:
+        inputs["Scale"] = scale
+    if bias is not None:
+        inputs["Bias"] = bias
+    helper.append_op(type="affine_channel", inputs=inputs,
+                     outputs={"Out": out},
+                     attrs={"data_layout": data_layout})
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="random_crop", inputs={"X": x},
+                     outputs={"Out": out},
+                     attrs={"shape": [int(d) for d in shape],
+                            "seed": seed or 0})
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, name=None):
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+
+    def _t(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+
+    helper.append_op(type="pool3d", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"pooling_type": pool_type,
+                            "ksize": _t(pool_size),
+                            "strides": _t(pool_stride),
+                            "paddings": _t(pool_padding),
+                            "global_pooling": global_pooling,
+                            "ceil_mode": ceil_mode,
+                            "exclusive": exclusive})
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+
+    def _t(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+
+    stride, padding, dilation = _t(stride), _t(padding), _t(dilation)
+    if groups not in (None, 1):
+        raise NotImplementedError("grouped conv3d_transpose")
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("output_size or filter_size required")
+        if isinstance(output_size, int):
+            output_size = [output_size] * 3
+        fsize = [
+            output_size[i] - (input.shape[2 + i] - 1) * stride[i]
+            + 2 * padding[i] for i in range(3)]
+    else:
+        fsize = _t(filter_size)
+    c_in = input.shape[1]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[c_in, num_filters] + fsize,
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="conv3d_transpose",
+                     inputs={"Input": input, "Filter": w},
+                     outputs={"Output": out},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation})
+    out = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(out)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+
+    def _p(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    pads = _p(padding)
+    if len(pads) == 2:
+        pads = pads + pads
+    helper.append_op(type="im2sequence", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"kernels": _p(filter_size),
+                            "strides": _p(stride), "paddings": pads})
+    return out
+
+
+def unpool(x, indices, ksize=(2, 2), strides=(2, 2), paddings=(0, 0)):
+    helper = LayerHelper("unpool")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="unpool",
+                     inputs={"X": x, "Indices": indices},
+                     outputs={"Out": out},
+                     attrs={"ksize": list(ksize), "strides": list(strides),
+                            "paddings": list(paddings)})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    D = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[future_context_size + 1, D],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="row_conv", inputs={"X": input, "Filter": w},
+                     outputs={"Out": out})
+    return helper.append_activation(out)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": label}
+    if prior_dist is not None:
+        inputs["PriorDist"] = prior_dist
+    helper.append_op(type="label_smooth", inputs=inputs,
+                     outputs={"Out": out}, attrs={"epsilon": float(epsilon)})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[size, x.shape[1], y.shape[1]],
+                                dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x, "Y": y, "Weight": w}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[1, size],
+                                    dtype=x.dtype, is_bias=True)
+        inputs["Bias"] = b
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": out})
+    return helper.append_activation(out)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x}
+    attrs = {}
+    if isinstance(shape, Variable):
+        inputs["Y"] = shape
+    else:
+        attrs["shape"] = [int(d) for d in shape]
+    if isinstance(offsets, Variable):
+        inputs["Offsets"] = offsets
+    elif offsets is not None:
+        attrs["offsets"] = [int(d) for d in offsets]
+    helper.append_op(type="crop", inputs=inputs, outputs={"Out": out},
+                     attrs=attrs)
+    return out
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    helper = LayerHelper("selu", name=name)
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    if alpha is not None:
+        attrs["alpha"] = float(alpha)
+    return _single_op_layer(helper, "selu", x, attrs=attrs)
+
+
+def spp(input, pyramid_height=3, pool_type="max"):
+    helper = LayerHelper("spp")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="spp", inputs={"X": input}, outputs={"Out": out},
+                     attrs={"pyramid_height": int(pyramid_height),
+                            "pooling_type": pool_type})
+    return out
+
+
+def shuffle_channel(x, group, name=None):
+    helper = LayerHelper("shuffle_channel", name=name)
+    return _single_op_layer(helper, "shuffle_channel", x,
+                            attrs={"group": int(group)})
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="psroi_pool",
+                     inputs={"X": input, "ROIs": rois},
+                     outputs={"Out": out},
+                     attrs={"output_channels": int(output_channels),
+                            "spatial_scale": float(spatial_scale),
+                            "pooled_height": int(pooled_height),
+                            "pooled_width": int(pooled_width)})
+    return out
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    helper = LayerHelper("scatter_nd_add", name=name)
+    out = helper.create_variable_for_type_inference(ref.dtype)
+    helper.append_op(type="scatter_nd_add",
+                     inputs={"X": ref, "Index": index, "Updates": updates},
+                     outputs={"Out": out})
+    return out
+
+
+def scatter_nd(index, updates, shape, name=None):
+    helper = LayerHelper("scatter_nd", name=name)
+    out = helper.create_variable_for_type_inference(updates.dtype)
+    helper.append_op(type="scatter_nd",
+                     inputs={"Index": index, "Updates": updates},
+                     outputs={"Out": out},
+                     attrs={"shape": [int(d) for d in shape]})
+    return out
+
+
+def squared_l2_distance(x, y):
+    helper = LayerHelper("squared_l2_distance")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    sub = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op(type="squared_l2_distance",
+                     inputs={"X": x, "Y": y},
+                     outputs={"Out": out, "sub_result": sub})
+    return out
+
+
+def l2_norm_layer(x, axis=1, epsilon=1e-10):
+    """`norm` op wrapper (norm_op.cc)."""
+    helper = LayerHelper("norm")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op(type="norm", inputs={"X": x},
+                     outputs={"Out": out, "Norm": norm},
+                     attrs={"axis": int(axis), "epsilon": float(epsilon)})
+    return out
+
+
+def fsp_matrix(x, y):
+    helper = LayerHelper("fsp")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="fsp", inputs={"X": x, "Y": y},
+                     outputs={"Out": out})
+    return out
+
+
+def gather_tree(ids, parents):
+    helper = LayerHelper("gather_tree")
+    out = helper.create_variable_for_type_inference(ids.dtype)
+    helper.append_op(type="gather_tree",
+                     inputs={"Ids": ids, "Parents": parents},
+                     outputs={"Out": out})
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op(type="pad_constant_like", inputs={"X": x, "Y": y},
+                     outputs={"Out": out},
+                     attrs={"pad_value": float(pad_value)})
+    return out
 
 
 def unique_with_counts(x, dtype="int32"):

@@ -14,6 +14,7 @@ __all__ = [
     "sequence_first_step", "sequence_last_step", "sequence_reverse",
     "sequence_pad", "sequence_unpad", "sequence_mask", "sequence_enumerate",
     "sequence_reshape", "sequence_slice", "sequence_concat",
+    "sequence_scatter", "sequence_expand_as",
 ]
 
 
@@ -238,5 +239,26 @@ def sequence_concat(input, name=None):
     out = helper.create_variable_for_type_inference(input[0].dtype)
     out.lod_level = 1
     helper.append_op(type="sequence_concat", inputs={"X": input},
+                     outputs={"Out": out})
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """reference sequence_scatter_op.cc: per-sequence scatter-add of
+    `updates` rows into `input` at `index` positions."""
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_scatter",
+                     inputs={"X": input, "Ids": index, "Updates": updates},
+                     outputs={"Out": out})
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    """reference sequence_expand_as_op.cc: repeat row i of x len(y_i)
+    times."""
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_expand_as", inputs={"X": x, "Y": y},
                      outputs={"Out": out})
     return out

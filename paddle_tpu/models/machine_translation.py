@@ -62,12 +62,17 @@ def bi_lstm_encoder(input_seq, gate_size):
 
 
 def simple_attention(encoder_vec, encoder_proj, decoder_state, decoder_size):
-    state_proj = fluid.layers.fc(input=decoder_state, size=decoder_size,
-                                 bias_attr=False)
+    """Additive attention over the ragged encoder axis. Parameter names are
+    pinned ("att_state_w", "att_score_w") so the dense generation decoder
+    (below) can reuse the trained weights."""
+    state_proj = fluid.layers.fc(
+        input=decoder_state, size=decoder_size, bias_attr=False,
+        param_attr=fluid.ParamAttr(name="att_state_w"))
     state_expand = fluid.layers.sequence_expand(x=state_proj, y=encoder_proj)
     concated = fluid.layers.concat(input=[encoder_proj, state_expand], axis=1)
     weights = fluid.layers.fc(input=concated, size=1, act="tanh",
-                              bias_attr=False)
+                              bias_attr=False,
+                              param_attr=fluid.ParamAttr(name="att_score_w"))
     weights = fluid.layers.sequence_softmax(input=weights)
     weights = fluid.layers.reshape(x=weights, shape=[-1])
     scaled = fluid.layers.elementwise_mul(x=encoder_vec, y=weights, axis=0)
@@ -135,24 +140,75 @@ def seq_to_seq_net(embedding_dim, encoder_size, decoder_size,
                         "label_sequence"]
         return avg_cost, prediction, feeding_list
 
-    # -- generation: dense beam search conditioned on the encoder state --
+    # -- generation: dense beam search with per-step attention, sharing the
+    # training decoder's parameters (attention + lstm gates + output fc) --
     W = beam_size
-    # context [B, D] -> repeat-interleave to [B*W, D] (unsqueeze/expand)
-    ctx0 = fluid.layers.unsqueeze(decoder_boot, axes=[1])      # [B, 1, D]
-    ctx0 = fluid.layers.expand(ctx0, expand_times=[1, W, 1])   # [B, W, D]
-    context = fluid.layers.reshape(ctx0, shape=[-1, decoder_size])
+    # replicate per-source state to W beam rows: [B, D] -> [B*W, D]
+    boot0 = fluid.layers.unsqueeze(decoder_boot, axes=[1])      # [B, 1, D]
+    boot0 = fluid.layers.expand(boot0, expand_times=[1, W, 1])  # [B, W, D]
+    boot_beam = fluid.layers.reshape(boot0, shape=[-1, decoder_size])
+
+    # dense (padded) encoder states + validity mask, gathered per beam row
+    pad0 = fluid.layers.fill_constant([1], "float32", 0.0)
+    enc_pad, _ = fluid.layers.sequence_pad(encoded_vector, pad0)  # [B,T,2E]
+    proj_pad, _ = fluid.layers.sequence_pad(encoded_proj, pad0)   # [B,T,D]
+    ones_ragged = fluid.layers.scale(
+        fluid.layers.cast(src_word_idx, "float32"), scale=0.0, bias=1.0)
+    mask_pad, _ = fluid.layers.sequence_pad(ones_ragged, pad0)    # [B,T,1]
+    ones_bw = fluid.layers.fill_constant_batch_size_like(
+        input=boot_beam, shape=[-1, 1], value=1.0, dtype="float32")
+    ramp = fluid.layers.cumsum(ones_bw, axis=0, exclusive=True)  # 0..BW-1
+    src_idx = fluid.layers.cast(
+        fluid.layers.floor(fluid.layers.scale(ramp, scale=1.0 / W)), "int32")
+    src_idx = fluid.layers.reshape(src_idx, shape=[-1])
+    enc_beam = fluid.layers.gather(enc_pad, src_idx)      # [BW, T, 2E]
+    proj_beam = fluid.layers.gather(proj_pad, src_idx)    # [BW, T, D]
+    mask_beam = fluid.layers.gather(mask_pad, src_idx)    # [BW, T, 1]
+
+    # attention score weight shared with training: att_score_w [2D, 1],
+    # split into the encoder-proj half and the state half
+    helper = fluid.LayerHelper("gen_attention")
+    att_w = helper.create_parameter(
+        attr=fluid.ParamAttr(name="att_score_w"),
+        shape=[2 * decoder_size, 1], dtype="float32")
+    w_proj = fluid.layers.slice(att_w, axes=[0], starts=[0],
+                                ends=[decoder_size])
+    w_state = fluid.layers.slice(att_w, axes=[0], starts=[decoder_size],
+                                 ends=[2 * decoder_size])
+
+    def dense_attention(hidden):
+        """Same math as simple_attention, on padded beam tensors:
+        fc(concat([proj, state])) == proj @ w_proj + state @ w_state."""
+        state_proj = fluid.layers.fc(
+            input=hidden, size=decoder_size, bias_attr=False,
+            param_attr=fluid.ParamAttr(name="att_state_w"))
+        s_enc = fluid.layers.matmul(proj_beam, w_proj)     # [BW, T, 1]
+        s_state = fluid.layers.unsqueeze(
+            fluid.layers.matmul(state_proj, w_state), axes=[1])  # [BW,1,1]
+        score = fluid.layers.tanh(
+            fluid.layers.elementwise_add(s_enc, s_state))
+        neg = fluid.layers.scale(mask_beam, scale=1e9, bias=-1e9)
+        score = fluid.layers.elementwise_add(
+            fluid.layers.elementwise_mul(score, mask_beam), neg)
+        score = fluid.layers.squeeze(score, axes=[2])      # [BW, T]
+        att = fluid.layers.softmax(score)
+        att = fluid.layers.unsqueeze(att, axes=[2])        # [BW, T, 1]
+        ctx = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(enc_beam, att), dim=1)
+        return ctx                                          # [BW, 2E]
 
     start_id = 0
     end_id = 1
     pre_ids = fluid.layers.fill_constant_batch_size_like(
-        input=context, shape=[-1, 1], value=start_id, dtype="int64")
+        input=boot_beam, shape=[-1, 1], value=start_id, dtype="int64")
     pre_scores = fluid.layers.fill_constant_batch_size_like(
-        input=context, shape=[-1, 1], value=0.0, dtype="float32")
+        input=boot_beam, shape=[-1, 1], value=0.0, dtype="float32")
 
     step_ids, step_scores, step_parents = [], [], []
-    hidden = context
+    hidden = boot_beam
     cell = fluid.layers.fill_constant_batch_size_like(
-        input=context, shape=[-1, decoder_size], value=0.0, dtype="float32")
+        input=boot_beam, shape=[-1, decoder_size], value=0.0,
+        dtype="float32")
     first = True
     for t in range(max_length):
         word_emb = fluid.layers.embedding(
@@ -160,6 +216,7 @@ def seq_to_seq_net(embedding_dim, encoder_size, decoder_size,
             dtype="float32", param_attr=fluid.ParamAttr(name="trg_emb"))
         word_emb = fluid.layers.reshape(word_emb,
                                         shape=[-1, embedding_dim])
+        context = dense_attention(hidden)
         dec_in = fluid.layers.concat(input=[context, word_emb], axis=1)
         hidden, cell = lstm_step(dec_in, hidden, cell, decoder_size,
                                  param_prefix="decoder_lstm")
@@ -175,7 +232,7 @@ def seq_to_seq_net(embedding_dim, encoder_size, decoder_size,
             # starts with a single LoD beam per source)
             first = False
             accu = fluid.layers.elementwise_add(
-                accu, _beam_slot_mask(context, W), axis=0)
+                accu, _beam_slot_mask(boot_beam, W), axis=0)
         sel_ids, sel_scores, parent_idx = fluid.layers.beam_search(
             pre_ids, pre_scores, None, accu, beam_size=W, end_id=end_id,
             return_parent_idx=True)

@@ -1,0 +1,257 @@
+"""Misc tensor / sequence op lowerings completing the §2.2 inventory.
+
+Reference analogues: norm_op.cc, squared_l2_distance_op.cc,
+pad_constant_like_op.cc, label_smooth_op.cc, bilinear_tensor_product_op.cc,
+scatter_nd_add_op (gather_scatter family), sequence_scatter_op.cc,
+sequence_expand_as_op.cc, gather_tree (beam ancestry), row_conv_op.cc,
+fsp_op (distillation), fake_quantize_op.cc / fake_dequantize_op.cc.
+"""
+
+import numpy as np
+
+from .registry import register_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register_op("norm")
+def _norm(ctx):
+    """l2-normalize along axis; emits Out and the Norm denominator."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    axis = int(ctx.attr("axis", 1))
+    eps = float(ctx.attr("epsilon", 1e-10))
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx):
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("Y")
+    sub = x - y                      # y may broadcast [1, D] -> [N, D]
+    sub = jnp.broadcast_to(sub, (x.shape[0],) + sub.shape[1:])
+    out = jnp.sum(sub * sub, axis=tuple(range(1, sub.ndim)),
+                  keepdims=False)[:, None]
+    return {"Out": out, "sub_result": sub}
+
+
+@register_op("pad_constant_like")
+def _pad_constant_like(ctx):
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("Y")
+    val = ctx.attr("pad_value", 0.0)
+    pads = [(0, int(xd) - int(yd)) for xd, yd in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, pads, constant_values=val)}
+
+
+@register_op("label_smooth")
+def _label_smooth(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")       # one-hot-ish distribution [..., K]
+    eps = float(ctx.attr("epsilon", 0.0))
+    dist = ctx.input("PriorDist")
+    K = x.shape[-1]
+    if dist is not None:
+        prior = dist.reshape((1,) * (x.ndim - 1) + (K,))
+        return {"Out": (1.0 - eps) * x + eps * prior}
+    return {"Out": (1.0 - eps) * x + eps / K}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")       # [N, M]
+    y = ctx.input("Y")       # [N, P]
+    w = ctx.input("Weight")  # [K, M, P]
+    out = jnp.einsum("nm,kmp,np->nk", x, w, y)
+    b = ctx.input("Bias")
+    if b is not None:
+        out = out + b.reshape(1, -1)
+    return {"Out": out}
+
+
+@register_op("scatter_nd_add")
+def _scatter_nd_add(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    index = ctx.input("Index").astype(jnp.int32)
+    updates = ctx.input("Updates")
+    idx = tuple(index[..., i] for i in range(index.shape[-1]))
+    return {"Out": x.at[idx].add(updates)}
+
+
+@register_op("scatter_nd")
+def _scatter_nd(ctx):
+    jnp = _jnp()
+    index = ctx.input("Index").astype(jnp.int32)
+    updates = ctx.input("Updates")
+    shape = [int(d) for d in ctx.attr("shape")]
+    zeros = jnp.zeros(shape, updates.dtype)
+    idx = tuple(index[..., i] for i in range(index.shape[-1]))
+    return {"Out": zeros.at[idx].add(updates)}
+
+
+@register_op("sequence_scatter")
+def _sequence_scatter(ctx):
+    """X [B, D]; Ids ragged [B, T] + lens; Updates ragged [B, T]:
+    out[b, ids[b,t]] += updates[b,t] for valid t (sequence_scatter_op.cc)."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    ids = ctx.input("Ids")
+    upd = ctx.input("Updates")
+    lens = ctx.lod_len("Ids")
+    if ids.ndim == 3:
+        ids = ids[..., 0]
+    if upd.ndim == 3:
+        upd = upd[..., 0]
+    B, T = ids.shape
+    if lens is None:
+        valid = jnp.ones((B, T), bool)
+    else:
+        valid = jnp.arange(T)[None, :] < lens[:, None]
+    upd = jnp.where(valid, upd, 0)
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    out = x.at[rows.reshape(-1), ids.reshape(-1).astype(jnp.int32)].add(
+        upd.reshape(-1))
+    return {"Out": out}
+
+
+@register_op("sequence_expand_as")
+def _sequence_expand_as(ctx):
+    """X [B, D] one row per sequence -> ragged [B, T, D] repeating each row
+    len(Y_b) times (sequence_expand_as_op.cc)."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    ylens = ctx.lod_len("Y")
+    T = y.shape[1] if y.ndim > 1 else y.shape[0]
+    B = x.shape[0]
+    if ylens is None:
+        ylens = jnp.full((B,), T, jnp.int32)
+    out = jnp.broadcast_to(x[:, None], (B, T) + x.shape[1:])
+    mask = (jnp.arange(T)[None, :] < ylens[:, None])
+    out = out * mask.reshape((B, T) + (1,) * (x.ndim - 1)).astype(x.dtype)
+    return {"Out": out, "Out@LOD_LEN": ylens}
+
+
+@register_op("gather_tree")
+def _gather_tree(ctx):
+    """Beam ancestry walk (gather_tree): Ids/Parents [T, B, W] ->
+    full sequences [T, B, W] by backtracking parents from the last step."""
+    import jax
+    jnp = _jnp()
+    ids = ctx.input("Ids")
+    parents = ctx.input("Parents").astype(jnp.int32)
+    T, B, W = ids.shape
+
+    def step(carry, t):
+        beam = carry                      # [B, W] beam index at step t+1
+        idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, W))
+        out_t = ids[t, idx, beam]
+        parent = parents[t, idx, beam]
+        return parent, out_t
+
+    beam0 = jnp.broadcast_to(jnp.arange(W)[None, :], (B, W))
+    _, outs = jax.lax.scan(step, beam0, jnp.arange(T - 1, -1, -1))
+    return {"Out": outs[::-1]}
+
+
+@register_op("row_conv")
+def _row_conv(ctx):
+    """Lookahead row convolution (row_conv_op.cc): ragged X [B, T, D],
+    Filter [k, D]: out[t] = sum_j filter[j] * x[t + j], zero past the
+    sequence end."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    w = ctx.input("Filter")
+    lens = ctx.lod_len("X")
+    B, T, D = x.shape
+    k = w.shape[0]
+    if lens is not None:
+        mask = (jnp.arange(T)[None, :] < lens[:, None]).astype(x.dtype)
+        x = x * mask[:, :, None]
+    out = jnp.zeros_like(x)
+    padded = jnp.pad(x, ((0, 0), (0, k), (0, 0)))
+    for j in range(k):
+        out = out + padded[:, j:j + T, :] * w[j][None, None, :]
+    return {"Out": out}
+
+
+@register_op("fsp")
+def _fsp(ctx):
+    """FSP matrix for distillation (fsp_op): X [N,C1,H,W], Y [N,C2,H,W] ->
+    [N, C1, C2] mean over H*W of channel outer products."""
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("Y")
+    hw = x.shape[2] * x.shape[3]
+    return {"Out": jnp.einsum("nchw,ndhw->ncd", x, y) / hw}
+
+
+# ---------------------------------------------------------------------------
+# quantization (fake_quantize_op.cc, fake_dequantize_op.cc)
+# ---------------------------------------------------------------------------
+
+def _quant(x, scale, bit_length):
+    jnp = _jnp()
+    bnt = (1 << (bit_length - 1)) - 1
+    return jnp.round(jnp.clip(x / scale, -1.0, 1.0) * bnt)
+
+
+@register_op("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    bits = int(ctx.attr("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    scale = jnp.maximum(scale, 1e-12)
+    return {"Out": _quant(x, scale, bits), "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_quantize_range_abs_max")
+def _fake_quantize_range_abs_max(ctx):
+    """Running-max variant: in training the scale is the max of the sliding
+    scale window; we use current-batch abs max folded with InScale (the
+    stateless functional equivalent)."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    bits = int(ctx.attr("bit_length", 8))
+    cur = jnp.max(jnp.abs(x))
+    in_scale = ctx.input("InScale")
+    if in_scale is not None and not ctx.attr("is_test", False):
+        scale = jnp.maximum(cur, in_scale.reshape(())[None][0])
+    elif in_scale is not None:
+        scale = in_scale.reshape(())[None][0]
+    else:
+        scale = cur
+    scale = jnp.maximum(scale, 1e-12)
+    return {"Out": _quant(x, scale, bits), "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_dequantize_max_abs")
+def _fake_dequantize_max_abs(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    scale = ctx.input("Scale").reshape(())
+    max_range = float(ctx.attr("max_range", 127.0))
+    return {"Out": x * scale / max_range}
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def _fake_quantize_dequantize_abs_max(ctx):
+    """Quantize-dequantize with a straight-through estimator so QAT
+    gradients flow as identity through the rounding (the reference's grad
+    kernel passes dOut through unchanged)."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    bits = int(ctx.attr("bit_length", 8))
+    bnt = (1 << (bits - 1)) - 1
+    scale = jnp.maximum(jnp.max(jnp.abs(jax.lax.stop_gradient(x))), 1e-12)
+    q = jnp.round(jnp.clip(x / scale, -1.0, 1.0) * bnt)
+    qdq = q * scale / bnt
+    out = x + jax.lax.stop_gradient(qdq - x)    # STE
+    return {"Out": out, "OutScale": scale.reshape(1)}

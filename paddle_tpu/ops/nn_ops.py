@@ -88,9 +88,13 @@ def _conv2d_transpose(ctx):
     pads = _pair(ctx.attr("paddings", [0, 0]))
     dilations = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1) or 1
+    # jax conv_transpose applies `padding` directly to the dilated input;
+    # the reference's deconv padding p (output = (H-1)s + d(k-1) - 2p + 1)
+    # maps to jax padding d*(k-1) - p per side
+    jpads = [(dilations[i] * (w.shape[2 + i] - 1) - pads[i],) * 2
+             for i in range(2)]
     out = jax.lax.conv_transpose(
-        x, w, strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        x, w, strides=strides, padding=jpads,
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "IOHW", "NCHW"),
         transpose_kernel=True)
@@ -103,6 +107,14 @@ def _conv2d_transpose(ctx):
 # pooling (pool_op.cc)
 # ---------------------------------------------------------------------------
 
+def ceil_extra_pad(extent, k, s, p):
+    """Extra high-side padding so lax.reduce_window (floor semantics)
+    reproduces the reference's ceil_mode output size (pool_op.h
+    OutputSizePool with ceil)."""
+    out_ceil = (extent + 2 * p - k + s - 1) // s + 1
+    return max((out_ceil - 1) * s + k - (extent + 2 * p), 0)
+
+
 @register_op("pool2d")
 def _pool2d(ctx):
     import jax
@@ -112,17 +124,23 @@ def _pool2d(ctx):
     ksize = _pair(ctx.attr("ksize", [2, 2]))
     strides = _pair(ctx.attr("strides", [1, 1]))
     pads = _pair(ctx.attr("paddings", [0, 0]))
+    ceil_mode = bool(ctx.attr("ceil_mode", False))
     if ctx.attr("global_pooling", False):
         ksize = (x.shape[2], x.shape[3])
         strides = ksize
         pads = (0, 0)
+        ceil_mode = False
     if ctx.attr("adaptive", False) and tuple(ksize) == (1, 1):
         # adaptive 1x1 == global pooling
         ksize = (x.shape[2], x.shape[3])
         strides, pads = ksize, (0, 0)
+        ceil_mode = False
     window = (1, 1) + ksize
     stride = (1, 1) + strides
-    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    extras = [ceil_extra_pad(x.shape[2 + i], ksize[i], strides[i], pads[i])
+              if ceil_mode else 0 for i in range(2)]
+    padding = ((0, 0), (0, 0), (pads[0], pads[0] + extras[0]),
+               (pads[1], pads[1] + extras[1]))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
@@ -131,7 +149,8 @@ def _pool2d(ctx):
     else:
         summed = jax.lax.reduce_window(
             x, np.asarray(0, x.dtype), jax.lax.add, window, stride, padding)
-        if ctx.attr("exclusive", True) and (pads[0] or pads[1]):
+        if ctx.attr("exclusive", True) and (pads[0] or pads[1] or
+                                            any(extras)):
             ones = jnp.ones(x.shape, x.dtype)
             counts = jax.lax.reduce_window(
                 ones, np.asarray(0, x.dtype), jax.lax.add, window, stride,

@@ -1,0 +1,372 @@
+"""Vision op lowerings beyond the conv/pool core.
+
+Reference analogues: grid_sampler_op.cc, affine_grid_op.cc,
+affine_channel_op.cc, pool_op.cc (pool3d), conv_transpose_op.cc
+(conv3d_transpose), unpool_op.cc, spp_op.cc, shuffle_channel (reshape
+trick), psroi_pool_op.cc, crop_op.cc, random_crop_op.cc, im2sequence_op.cc,
+activation_op.cc (selu) — SURVEY.md §2.2 dense-math / tensor-manip rows.
+
+TPU notes: samplers are expressed as gathers + bilinear weights (XLA fuses
+the four corner gathers); pooling variants ride lax.reduce_window which XLA
+lowers to the TPU's windowed reductions.
+"""
+
+import numpy as np
+
+from .registry import register_op
+from .nn_ops import _pair, ceil_extra_pad
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _triple(v):
+    return _pair(v, 3)
+
+
+def _bilinear_nchw(feat, ys, xs, align=True):
+    """feat [C,H,W]; ys/xs [...] pixel coords -> [C, ...] bilinear samples,
+    zero outside."""
+    jnp = _jnp()
+    H, W = feat.shape[1], feat.shape[2]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+
+    def at(yy, xx):
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        inb = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        return feat[:, yi, xi] * inb.astype(feat.dtype)[None]
+
+    return (at(y0, x0) * ((1 - wy1) * (1 - wx1)) +
+            at(y0, x0 + 1) * ((1 - wy1) * wx1) +
+            at(y0 + 1, x0) * (wy1 * (1 - wx1)) +
+            at(y0 + 1, x0 + 1) * (wy1 * wx1))
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ctx):
+    """X [N,C,H,W], Grid [N,H',W',2] normalized to [-1,1] -> [N,C,H',W']
+    (grid_sampler_op.cc: bilinear, zero padding, align_corners)."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    grid = ctx.input("Grid")
+    H, W = x.shape[2], x.shape[3]
+
+    def one(feat, g):
+        xs = (g[..., 0] + 1.0) * (W - 1) / 2.0
+        ys = (g[..., 1] + 1.0) * (H - 1) / 2.0
+        return _bilinear_nchw(feat, ys, xs)
+
+    return {"Output": jax.vmap(one)(x, grid)}
+
+
+@register_op("affine_grid")
+def _affine_grid(ctx):
+    """Theta [N,2,3] -> Grid [N,H,W,2] of normalized sample coords
+    (affine_grid_op.cc)."""
+    jnp = _jnp()
+    theta = ctx.input("Theta")
+    if ctx.has_input("OutputShape"):
+        # output H/W define array shapes, which XLA requires static; a
+        # traced OutputShape tensor cannot be supported (the layer rejects
+        # Variables up front with a clear error)
+        shape = [int(d) for d in np.asarray(ctx.input("OutputShape"))]
+    else:
+        shape = [int(d) for d in ctx.attr("output_shape")]
+    H, W = shape[2], shape[3]
+    ys = jnp.linspace(-1.0, 1.0, H)
+    xs = jnp.linspace(-1.0, 1.0, W)
+    xg, yg = jnp.meshgrid(xs, ys)            # [H, W]
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, ones], axis=-1)    # [H, W, 3]
+    out = jnp.einsum("hwk,nck->nhwc", base.astype(theta.dtype), theta)
+    return {"Output": out}
+
+
+@register_op("affine_channel")
+def _affine_channel(ctx):
+    x = ctx.input("X")
+    layout = ctx.attr("data_layout", "NCHW")
+    cshape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)
+    scale = ctx.input("Scale")
+    bias = ctx.input("Bias")
+    out = x
+    if scale is not None:
+        out = out * scale.reshape(cshape)
+    if bias is not None:
+        out = out + bias.reshape(cshape)
+    return {"Out": out}
+
+
+@register_op("pool3d")
+def _pool3d(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = _triple(ctx.attr("ksize", [2, 2, 2]))
+    strides = _triple(ctx.attr("strides", [1, 1, 1]))
+    pads = _triple(ctx.attr("paddings", [0, 0, 0]))
+    ceil_mode = bool(ctx.attr("ceil_mode", False))
+    if ctx.attr("global_pooling", False):
+        ksize = (x.shape[2], x.shape[3], x.shape[4])
+        strides, pads = ksize, (0, 0, 0)
+        ceil_mode = False
+    window = (1, 1) + ksize
+    stride = (1, 1) + strides
+    extras = [ceil_extra_pad(x.shape[2 + i], ksize[i], strides[i], pads[i])
+              if ceil_mode else 0 for i in range(3)]
+    padding = ((0, 0), (0, 0)) + tuple(
+        (p, p + e) for p, e in zip(pads, extras))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, np.asarray(init, x.dtype),
+                                    jax.lax.max, window, stride, padding)
+    else:
+        summed = jax.lax.reduce_window(
+            x, np.asarray(0, x.dtype), jax.lax.add, window, stride, padding)
+        if ctx.attr("exclusive", True) and (any(pads) or any(extras)):
+            ones = jnp.ones(x.shape, x.dtype)
+            counts = jax.lax.reduce_window(
+                ones, np.asarray(0, x.dtype), jax.lax.add, window, stride,
+                padding)
+            out = summed / counts
+        else:
+            out = summed / np.asarray(
+                ksize[0] * ksize[1] * ksize[2], x.dtype)
+    return {"Out": out}
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx):
+    import jax
+    x, w = ctx.input("Input"), ctx.input("Filter")
+    strides = _triple(ctx.attr("strides", [1, 1, 1]))
+    pads = _triple(ctx.attr("paddings", [0, 0, 0]))
+    dilations = _triple(ctx.attr("dilations", [1, 1, 1]))
+    # filter layout IODHW (reference conv_transpose filter [C_in, C_out,
+    # D, H, W]); jax applies `padding` to the dilated input directly, so
+    # the reference's deconv padding p maps to d*(k-1) - p per side
+    jpads = [(dilations[i] * (w.shape[2 + i] - 1) - pads[i],) * 2
+             for i in range(3)]
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides, padding=jpads,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+        transpose_kernel=True)
+    return {"Output": out.astype(x.dtype)}
+
+
+@register_op("unpool")
+def _unpool(ctx):
+    """Max unpooling (unpool_op.cc): X [N,C,h,w] pooled values, Indices
+    [N,C,h,w] flat positions within each HxW output plane."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    idx = ctx.input("Indices").astype(jnp.int32)
+    ksize = ctx.attr("ksize", [2, 2])
+    strides = ctx.attr("strides", [2, 2])
+    pads = ctx.attr("paddings", [0, 0])
+    N, C, h, w = x.shape
+    H = (h - 1) * strides[0] - 2 * pads[0] + ksize[0]
+    W = (w - 1) * strides[1] - 2 * pads[1] + ksize[1]
+    flat = jnp.zeros((N, C, H * W), x.dtype)
+    out = flat.at[
+        jnp.arange(N)[:, None, None],
+        jnp.arange(C)[None, :, None],
+        idx.reshape(N, C, -1)].add(x.reshape(N, C, -1))
+    return {"Out": out.reshape(N, C, H, W)}
+
+
+def _adaptive_pool2d_masked(x, bins_h, bins_w, ptype):
+    """Adaptive pooling via per-bin masks (integer boundaries matching the
+    reference's ADAPT_START/END). x [N,C,H,W] -> [N,C,bins_h,bins_w]."""
+    jnp = _jnp()
+    N, C, H, W = x.shape
+    hi = jnp.arange(H)
+    wi = jnp.arange(W)
+    ib = np.arange(bins_h)
+    jb = np.arange(bins_w)
+    hstart = np.floor(ib * H / bins_h).astype(np.int64)
+    hend = np.ceil((ib + 1) * H / bins_h).astype(np.int64)
+    wstart = np.floor(jb * W / bins_w).astype(np.int64)
+    wend = np.ceil((jb + 1) * W / bins_w).astype(np.int64)
+    hmask = (hi[None, :] >= hstart[:, None]) & (hi[None, :] < hend[:, None])
+    wmask = (wi[None, :] >= wstart[:, None]) & (wi[None, :] < wend[:, None])
+    m = (hmask[:, None, :, None] & wmask[None, :, None, :])  # [bh,bw,H,W]
+    xb = x[:, :, None, None, :, :]                            # [N,C,1,1,H,W]
+    if ptype == "max":
+        big = jnp.where(m[None, None], xb,
+                        jnp.asarray(-np.inf, x.dtype))
+        return jnp.max(big, axis=(4, 5))
+    big = jnp.where(m[None, None], xb, jnp.asarray(0, x.dtype))
+    counts = m.sum(axis=(2, 3)).astype(x.dtype)               # [bh,bw]
+    return jnp.sum(big, axis=(4, 5)) / counts[None, None]
+
+
+@register_op("spp")
+def _spp(ctx):
+    """Spatial pyramid pooling (spp_op.cc): levels 0..pyramid_height-1,
+    each adaptively pooled to 2^l x 2^l and flattened, concat over levels."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    height = int(ctx.attr("pyramid_height", 1))
+    ptype = ctx.attr("pooling_type", "max")
+    N = x.shape[0]
+    outs = []
+    for l in range(height):
+        bins = 2 ** l
+        p = _adaptive_pool2d_masked(x, bins, bins, ptype)
+        outs.append(p.reshape(N, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    group = int(ctx.attr("group", 1))
+    N, C, H, W = x.shape
+    out = x.reshape(N, group, C // group, H, W).transpose(0, 2, 1, 3, 4)
+    return {"Out": out.reshape(N, C, H, W)}
+
+
+@register_op("psroi_pool")
+def _psroi_pool(ctx):
+    """Position-sensitive RoI pooling (psroi_pool_op.cc): input channels
+    C = output_channels * ph * pw; bin (i, j) of output channel c averages
+    input channel c*ph*pw + i*pw + j over the bin region."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    rois = ctx.input("ROIs")
+    lens = ctx.lod_len("ROIs")
+    oc = int(ctx.attr("output_channels"))
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    B, C, H, W = x.shape
+    squeeze = rois.ndim == 2
+    if squeeze:
+        rois = rois[None]
+    R = rois.shape[1]
+    hi = jnp.arange(H)
+    wi = jnp.arange(W)
+
+    def one_roi(feat, roi):
+        # reference rounds roi to bin units
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale) + 1.0
+        y2 = jnp.round(roi[3] * scale) + 1.0
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        ib = jnp.arange(ph, dtype=feat.dtype)
+        jb = jnp.arange(pw, dtype=feat.dtype)
+        hstart = jnp.clip(jnp.floor(ib * bin_h + y1), 0, H)
+        hend = jnp.clip(jnp.ceil((ib + 1) * bin_h + y1), 0, H)
+        wstart = jnp.clip(jnp.floor(jb * bin_w + x1), 0, W)
+        wend = jnp.clip(jnp.ceil((jb + 1) * bin_w + x1), 0, W)
+        hmask = (hi[None, :] >= hstart[:, None]) & \
+                (hi[None, :] < hend[:, None])                 # [ph, H]
+        wmask = (wi[None, :] >= wstart[:, None]) & \
+                (wi[None, :] < wend[:, None])                 # [pw, W]
+        m = hmask[:, None, :, None] & wmask[None, :, None, :]  # [ph,pw,H,W]
+        fgrp = feat.reshape(oc, ph, pw, H, W)                  # c,i,j,H,W
+        masked = jnp.where(m[None], fgrp, jnp.asarray(0, feat.dtype))
+        s = jnp.sum(masked, axis=(3, 4))                        # [oc, ph, pw]
+        cnt = jnp.maximum(m.sum(axis=(2, 3)).astype(feat.dtype), 1.0)
+        return s / cnt[None]
+
+    out = jax.vmap(lambda feat, rs: jax.vmap(
+        lambda r: one_roi(feat, r))(rs))(x, rois)
+    if lens is not None:
+        valid = jnp.arange(R)[None, :] < lens[:, None]
+        out = jnp.where(valid[:, :, None, None, None], out, 0.0)
+    if squeeze:
+        out = out[0]
+    return {"Out": out}
+
+
+@register_op("crop")
+def _crop(ctx):
+    """crop_op.cc: slice X at offsets to shape (or Y's shape). The slice
+    extent must be static (XLA), but the offsets may be a traced tensor —
+    lax.dynamic_slice takes traced start indices."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    if ctx.has_input("Y") and ctx.input("Y") is not None:
+        shape = ctx.input("Y").shape
+    else:
+        shape = [int(d) for d in ctx.attr("shape")]
+    off_in = ctx.input("Offsets") if ctx.has_input("Offsets") else None
+    if off_in is not None:
+        offsets = [off_in[i] for i in range(x.ndim)]
+    else:
+        offsets = [int(d) for d in
+                   ctx.attr("offsets", [0] * x.ndim) or [0] * x.ndim]
+    return {"Out": jax.lax.dynamic_slice(x, offsets, shape)}
+
+
+@register_op("random_crop")
+def _random_crop(ctx):
+    """random_crop_op.cc: crop the trailing dims to `shape` at a random
+    offset (per-op seed via the functional rng)."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    shape = [int(d) for d in ctx.attr("shape")]
+    k = len(shape)
+    lead = x.shape[:x.ndim - k]
+    key = ctx.rng_key()
+    starts = []
+    for i, (extent, want) in enumerate(zip(x.shape[x.ndim - k:], shape)):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, extent - want + 1))
+    offsets = [0] * len(lead) + [s for s in starts]
+    return {"Out": jax.lax.dynamic_slice(
+        x, offsets, list(lead) + shape), "SeedOut": None}
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx):
+    """im2sequence_op.cc: [N,C,H,W] -> rows of flattened kh*kw*C patches;
+    ragged output [N, oh*ow, C*kh*kw] with oh*ow rows per image."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    kernels = ctx.attr("kernels", [1, 1])
+    strides = ctx.attr("strides", [1, 1])
+    pads = ctx.attr("paddings", [0, 0, 0, 0])
+    N, C, H, W = x.shape
+    kh, kw = int(kernels[0]), int(kernels[1])
+    sh, sw = int(strides[0]), int(strides[1])
+    pu, pl, pd, pr = (int(p) for p in pads)
+    oh = (H + pu + pd - kh) // sh + 1
+    ow = (W + pl + pr - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(pu, pd), (pl, pr)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))   # [N, C*kh*kw, oh, ow]
+    out = patches.reshape(N, C * kh * kw, oh * ow).transpose(0, 2, 1)
+    lens = jnp.full((N,), oh * ow, jnp.int32)
+    return {"Out": out, "Out@LOD_LEN": lens}
+
+
+@register_op("selu")
+def _selu(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    scale = ctx.attr("scale", 1.0507009873554805)
+    alpha = ctx.attr("alpha", 1.6732632423543772)
+    return {"Out": scale * jnp.where(
+        x > 0, x, alpha * (jnp.exp(x) - 1.0))}

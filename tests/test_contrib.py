@@ -1,0 +1,148 @@
+"""contrib Trainer / QuantizeTranspiler / evaluators / debugger tests
+(reference unittests test_trainer*, test_quantize_transpiler.py,
+test_chunk_eval_op.py + evaluator usage, debugger smoke)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+
+
+def test_trainer_events_and_checkpoint(tmp_path):
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(4).astype(np.float32),
+             np.array([rng.randn() * 0.1 + x.sum()], np.float32))
+            for x in [rng.randn(4).astype(np.float32) for _ in range(8)]]
+    # simple regression samples: y ~ sum(x)
+    data = [(x, np.array([x.sum()], np.float32))
+            for x, _ in data]
+
+    def train_func():
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        return fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+
+    def optimizer_func():
+        return fluid.optimizer.SGD(learning_rate=0.05)
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    cfg = fluid.contrib.CheckpointConfig(checkpoint_dir=ckpt_dir,
+                                         step_interval=4)
+    trainer = fluid.contrib.Trainer(train_func, optimizer_func,
+                                    place=fluid.CPUPlace(),
+                                    checkpoint_config=cfg)
+    events = []
+    losses = []
+
+    def handler(ev):
+        events.append(type(ev).__name__)
+        if isinstance(ev, fluid.contrib.EndStepEvent):
+            losses.append(float(np.asarray(ev.metrics[0]).flatten()[0]))
+
+    def reader():
+        for x, y in data:
+            yield [(x, y)]
+
+    trainer.train(num_epochs=2, event_handler=handler, reader=reader,
+                  feed_order=["x", "y"])
+    assert "BeginEpochEvent" in events and "EndStepEvent" in events
+    assert losses[-1] < losses[0]
+    assert os.path.isdir(ckpt_dir)
+    # resume: new trainer picks up the checkpoint without error
+    t2 = fluid.contrib.Trainer(train_func, optimizer_func,
+                               place=fluid.CPUPlace(),
+                               checkpoint_config=fluid.contrib.
+                               CheckpointConfig(checkpoint_dir=ckpt_dir))
+    assert t2.checkpoint_cfg.step_id > 0
+
+
+def test_quantize_transpiler_training():
+    rng = np.random.RandomState(1)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    qt = fluid.contrib.QuantizeTranspiler()
+    qt.training_transpile(main, startup)
+    qops = [op for op in main.global_block().ops
+            if op.type == "fake_quantize_dequantize_abs_max"]
+    assert len(qops) >= 2   # at least both mul inputs quantized
+    # mul ops consume the quantized names
+    for op in main.global_block().ops:
+        if op.type == "mul":
+            assert op.inputs["Y"][0].endswith(".quantized.dequantized")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(16, 8).astype(np.float32)
+    ys = xs.sum(axis=1, keepdims=True)
+    losses = []
+    for _ in range(12):
+        (l,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(np.asarray(l).flatten()[0]))
+        assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0]   # STE gradients train through quant
+
+
+def test_memory_usage():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[100], dtype="float32")
+        fluid.layers.fc(x, size=50)
+    lo, hi = fluid.contrib.memory_usage(main, batch_size=32)
+    assert 0 < lo < hi
+
+
+def test_weighted_average():
+    wa = fluid.average.WeightedAverage()
+    wa.add(2.0, 1.0)
+    wa.add(4.0, 3.0)
+    np.testing.assert_allclose(wa.eval(), 3.5)
+    wa.reset()
+    with pytest.raises(ValueError):
+        wa.eval()
+
+
+def test_debugger_dot_output(tmp_path):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        fluid.layers.fc(x, size=2)
+    path = str(tmp_path / "g.dot")
+    dot = fluid.debugger.draw_block_graphviz(main.global_block(), path=path)
+    assert os.path.exists(path)
+    assert "digraph G" in dot and "mul" in dot
+    code = fluid.debugger.pprint_program_codes(main)
+    assert "mul" in code and "var x" in code
+
+
+def test_edit_distance_evaluator():
+    from paddle_tpu.fluid.lod import create_lod_tensor
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        hyp = fluid.layers.data("hyp", shape=[1], dtype="int64",
+                                lod_level=1)
+        ref = fluid.layers.data("ref", shape=[1], dtype="int64",
+                                lod_level=1)
+        ed = fluid.evaluator.EditDistance(hyp, ref)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ed.reset(exe)
+    h = np.array([[1], [2], [3]], np.int64)
+    r = np.array([[1], [2], [4]], np.int64)
+    exe.run(main, feed={"hyp": create_lod_tensor(h, [[3]]),
+                        "ref": create_lod_tensor(r, [[3]])},
+            fetch_list=[])
+    avg_dist, err_rate = ed.eval(exe)
+    np.testing.assert_allclose(avg_dist, [1.0 / 3.0], atol=1e-5)
+    np.testing.assert_allclose(err_rate, [1.0], atol=1e-5)

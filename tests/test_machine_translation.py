@@ -128,3 +128,33 @@ def test_mt_generation_beam_search():
     # per-source beams come out ranked best-first
     assert scores_np[0] >= scores_np[1] >= scores_np[2]
     assert scores_np[3] >= scores_np[4] >= scores_np[5]
+
+
+def test_train_and_generation_share_parameter_shapes():
+    """Trained weights must be loadable into the generation program: every
+    parameter name that appears in both programs must have the same shape
+    (build each under a fresh unique_name.guard, the reference idiom)."""
+    from paddle_tpu.models.machine_translation import seq_to_seq_net
+
+    def build(is_gen):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                seq_to_seq_net(16, 32, 32, 40, 40, is_generating=is_gen,
+                               beam_size=2, max_length=3)
+        return main
+
+    train = build(False)
+    gen = build(True)
+    tparams = {p.name: tuple(p.shape) for p in train.all_parameters()}
+    gparams = {p.name: tuple(p.shape) for p in gen.all_parameters()}
+    shared = set(tparams) & set(gparams)
+    # decoder params must all be shared (lstm gates, output fc, attention,
+    # target embedding)
+    for needle in ("decoder_lstm_g0_w0", "decoder_out_w", "att_score_w",
+                   "att_state_w", "trg_emb"):
+        assert any(needle in n for n in shared), "missing shared " + needle
+    for name in shared:
+        assert tparams[name] == gparams[name], (
+            "shape mismatch for %s: train %s vs gen %s"
+            % (name, tparams[name], gparams[name]))

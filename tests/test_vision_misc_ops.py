@@ -1,0 +1,516 @@
+"""Vision + misc op tests (reference unittests/test_grid_sampler_op.py,
+test_affine_grid_op.py, test_pool3d_op.py, test_unpool_op.py, test_spp_op.py,
+test_row_conv_op.py, test_label_smooth_op.py, test_fake_quantize_op.py
+family) — numpy references."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.lod import create_lod_tensor, LoDTensor
+
+
+def _run(build_fn, feed):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        fetches = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    res = exe.run(main, feed=feed, fetch_list=list(fetches))
+    return [r.numpy() if isinstance(r, LoDTensor) else np.asarray(r)
+            for r in res]
+
+
+def test_affine_grid_identity_and_grid_sampler():
+    # identity theta -> grid covers [-1,1]; sampling with it reproduces x
+    x = np.random.RandomState(0).randn(2, 3, 5, 7).astype(np.float32)
+    theta = np.tile(np.array([[1.0, 0, 0], [0, 1.0, 0]], np.float32),
+                    (2, 1, 1))
+
+    def build():
+        xv = fluid.layers.data("x", shape=[3, 5, 7], dtype="float32")
+        tv = fluid.layers.data("t", shape=[2, 3], dtype="float32")
+        grid = fluid.layers.affine_grid(tv, out_shape=[2, 3, 5, 7])
+        out = fluid.layers.grid_sampler(xv, grid)
+        return [grid, out]
+
+    grid, out = _run(build, {"x": x, "t": theta})
+    assert grid.shape == (2, 5, 7, 2)
+    np.testing.assert_allclose(grid[0, 0, 0], [-1.0, -1.0], atol=1e-6)
+    np.testing.assert_allclose(grid[0, -1, -1], [1.0, 1.0], atol=1e-6)
+    np.testing.assert_allclose(out, x, atol=1e-4)
+
+
+def test_affine_channel():
+    x = np.random.RandomState(1).randn(2, 3, 4, 4).astype(np.float32)
+    scale = np.array([1.0, 2.0, 3.0], np.float32)
+    bias = np.array([0.5, -0.5, 0.0], np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[3, 4, 4], dtype="float32")
+        s = fluid.layers.data("s", shape=[3], dtype="float32",
+                              append_batch_size=False)
+        b = fluid.layers.data("b", shape=[3], dtype="float32",
+                              append_batch_size=False)
+        return [fluid.layers.affine_channel(xv, s, b)]
+
+    (out,) = _run(build, {"x": x, "s": scale, "b": bias})
+    ref = x * scale[None, :, None, None] + bias[None, :, None, None]
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_pool3d_max_avg():
+    x = np.random.RandomState(2).randn(1, 2, 4, 4, 4).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[2, 4, 4, 4], dtype="float32")
+        mx = fluid.layers.pool3d(xv, pool_size=2, pool_type="max",
+                                 pool_stride=2)
+        av = fluid.layers.pool3d(xv, pool_size=2, pool_type="avg",
+                                 pool_stride=2)
+        return [mx, av]
+
+    mx, av = _run(build, {"x": x})
+    ref_mx = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+    ref_av = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7))
+    np.testing.assert_allclose(mx, ref_mx, atol=1e-5)
+    np.testing.assert_allclose(av, ref_av, atol=1e-5)
+
+
+def test_conv3d_transpose_identity():
+    # 1x1x1 filter with identity weights = channel mix only
+    x = np.random.RandomState(3).randn(1, 2, 3, 3, 3).astype(np.float32)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[2, 3, 3, 3], dtype="float32")
+        out = fluid.layers.conv3d_transpose(
+            xv, num_filters=2, filter_size=1,
+            param_attr=fluid.ParamAttr(
+                name="w3dt",
+                initializer=fluid.initializer.Constant(1.0)),
+            bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (res,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+    ref = np.tile(x.sum(axis=1, keepdims=True), (1, 2, 1, 1, 1))
+    np.testing.assert_allclose(np.asarray(res), ref, atol=1e-5)
+
+
+def test_unpool():
+    # 2x2 max pool indices then unpool restores values at argmax positions
+    x = np.array([[[[5.0, 1.0], [2.0, 3.0]]]], np.float32)  # pooled [1,1,2,2]?
+    pooled = np.array([[[[9.0]]]], np.float32)
+    indices = np.array([[[[3]]]], np.int32)   # flat pos 3 in 2x2 plane
+
+    def build():
+        p = fluid.layers.data("p", shape=[1, 1, 1], dtype="float32")
+        i = fluid.layers.data("i", shape=[1, 1, 1], dtype="int32")
+        return [fluid.layers.unpool(p, i, ksize=[2, 2], strides=[2, 2])]
+
+    (out,) = _run(build, {"p": pooled, "i": indices})
+    ref = np.zeros((1, 1, 2, 2), np.float32)
+    ref[0, 0, 1, 1] = 9.0
+    np.testing.assert_allclose(out, ref)
+
+
+def test_spp():
+    x = np.random.RandomState(4).randn(2, 3, 4, 4).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[3, 4, 4], dtype="float32")
+        return [fluid.layers.spp(xv, pyramid_height=2, pool_type="max")]
+
+    (out,) = _run(build, {"x": x})
+    # level0: global max [2,3]; level1: 2x2 adaptive max [2,12] -> 15 per C
+    assert out.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(out[:, :3], x.max(axis=(2, 3)), atol=1e-5)
+    blk = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5)).reshape(2, -1)
+    np.testing.assert_allclose(out[:, 3:], blk, atol=1e-5)
+
+
+def test_shuffle_channel():
+    x = np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[8, 1, 1], dtype="float32")
+        return [fluid.layers.shuffle_channel(xv, group=2)]
+
+    (out,) = _run(build, {"x": x})
+    np.testing.assert_allclose(out[0, :, 0, 0], [0, 4, 1, 5, 2, 6, 3, 7])
+
+
+def test_psroi_pool_constant():
+    oc, ph, pw = 2, 2, 2
+    x = np.full((1, oc * ph * pw, 8, 8), 3.0, np.float32)
+    rois = np.array([[[0.0, 0.0, 7.0, 7.0]]], np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[oc * ph * pw, 8, 8],
+                               dtype="float32")
+        rv = fluid.layers.data("r", shape=[1, 4], dtype="float32")
+        return [fluid.layers.psroi_pool(xv, rv, oc, 1.0, ph, pw)]
+
+    (out,) = _run(build, {"x": x, "r": rois})
+    assert out.shape == (1, 1, oc, ph, pw)
+    np.testing.assert_allclose(out, 3.0, atol=1e-5)
+
+
+def test_crop_and_pad_constant_like():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    y = np.ones((1, 2, 2), np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[2, 3, 4], dtype="float32",
+                               append_batch_size=False)
+        yv = fluid.layers.data("y", shape=[1, 2, 2], dtype="float32",
+                               append_batch_size=False)
+        c = fluid.layers.crop(xv, shape=[1, 2, 2], offsets=[1, 0, 1])
+        p = fluid.layers.pad_constant_like(xv, yv, pad_value=7.0)
+        return [c, p]
+
+    c, p = _run(build, {"x": x, "y": y})
+    np.testing.assert_allclose(c, x[1:2, 0:2, 1:3])
+    ref = np.full((2, 3, 4), 7.0, np.float32)
+    ref[:1, :2, :2] = 1.0
+    np.testing.assert_allclose(p, ref)
+
+
+def test_random_crop():
+    x = np.random.RandomState(5).randn(4, 3, 10, 10).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[3, 10, 10], dtype="float32")
+        return [fluid.layers.random_crop(xv, shape=[3, 6, 6])]
+
+    (out,) = _run(build, {"x": x})
+    assert out.shape == (4, 3, 6, 6)
+    # crop content must come from x: every output plane is a sub-window
+    flat = x.reshape(4, -1)
+    assert np.all(np.isin(np.round(out, 5), np.round(flat, 5)))
+
+
+def test_im2sequence():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[1, 4, 4], dtype="float32")
+        return [fluid.layers.im2sequence(xv, filter_size=2, stride=2)]
+
+    (out,) = _run(build, {"x": x})
+    # 4 patches of 4 values each
+    assert out.shape == (4, 4)
+    np.testing.assert_allclose(out[0], [0, 1, 4, 5])
+    np.testing.assert_allclose(out[3], [10, 11, 14, 15])
+
+
+def test_selu():
+    x = np.array([[-1.0, 0.0, 2.0]], np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[3], dtype="float32")
+        return [fluid.layers.selu(xv)]
+
+    (out,) = _run(build, {"x": x})
+    scale, alpha = 1.0507009873554805, 1.6732632423543772
+    ref = scale * np.where(x > 0, x, alpha * (np.exp(x) - 1))
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_norm_and_squared_l2_distance():
+    x = np.random.RandomState(6).randn(3, 5).astype(np.float32)
+    y = np.random.RandomState(7).randn(3, 5).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[5], dtype="float32")
+        yv = fluid.layers.data("y", shape=[5], dtype="float32")
+        n = fluid.layers.l2_norm_layer(xv, axis=1)
+        d = fluid.layers.squared_l2_distance(xv, yv)
+        return [n, d]
+
+    n, d = _run(build, {"x": x, "y": y})
+    ref_n = x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(n, ref_n, atol=1e-5)
+    np.testing.assert_allclose(d[:, 0], ((x - y) ** 2).sum(axis=1),
+                               atol=1e-5)
+
+
+def test_label_smooth():
+    onehot = np.eye(4, dtype=np.float32)[None]
+
+    def build():
+        xv = fluid.layers.data("x", shape=[4, 4], dtype="float32")
+        return [fluid.layers.label_smooth(xv, epsilon=0.1)]
+
+    (out,) = _run(build, {"x": onehot})
+    ref = 0.9 * onehot + 0.1 / 4
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_bilinear_tensor_product_shape_and_grad():
+    rng = np.random.RandomState(8)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[3], dtype="float32")
+        yv = fluid.layers.data("y", shape=[4], dtype="float32")
+        out = fluid.layers.bilinear_tensor_product(xv, yv, size=5)
+        loss = fluid.layers.mean(out)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": rng.randn(2, 3).astype(np.float32),
+            "y": rng.randn(2, 4).astype(np.float32)}
+    (o1,) = exe.run(main, feed=feed, fetch_list=[out])
+    assert np.asarray(o1).shape == (2, 5)
+    (l1,) = exe.run(main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(float(np.asarray(l1).flatten()[0]))
+
+
+def test_scatter_nd_add():
+    x = np.zeros((3, 4), np.float32)
+    idx = np.array([[0, 1], [2, 3], [0, 1]], np.int32)
+    upd = np.array([1.0, 2.0, 3.0], np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[3, 4], dtype="float32",
+                               append_batch_size=False)
+        iv = fluid.layers.data("i", shape=[3, 2], dtype="int32",
+                               append_batch_size=False)
+        uv = fluid.layers.data("u", shape=[3], dtype="float32",
+                               append_batch_size=False)
+        return [fluid.layers.scatter_nd_add(xv, iv, uv)]
+
+    (out,) = _run(build, {"x": x, "i": idx, "u": upd})
+    ref = x.copy()
+    ref[0, 1] += 4.0
+    ref[2, 3] += 2.0
+    np.testing.assert_allclose(out, ref)
+
+
+def test_sequence_expand_as():
+    x = np.array([[1.0], [2.0]], np.float32)
+    y_rows = np.zeros((5, 1), np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[1], dtype="float32")
+        yv = fluid.layers.data("y", shape=[1], dtype="float32", lod_level=1)
+        return [fluid.layers.sequence_expand_as(xv, yv)]
+
+    (out,) = _run(build, {"x": x,
+                          "y": create_lod_tensor(y_rows, [[2, 3]])})
+    # row0 repeated 2x, row1 repeated 3x -> packed [5, 1]
+    np.testing.assert_allclose(out[:, 0], [1, 1, 2, 2, 2])
+
+
+def test_sequence_scatter():
+    x = np.zeros((2, 6), np.float32)
+    ids = np.array([[0], [2], [1], [5]], np.int32)
+    upd = np.array([[1.0], [2.0], [3.0], [4.0]], np.float32)
+    lens = [2, 2]
+
+    def build():
+        xv = fluid.layers.data("x", shape=[6], dtype="float32")
+        iv = fluid.layers.data("i", shape=[1], dtype="int32", lod_level=1)
+        uv = fluid.layers.data("u", shape=[1], dtype="float32", lod_level=1)
+        return [fluid.layers.sequence_scatter(xv, iv, uv)]
+
+    (out,) = _run(build, {"x": x, "i": create_lod_tensor(ids, [lens]),
+                          "u": create_lod_tensor(upd, [lens])})
+    ref = np.zeros((2, 6), np.float32)
+    ref[0, 0] = 1.0
+    ref[0, 2] = 2.0
+    ref[1, 1] = 3.0
+    ref[1, 5] = 4.0
+    np.testing.assert_allclose(out, ref)
+
+
+def test_gather_tree():
+    T, B, W = 3, 1, 2
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int32)
+    parents = np.array([[[0, 0]], [[0, 1]], [[1, 0]]], np.int32)
+
+    def build():
+        iv = fluid.layers.data("i", shape=[B, W], dtype="int32",
+                               append_batch_size=False)
+        pv = fluid.layers.data("p", shape=[B, W], dtype="int32",
+                               append_batch_size=False)
+        return [fluid.layers.gather_tree(iv, pv)]
+
+    feed_shape_fix = {"i": ids, "p": parents}
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        iv = fluid.layers.data("i", shape=[T, B, W], dtype="int32",
+                               append_batch_size=False)
+        pv = fluid.layers.data("p", shape=[T, B, W], dtype="int32",
+                               append_batch_size=False)
+        out = fluid.layers.gather_tree(iv, pv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (res,) = exe.run(main, feed=feed_shape_fix, fetch_list=[out])
+    res = np.asarray(res)
+    # beam 0 final: id 5 at t2 with parent 1 -> t1 beam1 id 4, its parent 1
+    # -> wait: parents[1]=[0,1]: t1 beam1 parent=1 -> t0 beam1 id 2
+    np.testing.assert_array_equal(res[:, 0, 0], [2, 4, 5])
+    # beam 1 final: id 6 at t2, parent 0 -> t1 beam0 id 3, parent 0 -> id 1
+    np.testing.assert_array_equal(res[:, 0, 1], [1, 3, 6])
+
+
+def test_row_conv():
+    rows = np.random.RandomState(9).randn(5, 3).astype(np.float32)
+    lens = [2, 3]
+    k = 2  # future_context 1 -> filter [2, 3]
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[3], dtype="float32", lod_level=1)
+        out = fluid.layers.row_conv(
+            xv, future_context_size=1,
+            param_attr=fluid.ParamAttr(
+                name="rc_w", initializer=fluid.initializer.Constant(0.5)))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (res,) = exe.run(main,
+                     feed={"x": create_lod_tensor(rows, [lens])},
+                     fetch_list=[out])
+    res = res.numpy() if isinstance(res, LoDTensor) else np.asarray(res)
+    w = np.full((2, 3), 0.5, np.float32)
+    ref = np.zeros_like(rows)
+    seqs = [rows[0:2], rows[2:5]]
+    outs = []
+    for s in seqs:
+        o = np.zeros_like(s)
+        T = len(s)
+        for t in range(T):
+            for j in range(2):
+                if t + j < T:
+                    o[t] += w[j] * s[t + j]
+        outs.append(o)
+    ref = np.concatenate(outs, axis=0)
+    np.testing.assert_allclose(res, ref, atol=1e-5)
+
+
+def test_fake_quantize_roundtrip():
+    x = np.array([[0.5, -1.0, 0.25, 0.99]], np.float32)
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[4], dtype="float32")
+        blk = main.global_block()
+        q = blk.create_var(name="q", dtype="float32")
+        sc = blk.create_var(name="qs", dtype="float32")
+        blk.append_op(type="fake_quantize_abs_max", inputs={"X": xv},
+                      outputs={"Out": q, "OutScale": sc},
+                      attrs={"bit_length": 8})
+        dq = blk.create_var(name="dq", dtype="float32")
+        blk.append_op(type="fake_dequantize_max_abs",
+                      inputs={"X": q, "Scale": sc},
+                      outputs={"Out": dq}, attrs={"max_range": 127.0})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    qv, scv, dqv = exe.run(main, feed={"x": x}, fetch_list=["q", "qs", "dq"])
+    np.testing.assert_allclose(np.asarray(scv), [1.0], atol=1e-6)
+    assert np.all(np.abs(np.asarray(qv)) <= 127)
+    np.testing.assert_allclose(np.asarray(dqv), x, atol=1.0 / 127)
+
+
+def test_conv2d_transpose_output_size_and_values():
+    # reference deconv: H_out = (H-1)*s - 2p + k
+    x = np.ones((1, 1, 4, 4), np.float32)
+    for p, want in [(0, 9), (1, 7)]:
+        main, startup = Program(), Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data("x", shape=[1, 4, 4], dtype="float32")
+            out = fluid.layers.conv2d_transpose(
+                xv, num_filters=1, filter_size=3, stride=2, padding=p,
+                param_attr=fluid.ParamAttr(
+                    name="w_dc_%d" % p,
+                    initializer=fluid.initializer.Constant(1.0)),
+                bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (res,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+        res = np.asarray(res)
+        assert res.shape == (1, 1, want, want), (p, res.shape)
+        # each output = count of contributing inputs; corner of p=0 is 1
+        if p == 0:
+            np.testing.assert_allclose(res[0, 0, 0, 0], 1.0)
+            np.testing.assert_allclose(res[0, 0, 2, 2], 4.0)
+
+
+def test_conv3d_transpose_expands():
+    x = np.ones((1, 1, 3, 3, 3), np.float32)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[1, 3, 3, 3], dtype="float32")
+        out = fluid.layers.conv3d_transpose(
+            xv, num_filters=1, filter_size=3, stride=2,
+            bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (res,) = exe.run(main, feed={"x": x}, fetch_list=[out])
+    # (3-1)*2 - 0 + 3 = 7
+    assert np.asarray(res).shape == (1, 1, 7, 7, 7)
+
+
+def test_pool2d_pool3d_ceil_mode():
+    x = np.random.RandomState(10).randn(1, 1, 5, 5).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[1, 5, 5], dtype="float32")
+        c = fluid.layers.pool2d(xv, pool_size=2, pool_stride=2,
+                                pool_type="max", ceil_mode=True)
+        f = fluid.layers.pool2d(xv, pool_size=2, pool_stride=2,
+                                pool_type="max", ceil_mode=False)
+        return [c, f]
+
+    c, f = _run(build, {"x": x})
+    assert c.shape == (1, 1, 3, 3)   # ceil((5-2)/2)+1 = 3
+    assert f.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(c[0, 0, 2, 2], x[0, 0, 4, 4])  # partial win
+
+    x3 = np.random.RandomState(11).randn(1, 1, 5, 5, 5).astype(np.float32)
+
+    def build3():
+        xv = fluid.layers.data("x", shape=[1, 5, 5, 5], dtype="float32")
+        c = fluid.layers.pool3d(xv, pool_size=2, pool_stride=2,
+                                pool_type="avg", ceil_mode=True)
+        return [c]
+
+    (c3,) = _run(build3, {"x": x3})
+    assert c3.shape == (1, 1, 3, 3, 3)
+    # last cell averages only the single valid element
+    np.testing.assert_allclose(c3[0, 0, 2, 2, 2], x3[0, 0, 4, 4, 4],
+                               atol=1e-6)
+
+
+def test_affine_channel_defaults_and_nhwc():
+    x = np.random.RandomState(12).randn(1, 2, 2, 3).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[2, 2, 3], dtype="float32")
+        plain = fluid.layers.affine_channel(xv)   # no scale/bias: identity
+        s = fluid.layers.data("s", shape=[3], dtype="float32",
+                              append_batch_size=False)
+        nhwc = fluid.layers.affine_channel(xv, scale=s,
+                                           data_layout="NHWC")
+        return [plain, nhwc]
+
+    scale = np.array([1.0, 2.0, 3.0], np.float32)
+    plain, nhwc = _run(build, {"x": x, "s": scale})
+    np.testing.assert_allclose(plain, x, atol=1e-6)
+    np.testing.assert_allclose(nhwc, x * scale[None, None, None, :],
+                               atol=1e-6)
+
+
+def test_crop_with_tensor_offsets():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    offs = np.array([1, 0, 1], np.int32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[2, 3, 4], dtype="float32",
+                               append_batch_size=False)
+        ov = fluid.layers.data("o", shape=[3], dtype="int32",
+                               append_batch_size=False)
+        return [fluid.layers.crop(xv, shape=[1, 2, 2], offsets=ov)]
+
+    (out,) = _run(build, {"x": x, "o": offs})
+    np.testing.assert_allclose(out, x[1:2, 0:2, 1:3])

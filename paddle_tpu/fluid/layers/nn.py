@@ -38,6 +38,7 @@ __all__ = [
     "bilinear_tensor_product", "crop", "selu", "spp", "shuffle_channel",
     "psroi_pool", "scatter_nd_add", "scatter_nd", "squared_l2_distance",
     "l2_norm_layer", "fsp_matrix", "gather_tree", "pad_constant_like",
+    "flash_attention",
 ]
 
 
@@ -1378,4 +1379,23 @@ def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
                             "output_dim_idx": output_dim_idx,
                             "mean": mean, "std": std, "seed": seed,
                             "dtype": out.dtype})
+    return out
+
+
+def flash_attention(q, k, v, num_heads=1, causal=False, name=None):
+    """Fused (pallas) attention layer — q/k/v [B, S, D] (num_heads splits D)
+    or [B, S, H, Dh]. TPU-native addition beyond the reference op set; the
+    composition equivalent is nets.scaled_dot_product_attention."""
+    helper = LayerHelper("flash_attention", name=name)
+    if len(q.shape) == 3 and q.shape[-1] is not None and \
+            q.shape[-1] > 0 and q.shape[-1] % num_heads:
+        raise ValueError(
+            "flash_attention: hidden size %d not divisible by num_heads %d"
+            % (q.shape[-1], num_heads))
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(type="flash_attention",
+                     inputs={"Q": q, "K": k, "V": v},
+                     outputs={"Out": out},
+                     attrs={"num_heads": int(num_heads),
+                            "causal": bool(causal)})
     return out

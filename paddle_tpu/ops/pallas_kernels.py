@@ -1,0 +1,240 @@
+"""Pallas TPU kernels for the ops XLA's fusion won't schedule optimally.
+
+No direct reference analogue — the reference's hand-written CUDA kernels
+(paddle/legacy/cuda, operators/math/*.cu) fill this role; on TPU the op set
+that merits hand kernels is much smaller because XLA fuses elementwise
+chains into matmuls. Flash attention is the headline case: the [S, S] score
+matrix never leaves VMEM, with online-softmax accumulation over K/V blocks
+(see /opt/skills/guides/pallas_guide.md).
+
+The kernel runs in interpret mode off-TPU so the same code path is unit
+tested on the CPU mesh. Gradients via jax.custom_vjp: the backward pass is
+a blockwise (flash-style) recomputation in plain XLA — O(S * block) memory.
+"""
+
+import functools
+
+import numpy as np
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
+            block_k):
+    """One (batch*head, q-block) program: fori_loop over K/V blocks with
+    the online-softmax state held in registers/VMEM values (no scratch
+    round-trips)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    S = k_ref.shape[1]
+    nk = S // block_k
+
+    q = q_ref[0]                      # [BQ, D]
+    qpos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def compute(ik, state):
+        o, l, m = state
+        k = k_ref[0, pl.ds(ik * block_k, block_k), :]
+        v = v_ref[0, pl.ds(ik * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos > qpos, _NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)                    # [BQ, BK]
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        o = o * alpha + pv
+        return o, l, m_new
+
+    if causal:
+        # fixed trip count (keeps the loop pipelineable); blocks entirely
+        # above the diagonal are skipped with a cheap predicate
+        def body(ik, state):
+            return jax.lax.cond(
+                ik * block_k <= (iq + 1) * block_q - 1,
+                lambda st: compute(ik, st), lambda st: st, state)
+    else:
+        body = compute
+
+    o0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    o, l, _ = jax.lax.fori_loop(0, nk, body, (o0, l0, m0))
+    o_ref[0] = (o / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k,
+                      interpret):
+    """q,k,v [BH, S, D] -> o [BH, S, D]."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, S, D = q.shape
+    nq = S // block_q
+    grid = (BH, nq)
+    kern = functools.partial(_kernel, scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _softmax_stats(q, k, scale, causal, block_k):
+    """Recompute per-row logsumexp L [BH, S] blockwise — only [S, block_k]
+    score tiles live, matching the O(S*block) memory of the rest of the
+    backward."""
+    import jax
+    import jax.numpy as jnp
+    BH, S, D = q.shape
+    nb = S // block_k
+    qpos = jnp.arange(S)
+
+    def block(carry, jb):
+        m, l = carry
+        ks = jax.lax.dynamic_slice_in_dim(k, jb * block_k, block_k, 1)
+        s = jnp.einsum("bqd,bkd->bqk", q, ks) * scale
+        if causal:
+            kpos = jb * block_k + jnp.arange(block_k)
+            s = jnp.where((kpos[None, :] > qpos[:, None])[None],
+                          _NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(s - m_new[..., None]), axis=-1)
+        return (m_new, l), None
+
+    m0 = jnp.full((BH, S), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BH, S), jnp.float32)
+    (m, l), _ = jax.lax.scan(block, (m0, l0), jnp.arange(nb))
+    return m + jnp.log(jnp.maximum(l, 1e-20))
+
+
+def _flash_bwd(scale, causal, block_k, res, do):
+    """Blockwise flash backward in plain XLA: scan over K/V blocks, keeping
+    only [S, block] score tiles live."""
+    import jax
+    import jax.numpy as jnp
+    q, k, v, o = res
+    BH, S, D = q.shape
+    L = _softmax_stats(q, k, scale, causal, block_k)   # [BH, S]
+    Drow = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                   axis=-1)                        # [BH, S]
+    nb = S // block_k
+    qpos = jnp.arange(S)
+
+    def block(carry, jb):
+        dq = carry
+        ks = jax.lax.dynamic_slice_in_dim(k, jb * block_k, block_k, 1)
+        vs = jax.lax.dynamic_slice_in_dim(v, jb * block_k, block_k, 1)
+        s = jnp.einsum("bqd,bkd->bqk", q, ks) * scale
+        if causal:
+            kpos = jb * block_k + jnp.arange(block_k)
+            s = jnp.where((kpos[None, :] > qpos[:, None])[None],
+                          _NEG_INF, s)
+        p = jnp.exp(s - L[..., None])              # [BH, S, BK]
+        dv = jnp.einsum("bqk,bqd->bkd", p, do.astype(p.dtype))
+        dp = jnp.einsum("bqd,bkd->bqk", do.astype(p.dtype), vs)
+        ds = p * (dp - Drow[..., None])
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, ks) * scale
+        dk = jnp.einsum("bqk,bqd->bkd", ds, q) * scale
+        return dq, (dk, dv)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(block, dq0, jnp.arange(nb))
+    dk = jnp.moveaxis(dks, 0, 1).reshape(BH, S, D)
+    dv = jnp.moveaxis(dvs, 0, 1).reshape(BH, S, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=None):
+    """Fused attention: q,k,v [B, S, H, D] -> [B, S, H, D].
+
+    Pallas kernel on TPU (interpret-mode elsewhere); differentiable via a
+    blockwise custom VJP. Falls back to plain attention when S is not
+    divisible by the block size."""
+    import jax
+    import jax.numpy as jnp
+
+    B, S, H, D = q.shape
+    scale = float(scale if scale is not None else 1.0 / np.sqrt(D))
+    bq = block_q or min(128, S)
+    bk = block_k or min(128, S)
+    if S % bq or S % bk:
+        from ..parallel.ring_attention import local_attention
+        return local_attention(q, k, v, causal=causal, scale=scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    def from_bh(x):
+        return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+    @jax.custom_vjp
+    def _fa(qb, kb, vb):
+        return _flash_fwd_pallas(qb, kb, vb, scale, causal, bq, bk,
+                                 interpret)
+
+    def _fa_fwd(qb, kb, vb):
+        o = _flash_fwd_pallas(qb, kb, vb, scale, causal, bq, bk, interpret)
+        return o, (qb, kb, vb, o)
+
+    _fa.defvjp(_fa_fwd, functools.partial(_flash_bwd, scale, causal, bk))
+
+    return from_bh(_fa(to_bh(q), to_bh(k), to_bh(v)))
+
+
+# ---------------------------------------------------------------------------
+# framework op wrapper: fluid programs reach the kernel via this op type
+# ---------------------------------------------------------------------------
+
+from .registry import register_op  # noqa: E402
+
+
+@register_op("flash_attention")
+def _flash_attention_op(ctx):
+    q = ctx.input("Q")
+    k = ctx.input("K")
+    v = ctx.input("V")
+    reshaped = False
+    if q.ndim == 3:           # [B, S, D] with num_heads attr
+        H = int(ctx.attr("num_heads", 1))
+        B, S, Dm = q.shape
+        if Dm % H:
+            raise ValueError(
+                "flash_attention: hidden size %d not divisible by "
+                "num_heads %d" % (Dm, H))
+        q = q.reshape(B, S, H, Dm // H)
+        k = k.reshape(B, S, H, Dm // H)
+        v = v.reshape(B, S, H, Dm // H)
+        reshaped = True
+    out = flash_attention(q, k, v, causal=bool(ctx.attr("causal", False)))
+    if reshaped:
+        out = out.reshape(B, S, Dm)
+    return {"Out": out}

@@ -261,11 +261,13 @@ def _psroi_pool(ctx):
     wi = jnp.arange(W)
 
     def one_roi(feat, roi):
-        # reference rounds roi to bin units
-        x1 = jnp.round(roi[0] * scale)
-        y1 = jnp.round(roi[1] * scale)
-        x2 = jnp.round(roi[2] * scale) + 1.0
-        y2 = jnp.round(roi[3] * scale) + 1.0
+        # reference rounds the RAW roi coords, adds 1 to the end, THEN
+        # scales (psroi_pool_op.h roi_start_w = round(rois[0]) * scale,
+        # roi_end_w = (round(rois[2]) + 1) * scale)
+        x1 = jnp.round(roi[0]) * scale
+        y1 = jnp.round(roi[1]) * scale
+        x2 = (jnp.round(roi[2]) + 1.0) * scale
+        y2 = (jnp.round(roi[3]) + 1.0) * scale
         rh = jnp.maximum(y2 - y1, 0.1)
         rw = jnp.maximum(x2 - x1, 0.1)
         bin_h = rh / ph

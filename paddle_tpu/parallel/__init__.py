@@ -1,0 +1,23 @@
+"""Parallelism strategies (SURVEY.md §2.10) — TPU-native:
+
+data parallel      — ParallelExecutor / pjit batch sharding (fluid layer)
+tensor parallel    — NamedSharding on weight matrices (mesh 'model' axis)
+sequence/context   — ring_attention (ppermute ring) / ulysses (all-to-all)
+pipeline           — GPipe schedule over the 'pipe' axis
+multi-host         — distributed.init_collective (jax.distributed bootstrap)
+"""
+
+from .mesh import (make_mesh, data_parallel_mesh, local_device_count,
+                   DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS)
+from .ring_attention import (ring_attention, ring_attention_sharded,
+                             local_attention)
+from .ulysses import ulysses_attention, ulysses_attention_sharded
+from .pipeline import pipeline_apply, pipeline_sharded
+
+__all__ = [
+    "make_mesh", "data_parallel_mesh", "local_device_count",
+    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
+    "ring_attention", "ring_attention_sharded", "local_attention",
+    "ulysses_attention", "ulysses_attention_sharded",
+    "pipeline_apply", "pipeline_sharded",
+]

@@ -16,7 +16,7 @@ import os
 
 import numpy as np
 
-__all__ = ["make_mesh", "data_parallel_mesh", "local_device_count",
+__all__ = ["make_mesh", "data_parallel_mesh", "local_device_count", "get_shard_map",
            "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS"]
 
 DATA_AXIS = "data"
@@ -59,3 +59,13 @@ def data_parallel_mesh(num_devices=None, use_cuda=True):
     if num_devices is None:
         num_devices = local_device_count(use_cuda)
     return make_mesh({DATA_AXIS: num_devices}, devs[:num_devices])
+
+
+def get_shard_map():
+    """Version-compat accessor for jax's shard_map (moved out of
+    jax.experimental in jax 0.8)."""
+    try:
+        from jax import shard_map
+    except ImportError:       # older jax
+        from jax.experimental.shard_map import shard_map
+    return shard_map

@@ -1,0 +1,123 @@
+"""Ring attention — context parallelism over the `seq` mesh axis.
+
+No reference analogue (powermano/Paddle predates sequence parallelism —
+SURVEY.md §2.10 row 'Pipeline/TP/SP': absent); built TPU-first per the task
+charter. Design follows blockwise ring attention: Q stays resident, K/V
+blocks circulate the ring via `lax.ppermute` over ICI, each hop overlapped
+with the local block's flash-style online-softmax update, so no device ever
+materializes the full [S, S] score matrix or the full K/V.
+
+Use inside shard_map over a mesh with a `seq` axis (helper
+`ring_attention_sharded` wraps that), sequence sharded as [B, S/n, H, D].
+"""
+
+import functools
+
+import numpy as np
+
+__all__ = ["ring_attention", "ring_attention_sharded", "local_attention"]
+
+
+def _online_block_update(o, l, m, q, k, v, mask, scale):
+    """One flash-attention block accumulation step.
+
+    o [B,Sq,H,D] running (unnormalized) output, l [B,Sq,H] running sum of
+    exp, m [B,Sq,H] running max; q [B,Sq,H,D], k/v [B,Sk,H,D];
+    mask [Sq, Sk] additive (-inf for masked) or None."""
+    import jax.numpy as jnp
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale   # [B,H,Sq,Sk]
+    if mask is not None:
+        scores = scores + mask[None, None]
+    m_blk = jnp.max(scores, axis=-1)                        # [B,H,Sq]
+    m_new = jnp.maximum(m, m_blk.transpose(0, 2, 1))        # [B,Sq,H]
+    # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) -> use where
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+    p = jnp.exp(scores - safe_m.transpose(0, 2, 1)[:, :, :, None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    l_new = l * alpha + jnp.sum(p, axis=-1).transpose(0, 2, 1)
+    o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o_new, l_new, m_new
+
+
+def local_attention(q, k, v, causal=False, q_offset=0, k_offset=0,
+                    scale=None):
+    """Plain (single-block) attention with optional causal mask expressed in
+    GLOBAL positions — the building block the ring circulates."""
+    import jax.numpy as jnp
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(Sq)
+        kpos = k_offset + jnp.arange(Sk)
+        mask = (kpos[None, :] > qpos[:, None])
+        scores = jnp.where(mask[None, None], -jnp.inf, scores)
+    p = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    denom = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-20)
+    return jnp.einsum("bhqk,bkhd->bqhd", p / denom, v)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Per-shard body: call INSIDE shard_map/pjit with q,k,v local blocks
+    [B, S_loc, H, D] sharded over `axis_name`. Returns the local output
+    block [B, S_loc, H, D].
+
+    K/V make a full trip around the ring (n hops); hop t processes the
+    block that originated on device (rank - t) mod n, with the causal mask
+    evaluated in global coordinates."""
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    B, S_loc, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = rank * S_loc + jnp.arange(S_loc)                 # global q rows
+
+    def hop(t, state):
+        o, l, m, kb, vb = state
+        src = (rank - t) % n                                  # block origin
+        k_pos = src * S_loc + jnp.arange(S_loc)
+        if causal:
+            mask = jnp.where(k_pos[None, :] > q_pos[:, None],
+                             -jnp.inf, 0.0)
+        else:
+            mask = None
+        o, l, m = _online_block_update(o, l, m, q, kb, vb, mask, scale)
+        # rotate K/V to the next device (skipped result unused on last hop)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return o, l, m, kb, vb
+
+    o0 = jnp.zeros_like(q)
+    l0 = jnp.zeros((B, S_loc, H), q.dtype)
+    m0 = jnp.full((B, S_loc, H), -jnp.inf, q.dtype)
+    state = (o0, l0, m0, k, v)
+    # static python loop: n is a trace-time constant; each hop's ppermute
+    # overlaps with the next hop's compute under XLA's async collectives
+    for t in range(n):
+        state = hop(t, state)
+    o, l, m = state[0], state[1], state[2]
+    return o / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="seq", causal=False,
+                           scale=None):
+    """Convenience wrapper: q,k,v are GLOBAL [B, S, H, D] arrays; runs
+    ring_attention under shard_map with S sharded over `axis_name`."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from .mesh import get_shard_map
+    shard_map = get_shard_map()
+
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

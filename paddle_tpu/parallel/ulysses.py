@@ -1,0 +1,59 @@
+"""Ulysses-style sequence parallelism — all-to-all head/sequence resharding.
+
+No reference analogue (SURVEY.md §2.10: sequence parallelism absent in the
+2018 codebase); TPU-first per the task charter. The DeepSpeed-Ulysses
+scheme: activations arrive sequence-sharded [B, S/n, H, D]; an all-to-all
+over ICI reshards to head-sharded [B, S, H/n, D] so every device computes
+exact full-sequence attention for its head group; a second all-to-all
+restores sequence sharding. Two all-to-alls replace ring attention's n
+ppermute hops — better when H >= n and ICI bisection bandwidth is plentiful.
+"""
+
+import functools
+
+import numpy as np
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Per-shard body (inside shard_map): q,k,v local [B, S_loc, H, D] with
+    H divisible by the axis size. Returns local [B, S_loc, H, D]."""
+    import jax
+    import jax.numpy as jnp
+    from .ring_attention import local_attention
+
+    n = jax.lax.psum(1, axis_name)
+    B, S_loc, H, D = q.shape
+
+    def seq_to_heads(x):
+        # [B, S/n, H, D] -> all_to_all -> [B, S, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh = seq_to_heads(q)      # [B, S, H/n, D]
+    kh = seq_to_heads(k)
+    vh = seq_to_heads(v)
+    out = local_attention(qh, kh, vh, causal=causal, q_offset=0, k_offset=0,
+                          scale=scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, axis_name="seq", causal=False,
+                              scale=None):
+    """q,k,v GLOBAL [B, S, H, D]; S sharded over `axis_name` in/out."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from .mesh import get_shard_map
+    shard_map = get_shard_map()
+
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -1,0 +1,121 @@
+"""Sequence/context/pipeline parallelism tests on the virtual 8-device CPU
+mesh (SURVEY.md §4 fixtures note). Each strategy is checked for exact
+agreement with a single-device reference."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import (make_mesh, ring_attention_sharded,
+                                 ulysses_attention_sharded, local_attention,
+                                 pipeline_sharded)
+
+
+def _ref_attention(q, k, v, causal):
+    B, S, H, D = q.shape
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        mask = np.triu(np.ones((S, S), bool), k=1)
+        scores = np.where(mask[None, None], -np.inf, scores)
+    scores = scores - scores.max(axis=-1, keepdims=True)
+    p = np.exp(scores)
+    p = p / p.sum(axis=-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    devs = jax.devices()
+    assert len(devs) >= 4
+    return make_mesh({"seq": 4}, devs[:4])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(seq_mesh, causal):
+    rng = np.random.RandomState(0)
+    B, S, H, D = 2, 16, 4, 8
+    q = rng.randn(B, S, H, D).astype(np.float32)
+    k = rng.randn(B, S, H, D).astype(np.float32)
+    v = rng.randn(B, S, H, D).astype(np.float32)
+    out = ring_attention_sharded(jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v), seq_mesh, "seq",
+                                 causal=causal)
+    ref = _ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(seq_mesh, causal):
+    rng = np.random.RandomState(1)
+    B, S, H, D = 2, 16, 4, 8   # H=4 divisible by axis 4
+    q = rng.randn(B, S, H, D).astype(np.float32)
+    k = rng.randn(B, S, H, D).astype(np.float32)
+    v = rng.randn(B, S, H, D).astype(np.float32)
+    out = ulysses_attention_sharded(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v), seq_mesh, "seq",
+                                    causal=causal)
+    ref = _ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+def test_ring_attention_jit_grad(seq_mesh):
+    """ring attention is differentiable under jit (training path)."""
+    rng = np.random.RandomState(2)
+    B, S, H, D = 1, 8, 2, 4
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+
+    @jax.jit
+    def loss(q, k, v):
+        o = ring_attention_sharded(q, k, v, seq_mesh, "seq", causal=True)
+        return jnp.sum(o * o)
+
+    g = jax.grad(loss)(q, k, v)
+    assert np.isfinite(np.asarray(g)).all()
+
+    def ref_loss(q, k, v):
+        o = local_attention(q, k, v, causal=True)
+        return jnp.sum(o * o)
+
+    g_ref = jax.grad(ref_loss)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=2e-4)
+
+
+def test_pipeline_matches_sequential():
+    devs = jax.devices()
+    mesh = make_mesh({"pipe": 4}, devs[:4])
+    rng = np.random.RandomState(3)
+    n_stages, M, mb, D = 4, 6, 3, 5
+    Ws = rng.randn(n_stages, D, D).astype(np.float32) * 0.3
+    bs = rng.randn(n_stages, D).astype(np.float32) * 0.1
+    xs = rng.randn(M, mb, D).astype(np.float32)
+
+    def stage_fn(params, x):
+        W, b = params
+        return jnp.tanh(x @ W + b)
+
+    out = pipeline_sharded(stage_fn, (jnp.asarray(Ws), jnp.asarray(bs)),
+                           jnp.asarray(xs), mesh, "pipe")
+    # sequential reference
+    ref = xs.copy()
+    for s in range(n_stages):
+        ref = np.tanh(ref @ Ws[s] + bs[s])
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_ring_attention_long_sequence_memory_shape():
+    """The ring path only ever holds S/n keys locally: run a sequence 8x
+    the per-device block to show the sharded entry point handles it."""
+    devs = jax.devices()
+    mesh = make_mesh({"seq": 8}, devs[:8])
+    rng = np.random.RandomState(4)
+    B, S, H, D = 1, 64, 2, 4
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    out = ring_attention_sharded(q, k, v, mesh, "seq", causal=True)
+    ref = _ref_attention(np.asarray(q), np.asarray(k), np.asarray(v), True)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)

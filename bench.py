@@ -30,6 +30,10 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     if not on_tpu:
         batch = 16  # CPU smoke mode
+    # bf16 AMP (fp32 master weights + MXU-native bf16 matmuls) unless
+    # explicitly disabled — the TPU-idiomatic training precision
+    if os.environ.get("BENCH_AMP", "1") == "1":
+        fluid.set_amp(True)
 
     main_prog, startup, feeds, loss, acc, predict = resnet.get_model(
         batch_size=batch, class_dim=1000, depth=50, dataset="imagenet",

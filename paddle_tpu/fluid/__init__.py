@@ -52,6 +52,7 @@ from .transpiler import (  # noqa: F401
 from . import metrics
 from . import profiler
 from . import nets
+from ..ops.registry import set_amp, amp_enabled  # noqa: F401  (bf16 AMP)
 from . import average
 from . import evaluator
 from . import debugger
@@ -70,4 +71,5 @@ __all__ = [
     "LoDTensor", "create_lod_tensor", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "memory_optimize", "release_memory",
     "InferenceTranspiler", "average", "evaluator", "debugger", "contrib",
+    "set_amp", "amp_enabled",
 ]

@@ -145,8 +145,9 @@ class Executor:
 
     def _get_jitted(self, program, feed_names, fetch_names, state_names):
         import jax
+        from ..ops.registry import amp_enabled
         key = (id(program), program._version, feed_names, fetch_names,
-               tuple(state_names))
+               tuple(state_names), amp_enabled())
         fn = self._cache.get(key)
         if fn is None:
             step_fn = functionalizer.build_step_fn(

@@ -161,7 +161,7 @@ def _run_forward_op(op, env, vjp_cache, needed_vjp, step, seed, mesh):
         vjp_cache[op.uid] = (vjp_fn, struct)
         _write_outputs(op, norm, env)
     else:
-        outs = od.lower(ctx)
+        outs = op_registry.call_lower(od, ctx)
         if outs:
             _write_outputs(op, outs, env)
     _propagate_lod(op, env)

@@ -110,8 +110,9 @@ class ParallelExecutor:
 
     def _get_jitted(self, feed_key, fetch_names, state_names):
         import jax
+        from ..ops.registry import amp_enabled
         key = (feed_key, fetch_names, tuple(state_names),
-               self._main_program._version)
+               self._main_program._version, amp_enabled())
         fn = self._cache.get(key)
         if fn is not None:
             return fn

@@ -1,0 +1,118 @@
+"""SE-ResNeXt (50/101/152) for ImageNet-shaped inputs.
+
+Parity with reference python/paddle/fluid/tests/unittests/dist_se_resnext.py
+(SE_ResNeXt class: cardinality-64 grouped 3x3 convs + squeeze-excitation
+with reduction 16) — the reference's multi-device convergence workhorse
+(test_parallel_executor_seresnext / test_dist_se_resnext).
+
+TPU notes: grouped convs lower to one lax.conv_general_dilated with
+feature_group_count; the SE block's squeeze (global avgpool) + two fcs +
+channel scale all fuse into the surrounding convolutions' epilogues.
+"""
+
+import paddle_tpu.fluid as fluid
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
+                  act=None, is_train=True):
+    conv = fluid.layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=stride, padding=(filter_size - 1) // 2, groups=groups,
+        act=None, bias_attr=False)
+    return fluid.layers.batch_norm(input=conv, act=act, is_test=not is_train)
+
+
+def squeeze_excitation(input, num_channels, reduction_ratio):
+    pool = fluid.layers.pool2d(input=input, pool_type="avg",
+                               global_pooling=True)
+    pool = fluid.layers.reshape(pool, [-1, num_channels])
+    squeeze = fluid.layers.fc(input=pool,
+                              size=num_channels // reduction_ratio,
+                              act="relu")
+    excitation = fluid.layers.fc(input=squeeze, size=num_channels,
+                                 act="sigmoid")
+    excitation = fluid.layers.reshape(excitation, [-1, num_channels, 1, 1])
+    return fluid.layers.elementwise_mul(x=input, y=excitation)
+
+
+def shortcut(input, ch_out, stride, is_train=True):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        filter_size = 1
+        return conv_bn_layer(input, ch_out, filter_size, stride,
+                             is_train=is_train)
+    return input
+
+
+def bottleneck_block(input, num_filters, stride, cardinality,
+                     reduction_ratio, is_train=True):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
+                          is_train=is_train)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride,
+                          groups=cardinality, act="relu", is_train=is_train)
+    conv2 = conv_bn_layer(conv1, num_filters * 2, 1, act=None,
+                          is_train=is_train)
+    scale = squeeze_excitation(conv2, num_filters * 2, reduction_ratio)
+    short = shortcut(input, num_filters * 2, stride, is_train=is_train)
+    return fluid.layers.elementwise_add(x=short, y=scale, act="relu")
+
+
+def build(img, layers=50, class_dim=1000, is_train=True):
+    """img [N, 3, H, W] -> logits [N, class_dim] (pre-softmax fc)."""
+    supported = {50: ([3, 4, 6, 3], [128, 256, 512, 1024]),
+                 101: ([3, 4, 23, 3], [128, 256, 512, 1024]),
+                 152: ([3, 8, 36, 3], [128, 256, 512, 1024])}
+    depth, num_filters = supported[layers]
+    cardinality = 64
+    reduction_ratio = 16
+
+    if layers == 152:
+        conv = conv_bn_layer(img, 64, 3, stride=2, act="relu",
+                             is_train=is_train)
+        conv = conv_bn_layer(conv, 64, 3, act="relu", is_train=is_train)
+        conv = conv_bn_layer(conv, 128, 3, act="relu", is_train=is_train)
+    else:
+        conv = conv_bn_layer(img, 64, 7, stride=2, act="relu",
+                             is_train=is_train)
+    conv = fluid.layers.pool2d(input=conv, pool_size=3, pool_stride=2,
+                               pool_padding=1, pool_type="max")
+    for block in range(len(depth)):
+        for i in range(depth[block]):
+            conv = bottleneck_block(
+                conv, num_filters[block], 2 if i == 0 and block != 0 else 1,
+                cardinality, reduction_ratio, is_train=is_train)
+    pool = fluid.layers.pool2d(input=conv, pool_type="avg",
+                               global_pooling=True)
+    pool = fluid.layers.reshape(pool, [-1, pool.shape[1]])
+    drop = fluid.layers.dropout(pool, dropout_prob=0.2,
+                                is_test=not is_train)
+    return fluid.layers.fc(input=drop, size=class_dim)
+
+
+def get_model(batch_size=32, class_dim=1000, layers=50, img_size=224,
+              lr=0.1, is_train=True):
+    """Training program mirroring dist_se_resnext.py get_model: Momentum +
+    piecewise decay + L2."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("data", shape=[3, img_size, img_size],
+                                dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        logits = build(img, layers=layers, class_dim=class_dim,
+                       is_train=is_train)
+        prob = fluid.layers.softmax(logits)
+        loss = fluid.layers.cross_entropy(input=prob, label=label)
+        avg_loss = fluid.layers.mean(loss)
+        acc = fluid.layers.accuracy(input=prob, label=label)
+        if is_train:
+            epochs = [30, 60, 90]
+            steps_per_pass = 1252
+            bd = [e * steps_per_pass for e in epochs]
+            lrs = [lr * (0.1 ** i) for i in range(len(bd) + 1)]
+            opt = fluid.optimizer.Momentum(
+                learning_rate=fluid.layers.piecewise_decay(
+                    boundaries=bd, values=lrs),
+                momentum=0.9,
+                regularization=fluid.regularizer.L2Decay(1e-4))
+            opt.minimize(avg_loss)
+    return main, startup, ["data", "label"], avg_loss, acc, prob

@@ -21,4 +21,5 @@ from . import pallas_kernels    # noqa: F401
 
 from .registry import (  # noqa: F401
     register_op, get_op_def, has_op, registered_ops, infer_shape, ExecContext,
+    call_lower, set_amp, amp_enabled,
 )

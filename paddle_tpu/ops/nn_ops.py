@@ -39,24 +39,19 @@ def _conv2d(ctx):
     pads = _pair(ctx.attr("paddings", [0, 0]))
     dilations = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1) or 1
+    # NOTE: no explicit preferred_element_type — the TPU MXU already
+    # accumulates bf16 inputs in fp32 internally, and an explicit fp32
+    # output type breaks jax's conv transpose rule under AMP (the f32
+    # cotangent meets the bf16 residual operand)
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations, feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=jnp_acc_type(x))
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
     out = out.astype(x.dtype)
     if ctx.has_input("Bias"):
         out = out + ctx.input("Bias").reshape((1, -1, 1, 1))
     return {"Output": out}
-
-
-def jnp_acc_type(x):
-    jnp = _jnp()
-    # bf16 matmul/conv accumulate in fp32 on the MXU
-    if x.dtype == jnp.bfloat16:
-        return jnp.float32
-    return None
 
 
 @register_op("depthwise_conv2d")

@@ -249,7 +249,7 @@ def make_forward_and_vjp(op, od, ctx):
             flat[i] = v
         c2 = ExecContext(op, rebuild(flat), step=ctx.step, seed=ctx.seed,
                          mesh=ctx.mesh)
-        outs = od.lower(c2)
+        outs = call_lower(od, c2)
         # normalized {slot: [vals]} so cotangent trees are predictable
         return {s: list(v) if isinstance(v, (list, tuple)) else [v]
                 for s, v in outs.items()}
@@ -269,3 +269,79 @@ def make_forward_and_vjp(op, od, ctx):
         return d
 
     return outs, vjp_to_slots
+
+
+# ---------------------------------------------------------------------------
+# automatic mixed precision (bf16 compute, fp32 master weights)
+# ---------------------------------------------------------------------------
+# The reference's fp16 story is data_type_transform + a float16 type
+# (platform/float16.h) with per-kernel fp16 registrations. TPU-first
+# equivalent: matmul-class ops compute in bfloat16 — the MXU natively
+# accumulates bf16 inputs in fp32, so no explicit preferred_element_type is
+# needed (and setting one breaks jax's conv transpose under AMP; the Pallas
+# flash kernel sets it internally). Numerically sensitive ops are forced
+# back to fp32, and parameters/optimizer state stay fp32. The casts live
+# INSIDE the differentiated lowering call, so gradients flow to the fp32
+# primals automatically.
+
+_AMP = {"enabled": False}
+
+# compute-bound ops that should feed the MXU in bf16
+AMP_WHITE = frozenset([
+    "conv2d", "depthwise_conv2d", "conv3d", "conv2d_transpose",
+    "conv3d_transpose", "mul", "matmul", "flash_attention",
+])
+
+# numerically sensitive ops: force fp32 inputs
+AMP_BLACK = frozenset([
+    "softmax", "softmax_with_cross_entropy", "cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "layer_norm", "batch_norm",
+    "group_norm", "mean", "reduce_mean", "reduce_sum", "sum", "exp", "log",
+    "sequence_softmax", "log_softmax", "linear_chain_crf", "warpctc",
+    # optimizer updates accumulate in fp32 master weights
+    "sgd", "momentum", "lars_momentum", "adam", "adamax", "adagrad",
+    "decayed_adagrad", "adadelta", "rmsprop", "ftrl", "proximal_gd",
+])
+
+
+def set_amp(enabled):
+    _AMP["enabled"] = bool(enabled)
+
+
+def amp_enabled():
+    return _AMP["enabled"]
+
+
+def _amp_cast(vals, to_dtype):
+    import jax.numpy as jnp
+    out = []
+    for v in vals:
+        if v is not None and hasattr(v, "dtype") and \
+                v.dtype in (jnp.float32, jnp.bfloat16) and \
+                v.dtype != to_dtype:
+            v = v.astype(to_dtype)
+        out.append(v)
+    return out
+
+
+def call_lower(od, ctx):
+    """All lowering invocations go through here so AMP casts sit inside the
+    traced (and differentiated) computation."""
+    if not _AMP["enabled"]:
+        return od.lower(ctx)
+    import jax.numpy as jnp
+    if od.type in AMP_WHITE:
+        to = jnp.bfloat16
+    elif od.type in AMP_BLACK:
+        to = jnp.float32
+    else:
+        return od.lower(ctx)
+    new_inputs = {}
+    for slot, vals in ctx._inputs.items():
+        if slot.endswith("@LOD_LEN"):
+            new_inputs[slot] = vals     # integer length companions
+        else:
+            new_inputs[slot] = _amp_cast(vals, to)
+    c2 = ExecContext(ctx.op, new_inputs, step=ctx.step, seed=ctx.seed,
+                     mesh=ctx.mesh, env=ctx.env)
+    return od.lower(c2)

@@ -1,0 +1,69 @@
+"""bf16 automatic mixed precision tests (reference analogue: fp16
+data_type_transform + float16.h; TPU-first bf16 design)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+
+
+@pytest.fixture(autouse=True)
+def _amp_off_after():
+    yield
+    fluid.set_amp(False)
+
+
+def _build_mlp():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=32, act="relu")
+        p = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def test_amp_trains_and_keeps_fp32_master_weights():
+    rng = np.random.RandomState(0)
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fluid.set_amp(True)
+    xs = rng.randn(32, 16).astype(np.float32)
+    ys = xs.sum(axis=1, keepdims=True)
+    losses = []
+    for _ in range(15):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).flatten()[0]))
+        assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0] * 0.5
+    # master weights stayed fp32 in the scope
+    scope = fluid.global_scope()
+    for p in main.all_parameters():
+        arr = scope.get(p.name)
+        assert str(np.asarray(arr).dtype) == "float32", p.name
+
+
+def test_amp_matches_fp32_loosely():
+    """bf16 compute tracks the fp32 result within bf16 tolerance."""
+    rng = np.random.RandomState(1)
+    main, startup, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(8, 16).astype(np.float32)
+    ys = xs.sum(axis=1, keepdims=True)
+    scope = fluid.global_scope()
+    snap = {p.name: np.array(np.asarray(scope.get(p.name)))
+            for p in main.all_parameters()}
+    (l32,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    # restore the identical initial params for the amp run
+    for name, arr in snap.items():
+        scope.set(name, arr)
+    fluid.set_amp(True)
+    (l16,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    l32 = float(np.asarray(l32).flatten()[0])
+    l16 = float(np.asarray(l16).flatten()[0])
+    assert abs(l32 - l16) / max(abs(l32), 1e-6) < 0.05

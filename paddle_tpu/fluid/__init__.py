@@ -53,6 +53,7 @@ from . import metrics
 from . import profiler
 from . import nets
 from ..ops.registry import set_amp, amp_enabled  # noqa: F401  (bf16 AMP)
+from . import ir_passes
 from . import average
 from . import evaluator
 from . import debugger
@@ -71,5 +72,5 @@ __all__ = [
     "LoDTensor", "create_lod_tensor", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "memory_optimize", "release_memory",
     "InferenceTranspiler", "average", "evaluator", "debugger", "contrib",
-    "set_amp", "amp_enabled",
+    "set_amp", "amp_enabled", "ir_passes",
 ]

@@ -99,9 +99,17 @@ def _append_grad_ops(block, path_ops, grad_map, no_grad_set):
         od = op_registry.get_op_def(op.type) if op_registry.has_op(op.type) \
             else None
         if od is not None and od.grad_maker is not None:
-            new_ops = od.grad_maker(op, block, grad_map, no_grad_set)
-            _ = new_ops
-            continue
+            # a maker returning None declines (falls back to the generic
+            # vjp-based grad op) — e.g. lookup_table only goes sparse when
+            # is_sparse is set and the table has a single consumer
+            made = od.grad_maker(op, block, grad_map, no_grad_set)
+            if made is not None:
+                for name in set(op.input_arg_names):
+                    if name in pending:
+                        pending[name] -= 1
+                        if pending[name] == 0 and name in partials:
+                            finalize_grad(name)
+                continue
 
         grad_inputs = {}
         for slot, names in op.inputs.items():

@@ -1,0 +1,166 @@
+"""Program-level pass framework.
+
+Reference analogue: paddle/fluid/framework/ir/ — ir::Graph (graph.h:63),
+Pass/PassRegistry (pass.h:32), GraphPatternDetector, and the fusion pass
+suite chained by BuildStrategy (details/build_strategy.cc:27).
+
+TPU redesign: most reference passes exist to pre-fuse kernels (fc_fuse,
+conv_bn, fuse_elewise_add_act) — XLA's fusion subsumes them, so the fusion
+passes here are *structural parity* rewrites kept for program inspection and
+op-count parity, while graph_viz / is_test / memory passes carry real
+behavior. The pass substrate works on the Program in place (the Program IS
+the graph: ops + var def/use edges), mirroring ir::Pass::ApplyImpl.
+"""
+
+from .framework import Program
+
+__all__ = ["Pass", "register_pass", "get_pass", "apply_passes",
+           "registered_passes"]
+
+_PASS_REGISTRY = {}
+
+
+class Pass:
+    """reference ir/pass.h:32. Subclasses implement apply_impl(program)."""
+
+    name = None
+
+    def __init__(self, **attrs):
+        self.attrs = dict(attrs)
+
+    def set(self, key, value):
+        self.attrs[key] = value
+        return self
+
+    def get(self, key, default=None):
+        return self.attrs.get(key, default)
+
+    def apply(self, program):
+        out = self.apply_impl(program)
+        program._bump_version()
+        return out if out is not None else program
+
+    def apply_impl(self, program):
+        raise NotImplementedError
+
+
+def register_pass(cls):
+    assert cls.name, "pass needs a name"
+    _PASS_REGISTRY[cls.name] = cls
+    return cls
+
+
+def get_pass(name, **attrs):
+    return _PASS_REGISTRY[name](**attrs)
+
+
+def registered_passes():
+    return sorted(_PASS_REGISTRY)
+
+
+def apply_passes(program, names, **attrs):
+    for n in names:
+        program = get_pass(n, **attrs).apply(program)
+    return program
+
+
+# ---------------------------------------------------------------------------
+# concrete passes
+# ---------------------------------------------------------------------------
+
+@register_pass
+class GraphVizPass(Pass):
+    """ir/graph_viz_pass.cc: dump the op/var graph as graphviz dot."""
+
+    name = "graph_viz_pass"
+
+    def apply_impl(self, program):
+        from .debugger import draw_block_graphviz
+        path = self.get("graph_viz_path", "./program.dot")
+        draw_block_graphviz(program.global_block(), path=path)
+        return program
+
+
+@register_pass
+class IsTestPass(Pass):
+    """ir/is_test_pass.cc: flip is_test on inference-sensitive ops."""
+
+    name = "is_test_pass"
+
+    _OPS = ("dropout", "batch_norm", "lrn", "layer_norm")
+
+    def apply_impl(self, program):
+        for blk in program.blocks:
+            for op in blk.ops:
+                if op.type in self._OPS:
+                    op.attrs["is_test"] = True
+        return program
+
+
+@register_pass
+class FuseElewiseAddActPass(Pass):
+    """ir/fuse_elewise_add_act_pass.cc: elementwise_add + activation ->
+    fused_elemwise_activation. XLA fuses these anyway; the rewrite keeps
+    op-count/structure parity and exercises the pattern machinery."""
+
+    name = "fuse_elewise_add_act_pass"
+
+    _ACTS = ("relu", "sigmoid", "tanh", "gelu")
+
+    def apply_impl(self, program):
+        blk = program.global_block()
+        i = 0
+        while i < len(blk.ops) - 1:
+            add_op = blk.ops[i]
+            act_op = blk.ops[i + 1]
+            if (add_op.type == "elementwise_add" and
+                    act_op.type in self._ACTS and
+                    act_op.inputs.get("X", [None])[0] ==
+                    add_op.outputs["Out"][0] and
+                    self._single_use(blk, add_op.outputs["Out"][0])):
+                fused = blk.ops[i]
+                fused.type = "fused_elemwise_activation"
+                fused.attrs["functor_list"] = [
+                    "elementwise_add", act_op.type]
+                fused.attrs["axis"] = add_op.attrs.get("axis", -1)
+                fused.outputs = {"Out": list(act_op.outputs["Out"])}
+                del blk.ops[i + 1]
+            i += 1
+        return program
+
+    @staticmethod
+    def _single_use(blk, name):
+        return sum(1 for o in blk.ops
+                   for ns in o.inputs.values() for n in ns
+                   if n == name) == 1
+
+
+@register_pass
+class FCFusePass(Pass):
+    """ir/fc_fuse_pass.cc: mul + elementwise_add(bias) -> fc op."""
+
+    name = "fc_fuse_pass"
+
+    def apply_impl(self, program):
+        blk = program.global_block()
+        i = 0
+        while i < len(blk.ops) - 1:
+            mul_op = blk.ops[i]
+            add_op = blk.ops[i + 1]
+            if (mul_op.type == "mul" and
+                    add_op.type == "elementwise_add" and
+                    add_op.inputs.get("X", [None])[0] ==
+                    mul_op.outputs["Out"][0] and
+                    FuseElewiseAddActPass._single_use(
+                        blk, mul_op.outputs["Out"][0])):
+                fused = blk.ops[i]
+                fused.type = "fc"
+                fused.inputs = {"Input": list(mul_op.inputs["X"]),
+                                "W": list(mul_op.inputs["Y"]),
+                                "Bias": list(add_op.inputs["Y"])}
+                fused.attrs = {"in_num_col_dims":
+                               mul_op.attrs.get("x_num_col_dims", 1)}
+                fused.outputs = {"Out": list(add_op.outputs["Out"])}
+                del blk.ops[i + 1]
+            i += 1
+        return program

@@ -83,6 +83,17 @@ class ParallelExecutor:
         self._num_devices = int(np.prod(list(self._mesh.shape.values())))
         self._cache = {}
         self._step = 0
+        # BuildStrategy pass pipeline (reference build_strategy.cc:27
+        # ParallelExecutorPassBuilder chains passes before graph build)
+        from . import ir_passes
+        if self._build_strategy.fuse_elewise_add_act_ops:
+            ir_passes.get_pass("fuse_elewise_add_act_pass").apply(
+                self._main_program)
+        if self._build_strategy.debug_graphviz_path:
+            ir_passes.get_pass(
+                "graph_viz_pass",
+                graph_viz_path=self._build_strategy.debug_graphviz_path
+            ).apply(self._main_program)
         # BCastParamsToDevices analogue: replicate existing scope arrays
         self._replicate_state()
 

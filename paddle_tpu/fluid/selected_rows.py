@@ -1,0 +1,81 @@
+"""SelectedRows — sparse row-set gradients for embeddings.
+
+Reference analogue: paddle/fluid/framework/selected_rows.h (rows + value
+tensor + height), produced by lookup_table_grad's sparse kernel when
+is_sparse=True and consumed by the sparse paths of sgd/adam/adagrad
+(operators/optimizers/*, SelectedRows overloads).
+
+TPU design: a SelectedRowsValue is a jax pytree (rows int32 [K], values
+[K, D], static height), so it flows through the jitted step like any other
+value; optimizer lowerings detect it and perform row-wise scatter updates —
+the update cost scales with the touched rows, not the table height, exactly
+the property the reference's sparse kernels provide.
+"""
+
+import numpy as np
+
+__all__ = ["SelectedRowsValue"]
+
+
+class SelectedRowsValue:
+    """rows [K] int32, values [K, D], height = table size (static)."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    # -- reference SelectedRows API (selected_rows.h) --
+    def get_rows(self):
+        return self.rows
+
+    def get_tensor(self):
+        return self.values
+
+    def get_height(self):
+        return self.height
+
+    def to_dense(self):
+        """Densify: [height, D] with rows scattered (get_tensor_from_
+        selected_rows op semantics)."""
+        import jax.numpy as jnp
+        dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def merged(self):
+        """Deduplicate rows, summing their values (merge_selected_rows
+        op / MergeAdd functor). Rows stay fixed-capacity (unique positions
+        padded with the first row id) so shapes are static under jit."""
+        import jax.numpy as jnp
+        uniq, inv = jnp.unique(self.rows, return_inverse=True,
+                               size=self.rows.shape[0],
+                               fill_value=self.rows[0])
+        summed = jnp.zeros_like(self.values).at[inv].add(self.values)
+        return SelectedRowsValue(uniq.astype(jnp.int32), summed,
+                                 self.height)
+
+    def __repr__(self):
+        return "SelectedRows(rows=%s, values=%s, height=%d)" % (
+            getattr(self.rows, "shape", None),
+            getattr(self.values, "shape", None), self.height)
+
+
+def _flatten(sr):
+    return (sr.rows, sr.values), sr.height
+
+
+def _unflatten(height, children):
+    rows, values = children
+    return SelectedRowsValue(rows, values, height)
+
+
+def _register():
+    import jax
+    jax.tree_util.register_pytree_node(SelectedRowsValue, _flatten,
+                                       _unflatten)
+
+
+_register()

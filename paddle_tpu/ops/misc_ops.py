@@ -255,3 +255,43 @@ def _fake_quantize_dequantize_abs_max(ctx):
     qdq = q * scale / bnt
     out = x + jax.lax.stop_gradient(qdq - x)    # STE
     return {"Out": out, "OutScale": scale.reshape(1)}
+
+
+# ---------------------------------------------------------------------------
+# fused ops produced by the ir passes (fused_elemwise_activation_op.cc;
+# the fc op the reference registers natively, operators/fc_op in later eras)
+# ---------------------------------------------------------------------------
+
+@register_op("fused_elemwise_activation")
+def _fused_elemwise_activation(ctx):
+    import jax
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("Y")
+    functors = ctx.attr("functor_list", ["elementwise_add", "relu"])
+    act = functors[1] if len(functors) > 1 else "relu"
+    s = x + y
+    if act == "relu":
+        out = jnp.maximum(s, 0)
+    elif act == "sigmoid":
+        out = jax.nn.sigmoid(s)
+    elif act == "tanh":
+        out = jnp.tanh(s)
+    elif act == "gelu":
+        out = jax.nn.gelu(s)
+    else:
+        raise NotImplementedError(act)
+    return {"Out": out}
+
+
+@register_op("fc")
+def _fc_fused(ctx):
+    jnp = _jnp()
+    x, w = ctx.input("Input"), ctx.input("W")
+    b = ctx.input("Bias")
+    ncol = int(ctx.attr("in_num_col_dims", 1))
+    lead = x.shape[:ncol]
+    x2 = x.reshape((-1, int(np.prod(x.shape[ncol:]))))
+    out = x2 @ w
+    if b is not None:
+        out = out + b.reshape(1, -1)
+    return {"Out": out.reshape(tuple(lead) + (w.shape[-1],))}

@@ -493,3 +493,69 @@ def _pad(ctx):
     pads = tuple((p[2 * i], p[2 * i + 1]) for i in range(x.ndim))
     return {"Out": jnp.pad(x, pads,
                            constant_values=ctx.attr("pad_value", 0.0))}
+
+
+# ---------------------------------------------------------------------------
+# sparse embedding gradients (SelectedRows — selected_rows.h; sparse kernel
+# of lookup_table_grad, lookup_table_op.cc is_sparse path)
+# ---------------------------------------------------------------------------
+
+@register_op("lookup_table_sparse_grad")
+def _lookup_table_sparse_grad(ctx):
+    """Sparse grad: emit a SelectedRowsValue (rows = the looked-up ids,
+    values = the output cotangent rows) instead of a dense scatter into the
+    full table."""
+    jnp = _jnp()
+    from ..fluid.selected_rows import SelectedRowsValue
+    w = ctx.input("W")
+    ids = ctx.input("Ids")
+    d_out = ctx.input("GRAD:Out")
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    flat_ids = (ids.reshape(ids.shape[:-1]) if squeeze_last
+                else ids).reshape(-1).astype(jnp.int32)
+    D = w.shape[-1]
+    values = d_out.reshape(-1, D)
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        values = values * (flat_ids != padding_idx)[:, None].astype(
+            values.dtype)
+    return {"GRAD:W": SelectedRowsValue(flat_ids, values, w.shape[0])}
+
+
+def _lookup_table_grad_maker(op, block, grad_map, no_grad_set):
+    """Emit the sparse grad op when is_sparse is set; decline (None) for
+    the dense default so the generic vjp path runs."""
+    if not op.attrs.get("is_sparse", False):
+        return None
+    from ..fluid.framework import grad_var_name
+    w_name = op.inputs["W"][0]
+    out_name = op.outputs["Out"][0]
+    if w_name in no_grad_set or out_name not in grad_map:
+        return None
+    # shared tables (several lookups on one W) need grad accumulation
+    # across consumers — decline to the dense path, whose fan-in summing
+    # machinery handles it
+    consumers = sum(1 for o in block.ops
+                    if o.type == "lookup_table" and
+                    o.inputs.get("W", [None])[0] == w_name)
+    if consumers > 1:
+        return None
+    gname = grad_var_name(w_name)
+    w_var = block._find_var_recursive(w_name)
+    gvar = block.create_var(name=gname, dtype=w_var.dtype,
+                            shape=w_var.shape, stop_gradient=True)
+    gvar.is_selected_rows = True
+    block.append_op(
+        type="lookup_table_sparse_grad",
+        inputs={"W": [w_name], "Ids": list(op.inputs["Ids"]),
+                "GRAD:Out": [grad_map[out_name]]},
+        outputs={"GRAD:W": [gname]},
+        attrs={"padding_idx": op.attrs.get("padding_idx", -1),
+               "op_role": "Backward"},
+        infer_shape=False)
+    grad_map[w_name] = gname
+    return [gname]
+
+
+from .registry import set_grad_maker as _set_gm  # noqa: E402
+_set_gm("lookup_table", _lookup_table_grad_maker)

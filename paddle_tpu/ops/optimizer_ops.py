@@ -7,10 +7,11 @@ Param/Moment outputs; here each is one pure update function — the executor's
 functional state-threading makes "in-place" an XLA buffer-donation concern,
 not an op concern.
 
-Sparse (SelectedRows) gradient paths in the reference collapse into the same
-dense update because embedding grads are produced as dense scatter-adds; a
-row-sparse update path can be added per-op via segment ops if profiling
-demands it.
+Sparse (SelectedRows) gradients: when an embedding was built with
+is_sparse=True, its grad arrives as a SelectedRowsValue (rows + values —
+fluid/selected_rows.py) and sgd/momentum/adam/adagrad take a row-wise
+scatter-update path whose cost scales with the touched rows, mirroring the
+reference's SelectedRows kernels (adam lazy mode included).
 """
 
 import numpy as np
@@ -28,10 +29,20 @@ def _lr(ctx):
     return lr.reshape(()) if hasattr(lr, "reshape") else lr
 
 
+def _is_sparse(g):
+    from ..fluid.selected_rows import SelectedRowsValue
+    return isinstance(g, SelectedRowsValue)
+
+
 @register_op("sgd", stateful=True)
 def _sgd(ctx):
     p, g = ctx.input("Param"), ctx.input("Grad")
-    return {"ParamOut": p - _lr(ctx).astype(p.dtype) * g.astype(p.dtype)}
+    lr = _lr(ctx).astype(p.dtype)
+    if _is_sparse(g):
+        g = g.merged()
+        return {"ParamOut": p.at[g.rows].add(
+            -lr * g.values.astype(p.dtype))}
+    return {"ParamOut": p - lr * g.astype(p.dtype)}
 
 
 @register_op("momentum", stateful=True)
@@ -39,6 +50,8 @@ def _momentum(ctx):
     p, g, v = ctx.input("Param"), ctx.input("Grad"), ctx.input("Velocity")
     mu = ctx.attr("mu")
     lr = _lr(ctx).astype(p.dtype)
+    if _is_sparse(g):
+        g = g.to_dense()   # velocity state is dense; reference densifies too
     v_out = mu * v + g
     if ctx.attr("use_nesterov", False):
         p_out = p - (g + mu * v_out) * lr
@@ -74,6 +87,19 @@ def _adam(ctx):
     beta1, beta2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
     lr = _lr(ctx)
+    if _is_sparse(g):
+        # lazy sparse adam (adam_op.h SelectedRows path, lazy_mode): only
+        # the touched rows' moments and params move
+        sr = g.merged()
+        rows, vals = sr.rows, sr.values
+        m1r = beta1 * m1[rows] + (1 - beta1) * vals
+        m2r = beta2 * m2[rows] + (1 - beta2) * jnp.square(vals)
+        lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+        p_new = p.at[rows].add(-lr_t.astype(p.dtype) * (
+            m1r / (jnp.sqrt(m2r) + eps)).astype(p.dtype))
+        return {"ParamOut": p_new,
+                "Moment1Out": m1.at[rows].set(m1r),
+                "Moment2Out": m2.at[rows].set(m2r)}
     m1_out = beta1 * m1 + (1 - beta1) * g
     m2_out = beta2 * m2 + (1 - beta2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
@@ -103,6 +129,13 @@ def _adagrad(ctx):
     jnp = _jnp()
     p, g, m = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
     eps = ctx.attr("epsilon", 1e-6)
+    if _is_sparse(g):
+        sr = g.merged()
+        rows, vals = sr.rows, sr.values
+        mr = m[rows] + jnp.square(vals)
+        p_new = p.at[rows].add(
+            -_lr(ctx).astype(p.dtype) * vals / (jnp.sqrt(mr) + eps))
+        return {"ParamOut": p_new, "MomentOut": m.at[rows].set(mr)}
     m_out = m + jnp.square(g)
     p_out = p - _lr(ctx).astype(p.dtype) * g / (jnp.sqrt(m_out) + eps)
     return {"ParamOut": p_out, "MomentOut": m_out}

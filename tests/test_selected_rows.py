@@ -1,0 +1,120 @@
+"""SelectedRows sparse-gradient tests (reference selected_rows.h +
+test_lookup_table_op sparse grad + optimizer sparse kernels)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+
+
+def _build(optimizer, V=50, EMB=8):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[V, EMB], dtype="float32",
+                                     is_sparse=True,
+                                     param_attr=fluid.ParamAttr(name="table"))
+        emb = fluid.layers.reshape(emb, [-1, EMB])
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(emb, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        optimizer().minimize(loss)
+    return main, startup, loss
+
+
+def test_sparse_grad_op_emitted():
+    main, startup, loss = _build(lambda: fluid.optimizer.SGD(0.1))
+    types = [op.type for op in main.global_block().ops]
+    assert "lookup_table_sparse_grad" in types
+    for op in main.global_block().ops:
+        if op.type == "lookup_table_sparse_grad":
+            assert op.outputs["GRAD:W"] == ["table@GRAD"]
+
+
+@pytest.mark.parametrize("opt", [
+    lambda: fluid.optimizer.SGD(0.1),
+    lambda: fluid.optimizer.Adam(0.1),
+    lambda: fluid.optimizer.Adagrad(0.1),
+    lambda: fluid.optimizer.Momentum(0.1, 0.9),
+])
+def test_sparse_updates_touch_only_seen_rows(opt):
+    V = 50
+    main, startup, loss = _build(opt, V=V)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    before = np.array(np.asarray(scope.get("table")))
+    ids = np.array([[3], [7], [3]], np.int64)   # duplicate row 3
+    ys = np.array([[1.0], [2.0], [3.0]], np.float32)
+    (lv,) = exe.run(main, feed={"ids": ids, "y": ys}, fetch_list=[loss])
+    assert np.isfinite(float(np.asarray(lv).flatten()[0]))
+    after = np.asarray(scope.get("table"))
+    changed = np.where(np.any(before != after, axis=1))[0]
+    assert set(changed.tolist()) == {3, 7}
+
+
+def test_sparse_matches_dense_sgd():
+    """Sparse and dense paths must produce identical updates."""
+    V, EMB = 20, 4
+
+    def build(is_sparse):
+        main, startup = Program(), Program()
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+                emb = fluid.layers.embedding(
+                    ids, size=[V, EMB], dtype="float32",
+                    is_sparse=is_sparse,
+                    param_attr=fluid.ParamAttr(
+                        name="tbl",
+                        initializer=fluid.initializer.Constant(0.5)))
+                emb = fluid.layers.reshape(emb, [-1, EMB])
+                s = fluid.layers.reduce_sum(emb, dim=1, keep_dim=True)
+                y = fluid.layers.data("y", shape=[1], dtype="float32")
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(s, y))
+                fluid.optimizer.SGD(0.1).minimize(loss)
+        return main, startup
+
+    ids = np.array([[2], [5], [2]], np.int64)
+    ys = np.array([[1.0], [0.0], [2.0]], np.float32)
+    tables = []
+    for sparse in (False, True):
+        main, startup = build(sparse)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for _ in range(3):
+                exe.run(main, feed={"ids": ids, "y": ys}, fetch_list=[])
+            tables.append(np.array(np.asarray(scope.get("tbl"))))
+    np.testing.assert_allclose(tables[0], tables[1], atol=1e-6)
+
+
+def test_shared_table_declines_to_dense():
+    """Two lookups on one table -> maker declines; grads still correct."""
+    V, EMB = 15, 4
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[1], dtype="int64")
+        b = fluid.layers.data("b", shape=[1], dtype="int64")
+        ea = fluid.layers.embedding(a, size=[V, EMB], dtype="float32",
+                                    is_sparse=True,
+                                    param_attr=fluid.ParamAttr(name="sh"))
+        eb = fluid.layers.embedding(b, size=[V, EMB], dtype="float32",
+                                    is_sparse=True,
+                                    param_attr=fluid.ParamAttr(name="sh"))
+        s = fluid.layers.elementwise_add(
+            fluid.layers.reshape(ea, [-1, EMB]),
+            fluid.layers.reshape(eb, [-1, EMB]))
+        loss = fluid.layers.mean(fluid.layers.reduce_sum(s, dim=1))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "lookup_table_sparse_grad" not in types   # declined to dense
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (lv,) = exe.run(main, feed={"a": np.array([[1]], np.int64),
+                                "b": np.array([[2]], np.int64)},
+                    fetch_list=[loss])
+    assert np.isfinite(float(np.asarray(lv).flatten()[0]))

@@ -251,12 +251,14 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     conf_loss = conf_loss * _per_prior(conf_weight)
     loss = loc_loss_weight * loc_loss + conf_loss_weight * conf_loss
     if normalize:
-        # normalize by number of matched (positive) priors, >= 1
+        # normalize by number of matched (positive) priors, >= 1; the
+        # result stays per-prior [N, P] like the reference (detection.py
+        # ssd_loss returns the reshaped per-prior loss / normalizer), so a
+        # downstream mean() gives the same magnitude as reference configs
         denom = nn.reduce_sum(nn.reduce_sum(loc_weight, dim=1), dim=0)
         denom = nn.elementwise_max(
             denom, tensor_layers.fill_constant([1], "float32", 1.0))
-        loss = nn.elementwise_div(nn.reduce_sum(loss, dim=1, keep_dim=True),
-                                  denom)
+        loss = nn.elementwise_div(loss, denom)
     return loss
 
 

@@ -43,16 +43,21 @@ class SelectedRowsValue:
         import jax.numpy as jnp
         dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
                           self.values.dtype)
-        return dense.at[self.rows].add(self.values)
+        # mode="drop": a merged() SelectedRows pads rows with the
+        # out-of-range id `height`, which must not land anywhere.
+        return dense.at[self.rows].add(self.values, mode="drop")
 
     def merged(self):
         """Deduplicate rows, summing their values (merge_selected_rows
-        op / MergeAdd functor). Rows stay fixed-capacity (unique positions
-        padded with the first row id) so shapes are static under jit."""
+        op / MergeAdd functor). Rows stay fixed-capacity so shapes are
+        static under jit; padding positions carry the out-of-range id
+        ``height`` so downstream scatters (mode="drop") never touch a real
+        row — an in-range pad id would clobber that row's moments/params
+        when the batch contains duplicate ids."""
         import jax.numpy as jnp
         uniq, inv = jnp.unique(self.rows, return_inverse=True,
                                size=self.rows.shape[0],
-                               fill_value=self.rows[0])
+                               fill_value=self.height)
         summed = jnp.zeros_like(self.values).at[inv].add(self.values)
         return SelectedRowsValue(uniq.astype(jnp.int32), summed,
                                  self.height)

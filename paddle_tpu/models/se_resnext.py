@@ -1,9 +1,10 @@
 """SE-ResNeXt (50/101/152) for ImageNet-shaped inputs.
 
 Parity with reference python/paddle/fluid/tests/unittests/dist_se_resnext.py
-(SE_ResNeXt class: cardinality-64 grouped 3x3 convs + squeeze-excitation
-with reduction 16) — the reference's multi-device convergence workhorse
-(test_parallel_executor_seresnext / test_dist_se_resnext).
+(SE_ResNeXt class: grouped 3x3 convs — cardinality 32 for depths 50/101,
+64 for 152 — + squeeze-excitation with reduction 16) — the reference's
+multi-device convergence workhorse (test_parallel_executor_seresnext /
+test_dist_se_resnext).
 
 TPU notes: grouped convs lower to one lax.conv_general_dilated with
 feature_group_count; the SE block's squeeze (global avgpool) + two fcs +
@@ -59,11 +60,12 @@ def bottleneck_block(input, num_filters, stride, cardinality,
 
 def build(img, layers=50, class_dim=1000, is_train=True):
     """img [N, 3, H, W] -> logits [N, class_dim] (pre-softmax fc)."""
-    supported = {50: ([3, 4, 6, 3], [128, 256, 512, 1024]),
-                 101: ([3, 4, 23, 3], [128, 256, 512, 1024]),
-                 152: ([3, 8, 36, 3], [128, 256, 512, 1024])}
-    depth, num_filters = supported[layers]
-    cardinality = 64
+    # cardinality per depth matches dist_se_resnext.py:60,:78,:96 —
+    # 32 groups for SE-ResNeXt-50/101, 64 for 152
+    supported = {50: ([3, 4, 6, 3], [128, 256, 512, 1024], 32),
+                 101: ([3, 4, 23, 3], [128, 256, 512, 1024], 32),
+                 152: ([3, 8, 36, 3], [128, 256, 512, 1024], 64)}
+    depth, num_filters, cardinality = supported[layers]
     reduction_ratio = 16
 
     if layers == 152:

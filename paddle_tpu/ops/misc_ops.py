@@ -211,20 +211,41 @@ def _fake_quantize_abs_max(ctx):
     return {"Out": _quant(x, scale, bits), "OutScale": scale.reshape(1)}
 
 
-@register_op("fake_quantize_range_abs_max")
+@register_op("fake_quantize_range_abs_max", stateful=True)
 def _fake_quantize_range_abs_max(ctx):
-    """Running-max variant: in training the scale is the max of the sliding
-    scale window; we use current-batch abs max folded with InScale (the
-    stateless functional equivalent)."""
+    """Sliding-window running max (fake_quantize_op.cc FindRangeAbsMax):
+    each training step records the current batch's abs-max into
+    InScales[Iter % window_size] and the effective scale is the max over
+    the window, so one outlier batch ages out after window_size steps.
+
+    Wiring: thread a [window_size] InScales buffer and an Iter counter
+    through the op (outputs OutScales / IterOut name the same vars).
+    Without them the op degrades to max(cur, InScale) — a monotone running
+    max that never forgets an outlier; acceptable only for short runs.
+    """
     jnp = _jnp()
     x = ctx.input("X")
     bits = int(ctx.attr("bit_length", 8))
-    cur = jnp.max(jnp.abs(x))
     in_scale = ctx.input("InScale")
-    if in_scale is not None and not ctx.attr("is_test", False):
+    if ctx.attr("is_test", False) and in_scale is not None:
+        scale = jnp.maximum(in_scale.reshape(())[None][0], 1e-12)
+        return {"Out": _quant(x, scale, bits), "OutScale": scale.reshape(1)}
+    cur = jnp.max(jnp.abs(x))
+    scales = ctx.input("InScales")
+    it = ctx.input("Iter")
+    if scales is not None and it is not None:
+        it = it.reshape(()).astype(jnp.int32)
+        window = scales.shape[0]
+        scales = scales.at[it % window].set(cur)
+        # entries beyond the first Iter+1 steps are still zero and never
+        # win the max, matching the reference's min(iter+1, window) span
+        scale = jnp.maximum(jnp.max(scales), 1e-12)
+        return {"Out": _quant(x, scale, bits),
+                "OutScale": scale.reshape(1),
+                "OutScales": scales,
+                "IterOut": (it + 1).reshape(1)}
+    if in_scale is not None:
         scale = jnp.maximum(cur, in_scale.reshape(())[None][0])
-    elif in_scale is not None:
-        scale = in_scale.reshape(())[None][0]
     else:
         scale = cur
     scale = jnp.maximum(scale, 1e-12)

@@ -532,13 +532,15 @@ def _lookup_table_grad_maker(op, block, grad_map, no_grad_set):
     out_name = op.outputs["Out"][0]
     if w_name in no_grad_set or out_name not in grad_map:
         return None
-    # shared tables (several lookups on one W) need grad accumulation
-    # across consumers — decline to the dense path, whose fan-in summing
-    # machinery handles it
+    # shared tables need grad accumulation across ALL consumers — not just
+    # other lookups: a tied softmax head (mul on the same W) contributes a
+    # dense partial grad that would silently overwrite the sparse one.
+    # Decline to the dense path, whose fan-in summing machinery handles it,
+    # whenever W feeds any other op.
     consumers = sum(1 for o in block.ops
-                    if o.type == "lookup_table" and
-                    o.inputs.get("W", [None])[0] == w_name)
-    if consumers > 1:
+                    if o is not op and
+                    any(w_name in names for names in o.inputs.values()))
+    if consumers > 0:
         return None
     gname = grad_var_name(w_name)
     w_var = block._find_var_recursive(w_name)

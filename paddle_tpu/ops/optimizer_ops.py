@@ -40,8 +40,10 @@ def _sgd(ctx):
     lr = _lr(ctx).astype(p.dtype)
     if _is_sparse(g):
         g = g.merged()
+        # merged() pads rows with the out-of-range id `height`;
+        # mode="drop" keeps those padding entries off real rows.
         return {"ParamOut": p.at[g.rows].add(
-            -lr * g.values.astype(p.dtype))}
+            -lr * g.values.astype(p.dtype), mode="drop")}
     return {"ParamOut": p - lr * g.astype(p.dtype)}
 
 
@@ -92,14 +94,16 @@ def _adam(ctx):
         # the touched rows' moments and params move
         sr = g.merged()
         rows, vals = sr.rows, sr.values
+        # padding rows are out of range: gathers clip (read garbage that is
+        # never written back), scatters with mode="drop" discard them.
         m1r = beta1 * m1[rows] + (1 - beta1) * vals
         m2r = beta2 * m2[rows] + (1 - beta2) * jnp.square(vals)
         lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
         p_new = p.at[rows].add(-lr_t.astype(p.dtype) * (
-            m1r / (jnp.sqrt(m2r) + eps)).astype(p.dtype))
+            m1r / (jnp.sqrt(m2r) + eps)).astype(p.dtype), mode="drop")
         return {"ParamOut": p_new,
-                "Moment1Out": m1.at[rows].set(m1r),
-                "Moment2Out": m2.at[rows].set(m2r)}
+                "Moment1Out": m1.at[rows].set(m1r, mode="drop"),
+                "Moment2Out": m2.at[rows].set(m2r, mode="drop")}
     m1_out = beta1 * m1 + (1 - beta1) * g
     m2_out = beta2 * m2 + (1 - beta2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
@@ -134,8 +138,10 @@ def _adagrad(ctx):
         rows, vals = sr.rows, sr.values
         mr = m[rows] + jnp.square(vals)
         p_new = p.at[rows].add(
-            -_lr(ctx).astype(p.dtype) * vals / (jnp.sqrt(mr) + eps))
-        return {"ParamOut": p_new, "MomentOut": m.at[rows].set(mr)}
+            -_lr(ctx).astype(p.dtype) * vals / (jnp.sqrt(mr) + eps),
+            mode="drop")
+        return {"ParamOut": p_new,
+                "MomentOut": m.at[rows].set(mr, mode="drop")}
     m_out = m + jnp.square(g)
     p_out = p - _lr(ctx).astype(p.dtype) * g / (jnp.sqrt(m_out) + eps)
     return {"ParamOut": p_out, "MomentOut": m_out}

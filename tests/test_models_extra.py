@@ -23,9 +23,9 @@ def test_se_resnext_trains():
         (lv,) = exe.run(main, feed={"data": img, "label": lab},
                         fetch_list=[loss])
         assert np.isfinite(float(np.asarray(lv).flatten()[0]))
-    # structural parity: grouped conv with cardinality 64 present
+    # structural parity: depth-50 uses cardinality 32 (dist_se_resnext.py:60)
     gops = [op for op in main.global_block().ops
-            if op.type == "conv2d" and op.attrs.get("groups", 1) == 64]
+            if op.type == "conv2d" and op.attrs.get("groups", 1) == 32]
     assert len(gops) == 16   # one per bottleneck block [3,4,6,3]
 
 

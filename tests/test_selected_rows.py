@@ -54,8 +54,17 @@ def test_sparse_updates_touch_only_seen_rows(opt):
     assert set(changed.tolist()) == {3, 7}
 
 
-def test_sparse_matches_dense_sgd():
-    """Sparse and dense paths must produce identical updates."""
+@pytest.mark.parametrize("opt", [
+    lambda: fluid.optimizer.SGD(0.1),
+    lambda: fluid.optimizer.Adam(0.1),
+    lambda: fluid.optimizer.Adagrad(0.1),
+], ids=["sgd", "adam", "adagrad"])
+def test_sparse_matches_dense(opt):
+    """Sparse and dense paths must produce identical updates over several
+    steps with duplicate ids in the batch. Regression: merged() used to pad
+    its fixed-capacity unique-row set with an in-range row id, so Adam and
+    Adagrad's set-scatters clobbered that row's moments once they were
+    nonzero (steps >= 2) and added spurious param deltas."""
     V, EMB = 20, 4
 
     def build(is_sparse):
@@ -74,7 +83,7 @@ def test_sparse_matches_dense_sgd():
                 y = fluid.layers.data("y", shape=[1], dtype="float32")
                 loss = fluid.layers.mean(
                     fluid.layers.square_error_cost(s, y))
-                fluid.optimizer.SGD(0.1).minimize(loss)
+                opt().minimize(loss)
         return main, startup
 
     ids = np.array([[2], [5], [2]], np.int64)
@@ -86,9 +95,55 @@ def test_sparse_matches_dense_sgd():
         with fluid.scope_guard(scope):
             exe = fluid.Executor(fluid.CPUPlace())
             exe.run(startup)
-            for _ in range(3):
+            for _ in range(4):
                 exe.run(main, feed={"ids": ids, "y": ys}, fetch_list=[])
             tables.append(np.array(np.asarray(scope.get("tbl"))))
+    np.testing.assert_allclose(tables[0], tables[1], atol=1e-6)
+
+
+def test_tied_weight_declines_to_dense():
+    """W consumed by a lookup AND a mul (tied softmax head): the sparse
+    maker must decline, else the dense partial grad from the mul overwrites
+    the sparse embedding grad. Regression: the maker used to count only
+    other lookup_table consumers."""
+    V, EMB = 12, 6
+
+    def build(is_sparse):
+        main, startup = Program(), Program()
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+                emb = fluid.layers.embedding(
+                    ids, size=[V, EMB], dtype="float32",
+                    is_sparse=is_sparse,
+                    param_attr=fluid.ParamAttr(
+                        name="tied",
+                        initializer=fluid.initializer.Constant(0.25)))
+                emb = fluid.layers.reshape(emb, [-1, EMB])
+                w = main.global_block().var("tied")
+                logits = fluid.layers.matmul(emb, w, transpose_y=True)
+                y = fluid.layers.data("y", shape=[1], dtype="int64")
+                loss = fluid.layers.mean(
+                    fluid.layers.cross_entropy(
+                        fluid.layers.softmax(logits), y))
+                fluid.optimizer.SGD(0.1).minimize(loss)
+        return main, startup
+
+    ids = np.array([[1], [4], [1]], np.int64)
+    ys = np.array([[2], [0], [7]], np.int64)
+    tables = []
+    for sparse in (False, True):
+        main, startup = build(sparse)
+        if sparse:
+            types = [op.type for op in main.global_block().ops]
+            assert "lookup_table_sparse_grad" not in types
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for _ in range(3):
+                exe.run(main, feed={"ids": ids, "y": ys}, fetch_list=[])
+            tables.append(np.array(np.asarray(scope.get("tied"))))
     np.testing.assert_allclose(tables[0], tables[1], atol=1e-6)
 
 

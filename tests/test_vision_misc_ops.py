@@ -412,6 +412,41 @@ def test_fake_quantize_roundtrip():
     np.testing.assert_allclose(np.asarray(dqv), x, atol=1.0 / 127)
 
 
+def test_fake_quantize_range_abs_max_window():
+    """Sliding-window scale: an outlier batch must age out of the max
+    after window_size steps (reference FindRangeAbsMax). Regression: the
+    lowering used to keep a monotone running max that never forgot."""
+    window = 2
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[4], dtype="float32")
+        blk = main.global_block()
+        for name, shape in [("q", None), ("qscale", None)]:
+            blk.create_var(name=name, dtype="float32")
+        scales = blk.create_var(name="scales_w", dtype="float32",
+                                shape=[window], persistable=True)
+        itv = blk.create_var(name="it", dtype="int32", shape=[1],
+                             persistable=True)
+        blk.append_op(type="fake_quantize_range_abs_max",
+                      inputs={"X": xv, "InScales": scales, "Iter": itv},
+                      outputs={"Out": "q", "OutScale": "qscale",
+                               "OutScales": scales, "IterOut": itv},
+                      attrs={"bit_length": 8, "window_size": window})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    scope.set("scales_w", np.zeros(window, np.float32))
+    scope.set("it", np.zeros(1, np.int32))
+    batches = [10.0, 1.0, 1.0]
+    seen = []
+    for mx in batches:
+        x = np.array([[mx, -0.5, 0.25, 0.1]], np.float32)
+        (sc,) = exe.run(main, feed={"x": x}, fetch_list=["qscale"])
+        seen.append(float(np.asarray(sc).flatten()[0]))
+    assert seen[0] == 10.0 and seen[1] == 10.0   # outlier still in window
+    assert seen[2] == 1.0                        # aged out after `window`
+
+
 def test_conv2d_transpose_output_size_and_values():
     # reference deconv: H_out = (H-1)*s - 2p + k
     x = np.ones((1, 1, 4, 4), np.float32)

@@ -78,6 +78,31 @@ class ParallelExecutor:
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._build_strategy = build_strategy or BuildStrategy()
         self._loss_name = loss_name
+        # multi-host ("nccl2") data parallelism: after the startup
+        # program's gen_collective_id has run jax.distributed.initialize,
+        # jax.devices() spans every trainer process and the mesh below is
+        # the cross-node NCCLContextMap analogue (nccl_helper.h:82,
+        # parallel_executor.cc:113). Feeds stay process-local; run()
+        # assembles them into global arrays.
+        self._num_trainers = int(num_trainers or 1)
+        self._trainer_id = int(trainer_id or 0)
+        if self._num_trainers > 1:
+            if jax.process_count() != self._num_trainers:
+                raise RuntimeError(
+                    "ParallelExecutor(num_trainers=%d) but the collective "
+                    "world has %d processes — run gen_collective_id (the "
+                    "collective-mode transpiler emits it into the startup "
+                    "program) or set PADDLE_COORDINATOR before first "
+                    "device use" % (self._num_trainers,
+                                    jax.process_count()))
+            if jax.process_index() != self._trainer_id:
+                raise RuntimeError(
+                    "trainer_id=%d does not match collective process "
+                    "index %d" % (self._trainer_id, jax.process_index()))
+            if mesh is None:
+                from ..parallel.mesh import make_mesh
+                devs = jax.devices()
+                mesh = make_mesh({DATA_AXIS: len(devs)}, devs)
         self._mesh = mesh if mesh is not None else \
             data_parallel_mesh(use_cuda=use_cuda)
         self._num_devices = int(np.prod(list(self._mesh.shape.values())))
@@ -111,13 +136,22 @@ class ParallelExecutor:
         return NamedSharding(self._mesh,
                              P(DATA_AXIS, *([None] * (ndim - 1))))
 
-    def _replicate_state(self):
+    def _put(self, arr, sharding):
+        """Place a process-local array under `sharding`. Across processes
+        this is the BCast/split analogue: every process contributes its
+        addressable shards (full array when replicated, the local batch
+        shard when batch-sharded)."""
         import jax
+        if self._num_trainers > 1:
+            return jax.make_array_from_process_local_data(sharding, arr)
+        return jax.device_put(arr, sharding)
+
+    def _replicate_state(self):
         rep = self._replicated_sharding()
         for name in functionalizer.persistable_names(self._main_program):
             val = self._scope.get(name)
             if val is not None:
-                self._scope.set(name, jax.device_put(val, rep))
+                self._scope.set(name, self._put(np.asarray(val), rep))
 
     def _get_jitted(self, feed_key, fetch_names, state_names):
         import jax
@@ -173,8 +207,10 @@ class ParallelExecutor:
             if arr.ndim == 0:
                 feeds[name] = jnp.asarray(arr)
             else:
-                feeds[name] = jax.device_put(
-                    arr, self._batch_sharding(arr.ndim))
+                # multi-trainer: `arr` is this trainer's LOCAL batch; the
+                # global array spans num_trainers x local (the reference's
+                # per-trainer reader semantics in nccl2 mode)
+                feeds[name] = self._put(arr, self._batch_sharding(arr.ndim))
         feed_key = tuple(sorted(feeds.keys()))
 
         persistables = tuple(

@@ -192,13 +192,16 @@ class DistributeTranspiler:
             gb = startup_program.global_block()
             gb.create_var(name="CollectiveId", shape=(1,), dtype="int64",
                           persistable=True)
-            gb.append_op(
+            # PREPENDED: jax.distributed.initialize must run before any op
+            # touches the backend (param initializers included), or the
+            # process joins the collective world after its devices are
+            # already pinned local-only
+            gb._prepend_op(
                 type="gen_collective_id",
                 inputs={}, outputs={"Out": ["CollectiveId"]},
                 attrs={"trainer_id": trainer_id,
                        "num_trainers": trainers,
-                       RPC_OP_ROLE_ATTR: RPC_OP_ROLE_VALUE},
-                infer_shape=False)
+                       RPC_OP_ROLE_ATTR: RPC_OP_ROLE_VALUE})
         program._num_trainers = trainers
         program._trainer_id = trainer_id
         self.trainer_program = program

@@ -1,0 +1,93 @@
+"""Trainer child process for test_dist_collective.py (reference pattern:
+test_dist_base.py:219,:299 — subprocess localhost cluster, per-step losses
+compared against a local run).
+
+Usage: python dist_collective_trainer.py <trainer_id> <num_trainers> <port>
+Prints one line: ``LOSSES <json list>``.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model():
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def global_batches(steps=5, global_bs=8):
+    import numpy as np
+    rng = np.random.RandomState(7)
+    w = rng.randn(4, 1).astype(np.float32)
+    out = []
+    for _ in range(steps):
+        xb = rng.randn(global_bs, 4).astype(np.float32)
+        yb = (xb @ w + 0.1 * rng.randn(global_bs, 1)).astype(np.float32)
+        out.append((xb, yb))
+    return out
+
+
+def run_local():
+    """Single-process full-batch baseline (invoked by the parent test)."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    main, startup, loss = build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for xb, yb in global_batches():
+        (lv,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).flatten()[0]))
+    return losses
+
+
+def run_trainer(tid, n, port):
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    os.environ["PADDLE_COORDINATOR"] = "127.0.0.1:%s" % port
+
+    main, startup, loss = build_model()
+    config = fluid.DistributeTranspilerConfig()
+    config.mode = "collective"
+    t = fluid.DistributeTranspiler(config=config)
+    t.transpile(tid, program=main, trainers=n, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)       # gen_collective_id -> jax.distributed.initialize
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                main_program=main, num_trainers=n,
+                                trainer_id=tid)
+    losses = []
+    for xb, yb in global_batches():
+        lo = xb.shape[0] // n
+        sl = slice(tid * lo, (tid + 1) * lo)   # this trainer's local shard
+        (lv,) = pe.run(fetch_list=[loss.name],
+                       feed={"x": xb[sl], "y": yb[sl]})
+        losses.append(float(np.asarray(lv).flatten()[0]))
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+def main():
+    tid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    run_trainer(tid, n, port)
+
+
+if __name__ == "__main__":
+    main()

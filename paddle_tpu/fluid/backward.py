@@ -63,10 +63,14 @@ def _append_grad_ops(block, path_ops, grad_map, no_grad_set):
     writes a renamed grad, then a `sum` op merges them."""
     from .. import ops as op_registry
 
-    # count how many path ops consume each var (fan-out in fwd = fan-in in bwd)
+    # count how many path ops consume each var (fan-out in fwd = fan-in in
+    # bwd). An op that both reads and writes a name (while carries, in-place
+    # increment) is not a downstream consumer of it — counting the self-loop
+    # would leave the var's grad as a forever-pending partial.
     pending = {}
     for op in path_ops:
-        for name in set(op.input_arg_names):
+        outs = set(op.output_arg_names)
+        for name in set(op.input_arg_names) - outs:
             pending[name] = pending.get(name, 0) + 1
 
     partials = {}  # var name -> list of partial grad var names
@@ -104,7 +108,8 @@ def _append_grad_ops(block, path_ops, grad_map, no_grad_set):
             # is_sparse is set and the table has a single consumer
             made = od.grad_maker(op, block, grad_map, no_grad_set)
             if made is not None:
-                for name in set(op.input_arg_names):
+                for name in set(op.input_arg_names) - \
+                        set(op.output_arg_names):
                     if name in pending:
                         pending[name] -= 1
                         if pending[name] == 0 and name in partials:
@@ -152,7 +157,7 @@ def _append_grad_ops(block, path_ops, grad_map, no_grad_set):
                 infer_shape=False)
 
         # a consumer of each input var has now contributed its partial
-        for name in set(op.input_arg_names):
+        for name in set(op.input_arg_names) - set(op.output_arg_names):
             if name in pending:
                 pending[name] -= 1
                 if pending[name] == 0 and name in partials:

@@ -171,8 +171,11 @@ class _ShapeOf:
     __slots__ = ("shape", "dtype")
 
     def __init__(self, v):
-        self.shape = v.shape
-        self.dtype = v.dtype
+        # v may be None (e.g. a while op's declared-but-uninitialized
+        # output position); jax treats None as an empty pytree node, so
+        # the matching cotangent is also None
+        self.shape = getattr(v, "shape", None)
+        self.dtype = getattr(v, "dtype", None)
 
 
 def _run_grad_op(op, env, vjp_cache, step, seed, mesh):
@@ -199,6 +202,9 @@ def _run_grad_op(op, env, vjp_cache, step, seed, mesh):
         gnames = op.inputs.get("GRAD:" + slot, [])
         cs = []
         for i, p in enumerate(parts):
+            if p.shape is None:          # None primal -> None cotangent
+                cs.append(None)
+                continue
             g = env.get(gnames[i]) if i < len(gnames) and gnames[i] else None
             if g is None:
                 cs.append(_float0_zeros(p))

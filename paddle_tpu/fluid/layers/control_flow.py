@@ -139,6 +139,29 @@ class BlockGuard:
         return exc_type is None
 
 
+def _external_block_io(sub_block, parent_block):
+    """Static (build-time) read/write analysis of a sub-block against its
+    parent scope chain: reads = parent vars consumed before any local
+    definition; writes = parent vars assigned inside the block."""
+    local = set(sub_block.vars.keys())
+    produced = set()
+    reads, writes = [], []
+    for op in sub_block.ops:
+        for n in op.input_arg_names:
+            if n and n not in produced and n not in local and \
+                    n not in reads and \
+                    parent_block._find_var_recursive(n) is not None:
+                reads.append(n)
+        for n in op.output_arg_names:
+            if not n:
+                continue
+            produced.add(n)
+            if n not in local and n not in writes and \
+                    parent_block._find_var_recursive(n) is not None:
+                writes.append(n)
+    return reads, writes
+
+
 class While:
     """reference control_flow.py:655. Usage:
         cond = layers.less_than(i, n)
@@ -152,13 +175,19 @@ class While:
     IN_WHILE_BLOCK = 1
     AFTER_WHILE_BLOCK = 2
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None, max_iters=None):
+        """max_iters: static trip-count bound. When set (and not is_test)
+        the loop lowers to a bounded masked lax.scan, which makes it
+        DIFFERENTIABLE — append_backward can train through the loop
+        (reference while_grad, while_op.cc:119). Without it the loop
+        lowers to lax.while_loop: dynamic trip count, forward-only."""
         self.helper = LayerHelper("while", name=name)
         self.status = While.BEFORE_WHILE_BLOCK
         if cond.dtype != core.VarDesc.VarType.BOOL:
             raise TypeError("condition should be a bool variable")
         self.cond_var = cond
         self.is_test = is_test
+        self.max_iters = max_iters
 
     def block(self):
         return WhileGuard(self)
@@ -167,11 +196,19 @@ class While:
         main_program = self.helper.main_program
         while_block = main_program.current_block()
         parent_block = main_program.block(while_block.parent_idx)
+        # Declare the loop's data flow on the op (reference while_op kX/kOut):
+        # X = parent-block vars the sub-block reads or carries, Out = parent
+        # vars it writes. This makes the op a pure function of its inputs, so
+        # backward.py's path discovery and the generic vjp grad machinery see
+        # through the loop.
+        reads, writes = _external_block_io(while_block, parent_block)
+        xs = list(dict.fromkeys(reads + writes))   # carries need init values
         parent_block.append_op(
             type="while",
-            inputs={"Condition": [self.cond_var]},
-            outputs={},
-            attrs={"sub_block": while_block, "is_test": self.is_test},
+            inputs={"Condition": [self.cond_var], "X": xs},
+            outputs={"Out": list(writes)},
+            attrs={"sub_block": while_block, "is_test": self.is_test,
+                   "max_iters": self.max_iters},
             infer_shape=False)
 
 

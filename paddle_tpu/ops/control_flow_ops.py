@@ -13,10 +13,11 @@ functionalizer (fluid/functionalizer.run_block), so
   the parent block at build time (trace-time unrolling is free under XLA and
   keeps the whole net differentiable by the generic vjp machinery).
 
-Gradient support: lax.while_loop is not differentiable (matching XLA
-semantics); training-time recurrences go through recurrent/scan or the
-unrolled StaticRNN, while `while` serves inference/decoding loops — the same
-split the reference's dynamic-RNN machinery effectively made.
+Gradient support: a `while` built with max_iters lowers to a bounded masked
+lax.scan and is differentiable through the generic vjp machinery (reference
+while_grad, while_op.cc:119); without a bound it lowers to lax.while_loop —
+dynamic trip count, forward-only (inference/decoding loops). Training-time
+recurrences can also go through recurrent/scan or the unrolled StaticRNN.
 """
 
 import numpy as np
@@ -48,35 +49,98 @@ def _subblock_io(block, env):
 
 @register_op("while")
 def _while(ctx):
+    """Pure while op over its declared X (reads + carry inits) / Out
+    (writes) slots. Two lowerings:
+
+    - max_iters attr set and not is_test: bounded masked lax.scan — the body
+      runs exactly max_iters times and a jnp.where on the condition freezes
+      the carry once it turns false. This form is DIFFERENTIABLE (the where
+      gates cotangents), restoring the reference's while_grad capability
+      (while_op.cc:119,:181) through the generic vjp machinery.
+    - otherwise: lax.while_loop — dynamic trip count, forward-only
+      (inference/decoding loops).
+
+    Programs built before X/Out declaration fall back to env introspection.
+    """
     import jax
+    jnp = _jnp()
     from ..fluid import functionalizer
     block = ctx.attr("sub_block")
     cond_name = ctx.op.inputs["Condition"][0]
-    env = ctx.env  # threaded by the functionalizer
-    reads, writes = _subblock_io(block, env)
-    carry_names = [n for n in writes if n in env]
-    closure_names = [n for n in reads if n not in carry_names]
-    closure = {n: env[n] for n in closure_names}
-    init = tuple(env[n] for n in carry_names)
+
+    x_names = list(ctx.op.inputs.get("X", []))
+    out_names = list(ctx.op.outputs.get("Out", []))
+    if x_names:
+        vals = dict(zip(x_names, ctx.inputs("X")))
+        vals.setdefault(cond_name, ctx.input("Condition"))
+        carry_names = [n for n in out_names if vals.get(n) is not None]
+        closure = {n: v for n, v in vals.items()
+                   if n not in carry_names and v is not None}
+        env = None
+    else:        # legacy env-introspection path
+        env = ctx.env
+        reads, writes = _subblock_io(block, env)
+        carry_names = [n for n in writes if n in env]
+        closure = {n: env[n] for n in reads if n not in carry_names}
+        vals = env
+
+    if cond_name not in carry_names and cond_name not in closure:
+        closure[cond_name] = vals[cond_name]
+    init = tuple(vals[n] for n in carry_names)
 
     def overlay(carry):
         e = dict(closure)
         e.update(zip(carry_names, carry))
         return e
 
-    def cond_fun(carry):
-        return overlay(carry)[cond_name].reshape(())
-
-    def body_fun(carry):
-        e = overlay(carry)
+    def run_body(e):
         functionalizer.run_block(block, e, step=ctx.step, seed=ctx.seed,
                                  mesh=ctx.mesh)
         return tuple(e[n] for n in carry_names)
 
-    final = jax.lax.while_loop(cond_fun, body_fun, init)
-    for n, v in zip(carry_names, final):
-        env[n] = v
-    return {}
+    max_iters = ctx.attr("max_iters")
+    if max_iters and not ctx.attr("is_test", False):
+        def scan_body(carry, _):
+            e = overlay(carry)
+            pred = e[cond_name].reshape(())
+            new = run_body(e)
+            kept = tuple(jnp.where(pred, nv, cv)
+                         for nv, cv in zip(new, carry))
+            return kept, None
+        final, _ = jax.lax.scan(scan_body, init, None,
+                                length=int(max_iters))
+    else:
+        def cond_fun(carry):
+            return overlay(carry)[cond_name].reshape(())
+
+        def body_fun(carry):
+            return run_body(overlay(carry))
+
+        final = jax.lax.while_loop(cond_fun, body_fun, init)
+
+    if env is not None:        # legacy: write straight into the parent env
+        for n, v in zip(carry_names, final):
+            env[n] = v
+        return {}
+    by_name = dict(zip(carry_names, final))
+    return {"Out": [by_name.get(n) for n in out_names]}
+
+
+def _while_grad_maker(op, block, grad_map, no_grad_set):
+    """Guard rail: differentiating a while requires the bounded-scan
+    lowering. Decline (None) to the generic vjp path when max_iters is set;
+    fail with guidance instead of a cryptic lax error when it is not."""
+    if op.attrs.get("max_iters"):
+        return None
+    raise RuntimeError(
+        "cannot differentiate through `while` without a trip-count bound: "
+        "construct the loop with layers.While(cond, max_iters=N) so it "
+        "lowers to a bounded lax.scan (reference while_grad capability, "
+        "while_op.cc:119)")
+
+
+from .registry import set_grad_maker as _set_gm_cf  # noqa: E402
+_set_gm_cf("while", _while_grad_maker)
 
 
 @register_op("conditional_block")

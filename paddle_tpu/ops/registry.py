@@ -221,6 +221,8 @@ def infer_shape(op, block):
 
 def _is_float(x):
     import jax.numpy as jnp
+    if x is None:
+        return False
     return jnp.issubdtype(jnp.result_type(x), jnp.floating)
 
 

@@ -27,6 +27,100 @@ def test_while_loop_sums():
     assert float(np.asarray(res)) == 45.0
 
 
+def _build_while_net(B=3):
+    """loss = mean(h) where h = W @ (W @ (W @ x)) computed by a While loop
+    reading parameter W each iteration (the reference's train-through-While
+    pattern, while_op.cc:119 while_grad)."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.assign(x)
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        n = fluid.layers.fill_constant([1], "int64", 3)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond, max_iters=3)
+        with w.block():
+            h2 = fluid.layers.fc(
+                h, size=4, bias_attr=False,
+                param_attr=fluid.ParamAttr(
+                    name="loop_w",
+                    initializer=fluid.initializer.Constant(0.4)))
+            fluid.layers.assign(h2, h)
+            fluid.layers.increment(i, 1.0, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+        loss = fluid.layers.mean(h)
+    return main, startup, loss
+
+
+def test_while_gradient_finite_difference():
+    """OpTest-grade numeric check of d loss / d W through the loop."""
+    main, startup, loss = _build_while_net()
+    with fluid.program_guard(main, startup):
+        (wgrad,) = fluid.backward.gradients(
+            loss, [main.global_block().var("loop_w")])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    rng = np.random.RandomState(3)
+    xb = rng.randn(3, 4).astype(np.float32)
+    (g,) = exe.run(main, feed={"x": xb}, fetch_list=[wgrad])
+    g = np.asarray(g)
+
+    def loss_at(wval):
+        scope.set("loop_w", wval)
+        (lv,) = exe.run(main, feed={"x": xb}, fetch_list=[loss])
+        return float(np.asarray(lv).flatten()[0])
+
+    w0 = np.array(np.asarray(scope.get("loop_w")))
+    eps = 1e-3
+    num = np.zeros_like(w0)
+    for r in range(w0.shape[0]):
+        for c in range(w0.shape[1]):
+            wp = w0.copy(); wp[r, c] += eps
+            wm = w0.copy(); wm[r, c] -= eps
+            num[r, c] = (loss_at(wp) - loss_at(wm)) / (2 * eps)
+    scope.set("loop_w", w0)
+    np.testing.assert_allclose(g, num, atol=1e-3, rtol=1e-2)
+
+
+def test_while_trains():
+    """Training through a While loop: loss decreases."""
+    main, startup, loss = _build_while_net()
+    with fluid.program_guard(main, startup):
+        sq = fluid.layers.square(loss)      # minimize mean(h)^2 -> 0
+        fluid.optimizer.SGD(0.02).minimize(sq)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xb = np.abs(rng.randn(3, 4)).astype(np.float32) + 0.5
+    vals = []
+    for _ in range(15):
+        (lv,) = exe.run(main, feed={"x": xb}, fetch_list=[sq])
+        vals.append(float(np.asarray(lv).flatten()[0]))
+    assert vals[-1] < 0.05 * vals[0], vals
+
+
+def test_while_without_bound_raises_on_backward():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.assign(x)
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        n = fluid.layers.fill_constant([1], "int64", 3)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)          # no max_iters
+        with w.block():
+            h2 = fluid.layers.fc(h, size=4, bias_attr=False,
+                                 param_attr=fluid.ParamAttr(name="w2"))
+            fluid.layers.assign(h2, h)
+            fluid.layers.increment(i, 1.0, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+        loss = fluid.layers.mean(h)
+        import pytest
+        with pytest.raises(RuntimeError, match="max_iters"):
+            fluid.optimizer.SGD(0.1).minimize(loss)
+
+
 def test_conditional_block():
     main, startup = Program(), Program()
     with fluid.program_guard(main, startup):

@@ -278,7 +278,9 @@ class RPCClient:
         s = conns.get(ep)
         if s is None:
             host, port = ep.rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=120)
+            from ..flags import FLAGS
+            s = socket.create_connection((host, int(port)),
+                                         timeout=FLAGS.rpc_deadline)
             conns[ep] = s
         return s
 

@@ -1,0 +1,182 @@
+"""Typed runtime flag registry with environment ingestion.
+
+Reference analogue: the gflags config surface — 87 ``DEFINE_*`` across
+fluid (e.g. ``fraction_of_gpu_memory_to_use`` platform/gpu_info.cc:22,
+``use_mkldnn`` framework/executor.cc:28, allocator strategy
+allocation/allocator_strategy.h:21) re-exported to Python through a curated
+env-flag allowlist at import (python/paddle/fluid/__init__.py:114-134
+``read_env_flags`` -> ``core.init_gflags``).
+
+TPU redesign: one typed registry. A flag is declared with DEFINE_*; at
+import, ``PADDLE_TPU_FLAGS_<name>`` (or reference-style ``FLAGS_<name>``)
+environment variables override defaults; at runtime ``set_flags`` /
+``get_flags`` mirror the modern fluid API. Flags may register an on-change
+callback for live wiring (e.g. AMP). Flags whose reference meaning is owned
+by XLA on TPU (allocator sizing, per-op GC) are kept as documented
+advisory knobs so reference configs keep loading.
+"""
+
+import os
+
+__all__ = ["DEFINE_bool", "DEFINE_int", "DEFINE_float", "DEFINE_string",
+           "FLAGS", "set_flags", "get_flags", "flag_info"]
+
+_TRUE = frozenset(["1", "true", "yes", "on"])
+_FALSE = frozenset(["0", "false", "no", "off", ""])
+
+
+class _FlagDef:
+    __slots__ = ("name", "type", "default", "help", "on_change", "value")
+
+    def __init__(self, name, type_, default, help_, on_change=None):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.help = help_
+        self.on_change = on_change
+        self.value = default
+
+
+_DEFS = {}
+
+
+class _Flags:
+    """Attribute access mirror of the registry: ``FLAGS.check_nan_inf``."""
+
+    def __getattr__(self, name):
+        d = _DEFS.get(name)
+        if d is None:
+            raise AttributeError("unknown flag %r" % name)
+        return d.value
+
+    def __setattr__(self, name, value):
+        set_flags({name: value})
+
+
+FLAGS = _Flags()
+
+
+def _coerce(d, value):
+    if d.type is bool:
+        if isinstance(value, str):
+            lv = value.strip().lower()
+            if lv in _TRUE:
+                return True
+            if lv in _FALSE:
+                return False
+            raise ValueError("flag %s: cannot parse %r as bool"
+                             % (d.name, value))
+        return bool(value)
+    return d.type(value)
+
+
+def _env_override(d):
+    for key in ("PADDLE_TPU_FLAGS_" + d.name, "FLAGS_" + d.name):
+        if key in os.environ:
+            return os.environ[key]
+    return None
+
+
+def _define(name, type_, default, help_, on_change=None):
+    d = _FlagDef(name, type_, default, help_, on_change)
+    _DEFS[name] = d
+    raw = _env_override(d)
+    if raw is not None:
+        set_flags({name: raw})
+    return d
+
+
+def DEFINE_bool(name, default, help_="", on_change=None):
+    return _define(name, bool, default, help_, on_change)
+
+
+def DEFINE_int(name, default, help_="", on_change=None):
+    return _define(name, int, default, help_, on_change)
+
+
+def DEFINE_float(name, default, help_="", on_change=None):
+    return _define(name, float, default, help_, on_change)
+
+
+def DEFINE_string(name, default, help_="", on_change=None):
+    return _define(name, str, default, help_, on_change)
+
+
+def set_flags(flags_dict):
+    """Set one or more flags (modern fluid API: fluid.set_flags)."""
+    for name, value in flags_dict.items():
+        d = _DEFS.get(name)
+        if d is None:
+            raise KeyError(
+                "unknown flag %r; known flags: %s"
+                % (name, ", ".join(sorted(_DEFS))))
+        new = _coerce(d, value)
+        old, d.value = d.value, new
+        if d.on_change is not None and new != old:
+            d.on_change(new)
+
+
+def get_flags(names):
+    """Read flags by name (str or list of str) -> dict."""
+    if isinstance(names, str):
+        names = [names]
+    return {n: _DEFS[n].value for n in names}
+
+
+def flag_info():
+    """name -> (type, default, current, help) for documentation/tests."""
+    return {n: (d.type.__name__, d.default, d.value, d.help)
+            for n, d in sorted(_DEFS.items())}
+
+
+# ---------------------------------------------------------------------------
+# built-in flag definitions (the curated allowlist)
+# ---------------------------------------------------------------------------
+
+def _amp_changed(v):
+    from .ops import registry
+    registry.set_amp(v)
+
+
+DEFINE_bool(
+    "check_nan_inf", False,
+    "Re-check op outputs for NaN/Inf (reference FLAGS_check_nan_inf, "
+    "framework/operator.cc:29). Eagerly-run programs (host-op blocks) get "
+    "per-op attribution; jitted steps are checked at the step boundary. "
+    "Combine with jax_debug_nans for primitive-level attribution.")
+DEFINE_bool(
+    "benchmark", False,
+    "Synchronize after every executor step and make timing honest "
+    "(reference FLAGS_benchmark forced per-op device sync, scope.cc:25).")
+DEFINE_bool(
+    "use_bf16_amp", False,
+    "bf16 automatic mixed precision: MXU-native bf16 matmuls/convs with "
+    "fp32 master weights (the TPU analogue of the reference's fp16 "
+    "data-transform story).", on_change=_amp_changed)
+DEFINE_bool(
+    "cpu_deterministic", False,
+    "Prefer deterministic reduction order (reference FLAGS_cpu_deterministic, "
+    "python/paddle/fluid/__init__.py:123). Advisory on TPU: XLA reductions "
+    "are deterministic for a fixed compilation.")
+DEFINE_string(
+    "profiler_path", "/tmp/paddle_tpu_profile",
+    "Default trace output directory for fluid.profiler "
+    "(reference profiler proto path).")
+DEFINE_float(
+    "eager_delete_tensor_gb", -1.0,
+    "Reference GC threshold (executor.cc eager deletion). Advisory: XLA "
+    "owns device memory; buffer lifetime ends with the computation.")
+DEFINE_float(
+    "fraction_of_gpu_memory_to_use", 0.92,
+    "Reference gpu_info.cc:22. Advisory on TPU (XLA preallocates HBM); "
+    "honored for CPU client via XLA_PYTHON_CLIENT_MEM_FRACTION when set "
+    "before first device use.")
+DEFINE_int(
+    "paddle_num_threads", 1,
+    "Reference inter-op CPU threads. Advisory: XLA owns scheduling.")
+DEFINE_float(
+    "rpc_deadline", 180.0,
+    "Parameter-server RPC timeout in seconds (reference FLAGS_rpc_deadline).")
+DEFINE_int(
+    "dist_threadpool_size", 0,
+    "Reference distributed thread pool size. Advisory.")

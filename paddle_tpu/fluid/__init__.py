@@ -53,6 +53,8 @@ from . import metrics
 from . import profiler
 from . import nets
 from ..ops.registry import set_amp, amp_enabled  # noqa: F401  (bf16 AMP)
+from .. import flags  # noqa: F401  (typed runtime flags, env-ingested)
+from ..flags import set_flags, get_flags, FLAGS  # noqa: F401
 from . import ir_passes
 from . import average
 from . import evaluator
@@ -73,4 +75,5 @@ __all__ = [
     "DistributeTranspilerConfig", "memory_optimize", "release_memory",
     "InferenceTranspiler", "average", "evaluator", "debugger", "contrib",
     "set_amp", "amp_enabled", "ir_passes",
+    "flags", "set_flags", "get_flags", "FLAGS",
 ]

@@ -121,6 +121,25 @@ def _fetch_name(f):
     raise TypeError("bad fetch entry: %r" % (f,))
 
 
+def _check_nan_inf(fetch_names, fetches, new_state):
+    """FLAGS.check_nan_inf step-boundary check (reference operator.cc:29
+    per-op check; eagerly-run host-op programs get per-op attribution in
+    functionalizer._run_forward_op instead)."""
+    bad = []
+    for name, val in list(zip(fetch_names, fetches)) + \
+            sorted(new_state.items()):
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        if arr.dtype.kind == "f" and not np.all(np.isfinite(arr)):
+            bad.append(name)
+    if bad:
+        raise FloatingPointError(
+            "check_nan_inf: non-finite values in: %s (enable "
+            "jax_debug_nans or run the program eagerly for per-op "
+            "attribution)" % ", ".join(bad))
+
+
 class Executor:
     """reference executor.py:256. `place` selects the jax backend; under jit
     there is no per-op placement, so CPUPlace/TPUPlace only choose where the
@@ -252,6 +271,14 @@ class Executor:
         self._step_counters[id(program)] = step + 1
 
         fetches, new_state = fn(state_in, feeds, np.uint32(step))
+        from ..flags import FLAGS
+        if FLAGS.benchmark:
+            # reference FLAGS_benchmark: force device sync per step so
+            # wall-clock timing around run() is honest (scope.cc:25)
+            import jax as _jax
+            _jax.block_until_ready((fetches, new_state))
+        if FLAGS.check_nan_inf:
+            _check_nan_inf(fetch_names, fetches, new_state)
         for n, val in new_state.items():
             scope.set(n, val)
 

@@ -165,6 +165,29 @@ def _run_forward_op(op, env, vjp_cache, needed_vjp, step, seed, mesh):
         if outs:
             _write_outputs(op, outs, env)
     _propagate_lod(op, env)
+    _maybe_check_nan_inf(op, norm if op.uid in needed_vjp else outs)
+
+
+def _maybe_check_nan_inf(op, outs):
+    """FLAGS.check_nan_inf per-op attribution for eagerly-run programs
+    (reference operator.cc:29 re-checks every op output). Under jit the
+    values are tracers and the Executor's step-boundary check applies
+    instead."""
+    from ..flags import FLAGS
+    if not FLAGS.check_nan_inf or not outs:
+        return
+    import jax
+    for slot, vals in _normalize_outs(outs).items():
+        for i, v in enumerate(vals):
+            if v is None or isinstance(v, jax.core.Tracer):
+                return
+            arr = np.asarray(v)
+            if arr.dtype.kind == "f" and not np.all(np.isfinite(arr)):
+                names = op.outputs.get(slot, [])
+                name = names[i] if i < len(names) else slot
+                raise FloatingPointError(
+                    "check_nan_inf: op '%s' produced non-finite output "
+                    "'%s'" % (op.type, name))
 
 
 class _ShapeOf:

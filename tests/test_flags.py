@@ -1,0 +1,101 @@
+"""Flag registry tests (reference config surface: gflags DEFINE_* +
+python/paddle/fluid/__init__.py:114-134 read_env_flags allowlist)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu import flags
+
+
+def test_set_get_and_types():
+    assert fluid.get_flags("check_nan_inf") == {"check_nan_inf": False}
+    fluid.set_flags({"check_nan_inf": True})
+    assert fluid.FLAGS.check_nan_inf is True
+    fluid.set_flags({"check_nan_inf": "0"})      # string coercion
+    assert fluid.FLAGS.check_nan_inf is False
+    with pytest.raises(KeyError):
+        fluid.set_flags({"no_such_flag": 1})
+    info = flags.flag_info()
+    assert "rpc_deadline" in info and info["rpc_deadline"][0] == "float"
+
+
+def test_amp_flag_wires_registry():
+    from paddle_tpu.ops.registry import amp_enabled
+    was = amp_enabled()
+    try:
+        fluid.set_flags({"use_bf16_amp": True})
+        assert amp_enabled()
+        fluid.set_flags({"use_bf16_amp": False})
+        assert not amp_enabled()
+    finally:
+        fluid.set_amp(was)
+
+
+def test_env_ingestion():
+    """PADDLE_TPU_FLAGS_* env vars override defaults at import."""
+    code = (
+        "import paddle_tpu.flags as f; "
+        "assert f.FLAGS.check_nan_inf is True, f.FLAGS.check_nan_inf; "
+        "assert f.FLAGS.rpc_deadline == 7.5; print('OK')")
+    env = dict(os.environ)
+    env["PADDLE_TPU_FLAGS_check_nan_inf"] = "true"
+    env["FLAGS_rpc_deadline"] = "7.5"           # reference-style name
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))),
+                         capture_output=True, text=True)
+    assert out.returncode == 0 and "OK" in out.stdout, out.stderr
+
+
+def test_check_nan_inf_jitted_step():
+    """Step-boundary detection in the jitted path."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.log(x)          # log(-1) -> nan
+        loss = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fluid.set_flags({"check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            exe.run(main, feed={"x": np.array([[-1.0, 2.0]], np.float32)},
+                    fetch_list=[loss])
+        # healthy values pass
+        (lv,) = exe.run(main,
+                        feed={"x": np.array([[1.0, 2.0]], np.float32)},
+                        fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(lv).flatten()[0]))
+    finally:
+        fluid.set_flags({"check_nan_inf": False})
+
+
+def test_check_nan_inf_eager_per_op_attribution():
+    """Host-op programs run eagerly: the failing op is named."""
+    import tempfile
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.log(x)
+        loss = fluid.layers.mean(y)
+        # a save op forces the eager host path
+        gb = main.global_block()
+        gb.append_op(type="save", inputs={"X": [loss.name]},
+                     outputs={},
+                     attrs={"file_path": tempfile.mktemp()},
+                     infer_shape=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fluid.set_flags({"check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError, match="op 'log'"):
+            exe.run(main, feed={"x": np.array([[-1.0, 2.0]], np.float32)},
+                    fetch_list=[loss])
+    finally:
+        fluid.set_flags({"check_nan_inf": False})

@@ -1,19 +1,30 @@
 """Profiler (reference python/paddle/fluid/profiler.py:39-:221 over
-platform/profiler.cc + CUPTI device_tracer).
+platform/profiler.cc event tables + CUPTI device_tracer +
+tools/timeline.py:115 chrome-trace conversion).
 
-TPU redesign: jax.profiler owns both host and device timelines (XPlane →
-Perfetto/TensorBoard), replacing the RecordEvent tables + CUPTI tracer +
-tools/timeline.py chrome-trace pipeline. The RAII named-region design is kept
-via profiler.scope()/RecordEvent."""
+TPU redesign: jax.profiler owns the device timeline (XPlane; also emits a
+chrome-trace JSON directly, subsuming tools/timeline.py's proto->chrome
+conversion). On top of that this module keeps the reference's *host* story:
+RecordEvent RAII spans aggregate into the sorted summary table that
+``stop_profiler(sorted_key)`` prints (profiler.cc PrintProfiler), and device
+XLA-op durations parsed from the captured trace join the same table, which
+replaces the CUPTI kernel table.
+"""
 
 import contextlib
+import glob
+import gzip
+import json
 import os
 import time
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
-           "stop_profiler", "RecordEvent"]
+           "stop_profiler", "RecordEvent", "export_chrome_tracing"]
 
 _trace_dir = None
+_tracing = False
+_host_events = {}    # name -> [calls, total_ms, min_ms, max_ms]
+_enabled = False
 
 
 @contextlib.contextmanager
@@ -24,27 +35,147 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 
 def reset_profiler():
-    pass
+    """reference profiler.py reset_profiler: clear collected events."""
+    _host_events.clear()
+
+
+def _record(name, ms):
+    e = _host_events.get(name)
+    if e is None:
+        _host_events[name] = [1, ms, ms, ms]
+    else:
+        e[0] += 1
+        e[1] += ms
+        e[2] = min(e[2], ms)
+        e[3] = max(e[3], ms)
 
 
 def start_profiler(state="All", tracer_option=None, output_dir=None):
-    global _trace_dir
+    global _trace_dir, _tracing, _enabled
     import jax
+    from ..flags import FLAGS
+    _enabled = True
+    reset_profiler()
     _trace_dir = output_dir or os.environ.get(
-        "PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
-    jax.profiler.start_trace(_trace_dir)
+        "PADDLE_TPU_TRACE_DIR", FLAGS.profiler_path)
+    try:
+        jax.profiler.start_trace(_trace_dir)
+        _tracing = True
+    except Exception:
+        _tracing = False    # host-only profiling still works
+
+
+def _device_events(trace_dir):
+    """Aggregate device XLA-op durations from the captured chrome trace
+    (the CUPTI kernel-table analogue)."""
+    out = {}
+    try:
+        files = sorted(glob.glob(os.path.join(
+            trace_dir, "plugins/profile/*/*.trace.json.gz")))
+        if not files:
+            return out
+        data = json.load(gzip.open(files[-1]))
+        events = data.get("traceEvents", [])
+        pids, tids = {}, {}
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pids[e["pid"]] = e["args"]["name"]
+            if e.get("ph") == "M" and e.get("name") == "thread_name":
+                tids[(e["pid"], e.get("tid"))] = e["args"]["name"]
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            if "TPU" not in str(pids.get(e.get("pid"), "")) and \
+                    "device" not in str(pids.get(e.get("pid"), "")).lower():
+                continue
+            if tids.get((e["pid"], e.get("tid")), "") != "XLA Ops":
+                continue
+            ms = e.get("dur", 0) / 1000.0
+            name = "xla::" + e["name"]
+            rec = out.get(name)
+            if rec is None:
+                out[name] = [1, ms, ms, ms]
+            else:
+                rec[0] += 1
+                rec[1] += ms
+                rec[2] = min(rec[2], ms)
+                rec[3] = max(rec[3], ms)
+    except Exception:
+        pass
+    return out
+
+
+_SORT_KEYS = {"calls": 0, "total": 1, "min": 2, "max": 3, "ave": 4,
+              "default": 1, None: 1}
+
+
+def _format_table(rows, sorted_key):
+    idx = _SORT_KEYS.get(sorted_key, 1)
+    total_time = sum(r[2] for r in rows) or 1.0
+    # row: (name, calls, total, min, max, ave)
+    full = [(n, c, t, mn, mx, t / c if c else 0.0)
+            for n, c, t, mn, mx in rows]
+    full.sort(key=lambda r: r[1 + idx], reverse=True)
+    lines = ["", "------------------------->     Profiling Report     "
+             "<-------------------------", "",
+             "%-44s %8s %12s %12s %12s %12s %8s" % (
+                 "Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+                 "Ave(ms)", "Ratio")]
+    for n, c, t, mn, mx, ave in full:
+        lines.append("%-44s %8d %12.4f %12.4f %12.4f %12.4f %7.4f" % (
+            n[:44], c, t, mn, mx, ave, t / total_time))
+    return "\n".join(lines)
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    """Stop tracing and print the sorted event summary table
+    (reference DisableProfiler -> PrintProfiler, platform/profiler.cc).
+    Returns the trace directory (contains the chrome-trace JSON)."""
+    global _tracing, _enabled
     import jax
-    jax.profiler.stop_trace()
+    if _tracing:
+        jax.profiler.stop_trace()
+        _tracing = False
+    if not _enabled:
+        return _trace_dir
+    _enabled = False
+    rows = [(n, e[0], e[1], e[2], e[3]) for n, e in _host_events.items()]
+    if _trace_dir:
+        rows += [(n, e[0], e[1], e[2], e[3])
+                 for n, e in _device_events(_trace_dir).items()]
+    if rows:
+        table = _format_table(rows, sorted_key)
+        print(table)
+        try:
+            with open(profile_path, "w") as f:
+                f.write(table + "\n")
+        except OSError:
+            pass
     return _trace_dir
+
+
+def export_chrome_tracing(trace_dir=None, output_path=None):
+    """tools/timeline.py:115 analogue: surface the captured trace as a
+    chrome://tracing-loadable JSON file. jax already records chrome-trace
+    JSON inside the XPlane dump; this decompresses the newest one."""
+    trace_dir = trace_dir or _trace_dir
+    if trace_dir is None:
+        raise ValueError("no trace captured; run the profiler first")
+    files = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.trace.json.gz")))
+    if not files:
+        raise FileNotFoundError("no trace.json.gz under %s" % trace_dir)
+    output_path = output_path or os.path.join(trace_dir, "timeline.json")
+    with gzip.open(files[-1], "rb") as src, open(output_path, "wb") as dst:
+        dst.write(src.read())
+    return output_path
 
 
 @contextlib.contextmanager
 def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
              tracer_option=None):
-    """with fluid.profiler.profiler(...): — wraps jax.profiler.trace."""
+    """with fluid.profiler.profiler(sorted_key="total"): ... — prints the
+    aggregated event table on exit (reference profiler.py:39)."""
     start_profiler(state, tracer_option,
                    profile_path if os.path.isdir(str(profile_path))
                    else None)
@@ -55,18 +186,23 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
 
 
 class RecordEvent:
-    """Named host-side region (reference platform/profiler.h:72 RAII marker);
-    shows up in the jax trace via TraceAnnotation."""
+    """Named host-side region (reference platform/profiler.h:72 RAII
+    marker): aggregates into the profiler table and annotates the jax
+    device trace."""
 
     def __init__(self, name):
         self.name = name
         self._ctx = None
+        self._t0 = None
 
     def __enter__(self):
         import jax
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
+        self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *args):
+        if self._t0 is not None:
+            _record(self.name, (time.perf_counter() - self._t0) * 1e3)
         self._ctx.__exit__(*args)

@@ -1,0 +1,69 @@
+"""Profiler tests (reference profiler.cc event tables printed by
+DisableProfiler; tools/timeline.py chrome-trace export)."""
+
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+
+
+def _tiny_run():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=4)
+        loss = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+            fetch_list=[loss])
+
+
+def test_profiler_sorted_table(tmp_path, capsys):
+    report = tmp_path / "profile.txt"
+    with fluid.profiler.profiler(sorted_key="total",
+                                 profile_path=str(report)):
+        with fluid.profiler.RecordEvent("forward_and_fetch"):
+            _tiny_run()
+        with fluid.profiler.RecordEvent("forward_and_fetch"):
+            _tiny_run()
+    out = capsys.readouterr().out
+    assert "Profiling Report" in out
+    assert "forward_and_fetch" in out
+    # aggregated: 2 calls on one row
+    row = [l for l in out.splitlines() if "forward_and_fetch" in l][0]
+    assert row.split()[1] == "2"
+    assert report.exists() and "forward_and_fetch" in report.read_text()
+
+
+def test_start_stop_and_timeline_export(tmp_path, capsys):
+    trace_dir = str(tmp_path / "trace")
+    fluid.profiler.start_profiler("All", output_dir=trace_dir)
+    with fluid.profiler.RecordEvent("step"):
+        _tiny_run()
+    fluid.profiler.stop_profiler(sorted_key="max",
+                                 profile_path=str(tmp_path / "p.txt"))
+    out = capsys.readouterr().out
+    assert "step" in out
+    # chrome-trace export (tools/timeline.py analogue)
+    try:
+        path = fluid.profiler.export_chrome_tracing(trace_dir)
+    except FileNotFoundError:
+        return  # device tracing unavailable on this backend — table-only
+    data = json.load(open(path))
+    assert "traceEvents" in data
+
+
+def test_reset_profiler():
+    fluid.profiler.start_profiler("All", output_dir=None)
+    with fluid.profiler.RecordEvent("r1"):
+        pass
+    fluid.profiler.reset_profiler()
+    fluid.profiler.stop_profiler()
+    # after reset, the r1 event is gone (no output assertion needed; just
+    # ensure the internal table is empty)
+    from paddle_tpu.fluid.profiler import _host_events
+    assert "r1" not in _host_events

@@ -1,0 +1,32 @@
+"""Chrome-trace timeline exporter CLI (reference tools/timeline.py:115
+Timeline — converted the profiler proto to chrome://tracing JSON; here the
+jax trace already contains chrome-trace JSON, so this locates and unpacks
+the newest capture).
+
+Usage:
+    python tools/timeline.py --profile_dir /tmp/paddle_tpu_profile \
+        --timeline_path /tmp/timeline.json
+Then open chrome://tracing and load the output file.
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_dir", required=True,
+                    help="directory passed to the profiler / start_profiler")
+    ap.add_argument("--timeline_path", default=None,
+                    help="output .json path (default: <dir>/timeline.json)")
+    args = ap.parse_args()
+    from paddle_tpu.fluid import profiler
+    out = profiler.export_chrome_tracing(args.profile_dir,
+                                         args.timeline_path)
+    print("chrome-trace timeline written to %s" % out)
+
+
+if __name__ == "__main__":
+    main()

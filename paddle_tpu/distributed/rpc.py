@@ -65,6 +65,27 @@ def deserialize_array(msg):
         .reshape(msg["shape"]).copy()
 
 
+def wait_server_ready(endpoints, timeout=60.0):
+    """Block until every endpoint accepts TCP connections (reference
+    transpiler/details/checkport.py:21 — trainers poll pserver ports
+    instead of racing the server's bind)."""
+    import time
+    deadline = time.monotonic() + timeout
+    pending = list(endpoints)
+    while pending:
+        ep = pending[0]
+        host, port = ep.rsplit(":", 1)
+        try:
+            s = socket.create_connection((host, int(port)), timeout=1.0)
+            s.close()
+            pending.pop(0)
+        except OSError:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "server %s not ready within %.0fs" % (ep, timeout))
+            time.sleep(0.05)
+
+
 class VariableServer:
     """One pserver endpoint: a variable store + sync barrier loop.
 

@@ -1,0 +1,129 @@
+"""Convergence-parity harness (reference
+parallel_executor_test_base.py:31 TestParallelExecutorBase.
+check_network_convergence + test_dist_base.py loss-delta checks).
+
+The north-star convergence requirement: the SAME model trained under
+different execution strategies — single-device Executor, multi-device
+ParallelExecutor, parameter-server distribution — must follow the SAME
+per-step loss trajectory (identical seeds/feeds), not merely "loss goes
+down"."""
+
+import threading
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def run_executor(build_fn, feeds, loss_getter, steps):
+    """Single-device baseline trajectory."""
+    with fluid.unique_name.guard():
+        main, startup, loss = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for i in range(steps):
+            (lv,) = exe.run(main, feed=feeds[i], fetch_list=[loss])
+            losses.append(float(np.asarray(lv).flatten()[0]))
+    return losses
+
+
+def run_parallel_executor(build_fn, feeds, loss_getter, steps):
+    """ParallelExecutor over every virtual device (conftest forces 8 CPU
+    devices); full global batch fed, split across devices."""
+    with fluid.unique_name.guard():
+        main, startup, loss = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    main_program=main)
+        assert pe.device_count > 1, "need a multi-device mesh"
+        for i in range(steps):
+            (lv,) = pe.run(fetch_list=[loss.name], feed=feeds[i])
+            losses.append(float(np.asarray(lv).flatten()[0]))
+    return losses
+
+
+def run_pserver_dist(build_fn, feeds, loss_getter, steps, endpoint,
+                     n_trainers=2):
+    """Sync parameter-server cluster, in-process (1 pserver, n trainers
+    splitting each global batch). Returns the mean per-step trainer loss."""
+    from paddle_tpu.fluid.transpiler import DistributeTranspiler
+    from paddle_tpu.distributed.rpc import wait_server_ready, global_client
+
+    with fluid.unique_name.guard():
+        main, startup, loss = build_fn()
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=endpoint,
+                trainers=n_trainers, startup_program=startup)
+    ps_prog = t.get_pserver_program(endpoint)
+    ps_startup = t.get_startup_program(endpoint, ps_prog,
+                                       startup_program=startup)
+    trainer_prog = t.get_trainer_program()
+
+    server_exc = []
+
+    def run_pserver():
+        try:
+            exe = fluid.Executor(fluid.CPUPlace())
+            with fluid.scope_guard(fluid.Scope()):
+                exe.run(ps_startup)
+                exe.run(ps_prog)
+        except Exception as e:      # pragma: no cover
+            server_exc.append(e)
+
+    th = threading.Thread(target=run_pserver, daemon=True)
+    th.start()
+    wait_server_ready([endpoint])
+
+    results = [[] for _ in range(n_trainers)]
+
+    def run_trainer(tid):
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            for i in range(steps):
+                feed = {}
+                for name, arr in feeds[i].items():
+                    n = arr.shape[0] // n_trainers
+                    feed[name] = arr[tid * n:(tid + 1) * n]
+                (lv,) = exe.run(trainer_prog, feed=feed, fetch_list=[loss])
+                results[tid].append(float(np.asarray(lv).flatten()[0]))
+
+    threads = [threading.Thread(target=run_trainer, args=(tid,),
+                                daemon=True) for tid in range(1, n_trainers)]
+    for th2 in threads:
+        th2.start()
+    run_trainer(0)
+    for th2 in threads:
+        th2.join(timeout=120)
+    global_client().send_exit(endpoint)
+    th.join(timeout=10)
+    assert not server_exc, server_exc
+    return [float(np.mean([results[t][i] for t in range(n_trainers)]))
+            for i in range(steps)]
+
+
+def check_network_convergence(build_fn, feeds, steps=4, delta=1e-5,
+                              pserver_endpoint=None, ps_delta=1e-3):
+    """Compare per-step loss trajectories across strategies.
+
+    build_fn() -> (main, startup, loss); must build deterministically
+    (seeded initializers) so every strategy starts from identical params.
+    feeds: list of per-step full-batch feed dicts.
+    """
+    local = run_executor(build_fn, feeds, None, steps)
+    pe = run_parallel_executor(build_fn, feeds, None, steps)
+    np.testing.assert_allclose(local, pe, atol=delta, err_msg=
+                               "Executor vs ParallelExecutor diverged")
+    if pserver_endpoint is not None:
+        # step 0's loss is computed from identical init params in both
+        # runs; later steps see PS-updated params
+        ps = run_pserver_dist(build_fn, feeds, None, steps,
+                              pserver_endpoint)
+        np.testing.assert_allclose(local, ps, atol=ps_delta, err_msg=
+                                   "Executor vs pserver run diverged")
+    return local

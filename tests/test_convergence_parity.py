@@ -1,0 +1,58 @@
+"""Convergence parity across execution strategies (reference
+test_parallel_executor_mnist.py / test_parallel_executor_seresnext.py via
+TestParallelExecutorBase.check_network_convergence, and
+test_dist_mnist.py:26 check_with_place)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from convergence_base import check_network_convergence
+
+
+def _mnist_build():
+    from paddle_tpu.models import mnist
+    main, startup, feeds, loss, acc, predict = mnist.get_model(
+        batch_size=16, lr=0.01, use_adam=False)
+    return main, startup, loss
+
+
+def _mnist_feeds(steps, global_bs=16):
+    rng = np.random.RandomState(5)
+    out = []
+    for _ in range(steps):
+        out.append({
+            "pixel": rng.randn(global_bs, 1, 28, 28).astype(np.float32),
+            "label": rng.randint(0, 10, (global_bs, 1)).astype(np.int64),
+        })
+    return out
+
+
+def test_mnist_convergence_parity():
+    losses = check_network_convergence(
+        _mnist_build, _mnist_feeds(4), steps=4, delta=1e-5,
+        pserver_endpoint="127.0.0.1:6298")
+    assert np.isfinite(losses).all()
+
+
+def _se_resnext_build():
+    from paddle_tpu.models import se_resnext
+    main, startup, feeds, loss, acc, prob = se_resnext.get_model(
+        batch_size=8, class_dim=8, layers=50, img_size=32, lr=0.01)
+    return main, startup, loss
+
+
+def _se_resnext_feeds(steps, global_bs=8):
+    rng = np.random.RandomState(6)
+    out = []
+    for _ in range(steps):
+        out.append({
+            "data": rng.randn(global_bs, 3, 32, 32).astype(np.float32),
+            "label": rng.randint(0, 8, (global_bs, 1)).astype(np.int64),
+        })
+    return out
+
+
+def test_se_resnext_convergence_parity():
+    losses = check_network_convergence(
+        _se_resnext_build, _se_resnext_feeds(3), steps=3, delta=1e-4)
+    assert np.isfinite(losses).all()

@@ -209,8 +209,8 @@ class TestDistTrainingParity:
 
         th = threading.Thread(target=run_pserver, daemon=True)
         th.start()
-        import time
-        time.sleep(0.3)
+        from paddle_tpu.distributed.rpc import wait_server_ready
+        wait_server_ready(["127.0.0.1:6199"])
 
         trainer_prog = t.get_trainer_program()
 

@@ -126,11 +126,15 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           act=None, name=None):
+           act=None, name=None, data_format="NCHW"):
+    """data_format: NCHW (fluid default) or NHWC (TPU-preferred channels-
+    last — keeps the channel dim in the lane dimension so BN/elementwise
+    epilogues fuse efficiently). Filter params are OIHW in either case."""
     helper = LayerHelper("conv2d", param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     dtype = input.dtype
-    num_channels = input.shape[1]
+    num_channels = input.shape[1] if data_format == "NCHW" \
+        else input.shape[-1]
     groups = groups or 1
     if isinstance(filter_size, int):
         filter_size = [filter_size, filter_size]
@@ -156,8 +160,11 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         inputs={"Input": input, "Filter": w},
         outputs={"Output": pre_bias},
         attrs={"strides": stride, "paddings": padding, "dilations": dilation,
-               "groups": groups, "use_cudnn": use_cudnn})
-    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+               "groups": groups, "use_cudnn": use_cudnn,
+               "data_format": data_format})
+    c_dim = 1 if data_format == "NCHW" else 3
+    pre_act = helper.append_bias_op(pre_bias, dim_start=c_dim,
+                                    dim_end=c_dim + 1)
     return helper.append_activation(pre_act)
 
 
@@ -229,7 +236,7 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, exclusive=True, name=None):
+           ceil_mode=False, exclusive=True, name=None, data_format="NCHW"):
     helper = LayerHelper("pool2d", name=name)
     if isinstance(pool_size, int):
         pool_size = [pool_size, pool_size]
@@ -243,7 +250,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
         attrs={"pooling_type": pool_type, "ksize": pool_size,
                "strides": pool_stride, "paddings": pool_padding,
                "global_pooling": global_pooling, "ceil_mode": ceil_mode,
-               "exclusive": exclusive})
+               "exclusive": exclusive, "data_format": data_format})
     return out
 
 
@@ -288,7 +295,8 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
         outputs={"Y": out, "MeanOut": mean, "VarianceOut": variance,
                  "SavedMean": saved_mean, "SavedVariance": saved_var},
         attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
-               "use_global_stats": use_global_stats})
+               "use_global_stats": use_global_stats,
+               "data_layout": data_layout})
     return helper.append_activation(out)
 
 

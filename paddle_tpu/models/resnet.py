@@ -5,94 +5,121 @@ flagship config: ResNet-50 batch 256) — conv7x7/2 + maxpool3/2 + bottleneck
 stacks [3,4,6,3] + global avgpool + fc, batch-norm after every conv,
 piecewise-decay Momentum training. Built from paddle_tpu layers; on TPU every
 conv+bn+relu chain fuses into MXU convolutions with fused epilogues.
+
+`layout` selects the activation layout: NCHW matches the reference feed
+contract; NHWC is the TPU-native channels-last layout (channel dim lives in
+the lane dimension of the (8,128) tile, so BN stat reductions and the
+BN/relu/add epilogues stay lane-aligned instead of reducing across lanes).
+Parameters are layout-independent (filters OIHW) — only activations and the
+`data` feed change shape.
 """
 
 import paddle_tpu.fluid as fluid
 
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
-                  is_train=True):
+                  is_train=True, layout="NCHW"):
     conv1 = fluid.layers.conv2d(
         input=input, filter_size=filter_size, num_filters=ch_out,
-        stride=stride, padding=padding, act=None, bias_attr=False)
-    return fluid.layers.batch_norm(input=conv1, act=act, is_test=not is_train)
+        stride=stride, padding=padding, act=None, bias_attr=False,
+        data_format=layout)
+    return fluid.layers.batch_norm(input=conv1, act=act,
+                                   is_test=not is_train, data_layout=layout)
 
 
-def shortcut(input, ch_out, stride, is_train=True):
-    ch_in = input.shape[1]
+def _channels(v, layout):
+    return v.shape[1] if layout == "NCHW" else v.shape[-1]
+
+
+def shortcut(input, ch_out, stride, is_train=True, layout="NCHW"):
+    ch_in = _channels(input, layout)
     if ch_in != ch_out or stride != 1:
         return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
-                             is_train=is_train)
+                             is_train=is_train, layout=layout)
     return input
 
 
-def bottleneck_block(input, num_filters, stride, is_train=True):
-    conv0 = conv_bn_layer(input, num_filters, 1, 1, 0, is_train=is_train)
+def bottleneck_block(input, num_filters, stride, is_train=True,
+                     layout="NCHW"):
+    conv0 = conv_bn_layer(input, num_filters, 1, 1, 0, is_train=is_train,
+                          layout=layout)
     conv1 = conv_bn_layer(conv0, num_filters, 3, stride, 1,
-                          is_train=is_train)
+                          is_train=is_train, layout=layout)
     conv2 = conv_bn_layer(conv1, num_filters * 4, 1, 1, 0, act=None,
-                          is_train=is_train)
-    short = shortcut(input, num_filters * 4, stride, is_train=is_train)
+                          is_train=is_train, layout=layout)
+    short = shortcut(input, num_filters * 4, stride, is_train=is_train,
+                     layout=layout)
     return fluid.layers.elementwise_add(x=short, y=conv2, act="relu")
 
 
-def basic_block(input, num_filters, stride, is_train=True):
+def basic_block(input, num_filters, stride, is_train=True, layout="NCHW"):
     conv0 = conv_bn_layer(input, num_filters, 3, stride, 1,
-                          is_train=is_train)
+                          is_train=is_train, layout=layout)
     conv1 = conv_bn_layer(conv0, num_filters, 3, 1, 1, act=None,
-                          is_train=is_train)
-    short = shortcut(input, num_filters, stride, is_train=is_train)
+                          is_train=is_train, layout=layout)
+    short = shortcut(input, num_filters, stride, is_train=is_train,
+                     layout=layout)
     return fluid.layers.elementwise_add(x=short, y=conv1, act="relu")
 
 
-def resnet_imagenet(input, class_dim=1000, depth=50, is_train=True):
+def resnet_imagenet(input, class_dim=1000, depth=50, is_train=True,
+                    layout="NCHW"):
     cfg = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}[depth]
-    conv = conv_bn_layer(input, 64, 7, 2, 3, is_train=is_train)
+    conv = conv_bn_layer(input, 64, 7, 2, 3, is_train=is_train,
+                         layout=layout)
     pool = fluid.layers.pool2d(input=conv, pool_size=3, pool_stride=2,
-                               pool_padding=1, pool_type="max")
+                               pool_padding=1, pool_type="max",
+                               data_format=layout)
     res = pool
     for stage, count in enumerate(cfg):
         num_filters = 64 * (2 ** stage)
         for i in range(count):
             stride = 2 if i == 0 and stage > 0 else 1
             res = bottleneck_block(res, num_filters, stride,
-                                   is_train=is_train)
+                                   is_train=is_train, layout=layout)
     pool = fluid.layers.pool2d(input=res, pool_type="avg",
-                               global_pooling=True)
+                               global_pooling=True, data_format=layout)
     out = fluid.layers.fc(input=pool, size=class_dim, act="softmax")
     return out
 
 
-def resnet_cifar10(input, class_dim=10, depth=32, is_train=True):
+def resnet_cifar10(input, class_dim=10, depth=32, is_train=True,
+                   layout="NCHW"):
     assert (depth - 2) % 6 == 0
     n = (depth - 2) // 6
-    conv = conv_bn_layer(input, 16, 3, 1, 1, is_train=is_train)
+    conv = conv_bn_layer(input, 16, 3, 1, 1, is_train=is_train,
+                         layout=layout)
     res = conv
     for stage in range(3):
         num_filters = 16 * (2 ** stage)
         for i in range(n):
             stride = 2 if i == 0 and stage > 0 else 1
-            res = basic_block(res, num_filters, stride, is_train=is_train)
+            res = basic_block(res, num_filters, stride, is_train=is_train,
+                              layout=layout)
     pool = fluid.layers.pool2d(input=res, pool_type="avg",
-                               global_pooling=True)
+                               global_pooling=True, data_format=layout)
     out = fluid.layers.fc(input=pool, size=class_dim, act="softmax")
     return out
 
 
 def get_model(batch_size=256, class_dim=1000, depth=50, dataset="imagenet",
-              lr=0.1, is_train=True, dtype="float32"):
+              lr=0.1, is_train=True, dtype="float32", layout="NCHW"):
     """(main, startup, feeds, loss, acc, predict) — mirrors the benchmark
-    harness contract (fluid_benchmark.py get_model)."""
+    harness contract (fluid_benchmark.py get_model). With layout="NHWC" the
+    `data` feed is channels-last ([H, W, 3])."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         if dataset == "imagenet":
-            image_shape = [3, 224, 224]
+            hw = 224
             model_fn = lambda im: resnet_imagenet(
-                im, class_dim=class_dim, depth=depth, is_train=is_train)
+                im, class_dim=class_dim, depth=depth, is_train=is_train,
+                layout=layout)
         else:
-            image_shape = [3, 32, 32]
+            hw = 32
             model_fn = lambda im: resnet_cifar10(
-                im, class_dim=class_dim, depth=depth, is_train=is_train)
+                im, class_dim=class_dim, depth=depth, is_train=is_train,
+                layout=layout)
+        image_shape = [3, hw, hw] if layout == "NCHW" else [hw, hw, 3]
         image = fluid.layers.data(name="data", shape=image_shape,
                                   dtype=dtype)
         label = fluid.layers.data(name="label", shape=[1], dtype="int64")

@@ -15,11 +15,21 @@ import paddle_tpu.fluid as fluid
 
 
 def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
-                  act=None, is_train=True):
+                  act=None, is_train=True, remove_bn=False):
     conv = fluid.layers.conv2d(
         input=input, num_filters=num_filters, filter_size=filter_size,
         stride=stride, padding=(filter_size - 1) // 2, groups=groups,
-        act=None, bias_attr=False)
+        act=act if remove_bn else None, bias_attr=False)
+    if remove_bn:
+        # reference test_parallel_executor_seresnext.py:38 `remove_bn`:
+        # the Executor-vs-ParallelExecutor convergence comparison drops BN
+        # because cross-replica stat reassociation makes deep BN stacks
+        # numerically chaotic (the reference's FIXME(zcd) comment).
+        # Deviation: the reference also drops `act` here (returning the
+        # bare conv, a mostly-linear net); we KEEP the activation so the
+        # parity comparison exercises a fully nonlinear model — a stricter
+        # check than the reference's.
+        return conv
     return fluid.layers.batch_norm(input=conv, act=act, is_test=not is_train)
 
 
@@ -36,29 +46,32 @@ def squeeze_excitation(input, num_channels, reduction_ratio):
     return fluid.layers.elementwise_mul(x=input, y=excitation)
 
 
-def shortcut(input, ch_out, stride, is_train=True):
+def shortcut(input, ch_out, stride, is_train=True, remove_bn=False):
     ch_in = input.shape[1]
     if ch_in != ch_out or stride != 1:
         filter_size = 1
         return conv_bn_layer(input, ch_out, filter_size, stride,
-                             is_train=is_train)
+                             is_train=is_train, remove_bn=remove_bn)
     return input
 
 
 def bottleneck_block(input, num_filters, stride, cardinality,
-                     reduction_ratio, is_train=True):
+                     reduction_ratio, is_train=True, remove_bn=False):
     conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
-                          is_train=is_train)
+                          is_train=is_train, remove_bn=remove_bn)
     conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride,
-                          groups=cardinality, act="relu", is_train=is_train)
+                          groups=cardinality, act="relu", is_train=is_train,
+                          remove_bn=remove_bn)
     conv2 = conv_bn_layer(conv1, num_filters * 2, 1, act=None,
-                          is_train=is_train)
+                          is_train=is_train, remove_bn=remove_bn)
     scale = squeeze_excitation(conv2, num_filters * 2, reduction_ratio)
-    short = shortcut(input, num_filters * 2, stride, is_train=is_train)
+    short = shortcut(input, num_filters * 2, stride, is_train=is_train,
+                     remove_bn=remove_bn)
     return fluid.layers.elementwise_add(x=short, y=scale, act="relu")
 
 
-def build(img, layers=50, class_dim=1000, is_train=True):
+def build(img, layers=50, class_dim=1000, is_train=True, remove_bn=False,
+          remove_dropout=False):
     """img [N, 3, H, W] -> logits [N, class_dim] (pre-softmax fc)."""
     # cardinality per depth matches dist_se_resnext.py:60,:78,:96 —
     # 32 groups for SE-ResNeXt-50/101, 64 for 152
@@ -70,38 +83,48 @@ def build(img, layers=50, class_dim=1000, is_train=True):
 
     if layers == 152:
         conv = conv_bn_layer(img, 64, 3, stride=2, act="relu",
-                             is_train=is_train)
-        conv = conv_bn_layer(conv, 64, 3, act="relu", is_train=is_train)
-        conv = conv_bn_layer(conv, 128, 3, act="relu", is_train=is_train)
+                             is_train=is_train, remove_bn=remove_bn)
+        conv = conv_bn_layer(conv, 64, 3, act="relu", is_train=is_train,
+                             remove_bn=remove_bn)
+        conv = conv_bn_layer(conv, 128, 3, act="relu", is_train=is_train,
+                             remove_bn=remove_bn)
     else:
         conv = conv_bn_layer(img, 64, 7, stride=2, act="relu",
-                             is_train=is_train)
+                             is_train=is_train, remove_bn=remove_bn)
     conv = fluid.layers.pool2d(input=conv, pool_size=3, pool_stride=2,
                                pool_padding=1, pool_type="max")
     for block in range(len(depth)):
         for i in range(depth[block]):
             conv = bottleneck_block(
                 conv, num_filters[block], 2 if i == 0 and block != 0 else 1,
-                cardinality, reduction_ratio, is_train=is_train)
+                cardinality, reduction_ratio, is_train=is_train,
+                remove_bn=remove_bn)
     pool = fluid.layers.pool2d(input=conv, pool_type="avg",
                                global_pooling=True)
     pool = fluid.layers.reshape(pool, [-1, pool.shape[1]])
-    drop = fluid.layers.dropout(pool, dropout_prob=0.2,
-                                is_test=not is_train)
+    if remove_dropout:
+        # reference test_parallel_executor_seresnext.py:34 `remove_dropout`
+        drop = pool
+    else:
+        drop = fluid.layers.dropout(pool, dropout_prob=0.2,
+                                    is_test=not is_train)
     return fluid.layers.fc(input=drop, size=class_dim)
 
 
 def get_model(batch_size=32, class_dim=1000, layers=50, img_size=224,
-              lr=0.1, is_train=True):
+              lr=0.1, is_train=True, remove_bn=False, remove_dropout=False):
     """Training program mirroring dist_se_resnext.py get_model: Momentum +
-    piecewise decay + L2."""
+    piecewise decay + L2. remove_bn/remove_dropout mirror the reference's
+    test_parallel_executor_seresnext.py globals (:34,:38) used by its
+    Executor-vs-ParallelExecutor convergence comparison."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         img = fluid.layers.data("data", shape=[3, img_size, img_size],
                                 dtype="float32")
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         logits = build(img, layers=layers, class_dim=class_dim,
-                       is_train=is_train)
+                       is_train=is_train, remove_bn=remove_bn,
+                       remove_dropout=remove_dropout)
         prob = fluid.layers.softmax(logits)
         loss = fluid.layers.cross_entropy(input=prob, label=label)
         avg_loss = fluid.layers.mean(loss)

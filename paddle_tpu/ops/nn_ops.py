@@ -31,6 +31,15 @@ def _pair(v, n=2):
 # convolution (conv_op.cc; cudnn variant conv_cudnn_op.cu)
 # ---------------------------------------------------------------------------
 
+def _img_layout(ctx):
+    """Activation layout attr: NCHW (fluid default) or NHWC (TPU-preferred,
+    channels-last — BN/elementwise chains keep the channel dim in the lane
+    dimension of the (8,128) tile, reference conv_op.cc `data_format` /
+    batch_norm_op.cc `data_layout`)."""
+    return ctx.attr("data_format", None) or ctx.attr("data_layout", None) \
+        or "NCHW"
+
+
 @register_op("conv2d")
 def _conv2d(ctx):
     import jax
@@ -39,6 +48,9 @@ def _conv2d(ctx):
     pads = _pair(ctx.attr("paddings", [0, 0]))
     dilations = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1) or 1
+    layout = _img_layout(ctx)
+    # filters stay OIHW in either layout so parameters/checkpoints are
+    # layout-independent; XLA transposes once during layout assignment.
     # NOTE: no explicit preferred_element_type — the TPU MXU already
     # accumulates bf16 inputs in fp32 internally, and an explicit fp32
     # output type breaks jax's conv transpose rule under AMP (the f32
@@ -47,10 +59,11 @@ def _conv2d(ctx):
         x, w, window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations, feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        dimension_numbers=(layout, "OIHW", layout))
     out = out.astype(x.dtype)
     if ctx.has_input("Bias"):
-        out = out + ctx.input("Bias").reshape((1, -1, 1, 1))
+        bshape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)
+        out = out + ctx.input("Bias").reshape(bshape)
     return {"Output": out}
 
 
@@ -120,22 +133,30 @@ def _pool2d(ctx):
     strides = _pair(ctx.attr("strides", [1, 1]))
     pads = _pair(ctx.attr("paddings", [0, 0]))
     ceil_mode = bool(ctx.attr("ceil_mode", False))
+    layout = _img_layout(ctx)
+    hw = (2, 3) if layout == "NCHW" else (1, 2)
     if ctx.attr("global_pooling", False):
-        ksize = (x.shape[2], x.shape[3])
+        ksize = (x.shape[hw[0]], x.shape[hw[1]])
         strides = ksize
         pads = (0, 0)
         ceil_mode = False
     if ctx.attr("adaptive", False) and tuple(ksize) == (1, 1):
         # adaptive 1x1 == global pooling
-        ksize = (x.shape[2], x.shape[3])
+        ksize = (x.shape[hw[0]], x.shape[hw[1]])
         strides, pads = ksize, (0, 0)
         ceil_mode = False
-    window = (1, 1) + ksize
-    stride = (1, 1) + strides
-    extras = [ceil_extra_pad(x.shape[2 + i], ksize[i], strides[i], pads[i])
+    extras = [ceil_extra_pad(x.shape[hw[i]], ksize[i], strides[i], pads[i])
               if ceil_mode else 0 for i in range(2)]
-    padding = ((0, 0), (0, 0), (pads[0], pads[0] + extras[0]),
-               (pads[1], pads[1] + extras[1]))
+    if layout == "NCHW":
+        window = (1, 1) + ksize
+        stride = (1, 1) + strides
+        padding = ((0, 0), (0, 0), (pads[0], pads[0] + extras[0]),
+                   (pads[1], pads[1] + extras[1]))
+    else:
+        window = (1,) + ksize + (1,)
+        stride = (1,) + strides + (1,)
+        padding = ((0, 0), (pads[0], pads[0] + extras[0]),
+                   (pads[1], pads[1] + extras[1]), (0, 0))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
@@ -169,8 +190,11 @@ def _batch_norm(ctx):
     eps = ctx.attr("epsilon", 1e-5)
     momentum = ctx.attr("momentum", 0.9)
     is_test = ctx.attr("is_test", False)
-    axes = tuple(i for i in range(x.ndim) if i != 1)
-    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    # NHWC: channel is the LAST dim at any rank (reference batch_norm_op.cc
+    # uses data_layout to pick dim C for both 3-d and 4-d inputs)
+    c_axis = (x.ndim - 1) if _img_layout(ctx) == "NHWC" else 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = tuple(-1 if i == c_axis else 1 for i in range(x.ndim))
     if is_test or ctx.attr("use_global_stats", False):
         use_mean, use_var = mean, var
         saved_mean = mean

@@ -1,0 +1,71 @@
+"""Debug: diff per-variable state after one PE vs Executor step."""
+import os
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("CPU_NUM", "8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import paddle_tpu.fluid as fluid
+
+
+def build():
+    from paddle_tpu.models import se_resnext
+    main, startup, feeds, loss, acc, prob = se_resnext.get_model(
+        batch_size=8, class_dim=8, layers=50, img_size=32, lr=0.01)
+    return main, startup, loss
+
+
+rng = np.random.RandomState(6)
+feed = {
+    "data": rng.randn(8, 3, 32, 32).astype(np.float32),
+    "label": rng.randint(0, 8, (8, 1)).astype(np.int64),
+}
+
+# Executor path
+with fluid.unique_name.guard():
+    main, startup, loss = build()
+exe = fluid.Executor(fluid.CPUPlace())
+scope1 = fluid.Scope()
+with fluid.scope_guard(scope1):
+    exe.run(startup)
+    (l1,) = exe.run(main, feed=feed, fetch_list=[loss])
+print("executor loss:", l1)
+
+# PE path — SAME program objects, fresh scope
+scope2 = fluid.Scope()
+with fluid.scope_guard(scope2):
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(startup)
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                main_program=main)
+    (l2,) = pe.run(fetch_list=[loss.name], feed=feed)
+print("pe loss:", l2)
+
+diffs = []
+for name in sorted(scope1.keys()):
+    a = scope1.get(name)
+    b = scope2.get(name)
+    if a is None or b is None:
+        if (a is None) != (b is None):
+            print("MISSING:", name, a is None, b is None)
+        continue
+    a, b = np.asarray(a), np.asarray(b)
+    if a.shape != b.shape:
+        print("SHAPE MISMATCH:", name, a.shape, b.shape)
+        continue
+    if a.dtype.kind not in "fc":
+        if not np.array_equal(a, b):
+            print("INT DIFF:", name, a.ravel()[:4], b.ravel()[:4])
+        continue
+    d = float(np.max(np.abs(a.astype(np.float64) - b.astype(np.float64))))
+    rel = d / (float(np.max(np.abs(a))) + 1e-12)
+    diffs.append((d, rel, name))
+
+diffs.sort(reverse=True)
+print("\ntop-30 absolute state diffs after 1 step:")
+for d, rel, name in diffs[:30]:
+    print("  %.3e (rel %.3e)  %s" % (d, rel, name))

@@ -1,0 +1,69 @@
+"""Isolate: same step_fn, same state — only feed sharding differs."""
+import os
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("CPU_NUM", "8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import functionalizer
+from paddle_tpu.parallel.mesh import data_parallel_mesh, DATA_AXIS
+
+from paddle_tpu.models import se_resnext
+
+with fluid.unique_name.guard():
+    main, startup, _, loss, acc, prob = se_resnext.get_model(
+        batch_size=8, class_dim=8, layers=50, img_size=32, lr=0.01)
+
+rng = np.random.RandomState(6)
+feed_np = {
+    "data": rng.randn(8, 3, 32, 32).astype(np.float32),
+    "label": rng.randint(0, 8, (8, 1)).astype(np.int32),
+}
+
+exe = fluid.Executor(fluid.CPUPlace())
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe.run(startup)
+    state = {n: scope.get(n)
+             for n in functionalizer.persistable_names(main)
+             if scope.get(n) is not None}
+
+persistables = tuple(functionalizer.persistable_names(main))
+step_fn = functionalizer.build_step_fn(
+    main, ("data", "label"), (loss.name,), persistables)
+jfn = jax.jit(step_fn)
+
+mesh = data_parallel_mesh(use_cuda=False)
+def bshard(ndim):
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+rep = NamedSharding(mesh, P())
+
+feeds_plain = {k: jnp.asarray(v) for k, v in feed_np.items()}
+feeds_shard = {k: jax.device_put(v, bshard(np.asarray(v).ndim))
+               for k, v in feed_np.items()}
+state_rep = {k: jax.device_put(np.asarray(v), rep) for k, v in state.items()}
+
+(f1, s1) = jfn(state, feeds_plain, np.uint32(0))
+(f2, s2) = jfn(state_rep, feeds_shard, np.uint32(0))
+print("loss plain  :", float(np.asarray(f1[0]).ravel()[0]))
+print("loss sharded:", float(np.asarray(f2[0]).ravel()[0]))
+
+diffs = []
+for n in s1:
+    a, b = np.asarray(s1[n]), np.asarray(s2[n])
+    if a.dtype.kind != "f":
+        continue
+    d = float(np.max(np.abs(a.astype(np.float64) - b.astype(np.float64))))
+    rel = d / (float(np.max(np.abs(a))) + 1e-12)
+    diffs.append((d, rel, n))
+diffs.sort(reverse=True)
+print("top-15 diffs (same jitted fn, sharding only):")
+for d, rel, n in diffs[:15]:
+    print("  %.3e (rel %.3e)  %s" % (d, rel, n))

@@ -1,0 +1,69 @@
+"""Measure BN-kept Executor-vs-sharded trajectory at small lr (chaos bound)."""
+import os
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("CPU_NUM", "8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import functionalizer
+from paddle_tpu.parallel.mesh import data_parallel_mesh, DATA_AXIS
+from paddle_tpu.models import se_resnext
+
+LR = 1e-4
+STEPS = 5
+
+with fluid.unique_name.guard():
+    main, startup, _, loss, acc, prob = se_resnext.get_model(
+        batch_size=8, class_dim=8, layers=50, img_size=32, lr=LR)
+
+rng = np.random.RandomState(6)
+feeds_np = [{
+    "data": rng.randn(8, 3, 32, 32).astype(np.float32),
+    "label": rng.randint(0, 8, (8, 1)).astype(np.int32),
+} for _ in range(STEPS)]
+
+exe = fluid.Executor(fluid.CPUPlace())
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe.run(startup)
+    state0 = {n: scope.get(n)
+              for n in functionalizer.persistable_names(main)
+              if scope.get(n) is not None}
+
+persistables = tuple(functionalizer.persistable_names(main))
+step_fn = functionalizer.build_step_fn(
+    main, ("data", "label"), (loss.name,), persistables)
+jfn = jax.jit(step_fn)
+
+mesh = data_parallel_mesh(use_cuda=False)
+bshard = lambda nd: NamedSharding(mesh, P(DATA_AXIS, *([None] * (nd - 1))))
+rep = NamedSharding(mesh, P())
+
+traj = {}
+for mode in ("plain", "sharded"):
+    state = dict(state0)
+    if mode == "sharded":
+        state = {k: jax.device_put(np.asarray(v), rep)
+                 for k, v in state.items()}
+    losses = []
+    for i in range(STEPS):
+        f = feeds_np[i]
+        if mode == "sharded":
+            feeds = {k: jax.device_put(v, bshard(np.asarray(v).ndim))
+                     for k, v in f.items()}
+        else:
+            feeds = {k: jnp.asarray(v) for k, v in f.items()}
+        (fetch, state) = jfn(state, feeds, np.uint32(i))
+        losses.append(float(np.asarray(fetch[0]).ravel()[0]))
+    traj[mode] = losses
+
+print("plain  :", traj["plain"])
+print("sharded:", traj["sharded"])
+print("deltas :", [abs(a - b) for a, b in zip(traj["plain"], traj["sharded"])])

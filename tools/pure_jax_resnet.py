@@ -1,0 +1,127 @@
+"""Ceiling probe: hand-written pure-JAX ResNet-50 bf16 train step (NHWC)."""
+import sys
+import time
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def bn_train(x, scale, bias):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(0, 1, 2))
+    var = jnp.var(xf, axis=(0, 1, 2))
+    inv = jax.lax.rsqrt(var + 1e-5)
+    y = (xf - mean) * inv * scale + bias
+    return y.astype(x.dtype)
+
+
+def block(x, p, stride):
+    y = conv(x, p["w1"])
+    y = jax.nn.relu(bn_train(y, p["s1"], p["b1"]))
+    y = conv(y, p["w2"], stride)
+    y = jax.nn.relu(bn_train(y, p["s2"], p["b2"]))
+    y = conv(y, p["w3"])
+    y = bn_train(y, p["s3"], p["b3"])
+    if "wsc" in p:
+        sc = bn_train(conv(x, p["wsc"], stride), p["ssc"], p["bsc"])
+    else:
+        sc = x
+    return jax.nn.relu(y + sc)
+
+
+CFG = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2),
+       (3, 512, 2048, 2)]
+
+
+def init_params(rng):
+    p = {}
+    k = iter(jax.random.split(jax.random.key(0), 200))
+
+    def w(shape):
+        fan = np.prod(shape[:3])
+        return (jax.random.normal(next(k), shape, jnp.float32)
+                * np.sqrt(2.0 / fan))
+    p["stem_w"] = w((7, 7, 3, 64))
+    p["stem_s"] = jnp.ones((64,)); p["stem_b"] = jnp.zeros((64,))
+    cin = 64
+    for si, (n, mid, out, stride) in enumerate(CFG):
+        for bi in range(n):
+            bp = {}
+            st = stride if bi == 0 else 1
+            bp["w1"] = w((1, 1, cin, mid))
+            bp["s1"] = jnp.ones((mid,)); bp["b1"] = jnp.zeros((mid,))
+            bp["w2"] = w((3, 3, mid, mid))
+            bp["s2"] = jnp.ones((mid,)); bp["b2"] = jnp.zeros((mid,))
+            bp["w3"] = w((1, 1, mid, out))
+            bp["s3"] = jnp.ones((out,)); bp["b3"] = jnp.zeros((out,))
+            if bi == 0:
+                bp["wsc"] = w((1, 1, cin, out))
+                bp["ssc"] = jnp.ones((out,)); bp["bsc"] = jnp.zeros((out,))
+            p["s%d_b%d" % (si, bi)] = bp
+            cin = out
+    p["fc_w"] = (jax.random.normal(next(k), (2048, 1000), jnp.float32)
+                 * 0.01)
+    p["fc_b"] = jnp.zeros((1000,))
+    return p
+
+
+def forward(params, x):
+    x = x.astype(jnp.bfloat16)
+    y = conv(x, params["stem_w"].astype(jnp.bfloat16), 2)
+    y = jax.nn.relu(bn_train(y, params["stem_s"], params["stem_b"]))
+    y = jax.lax.reduce_window(y, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    cin = 64
+    for si, (n, mid, out, stride) in enumerate(CFG):
+        for bi in range(n):
+            bp = params["s%d_b%d" % (si, bi)]
+            bpc = {kk: (v.astype(jnp.bfloat16) if kk.startswith("w") else v)
+                   for kk, v in bp.items()}
+            y = block(y, bpc, stride if bi == 0 else 1)
+    y = jnp.mean(y.astype(jnp.float32), axis=(1, 2))
+    return y @ params["fc_w"] + params["fc_b"]
+
+
+def loss_fn(params, x, labels):
+    logits = forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels, axis=-1))
+
+
+@jax.jit
+def train_step(params, vel, x, labels):
+    loss, grads = jax.value_and_grad(loss_fn)(params, x, labels)
+    new_vel = jax.tree.map(lambda v, g: 0.9 * v + g, vel, grads)
+    new_params = jax.tree.map(lambda p, v: p - 0.1 * v, params, new_vel)
+    return loss, new_params, new_vel
+
+
+def main(batch=256, iters=20):
+    params = init_params(0)
+    vel = jax.tree.map(jnp.zeros_like, params)
+    rng = np.random.RandomState(0)
+    x = jax.device_put(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    lab = jax.device_put(rng.randint(0, 1000, (batch, 1)))
+    for _ in range(2):
+        loss, params, vel = train_step(params, vel, x, lab)
+    print("warm loss", float(loss))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, vel = train_step(params, vel, x, lab)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    ips = batch * iters / dt
+    print("pure-jax: %.1f img/s  %.1f TFLOP/s  %.1f%% MFU (loss %.3f)"
+          % (ips, ips * 12.3e9 / 1e12, ips * 12.3e9 / 1e12 / 1.97, final))
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 256)

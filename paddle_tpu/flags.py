@@ -140,10 +140,10 @@ def _amp_changed(v):
 
 DEFINE_bool(
     "check_nan_inf", False,
-    "Re-check op outputs for NaN/Inf (reference FLAGS_check_nan_inf, "
-    "framework/operator.cc:29). Eagerly-run programs (host-op blocks) get "
-    "per-op attribution; jitted steps are checked at the step boundary. "
-    "Combine with jax_debug_nans for primitive-level attribution.")
+    "Re-check every op output for NaN/Inf and NAME the first offending op "
+    "(reference FLAGS_check_nan_inf, framework/operator.cc:29). Forces "
+    "eager per-op execution — a debugging mode with per-op dispatch cost, "
+    "exactly like the reference's per-op re-check + sync.")
 DEFINE_bool(
     "benchmark", False,
     "Synchronize after every executor step and make timing honest "

@@ -247,31 +247,53 @@ class Executor:
         # already exists. The jit signature keys on the input dict structure.
         persistables = tuple(functionalizer.persistable_names(program))
         hkey = (id(program), program._version)
-        has_host = self._host_op_cache.get(hkey)
-        if has_host is None:
-            has_host = functionalizer.contains_host_ops(program)
-            self._host_op_cache[hkey] = has_host
-        if has_host:
-            # RPC / IO ops do host side effects — run the block eagerly
-            # (the reference ran these kernels on CPU outside any graph
-            # executor optimization; listen_and_serv blocks here just like
-            # ListenAndServOp::RunImpl did). Cached like the jitted path.
-            ekey = (hkey, feed_key, fetch_ext, persistables)
-            fn = self._cache.get(ekey)
-            if fn is None:
-                fn = functionalizer.build_step_fn(
-                    program, feed_key, fetch_ext, persistables)
-                self._cache[ekey] = fn
-        else:
-            fn = self._get_jitted(program, feed_key, fetch_ext, persistables)
-
+        cached = self._host_op_cache.get(hkey)
+        if cached is None:
+            cached = (functionalizer.contains_host_ops(program),
+                      functionalizer.has_subblock_host_ops(program))
+            self._host_op_cache[hkey] = cached
+        has_host, has_sub_host = cached
+        from ..flags import FLAGS
         state_in = {n: scope.get(n) for n in persistables
                     if scope.has(n) and scope.get(n) is not None}
         step = self._step_counters.get(id(program), 0)
         self._step_counters[id(program)] = step + 1
 
-        fetches, new_state = fn(state_in, feeds, np.uint32(step))
-        from ..flags import FLAGS
+        if FLAGS.check_nan_inf or (has_host and has_sub_host):
+            # Fully-eager interpretation, two cases:
+            # (a) check_nan_inf debugging mode: every op's output is
+            #     concrete so the first non-finite op is NAMED (reference
+            #     FLAGS_check_nan_inf, operator.cc:29, per-op-sync cost);
+            # (b) host ops buried in control-flow sub-blocks — they cannot
+            #     be partitioned out at block-0 boundaries, so the whole
+            #     block is interpreted (host ops see concrete values).
+            ekey = ("eager", hkey, feed_key, fetch_ext, persistables)
+            fn = self._cache.get(ekey)
+            if fn is None:
+                fn = functionalizer.build_step_fn(
+                    program, feed_key, fetch_ext, persistables)
+                self._cache[ekey] = fn
+            fetches, new_state = fn(state_in, feeds, np.uint32(step))
+        elif has_host:
+            # RPC / IO host ops do side effects, but the compute BETWEEN
+            # them still runs from the XLA jit cache: the segmented runner
+            # partitions the block at HOST_OPS boundaries (SURVEY §7 step
+            # 3), jits each compute segment, and interprets host ops
+            # eagerly in order (reference: ListenAndServOp/save_op kernels
+            # ran on CPU between device kernels).
+            runner = self._cache.get(("seg", hkey))
+            if runner is None:
+                runner = functionalizer.SegmentedProgramRunner(program)
+                self._cache[("seg", hkey)] = runner
+            env = {}
+            env.update(state_in)
+            env.update(feeds)
+            runner.run(env, np.uint32(step), fetch_names=fetch_ext)
+            fetches = [env.get(n) for n in fetch_ext]
+            new_state = {n: env[n] for n in persistables if n in env}
+        else:
+            fn = self._get_jitted(program, feed_key, fetch_ext, persistables)
+            fetches, new_state = fn(state_in, feeds, np.uint32(step))
         if FLAGS.benchmark:
             # reference FLAGS_benchmark: force device sync per step so
             # wall-clock timing around run() is honest (scope.cc:25)
@@ -296,6 +318,12 @@ class Executor:
             else:
                 out.append(val)
         return out
+
+    def segmented_runner(self, program):
+        """The SegmentedProgramRunner used for `program` (None if the
+        program has no host ops or hasn't run yet). Exposes cache_hits /
+        cache_misses / num_compute_segments for observability + tests."""
+        return self._cache.get(("seg", (id(program), program._version)))
 
     # ---- parity shims used by reference scripts ----
     def _run_startup(self, startup_program, scope=None):

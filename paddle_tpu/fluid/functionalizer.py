@@ -150,6 +150,29 @@ def contains_host_ops(program):
     return False
 
 
+def has_subblock_host_ops(program):
+    """True when ANY host op sits inside a control-flow sub-block
+    (while/cond body). Such programs cannot be partitioned at block-0
+    boundaries — the enclosing control-flow op would trace the host op
+    under jit — so the Executor runs them fully eagerly instead."""
+    return any(op.type in HOST_OPS
+               for blk in program.blocks[1:] for op in blk.ops)
+
+
+def block_tree_has_host_ops(block):
+    """True when `block` or any nested sub_block contains a host op —
+    control-flow lowerings use this to pick their host-interpreted branch
+    (must match has_subblock_host_ops' recursive view, or a host op two
+    levels deep gets traced even on the eager path)."""
+    for op in block.ops:
+        if op.type in HOST_OPS:
+            return True
+        sub = op.attrs.get("sub_block")
+        if sub is not None and block_tree_has_host_ops(sub):
+            return True
+    return False
+
+
 def _run_forward_op(op, env, vjp_cache, needed_vjp, step, seed, mesh):
     od = op_registry.get_op_def(op.type)
     ctx = ExecContext(op, _gather_inputs(op, env), step=step, seed=seed,
@@ -242,16 +265,16 @@ def _run_grad_op(op, env, vjp_cache, step, seed, mesh):
                 env[name] = g
 
 
-def run_block(block, env, step=0, seed=0, mesh=None, vjp_cache=None):
-    """Interpret one block inside the current jax trace, mutating env.
-    Also used recursively by control-flow op lowerings."""
+def _interpret_ops(ops, env, step=0, seed=0, mesh=None, vjp_cache=None):
+    """Interpret a sequence of ops inside the current jax trace, mutating
+    env. The shared core of run_block and SegmentedProgramRunner."""
     if vjp_cache is None:
         vjp_cache = {}
     needed_vjp = set()
-    for op in block.ops:
+    for op in ops:
         if op.type.endswith("_grad") and "fwd_uid" in op.attrs:
             needed_vjp.add(op.attrs["fwd_uid"])
-    for op in block.ops:
+    for op in ops:
         if op.type in _SKIP_OPS:
             continue
         if op.type.endswith("_grad") and "fwd_uid" in op.attrs and \
@@ -260,6 +283,13 @@ def run_block(block, env, step=0, seed=0, mesh=None, vjp_cache=None):
         else:
             _run_forward_op(op, env, vjp_cache, needed_vjp, step, seed, mesh)
     return env
+
+
+def run_block(block, env, step=0, seed=0, mesh=None, vjp_cache=None):
+    """Interpret one block inside the current jax trace, mutating env.
+    Also used recursively by control-flow op lowerings."""
+    return _interpret_ops(block.ops, env, step=step, seed=seed, mesh=mesh,
+                          vjp_cache=vjp_cache)
 
 
 def build_step_fn(program, feed_names, fetch_names, state_names,
@@ -280,6 +310,176 @@ def build_step_fn(program, feed_names, fetch_names, state_names,
         return fetches, new_state
 
     return step_fn
+
+
+def _op_tree_reads(op):
+    """Names `op` may read from the surrounding env, recursing into
+    control-flow sub-blocks. Env-introspected ops (conditional_block,
+    legacy while) also READ the names their subtree writes — the lowering
+    uses the current env value as the carry init."""
+    reads = set()
+    for names in op.inputs.values():
+        reads.update(n for n in names if n)
+    sub = op.attrs.get("sub_block")
+    if sub is not None:
+        for o in sub.ops:
+            reads |= _op_tree_reads(o)
+        reads |= _op_tree_writes(op)
+    return reads
+
+
+def _op_tree_writes(op):
+    """Names `op` may write to the surrounding env, recursing into
+    control-flow sub-blocks (a conditional_block declares outputs={} but
+    its lowering writes the subtree's written names back to env)."""
+    writes = set()
+    for names in op.outputs.values():
+        writes.update(n for n in names if n)
+    sub = op.attrs.get("sub_block")
+    if sub is not None:
+        for o in sub.ops:
+            writes |= _op_tree_writes(o)
+    return writes
+
+
+def _jit_safe(v):
+    """Can v cross a jit boundary as a pytree of array leaves?"""
+    import jax
+    if v is None:
+        return False
+    if isinstance(v, (list, tuple)):
+        return all(_jit_safe(x) for x in v)
+    return isinstance(v, (jax.Array, np.ndarray, int, float, bool,
+                          np.generic))
+
+
+class SegmentedProgramRunner:
+    """Host-op program execution: partition a block at HOST_OPS
+    boundaries, jit each compute segment (cached per feed structure), run
+    host ops eagerly between them (SURVEY §7 step 3: "partitions a block
+    into XLA-lowerable segments").
+
+    Reference analogue: in framework/executor.cc every op ran through the
+    same interpreter loop and host-side kernels (save_op.cc, send_op,
+    listen_and_serv_op.cc) simply executed on CPU between device kernels;
+    here the device portion of the block compiles to XLA computations and
+    only the host ops remain interpreted."""
+
+    def __init__(self, program, block_idx=0):
+        self.program = program
+        self.block = program.blocks[block_idx]
+        self.seed = program.random_seed
+        self.segments = []        # ("compute", [ops]) | ("host", op)
+        cur = []
+        for op in self.block.ops:
+            if op.type in _SKIP_OPS:
+                continue
+            if op.type in HOST_OPS:
+                if cur:
+                    self.segments.append(("compute", cur))
+                    cur = []
+                self.segments.append(("host", op))
+            else:
+                cur.append(op)
+        if cur:
+            self.segments.append(("compute", cur))
+        # liveness: a segment only needs to EXPORT names read by later
+        # segments/host ops, persistable state, or runtime fetches — not
+        # every intermediate (exporting everything would force XLA to
+        # materialize all activations/grads as computation outputs).
+        # Reads/writes recurse into control-flow sub-blocks: a
+        # conditional_block declares only Cond, its real data flow is
+        # env-introspected at trace time (layers/control_flow.py), and it
+        # both reads AND writes its subtree's written names.
+        persist = set(persistable_names(program))
+        read_later = [set() for _ in self.segments]
+        acc = set()
+        for i in range(len(self.segments) - 1, -1, -1):
+            read_later[i] = set(acc)
+            kind, item = self.segments[i]
+            for op in ([item] if kind == "host" else item):
+                acc |= _op_tree_reads(op)
+        self._seg_all_outputs = []   # declared writes, for runtime fetches
+        self._seg_outputs = []       # live writes actually exported
+        for i, (kind, item) in enumerate(self.segments):
+            if kind != "compute":
+                self._seg_all_outputs.append(None)
+                self._seg_outputs.append(None)
+                continue
+            outs = set()
+            for op in item:
+                outs |= _op_tree_writes(op)
+            self._seg_all_outputs.append(outs)
+            self._seg_outputs.append(outs & (read_later[i] | persist))
+        self._jitted = {}
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    @property
+    def num_compute_segments(self):
+        return sum(1 for k, _ in self.segments if k == "compute")
+
+    def _run_host_op(self, op, env, step):
+        _run_forward_op(op, env, {}, (), step, self.seed, None)
+
+    def _get_segment_fn(self, idx, ops, in_names, extra_outs=()):
+        import jax
+        from ..ops.registry import amp_enabled
+        key = (idx, in_names, extra_outs, self.program._version,
+               amp_enabled())
+        fn = self._jitted.get(key)
+        if fn is not None:
+            self.cache_hits += 1
+            return fn
+        self.cache_misses += 1
+        out_names = tuple(sorted(self._seg_outputs[idx] | set(extra_outs)))
+        seed = self.seed
+
+        def seg_fn(env_in, step):
+            env = dict(env_in)
+            _interpret_ops(ops, env, step=step, seed=seed)
+            out = {}
+            for n in out_names:
+                if n in env:
+                    out[n] = env[n]
+                ln = n + LOD_LEN_SUFFIX
+                if ln in env:
+                    out[ln] = env[ln]
+            return out
+
+        fn = jax.jit(seg_fn)
+        self._jitted[key] = fn
+        return fn
+
+    def run(self, env, step, fetch_names=()):
+        """Execute all segments in order, mutating env (the host-side
+        variable map: state + feeds in, fetches + new state out).
+        fetch_names: extra names the caller will read from env afterwards
+        (exported from whichever segment produces them)."""
+        fetch_set = set(fetch_names)
+        for idx, (kind, item) in enumerate(self.segments):
+            if kind == "host":
+                self._run_host_op(item, env, step)
+                continue
+            # inputs: every env name any op in the segment may read, incl.
+            # control-flow subtree reads (plus LoD companions);
+            # within-segment redefinitions just overwrite, so passing the
+            # pre-segment value preserves interpreter order
+            in_env = {}
+            for op in item:
+                for n in _op_tree_reads(op):
+                    if n in env and _jit_safe(env[n]):
+                        in_env[n] = env[n]
+                        ln = n + LOD_LEN_SUFFIX
+                        if ln in env:
+                            in_env[ln] = env[ln]
+            extra = tuple(sorted((fetch_set & self._seg_all_outputs[idx])
+                                 - self._seg_outputs[idx]))
+            fn = self._get_segment_fn(idx, item, tuple(sorted(in_env)),
+                                      extra)
+            out = fn(in_env, step)
+            env.update(out)
+        return env
 
 
 def persistable_names(program):

@@ -164,3 +164,67 @@ class FCFusePass(Pass):
                 del blk.ops[i + 1]
             i += 1
         return program
+
+
+@register_pass
+class MultiBatchMergePass(Pass):
+    """ir/multi_batch_merge_pass.cc (+ test_dist_mnist_batch_merge):
+    gradient accumulation — run N micro-batches, apply ONE optimizer
+    update from the averaged accumulated gradient.
+
+    The reference rewrote the SSA graph to repeat the fwd/bwd subgraph N
+    times per iteration; the TPU-idiomatic encoding keeps one jitted step
+    and gates the optimizer ops instead (ops/optimizer_ops._merge_gated):
+    this pass creates a persistable accumulation buffer per gradient,
+    wires it into each optimizer op, and annotates `merge_n` so the gated
+    lowering accumulates on micro-steps and applies+resets every Nth
+    step. LR-decay counter increments are gated to count applied updates.
+
+    Usage: get_pass("multi_batch_merge_pass", n=4).apply(main_program)
+    """
+
+    name = "multi_batch_merge_pass"
+
+    def apply_impl(self, program):
+        from ..ops.optimizer_ops import MERGEABLE_OPT_OPS
+        from .layers.learning_rate_scheduler import LR_COUNTER_NAME
+        n = int(self.get("n", 1))
+        if n <= 1:
+            return program
+        blk = program.global_block()
+        # adam/adamax advance their beta-pow accumulators with separate
+        # in-place `scale` ops (optimizer.py _finish_update, mirroring the
+        # reference) — those must gate with the optimizer update
+        pow_names = set()
+        for op in blk.ops:
+            if op.type in MERGEABLE_OPT_OPS:
+                for slot in ("Beta1Pow", "Beta2Pow"):
+                    for nm in op.inputs.get(slot, []):
+                        if nm:
+                            pow_names.add(nm)
+        for op in blk.ops:
+            if op.type in MERGEABLE_OPT_OPS:
+                gname = op.inputs.get("Grad", [None])[0]
+                if not gname:
+                    continue
+                gvar = blk._find_var_recursive(gname)
+                acc_name = gname + "@MERGE_ACC"
+                if blk._find_var_recursive(acc_name) is None:
+                    blk.create_var(
+                        name=acc_name,
+                        dtype=gvar.dtype if gvar is not None else "float32",
+                        shape=gvar.shape if gvar is not None else None,
+                        persistable=True, stop_gradient=True)
+                op.inputs["GradAcc"] = [acc_name]
+                op.outputs["GradAccOut"] = [acc_name]
+                op.attrs["merge_n"] = n
+            elif op.type == "increment":
+                xn = op.inputs.get("X", [None])[0]
+                if xn == LR_COUNTER_NAME:
+                    op.attrs["merge_n"] = n
+            elif op.type == "scale":
+                xn = op.inputs.get("X", [None])[0]
+                on = op.outputs.get("Out", [None])[0]
+                if xn and xn == on and xn in pow_names:
+                    op.attrs["merge_n"] = n
+        return program

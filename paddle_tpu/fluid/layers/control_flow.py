@@ -22,8 +22,25 @@ __all__ = [
     "While", "Switch", "ConditionalBlock", "StaticRNN", "DynamicRNN",
     "increment", "array_write", "array_read", "array_length",
     "create_array", "less_than", "equal", "zeros_like", "ones_like",
-    "max_sequence_len", "is_empty",
+    "max_sequence_len", "is_empty", "Print",
 ]
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference layers/control_flow.py Print (print_op.cc): in-graph
+    debug dump of a tensor. Lowered to jax.debug.print so it works inside
+    jitted segments."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="print", inputs={"In": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={"first_n": first_n, "message": message or "",
+               "summarize": summarize, "print_phase": print_phase})
+    return out
 
 
 def increment(x, value=1.0, in_place=True):

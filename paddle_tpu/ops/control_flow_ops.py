@@ -99,7 +99,24 @@ def _while(ctx):
         return tuple(e[n] for n in carry_names)
 
     max_iters = ctx.attr("max_iters")
-    if max_iters and not ctx.attr("is_test", False):
+    if functionalizer.block_tree_has_host_ops(block):
+        # host ops (save/send/...) need concrete values each iteration:
+        # interpret the body per iteration on the host, like the
+        # reference's nested-Executor WhileOp (while_op.cc:50). Only
+        # possible when the surrounding program runs eagerly.
+        probe = vals.get(cond_name, closure.get(cond_name))
+        if isinstance(probe, jax.core.Tracer) or \
+                any(isinstance(v, jax.core.Tracer) for v in init):
+            raise RuntimeError(
+                "while body contains host ops (possibly nested) and "
+                "cannot be traced under jit — run the program through "
+                "the Executor's eager path")
+        import numpy as _np
+        carry = init
+        while bool(_np.asarray(overlay(carry)[cond_name]).reshape(())):
+            carry = run_body(overlay(carry))
+        final = carry
+    elif max_iters and not ctx.attr("is_test", False):
         def scan_body(carry, _):
             e = overlay(carry)
             pred = e[cond_name].reshape(())
@@ -165,8 +182,22 @@ def _conditional_block(ctx):
         return carry
 
     init = tuple(env[n] for n in carry_names)
-    out = jax.lax.cond(cond.reshape(()).astype(bool), true_fn, false_fn,
-                       init)
+    if functionalizer.block_tree_has_host_ops(block):
+        # host ops need concrete values: interpret the branch on the host
+        # (reference ConditionalBlockOp ran the sub-block via a nested
+        # Executor; only possible when the program runs eagerly)
+        if isinstance(cond, jax.core.Tracer) or \
+                any(isinstance(v, jax.core.Tracer) for v in init):
+            raise RuntimeError(
+                "conditional_block body contains host ops (possibly "
+                "nested) and cannot be traced under jit — run the "
+                "program through the Executor's eager path")
+        import numpy as _np
+        out = true_fn(init) if bool(
+            _np.asarray(cond).reshape(()).astype(bool)) else false_fn(init)
+    else:
+        out = jax.lax.cond(cond.reshape(()).astype(bool), true_fn, false_fn,
+                           init)
     for n, v in zip(carry_names, out):
         env[n] = v
     return {}

@@ -1,0 +1,81 @@
+"""In-graph checkpoint IO ops — host-side kernels.
+
+Reference analogues: operators/save_op.cc, load_op.cc,
+save_combine_op.cc, load_combine_op.cc — tensor serialization with a
+version header, executed as ordinary ops inside a program (driven by
+fluid.io.save/load_vars, io.py:89-:505).
+
+TPU redesign: these are HOST_OPS (functionalizer.HOST_OPS) — the
+segmented program runner executes them eagerly between jitted compute
+segments, so a training program containing a `save` op still runs its
+compute from the XLA jit cache. Serialization is numpy .npy/.npz (the
+same on-disk format as fluid/io.py, so in-graph saves and host-API saves
+are interchangeable).
+"""
+
+import os
+
+import numpy as np
+
+from .registry import register_op
+
+
+def _require_concrete(v, op):
+    import jax
+    if isinstance(v, jax.core.Tracer):
+        raise RuntimeError(
+            "op '%s' is a host IO op and cannot run under jit — it must "
+            "be executed by the segmented host path (this indicates a "
+            "mis-partitioned program)" % op)
+    return np.asarray(v)
+
+
+@register_op("save")
+def _save(ctx):
+    x = _require_concrete(ctx.input("X"), "save")
+    path = ctx.attr("file_path")
+    if not ctx.attr("overwrite", True) and os.path.exists(path):
+        raise RuntimeError("save: %s exists and overwrite=False" % path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        np.save(f, x)
+    return {}
+
+
+@register_op("load")
+def _load(ctx):
+    import jax.numpy as jnp
+    path = ctx.attr("file_path")
+    with open(path, "rb") as f:
+        arr = np.load(f)
+    return {"Out": jnp.asarray(arr)}
+
+
+@register_op("save_combine")
+def _save_combine(ctx):
+    xs = ctx.inputs("X")
+    names = ctx.op.inputs.get("X", [])
+    path = ctx.attr("file_path")
+    if not ctx.attr("overwrite", True) and os.path.exists(path):
+        raise RuntimeError("save_combine: %s exists and overwrite=False"
+                           % path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arrays = {n.replace("/", "__"): _require_concrete(v, "save_combine")
+              for n, v in zip(names, xs)}
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    return {}
+
+
+@register_op("load_combine")
+def _load_combine(ctx):
+    import jax.numpy as jnp
+    names = ctx.op.outputs.get("Out", [])
+    path = ctx.attr("file_path")
+    with np.load(path) as z:
+        return {"Out": [jnp.asarray(z[n.replace("/", "__")])
+                        for n in names]}

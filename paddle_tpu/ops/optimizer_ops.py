@@ -235,3 +235,114 @@ def _proximal_gd(ctx):
     p_out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (
         1.0 + lr * l2)
     return {"ParamOut": p_out}
+
+
+# ---------------------------------------------------------------------------
+# gradient accumulation / multi-batch merge (reference
+# ir/multi_batch_merge_pass.cc + test_dist_mnist_batch_merge): when the
+# multi_batch_merge_pass has annotated an optimizer op with merge_n=N, the
+# op accumulates grads into a persistable buffer for N micro-steps and
+# applies ONE update from the averaged grad on every Nth step. The gate is
+# a jnp.where over the op's in-place outputs — branch-free and jittable,
+# the TPU-idiomatic encoding of the reference's repeated-subgraph rewrite.
+# ---------------------------------------------------------------------------
+
+MERGEABLE_OPT_OPS = (
+    "sgd", "momentum", "lars_momentum", "adam", "adamax", "adagrad",
+    "decayed_adagrad", "adadelta", "rmsprop", "ftrl", "proximal_gd",
+)
+
+# in-place alias convention of the reference optimizer ops: output slot
+# "<X>Out" writes input slot "<X>" (ParamOut<-Param, VelocityOut<-Velocity,
+# MomentOut<-Moment, Beta1PowOut<-Beta1Pow, ...)
+_OUT_ALIASES = {
+    "SquaredAccumOut": "SquaredAccumulator",
+    "LinearAccumOut": "LinearAccumulator",
+    "AvgSquaredGradOut": "AvgSquaredGrad",
+    "AvgSquaredUpdateOut": "AvgSquaredUpdate",
+    "MeanSquareOut": "MeanSquare",
+    "MeanGradOut": "MeanGrad",
+}
+
+
+def _alias_input(ctx, slot):
+    if slot in _OUT_ALIASES:
+        return ctx.input(_OUT_ALIASES[slot])
+    if slot.endswith("Out") and ctx.has_input(slot[:-3]):
+        return ctx.input(slot[:-3])
+    return None
+
+
+def _merge_gated(lower):
+    import functools
+
+    @functools.wraps(lower)
+    def wrapped(ctx):
+        n = int(ctx.attr("merge_n", 0) or 0)
+        if n <= 1:
+            return lower(ctx)
+        jnp = _jnp()
+        from .registry import ExecContext
+        g = ctx.input("Grad")
+        if _is_sparse(g):
+            raise NotImplementedError(
+                "multi_batch_merge with sparse (SelectedRows) gradients — "
+                "densify the embedding grad (is_sparse=False) to combine "
+                "with gradient accumulation")
+        acc = ctx.input("GradAcc")
+        if acc is None:
+            acc = jnp.zeros_like(g)
+        acc_new = acc + g
+        step = jnp.asarray(ctx.step, jnp.uint32)
+        apply = ((step + jnp.uint32(1)) % jnp.uint32(n)) == 0
+        new_inputs = dict(ctx._inputs)
+        new_inputs["Grad"] = [acc_new / jnp.asarray(n, acc_new.dtype)]
+        c2 = ExecContext(ctx.op, new_inputs, step=ctx.step, seed=ctx.seed,
+                         mesh=ctx.mesh, env=ctx.env)
+        outs = lower(c2)
+        gated = {}
+        for slot, val in outs.items():
+            old = _alias_input(ctx, slot)
+            gated[slot] = val if old is None else jnp.where(apply, val, old)
+        gated["GradAccOut"] = jnp.where(
+            apply, jnp.zeros_like(acc_new), acc_new)
+        return gated
+    return wrapped
+
+
+def _gated_inplace(lower):
+    """Gate an in-place helper op (increment of the LR-decay counter,
+    scale of adam/adamax beta-pow accumulators) so its state advances once
+    per EFFECTIVE batch: under merge_n=N the update lands only on apply
+    steps (reference batch-merge kept per-iteration cadence for these)."""
+    import functools
+
+    @functools.wraps(lower)
+    def wrapped(ctx):
+        n = int(ctx.attr("merge_n", 0) or 0)
+        outs = lower(ctx)
+        if n <= 1:
+            return outs
+        jnp = _jnp()
+        step = jnp.asarray(ctx.step, jnp.uint32)
+        apply = ((step + jnp.uint32(1)) % jnp.uint32(n)) == 0
+        x = ctx.input("X")
+        return {s: jnp.where(apply, v, x) for s, v in outs.items()}
+    return wrapped
+
+
+def _install_merge_gates():
+    from . import registry as _reg
+    for t in MERGEABLE_OPT_OPS:
+        od = _reg._REGISTRY.get(t)
+        if od is not None and not getattr(od.lower, "_merge_gated", False):
+            od.lower = _merge_gated(od.lower)
+            od.lower._merge_gated = True
+    for t in ("increment", "scale"):
+        od = _reg._REGISTRY.get(t)
+        if od is not None and not getattr(od.lower, "_merge_gated", False):
+            od.lower = _gated_inplace(od.lower)
+            od.lower._merge_gated = True
+
+
+_install_merge_gates()

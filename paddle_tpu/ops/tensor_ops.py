@@ -454,5 +454,9 @@ def _is_empty(ctx):
 def _print(ctx):
     import jax
     x = ctx.input("In")
-    jax.debug.print(ctx.attr("message", "") + " {}", x)
+    # escape braces: the user message must not be treated as a format
+    # template (message="loss {step}" would raise during tracing)
+    msg = (ctx.attr("message", "") or "").replace("{", "{{") \
+                                         .replace("}", "}}")
+    jax.debug.print(msg + " {}", x)
     return {"Out": x}

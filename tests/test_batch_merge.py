@@ -1,0 +1,124 @@
+"""Gradient accumulation / multi-batch merge (reference
+ir/multi_batch_merge_pass.cc + test_dist_mnist_batch_merge): N
+micro-batches through the merged program must produce the SAME parameters
+as one N-x-larger batch through the plain program."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import ir_passes
+from paddle_tpu.fluid.framework import Program
+
+N = 4
+MICRO_BS = 8
+
+
+def _build(optimizer, lr_schedule=False):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=12, act="tanh")
+        logits = fluid.layers.fc(h, size=4)
+        prob = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=prob, label=label))
+        if lr_schedule:
+            lr = fluid.layers.piecewise_decay(boundaries=[2, 4],
+                                              values=[0.1, 0.01, 0.001])
+        else:
+            lr = 0.1
+        opt = optimizer(learning_rate=lr)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def _data(total):
+    rng = np.random.RandomState(11)
+    return (rng.randn(total, 6).astype(np.float32),
+            rng.randint(0, 4, (total, 1)).astype(np.int64))
+
+
+def _params(scope, main):
+    out = {}
+    for blk in main.blocks:
+        for v in blk.vars.values():
+            if getattr(v, "persistable", False) and \
+                    scope.get(v.name) is not None and \
+                    not v.name.endswith("@MERGE_ACC"):
+                out[v.name] = np.asarray(scope.get(v.name))
+    return out
+
+
+def _run_merged(optimizer, steps_effective=1, lr_schedule=False):
+    with fluid.unique_name.guard():
+        main, startup, loss = _build(optimizer, lr_schedule)
+    ir_passes.get_pass("multi_batch_merge_pass", n=N).apply(main)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    x, label = _data(N * MICRO_BS * steps_effective)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for s in range(N * steps_effective):
+            feed = {"x": x[s * MICRO_BS:(s + 1) * MICRO_BS],
+                    "label": label[s * MICRO_BS:(s + 1) * MICRO_BS]}
+            exe.run(main, feed=feed, fetch_list=[loss])
+        return _params(scope, main)
+
+
+def _run_big_batch(optimizer, steps_effective=1, lr_schedule=False):
+    with fluid.unique_name.guard():
+        main, startup, loss = _build(optimizer, lr_schedule)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    x, label = _data(N * MICRO_BS * steps_effective)
+    bs = N * MICRO_BS
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for s in range(steps_effective):
+            feed = {"x": x[s * bs:(s + 1) * bs],
+                    "label": label[s * bs:(s + 1) * bs]}
+            exe.run(main, feed=feed, fetch_list=[loss])
+        return _params(scope, main)
+
+
+def test_sgd_merge_equals_big_batch():
+    merged = _run_merged(fluid.optimizer.SGD, steps_effective=2)
+    big = _run_big_batch(fluid.optimizer.SGD, steps_effective=2)
+    assert merged.keys() == big.keys()
+    for name in merged:
+        np.testing.assert_allclose(merged[name], big[name], atol=1e-6,
+                                   err_msg=name)
+
+
+def test_momentum_merge_equals_big_batch():
+    """Momentum state must update once per effective batch (a wrong
+    gating would decay velocity on every micro-step)."""
+    opt = lambda learning_rate: fluid.optimizer.Momentum(
+        learning_rate=learning_rate, momentum=0.9)
+    merged = _run_merged(opt, steps_effective=3)
+    big = _run_big_batch(opt, steps_effective=3)
+    for name in merged:
+        np.testing.assert_allclose(merged[name], big[name], atol=1e-5,
+                                   err_msg=name)
+
+
+def test_adam_merge_equals_big_batch():
+    """Adam's Beta1Pow/Beta2Pow must advance once per effective batch."""
+    merged = _run_merged(fluid.optimizer.Adam, steps_effective=2)
+    big = _run_big_batch(fluid.optimizer.Adam, steps_effective=2)
+    for name in merged:
+        np.testing.assert_allclose(merged[name], big[name], atol=1e-5,
+                                   err_msg=name)
+
+
+def test_lr_decay_counts_effective_batches():
+    """piecewise_decay's @LR_DECAY_COUNTER@ advances per APPLIED update
+    under merge (reference batch-merge keeps per-iteration decay)."""
+    merged = _run_merged(fluid.optimizer.SGD, steps_effective=3,
+                         lr_schedule=True)
+    big = _run_big_batch(fluid.optimizer.SGD, steps_effective=3,
+                         lr_schedule=True)
+    for name in merged:
+        np.testing.assert_allclose(merged[name], big[name], atol=1e-6,
+                                   err_msg=name)

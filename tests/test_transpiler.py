@@ -302,8 +302,8 @@ class TestLrScheduleOnPserver:
 
         th = threading.Thread(target=run_ps, daemon=True)
         th.start()
-        import time
-        time.sleep(0.3)
+        from paddle_tpu.distributed.rpc import wait_server_ready
+        wait_server_ready([ep])
 
         trainer_prog = t.get_trainer_program()
         exe = fluid.Executor(fluid.CPUPlace())

@@ -18,3 +18,14 @@ from . import movielens
 
 __all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "wmt14",
            "movielens"]
+
+from . import conll05
+from . import imikolov
+from . import sentiment
+from . import wmt16
+from . import flowers
+from . import mq2007
+from . import voc2012
+
+__all__ += ["conll05", "imikolov", "sentiment", "wmt16", "flowers",
+            "mq2007", "voc2012"]

@@ -1,0 +1,54 @@
+"""CoNLL-2005 semantic-role-labeling reader (reference
+python/paddle/dataset/conll05.py). Samples are the 9 features the
+reference reader_creator yields (:150): word sequence, the five
+predicate-context sequences (ctx_n2..ctx_p2, each the context token
+repeated per position), predicate sequence, mark sequence (1 inside the
+predicate span), and the BIO label sequence. get_dict() returns
+(word_dict, verb_dict, label_dict) like the reference (:205)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["test", "get_dict", "get_embedding"]
+
+WORD_DICT_LEN = 44068       # reference Wikipedia-corpus vocab order
+VERB_DICT_LEN = 3162
+LABEL_DICT_LEN = 67         # BIO tags over the role label set
+TEST_SIZE = 256
+MIN_LEN, MAX_LEN = 5, 40
+
+
+def get_dict():
+    word_dict = {"w%d" % i: i for i in range(WORD_DICT_LEN)}
+    verb_dict = {"v%d" % i: i for i in range(VERB_DICT_LEN)}
+    label_dict = {"l%d" % i: i for i in range(LABEL_DICT_LEN)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """reference :218 returns the path of a pretrained embedding file; in
+    synthetic mode there is none."""
+    return common.download("conll05st/emb", "conll05st", None)
+
+
+def test():
+    def reader():
+        rng = common.split_rng("conll05", "test")
+        for _ in range(TEST_SIZE):
+            n = int(rng.randint(MIN_LEN, MAX_LEN + 1))
+            words = rng.randint(0, WORD_DICT_LEN, n)
+            pred_pos = int(rng.randint(0, n))
+            pred = int(rng.randint(0, VERB_DICT_LEN))
+
+            def ctx(off):
+                p = min(max(pred_pos + off, 0), n - 1)
+                return [int(words[p])] * n
+
+            mark = [1 if i == pred_pos else 0 for i in range(n)]
+            labels = rng.randint(0, LABEL_DICT_LEN, n)
+            yield ([int(w) for w in words], ctx(-2), ctx(-1), ctx(0),
+                   ctx(1), ctx(2), [pred] * n, mark,
+                   [int(l) for l in labels])
+
+    return reader

@@ -1,0 +1,40 @@
+"""Oxford-102 flowers reader (reference python/paddle/dataset/flowers.py):
+train/test/valid yield (image, label) where image is the mapper output —
+by default a float32 CHW array ready for conv nets — and label is in
+[0, 102)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "valid"]
+
+NUM_CLASSES = 102
+TRAIN_SIZE = 512
+TEST_SIZE = 128
+IMG_SHAPE = (3, 224, 224)
+
+
+def _creator(split, size):
+    def reader():
+        rng = common.split_rng("flowers", split)
+        for _ in range(size):
+            label = int(rng.randint(0, NUM_CLASSES))
+            # class-conditioned mean keeps the task learnable
+            img = (rng.rand(*IMG_SHAPE).astype(np.float32) * 0.5
+                   + label / float(NUM_CLASSES))
+            yield img, label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _creator("train", TRAIN_SIZE)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _creator("test", TEST_SIZE)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _creator("val", TEST_SIZE)

@@ -1,0 +1,51 @@
+"""PTB (imikolov) language-model reader (reference
+python/paddle/dataset/imikolov.py): build_dict() -> vocab; train/test
+yield n-gram tuples (NGRAM) or (cur_seq, next_seq) pairs (SEQ)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "build_dict", "DataType"]
+
+VOCAB = 2074         # reference build_dict default min_word_freq=50 order
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def build_dict(min_word_freq=50):
+    d = {"w%d" % i: i for i in range(VOCAB - 2)}
+    d["<unk>"] = VOCAB - 2
+    d["<e>"] = VOCAB - 1
+    return d
+
+
+def _creator(split, size, word_idx, n, data_type):
+    vocab = max(word_idx.values()) + 1 if word_idx else VOCAB
+
+    def reader():
+        rng = common.split_rng("imikolov", split)
+        for _ in range(size):
+            if data_type == DataType.NGRAM:
+                assert n > 1
+                yield tuple(int(v) for v in rng.randint(0, vocab, n))
+            else:
+                ln = int(rng.randint(3, 30))
+                seq = rng.randint(0, vocab, ln + 1)
+                yield ([int(v) for v in seq[:-1]],
+                       [int(v) for v in seq[1:]])
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return _creator("train", TRAIN_SIZE, word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return _creator("test", TEST_SIZE, word_idx, n, data_type)

@@ -1,0 +1,57 @@
+"""MQ2007 learning-to-rank reader (reference
+python/paddle/dataset/mq2007.py): format="pairwise" yields (label,
+left_features, right_features); "listwise" yields (relevance_list,
+feature_list); "pointwise" yields (score, features). 46-dim LETOR
+features per query-document pair."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+FEATURE_DIM = 46
+TRAIN_QUERIES = 128
+TEST_QUERIES = 32
+DOCS_PER_QUERY = (5, 20)
+
+
+def _gen_query(rng):
+    n = int(rng.randint(*DOCS_PER_QUERY))
+    rel = rng.randint(0, 3, n)              # LETOR relevance in {0,1,2}
+    feats = rng.rand(n, FEATURE_DIM).astype(np.float32)
+    # relevance-correlated feature block keeps ranking learnable
+    feats[:, :5] += rel[:, None] * 0.5
+    return rel, feats
+
+
+def _creator(split, n_queries, format):
+    def reader():
+        rng = common.split_rng("mq2007", split)
+        for _ in range(n_queries):
+            rel, feats = _gen_query(rng)
+            if format == "pointwise":
+                for i in range(len(rel)):
+                    yield float(rel[i]), feats[i]
+            elif format == "pairwise":
+                for i in range(len(rel)):
+                    for j in range(len(rel)):
+                        if rel[i] > rel[j]:
+                            yield np.array([1.0], np.float32), feats[i], \
+                                feats[j]
+            elif format == "listwise":
+                yield (np.asarray(rel, np.float32),
+                       np.asarray(feats, np.float32))
+            else:
+                raise ValueError("format must be pointwise|pairwise|"
+                                 "listwise")
+
+    return reader
+
+
+def train(format="pairwise"):
+    return _creator("train", TRAIN_QUERIES, format)
+
+
+def test(format="pairwise"):
+    return _creator("test", TEST_QUERIES, format)

@@ -1,0 +1,42 @@
+"""NLTK movie-reviews sentiment reader (reference
+python/paddle/dataset/sentiment.py): get_word_dict() -> vocab;
+train()/test() yield (word-id list, label in {0,1})."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_word_dict"]
+
+VOCAB = 39768          # reference movie_reviews vocab order
+TRAIN_SIZE = 1600      # reference: 80% of 2000 docs
+TEST_SIZE = 400
+MIN_LEN, MAX_LEN = 10, 200
+
+
+def get_word_dict():
+    return {"w%d" % i: i for i in range(VOCAB)}
+
+
+def _creator(split, size):
+    def reader():
+        rng = common.split_rng("sentiment", split)
+        third = VOCAB // 3
+        for _ in range(size):
+            label = int(rng.randint(0, 2))
+            n = int(rng.randint(MIN_LEN, MAX_LEN + 1))
+            bank_lo = 0 if label else third
+            biased = rng.randint(bank_lo, bank_lo + third, n)
+            neutral = rng.randint(2 * third, VOCAB, n)
+            words = np.where(rng.rand(n) < 0.7, biased, neutral)
+            yield [int(w) for w in words], label
+
+    return reader
+
+
+def train():
+    return _creator("train", TRAIN_SIZE)
+
+
+def test():
+    return _creator("test", TEST_SIZE)

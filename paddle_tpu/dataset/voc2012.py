@@ -1,0 +1,46 @@
+"""PASCAL VOC2012 segmentation reader (reference
+python/paddle/dataset/voc2012.py): train/test/val yield (image,
+label_map) — CHW float32 image + HW int32 per-pixel class map in
+[0, 21) (20 classes + background)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val"]
+
+NUM_CLASSES = 21
+TRAIN_SIZE = 128
+TEST_SIZE = 32
+H = W = 128
+
+
+def _creator(split, size):
+    def reader():
+        rng = common.split_rng("voc2012", split)
+        for _ in range(size):
+            img = rng.rand(3, H, W).astype(np.float32)
+            # blocky segmentation mask: a few rectangles per image
+            seg = np.zeros((H, W), np.int32)
+            for _ in range(int(rng.randint(1, 4))):
+                cls = int(rng.randint(1, NUM_CLASSES))
+                y0, x0 = rng.randint(0, H // 2), rng.randint(0, W // 2)
+                y1 = y0 + int(rng.randint(8, H // 2))
+                x1 = x0 + int(rng.randint(8, W // 2))
+                seg[y0:y1, x0:x1] = cls
+                img[:, y0:y1, x0:x1] += cls / float(NUM_CLASSES)
+            yield img, seg
+
+    return reader
+
+
+def train():
+    return _creator("train", TRAIN_SIZE)
+
+
+def test():
+    return _creator("test", TEST_SIZE)
+
+
+def val():
+    return _creator("val", TEST_SIZE)

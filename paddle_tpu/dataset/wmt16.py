@@ -1,0 +1,63 @@
+"""WMT16 en<->de translation reader (reference
+python/paddle/dataset/wmt16.py): train/test/validation yield
+(src_ids, trg_ids, trg_ids_next) with BPE-sized vocabs; get_dict(lang,
+size) returns the word->id map. <s>=0, <e>=1, <unk>=2 like the
+reference (:57-:59)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+TOTAL_EN_WORDS = 11250
+TOTAL_DE_WORDS = 19220
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+MIN_LEN, MAX_LEN = 4, 50
+
+
+def get_dict(lang, dict_size, reverse=False):
+    total = TOTAL_EN_WORDS if lang == "en" else TOTAL_DE_WORDS
+    dict_size = min(dict_size, total)
+    d = {"<s>": 0, "<e>": 1, "<unk>": 2}
+    for i in range(3, dict_size):
+        d["%s%d" % (lang, i)] = i
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _creator(split, size, src_dict_size, trg_dict_size, src_lang):
+    src_v = min(src_dict_size,
+                TOTAL_EN_WORDS if src_lang == "en" else TOTAL_DE_WORDS)
+    trg_v = min(trg_dict_size,
+                TOTAL_DE_WORDS if src_lang == "en" else TOTAL_EN_WORDS)
+
+    def reader():
+        rng = common.split_rng("wmt16", split)
+        for _ in range(size):
+            n_src = int(rng.randint(MIN_LEN, MAX_LEN + 1))
+            n_trg = int(rng.randint(MIN_LEN, MAX_LEN + 1))
+            src = [0] + [int(v) for v in rng.randint(3, src_v, n_src)] + [1]
+            trg_body = [int(v) for v in rng.randint(3, trg_v, n_trg)]
+            trg = [0] + trg_body
+            trg_next = trg_body + [1]
+            yield src, trg, trg_next
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator("train", TRAIN_SIZE, src_dict_size, trg_dict_size,
+                    src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator("test", TEST_SIZE, src_dict_size, trg_dict_size,
+                    src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator("val", TEST_SIZE, src_dict_size, trg_dict_size,
+                    src_lang)

@@ -1,4 +1,7 @@
 from . import rpc
 from .rpc import VariableServer, RPCClient
+from . import elastic
+from .elastic import MasterService, MasterClient, Task
 
-__all__ = ["rpc", "VariableServer", "RPCClient"]
+__all__ = ["rpc", "VariableServer", "RPCClient", "elastic",
+           "MasterService", "MasterClient", "Task"]

@@ -1,0 +1,372 @@
+"""Elastic Deep Learning layer: fault-tolerant master task queue +
+parameter-server checkpoints + trainer rejoin.
+
+Reference analogue: the Go EDL layer —
+- go/master/service.go: etcd-backed dataset task queue; `Service` (:89)
+  leases tasks with a timeout (:368 GetTask), retries failures up to a
+  cap (:455 TaskFailed -> :313 processFailedTask), re-queues expired
+  leases (:341 checkTimeoutFunc), completes passes by recycling the done
+  queue (:411 TaskFinished), and snapshots queue state to etcd (:207
+  snapshot / :237 recover).
+- go/pserver/service.go: parameter checkpoints to disk with CRC32 +
+  metadata (:119 checkpointMeta, :145 parameterCheckpoint, :174
+  LoadCheckpoint).
+- operators/distributed_ops/listen_and_serv_op.cc:172: after a trainer
+  rejoins, `NeedResetAllVars` resets the sync loop's partial state.
+
+TPU redesign: etcd is replaced by an atomic CRC-checked disk snapshot
+(the master is a single lightweight process; its durability story is
+restart-from-snapshot), and the transport is the same stdlib TCP message
+protocol as the parameter-server RPC (distributed/rpc.py) so subprocess
+tests need no extra infrastructure. Semantics — lease/timeout/retry/
+failure-cap/pass-rollover — follow go/master/service.go closely.
+"""
+
+import binascii
+import os
+import pickle
+import socket
+import socketserver
+import threading
+import time
+
+from .rpc import _send_msg, _recv_msg, _CLOSE  # shared wire protocol
+
+__all__ = ["Task", "MasterService", "MasterClient", "save_state_snapshot",
+           "load_state_snapshot"]
+
+
+class Task:
+    """One unit of pending work (go/master/service.go:79 Task: a set of
+    recordio chunks). `payload` is any picklable description of the data
+    slice (file + chunk range, batch indices, ...)."""
+
+    __slots__ = ("id", "payload", "failures")
+
+    def __init__(self, id, payload, failures=0):
+        self.id = id
+        self.payload = payload
+        self.failures = failures
+
+    def __repr__(self):
+        return "Task(%r, failures=%d)" % (self.id, self.failures)
+
+
+def save_state_snapshot(path, state):
+    """Atomic CRC-framed pickle (the etcd-snapshot analogue,
+    go/master/service.go:207)."""
+    payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    crc = binascii.crc32(payload) & 0xFFFFFFFF
+    tmp = path + ".tmp"
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(tmp, "wb") as f:
+        f.write(crc.to_bytes(4, "little"))
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+def load_state_snapshot(path):
+    """Verify CRC and unpickle; raises ValueError on corruption
+    (go/pserver/service.go:174 LoadCheckpoint CRC check)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    crc = int.from_bytes(raw[:4], "little")
+    payload = raw[4:]
+    if (binascii.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise ValueError("snapshot %s failed CRC32 check (corrupt)" % path)
+    return pickle.loads(payload)
+
+
+class MasterService:
+    """Dataset task-queue master (go/master/service.go:89).
+
+    Queues: todo -> pending(leased, deadline) -> done; failed tasks go
+    back to todo until `failure_max`, then are discarded. When todo and
+    pending are both empty, the done queue recycles into todo and the
+    pass counter advances. Every mutation snapshots to `snapshot_path`;
+    a restarted master recovers pending leases as todo.
+    """
+
+    def __init__(self, endpoint, snapshot_path=None, lease_timeout=5.0,
+                 failure_max=3, check_interval=None):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self.snapshot_path = snapshot_path
+        self.lease_timeout = float(lease_timeout)
+        self.failure_max = int(failure_max)
+        self._check_interval = check_interval or \
+            max(self.lease_timeout / 4.0, 0.05)
+        self._lock = threading.Lock()
+        self.todo = []            # [Task]
+        self.pending = {}         # task_id -> (Task, deadline, worker)
+        self.done = []            # [Task]
+        self.discarded = []       # failure-cap casualties
+        self.num_passes = 0
+        self.dataset_set = False
+        self._stopped = False
+        self._server = None
+        self._threads = []
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover()
+
+    # ---- durable state (go/master/service.go:207,:237) ----
+    def _state(self):
+        return {
+            "todo": [(t.id, t.payload, t.failures) for t in self.todo],
+            "pending": [(t.id, t.payload, t.failures)
+                        for (t, _, _) in self.pending.values()],
+            "done": [(t.id, t.payload, t.failures) for t in self.done],
+            "discarded": [(t.id, t.payload, t.failures)
+                          for t in self.discarded],
+            "num_passes": self.num_passes,
+            "dataset_set": self.dataset_set,
+        }
+
+    def _snapshot(self):
+        if self.snapshot_path:
+            save_state_snapshot(self.snapshot_path, self._state())
+
+    def _recover(self):
+        st = load_state_snapshot(self.snapshot_path)
+        mk = lambda rows: [Task(i, p, f) for (i, p, f) in rows]
+        # leases do not survive a master restart: pending -> todo
+        # (go/master recovers the queue from etcd; lease holders re-ask)
+        self.todo = mk(st["todo"]) + mk(st["pending"])
+        self.pending = {}
+        self.done = mk(st["done"])
+        self.discarded = mk(st["discarded"])
+        self.num_passes = st["num_passes"]
+        self.dataset_set = st["dataset_set"]
+
+    # ---- queue ops ----
+    def set_dataset(self, payloads):
+        """Install the dataset once (service.go SetDataset — subsequent
+        calls are no-ops so every worker may race to call it)."""
+        with self._lock:
+            if self.dataset_set:
+                return {"ok": True, "already": True}
+            self.todo = [Task(i, p) for i, p in enumerate(payloads)]
+            self.dataset_set = True
+            self._snapshot()
+        return {"ok": True, "count": len(self.todo)}
+
+    def get_task(self, worker="?"):
+        """Lease one task (service.go:368 GetTask)."""
+        with self._lock:
+            if not self.dataset_set:
+                return {"error": "dataset not set"}
+            if not self.todo and not self.pending and self.done:
+                # pass complete: recycle (service.go:411 end-of-pass)
+                self.todo, self.done = self.done, []
+                for t in self.todo:
+                    t.failures = 0
+                self.num_passes += 1
+            if not self.todo:
+                if self.pending:
+                    return {"error": "no task available, try later",
+                            "retry": True}
+                return {"error": "all tasks failed/discarded"}
+            t = self.todo.pop(0)
+            self.pending[t.id] = (t, time.monotonic() + self.lease_timeout,
+                                  worker)
+            self._snapshot()
+            return {"ok": True, "task_id": t.id, "payload": t.payload,
+                    "num_passes": self.num_passes}
+
+    def task_finished(self, task_id):
+        """service.go:411 TaskFinished."""
+        with self._lock:
+            ent = self.pending.pop(task_id, None)
+            if ent is None:
+                return {"error": "task %r not pending" % task_id}
+            self.done.append(ent[0])
+            self._snapshot()
+            return {"ok": True}
+
+    def task_failed(self, task_id):
+        """service.go:455 TaskFailed -> :313 processFailedTask."""
+        with self._lock:
+            ent = self.pending.pop(task_id, None)
+            if ent is None:
+                return {"error": "task %r not pending" % task_id}
+            self._process_failed(ent[0])
+            self._snapshot()
+            return {"ok": True}
+
+    def _process_failed(self, t):
+        t.failures += 1
+        if t.failures >= self.failure_max:
+            self.discarded.append(t)   # give up (failure cap)
+        else:
+            self.todo.append(t)        # retry
+
+    def _check_timeouts(self):
+        """service.go:341 checkTimeoutFunc: expired leases fail over."""
+        while not self._stopped:
+            time.sleep(self._check_interval)
+            with self._lock:
+                now = time.monotonic()
+                expired = [tid for tid, (_, dl, _) in self.pending.items()
+                           if dl <= now]
+                for tid in expired:
+                    t, _, _ = self.pending.pop(tid)
+                    self._process_failed(t)
+                if expired:
+                    self._snapshot()
+
+    # ---- service plumbing ----
+    def _dispatch(self, msg):
+        cmd = msg.get("cmd")
+        if cmd == "get_task":
+            return self.get_task(msg.get("worker", "?"))
+        if cmd == "task_finished":
+            return self.task_finished(msg["task_id"])
+        if cmd == "task_failed":
+            return self.task_failed(msg["task_id"])
+        if cmd == "set_dataset":
+            return self.set_dataset(msg["payloads"])
+        if cmd == "master_state":
+            with self._lock:
+                st = self._state()
+                st["pending_count"] = len(self.pending)
+                return {"ok": True, "state": st}
+        if cmd == "exit":
+            self._stopped = True
+            return _CLOSE
+        return {"error": "unknown cmd %r" % cmd}
+
+    def start(self, background=True):
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        reply = outer._dispatch(msg)
+                        if reply is _CLOSE:
+                            _send_msg(self.request, {"ok": True})
+                            break
+                        _send_msg(self.request, reply)
+                except (ConnectionError, EOFError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server(self._addr, Handler)
+        self._addr = self._server.server_address
+        th = threading.Thread(target=self._serve, daemon=True)
+        tt = threading.Thread(target=self._check_timeouts, daemon=True)
+        self._threads = [th, tt]
+        th.start()
+        tt.start()
+        if not background:
+            th.join()
+        return self
+
+    @property
+    def endpoint(self):
+        return "%s:%d" % (self._addr[0], self._addr[1])
+
+    def _serve(self):
+        self._server.timeout = 0.2
+        with self._server:
+            while not self._stopped:
+                self._server.handle_request()
+
+    def stop(self):
+        self._stopped = True
+        try:
+            s = socket.create_connection(self._addr, timeout=1)
+            s.close()
+        except OSError:
+            pass
+
+
+class MasterClient:
+    """go/master/client.go: fault-tolerant master client — re-dials with
+    backoff so a master restart (recovering from its snapshot) is
+    transparent to workers."""
+
+    def __init__(self, endpoint, worker="?", dial_timeout=30.0):
+        self.endpoint = endpoint
+        self.worker = worker
+        self.dial_timeout = float(dial_timeout)
+        self._sock = None
+
+    def _call(self, msg, deadline=None):
+        deadline = deadline or (time.monotonic() + self.dial_timeout)
+        backoff = 0.05
+        while True:
+            try:
+                if self._sock is None:
+                    host, port = self.endpoint.rsplit(":", 1)
+                    self._sock = socket.create_connection(
+                        (host, int(port)), timeout=10.0)
+                _send_msg(self._sock, msg)
+                return _recv_msg(self._sock)
+            except (ConnectionError, OSError, EOFError):
+                # master died/restarting: drop the conn, back off, retry
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 1.0)
+
+    def set_dataset(self, payloads):
+        r = self._call({"cmd": "set_dataset", "payloads": list(payloads)})
+        if "error" in r:
+            raise RuntimeError(r["error"])
+        return r
+
+    def get_task(self, block=True, timeout=30.0):
+        """Lease the next task; with block=True, retries while the queue
+        is momentarily empty (other workers hold leases). Returns
+        (task_id, payload) or None when the pass is exhausted."""
+        deadline = time.monotonic() + timeout
+        while True:
+            r = self._call({"cmd": "get_task", "worker": self.worker},
+                           deadline=deadline)
+            if r.get("ok"):
+                return r["task_id"], r["payload"]
+            if r.get("retry") and block:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("get_task: %s" % r["error"])
+                time.sleep(0.05)
+                continue
+            if r.get("retry"):
+                return None
+            if "all tasks failed" in r.get("error", ""):
+                return None
+            raise RuntimeError(r["error"])
+
+    def task_finished(self, task_id):
+        r = self._call({"cmd": "task_finished", "task_id": task_id})
+        if "error" in r:
+            raise RuntimeError(r["error"])
+
+    def task_failed(self, task_id):
+        r = self._call({"cmd": "task_failed", "task_id": task_id})
+        if "error" in r:
+            raise RuntimeError(r["error"])
+
+    def state(self):
+        r = self._call({"cmd": "master_state"})
+        return r["state"]
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

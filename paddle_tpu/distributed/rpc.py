@@ -109,6 +109,7 @@ class VariableServer:
         self._send_barriers = 0
         self._fetch_barriers = 0
         self._generation = 0
+        self._trainers = {}       # trainer_id -> incarnation
         self._stopped = False
         self._server = None
         self._thread = None
@@ -180,8 +181,16 @@ class VariableServer:
             with self._lock:
                 self.store[msg["name"]] = deserialize_array(msg["var"])
             return {"ok": True}
+        if cmd == "prefetch":
+            return self._handle_prefetch(msg)
+        if cmd == "sparse_push":
+            return self._handle_sparse_push(msg)
         if cmd == "checkpoint":
             return self._handle_checkpoint(msg)
+        if cmd == "load_checkpoint":
+            return self._handle_load_checkpoint(msg)
+        if cmd == "register_trainer":
+            return self._handle_register_trainer(msg)
         if cmd == "exit":
             self._stopped = True
             with self._lock:
@@ -238,18 +247,106 @@ class VariableServer:
                 self._lock.notify_all()
         return {"ok": True, "generation": self._generation}
 
+    def _handle_prefetch(self, msg):
+        """Distributed lookup-table remote prefetch (reference
+        distributed_ops/prefetch_op.cc + lookup_sparse_table): the global
+        table is row-sharded round-robin across pservers — global row id
+        maps to shard `id % num_shards`, local row `id // num_shards`
+        (transpiler ps_dispatcher.py RoundRobin semantics on ids). This
+        server holds shard rows as a dense [ceil(V/ns), D] array."""
+        name = msg["name"]
+        ids = deserialize_array(msg["ids"]).reshape(-1).astype(np.int64)
+        ns = max(int(msg.get("num_shards", 1)), 1)
+        with self._lock:
+            table = self.store.get(name)
+            if table is None:
+                return {"error": "no table %s" % name}
+            rows = table[ids // ns].copy()
+        return {"ok": True, "var": serialize_array(rows)}
+
+    def _handle_sparse_push(self, msg):
+        """Sparse-row gradient push: applies the update directly on this
+        shard's rows (reference's pserver-side sparse optimize block for
+        the distributed lookup table; plain SGD like lookup_sparse_table's
+        default)."""
+        name = msg["name"]
+        ids = deserialize_array(msg["ids"]).reshape(-1).astype(np.int64)
+        values = deserialize_array(msg["values"])
+        lr = float(msg.get("lr", 1.0))
+        ns = max(int(msg.get("num_shards", 1)), 1)
+        with self._lock:
+            table = self.store.get(name)
+            if table is None:
+                return {"error": "no table %s" % name}
+            np.subtract.at(table, ids // ns, lr * values)
+            self._generation += 1
+        return {"ok": True}
+
+    def _ckpt_path(self, dirname):
+        import os
+        return os.path.join(
+            dirname, "pserver_%s.ckpt" % self.endpoint.replace(":", "_"))
+
     def _handle_checkpoint(self, msg):
         """checkpoint_notify (distributed_ops/checkpoint_notify_op.cc):
-        persist this shard's store to the given directory."""
+        persist this shard's store — params AND optimizer accumulators —
+        with CRC32 + metadata (go/pserver/service.go:119 checkpointMeta,
+        :145 parameterCheckpoint: etcd meta replaced by an in-file
+        header; the write is atomic via os.replace)."""
         import os
+        import time as _time
+        import uuid
+        from .elastic import save_state_snapshot
         dirname = msg["dirname"]
         os.makedirs(dirname, exist_ok=True)
         with self._lock:
             snap = {k: v.copy() for k, v in self.store.items()}
-        path = "%s/pserver_%s.npz" % (dirname,
-                                      self.endpoint.replace(":", "_"))
-        np.savez(path, **snap)
+            gen = self._generation
+        path = self._ckpt_path(dirname)
+        save_state_snapshot(path, {
+            "meta": {"uuid": uuid.uuid4().hex, "timestamp": _time.time(),
+                     "endpoint": self.endpoint, "generation": gen},
+            "store": snap,
+        })
         return {"ok": True, "path": path}
+
+    def load_checkpoint(self, dirname):
+        """go/pserver/service.go:174 LoadCheckpoint: CRC-verify and
+        restore this shard's store (raises ValueError on corruption)."""
+        from .elastic import load_state_snapshot
+        st = load_state_snapshot(self._ckpt_path(dirname))
+        with self._lock:
+            self.store.update(st["store"])
+            self._generation = st["meta"].get("generation", 0)
+        return st["meta"]
+
+    def _handle_load_checkpoint(self, msg):
+        try:
+            meta = self.load_checkpoint(msg["dirname"])
+        except (OSError, ValueError) as e:
+            return {"error": str(e)}
+        return {"ok": True, "meta": meta}
+
+    def _handle_register_trainer(self, msg):
+        """Trainer (re)join. A REJOIN — same trainer_id, new incarnation
+        — means the previous incarnation died mid-step: reset the sync
+        loop's partial state (pending grad buffers + barrier counts) so
+        surviving trainers don't deadlock on the dead trainer's barrier
+        (reference listen_and_serv_op.cc:172 NeedResetAllVars after
+        trainer rejoin)."""
+        tid = msg["trainer_id"]
+        inc = msg.get("incarnation", 0)
+        with self._lock:
+            prev = self._trainers.get(tid)
+            rejoin = prev is not None and inc > prev
+            self._trainers[tid] = inc
+            if rejoin:
+                self._grad_buffers.clear()
+                self._send_barriers = 0
+                self._fetch_barriers = 0
+                self._lock.notify_all()
+        return {"ok": True, "rejoin": bool(rejoin),
+                "generation": self._generation}
 
     # ---- optimize ----
     def _apply_all(self):
@@ -344,6 +441,28 @@ class RPCClient:
 
     def checkpoint_notify(self, ep, dirname):
         return self._call(ep, {"cmd": "checkpoint", "dirname": dirname})
+
+    def prefetch(self, ep, name, ids, num_shards=1):
+        reply = self._call(ep, {"cmd": "prefetch", "name": name,
+                                "ids": serialize_array(np.asarray(ids)),
+                                "num_shards": num_shards})
+        return deserialize_array(reply["var"])
+
+    def sparse_push(self, ep, name, ids, values, lr=1.0, num_shards=1):
+        return self._call(ep, {"cmd": "sparse_push", "name": name,
+                               "ids": serialize_array(np.asarray(ids)),
+                               "values": serialize_array(
+                                   np.asarray(values)),
+                               "lr": lr, "num_shards": num_shards})
+
+    def load_checkpoint_notify(self, ep, dirname):
+        return self._call(ep, {"cmd": "load_checkpoint",
+                               "dirname": dirname})
+
+    def register_trainer(self, ep, trainer_id, incarnation=0):
+        return self._call(ep, {"cmd": "register_trainer",
+                               "trainer_id": trainer_id,
+                               "incarnation": incarnation})
 
     def send_exit(self, ep):
         try:

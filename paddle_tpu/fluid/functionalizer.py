@@ -138,7 +138,8 @@ _ENV_OPS = frozenset(["while", "conditional_block", "write_to_array",
 HOST_OPS = frozenset([
     "send", "recv", "send_barrier", "fetch_barrier", "listen_and_serv",
     "checkpoint_notify", "gen_collective_id", "save", "load",
-    "save_combine", "load_combine", "py_func",
+    "save_combine", "load_combine", "py_func", "prefetch",
+    "sparse_table_push",
 ])
 
 

@@ -122,15 +122,25 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
     nce_inputs = {"Input": input, "Label": label, "Weight": w, "Bias": b}
     if sample_weight is not None:
         nce_inputs["SampleWeight"] = sample_weight
+    attrs = {"num_total_classes": num_total_classes,
+             "num_neg_samples": num_neg_samples, "seed": seed,
+             "sampler": {"uniform": 0, "log_uniform": 1,
+                         "custom_dist": 2}.get(sampler, 0)}
+    if sampler == "custom_dist":
+        if custom_dist is None:
+            raise ValueError("sampler='custom_dist' needs custom_dist "
+                             "(a probability per class)")
+        assert len(custom_dist) == num_total_classes
+        # reference nce feeds the distribution through alias tables
+        # (CustomDistProbs/Alias/AliasProbs); the TPU lowering samples
+        # with jax.random.categorical, so the raw probs attr suffices
+        attrs["custom_dist_probs"] = [float(p) for p in custom_dist]
     helper.append_op(
         type="nce",
         inputs=nce_inputs,
         outputs={"Cost": cost, "SampleLogits": sample_logits,
                  "SampleLabels": sample_labels},
-        attrs={"num_total_classes": num_total_classes,
-               "num_neg_samples": num_neg_samples, "seed": seed,
-               "sampler": {"uniform": 0, "log_uniform": 1,
-                           "custom_dist": 2}.get(sampler, 0)})
+        attrs=attrs)
     return cost
 
 

@@ -221,6 +221,11 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
             // dilation[1] + 1]
     elif isinstance(filter_size, int):
         filter_size = [filter_size, filter_size]
+    if input.shape[1] % groups or num_filters % groups:
+        raise ValueError(
+            "conv2d_transpose: in_channels (%d) and num_filters (%d) must "
+            "both be divisible by groups (%d)"
+            % (input.shape[1], num_filters, groups))
     filter_shape = [input.shape[1], num_filters // groups] + list(filter_size)
     w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
                                 dtype=dtype)
@@ -1120,8 +1125,7 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
         return [v] * 3 if isinstance(v, int) else list(v)
 
     stride, padding, dilation = _t(stride), _t(padding), _t(dilation)
-    if groups not in (None, 1):
-        raise NotImplementedError("grouped conv3d_transpose")
+    groups = groups or 1
     if filter_size is None:
         if output_size is None:
             raise ValueError("output_size or filter_size required")
@@ -1133,15 +1137,21 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
     else:
         fsize = _t(filter_size)
     c_in = input.shape[1]
+    if c_in % groups or num_filters % groups:
+        raise ValueError(
+            "conv3d_transpose: in_channels (%d) and num_filters (%d) must "
+            "both be divisible by groups (%d)"
+            % (c_in, num_filters, groups))
     w = helper.create_parameter(
-        attr=helper.param_attr, shape=[c_in, num_filters] + fsize,
+        attr=helper.param_attr,
+        shape=[c_in, num_filters // groups] + fsize,
         dtype=input.dtype)
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(type="conv3d_transpose",
                      inputs={"Input": input, "Filter": w},
                      outputs={"Output": out},
                      attrs={"strides": stride, "paddings": padding,
-                            "dilations": dilation})
+                            "dilations": dilation, "groups": groups})
     out = helper.append_bias_op(out, dim_start=1, dim_end=2)
     return helper.append_activation(out)
 
@@ -1340,7 +1350,20 @@ def pad_constant_like(x, y, pad_value=0.0, name=None):
 
 
 def unique_with_counts(x, dtype="int32"):
-    raise NotImplementedError("unique_with_counts needs host fallback")
+    """reference unique_with_counts_op.cc. Output sizes are
+    data-dependent, so the op runs on the eager/host path (the lowering
+    documents the jit limitation)."""
+    from .. import core as _core
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    count = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="unique_with_counts", inputs={"X": x},
+        outputs={"Out": out, "Index": index, "Count": count},
+        attrs={"dtype": _core.convert_np_dtype_to_dtype_(dtype)},
+        infer_shape=False)
+    return out, index, count
 
 
 # ---------------- random layers ----------------

@@ -9,7 +9,8 @@ from ..initializer import Constant
 from .. import core
 
 __all__ = [
-    "dynamic_lstm", "dynamic_gru", "gru_unit", "sequence_conv",
+    "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit",
+    "sequence_conv",
     "sequence_pool", "sequence_softmax", "sequence_expand",
     "sequence_first_step", "sequence_last_step", "sequence_reverse",
     "sequence_pad", "sequence_unpad", "sequence_mask", "sequence_enumerate",
@@ -55,6 +56,47 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
                "cell_activation": cell_activation,
                "candidate_activation": candidate_activation})
     return hidden, cell
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, use_peepholes=True,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", dtype="float32", name=None):
+    """reference layers/nn.py dynamic_lstmp over lstmp_op.cc: LSTM with a
+    recurrent projection layer (hidden D = size/4, projection P =
+    proj_size; the recurrence runs on the projection). Returns
+    (projection, cell) ragged outputs."""
+    helper = LayerHelper("lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    assert size % 4 == 0
+    D, P = size // 4, int(proj_size)
+    weight = helper.create_parameter(attr=helper.param_attr,
+                                     shape=[P, 4 * D], dtype=dtype)
+    proj_weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[D, P], dtype=dtype)
+    bias_size = [1, 7 * D if use_peepholes else 4 * D]
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=bias_size,
+                                   dtype=dtype, is_bias=True)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    proj.lod_level = max(input.lod_level, 1)
+    cell.lod_level = max(input.lod_level, 1)
+    inputs = {"Input": input, "Weight": weight, "ProjWeight": proj_weight,
+              "Bias": bias}
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    helper.append_op(
+        type="lstmp", inputs=inputs,
+        outputs={"Projection": proj, "Cell": cell},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation})
+    return proj, cell
 
 
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,

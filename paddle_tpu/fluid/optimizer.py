@@ -460,14 +460,99 @@ class FtrlOptimizer(Optimizer):
 
 
 class ModelAverage(Optimizer):
-    """reference optimizer.py:1365 — EMA of parameters for eval. Minimal
-    implementation: accumulate sums as persistable state via ops; apply()
-    swaps averaged params in a scope (full parity lands with contrib)."""
+    """reference optimizer.py:1365: sliding-window average of parameters
+    for evaluation. Construct AFTER optimizer.minimize(); it appends one
+    `average_accumulates` op per parameter to the main program
+    (average_accumulates_op.h windowing: sum_1/sum_2/sum_3 buffers +
+    num/old_num/updates counters). `apply(exe)` swaps the averaged values
+    into the scope — (sum_1+sum_2+sum_3)/(num+old_num) — and `restore()`
+    puts the trained values back, mirroring the reference's tiny
+    apply/restore programs with direct scope assignment."""
 
     def __init__(self, average_window_rate=0.15, min_average_window=10000,
                  max_average_window=10000, **kwargs):
-        super().__init__(learning_rate=1.0, **kwargs)
-        raise NotImplementedError("ModelAverage lands in a later milestone")
+        super().__init__(learning_rate=0.0, **kwargs)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self.params = [
+            p for p in
+            default_main_program().global_block().all_parameters()
+            if getattr(p, "do_model_average", None) is not False]
+        self._backup = {}
+        for p in self.params:
+            self._append_average_accumulate_op(p)
+
+    def _append_average_accumulate_op(self, param):
+        s1 = self._add_accumulator("sum_1", param)
+        s2 = self._add_accumulator("sum_2", param)
+        s3 = self._add_accumulator("sum_3", param)
+        num_acc = self._add_accumulator("num_accumulates", param,
+                                        dtype="int64", shape=[1])
+        old_num = self._add_accumulator("old_num_accumulates", param,
+                                        dtype="int64", shape=[1])
+        num_upd = self._add_accumulator("num_updates", param,
+                                        dtype="int64", shape=[1])
+        default_main_program().global_block().append_op(
+            type="average_accumulates",
+            inputs={"param": param, "in_sum_1": s1, "in_sum_2": s2,
+                    "in_sum_3": s3, "in_num_accumulates": num_acc,
+                    "in_old_num_accumulates": old_num,
+                    "in_num_updates": num_upd},
+            outputs={"out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,
+                     "out_num_accumulates": num_acc,
+                     "out_old_num_accumulates": old_num,
+                     "out_num_updates": num_upd},
+            attrs={"average_window": self.average_window,
+                   "min_average_window": self.min_average_window,
+                   "max_average_window": self.max_average_window},
+            infer_shape=False)
+
+    def _averaged_value(self, scope, param):
+        s = (np.asarray(scope.get(
+                self._get_accumulator("sum_1", param).name))
+             + np.asarray(scope.get(
+                 self._get_accumulator("sum_2", param).name))
+             + np.asarray(scope.get(
+                 self._get_accumulator("sum_3", param).name)))
+        n = (int(np.asarray(scope.get(self._get_accumulator(
+                "num_accumulates", param).name)).reshape(()))
+             + int(np.asarray(scope.get(self._get_accumulator(
+                 "old_num_accumulates", param).name)).reshape(())))
+        if n == 0:
+            raise RuntimeError(
+                "ModelAverage.apply() before any training step: the "
+                "window is empty (run the main program at least once so "
+                "average_accumulates sees an update)")
+        return s / n
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: averaged params in, trained params back out."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            from .executor import global_scope
+            import jax.numpy as jnp
+            scope = global_scope()
+            for p in self.params:
+                self._backup[p.name] = scope.get(p.name)
+                scope.set(p.name, jnp.asarray(
+                    self._averaged_value(scope, p),
+                    dtype=np.asarray(self._backup[p.name]).dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+        return _ctx()
+
+    def restore(self, executor=None):
+        from .executor import global_scope
+        scope = global_scope()
+        for p in self.params:
+            if p.name in self._backup:
+                scope.set(p.name, self._backup.pop(p.name))
 
 
 # fluid short aliases

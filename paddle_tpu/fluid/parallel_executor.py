@@ -114,6 +114,7 @@ class ParallelExecutor:
         if self._build_strategy.fuse_elewise_add_act_ops:
             ir_passes.get_pass("fuse_elewise_add_act_pass").apply(
                 self._main_program)
+        self._apply_gradient_scale_strategy()
         if self._build_strategy.debug_graphviz_path:
             ir_passes.get_pass(
                 "graph_viz_pass",
@@ -121,6 +122,44 @@ class ParallelExecutor:
             ).apply(self._main_program)
         # BCastParamsToDevices analogue: replicate existing scope arrays
         self._replicate_state()
+
+    def _apply_gradient_scale_strategy(self):
+        """reference details/build_strategy.h:55 GradientScaleStrategy +
+        scale_loss_grad_op_handle: how the loss-gradient seed relates to
+        the device count.
+
+        - CoeffNumDevice (default): each device seeds 1/num_devices and
+          grads SUM-reduce — identical to this build's global formulation
+          (one SPMD step over the global batch, loss already a global
+          mean), so nothing changes.
+        - One: each device seeds 1.0 and grads sum — net effect is grads
+          num_devices x larger; encoded by rewriting the backward
+          fill_constant seed (backward.py appends fill_constant(1) for
+          <loss>@GRAD) to num_devices.
+        - Customized: per-device user-supplied seeds have no analogue in
+          the single-global-computation design — rejected explicitly.
+        """
+        strat = self._build_strategy.gradient_scale_strategy
+        if strat == BuildStrategy.GradientScaleStrategy.CoeffNumDevice:
+            return
+        if strat == BuildStrategy.GradientScaleStrategy.Customized:
+            raise NotImplementedError(
+                "GradientScaleStrategy.Customized: supply a custom loss "
+                "scale by scaling the loss itself (the SPMD step is one "
+                "global computation; there is no per-device seed to feed)")
+        if self._loss_name is None:
+            return
+        from .framework import grad_var_name
+        target = grad_var_name(self._loss_name)
+        for op in self._main_program.global_block().ops:
+            if op.type == "fill_constant" and \
+                    op.outputs.get("Out", [None])[0] == target:
+                if not op.attrs.get("@grad_scale_applied"):
+                    op.attrs["value"] = float(op.attrs.get("value", 1.0)) \
+                        * self._num_devices
+                    op.attrs["@grad_scale_applied"] = True
+                    self._main_program._bump_version()
+                break
 
     @property
     def device_count(self):

@@ -8,7 +8,12 @@ __all__ = ["ParamAttr", "WeightNormParamAttr"]
 class ParamAttr:
     def __init__(self, name=None, initializer=None, learning_rate=1.0,
                  regularizer=None, trainable=True, gradient_clip=None,
-                 do_model_average=False):
+                 do_model_average=None):
+        # do_model_average default None == "average" (the reference's
+        # EFFECTIVE behavior: its ParamAttr stores the flag under
+        # `model_average` while Parameter reads kwargs
+        # 'do_model_average', so the declared False default never
+        # reaches the ModelAverage filter); explicit False opts out
         self.name = name
         self.initializer = initializer
         self.learning_rate = learning_rate

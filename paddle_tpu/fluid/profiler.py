@@ -65,43 +65,70 @@ def start_profiler(state="All", tracer_option=None, output_dir=None):
         _tracing = False    # host-only profiling still works
 
 
+def _is_xla_op_event(e, pids, tids):
+    """Robust XLA-op detection across jax trace-format drift: primary
+    signal is the event's own args (hlo_category/long_name accompany
+    every XLA op in xplane-derived traces); fallback is the thread name
+    CONTAINING 'XLA Ops' under a TPU/device-ish process."""
+    args = e.get("args") or {}
+    if "hlo_category" in args or "long_name" in args:
+        return True
+    tname = str(tids.get((e.get("pid"), e.get("tid")), ""))
+    if "XLA Ops" not in tname:
+        return False
+    pname = str(pids.get(e.get("pid"), ""))
+    return ("TPU" in pname) or ("device" in pname.lower()) or not pname
+
+
 def _device_events(trace_dir):
     """Aggregate device XLA-op durations from the captured chrome trace
-    (the CUPTI kernel-table analogue)."""
+    (the CUPTI kernel-table analogue). Parse problems WARN instead of
+    silently yielding an empty table."""
+    import warnings
     out = {}
+    files = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.trace.json.gz")))
+    if not files:
+        return out
+    import zlib
     try:
-        files = sorted(glob.glob(os.path.join(
-            trace_dir, "plugins/profile/*/*.trace.json.gz")))
-        if not files:
-            return out
         data = json.load(gzip.open(files[-1]))
-        events = data.get("traceEvents", [])
-        pids, tids = {}, {}
-        for e in events:
-            if e.get("ph") == "M" and e.get("name") == "process_name":
-                pids[e["pid"]] = e["args"]["name"]
-            if e.get("ph") == "M" and e.get("name") == "thread_name":
-                tids[(e["pid"], e.get("tid"))] = e["args"]["name"]
-        for e in events:
-            if e.get("ph") != "X":
-                continue
-            if "TPU" not in str(pids.get(e.get("pid"), "")) and \
-                    "device" not in str(pids.get(e.get("pid"), "")).lower():
-                continue
-            if tids.get((e["pid"], e.get("tid")), "") != "XLA Ops":
-                continue
-            ms = e.get("dur", 0) / 1000.0
-            name = "xla::" + e["name"]
-            rec = out.get(name)
-            if rec is None:
-                out[name] = [1, ms, ms, ms]
-            else:
-                rec[0] += 1
-                rec[1] += ms
-                rec[2] = min(rec[2], ms)
-                rec[3] = max(rec[3], ms)
-    except Exception:
-        pass
+    except (OSError, ValueError, EOFError, zlib.error) as e:
+        # EOFError/zlib.error: jax was still flushing (or died writing)
+        # the trace — degrade to host-only tables, but say so
+        warnings.warn("profiler: could not parse device trace %s: %s"
+                      % (files[-1], e))
+        return out
+    events = data.get("traceEvents", [])
+    pids, tids = {}, {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        args = e.get("args") or {}
+        if e.get("name") == "process_name":
+            pids[e.get("pid")] = args.get("name", "")
+        elif e.get("name") == "thread_name":
+            tids[(e.get("pid"), e.get("tid"))] = args.get("name", "")
+    for e in events:
+        if e.get("ph") != "X" or "name" not in e:
+            continue
+        if not _is_xla_op_event(e, pids, tids):
+            continue
+        ms = e.get("dur", 0) / 1000.0
+        name = "xla::" + e["name"]
+        rec = out.get(name)
+        if rec is None:
+            out[name] = [1, ms, ms, ms]
+        else:
+            rec[0] += 1
+            rec[1] += ms
+            rec[2] = min(rec[2], ms)
+            rec[3] = max(rec[3], ms)
+    if events and not out:
+        warnings.warn(
+            "profiler: device trace parsed but no XLA-op events matched — "
+            "the jax trace format may have changed (expected X events "
+            "with hlo_category args or an 'XLA Ops' thread)")
     return out
 
 

@@ -156,3 +156,78 @@ def _listen_and_serv(ctx):
         for k, v in server.store.items():
             ctx.env[k] = v
     return {}
+
+
+@register_op("prefetch")
+def _prefetch(ctx):
+    """Distributed lookup-table remote prefetch (reference
+    distributed_ops/prefetch_op.cc): fetch embedding rows for a batch of
+    ids from the pservers holding the row-sharded table (shard = id %
+    num_endpoints, RoundRobin-on-ids). Host op: ids must be concrete."""
+    import jax
+    import jax.numpy as jnp
+    ids = ctx.input("X")
+    if isinstance(ids, jax.core.Tracer):
+        raise RuntimeError(
+            "prefetch is a host RPC op and cannot run under jit — it must "
+            "be executed by the segmented host path")
+    table = ctx.attr("table_name")
+    eps = ctx.attr("epmap", ctx.attr("endpoints", []))
+    ns = len(eps)
+    if ns == 0:
+        raise ValueError("prefetch op needs at least one endpoint "
+                         "(epmap/endpoints attr is empty)")
+    flat = np.asarray(ids).reshape(-1).astype(np.int64)
+    c = _client()
+    if flat.size == 0:
+        # empty id batch: probe shard 0 for the row width
+        probe = c.prefetch(eps[0], table, np.zeros((1,), np.int64),
+                           num_shards=ns)
+        out = np.zeros((0, probe.shape[-1]), probe.dtype)
+    else:
+        out = None
+        for s, ep in enumerate(eps):
+            sel = np.nonzero(flat % ns == s)[0]
+            if sel.size == 0:
+                continue
+            rows = c.prefetch(ep, table, flat[sel], num_shards=ns)
+            if out is None:
+                out = np.zeros((flat.size, rows.shape[-1]), rows.dtype)
+            out[sel] = rows
+    shape = tuple(np.asarray(ids).shape)
+    if shape and shape[-1] == 1:
+        shape = shape[:-1]
+    return {"Out": jnp.asarray(out.reshape(shape + (out.shape[-1],)))}
+
+
+@register_op("sparse_table_push")
+def _sparse_table_push(ctx):
+    """Companion to prefetch: push sparse row gradients of a distributed
+    lookup table back to its pserver shards (reference: split_ids +
+    send of the SelectedRows grad, applied by the pserver's sparse
+    optimize block)."""
+    import jax
+    ids = ctx.input("Ids")
+    grads = ctx.input("Grad")
+    if isinstance(ids, jax.core.Tracer) or isinstance(grads,
+                                                      jax.core.Tracer):
+        raise RuntimeError(
+            "sparse_table_push is a host RPC op and cannot run under jit")
+    table = ctx.attr("table_name")
+    eps = ctx.attr("epmap", ctx.attr("endpoints", []))
+    lr = float(ctx.attr("lr", 1.0))
+    ns = len(eps)
+    if ns == 0:
+        raise ValueError("sparse_table_push needs at least one endpoint "
+                         "(epmap/endpoints attr is empty)")
+    flat = np.asarray(ids).reshape(-1).astype(np.int64)
+    if flat.size == 0:
+        return {}                    # nothing to push this step
+    g = np.asarray(grads).reshape(flat.size, -1)
+    c = _client()
+    for s, ep in enumerate(eps):
+        sel = np.nonzero(flat % ns == s)[0]
+        if sel.size == 0:
+            continue
+        c.sparse_push(ep, table, flat[sel], g[sel], lr=lr, num_shards=ns)
+    return {}

@@ -502,8 +502,18 @@ def _nce(ctx):
             q = (jnp.log(k + 2.0) - jnp.log(k + 1.0)) / np.log(C + 1.0)
             return jnp.log(num_neg * q)
     elif sampler == 2:
-        raise NotImplementedError(
-            "nce custom_dist sampler is not supported on the TPU build")
+        # custom distribution (reference nce_op.h CustomSampler via alias
+        # tables): sample with jax.random.categorical over log-probs —
+        # mathematically the same distribution, alias method not needed
+        probs = np.asarray(ctx.attr("custom_dist_probs"), np.float32)
+        probs = probs / probs.sum()
+        logp_table = jnp.log(jnp.maximum(jnp.asarray(probs), 1e-30))
+        neg = jax.random.categorical(
+            _op_key(ctx), logp_table[None, :], axis=-1,
+            shape=(B, num_neg)).astype(jnp.int32)
+
+        def log_q_of(cls):
+            return jnp.log(num_neg) + jnp.take(logp_table, cls)
     else:
         neg = jax.random.randint(_op_key(ctx), (B, num_neg), 0, C)
 

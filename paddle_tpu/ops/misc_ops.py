@@ -316,3 +316,62 @@ def _fc_fused(ctx):
     if b is not None:
         out = out + b.reshape(1, -1)
     return {"Out": out.reshape(tuple(lead) + (w.shape[-1],))}
+
+
+# ---------------------------------------------------------------------------
+# hash (hash_op.cc/h): per input row, num_hash hashed bucket ids — the
+# reference computes XXH64(row_bytes, seed=ihash) % mod_by. The TPU
+# lowering uses a vectorized FNV-1a-style integer mix (same contract:
+# deterministic per-row bucketing, one id per seed) — the exact hash
+# function differs from xxhash, which only changes WHICH bucket a row
+# lands in, not the op's semantics.
+# ---------------------------------------------------------------------------
+
+@register_op("hash")
+def _hash(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")                 # [N, last_dim] integer ids
+    num_hash = int(ctx.attr("num_hash", 1))
+    mod_by = int(ctx.attr("mod_by", 1))
+    xi = x.astype(jnp.uint32)
+    outs = []
+    for seed in range(num_hash):
+        h = jnp.full(x.shape[:-1],
+                     np.uint32((2166136261 ^ (seed * 0x9E3779B9))
+                               & 0xFFFFFFFF),
+                     jnp.uint32)
+        for k in range(x.shape[-1]):   # static, small last dim
+            h = (h ^ xi[..., k]) * jnp.uint32(16777619)
+        # final avalanche
+        h = h ^ (h >> 15)
+        h = h * jnp.uint32(0x2C1B3C6D)
+        h = h ^ (h >> 12)
+        outs.append((h % jnp.uint32(mod_by)).astype(x.dtype))
+    out = jnp.stack(outs, axis=-1)[..., None]   # [N, num_hash, 1]
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# unique_with_counts (unique_with_counts_op.cc): data-dependent output
+# size — legal on concrete values (eager/host path); under jit it is an
+# XLA-static-shape limit.
+# ---------------------------------------------------------------------------
+
+@register_op("unique_with_counts")
+def _unique_with_counts(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")
+    if isinstance(x, jax.core.Tracer):
+        raise NotImplementedError(
+            "unique_with_counts has a data-dependent output shape and "
+            "cannot be traced under jit — run the program eagerly")
+    arr = np.asarray(x).reshape(-1)
+    uniq, index, counts = np.unique(arr, return_inverse=True,
+                                    return_counts=True)
+    from ..fluid import core as fcore
+    idx_dtype = fcore.convert_dtype_to_np(
+        ctx.attr("dtype", fcore.VarDesc.VarType.INT32))
+    return {"Out": jnp.asarray(uniq),
+            "Index": jnp.asarray(index.astype(idx_dtype)),
+            "Count": jnp.asarray(counts.astype(idx_dtype))}

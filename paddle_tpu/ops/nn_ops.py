@@ -101,13 +101,65 @@ def _conv2d_transpose(ctx):
     # maps to jax padding d*(k-1) - p per side
     jpads = [(dilations[i] * (w.shape[2 + i] - 1) - pads[i],) * 2
              for i in range(2)]
-    out = jax.lax.conv_transpose(
-        x, w, strides=strides, padding=jpads,
-        rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True)
-    if groups != 1:
-        raise NotImplementedError("grouped conv2d_transpose")
+
+    def one_group(xg, wg):
+        return jax.lax.conv_transpose(
+            xg, wg, strides=strides, padding=jpads,
+            rhs_dilation=dilations,
+            # with transpose_kernel=True the rhs spec describes the
+            # FORWARD conv kernel, so storage [in_c, out_c/g, kh, kw]
+            # maps to OIHW (O=in_c); torch-verified in test_op_tail
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            transpose_kernel=True)
+
+    if groups == 1:
+        out = one_group(x, w)
+    else:
+        # grouped deconv (conv_transpose_op.cc `groups`): filter is
+        # [in_c, out_c/g, kh, kw]; slice input channels per group and
+        # concat the per-group outputs on the channel axis
+        if x.shape[1] % groups != 0:
+            raise ValueError(
+                "conv2d_transpose: input channels (%d) must be divisible "
+                "by groups (%d)" % (x.shape[1], groups))
+        icg = x.shape[1] // groups
+        out = _jnp().concatenate(
+            [one_group(x[:, g * icg:(g + 1) * icg],
+                       w[g * icg:(g + 1) * icg]) for g in range(groups)],
+            axis=1)
+    return {"Output": out.astype(x.dtype)}
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx):
+    import jax
+    x, w = ctx.input("Input"), ctx.input("Filter")  # w: [in_c, out_c/g,...]
+    strides = _pair(ctx.attr("strides", [1, 1, 1]), 3)
+    pads = _pair(ctx.attr("paddings", [0, 0, 0]), 3)
+    dilations = _pair(ctx.attr("dilations", [1, 1, 1]), 3)
+    groups = ctx.attr("groups", 1) or 1
+    jpads = [(dilations[i] * (w.shape[2 + i] - 1) - pads[i],) * 2
+             for i in range(3)]
+
+    def one_group(xg, wg):
+        return jax.lax.conv_transpose(
+            xg, wg, strides=strides, padding=jpads,
+            rhs_dilation=dilations,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            transpose_kernel=True)
+
+    if groups == 1:
+        out = one_group(x, w)
+    else:
+        if x.shape[1] % groups != 0:
+            raise ValueError(
+                "conv3d_transpose: input channels (%d) must be divisible "
+                "by groups (%d)" % (x.shape[1], groups))
+        icg = x.shape[1] // groups
+        out = _jnp().concatenate(
+            [one_group(x[:, g * icg:(g + 1) * icg],
+                       w[g * icg:(g + 1) * icg]) for g in range(groups)],
+            axis=1)
     return {"Output": out.astype(x.dtype)}
 
 

@@ -346,3 +346,49 @@ def _install_merge_gates():
 
 
 _install_merge_gates()
+
+
+# ---------------------------------------------------------------------------
+# average_accumulates (operators/average_accumulates_op.h): the sliding-
+# window parameter-average accumulator behind ModelAverage. Three sum
+# buffers avoid precision loss: sum_1 accumulates each step, rolls into
+# sum_2 every kMaxNumAccumulates steps, and when the window exceeds
+# min/max/rate bounds everything rolls into sum_3 and the window restarts.
+# Branch-free jnp.where encoding of the reference's host branches.
+# ---------------------------------------------------------------------------
+
+@register_op("average_accumulates", stateful=True)
+def _average_accumulates(ctx):
+    jnp = _jnp()
+    p = ctx.input("param")
+    s1 = ctx.input("in_sum_1")
+    s2 = ctx.input("in_sum_2")
+    s3 = ctx.input("in_sum_3")
+    num_acc = ctx.input("in_num_accumulates").reshape(()).astype(jnp.int32)
+    old_num = ctx.input("in_old_num_accumulates").reshape(()) \
+        .astype(jnp.int32)
+    num_upd = ctx.input("in_num_updates").reshape(()).astype(jnp.int32)
+    avg_window = ctx.attr("average_window", 0.0)
+    max_w = int(ctx.attr("max_average_window", 10000))
+    min_w = int(ctx.attr("min_average_window", 10000))
+    k_max = 16384            # kMaxNumAccumulates, average_accumulates_op.h
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + p.astype(s1.dtype)
+    move = (num_upd % k_max) == 0
+    s2 = jnp.where(move, s2 + s1, s2)
+    s1 = jnp.where(move, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(
+        jnp.asarray(max_w, jnp.float32),
+        num_upd.astype(jnp.float32) * np.float32(avg_window))
+    roll = (num_acc >= min_w) & (num_acc.astype(jnp.float32) >= window)
+    s3 = jnp.where(roll, s1 + s2, s3)
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(roll, jnp.zeros_like(s2), s2)
+    old_num = jnp.where(roll, num_acc, old_num)
+    num_acc = jnp.where(roll, 0, num_acc)
+    return {"out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,
+            "out_num_accumulates": num_acc.reshape((1,)),
+            "out_old_num_accumulates": old_num.reshape((1,)),
+            "out_num_updates": num_upd.reshape((1,))}

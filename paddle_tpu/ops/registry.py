@@ -330,9 +330,57 @@ def _amp_cast(vals, to_dtype):
     return out
 
 
+class OpError(RuntimeError):
+    """An op lowering failed; the message carries the op's identity and
+    its inputs' shapes/dtypes (reference platform/enforce.h: every kernel
+    error surfaces with operator context instead of a bare backend
+    trace)."""
+
+
+def _describe_inputs(op, inputs):
+    lines = []
+    for slot, names in op.inputs.items():
+        vals = inputs.get(slot, [])
+        for i, n in enumerate(names):
+            v = vals[i] if i < len(vals) else None
+            if v is None:
+                desc = "<missing>"
+            elif hasattr(v, "shape"):
+                desc = "shape=%s dtype=%s" % (tuple(v.shape),
+                                              getattr(v, "dtype", "?"))
+            else:
+                desc = type(v).__name__
+            lines.append("    %s[%d] '%s': %s" % (slot, i, n, desc))
+    return lines
+
+
 def call_lower(od, ctx):
-    """All lowering invocations go through here so AMP casts sit inside the
-    traced (and differentiated) computation."""
+    """All lowering invocations go through here so (a) AMP casts sit
+    inside the traced computation and (b) failures re-raise with op
+    context — type, input names/shapes/dtypes (enforce.h analogue)."""
+    try:
+        return _call_lower_inner(od, ctx)
+    except (OpError, NotImplementedError):
+        raise                     # already actionable / intentional
+    except Exception as e:
+        lines = ["%s: %s" % (type(e).__name__, e),
+                 "  [operator context] op '%s' failed during lowering"
+                 % od.type]
+        lines += _describe_inputs(ctx.op, ctx._inputs)
+        attrs = {}
+        for k, v in ctx.attrs.items():
+            if k == "sub_block" or k.startswith("fwd_"):
+                continue
+            r = repr(v)
+            # cap each attr: a custom_dist_probs list can hold the whole
+            # vocab — the context must stay readable
+            attrs[k] = r if len(r) <= 200 else r[:200] + "...<truncated>"
+        if attrs:
+            lines.append("    attrs: %s" % attrs)
+        raise OpError("\n".join(lines)) from e
+
+
+def _call_lower_inner(od, ctx):
     if not _AMP["enabled"]:
         return od.lower(ctx)
     import jax.numpy as jnp

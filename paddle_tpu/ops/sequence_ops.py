@@ -36,6 +36,20 @@ def _expand_mask(m, ref):
     return m.reshape(m.shape + (1,) * (ref.ndim - 2))
 
 
+def _reverse_valid(x, lens):
+    """Reverse each row's VALID prefix along the time axis, leaving the
+    padded tail in place (the sequence-reverse gather shared by
+    sequence_reverse, the reversed lstm/gru scans, and their output
+    un-reversal)."""
+    jnp = _jnp()
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T)[None, :]
+    idx = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+    return jnp.take_along_axis(
+        x, idx.reshape((B, T) + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1)
+
+
 # ---------------------------------------------------------------------------
 # pooling / steps (sequence_pool_op.cc)
 # ---------------------------------------------------------------------------
@@ -115,12 +129,21 @@ def _sequence_softmax(ctx):
 
 @register_op("sequence_mask")
 def _sequence_mask(ctx):
+    import jax
     jnp = _jnp()
     x = ctx.input("X")  # lengths tensor
     maxlen = ctx.attr("maxlen", -1)
     if maxlen is None or maxlen < 0:
-        raise NotImplementedError(
-            "sequence_mask needs a static maxlen on XLA")
+        # dynamic maxlen = max(x): a data-dependent OUTPUT SHAPE. Legal
+        # when x is concrete (eager/host path); under jit it is an
+        # XLA-static-shape limit (reference sequence_mask_op.cc computed
+        # the max on the host at kernel time).
+        if isinstance(x, jax.core.Tracer):
+            raise NotImplementedError(
+                "sequence_mask with maxlen=-1 has a data-dependent output "
+                "shape and cannot be traced under jit — pass a static "
+                "maxlen, or run the program eagerly")
+        maxlen = int(np.max(np.asarray(x))) if np.asarray(x).size else 0
     from ..fluid import core as fcore
     dtype = fcore.convert_dtype_to_np(ctx.attr("out_dtype",
                                                fcore.VarDesc.VarType.INT64))
@@ -137,12 +160,7 @@ def _sequence_reverse(ctx):
     B, T = x.shape[0], x.shape[1]
     if lens is None:
         lens = jnp.full((B,), T, jnp.int32)
-    t = jnp.arange(T)[None, :]
-    idx = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
-    out = jnp.take_along_axis(
-        x, idx.reshape((B, T) + (1,) * (x.ndim - 2)).astype(jnp.int32),
-        axis=1)
-    return {"Y": out, "Y@LOD_LEN": lens}
+    return {"Y": _reverse_valid(x, lens), "Y@LOD_LEN": lens}
 
 
 @register_op("sequence_expand")
@@ -152,8 +170,34 @@ def _sequence_expand(ctx):
     ylens = ctx.lod_len("Y")
     if ylens is None:
         ylens = jnp.full((y.shape[0],), y.shape[1], jnp.int32)
-    if x.ndim == y.ndim:  # already ragged: repeat rows — not needed yet
-        raise NotImplementedError("sequence_expand of ragged X")
+    if x.ndim == y.ndim:
+        # ragged X: each x sequence repeats per Y's ref-level lod
+        # (sequence_expand_op.h). The reference's output row count is
+        # data-dependent; the static-shape encoding supports the common
+        # beam-style case where Y holds a STATIC integer multiple of X's
+        # rows (By = Bx * k): row i of X is tiled to output rows
+        # i*k..i*k+k-1, each masked to Y's per-row length.
+        xlens = ctx.lod_len("X")
+        Bx, By = x.shape[0], y.shape[0]
+        if By % Bx != 0:
+            raise NotImplementedError(
+                "sequence_expand of ragged X needs a data-dependent output "
+                "row count (an XLA-static-shape limit) unless Y's rows are "
+                "a static multiple of X's (got X rows %d, Y rows %d)"
+                % (Bx, By))
+        k = By // Bx
+        out = jnp.repeat(x, k, axis=0)            # [By, Tx, ...]
+        Tx, Ty = x.shape[1], y.shape[1]
+        if Ty <= Tx:
+            out = out[:, :Ty]
+        else:
+            pad = [(0, 0), (0, Ty - Tx)] + [(0, 0)] * (x.ndim - 2)
+            out = jnp.pad(out, pad)
+        out_lens = jnp.minimum(
+            jnp.repeat(xlens, k, axis=0) if xlens is not None
+            else jnp.full((By,), Tx, jnp.int32), ylens)
+        m = _expand_mask(_mask(out_lens, Ty, x.dtype), out)
+        return {"Out": out * m, "Out@LOD_LEN": out_lens}
     # dense X [B, D] -> ragged [B, Ty, D] tiling each row along time
     T = y.shape[1]
     out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
@@ -259,9 +303,32 @@ def _sequence_slice(ctx):
 
 @register_op("sequence_erase")
 def _sequence_erase(ctx):
-    raise NotImplementedError(
-        "sequence_erase changes per-row lengths data-dependently; "
-        "host-side fallback lands with the tokenizer utilities")
+    """sequence_erase_op.cc: drop the listed tokens from each sequence,
+    compacting the survivors left. Static-shape encoding: output keeps
+    the padded [B, T] extent, survivors stable-compacted to the front,
+    new per-row lengths in the LoD companion."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    tokens = ctx.attr("tokens", []) or []
+    lens = ctx.lod_len("X")
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    xx = x[..., 0] if squeeze else x
+    B, T = xx.shape[0], xx.shape[1]
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    keep = valid
+    for t in tokens:
+        keep = keep & (xx != t)
+    new_lens = keep.sum(axis=1).astype(jnp.int32)
+    # stable argsort of (not keep): kept positions first, original order
+    order = jnp.argsort(jnp.logical_not(keep), axis=1, stable=True)
+    out = jnp.take_along_axis(xx, order, axis=1)
+    out = jnp.where(jnp.arange(T)[None, :] < new_lens[:, None], out,
+                    jnp.zeros_like(out))
+    if squeeze:
+        out = out[..., None]
+    return {"Out": out, "Out@LOD_LEN": new_lens}
 
 
 @register_op("sequence_reshape")
@@ -333,11 +400,7 @@ def _lstm_scan(x, lens, w, bias, h0, c0, use_peepholes, is_reverse):
     ms = jnp.swapaxes(m, 0, 1)[..., None]  # [T, B, 1]
     if is_reverse:
         # reverse valid region: scan over reversed-valid-order indices
-        t = jnp.arange(T)[None, :]
-        idx = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
-        x_rev = jnp.take_along_axis(x, idx[..., None].astype(jnp.int32),
-                                    axis=1)
-        xs = jnp.swapaxes(x_rev, 0, 1)
+        xs = jnp.swapaxes(_reverse_valid(x, lens), 0, 1)
 
     def step(carry, inp):
         h, c = carry
@@ -363,12 +426,8 @@ def _lstm_scan(x, lens, w, bias, h0, c0, use_peepholes, is_reverse):
     hidden = jnp.swapaxes(hs, 0, 1)
     cell = jnp.swapaxes(cs, 0, 1)
     if is_reverse:
-        t = jnp.arange(T)[None, :]
-        idx = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
-        hidden = jnp.take_along_axis(hidden,
-                                     idx[..., None].astype(jnp.int32), axis=1)
-        cell = jnp.take_along_axis(cell,
-                                   idx[..., None].astype(jnp.int32), axis=1)
+        hidden = _reverse_valid(hidden, lens)
+        cell = _reverse_valid(cell, lens)
     return hidden, cell
 
 
@@ -397,9 +456,40 @@ def _lstm(ctx):
             "Hidden@LOD_LEN": lens, "Cell@LOD_LEN": lens}
 
 
+def _gru_scan(x, lens, w, h0, is_reverse):
+    """Shared GRU recurrence over pre-projected (+bias) gates x [B,T,3H]
+    (fluid gate layout: update u, reset r, then candidate)."""
+    import jax
+    jnp = _jnp()
+    H = x.shape[2] // 3
+    T = x.shape[1]
+    if is_reverse:
+        x = _reverse_valid(x, lens)
+    m = _mask(lens, T, x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(m, 0, 1)[..., None]
+    w_rz = w[:, :2 * H]
+    w_c = w[:, 2 * H:]
+
+    def step(h, inp):
+        xt, mt = inp
+        xrz, xc = xt[:, :2 * H], xt[:, 2 * H:]
+        rz = jax.nn.sigmoid(xrz + h @ w_rz)
+        u, r = jnp.split(rz, 2, axis=-1)
+        cand = jnp.tanh(xc + (r * h) @ w_c)
+        h_new = u * h + (1 - u) * cand
+        h = mt * h_new + (1 - mt) * h
+        return h, h * mt
+
+    _, hs = jax.lax.scan(step, h0, (xs, ms))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        hidden = _reverse_valid(hidden, lens)
+    return hidden
+
+
 @register_op("gru")
 def _gru(ctx):
-    import jax
     jnp = _jnp()
     x = ctx.input("Input")     # [B, T, 3H]
     w = ctx.input("Weight")    # [H, 3H]: [:, :2H] update/reset, [:, 2H:] cand
@@ -414,25 +504,7 @@ def _gru(ctx):
         h0 = jnp.zeros((B, H), x.dtype)
     if bias is not None:
         x = x + bias.reshape(1, 1, 3 * H)
-    m = _mask(lens, T, x.dtype)
-    xs = jnp.swapaxes(x, 0, 1)
-    ms = jnp.swapaxes(m, 0, 1)[..., None]
-    w_rz = w[:, :2 * H]
-    w_c = w[:, 2 * H:]
-
-    def step(h, inp):
-        xt, mt = inp
-        xrz, xc = xt[:, :2 * H], xt[:, 2 * H:]
-        rz = jax.nn.sigmoid(xrz + h @ w_rz)
-        # fluid gru layout: update gate u first, then reset gate r
-        u, r = jnp.split(rz, 2, axis=-1)
-        cand = jnp.tanh(xc + (r * h) @ w_c)
-        h_new = u * h + (1 - u) * cand
-        h = mt * h_new + (1 - mt) * h
-        return h, h * mt
-
-    h_fin, hs = jax.lax.scan(step, h0, (xs, ms))
-    hidden = jnp.swapaxes(hs, 0, 1)
+    hidden = _gru_scan(x, lens, w, h0, ctx.attr("is_reverse", False))
     return {"Hidden": hidden, "Hidden@LOD_LEN": lens,
             "BatchGate": x, "BatchResetHiddenPrev": hidden,
             "BatchHidden": hidden}
@@ -562,3 +634,236 @@ def _gru_unit(ctx):
     cand = jnp.tanh(xc + (r * h_prev) @ w[:, 2 * H:])
     h = u * h_prev + (1 - u) * cand
     return {"Hidden": h, "Gate": rz, "ResetHiddenPrev": r * h_prev}
+
+
+# ---------------------------------------------------------------------------
+# LSTMP (lstmp_op.cc): LSTM with a recurrent projection layer — the
+# recurrence runs on the projection r (dim P), gates on the hidden (dim D)
+# ---------------------------------------------------------------------------
+
+@register_op("lstmp")
+def _lstmp(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("Input")          # [B, T, 4D] pre-projected gates from x
+    w = ctx.input("Weight")         # [P, 4D] recurrent projection->gates
+    w_proj = ctx.input("ProjWeight")  # [D, P]
+    bias = ctx.input("Bias")        # [1, 4D] (+3D peephole)
+    lens = ctx.lod_len("Input")
+    B, T = x.shape[0], x.shape[1]
+    D = x.shape[2] // 4
+    P = w_proj.shape[1]
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    h0 = ctx.input("H0")            # ordered projection init [B, P]
+    c0 = ctx.input("C0")
+    if h0 is None:
+        h0 = jnp.zeros((B, P), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, D), x.dtype)
+    use_peepholes = ctx.attr("use_peepholes", True) and \
+        bias.shape[-1] == 7 * D
+    b_gate = bias[..., :4 * D].reshape(1, 4 * D)
+    if use_peepholes:
+        w_ic = bias[..., 4 * D:5 * D].reshape(1, D)
+        w_fc = bias[..., 5 * D:6 * D].reshape(1, D)
+        w_oc = bias[..., 6 * D:7 * D].reshape(1, D)
+    proj_act = ctx.attr("proj_activation", "tanh")
+
+    def proj_fn(v):
+        return jnp.tanh(v) if proj_act == "tanh" else (
+            jax.nn.sigmoid(v) if proj_act == "sigmoid" else v)
+
+    is_reverse = ctx.attr("is_reverse", False)
+    if is_reverse:
+        x = _reverse_valid(x, lens)
+    m = _mask(lens, T, x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(m, 0, 1)[..., None]
+
+    def step(carry, inp):
+        r, c = carry               # projection [B, P], cell [B, D]
+        xt, mt = inp
+        gates = xt + r @ w + b_gate
+        i, f, cand, o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            i = i + c * w_ic
+            f = f + c * w_fc
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        cand = jnp.tanh(cand)
+        c_new = f * c + i * cand
+        if use_peepholes:
+            o = o + c_new * w_oc
+        o = jax.nn.sigmoid(o)
+        h_new = o * jnp.tanh(c_new)
+        r_new = proj_fn(h_new @ w_proj)
+        r2 = mt * r_new + (1 - mt) * r
+        c2 = mt * c_new + (1 - mt) * c
+        return (r2, c2), (r2 * mt, c2 * mt)
+
+    (_, _), (rs, cs) = jax.lax.scan(step, (h0, c0), (xs, ms))
+    proj = jnp.swapaxes(rs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        proj = _reverse_valid(proj, lens)
+        cell = _reverse_valid(cell, lens)
+    return {"Projection": proj, "Cell": cell,
+            "Projection@LOD_LEN": lens, "Cell@LOD_LEN": lens}
+
+
+# ---------------------------------------------------------------------------
+# fused RNNs (fused/fusion_lstm_op.cc, fused/fusion_gru_op.cc): the
+# reference fuses the x-projection GEMM into the recurrence for CPU speed;
+# under XLA the same effect falls out of jit fusion, so these lowerings
+# simply do xx = x @ WeightX (+ bias) and reuse the scan cells.
+# ---------------------------------------------------------------------------
+
+@register_op("fusion_lstm")
+def _fusion_lstm(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")              # [B, T, M]
+    wx = ctx.input("WeightX")       # [M, 4D]
+    wh = ctx.input("WeightH")       # [D, 4D]
+    bias = ctx.input("Bias")        # [1, 4D] (+3D peephole)
+    lens = ctx.lod_len("X")
+    B, T = x.shape[0], x.shape[1]
+    D = wh.shape[0]
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    if h0 is None:
+        h0 = jnp.zeros((B, D), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, D), x.dtype)
+    xx = jnp.einsum("btm,mh->bth", x, wx)
+    use_peepholes = ctx.attr("use_peepholes", False) and \
+        bias.shape[-1] == 7 * D
+    hidden, cell = _lstm_scan(xx, lens, wh, bias, h0, c0, use_peepholes,
+                              ctx.attr("is_reverse", False))
+    return {"Hidden": hidden, "Cell": cell, "XX": xx,
+            "Hidden@LOD_LEN": lens, "Cell@LOD_LEN": lens}
+
+
+@register_op("fusion_gru")
+def _fusion_gru(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")              # [B, T, M]
+    wx = ctx.input("WeightX")       # [M, 3D]
+    wh = ctx.input("WeightH")       # [D, 3D]
+    bias = ctx.input("Bias")        # [1, 3D]
+    lens = ctx.lod_len("X")
+    B, T = x.shape[0], x.shape[1]
+    D = wh.shape[0]
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    h0 = ctx.input("H0")
+    if h0 is None:
+        h0 = jnp.zeros((B, D), x.dtype)
+    xx = jnp.einsum("btm,mh->bth", x, wx)
+    if bias is not None:
+        xx = xx + bias.reshape(1, 1, 3 * D)
+    hidden = _gru_scan(xx, lens, wh, h0, ctx.attr("is_reverse", False))
+    return {"Hidden": hidden, "XX": xx, "Hidden@LOD_LEN": lens}
+
+
+# ---------------------------------------------------------------------------
+# attention LSTM (fused/attention_lstm_op.cc): per step, attend over the
+# whole input sequence with the previous cell state, pool an lstm input,
+# then a standard [x; h] LSTM step
+# ---------------------------------------------------------------------------
+
+@register_op("attention_lstm")
+def _attention_lstm(ctx):
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")              # [B, T, M]
+    c0 = ctx.input("C0")            # [B, D]
+    h0 = ctx.input("H0")
+    att_w = ctx.input("AttentionWeight")      # [M+D, 1]
+    att_b = ctx.input("AttentionBias")        # [1, 1] or None
+    att_scalar = ctx.input("AttentionScalar")       # [1, 1] or None
+    att_scalar_b = ctx.input("AttentionScalarBias")
+    lstm_w = ctx.input("LSTMWeight")          # [D+M, 4D]
+    lstm_b = ctx.input("LSTMBias")            # [1, 4D]
+    lens = ctx.lod_len("X")
+    B, T, M = x.shape
+    D = c0.shape[1]
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    if h0 is None:
+        h0 = jnp.zeros((B, D), x.dtype)
+    valid = _mask(lens, T, x.dtype)           # [B, T]
+    w_x, w_h = att_w[:M], att_w[M:]           # [M,1], [D,1]
+    lw_x, lw_h = lstm_w[D:], lstm_w[:D]       # gates = [h; x] @ W
+    # x's attention fc contribution is step-invariant: precompute
+    att_x = jnp.einsum("btm,mo->bto", x, w_x)[..., 0]   # [B, T]
+
+    def step(carry, t_idx):
+        h, c = carry
+        score = att_x + (c @ w_h)[..., 0][:, None]       # [B, T]
+        if att_b is not None:
+            score = score + att_b.reshape(())
+        score = jax.nn.relu(score)
+        if att_scalar is not None:
+            score = score * att_scalar.reshape(())
+        if att_scalar_b is not None:
+            score = score + att_scalar_b.reshape(())
+        score = jax.nn.relu(score)
+        score = jnp.where(valid > 0, score, -1e30)
+        alpha = jax.nn.softmax(score, axis=1) * valid    # [B, T]
+        lstm_x = jnp.einsum("bt,btm->bm", alpha, x)      # [B, M]
+        gates = h @ lw_h + lstm_x @ lw_x + lstm_b.reshape(1, -1)
+        i, f, cand, o = jnp.split(gates, 4, axis=-1)
+        # reference attention_lstm uses sigmoid gates + tanh cand (the
+        # fused kernel's default act_gate/act_cell/act_cand)
+        i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                   jax.nn.sigmoid(o))
+        c_new = f * c + i * jnp.tanh(cand)
+        h_new = o * jnp.tanh(c_new)
+        mt = (t_idx < lens).astype(x.dtype)[:, None]
+        h2 = mt * h_new + (1 - mt) * h
+        c2 = mt * c_new + (1 - mt) * c
+        return (h2, c2), (h2 * mt, c2 * mt)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), jnp.arange(T))
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    return {"Hidden": hidden, "Cell": cell,
+            "Hidden@LOD_LEN": lens, "Cell@LOD_LEN": lens}
+
+
+def _infer_lstmp(op, block):
+    s = _in_shape(block, op, "Input")
+    pw = _in_shape(block, op, "ProjWeight")
+    if s and pw:
+        _set_out(block, op, "Projection", (-1, pw[1]))
+        _set_out(block, op, "Cell", (-1, s[-1] // 4))
+
+
+def _infer_fusion_lstm(op, block):
+    wh = _in_shape(block, op, "WeightH")
+    if wh:
+        _set_out(block, op, "Hidden", (-1, wh[0]))
+        _set_out(block, op, "Cell", (-1, wh[0]))
+
+
+def _infer_fusion_gru(op, block):
+    wh = _in_shape(block, op, "WeightH")
+    if wh:
+        _set_out(block, op, "Hidden", (-1, wh[0]))
+
+
+def _infer_attention_lstm(op, block):
+    c0 = _in_shape(block, op, "C0")
+    if c0:
+        _set_out(block, op, "Hidden", (-1, c0[-1]))
+        _set_out(block, op, "Cell", (-1, c0[-1]))
+
+
+_R["lstmp"].custom_infer_shape = _infer_lstmp
+_R["fusion_lstm"].custom_infer_shape = _infer_fusion_lstm
+_R["fusion_gru"].custom_infer_shape = _infer_fusion_gru
+_R["attention_lstm"].custom_infer_shape = _infer_attention_lstm

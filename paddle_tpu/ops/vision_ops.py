@@ -144,24 +144,7 @@ def _pool3d(ctx):
     return {"Out": out}
 
 
-@register_op("conv3d_transpose")
-def _conv3d_transpose(ctx):
-    import jax
-    x, w = ctx.input("Input"), ctx.input("Filter")
-    strides = _triple(ctx.attr("strides", [1, 1, 1]))
-    pads = _triple(ctx.attr("paddings", [0, 0, 0]))
-    dilations = _triple(ctx.attr("dilations", [1, 1, 1]))
-    # filter layout IODHW (reference conv_transpose filter [C_in, C_out,
-    # D, H, W]); jax applies `padding` to the dilated input directly, so
-    # the reference's deconv padding p maps to d*(k-1) - p per side
-    jpads = [(dilations[i] * (w.shape[2 + i] - 1) - pads[i],) * 2
-             for i in range(3)]
-    out = jax.lax.conv_transpose(
-        x, w, strides=strides, padding=jpads,
-        rhs_dilation=dilations,
-        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
-        transpose_kernel=True)
-    return {"Output": out.astype(x.dtype)}
+# conv3d_transpose lives in nn_ops.py (grouped + torch-verified numerics)
 
 
 @register_op("unpool")

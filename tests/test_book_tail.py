@@ -1,0 +1,172 @@
+"""Book e2e tail (VERDICT r2 task #10; reference
+python/paddle/fluid/tests/book/test_label_semantic_roles.py and
+test_rnn_encoder_decoder.py): SRL with embeddings + LSTM + CRF over the
+conll05 reader, and a seq2seq encoder-decoder over wmt16 — both train
+end-to-end (loss decreases) through the ragged-LoD pipeline."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.dataset as dataset
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.lod import LoDTensor
+
+
+def _to_lod(seqs, dtype=np.int64, extra_dim=True):
+    """list of python lists -> LoDTensor ([sum, 1] like fluid int feeds)."""
+    flat = np.concatenate([np.asarray(s, dtype) for s in seqs])
+    if extra_dim:
+        flat = flat.reshape(-1, 1)
+    lod = [0]
+    for s in seqs:
+        lod.append(lod[-1] + len(s))
+    t = LoDTensor(flat)
+    t.set_lod([lod])
+    return t
+
+
+def test_label_semantic_roles_trains():
+    """db_lstm-style SRL (book/test_label_semantic_roles.py): 8 feature
+    embeddings + LSTM + fc emission + linear-chain CRF loss, fed by the
+    conll05 reader."""
+    word_dict, verb_dict, label_dict = dataset.conll05.get_dict()
+    word_v, verb_v = 200, 50     # small synthetic slices of the vocabs
+    n_labels = len(label_dict)
+    emb_dim, hidden = 16, 32
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        feats = []
+        names = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2",
+                 "verb", "mark"]
+        for nm in names:
+            v = fluid.layers.data(nm, shape=[1], dtype="int64",
+                                  lod_level=1)
+            vocab = verb_v if nm == "verb" else (2 if nm == "mark"
+                                                 else word_v)
+            feats.append(fluid.layers.embedding(
+                v, size=[vocab, emb_dim], dtype="float32"))
+        concat = fluid.layers.concat(feats, axis=-1)
+        proj = fluid.layers.fc(concat, size=4 * hidden)
+        h, c = fluid.layers.dynamic_lstm(proj, size=4 * hidden,
+                                         use_peepholes=False)
+        emission = fluid.layers.fc(h, size=n_labels)
+        label = fluid.layers.data("label", shape=[1], dtype="int64",
+                                  lod_level=1)
+        crf_cost = fluid.layers.linear_chain_crf(
+            emission, label,
+            param_attr=fluid.ParamAttr(name="crfw"))
+        loss = fluid.layers.mean(crf_cost)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    rng = np.random.RandomState(0)
+    reader = dataset.conll05.test()()
+    batch = [next(reader) for _ in range(8)]
+    feed = {}
+    for i, nm in enumerate(names):
+        seqs = [[min(t, (verb_v if nm == "verb" else
+                         (1 if nm == "mark" else word_v)) - 1)
+                 for t in sample[i]] for sample in batch]
+        feed[nm] = _to_lod(seqs)
+    feed["label"] = _to_lod(
+        [[min(t, n_labels - 1) for t in s[8]] for s in batch])
+
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(6):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).flatten()[0]))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_rnn_encoder_decoder_trains():
+    """seq2seq encoder-decoder (book/test_rnn_encoder_decoder.py): LSTM
+    encoder (last step) seeds a DynamicRNN decoder; wmt16 feeds."""
+    src_v, trg_v = 64, 64
+    emb_dim, hidden = 16, 24
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[1], dtype="int64",
+                                lod_level=1)
+        trg = fluid.layers.data("trg", shape=[1], dtype="int64",
+                                lod_level=1)
+        nxt = fluid.layers.data("nxt", shape=[1], dtype="int64",
+                                lod_level=1)
+        src_emb = fluid.layers.embedding(src, size=[src_v, emb_dim],
+                                         dtype="float32")
+        proj = fluid.layers.fc(src_emb, size=4 * hidden)
+        enc_h, enc_c = fluid.layers.dynamic_lstm(proj, size=4 * hidden,
+                                                 use_peepholes=False)
+        enc_last = fluid.layers.sequence_last_step(enc_h)
+
+        trg_emb = fluid.layers.embedding(trg, size=[trg_v, emb_dim],
+                                         dtype="float32")
+        rnn = fluid.layers.DynamicRNN()
+        with rnn.block():
+            cur = rnn.step_input(trg_emb)
+            prev = rnn.memory(init=enc_last)
+            out = fluid.layers.fc(
+                fluid.layers.concat([cur, prev], axis=-1), size=hidden,
+                act="tanh")
+            rnn.update_memory(prev, out)
+            rnn.output(out)
+        dec = rnn()
+        logits = fluid.layers.fc(dec, size=trg_v)
+        prob = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=prob, label=nxt))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    reader = dataset.wmt16.train(src_v, trg_v)()
+    batch = [next(reader) for _ in range(6)]
+    feed = {
+        "src": _to_lod([[min(t, src_v - 1) for t in s[0]]
+                        for s in batch]),
+        "trg": _to_lod([[min(t, trg_v - 1) for t in s[1]]
+                        for s in batch]),
+        "nxt": _to_lod([[min(t, trg_v - 1) for t in s[2]]
+                        for s in batch]),
+    }
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(8):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).flatten()[0]))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_new_dataset_readers_shapes():
+    """The 7 round-3 readers produce reference-shaped samples."""
+    s = next(dataset.conll05.test()())
+    assert len(s) == 9 and len(s[0]) == len(s[8])
+    s = next(dataset.imikolov.train(dataset.imikolov.build_dict(), 5)())
+    assert len(s) == 5
+    s = next(dataset.imikolov.train(
+        dataset.imikolov.build_dict(), -1,
+        dataset.imikolov.DataType.SEQ)())
+    assert len(s[0]) == len(s[1])
+    s = next(dataset.sentiment.train()())
+    assert s[1] in (0, 1) and len(s[0]) >= 10
+    s = next(dataset.wmt16.train(100, 100)())
+    assert s[0][0] == 0 and s[0][-1] == 1          # <s> ... <e>
+    assert len(s[1]) == len(s[2])
+    img, lab = next(dataset.flowers.train()())
+    assert img.shape == (3, 224, 224) and 0 <= lab < 102
+    s = next(dataset.mq2007.train(format="pairwise")())
+    assert s[1].shape == (46,) and s[2].shape == (46,)
+    rel, feats = next(dataset.mq2007.train(format="listwise")())
+    assert feats.shape == (len(rel), 46)
+    img, seg = next(dataset.voc2012.train()())
+    assert img.shape[0] == 3 and seg.shape == img.shape[1:]
+    assert seg.max() < 21
+    # determinism
+    a = next(dataset.sentiment.train()())
+    b = next(dataset.sentiment.train()())
+    assert a[0] == b[0] and a[1] == b[1]

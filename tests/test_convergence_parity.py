@@ -125,3 +125,51 @@ def test_transformer_convergence_parity():
     losses = check_network_convergence(
         _transformer_build, _transformer_feeds(4), steps=4, delta=1e-4)
     assert np.isfinite(losses).all()
+
+
+def test_gradient_scale_strategy_one():
+    """BuildStrategy.GradientScaleStrategy.One (reference
+    build_strategy.h:55 + scale_loss_grad_op_handle): per-device seed 1.0
+    with sum-reduce == grads num_devices x CoeffNumDevice's. With SGD the
+    first parameter delta must scale by exactly the device count."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.framework import Program
+
+    def build():
+        main, startup = Program(), Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, size=1, bias_attr=False)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(13)
+    feed = {"x": rng.randn(8, 4).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32)}
+
+    deltas = {}
+    for strat_name in ("coeff", "one"):
+        with fluid.unique_name.guard():
+            main, startup, loss = build()
+        pname = main.global_block().all_parameters()[0].name
+        strategy = fluid.BuildStrategy()
+        if strat_name == "one":
+            strategy.gradient_scale_strategy = \
+                fluid.BuildStrategy.GradientScaleStrategy.One
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            before = np.asarray(scope.get(pname)).copy()
+            pe = fluid.ParallelExecutor(use_cuda=False,
+                                        loss_name=loss.name,
+                                        main_program=main,
+                                        build_strategy=strategy)
+            pe.run(fetch_list=[loss.name], feed=feed)
+            after = np.asarray(scope.get(pname))
+            deltas[strat_name] = after - before
+    ratio = deltas["one"] / deltas["coeff"]
+    np.testing.assert_allclose(ratio, 8.0, rtol=1e-4)  # 8 virtual devices

@@ -1,0 +1,47 @@
+"""Enforce-style error context (VERDICT r2 task #8; reference
+platform/enforce.h): a failing op lowering must surface the op type and
+its inputs' names/shapes/dtypes instead of a bare JAX trace."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.ops.registry import OpError
+
+
+def test_mis_shaped_feed_names_the_op():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(OpError) as ei:
+            # feed has 5 features, the fc weight expects 4
+            exe.run(main, feed={"x": np.zeros((2, 5), np.float32)},
+                    fetch_list=[y])
+    msg = str(ei.value)
+    assert "op 'mul'" in msg or "op 'fc'" in msg, msg
+    assert "(2, 5)" in msg, msg          # the offending input shape
+    assert "float32" in msg, msg
+    # actionable, not a wall of backend trace
+    assert len(msg.splitlines()) <= 8, msg
+
+
+def test_bad_dtype_op_context():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[2, 3], dtype="float32")
+        b = fluid.layers.data("b", shape=[4, 5], dtype="float32")
+        out = fluid.layers.matmul(a, b)   # 3 != 4: contraction mismatch
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        with pytest.raises(OpError) as ei:
+            exe.run(main, feed={"a": np.zeros((1, 2, 3), np.float32),
+                                "b": np.zeros((1, 4, 5), np.float32)},
+                    fetch_list=[out])
+    msg = str(ei.value)
+    assert "matmul" in msg, msg
+    assert "'a'" in msg and "'b'" in msg, msg

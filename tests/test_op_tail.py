@@ -1,0 +1,422 @@
+"""Op-tail coverage (VERDICT r2 task #5): lstmp, attention_lstm,
+fusion_lstm/gru, hash, sequence_erase, ragged sequence_expand, dynamic
+sequence_mask, grouped conv2d/3d_transpose, unique_with_counts, nce
+custom_dist, ModelAverage. Numeric references: torch (CPU) for the conv
+transposes, hand-rolled numpy scans for the RNs, np.unique for uniques."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.ops as ops
+from paddle_tpu.ops.registry import ExecContext
+
+
+class _FakeOp:
+    def __init__(self, type, attrs=None, inputs=None, outputs=None, uid=0):
+        self.type = type
+        self.attrs = attrs or {}
+        self.inputs = inputs or {}
+        self.outputs = outputs or {}
+        self.uid = uid
+
+
+def run_op(type, inputs, attrs=None, lod=None):
+    """Directly invoke a lowering with concrete arrays (OpTest-style)."""
+    import jax.numpy as jnp
+    vals = {k: [jnp.asarray(v)] for k, v in inputs.items()}
+    if lod:
+        for k, lens in lod.items():
+            vals[k + "@LOD_LEN"] = [jnp.asarray(lens)]
+    op = _FakeOp(type, attrs=dict(attrs or {}),
+                 inputs={k: [k] for k in inputs})
+    od = ops.get_op_def(type)
+    return ops.call_lower(od, ExecContext(op, vals))
+
+
+# ---------------------------------------------------------------------------
+# sequence ops
+# ---------------------------------------------------------------------------
+
+def test_sequence_erase():
+    x = np.array([[2, 1, 3, 1, 5], [1, 1, 9, 0, 0]], np.int64)
+    lens = np.array([5, 3], np.int32)
+    out = run_op("sequence_erase", {"X": x}, {"tokens": [1]},
+                 lod={"X": lens})
+    np.testing.assert_array_equal(np.asarray(out["Out"]),
+                                  [[2, 3, 5, 0, 0], [9, 0, 0, 0, 0]])
+    np.testing.assert_array_equal(np.asarray(out["Out@LOD_LEN"]), [3, 1])
+
+
+def test_sequence_mask_dynamic_maxlen():
+    x = np.array([2, 4, 1], np.int64)
+    out = run_op("sequence_mask", {"X": x}, {"maxlen": -1})
+    y = np.asarray(out["Y"])
+    assert y.shape == (3, 4)
+    np.testing.assert_array_equal(
+        y, [[1, 1, 0, 0], [1, 1, 1, 1], [1, 0, 0, 0]])
+
+
+def test_sequence_expand_ragged_static_multiple():
+    x = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+    xlens = np.array([3, 2], np.int32)
+    y = np.zeros((4, 3, 1), np.float32)    # By = 2*Bx -> k=2
+    ylens = np.array([2, 3, 1, 2], np.int32)
+    out = run_op("sequence_expand", {"X": x, "Y": y},
+                 lod={"X": xlens, "Y": ylens})
+    o = np.asarray(out["Out"])
+    lens = np.asarray(out["Out@LOD_LEN"])
+    np.testing.assert_array_equal(lens, [2, 3, 1, 2])
+    # row 0,1 replicate x[0]; row 2,3 replicate x[1]; masked to lens
+    np.testing.assert_allclose(o[0, :2], x[0, :2])
+    np.testing.assert_allclose(o[1, :3], x[0, :3])
+    np.testing.assert_allclose(o[2, :1], x[1, :1])
+    assert np.all(o[0, 2:] == 0)
+
+
+# ---------------------------------------------------------------------------
+# hash / unique
+# ---------------------------------------------------------------------------
+
+def test_hash_deterministic_in_range():
+    x = np.random.RandomState(0).randint(0, 10000, (7, 2)).astype(np.int64)
+    out = run_op("hash", {"X": x}, {"num_hash": 4, "mod_by": 1000})
+    o = np.asarray(out["Out"])
+    assert o.shape == (7, 4, 1)
+    assert o.min() >= 0 and o.max() < 1000
+    o2 = np.asarray(run_op("hash", {"X": x},
+                           {"num_hash": 4, "mod_by": 1000})["Out"])
+    np.testing.assert_array_equal(o, o2)            # deterministic
+    assert not np.array_equal(o[:, 0], o[:, 1])     # seeds differ
+    # identical rows hash identically
+    x2 = np.vstack([x[:1], x[:1]])
+    h2 = np.asarray(run_op("hash", {"X": x2},
+                           {"num_hash": 2, "mod_by": 1000})["Out"])
+    np.testing.assert_array_equal(h2[0], h2[1])
+
+
+def test_unique_with_counts():
+    x = np.array([2, 3, 3, 1, 5, 2, 2], np.int64)
+    out = run_op("unique_with_counts", {"X": x}, {})
+    uniq = np.asarray(out["Out"])
+    index = np.asarray(out["Index"])
+    count = np.asarray(out["Count"])
+    ref_u, ref_i, ref_c = np.unique(x, return_inverse=True,
+                                    return_counts=True)
+    np.testing.assert_array_equal(uniq, ref_u)
+    np.testing.assert_array_equal(index, ref_i)
+    np.testing.assert_array_equal(count, ref_c)
+    np.testing.assert_array_equal(uniq[index], x)
+
+
+# ---------------------------------------------------------------------------
+# grouped conv transposes vs torch
+# ---------------------------------------------------------------------------
+
+def test_grouped_conv2d_transpose_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 5, 5).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)  # [in_c, out_c/g, kh, kw]
+    for groups, stride, pad in [(2, 2, 1), (4, 1, 0)]:
+        out = run_op("conv2d_transpose", {"Input": x, "Filter": w},
+                     {"strides": [stride, stride], "paddings": [pad, pad],
+                      "groups": groups})
+        ref = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                 stride=stride, padding=pad,
+                                 groups=groups).numpy()
+        np.testing.assert_allclose(np.asarray(out["Output"]), ref,
+                                   atol=1e-4, err_msg="groups=%d" % groups)
+
+
+def test_grouped_conv3d_transpose_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 4, 3, 4, 4).astype(np.float32)
+    w = rng.randn(4, 2, 2, 2, 2).astype(np.float32)
+    out = run_op("conv3d_transpose", {"Input": x, "Filter": w},
+                 {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                  "groups": 2})
+    ref = F.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                             groups=2).numpy()
+    np.testing.assert_allclose(np.asarray(out["Output"]), ref, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# RNN tail: lstmp / fusion_lstm / fusion_gru / attention_lstm
+# ---------------------------------------------------------------------------
+
+def _np_lstmp(x, lens, w, w_proj, bias, D, P):
+    B, T, _ = x.shape
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    r = np.zeros((B, P), np.float32)
+    c = np.zeros((B, D), np.float32)
+    projs = np.zeros((B, T, P), np.float32)
+    for t in range(T):
+        gates = x[:, t] + r @ w + bias[:, :4 * D]
+        i, f, cand, o = np.split(gates, 4, axis=-1)
+        i, f, o = sig(i), sig(f), sig(o)
+        c_new = f * c + i * np.tanh(cand)
+        h_new = o * np.tanh(c_new)
+        r_new = np.tanh(h_new @ w_proj)
+        mt = (t < lens).astype(np.float32)[:, None]
+        r = mt * r_new + (1 - mt) * r
+        c = mt * c_new + (1 - mt) * c
+        projs[:, t] = r * mt
+    return projs
+
+
+def test_lstmp_matches_numpy():
+    rng = np.random.RandomState(3)
+    B, T, D, P = 3, 5, 4, 2
+    x = rng.randn(B, T, 4 * D).astype(np.float32) * 0.5
+    w = rng.randn(P, 4 * D).astype(np.float32) * 0.3
+    w_proj = rng.randn(D, P).astype(np.float32) * 0.3
+    bias = rng.randn(1, 4 * D).astype(np.float32) * 0.1
+    lens = np.array([5, 3, 1], np.int32)
+    out = run_op("lstmp", {"Input": x, "Weight": w, "ProjWeight": w_proj,
+                           "Bias": bias},
+                 {"use_peepholes": False}, lod={"Input": lens})
+    ref = _np_lstmp(x, lens, w, w_proj, bias, D, P)
+    np.testing.assert_allclose(np.asarray(out["Projection"]), ref,
+                               atol=1e-5)
+
+
+def test_fusion_lstm_equals_fc_plus_lstm():
+    rng = np.random.RandomState(4)
+    B, T, M, D = 2, 4, 3, 5
+    x = rng.randn(B, T, M).astype(np.float32)
+    wx = rng.randn(M, 4 * D).astype(np.float32) * 0.4
+    wh = rng.randn(D, 4 * D).astype(np.float32) * 0.4
+    bias = rng.randn(1, 4 * D).astype(np.float32) * 0.1
+    lens = np.array([4, 2], np.int32)
+    fused = run_op("fusion_lstm", {"X": x, "WeightX": wx, "WeightH": wh,
+                                   "Bias": bias},
+                   {"use_peepholes": False}, lod={"X": lens})
+    plain = run_op("lstm", {"Input": np.einsum("btm,mh->bth", x, wx) +
+                            bias.reshape(1, 1, -1) * 0.0,
+                            "Weight": wh, "Bias": bias},
+                   {"use_peepholes": False}, lod={"Input": lens})
+    np.testing.assert_allclose(np.asarray(fused["Hidden"]),
+                               np.asarray(plain["Hidden"]), atol=1e-5)
+
+
+def test_fusion_gru_equals_fc_plus_gru():
+    rng = np.random.RandomState(5)
+    B, T, M, D = 2, 4, 3, 5
+    x = rng.randn(B, T, M).astype(np.float32)
+    wx = rng.randn(M, 3 * D).astype(np.float32) * 0.4
+    wh = rng.randn(D, 3 * D).astype(np.float32) * 0.4
+    bias = rng.randn(1, 3 * D).astype(np.float32) * 0.1
+    lens = np.array([4, 3], np.int32)
+    fused = run_op("fusion_gru", {"X": x, "WeightX": wx, "WeightH": wh,
+                                  "Bias": bias}, {}, lod={"X": lens})
+    xx = np.einsum("btm,mh->bth", x, wx)
+    plain = run_op("gru", {"Input": xx, "Weight": wh, "Bias": bias},
+                   {}, lod={"Input": lens})
+    np.testing.assert_allclose(np.asarray(fused["Hidden"]),
+                               np.asarray(plain["Hidden"]), atol=1e-5)
+
+
+def test_attention_lstm_shapes_and_masking():
+    rng = np.random.RandomState(6)
+    B, T, M, D = 2, 5, 3, 4
+    x = rng.randn(B, T, M).astype(np.float32) * 0.5
+    c0 = rng.randn(B, D).astype(np.float32) * 0.3
+    att_w = rng.randn(M + D, 1).astype(np.float32) * 0.4
+    lstm_w = rng.randn(D + M, 4 * D).astype(np.float32) * 0.3
+    lstm_b = rng.randn(1, 4 * D).astype(np.float32) * 0.1
+    lens = np.array([5, 2], np.int32)
+    out = run_op("attention_lstm",
+                 {"X": x, "C0": c0, "AttentionWeight": att_w,
+                  "LSTMWeight": lstm_w, "LSTMBias": lstm_b},
+                 {}, lod={"X": lens})
+    h = np.asarray(out["Hidden"])
+    assert h.shape == (B, T, D)
+    # padded steps of the short sequence must be zeroed
+    assert np.all(h[1, 2:] == 0)
+    assert np.all(np.isfinite(h))
+    # changing x BEYOND a sequence's length must not change its outputs
+    x2 = x.copy()
+    x2[1, 2:] += 100.0
+    out2 = run_op("attention_lstm",
+                  {"X": x2, "C0": c0, "AttentionWeight": att_w,
+                   "LSTMWeight": lstm_w, "LSTMBias": lstm_b},
+                  {}, lod={"X": lens})
+    np.testing.assert_allclose(np.asarray(out2["Hidden"])[1, :2],
+                               h[1, :2], atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# nce custom_dist
+# ---------------------------------------------------------------------------
+
+def test_nce_custom_dist_respects_support():
+    rng = np.random.RandomState(7)
+    B, D, C = 6, 4, 10
+    x = rng.randn(B, D).astype(np.float32)
+    label = rng.randint(0, 3, (B, 1)).astype(np.int64)
+    w = rng.randn(C, D).astype(np.float32)
+    b = rng.randn(C, 1).astype(np.float32)
+    # probability mass only on classes 0..4
+    probs = [0.2] * 5 + [0.0] * 5
+    out = run_op("nce", {"Input": x, "Label": label, "Weight": w,
+                         "Bias": b},
+                 {"num_total_classes": C, "num_neg_samples": 20,
+                  "sampler": 2, "custom_dist_probs": probs})
+    cost = np.asarray(out["Cost"])
+    samples = np.asarray(out["SampleLabels"])
+    assert np.all(np.isfinite(cost)) and cost.shape == (B, 1)
+    neg = samples[:, 1:]                      # first col = true label
+    assert neg.max() < 5, "sampled a zero-probability class"
+
+
+# ---------------------------------------------------------------------------
+# ModelAverage end to end
+# ---------------------------------------------------------------------------
+
+def test_model_average_applies_window_mean():
+    from paddle_tpu.fluid.framework import Program
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(
+            average_window_rate=1.0, min_average_window=1,
+            max_average_window=100)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(8)
+    pname = [p.name for p in main.global_block().all_parameters()
+             if "w" in p.name][0]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        history = []
+        for i in range(6):
+            feed = {"x": rng.randn(8, 3).astype(np.float32),
+                    "y": rng.randn(8, 1).astype(np.float32)}
+            exe.run(main, feed=feed, fetch_list=[loss])
+            history.append(np.asarray(scope.get(pname)))
+        trained = np.asarray(scope.get(pname))
+        with ma.apply(exe):
+            averaged = np.asarray(scope.get(pname))
+            # window covers all 6 updates: averaged == mean of the
+            # post-update parameter trajectory
+            np.testing.assert_allclose(averaged,
+                                       np.mean(history, axis=0), atol=1e-5)
+        # restored afterwards
+        np.testing.assert_allclose(np.asarray(scope.get(pname)), trained,
+                                   atol=0)
+
+
+# ---------------------------------------------------------------------------
+# distributed lookup-table remote prefetch (prefetch_op.cc + the
+# transpiler's distribute_lookup_table path): the table is row-sharded
+# round-robin across 2 pservers; a training loop that prefetches rows,
+# computes a loss, and pushes sparse row grads must track a local
+# full-table run exactly.
+# ---------------------------------------------------------------------------
+
+def test_distributed_lookup_table_prefetch_parity():
+    from paddle_tpu.distributed.rpc import (VariableServer, RPCClient,
+                                            wait_server_ready)
+    from paddle_tpu.fluid.framework import Program
+
+    rng = np.random.RandomState(9)
+    V, D = 10, 4
+    table = rng.randn(V, D).astype(np.float32)
+    LR = 0.5
+
+    servers = [VariableServer("127.0.0.1:0").start() for _ in range(2)]
+    for s in servers:
+        wait_server_ready([s.endpoint])
+    eps = [s.endpoint for s in servers]
+    cli = RPCClient()
+    try:
+        # shard the table: server s holds rows {id : id % 2 == s} at
+        # local index id // 2
+        for s_i, srv in enumerate(servers):
+            rows = table[np.arange(V) % 2 == s_i]
+            cli.put_var(srv.endpoint, "emb", rows)
+
+        # program: prefetch rows for the id batch, then push grads back
+        main, startup = Program(), Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+            gb = main.global_block()
+            rows_v = gb.create_var(name="rows", dtype="float32",
+                                   shape=[-1, D])
+            gb.append_op(type="prefetch", inputs={"X": [ids.name]},
+                         outputs={"Out": [rows_v.name]},
+                         attrs={"table_name": "emb", "epmap": eps},
+                         infer_shape=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+
+        local = table.copy()
+        id_batches = [rng.randint(0, V, (6,)).astype(np.int64)
+                      for _ in range(3)]
+        with fluid.scope_guard(fluid.Scope()):
+            for batch in id_batches:
+                (rows,) = exe.run(main, feed={"ids": batch.reshape(-1, 1)},
+                                  fetch_list=["rows"])
+                rows = np.asarray(rows)
+                np.testing.assert_allclose(rows, local[batch], atol=1e-6,
+                                           err_msg="prefetch rows wrong")
+                # loss = 0.5*sum(rows^2) -> grad = rows; push to servers
+                grad = rows
+                from paddle_tpu.distributed.rpc import global_client
+                c = global_client()
+                ns = len(eps)
+                for s_i, ep in enumerate(eps):
+                    sel = np.nonzero(batch % ns == s_i)[0]
+                    if sel.size:
+                        c.sparse_push(ep, "emb", batch[sel], grad[sel],
+                                      lr=LR, num_shards=ns)
+                # local reference applies the same sparse SGD
+                np.subtract.at(local, batch, LR * grad)
+        # final shards match the local table
+        for s_i, srv in enumerate(servers):
+            got = np.asarray(srv.store["emb"])
+            np.testing.assert_allclose(got, local[np.arange(V) % 2 == s_i],
+                                       atol=1e-5)
+    finally:
+        for s in servers:
+            cli.send_exit(s.endpoint)
+            s.stop()
+        cli.close()
+
+
+def test_lstmp_is_reverse():
+    """is_reverse must scan the valid prefix backwards (regression: the
+    attr was silently ignored). For a full-length sequence, reversed
+    lstmp(x) == reverse(lstmp(reverse(x)))."""
+    rng = np.random.RandomState(12)
+    B, T, D, P = 2, 4, 3, 2
+    x = rng.randn(B, T, 4 * D).astype(np.float32) * 0.5
+    w = rng.randn(P, 4 * D).astype(np.float32) * 0.3
+    w_proj = rng.randn(D, P).astype(np.float32) * 0.3
+    bias = rng.randn(1, 4 * D).astype(np.float32) * 0.1
+    lens = np.array([T, T], np.int32)
+    rev = run_op("lstmp", {"Input": x, "Weight": w, "ProjWeight": w_proj,
+                           "Bias": bias},
+                 {"use_peepholes": False, "is_reverse": True},
+                 lod={"Input": lens})
+    fwd_of_flipped = run_op(
+        "lstmp", {"Input": x[:, ::-1].copy(), "Weight": w,
+                  "ProjWeight": w_proj, "Bias": bias},
+        {"use_peepholes": False}, lod={"Input": lens})
+    np.testing.assert_allclose(
+        np.asarray(rev["Projection"]),
+        np.asarray(fwd_of_flipped["Projection"])[:, ::-1], atol=1e-5)
+    # and it differs from the forward scan
+    fwd = run_op("lstmp", {"Input": x, "Weight": w, "ProjWeight": w_proj,
+                           "Bias": bias},
+                 {"use_peepholes": False}, lod={"Input": lens})
+    assert not np.allclose(np.asarray(rev["Projection"]),
+                           np.asarray(fwd["Projection"]))

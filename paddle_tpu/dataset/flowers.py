@@ -15,25 +15,30 @@ TEST_SIZE = 128
 IMG_SHAPE = (3, 224, 224)
 
 
-def _creator(split, size):
+def _creator(split, size, cycle=False):
     def reader():
-        rng = common.split_rng("flowers", split)
-        for _ in range(size):
-            label = int(rng.randint(0, NUM_CLASSES))
-            # class-conditioned mean keeps the task learnable
-            img = (rng.rand(*IMG_SHAPE).astype(np.float32) * 0.5
-                   + label / float(NUM_CLASSES))
-            yield img, label
+        while True:
+            rng = common.split_rng("flowers", split)
+            for _ in range(size):
+                label = int(rng.randint(0, NUM_CLASSES))
+                # class-conditioned mean keeps the task learnable
+                img = (rng.rand(*IMG_SHAPE).astype(np.float32) * 0.5
+                       + label / float(NUM_CLASSES))
+                yield img, label
+            if not cycle:
+                return
 
     return reader
 
 
 def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
-    return _creator("train", TRAIN_SIZE)
+    """mapper/buffered_size/use_xmap exist for reference API parity; the
+    synthetic samples are already mapper-shaped CHW float arrays."""
+    return _creator("train", TRAIN_SIZE, cycle)
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
-    return _creator("test", TEST_SIZE)
+    return _creator("test", TEST_SIZE, cycle)
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=True):

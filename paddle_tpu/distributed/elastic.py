@@ -287,6 +287,12 @@ class MasterService:
             pass
 
 
+class NoTaskYet(Exception):
+    """get_task(block=False): the queue is momentarily empty because
+    other workers hold leases — try again later (distinct from the pass
+    being exhausted, which returns None)."""
+
+
 class MasterClient:
     """go/master/client.go: fault-tolerant master client — re-dials with
     backoff so a master restart (recovering from its snapshot) is
@@ -299,16 +305,25 @@ class MasterClient:
         self._sock = None
 
     def _call(self, msg, deadline=None):
+        """Returns (reply, resent): resent=True when the request was
+        re-sent after a connection failure — the master may have already
+        processed the first copy (at-least-once delivery), so callers of
+        non-idempotent commands must tolerate already-applied errors."""
         deadline = deadline or (time.monotonic() + self.dial_timeout)
         backoff = 0.05
+        resent = False
+        sent_once = False
         while True:
             try:
                 if self._sock is None:
                     host, port = self.endpoint.rsplit(":", 1)
                     self._sock = socket.create_connection(
                         (host, int(port)), timeout=10.0)
+                if sent_once:
+                    resent = True
                 _send_msg(self._sock, msg)
-                return _recv_msg(self._sock)
+                sent_once = True
+                return _recv_msg(self._sock), resent
             except (ConnectionError, OSError, EOFError):
                 # master died/restarting: drop the conn, back off, retry
                 if self._sock is not None:
@@ -323,7 +338,8 @@ class MasterClient:
                 backoff = min(backoff * 2, 1.0)
 
     def set_dataset(self, payloads):
-        r = self._call({"cmd": "set_dataset", "payloads": list(payloads)})
+        r, _ = self._call({"cmd": "set_dataset",
+                           "payloads": list(payloads)})
         if "error" in r:
             raise RuntimeError(r["error"])
         return r
@@ -331,11 +347,13 @@ class MasterClient:
     def get_task(self, block=True, timeout=30.0):
         """Lease the next task; with block=True, retries while the queue
         is momentarily empty (other workers hold leases). Returns
-        (task_id, payload) or None when the pass is exhausted."""
+        (task_id, payload), or None when the pass is exhausted; with
+        block=False a momentarily-empty queue raises NoTaskYet so callers
+        can distinguish 'try later' from 'done'."""
         deadline = time.monotonic() + timeout
         while True:
-            r = self._call({"cmd": "get_task", "worker": self.worker},
-                           deadline=deadline)
+            r, _ = self._call({"cmd": "get_task", "worker": self.worker},
+                              deadline=deadline)
             if r.get("ok"):
                 return r["task_id"], r["payload"]
             if r.get("retry") and block:
@@ -344,23 +362,31 @@ class MasterClient:
                 time.sleep(0.05)
                 continue
             if r.get("retry"):
-                return None
+                raise NoTaskYet(r["error"])
             if "all tasks failed" in r.get("error", ""):
                 return None
             raise RuntimeError(r["error"])
 
-    def task_finished(self, task_id):
-        r = self._call({"cmd": "task_finished", "task_id": task_id})
+    def _ack(self, cmd, task_id):
+        r, resent = self._call({"cmd": cmd, "task_id": task_id})
         if "error" in r:
+            if resent and "not pending" in r["error"]:
+                # at-least-once delivery: the first copy landed before
+                # the master's reply was lost — the ack already applied
+                import warnings
+                warnings.warn("%s(%r): already applied after master "
+                              "reconnect" % (cmd, task_id))
+                return
             raise RuntimeError(r["error"])
+
+    def task_finished(self, task_id):
+        self._ack("task_finished", task_id)
 
     def task_failed(self, task_id):
-        r = self._call({"cmd": "task_failed", "task_id": task_id})
-        if "error" in r:
-            raise RuntimeError(r["error"])
+        self._ack("task_failed", task_id)
 
     def state(self):
-        r = self._call({"cmd": "master_state"})
+        r, _ = self._call({"cmd": "master_state"})
         return r["state"]
 
     def close(self):

@@ -76,4 +76,9 @@ __all__ = [
     "InferenceTranspiler", "average", "evaluator", "debugger", "contrib",
     "set_amp", "amp_enabled", "ir_passes",
     "flags", "set_flags", "get_flags", "FLAGS",
+    "concurrency", "Go", "make_channel", "channel_send", "channel_recv",
+    "channel_close",
 ]
+from . import concurrency  # noqa: E402
+from .concurrency import (  # noqa: F401,E402
+    Go, make_channel, channel_send, channel_recv, channel_close)

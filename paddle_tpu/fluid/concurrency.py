@@ -1,0 +1,110 @@
+"""CSP concurrency: Go blocks + channels.
+
+Reference analogue: paddle/fluid/operators/csp/go_op.cc (GoOp spawns a
+detached thread running a sub-block via a nested Executor) and the Fluid
+CHANNEL variable type (framework.proto:105 VarType CHANNEL) with the
+channel_send/recv/close kernels of that era.
+
+TPU redesign: channels are host-side synchronized queues living in the
+interpreter env, and a Go block is a daemon thread interpreting its
+sub-block over a snapshot env sharing the channel objects — so programs
+using CSP run on the Executor's eager host path (the ops are HOST_OPS),
+exactly like the reference ran these on CPU outside any device stream.
+Device compute inside a Go block still jits per op group.
+"""
+
+from .framework import Variable
+from .layer_helper import LayerHelper
+from . import core
+from .layers.control_flow import BlockGuard, _external_block_io
+
+__all__ = ["Go", "make_channel", "channel_send", "channel_recv",
+           "channel_close"]
+
+
+def make_channel(dtype, capacity=0):
+    """Create a channel variable (CHANNEL VarType analogue). capacity=0
+    means an unbuffered (rendezvous-free, size-1 handoff) channel like
+    the reference's default."""
+    helper = LayerHelper("channel_create")
+    ch = helper.create_variable_for_type_inference("float32")
+    ch.stop_gradient = True
+    helper.append_op(type="channel_create", inputs={},
+                     outputs={"Out": [ch.name]},
+                     attrs={"capacity": int(capacity)},
+                     infer_shape=False)
+    return ch
+
+
+def channel_send(channel, value):
+    """Blocking send (bounded-queue put)."""
+    helper = LayerHelper("channel_send")
+    status = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="channel_send",
+                     inputs={"Channel": [channel.name],
+                             "X": [value.name]},
+                     outputs={"Status": [status.name]},
+                     infer_shape=False)
+    return status
+
+
+def channel_recv(channel, return_value):
+    """Blocking receive into `return_value`; Status is False once the
+    channel is closed and drained."""
+    helper = LayerHelper("channel_recv")
+    status = helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="channel_recv",
+                     inputs={"Channel": [channel.name]},
+                     outputs={"Out": [return_value.name],
+                              "Status": [status.name]},
+                     infer_shape=False)
+    return status
+
+
+def channel_close(channel):
+    helper = LayerHelper("channel_close")
+    helper.append_op(type="channel_close",
+                     inputs={"Channel": [channel.name]}, outputs={},
+                     infer_shape=False)
+
+
+class Go:
+    """reference go_op.cc: `with fluid.Go():` builds a sub-block that runs
+    concurrently (daemon thread) when execution reaches the go op."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("go", name=name)
+
+    def __enter__(self):
+        self._guard = BlockGuard(self.helper.main_program)
+        self._guard.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        ok = self._guard.__exit__(exc_type, exc_val, exc_tb)
+        if exc_type is None:
+            self._construct_go_op()
+        return ok
+
+    def _construct_go_op(self):
+        main_program = self.helper.main_program
+        # the guard already rolled back: current block is the parent,
+        # the go body is the block created inside __enter__
+        parent_block = main_program.current_block()
+        go_block = None
+        # the body is the highest-index block whose parent is the current
+        # block and which is not yet owned by another control-flow op
+        owned = set()
+        for blk in main_program.blocks:
+            for op in blk.ops:
+                sb = op.attrs.get("sub_block")
+                if sb is not None:
+                    owned.add(sb.idx)
+        for blk in main_program.blocks:
+            if blk.parent_idx == parent_block.idx and blk.idx not in owned:
+                go_block = blk
+        assert go_block is not None, "Go body block not found"
+        reads, _ = _external_block_io(go_block, parent_block)
+        parent_block.append_op(
+            type="go", inputs={"X": reads}, outputs={},
+            attrs={"sub_block": go_block}, infer_shape=False)

@@ -129,7 +129,7 @@ def _propagate_lod(op, env):
 
 # ops that mutate the interpreter env directly (control flow / arrays)
 _ENV_OPS = frozenset(["while", "conditional_block", "write_to_array",
-                      "listen_and_serv"])
+                      "listen_and_serv", "go"])
 
 # host-side ops (socket IO / process bootstrap / python callbacks): a block
 # containing any of these cannot be jitted as one computation — the Executor
@@ -139,7 +139,8 @@ HOST_OPS = frozenset([
     "send", "recv", "send_barrier", "fetch_barrier", "listen_and_serv",
     "checkpoint_notify", "gen_collective_id", "save", "load",
     "save_combine", "load_combine", "py_func", "prefetch",
-    "sparse_table_push",
+    "sparse_table_push", "go", "channel_create", "channel_send",
+    "channel_recv", "channel_close",
 ])
 
 

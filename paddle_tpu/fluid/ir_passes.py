@@ -64,6 +64,15 @@ def apply_passes(program, names, **attrs):
     return program
 
 
+def use_count(block, var_name):
+    """Number of ops in `block` consuming var_name (the reference's
+    intermediate-node single-consumer rule; shared by the adjacency
+    passes and GraphPatternDetector)."""
+    return sum(1 for o in block.ops
+               for ns in o.inputs.values() for n in ns
+               if n == var_name)
+
+
 # ---------------------------------------------------------------------------
 # concrete passes
 # ---------------------------------------------------------------------------
@@ -130,9 +139,7 @@ class FuseElewiseAddActPass(Pass):
 
     @staticmethod
     def _single_use(blk, name):
-        return sum(1 for o in blk.ops
-                   for ns in o.inputs.values() for n in ns
-                   if n == name) == 1
+        return use_count(blk, name) == 1
 
 
 @register_pass
@@ -227,4 +234,171 @@ class MultiBatchMergePass(Pass):
                 on = op.outputs.get("Out", [None])[0]
                 if xn and xn == on and xn in pow_names:
                     op.attrs["merge_n"] = n
+        return program
+
+
+# ---------------------------------------------------------------------------
+# GraphPatternDetector (reference ir/graph_pattern_detector.h: PDPattern of
+# PDNodes + subgraph matcher that fusion passes build on). Program-level
+# equivalent: declarative op-chain patterns where dataflow is expressed by
+# shared symbols bound to concrete variable names during matching.
+# ---------------------------------------------------------------------------
+
+class GraphPatternDetector:
+    """Declarative subgraph patterns over a Block.
+
+    Usage:
+        d = GraphPatternDetector()
+        d.add_op("mul", types=["mul"], outputs={"Out": "mm"})
+        d.add_op("add", types=["elementwise_add"], inputs={"X": "mm"},
+                 single_use={"mm"})
+        for m in d.detect(block):   # m: name -> Operator
+            ...rewrite...
+
+    Symbols (like "mm") bind to concrete var names; a symbol appearing in
+    one node's outputs and another's inputs is a dataflow edge. `single_use`
+    marks symbols that must have exactly one consumer in the block (the
+    reference's intermediate-node constraint, so fusion never drops a value
+    some other op still reads).
+    """
+
+    def __init__(self):
+        self._nodes = []   # (name, types, in_links, out_links, single_use)
+
+    def add_op(self, name, types, inputs=None, outputs=None,
+               single_use=()):
+        self._nodes.append((name, tuple(types), dict(inputs or {}),
+                            dict(outputs or {}), frozenset(single_use)))
+        return self
+
+    @staticmethod
+    def _uses(block, var_name):
+        return use_count(block, var_name)
+
+    def detect(self, block):
+        """Yield non-overlapping matches as {node_name: Operator}."""
+        matches = []
+        used_ops = set()
+
+        def bind(node_idx, binding, chosen):
+            if node_idx == len(self._nodes):
+                matches.append(dict(chosen))
+                used_ops.update(id(op) for op in chosen.values())
+                return True
+            name, types, ins, outs, single = self._nodes[node_idx]
+            for op in block.ops:
+                if op.type not in types or id(op) in used_ops or \
+                        any(op is c for c in chosen.values()):
+                    continue
+                b2 = dict(binding)
+                ok = True
+                for slot, sym in ins.items():
+                    actual = op.inputs.get(slot, [None])[0]
+                    if actual is None or \
+                            (sym in b2 and b2[sym] != actual):
+                        ok = False
+                        break
+                    b2[sym] = actual
+                if not ok:
+                    continue
+                for slot, sym in outs.items():
+                    actual = op.outputs.get(slot, [None])[0]
+                    if actual is None or \
+                            (sym in b2 and b2[sym] != actual):
+                        ok = False
+                        break
+                    b2[sym] = actual
+                if not ok:
+                    continue
+                if any(self._uses(block, b2[s]) != 1 for s in single
+                       if s in b2):
+                    continue
+                chosen[name] = op
+                if bind(node_idx + 1, b2, chosen):
+                    return True
+                del chosen[name]
+            return False
+
+        # greedily find all non-overlapping matches
+        while bind(0, {}, {}):
+            pass
+        return matches
+
+
+@register_pass
+class FCLstmFusePass(Pass):
+    """ir/fc_lstm_fuse_pass.cc: fc (projection to 4H gates) feeding an
+    lstm collapses into one fusion_lstm op (the reference's CPU-fused
+    kernel; here the rewrite keeps op-structure parity and drops an IR
+    level — XLA fuses either form). Built on GraphPatternDetector."""
+
+    name = "fc_lstm_fuse_pass"
+
+    def _rewrite(self, blk, lstm_op, x, wx, bias_x, dead_ops, xx_name):
+        inputs = {"X": [x], "WeightX": [wx],
+                  "WeightH": list(lstm_op.inputs["Weight"]),
+                  "Bias": list(lstm_op.inputs["Bias"])}
+        if bias_x:
+            inputs["BiasX"] = [bias_x]
+        for h0slot in ("H0", "C0"):
+            if lstm_op.inputs.get(h0slot):
+                inputs[h0slot] = list(lstm_op.inputs[h0slot])
+        lstm_op.type = "fusion_lstm"
+        lstm_op.inputs = inputs
+        lstm_op.outputs = {"Hidden": list(lstm_op.outputs["Hidden"]),
+                           "Cell": list(lstm_op.outputs["Cell"]),
+                           "XX": [xx_name]}
+        for op in dead_ops:
+            blk.ops.remove(op)
+
+    @staticmethod
+    def _is_bias_var(blk, name):
+        """The folded add's Y must be a real fc bias — a vector of 4H
+        gate values (reference fc_lstm_fuse matches the fc pattern's bias
+        node, never a residual add)."""
+        v = blk._find_var_recursive(name)
+        if v is None or v.shape is None:
+            return False
+        dims = [d for d in v.shape if d not in (1,)]
+        return len(dims) <= 1
+
+    def apply_impl(self, program):
+        blk = program.global_block()
+        # the fc projection appears as an `fc` op, or un-fused as
+        # mul(+elementwise_add) — match all three shapes (the reference's
+        # pattern is built over the fc-fuse result)
+        d = GraphPatternDetector()
+        d.add_op("mul", types=["mul"], outputs={"Out": "mm"})
+        d.add_op("add", types=["elementwise_add"], inputs={"X": "mm"},
+                 outputs={"Out": "proj"}, single_use={"mm"})
+        d.add_op("lstm", types=["lstm"], inputs={"Input": "proj"},
+                 single_use={"proj"})
+        for m in d.detect(blk):
+            bias_name = m["add"].inputs["Y"][0]
+            if not self._is_bias_var(blk, bias_name):
+                continue        # residual add, not an fc bias — skip
+            self._rewrite(blk, m["lstm"], m["mul"].inputs["X"][0],
+                          m["mul"].inputs["Y"][0],
+                          bias_name,
+                          [m["mul"], m["add"]],
+                          m["add"].outputs["Out"][0])
+        d = GraphPatternDetector()
+        d.add_op("fc", types=["fc"], outputs={"Out": "proj"})
+        d.add_op("lstm", types=["lstm"], inputs={"Input": "proj"},
+                 single_use={"proj"})
+        for m in d.detect(blk):
+            fc_op = m["fc"]
+            self._rewrite(blk, m["lstm"], fc_op.inputs["Input"][0],
+                          fc_op.inputs["W"][0],
+                          fc_op.inputs.get("Bias", [None])[0],
+                          [fc_op], fc_op.outputs["Out"][0])
+        d = GraphPatternDetector()
+        d.add_op("mul", types=["mul"], outputs={"Out": "proj"})
+        d.add_op("lstm", types=["lstm"], inputs={"Input": "proj"},
+                 single_use={"proj"})
+        for m in d.detect(blk):
+            mul_op = m["mul"]
+            self._rewrite(blk, m["lstm"], mul_op.inputs["X"][0],
+                          mul_op.inputs["Y"][0], None,
+                          [mul_op], mul_op.outputs["Out"][0])
         return program

@@ -130,7 +130,10 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         if custom_dist is None:
             raise ValueError("sampler='custom_dist' needs custom_dist "
                              "(a probability per class)")
-        assert len(custom_dist) == num_total_classes
+        if len(custom_dist) != num_total_classes:
+            raise ValueError(
+                "custom_dist must have one probability per class: got %d "
+                "for %d classes" % (len(custom_dist), num_total_classes))
         # reference nce feeds the distribution through alias tables
         # (CustomDistProbs/Alias/AliasProbs); the TPU lowering samples
         # with jax.random.categorical, so the raw probs attr suffices

@@ -92,7 +92,8 @@ def _device_events(trace_dir):
         return out
     import zlib
     try:
-        data = json.load(gzip.open(files[-1]))
+        with gzip.open(files[-1]) as f:
+            data = json.load(f)
     except (OSError, ValueError, EOFError, zlib.error) as e:
         # EOFError/zlib.error: jax was still flushing (or died writing)
         # the trace — degrade to host-only tables, but say so

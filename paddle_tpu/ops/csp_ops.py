@@ -1,0 +1,117 @@
+"""CSP op lowerings: go / channel_{create,send,recv,close}.
+
+Reference analogues: operators/csp/go_op.cc (GoOp::RunImpl spawns a
+detached thread executing the sub-block via a nested Executor) and the
+era's CHANNEL variable machinery (framework.proto VarType CHANNEL).
+
+All host ops (functionalizer.HOST_OPS): channels are synchronized
+queues, `go` interprets its sub-block on a daemon thread over a shallow
+env snapshot — channel objects are shared by reference, giving the
+goroutine-style communicate-by-channel semantics."""
+
+import threading
+import warnings
+
+import numpy as np
+
+from .registry import register_op
+
+
+class Channel:
+    """Closable bounded queue. capacity=0 = unbuffered handoff (size-1
+    slot, like a Go unbuffered channel's rendezvous up to one pending
+    item)."""
+
+    def __init__(self, capacity=0):
+        self.capacity = max(int(capacity), 1)
+        self._items = []
+        self._closed = False
+        self._cv = threading.Condition()
+
+    def send(self, value):
+        with self._cv:
+            while len(self._items) >= self.capacity and not self._closed:
+                self._cv.wait(timeout=0.1)
+            if self._closed:
+                return False          # send on closed channel
+            self._items.append(value)
+            self._cv.notify_all()
+            return True
+
+    def recv(self):
+        with self._cv:
+            while not self._items and not self._closed:
+                self._cv.wait(timeout=0.1)
+            if self._items:
+                v = self._items.pop(0)
+                self._cv.notify_all()
+                return v, True
+            return None, False        # closed and drained
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
+@register_op("channel_create")
+def _channel_create(ctx):
+    return {"Out": Channel(ctx.attr("capacity", 0))}
+
+
+@register_op("channel_send")
+def _channel_send(ctx):
+    ch = ctx.input("Channel")
+    assert isinstance(ch, Channel), "channel_send on a non-channel var"
+    ok = ch.send(np.asarray(ctx.input("X")))
+    return {"Status": np.asarray([ok])}
+
+
+@register_op("channel_recv")
+def _channel_recv(ctx):
+    import jax.numpy as jnp
+    ch = ctx.input("Channel")
+    assert isinstance(ch, Channel), "channel_recv on a non-channel var"
+    v, ok = ch.recv()
+    out = {"Status": np.asarray([ok])}
+    if v is not None:
+        out["Out"] = jnp.asarray(v)
+    return out
+
+
+@register_op("channel_close")
+def _channel_close(ctx):
+    ctx.input("Channel").close()
+    return {}
+
+
+@register_op("go")
+def _go(ctx):
+    """go_op.cc RunImpl: execute the sub-block concurrently. The thread
+    interprets over a shallow env snapshot — values captured at spawn,
+    Channel objects shared by reference."""
+    import jax
+    from ..fluid import functionalizer
+    block = ctx.attr("sub_block")
+    env = ctx.env
+    assert env is not None, "go op needs the interpreter env (eager path)"
+    if any(isinstance(v, jax.core.Tracer) for v in env.values()):
+        raise RuntimeError("go blocks cannot be traced under jit — run "
+                           "the program through the Executor's eager path")
+    snapshot = dict(env)
+    step, seed = ctx.step, ctx.seed
+
+    def run():
+        try:
+            functionalizer.run_block(block, snapshot, step=step, seed=seed)
+        except Exception as e:          # detached thread: surface loudly
+            warnings.warn("go block failed: %s" % e)
+            # fail fast: close every channel the block could reach so
+            # main-program channel_recv calls unblock with Status=False
+            # instead of hanging on a producer that died mid-way
+            for v in snapshot.values():
+                if isinstance(v, Channel):
+                    v.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    return {}

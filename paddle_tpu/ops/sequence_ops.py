@@ -738,6 +738,10 @@ def _fusion_lstm(ctx):
     if c0 is None:
         c0 = jnp.zeros((B, D), x.dtype)
     xx = jnp.einsum("btm,mh->bth", x, wx)
+    bias_x = ctx.input("BiasX")
+    if bias_x is not None:
+        # fc_lstm_fuse: the folded fc's bias applies to the x-projection
+        xx = xx + bias_x.reshape(1, 1, -1)
     use_peepholes = ctx.attr("use_peepholes", False) and \
         bias.shape[-1] == 7 * D
     hidden, cell = _lstm_scan(xx, lens, wh, bias, h0, c0, use_peepholes,

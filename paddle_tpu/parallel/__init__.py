@@ -13,8 +13,10 @@ from .ring_attention import (ring_attention, ring_attention_sharded,
                              local_attention)
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 from .pipeline import pipeline_apply, pipeline_sharded
+from .sharded_embedding import shard_table, sharded_lookup
 
 __all__ = [
+    "shard_table", "sharded_lookup",
     "make_mesh", "data_parallel_mesh", "local_device_count",
     "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
     "ring_attention", "ring_attention_sharded", "local_attention",

@@ -1,0 +1,52 @@
+"""CSP concurrency (reference operators/csp/go_op.cc + the CHANNEL
+variable machinery): Go blocks run sub-blocks on concurrent threads,
+communicating over blocking channels."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.layers import tensor as tl
+
+
+def test_go_channel_producer_consumer():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        ch = fluid.make_channel(dtype="float32", capacity=4)
+        x = fluid.layers.data("x", shape=[1], dtype="float32")
+        with fluid.Go():
+            # producer: sends x*2 then x*3 into the channel
+            a = fluid.layers.scale(x, scale=2.0)
+            b = fluid.layers.scale(x, scale=3.0)
+            fluid.channel_send(ch, a)
+            fluid.channel_send(ch, b)
+            fluid.channel_close(ch)
+        r1 = tl.fill_constant([1, 1], "float32", 0.0)
+        r2 = tl.fill_constant([1, 1], "float32", 0.0)
+        s1 = fluid.channel_recv(ch, r1)
+        s2 = fluid.channel_recv(ch, r2)
+        total = fluid.layers.elementwise_add(r1, r2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (tot, st1, st2) = exe.run(
+            main, feed={"x": np.array([[5.0]], np.float32)},
+            fetch_list=[total, s1, s2])
+    assert float(np.asarray(tot).flatten()[0]) == 25.0   # 10 + 15
+    assert bool(np.asarray(st1).flatten()[0])
+    assert bool(np.asarray(st2).flatten()[0])
+
+
+def test_channel_recv_after_close_reports_status():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        ch = fluid.make_channel(dtype="float32", capacity=1)
+        fluid.channel_close(ch)
+        r = tl.fill_constant([1], "float32", -1.0)
+        status = fluid.channel_recv(ch, r)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        (st, rv) = exe.run(main, fetch_list=[status, r])
+    assert not bool(np.asarray(st).flatten()[0])
+    # value untouched on failed recv
+    assert float(np.asarray(rv).flatten()[0]) == -1.0

@@ -53,8 +53,9 @@ def test_master_lease_timeout_requeues():
         got = {c2.get_task()[1], c2.get_task()[1]}
         assert got == {"a", "b"}
         st = c.state()
-        assert ("a", 1) in [(p, f) for (_, p, f) in
-                            st["pending"]] or True  # failures recorded
+        # the expired lease's failure was recorded on task 'a'
+        by_payload = {p: f for (_, p, f) in st["pending"]}
+        assert by_payload["a"] == 1 and by_payload["b"] == 0, by_payload
     finally:
         m.stop()
 

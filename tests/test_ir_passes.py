@@ -102,3 +102,76 @@ def test_fusion_declines_on_training_program():
     ir_passes.get_pass("fuse_elewise_add_act_pass").apply(main)
     after = [op.type for op in main.global_block().ops]
     assert before == after
+
+
+def test_graph_pattern_detector_matches_dataflow():
+    """GraphPatternDetector (reference ir/graph_pattern_detector.h):
+    symbol-linked op patterns match via dataflow, not adjacency."""
+    from paddle_tpu.fluid.ir_passes import GraphPatternDetector
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=8)          # mul + elementwise_add
+        # unrelated op BETWEEN producer and consumer
+        side = fluid.layers.scale(x, scale=2.0)
+        r = fluid.layers.relu(h)
+    blk = main.global_block()
+    d = GraphPatternDetector()
+    d.add_op("add", types=["elementwise_add"], outputs={"Out": "v"})
+    d.add_op("act", types=["relu"], inputs={"X": "v"}, single_use={"v"})
+    matches = d.detect(blk)
+    assert len(matches) == 1
+    assert matches[0]["add"].type == "elementwise_add"
+    assert matches[0]["act"].type == "relu"
+    # single_use constraint: a second consumer kills the match
+    main2, startup2 = Program(), Program()
+    with fluid.program_guard(main2, startup2):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=8)
+        r1 = fluid.layers.relu(h)
+        r2 = fluid.layers.sigmoid(h)            # second consumer
+    d2 = GraphPatternDetector()
+    d2.add_op("add", types=["elementwise_add"], outputs={"Out": "v"})
+    d2.add_op("act", types=["relu"], inputs={"X": "v"}, single_use={"v"})
+    assert d2.detect(main2.global_block()) == []
+
+
+def test_fc_lstm_fuse_pass_preserves_numerics():
+    """fc + lstm -> fusion_lstm rewrite (ir/fc_lstm_fuse_pass.cc): same
+    outputs before and after the pass."""
+    from paddle_tpu.fluid import ir_passes
+    from paddle_tpu.fluid.lod import LoDTensor
+
+    def build():
+        main, startup = Program(), Program()
+        with fluid.program_guard(main, startup):
+            w = fluid.layers.data("w", shape=[6], dtype="float32",
+                                  lod_level=1)
+            proj = fluid.layers.fc(w, size=4 * 8)
+            h, c = fluid.layers.dynamic_lstm(proj, size=4 * 8,
+                                             use_peepholes=False)
+            out = fluid.layers.sequence_pool(h, pool_type="sum")
+        return main, startup, out
+
+    rng = np.random.RandomState(3)
+    seqs = [rng.randn(n, 6).astype(np.float32) for n in (3, 5)]
+    flat = np.concatenate(seqs)
+    t = LoDTensor(flat)
+    t.set_lod([[0, 3, 8]])
+
+    results = {}
+    for fuse in (False, True):
+        with fluid.unique_name.guard():
+            main, startup, out = build()
+        if fuse:
+            n_before = len(main.global_block().ops)
+            ir_passes.get_pass("fc_lstm_fuse_pass").apply(main)
+            ops = [o.type for o in main.global_block().ops]
+            assert "fusion_lstm" in ops and "lstm" not in ops, ops
+            assert len(main.global_block().ops) < n_before
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            (res,) = exe.run(main, feed={"w": t}, fetch_list=[out])
+            results[fuse] = np.asarray(res)
+    np.testing.assert_allclose(results[True], results[False], atol=1e-5)

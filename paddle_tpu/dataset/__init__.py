@@ -14,18 +14,15 @@ from . import cifar
 from . import uci_housing
 from . import imdb
 from . import wmt14
-from . import movielens
-
-__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "wmt14",
-           "movielens"]
-
-from . import conll05
-from . import imikolov
-from . import sentiment
 from . import wmt16
+from . import movielens
+from . import conll05
 from . import flowers
+from . import imikolov
 from . import mq2007
+from . import sentiment
 from . import voc2012
 
-__all__ += ["conll05", "imikolov", "sentiment", "wmt16", "flowers",
-            "mq2007", "voc2012"]
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "wmt14",
+           "wmt16", "movielens", "conll05", "flowers", "imikolov",
+           "mq2007", "sentiment", "voc2012"]

@@ -152,11 +152,28 @@ class MasterService:
             self._snapshot()
         return {"ok": True, "count": len(self.todo)}
 
-    def get_task(self, worker="?"):
-        """Lease one task (service.go:368 GetTask)."""
+    def get_task(self, worker="?", resend=False):
+        """Lease one task (service.go:368 GetTask).
+
+        ``resend=True`` marks an at-least-once retry after a lost reply:
+        if this worker already holds a lease (granted by the first copy of
+        the request whose reply vanished), hand the SAME task back with a
+        refreshed deadline instead of leasing a second one — otherwise the
+        orphaned lease expires and records a spurious failure."""
         with self._lock:
             if not self.dataset_set:
                 return {"error": "dataset not set"}
+            if resend and worker != "?":
+                held = [tid for tid, (_, _, w) in self.pending.items()
+                        if w == worker]
+                if held:
+                    tid = held[-1]
+                    t, _, w = self.pending[tid]
+                    self.pending[tid] = (
+                        t, time.monotonic() + self.lease_timeout, w)
+                    return {"ok": True, "task_id": t.id,
+                            "payload": t.payload,
+                            "num_passes": self.num_passes}
             if not self.todo and not self.pending and self.done:
                 # pass complete: recycle (service.go:411 end-of-pass)
                 self.todo, self.done = self.done, []
@@ -220,7 +237,8 @@ class MasterService:
     def _dispatch(self, msg):
         cmd = msg.get("cmd")
         if cmd == "get_task":
-            return self.get_task(msg.get("worker", "?"))
+            return self.get_task(msg.get("worker", "?"),
+                                 resend=bool(msg.get("resend")))
         if cmd == "task_finished":
             return self.task_finished(msg["task_id"])
         if cmd == "task_failed":
@@ -321,6 +339,7 @@ class MasterClient:
                         (host, int(port)), timeout=10.0)
                 if sent_once:
                     resent = True
+                    msg = dict(msg, resend=True)
                 _send_msg(self._sock, msg)
                 sent_once = True
                 return _recv_msg(self._sock), resent

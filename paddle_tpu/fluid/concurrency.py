@@ -13,9 +13,7 @@ exactly like the reference ran these on CPU outside any device stream.
 Device compute inside a Go block still jits per op group.
 """
 
-from .framework import Variable
 from .layer_helper import LayerHelper
-from . import core
 from .layers.control_flow import BlockGuard, _external_block_io
 
 __all__ = ["Go", "make_channel", "channel_send", "channel_recv",
@@ -27,7 +25,7 @@ def make_channel(dtype, capacity=0):
     means an unbuffered (rendezvous-free, size-1 handoff) channel like
     the reference's default."""
     helper = LayerHelper("channel_create")
-    ch = helper.create_variable_for_type_inference("float32")
+    ch = helper.create_variable_for_type_inference(dtype)
     ch.stop_gradient = True
     helper.append_op(type="channel_create", inputs={},
                      outputs={"Out": [ch.name]},

@@ -39,10 +39,19 @@ class DataFeeder:
                 self.feed_lod_level, columns):
             if lod_level == 0:
                 arr = np.asarray(col, dtype=dtype)
-                # restore static trailing dims (e.g. label [-1,1])
-                want = [d for d in shape if d is not None]
                 if len(shape) and shape[-1] == 1 and arr.ndim == 1:
                     arr = arr.reshape(-1, 1)
+                # restore static trailing dims when the flat sample size
+                # matches (e.g. dense_vector fed to a [C,H,W] image layer —
+                # the reference reshapes in the C++ feed path)
+                static = [d for d in shape[1:]]
+                if (static and all(isinstance(d, int) and d > 0
+                                   for d in static)
+                        and arr.ndim >= 1
+                        and tuple(arr.shape[1:]) != tuple(static)
+                        and int(np.prod(arr.shape[1:])) ==
+                        int(np.prod(static))):
+                    arr = arr.reshape((-1,) + tuple(static))
                 ret[name] = arr
             else:
                 seq_lens = [len(s) for s in col]

@@ -64,13 +64,23 @@ def apply_passes(program, names, **attrs):
     return program
 
 
-def use_count(block, var_name):
+def use_count(block, var_name, _seen=None):
     """Number of ops in `block` consuming var_name (the reference's
     intermediate-node single-consumer rule; shared by the adjacency
-    passes and GraphPatternDetector)."""
-    return sum(1 for o in block.ops
-               for ns in o.inputs.values() for n in ns
-               if n == var_name)
+    passes and GraphPatternDetector). Reads hidden inside nested
+    sub-blocks (conditional_block/while declare outputs={} at the parent
+    level) count too — a fusion must not delete an op whose output a
+    sub-block still reads."""
+    _seen = _seen if _seen is not None else set()
+    n_uses = 0
+    for o in block.ops:
+        n_uses += sum(1 for ns in o.inputs.values() for n in ns
+                      if n == var_name)
+        sub = o.attrs.get("sub_block")
+        if sub is not None and id(sub) not in _seen:
+            _seen.add(id(sub))
+            n_uses += use_count(sub, var_name, _seen)
+    return n_uses
 
 
 # ---------------------------------------------------------------------------
@@ -280,13 +290,13 @@ class GraphPatternDetector:
         matches = []
         used_ops = set()
 
-        def bind(node_idx, binding, chosen):
+        def bind(node_idx, binding, chosen, anchor=None):
             if node_idx == len(self._nodes):
                 matches.append(dict(chosen))
                 used_ops.update(id(op) for op in chosen.values())
                 return True
             name, types, ins, outs, single = self._nodes[node_idx]
-            for op in block.ops:
+            for op in ([anchor] if anchor is not None else block.ops):
                 if op.type not in types or id(op) in used_ops or \
                         any(op is c for c in chosen.values()):
                     continue
@@ -319,9 +329,12 @@ class GraphPatternDetector:
                 del chosen[name]
             return False
 
-        # greedily find all non-overlapping matches
-        while bind(0, {}, {}):
-            pass
+        # greedily find all non-overlapping matches: each op is tried as
+        # the first pattern node's anchor exactly once (no full-search
+        # restart per accepted match)
+        for op in list(block.ops):
+            if id(op) not in used_ops:
+                bind(0, {}, {}, anchor=op)
         return matches
 
 

@@ -226,9 +226,37 @@ class While:
                         found = op
             return found
 
+        def block_writers(block, name, seen=None):
+            # writes hidden inside nested sub-blocks (conditional_block
+            # declares outputs={}) must count as writers too, else the
+            # derived bound silently truncates the scan
+            seen = seen if seen is not None else set()
+            writers = []
+            for op in block.ops:
+                for ns in op.outputs.values():
+                    if name in ns:
+                        writers.append(op)
+                sub = op.attrs.get("sub_block")
+                if sub is not None and id(sub) not in seen:
+                    seen.add(id(sub))
+                    if _writes_in_block(sub, name, seen):
+                        writers.append(op)
+            return writers
+
+        def _writes_in_block(block, name, seen):
+            for op in block.ops:
+                for ns in op.outputs.values():
+                    if name in ns:
+                        return True
+                sub = op.attrs.get("sub_block")
+                if sub is not None and id(sub) not in seen:
+                    seen.add(id(sub))
+                    if _writes_in_block(sub, name, seen):
+                        return True
+            return False
+
         def body_writers(name):
-            return [op for op in while_block.ops
-                    for ns in op.outputs.values() if name in ns]
+            return block_writers(while_block, name)
 
         lt = producer(while_block, self.cond_var.name) or \
             producer(parent_block, self.cond_var.name)

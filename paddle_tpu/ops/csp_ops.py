@@ -101,15 +101,31 @@ def _go(ctx):
     snapshot = dict(env)
     step, seed = ctx.step, ctx.seed
 
+    # channels this block PRODUCES into (transitively through its
+    # sub-blocks): only these may be force-closed on failure — closing
+    # every reachable channel would silently kill unrelated pipelines
+    def sent_channels(blk, acc, seen):
+        for op in blk.ops:
+            if op.type == "channel_send":
+                acc.update(op.inputs.get("Channel", []))
+            sub = op.attrs.get("sub_block")
+            if sub is not None and id(sub) not in seen:
+                seen.add(id(sub))
+                sent_channels(sub, acc, seen)
+        return acc
+
+    produced = sent_channels(block, set(), set())
+
     def run():
         try:
             functionalizer.run_block(block, snapshot, step=step, seed=seed)
         except Exception as e:          # detached thread: surface loudly
             warnings.warn("go block failed: %s" % e)
-            # fail fast: close every channel the block could reach so
+            # fail fast: close the channels this producer feeds so
             # main-program channel_recv calls unblock with Status=False
             # instead of hanging on a producer that died mid-way
-            for v in snapshot.values():
+            for name in produced:
+                v = snapshot.get(name)
                 if isinstance(v, Channel):
                     v.close()
 

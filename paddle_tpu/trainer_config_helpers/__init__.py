@@ -1,0 +1,25 @@
+"""trainer_config_helpers-compatible DSL (reference
+python/paddle/trainer_config_helpers/).
+
+The v1 config DSL: ``*_layer`` functions + ``settings()`` + ``outputs()``
+building a model config that the legacy trainer consumed. The TPU build
+exposes the same names over the v2 layer nodes (python/paddle/v2/layer.py
+derives its API from this module by name-stripping; here the arrow points
+the other way — one implementation, two historical surfaces), and
+``parse_network_config`` realizes a config function as a serialized fluid
+Program.
+"""
+
+from . import layers
+from . import networks
+from .layers import *  # noqa: F401,F403
+from .networks import *  # noqa: F401,F403
+from .activations import *  # noqa: F401,F403
+from .poolings import *  # noqa: F401,F403
+from .attrs import *  # noqa: F401,F403
+from .optimizers import *  # noqa: F401,F403
+from .config_parser_utils import (parse_network_config,  # noqa: F401
+                                  parse_optimizer_config)
+
+__all__ = (layers.__all__ + networks.__all__ +
+           ["parse_network_config", "parse_optimizer_config"])

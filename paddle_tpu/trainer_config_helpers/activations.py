@@ -1,0 +1,27 @@
+"""v1 activation names (reference trainer_config_helpers/activations.py:
+``*Activation`` classes) aliased to the v2 activation objects."""
+
+from ..v2 import activation as _a
+
+__all__ = [
+    "TanhActivation", "SigmoidActivation", "SoftmaxActivation",
+    "IdentityActivation", "LinearActivation", "SequenceSoftmaxActivation",
+    "ExpActivation", "ReluActivation", "BReluActivation",
+    "SoftReluActivation", "STanhActivation", "AbsActivation",
+    "SquareActivation", "LogActivation",
+]
+
+TanhActivation = _a.Tanh
+SigmoidActivation = _a.Sigmoid
+SoftmaxActivation = _a.Softmax
+IdentityActivation = _a.Identity
+LinearActivation = _a.Linear
+SequenceSoftmaxActivation = _a.SequenceSoftmax
+ExpActivation = _a.Exp
+ReluActivation = _a.Relu
+BReluActivation = _a.BRelu
+SoftReluActivation = _a.SoftRelu
+STanhActivation = _a.STanh
+AbsActivation = _a.Abs
+SquareActivation = _a.Square
+LogActivation = _a.Log

@@ -1,0 +1,10 @@
+"""v1 attribute names (reference trainer_config_helpers/attrs.py)."""
+
+from ..v2.attr import (ParameterAttribute,  # noqa: F401
+                       ExtraLayerAttribute)
+
+__all__ = ["ParameterAttribute", "ExtraLayerAttribute", "ParamAttr",
+           "ExtraAttr"]
+
+ParamAttr = ParameterAttribute
+ExtraAttr = ExtraLayerAttribute

@@ -1,0 +1,25 @@
+"""Config realization (reference
+python/paddle/trainer/config_parser_utils.py): run a config function and
+hand back the serialized model config — here, the serialized fluid
+Program built from the declared outputs."""
+
+from ..v2.topology import Topology
+
+__all__ = ["parse_network_config", "parse_optimizer_config"]
+
+
+def parse_network_config(network_conf, config_arg_str=""):
+    """Run `network_conf()`; it must return (or `outputs()`-declare by
+    returning) the output layer(s). Returns the serialized Program."""
+    out = network_conf()
+    if out is None:
+        raise ValueError(
+            "network_conf must return its output layer(s)")
+    return Topology(out).proto()
+
+
+def parse_optimizer_config(optimizer_conf, config_arg_str=""):
+    """Run `optimizer_conf()` and return the recorded settings."""
+    from .optimizers import get_settings
+    optimizer_conf()
+    return get_settings()

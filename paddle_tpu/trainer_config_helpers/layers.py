@@ -1,0 +1,69 @@
+"""v1 ``*_layer`` DSL names (reference
+python/paddle/trainer_config_helpers/layers.py) mapped onto the v2 layer
+nodes — the inverse of the reference's v2-from-v1 name derivation
+(v2/layer.py:56 __convert_name__: fc_layer→fc, maxid_layer→max_id)."""
+
+from ..v2 import layer as _v2
+from ..v2.config_base import Layer as _LayerNode
+
+__all__ = [
+    "data_layer", "fc_layer", "embedding_layer", "img_conv_layer",
+    "img_pool_layer", "img_cmrnorm_layer", "batch_norm_layer",
+    "dropout_layer", "concat_layer", "addto_layer", "pooling_layer",
+    "first_seq", "last_seq", "maxid_layer", "expand_layer",
+    "seq_reshape_layer", "trans_layer", "scaling_layer",
+    "slope_intercept_layer", "mixed_layer", "full_matrix_projection",
+    "identity_projection", "table_projection", "classification_cost",
+    "cross_entropy", "regression_cost", "square_error_cost", "mse_cost",
+    "multi_binary_label_cross_entropy", "huber_regression_cost",
+    "rank_cost", "sum_cost", "crf_layer", "crf_decoding_layer",
+    "ctc_layer", "warp_ctc_layer", "nce_layer", "hsigmoid_layer",
+    "eos_layer", "lstmemory", "grumemory", "LayerOutput",
+]
+
+# v1 name -> v2 implementation
+data_layer = _v2.data
+fc_layer = _v2.fc
+embedding_layer = _v2.embedding
+img_conv_layer = _v2.img_conv
+img_pool_layer = _v2.img_pool
+img_cmrnorm_layer = _v2.img_cmrnorm
+batch_norm_layer = _v2.batch_norm
+dropout_layer = _v2.dropout
+concat_layer = _v2.concat
+addto_layer = _v2.addto
+pooling_layer = _v2.pooling
+first_seq = _v2.first_seq
+last_seq = _v2.last_seq
+maxid_layer = _v2.max_id
+expand_layer = _v2.expand
+seq_reshape_layer = _v2.seq_reshape
+trans_layer = _v2.trans
+scaling_layer = _v2.scaling
+slope_intercept_layer = _v2.slope_intercept
+mixed_layer = _v2.mixed
+full_matrix_projection = _v2.full_matrix_projection
+identity_projection = _v2.identity_projection
+table_projection = _v2.table_projection
+classification_cost = _v2.classification_cost
+cross_entropy = _v2.cross_entropy_cost
+regression_cost = _v2.regression_cost
+square_error_cost = _v2.square_error_cost
+mse_cost = _v2.mse_cost
+multi_binary_label_cross_entropy = \
+    _v2.multi_binary_label_cross_entropy_cost
+huber_regression_cost = _v2.huber_regression_cost
+rank_cost = _v2.rank_cost
+sum_cost = _v2.sum_cost
+crf_layer = _v2.crf
+crf_decoding_layer = _v2.crf_decoding
+ctc_layer = _v2.ctc
+warp_ctc_layer = _v2.warp_ctc
+nce_layer = _v2.nce
+hsigmoid_layer = _v2.hsigmoid
+eos_layer = _v2.eos
+lstmemory = _v2.lstmemory
+grumemory = _v2.grumemory
+
+# the v1 return type name; v2 Layer nodes play the role
+LayerOutput = _LayerNode

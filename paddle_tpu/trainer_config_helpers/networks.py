@@ -1,0 +1,7 @@
+"""v1 composed networks (reference trainer_config_helpers/networks.py) —
+shared implementation with the v2 networks module."""
+
+from ..v2.networks import *  # noqa: F401,F403
+from ..v2 import networks as _n
+
+__all__ = list(_n.__all__)

@@ -1,0 +1,48 @@
+"""v1 optimizer settings DSL (reference
+trainer_config_helpers/optimizers.py): ``settings(...)`` records the
+training configuration; ``*Optimizer`` classes name the methods. The v2
+optimizer objects carry the actual lowering."""
+
+from ..v2 import optimizer as _opt
+
+__all__ = [
+    "settings", "get_settings", "BaseSGDOptimizer", "MomentumOptimizer",
+    "AdamOptimizer", "AdamaxOptimizer", "AdaGradOptimizer",
+    "DecayedAdaGradOptimizer", "AdaDeltaOptimizer", "RMSPropOptimizer",
+]
+
+BaseSGDOptimizer = _opt.Optimizer
+MomentumOptimizer = _opt.Momentum
+AdamOptimizer = _opt.Adam
+AdamaxOptimizer = _opt.Adamax
+AdaGradOptimizer = _opt.AdaGrad
+DecayedAdaGradOptimizer = _opt.DecayedAdaGrad
+AdaDeltaOptimizer = _opt.AdaDelta
+RMSPropOptimizer = _opt.RMSProp
+
+_settings = {}
+
+
+def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
+             regularization=None, model_average=None,
+             gradient_clipping_threshold=None, **kwargs):
+    """Record the global training settings (reference optimizers.py
+    settings()). Returns the equivalent v2 optimizer for direct use with
+    the SGD trainer."""
+    method = learning_method or _opt.Momentum(momentum=0.0)
+    if isinstance(method, type):
+        method = method()
+    method.learning_rate = learning_rate
+    if regularization is not None:
+        method.regularization = regularization
+    if model_average is not None:
+        method.model_average = model_average
+    if gradient_clipping_threshold is not None:
+        method.gradient_clipping_threshold = gradient_clipping_threshold
+    _settings.update(dict(batch_size=batch_size, optimizer=method,
+                          **kwargs))
+    return method
+
+
+def get_settings():
+    return dict(_settings)

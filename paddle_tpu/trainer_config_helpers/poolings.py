@@ -1,0 +1,13 @@
+"""v1 pooling names (reference trainer_config_helpers/poolings.py)."""
+
+from ..v2 import pooling as _p
+
+__all__ = ["MaxPooling", "AvgPooling", "SumPooling", "SquareRootNPooling",
+           "CudnnMaxPooling", "CudnnAvgPooling"]
+
+MaxPooling = _p.Max
+AvgPooling = _p.Avg
+SumPooling = _p.Sum
+SquareRootNPooling = _p.SquareRootN
+CudnnMaxPooling = _p.CudnnMax
+CudnnAvgPooling = _p.CudnnAvg

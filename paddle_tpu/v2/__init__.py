@@ -1,0 +1,88 @@
+"""paddle.v2-compatible API (reference python/paddle/v2/__init__.py).
+
+The legacy v2 generation (SURVEY §2.8): a declarative layer DSL +
+Parameters + SGD trainer + inference, originally interpreted by the C++
+gserver GradientMachine stack. Here the whole surface is a thin veneer
+over the fluid/XLA substrate — one execution engine serves both API
+generations, which is the TPU-native answer to the reference's 139k-LoC
+second engine: topologies lower to fluid Programs, training steps jit to
+single XLA computations, and Parameters are numpy pools synced with
+executor scopes.
+
+Usage mirrors the reference:
+
+    import paddle_tpu.v2 as paddle
+    paddle.init(use_gpu=False, trainer_count=1)
+    images = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(10))
+    out = paddle.layer.fc(images, size=10,
+                          act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=out, label=label)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 paddle.optimizer.Momentum(momentum=0.9))
+    trainer.train(paddle.batch(reader, 64), num_passes=2)
+"""
+
+from . import activation
+from . import attr
+from . import config_base
+from . import data_feeder
+from . import data_type
+from . import evaluator
+from . import event
+from . import image
+from . import inference
+from . import layer
+from . import minibatch
+from . import networks
+from . import op
+from . import optimizer
+from . import parameters
+from . import plot
+from . import pooling
+from . import topology
+from . import trainer
+
+from .inference import infer
+from .minibatch import batch
+from ..dataset import *  # noqa: F401,F403 — paddle.v2.dataset surface
+from .. import dataset
+from .. import reader
+from ..fluid.framework import (default_main_program,
+                               default_startup_program)
+
+__all__ = [
+    "init", "optimizer", "layer", "activation", "parameters", "trainer",
+    "event", "data_type", "attr", "pooling", "dataset", "reader",
+    "topology", "networks", "infer", "batch", "inference", "image",
+    "master", "default_main_program", "default_startup_program",
+]
+
+_init_kwargs = {}
+
+
+def init(**kwargs):
+    """reference v2/__init__.py init() — swallow the v1 runtime knobs
+    (use_gpu, trainer_count, log levels); device selection is jax-native
+    here. Distributed knobs map onto the collective bootstrap."""
+    _init_kwargs.update(kwargs)
+    if kwargs.get("trainer_count", 1) > 1:
+        # multi-device: the fluid ParallelExecutor path serves this; the
+        # v2 trainer itself stays single-stream like the reference's
+        # local updater
+        pass
+    return None
+
+
+class _MasterModule(object):
+    """paddle.v2.master client surface — backed by the TPU build's elastic
+    layer (paddle_tpu.distributed.elastic), reference go/master."""
+
+    @property
+    def client(self):
+        from ..distributed.elastic import MasterClient
+        return MasterClient
+
+
+master = _MasterModule()

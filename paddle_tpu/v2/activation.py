@@ -1,0 +1,75 @@
+"""v2 activation objects (reference python/paddle/v2/activation.py, which
+re-exports trainer_config_helpers.activations). Each carries the fluid
+activation name applied by layer builders."""
+
+__all__ = [
+    "Base", "Tanh", "Sigmoid", "Softmax", "Identity", "Linear",
+    "SequenceSoftmax", "Exp", "Relu", "BRelu", "SoftRelu", "STanh",
+    "Abs", "Square", "Log", "SquareRootN",
+]
+
+
+class Base(object):
+    fluid_act = None  # None = identity
+
+    def __repr__(self):
+        return self.__class__.__name__ + "()"
+
+
+class Tanh(Base):
+    fluid_act = "tanh"
+
+
+class Sigmoid(Base):
+    fluid_act = "sigmoid"
+
+
+class Softmax(Base):
+    fluid_act = "softmax"
+
+
+class SequenceSoftmax(Base):
+    fluid_act = "sequence_softmax"
+
+
+class Identity(Base):
+    fluid_act = None
+
+
+Linear = Identity
+
+
+class Exp(Base):
+    fluid_act = "exp"
+
+
+class Relu(Base):
+    fluid_act = "relu"
+
+
+class BRelu(Base):
+    fluid_act = "brelu"
+
+
+class SoftRelu(Base):
+    fluid_act = "soft_relu"
+
+
+class STanh(Base):
+    fluid_act = "stanh"
+
+
+class Abs(Base):
+    fluid_act = "abs"
+
+
+class Square(Base):
+    fluid_act = "square"
+
+
+class Log(Base):
+    fluid_act = "log"
+
+
+class SquareRootN(Base):
+    fluid_act = "sqrt"

@@ -1,0 +1,80 @@
+"""v2 attribute objects (reference python/paddle/v2/attr.py →
+trainer_config_helpers.attrs.ParameterAttribute/ExtraLayerAttribute).
+``Param`` maps onto fluid ``ParamAttr``; ``Extra`` keeps the same knob
+names (drop_rate etc.) and is honored where meaningful."""
+
+from ..fluid.param_attr import ParamAttr
+from ..fluid import initializer as _init
+from ..fluid import regularizer as _reg
+
+__all__ = ["Param", "Extra", "ParameterAttribute", "ExtraLayerAttribute",
+           "ExtraAttr", "ParamAttr"]
+
+
+class ParameterAttribute(object):
+    """v2-style parameter attribute; ``to_fluid(name)`` lowers it."""
+
+    def __init__(self, name=None, is_static=False, initial_std=None,
+                 initial_mean=None, initial_max=None, initial_min=None,
+                 l1_rate=None, l2_rate=None, learning_rate=1.0,
+                 momentum=None, gradient_clipping_threshold=None,
+                 sparse_update=False, initializer=None):
+        self.name = name
+        self.is_static = is_static
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.initial_max = initial_max
+        self.initial_min = initial_min
+        self.l1_rate = l1_rate
+        self.l2_rate = l2_rate
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.gradient_clipping_threshold = gradient_clipping_threshold
+        self.sparse_update = sparse_update
+        self.initializer = initializer
+
+    def to_fluid(self, name=None):
+        init = self.initializer
+        if init is None:
+            if self.initial_max is not None or self.initial_min is not None:
+                lo = self.initial_min if self.initial_min is not None else 0.0
+                hi = self.initial_max if self.initial_max is not None else 1.0
+                init = _init.Uniform(low=lo, high=hi)
+            elif self.initial_std is not None or self.initial_mean is not None:
+                init = _init.Normal(
+                    loc=self.initial_mean or 0.0,
+                    scale=self.initial_std
+                    if self.initial_std is not None else 1.0)
+        reg = None
+        if self.l2_rate:
+            reg = _reg.L2Decay(self.l2_rate)
+        elif self.l1_rate:
+            reg = _reg.L1Decay(self.l1_rate)
+        return ParamAttr(
+            name=self.name or name,
+            initializer=init,
+            regularizer=reg,
+            learning_rate=self.learning_rate,
+            trainable=not self.is_static)
+
+
+class ExtraLayerAttribute(object):
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None):
+        self.error_clipping_threshold = error_clipping_threshold
+        self.drop_rate = drop_rate
+        self.device = device
+
+
+Param = ParameterAttribute
+Extra = ExtraLayerAttribute
+ExtraAttr = ExtraLayerAttribute
+
+
+def lower_param_attr(attr, default_name=None):
+    """Accept None | ParameterAttribute | fluid ParamAttr | False."""
+    if attr is None or attr is False:
+        return attr
+    if isinstance(attr, ParameterAttribute):
+        return attr.to_fluid(default_name)
+    return attr

@@ -1,0 +1,54 @@
+"""v2 layer graph node base.
+
+Reference: python/paddle/v2/config_base.py — there, v2 ``Layer`` objects
+wrap trainer_config_helpers outputs and are stitched into a ModelConfig
+protobuf that a C++ GradientMachine interprets. Here the declarative DSL is
+kept, but realization is TPU-native: each node knows how to emit ops into a
+fluid ``Program`` (which then lowers to one jitted XLA computation), so the
+v2 API and the fluid API share a single execution engine.
+"""
+
+from ..fluid import unique_name
+
+__all__ = ["Layer"]
+
+
+class Layer(object):
+    """A declarative node in a v2 topology DAG.
+
+    ``parents`` are other Layers this node consumes. ``build_fn`` receives
+    the already-built parent fluid Variables and must append ops to the
+    current default program, returning the output Variable.
+    """
+
+    def __init__(self, name=None, parents=None, build_fn=None,
+                 layer_type="layer", extra_parents=None):
+        self.name = name if name else unique_name.generate(layer_type)
+        self.layer_type = layer_type
+        self.__parents__ = list(parents or [])
+        self.__extra_parents__ = list(extra_parents or [])
+        self.__build_fn__ = build_fn
+
+    def parents(self):
+        return self.__parents__ + self.__extra_parents__
+
+    def build(self, context):
+        """Realize this node (and its ancestors) as fluid Variables.
+
+        ``context`` maps id(Layer) -> fluid Variable and must be used under
+        a ``fluid.program_guard``; memoization makes diamond-shaped DAGs
+        emit each layer exactly once, mirroring the reference's
+        __get_used_layers__ dedup (v2/layer.py:110).
+        """
+        key = id(self)
+        if key in context:
+            return context[key]
+        parent_vars = [p.build(context) for p in self.__parents__]
+        for extra in self.__extra_parents__:
+            extra.build(context)
+        out = self.__build_fn__(*parent_vars)
+        context[key] = out
+        return out
+
+    def __repr__(self):
+        return "Layer(%s, type=%s)" % (self.name, self.layer_type)

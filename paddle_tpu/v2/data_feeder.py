@@ -1,0 +1,25 @@
+"""v2 DataFeeder (reference python/paddle/v2/data_feeder.py): converts
+reader minibatches into feed form given the topology's data types and an
+optional ``feeding`` name->column mapping. Thin adapter over the fluid
+DataFeeder (the dense/LoD conversion lives there)."""
+
+from ..fluid.data_feeder import DataFeeder as _FluidFeeder
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder(object):
+    def __init__(self, data_types, feeding=None):
+        self.data_types = list(data_types)
+        names = [n for n, _ in self.data_types]
+        if feeding is not None:
+            if isinstance(feeding, dict):
+                names = [kv[0] for kv in
+                         sorted(feeding.items(), key=lambda kv: kv[1])]
+            else:
+                names = list(feeding)
+        self.feed_order = names
+
+    def __call__(self, data_batch, program=None):
+        feeder = _FluidFeeder(feed_list=self.feed_order, program=program)
+        return feeder.feed(data_batch)

@@ -1,0 +1,77 @@
+"""v2 input data types.
+
+Reference: python/paddle/v2/data_type.py re-exports py_paddle.dataprovider
+converter types (dense_vector, integer_value, sparse vectors, each with
+_sequence/_sub_sequence variants). The TPU build keeps the same constructor
+names; the returned ``InputType`` records dim/seq/kind and drives the v2
+DataFeeder's dense encoding (ragged sequences ride the fluid LoD system's
+padded-dense form, SURVEY §5 long-context note).
+"""
+
+__all__ = [
+    "InputType", "DataType", "SequenceType",
+    "dense_vector", "dense_vector_sequence", "dense_array",
+    "integer_value", "integer_value_sequence",
+    "sparse_binary_vector", "sparse_binary_vector_sequence",
+    "sparse_float_vector", "sparse_float_vector_sequence",
+]
+
+
+class DataType(object):
+    Dense = 0
+    SparseNonValue = 1
+    SparseValue = 2
+    Index = 3
+
+
+class SequenceType(object):
+    NO_SEQUENCE = 0
+    SEQUENCE = 1
+    SUB_SEQUENCE = 2
+
+
+class InputType(object):
+    def __init__(self, dim, seq_type, tp):
+        self.dim = dim
+        self.seq_type = seq_type
+        self.type = tp
+
+    def __repr__(self):
+        return "InputType(dim=%d, seq=%d, type=%d)" % (
+            self.dim, self.seq_type, self.type)
+
+
+def dense_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.Dense)
+
+
+def dense_vector_sequence(dim):
+    return dense_vector(dim, SequenceType.SEQUENCE)
+
+
+def dense_array(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.Dense)
+
+
+def integer_value(value_range, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(value_range, seq_type, DataType.Index)
+
+
+def integer_value_sequence(value_range):
+    return integer_value(value_range, SequenceType.SEQUENCE)
+
+
+def sparse_binary_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.SparseNonValue)
+
+
+def sparse_binary_vector_sequence(dim):
+    return sparse_binary_vector(dim, SequenceType.SEQUENCE)
+
+
+def sparse_float_vector(dim, seq_type=SequenceType.NO_SEQUENCE):
+    return InputType(dim, seq_type, DataType.SparseValue)
+
+
+def sparse_float_vector_sequence(dim):
+    return sparse_float_vector(dim, SequenceType.SEQUENCE)

@@ -1,0 +1,33 @@
+"""v2 evaluators (reference python/paddle/v2/evaluator.py, deriving from
+trainer_config_helpers/evaluators.py). An evaluator attaches a metric
+computation to the topology as an extra layer; pass it via
+``SGD(extra_layers=...)`` or use the trainer's built-in classification
+error tracking."""
+
+from .config_base import Layer
+from ..fluid import layers as F
+
+__all__ = ["classification_error", "auc"]
+
+
+def classification_error(input, label, name=None, top_k=1):
+    """classification error rate metric node (v1
+    classification_error_evaluator)."""
+
+    def build(pv, lv):
+        acc = F.accuracy(input=pv, label=lv, k=top_k)
+        return F.scale(acc, scale=-1.0, bias=1.0)
+
+    return Layer(name=name, parents=[input, label], build_fn=build,
+                 layer_type="evaluator")
+
+
+def auc(input, label, name=None):
+    """streaming AUC metric node (v1 auc_evaluator)."""
+
+    def build(pv, lv):
+        out, _ = F.auc(input=pv, label=lv)
+        return out
+
+    return Layer(name=name, parents=[input, label], build_fn=build,
+                 layer_type="evaluator")

@@ -1,0 +1,55 @@
+"""v2 training events (reference python/paddle/v2/event.py — the trainer
+fires these into the user's event_handler)."""
+
+__all__ = ["EndIteration", "BeginIteration", "BeginPass", "EndPass",
+           "TestResult", "EndForwardBackward"]
+
+
+class WithMetric(object):
+    """reference event.py:31 — exposes evaluator metric pairs."""
+
+    def __init__(self, evaluator=None):
+        self.__evaluator__ = evaluator or {}
+
+    @property
+    def metrics(self):
+        return dict(self.__evaluator__)
+
+
+class TestResult(WithMetric):
+    """reference event.py:48"""
+
+    def __init__(self, evaluator=None, cost=None):
+        super(TestResult, self).__init__(evaluator)
+        self.cost = cost
+
+
+class BeginPass(object):
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id, evaluator=None, gm=None):
+        self.pass_id = pass_id
+        super(EndPass, self).__init__(evaluator)
+
+
+class BeginIteration(object):
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndForwardBackward(object):
+    def __init__(self, pass_id, batch_id, gm=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id, batch_id, cost, evaluator=None, gm=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        super(EndIteration, self).__init__(evaluator)

@@ -1,0 +1,117 @@
+"""v2 image utilities (reference python/paddle/v2/image.py): numpy-side
+preprocessing used by the v2 image models. cv2-free: PIL-style ops are
+implemented directly on numpy arrays."""
+
+import numpy as np
+
+__all__ = [
+    "load_image", "resize_short", "to_chw", "center_crop", "random_crop",
+    "left_right_flip", "simple_transform", "load_and_transform",
+    "batch_images_from_tar",
+]
+
+
+def load_image(file_path, is_color=True):
+    """Decode an image file to an HWC uint8 array. Supports the formats the
+    stdlib can decode (PPM/PGM via manual parse); for arbitrary JPEG/PNG the
+    caller should hand in arrays directly (zero-egress image: no cv2)."""
+    with open(file_path, "rb") as f:
+        data = f.read()
+    if data[:2] in (b"P5", b"P6"):
+        return _parse_pnm(data)
+    raise ValueError("unsupported image format; pass numpy arrays instead")
+
+
+def _parse_pnm(data):
+    parts = data.split(None, 4)
+    magic, w, h, maxval = parts[0], int(parts[1]), int(parts[2]), \
+        int(parts[3])
+    raw = parts[4]
+    ch = 3 if magic == b"P6" else 1
+    arr = np.frombuffer(raw, dtype=np.uint8, count=w * h * ch)
+    return arr.reshape(h, w, ch) if ch == 3 else arr.reshape(h, w)
+
+
+def _resize_bilinear(im, out_h, out_w):
+    h, w = im.shape[:2]
+    ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :]
+    if im.ndim == 2:
+        im = im[:, :, None]
+    top = im[y0][:, x0] * (1 - wx)[..., None] + \
+        im[y0][:, x1] * wx[..., None]
+    bot = im[y1][:, x0] * (1 - wx)[..., None] + \
+        im[y1][:, x1] * wx[..., None]
+    out = top * (1 - wy)[..., None] + bot * wy[..., None]
+    return out.squeeze().astype(im.dtype)
+
+
+def resize_short(im, size):
+    """Resize so the shorter edge equals `size` (reference image.py
+    resize_short)."""
+    h, w = im.shape[:2]
+    if h > w:
+        return _resize_bilinear(im, int(h * size / w), size)
+    return _resize_bilinear(im, size, int(w * size / h))
+
+
+def to_chw(im, order=(2, 0, 1)):
+    if im.ndim == 2:
+        im = im[:, :, None]
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h_start = rng.randint(0, h - size + 1)
+    w_start = rng.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """resize_short -> crop(+flip when training) -> CHW -> mean-subtract
+    (reference image.py simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if (rng or np.random).randint(2) == 1:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, dtype=np.float32)
+        im -= mean.reshape(-1, 1, 1) if mean.ndim == 1 else mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    raise NotImplementedError(
+        "tar batching requires the dataset cache layout; use the "
+        "paddle_tpu.dataset readers instead")

@@ -1,0 +1,71 @@
+"""v2 inference (reference python/paddle/v2/inference.py:24 Inference /
+infer). The reference forwards batches through a GradientMachine in test
+mode; here the output layer's topology is cloned for_test and run through
+the fluid Executor's jit cache."""
+
+import numpy as np
+
+from .topology import Topology
+from .parameters import Parameters
+from ..fluid import executor as _executor
+from ..fluid.data_feeder import DataFeeder
+
+__all__ = ["infer", "Inference"]
+
+
+class Inference(object):
+    def __init__(self, parameters, output_layer=None, fileobj=None):
+        if output_layer is None:
+            raise ValueError("output_layer is required")
+        if not isinstance(parameters, Parameters):
+            raise TypeError("parameters must be paddle.v2 Parameters")
+        self.__topology__ = Topology(output_layer)
+        self.__data_types__ = self.__topology__.data_type()
+        self.__parameters__ = parameters
+        self.__scope__ = _executor.Scope()
+        self.__exe__ = _executor.Executor()
+        with _executor.scope_guard(self.__scope__):
+            self.__exe__.run(self.__topology__.startup_program)
+        parameters.push_to_scope(self.__scope__)
+        self.__program__ = self.__topology__.main_program.clone(
+            for_test=True)
+
+    def iter_infer(self, input, feeding=None):
+        names = [n for n, _ in self.__data_types__]
+        if feeding is not None:
+            if isinstance(feeding, dict):
+                names = [kv[0] for kv in
+                         sorted(feeding.items(), key=lambda kv: kv[1])]
+            else:
+                names = list(feeding)
+        feed_vars = [self.__program__.global_block().var(n) for n in names]
+        feeder = DataFeeder(feed_list=feed_vars, program=self.__program__)
+        fetch = list(self.__topology__.output_vars)
+        with _executor.scope_guard(self.__scope__):
+            yield self.__exe__.run(self.__program__,
+                                   feed=feeder.feed(input),
+                                   fetch_list=fetch)
+
+    def iter_infer_field(self, field, **kwargs):
+        if field != "value":
+            raise ValueError("TPU inference exposes field='value' only")
+        for result in self.iter_infer(**kwargs):
+            yield [np.asarray(r) for r in result]
+
+    def infer(self, input, field="value", flatten_result=True, **kwargs):
+        """reference inference.py:76 — returns a single ndarray when the
+        topology has one output, else a list."""
+        results = []
+        for res in self.iter_infer_field(field=field, input=input, **kwargs):
+            results.append(res)
+        outs = [np.concatenate([r[i] for r in results], axis=0)
+                for i in range(len(results[0]))]
+        if flatten_result and len(outs) == 1:
+            return outs[0]
+        return outs
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    """reference inference.py module-level infer()"""
+    inferer = Inference(output_layer=output_layer, parameters=parameters)
+    return inferer.infer(field=field, input=input, feeding=feeding)

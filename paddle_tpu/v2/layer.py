@@ -1,0 +1,580 @@
+"""v2 layer DSL, TPU-native.
+
+Reference: python/paddle/v2/layer.py (which renames the
+trainer_config_helpers DSL — fc_layer→fc, data_layer→data, v2/layer.py:56
+__convert_name__) and python/paddle/trainer_config_helpers/layers.py for
+the underlying semantics. There, layer calls accrete a ModelConfig protobuf
+run by the C++ GradientMachine (legacy/gserver); here each v2 layer is a
+declarative ``Layer`` node that lazily emits fluid ops, so one topology
+lowers to a single jitted XLA computation — the v1/v2/fluid APIs share the
+TPU execution engine instead of carrying a second 139k-LoC interpreter
+(SURVEY §2.8).
+
+Sequence inputs ride the fluid LoD system (padded-dense + @LOD_LEN
+companions), so `integer_value_sequence` data feeds ragged samples exactly
+like the reference's sequence layers.
+"""
+
+import functools
+
+from . import activation as _act_mod
+from . import data_type as _dt
+from . import pooling as _pooling
+from .attr import lower_param_attr
+from .config_base import Layer
+from ..fluid import layers as F
+
+__all__ = [
+    "data", "fc", "embedding", "img_conv", "img_pool", "img_cmrnorm",
+    "batch_norm", "dropout", "concat", "addto", "pooling", "first_seq",
+    "last_seq", "max_id", "lstmemory", "grumemory", "expand",
+    "seq_reshape", "trans", "scaling", "slope_intercept", "mixed",
+    "full_matrix_projection", "identity_projection", "table_projection",
+    "classification_cost", "cross_entropy_cost", "regression_cost",
+    "square_error_cost", "mse_cost", "multi_binary_label_cross_entropy_cost",
+    "huber_regression_cost", "rank_cost", "sum_cost", "crf", "crf_decoding",
+    "ctc", "warp_ctc", "nce", "hsigmoid", "eos", "parse_network",
+    "get_layer",
+]
+
+_name_to_layer = {}
+
+
+def _remember(layer):
+    _name_to_layer[layer.name] = layer
+    return layer
+
+
+def get_layer(name):
+    """reference v2/layer.py:325"""
+    return _name_to_layer.get(name)
+
+
+def _apply_act(var, act):
+    if act is None:
+        return var
+    if isinstance(act, type):
+        act = act()
+    name = getattr(act, "fluid_act", None)
+    if name is None:
+        return var
+    if name == "softmax":
+        return F.softmax(var)
+    if name == "sequence_softmax":
+        return F.sequence_softmax(var)
+    from ..fluid.layer_helper import LayerHelper
+    helper = LayerHelper(name)
+    out = helper.create_variable_for_type_inference(var.dtype)
+    helper.append_op(type=name, inputs={"X": var}, outputs={"Out": out})
+    return out
+
+
+def _seq_dim(tp):
+    return tp.seq_type != _dt.SequenceType.NO_SEQUENCE
+
+
+def data(name, type, height=None, width=None, layer_attr=None):
+    """v2 data layer (reference v2/layer.py:87 __data_layer__)."""
+    tp = type
+
+    def build():
+        if tp.type == _dt.DataType.Index:
+            return F.data(name=name, shape=[1], dtype="int64",
+                          lod_level=1 if _seq_dim(tp) else 0)
+        shape = [tp.dim]
+        if height and width:
+            ch = tp.dim // (height * width)
+            shape = [ch, height, width]
+        return F.data(name=name, shape=shape, dtype="float32",
+                      lod_level=1 if _seq_dim(tp) else 0)
+
+    layer = Layer(name=name, parents=[], build_fn=build, layer_type="data")
+    layer.data_type = tp
+    return _remember(layer)
+
+
+def _single_input(input):
+    if isinstance(input, (list, tuple)):
+        if len(input) != 1:
+            raise ValueError("this layer takes exactly one input")
+        return input[0]
+    return input
+
+
+def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
+       layer_attr=None):
+    """fc_layer (trainer_config_helpers/layers.py fc_layer)."""
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(*parents):
+        outs = []
+        for i, pv in enumerate(parents):
+            pa = param_attr[i] if isinstance(param_attr, (list, tuple)) \
+                else param_attr
+            outs.append(F.fc(pv, size=size,
+                             param_attr=lower_param_attr(pa),
+                             bias_attr=False, num_flatten_dims=1))
+        out = outs[0]
+        for o in outs[1:]:
+            out = F.elementwise_add(out, o)
+        out = _add_bias(out, bias_attr, size)
+        return _apply_act(out, act)
+
+    return _remember(Layer(name=name, parents=list(inputs), build_fn=build,
+                           layer_type="fc"))
+
+
+def _add_bias(var, bias_attr, size):
+    if bias_attr is False:
+        return var
+    from ..fluid.layer_helper import LayerHelper
+    helper = LayerHelper("bias", bias_attr=lower_param_attr(bias_attr),
+                         act=None)
+    return helper.append_bias_op(var)
+
+
+def embedding(input, size, param_attr=None, layer_attr=None, name=None):
+    def build(pv):
+        return F.embedding(pv, size=[input.data_type.dim, size],
+                           param_attr=lower_param_attr(param_attr))
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="embedding"))
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
+             padding=0, act=None, name=None, param_attr=None,
+             bias_attr=None, groups=1, dilation=1, shared_biases=True,
+             layer_attr=None, trans=False):
+    def build(pv):
+        conv = (F.conv2d_transpose if trans else F.conv2d)
+        out = conv(pv, num_filters=num_filters, filter_size=filter_size,
+                   stride=stride, padding=padding, dilation=dilation,
+                   groups=groups, param_attr=lower_param_attr(param_attr),
+                   bias_attr=lower_param_attr(bias_attr)
+                   if bias_attr is not None else None)
+        return _apply_act(out, act)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="conv"))
+
+
+def img_pool(input, pool_size, num_channels=None, pool_type=None, stride=1,
+             padding=0, name=None, ceil_mode=True, exclude_mode=True,
+             layer_attr=None):
+    ptype = pool_type or _pooling.Max()
+    if isinstance(ptype, type):
+        ptype = ptype()
+
+    def build(pv):
+        return F.pool2d(pv, pool_size=pool_size,
+                        pool_type=ptype.img_pool_type, pool_stride=stride,
+                        pool_padding=padding, ceil_mode=ceil_mode)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="pool"))
+
+
+def img_cmrnorm(input, size, scale=0.0128, power=0.75, name=None,
+                num_channels=None, layer_attr=None):
+    """local response normalization (img_cmrnorm_layer)."""
+
+    def build(pv):
+        return F.lrn(pv, n=size, alpha=scale, beta=power)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="norm"))
+
+
+def batch_norm(input, act=None, name=None, num_channels=None,
+               bias_attr=None, param_attr=None, layer_attr=None,
+               batch_norm_type=None, moving_average_fraction=0.9,
+               use_global_stats=None, mean_var_names=None):
+    def build(pv):
+        out = F.batch_norm(pv, momentum=moving_average_fraction,
+                           param_attr=lower_param_attr(param_attr),
+                           bias_attr=lower_param_attr(bias_attr),
+                           use_global_stats=bool(use_global_stats))
+        return _apply_act(out, act)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="batch_norm"))
+
+
+def dropout(input, dropout_rate, name=None):
+    def build(pv):
+        return F.dropout(pv, dropout_prob=dropout_rate)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="dropout"))
+
+
+def concat(input, act=None, name=None, layer_attr=None):
+    def build(*parents):
+        return _apply_act(F.concat(list(parents), axis=1), act)
+
+    return _remember(Layer(name=name, parents=list(input), build_fn=build,
+                           layer_type="concat"))
+
+
+def addto(input, act=None, name=None, bias_attr=None, layer_attr=None):
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(*parents):
+        out = parents[0]
+        for p in parents[1:]:
+            out = F.elementwise_add(out, p)
+        if bias_attr not in (None, False):
+            out = _add_bias(out, bias_attr, None)
+        return _apply_act(out, act)
+
+    return _remember(Layer(name=name, parents=list(inputs), build_fn=build,
+                           layer_type="addto"))
+
+
+def pooling(input, pooling_type=None, name=None, bias_attr=None,
+            agg_level=None, layer_attr=None):
+    """sequence pooling over a LoD input (pooling_layer)."""
+    ptype = pooling_type or _pooling.Max()
+    if isinstance(ptype, type):
+        ptype = ptype()
+
+    def build(pv):
+        return F.sequence_pool(pv, pool_type=ptype.seq_pool_type)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="seq_pool"))
+
+
+def first_seq(input, name=None, agg_level=None, layer_attr=None):
+    def build(pv):
+        return F.sequence_first_step(pv)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="first_seq"))
+
+
+def last_seq(input, name=None, agg_level=None, layer_attr=None):
+    def build(pv):
+        return F.sequence_last_step(pv)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="last_seq"))
+
+
+def max_id(input, name=None, layer_attr=None):
+    def build(pv):
+        return F.argmax(pv, axis=-1)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="max_id"))
+
+
+def expand(input, expand_as, name=None, agg_level=None, layer_attr=None):
+    def build(pv, ref):
+        return F.sequence_expand(pv, ref)
+
+    return _remember(Layer(name=name, parents=[input, expand_as],
+                           build_fn=build, layer_type="expand"))
+
+
+def seq_reshape(input, reshape_size, name=None, act=None, bias_attr=None,
+                layer_attr=None):
+    def build(pv):
+        return _apply_act(F.sequence_reshape(pv, new_dim=reshape_size), act)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="seq_reshape"))
+
+
+def trans(input, name=None, layer_attr=None):
+    def build(pv):
+        return F.transpose(pv, perm=[1, 0])
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="trans"))
+
+
+def scaling(input, weight, name=None, layer_attr=None):
+    """row-wise scale of `input` by scalar-per-row `weight`."""
+
+    def build(pv, wv):
+        return F.elementwise_mul(pv, wv, axis=0)
+
+    return _remember(Layer(name=name, parents=[input, weight],
+                           build_fn=build, layer_type="scaling"))
+
+
+def slope_intercept(input, slope=1.0, intercept=0.0, name=None,
+                    layer_attr=None):
+    def build(pv):
+        return F.scale(pv, scale=slope, bias=intercept)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="slope_intercept"))
+
+
+# ---------------------------------------------------------------------------
+# mixed layer / projections — the v1 "mixed" aggregation form
+# ---------------------------------------------------------------------------
+
+class _Projection(object):
+    def __init__(self, input, build_fn):
+        self.input = input
+        self.build_fn = build_fn
+
+
+def full_matrix_projection(input, size=0, param_attr=None):
+    def build(pv):
+        return F.fc(pv, size=size, param_attr=lower_param_attr(param_attr),
+                    bias_attr=False)
+
+    return _Projection(input, build)
+
+
+def identity_projection(input, offset=None, size=None):
+    def build(pv):
+        if offset is None:
+            return pv
+        end = offset + (size or (pv.shape[-1] - offset))
+        return F.slice(pv, axes=[1], starts=[offset], ends=[end])
+
+    return _Projection(input, build)
+
+
+def table_projection(input, size=0, param_attr=None):
+    def build(pv):
+        return F.embedding(pv, size=[input.data_type.dim, size],
+                           param_attr=lower_param_attr(param_attr))
+
+    return _Projection(input, build)
+
+
+def mixed(size=0, name=None, input=None, act=None, bias_attr=None,
+          layer_attr=None):
+    """mixed_layer: sum of projections (trainer_config_helpers
+    mixed_layer); supports the common full_matrix/identity/table forms."""
+    projs = input if isinstance(input, (list, tuple)) else [input]
+    parents = [p.input for p in projs]
+
+    def build(*parent_vars):
+        outs = [p.build_fn(v) for p, v in zip(projs, parent_vars)]
+        out = outs[0]
+        for o in outs[1:]:
+            out = F.elementwise_add(out, o)
+        if bias_attr not in (None, False):
+            out = _add_bias(out, bias_attr, size)
+        return _apply_act(out, act)
+
+    return _remember(Layer(name=name, parents=parents, build_fn=build,
+                           layer_type="mixed"))
+
+
+# ---------------------------------------------------------------------------
+# recurrent memories
+# ---------------------------------------------------------------------------
+
+def lstmemory(input, name=None, reverse=False, act=None, gate_act=None,
+              state_act=None, bias_attr=None, param_attr=None,
+              layer_attr=None):
+    """LSTM over a pre-projected (4*size) sequence input, like the
+    reference lstmemory (trainer_config_helpers layers.py; the projection
+    convention is the v1 contract — use networks.simple_lstm for the
+    fused projection+lstm form)."""
+
+    def build(pv):
+        size = pv.shape[-1] // 4
+        h, _ = F.dynamic_lstm(
+            pv, size=size * 4, is_reverse=reverse,
+            param_attr=lower_param_attr(param_attr),
+            bias_attr=lower_param_attr(bias_attr),
+            gate_activation=getattr(gate_act, "fluid_act", None) or "sigmoid",
+            cell_activation=getattr(state_act, "fluid_act", None) or "tanh",
+            candidate_activation=getattr(act, "fluid_act", None) or "tanh")
+        return h
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="lstmemory"))
+
+
+def grumemory(input, name=None, reverse=False, act=None, gate_act=None,
+              bias_attr=None, param_attr=None, layer_attr=None):
+    """GRU over a pre-projected (3*size) sequence input."""
+
+    def build(pv):
+        size = pv.shape[-1] // 3
+        return F.dynamic_gru(
+            pv, size=size, is_reverse=reverse,
+            param_attr=lower_param_attr(param_attr),
+            bias_attr=lower_param_attr(bias_attr),
+            gate_activation=getattr(gate_act, "fluid_act", None) or "sigmoid",
+            candidate_activation=getattr(act, "fluid_act", None) or "tanh")
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="grumemory"))
+
+
+# ---------------------------------------------------------------------------
+# costs
+# ---------------------------------------------------------------------------
+
+def classification_cost(input, label, weight=None, name=None,
+                        evaluator=None, layer_attr=None):
+    """cross-entropy over a softmax output layer (v1 classification_cost).
+    `input` is expected to already carry Softmax activation, matching the
+    reference convention."""
+
+    def build(pv, lv, *rest):
+        ce = F.cross_entropy(pv, lv)
+        if rest:
+            ce = F.elementwise_mul(ce, rest[0], axis=0)
+        return F.mean(ce)
+
+    parents = [input, label] + ([weight] if weight is not None else [])
+    return _remember(Layer(name=name, parents=parents, build_fn=build,
+                           layer_type="cost"))
+
+
+def cross_entropy_cost(input, label, name=None, coeff=1.0, weight=None,
+                       layer_attr=None):
+    def build(pv, lv, *rest):
+        ce = F.cross_entropy(pv, lv)
+        if rest:
+            ce = F.elementwise_mul(ce, rest[0], axis=0)
+        out = F.mean(ce)
+        return F.scale(out, scale=coeff) if coeff != 1.0 else out
+
+    parents = [input, label] + ([weight] if weight is not None else [])
+    return _remember(Layer(name=name, parents=parents, build_fn=build,
+                           layer_type="cost"))
+
+
+def square_error_cost(input, label, name=None, coeff=1.0, layer_attr=None):
+    def build(pv, lv):
+        out = F.mean(F.square_error_cost(pv, lv))
+        return F.scale(out, scale=coeff) if coeff != 1.0 else out
+
+    return _remember(Layer(name=name, parents=[input, label],
+                           build_fn=build, layer_type="cost"))
+
+
+mse_cost = square_error_cost
+regression_cost = square_error_cost
+
+
+def multi_binary_label_cross_entropy_cost(input, label, name=None,
+                                          coeff=1.0, layer_attr=None):
+    def build(pv, lv):
+        return F.mean(F.sigmoid_cross_entropy_with_logits(pv, lv))
+
+    return _remember(Layer(name=name, parents=[input, label],
+                           build_fn=build, layer_type="cost"))
+
+
+def huber_regression_cost(input, label, name=None, delta=1.0, coeff=1.0,
+                          layer_attr=None):
+    def build(pv, lv):
+        return F.mean(F.huber_loss(pv, lv, delta=delta))
+
+    return _remember(Layer(name=name, parents=[input, label],
+                           build_fn=build, layer_type="cost"))
+
+
+def rank_cost(left, right, label, name=None, weight=None, coeff=1.0,
+              layer_attr=None):
+    def build(lv, rv, labv):
+        return F.mean(F.margin_rank_loss(labv, lv, rv, margin=0.0))
+
+    return _remember(Layer(name=name, parents=[left, right, label],
+                           build_fn=build, layer_type="cost"))
+
+
+def sum_cost(input, name=None, layer_attr=None):
+    def build(pv):
+        return F.reduce_sum(pv)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="cost"))
+
+
+def crf(input, label, size=None, name=None, param_attr=None,
+        layer_attr=None):
+    """linear-chain CRF cost (crf_layer)."""
+
+    def build(pv, lv):
+        from ..fluid.layers import loss as L
+        ll = L.linear_chain_crf(pv, lv,
+                                param_attr=lower_param_attr(param_attr))
+        return F.mean(ll)
+
+    return _remember(Layer(name=name, parents=[input, label],
+                           build_fn=build, layer_type="crf"))
+
+
+def crf_decoding(input, size=None, label=None, name=None, param_attr=None,
+                 layer_attr=None):
+    def build(pv, *rest):
+        from ..fluid.layers import loss as L
+        return L.crf_decoding(pv, param_attr=lower_param_attr(param_attr),
+                              label=rest[0] if rest else None)
+
+    parents = [input] + ([label] if label is not None else [])
+    return _remember(Layer(name=name, parents=parents, build_fn=build,
+                           layer_type="crf_decoding"))
+
+
+def ctc(input, label, size=None, name=None, norm_by_times=False,
+        layer_attr=None):
+    def build(pv, lv):
+        from ..fluid.layers import loss as L
+        return F.mean(L.warpctc(pv, lv, norm_by_times=norm_by_times))
+
+    return _remember(Layer(name=name, parents=[input, label],
+                           build_fn=build, layer_type="ctc"))
+
+
+warp_ctc = ctc
+
+
+def nce(input, label, num_classes, name=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, layer_attr=None):
+    def build(pv, lv):
+        from ..fluid.layers import loss as L
+        return F.mean(L.nce(pv, lv, num_classes,
+                            param_attr=lower_param_attr(param_attr),
+                            bias_attr=lower_param_attr(bias_attr),
+                            num_neg_samples=num_neg_samples))
+
+    return _remember(Layer(name=name, parents=[input, label],
+                           build_fn=build, layer_type="nce"))
+
+
+def hsigmoid(input, label, num_classes, name=None, param_attr=None,
+             bias_attr=None, layer_attr=None):
+    def build(pv, lv):
+        from ..fluid.layers import loss as L
+        return F.mean(L.hsigmoid(pv, lv, num_classes,
+                                 param_attr=lower_param_attr(param_attr),
+                                 bias_attr=lower_param_attr(bias_attr)))
+
+    return _remember(Layer(name=name, parents=[input, label],
+                           build_fn=build, layer_type="hsigmoid"))
+
+
+def eos(input, eos_id, name=None, layer_attr=None):
+    def build(pv):
+        const = F.fill_constant([1], "int64", eos_id)
+        return F.cast(F.equal(pv, const), "float32")
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="eos"))
+
+
+def parse_network(output_layers, extra_layers=None):
+    """Build the fluid Program realizing `output_layers` (reference
+    v2/layer.py:263 parse_network returns the trimmed ModelConfig; here the
+    Program pair IS the config)."""
+    from .topology import Topology
+    if not isinstance(output_layers, (list, tuple)):
+        output_layers = [output_layers]
+    return Topology(output_layers, extra_layers=extra_layers).proto()

@@ -1,0 +1,174 @@
+"""v2 composed networks (reference python/paddle/v2/networks.py →
+trainer_config_helpers/networks.py). The widely-used compositions,
+expressed over the v2 layer DSL."""
+
+from . import layer as L
+from . import activation as A
+from . import pooling as P
+from .attr import ParameterAttribute
+
+__all__ = [
+    "sequence_conv_pool", "simple_lstm", "simple_img_conv_pool",
+    "img_conv_bn_pool", "img_conv_group", "simple_gru", "bidirectional_gru",
+    "text_conv_pool", "bidirectional_lstm", "vgg_16_network", "small_vgg",
+    "inputs", "outputs",
+]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, num_channel=None,
+                         param_attr=None, shared_bias=True, name=None,
+                         conv_stride=1, conv_padding=0, bias_attr=None,
+                         pool_type=None):
+    """conv + pool (trainer_config_helpers/networks.py
+    simple_img_conv_pool)."""
+    conv = L.img_conv(input=input, filter_size=filter_size,
+                      num_filters=num_filters, num_channels=num_channel,
+                      stride=conv_stride, padding=conv_padding, act=act,
+                      param_attr=param_attr, bias_attr=bias_attr)
+    return L.img_pool(input=conv, pool_size=pool_size, stride=pool_stride,
+                      pool_type=pool_type or P.Max(), name=name)
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
+                     pool_stride, act=None, num_channel=None,
+                     conv_stride=1, conv_padding=0, conv_param_attr=None,
+                     conv_bias_attr=None, bn_param_attr=None,
+                     bn_bias_attr=None, pool_type=None, name=None):
+    conv = L.img_conv(input=input, filter_size=filter_size,
+                      num_filters=num_filters, num_channels=num_channel,
+                      stride=conv_stride, padding=conv_padding, act=None,
+                      param_attr=conv_param_attr, bias_attr=conv_bias_attr)
+    bn = L.batch_norm(input=conv, act=act, param_attr=bn_param_attr,
+                      bias_attr=bn_bias_attr)
+    return L.img_pool(input=bn, pool_size=pool_size, stride=pool_stride,
+                      pool_type=pool_type or P.Max(), name=name)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type=None, param_attr=None):
+    """stacked convs (optionally +BN+dropout) then one pool — the VGG
+    building block."""
+    tmp = input
+    if not isinstance(conv_with_batchnorm, (list, tuple)):
+        conv_with_batchnorm = [conv_with_batchnorm] * len(conv_num_filter)
+    if not isinstance(conv_batchnorm_drop_rate, (list, tuple)):
+        conv_batchnorm_drop_rate = \
+            [conv_batchnorm_drop_rate] * len(conv_num_filter)
+    for i, nf in enumerate(conv_num_filter):
+        tmp = L.img_conv(
+            input=tmp, filter_size=conv_filter_size, num_filters=nf,
+            num_channels=num_channels if i == 0 else None,
+            padding=conv_padding,
+            act=None if conv_with_batchnorm[i] else conv_act,
+            param_attr=param_attr)
+        if conv_with_batchnorm[i]:
+            tmp = L.batch_norm(input=tmp, act=conv_act)
+            if conv_batchnorm_drop_rate[i]:
+                tmp = L.dropout(input=tmp,
+                                dropout_rate=conv_batchnorm_drop_rate[i])
+    return L.img_pool(input=tmp, pool_size=pool_size, stride=pool_stride,
+                      pool_type=pool_type or P.Max())
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000):
+    """VGG-16 (trainer_config_helpers/networks.py vgg_16_network)."""
+    tmp = input_image
+    for i, (n, nf) in enumerate([(2, 64), (2, 128), (3, 256), (3, 512),
+                                 (3, 512)]):
+        tmp = img_conv_group(
+            input=tmp, conv_num_filter=[nf] * n, pool_size=2,
+            num_channels=num_channels if i == 0 else None,
+            conv_act=A.Relu(), pool_stride=2)
+    tmp = L.fc(input=tmp, size=4096, act=A.Relu())
+    tmp = L.dropout(input=tmp, dropout_rate=0.5)
+    tmp = L.fc(input=tmp, size=4096, act=A.Relu())
+    tmp = L.dropout(input=tmp, dropout_rate=0.5)
+    return L.fc(input=tmp, size=num_classes, act=A.Softmax())
+
+
+def small_vgg(input_image, num_channels, num_classes=1000):
+    tmp = input_image
+    for i, (n, nf) in enumerate([(2, 64), (2, 128), (3, 256), (3, 512)]):
+        tmp = img_conv_group(
+            input=tmp, conv_num_filter=[nf] * n, pool_size=2,
+            num_channels=num_channels if i == 0 else None,
+            conv_act=A.Relu(), pool_stride=2)
+    tmp = L.dropout(input=tmp, dropout_rate=0.5)
+    tmp = L.fc(input=tmp, size=512, act=A.Relu())
+    tmp = L.batch_norm(input=tmp, act=A.Relu())
+    return L.fc(input=tmp, size=num_classes, act=A.Softmax())
+
+
+def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
+                bias_param_attr=None, inner_param_attr=None, act=None,
+                gate_act=None, state_act=None):
+    """fc(4*size) + lstmemory (trainer_config_helpers simple_lstm)."""
+    proj = L.fc(input=input, size=size * 4, act=None,
+                param_attr=mat_param_attr, bias_attr=False)
+    return L.lstmemory(input=proj, name=name, reverse=reverse, act=act,
+                       gate_act=gate_act, state_act=state_act,
+                       bias_attr=bias_param_attr,
+                       param_attr=inner_param_attr)
+
+
+def bidirectional_lstm(input, size, name=None, return_seq=False,
+                       fwd_mat_param_attr=None, bwd_mat_param_attr=None):
+    fwd = simple_lstm(input=input, size=size,
+                      mat_param_attr=fwd_mat_param_attr)
+    bwd = simple_lstm(input=input, size=size, reverse=True,
+                      mat_param_attr=bwd_mat_param_attr)
+    if return_seq:
+        return L.concat(input=[fwd, bwd], name=name)
+    return L.concat(input=[L.last_seq(input=fwd), L.first_seq(input=bwd)],
+                    name=name)
+
+
+def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
+               gru_param_attr=None, gru_bias_attr=None, act=None,
+               gate_act=None):
+    proj = L.fc(input=input, size=size * 3, act=None,
+                param_attr=mixed_param_attr, bias_attr=False)
+    return L.grumemory(input=proj, name=name, reverse=reverse, act=act,
+                       gate_act=gate_act, param_attr=gru_param_attr,
+                       bias_attr=gru_bias_attr)
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False,
+                      fwd_mixed_param_attr=None, bwd_mixed_param_attr=None):
+    fwd = simple_gru(input=input, size=size,
+                     mixed_param_attr=fwd_mixed_param_attr)
+    bwd = simple_gru(input=input, size=size, reverse=True,
+                     mixed_param_attr=bwd_mixed_param_attr)
+    if return_seq:
+        return L.concat(input=[fwd, bwd], name=name)
+    return L.concat(input=[L.last_seq(input=fwd), L.first_seq(input=bwd)],
+                    name=name)
+
+
+def text_conv_pool(input, context_len, hidden_size, name=None,
+                   context_start=None, pool_type=None, fc_act=None,
+                   fc_param_attr=None):
+    """context window fc + sequence pooling (text CNN building block)."""
+    fc = L.fc(input=input, size=hidden_size, act=fc_act,
+              param_attr=fc_param_attr)
+    return L.pooling(input=fc, pooling_type=pool_type or P.Max(), name=name)
+
+
+sequence_conv_pool = text_conv_pool
+
+
+def inputs(layers, *args):
+    """Declare data layer order (trainer_config_helpers inputs())."""
+    if not isinstance(layers, (list, tuple)):
+        layers = [layers] + list(args)
+    return list(layers)
+
+
+def outputs(layers, *args):
+    """Declare output layers (trainer_config_helpers outputs())."""
+    if not isinstance(layers, (list, tuple)):
+        layers = [layers] + list(args)
+    return list(layers)

@@ -1,0 +1,76 @@
+"""v2 layer arithmetic (reference python/paddle/v2/op.py): operator
+overloading + unary math on Layer nodes — exp/log/abs/sigmoid/tanh/
+square/relu/sqrt plus +, -, unary neg, and scalar *."""
+
+from .config_base import Layer
+from . import layer as v2_layer
+from ..fluid import layers as F
+
+__all__ = ["exp", "log", "abs", "sigmoid", "tanh", "square", "relu",
+           "sqrt"]
+
+
+def _unary(op_name):
+    def impl(one):
+        def build(pv):
+            from ..fluid.layer_helper import LayerHelper
+            helper = LayerHelper(op_name)
+            out = helper.create_variable_for_type_inference(pv.dtype)
+            helper.append_op(type=op_name, inputs={"X": pv},
+                             outputs={"Out": out})
+            return out
+
+        return Layer(parents=[one], build_fn=build, layer_type=op_name)
+
+    impl.__name__ = op_name
+    return impl
+
+
+exp = _unary("exp")
+log = _unary("log")
+abs = _unary("abs")
+sigmoid = _unary("sigmoid")
+tanh = _unary("tanh")
+square = _unary("square")
+relu = _unary("relu")
+sqrt = _unary("sqrt")
+
+
+def _add(self, other):
+    if isinstance(other, Layer):
+        return v2_layer.addto([self, other])
+    return _slope(self, 1.0, float(other))
+
+
+def _neg(self):
+    return _slope(self, -1.0, 0.0)
+
+
+def _sub(self, other):
+    if isinstance(other, Layer):
+        return v2_layer.addto([self, _neg(other)])
+    return _slope(self, 1.0, -float(other))
+
+
+def _rsub(self, other):
+    return _slope(_sub(self, other), -1.0, 0.0)
+
+
+def _mul(self, other):
+    if isinstance(other, Layer):
+        raise TypeError("layer * layer is not defined; use "
+                        "fluid elementwise_mul via a custom layer")
+    return _slope(self, float(other), 0.0)
+
+
+def _slope(one, slope, intercept):
+    return v2_layer.slope_intercept(one, slope=slope, intercept=intercept)
+
+
+Layer.__add__ = _add
+Layer.__radd__ = _add
+Layer.__neg__ = _neg
+Layer.__sub__ = _sub
+Layer.__rsub__ = _rsub
+Layer.__mul__ = _mul
+Layer.__rmul__ = _mul

@@ -1,0 +1,159 @@
+"""v2 optimizers (reference python/paddle/v2/optimizer.py).
+
+There, each v2 optimizer routes kwargs through trainer_config_helpers
+``settings()`` into a C++ ParameterUpdater. Here each one lowers to the
+matching fluid optimizer (whose update rules are jitted XLA ops), keeping
+the v2 surface: learning_rate, regularization=L2Regularization(rate),
+learning_rate_schedule ('constant' | 'poly' | 'exp' | 'discexp'), and
+model_average=ModelAverage(...).
+"""
+
+from ..fluid import optimizer as F_opt
+from ..fluid import regularizer as F_reg
+from ..fluid import layers as F
+
+__all__ = [
+    "Momentum", "Adam", "Adamax", "AdaGrad", "DecayedAdaGrad", "AdaDelta",
+    "RMSProp", "ModelAverage", "L2Regularization", "Optimizer",
+]
+
+
+class L2Regularization(object):
+    """settings(regularization=...) analogue."""
+
+    def __init__(self, rate=0.0):
+        self.rate = rate
+
+
+class ModelAverage(object):
+    """settings(model_average=...) analogue — carried through to the fluid
+    ModelAverage wrapper when used via trainer."""
+
+    def __init__(self, average_window=0.15, max_average_window=None,
+                 min_average_window=None, do_average_in_cpu=False):
+        self.average_window = average_window
+        self.max_average_window = max_average_window
+        self.min_average_window = min_average_window
+
+
+class Optimizer(object):
+    def __init__(self, learning_rate=1e-3, learning_rate_decay_a=0.0,
+                 learning_rate_decay_b=0.0,
+                 learning_rate_schedule="constant", regularization=None,
+                 model_average=None, gradient_clipping_threshold=None,
+                 batch_size=None, learning_rate_args=None, **kwargs):
+        self.learning_rate = learning_rate
+        self.decay_a = learning_rate_decay_a
+        self.decay_b = learning_rate_decay_b
+        self.schedule = learning_rate_schedule
+        self.regularization = regularization
+        self.model_average = model_average
+        self.gradient_clipping_threshold = gradient_clipping_threshold
+
+    def _lr(self):
+        """Lower the v1 learning_rate_schedule to in-graph decay ops
+        (trainer_config_helpers optimizers.py schedule semantics:
+        poly: lr*(1+a*t)^-b, exp/discexp: lr*a^(t/b))."""
+        lr = self.learning_rate
+        if self.schedule in (None, "constant"):
+            return lr
+        from ..fluid.layers import learning_rate_scheduler as sched
+        if self.schedule == "poly":
+            counter = sched._decay_step_counter()
+            return F.scale(
+                F.pow(F.scale(counter, scale=self.decay_a, bias=1.0),
+                      factor=-self.decay_b), scale=lr)
+        if self.schedule in ("exp", "discexp"):
+            return sched.exponential_decay(
+                lr, decay_steps=max(int(self.decay_b), 1),
+                decay_rate=self.decay_a,
+                staircase=(self.schedule == "discexp"))
+        raise ValueError("unknown learning_rate_schedule %r" % self.schedule)
+
+    def _reg(self):
+        if isinstance(self.regularization, L2Regularization) \
+                and self.regularization.rate:
+            return F_reg.L2Decay(self.regularization.rate)
+        return None
+
+    def to_fluid(self):
+        raise NotImplementedError
+
+    def _wrap(self, opt):
+        if self.gradient_clipping_threshold:
+            from ..fluid import clip as F_clip
+            opt._v2_grad_clip = F_clip.GradientClipByGlobalNorm(
+                self.gradient_clipping_threshold)
+        return opt
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=None, sparse=False, **kwargs):
+        super(Momentum, self).__init__(**kwargs)
+        self.momentum = momentum or 0.0
+
+    def to_fluid(self):
+        return self._wrap(F_opt.MomentumOptimizer(
+            learning_rate=self._lr(), momentum=self.momentum,
+            regularization=self._reg()))
+
+
+class Adam(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super(Adam, self).__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def to_fluid(self):
+        return self._wrap(F_opt.AdamOptimizer(
+            learning_rate=self._lr(), beta1=self.beta1, beta2=self.beta2,
+            epsilon=self.epsilon, regularization=self._reg()))
+
+
+class Adamax(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, **kwargs):
+        super(Adamax, self).__init__(**kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def to_fluid(self):
+        return self._wrap(F_opt.AdamaxOptimizer(
+            learning_rate=self._lr(), beta1=self.beta1, beta2=self.beta2,
+            regularization=self._reg()))
+
+
+class AdaGrad(Optimizer):
+    def to_fluid(self):
+        return self._wrap(F_opt.AdagradOptimizer(
+            learning_rate=self._lr(), regularization=self._reg()))
+
+
+class DecayedAdaGrad(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-06, **kwargs):
+        super(DecayedAdaGrad, self).__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self):
+        return self._wrap(F_opt.DecayedAdagradOptimizer(
+            learning_rate=self._lr(), decay=self.rho, epsilon=self.epsilon,
+            regularization=self._reg()))
+
+
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-06, **kwargs):
+        super(AdaDelta, self).__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self):
+        return self._wrap(F_opt.AdadeltaOptimizer(
+            learning_rate=self._lr(), rho=self.rho, epsilon=self.epsilon,
+            regularization=self._reg()))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        super(RMSProp, self).__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self):
+        return self._wrap(F_opt.RMSPropOptimizer(
+            learning_rate=self._lr(), rho=self.rho, epsilon=self.epsilon,
+            regularization=self._reg()))

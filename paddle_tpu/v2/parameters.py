@@ -1,0 +1,160 @@
+"""v2 Parameters: numpy-facing parameter pool shared by trainer/inference.
+
+Reference: python/paddle/v2/parameters.py — Parameters wraps per-parameter
+numpy views synced into C++ GradientMachines (parameters.py:272
+append_gradient_machine). Here the pool syncs with fluid Scopes instead:
+trainer/inference push the pool into a scope before running and pull it
+back after, so one Parameters object can hop between topologies exactly
+like the reference's (create:27, to_tar:328, from_tar:358).
+"""
+
+import struct
+import tarfile
+import io as _io
+
+import numpy as np
+
+from ..fluid import executor as _executor
+from ..fluid import core as _core
+from .topology import Topology
+
+__all__ = ["Parameters", "create"]
+
+
+def create(layers):
+    """Create Parameters for the topology rooted at `layers` (reference
+    parameters.py:27). Runs the startup program once to materialize
+    initialized values."""
+    topo = layers if isinstance(layers, Topology) else Topology(layers)
+    params = Parameters()
+    params.init_from_topology(topo)
+    return params
+
+
+class Parameters(object):
+    def __init__(self):
+        self.__param_dict__ = {}    # name -> np.ndarray
+        self.__shapes__ = {}
+
+    # -- construction ------------------------------------------------------
+    def init_from_topology(self, topology):
+        scope = _executor.Scope()
+        exe = _executor.Executor()
+        with _executor.scope_guard(scope):
+            exe.run(topology.startup_program)
+        for block in topology.main_program.blocks:
+            for var in block.vars.values():
+                if getattr(var, "persistable", False):
+                    val = scope.get(var.name)
+                    if val is not None:
+                        self.__param_dict__[var.name] = np.asarray(val)
+                        self.__shapes__[var.name] = tuple(
+                            np.asarray(val).shape)
+        return self
+
+    # -- mapping interface (reference parameters.py:108-:260) --------------
+    def keys(self):
+        return list(self.__param_dict__.keys())
+
+    def names(self):
+        return self.keys()
+
+    def has_key(self, key):
+        return key in self.__param_dict__
+
+    def __contains__(self, key):
+        return self.has_key(key)
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def __len__(self):
+        return len(self.__param_dict__)
+
+    def __getitem__(self, key):
+        return self.get(key)
+
+    def __setitem__(self, key, value):
+        self.set(key, value)
+
+    def get(self, parameter_name):
+        if parameter_name not in self.__param_dict__:
+            raise KeyError("no parameter %s" % parameter_name)
+        return self.__param_dict__[parameter_name]
+
+    def get_shape(self, key):
+        if key in self.__shapes__:
+            return self.__shapes__[key]
+        return tuple(self.get(key).shape)
+
+    def set(self, parameter_name, value):
+        value = np.asarray(value)
+        if parameter_name in self.__shapes__:
+            want = self.__shapes__[parameter_name]
+            if tuple(value.shape) != tuple(want):
+                raise ValueError(
+                    "shape mismatch for %s: expect %s got %s"
+                    % (parameter_name, want, value.shape))
+        self.__param_dict__[parameter_name] = value
+        self.__shapes__[parameter_name] = tuple(value.shape)
+
+    # -- scope sync (the TPU-native analogue of append_gradient_machine) --
+    def push_to_scope(self, scope):
+        for name, val in self.__param_dict__.items():
+            scope.set(name, val)
+
+    def pull_from_scope(self, scope, names=None):
+        for name in (names if names is not None else self.keys()):
+            val = scope.get(name)
+            if val is not None:
+                self.__param_dict__[name] = np.asarray(val)
+
+    # -- serialization (reference parameters.py:296-:400) ------------------
+    def serialize(self, name, f):
+        """Single-parameter binary: u32 version, u32 elem size, u64 rank,
+        rank*u64 dims, raw fp32 data — self-describing like the reference's
+        Parameter header."""
+        arr = np.asarray(self.get(name), dtype=np.float32)
+        f.write(struct.pack("<IIQ", 0, 4, arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack("<Q", d))
+        f.write(arr.tobytes())
+
+    def deserialize(self, name, f):
+        _, _, rank = struct.unpack("<IIQ", f.read(16))
+        shape = tuple(struct.unpack("<Q", f.read(8))[0]
+                      for _ in range(rank))
+        count = int(np.prod(shape)) if shape else 1
+        arr = np.frombuffer(f.read(4 * count),
+                            dtype=np.float32).reshape(shape)
+        self.set(name, arr.copy())
+
+    def to_tar(self, f):
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self.keys():
+                buf = _io.BytesIO()
+                self.serialize(name, buf)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name)
+                info.size = len(data)
+                tar.addfile(info, _io.BytesIO(data))
+
+    @staticmethod
+    def from_tar(f):
+        params = Parameters()
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                buf = tar.extractfile(member)
+                params.__param_dict__[member.name] = None
+                params.deserialize(member.name, buf)
+        return params
+
+    def init_from_tar(self, f, exclude_params=None):
+        """Overwrite matching parameters from a tar (reference :386)."""
+        exclude = set(exclude_params or [])
+        other = Parameters.from_tar(f)
+        for name in other.keys():
+            if name in exclude:
+                continue
+            if name in self.__param_dict__:
+                self.set(name, other.get(name))

@@ -1,0 +1,64 @@
+"""v2 training-curve plotter (reference python/paddle/v2/plot/plot.py
+Ploter). Falls back to text output when matplotlib is unavailable or the
+session is headless, like the reference's DISABLE_PLOT path."""
+
+import os
+
+__all__ = ["Ploter"]
+
+
+class PlotData(object):
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter(object):
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = dict((t, PlotData()) for t in args)
+        self.__disable_plot__ = os.environ.get("DISABLE_PLOT", "")
+        self.__plot__ = None
+        if not self.__plot_is_disabled__():
+            try:
+                import matplotlib.pyplot as plt
+                self.__plot__ = plt
+            except Exception:
+                self.__plot__ = None
+
+    def __plot_is_disabled__(self):
+        return self.__disable_plot__ == "True"
+
+    def append(self, title, step, value):
+        assert title in self.__plot_data__
+        self.__plot_data__[title].append(step, value)
+
+    def plot(self, path=None):
+        if self.__plot__ is not None:
+            titles = []
+            for title in self.__args__:
+                data = self.__plot_data__[title]
+                if len(data.step) > 0:
+                    self.__plot__.plot(data.step, data.value)
+                    titles.append(title)
+            self.__plot__.legend(titles, loc="upper left")
+            if path:
+                self.__plot__.savefig(path)
+        else:
+            for title in self.__args__:
+                data = self.__plot_data__[title]
+                if data.step:
+                    print("%s: step %s value %.6f"
+                          % (title, data.step[-1], data.value[-1]))
+
+    def reset(self):
+        for d in self.__plot_data__.values():
+            d.reset()

@@ -1,0 +1,39 @@
+"""v2 pooling type objects (reference python/paddle/v2/pooling.py →
+trainer_config_helpers.poolings). ``seq_pool_type`` drives fluid
+sequence_pool; ``img_pool_type`` drives fluid pool2d."""
+
+__all__ = ["BasePool", "Max", "Avg", "Sum", "SquareRootN", "CudnnMax",
+           "CudnnAvg"]
+
+
+class BasePool(object):
+    seq_pool_type = None
+    img_pool_type = None
+
+    def __repr__(self):
+        return self.__class__.__name__ + "()"
+
+
+class Max(BasePool):
+    seq_pool_type = "max"
+    img_pool_type = "max"
+
+
+class Avg(BasePool):
+    seq_pool_type = "average"
+    img_pool_type = "avg"
+
+
+# cudnn variants are aliases on TPU — one XLA pooling lowering serves both
+CudnnMax = Max
+CudnnAvg = Avg
+
+
+class Sum(BasePool):
+    seq_pool_type = "sum"
+    img_pool_type = "avg"
+
+
+class SquareRootN(BasePool):
+    seq_pool_type = "sqrt"
+    img_pool_type = "avg"

@@ -1,0 +1,91 @@
+"""v2 Topology: realize a layer DAG as a fluid Program pair.
+
+Reference: python/paddle/v2/topology.py — there Topology(output_layers)
+trims and serializes a ModelConfig protobuf (v2/layer.py:263 parse_network)
+for the C++ GradientMachine. Here the "model config" IS a fluid Program:
+one build pass emits the ops, and proto() hands back the serialized Program
+(the TPU stack's IR), so everything downstream (trainer, inference,
+save/load) reuses the fluid machinery.
+"""
+
+import contextlib
+
+from ..fluid import framework
+from ..fluid import unique_name
+from .config_base import Layer
+from .data_type import InputType
+
+__all__ = ["Topology"]
+
+
+class Topology(object):
+    def __init__(self, layers, extra_layers=None):
+        if isinstance(layers, Layer):
+            layers = [layers]
+        if extra_layers is not None and not isinstance(extra_layers, list):
+            extra_layers = [extra_layers]
+        self.layers = list(layers)
+        self.extra_layers = list(extra_layers or [])
+        self.main_program = framework.Program()
+        self.startup_program = framework.Program()
+        self._var_of = {}
+        # Build under a topology-private name generator: rebuilding the
+        # same layer DAG (trainer / test / inference) must produce
+        # IDENTICAL parameter names so one Parameters pool serves them all
+        # (the reference gets this for free from explicit layer-name-based
+        # protobuf naming, trainer_config_helpers wrap_name_default).
+        self._name_gen = unique_name.UniqueNameGenerator()
+        with self.name_guard():
+            with framework.program_guard(self.main_program,
+                                         self.startup_program):
+                ctx = self._var_of
+                self.output_vars = [l.build(ctx) for l in
+                                    self.layers + self.extra_layers]
+        self._data_layers = self._collect_data_layers()
+
+    @contextlib.contextmanager
+    def name_guard(self):
+        """Continue this topology's private unique-name stream (used by the
+        trainer when appending optimizer/metric ops to the built program)."""
+        old = unique_name.switch(self._name_gen)
+        try:
+            yield
+        finally:
+            unique_name.switch(old)
+
+    def _collect_data_layers(self):
+        seen, order = set(), []
+
+        def visit(layer):
+            if id(layer) in seen:
+                return
+            seen.add(id(layer))
+            for p in layer.parents():
+                visit(p)
+            if layer.layer_type == "data":
+                order.append(layer)
+
+        for l in self.layers + self.extra_layers:
+            visit(l)
+        return order
+
+    def data_layers(self):
+        """name -> data Layer, in dependency-discovery order (reference
+        topology.py data_layers)."""
+        return dict((l.name, l) for l in self._data_layers)
+
+    def data_type(self):
+        """[(name, InputType)] in feed order (reference topology.py:data_type
+        — drives DataFeeder construction)."""
+        return [(l.name, l.data_type) for l in self._data_layers]
+
+    def var_for(self, layer):
+        """fluid Variable realizing `layer` in this topology's program."""
+        if id(layer) not in self._var_of:
+            raise ValueError("layer %s is not part of this topology"
+                             % layer.name)
+        return self._var_of[id(layer)]
+
+    def proto(self):
+        """Serialized model config == serialized fluid main Program."""
+        return self.main_program.serialize_to_string()

@@ -1,0 +1,167 @@
+"""v2 SGD trainer (reference python/paddle/v2/trainer.py:37).
+
+The reference SGD builds a C++ GradientMachine from the topology protobuf
+and pumps ParameterUpdater callbacks around forward/backward. The TPU
+build compiles the same topology's fluid Program (+ append_backward +
+optimizer ops) into one jitted XLA step via the fluid Executor, and drives
+the identical user contract: ``SGD(cost, parameters, update_equation)``
+then ``train(reader, num_passes, event_handler, feeding)`` with
+BeginPass/BeginIteration/EndIteration/EndPass events.
+"""
+
+import numpy as np
+
+from . import event as v2_event
+from .topology import Topology
+from .parameters import Parameters
+from ..fluid import executor as _executor
+from ..fluid import clip as _clip
+from ..fluid import layers as F
+from ..fluid.data_feeder import DataFeeder
+from ..fluid.framework import program_guard
+
+__all__ = ["SGD"]
+
+
+def default_event_handler(event):
+    """reference trainer.py:26"""
+    pass
+
+
+class SGD(object):
+    def __init__(self, cost, parameters, update_equation, extra_layers=None,
+                 is_local=True, pserver_spec=None, use_etcd=True):
+        if not isinstance(parameters, Parameters):
+            raise TypeError("parameters must be paddle.v2 Parameters")
+        self.__topology__ = Topology(cost, extra_layers=extra_layers)
+        self.__parameters__ = parameters
+        self.__update_equation__ = update_equation
+
+        self._scope = _executor.Scope()
+        self._exe = _executor.Executor()
+        main = self.__topology__.main_program
+        startup = self.__topology__.startup_program
+        self._cost_var = self.__topology__.output_vars[0]
+        with self.__topology__.name_guard():
+            with program_guard(main, startup):
+                # build inside the guard: lr schedules emit in-graph decay
+                # ops that must land in THIS program
+                fluid_opt = update_equation.to_fluid()
+                clip = getattr(fluid_opt, "_v2_grad_clip", None)
+                if clip is not None:
+                    _clip.set_gradient_clip(clip, program=main)
+                fluid_opt.minimize(self._cost_var)
+            # metrics: when the cost is classification over (softmax, label),
+            # track classification error like the reference's default
+            # evaluator wiring
+            self._metric_vars = {}
+            cost_layer = (cost[0] if isinstance(cost, (list, tuple))
+                          else cost)
+            pl = cost_layer.parents()
+            if (cost_layer.layer_type == "cost" and len(pl) >= 2
+                    and pl[1].layer_type == "data"
+                    and pl[1].data_type.type == 3):  # Index label
+                pred = self.__topology__.var_for(pl[0])
+                label = self.__topology__.var_for(pl[1])
+                with program_guard(main, startup):
+                    acc = F.accuracy(input=pred, label=label)
+                self._metric_vars["classification_error_evaluator"] = acc
+        # initialize scope: startup for non-param state, then the pool
+        with _executor.scope_guard(self._scope):
+            self._exe.run(startup)
+        self.__parameters__.push_to_scope(self._scope)
+        self._train_prog = main
+
+    def get_topology_proto(self):
+        return self.__topology__.proto()
+
+    def save_parameter_to_tar(self, f):
+        self.__sync_back__()
+        self.__parameters__.to_tar(f)
+
+    def __sync_back__(self):
+        self.__parameters__.pull_from_scope(self._scope)
+
+    def _feeder(self, feeding):
+        data_types = self.__topology__.data_type()
+        names = [n for n, _ in data_types]
+        if feeding is not None:
+            if isinstance(feeding, dict):
+                # {name: column index} — reorder to column order
+                names = [kv[0] for kv in
+                         sorted(feeding.items(), key=lambda kv: kv[1])]
+            else:
+                names = list(feeding)
+        feed_vars = [self._train_prog.global_block().var(n) for n in names]
+        return DataFeeder(feed_list=feed_vars, program=self._train_prog)
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        """reference trainer.py:137 — reader yields SAMPLES (not batches);
+        compose with paddle.batch to form minibatches."""
+        if event_handler is None:
+            event_handler = default_event_handler
+        feeder = self._feeder(feeding)
+        fetch = [self._cost_var] + list(self._metric_vars.values())
+        metric_names = list(self._metric_vars.keys())
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            pass_costs, pass_metrics = [], []
+            for batch_id, data_batch in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                with _executor.scope_guard(self._scope):
+                    outs = self._exe.run(self._train_prog,
+                                         feed=feeder.feed(data_batch),
+                                         fetch_list=fetch)
+                cost = float(np.asarray(outs[0]).ravel()[0])
+                # accuracy fetch -> error rate, matching the reference's
+                # classification_error_evaluator semantics
+                metrics = dict(
+                    (k, 1.0 - float(np.asarray(o).ravel()[0])
+                     if k == "classification_error_evaluator"
+                     else float(np.asarray(o).ravel()[0]))
+                    for k, o in zip(metric_names, outs[1:]))
+                pass_costs.append(cost)
+                pass_metrics.append(metrics)
+                event_handler(v2_event.EndForwardBackward(pass_id, batch_id))
+                event_handler(v2_event.EndIteration(
+                    pass_id, batch_id, cost, evaluator=metrics))
+            agg = {}
+            if pass_metrics:
+                for k in metric_names:
+                    agg[k] = float(np.mean([m[k] for m in pass_metrics]))
+            event_handler(v2_event.EndPass(pass_id, evaluator=agg))
+        self.__sync_back__()
+
+    def test(self, reader, feeding=None):
+        """reference trainer.py:217 — evaluate on a reader, return
+        TestResult(cost, metrics). Runs the forward program only (the
+        topology's programs untouched by optimizer ops)."""
+        topo = Topology(self.__topology__.layers)
+        cost_var = topo.output_vars[0]
+        scope = _executor.Scope()
+        with _executor.scope_guard(scope):
+            self._exe.run(topo.startup_program)
+        self.__sync_back__()
+        self.__parameters__.push_to_scope(scope)
+        data_types = topo.data_type()
+        names = [n for n, _ in data_types]
+        if feeding is not None:
+            if isinstance(feeding, dict):
+                names = [kv[0] for kv in
+                         sorted(feeding.items(), key=lambda kv: kv[1])]
+            else:
+                names = list(feeding)
+        feed_vars = [topo.main_program.global_block().var(n) for n in names]
+        feeder = DataFeeder(feed_list=feed_vars, program=topo.main_program)
+        test_prog = topo.main_program.clone(for_test=True)
+        costs, count = [], 0
+        for data_batch in reader():
+            with _executor.scope_guard(scope):
+                outs = self._exe.run(test_prog,
+                                     feed=feeder.feed(data_batch),
+                                     fetch_list=[cost_var])
+            costs.append(float(np.asarray(outs[0]).ravel()[0])
+                         * len(data_batch))
+            count += len(data_batch)
+        avg = sum(costs) / max(count, 1)
+        return v2_event.TestResult(evaluator={}, cost=avg)

@@ -126,10 +126,20 @@ def _device_events(trace_dir):
             rec[2] = min(rec[2], ms)
             rec[3] = max(rec[3], ms)
     if events and not out:
-        warnings.warn(
-            "profiler: device trace parsed but no XLA-op events matched — "
-            "the jax trace format may have changed (expected X events "
-            "with hlo_category args or an 'XLA Ops' thread)")
+        # a pure-host trace (CPU backend: every process is '/host:CPU'
+        # and X events are python frames / threadpool regions) simply has
+        # no device op table — only warn when a device process exists but
+        # its ops failed to parse, which indicates real format drift
+        has_device_pid = any(
+            ("TPU" in str(n)) or ("GPU" in str(n)) or
+            ("device" in str(n).lower())
+            for n in pids.values())
+        if has_device_pid:
+            warnings.warn(
+                "profiler: device trace parsed but no XLA-op events "
+                "matched — the jax trace format may have changed "
+                "(expected X events with hlo_category args or an "
+                "'XLA Ops' thread)")
     return out
 
 

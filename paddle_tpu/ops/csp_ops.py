@@ -101,20 +101,22 @@ def _go(ctx):
     snapshot = dict(env)
     step, seed = ctx.step, ctx.seed
 
-    # channels this block PRODUCES into (transitively through its
-    # sub-blocks): only these may be force-closed on failure — closing
-    # every reachable channel would silently kill unrelated pipelines
-    def sent_channels(blk, acc, seen):
+    # channels this block TOUCHES (sends to OR receives from, transitively
+    # through its sub-blocks): only these may be force-closed on failure.
+    # Closing its send targets unblocks downstream consumers; closing its
+    # recv sources unblocks upstream producers parked in a rendezvous
+    # send. Channels of unrelated pipelines stay open.
+    def touched_channels(blk, acc, seen):
         for op in blk.ops:
-            if op.type == "channel_send":
+            if op.type in ("channel_send", "channel_recv"):
                 acc.update(op.inputs.get("Channel", []))
             sub = op.attrs.get("sub_block")
             if sub is not None and id(sub) not in seen:
                 seen.add(id(sub))
-                sent_channels(sub, acc, seen)
+                touched_channels(sub, acc, seen)
         return acc
 
-    produced = sent_channels(block, set(), set())
+    produced = touched_channels(block, set(), set())
 
     def run():
         try:

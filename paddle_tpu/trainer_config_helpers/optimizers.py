@@ -23,22 +23,26 @@ RMSPropOptimizer = _opt.RMSProp
 _settings = {}
 
 
-def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
+def settings(batch_size=None, learning_rate=None, learning_method=None,
              regularization=None, model_average=None,
              gradient_clipping_threshold=None, **kwargs):
     """Record the global training settings (reference optimizers.py
-    settings()). Returns the equivalent v2 optimizer for direct use with
-    the SGD trainer."""
+    settings() — each call REPLACES the config, like the reference's
+    global reset in config_parser). Returns the equivalent v2 optimizer
+    for direct use with the SGD trainer. ``learning_rate`` left unset
+    keeps whatever the optimizer instance already carries."""
     method = learning_method or _opt.Momentum(momentum=0.0)
     if isinstance(method, type):
         method = method()
-    method.learning_rate = learning_rate
+    if learning_rate is not None:
+        method.learning_rate = learning_rate
     if regularization is not None:
         method.regularization = regularization
     if model_average is not None:
         method.model_average = model_average
     if gradient_clipping_threshold is not None:
         method.gradient_clipping_threshold = gradient_clipping_threshold
+    _settings.clear()
     _settings.update(dict(batch_size=batch_size, optimizer=method,
                           **kwargs))
     return method

@@ -1,25 +1,88 @@
 """v2 DataFeeder (reference python/paddle/v2/data_feeder.py): converts
-reader minibatches into feed form given the topology's data types and an
-optional ``feeding`` name->column mapping. Thin adapter over the fluid
-DataFeeder (the dense/LoD conversion lives there)."""
+reader minibatches into feed dicts given the topology's data types and an
+optional ``feeding`` name->column mapping. Standalone: conversion is
+driven purely by the declared InputTypes (the reference's
+DataProviderConverter), no Program needed."""
 
-from ..fluid.data_feeder import DataFeeder as _FluidFeeder
+import numpy as np
 
-__all__ = ["DataFeeder"]
+from . import data_type as _dt
+from ..fluid.lod import LoDTensor
+
+__all__ = ["DataFeeder", "resolve_feed_order"]
+
+
+def resolve_feed_order(names, feeding):
+    """Shared feeding-spec resolution (trainer/inference/feeder all accept
+    the same ``feeding``): None keeps the topology's data order; a dict
+    maps name -> sample column index; a list gives the order directly."""
+    if feeding is None:
+        return list(names)
+    if isinstance(feeding, dict):
+        return [kv[0] for kv in sorted(feeding.items(),
+                                       key=lambda kv: kv[1])]
+    return list(feeding)
 
 
 class DataFeeder(object):
     def __init__(self, data_types, feeding=None):
         self.data_types = list(data_types)
-        names = [n for n, _ in self.data_types]
-        if feeding is not None:
-            if isinstance(feeding, dict):
-                names = [kv[0] for kv in
-                         sorted(feeding.items(), key=lambda kv: kv[1])]
-            else:
-                names = list(feeding)
-        self.feed_order = names
+        self._type_of = dict(self.data_types)
+        self.feed_order = resolve_feed_order(
+            [n for n, _ in self.data_types], feeding)
 
-    def __call__(self, data_batch, program=None):
-        feeder = _FluidFeeder(feed_list=self.feed_order, program=program)
-        return feeder.feed(data_batch)
+    def __call__(self, data_batch):
+        return self.feed(data_batch)
+
+    def feed(self, data_batch):
+        """data_batch: list of sample tuples in feed_order column order.
+        Returns {name: ndarray | LoDTensor} in the fluid executor's feed
+        format."""
+        columns = list(zip(*data_batch))
+        if len(columns) < len(self.feed_order):
+            raise ValueError(
+                "each sample must have %d slots (feed order %s), got %d"
+                % (len(self.feed_order), self.feed_order, len(columns)))
+        out = {}
+        for name, col in zip(self.feed_order, columns):
+            tp = self._type_of.get(name)
+            if tp is None:
+                raise KeyError("no data type declared for feed %r" % name)
+            out[name] = self._convert(tp, col)
+        return out
+
+    @staticmethod
+    def _convert(tp, col):
+        is_seq = tp.seq_type != _dt.SequenceType.NO_SEQUENCE
+        if tp.type == _dt.DataType.Index:
+            if is_seq:
+                lens = [len(s) for s in col]
+                flat = np.concatenate(
+                    [np.asarray(s, dtype=np.int64).reshape(-1, 1)
+                     for s in col])
+                t = LoDTensor(flat)
+                t.set_recursive_sequence_lengths([lens])
+                return t
+            return np.asarray(col, dtype=np.int64).reshape(-1, 1)
+        # dense (sparse vectors densify — the TPU-native encoding)
+        if tp.type in (_dt.DataType.SparseNonValue, _dt.DataType.SparseValue):
+            col = [DataFeeder._densify(s, tp) for s in col]
+        if is_seq:
+            lens = [len(s) for s in col]
+            flat = np.concatenate(
+                [np.asarray(s, dtype=np.float32).reshape(len(s), -1)
+                 for s in col])
+            t = LoDTensor(flat)
+            t.set_recursive_sequence_lengths([lens])
+            return t
+        return np.asarray(col, dtype=np.float32)
+
+    @staticmethod
+    def _densify(sample, tp):
+        dense = np.zeros(tp.dim, dtype=np.float32)
+        if tp.type == _dt.DataType.SparseNonValue:
+            dense[np.asarray(sample, dtype=np.int64)] = 1.0
+        else:
+            for idx, val in sample:
+                dense[int(idx)] = float(val)
+        return dense

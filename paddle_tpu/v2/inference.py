@@ -31,13 +31,9 @@ class Inference(object):
             for_test=True)
 
     def iter_infer(self, input, feeding=None):
-        names = [n for n, _ in self.__data_types__]
-        if feeding is not None:
-            if isinstance(feeding, dict):
-                names = [kv[0] for kv in
-                         sorted(feeding.items(), key=lambda kv: kv[1])]
-            else:
-                names = list(feeding)
+        from .data_feeder import resolve_feed_order
+        names = resolve_feed_order(
+            [n for n, _ in self.__data_types__], feeding)
         feed_vars = [self.__program__.global_block().var(n) for n in names]
         feeder = DataFeeder(feed_list=feed_vars, program=self.__program__)
         fetch = list(self.__topology__.output_vars)

@@ -51,6 +51,18 @@ class SGD(object):
                 if clip is not None:
                     _clip.set_gradient_clip(clip, program=main)
                 fluid_opt.minimize(self._cost_var)
+                self._model_average = None
+                ma = getattr(update_equation, "model_average", None)
+                if ma is not None:
+                    from ..fluid.optimizer import ModelAverage as FluidMA
+                    self._model_average = FluidMA(
+                        average_window_rate=ma.average_window,
+                        min_average_window=(ma.min_average_window
+                                            if ma.min_average_window
+                                            is not None else 10000),
+                        max_average_window=(ma.max_average_window
+                                            if ma.max_average_window
+                                            is not None else 10000))
             # metrics: when the cost is classification over (softmax, label),
             # track classification error like the reference's default
             # evaluator wiring
@@ -83,15 +95,9 @@ class SGD(object):
         self.__parameters__.pull_from_scope(self._scope)
 
     def _feeder(self, feeding):
-        data_types = self.__topology__.data_type()
-        names = [n for n, _ in data_types]
-        if feeding is not None:
-            if isinstance(feeding, dict):
-                # {name: column index} — reorder to column order
-                names = [kv[0] for kv in
-                         sorted(feeding.items(), key=lambda kv: kv[1])]
-            else:
-                names = list(feeding)
+        from .data_feeder import resolve_feed_order
+        names = resolve_feed_order(
+            [n for n, _ in self.__topology__.data_type()], feeding)
         feed_vars = [self._train_prog.global_block().var(n) for n in names]
         return DataFeeder(feed_list=feed_vars, program=self._train_prog)
 
@@ -136,21 +142,23 @@ class SGD(object):
         """reference trainer.py:217 — evaluate on a reader, return
         TestResult(cost, metrics). Runs the forward program only (the
         topology's programs untouched by optimizer ops)."""
+        from .data_feeder import resolve_feed_order
         topo = Topology(self.__topology__.layers)
         cost_var = topo.output_vars[0]
         scope = _executor.Scope()
         with _executor.scope_guard(scope):
             self._exe.run(topo.startup_program)
-        self.__sync_back__()
+        if self._model_average is not None:
+            # evaluate with the sliding-window averaged weights, like the
+            # reference's ParameterUpdater apply/restore around testing
+            with _executor.scope_guard(self._scope):
+                with self._model_average.apply(executor=self._exe):
+                    self.__parameters__.pull_from_scope(self._scope)
+        else:
+            self.__sync_back__()
         self.__parameters__.push_to_scope(scope)
-        data_types = topo.data_type()
-        names = [n for n, _ in data_types]
-        if feeding is not None:
-            if isinstance(feeding, dict):
-                names = [kv[0] for kv in
-                         sorted(feeding.items(), key=lambda kv: kv[1])]
-            else:
-                names = list(feeding)
+        names = resolve_feed_order(
+            [n for n, _ in topo.data_type()], feeding)
         feed_vars = [topo.main_program.global_block().var(n) for n in names]
         feeder = DataFeeder(feed_list=feed_vars, program=topo.main_program)
         test_prog = topo.main_program.clone(for_test=True)

@@ -1,0 +1,39 @@
+"""The benchmark harness (reference benchmark/fluid/fluid_benchmark.py)
+drives every zoo model end to end with synthetic data and reports
+examples/sec as one JSON line."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(*extra):
+    cmd = [sys.executable, os.path.join(REPO, "tools", "fluid_benchmark.py"),
+           "--device", "CPU", "--iterations", "3", "--skip_batch_num", "1",
+           *extra]
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                         cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def test_benchmark_mnist_local():
+    r = _run("--model", "mnist", "--batch_size", "16")
+    assert r["model"] == "mnist" and r["device"] == "cpu"
+    assert r["examples_per_sec"] > 0
+    assert r["last_loss"] == r["last_loss"]  # finite (json would be null)
+
+
+def test_benchmark_lstm_ragged_feeds():
+    r = _run("--model", "stacked_dynamic_lstm", "--batch_size", "8")
+    assert r["examples_per_sec"] > 0
+
+
+def test_benchmark_parallel_mode():
+    r = _run("--model", "mnist", "--batch_size", "16", "--parallel")
+    assert r["parallel"] is True
+    assert r["examples_per_sec"] > 0

@@ -1,0 +1,178 @@
+"""Benchmark harness (reference benchmark/fluid/fluid_benchmark.py).
+
+Same CLI shape as the reference runner: pick a model from the benchmark
+zoo, train for a fixed number of iterations with synthetic data
+(--use_fake_data is the default here: this environment generates data
+procedurally), report examples/sec. `--parallel` runs through the
+mesh-sharded ParallelExecutor; `--update_method` mirrors the reference's
+local/pserver/nccl2 modes (nccl2 == collective DP over the jax mesh).
+
+Examples:
+    python tools/fluid_benchmark.py --model mnist --iterations 20
+    python tools/fluid_benchmark.py --model resnet --batch_size 256 \
+        --data_set imagenet --layout NHWC
+    python tools/fluid_benchmark.py --model stacked_dynamic_lstm
+    python tools/fluid_benchmark.py --model vgg --parallel
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+MODELS = ["mnist", "resnet", "vgg", "stacked_dynamic_lstm",
+          "machine_translation", "se_resnext", "transformer"]
+
+
+def parse_args():
+    p = argparse.ArgumentParser("fluid_benchmark")
+    p.add_argument("--model", default="mnist", choices=MODELS)
+    p.add_argument("--batch_size", type=int, default=0,
+                   help="0 = model default")
+    p.add_argument("--iterations", type=int, default=20)
+    p.add_argument("--skip_batch_num", type=int, default=2,
+                   help="warmup batches excluded from timing")
+    p.add_argument("--pass_num", type=int, default=1)
+    p.add_argument("--device", default=None, choices=[None, "CPU", "TPU"],
+                   help="default: whatever jax picked")
+    p.add_argument("--data_set", default=None,
+                   help="imagenet|cifar10|flowers for the vision models")
+    p.add_argument("--layout", default="NCHW", choices=["NCHW", "NHWC"])
+    p.add_argument("--learning_rate", type=float, default=0.0)
+    p.add_argument("--parallel", action="store_true",
+                   help="train through ParallelExecutor (all devices)")
+    p.add_argument("--update_method", default="local",
+                   choices=["local", "pserver", "nccl2"],
+                   help="nccl2 = collective DP (mesh); pserver = RPC PS")
+    p.add_argument("--no_amp", action="store_true",
+                   help="disable bf16 AMP (AMP on by default on TPU)")
+    p.add_argument("--profile", action="store_true")
+    p.add_argument("--use_fake_data", action="store_true", default=True)
+    return p.parse_args()
+
+
+def build_model(args):
+    from paddle_tpu import models
+    import importlib
+    mod = importlib.import_module("paddle_tpu.models.%s" % args.model)
+    kwargs = {}
+    if args.batch_size:
+        kwargs["batch_size"] = args.batch_size
+    if args.learning_rate:
+        kwargs["lr"] = args.learning_rate
+    if args.model in ("resnet", "vgg") and args.data_set:
+        kwargs["dataset"] = args.data_set
+    if args.model == "resnet":
+        kwargs["layout"] = args.layout
+    return mod.get_model(**kwargs)
+
+
+def synth_feed(feeds, batch, rng, program=None):
+    """Synthetic batch for the model's feed vars (the reference's
+    --use_fake_data constant-fill path, fluid_benchmark.py:149)."""
+    from paddle_tpu.fluid.lod import LoDTensor
+    from paddle_tpu.fluid import core
+    out = {}
+    for v in feeds:
+        if isinstance(v, str):   # some models return feed NAMES
+            v = program.global_block().var(v)
+        dtype = core.convert_dtype_to_np(v.dtype)
+        shape = [d if isinstance(d, int) and d > 0 else None
+                 for d in v.shape]
+        sample_shape = [d for d in shape[1:] if d is not None]
+        if v.lod_level and v.lod_level > 0:
+            lens = rng.randint(3, 12, size=batch)
+            flat = np.concatenate(
+                [_sample(dtype, [l] + sample_shape, rng) for l in lens])
+            t = LoDTensor(flat)
+            t.set_recursive_sequence_lengths([lens.tolist()])
+            out[v.name] = t
+        else:
+            out[v.name] = _sample(dtype, [batch] + sample_shape, rng)
+    return out
+
+
+def _sample(dtype, shape, rng):
+    if np.issubdtype(dtype, np.integer):
+        # ids: stay tiny so any vocab/label bound holds
+        return rng.randint(0, 2, size=shape).astype(dtype)
+    return rng.uniform(-0.5, 0.5, size=shape).astype(dtype)
+
+
+def main():
+    args = parse_args()
+    import jax
+    if args.device == "CPU":
+        # set BEFORE any backend query — default_backend() would
+        # initialize (and possibly wait on) the TPU runtime
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import profiler as prof
+
+    if not args.no_amp and jax.default_backend() == "tpu":
+        fluid.set_amp(True)
+
+    main_prog, startup, feeds, loss, acc, _ = build_model(args)
+    feeds = [main_prog.global_block().var(f) if isinstance(f, str) else f
+             for f in feeds]
+    batch = args.batch_size or feeds[0].shape[0] or 32
+    if not isinstance(batch, int) or batch <= 0:
+        batch = 32
+    rng = np.random.RandomState(0)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+
+    pe = None
+    if args.parallel or args.update_method == "nccl2":
+        pe = fluid.ParallelExecutor(
+            use_cuda=False, loss_name=loss.name, main_program=main_prog)
+
+    fetch = [loss.name] + ([acc.name] if acc is not None else [])
+    if args.profile:
+        prof.start_profiler("All")
+
+    n_warm, n_timed = args.skip_batch_num, args.iterations
+    examples = 0
+    t0 = time.perf_counter()
+    last = None
+    for i in range(n_warm + n_timed):
+        # start timing BEFORE the first timed batch so its runtime
+        # (including jit compile when n_warm == 0) is in the denominator
+        if i == n_warm:
+            t0 = time.perf_counter()
+        feed = synth_feed(feeds, batch, rng, program=main_prog)
+        if pe is not None:
+            outs = pe.run(fetch_list=fetch, feed=feed)
+        else:
+            outs = exe.run(main_prog, feed=feed, fetch_list=fetch)
+        last = float(np.asarray(outs[0]).ravel()[0])  # host sync fence
+        if i >= n_warm:
+            examples += batch
+    dt = time.perf_counter() - t0
+
+    if args.profile:
+        prof.stop_profiler("total", "/tmp/fluid_benchmark_profile")
+
+    assert np.isfinite(last), "loss diverged"
+    print(json.dumps({
+        "model": args.model,
+        "batch_size": batch,
+        "iterations": n_timed,
+        "examples_per_sec": round(examples / dt, 2) if dt else None,
+        "last_loss": round(last, 4),
+        "device": jax.default_backend(),
+        "parallel": bool(pe),
+        "update_method": args.update_method,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ __all__ = [
     "rank_cost", "sum_cost", "crf_layer", "crf_decoding_layer",
     "ctc_layer", "warp_ctc_layer", "nce_layer", "hsigmoid_layer",
     "eos_layer", "lstmemory", "grumemory", "LayerOutput",
+    "recurrent_group", "memory", "StaticInput",
 ]
 
 # v1 name -> v2 implementation
@@ -64,6 +65,10 @@ hsigmoid_layer = _v2.hsigmoid
 eos_layer = _v2.eos
 lstmemory = _v2.lstmemory
 grumemory = _v2.grumemory
+
+recurrent_group = _v2.recurrent_group
+memory = _v2.memory
+StaticInput = _v2.StaticInput
 
 # the v1 return type name; v2 Layer nodes play the role
 LayerOutput = _LayerNode

@@ -22,12 +22,14 @@ class Layer(object):
     """
 
     def __init__(self, name=None, parents=None, build_fn=None,
-                 layer_type="layer", extra_parents=None):
+                 layer_type="layer", extra_parents=None,
+                 build_with_ctx=False):
         self.name = name if name else unique_name.generate(layer_type)
         self.layer_type = layer_type
         self.__parents__ = list(parents or [])
         self.__extra_parents__ = list(extra_parents or [])
         self.__build_fn__ = build_fn
+        self.__build_with_ctx__ = build_with_ctx
 
     def parents(self):
         return self.__parents__ + self.__extra_parents__
@@ -46,7 +48,10 @@ class Layer(object):
         parent_vars = [p.build(context) for p in self.__parents__]
         for extra in self.__extra_parents__:
             extra.build(context)
-        out = self.__build_fn__(*parent_vars)
+        if self.__build_with_ctx__:
+            out = self.__build_fn__(context, *parent_vars)
+        else:
+            out = self.__build_fn__(*parent_vars)
         context[key] = out
         return out
 

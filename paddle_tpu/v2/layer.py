@@ -34,7 +34,7 @@ __all__ = [
     "square_error_cost", "mse_cost", "multi_binary_label_cross_entropy_cost",
     "huber_regression_cost", "rank_cost", "sum_cost", "crf", "crf_decoding",
     "ctc", "warp_ctc", "nce", "hsigmoid", "eos", "parse_network",
-    "get_layer",
+    "get_layer", "recurrent_group", "memory", "StaticInput",
 ]
 
 _name_to_layer = {}
@@ -568,6 +568,214 @@ def eos(input, eos_id, name=None, layer_attr=None):
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
                            build_fn=build, layer_type="eos"))
+
+
+# ---------------------------------------------------------------------------
+# recurrent_group — the v1/v2 custom-RNN construct
+# ---------------------------------------------------------------------------
+
+class StaticInput(object):
+    """Unrolled (per-sequence constant) input to recurrent_group
+    (trainer_config_helpers layers.py StaticInput)."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        if is_seq:
+            raise NotImplementedError(
+                "sequence-typed StaticInput: the padded-dense encoding "
+                "keeps batch order fixed; pass the sequence as a normal "
+                "input instead")
+        self.input = input
+        self.size = size
+
+
+class _Memory(Layer):
+    """Marker node for `memory(name=...)` inside a step function; resolved
+    by recurrent_group into a DynamicRNN state slot."""
+
+    def __init__(self, link_name, size, boot_layer=None,
+                 boot_with_const_id=None, is_seq=False):
+        self.link_name = link_name
+        self.size = size
+        self.boot_layer = boot_layer
+        # build_fn is never used directly — recurrent_group seeds the
+        # context with this node's state var before the step DAG builds
+        super(_Memory, self).__init__(
+            name="@mem@" + link_name, parents=[],
+            build_fn=lambda: (_ for _ in ()).throw(RuntimeError(
+                "memory() used outside recurrent_group")),
+            layer_type="memory")
+
+
+def memory(name, size, boot_layer=None, is_seq=False, **kwargs):
+    """Previous-timestep output of the step layer called `name`
+    (trainer_config_helpers memory()); initial value is zeros or
+    `boot_layer`'s (batch-sized) output."""
+    if is_seq:
+        raise NotImplementedError(
+            "sequence-level memory (is_seq=True) is not supported — the "
+            "padded-dense scan carries fixed-rank state")
+    unsupported = {k: v for k, v in kwargs.items() if v not in (None, False)}
+    if unsupported:
+        raise NotImplementedError(
+            "memory(): unsupported v1 arguments %s" % sorted(unsupported))
+    return _Memory(name, size, boot_layer=boot_layer)
+
+
+class _StepSlot(Layer):
+    """Per-timestep view of a recurrent_group input inside the step DAG."""
+
+    def __init__(self, kind, source):
+        self.kind = kind            # "seq" | "static"
+        self.source = source
+        super(_StepSlot, self).__init__(
+            parents=[], layer_type="step_input",
+            build_fn=lambda: (_ for _ in ()).throw(RuntimeError(
+                "step input used outside recurrent_group")))
+
+
+def recurrent_group(step, input, reverse=False, name=None, **kwargs):
+    """Run `step` over every timestep of the sequence inputs
+    (trainer_config_helpers recurrent_group -> here fluid DynamicRNN ->
+    one `recurrent` op lowered to a masked lax.scan).
+
+    `step` executes ONCE, eagerly, at DSL time over placeholder nodes —
+    v2 layers are lazy, so this only discovers the step DAG (and its
+    `memory` declarations); ops are emitted when a Topology builds."""
+    if kwargs:
+        raise NotImplementedError(
+            "recurrent_group: unsupported v1 arguments %s"
+            % sorted(kwargs))
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    slots = [_StepSlot("static" if isinstance(i, StaticInput) else "seq",
+                       i.input if isinstance(i, StaticInput) else i)
+             for i in inputs]
+    outs = step(*slots)
+    out_layers = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+    # discover memory leaves + every node reachable from the outputs
+    memories, seen, order = [], set(), []
+
+    def scan(l):
+        if id(l) in seen:
+            return
+        seen.add(id(l))
+        for p in l.parents():
+            scan(p)
+        if isinstance(l, _Memory):
+            memories.append(l)
+        order.append(l)
+
+    for o in out_layers:
+        scan(o)
+
+    # resolve memory links NOW, against the step DAG itself — the global
+    # name registry is mutable and a later layer may reuse the name
+    by_name = {}
+    for l in order:
+        by_name.setdefault(l.name, l)
+    links = {}
+    for m in memories:
+        link = by_name.get(m.link_name)
+        if link is None:
+            raise ValueError(
+                "memory(name=%r) does not link to any layer produced "
+                "inside this step function" % m.link_name)
+        links[id(m)] = link
+
+    # nodes NOT downstream of a slot/memory are OUTER references the user
+    # pulled into the step (v1's implicit read-only link): build them in
+    # the enclosing block and close over their values, never re-emit
+    # their ops (a data layer re-emitted inside the scan is unfeedable)
+    internal = set()
+
+    def mark_internal(l):
+        if id(l) in internal:
+            return True
+        if isinstance(l, (_StepSlot, _Memory)):
+            internal.add(id(l))
+            return True
+        # evaluate EVERY parent (no any() short-circuit) so all internal
+        # nodes get marked, not just the first hit's subtree
+        hits = [mark_internal(p) for p in l.parents()]
+        if any(hits):
+            internal.add(id(l))
+            return True
+        return False
+
+    for o in out_layers:
+        mark_internal(o)
+    outer_refs, _outer_seen = [], set()
+    for c in order:
+        if id(c) not in internal:
+            continue
+        for p in c.parents():
+            if id(p) not in internal and id(p) not in _outer_seen:
+                _outer_seen.add(id(p))
+                outer_refs.append(p)
+
+    parents = [s.source for s in slots]
+    boot_parents = [m.boot_layer for m in memories
+                    if m.boot_layer is not None] + outer_refs
+
+    def build(ctx, *parent_vars):
+        seq_vars = [v for s, v in zip(slots, parent_vars)
+                    if s.kind == "seq"]
+        if reverse:
+            seq_vars = [F.sequence_reverse(v) for v in seq_vars]
+        if not seq_vars:
+            raise ValueError("recurrent_group needs >=1 sequence input")
+        # batch-sized zero inits derive from a per-sequence view of the
+        # first sequence input (parent block, before the step block
+        # opens); computed lazily — boot_layer-only groups skip it
+        head = None
+        inits = []
+        for m in memories:
+            if m.boot_layer is not None:
+                inits.append(ctx[id(m.boot_layer)])
+            else:
+                if head is None:
+                    head = F.sequence_first_step(seq_vars[0])
+                inits.append(F.fill_constant_batch_size_like(
+                    input=head, shape=[-1, m.size], dtype="float32",
+                    value=0.0))
+
+        drnn = F.DynamicRNN()
+        with drnn.block():
+            step_ctx = dict()
+            # outer references close over their parent-block values
+            for l in outer_refs:
+                step_ctx[id(l)] = ctx[id(l)]
+            si = iter(seq_vars)
+            for s, v in zip(slots, parent_vars):
+                if s.kind == "seq":
+                    step_ctx[id(s)] = drnn.step_input(next(si))
+                else:
+                    step_ctx[id(s)] = drnn.static_input(v)
+            mem_vars = {}
+            for m, init in zip(memories, inits):
+                mem_vars[id(m)] = drnn.memory(init=init)
+                step_ctx[id(m)] = mem_vars[id(m)]
+            out_vars = [o.build(step_ctx) for o in out_layers]
+            for m in memories:
+                drnn.update_memory(mem_vars[id(m)],
+                                   step_ctx[id(links[id(m)])])
+            for ov in out_vars:
+                drnn.output(ov)
+        result = drnn()
+        result_list = result if isinstance(result, list) else [result]
+        if reverse:
+            result_list = [F.sequence_reverse(v) for v in result_list]
+        return result_list[0] if len(result_list) == 1 else result_list
+
+    group = _remember(Layer(name=name, parents=parents,
+                            extra_parents=boot_parents, build_fn=build,
+                            build_with_ctx=True, layer_type="recurrent"))
+    if len(out_layers) == 1:
+        return group
+    return [_remember(Layer(parents=[group],
+                            build_fn=lambda lst, _i=i: lst[_i],
+                            layer_type="recurrent_out"))
+            for i in range(len(out_layers))]
 
 
 def parse_network(output_layers, extra_layers=None):

@@ -265,3 +265,109 @@ def test_v2_plot_and_data_feeder():
          ("lbl", paddle.data_type.integer_value(2))],
         feeding={"img": 0, "lbl": 1})
     assert feeder.feed_order == ["img", "lbl"]
+
+
+def test_v2_recurrent_group_trains_and_matches_memory_semantics():
+    """recurrent_group + memory + StaticInput: a custom RNN cell written
+    v1-style (reference trainer_config_helpers recurrent_group) trains
+    and threads state across timesteps."""
+    words = paddle.layer.data(
+        "w", paddle.data_type.integer_value_sequence(12))
+    ctx_in = paddle.layer.data("ctx", paddle.data_type.dense_vector(3))
+    label = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(words, size=4)
+
+    def step(wt, static_ctx):
+        mem = paddle.layer.memory(name="rg_h", size=6)
+        h = paddle.layer.fc([wt, mem, static_ctx], size=6,
+                            act=paddle.activation.Tanh(), name="rg_h")
+        return h
+
+    rnn_out = paddle.layer.recurrent_group(
+        step=step, input=[emb, paddle.layer.StaticInput(ctx_in)])
+    last = paddle.layer.last_seq(rnn_out)
+    out = paddle.layer.fc(last, size=2, act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=out, label=label)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    rng = np.random.RandomState(9)
+
+    def reader():
+        for _ in range(24):
+            y = rng.randint(0, 2)
+            n = rng.randint(2, 5)
+            seq = rng.randint(6 * y, 6 * y + 6, size=n).tolist()
+            yield seq, np.zeros(3, dtype=np.float32), y
+
+    costs = []
+    trainer.train(
+        paddle.batch(reader, 8), num_passes=6,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None)
+    assert all(np.isfinite(c) for c in costs)
+    assert np.mean(costs[-3:]) < 0.8 * np.mean(costs[:3])
+    # inference through the same group works and is deterministic
+    probs = paddle.infer(output_layer=out, parameters=params,
+                         input=[([1, 2, 3], np.zeros(3, np.float32)),
+                                ([7, 8], np.zeros(3, np.float32))])
+    assert probs.shape == (2, 2)
+
+
+def test_v2_recurrent_group_boot_layer_and_reverse():
+    seq = paddle.layer.data(
+        "s", paddle.data_type.dense_vector_sequence(4))
+    boot = paddle.layer.data("boot", paddle.data_type.dense_vector(4))
+
+    def step(xt):
+        mem = paddle.layer.memory(name="acc2", size=4,
+                                  boot_layer=boot)
+        s = paddle.layer.addto([xt, mem], name="acc2")
+        return s
+
+    out = paddle.layer.recurrent_group(step=step, input=seq, reverse=True)
+    first = paddle.layer.first_seq(out)
+    params = paddle.parameters.create(first)
+    # reverse accumulation: first position of output (reversed back) holds
+    # boot + sum of all timesteps
+    import paddle_tpu.v2.inference as v2inf
+    inf = v2inf.Inference(parameters=params, output_layer=first)
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    b = np.full(4, 0.5, dtype=np.float32)
+    res = inf.infer(input=[(x, b)])
+    np.testing.assert_allclose(
+        np.asarray(res)[0], x.sum(0) + 0.5, atol=1e-5)
+
+
+def test_v2_recurrent_group_outer_reference_is_static_link():
+    """A layer referenced inside the step without being declared as an
+    input acts as a read-only outer link (v1 semantics), not a rebuilt
+    sub-block node."""
+    seq = paddle.layer.data(
+        "s2", paddle.data_type.dense_vector_sequence(3))
+    outer = paddle.layer.data("outer_ctx",
+                              paddle.data_type.dense_vector(3))
+    outer_scaled = 2.0 * outer        # derived outer layer
+
+    def step(xt):
+        return paddle.layer.addto([xt, outer_scaled], name="rg_o")
+
+    out = paddle.layer.recurrent_group(step=step, input=seq)
+    first = paddle.layer.first_seq(out)
+    params = paddle.parameters.create(first)
+    import paddle_tpu.v2.inference as v2inf
+    inf = v2inf.Inference(parameters=params, output_layer=first)
+    x = np.ones((2, 3), dtype=np.float32)
+    c = np.full(3, 1.5, dtype=np.float32)
+    res = inf.infer(input=[(x, c)])
+    # first timestep: x[0] + 2*outer = 1 + 3 = 4
+    np.testing.assert_allclose(np.asarray(res)[0],
+                               np.full(3, 4.0), atol=1e-5)
+
+
+def test_v2_memory_rejects_unsupported_v1_args():
+    with pytest.raises(NotImplementedError):
+        paddle.layer.memory(name="m", size=4, is_seq=True)
+    with pytest.raises(NotImplementedError):
+        paddle.layer.memory(name="m", size=4, boot_with_const_id=3)

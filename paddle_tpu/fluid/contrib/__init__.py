@@ -4,7 +4,11 @@ from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)
 from .quantize_transpiler import QuantizeTranspiler
 from .memory_usage_calc import memory_usage
+from .hdfs_utils import HDFSClient, multi_upload, multi_download
+from .inferencer import Inferencer
+from .op_frequence import op_freq_statistic
 
 __all__ = ["Trainer", "CheckpointConfig", "BeginEpochEvent", "EndEpochEvent",
            "BeginStepEvent", "EndStepEvent", "QuantizeTranspiler",
-           "memory_usage"]
+           "memory_usage", "HDFSClient", "multi_upload", "multi_download",
+           "Inferencer", "op_freq_statistic"]

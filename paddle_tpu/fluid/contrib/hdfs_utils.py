@@ -1,0 +1,205 @@
+"""HDFS client utility (reference
+python/paddle/fluid/contrib/utils/hdfs_utils.py HDFSClient).
+
+The reference shells out to `hadoop fs` for upload/download/ls/mkdir of
+checkpoints and datasets. This environment has no Hadoop cluster (or
+network egress), so the same API is backed by either:
+
+- a real `hadoop` binary when `hadoop_home` points at one, or
+- a local-filesystem sandbox (`fs:///...` semantics) otherwise — the
+  path layout, return conventions, and multi-file helpers behave the
+  same, so training scripts that stage checkpoints through HDFSClient
+  run unmodified.
+"""
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["HDFSClient", "multi_upload", "multi_download"]
+
+
+class HDFSClient(object):
+    def __init__(self, hadoop_home=None, configs=None):
+        self.hadoop_home = hadoop_home
+        self.configs = dict(configs or {})
+        self._bin = None
+        if hadoop_home:
+            cand = os.path.join(hadoop_home, "bin", "hadoop")
+            if os.path.exists(cand):
+                self._bin = cand
+        # local sandbox root used when no hadoop binary exists
+        self.local_root = self.configs.get(
+            "fs.local.root", "/tmp/paddle_tpu_hdfs")
+
+    # -- path mapping ------------------------------------------------------
+    def _local(self, hdfs_path):
+        return os.path.join(self.local_root, hdfs_path.lstrip("/"))
+
+    def _run(self, args, retry_times=5):
+        import time
+        cmd = [self._bin, "fs"] + [
+            "-D%s=%s" % kv for kv in self.configs.items()
+            if kv[0] != "fs.local.root"] + args
+        for i in range(retry_times):
+            if i:
+                time.sleep(0.5 * i)   # backoff between transient retries
+            ret = subprocess.run(cmd, capture_output=True, text=True)
+            if ret.returncode == 0:
+                return True, ret.stdout
+        return False, ret.stderr
+
+    # -- API (reference hdfs_utils.py:68-:382) -----------------------------
+    def upload(self, hdfs_path, local_path, overwrite=False,
+               retry_times=5):
+        if self._bin:
+            args = ["-put"] + (["-f"] if overwrite else []) + \
+                [local_path, hdfs_path]
+            return self._run(args, retry_times)[0]
+        dst = self._local(hdfs_path)
+        if os.path.exists(dst) and not overwrite:
+            return False
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.isdir(local_path):
+            if os.path.exists(dst):
+                shutil.rmtree(dst)
+            shutil.copytree(local_path, dst)
+        else:
+            shutil.copy2(local_path, dst)
+        return True
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False):
+        if self._bin:
+            if os.path.exists(local_path):
+                if not overwrite:
+                    return False
+                if os.path.isdir(local_path):
+                    shutil.rmtree(local_path)
+                else:
+                    os.remove(local_path)
+            return self._run(["-get", hdfs_path, local_path])[0]
+        src = self._local(hdfs_path)
+        if not os.path.exists(src):
+            return False
+        if os.path.exists(local_path) and not overwrite:
+            return False
+        os.makedirs(os.path.dirname(os.path.abspath(local_path)),
+                    exist_ok=True)
+        if os.path.isdir(src):
+            if os.path.exists(local_path):
+                shutil.rmtree(local_path)
+            shutil.copytree(src, local_path)
+        else:
+            shutil.copy2(src, local_path)
+        return True
+
+    def is_exist(self, hdfs_path=None):
+        if self._bin:
+            return self._run(["-test", "-e", hdfs_path], 1)[0]
+        return os.path.exists(self._local(hdfs_path))
+
+    def is_dir(self, hdfs_path=None):
+        if self._bin:
+            return self._run(["-test", "-d", hdfs_path], 1)[0]
+        return os.path.isdir(self._local(hdfs_path))
+
+    def delete(self, hdfs_path):
+        if self._bin:
+            return self._run(["-rm", "-r", hdfs_path])[0]
+        p = self._local(hdfs_path)
+        if not os.path.exists(p):
+            return False
+        shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+        return True
+
+    def rename(self, hdfs_src_path, hdfs_dst_path, overwrite=False):
+        if self._bin:
+            if overwrite and self.is_exist(hdfs_dst_path):
+                self._run(["-rm", "-r", hdfs_dst_path], 1)
+            return self._run(["-mv", hdfs_src_path, hdfs_dst_path])[0]
+        src, dst = self._local(hdfs_src_path), self._local(hdfs_dst_path)
+        if not os.path.exists(src):
+            return False
+        if os.path.exists(dst):
+            if not overwrite:
+                return False
+            self.delete(hdfs_dst_path)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.rename(src, dst)
+        return True
+
+    def makedirs(self, hdfs_path):
+        if self._bin:
+            return self._run(["-mkdir", "-p", hdfs_path])[0]
+        os.makedirs(self._local(hdfs_path), exist_ok=True)
+        return True
+
+    @staticmethod
+    def make_local_dirs(local_path):
+        os.makedirs(local_path, exist_ok=True)
+
+    def ls(self, hdfs_path):
+        if self._bin:
+            ok, out = self._run(["-ls", hdfs_path])
+            if not ok:
+                return []
+            return [line.split()[-1] for line in out.splitlines()
+                    if line and not line.startswith("Found")]
+        p = self._local(hdfs_path)
+        if not os.path.isdir(p):
+            return []
+        return sorted(
+            os.path.join(hdfs_path, n) for n in os.listdir(p))
+
+    def lsr(self, hdfs_path, only_file=True, sort=True):
+        if self._bin:
+            ok, out_text = self._run(["-ls", "-R", hdfs_path])
+            if not ok:
+                return []
+            out = []
+            for line in out_text.splitlines():
+                parts = line.split()
+                if len(parts) < 8:
+                    continue
+                if only_file and parts[0].startswith("d"):
+                    continue
+                out.append(parts[-1])
+            return sorted(out) if sort else out
+        p = self._local(hdfs_path)
+        out = []
+        for root, dirs, files in os.walk(p):
+            rel = os.path.relpath(root, self.local_root)
+            names = files if only_file else files + dirs
+            for n in names:
+                out.append("/" + os.path.join(rel, n))
+        return sorted(out) if sort else out
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False, sync=True):
+    """Upload a local tree (reference hdfs_utils.py multi_upload; the
+    process fan-out is an I/O optimization — semantics preserved)."""
+    for root, _, files in os.walk(local_path):
+        rel = os.path.relpath(root, local_path)
+        for n in files:
+            dst = os.path.join(hdfs_path, "" if rel == "." else rel, n)
+            client.makedirs(os.path.dirname(dst))
+            client.upload(dst, os.path.join(root, n), overwrite=overwrite)
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id=0,
+                   trainers=1, multi_processes=5):
+    """Download this trainer's shard of an HDFS tree (reference
+    hdfs_utils.py multi_download: files round-robin by trainer id)."""
+    files = client.lsr(hdfs_path)
+    mine = [f for i, f in enumerate(files)
+            if i % max(trainers, 1) == trainer_id]
+    got = []
+    for f in mine:
+        rel = os.path.relpath(f, hdfs_path)
+        dst = os.path.join(local_path, rel)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if client.download(f, dst, overwrite=True):
+            got.append(dst)
+    return got

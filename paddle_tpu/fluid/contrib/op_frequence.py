@@ -1,0 +1,25 @@
+"""Op-frequency statistics (reference contrib/op_frequence.py:23
+op_freq_statistic): count op types (and adjacent op-pair patterns) over a
+Program — the quick profile used to pick fusion-pass targets."""
+
+from collections import Counter, OrderedDict
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_2_op_freq): per-op-type counts and
+    adjacent-pair counts across every block, most-common first."""
+    if program is None:
+        raise ValueError("program is None")
+    uni, adj = Counter(), Counter()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] += 1
+            if prev is not None:
+                adj["%s->%s" % (prev, op.type)] += 1
+            prev = op.type
+    uni_sorted = OrderedDict(uni.most_common())
+    adj_sorted = OrderedDict(adj.most_common())
+    return uni_sorted, adj_sorted

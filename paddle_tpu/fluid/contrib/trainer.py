@@ -71,7 +71,9 @@ class Trainer:
         self.train_program = Program()
         self.startup_program = Program()
         from ..framework import program_guard
-        with program_guard(self.train_program, self.startup_program):
+        from .. import unique_name
+        with program_guard(self.train_program, self.startup_program), \
+                unique_name.guard():
             ret = train_func()
             if isinstance(ret, (list, tuple)):
                 self.train_outputs = list(ret)

@@ -11,10 +11,11 @@ from .decorator import (
     map_readers, buffered, compose, chain, shuffle, firstn, xmap_readers,
     cache, ComposeNotAligned,
 )
+from . import creator
 
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
-    "xmap_readers", "cache", "batch", "ComposeNotAligned",
+    "xmap_readers", "cache", "batch", "ComposeNotAligned", "creator",
 ]
 
 

@@ -614,10 +614,10 @@ def memory(name, size, boot_layer=None, is_seq=False, **kwargs):
         raise NotImplementedError(
             "sequence-level memory (is_seq=True) is not supported — the "
             "padded-dense scan carries fixed-rank state")
-    unsupported = {k: v for k, v in kwargs.items() if v not in (None, False)}
+    unsupported = sorted(k for k, v in kwargs.items() if v is not None)
     if unsupported:
         raise NotImplementedError(
-            "memory(): unsupported v1 arguments %s" % sorted(unsupported))
+            "memory(): unsupported v1 arguments %s" % unsupported)
     return _Memory(name, size, boot_layer=boot_layer)
 
 
@@ -687,20 +687,25 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
     # the enclosing block and close over their values, never re-emit
     # their ops (a data layer re-emitted inside the scan is unfeedable)
     internal = set()
+    _mark_memo = {}
 
     def mark_internal(l):
-        if id(l) in internal:
-            return True
+        if id(l) in _mark_memo:
+            return _mark_memo[id(l)]
         if isinstance(l, (_StepSlot, _Memory)):
             internal.add(id(l))
+            _mark_memo[id(l)] = True
             return True
         # evaluate EVERY parent (no any() short-circuit) so all internal
-        # nodes get marked, not just the first hit's subtree
+        # nodes get marked; memoize both verdicts or diamond-shaped
+        # outer DAGs re-traverse exponentially
+        _mark_memo[id(l)] = False   # cycle guard; overwritten below
         hits = [mark_internal(p) for p in l.parents()]
-        if any(hits):
+        verdict = any(hits)
+        if verdict:
             internal.add(id(l))
-            return True
-        return False
+        _mark_memo[id(l)] = verdict
+        return verdict
 
     for o in out_layers:
         mark_internal(o)

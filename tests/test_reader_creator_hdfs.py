@@ -1,0 +1,129 @@
+"""reader.creator (reference python/paddle/reader/creator.py) and the
+contrib HDFSClient (reference contrib/utils/hdfs_utils.py, local-backend
+mode)."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu.reader as reader_pkg
+from paddle_tpu.fluid.contrib import HDFSClient, multi_upload, \
+    multi_download
+
+
+def test_np_array_and_text_file_creators(tmp_path):
+    arr = np.arange(12).reshape(4, 3)
+    rows = list(reader_pkg.creator.np_array(arr)())
+    assert len(rows) == 4
+    np.testing.assert_array_equal(rows[2], arr[2])
+
+    p = tmp_path / "lines.txt"
+    p.write_text("alpha\nbeta\ngamma\n")
+    lines = list(reader_pkg.creator.text_file(str(p))())
+    assert lines == ["alpha", "beta", "gamma"]
+
+
+def test_recordio_creator_roundtrip(tmp_path):
+    from paddle_tpu.native import RecordIOWriter
+    path = str(tmp_path / "data.recordio")
+    with RecordIOWriter(path) as w:
+        for i in range(5):
+            w.write(b"rec-%d" % i)
+    recs = list(reader_pkg.creator.recordio(path)())
+    assert recs == [b"rec-%d" % i for i in range(5)]
+
+
+def test_hdfs_client_local_backend(tmp_path):
+    client = HDFSClient(configs={"fs.local.root": str(tmp_path / "hdfs")})
+    src = tmp_path / "model.bin"
+    src.write_bytes(b"weights")
+
+    assert client.upload("/ckpt/model.bin", str(src))
+    assert client.is_exist("/ckpt/model.bin")
+    assert client.is_dir("/ckpt")
+    assert not client.upload("/ckpt/model.bin", str(src))  # no overwrite
+    assert client.upload("/ckpt/model.bin", str(src), overwrite=True)
+
+    dst = tmp_path / "restored.bin"
+    assert client.download("/ckpt/model.bin", str(dst))
+    assert dst.read_bytes() == b"weights"
+
+    assert client.ls("/ckpt") == ["/ckpt/model.bin"]
+    assert client.rename("/ckpt/model.bin", "/ckpt/model2.bin")
+    assert not client.is_exist("/ckpt/model.bin")
+    assert client.delete("/ckpt/model2.bin")
+    assert not client.is_exist("/ckpt/model2.bin")
+
+
+def test_hdfs_multi_upload_download_shards(tmp_path):
+    client = HDFSClient(configs={"fs.local.root": str(tmp_path / "hdfs")})
+    local = tmp_path / "out"
+    (local / "sub").mkdir(parents=True)
+    for i in range(4):
+        (local / "sub" / ("f%d" % i)).write_text(str(i))
+    multi_upload(client, "/data", str(local))
+    files = client.lsr("/data")
+    assert len(files) == 4
+
+    got0 = multi_download(client, "/data", str(tmp_path / "t0"),
+                          trainer_id=0, trainers=2)
+    got1 = multi_download(client, "/data", str(tmp_path / "t1"),
+                          trainer_id=1, trainers=2)
+    assert len(got0) == 2 and len(got1) == 2
+    assert {os.path.basename(p) for p in got0} | \
+        {os.path.basename(p) for p in got1} == {"f0", "f1", "f2", "f3"}
+
+
+def test_contrib_inferencer_roundtrip(tmp_path):
+    """contrib.Inferencer loads params saved by a training run and serves
+    the same predictions (reference contrib/inferencer.py)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.contrib import Inferencer
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup), fluid.unique_name.guard():
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1, name="infer_fc")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    scope = fluid.executor.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(10):
+            xv = rng.randn(8, 4).astype(np.float32)
+            yv = (xv.sum(1, keepdims=True) > 0).astype(np.float32)
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        fluid.io.save_params(exe, str(tmp_path / "params"),
+                             main_program=main)
+        expected = np.asarray(exe.run(
+            main.clone(for_test=True),
+            feed={"x": np.ones((2, 4), np.float32),
+                  "y": np.zeros((2, 1), np.float32)},
+            fetch_list=[pred])[0])
+
+    def infer_func():
+        xi = fluid.layers.data("x", shape=[4])
+        return fluid.layers.fc(xi, size=1, name="infer_fc")
+
+    inf = Inferencer(infer_func, str(tmp_path / "params"),
+                     place=fluid.CPUPlace())
+    got = inf.infer({"x": np.ones((2, 4), np.float32)})[0]
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_contrib_op_freq_statistic():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.contrib import op_freq_statistic
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.fc(x, size=4, act="relu")
+        h = fluid.layers.fc(h, size=4, act="relu")
+        fluid.layers.mean(h)
+    uni, adj = op_freq_statistic(main)
+    assert uni["relu"] == 2
+    assert any(k.endswith("->relu") for k in adj)

@@ -41,7 +41,8 @@ class HDFSClient(object):
         cmd = [self._bin, "fs"] + [
             "-D%s=%s" % kv for kv in self.configs.items()
             if kv[0] != "fs.local.root"] + args
-        for i in range(retry_times):
+        ret = None
+        for i in range(max(1, retry_times)):
             if i:
                 time.sleep(0.5 * i)   # backoff between transient retries
             ret = subprocess.run(cmd, capture_output=True, text=True)
@@ -71,14 +72,21 @@ class HDFSClient(object):
     def download(self, hdfs_path, local_path, overwrite=False,
                  unzip=False):
         if self._bin:
+            if os.path.exists(local_path) and not overwrite:
+                return False
+            # fetch beside the target and swap only on success — the
+            # existing local copy must survive a failed transfer
+            tmp = local_path + ".hdfs_dl_tmp"
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp) if os.path.isdir(tmp) \
+                    else os.remove(tmp)
+            if not self._run(["-get", hdfs_path, tmp])[0]:
+                return False
             if os.path.exists(local_path):
-                if not overwrite:
-                    return False
-                if os.path.isdir(local_path):
-                    shutil.rmtree(local_path)
-                else:
-                    os.remove(local_path)
-            return self._run(["-get", hdfs_path, local_path])[0]
+                shutil.rmtree(local_path) if os.path.isdir(local_path) \
+                    else os.remove(local_path)
+            os.rename(tmp, local_path)
+            return True
         src = self._local(hdfs_path)
         if not os.path.exists(src):
             return False
@@ -106,7 +114,8 @@ class HDFSClient(object):
 
     def delete(self, hdfs_path):
         if self._bin:
-            return self._run(["-rm", "-r", hdfs_path])[0]
+            # deterministic outcome — no point re-running 5 times
+            return self._run(["-rm", "-r", hdfs_path], 1)[0]
         p = self._local(hdfs_path)
         if not os.path.exists(p):
             return False
@@ -117,7 +126,7 @@ class HDFSClient(object):
         if self._bin:
             if overwrite and self.is_exist(hdfs_dst_path):
                 self._run(["-rm", "-r", hdfs_dst_path], 1)
-            return self._run(["-mv", hdfs_src_path, hdfs_dst_path])[0]
+            return self._run(["-mv", hdfs_src_path, hdfs_dst_path], 1)[0]
         src, dst = self._local(hdfs_src_path), self._local(hdfs_dst_path)
         if not os.path.exists(src):
             return False
@@ -131,7 +140,7 @@ class HDFSClient(object):
 
     def makedirs(self, hdfs_path):
         if self._bin:
-            return self._run(["-mkdir", "-p", hdfs_path])[0]
+            return self._run(["-mkdir", "-p", hdfs_path], 1)[0]
         os.makedirs(self._local(hdfs_path), exist_ok=True)
         return True
 
@@ -141,7 +150,7 @@ class HDFSClient(object):
 
     def ls(self, hdfs_path):
         if self._bin:
-            ok, out = self._run(["-ls", hdfs_path])
+            ok, out = self._run(["-ls", hdfs_path], 1)
             if not ok:
                 return []
             return [line.split()[-1] for line in out.splitlines()
@@ -154,7 +163,7 @@ class HDFSClient(object):
 
     def lsr(self, hdfs_path, only_file=True, sort=True):
         if self._bin:
-            ok, out_text = self._run(["-ls", "-R", hdfs_path])
+            ok, out_text = self._run(["-ls", "-R", hdfs_path], 1)
             if not ok:
                 return []
             out = []
@@ -179,13 +188,19 @@ class HDFSClient(object):
 def multi_upload(client, hdfs_path, local_path, multi_processes=5,
                  overwrite=False, sync=True):
     """Upload a local tree (reference hdfs_utils.py multi_upload; the
-    process fan-out is an I/O optimization — semantics preserved)."""
+    process fan-out is an I/O optimization — semantics preserved).
+    Returns the list of destinations that FAILED to upload (empty on
+    full success) so partial staging is visible to the caller."""
+    failed = []
     for root, _, files in os.walk(local_path):
         rel = os.path.relpath(root, local_path)
         for n in files:
             dst = os.path.join(hdfs_path, "" if rel == "." else rel, n)
             client.makedirs(os.path.dirname(dst))
-            client.upload(dst, os.path.join(root, n), overwrite=overwrite)
+            if not client.upload(dst, os.path.join(root, n),
+                                 overwrite=overwrite):
+                failed.append(dst)
+    return failed
 
 
 def multi_download(client, hdfs_path, local_path, trainer_id=0,

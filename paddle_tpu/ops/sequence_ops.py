@@ -871,3 +871,112 @@ _R["lstmp"].custom_infer_shape = _infer_lstmp
 _R["fusion_lstm"].custom_infer_shape = _infer_fusion_lstm
 _R["fusion_gru"].custom_infer_shape = _infer_fusion_gru
 _R["attention_lstm"].custom_infer_shape = _infer_attention_lstm
+
+
+# ---------------------------------------------------------------------------
+# remaining fused/ family (reference operators/fused/): composite lowerings —
+# one traced function each, fully fusable by XLA
+# ---------------------------------------------------------------------------
+
+@register_op("fusion_seqconv_eltadd_relu")
+def _fusion_seqconv_eltadd_relu(ctx):
+    """fused/fusion_seqconv_eltadd_relu_op.cc: sequence_conv + bias add +
+    relu in one op."""
+    jnp = _jnp()
+    x = ctx.input("X")              # [B, T, D]
+    w = ctx.input("Filter")         # [ctx_len*D, M]
+    bias = ctx.input("Bias")        # [1, M]
+    lens = ctx.lod_len("X")
+    ctx_len = ctx.attr("contextLength", 3)
+    ctx_start = ctx.attr("contextStart", -1)
+    B, T, D = x.shape
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    m = _mask(lens, T, x.dtype)
+    xm = x * m[..., None]
+    t = jnp.arange(T)
+    shifted = []
+    for k in range(ctx_len):
+        src = t + ctx_start + k
+        valid = (src >= 0) & (src < T)
+        g = jnp.take(xm, jnp.clip(src, 0, T - 1), axis=1)
+        shifted.append(jnp.where(valid[None, :, None], g, 0))
+    col = jnp.concatenate(shifted, axis=-1)       # [B, T, ctx_len*D]
+    out = jnp.einsum("btd,dm->btm", col, w) + bias.reshape(1, 1, -1)
+    out = jnp.maximum(out, 0) * m[..., None]
+    return {"Out": out, "ColMat": col, "Out@LOD_LEN": lens}
+
+
+@register_op("fusion_seqexpand_concat_fc")
+def _fusion_seqexpand_concat_fc(ctx):
+    """fused/fusion_seqexpand_concat_fc_op.cc: X[0] is the ragged sequence;
+    X[1:] are one-row-per-sequence tensors broadcast (seq_expand) across
+    timesteps; concat features -> fc -> activation."""
+    import jax
+    jnp = _jnp()
+    xs = ctx.inputs("X")
+    w = ctx.input("FCWeight")       # [sum(D_i), M]
+    bias = ctx.input("FCBias")      # [1, M] or None
+    lens = ctx.lod_lens("X")[0]
+    seq = xs[0]                     # [B, T, D0]
+    B, T = seq.shape[0], seq.shape[1]
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    feats = [seq]
+    for extra in xs[1:]:            # [B, D_i] -> [B, T, D_i]
+        feats.append(jnp.broadcast_to(
+            extra[:, None, :], (B, T, extra.shape[-1])))
+    cat = jnp.concatenate(feats, axis=-1)
+    out = jnp.einsum("btd,dm->btm", cat, w)
+    if bias is not None:
+        out = out + bias.reshape(1, 1, -1)
+    act = ctx.attr("fc_activation", "identity")
+    if act == "relu":
+        out = jnp.maximum(out, 0)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    elif act == "sigmoid":
+        out = jax.nn.sigmoid(out)
+    m = _mask(lens, T, out.dtype)
+    out = out * m[..., None]
+    return {"Out": out, "FCOut": out, "Out@LOD_LEN": lens}
+
+
+@register_op("fused_embedding_fc_lstm")
+def _fused_embedding_fc_lstm(ctx):
+    """fused/fused_embedding_fc_lstm_op.cc: the fc of fusion_lstm is
+    pre-folded into the embedding table (Embeddings[V, 4D] = emb @ Wx,
+    + fc bias folded by the pass), so the x-projection is one gather."""
+    jnp = _jnp()
+    ids = ctx.input("Ids")          # [B, T, 1] int
+    emb = ctx.input("Embeddings")   # [V, 4D]
+    wh = ctx.input("WeightH")       # [D, 4D]
+    bias = ctx.input("Bias")        # [1, 4D] (+3D peephole)
+    lens = ctx.lod_len("Ids")
+    idx = ids.reshape(ids.shape[0], ids.shape[1]).astype("int32")
+    B, T = idx.shape
+    D = wh.shape[0]
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    xx = jnp.take(emb, jnp.clip(idx, 0, emb.shape[0] - 1), axis=0)
+    h0, c0 = ctx.input("H0"), ctx.input("C0")
+    if h0 is None:
+        h0 = jnp.zeros((B, D), xx.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, D), xx.dtype)
+    use_peepholes = ctx.attr("use_peepholes", False) and \
+        bias.shape[-1] == 7 * D
+    hidden, cell = _lstm_scan(xx, lens, wh, bias, h0, c0, use_peepholes,
+                              ctx.attr("is_reverse", False))
+    return {"Hidden": hidden, "Cell": cell, "XX": xx,
+            "Hidden@LOD_LEN": lens, "Cell@LOD_LEN": lens}
+
+
+def _infer_fused_emb_fc_lstm(op, block):
+    wh = _in_shape(block, op, "WeightH")
+    if wh:
+        _set_out(block, op, "Hidden", (-1, wh[0]))
+        _set_out(block, op, "Cell", (-1, wh[0]))
+
+
+_R["fused_embedding_fc_lstm"].custom_infer_shape = _infer_fused_emb_fc_lstm

@@ -614,6 +614,17 @@ def memory(name, size, boot_layer=None, is_seq=False, **kwargs):
         raise NotImplementedError(
             "sequence-level memory (is_seq=True) is not supported — the "
             "padded-dense scan carries fixed-rank state")
+    # boot_bias=False/None means "no boot bias" — exactly the zero-boot
+    # we implement, so accept it. Everything else changes semantics when
+    # present at all (boot_with_const_id=0 is a real word id), so only
+    # None counts as "not passed".
+    if kwargs.pop("boot_bias", None) not in (None, False):
+        raise NotImplementedError("memory(): boot_bias is not supported")
+    if "boot_with_const_id" in kwargs \
+            and kwargs["boot_with_const_id"] is not None:
+        raise NotImplementedError(
+            "memory(): boot_with_const_id is not supported")
+    kwargs.pop("boot_with_const_id", None)
     unsupported = sorted(k for k, v in kwargs.items() if v is not None)
     if unsupported:
         raise NotImplementedError(
@@ -686,29 +697,25 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
     # pulled into the step (v1's implicit read-only link): build them in
     # the enclosing block and close over their values, never re-emit
     # their ops (a data layer re-emitted inside the scan is unfeedable)
-    internal = set()
     _mark_memo = {}
 
     def mark_internal(l):
         if id(l) in _mark_memo:
             return _mark_memo[id(l)]
         if isinstance(l, (_StepSlot, _Memory)):
-            internal.add(id(l))
             _mark_memo[id(l)] = True
             return True
         # evaluate EVERY parent (no any() short-circuit) so all internal
         # nodes get marked; memoize both verdicts or diamond-shaped
         # outer DAGs re-traverse exponentially
         _mark_memo[id(l)] = False   # cycle guard; overwritten below
-        hits = [mark_internal(p) for p in l.parents()]
-        verdict = any(hits)
-        if verdict:
-            internal.add(id(l))
+        verdict = any([mark_internal(p) for p in l.parents()])
         _mark_memo[id(l)] = verdict
         return verdict
 
     for o in out_layers:
         mark_internal(o)
+    internal = {k for k, v in _mark_memo.items() if v}
     outer_refs, _outer_seen = [], set()
     for c in order:
         if id(c) not in internal:

@@ -10,6 +10,7 @@ import pytest
 import paddle_tpu.fluid as fluid
 import paddle_tpu.ops as ops
 from paddle_tpu.ops.registry import ExecContext
+from paddle_tpu.fluid.lod import create_lod_tensor
 
 
 class _FakeOp:
@@ -420,3 +421,144 @@ def test_lstmp_is_reverse():
                  {"use_peepholes": False}, lod={"Input": lens})
     assert not np.allclose(np.asarray(rev["Projection"]),
                            np.asarray(fwd["Projection"]))
+
+
+# ---------------------------------------------------------------------------
+# remaining fused/ family (reference operators/fused/)
+# ---------------------------------------------------------------------------
+
+def test_fusion_seqconv_eltadd_relu_matches_unfused():
+    """fused seqconv+bias+relu == sequence_conv -> +bias -> relu chain."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32", lod_level=1)
+        ref = fluid.layers.sequence_conv(
+            x, num_filters=5, filter_size=3,
+            param_attr=fluid.ParamAttr(name="scw"))
+        w = main.global_block().var("scw")
+        helper_out = main.global_block().create_var(
+            name="fused_out", dtype="float32", lod_level=1)
+        col = main.global_block().create_var(
+            name="fused_col", dtype="float32")
+        bvar = fluid.layers.fill_constant([1, 5], "float32", 0.25)
+        main.global_block().append_op(
+            type="fusion_seqconv_eltadd_relu",
+            inputs={"X": [x.name], "Filter": [w.name],
+                    "Bias": [bvar.name]},
+            outputs={"Out": [helper_out.name], "ColMat": [col.name]},
+            attrs={"contextLength": 3, "contextStart": -1},
+            infer_shape=False)
+        ref_act = fluid.layers.relu(
+            fluid.layers.elementwise_add(ref, bvar))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    data = rng.randn(7, 4).astype("float32")
+    lod = create_lod_tensor(data, [[3, 4]])
+    fused, ref_v = exe.run(main, feed={"x": lod},
+                           fetch_list=["fused_out", ref_act])
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref_v),
+                               atol=1e-5)
+
+
+def test_fusion_seqexpand_concat_fc():
+    """seq + per-sequence row broadcast + concat + fc + relu, vs numpy."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32", lod_level=1)
+        s = fluid.layers.data("s", shape=[2], dtype="float32")
+        wv = fluid.layers.fill_constant([5, 4], "float32", 0.1)
+        bv = fluid.layers.fill_constant([1, 4], "float32", 0.5)
+        out = main.global_block().create_var(
+            name="secf_out", dtype="float32", lod_level=1)
+        fco = main.global_block().create_var(
+            name="secf_fco", dtype="float32")
+        main.global_block().append_op(
+            type="fusion_seqexpand_concat_fc",
+            inputs={"X": [x.name, s.name], "FCWeight": [wv.name],
+                    "FCBias": [bv.name]},
+            outputs={"Out": [out.name], "FCOut": [fco.name]},
+            attrs={"fc_activation": "relu"}, infer_shape=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    data = rng.randn(5, 3).astype("float32")
+    srows = rng.randn(2, 2).astype("float32")
+    lod = create_lod_tensor(data, [[2, 3]])
+    (res,) = exe.run(main, feed={"x": lod, "s": srows},
+                     fetch_list=["secf_out"])
+    res = np.asarray(res)
+    # manual: rows of seq i concat srows[i], @ 0.1 + 0.5, relu
+    flat = []
+    for i, (a, b) in enumerate([(0, 2), (2, 5)]):
+        for r in range(a, b):
+            cat = np.concatenate([data[r], srows[i]])
+            flat.append(np.maximum(cat @ np.full((5, 4), 0.1) + 0.5, 0))
+    np.testing.assert_allclose(res.reshape(-1, 4)[:len(flat)],
+                               np.array(flat), atol=1e-5)
+
+
+def test_fused_embedding_fc_lstm_matches_fusion_lstm():
+    """gathering a pre-folded embedding == embedding + fc + fusion_lstm."""
+    V, H = 9, 4
+    rng = np.random.RandomState(2)
+    emb4h = rng.randn(V, 4 * H).astype("float32") * 0.1
+    wh = rng.randn(H, 4 * H).astype("float32") * 0.1
+    bias = rng.randn(1, 4 * H).astype("float32") * 0.1
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64",
+                                lod_level=1)
+        embv = fluid.layers.assign(emb4h)
+        whv = fluid.layers.assign(wh)
+        bv = fluid.layers.assign(bias)
+        hid = main.global_block().create_var(
+            name="fel_hid", dtype="float32", lod_level=1)
+        cell = main.global_block().create_var(
+            name="fel_cell", dtype="float32", lod_level=1)
+        xx = main.global_block().create_var(
+            name="fel_xx", dtype="float32")
+        main.global_block().append_op(
+            type="fused_embedding_fc_lstm",
+            inputs={"Ids": [ids.name], "Embeddings": [embv.name],
+                    "WeightH": [whv.name], "Bias": [bv.name]},
+            outputs={"Hidden": [hid.name], "Cell": [cell.name],
+                     "XX": [xx.name]},
+            attrs={"use_peepholes": False}, infer_shape=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    seqs = [[1, 3, 5], [2, 8]]
+    flat = np.array([i for s in seqs for i in s], np.int64).reshape(-1, 1)
+    lod = create_lod_tensor(flat, [[3, 2]])
+    (hv,) = exe.run(main, feed={"ids": lod}, fetch_list=["fel_hid"])
+    hv = np.asarray(hv)
+
+    # reference chain: one-hot @ emb4h == gather; lstm via fusion_lstm
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x = fluid.layers.data("x", shape=[4 * H], dtype="float32",
+                              lod_level=1)
+        whv = fluid.layers.assign(wh)
+        bv = fluid.layers.assign(bias)
+        hid2 = main2.global_block().create_var(
+            name="fl_hid", dtype="float32", lod_level=1)
+        cell2 = main2.global_block().create_var(
+            name="fl_cell", dtype="float32", lod_level=1)
+        xx2 = main2.global_block().create_var(
+            name="fl_xx", dtype="float32")
+        main2.global_block().append_op(
+            type="fusion_lstm",
+            inputs={"X": [x.name],
+                    "WeightX": [fluid.layers.assign(
+                        np.eye(4 * H, dtype=np.float32)).name],
+                    "WeightH": [whv.name], "Bias": [bv.name]},
+            outputs={"Hidden": [hid2.name], "Cell": [cell2.name],
+                     "XX": [xx2.name]},
+            attrs={"use_peepholes": False}, infer_shape=False)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(startup2)
+    xflat = emb4h[flat.reshape(-1)]
+    lod2 = create_lod_tensor(xflat, [[3, 2]])
+    (hv2,) = exe2.run(main2, feed={"x": lod2}, fetch_list=["fl_hid"])
+    np.testing.assert_allclose(hv, np.asarray(hv2), atol=1e-5)

@@ -7,8 +7,12 @@ from .memory_usage_calc import memory_usage
 from .hdfs_utils import HDFSClient, multi_upload, multi_download
 from .inferencer import Inferencer
 from .op_frequence import op_freq_statistic
+from . import decoder
+from .decoder import (InitState, StateCell, TrainingDecoder,
+                      BeamSearchDecoder)
 
 __all__ = ["Trainer", "CheckpointConfig", "BeginEpochEvent", "EndEpochEvent",
            "BeginStepEvent", "EndStepEvent", "QuantizeTranspiler",
            "memory_usage", "HDFSClient", "multi_upload", "multi_download",
-           "Inferencer", "op_freq_statistic"]
+           "Inferencer", "op_freq_statistic", "decoder", "InitState",
+           "StateCell", "TrainingDecoder", "BeamSearchDecoder"]

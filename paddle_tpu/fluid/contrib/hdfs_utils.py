@@ -81,6 +81,9 @@ class HDFSClient(object):
                 shutil.rmtree(tmp) if os.path.isdir(tmp) \
                     else os.remove(tmp)
             if not self._run(["-get", hdfs_path, tmp])[0]:
+                if os.path.exists(tmp):   # drop the partial transfer
+                    shutil.rmtree(tmp) if os.path.isdir(tmp) \
+                        else os.remove(tmp)
                 return False
             if os.path.exists(local_path):
                 shutil.rmtree(local_path) if os.path.isdir(local_path) \
@@ -140,7 +143,8 @@ class HDFSClient(object):
 
     def makedirs(self, hdfs_path):
         if self._bin:
-            return self._run(["-mkdir", "-p", hdfs_path], 1)[0]
+            # '-mkdir -p' is idempotent: retrying transient failures is safe
+            return self._run(["-mkdir", "-p", hdfs_path])[0]
         os.makedirs(self._local(hdfs_path), exist_ok=True)
         return True
 
@@ -150,7 +154,7 @@ class HDFSClient(object):
 
     def ls(self, hdfs_path):
         if self._bin:
-            ok, out = self._run(["-ls", hdfs_path], 1)
+            ok, out = self._run(["-ls", hdfs_path], 3)
             if not ok:
                 return []
             return [line.split()[-1] for line in out.splitlines()
@@ -163,7 +167,7 @@ class HDFSClient(object):
 
     def lsr(self, hdfs_path, only_file=True, sort=True):
         if self._bin:
-            ok, out_text = self._run(["-ls", "-R", hdfs_path], 1)
+            ok, out_text = self._run(["-ls", "-R", hdfs_path], 3)
             if not ok:
                 return []
             out = []

@@ -353,6 +353,24 @@ def _sequence_reshape(ctx):
     return r
 
 
+def _seq_context_matrix(x, lens, ctx_len, ctx_start):
+    """Sliding context-window stack shared by sequence_conv and the fused
+    seqconv op: masked [B, T, ctx_len*D] concat of shifted rows, plus the
+    validity mask [B, T]."""
+    jnp = _jnp()
+    B, T, D = x.shape
+    m = _mask(lens, T, x.dtype)
+    xm = x * m[..., None]
+    shifted = []
+    t = jnp.arange(T)
+    for k in range(ctx_len):
+        src = t + ctx_start + k
+        valid = (src >= 0) & (src < T)
+        g = jnp.take(xm, jnp.clip(src, 0, T - 1), axis=1)
+        shifted.append(jnp.where(valid[None, :, None], g, 0))
+    return jnp.concatenate(shifted, axis=-1), m
+
+
 @register_op("sequence_conv")
 def _sequence_conv(ctx):
     """Context-window projection (sequence_conv_op.cc): for each timestep,
@@ -367,16 +385,7 @@ def _sequence_conv(ctx):
     B, T, D = x.shape
     if lens is None:
         lens = jnp.full((B,), T, jnp.int32)
-    m = _mask(lens, T, x.dtype)
-    xm = x * m[..., None]
-    shifted = []
-    t = jnp.arange(T)
-    for k in range(ctx_len):
-        src = t + ctx_start + k
-        valid = (src >= 0) & (src < T)
-        g = jnp.take(xm, jnp.clip(src, 0, T - 1), axis=1)
-        shifted.append(jnp.where(valid[None, :, None], g, 0))
-    stacked = jnp.concatenate(shifted, axis=-1)   # [B, T, ctx_len*D]
+    stacked, m = _seq_context_matrix(x, lens, ctx_len, ctx_start)
     out = jnp.einsum("btd,dm->btm", stacked, w)
     return {"Out": out * m[..., None], "Out@LOD_LEN": lens}
 
@@ -892,16 +901,7 @@ def _fusion_seqconv_eltadd_relu(ctx):
     B, T, D = x.shape
     if lens is None:
         lens = jnp.full((B,), T, jnp.int32)
-    m = _mask(lens, T, x.dtype)
-    xm = x * m[..., None]
-    t = jnp.arange(T)
-    shifted = []
-    for k in range(ctx_len):
-        src = t + ctx_start + k
-        valid = (src >= 0) & (src < T)
-        g = jnp.take(xm, jnp.clip(src, 0, T - 1), axis=1)
-        shifted.append(jnp.where(valid[None, :, None], g, 0))
-    col = jnp.concatenate(shifted, axis=-1)       # [B, T, ctx_len*D]
+    col, m = _seq_context_matrix(x, lens, ctx_len, ctx_start)
     out = jnp.einsum("btd,dm->btm", col, w) + bias.reshape(1, 1, -1)
     out = jnp.maximum(out, 0) * m[..., None]
     return {"Out": out, "ColMat": col, "Out@LOD_LEN": lens}

@@ -1,0 +1,175 @@
+"""contrib.decoder: StateCell / TrainingDecoder / BeamSearchDecoder
+(reference contrib/decoder/beam_search_decoder.py + the book
+machine_translation-with-decoder-API demo, condensed)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.contrib import (InitState, StateCell,
+                                      TrainingDecoder, BeamSearchDecoder)
+from paddle_tpu.fluid.lod import create_lod_tensor
+
+V = 12          # vocab (0 = start, 1 = end)
+EMB = 6
+H = 8
+END_ID = 1
+
+
+def _build_cell(encoder_last):
+    init_state = InitState(init=encoder_last)
+    cell = StateCell(inputs={"x": None},
+                     states={"h": init_state}, out_state="h")
+
+    @cell.state_updater
+    def updater(state_cell):
+        x = state_cell.get_input("x")
+        h = state_cell.get_state("h")
+        nh = fluid.layers.fc(
+            input=[x, h], size=H, act="tanh", bias_attr=False,
+            param_attr=[fluid.ParamAttr(name="cell_x_w"),
+                        fluid.ParamAttr(name="cell_h_w")])
+        state_cell.set_state("h", nh)
+
+    return cell
+
+
+def _encoder(src):
+    emb = fluid.layers.embedding(
+        src, size=[V, EMB], param_attr=fluid.ParamAttr(name="src_emb"))
+    proj = fluid.layers.fc(emb, size=H, act="tanh",
+                           param_attr=fluid.ParamAttr(name="enc_w"),
+                           bias_attr=False)
+    return fluid.layers.sequence_last_step(proj)
+
+
+def _train_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[1], dtype="int64",
+                                lod_level=1)
+        trg = fluid.layers.data("trg", shape=[1], dtype="int64",
+                                lod_level=1)
+        lbl = fluid.layers.data("lbl", shape=[1], dtype="int64",
+                                lod_level=1)
+        enc_last = _encoder(src)
+        cell = _build_cell(enc_last)
+        decoder = TrainingDecoder(cell)
+        trg_emb = fluid.layers.embedding(
+            trg, size=[V, EMB], param_attr=fluid.ParamAttr(name="trg_emb"))
+        with decoder.block():
+            cur = decoder.step_input(trg_emb)
+            decoder.state_cell.compute_state(inputs={"x": cur})
+            h = decoder.state_cell.get_state("h")
+            out = fluid.layers.fc(
+                h, size=V, act="softmax",
+                param_attr=fluid.ParamAttr(name="score_w"),
+                bias_attr=fluid.ParamAttr(name="score_b"))
+            decoder.state_cell.update_states()
+            decoder.output(out)
+        pred = decoder()
+        cost = fluid.layers.mean(fluid.layers.cross_entropy(pred, lbl))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(cost)
+    return main, startup, cost
+
+
+def _gen_program(beam_size=3, max_len=4):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[1], dtype="int64",
+                                lod_level=1)
+        enc_last = _encoder(src)
+        cell = _build_cell(enc_last)
+        init_ids = fluid.layers.fill_constant_batch_size_like(
+            input=enc_last, shape=[-1, 1], value=0, dtype="int64")
+        init_scores = fluid.layers.fill_constant_batch_size_like(
+            input=enc_last, shape=[-1, 1], value=0.0, dtype="float32")
+        decoder = BeamSearchDecoder(
+            state_cell=cell, init_ids=init_ids, init_scores=init_scores,
+            target_dict_dim=V, word_dim=EMB, input_var_dict={},
+            topk_size=V, sparse_emb=False, max_len=max_len,
+            beam_size=beam_size, end_id=END_ID,
+            emb_param_attr=fluid.ParamAttr(name="trg_emb"),
+            score_param_attr=fluid.ParamAttr(name="score_w"),
+            score_bias_attr=fluid.ParamAttr(name="score_b"))
+        decoder.decode()
+        ids, scores = decoder()
+    return main, startup, ids, scores
+
+
+def _toy_batch(rng, n=6):
+    srcs, trgs, lbls = [], [], []
+    for _ in range(n):
+        L = int(rng.randint(2, 5))
+        s = rng.randint(2, V, size=L)
+        # task: echo the LAST source token then END (the encoder state
+        # is the last-step projection, so the last token is visible)
+        t = np.array([0, s[-1]], dtype=np.int64)         # <s>, tok
+        l = np.array([s[-1], END_ID], dtype=np.int64)    # tok, </s>
+        srcs.append(s.reshape(-1, 1).astype(np.int64))
+        trgs.append(t.reshape(-1, 1))
+        lbls.append(l.reshape(-1, 1))
+    feed = {
+        "src": create_lod_tensor(np.concatenate(srcs),
+                                 [[len(s) for s in srcs]]),
+        "trg": create_lod_tensor(np.concatenate(trgs),
+                                 [[len(t) for t in trgs]]),
+        "lbl": create_lod_tensor(np.concatenate(lbls),
+                                 [[len(l) for l in lbls]]),
+    }
+    return feed, [int(s[-1]) for s in srcs]
+
+
+def test_training_decoder_trains_and_beam_decoder_generates():
+    scope = fluid.executor.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.executor.scope_guard(scope):
+        main, startup, cost = _train_program()
+        exe.run(startup)
+        losses = []
+        for _ in range(60):
+            feed, _ = _toy_batch(rng)
+            (l,) = exe.run(main, feed=feed, fetch_list=[cost])
+            losses.append(float(np.asarray(l).ravel()[0]))
+        assert losses[-1] < 0.35 * losses[0], losses[::10]
+
+        # generation shares the trained parameters via pinned names;
+        # snapshot them around the generation startup (which initializes
+        # every param in its program, like the reference's startup)
+        trained = {n: np.asarray(scope.get(n)) for n in
+                   ["src_emb", "enc_w", "cell_x_w", "cell_h_w",
+                    "trg_emb", "score_w", "score_b"]}
+        gmain, gstartup, ids_var, scores_var = _gen_program()
+        exe.run(gstartup)
+        for n, v in trained.items():
+            scope.set(n, v)
+        feed, first_tokens = _toy_batch(rng, n=4)
+        ids, scores = exe.run(
+            gmain, feed={"src": feed["src"]},
+            fetch_list=[ids_var, scores_var], return_numpy=False)
+        lens = ids.recursive_sequence_lengths()[-1]
+        flat = np.asarray(ids).reshape(-1)
+        # top hypothesis per source: starts at offsets of cumsum; beams
+        # come out ranked best-first, 3 per source
+        offs = np.cumsum([0] + list(lens))[:-1]
+        # hypotheses don't include <s>: first entry IS the echoed token
+        got_first = [int(flat[o]) for o in offs[::3]]
+        # the learned echo task: >= 3 of 4 sources decode their last token
+        hits = sum(1 for g, w in zip(got_first, first_tokens) if g == w)
+        assert hits >= 3, (got_first, first_tokens)
+
+
+def test_state_cell_guards():
+    import pytest
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        boot = fluid.layers.data("b", shape=[H], dtype="float32")
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=boot)},
+                         out_state="h")
+        with pytest.raises(ValueError):
+            cell.get_state("nope")
+        with pytest.raises(ValueError):
+            cell.get_state("h")   # outside a decoder block
+        with pytest.raises(ValueError):
+            cell.update_states()

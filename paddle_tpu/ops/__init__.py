@@ -18,6 +18,7 @@ from . import detection_ops     # noqa: F401
 from . import vision_ops        # noqa: F401
 from . import misc_ops          # noqa: F401
 from . import io_ops            # noqa: F401
+from . import compat_ops        # noqa: F401
 from . import csp_ops           # noqa: F401
 from . import pallas_kernels    # noqa: F401
 

@@ -1,0 +1,587 @@
+"""Remaining reference op tail (final parity sweep).
+
+Reference analogues, all under paddle/fluid/operators/: conv_fusion_op.cc,
+add_position_encoding_op.cc, conv_shift_op.cc, cos_sim_op.cc,
+maxout_op.cc, prelu_op.cc, minus_op.cc, modified_huber_loss_op.cc,
+l1_norm_op.cc, multiplex_op.cc, fill_op.cc, fake_init_op.cc,
+get_places_op.cc, interpolate_op.cc, pool_with_index_op.cc,
+detection_map_op.cc, lod_rank_table_op.cc, reorder_lod_tensor_by_rank_op.cc,
+split_lod_tensor_op.cc / merge_lod_tensor_op.cc (the IfElse pair),
+split_selected_rows_op.cc, distributed_ops/{split_ids,merge_ids,
+split_byref}_op.cc, lookup_sparse_table_op.cc, delete_var_op.cc,
+tensor_array_to_tensor_op.cc, similarity_focus_op.cc.
+
+Grad ops are NOT mirrored: the generic per-op vjp (registry.py) derives
+them — each reference *_grad op registration is subsumed by autodiff.
+"""
+
+import numpy as np
+
+from .registry import register_op, get_op_def as get_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# fused conv + epilogue (conv_fusion_op.cc — the cuDNN fused kernel)
+# ---------------------------------------------------------------------------
+
+@register_op("conv2d_fusion")
+def _conv2d_fusion(ctx):
+    jnp = _jnp()
+    # the conv2d lowering already folds a Bias input when present
+    out = get_op("conv2d").lower(ctx)["Output"]
+    residual = ctx.input("ResidualData")
+    if residual is not None:
+        out = out + residual
+    act = ctx.attr("activation", "relu")
+    if act in ("relu",):
+        out = jnp.maximum(out, 0)
+    elif act in ("identity", "", None):
+        pass
+    elif act == "sigmoid":
+        import jax
+        out = jax.nn.sigmoid(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    else:
+        raise NotImplementedError("conv2d_fusion activation %r" % act)
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(ctx):
+    ctx.attrs = dict(ctx.attrs)
+    x = ctx.input("Input")
+    layout = ctx.attr("data_format", "NCHW")
+    channels = x.shape[1] if layout in ("NCHW", "AnyLayout") \
+        else x.shape[-1]
+    ctx.attrs["groups"] = channels
+    return get_op("conv2d_transpose").lower(ctx)
+
+
+# ---------------------------------------------------------------------------
+# small math / activation tail
+# ---------------------------------------------------------------------------
+
+@register_op("add_position_encoding")
+def _add_position_encoding(ctx):
+    """out = alpha*x + beta*sinusoid(pos) (add_position_encoding_op.cc)."""
+    jnp = _jnp()
+    x = ctx.input("X")      # [B, T, D]
+    alpha = ctx.attr("alpha", 1.0)
+    beta = ctx.attr("beta", 1.0)
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    i = jnp.arange(D // 2, dtype=jnp.float32)[None, :]
+    freq = pos / jnp.power(10000.0, 2.0 * i / D)
+    # reference layout: first half sin, second half cos
+    enc = jnp.concatenate([jnp.sin(freq), jnp.cos(freq)], axis=1)
+    if enc.shape[1] < D:    # odd D: pad the tail
+        enc = jnp.pad(enc, ((0, 0), (0, D - enc.shape[1])))
+    return {"Out": (alpha * x + beta * enc[None].astype(x.dtype))
+            .astype(x.dtype)}
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx):
+    """Circular correlation (conv_shift_op.cc): out[b,i] =
+    sum_j x[b,(i+j-M//2) mod N] * y[b,j]."""
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("Y")
+    N, M = x.shape[1], y.shape[1]
+    half = M // 2
+    out = jnp.zeros_like(x)
+    for j in range(M):
+        out = out + jnp.roll(x, half - j, axis=1) * y[:, j:j + 1]
+    return {"Out": out}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx):
+    jnp = _jnp()
+    x, y = ctx.input("X"), ctx.input("Y")
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    dot = jnp.sum(x * y, axis=-1, keepdims=True)
+    return {"Out": dot / jnp.maximum(xn * yn, 1e-12),
+            "XNorm": xn, "YNorm": yn}
+
+
+@register_op("maxout")
+def _maxout(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")      # [B, C, H, W]
+    groups = ctx.attr("groups", 1)
+    B, C, H, W = x.shape
+    return {"Out": jnp.max(
+        x.reshape(B, C // groups, groups, H, W), axis=2)}
+
+
+@register_op("prelu")
+def _prelu(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    alpha = ctx.input("Alpha")
+    mode = ctx.attr("mode", "all")
+    if mode == "channel":
+        # channel dim is axis 1 for any rank >= 2 (prelu_op.cc)
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "element":
+        a = alpha.reshape((1,) + tuple(x.shape[1:]))
+    else:
+        a = alpha.reshape(())
+    return {"Out": jnp.where(x >= 0, x, a * x)}
+
+
+@register_op("minus")
+def _minus(ctx):
+    return {"Out": ctx.input("X") - ctx.input("Y")}
+
+
+@register_op("modified_huber_loss")
+def _modified_huber_loss(ctx):
+    """modified_huber_loss_op.h: binary classification loss on y in {0,1};
+    z = (2y-1)*pred; loss = max(0,1-z)^2 for z>=-1 else -4z."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    z = (2.0 * y - 1.0) * x
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.square(jnp.maximum(1.0 - z, 0.0)))
+    return {"Out": loss, "IntermediateVal": z}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx):
+    jnp = _jnp()
+    return {"Out": jnp.sum(jnp.abs(ctx.input("X"))).reshape(1)}
+
+
+@register_op("fill")
+def _fill(ctx):
+    """fill_op.cc: fill the output from an attr-carried buffer."""
+    jnp = _jnp()
+    from ..fluid import core as fcore
+    shape = [int(s) for s in ctx.attr("shape", [1])]
+    dtype = fcore.convert_dtype_to_np(
+        ctx.attr("dtype", fcore.VarDesc.VarType.FP32))
+    value = np.asarray(ctx.attr("value", [0.0]), dtype=dtype)
+    return {"Out": jnp.asarray(value.reshape(shape))}
+
+
+@register_op("fake_init")
+def _fake_init(ctx):
+    """fake_init_op.cc: placeholder init for vars another process owns
+    (pserver-side tables) — zeros of the declared shape."""
+    jnp = _jnp()
+    shape = [int(s) for s in ctx.attr("shape", [1])]
+    return {"Out": jnp.zeros(shape, "float32")}
+
+
+@register_op("get_places")
+def _get_places(ctx):
+    """get_places_op.cc: the visible device list, as indices."""
+    import jax
+    jnp = _jnp()
+    n = ctx.attr("device_count", 0) or len(jax.devices())
+    return {"Out": jnp.arange(n, dtype=jnp.int32)}
+
+
+@register_op("interpolate")
+def _interpolate(ctx):
+    method = ctx.attr("interp_method", "bilinear")
+    op = "bilinear_interp" if method == "bilinear" else "nearest_interp"
+    out = get_op(op).lower(ctx)
+    return out
+
+
+@register_op("similarity_focus")
+def _similarity_focus(ctx):
+    """similarity_focus_op.h: per (axis, index) slice, greedily select the
+    highest cells whose row AND column are both still unused — exactly
+    min(H, W) ones per slice — and broadcast the mask across channels."""
+    jnp = _jnp()
+    x = ctx.input("X")      # [B, C, H, W]
+    axis = ctx.attr("axis", 1)
+    indexes = ctx.attr("indexes", [0])
+    if axis != 1:
+        raise NotImplementedError("similarity_focus: axis=1 only")
+    B, C, H, W = x.shape
+    neg = jnp.asarray(-np.inf, x.dtype)
+    mask = jnp.zeros_like(x)
+    for idx in indexes:
+        sl = x[:, idx]                     # [B, H, W]
+        m = jnp.zeros_like(sl)
+        avail = sl
+        for _step in range(min(H, W)):     # static greedy selection
+            flat = avail.reshape(B, -1)
+            best = jnp.argmax(flat, axis=1)          # [B]
+            r, c = best // W, best % W
+            hit = (jnp.arange(H)[None, :, None] == r[:, None, None]) & \
+                  (jnp.arange(W)[None, None, :] == c[:, None, None])
+            m = jnp.maximum(m, hit.astype(sl.dtype))
+            row_used = jnp.arange(H)[None, :, None] == r[:, None, None]
+            col_used = jnp.arange(W)[None, None, :] == c[:, None, None]
+            avail = jnp.where(row_used | col_used, neg, avail)
+        mask = jnp.maximum(mask, jnp.broadcast_to(m[:, None], mask.shape))
+    return {"Out": mask}
+
+
+# ---------------------------------------------------------------------------
+# pooling with argmax indices (pool_with_index_op.cc)
+# ---------------------------------------------------------------------------
+
+def _pool_with_index(ctx, spatial):
+    jnp = _jnp()
+    x = ctx.input("X")                     # [B, C, *spatial]
+    ksize = [int(k) for k in ctx.attr("ksize", [2] * spatial)]
+    strides = [int(s) for s in ctx.attr("strides", [1] * spatial)]
+    pads = [int(p) for p in ctx.attr("paddings", [0] * spatial)]
+    if ctx.attr("global_pooling", False):
+        # pool_with_index_op.cc: global pooling overrides ksize/paddings
+        ksize = list(x.shape[2:])
+        pads = [0] * spatial
+    pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, pad_cfg, constant_values=neg)
+    in_spatial = x.shape[2:]
+    out_spatial = [
+        (in_spatial[d] + 2 * pads[d] - ksize[d]) // strides[d] + 1
+        for d in range(spatial)]
+    # stack all window offsets, track flat UNPADDED input index per cell
+    cand_vals, cand_idx = [], []
+    import itertools
+    for off in itertools.product(*[range(k) for k in ksize]):
+        idx_nd = []
+        sl = xp
+        for d in range(spatial):
+            start = off[d]
+            end = start + strides[d] * (out_spatial[d] - 1) + 1
+            sl = jnp.take(sl, jnp.arange(start, end, strides[d]),
+                          axis=2 + d)
+            idx_nd.append(jnp.arange(out_spatial[d]) * strides[d]
+                          + off[d] - pads[d])
+        cand_vals.append(sl)
+        flat = jnp.zeros((), jnp.int32)
+        for d in range(spatial):
+            shape = [1] * spatial
+            shape[d] = out_spatial[d]
+            flat = flat * in_spatial[d] + \
+                jnp.clip(idx_nd[d], 0, in_spatial[d] - 1).reshape(shape)
+        cand_idx.append(jnp.broadcast_to(flat, tuple(out_spatial)))
+    vals = jnp.stack(cand_vals, axis=0)     # [K, B, C, *out]
+    idxs = jnp.stack(cand_idx, axis=0)      # [K, *out]
+    best = jnp.argmax(vals, axis=0)         # [B, C, *out]
+    out = jnp.max(vals, axis=0)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(idxs[:, None, None], vals.shape), best[None],
+        axis=0)[0]
+    return {"Out": out, "Mask": mask.astype(jnp.int32)}
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx):
+    return _pool_with_index(ctx, 2)
+
+
+@register_op("max_pool3d_with_index")
+def _max_pool3d_with_index(ctx):
+    return _pool_with_index(ctx, 3)
+
+
+# ---------------------------------------------------------------------------
+# LoD rank table + reorder + IfElse split/merge
+# ---------------------------------------------------------------------------
+
+@register_op("lod_rank_table")
+def _lod_rank_table(ctx):
+    """lod_rank_table_op.cc: order sequences by length, descending (stable).
+    Dense encoding: the table IS the permutation vector [B]."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    lens = ctx.lod_len("X")
+    B = x.shape[0]
+    if lens is None:
+        lens = jnp.full((B,), x.shape[1] if x.ndim > 1 else 1, jnp.int32)
+    # stable descending sort: argsort of (-len, index)
+    perm = jnp.argsort(-lens.astype(jnp.int64) * B
+                       - (B - 1 - jnp.arange(B)))
+    return {"Out": perm.astype(jnp.int32)}
+
+
+@register_op("reorder_lod_tensor_by_rank")
+def _reorder_lod_tensor_by_rank(ctx):
+    jnp = _jnp()
+    x = ctx.input("X")
+    table = ctx.input("RankTable").astype("int32")
+    out = jnp.take(x, table, axis=0)
+    lens = ctx.lod_len("X")
+    res = {"Out": out}
+    if lens is not None:
+        res["Out@LOD_LEN"] = jnp.take(lens, table, axis=0)
+    return res
+
+
+@register_op("split_lod_tensor")
+def _split_lod_tensor(ctx):
+    """split_lod_tensor_op.cc (the IfElse input split). Output row counts
+    are data-dependent; the dense encoding keeps ALL rows in both outputs
+    and masks the non-selected ones to zero — merge_lod_tensor composes
+    exactly, which is the invariant IfElse needs."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    mask = ctx.input("Mask").reshape(-1).astype(bool)
+    m = mask.reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"OutTrue": jnp.where(m, x, 0).astype(x.dtype),
+            "OutFalse": jnp.where(m, 0, x).astype(x.dtype)}
+
+
+@register_op("merge_lod_tensor")
+def _merge_lod_tensor(ctx):
+    jnp = _jnp()
+    mask = ctx.input("Mask").reshape(-1).astype(bool)
+    t, f = ctx.input("InTrue"), ctx.input("InFalse")
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+    return {"Out": jnp.where(m, t, f)}
+
+
+@register_op("lod_array_length")
+def _lod_array_length(ctx):
+    return get_op("array_length").lower(ctx)
+
+
+@register_op("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ctx):
+    """tensor_array_to_tensor_op.cc: stack/concat the array entries."""
+    jnp = _jnp()
+    xs = ctx.inputs("X")
+    axis = ctx.attr("axis", 0)
+    use_stack = ctx.attr("use_stack", False)
+    out = jnp.stack(xs, axis=axis) if use_stack \
+        else jnp.concatenate(xs, axis=axis)
+    idx = jnp.array([x.shape[axis] if not use_stack else 1 for x in xs],
+                    jnp.int32)
+    return {"Out": out, "OutIndex": idx}
+
+
+# ---------------------------------------------------------------------------
+# distributed / sparse-table helpers
+# ---------------------------------------------------------------------------
+
+@register_op("lookup_sparse_table")
+def _lookup_sparse_table(ctx):
+    """lookup_sparse_table_op.cc: lookup_table over an auto-growing
+    pserver table; dense substrate serves it with the same gather."""
+    return get_op("lookup_table").lower(ctx)
+
+
+@register_op("split_ids")
+def _split_ids(ctx):
+    """split_ids_op.cc: shard ids by id % n_parts, preserving each
+    shard's original order. Output row counts are data-dependent —
+    eager/host path only (the PS prefetch path, which runs eagerly)."""
+    import jax
+    jnp = _jnp()
+    ids = ctx.input("Ids")
+    n = len(ctx.op.outputs.get("Out", []))
+    if isinstance(ids, jax.core.Tracer):
+        raise NotImplementedError(
+            "split_ids has data-dependent output shapes — host path only")
+    flat = np.asarray(ids).reshape(-1)
+    parts = [flat[flat % n == i].reshape(-1, 1) for i in range(n)]
+    return {"Out": [jnp.asarray(p) for p in parts]}
+
+
+@register_op("merge_ids")
+def _merge_ids(ctx):
+    """merge_ids_op.cc: restore per-shard prefetched rows to the original
+    Ids order (host path, exact mirror of split_ids' sharding)."""
+    import jax
+    jnp = _jnp()
+    ids = ctx.inputs("Ids")
+    rows = ctx.inputs("X")
+    if any(isinstance(v, jax.core.Tracer) for v in list(ids) + list(rows)):
+        raise NotImplementedError("merge_ids runs on the host path only")
+    orig = np.asarray(ids[0]).reshape(-1)
+    n = len(rows)
+    rows_np = [np.asarray(r) for r in rows]
+    width = rows_np[0].shape[-1]
+    out = np.zeros((len(orig), width), rows_np[0].dtype)
+    counters = [0] * n
+    for k, idv in enumerate(orig):
+        s = int(idv) % n
+        out[k] = rows_np[s][counters[s]]
+        counters[s] += 1
+    return {"Out": jnp.asarray(out)}
+
+
+@register_op("split_byref")
+def _split_byref(ctx):
+    """split_byref_op.cc: split rows into height-sections (zero-copy in
+    the reference; XLA slices here)."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    sections = ctx.attr("height_sections", None) or ctx.attr(
+        "sections", None)
+    n = len(ctx.op.outputs.get("Out", []))
+    if not sections:
+        # array_split semantics: earlier parts take the remainder rows —
+        # nothing is silently dropped
+        base, rem = divmod(x.shape[0], n)
+        sections = [base + (1 if i < rem else 0) for i in range(n)]
+    if sum(int(s) for s in sections) != x.shape[0]:
+        raise ValueError(
+            "split_byref: sections %s do not sum to height %d"
+            % (sections, x.shape[0]))
+    outs, off = [], 0
+    for s in sections:
+        outs.append(x[off:off + int(s)])
+        off += int(s)
+    return {"Out": outs}
+
+
+@register_op("split_selected_rows")
+def _split_selected_rows(ctx):
+    return get_op("split_byref").lower(ctx)
+
+
+@register_op("delete_var")
+def _delete_var(ctx):
+    """delete_var_op.cc: drop variables (host op — the executor removes
+    the env entries; functional state threading makes this advisory)."""
+    return {}
+
+
+@register_op("gen_nccl_id")
+def _gen_nccl_id(ctx):
+    """gen_nccl_id_op.cc — alias of the collective-id bootstrap."""
+    return get_op("gen_collective_id").lower(ctx)
+
+
+# ---------------------------------------------------------------------------
+# detection mAP metric (detection_map_op.cc) — host/eager evaluation
+# ---------------------------------------------------------------------------
+
+@register_op("detection_map")
+def _detection_map(ctx):
+    """11-point / integral mAP over (label, score, box-match) rows.
+    Metric op: evaluated on concrete host arrays (metrics run outside the
+    jitted step, reference detection_map_op.h)."""
+    import jax
+    jnp = _jnp()
+    det = ctx.input("DetectRes")    # [M, 6]: label, score, xmin..ymax
+    gt = ctx.input("Label")         # [N, 6]: label, xmin..ymax (+difficult)
+    if isinstance(det, jax.core.Tracer) or isinstance(gt, jax.core.Tracer):
+        raise NotImplementedError("detection_map runs on the host path")
+    overlap_t = ctx.attr("overlap_threshold", 0.5)
+    ap_type = ctx.attr("ap_type", "integral")
+    evaluate_difficult = ctx.attr("evaluate_difficult", True)
+    det = np.asarray(det)
+    gt = np.asarray(gt)
+    det_lens = ctx.lod_len("DetectRes")
+    gt_lens = ctx.lod_len("Label")
+    det_lens = (np.asarray(det_lens) if det_lens is not None
+                else np.array([det.shape[0]]))
+    gt_lens = (np.asarray(gt_lens) if gt_lens is not None
+               else np.array([gt.shape[0]]))
+    det = det.reshape(-1, det.shape[-1])
+    gt = gt.reshape(-1, gt.shape[-1])
+    # Label rows: 6 columns = [label, difficult, xmin, ymin, xmax, ymax]
+    # (detection_map_op.h), 5 columns = no difficult flag
+    has_difficult = gt.shape[-1] >= 6
+
+    def gt_box(r):
+        return r[2:6] if has_difficult else r[1:5]
+
+    def gt_difficult(r):
+        return bool(r[1]) if has_difficult else False
+
+    def iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    # streaming accumulation (detection_map_op.h GetInputPos): rows of
+    # [class, npos] / [class, score, count]; prior batches arrive via the
+    # PosCount/TruePos/FalsePos inputs
+    npos_of, tp_rows, fp_rows = {}, [], []
+    prior_pos = ctx.input("PosCount")
+    prior_tp = ctx.input("TruePos")
+    prior_fp = ctx.input("FalsePos")
+    if prior_pos is not None:
+        for c, n in np.asarray(prior_pos).reshape(-1, 2):
+            npos_of[int(c)] = npos_of.get(int(c), 0) + int(n)
+    if prior_tp is not None:
+        tp_rows += [tuple(r) for r in np.asarray(prior_tp).reshape(-1, 3)]
+    if prior_fp is not None:
+        fp_rows += [tuple(r) for r in np.asarray(prior_fp).reshape(-1, 3)]
+
+    classes = sorted(set(gt[:, 0].astype(int)))
+    d_off = np.concatenate([[0], np.cumsum(det_lens)]).astype(int)
+    g_off = np.concatenate([[0], np.cumsum(gt_lens)]).astype(int)
+    for c in classes:
+        for i in range(len(gt_lens)):
+            grows = [r for r in gt[g_off[i]:g_off[i + 1]]
+                     if int(r[0]) == c]
+            drows = det[d_off[i]:d_off[i + 1]]
+            gboxes = [gt_box(r) for r in grows]
+            counted = [evaluate_difficult or not gt_difficult(r)
+                       for r in grows]
+            npos_of[c] = npos_of.get(c, 0) + sum(counted)
+            taken = [False] * len(gboxes)
+            dc = sorted([r for r in drows if int(r[0]) == c],
+                        key=lambda r: -r[1])
+            for r in dc:
+                best, bi = 0.0, -1
+                for j, gb in enumerate(gboxes):
+                    o = iou(r[2:6], gb)
+                    if o > best:
+                        best, bi = o, j
+                if best >= overlap_t and bi >= 0 and not taken[bi]:
+                    taken[bi] = True
+                    if counted[bi]:
+                        tp_rows.append((c, float(r[1]), 1))
+                else:
+                    fp_rows.append((c, float(r[1]), 1))
+
+    aps = []
+    for c, npos in npos_of.items():
+        if npos == 0:
+            continue
+        scored = [(s, 1) for cc, s, n in tp_rows if int(cc) == c] + \
+                 [(s, 0) for cc, s, n in fp_rows if int(cc) == c]
+        if not scored:
+            aps.append(0.0)
+            continue
+        scored.sort(key=lambda t: -t[0])
+        tps = np.cumsum([t[1] for t in scored])
+        fps = np.cumsum([1 - t[1] for t in scored])
+        rec = tps / npos
+        prec = tps / np.maximum(tps + fps, 1e-12)
+        if ap_type == "11point":
+            ap = np.mean([
+                max([p for r_, p in zip(rec, prec) if r_ >= th] or [0.0])
+                for th in np.arange(0, 1.01, 0.1)])
+        else:
+            ap = 0.0
+            prev_r = 0.0
+            for r_, p in zip(rec, prec):
+                ap += (r_ - prev_r) * p
+                prev_r = r_
+        aps.append(float(ap))
+    m_ap = float(np.mean(aps)) if aps else 0.0
+    pos_arr = np.array(sorted((c, n) for c, n in npos_of.items()),
+                       np.int32).reshape(-1, 2)
+    tp_arr = np.array(tp_rows, np.float32).reshape(-1, 3)
+    fp_arr = np.array(fp_rows, np.float32).reshape(-1, 3)
+    return {"MAP": jnp.asarray([m_ap], jnp.float32),
+            "AccumPosCount": jnp.asarray(pos_arr),
+            "AccumTruePos": jnp.asarray(tp_arr),
+            "AccumFalsePos": jnp.asarray(fp_arr)}

@@ -1,0 +1,263 @@
+"""Final op-tail parity sweep (ops/compat_ops.py) — numpy-diff checks in
+the OpTest style (reference unittests/op_test.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.ops  # noqa: F401 — registers everything
+from paddle_tpu.ops.registry import ExecContext
+
+
+class _FakeOp:
+    def __init__(self, type, inputs=None, outputs=None, attrs=None):
+        self.type = type
+        self.inputs = inputs or {}
+        self.outputs = outputs or {}
+        self.attrs = attrs or {}
+
+
+def _run(op_type, inputs, attrs=None, outputs=None):
+    from paddle_tpu.ops.registry import get_op_def
+    import jax.numpy as jnp
+    vals = {k: [jnp.asarray(v) for v in (vs if isinstance(vs, list)
+                                         else [vs])]
+            for k, vs in inputs.items()}
+    op = _FakeOp(op_type, outputs=outputs or {}, attrs=attrs or {})
+    return get_op_def(op_type).lower(ExecContext(op, vals))
+
+
+def test_conv2d_fusion_matches_conv_bias_relu():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32")
+    b = rng.randn(4).astype("float32")
+    attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1, "activation": "relu"}
+    fused = np.asarray(_run("conv2d_fusion",
+                            {"Input": x, "Filter": w, "Bias": b},
+                            attrs)["Output"])
+    plain = np.asarray(_run("conv2d", {"Input": x, "Filter": w},
+                            attrs)["Output"])
+    ref = np.maximum(plain + b.reshape(1, -1, 1, 1), 0)
+    np.testing.assert_allclose(fused, ref, atol=1e-5)
+
+
+def test_add_position_encoding():
+    x = np.zeros((1, 4, 6), np.float32)
+    out = np.asarray(_run("add_position_encoding", {"X": x},
+                          {"alpha": 1.0, "beta": 1.0})["Out"])
+    # position 0: sin part 0, cos part 1
+    np.testing.assert_allclose(out[0, 0, :3], np.zeros(3), atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 3:], np.ones(3), atol=1e-6)
+    # sin(1) at pos 1, first frequency
+    assert abs(out[0, 1, 0] - np.sin(1.0)) < 1e-5
+
+
+def test_conv_shift_circular():
+    x = np.arange(5, dtype=np.float32).reshape(1, 5)
+    y = np.array([[1.0, 2.0, 3.0]], np.float32)
+    out = np.asarray(_run("conv_shift", {"X": x, "Y": y})["Out"])
+    ref = np.zeros(5, np.float32)
+    for i in range(5):
+        for j in range(3):
+            ref[i] += x[0, (i + j - 1) % 5] * y[0, j]
+    np.testing.assert_allclose(out[0], ref, atol=1e-5)
+
+
+def test_cos_sim_maxout_prelu_minus():
+    rng = np.random.RandomState(1)
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(3, 4).astype("float32")
+    r = _run("cos_sim", {"X": a, "Y": b})
+    ref = (a * b).sum(1) / (np.linalg.norm(a, axis=1)
+                            * np.linalg.norm(b, axis=1))
+    np.testing.assert_allclose(np.asarray(r["Out"]).ravel(), ref,
+                               atol=1e-5)
+
+    x = rng.randn(2, 6, 3, 3).astype("float32")
+    mo = np.asarray(_run("maxout", {"X": x}, {"groups": 3})["Out"])
+    assert mo.shape == (2, 2, 3, 3)
+    np.testing.assert_allclose(
+        mo, x.reshape(2, 2, 3, 3, 3).max(axis=2), atol=1e-6)
+
+    alpha = np.array([0.1, 0.2, 0.3], np.float32)
+    xp = rng.randn(2, 3, 2, 2).astype("float32")
+    pr = np.asarray(_run("prelu", {"X": xp, "Alpha": alpha},
+                         {"mode": "channel"})["Out"])
+    ref = np.where(xp >= 0, xp, alpha.reshape(1, 3, 1, 1) * xp)
+    np.testing.assert_allclose(pr, ref, atol=1e-6)
+
+    mn = np.asarray(_run("minus", {"X": a, "Y": b})["Out"])
+    np.testing.assert_allclose(mn, a - b, atol=1e-6)
+
+
+def test_modified_huber_and_l1_norm_and_multiplex():
+    x = np.array([[2.0], [0.5], [-3.0]], np.float32)
+    y = np.array([[1.0], [0.0], [1.0]], np.float32)
+    out = np.asarray(_run("modified_huber_loss",
+                          {"X": x, "Y": y})["Out"]).ravel()
+    z = (2 * y - 1).ravel() * x.ravel()
+    ref = np.where(z < -1, -4 * z, np.maximum(1 - z, 0) ** 2)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    l1 = np.asarray(_run("l1_norm", {"X": x})["Out"])
+    np.testing.assert_allclose(l1, [5.5], atol=1e-6)
+
+    c0 = np.full((3, 2), 0.0, np.float32)
+    c1 = np.full((3, 2), 1.0, np.float32)
+    ids = np.array([[1], [0], [1]], np.int32)
+    mx = np.asarray(_run("multiplex", {"Ids": ids, "X": [c0, c1]})["Out"])
+    np.testing.assert_allclose(mx[:, 0], [1, 0, 1], atol=1e-6)
+
+
+def test_max_pool2d_with_index():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    r = _run("max_pool2d_with_index", {"X": x},
+             {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]})
+    out, mask = np.asarray(r["Out"]), np.asarray(r["Mask"])
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]], atol=1e-6)
+    np.testing.assert_array_equal(mask[0, 0], [[5, 7], [13, 15]])
+
+
+def test_lod_rank_table_and_reorder():
+    import jax.numpy as jnp
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    lens = np.array([2, 4, 3], np.int32)
+    perm = np.asarray(_run("lod_rank_table",
+                           {"X": x, "X@LOD_LEN": lens})["Out"])
+    np.testing.assert_array_equal(perm, [1, 2, 0])  # lengths 4,3,2
+    r = _run("reorder_lod_tensor_by_rank",
+             {"X": x, "X@LOD_LEN": lens, "RankTable": perm})
+    np.testing.assert_allclose(np.asarray(r["Out"])[0], x[1], atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(r["Out@LOD_LEN"]), [4, 3, 2])
+
+
+def test_split_merge_lod_tensor_roundtrip():
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    mask = np.array([[1], [0], [1], [0]], np.int32)
+    s = _run("split_lod_tensor", {"X": x, "Mask": mask})
+    m = _run("merge_lod_tensor",
+             {"InTrue": np.asarray(s["OutTrue"]),
+              "InFalse": np.asarray(s["OutFalse"]), "Mask": mask,
+              "X": x})
+    np.testing.assert_allclose(np.asarray(m["Out"]), x, atol=1e-6)
+
+
+def test_split_ids_merge_ids_roundtrip():
+    ids = np.array([[1], [2], [3], [4], [5], [6]], np.int64)
+    s = _run("split_ids", {"Ids": ids},
+             outputs={"Out": ["o0", "o1", "o2"]})
+    shards = [np.asarray(p).ravel().tolist() for p in s["Out"]]
+    assert shards == [[3, 6], [1, 4], [2, 5]]
+    rows = [np.asarray([[v / 10.0, v / 10.0] for v in shard],
+                       dtype=np.float32) for shard in shards]
+    m = _run("merge_ids", {"Ids": ids, "X": rows})
+    np.testing.assert_allclose(
+        np.asarray(m["Out"])[:, 0], np.arange(1, 7) / 10.0, atol=1e-6)
+
+
+def test_split_byref_and_tensor_array_to_tensor():
+    x = np.arange(10, dtype=np.float32).reshape(5, 2)
+    s = _run("split_byref", {"X": x}, {"height_sections": [2, 3]},
+             outputs={"Out": ["a", "b"]})
+    assert np.asarray(s["Out"][0]).shape == (2, 2)
+    assert np.asarray(s["Out"][1]).shape == (3, 2)
+
+    r = _run("tensor_array_to_tensor",
+             {"X": [x[:2], x[2:]]}, {"axis": 0, "use_stack": False})
+    np.testing.assert_allclose(np.asarray(r["Out"]), x, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(r["OutIndex"]), [2, 3])
+
+
+def test_detection_map_perfect_predictions():
+    # one image, two gt boxes of class 1, perfectly detected
+    gt = np.array([[1, 0, 0, 1, 1], [1, 2, 2, 3, 3]], np.float32)
+    det = np.array([[1, 0.9, 0, 0, 1, 1], [1, 0.8, 2, 2, 3, 3]],
+                   np.float32)
+    r = _run("detection_map", {"DetectRes": det, "Label": gt},
+             {"overlap_threshold": 0.5, "ap_type": "integral"})
+    assert abs(float(np.asarray(r["MAP"])[0]) - 1.0) < 1e-6
+    # a wrong detection lowers mAP
+    det2 = np.array([[1, 0.9, 5, 5, 6, 6], [1, 0.8, 2, 2, 3, 3]],
+                    np.float32)
+    r2 = _run("detection_map", {"DetectRes": det2, "Label": gt},
+              {"overlap_threshold": 0.5, "ap_type": "integral"})
+    assert float(np.asarray(r2["MAP"])[0]) < 1.0
+
+
+def test_fill_fake_init_get_places_interpolate():
+    from paddle_tpu.fluid import core as fcore
+    f = np.asarray(_run("fill", {}, {
+        "shape": [2, 2], "dtype": fcore.VarDesc.VarType.FP32,
+        "value": [1.0, 2.0, 3.0, 4.0]})["Out"])
+    np.testing.assert_allclose(f, [[1, 2], [3, 4]], atol=1e-6)
+
+    z = np.asarray(_run("fake_init", {}, {"shape": [3]})["Out"])
+    np.testing.assert_allclose(z, np.zeros(3), atol=1e-6)
+
+    p = np.asarray(_run("get_places", {}, {"device_count": 4})["Out"])
+    np.testing.assert_array_equal(p, [0, 1, 2, 3])
+
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    up = np.asarray(_run("interpolate", {"X": x},
+                         {"interp_method": "nearest",
+                          "out_h": 4, "out_w": 4})["Out"])
+    assert up.shape == (1, 1, 4, 4)
+
+
+def test_depthwise_conv2d_transpose_and_lookup_sparse_table():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 3, 4, 4).astype("float32")
+    w = rng.randn(3, 1, 2, 2).astype("float32")
+    out = np.asarray(_run(
+        "depthwise_conv2d_transpose", {"Input": x, "Filter": w},
+        {"strides": [2, 2], "paddings": [0, 0], "dilations": [1, 1]}
+    )["Output"])
+    assert out.shape == (1, 3, 8, 8)
+
+    table = rng.randn(10, 4).astype("float32")
+    ids = np.array([[1], [7]], np.int64)
+    r = np.asarray(_run("lookup_sparse_table",
+                        {"W": table, "Ids": ids})["Out"])
+    np.testing.assert_allclose(r.reshape(2, 4), table[[1, 7]], atol=1e-6)
+
+
+def test_detection_map_difficult_and_accumulation():
+    # 6-column labels: [label, difficult, xmin, ymin, xmax, ymax]
+    gt = np.array([[1, 0, 0, 0, 1, 1], [1, 1, 2, 2, 3, 3]], np.float32)
+    det = np.array([[1, 0.9, 0, 0, 1, 1]], np.float32)
+    # difficult box excluded -> npos=1, the one detection matches: mAP 1
+    r = _run("detection_map", {"DetectRes": det, "Label": gt},
+             {"overlap_threshold": 0.5, "ap_type": "integral",
+              "evaluate_difficult": False})
+    assert abs(float(np.asarray(r["MAP"])[0]) - 1.0) < 1e-6
+    # accumulation: feed batch-1 accumulators into batch 2
+    gt2 = np.array([[1, 0, 5, 5, 6, 6]], np.float32)
+    det2 = np.array([[1, 0.8, 9, 9, 10, 10]], np.float32)  # miss
+    r2 = _run("detection_map",
+              {"DetectRes": det2, "Label": gt2,
+               "PosCount": np.asarray(r["AccumPosCount"]),
+               "TruePos": np.asarray(r["AccumTruePos"]),
+               "FalsePos": np.asarray(r["AccumFalsePos"])},
+              {"overlap_threshold": 0.5, "ap_type": "integral",
+               "evaluate_difficult": False})
+    m = float(np.asarray(r2["MAP"])[0])
+    assert 0.0 < m < 1.0   # one hit of two positives + one false positive
+
+
+def test_similarity_focus_greedy_unique():
+    x = np.array([[[[3.0, 2.0], [1.0, 0.0]]]], np.float32)  # [1,1,2,2]
+    r = np.asarray(_run("similarity_focus", {"X": x},
+                        {"axis": 1, "indexes": [0]})["Out"])
+    np.testing.assert_allclose(r[0, 0], [[1, 0], [0, 1]], atol=1e-6)
+
+
+def test_multiplex_rank3_still_works():
+    # the general lowering (loss_ops) must not be shadowed
+    c0 = np.zeros((2, 2, 2), np.float32)
+    c1 = np.ones((2, 2, 2), np.float32)
+    ids = np.array([[1], [0]], np.int32)
+    out = np.asarray(_run("multiplex", {"Ids": ids, "X": [c0, c1]})["Out"])
+    np.testing.assert_allclose(out[0], np.ones((2, 2)), atol=1e-6)
+    np.testing.assert_allclose(out[1], np.zeros((2, 2)), atol=1e-6)

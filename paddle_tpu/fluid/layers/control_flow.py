@@ -22,7 +22,8 @@ __all__ = [
     "While", "Switch", "ConditionalBlock", "StaticRNN", "DynamicRNN",
     "increment", "array_write", "array_read", "array_length",
     "create_array", "less_than", "equal", "zeros_like", "ones_like",
-    "max_sequence_len", "is_empty", "Print",
+    "max_sequence_len", "is_empty", "Print", "IfElse",
+    "lod_rank_table", "reorder_lod_tensor_by_rank",
 ]
 
 
@@ -696,3 +697,127 @@ class DynamicRNNGuard(BlockGuard):
         self.rnn.status = DynamicRNN.AFTER_RNN
         self.rnn._complete()
         return super().__exit__(exc_type, exc_val, exc_tb)
+
+
+class IfElseBlockGuard(object):
+    """reference control_flow.py:1379."""
+
+    def __init__(self, is_true, ie):
+        self.ie = ie
+        self.is_true = is_true
+
+    def __enter__(self):
+        self.ie.status = (IfElse.IN_IF_ELSE_TRUE_BLOCKS if self.is_true
+                          else IfElse.IN_IF_ELSE_FALSE_BLOCKS)
+        return self
+
+    def __exit__(self, *a):
+        self.ie.status = IfElse.OUT_IF_ELSE_BLOCKS
+        return False
+
+
+class IfElse(object):
+    """Row-wise conditional (reference control_flow.py:1412): rows where
+    `cond` holds flow through the true block, the rest through the false
+    block, and per-slot outputs merge back in original row order.
+
+    TPU realization: split_lod_tensor/merge_lod_tensor lower to dense
+    masking (ops/compat_ops.py) — both branches are computed over the
+    full batch and the merge selects per row. This is XLA-idiomatic
+    predication: identical results for row-wise branch computations,
+    with no dynamic shapes. (A branch whose computation couples rows —
+    e.g. a batch reduction — sees masked-out rows as zeros, matching the
+    reference's split semantics for sums but not for means.)"""
+
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self.input_table = {}
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        self.output_table = [[], []]   # [false_outs, true_outs]
+
+    def input(self, x):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("input must be inside a true/false block")
+        in_true = self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS
+        if id(x) not in self.input_table:
+            true_out = self.helper.create_variable_for_type_inference(
+                x.dtype)
+            false_out = self.helper.create_variable_for_type_inference(
+                x.dtype)
+            self.helper.append_op(
+                type="split_lod_tensor",
+                inputs={"X": [x], "Mask": [self.cond]},
+                outputs={"OutTrue": [true_out], "OutFalse": [false_out]},
+                attrs={}, infer_shape=False)
+            true_out.shape = tuple(x.shape)
+            false_out.shape = tuple(x.shape)
+            self.input_table[id(x)] = (true_out, false_out)
+        true_out, false_out = self.input_table[id(x)]
+        return true_out if in_true else false_out
+
+    def true_block(self):
+        return IfElseBlockGuard(True, self)
+
+    def false_block(self):
+        return IfElseBlockGuard(False, self)
+
+    def output(self, *outs):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("output must be inside a true/false block")
+        out_table = self.output_table[
+            1 if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS else 0]
+        out_table.extend(outs)
+
+    def __call__(self):
+        if self.status != IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("IfElse::__call__ must be out of sub-blocks")
+        false_outs, true_outs = self.output_table
+        if len(false_outs) != len(true_outs):
+            raise ValueError(
+                "true and false blocks must produce the same number of "
+                "outputs (%d vs %d)" % (len(true_outs), len(false_outs)))
+        rlist = []
+        for t, f in zip(true_outs, false_outs):
+            merged = self.helper.create_variable_for_type_inference(
+                t.dtype)
+            self.helper.append_op(
+                type="merge_lod_tensor",
+                inputs={"InTrue": [t], "InFalse": [f],
+                        "Mask": [self.cond], "X": [t]},
+                outputs={"Out": [merged]}, attrs={}, infer_shape=False)
+            merged.shape = tuple(t.shape)
+            rlist.append(merged)
+        # ALWAYS a list (reference control_flow.py IfElse.__call__) — a
+        # bare Variable would make `ie()[0]` slice rows instead of
+        # selecting the first output
+        return rlist
+
+
+def lod_rank_table(x, level=0):
+    """reference control_flow.py lod_rank_table: order sequences by
+    length, descending. Dense encoding: the table IS a permutation
+    vector [B] (ops/compat_ops.py)."""
+    helper = LayerHelper("lod_rank_table")
+    out = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT32, stop_gradient=True)
+    helper.append_op(type="lod_rank_table", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"level": level},
+                     infer_shape=False)
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """reference layers reorder_lod_tensor_by_rank."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = x.lod_level
+    helper.append_op(type="reorder_lod_tensor_by_rank",
+                     inputs={"X": [x], "RankTable": [rank_table]},
+                     outputs={"Out": [out]}, infer_shape=False)
+    out.shape = tuple(x.shape)
+    return out

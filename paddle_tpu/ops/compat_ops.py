@@ -386,12 +386,12 @@ def _split_ids(ctx):
     eager/host path only (the PS prefetch path, which runs eagerly)."""
     import jax
     jnp = _jnp()
-    ids = ctx.input("Ids")
+    id_inputs = ctx.inputs("Ids")   # duplicable slot: concat all of them
     n = len(ctx.op.outputs.get("Out", []))
-    if isinstance(ids, jax.core.Tracer):
+    if any(isinstance(i, jax.core.Tracer) for i in id_inputs):
         raise NotImplementedError(
             "split_ids has data-dependent output shapes — host path only")
-    flat = np.asarray(ids).reshape(-1)
+    flat = np.concatenate([np.asarray(i).reshape(-1) for i in id_inputs])
     parts = [flat[flat % n == i].reshape(-1, 1) for i in range(n)]
     return {"Out": [jnp.asarray(p) for p in parts]}
 
@@ -406,17 +406,29 @@ def _merge_ids(ctx):
     rows = ctx.inputs("X")
     if any(isinstance(v, jax.core.Tracer) for v in list(ids) + list(rows)):
         raise NotImplementedError("merge_ids runs on the host path only")
-    orig = np.asarray(ids[0]).reshape(-1)
     n = len(rows)
     rows_np = [np.asarray(r) for r in rows]
     width = rows_np[0].shape[-1]
-    out = np.zeros((len(orig), width), rows_np[0].dtype)
+    # the shard order interleaves ALL Ids inputs (split_ids concatenated
+    # them); walk them in the same global order, emitting one Out per
+    # Ids input (both slots are duplicable, merge_ids_op.cc)
     counters = [0] * n
-    for k, idv in enumerate(orig):
+    outs = []
+    for id_in in ids:
+        orig = np.asarray(id_in).reshape(-1)
+        out = np.zeros((len(orig), width), rows_np[0].dtype)
+        outs.append(out)
+    flat_positions = []
+    for t, id_in in enumerate(ids):
+        for k in range(np.asarray(id_in).reshape(-1).shape[0]):
+            flat_positions.append((t, k))
+    all_ids = np.concatenate([np.asarray(i).reshape(-1) for i in ids])
+    for (t, k), idv in zip(flat_positions, all_ids):
         s = int(idv) % n
-        out[k] = rows_np[s][counters[s]]
+        outs[t][k] = rows_np[s][counters[s]]
         counters[s] += 1
-    return {"Out": jnp.asarray(out)}
+    result = [jnp.asarray(o) for o in outs]
+    return {"Out": result if len(result) > 1 else result[0]}
 
 
 @register_op("split_byref")
@@ -523,7 +535,11 @@ def _detection_map(ctx):
     if prior_fp is not None:
         fp_rows += [tuple(r) for r in np.asarray(prior_fp).reshape(-1, 3)]
 
-    classes = sorted(set(gt[:, 0].astype(int)))
+    # classes seen in EITHER labels or detections: a detection of a class
+    # with no ground truth anywhere in the batch must still count as a
+    # false positive (detection_map_op.h CalcTrueAndFalsePositive)
+    classes = sorted(set(gt[:, 0].astype(int))
+                     | set(det[:, 0].astype(int)))
     d_off = np.concatenate([[0], np.cumsum(det_lens)]).astype(int)
     g_off = np.concatenate([[0], np.cumsum(gt_lens)]).astype(int)
     for c in classes:

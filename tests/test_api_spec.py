@@ -1,0 +1,30 @@
+"""The public API surface is frozen in API.spec (reference
+paddle/fluid/API.spec + the CI signature diff check): regenerating the
+inventory must match the committed file, so accidental signature or
+symbol removals fail loudly."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_spec_is_current():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "gen_api_spec.py")],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    generated = out.stdout.strip().splitlines()
+    with open(os.path.join(REPO, "API.spec")) as f:
+        committed = f.read().strip().splitlines()
+    gen_set, com_set = set(generated), set(committed)
+    removed = sorted(com_set - gen_set)[:10]
+    added = sorted(gen_set - com_set)[:10]
+    assert gen_set == com_set, (
+        "API surface drifted from API.spec.\n"
+        "Removed/changed: %s\nAdded: %s\n"
+        "If intentional, regenerate: python tools/gen_api_spec.py > "
+        "API.spec" % (removed, added))
+    # sanity: the surface is substantial (reference: 413 entries)
+    assert len(generated) > 400

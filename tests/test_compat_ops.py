@@ -261,3 +261,14 @@ def test_multiplex_rank3_still_works():
     out = np.asarray(_run("multiplex", {"Ids": ids, "X": [c0, c1]})["Out"])
     np.testing.assert_allclose(out[0], np.ones((2, 2)), atol=1e-6)
     np.testing.assert_allclose(out[1], np.zeros((2, 2)), atol=1e-6)
+
+
+def test_detection_map_counts_fp_for_unlabeled_class():
+    gt = np.array([[1, 0, 0, 1, 1]], np.float32)        # only class 1
+    det = np.array([[1, 0.9, 0, 0, 1, 1],               # hit class 1
+                    [2, 0.8, 0, 0, 1, 1]], np.float32)  # class 2: FP
+    r = _run("detection_map", {"DetectRes": det, "Label": gt},
+             {"overlap_threshold": 0.5, "ap_type": "integral"})
+    # class-2 FP must be recorded in the accumulators
+    fp = np.asarray(r["AccumFalsePos"])
+    assert any(int(row[0]) == 2 for row in fp), fp

@@ -302,3 +302,47 @@ def test_dynamic_rnn_with_params_trains():
             fetch_list=[loss])
         losses.append(float(np.asarray(l)))
     assert losses[-1] < 0.6 * losses[0], losses
+
+
+def test_ifelse_rowwise_branches():
+    """IfElse (reference control_flow.py:1412): rows route through the
+    true/false branches and merge in original order."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        limit = fluid.layers.fill_constant([1], "float32", 0.0)
+        # per-row condition: first feature < 0
+        feat = fluid.layers.slice(x, axes=[1], starts=[0], ends=[1])
+        cond = fluid.layers.cast(
+            fluid.layers.less_than(feat, limit), "int32")
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(fluid.layers.scale(d, scale=-1.0))   # negate
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(fluid.layers.scale(d, scale=10.0))   # x10
+        out = ie()[0]   # reference contract: always a list
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.array([[1.0, 2.0], [-3.0, 4.0], [5.0, -6.0]], np.float32)
+    (res,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    expect = np.array([[10.0, 20.0], [3.0, -4.0], [50.0, -60.0]],
+                      np.float32)
+    np.testing.assert_allclose(np.asarray(res), expect, atol=1e-5)
+
+
+def test_lod_rank_table_layer_and_reorder():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        table = fluid.layers.lod_rank_table(x)
+        reordered = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+        pooled = fluid.layers.sequence_pool(reordered, "last")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    data = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lod = create_lod_tensor(data, [[1, 3, 1]])
+    (res,) = exe.run(main, feed={"x": lod}, fetch_list=[pooled])
+    # order by length desc: seq1 (len 3, last row idx3), seq0, seq2
+    np.testing.assert_allclose(np.asarray(res)[0], data[3], atol=1e-5)

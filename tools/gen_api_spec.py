@@ -1,0 +1,92 @@
+"""Generate API.spec — the frozen public-API inventory (reference
+paddle/fluid/API.spec, 413 entries, enforced by their CI diff check).
+
+Walks the stable public surface (fluid layers/optimizers/io/..., the v2
+generation, trainer_config_helpers) and records one line per callable:
+``module.name (args...)``. `tests/test_api_spec.py` regenerates and
+diffs against the committed file, so accidental API breaks fail CI the
+same way the reference's print_signatures-based check does.
+
+Usage: python tools/gen_api_spec.py > API.spec
+"""
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+MODULES = [
+    "paddle_tpu.fluid",
+    "paddle_tpu.fluid.layers",
+    "paddle_tpu.fluid.layers.control_flow",
+    "paddle_tpu.fluid.layers.detection",
+    "paddle_tpu.fluid.layers.io",
+    "paddle_tpu.fluid.layers.sequence",
+    "paddle_tpu.fluid.layers.tensor",
+    "paddle_tpu.fluid.optimizer",
+    "paddle_tpu.fluid.initializer",
+    "paddle_tpu.fluid.regularizer",
+    "paddle_tpu.fluid.clip",
+    "paddle_tpu.fluid.io",
+    "paddle_tpu.fluid.metrics",
+    "paddle_tpu.fluid.profiler",
+    "paddle_tpu.fluid.transpiler",
+    "paddle_tpu.fluid.contrib",
+    "paddle_tpu.fluid.nets",
+    "paddle_tpu.reader",
+    "paddle_tpu.v2.layer",
+    "paddle_tpu.v2.networks",
+    "paddle_tpu.v2.optimizer",
+    "paddle_tpu.v2.data_type",
+    "paddle_tpu.trainer_config_helpers",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def collect():
+    import importlib
+    lines = []
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(set(names)):
+            obj = getattr(mod, name, None)
+            if obj is None:
+                continue
+            if inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                lines.append("%s.%s.__init__ %s"
+                             % (modname, name, _sig(obj.__init__)))
+                for meth in sorted(vars(obj)):
+                    if meth.startswith("_"):
+                        continue
+                    m = getattr(obj, meth)
+                    if callable(m):
+                        lines.append("%s.%s.%s %s"
+                                     % (modname, name, meth, _sig(m)))
+            elif callable(obj):
+                lines.append("%s.%s %s" % (modname, name, _sig(obj)))
+            else:
+                lines.append("%s.%s <const>" % (modname, name))
+    return lines
+
+
+def main():
+    for line in collect():
+        print(line)
+
+
+if __name__ == "__main__":
+    main()

@@ -409,6 +409,14 @@ class RPCClient:
         return gens
 
     def _call(self, ep, msg):
+        from ..flags import FLAGS
+        if getattr(FLAGS, "enable_rpc_profiler", False):
+            from ..fluid.profiler import RecordEvent
+            with RecordEvent("rpc/%s" % msg.get("cmd", "?")):
+                return self._call_impl(ep, msg)
+        return self._call_impl(ep, msg)
+
+    def _call_impl(self, ep, msg):
         s = self._conn(ep)
         _send_msg(s, msg)
         reply = _recv_msg(s)

@@ -180,3 +180,7 @@ DEFINE_float(
 DEFINE_int(
     "dist_threadpool_size", 0,
     "Reference distributed thread pool size. Advisory.")
+DEFINE_bool(
+    "enable_rpc_profiler", False,
+    "Record every parameter-server RPC as a profiler event "
+    "(reference profiler.cc:33 FLAGS_enable_rpc_profiler).")

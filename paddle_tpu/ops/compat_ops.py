@@ -409,24 +409,19 @@ def _merge_ids(ctx):
     n = len(rows)
     rows_np = [np.asarray(r) for r in rows]
     width = rows_np[0].shape[-1]
-    # the shard order interleaves ALL Ids inputs (split_ids concatenated
-    # them); walk them in the same global order, emitting one Out per
-    # Ids input (both slots are duplicable, merge_ids_op.cc)
+    # walk the Ids inputs in the same global order split_ids concatenated
+    # them, emitting one Out per Ids input (both slots are duplicable,
+    # merge_ids_op.cc)
     counters = [0] * n
     outs = []
     for id_in in ids:
-        orig = np.asarray(id_in).reshape(-1)
-        out = np.zeros((len(orig), width), rows_np[0].dtype)
+        flat = np.asarray(id_in).reshape(-1)
+        out = np.zeros((len(flat), width), rows_np[0].dtype)
+        for k, idv in enumerate(flat):
+            s = int(idv) % n
+            out[k] = rows_np[s][counters[s]]
+            counters[s] += 1
         outs.append(out)
-    flat_positions = []
-    for t, id_in in enumerate(ids):
-        for k in range(np.asarray(id_in).reshape(-1).shape[0]):
-            flat_positions.append((t, k))
-    all_ids = np.concatenate([np.asarray(i).reshape(-1) for i in ids])
-    for (t, k), idv in zip(flat_positions, all_ids):
-        s = int(idv) % n
-        outs[t][k] = rows_np[s][counters[s]]
-        counters[s] += 1
     result = [jnp.asarray(o) for o in outs]
     return {"Out": result if len(result) > 1 else result[0]}
 

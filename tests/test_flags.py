@@ -102,3 +102,27 @@ def test_check_nan_inf_eager_per_op_attribution():
                     fetch_list=[loss])
     finally:
         fluid.set_flags({"check_nan_inf": False})
+
+
+def test_enable_rpc_profiler_records_events():
+    """FLAGS_enable_rpc_profiler (reference profiler.cc:33): RPC calls
+    appear as profiler events when the flag is on."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.rpc import VariableServer, RPCClient
+    from paddle_tpu.fluid import profiler
+
+    server = VariableServer("127.0.0.1:0").start()
+    try:
+        fluid.set_flags({"enable_rpc_profiler": True})
+        profiler.reset_profiler()
+        client = RPCClient()
+        client.put_var(server.endpoint, "w", np.ones(3, np.float32))
+        out = client.async_get_var(server.endpoint, "w")
+        np.testing.assert_allclose(np.asarray(out), np.ones(3))
+        assert any(k.startswith("rpc/")
+                   for k in profiler._host_events), \
+            list(profiler._host_events)
+    finally:
+        fluid.set_flags({"enable_rpc_profiler": False})
+        server.stop()

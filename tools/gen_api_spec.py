@@ -69,10 +69,18 @@ def collect():
             if inspect.isclass(obj):
                 lines.append("%s.%s.__init__ %s"
                              % (modname, name, _sig(obj.__init__)))
-                for meth in sorted(vars(obj)):
+                # walk dir() (the full MRO), not vars(): inherited public
+                # methods — e.g. every optimizer's minimize from the
+                # non-exported base — are part of the frozen surface too
+                for meth in sorted(dir(obj)):
                     if meth.startswith("_"):
                         continue
-                    m = getattr(obj, meth)
+                    static = inspect.getattr_static(obj, meth, None)
+                    if isinstance(static, property):
+                        lines.append("%s.%s.%s <property>"
+                                     % (modname, name, meth))
+                        continue
+                    m = getattr(obj, meth, None)
                     if callable(m):
                         lines.append("%s.%s.%s %s"
                                      % (modname, name, meth, _sig(m)))

@@ -4,6 +4,7 @@ data parallel      — ParallelExecutor / pjit batch sharding (fluid layer)
 tensor parallel    — NamedSharding on weight matrices (mesh 'model' axis)
 sequence/context   — ring_attention (ppermute ring) / ulysses (all-to-all)
 pipeline           — GPipe schedule over the 'pipe' axis
+expert parallel    — moe_ffn_sharded (top-1 dispatch, all_to_all)
 multi-host         — distributed.init_collective (jax.distributed bootstrap)
 """
 
@@ -14,6 +15,7 @@ from .ring_attention import (ring_attention, ring_attention_sharded,
 from .ulysses import ulysses_attention, ulysses_attention_sharded
 from .pipeline import pipeline_apply, pipeline_sharded
 from .sharded_embedding import shard_table, sharded_lookup
+from .moe import moe_ffn, moe_ffn_sharded, top1_dispatch
 
 __all__ = [
     "shard_table", "sharded_lookup",
@@ -22,4 +24,5 @@ __all__ = [
     "ring_attention", "ring_attention_sharded", "local_attention",
     "ulysses_attention", "ulysses_attention_sharded",
     "pipeline_apply", "pipeline_sharded",
+    "moe_ffn", "moe_ffn_sharded", "top1_dispatch",
 ]

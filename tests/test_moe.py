@@ -1,0 +1,118 @@
+"""Expert parallelism (parallel/moe.py): top-1 MoE dispatch over the
+`expert` mesh axis — exact parity against per-token reference semantics
+on the virtual 8-device mesh, plus gradient flow."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.moe import moe_ffn_sharded, top1_dispatch
+
+E = 8       # experts (1 per device on the 8-dev mesh)
+D = 6
+F = 10
+T = 64      # tokens, sharded 8 per device
+
+
+def _weights(rng):
+    gate_w = rng.randn(D, E).astype(np.float32) * 0.5
+    w_in = rng.randn(E, D, F).astype(np.float32) * 0.3
+    w_out = rng.randn(E, F, D).astype(np.float32) * 0.3
+    return gate_w, w_in, w_out
+
+
+def _reference(x, gate_w, w_in, w_out, n_shards, capacity_factor=1.25):
+    """Per-token semantics: expert = argmax softmax gate; token kept if
+    its arrival rank within (shard, expert) < capacity; output =
+    gate_prob * FFN_expert(x)."""
+    T_loc = x.shape[0] // n_shards
+    capacity = int(np.ceil(capacity_factor * T_loc / E)) or 1
+    out = np.zeros_like(x)
+    for s in range(n_shards):
+        counts = np.zeros(E, np.int64)
+        for t in range(s * T_loc, (s + 1) * T_loc):
+            logits = x[t] @ gate_w
+            p = np.exp(logits - logits.max())
+            p = p / p.sum()
+            e = int(np.argmax(p))
+            if counts[e] < capacity:
+                h = np.maximum(x[t] @ w_in[e], 0.0)
+                out[t] = p[e] * (h @ w_out[e])
+            counts[e] += 1
+    return out
+
+
+def test_moe_sharded_matches_reference_semantics():
+    rng = np.random.RandomState(0)
+    mesh = make_mesh({"expert": 8})
+    gate_w, w_in, w_out = _weights(rng)
+    x = rng.randn(T, D).astype(np.float32)
+    got = np.asarray(moe_ffn_sharded(
+        jnp.asarray(x), jnp.asarray(gate_w), jnp.asarray(w_in),
+        jnp.asarray(w_out), mesh))
+    ref = _reference(x, gate_w, w_in, w_out, n_shards=8)
+    np.testing.assert_allclose(got, ref, atol=2e-5)
+    # routing is non-degenerate: several experts active, some output mass
+    assert np.abs(got).sum() > 0
+
+
+def test_top1_dispatch_capacity_drops_overflow():
+    logits = jnp.asarray(np.tile([[5.0, 0.0, 0.0, 0.0]], (6, 1)))
+    dispatch, combine, probs = top1_dispatch(logits, 4, capacity=2)
+    d = np.asarray(dispatch)
+    # all six tokens route to expert 0; only the first two fit
+    assert d[:, 0].sum() == 2.0
+    assert d[0, 0, 0] == 1.0 and d[1, 0, 1] == 1.0
+    assert d[2:].sum() == 0.0
+
+
+def test_moe_gradients_flow_through_dispatch():
+    rng = np.random.RandomState(1)
+    mesh = make_mesh({"expert": 8})
+    gate_w, w_in, w_out = _weights(rng)
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+
+    def loss_fn(wi, wo, gw):
+        y = moe_ffn_sharded(x, gw, wi, wo, mesh)
+        return jnp.mean(jnp.square(y))
+
+    g_in, g_out, g_gate = jax.grad(loss_fn, argnums=(0, 1, 2))(
+        jnp.asarray(w_in), jnp.asarray(w_out), jnp.asarray(gate_w))
+    for g in (g_in, g_out, g_gate):
+        assert np.isfinite(np.asarray(g)).all()
+    # the expert weights that served tokens must receive gradient
+    assert float(jnp.abs(g_in).sum()) > 0
+    assert float(jnp.abs(g_out).sum()) > 0
+    # gate grads flow via the combine weights (prob-scaled outputs)
+    assert float(jnp.abs(g_gate).sum()) > 0
+
+
+def test_moe_multiple_experts_per_device():
+    """E_loc > 1: 8 experts on a 4-device expert axis exercises the
+    block-major all_to_all reshapes (a wrong ordering is invisible when
+    E_loc == 1)."""
+    rng = np.random.RandomState(2)
+    mesh = make_mesh({"expert": 4})
+    gate_w, w_in, w_out = _weights(rng)
+    x = rng.randn(T, D).astype(np.float32)
+    got = np.asarray(moe_ffn_sharded(
+        jnp.asarray(x), jnp.asarray(gate_w), jnp.asarray(w_in),
+        jnp.asarray(w_out), mesh))
+    ref = _reference(x, gate_w, w_in, w_out, n_shards=4)
+    np.testing.assert_allclose(got, ref, atol=2e-5)
+
+
+def test_top1_dispatch_bf16_ranks_do_not_collide():
+    """Rank bookkeeping must be integer: a bf16 cumsum saturates past 256
+    and collides capacity slots."""
+    T_big = 400
+    logits = jnp.asarray(
+        np.tile([[5.0, 0.0]], (T_big, 1)), dtype=jnp.bfloat16)
+    dispatch, _, _ = top1_dispatch(logits, 2, capacity=T_big)
+    d = np.asarray(dispatch, np.float32)
+    # every token gets its own slot: each occupied slot holds exactly 1
+    per_slot = d[:, 0, :].sum(axis=0)
+    assert per_slot.max() == 1.0
+    assert d[:, 0, :].sum() == T_big

@@ -13,8 +13,6 @@ beam_search op returns parent pointers, and states reorder with one
 models/machine_translation.py generation, which validates the encoding
 end to end)."""
 
-from ... import unique_name
-from ...framework import Variable
 from ...layer_helper import LayerHelper
 from ... import layers
 

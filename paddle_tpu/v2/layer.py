@@ -15,9 +15,6 @@ companions), so `integer_value_sequence` data feeds ragged samples exactly
 like the reference's sequence layers.
 """
 
-import functools
-
-from . import activation as _act_mod
 from . import data_type as _dt
 from . import pooling as _pooling
 from .attr import lower_param_attr

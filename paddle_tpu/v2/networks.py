@@ -5,7 +5,6 @@ expressed over the v2 layer DSL."""
 from . import layer as L
 from . import activation as A
 from . import pooling as P
-from .attr import ParameterAttribute
 
 __all__ = [
     "sequence_conv_pool", "simple_lstm", "simple_img_conv_pool",

@@ -4,7 +4,6 @@ square/relu/sqrt plus +, -, unary neg, and scalar *."""
 
 from .config_base import Layer
 from . import layer as v2_layer
-from ..fluid import layers as F
 
 __all__ = ["exp", "log", "abs", "sigmoid", "tanh", "square", "relu",
            "sqrt"]

@@ -15,7 +15,6 @@ import io as _io
 import numpy as np
 
 from ..fluid import executor as _executor
-from ..fluid import core as _core
 from .topology import Topology
 
 __all__ = ["Parameters", "create"]

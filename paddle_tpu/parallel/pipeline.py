@@ -60,7 +60,10 @@ def pipeline_apply(stage_fn, stage_params, microbatches, axis_name):
     outs0 = jnp.zeros((M,) + x_shape, microbatches.dtype)
     # carries become device-varying after the first tick (ppermute/rank
     # branches); mark the initial values as varying so scan types match
-    if hasattr(jax.lax, "pvary"):
+    if hasattr(jax.lax, "pcast"):          # jax >= 0.8 spelling
+        buf0 = jax.lax.pcast(buf0, axis_name, to="varying")
+        outs0 = jax.lax.pcast(outs0, axis_name, to="varying")
+    elif hasattr(jax.lax, "pvary"):
         buf0 = jax.lax.pvary(buf0, (axis_name,))
         outs0 = jax.lax.pvary(outs0, (axis_name,))
     (_, outs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(ticks))

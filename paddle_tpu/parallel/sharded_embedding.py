@@ -47,8 +47,9 @@ def sharded_lookup(table, ids, mesh, axis="model"):
     shard only."""
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+    from .mesh import get_shard_map
+    shard_map = get_shard_map()
 
     n = mesh.shape[axis]
     V = table.shape[0]

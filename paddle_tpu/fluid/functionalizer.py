@@ -140,7 +140,8 @@ HOST_OPS = frozenset([
     "checkpoint_notify", "gen_collective_id", "save", "load",
     "save_combine", "load_combine", "py_func", "prefetch",
     "sparse_table_push", "go", "channel_create", "channel_send",
-    "channel_recv", "channel_close",
+    "channel_recv", "channel_close", "generate_proposal_labels",
+    "detection_map",
 ])
 
 

@@ -18,6 +18,7 @@ from . import nn
 from . import tensor as tensor_layers
 
 __all__ = [
+    "detection_map", "generate_proposal_labels", "roi_perspective_transform",
     "prior_box", "density_prior_box", "multi_box_head", "bipartite_match",
     "target_assign", "detection_output", "ssd_loss", "iou_similarity",
     "box_coder", "roi_pool", "roi_align", "anchor_generator",
@@ -436,4 +437,92 @@ def box_clip(input, im_info, name=None):
     helper.append_op(type="box_clip",
                      inputs={"Input": input, "ImInfo": im_info},
                      outputs={"Output": out})
+    return out
+
+
+def detection_map(detect_res, label, class_num=None, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  has_state=None, input_states=None,
+                  out_states=None, ap_version="integral"):
+    """reference layers/detection.py detection_map — streaming mAP with
+    optional cross-batch accumulator state (detection_map_op.cc)."""
+    helper = LayerHelper("detection_map")
+    m_ap = helper.create_variable_for_type_inference("float32")
+    if out_states is not None:
+        # bind the caller's accumulator vars so the next batch's
+        # input_states read THIS batch's totals (streaming mAP)
+        acc_pos, acc_tp, acc_fp = out_states
+    else:
+        acc_pos = helper.create_variable_for_type_inference(
+            core.VarDesc.VarType.INT32)
+        acc_tp = helper.create_variable_for_type_inference("float32")
+        acc_fp = helper.create_variable_for_type_inference("float32")
+    inputs = {"DetectRes": detect_res, "Label": label}
+    if has_state is not None:
+        inputs["HasState"] = has_state
+    if input_states is not None:
+        inputs["PosCount"] = input_states[0]
+        inputs["TruePos"] = input_states[1]
+        inputs["FalsePos"] = input_states[2]
+    helper.append_op(
+        type="detection_map", inputs=inputs,
+        outputs={"MAP": m_ap, "AccumPosCount": acc_pos,
+                 "AccumTruePos": acc_tp, "AccumFalsePos": acc_fp},
+        attrs={"overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version,
+               "class_num": class_num,
+               "background_label": background_label},
+        infer_shape=False)
+    return m_ap
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=None, class_nums=None,
+                             use_random=True):
+    """reference layers/detection.py generate_proposal_labels — the
+    Faster-RCNN second-stage sampler (host-path op)."""
+    helper = LayerHelper("generate_proposal_labels")
+    rois = helper.create_variable_for_type_inference("float32")
+    labels = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT32)
+    targets = helper.create_variable_for_type_inference("float32")
+    inw = helper.create_variable_for_type_inference("float32")
+    outw = helper.create_variable_for_type_inference("float32")
+    inputs = {"RpnRois": rpn_rois, "GtClasses": gt_classes,
+              "GtBoxes": gt_boxes}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = is_crowd
+    if im_info is not None:
+        inputs["ImInfo"] = im_info
+    helper.append_op(
+        type="generate_proposal_labels", inputs=inputs,
+        outputs={"Rois": rois, "LabelsInt32": labels,
+                 "BboxTargets": targets, "BboxInsideWeights": inw,
+                 "BboxOutsideWeights": outw},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "use_random": use_random,
+               "class_nums": class_nums or 0},
+        infer_shape=False)
+    return rois, labels, targets, inw, outw
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    """reference layers/detection.py roi_perspective_transform."""
+    helper = LayerHelper("roi_perspective_transform")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="roi_perspective_transform",
+        inputs={"X": input, "ROIs": rois},
+        outputs={"Out": out},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale},
+        infer_shape=False)
     return out

@@ -12,7 +12,9 @@ from ..framework import default_main_program, default_startup_program
 from ..layer_helper import LayerHelper
 from .. import core
 
-__all__ = ["data", "py_reader", "batch", "double_buffer", "read_file"]
+__all__ = ["data", "py_reader", "batch", "double_buffer",
+           "read_file", "create_py_reader_by_data", "open_files",
+           "shuffle"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
@@ -56,3 +58,65 @@ def double_buffer(reader, place=None, name=None):
 
 def read_file(reader):
     return reader.output_vars
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """reference layers/io.py create_py_reader_by_data: a py_reader whose
+    slots are existing data vars."""
+    from ..reader import PyReader
+    return PyReader(capacity=capacity, feed_vars=list(feed_list),
+                    use_double_buffer=use_double_buffer)
+
+
+def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
+               buffer_size=None, pass_num=1, is_test=None):
+    """reference layers/io.py open_files: an in-graph reader over
+    recordio files. Returns a reader object whose records (serialized
+    tensor tuples written by fluid.recordio_writer) stream through the
+    py_reader queue machinery."""
+    from ..recordio_writer import recordio_reader
+    from .. import unique_name
+    # reuse py_reader's slot creation with a unique prefix: two
+    # open_files readers in one program must not collide on var names
+    reader = py_reader(capacity=buffer_size or 64, shapes=shapes,
+                       dtypes=dtypes, lod_levels=lod_levels,
+                       name=unique_name.generate("open_files"),
+                       use_double_buffer=False)
+    if isinstance(filenames, str):
+        filenames = [filenames]
+
+    def gen():
+        for _ in range(pass_num):
+            for fn in filenames:
+                for rec in recordio_reader(fn)():
+                    yield rec if isinstance(rec, tuple) else (rec,)
+
+    reader.decorate_tensor_provider(gen)
+    return reader
+
+
+def shuffle(reader, buffer_size):
+    """reference layers/io.py shuffle: wrap an in-graph reader with a
+    shuffling provider (dense analogue of shuffle_reader)."""
+    import random as _random
+    inner = getattr(reader, "_paddle_reader", None)
+    if inner is None:
+        raise ValueError("shuffle() wraps readers created by open_files/"
+                         "py_reader with a provider attached")
+
+    def shuffled():
+        buf = []
+        for item in inner():
+            buf.append(item)
+            if len(buf) >= buffer_size:
+                _random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        _random.shuffle(buf)
+        for b in buf:
+            yield b
+
+    reader.decorate_tensor_provider(shuffled)
+    return reader

@@ -9,6 +9,7 @@ from ..layer_helper import LayerHelper
 from . import tensor, nn, ops
 
 __all__ = [
+    "autoincreased_step_counter", "append_LARS",
     "exponential_decay", "natural_exp_decay", "inverse_time_decay",
     "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
     "linear_lr_warmup",
@@ -122,3 +123,56 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
         learning_rate = tensor.fill_constant([1], "float32",
                                              float(learning_rate))
     return nn.where(before, warm_lr, learning_rate)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """reference layers/nn.py autoincreased_step_counter: a persistable
+    counter advancing by `step` per run. Default name is the shared
+    @LR_DECAY_COUNTER@; pass counter_name for an independent counter."""
+    from ..layer_helper import LayerHelper
+    from ..initializer import Constant
+    name = counter_name or LR_COUNTER_NAME
+    helper = LayerHelper("global_step_counter")
+    gb = helper.main_program.global_block()
+    if gb.has_var(name):
+        counter = gb.var(name)
+    else:
+        counter = helper.create_global_variable(
+            name=name, dtype="float32", shape=[1], persistable=True,
+            stop_gradient=True)
+        helper.set_variable_initializer(counter, Constant(float(begin)
+                                                          - step))
+    gb._prepend_op(
+        type="increment", inputs={"X": [counter.name]},
+        outputs={"Out": [counter.name]}, attrs={"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """reference layers/learning_rate_scheduler.py append_LARS: per-param
+    layer-adaptive rate lr * ||w|| / (||g|| + wd * ||w||). Returns the
+    decayed learning-rate var list (one per param)."""
+    from . import nn as _nn
+    from . import ops as _ops
+
+    def _balanced_weight(param_norm, grad_norm):
+        if weight_decay == 1.0:
+            return grad_norm + param_norm
+        return grad_norm + weight_decay * param_norm
+
+    out = []
+    for param, grad in params_grads:
+        param_lr = param.optimize_attr.get("learning_rate", 1.0) \
+            if hasattr(param, "optimize_attr") else 1.0
+        param_norm = _ops.sqrt(_nn.reduce_sum(_ops.square(param)))
+        grad_norm = _ops.sqrt(_nn.reduce_sum(_ops.square(grad)))
+        scaled = _nn.scale(param_norm, scale=param_lr)
+        if isinstance(learning_rate, (int, float)):
+            scaled = _nn.scale(scaled, scale=float(learning_rate))
+        else:   # a decay-scheduler Variable
+            scaled = _nn.elementwise_mul(scaled, learning_rate)
+        decayed_lr = _nn.elementwise_div(
+            scaled, _balanced_weight(param_norm, grad_norm))
+        out.append(decayed_lr)
+    return out

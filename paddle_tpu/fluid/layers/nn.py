@@ -14,6 +14,7 @@ from ..initializer import Constant, NormalInitializer
 from .. import core
 
 __all__ = [
+    "add_position_encoding", "similarity_focus", "hash", "stanh", "image_resize_short", "lod_reset", "logical_and", "logical_or", "logical_xor", "lstm_unit",
     "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose", "pool2d",
     "batch_norm", "layer_norm", "group_norm", "dropout", "softmax",
     "cross_entropy", "softmax_with_cross_entropy",
@@ -1430,3 +1431,121 @@ def flash_attention(q, k, v, num_heads=1, causal=False, name=None):
                      attrs={"num_heads": int(num_heads),
                             "causal": bool(causal)})
     return out
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """reference layers/nn.py add_position_encoding (sinusoidal)."""
+    helper = LayerHelper("add_position_encoding", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="add_position_encoding", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"alpha": float(alpha), "beta": float(beta)})
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference layers/nn.py similarity_focus."""
+    helper = LayerHelper("similarity_focus", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="similarity_focus", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"axis": int(axis),
+                            "indexes": [int(i) for i in indexes]})
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """reference layers/nn.py hash (hash_op.cc: xxhash-mod buckets)."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT64)
+    helper.append_op(type="hash", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"num_hash": int(num_hash),
+                            "mod_by": int(hash_size)},
+                     infer_shape=False)
+    return out
+
+
+def stanh(x, scale_a=2.0 / 3.0, scale_b=1.7159, name=None):
+    """reference scaled tanh activation layer."""
+    helper = LayerHelper("stanh", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="stanh", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"scale_a": float(scale_a),
+                            "scale_b": float(scale_b)})
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """reference layers/nn.py image_resize_short: resize so the SHORT
+    edge equals out_short_len, preserving aspect ratio."""
+    in_shape = input.shape
+    h, w = int(in_shape[2]), int(in_shape[3])
+    short = min(h, w)
+    out_h = int(round(h * out_short_len / short))
+    out_w = int(round(w * out_short_len / short))
+    return image_resize(input, out_shape=[out_h, out_w],
+                        resample=resample)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """reference layers/nn.py lod_reset: re-seat x's LoD from y (or a
+    static target_lod). Dense encoding: the value passes through and the
+    @LOD_LEN companion re-derives from y."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = 1
+    inputs = {"X": x}
+    if y is not None:
+        inputs["Y"] = y
+    helper.append_op(type="lod_reset", inputs=inputs,
+                     outputs={"Out": out},
+                     attrs={"target_lod": target_lod or []},
+                     infer_shape=False)
+    out.shape = tuple(x.shape)
+    return out
+
+
+def _logical_binary(op_type, x, y, out=None, name=None):
+    helper = LayerHelper(op_type, name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            core.VarDesc.VarType.BOOL)
+    helper.append_op(type=op_type, inputs={"X": x, "Y": y},
+                     outputs={"Out": out})
+    return out
+
+
+def logical_and(x, y, out=None, name=None):
+    return _logical_binary("logical_and", x, y, out, name)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _logical_binary("logical_or", x, y, out, name)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _logical_binary("logical_xor", x, y, out, name)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference layers/nn.py lstm_unit: one LSTM step — fc([x, h_prev])
+    to 4D gates, then the lstm_unit op's cell update. Returns (h, c)."""
+    helper = LayerHelper("lstm_unit_layer", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    size = int(cell_t_prev.shape[-1])
+    concat_in = concat([x_t, hidden_t_prev], axis=1)
+    fc_out = fc(concat_in, size=4 * size, param_attr=helper.param_attr,
+                bias_attr=helper.bias_attr, num_flatten_dims=1)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": fc_out, "C_prev": cell_t_prev},
+                     outputs={"H": h, "C": c},
+                     attrs={"forget_bias": float(forget_bias)},
+                     infer_shape=False)
+    h.shape = tuple(cell_t_prev.shape)
+    c.shape = tuple(cell_t_prev.shape)
+    return h, c

@@ -8,6 +8,7 @@ from ..initializer import Constant
 from .. import core
 
 __all__ = [
+    "sum", "tensor_array_to_tensor",
     "create_tensor", "create_parameter", "create_global_var", "cast",
     "concat", "sums", "assign", "fill_constant",
     "fill_constant_batch_size_like", "ones", "zeros", "zeros_like",
@@ -219,3 +220,24 @@ def argmax(x, axis=0):
 def argsort(x, axis=-1, name=None):
     from . import nn
     return nn.argsort(x, axis, name)
+
+
+def sum(x):
+    """reference layers/tensor.py sum: elementwise sum of a tensor list
+    (the sum op the backward pass also uses for grad accumulation)."""
+    return sums(x if isinstance(x, (list, tuple)) else [x])
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """reference layers/tensor.py tensor_array_to_tensor: concat (or
+    stack) the entries of a LoDTensorArray. Returns (out, index)."""
+    helper = LayerHelper("tensor_array_to_tensor")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    index = helper.create_variable_for_type_inference(
+        core.VarDesc.VarType.INT32)
+    helper.append_op(type="tensor_array_to_tensor",
+                     inputs={"X": input},
+                     outputs={"Out": out, "OutIndex": index},
+                     attrs={"axis": axis, "use_stack": use_stack},
+                     infer_shape=False)
+    return out, index

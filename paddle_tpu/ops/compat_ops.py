@@ -532,9 +532,11 @@ def _detection_map(ctx):
 
     # classes seen in EITHER labels or detections: a detection of a class
     # with no ground truth anywhere in the batch must still count as a
-    # false positive (detection_map_op.h CalcTrueAndFalsePositive)
-    classes = sorted(set(gt[:, 0].astype(int))
-                     | set(det[:, 0].astype(int)))
+    # false positive (detection_map_op.h CalcTrueAndFalsePositive).
+    # The background class never scores.
+    background = int(ctx.attr("background_label", 0))
+    classes = sorted((set(gt[:, 0].astype(int))
+                      | set(det[:, 0].astype(int))) - {background})
     d_off = np.concatenate([[0], np.cumsum(det_lens)]).astype(int)
     g_off = np.concatenate([[0], np.cumsum(gt_lens)]).astype(int)
     for c in classes:

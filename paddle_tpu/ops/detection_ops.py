@@ -888,3 +888,220 @@ def _box_clip(ctx):
                          jnp.clip(boxes[..., 2], 0, w),
                          jnp.clip(boxes[..., 3], 0, h)], axis=-1)
     return {"Output": out}
+
+
+@register_op("roi_perspective_transform")
+def _roi_perspective_transform(ctx):
+    """detection/roi_perspective_transform_op.cc: each ROI is a
+    quadrilateral (8 coords, clockwise from top-left); the op warps it to
+    a [transformed_height, transformed_width] rectangle with bilinear
+    sampling via the standard 4-point homography."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")          # [B, C, H, W]
+    rois = ctx.input("ROIs")    # [N, 8]
+    lens = ctx.lod_len("ROIs")
+    out_h = int(ctx.attr("transformed_height", 8))
+    out_w = int(ctx.attr("transformed_width", 8))
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    B, C, H, W = x.shape
+    N = rois.shape[0]
+    if lens is None:
+        batch_idx = jnp.zeros((N,), jnp.int32)
+    else:
+        batch_idx = _roi_batch_index(lens, N)
+
+    quad = rois.reshape(N, 4, 2) * scale     # [N, 4, (x,y)]
+    # homography: solve the 8x8 system mapping unit square corners to quad
+    # (u,v) in [0,1]^2 -> (x,y); dst corners order: tl, tr, br, bl
+    src = jnp.asarray([[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0]],
+                      x.dtype)
+
+    def solve_h(q):
+        rows = []
+        rhs = []
+        for i in range(4):
+            u, v = src[i, 0], src[i, 1]
+            xx, yy = q[i, 0], q[i, 1]
+            rows.append(jnp.stack([u, v, 1.0, 0.0, 0.0, 0.0,
+                                   -u * xx, -v * xx]))
+            rhs.append(xx)
+            rows.append(jnp.stack([0.0, 0.0, 0.0, u, v, 1.0,
+                                   -u * yy, -v * yy]))
+            rhs.append(yy)
+        A = jnp.stack(rows)
+        b = jnp.stack(rhs)
+        h = jnp.linalg.solve(A, b)
+        return jnp.concatenate([h, jnp.ones(1, h.dtype)]).reshape(3, 3)
+
+    Hm = jax.vmap(solve_h)(quad)            # [N, 3, 3]
+    u = (jnp.arange(out_w, dtype=x.dtype) + 0.5) / out_w
+    v = (jnp.arange(out_h, dtype=x.dtype) + 0.5) / out_h
+    uu, vv = jnp.meshgrid(u, v)             # [out_h, out_w]
+    grid = jnp.stack([uu, vv, jnp.ones_like(uu)], axis=-1)  # [h, w, 3]
+    mapped = jnp.einsum("nij,hwj->nhwi", Hm, grid)
+    px = mapped[..., 0] / jnp.maximum(mapped[..., 2], 1e-8)
+    py = mapped[..., 1] / jnp.maximum(mapped[..., 2], 1e-8)
+
+    def sample(img, sx, sy):
+        # img [C, H, W]; sx/sy [h, w] source coords; bilinear w/ border 0
+        x0 = jnp.floor(sx)
+        y0 = jnp.floor(sy)
+        wx = sx - x0
+        wy = sy - y0
+        val = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                xi = jnp.clip(x0 + dx, 0, W - 1).astype(jnp.int32)
+                yi = jnp.clip(y0 + dy, 0, H - 1).astype(jnp.int32)
+                wgt = ((wx if dx else 1 - wx) * (wy if dy else 1 - wy))
+                inb = ((x0 + dx >= 0) & (x0 + dx <= W - 1) &
+                       (y0 + dy >= 0) & (y0 + dy <= H - 1))
+                val = val + img[:, yi, xi] * (wgt * inb)[None]
+        return val
+
+    imgs = jnp.take(x, batch_idx, axis=0)   # [N, C, H, W]
+    out = jax.vmap(sample)(imgs, px, py)    # [N, C, h, w]
+    return {"Out": out}
+
+
+def _roi_batch_index(lens, N):
+    jnp = _jnp()
+    # rois are grouped by image with per-image counts `lens`
+    ends = jnp.cumsum(lens)
+    idx = jnp.sum(jnp.arange(N)[:, None] >= ends[None, :], axis=1)
+    return idx.astype(jnp.int32)
+
+
+@register_op("generate_proposal_labels")
+def _generate_proposal_labels(ctx):
+    """detection/generate_proposal_labels_op.cc: Faster-RCNN second-stage
+    sampler — label RPN proposals against ground truth, subsample a fixed
+    foreground fraction, emit regression targets. Data-dependent output
+    sizes: host/eager path (the reference runs it on CPU too)."""
+    import jax
+    jnp = _jnp()
+    rois = ctx.input("RpnRois")
+    gt_classes = ctx.input("GtClasses")
+    gt_boxes = ctx.input("GtBoxes")
+    if any(isinstance(v, jax.core.Tracer)
+           for v in (rois, gt_classes, gt_boxes)):
+        raise NotImplementedError(
+            "generate_proposal_labels has data-dependent output shapes — "
+            "host path only (reference runs it as a CPU kernel)")
+    rois = np.asarray(rois).reshape(-1, 4)
+    gtc = np.asarray(gt_classes).reshape(-1)
+    gtb = np.asarray(gt_boxes).reshape(-1, 4)
+    is_crowd_in = ctx.input("IsCrowd")
+    crowd = (np.asarray(is_crowd_in).reshape(-1).astype(bool)
+             if is_crowd_in is not None else np.zeros(len(gtb), bool))
+    batch_size = int(ctx.attr("batch_size_per_im", 256))
+    fg_frac = float(ctx.attr("fg_fraction", 0.25))
+    fg_thresh = float(ctx.attr("fg_thresh", 0.5))
+    bg_hi = float(ctx.attr("bg_thresh_hi", 0.5))
+    bg_lo = float(ctx.attr("bg_thresh_lo", 0.0))
+    use_random = bool(ctx.attr("use_random", True))
+    class_nums = int(ctx.attr("class_nums", 0) or 0)
+    # resample every step: fold the executor's step counter into the rng
+    rng = np.random.RandomState(
+        (int(ctx.attr("seed", 0) or 0) + int(getattr(ctx, "step", 0)))
+        & 0x7FFFFFFF)
+
+    # per-image segmentation from the LoD companions (flattening the
+    # batch would match proposals against other images' ground truth)
+    roi_lens = ctx.lod_len("RpnRois")
+    gt_lens = ctx.lod_len("GtBoxes")
+    roi_lens = (np.asarray(roi_lens).astype(int)
+                if roi_lens is not None else np.array([len(rois)]))
+    gt_lens = (np.asarray(gt_lens).astype(int)
+               if gt_lens is not None else np.array([len(gtb)]))
+    r_off = np.concatenate([[0], np.cumsum(roi_lens)])
+    g_off = np.concatenate([[0], np.cumsum(gt_lens)])
+
+    def iou_mat(a, b):
+        ax1, ay1, ax2, ay2 = a[:, 0, None], a[:, 1, None], \
+            a[:, 2, None], a[:, 3, None]
+        bx1, by1, bx2, by2 = b[None, :, 0], b[None, :, 1], \
+            b[None, :, 2], b[None, :, 3]
+        iw = np.maximum(np.minimum(ax2, bx2) - np.maximum(ax1, bx1), 0)
+        ih = np.maximum(np.minimum(ay2, by2) - np.maximum(ay1, by1), 0)
+        inter = iw * ih
+        ua = ((ax2 - ax1) * (ay2 - ay1)
+              + (bx2 - bx1) * (by2 - by1) - inter)
+        return inter / np.maximum(ua, 1e-9)
+
+    all_rois, all_labels, all_t, all_in, all_out, out_lens = \
+        [], [], [], [], [], []
+    for im in range(len(roi_lens)):
+        rois_i = rois[r_off[im]:r_off[im + 1]]
+        gtb_i = gtb[g_off[im]:g_off[im + 1]]
+        gtc_i = gtc[g_off[im]:g_off[im + 1]]
+        crowd_i = crowd[g_off[im]:g_off[im + 1]] \
+            if len(crowd) >= g_off[im + 1] else \
+            np.zeros(len(gtb_i), bool)
+        # crowd regions never serve as match targets
+        match_b = gtb_i[~crowd_i]
+        match_c = gtc_i[~crowd_i]
+        cand = np.concatenate([rois_i, match_b], axis=0)
+        overlaps = iou_mat(cand, match_b) if len(match_b) else \
+            np.zeros((len(cand), 0))
+        max_ov = overlaps.max(axis=1) if overlaps.size else \
+            np.zeros(len(cand))
+        argmax_ov = overlaps.argmax(axis=1) if overlaps.size else \
+            np.zeros(len(cand), np.int64)
+
+        fg = np.where(max_ov >= fg_thresh)[0]
+        bg = np.where((max_ov < bg_hi) & (max_ov >= bg_lo))[0]
+        n_fg = min(int(batch_size * fg_frac), len(fg))
+        if len(fg) > n_fg:
+            fg = rng.choice(fg, n_fg, replace=False) if use_random \
+                else fg[:n_fg]
+        n_bg = min(batch_size - n_fg, len(bg))
+        if len(bg) > n_bg:
+            bg = rng.choice(bg, n_bg, replace=False) if use_random \
+                else bg[:n_bg]
+        keep = np.concatenate([fg, bg]).astype(np.int64)
+
+        labels = np.zeros(len(keep), np.int32)
+        if len(match_b):
+            labels[:len(fg)] = match_c[argmax_ov[fg]].astype(np.int32)
+        targets4 = np.zeros((len(keep), 4), np.float32)
+        if len(fg) and len(match_b):
+            p = cand[fg]
+            g = match_b[argmax_ov[fg]]
+            pw = np.maximum(p[:, 2] - p[:, 0], 1e-6)
+            ph = np.maximum(p[:, 3] - p[:, 1], 1e-6)
+            gw = np.maximum(g[:, 2] - g[:, 0], 1e-6)
+            gh = np.maximum(g[:, 3] - g[:, 1], 1e-6)
+            targets4[:len(fg), 0] = ((g[:, 0] + g[:, 2]) / 2
+                                     - (p[:, 0] + p[:, 2]) / 2) / pw
+            targets4[:len(fg), 1] = ((g[:, 1] + g[:, 3]) / 2
+                                     - (p[:, 1] + p[:, 3]) / 2) / ph
+            targets4[:len(fg), 2] = np.log(gw / pw)
+            targets4[:len(fg), 3] = np.log(gh / ph)
+        width = 4 * class_nums if class_nums else 4
+        targets = np.zeros((len(keep), width), np.float32)
+        inside = np.zeros((len(keep), width), np.float32)
+        if class_nums:
+            # class-expanded layout (bbox_util: one 4-slot per class)
+            for k in range(len(fg)):
+                c = int(labels[k])
+                targets[k, 4 * c:4 * c + 4] = targets4[k]
+                inside[k, 4 * c:4 * c + 4] = 1.0
+        else:
+            targets[:] = targets4
+            inside[:len(fg)] = 1.0
+        all_rois.append(cand[keep].astype(np.float32))
+        all_labels.append(labels)
+        all_t.append(targets)
+        all_in.append(inside)
+        all_out.append(inside.copy())
+        out_lens.append(len(keep))
+
+    return {"Rois": jnp.asarray(np.concatenate(all_rois)),
+            "LabelsInt32": jnp.asarray(
+                np.concatenate(all_labels).reshape(-1, 1)),
+            "BboxTargets": jnp.asarray(np.concatenate(all_t)),
+            "BboxInsideWeights": jnp.asarray(np.concatenate(all_in)),
+            "BboxOutsideWeights": jnp.asarray(np.concatenate(all_out)),
+            "Rois@LOD_LEN": jnp.asarray(np.asarray(out_lens, np.int32))}

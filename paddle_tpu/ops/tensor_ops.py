@@ -438,9 +438,45 @@ def _pixel_shuffle(ctx):
 
 @register_op("lod_reset")
 def _lod_reset(ctx):
-    # LoD metadata is carried outside the traced values (see fluid/lod.py);
-    # dense value passes through unchanged.
-    return {"Out": ctx.input("X")}
+    """lod_reset_op.cc: re-segment X's rows by Y's LoD (or target_lod).
+    Dense encoding: compact X's valid rows (static-shape scatter via a
+    cumsum of the mask), then regroup them into Y's padded layout."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    xlens = ctx.lod_len("X")
+    ylens = ctx.lod_len("Y")
+    if ylens is None:
+        target = ctx.attr("target_lod", [])
+        if not target:
+            return {"Out": x}
+        offsets = np.asarray(target, np.int64)
+        ylens = jnp.asarray(offsets[1:] - offsets[:-1], jnp.int32)
+    # 1) compact X's valid rows into flat [N, ...] (row-major order)
+    if x.ndim >= 3 or xlens is not None:
+        B_x, T_x = x.shape[0], x.shape[1]
+        if xlens is None:
+            xlens = jnp.full((B_x,), T_x, jnp.int32)
+        mask = (jnp.arange(T_x)[None, :] < xlens[:, None]).reshape(-1)
+        rows = x.reshape((B_x * T_x,) + tuple(x.shape[2:]))
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        dest = jnp.where(mask, pos, B_x * T_x)   # out-of-range -> dropped
+        flat = jnp.zeros_like(rows).at[dest].set(rows, mode="drop")
+    else:
+        flat = x                                # [N, ...] already flat
+    # 2) regroup into Y's padded [B_y, T_y, ...] layout (T_y static: Y's
+    # padded time axis, else the flat row count)
+    B_y = ylens.shape[0]
+    y = ctx.input("Y")
+    T_y = y.shape[1] if (y is not None and y.ndim >= 3) \
+        else int(flat.shape[0])
+    off = jnp.cumsum(ylens) - ylens             # exclusive offsets
+    t = jnp.arange(T_y)[None, :]
+    idx = (off[:, None] + t).clip(0, flat.shape[0] - 1)
+    out = jnp.take(flat, idx.reshape(-1), axis=0).reshape(
+        (B_y, T_y) + tuple(flat.shape[1:]))
+    m = (t < ylens[:, None]).reshape(
+        (B_y, T_y) + (1,) * (flat.ndim - 1)).astype(out.dtype)
+    return {"Out": out * m, "Out@LOD_LEN": ylens}
 
 
 @register_op("is_empty")

@@ -1,0 +1,222 @@
+"""Layer-API parity tail (the last reference fluid.layers names):
+add_position_encoding, similarity_focus, hash, stanh, lod_reset,
+logical_*, lstm_unit, sum, tensor_array_to_tensor, image_resize_short,
+detection_map / generate_proposal_labels / roi_perspective_transform,
+open_files / shuffle, autoincreased_step_counter / append_LARS."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.lod import create_lod_tensor
+
+
+def _run(build, feeds=None, n_fetch=1):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fetch = outs if isinstance(outs, (list, tuple)) else [outs]
+    return exe.run(main, feed=feeds or {}, fetch_list=list(fetch))
+
+
+def test_positional_encoding_stanh_logical_sum():
+    def build():
+        x = fluid.layers.data("x", shape=[4, 6])
+        pe = fluid.layers.add_position_encoding(x, alpha=1.0, beta=1.0)
+        st = fluid.layers.stanh(x)
+        a = fluid.layers.data("a", shape=[2], dtype="bool")
+        b = fluid.layers.data("b", shape=[2], dtype="bool")
+        land = fluid.layers.logical_and(a, b)
+        lor = fluid.layers.logical_or(a, b)
+        s = fluid.layers.sum([x, x])
+        return pe, st, land, lor, s
+
+    xv = np.zeros((1, 4, 6), np.float32)
+    av = np.array([[True, False]])
+    bv = np.array([[True, True]])
+    pe, st, land, lor, s = _run(build, {"x": xv, "a": av, "b": bv})
+    np.testing.assert_allclose(np.asarray(pe)[0, 0, 3:],
+                               np.ones(3), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(st), np.zeros_like(xv),
+                               atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(land)[0], [True, False])
+    np.testing.assert_array_equal(np.asarray(lor)[0], [True, True])
+    np.testing.assert_allclose(np.asarray(s), 2 * xv, atol=1e-6)
+
+
+def test_hash_and_similarity_focus_layers():
+    def build():
+        ids = fluid.layers.data("ids", shape=[2], dtype="int64")
+        h = fluid.layers.hash(ids, hash_size=100, num_hash=2)
+        img = fluid.layers.data("img", shape=[1, 2, 2])
+        sf = fluid.layers.similarity_focus(img, axis=1, indexes=[0])
+        return h, sf
+
+    ids = np.array([[3, 7], [3, 7]], np.int64)
+    img = np.array([[[[3.0, 2.0], [1.0, 0.0]]]], np.float32)
+    h, sf = _run(build, {"ids": ids, "img": img})
+    h = np.asarray(h)
+    # reference hash output layout: [N, num_hash, 1]
+    assert h.shape[-2:] == (2, 1) and (h >= 0).all() and (h < 100).all()
+    # same input rows -> same hashes (deterministic)
+    np.testing.assert_array_equal(h[0], h[1])
+    np.testing.assert_allclose(np.asarray(sf)[0, 0],
+                               [[1, 0], [0, 1]], atol=1e-6)
+
+
+def test_lstm_unit_layer_steps_state():
+    def build():
+        x = fluid.layers.data("x", shape=[3])
+        h0 = fluid.layers.data("h0", shape=[5])
+        c0 = fluid.layers.data("c0", shape=[5])
+        h, c = fluid.layers.lstm_unit(x, h0, c0, forget_bias=1.0)
+        return h, c
+
+    rng = np.random.RandomState(0)
+    h, c = _run(build, {"x": rng.randn(2, 3).astype(np.float32),
+                        "h0": np.zeros((2, 5), np.float32),
+                        "c0": np.zeros((2, 5), np.float32)})
+    assert np.asarray(h).shape == (2, 5)
+    assert np.isfinite(np.asarray(c)).all()
+
+
+def test_lod_reset_reseats_lengths():
+    def build():
+        x = fluid.layers.data("x", shape=[2], lod_level=1)
+        y = fluid.layers.data("y", shape=[1], lod_level=1)
+        r = fluid.layers.lod_reset(x, y)
+        return fluid.layers.sequence_pool(r, "sum")
+
+    data = np.ones((4, 2), np.float32)
+    x = create_lod_tensor(data, [[2, 2]])
+    y = create_lod_tensor(np.zeros((4, 1), np.float32), [[1, 3]])
+    (pooled,) = _run(build, {"x": x, "y": y})
+    # after reset to lengths [1, 3]: sums are 1 row and 3 rows
+    np.testing.assert_allclose(np.asarray(pooled),
+                               [[1, 1], [3, 3]], atol=1e-5)
+
+
+def test_roi_perspective_transform_identity_quad():
+    def build():
+        img = fluid.layers.data("img", shape=[1, 4, 4])
+        rois = fluid.layers.data("rois", shape=[8])
+        return fluid.layers.roi_perspective_transform(img, rois, 4, 4)
+
+    img = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # axis-aligned quad covering the full image
+    rois = np.array([[0, 0, 4, 0, 4, 4, 0, 4]], np.float32)
+    (out,) = _run(build, {"img": img, "rois": rois})
+    out = np.asarray(out)
+    assert out.shape == (1, 1, 4, 4)
+    # identity-ish warp on interior cells (borders zero-pad): values
+    # increase left-to-right and top-to-bottom
+    assert out[0, 0, 1, 1] < out[0, 0, 1, 2]
+    assert out[0, 0, 1, 1] < out[0, 0, 2, 1]
+
+
+def test_generate_proposal_labels_samples():
+    def build():
+        rois = fluid.layers.data("rois", shape=[4])
+        gtc = fluid.layers.data("gtc", shape=[1], dtype="int64")
+        gtb = fluid.layers.data("gtb", shape=[4])
+        return fluid.layers.generate_proposal_labels(
+            rois, gtc, None, gtb, batch_size_per_im=8,
+            fg_fraction=0.5, fg_thresh=0.5)[0:2]
+
+    rois = np.array([[0, 0, 10, 10], [0, 0, 9, 9], [50, 50, 60, 60]],
+                    np.float32)
+    gtc = np.array([[3]], np.int64)
+    gtb = np.array([[0, 0, 10, 10]], np.float32)
+    out_rois, labels = _run(build, {"rois": rois, "gtc": gtc,
+                                    "gtb": gtb})
+    labels = np.asarray(labels).reshape(-1)
+    assert (labels == 3).sum() >= 1          # fg got the gt class
+    assert (labels == 0).sum() >= 1          # bg sampled too
+
+
+def test_open_files_and_shuffle_roundtrip(tmp_path):
+    from paddle_tpu.fluid.recordio_writer import \
+        convert_reader_to_recordio_file
+
+    path = str(tmp_path / "data.recordio")
+
+    def samples():
+        for i in range(6):
+            yield (np.full((2,), i, np.float32),)
+
+    convert_reader_to_recordio_file(path, samples)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.open_files(
+            [path], shapes=[[-1, 2]], lod_levels=[0],
+            dtypes=["float32"])
+        reader = fluid.layers.shuffle(reader, buffer_size=6)
+        slot = reader.output_vars[0]
+        out = fluid.layers.scale(slot, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    reader.start()
+    seen = []
+    for _ in range(6):
+        (v,) = exe.run(main, feed=reader.next_feed(), fetch_list=[out])
+        seen.append(float(np.asarray(v).ravel()[0]))
+    reader.reset()
+    assert sorted(seen) == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_step_counter_and_append_LARS():
+    def build():
+        x = fluid.layers.data("x", shape=[2])
+        ctr = fluid.layers.autoincreased_step_counter()
+        w = fluid.layers.create_parameter([2, 2], "float32", name="lw")
+        g = fluid.layers.scale(w, scale=0.1)
+        lrs = fluid.layers.append_LARS([(w, g)], learning_rate=0.5,
+                                       weight_decay=0.01)
+        return ctr, lrs[0]
+
+    ctr, lr = _run(build, {"x": np.zeros((1, 2), np.float32)})
+    assert np.isfinite(np.asarray(lr)).all()
+
+
+def test_generate_proposal_labels_per_image_segmentation():
+    """Batch of 2 images via LoD: proposals must only match ground truth
+    from their OWN image, and crowd gt never serves as a target."""
+    def build():
+        rois = fluid.layers.data("rois", shape=[4], lod_level=1)
+        gtc = fluid.layers.data("gtc", shape=[1], dtype="int64",
+                                lod_level=1)
+        crowd = fluid.layers.data("crowd", shape=[1], dtype="int64",
+                                  lod_level=1)
+        gtb = fluid.layers.data("gtb", shape=[4], lod_level=1)
+        return fluid.layers.generate_proposal_labels(
+            rois, gtc, crowd, gtb, batch_size_per_im=8,
+            fg_fraction=0.5, fg_thresh=0.5)[0:2]
+
+    # image 0: roi overlapping IMAGE 1's gt location but not its own
+    rois = create_lod_tensor(
+        np.array([[50, 50, 60, 60],       # img0 roi (matches img1's gt!)
+                  [0, 0, 10, 10]],        # img1 roi (matches img1 gt? no)
+                 np.float32), [[1, 1]])
+    gtb = create_lod_tensor(
+        np.array([[0, 0, 10, 10],         # img0 gt at origin
+                  [50, 50, 60, 60]],      # img1 gt at 50..60
+                 np.float32), [[1, 1]])
+    gtc = create_lod_tensor(
+        np.array([[3], [7]], np.int64), [[1, 1]])
+    crowd = create_lod_tensor(
+        np.array([[0], [0]], np.int64), [[1, 1]])
+    out_rois, labels = _run(build, {"rois": rois, "gtb": gtb,
+                                    "gtc": gtc, "crowd": crowd})
+    labels = np.asarray(labels).reshape(-1)
+    # cross-image matches are impossible: neither sampled roi may carry
+    # the OTHER image's class via its roi (gt boxes join their own pool,
+    # so classes 3 and 7 appear only via same-image candidates)
+    assert set(labels.tolist()) <= {0, 3, 7}
+    # img0's roi at (50,50) must NOT be labeled 7 (that gt is in img1)
+    rois_np = np.asarray(out_rois)
+    for r, l in zip(rois_np, labels):
+        if l == 7:
+            # any class-7 row must be img1's own candidate (gt join)
+            assert r[0] >= 50

@@ -81,6 +81,13 @@ def _append_grad_ops(block, path_ops, grad_map, no_grad_set):
         if not parts:
             return
         gname = grad_var_name(name)
+        if not block.has_var(gname):
+            # partials may carry custom names (maker-produced, e.g.
+            # @WHILE): the canonical grad var must exist for the
+            # assign/sum below and for params_and_grads collection
+            v = block._find_var_recursive(name)
+            if v is not None:
+                _create_grad_var(block, v, gname)
         if len(parts) == 1:
             if parts[0] != gname:
                 block.append_op(type="assign", inputs={"X": parts[0]},
@@ -105,8 +112,11 @@ def _append_grad_ops(block, path_ops, grad_map, no_grad_set):
         if od is not None and od.grad_maker is not None:
             # a maker returning None declines (falls back to the generic
             # vjp-based grad op) — e.g. lookup_table only goes sparse when
-            # is_sparse is set and the table has a single consumer
-            made = od.grad_maker(op, block, grad_map, no_grad_set)
+            # is_sparse is set and the table has a single consumer.
+            # Makers join the fan-in protocol through `bw_ctx`.
+            bw_ctx = {"pending": pending, "partials": partials}
+            made = od.grad_maker(op, block, grad_map, no_grad_set,
+                                 bw_ctx)
             if made is not None:
                 for name in set(op.input_arg_names) - \
                         set(op.output_arg_names):

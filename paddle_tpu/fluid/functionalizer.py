@@ -141,7 +141,7 @@ HOST_OPS = frozenset([
     "save_combine", "load_combine", "py_func", "prefetch",
     "sparse_table_push", "go", "channel_create", "channel_send",
     "channel_recv", "channel_close", "generate_proposal_labels",
-    "detection_map",
+    "detection_map", "while_grad_dynamic",
 ])
 
 

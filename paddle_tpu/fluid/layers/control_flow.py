@@ -195,10 +195,11 @@ class While:
 
     def __init__(self, cond, is_test=False, name=None, max_iters=None):
         """max_iters: static trip-count bound. When set (and not is_test)
-        the loop lowers to a bounded masked lax.scan, which makes it
-        DIFFERENTIABLE — append_backward can train through the loop
-        (reference while_grad, while_op.cc:119). Without it the loop
-        lowers to lax.while_loop: dynamic trip count, forward-only."""
+        the loop lowers to a bounded masked lax.scan, differentiable
+        in-graph (reference while_grad, while_op.cc:119). Without it the
+        loop lowers to lax.while_loop; backward then uses the replay-based
+        while_grad_dynamic op on the host execution path — dynamic trip
+        counts train too, at the cost of eager execution."""
         self.helper = LayerHelper("while", name=name)
         self.status = While.BEFORE_WHILE_BLOCK
         if cond.dtype != core.VarDesc.VarType.BOOL:

@@ -21,22 +21,10 @@ LR_COUNTER_NAME = "@LR_DECAY_COUNTER@"
 def _decay_step_counter(begin=0):
     """Persistable int step counter incremented once per program run.
     Reference: layers/learning_rate_scheduler.py autoincreased_step_counter;
-    the ParallelExecutor honors the same var name (parallel_executor.cc:259)."""
-    helper = LayerHelper("global_step_counter")
-    gb = helper.main_program.global_block()
-    if gb.has_var(LR_COUNTER_NAME):
-        counter = gb.var(LR_COUNTER_NAME)
-    else:
-        counter = helper.create_global_variable(
-            name=LR_COUNTER_NAME, dtype="float32", shape=[1],
-            persistable=True, stop_gradient=True)
-        from ..initializer import Constant
-        helper.set_variable_initializer(counter, Constant(float(begin - 1)))
-    helper.main_program.global_block()._prepend_op(
-        type="increment", inputs={"X": [counter.name]},
-        outputs={"Out": [counter.name]}, attrs={"step": 1.0})
-    counter.stop_gradient = True
-    return counter
+    the ParallelExecutor honors the same var name (parallel_executor.cc:259).
+    Delegates to autoincreased_step_counter on the shared LR counter."""
+    return autoincreased_step_counter(counter_name=LR_COUNTER_NAME,
+                                      begin=begin, step=1)
 
 
 def noam_decay(d_model, warmup_steps):
@@ -127,21 +115,23 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
 
 def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     """reference layers/nn.py autoincreased_step_counter: a persistable
-    counter advancing by `step` per run. Default name is the shared
-    @LR_DECAY_COUNTER@; pass counter_name for an independent counter."""
+    counter advancing by `step` per run. The increment op is emitted only
+    on FIRST creation — later calls (or LR schedules sharing the counter)
+    reuse the var without double-stepping it. Default name is the
+    dedicated @STEP_COUNTER@ (the LR schedules use @LR_DECAY_COUNTER@)."""
     from ..layer_helper import LayerHelper
     from ..initializer import Constant
-    name = counter_name or LR_COUNTER_NAME
+    name = counter_name or "@STEP_COUNTER@"
     helper = LayerHelper("global_step_counter")
     gb = helper.main_program.global_block()
     if gb.has_var(name):
         counter = gb.var(name)
-    else:
-        counter = helper.create_global_variable(
-            name=name, dtype="float32", shape=[1], persistable=True,
-            stop_gradient=True)
-        helper.set_variable_initializer(counter, Constant(float(begin)
-                                                          - step))
+        counter.stop_gradient = True
+        return counter
+    counter = helper.create_global_variable(
+        name=name, dtype="float32", shape=[1], persistable=True,
+        stop_gradient=True)
+    helper.set_variable_initializer(counter, Constant(float(begin) - step))
     gb._prepend_op(
         type="increment", inputs={"X": [counter.name]},
         outputs={"Out": [counter.name]}, attrs={"step": float(step)})

@@ -15,9 +15,12 @@ functionalizer (fluid/functionalizer.run_block), so
 
 Gradient support: a `while` built with max_iters lowers to a bounded masked
 lax.scan and is differentiable through the generic vjp machinery (reference
-while_grad, while_op.cc:119); without a bound it lowers to lax.while_loop —
-dynamic trip count, forward-only (inference/decoding loops). Training-time
-recurrences can also go through recurrent/scan or the unrolled StaticRNN.
+while_grad, while_op.cc:119); without a bound it lowers to lax.while_loop
+(forward) and differentiates via the explicit `while_grad_dynamic` op — a
+host-path replay of the loop (initial carries snapshotted before the
+forward op) followed by a per-iteration vjp sweep, the direct analogue of
+the reference's per-iteration-scope WhileGradOp. Training-time recurrences
+can also go through recurrent/scan or the unrolled StaticRNN.
 """
 
 import numpy as np
@@ -143,17 +146,201 @@ def _while(ctx):
     return {"Out": [by_name.get(n) for n in out_names]}
 
 
-def _while_grad_maker(op, block, grad_map, no_grad_set):
-    """Guard rail: differentiating a while requires the bounded-scan
-    lowering. Decline (None) to the generic vjp path when max_iters is set;
-    fail with guidance instead of a cryptic lax error when it is not."""
+def _is_float_var(block, name):
+    from ..fluid import core as fcore
+    v = block._find_var_recursive(name)
+    if v is None or v.dtype is None:
+        return False
+    try:
+        return np.issubdtype(fcore.convert_dtype_to_np(v.dtype),
+                             np.floating)
+    except Exception:
+        return False
+
+
+def _while_grad_maker(op, block, grad_map, no_grad_set, bw_ctx=None):
+    """Differentiating a while: with max_iters the bounded-scan lowering
+    is jax-differentiable — decline (None) to the generic vjp path.
+    WITHOUT a bound, emit an explicit `while_grad` op (reference
+    while_op.cc:119 WhileGradOp): a host-path op that replays the loop
+    recording per-iteration carries, then runs the body's vjp backward
+    over the recorded trajectory — dynamic trip counts fully supported
+    on the eager/host execution path."""
     if op.attrs.get("max_iters"):
         return None
-    raise RuntimeError(
-        "cannot differentiate through `while` without a trip-count bound: "
-        "construct the loop with layers.While(cond, max_iters=N) so it "
-        "lowers to a bounded lax.scan (reference while_grad capability, "
-        "while_op.cc:119)")
+    from ..fluid.framework import grad_var_name
+    x_names = list(op.inputs.get("X", []))
+    out_names = list(op.outputs.get("Out", []))
+    out_grads = [grad_map.get(n, "") for n in out_names]
+    if not any(out_grads):
+        return []        # loop contributes no gradient — handled, empty
+
+    # carries are clobbered IN PLACE by the forward loop (Out name ==
+    # X name), so the replay needs snapshots of the INITIAL values:
+    # insert assigns right before the forward while op (the analogue of
+    # the reference's per-iteration scope capture)
+    while_idx = next(i for i, o in enumerate(block.ops) if o is op)
+    feed_names = []
+    n_inserted = 0
+    for n in x_names:
+        if n in out_names:
+            init_name = n + "@WHILE_INIT"
+            v = block._find_var_recursive(n)
+            block.create_var(name=init_name, dtype=v.dtype,
+                             shape=v.shape, stop_gradient=True)
+            block._insert_op(
+                while_idx + n_inserted,
+                type="assign", inputs={"X": [n]},
+                outputs={"Out": [init_name]}, attrs={})
+            n_inserted += 1
+            feed_names.append(init_name)
+        else:
+            feed_names.append(n)
+
+    made = []
+    x_grad_names = []
+    for n in x_names:
+        if n in no_grad_set or not _is_float_var(block, n):
+            x_grad_names.append("")
+            continue
+        gname = grad_var_name(n) + "@WHILE"
+        v = block._find_var_recursive(n)
+        block.create_var(name=gname, dtype=v.dtype, shape=v.shape,
+                         stop_gradient=True)
+        x_grad_names.append(gname)
+    block.append_op(
+        type="while_grad_dynamic",
+        inputs={"X": feed_names, "GRAD:Out": out_grads},
+        outputs={"GRAD:X": x_grad_names},
+        attrs={"sub_block": op.attrs.get("sub_block"),
+               "out_names": out_names, "x_names": x_names,
+               "cond_name": list(op.inputs.get("Condition", ["?"]))[0],
+               "op_role": "Backward"},
+        infer_shape=False)
+    # integrate with the backward pass's fan-in protocol (bw_ctx carries
+    # its pending/partials state):
+    # - a CARRY's out-grad (grad_map[n]) was CONSUMED by the replay; the
+    #   computed initial-state grad REPLACES it — summing would
+    #   double-count the upstream gradient through an identity loop
+    # - a CLOSURE input behaves like any other consumer: contribute a
+    #   partial and let finalize_grad sum across all consumers
+    pending = (bw_ctx or {}).get("pending", {})
+    partials = (bw_ctx or {}).get("partials", {})
+    for n, gname in zip(x_names, x_grad_names):
+        if not gname:
+            continue
+        made.append(gname)
+        if n in out_names:
+            if pending.get(n, 0) > 0:
+                # other consumers still owed: join their fan-in; the
+                # stale out-grad in grad_map is overwritten at finalize
+                partials.setdefault(n, []).append(gname)
+            else:
+                grad_map[n] = gname
+        else:
+            partials.setdefault(n, []).append(gname)
+            # the handled-branch decrement in backward.py finalizes this
+            # var once every consumer (including this loop) contributed
+    return made
+
+
+@register_op("while_grad_dynamic")
+def _while_grad(ctx):
+    """Reference WhileGradOp (while_op.cc:119): replay the forward loop
+    from its recorded inputs (per-iteration carries = the reference's
+    per-iteration scopes), then apply the body's vjp backward over the
+    trajectory. Host path only — trip count is data-dependent."""
+    import jax
+    jnp = _jnp()
+    from ..fluid import functionalizer
+
+    block = ctx.attr("sub_block")
+    out_names = list(ctx.attr("out_names", []))
+    # X holds @WHILE_INIT snapshots for clobbered carries; x_names maps
+    # each position back to the loop's own variable names
+    x_names = list(ctx.attr("x_names", [])) or \
+        list(ctx.op.inputs.get("X", []))
+    cond_name = ctx.attr("cond_name")
+    vals = dict(zip(x_names, ctx.inputs("X")))
+    grad_out_vals = dict(zip(out_names, ctx.inputs("GRAD:Out")))
+    if any(isinstance(v, jax.core.Tracer) for v in vals.values()
+           if v is not None):
+        raise NotImplementedError(
+            "while_grad replays a data-dependent trip count and runs on "
+            "the host execution path only (programs containing it are "
+            "segmented automatically by the executor)")
+
+    carry_names = [n for n in out_names if vals.get(n) is not None]
+    closure = {n: v for n, v in vals.items()
+               if n not in carry_names and v is not None}
+
+    def is_float(v):
+        return np.issubdtype(np.asarray(v).dtype, np.floating)
+
+    diff_carries = [n for n in carry_names if is_float(vals[n])]
+    nondiff_carries = [n for n in carry_names if n not in diff_carries]
+    diff_closure = [n for n in closure if is_float(closure[n])]
+
+    # ---- forward replay, recording every iteration's full carry ----
+    history = []
+    cur = {n: vals[n] for n in carry_names}
+
+    def cond_of(env):
+        src = env.get(cond_name, closure.get(cond_name))
+        return bool(np.asarray(src).reshape(()))
+
+    def run_iter(env_carries):
+        e = dict(closure)
+        e.update(env_carries)
+        functionalizer.run_block(block, e, step=ctx.step, seed=ctx.seed,
+                                 mesh=ctx.mesh)
+        return {n: e[n] for n in carry_names}
+
+    probe = dict(closure)
+    probe.update(cur)
+    while cond_of(probe):
+        history.append(dict(cur))
+        cur = run_iter(cur)
+        probe = dict(closure)
+        probe.update(cur)
+
+    # ---- backward sweep over the recorded trajectory ----
+    g_carry = {n: (grad_out_vals.get(n)
+                   if grad_out_vals.get(n) is not None
+                   else jnp.zeros_like(vals[n]))
+               for n in diff_carries}
+    g_closure = {n: jnp.zeros_like(closure[n]) for n in diff_closure}
+
+    for t in range(len(history) - 1, -1, -1):
+        carries_t = history[t]
+        nondiff_env = {n: carries_t[n] for n in nondiff_carries}
+
+        def step_fn(dc_vals, cl_vals):
+            e = dict(closure)
+            e.update(nondiff_env)
+            e.update(dict(zip(diff_closure, cl_vals)))
+            e.update(dict(zip(diff_carries, dc_vals)))
+            functionalizer.run_block(block, e, step=ctx.step,
+                                     seed=ctx.seed, mesh=ctx.mesh)
+            return tuple(e[n] for n in diff_carries)
+
+        _, vjp_fn = jax.vjp(step_fn,
+                            tuple(carries_t[n] for n in diff_carries),
+                            tuple(closure[n] for n in diff_closure))
+        gc, gcl = vjp_fn(tuple(g_carry[n] for n in diff_carries))
+        g_carry = dict(zip(diff_carries, gc))
+        for n, g in zip(diff_closure, gcl):
+            g_closure[n] = g_closure[n] + g
+
+    grads = []
+    for n in x_names:
+        if n in g_carry:
+            grads.append(g_carry[n])
+        elif n in g_closure:
+            grads.append(g_closure[n])
+        else:
+            grads.append(None)
+    return {"GRAD:X": grads}
 
 
 from .registry import set_grad_maker as _set_gm_cf  # noqa: E402

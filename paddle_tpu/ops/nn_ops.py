@@ -605,7 +605,8 @@ def _lookup_table_sparse_grad(ctx):
     return {"GRAD:W": SelectedRowsValue(flat_ids, values, w.shape[0])}
 
 
-def _lookup_table_grad_maker(op, block, grad_map, no_grad_set):
+def _lookup_table_grad_maker(op, block, grad_map, no_grad_set,
+                             bw_ctx=None):
     """Emit the sparse grad op when is_sparse is set; decline (None) for
     the dense default so the generic vjp path runs."""
     if not op.attrs.get("is_sparse", False):

@@ -134,9 +134,11 @@ def test_while_bound_auto_derived_trains():
     assert vals[-1] < vals[0], vals
 
 
-def test_while_dynamic_bound_raises_on_backward():
+def test_while_dynamic_bound_emits_replay_grad_op():
     """A genuinely data-dependent limit (fed at runtime) cannot derive a
-    static bound: backward still fails with guidance."""
+    static bound: backward now emits the replay-based while_grad_dynamic
+    op (reference while_op.cc:119) instead of raising, with initial-carry
+    snapshots inserted before the forward loop."""
     main, startup = Program(), Program()
     with fluid.program_guard(main, startup):
         x = fluid.layers.data("x", shape=[4], dtype="float32")
@@ -152,9 +154,12 @@ def test_while_dynamic_bound_raises_on_backward():
             fluid.layers.increment(i, 1.0, in_place=True)
             fluid.layers.less_than(i, n, cond=cond)
         loss = fluid.layers.mean(h)
-        import pytest
-        with pytest.raises(RuntimeError, match="max_iters"):
-            fluid.optimizer.SGD(0.1).minimize(loss)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "while_grad_dynamic" in types
+    widx = types.index("while")
+    # initial-carry snapshots precede the forward loop
+    assert types[widx - 1] == "assign"
 
 
 def test_conditional_block():
@@ -346,3 +351,146 @@ def test_lod_rank_table_layer_and_reorder():
     (res,) = exe.run(main, feed={"x": lod}, fetch_list=[pooled])
     # order by length desc: seq1 (len 3, last row idx3), seq0, seq2
     np.testing.assert_allclose(np.asarray(res)[0], data[3], atol=1e-5)
+
+
+def test_dynamic_while_grad_trains_without_bound():
+    """reference while_grad (while_op.cc:119): a loop whose trip count
+    depends on runtime DATA (no derivable static bound) trains on the
+    host execution path via the replay-based while_grad op."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        n_steps = fluid.layers.data("n", shape=[1])  # data-dependent!
+        w = fluid.layers.create_parameter([4, 4], "float32", name="dw_w")
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        state = fluid.layers.elementwise_add(
+            x, fluid.layers.fill_constant([1], "float32", 0.0))
+        cond = fluid.layers.less_than(i, n_steps)
+        loop = fluid.layers.While(cond)
+        with loop.block():
+            nxt = fluid.layers.tanh(fluid.layers.mul(state, w))
+            fluid.layers.assign(nxt, state)
+            fluid.layers.increment(i)
+            fluid.layers.less_than(i, n_steps, cond=cond)
+        target = fluid.layers.data("t", shape=[4])
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(state, target))
+        fluid.optimizer.SGDOptimizer(0.2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for step in range(30):
+            # trip count varies per step: 1..3 iterations, decided by DATA
+            k = 1 + (step % 3)
+            n = np.array([[float(k)]], np.float32)
+            xv = rng.randn(2, 4).astype(np.float32)
+            tv = xv
+            for _ in range(k):   # target iterates the SAME trip count
+                tv = np.tanh(tv @ np.full((4, 4), 0.1, np.float32))
+            (l,) = exe.run(main, feed={"x": xv, "n": n, "t": tv},
+                           fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+    # trainable: parameters receive gradients through the dynamic loop
+    assert np.mean(losses[-5:]) < 0.5 * np.mean(losses[:5]), \
+        losses[::6]
+
+
+def test_dynamic_while_grad_fan_in_and_producer_grads():
+    """The review repros: (a) a parameter consumed both inside an
+    unbounded loop and outside it receives the SUM of both
+    contributions; (b) a trainable producer feeding the loop gets the
+    true chained gradient, not a double-counted one. Both checked
+    against numeric finite differences."""
+    def build():
+        main, startup = Program(), Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[3])
+            n = fluid.layers.data("n", shape=[1])
+            w0 = fluid.layers.create_parameter(
+                [3, 3], "float32", name="fw0",
+                default_initializer=fluid.initializer.Normal(scale=0.3))
+            w = fluid.layers.create_parameter(
+                [3, 3], "float32", name="fw",
+                default_initializer=fluid.initializer.Normal(scale=0.3))
+            state = fluid.layers.mul(x, w0)     # trainable producer
+            i = fluid.layers.fill_constant([1], "float32", 0.0)
+            cond = fluid.layers.less_than(i, n)
+            loop = fluid.layers.While(cond)
+            with loop.block():
+                nxt = fluid.layers.tanh(fluid.layers.mul(state, w))
+                fluid.layers.assign(nxt, state)
+                fluid.layers.increment(i)
+                fluid.layers.less_than(i, n, cond=cond)
+            outside = fluid.layers.mean(fluid.layers.mul(x, w))
+            loss = fluid.layers.elementwise_add(
+                fluid.layers.mean(state), outside)
+            pg = fluid.backward.append_backward(loss)
+        return main, startup, loss, pg
+
+    main, startup, loss, pg = build()
+    names = {p.name for p, g in pg}
+    assert names == {"fw0", "fw"}, names
+    gmap = {p.name: g.name for p, g in pg}
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    rng = np.random.RandomState(3)
+    xv = rng.randn(2, 3).astype(np.float32)
+    nv = np.array([[2.0]], np.float32)
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        l0, gw0, gw = exe.run(
+            main, feed={"x": xv, "n": nv},
+            fetch_list=[loss, gmap["fw0"], gmap["fw"]])
+        w0_val = np.asarray(scope.get("fw0")).copy()
+        w_val = np.asarray(scope.get("fw")).copy()
+
+        # finite differences against the same program
+        def loss_at(w0_new, w_new):
+            scope.set("fw0", w0_new.astype(np.float32))
+            scope.set("fw", w_new.astype(np.float32))
+            (lv,) = exe.run(main, feed={"x": xv, "n": nv},
+                            fetch_list=[loss])
+            return float(np.asarray(lv).ravel()[0])
+
+        eps = 1e-3
+        for pname, gval, base0, base1 in (
+                ("fw0", np.asarray(gw0), w0_val, w_val),
+                ("fw", np.asarray(gw), w0_val, w_val)):
+            for idx in [(0, 0), (1, 2)]:
+                d0 = w0_val.copy()
+                d1 = w_val.copy()
+                tgt = d0 if pname == "fw0" else d1
+                tgt[idx] += eps
+                lp = loss_at(d0, d1)
+                tgt[idx] -= 2 * eps
+                lm = loss_at(d0, d1)
+                tgt[idx] += eps
+                num = (lp - lm) / (2 * eps)
+                np.testing.assert_allclose(gval[idx], num, atol=5e-3,
+                                           err_msg="%s%s" % (pname, idx))
+            loss_at(w0_val, w_val)   # restore
+
+
+def test_step_counter_no_double_increment_with_lr_schedule():
+    """A program using BOTH an LR decay schedule and
+    autoincreased_step_counter must not double-step either counter."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2])
+        lr = fluid.layers.exponential_decay(0.1, decay_steps=10,
+                                            decay_rate=0.5)
+        ctr = fluid.layers.autoincreased_step_counter()
+        out = fluid.layers.mean(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        vals = []
+        for _ in range(3):
+            (c,) = exe.run(main, feed={"x": np.zeros((1, 2), np.float32)},
+                           fetch_list=[ctr])
+            vals.append(float(np.asarray(c).ravel()[0]))
+    assert vals == [1.0, 2.0, 3.0], vals

@@ -99,10 +99,15 @@ def _append_grad_ops(block, path_ops, grad_map, no_grad_set):
             grad_map[name] = gname
 
     for op in reversed(path_ops):
-        # collect available output grads
+        # collect available output grads. A `while` carry that also has a
+        # PRE-loop consumer holds its post-loop contributions as
+        # unfinalized partials (pending counts the pre-loop consumer, who
+        # hasn't run yet in the reverse walk) — the while's grad maker
+        # force-finalizes those, so count partials as "grads exist" there.
         out_grads_exist = False
         for name in op.output_arg_names:
-            if name in grad_map:
+            if name in grad_map or \
+                    (op.type == "while" and partials.get(name)):
                 out_grads_exist = True
         if not out_grads_exist:
             continue

@@ -101,6 +101,10 @@ _LOD_DROP_OPS = frozenset([
     "multiclass_nms", "generate_proposals",
     # per-sequence scatter writes into a dense [B, D] tensor
     "sequence_scatter",
+    # metric/sampler/grad ops whose outputs are NOT ragged views of their
+    # inputs (emit their own companions where needed)
+    "detection_map", "generate_proposal_labels", "lod_rank_table",
+    "while_grad_dynamic",
 ])
 
 

@@ -14,7 +14,9 @@ from .framework import default_startup_program
 __all__ = [
     "Constant", "Uniform", "Normal", "TruncatedNormal", "Xavier", "MSRA",
     "Bilinear", "NumpyArrayInitializer", "force_init_on_cpu",
-    "init_on_cpu",
+    "init_on_cpu", "ConstantInitializer", "UniformInitializer",
+    "NormalInitializer", "TruncatedNormalInitializer",
+    "XavierInitializer", "MSRAInitializer", "BilinearInitializer",
 ]
 
 

@@ -2,7 +2,8 @@
 
 import numpy as np
 
-__all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy",
+__all__ = ["DetectionMAP",
+           "MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy",
            "ChunkEvaluator", "EditDistance", "Auc"]
 
 
@@ -173,3 +174,91 @@ class ChunkEvaluator(MetricBase):
         f1 = 2 * precision * recall / (precision + recall) \
             if self.num_correct_chunks else 0.0
         return precision, recall, f1
+
+
+class DetectionMAP(object):
+    """Detection mean average precision evaluator (reference
+    fluid/metrics.py DetectionMAP): wires two detection_map layers — the
+    per-batch mAP and a streaming one whose accumulator states thread
+    across batches — plus reset()."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral"):
+        from . import layers
+        from .layer_helper import LayerHelper
+        from .initializer import Constant
+        from . import core
+
+        self.helper = LayerHelper("map_eval")
+        gt_label = layers.cast(gt_label, "float32")
+        if gt_difficult is not None:
+            gt_difficult = layers.cast(gt_difficult, "float32")
+            label = layers.concat([gt_label, gt_difficult, gt_box],
+                                  axis=1)
+        else:
+            label = layers.concat([gt_label, gt_box], axis=1)
+        label.lod_level = max(getattr(gt_box, "lod_level", 0), 1)
+
+        self.cur_map = layers.detection_map(
+            input, label, class_num=class_num,
+            background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult,
+            ap_version=ap_version)
+
+        self._state_names = []
+        states = [
+            self._create_state(core.VarDesc.VarType.INT32,
+                               "accum_pos_count", [1, 2]),
+            self._create_state("float32", "accum_true_pos", [1, 3]),
+            self._create_state("float32", "accum_false_pos", [1, 3]),
+        ]
+        self.states = states
+        self.has_state = self._create_state(
+            core.VarDesc.VarType.INT32, "has_state", [1])
+        self.helper.set_variable_initializer(self.has_state, Constant(0))
+
+        self.accum_map = layers.detection_map(
+            input, label, class_num=class_num,
+            background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult,
+            has_state=self.has_state, input_states=states,
+            out_states=states, ap_version=ap_version)
+        layers.fill_constant(shape=[1], dtype="int32", value=1,
+                             out=self.has_state)
+
+    def _create_state(self, dtype, suffix, shape):
+        from . import unique_name
+        var = self.helper.create_global_variable(
+            name=unique_name.generate("map_eval_%s" % suffix),
+            dtype=dtype, shape=shape, persistable=True,
+            stop_gradient=True)
+        self._state_names.append(var.name)
+        return var
+
+    def get_map_var(self):
+        """(current-batch mAP var, accumulative mAP var)."""
+        return self.cur_map, self.accum_map
+
+    def reset(self, executor, reset_program=None):
+        """Zero the accumulators (reference metrics.py DetectionMAP
+        reset): neutral single-row states — class 0 is background, so a
+        (0, ...) row contributes nothing."""
+        from . import framework
+        from . import layers
+        if reset_program is None:
+            reset_program = framework.Program()
+        with framework.program_guard(reset_program):
+            for name, shape, dtype in (
+                    (self._state_names[0], [1, 2], "int32"),
+                    (self._state_names[1], [1, 3], "float32"),
+                    (self._state_names[2], [1, 3], "float32"),
+                    (self.has_state.name, [1], "int32")):
+                var = reset_program.global_block().create_var(
+                    name=name, dtype=dtype, persistable=True)
+                layers.fill_constant(shape=shape, dtype=dtype, value=0,
+                                     out=var)
+        executor.run(reset_program)

@@ -519,9 +519,14 @@ def _detection_map(ctx):
     # [class, npos] / [class, score, count]; prior batches arrive via the
     # PosCount/TruePos/FalsePos inputs
     npos_of, tp_rows, fp_rows = {}, [], []
-    prior_pos = ctx.input("PosCount")
-    prior_tp = ctx.input("TruePos")
-    prior_fp = ctx.input("FalsePos")
+    # HasState gate (detection_map_op.h): 0 means the accumulator inputs
+    # are uninitialized/stale and must be ignored this run
+    has_state_in = ctx.input("HasState")
+    use_prior = (has_state_in is None or
+                 int(np.asarray(has_state_in).reshape(-1)[0]) != 0)
+    prior_pos = ctx.input("PosCount") if use_prior else None
+    prior_tp = ctx.input("TruePos") if use_prior else None
+    prior_fp = ctx.input("FalsePos") if use_prior else None
     if prior_pos is not None:
         for c, n in np.asarray(prior_pos).reshape(-1, 2):
             npos_of[int(c)] = npos_of.get(int(c), 0) + int(n)

@@ -169,8 +169,37 @@ def _while_grad_maker(op, block, grad_map, no_grad_set, bw_ctx=None):
     if op.attrs.get("max_iters"):
         return None
     from ..fluid.framework import grad_var_name
+    pending = (bw_ctx or {}).get("pending", {})
+    partials = (bw_ctx or {}).get("partials", {})
     x_names = list(op.inputs.get("X", []))
     out_names = list(op.outputs.get("Out", []))
+
+    # Force-finalize each carry's POST-loop contributions: with a
+    # pre-loop consumer in the graph, pending has not drained and the
+    # partials contributed so far (exactly the post-loop consumers —
+    # they precede this op in the reverse walk) are the loop's out-grad.
+    # The canonical grad name is reused later by the producer's own
+    # finalize; sequential execution on the host path makes the in-place
+    # rebinding safe (this op consumes the value before the overwrite).
+    for n in out_names:
+        if n in grad_map:
+            continue
+        parts = partials.pop(n, [])
+        if not parts:
+            continue
+        gname = grad_var_name(n)
+        v = block._find_var_recursive(n)
+        if not block.has_var(gname) and v is not None:
+            from ..fluid.backward import _create_grad_var
+            _create_grad_var(block, v, gname)
+        if len(parts) == 1:
+            block.append_op(type="assign", inputs={"X": [parts[0]]},
+                            outputs={"Out": [gname]}, infer_shape=False)
+        else:
+            block.append_op(type="sum", inputs={"X": parts},
+                            outputs={"Out": [gname]}, infer_shape=False)
+        grad_map[n] = gname
+
     out_grads = [grad_map.get(n, "") for n in out_names]
     if not any(out_grads):
         return []        # loop contributes no gradient — handled, empty
@@ -281,40 +310,19 @@ def _while_grad(ctx):
     nondiff_carries = [n for n in carry_names if n not in diff_carries]
     diff_closure = [n for n in closure if is_float(closure[n])]
 
-    # ---- forward replay, recording every iteration's full carry ----
-    history = []
+    # ---- forward replay: ONE body execution per iteration, capturing
+    # each iteration's vjp closure as we go (the residuals play the role
+    # of the reference's per-iteration scopes) ----
+    vjp_fns = []
     cur = {n: vals[n] for n in carry_names}
 
     def cond_of(env):
         src = env.get(cond_name, closure.get(cond_name))
         return bool(np.asarray(src).reshape(()))
 
-    def run_iter(env_carries):
-        e = dict(closure)
-        e.update(env_carries)
-        functionalizer.run_block(block, e, step=ctx.step, seed=ctx.seed,
-                                 mesh=ctx.mesh)
-        return {n: e[n] for n in carry_names}
+    cl_vals_now = tuple(closure[n] for n in diff_closure)
 
-    probe = dict(closure)
-    probe.update(cur)
-    while cond_of(probe):
-        history.append(dict(cur))
-        cur = run_iter(cur)
-        probe = dict(closure)
-        probe.update(cur)
-
-    # ---- backward sweep over the recorded trajectory ----
-    g_carry = {n: (grad_out_vals.get(n)
-                   if grad_out_vals.get(n) is not None
-                   else jnp.zeros_like(vals[n]))
-               for n in diff_carries}
-    g_closure = {n: jnp.zeros_like(closure[n]) for n in diff_closure}
-
-    for t in range(len(history) - 1, -1, -1):
-        carries_t = history[t]
-        nondiff_env = {n: carries_t[n] for n in nondiff_carries}
-
+    def make_step(nondiff_env):
         def step_fn(dc_vals, cl_vals):
             e = dict(closure)
             e.update(nondiff_env)
@@ -322,11 +330,33 @@ def _while_grad(ctx):
             e.update(dict(zip(diff_carries, dc_vals)))
             functionalizer.run_block(block, e, step=ctx.step,
                                      seed=ctx.seed, mesh=ctx.mesh)
-            return tuple(e[n] for n in diff_carries)
+            diff_out = tuple(e[n] for n in diff_carries)
+            aux = {n: e[n] for n in nondiff_carries}
+            return diff_out, aux
+        return step_fn
 
-        _, vjp_fn = jax.vjp(step_fn,
-                            tuple(carries_t[n] for n in diff_carries),
-                            tuple(closure[n] for n in diff_closure))
+    probe = dict(closure)
+    probe.update(cur)
+    while cond_of(probe):
+        nondiff_env = {n: cur[n] for n in nondiff_carries}
+        step_fn = make_step(nondiff_env)
+        diff_out, vjp_fn, aux = jax.vjp(
+            step_fn, tuple(cur[n] for n in diff_carries), cl_vals_now,
+            has_aux=True)
+        vjp_fns.append(vjp_fn)
+        cur = dict(zip(diff_carries, diff_out))
+        cur.update(aux)
+        probe = dict(closure)
+        probe.update(cur)
+
+    # ---- backward sweep over the captured closures ----
+    g_carry = {n: (grad_out_vals.get(n)
+                   if grad_out_vals.get(n) is not None
+                   else jnp.zeros_like(vals[n]))
+               for n in diff_carries}
+    g_closure = {n: jnp.zeros_like(closure[n]) for n in diff_closure}
+
+    for vjp_fn in reversed(vjp_fns):
         gc, gcl = vjp_fn(tuple(g_carry[n] for n in diff_carries))
         g_carry = dict(zip(diff_carries, gc))
         for n, g in zip(diff_closure, gcl):

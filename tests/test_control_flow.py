@@ -494,3 +494,61 @@ def test_step_counter_no_double_increment_with_lr_schedule():
                            fetch_list=[ctr])
             vals.append(float(np.asarray(c).ravel()[0]))
     assert vals == [1.0, 2.0, 3.0], vals
+
+
+def test_dynamic_while_grad_with_pre_loop_consumer():
+    """The carry is consumed BEFORE the loop too (pending fan-in): the
+    while's gradient contribution must not be dropped. Checked against
+    finite differences."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        n = fluid.layers.data("n", shape=[1])
+        w0 = fluid.layers.create_parameter(
+            [3, 3], "float32", name="pw0",
+            default_initializer=fluid.initializer.Normal(scale=0.3))
+        state = fluid.layers.mul(x, w0)
+        pre = fluid.layers.mean(state)          # PRE-loop consumer
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        cond = fluid.layers.less_than(i, n)
+        loop = fluid.layers.While(cond)
+        with loop.block():
+            nxt = fluid.layers.tanh(fluid.layers.scale(state, scale=0.9))
+            fluid.layers.assign(nxt, state)
+            fluid.layers.increment(i)
+            fluid.layers.less_than(i, n, cond=cond)
+        loss = fluid.layers.elementwise_add(
+            fluid.layers.mean(state), pre)
+        pg = fluid.backward.append_backward(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "while_grad_dynamic" in types, types
+    gmap = {p.name: g.name for p, g in pg}
+    assert "pw0" in gmap
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    rng = np.random.RandomState(7)
+    xv = rng.randn(2, 3).astype(np.float32)
+    nv = np.array([[2.0]], np.float32)
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"x": xv, "n": nv},
+                       fetch_list=[gmap["pw0"]])
+        g = np.asarray(g)
+        w_val = np.asarray(scope.get("pw0")).copy()
+
+        def loss_at(wv):
+            scope.set("pw0", wv.astype(np.float32))
+            (lv,) = exe.run(main, feed={"x": xv, "n": nv},
+                            fetch_list=[loss])
+            return float(np.asarray(lv).ravel()[0])
+
+        eps = 1e-3
+        for idx in [(0, 0), (2, 1)]:
+            d = w_val.copy()
+            d[idx] += eps
+            lp = loss_at(d)
+            d[idx] -= 2 * eps
+            lm = loss_at(d)
+            num = (lp - lm) / (2 * eps)
+            np.testing.assert_allclose(g[idx], num, atol=5e-3)
+        loss_at(w_val)

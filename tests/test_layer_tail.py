@@ -220,3 +220,47 @@ def test_generate_proposal_labels_per_image_segmentation():
         if l == 7:
             # any class-7 row must be img1's own candidate (gt join)
             assert r[0] >= 50
+
+
+def test_detection_map_metric_streaming():
+    """fluid.metrics.DetectionMAP: per-batch and accumulative mAP vars,
+    states threading across runs, reset()."""
+    from paddle_tpu.fluid.metrics import DetectionMAP
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        det = fluid.layers.data("det", shape=[6], lod_level=1)
+        gt_label = fluid.layers.data("gl", shape=[1], dtype="int64",
+                                     lod_level=1)
+        gt_box = fluid.layers.data("gb", shape=[4], lod_level=1)
+        m = DetectionMAP(det, gt_label, gt_box, class_num=3)
+        cur_map, accum_map = m.get_map_var()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+
+    def batch(hit):
+        d = np.array([[1, 0.9, 0, 0, 1, 1]], np.float32) if hit else \
+            np.array([[1, 0.9, 5, 5, 6, 6]], np.float32)
+        return {
+            "det": create_lod_tensor(d, [[1]]),
+            "gl": create_lod_tensor(np.array([[1]], np.int64), [[1]]),
+            "gb": create_lod_tensor(
+                np.array([[0, 0, 1, 1]], np.float32), [[1]]),
+        }
+
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        c1, a1 = exe.run(main, feed=batch(True),
+                         fetch_list=[cur_map, accum_map])
+        assert abs(float(np.asarray(c1)[0]) - 1.0) < 1e-6
+        assert abs(float(np.asarray(a1)[0]) - 1.0) < 1e-6
+        # a miss lowers the STREAM mAP below the current-batch value
+        c2, a2 = exe.run(main, feed=batch(False),
+                         fetch_list=[cur_map, accum_map])
+        assert float(np.asarray(c2)[0]) == 0.0
+        assert 0.0 < float(np.asarray(a2)[0]) < 1.0
+        # reset clears the accumulators
+        m.reset(exe)
+        c3, a3 = exe.run(main, feed=batch(True),
+                         fetch_list=[cur_map, accum_map])
+        assert abs(float(np.asarray(a3)[0]) - 1.0) < 1e-6

@@ -32,7 +32,10 @@ from .initializer import Constant, Normal, Uniform, Xavier, MSRA  # noqa: F401
 
 # populated by later milestones; imported lazily to keep import cheap
 from . import lod  # noqa: F401
-from .lod import LoDTensor, create_lod_tensor  # noqa: F401
+from .lod import (LoDTensor, create_lod_tensor,  # noqa: F401
+                  create_random_int_lodtensor)
+from . import recordio_writer  # noqa: F401
+from .layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401,E501
 from . import io
 from .io import (  # noqa: F401
     save_vars, save_params, save_persistables, load_vars, load_params,
@@ -77,8 +80,18 @@ __all__ = [
     "set_amp", "amp_enabled", "ir_passes",
     "flags", "set_flags", "get_flags", "FLAGS",
     "concurrency", "Go", "make_channel", "channel_send", "channel_recv",
-    "channel_close",
+    "channel_close", "LoDTensorArray", "Tensor", "recordio_writer",
+    "learning_rate_decay", "create_random_int_lodtensor", "Trainer",
+    "Inferencer",
 ]
+
+# reference top-level aliases: the fluid package re-exported the contrib
+# Trainer/Inferencer and the core tensor types at its root
+import numpy as _np                       # noqa: E402
+Tensor = _np.ndarray                      # core.Tensor: a dense array
+LoDTensorArray = list                     # LOD_TENSOR_ARRAY: python list
+Trainer = contrib.Trainer
+Inferencer = contrib.Inferencer
 from . import concurrency  # noqa: E402
 from .concurrency import (  # noqa: F401,E402
     Go, make_channel, channel_send, channel_recv, channel_close)

@@ -142,3 +142,14 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
     t.set_recursive_sequence_lengths(recursive_seq_lens)
     assert t.has_valid_recursive_sequence_lengths()
     return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
+                                low, high):
+    """reference lod_tensor.py create_random_int_lodtensor: random int64
+    ragged tensor with the given per-sequence lengths."""
+    import numpy as np
+    total = sum(recursive_seq_lens[-1])
+    data = np.random.randint(low, high + 1,
+                             size=[total] + list(base_shape)).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -166,21 +166,20 @@ def _while_grad_maker(op, block, grad_map, no_grad_set, bw_ctx=None):
     recording per-iteration carries, then runs the body's vjp backward
     over the recorded trajectory — dynamic trip counts fully supported
     on the eager/host execution path."""
-    if op.attrs.get("max_iters"):
-        return None
     from ..fluid.framework import grad_var_name
     pending = (bw_ctx or {}).get("pending", {})
     partials = (bw_ctx or {}).get("partials", {})
     x_names = list(op.inputs.get("X", []))
     out_names = list(op.outputs.get("Out", []))
 
-    # Force-finalize each carry's POST-loop contributions: with a
-    # pre-loop consumer in the graph, pending has not drained and the
-    # partials contributed so far (exactly the post-loop consumers —
-    # they precede this op in the reverse walk) are the loop's out-grad.
-    # The canonical grad name is reused later by the producer's own
-    # finalize; sequential execution on the host path makes the in-place
-    # rebinding safe (this op consumes the value before the overwrite).
+    # Force-finalize each carry's POST-loop contributions — for BOTH the
+    # bounded and dynamic paths: with a pre-loop consumer in the graph,
+    # pending has not drained and the partials contributed so far
+    # (exactly the post-loop consumers — they precede this op in the
+    # reverse walk) are the loop's out-grad. The canonical grad name is
+    # reused later by the producer's own finalize; sequential execution
+    # makes the in-place rebinding safe (this op's grad consumes the
+    # value before the overwrite).
     for n in out_names:
         if n in grad_map:
             continue
@@ -199,6 +198,10 @@ def _while_grad_maker(op, block, grad_map, no_grad_set, bw_ctx=None):
             block.append_op(type="sum", inputs={"X": parts},
                             outputs={"Out": [gname]}, infer_shape=False)
         grad_map[n] = gname
+
+    if op.attrs.get("max_iters"):
+        return None      # bounded scan: generic vjp path (grads seeded
+                         # from the force-finalized map above)
 
     out_grads = [grad_map.get(n, "") for n in out_names]
     if not any(out_grads):
@@ -253,8 +256,6 @@ def _while_grad_maker(op, block, grad_map, no_grad_set, bw_ctx=None):
     #   double-count the upstream gradient through an identity loop
     # - a CLOSURE input behaves like any other consumer: contribute a
     #   partial and let finalize_grad sum across all consumers
-    pending = (bw_ctx or {}).get("pending", {})
-    partials = (bw_ctx or {}).get("partials", {})
     for n, gname in zip(x_names, x_grad_names):
         if not gname:
             continue

@@ -13,4 +13,15 @@ Layout (SURVEY.md §7):
 
 __version__ = "0.1.0"
 
-from . import fluid  # noqa: F401
+import warnings as _warnings
+
+# Design-intended behavior, not a defect: the framework runs with jax
+# x64 disabled (TPU-native int32/float32 words), so reference-API int64
+# vars deliberately ride int32 on device. jax warns on every such
+# conversion; silence exactly that message (fluid/core.py keeps true
+# int64 on the numpy/serde side).
+_warnings.filterwarnings(
+    "ignore",
+    message=r"Explicitly requested dtype .*int64.* is not available")
+
+from . import fluid  # noqa: F401,E402

@@ -87,8 +87,8 @@ __all__ = [
 
 # reference top-level aliases: the fluid package re-exported the contrib
 # Trainer/Inferencer and the core tensor types at its root
-import numpy as _np                       # noqa: E402
-Tensor = _np.ndarray                      # core.Tensor: a dense array
+Tensor = LoDTensor                        # reference: Tensor aliases the
+                                          # LoD-carrying dense tensor
 LoDTensorArray = list                     # LOD_TENSOR_ARRAY: python list
 Trainer = contrib.Trainer
 Inferencer = contrib.Inferencer

@@ -152,11 +152,13 @@ def _append_grad_ops(block, path_ops, grad_map, no_grad_set):
                 gname = grad_var_name(n)
                 # rename whenever another partial already exists (or more
                 # are owed): two consumers may otherwise both see
-                # pending == 1 — e.g. a while carry whose replay consumed
-                # the base name without decrementing pending — and their
-                # identically-named partials would sum to 2x one value
-                if pending.get(n, 0) > 1 or partials.get(n) or \
-                        grad_map.get(n) == gname:
+                # pending == 1 — e.g. a while carry whose force-finalize
+                # emptied partials without decrementing pending — and
+                # their identically-named partials would sum to 2x one
+                # value. When no partial exists and none are owed, the
+                # base name is REQUIRED: downstream grad ops read it
+                # in-place before any end-of-walk rebinding could run.
+                if pending.get(n, 0) > 1 or partials.get(n):
                     gname = gname + "@RENAME@%d" % len(
                         partials.setdefault(n, []))
                     partials[n].append(gname)

@@ -148,7 +148,6 @@ def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
                                 low, high):
     """reference lod_tensor.py create_random_int_lodtensor: random int64
     ragged tensor with the given per-sequence lengths."""
-    import numpy as np
     total = sum(recursive_seq_lens[-1])
     data = np.random.randint(low, high + 1,
                              size=[total] + list(base_shape)).astype("int64")

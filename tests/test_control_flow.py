@@ -605,3 +605,54 @@ def test_bounded_while_grad_with_pre_loop_consumer():
             np.testing.assert_allclose(g[idx], (lp - lm) / (2 * eps),
                                        atol=5e-3)
         loss_at(w_val)
+
+
+def test_bounded_while_grad_no_pre_loop_consumer():
+    """The mirror topology (carry has an upstream producer but NO
+    pre-loop consumer) — a rename-clause regression once produced stale
+    out-grads here. Finite-difference checked."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        w0 = fluid.layers.create_parameter(
+            [3, 3], "float32", name="nbw0",
+            default_initializer=fluid.initializer.Normal(scale=0.3))
+        state = fluid.layers.mul(x, w0)
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        n = fluid.layers.fill_constant([1], "float32", 2.0)
+        cond = fluid.layers.less_than(i, n)
+        loop = fluid.layers.While(cond, max_iters=2)
+        with loop.block():
+            nxt = fluid.layers.tanh(fluid.layers.scale(state, scale=0.9))
+            fluid.layers.assign(nxt, state)
+            fluid.layers.increment(i)
+            fluid.layers.less_than(i, n, cond=cond)
+        loss = fluid.layers.mean(state)
+        pg = fluid.backward.append_backward(loss)
+    gmap = {p.name: g.name for p, g in pg}
+    assert "nbw0" in gmap
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    rng = np.random.RandomState(13)
+    xv = rng.randn(2, 3).astype(np.float32)
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"x": xv}, fetch_list=[gmap["nbw0"]])
+        g = np.asarray(g)
+        w_val = np.asarray(scope.get("nbw0")).copy()
+
+        def loss_at(wv):
+            scope.set("nbw0", wv.astype(np.float32))
+            (lv,) = exe.run(main, feed={"x": xv}, fetch_list=[loss])
+            return float(np.asarray(lv).ravel()[0])
+
+        eps = 1e-3
+        for idx in [(0, 0), (1, 2)]:
+            d = w_val.copy()
+            d[idx] += eps
+            lp = loss_at(d)
+            d[idx] -= 2 * eps
+            lm = loss_at(d)
+            np.testing.assert_allclose(g[idx], (lp - lm) / (2 * eps),
+                                       atol=5e-3)
+        loss_at(w_val)

@@ -37,3 +37,41 @@ def test_benchmark_parallel_mode():
     r = _run("--model", "mnist", "--batch_size", "16", "--parallel")
     assert r["parallel"] is True
     assert r["examples_per_sec"] > 0
+
+
+def test_benchmark_pserver_mode_cluster():
+    """--update_method pserver: the harness reads the reference's
+    PADDLE_* env-var role wiring (fluid_benchmark.py:84-86) — launch one
+    pserver + one trainer as real subprocesses."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ep = "127.0.0.1:%d" % port
+    base_env = dict(os.environ, PADDLE_PSERVER_EPS=ep,
+                    PADDLE_TRAINERS="1", JAX_PLATFORMS="cpu")
+    cmd = [sys.executable,
+           os.path.join(REPO, "tools", "fluid_benchmark.py"),
+           "--device", "CPU", "--model", "mnist", "--batch_size", "8",
+           "--iterations", "3", "--skip_batch_num", "1",
+           "--update_method", "pserver"]
+    ps = subprocess.Popen(
+        cmd, env=dict(base_env, PADDLE_TRAINING_ROLE="PSERVER",
+                      PADDLE_CURRENT_ENDPOINT=ep),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        cwd=REPO)
+    try:
+        tr = subprocess.run(
+            cmd, env=dict(base_env, PADDLE_TRAINING_ROLE="TRAINER",
+                          PADDLE_TRAINER_ID="0"),
+            capture_output=True, text=True, timeout=420, cwd=REPO)
+        assert tr.returncode == 0, tr.stderr[-2000:]
+        rec = json.loads(tr.stdout.strip().splitlines()[-1])
+        assert rec["update_method"] == "pserver"
+        assert rec["examples_per_sec"] > 0
+        ps.wait(timeout=60)   # trainer 0's exit notification stops it
+    finally:
+        if ps.poll() is None:
+            ps.kill()

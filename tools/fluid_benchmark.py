@@ -127,6 +127,37 @@ def main():
         batch = 32
     rng = np.random.RandomState(0)
 
+    pserver_eps = os.environ.get(
+        "PADDLE_PSERVER_EPS",
+        os.environ.get("PADDLE_PSERVER_IPS", "127.0.0.1") + ":" +
+        os.environ.get("PADDLE_PSERVER_PORT", "6174"))
+    if args.update_method == "pserver":
+        # reference fluid_benchmark.py:84-86: roles and endpoints come
+        # from the PADDLE_* environment (test_dist_base-style clusters)
+        from paddle_tpu.fluid.transpiler import DistributeTranspiler
+        from paddle_tpu.distributed.rpc import wait_server_ready
+        role = os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER")
+        trainers = int(os.environ.get("PADDLE_TRAINERS", "1"))
+        trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        t = DistributeTranspiler()
+        t.transpile(trainer_id=trainer_id, program=main_prog,
+                    pservers=pserver_eps, trainers=trainers,
+                    startup_program=startup)
+        if role == "PSERVER":
+            ep = os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                pserver_eps.split(",")[0])
+            ps_prog = t.get_pserver_program(ep)
+            ps_startup = t.get_startup_program(ep, ps_prog,
+                                               startup_program=startup)
+            exe = fluid.Executor(fluid.TPUPlace(0))
+            exe.run(ps_startup)
+            print(json.dumps({"role": "pserver", "endpoint": ep}),
+                  flush=True)
+            exe.run(ps_prog)        # listen_and_serv blocks until exit
+            return
+        main_prog = t.get_trainer_program()
+        wait_server_ready(pserver_eps.split(","))
+
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup)
 
@@ -161,6 +192,16 @@ def main():
     if args.profile:
         prof.stop_profiler("total", "/tmp/fluid_benchmark_profile")
 
+    if args.update_method == "pserver" and \
+            int(os.environ.get("PADDLE_TRAINER_ID", "0")) == 0:
+        # trainer 0 tells every pserver to exit its serve loop
+        from paddle_tpu.distributed.rpc import RPCClient
+        client = RPCClient()
+        for ep in pserver_eps.split(","):
+            try:
+                client.send_exit(ep)
+            except Exception:
+                pass
     assert np.isfinite(last), "loss diverged"
     print(json.dumps({
         "model": args.model,

@@ -24,12 +24,13 @@ failure-cap/pass-rollover — follow go/master/service.go closely.
 
 import binascii
 import os
-import pickle
 import socket
 import socketserver
 import threading
 import time
 
+from ..native.wire import WireError, decode as _wire_decode, \
+    encode as _wire_encode
 from .rpc import _send_msg, _recv_msg, _CLOSE  # shared wire protocol
 
 __all__ = ["Task", "MasterService", "MasterClient", "save_state_snapshot",
@@ -38,8 +39,9 @@ __all__ = ["Task", "MasterService", "MasterClient", "save_state_snapshot",
 
 class Task:
     """One unit of pending work (go/master/service.go:79 Task: a set of
-    recordio chunks). `payload` is any picklable description of the data
-    slice (file + chunk range, batch indices, ...)."""
+    recordio chunks). `payload` is any wire-encodable description of the
+    data slice (file + chunk range, batch indices, ... — scalars, str/
+    bytes, lists/tuples/dicts, ndarrays; see native/wire.py)."""
 
     __slots__ = ("id", "payload", "failures")
 
@@ -53,9 +55,10 @@ class Task:
 
 
 def save_state_snapshot(path, state):
-    """Atomic CRC-framed pickle (the etcd-snapshot analogue,
-    go/master/service.go:207)."""
-    payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    """Atomic CRC-framed typed snapshot (the etcd-snapshot analogue,
+    go/master/service.go:207; format = native/wire.cc, same codec as the
+    socket path — no pickle on disk either)."""
+    payload = _wire_encode(state)
     crc = binascii.crc32(payload) & 0xFFFFFFFF
     tmp = path + ".tmp"
     d = os.path.dirname(path)
@@ -68,15 +71,16 @@ def save_state_snapshot(path, state):
 
 
 def load_state_snapshot(path):
-    """Verify CRC and unpickle; raises ValueError on corruption
-    (go/pserver/service.go:174 LoadCheckpoint CRC check)."""
+    """Verify CRC and decode; raises ValueError on corruption
+    (go/pserver/service.go:174 LoadCheckpoint CRC check — WireError is a
+    ValueError, so pre-wire pickle snapshots are also rejected cleanly)."""
     with open(path, "rb") as f:
         raw = f.read()
     crc = int.from_bytes(raw[:4], "little")
     payload = raw[4:]
     if (binascii.crc32(payload) & 0xFFFFFFFF) != crc:
         raise ValueError("snapshot %s failed CRC32 check (corrupt)" % path)
-    return pickle.loads(payload)
+    return _wire_decode(payload)
 
 
 class MasterService:
@@ -99,6 +103,7 @@ class MasterService:
         self._check_interval = check_interval or \
             max(self.lease_timeout / 4.0, 0.05)
         self._lock = threading.Lock()
+        self._last_grant = {}     # worker -> (req_id, task_id) for resends
         self.todo = []            # [Task]
         self.pending = {}         # task_id -> (Task, deadline, worker)
         self.done = []            # [Task]
@@ -109,7 +114,15 @@ class MasterService:
         self._server = None
         self._threads = []
         if snapshot_path and os.path.exists(snapshot_path):
-            self._recover()
+            try:
+                self._recover()
+            except (ValueError, KeyError) as e:
+                # corrupt or pre-wire-format snapshot: start with a fresh
+                # queue instead of refusing to boot (the go master also
+                # proceeds when the etcd snapshot is unusable)
+                import warnings
+                warnings.warn("ignoring unreadable master snapshot %s: %s"
+                              % (snapshot_path, e))
 
     # ---- durable state (go/master/service.go:207,:237) ----
     def _state(self):
@@ -152,24 +165,26 @@ class MasterService:
             self._snapshot()
         return {"ok": True, "count": len(self.todo)}
 
-    def get_task(self, worker="?", resend=False):
+    def get_task(self, worker="?", resend=False, req_id=None):
         """Lease one task (service.go:368 GetTask).
 
-        ``resend=True`` marks an at-least-once retry after a lost reply:
-        if this worker already holds a lease (granted by the first copy of
-        the request whose reply vanished), hand the SAME task back with a
-        refreshed deadline instead of leasing a second one — otherwise the
-        orphaned lease expires and records a spurious failure."""
+        ``resend=True`` marks an at-least-once retry after a lost reply.
+        The replay is keyed by the client-echoed ``req_id``: only when the
+        retry carries the SAME request id that granted this worker's
+        still-pending lease is that task handed back (with a refreshed
+        deadline). A retry with a new req_id — the previous reply was in
+        fact delivered and the worker is asking for its next task — falls
+        through to a normal lease instead of duplicating work."""
         with self._lock:
             if not self.dataset_set:
                 return {"error": "dataset not set"}
-            if resend and worker != "?":
-                held = [tid for tid, (_, _, w) in self.pending.items()
-                        if w == worker]
-                if held:
-                    tid = held[-1]
-                    t, _, w = self.pending[tid]
-                    self.pending[tid] = (
+            if resend and worker != "?" and req_id is not None:
+                last = self._last_grant.get(worker)
+                if last is not None and last[0] == req_id \
+                        and last[1] in self.pending \
+                        and self.pending[last[1]][2] == worker:
+                    t, _, w = self.pending[last[1]]
+                    self.pending[last[1]] = (
                         t, time.monotonic() + self.lease_timeout, w)
                     return {"ok": True, "task_id": t.id,
                             "payload": t.payload,
@@ -188,6 +203,8 @@ class MasterService:
             t = self.todo.pop(0)
             self.pending[t.id] = (t, time.monotonic() + self.lease_timeout,
                                   worker)
+            if worker != "?" and req_id is not None:
+                self._last_grant[worker] = (req_id, t.id)
             self._snapshot()
             return {"ok": True, "task_id": t.id, "payload": t.payload,
                     "num_passes": self.num_passes}
@@ -238,7 +255,8 @@ class MasterService:
         cmd = msg.get("cmd")
         if cmd == "get_task":
             return self.get_task(msg.get("worker", "?"),
-                                 resend=bool(msg.get("resend")))
+                                 resend=bool(msg.get("resend")),
+                                 req_id=msg.get("req_id"))
         if cmd == "task_finished":
             return self.task_finished(msg["task_id"])
         if cmd == "task_failed":
@@ -263,11 +281,17 @@ class MasterService:
                 try:
                     while True:
                         msg = _recv_msg(self.request)
-                        reply = outer._dispatch(msg)
+                        try:
+                            reply = outer._dispatch(msg)
+                        except (KeyError, TypeError, AttributeError,
+                                ValueError) as e:
+                            reply = {"error": "bad request: %r" % (e,)}
                         if reply is _CLOSE:
                             _send_msg(self.request, {"ok": True})
                             break
                         _send_msg(self.request, reply)
+                except WireError:
+                    pass  # malformed frame: drop the connection
                 except (ConnectionError, EOFError):
                     pass
 
@@ -321,6 +345,7 @@ class MasterClient:
         self.worker = worker
         self.dial_timeout = float(dial_timeout)
         self._sock = None
+        self._req_counter = 0
 
     def _call(self, msg, deadline=None):
         """Returns (reply, resent): resent=True when the request was
@@ -371,8 +396,12 @@ class MasterClient:
         can distinguish 'try later' from 'done'."""
         deadline = time.monotonic() + timeout
         while True:
-            r, _ = self._call({"cmd": "get_task", "worker": self.worker},
-                              deadline=deadline)
+            # fresh request id per lease attempt: the master replays a
+            # lease only when a RESEND carries the id that granted it
+            self._req_counter += 1
+            req_id = "%s/%d" % (self.worker, self._req_counter)
+            r, _ = self._call({"cmd": "get_task", "worker": self.worker,
+                               "req_id": req_id}, deadline=deadline)
             if r.get("ok"):
                 return r["task_id"], r["payload"]
             if r.get("retry") and block:

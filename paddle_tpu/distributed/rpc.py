@@ -19,7 +19,6 @@ slot, runs that param's optimize block, bumps the generation, and wakes Get
 waiters; fetch-barrier closes the step.
 """
 
-import pickle
 import socket
 import socketserver
 import struct
@@ -27,14 +26,23 @@ import threading
 
 import numpy as np
 
+from ..native.wire import WireError, decode as _wire_decode, \
+    encode as _wire_encode
+
 __all__ = ["VariableServer", "RPCClient", "serialize_array",
            "deserialize_array"]
 
 _HDR = struct.Struct("<Q")
+# Frame cap: a hostile/garbled length prefix must not become an OOM. Big
+# enough for any sliced param block (slice_variable keeps blocks ~MBs).
+_MAX_FRAME = 1 << 31
 
 
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    """Typed native wire frame (native/wire.cc) with a u64 length prefix —
+    no pickle anywhere on the socket path (the reference's typed
+    VariableMessage serde, grpc_serde.cc, not arbitrary object streams)."""
+    payload = _wire_encode(obj)
     sock.sendall(_HDR.pack(len(payload)) + payload)
 
 
@@ -50,19 +58,25 @@ def _recv_exact(sock, n):
 
 def _recv_msg(sock):
     (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, n))
+    if n > _MAX_FRAME:
+        raise WireError("wire frame length %d exceeds cap" % n)
+    msg = _wire_decode(_recv_exact(sock, n))
+    if not isinstance(msg, dict):
+        # every protocol message (request or reply) is a dict — anything
+        # else is malformed even when the frame itself decodes
+        raise WireError("protocol message must be a dict, got %s"
+                        % type(msg).__name__)
+    return msg
 
 
 def serialize_array(arr):
-    """dtype/shape header + raw buffer (grpc_serde.cc analogue)."""
-    arr = np.ascontiguousarray(arr)
-    return {"dtype": str(arr.dtype), "shape": arr.shape,
-            "data": arr.tobytes()}
+    """Normalize to a wire-encodable ndarray (the codec itself writes the
+    dtype/shape header + raw buffer — grpc_serde.cc analogue)."""
+    return np.ascontiguousarray(arr)
 
 
 def deserialize_array(msg):
-    return np.frombuffer(msg["data"], dtype=np.dtype(msg["dtype"])) \
-        .reshape(msg["shape"]).copy()
+    return np.asarray(msg)
 
 
 def wait_server_ready(endpoints, timeout=60.0):
@@ -123,12 +137,22 @@ class VariableServer:
                 try:
                     while True:
                         msg = _recv_msg(self.request)
-                        reply = outer._dispatch(msg)
+                        try:
+                            reply = outer._dispatch(msg)
+                        except (KeyError, TypeError, AttributeError,
+                                ValueError) as e:
+                            # a decodable frame with the wrong field shape
+                            # gets an error reply, not a dead handler
+                            reply = {"error": "bad request: %r" % (e,)}
                         if reply is _CLOSE:
                             _send_msg(self.request, {"ok": True})
                             break
                         if reply is not None:
                             _send_msg(self.request, reply)
+                except WireError:
+                    # malformed frame: the stream is desynced — drop the
+                    # connection (never crash the server)
+                    pass
                 except (ConnectionError, EOFError):
                     pass
 
@@ -168,7 +192,7 @@ class VariableServer:
 
     # ---- request dispatch ----
     def _dispatch(self, msg):
-        cmd = msg["cmd"]
+        cmd = msg.get("cmd")
         if cmd == "send":
             return self._handle_send(msg)
         if cmd == "get":

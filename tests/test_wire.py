@@ -1,0 +1,251 @@
+"""Native wire codec tests (native/wire.cc + paddle_tpu/native/wire.py).
+
+Reference analogue: grpc_serde_test.cc — serialize a variable into the
+wire format, parse it back, compare; plus the hostile-input cases the
+reference's typed protobuf parsing gave for free and pickle never did.
+"""
+
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from paddle_tpu.native import wire
+
+
+VALUES = [
+    None, True, False, 0, 42, -(1 << 62), 3.25, float("inf"),
+    "", "héllo ∆", b"", b"\x00\xff raw",
+    [], [1, [2, [3]]], (), ("a", (None, 1.5)),
+    {}, {"k": 1, "nested": {"arr": [1, 2]}},
+    np.arange(12, dtype=np.float32).reshape(3, 4),
+    np.array(7, dtype=np.int64),
+    np.zeros((0, 3), dtype=np.int32),
+    np.random.RandomState(0).randn(2, 3, 4).astype(np.float16),
+    {"cmd": "send", "name": "w@GRAD",
+     "var": np.random.RandomState(1).randn(8).astype(np.float64)},
+]
+
+
+def _deep_eq(a, b):
+    if isinstance(a, np.ndarray):
+        return (isinstance(b, np.ndarray) and a.dtype == b.dtype
+                and a.shape == b.shape and np.array_equal(a, b))
+    if isinstance(a, dict):
+        return (isinstance(b, dict) and set(a) == set(b)
+                and all(_deep_eq(a[k], b[k]) for k in a))
+    if isinstance(a, (list, tuple)):
+        return (type(a) is type(b) and len(a) == len(b)
+                and all(_deep_eq(x, y) for x, y in zip(a, b)))
+    return type(a) is type(b) and a == b
+
+
+@pytest.mark.parametrize("value", VALUES,
+                         ids=[str(i) for i in range(len(VALUES))])
+def test_roundtrip_all_codec_pairs(value):
+    # native and pure-python codecs must produce interchangeable frames
+    encoders = [wire._encode_py]
+    decoders = [wire._decode_py]
+    if wire._HAS_NATIVE:
+        encoders.append(wire._encode_native)
+        decoders.append(wire._decode_native)
+    for enc in encoders:
+        frame = enc(value)
+        for dec in decoders:
+            assert _deep_eq(dec(frame), value)
+
+
+def test_native_codec_is_loaded():
+    # the build environment has g++; the native path must actually be
+    # exercised here, not silently fall back
+    assert wire._HAS_NATIVE
+
+
+@pytest.mark.parametrize("frame", [
+    b"",
+    b"short",
+    b"XXXX\x01\x00\x00\x00\x00",                    # bad magic
+    b"PTW1\x63\x00\x00\x00\x00",                    # bad version
+    b"PTW1\x01\x00\x00\x00\x63",                    # unknown tag
+    b"PTW1\x01\x00\x00\x00\x04\xff\xff\xff\xff",    # str claiming 4GB
+    b"PTW1\x01\x00\x00\x00\x06\xff\xff\xff\xffaa",  # list claiming 4G items
+    b"PTW1\x01\x00\x00\x00\x00\x00",                # trailing junk
+    b"PTW1\x01\x00\x00\x00\x09\x00\x00\x00\x00\x02\x00\x00\x00"
+    + b"\xff" * 40,                                  # tensor bad dims
+])
+def test_malformed_frames_rejected(frame):
+    with pytest.raises(wire.WireError):
+        wire.decode(frame)
+    with pytest.raises(wire.WireError):
+        wire._decode_py(frame)
+
+
+def test_hostile_container_count_no_oom():
+    """A count field claiming 4G entries must be rejected up front — not
+    turned into a multi-GB reserve that aborts the process
+    (std::bad_alloc through the C ABI)."""
+    for tag in (6, 7, 8):  # LIST, TUPLE, DICT
+        frame = b"PTW1\x01\x00\x00\x00" + bytes([tag]) + b"\xff" * 4
+        with pytest.raises(wire.WireError):
+            wire.decode(frame)
+        with pytest.raises(wire.WireError):
+            wire._decode_py(frame)
+
+
+def test_non_utf8_dict_key_raises_wire_error():
+    # DICT, 1 entry, klen=1, key=0xff (invalid utf-8), value NONE
+    frame = (b"PTW1\x01\x00\x00\x00\x08\x01\x00\x00\x00"
+             b"\x01\x00\x00\x00\xff\x00")
+    with pytest.raises(wire.WireError):
+        wire.decode(frame)
+    with pytest.raises(wire.WireError):
+        wire._decode_py(frame)
+
+
+def test_non_dict_protocol_message_rejected():
+    """Valid frames that are not dicts are malformed at the protocol
+    layer — servers must reply/close cleanly, not crash on msg['cmd']."""
+    from paddle_tpu.distributed.rpc import VariableServer, _HDR
+    server = VariableServer("127.0.0.1:0").start()
+    try:
+        host, port = server.endpoint.rsplit(":", 1)
+        for payload in (wire.encode(42), wire.encode([1, 2]),
+                        wire.encode({})):  # dict without "cmd"
+            s = socket.create_connection((host, int(port)), timeout=5)
+            s.sendall(_HDR.pack(len(payload)) + payload)
+            s.settimeout(5)
+            got = s.recv(1 << 16)
+            if got:  # {} decodes: server replies an error message
+                n = _HDR.unpack(got[:8])[0]
+                reply = wire.decode(got[8:8 + n])
+                assert "error" in reply
+            s.close()
+        # the server still works for well-formed clients
+        from paddle_tpu.distributed.rpc import RPCClient
+        client = RPCClient()
+        client.put_var(server.endpoint, "v", np.zeros(2, np.float32))
+        assert client.async_get_var(server.endpoint, "v").shape == (2,)
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_truncated_valid_frame_rejected():
+    frame = wire.encode({"cmd": "send", "var": np.arange(100.0)})
+    for cut in (9, len(frame) // 2, len(frame) - 1):
+        with pytest.raises(wire.WireError):
+            wire.decode(frame[:cut])
+
+
+def test_lying_container_count_rejected():
+    # a dict header claiming more entries than the payload carries
+    frame = bytearray(wire.encode({"a": 1}))
+    # dict tag is right after the 8-byte magic/version header
+    assert frame[8] == 8
+    struct.pack_into("<I", frame, 9, 5)  # count 1 -> 5
+    with pytest.raises(wire.WireError):
+        wire.decode(bytes(frame))
+
+
+def test_tensor_shape_bytes_mismatch_rejected():
+    frame = bytearray(wire.encode(np.arange(6, dtype=np.float32)))
+    # bump dims[0] without adding bytes: shape*itemsize != nbytes
+    assert frame[8] == 9
+    struct.pack_into("<Q", frame, 8 + 1 + 4 + 4, 7)
+    with pytest.raises(wire.WireError):
+        wire.decode(bytes(frame))
+
+
+def test_int64_range_and_numpy_bool():
+    # out-of-range ints must raise, not silently wrap through c_int64
+    for v in (1 << 63, -(1 << 63) - 1, 1 << 64 | 5):
+        with pytest.raises(wire.WireError):
+            wire.encode(v)
+        with pytest.raises(wire.WireError):
+            wire._encode_py(v)
+    assert wire.decode(wire.encode((1 << 63) - 1)) == (1 << 63) - 1
+    assert wire.decode(wire.encode(-(1 << 63))) == -(1 << 63)
+    # np.bool_ (numpy comparison results) encodes as BOOL
+    got = wire.decode(wire.encode({"done": np.bool_(True)}))
+    assert got == {"done": True} and isinstance(got["done"], bool)
+
+
+def test_master_ignores_unreadable_snapshot(tmp_path):
+    """A corrupt/pre-wire snapshot must not wedge the master at boot."""
+    import warnings
+    from paddle_tpu.distributed.elastic import MasterService
+    snap = str(tmp_path / "m.snap")
+    with open(snap, "wb") as f:
+        f.write(b"\x00\x01\x02corrupt-not-a-snapshot")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m = MasterService("127.0.0.1:0", snapshot_path=snap)
+        assert any("unreadable master snapshot" in str(x.message)
+                   for x in w)
+    assert m.todo == [] and not m.dataset_set  # fresh queue
+
+
+def test_wire_error_is_value_error():
+    # load_state_snapshot documents ValueError on corruption
+    assert issubclass(wire.WireError, ValueError)
+
+
+def test_no_pickle_on_socket_paths():
+    import paddle_tpu.distributed.rpc as rpc
+    import paddle_tpu.distributed.elastic as elastic
+    for mod in (rpc, elastic):
+        src = open(mod.__file__.rstrip("c")).read()
+        assert "import pickle" not in src
+        assert "pickle.loads" not in src
+
+
+def test_malformed_frame_does_not_crash_server():
+    """A hostile client sending garbage must not take the server down or
+    poison other connections (the clean-error half of VERDICT Next #4)."""
+    from paddle_tpu.distributed.rpc import RPCClient, VariableServer
+    server = VariableServer("127.0.0.1:0").start()
+    try:
+        ep = server.endpoint
+        host, port = ep.rsplit(":", 1)
+        # 1. raw garbage with a plausible length prefix
+        s = socket.create_connection((host, int(port)), timeout=5)
+        s.sendall(struct.pack("<Q", 16) + b"\xde\xad\xbe\xef" * 4)
+        # server drops the connection instead of replying
+        s.settimeout(5)
+        assert s.recv(1) == b""
+        s.close()
+        # 2. absurd length prefix must not OOM — connection dropped
+        s = socket.create_connection((host, int(port)), timeout=5)
+        s.sendall(struct.pack("<Q", 1 << 62))
+        s.sendall(b"x" * 64)
+        s.settimeout(5)
+        assert s.recv(1) == b""
+        s.close()
+        # 3. a well-formed client still gets service afterwards
+        client = RPCClient()
+        client.put_var(ep, "w", np.ones(3, dtype=np.float32))
+        out = client.async_get_var(ep, "w")
+        np.testing.assert_array_equal(out, np.ones(3, dtype=np.float32))
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_master_resend_dedup_by_req_id():
+    """get_task replay is keyed by request id: a RESEND of the same
+    request returns the same lease; a NEW request from the same worker
+    leases fresh work (ADVICE r3: held[-1] replay duplicated tasks)."""
+    from paddle_tpu.distributed.elastic import MasterService
+    master = MasterService("127.0.0.1:0", lease_timeout=60.0)
+    master.set_dataset(["a", "b", "c"])
+    r1 = master.get_task(worker="w0", req_id="w0/1")
+    # lost-reply retry: same req_id -> same task
+    r1b = master.get_task(worker="w0", resend=True, req_id="w0/1")
+    assert r1b["task_id"] == r1["task_id"]
+    # next logical request (reply WAS delivered): new req_id -> new task,
+    # even though the connection flapped and resend is set
+    r2 = master.get_task(worker="w0", resend=True, req_id="w0/2")
+    assert r2["task_id"] != r1["task_id"]
+    # and the first lease is still pending exactly once
+    assert sorted(master.pending) == sorted([r1["task_id"], r2["task_id"]])

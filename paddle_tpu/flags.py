@@ -184,3 +184,18 @@ DEFINE_bool(
     "enable_rpc_profiler", False,
     "Record every parameter-server RPC as a profiler event "
     "(reference profiler.cc:33 FLAGS_enable_rpc_profiler).")
+DEFINE_int(
+    "while_grad_max_iters", 256,
+    "Trip-count bucket for differentiating an UNBOUNDED While loop "
+    "in-graph: the jit-native while gradient records per-iteration "
+    "carries into a static buffer of this size. A loop still running at "
+    "the cap poisons its float carries with NaN (loud failure, never a "
+    "silently-truncated forward). Raise it for longer data-dependent "
+    "loops; memory cost is cap x carry size.")
+DEFINE_bool(
+    "dynamic_while_host_grad", False,
+    "Differentiate unbounded While loops via the host-path replay op "
+    "(while_grad_dynamic) instead of the jit-native recorded gradient. "
+    "The replay supports truly unbounded trip counts but forces the "
+    "whole program onto the segmented eager path (reference "
+    "while_op.cc:119 semantics).")

@@ -102,6 +102,8 @@ def _while(ctx):
         return tuple(e[n] for n in carry_names)
 
     max_iters = ctx.attr("max_iters")
+    record_cap = ctx.attr("grad_max_iters") \
+        if ctx.attr("record_for_grad", False) else None
     if functionalizer.block_tree_has_host_ops(block):
         # host ops (save/send/...) need concrete values each iteration:
         # interpret the body per iteration on the host, like the
@@ -129,6 +131,9 @@ def _while(ctx):
             return kept, None
         final, _ = jax.lax.scan(scan_body, init, None,
                                 length=int(max_iters))
+    elif record_cap and not ctx.attr("is_test", False):
+        final = _recorded_while(ctx, block, carry_names, closure, init,
+                                cond_name, run_body, int(record_cap))
     else:
         def cond_fun(carry):
             return overlay(carry)[cond_name].reshape(())
@@ -144,6 +149,128 @@ def _while(ctx):
         return {}
     by_name = dict(zip(carry_names, final))
     return {"Out": [by_name.get(n) for n in out_names]}
+
+
+def _recorded_while(ctx, block, carry_names, closure, init, cond_name,
+                    run_body, cap):
+    """Jit-native gradient for a dynamic-trip-count while (VERDICT r3 #3;
+    reference WhileGradOp, while_op.cc:119 — but in-graph instead of a
+    nested-executor replay).
+
+    Forward: `lax.while_loop` that records each iteration's pre-body
+    carries into a static [cap, ...] buffer (the in-graph analogue of the
+    reference's per-iteration scopes), truncating at `cap` iterations
+    (FLAGS.while_grad_max_iters bucketing — XLA needs a static bound for
+    the residual buffer).
+    Backward: a reverse `lax.while_loop` from the recorded trip count
+    down to 0, running the body's vjp at each recorded carry — cost
+    O(actual trip count), not O(cap). The whole construct is a
+    `jax.custom_vjp`, so the generic per-op vjp machinery differentiates
+    through it and the training program stays inside ONE jitted XLA
+    computation (no SegmentedProgramRunner).
+
+    Overflow is LOUD: if the loop still wants to run at `cap` iterations,
+    every float carry is poisoned with NaN — a silently-truncated forward
+    would train on wrong values (and diverge from the is_test lowering,
+    which stays unbounded). Raise FLAGS.while_grad_max_iters when this
+    trips."""
+    import jax
+    jnp = _jnp()
+
+    def is_floatv(v):
+        return jnp.issubdtype(jnp.result_type(v), jnp.floating)
+
+    d_names = [n for n, v in zip(carry_names, init) if is_floatv(v)]
+    n_names = [n for n in carry_names if n not in d_names]
+    vals_by_name = dict(zip(carry_names, init))
+    dcl_names = [n for n, v in closure.items() if is_floatv(v)]
+    ndcl = {n: v for n, v in closure.items() if n not in dcl_names}
+
+    def run_env(dc, ndc, dcl):
+        e = dict(ndcl)
+        e.update(zip(dcl_names, dcl))
+        e.update(zip(n_names, ndc))
+        e.update(zip(d_names, dc))
+        return e
+
+    def step_all(dc, ndc, dcl):
+        e = run_env(dc, ndc, dcl)
+        new = dict(zip(carry_names, run_body(e)))
+        return (tuple(new[n] for n in d_names),
+                tuple(new[n] for n in n_names))
+
+    def fwd_impl(dc0, dcl):
+        ndc0 = tuple(vals_by_name[n] for n in n_names)
+        bd = tuple(jnp.zeros((cap,) + tuple(v.shape), jnp.result_type(v))
+                   for v in dc0)
+        bn = tuple(jnp.zeros((cap,) + tuple(v.shape), jnp.result_type(v))
+                   for v in ndc0)
+
+        def cond_fn(c):
+            i, dc, ndc = c[0], c[1], c[2]
+            e = run_env(dc, ndc, dcl)
+            return jnp.logical_and(
+                e[cond_name].reshape(()).astype(bool), i < cap)
+
+        def body_fn(c):
+            i, dc, ndc, bd, bn = c
+            bd = tuple(b.at[i].set(v) for b, v in zip(bd, dc))
+            bn = tuple(b.at[i].set(v) for b, v in zip(bn, ndc))
+            dc2, ndc2 = step_all(dc, ndc, dcl)
+            return (i + 1, dc2, ndc2, bd, bn)
+
+        return jax.lax.while_loop(
+            cond_fn, body_fn, (jnp.asarray(0, jnp.int32), dc0, ndc0,
+                               bd, bn))
+
+    def finals(t, dc, ndc, dcl):
+        # cap reached with the condition still true = truncated loop:
+        # poison the float finals so training fails loudly instead of
+        # silently optimizing a different (shorter) program
+        e = run_env(dc, ndc, dcl)
+        overflow = jnp.logical_and(
+            t >= cap, e[cond_name].reshape(()).astype(bool))
+        return tuple(jnp.where(overflow, jnp.nan, v).astype(v.dtype)
+                     for v in dc), ndc
+
+    @jax.custom_vjp
+    def run(dc0, dcl):
+        t, dc, ndc, _, _ = fwd_impl(dc0, dcl)
+        return finals(t, dc, ndc, dcl)
+
+    def run_fwd(dc0, dcl):
+        t, dc, ndc, bd, bn = fwd_impl(dc0, dcl)
+        return finals(t, dc, ndc, dcl), (t, bd, bn, dcl)
+
+    def run_bwd(res, g):
+        t, bd, bn, dcl = res
+        g_dc = tuple(g[0])  # cotangents for the nondiff finals are float0
+        g_dcl = tuple(jnp.zeros(v.shape, jnp.result_type(v)) for v in dcl)
+
+        def cond_fn(c):
+            return c[0] >= 0
+
+        def body_fn(c):
+            k, gdc, gdcl = c
+            dck = tuple(b[k] for b in bd)
+            ndck = tuple(b[k] for b in bn)
+            _, vjp_fn = jax.vjp(
+                lambda d, cl: step_all(d, ndck, cl)[0], dck, dcl)
+            gd, gcl = vjp_fn(gdc)
+            return (k - 1, tuple(gd),
+                    tuple(a + b for a, b in zip(gdcl, gcl)))
+
+        _, g_dc, g_dcl = jax.lax.while_loop(
+            cond_fn, body_fn, (t - 1, g_dc, g_dcl))
+        return g_dc, g_dcl
+
+    run.defvjp(run_fwd, run_bwd)
+
+    dc_f, ndc_f = run(tuple(vals_by_name[n] for n in d_names),
+                      tuple(closure[n] for n in dcl_names))
+    by = dict(zip(d_names, dc_f))
+    by.update(zip(n_names, ndc_f))
+    return tuple(by[n] for n in carry_names)
 
 
 def _is_float_var(block, name):
@@ -202,6 +329,19 @@ def _while_grad_maker(op, block, grad_map, no_grad_set, bw_ctx=None):
     if op.attrs.get("max_iters"):
         return None      # bounded scan: generic vjp path (grads seeded
                          # from the force-finalized map above)
+
+    from ..flags import FLAGS
+    from ..fluid import functionalizer as _fz
+    if not FLAGS.dynamic_while_host_grad and \
+            not _fz.block_tree_has_host_ops(op.attrs.get("sub_block")):
+        # jit-native dynamic-while gradient (VERDICT r3 #3): mark the
+        # forward op to lower to the recording custom_vjp form
+        # (_recorded_while) and decline to the generic vjp path — the
+        # training program stays fully jitted. Host-op bodies (save/
+        # send/print...) still need the replay below.
+        op.attrs["record_for_grad"] = True
+        op.attrs["grad_max_iters"] = int(FLAGS.while_grad_max_iters)
+        return None
 
     out_grads = [grad_map.get(n, "") for n in out_names]
     if not any(out_grads):

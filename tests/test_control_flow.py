@@ -134,11 +134,8 @@ def test_while_bound_auto_derived_trains():
     assert vals[-1] < vals[0], vals
 
 
-def test_while_dynamic_bound_emits_replay_grad_op():
-    """A genuinely data-dependent limit (fed at runtime) cannot derive a
-    static bound: backward now emits the replay-based while_grad_dynamic
-    op (reference while_op.cc:119) instead of raising, with initial-carry
-    snapshots inserted before the forward loop."""
+def _build_dynamic_while_program():
+    """Loop whose limit is a runtime feed — no derivable static bound."""
     main, startup = Program(), Program()
     with fluid.program_guard(main, startup):
         x = fluid.layers.data("x", shape=[4], dtype="float32")
@@ -155,11 +152,99 @@ def test_while_dynamic_bound_emits_replay_grad_op():
             fluid.layers.less_than(i, n, cond=cond)
         loss = fluid.layers.mean(h)
         fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_while_dynamic_bound_takes_jit_native_grad_path():
+    """A genuinely data-dependent limit (fed at runtime) cannot derive a
+    static bound: backward marks the forward op for in-graph carry
+    recording (record_for_grad) and differentiates via the generic vjp
+    machinery — the program stays FULLY jitted, no host-path replay op
+    and no SegmentedProgramRunner (VERDICT r3 #3; reference
+    while_op.cc:119 ran while-grad in-graph too)."""
+    main, startup, loss = _build_dynamic_while_program()
     types = [op.type for op in main.global_block().ops]
+    assert "while_grad_dynamic" not in types
+    assert "while_grad" in types
+    wop = next(op for op in main.global_block().ops if op.type == "while")
+    assert wop.attrs.get("record_for_grad") is True
+    assert wop.attrs.get("grad_max_iters") == \
+        fluid.flags.FLAGS.while_grad_max_iters
+    # ... and it actually trains on the fully-jitted executor path
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        (l0,) = exe.run(
+            main, feed={"x": np.ones((2, 4), np.float32),
+                        "n": np.array([[3]], np.int64)},
+            fetch_list=[loss])
+    assert np.isfinite(float(np.asarray(l0).ravel()[0]))
+    assert exe.segmented_runner(main) is None, \
+        "dynamic-while training program must not engage the host path"
+
+
+def test_while_dynamic_host_replay_flag_matches_jit_native():
+    """FLAGS.dynamic_while_host_grad=True restores the round-3 host-path
+    replay (while_grad_dynamic + initial-carry snapshots); losses over a
+    training trajectory match the jit-native recorded path."""
+    from paddle_tpu.flags import FLAGS
+
+    def run_losses(n_steps=6):
+        main, startup, loss = _build_dynamic_while_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.executor.Scope()
+        rng = np.random.RandomState(11)
+        losses = []
+        with fluid.executor.scope_guard(scope):
+            exe.run(startup)
+            scope.set("w3", (np.eye(4) * 0.5).astype(np.float32))
+            for step in range(n_steps):
+                xv = rng.randn(2, 4).astype(np.float32)
+                nv = np.array([[1 + step % 3]], np.int64)
+                (l,) = exe.run(main, feed={"x": xv, "n": nv},
+                               fetch_list=[loss])
+                losses.append(float(np.asarray(l).ravel()[0]))
+        return main, losses
+
+    _, jit_losses = run_losses()
+    FLAGS.dynamic_while_host_grad = True
+    try:
+        host_main, host_losses = run_losses()
+    finally:
+        FLAGS.dynamic_while_host_grad = False
+    types = [op.type for op in host_main.global_block().ops]
     assert "while_grad_dynamic" in types
     widx = types.index("while")
-    # initial-carry snapshots precede the forward loop
+    # initial-carry snapshots precede the forward loop on the host path
     assert types[widx - 1] == "assign"
+    np.testing.assert_allclose(jit_losses, host_losses, rtol=2e-4,
+                               err_msg="jit-native while grad diverged "
+                                       "from the host replay path")
+
+
+def test_while_grad_cap_overflow_is_loud():
+    """A dynamic loop still running at FLAGS.while_grad_max_iters must
+    poison its carries with NaN — never a silently-truncated forward."""
+    from paddle_tpu.flags import FLAGS
+    old = FLAGS.while_grad_max_iters
+    FLAGS.while_grad_max_iters = 4
+    try:
+        main, startup, loss = _build_dynamic_while_program()
+    finally:
+        FLAGS.while_grad_max_iters = old
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        (ok,) = exe.run(main, feed=dict(feed, n=np.array([[3]], np.int64)),
+                        fetch_list=[loss])       # 3 < cap: fine
+        (bad,) = exe.run(main, feed=dict(feed, n=np.array([[9]], np.int64)),
+                         fetch_list=[loss])      # 9 > cap: poisoned
+    assert np.isfinite(float(np.asarray(ok).ravel()[0]))
+    assert np.isnan(float(np.asarray(bad).ravel()[0])), \
+        "truncated while forward must fail loudly"
 
 
 def test_conditional_block():
@@ -521,7 +606,7 @@ def test_dynamic_while_grad_with_pre_loop_consumer():
             fluid.layers.mean(state), pre)
         pg = fluid.backward.append_backward(loss)
     types = [op.type for op in main.global_block().ops]
-    assert "while_grad_dynamic" in types, types
+    assert "while_grad" in types, types   # jit-native recorded path
     gmap = {p.name: g.name for p, g in pg}
     assert "pw0" in gmap
     exe = fluid.Executor(fluid.CPUPlace())

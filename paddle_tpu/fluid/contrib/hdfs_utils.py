@@ -157,8 +157,10 @@ class HDFSClient(object):
             ok, out = self._run(["-ls", hdfs_path], 3)
             if not ok:
                 return []
-            return [line.split()[-1] for line in out.splitlines()
-                    if line and not line.startswith("Found")]
+            # 8 fields, maxsplit=7: spaces in the path stay intact
+            return [line.split(None, 7)[7] for line in out.splitlines()
+                    if line and not line.startswith("Found")
+                    and len(line.split(None, 7)) >= 8]
         p = self._local(hdfs_path)
         if not os.path.isdir(p):
             return []
@@ -172,12 +174,14 @@ class HDFSClient(object):
                 return []
             out = []
             for line in out_text.splitlines():
-                parts = line.split()
+                # `hadoop fs -ls` emits 8 whitespace-separated fields;
+                # maxsplit=7 keeps paths containing spaces intact
+                parts = line.split(None, 7)
                 if len(parts) < 8:
                     continue
                 if only_file and parts[0].startswith("d"):
                     continue
-                out.append(parts[-1])
+                out.append(parts[7])
             return sorted(out) if sort else out
         p = self._local(hdfs_path)
         out = []

@@ -703,17 +703,45 @@ class DynamicRNNGuard(BlockGuard):
 class IfElseBlockGuard(object):
     """reference control_flow.py:1379."""
 
+    # ops whose result couples rows of the batch: under the dense-masking
+    # lowering these see the non-selected rows as ZEROS, which diverges
+    # from the reference's row-split semantics (e.g. a mean divides by
+    # the full batch size, not the branch's row count)
+    _CROSS_ROW_OPS = frozenset([
+        "mean", "reduce_mean", "batch_norm", "data_norm", "auc",
+        "accuracy", "sequence_pool", "sequence_softmax", "sequence_conv",
+        "sequence_expand", "sequence_concat", "sequence_reshape",
+    ])
+
     def __init__(self, is_true, ie):
         self.ie = ie
         self.is_true = is_true
+        self._op_start = 0
 
     def __enter__(self):
         self.ie.status = (IfElse.IN_IF_ELSE_TRUE_BLOCKS if self.is_true
                           else IfElse.IN_IF_ELSE_FALSE_BLOCKS)
+        self._op_start = len(
+            self.ie.helper.main_program.current_block().ops)
         return self
 
     def __exit__(self, *a):
         self.ie.status = IfElse.OUT_IF_ELSE_BLOCKS
+        block = self.ie.helper.main_program.current_block()
+        crossers = sorted({op.type
+                           for op in block.ops[self._op_start:]
+                           if op.type in self._CROSS_ROW_OPS})
+        if crossers and not self.ie._warned_cross_row:
+            self.ie._warned_cross_row = True
+            import warnings
+            warnings.warn(
+                "IfElse branch contains cross-row op(s) %s: this build "
+                "lowers IfElse to dense masking (both branches run over "
+                "the full batch, non-selected rows zeroed), so batch-"
+                "coupled results differ from the reference's row-split "
+                "semantics (a mean divides by the full batch size). "
+                "Restructure with row-wise ops, or apply the reduction "
+                "outside the IfElse." % ", ".join(crossers))
         return False
 
 
@@ -740,6 +768,7 @@ class IfElse(object):
         self.input_table = {}
         self.status = IfElse.OUT_IF_ELSE_BLOCKS
         self.output_table = [[], []]   # [false_outs, true_outs]
+        self._warned_cross_row = False
 
     def input(self, x):
         if self.status == IfElse.OUT_IF_ELSE_BLOCKS:

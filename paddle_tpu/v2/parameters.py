@@ -20,6 +20,76 @@ from .topology import Topology
 __all__ = ["Parameters", "create"]
 
 
+# -- minimal ParameterConfig protobuf wire codec ---------------------------
+# proto/ParameterConfig.proto:34 — required string name = 1, required
+# uint64 size = 2, repeated uint64 dims = 9. Hand-encoded (protobuf wire
+# format: varints + length-delimited fields) because the image has no
+# generated bindings for the reference protos; unknown fields written by
+# the reference (learning_rate, momentum, ...) are skipped on read.
+
+def _varint(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(data, pos):
+    result = shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated ParameterConfig varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _encode_param_config(name, arr):
+    raw = name.encode("utf-8")
+    out = b"\x0a" + _varint(len(raw)) + raw          # field 1: name
+    out += b"\x10" + _varint(int(arr.size))          # field 2: size
+    for d in arr.shape:
+        out += b"\x48" + _varint(int(d))             # field 9: dims
+    return out
+
+
+def _decode_param_config(data):
+    name, size, dims, pos = None, 0, [], 0
+    while pos < len(data):
+        key, pos = _read_varint(data, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(data, pos)
+            if field == 2:
+                size = val
+            elif field == 9:
+                dims.append(val)
+        elif wire == 2:
+            ln, pos = _read_varint(data, pos)
+            if pos + ln > len(data):
+                raise ValueError("truncated ParameterConfig field")
+            if field == 1:
+                name = data[pos:pos + ln].decode("utf-8")
+            pos += ln
+        elif wire == 1:
+            pos += 8
+        elif wire == 5:
+            pos += 4
+        else:
+            raise ValueError("bad ParameterConfig wire type %d" % wire)
+    if name is None:
+        raise ValueError("ParameterConfig missing required name field")
+    return name, size, tuple(dims)
+
+
 def create(layers):
     """Create Parameters for the topology rooted at `layers` (reference
     parameters.py:27). Runs the startup program once to materialize
@@ -109,43 +179,81 @@ class Parameters(object):
                 self.__param_dict__[name] = np.asarray(val)
 
     # -- serialization (reference parameters.py:296-:400) ------------------
+    # The on-disk format IS the reference's: each tar holds a raw-payload
+    # member per parameter (header u32 version=0, u32 elem_size=4, u64
+    # NUM_ELEMENTS, then raw fp32 — parameters.py:306) plus a
+    # '<name>.protobuf' member carrying a ParameterConfig message
+    # (proto/ParameterConfig.proto:34) whose `dims` field recovers the
+    # shape at load time. The config codec below hand-writes the protobuf
+    # wire format for the fields this framework uses (name=1, size=2,
+    # dims=9) and skips unknown fields, so reference-produced model tars
+    # load here and tars written here load in the reference.
+
     def serialize(self, name, f):
-        """Single-parameter binary: u32 version, u32 elem size, u64 rank,
-        rank*u64 dims, raw fp32 data — self-describing like the reference's
-        Parameter header."""
         arr = np.asarray(self.get(name), dtype=np.float32)
-        f.write(struct.pack("<IIQ", 0, 4, arr.ndim))
-        for d in arr.shape:
-            f.write(struct.pack("<Q", d))
+        f.write(struct.pack("<IIQ", 0, 4, int(arr.size)))
         f.write(arr.tobytes())
 
     def deserialize(self, name, f):
-        _, _, rank = struct.unpack("<IIQ", f.read(16))
-        shape = tuple(struct.unpack("<Q", f.read(8))[0]
-                      for _ in range(rank))
-        count = int(np.prod(shape)) if shape else 1
-        arr = np.frombuffer(f.read(4 * count),
-                            dtype=np.float32).reshape(shape)
-        self.set(name, arr.copy())
+        version, elem_size, count = struct.unpack("<IIQ", f.read(16))
+        if version != 0 or elem_size != 4:
+            raise ValueError(
+                "parameter %r: unsupported header (version=%d elem_size=%d)"
+                " — not a v2 model tar produced by this framework or the "
+                "reference" % (name, version, elem_size))
+        arr = np.frombuffer(f.read(4 * count), dtype=np.float32)
+        if arr.size != count:
+            raise ValueError("parameter %r: truncated payload" % name)
+        self.set(name, arr.reshape(self.get_shape(name)).copy())
 
     def to_tar(self, f):
         with tarfile.open(fileobj=f, mode="w") as tar:
-            for name in self.keys():
-                buf = _io.BytesIO()
-                self.serialize(name, buf)
-                data = buf.getvalue()
+            def add(name, data):
                 info = tarfile.TarInfo(name=name)
                 info.size = len(data)
                 tar.addfile(info, _io.BytesIO(data))
+            for name in self.keys():
+                buf = _io.BytesIO()
+                self.serialize(name, buf)
+                add(name, buf.getvalue())
+                add("%s.protobuf" % name, _encode_param_config(
+                    name, np.asarray(self.get(name))))
 
     @staticmethod
     def from_tar(f):
         params = Parameters()
         with tarfile.open(fileobj=f, mode="r") as tar:
+            # pass 1: ParameterConfig members give names + shapes
             for member in tar.getmembers():
-                buf = tar.extractfile(member)
-                params.__param_dict__[member.name] = None
-                params.deserialize(member.name, buf)
+                if member.name.endswith(".protobuf"):
+                    name, size, dims = _decode_param_config(
+                        tar.extractfile(member).read())
+                    if not dims:
+                        # configs without dims: a true scalar when size
+                        # is 1 (our 0-d round-trip), else a flat vector
+                        dims = () if int(size) == 1 else (int(size),)
+                    params.__param_dict__[name] = None
+                    params.__shapes__[name] = tuple(int(d) for d in dims)
+            if not params.__shapes__:
+                raise ValueError(
+                    "model tar has no ParameterConfig ('.protobuf') "
+                    "members — not a v2 model tar (reference "
+                    "parameters.py to_tar writes one per parameter)")
+            # pass 2: extract each configured payload BY NAME (reference
+            # from_tar:381 — unrelated tar members are ignored, and a
+            # config without its payload is an error here, not a silent
+            # None entry)
+            for name in list(params.__param_dict__):
+                try:
+                    payload = tar.extractfile(name)
+                except KeyError:
+                    payload = None
+                if payload is None:
+                    raise ValueError(
+                        "model tar is missing the payload member for "
+                        "parameter %r (has only its .protobuf config)"
+                        % name)
+                params.deserialize(name, payload)
         return params
 
     def init_from_tar(self, f, exclude_params=None):

@@ -223,6 +223,34 @@ def test_while_dynamic_host_replay_flag_matches_jit_native():
                                        "from the host replay path")
 
 
+def test_ifelse_cross_row_op_warns():
+    """ADVICE r3: the dense-masking IfElse lowering diverges from the
+    reference's row-split semantics for batch-coupled ops — that must
+    surface as a warning at build time, not only in a docstring."""
+    import warnings
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1])
+        limit = fluid.layers.fill_constant([1], "float32", 0.0)
+        cond = fluid.layers.less_than(x, limit)
+        ie = fluid.layers.IfElse(cond)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            with ie.true_block():
+                xt = ie.input(x)
+                fluid.layers.mean(xt)          # couples rows
+                ie.output(xt)
+        assert any("cross-row" in str(x.message) for x in w)
+        # row-wise branches stay silent
+        ie2 = fluid.layers.IfElse(cond)
+        with warnings.catch_warnings(record=True) as w2:
+            warnings.simplefilter("always")
+            with ie2.true_block():
+                xt = ie2.input(x)
+                ie2.output(fluid.layers.scale(xt, scale=2.0))
+        assert not any("cross-row" in str(x.message) for x in w2)
+
+
 def test_while_grad_cap_overflow_is_loud():
     """A dynamic loop still running at FLAGS.while_grad_max_iters must
     poison its carries with NaN — never a silently-truncated forward."""

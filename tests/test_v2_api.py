@@ -109,6 +109,102 @@ def test_v2_parameters_tar_roundtrip_and_shape_check():
     np.testing.assert_array_equal(params.get(k0), restored.get(k0))
 
 
+def test_v2_parameters_reference_tar_format_interop():
+    """The tar layout matches the reference byte-for-byte (ADVICE r3):
+    payload header = (version u32, elem_size u32, NUM_ELEMENTS u64) + raw
+    fp32 (reference parameters.py:306), plus a '<name>.protobuf'
+    ParameterConfig member whose dims field recovers the shape (:348).
+    Construct a tar exactly as the reference writer would and load it."""
+    import struct
+    import tarfile
+    rng = np.random.RandomState(0)
+    w = rng.randn(3, 5).astype(np.float32)
+    b = rng.randn(7).astype(np.float32)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        def add(name, data):
+            info = tarfile.TarInfo(name=name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+        for name, arr in (("ref_w", w), ("ref_b", b)):
+            add(name, struct.pack("<IIQ", 0, 4, arr.size) + arr.tobytes())
+            # ParameterConfig: name=1 (len-delim), size=2 (varint),
+            # momentum=4 (fixed64, must be SKIPPED), dims=9 (varints)
+            conf = (b"\x0a" + bytes([len(name)]) + name.encode()
+                    + b"\x10" + bytes([arr.size])
+                    + b"\x21" + struct.pack("<d", 0.9)
+                    + b"".join(b"\x48" + bytes([d]) for d in arr.shape))
+            add(name + ".protobuf", conf)
+    buf.seek(0)
+    params = paddle.parameters.Parameters.from_tar(buf)
+    assert sorted(params.keys()) == ["ref_b", "ref_w"]
+    np.testing.assert_array_equal(params.get("ref_w"), w)
+    np.testing.assert_array_equal(params.get("ref_b"), b)
+    assert params.get_shape("ref_w") == (3, 5)
+    # ... and our writer emits '.protobuf' members the reference expects
+    out = io.BytesIO()
+    params.to_tar(out)
+    out.seek(0)
+    with tarfile.open(fileobj=out, mode="r") as tar:
+        names = sorted(m.name for m in tar.getmembers())
+    assert names == ["ref_b", "ref_b.protobuf", "ref_w", "ref_w.protobuf"]
+
+
+def test_v2_parameters_tar_edge_cases():
+    import tarfile
+    # 0-d parameter survives a round trip with shape () intact
+    p = paddle.parameters.Parameters()
+    p.set("scalar", np.float32(3.5))
+    p.set("vec1", np.ones((1,), np.float32))
+    buf = io.BytesIO()
+    p.to_tar(buf)
+    buf.seek(0)
+    r = paddle.parameters.Parameters.from_tar(buf)
+    assert r.get_shape("scalar") == ()
+    assert r.get_shape("vec1") == (1,)
+    buf.seek(0)
+    p.init_from_tar(buf)  # must not raise shape mismatch
+    # extra non-parameter members are ignored (reference iterates
+    # configs, not all members)
+    buf2 = io.BytesIO()
+    with tarfile.open(fileobj=buf2, mode="w") as tar:
+        buf.seek(0)
+        with tarfile.open(fileobj=buf, mode="r") as src:
+            for m in src.getmembers():
+                tar.addfile(m, src.extractfile(m))
+        info = tarfile.TarInfo(name="README")
+        info.size = 5
+        tar.addfile(info, io.BytesIO(b"hello"))
+    buf2.seek(0)
+    r2 = paddle.parameters.Parameters.from_tar(buf2)
+    assert sorted(r2.keys()) == ["scalar", "vec1"]
+    # a config without its payload is a loud error, not a None entry
+    buf3 = io.BytesIO()
+    with tarfile.open(fileobj=buf3, mode="w") as tar:
+        conf = b"\x0a\x01w\x10\x04\x48\x02\x48\x02"
+        info = tarfile.TarInfo(name="w.protobuf")
+        info.size = len(conf)
+        tar.addfile(info, io.BytesIO(conf))
+    buf3.seek(0)
+    with pytest.raises(ValueError, match="missing the payload"):
+        paddle.parameters.Parameters.from_tar(buf3)
+
+
+def test_v2_parameters_rejects_non_model_tar():
+    """A tar with no ParameterConfig members (e.g. the pre-round-4 rank
+    format) is rejected with a clear error, not misparsed."""
+    import tarfile
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        data = b"\x00" * 32
+        info = tarfile.TarInfo(name="w")
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+    buf.seek(0)
+    with pytest.raises(ValueError, match="protobuf"):
+        paddle.parameters.Parameters.from_tar(buf)
+
+
 def test_v2_conv_network_trains():
     images = paddle.layer.data(
         "image", paddle.data_type.dense_vector(64), height=8, width=8)

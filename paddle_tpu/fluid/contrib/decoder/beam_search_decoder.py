@@ -13,8 +13,10 @@ beam_search op returns parent pointers, and states reorder with one
 models/machine_translation.py generation, which validates the encoding
 end to end)."""
 
+import contextlib
+
 from ...layer_helper import LayerHelper
-from ... import layers
+from ... import layers, unique_name
 
 __all__ = ["InitState", "StateCell", "TrainingDecoder",
            "BeamSearchDecoder"]
@@ -304,6 +306,14 @@ class BeamSearchDecoder(object):
         self._score_bias_attr = score_bias_attr
         self._sentence_ids = None
         self._sentence_scores = None
+        # custom-block decode state (block/read_array/update_array)
+        self._counter = None
+        self._cond = None
+        self._zero_idx = None
+        self._array_dict = {}
+        self._array_link = []
+        self._ids_array = None
+        self._scores_array = None
 
     @property
     def type(self):
@@ -413,7 +423,105 @@ class BeamSearchDecoder(object):
         self._status = BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER
         cell._leave_decoder(self)
 
+    # -- custom-block decoding (reference :616-:800) ----------------------
+    # decode() above is the canonical DENSE search; block() exposes the
+    # reference's build-your-own-step contract: ops recorded inside run
+    # once per generation step in a While owned by the decoder, with
+    # TensorArrays threading per-step selections. Data-dependent array
+    # indices/lengths need concrete values, so the loop runs on the
+    # host-interpreted path (force_host — the reference's WhileOp ran a
+    # nested Executor per iteration too, while_op.cc:50).
+
+    @contextlib.contextmanager
+    def block(self):
+        """Define custom per-step decode behavior (reference :616)."""
+        if self._status != BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER:
+            raise ValueError("block() can only be invoked once")
+        self._status = BeamSearchDecoder.IN_BEAM_SEARCH_DECODER
+        self._state_cell._enter_decoder(self)
+        self._counter = layers.zeros(shape=[1], dtype="int64")
+        self._counter.stop_gradient = True
+        max_len_var = layers.fill_constant([1], "int64", self._max_len)
+        self._cond = layers.less_than(self._counter, max_len_var)
+        self._zero_idx = layers.fill_constant([1], "int64", 0,
+                                              force_cpu=True)
+        while_op = layers.While(self._cond, force_host=True)
+        with while_op.block():
+            yield
+            with layers.Switch() as switch:
+                with switch.case(self._cond):
+                    layers.increment(self._counter, value=1.0,
+                                     in_place=True)
+                    for value, array in self._array_link:
+                        layers.array_write(value, i=self._counter,
+                                           array=array)
+                    layers.less_than(self._counter, max_len_var,
+                                     cond=self._cond)
+        self._status = BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER
+        self._state_cell._leave_decoder(self)
+
+    def early_stop(self):
+        """Stop generation before max_len — a "break" (reference :646)."""
+        self._assert_in_decoder_block("early_stop")
+        layers.fill_constant(shape=[1], dtype="bool", value=0,
+                             force_cpu=True, out=self._cond)
+
+    def read_array(self, init, is_ids=False, is_scores=False):
+        """Read this step's value of a loop-carried array; `init` seeds
+        step 0 (reference :731)."""
+        self._assert_in_decoder_block("read_array")
+        if is_ids and is_scores:
+            raise ValueError("an array cannot be both ids and scores")
+        parent_block = self._parent_block()
+        array = parent_block.create_var(
+            name=unique_name.generate("beam_search_decoder_array"),
+            dtype=init.dtype)
+        parent_block.append_op(
+            type="write_to_array",
+            inputs={"X": [init], "I": [self._zero_idx]},
+            outputs={"Out": [array]}, attrs={}, infer_shape=False)
+        if is_ids:
+            self._ids_array = array
+        elif is_scores:
+            self._scores_array = array
+        read_value = layers.array_read(array=array, i=self._counter)
+        self._array_dict[read_value.name] = array
+        return read_value
+
+    def update_array(self, array, value):
+        """Store this step's `value` into the array `read_array` returned
+        (written at counter+1 as the loop advances; reference :780)."""
+        self._assert_in_decoder_block("update_array")
+        array = self._array_dict.get(array.name)
+        if array is None:
+            raise ValueError("invoke read_array before update_array")
+        self._array_link.append((value, array))
+
+    def _parent_block(self):
+        program = self._helper.main_program
+        parent_idx = program.current_block().parent_idx
+        if parent_idx < 0:
+            raise ValueError("decoder block has no parent block")
+        return program.block(parent_idx)
+
+    def _assert_in_decoder_block(self, method):
+        if self._status != BeamSearchDecoder.IN_BEAM_SEARCH_DECODER:
+            raise ValueError("%s must be invoked inside block()" % method)
+
     def __call__(self):
         if self._status != BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER:
             raise ValueError("call BeamSearchDecoder after decode()")
+        if self._sentence_ids is None and self._ids_array is not None:
+            if self._scores_array is None:
+                raise ValueError(
+                    "custom decoder block marked is_ids on a read_array "
+                    "but never is_scores — beam_search_decode needs both "
+                    "(mark the scores array with read_array(..., "
+                    "is_scores=True))")
+            # custom-block path: decode straight from the TensorArrays
+            # (the op stacks list-valued inputs)
+            self._sentence_ids, self._sentence_scores = \
+                layers.beam_search_decode(
+                    self._ids_array, self._scores_array,
+                    beam_size=self._beam_size, end_id=self._end_id)
         return self._sentence_ids, self._sentence_scores

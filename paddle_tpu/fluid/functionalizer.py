@@ -91,6 +91,7 @@ def _write_outputs(op, outs, env):
 # companion propagation must not re-attach lengths to their outputs
 _LOD_DROP_OPS = frozenset([
     "sequence_pool", "sequence_first_step", "sequence_last_step",
+    "sequence_length",
     "sequence_mask", "mean", "reduce_sum", "reduce_mean", "reduce_max",
     "shape", "accuracy", "top_k",
     "linear_chain_crf", "warpctc", "edit_distance", "chunk_eval", "auc",
@@ -149,10 +150,18 @@ HOST_OPS = frozenset([
 ])
 
 
+def is_host_op(op):
+    """A while op marked force_host interprets its body per iteration on
+    the host (the reference's nested-Executor WhileOp) — the executor
+    must treat it exactly like the named host ops."""
+    return op.type in HOST_OPS or \
+        (op.type == "while" and bool(op.attrs.get("force_host")))
+
+
 def contains_host_ops(program):
     for blk in program.blocks:
         for op in blk.ops:
-            if op.type in HOST_OPS:
+            if is_host_op(op):
                 return True
     return False
 
@@ -162,7 +171,7 @@ def has_subblock_host_ops(program):
     (while/cond body). Such programs cannot be partitioned at block-0
     boundaries — the enclosing control-flow op would trace the host op
     under jit — so the Executor runs them fully eagerly instead."""
-    return any(op.type in HOST_OPS
+    return any(is_host_op(op)
                for blk in program.blocks[1:] for op in blk.ops)
 
 
@@ -172,7 +181,7 @@ def block_tree_has_host_ops(block):
     (must match has_subblock_host_ops' recursive view, or a host op two
     levels deep gets traced even on the eager path)."""
     for op in block.ops:
-        if op.type in HOST_OPS:
+        if is_host_op(op):
             return True
         sub = op.attrs.get("sub_block")
         if sub is not None and block_tree_has_host_ops(sub):
@@ -381,7 +390,7 @@ class SegmentedProgramRunner:
         for op in self.block.ops:
             if op.type in _SKIP_OPS:
                 continue
-            if op.type in HOST_OPS:
+            if is_host_op(op):
                 if cur:
                     self.segments.append(("compute", cur))
                     cur = []

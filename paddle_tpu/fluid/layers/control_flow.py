@@ -160,23 +160,33 @@ class BlockGuard:
 def _external_block_io(sub_block, parent_block):
     """Static (build-time) read/write analysis of a sub-block against its
     parent scope chain: reads = parent vars consumed before any local
-    definition; writes = parent vars assigned inside the block."""
+    definition; writes = parent vars assigned inside the block. Recurses
+    into nested control-flow sub-blocks (a Switch inside a While reads/
+    writes external vars too — they must surface in the While's X/Out)."""
     local = set(sub_block.vars.keys())
     produced = set()
     reads, writes = [], []
-    for op in sub_block.ops:
-        for n in op.input_arg_names:
-            if n and n not in produced and n not in local and \
-                    n not in reads and \
-                    parent_block._find_var_recursive(n) is not None:
-                reads.append(n)
-        for n in op.output_arg_names:
-            if not n:
-                continue
-            produced.add(n)
-            if n not in local and n not in writes and \
-                    parent_block._find_var_recursive(n) is not None:
-                writes.append(n)
+
+    def external(n, local_sets):
+        return not any(n in ls for ls in local_sets) and \
+            parent_block._find_var_recursive(n) is not None
+
+    def visit(block, local_sets):
+        for op in block.ops:
+            for n in op.input_arg_names:
+                if n and n not in produced and n not in reads and \
+                        external(n, local_sets):
+                    reads.append(n)
+            nested = op.attrs.get("sub_block")
+            if nested is not None:
+                visit(nested, local_sets + [set(nested.vars.keys())])
+            for n in op.output_arg_names:
+                if not n:
+                    continue
+                produced.add(n)
+                if n not in writes and external(n, local_sets):
+                    writes.append(n)
+    visit(sub_block, [local])
     return reads, writes
 
 
@@ -193,13 +203,18 @@ class While:
     IN_WHILE_BLOCK = 1
     AFTER_WHILE_BLOCK = 2
 
-    def __init__(self, cond, is_test=False, name=None, max_iters=None):
+    def __init__(self, cond, is_test=False, name=None, max_iters=None,
+                 force_host=False):
         """max_iters: static trip-count bound. When set (and not is_test)
         the loop lowers to a bounded masked lax.scan, differentiable
         in-graph (reference while_grad, while_op.cc:119). Without it the
-        loop lowers to lax.while_loop; backward then uses the replay-based
-        while_grad_dynamic op on the host execution path — dynamic trip
-        counts train too, at the cost of eager execution."""
+        loop differentiates via the jit-native recorded gradient
+        (carries recorded into a FLAGS.while_grad_max_iters buffer);
+        FLAGS.dynamic_while_host_grad restores the host replay.
+        force_host: interpret the loop body on the host per iteration
+        (the reference's nested-Executor WhileOp, while_op.cc:50) — for
+        bodies that need concrete values each step, e.g. TensorArray
+        manipulation with data-dependent indices (custom beam decoders)."""
         self.helper = LayerHelper("while", name=name)
         self.status = While.BEFORE_WHILE_BLOCK
         if cond.dtype != core.VarDesc.VarType.BOOL:
@@ -207,6 +222,7 @@ class While:
         self.cond_var = cond
         self.is_test = is_test
         self.max_iters = max_iters
+        self.force_host = force_host
 
     def block(self):
         return WhileGuard(self)
@@ -306,7 +322,8 @@ class While:
             inputs={"Condition": [self.cond_var], "X": xs},
             outputs={"Out": list(writes)},
             attrs={"sub_block": while_block, "is_test": self.is_test,
-                   "max_iters": self.max_iters},
+                   "max_iters": self.max_iters,
+                   "force_host": self.force_host},
             infer_shape=False)
 
 

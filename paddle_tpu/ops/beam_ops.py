@@ -87,8 +87,32 @@ def _beam_search_decode(ctx):
     end_id) and SentenceScores [B*W, 1] (final accumulated score)."""
     import jax
     jnp = _jnp()
-    ids = ctx.input("Ids").astype(jnp.int32)            # [T, BW]
-    scores = ctx.input("Scores")                        # [T, BW]
+
+    def stack_array(entries):
+        """TensorArray input (custom-block decoders write a python list):
+        stack per-step rows to [T, BW], beam-expanding any entry with
+        fewer rows (the init row is [B, ...] while selections are
+        [B*W, ...] — each source row repeats across its beam slots)."""
+        rows = [jnp.reshape(e, (-1,)) for e in entries if e is not None]
+        bw = max(r.shape[0] for r in rows)
+        out = []
+        for r in rows:
+            if r.shape[0] != bw:
+                if bw % r.shape[0]:
+                    raise ValueError(
+                        "beam_search_decode: array entry rows %d do not "
+                        "tile into beam width %d" % (r.shape[0], bw))
+                r = jnp.repeat(r, bw // r.shape[0])
+            out.append(r)
+        return jnp.stack(out, axis=0)
+
+    ids = ctx.input("Ids")
+    scores = ctx.input("Scores")
+    if isinstance(ids, list):
+        ids = stack_array(ids)
+    if isinstance(scores, list):
+        scores = stack_array(scores)
+    ids = ids.astype(jnp.int32)                         # [T, BW]
     end_id = ctx.attr("end_id")
     T, BW = ids.shape
     parents = ctx.input("ParentIdx")                    # [T, BW] or absent

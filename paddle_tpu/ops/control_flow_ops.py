@@ -104,7 +104,8 @@ def _while(ctx):
     max_iters = ctx.attr("max_iters")
     record_cap = ctx.attr("grad_max_iters") \
         if ctx.attr("record_for_grad", False) else None
-    if functionalizer.block_tree_has_host_ops(block):
+    if functionalizer.block_tree_has_host_ops(block) or \
+            ctx.attr("force_host", False):
         # host ops (save/send/...) need concrete values each iteration:
         # interpret the body per iteration on the host, like the
         # reference's nested-Executor WhileOp (while_op.cc:50). Only
@@ -540,7 +541,11 @@ def _conditional_block(ctx):
         return carry
 
     init = tuple(env[n] for n in carry_names)
-    if functionalizer.block_tree_has_host_ops(block):
+    # TensorArray carries are Python lists at trace time — lax.cond can't
+    # carry them; interpret on the host (valid: values are concrete there)
+    has_list_carry = any(isinstance(v, list)
+                         for v in init + tuple(closure.values()))
+    if functionalizer.block_tree_has_host_ops(block) or has_list_carry:
         # host ops need concrete values: interpret the branch on the host
         # (reference ConditionalBlockOp ran the sub-block via a nested
         # Executor; only possible when the program runs eagerly)

@@ -173,3 +173,77 @@ def test_state_cell_guards():
             cell.get_state("h")   # outside a decoder block
         with pytest.raises(ValueError):
             cell.update_states()
+
+
+def _custom_block_program(max_len, use_early_stop):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        boot = fluid.layers.data("b", shape=[H], dtype="float32")
+        init_ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        init_scores = fluid.layers.data("scores", shape=[1],
+                                        dtype="float32")
+        cell = _build_cell(boot)
+        decoder = BeamSearchDecoder(
+            state_cell=cell, init_ids=init_ids, init_scores=init_scores,
+            target_dict_dim=V, word_dim=EMB, max_len=max_len, beam_size=1,
+            end_id=V + 7)  # never emitted: lengths stay max
+        with decoder.block():
+            prev_ids = decoder.read_array(init=init_ids, is_ids=True)
+            prev_scores = decoder.read_array(init=init_scores,
+                                             is_scores=True)
+            one = fluid.layers.fill_constant_batch_size_like(
+                input=prev_ids, shape=[-1, 1], value=1, dtype="int64")
+            next_ids = fluid.layers.elementwise_add(prev_ids, one)
+            next_scores = fluid.layers.scale(prev_scores, scale=0.5)
+            if use_early_stop:
+                decoder.early_stop()
+            decoder.update_array(prev_ids, next_ids)
+            decoder.update_array(prev_scores, next_scores)
+        sent_ids, sent_scores = decoder()
+    return main, startup, sent_ids, sent_scores
+
+
+def test_beam_search_decoder_custom_block():
+    """The reference's build-your-own-step contract (contrib
+    beam_search_decoder.py:616 block / :731 read_array / :780
+    update_array): a custom loop body threading TensorArrays through the
+    decoder-owned While."""
+    main, startup, sent_ids, _ = _custom_block_program(
+        max_len=3, use_early_stop=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        (ids,) = exe.run(
+            main,
+            feed={"b": np.zeros((2, H), np.float32),
+                  "ids": np.array([[3], [10]], np.int64),
+                  "scores": np.ones((2, 1), np.float32)},
+            fetch_list=[sent_ids], return_numpy=False)
+    flat = np.asarray(ids).reshape(-1)
+    offs = ids.lod()[0]
+    # steps: init, +1, +2, +3 (loop runs max_len times)
+    np.testing.assert_array_equal(offs, [0, 4, 8])
+    np.testing.assert_array_equal(flat[0:4], [3, 4, 5, 6])
+    np.testing.assert_array_equal(flat[4:8], [10, 11, 12, 13])
+
+
+def test_beam_search_decoder_early_stop():
+    """early_stop() acts as break: generation ends after the current
+    step's arrays are discarded (reference :646)."""
+    main, startup, sent_ids, _ = _custom_block_program(
+        max_len=5, use_early_stop=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.executor.Scope()
+    with fluid.executor.scope_guard(scope):
+        exe.run(startup)
+        (ids,) = exe.run(
+            main,
+            feed={"b": np.zeros((2, H), np.float32),
+                  "ids": np.array([[3], [10]], np.int64),
+                  "scores": np.ones((2, 1), np.float32)},
+            fetch_list=[sent_ids], return_numpy=False)
+    flat = np.asarray(ids).reshape(-1)
+    # only the init entry survives: one token per sequence
+    np.testing.assert_array_equal(ids.lod()[0], [0, 1, 2])
+    np.testing.assert_array_equal(flat, [3, 10])

@@ -224,6 +224,12 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     path = _op_path(block, [loss.name], params, no_grad)
     _append_grad_ops(block, path, grad_map, no_grad)
 
+    # honor per-var error_clip attrs (reference backward.py runs
+    # clip.error_clip_callback on every appended grad op; clipping is
+    # idempotent so one post-pass over the block is equivalent)
+    from .clip import error_clip_callback
+    error_clip_callback(block, {})
+
     params_and_grads = []
     for pname in params:
         gname = grad_map.get(pname)

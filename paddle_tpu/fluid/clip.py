@@ -27,6 +27,11 @@ class ErrorClipByValue(BaseErrorClipAttr):
                         attrs={"min": self.min, "max": self.max},
                         infer_shape=False)
 
+    def _insert_clip_op(self, block, idx, grad_name):
+        block._insert_op(idx, type="clip", inputs={"X": [grad_name]},
+                         outputs={"Out": [grad_name]},
+                         attrs={"min": self.min, "max": self.max})
+
 
 class BaseGradientClipAttr:
     def _process_context(self, context, param, grad):
@@ -131,15 +136,26 @@ def set_gradient_clip(clip, param_list=None, program=None):
 
 
 def error_clip_callback(block, context):
-    for op in block.ops:
+    """Clip gradients of vars that declare `error_clip` (reference
+    clip.py error_clip_callback, invoked from append_backward). The clip
+    op is INSERTED right after each producing op so downstream grad
+    consumers — which execute in block order — see the clipped value."""
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        inserted = 0
         for grad_n in op.output_arg_names:
-            if grad_n.endswith("@GRAD"):
-                fwd_var = block._find_var_recursive(grad_n[:-5])
-                if fwd_var is None:
-                    continue
-                error_clip = getattr(fwd_var, "error_clip", None)
-                if error_clip is not None:
-                    error_clip._append_clip_op(block, grad_n)
+            if not grad_n.endswith("@GRAD"):
+                continue
+            fwd_var = block._find_var_recursive(grad_n[:-5])
+            if fwd_var is None:
+                continue
+            error_clip = getattr(fwd_var, "error_clip", None)
+            if error_clip is not None:
+                error_clip._insert_clip_op(block, i + 1 + inserted,
+                                           grad_n)
+                inserted += 1
+        i += 1 + inserted    # skip the clip ops we just inserted
 
 
 def append_gradient_clip_ops(param_grads):

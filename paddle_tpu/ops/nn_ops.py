@@ -64,6 +64,12 @@ def _conv2d(ctx):
     if ctx.has_input("Bias"):
         bshape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)
         out = out + ctx.input("Bias").reshape(bshape)
+    # named checkpoint: identity in normal execution; lets a rematerialized
+    # step (jax.checkpoint + save_only_these_names("conv_out")) keep conv
+    # outputs and recompute the cheap BN/activation tail in backward —
+    # the HBM-traffic lever in ROOFLINE.md
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out, "conv_out")
     return {"Output": out}
 
 

@@ -300,6 +300,20 @@ def _crop(ctx):
     else:
         offsets = [int(d) for d in
                    ctx.attr("offsets", [0] * x.ndim) or [0] * x.ndim]
+    # a non-positive extent keeps the input's remaining extent past the
+    # offset (so -1 in the batch slot crops every row); with runtime
+    # Offsets that extent is data-dependent, so it needs a concrete
+    # (eager) offset — under jit dynamic_slice would silently clamp the
+    # start to 0 and return the uncropped axis
+    if any(d <= 0 for d in shape) and off_in is not None and \
+            isinstance(off_in, jax.core.Tracer):
+        raise NotImplementedError(
+            "crop with runtime Offsets and a non-positive shape entry "
+            "has a data-dependent output extent — pass static offsets "
+            "via the attr, give every shape entry a positive size, or "
+            "run eagerly")
+    shape = [int(x.shape[i]) - int(offsets[i]) if d <= 0 else d
+             for i, d in enumerate(shape)]
     return {"Out": jax.lax.dynamic_slice(x, offsets, shape)}
 
 

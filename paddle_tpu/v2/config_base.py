@@ -13,6 +13,27 @@ from ..fluid import unique_name
 __all__ = ["Layer"]
 
 
+def _apply_extra_attr(var, layer_attr):
+    """Honor ExtraLayerAttribute on a built layer output (reference
+    trainer_config_helpers/attrs.py:233): drop_rate wraps the output in
+    dropout, error_clipping_threshold clips the BACKPROPAGATED error
+    (reference ExtraLayerAttribute semantics -> fluid ErrorClipByValue,
+    applied to this var's gradient by append_backward). `device` is
+    accepted and ignored — placement belongs to the mesh."""
+    from .attr import ExtraLayerAttribute
+    if not isinstance(layer_attr, ExtraLayerAttribute) or var is None \
+            or not hasattr(var, "dtype"):
+        return var
+    if layer_attr.error_clipping_threshold:
+        from ..fluid.clip import ErrorClipByValue
+        var.error_clip = ErrorClipByValue(
+            max=float(layer_attr.error_clipping_threshold))
+    if layer_attr.drop_rate:
+        from ..fluid import layers as F
+        var = F.dropout(var, dropout_prob=float(layer_attr.drop_rate))
+    return var
+
+
 class Layer(object):
     """A declarative node in a v2 topology DAG.
 
@@ -23,13 +44,14 @@ class Layer(object):
 
     def __init__(self, name=None, parents=None, build_fn=None,
                  layer_type="layer", extra_parents=None,
-                 build_with_ctx=False):
+                 build_with_ctx=False, layer_attr=None):
         self.name = name if name else unique_name.generate(layer_type)
         self.layer_type = layer_type
         self.__parents__ = list(parents or [])
         self.__extra_parents__ = list(extra_parents or [])
         self.__build_fn__ = build_fn
         self.__build_with_ctx__ = build_with_ctx
+        self.__layer_attr__ = layer_attr
 
     def parents(self):
         return self.__parents__ + self.__extra_parents__
@@ -52,6 +74,7 @@ class Layer(object):
             out = self.__build_fn__(context, *parent_vars)
         else:
             out = self.__build_fn__(*parent_vars)
+        out = _apply_extra_attr(out, self.__layer_attr__)
         context[key] = out
         return out
 

@@ -32,6 +32,16 @@ __all__ = [
     "huber_regression_cost", "rank_cost", "sum_cost", "crf", "crf_decoding",
     "ctc", "warp_ctc", "nce", "hsigmoid", "eos", "parse_network",
     "get_layer", "recurrent_group", "memory", "StaticInput",
+    # round-4 gserver tail + projections/operators
+    "dotmul_projection", "scaling_projection",
+    "trans_full_matrix_projection", "slice_projection",
+    "context_projection", "conv_projection", "dotmul_operator",
+    "conv_operator", "cos_sim", "interpolation", "power",
+    "sum_to_one_norm", "linear_comb", "bilinear_interp", "repeat",
+    "seq_concat", "seq_slice", "pad", "rotate", "maxout", "norm",
+    "sampling_id", "out_prod", "block_expand", "crop", "clip",
+    "dot_prod", "l2_distance", "smooth_l1_cost", "multiplex", "prelu",
+    "gated_unit", "scale_shift", "resize", "row_conv", "sub_seq",
 ]
 
 _name_to_layer = {}
@@ -118,7 +128,7 @@ def fc(input, size, act=None, name=None, param_attr=None, bias_attr=None,
         return _apply_act(out, act)
 
     return _remember(Layer(name=name, parents=list(inputs), build_fn=build,
-                           layer_type="fc"))
+                           layer_type="fc", layer_attr=layer_attr))
 
 
 def _add_bias(var, bias_attr, size):
@@ -136,7 +146,7 @@ def embedding(input, size, param_attr=None, layer_attr=None, name=None):
                            param_attr=lower_param_attr(param_attr))
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
-                           build_fn=build, layer_type="embedding"))
+                           build_fn=build, layer_type="embedding", layer_attr=layer_attr))
 
 
 def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
@@ -153,7 +163,7 @@ def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
         return _apply_act(out, act)
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
-                           build_fn=build, layer_type="conv"))
+                           build_fn=build, layer_type="conv", layer_attr=layer_attr))
 
 
 def img_pool(input, pool_size, num_channels=None, pool_type=None, stride=1,
@@ -169,7 +179,7 @@ def img_pool(input, pool_size, num_channels=None, pool_type=None, stride=1,
                         pool_padding=padding, ceil_mode=ceil_mode)
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
-                           build_fn=build, layer_type="pool"))
+                           build_fn=build, layer_type="pool", layer_attr=layer_attr))
 
 
 def img_cmrnorm(input, size, scale=0.0128, power=0.75, name=None,
@@ -180,7 +190,7 @@ def img_cmrnorm(input, size, scale=0.0128, power=0.75, name=None,
         return F.lrn(pv, n=size, alpha=scale, beta=power)
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
-                           build_fn=build, layer_type="norm"))
+                           build_fn=build, layer_type="norm", layer_attr=layer_attr))
 
 
 def batch_norm(input, act=None, name=None, num_channels=None,
@@ -195,7 +205,7 @@ def batch_norm(input, act=None, name=None, num_channels=None,
         return _apply_act(out, act)
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
-                           build_fn=build, layer_type="batch_norm"))
+                           build_fn=build, layer_type="batch_norm", layer_attr=layer_attr))
 
 
 def dropout(input, dropout_rate, name=None):
@@ -211,7 +221,7 @@ def concat(input, act=None, name=None, layer_attr=None):
         return _apply_act(F.concat(list(parents), axis=1), act)
 
     return _remember(Layer(name=name, parents=list(input), build_fn=build,
-                           layer_type="concat"))
+                           layer_type="concat", layer_attr=layer_attr))
 
 
 def addto(input, act=None, name=None, bias_attr=None, layer_attr=None):
@@ -226,7 +236,7 @@ def addto(input, act=None, name=None, bias_attr=None, layer_attr=None):
         return _apply_act(out, act)
 
     return _remember(Layer(name=name, parents=list(inputs), build_fn=build,
-                           layer_type="addto"))
+                           layer_type="addto", layer_attr=layer_attr))
 
 
 def pooling(input, pooling_type=None, name=None, bias_attr=None,
@@ -248,7 +258,7 @@ def first_seq(input, name=None, agg_level=None, layer_attr=None):
         return F.sequence_first_step(pv)
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
-                           build_fn=build, layer_type="first_seq"))
+                           build_fn=build, layer_type="first_seq", layer_attr=layer_attr))
 
 
 def last_seq(input, name=None, agg_level=None, layer_attr=None):
@@ -256,7 +266,7 @@ def last_seq(input, name=None, agg_level=None, layer_attr=None):
         return F.sequence_last_step(pv)
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
-                           build_fn=build, layer_type="last_seq"))
+                           build_fn=build, layer_type="last_seq", layer_attr=layer_attr))
 
 
 def max_id(input, name=None, layer_attr=None):
@@ -272,7 +282,7 @@ def expand(input, expand_as, name=None, agg_level=None, layer_attr=None):
         return F.sequence_expand(pv, ref)
 
     return _remember(Layer(name=name, parents=[input, expand_as],
-                           build_fn=build, layer_type="expand"))
+                           build_fn=build, layer_type="expand", layer_attr=layer_attr))
 
 
 def seq_reshape(input, reshape_size, name=None, act=None, bias_attr=None,
@@ -281,7 +291,7 @@ def seq_reshape(input, reshape_size, name=None, act=None, bias_attr=None,
         return _apply_act(F.sequence_reshape(pv, new_dim=reshape_size), act)
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
-                           build_fn=build, layer_type="seq_reshape"))
+                           build_fn=build, layer_type="seq_reshape", layer_attr=layer_attr))
 
 
 def trans(input, name=None, layer_attr=None):
@@ -289,7 +299,7 @@ def trans(input, name=None, layer_attr=None):
         return F.transpose(pv, perm=[1, 0])
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
-                           build_fn=build, layer_type="trans"))
+                           build_fn=build, layer_type="trans", layer_attr=layer_attr))
 
 
 def scaling(input, weight, name=None, layer_attr=None):
@@ -299,7 +309,7 @@ def scaling(input, weight, name=None, layer_attr=None):
         return F.elementwise_mul(pv, wv, axis=0)
 
     return _remember(Layer(name=name, parents=[input, weight],
-                           build_fn=build, layer_type="scaling"))
+                           build_fn=build, layer_type="scaling", layer_attr=layer_attr))
 
 
 def slope_intercept(input, slope=1.0, intercept=0.0, name=None,
@@ -308,7 +318,7 @@ def slope_intercept(input, slope=1.0, intercept=0.0, name=None,
         return F.scale(pv, scale=slope, bias=intercept)
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
-                           build_fn=build, layer_type="slope_intercept"))
+                           build_fn=build, layer_type="slope_intercept", layer_attr=layer_attr))
 
 
 # ---------------------------------------------------------------------------
@@ -316,17 +326,22 @@ def slope_intercept(input, slope=1.0, intercept=0.0, name=None,
 # ---------------------------------------------------------------------------
 
 class _Projection(object):
-    def __init__(self, input, build_fn):
+    def __init__(self, input, build_fn, size_parametric=False):
         self.input = input
         self.build_fn = build_fn
+        # size-parametric projections (full_matrix/table/trans) default
+        # their output width to the enclosing mixed_layer's `size`
+        # (reference mixed_layer size inference)
+        self.size_parametric = size_parametric
 
 
 def full_matrix_projection(input, size=0, param_attr=None):
-    def build(pv):
-        return F.fc(pv, size=size, param_attr=lower_param_attr(param_attr),
+    def build(pv, mixed_size=0):
+        return F.fc(pv, size=size or mixed_size,
+                    param_attr=lower_param_attr(param_attr),
                     bias_attr=False)
 
-    return _Projection(input, build)
+    return _Projection(input, build, size_parametric=not size)
 
 
 def identity_projection(input, offset=None, size=None):
@@ -340,22 +355,183 @@ def identity_projection(input, offset=None, size=None):
 
 
 def table_projection(input, size=0, param_attr=None):
-    def build(pv):
-        return F.embedding(pv, size=[input.data_type.dim, size],
+    def build(pv, mixed_size=0):
+        return F.embedding(pv, size=[input.data_type.dim,
+                                     size or mixed_size],
                            param_attr=lower_param_attr(param_attr))
+
+    return _Projection(input, build, size_parametric=not size)
+
+
+def dotmul_projection(input, param_attr=None):
+    """out = x ⊙ w with a learned [1, D] weight (reference
+    trainer_config_helpers DotMulProjection)."""
+    def build(pv):
+        w = F.create_parameter(
+            shape=[1, int(pv.shape[-1])], dtype="float32",
+            attr=lower_param_attr(param_attr))
+        return F.elementwise_mul(pv, w)
+
+    return _Projection(input, build)
+
+
+def scaling_projection(input, param_attr=None):
+    """out = w * x with a single learned scalar (ScalingProjection)."""
+    def build(pv):
+        w = F.create_parameter(shape=[1], dtype="float32",
+                               attr=lower_param_attr(param_attr))
+        return F.elementwise_mul(pv, w)
+
+    return _Projection(input, build)
+
+
+def trans_full_matrix_projection(input, size=0, param_attr=None):
+    """out = x @ Wᵀ — the weight is stored transposed [size, in_dim]
+    (TransposedFullMatrixProjection; weight-sharing with an fc going the
+    other way)."""
+    def build(pv, mixed_size=0):
+        w = F.create_parameter(
+            shape=[size or mixed_size, int(pv.shape[-1])],
+            dtype="float32", attr=lower_param_attr(param_attr))
+        return F.matmul(pv, w, transpose_y=True)
+
+    return _Projection(input, build, size_parametric=not size)
+
+
+def slice_projection(input, slices):
+    """Concat of [start, end) column slices (SliceProjection)."""
+    def build(pv):
+        parts = [F.slice(pv, axes=[len(pv.shape) - 1],
+                         starts=[s], ends=[e]) for s, e in slices]
+        return parts[0] if len(parts) == 1 \
+            else F.concat(parts, axis=len(pv.shape) - 1)
+
+    return _Projection(input, build)
+
+
+def context_projection(input, context_len, context_start=None,
+                       padding_attr=False):
+    """Concat a sliding context window of sequence steps (reference
+    ContextProjection — the word-window trick under v1 NLP configs).
+    Dense realization: the padded-dense [B, T, D] encoding shifts along
+    T with zero fill (sequence boundaries are row boundaries, so no
+    cross-sequence leakage — the same zero-padding the reference applies
+    at sequence edges when padding_attr is False)."""
+    start = context_start if context_start is not None \
+        else -(context_len // 2)
+
+    def build(pv):
+        # T-relative shifts only (the padded T is a runtime property):
+        # past offsets slice [0, T-k) and zero-pad the front, future
+        # offsets slice [k, T) and zero-pad the back
+        outs = []
+        for off in range(start, start + context_len):
+            if off == 0:
+                outs.append(pv)
+            elif off < 0:
+                body = F.slice(pv, axes=[1], starts=[0], ends=[off])
+                outs.append(F.pad(body, paddings=[0, 0, -off, 0, 0, 0]))
+            else:
+                body = F.slice(pv, axes=[1], starts=[off],
+                               ends=[1 << 30])
+                outs.append(F.pad(body, paddings=[0, 0, 0, off, 0, 0]))
+        # fluid LoD convention: feature concat on a ragged var is axis 1
+        # (the concat op shifts past the padded time dim itself)
+        return F.concat(outs, axis=1)
+
+    return _Projection(input, build)
+
+
+class _Operator(object):
+    """A mixed_layer operator: multiple inputs, no own parameters
+    (reference trainer_config_helpers Operator)."""
+
+    def __init__(self, inputs, build_fn):
+        self.inputs = list(inputs)
+        self.build_fn = build_fn
+
+
+def dotmul_operator(a=None, b=None, scale=1.0, **kwargs):
+    """out = scale * (a ⊙ b) (DotMulOperator)."""
+    a = a if a is not None else kwargs.get("x")
+    b = b if b is not None else kwargs.get("y")
+
+    def build(av, bv):
+        out = F.elementwise_mul(av, bv)
+        return F.scale(out, scale=scale) if scale != 1.0 else out
+
+    return _Operator([a, b], build)
+
+
+def conv_operator(img, filter, filter_size, num_filters,
+                  num_channels=None, stride=1, padding=0,
+                  filter_size_y=None, stride_y=None, padding_y=None):
+    """Convolve `img` with a DYNAMIC filter produced by another layer
+    (ConvOperator): the filter values come from `filter`'s output, not a
+    parameter — conv2d's Filter slot is an ordinary input var here, so
+    this is a direct lowering."""
+    fy = filter_size_y or filter_size
+    nc = num_channels
+
+    def build(iv, fv):
+        c = nc if nc is not None else int(iv.shape[1])
+        f = F.reshape(fv, shape=[num_filters, c, fy, filter_size])
+        from ..fluid.layer_helper import LayerHelper
+        helper = LayerHelper("conv_operator")
+        out = helper.create_variable_for_type_inference(iv.dtype)
+        helper.append_op(
+            type="conv2d", inputs={"Input": [iv], "Filter": [f]},
+            outputs={"Output": [out]},
+            attrs={"strides": [stride_y or stride, stride],
+                   "paddings": [padding_y or padding, padding],
+                   "dilations": [1, 1], "groups": 1},
+            infer_shape=False)
+        return out
+
+    return _Operator([img, filter], build)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, groups=1, param_attr=None,
+                    trans=False):
+    """Convolution as a mixed_layer projection (ConvProjection): own
+    filter parameter, outputs summed with the other projections."""
+    def build(pv):
+        conv = F.conv2d_transpose if trans else F.conv2d
+        return conv(pv, num_filters=num_filters, filter_size=filter_size,
+                    stride=stride, padding=padding, groups=groups,
+                    param_attr=lower_param_attr(param_attr),
+                    bias_attr=False)
 
     return _Projection(input, build)
 
 
 def mixed(size=0, name=None, input=None, act=None, bias_attr=None,
           layer_attr=None):
-    """mixed_layer: sum of projections (trainer_config_helpers
-    mixed_layer); supports the common full_matrix/identity/table forms."""
+    """mixed_layer: sum of projections and operators
+    (trainer_config_helpers mixed_layer). Projections carry their own
+    parameters (full_matrix/table/dotmul/scaling/trans/context/conv);
+    operators combine multiple layer outputs (dotmul/conv)."""
     projs = input if isinstance(input, (list, tuple)) else [input]
-    parents = [p.input for p in projs]
+    parents = []
+    arity = []
+    for p in projs:
+        if isinstance(p, _Operator):
+            parents.extend(p.inputs)
+            arity.append(len(p.inputs))
+        else:
+            parents.append(p.input)
+            arity.append(1)
 
     def build(*parent_vars):
-        outs = [p.build_fn(v) for p, v in zip(projs, parent_vars)]
+        outs, i = [], 0
+        for p, n in zip(projs, arity):
+            if getattr(p, "size_parametric", False) and size:
+                outs.append(p.build_fn(*parent_vars[i:i + n],
+                                       mixed_size=size))
+            else:
+                outs.append(p.build_fn(*parent_vars[i:i + n]))
+            i += n
         out = outs[0]
         for o in outs[1:]:
             out = F.elementwise_add(out, o)
@@ -364,7 +540,7 @@ def mixed(size=0, name=None, input=None, act=None, bias_attr=None,
         return _apply_act(out, act)
 
     return _remember(Layer(name=name, parents=parents, build_fn=build,
-                           layer_type="mixed"))
+                           layer_type="mixed", layer_attr=layer_attr))
 
 
 # ---------------------------------------------------------------------------
@@ -565,6 +741,412 @@ def eos(input, eos_id, name=None, layer_attr=None):
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
                            build_fn=build, layer_type="eos"))
+
+
+# ---------------------------------------------------------------------------
+# gserver layer tail (VERDICT r3 #5): the commonly-used long tail of
+# paddle/legacy/gserver/layers/ Layer classes, lowered to fluid ops.
+# ---------------------------------------------------------------------------
+
+def _unary(layer_type, fn):
+    def layer(input, name=None, layer_attr=None, **kw):
+        def build(pv):
+            return fn(pv, **kw)
+        return _remember(Layer(name=name,
+                               parents=[_single_input(input)],
+                               build_fn=build, layer_type=layer_type,
+                               layer_attr=layer_attr))
+    layer.__name__ = layer_type
+    return layer
+
+
+def cos_sim(a, b, scale=1, size=1, name=None, layer_attr=None):
+    """CosSimLayer (gserver/layers/CosSimLayer.cpp)."""
+    def build(av, bv):
+        out = F.cos_sim(av, bv)
+        return F.scale(out, scale=float(scale)) if scale != 1 else out
+
+    return _remember(Layer(name=name, parents=[a, b], build_fn=build,
+                           layer_type="cos_sim", layer_attr=layer_attr))
+
+
+def interpolation(input, weight, name=None, layer_attr=None):
+    """w*a + (1-w)*b over input=[a, b] (InterpolationLayer)."""
+    a, b = input
+
+    def build(wv, av, bv):
+        return F.elementwise_add(
+            F.elementwise_mul(av, wv, axis=0),
+            F.elementwise_mul(
+                bv, F.scale(wv, scale=-1.0, bias=1.0), axis=0))
+
+    return _remember(Layer(name=name, parents=[weight, a, b],
+                           build_fn=build, layer_type="interpolation",
+                           layer_attr=layer_attr))
+
+
+def power(input, weight, name=None, layer_attr=None):
+    """x ** w with a per-sample scalar exponent (PowerLayer)."""
+    def build(pv, wv):
+        return F.elementwise_pow(pv, wv, axis=0)
+
+    return _remember(Layer(name=name,
+                           parents=[_single_input(input), weight],
+                           build_fn=build, layer_type="power",
+                           layer_attr=layer_attr))
+
+
+def sum_to_one_norm(input, name=None, layer_attr=None):
+    """Row-normalize to sum 1 (SumToOneNormLayer)."""
+    def build(pv):
+        s = F.reduce_sum(pv, dim=-1, keep_dim=True)
+        return F.elementwise_div(pv, s)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="sum_to_one_norm",
+                           layer_attr=layer_attr))
+
+
+def linear_comb(weights, vectors, size=None, name=None, layer_attr=None):
+    """out_j = sum_i w_i * vec[i*size+j] (LinearCombLayer /
+    convex_comb)."""
+    def build(wv, vv):
+        m = int(wv.shape[-1])
+        d = size or int(vv.shape[-1]) // m
+        v3 = F.reshape(vv, shape=[-1, m, d])
+        w3 = F.reshape(wv, shape=[-1, m, 1])
+        return F.reduce_sum(F.elementwise_mul(v3, w3), dim=1)
+
+    return _remember(Layer(name=name, parents=[weights, vectors],
+                           build_fn=build, layer_type="linear_comb",
+                           layer_attr=layer_attr))
+
+
+def bilinear_interp(input, out_size_x, out_size_y, num_channels=None,
+                    name=None, layer_attr=None):
+    """BilinearInterpLayer -> resize_bilinear."""
+    def build(pv):
+        return F.resize_bilinear(pv, out_shape=[out_size_y, out_size_x])
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="bilinear_interp",
+                           layer_attr=layer_attr))
+
+
+def repeat(input, num_repeats, as_row_vector=True, act=None, name=None,
+           layer_attr=None):
+    """Tile features num_repeats times (FeatureMapExpand/RepeatLayer:
+    as_row_vector repeats [a b] -> [a b a b]; otherwise interleaves
+    [a a b b])."""
+    def build(pv):
+        if as_row_vector:
+            out = F.concat([pv] * num_repeats,
+                           axis=len(pv.shape) - 1)
+        else:
+            last = int(pv.shape[-1])
+            e = F.unsqueeze(pv, axes=[len(pv.shape)])
+            e = F.expand(e, expand_times=[1] * len(pv.shape)
+                         + [num_repeats])
+            out = F.reshape(e, shape=[-1, last * num_repeats])
+        return _apply_act(out, act)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="repeat",
+                           layer_attr=layer_attr))
+
+
+def seq_concat(a, b, act=None, name=None, layer_attr=None,
+               bias_attr=None):
+    """Concatenate two sequences time-wise (SequenceConcatLayer)."""
+    def build(av, bv):
+        return _apply_act(F.sequence_concat([av, bv]), act)
+
+    return _remember(Layer(name=name, parents=[a, b], build_fn=build,
+                           layer_type="seq_concat",
+                           layer_attr=layer_attr))
+
+
+def seq_slice(input, starts=None, ends=None, name=None):
+    """SequenceSliceLayer -> sequence_slice (offset/length form)."""
+    parents = [_single_input(input)]
+    if starts is not None:
+        parents.append(starts)
+    if ends is not None:
+        parents.append(ends)
+
+    def build(pv, *rest):
+        i = 0
+        sv = ev = None
+        if starts is not None:
+            sv = rest[i]
+            i += 1
+        if ends is not None:
+            ev = rest[i]
+        if sv is None:
+            sv = F.fill_constant_batch_size_like(pv, shape=[-1, 1],
+                                                 dtype="int64", value=0)
+        if ev is None:
+            from ..fluid.layers.sequence import _sequence_length
+            length = _sequence_length(pv)
+            ev = F.cast(F.reshape(length, shape=[-1, 1]), "int64")
+        offset = F.cast(sv, "int64")
+        length = F.elementwise_sub(F.cast(ev, "int64"), offset)
+        return F.sequence_slice(pv, offset=offset, length=length)
+
+    return _remember(Layer(name=name, parents=parents, build_fn=build,
+                           layer_type="seq_slice"))
+
+
+def pad(input, pad_c=None, pad_h=None, pad_w=None, name=None,
+        layer_attr=None):
+    """PadLayer: zero-pad channel/height/width of [N, C, H, W]."""
+    pc = list(pad_c or [0, 0])
+    ph = list(pad_h or [0, 0])
+    pw = list(pad_w or [0, 0])
+
+    def build(pv):
+        return F.pad(pv, paddings=[0, 0] + pc + ph + pw)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="pad",
+                           layer_attr=layer_attr))
+
+
+def rotate(input, height, width, name=None, layer_attr=None):
+    """RotateLayer: 90-degree CCW rotation of each [C, H, W] map."""
+    def build(pv):
+        x = F.reshape(pv, shape=[-1, int(pv.shape[-1]) // (height * width),
+                                 height, width])
+        x = F.transpose(x, perm=[0, 1, 3, 2])
+        x = F.reverse(x, axis=[2])
+        return F.reshape(x, shape=[-1, int(pv.shape[-1])])
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="rotate",
+                           layer_attr=layer_attr))
+
+
+def maxout(input, groups, num_channels=None, name=None, layer_attr=None):
+    """MaxOutLayer -> maxout op."""
+    def build(pv):
+        return F.maxout(pv, groups=groups)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="maxout",
+                           layer_attr=layer_attr))
+
+
+def norm(input, norm_type="cmrnorm-projection", channels=1, size=None,
+         name=None, layer_attr=None):
+    """CrossChannelNormLayer: L2-normalize across the channel axis."""
+    def build(pv):
+        return F.l2_normalize(pv, axis=1)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="norm",
+                           layer_attr=layer_attr))
+
+
+def sampling_id(input, name=None, layer_attr=None):
+    """SamplingIdLayer -> sampling_id op."""
+    def build(pv):
+        return F.sampling_id(pv)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="sampling_id",
+                           layer_attr=layer_attr))
+
+
+def out_prod(a, b, name=None, layer_attr=None):
+    """Outer product per row (OuterProdLayer): [B,M] x [B,N] ->
+    [B, M*N]."""
+    def build(av, bv):
+        m, n = int(av.shape[-1]), int(bv.shape[-1])
+        o = F.matmul(F.reshape(av, shape=[-1, m, 1]),
+                     F.reshape(bv, shape=[-1, 1, n]))
+        return F.reshape(o, shape=[-1, m * n])
+
+    return _remember(Layer(name=name, parents=[a, b], build_fn=build,
+                           layer_type="out_prod", layer_attr=layer_attr))
+
+
+def block_expand(input, block_x, block_y, stride_x=None, stride_y=None,
+                 padding_x=0, padding_y=0, num_channels=None, name=None,
+                 layer_attr=None):
+    """BlockExpandLayer -> im2sequence (image patches to sequence)."""
+    def build(pv):
+        return F.im2sequence(
+            pv, filter_size=[block_y, block_x],
+            stride=[stride_y or block_y, stride_x or block_x],
+            padding=[padding_y, padding_x, padding_y, padding_x])
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="block_expand",
+                           layer_attr=layer_attr))
+
+
+def crop(input, offset, shape=None, axis=2, name=None, layer_attr=None):
+    """CropLayer: crop input (optionally to a reference layer's shape)."""
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(pv, *ref):
+        ndim = len(pv.shape)
+        tgt = list(shape) if shape is not None else \
+            [int(d) for d in ref[0].shape]
+        # offset/shape anchor at `axis` (reference CropLayer crop_axis);
+        # dims before it keep their full extent (non-positive entry)
+        full_tgt = tgt if len(tgt) == ndim else \
+            ([0] * axis + tgt + [0] * ndim)[:ndim]
+        full_off = ([0] * axis + list(offset) + [0] * ndim)[:ndim]
+        return F.crop(pv, shape=full_tgt, offsets=full_off)
+
+    return _remember(Layer(name=name, parents=list(inputs),
+                           build_fn=build, layer_type="crop",
+                           layer_attr=layer_attr))
+
+
+def clip(input, min, max, name=None, layer_attr=None):
+    """ClipLayer -> clip op."""
+    def build(pv):
+        return F.clip(pv, min=float(min), max=float(max))
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="clip",
+                           layer_attr=layer_attr))
+
+
+def dot_prod(a, b, name=None, layer_attr=None):
+    """Row-wise dot product (DotProdLayer)."""
+    def build(av, bv):
+        return F.reduce_sum(F.elementwise_mul(av, bv), dim=-1,
+                            keep_dim=True)
+
+    return _remember(Layer(name=name, parents=[a, b], build_fn=build,
+                           layer_type="dot_prod", layer_attr=layer_attr))
+
+
+def l2_distance(a, b, name=None, layer_attr=None):
+    """Row-wise euclidean distance (L2DistanceLayer)."""
+    def build(av, bv):
+        d = F.elementwise_sub(av, bv)
+        return F.sqrt(F.reduce_sum(F.elementwise_mul(d, d), dim=-1,
+                                   keep_dim=True))
+
+    return _remember(Layer(name=name, parents=[a, b], build_fn=build,
+                           layer_type="l2_distance",
+                           layer_attr=layer_attr))
+
+
+def smooth_l1_cost(input, label, name=None, coeff=1.0, layer_attr=None):
+    """SmoothL1CostLayer -> smooth_l1 op."""
+    def build(pv, lv):
+        out = F.mean(F.smooth_l1(pv, lv))
+        return F.scale(out, scale=coeff) if coeff != 1.0 else out
+
+    return _remember(Layer(name=name, parents=[input, label],
+                           build_fn=build, layer_type="cost",
+                           layer_attr=layer_attr))
+
+
+def multiplex(input, name=None, layer_attr=None):
+    """MultiplexLayer: input[0] is the per-row selector into
+    input[1:]."""
+    index = input[0]
+    choices = list(input[1:])
+
+    def build(iv, *cvs):
+        return F.multiplex(list(cvs), F.cast(iv, "int32"))
+
+    return _remember(Layer(name=name, parents=[index] + choices,
+                           build_fn=build, layer_type="multiplex",
+                           layer_attr=layer_attr))
+
+
+def prelu(input, partial_sum=1, param_attr=None, name=None,
+          layer_attr=None):
+    """PReluLayer -> prelu op (per-channel slopes)."""
+    def build(pv):
+        mode = "all" if partial_sum == int(pv.shape[-1]) else "channel"
+        return F.prelu(pv, mode=mode,
+                       param_attr=lower_param_attr(param_attr))
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="prelu",
+                           layer_attr=layer_attr))
+
+
+def gated_unit(input, size, act=None, name=None, gate_attr=None,
+               gate_param_attr=None, gate_bias_attr=None,
+               inproj_attr=None, inproj_param_attr=None,
+               inproj_bias_attr=None, layer_attr=None):
+    """GatedRecurrentUnit-style gating: fc(x) * sigmoid(fc_gate(x))
+    (gated_unit_layer)."""
+    def build(pv):
+        proj = F.fc(pv, size=size,
+                    param_attr=lower_param_attr(inproj_param_attr),
+                    bias_attr=lower_param_attr(inproj_bias_attr)
+                    if inproj_bias_attr is not None else None)
+        proj = _apply_act(proj, act)
+        gate = F.fc(pv, size=size, act="sigmoid",
+                    param_attr=lower_param_attr(gate_param_attr),
+                    bias_attr=lower_param_attr(gate_bias_attr)
+                    if gate_bias_attr is not None else None)
+        return F.elementwise_mul(proj, gate)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="gated_unit",
+                           layer_attr=layer_attr))
+
+
+def scale_shift(input, name=None, param_attr=None, bias_attr=None):
+    """w * x + b with scalar w, b (ScaleShiftLayer)."""
+    def build(pv):
+        w = F.create_parameter(shape=[1], dtype="float32",
+                               attr=lower_param_attr(param_attr))
+        out = F.elementwise_mul(pv, w)
+        if bias_attr is not False:
+            b = F.create_parameter(shape=[1], dtype="float32",
+                                   attr=lower_param_attr(bias_attr),
+                                   is_bias=True)
+            out = F.elementwise_add(out, b)
+        return out
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="scale_shift"))
+
+
+def resize(input, size, name=None, layer_attr=None):
+    """ResizeLayer: reinterpret rows as [-1, size]."""
+    def build(pv):
+        return F.reshape(pv, shape=[-1, size])
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="resize",
+                           layer_attr=layer_attr))
+
+
+def row_conv(input, context_len, act=None, name=None, param_attr=None,
+             layer_attr=None):
+    """RowConvLayer -> row_conv op (lookahead convolution)."""
+    def build(pv):
+        return _apply_act(
+            F.row_conv(pv, future_context_size=context_len,
+                       param_attr=lower_param_attr(param_attr)), act)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="row_conv",
+                           layer_attr=layer_attr))
+
+
+def sub_seq(input, offsets, sizes, act=None, bias_attr=None, name=None):
+    """SubSequenceLayer: per-sequence [offset, offset+size) slice."""
+    def build(pv, ov, sv):
+        return _apply_act(F.sequence_slice(
+            pv, offset=F.cast(F.reshape(ov, shape=[-1, 1]), "int64"),
+            length=F.cast(F.reshape(sv, shape=[-1, 1]), "int64")), act)
+
+    return _remember(Layer(name=name, parents=[input, offsets, sizes],
+                           build_fn=build, layer_type="sub_seq"))
 
 
 # ---------------------------------------------------------------------------

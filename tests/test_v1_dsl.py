@@ -1,0 +1,257 @@
+"""v1 trainer_config_helpers DSL as a REAL layer (VERDICT r3 #5):
+ExtraLayerAttribute kwarg translation, the mixed_layer projection/
+operator model, and the round-4 gserver layer tail — exercised through
+v1 spellings end to end (reference
+python/paddle/trainer_config_helpers/layers.py)."""
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+import paddle_tpu.trainer_config_helpers as tch
+from paddle_tpu.trainer_config_helpers import layers as v1
+
+
+def _train(cost, feeder, passes=6, lr=0.1):
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=lr))
+    losses = []
+
+    def on_event(event):
+        if isinstance(event, paddle.event.EndIteration):
+            losses.append(float(event.cost))
+
+    tr.train(reader=feeder, num_passes=passes, event_handler=on_event)
+    return losses, params
+
+
+def test_v1_extra_attr_and_mixed_projections_train():
+    """THE round-3 done-criterion: a v1-spelling model using
+    ExtraLayerAttribute(drop_rate=...) on a layer plus mixed_layer with
+    full_matrix + dotmul projections trains and converges."""
+    x = v1.data_layer(name="x", type=paddle.data_type.dense_vector(6))
+    hid = v1.fc_layer(
+        input=x, size=12,
+        act=paddle.activation.Tanh(),
+        layer_attr=v1.ExtraLayerAttribute(drop_rate=0.05,
+                                          error_clipping_threshold=5.0))
+    mix = v1.mixed_layer(
+        size=12,
+        input=[v1.full_matrix_projection(input=hid, size=12),
+               v1.dotmul_projection(input=hid)],
+        act=paddle.activation.Relu(),
+        bias_attr=v1.ParamAttr(name="mix_b"))
+    out = v1.fc_layer(input=mix, size=2,
+                      act=paddle.activation.Softmax())
+    lbl = v1.data_layer(name="lbl",
+                        type=paddle.data_type.integer_value(2))
+    cost = v1.classification_cost(input=out, label=lbl)
+
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(64):
+            v = rng.randn(6).astype(np.float32)
+            yield v, int(v.sum() > 0)
+
+    losses, params = _train(cost, paddle.batch(reader, 16), passes=10)
+    # dropout is live, so compare epoch means
+    first = np.mean(losses[: len(losses) // 3])
+    last = np.mean(losses[-len(losses) // 3:])
+    assert last < 0.7 * first, (first, last)
+    # the mixed_layer projections own parameters; the dotmul weight is
+    # a [1, 12] vector
+    shapes = {n: params.get_shape(n) for n in params.keys()}
+    assert any(s == (1, 12) for s in shapes.values()), shapes
+
+
+def test_v1_dropout_attr_emits_dropout_op():
+    x = v1.data_layer(name="xa", type=paddle.data_type.dense_vector(4))
+    h = v1.fc_layer(input=x, size=3,
+                    layer_attr=v1.ExtraAttr(drop_rate=0.5))
+    topo = paddle.topology.Topology([h])
+    types = [op.type for op in topo.main_program.global_block().ops]
+    assert "dropout" in types
+
+
+def test_v1_error_clip_attr_clips_gradient():
+    import paddle_tpu.fluid as fluid
+    x = v1.data_layer(name="xc", type=paddle.data_type.dense_vector(4))
+    h = v1.fc_layer(input=x, size=3,
+                    layer_attr=v1.ExtraAttr(
+                        error_clipping_threshold=0.25))
+    out = v1.fc_layer(input=h, size=1)
+    lbl = v1.data_layer(name="yc", type=paddle.data_type.dense_vector(1))
+    cost = v1.square_error_cost(input=out, label=lbl)
+    topo = paddle.topology.Topology([cost])
+    main = topo.main_program
+    types = [op.type for op in main.global_block().ops]
+    assert "clip" not in types  # forward has no clip...
+    with fluid.program_guard(main, topo.startup_program):
+        fluid.backward.append_backward(topo.var_for(cost))
+    types = [op.type for op in main.global_block().ops]
+    assert "clip" in types      # ...backward clips the layer's error
+
+
+def test_v1_mixed_operators_and_more_projections():
+    """conv-free operator/projection coverage: dotmul_operator,
+    scaling/trans/context/slice projections all build and run."""
+    x = v1.data_layer(name="xo", type=paddle.data_type.dense_vector(8))
+    a = v1.fc_layer(input=x, size=8, act=paddle.activation.Tanh())
+    b = v1.fc_layer(input=x, size=8, act=paddle.activation.Tanh())
+    mix = v1.mixed_layer(
+        size=8,
+        input=[v1.dotmul_operator(a=a, b=b, scale=0.5),
+               v1.scaling_projection(input=a),
+               v1.trans_full_matrix_projection(input=b, size=8),
+               v1.slice_projection(input=a, slices=[(0, 4), (4, 8)])])
+    out = v1.fc_layer(input=mix, size=2,
+                      act=paddle.activation.Softmax())
+    params = paddle.parameters.create(out)
+    probs = paddle.infer(
+        output_layer=out, parameters=params,
+        input=[(np.random.RandomState(1).randn(8).astype(np.float32),)])
+    assert probs.shape == (1, 2)
+    assert np.allclose(probs.sum(), 1.0, atol=1e-4)
+
+
+def test_v1_gserver_tail_layers_run():
+    """The newly-added tail, built and executed through paddle.infer."""
+    rng = np.random.RandomState(2)
+    x = v1.data_layer(name="xt", type=paddle.data_type.dense_vector(12))
+    y = v1.data_layer(name="yt", type=paddle.data_type.dense_vector(12))
+    w = v1.data_layer(name="wt", type=paddle.data_type.dense_vector(1))
+    outs = [
+        v1.cos_sim(a=x, b=y),
+        v1.interpolation_layer(input=[x, y], weight=w),
+        v1.sum_to_one_norm_layer(input=x),
+        v1.dot_prod_layer(a=x, b=y),
+        v1.l2_distance_layer(a=x, b=y),
+        v1.out_prod_layer(a=w, b=w),
+        v1.clip_layer(input=x, min=-0.5, max=0.5),
+        v1.resize_layer(input=x, size=6),
+        v1.repeat_layer(input=w, num_repeats=3),
+        v1.scale_shift_layer(input=x),
+        v1.gated_unit_layer(input=x, size=5),
+        v1.linear_comb_layer(weights=v1.fc_layer(input=x, size=3),
+                             vectors=x, size=4),
+    ]
+    xv = rng.randn(12).astype(np.float32)
+    yv = rng.randn(12).astype(np.float32)
+    wv = np.array([0.3], np.float32)
+    vals = {"xt": xv, "yt": yv, "wt": wv}
+
+    def run(layer):
+        topo = paddle.topology.Topology([layer])
+        names = [n for n, _ in topo.data_type()]
+        p = paddle.parameters.create(layer)
+        return paddle.infer(output_layer=layer, parameters=p,
+                            input=[tuple(vals[n] for n in names)])
+
+    for layer in outs:
+        got = run(layer)
+        assert np.all(np.isfinite(got)), layer
+    # power needs a positive base (x**0.3 is NaN for x<0, as in the
+    # reference's PowerLayer)
+    vals["xt"] = np.abs(xv) + 0.1
+    pw = run(v1.power_layer(input=x, weight=w))
+    np.testing.assert_allclose(np.asarray(pw).ravel(),
+                               (np.abs(xv) + 0.1) ** 0.3, rtol=1e-4)
+    vals["xt"] = xv
+    # maxout wants conv-shaped [C, H, W] input (reference MaxOutLayer)
+    xi = v1.data_layer(name="xi",
+                       type=paddle.data_type.dense_vector(16),
+                       height=2, width=2)
+    mo = v1.maxout_layer(input=xi, groups=2, num_channels=4)
+    p = paddle.parameters.create(mo)
+    got = paddle.infer(output_layer=mo, parameters=p,
+                       input=[(rng.randn(16).astype(np.float32),)])
+    assert np.all(np.isfinite(got)) and np.asarray(got).size == 8
+    # numeric spot checks
+    cs = run(outs[0])
+    want = xv.dot(yv) / (np.linalg.norm(xv) * np.linalg.norm(yv))
+    np.testing.assert_allclose(np.asarray(cs).ravel()[0], want,
+                               rtol=1e-4)
+    sn = run(outs[2])
+    np.testing.assert_allclose(np.asarray(sn).sum(), 1.0, rtol=1e-4)
+
+
+def test_v1_conv_projections_and_image_tail():
+    """conv_projection/conv_operator inside mixed_layer + the image tail
+    (bilinear_interp, pad, crop, block_expand, prelu, norm)."""
+    rng = np.random.RandomState(4)
+    img = v1.data_layer(name="im",
+                        type=paddle.data_type.dense_vector(2 * 8 * 8),
+                        height=8, width=8)
+    # conv_projection: conv with its own filter param as a projection
+    mix = v1.mixed_layer(
+        input=[v1.conv_projection(input=img, filter_size=3,
+                                  num_filters=4, num_channels=2,
+                                  padding=1)])
+    bi = v1.bilinear_interp_layer(input=mix, out_size_x=4, out_size_y=4)
+    pd = v1.pad_layer(input=bi, pad_c=[0, 1], pad_h=[1, 1],
+                      pad_w=[0, 0])
+    pr = v1.prelu_layer(input=mix)
+    nm = v1.cross_channel_norm_layer(input=mix)
+    be = v1.block_expand_layer(input=mix, block_x=2, block_y=2,
+                               num_channels=4)
+    cr = v1.crop_layer(input=mix, offset=[0, 0, 2, 2],
+                       shape=[-1, 4, 4, 4])
+    # conv_operator: filter values produced by another LAYER
+    filt = v1.fc_layer(input=v1.data_layer(
+        name="fseed", type=paddle.data_type.dense_vector(4)),
+        size=4 * 2 * 3 * 3)
+    co = v1.mixed_layer(
+        input=[v1.conv_operator(img=img, filter=filt, filter_size=3,
+                                num_filters=4, num_channels=2,
+                                padding=1)])
+    imv = rng.randn(2 * 8 * 8).astype(np.float32)
+    fsv = rng.randn(4).astype(np.float32)
+    for layer in [mix, bi, pd, pr, nm, be, cr, co]:
+        topo = paddle.topology.Topology([layer])
+        names = [n for n, _ in topo.data_type()]
+        vals = {"im": imv, "fseed": fsv}
+        p = paddle.parameters.create(layer)
+        got = paddle.infer(output_layer=layer, parameters=p,
+                           input=[tuple(vals[n] for n in names)])
+        assert np.all(np.isfinite(np.asarray(got))), layer
+
+
+def test_v1_context_projection_window():
+    """context_projection concatenates the +-1 word window with zero
+    padding at sequence edges (reference ContextProjection)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.lod import create_lod_tensor
+    seq = v1.data_layer(
+        name="s", type=paddle.data_type.dense_vector_sequence(2))
+    ctx = v1.mixed_layer(
+        input=[v1.context_projection(input=seq, context_len=3)])
+    topo = paddle.topology.Topology([ctx])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(topo.startup_program)
+        vals = np.arange(8, dtype=np.float32).reshape(4, 2)
+        feed = {"s": create_lod_tensor(vals, [[3, 1]])}
+        (out,) = exe.run(topo.main_program, feed=feed,
+                         fetch_list=[topo.var_for(ctx)],
+                         return_numpy=False)
+    got = np.asarray(out).reshape(-1, 6)   # ragged flat [sum_len, 6]
+    # sequence 1 = rows 0..2; window at t=0: [zeros, row0, row1]
+    np.testing.assert_allclose(got[0], np.r_[0, 0, vals[0], vals[1]])
+    np.testing.assert_allclose(got[1],
+                               np.r_[vals[0], vals[1], vals[2]])
+    np.testing.assert_allclose(got[2], np.r_[vals[1], vals[2], 0, 0])
+    # sequence 2 = row 3, a single step: both context slots zero
+    np.testing.assert_allclose(got[3], np.r_[0, 0, vals[3], 0, 0])
+
+
+def test_v1_tch_namespace_exports_tail():
+    for name in ["cos_sim", "interpolation_layer", "power_layer",
+                 "maxout_layer", "block_expand_layer", "crop_layer",
+                 "prelu_layer", "row_conv_layer", "context_projection",
+                 "dotmul_operator", "conv_operator", "conv_projection",
+                 "ExtraLayerAttribute"]:
+        assert hasattr(tch, name) or hasattr(v1, name), name
+        assert getattr(v1, name) is not None

@@ -1,9 +1,9 @@
 from .predictor import (
     NativeConfig, AnalysisConfig, PaddleTensor, Predictor,
-    create_paddle_predictor,
+    create_paddle_predictor, AotPredictor, load_aot_predictor,
 )
 
 __all__ = [
     "NativeConfig", "AnalysisConfig", "PaddleTensor", "Predictor",
-    "create_paddle_predictor",
+    "create_paddle_predictor", "AotPredictor", "load_aot_predictor",
 ]

@@ -6,10 +6,14 @@ NativePaddlePredictor/AnalysisPredictor API (inference/api/api_impl.cc:95,
 analysis_predictor.cc).
 """
 
+import os
+
 import numpy as np
 import pytest
 
 import paddle_tpu.fluid as fluid
+
+HERE = os.path.dirname(os.path.abspath(__file__))
 from paddle_tpu.inference import (
     NativeConfig, AnalysisConfig, PaddleTensor, create_paddle_predictor)
 
@@ -109,3 +113,48 @@ def test_predictor_clone(trained_model):
     clone = pred.clone()
     out, = clone.run({"img": x})
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_aot_export_serves_in_fresh_process_without_retrace(
+        trained_model, tmp_path):
+    """VERDICT r3 #8: save model -> AOT-export -> NEW process serves with
+    NO Program rebuild and NO jax trace (build_step_fn is poisoned in the
+    child; only jax.export deserialization + XLA compile may run)."""
+    import subprocess
+    import sys
+    model_dir, x, ref = trained_model
+    aot_dir = str(tmp_path / "aot")
+    pred = create_paddle_predictor(NativeConfig(model_dir=model_dir))
+    pred.save_aot(aot_dir, batch_sizes=(4, 8))
+    np.save(str(tmp_path / "x.npy"), x)
+    np.save(str(tmp_path / "ref.npy"), ref)
+    code = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+# poison tracing: serving an AOT artifact must NEVER build/trace a step
+from paddle_tpu.fluid import functionalizer
+def _no_trace(*a, **k):
+    raise AssertionError("AOT serving must not rebuild/trace the program")
+functionalizer.build_step_fn = _no_trace
+from paddle_tpu.inference import load_aot_predictor
+p = load_aot_predictor(%r)
+x = np.load(%r)
+(out,) = p.run({"img": x})
+ref = np.load(%r)
+np.testing.assert_allclose(out, ref, rtol=2e-4, atol=1e-5)
+# a smaller batch pads up to the nearest exported bucket
+(out2,) = p.run({"img": x[:2]})
+np.testing.assert_allclose(out2, ref[:2], rtol=2e-4, atol=1e-5)
+print("AOT-SERVE-OK")
+""" % (aot_dir, str(tmp_path / "x.npy"), str(tmp_path / "ref.npy"))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300,
+                          cwd=os.path.dirname(HERE))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "AOT-SERVE-OK" in proc.stdout

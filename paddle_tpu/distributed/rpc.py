@@ -109,7 +109,8 @@ class VariableServer:
     """
 
     def __init__(self, endpoint, fanin=1, sync_mode=True, optimize_fn=None,
-                 grad_to_param=None, pre_apply_fn=None):
+                 grad_to_param=None, pre_apply_fn=None, dc_asgd=False,
+                 dc_lambda=0.04):
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
         self.fanin = max(int(fanin), 1)
@@ -117,6 +118,15 @@ class VariableServer:
         self.optimize_fn = optimize_fn
         self.pre_apply_fn = pre_apply_fn
         self.grad_to_param = dict(grad_to_param or {})
+        # delay-compensated async SGD (reference request_handler_impl.cc
+        # enable_dc_asgd + transpiler _append_dc_asgd_ops): per-trainer
+        # param snapshots taken at Get time; on grad arrival the
+        # correction g + λ·g⊙g⊙(w_now − w_snapshot) compensates the
+        # trainer's staleness (Zheng et al., 2017)
+        self.dc_asgd = bool(dc_asgd) and not sync_mode
+        self.dc_lambda = float(dc_lambda)
+        self._dc_params = frozenset(self.grad_to_param.values())
+        self._param_bak = {}      # (trainer_id, param) -> np.ndarray
         self.store = {}           # name -> np.ndarray
         self._grad_buffers = {}   # grad name -> [np.ndarray]
         self._lock = threading.Condition()
@@ -231,7 +241,8 @@ class VariableServer:
             else:
                 # async SGD: apply immediately (RunAsyncLoop,
                 # listen_and_serv_op.cc:216)
-                self._apply_one(name, arr)
+                self._apply_one(name, arr,
+                                trainer_id=msg.get("trainer_id", 0))
                 self._generation += 1
                 self._lock.notify_all()
         return {"ok": True}
@@ -258,6 +269,13 @@ class VariableServer:
                 while self._generation < gen and not self._stopped:
                     self._lock.wait(timeout=30)
             val = self.store.get(name)
+            if val is not None and self.dc_asgd and \
+                    name in self._dc_params:
+                # snapshot what this trainer is about to compute on
+                # (reference RequestGetHandler '%s.trainer_%d_bak' copy);
+                # only params a grad maps to can receive the correction
+                tid = msg.get("trainer_id", 0)
+                self._param_bak[(tid, name)] = np.array(val, copy=True)
         if val is None:
             return {"error": "no var %s" % name}
         return {"ok": True, "var": serialize_array(val),
@@ -387,8 +405,16 @@ class VariableServer:
         for gname, avg in grads.items():
             self._apply_one(gname, avg)
 
-    def _apply_one(self, grad_name, grad):
+    def _apply_one(self, grad_name, grad, trainer_id=None):
         pname = self.grad_to_param.get(grad_name)
+        if self.dc_asgd and pname is not None and trainer_id is not None:
+            w_now = self.store.get(pname)
+            bak = self._param_bak.get((trainer_id, pname))
+            if w_now is not None and bak is not None and \
+                    np.shape(bak) == np.shape(grad):
+                g = np.asarray(grad)
+                grad = g + self.dc_lambda * g * g * \
+                    (np.asarray(w_now) - bak)
         if self.optimize_fn is not None and pname is not None:
             self.optimize_fn(pname, grad_name, grad, self.store)
         elif pname is not None and pname in self.store:
@@ -451,13 +477,15 @@ class RPCClient:
             self._generation_map()[ep] = reply["generation"]
         return reply
 
-    def async_send_var(self, ep, name, value):
+    def async_send_var(self, ep, name, value, trainer_id=0):
         return self._call(ep, {"cmd": "send", "name": name,
+                               "trainer_id": int(trainer_id),
                                "var": serialize_array(np.asarray(value))})
 
-    def async_get_var(self, ep, name):
+    def async_get_var(self, ep, name, trainer_id=0):
         gen = self._generation_map().get(ep, 0)
         reply = self._call(ep, {"cmd": "get", "name": name,
+                                "trainer_id": int(trainer_id),
                                 "generation": gen})
         return deserialize_array(reply["var"])
 

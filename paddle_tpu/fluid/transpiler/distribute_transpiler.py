@@ -54,6 +54,10 @@ class DistributeTranspilerConfig:
     min_block_size = 8192
     mode = "pserver"
     sync_mode = True
+    # delay-compensated async SGD (reference :140 enable_dc_asgd +
+    # listen_and_serv_op.cc:342 dc_asgd handlers): only meaningful with
+    # sync_mode=False
+    enable_dc_asgd = False
 
 
 class VarBlock:
@@ -379,14 +383,28 @@ class DistributeTranspiler:
                        for p in param_names],
                    "Fanin": self.trainer_num,
                    "sync_mode": self.sync_mode,
+                   "dc_asgd": bool(self.config.enable_dc_asgd),
                    RPC_OP_ROLE_ATTR: RPC_OP_ROLE_VALUE},
             infer_shape=False)
         return pserver_program
+
+    def get_pserver_programs(self, endpoint):
+        """(main_program, startup_program) for one pserver endpoint in a
+        single call (reference distribute_transpiler.py:838)."""
+        pserver_prog = self.get_pserver_program(endpoint)
+        pserver_startup = self.get_startup_program(
+            endpoint, pserver_program=pserver_prog)
+        return pserver_prog, pserver_startup
 
     def get_startup_program(self, endpoint, pserver_program=None,
                             startup_program=None):
         """Startup program creating + initializing this endpoint's params
         (reference distribute_transpiler.py get_startup_program)."""
+        if startup_program is None:
+            # prefer the startup handed to transpile(): programs are often
+            # built under their own program_guard, where the process-global
+            # default startup is empty
+            startup_program = getattr(self, "startup_program", None)
         if startup_program is None:
             from ..framework import default_startup_program
             startup_program = default_startup_program()

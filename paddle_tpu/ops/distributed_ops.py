@@ -33,10 +33,11 @@ def _send(ctx):
     """send_op: push grads to their endpoints (rpc_client.h AsyncSendVar)."""
     names = ctx.op.input("X")
     epmap = ctx.attr("epmap", [])
+    tid = int(ctx.attr("trainer_id", 0) or 0)
     c = _client()
     for (name, ep), val in zip(zip(names, epmap), ctx.inputs("X")):
         if val is not None:
-            c.async_send_var(ep, name, np.asarray(val))
+            c.async_send_var(ep, name, np.asarray(val), trainer_id=tid)
     return {}
 
 
@@ -52,10 +53,11 @@ def _send_barrier(ctx):
 def _recv(ctx):
     names = ctx.op.output("Out")
     epmap = ctx.attr("epmap", [])
+    tid = int(ctx.attr("trainer_id", 0) or 0)
     c = _client()
     out = []
     for name, ep in zip(names, epmap):
-        out.append(c.async_get_var(ep, name))
+        out.append(c.async_get_var(ep, name, trainer_id=tid))
     return {"Out": out}
 
 
@@ -141,7 +143,8 @@ def _listen_and_serv(ctx):
     server = VariableServer(endpoint, fanin=fanin, sync_mode=sync_mode,
                             optimize_fn=optimize_fn,
                             grad_to_param=grad_to_param,
-                            pre_apply_fn=pre_apply_fn)
+                            pre_apply_fn=pre_apply_fn,
+                            dc_asgd=bool(ctx.attr("dc_asgd", False)))
     # seed the store with every value the surrounding env already has
     # (params + optimizer state + @LR_DECAY_COUNTER@ created by the pserver
     # startup program); only the @LOD_LEN companion entries are internal

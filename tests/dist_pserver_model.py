@@ -1,0 +1,135 @@
+"""Role script for the 4-process parameter-server cluster test (the
+analogue of the reference's dist_mnist.py model scripts driven by
+test_dist_base.py:219 start_pserver / :299 _run_cluster).
+
+Invoked as:  python dist_pserver_model.py ROLE ...
+  PSERVER <my_endpoint> <all_endpoints> <trainers> <sync:0|1>
+  TRAINER <trainer_id>  <all_endpoints> <trainers> <sync:0|1> <steps>
+  LOCAL   <steps>                      (single-process baseline)
+
+Trainers print one line 'LOSSES <json>'. Deterministic everywhere: fixed
+seeds, fixed data, two fc layers so the round-robin dispatcher puts
+param blocks on BOTH pservers.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid.transpiler import DistributeTranspiler
+
+STEPS_DEFAULT = 5
+GLOBAL_BATCH = 8
+
+
+def build_net(seed=17):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=6, act="tanh",
+                            param_attr=fluid.ParamAttr(name="w1"),
+                            bias_attr=fluid.ParamAttr(name="b1"))
+        pred = fluid.layers.fc(input=h, size=1, act=None,
+                               param_attr=fluid.ParamAttr(name="w2"),
+                               bias_attr=fluid.ParamAttr(name="b2"))
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def make_data(steps):
+    rng = np.random.RandomState(3)
+    w = np.array([[1.0], [-2.0], [0.5], [0.3]], np.float32)
+    out = []
+    for _ in range(steps):
+        x = rng.randn(GLOBAL_BATCH, 4).astype(np.float32)
+        out.append((x, np.tanh(x).dot(w) + 0.1))
+    return out
+
+
+def transpile(role_id, endpoints, trainers, sync, current_endpoint=""):
+    main, startup, loss = build_net()
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=role_id, program=main, pservers=endpoints,
+                trainers=trainers, sync_mode=sync,
+                startup_program=startup,
+                current_endpoint=current_endpoint)
+    return t, main, startup, loss
+
+
+def run_pserver(my_ep, endpoints, trainers, sync):
+    t, _, startup, _ = transpile(0, endpoints, trainers, sync,
+                                 current_endpoint=my_ep)
+    prog, ps_startup = t.get_pserver_programs(my_ep)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(ps_startup)
+        exe.run(prog)          # blocks in listen_and_serv until exit
+
+
+def run_trainer(tid, endpoints, trainers, sync, steps):
+    from paddle_tpu.distributed.rpc import wait_server_ready
+    wait_server_ready(endpoints.split(","))
+    t, _, startup, loss = transpile(tid, endpoints, trainers, sync)
+    trainer_prog = t.get_trainer_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    data = make_data(steps)
+    half = GLOBAL_BATCH // 2
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(steps):
+            x, y = data[i]
+            sl = slice(tid * half, (tid + 1) * half)
+            (lv,) = exe.run(trainer_prog, feed={"x": x[sl], "y": y[sl]},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    print("LOSSES %s" % json.dumps(losses), flush=True)
+
+
+def run_local(steps=STEPS_DEFAULT):
+    main, startup, loss = build_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    data = make_data(steps)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for x, y in data:
+            (lv,) = exe.run(main, feed={"x": x, "y": y},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    return losses
+
+
+def main():
+    role = sys.argv[1]
+    if role == "PSERVER":
+        run_pserver(sys.argv[2], sys.argv[3], int(sys.argv[4]),
+                    bool(int(sys.argv[5])))
+    elif role == "TRAINER":
+        run_trainer(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
+                    bool(int(sys.argv[5])), int(sys.argv[6]))
+    elif role == "LOCAL":
+        print("LOSSES %s" % json.dumps(run_local(int(sys.argv[2]))),
+              flush=True)
+    else:
+        raise SystemExit("unknown role %r" % role)
+
+
+if __name__ == "__main__":
+    main()

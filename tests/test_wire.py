@@ -249,3 +249,69 @@ def test_master_resend_dedup_by_req_id():
     assert r2["task_id"] != r1["task_id"]
     # and the first lease is still pending exactly once
     assert sorted(master.pending) == sorted([r1["task_id"], r2["task_id"]])
+
+
+def test_dc_asgd_delay_compensation():
+    """Delay-compensated async SGD (reference request_handler_impl.cc
+    enable_dc_asgd + transpiler _append_dc_asgd_ops): the server
+    snapshots each trainer's pulled params at Get time; a later grad is
+    corrected by +lambda*g*g*(w_now - w_pulled) before the optimize
+    block runs — a stale trainer's update is pushed toward where the
+    params have moved meanwhile (Zheng et al. 2017)."""
+    from paddle_tpu.distributed.rpc import RPCClient, VariableServer
+    lam = 0.1
+    applied = []
+
+    def sgd(pname, gname, grad, store):
+        applied.append(np.array(grad))
+        store[pname] = store[pname] - 0.5 * grad
+
+    srv = VariableServer("127.0.0.1:0", sync_mode=False, dc_asgd=True,
+                         dc_lambda=lam, optimize_fn=sgd,
+                         grad_to_param={"w@GRAD": "w"}).start()
+    try:
+        cli = RPCClient()
+        w0 = np.array([1.0, 2.0], np.float32)
+        cli.put_var(srv.endpoint, "w", w0)
+        # trainer 0 pulls (snapshot w0), then trainer 1 pushes a grad
+        # that moves w — trainer 0's grad is now stale
+        cli.async_get_var(srv.endpoint, "w", trainer_id=0)
+        g1 = np.array([0.2, -0.4], np.float32)
+        cli.async_get_var(srv.endpoint, "w", trainer_id=1)
+        cli.async_send_var(srv.endpoint, "w@GRAD", g1, trainer_id=1)
+        w_after1 = cli.async_get_var(srv.endpoint, "w", trainer_id=1)
+        # trainer 0 sends its stale grad g0: correction uses w_now - w0
+        g0 = np.array([1.0, 1.0], np.float32)
+        cli.async_send_var(srv.endpoint, "w@GRAD", g0, trainer_id=0)
+        want_corrected = g0 + lam * g0 * g0 * (w_after1 - w0)
+        np.testing.assert_allclose(applied[-1], want_corrected,
+                                   rtol=1e-6)
+        # trainer 1 was NOT stale (pulled right before sending): its
+        # correction term is zero
+        np.testing.assert_allclose(applied[0], g1, rtol=1e-6)
+        cli.send_exit(srv.endpoint)
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_transpiler_dc_asgd_attr_flows():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.transpiler import (DistributeTranspiler,
+                                             DistributeTranspilerConfig)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, size=2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        cfg = DistributeTranspilerConfig()
+        cfg.enable_dc_asgd = True
+        t = DistributeTranspiler(config=cfg)
+        t.transpile(trainer_id=0, program=main,
+                    pservers="127.0.0.1:6170", trainers=2,
+                    sync_mode=False, startup_program=startup)
+        prog = t.get_pserver_program("127.0.0.1:6170")
+    ls = next(op for op in prog.global_block().ops
+              if op.type == "listen_and_serv")
+    assert ls.attrs.get("dc_asgd") is True
+    assert ls.attrs.get("sync_mode") is False

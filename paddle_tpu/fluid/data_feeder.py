@@ -64,6 +64,42 @@ class DataFeeder:
                 ret[name].set_recursive_sequence_lengths([seq_lens])
         return ret
 
+    def _get_number_of_places_(self, num_places):
+        if num_places is not None:
+            return int(num_places)
+        import os
+        if "CPU_NUM" in os.environ:
+            return int(os.environ["CPU_NUM"])
+        import jax
+        return jax.local_device_count()
+
+    def decorate_reader(self, reader, multi_devices, num_places=None,
+                        drop_last=True):
+        """Wrap a batch reader into one yielding ready feed dicts — one
+        dict per step, or a list of per-device dicts when multi_devices
+        (reference data_feeder.py:251; the multi-device path consumes one
+        batch per device per step, matching ParallelExecutor.run's
+        per-device feed list)."""
+
+        def __reader_creator__():
+            if not multi_devices:
+                for item in reader():
+                    yield self.feed(item)
+            else:
+                num = self._get_number_of_places_(num_places)
+                item = []
+                for batch in reader():
+                    item.append(batch)
+                    if len(item) == num:
+                        yield [self.feed(b) for b in item]
+                        item = []
+                if not drop_last and item:
+                    raise ValueError(
+                        "The data batch which cannot fit for devices will "
+                        "be dropped is not implementation.")
+
+        return __reader_creator__
+
     def feed_parallel(self, iterable, num_places=None):
         """split one batch into per-device feeds (reference :83 multi-device
         path); with the mesh-sharded ParallelExecutor a single dict is

@@ -42,12 +42,24 @@ class _TensorView:
 
 
 class Scope:
-    """name -> device array map (reference scope.h:41). Flat: the reference's
-    parent-scope chain existed for per-op temporary locals, which the
-    functional executor doesn't materialize."""
+    """name -> device array map (reference scope.h:41). Mostly flat — the
+    reference's parent-scope chain existed for per-op temporary locals,
+    which the functional executor doesn't materialize — but `new_scope`
+    keeps the kid-scope contract: reads fall through to the parent,
+    writes stay local (scope.cc Scope::NewScope + parent lookup)."""
 
     def __init__(self):
         self._vars = {}
+        self._parent = None
+        self._kids = []
+
+    def new_scope(self):
+        """Create a kid scope (reference pybind Scope.new_scope —
+        API.spec:412)."""
+        kid = Scope()
+        kid._parent = self
+        self._kids.append(kid)
+        return kid
 
     def var(self, name):
         if name not in self._vars:
@@ -57,19 +69,26 @@ class Scope:
     def find_var(self, name):
         if name in self._vars:
             return _TensorView(self, name)
+        if self._parent is not None:
+            return self._parent.find_var(name)
         return None
 
     def has(self, name):
-        return name in self._vars
+        return name in self._vars or \
+            (self._parent is not None and self._parent.has(name))
 
     def get(self, name):
-        return self._vars.get(name)
+        if name in self._vars:
+            return self._vars[name]
+        if self._parent is not None:
+            return self._parent.get(name)
+        return None
 
     def set(self, name, value):
         self._vars[name] = value
 
     def drop_kids(self):
-        pass
+        self._kids = []
 
     def keys(self):
         return self._vars.keys()

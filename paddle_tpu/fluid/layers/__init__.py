@@ -8,7 +8,8 @@ from . import ops
 from .ops import *  # noqa: F401,F403
 from . import io
 from .io import (data, py_reader, batch, double_buffer, read_file,  # noqa: F401
-                 create_py_reader_by_data, open_files, shuffle)
+                 create_py_reader_by_data, open_files, shuffle,
+                 random_data_generator, Preprocessor, load)
 from . import sequence
 from .sequence import *  # noqa: F401,F403
 from . import control_flow

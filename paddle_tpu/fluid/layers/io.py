@@ -14,7 +14,7 @@ from .. import core
 
 __all__ = ["data", "py_reader", "batch", "double_buffer",
            "read_file", "create_py_reader_by_data", "open_files",
-           "shuffle"]
+           "shuffle", "random_data_generator", "Preprocessor", "load"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
@@ -94,6 +94,105 @@ def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
 
     reader.decorate_tensor_provider(gen)
     return reader
+
+
+def random_data_generator(low, high, shapes, lod_levels, for_parallel=True):
+    """Uniform-random dummy reader (reference layers/io.py:416
+    RandomDataGenerator): a reader whose samples are fp32 uniforms of the
+    given shapes — for testing a network without opening a real file.
+    `for_parallel` kept for API parity (sharding is the mesh's job)."""
+    import numpy as np
+    from .. import unique_name
+    reader = py_reader(capacity=64,
+                       shapes=[[-1] + list(s) for s in shapes],
+                       dtypes=["float32"] * len(shapes),
+                       lod_levels=lod_levels,
+                       name=unique_name.generate("random_data_generator"),
+                       use_double_buffer=False)
+
+    def gen():
+        rng = np.random.RandomState()
+        while True:
+            yield tuple(
+                rng.uniform(low, high, size=tuple(s)).astype(np.float32)
+                for s in shapes)
+
+    reader.decorate_tensor_provider(gen)
+    return reader
+
+
+class Preprocessor(object):
+    """In-pipeline data preprocessing block (reference layers/io.py:1069
+    create_custom_reader): ops recorded between `inputs()` and
+    `outputs()` transform each batch coming off `reader`.
+
+    TPU redesign: the reference moved the sub-block into a C++
+    CustomReader; here the transform ops inline into the main program
+    (XLA fuses them with the consumers — same numerics, no extra reader
+    hop), and the returned reader simply exposes the transformed vars."""
+
+    BEFORE_SUB_BLOCK = 0
+    IN_SUB_BLOCK = 1
+    AFTER_SUB_BLOCK = 2
+
+    def __init__(self, reader, name=None):
+        self.underlying_reader = reader
+        self.status = Preprocessor.BEFORE_SUB_BLOCK
+        self.source_var_names = None
+        self.sink_var_names = None
+        self._sink_vars = None
+
+    def _is_completed(self):
+        return self.source_var_names and self.sink_var_names
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            self.status = Preprocessor.IN_SUB_BLOCK
+            yield
+            self.status = Preprocessor.AFTER_SUB_BLOCK
+            if not self._is_completed():
+                raise RuntimeError(
+                    "Preprocessor definition incomplete: invoke inputs() "
+                    "and outputs() inside the block")
+        return guard()
+
+    def inputs(self):
+        if self.status != Preprocessor.IN_SUB_BLOCK:
+            raise RuntimeError(
+                "Preprocessor.inputs() can only be invoked inside block()")
+        src = list(self.underlying_reader.output_vars)
+        self.source_var_names = [v.name for v in src]
+        return src
+
+    def outputs(self, *outs):
+        if self.status != Preprocessor.IN_SUB_BLOCK:
+            raise RuntimeError(
+                "Preprocessor.outputs() can only be invoked inside "
+                "block()")
+        self.sink_var_names = [o.name for o in outs]
+        self._sink_vars = list(outs)
+
+    def __call__(self, *args, **kwargs):
+        if self.status != Preprocessor.AFTER_SUB_BLOCK:
+            raise RuntimeError(
+                "Preprocessor output is only available after block()")
+        self.underlying_reader.output_vars = list(self._sink_vars)
+        return self.underlying_reader
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Load a saved tensor into `out` via the load op (reference
+    layers/io.py:1169; save_op.cc counterpart writes the file)."""
+    helper = LayerHelper("load")
+    attrs = {"file_path": file_path}
+    if load_as_fp16 is not None:
+        attrs["load_as_fp16"] = load_as_fp16
+    helper.append_op(type="load", inputs={}, outputs={"Out": [out]},
+                     attrs=attrs, infer_shape=False)
+    return out
 
 
 def shuffle(reader, buffer_size):

@@ -2,41 +2,80 @@
 recordio_writer.py: convert_reader_to_recordio_file) over the C++
 recordio/tensor-serde layer (native/recordio.cc, native/tensor_serde.cc)."""
 
+import struct
+
 import numpy as np
 
 from ..native import (RecordIOWriter, RecordIOScanner, serialize_tensor,
                       deserialize_tensor)
 
-__all__ = ["convert_reader_to_recordio_file", "recordio_reader"]
+__all__ = ["convert_reader_to_recordio_file",
+           "convert_reader_to_recordio_files", "recordio_reader"]
+
+
+def _serialize_sample(sample):
+    """One record = one sample = count prefix + per-field length-framed
+    tensor records. The single place that defines the record layout —
+    recordio_reader inverts it."""
+    if not isinstance(sample, (tuple, list)):
+        sample = (sample,)
+    parts = [struct.pack("<I", len(sample))]
+    for field in sample:
+        t = serialize_tensor(np.asarray(field))
+        parts.append(struct.pack("<Q", len(t)))
+        parts.append(t)
+    return b"".join(parts)
 
 
 def convert_reader_to_recordio_file(filename, reader_creator, feeder=None,
                                     compressor=None, max_num_records=1000,
                                     feed_order=None):
     """Serialize every sample (tuple of arrays) from the reader into one
-    recordio file; one record = one sample = concatenated tensor records
-    with a count prefix. Returns number of records written."""
-    import struct
+    recordio file. Returns number of records written."""
     count = 0
     with RecordIOWriter(filename, max_chunk_records=max_num_records) as w:
         for sample in reader_creator():
-            if not isinstance(sample, (tuple, list)):
-                sample = (sample,)
-            parts = [struct.pack("<I", len(sample))]
-            for field in sample:
-                arr = np.asarray(field)
-                t = serialize_tensor(arr)
-                parts.append(struct.pack("<Q", len(t)))
-                parts.append(t)
-            w.write(b"".join(parts))
+            w.write(_serialize_sample(sample))
             count += 1
     return count
+
+
+def convert_reader_to_recordio_files(filename, batch_per_file,
+                                     reader_creator, feeder=None,
+                                     compressor=None, max_num_records=1000,
+                                     feed_order=None):
+    """Shard a reader across many recordio files, at most
+    `batch_per_file` records each (reference recordio_writer.py:91:
+    '<stem>-00000<ext>', '<stem>-00001<ext>', ...). Returns the list of
+    files written."""
+    import os
+    stem, ext = os.path.splitext(filename)
+    files = []
+    writer = None
+    in_file = 0
+    idx = 0
+    try:
+        for sample in reader_creator():
+            if writer is None or in_file >= batch_per_file:
+                if writer is not None:
+                    writer.close()
+                path = "%s-%05d%s" % (stem, idx, ext)
+                writer = RecordIOWriter(path,
+                                        max_chunk_records=max_num_records)
+                files.append(path)
+                idx += 1
+                in_file = 0
+            writer.write(_serialize_sample(sample))
+            in_file += 1
+    finally:
+        if writer is not None:
+            writer.close()
+    return files
 
 
 def recordio_reader(filename):
     """Reader creator over a recordio file (reference open_files /
     recordio reader ops, operators/reader/)."""
-    import struct
 
     def reader():
         with RecordIOScanner(filename) as s:

@@ -50,6 +50,8 @@ def _load(ctx):
     path = ctx.attr("file_path")
     with open(path, "rb") as f:
         arr = np.load(f)
+    if ctx.attr("load_as_fp16", False):
+        arr = arr.astype(np.float16)
     return {"Out": jnp.asarray(arr)}
 
 

@@ -152,6 +152,18 @@ def _sequence_mask(ctx):
     return {"Y": m.reshape(tuple(x.shape) + (maxlen,))}
 
 
+@register_op("sequence_length")
+def _sequence_length(ctx):
+    """Per-sequence valid lengths [B] from the @LOD_LEN companion; a
+    dense input (no companion) is full-width by construction."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    lens = ctx.lod_len("X")
+    if lens is None:
+        lens = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    return {"Out": lens.astype(jnp.int64)}
+
+
 @register_op("sequence_reverse")
 def _sequence_reverse(ctx):
     jnp = _jnp()

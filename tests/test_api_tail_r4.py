@@ -1,0 +1,127 @@
+"""Round-4 API.spec tail: the 15 symbols VERDICT r3 #6 listed as
+unresolved, each exercised functionally (not just importable).
+Reference: paddle/fluid/API.spec lines 20, 196-203, 318-322, 331, 392,
+408, 412."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+
+
+def test_scope_new_scope_parent_fallback():
+    s = fluid.executor.Scope()
+    s.set("w", np.ones(2))
+    kid = s.new_scope()
+    # reads fall through to the parent; writes stay local
+    np.testing.assert_array_equal(kid.get("w"), np.ones(2))
+    assert kid.has("w")
+    kid.set("w", np.zeros(2))
+    np.testing.assert_array_equal(kid.get("w"), np.zeros(2))
+    np.testing.assert_array_equal(s.get("w"), np.ones(2))
+    assert kid.find_var("w") is not None
+    s.drop_kids()
+
+
+def test_layers_load_roundtrip(tmp_path):
+    path = str(tmp_path / "t.npy")
+    val = np.arange(6, dtype=np.float32).reshape(2, 3)
+    with open(path, "wb") as f:
+        np.save(f, val)
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        out = fluid.layers.create_tensor(dtype="float32")
+        fluid.layers.load(out, file_path=path)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(main, fetch_list=[out])
+    np.testing.assert_array_equal(np.asarray(got), val)
+
+
+def test_random_data_generator_and_preprocessor():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.random_data_generator(
+            low=0.0, high=1.0, shapes=[[4, 3], [4, 1]], lod_levels=[0, 0])
+        pre = fluid.layers.Preprocessor(reader=reader)
+        with pre.block():
+            img, lbl = pre.inputs()
+            img_out = fluid.layers.scale(img, scale=2.0)
+            lbl_out = fluid.layers.scale(lbl, scale=1.0, bias=1.0)
+            pre.outputs(img_out, lbl_out)
+        img_v, lbl_v = fluid.layers.read_file(pre())
+        s = fluid.layers.reduce_mean(img_v)
+    exe = fluid.Executor(fluid.CPUPlace())
+    reader.start()
+    vals = []
+    for _ in range(3):
+        feed = reader.next_feed()
+        (img_np, lbl_np, sv) = exe.run(main, feed=feed,
+                                       fetch_list=[img_v, lbl_v, s])
+        img_np = np.asarray(img_np)
+        lbl_np = np.asarray(lbl_np)
+        # scaled uniforms: img in [0,2), lbl in [1,2)
+        assert img_np.shape == (4, 3) and lbl_np.shape == (4, 1)
+        assert (img_np >= 0).all() and (img_np < 2).all()
+        assert (lbl_np >= 1).all() and (lbl_np < 2).all()
+        vals.append(float(np.asarray(sv)))
+    reader.reset()
+    assert len(set(vals)) > 1   # actually random, not constant
+
+
+def test_data_feeder_decorate_reader():
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("dx", shape=[2])
+        y = fluid.layers.data("dy", shape=[1], dtype="int64")
+    feeder = fluid.DataFeeder(feed_list=[x, y], program=main)
+
+    def rdr():
+        for i in range(6):
+            yield [(np.full(2, i, np.float32),
+                    np.array([i], np.int64))]
+
+    single = list(feeder.decorate_reader(rdr, multi_devices=False)())
+    assert len(single) == 6 and set(single[0]) == {"dx", "dy"}
+    multi = list(feeder.decorate_reader(rdr, multi_devices=True,
+                                        num_places=2)())
+    assert len(multi) == 3            # 6 batches -> 3 steps of 2 devices
+    assert isinstance(multi[0], list) and len(multi[0]) == 2
+    assert float(np.asarray(multi[1][0]["dx"])[0, 0]) == 2.0
+
+
+def test_transpiler_get_pserver_programs():
+    import paddle_tpu.fluid.transpiler as transpiler
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(x, size=2)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        t = transpiler.DistributeTranspiler()
+        t.transpile(trainer_id=0,
+                    pservers="127.0.0.1:6174,127.0.0.1:6175", trainers=2)
+        prog, start = t.get_pserver_programs("127.0.0.1:6174")
+    types = [op.type for op in prog.global_block().ops]
+    assert "listen_and_serv" in types
+    assert start.global_block().ops  # startup initializes assigned params
+
+
+def test_convert_reader_to_recordio_files(tmp_path):
+    from paddle_tpu.fluid import recordio_writer
+
+    def rdr():
+        for i in range(7):
+            yield (np.full((2,), i, np.float32),)
+
+    files = recordio_writer.convert_reader_to_recordio_files(
+        str(tmp_path / "d.recordio"), batch_per_file=3,
+        reader_creator=rdr)
+    assert [os.path.basename(f) for f in files] == \
+        ["d-00000.recordio", "d-00001.recordio", "d-00002.recordio"]
+    got = []
+    for f in files:
+        for rec in recordio_writer.recordio_reader(f)():
+            got.append(float(np.asarray(rec)[0]))
+    assert got == [float(i) for i in range(7)]

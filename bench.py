@@ -90,6 +90,14 @@ def main():
     state_names = tuple(functionalizer.persistable_names(main_prog))
     step_fn = functionalizer.build_step_fn(
         main_prog, ("data", "label"), (loss.name,), state_names)
+    if os.environ.get("BENCH_REMAT", "0") == "1":
+        # rematerialized backward: keep only conv outputs as residuals,
+        # recompute BN/activation tails — trades (spare) FLOPs for HBM
+        # reads; see ROOFLINE.md "what would move the number"
+        step_fn = jax.checkpoint(
+            step_fn,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "conv_out"))
     jitted = jax.jit(step_fn, donate_argnums=(0,))
 
     state = {n: scope.get(n) for n in state_names

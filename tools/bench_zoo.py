@@ -1,0 +1,113 @@
+"""BASELINE.json model-zoo benchmark sweep (VERDICT r3 #2).
+
+Runs every tracked config through tools/fluid_benchmark.py in fresh
+subprocesses (one clean backend init each) and writes ONE sidecar JSON
+with throughput + a step-time breakdown per model. On a real chip the
+numbers are recorded as TPU; when the transport is down the sweep still
+completes in CPU smoke mode with a self-describing backend tag (same
+degradation contract as bench.py).
+
+Usage:  python tools/bench_zoo.py [--out BENCH_zoo.json] [--iterations N]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+# (name, fluid_benchmark args, tpu batch, cpu smoke batch)
+CONFIGS = [
+    ("mnist_cnn", ["--model", "mnist"], 512, 64),
+    ("vgg16_cifar10", ["--model", "vgg", "--data_set", "cifar10"],
+     128, 8),
+    ("stacked_dynamic_lstm_ptb", ["--model", "stacked_dynamic_lstm"],
+     64, 8),
+    ("se_resnext_imagenet", ["--model", "se_resnext"], 64, 4),
+    ("resnet50_imagenet", ["--model", "resnet", "--data_set", "imagenet",
+                           "--layout", "NHWC"], 256, 8),
+]
+
+
+def probe_backend(timeout=120):
+    """Same wedge-proof probe as bench.py: jax init can block forever on
+    a dead TPU transport."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout, cwd=REPO)
+        if proc.returncode == 0 and proc.stdout.strip():
+            return proc.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return None
+
+
+def run_config(name, extra, batch, iterations, force_cpu):
+    cmd = [sys.executable, os.path.join(HERE, "fluid_benchmark.py"),
+           "--batch_size", str(batch), "--iterations", str(iterations),
+           "--skip_batch_num", "2"] + extra
+    env = dict(os.environ)
+    if force_cpu:
+        cmd += ["--device", "CPU"]
+    t0 = time.time()
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=3600, cwd=REPO, env=env)
+    wall = time.time() - t0
+    if proc.returncode != 0:
+        return {"config": name, "error": proc.stderr[-800:],
+                "wall_sec": round(wall, 1)}
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    if not lines:
+        return {"config": name, "wall_sec": round(wall, 1),
+                "error": "no JSON record on stdout; tail: %r"
+                         % proc.stdout[-400:]}
+    rec = json.loads(lines[-1])
+    rec["config"] = name
+    rec["wall_sec"] = round(wall, 1)
+    if rec.get("examples_per_sec"):
+        rec["ms_per_step"] = round(
+            rec["batch_size"] / rec["examples_per_sec"] * 1000.0, 2)
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_zoo.json"))
+    ap.add_argument("--iterations", type=int, default=20)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated config-name filter")
+    args = ap.parse_args()
+
+    backend = probe_backend()
+    force_cpu = backend != "tpu"
+    results = {
+        "backend": backend or "cpu-fallback (TPU transport unreachable)",
+        "smoke_mode": force_cpu,
+        "iterations": args.iterations,
+        "configs": [],
+    }
+    wanted = set(args.only.split(",")) if args.only else None
+    for name, extra, tpu_batch, cpu_batch in CONFIGS:
+        if wanted and name not in wanted:
+            continue
+        batch = cpu_batch if force_cpu else tpu_batch
+        print("== %s (batch %d) ==" % (name, batch), flush=True)
+        rec = run_config(name, extra, batch, args.iterations, force_cpu)
+        print(json.dumps(rec), flush=True)
+        results["configs"].append(rec)
+        # persist after every config: a crash or ^C mid-sweep must not
+        # discard completed hour-scale runs
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+
+    print("wrote %s" % args.out)
+
+
+if __name__ == "__main__":
+    main()

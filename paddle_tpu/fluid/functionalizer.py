@@ -91,7 +91,7 @@ def _write_outputs(op, outs, env):
 # companion propagation must not re-attach lengths to their outputs
 _LOD_DROP_OPS = frozenset([
     "sequence_pool", "sequence_first_step", "sequence_last_step",
-    "sequence_length",
+    "sequence_length", "kmax_seq_score", "lambda_rank",
     "sequence_mask", "mean", "reduce_sum", "reduce_mean", "reduce_max",
     "shape", "accuracy", "top_k",
     "linear_chain_crf", "warpctc", "edit_distance", "chunk_eval", "auc",

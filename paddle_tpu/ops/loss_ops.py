@@ -472,6 +472,54 @@ def _rank_loss(ctx):
     return {"Out": jnp.logaddexp(0.0, o) - label * o}
 
 
+@register_op("lambda_rank")
+def _lambda_rank(ctx):
+    """LambdaRank cost over per-query (per-sequence) score lists
+    (reference legacy LambdaCost): for every in-query pair with
+    rel_i > rel_j, |deltaNDCG(i,j)| * log(1 + exp(-(s_i - s_j))),
+    where deltaNDCG swaps the two items' positions in the
+    score-descending ranking, truncated at NDCG_num. Padded [B, T]
+    encoding; O(T^2) pairwise terms batch onto the VPU."""
+    jnp = _jnp()
+    score = ctx.input("Score")      # model scores [B, T(, 1)]
+    rel = ctx.input("Label")        # relevance   [B, T(, 1)]
+    lens = ctx.lod_len("Score")
+    if lens is None:
+        lens = ctx.lod_len("Label")
+    if score.ndim == 3:             # padded ragged rows carry a width-1
+        score = score[..., 0]       # feature dim
+    B, T = score.shape
+    rel = rel.reshape(B, T)
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    ndcg_num = int(ctx.attr("NDCG_num", 5))
+    valid = jnp.arange(T)[None, :] < lens[:, None]          # [B, T]
+
+    # rank position of each item under score-descending order
+    order = jnp.argsort(jnp.where(valid, -score, jnp.inf), axis=1)
+    pos = jnp.argsort(order, axis=1)                        # [B, T] 0-based
+    gain = jnp.exp2(rel) - 1.0
+    disc = jnp.where(pos < ndcg_num,
+                     1.0 / jnp.log2(pos.astype(score.dtype) + 2.0), 0.0)
+    # ideal DCG truncated at NDCG_num, from relevance-descending order
+    ideal_gain = -jnp.sort(jnp.where(valid, -gain, 0.0), axis=1)
+    k = min(ndcg_num, T)
+    max_dcg = jnp.sum(
+        ideal_gain[:, :k] / jnp.log2(jnp.arange(k, dtype=score.dtype)
+                                     + 2.0), axis=1)
+    safe_max = jnp.where(max_dcg > 0, max_dcg, 1.0)
+
+    dgain = gain[:, :, None] - gain[:, None, :]             # [B, T, T]
+    ddisc = disc[:, :, None] - disc[:, None, :]
+    dndcg = jnp.abs(dgain * ddisc) / safe_max[:, None, None]
+    ds = score[:, :, None] - score[:, None, :]
+    pair = (rel[:, :, None] > rel[:, None, :]) & \
+        valid[:, :, None] & valid[:, None, :]
+    loss = jnp.sum(jnp.where(pair, dndcg * jnp.logaddexp(0.0, -ds), 0.0),
+                   axis=(1, 2))
+    return {"Out": jnp.where(max_dcg > 0, loss, 0.0)[:, None]}
+
+
 @register_op("nce")
 def _nce(ctx):
     """Noise-contrastive estimation with a uniform sampler (nce_op.h).

@@ -531,6 +531,70 @@ def _gru(ctx):
             "BatchHidden": hidden}
 
 
+@register_op("kmax_seq_score")
+def _kmax_seq_score(ctx):
+    """Indices of the beam_size highest scores within each sequence's
+    VALID prefix (reference legacy KmaxSeqScoreLayer) — padded positions
+    are masked out before the top-k."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    if x.ndim == 3:
+        x = x[..., 0]
+    lens = ctx.lod_len("X")
+    B, T = x.shape
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    k = int(ctx.attr("beam_size", 1))
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    masked = jnp.where(valid, x, -jnp.inf)
+    idx = jnp.argsort(-masked, axis=1)[:, :k]
+    return {"Out": idx.astype(jnp.int64)}
+
+
+@register_op("simple_rnn")
+def _simple_rnn(ctx):
+    """Elman recurrence h_t = act(x_t + h_{t-1} @ W) over a pre-projected
+    sequence (reference legacy RecurrentLayer — the v1 recurrent_layer
+    contract: input already carries the x @ U projection)."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("Input")      # [B, T, H]
+    w = ctx.input("Weight")     # [H, H]
+    bias = ctx.input("Bias")
+    lens = ctx.lod_len("Input")
+    B, T, H = x.shape
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    if bias is not None:
+        x = x + bias.reshape(1, 1, H)
+    acts = {"tanh": jnp.tanh, "relu": jax.nn.relu,
+            "sigmoid": jax.nn.sigmoid, "identity": lambda v: v,
+            "abs": jnp.abs, "square": jnp.square, "exp": jnp.exp,
+            "softsign": jax.nn.soft_sign}
+    name = ctx.attr("activation", "tanh")
+    if name not in acts:
+        raise NotImplementedError(
+            "simple_rnn activation %r (supported: %s)"
+            % (name, sorted(acts)))
+    act = acts[name]
+    reverse = bool(ctx.attr("is_reverse", False))
+    xs = _reverse_valid(x, lens) if reverse else x
+
+    def step(h_prev, xt_t):
+        xt, t = xt_t
+        h = act(xt + h_prev @ w)
+        valid = (t < lens)[:, None]
+        h = jnp.where(valid, h, 0.0)
+        return h, h
+
+    _, hs = jax.lax.scan(step, jnp.zeros((B, H), x.dtype),
+                         (jnp.swapaxes(xs, 0, 1), jnp.arange(T)))
+    out = jnp.swapaxes(hs, 0, 1)
+    if reverse:
+        out = _reverse_valid(out, lens)
+    return {"Out": out, "Out@LOD_LEN": lens}
+
+
 @register_op("lstm_unit")
 def _lstm_unit(ctx):
     import jax
@@ -649,10 +713,14 @@ def _gru_unit(ctx):
     H = h_prev.shape[-1]
     if bias is not None:
         x = x + bias.reshape(1, -1)
+    acts = {"tanh": jnp.tanh, "relu": jax.nn.relu,
+            "sigmoid": jax.nn.sigmoid, "identity": lambda v: v}
+    act = acts[ctx.attr("activation", "tanh")]
+    gate_act = acts[ctx.attr("gate_activation", "sigmoid")]
     xrz, xc = x[:, :2 * H], x[:, 2 * H:]
-    rz = jax.nn.sigmoid(xrz + h_prev @ w[:, :2 * H])
+    rz = gate_act(xrz + h_prev @ w[:, :2 * H])
     u, r = jnp.split(rz, 2, axis=-1)
-    cand = jnp.tanh(xc + (r * h_prev) @ w[:, 2 * H:])
+    cand = act(xc + (r * h_prev) @ w[:, 2 * H:])
     h = u * h_prev + (1 - u) * cand
     return {"Hidden": h, "Gate": rz, "ResetHiddenPrev": r * h_prev}
 

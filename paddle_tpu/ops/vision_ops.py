@@ -317,6 +317,29 @@ def _crop(ctx):
     return {"Out": jax.lax.dynamic_slice(x, offsets, shape)}
 
 
+@register_op("scale_sub_region")
+def _scale_sub_region(ctx):
+    """Scale a per-sample [c1,c2,h1,h2,w1,w2] sub-box of an NCHW tensor
+    by `value` (reference legacy ScaleSubRegionLayer; indices 1-based
+    inclusive). Built from broadcasted range masks so offsets may be
+    traced tensors."""
+    jnp = _jnp()
+    x = ctx.input("X")          # [B, C, H, W]
+    idx = ctx.input("Indices").astype(jnp.int32)   # [B, 6]
+    value = ctx.attr("value", 1.0)
+    B, C, H, W = x.shape
+
+    def axis_mask(lo, hi, n):
+        r = jnp.arange(n)[None, :]
+        return ((r >= (lo - 1)[:, None]) & (r <= (hi - 1)[:, None]))
+
+    mc = axis_mask(idx[:, 0], idx[:, 1], C)[:, :, None, None]
+    mh = axis_mask(idx[:, 2], idx[:, 3], H)[:, None, :, None]
+    mw = axis_mask(idx[:, 4], idx[:, 5], W)[:, None, None, :]
+    m = (mc & mh & mw)
+    return {"Out": jnp.where(m, x * value, x)}
+
+
 @register_op("random_crop")
 def _random_crop(ctx):
     """random_crop_op.cc: crop the trailing dims to `shape` at a random

@@ -141,3 +141,90 @@ from .attrs import (ParameterAttribute, ExtraLayerAttribute,  # noqa: E402
 
 # the v1 return type name; v2 Layer nodes play the role
 LayerOutput = _LayerNode
+
+# round-4b gserver tail: the remaining reference v1 __all__ names
+row_l2_norm_layer = _v2.row_l2_norm
+tensor_layer = _v2.tensor
+conv_shift_layer = _v2.conv_shift
+switch_order_layer = _v2.switch_order
+upsample_layer = _v2.upsample
+spp_layer = _v2.spp
+kmax_seq_score_layer = _v2.kmax_seq_score
+scale_sub_region_layer = _v2.scale_sub_region
+factorization_machine = _v2.factorization_machine
+selective_fc_layer = _v2.selective_fc
+print_layer = _v2.printer
+printer_layer = _v2.printer
+priorbox_layer = _v2.priorbox
+multibox_loss_layer = _v2.multibox_loss
+detection_output_layer = _v2.detection_output
+roi_pool_layer = _v2.roi_pool
+huber_classification_cost = _v2.huber_classification_cost
+cross_entropy_with_selfnorm = _v2.cross_entropy_with_selfnorm
+lambda_cost = _v2.lambda_cost
+recurrent_layer = _v2.recurrent
+lstm_step_layer = _v2.lstm_step
+gru_step_layer = _v2.gru_step
+gru_step_naive_layer = _v2.gru_step_naive
+get_output_layer = _v2.get_output
+hsigmoid = _v2.hsigmoid
+
+
+class AggregateLevel(object):
+    """pooling/aggregation granularity over (nested) sequences
+    (reference layers.py AggregateLevel)."""
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    # compat spellings
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
+
+
+class ExpandLevel(object):
+    """expansion granularity (reference layers.py ExpandLevel)."""
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    FROM_TIMESTEP = FROM_NO_SEQUENCE
+
+
+class LayerType(object):
+    """layer-type string constants (reference layers.py LayerType);
+    here they mirror the Layer.layer_type tags."""
+    DATA = "data"
+    FC_LAYER = "fc"
+    MIXED_LAYER = "mixed"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "grumemory"
+    SEQUENCE_LAST_INSTANCE = "last_seq"
+    SEQUENCE_FIRST_INSTANCE = "first_seq"
+    POOLING_MAX = "max"
+    POOLING_AVG = "average"
+    COST = "cost"
+
+    @staticmethod
+    def is_layer_type(type_name):
+        return isinstance(type_name, str)
+
+
+def layer_support(*attrs):
+    """Decorator marking which ExtraLayerAttribute fields a layer honors
+    (reference layer_support). Attribute application happens uniformly in
+    config_base._apply_extra_attr, so this is a transparent marker."""
+    def decorator(fn):
+        return fn
+    return decorator
+
+
+__all__ += [
+    "row_l2_norm_layer", "tensor_layer", "conv_shift_layer",
+    "switch_order_layer", "upsample_layer", "spp_layer",
+    "kmax_seq_score_layer", "scale_sub_region_layer",
+    "factorization_machine", "selective_fc_layer", "print_layer",
+    "printer_layer", "priorbox_layer", "multibox_loss_layer",
+    "detection_output_layer", "roi_pool_layer",
+    "huber_classification_cost", "cross_entropy_with_selfnorm",
+    "lambda_cost", "recurrent_layer", "lstm_step_layer",
+    "gru_step_layer", "gru_step_naive_layer", "get_output_layer",
+    "hsigmoid", "AggregateLevel", "ExpandLevel", "LayerType",
+    "layer_support",
+]

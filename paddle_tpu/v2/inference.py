@@ -54,7 +54,8 @@ class Inference(object):
         results = []
         for res in self.iter_infer_field(field=field, input=input, **kwargs):
             results.append(res)
-        outs = [np.concatenate([r[i] for r in results], axis=0)
+        outs = [np.concatenate([np.atleast_1d(r[i]) for r in results],
+                               axis=0)
                 for i in range(len(results[0]))]
         if flatten_result and len(outs) == 1:
             return outs[0]

@@ -42,6 +42,13 @@ __all__ = [
     "sampling_id", "out_prod", "block_expand", "crop", "clip",
     "dot_prod", "l2_distance", "smooth_l1_cost", "multiplex", "prelu",
     "gated_unit", "scale_shift", "resize", "row_conv", "sub_seq",
+    # round-4b gserver tail
+    "row_l2_norm", "tensor", "conv_shift", "switch_order", "upsample",
+    "spp", "kmax_seq_score", "scale_sub_region", "factorization_machine",
+    "selective_fc", "printer", "priorbox", "multibox_loss",
+    "detection_output", "roi_pool", "huber_classification_cost",
+    "cross_entropy_with_selfnorm", "lambda_cost", "recurrent",
+    "lstm_step", "gru_step", "gru_step_naive", "get_output",
 ]
 
 _name_to_layer = {}
@@ -1377,3 +1384,524 @@ def parse_network(output_layers, extra_layers=None):
     if not isinstance(output_layers, (list, tuple)):
         output_layers = [output_layers]
     return Topology(output_layers, extra_layers=extra_layers).proto()
+
+
+# ---------------------------------------------------------------------------
+# round-4b gserver tail: the rest of the reference v1 __all__ surface
+# (reference trainer_config_helpers/layers.py; legacy/gserver/layers/)
+# ---------------------------------------------------------------------------
+
+def _append_raw_op(op_type, inputs, attrs=None, dtype="float32",
+                   lod_out=False, n_outs=1, infer_shape=True):
+    """Emit one registry op from a v2 builder (for ops with no public
+    fluid layer — the v1-only gserver semantics)."""
+    from ..fluid.layer_helper import LayerHelper
+    helper = LayerHelper(op_type)
+    outs = [helper.create_variable_for_type_inference(dtype)
+            for _ in range(n_outs)]
+    if lod_out:
+        for o in outs:
+            o.lod_level = 1
+    out_slots = {"Out": outs[0]} if n_outs == 1 else \
+        {"Out%d" % i: o for i, o in enumerate(outs)}
+    helper.append_op(type=op_type, inputs=inputs, outputs=out_slots,
+                     attrs=attrs or {}, infer_shape=infer_shape)
+    return outs[0] if n_outs == 1 else outs
+
+
+def row_l2_norm(input, name=None, layer_attr=None):
+    """RowL2NormLayer: x / ||x||_2 per row."""
+    def build(pv):
+        return F.l2_normalize(pv, axis=-1)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="row_l2_norm",
+                           layer_attr=layer_attr))
+
+
+def tensor(a, b, size, act=None, name=None, param_attr=None,
+           bias_attr=None, layer_attr=None):
+    """TensorLayer: out_k = a W_k b^T (bilinear tensor product,
+    reference tensor_layer). W stored [da, size*db] so the contraction
+    is one MXU matmul + a broadcast multiply."""
+    def build(av, bv):
+        da, db = int(av.shape[-1]), int(bv.shape[-1])
+        w = F.create_parameter(shape=[da, size * db], dtype="float32",
+                               attr=lower_param_attr(param_attr))
+        proj = F.matmul(av, w)                       # [B, size*db]
+        proj = F.reshape(proj, shape=[-1, size, db])
+        out = F.reduce_sum(
+            F.elementwise_mul(proj, F.reshape(bv, shape=[-1, 1, db])),
+            dim=-1)                                  # [B, size]
+        if bias_attr is not False:
+            out = _add_bias(out, bias_attr, size)
+        return _apply_act(out, act)
+
+    return _remember(Layer(name=name, parents=[a, b], build_fn=build,
+                           layer_type="tensor", layer_attr=layer_attr))
+
+
+def conv_shift(a, b, name=None, layer_attr=None):
+    """ConvShiftLayer: circular correlation
+    c[i] = sum_j a[i+j-(N-1)/2] b[j], N odd (reference conv_shift_layer).
+    N is static (b's width), so the shifts unroll into N adds."""
+    def build(av, bv):
+        n = int(bv.shape[-1])
+        m = int(av.shape[-1])
+        half = (n - 1) // 2
+        total = None
+        for j in range(n):
+            shift = j - half
+            # circular shift of a by `shift` via two static slices
+            k = shift % m
+            if k == 0:
+                rolled = av
+            else:
+                left = F.slice(av, axes=[1], starts=[k], ends=[m])
+                right = F.slice(av, axes=[1], starts=[0], ends=[k])
+                rolled = F.concat([left, right], axis=1)
+            bj = F.slice(bv, axes=[1], starts=[j], ends=[j + 1])
+            term = F.elementwise_mul(rolled, bj)
+            total = term if total is None else \
+                F.elementwise_add(total, term)
+        return total
+
+    return _remember(Layer(name=name, parents=[a, b], build_fn=build,
+                           layer_type="conv_shift", layer_attr=layer_attr))
+
+
+def switch_order(input, reshape_axis=None, act=None, name=None,
+                 layer_attr=None):
+    """SwitchOrderLayer: NCHW -> NHWC (reference switch_order_layer)."""
+    def build(pv):
+        return _apply_act(F.transpose(pv, perm=[0, 2, 3, 1]), act)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="switch_order",
+                           layer_attr=layer_attr))
+
+
+def upsample(input, scale=None, scale_y=None, upsample_size=None,
+             upsample_size_y=None, pad_out_x=False, pad_out_y=False,
+             name=None, layer_attr=None):
+    """UpsampleLayer as nearest-neighbor resize by integer scale. The
+    reference's unpool-with-mask form pairs with max_pool_with_mask
+    (legacy UpsampleLayer.cpp); the resize semantics cover the common
+    segmentation-decoder use — use fluid.layers.unpool for mask-exact
+    unpooling."""
+    def build(pv):
+        sy = scale_y or scale
+        h, w = int(pv.shape[2]), int(pv.shape[3])
+        if upsample_size:
+            out_hw = [upsample_size_y or upsample_size, upsample_size]
+        else:
+            out_hw = [h * sy, w * scale]
+        return F.resize_nearest(pv, out_shape=out_hw)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="upsample",
+                           layer_attr=layer_attr))
+
+
+def spp(input, pyramid_height=None, num_channels=None, pool_type=None,
+        name=None, layer_attr=None):
+    """SpatialPyramidPoolLayer: concat max/avg pools at pyramid levels
+    1x1 .. 2^(h-1) bins (reference spp_layer)."""
+    ptype = pool_type or _pooling.Max()
+    if isinstance(ptype, type):
+        ptype = ptype()
+
+    def build(pv):
+        h, w = int(pv.shape[2]), int(pv.shape[3])
+        c = int(pv.shape[1])
+        reduce = F.reduce_max if ptype.img_pool_type == "max" \
+            else F.reduce_mean
+        outs = []
+        for lvl in range(pyramid_height):
+            bins = 2 ** lvl
+            # exact bin boundaries (floor start, ceil end) — works for
+            # any h/w, matching the reference's adaptive binning
+            cells = []
+            for bi in range(bins):
+                h0, h1 = bi * h // bins, -(-(bi + 1) * h // bins)
+                for bj in range(bins):
+                    w0, w1 = bj * w // bins, -(-(bj + 1) * w // bins)
+                    cell = F.slice(pv, axes=[2, 3], starts=[h0, w0],
+                                   ends=[h1, w1])
+                    cells.append(reduce(cell, dim=[2, 3]))  # [B, C]
+            lvl_out = F.stack(cells, axis=2)                # [B, C, bins^2]
+            outs.append(F.reshape(lvl_out, shape=[-1, c * bins * bins]))
+        return F.concat(outs, axis=1)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="spp",
+                           layer_attr=layer_attr))
+
+
+def kmax_seq_score(input, beam_size=1, name=None):
+    """KmaxSeqScoreLayer: indices of the beam_size highest scores within
+    each sequence's valid prefix (ops/sequence_ops.py kmax_seq_score —
+    padded positions never outrank real ones)."""
+    def build(pv):
+        out = _append_raw_op("kmax_seq_score", {"X": pv},
+                             {"beam_size": int(beam_size)},
+                             dtype="int64", infer_shape=False)
+        out.shape = (-1, int(beam_size))
+        return out
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="kmax_seq_score"))
+
+
+def scale_sub_region(input, indices, value, name=None):
+    """ScaleSubRegionLayer: scale the per-sample [c1,c2,h1,h2,w1,w2]
+    box (1-based inclusive) by `value` (ops/vision_ops.py
+    scale_sub_region)."""
+    def build(pv, iv):
+        return _append_raw_op(
+            "scale_sub_region", {"X": pv, "Indices": iv},
+            {"value": float(value)}, dtype=pv.dtype)
+
+    return _remember(Layer(name=name, parents=[input, indices],
+                           build_fn=build, layer_type="scale_sub_region"))
+
+
+def factorization_machine(input, factor_size, act=None, name=None,
+                          param_attr=None, layer_attr=None):
+    """FactorizationMachineLayer: second-order FM interactions
+    0.5 * sum((xV)^2 - (x^2)(V^2)) (Rendle 2010; reference
+    factorization_machine)."""
+    def build(pv):
+        d = int(pv.shape[-1])
+        v = F.create_parameter(shape=[d, factor_size], dtype="float32",
+                               attr=lower_param_attr(param_attr))
+        xv2 = F.square(F.matmul(pv, v))
+        x2v2 = F.matmul(F.square(pv), F.square(v))
+        out = F.scale(F.reduce_sum(
+            F.elementwise_sub(xv2, x2v2), dim=-1, keep_dim=True),
+            scale=0.5)
+        return _apply_act(out, act)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="factorization_machine",
+                           layer_attr=layer_attr))
+
+
+def selective_fc(input, size, select=None, act=None, name=None,
+                 pass_generation=False, has_selected_colums=True,
+                 mul_ratio=0.02, param_attr=None, bias_attr=None,
+                 layer_attr=None):
+    """SelectiveFullyConnectedLayer: fc whose output is restricted to the
+    columns marked in `select`. The reference skips the un-selected
+    columns' FLOPs on CPU; on the MXU the full matmul is the fast path,
+    so this computes fc then masks — identical semantics."""
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(*vs):
+        pvs, sv = vs[:-1], vs[-1]
+        outs = [F.fc(pv, size=size, param_attr=lower_param_attr(param_attr),
+                     bias_attr=False) for pv in pvs]
+        out = outs[0]
+        for o in outs[1:]:
+            out = F.elementwise_add(out, o)
+        if bias_attr is not False:
+            out = _add_bias(out, bias_attr, size)
+        out = _apply_act(out, act)
+        return F.elementwise_mul(out, F.cast(sv, "float32"))
+
+    parents = list(inputs) + [select]
+    if select is None:
+        raise ValueError("selective_fc requires a select input (a 0/1 "
+                         "mask layer over the output columns)")
+    return _remember(Layer(name=name, parents=parents, build_fn=build,
+                           layer_type="selective_fc",
+                           layer_attr=layer_attr))
+
+
+def printer(input, format=None, name=None):
+    """PrintLayer -> Print op (passthrough)."""
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(*vs):
+        outs = [F.Print(v, message=format or "") for v in vs]
+        return outs[0] if len(outs) == 1 else outs
+
+    return _remember(Layer(name=name, parents=list(inputs),
+                           build_fn=build, layer_type="printer"))
+
+
+def priorbox(input, image, aspect_ratio, variance, min_size, max_size=None,
+             name=None):
+    """PriorBoxLayer -> fluid prior_box; returns the [prior, 8] layout the
+    v1 detection stack consumed (4 box + 4 variance columns)."""
+    def build(pv, iv):
+        box, var = F.prior_box(
+            pv, iv, min_sizes=list(min_size),
+            max_sizes=list(max_size) if max_size else None,
+            aspect_ratios=list(aspect_ratio), variance=list(variance),
+            flip=True)
+        b = F.reshape(box, shape=[-1, 4])
+        v = F.reshape(var, shape=[-1, 4])
+        return F.concat([b, v], axis=1)
+
+    return _remember(Layer(name=name, parents=[input, image],
+                           build_fn=build, layer_type="priorbox"))
+
+
+def multibox_loss(input_loc, input_conf, priorbox, label, num_classes,
+                  overlap_threshold=0.5, neg_pos_ratio=3.0,
+                  neg_overlap=0.5, background_id=0, name=None):
+    """MultiBoxLossLayer -> fluid ssd_loss over the mbox head tensors."""
+    locs = input_loc if isinstance(input_loc, (list, tuple)) \
+        else [input_loc]
+    confs = input_conf if isinstance(input_conf, (list, tuple)) \
+        else [input_conf]
+
+    def build(*vs):
+        n_loc = len(locs)
+        loc_vs = list(vs[:n_loc])
+        conf_vs = list(vs[n_loc:n_loc + len(confs)])
+        pb_v, lbl_v = vs[-2], vs[-1]
+        loc = loc_vs[0] if len(loc_vs) == 1 else F.concat(loc_vs, axis=1)
+        conf = conf_vs[0] if len(conf_vs) == 1 \
+            else F.concat(conf_vs, axis=1)
+        # v1 packed [prior, 8] -> fluid (boxes [P,4], variances [P,4])
+        pb = F.slice(pb_v, axes=[1], starts=[0], ends=[4])
+        pbv = F.slice(pb_v, axes=[1], starts=[4], ends=[8])
+        gt_box = F.slice(lbl_v, axes=[1], starts=[1], ends=[5])
+        gt_lbl = F.cast(F.slice(lbl_v, axes=[1], starts=[0], ends=[1]),
+                        "int64")
+        loc = F.reshape(loc, shape=[0, -1, 4])
+        conf = F.reshape(conf, shape=[0, -1, num_classes])
+        loss = F.ssd_loss(loc, conf, gt_box, gt_lbl, pb, pbv,
+                          overlap_threshold=overlap_threshold,
+                          neg_pos_ratio=neg_pos_ratio,
+                          neg_overlap=neg_overlap,
+                          background_label=background_id)
+        return F.mean(loss)
+
+    return _remember(Layer(name=name,
+                           parents=locs + confs + [priorbox, label],
+                           build_fn=build, layer_type="multibox_loss"))
+
+
+def detection_output(input_loc, input_conf, priorbox, num_classes,
+                     nms_threshold=0.45, nms_top_k=400, keep_top_k=200,
+                     confidence_threshold=0.01, background_id=0,
+                     name=None):
+    """DetectionOutputLayer -> fluid detection_output (decode + NMS)."""
+    locs = input_loc if isinstance(input_loc, (list, tuple)) \
+        else [input_loc]
+    confs = input_conf if isinstance(input_conf, (list, tuple)) \
+        else [input_conf]
+
+    def build(*vs):
+        n_loc = len(locs)
+        loc_vs = list(vs[:n_loc])
+        conf_vs = list(vs[n_loc:n_loc + len(confs)])
+        pb_v = vs[-1]
+        loc = loc_vs[0] if len(loc_vs) == 1 else F.concat(loc_vs, axis=1)
+        conf = conf_vs[0] if len(conf_vs) == 1 \
+            else F.concat(conf_vs, axis=1)
+        pb = F.slice(pb_v, axes=[1], starts=[0], ends=[4])
+        pbv = F.slice(pb_v, axes=[1], starts=[4], ends=[8])
+        loc = F.reshape(loc, shape=[0, -1, 4])
+        # conf stays logits: F.detection_output softmaxes internally
+        # (fluid/layers/detection.py)
+        conf = F.reshape(conf, shape=[0, -1, num_classes])
+        return F.detection_output(
+            loc, conf, pb, pbv, nms_threshold=nms_threshold,
+            nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+            score_threshold=confidence_threshold,
+            background_label=background_id)
+
+    return _remember(Layer(name=name, parents=locs + confs + [priorbox],
+                           build_fn=build, layer_type="detection_output"))
+
+
+def roi_pool(input, rois, pooled_width, pooled_height, spatial_scale,
+             num_channels=None, name=None):
+    """ROIPoolLayer -> fluid roi_pool."""
+    def build(pv, rv):
+        return F.roi_pool(pv, rv, pooled_height=pooled_height,
+                          pooled_width=pooled_width,
+                          spatial_scale=spatial_scale)
+
+    return _remember(Layer(name=name, parents=[input, rois],
+                           build_fn=build, layer_type="roi_pool"))
+
+
+def huber_classification_cost(input, label, name=None, coeff=1.0,
+                              layer_attr=None):
+    """Modified Huber loss for binary classification over a real score f
+    and label y in {0,1} -> y' in {-1,1}: max(0, 1-y'f)^2 for y'f >= -1,
+    else -4 y'f (reference huber_classification_cost)."""
+    def build(pv, lv):
+        yp = F.scale(F.cast(lv, "float32"), scale=2.0, bias=-1.0)
+        a = F.elementwise_mul(pv, yp)
+        hinge_sq = F.square(F.relu(F.scale(a, scale=-1.0, bias=1.0)))
+        linear = F.scale(a, scale=-4.0)
+        big = F.cast(F.less_than(a, F.fill_constant_batch_size_like(
+            a, shape=[-1, 1], dtype="float32", value=-1.0)), "float32")
+        per = F.elementwise_add(
+            F.elementwise_mul(linear, big),
+            F.elementwise_mul(hinge_sq, F.scale(big, scale=-1.0,
+                                                bias=1.0)))
+        out = F.mean(per)
+        return F.scale(out, scale=coeff) if coeff != 1.0 else out
+
+    return _remember(Layer(name=name, parents=[input, label],
+                           build_fn=build, layer_type="cost",
+                           layer_attr=layer_attr))
+
+
+def cross_entropy_with_selfnorm(input, label, name=None, coeff=1.0,
+                                softmax_selfnorm_alpha=0.1,
+                                layer_attr=None):
+    """Self-normalizing CE (reference cross_entropy_with_selfnorm): the
+    input is UNNORMALIZED positive scores; cost = CE(softmax(x), y) +
+    alpha * log(Z)^2 pushes the normalizer Z toward 1 so inference can
+    skip the softmax."""
+    def build(pv, lv):
+        z = F.reduce_sum(pv, dim=-1, keep_dim=True)
+        prob = F.elementwise_div(pv, z)
+        ce = F.cross_entropy(prob, lv)
+        selfnorm = F.scale(F.square(F.log(z)),
+                           scale=softmax_selfnorm_alpha)
+        out = F.mean(F.elementwise_add(ce, selfnorm))
+        return F.scale(out, scale=coeff) if coeff != 1.0 else out
+
+    return _remember(Layer(name=name, parents=[input, label],
+                           build_fn=build, layer_type="cost",
+                           layer_attr=layer_attr))
+
+
+def lambda_cost(input, score, name=None, NDCG_num=5, max_sort_size=-1,
+                layer_attr=None):
+    """LambdaRank LTR cost (ops/loss_ops.py lambda_rank; reference
+    lambda_cost — input: per-item model scores over a sequence, score:
+    relevance labels)."""
+    def build(pv, sv):
+        raw = _append_raw_op(
+            "lambda_rank",
+            {"Score": F.reshape(pv, shape=[0, -1]) if
+             len(pv.shape) > 2 else pv,
+             "Label": F.reshape(sv, shape=[0, -1]) if
+             len(sv.shape) > 2 else sv},
+            {"NDCG_num": int(NDCG_num)}, infer_shape=False)
+        raw.shape = (-1, 1)
+        return F.mean(raw)
+
+    return _remember(Layer(name=name, parents=[input, score],
+                           build_fn=build, layer_type="cost",
+                           layer_attr=layer_attr))
+
+
+def recurrent(input, act=None, bias_attr=None, param_attr=None,
+              reverse=False, name=None, layer_attr=None):
+    """Elman recurrent_layer over a pre-projected sequence: h_t =
+    act(x_t + h_{t-1} W) (ops/sequence_ops.py simple_rnn)."""
+    def build(pv):
+        h = int(pv.shape[-1])
+        w = F.create_parameter(shape=[h, h], dtype="float32",
+                               attr=lower_param_attr(param_attr))
+        ins = {"Input": pv, "Weight": w}
+        if bias_attr is not False:
+            from ..fluid.layer_helper import LayerHelper
+            helper = LayerHelper("simple_rnn",
+                                 bias_attr=lower_param_attr(bias_attr))
+            b = helper.create_parameter(attr=helper.bias_attr,
+                                        shape=[1, h], dtype="float32",
+                                        is_bias=True)
+            ins["Bias"] = b
+        if act is None:
+            fluid_act = "tanh"        # the v1 recurrent_layer default
+        else:
+            a = act() if isinstance(act, type) else act
+            # fluid_act None == linear (v2/activation.py) -> identity
+            fluid_act = getattr(a, "fluid_act", None) or "identity"
+        out = _append_raw_op(
+            "simple_rnn", ins,
+            {"activation": fluid_act,
+             "is_reverse": bool(reverse)},
+            lod_out=True, infer_shape=False)
+        out.shape = tuple(pv.shape)
+        out.lod_level = max(getattr(pv, "lod_level", 0), 1)
+        return out
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="recurrent_plain",
+                           layer_attr=layer_attr))
+
+
+def lstm_step(input, state, size=None, act=None, gate_act=None,
+              state_act=None, bias_attr=None, name=None, layer_attr=None):
+    """LstmStepLayer for recurrent_group: the pure cell arithmetic over a
+    pre-projected [B, 4H] input and the cell-state memory. The hidden
+    output is returned; get_output(layer, 'state') reads the new cell."""
+    layer = Layer(name=name, parents=[input, state], build_fn=None,
+                  build_with_ctx=True, layer_type="lstm_step",
+                  layer_attr=layer_attr)
+
+    def build(ctx, iv, sv):
+        from ..fluid.layer_helper import LayerHelper
+        helper = LayerHelper("lstm_step")
+        h = helper.create_variable_for_type_inference(iv.dtype)
+        c = helper.create_variable_for_type_inference(iv.dtype)
+        helper.append_op(type="lstm_unit",
+                         inputs={"X": iv, "C_prev": sv},
+                         outputs={"H": h, "C": c},
+                         attrs={"forget_bias": 0.0}, infer_shape=False)
+        h.shape = tuple(sv.shape)
+        c.shape = tuple(sv.shape)
+        ctx[(id(layer), "state")] = c
+        return h
+
+    layer.__build_fn__ = build
+    return _remember(layer)
+
+
+def gru_step(input, output_mem, size=None, act=None, gate_act=None,
+             bias_attr=None, param_attr=None, name=None, layer_attr=None):
+    """GruStepLayer for recurrent_group: one GRU update over a
+    pre-projected [B, 3H] input and the previous output memory."""
+    def _resolve(a, default):
+        if a is None:
+            return default
+        a = a() if isinstance(a, type) else a
+        # fluid_act None == linear (v2/activation.py) -> identity
+        return getattr(a, "fluid_act", None) or "identity"
+
+    def build(iv, mv):
+        sz = size or int(mv.shape[-1]) * 3
+        out, _, _ = F.gru_unit(
+            iv, mv, sz, param_attr=lower_param_attr(param_attr),
+            bias_attr=lower_param_attr(bias_attr),
+            activation=_resolve(act, "tanh"),
+            gate_activation=_resolve(gate_act, "sigmoid"))
+        return out
+
+    return _remember(Layer(name=name, parents=[input, output_mem],
+                           build_fn=build, layer_type="gru_step",
+                           layer_attr=layer_attr))
+
+
+gru_step_naive = gru_step
+
+
+def get_output(input, arg_name, name=None, layer_attr=None):
+    """GetOutputLayer: read a named secondary output of a layer (e.g.
+    the 'state' cell of lstm_step)."""
+    src = _single_input(input)
+
+    def build(ctx, _pv):
+        key = (id(src), arg_name)
+        if key not in ctx:
+            raise ValueError(
+                "layer %s has no secondary output %r" % (src.name,
+                                                         arg_name))
+        return ctx[key]
+
+    return _remember(Layer(name=name, parents=[src], build_fn=build,
+                           build_with_ctx=True, layer_type="get_output",
+                           layer_attr=layer_attr))

@@ -1,0 +1,251 @@
+"""Round-4b gserver tail: the remaining reference v1 __all__ names
+(tensor/conv_shift/selective_fc/spp/recurrent/lstm_step/lambda_cost/...)
+built through v1 spellings and executed, with numpy cross-checks where
+the semantics are cheap to restate (reference
+python/paddle/trainer_config_helpers/layers.py; legacy/gserver/layers/)."""
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.trainer_config_helpers import layers as v1
+
+
+def _run(layer, vals):
+    topo = paddle.topology.Topology([layer])
+    names = [n for n, _ in topo.data_type()]
+    p = paddle.parameters.create(layer)
+    return np.asarray(paddle.infer(
+        output_layer=layer, parameters=p,
+        input=[tuple(vals[n] for n in names)]))
+
+
+def test_elementwise_tail_cross_checked():
+    rng = np.random.RandomState(3)
+    xv = rng.randn(8).astype(np.float32)
+    x = v1.data_layer(name="xb", size=8)
+
+    got = _run(v1.row_l2_norm_layer(input=x), {"xb": xv})
+    np.testing.assert_allclose(got.ravel(), xv / np.linalg.norm(xv),
+                               rtol=1e-5)
+
+    # circular correlation vs direct sum
+    bv = rng.randn(3).astype(np.float32)
+    b = v1.data_layer(name="bb", size=3)
+    got = _run(v1.conv_shift_layer(a=x, b=b), {"xb": xv, "bb": bv})
+    want = np.zeros(8, np.float32)
+    for i in range(8):
+        for j in range(-1, 2):
+            want[i] += xv[(i + j) % 8] * bv[j + 1]
+    np.testing.assert_allclose(got.ravel(), want, rtol=1e-4)
+
+
+def test_tensor_and_fm_shapes():
+    rng = np.random.RandomState(4)
+    a = v1.data_layer(name="ta", size=5)
+    b = v1.data_layer(name="tb", size=7)
+    vals = {"ta": rng.randn(5).astype(np.float32),
+            "tb": rng.randn(7).astype(np.float32)}
+    got = _run(v1.tensor_layer(a=a, b=b, size=4), vals)
+    assert got.ravel().shape == (4,) and np.all(np.isfinite(got))
+
+    got = _run(v1.factorization_machine(input=a, factor_size=3),
+               {"ta": vals["ta"]})
+    assert got.ravel().shape == (1,) and np.all(np.isfinite(got))
+
+
+def test_image_tail_shapes():
+    rng = np.random.RandomState(5)
+    img = v1.data_layer(name="im", size=2 * 4 * 4, height=4, width=4)
+    iv = rng.rand(2 * 4 * 4).astype(np.float32)
+
+    got = _run(v1.switch_order_layer(input=img), {"im": iv})
+    np.testing.assert_allclose(
+        got.ravel(), iv.reshape(2, 4, 4).transpose(1, 2, 0).ravel(),
+        rtol=1e-6)
+
+    got = _run(v1.upsample_layer(input=img, scale=2), {"im": iv})
+    assert got.ravel().shape == (2 * 8 * 8,)
+
+    # spp over pyramid height 2: 1x1 + 2x2 bins per channel = 5C
+    got = _run(v1.spp_layer(input=img, pyramid_height=2), {"im": iv})
+    assert got.ravel().shape == (2 * 5,)
+    np.testing.assert_allclose(got.ravel()[0],
+                               iv.reshape(2, 16)[0].max(), rtol=1e-6)
+
+    # scale the full channel-0 box by 3
+    idx = v1.data_layer(name="ix", size=6)
+    ixv = np.array([1, 1, 1, 4, 1, 4], np.float32)
+    got = _run(v1.scale_sub_region_layer(input=img, indices=idx, value=3.0),
+               {"im": iv, "ix": ixv})
+    want = iv.reshape(2, 4, 4).copy()
+    want[0] *= 3.0
+    np.testing.assert_allclose(got.ravel(), want.ravel(), rtol=1e-6)
+
+
+def test_selective_fc_masks_columns():
+    rng = np.random.RandomState(6)
+    x = v1.data_layer(name="sx", size=6)
+    sel = v1.data_layer(name="ss", size=4)
+    sv = np.array([1, 0, 1, 0], np.float32)
+    got = _run(v1.selective_fc_layer(input=x, size=4, select=sel,
+                                     bias_attr=False),
+               {"sx": rng.randn(6).astype(np.float32), "ss": sv})
+    assert got.ravel()[1] == 0.0 and got.ravel()[3] == 0.0
+
+
+def test_kmax_seq_score_and_printer():
+    x = v1.data_layer(name="ks", size=6)
+    xv = np.array([0.1, 0.9, 0.3, 0.7, 0.2, 0.5], np.float32)
+    got = _run(v1.kmax_seq_score_layer(input=x, beam_size=2), {"ks": xv})
+    assert set(got.ravel().astype(int)) == {1, 3}
+
+    got = _run(v1.print_layer(input=x), {"ks": xv})
+    np.testing.assert_allclose(got.ravel(), xv)
+
+
+def test_costs_run_and_rank_sensitivity():
+    rng = np.random.RandomState(7)
+
+    # modified Huber: correct confident scores cost ~0, wrong ones > 1
+    f = v1.data_layer(name="hf", size=1)
+    y = v1.data_layer(name="hy", size=1)
+    cost = v1.huber_classification_cost(input=f, label=y)
+    good = _run(cost, {"hf": np.array([2.0], np.float32),
+                       "hy": np.array([1.0], np.float32)})
+    bad = _run(cost, {"hf": np.array([-2.0], np.float32),
+                      "hy": np.array([1.0], np.float32)})
+    assert float(good) == 0.0 and float(bad) >= 4.0
+
+    # selfnorm CE: Z=1 distribution has no selfnorm penalty
+    p = v1.data_layer(name="sp", size=3)
+    lb = v1.data_layer(name="sl",
+                       type=paddle.data_type.integer_value(3))
+    cost = v1.cross_entropy_with_selfnorm(input=p, label=lb,
+                                          softmax_selfnorm_alpha=10.0)
+    z1 = _run(cost, {"sp": np.array([0.2, 0.3, 0.5], np.float32),
+                     "sl": np.array([2], np.int64)})
+    z4 = _run(cost, {"sp": 4 * np.array([0.2, 0.3, 0.5], np.float32),
+                     "sl": np.array([2], np.int64)})
+    assert float(z4) > float(z1)
+
+    # lambda_cost: perfectly-ranked scores cost less than inverted ones
+    sc = v1.data_layer(name="lsc",
+                       type=paddle.data_type.dense_vector_sequence(1))
+    rel = v1.data_layer(name="lrl",
+                        type=paddle.data_type.dense_vector_sequence(1))
+    cost = v1.lambda_cost(input=sc, score=rel, NDCG_num=3)
+    rels = np.array([[2.0], [1.0], [0.0]], np.float32)
+    good = _run(cost, {"lsc": np.array([[3.], [2.], [1.]], np.float32),
+                       "lrl": rels})
+    bad = _run(cost, {"lsc": np.array([[1.], [2.], [3.]], np.float32),
+                      "lrl": rels})
+    assert 0.0 <= float(good) < float(bad)
+
+
+def test_recurrent_layer_runs_and_respects_lengths():
+    rng = np.random.RandomState(8)
+    x = v1.data_layer(name="rx",
+                      type=paddle.data_type.dense_vector_sequence(4))
+    out = v1.recurrent_layer(input=x, bias_attr=False)
+    xv = rng.randn(3, 4).astype(np.float32)
+    got = _run(out, {"rx": xv})
+    assert got.shape[-1] == 4 and np.all(np.isfinite(got))
+
+
+def test_lstm_step_get_output_and_gru_step_in_group():
+    rng = np.random.RandomState(9)
+    x = v1.data_layer(name="gx",
+                      type=paddle.data_type.dense_vector_sequence(8))
+
+    def lstm_step(inp):
+        c_mem = v1.memory(name="c_state", size=2)
+        gates = v1.mixed_layer(
+            size=8, input=[v1.full_matrix_projection(input=inp)],
+            bias_attr=False, name="gate_proj")
+        step = v1.lstm_step_layer(input=gates, state=c_mem,
+                                  name="the_step")
+        cell = v1.get_output_layer(input=step, arg_name="state",
+                                   name="c_state")
+        return step, cell
+
+    h, _c = v1.recurrent_group(step=lstm_step, input=x)
+    last = v1.last_seq(input=h)
+    got = _run(last, {"gx": rng.randn(3, 8).astype(np.float32)})
+    assert got.ravel().shape == (2,) and np.all(np.isfinite(got))
+
+
+def test_enums_and_layer_support():
+    assert v1.AggregateLevel.TO_NO_SEQUENCE == "non-seq"
+    assert v1.ExpandLevel.FROM_NO_SEQUENCE == "non-seq"
+    assert v1.LayerType.FC_LAYER == "fc"
+
+    @v1.layer_support("drop_rate")
+    def my_layer(x):
+        return x
+
+    assert my_layer(5) == 5
+
+
+def test_spp_non_divisible_input():
+    rng = np.random.RandomState(10)
+    img = v1.data_layer(name="im5", size=2 * 5 * 5, height=5, width=5)
+    iv = rng.rand(2 * 5 * 5).astype(np.float32)
+    got = _run(v1.spp_layer(input=img, pyramid_height=2), {"im5": iv})
+    assert got.ravel().shape == (2 * 5,)
+    np.testing.assert_allclose(got.ravel()[0],
+                               iv.reshape(2, 25)[0].max(), rtol=1e-6)
+
+
+def test_kmax_seq_score_ignores_padding():
+    x = v1.data_layer(name="kp",
+                      type=paddle.data_type.dense_vector_sequence(1))
+    layer = v1.kmax_seq_score_layer(input=x, beam_size=1)
+    topo = paddle.topology.Topology([layer])
+    p = paddle.parameters.create(layer)
+    # batch of 2 ragged sequences: len 2 (all negative) and len 4 — the
+    # len-2 row's padded zeros must NOT outrank its real scores
+    seqs = [
+        (np.array([[-5.0], [-1.0]], np.float32),),
+        (np.array([[0.1], [0.9], [0.3], [0.2]], np.float32),),
+    ]
+    got = np.asarray(paddle.infer(output_layer=layer, parameters=p,
+                                  input=seqs))
+    assert got.ravel()[0] == 1     # argmax of [-5, -1] within length 2
+    assert got.ravel()[1] == 1     # argmax of the len-4 row
+
+
+def test_recurrent_linear_activation_is_identity():
+    x = v1.data_layer(name="rl",
+                      type=paddle.data_type.dense_vector_sequence(4))
+    out = v1.recurrent_layer(input=x, bias_attr=False,
+                             act=paddle.activation.Linear())
+    big = 10.0 * np.ones((2, 4), np.float32)
+    got = _run(out, {"rl": big})
+    # tanh would cap |h| at 1; identity lets x_t pass through
+    assert np.abs(got).max() > 1.5
+
+
+def test_detection_pipeline_builds_and_runs():
+    rng = np.random.RandomState(11)
+    feat = v1.data_layer(name="df", size=3 * 2 * 2, height=2, width=2)
+    img = v1.data_layer(name="di", size=3 * 8 * 8, height=8, width=8)
+    pb = v1.priorbox_layer(input=feat, image=img,
+                           aspect_ratio=[2.0], variance=[0.1] * 4,
+                           min_size=[4.0], max_size=[6.0])
+    n_priors_per_cell = 4        # 1 min + 1 max + 2 aspect flips
+    n_priors = 2 * 2 * n_priors_per_cell
+    loc = v1.data_layer(name="dl", size=n_priors * 4)
+    conf = v1.data_layer(name="dc", size=n_priors * 2)
+    det = v1.detection_output_layer(
+        input_loc=loc, input_conf=conf, priorbox=pb, num_classes=2,
+        confidence_threshold=0.0)
+    vals = {"df": rng.rand(3 * 2 * 2).astype(np.float32),
+            "di": rng.rand(3 * 8 * 8).astype(np.float32),
+            "dl": 0.1 * rng.randn(n_priors * 4).astype(np.float32),
+            "dc": rng.randn(n_priors * 2).astype(np.float32)}
+    got = _run(det, vals)
+    # [N, 6] detections: label, score in (0,1] once (no double softmax
+    # squashing everything toward 0.5), xmin/ymin/xmax/ymax
+    assert got.shape[-1] == 6
+    scores = got[..., 1].ravel()
+    assert np.all((scores > 0) & (scores <= 1.0))

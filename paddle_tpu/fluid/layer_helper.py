@@ -103,9 +103,17 @@ class LayerHelper:
             attr.name = unique_name.generate(".".join([self.name, "w" if
                                                        not is_bias else "b"]))
         startup_block = self.startup_program.global_block()
-        startup_p = startup_block.create_parameter(
-            shape=shape, dtype=dtype, **attr._to_kwargs())
-        attr.initializer(startup_p, startup_block)
+        if attr.name in startup_block.vars:
+            # shared parameter (same explicit name created again, e.g. an
+            # unrolled decode loop re-building its step): one startup
+            # init, one runtime array — return the existing main var
+            existing = self.main_program.global_block().vars.get(attr.name)
+            if existing is not None:
+                return existing
+        else:
+            startup_p = startup_block.create_parameter(
+                shape=shape, dtype=dtype, **attr._to_kwargs())
+            attr.initializer(startup_p, startup_block)
         main_p = self.main_program.global_block().create_parameter(
             shape=shape, dtype=dtype, **attr._to_kwargs())
         return main_p

@@ -14,7 +14,7 @@ from ..initializer import Constant, NormalInitializer
 from .. import core
 
 __all__ = [
-    "add_position_encoding", "similarity_focus", "hash", "stanh", "image_resize_short", "lod_reset", "logical_and", "logical_or", "logical_xor", "lstm_unit",
+    "add_position_encoding", "beam_slot_mask", "similarity_focus", "hash", "stanh", "image_resize_short", "lod_reset", "logical_and", "logical_or", "logical_xor", "lstm_unit",
     "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose", "pool2d",
     "batch_norm", "layer_norm", "group_norm", "dropout", "softmax",
     "cross_entropy", "softmax_with_cross_entropy",
@@ -1330,6 +1330,23 @@ def fsp_matrix(x, y):
     helper.append_op(type="fsp", inputs={"X": x, "Y": y},
                      outputs={"Out": out})
     return out
+
+
+def beam_slot_mask(context, beam_size):
+    """[B*W, 1] additive mask deactivating the W-1 duplicate start beams
+    per source at the first expansion: 0 for each source's beam slot 0,
+    -1e9 for the rest. Rows are grouped per source (row % W = slot) —
+    the dense analogue of the reference's single initial LoD beam."""
+    from .tensor import fill_constant_batch_size_like
+    from .ops import floor
+    W = beam_size
+    ones = fill_constant_batch_size_like(
+        input=context, shape=[-1, 1], value=1.0, dtype="float32")
+    ramp = cumsum(ones, axis=0, exclusive=True)   # 0,1,2,...
+    slot = elementwise_sub(
+        ramp, scale(floor(scale(ramp, scale=1.0 / W)), scale=float(W)))
+    # slot==0 -> 0, else -1e9 (slots are non-negative integers)
+    return scale(elementwise_min(slot, ones), scale=-1e9)
 
 
 def gather_tree(ids, parents):

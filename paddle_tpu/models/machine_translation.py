@@ -256,18 +256,7 @@ def seq_to_seq_net(embedding_dim, encoder_size, decoder_size,
 
 
 def _beam_slot_mask(context, W):
-    """[B*W, 1] additive mask: 0 for each source's beam slot 0, -1e9 for
-    the duplicate slots. Rows are grouped per source (row % W = slot)."""
-    ones = fluid.layers.fill_constant_batch_size_like(
-        input=context, shape=[-1, 1], value=1.0, dtype="float32")
-    ramp = fluid.layers.cumsum(ones, axis=0, exclusive=True)   # 0,1,2,...
-    slot = fluid.layers.elementwise_sub(
-        ramp, fluid.layers.scale(
-            fluid.layers.floor(fluid.layers.scale(ramp, scale=1.0 / W)),
-            scale=float(W)))
-    # slot==0 -> 0, else -1e9 (slots are non-negative integers)
-    return fluid.layers.scale(fluid.layers.elementwise_min(slot, ones),
-                              scale=-1e9)
+    return fluid.layers.beam_slot_mask(context, W)
 
 
 def get_model(batch_size=16, embedding_dim=512, encoder_size=512,

@@ -45,7 +45,7 @@ def data_layer(name, size=None, depth=None, height=None, width=None,
     from ..v2 import data_type as _dt
     tp = type if type is not None else _dt.dense_vector(size)
     return _v2.data(name=name, type=tp, height=height, width=width,
-                    layer_attr=layer_attr)
+                    depth=depth, layer_attr=layer_attr)
 fc_layer = _v2.fc
 embedding_layer = _v2.embedding
 img_conv_layer = _v2.img_conv
@@ -227,4 +227,21 @@ __all__ += [
     "gru_step_layer", "gru_step_naive_layer", "get_output_layer",
     "hsigmoid", "AggregateLevel", "ExpandLevel", "LayerType",
     "layer_support",
+]
+
+# generation machinery + 3D tail (completes the reference v1 __all__)
+BaseGeneratedInput = _v2.BaseGeneratedInput
+GeneratedInput = _v2.GeneratedInput
+SubsequenceInput = _v2.SubsequenceInput
+BeamInput = _v2.BeamInput
+beam_search = _v2.beam_search
+cross_entropy_over_beam = _v2.cross_entropy_over_beam
+img_conv3d_layer = _v2.img_conv3d
+img_pool3d_layer = _v2.img_pool3d
+sub_nested_seq_layer = _v2.sub_nested_seq
+
+__all__ += [
+    "BaseGeneratedInput", "GeneratedInput", "SubsequenceInput",
+    "BeamInput", "beam_search", "cross_entropy_over_beam",
+    "img_conv3d_layer", "img_pool3d_layer", "sub_nested_seq_layer",
 ]

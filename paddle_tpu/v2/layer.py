@@ -49,6 +49,10 @@ __all__ = [
     "detection_output", "roi_pool", "huber_classification_cost",
     "cross_entropy_with_selfnorm", "lambda_cost", "recurrent",
     "lstm_step", "gru_step", "gru_step_naive", "get_output",
+    # generation machinery + 3D tail
+    "BaseGeneratedInput", "GeneratedInput", "SubsequenceInput",
+    "BeamInput", "beam_search", "cross_entropy_over_beam",
+    "img_conv3d", "img_pool3d", "sub_nested_seq",
 ]
 
 _name_to_layer = {}
@@ -87,8 +91,10 @@ def _seq_dim(tp):
     return tp.seq_type != _dt.SequenceType.NO_SEQUENCE
 
 
-def data(name, type, height=None, width=None, layer_attr=None):
-    """v2 data layer (reference v2/layer.py:87 __data_layer__)."""
+def data(name, type, height=None, width=None, depth=None,
+         layer_attr=None):
+    """v2 data layer (reference v2/layer.py:87 __data_layer__); `depth`
+    gives the NCDHW volume shape for the 3D conv/pool tail."""
     tp = type
 
     def build():
@@ -97,8 +103,10 @@ def data(name, type, height=None, width=None, layer_attr=None):
                           lod_level=1 if _seq_dim(tp) else 0)
         shape = [tp.dim]
         if height and width:
-            ch = tp.dim // (height * width)
-            shape = [ch, height, width]
+            vol = (depth or 1) * height * width
+            ch = tp.dim // vol
+            shape = [ch, depth, height, width] if depth \
+                else [ch, height, width]
         return F.data(name=name, shape=shape, dtype="float32",
                       lod_level=1 if _seq_dim(tp) else 0)
 
@@ -1879,6 +1887,8 @@ def gru_step(input, output_mem, size=None, act=None, gate_act=None,
             bias_attr=lower_param_attr(bias_attr),
             activation=_resolve(act, "tanh"),
             gate_activation=_resolve(gate_act, "sigmoid"))
+        if out.shape is None:
+            out.shape = tuple(mv.shape)
         return out
 
     return _remember(Layer(name=name, parents=[input, output_mem],
@@ -1905,3 +1915,311 @@ def get_output(input, arg_name, name=None, layer_attr=None):
     return _remember(Layer(name=name, parents=[src], build_fn=build,
                            build_with_ctx=True, layer_type="get_output",
                            layer_attr=layer_attr))
+
+
+# ---------------------------------------------------------------------------
+# v1 generation machinery: GeneratedInput + beam_search (reference
+# trainer_config_helpers/layers.py:4282-4600), cross_entropy_over_beam,
+# and the 3D conv/pool tail
+# ---------------------------------------------------------------------------
+
+class BaseGeneratedInput(object):
+    """reference layers.py:4282."""
+
+    def __init__(self):
+        self.bos_id = None
+        self.eos_id = None
+
+
+class GeneratedInput(BaseGeneratedInput):
+    """The generated-word slot of a beam_search step: each timestep feeds
+    the embedding (shared table `embedding_name`) of the previously
+    selected word (reference layers.py:4294)."""
+
+    def __init__(self, size, embedding_name, embedding_size):
+        super(GeneratedInput, self).__init__()
+        self.size = size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+class SubsequenceInput(object):
+    """Nested-sequence input to recurrent_group (reference
+    layers.py:4257). The padded-dense LoD runtime carries single-level
+    lengths only, so nested iteration is not lowered."""
+
+    def __init__(self, input):
+        raise NotImplementedError(
+            "SubsequenceInput: nested-sequence recurrent_group is not "
+            "supported by the single-level padded-dense LoD encoding — "
+            "flatten the nesting or iterate the outer level in Python")
+
+
+def _var_layer(var, name=None):
+    """Wrap an already-built fluid var as a v2 Layer node (for handing
+    per-timestep vars to user step functions)."""
+    return Layer(name=name, parents=[], build_fn=lambda: var,
+                 layer_type="var")
+
+
+def _beam_expand(var, beam_size):
+    """[B, ...] -> [B*W, ...] with each row repeated W times (rows
+    grouped per source, row i -> rows i*W .. i*W+W-1); handles any rank
+    (a [B, T, D] attention-encoder sequence expands per row too)."""
+    rest = [int(d) for d in var.shape[1:]]
+    x = F.unsqueeze(var, axes=[1])                     # [B, 1, ...]
+    x = F.expand(x, expand_times=[1, beam_size] + [1] * len(rest))
+    out = F.reshape(x, shape=[-1] + rest)
+    out.shape = tuple([-1] + rest)
+    return out
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
+                name=None, num_results_per_sample=None):
+    """v1 sequence generation (reference layers.py:4485): drive `step`
+    (a v1 layer function using memory() for decoder state) with the
+    embedding of the previously generated word, expanding a dense
+    beam_size-wide frontier for max_length unrolled steps, then
+    backtrack with beam_search_decode. Each timestep rebuilds the step
+    DAG under a fixed-prefix name guard so parameters are shared across
+    timesteps (the v1 recurrent machinery's weight sharing); memories
+    are gathered by beam parent pointers between steps.
+
+    Returns the generated id sequences; get_output(layer, 'scores')
+    reads the per-hypothesis log-probabilities."""
+    from ..fluid import unique_name as fluid_unique_name
+
+    if num_results_per_sample is not None and \
+            int(num_results_per_sample) != int(beam_size):
+        raise NotImplementedError(
+            "num_results_per_sample=%r: the decode emits all beam_size "
+            "hypotheses per source, ranked best-first — slice the first "
+            "k sequences of each source's group from the LoD result "
+            "(per-source truncation inside the graph needs LoD-aware "
+            "sub-sequence selection)" % (num_results_per_sample,))
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    gen = [i for i in inputs if isinstance(i, BaseGeneratedInput)]
+    if len(gen) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput")
+    gen = gen[0]
+    statics = [i for i in inputs if not isinstance(i, BaseGeneratedInput)]
+    static_layers = [s.input if isinstance(s, StaticInput) else s
+                     for s in statics]
+    W = int(beam_size)
+
+    out = Layer(name=name, parents=list(static_layers), build_fn=None,
+                build_with_ctx=True, layer_type="beam_search")
+
+    def build(ctx, *static_vars):
+        beam_statics = [_beam_expand(v, W) for v in static_vars]
+        anchor = beam_statics[0] if beam_statics else None
+        if anchor is None:
+            raise ValueError(
+                "beam_search needs at least one static input to size "
+                "the batch (the encoder context)")
+        pre_ids = F.fill_constant_batch_size_like(
+            anchor, shape=[-1, 1], dtype="int64", value=bos_id)
+        pre_scores = F.fill_constant_batch_size_like(
+            anchor, shape=[-1, 1], dtype="float32", value=0.0)
+
+        mem_vals = {}            # link_name -> current beam-rows var
+        step_ids, step_scores, step_parents = [], [], []
+        for t in range(max_length):
+            word_emb = F.embedding(
+                pre_ids, size=[gen.size, gen.embedding_size],
+                param_attr=_fluid_param_attr(gen.embedding_name))
+            word_emb = F.reshape(word_emb,
+                                 shape=[-1, gen.embedding_size])
+            word_emb.shape = (-1, gen.embedding_size)
+            with fluid_unique_name.guard("@beamgen@"):
+                step_ctx = dict(ctx)
+                # bind step args in the declared input order: the
+                # GeneratedInput slot gets this step's word embedding
+                # (v1 substitutes it in place, layers.py:4570)
+                args = []
+                static_it = iter(beam_statics)
+                for i in inputs:
+                    if isinstance(i, BaseGeneratedInput):
+                        args.append(_var_layer(word_emb))
+                    else:
+                        args.append(_var_layer(next(static_it)))
+                out_layer = step(*args)
+                if isinstance(out_layer, (list, tuple)):
+                    out_layer = out_layer[0]
+                # collect the step DAG; seed memory markers with current
+                # values (zeros at t=0 unless boot_layer, beam-expanded)
+                all_nodes = {}
+
+                def _collect(node):
+                    if id(node) in all_nodes:
+                        return
+                    all_nodes[id(node)] = node
+                    for p in node.parents():
+                        _collect(p)
+
+                _collect(out_layer)
+                mems = [n for n in all_nodes.values()
+                        if isinstance(n, _Memory)]
+                for node in mems:
+                    if node.link_name not in mem_vals:
+                        if node.boot_layer is not None:
+                            boot = node.boot_layer.build(step_ctx)
+                            # a boot derived from the step's own args
+                            # (the _var_layer wrappers) is already
+                            # beam-row-aligned; only outer layers need
+                            # the per-source -> per-beam expansion
+                            boot_nodes = {}
+
+                            def _bc(n):
+                                if id(n) in boot_nodes:
+                                    return
+                                boot_nodes[id(n)] = n
+                                for p in n.parents():
+                                    _bc(p)
+
+                            _bc(node.boot_layer)
+                            from_args = any(
+                                n.layer_type == "var"
+                                for n in boot_nodes.values())
+                            mem_vals[node.link_name] = boot if from_args \
+                                else _beam_expand(boot, W)
+                        else:
+                            mem_vals[node.link_name] = \
+                                F.fill_constant_batch_size_like(
+                                    anchor, shape=[-1, node.size],
+                                    dtype="float32", value=0.0)
+                    step_ctx[id(node)] = mem_vals[node.link_name]
+                probs_var = out_layer.build(step_ctx)
+                # the new memory values are the step layers named by the
+                # memory links
+                for m in mems:
+                    link = next((n for n in all_nodes.values()
+                                 if n.name == m.link_name and
+                                 not isinstance(n, _Memory)), None)
+                    if link is not None and id(link) in step_ctx:
+                        mem_vals[m.link_name] = step_ctx[id(link)]
+
+            log_probs = F.log(probs_var)
+            accu = F.elementwise_add(log_probs, pre_scores, axis=0)
+            if t == 0:
+                accu = F.elementwise_add(
+                    accu, F.beam_slot_mask(anchor, W), axis=0)
+            sel_ids, sel_scores, parent_idx = F.beam_search(
+                pre_ids, pre_scores, None, accu, beam_size=W,
+                end_id=eos_id, return_parent_idx=True)
+            step_ids.append(sel_ids)
+            step_scores.append(sel_scores)
+            step_parents.append(parent_idx)
+            pre_ids, pre_scores = sel_ids, sel_scores
+            for k in list(mem_vals):
+                shape = mem_vals[k].shape
+                mem_vals[k] = F.gather(mem_vals[k], parent_idx)
+                if mem_vals[k].shape is None:
+                    mem_vals[k].shape = shape
+
+        ids_arr = F.stack([F.reshape(i, shape=[-1]) for i in step_ids],
+                          axis=0)
+        scores_arr = F.stack([F.reshape(s, shape=[-1])
+                              for s in step_scores], axis=0)
+        parents_arr = F.stack(step_parents, axis=0)
+        sent_ids, sent_scores = F.beam_search_decode(
+            ids_arr, scores_arr, beam_size=W, end_id=eos_id,
+            parent_idx=parents_arr)
+        ctx[(id(out), "scores")] = sent_scores
+        return sent_ids
+
+    out.__build_fn__ = build
+    return _remember(out)
+
+
+def _fluid_param_attr(name):
+    from ..fluid.param_attr import ParamAttr as FluidParamAttr
+    return FluidParamAttr(name=name)
+
+
+class BeamInput(object):
+    """One beam for cross_entropy_over_beam: candidate scores [B, C],
+    selected candidate ids [B, C], gold id [B, 1] (reference
+    layers.py:6441)."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+def cross_entropy_over_beam(input, name=None):
+    """Beam-aware CE (reference layers.py:6478 / CrossEntropyOverBeam):
+    for each beam, -log P(gold | candidates) under a softmax over the
+    candidate scores; a gold that fell off the beam contributes the
+    floor probability (-log eps) rather than an error."""
+    beams = input if isinstance(input, (list, tuple)) else [input]
+    parents = []
+    for b in beams:
+        parents += [b.candidate_scores, b.selected_candidates, b.gold]
+
+    def build(*vs):
+        total = None
+        for i in range(0, len(vs), 3):
+            scores, cand, gold = vs[i], vs[i + 1], vs[i + 2]
+            p = F.softmax(scores)
+            hit = F.cast(F.equal(F.cast(cand, "int64"),
+                                 F.cast(gold, "int64")), "float32")
+            p_gold = F.reduce_sum(F.elementwise_mul(p, hit), dim=-1,
+                                  keep_dim=True)
+            loss = F.scale(F.log(F.scale(p_gold, bias=1e-10)),
+                           scale=-1.0)
+            total = loss if total is None else \
+                F.elementwise_add(total, loss)
+        return F.mean(total)
+
+    return _remember(Layer(name=name, parents=parents, build_fn=build,
+                           layer_type="cost"))
+
+
+def img_conv3d(input, filter_size, num_filters, num_channels=None,
+               stride=1, padding=0, act=None, groups=1, dilation=1,
+               param_attr=None, bias_attr=None, name=None,
+               layer_attr=None, trans=False):
+    """Img3DConvLayer -> fluid conv3d (NCDHW)."""
+    def build(pv):
+        out = F.conv3d(pv, num_filters=num_filters,
+                       filter_size=filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       param_attr=lower_param_attr(param_attr),
+                       bias_attr=lower_param_attr(bias_attr)
+                       if bias_attr is not None else None)
+        return _apply_act(out, act)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="conv3d",
+                           layer_attr=layer_attr))
+
+
+def img_pool3d(input, pool_size, num_channels=None, pool_type=None,
+               stride=1, padding=0, name=None, ceil_mode=True,
+               layer_attr=None):
+    """Img3DPoolLayer -> fluid pool3d (NCDHW)."""
+    ptype = pool_type or _pooling.Max()
+    if isinstance(ptype, type):
+        ptype = ptype()
+
+    def build(pv):
+        return F.pool3d(pv, pool_size=pool_size,
+                        pool_type=ptype.img_pool_type,
+                        pool_stride=stride, pool_padding=padding,
+                        ceil_mode=ceil_mode)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="pool3d",
+                           layer_attr=layer_attr))
+
+
+def sub_nested_seq(input, selected_indices, name=None):
+    """SubNestedSequenceLayer (reference sub_nested_seq_layer): nested
+    LoD is not carried by the single-level padded-dense encoding."""
+    raise NotImplementedError(
+        "sub_nested_seq_layer needs nested (2-level) LoD, which the "
+        "padded-dense runtime does not carry — restructure with the "
+        "outer level iterated in Python, or use seq_slice on the "
+        "flattened sequence")

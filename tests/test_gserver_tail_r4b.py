@@ -249,3 +249,107 @@ def test_detection_pipeline_builds_and_runs():
     assert got.shape[-1] == 6
     scores = got[..., 1].ravel()
     assert np.all((scores > 0) & (scores <= 1.0))
+
+
+def test_conv3d_pool3d_shapes():
+    rng = np.random.RandomState(12)
+    # NCDHW volume [2, 4, 4, 4] fed flat through depth/height/width
+    vol = v1.data_layer(name="v3", size=2 * 4 * 4 * 4,
+                        depth=4, height=4, width=4)
+    conv = v1.img_conv3d_layer(input=vol, filter_size=3, num_filters=3,
+                               padding=1, bias_attr=False)
+    pool = v1.img_pool3d_layer(input=conv, pool_size=2, stride=2)
+    iv = rng.rand(2 * 4 * 4 * 4).astype(np.float32)
+    got = _run(pool, {"v3": iv})
+    assert got.ravel().shape == (3 * 2 * 2 * 2,)
+    assert np.all(np.isfinite(got))
+
+
+def test_beam_search_generates_ranked_hypotheses():
+    """v1 beam_search drives a user step (memory + gru_step + softmax)
+    over an unrolled beam frontier and emits ranked hypotheses."""
+    vocab, emb, hid, W, maxlen = 10, 6, 8, 3, 4
+
+    enc = v1.data_layer(name="enc_ctx", size=hid)
+
+    def step(word_emb, enc_ctx):
+        mem = v1.memory(name="dec_state", size=hid, boot_layer=enc_ctx)
+        gates = v1.mixed_layer(
+            size=hid * 3,
+            input=[v1.full_matrix_projection(input=word_emb),
+                   v1.full_matrix_projection(input=enc_ctx)],
+            bias_attr=False)
+        nxt = v1.gru_step_layer(input=gates, output_mem=mem,
+                                name="dec_state")
+        probs = v1.fc_layer(input=nxt, size=vocab,
+                            act=paddle.activation.Softmax())
+        return probs
+
+    gen = v1.beam_search(
+        step=step,
+        input=[v1.GeneratedInput(size=vocab, embedding_name="gen_emb",
+                                 embedding_size=emb),
+               v1.StaticInput(input=enc)],
+        bos_id=0, eos_id=1, beam_size=W, max_length=maxlen)
+
+    rng = np.random.RandomState(13)
+    p = paddle.parameters.create(gen)
+    got = paddle.infer(output_layer=gen, parameters=p,
+                       input=[(rng.randn(hid).astype(np.float32),),
+                              (rng.randn(hid).astype(np.float32),)])
+    ids = np.asarray(got).ravel()
+    # 2 sources x W beams, each hypothesis 1..maxlen tokens of the vocab
+    assert ids.size >= 2 * W and np.all((ids >= 0) & (ids < vocab))
+
+
+def test_cross_entropy_over_beam_prefers_gold_on_beam():
+    scores = v1.data_layer(name="cs", size=4)
+    cand = v1.data_layer(name="cc", size=4)
+    gold = v1.data_layer(name="cg", size=1)
+    cost = v1.cross_entropy_over_beam(
+        input=[v1.BeamInput(candidate_scores=scores,
+                            selected_candidates=cand, gold=gold)])
+    cand_v = np.array([3, 7, 5, 2], np.float32)
+    on = _run(cost, {"cs": np.array([4.0, 1.0, 1.0, 1.0], np.float32),
+                     "cc": cand_v, "cg": np.array([3.0], np.float32)})
+    off = _run(cost, {"cs": np.array([4.0, 1.0, 1.0, 1.0], np.float32),
+                      "cc": cand_v, "cg": np.array([9.0], np.float32)})
+    # gold=3 is candidate 0 (high score) -> small loss; gold=9 fell off
+    # the beam -> floor-probability loss
+    assert float(on) < 1.0 < float(off)
+
+
+def test_beam_search_binds_generated_input_in_place():
+    """GeneratedInput after StaticInput binds the word embedding to the
+    SECOND step argument (v1 substitutes it positionally)."""
+    vocab, emb, hid, W = 8, 4, 4, 2
+    enc = v1.data_layer(name="enc2", size=hid)
+
+    def step(enc_ctx, word_emb):
+        # enc_ctx must be the encoder context (hid), word_emb the
+        # embedding (emb) — a swap would flip these widths
+        mem = v1.memory(name="st2", size=hid)
+        gates = v1.mixed_layer(
+            size=hid * 3,
+            input=[v1.full_matrix_projection(input=word_emb),
+                   v1.full_matrix_projection(input=enc_ctx)],
+            bias_attr=False)
+        nxt = v1.gru_step_layer(input=gates, output_mem=mem, name="st2")
+        return v1.fc_layer(input=nxt, size=vocab,
+                           act=paddle.activation.Softmax())
+
+    gen = v1.beam_search(
+        step=step,
+        input=[v1.StaticInput(input=enc),
+               v1.GeneratedInput(size=vocab, embedding_name="e2",
+                                 embedding_size=emb)],
+        bos_id=0, eos_id=1, beam_size=W, max_length=3)
+    rng = np.random.RandomState(14)
+    p = paddle.parameters.create(gen)
+    # the trg embedding table must exist with the declared shape — a
+    # swapped binding would build it against the encoder width
+    assert tuple(p.get_shape("e2")) == (vocab, emb)
+    got = paddle.infer(output_layer=gen, parameters=p,
+                       input=[(rng.randn(hid).astype(np.float32),)])
+    ids = np.asarray(got).ravel()
+    assert ids.size >= W and np.all((ids >= 0) & (ids < vocab))

@@ -224,7 +224,7 @@ class Executor:
             v = gb._find_var_recursive(name)
             from .lod import LoDTensor, pad_lod_feed
             if isinstance(value, LoDTensor) and value.lod():
-                padded, lengths = pad_lod_feed(value)
+                padded, lengths, seg = pad_lod_feed(value)
                 if v is not None and v.dtype is not None:
                     want = core.convert_dtype_to_np(v.dtype)
                     if padded.dtype != want and not (
@@ -233,6 +233,9 @@ class Executor:
                 feeds[name] = jnp.asarray(padded)
                 feeds[name + functionalizer.LOD_LEN_SUFFIX] = \
                     jnp.asarray(lengths)
+                if seg is not None:
+                    feeds[name + functionalizer.LOD_SEG_SUFFIX] = \
+                        jnp.asarray(seg)
                 continue
             if isinstance(value, jax.Array):
                 # already on device (PyReader double-buffer path) — do NOT
@@ -259,7 +262,9 @@ class Executor:
         # env only when the value is actually ragged; None otherwise)
         lod_fetch = tuple(n + functionalizer.LOD_LEN_SUFFIX
                           for n in fetch_names)
-        fetch_ext = fetch_names + lod_fetch
+        seg_fetch = tuple(n + functionalizer.LOD_SEG_SUFFIX
+                          for n in fetch_names)
+        fetch_ext = fetch_names + lod_fetch + seg_fetch
 
         # output state covers ALL persistables (startup programs create
         # params that are not yet in the scope); input state is whatever
@@ -323,15 +328,26 @@ class Executor:
         for n, val in new_state.items():
             scope.set(n, val)
 
-        lens_by_name = dict(zip(lod_fetch, fetches[len(fetch_names):]))
+        n_names = len(fetch_names)
+        lens_by_name = dict(zip(lod_fetch,
+                                fetches[n_names:n_names + len(lod_fetch)]))
+        segs_by_name = dict(zip(seg_fetch,
+                                fetches[n_names + len(lod_fetch):]))
         out = []
         for i, n in enumerate(fetch_names):
             val = fetches[i]
             lens = lens_by_name.get(n + functionalizer.LOD_LEN_SUFFIX)
             if lens is not None and val is not None:
                 from .lod import unpad_to_lod_tensor
-                out.append(unpad_to_lod_tensor(np.asarray(val),
-                                               np.asarray(lens)))
+                t = unpad_to_lod_tensor(np.asarray(val), np.asarray(lens))
+                seg = segs_by_name.get(n + functionalizer.LOD_SEG_SUFFIX)
+                if seg is not None:
+                    # nested: prepend the outer level — the companion IS
+                    # the per-group inner-sequence counts
+                    outer = [int(c) for c in np.asarray(seg)]
+                    t.set_recursive_sequence_lengths(
+                        [outer] + t.recursive_sequence_lengths())
+                out.append(t)
             elif return_numpy:
                 out.append(np.asarray(val))
             else:

@@ -30,6 +30,13 @@ _SKIP_OPS = frozenset(["feed", "fetch"])
 # encoding rationale — reference lod_tensor.h:58).
 LOD_LEN_SUFFIX = "@LOD_LEN"
 
+# Second-level (nested LoD) companion: for a lod_level-2 var the env also
+# carries `<name>@LOD_SEG` — int32 [B_outer] COUNT of inner sequences in
+# each outer group (counts, not ids: trailing empty groups survive).
+# Inner-level ops ignore it; outer-level ops (sub_nested_seq, nested
+# kmax) consume it.
+LOD_SEG_SUFFIX = "@LOD_SEG"
+
 
 def _float0_zeros(primal_struct):
     import jax
@@ -68,18 +75,22 @@ def _gather_inputs(op, env):
         lens = [env.get(n + LOD_LEN_SUFFIX) if n else None for n in names]
         if any(l is not None for l in lens):
             vals[slot + LOD_LEN_SUFFIX] = lens
+        segs = [env.get(n + LOD_SEG_SUFFIX) if n else None for n in names]
+        if any(s is not None for s in segs):
+            vals[slot + LOD_SEG_SUFFIX] = segs
     return vals
 
 
 def _write_outputs(op, outs, env):
     norm = _normalize_outs(outs)
     for slot, produced in norm.items():
-        if slot.endswith(LOD_LEN_SUFFIX):
-            base = slot[:-len(LOD_LEN_SUFFIX)]
-            names = op.outputs.get(base, [])
+        suffix = next((s for s in (LOD_LEN_SUFFIX, LOD_SEG_SUFFIX)
+                       if slot.endswith(s)), None)
+        if suffix is not None:
+            names = op.outputs.get(slot[:-len(suffix)], [])
             for i, name in enumerate(names):
                 if name and i < len(produced) and produced[i] is not None:
-                    env[name + LOD_LEN_SUFFIX] = produced[i]
+                    env[name + suffix] = produced[i]
             continue
         names = op.outputs.get(slot, [])
         for i, name in enumerate(names):
@@ -116,11 +127,12 @@ def _propagate_lod(op, env):
     excluded (mirrors the reference's per-op ShareLoD decisions)."""
     if op.type in _LOD_DROP_OPS:
         return
-    src = None
+    src = seg = None
     for names in op.inputs.values():
         for n in names:
             if n and (n + LOD_LEN_SUFFIX) in env:
                 src = env[n + LOD_LEN_SUFFIX]
+                seg = env.get(n + LOD_SEG_SUFFIX)
                 break
         if src is not None:
             break
@@ -130,6 +142,8 @@ def _propagate_lod(op, env):
         for n in names:
             if n and (n + LOD_LEN_SUFFIX) not in env:
                 env[n + LOD_LEN_SUFFIX] = src
+                if seg is not None and (n + LOD_SEG_SUFFIX) not in env:
+                    env[n + LOD_SEG_SUFFIX] = seg
 
 
 # ops that mutate the interpreter env directly (control flow / arrays)
@@ -147,15 +161,18 @@ HOST_OPS = frozenset([
     "sparse_table_push", "go", "channel_create", "channel_send",
     "channel_recv", "channel_close", "generate_proposal_labels",
     "detection_map", "while_grad_dynamic",
+    # nested-LoD selection: data-dependent group structure (reference
+    # layers are CPU-only as well)
+    "sub_nested_seq",
 ])
 
 
 def is_host_op(op):
-    """A while op marked force_host interprets its body per iteration on
-    the host (the reference's nested-Executor WhileOp) — the executor
-    must treat it exactly like the named host ops."""
-    return op.type in HOST_OPS or \
-        (op.type == "while" and bool(op.attrs.get("force_host")))
+    """Ops marked force_host run eagerly on the host: a while so marked
+    interprets its body per iteration (the reference's nested-Executor
+    WhileOp), and layers set it on data-dependent nested-LoD ops (e.g.
+    kmax_seq_score over a lod_level-2 input)."""
+    return op.type in HOST_OPS or bool(op.attrs.get("force_host"))
 
 
 def contains_host_ops(program):
@@ -458,9 +475,9 @@ class SegmentedProgramRunner:
             for n in out_names:
                 if n in env:
                     out[n] = env[n]
-                ln = n + LOD_LEN_SUFFIX
-                if ln in env:
-                    out[ln] = env[ln]
+                for suf in (LOD_LEN_SUFFIX, LOD_SEG_SUFFIX):
+                    if (n + suf) in env:
+                        out[n + suf] = env[n + suf]
             return out
 
         fn = jax.jit(seg_fn)
@@ -486,9 +503,9 @@ class SegmentedProgramRunner:
                 for n in _op_tree_reads(op):
                     if n in env and _jit_safe(env[n]):
                         in_env[n] = env[n]
-                        ln = n + LOD_LEN_SUFFIX
-                        if ln in env:
-                            in_env[ln] = env[ln]
+                        for suf in (LOD_LEN_SUFFIX, LOD_SEG_SUFFIX):
+                            if (n + suf) in env:
+                                in_env[n + suf] = env[n + suf]
             extra = tuple(sorted((fetch_set & self._seg_all_outputs[idx])
                                  - self._seg_outputs[idx]))
             fn = self._get_segment_fn(idx, item, tuple(sorted(in_env)),

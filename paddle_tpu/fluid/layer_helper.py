@@ -34,7 +34,33 @@ class LayerHelper:
         return default_startup_program()
 
     def append_op(self, *args, **kwargs):
-        return self.main_program.current_block().append_op(*args, **kwargs)
+        op = self.main_program.current_block().append_op(*args, **kwargs)
+        self._propagate_build_lod_level(kwargs)
+        return op
+
+    @staticmethod
+    def _propagate_build_lod_level(kwargs):
+        """Build-time analogue of the runtime companion propagation: a
+        LoD-oblivious op's outputs inherit the max input lod_level, so
+        downstream layers can gate on nestedness (e.g. kmax_seq_score
+        force_host) without the var having been fed directly."""
+        from .framework import Variable
+        from .functionalizer import _LOD_DROP_OPS
+        if kwargs.get("type") in _LOD_DROP_OPS:
+            return
+        level = 0
+        for names in (kwargs.get("inputs") or {}).values():
+            vs = names if isinstance(names, (list, tuple)) else [names]
+            for v in vs:
+                if isinstance(v, Variable):
+                    level = max(level, getattr(v, "lod_level", 0) or 0)
+        if level:
+            for names in (kwargs.get("outputs") or {}).values():
+                vs = names if isinstance(names, (list, tuple)) else [names]
+                for v in vs:
+                    if isinstance(v, Variable) and \
+                            not getattr(v, "lod_level", 0):
+                        v.lod_level = level
 
     # ---- inputs ----
     def multiple_input(self, input_param_name="input"):

@@ -104,11 +104,15 @@ def _bucket_len(n, minimum=16):
 
 
 def pad_lod_feed(lod_tensor, bucket=True):
-    """packed LoDTensor -> (padded [B, T, ...], lengths int32 [B]).
+    """packed LoDTensor -> (padded [B, T, ...], lengths int32 [B], seg).
     T is bucketed to a power of two so changing batch raggedness reuses
-    compiled programs (SURVEY.md §7 'segment ids + maxlen bucketing')."""
+    compiled programs (SURVEY.md §7 'segment ids + maxlen bucketing').
+    For a 2-level (nested) LoD, B counts INNER sequences and `seg` is the
+    int32 [B] outer-group id of each (functionalizer.LOD_SEG_SUFFIX);
+    seg is None for single-level inputs."""
     data = np.asarray(lod_tensor)
-    offsets = lod_tensor.lod()[-1]
+    lod = lod_tensor.lod()
+    offsets = lod[-1]
     lens = np.array([offsets[i + 1] - offsets[i]
                      for i in range(len(offsets) - 1)], dtype=np.int32)
     B = len(lens)
@@ -118,7 +122,15 @@ def pad_lod_feed(lod_tensor, bucket=True):
     padded = np.zeros((B, T) + data.shape[1:], dtype=data.dtype)
     for i in range(B):
         padded[i, :lens[i]] = data[offsets[i]:offsets[i + 1]]
-    return padded, lens
+    seg = None
+    if len(lod) >= 2:
+        # outer level groups inner sequences: carry the per-group inner
+        # COUNTS [B_outer] (not per-inner ids — counts preserve trailing
+        # empty groups); lod[-2] offsets index the inner-sequence axis
+        off = lod[-2]
+        seg = np.array([off[i + 1] - off[i]
+                        for i in range(len(off) - 1)], dtype=np.int32)
+    return padded, lens, seg
 
 
 def unpad_to_lod_tensor(padded, lens):

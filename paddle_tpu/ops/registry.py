@@ -94,6 +94,12 @@ class ExecContext:
         vs = self._inputs.get(slot + "@LOD_LEN")
         return vs if vs else [None] * len(self._inputs.get(slot, []))
 
+    def lod_seg(self, slot):
+        """Outer-group segment ids [N] for a NESTED (lod_level-2) input,
+        or None (functionalizer.LOD_SEG_SUFFIX)."""
+        vs = self._inputs.get(slot + "@LOD_SEG")
+        return vs[0] if vs else None
+
     def rng_key(self):
         """Deterministic per-op, per-step PRNG key. Reproduces the reference's
         per-op `seed` attr semantics (e.g. dropout_op) while staying functional:

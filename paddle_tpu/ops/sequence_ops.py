@@ -535,20 +535,85 @@ def _gru(ctx):
 def _kmax_seq_score(ctx):
     """Indices of the beam_size highest scores within each sequence's
     VALID prefix (reference legacy KmaxSeqScoreLayer) — padded positions
-    are masked out before the top-k."""
+    are masked out before the top-k. For a NESTED input (a score per
+    inner sequence), returns each outer group's top-k inner-sequence
+    indices, local to the group (feeds sub_nested_seq)."""
+    import jax
     jnp = _jnp()
     x = ctx.input("X")
     if x.ndim == 3:
         x = x[..., 0]
     lens = ctx.lod_len("X")
+    seg = ctx.lod_seg("X")
+    k = int(ctx.attr("beam_size", 1))
+    if seg is not None:
+        # score of inner sequence i = its first element; rank inner
+        # sequences within each outer group. Group count is
+        # data-dependent -> host/eager evaluation (the reference layer
+        # is CPU-only too).
+        if isinstance(x, jax.core.Tracer) or \
+                isinstance(seg, jax.core.Tracer):
+            raise NotImplementedError(
+                "nested kmax_seq_score has a data-dependent group count "
+                "— run the program eagerly (reference "
+                "KmaxSeqScoreLayer is host-side as well)")
+        scores = np.asarray(x)[:, 0]
+        counts = np.asarray(seg)          # [B_outer] inner-seq counts
+        n_groups = len(counts)
+        # unfilled slots pad with -1 (reference KmaxSeqScoreLayer);
+        # sub_nested_seq skips negatives
+        out = np.full((n_groups, k), -1, np.int64)
+        start = 0
+        for g in range(n_groups):
+            local = scores[start:start + int(counts[g])]
+            order = np.argsort(-local)[:k]
+            out[g, :len(order)] = order
+            start += int(counts[g])
+        return {"Out": out}
     B, T = x.shape
     if lens is None:
         lens = jnp.full((B,), T, jnp.int32)
-    k = int(ctx.attr("beam_size", 1))
     valid = jnp.arange(T)[None, :] < lens[:, None]
     masked = jnp.where(valid, x, -jnp.inf)
     idx = jnp.argsort(-masked, axis=1)[:, :k]
     return {"Out": idx.astype(jnp.int64)}
+
+
+@register_op("sub_nested_seq")
+def _sub_nested_seq(ctx):
+    """Select per-outer-group inner sequences of a nested LoD input by
+    LOCAL indices [B_outer, K] (reference SubNestedSequenceLayer paired
+    with kmax_seq_score). Output is a level-1 ragged var of B_outer*K
+    inner sequences. Group starts are data-dependent -> host/eager."""
+    import jax
+    jnp = _jnp()
+    x = ctx.input("X")              # [N, T, ...] padded inner seqs
+    idx = ctx.input("Indices")      # [B_outer, K] local indices
+    lens = ctx.lod_len("X")
+    seg = ctx.lod_seg("X")
+    if seg is None:
+        raise ValueError("sub_nested_seq needs a nested (lod_level-2) "
+                         "input — got a single-level sequence")
+    if isinstance(x, jax.core.Tracer) or isinstance(seg, jax.core.Tracer):
+        raise NotImplementedError(
+            "sub_nested_seq selects data-dependent rows — run the "
+            "program eagerly (the reference layer is host-side too)")
+    x = np.asarray(x)
+    idx = np.asarray(idx).astype(np.int64)
+    counts = np.asarray(seg)              # [B_outer] inner-seq counts
+    lens = np.asarray(lens) if lens is not None else \
+        np.full((x.shape[0],), x.shape[1], np.int32)
+    starts = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    rows, out_counts = [], []
+    for g in range(min(len(idx), len(counts))):
+        picked = [int(i) for i in idx[g] if i >= 0]   # -1 = unfilled
+        rows += [starts[g] + i for i in picked]
+        out_counts.append(len(picked))
+    rows = np.asarray(rows, np.int64)
+    out = x[rows]
+    out_lens = lens[rows].astype(np.int32)
+    return {"Out": out, "Out@LOD_LEN": out_lens,
+            "Out@LOD_SEG": np.asarray(out_counts, np.int32)}
 
 
 @register_op("simple_rnn")

@@ -10,6 +10,7 @@ padded-dense form, SURVEY §5 long-context note).
 
 __all__ = [
     "InputType", "DataType", "SequenceType",
+    "dense_vector_sub_sequence", "integer_value_sub_sequence",
     "dense_vector", "dense_vector_sequence", "dense_array",
     "integer_value", "integer_value_sequence",
     "sparse_binary_vector", "sparse_binary_vector_sequence",
@@ -49,6 +50,10 @@ def dense_vector_sequence(dim):
     return dense_vector(dim, SequenceType.SEQUENCE)
 
 
+def dense_vector_sub_sequence(dim):
+    return dense_vector(dim, SequenceType.SUB_SEQUENCE)
+
+
 def dense_array(dim, seq_type=SequenceType.NO_SEQUENCE):
     return InputType(dim, seq_type, DataType.Dense)
 
@@ -59,6 +64,10 @@ def integer_value(value_range, seq_type=SequenceType.NO_SEQUENCE):
 
 def integer_value_sequence(value_range):
     return integer_value(value_range, SequenceType.SEQUENCE)
+
+
+def integer_value_sub_sequence(value_range):
+    return integer_value(value_range, SequenceType.SUB_SEQUENCE)
 
 
 def sparse_binary_vector(dim, seq_type=SequenceType.NO_SEQUENCE):

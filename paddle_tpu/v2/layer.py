@@ -97,10 +97,15 @@ def data(name, type, height=None, width=None, depth=None,
     gives the NCDHW volume shape for the 3D conv/pool tail."""
     tp = type
 
+    def _lod_level():
+        if tp.seq_type == _dt.SequenceType.SUB_SEQUENCE:
+            return 2
+        return 1 if _seq_dim(tp) else 0
+
     def build():
         if tp.type == _dt.DataType.Index:
             return F.data(name=name, shape=[1], dtype="int64",
-                          lod_level=1 if _seq_dim(tp) else 0)
+                          lod_level=_lod_level())
         shape = [tp.dim]
         if height and width:
             vol = (depth or 1) * height * width
@@ -108,7 +113,7 @@ def data(name, type, height=None, width=None, depth=None,
             shape = [ch, depth, height, width] if depth \
                 else [ch, height, width]
         return F.data(name=name, shape=shape, dtype="float32",
-                      lod_level=1 if _seq_dim(tp) else 0)
+                      lod_level=_lod_level())
 
     layer = Layer(name=name, parents=[], build_fn=build, layer_type="data")
     layer.data_type = tp
@@ -1551,8 +1556,12 @@ def kmax_seq_score(input, beam_size=1, name=None):
     each sequence's valid prefix (ops/sequence_ops.py kmax_seq_score —
     padded positions never outrank real ones)."""
     def build(pv):
-        out = _append_raw_op("kmax_seq_score", {"X": pv},
-                             {"beam_size": int(beam_size)},
+        attrs = {"beam_size": int(beam_size)}
+        if getattr(pv, "lod_level", 0) >= 2:
+            # nested ranking has a data-dependent group count — run on
+            # the host path (the reference layer is CPU-only too)
+            attrs["force_host"] = True
+        out = _append_raw_op("kmax_seq_score", {"X": pv}, attrs,
                              dtype="int64", infer_shape=False)
         out.shape = (-1, int(beam_size))
         return out
@@ -2216,10 +2225,19 @@ def img_pool3d(input, pool_size, num_channels=None, pool_type=None,
 
 
 def sub_nested_seq(input, selected_indices, name=None):
-    """SubNestedSequenceLayer (reference sub_nested_seq_layer): nested
-    LoD is not carried by the single-level padded-dense encoding."""
-    raise NotImplementedError(
-        "sub_nested_seq_layer needs nested (2-level) LoD, which the "
-        "padded-dense runtime does not carry — restructure with the "
-        "outer level iterated in Python, or use seq_slice on the "
-        "flattened sequence")
+    """SubNestedSequenceLayer (reference sub_nested_seq_layer): select
+    per-outer-group inner sequences of a nested (lod_level-2) input by
+    the LOCAL indices produced by kmax_seq_score
+    (ops/sequence_ops.py sub_nested_seq; host-path op, like the
+    reference's CPU-only layer)."""
+    def build(pv, iv):
+        out = _append_raw_op("sub_nested_seq",
+                             {"X": pv, "Indices": iv},
+                             dtype=pv.dtype, lod_out=True,
+                             infer_shape=False)
+        out.shape = tuple(pv.shape)
+        out.lod_level = 2
+        return out
+
+    return _remember(Layer(name=name, parents=[input, selected_indices],
+                           build_fn=build, layer_type="sub_nested_seq"))

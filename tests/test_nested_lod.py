@@ -1,0 +1,181 @@
+"""Nested (2-level) LoD: feeding, companion propagation, and the
+kmax_seq_score -> sub_nested_seq selection pipeline (reference
+lod_tensor.h multi-level LoD; legacy KmaxSeqScoreLayer /
+SubNestedSequenceLayer). Encoding: inner sequences ride the standard
+padded [N, T, ...] + @LOD_LEN, with @LOD_SEG carrying each inner
+sequence's outer-group id."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.lod import LoDTensor
+
+
+def _nested_feed():
+    """2 outer groups: group 0 has 2 inner seqs (lens 2, 1), group 1 has
+    3 inner seqs (lens 1, 3, 2). Feature dim 1, values encode identity:
+    value = 10*inner_index + position."""
+    lens = [2, 1, 1, 3, 2]
+    rows = []
+    for i, l in enumerate(lens):
+        for p in range(l):
+            rows.append([10.0 * i + p])
+    t = LoDTensor(np.asarray(rows, np.float32))
+    t.set_recursive_sequence_lengths([[2, 3], lens])
+    return t, lens
+
+
+def test_nested_feed_round_trip():
+    """A nested LoD feed passes through an elementwise op and fetches
+    back with BOTH levels intact."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], dtype="float32",
+                              lod_level=2)
+        y = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    t, lens = _nested_feed()
+    out, = exe.run(main, feed={"x": t}, fetch_list=[y])
+    assert isinstance(out, LoDTensor)
+    got_lens = out.recursive_sequence_lengths()
+    assert got_lens == [[2, 3], lens], got_lens
+    np.testing.assert_allclose(np.asarray(out), 2.0 * np.asarray(t))
+
+
+def test_kmax_then_sub_nested_seq_selects_top_subsequences():
+    """Rank inner sequences per outer group by their first score, select
+    the top-1 of each group (the reference kmax+sub_nested pipeline)."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], dtype="float32",
+                              lod_level=2)
+        scores = fluid.layers.data("s", shape=[1], dtype="float32",
+                                   lod_level=2)
+        from paddle_tpu.fluid.layer_helper import LayerHelper
+        helper = LayerHelper("kmax_seq_score")
+        idx = helper.create_variable_for_type_inference("int64")
+        helper.append_op(type="kmax_seq_score", inputs={"X": scores},
+                         outputs={"Out": idx},
+                         attrs={"beam_size": 1, "force_host": True},
+                         infer_shape=False)
+        sel = helper.create_variable_for_type_inference("float32")
+        sel.lod_level = 2
+        helper.append_op(type="sub_nested_seq",
+                         inputs={"X": x, "Indices": idx},
+                         outputs={"Out": sel}, infer_shape=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    t, lens = _nested_feed()
+    # per-inner-seq scores: group 0 -> [0.1, 0.9]; group 1 ->
+    # [0.5, 0.2, 0.8]: winners are inner seq 1 and inner seq 4
+    srows = []
+    for i, (l, s) in enumerate(zip(lens, [0.1, 0.9, 0.5, 0.2, 0.8])):
+        srows += [[s]] * l
+    st = LoDTensor(np.asarray(srows, np.float32))
+    st.set_recursive_sequence_lengths([[2, 3], lens])
+    out, = exe.run(main, feed={"x": t, "s": st}, fetch_list=[sel])
+    assert isinstance(out, LoDTensor)
+    got = out.recursive_sequence_lengths()
+    # 1 selected inner seq per group, lengths of inner seqs 1 and 4
+    assert got == [[1, 1], [lens[1], lens[4]]], got
+    vals = np.asarray(out).ravel()
+    # inner seq 1 = [10.0], inner seq 4 = [40.0, 41.0]
+    np.testing.assert_allclose(vals, [10.0, 40.0, 41.0])
+
+
+def test_v2_sub_nested_pipeline():
+    """The v1 spelling: data(sub_sequence) -> kmax_seq_score_layer ->
+    sub_nested_seq_layer through the v2 trainer machinery."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    x = v1.data_layer(
+        name="nx", type=paddle.data_type.dense_vector_sub_sequence(1))
+    sc = v1.data_layer(
+        name="ns", type=paddle.data_type.dense_vector_sub_sequence(1))
+    idx = v1.kmax_seq_score_layer(input=sc, beam_size=1)
+    sel = v1.sub_nested_seq_layer(input=x, selected_indices=idx)
+
+    topo = paddle.topology.Topology([sel])
+    p = paddle.parameters.create(sel)
+    sample_x = [[[0.0], [1.0]], [[5.0]]]          # 2 inner seqs
+    sample_s = [[[0.2], [0.2]], [[0.9]]]          # second wins
+    got = paddle.infer(output_layer=sel, parameters=p,
+                       input=[(sample_x, sample_s)])
+    np.testing.assert_allclose(np.asarray(got).ravel(), [5.0])
+
+
+def test_seg_companion_survives_compute_segment():
+    """A device op (scale) between the feed and the nested host ops: the
+    jitted compute segment must carry @LOD_SEG across its boundary."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], dtype="float32",
+                              lod_level=2)
+        scores = fluid.layers.data("s", shape=[1], dtype="float32",
+                                   lod_level=2)
+        xs = fluid.layers.scale(x, scale=1.0)        # device segment
+        ss = fluid.layers.scale(scores, scale=2.0)   # device segment
+        from paddle_tpu.fluid.layer_helper import LayerHelper
+        helper = LayerHelper("kmax_seq_score")
+        assert ss.lod_level == 2     # build-time propagation
+        idx = helper.create_variable_for_type_inference("int64")
+        helper.append_op(type="kmax_seq_score", inputs={"X": ss},
+                         outputs={"Out": idx},
+                         attrs={"beam_size": 1, "force_host": True},
+                         infer_shape=False)
+        sel = helper.create_variable_for_type_inference("float32")
+        sel.lod_level = 2
+        helper.append_op(type="sub_nested_seq",
+                         inputs={"X": xs, "Indices": idx},
+                         outputs={"Out": sel}, infer_shape=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    t, lens = _nested_feed()
+    srows = []
+    for l, s in zip(lens, [0.1, 0.9, 0.5, 0.2, 0.8]):
+        srows += [[s]] * l
+    st = LoDTensor(np.asarray(srows, np.float32))
+    st.set_recursive_sequence_lengths([[2, 3], lens])
+    out, = exe.run(main, feed={"x": t, "s": st}, fetch_list=[sel])
+    got = out.recursive_sequence_lengths()
+    assert got == [[1, 1], [lens[1], lens[4]]], got
+    np.testing.assert_allclose(np.asarray(out).ravel(),
+                               [10.0, 40.0, 41.0])
+
+
+def test_trailing_empty_outer_group_survives():
+    """Outer groups that contribute no inner sequences must round-trip
+    (counts encoding; an id encoding would drop them)."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], dtype="float32",
+                              lod_level=2)
+        y = fluid.layers.scale(x, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    t = LoDTensor(np.asarray([[1.0], [2.0]], np.float32))
+    t.set_recursive_sequence_lengths([[2, 0], [1, 1]])
+    out, = exe.run(main, feed={"x": t}, fetch_list=[y])
+    assert out.recursive_sequence_lengths() == [[2, 0], [1, 1]]
+
+
+def test_kmax_pads_unfilled_slots_with_minus_one():
+    """beam_size larger than a group's inner count: unfilled slots are
+    -1 (reference padding) and sub_nested_seq skips them."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    x = v1.data_layer(
+        name="px", type=paddle.data_type.dense_vector_sub_sequence(1))
+    sc = v1.data_layer(
+        name="ps", type=paddle.data_type.dense_vector_sub_sequence(1))
+    idx = v1.kmax_seq_score_layer(input=sc, beam_size=3)
+    sel = v1.sub_nested_seq_layer(input=x, selected_indices=idx)
+    p = paddle.parameters.create(sel)
+    # one outer group with only 2 inner sequences, beam 3
+    sample_x = [[[1.0]], [[2.0], [3.0]]]
+    sample_s = [[[0.1]], [[0.9], [0.9]]]
+    got = paddle.infer(output_layer=sel, parameters=p,
+                       input=[(sample_x, sample_s)])
+    vals = sorted(np.asarray(got).ravel().tolist())
+    # both real inner seqs selected exactly once, no duplicate of seq 0
+    assert vals == [1.0, 2.0, 3.0], vals

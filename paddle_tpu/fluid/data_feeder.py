@@ -53,14 +53,14 @@ class DataFeeder:
                         int(np.prod(static))):
                     arr = arr.reshape((-1,) + tuple(static))
                 ret[name] = arr
-            elif lod_level >= 2:
+            elif lod_level == 2:
                 # nested: each sample is a list of inner sequences
-                from .lod import create_lod_tensor
-                outer = [len(s) for s in col]
-                inners = [np.asarray(inner, dtype=dtype).reshape(
-                    len(inner), -1) for s in col for inner in s]
-                ret[name] = create_lod_tensor(
-                    inners, [outer, [len(i) for i in inners]])
+                from .lod import nested_samples_to_lod_tensor
+                ret[name] = nested_samples_to_lod_tensor(col, dtype)
+            elif lod_level > 2:
+                raise NotImplementedError(
+                    "lod_level %d feeds: the runtime carries two LoD "
+                    "levels (inner lengths + outer counts)" % lod_level)
             else:
                 seq_lens = [len(s) for s in col]
                 flat = np.concatenate(

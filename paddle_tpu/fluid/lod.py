@@ -107,9 +107,10 @@ def pad_lod_feed(lod_tensor, bucket=True):
     """packed LoDTensor -> (padded [B, T, ...], lengths int32 [B], seg).
     T is bucketed to a power of two so changing batch raggedness reuses
     compiled programs (SURVEY.md §7 'segment ids + maxlen bucketing').
-    For a 2-level (nested) LoD, B counts INNER sequences and `seg` is the
-    int32 [B] outer-group id of each (functionalizer.LOD_SEG_SUFFIX);
-    seg is None for single-level inputs."""
+    For a 2-level (nested) LoD, B counts INNER sequences and `seg` is
+    the int32 [B_outer] COUNT of inner sequences per outer group
+    (functionalizer.LOD_SEG_SUFFIX); seg is None for single-level
+    inputs."""
     data = np.asarray(lod_tensor)
     lod = lod_tensor.lod()
     offsets = lod[-1]
@@ -154,6 +155,15 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
     t.set_recursive_sequence_lengths(recursive_seq_lens)
     assert t.has_valid_recursive_sequence_lengths()
     return t
+
+
+def nested_samples_to_lod_tensor(col, dtype):
+    """Batch of nested samples (each a list of inner sequences) -> 2-level
+    LoDTensor. The single conversion both feeders share."""
+    outer = [len(s) for s in col]
+    inners = [np.asarray(inner, dtype=dtype).reshape(len(inner), -1)
+              for s in col for inner in s]
+    return create_lod_tensor(inners, [outer, [len(i) for i in inners]])
 
 
 def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,

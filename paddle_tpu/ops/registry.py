@@ -95,8 +95,8 @@ class ExecContext:
         return vs if vs else [None] * len(self._inputs.get(slot, []))
 
     def lod_seg(self, slot):
-        """Outer-group segment ids [N] for a NESTED (lod_level-2) input,
-        or None (functionalizer.LOD_SEG_SUFFIX)."""
+        """Per-outer-group inner-sequence COUNTS [B_outer] for a NESTED
+        (lod_level-2) input, or None (functionalizer.LOD_SEG_SUFFIX)."""
         vs = self._inputs.get(slot + "@LOD_SEG")
         return vs[0] if vs else None
 

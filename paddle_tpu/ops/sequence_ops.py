@@ -603,10 +603,20 @@ def _sub_nested_seq(ctx):
     counts = np.asarray(seg)              # [B_outer] inner-seq counts
     lens = np.asarray(lens) if lens is not None else \
         np.full((x.shape[0],), x.shape[1], np.int32)
+    if len(idx) != len(counts):
+        raise ValueError(
+            "sub_nested_seq: Indices rows (%d) != outer groups (%d)"
+            % (len(idx), len(counts)))
     starts = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
     rows, out_counts = [], []
-    for g in range(min(len(idx), len(counts))):
+    for g in range(len(idx)):
         picked = [int(i) for i in idx[g] if i >= 0]   # -1 = unfilled
+        bad = [i for i in picked if i >= int(counts[g])]
+        if bad:
+            raise ValueError(
+                "sub_nested_seq: index %d out of range for outer group "
+                "%d with %d inner sequences" % (bad[0], g,
+                                                int(counts[g])))
         rows += [starts[g] + i for i in picked]
         out_counts.append(len(picked))
     rows = np.asarray(rows, np.int64)

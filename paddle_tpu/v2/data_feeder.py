@@ -57,14 +57,10 @@ class DataFeeder(object):
         is_nested = tp.seq_type == _dt.SequenceType.SUB_SEQUENCE
         if is_nested:
             # sample = list of inner sequences -> 2-level LoD
-            from ..fluid.lod import create_lod_tensor
+            from ..fluid.lod import nested_samples_to_lod_tensor
             dtype = np.int64 if tp.type == _dt.DataType.Index \
                 else np.float32
-            outer = [len(s) for s in col]
-            inners = [np.asarray(inner, dtype=dtype).reshape(
-                len(inner), -1) for s in col for inner in s]
-            return create_lod_tensor(
-                inners, [outer, [len(i) for i in inners]])
+            return nested_samples_to_lod_tensor(col, dtype)
         if tp.type == _dt.DataType.Index:
             if is_seq:
                 lens = [len(s) for s in col]

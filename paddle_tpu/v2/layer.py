@@ -57,9 +57,16 @@ __all__ = [
 
 _name_to_layer = {}
 
+# recurrent_group records every layer its step function creates (the
+# reference collected step layers via the global config; memories may
+# link to SIDE layers like get_output that no output reaches)
+_capture_stack = []
+
 
 def _remember(layer):
     _name_to_layer[layer.name] = layer
+    if _capture_stack:
+        _capture_stack[-1].append(layer)
     return layer
 
 
@@ -1259,7 +1266,11 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
     slots = [_StepSlot("static" if isinstance(i, StaticInput) else "seq",
                        i.input if isinstance(i, StaticInput) else i)
              for i in inputs]
-    outs = step(*slots)
+    _capture_stack.append([])
+    try:
+        outs = step(*slots)
+    finally:
+        created = _capture_stack.pop()
     out_layers = list(outs) if isinstance(outs, (list, tuple)) else [outs]
 
     # discover memory leaves + every node reachable from the outputs
@@ -1279,9 +1290,11 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
         scan(o)
 
     # resolve memory links NOW, against the step DAG itself — the global
-    # name registry is mutable and a later layer may reuse the name
+    # name registry is mutable and a later layer may reuse the name.
+    # Side layers created in the step but unreachable from its outputs
+    # (get_output state taps) resolve too.
     by_name = {}
-    for l in order:
+    for l in order + created:
         by_name.setdefault(l.name, l)
     links = {}
     for m in memories:
@@ -1291,6 +1304,11 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
                 "memory(name=%r) does not link to any layer produced "
                 "inside this step function" % m.link_name)
         links[id(m)] = link
+        # a SIDE link (unreachable from the outputs, e.g. a get_output
+        # state tap) joins the step DAG traversal so its own memories
+        # and outer references get the same treatment as output paths
+        if id(link) not in seen:
+            scan(link)
 
     # nodes NOT downstream of a slot/memory are OUTER references the user
     # pulled into the step (v1's implicit read-only link): build them in
@@ -1314,6 +1332,8 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
 
     for o in out_layers:
         mark_internal(o)
+    for m in memories:
+        mark_internal(links[id(m)])
     internal = {k for k, v in _mark_memo.items() if v}
     outer_refs, _outer_seen = [], set()
     for c in order:
@@ -1368,8 +1388,11 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
                 step_ctx[id(m)] = mem_vars[id(m)]
             out_vars = [o.build(step_ctx) for o in out_layers]
             for m in memories:
+                link = links[id(m)]
+                if id(link) not in step_ctx:
+                    link.build(step_ctx)     # side layer (state tap)
                 drnn.update_memory(mem_vars[id(m)],
-                                   step_ctx[id(links[id(m)])])
+                                   step_ctx[id(link)])
             for ov in out_vars:
                 drnn.output(ov)
         result = drnn()

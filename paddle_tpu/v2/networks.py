@@ -171,3 +171,239 @@ def outputs(layers, *args):
     if not isinstance(layers, (list, tuple)):
         layers = [layers] + list(args)
     return list(layers)
+
+
+# ---------------------------------------------------------------------------
+# round-4 tail: step units, groups, separable conv, attention family
+# (reference trainer_config_helpers/networks.py)
+# ---------------------------------------------------------------------------
+
+from .config_base import Layer as _Layer
+from ..fluid import layers as F
+from ..fluid import unique_name as _un
+
+
+def lstmemory_unit(input, out_memory=None, name=None, size=None,
+                   param_attr=None, act=None, gate_act=None,
+                   state_act=None, input_proj_bias_attr=None,
+                   input_proj_layer_attr=None, lstm_bias_attr=None,
+                   lstm_layer_attr=None):
+    """One LSTM step for recurrent_group (reference networks.py:717):
+    mixed(identity(input) + W*out_mem) -> lstm_step, with the cell state
+    readable as '<name>_state'. `size` is required (this build cannot
+    read a layer's width before the topology builds)."""
+    if size is None:
+        raise ValueError("lstmemory_unit needs an explicit size")
+    name = name or _un.generate("lstm_unit")
+    out_mem = out_memory if out_memory is not None else \
+        L.memory(name=name, size=size)
+    state_mem = L.memory(name="%s_state" % name, size=size)
+    m = L.mixed(name="%s_input_recurrent" % name, size=size * 4,
+                bias_attr=input_proj_bias_attr,
+                layer_attr=input_proj_layer_attr,
+                input=[L.identity_projection(input=input),
+                       L.full_matrix_projection(input=out_mem,
+                                                param_attr=param_attr)])
+    lstm_out = L.lstm_step(name=name, input=m, state=state_mem,
+                           size=size, bias_attr=lstm_bias_attr, act=act,
+                           gate_act=gate_act, state_act=state_act,
+                           layer_attr=lstm_layer_attr)
+    L.get_output(name="%s_state" % name, input=lstm_out,
+                 arg_name="state")
+    return lstm_out
+
+
+def lstmemory_group(input, size=None, name=None, out_memory=None,
+                    reverse=False, param_attr=None, act=None,
+                    gate_act=None, state_act=None,
+                    input_proj_bias_attr=None, input_proj_layer_attr=None,
+                    lstm_bias_attr=None, lstm_layer_attr=None):
+    """recurrent_group form of LSTM over a pre-projected (4*size) input
+    (reference networks.py:836) — per-step states stay addressable."""
+    name = name or _un.generate("lstm_group")
+
+    def __lstm_step__(ipt):
+        return lstmemory_unit(
+            input=ipt, name=name, size=size, act=act, gate_act=gate_act,
+            state_act=state_act, out_memory=out_memory,
+            input_proj_bias_attr=input_proj_bias_attr,
+            input_proj_layer_attr=input_proj_layer_attr,
+            param_attr=param_attr, lstm_layer_attr=lstm_layer_attr,
+            lstm_bias_attr=lstm_bias_attr)
+
+    return L.recurrent_group(name="%s_recurrent_group" % name,
+                             step=__lstm_step__, reverse=reverse,
+                             input=input)
+
+
+def gru_unit(input, memory_boot=None, size=None, name=None,
+             gru_bias_attr=None, gru_param_attr=None, act=None,
+             gate_act=None, gru_layer_attr=None, naive=False):
+    """One GRU step for recurrent_group over a pre-projected (3*size)
+    input (reference networks.py:940)."""
+    if size is None:
+        raise ValueError("gru_unit needs an explicit size")
+    name = name or _un.generate("gru_unit")
+    out_mem = L.memory(name=name, size=size, boot_layer=memory_boot)
+    return L.gru_step(name=name, input=input, output_mem=out_mem,
+                      size=size * 3, bias_attr=gru_bias_attr,
+                      param_attr=gru_param_attr, act=act,
+                      gate_act=gate_act, layer_attr=gru_layer_attr)
+
+
+def gru_group(input, memory_boot=None, size=None, name=None,
+              reverse=False, gru_bias_attr=None, gru_param_attr=None,
+              act=None, gate_act=None, gru_layer_attr=None, naive=False):
+    """recurrent_group form of GRU (reference networks.py:1002)."""
+    name = name or _un.generate("gru_group")
+
+    def __gru_step__(ipt):
+        return gru_unit(
+            input=ipt, name=name, memory_boot=memory_boot, size=size,
+            gru_bias_attr=gru_bias_attr, gru_param_attr=gru_param_attr,
+            act=act, gate_act=gate_act, gru_layer_attr=gru_layer_attr,
+            naive=naive)
+
+    return L.recurrent_group(name="%s_recurrent_group" % name,
+                             step=__gru_step__, reverse=reverse,
+                             input=input)
+
+
+def simple_gru2(input, size, name=None, reverse=False,
+                mixed_param_attr=None, mixed_bias_attr=None,
+                gru_param_attr=None, gru_bias_attr=None, act=None,
+                gate_act=None, mixed_layer_attr=None,
+                gru_cell_attr=None):
+    """fc(3*size) + gru_group (reference networks.py:1163 — same maths
+    as simple_gru, grouped step-by-step)."""
+    proj = L.fc(input=input, size=size * 3, act=None,
+                param_attr=mixed_param_attr, bias_attr=mixed_bias_attr,
+                layer_attr=mixed_layer_attr)
+    return gru_group(input=proj, size=size, name=name, reverse=reverse,
+                     gru_bias_attr=gru_bias_attr,
+                     gru_param_attr=gru_param_attr, act=act,
+                     gate_act=gate_act, gru_layer_attr=gru_cell_attr)
+
+
+def img_separable_conv(input, num_channels, num_out_channels, filter_size,
+                       stride=1, padding=0, depth_multiplier=1, act=None,
+                       bias_attr=None, param_attr=None, shared_bias=True,
+                       layer_type="exconv", name=None):
+    """Depthwise conv (groups == channels) + 1x1 pointwise mix
+    (reference networks.py img_separable_conv; Xception)."""
+    depthwise = L.img_conv(input=input, filter_size=filter_size,
+                           num_filters=num_channels * depth_multiplier,
+                           num_channels=num_channels, stride=stride,
+                           padding=padding, groups=num_channels,
+                           act=None, param_attr=param_attr,
+                           bias_attr=bias_attr)
+    return L.img_conv(input=depthwise, filter_size=1,
+                      num_filters=num_out_channels,
+                      num_channels=num_channels * depth_multiplier,
+                      stride=1, padding=0, act=act,
+                      param_attr=param_attr, bias_attr=bias_attr,
+                      name=name)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     weight_act=None, name=None):
+    """Additive (Bahdanau) attention context (reference
+    networks.py:1400): e_j = v tanh(W s + U h_j), weights =
+    softmax-over-sequence, context = sum_j w_j h_j. Widths come from the
+    built vars, matching the size-free reference API."""
+    from .attr import lower_param_attr as _lp
+
+    def build(enc, proj, state):
+        att = int(proj.shape[-1])
+        s_proj = F.fc(state, size=att,
+                      param_attr=_lp(transform_param_attr),
+                      bias_attr=False)                  # [B, A]
+        combined = F.elementwise_add(proj,
+                                     F.unsqueeze(s_proj, axes=[1]))
+        act_name = getattr(weight_act, "fluid_act", None) \
+            if weight_act is not None else "tanh"
+        if act_name:                   # fluid_act None == linear
+            combined = getattr(F, act_name)(combined)
+        v = F.create_parameter(shape=[att, 1], dtype="float32",
+                               attr=_lp(softmax_param_attr))
+        scores = F.matmul(combined, v)                  # [B, T, 1]
+        weights = F.sequence_softmax(scores)
+        return F.reduce_sum(F.elementwise_mul(enc, weights), dim=1)
+
+    return L._remember(_Layer(
+        name=name, parents=[encoded_sequence, encoded_proj,
+                            decoder_state],
+        build_fn=build, layer_type="simple_attention"))
+
+
+def dot_product_attention(encoded_sequence, attended_sequence,
+                          transformed_state, softmax_param_attr=None,
+                          name=None):
+    """Dot-product attention (reference networks.py:1498): e_j = s^T h_j
+    over encoded_sequence, context = weighted sum of attended_sequence."""
+
+    def build(enc, att, state):
+        # matmul keeps the LoD companion (reduce_* ops drop it, which
+        # would unmask the padded tail in the sequence softmax)
+        scores = F.matmul(enc, F.unsqueeze(state, axes=[2]))  # [B, T, 1]
+        weights = F.sequence_softmax(scores)
+        return F.reduce_sum(F.elementwise_mul(att, weights), dim=1)
+
+    return L._remember(_Layer(
+        name=name, parents=[encoded_sequence, attended_sequence,
+                            transformed_state],
+        build_fn=build, layer_type="dot_product_attention"))
+
+
+def multi_head_attention(query, key, value, key_proj_size, value_proj_size,
+                         head_num, attention_type, softmax_param_attr=None,
+                         name=None):
+    """Multi-head attention (reference networks.py:1580): project q/k/v
+    per head, score by dot-product or additive attention over the key
+    sequence, concat the per-head weighted value sums."""
+    if attention_type not in ("dot-product attention",
+                              "additive attention"):
+        raise ValueError("unknown attention_type %r" % attention_type)
+    assert key_proj_size % head_num == 0
+    assert value_proj_size % head_num == 0
+
+    def build(qv, kv, vv):
+        dk = key_proj_size // head_num
+        dv = value_proj_size // head_num
+        dq, dkv, dvv = (int(qv.shape[-1]), int(kv.shape[-1]),
+                        int(vv.shape[-1]))
+        heads = []
+        for h in range(head_num):
+            wq = F.create_parameter(shape=[dq, dk], dtype="float32")
+            wk = F.create_parameter(shape=[dkv, dk], dtype="float32")
+            wv = F.create_parameter(shape=[dvv, dv], dtype="float32")
+            qh = F.matmul(qv, wq)                             # [B, dk]
+            kh = F.matmul(kv, wk)                             # [B, T, dk]
+            vh = F.matmul(vv, wv)                             # [B, T, dv]
+            if attention_type == "dot-product attention":
+                scores = F.scale(
+                    F.matmul(kh, F.unsqueeze(qh, axes=[2])),
+                    scale=1.0 / float(dk) ** 0.5)             # [B, T, 1]
+            else:
+                combined = F.tanh(
+                    F.elementwise_add(kh, F.unsqueeze(qh, axes=[1])))
+                from .attr import lower_param_attr as _lp
+                va = F.create_parameter(shape=[dk, 1], dtype="float32",
+                                        attr=_lp(softmax_param_attr))
+                scores = F.matmul(combined, va)
+            weights = F.sequence_softmax(scores)
+            heads.append(F.reduce_sum(
+                F.elementwise_mul(vh, weights), dim=1))       # [B, dv]
+        return heads[0] if len(heads) == 1 else F.concat(heads, axis=1)
+
+    return L._remember(_Layer(
+        name=name, parents=[query, key, value], build_fn=build,
+        layer_type="multi_head_attention"))
+
+
+__all__ += [
+    "lstmemory_unit", "lstmemory_group", "gru_unit", "gru_group",
+    "simple_gru2", "img_separable_conv", "simple_attention",
+    "dot_product_attention", "multi_head_attention",
+]

@@ -25,3 +25,7 @@ _warnings.filterwarnings(
     message=r"Explicitly requested dtype .*int64.* is not available")
 
 from . import fluid  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
+from . import compat  # noqa: F401,E402
+from .batch import batch  # noqa: F401,E402

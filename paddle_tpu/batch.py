@@ -1,0 +1,18 @@
+"""Top-level paddle.batch (reference python/paddle/batch.py): group a
+sample reader into a minibatch reader. v2.minibatch aliases this."""
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,95 @@
+"""Py2/3 compatibility helpers (reference python/paddle/compat.py) —
+python-3-only build, the API surface is kept for ported code."""
+
+import math
+
+__all__ = ["long_type", "to_text", "to_bytes", "round",
+           "floor_division", "get_exception_message"]
+
+int_type = int
+long_type = int
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Convert bytes (recursively through list/set/dict) to str
+    (reference compat.py to_text)."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            for i in range(len(obj)):
+                obj[i] = _to_text(obj[i], encoding)
+            return obj
+        return [_to_text(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        if inplace:
+            items = [_to_text(o, encoding) for o in obj]
+            obj.clear()
+            obj.update(items)
+            return obj
+        return set(_to_text(o, encoding) for o in obj)
+    if isinstance(obj, dict):
+        if inplace:
+            for k in list(obj):
+                obj[k] = _to_text(obj[k], encoding)
+            return obj
+        return {k: _to_text(v, encoding) for k, v in obj.items()}
+    return _to_text(obj, encoding)
+
+
+def _to_text(obj, encoding):
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    return obj
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Convert str (recursively through containers) to bytes."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            for i in range(len(obj)):
+                obj[i] = _to_bytes(obj[i], encoding)
+            return obj
+        return [_to_bytes(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        if inplace:
+            items = [_to_bytes(o, encoding) for o in obj]
+            obj.clear()
+            obj.update(items)
+            return obj
+        return set(_to_bytes(o, encoding) for o in obj)
+    if isinstance(obj, dict):
+        if inplace:
+            for k in list(obj):
+                obj[k] = _to_bytes(obj[k], encoding)
+            return obj
+        return {k: _to_bytes(v, encoding) for k, v in obj.items()}
+    return _to_bytes(obj, encoding)
+
+
+def _to_bytes(obj, encoding):
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    return obj
+
+
+def round(x, d=0):
+    """Python-2 semantics: round half away from zero (reference
+    compat.py round)."""
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0:
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return 0.0
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    assert exc is not None
+    return str(exc)

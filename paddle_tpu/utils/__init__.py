@@ -1,0 +1,12 @@
+"""User utilities (reference python/paddle/utils/: dump_config, plot,
+merge_model, image_util). The config-dump and model-merge tools operate
+on this build's Program/topology serialization instead of the
+TrainerConfig protobuf."""
+
+from ..v2.plot import Ploter
+from . import image_util   # noqa: F401
+from .dump_config import dump_config, dump_v2_config
+from .merge_model import merge_v2_model
+
+__all__ = ["dump_config", "Ploter", "dump_v2_config", "merge_v2_model",
+           "image_util"]

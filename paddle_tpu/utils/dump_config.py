@@ -1,0 +1,54 @@
+"""Model-config dump tools (reference python/paddle/utils/dump_config.py
+and dump_v2_config.py). The reference printed the TrainerConfig protobuf
+parsed from a config file; here the canonical model description is the
+fluid Program, so the dump is its JSON serialization."""
+
+import json
+
+__all__ = ["dump_config", "dump_v2_config"]
+
+
+def dump_v2_config(topology, save_path=None, binary=False):
+    """Serialize a v2 topology's inference Program (reference
+    dump_v2_config.py:24 — there, the ModelConfig protobuf). Returns the
+    serialized text; writes it to save_path when given."""
+    from ..v2.topology import Topology
+    if not isinstance(topology, Topology):
+        topology = Topology(topology)
+    text = topology.proto()
+    if binary:
+        text = text.encode("utf-8") if isinstance(text, str) else text
+    if save_path:
+        mode = "wb" if binary else "w"
+        with open(save_path, mode) as f:
+            f.write(text)
+    return text
+
+
+def dump_config(config_path=None, module=None, config_arg_str=""):
+    """Execute a v1/v2 config file and dump the resulting network
+    (reference dump_config.py: parsed the file into TrainerConfig).
+    The config script must expose the output layer(s) via a top-level
+    `net`/`cost`/`outputs` variable."""
+    import runpy
+    if module is not None:
+        env = vars(module)
+    else:
+        env = runpy.run_path(config_path)
+    for key in ("outputs", "net", "cost", "prediction"):
+        if key in env:
+            return dump_v2_config(env[key])
+    raise ValueError(
+        "config %r defines none of outputs/net/cost/prediction"
+        % (config_path or module))
+
+
+def _program_summary(program):
+    """Human-oriented op/var counts per block (debug aid)."""
+    out = []
+    for i, blk in enumerate(program.blocks):
+        ops = {}
+        for op in blk.ops:
+            ops[op.type] = ops.get(op.type, 0) + 1
+        out.append({"block": i, "n_vars": len(blk.vars), "ops": ops})
+    return json.dumps(out, indent=2, sort_keys=True)

@@ -1,0 +1,7 @@
+"""Image preprocessing helpers (reference python/paddle/utils/
+image_util.py) — shared implementation with the v2 image module."""
+
+from ..v2.image import *          # noqa: F401,F403
+from ..v2 import image as _img
+
+__all__ = list(getattr(_img, "__all__", []))

@@ -1,0 +1,58 @@
+"""Merge a v2 network + trained parameters into ONE deployable file
+(reference python/paddle/utils/merge_model.py merge_v2_model: config
+proto + each parameter, length-framed). Format here: a tar containing
+'__topology__.json' (the inference Program) and the parameters in the
+v2 tar layout — loadable with load_merged_model."""
+
+import os
+import tarfile
+import tempfile
+
+__all__ = ["merge_v2_model", "load_merged_model"]
+
+
+def merge_v2_model(net, param_file, output_file):
+    """net: output layer(s) of the inference network; param_file: a
+    Parameters tar saved by `parameters.to_tar` (reference took the
+    .tar.gz path); output_file: merged artifact path."""
+    from ..v2.topology import Topology
+    from ..v2.parameters import Parameters
+
+    assert not os.path.exists(output_file), \
+        "%r already exists" % output_file
+    topo = net if isinstance(net, Topology) else Topology(net)
+    blob = topo.proto()
+
+    with open(param_file, "rb") as f:
+        params = Parameters.from_tar(f)
+
+    with tarfile.open(output_file, "w") as tar:
+        if isinstance(blob, str):
+            blob = blob.encode("utf-8")
+        _add_bytes(tar, "__topology__.json", blob)
+        with tempfile.NamedTemporaryFile(delete=False) as tmp:
+            params.to_tar(tmp)
+            tmp_path = tmp.name
+        tar.add(tmp_path, arcname="__parameters__.tar")
+        os.unlink(tmp_path)
+
+
+def load_merged_model(path):
+    """(program, Parameters) from a merge_v2_model artifact."""
+    from ..fluid.framework import Program
+    from ..v2.parameters import Parameters
+
+    with tarfile.open(path, "r") as tar:
+        blob = tar.extractfile("__topology__.json").read()
+        program = Program.parse_from_string(blob.decode("utf-8"))
+        pf = tar.extractfile("__parameters__.tar")
+        import io
+        params = Parameters.from_tar(io.BytesIO(pf.read()))
+    return program, params
+
+
+def _add_bytes(tar, name, blob):
+    import io
+    info = tarfile.TarInfo(name)
+    info.size = len(blob)
+    tar.addfile(info, io.BytesIO(blob))

@@ -1,18 +1,6 @@
-"""paddle.batch (reference python/paddle/v2/minibatch.py): group a sample
-reader into a minibatch reader."""
+"""paddle.v2.minibatch (reference python/paddle/v2/minibatch.py) —
+shared with the top-level batch module."""
+
+from ..batch import batch   # noqa: F401
 
 __all__ = ["batch"]
-
-
-def batch(reader, batch_size, drop_last=False):
-    def batch_reader():
-        b = []
-        for sample in reader():
-            b.append(sample)
-            if len(b) == batch_size:
-                yield b
-                b = []
-        if b and not drop_last:
-            yield b
-
-    return batch_reader

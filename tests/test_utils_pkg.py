@@ -1,0 +1,50 @@
+"""paddle.utils parity (reference python/paddle/utils/): config dump,
+model merge round-trip, Ploter, image_util."""
+
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+import paddle_tpu.utils as utils
+from paddle_tpu.trainer_config_helpers import layers as v1
+
+
+def _tiny_net():
+    x = v1.data_layer(name="ux", size=4)
+    h = v1.fc_layer(input=x, size=6, act=paddle.activation.Tanh())
+    return v1.fc_layer(input=h, size=2, act=paddle.activation.Softmax())
+
+
+def test_dump_v2_config_round_trips():
+    out = _tiny_net()
+    text = utils.dump_v2_config(out)
+    from paddle_tpu.fluid.framework import Program
+    prog = Program.parse_from_string(text)
+    types = [op.type for blk in prog.blocks for op in blk.ops]
+    assert "softmax" in types and any("mul" in t or "matmul" in t
+                                      for t in types), types
+
+
+def test_merge_v2_model_round_trip(tmp_path):
+    out = _tiny_net()
+    params = paddle.parameters.create(out)
+    pf = str(tmp_path / "params.tar")
+    with open(pf, "wb") as f:
+        params.to_tar(f)
+    merged = str(tmp_path / "model.paddle")
+    utils.merge_v2_model(out, pf, merged)
+
+    from paddle_tpu.utils.merge_model import load_merged_model
+    prog, params2 = load_merged_model(merged)
+    assert set(params.names()) == set(params2.names())
+    for n in params.names():
+        np.testing.assert_array_equal(params.get(n), params2.get(n))
+    # merged program carries the same parameter names
+    pvars = {p.name for p in prog.all_parameters()}
+    assert set(params.names()) <= pvars
+
+
+def test_ploter_and_image_util_importable():
+    p = utils.Ploter("train", "test")
+    p.append("train", 0, 1.0)
+    p.append("train", 1, 0.5)
+    assert hasattr(utils.image_util, "load_image")

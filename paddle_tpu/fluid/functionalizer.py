@@ -161,10 +161,21 @@ HOST_OPS = frozenset([
     "sparse_table_push", "go", "channel_create", "channel_send",
     "channel_recv", "channel_close", "generate_proposal_labels",
     "detection_map", "while_grad_dynamic",
-    # nested-LoD selection: data-dependent group structure (reference
-    # layers are CPU-only as well)
-    "sub_nested_seq",
+    # nested-LoD selection / re-batching: data-dependent group structure
+    # (reference layers are CPU-only as well)
+    "sub_nested_seq", "nested_to_outer", "nested_to_outer_grad",
 ])
+
+
+# attr-conditional host routing: these op types are jit-clean in their
+# common configuration but have a data-dependent OUTPUT SHAPE for
+# specific attr values (the reference computed such shapes on the host
+# at kernel launch, e.g. sequence_mask_op.cc's maxlen = max(x)).
+_HOST_IF = {
+    # maxlen=-1 means "max over the lengths tensor" -> dynamic width
+    "sequence_mask": lambda op: (op.attrs.get("maxlen") is None
+                                 or op.attrs.get("maxlen", -1) < 0),
+}
 
 
 def is_host_op(op):
@@ -172,7 +183,10 @@ def is_host_op(op):
     interprets its body per iteration (the reference's nested-Executor
     WhileOp), and layers set it on data-dependent nested-LoD ops (e.g.
     kmax_seq_score over a lod_level-2 input)."""
-    return op.type in HOST_OPS or bool(op.attrs.get("force_host"))
+    if op.type in HOST_OPS or bool(op.attrs.get("force_host")):
+        return True
+    pred = _HOST_IF.get(op.type)
+    return pred is not None and pred(op)
 
 
 def contains_host_ops(program):
@@ -262,9 +276,17 @@ def _run_grad_op(op, env, vjp_cache, step, seed, mesh):
     entry = vjp_cache.get(fwd_uid)
     if entry is None:
         # fallback: re-run forward under vjp from the wired fwd inputs
-        fwd_inputs = {slot: [env.get(n) if n else None for n in names]
-                      for slot, names in op.inputs.items()
-                      if not slot.startswith(("Out:", "GRAD:"))}
+        # (incl. LoD companions — a ragged mul shifts its flatten axis
+        # on them, so dropping them silently mis-shapes the recompute)
+        fwd_inputs = {}
+        for slot, names in op.inputs.items():
+            if slot.startswith(("Out:", "GRAD:")):
+                continue
+            fwd_inputs[slot] = [env.get(n) if n else None for n in names]
+            for suf in (LOD_LEN_SUFFIX, LOD_SEG_SUFFIX):
+                comp = [env.get(n + suf) if n else None for n in names]
+                if any(c is not None for c in comp):
+                    fwd_inputs[slot + suf] = comp
         proxy = _FwdProxy(op.attrs["fwd_type"], op.attrs["fwd_attrs"],
                           fwd_uid, fwd_inputs)
         od = op_registry.get_op_def(proxy.type)
@@ -298,6 +320,15 @@ def _run_grad_op(op, env, vjp_cache, step, seed, mesh):
                 env[name] = g
 
 
+def _is_generic_grad(op):
+    """True for grad ops served by the stashed forward vjp. A grad type
+    with its own registered lowering doesn't use it (e.g.
+    nested_to_outer_grad scatters host-side), so its forward must not be
+    re-run under vjp either."""
+    return (op.type.endswith("_grad") and "fwd_uid" in op.attrs
+            and not op_registry.has_op(op.type))
+
+
 def _interpret_ops(ops, env, step=0, seed=0, mesh=None, vjp_cache=None):
     """Interpret a sequence of ops inside the current jax trace, mutating
     env. The shared core of run_block and SegmentedProgramRunner."""
@@ -305,13 +336,12 @@ def _interpret_ops(ops, env, step=0, seed=0, mesh=None, vjp_cache=None):
         vjp_cache = {}
     needed_vjp = set()
     for op in ops:
-        if op.type.endswith("_grad") and "fwd_uid" in op.attrs:
+        if _is_generic_grad(op):
             needed_vjp.add(op.attrs["fwd_uid"])
     for op in ops:
         if op.type in _SKIP_OPS:
             continue
-        if op.type.endswith("_grad") and "fwd_uid" in op.attrs and \
-                not op_registry.has_op(op.type):
+        if _is_generic_grad(op):
             _run_grad_op(op, env, vjp_cache, step, seed, mesh)
         else:
             _run_forward_op(op, env, vjp_cache, needed_vjp, step, seed, mesh)

@@ -531,6 +531,70 @@ def _gru(ctx):
             "BatchHidden": hidden}
 
 
+@register_op("nested_to_outer")
+def _nested_to_outer(ctx):
+    """Re-batch a nested var for OUTER-level iteration: inner sequences
+    [N, T, ...] grouped by counts [B_outer] become [B_outer, S_max, T,
+    ...] (zero-padded slots) with an inner-length matrix [B_outer,
+    S_max]; both carry counts as their outer @LOD_LEN so a DynamicRNN
+    over them iterates sub-sequences (SubsequenceInput). S_max is
+    data-dependent -> host path."""
+    import jax
+    x = ctx.input("X")
+    lens = ctx.lod_len("X")
+    counts = ctx.lod_seg("X")
+    if counts is None:
+        raise ValueError("nested_to_outer needs a nested (lod_level-2) "
+                         "input")
+    if isinstance(x, jax.core.Tracer):
+        raise NotImplementedError(
+            "nested_to_outer has a data-dependent sub-sequence capacity "
+            "— runs on the host path")
+    x = np.asarray(x)
+    counts = np.asarray(counts)
+    lens = np.asarray(lens) if lens is not None else \
+        np.full((x.shape[0],), x.shape[1], np.int32)
+    B = len(counts)
+    S = int(counts.max()) if B else 0
+    out = np.zeros((B, S) + x.shape[1:], x.dtype)
+    lmat = np.zeros((B, S), np.int32)
+    start = 0
+    for g in range(B):
+        c = int(counts[g])
+        out[g, :c] = x[start:start + c]
+        lmat[g, :c] = lens[start:start + c]
+        start += c
+    return {"Out": out, "Out@LOD_LEN": counts.astype(np.int32),
+            "OutLens": lmat, "OutLens@LOD_LEN": counts.astype(np.int32)}
+
+
+@register_op("nested_to_outer_grad")
+def _nested_to_outer_grad(ctx):
+    """Explicit host-side gradient of nested_to_outer (the forward's
+    numpy re-batching is not vjp-traceable): unpack the outer-major
+    cotangent [B_outer, S_max, T, ...] back to inner rows [N, T, ...]."""
+    d_out = ctx.input("GRAD:Out")
+    counts = ctx.lod_seg("X")
+    x = ctx.input("X")
+    counts = np.asarray(counts)
+    d_out = np.asarray(d_out)
+    parts = [d_out[g, :int(c)] for g, c in enumerate(counts)]
+    dx = (np.concatenate(parts, axis=0) if parts
+          else np.zeros_like(np.asarray(x)))
+    return {"GRAD:X": dx}
+
+
+@register_op("attach_lod")
+def _attach_lod(ctx):
+    """Out = X with Lens attached as its @LOD_LEN companion — turns a
+    dense per-step slice back into a ragged var inside a recurrent
+    sub-block (the inner-sequence view of a SubsequenceInput step)."""
+    jnp = _jnp()
+    x = ctx.input("X")
+    lens = ctx.input("Lens")
+    return {"Out": x, "Out@LOD_LEN": lens.astype(jnp.int32)}
+
+
 @register_op("kmax_seq_score")
 def _kmax_seq_score(ctx):
     """Indices of the beam_size highest scores within each sequence's

@@ -4,13 +4,14 @@
 from ..v2 import activation as _a
 
 __all__ = [
-    "TanhActivation", "SigmoidActivation", "SoftmaxActivation",
-    "IdentityActivation", "LinearActivation", "SequenceSoftmaxActivation",
-    "ExpActivation", "ReluActivation", "BReluActivation",
-    "SoftReluActivation", "STanhActivation", "AbsActivation",
-    "SquareActivation", "LogActivation",
+    "BaseActivation", "TanhActivation", "SigmoidActivation",
+    "SoftmaxActivation", "IdentityActivation", "LinearActivation",
+    "SequenceSoftmaxActivation", "ExpActivation", "ReluActivation",
+    "BReluActivation", "SoftReluActivation", "STanhActivation",
+    "AbsActivation", "SquareActivation", "LogActivation",
 ]
 
+BaseActivation = _a.Base
 TanhActivation = _a.Tanh
 SigmoidActivation = _a.Sigmoid
 SoftmaxActivation = _a.Softmax

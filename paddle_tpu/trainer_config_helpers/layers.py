@@ -139,6 +139,13 @@ conv_operator = _v2.conv_operator
 from .attrs import (ParameterAttribute, ExtraLayerAttribute,  # noqa: E402
                     ParamAttr, ExtraAttr)
 
+# activation spellings the reference layers.py imported into its own
+# namespace (reference layers.py:20-21)
+from .activations import (LinearActivation, SigmoidActivation,  # noqa: E402
+                          TanhActivation, ReluActivation,
+                          IdentityActivation, SoftmaxActivation,
+                          BaseActivation)
+
 # the v1 return type name; v2 Layer nodes play the role
 LayerOutput = _LayerNode
 

@@ -1263,9 +1263,24 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
             "recurrent_group: unsupported v1 arguments %s"
             % sorted(kwargs))
     inputs = input if isinstance(input, (list, tuple)) else [input]
-    slots = [_StepSlot("static" if isinstance(i, StaticInput) else "seq",
-                       i.input if isinstance(i, StaticInput) else i)
-             for i in inputs]
+
+    def _slot_of(i):
+        if isinstance(i, StaticInput):
+            return _StepSlot("static", i.input)
+        if isinstance(i, SubsequenceInput):
+            return _StepSlot("subseq", i.input)
+        return _StepSlot("seq", i)
+
+    slots = [_slot_of(i) for i in inputs]
+    kinds = set(s.kind for s in slots)
+    if "subseq" in kinds and "seq" in kinds:
+        # the reference rejected mixed nesting levels among group inputs
+        # (all sequence inputs must share the outer iteration structure)
+        raise NotImplementedError(
+            "recurrent_group: SubsequenceInput cannot be mixed with "
+            "single-level sequence inputs — the group iterates the OUTER "
+            "level; wrap every sequence input as SubsequenceInput or use "
+            "StaticInput for per-group constants")
     _capture_stack.append([])
     try:
         outs = step(*slots)
@@ -1349,8 +1364,36 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
                     if m.boot_layer is not None] + outer_refs
 
     def build(ctx, *parent_vars):
-        seq_vars = [v for s, v in zip(slots, parent_vars)
-                    if s.kind == "seq"]
+        from ..fluid.layer_helper import LayerHelper
+
+        def _to_outer(v):
+            helper = LayerHelper("nested_to_outer")
+            out = helper.create_variable_for_type_inference(v.dtype)
+            lmat = helper.create_variable_for_type_inference("int32")
+            out.lod_level = 1
+            lmat.lod_level = 1
+            helper.append_op(type="nested_to_outer", inputs={"X": v},
+                             outputs={"Out": out, "OutLens": lmat},
+                             infer_shape=False)
+            # ragged build-shape convention is PACKED rank-2 (runtime
+            # arrays are padded rank-3/4) — keep it so downstream shape
+            # inference sees the usual [rows, D] view
+            out.shape = tuple(v.shape)
+            lmat.shape = (-1, 1)
+            return out, lmat
+
+        subseq_lmats = {}
+        seq_vars = []
+        for s, v in zip(slots, parent_vars):
+            if s.kind == "seq":
+                seq_vars.append(v)
+            elif s.kind == "subseq":
+                if reverse:
+                    raise NotImplementedError(
+                        "reverse=True with SubsequenceInput")
+                ov, lmat = _to_outer(v)
+                subseq_lmats[id(s)] = lmat
+                seq_vars.append(ov)
         if reverse:
             seq_vars = [F.sequence_reverse(v) for v in seq_vars]
         if not seq_vars:
@@ -1380,6 +1423,20 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
             for s, v in zip(slots, parent_vars):
                 if s.kind == "seq":
                     step_ctx[id(s)] = drnn.step_input(next(si))
+                elif s.kind == "subseq":
+                    xs = drnn.step_input(next(si))  # [B_outer, T, D]
+                    ls = drnn.step_input(
+                        subseq_lmats[id(s)])        # [B_outer]
+                    helper = LayerHelper("attach_lod")
+                    ragged = helper.create_variable_for_type_inference(
+                        xs.dtype)
+                    ragged.lod_level = 1
+                    helper.append_op(type="attach_lod",
+                                     inputs={"X": xs, "Lens": ls},
+                                     outputs={"Out": ragged},
+                                     infer_shape=False)
+                    ragged.shape = tuple(xs.shape)   # packed [rows, D]
+                    step_ctx[id(s)] = ragged
                 else:
                     step_ctx[id(s)] = drnn.static_input(v)
             mem_vars = {}
@@ -1977,14 +2034,14 @@ class GeneratedInput(BaseGeneratedInput):
 
 class SubsequenceInput(object):
     """Nested-sequence input to recurrent_group (reference
-    layers.py:4257). The padded-dense LoD runtime carries single-level
-    lengths only, so nested iteration is not lowered."""
+    layers.py:4257): the group iterates the OUTER level — step s sees
+    the s-th inner sequence of each outer group as a level-1 ragged
+    var. Lowered via the nested_to_outer re-batching op (host path; the
+    reference's nested machinery was CPU-side too) + an in-block
+    attach_lod that restores the inner lengths per step."""
 
     def __init__(self, input):
-        raise NotImplementedError(
-            "SubsequenceInput: nested-sequence recurrent_group is not "
-            "supported by the single-level padded-dense LoD encoding — "
-            "flatten the nesting or iterate the outer level in Python")
+        self.input = input
 
 
 def _var_layer(var, name=None):

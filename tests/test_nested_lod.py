@@ -179,3 +179,123 @@ def test_kmax_pads_unfilled_slots_with_minus_one():
     vals = sorted(np.asarray(got).ravel().tolist())
     # both real inner seqs selected exactly once, no duplicate of seq 0
     assert vals == [1.0, 2.0, 3.0], vals
+
+
+def test_subsequence_input_recurrent_group():
+    """Hierarchical RNN (reference SubsequenceInput): the group iterates
+    OUTER groups; each step sees one inner sequence, pools it, and
+    updates a memory. Cross-checked against a numpy restatement."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    x = v1.data_layer(
+        name="hx", type=paddle.data_type.dense_vector_sub_sequence(3))
+
+    def step(inner_seq):
+        mem = v1.memory(name="acc", size=3)
+        pooled = v1.pooling_layer(input=inner_seq,
+                                  pooling_type=paddle.pooling.Sum())
+        nxt = v1.addto_layer(input=[pooled, mem], name="acc",
+                             bias_attr=False)
+        return nxt
+
+    h = v1.recurrent_group(step=step, input=v1.SubsequenceInput(x))
+    last = v1.last_seq(input=h)
+
+    p = paddle.parameters.create(last)
+    # 2 outer groups: [[a,b],[c]] and [[d],[e,f],[g]]
+    rng = np.random.RandomState(6)
+    s1 = [rng.randn(2, 3).astype(np.float32),
+          rng.randn(1, 3).astype(np.float32)]
+    s2 = [rng.randn(1, 3).astype(np.float32),
+          rng.randn(2, 3).astype(np.float32),
+          rng.randn(3, 3).astype(np.float32)]
+    got = np.asarray(paddle.infer(output_layer=last, parameters=p,
+                                  input=[(s1,), (s2,)]))
+    # running sum of inner-sequence sums -> last = total sum per group
+    want = np.stack([sum(a.sum(0) for a in s1),
+                     sum(a.sum(0) for a in s2)])
+    np.testing.assert_allclose(got.reshape(2, 3), want, rtol=1e-5)
+
+
+def test_subsequence_input_max_pool_pins_inner_lengths():
+    """Max pooling reads the exact inner lengths — a wrong length matrix
+    (e.g. full-T) would pick up pad positions."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    x = v1.data_layer(
+        name="mx", type=paddle.data_type.dense_vector_sub_sequence(2))
+
+    def step(inner_seq):
+        mem = v1.memory(name="mmax", size=2)
+        pooled = v1.pooling_layer(input=inner_seq,
+                                  pooling_type=paddle.pooling.Max())
+        return v1.addto_layer(input=[pooled, mem], name="mmax",
+                              bias_attr=False)
+
+    h = v1.recurrent_group(step=step, input=v1.SubsequenceInput(x))
+    last = v1.last_seq(input=h)
+    p = paddle.parameters.create(last)
+    # ALL-NEGATIVE values: if padded zeros leaked into the max, the
+    # result would be 0 instead of the true (negative) maxima
+    s1 = [np.array([[-3.0, -1.0], [-2.0, -5.0]], np.float32),
+          np.array([[-4.0, -6.0]], np.float32)]
+    got = np.asarray(paddle.infer(output_layer=last, parameters=p,
+                                  input=[(s1,)])).ravel()
+    want = (np.array([-2.0, -1.0]) + np.array([-4.0, -6.0]))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_subsequence_input_trains_through_upstream_layer():
+    """A trainable fc BEFORE the SubsequenceInput: gradients flow back
+    through nested_to_outer (the explicit host-side grad op)."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    x = v1.data_layer(
+        name="tx", type=paddle.data_type.dense_vector_sub_sequence(3))
+    proj = v1.fc_layer(input=x, size=4, act=paddle.activation.Linear(),
+                       bias_attr=False)
+
+    def step(inner_seq):
+        mem = v1.memory(name="tacc", size=4)
+        pooled = v1.pooling_layer(input=inner_seq,
+                                  pooling_type=paddle.pooling.Sum())
+        return v1.addto_layer(input=[pooled, mem], name="tacc",
+                              bias_attr=False)
+
+    h = v1.recurrent_group(step=step, input=v1.SubsequenceInput(proj))
+    pred = v1.fc_layer(input=v1.last_seq(input=h), size=1,
+                       act=paddle.activation.Linear())
+    y = v1.data_layer(name="ty", size=1)
+    cost = v1.regression_cost(input=pred, label=y)
+
+    params = paddle.parameters.create(cost)
+    w0 = {n: np.array(params.get(n)) for n in params.names()}
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+    rng = np.random.RandomState(7)
+    tgt_w = np.array([1.0, -2.0, 0.5], np.float32)
+
+    def reader():
+        for _ in range(32):
+            groups = [rng.randn(rng.randint(1, 4), 3).astype(np.float32)
+                      for _ in range(rng.randint(2, 4))]
+            tot = sum(g.sum(0) for g in groups)
+            yield groups, np.array([float(tot @ tgt_w)], np.float32)
+
+    losses = []
+
+    def on_event(ev):
+        if isinstance(ev, paddle.event.EndIteration):
+            losses.append(float(ev.cost))
+
+    tr.train(paddle.batch(reader, 8), num_passes=12,
+             event_handler=on_event, feeding={"tx": 0, "ty": 1})
+    assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+    # the UPSTREAM projection learned, so gradients crossed
+    # nested_to_outer's explicit host-side grad
+    assert any(np.abs(np.array(params.get(n)) - w0[n]).max() > 0.05
+               for n in params.names() if n.startswith("fc"))

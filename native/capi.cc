@@ -1,0 +1,358 @@
+// C inference API implementation — see pd_capi.h for the contract and
+// the reference mapping (paddle_api.h:134 PaddlePredictor::Run; legacy
+// capi paddle_matrix/paddle_gradient_machine surface).
+//
+// Design: the serving computation is an AOT-exported XLA module
+// (paddle_tpu/inference/predictor.py AotPredictor — no Program rebuild,
+// no trace). CPython is embedded purely as host glue: ~200 lines of
+// dict/ndarray plumbing per call, nanoseconds next to an XLA dispatch.
+// numpy interop deliberately uses the buffer protocol + frombuffer
+// instead of the numpy C API so the .so builds against libpython alone.
+
+#include "pd_capi.h"
+
+#include <Python.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+// thread_local: pd_last_error() is read without the GIL, and one
+// thread's failure must not clobber another's message
+thread_local std::string g_err;
+
+struct DtypeEntry {
+  int code;
+  const char *np_name;
+  size_t size;
+};
+
+const DtypeEntry kDtypes[] = {
+    {PD_FLOAT32, "float32", 4}, {PD_FLOAT64, "float64", 8},
+    {PD_INT32, "int32", 4},     {PD_INT64, "int64", 8},
+    {PD_UINT8, "uint8", 1},     {PD_BOOL, "bool", 1},
+};
+
+const DtypeEntry *dtype_by_code(int code) {
+  for (const auto &e : kDtypes)
+    if (e.code == code) return &e;
+  return nullptr;
+}
+
+const DtypeEntry *dtype_by_np_name(const char *name) {
+  for (const auto &e : kDtypes)
+    if (std::strcmp(e.np_name, name) == 0) return &e;
+  return nullptr;
+}
+
+void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_err = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) g_err = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Initialize the interpreter once and release the GIL so every API call
+// can use PyGILState_Ensure/Release symmetrically. std::call_once makes
+// concurrent first calls from several threads safe: losers block until
+// the interpreter is up (or init failed) instead of racing the flags.
+bool ensure_python() {
+  static std::once_flag flag;
+  static bool ok = false;
+  static std::string init_err;
+  std::call_once(flag, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      const char *plat = std::getenv("PD_CAPI_PLATFORM");
+      if (plat && *plat) {
+        // pin the platform BEFORE any jax backend init (a sitecustomize
+        // may already have imported jax; config.update still wins as
+        // long as no backend came up)
+        std::string code = "import jax\n"
+                           "jax.config.update('jax_platforms', '";
+        code += plat;
+        code += "')\n";
+        if (PyRun_SimpleString(code.c_str()) != 0) {
+          init_err = std::string("PD_CAPI_PLATFORM pin failed for "
+                                 "platform: ") + plat;
+          PyEval_SaveThread();
+          return;
+        }
+      }
+      PyEval_SaveThread();  // drop the GIL held since Py_InitializeEx
+    }
+    ok = true;
+  });
+  if (!ok) g_err = init_err.empty() ? "python init failed" : init_err;
+  return ok;
+}
+
+struct Predictor {
+  PyObject *pred;         // AotPredictor instance
+  PyObject *np;           // numpy module
+  PyObject *feed_names;   // list[str]
+  PyObject *fetch_names;  // list[str]
+};
+
+// np.frombuffer(bytes, dtype=...).reshape(dims) for one input tensor.
+PyObject *tensor_to_ndarray(const Predictor *p, const pd_tensor *t) {
+  const DtypeEntry *de = dtype_by_code(t->dtype);
+  if (!de) {
+    g_err = "unknown input dtype code";
+    return nullptr;
+  }
+  size_t count = 1;
+  for (int i = 0; i < t->ndim; ++i) count *= (size_t)t->dims[i];
+  if (t->nbytes != count * de->size) {
+    g_err = "input nbytes does not match dims*itemsize";
+    return nullptr;
+  }
+  // zero-copy view of the caller's buffer: safe because run() is
+  // synchronous (the predictor copies on astype/jnp.asarray before the
+  // call returns) and the caller owns the input for the call's duration
+  PyObject *mv = PyMemoryView_FromMemory((char *)t->data,
+                                         (Py_ssize_t)t->nbytes, PyBUF_READ);
+  if (!mv) return nullptr;
+  PyObject *flat = PyObject_CallMethod(p->np, "frombuffer", "Os", mv,
+                                       de->np_name);
+  Py_DECREF(mv);
+  if (!flat) return nullptr;
+  PyObject *shape = PyTuple_New(t->ndim);
+  if (!shape) {
+    Py_DECREF(flat);
+    return nullptr;
+  }
+  for (int i = 0; i < t->ndim; ++i)
+    PyTuple_SET_ITEM(shape, i, PyLong_FromLongLong(t->dims[i]));
+  PyObject *arr = PyObject_CallMethod(flat, "reshape", "O", shape);
+  Py_DECREF(flat);
+  Py_DECREF(shape);
+  return arr;
+}
+
+// Copy one ndarray out into a malloc'd pd_tensor.
+bool ndarray_to_tensor(const Predictor *p, PyObject *arr_in,
+                       PyObject *name_obj, pd_tensor *out) {
+  std::memset(out, 0, sizeof(*out));
+  PyObject *arr =
+      PyObject_CallMethod(p->np, "ascontiguousarray", "O", arr_in);
+  if (!arr) return false;
+  bool ok = false;
+  PyObject *dt = nullptr, *dt_name = nullptr, *shape = nullptr;
+  Py_buffer view;
+  std::memset(&view, 0, sizeof(view));
+  do {
+    dt = PyObject_GetAttrString(arr, "dtype");
+    if (!dt) break;
+    dt_name = PyObject_GetAttrString(dt, "name");
+    if (!dt_name) break;
+    const char *np_name = PyUnicode_AsUTF8(dt_name);
+    const DtypeEntry *de = np_name ? dtype_by_np_name(np_name) : nullptr;
+    if (!de) {
+      g_err = std::string("unsupported output dtype: ") +
+              (np_name ? np_name : "?");
+      break;
+    }
+    shape = PyObject_GetAttrString(arr, "shape");
+    if (!shape) break;
+    Py_ssize_t ndim = PyTuple_Size(shape);
+    if (ndim > PD_MAX_DIMS) {
+      g_err = "output rank exceeds PD_MAX_DIMS";
+      break;
+    }
+    out->dtype = de->code;
+    out->ndim = (int)ndim;
+    for (Py_ssize_t i = 0; i < ndim; ++i)
+      out->dims[i] = PyLong_AsLongLong(PyTuple_GET_ITEM(shape, i));
+    if (PyObject_GetBuffer(arr, &view, PyBUF_CONTIG_RO) != 0) break;
+    out->nbytes = (size_t)view.len;
+    out->data = std::malloc(out->nbytes ? out->nbytes : 1);
+    if (!out->data) {
+      g_err = "malloc failed";
+      break;
+    }
+    std::memcpy(out->data, view.buf, out->nbytes);
+    if (name_obj) {
+      const char *nm = PyUnicode_AsUTF8(name_obj);
+      if (nm) {
+        std::strncpy(out->name, nm, PD_MAX_NAME - 1);
+        out->name[PD_MAX_NAME - 1] = '\0';
+      }
+    }
+    ok = true;
+  } while (false);
+  if (view.obj) PyBuffer_Release(&view);
+  Py_XDECREF(shape);
+  Py_XDECREF(dt_name);
+  Py_XDECREF(dt);
+  Py_DECREF(arr);
+  return ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *pd_create_predictor(const char *model_dir) {
+  g_err.clear();
+  if (!ensure_python()) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Predictor *p = nullptr;
+  PyObject *mod = nullptr, *np = nullptr, *pred = nullptr;
+  do {
+    np = PyImport_ImportModule("numpy");
+    if (!np) {
+      set_err_from_python();
+      break;
+    }
+    mod = PyImport_ImportModule("paddle_tpu.inference");
+    if (!mod) {
+      set_err_from_python();
+      break;
+    }
+    pred = PyObject_CallMethod(mod, "load_aot_predictor", "s", model_dir);
+    if (!pred) {
+      set_err_from_python();
+      break;
+    }
+    PyObject *feeds = PyObject_GetAttrString(pred, "_feed_names");
+    PyObject *fetches = PyObject_GetAttrString(pred, "_fetch_names");
+    if (!feeds || !fetches) {
+      Py_XDECREF(feeds);
+      Py_XDECREF(fetches);
+      set_err_from_python();
+      break;
+    }
+    p = new Predictor{pred, np, feeds, fetches};
+    pred = nullptr;  // ownership moved
+    np = nullptr;
+  } while (false);
+  Py_XDECREF(pred);
+  Py_XDECREF(np);
+  Py_XDECREF(mod);
+  if (PyErr_Occurred()) PyErr_Clear();  // never leak a pending exception
+  PyGILState_Release(gil);
+  return p;
+}
+
+int pd_predictor_run(void *predictor, const pd_tensor *inputs, int n_in,
+                     pd_tensor *outputs, int max_out) {
+  g_err.clear();
+  Predictor *p = (Predictor *)predictor;
+  if (!p) {
+    g_err = "null predictor";
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int result = -1;
+  PyObject *feeds = nullptr, *outs = nullptr;
+  do {
+    feeds = PyDict_New();
+    if (!feeds) break;
+    bool bad = false;
+    for (int i = 0; i < n_in; ++i) {
+      PyObject *arr = tensor_to_ndarray(p, &inputs[i]);
+      if (!arr) {
+        if (g_err.empty()) set_err_from_python();
+        bad = true;
+        break;
+      }
+      int rc;
+      if (inputs[i].name[0]) {
+        rc = PyDict_SetItemString(feeds, inputs[i].name, arr);
+      } else {
+        PyObject *nm = PyList_GetItem(p->feed_names, i);  // borrowed
+        if (!nm) {
+          g_err = "more inputs than model feeds";
+          Py_DECREF(arr);
+          bad = true;
+          break;
+        }
+        rc = PyDict_SetItem(feeds, nm, arr);
+      }
+      Py_DECREF(arr);
+      if (rc != 0) {
+        set_err_from_python();
+        bad = true;
+        break;
+      }
+    }
+    if (bad) break;
+    outs = PyObject_CallMethod(p->pred, "run", "O", feeds);
+    if (!outs) {
+      set_err_from_python();
+      break;
+    }
+    Py_ssize_t n_out = PySequence_Size(outs);
+    if (n_out < 0) {
+      set_err_from_python();
+      break;
+    }
+    bool copy_ok = true;
+    for (Py_ssize_t i = 0; i < n_out && i < max_out; ++i) {
+      PyObject *item = PySequence_GetItem(outs, i);
+      if (!item) {
+        set_err_from_python();
+        copy_ok = false;
+        break;
+      }
+      PyObject *nm = (i < PyList_Size(p->fetch_names))
+                         ? PyList_GetItem(p->fetch_names, i)
+                         : nullptr;  // borrowed
+      bool one = ndarray_to_tensor(p, item, nm, &outputs[i]);
+      Py_DECREF(item);
+      if (!one) {
+        if (g_err.empty()) set_err_from_python();
+        // release anything already copied so the caller need not
+        for (Py_ssize_t j = 0; j < i; ++j) pd_free_tensor_data(&outputs[j]);
+        copy_ok = false;
+        break;
+      }
+    }
+    if (!copy_ok) break;
+    result = (int)n_out;
+  } while (false);
+  Py_XDECREF(outs);
+  Py_XDECREF(feeds);
+  if (PyErr_Occurred()) PyErr_Clear();  // never leak a pending exception
+  PyGILState_Release(gil);
+  return result;
+}
+
+void pd_free_tensor_data(pd_tensor *t) {
+  if (t && t->data) {
+    std::free(t->data);
+    t->data = nullptr;
+    t->nbytes = 0;
+  }
+}
+
+void pd_destroy_predictor(void *predictor) {
+  Predictor *p = (Predictor *)predictor;
+  if (!p) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->pred);
+  Py_XDECREF(p->np);
+  Py_XDECREF(p->feed_names);
+  Py_XDECREF(p->fetch_names);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+const char *pd_last_error(void) { return g_err.c_str(); }
+
+}  // extern "C"

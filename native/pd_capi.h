@@ -1,0 +1,69 @@
+/* C inference API for paddle_tpu.
+ *
+ * Reference analogue: the C++ PaddlePredictor / CreatePaddlePredictor
+ * surface (paddle/fluid/inference/api/paddle_api.h:134,:204) and the
+ * legacy pure-C capi (paddle/legacy/capi). TPU redesign: the model is a
+ * `Predictor.save_aot` artifact (versioned StableHLO + weights); this
+ * library embeds CPython as host glue to feed the XLA computation, so a
+ * C/C++ application links one .so and serves with no Python of its own.
+ *
+ * Threading: calls are serialized internally via the GIL. Buffers in
+ * `pd_tensor.data` are caller-owned for inputs; for outputs they are
+ * malloc'd by the library and released with pd_free_tensor_data().
+ *
+ * Env: PD_CAPI_PLATFORM=cpu|tpu pins the jax platform before backend
+ * init (needed on hosts whose default platform is unavailable).
+ */
+#ifndef PD_CAPI_H
+#define PD_CAPI_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+enum pd_dtype {
+  PD_FLOAT32 = 0,
+  PD_FLOAT64 = 1,
+  PD_INT32 = 2,
+  PD_INT64 = 3,
+  PD_UINT8 = 4,
+  PD_BOOL = 5,
+};
+
+#define PD_MAX_DIMS 8
+#define PD_MAX_NAME 64
+
+typedef struct pd_tensor {
+  int dtype;                 /* enum pd_dtype */
+  int ndim;
+  int64_t dims[PD_MAX_DIMS];
+  void *data;                /* contiguous, C order */
+  size_t nbytes;
+  char name[PD_MAX_NAME];    /* "" on input = positional feed order */
+} pd_tensor;
+
+/* Open a save_aot artifact directory. NULL on failure (pd_last_error). */
+void *pd_create_predictor(const char *model_dir);
+
+/* Run one batch. Fills up to max_out tensors (malloc'd data; free each
+ * with pd_free_tensor_data). Returns the number of model outputs, or -1
+ * on failure. If the model has more outputs than max_out, the first
+ * max_out are filled and the true count is returned. */
+int pd_predictor_run(void *predictor, const pd_tensor *inputs, int n_in,
+                     pd_tensor *outputs, int max_out);
+
+void pd_free_tensor_data(pd_tensor *t);
+
+void pd_destroy_predictor(void *predictor);
+
+/* Last error message (empty string when the previous call succeeded). */
+const char *pd_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PD_CAPI_H */

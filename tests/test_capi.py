@@ -1,0 +1,113 @@
+"""C inference API (native/pd_capi.h, native/capi.cc): a PURE C client
+(native/capi_demo.c — no Python of its own) serves a save_aot artifact
+and must produce the same numbers as AotPredictor.run in-process.
+Reference analogue: paddle_api.h:134 PaddlePredictor::Run and the legacy
+capi examples (paddle/legacy/capi/examples/model_inference)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+NATIVE = os.path.join(REPO, "native")
+
+
+@pytest.fixture(scope="module")
+def capi_demo_bin():
+    import shutil
+    # skip only when the toolchain genuinely isn't there; a compile
+    # error with the toolchain present must FAIL, not skip
+    for tool in ("make", "g++", "gcc", "python3-config"):
+        if shutil.which(tool) is None:
+            pytest.skip("native toolchain unavailable: no %s" % tool)
+    proc = subprocess.run(
+        ["make", "libpaddle_tpu_capi.so", "capi_demo"], cwd=NATIVE,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, "capi build failed:\n" + proc.stderr[-2000:]
+    return os.path.join(NATIVE, "capi_demo")
+
+
+@pytest.fixture(scope="module")
+def aot_model(tmp_path_factory):
+    """Train a tiny conv net a few steps, save_inference_model, AOT-export
+    for batch 4, and return (aot_dir, reference outputs for the demo's
+    deterministic input)."""
+    from paddle_tpu.inference import (NativeConfig, create_paddle_predictor,
+                                      load_aot_predictor)
+    tmp = tmp_path_factory.mktemp("capi")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[1, 8, 8],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                   padding=1, act="relu")
+        pool = fluid.layers.pool2d(input=conv, pool_size=2, pool_stride=2)
+        pred = fluid.layers.fc(input=pool, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(2):
+            exe.run(main, feed={
+                "img": rng.randn(4, 1, 8, 8).astype(np.float32),
+                "label": rng.randint(0, 10, (4, 1)).astype(np.int64)},
+                fetch_list=[loss])
+        model_dir = str(tmp / "model")
+        fluid.save_inference_model(model_dir, ["img"], [pred], exe,
+                                   main_program=main)
+    aot_dir = str(tmp / "aot")
+    p = create_paddle_predictor(NativeConfig(model_dir=model_dir))
+    p.save_aot(aot_dir, batch_sizes=(4,))
+
+    # the demo's deterministic input: ((i*37 % 65) - 32) / 32
+    n = 4 * 1 * 8 * 8
+    x = ((np.arange(n) * 37 % 65) - 32.0).astype(np.float32) / 32.0
+    x = x.reshape(4, 1, 8, 8)
+    (ref,) = load_aot_predictor(aot_dir).run({"img": x})
+    return aot_dir, np.asarray(ref)
+
+
+def test_c_client_matches_python_predictor(capi_demo_bin, aot_model):
+    aot_dir, ref = aot_model
+    env = dict(os.environ)
+    env["PD_CAPI_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [capi_demo_bin, aot_dir, "4", "1", "8", "8"],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, (proc.stdout[-500:], proc.stderr[-2000:])
+    assert "CAPI-DEMO-OK" in proc.stdout
+    assert "second run ok" in proc.stdout
+
+    lines = proc.stdout.strip().splitlines()
+    assert lines[0] == "n_out 1", lines[0]
+    hdr = lines[1].split()
+    # "out <name> ndim 2 dims 4 10"
+    assert hdr[0] == "out" and hdr[2] == "ndim"
+    dims = [int(d) for d in hdr[hdr.index("dims") + 1:]]
+    assert tuple(dims) == ref.shape, (dims, ref.shape)
+    vals = np.array([float(v) for v in lines[2].split()],
+                    np.float32).reshape(ref.shape)
+    np.testing.assert_allclose(vals, ref, rtol=1e-4, atol=2e-6)
+
+
+def test_c_client_reports_clean_error_for_bad_dir(capi_demo_bin, tmp_path):
+    env = dict(os.environ)
+    env["PD_CAPI_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [capi_demo_bin, str(tmp_path / "nope"), "4", "1", "8", "8"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 1
+    assert "create failed:" in proc.stderr

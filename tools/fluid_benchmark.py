@@ -53,6 +53,10 @@ def parse_args():
                    help="nccl2 = collective DP (mesh); pserver = RPC PS")
     p.add_argument("--no_amp", action="store_true",
                    help="disable bf16 AMP (AMP on by default on TPU)")
+    p.add_argument("--fetch_every", type=int, default=1,
+                   help="fetch loss (host sync) every N steps; 1 = the "
+                        "reference's per-step methodology, >1 lets async "
+                        "dispatch pipeline the steps between fetches")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--use_fake_data", action="store_true", default=True)
     return p.parse_args()
@@ -180,11 +184,28 @@ def main():
         if i == n_warm:
             t0 = time.perf_counter()
         feed = synth_feed(feeds, batch, rng, program=main_prog)
-        if pe is not None:
-            outs = pe.run(fetch_list=fetch, feed=feed)
+        # --fetch_every N: fetch (= host sync) only every Nth step and on
+        # the last, letting XLA's async dispatch pipeline the steps in
+        # between. Default 1 keeps the reference methodology (the
+        # reference fluid_benchmark fetched loss each iteration).
+        # Fetch and no-fetch are distinct jit cache entries, so warmup
+        # must compile BOTH: all warm steps fetch except the final one
+        # (a single warm step must still fetch — with n_warm < 2 the
+        # other variant's compile unavoidably lands in the timed region).
+        if args.fetch_every <= 1:
+            do_fetch = True
+        elif i < n_warm:
+            do_fetch = n_warm < 2 or i != n_warm - 1
         else:
-            outs = exe.run(main_prog, feed=feed, fetch_list=fetch)
-        last = float(np.asarray(outs[0]).ravel()[0])  # host sync fence
+            do_fetch = ((i + 1) % args.fetch_every == 0
+                        or i == n_warm + n_timed - 1)
+        if pe is not None:
+            outs = pe.run(fetch_list=fetch if do_fetch else [], feed=feed)
+        else:
+            outs = exe.run(main_prog, feed=feed,
+                           fetch_list=fetch if do_fetch else [])
+        if do_fetch:
+            last = float(np.asarray(outs[0]).ravel()[0])  # host sync fence
         if i >= n_warm:
             examples += batch
     dt = time.perf_counter() - t0

@@ -746,3 +746,46 @@ def _chunk_eval(ctx):
             "NumInferChunks": num_i.reshape((1,)),
             "NumLabelChunks": num_l.reshape((1,)),
             "NumCorrectChunks": num_c.reshape((1,))}
+
+
+@register_op("positive_negative_pair", stateful=True)
+def _positive_negative_pair(ctx):
+    """Ranking pair statistics per query (reference
+    metrics/positive_negative_pair_op.h:44-110): every same-query item
+    pair with differing labels contributes w = (w_i + w_j)/2 — positive
+    when the score ordering agrees with the label ordering, negative
+    otherwise; equal scores ALSO add to neutral (the reference counts a
+    tie as neutral AND negative). Accumulator inputs make it streaming."""
+    jnp = _jnp()
+    score = ctx.input("Score")
+    label = ctx.input("Label")
+    query = ctx.input("QueryID")
+    weight = ctx.input("Weight")
+    col = ctx.attr("column", 0)
+    s = score[:, col] if score.ndim == 2 else score.reshape(-1)
+    lab = label.reshape(-1).astype(s.dtype)
+    q = query.reshape(-1)
+    n = s.shape[0]
+    w = (weight.reshape(-1).astype(s.dtype) if weight is not None
+         else jnp.ones((n,), s.dtype))
+    pair_w = (w[:, None] + w[None, :]) * 0.5
+    valid = ((q[:, None] == q[None, :])
+             & (lab[:, None] != lab[None, :])
+             & jnp.triu(jnp.ones((n, n), bool), k=1))
+    sd = s[:, None] - s[None, :]
+    ld = lab[:, None] - lab[None, :]
+    agree = sd * ld > 0
+    pos = jnp.sum(jnp.where(valid & agree, pair_w, 0.0))
+    neg = jnp.sum(jnp.where(valid & ~agree, pair_w, 0.0))
+    neu = jnp.sum(jnp.where(valid & (sd == 0), pair_w, 0.0))
+    acc_p = ctx.input("AccumulatePositivePair")
+    acc_n = ctx.input("AccumulateNegativePair")
+    acc_u = ctx.input("AccumulateNeutralPair")
+    if acc_p is not None and acc_n is not None and acc_u is not None:
+        pos = pos + acc_p.reshape(-1)[0]
+        neg = neg + acc_n.reshape(-1)[0]
+        neu = neu + acc_u.reshape(-1)[0]
+    f32 = jnp.float32
+    return {"PositivePair": pos.astype(f32).reshape(1),
+            "NegativePair": neg.astype(f32).reshape(1),
+            "NeutralPair": neu.astype(f32).reshape(1)}

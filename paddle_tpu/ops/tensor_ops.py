@@ -486,13 +486,30 @@ def _is_empty(ctx):
     return {"Out": jnp.asarray([x.size == 0])}
 
 
+def _print_msg(raw):
+    # escape braces: the user message must not be treated as a format
+    # template (message="loss {step}" would raise during tracing)
+    return (raw or "").replace("{", "{{").replace("}", "}}")
+
+
 @register_op("print")
 def _print(ctx):
     import jax
     x = ctx.input("In")
-    # escape braces: the user message must not be treated as a format
-    # template (message="loss {step}" would raise during tracing)
-    msg = (ctx.attr("message", "") or "").replace("{", "{{") \
-                                         .replace("}", "}}")
-    jax.debug.print(msg + " {}", x)
+    if ctx.attr("print_phase", "both") in ("forward", "both"):
+        jax.debug.print(_print_msg(ctx.attr("message", "")) + " {}", x)
     return {"Out": x}
+
+
+@register_op("print_grad")
+def _print_grad(ctx):
+    """Backward phase of print_op.cc: print_phase backward/both dumps the
+    incoming cotangent, then passes it through unchanged."""
+    import jax
+    d = ctx.input("GRAD:Out")
+    attrs = ctx.attr("fwd_attrs", None) or {}
+    if d is not None and \
+            attrs.get("print_phase", "both") in ("backward", "both"):
+        jax.debug.print(_print_msg(attrs.get("message", ""))
+                        + " @GRAD {}", d)
+    return {"GRAD:In": d}

@@ -15,6 +15,7 @@ from . import networks
 from .layers import *  # noqa: F401,F403
 from .networks import *  # noqa: F401,F403
 from .activations import *  # noqa: F401,F403
+from .evaluators import *  # noqa: F401,F403
 from .poolings import *  # noqa: F401,F403
 from .attrs import *  # noqa: F401,F403
 from .optimizers import *  # noqa: F401,F403

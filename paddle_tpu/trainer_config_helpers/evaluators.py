@@ -1,0 +1,67 @@
+"""v1 evaluator spellings (reference trainer_config_helpers/evaluators.py
+__all__:18-35) over the v2 evaluator nodes — same engine, the
+``*_evaluator`` names the v1 DSL and config files use. v2 strips the
+suffix when generating its module (reference python/paddle/v2/
+evaluator.py), which is where the implementations live here."""
+
+from ..v2 import evaluator as _ev
+
+__all__ = [
+    "evaluator_base", "EvaluatorAttribute",
+    "classification_error_evaluator", "auc_evaluator",
+    "pnpair_evaluator", "precision_recall_evaluator",
+    "ctc_error_evaluator", "chunk_evaluator", "sum_evaluator",
+    "column_sum_evaluator", "value_printer_evaluator",
+    "gradient_printer_evaluator", "maxid_printer_evaluator",
+    "maxframe_printer_evaluator", "seqtext_printer_evaluator",
+    "classification_error_printer_evaluator", "detection_map_evaluator",
+]
+
+
+class EvaluatorAttribute(object):
+    """Category bitmask (reference evaluators.py:38-52) — config parity
+    for code that filters evaluators by kind."""
+    FOR_CLASSIFICATION = 1
+    FOR_REGRESSION = 1 << 1
+    FOR_RANK = 1 << 2
+    FOR_PRINT = 1 << 3
+    FOR_UTILS = 1 << 4
+    FOR_DETECTION = 1 << 5
+
+    KEYS = ["for_classification", "for_regression", "for_rank",
+            "for_print", "for_utils", "for_detection"]
+
+    @staticmethod
+    def to_key(value):
+        for i, key in enumerate(EvaluatorAttribute.KEYS):
+            if value & (1 << i):
+                return key
+        raise ValueError("unknown evaluator attribute %r" % value)
+
+
+def evaluator_base(input, type=None, label=None, name=None, **kwargs):
+    """Generic entry the reference used internally; routes to the named
+    v2 evaluator when ``type`` matches one, else a value printer."""
+    fn = getattr(_ev, str(type).replace("_evaluator", ""), None)
+    if fn is None:
+        return _ev.value_printer(input, name=name)
+    if label is not None:
+        return fn(input, label, name=name, **kwargs)
+    return fn(input, name=name, **kwargs)
+
+
+classification_error_evaluator = _ev.classification_error
+auc_evaluator = _ev.auc
+pnpair_evaluator = _ev.pnpair
+precision_recall_evaluator = _ev.precision_recall
+ctc_error_evaluator = _ev.ctc_error
+chunk_evaluator = _ev.chunk
+sum_evaluator = _ev.sum
+column_sum_evaluator = _ev.column_sum
+value_printer_evaluator = _ev.value_printer
+gradient_printer_evaluator = _ev.gradient_printer
+maxid_printer_evaluator = _ev.maxid_printer
+maxframe_printer_evaluator = _ev.maxframe_printer
+seqtext_printer_evaluator = _ev.seqtext_printer
+classification_error_printer_evaluator = _ev.classification_error_printer
+detection_map_evaluator = _ev.detection_map

@@ -139,6 +139,9 @@ conv_operator = _v2.conv_operator
 from .attrs import (ParameterAttribute, ExtraLayerAttribute,  # noqa: E402
                     ParamAttr, ExtraAttr)
 
+# evaluator spellings (reference layers.py:22 `from .evaluators import *`)
+from .evaluators import *  # noqa: E402,F401,F403
+
 # activation spellings the reference layers.py imported into its own
 # namespace (reference layers.py:20-21)
 from .activations import (LinearActivation, SigmoidActivation,  # noqa: E402

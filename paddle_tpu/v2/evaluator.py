@@ -1,13 +1,18 @@
-"""v2 evaluators (reference python/paddle/v2/evaluator.py, deriving from
-trainer_config_helpers/evaluators.py). An evaluator attaches a metric
-computation to the topology as an extra layer; pass it via
-``SGD(extra_layers=...)`` or use the trainer's built-in classification
-error tracking."""
+"""v2 evaluators (reference python/paddle/v2/evaluator.py, which strips
+the ``_evaluator`` suffix off every trainer_config_helpers evaluator:
+evaluators.py:18-35). An evaluator attaches a metric (or printer) node to
+the topology; pass it via ``SGD(extra_layers=...)`` or fetch it like any
+layer with ``paddle.infer``/event callbacks."""
 
 from .config_base import Layer
 from ..fluid import layers as F
 
-__all__ = ["classification_error", "auc"]
+__all__ = [
+    "classification_error", "auc", "pnpair", "precision_recall",
+    "ctc_error", "chunk", "sum", "column_sum", "value_printer",
+    "gradient_printer", "maxid_printer", "maxframe_printer",
+    "seqtext_printer", "classification_error_printer", "detection_map",
+]
 
 
 def classification_error(input, label, name=None, top_k=1):
@@ -28,6 +33,227 @@ def auc(input, label, name=None):
     def build(pv, lv):
         out, _ = F.auc(input=pv, label=lv)
         return out
+
+    return Layer(name=name, parents=[input, label], build_fn=build,
+                 layer_type="evaluator")
+
+
+def pnpair(input, label, query_id, weight=None, name=None):
+    """Positive/negative ranking-pair rate for learning-to-rank (v1
+    pnpair_evaluator, reference metrics/positive_negative_pair_op.h):
+    streaming [pos, neg, neu] pair counts over same-query items."""
+    from ..fluid.layer_helper import LayerHelper
+    from ..fluid.initializer import Constant
+    from ..fluid import unique_name as _un
+
+    parents = [input, label, query_id] + ([weight] if weight else [])
+
+    def build(pv, lv, qv, *rest):
+        helper = LayerHelper("positive_negative_pair")
+        gb = helper.main_program.global_block()
+        accs = []
+        for tag in ("pos", "neg", "neu"):
+            v = gb.create_var(name=_un.generate("pnpair_" + tag),
+                              shape=[1], dtype="float32",
+                              persistable=True, stop_gradient=True)
+            helper.set_variable_initializer(v, Constant(0.0))
+            accs.append(v)
+        inputs = {"Score": pv, "Label": lv, "QueryID": qv,
+                  "AccumulatePositivePair": accs[0],
+                  "AccumulateNegativePair": accs[1],
+                  "AccumulateNeutralPair": accs[2]}
+        if rest:
+            inputs["Weight"] = rest[0]
+        helper.append_op(
+            type="positive_negative_pair", inputs=inputs,
+            outputs={"PositivePair": accs[0], "NegativePair": accs[1],
+                     "NeutralPair": accs[2]},
+            attrs={"column": 0})
+        # expose the running triple as one [3] node
+        return F.concat([accs[0], accs[1], accs[2]], axis=0)
+
+    return Layer(name=name, parents=parents, build_fn=build,
+                 layer_type="evaluator")
+
+
+def precision_recall(input, label, positive_label=None, weight=None,
+                     name=None):
+    """Streaming multi-class precision/recall/F1 (v1
+    precision_recall_evaluator). Returns the [6] accumulated metric
+    vector (macro P/R/F1 then micro P/R/F1); ``positive_label`` narrows
+    macro averaging to one class in the reference — here the full macro
+    vector is reported and the arg is accepted for config parity."""
+    from ..fluid.layer_helper import LayerHelper
+    from ..fluid.initializer import Constant
+    from ..fluid import unique_name as _un
+
+    parents = [input, label] + ([weight] if weight else [])
+
+    def build(pv, lv, *rest):
+        helper = LayerHelper("precision_recall")
+        gb = helper.main_program.global_block()
+        class_num = int(pv.shape[-1])
+        states = gb.create_var(name=_un.generate("precrec_states"),
+                               shape=[class_num, 4], dtype="float32",
+                               persistable=True, stop_gradient=True)
+        helper.set_variable_initializer(states, Constant(0.0))
+        idx = F.argmax(pv, axis=-1)
+        batch_m = helper.create_variable_for_type_inference(
+            "float32", stop_gradient=True)
+        accum_m = helper.create_variable_for_type_inference(
+            "float32", stop_gradient=True)
+        inputs = {"Indices": idx, "Labels": lv, "StatesInfo": states}
+        if rest:
+            inputs["Weights"] = rest[0]
+        helper.append_op(
+            type="precision_recall", inputs=inputs,
+            outputs={"BatchMetrics": batch_m, "AccumMetrics": accum_m,
+                     "AccumStatesInfo": states},
+            attrs={"class_number": class_num})
+        return accum_m
+
+    return Layer(name=name, parents=parents, build_fn=build,
+                 layer_type="evaluator")
+
+
+def ctc_error(input, label, name=None):
+    """Normalized edit distance between decoded sequences and labels (v1
+    ctc_error_evaluator, reference edit_distance_op.h)."""
+
+    def build(pv, lv):
+        # frame-level class scores arrive from the acoustic model (the
+        # v1 evaluator decoded internally): greedy best-path decode —
+        # merge repeats, drop blanks — then edit distance on token ids.
+        # Already-decoded integer sequences pass through unchanged.
+        from ..fluid import core as fcore
+        ids = pv
+        if fcore.convert_dtype_to_np(pv.dtype).kind == "f" and \
+                len(pv.shape) >= 2 and int(pv.shape[-1]) > 1:
+            ids = F.ctc_greedy_decoder(input=pv,
+                                       blank=int(pv.shape[-1]) - 1)
+        dist, _ = F.edit_distance(input=ids, label=lv, normalized=True)
+        return F.mean(dist)
+
+    return Layer(name=name, parents=[input, label], build_fn=build,
+                 layer_type="evaluator")
+
+
+def chunk(input, label, chunk_scheme, num_chunk_types,
+          excluded_chunk_types=None, name=None):
+    """Chunk-level F1 for sequence labeling (v1 chunk_evaluator,
+    reference chunk_eval_op.h)."""
+
+    def build(pv, lv):
+        f1 = F.chunk_eval(input=pv, label=lv, chunk_scheme=chunk_scheme,
+                          num_chunk_types=num_chunk_types,
+                          excluded_chunk_types=excluded_chunk_types)[2]
+        return f1
+
+    return Layer(name=name, parents=[input, label], build_fn=build,
+                 layer_type="evaluator")
+
+
+def sum(input, name=None):
+    """Sum of the input values over the batch (v1 sum_evaluator)."""
+
+    def build(pv):
+        return F.reduce_sum(pv)
+
+    return Layer(name=name, parents=[input], build_fn=build,
+                 layer_type="evaluator")
+
+
+def column_sum(input, name=None):
+    """Per-column sum over the batch (v1 column_sum_evaluator)."""
+
+    def build(pv):
+        return F.reduce_sum(pv, dim=0)
+
+    return Layer(name=name, parents=[input], build_fn=build,
+                 layer_type="evaluator")
+
+
+def _printer(input, message, name, transform=None, print_phase="forward"):
+    def build(pv):
+        v = transform(pv) if transform else pv
+        F.Print(v, message=message or (name or "eval"),
+                print_phase=print_phase)
+        return v
+
+    return Layer(name=name, parents=[input], build_fn=build,
+                 layer_type="evaluator")
+
+
+def value_printer(input, name=None):
+    """Print the layer's forward values (v1 value_printer_evaluator)."""
+    return _printer(input, "value", name)
+
+
+def gradient_printer(input, name=None):
+    """Print the gradient flowing through this node during backward (v1
+    gradient_printer_evaluator). The print op's registered print_grad
+    dumps the incoming cotangent (reference print_op.cc print_phase
+    'backward'), so gradients print when THIS NODE'S OUTPUT is used on
+    the differentiated path — e.g. feed its return value into the cost.
+    As a pure extra_layers leaf no backward reaches it (the reference's
+    gserver hooked evaluators into its own backward pass; this engine's
+    autodiff only visits ops on the loss path)."""
+    def build(pv):
+        return F.Print(pv, message=(name or "gradient"),
+                       print_phase="backward")
+
+    return Layer(name=name, parents=[input], build_fn=build,
+                 layer_type="evaluator")
+
+
+def maxid_printer(input, name=None):
+    """Print the argmax id per sample (v1 maxid_printer_evaluator)."""
+    return _printer(input, "maxid", name,
+                    transform=lambda pv: F.argmax(pv, axis=-1))
+
+
+def maxframe_printer(input, name=None):
+    """Print each sequence's maximal frame (v1
+    maxframe_printer_evaluator)."""
+    return _printer(input, "maxframe", name,
+                    transform=lambda pv: F.reduce_max(pv, dim=-1))
+
+
+def seqtext_printer(input, name=None, result_file=None):
+    """Print sequence token ids (v1 seqtext_printer_evaluator; the
+    reference wrote to result_file — accepted for config parity, output
+    goes to the log here)."""
+    return _printer(input, "seqtext", name)
+
+
+def classification_error_printer(input, label, name=None):
+    """Print the per-batch classification error (v1
+    classification_error_printer_evaluator)."""
+
+    def build(pv, lv):
+        acc = F.accuracy(input=pv, label=lv)
+        err = F.scale(acc, scale=-1.0, bias=1.0)
+        F.Print(err, message=name or "classification_error")
+        return err
+
+    return Layer(name=name, parents=[input, label], build_fn=build,
+                 layer_type="evaluator")
+
+
+def detection_map(input, label, overlap_threshold=0.5,
+                  background_id=0, evaluate_difficult=False,
+                  ap_type="11point", name=None):
+    """Streaming detection mAP (v1 detection_map_evaluator, reference
+    detection_map_op.cc). ``input`` is the detection output [[label,
+    score, xmin, ymin, xmax, ymax]]; ``label`` the ground-truth boxes."""
+
+    def build(pv, lv):
+        return F.detection_map(
+            detect_res=pv, label=lv,
+            background_label=background_id,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult,
+            ap_version="integral" if ap_type == "Integral" else ap_type)
 
     return Layer(name=name, parents=[input, label], build_fn=build,
                  layer_type="evaluator")

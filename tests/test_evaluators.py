@@ -1,0 +1,202 @@
+"""Evaluator family: positive_negative_pair op parity vs a per-pair
+numpy restatement (reference metrics/positive_negative_pair_op.h), and
+the v1/v2 evaluator surface (reference trainer_config_helpers/
+evaluators.py __all__, python/paddle/v2/evaluator.py generation)."""
+
+import numpy as np
+
+from tests.test_op_tail import run_op
+
+
+def _pnpair_reference(score, label, query, weight=None):
+    n = len(score)
+    w = weight if weight is not None else np.ones(n, np.float32)
+    pos = neg = neu = 0.0
+    for i in range(n):
+        for j in range(i + 1, n):
+            if query[i] != query[j] or label[i] == label[j]:
+                continue
+            pw = (w[i] + w[j]) * 0.5
+            if score[i] == score[j]:
+                neu += pw
+            if (score[i] - score[j]) * (label[i] - label[j]) > 0:
+                pos += pw
+            else:
+                neg += pw
+    return pos, neg, neu
+
+
+def test_positive_negative_pair_matches_reference_semantics():
+    rng = np.random.RandomState(0)
+    n = 40
+    score = rng.randint(0, 6, n).astype(np.float32)[:, None]  # forces ties
+    label = rng.randint(0, 3, n).astype(np.float32)[:, None]
+    query = rng.randint(0, 5, n).astype(np.int64)[:, None]
+    out = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": query})
+    pos, neg, neu = _pnpair_reference(score[:, 0], label[:, 0], query[:, 0])
+    np.testing.assert_allclose(float(np.asarray(out["PositivePair"])), pos)
+    np.testing.assert_allclose(float(np.asarray(out["NegativePair"])), neg)
+    np.testing.assert_allclose(float(np.asarray(out["NeutralPair"])), neu)
+
+
+def test_positive_negative_pair_weighted_and_accumulating():
+    rng = np.random.RandomState(1)
+    n = 16
+    score = rng.randn(n).astype(np.float32)[:, None]
+    label = rng.randint(0, 2, n).astype(np.float32)[:, None]
+    query = rng.randint(0, 3, n).astype(np.int64)[:, None]
+    weight = rng.rand(n).astype(np.float32)[:, None]
+    out = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": query,
+                  "Weight": weight,
+                  "AccumulatePositivePair": np.array([10.0], np.float32),
+                  "AccumulateNegativePair": np.array([20.0], np.float32),
+                  "AccumulateNeutralPair": np.array([30.0], np.float32)})
+    pos, neg, neu = _pnpair_reference(score[:, 0], label[:, 0],
+                                      query[:, 0], weight[:, 0])
+    np.testing.assert_allclose(float(np.asarray(out["PositivePair"])),
+                               pos + 10.0, rtol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(out["NegativePair"])),
+                               neg + 20.0, rtol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(out["NeutralPair"])),
+                               neu + 30.0, rtol=1e-6)
+
+
+def test_v1_evaluator_surface_complete():
+    """Every reference evaluators.py __all__ name resolves in the v1 DSL
+    and its suffix-stripped form in v2 (reference v2/evaluator.py)."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.trainer_config_helpers import layers as v1
+    ref_all = [
+        "evaluator_base", "classification_error_evaluator",
+        "auc_evaluator", "pnpair_evaluator", "precision_recall_evaluator",
+        "ctc_error_evaluator", "chunk_evaluator", "sum_evaluator",
+        "column_sum_evaluator", "value_printer_evaluator",
+        "gradient_printer_evaluator", "maxid_printer_evaluator",
+        "maxframe_printer_evaluator", "seqtext_printer_evaluator",
+        "classification_error_printer_evaluator",
+        "detection_map_evaluator",
+    ]
+    for n in ref_all:
+        assert hasattr(v1, n), "v1 missing %s" % n
+    for n in ref_all[1:]:
+        assert hasattr(paddle.evaluator, n[:-len("_evaluator")]), n
+
+
+def test_evaluator_nodes_compute_through_trainer():
+    """classification_error + precision_recall + column_sum as
+    extra_layers on a trained topology: values fetched via infer match a
+    numpy restatement on the same inputs."""
+    import paddle_tpu.v2 as paddle
+
+    x = paddle.layer.data(name="ex",
+                          type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="ey",
+                          type=paddle.data_type.integer_value(3))
+    pred = paddle.layer.fc(input=x, size=3,
+                           act=paddle.activation.Softmax())
+    err = paddle.evaluator.classification_error(input=pred, label=y)
+    csum = paddle.evaluator.column_sum(input=pred)
+
+    params = paddle.parameters.create(err)
+    rng = np.random.RandomState(2)
+    xs = rng.randn(6, 4).astype(np.float32)
+    ys = rng.randint(0, 3, (6,)).astype(np.int64)
+    got_err, got_sum, got_pred = paddle.infer(
+        output_layer=[err, csum, pred], parameters=params,
+        input=[(a, b) for a, b in zip(xs, ys)],
+        feeding={"ex": 0, "ey": 1})
+    p = np.asarray(got_pred)
+    want_err = float(np.mean(np.argmax(p, axis=1) != ys))
+    np.testing.assert_allclose(float(np.asarray(got_err).ravel()[0]),
+                               want_err, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_sum).ravel(), p.sum(0),
+                               rtol=1e-5)
+
+
+def test_pnpair_evaluator_streams_across_batches():
+    """The pnpair node accumulates across exe.run calls (persistable
+    accumulators), matching the cumulative numpy restatement."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    s = paddle.layer.data(name="ps",
+                          type=paddle.data_type.dense_vector(1))
+    lb = paddle.layer.data(name="pl",
+                           type=paddle.data_type.dense_vector(1))
+    q = paddle.layer.data(name="pq",
+                          type=paddle.data_type.integer_value(100))
+    node = v1.pnpair_evaluator(s, lb, q)
+    params = paddle.parameters.create(node)
+
+    rng = np.random.RandomState(3)
+    total = np.zeros(3)
+    feeds = []
+    for _ in range(3):
+        n = 10
+        sc = rng.randint(0, 4, (n, 1)).astype(np.float32)
+        la = rng.randint(0, 2, (n, 1)).astype(np.float32)
+        qu = rng.randint(0, 3, (n,)).astype(np.int64)
+        feeds.append((sc, la, qu))
+        pos, neg, neu = _pnpair_reference(sc[:, 0], la[:, 0], qu)
+        total += [pos, neg, neu]
+
+    # one Inference machine keeps one scope -> accumulators persist
+    from paddle_tpu.v2.inference import Inference
+    inf = Inference(output_layer=node, parameters=params)
+    last = None
+    for sc, la, qu in feeds:
+        last = inf.infer(input=[(sc, la, qu)],
+                         feeding={"ps": 0, "pl": 1, "pq": 2})
+    np.testing.assert_allclose(np.asarray(last).ravel(), total, rtol=1e-6)
+
+
+def test_print_grad_dumps_cotangent(capfd):
+    """print_phase='backward' prints the incoming gradient (registered
+    print_grad lowering), not the forward value (reference print_op.cc)."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32")
+        h = fluid.layers.fc(x, size=4, bias_attr=False)
+        tapped = fluid.layers.Print(h, message="gradtap",
+                                    print_phase="backward")
+        loss = fluid.layers.mean(tapped)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                fetch_list=[loss])
+    out = capfd.readouterr().out
+    assert "gradtap @GRAD" in out
+    # mean over 2x4 -> each cotangent element is 1/8
+    assert "0.125" in out
+    # no forward-phase print of the raw activations
+    assert out.count("gradtap") == 1
+
+
+def test_ctc_error_evaluator_decodes_frames():
+    """Float frame scores are greedy-decoded (merge repeats, drop blank)
+    before edit distance — feeding probabilities straight to
+    edit_distance would compare garbage integer casts."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    C = 4  # classes incl. blank (= C-1)
+    frames = paddle.layer.data(
+        name="cf", type=paddle.data_type.dense_vector_sequence(C))
+    lab = paddle.layer.data(
+        name="cl", type=paddle.data_type.integer_value_sequence(C))
+    node = v1.ctc_error_evaluator(input=frames, label=lab)
+    params = paddle.parameters.create(node)
+
+    # frames argmax: [0, 0, blank, 1] -> decoded [0, 1]; label [0, 1]
+    f = np.full((4, C), 0.1, np.float32)
+    f[0, 0] = f[1, 0] = f[2, C - 1] = f[3, 1] = 0.9
+    got = paddle.infer(output_layer=node, parameters=params,
+                       input=[(f, np.array([0, 1], np.int64))],
+                       feeding={"cf": 0, "cl": 1})
+    assert float(np.asarray(got).ravel()[0]) == 0.0

@@ -245,15 +245,37 @@ def detection_map(input, label, overlap_threshold=0.5,
                   ap_type="11point", name=None):
     """Streaming detection mAP (v1 detection_map_evaluator, reference
     detection_map_op.cc). ``input`` is the detection output [[label,
-    score, xmin, ymin, xmax, ymax]]; ``label`` the ground-truth boxes."""
+    score, xmin, ymin, xmax, ymax]]; ``label`` the ground-truth boxes.
+    Accumulator states are persistable (fluid.metrics.DetectionMAP's
+    wiring), so one Inference machine reports the cumulative pass mAP."""
+    from ..fluid.layer_helper import LayerHelper
+    from ..fluid.initializer import Constant
+    from ..fluid import unique_name as _un
 
     def build(pv, lv):
-        return F.detection_map(
+        helper = LayerHelper("detection_map_eval")
+        gb = helper.main_program.global_block()
+
+        def state(tag, shape, dtype):
+            v = gb.create_var(name=_un.generate("dmap_" + tag),
+                              shape=shape, dtype=dtype, persistable=True,
+                              stop_gradient=True)
+            helper.set_variable_initializer(v, Constant(0))
+            return v
+
+        states = [state("pos", [1, 2], "int32"),
+                  state("tp", [1, 3], "float32"),
+                  state("fp", [1, 3], "float32")]
+        has_state = state("has", [1], "int32")
+        m = F.detection_map(
             detect_res=pv, label=lv,
             background_label=background_id,
             overlap_threshold=overlap_threshold,
             evaluate_difficult=evaluate_difficult,
+            has_state=has_state, input_states=states, out_states=states,
             ap_version="integral" if ap_type == "Integral" else ap_type)
+        F.fill_constant(shape=[1], dtype="int32", value=1, out=has_state)
+        return m
 
     return Layer(name=name, parents=[input, label], build_fn=build,
                  layer_type="evaluator")

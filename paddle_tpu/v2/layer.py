@@ -1281,6 +1281,8 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
             "single-level sequence inputs — the group iterates the OUTER "
             "level; wrap every sequence input as SubsequenceInput or use "
             "StaticInput for per-group constants")
+    if reverse and "subseq" in kinds:
+        raise NotImplementedError("reverse=True with SubsequenceInput")
     _capture_stack.append([])
     try:
         outs = step(*slots)

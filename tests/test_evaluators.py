@@ -200,3 +200,39 @@ def test_ctc_error_evaluator_decodes_frames():
                        input=[(f, np.array([0, 1], np.int64))],
                        feeding={"cf": 0, "cl": 1})
     assert float(np.asarray(got).ravel()[0]) == 0.0
+
+
+def test_detection_map_evaluator_streams_across_batches():
+    """Accumulator states are persistable: after a perfect batch and an
+    all-wrong batch through ONE Inference machine, the reported mAP is
+    cumulative (between the two per-batch values), not the last batch's."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    det = paddle.layer.data(name="dd",
+                            type=paddle.data_type.dense_vector_sequence(6))
+    gt = paddle.layer.data(name="dg",
+                           type=paddle.data_type.dense_vector_sequence(6))
+    node = v1.detection_map_evaluator(input=det, label=gt,
+                                      overlap_threshold=0.5,
+                                      ap_type="integral")
+    params = paddle.parameters.create(node)
+    from paddle_tpu.v2.inference import Inference
+    inf = Inference(output_layer=node, parameters=params)
+
+    box = [0.1, 0.1, 0.4, 0.4]
+    gt_row = [[1.0, 0.0] + box]                       # class 1, easy
+    perfect = [[1.0, 0.9] + box]                      # hits it
+    wrong = [[1.0, 0.9, 0.6, 0.6, 0.9, 0.9]]         # misses it
+    m1 = float(np.asarray(inf.infer(
+        input=[(np.array(perfect, np.float32),
+                np.array(gt_row, np.float32))],
+        feeding={"dd": 0, "dg": 1})).ravel()[0])
+    m2 = float(np.asarray(inf.infer(
+        input=[(np.array(wrong, np.float32),
+                np.array(gt_row, np.float32))],
+        feeding={"dd": 0, "dg": 1})).ravel()[0])
+    assert m1 == 1.0, m1
+    # cumulative: 1 TP + 1 FP over 2 positives -> strictly between the
+    # perfect 1.0 and the all-wrong 0.0 of batch 2 alone
+    assert 0.0 < m2 < 1.0, m2

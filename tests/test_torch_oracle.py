@@ -1,0 +1,176 @@
+"""Independent-oracle semantics audit: core layers vs torch (CPU).
+
+Most tests in this suite validate against numpy restatements written
+from the same understanding of the spec — an independent framework
+catches wrong-default bugs those can't (padding/dilation conventions,
+avg-pool exclusive vs count_include_pad, LRN's alpha scaling, BN eps
+placement). Reference kernels: conv_op.cc, pool_op.cc, batch_norm_op.cc,
+lrn_op.cc, conv_transpose_op.cc."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+
+
+def _run(build, feed, weights=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        sc = fluid.executor.global_scope()
+        for k, v in (weights or {}).items():
+            sc.set(k, v)
+        (o,) = exe.run(main, feed=feed, fetch_list=[out])
+    return np.asarray(o)
+
+
+@pytest.fixture(scope="module")
+def x():
+    return np.random.RandomState(0).randn(2, 4, 8, 8).astype(np.float32)
+
+
+def test_conv2d_stride_pad_dilation(x):
+    w = np.random.RandomState(1).randn(6, 4, 3, 3).astype(np.float32)
+
+    def b():
+        xi = fluid.layers.data("x", shape=[4, 8, 8], dtype="float32")
+        return fluid.layers.conv2d(
+            xi, num_filters=6, filter_size=3, stride=2, padding=1,
+            dilation=2, bias_attr=False,
+            param_attr=fluid.ParamAttr(name="w"))
+
+    got = _run(b, {"x": x}, {"w": w})
+    ref = TF.conv2d(torch.tensor(x), torch.tensor(w), stride=2,
+                    padding=1, dilation=2).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_conv2d_groups(x):
+    w = np.random.RandomState(2).randn(6, 2, 3, 3).astype(np.float32)
+
+    def b():
+        xi = fluid.layers.data("x", shape=[4, 8, 8], dtype="float32")
+        return fluid.layers.conv2d(
+            xi, num_filters=6, filter_size=3, groups=2, padding=1,
+            bias_attr=False, param_attr=fluid.ParamAttr(name="w"))
+
+    got = _run(b, {"x": x}, {"w": w})
+    ref = TF.conv2d(torch.tensor(x), torch.tensor(w), padding=1,
+                    groups=2).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_conv2d_transpose(x):
+    w = np.random.RandomState(3).randn(4, 3, 3, 3).astype(np.float32)
+
+    def b():
+        xi = fluid.layers.data("x", shape=[4, 8, 8], dtype="float32")
+        return fluid.layers.conv2d_transpose(
+            xi, num_filters=3, filter_size=3, stride=2, padding=1,
+            bias_attr=False, param_attr=fluid.ParamAttr(name="w"))
+
+    got = _run(b, {"x": x}, {"w": w})
+    ref = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                              stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("exclusive,ceil_mode",
+                         [(True, False), (False, False), (True, True)])
+def test_avg_pool_exclusive_and_ceil(x, exclusive, ceil_mode):
+    """paddle `exclusive` is torch's count_include_pad INVERTED; ceil_mode
+    changes the output grid."""
+
+    def b():
+        xi = fluid.layers.data("x", shape=[4, 8, 8], dtype="float32")
+        return fluid.layers.pool2d(
+            xi, pool_size=3, pool_stride=2, pool_padding=1,
+            pool_type="avg", ceil_mode=ceil_mode, exclusive=exclusive)
+
+    got = _run(b, {"x": x})
+    ref = TF.avg_pool2d(torch.tensor(x), 3, 2, 1, ceil_mode=ceil_mode,
+                        count_include_pad=not exclusive).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_max_pool(x):
+    def b():
+        xi = fluid.layers.data("x", shape=[4, 8, 8], dtype="float32")
+        return fluid.layers.pool2d(xi, pool_size=2, pool_stride=2,
+                                   pool_type="max")
+
+    got = _run(b, {"x": x})
+    np.testing.assert_allclose(
+        got, TF.max_pool2d(torch.tensor(x), 2, 2).numpy(), atol=1e-6)
+
+
+def test_batch_norm_inference_stats(x):
+    rng = np.random.RandomState(4)
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32)
+    mean = rng.randn(4).astype(np.float32)
+    var = rng.rand(4).astype(np.float32) + 0.5
+
+    def b():
+        xi = fluid.layers.data("x", shape=[4, 8, 8], dtype="float32")
+        return fluid.layers.batch_norm(
+            xi, is_test=True, epsilon=1e-5,
+            param_attr=fluid.ParamAttr(name="g"),
+            bias_attr=fluid.ParamAttr(name="b"),
+            moving_mean_name="m", moving_variance_name="v")
+
+    got = _run(b, {"x": x},
+               {"g": gamma, "b": beta, "m": mean, "v": var})
+    ref = TF.batch_norm(torch.tensor(x), torch.tensor(mean),
+                        torch.tensor(var), torch.tensor(gamma),
+                        torch.tensor(beta), False, 0.9, 1e-5).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_lrn_alpha_convention(x):
+    """paddle lrn alpha is PER-ELEMENT; torch's is divided by size —
+    alpha_torch = alpha_paddle * n (lrn_op.cc)."""
+
+    def b():
+        xi = fluid.layers.data("x", shape=[4, 8, 8], dtype="float32")
+        return fluid.layers.lrn(xi, n=5, k=1.0, alpha=1e-4, beta=0.75)
+
+    got = _run(b, {"x": x})
+    ref = TF.local_response_norm(torch.tensor(x), size=5, alpha=1e-4 * 5,
+                                 beta=0.75, k=1.0).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_softmax_last_dim(x):
+    def b():
+        xi = fluid.layers.data("x", shape=[4, 8, 8], dtype="float32")
+        return fluid.layers.softmax(xi)
+
+    got = _run(b, {"x": x})
+    np.testing.assert_allclose(
+        got, TF.softmax(torch.tensor(x), dim=-1).numpy(), atol=1e-6)
+
+
+def test_layer_norm_affine():
+    rng = np.random.RandomState(5)
+    h = rng.randn(4, 10).astype(np.float32)
+    g = rng.rand(10).astype(np.float32) + 0.5
+    bb = rng.randn(10).astype(np.float32)
+
+    def b():
+        xi = fluid.layers.data("h", shape=[10], dtype="float32")
+        return fluid.layers.layer_norm(
+            xi, scale=True, shift=True, epsilon=1e-5,
+            param_attr=fluid.ParamAttr(name="lg"),
+            bias_attr=fluid.ParamAttr(name="lb"))
+
+    got = _run(b, {"h": h}, {"lg": g, "lb": bb})
+    ref = TF.layer_norm(torch.tensor(h), (10,), torch.tensor(g),
+                        torch.tensor(bb), 1e-5).numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-5)

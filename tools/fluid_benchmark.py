@@ -189,13 +189,15 @@ def main():
         # between. Default 1 keeps the reference methodology (the
         # reference fluid_benchmark fetched loss each iteration).
         # Fetch and no-fetch are distinct jit cache entries, so warmup
-        # must compile BOTH: all warm steps fetch except the final one
-        # (a single warm step must still fetch — with n_warm < 2 the
-        # other variant's compile unavoidably lands in the timed region).
+        # must compile BOTH: the FIRST warm step takes the no-fetch
+        # variant, the rest fetch — so the final warm step fences the
+        # device before t0 and no warmup execution leaks into the timed
+        # window. (With n_warm < 2 the no-fetch compile unavoidably
+        # lands in the timed region.)
         if args.fetch_every <= 1:
             do_fetch = True
         elif i < n_warm:
-            do_fetch = n_warm < 2 or i != n_warm - 1
+            do_fetch = not (i == 0 and n_warm >= 2)
         else:
             do_fetch = ((i + 1) % args.fetch_every == 0
                         or i == n_warm + n_timed - 1)

@@ -113,6 +113,24 @@ def pad_lod_feed(lod_tensor, bucket=True):
     inputs."""
     data = np.asarray(lod_tensor)
     lod = lod_tensor.lod()
+    # the pybind convention is OFFSETS ([0, 6, 12]), not lengths — a
+    # lengths list ([6, 6]) silently selects wrong rows, so enforce
+    # validity at EVERY level like the reference's CheckLoD
+    # (lod_tensor.cc): each level starts at 0 and is monotone; level i's
+    # last offset indexes level i+1's sequence count; the last level's
+    # last offset is the row count.
+    for li, level in enumerate(lod):
+        level = list(level)
+        end = (data.shape[0] if li == len(lod) - 1
+               else len(lod[li + 1]) - 1)
+        if (len(level) == 0 or level[0] != 0 or level[-1] != end
+                or any(level[i] > level[i + 1]
+                       for i in range(len(level) - 1))):
+            raise ValueError(
+                "invalid LoD level %d %r (expected offsets 0..%d): "
+                "lod() carries OFFSETS, not lengths (use "
+                "set_recursive_sequence_lengths for lengths)"
+                % (li, lod, end))
     offsets = lod[-1]
     lens = np.array([offsets[i + 1] - offsets[i]
                      for i in range(len(offsets) - 1)], dtype=np.int32)

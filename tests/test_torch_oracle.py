@@ -174,3 +174,60 @@ def test_layer_norm_affine():
     ref = TF.layer_norm(torch.tensor(h), (10,), torch.tensor(g),
                         torch.tensor(bb), 1e-5).numpy()
     np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_warpctc_vs_torch_ctc_loss():
+    """CTC forward algorithm vs torch.nn.functional.ctc_loss — exact
+    per-sequence negative log-likelihoods (reference warpctc_op.cc)."""
+    from tests.test_op_tail import run_op
+    rng = np.random.RandomState(0)
+    B, T, C = 2, 6, 5
+    logits = rng.randn(B, T, C).astype(np.float32)
+    labels = np.zeros((B, 3), np.int64)
+    labels[0, :2] = [1, 2]
+    labels[1, :3] = [3, 1, 4]
+    out = run_op("warpctc", {"Logits": logits, "Label": labels},
+                 {"blank": 0, "norm_by_times": False},
+                 lod={"Logits": np.array([6, 6], np.int32),
+                      "Label": np.array([2, 3], np.int32)})
+    got = np.asarray(out["Loss"]).ravel()
+    lp = TF.log_softmax(torch.tensor(logits).permute(1, 0, 2), dim=-1)
+    ref = TF.ctc_loss(lp, torch.tensor([1, 2, 3, 1, 4]),
+                      torch.tensor([6, 6]), torch.tensor([2, 3]),
+                      blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_softmax_with_cross_entropy_vs_torch():
+    rng = np.random.RandomState(6)
+    logits = rng.randn(5, 7).astype(np.float32)
+    lab = rng.randint(0, 7, (5, 1)).astype(np.int64)
+
+    def b():
+        xi = fluid.layers.data("l", shape=[7], dtype="float32")
+        yi = fluid.layers.data("y", shape=[1], dtype="int64")
+        return fluid.layers.softmax_with_cross_entropy(xi, yi)
+
+    got = np.asarray(_run(b, {"l": logits, "y": lab})).ravel()
+    ref = TF.cross_entropy(torch.tensor(logits), torch.tensor(lab[:, 0]),
+                           reduction="none").numpy()
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_lod_feed_rejects_lengths_passed_as_offsets():
+    """LoDTensor carries OFFSETS (pybind convention); feeding lengths
+    used to silently select wrong rows — now it raises (reference
+    lod_tensor.cc CheckLoD)."""
+    from paddle_tpu.fluid.lod import LoDTensor, pad_lod_feed
+    data = np.arange(12, dtype=np.float32).reshape(12, 1)
+    ok = pad_lod_feed(LoDTensor(data, [[0, 6, 12]]))
+    assert ok[0].shape[0] == 2
+    with pytest.raises(ValueError, match="OFFSETS"):
+        pad_lod_feed(LoDTensor(data, [[6, 6]]))
+    # ndarray levels stay accepted (pybind returns lists, tests use both)
+    assert pad_lod_feed(LoDTensor(data, [np.array([0, 6, 12])]))[0].shape[0] == 2
+    # 2-level: the OUTER level must be offsets too
+    assert list(pad_lod_feed(
+        LoDTensor(data, [[0, 2, 3], [0, 4, 6, 12]]))[2]) == [2, 1]
+    with pytest.raises(ValueError, match="OFFSETS"):
+        pad_lod_feed(LoDTensor(data, [[2], [0, 6, 12]]))

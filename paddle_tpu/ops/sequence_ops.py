@@ -1,8 +1,9 @@
 """Sequence (LoD/ragged) op lowerings + recurrent ops.
 
 Reference analogues: paddle/fluid/operators/sequence_ops/ (17 op families, all
-honoring the packed LoD layout), lstm_op.cc (dynamic LSTM: gate order i,f,c,o
-per lstm_op.cc:187-:218, optional peepholes), gru_op.cc, and the
+honoring the packed LoD layout), lstm_op.cc (dynamic LSTM: gate columns
+{c, i, f, o} per math/detail/lstm_cpu_kernel.h:44-47, optional peepholes),
+gru_op.cc ({u, r, c} columns, out = (1-u)*prev + u*cand), and the
 math/sequence2batch machinery that re-batches ragged rows per timestep.
 
 TPU encoding (SURVEY.md §5 long-context): a ragged var is a padded dense
@@ -427,7 +428,10 @@ def _lstm_scan(x, lens, w, bias, h0, c0, use_peepholes, is_reverse):
         h, c = carry
         xt, mt = inp
         gates = xt + h @ w + b_gate
-        i, f, cand, o = jnp.split(gates, 4, axis=-1)
+        # reference gate column layout: {candidate, input, forget,
+        # output} (math/detail/lstm_cpu_kernel.h:44-47; lstm_op.cc
+        # Weight doc "{W_ch, W_ih, W_fh, W_oh}")
+        cand, i, f, o = jnp.split(gates, 4, axis=-1)
         if use_peepholes:
             i = i + c * w_ic
             f = f + c * w_fc
@@ -498,7 +502,9 @@ def _gru_scan(x, lens, w, h0, is_reverse):
         rz = jax.nn.sigmoid(xrz + h @ w_rz)
         u, r = jnp.split(rz, 2, axis=-1)
         cand = jnp.tanh(xc + (r * h) @ w_c)
-        h_new = u * h + (1 - u) * cand
+        # reference: out = prev - u*prev + u*cand
+        # (math/detail/gru_kernel.h:62-63)
+        h_new = (1 - u) * h + u * cand
         h = mt * h_new + (1 - mt) * h
         return h, h * mt
 
@@ -741,7 +747,8 @@ def _lstm_unit(ctx):
     x = ctx.input("X")          # [B, 4H]
     c_prev = ctx.input("C_prev")
     forget_bias = ctx.attr("forget_bias", 0.0)
-    i, f, cand, o = jnp.split(x, 4, axis=-1)
+    # reference chunk order: {i, f, o, g} (lstm_unit_op.h:63-66)
+    i, f, o, cand = jnp.split(x, 4, axis=-1)
     i = jax.nn.sigmoid(i)
     f = jax.nn.sigmoid(f + forget_bias)
     cand = jnp.tanh(cand)
@@ -860,8 +867,12 @@ def _gru_unit(ctx):
     rz = gate_act(xrz + h_prev @ w[:, :2 * H])
     u, r = jnp.split(rz, 2, axis=-1)
     cand = act(xc + (r * h_prev) @ w[:, 2 * H:])
-    h = u * h_prev + (1 - u) * cand
-    return {"Hidden": h, "Gate": rz, "ResetHiddenPrev": r * h_prev}
+    # reference: h = u*(c - h_prev) + h_prev (gru_unit_op.h:116)
+    h = (1 - u) * h_prev + u * cand
+    # Gate is the full [B, 3H] {u, r, c} block after activations
+    # (gru_unit_op.h:99-113 activates all three slices in place)
+    gate = jnp.concatenate([rz, cand], axis=-1)
+    return {"Hidden": h, "Gate": gate, "ResetHiddenPrev": r * h_prev}
 
 
 # ---------------------------------------------------------------------------
@@ -913,7 +924,9 @@ def _lstmp(ctx):
         r, c = carry               # projection [B, P], cell [B, D]
         xt, mt = inp
         gates = xt + r @ w + b_gate
-        i, f, cand, o = jnp.split(gates, 4, axis=-1)
+        # same {c, i, f, o} gate columns as lstm (lstmp_op.h reuses the
+        # lstm math functors)
+        cand, i, f, o = jnp.split(gates, 4, axis=-1)
         if use_peepholes:
             i = i + c * w_ic
             f = f + c * w_fc
@@ -1048,7 +1061,9 @@ def _attention_lstm(ctx):
         alpha = jax.nn.softmax(score, axis=1) * valid    # [B, T]
         lstm_x = jnp.einsum("bt,btm->bm", alpha, x)      # [B, M]
         gates = h @ lw_h + lstm_x @ lw_x + lstm_b.reshape(1, -1)
-        i, f, cand, o = jnp.split(gates, 4, axis=-1)
+        # reference weight layout: {W_forget, W_input, W_output, W_cell}
+        # (attention_lstm_op.cc:166, kernel :382-397)
+        f, i, o, cand = jnp.split(gates, 4, axis=-1)
         # reference attention_lstm uses sigmoid gates + tanh cand (the
         # fused kernel's default act_gate/act_cell/act_cand)
         i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),

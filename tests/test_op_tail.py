@@ -157,7 +157,8 @@ def _np_lstmp(x, lens, w, w_proj, bias, D, P):
     projs = np.zeros((B, T, P), np.float32)
     for t in range(T):
         gates = x[:, t] + r @ w + bias[:, :4 * D]
-        i, f, cand, o = np.split(gates, 4, axis=-1)
+        # reference gate columns {c, i, f, o} (lstm_cpu_kernel.h:44-47)
+        cand, i, f, o = np.split(gates, 4, axis=-1)
         i, f, o = sig(i), sig(f), sig(o)
         c_new = f * c + i * np.tanh(cand)
         h_new = o * np.tanh(c_new)

@@ -231,3 +231,72 @@ def test_lod_feed_rejects_lengths_passed_as_offsets():
         LoDTensor(data, [[0, 2, 3], [0, 4, 6, 12]]))[2]) == [2, 1]
     with pytest.raises(ValueError, match="OFFSETS"):
         pad_lod_feed(LoDTensor(data, [[2], [0, 6, 12]]))
+
+
+def test_dynamic_lstm_gate_layout_vs_torch():
+    """The lstm op consumes gate columns in the REFERENCE order
+    {candidate, input, forget, output} (math/detail/lstm_cpu_kernel.h:
+    44-47). torch.nn.LSTM uses rows {i, f, g, o}; remapping torch's
+    weights into the reference layout must reproduce torch exactly — a
+    wrong column order shows O(1) divergence."""
+    from tests.test_op_tail import run_op
+    rng = np.random.RandomState(7)
+    B, T, I, H = 2, 5, 3, 4
+    x = rng.randn(B, T, I).astype(np.float32)
+    lstm = torch.nn.LSTM(I, H, batch_first=True)
+    with torch.no_grad():
+        ref_out, _ = lstm(torch.tensor(x))
+    ref = ref_out.numpy()
+
+    w_ih = lstm.weight_ih_l0.detach().numpy()   # [4H, I] rows i,f,g,o
+    w_hh = lstm.weight_hh_l0.detach().numpy()   # [4H, H]
+    b = (lstm.bias_ih_l0 + lstm.bias_hh_l0).detach().numpy()  # [4H]
+    ti, tf, tg, to = [np.arange(k * H, (k + 1) * H) for k in range(4)]
+    order = np.concatenate([tg, ti, tf, to])    # torch rows -> {c,i,f,o}
+    x_proj = np.einsum("bti,hi->bth", x, w_ih[order])   # [B,T,4H]
+    weight = w_hh[order].T.astype(np.float32)           # [H, 4H]
+    bias = b[order].reshape(1, 4 * H).astype(np.float32)
+    out = run_op("lstm", {"Input": x_proj.astype(np.float32),
+                          "Weight": weight, "Bias": bias},
+                 {"use_peepholes": False},
+                 lod={"Input": np.full(B, T, np.int32)})
+    np.testing.assert_allclose(np.asarray(out["Hidden"]), ref, atol=2e-6)
+
+
+def test_dynamic_gru_update_gate_vs_torch():
+    """GRU output is out = (1-u)*prev + u*cand (math/detail/gru_kernel.h:
+    62-63) with gate columns {u, r, c}. torch's z plays the keep-previous
+    role (h' = (1-z)n + z h), so u = sigmoid(-z_logits): negating
+    torch's z weights must reproduce torch exactly. Two documented
+    semantic gaps are neutralized to isolate the update-gate direction:
+    paddle resets hidden BEFORE the candidate matmul (gru_unit_op.h:104
+    r_h_p = r*h then GEMM) while torch resets after — equal iff W_hn is
+    diagonal — and torch couples b_hn inside r*(...), so b_hn = 0."""
+    from tests.test_op_tail import run_op
+    rng = np.random.RandomState(8)
+    B, T, I, H = 2, 5, 3, 4
+    x = rng.randn(B, T, I).astype(np.float32)
+    gru = torch.nn.GRU(I, H, batch_first=True)
+    with torch.no_grad():
+        gru.bias_hh_l0[2 * H:] = 0.0    # b_hn = 0 (see docstring)
+        gru.weight_hh_l0[2 * H:] = torch.diag(
+            torch.tensor(rng.rand(H).astype(np.float32) + 0.5))
+        ref_out, _ = gru(torch.tensor(x))
+    ref = ref_out.numpy()
+
+    w_ih = gru.weight_ih_l0.detach().numpy()    # [3H, I] rows r,z,n
+    w_hh = gru.weight_hh_l0.detach().numpy()
+    b_ih = gru.bias_ih_l0.detach().numpy()
+    b_hh = gru.bias_hh_l0.detach().numpy()
+    r_, z_, n_ = [np.arange(k * H, (k + 1) * H) for k in range(3)]
+
+    # our columns {u, r, c}: u = -z (logit negation), r = r, c = n
+    wx = np.concatenate([-w_ih[z_], w_ih[r_], w_ih[n_]]).astype(np.float32)
+    wh = np.concatenate([-w_hh[z_], w_hh[r_], w_hh[n_]]).astype(np.float32)
+    bx = np.concatenate([-(b_ih[z_] + b_hh[z_]), b_ih[r_] + b_hh[r_],
+                         b_ih[n_]]).astype(np.float32)
+    x_proj = (np.einsum("bti,hi->bth", x, wx)
+              + bx.reshape(1, 1, 3 * H)).astype(np.float32)
+    out = run_op("gru", {"Input": x_proj, "Weight": wh.T.copy()},
+                 {}, lod={"Input": np.full(B, T, np.int32)})
+    np.testing.assert_allclose(np.asarray(out["Hidden"]), ref, atol=2e-6)

@@ -1035,7 +1035,9 @@ def image_resize(input, out_shape=None, scale=None, name=None,
     helper.append_op(type=op_type, inputs={"X": input},
                      outputs={"Out": out},
                      attrs={"out_h": int(out_shape[0]),
-                            "out_w": int(out_shape[1])})
+                            "out_w": int(out_shape[1]),
+                            "align_corners": align_corners,
+                            "align_mode": align_mode})
     return out
 
 

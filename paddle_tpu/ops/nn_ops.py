@@ -538,26 +538,63 @@ def _accuracy(ctx):
 # image ops used by detection/vision models
 # ---------------------------------------------------------------------------
 
+def _interp_grid(ctx, in_sz, out_sz):
+    """Source grid for the interpolate family. The 2018 reference op is
+    unconditionally align-corners (interpolate_op.h:171-174: ratio =
+    (in-1)/(out-1), src = ratio*dst) and has no attr; the layer API also
+    accepts the later-era align_corners=False with align_mode 0
+    (half-pixel: src = ratio*(dst+0.5)-0.5) / 1 (src = ratio*dst,
+    ratio = in/out), honored here."""
+    jnp = _jnp()
+    dst = jnp.arange(out_sz, dtype=jnp.float32)
+    if ctx.attr("align_corners", True):
+        ratio = (in_sz - 1) / (out_sz - 1) if out_sz > 1 else 0.0
+        return dst * jnp.float32(ratio)
+    ratio = in_sz / out_sz
+    if ctx.attr("align_mode", 1) == 0:
+        return jnp.maximum(dst * jnp.float32(ratio)
+                           + jnp.float32(0.5 * ratio - 0.5), 0.0)
+    return dst * jnp.float32(ratio)
+
+
 @register_op("bilinear_interp")
 def _bilinear_interp(ctx):
-    import jax
     jnp = _jnp()
     x = ctx.input("X")  # NCHW
     out_h = ctx.attr("out_h")
     out_w = ctx.attr("out_w")
-    return {"Out": jax.image.resize(
-        x, (x.shape[0], x.shape[1], out_h, out_w), method="bilinear"
-    ).astype(x.dtype)}
+    H, W = x.shape[2], x.shape[3]
+    y = _interp_grid(ctx, H, out_h)
+    xw = _interp_grid(ctx, W, out_w)
+    y0 = jnp.minimum(jnp.floor(y).astype(jnp.int32), H - 1)
+    x0 = jnp.minimum(jnp.floor(xw).astype(jnp.int32), W - 1)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    dy = (y - y0).astype(x.dtype)[:, None]        # [out_h, 1]
+    dx = (xw - x0).astype(x.dtype)[None, :]       # [1, out_w]
+    # gather the four corner planes at [B, C, out_h, out_w] directly
+    # (full-width row intermediates would be W/out_w times larger)
+    yg0, yg1 = y0[:, None], y1[:, None]           # [out_h, 1]
+    xg0, xg1 = x0[None, :], x1[None, :]           # [1, out_w]
+    tl, tr = x[:, :, yg0, xg0], x[:, :, yg0, xg1]
+    bl, br = x[:, :, yg1, xg0], x[:, :, yg1, xg1]
+    top = tl * (1 - dx) + tr * dx
+    bot = bl * (1 - dx) + br * dx
+    return {"Out": (top * (1 - dy) + bot * dy).astype(x.dtype)}
 
 
 @register_op("nearest_interp")
 def _nearest_interp(ctx):
-    import jax
+    jnp = _jnp()
     x = ctx.input("X")
     out_h, out_w = ctx.attr("out_h"), ctx.attr("out_w")
-    return {"Out": jax.image.resize(
-        x, (x.shape[0], x.shape[1], out_h, out_w), method="nearest"
-    ).astype(x.dtype)}
+    H, W = x.shape[2], x.shape[3]
+    # reference rounds the source grid (interpolate_op.h:33)
+    yi = jnp.clip((_interp_grid(ctx, H, out_h) + 0.5).astype(jnp.int32),
+                  0, H - 1)
+    xi = jnp.clip((_interp_grid(ctx, W, out_w) + 0.5).astype(jnp.int32),
+                  0, W - 1)
+    return {"Out": x[:, :, yi][..., xi]}
 
 
 @register_op("pad2d")

@@ -300,3 +300,45 @@ def test_dynamic_gru_update_gate_vs_torch():
     out = run_op("gru", {"Input": x_proj, "Weight": wh.T.copy()},
                  {}, lod={"Input": np.full(B, T, np.int32)})
     np.testing.assert_allclose(np.asarray(out["Hidden"]), ref, atol=2e-6)
+
+
+def test_bilinear_interp_align_corners_vs_torch():
+    """The reference interpolate op uses the align-corners grid
+    (interpolate_op.h:171-174), matching torch align_corners=True —
+    jax.image.resize's half-pixel mapping diverges O(0.1)."""
+    from tests.test_op_tail import run_op
+    x = np.random.RandomState(0).randn(2, 3, 5, 7).astype(np.float32)
+    out = np.asarray(run_op("bilinear_interp", {"X": x},
+                            {"out_h": 9, "out_w": 4})["Out"])
+    ref = TF.interpolate(torch.tensor(x), size=(9, 4), mode="bilinear",
+                         align_corners=True).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_nearest_interp_reference_rounding():
+    """Nearest uses round(align-corners grid) (interpolate_op.h:33)."""
+    from tests.test_op_tail import run_op
+    x = np.random.RandomState(1).randn(1, 2, 5, 7).astype(np.float32)
+    out = np.asarray(run_op("nearest_interp", {"X": x},
+                            {"out_h": 9, "out_w": 4})["Out"])
+    rh, rw = (5 - 1) / (9 - 1), (7 - 1) / (4 - 1)
+    for k in range(9):
+        for l in range(4):
+            np.testing.assert_array_equal(
+                out[:, :, k, l],
+                x[:, :, min(int(rh * k + 0.5), 4),
+                  min(int(rw * l + 0.5), 6)])
+
+
+def test_bilinear_interp_half_pixel_mode():
+    """align_corners=False + align_mode=0 is the half-pixel grid —
+    matches torch align_corners=False."""
+    from tests.test_op_tail import run_op
+    x = np.random.RandomState(2).randn(2, 3, 5, 7).astype(np.float32)
+    out = np.asarray(run_op(
+        "bilinear_interp", {"X": x},
+        {"out_h": 9, "out_w": 4, "align_corners": False,
+         "align_mode": 0})["Out"])
+    ref = TF.interpolate(torch.tensor(x), size=(9, 4), mode="bilinear",
+                         align_corners=False).numpy()
+    np.testing.assert_allclose(out, ref, atol=2e-6)

@@ -122,9 +122,11 @@ def _adamax(ctx):
     eps = ctx.attr("epsilon", 1e-8)
     lr = _lr(ctx)
     m_out = beta1 * m + (1 - beta1) * g
-    inf_out = jnp.maximum(beta2 * inf, jnp.abs(g))
+    # reference folds epsilon INSIDE the max (adamax_op.h:68-69):
+    # inf_out = max(|g|, beta2*inf + eps); denominator takes no extra eps
+    inf_out = jnp.maximum(jnp.abs(g), beta2 * inf + eps)
     lr_t = lr / (1 - b1p.reshape(()))
-    p_out = p - lr_t.astype(p.dtype) * m_out / (inf_out + eps)
+    p_out = p - lr_t.astype(p.dtype) * m_out / inf_out
     return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out}
 
 
@@ -214,10 +216,11 @@ def _ftrl(ctx):
         lin_delta = g - (new_accum ** (-lr_power) -
                          sq_accum ** (-lr_power)) / lr * p
     lin_out = lin_accum + lin_delta
+    # reference shrink denominator carries 2*l2 (ftrl_op.h:87-95)
     if lr_power == -0.5:
-        x = l2 + jnp.sqrt(new_accum) / lr
+        x = 2 * l2 + jnp.sqrt(new_accum) / lr
     else:
-        x = l2 + new_accum ** (-lr_power) / lr
+        x = 2 * l2 + new_accum ** (-lr_power) / lr
     pre_shrink = (jnp.sign(lin_out) * l1 - lin_out) / x
     p_out = jnp.where(jnp.abs(lin_out) > l1, pre_shrink,
                       jnp.zeros_like(p))

@@ -1,0 +1,144 @@
+"""Exact single-step parity of every optimizer op against numpy
+restatements transcribed from the reference kernels
+(operators/optimizers/*.h) — convergence tests can't catch a wrong
+epsilon placement or a missing factor (e.g. ftrl's 2*l2)."""
+
+import numpy as np
+import pytest
+
+from tests.test_op_tail import run_op
+
+rng = np.random.RandomState(0)
+N = 7
+P = rng.randn(N).astype(np.float32)
+G = rng.randn(N).astype(np.float32)
+LR = np.array([0.1], np.float32)
+
+
+def _o(name, inputs, attrs=None):
+    inputs = dict(inputs)
+    inputs.setdefault("LearningRate", LR)
+    return {k: np.asarray(v) for k, v in
+            run_op(name, inputs, attrs or {}).items()}
+
+
+def test_sgd():
+    out = _o("sgd", {"Param": P, "Grad": G})
+    np.testing.assert_allclose(out["ParamOut"], P - 0.1 * G, rtol=1e-6)
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_momentum(nesterov):
+    v = rng.rand(N).astype(np.float32)
+    out = _o("momentum", {"Param": P, "Grad": G, "Velocity": v},
+             {"mu": 0.9, "use_nesterov": nesterov})
+    v_out = 0.9 * v + G
+    ref = P - (G + 0.9 * v_out) * 0.1 if nesterov else P - 0.1 * v_out
+    np.testing.assert_allclose(out["VelocityOut"], v_out, rtol=1e-6)
+    np.testing.assert_allclose(out["ParamOut"], ref, rtol=1e-6)
+
+
+def test_lars_momentum():
+    v = rng.rand(N).astype(np.float32)
+    out = _o("lars_momentum", {"Param": P, "Grad": G, "Velocity": v},
+             {"mu": 0.9, "lars_coeff": 0.001, "lars_weight_decay": 0.0005})
+    pn, gn = np.linalg.norm(P), np.linalg.norm(G)
+    llr = 0.1 * 0.001 * pn / (gn + 0.0005 * pn)
+    v_out = 0.9 * v + llr * (G + 0.0005 * P)
+    np.testing.assert_allclose(out["ParamOut"], P - v_out, rtol=1e-5)
+
+
+def test_adam():
+    m1 = rng.rand(N).astype(np.float32)
+    m2 = rng.rand(N).astype(np.float32)
+    out = _o("adam", {"Param": P, "Grad": G, "Moment1": m1, "Moment2": m2,
+                      "Beta1Pow": np.array([0.9 ** 3], np.float32),
+                      "Beta2Pow": np.array([0.999 ** 3], np.float32)},
+             {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8})
+    m1o = 0.9 * m1 + 0.1 * G
+    m2o = 0.999 * m2 + 0.001 * G * G
+    lr_t = 0.1 * np.sqrt(1 - 0.999 ** 3) / (1 - 0.9 ** 3)
+    ref = P - lr_t * m1o / (np.sqrt(m2o) + 1e-8)
+    np.testing.assert_allclose(out["ParamOut"], ref, rtol=1e-5)
+
+
+def test_adamax_epsilon_inside_max():
+    """adamax_op.h:68-69: inf_out = max(|g|, beta2*inf + eps); the
+    denominator takes NO extra epsilon."""
+    m = rng.rand(N).astype(np.float32)
+    inf = rng.rand(N).astype(np.float32)
+    out = _o("adamax", {"Param": P, "Grad": G, "Moment": m, "InfNorm": inf,
+                        "Beta1Pow": np.array([0.9 ** 2], np.float32)},
+             {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8})
+    m_out = 0.9 * m + 0.1 * G
+    inf_out = np.maximum(np.abs(G), 0.999 * inf + 1e-8)
+    ref = P - (0.1 / (1 - 0.9 ** 2)) * m_out / inf_out
+    np.testing.assert_allclose(out["InfNormOut"], inf_out, rtol=1e-6)
+    np.testing.assert_allclose(out["ParamOut"], ref, rtol=1e-5)
+
+
+def test_adagrad():
+    m = rng.rand(N).astype(np.float32)
+    out = _o("adagrad", {"Param": P, "Grad": G, "Moment": m},
+             {"epsilon": 1e-6})
+    m_out = m + G * G
+    ref = P - 0.1 * G / (np.sqrt(m_out) + 1e-6)
+    np.testing.assert_allclose(out["ParamOut"], ref, rtol=1e-5)
+
+
+def test_decayed_adagrad():
+    m = rng.rand(N).astype(np.float32)
+    out = _o("decayed_adagrad", {"Param": P, "Grad": G, "Moment": m},
+             {"decay": 0.95, "epsilon": 1e-6})
+    m_out = 0.95 * m + 0.05 * G * G
+    ref = P - 0.1 * G / (np.sqrt(m_out) + 1e-6)
+    np.testing.assert_allclose(out["ParamOut"], ref, rtol=1e-5)
+
+
+def test_adadelta():
+    ag = rng.rand(N).astype(np.float32)
+    au = rng.rand(N).astype(np.float32)
+    out = _o("adadelta", {"Param": P, "Grad": G, "AvgSquaredGrad": ag,
+                          "AvgSquaredUpdate": au},
+             {"rho": 0.95, "epsilon": 1e-6})
+    ago = 0.95 * ag + 0.05 * G * G
+    upd = -np.sqrt((au + 1e-6) / (ago + 1e-6)) * G
+    np.testing.assert_allclose(out["ParamOut"], P + upd, rtol=1e-5)
+    np.testing.assert_allclose(out["AvgSquaredUpdateOut"],
+                               0.95 * au + 0.05 * upd * upd, rtol=1e-5)
+
+
+@pytest.mark.parametrize("centered", [False, True])
+def test_rmsprop(centered):
+    ms = rng.rand(N).astype(np.float32)
+    mom = rng.rand(N).astype(np.float32)
+    mg = rng.randn(N).astype(np.float32) * 0.1
+    ins = {"Param": P, "Grad": G, "MeanSquare": ms, "Moment": mom}
+    if centered:
+        ins["MeanGrad"] = mg
+    out = _o("rmsprop", ins, {"decay": 0.95, "epsilon": 1e-6,
+                              "momentum": 0.8, "centered": centered})
+    ms_out = 0.95 * ms + 0.05 * G * G
+    if centered:
+        mg_out = 0.95 * mg + 0.05 * G
+        denom = ms_out - mg_out * mg_out + 1e-6
+    else:
+        denom = ms_out + 1e-6
+    mom_out = 0.8 * mom + 0.1 * G / np.sqrt(denom)
+    np.testing.assert_allclose(out["ParamOut"], P - mom_out, rtol=1e-5)
+
+
+def test_ftrl_two_l2():
+    """ftrl_op.h:87-95: the shrink denominator is sqrt(acc)/lr + 2*l2."""
+    sq = rng.rand(N).astype(np.float32)
+    lin = rng.randn(N).astype(np.float32)
+    l1, l2 = 0.1, 0.2
+    out = _o("ftrl", {"Param": P, "Grad": G, "SquaredAccumulator": sq,
+                      "LinearAccumulator": lin},
+             {"l1": l1, "l2": l2, "lr_power": -0.5})
+    new_acc = sq + G * G
+    lin_out = lin + G - (np.sqrt(new_acc) - np.sqrt(sq)) / 0.1 * P
+    y = np.sqrt(new_acc) / 0.1 + 2 * l2
+    pre = (np.sign(lin_out) * l1 - lin_out) / y
+    ref = np.where(np.abs(lin_out) > l1, pre, 0.0)
+    np.testing.assert_allclose(out["ParamOut"], ref, rtol=1e-5)

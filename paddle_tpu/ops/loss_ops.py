@@ -574,15 +574,23 @@ def _nce(ctx):
     logits = jnp.einsum("bd,bsd->bs", x, sw)
     if bias is not None:
         logits = logits + jnp.take(bias.reshape(-1), samples)
-    adj = logits - log_q_of(samples)                    # s - log(k * q(y))
-    pos = -jax.nn.log_sigmoid(adj[:, :num_true]).sum(axis=1)
-    # -log(1 - sigmoid(z)) == softplus(z), exact and gradient-stable
-    negl = jnp.logaddexp(0.0, adj[:, num_true:]).sum(axis=1)
+    # reference formula (nce_op.h:140-151): o = sigmoid(logit) is the
+    # model probability, b = num_neg * q(y); cost = -log(o/(o+b)) for
+    # true classes, -log(b/(o+b)) for sampled negatives. (NOT the
+    # logit-minus-log-q form: o/(o+b) = 1/(1 + b + b*e^-s) differs from
+    # sigmoid(s - log b) = 1/(1 + b*e^-s).)
+    log_b = log_q_of(samples)                           # log(num_neg*q)
+    log_o = jax.nn.log_sigmoid(logits)                  # log sigmoid, stable
+    log_ob = jnp.logaddexp(log_o, log_b)                # log(o + b)
+    pos = (log_ob - log_o)[:, :num_true].sum(axis=1)
+    negl = (log_ob - log_b)[:, num_true:].sum(axis=1)
     cost = (pos + negl)[:, None]
     sw = ctx.input("SampleWeight")
     if sw is not None:
         cost = cost * sw.reshape(-1, 1)
-    return {"Cost": cost, "SampleLogits": logits,
+    # reference SampleLogits holds the post-sigmoid sample outputs
+    # (nce_op.h:141 overwrites in place)
+    return {"Cost": cost, "SampleLogits": jax.nn.sigmoid(logits),
             "SampleLabels": samples.astype(jnp.int64)}
 
 
@@ -607,6 +615,7 @@ def _hsigmoid(ctx):
     code_len = (jnp.floor(jnp.log2(c.astype(jnp.float32)) + 1e-6)
                 .astype(jnp.int32))                     # path edges count
     loss = jnp.zeros(x.shape[0], jnp.float32)
+    pre_cols = []
     for j in range(max_len):
         # depth-j edge: parent node is c's bit-prefix above position `shift`,
         # the branch taken is bit `shift` itself (SimpleCode calc_index(b) =
@@ -621,11 +630,16 @@ def _hsigmoid(ctx):
         if bias is not None:
             pre = pre + jnp.take(bias.reshape(-1),
                                  jnp.clip(node, 0, w.shape[0] - 1))
-        # sigmoid cross entropy with target = bit
-        step_loss = jnp.logaddexp(0.0, pre) - bit.astype(jnp.float32) * pre
+        # reference clips pre to [-40, 40], then loss = softrelu(pre) -
+        # bit*pre, and PreOut holds the in-place softrelu values
+        # (hierarchical_sigmoid_op.h:66-75)
+        pre = jnp.clip(pre, -40.0, 40.0)
+        soft = jnp.logaddexp(0.0, pre)
+        step_loss = soft - bit.astype(jnp.float32) * pre
         loss = loss + jnp.where(valid, step_loss, 0.0)
+        pre_cols.append(jnp.where(valid, soft, 0.0))
     return {"Out": loss[:, None],
-            "PreOut": jnp.zeros((x.shape[0], max_len), jnp.float32)}
+            "PreOut": jnp.stack(pre_cols, axis=1)}
 
 
 # ---------------------------------------------------------------------------

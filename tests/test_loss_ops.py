@@ -463,3 +463,60 @@ def test_sampling_id():
     exe.run(startup)
     (res,) = exe.run(main, feed={"x": p}, fetch_list=[out])
     np.testing.assert_array_equal(np.asarray(res).reshape(-1), [1, 0])
+
+
+
+
+def test_nce_cost_matches_reference_formula():
+    """nce_op.h:140-151: o = sigmoid(sample logit), b = num_neg * q(y);
+    per-sample cost = -log(o/(o+b)) (true) / -log(b/(o+b)) (negative).
+    Recomputed in numpy from the op's own sampled labels."""
+    from tests.test_op_tail import run_op
+    rng2 = np.random.RandomState(3)
+    B, D, C, K = 4, 5, 11, 6
+    x = rng2.randn(B, D).astype(np.float32)
+    w = rng2.randn(C, D).astype(np.float32)
+    bias = rng2.randn(C).astype(np.float32)
+    lab = rng2.randint(0, C, (B, 1)).astype(np.int64)
+    out = run_op("nce", {"Input": x, "Label": lab, "Weight": w,
+                         "Bias": bias},
+                 {"num_neg_samples": K, "num_total_classes": C,
+                  "sampler": 0})
+    samples = np.asarray(out["SampleLabels"])            # [B, 1+K]
+    cost = np.asarray(out["Cost"]).ravel()
+    b_const = K / float(C)                               # uniform q
+    ref = np.zeros(B)
+    for i in range(B):
+        for j, t in enumerate(samples[i]):
+            o = 1.0 / (1.0 + np.exp(-(x[i] @ w[t] + bias[t])))
+            ref[i] += (-np.log(o / (o + b_const)) if j < 1
+                       else -np.log(b_const / (o + b_const)))
+    np.testing.assert_allclose(cost, ref, rtol=1e-5)
+    # SampleLogits holds post-sigmoid outputs (nce_op.h:141)
+    sl = np.asarray(out["SampleLogits"])
+    assert np.all(sl > 0) and np.all(sl < 1)
+
+
+def test_hsigmoid_preout_holds_softrelu_values():
+    """PreOut mirrors the reference's in-place softrelu(clip(pre))
+    (hierarchical_sigmoid_op.h:66-75): always >= 0, log(1+e^pre) at
+    valid path positions, 0 padding beyond each label's code length."""
+    from tests.test_op_tail import run_op
+    rng = np.random.RandomState(5)
+    B, D, C = 3, 4, 6
+    x = rng.randn(B, D).astype(np.float32)
+    w = rng.randn(C - 1, D).astype(np.float32)
+    lab = rng.randint(0, C, (B, 1)).astype(np.int64)
+    out = run_op("hierarchical_sigmoid", {"X": x, "W": w, "Label": lab},
+                 {"num_classes": C})
+    pre_out = np.asarray(out["PreOut"])
+    assert np.all(pre_out >= 0)
+    for i in range(B):
+        c = int(lab[i, 0]) + C
+        code_len = int(np.floor(np.log2(c)))
+        for j, shift in enumerate(range(code_len - 1, -1, -1)):
+            node = (c >> (shift + 1)) - 1
+            pre = float(x[i] @ w[node])
+            np.testing.assert_allclose(pre_out[i, j],
+                                       np.logaddexp(0.0, pre), rtol=1e-5)
+        assert np.all(pre_out[i, code_len:] == 0)

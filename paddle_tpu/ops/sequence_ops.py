@@ -192,24 +192,63 @@ def _sequence_expand(ctx):
         # i*k..i*k+k-1, each masked to Y's per-row length.
         xlens = ctx.lod_len("X")
         Bx, By = x.shape[0], y.shape[0]
-        if By % Bx != 0:
-            raise NotImplementedError(
-                "sequence_expand of ragged X needs a data-dependent output "
-                "row count (an XLA-static-shape limit) unless Y's rows are "
-                "a static multiple of X's (got X rows %d, Y rows %d)"
-                % (Bx, By))
-        k = By // Bx
-        out = jnp.repeat(x, k, axis=0)            # [By, Tx, ...]
-        Tx, Ty = x.shape[1], y.shape[1]
-        if Ty <= Tx:
-            out = out[:, :Ty]
+        import jax
+
+        def conform(out, out_lens, xa_ndim):
+            """Pad/trim the time axis to Y's padded width Ty so downstream
+            elementwise ops against Y line up; trimming may only remove
+            padding (the reference's packed layout has no width notion)."""
+            Ty = y.shape[1]
+            if Ty >= out.shape[1]:
+                pad = [(0, 0), (0, Ty - out.shape[1])] + \
+                    [(0, 0)] * (xa_ndim - 2)
+                return jnp.pad(out, pad), out_lens
+            max_len = jnp.max(out_lens) if out_lens.shape[0] else 0
+            if isinstance(max_len, jax.core.Tracer) or int(max_len) <= Ty:
+                return out[:, :Ty], jnp.minimum(out_lens, Ty)
+            raise ValueError(
+                "sequence_expand: Y's padded width %d cannot hold the "
+                "expanded sequences (max length %d)" % (Ty, int(max_len)))
+
+        seg = ctx.lod_seg("Y")
+        concrete_seg = (seg is not None
+                        and not isinstance(x, jax.core.Tracer)
+                        and not isinstance(seg, jax.core.Tracer))
+        if concrete_seg:
+            # general per-sequence repeat counts (the reference's
+            # ref_level semantics, sequence_expand_op.h:109-118): Y is
+            # nested, its outer counts say how often each X sequence
+            # repeats; the output keeps X's OWN inner lengths, repeated.
+            # Data-dependent row count -> concrete (host/eager) only.
+            counts = np.asarray(seg).astype(np.int64)
+            if len(counts) != Bx or counts.sum() != By:
+                raise ValueError(
+                    "sequence_expand: Y's outer counts %r do not match "
+                    "X's %d sequences / Y's %d rows"
+                    % (counts.tolist(), Bx, By))
+            xa = np.asarray(x)
+            xl = (np.asarray(xlens) if xlens is not None
+                  else np.full((Bx,), xa.shape[1], np.int32))
+            out = jnp.asarray(np.repeat(xa, counts, axis=0))
+            out_lens = jnp.asarray(np.repeat(xl, counts).astype(np.int32))
         else:
-            pad = [(0, 0), (0, Ty - Tx)] + [(0, 0)] * (x.ndim - 2)
-            out = jnp.pad(out, pad)
-        out_lens = jnp.minimum(
-            jnp.repeat(xlens, k, axis=0) if xlens is not None
-            else jnp.full((By,), Tx, jnp.int32), ylens)
-        m = _expand_mask(_mask(out_lens, Ty, x.dtype), out)
+            if By % Bx != 0:
+                raise NotImplementedError(
+                    "sequence_expand of ragged X needs a data-dependent "
+                    "output row count (an XLA-static-shape limit) unless "
+                    "Y's rows are a static multiple of X's (got X rows "
+                    "%d, Y rows %d) — or run on the host path with a "
+                    "nested Y carrying per-group repeat counts"
+                    % (Bx, By))
+            # static multiple (beam-style): row i of X tiles to output
+            # rows i*k..i*k+k-1, keeping X's own lengths (the reference
+            # builds out_lod from x_seq_len, sequence_expand_op.h:115)
+            k = By // Bx
+            out = jnp.repeat(x, k, axis=0)            # [By, Tx, ...]
+            out_lens = (jnp.repeat(xlens, k, axis=0) if xlens is not None
+                        else jnp.full((By,), x.shape[1], jnp.int32))
+        out, out_lens = conform(out, out_lens, x.ndim)
+        m = _expand_mask(_mask(out_lens, out.shape[1], out.dtype), out)
         return {"Out": out * m, "Out@LOD_LEN": out_lens}
     # dense X [B, D] -> ragged [B, Ty, D] tiling each row along time
     T = y.shape[1]

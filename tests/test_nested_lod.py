@@ -299,3 +299,47 @@ def test_subsequence_input_trains_through_upstream_layer():
     # nested_to_outer's explicit host-side grad
     assert any(np.abs(np.array(params.get(n)) - w0[n]).max() > 0.05
                for n in params.names() if n.startswith("fc"))
+
+
+def test_sequence_expand_general_counts_on_host():
+    """General ref_level expansion (sequence_expand_op.h): a nested Y
+    carries per-group repeat counts that are NOT a uniform multiple —
+    served with concrete arrays (host path)."""
+    from tests.test_op_tail import run_op
+    x = np.array([[[1.0], [2.0]],
+                  [[3.0], [0.0]],
+                  [[5.0], [6.0]]], np.float32)        # 3 seqs, T=2
+    xlens = np.array([2, 1, 2], np.int32)
+    # counts 2,1,3 -> 6 output rows (not a multiple of 3)
+    By, Ty = 6, 2
+    y = np.zeros((By, Ty, 1), np.float32)
+    ylens = np.array([2, 1, 1, 2, 2, 2], np.int32)
+    # run_op has no @LOD_SEG plumbing: drive via ExecContext with seg
+    import jax.numpy as jnp
+    from tests.test_op_tail import _FakeOp, ops, ExecContext
+    vals = {"X": [jnp.asarray(x)], "Y": [jnp.asarray(y)],
+            "X@LOD_LEN": [jnp.asarray(xlens)],
+            "Y@LOD_LEN": [jnp.asarray(ylens)],
+            "Y@LOD_SEG": [jnp.asarray(np.array([2, 1, 3], np.int32))]}
+    op = _FakeOp("sequence_expand", attrs={},
+                 inputs={"X": ["X"], "Y": ["Y"]})
+    od = ops.get_op_def("sequence_expand")
+    got = ops.call_lower(od, ExecContext(op, vals))
+    o = np.asarray(got["Out"])
+    lens = np.asarray(got["Out@LOD_LEN"])
+    assert o.shape[0] == 6
+    # x seq0 twice, seq1 once, seq2 three times; lengths are X's own,
+    # repeated (Y's ref-level lod only supplies counts)
+    np.testing.assert_array_equal(lens, [2, 2, 1, 2, 2, 2])
+    np.testing.assert_allclose(o[0, :, 0], [1.0, 2.0])
+    np.testing.assert_allclose(o[1, :, 0], [1.0, 2.0])
+    np.testing.assert_allclose(o[2, :, 0], [3.0, 0.0])
+    np.testing.assert_allclose(o[3, :, 0], [5.0, 6.0])
+
+    # corrupt counts are rejected with a clear error
+    import pytest
+    bad = dict(vals)
+    bad["Y@LOD_SEG"] = [jnp.asarray(np.array([2, 1], np.int32))]
+    from paddle_tpu.ops.registry import OpError
+    with pytest.raises(OpError, match="outer counts"):
+        ops.call_lower(od, ExecContext(op, bad))

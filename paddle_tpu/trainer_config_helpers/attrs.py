@@ -1,10 +1,10 @@
 """v1 attribute names (reference trainer_config_helpers/attrs.py)."""
 
 from ..v2.attr import (ParameterAttribute,  # noqa: F401
-                       ExtraLayerAttribute)
+                       ExtraLayerAttribute, HookAttribute)
 
-__all__ = ["ParameterAttribute", "ExtraLayerAttribute", "ParamAttr",
-           "ExtraAttr"]
+__all__ = ["ParameterAttribute", "ExtraLayerAttribute", "HookAttribute",
+           "ParamAttr", "ExtraAttr"]
 
 ParamAttr = ParameterAttribute
 ExtraAttr = ExtraLayerAttribute

@@ -7,7 +7,8 @@ from ..fluid.param_attr import ParamAttr
 from ..fluid import initializer as _init
 from ..fluid import regularizer as _reg
 
-__all__ = ["Param", "Extra", "ParameterAttribute", "ExtraLayerAttribute",
+__all__ = ["Param", "Extra", "Hook", "HookAttribute",
+           "ParameterAttribute", "ExtraLayerAttribute",
            "ExtraAttr", "ParamAttr"]
 
 
@@ -18,8 +19,10 @@ class ParameterAttribute(object):
                  initial_mean=None, initial_max=None, initial_min=None,
                  l1_rate=None, l2_rate=None, learning_rate=1.0,
                  momentum=None, gradient_clipping_threshold=None,
-                 sparse_update=False, initializer=None):
+                 sparse_update=False, initializer=None,
+                 update_hooks=None):
         self.name = name
+        self.update_hooks = update_hooks
         self.is_static = is_static
         self.initial_std = initial_std
         self.initial_mean = initial_mean
@@ -78,3 +81,22 @@ def lower_param_attr(attr, default_name=None):
     if isinstance(attr, ParameterAttribute):
         return attr.to_fluid(default_name)
     return attr
+
+
+class HookAttribute(object):
+    """Parameter update hook (reference trainer_config_helpers/attrs.py:59
+    HookAttribute; v2 re-exports it as Hook). Accepted via
+    ParameterAttribute(update_hooks=...) for config parity — the
+    'pruning' schedule itself (zeroing the smallest-magnitude
+    sparsity_ratio fraction during training, the reference's
+    ParameterPruningHook) is not executed by this engine."""
+
+    def __init__(self, type, sparsity_ratio=None):
+        self.type = type
+        self.sparsity_ratio = sparsity_ratio
+        if self.sparsity_ratio is not None:
+            assert 0 <= self.sparsity_ratio <= 1, \
+                "sparsity_ratio must be in [0, 1]"
+
+
+Hook = HookAttribute

@@ -5,7 +5,8 @@ implemented directly on numpy arrays."""
 import numpy as np
 
 __all__ = [
-    "load_image", "resize_short", "to_chw", "center_crop", "random_crop",
+    "load_image", "load_image_bytes", "resize_short", "to_chw",
+    "center_crop", "random_crop",
     "left_right_flip", "simple_transform", "load_and_transform",
     "batch_images_from_tar",
 ]
@@ -16,10 +17,7 @@ def load_image(file_path, is_color=True):
     stdlib can decode (PPM/PGM via manual parse); for arbitrary JPEG/PNG the
     caller should hand in arrays directly (zero-egress image: no cv2)."""
     with open(file_path, "rb") as f:
-        data = f.read()
-    if data[:2] in (b"P5", b"P6"):
-        return _parse_pnm(data)
-    raise ValueError("unsupported image format; pass numpy arrays instead")
+        return load_image_bytes(f.read(), is_color)
 
 
 def _parse_pnm(data):
@@ -115,3 +113,12 @@ def batch_images_from_tar(data_file, dataset_name, img2label,
     raise NotImplementedError(
         "tar batching requires the dataset cache layout; use the "
         "paddle_tpu.dataset readers instead")
+
+
+def load_image_bytes(bytes, is_color=True):  # noqa: A002 (reference name)
+    """Decode an image from an in-memory bytes buffer (reference
+    v2/image.py:111 load_image_bytes) — same format support as
+    load_image (PPM/PGM via the stdlib-only parser)."""
+    if bytes[:2] in (b"P5", b"P6"):
+        return _parse_pnm(bytes)
+    raise ValueError("unsupported image format; pass numpy arrays instead")

@@ -1,0 +1,56 @@
+"""Frozen v2 package surface: every name in the reference's v2 module
+__all__ lists resolves here (the v2 analogue of the fluid API.spec
+freeze). Reference: python/paddle/v2/*.py."""
+
+import ast
+import os
+
+import pytest
+
+REF = "/root/reference/python/paddle/v2"
+
+
+def _ref_all(path):
+    try:
+        tree = ast.parse(open(path).read())
+    except SyntaxError:  # py2-only module (e.g. op.py's print statements)
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", "") == "__all__":
+                    try:
+                        return [ast.literal_eval(e)
+                                for e in node.value.elts]
+                    except Exception:
+                        return None
+    return None
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+def test_v2_module_all_names_resolve():
+    import warnings
+    warnings.filterwarnings("ignore")
+    import paddle_tpu.v2 as p
+
+    gaps = {}
+    checked = 0
+    for f in sorted(os.listdir(REF)):
+        if not f.endswith(".py") or f.startswith("test") \
+                or f == "__init__.py":
+            continue
+        names = _ref_all(os.path.join(REF, f))
+        if not names:
+            continue
+        mod = getattr(p, f[:-3], None)
+        if mod is None:
+            gaps[f[:-3]] = ["<module absent>"]
+            continue
+        missing = [n for n in names if not hasattr(mod, n)]
+        if missing:
+            gaps[f[:-3]] = missing
+        checked += len(names)
+    assert not gaps, gaps
+    # most reference v2 modules are py2-only or build __all__
+    # dynamically; ~29 literal names are checkable today
+    assert checked >= 25, checked

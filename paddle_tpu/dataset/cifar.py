@@ -7,7 +7,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ["train10", "test10", "train100", "test100"]
+__all__ = ["train10", "test10", "train100", "test100", "convert"]
 
 IMAGE_DIM = 3 * 32 * 32
 TRAIN_SIZE = 2048
@@ -43,3 +43,8 @@ def train100():
 
 def test100():
     return _creator("test", TEST_SIZE, 100)
+def convert(path):
+    """Write the cifar-10 readers as recordio shards (reference
+    cifar.py convert)."""
+    common.convert(path, train10(), 1000, "cifar_train10")
+    common.convert(path, test10(), 1000, "cifar_test10")

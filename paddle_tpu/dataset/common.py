@@ -11,6 +11,7 @@ import os
 import numpy as np
 
 __all__ = ["DATA_HOME", "download", "md5file", "split_rng",
+           "split", "cluster_files_reader", "convert",
            "synthetic_mode", "is_synthetic"]
 
 DATA_HOME = os.path.expanduser(os.environ.get(
@@ -55,3 +56,72 @@ def split_rng(name, split):
     seed = int(hashlib.md5(("%s/%s" % (name, split)).encode())
                .hexdigest()[:8], 16)
     return np.random.RandomState(seed)
+
+
+def _sharded(reader, line_count, dump):
+    """Accumulate reader items into line_count-sized chunks and hand each
+    to dump(idx, chunk). Returns the dump results (one per shard)."""
+    assert line_count >= 1
+    files, buf, idx = [], [], 0
+    for item in reader():
+        buf.append(item)
+        if len(buf) == line_count:
+            files.append(dump(idx, buf))
+            buf, idx = [], idx + 1
+    if buf:
+        files.append(dump(idx, buf))
+    return files
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Shard a reader into pickle files of `line_count` items each
+    (reference dataset/common.py:137). Returns the file list."""
+    import pickle
+    dumper = dumper or pickle.dump
+
+    def dump(idx, buf):
+        path = suffix % idx
+        with open(path, "wb") as f:
+            dumper(buf, f)
+        return path
+
+    return _sharded(reader, line_count, dump)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Reader over this trainer's shard of `split(...)` files (reference
+    dataset/common.py:175): file i belongs to trainer i % trainer_count."""
+    import glob
+    import pickle
+    loader = loader or pickle.load
+
+    def reader():
+        paths = sorted(glob.glob(files_pattern))
+        for i, path in enumerate(paths):
+            if i % trainer_count != trainer_id:
+                continue
+            with open(path, "rb") as f:
+                for item in loader(f):
+                    yield item
+
+    return reader
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Write a reader out as recordio shards of pickled records
+    (reference dataset/common.py:210). Uses the native recordio writer
+    when built, the pyrio fallback otherwise. Returns the file list."""
+    import os
+    import pickle
+    from ..native import RecordIOWriter
+
+    def dump(idx, buf):
+        path = os.path.join(output_path, "%s-%05d" % (name_prefix, idx))
+        w = RecordIOWriter(path)
+        for item in buf:
+            w.write(pickle.dumps(item))
+        w.close()
+        return path
+
+    return _sharded(reader, line_count, dump)

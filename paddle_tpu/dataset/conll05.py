@@ -10,7 +10,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ["test", "get_dict", "get_embedding"]
+__all__ = ["test", "get_dict", "get_embedding", "convert"]
 
 WORD_DICT_LEN = 44068       # reference Wikipedia-corpus vocab order
 VERB_DICT_LEN = 3162
@@ -52,3 +52,8 @@ def test():
                    [int(l) for l in labels])
 
     return reader
+
+
+def convert(path):
+    """Write the test reader as recordio shards (reference conll05.py)."""
+    common.convert(path, test(), 1000, "conl105_test")

@@ -1,0 +1,6 @@
+"""Image preprocessing helpers under the dataset package (reference
+python/paddle/dataset/image.py — the same functions the v2 package
+exposes as paddle.v2.image; one implementation, both import paths)."""
+
+from ..v2.image import *  # noqa: F401,F403
+from ..v2.image import __all__  # noqa: F401

@@ -8,7 +8,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ["train", "test", "word_dict"]
+__all__ = ["train", "test", "word_dict", "build_dict", "convert"]
 
 VOCAB_SIZE = 5148  # matches the reference's imdb.word_dict() size order
 TRAIN_SIZE = 1024
@@ -47,3 +47,17 @@ def train(word_idx=None):
 
 def test(word_idx=None):
     return _creator("test", TEST_SIZE)
+
+
+def build_dict(pattern=None, cutoff=None):
+    """Vocabulary builder (reference imdb.py build_dict walked the raw
+    corpus; the synthetic corpus's vocab is word_dict itself)."""
+    return word_dict()
+
+
+def convert(path):
+    """Write the readers as recordio shards (reference imdb.py)."""
+    from . import common
+    w = word_dict()
+    common.convert(path, train(w), 1000, "imdb_train")
+    common.convert(path, test(w), 1000, "imdb_test")

@@ -6,7 +6,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ["train", "test", "build_dict", "DataType"]
+__all__ = ["train", "test", "build_dict", "DataType", "convert"]
 
 VOCAB = 2074         # reference build_dict default min_word_freq=50 order
 TRAIN_SIZE = 2048
@@ -49,3 +49,10 @@ def train(word_idx, n, data_type=DataType.NGRAM):
 
 def test(word_idx, n, data_type=DataType.NGRAM):
     return _creator("test", TEST_SIZE, word_idx, n, data_type)
+def convert(path):
+    """Write the readers as recordio shards (reference imikolov.py)."""
+    from . import common
+    N = 5
+    word_dict = build_dict()
+    common.convert(path, train(word_dict, N), 1000, "imikolov_train")
+    common.convert(path, test(word_dict, N), 1000, "imikolov_test")

@@ -8,7 +8,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ["train", "test"]
+__all__ = ["train", "test", "convert"]
 
 IMAGE_DIM = 784
 CLASS_NUM = 10
@@ -42,3 +42,7 @@ def train():
 
 def test():
     return _creator("test", TEST_SIZE)
+def convert(path):
+    """Write the readers as recordio shards (reference mnist.py:133)."""
+    common.convert(path, train(), 1000, "minist_train")
+    common.convert(path, test(), 1000, "minist_test")

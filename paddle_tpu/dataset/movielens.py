@@ -9,7 +9,9 @@ import numpy as np
 from . import common
 
 __all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
-           "age_table"]
+           "age_table", "get_movie_title_dict", "movie_categories",
+           "user_info", "movie_info", "convert",
+           "MovieInfo", "UserInfo"]
 
 USER_NUM = 944
 MOVIE_NUM = 1683
@@ -63,3 +65,100 @@ def train():
 
 def test():
     return _creator("test", TEST_SIZE)
+
+
+class MovieInfo(object):
+    """Movie id, title and categories (reference movielens.py:48)."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index,
+                [CATEGORIES_DICT[c] for c in self.categories],
+                [MOVIE_TITLE_DICT[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return "<MovieInfo id(%d), title(%s), categories(%s)>" % (
+            self.index, self.title, self.categories)
+
+
+class UserInfo(object):
+    """User id, gender, age bucket and job (reference movielens.py:75)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+    def __repr__(self):
+        return "<UserInfo id(%d), gender(%s), age(%d), job(%d)>" % (
+            self.index, "M" if self.is_male else "F",
+            age_table[self.age], self.job_id)
+
+
+CATEGORIES = ["Action", "Adventure", "Animation", "Children's", "Comedy",
+              "Crime", "Documentary", "Drama", "Fantasy", "Film-Noir",
+              "Horror", "Musical", "Mystery", "Romance", "Sci-Fi",
+              "Thriller", "War", "Western"]
+CATEGORIES_DICT = {c: i for i, c in enumerate(CATEGORIES)}
+# procedurally generated titles: "movie <id>" per synthetic movie
+MOVIE_TITLE_DICT = {"movie": 0}
+MOVIE_TITLE_DICT.update({str(i): i + 1 for i in range(MOVIE_NUM)})
+
+_MOVIE_INFO = None
+_USER_INFO = None
+
+
+def _meta():
+    """Deterministic synthetic metadata consistent with the rating
+    readers' id ranges (the reference parsed movies.dat/users.dat)."""
+    global _MOVIE_INFO, _USER_INFO
+    if _MOVIE_INFO is None:
+        rng = common.split_rng("movielens", "meta")
+        _MOVIE_INFO = {}
+        for m in range(1, MOVIE_NUM):
+            cats = [CATEGORIES[c] for c in
+                    rng.choice(CATEGORY_NUM, rng.randint(1, 4),
+                               replace=False)]
+            _MOVIE_INFO[m] = MovieInfo(m, cats, "movie %d" % m)
+        _USER_INFO = {}
+        for u in range(1, USER_NUM):
+            _USER_INFO[u] = UserInfo(
+                u, "M" if rng.randint(0, 2) else "F",
+                age_table[rng.randint(0, len(age_table))],
+                rng.randint(0, JOB_NUM))
+    return _MOVIE_INFO, _USER_INFO
+
+
+def get_movie_title_dict():
+    """Movie title vocabulary (reference movielens.py:178)."""
+    return MOVIE_TITLE_DICT
+
+
+def movie_categories():
+    """Category name -> id (reference movielens.py:225)."""
+    return CATEGORIES_DICT
+
+
+def user_info():
+    """user id -> UserInfo (reference movielens.py:233)."""
+    return _meta()[1]
+
+
+def movie_info():
+    """movie id -> MovieInfo (reference movielens.py:241)."""
+    return _meta()[0]
+
+
+def convert(path):
+    """Write the readers as recordio shards (reference movielens.py)."""
+    common.convert(path, train(), 1000, "movielens_train")
+    common.convert(path, test(), 1000, "movielens_test")

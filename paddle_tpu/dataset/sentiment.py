@@ -6,7 +6,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ["train", "test", "get_word_dict"]
+__all__ = ["train", "test", "get_word_dict", "convert"]
 
 VOCAB = 39768          # reference movie_reviews vocab order
 TRAIN_SIZE = 1600      # reference: 80% of 2000 docs
@@ -40,3 +40,9 @@ def train():
 
 def test():
     return _creator("test", TEST_SIZE)
+
+
+def convert(path):
+    """Write the readers as recordio shards (reference sentiment.py)."""
+    common.convert(path, train(), 1000, "sentiment_train")
+    common.convert(path, test(), 1000, "sentiment_test")

@@ -8,7 +8,8 @@ import numpy as np
 
 from . import common
 
-__all__ = ["train", "test", "N", "START", "END", "UNK"]
+__all__ = ["train", "test", "N", "START", "END", "UNK",
+           "get_dict", "convert"]
 
 N = 30  # default dict size knob in the reference API
 START, END, UNK = 0, 1, 2
@@ -39,3 +40,21 @@ def train(dict_size):
 
 def test(dict_size):
     return _creator("test", TEST_SIZE, dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    """(src_dict, trg_dict) consistent with the synthetic id streams
+    (reference wmt14.py get_dict: id->word when reverse=True)."""
+    def one(prefix):
+        words = {0: "<s>", 1: "<e>", 2: "<unk>"}
+        words.update({i: "%s%d" % (prefix, i) for i in range(3, dict_size)})
+        if reverse:
+            return words
+        return {w: i for i, w in words.items()}
+    return one("src"), one("trg")
+
+
+def convert(path):
+    """Write the readers as recordio shards (reference wmt14.py)."""
+    common.convert(path, train(N), 1000, "wmt14_train")
+    common.convert(path, test(N), 1000, "wmt14_test")

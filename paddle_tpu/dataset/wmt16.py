@@ -8,7 +8,8 @@ import numpy as np
 
 from . import common
 
-__all__ = ["train", "test", "validation", "get_dict"]
+__all__ = ["train", "test", "validation", "get_dict",
+           "fetch", "convert"]
 
 TOTAL_EN_WORDS = 11250
 TOTAL_DE_WORDS = 19220
@@ -61,3 +62,18 @@ def test(src_dict_size, trg_dict_size, src_lang="en"):
 def validation(src_dict_size, trg_dict_size, src_lang="en"):
     return _creator("val", TEST_SIZE, src_dict_size, trg_dict_size,
                     src_lang)
+
+
+def fetch():
+    """reference wmt16.py fetch: pre-download the corpus. The synthetic
+    corpus is generated in-process, so this is a no-op that exists for
+    script parity."""
+    return None
+
+
+def convert(path, src_dict_size=3000, trg_dict_size=3000, src_lang="en"):
+    """Write the readers as recordio shards (reference wmt16.py)."""
+    common.convert(path, train(src_dict_size, trg_dict_size, src_lang),
+                   1000, "wmt16_train")
+    common.convert(path, test(src_dict_size, trg_dict_size, src_lang),
+                   1000, "wmt16_test")

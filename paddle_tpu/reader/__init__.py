@@ -9,7 +9,7 @@ plus the multithreaded xmap_readers and the batching wrapper
 
 from .decorator import (
     map_readers, buffered, compose, chain, shuffle, firstn, xmap_readers,
-    cache, ComposeNotAligned,
+    cache, ComposeNotAligned, multiprocess_reader, PipeReader, Fake,
 )
 from . import creator
 

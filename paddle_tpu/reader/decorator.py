@@ -8,6 +8,7 @@ import threading
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
     "xmap_readers", "cache", "ComposeNotAligned",
+    "multiprocess_reader", "PipeReader", "Fake",
 ]
 
 
@@ -202,3 +203,147 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     held[i] = mapped
 
     return data_reader
+
+
+class _EndOfStream(object):
+    """Pickle-stable end sentinel for multiprocess_reader — a plain None
+    would truncate streams whose readers legitimately yield None."""
+
+    def __reduce__(self):
+        return (_EndOfStream, ())
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Merge readers, one OS process each (reference decorator.py:338).
+    Each child streams items; the parent interleaves until every child
+    has sent its end sentinel."""
+    import multiprocessing
+    import sys
+    assert isinstance(readers, (list, tuple)) and len(readers) > 0
+
+    def _feed(reader, q):
+        try:
+            for item in reader():
+                q.put(item)
+        finally:
+            q.put(_EndOfStream())
+
+    def queue_reader():
+        q = multiprocessing.Queue(queue_size)
+        procs = [multiprocessing.Process(target=_feed, args=(r, q))
+                 for r in readers]
+        for p in procs:
+            p.daemon = True
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if isinstance(item, _EndOfStream):
+                finished += 1
+            else:
+                yield item
+        for p in procs:
+            p.join()
+
+    def pipe_reader():
+        from multiprocessing.connection import wait
+        conns = []
+        procs = []
+        for r in readers:
+            parent, child = multiprocessing.Pipe(duplex=False)
+
+            def _feed_pipe(reader, conn):
+                try:
+                    for item in reader():
+                        conn.send(item)
+                finally:
+                    conn.send(_EndOfStream())
+                    conn.close()
+
+            p = multiprocessing.Process(target=_feed_pipe,
+                                        args=(r, child))
+            p.daemon = True
+            p.start()
+            child.close()   # parent must drop its copy or EOF never fires
+            conns.append(parent)
+            procs.append(p)
+        live = list(conns)
+        while live:
+            for conn in wait(live):
+                try:
+                    item = conn.recv()
+                except EOFError:   # child died before its sentinel
+                    live.remove(conn)
+                    continue
+                if isinstance(item, _EndOfStream):
+                    live.remove(conn)
+                else:
+                    yield item
+        for p in procs:
+            p.join()
+
+    if sys.platform == "win32":
+        raise NotImplementedError("multiprocess_reader: POSIX only")
+    return pipe_reader if use_pipe else queue_reader
+
+
+class PipeReader:
+    """Stream a shell command's stdout and parse it into lines
+    (reference decorator.py:438) — read corpora from another program
+    (hdfs/ceph/s3 cat, curl, zcat, ...)."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        import subprocess
+        import zlib
+        if not isinstance(command, str):
+            raise TypeError("left_cmd must be a string")
+        if file_type == "gzip":
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        elif file_type != "plain":
+            raise TypeError("file_type %s is not allowed" % file_type)
+        self.file_type = file_type
+        self.bufsize = bufsize
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if not buff:
+                break
+            if self.file_type == "gzip":
+                decomp = self.dec.decompress(buff).decode(
+                    "utf-8", "replace")
+            else:
+                decomp = buff.decode("utf-8", "replace")
+            if cut_lines:
+                pieces = (remained + decomp).split(line_break)
+                remained = pieces[-1]
+                for line in pieces[:-1]:
+                    yield line
+            else:
+                yield decomp
+        if cut_lines and remained:
+            yield remained
+
+
+class Fake(object):
+    """Cache the first item a reader yields and repeat it data_num times
+    (reference decorator.py:509) — pins the input for speed testing."""
+
+    _EMPTY = object()
+
+    def __init__(self):
+        self.data = None
+
+    def __call__(self, reader, data_num):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader(), Fake._EMPTY)
+            if self.data is Fake._EMPTY:
+                return   # empty source reader -> empty stream
+            for _ in range(data_num):
+                yield self.data
+
+        return fake_reader

@@ -67,12 +67,14 @@ def test_sequence_expand_ragged_static_multiple():
                  lod={"X": xlens, "Y": ylens})
     o = np.asarray(out["Out"])
     lens = np.asarray(out["Out@LOD_LEN"])
-    np.testing.assert_array_equal(lens, [2, 3, 1, 2])
-    # row 0,1 replicate x[0]; row 2,3 replicate x[1]; masked to lens
-    np.testing.assert_allclose(o[0, :2], x[0, :2])
+    # reference semantics (sequence_expand_op.h:114 — each repeat keeps
+    # x_i's own length): out lens are X's lengths repeated k=2 times
+    np.testing.assert_array_equal(lens, [3, 3, 2, 2])
+    # rows 0,1 replicate x[0]; rows 2,3 replicate x[1]
+    np.testing.assert_allclose(o[0, :3], x[0, :3])
     np.testing.assert_allclose(o[1, :3], x[0, :3])
-    np.testing.assert_allclose(o[2, :1], x[1, :1])
-    assert np.all(o[0, 2:] == 0)
+    np.testing.assert_allclose(o[2, :2], x[1, :2])
+    np.testing.assert_allclose(o[3, :2], x[1, :2])
 
 
 # ---------------------------------------------------------------------------

@@ -88,16 +88,30 @@ def main():
     exe.run(startup)
     scope = fluid.global_scope()
     state_names = tuple(functionalizer.persistable_names(main_prog))
-    step_fn = functionalizer.build_step_fn(
-        main_prog, ("data", "label"), (loss.name,), state_names)
-    if os.environ.get("BENCH_REMAT", "0") == "1":
-        # rematerialized backward: keep only conv outputs as residuals,
-        # recompute BN/activation tails — trades (spare) FLOPs for HBM
-        # reads; see ROOFLINE.md "what would move the number"
-        step_fn = jax.checkpoint(
-            step_fn,
-            policy=jax.checkpoint_policies.save_only_these_names(
-                "conv_out"))
+    # whole-graph AD: one jax.vjp over the forward region (vs per-op
+    # stashed vjps). Required for BENCH_REMAT to mean anything — a
+    # jax.checkpoint around a program whose backward is already baked in
+    # is a no-op (there is no outer differentiation for the policy to
+    # act on); with whole-graph AD the save_only_these_names("conv_out")
+    # policy genuinely drops BN/activation tails and recomputes them in
+    # the backward (ROOFLINE.md remat lever).
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    whole_graph = os.environ.get("BENCH_WHOLEGRAPH", "1") == "1"
+    if whole_graph or remat:
+        step_fn = functionalizer.build_whole_graph_step_fn(
+            main_prog, ("data", "label"), (loss.name,), state_names,
+            remat_policy="conv_out" if remat else None)
+        if step_fn is None and remat:
+            # never mislabel a baseline run as a remat measurement
+            raise RuntimeError(
+                "BENCH_REMAT=1 but the program is ineligible for "
+                "whole-graph AD (remat would silently not engage)")
+        if step_fn is None:
+            step_fn = functionalizer.build_step_fn(
+                main_prog, ("data", "label"), (loss.name,), state_names)
+    else:
+        step_fn = functionalizer.build_step_fn(
+            main_prog, ("data", "label"), (loss.name,), state_names)
     jitted = jax.jit(step_fn, donate_argnums=(0,))
 
     state = {n: scope.get(n) for n in state_names

@@ -154,6 +154,17 @@ DEFINE_bool(
     "fp32 master weights (the TPU analogue of the reference's fp16 "
     "data-transform story).", on_change=_amp_changed)
 DEFINE_bool(
+    "whole_graph_ad", False,
+    "Serve a program's backward section with ONE jax.vjp over the whole "
+    "forward region instead of per-op stashed vjps, when the program shape "
+    "allows it (straight-line forward, generic grads only). Enables real "
+    "jax.checkpoint rematerialization via FLAGS.remat_policy.")
+DEFINE_string(
+    "remat_policy", "",
+    "Rematerialization policy for whole_graph_ad: '' (save everything), "
+    "'conv_out' (keep conv outputs, recompute BN/activation tails — "
+    "ROOFLINE.md's remat lever), 'dots', or 'nothing'.")
+DEFINE_bool(
     "cpu_deterministic", False,
     "Prefer deterministic reduction order (reference FLAGS_cpu_deterministic, "
     "python/paddle/fluid/__init__.py:123). Advisory on TPU: XLA reductions "

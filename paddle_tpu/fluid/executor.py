@@ -184,12 +184,16 @@ class Executor:
     def _get_jitted(self, program, feed_names, fetch_names, state_names):
         import jax
         from ..ops.registry import amp_enabled
+        from ..flags import FLAGS
         key = (id(program), program._version, feed_names, fetch_names,
-               tuple(state_names), amp_enabled())
+               tuple(state_names), amp_enabled(),
+               FLAGS.whole_graph_ad, FLAGS.remat_policy)
         fn = self._cache.get(key)
         if fn is None:
             step_fn = functionalizer.build_step_fn(
-                program, feed_names, fetch_names, state_names)
+                program, feed_names, fetch_names, state_names,
+                whole_graph_ad=FLAGS.whole_graph_ad,
+                remat_policy=FLAGS.remat_policy or None)
             donate = ()
             dev = self._device()
             if dev is not None and dev.platform == "tpu":

@@ -21,6 +21,7 @@ import numpy as np
 
 from .. import ops as op_registry
 from ..ops.registry import ExecContext, make_forward_and_vjp
+from .framework import GRAD_VAR_SUFFIX as GRAD_SUFFIX, grad_var_name
 
 _SKIP_OPS = frozenset(["feed", "fetch"])
 
@@ -356,8 +357,21 @@ def run_block(block, env, step=0, seed=0, mesh=None, vjp_cache=None):
 
 
 def build_step_fn(program, feed_names, fetch_names, state_names,
-                  block_idx=0, mesh=None):
-    """Return pure fn(state_dict, feed_dict, step) -> (fetches, new_state)."""
+                  block_idx=0, mesh=None, whole_graph_ad=False,
+                  remat_policy=None):
+    """Return pure fn(state_dict, feed_dict, step) -> (fetches, new_state).
+
+    With whole_graph_ad the backward region of the program is served by ONE
+    jax.vjp over the whole forward region instead of per-op stashed vjps —
+    the TPU-idiomatic formulation that makes `jax.checkpoint` rematerialization
+    policies real (see build_whole_graph_step_fn). Falls back to the per-op
+    interpreter when the program shape is ineligible."""
+    if whole_graph_ad:
+        fn = build_whole_graph_step_fn(
+            program, feed_names, fetch_names, state_names,
+            block_idx=block_idx, mesh=mesh, remat_policy=remat_policy)
+        if fn is not None:
+            return fn
     block = program.blocks[block_idx]
     seed = program.random_seed
     state_names = tuple(state_names)
@@ -368,6 +382,188 @@ def build_step_fn(program, feed_names, fetch_names, state_names,
         env.update(state)
         env.update(feeds)
         run_block(block, env, step=step, seed=seed, mesh=mesh)
+        fetches = [env.get(n) for n in fetch_names]
+        new_state = {n: env[n] for n in state_names if n in env}
+        return fetches, new_state
+
+    return step_fn
+
+
+def _partition_whole_graph(block):
+    """Split block.ops into (forward_ops, update_ops, loss_name, diff_info)
+    for whole-graph AD, or return None when the program shape is not
+    eligible (host ops, control-flow sub-blocks, custom grad lowerings,
+    maker-produced backward ops, multiple grad seeds).
+
+    The backward region — seed fill_constant, generic `<type>_grad` ops and
+    their fan-in sum/assign ops (backward.py:58) — is DROPPED: jax's own
+    transpose serves it. Everything after (grad clip, regularizers,
+    optimizer ops) is the update region and still interprets op-by-op."""
+    ops = list(block.ops)
+    seed_idx = None
+    for i, op in enumerate(ops):
+        if (op.type == "fill_constant"
+                and all(n.endswith(GRAD_SUFFIX)
+                        for ns in op.outputs.values() for n in ns if n)
+                and any(n for ns in op.outputs.values() for n in ns)):
+            seed_idx = i
+            break
+    if seed_idx is None:
+        return None
+    seed_outs = [n for ns in ops[seed_idx].outputs.values() for n in ns if n]
+    if len(seed_outs) != 1:
+        return None
+    loss_name = seed_outs[0][:-len(GRAD_SUFFIX)]
+
+    def _is_bwd_helper(op):
+        # fan-in accumulation / canonical rebinding emitted by backward.py
+        return (op.type in ("sum", "assign")
+                and all(GRAD_SUFFIX in n
+                        for ns in op.outputs.values() for n in ns if n))
+
+    end = seed_idx + 1
+    while end < len(ops):
+        op = ops[end]
+        if _is_generic_grad(op) or _is_bwd_helper(op):
+            end += 1
+            continue
+        if op.type.endswith("_grad"):
+            return None  # custom grad lowering — per-op semantics required
+        if any(GRAD_SUFFIX in n for ns in op.outputs.values()
+               for n in ns if n) and not _is_bwd_helper(op):
+            # maker-produced backward op (sparse lookup, while grad, ...)
+            break
+        break
+    forward_ops, bwd_ops, update_ops = \
+        ops[:seed_idx], ops[seed_idx + 1:end], ops[end:]
+
+    # eligibility: straight-line jit-able forward; no maker ops left in the
+    # region jax is replacing; no grad-writing op hiding in fwd/update
+    for op in forward_ops:
+        if is_host_op(op) or op.attrs.get("sub_block") is not None:
+            return None
+        if any(GRAD_SUFFIX in n for ns in op.outputs.values()
+               for n in ns if n):
+            return None
+    del bwd_ops  # every op in the region satisfied the admission predicate
+    for op in update_ops:
+        if is_host_op(op) or op.attrs.get("sub_block") is not None:
+            # sub-block dataflow is invisible to the top-level
+            # input_arg_names scans below (needed_gnames / aux) — an
+            # env-introspecting update op could read grads or forward
+            # intermediates we never bound; per-op path serves those
+            return None
+        if _is_generic_grad(op) or op.type.endswith("_grad"):
+            return None
+    return forward_ops, update_ops, loss_name
+
+
+def _resolve_remat_policy(policy):
+    import jax
+    if policy is None or callable(policy):
+        return policy
+    # string shorthands (flag-friendly)
+    if policy == "conv_out":
+        return jax.checkpoint_policies.save_only_these_names("conv_out")
+    if policy == "nothing":
+        return jax.checkpoint_policies.nothing_saveable
+    if policy == "dots":
+        return jax.checkpoint_policies.checkpoint_dots
+    raise ValueError("unknown remat policy %r" % (policy,))
+
+
+def build_whole_graph_step_fn(program, feed_names, fetch_names, state_names,
+                              block_idx=0, mesh=None, remat_policy=None):
+    """Whole-graph AD step builder: fn(state, feeds, step) -> (fetches,
+    new_state), with the program's backward section served by a single
+    jax.vjp over the forward region.
+
+    Why this exists: the per-op interpreter stashes a vjp per forward op, so
+    fwd+bwd are one dataflow graph and a `jax.checkpoint` wrapped around the
+    step is a no-op — there is no outer differentiation for the policy to
+    act on. Here the forward region IS the differentiated function, so
+    rematerialization policies (e.g. save_only_these_names("conv_out"),
+    tagged in ops/nn_ops.py:72) genuinely drop activations and recompute
+    them in the backward, trading FLOPs for HBM traffic (ROOFLINE.md).
+
+    Returns None when the program is ineligible (host ops, control-flow
+    sub-blocks, custom/maker grad ops, grads of intermediate activations) —
+    callers fall back to the per-op path whose semantics cover everything.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    block = program.blocks[block_idx]
+    part = _partition_whole_graph(block)
+    if part is None:
+        return None
+    forward_ops, update_ops, loss_name = part
+    seed = program.random_seed
+    state_names = tuple(state_names)
+    fetch_names = tuple(fetch_names)
+    policy = _resolve_remat_policy(remat_policy)
+
+    # vars whose canonical grads the downstream region (or the user's
+    # fetch_list) consumes; they must be inputs of the forward region
+    needed_gnames = set()
+    for op in update_ops:
+        needed_gnames.update(n for n in op.input_arg_names
+                             if n.endswith(GRAD_SUFFIX))
+    needed_gnames.update(n for n in fetch_names if n.endswith(GRAD_SUFFIX))
+    diff_names = tuple(sorted(n[:-len(GRAD_SUFFIX)] for n in needed_gnames))
+
+    forward_writes = set()
+    for op in forward_ops:
+        forward_writes |= _op_tree_writes(op)
+    if any(n in forward_writes for n in diff_names):
+        return None  # grad of an intermediate — per-op path serves it
+
+    # forward-produced values needed after the vjp (everything else is free
+    # to die inside the differentiated region — returning the whole env as
+    # aux would pin every activation and defeat remat)
+    downstream_reads = set()
+    for op in update_ops:
+        downstream_reads.update(op.input_arg_names)
+    aux_base = ((downstream_reads | set(fetch_names) | set(state_names))
+                & forward_writes) | {loss_name}
+    aux_names = set()
+    for n in aux_base:
+        aux_names.add(n)
+        aux_names.add(n + LOD_LEN_SUFFIX)
+        aux_names.add(n + LOD_SEG_SUFFIX)
+    aux_names = tuple(sorted(aux_names))
+
+    def step_fn(state, feeds, step):
+        env0 = {}
+        env0.update(state)
+        env0.update(feeds)
+        if any(n not in env0 for n in diff_names):
+            raise ValueError(
+                "whole-graph AD: differentiated vars %s not all in "
+                "state/feeds" % (diff_names,))
+        base = {n: v for n, v in env0.items() if n not in diff_names}
+
+        def fwd(diff_vals):
+            env = dict(base)
+            env.update(diff_vals)
+            _interpret_ops(forward_ops, env, step=step, seed=seed,
+                           mesh=mesh)
+            aux = {n: env[n] for n in aux_names if n in env}
+            return env[loss_name], aux
+
+        f = fwd if policy is None else jax.checkpoint(fwd, policy=policy)
+        diff_vals = {n: env0[n] for n in diff_names}
+        loss_val, vjp_fn, aux = jax.vjp(f, diff_vals, has_aux=True)
+        grads, = vjp_fn(jnp.ones_like(loss_val))
+
+        env = dict(env0)
+        env.update(aux)
+        for n in diff_names:
+            g = grads.get(n)
+            if g is not None and not (
+                    hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+                env[grad_var_name(n)] = g
+        _interpret_ops(update_ops, env, step=step, seed=seed, mesh=mesh)
         fetches = [env.get(n) for n in fetch_names]
         new_state = {n: env[n] for n in state_names if n in env}
         return fetches, new_state

@@ -192,7 +192,10 @@ class Executor:
         if fn is None:
             step_fn = functionalizer.build_step_fn(
                 program, feed_names, fetch_names, state_names,
-                whole_graph_ad=FLAGS.whole_graph_ad,
+                # a remat policy implies whole-graph AD: never let a
+                # policy-only FLAGS setting silently run the baseline
+                whole_graph_ad=(FLAGS.whole_graph_ad
+                                or bool(FLAGS.remat_policy)),
                 remat_policy=FLAGS.remat_policy or None)
             donate = ()
             dev = self._device()

@@ -372,6 +372,19 @@ def build_step_fn(program, feed_names, fetch_names, state_names,
             block_idx=block_idx, mesh=mesh, remat_policy=remat_policy)
         if fn is not None:
             return fn
+        has_backward = any(
+            _is_generic_grad(op)
+            for op in program.blocks[block_idx].ops)
+        if remat_policy and has_backward:
+            # falling back would run the NON-remat per-op path under a
+            # remat label — refuse rather than mislabel. Programs with no
+            # backward at all (startup, inference) have nothing to remat
+            # and fall through silently.
+            raise RuntimeError(
+                "remat_policy %r requested but the program is ineligible "
+                "for whole-graph AD (host ops, control-flow sub-blocks, "
+                "custom grad ops, or grads of intermediates)"
+                % (remat_policy,))
     block = program.blocks[block_idx]
     seed = program.random_seed
     state_names = tuple(state_names)
@@ -429,10 +442,8 @@ def _partition_whole_graph(block):
             continue
         if op.type.endswith("_grad"):
             return None  # custom grad lowering — per-op semantics required
-        if any(GRAD_SUFFIX in n for ns in op.outputs.values()
-               for n in ns if n) and not _is_bwd_helper(op):
-            # maker-produced backward op (sparse lookup, while grad, ...)
-            break
+        # anything else (incl. maker-produced backward ops) ends the
+        # region; grad-writing stragglers are rejected below
         break
     forward_ops, bwd_ops, update_ops = \
         ops[:seed_idx], ops[seed_idx + 1:end], ops[end:]

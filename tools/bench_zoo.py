@@ -38,22 +38,30 @@ CONFIGS = [
      512, 64),
     ("stacked_dynamic_lstm_pipelined",
      ["--model", "stacked_dynamic_lstm", "--fetch_every", "10"], 64, 8),
+    # whole-graph AD + rematerialized backward (ROOFLINE.md remat lever);
+    # ineligible programs fail loudly (functionalizer refuses to run a
+    # baseline under a remat label) rather than skewing the sweep
+    ("resnet50_imagenet_remat",
+     ["--model", "resnet", "--data_set", "imagenet", "--layout", "NHWC",
+      "--whole_graph_ad", "--remat_policy", "conv_out"], 256, 8),
+    ("vgg16_cifar10_remat",
+     ["--model", "vgg", "--data_set", "cifar10",
+      "--whole_graph_ad", "--remat_policy", "conv_out"], 128, 8),
+    ("stacked_dynamic_lstm_remat",
+     ["--model", "stacked_dynamic_lstm",
+      "--whole_graph_ad", "--remat_policy", "conv_out"], 64, 8),
 ]
 
 
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
 def probe_backend(timeout=120):
-    """Same wedge-proof probe as bench.py: jax init can block forever on
-    a dead TPU transport."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True, timeout=timeout, cwd=REPO)
-        if proc.returncode == 0 and proc.stdout.strip():
-            return proc.stdout.strip().splitlines()[-1]
-    except subprocess.TimeoutExpired:
-        pass
-    return None
+    """Shared wedge-proof probe (bench.py owns the recipe): jax init can
+    block forever on a dead TPU transport."""
+    from bench import _backend_probe
+    return _backend_probe(timeout=timeout)
 
 
 def run_config(name, extra, batch, iterations, force_cpu):

@@ -59,6 +59,12 @@ def parse_args():
                         "dispatch pipeline the steps between fetches")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--use_fake_data", action="store_true", default=True)
+    p.add_argument("--whole_graph_ad", action="store_true",
+                   help="serve the backward with one jax.vjp over the "
+                        "forward region (enables --remat_policy)")
+    p.add_argument("--remat_policy", default="",
+                   help="jax.checkpoint policy under --whole_graph_ad: "
+                        "'conv_out', 'dots' or 'nothing'")
     return p.parse_args()
 
 
@@ -122,6 +128,17 @@ def main():
 
     if not args.no_amp and jax.default_backend() == "tpu":
         fluid.set_amp(True)
+    if args.whole_graph_ad or args.remat_policy:
+        if args.remat_policy and (args.parallel
+                                  or args.update_method != "local"):
+            # ParallelExecutor builds its own SPMD step and ignores
+            # FLAGS.whole_graph_ad — refuse rather than record a
+            # baseline number under a remat label
+            raise SystemExit(
+                "--remat_policy only supported with the local Executor")
+        from paddle_tpu.flags import FLAGS
+        FLAGS.whole_graph_ad = True
+        FLAGS.remat_policy = args.remat_policy
 
     main_prog, startup, feeds, loss, acc, _ = build_model(args)
     feeds = [main_prog.global_block().var(f) if isinstance(f, str) else f
@@ -235,6 +252,8 @@ def main():
         "device": jax.default_backend(),
         "parallel": bool(pe),
         "update_method": args.update_method,
+        "whole_graph_ad": bool(args.whole_graph_ad or args.remat_policy),
+        "remat_policy": args.remat_policy,
     }))
 
 

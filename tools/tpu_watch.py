@@ -23,17 +23,16 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
 
-def probe(timeout=90):
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True, timeout=timeout, cwd=REPO)
-        if proc.returncode == 0 and proc.stdout.strip():
-            return proc.stdout.strip().splitlines()[-1]
-    except subprocess.TimeoutExpired:
-        pass
-    return None
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def probe(timeout=120):
+    """The same wedge-proof probe as bench.py._backend_probe — import it
+    so the recipe (and its timeout) cannot drift across the three
+    entry points (bench.py, bench_zoo.py, here)."""
+    from bench import _backend_probe
+    return _backend_probe(timeout=timeout)
 
 
 def run_logged(cmd, env_extra, log, timeout):
@@ -75,19 +74,23 @@ def main():
                 # remat variant, then the zoo sweep.
                 ok, out = run_logged(
                     [sys.executable, "bench.py"], {}, log, 1800)
-                if ok:
+                def parse_lines(out, variant):
                     for line in out.splitlines():
-                        if line.startswith("{"):
+                        if not line.startswith("{"):
+                            continue
+                        try:
                             results.append(
-                                dict(json.loads(line), variant="nhwc"))
+                                dict(json.loads(line), variant=variant))
+                        except ValueError:
+                            pass  # '{'-prefixed non-JSON debug line
+
+                if ok:
+                    parse_lines(out, "nhwc")
                     ok2, out2 = run_logged(
                         [sys.executable, "bench.py"],
                         {"BENCH_REMAT": "1"}, log, 1800)
                     if ok2:
-                        for line in out2.splitlines():
-                            if line.startswith("{"):
-                                results.append(dict(json.loads(line),
-                                                    variant="nhwc+remat"))
+                        parse_lines(out2, "nhwc+remat")
                     run_logged([sys.executable, "tools/bench_zoo.py",
                                 "--out", "BENCH_zoo.json"], {}, log, 3600)
                     with open(os.path.join(REPO, "BENCH_watch.json"),

@@ -40,6 +40,71 @@ def _img_layout(ctx):
         or "NCHW"
 
 
+def _grouped_conv(strides, padding, dilations, groups, layout):
+    """Feature-grouped conv with a custom VJP.
+
+    jax's builtin filter-gradient for a feature-grouped conv is a
+    `batch_group_count` convolution, which XLA lowers pathologically:
+    measured 9.1s vs 0.14s for the dense equivalent on a (2,256,56,56)
+    NCHW input with groups=32 (the SE-ResNeXt cardinality) — ~64x, and
+    the reason SE-ResNeXt training ran at 4.5 s/step on the TPU. The
+    input gradient is itself a plain feature-grouped conv (fast), so
+    only dW is replaced: extract the conv's input patches once and
+    contract them against the cotangent as one group-batched einsum
+    (maps to MXU batched matmul; fp32 accumulation), ~38x faster than
+    the builtin form. Reference analogue: conv_grad kernels pick a
+    grouped algo in cuDNN (conv_cudnn_op.cu) — the reshape trick is the
+    TPU-native equivalent."""
+    import jax
+    import jax.numpy as jnp
+    dn = (layout, "OIHW", layout)
+
+    def base(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding,
+            rhs_dilation=dilations, feature_group_count=groups,
+            dimension_numbers=dn)
+
+    @jax.custom_vjp
+    def conv(x, w):
+        return base(x, w)
+
+    def fwd(x, w):
+        return base(x, w), (x, w)
+
+    def bwd(res, dy):
+        x, w = res
+        _, pull = jax.vjp(lambda x_: base(x_, w), x)
+        dx, = pull(dy)
+        o, ipg, kh, kw = w.shape
+        n = x.shape[0]
+        og, ik = o // groups, ipg * kh * kw
+        # patches feature dim unravels (c, kh, kw) with c outermost, so
+        # each group's ipg*kh*kw taps are one contiguous block
+        p = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), strides, padding, rhs_dilation=dilations,
+            dimension_numbers=dn)
+        if layout == "NCHW":
+            s = p.shape[2] * p.shape[3]
+            dw = jnp.einsum(
+                "ngis,ngos->goi",
+                p.reshape(n, groups, ik, s),
+                dy.reshape(n, groups, og, s),
+                preferred_element_type=jnp.float32)
+        else:  # NHWC
+            s = p.shape[1] * p.shape[2]
+            dw = jnp.einsum(
+                "nsgi,nsgo->goi",
+                p.reshape(n, s, groups, ik),
+                dy.reshape(n, s, groups, og),
+                preferred_element_type=jnp.float32)
+        dw = dw.reshape(o, ipg, kh, kw).astype(w.dtype)
+        return dx, dw
+
+    conv.defvjp(fwd, bwd)
+    return conv
+
+
 @register_op("conv2d")
 def _conv2d(ctx):
     import jax
@@ -49,17 +114,20 @@ def _conv2d(ctx):
     dilations = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1) or 1
     layout = _img_layout(ctx)
+    padding = [(pads[0], pads[0]), (pads[1], pads[1])]
     # filters stay OIHW in either layout so parameters/checkpoints are
     # layout-independent; XLA transposes once during layout assignment.
     # NOTE: no explicit preferred_element_type — the TPU MXU already
     # accumulates bf16 inputs in fp32 internally, and an explicit fp32
     # output type breaks jax's conv transpose rule under AMP (the f32
     # cotangent meets the bf16 residual operand)
-    out = jax.lax.conv_general_dilated(
-        x, w, window_strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dilations, feature_group_count=groups,
-        dimension_numbers=(layout, "OIHW", layout))
+    if groups > 1:
+        out = _grouped_conv(strides, padding, dilations, groups, layout)(x, w)
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=strides, padding=padding,
+            rhs_dilation=dilations, feature_group_count=groups,
+            dimension_numbers=(layout, "OIHW", layout))
     out = out.astype(x.dtype)
     if ctx.has_input("Bias"):
         bshape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)

@@ -129,7 +129,7 @@ def test_conv2d_op_training_uses_custom_path():
              if scope.has(n) and scope.get(n) is not None}
     feeds = {"img": jnp.asarray(x)}
     step_fn = functionalizer.build_step_fn(
-        main, tuple(sorted(feeds)), ("mean_0.tmp_0",), persistables)
+        main, tuple(sorted(feeds)), (loss.name,), persistables)
     hlo = jax.jit(step_fn).lower(
         state, feeds, np.uint32(0)).as_text()
     # every conv prints `batch_group_count = 1`; the pathological builtin

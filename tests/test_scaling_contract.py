@@ -1,0 +1,73 @@
+"""SPMD scaling contract, checked from compiled HLO (BASELINE config 5).
+
+Data parallelism over the mesh must cost only all-reduce collectives
+whose total byte volume equals the trainable parameter bytes (plus the
+scalar loss fetch), with per-chip FLOPs scaling ~1/dp at fixed global
+batch and no all-gather/all-to-all contamination. For the BN-free
+mnist model compiled here, XLA additionally bundles every gradient
+into exactly ONE fused all-reduce (BN models pin reduction points
+mid-graph and emit one per fusion cluster — see SCALING_r04.md's
+resnet census). This is the compile-time half of the 16-chip scaling
+story the environment's single chip cannot measure;
+`tools/scaling_analysis.py` produces the committed full-size record.
+Reference analogue: ncclAllReduce once per grad in
+multi_devices_graph_pass (SURVEY §2.10).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core, functionalizer
+from paddle_tpu.fluid.framework import Parameter
+from paddle_tpu.models import mnist
+from paddle_tpu.parallel.mesh import make_mesh, DATA_AXIS
+from tools.scaling_analysis import collective_census
+
+
+def _compile_step(dp, batch=64):
+    main, startup, _, loss, acc, prob = mnist.get_model(batch_size=batch)
+    mesh = make_mesh({DATA_AXIS: dp}, jax.devices()[:dp])
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                main_program=main, mesh=mesh)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    gb = main.global_block()
+    feeds = {}
+    for name, shape in (("pixel", (batch, 1, 28, 28)),
+                        ("label", (batch, 1))):
+        v = gb._find_var_recursive(name)
+        arr = np.zeros(shape, core.convert_dtype_to_np(v.dtype))
+        feeds[name] = pe._put(arr, pe._batch_sharding(arr.ndim))
+    persist = tuple(functionalizer.persistable_names(main))
+    fn = pe._get_jitted(tuple(sorted(feeds)), (loss.name,), persist)
+    scope = fluid.global_scope()
+    state = {n: pe._put(np.asarray(scope.get(n)),
+                        pe._replicated_sharding())
+             for n in persist if scope.get(n) is not None}
+    compiled = fn.lower(state, feeds, np.uint32(0)).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    pbytes = sum(int(np.asarray(scope.get(n)).nbytes) for n in persist
+                 if scope.get(n) is not None
+                 and isinstance(gb._find_var_recursive(n), Parameter))
+    return compiled.as_text(), cost.get("flops", -1.0), pbytes
+
+
+def test_dp8_one_allreduce_of_exact_param_volume():
+    hlo, flops8, pbytes = _compile_step(dp=8)
+    coll = collective_census(hlo)
+    assert set(coll) == {"all-reduce"}, \
+        "dp step must use only all-reduce, got %s" % coll
+    count, nbytes = coll["all-reduce"]
+    assert count == 1, "gradients must bundle into ONE all-reduce"
+    # volume = every trainable parameter gradient + the scalar loss mean
+    assert abs(nbytes - (pbytes + 4)) <= 64, (nbytes, pbytes)
+
+    _, flops1, _ = _compile_step(dp=1)
+    ratio = flops8 / (flops1 / 8.0)
+    assert 0.9 < ratio < 1.15, \
+        "per-chip FLOPs not ~1/8 of single-chip: ratio %.3f" % ratio

@@ -1,0 +1,1 @@
+"""Benchmark / analysis / debugging tools (reference benchmark/fluid + tools)."""

@@ -1,0 +1,54 @@
+"""SE-ResNeXt NHWC layout: numerical parity with the NCHW build.
+
+The TPU-preferred channels-last layout (dist_se_resnext.py analogue of
+resnet.py's `layout` param) must compute the same function — same
+initializers apply to the layout-independent OIHW filters, so feeding
+the transposed image through the NHWC program must reproduce the NCHW
+logits and the training trajectory.
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import se_resnext
+
+
+def _run(layout, img_nchw, lab, steps=2, **kw):
+    main, startup, feeds, loss, acc, prob = se_resnext.get_model(
+        batch_size=2, img_size=48, class_dim=5, lr=0.01, layout=layout,
+        **kw)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    img = img_nchw if layout == "NCHW" else \
+        np.transpose(img_nchw, (0, 2, 3, 1)).copy()
+    traj = []
+    for _ in range(steps):
+        l = exe.run(main, feed={"data": img, "label": lab},
+                    fetch_list=[loss])[0]
+        traj.append(float(np.asarray(l).flatten()[0]))
+    return traj
+
+
+def test_nhwc_matches_nchw_trajectory():
+    """Tight parity under the reference's own remove_bn methodology
+    (test_parallel_executor_seresnext.py:38): a 50-layer BN stack
+    amplifies the layout-dependent reduction-order noise chaotically
+    (their FIXME(zcd) rationale), so the strict trajectory comparison
+    drops BN; the full model is pinned at step 0 (forward + loss
+    identical) and sanity-bounded after one update."""
+    rng = np.random.RandomState(0)
+    img = rng.randn(2, 3, 48, 48).astype("float32")
+    lab = rng.randint(0, 5, (2, 1)).astype("int64")
+    t_nchw = _run("NCHW", img, lab, remove_bn=True, remove_dropout=True)
+    t_nhwc = _run("NHWC", img, lab, remove_bn=True, remove_dropout=True)
+    np.testing.assert_allclose(t_nchw, t_nhwc, atol=2e-4, rtol=2e-4)
+
+
+def test_nhwc_full_model_step0_exact():
+    rng = np.random.RandomState(1)
+    img = rng.randn(2, 3, 48, 48).astype("float32")
+    lab = rng.randint(0, 5, (2, 1)).astype("int64")
+    t_nchw = _run("NCHW", img, lab)
+    t_nhwc = _run("NHWC", img, lab)
+    assert abs(t_nchw[0] - t_nhwc[0]) < 1e-5, (t_nchw, t_nhwc)
+    assert abs(t_nchw[1] - t_nhwc[1]) < 0.1 * max(1.0, abs(t_nchw[1]))

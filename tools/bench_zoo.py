@@ -27,9 +27,12 @@ CONFIGS = [
      128, 8),
     ("stacked_dynamic_lstm_ptb", ["--model", "stacked_dynamic_lstm"],
      64, 8),
-    ("se_resnext_imagenet", ["--model", "se_resnext"], 64, 4),
+    ("se_resnext_imagenet", ["--model", "se_resnext",
+                             "--layout", "NHWC"], 64, 4),
     ("resnet50_imagenet", ["--model", "resnet", "--data_set", "imagenet",
                            "--layout", "NHWC"], 256, 8),
+    ("transformer_base_s512", ["--model", "transformer"], 32, 2),
+    ("machine_translation_wmt", ["--model", "machine_translation"], 16, 4),
     # pipelined variants: fetch (host sync) every 10 steps instead of
     # each one — shows the small-model throughput with async dispatch
     # allowed to overlap steps (bench.py's flagship methodology); the

@@ -79,7 +79,7 @@ def build_model(args):
         kwargs["lr"] = args.learning_rate
     if args.model in ("resnet", "vgg") and args.data_set:
         kwargs["dataset"] = args.data_set
-    if args.model == "resnet":
+    if args.model in ("resnet", "se_resnext"):
         kwargs["layout"] = args.layout
     return mod.get_model(**kwargs)
 
@@ -254,6 +254,7 @@ def main():
         "update_method": args.update_method,
         "whole_graph_ad": bool(args.whole_graph_ad or args.remat_policy),
         "remat_policy": args.remat_policy,
+        "layout": args.layout,
     }))
 
 

@@ -100,6 +100,35 @@ def test_grad_bf16_accumulates_fp32():
                                atol=0.5, rtol=0.05)
 
 
+def test_grad_matches_torch_oracle():
+    """Independent oracle: torch autograd, cardinality-32 SE-ResNeXt
+    shape with stride 2 — catches a systematically wrong convention the
+    builtin-vs-custom comparison could share."""
+    torch = pytest.importorskip("torch")
+    TF = torch.nn.functional
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 64, 14, 14).astype(np.float32)
+    w = rng.randn(64, 2, 3, 3).astype(np.float32)
+    dy_seed = rng.randn(2, 64, 7, 7).astype(np.float32)
+
+    custom = _grouped_conv((2, 2), [(1, 1), (1, 1)], (1, 1), 32, "NCHW")
+
+    def loss(x_, w_):
+        return (custom(x_, w_) * jnp.asarray(dy_seed)).sum()
+
+    dx, dw = jax.grad(loss, argnums=(0, 1))(jnp.asarray(x),
+                                            jnp.asarray(w))
+
+    xt = torch.tensor(x, requires_grad=True)
+    wt = torch.tensor(w, requires_grad=True)
+    (TF.conv2d(xt, wt, stride=2, padding=1, groups=32)
+     * torch.tensor(dy_seed)).sum().backward()
+    np.testing.assert_allclose(np.asarray(dx), xt.grad.numpy(),
+                               atol=2e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(dw), wt.grad.numpy(),
+                               atol=2e-4, rtol=1e-4)
+
+
 def test_conv2d_op_training_uses_custom_path():
     """End-to-end: a grouped-conv training program differentiates, and its
     lowered step-function HLO contains no batch_group_count conv — the

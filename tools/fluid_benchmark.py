@@ -254,7 +254,10 @@ def main():
         "update_method": args.update_method,
         "whole_graph_ad": bool(args.whole_graph_ad or args.remat_policy),
         "remat_policy": args.remat_policy,
-        "layout": args.layout,
+        # only models that honor --layout get the field; recording it
+        # for others would mislabel an NCHW build as NHWC
+        **({"layout": args.layout}
+           if args.model in ("resnet", "se_resnext") else {}),
     }))
 
 

@@ -205,26 +205,12 @@ class Executor:
             self._cache[key] = fn
         return fn
 
-    def run(self, program=None, feed=None, fetch_list=None,
-            feed_var_name="feed", fetch_var_name="fetch", scope=None,
-            return_numpy=True, use_program_cache=True):
+    def _prepare_feeds(self, program, feed):
+        """numpy -> device arrays with var dtype; LoDTensor (ragged)
+        feeds become padded [B, T, ...] + <name>@LOD_LEN lengths, with T
+        bucketed to a power of two to bound recompiles."""
         import jax
         import jax.numpy as jnp
-
-        if program is None:
-            program = default_main_program()
-        if feed is None:
-            feed = {}
-        if fetch_list is None:
-            fetch_list = []
-        if scope is None:
-            scope = global_scope()
-
-        fetch_names = tuple(_fetch_name(f) for f in fetch_list)
-
-        # prepare feeds: numpy -> device arrays with var dtype; LoDTensor
-        # (ragged) feeds become padded [B, T, ...] + <name>@LOD_LEN lengths,
-        # with T bucketed to a power of two to bound recompiles
         gb = program.global_block()
         feeds = {}
         for name, value in feed.items():
@@ -263,6 +249,125 @@ class Executor:
                         arr.dtype.kind in "iu" and want.kind in "iu"):
                     arr = arr.astype(want)
             feeds[name] = jnp.asarray(arr)
+        return feeds
+
+    def run_loop(self, program=None, feed=None, fetch_list=None,
+                 steps=1, scope=None, return_numpy=True):
+        """Run `steps` training steps as ONE device computation — a
+        lax.fori_loop over the jitted step body with a constant feed —
+        and return the LAST step's fetches. The TPU-idiomatic device-side
+        loop: one host->device dispatch per `steps` steps instead of per
+        step, so throughput is not bounded by host/relay round-trips
+        (reference analogue: the while_op + reader-op training loops that
+        kept the GPU busy without per-step feeds, fluid_benchmark.py
+        --use_reader_op).
+
+        The per-op RNG streams still fold the step counter, so dropout
+        masks differ across iterations exactly as under run(). Programs
+        containing host ops cannot run as one computation and are
+        rejected loudly.
+        """
+        import jax
+        import jax.numpy as jnp
+        if program is None:
+            program = default_main_program()
+        if feed is None:
+            feed = {}
+        if fetch_list is None:
+            fetch_list = []
+        if scope is None:
+            scope = global_scope()
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError("run_loop: steps must be >= 1")
+        from ..flags import FLAGS
+        if FLAGS.check_nan_inf:
+            raise RuntimeError(
+                "run_loop: FLAGS.check_nan_inf needs per-op attribution, "
+                "which requires per-step execution — use Executor.run")
+        hkey = (id(program), program._version)
+        cached_host = self._host_op_cache.get(hkey)
+        if cached_host is None:
+            cached_host = (functionalizer.contains_host_ops(program),
+                           functionalizer.has_subblock_host_ops(program))
+            self._host_op_cache[hkey] = cached_host
+        if cached_host[0]:
+            raise RuntimeError(
+                "run_loop: the program contains host ops (RPC/IO/python "
+                "callbacks) and cannot run as one device computation — "
+                "use Executor.run per step")
+
+        fetch_names = tuple(_fetch_name(f) for f in fetch_list)
+        feeds = self._prepare_feeds(program, feed)
+        feed_key = tuple(sorted(feeds.keys()))
+        lod_fetch = tuple(n + functionalizer.LOD_LEN_SUFFIX
+                          for n in fetch_names)
+        seg_fetch = tuple(n + functionalizer.LOD_SEG_SUFFIX
+                          for n in fetch_names)
+        fetch_ext = fetch_names + lod_fetch + seg_fetch
+        persistables = tuple(functionalizer.persistable_names(program))
+        state_in = {n: scope.get(n) for n in persistables
+                    if scope.has(n) and scope.get(n) is not None}
+        step0 = self._step_counters.get(id(program), 0)
+        self._step_counters[id(program)] = step0 + steps
+
+        from ..ops.registry import amp_enabled
+        key = ("loop", id(program), program._version, feed_key, fetch_ext,
+               persistables, amp_enabled(), FLAGS.whole_graph_ad,
+               FLAGS.remat_policy)
+        fn = self._cache.get(key)
+        if fn is None:
+            step_fn = functionalizer.build_step_fn(
+                program, feed_key, fetch_ext, persistables,
+                whole_graph_ad=(FLAGS.whole_graph_ad
+                                or bool(FLAGS.remat_policy)),
+                remat_policy=FLAGS.remat_policy or None)
+
+            def loop_fn(state, feeds, step0, nsteps):
+                # first step OUTSIDE the loop: the input state may be a
+                # subset of the persistable set (scope before first run)
+                # while the step's output always covers all of it — the
+                # carry structure must be the fixed post-step one
+                carry = step_fn(state, feeds, step0)
+
+                def body(i, carry):
+                    return step_fn(carry[1], feeds,
+                                   step0 + jnp.uint32(i))
+                return jax.lax.fori_loop(1, nsteps, body, carry)
+
+            donate = ()
+            dev = self._device()
+            if dev is not None and dev.platform == "tpu":
+                donate = (0,)
+            fn = jax.jit(loop_fn, donate_argnums=donate)
+            self._cache[key] = fn
+        fetches, new_state = fn(state_in, feeds, np.uint32(step0),
+                                np.int32(steps))
+        if FLAGS.benchmark:
+            jax.block_until_ready((fetches, new_state))
+        for n, val in new_state.items():
+            scope.set(n, val)
+        return self._post_fetches(fetch_names, lod_fetch, seg_fetch,
+                                  fetches, return_numpy)
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            feed_var_name="feed", fetch_var_name="fetch", scope=None,
+            return_numpy=True, use_program_cache=True):
+        import jax
+        import jax.numpy as jnp
+
+        if program is None:
+            program = default_main_program()
+        if feed is None:
+            feed = {}
+        if fetch_list is None:
+            fetch_list = []
+        if scope is None:
+            scope = global_scope()
+
+        fetch_names = tuple(_fetch_name(f) for f in fetch_list)
+
+        feeds = self._prepare_feeds(program, feed)
         feed_key = tuple(sorted(feeds.keys()))
 
         # for ragged fetches, also fetch the companion lengths (present in
@@ -334,7 +439,14 @@ class Executor:
             _check_nan_inf(fetch_names, fetches, new_state)
         for n, val in new_state.items():
             scope.set(n, val)
+        return self._post_fetches(fetch_names, lod_fetch, seg_fetch,
+                                  fetches, return_numpy)
 
+    @staticmethod
+    def _post_fetches(fetch_names, lod_fetch, seg_fetch, fetches,
+                      return_numpy):
+        """Reassemble fetched values; ragged ones (with @LOD_LEN
+        companions) become LoDTensors, nested levels from @LOD_SEG."""
         n_names = len(fetch_names)
         lens_by_name = dict(zip(lod_fetch,
                                 fetches[n_names:n_names + len(lod_fetch)]))

@@ -1,0 +1,131 @@
+"""Executor.run_loop: K training steps as one device computation.
+
+The device-side loop (lax.fori_loop over the jitted step) must produce
+the same parameter trajectory as K individual Executor.run calls —
+including the per-op RNG streams folding the step counter, so dropout
+masks differ across loop iterations exactly as under run(). Host-op
+programs are rejected loudly. Reference analogue: the reader-op
+training loops that kept the device busy without per-step feeds
+(benchmark/fluid fluid_benchmark.py --use_reader_op).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _build(with_dropout=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        if with_dropout:
+            h = fluid.layers.dropout(h, dropout_prob=0.3)
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _feed():
+    rng = np.random.RandomState(0)
+    return {"x": rng.randn(4, 8).astype("float32"),
+            "y": rng.randn(4, 1).astype("float32")}
+
+
+def test_matches_per_step_trajectory():
+    feed = _feed()
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(5):
+            per_step = exe.run(main, feed=feed, fetch_list=[loss])[0]
+
+    main2, startup2, loss2 = _build()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup2)
+        looped = exe2.run_loop(main2, feed=feed, fetch_list=[loss2],
+                               steps=5)[0]
+    np.testing.assert_allclose(np.asarray(per_step), np.asarray(looped),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_steps_one_equals_single_run():
+    feed = _feed()
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        single = exe.run(main, feed=feed, fetch_list=[loss])[0]
+
+    main2, startup2, loss2 = _build()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup2)
+        looped = exe2.run_loop(main2, feed=feed, fetch_list=[loss2],
+                               steps=1)[0]
+    np.testing.assert_allclose(np.asarray(single), np.asarray(looped),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_dropout_steps_see_distinct_rng():
+    """Two consecutive run_loop dispatches continue the step counter, and
+    a dropout model's loop trajectory matches per-step runs (same
+    per-step RNG folding)."""
+    feed = _feed()
+    main, startup, loss = _build(with_dropout=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        traj = [exe.run(main, feed=feed, fetch_list=[loss])[0]
+                for _ in range(4)]
+
+    main2, startup2, loss2 = _build(with_dropout=True)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup2)
+        l2 = exe2.run_loop(main2, feed=feed, fetch_list=[loss2], steps=2)
+        l4 = exe2.run_loop(main2, feed=feed, fetch_list=[loss2], steps=2)
+    np.testing.assert_allclose(np.asarray(traj[1]), np.asarray(l2[0]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(traj[3]), np.asarray(l4[0]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_host_op_program_rejected():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(input=x, size=2)
+        # save is a host op (file IO side effect)
+        main.global_block().append_op(
+            type="save", inputs={"X": [out]}, outputs={},
+            attrs={"file_path": "/tmp/run_loop_reject.bin"})
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="host op"):
+            exe.run_loop(main, feed={"x": np.zeros((2, 4), "float32")},
+                         fetch_list=[out], steps=3)
+
+
+def test_check_nan_inf_rejected():
+    """FLAGS.check_nan_inf needs per-op attribution; run_loop refuses
+    rather than silently skip the checks run() would perform."""
+    from paddle_tpu.flags import FLAGS
+    feed = _feed()
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        FLAGS.check_nan_inf = True
+        try:
+            with pytest.raises(RuntimeError, match="check_nan_inf"):
+                exe.run_loop(main, feed=feed, fetch_list=[loss], steps=2)
+        finally:
+            FLAGS.check_nan_inf = False

@@ -39,6 +39,14 @@ CONFIGS = [
     # per-step rows above stay the reference-faithful comparison
     ("mnist_cnn_pipelined", ["--model", "mnist", "--fetch_every", "10"],
      512, 64),
+    # device-side loop: 10 steps per dispatch (lax.fori_loop over the
+    # jitted step) — measures chip throughput with host/relay round
+    # trips amortized away entirely
+    ("mnist_cnn_deviceloop", ["--model", "mnist", "--device_loop", "10"],
+     512, 64),
+    ("resnet50_deviceloop",
+     ["--model", "resnet", "--data_set", "imagenet", "--layout", "NHWC",
+      "--device_loop", "10"], 256, 8),
     ("stacked_dynamic_lstm_pipelined",
      ["--model", "stacked_dynamic_lstm", "--fetch_every", "10"], 64, 8),
     # whole-graph AD + rematerialized backward (ROOFLINE.md remat lever);

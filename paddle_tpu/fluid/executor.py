@@ -205,6 +205,17 @@ class Executor:
             self._cache[key] = fn
         return fn
 
+    def _host_ops_cached(self, program):
+        """(contains_host_ops, has_subblock_host_ops) memoized per
+        (program identity, version)."""
+        hkey = (id(program), program._version)
+        cached = self._host_op_cache.get(hkey)
+        if cached is None:
+            cached = (functionalizer.contains_host_ops(program),
+                      functionalizer.has_subblock_host_ops(program))
+            self._host_op_cache[hkey] = cached
+        return cached
+
     def _prepare_feeds(self, program, feed):
         """numpy -> device arrays with var dtype; LoDTensor (ragged)
         feeds become padded [B, T, ...] + <name>@LOD_LEN lengths, with T
@@ -285,13 +296,7 @@ class Executor:
             raise RuntimeError(
                 "run_loop: FLAGS.check_nan_inf needs per-op attribution, "
                 "which requires per-step execution — use Executor.run")
-        hkey = (id(program), program._version)
-        cached_host = self._host_op_cache.get(hkey)
-        if cached_host is None:
-            cached_host = (functionalizer.contains_host_ops(program),
-                           functionalizer.has_subblock_host_ops(program))
-            self._host_op_cache[hkey] = cached_host
-        if cached_host[0]:
+        if self._host_ops_cached(program)[0]:
             raise RuntimeError(
                 "run_loop: the program contains host ops (RPC/IO/python "
                 "callbacks) and cannot run as one device computation — "
@@ -309,7 +314,6 @@ class Executor:
         state_in = {n: scope.get(n) for n in persistables
                     if scope.has(n) and scope.get(n) is not None}
         step0 = self._step_counters.get(id(program), 0)
-        self._step_counters[id(program)] = step0 + steps
 
         from ..ops.registry import amp_enabled
         key = ("loop", id(program), program._version, feed_key, fetch_ext,
@@ -343,6 +347,9 @@ class Executor:
             self._cache[key] = fn
         fetches, new_state = fn(state_in, feeds, np.uint32(step0),
                                 np.int32(steps))
+        # only a successful dispatch advances the counter — a build or
+        # compile failure must not skew the RNG step fold for later runs
+        self._step_counters[id(program)] = step0 + steps
         if FLAGS.benchmark:
             jax.block_until_ready((fetches, new_state))
         for n, val in new_state.items():
@@ -353,9 +360,6 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name="feed", fetch_var_name="fetch", scope=None,
             return_numpy=True, use_program_cache=True):
-        import jax
-        import jax.numpy as jnp
-
         if program is None:
             program = default_main_program()
         if feed is None:
@@ -382,13 +386,8 @@ class Executor:
         # params that are not yet in the scope); input state is whatever
         # already exists. The jit signature keys on the input dict structure.
         persistables = tuple(functionalizer.persistable_names(program))
+        has_host, has_sub_host = self._host_ops_cached(program)
         hkey = (id(program), program._version)
-        cached = self._host_op_cache.get(hkey)
-        if cached is None:
-            cached = (functionalizer.contains_host_ops(program),
-                      functionalizer.has_subblock_host_ops(program))
-            self._host_op_cache[hkey] = cached
-        has_host, has_sub_host = cached
         from ..flags import FLAGS
         state_in = {n: scope.get(n) for n in persistables
                     if scope.has(n) and scope.get(n) is not None}

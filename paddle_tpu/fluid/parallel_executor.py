@@ -107,6 +107,7 @@ class ParallelExecutor:
             data_parallel_mesh(use_cuda=use_cuda)
         self._num_devices = int(np.prod(list(self._mesh.shape.values())))
         self._cache = {}
+        self._host_ops_flag = {}  # program version -> has host ops
         self._step = 0
         # BuildStrategy pass pipeline (reference build_strategy.cc:27
         # ParallelExecutorPassBuilder chains passes before graph build)
@@ -215,11 +216,9 @@ class ParallelExecutor:
         self._cache[key] = fn
         return fn
 
-    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
-        """reference parallel_executor.py:169. `feed` may be one dict (full
-        global batch, split across devices — the reference's split path) or a
-        list of per-device dicts (concatenated here, then sharded)."""
-        import jax
+    def _prepare_feeds(self, feed, feed_dict=None):
+        """Merge per-device feed lists, cast to var dtypes, and shard on
+        the batch axis of the mesh."""
         import jax.numpy as jnp
         if feed is None:
             feed = feed_dict
@@ -231,8 +230,6 @@ class ParallelExecutor:
                 merged[k] = np.concatenate(
                     [np.asarray(d[k]) for d in feed], axis=0)
             feed = merged
-
-        fetch_names = tuple(_fetch_name(f) for f in fetch_list)
         gb = self._main_program.global_block()
         feeds = {}
         for name, value in feed.items():
@@ -246,10 +243,77 @@ class ParallelExecutor:
             if arr.ndim == 0:
                 feeds[name] = jnp.asarray(arr)
             else:
-                # multi-trainer: `arr` is this trainer's LOCAL batch; the
-                # global array spans num_trainers x local (the reference's
-                # per-trainer reader semantics in nccl2 mode)
                 feeds[name] = self._put(arr, self._batch_sharding(arr.ndim))
+        return feeds
+
+    def run_loop(self, fetch_list, feed=None, steps=1, return_numpy=True):
+        """`steps` SPMD training steps as ONE device computation — the
+        multi-chip analogue of Executor.run_loop: lax.fori_loop over the
+        mesh-sharded jitted step with a constant sharded feed, one
+        dispatch per `steps` steps. Gradient all-reduces stay inside the
+        single XLA computation, so a pod iterates without any host
+        involvement between steps."""
+        import jax
+        import jax.numpy as jnp
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError("run_loop: steps must be >= 1")
+        hkey = self._main_program._version
+        if self._host_ops_flag.get(hkey) is None:
+            self._host_ops_flag[hkey] = \
+                functionalizer.contains_host_ops(self._main_program)
+        if self._host_ops_flag[hkey]:
+            raise RuntimeError(
+                "run_loop: the program contains host ops and cannot run "
+                "as one device computation — use ParallelExecutor.run")
+        fetch_names = tuple(_fetch_name(f) for f in fetch_list)
+        feeds = self._prepare_feeds(feed)
+        feed_key = tuple(sorted(feeds.keys()))
+        persistables = tuple(
+            functionalizer.persistable_names(self._main_program))
+        from ..ops.registry import amp_enabled
+        key = ("loop", feed_key, fetch_names, persistables,
+               self._main_program._version, amp_enabled())
+        fn = self._cache.get(key)
+        if fn is None:
+            step_fn = functionalizer.build_step_fn(
+                self._main_program, feed_key, fetch_names, persistables,
+                mesh=self._mesh)
+
+            def loop_fn(state, feeds, step0, nsteps):
+                # first step outside the loop: input state may be a
+                # subset of the full persistable carry structure
+                carry = step_fn(state, feeds, step0)
+
+                def body(i, carry):
+                    return step_fn(carry[1], feeds,
+                                   step0 + jnp.uint32(i))
+                return jax.lax.fori_loop(1, nsteps, body, carry)
+
+            donate = (0,) if any(d.platform == "tpu"
+                                 for d in self._mesh.devices.flat) else ()
+            fn = jax.jit(loop_fn, donate_argnums=donate)
+            self._cache[key] = fn
+        state_in = {n: self._scope.get(n) for n in persistables
+                    if self._scope.get(n) is not None}
+        fetches, new_state = fn(state_in, feeds,
+                                np.uint32(self._step), np.int32(steps))
+        self._step += steps
+        for n, val in new_state.items():
+            self._scope.set(n, val)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        """reference parallel_executor.py:169. `feed` may be one dict (full
+        global batch, split across devices — the reference's split path) or a
+        list of per-device dicts (concatenated here, then sharded). In
+        nccl2 multi-trainer mode each array is this trainer's LOCAL
+        batch; the global array spans num_trainers x local (the
+        reference's per-trainer reader semantics)."""
+        fetch_names = tuple(_fetch_name(f) for f in fetch_list)
+        feeds = self._prepare_feeds(feed, feed_dict)
         feed_key = tuple(sorted(feeds.keys()))
 
         persistables = tuple(

@@ -129,3 +129,32 @@ def test_check_nan_inf_rejected():
                 exe.run_loop(main, feed=feed, fetch_list=[loss], steps=2)
         finally:
             FLAGS.check_nan_inf = False
+
+
+def test_parallel_executor_run_loop_matches_per_step():
+    """SPMD device-loop: K looped steps over an 8-device dp mesh must
+    reproduce K per-step ParallelExecutor.run calls (the gradient
+    all-reduce stays inside the single XLA computation)."""
+    feed = _feed()   # batch 4; pad to 8 so it shards over 8 devices
+    feed = {"x": np.concatenate([feed["x"]] * 2),
+            "y": np.concatenate([feed["y"]] * 2)}
+
+    def build_pe():
+        main, startup, loss = _build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    main_program=main)
+        return pe, loss
+
+    with fluid.scope_guard(fluid.Scope()):
+        pe, loss = build_pe()
+        for _ in range(4):
+            per_step = pe.run(fetch_list=[loss], feed=feed)[0]
+
+    with fluid.scope_guard(fluid.Scope()):
+        pe2, loss2 = build_pe()
+        looped = pe2.run_loop(fetch_list=[loss2], feed=feed, steps=4)[0]
+
+    np.testing.assert_allclose(np.asarray(per_step), np.asarray(looped),
+                               rtol=1e-5, atol=1e-6)

@@ -158,3 +158,46 @@ def test_parallel_executor_run_loop_matches_per_step():
 
     np.testing.assert_allclose(np.asarray(per_step), np.asarray(looped),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_lod_program_device_loop():
+    """Ragged (LoD) feeds ride run_loop too: the padded-dense encoding +
+    @LOD_LEN companions are constants across loop iterations, so the
+    dynamic-LSTM training trajectory matches per-step execution."""
+    from paddle_tpu.fluid.lod import LoDTensor
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32",
+                                  lod_level=1)
+            fc = fluid.layers.fc(input=x, size=16 * 4)
+            h, c = fluid.layers.dynamic_lstm(input=fc, size=16 * 4)
+            pool = fluid.layers.sequence_pool(h, pool_type="max")
+            pred = fluid.layers.fc(input=pool, size=1)
+            loss = fluid.layers.mean(pred)
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    lens = [3, 5, 2]
+    flat = rng.randn(sum(lens), 8).astype("float32")
+    t = LoDTensor(flat)
+    t.set_recursive_sequence_lengths([lens])
+    feed = {"x": t}
+
+    with fluid.scope_guard(fluid.Scope()):
+        main, startup, loss = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(3):
+            per_step = exe.run(main, feed=feed, fetch_list=[loss])[0]
+
+    with fluid.scope_guard(fluid.Scope()):
+        main2, startup2, loss2 = build()
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        looped = exe2.run_loop(main2, feed=feed, fetch_list=[loss2],
+                               steps=3)[0]
+    np.testing.assert_allclose(np.asarray(per_step), np.asarray(looped),
+                               rtol=1e-5, atol=1e-6)

@@ -47,6 +47,8 @@ CONFIGS = [
     ("resnet50_deviceloop",
      ["--model", "resnet", "--data_set", "imagenet", "--layout", "NHWC",
       "--device_loop", "10"], 256, 8),
+    ("stacked_dynamic_lstm_deviceloop",
+     ["--model", "stacked_dynamic_lstm", "--device_loop", "10"], 64, 8),
     ("stacked_dynamic_lstm_pipelined",
      ["--model", "stacked_dynamic_lstm", "--fetch_every", "10"], 64, 8),
     # whole-graph AD + rematerialized backward (ROOFLINE.md remat lever);

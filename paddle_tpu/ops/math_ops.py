@@ -81,7 +81,11 @@ def _make_activations():
         "swish": lambda ctx, x: x * jax.nn.sigmoid(ctx.attr("beta", 1.0) * x),
         "thresholded_relu": lambda ctx, x: jnp.where(
             x > ctx.attr("threshold", 1.0), x, 0.0).astype(x.dtype),
-        "gelu": _act(jax.nn.gelu),
+        # default is the exact erf form (torch's default; the 2018
+        # reference has no gelu op) — later-era programs may carry an
+        # 'approximate' attr requesting the tanh form
+        "gelu": lambda ctx, x: jax.nn.gelu(
+            x, approximate=bool(ctx.attr("approximate", False))),
         "erf": _act(jax.scipy.special.erf),
         "sign": _act(jnp.sign),
         "logical_not": _act(jnp.logical_not),

@@ -298,7 +298,8 @@ def _fused_elemwise_activation(ctx):
     elif act == "tanh":
         out = jnp.tanh(s)
     elif act == "gelu":
-        out = jax.nn.gelu(s)
+        out = jax.nn.gelu(
+            s, approximate=bool(ctx.attr("approximate", False)))
     else:
         raise NotImplementedError(act)
     return {"Out": out}

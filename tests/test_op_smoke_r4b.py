@@ -1,0 +1,231 @@
+"""Numeric oracles for the op tail with no direct test coverage (r4b).
+
+An audit of the 326-op registry against the test corpus found ~100 op
+types never named in any test (most are reached indirectly through
+layers; some were not exercised at all). This file pins the pure-math
+tail — activations, elementwise variants, comparisons, reductions,
+tensor manipulation, RNG moments — to numpy oracles through the same
+direct-lowering harness as test_op_tail. Reference kernels:
+activation_op.cc, elementwise ops, reduce_op.cc, compare_op.cc,
+gaussian_random_op.cc, uniform_random_op.cc, pad2d_op.cc etc.
+"""
+
+import numpy as np
+import pytest
+
+from tests.test_op_tail import run_op
+
+RNG = np.random.RandomState(7)
+X = RNG.randn(3, 5).astype(np.float32)
+Y = (RNG.randn(3, 5) + 1.1).astype(np.float32)
+
+
+def _one(op, inputs, attrs=None, out="Out", **kw):
+    r = run_op(op, inputs, attrs or {}, **kw)
+    return np.asarray(r[out])
+
+
+ACTIVATIONS = [
+    ("brelu", {"t_min": -0.5, "t_max": 0.5},
+     lambda x: np.clip(x, -0.5, 0.5)),
+    ("relu6", {}, lambda x: np.clip(x, 0, 6)),
+    ("soft_relu", {"threshold": 40.0},
+     lambda x: np.log1p(np.exp(np.clip(x, -40, 40)))),
+    ("softplus", {}, lambda x: np.log1p(np.exp(x))),
+    ("logsigmoid", {}, lambda x: -np.log1p(np.exp(-x))),
+    ("reciprocal", {}, lambda x: 1.0 / x),
+    ("rsqrt", {}, None),   # positive-shifted oracle in the test body
+    ("cos", {}, np.cos),
+    ("erf", {}, None),   # scipy-free: checked against tanh approx bound
+    ("gelu", {}, None),   # math.erf-based oracle in the test body
+    ("hard_sigmoid", {"slope": 0.2, "offset": 0.5},
+     lambda x: np.clip(0.2 * x + 0.5, 0, 1)),
+    ("hard_shrink", {"threshold": 0.5},
+     lambda x: np.where(np.abs(x) > 0.5, x, 0)),
+    ("softshrink", {"lambda": 0.5},
+     lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0))),
+    ("tanh_shrink", {}, lambda x: x - np.tanh(x)),
+    ("thresholded_relu", {"threshold": 1.0},
+     lambda x: np.where(x > 1.0, x, 0)),
+    ("swish", {"beta": 1.0}, lambda x: x / (1.0 + np.exp(-x))),
+]
+
+
+@pytest.mark.parametrize("op,attrs,ref",
+                         ACTIVATIONS, ids=[a[0] for a in ACTIVATIONS])
+def test_activation_tail(op, attrs, ref):
+    x = X + 2.0 if op == "rsqrt" else X   # rsqrt needs positive input
+    got = _one(op, {"X": x}, attrs)
+    if op == "rsqrt":
+        np.testing.assert_allclose(got, 1.0 / np.sqrt(x), rtol=1e-5)
+        return
+    if op in ("erf", "gelu"):
+        import math
+        erf = np.vectorize(math.erf)(x / (np.sqrt(2.0) if op == "gelu"
+                                          else 1.0))
+        ref_v = (erf if op == "erf"
+                 else 0.5 * x * (1.0 + erf)).astype(np.float32)
+    else:
+        ref_v = ref(x).astype(np.float32)
+    np.testing.assert_allclose(got, ref_v, rtol=1e-5, atol=1e-6)
+
+
+ELEMENTWISE = [
+    ("elementwise_max", np.maximum),
+    ("elementwise_min", np.minimum),
+    ("elementwise_pow", np.power),
+    ("elementwise_mod", None),
+    ("elementwise_floordiv", None),
+]
+
+
+@pytest.mark.parametrize("op,ref", ELEMENTWISE,
+                         ids=[e[0] for e in ELEMENTWISE])
+def test_elementwise_tail(op, ref):
+    if op in ("elementwise_mod", "elementwise_floordiv"):
+        a = RNG.randint(1, 50, (3, 5)).astype(np.int64)
+        b = RNG.randint(1, 7, (3, 5)).astype(np.int64)
+        got = _one(op, {"X": a, "Y": b})
+        want = np.mod(a, b) if op == "elementwise_mod" \
+            else np.floor_divide(a, b)
+        np.testing.assert_array_equal(got, want)
+        return
+    a = np.abs(X) + 0.5 if op == "elementwise_pow" else X
+    got = _one(op, {"X": a, "Y": Y})
+    np.testing.assert_allclose(got, ref(a, Y), rtol=1e-5)
+
+
+COMPARE = [
+    ("greater_than", np.greater),
+    ("greater_equal", np.greater_equal),
+    ("less_equal", np.less_equal),
+    ("not_equal", np.not_equal),
+]
+
+
+@pytest.mark.parametrize("op,ref", COMPARE, ids=[c[0] for c in COMPARE])
+def test_compare_tail(op, ref):
+    a = RNG.randint(0, 3, (4, 4)).astype(np.int64)
+    b = RNG.randint(0, 3, (4, 4)).astype(np.int64)
+    got = _one(op, {"X": a, "Y": b})
+    np.testing.assert_array_equal(got.astype(bool), ref(a, b))
+
+
+def test_logical_tail():
+    a = np.array([[True, False], [True, True]])
+    b = np.array([[False, False], [True, False]])
+    np.testing.assert_array_equal(
+        _one("logical_not", {"X": a}).astype(bool), ~a)
+    np.testing.assert_array_equal(
+        _one("logical_xor", {"X": a, "Y": b}).astype(bool), a ^ b)
+
+
+REDUCE = [
+    ("reduce_min", np.min),
+    ("reduce_prod", np.prod),
+    ("reduce_any", np.any),
+]
+
+
+@pytest.mark.parametrize("op,ref", REDUCE, ids=[r[0] for r in REDUCE])
+def test_reduce_tail(op, ref):
+    x = (np.abs(X) > 1.0) if op == "reduce_any" else np.abs(X) + 0.5
+    got = _one(op, {"X": x.astype(np.float32) if op != "reduce_any"
+                    else x}, {"dim": [1], "keep_dim": False})
+    want = ref(x, axis=1)
+    if op == "reduce_any":
+        np.testing.assert_array_equal(got.astype(bool), want)
+    else:
+        np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-5)
+
+
+def test_arg_max_min():
+    got = _one("arg_max", {"X": X}, {"axis": 1})
+    np.testing.assert_array_equal(got, np.argmax(X, axis=1))
+    got = _one("arg_min", {"X": X}, {"axis": 0})
+    np.testing.assert_array_equal(got, np.argmin(X, axis=0))
+
+
+def test_tensor_manipulation_tail():
+    # gather_nd / scatter_nd
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    idx = np.array([[0, 2], [1, 0]], np.int64)
+    np.testing.assert_array_equal(
+        _one("gather_nd", {"X": x, "Index": idx}), x[[0, 1], [2, 0]])
+    upd = np.ones((2, 4), np.float32)
+    got = _one("scatter_nd", {"Index": idx, "Updates": upd},
+               {"shape": [2, 3, 4]})
+    want = np.zeros((2, 3, 4), np.float32)
+    want[0, 2] += 1
+    want[1, 0] += 1
+    np.testing.assert_array_equal(got, want)
+    # strided_slice
+    got = _one("strided_slice", {"Input": x},
+               {"axes": [1], "starts": [0], "ends": [3], "strides": [2]})
+    np.testing.assert_array_equal(got, x[:, 0:3:2])
+    # unstack
+    r = run_op("unstack", {"X": x}, {"axis": 0, "num": 2}, )
+    outs = [np.asarray(v) for v in r["Y"]]
+    np.testing.assert_array_equal(outs[0], x[0])
+    np.testing.assert_array_equal(outs[1], x[1])
+    # space_to_depth
+    s = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    got = _one("space_to_depth", {"X": s}, {"blocksize": 2})
+    assert got.shape == (1, 4, 2, 2)
+    # pad2d
+    p = _one("pad2d", {"X": s}, {"paddings": [1, 1, 2, 2],
+                                 "mode": "constant", "pad_value": 0.0})
+    assert p.shape == (1, 1, 6, 8)
+    np.testing.assert_array_equal(p[0, 0, 1:5, 2:6], s[0, 0])
+    # pixel_shuffle
+    ps_in = np.arange(16, dtype=np.float32).reshape(1, 4, 2, 2)
+    ps = _one("pixel_shuffle", {"X": ps_in}, {"upscale_factor": 2})
+    assert ps.shape == (1, 1, 4, 4)
+    # linspace
+    ls = _one("linspace", {"Start": np.float32(0.0),
+                           "Stop": np.float32(1.0),
+                           "Num": np.int32(5)})
+    np.testing.assert_allclose(ls, np.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_fill_zeros_like_and_is_empty():
+    z = _one("fill_zeros_like", {"X": X})
+    np.testing.assert_array_equal(z, np.zeros_like(X))
+    assert not bool(_one("is_empty", {"X": X}))
+
+
+def test_rng_moments():
+    """Distribution sanity for the random tail: mean/std within loose
+    bounds (deterministic seeds — exact reproducibility is covered by
+    the framework RNG tests)."""
+    g = _one("gaussian_random", {}, {"shape": [2000], "mean": 1.0,
+                                     "std": 2.0, "seed": 3})
+    assert abs(g.mean() - 1.0) < 0.2 and abs(g.std() - 2.0) < 0.2
+    u = _one("uniform_random", {}, {"shape": [2000], "min": -1.0,
+                                    "max": 3.0, "seed": 3})
+    assert u.min() >= -1.0 and u.max() <= 3.0
+    assert abs(u.mean() - 1.0) < 0.2
+    t = _one("truncated_gaussian_random", {},
+             {"shape": [2000], "mean": 0.0, "std": 1.0, "seed": 3})
+    assert np.abs(t).max() <= 2.0 + 1e-5   # truncated at 2 std
+    ub = _one("uniform_random_batch_size_like", {"Input": X},
+              {"shape": [0, 7], "min": 0.0, "max": 1.0, "seed": 1})
+    assert ub.shape == (3, 7)
+
+
+def test_norm_tail():
+    got = _one("squared_l2_norm", {"X": X})
+    np.testing.assert_allclose(np.asarray(got).ravel()[0],
+                               (X ** 2).sum(), rtol=1e-5)
+    got = _one("clip_by_norm", {"X": X}, {"max_norm": 1.0})
+    np.testing.assert_allclose(
+        got, X * (1.0 / max(1.0, np.sqrt((X ** 2).sum()))), rtol=1e-5)
+    g = RNG.randn(4, 6).astype(np.float32)
+    gn = _one("group_norm", {"X": g.reshape(1, 4, 6, 1),
+                             "Scale": np.ones(4, np.float32),
+                             "Bias": np.zeros(4, np.float32)},
+              {"groups": 2, "epsilon": 1e-5}, out="Y")
+    grp = g.reshape(2, 12)
+    want = ((grp - grp.mean(1, keepdims=True))
+            / np.sqrt(grp.var(1, keepdims=True) + 1e-5)).reshape(1, 4, 6, 1)
+    np.testing.assert_allclose(gn, want, rtol=1e-4, atol=1e-5)

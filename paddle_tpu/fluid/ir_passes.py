@@ -139,6 +139,11 @@ class FuseElewiseAddActPass(Pass):
                     self._single_use(blk, add_op.outputs["Out"][0])):
                 fused = blk.ops[i]
                 fused.type = "fused_elemwise_activation"
+                # the activation's own attrs (e.g. gelu's 'approximate')
+                # must survive the fusion or the fused lowering reads
+                # defaults the unfused path would not have used
+                for k, v in act_op.attrs.items():
+                    fused.attrs.setdefault(k, v)
                 fused.attrs["functor_list"] = [
                     "elementwise_add", act_op.type]
                 fused.attrs["axis"] = add_op.attrs.get("axis", -1)

@@ -36,7 +36,7 @@ ACTIVATIONS = [
     ("reciprocal", {}, lambda x: 1.0 / x),
     ("rsqrt", {}, None),   # positive-shifted oracle in the test body
     ("cos", {}, np.cos),
-    ("erf", {}, None),   # scipy-free: checked against tanh approx bound
+    ("erf", {}, None),   # math.erf oracle in the test body (scipy-free)
     ("gelu", {}, None),   # math.erf-based oracle in the test body
     ("hard_sigmoid", {"slope": 0.2, "offset": 0.5},
      lambda x: np.clip(0.2 * x + 0.5, 0, 1)),

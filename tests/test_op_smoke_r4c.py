@@ -1,0 +1,150 @@
+"""Numeric oracles, batch 2: sequence/loss/unit-cell op tail (r4c).
+
+Continues test_op_smoke_r4b for the ragged-sequence tail and the
+remaining losses/cells, restating the reference kernel formulas in
+numpy. Reference kernels: sequence_concat_op, sequence_pad_op,
+sequence_enumerate_op, sequence_slice_op, smooth_l1_loss_op.h,
+margin_rank_loss_op.h, fsp_op, gru_unit_op.h:90-116,
+max_sequence_len_op, shuffle_batch_op, scale_sub_region (legacy).
+"""
+
+import numpy as np
+
+from tests.test_op_tail import run_op
+
+RNG = np.random.RandomState(11)
+
+
+def _np(r, key="Out"):
+    return np.asarray(r[key])
+
+
+def test_sequence_concat_ragged():
+    a = RNG.randn(2, 3, 4).astype(np.float32)
+    b = RNG.randn(2, 2, 4).astype(np.float32)
+    la, lb = np.int32([2, 3]), np.int32([1, 2])
+    # multi-input slot: call the lowering directly with a list
+    import jax.numpy as jnp
+    from tests.test_op_tail import _FakeOp
+    from paddle_tpu.ops import registry as ops
+    op = _FakeOp("sequence_concat", attrs={}, inputs={"X": ["a", "b"]})
+    vals = {"X": [jnp.asarray(a), jnp.asarray(b)],
+            "X@LOD_LEN": [jnp.asarray(la), jnp.asarray(lb)]}
+    od = ops.get_op_def("sequence_concat")
+    r = ops.call_lower(od, ops.ExecContext(op, vals))
+    out, lens = _np(r), _np(r, "Out@LOD_LEN")
+    np.testing.assert_array_equal(lens, la + lb)
+    for i in range(2):
+        want = np.concatenate([a[i, :la[i]], b[i, :lb[i]]])
+        np.testing.assert_allclose(out[i, :la[i] + lb[i]], want, rtol=1e-6)
+
+
+def test_sequence_pad_and_unpad_roundtrip():
+    x = RNG.randn(3, 4, 2).astype(np.float32)
+    lens = np.int32([2, 4, 1])
+    r = run_op("sequence_pad", {"X": x, "PadValue": np.float32([0.0])},
+               {"padded_length": 6}, lod={"X": lens})
+    out, length = _np(r), _np(r, "Length")
+    assert out.shape == (3, 6, 2)
+    np.testing.assert_array_equal(length, lens)
+    for i in range(3):
+        np.testing.assert_allclose(out[i, :lens[i]], x[i, :lens[i]])
+        np.testing.assert_allclose(out[i, lens[i]:], 0.0)
+    r2 = run_op("sequence_unpad", {"X": out, "Length": length}, {})
+    np.testing.assert_array_equal(_np(r2, "Out@LOD_LEN"), lens)
+
+
+def test_sequence_enumerate_windows():
+    x = np.int64([[1, 2, 3, 4], [5, 6, 0, 0]])
+    lens = np.int32([4, 2])
+    r = run_op("sequence_enumerate", {"X": x},
+               {"win_size": 2, "pad_value": 0}, lod={"X": lens})
+    out = _np(r)
+    # reference: per position the next win ids, pad_value past the end
+    np.testing.assert_array_equal(out[0], [[1, 2], [2, 3], [3, 4], [4, 0]])
+    np.testing.assert_array_equal(out[1, :2], [[5, 6], [6, 0]])
+
+
+def test_sequence_slice_per_row():
+    x = RNG.randn(2, 5).astype(np.float32)
+    r = run_op("sequence_slice",
+               {"X": x, "Offset": np.int64([[1], [0]]),
+                "Length": np.int64([[3], [2]])}, {})
+    out, lens = _np(r), _np(r, "Out@LOD_LEN")
+    np.testing.assert_array_equal(lens, [3, 2])
+    np.testing.assert_allclose(out[0, :3], x[0, 1:4], rtol=1e-6)
+    np.testing.assert_allclose(out[1, :2], x[1, 0:2], rtol=1e-6)
+
+
+def test_smooth_l1_loss_huber():
+    x = RNG.randn(4, 3).astype(np.float32)
+    y = RNG.randn(4, 3).astype(np.float32)
+    sigma = 2.0
+    r = run_op("smooth_l1_loss", {"X": x, "Y": y}, {"sigma": sigma})
+    d = x - y
+    ad = np.abs(d)
+    s2 = sigma * sigma
+    loss = np.where(ad < 1.0 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2)
+    np.testing.assert_allclose(_np(r).ravel(), loss.sum(1), rtol=1e-5)
+
+
+def test_margin_rank_loss():
+    x1 = RNG.randn(5, 1).astype(np.float32)
+    x2 = RNG.randn(5, 1).astype(np.float32)
+    lab = np.where(RNG.rand(5, 1) > 0.5, 1.0, -1.0).astype(np.float32)
+    r = run_op("margin_rank_loss", {"X1": x1, "X2": x2, "Label": lab},
+               {"margin": 0.1})
+    want = np.maximum(0.0, -lab * (x1 - x2) + 0.1)
+    np.testing.assert_allclose(_np(r), want, rtol=1e-5)
+    np.testing.assert_array_equal(_np(r, "Activated"), (want > 0))
+
+
+def test_fsp_matrix():
+    x = RNG.randn(2, 3, 4, 5).astype(np.float32)
+    y = RNG.randn(2, 6, 4, 5).astype(np.float32)
+    r = run_op("fsp", {"X": x, "Y": y}, {})
+    want = np.einsum("nchw,ndhw->ncd", x, y) / 20.0
+    np.testing.assert_allclose(_np(r), want, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_unit_reference_formula():
+    """gru_unit_op.h:90-116: gates [u, r, c], r_h_p = r*h_prev feeds the
+    candidate GEMM, h = u*(c - h_prev) + h_prev."""
+    B, H = 3, 4
+    x = RNG.randn(B, 3 * H).astype(np.float32)
+    hp = RNG.randn(B, H).astype(np.float32)
+    w = (RNG.randn(H, 3 * H) * 0.5).astype(np.float32)
+    r = run_op("gru_unit", {"Input": x, "HiddenPrev": hp, "Weight": w},
+               {"activation": "tanh", "gate_activation": "sigmoid"})
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+    g_ur = x[:, :2 * H] + hp @ w[:, :2 * H]
+    u = sig(g_ur[:, :H])
+    rr = sig(g_ur[:, H:])
+    c = np.tanh(x[:, 2 * H:] + (rr * hp) @ w[:, 2 * H:])
+    h = u * (c - hp) + hp
+    np.testing.assert_allclose(_np(r, "Hidden"), h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(r, "ResetHiddenPrev"), rr * hp,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_shuffle_batch_is_permutation():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    r = run_op("shuffle_batch", {"X": x}, {})
+    out = _np(r)
+    np.testing.assert_allclose(np.sort(out[:, 0]), x[:, 0])
+    idx = _np(r, "ShuffleIdx")
+    np.testing.assert_array_equal(np.sort(idx), np.arange(6))
+    np.testing.assert_allclose(out, x[idx])
+
+
+def test_scale_sub_region_box():
+    x = np.ones((1, 2, 4, 4), np.float32)
+    idx = np.int32([[1, 1, 2, 3, 2, 4]])   # 1-based inclusive
+    r = run_op("scale_sub_region", {"X": x, "Indices": idx},
+               {"value": 3.0})
+    out = _np(r)
+    want = np.ones_like(x)
+    want[0, 0, 1:3, 1:4] = 3.0
+    np.testing.assert_allclose(out, want)

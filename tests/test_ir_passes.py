@@ -175,3 +175,49 @@ def test_fc_lstm_fuse_pass_preserves_numerics():
             (res,) = exe.run(main, feed={"w": t}, fetch_list=[out])
             results[fuse] = np.asarray(res)
     np.testing.assert_allclose(results[True], results[False], atol=1e-5)
+
+
+def test_fuse_elewise_add_act_keeps_act_attrs():
+    """The fusion must carry the activation op's own attrs (e.g. gelu's
+    'approximate') onto fused_elemwise_activation, or the fused lowering
+    reads defaults the unfused program would not have used."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import ir_passes
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            a = fluid.layers.data("a", shape=[6], dtype="float32")
+            b = fluid.layers.data("b", shape=[6], dtype="float32")
+            s = fluid.layers.elementwise_add(a, b)
+            blk = main.global_block()
+            out = blk.create_var(name="gelu_out", shape=[-1, 6],
+                                 dtype="float32")
+            blk.append_op(type="gelu", inputs={"X": [s.name]},
+                          outputs={"Out": [out.name]},
+                          attrs={"approximate": True})
+        return main, startup, out
+
+    x = np.random.RandomState(0).randn(2, 6).astype("float32")
+    y = np.random.RandomState(1).randn(2, 6).astype("float32")
+
+    main, startup, out = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        unfused = exe.run(main, feed={"a": x, "b": y},
+                          fetch_list=[out])[0]
+
+    main2, startup2, out2 = build()
+    ir_passes.get_pass("fuse_elewise_add_act_pass").apply(main2)
+    ops = [op.type for op in main2.global_block().ops]
+    assert "fused_elemwise_activation" in ops and "gelu" not in ops
+    fused_op = [op for op in main2.global_block().ops
+                if op.type == "fused_elemwise_activation"][0]
+    assert fused_op.attrs.get("approximate") is True
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup2)
+        fused = exe.run(main2, feed={"a": x, "b": y},
+                        fetch_list=[out2])[0]
+    np.testing.assert_allclose(unfused, fused, rtol=1e-6)

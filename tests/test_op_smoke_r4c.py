@@ -23,15 +23,8 @@ def test_sequence_concat_ragged():
     a = RNG.randn(2, 3, 4).astype(np.float32)
     b = RNG.randn(2, 2, 4).astype(np.float32)
     la, lb = np.int32([2, 3]), np.int32([1, 2])
-    # multi-input slot: call the lowering directly with a list
-    import jax.numpy as jnp
-    from tests.test_op_tail import _FakeOp
-    from paddle_tpu.ops import registry as ops
-    op = _FakeOp("sequence_concat", attrs={}, inputs={"X": ["a", "b"]})
-    vals = {"X": [jnp.asarray(a), jnp.asarray(b)],
-            "X@LOD_LEN": [jnp.asarray(la), jnp.asarray(lb)]}
-    od = ops.get_op_def("sequence_concat")
-    r = ops.call_lower(od, ops.ExecContext(op, vals))
+    r = run_op("sequence_concat", {"X": [a, b]}, {},
+               lod={"X": [la, lb]})
     out, lens = _np(r), _np(r, "Out@LOD_LEN")
     np.testing.assert_array_equal(lens, la + lb)
     for i in range(2):

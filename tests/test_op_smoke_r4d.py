@@ -1,0 +1,121 @@
+"""Numeric oracles, batch 3: optimizer/metric/rank + XShape tail (r4d).
+
+Reference kernels: proximal_gd_op.h (prox = p - lr*g, soft-threshold by
+lr*l1, shrink by 1+lr*l2), precision_recall_op.h ([C,4] TP/FP/TN/FN
+states, macro + micro metrics), legacy LambdaCost (pairwise
+|deltaNDCG| * log(1+exp(-ds)) truncated at NDCG_num), reshape2/
+transpose2/squeeze2/unsqueeze2/flatten2 XShape contract, assign_value.
+"""
+
+import numpy as np
+
+from tests.test_op_tail import run_op
+
+RNG = np.random.RandomState(13)
+
+
+def _np(r, key="Out"):
+    return np.asarray(r[key])
+
+
+def test_proximal_gd_formula():
+    p = RNG.randn(4).astype(np.float32)
+    g = RNG.randn(4).astype(np.float32)
+    lr = np.float32([0.1])
+    l1, l2 = 0.05, 0.2
+    r = run_op("proximal_gd",
+               {"Param": p, "Grad": g, "LearningRate": lr},
+               {"l1": l1, "l2": l2})
+    prox = p - 0.1 * g
+    want = (np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0.0)
+            / (1.0 + 0.1 * l2))
+    np.testing.assert_allclose(_np(r, "ParamOut"), want, rtol=1e-5)
+
+
+def test_precision_recall_micro_macro():
+    # 3 classes; predictions [0,1,1,2], labels [0,2,1,2]
+    idx = np.int32([[0], [1], [1], [2]])
+    lab = np.int32([[0], [2], [1], [2]])
+    states = np.zeros((3, 4), np.float32)
+    r = run_op("precision_recall",
+               {"Indices": idx, "Labels": lab, "StatesInfo": states},
+               {"class_number": 3})
+    # per-class: c0 tp1 fp0 fn0; c1 tp1 fp1 fn0; c2 tp1 fp0 fn1
+    tp = np.float32([1, 1, 1])
+    fp = np.float32([0, 1, 0])
+    fn = np.float32([0, 0, 1])
+    prec = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1e-12), 1.0)
+    rec = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1e-12), 1.0)
+    f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-12)
+    macro = [prec.mean(), rec.mean(), f1.mean()]
+    stp, sfp, sfn = tp.sum(), fp.sum(), fn.sum()
+    mp, mr = stp / (stp + sfp), stp / (stp + sfn)
+    micro = [mp, mr, 2 * mp * mr / (mp + mr)]
+    np.testing.assert_allclose(_np(r, "BatchMetrics"),
+                               np.float32(macro + micro), rtol=1e-5)
+    st = _np(r, "AccumStatesInfo")
+    np.testing.assert_allclose(st[:, 0], tp)
+    np.testing.assert_allclose(st[:, 1], fp)
+    np.testing.assert_allclose(st[:, 3], fn)
+
+
+def test_lambda_rank_bruteforce():
+    score = np.float32([[0.2, 1.5, -0.3, 0.8]])
+    rel = np.float32([[1.0, 2.0, 0.0, 0.0]])
+    ndcg_num = 3
+    r = run_op("lambda_rank", {"Score": score, "Label": rel},
+               {"NDCG_num": ndcg_num})
+    got = float(_np(r).ravel()[0])
+
+    s, g = score[0], (2.0 ** rel[0]) - 1.0
+    order = np.argsort(-s)
+    pos = np.argsort(order)
+    disc = np.where(pos < ndcg_num, 1.0 / np.log2(pos + 2.0), 0.0)
+    ideal = np.sort(g)[::-1][:ndcg_num]
+    max_dcg = np.sum(ideal / np.log2(np.arange(len(ideal)) + 2.0))
+    want = 0.0
+    for i in range(4):
+        for j in range(4):
+            if rel[0, i] > rel[0, j]:
+                dndcg = abs((g[i] - g[j]) * (disc[i] - disc[j])) / max_dcg
+                want += dndcg * np.log1p(np.exp(-(s[i] - s[j])))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_xshape_contract():
+    """reshape2/transpose2/squeeze2/unsqueeze2/flatten2 emit Out plus an
+    XShape the reference grad kernels use to reconstruct input shape."""
+    x = RNG.randn(2, 3, 4).astype(np.float32)
+
+    def xshape_of(r):
+        assert "XShape" in r, "XShape output missing"
+        return tuple(np.asarray(r["XShape"]).shape)
+
+    r = run_op("reshape2", {"X": x}, {"shape": [2, 12]})
+    assert _np(r).shape == (2, 12)
+    assert xshape_of(r)[-3:] == (2, 3, 4)
+
+    r = run_op("transpose2", {"X": x}, {"axis": [2, 0, 1]})
+    np.testing.assert_allclose(_np(r), np.transpose(x, (2, 0, 1)))
+    assert xshape_of(r)[-3:] == (2, 3, 4)
+
+    xs = RNG.randn(2, 1, 3, 1).astype(np.float32)
+    r = run_op("squeeze2", {"X": xs}, {"axes": [1, 3]})
+    assert _np(r).shape == (2, 3)
+    assert xshape_of(r)[-4:] == (2, 1, 3, 1)
+
+    r = run_op("unsqueeze2", {"X": x}, {"axes": [0]})
+    assert _np(r).shape == (1, 2, 3, 4)
+    assert xshape_of(r)[-3:] == (2, 3, 4)
+
+    r = run_op("flatten2", {"X": x}, {"axis": 2})
+    assert _np(r).shape == (6, 4)
+    assert xshape_of(r)[-3:] == (2, 3, 4)
+
+
+def test_assign_value():
+    r = run_op("assign_value", {}, {"shape": [2, 2],
+                                    "dtype": 5,   # fp32
+                                    "fp32_values": [1.0, 2.0, 3.0, 4.0]})
+    np.testing.assert_allclose(_np(r),
+                               np.float32([[1, 2], [3, 4]]))

@@ -23,14 +23,22 @@ class _FakeOp:
 
 
 def run_op(type, inputs, attrs=None, lod=None):
-    """Directly invoke a lowering with concrete arrays (OpTest-style)."""
+    """Directly invoke a lowering with concrete arrays (OpTest-style).
+    A list/tuple input value feeds a multi-tensor slot (e.g.
+    sequence_concat's X); `lod` values follow the same convention."""
     import jax.numpy as jnp
-    vals = {k: [jnp.asarray(v)] for k, v in inputs.items()}
+
+    def dev(v):
+        if isinstance(v, (list, tuple)):
+            return [jnp.asarray(x) for x in v]
+        return [jnp.asarray(v)]
+    vals = {k: dev(v) for k, v in inputs.items()}
     if lod:
         for k, lens in lod.items():
-            vals[k + "@LOD_LEN"] = [jnp.asarray(lens)]
+            vals[k + "@LOD_LEN"] = dev(lens)
     op = _FakeOp(type, attrs=dict(attrs or {}),
-                 inputs={k: [k] for k in inputs})
+                 inputs={k: [k + "_%d" % i for i in range(len(vals[k]))]
+                         if len(vals[k]) > 1 else [k] for k in inputs})
     od = ops.get_op_def(type)
     return ops.call_lower(od, ExecContext(op, vals))
 

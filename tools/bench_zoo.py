@@ -21,17 +21,28 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 
 # (name, fluid_benchmark args, tpu batch, cpu smoke batch)
+# Ordered by information value per minute of relay uptime: the axon
+# transport has historically wedged partway through heavy sweeps
+# (TPU_OUTAGE_r03.md), and results persist incrementally — so configs
+# with NO real-chip number yet (or invalidated ones: se_resnext
+# predates the grouped-conv VJP fix) run first, re-confirmations of
+# r4-measured rows later, and the riskiest compiles (remat) last.
 CONFIGS = [
-    ("mnist_cnn", ["--model", "mnist"], 512, 64),
-    ("vgg16_cifar10", ["--model", "vgg", "--data_set", "cifar10"],
-     128, 8),
-    ("stacked_dynamic_lstm_ptb", ["--model", "stacked_dynamic_lstm"],
-     64, 8),
     ("se_resnext_imagenet", ["--model", "se_resnext",
                              "--layout", "NHWC"], 64, 4),
     ("resnet50_imagenet", ["--model", "resnet", "--data_set", "imagenet",
                            "--layout", "NHWC"], 256, 8),
     ("transformer_base_s512", ["--model", "transformer"], 32, 2),
+    # device-side loop: 10 steps per dispatch (lax.fori_loop over the
+    # jitted step) — measures chip throughput with host/relay round
+    # trips amortized away entirely
+    ("resnet50_deviceloop",
+     ["--model", "resnet", "--data_set", "imagenet", "--layout", "NHWC",
+      "--device_loop", "10"], 256, 8),
+    ("mnist_cnn_deviceloop", ["--model", "mnist", "--device_loop", "10"],
+     512, 64),
+    ("stacked_dynamic_lstm_deviceloop",
+     ["--model", "stacked_dynamic_lstm", "--device_loop", "10"], 64, 8),
     ("machine_translation_wmt", ["--model", "machine_translation"], 16, 4),
     # pipelined variants: fetch (host sync) every 10 steps instead of
     # each one — shows the small-model throughput with async dispatch
@@ -39,21 +50,18 @@ CONFIGS = [
     # per-step rows above stay the reference-faithful comparison
     ("mnist_cnn_pipelined", ["--model", "mnist", "--fetch_every", "10"],
      512, 64),
-    # device-side loop: 10 steps per dispatch (lax.fori_loop over the
-    # jitted step) — measures chip throughput with host/relay round
-    # trips amortized away entirely
-    ("mnist_cnn_deviceloop", ["--model", "mnist", "--device_loop", "10"],
-     512, 64),
-    ("resnet50_deviceloop",
-     ["--model", "resnet", "--data_set", "imagenet", "--layout", "NHWC",
-      "--device_loop", "10"], 256, 8),
-    ("stacked_dynamic_lstm_deviceloop",
-     ["--model", "stacked_dynamic_lstm", "--device_loop", "10"], 64, 8),
     ("stacked_dynamic_lstm_pipelined",
      ["--model", "stacked_dynamic_lstm", "--fetch_every", "10"], 64, 8),
+    # re-confirmations of rows measured on silicon earlier in r4
+    ("mnist_cnn", ["--model", "mnist"], 512, 64),
+    ("vgg16_cifar10", ["--model", "vgg", "--data_set", "cifar10"],
+     128, 8),
+    ("stacked_dynamic_lstm_ptb", ["--model", "stacked_dynamic_lstm"],
+     64, 8),
     # whole-graph AD + rematerialized backward (ROOFLINE.md remat lever);
     # ineligible programs fail loudly (functionalizer refuses to run a
-    # baseline under a remat label) rather than skewing the sweep
+    # baseline under a remat label) rather than skewing the sweep.
+    # Last: the remat compile is what wedged the transport in r4.
     ("resnet50_imagenet_remat",
      ["--model", "resnet", "--data_set", "imagenet", "--layout", "NHWC",
       "--whole_graph_ad", "--remat_policy", "conv_out"], 256, 8),

@@ -86,13 +86,17 @@ def main():
 
                 if ok:
                     parse_lines(out, "nhwc")
+                    # zoo BEFORE the remat flagship: the BENCH_REMAT
+                    # compile is what wedged the transport at the r4
+                    # session start — the riskiest run goes last so a
+                    # wedge there cannot cost the zoo
+                    run_logged([sys.executable, "tools/bench_zoo.py",
+                                "--out", "BENCH_zoo.json"], {}, log, 5400)
                     ok2, out2 = run_logged(
                         [sys.executable, "bench.py"],
                         {"BENCH_REMAT": "1"}, log, 1800)
                     if ok2:
                         parse_lines(out2, "nhwc+remat")
-                    run_logged([sys.executable, "tools/bench_zoo.py",
-                                "--out", "BENCH_zoo.json"], {}, log, 3600)
                     with open(os.path.join(REPO, "BENCH_watch.json"),
                               "w") as f:
                         json.dump(results, f, indent=1)

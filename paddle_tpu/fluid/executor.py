@@ -184,19 +184,14 @@ class Executor:
     def _get_jitted(self, program, feed_names, fetch_names, state_names):
         import jax
         from ..ops.registry import amp_enabled
-        from ..flags import FLAGS
+        wga, remat = functionalizer.flags_ad_config()
         key = (id(program), program._version, feed_names, fetch_names,
-               tuple(state_names), amp_enabled(),
-               FLAGS.whole_graph_ad, FLAGS.remat_policy)
+               tuple(state_names), amp_enabled(), wga, remat)
         fn = self._cache.get(key)
         if fn is None:
             step_fn = functionalizer.build_step_fn(
                 program, feed_names, fetch_names, state_names,
-                # a remat policy implies whole-graph AD: never let a
-                # policy-only FLAGS setting silently run the baseline
-                whole_graph_ad=(FLAGS.whole_graph_ad
-                                or bool(FLAGS.remat_policy)),
-                remat_policy=FLAGS.remat_policy or None)
+                whole_graph_ad=wga, remat_policy=remat)
             donate = ()
             dev = self._device()
             if dev is not None and dev.platform == "tpu":
@@ -316,16 +311,14 @@ class Executor:
         step0 = self._step_counters.get(id(program), 0)
 
         from ..ops.registry import amp_enabled
+        wga, remat = functionalizer.flags_ad_config()
         key = ("loop", id(program), program._version, feed_key, fetch_ext,
-               persistables, amp_enabled(), FLAGS.whole_graph_ad,
-               FLAGS.remat_policy)
+               persistables, amp_enabled(), wga, remat)
         fn = self._cache.get(key)
         if fn is None:
             step_fn = functionalizer.build_step_fn(
                 program, feed_key, fetch_ext, persistables,
-                whole_graph_ad=(FLAGS.whole_graph_ad
-                                or bool(FLAGS.remat_policy)),
-                remat_policy=FLAGS.remat_policy or None)
+                whole_graph_ad=wga, remat_policy=remat)
 
             def loop_fn(state, feeds, step0, nsteps):
                 # first step OUTSIDE the loop: the input state may be a

@@ -356,6 +356,17 @@ def run_block(block, env, step=0, seed=0, mesh=None, vjp_cache=None):
                           vjp_cache=vjp_cache)
 
 
+def flags_ad_config():
+    """(whole_graph_ad, remat_policy) derived from FLAGS — a remat
+    policy implies whole-graph AD so a policy-only setting never
+    silently runs the per-op baseline. The single source for every
+    jit-cache construction site (Executor/ParallelExecutor, per-step
+    and loop paths); cache keys must include this tuple."""
+    from ..flags import FLAGS
+    return (FLAGS.whole_graph_ad or bool(FLAGS.remat_policy),
+            FLAGS.remat_policy or None)
+
+
 def build_step_fn(program, feed_names, fetch_names, state_names,
                   block_idx=0, mesh=None, whole_graph_ad=False,
                   remat_policy=None):

@@ -196,14 +196,15 @@ class ParallelExecutor:
     def _get_jitted(self, feed_key, fetch_names, state_names):
         import jax
         from ..ops.registry import amp_enabled
+        wga, remat = functionalizer.flags_ad_config()
         key = (feed_key, fetch_names, tuple(state_names),
-               self._main_program._version, amp_enabled())
+               self._main_program._version, amp_enabled(), wga, remat)
         fn = self._cache.get(key)
         if fn is not None:
             return fn
         step_fn = functionalizer.build_step_fn(
             self._main_program, feed_key, fetch_names, state_names,
-            mesh=self._mesh)
+            mesh=self._mesh, whole_graph_ad=wga, remat_policy=remat)
         rep = self._replicated_sharding()
 
         def wrapped(state, feeds, step):
@@ -272,13 +273,14 @@ class ParallelExecutor:
         persistables = tuple(
             functionalizer.persistable_names(self._main_program))
         from ..ops.registry import amp_enabled
+        wga, remat = functionalizer.flags_ad_config()
         key = ("loop", feed_key, fetch_names, persistables,
-               self._main_program._version, amp_enabled())
+               self._main_program._version, amp_enabled(), wga, remat)
         fn = self._cache.get(key)
         if fn is None:
             step_fn = functionalizer.build_step_fn(
                 self._main_program, feed_key, fetch_names, persistables,
-                mesh=self._mesh)
+                mesh=self._mesh, whole_graph_ad=wga, remat_policy=remat)
 
             def loop_fn(state, feeds, step0, nsteps):
                 # first step outside the loop: input state may be a

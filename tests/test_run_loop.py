@@ -201,3 +201,39 @@ def test_lod_program_device_loop():
                                steps=3)[0]
     np.testing.assert_allclose(np.asarray(per_step), np.asarray(looped),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_parallel_executor_whole_graph_remat():
+    """Remat (whole-graph AD) composes with the SPMD ParallelExecutor:
+    the mesh-sharded remat step trains with the same trajectory as the
+    per-op PE baseline (jax.checkpoint only trades memory for
+    recompute). The benchmark's --remat_policy + --parallel path rides
+    this."""
+    from paddle_tpu.flags import FLAGS
+    feed = _feed()
+    feed = {"x": np.concatenate([feed["x"]] * 2),
+            "y": np.concatenate([feed["y"]] * 2)}
+
+    def run(remat):
+        main, startup, loss = _build()
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            old = FLAGS.whole_graph_ad, FLAGS.remat_policy
+            if remat:
+                FLAGS.whole_graph_ad = True
+                FLAGS.remat_policy = "dots"
+            try:
+                pe = fluid.ParallelExecutor(
+                    use_cuda=False, loss_name=loss.name,
+                    main_program=main)
+                traj = [np.asarray(pe.run(fetch_list=[loss],
+                                          feed=feed)[0]).ravel()[0]
+                        for _ in range(3)]
+            finally:
+                FLAGS.whole_graph_ad, FLAGS.remat_policy = old
+        return traj
+
+    base = run(remat=False)
+    remat = run(remat=True)
+    np.testing.assert_allclose(base, remat, rtol=1e-4, atol=1e-5)

@@ -86,6 +86,11 @@ def probe_backend(timeout=120):
 
 
 def run_config(name, extra, batch, iterations, force_cpu):
+    if force_cpu and "--device_loop" in extra:
+        # smoke mode only checks the path works; a 10-deep loop of
+        # resnet-class steps on CPU blows the per-config timeout
+        extra = list(extra)
+        extra[extra.index("--device_loop") + 1] = "2"
     cmd = [sys.executable, os.path.join(HERE, "fluid_benchmark.py"),
            "--batch_size", str(batch), "--iterations", str(iterations),
            "--skip_batch_num", "2"] + extra
@@ -93,8 +98,15 @@ def run_config(name, extra, batch, iterations, force_cpu):
     if force_cpu:
         cmd += ["--device", "CPU"]
     t0 = time.time()
-    proc = subprocess.run(cmd, capture_output=True, text=True,
-                          timeout=3600, cwd=REPO, env=env)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=1800, cwd=REPO, env=env)
+    except subprocess.TimeoutExpired:
+        # one wedged config must not cost the rest of the sweep — the
+        # whole point of the information-value ordering
+        return {"config": name, "error": "timeout after 1800s "
+                "(transport wedge or pathological config)",
+                "timeout": True, "wall_sec": round(time.time() - t0, 1)}
     wall = time.time() - t0
     if proc.returncode != 0:
         return {"config": name, "error": proc.stderr[-800:],
@@ -130,6 +142,7 @@ def main():
         "configs": [],
     }
     wanted = set(args.only.split(",")) if args.only else None
+    consecutive_timeouts = 0
     for name, extra, tpu_batch, cpu_batch in CONFIGS:
         if wanted and name not in wanted:
             continue
@@ -142,6 +155,17 @@ def main():
         # discard completed hour-scale runs
         with open(args.out, "w") as f:
             json.dump(results, f, indent=2)
+        consecutive_timeouts = consecutive_timeouts + 1 \
+            if rec.get("timeout") else 0
+        if consecutive_timeouts >= 2:
+            # two configs in a row hitting the ceiling means the
+            # transport is wedged, not the configs — stop burning the
+            # remaining budget
+            results["aborted"] = "2 consecutive config timeouts"
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=2)
+            print("aborting sweep: 2 consecutive timeouts", flush=True)
+            break
 
     print("wrote %s" % args.out)
 

@@ -134,13 +134,13 @@ def main():
     if not args.no_amp and jax.default_backend() == "tpu":
         fluid.set_amp(True)
     if args.whole_graph_ad or args.remat_policy:
-        if args.remat_policy and (args.parallel
-                                  or args.update_method != "local"):
-            # ParallelExecutor builds its own SPMD step and ignores
-            # FLAGS.whole_graph_ad — refuse rather than record a
-            # baseline number under a remat label
+        if args.remat_policy and args.update_method == "pserver":
+            # the transpiled pserver program interleaves RPC host ops;
+            # whole-graph AD cannot span them — refuse rather than
+            # record a baseline number under a remat label
             raise SystemExit(
-                "--remat_policy only supported with the local Executor")
+                "--remat_policy not supported with --update_method "
+                "pserver")
         from paddle_tpu.flags import FLAGS
         FLAGS.whole_graph_ad = True
         FLAGS.remat_policy = args.remat_policy

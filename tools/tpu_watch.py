@@ -69,9 +69,10 @@ def main():
             log.write("[%s] probe -> %s\n" % (stamp, backend))
             log.flush()
             if backend == "tpu":
-                # Chip is answering: take the flagship number first
-                # (20-min ceiling covers a slow relay compile), then the
-                # remat variant, then the zoo sweep.
+                # Chip is answering: flagship number first (20-min
+                # ceiling covers a slow relay compile), then the zoo
+                # sweep, then the remat flagship variant last (its
+                # compile is what wedged the transport in r4).
                 ok, out = run_logged(
                     [sys.executable, "bench.py"], {}, log, 1800)
                 def parse_lines(out, variant):
@@ -90,8 +91,12 @@ def main():
                     # compile is what wedged the transport at the r4
                     # session start — the riskiest run goes last so a
                     # wedge there cannot cost the zoo
+                    # per-config ceiling is 1800s and the sweep
+                    # self-aborts after 2 consecutive timeouts, so the
+                    # budget covers a full healthy run (~15 configs x
+                    # a few min) plus wedge detection
                     run_logged([sys.executable, "tools/bench_zoo.py",
-                                "--out", "BENCH_zoo.json"], {}, log, 5400)
+                                "--out", "BENCH_zoo.json"], {}, log, 14400)
                     ok2, out2 = run_logged(
                         [sys.executable, "bench.py"],
                         {"BENCH_REMAT": "1"}, log, 1800)

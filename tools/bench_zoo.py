@@ -157,7 +157,10 @@ def main():
             json.dump(results, f, indent=2)
         consecutive_timeouts = consecutive_timeouts + 1 \
             if rec.get("timeout") else 0
-        if consecutive_timeouts >= 2:
+        # smoke mode's heavy vision configs can legitimately hit the
+        # per-config ceiling on CPU — only a real-chip sweep treats
+        # consecutive timeouts as a transport wedge
+        if consecutive_timeouts >= 2 and not force_cpu:
             # two configs in a row hitting the ceiling means the
             # transport is wedged, not the configs — stop burning the
             # remaining budget

@@ -145,12 +145,12 @@ def main():
         FLAGS.whole_graph_ad = True
         FLAGS.remat_policy = args.remat_policy
 
-    if args.device_loop > 0 and (args.parallel
-                                  or args.update_method != "local"):
-        # refuse rather than record a per-step run under a device_loop
-        # label (same contract as the remat guard above)
+    if args.device_loop > 0 and args.update_method == "pserver":
+        # the pserver program interleaves RPC host ops; a device loop
+        # cannot span them — refuse rather than record a per-step run
+        # under a device_loop label (same contract as the remat guard)
         raise SystemExit(
-            "--device_loop only supported with the local Executor")
+            "--device_loop not supported with --update_method pserver")
     main_prog, startup, feeds, loss, acc, _ = build_model(args)
     feeds = [main_prog.global_block().var(f) if isinstance(f, str) else f
              for f in feeds]
@@ -231,8 +231,13 @@ def main():
                         or i == n_warm + n_timed - 1)
         if args.device_loop > 0:
             # one dispatch covers device_loop steps; fetch fences it
-            outs = exe.run_loop(main_prog, feed=feed, fetch_list=fetch,
-                                steps=args.device_loop)
+            if pe is not None:
+                outs = pe.run_loop(fetch_list=fetch, feed=feed,
+                                   steps=args.device_loop)
+            else:
+                outs = exe.run_loop(main_prog, feed=feed,
+                                    fetch_list=fetch,
+                                    steps=args.device_loop)
             last = float(np.asarray(outs[0]).ravel()[0])
             if i >= n_warm:
                 examples += batch * args.device_loop

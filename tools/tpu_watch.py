@@ -4,8 +4,9 @@ The axon relay wedges under load (TPU_OUTAGE_r03.md): devices enumerate
 at session start, then the first heavy compile can hang the transport
 for hours. This watcher probes the backend in short-timeout subprocesses
 every --interval seconds; the moment a probe answers "tpu" it runs the
-flagship bench (NHWC, then the BENCH_REMAT=1 variant) and the model-zoo
-sweep, appending everything to --log and writing the bench JSON lines to
+flagship bench (NHWC), then the model-zoo sweep, then the BENCH_REMAT=1
+flagship variant LAST (its compile is what wedged the transport in r4),
+appending everything to --log and writing the bench JSON lines to
 BENCH_watch.json so a recovered chip is never missed between manual
 checks.
 

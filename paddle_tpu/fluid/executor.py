@@ -159,6 +159,58 @@ def _check_nan_inf(fetch_names, fetches, new_state):
             "attribution)" % ", ".join(bad))
 
 
+def prepare_feeds(program, feed, device_put=True):
+    """numpy -> device arrays with var dtype; LoDTensor (ragged) feeds
+    become padded [B, T, ...] + <name>@LOD_LEN lengths, with T bucketed
+    to a power of two to bound recompiles. Shared by Executor and
+    ParallelExecutor; the latter passes device_put=False so values stay
+    host-side (or on their original device for jax.Array feeds) and the
+    ONLY transfer is the sharded device_put over the mesh — committing
+    a pod-global batch to device 0 first could OOM it."""
+    import jax
+    import jax.numpy as jnp
+    put = jnp.asarray if device_put else np.asarray
+    gb = program.global_block()
+    feeds = {}
+    for name, value in feed.items():
+        v = gb._find_var_recursive(name)
+        from .lod import LoDTensor, pad_lod_feed
+        if isinstance(value, LoDTensor) and value.lod():
+            padded, lengths, seg = pad_lod_feed(value)
+            if v is not None and v.dtype is not None:
+                want = core.convert_dtype_to_np(v.dtype)
+                if padded.dtype != want and not (
+                        padded.dtype.kind in "iu" and want.kind in "iu"):
+                    padded = padded.astype(want)
+            feeds[name] = jnp.asarray(padded)
+            feeds[name + functionalizer.LOD_LEN_SUFFIX] = \
+                jnp.asarray(lengths)
+            if seg is not None:
+                feeds[name + functionalizer.LOD_SEG_SUFFIX] = \
+                    jnp.asarray(seg)
+            continue
+        if isinstance(value, jax.Array):
+            # already on device (PyReader double-buffer path) — do NOT
+            # round-trip through numpy, that would force D2H + H2D
+            arr = value
+            if v is not None and v.dtype is not None:
+                want = core.convert_dtype_to_np(v.dtype)
+                if arr.dtype != want and not (
+                        np.dtype(arr.dtype).kind in "iu"
+                        and want.kind in "iu"):
+                    arr = arr.astype(want)
+            feeds[name] = arr
+            continue
+        arr = np.asarray(value)
+        if v is not None and v.dtype is not None:
+            want = core.convert_dtype_to_np(v.dtype)
+            if arr.dtype != want and not (
+                    arr.dtype.kind in "iu" and want.kind in "iu"):
+                arr = arr.astype(want)
+        feeds[name] = jnp.asarray(arr)
+    return feeds
+
+
 class Executor:
     """reference executor.py:256. `place` selects the jax backend; under jit
     there is no per-op placement, so CPUPlace/TPUPlace only choose where the
@@ -212,50 +264,8 @@ class Executor:
         return cached
 
     def _prepare_feeds(self, program, feed):
-        """numpy -> device arrays with var dtype; LoDTensor (ragged)
-        feeds become padded [B, T, ...] + <name>@LOD_LEN lengths, with T
-        bucketed to a power of two to bound recompiles."""
-        import jax
-        import jax.numpy as jnp
-        gb = program.global_block()
-        feeds = {}
-        for name, value in feed.items():
-            v = gb._find_var_recursive(name)
-            from .lod import LoDTensor, pad_lod_feed
-            if isinstance(value, LoDTensor) and value.lod():
-                padded, lengths, seg = pad_lod_feed(value)
-                if v is not None and v.dtype is not None:
-                    want = core.convert_dtype_to_np(v.dtype)
-                    if padded.dtype != want and not (
-                            padded.dtype.kind in "iu" and want.kind in "iu"):
-                        padded = padded.astype(want)
-                feeds[name] = jnp.asarray(padded)
-                feeds[name + functionalizer.LOD_LEN_SUFFIX] = \
-                    jnp.asarray(lengths)
-                if seg is not None:
-                    feeds[name + functionalizer.LOD_SEG_SUFFIX] = \
-                        jnp.asarray(seg)
-                continue
-            if isinstance(value, jax.Array):
-                # already on device (PyReader double-buffer path) — do NOT
-                # round-trip through numpy, that would force D2H + H2D
-                arr = value
-                if v is not None and v.dtype is not None:
-                    want = core.convert_dtype_to_np(v.dtype)
-                    if arr.dtype != want and not (
-                            np.dtype(arr.dtype).kind in "iu"
-                            and want.kind in "iu"):
-                        arr = arr.astype(want)
-                feeds[name] = arr
-                continue
-            arr = np.asarray(value)
-            if v is not None and v.dtype is not None:
-                want = core.convert_dtype_to_np(v.dtype)
-                if arr.dtype != want and not (
-                        arr.dtype.kind in "iu" and want.kind in "iu"):
-                    arr = arr.astype(want)
-            feeds[name] = jnp.asarray(arr)
-        return feeds
+        return prepare_feeds(program, feed)
+
 
     def run_loop(self, program=None, feed=None, fetch_list=None,
                  steps=1, scope=None, return_numpy=True):

@@ -218,9 +218,12 @@ class ParallelExecutor:
         return fn
 
     def _prepare_feeds(self, feed, feed_dict=None):
-        """Merge per-device feed lists, cast to var dtypes, and shard on
-        the batch axis of the mesh."""
+        """Merge per-device feed lists, then run the Executor's shared
+        feed preparation (dtype casts; LoDTensor -> padded dense +
+        @LOD_LEN companions) and shard every batch-dim array on the
+        mesh's data axis."""
         import jax.numpy as jnp
+        from .executor import prepare_feeds
         if feed is None:
             feed = feed_dict
         if feed is None:
@@ -231,20 +234,21 @@ class ParallelExecutor:
                 merged[k] = np.concatenate(
                     [np.asarray(d[k]) for d in feed], axis=0)
             feed = merged
-        gb = self._main_program.global_block()
+        import jax
+        dense = prepare_feeds(self._main_program, feed, device_put=False)
         feeds = {}
-        for name, value in feed.items():
-            arr = np.asarray(value)
-            v = gb._find_var_recursive(name)
-            if v is not None and v.dtype is not None:
-                want = core.convert_dtype_to_np(v.dtype)
-                if arr.dtype != want and not (
-                        arr.dtype.kind in "iu" and want.kind in "iu"):
-                    arr = arr.astype(want)
+        for name, arr in dense.items():
             if arr.ndim == 0:
                 feeds[name] = jnp.asarray(arr)
-            else:
-                feeds[name] = self._put(arr, self._batch_sharding(arr.ndim))
+                continue
+            # @LOD_LEN/@LOD_SEG companions are batch-dim vectors and
+            # shard with their payload. jax.Array feeds (PyReader
+            # double-buffer) go straight to the sharded device_put —
+            # no host round-trip — except in multi-trainer mode, where
+            # make_array_from_process_local_data wants host data.
+            if isinstance(arr, jax.Array) and self._num_trainers > 1:
+                arr = np.asarray(arr)
+            feeds[name] = self._put(arr, self._batch_sharding(arr.ndim))
         return feeds
 
     def run_loop(self, fetch_list, feed=None, steps=1, return_numpy=True):

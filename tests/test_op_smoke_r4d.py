@@ -119,3 +119,46 @@ def test_assign_value():
                                     "fp32_values": [1.0, 2.0, 3.0, 4.0]})
     np.testing.assert_allclose(_np(r),
                                np.float32([[1, 2], [3, 4]]))
+
+
+def test_mine_hard_examples_max_negative():
+    """mine_hard_examples_op.cc kMaxNegative: negatives = unmatched
+    priors (match index < 0) with match distance under the threshold,
+    ranked by classification loss descending, capped at
+    neg_pos_ratio * num_positives."""
+    cls_loss = np.float32([[0.9, 0.1, 0.8, 0.4, 0.7, 0.2]])
+    midx = np.int32([[2, -1, -1, -1, -1, -1]])   # 1 positive
+    mdist = np.float32([[0.9, 0.1, 0.2, 0.6, 0.3, 0.1]])
+    r = run_op("mine_hard_examples",
+               {"ClsLoss": cls_loss, "MatchIndices": midx,
+                "MatchDist": mdist},
+               {"neg_pos_ratio": 2.0, "neg_dist_threshold": 0.5,
+                "mining_type": "max_negative"})
+    lens = int(np.asarray(r["NegIndices@LOD_LEN"]).ravel()[0])
+    # candidates: priors 1,2,4,5 (unmatched & dist<0.5); cap = 2*1 = 2;
+    # by loss desc: prior 2 (0.8), prior 4 (0.7)
+    assert lens == 2
+    neg = np.asarray(r["NegIndices"])[0, :lens]
+    np.testing.assert_array_equal(np.sort(neg), [2, 4])
+    np.testing.assert_array_equal(
+        np.asarray(r["UpdatedMatchIndices"]), midx)
+
+
+def test_mine_hard_examples_hard_example_drops_unselected_pos():
+    """kHardExample: top sample_size priors by loss are selected;
+    positives NOT selected get dropped (match index -> -1)."""
+    cls_loss = np.float32([[0.9, 0.1, 0.8, 0.4]])
+    midx = np.int32([[0, 1, -1, -1]])     # priors 0,1 positive
+    mdist = np.float32([[0.1, 0.1, 0.2, 0.1]])
+    r = run_op("mine_hard_examples",
+               {"ClsLoss": cls_loss, "MatchIndices": midx,
+                "MatchDist": mdist},
+               {"sample_size": 2, "neg_dist_threshold": 0.5,
+                "mining_type": "hard_example"})
+    upd = np.asarray(r["UpdatedMatchIndices"])[0]
+    # selected top-2 by loss: priors 0 (0.9) and 2 (0.8); positive prior
+    # 1 was not selected -> dropped; prior 2 is the one negative
+    np.testing.assert_array_equal(upd, [0, -1, -1, -1])
+    lens = int(np.asarray(r["NegIndices@LOD_LEN"]).ravel()[0])
+    assert lens == 1
+    assert int(np.asarray(r["NegIndices"])[0, 0]) == 2

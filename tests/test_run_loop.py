@@ -237,3 +237,58 @@ def test_parallel_executor_whole_graph_remat():
     base = run(remat=False)
     remat = run(remat=True)
     np.testing.assert_allclose(base, remat, rtol=1e-4, atol=1e-5)
+
+
+def test_parallel_executor_handles_ragged_lod_feed():
+    """PE shares the Executor's feed preparation, so ragged LoDTensor
+    feeds pad + carry @LOD_LEN companions and shard over the mesh —
+    pe.run and pe.run_loop train a dynamic-LSTM model with trajectories
+    matching the single-device Executor."""
+    from paddle_tpu.fluid.lod import LoDTensor
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32",
+                                  lod_level=1)
+            fc = fluid.layers.fc(input=x, size=16 * 4)
+            h, c = fluid.layers.dynamic_lstm(input=fc, size=16 * 4)
+            pool = fluid.layers.sequence_pool(h, pool_type="max")
+            pred = fluid.layers.fc(input=pool, size=1)
+            loss = fluid.layers.mean(pred)
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    lens = [3, 5, 2, 4, 1, 2, 3, 4]     # 8 sequences -> shards over 8
+    flat = rng.randn(sum(lens), 8).astype("float32")
+    t = LoDTensor(flat)
+    t.set_recursive_sequence_lengths([lens])
+    feed = {"x": t}
+
+    with fluid.scope_guard(fluid.Scope()):
+        main, startup, loss = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ref = [np.asarray(exe.run(main, feed=feed,
+                                  fetch_list=[loss])[0]).ravel()[0]
+               for _ in range(3)]
+
+    with fluid.scope_guard(fluid.Scope()):
+        main2, startup2, loss2 = build()
+        fluid.Executor(fluid.CPUPlace()).run(startup2)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss2.name,
+                                    main_program=main2)
+        got = [np.asarray(pe.run(fetch_list=[loss2],
+                                 feed=feed)[0]).ravel()[0]
+               for _ in range(3)]
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+    with fluid.scope_guard(fluid.Scope()):
+        main3, startup3, loss3 = build()
+        fluid.Executor(fluid.CPUPlace()).run(startup3)
+        pe3 = fluid.ParallelExecutor(use_cuda=False, loss_name=loss3.name,
+                                     main_program=main3)
+        looped = pe3.run_loop(fetch_list=[loss3], feed=feed, steps=3)[0]
+    np.testing.assert_allclose(ref[-1], np.asarray(looped).ravel()[0],
+                               rtol=1e-5, atol=1e-6)

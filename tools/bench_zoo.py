@@ -131,10 +131,18 @@ def main():
     ap.add_argument("--iterations", type=int, default=20)
     ap.add_argument("--only", default=None,
                     help="comma-separated config-name filter")
+    ap.add_argument("--require_tpu", action="store_true",
+                    help="exit nonzero instead of falling back to CPU "
+                         "smoke when the chip does not answer (the "
+                         "watcher's recovery flow wants chip numbers "
+                         "or nothing)")
     args = ap.parse_args()
 
     backend = probe_backend()
     force_cpu = backend != "tpu"
+    if args.require_tpu and force_cpu:
+        print("TPU required but backend probe returned %r" % (backend,))
+        raise SystemExit(3)
     results = {
         "backend": backend or "cpu-fallback (TPU transport unreachable)",
         "smoke_mode": force_cpu,

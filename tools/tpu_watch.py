@@ -88,28 +88,40 @@ def main():
 
                 if ok:
                     parse_lines(out, "nhwc")
-                    # zoo BEFORE the remat flagship: the BENCH_REMAT
-                    # compile is what wedged the transport at the r4
-                    # session start — the riskiest run goes last so a
-                    # wedge there cannot cost the zoo
-                    # per-config ceiling is 1800s and the sweep
-                    # self-aborts after 2 consecutive timeouts, so the
-                    # budget covers a full healthy run (~15 configs x
-                    # a few min) plus wedge detection
-                    run_logged([sys.executable, "tools/bench_zoo.py",
-                                "--out", "BENCH_zoo.json"], {}, log, 14400)
-                    ok2, out2 = run_logged(
-                        [sys.executable, "bench.py"],
-                        {"BENCH_REMAT": "1"}, log, 1800)
-                    if ok2:
-                        parse_lines(out2, "nhwc+remat")
                     with open(os.path.join(REPO, "BENCH_watch.json"),
                               "w") as f:
                         json.dump(results, f, indent=1)
-                    log.write("[%s] sweep complete\n"
-                              % time.strftime("%H:%M:%S"))
-                    log.flush()
-                    return
+                    # zoo BEFORE the remat flagship: the BENCH_REMAT
+                    # compile is what wedged the transport at the r4
+                    # session start — the riskiest run goes last so a
+                    # wedge there cannot cost the zoo. Per-config
+                    # ceiling is 1800s with a 2-consecutive-timeout
+                    # abort, and --require_tpu fails fast if the
+                    # transport wedged after the flagship run.
+                    zoo_ok, _ = run_logged(
+                        [sys.executable, "tools/bench_zoo.py",
+                         "--out", "BENCH_zoo.json",
+                         "--require_tpu"], {}, log, 14400)
+                    if not zoo_ok:
+                        # transport wedged again between flagship and
+                        # zoo: keep probing instead of declaring the
+                        # sweep complete with zero zoo numbers
+                        log.write("[%s] zoo failed; resuming probe "
+                                  "loop\n" % time.strftime("%H:%M:%S"))
+                        log.flush()
+                    else:
+                        ok2, out2 = run_logged(
+                            [sys.executable, "bench.py"],
+                            {"BENCH_REMAT": "1"}, log, 1800)
+                        if ok2:
+                            parse_lines(out2, "nhwc+remat")
+                        with open(os.path.join(REPO, "BENCH_watch.json"),
+                                  "w") as f:
+                            json.dump(results, f, indent=1)
+                        log.write("[%s] sweep complete\n"
+                                  % time.strftime("%H:%M:%S"))
+                        log.flush()
+                        return
             if args.once:
                 return
             time.sleep(args.interval)

@@ -182,12 +182,10 @@ def prepare_feeds(program, feed, device_put=True):
                 if padded.dtype != want and not (
                         padded.dtype.kind in "iu" and want.kind in "iu"):
                     padded = padded.astype(want)
-            feeds[name] = jnp.asarray(padded)
-            feeds[name + functionalizer.LOD_LEN_SUFFIX] = \
-                jnp.asarray(lengths)
+            feeds[name] = put(padded)
+            feeds[name + functionalizer.LOD_LEN_SUFFIX] = put(lengths)
             if seg is not None:
-                feeds[name + functionalizer.LOD_SEG_SUFFIX] = \
-                    jnp.asarray(seg)
+                feeds[name + functionalizer.LOD_SEG_SUFFIX] = put(seg)
             continue
         if isinstance(value, jax.Array):
             # already on device (PyReader double-buffer path) — do NOT
@@ -207,7 +205,7 @@ def prepare_feeds(program, feed, device_put=True):
             if arr.dtype != want and not (
                     arr.dtype.kind in "iu" and want.kind in "iu"):
                 arr = arr.astype(want)
-        feeds[name] = jnp.asarray(arr)
+        feeds[name] = put(arr)
     return feeds
 
 

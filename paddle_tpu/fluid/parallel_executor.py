@@ -229,10 +229,36 @@ class ParallelExecutor:
         if feed is None:
             feed = {}
         if isinstance(feed, (list, tuple)):
+            from .lod import LoDTensor
             merged = {}
             for k in feed[0]:
-                merged[k] = np.concatenate(
-                    [np.asarray(d[k]) for d in feed], axis=0)
+                vals = [d[k] for d in feed]
+                if any(isinstance(v, LoDTensor) and v.lod() for v in vals):
+                    # merge data AND lod — np.concatenate alone would
+                    # strip the ragged structure via __array__: per
+                    # level, sequence lengths concatenate (each level's
+                    # offsets index rows of the next, and concatenation
+                    # preserves that nesting)
+                    if not all(isinstance(v, LoDTensor) and v.lod()
+                               for v in vals):
+                        raise ValueError(
+                            "feed '%s': mixed LoDTensor and dense "
+                            "entries across devices" % k)
+                    depth = len(vals[0].lod())
+                    if any(len(v.lod()) != depth for v in vals):
+                        raise ValueError(
+                            "feed '%s': inconsistent LoD depth across "
+                            "devices" % k)
+                    t = LoDTensor(np.concatenate(
+                        [v.numpy() for v in vals], axis=0))
+                    t.set_recursive_sequence_lengths(
+                        [sum((v.recursive_sequence_lengths()[lv]
+                              for v in vals), [])
+                         for lv in range(depth)])
+                    merged[k] = t
+                else:
+                    merged[k] = np.concatenate(
+                        [np.asarray(v) for v in vals], axis=0)
             feed = merged
         import jax
         dense = prepare_feeds(self._main_program, feed, device_put=False)

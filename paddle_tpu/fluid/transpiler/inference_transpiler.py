@@ -23,6 +23,11 @@ class InferenceTranspiler:
             scope = global_scope()
         self._remove_dropout(program)
         self._fuse_batch_norm(program, scope)
+        # NHWC residual blocks collapse onto the VMEM-resident Pallas
+        # kernel (ir_passes.FuseBottleneckPass); NCHW programs are left
+        # to XLA's per-conv fusion
+        from ..ir_passes import apply_passes
+        apply_passes(program, ["fuse_bottleneck_pass"])
         self._set_is_test(program)
         return program
 
@@ -112,12 +117,16 @@ class InferenceTranspiler:
             persistable=True)
         bias_var.persistable = True
         scope.set(bias_name, new_bias)
-        # BN becomes a per-channel bias add on the conv's raw output
+        # BN becomes a per-channel bias add on the conv's raw output;
+        # the broadcast axis follows the conv's activation layout (the
+        # channel dim is 1 for NCHW, trailing for NHWC)
         from ..framework import Operator
         conv_out = conv_op.output("Output")[0]
         bn_out = bn_op.output("Y")[0]
+        axis = 1 if conv_op.attrs.get("data_format", "NCHW") == "NCHW" \
+            else -1
         return Operator(
             block, "elementwise_add",
             inputs={"X": [conv_out], "Y": [bias_name]},
             outputs={"Out": [bn_out]},
-            attrs={"axis": 1})
+            attrs={"axis": axis})

@@ -16,7 +16,7 @@ import functools
 
 import numpy as np
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "fused_bottleneck", "bottleneck_reference"]
 
 _NEG_INF = -1e30
 
@@ -237,4 +237,250 @@ def _flash_attention_op(ctx):
     out = flash_attention(q, k, v, causal=bool(ctx.attr("causal", False)))
     if reshaped:
         out = out.reshape(B, S, Dm)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# Fused ResNet bottleneck (inference): the whole residual block — three
+# BN-folded convs, both relus, and the shortcut add — in one VMEM-resident
+# kernel. This is the "cross-layer fused conv pipeline" lever from
+# ROOFLINE.md: the unfused block round-trips every intermediate activation
+# through HBM; fused, only the block input and output touch HBM, roughly
+# halving activation traffic for the inference graph.
+#
+# Reference analogue: inference-time conv+bn+act fusion passes
+# (paddle/fluid/framework/ir/conv_bn_fuse_pass.cc and the TensorRT engine's
+# layer fusion); the reference stops at per-conv epilogue fusion — this
+# kernel fuses ACROSS the three convs of a block, which only makes sense on
+# TPU where VMEM is large enough to hold the intermediate tiles.
+#
+# Layout: NHWC only (channels in the lane dimension). 1x1 convs are plain
+# [rows, Cin] @ [Cin, Cout] matmuls on the MXU; the 3x3 is nine shifted
+# matmuls accumulated in fp32. Stride 2 (on the 3x3, ResNet v1.5 style like
+# paddle_tpu/models/resnet.py) is handled with reshape-decimation — Mosaic
+# has no general strided slice, but slicing an even run and dropping every
+# other row via reshape lowers cleanly.
+# ---------------------------------------------------------------------------
+
+
+def _bottleneck_kernel(x_ref, w0_ref, b0_ref, w1_ref, b1_ref, w2_ref,
+                       b2_ref, ws_ref, bs_ref, o_ref, *, H, W, stride,
+                       block_h, has_branch):
+    """One (batch, row-block) program.
+
+    x_ref    [1, H+2, W, C]   input, pre-padded by one zero row top/bottom
+    w0_ref   [C, F]           1x1 reduce (BN-folded)      b0_ref [1, F]
+    w1_ref   [9, F, F]        3x3 taps (BN-folded)        b1_ref [1, F]
+    w2_ref   [F, C4]          1x1 expand (BN-folded)      b2_ref [1, C4]
+    ws_ref   [C, C4]          projection shortcut         bs_ref [1, C4]
+                              (aliased to w0/b0 when has_branch is False)
+    o_ref    [1, block_h, Wo, C4]
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    s = stride
+    bh = block_h
+    Wo = W // s if s > 1 else W
+    F = w0_ref.shape[1]
+    C4 = w2_ref.shape[1]
+    io = pl.program_id(1)
+    o0 = io * bh                       # first output row of this program
+    ext = s * bh + 2                   # conv0 rows incl. the 3x3 halo
+
+    # -- conv0 (1x1) + bias + relu on the extended row window ------------
+    # padded-row r of the window corresponds to padded image row s*o0 + r;
+    # padded rows 0 and H+1 are the zero-pad ring: conv0 of a zero row is
+    # relu(b0) != 0, but the 3x3's true pad operates on a1, so those rows
+    # must be exact zeros — mask them.
+    x_ext = x_ref[0, pl.ds(o0 * s, ext), :, :]           # [ext, W, C]
+    a1 = jax.lax.dot_general(
+        x_ext.reshape(ext * W, x_ext.shape[-1]), w0_ref[...],
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    a1 = jnp.maximum(a1 + b0_ref[0], 0.0).reshape(ext, W, F)
+    row_ids = o0 * s + jax.lax.broadcasted_iota(jnp.int32, (ext, 1, 1), 0)
+    a1 = jnp.where((row_ids >= 1) & (row_ids <= H), a1, 0.0)
+    a1 = a1.astype(x_ref.dtype)
+
+    # -- conv1 (3x3, stride s) as nine shifted matmuls -------------------
+    zcol = jnp.zeros((ext, 1, F), a1.dtype)
+    a1p = jnp.concatenate([zcol, a1, zcol], axis=1)      # [ext, W+2, F]
+    acc = jnp.zeros((bh * Wo, F), jnp.float32)
+    for dy in range(3):
+        if s == 1:
+            rows = a1p[dy:dy + bh]                       # [bh, W+2, F]
+        else:
+            rows = a1p[dy:dy + s * bh].reshape(
+                bh, s, W + 2, F)[:, 0]                   # decimate rows
+        for dx in range(3):
+            if s == 1:
+                tap = rows[:, dx:dx + Wo]                # [bh, Wo, F]
+            else:
+                tap = rows[:, dx:dx + s * Wo].reshape(
+                    bh, Wo, s, F)[:, :, 0]               # decimate cols
+            acc = acc + jax.lax.dot_general(
+                tap.reshape(bh * Wo, F), w1_ref[dy * 3 + dx],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    h = jnp.maximum(acc + b1_ref[0], 0.0).astype(x_ref.dtype)
+
+    # -- conv2 (1x1 expand) + shortcut + final relu ----------------------
+    y = jax.lax.dot_general(h, w2_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    y = y + b2_ref[0]
+
+    if has_branch:
+        # projection shortcut: x strided by s in both dims, then 1x1
+        xs = x_ref[0, pl.ds(o0 * s + 1, s * bh), :, :]
+        if s > 1:
+            xs = xs.reshape(bh, s, W, xs.shape[-1])[:, 0]
+            xs = xs.reshape(bh, Wo, s, xs.shape[-1])[:, :, 0]
+        short = jax.lax.dot_general(
+            xs.reshape(bh * Wo, xs.shape[-1]), ws_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) + bs_ref[0]
+    else:
+        # identity: C == C4 and s == 1
+        xs = x_ref[0, pl.ds(o0 + 1, bh), :, :]
+        short = xs.reshape(bh * Wo, C4).astype(jnp.float32)
+
+    out = jnp.maximum(y + short, 0.0)
+    o_ref[0] = out.reshape(bh, Wo, C4).astype(o_ref.dtype)
+
+
+def _pick_block_h(Ho):
+    for cand in (16, 14, 12, 8, 7, 6, 4, 2, 1):
+        if Ho % cand == 0:
+            return cand
+    return 1
+
+
+def _bottleneck_vmem_bytes(H, W, C, F, C4, stride, block_h, dtype_bytes):
+    """Rough VMEM budget for one program: the padded input image, the
+    fp32 conv0 window, and all weight operands."""
+    ext = stride * block_h + 2
+    return ((H + 2) * W * C * dtype_bytes            # x image block
+            + ext * W * F * 4                        # a1 window (fp32)
+            + ext * (W + 2) * F * dtype_bytes        # a1p
+            + C * F * dtype_bytes + 9 * F * F * dtype_bytes
+            + F * C4 * dtype_bytes + C * C4 * dtype_bytes)
+
+
+def bottleneck_reference(x, w0, b0, w1, b1, w2, b2, ws, bs, stride):
+    """Plain-XLA oracle/fallback: the same BN-folded block as three
+    conv_general_dilated calls (NHWC, HWIO filters)."""
+    import jax
+    import jax.numpy as jnp
+
+    def conv(v, w, s, pad):
+        return jax.lax.conv_general_dilated(
+            v, w.astype(v.dtype), (s, s), pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+
+    a = jnp.maximum(conv(x, w0[None, None], 1, "VALID") + b0, 0.0)
+    a = a.astype(x.dtype)
+    h = jnp.maximum(
+        conv(a, w1, stride, [(1, 1), (1, 1)]) + b1, 0.0).astype(x.dtype)
+    y = conv(h, w2[None, None], 1, "VALID") + b2
+    if ws is not None:
+        short = conv(x, ws[None, None], stride, "VALID") + bs
+    else:
+        short = x.astype(jnp.float32)
+    return jnp.maximum(y + short, 0.0).astype(x.dtype)
+
+
+_VMEM_CAP = 13 * 1024 * 1024
+
+
+def fused_bottleneck(x, w0, b0, w1, b1, w2, b2, ws=None, bs=None,
+                     stride=1, interpret=None, block_h=None):
+    """Fused ResNet bottleneck, inference only. NHWC activations.
+
+    x  [N, H, W, C]
+    w0 [C, F]  b0 [F]          1x1 reduce   (BN folded into w/b)
+    w1 [3, 3, F, F]  b1 [F]    3x3, stride `stride`, pad 1
+    w2 [F, C4]  b2 [C4]        1x1 expand
+    ws [C, C4]  bs [C4]        projection shortcut (None -> identity)
+
+    Falls back to the plain-XLA composition when the geometry doesn't
+    tile (odd W under stride 2, indivisible rows) or the block would
+    blow the VMEM budget.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, H, W, C = x.shape
+    F = w0.shape[1]
+    C4 = w2.shape[1]
+    if w1.shape != (3, 3, F, F):
+        raise ValueError("w1 must be [3, 3, F, F] with F matching w0; "
+                         "got %s" % (w1.shape,))
+    s = int(stride)
+    has_branch = ws is not None
+    if not has_branch and (s != 1 or C != C4):
+        raise ValueError("identity shortcut requires stride 1 and C == C4")
+    Ho = H // s if s > 1 else H
+    Wo = W // s if s > 1 else W
+    bh = block_h or _pick_block_h(Ho)
+    dtype_bytes = jnp.dtype(x.dtype).itemsize
+    # the reshape-decimation trick only handles s in (1, 2) with evenly
+    # divisible geometry — anything else takes the plain-XLA path
+    tileable = (s in (1, 2) and Ho % bh == 0
+                and (s == 1 or (H % s == 0 and W % s == 0))
+                and _bottleneck_vmem_bytes(
+                    H, W, C, F, C4, s, bh, dtype_bytes) <= _VMEM_CAP)
+    if not tileable:
+        return bottleneck_reference(x, w0, b0, w1, b1, w2, b2, ws, bs, s)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    xp = jnp.pad(x, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    w1f = w1.reshape(9, F, F)
+    wsx = ws if has_branch else w0          # alias: unused when no branch
+    bsx = bs if has_branch else b0
+    kern = functools.partial(
+        _bottleneck_kernel, H=H, W=W, stride=s, block_h=bh,
+        has_branch=has_branch)
+    full = lambda a: pl.BlockSpec(a.shape, lambda b, i: (0,) * a.ndim)
+    args = (w0, b0.reshape(1, F), w1f, b1.reshape(1, F), w2,
+            b2.reshape(1, C4), wsx,
+            bsx.reshape(1, -1))
+    return pl.pallas_call(
+        kern,
+        grid=(N, Ho // bh),
+        in_specs=[pl.BlockSpec((1, H + 2, W, C), lambda b, i: (b, 0, 0, 0))]
+        + [full(a) for a in args],
+        out_specs=pl.BlockSpec((1, bh, Wo, C4), lambda b, i: (b, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, Ho, Wo, C4), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(xp, *args)
+
+
+def _oihw_to_mat(w):
+    """OIHW 1x1 filter [O, I, 1, 1] -> matmul layout [I, O]."""
+    return w.reshape(w.shape[0], w.shape[1]).T
+
+
+@register_op("fused_bottleneck")
+def _fused_bottleneck_op(ctx):
+    """Program-level fused bottleneck. Filters arrive in the framework's
+    OIHW layout (layout-independent parameters, models/resnet.py) and are
+    re-laid for the matmul kernel at trace time — XLA constant-folds the
+    transposes of persistable weights into the compiled executable."""
+    x = ctx.input("X")
+    w0 = _oihw_to_mat(ctx.input("W0"))
+    w1 = ctx.input("W1").transpose(2, 3, 1, 0)       # OIHW -> HWIO
+    w2 = _oihw_to_mat(ctx.input("W2"))
+    ws = ctx.input("Ws") if ctx.has_input("Ws") else None
+    out = fused_bottleneck(
+        x, w0, ctx.input("B0"), w1, ctx.input("B1"), w2, ctx.input("B2"),
+        ws=None if ws is None else _oihw_to_mat(ws),
+        bs=ctx.input("Bs") if ctx.has_input("Bs") else None,
+        stride=int(ctx.attr("stride", 1)))
     return {"Out": out}

@@ -1,0 +1,155 @@
+"""Fused ResNet bottleneck: Pallas kernel parity + the inference-graph
+fusion pass.
+
+The kernel (ops/pallas_kernels.py fused_bottleneck) runs a whole BN-folded
+residual block — three convs, both relus, shortcut add — in one
+VMEM-resident pallas_call, the "cross-layer fused conv pipeline" lever from
+ROOFLINE.md. Reference analogue: the conv+bn+act fusion pass family
+(paddle/fluid/framework/ir/conv_bn_fuse_pass.cc) which stops at per-conv
+epilogues; fusing across the block is TPU-specific.
+
+Interpret mode makes every test here exact on the CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.ops.pallas_kernels import (fused_bottleneck,
+                                           bottleneck_reference)
+
+
+def _params(rng, C, F, C4, branch):
+    t = lambda *s: rng.randn(*s).astype(np.float32) * 0.1
+    p = dict(w0=t(C, F), b0=t(F), w1=t(3, 3, F, F), b1=t(F),
+             w2=t(F, C4), b2=t(C4))
+    p["ws"], p["bs"] = (t(C, C4), t(C4)) if branch else (None, None)
+    return p
+
+
+@pytest.mark.parametrize(
+    "N,H,W,C,F,stride,branch",
+    [(2, 8, 8, 32, 16, 1, False),      # identity shortcut
+     (2, 8, 8, 32, 16, 1, True),       # projection, stride 1
+     (2, 8, 8, 32, 16, 2, True),       # projection, stride 2
+     (1, 14, 14, 64, 32, 2, True),     # odd output rows (Ho=7)
+     (1, 7, 7, 128, 32, 1, False)])    # odd everything
+def test_kernel_matches_reference(N, H, W, C, F, stride, branch):
+    rng = np.random.RandomState(0)
+    C4 = F * 4 if branch else C
+    p = _params(rng, C, F, C4, branch)
+    x = rng.randn(N, H, W, C).astype(np.float32)
+    got = fused_bottleneck(x, p["w0"], p["b0"], p["w1"], p["b1"], p["w2"],
+                           p["b2"], p["ws"], p["bs"], stride=stride,
+                           interpret=True)
+    want = bottleneck_reference(x, p["w0"], p["b0"], p["w1"], p["b1"],
+                                p["w2"], p["b2"], p["ws"], p["bs"], stride)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_kernel_bf16():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(1)
+    p = _params(rng, 32, 16, 64, True)
+    x = rng.randn(2, 8, 8, 32).astype(np.float32)
+    cast = lambda a: None if a is None else jnp.asarray(a, jnp.bfloat16)
+    got = fused_bottleneck(cast(x), *(cast(p[k]) for k in
+                                      ("w0", "b0", "w1", "b1", "w2", "b2",
+                                       "ws", "bs")),
+                           stride=1, interpret=True)
+    want = bottleneck_reference(x, p["w0"], p["b0"], p["w1"], p["b1"],
+                                p["w2"], p["b2"], p["ws"], p["bs"], 1)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), atol=0.12, rtol=0.12)
+
+
+def test_untileable_falls_back():
+    # odd W under stride 2 cannot reshape-decimate: the wrapper must
+    # return the plain-XLA composition rather than fail
+    rng = np.random.RandomState(2)
+    p = _params(rng, 16, 8, 32, True)
+    x = rng.randn(1, 9, 9, 16).astype(np.float32)
+    got = fused_bottleneck(x, p["w0"], p["b0"], p["w1"], p["b1"], p["w2"],
+                           p["b2"], p["ws"], p["bs"], stride=2,
+                           interpret=True)
+    want = bottleneck_reference(x, p["w0"], p["b0"], p["w1"], p["b1"],
+                                p["w2"], p["b2"], p["ws"], p["bs"], 2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# graph-level: InferenceTranspiler folds BN then collapses NHWC blocks
+# ---------------------------------------------------------------------------
+
+def _build_resnet_tail(layout):
+    """data -> bottleneck(stride 2, projection) -> bottleneck(identity)."""
+    from paddle_tpu.models.resnet import bottleneck_block
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        shape = [8, 8, 16] if layout == "NHWC" else [16, 8, 8]
+        img = fluid.layers.data(name="img", shape=shape, dtype="float32")
+        out = bottleneck_block(img, 8, 2, is_train=False, layout=layout)
+        out = bottleneck_block(out, 8, 1, is_train=False, layout=layout)
+    return main, startup, out
+
+
+@pytest.mark.parametrize("layout", ["NHWC", "NCHW"])
+def test_transpiler_fuses_nhwc_blocks(layout):
+    main, startup, out = _build_resnet_tail(layout)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(3)
+    shape = (4, 8, 8, 16) if layout == "NHWC" else (4, 16, 8, 8)
+    x = rng.randn(*shape).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want, = exe.run(main, feed={"img": x}, fetch_list=[out.name])
+        infer = main.clone(for_test=True)
+        from paddle_tpu.fluid.transpiler import InferenceTranspiler
+        InferenceTranspiler().transpile(infer, scope=scope)
+        types = [op.type for op in infer.global_block().ops]
+        if layout == "NHWC":
+            # both blocks collapse: no loose conv/add/relu remain
+            assert types.count("fused_bottleneck") == 2, types
+            assert "conv2d" not in types and "relu" not in types, types
+        else:
+            # NCHW stays on the XLA path (kernel is lane-aligned NHWC)
+            assert "fused_bottleneck" not in types, types
+        got, = exe.run(infer, feed={"img": x}, fetch_list=[out.name])
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_nhwc_bn_fold_bias_axis():
+    # regression: the folded BN bias add must broadcast over the channel
+    # axis of the conv's layout — for NHWC that is the trailing dim, and
+    # H != C here so a wrong axis is a loud shape error (or silent
+    # corruption when H == C)
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[6, 6, 5],
+                                dtype="float32")
+        conv = fluid.layers.conv2d(input=img, num_filters=7, filter_size=3,
+                                   padding=1, act=None, bias_attr=False,
+                                   data_format="NHWC")
+        out = fluid.layers.batch_norm(input=conv, act=None, is_test=True,
+                                      data_layout="NHWC")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 6, 6, 5).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want, = exe.run(main, feed={"img": x}, fetch_list=[out.name])
+        infer = main.clone(for_test=True)
+        from paddle_tpu.fluid.transpiler import InferenceTranspiler
+        InferenceTranspiler().transpile(infer, scope=scope)
+        got, = exe.run(infer, feed={"img": x}, fetch_list=[out.name])
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)

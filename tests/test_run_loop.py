@@ -292,3 +292,57 @@ def test_parallel_executor_handles_ragged_lod_feed():
         looped = pe3.run_loop(fetch_list=[loss3], feed=feed, steps=3)[0]
     np.testing.assert_allclose(ref[-1], np.asarray(looped).ravel()[0],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_parallel_executor_per_device_lod_feed_list():
+    """The classic PE per-device feed style (list of dicts) must merge
+    LoDTensor entries data+lod — a plain np.concatenate would silently
+    strip the ragged structure via __array__ and feed garbage."""
+    from paddle_tpu.fluid.lod import LoDTensor
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32",
+                                  lod_level=1)
+            fc = fluid.layers.fc(input=x, size=16 * 4)
+            h, c = fluid.layers.dynamic_lstm(input=fc, size=16 * 4)
+            pool = fluid.layers.sequence_pool(h, pool_type="max")
+            pred = fluid.layers.fc(input=pool, size=1)
+            loss = fluid.layers.mean(pred)
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(1)
+    lens = [3, 5, 2, 4, 1, 2, 3, 4]
+    flat = rng.randn(sum(lens), 8).astype("float32")
+
+    def lod_slice(seq_lo, seq_hi):
+        row_lo = sum(lens[:seq_lo])
+        row_hi = sum(lens[:seq_hi])
+        t = LoDTensor(flat[row_lo:row_hi])
+        t.set_recursive_sequence_lengths([lens[seq_lo:seq_hi]])
+        return t
+
+    whole = LoDTensor(flat)
+    whole.set_recursive_sequence_lengths([lens])
+
+    with fluid.scope_guard(fluid.Scope()):
+        main, startup, loss = build()
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    main_program=main)
+        ref = [np.asarray(pe.run(fetch_list=[loss],
+                                 feed={"x": whole})[0]).ravel()[0]
+               for _ in range(2)]
+
+    with fluid.scope_guard(fluid.Scope()):
+        main2, startup2, loss2 = build()
+        fluid.Executor(fluid.CPUPlace()).run(startup2)
+        pe2 = fluid.ParallelExecutor(use_cuda=False, loss_name=loss2.name,
+                                     main_program=main2)
+        split = [{"x": lod_slice(0, 4)}, {"x": lod_slice(4, 8)}]
+        got = [np.asarray(pe2.run(fetch_list=[loss2],
+                                  feed=split)[0]).ravel()[0]
+               for _ in range(2)]
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)

@@ -77,6 +77,10 @@ def main():
                 ok, out = run_logged(
                     [sys.executable, "bench.py"], {}, log, 1800)
                 def parse_lines(out, variant):
+                    # a re-run after a mid-sweep wedge replaces that
+                    # variant's earlier rows instead of duplicating them
+                    results[:] = [r for r in results
+                                  if r.get("variant") != variant]
                     for line in out.splitlines():
                         if not line.startswith("{"):
                             continue

@@ -356,15 +356,18 @@ def _pick_block_h(Ho):
     return 1
 
 
-def _bottleneck_vmem_bytes(H, W, C, F, C4, stride, block_h, dtype_bytes):
+def _bottleneck_vmem_bytes(H, W, C, F, C4, stride, block_h, dtype_bytes,
+                           has_branch=True):
     """Rough VMEM budget for one program: the padded input image, the
-    fp32 conv0 window, and all weight operands."""
+    fp32 conv0 window, and all weight operands (the identity case passes
+    w0 aliased in the ws slot, so its footprint is C*F, not C*C4)."""
     ext = stride * block_h + 2
+    ws_elems = C * C4 if has_branch else C * F
     return ((H + 2) * W * C * dtype_bytes            # x image block
             + ext * W * F * 4                        # a1 window (fp32)
             + ext * (W + 2) * F * dtype_bytes        # a1p
             + C * F * dtype_bytes + 9 * F * F * dtype_bytes
-            + F * C4 * dtype_bytes + C * C4 * dtype_bytes)
+            + F * C4 * dtype_bytes + ws_elems * dtype_bytes)
 
 
 def bottleneck_reference(x, w0, b0, w1, b1, w2, b2, ws, bs, stride):
@@ -432,7 +435,8 @@ def fused_bottleneck(x, w0, b0, w1, b1, w2, b2, ws=None, bs=None,
     tileable = (s in (1, 2) and Ho % bh == 0
                 and (s == 1 or (H % s == 0 and W % s == 0))
                 and _bottleneck_vmem_bytes(
-                    H, W, C, F, C4, s, bh, dtype_bytes) <= _VMEM_CAP)
+                    H, W, C, F, C4, s, bh, dtype_bytes,
+                    has_branch) <= _VMEM_CAP)
     if not tileable:
         return bottleneck_reference(x, w0, b0, w1, b1, w2, b2, ws, bs, s)
     if interpret is None:

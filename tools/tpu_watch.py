@@ -76,25 +76,31 @@ def main():
                 # compile is what wedged the transport in r4).
                 ok, out = run_logged(
                     [sys.executable, "bench.py"], {}, log, 1800)
-                def parse_lines(out, variant):
+                def parse_lines(out, sweep):
                     # a re-run after a mid-sweep wedge replaces that
-                    # variant's earlier rows instead of duplicating them
+                    # sweep stage's earlier rows instead of duplicating
+                    # them; `sweep` labels the stage and must NOT clobber
+                    # a record's own "variant" field (bench_infer emits
+                    # fused/unfused rows)
                     results[:] = [r for r in results
-                                  if r.get("variant") != variant]
+                                  if r.get("sweep") != sweep]
                     for line in out.splitlines():
                         if not line.startswith("{"):
                             continue
                         try:
                             results.append(
-                                dict(json.loads(line), variant=variant))
+                                dict(json.loads(line), sweep=sweep))
                         except ValueError:
                             pass  # '{'-prefixed non-JSON debug line
 
-                if ok:
-                    parse_lines(out, "nhwc")
+                def flush_results():
                     with open(os.path.join(REPO, "BENCH_watch.json"),
                               "w") as f:
                         json.dump(results, f, indent=1)
+
+                if ok:
+                    parse_lines(out, "nhwc")
+                    flush_results()
                     # zoo BEFORE the remat flagship: the BENCH_REMAT
                     # compile is what wedged the transport at the r4
                     # session start — the riskiest run goes last so a
@@ -114,14 +120,21 @@ def main():
                                   "loop\n" % time.strftime("%H:%M:%S"))
                         log.flush()
                     else:
+                        # inference fused-vs-unfused after the zoo: a
+                        # fresh Pallas compile, riskier than the zoo but
+                        # less than remat
+                        inf_ok, inf_out = run_logged(
+                            [sys.executable, "tools/bench_infer.py",
+                             "--require_tpu"], {}, log, 1800)
+                        if inf_ok:
+                            parse_lines(inf_out, "infer")
+                            flush_results()
                         ok2, out2 = run_logged(
                             [sys.executable, "bench.py"],
                             {"BENCH_REMAT": "1"}, log, 1800)
                         if ok2:
                             parse_lines(out2, "nhwc+remat")
-                        with open(os.path.join(REPO, "BENCH_watch.json"),
-                                  "w") as f:
-                            json.dump(results, f, indent=1)
+                        flush_results()
                         log.write("[%s] sweep complete\n"
                                   % time.strftime("%H:%M:%S"))
                         log.flush()

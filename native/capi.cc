@@ -102,7 +102,7 @@ bool ensure_python() {
 }
 
 struct Predictor {
-  PyObject *pred;         // AotPredictor instance
+  PyObject *pred;         // AotPredictor / AotTrainer instance
   PyObject *np;           // numpy module
   PyObject *feed_names;   // list[str]
   PyObject *fetch_names;  // list[str]
@@ -203,11 +203,10 @@ bool ndarray_to_tensor(const Predictor *p, PyObject *arr_in,
   return ok;
 }
 
-}  // namespace
-
-extern "C" {
-
-void *pd_create_predictor(const char *model_dir) {
+// Shared constructor: import `factory` from `mod_name`, call it on
+// model_dir, keep the instance + its feed/fetch name lists.
+Predictor *create_host(const char *mod_name, const char *factory,
+                       const char *model_dir) {
   g_err.clear();
   if (!ensure_python()) return nullptr;
   PyGILState_STATE gil = PyGILState_Ensure();
@@ -219,12 +218,12 @@ void *pd_create_predictor(const char *model_dir) {
       set_err_from_python();
       break;
     }
-    mod = PyImport_ImportModule("paddle_tpu.inference");
+    mod = PyImport_ImportModule(mod_name);
     if (!mod) {
       set_err_from_python();
       break;
     }
-    pred = PyObject_CallMethod(mod, "load_aot_predictor", "s", model_dir);
+    pred = PyObject_CallMethod(mod, factory, "s", model_dir);
     if (!pred) {
       set_err_from_python();
       break;
@@ -249,12 +248,35 @@ void *pd_create_predictor(const char *model_dir) {
   return p;
 }
 
-int pd_predictor_run(void *predictor, const pd_tensor *inputs, int n_in,
-                     pd_tensor *outputs, int max_out) {
+void destroy_host(Predictor *p) {
+  if (!p) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->pred);
+  Py_XDECREF(p->np);
+  Py_XDECREF(p->feed_names);
+  Py_XDECREF(p->fetch_names);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *pd_create_predictor(const char *model_dir) {
+  return create_host("paddle_tpu.inference", "load_aot_predictor",
+                     model_dir);
+}
+
+// The predictor's run() and the trainer's step() share the exact feed /
+// fetch marshalling; only the bound method differs.
+static int run_host_method(void *predictor, const char *method,
+                           const pd_tensor *inputs, int n_in,
+                           pd_tensor *outputs, int max_out) {
   g_err.clear();
   Predictor *p = (Predictor *)predictor;
   if (!p) {
-    g_err = "null predictor";
+    g_err = "null handle";
     return -1;
   }
   PyGILState_STATE gil = PyGILState_Ensure();
@@ -292,7 +314,7 @@ int pd_predictor_run(void *predictor, const pd_tensor *inputs, int n_in,
       }
     }
     if (bad) break;
-    outs = PyObject_CallMethod(p->pred, "run", "O", feeds);
+    outs = PyObject_CallMethod(p->pred, method, "O", feeds);
     if (!outs) {
       set_err_from_python();
       break;
@@ -333,6 +355,12 @@ int pd_predictor_run(void *predictor, const pd_tensor *inputs, int n_in,
   return result;
 }
 
+int pd_predictor_run(void *predictor, const pd_tensor *inputs, int n_in,
+                     pd_tensor *outputs, int max_out) {
+  return run_host_method(predictor, "run", inputs, n_in, outputs,
+                         max_out);
+}
+
 void pd_free_tensor_data(pd_tensor *t) {
   if (t && t->data) {
     std::free(t->data);
@@ -342,15 +370,45 @@ void pd_free_tensor_data(pd_tensor *t) {
 }
 
 void pd_destroy_predictor(void *predictor) {
-  Predictor *p = (Predictor *)predictor;
-  if (!p) return;
+  destroy_host((Predictor *)predictor);
+}
+
+/* ---- training (reference train/demo analogue) ---------------------- */
+
+void *pd_create_trainer(const char *model_dir) {
+  return create_host("paddle_tpu.fluid.train_export", "load_aot_trainer",
+                     model_dir);
+}
+
+int pd_trainer_step(void *trainer, const pd_tensor *inputs, int n_in,
+                    pd_tensor *outputs, int max_out) {
+  return run_host_method(trainer, "step", inputs, n_in, outputs,
+                         max_out);
+}
+
+int pd_trainer_save(void *trainer, const char *dirname) {
+  g_err.clear();
+  Predictor *p = (Predictor *)trainer;
+  if (!p) {
+    g_err = "null handle";
+    return -1;
+  }
   PyGILState_STATE gil = PyGILState_Ensure();
-  Py_XDECREF(p->pred);
-  Py_XDECREF(p->np);
-  Py_XDECREF(p->feed_names);
-  Py_XDECREF(p->fetch_names);
+  int rc = -1;
+  PyObject *r = PyObject_CallMethod(p->pred, "save", "s", dirname);
+  if (r) {
+    rc = 0;
+    Py_DECREF(r);
+  } else {
+    set_err_from_python();
+  }
+  if (PyErr_Occurred()) PyErr_Clear();
   PyGILState_Release(gil);
-  delete p;
+  return rc;
+}
+
+void pd_destroy_trainer(void *trainer) {
+  destroy_host((Predictor *)trainer);
 }
 
 const char *pd_last_error(void) { return g_err.c_str(); }

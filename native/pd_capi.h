@@ -59,6 +59,30 @@ void pd_free_tensor_data(pd_tensor *t);
 
 void pd_destroy_predictor(void *predictor);
 
+/* ---- training from a saved artifact ---------------------------------
+ * Reference analogue: the C++ train/demo (paddle/fluid/train/demo/
+ * demo_trainer.cc) — training driven from a saved program with no
+ * Python of the application's own. The artifact is written by
+ * paddle_tpu.fluid.train_export.save_aot_trainer: the whole optimizer
+ * step (forward+backward+update) as one AOT StableHLO module, with the
+ * parameter/optimizer state threaded through each call. */
+
+/* Open a save_aot_trainer artifact. NULL on failure (pd_last_error). */
+void *pd_create_trainer(const char *model_dir);
+
+/* One optimizer step: feeds in, per-step fetches (losses) out. Same
+ * tensor conventions as pd_predictor_run. Parameter state advances
+ * inside the handle. Returns fetch count, or -1 on failure. */
+int pd_trainer_step(void *trainer, const pd_tensor *inputs, int n_in,
+                    pd_tensor *outputs, int max_out);
+
+/* Checkpoint state + step counter into dirname (may equal the source
+ * artifact dir). A later pd_create_trainer on that dir resumes exactly.
+ * Returns 0, or -1 on failure. */
+int pd_trainer_save(void *trainer, const char *dirname);
+
+void pd_destroy_trainer(void *trainer);
+
 /* Last error message (empty string when the previous call succeeded). */
 const char *pd_last_error(void);
 

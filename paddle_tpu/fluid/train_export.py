@@ -1,0 +1,185 @@
+"""AOT training export: run a TRAINING step from a saved artifact with no
+Program rebuild and no jax trace.
+
+Reference analogue: the C++ train/demo
+(/root/reference/paddle/fluid/train/demo/demo_trainer.cc,
+test_train_recognize_digits.cc) — pure-C++ training driven from a saved
+program via `framework::Executor`. TPU redesign: the whole optimizer step
+(forward + backward + update) is functionalized into one pure
+fn(state, feeds, step) -> (fetches, new_state), AOT-exported as versioned
+StableHLO (jax.export), and the parameter/optimizer state rides the
+no-pickle wire codec. A fresh process — or a C host via
+native/pd_capi.h's pd_create_trainer — deserializes and trains with XLA
+compiling the stored module directly.
+
+Artifact layout (directory):
+  train_step.bin    serialized jax.export module for the step fn
+  train_state.bin   wire-encoded {name: ndarray} parameter/opt state
+  train_meta.bin    wire-encoded feed specs, fetch names, step counter
+"""
+
+import os
+
+import numpy as np
+
+__all__ = ["save_aot_trainer", "load_aot_trainer", "AotTrainer"]
+
+
+def save_aot_trainer(dirname, program, feed_names, fetch_names,
+                     scope=None, batch_size=None):
+    """Export `program`'s training step for batch size `batch_size`
+    (default: the feed vars' static batch dim; -1 dims require an
+    explicit batch_size).
+
+    `fetch_names` are the per-step fetches (losses/metrics); the full
+    persistable state is threaded and saved automatically."""
+    import jax
+    from jax import export as jax_export
+    from . import functionalizer
+    from .executor import global_scope
+    from ..native import wire
+    from . import core
+
+    if scope is None:
+        scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    gb = program.global_block()
+    fetch_names = [getattr(f, "name", f) for f in fetch_names]
+    # caller order is the artifact's positional-feed contract (same
+    # convention as AotPredictor); the step fn internally keys feeds by
+    # name so its own ordering is irrelevant
+    feed_names = tuple(getattr(f, "name", f) for f in feed_names)
+
+    feed_specs = {}
+    for name in feed_names:
+        v = gb._find_var_recursive(name)
+        if v is None or v.shape is None:
+            raise ValueError("feed var %r not found or unshaped" % name)
+        shape = [int(d) for d in v.shape]
+        if shape and shape[0] == -1:
+            if batch_size is None:
+                raise ValueError(
+                    "feed %r has dynamic batch; pass batch_size" % name)
+            shape[0] = int(batch_size)
+        if any(d < 0 for d in shape):
+            raise ValueError("feed %r has non-batch dynamic dims %s"
+                             % (name, shape))
+        feed_specs[name] = (tuple(shape),
+                            str(np.dtype(core.convert_dtype_to_np(
+                                v.dtype))))
+
+    state_names = tuple(functionalizer.persistable_names(program))
+    state = {}
+    for n in state_names:
+        val = scope.get(n)
+        if val is not None:
+            state[n] = np.asarray(val)
+    step_fn = functionalizer.build_step_fn(
+        program, tuple(sorted(feed_names)), tuple(fetch_names),
+        tuple(state.keys()))
+
+    state_spec = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                  for n, v in state.items()}
+    feeds_spec = {n: jax.ShapeDtypeStruct(s, np.dtype(dt))
+                  for n, (s, dt) in feed_specs.items()}
+    step_spec = jax.ShapeDtypeStruct((), np.uint32)
+    exp = jax_export.export(jax.jit(step_fn))(state_spec, feeds_spec,
+                                              step_spec)
+    with open(os.path.join(dirname, "train_step.bin"), "wb") as f:
+        f.write(exp.serialize())
+    with open(os.path.join(dirname, "train_state.bin"), "wb") as f:
+        f.write(wire.encode(state))
+    meta = {
+        "feed_names": list(feed_names),
+        "fetch_names": list(fetch_names),
+        "feed_specs": {n: {"shape": list(s), "dtype": d}
+                       for n, (s, d) in feed_specs.items()},
+        "step": 0,
+        "platform": jax.default_backend(),
+    }
+    with open(os.path.join(dirname, "train_meta.bin"), "wb") as f:
+        f.write(wire.encode(meta))
+    return dirname
+
+
+class AotTrainer:
+    """Train from a `save_aot_trainer` artifact: step() runs the stored
+    XLA module and threads the state; save() checkpoints state + step
+    counter so a later process resumes exactly."""
+
+    def __init__(self, dirname):
+        from jax import export as jax_export
+        from ..native import wire
+
+        with open(os.path.join(dirname, "train_meta.bin"), "rb") as f:
+            self._meta = wire.decode(f.read())
+        with open(os.path.join(dirname, "train_state.bin"), "rb") as f:
+            self._state = wire.decode(f.read())
+        with open(os.path.join(dirname, "train_step.bin"), "rb") as f:
+            self._fn = jax_export.deserialize(f.read()).call
+        self._dir = dirname
+        self._feed_names = list(self._meta["feed_names"])
+        self._fetch_names = list(self._meta["fetch_names"])
+        self._feed_specs = self._meta["feed_specs"]
+        self._step = int(self._meta.get("step", 0))
+
+    @property
+    def step_count(self):
+        return self._step
+
+    def state(self, name):
+        return self._state[name]
+
+    def step(self, feed):
+        """One optimizer step. `feed` is {name: array} (or a positional
+        sequence in feed_names order); returns the fetch list."""
+        if not isinstance(feed, dict):
+            feed = {n: v for n, v in zip(self._feed_names, feed)}
+        feeds = {}
+        for name in self._feed_names:
+            if name not in feed:
+                raise KeyError("missing feed %r" % name)
+            spec = self._feed_specs[name]
+            arr = np.asarray(feed[name])
+            want = np.dtype(spec["dtype"])
+            if arr.dtype != want:
+                if arr.dtype.kind in "iu" and want.kind in "iu":
+                    arr = arr.astype(want)
+                elif arr.dtype.kind == "f" and want.kind == "f":
+                    arr = arr.astype(want)
+                else:
+                    raise TypeError(
+                        "feed %r dtype %s, artifact expects %s"
+                        % (name, arr.dtype, want))
+            if tuple(arr.shape) != tuple(spec["shape"]):
+                raise ValueError(
+                    "feed %r shape %s, artifact expects %s"
+                    % (name, arr.shape, tuple(spec["shape"])))
+            feeds[name] = arr
+        fetches, self._state = self._fn(self._state, feeds,
+                                        np.uint32(self._step))
+        self._step += 1
+        return [np.asarray(f) for f in fetches]
+
+    def save(self, dirname):
+        """Checkpoint into `dirname` (may be the source artifact dir):
+        the step module is copied if absent, state and step counter are
+        rewritten."""
+        import shutil
+        from ..native import wire
+
+        os.makedirs(dirname, exist_ok=True)
+        dst_mod = os.path.join(dirname, "train_step.bin")
+        if not os.path.exists(dst_mod):
+            shutil.copy(os.path.join(self._dir, "train_step.bin"),
+                        dst_mod)
+        with open(os.path.join(dirname, "train_state.bin"), "wb") as f:
+            f.write(wire.encode({n: np.asarray(v)
+                                 for n, v in self._state.items()}))
+        with open(os.path.join(dirname, "train_meta.bin"), "wb") as f:
+            f.write(wire.encode(dict(self._meta, step=self._step)))
+        return dirname
+
+
+def load_aot_trainer(dirname):
+    return AotTrainer(dirname)

@@ -145,8 +145,12 @@ def main():
 
     for rec in results:
         if not on_tpu:
-            rec["backend"] = ("cpu-fallback (TPU transport unreachable)"
-                              if backend is None else "cpu")
+            if args.smoke:
+                rec["backend"] = "cpu (smoke mode; transport not probed)"
+            elif backend is None:
+                rec["backend"] = "cpu-fallback (TPU transport unreachable)"
+            else:
+                rec["backend"] = "cpu"
         print(json.dumps(rec))
 
 

@@ -126,9 +126,21 @@ def main():
                         inf_ok, inf_out = run_logged(
                             [sys.executable, "tools/bench_infer.py",
                              "--require_tpu"], {}, log, 1800)
-                        if inf_ok:
-                            parse_lines(inf_out, "infer")
-                            flush_results()
+                        if not inf_ok:
+                            # same policy as a zoo failure: the transport
+                            # wedged mid-sweep — keep probing so the
+                            # fused-vs-unfused numbers are retried, do
+                            # not fall through and declare completion
+                            log.write("[%s] bench_infer failed; resuming "
+                                      "probe loop\n"
+                                      % time.strftime("%H:%M:%S"))
+                            log.flush()
+                            if args.once:
+                                return
+                            time.sleep(args.interval)
+                            continue
+                        parse_lines(inf_out, "infer")
+                        flush_results()
                         ok2, out2 = run_logged(
                             [sys.executable, "bench.py"],
                             {"BENCH_REMAT": "1"}, log, 1800)

@@ -56,6 +56,37 @@ def _backend_probe(timeout=120):
     return None
 
 
+def init_backend(smoke=False, require_tpu=False, tool="bench"):
+    """Shared wedge-avoidance preamble for the bench tools: probe the
+    backend in a subprocess (never inline — a wedged transport hangs jax
+    init), pin CPU on failure or in smoke mode, honor the require_tpu
+    exit-3 contract, and return (on_tpu, backend_label) where
+    backend_label is None on TPU and a self-describing provenance string
+    on any CPU path."""
+    backend = None if smoke else _backend_probe()
+    if backend is None:
+        if require_tpu and not smoke:
+            print("%s: TPU transport unreachable" % tool, file=sys.stderr)
+            sys.exit(3)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    if backend is None:
+        jax.config.update("jax_platforms", "cpu")
+    on_tpu = jax.default_backend() == "tpu"
+    if require_tpu and not smoke and not on_tpu:
+        # a healthy CPU-only backend is still not a chip measurement
+        print("%s: backend is %r, not tpu" % (tool, jax.default_backend()),
+              file=sys.stderr)
+        sys.exit(3)
+    if on_tpu:
+        return True, None
+    if smoke:
+        return False, "cpu (smoke mode; transport not probed)"
+    if backend is None:
+        return False, "cpu-fallback (TPU transport unreachable)"
+    return False, "cpu"
+
+
 def main():
     backend = _backend_probe()
     if backend is None:

@@ -170,9 +170,11 @@ class AotTrainer:
 
         os.makedirs(dirname, exist_ok=True)
         dst_mod = os.path.join(dirname, "train_step.bin")
-        if not os.path.exists(dst_mod):
-            shutil.copy(os.path.join(self._dir, "train_step.bin"),
-                        dst_mod)
+        src_mod = os.path.join(self._dir, "train_step.bin")
+        # always overwrite: a stale module from an earlier export in the
+        # target dir would silently resume the OLD program on new state
+        if os.path.abspath(dst_mod) != os.path.abspath(src_mod):
+            shutil.copy(src_mod, dst_mod)
         with open(os.path.join(dirname, "train_state.bin"), "wb") as f:
             f.write(wire.encode({n: np.asarray(v)
                                  for n, v in self._state.items()}))

@@ -153,3 +153,31 @@ def test_nhwc_bn_fold_bias_axis():
         InferenceTranspiler().transpile(infer, scope=scope)
         got, = exe.run(infer, feed={"img": x}, fetch_list=[out.name])
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_fused_program_exports_aot(tmp_path):
+    """The AnalysisPredictor path (BN fold + block fusion) must still
+    AOT-export and serve in a fresh predictor: the fused op's kernel has
+    to survive jax.export serialization."""
+    main, startup, out = _build_resnet_tail("NHWC")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 8, 8, 16).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = str(tmp_path / "model")
+        fluid.save_inference_model(md, ["img"], [out], exe,
+                                   main_program=main)
+        from paddle_tpu.inference import (AnalysisConfig,
+                                          create_paddle_predictor,
+                                          load_aot_predictor)
+        p = create_paddle_predictor(AnalysisConfig(model_dir=md))
+        types = [op.type for op in p._program.global_block().ops]
+        assert types.count("fused_bottleneck") == 2, types
+        ref, = p.run({"img": x})
+        ad = str(tmp_path / "aot")
+        p.save_aot(ad, batch_sizes=(4,))
+        got, = load_aot_predictor(ad).run({"img": x})
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)

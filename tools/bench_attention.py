@@ -1,0 +1,114 @@
+"""Long-context attention microbench: Pallas flash attention vs the
+plain-XLA composition, sequence-length sweep on one chip.
+
+This is the perf evidence for the long-context story (SURVEY §5): the
+flash kernel (ops/pallas_kernels.py) keeps the [S, S] score matrix in
+VMEM with online softmax, so its memory footprint is O(S·block) while
+the naive path materializes O(S²) scores — at long S the naive form
+first slows (HBM traffic), then OOMs entirely; the kernel keeps going.
+
+Prints one JSON line per (seq_len, variant):
+  {"metric": "attention_fwd_bwd_ms", "seq_len": S, "variant":
+   "flash"|"xla", "value": ms, "tflops": ...}
+
+Run manually when the chip is stable (not part of the tpu_watch sweep —
+every extra compile there risks wedging the transport before the
+riskier remat stage). CPU smoke: --smoke runs tiny shapes in interpret
+mode so the harness itself is always testable.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head_dim", type=int, default=128)
+    ap.add_argument("--seq_lens", default="1024,2048,4096,8192")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--causal", type=int, default=1)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--require_tpu", action="store_true")
+    args = ap.parse_args()
+
+    from bench import init_backend
+    on_tpu, backend_label = init_backend(
+        smoke=args.smoke, require_tpu=args.require_tpu,
+        tool="bench_attention")
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import flash_attention
+    from paddle_tpu.parallel.ring_attention import local_attention
+
+    B, H, D = args.batch, args.heads, args.head_dim
+    causal = bool(args.causal)
+    seq_lens = [int(s) for s in args.seq_lens.split(",")]
+    if not on_tpu:
+        B, H, D = 2, 2, 64
+        seq_lens = [256, 512]
+        iters = 2
+    else:
+        iters = args.iters
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    def make_fn(attn):
+        def loss_fn(q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32))
+        grad = jax.grad(loss_fn, argnums=(0, 1, 2))
+
+        def step(q, k, v):
+            return grad(q, k, v)
+        return jax.jit(step)
+
+    flash = make_fn(lambda q, k, v: flash_attention(q, k, v,
+                                                    causal=causal))
+    naive = make_fn(lambda q, k, v: local_attention(q, k, v,
+                                                    causal=causal))
+
+    rng = np.random.RandomState(0)
+    for S in seq_lens:
+        q, k, v = (jax.device_put(
+            rng.randn(B, S, H, D).astype(np.float32) * 0.1).astype(dtype)
+            for _ in range(3))
+        # fwd+bwd FLOPs: 4*B*H*S^2*D fwd matmuls x ~2.5 for the backward
+        flops = 4.0 * B * H * S * S * D * 3.5 * (0.5 if causal else 1.0)
+        for name, fn in (("flash", flash), ("xla", naive)):
+            try:
+                out = fn(q, k, v)
+                jax.block_until_ready(out)
+                float(np.asarray(out[0], np.float32).ravel()[0])  # fence
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = fn(q, k, v)
+                float(np.asarray(out[0], np.float32).ravel()[0])
+                dt = (time.perf_counter() - t0) / iters
+                rec = {"metric": "attention_fwd_bwd_ms", "seq_len": S,
+                       "variant": name, "value": round(dt * 1e3, 3),
+                       "unit": "ms",
+                       "tflops": round(flops / dt / 1e12, 2),
+                       "batch": B, "heads": H, "head_dim": D,
+                       "causal": causal}
+            except Exception as e:  # OOM at long S is a RESULT
+                rec = {"metric": "attention_fwd_bwd_ms", "seq_len": S,
+                       "variant": name, "value": None,
+                       "error": type(e).__name__,
+                       "note": (str(e).splitlines() or [""])[0][:160]}
+            if backend_label:
+                rec["backend"] = backend_label
+            print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,27 +44,13 @@ def main():
                          "serving precision)")
     args = ap.parse_args()
 
-    from bench import _backend_probe
-    backend = None if args.smoke else _backend_probe()
-    if backend is None:
-        if args.require_tpu and not args.smoke:
-            print("bench_infer: TPU transport unreachable", file=sys.stderr)
-            sys.exit(3)
-        os.environ["JAX_PLATFORMS"] = "cpu"
+    from bench import init_backend
+    on_tpu, backend_label = init_backend(
+        smoke=args.smoke, require_tpu=args.require_tpu, tool="bench_infer")
     import jax
-    if backend is None:
-        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models.resnet import resnet_imagenet
-
-    on_tpu = jax.default_backend() == "tpu"
-    if args.require_tpu and not args.smoke and not on_tpu:
-        # same contract as bench_zoo: a healthy CPU-only backend is NOT
-        # a chip measurement — never exit 0 with CPU rows under the flag
-        print("bench_infer: backend is %r, not tpu"
-              % jax.default_backend(), file=sys.stderr)
-        sys.exit(3)
     batch = args.batch if on_tpu else 4
     iters = args.iters if on_tpu else 2
 
@@ -144,13 +130,8 @@ def main():
                         "fused_blocks": nf})
 
     for rec in results:
-        if not on_tpu:
-            if args.smoke:
-                rec["backend"] = "cpu (smoke mode; transport not probed)"
-            elif backend is None:
-                rec["backend"] = "cpu-fallback (TPU transport unreachable)"
-            else:
-                rec["backend"] = "cpu"
+        if backend_label:
+            rec["backend"] = backend_label
         print(json.dumps(rec))
 
 

@@ -115,8 +115,20 @@ PyObject *tensor_to_ndarray(const Predictor *p, const pd_tensor *t) {
     g_err = "unknown input dtype code";
     return nullptr;
   }
+  // validate BEFORE iterating dims: a garbage ndim would walk past the
+  // fixed dims[PD_MAX_DIMS] array (the output path already checks)
+  if (t->ndim < 0 || t->ndim > PD_MAX_DIMS) {
+    g_err = "input rank outside [0, PD_MAX_DIMS]";
+    return nullptr;
+  }
   size_t count = 1;
-  for (int i = 0; i < t->ndim; ++i) count *= (size_t)t->dims[i];
+  for (int i = 0; i < t->ndim; ++i) {
+    if (t->dims[i] < 0) {
+      g_err = "negative input dim";
+      return nullptr;
+    }
+    count *= (size_t)t->dims[i];
+  }
   if (t->nbytes != count * de->size) {
     g_err = "input nbytes does not match dims*itemsize";
     return nullptr;

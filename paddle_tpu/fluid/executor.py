@@ -327,24 +327,9 @@ class Executor:
             step_fn = functionalizer.build_step_fn(
                 program, feed_key, fetch_ext, persistables,
                 whole_graph_ad=wga, remat_policy=remat)
-
-            def loop_fn(state, feeds, step0, nsteps):
-                # first step OUTSIDE the loop: the input state may be a
-                # subset of the persistable set (scope before first run)
-                # while the step's output always covers all of it — the
-                # carry structure must be the fixed post-step one
-                carry = step_fn(state, feeds, step0)
-
-                def body(i, carry):
-                    return step_fn(carry[1], feeds,
-                                   step0 + jnp.uint32(i))
-                return jax.lax.fori_loop(1, nsteps, body, carry)
-
-            donate = ()
             dev = self._device()
-            if dev is not None and dev.platform == "tpu":
-                donate = (0,)
-            fn = jax.jit(loop_fn, donate_argnums=donate)
+            fn = functionalizer.jit_loop(
+                step_fn, dev is not None and dev.platform == "tpu")
             self._cache[key] = fn
         fetches, new_state = fn(state_in, feeds, np.uint32(step0),
                                 np.int32(steps))

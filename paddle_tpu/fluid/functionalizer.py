@@ -367,6 +367,31 @@ def flags_ad_config():
             FLAGS.remat_policy or None)
 
 
+def jit_loop(step_fn, donate_state):
+    """Wrap a step fn as a jitted K-step device-side loop:
+    fn(state, feeds, step0, nsteps) -> last step's (fetches, state).
+
+    The first step runs OUTSIDE the lax.fori_loop: the input state may
+    be a subset of the persistable set (scope before the first run)
+    while the step's output always covers all of it, and the loop carry
+    must have the fixed post-step structure. The step counter is folded
+    per iteration so per-op RNG streams advance exactly as under
+    per-step execution. Shared by Executor.run_loop and
+    ParallelExecutor.run_loop — the construction (carry trick, counter
+    fold, donation policy) must not fork between them."""
+    import jax
+    import jax.numpy as jnp
+
+    def loop_fn(state, feeds, step0, nsteps):
+        carry = step_fn(state, feeds, step0)
+
+        def body(i, carry):
+            return step_fn(carry[1], feeds, step0 + jnp.uint32(i))
+        return jax.lax.fori_loop(1, nsteps, body, carry)
+
+    return jax.jit(loop_fn, donate_argnums=(0,) if donate_state else ())
+
+
 def build_step_fn(program, feed_names, fetch_names, state_names,
                   block_idx=0, mesh=None, whole_graph_ad=False,
                   remat_policy=None):

@@ -289,6 +289,12 @@ class ParallelExecutor:
         steps = int(steps)
         if steps < 1:
             raise ValueError("run_loop: steps must be >= 1")
+        from ..flags import FLAGS
+        if FLAGS.check_nan_inf:
+            raise RuntimeError(
+                "run_loop: FLAGS.check_nan_inf needs per-op attribution, "
+                "which requires per-step execution — use "
+                "ParallelExecutor.run")
         hkey = self._main_program._version
         if self._host_ops_flag.get(hkey) is None:
             self._host_ops_flag[hkey] = \
@@ -311,20 +317,9 @@ class ParallelExecutor:
             step_fn = functionalizer.build_step_fn(
                 self._main_program, feed_key, fetch_names, persistables,
                 mesh=self._mesh, whole_graph_ad=wga, remat_policy=remat)
-
-            def loop_fn(state, feeds, step0, nsteps):
-                # first step outside the loop: input state may be a
-                # subset of the full persistable carry structure
-                carry = step_fn(state, feeds, step0)
-
-                def body(i, carry):
-                    return step_fn(carry[1], feeds,
-                                   step0 + jnp.uint32(i))
-                return jax.lax.fori_loop(1, nsteps, body, carry)
-
-            donate = (0,) if any(d.platform == "tpu"
-                                 for d in self._mesh.devices.flat) else ()
-            fn = jax.jit(loop_fn, donate_argnums=donate)
+            fn = functionalizer.jit_loop(
+                step_fn, any(d.platform == "tpu"
+                             for d in self._mesh.devices.flat))
             self._cache[key] = fn
         state_in = {n: self._scope.get(n) for n in persistables
                     if self._scope.get(n) is not None}

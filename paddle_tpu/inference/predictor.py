@@ -246,11 +246,22 @@ class Predictor:
         with open(os.path.join(dirname, "aot_state.bin"), "wb") as f:
             f.write(wire.encode({n: np.asarray(v)
                                  for n, v in self._state.items()}))
+        # which fetches are batch-major (program var has a -1 leading
+        # dim): only those get un-padded at serve time — a global output
+        # whose leading dim merely EQUALS the padded bucket must come
+        # back whole
+        fetch_batched = []
+        for name in self._fetch_names:
+            v = gb._find_var_recursive(name)
+            fetch_batched.append(
+                bool(v is not None and v.shape is not None
+                     and len(v.shape) >= 1 and int(v.shape[0]) == -1))
         meta = {
             "feed_names": list(self._feed_names),
             "fetch_names": list(self._fetch_names),
             "feed_specs": {n: {"shape": list(s), "dtype": d}
                            for n, (s, d) in feed_specs.items()},
+            "fetch_batched": fetch_batched,
             "exports": exports,
             "platform": jax.default_backend(),
         }
@@ -275,6 +286,7 @@ class AotPredictor:
         self._feed_names = list(meta["feed_names"])
         self._fetch_names = list(meta["fetch_names"])
         self._feed_specs = meta["feed_specs"]
+        self._fetch_batched = meta.get("fetch_batched")
         self._fns = {}
         for bs, fname in sorted(meta["exports"].items(),
                                 key=lambda kv: int(kv[0])):
@@ -311,12 +323,18 @@ class AotPredictor:
             feeds[name] = jnp.asarray(arr)
         fetches = self._fns[cap](self._state, feeds)
         out = []
-        for f in fetches:
+        for i, f in enumerate(fetches):
             a = np.asarray(f)
-            # un-pad only fetches that are batch-major for the padded
-            # bucket — a reduced/global output (leading dim unrelated to
-            # batch) must come back whole
-            if cap > b and a.ndim >= 1 and a.shape[0] == cap:
+            # un-pad only fetches the artifact marked batch-major — a
+            # reduced/global output whose leading dim coincidentally
+            # equals the padded bucket must come back whole. Artifacts
+            # predating the marker fall back to the shape heuristic.
+            if self._fetch_batched is not None:
+                batched = (i < len(self._fetch_batched)
+                           and self._fetch_batched[i])
+            else:
+                batched = a.ndim >= 1 and a.shape[0] == cap
+            if cap > b and batched and a.ndim >= 1 and a.shape[0] == cap:
                 a = a[:b]
             out.append(a)
         return out

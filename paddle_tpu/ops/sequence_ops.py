@@ -197,14 +197,25 @@ def _sequence_expand(ctx):
         def conform(out, out_lens, xa_ndim):
             """Pad/trim the time axis to Y's padded width Ty so downstream
             elementwise ops against Y line up; trimming may only remove
-            padding (the reference's packed layout has no width notion)."""
+            padding (the reference's packed layout has no width notion).
+            Eagerly, a real truncation raises; under jit the check is
+            data-dependent, so overflowed ROWS are poisoned with NaN
+            (float dtypes) instead of silently clipped — FLAGS.
+            check_nan_inf or any downstream reduction surfaces it."""
             Ty = y.shape[1]
             if Ty >= out.shape[1]:
                 pad = [(0, 0), (0, Ty - out.shape[1])] + \
                     [(0, 0)] * (xa_ndim - 2)
                 return jnp.pad(out, pad), out_lens
             max_len = jnp.max(out_lens) if out_lens.shape[0] else 0
-            if isinstance(max_len, jax.core.Tracer) or int(max_len) <= Ty:
+            if isinstance(max_len, jax.core.Tracer):
+                trimmed = out[:, :Ty]
+                if jnp.issubdtype(trimmed.dtype, jnp.floating):
+                    bad = (out_lens > Ty).reshape(
+                        (-1,) + (1,) * (xa_ndim - 1))
+                    trimmed = jnp.where(bad, jnp.nan, trimmed)
+                return trimmed, jnp.minimum(out_lens, Ty)
+            if int(max_len) <= Ty:
                 return out[:, :Ty], jnp.minimum(out_lens, Ty)
             raise ValueError(
                 "sequence_expand: Y's padded width %d cannot hold the "
@@ -684,7 +695,15 @@ def _kmax_seq_score(ctx):
         lens = jnp.full((B,), T, jnp.int32)
     valid = jnp.arange(T)[None, :] < lens[:, None]
     masked = jnp.where(valid, x, -jnp.inf)
-    idx = jnp.argsort(-masked, axis=1)[:, :k]
+    # reference KmaxSeqScoreLayer: output is ALWAYS [B, beam_size],
+    # pre-filled with -1; only min(beam_size, seq_len) slots per row
+    # hold real indices (consumers like sub_nested_seq skip negatives)
+    idx = jnp.argsort(-masked, axis=1)[:, :k]     # [B, min(k, T)]
+    slot = jnp.arange(idx.shape[1])[None, :]
+    idx = jnp.where(slot < lens[:, None], idx, -1)
+    if idx.shape[1] < k:
+        idx = jnp.concatenate(
+            [idx, jnp.full((B, k - idx.shape[1]), -1, idx.dtype)], axis=1)
     return {"Out": idx.astype(jnp.int64)}
 
 

@@ -353,3 +353,22 @@ def test_beam_search_binds_generated_input_in_place():
                        input=[(rng.randn(hid).astype(np.float32),)])
     ids = np.asarray(got).ravel()
     assert ids.size >= W and np.all((ids >= 0) & (ids < vocab))
+
+
+def test_kmax_seq_score_fills_unfilled_slots_with_minus_one():
+    # reference KmaxSeqScoreLayer: output is always [B, beam_size]
+    # pre-filled with -1; a sequence shorter than the beam must NOT
+    # surface padding-position indices in the tail slots
+    x = v1.data_layer(name="km1",
+                      type=paddle.data_type.dense_vector_sequence(1))
+    layer = v1.kmax_seq_score_layer(input=x, beam_size=3)
+    topo = paddle.topology.Topology([layer])
+    p = paddle.parameters.create(layer)
+    seqs = [
+        (np.array([[0.2], [0.8]], np.float32),),       # len 2 < beam 3
+        (np.array([[0.1], [0.9], [0.3], [0.4]], np.float32),),
+    ]
+    got = np.asarray(paddle.infer(output_layer=layer, parameters=p,
+                                  input=seqs)).reshape(2, 3).astype(int)
+    assert got[0].tolist() == [1, 0, -1], got
+    assert got[1].tolist() == [1, 3, 2], got

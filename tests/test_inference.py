@@ -158,3 +158,34 @@ print("AOT-SERVE-OK")
                           cwd=os.path.dirname(HERE))
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "AOT-SERVE-OK" in proc.stdout
+
+
+def test_aot_unpad_spares_global_fetches(tmp_path):
+    """Un-padding must only apply to batch-major fetches: a global
+    (reduced) output whose leading dim coincidentally equals the padded
+    batch bucket must come back whole."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[4], dtype="float32")
+        pred = fluid.layers.fc(input=img, size=8, act="softmax")
+        # [8]-vector: leading dim == the padded bucket below, NOT batch
+        colsum = fluid.layers.reduce_sum(pred, dim=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        model_dir = str(tmp_path / "m")
+        fluid.save_inference_model(model_dir, ["img"], [pred, colsum],
+                                   exe, main_program=main)
+        p = create_paddle_predictor(NativeConfig(model_dir=model_dir))
+        aot = str(tmp_path / "aot")
+        p.save_aot(aot, batch_sizes=(8,))
+    from paddle_tpu.inference import load_aot_predictor
+    q = load_aot_predictor(aot)
+    x = rng.randn(1, 4).astype(np.float32)     # b=1, padded to cap=8
+    got_pred, got_colsum = q.run({"img": x})
+    assert got_pred.shape == (1, 8)            # batch-major: un-padded
+    assert got_colsum.shape == (8,), got_colsum.shape  # global: whole

@@ -146,6 +146,36 @@ def _depthwise_conv2d(ctx):
     return _conv2d(ctx)
 
 
+@register_op("conv2d_dynamic_filter")
+def _conv2d_dynamic_filter(ctx):
+    """Per-SAMPLE dynamic filters (reference ConvOperator,
+    legacy/gserver ConvOp with a filter produced by another layer):
+    Input [B, C, H, W] is convolved with Filter [B, nf*C*fy*fx] — each
+    sample uses its own filter values. Lowered as ONE grouped conv via
+    the feature-group trick: x -> [1, B*C, H, W], filters ->
+    [B*nf, C, fy, fx], feature_group_count=B, so group b convolves
+    sample b's channels with sample b's filters on the MXU (no python
+    loop over the batch)."""
+    import jax
+    jnp = _jnp()
+    x, f = ctx.input("Input"), ctx.input("Filter")
+    nf = int(ctx.attr("num_filters"))
+    fy = int(ctx.attr("filter_size_y"))
+    fx = int(ctx.attr("filter_size_x"))
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    B, C = x.shape[0], x.shape[1]
+    w = f.reshape(B * nf, C, fy, fx).astype(x.dtype)
+    xg = x.reshape(1, B * C, x.shape[2], x.shape[3])
+    out = jax.lax.conv_general_dilated(
+        xg, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        feature_group_count=B,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    out = out.reshape(B, nf, out.shape[2], out.shape[3]).astype(x.dtype)
+    return {"Output": out}
+
+
 @register_op("conv3d")
 def _conv3d(ctx):
     import jax

@@ -332,14 +332,16 @@ class Fake(object):
     """Cache the first item a reader yields and repeat it data_num times
     (reference decorator.py:509) — pins the input for speed testing."""
 
-    _EMPTY = object()
+    _EMPTY = object()      # source reader yielded nothing
+    _UNSET = object()      # first item not cached yet (None is a legal
+                           # item — it must not re-trigger consumption)
 
     def __init__(self):
-        self.data = None
+        self.data = Fake._UNSET
 
     def __call__(self, reader, data_num):
         def fake_reader():
-            if self.data is None:
+            if self.data is Fake._UNSET:
                 self.data = next(reader(), Fake._EMPTY)
             if self.data is Fake._EMPTY:
                 return   # empty source reader -> empty stream

@@ -107,7 +107,7 @@ seq_slice_layer = _v2.seq_slice
 pad_layer = _v2.pad
 rotate_layer = _v2.rotate
 maxout_layer = _v2.maxout
-cross_channel_norm_layer = _v2.norm
+cross_channel_norm_layer = _v2.cross_channel_norm
 sampling_id_layer = _v2.sampling_id
 out_prod_layer = _v2.out_prod
 block_expand_layer = _v2.block_expand

@@ -39,6 +39,7 @@ __all__ = [
     "conv_operator", "cos_sim", "interpolation", "power",
     "sum_to_one_norm", "linear_comb", "bilinear_interp", "repeat",
     "seq_concat", "seq_slice", "pad", "rotate", "maxout", "norm",
+    "cross_channel_norm",
     "sampling_id", "out_prod", "block_expand", "crop", "clip",
     "dot_prod", "l2_distance", "smooth_l1_cost", "multiplex", "prelu",
     "gated_unit", "scale_shift", "resize", "row_conv", "sub_seq",
@@ -501,17 +502,20 @@ def conv_operator(img, filter, filter_size, num_filters,
     nc = num_channels
 
     def build(iv, fv):
-        c = nc if nc is not None else int(iv.shape[1])
-        f = F.reshape(fv, shape=[num_filters, c, fy, filter_size])
+        # fv is [B, num_filters*c*fy*fx]: PER-SAMPLE filters (the
+        # reference ConvOperator's dynamic-filter semantics) — lowered
+        # to the feature-group trick, not a batchless reshape
         from ..fluid.layer_helper import LayerHelper
         helper = LayerHelper("conv_operator")
         out = helper.create_variable_for_type_inference(iv.dtype)
         helper.append_op(
-            type="conv2d", inputs={"Input": [iv], "Filter": [f]},
+            type="conv2d_dynamic_filter",
+            inputs={"Input": [iv], "Filter": [fv]},
             outputs={"Output": [out]},
-            attrs={"strides": [stride_y or stride, stride],
-                   "paddings": [padding_y or padding, padding],
-                   "dilations": [1, 1], "groups": 1},
+            attrs={"num_filters": num_filters,
+                   "filter_size_y": fy, "filter_size_x": filter_size,
+                   "strides": [stride_y or stride, stride],
+                   "paddings": [padding_y or padding, padding]},
             infer_shape=False)
         return out
 
@@ -775,18 +779,6 @@ def eos(input, eos_id, name=None, layer_attr=None):
 # paddle/legacy/gserver/layers/ Layer classes, lowered to fluid ops.
 # ---------------------------------------------------------------------------
 
-def _unary(layer_type, fn):
-    def layer(input, name=None, layer_attr=None, **kw):
-        def build(pv):
-            return fn(pv, **kw)
-        return _remember(Layer(name=name,
-                               parents=[_single_input(input)],
-                               build_fn=build, layer_type=layer_type,
-                               layer_attr=layer_attr))
-    layer.__name__ = layer_type
-    return layer
-
-
 def cos_sim(a, b, scale=1, size=1, name=None, layer_attr=None):
     """CosSimLayer (gserver/layers/CosSimLayer.cpp)."""
     def build(av, bv):
@@ -963,10 +955,42 @@ def maxout(input, groups, num_channels=None, name=None, layer_attr=None):
                            layer_attr=layer_attr))
 
 
-def norm(input, norm_type="cmrnorm-projection", channels=1, size=None,
-         name=None, layer_attr=None):
-    """CrossChannelNormLayer: L2-normalize across the channel axis."""
+def cross_channel_norm(input, name=None, param_attr=None,
+                       layer_attr=None):
+    """CrossChannelNormLayer (the SSD conv4_3 normalizer,
+    reference layers.py:1377): L2-normalize across the channel axis at
+    each spatial position, then scale by a LEARNED per-channel factor
+    (SSD initializes it to 20 via param_attr)."""
     def build(pv):
+        out = F.l2_normalize(pv, axis=1)
+        channels = int(pv.shape[1])
+        from ..fluid.initializer import Constant
+        scale = F.create_parameter(
+            shape=[channels], dtype="float32",
+            attr=lower_param_attr(param_attr),
+            default_initializer=Constant(1.0))
+        return F.elementwise_mul(out, scale, axis=1)
+
+    return _remember(Layer(name=name, parents=[_single_input(input)],
+                           build_fn=build, layer_type="norm",
+                           layer_attr=layer_attr))
+
+
+def norm(input, norm_type="cmrnorm-projection", channels=1, size=None,
+         name=None, param_attr=None, layer_attr=None, **kw):
+    """The v1 Norm-config dispatcher: cross-channel-norm is the learned
+    SSD normalizer; cmrnorm-projection is local response normalization
+    (img_cmrnorm)."""
+    if norm_type == "cross-channel-norm":
+        return cross_channel_norm(input, name=name,
+                                  param_attr=param_attr,
+                                  layer_attr=layer_attr)
+
+    def build(pv):
+        if norm_type in ("cmrnorm-projection", "cmrnorm"):
+            return F.lrn(pv, n=size or 5,
+                         alpha=kw.get("scale", 1e-4),
+                         beta=kw.get("power", 0.75))
         return F.l2_normalize(pv, axis=1)
 
     return _remember(Layer(name=name, parents=[_single_input(input)],
@@ -1091,9 +1115,28 @@ def multiplex(input, name=None, layer_attr=None):
 
 def prelu(input, partial_sum=1, param_attr=None, name=None,
           layer_attr=None):
-    """PReluLayer -> prelu op (per-channel slopes)."""
+    """PReluLayer. The reference's partial_sum groups elements sharing
+    one slope (layers.py:6790): 1 = element-wise, elements-per-channel
+    = channel-wise, all elements = one shared slope. Mapped onto the
+    fluid prelu modes element/channel/all respectively; other group
+    sizes have no fluid equivalent and are rejected."""
     def build(pv):
-        mode = "all" if partial_sum == int(pv.shape[-1]) else "channel"
+        import numpy as _np
+        dims = [int(d) for d in pv.shape[1:]]
+        nelem = int(_np.prod(dims)) if dims else 1
+        per_channel = (nelem // dims[0]) if dims else 1
+        if partial_sum == 1:
+            mode = "element"
+        elif partial_sum == nelem:
+            mode = "all"
+        elif dims and partial_sum == per_channel:
+            mode = "channel"
+        else:
+            raise ValueError(
+                "prelu: partial_sum=%d does not match element-wise (1), "
+                "channel-wise (%d) or shared (%d) grouping for input "
+                "shape %s" % (partial_sum, per_channel, nelem,
+                              tuple(pv.shape)))
         return F.prelu(pv, mode=mode,
                        param_attr=lower_param_attr(param_attr))
 
@@ -2134,7 +2177,15 @@ def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
                         args.append(_var_layer(word_emb))
                     else:
                         args.append(_var_layer(next(static_it)))
-                out_layer = step(*args)
+                # capture every layer the step creates: memories may
+                # link to SIDE layers unreachable from the step's output
+                # (get_output state taps, e.g. an LSTM decoder's cell) —
+                # the same treatment recurrent_group gives its links
+                _capture_stack.append([])
+                try:
+                    out_layer = step(*args)
+                finally:
+                    created = _capture_stack.pop()
                 if isinstance(out_layer, (list, tuple)):
                     out_layer = out_layer[0]
                 # collect the step DAG; seed memory markers with current
@@ -2151,6 +2202,21 @@ def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
                 _collect(out_layer)
                 mems = [n for n in all_nodes.values()
                         if isinstance(n, _Memory)]
+                for n in created:
+                    if isinstance(n, _Memory) and id(n) not in all_nodes:
+                        all_nodes[id(n)] = n
+                        mems.append(n)
+                # link resolution across the step DAG AND side layers
+                link_by_name = {}
+                for n in list(all_nodes.values()) + created:
+                    if not isinstance(n, _Memory):
+                        link_by_name.setdefault(n.name, n)
+                side_links = []
+                for m in mems:
+                    link = link_by_name.get(m.link_name)
+                    if link is not None and id(link) not in all_nodes:
+                        _collect(link)
+                        side_links.append(link)
                 for node in mems:
                     if node.link_name not in mem_vals:
                         if node.boot_layer is not None:
@@ -2181,12 +2247,14 @@ def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
                                     dtype="float32", value=0.0)
                     step_ctx[id(node)] = mem_vals[node.link_name]
                 probs_var = out_layer.build(step_ctx)
+                # side links build AFTER the output: the shared prefix
+                # is cached in step_ctx, only the tap itself is emitted
+                for link in side_links:
+                    link.build(step_ctx)
                 # the new memory values are the step layers named by the
                 # memory links
                 for m in mems:
-                    link = next((n for n in all_nodes.values()
-                                 if n.name == m.link_name and
-                                 not isinstance(n, _Memory)), None)
+                    link = link_by_name.get(m.link_name)
                     if link is not None and id(link) in step_ctx:
                         mem_vals[m.link_name] = step_ctx[id(link)]
 

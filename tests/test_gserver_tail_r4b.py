@@ -372,3 +372,42 @@ def test_kmax_seq_score_fills_unfilled_slots_with_minus_one():
                                   input=seqs)).reshape(2, 3).astype(int)
     assert got[0].tolist() == [1, 0, -1], got
     assert got[1].tolist() == [1, 3, 2], got
+
+
+def test_beam_search_lstm_decoder_cell_state_advances():
+    """An LSTM decoder's cell memory links to a get_output SIDE layer
+    that is unreachable from the step's output — beam_search must still
+    update it every timestep (frozen-at-zero cell state regression)."""
+    import paddle_tpu.v2.networks as networks
+    vocab, hid, W, maxlen = 8, 4, 2, 3
+    emb = 4 * hid       # lstmemory_unit identity-projects the input
+    enc = v1.data_layer(name="enc_l", size=hid)
+
+    def step(word_emb, enc_ctx):
+        h = networks.lstmemory_unit(input=word_emb, name="dec_lstm",
+                                    size=hid)
+        return v1.fc_layer(input=h, size=vocab,
+                           act=paddle.activation.Softmax())
+
+    gen = v1.beam_search(
+        step=step,
+        input=[v1.GeneratedInput(size=vocab, embedding_name="lemb",
+                                 embedding_size=emb),
+               v1.StaticInput(input=enc)],
+        bos_id=0, eos_id=1, beam_size=W, max_length=maxlen)
+
+    topo = paddle.topology.Topology([gen])
+    ops = topo.main_program.global_block().ops
+    lstm_ops = [op for op in ops if op.type == "lstm_unit"]
+    assert len(lstm_ops) == maxlen, len(lstm_ops)
+    c_prevs = [op.inputs["C_prev"][0] for op in lstm_ops]
+    # frozen-state bug: every timestep read the SAME zeros var; the
+    # fixed path threads each step's C output (beam-gathered) forward
+    assert len(set(c_prevs)) == maxlen, c_prevs
+
+    rng = np.random.RandomState(15)
+    p = paddle.parameters.create(gen)
+    got = paddle.infer(output_layer=gen, parameters=p,
+                       input=[(rng.randn(hid).astype(np.float32),)])
+    ids = np.asarray(got).ravel()
+    assert ids.size >= W and np.all((ids >= 0) & (ids < vocab))

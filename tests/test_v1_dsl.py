@@ -255,3 +255,40 @@ def test_v1_tch_namespace_exports_tail():
                  "ExtraLayerAttribute"]:
         assert hasattr(tch, name) or hasattr(v1, name), name
         assert getattr(v1, name) is not None
+
+
+def test_conv_operator_per_sample_filters_batch2():
+    """conv_operator uses PER-SAMPLE dynamic filters (reference
+    ConvOperator): with batch 2 each sample must be convolved with its
+    own filter values, and the whole batch runs as one grouped conv."""
+    rng = np.random.RandomState(11)
+    img = v1.data_layer(name="im2",
+                        type=paddle.data_type.dense_vector(2 * 5 * 5),
+                        height=5, width=5)
+    filt = v1.fc_layer(input=v1.data_layer(
+        name="fs2", type=paddle.data_type.dense_vector(6)),
+        size=3 * 2 * 3 * 3, bias_attr=False)
+    co = v1.mixed_layer(
+        input=[v1.conv_operator(img=img, filter=filt, filter_size=3,
+                                num_filters=3, num_channels=2,
+                                padding=1)])
+    p = paddle.parameters.create(co)
+    ims = rng.randn(2, 2 * 5 * 5).astype(np.float32)
+    fss = rng.randn(2, 6).astype(np.float32)
+    got = np.asarray(paddle.infer(
+        output_layer=co, parameters=p,
+        input=[(ims[0], fss[0]), (ims[1], fss[1])])).reshape(2, 3, 5, 5)
+
+    # oracle: per-sample scipy-style conv via explicit numpy
+    w_fc = p.get(sorted(n for n in p.names() if "fc" in n or "w" in n)[0])
+    filt_vals = fss @ np.asarray(w_fc, np.float32)      # [2, 54]
+    import jax.numpy as jnp
+    import jax
+    for b in range(2):
+        w = filt_vals[b].reshape(3, 2, 3, 3)
+        want = jax.lax.conv_general_dilated(
+            jnp.asarray(ims[b].reshape(1, 2, 5, 5)), jnp.asarray(w),
+            (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(got[b], np.asarray(want)[0],
+                                   rtol=2e-4, atol=2e-5)

@@ -209,7 +209,9 @@ def main():
     for r in rows:
         dp = r["dp"]
         fl = r["per_chip_flops"]
-        ideal = base_flops / dp
+        # ideal is 1/dp of the FIRST row's total work — the first row
+        # need not be dp=1, so rescale by its own dp
+        ideal = base_flops * rows[0]["dp"] / dp
         ar = r["collectives"].get("all-reduce", [0, 0])
         others = {k: v for k, v in r["collectives"].items()
                   if k != "all-reduce"}

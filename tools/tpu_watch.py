@@ -63,6 +63,7 @@ def main():
     args = ap.parse_args()
 
     results = []
+    remat_failures = 0
     with open(args.log, "a") as log:
         while True:
             backend = probe()
@@ -144,7 +145,27 @@ def main():
                         ok2, out2 = run_logged(
                             [sys.executable, "bench.py"],
                             {"BENCH_REMAT": "1"}, log, 1800)
-                        if ok2:
+                        if not ok2:
+                            # remat is the riskiest compile; a wedge here
+                            # is retried like the zoo/infer stages — but
+                            # bounded, so a deterministic compile error
+                            # cannot cycle the full sweep forever
+                            remat_failures += 1
+                            if remat_failures < 3:
+                                log.write("[%s] remat run failed (%d); "
+                                          "resuming probe loop\n"
+                                          % (time.strftime("%H:%M:%S"),
+                                             remat_failures))
+                                log.flush()
+                                if args.once:
+                                    return
+                                time.sleep(args.interval)
+                                continue
+                            log.write("[%s] remat failed %d times; "
+                                      "completing sweep without it\n"
+                                      % (time.strftime("%H:%M:%S"),
+                                         remat_failures))
+                        else:
                             parse_lines(out2, "nhwc+remat")
                         flush_results()
                         log.write("[%s] sweep complete\n"

@@ -144,14 +144,23 @@ class MasterService:
     def _recover(self):
         st = load_state_snapshot(self.snapshot_path)
         mk = lambda rows: [Task(i, p, f) for (i, p, f) in rows]
+        # decode EVERYTHING before assigning ANY field: a snapshot
+        # missing one key (format drift surviving the CRC) must not
+        # leave a half-recovered queue behind the caller's "fresh
+        # queue" warning.
         # leases do not survive a master restart: pending -> todo
         # (go/master recovers the queue from etcd; lease holders re-ask)
-        self.todo = mk(st["todo"]) + mk(st["pending"])
+        todo = mk(st["todo"]) + mk(st["pending"])
+        done = mk(st["done"])
+        discarded = mk(st["discarded"])
+        num_passes = st["num_passes"]
+        dataset_set = st["dataset_set"]
+        self.todo = todo
         self.pending = {}
-        self.done = mk(st["done"])
-        self.discarded = mk(st["discarded"])
-        self.num_passes = st["num_passes"]
-        self.dataset_set = st["dataset_set"]
+        self.done = done
+        self.discarded = discarded
+        self.num_passes = num_passes
+        self.dataset_set = dataset_set
 
     # ---- queue ops ----
     def set_dataset(self, payloads):

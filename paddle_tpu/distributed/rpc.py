@@ -19,6 +19,7 @@ slot, runs that param's optimize block, bumps the generation, and wakes Get
 waiters; fetch-barrier closes the step.
 """
 
+import os
 import socket
 import socketserver
 import struct
@@ -33,9 +34,12 @@ __all__ = ["VariableServer", "RPCClient", "serialize_array",
            "deserialize_array"]
 
 _HDR = struct.Struct("<Q")
-# Frame cap: a hostile/garbled length prefix must not become an OOM. Big
-# enough for any sliced param block (slice_variable keeps blocks ~MBs).
-_MAX_FRAME = 1 << 31
+# Frame cap: a hostile/garbled length prefix must not become an OOM.
+# slice_variable keeps pserver blocks ~MBs, so 256 MiB leaves two
+# orders of magnitude of headroom while keeping the worst case of a
+# bogus header a bounded allocation; unsliced jumbo tensors can raise
+# it via PADDLE_TPU_MAX_RPC_FRAME (bytes).
+_MAX_FRAME = int(os.environ.get("PADDLE_TPU_MAX_RPC_FRAME", 1 << 28))
 
 
 def _send_msg(sock, obj):

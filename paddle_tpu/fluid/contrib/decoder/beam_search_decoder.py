@@ -511,6 +511,16 @@ class BeamSearchDecoder(object):
     def __call__(self):
         if self._status != BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER:
             raise ValueError("call BeamSearchDecoder after decode()")
+        if self._sentence_ids is None and self._ids_array is None:
+            # the symmetric misuse cases both get explicit messages —
+            # returning (None, None) here would surface as an unrelated
+            # error at the caller's fetch
+            raise ValueError(
+                "custom decoder block never marked an ids array — "
+                "beam_search_decode needs both (mark the ids array with "
+                "read_array(..., is_ids=True)%s)"
+                % ("" if self._scores_array is None
+                   else "; is_scores was marked"))
         if self._sentence_ids is None and self._ids_array is not None:
             if self._scores_array is None:
                 raise ValueError(

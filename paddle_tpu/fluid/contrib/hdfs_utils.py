@@ -158,9 +158,10 @@ class HDFSClient(object):
             if not ok:
                 return []
             # 8 fields, maxsplit=7: spaces in the path stay intact
-            return [line.split(None, 7)[7] for line in out.splitlines()
-                    if line and not line.startswith("Found")
-                    and len(line.split(None, 7)) >= 8]
+            return [parts[7] for parts in
+                    (line.split(None, 7) for line in out.splitlines()
+                     if line and not line.startswith("Found"))
+                    if len(parts) >= 8]
         p = self._local(hdfs_path)
         if not os.path.isdir(p):
             return []

@@ -230,6 +230,15 @@ class Predictor:
         state_spec = {n: jax.ShapeDtypeStruct(np.shape(v),
                                               np.asarray(v).dtype)
                       for n, v in self._state.items()}
+        for name, (shape, dt) in feed_specs.items():
+            if any(d == -1 for d in shape[1:]):
+                # same guard as train_export.save_aot_trainer: a
+                # non-leading dynamic dim silently frozen to the batch
+                # size would produce an artifact that rejects every
+                # differently-shaped request at serve time
+                raise ValueError(
+                    "feed %r has non-batch dynamic dims %s — AOT export "
+                    "needs static non-batch shapes" % (name, shape))
         exports = {}
         for bs in batch_sizes:
             feeds_spec = {}
@@ -305,7 +314,16 @@ class AotPredictor:
                     named[t.name or self._feed_names[i]] = t.data
                 else:
                     named[self._feed_names[i]] = np.asarray(t)
-        b = next(iter(named.values())).shape[0]
+        # the batch is read from (and padding applied to) BATCH-MAJOR
+        # feeds only — those whose recorded var shape leads with -1; a
+        # fixed-shape side feed must go through untouched
+        batched_feed = {n: bool(spec["shape"]
+                                and int(spec["shape"][0]) == -1)
+                        for n, spec in self._feed_specs.items()}
+        b = next((arr.shape[0] for name, arr in named.items()
+                  if batched_feed.get(name)), None)
+        if b is None:
+            b = next(iter(named.values())).shape[0]
         cap = next((c for c in self._fns if c >= b), None)
         if cap is None:
             raise ValueError(
@@ -316,7 +334,7 @@ class AotPredictor:
             want = np.dtype(self._feed_specs[name]["dtype"])
             if arr.dtype != want:
                 arr = arr.astype(want)
-            if cap > b:
+            if cap > b and batched_feed.get(name):
                 arr = np.concatenate(
                     [arr, np.zeros((cap - b,) + arr.shape[1:],
                                    arr.dtype)], axis=0)

@@ -110,6 +110,12 @@ def _tensor_parts(obj):
     code = _DTYPE_CODES.get(arr.dtype)
     if code is None:
         raise WireError("unsupported tensor dtype %s" % arr.dtype)
+    if arr.ndim > _MAX_NDIM:
+        # the parser (both C++ and python) caps rank at _MAX_NDIM —
+        # refusing HERE keeps encode/decode a round trip instead of
+        # writing frames our own decoder calls malformed
+        raise WireError("tensor rank %d exceeds the wire format's max "
+                        "of %d" % (arr.ndim, _MAX_NDIM))
     return arr, code
 
 

@@ -189,3 +189,55 @@ def test_aot_unpad_spares_global_fetches(tmp_path):
     got_pred, got_colsum = q.run({"img": x})
     assert got_pred.shape == (1, 8)            # batch-major: un-padded
     assert got_colsum.shape == (8,), got_colsum.shape  # global: whole
+
+
+def test_aot_fixed_shape_side_feed_not_padded(tmp_path):
+    """Batch padding must only touch batch-major feeds; a fixed-shape
+    side feed (append_batch_size=False) goes through whole, and the
+    request batch is inferred from a batch-major feed regardless of
+    dict order."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = startup.random_seed = 21
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[4], dtype="float32")
+        aux = fluid.layers.data(name="aux", shape=[4], dtype="float32",
+                                append_batch_size=False)
+        out = fluid.layers.elementwise_add(
+            fluid.layers.fc(input=img, size=4), aux, axis=-1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = str(tmp_path / "m")
+        fluid.save_inference_model(md, ["img", "aux"], [out], exe,
+                                   main_program=main)
+        p = create_paddle_predictor(NativeConfig(model_dir=md))
+        aot = str(tmp_path / "aot")
+        p.save_aot(aot, batch_sizes=(8,))
+    from paddle_tpu.inference import load_aot_predictor
+    q = load_aot_predictor(aot)
+    x = rng.randn(1, 4).astype(np.float32)
+    a = rng.randn(4).astype(np.float32)
+    # aux first: batch must still come from the batch-major img feed
+    res, = q.run({"aux": a, "img": x})
+    assert res.shape == (1, 4)
+
+
+def test_aot_export_rejects_non_batch_dynamic_dims(tmp_path):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        seq = fluid.layers.data(name="s", shape=[-1, 4], dtype="float32")
+        out = fluid.layers.relu(seq)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = str(tmp_path / "m")
+        fluid.save_inference_model(md, ["s"], [out], exe,
+                                   main_program=main)
+        p = create_paddle_predictor(NativeConfig(model_dir=md))
+        with pytest.raises(ValueError, match="non-batch dynamic"):
+            p.save_aot(str(tmp_path / "aot"), batch_sizes=(4,))

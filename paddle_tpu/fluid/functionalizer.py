@@ -367,6 +367,26 @@ def flags_ad_config():
             FLAGS.remat_policy or None)
 
 
+def export_step_for_tpu(step_fn, state, feed_specs):
+    """Cross-platform jax.export of a step fn for the TPU platform —
+    the off-chip lowering check (Pallas->Mosaic conversion and XLA
+    lowering run at export time, so kernel/layout regressions surface
+    without a chip). `state` maps name -> array (or ShapeDtypeStruct);
+    `feed_specs` maps name -> (shape, dtype). Shared by
+    tools/check_tpu_lowering.py and the in-suite lowering guards."""
+    import jax
+    import numpy as _np
+    from jax import export as jax_export
+    state_spec = {n: v if isinstance(v, jax.ShapeDtypeStruct)
+                  else jax.ShapeDtypeStruct(_np.shape(v),
+                                            _np.asarray(v).dtype)
+                  for n, v in state.items()}
+    feeds_spec = {n: jax.ShapeDtypeStruct(tuple(s), _np.dtype(d))
+                  for n, (s, d) in feed_specs.items()}
+    return jax_export.export(jax.jit(step_fn), platforms=["tpu"])(
+        state_spec, feeds_spec, jax.ShapeDtypeStruct((), _np.uint32))
+
+
 def jit_loop(step_fn, donate_state):
     """Wrap a step fn as a jitted K-step device-side loop:
     fn(state, feeds, step0, nsteps) -> last step's (fetches, state).

@@ -123,10 +123,12 @@ def _while(ctx):
             carry = run_body(overlay(carry))
         final = carry
     elif max_iters and not ctx.attr("is_test", False):
+        run_pinned = _pin_carry_dtypes(run_body, init, jnp)
+
         def scan_body(carry, _):
             e = overlay(carry)
             pred = e[cond_name].reshape(())
-            new = run_body(e)
+            new = run_pinned(e)
             kept = tuple(jnp.where(pred, nv, cv)
                          for nv, cv in zip(new, carry))
             return kept, None
@@ -134,13 +136,17 @@ def _while(ctx):
                                 length=int(max_iters))
     elif record_cap and not ctx.attr("is_test", False):
         final = _recorded_while(ctx, block, carry_names, closure, init,
-                                cond_name, run_body, int(record_cap))
+                                cond_name,
+                                _pin_carry_dtypes(run_body, init, jnp),
+                                int(record_cap))
     else:
+        run_pinned = _pin_carry_dtypes(run_body, init, jnp)
+
         def cond_fun(carry):
             return overlay(carry)[cond_name].reshape(())
 
         def body_fun(carry):
-            return run_body(overlay(carry))
+            return run_pinned(overlay(carry))
 
         final = jax.lax.while_loop(cond_fun, body_fun, init)
 
@@ -150,6 +156,20 @@ def _while(ctx):
         return {}
     by_name = dict(zip(carry_names, final))
     return {"Out": [by_name.get(n) for n in out_names]}
+
+
+def _pin_carry_dtypes(run_body, init, jnp):
+    """Wrap a while/scan body so its carry outputs keep the INIT dtypes:
+    under AMP a body op can promote a bf16-initialized carry to fp32
+    (bf16 activation meeting an fp32 master weight), tripping the
+    carry-type check at lowering — the same class fixed in the
+    lstm/gru/recurrent scans."""
+    dtypes = tuple(jnp.asarray(v).dtype for v in init)
+
+    def pinned(e):
+        return tuple(jnp.asarray(nv).astype(dt)
+                     for nv, dt in zip(run_body(e), dtypes))
+    return pinned
 
 
 def _recorded_while(ctx, block, carry_names, closure, init, cond_name,
@@ -679,7 +699,12 @@ def _recurrent(ctx):
         new_states = []
         for prev, name in zip(carry, state_names):
             new = e[name]
-            new_states.append(mt * new + (1 - mt) * prev)
+            # carry dtype stays the init's: under AMP the block's fc
+            # outputs promote to fp32 against bf16 boot states, which
+            # would otherwise trip scan's carry-type check — states are
+            # activations, so the bf16 round matches AMP semantics
+            new_states.append((mt * new + (1 - mt) * prev)
+                              .astype(prev.dtype))
         outs = tuple(e[n] * mt for n in out_names)
         return tuple(new_states), outs
 

@@ -462,6 +462,13 @@ def _lstm_scan(x, lens, w, bias, h0, c0, use_peepholes, is_reverse):
     jnp = _jnp()
     B, T, H4 = x.shape
     H = H4 // 4
+    # the carry must match the body's promoted dtype: under AMP x is
+    # bf16 while the weights stay fp32 masters, so the gate matmul
+    # promotes to fp32 — a bf16-initialized carry then trips scan's
+    # carry-type check at lowering time
+    cdt = jnp.result_type(x.dtype, w.dtype)
+    h0 = h0.astype(cdt)
+    c0 = c0.astype(cdt)
     b_gate = bias[..., :4 * H].reshape(1, 4 * H)
     if use_peepholes:
         w_ic = bias[..., 4 * H:5 * H].reshape(1, H)
@@ -538,6 +545,8 @@ def _gru_scan(x, lens, w, h0, is_reverse):
     jnp = _jnp()
     H = x.shape[2] // 3
     T = x.shape[1]
+    # same carry-dtype pinning as _lstm_scan: AMP keeps weights fp32
+    h0 = h0.astype(jnp.result_type(x.dtype, w.dtype))
     if is_reverse:
         x = _reverse_valid(x, lens)
     m = _mask(lens, T, x.dtype)
@@ -790,7 +799,10 @@ def _simple_rnn(ctx):
         h = jnp.where(valid, h, 0.0)
         return h, h
 
-    _, hs = jax.lax.scan(step, jnp.zeros((B, H), x.dtype),
+    # carry pinned to the body's promoted dtype (AMP: bf16 x, fp32 w)
+    _, hs = jax.lax.scan(step,
+                         jnp.zeros((B, H),
+                                   jnp.result_type(x.dtype, w.dtype)),
                          (jnp.swapaxes(xs, 0, 1), jnp.arange(T)))
     out = jnp.swapaxes(hs, 0, 1)
     if reverse:
@@ -958,6 +970,9 @@ def _lstmp(ctx):
         h0 = jnp.zeros((B, P), x.dtype)
     if c0 is None:
         c0 = jnp.zeros((B, D), x.dtype)
+    # carry pinned to the body's promoted dtype (AMP: bf16 x, fp32 w)
+    cdt = jnp.result_type(x.dtype, w.dtype)
+    h0, c0 = h0.astype(cdt), c0.astype(cdt)
     use_peepholes = ctx.attr("use_peepholes", True) and \
         bias.shape[-1] == 7 * D
     b_gate = bias[..., :4 * D].reshape(1, 4 * D)
@@ -1098,6 +1113,9 @@ def _attention_lstm(ctx):
         lens = jnp.full((B,), T, jnp.int32)
     if h0 is None:
         h0 = jnp.zeros((B, D), x.dtype)
+    # carry pinned to the body's promoted dtype (AMP: bf16 x, fp32 w)
+    cdt = jnp.result_type(x.dtype, lstm_w.dtype)
+    h0, c0 = h0.astype(cdt), c0.astype(cdt)
     valid = _mask(lens, T, x.dtype)           # [B, T]
     w_x, w_h = att_w[:M], att_w[M:]           # [M,1], [D,1]
     lw_x, lw_h = lstm_w[D:], lstm_w[:D]       # gates = [h; x] @ W

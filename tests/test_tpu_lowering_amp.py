@@ -1,0 +1,90 @@
+"""Off-chip TPU-lowering guards for AMP-mode recurrent programs.
+
+Under bf16 AMP the activations are bf16 while weights stay fp32
+masters, so an RNN scan body promotes to fp32 — a carry initialized at
+the activation dtype then trips lax.scan's carry-type check. This was
+invisible to the CPU suite (AMP only engages on TPU in the benchmarks)
+until the cross-platform jax.export sweep (tools/check_tpu_lowering.py)
+caught it on machine_translation. These are the fast in-suite guards.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import functionalizer
+
+
+def _export_for_tpu(main, startup, feed_specs, loss):
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        sn = tuple(functionalizer.persistable_names(main))
+        state = {n: scope.get(n) for n in sn if scope.get(n) is not None}
+    step_fn = functionalizer.build_step_fn(
+        main, tuple(sorted(feed_specs)), (loss.name,),
+        tuple(state.keys()))
+    return functionalizer.export_step_for_tpu(step_fn, state, feed_specs)
+
+
+@pytest.mark.parametrize("cell", ["lstm", "gru"])
+def test_amp_dynamic_rnn_lowers_for_tpu(cell):
+    fluid.set_amp(True)
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32",
+                                  lod_level=1)
+            fc = fluid.layers.fc(input=x, size=16 * (4 if cell == "lstm"
+                                                     else 3))
+            if cell == "lstm":
+                h, c = fluid.layers.dynamic_lstm(input=fc, size=16 * 4)
+            else:
+                h = fluid.layers.dynamic_gru(input=fc, size=16)
+            pool = fluid.layers.sequence_pool(h, pool_type="max")
+            loss = fluid.layers.mean(fluid.layers.fc(input=pool, size=1))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        # padded ragged feed: dense [B, T, 8] + @LOD_LEN companion
+        feed_specs = {
+            "x": ((4, 16, 8), np.float32),
+            "x" + functionalizer.LOD_LEN_SUFFIX: ((4,), np.int32),
+        }
+        exp = _export_for_tpu(main, startup, feed_specs, loss)
+        assert len(exp.mlir_module_serialized) > 0
+    finally:
+        fluid.set_amp(False)
+
+
+def test_amp_dynamic_rnn_block_lowers_for_tpu():
+    """DynamicRNN (the generic `recurrent` op): the user block's fc
+    promotes against bf16 boot states — the carry must stay stable."""
+    fluid.set_amp(True)
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32",
+                                  lod_level=1)
+            boot = fluid.layers.data("boot", shape=[16], dtype="float32")
+            rnn = fluid.layers.DynamicRNN()
+            with rnn.block():
+                step = rnn.step_input(x)
+                mem = rnn.memory(init=boot)
+                nxt = fluid.layers.fc(input=[step, mem], size=16,
+                                      act="tanh")
+                rnn.update_memory(mem, nxt)
+                rnn.output(nxt)
+            out = rnn()
+            pool = fluid.layers.sequence_pool(out, pool_type="last")
+            loss = fluid.layers.mean(fluid.layers.fc(input=pool, size=1))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        feed_specs = {
+            "x": ((4, 16, 8), np.float32),
+            "x" + functionalizer.LOD_LEN_SUFFIX: ((4,), np.int32),
+            "boot": ((4, 16), np.float32),
+        }
+        exp = _export_for_tpu(main, startup, feed_specs, loss)
+        assert len(exp.mlir_module_serialized) > 0
+    finally:
+        fluid.set_amp(False)

@@ -181,7 +181,6 @@ def test_remat_step_lowers_for_tpu_offchip(amp):
     BOTH precisions since bench runs bf16 AMP). The r03/r04 transport
     wedges during the remat compile were load failures, not lowering
     failures — this test pins that."""
-    from jax import export as jax_export
     fluid.set_amp(amp)
     try:
         main, startup, loss = _conv_model()
@@ -190,14 +189,10 @@ def test_remat_step_lowers_for_tpu_offchip(amp):
             main, ("img", "label"), (loss.name,), sn,
             remat_policy="conv_out")
         assert step_fn is not None
-        state_spec = {n: jax.ShapeDtypeStruct(np.shape(v),
-                                              np.asarray(v).dtype)
-                      for n, v in state.items()}
-        feeds_spec = {"img": jax.ShapeDtypeStruct((4, 8, 8, 3),
-                                                  np.float32),
-                      "label": jax.ShapeDtypeStruct((4, 1), np.int64)}
-        exp = jax_export.export(jax.jit(step_fn), platforms=["tpu"])(
-            state_spec, feeds_spec, jax.ShapeDtypeStruct((), np.uint32))
+        exp = functionalizer.export_step_for_tpu(
+            step_fn, state,
+            {"img": ((4, 8, 8, 3), np.float32),
+             "label": ((4, 1), np.int64)})
         assert len(exp.mlir_module_serialized) > 0
     finally:
         fluid.set_amp(False)

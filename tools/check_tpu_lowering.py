@@ -1,0 +1,112 @@
+"""Off-chip TPU-lowering sweep for the benchmark zoo.
+
+Pallas->Mosaic conversion and XLA lowering happen at jax.export time,
+so every zoo config's training step can be validated for the TPU
+platform from a CPU-only host — no transport window gets burned
+discovering a lowering bug mid-sweep. Prints one JSON line per config:
+
+  {"config": ..., "ok": true, "mlir_bytes": N}
+  {"config": ..., "ok": false, "error": ..., "note": ...}
+
+Run after kernel/model/functionalizer changes; the per-kernel fast
+guards live in the suite (tests/test_fused_bottleneck.py,
+test_whole_graph_ad.py) — this sweep is the full-model version.
+"""
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# (name, model, kwargs, batch, amp, remat)
+CONFIGS = [
+    ("mnist_cnn", "mnist", {}, 16, True, None),
+    ("resnet50_nhwc", "resnet", {"dataset": "imagenet",
+                                 "layout": "NHWC"}, 8, True, None),
+    ("resnet50_nhwc_remat", "resnet", {"dataset": "imagenet",
+                                       "layout": "NHWC"}, 8, True,
+     "conv_out"),
+    ("se_resnext_nhwc", "se_resnext", {"layout": "NHWC"}, 4, True, None),
+    ("vgg16_cifar10", "vgg", {"dataset": "cifar10"}, 8, True, None),
+    ("vgg16_cifar10_remat", "vgg", {"dataset": "cifar10"}, 8, True,
+     "conv_out"),
+    ("stacked_dynamic_lstm", "stacked_dynamic_lstm", {}, 8, True, None),
+    ("transformer", "transformer", {}, 4, True, None),
+    ("machine_translation", "machine_translation", {}, 4, True, None),
+]
+
+
+def check(name, model, kwargs, batch, amp, remat):
+    import importlib
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import functionalizer
+    from paddle_tpu.fluid.executor import prepare_feeds
+    from fluid_benchmark import synth_feed
+
+    fluid.set_amp(amp)
+    with fluid.unique_name.guard():
+        mod = importlib.import_module("paddle_tpu.models.%s" % model)
+        main_prog, startup, feeds, loss, acc, _ = mod.get_model(
+            batch_size=batch, **kwargs)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        feeds = [main_prog.global_block().var(f)
+                 if isinstance(f, str) else f for f in feeds]
+        rng = np.random.RandomState(0)
+        feed = synth_feed(feeds, batch, rng, program=main_prog)
+        dense = prepare_feeds(main_prog, feed, device_put=False)
+        sn = tuple(functionalizer.persistable_names(main_prog))
+        state = {n: scope.get(n) for n in sn
+                 if scope.get(n) is not None}
+    feed_key = tuple(sorted(dense.keys()))
+    step_fn = functionalizer.build_step_fn(
+        main_prog, feed_key, (loss.name,), tuple(state.keys()),
+        whole_graph_ad=bool(remat), remat_policy=remat)
+    feed_specs = {n: (np.shape(v), np.asarray(v).dtype)
+                  for n, v in dense.items()}
+    exp = functionalizer.export_step_for_tpu(step_fn, state, feed_specs)
+    return len(exp.mlir_module_serialized)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default="",
+                    help="comma-separated config-name substring filter")
+    args = ap.parse_args()
+    # pin CPU BEFORE any backend query: on a transport-attached host the
+    # first jax op would otherwise initialize the TPU runtime this
+    # sweep exists to avoid touching (same guard as fluid_benchmark)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    wanted = [w for w in args.only.split(",") if w]
+    failures = 0
+    for name, model, kwargs, batch, amp, remat in CONFIGS:
+        if wanted and not any(w in name for w in wanted):
+            continue
+        try:
+            n = check(name, model, kwargs, batch, amp, remat)
+            print(json.dumps({"config": name, "ok": True,
+                              "mlir_bytes": n}), flush=True)
+        except Exception as e:
+            failures += 1
+            print(json.dumps({
+                "config": name, "ok": False,
+                "error": type(e).__name__,
+                "note": (str(e).splitlines() or [""])[0][:300]}),
+                flush=True)
+            traceback.print_exc(file=sys.stderr)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

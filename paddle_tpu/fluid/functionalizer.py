@@ -381,8 +381,10 @@ def export_step_for_tpu(step_fn, state, feed_specs):
                   else jax.ShapeDtypeStruct(_np.shape(v),
                                             _np.asarray(v).dtype)
                   for n, v in state.items()}
-    feeds_spec = {n: jax.ShapeDtypeStruct(tuple(s), _np.dtype(d))
-                  for n, (s, d) in feed_specs.items()}
+    feeds_spec = {n: v if isinstance(v, jax.ShapeDtypeStruct)
+                  else jax.ShapeDtypeStruct(tuple(v[0]),
+                                            _np.dtype(v[1]))
+                  for n, v in feed_specs.items()}
     return jax_export.export(jax.jit(step_fn), platforms=["tpu"])(
         state_spec, feeds_spec, jax.ShapeDtypeStruct((), _np.uint32))
 

@@ -11,10 +11,11 @@ Prints one JSON line per (seq_len, variant):
   {"metric": "attention_fwd_bwd_ms", "seq_len": S, "variant":
    "flash"|"xla", "value": ms, "tflops": ...}
 
-Run manually when the chip is stable (not part of the tpu_watch sweep —
-every extra compile there risks wedging the transport before the
-riskier remat stage). CPU smoke: --smoke runs tiny shapes in interpret
-mode so the harness itself is always testable.
+Runs as a best-effort EXTRA at the end of the tpu_watch sweep — after
+every primary stage (flagship/zoo/infer/remat) has completed and been
+flushed, so a wedge here cannot cost recorded numbers. Also runnable
+manually. CPU smoke: --smoke runs tiny shapes in interpret mode so the
+harness itself is always testable.
 """
 
 import argparse

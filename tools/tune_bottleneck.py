@@ -43,10 +43,15 @@ def main():
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--require_tpu", action="store_true",
+                    help="exit 3 instead of falling back to CPU — "
+                         "interpret-mode timings must never be mistaken "
+                         "for chip tuner results")
     args = ap.parse_args()
 
     from bench import init_backend
     on_tpu, backend_label = init_backend(smoke=args.smoke,
+                                         require_tpu=args.require_tpu,
                                          tool="tune_bottleneck")
     import jax
     import jax.numpy as jnp

@@ -78,6 +78,53 @@ def check(name, model, kwargs, batch, amp, remat):
     return len(exp.mlir_module_serialized)
 
 
+def check_spmd_dp16():
+    """BASELINE config 5 (v5e-16 pod): the ResNet-50 NHWC bf16 training
+    step sharded dp=16 over an ABSTRACT 16-TPU-device mesh — the
+    north-star topology's lowering, validated with zero chips (the
+    partitioner consumes the sdy.sharding annotations at target-compile
+    time; SCALING_r04.md has the compiled-HLO collective census)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import functionalizer
+    from paddle_tpu.models import resnet
+
+    fluid.set_amp(True)
+    with fluid.unique_name.guard():
+        main_prog, startup, feeds, loss, acc, _ = resnet.get_model(
+            batch_size=64, class_dim=1000, depth=50, dataset="imagenet",
+            is_train=True, layout="NHWC")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        sn = tuple(functionalizer.persistable_names(main_prog))
+        state = {n: scope.get(n) for n in sn if scope.get(n) is not None}
+    # trace against the virtual CPU mesh; export against the abstract
+    # TPU one (build_step_fn only reads axis names from the mesh)
+    n_cpu = len(jax.devices())
+    cpu_mesh = Mesh(np.array(jax.devices()).reshape(n_cpu), ("data",))
+    step_fn = functionalizer.build_step_fn(
+        main_prog, ("data", "label"), (loss.name,), tuple(state.keys()),
+        mesh=cpu_mesh)
+    amesh = jax.sharding.AbstractMesh((16,), ("data",))
+    state_specs = {n: jax.ShapeDtypeStruct(
+        np.shape(v), np.asarray(v).dtype,
+        sharding=NamedSharding(amesh, P())) for n, v in state.items()}
+    feed_specs = {
+        "data": jax.ShapeDtypeStruct((64, 224, 224, 3), np.float32,
+                                     sharding=NamedSharding(
+                                         amesh, P("data"))),
+        "label": jax.ShapeDtypeStruct((64, 1), np.int32,
+                                      sharding=NamedSharding(
+                                          amesh, P("data"))),
+    }
+    exp = functionalizer.export_step_for_tpu(step_fn, state_specs,
+                                             feed_specs)
+    assert exp.nr_devices == 16, exp.nr_devices
+    return len(exp.mlir_module_serialized)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", default="",
@@ -90,11 +137,11 @@ def main():
     jax.config.update("jax_platforms", "cpu")
     wanted = [w for w in args.only.split(",") if w]
     failures = 0
-    for name, model, kwargs, batch, amp, remat in CONFIGS:
-        if wanted and not any(w in name for w in wanted):
-            continue
+
+    def run_one(name, fn):
+        nonlocal failures
         try:
-            n = check(name, model, kwargs, batch, amp, remat)
+            n = fn()
             print(json.dumps({"config": name, "ok": True,
                               "mlir_bytes": n}), flush=True)
         except Exception as e:
@@ -105,6 +152,14 @@ def main():
                 "note": (str(e).splitlines() or [""])[0][:300]}),
                 flush=True)
             traceback.print_exc(file=sys.stderr)
+
+    for name, model, kwargs, batch, amp, remat in CONFIGS:
+        if wanted and not any(w in name for w in wanted):
+            continue
+        run_one(name, lambda: check(name, model, kwargs, batch, amp,
+                                    remat))
+    if not wanted or any(w in "resnet50_dp16_pod" for w in wanted):
+        run_one("resnet50_dp16_pod", check_spmd_dp16)
     sys.exit(1 if failures else 0)
 
 

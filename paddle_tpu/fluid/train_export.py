@@ -26,13 +26,15 @@ __all__ = ["save_aot_trainer", "load_aot_trainer", "AotTrainer"]
 
 
 def save_aot_trainer(dirname, program, feed_names, fetch_names,
-                     scope=None, batch_size=None):
+                     scope=None, batch_size=None, platforms=None):
     """Export `program`'s training step for batch size `batch_size`
     (default: the feed vars' static batch dim; -1 dims require an
     explicit batch_size).
 
     `fetch_names` are the per-step fetches (losses/metrics); the full
-    persistable state is threaded and saved automatically."""
+    persistable state is threaded and saved automatically. `platforms`
+    (e.g. ("cpu", "tpu")) embeds lowerings for several targets in one
+    artifact — export on a CPU build host, train on TPU."""
     import jax
     from jax import export as jax_export
     from . import functionalizer
@@ -83,8 +85,10 @@ def save_aot_trainer(dirname, program, feed_names, fetch_names,
     feeds_spec = {n: jax.ShapeDtypeStruct(s, np.dtype(dt))
                   for n, (s, dt) in feed_specs.items()}
     step_spec = jax.ShapeDtypeStruct((), np.uint32)
-    exp = jax_export.export(jax.jit(step_fn))(state_spec, feeds_spec,
-                                              step_spec)
+    exp = jax_export.export(
+        jax.jit(step_fn),
+        platforms=list(platforms) if platforms else None)(
+        state_spec, feeds_spec, step_spec)
     with open(os.path.join(dirname, "train_step.bin"), "wb") as f:
         f.write(exp.serialize())
     with open(os.path.join(dirname, "train_state.bin"), "wb") as f:

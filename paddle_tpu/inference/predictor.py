@@ -201,9 +201,14 @@ class Predictor:
     # consume the same artifact.
     # ------------------------------------------------------------------
 
-    def save_aot(self, dirname, batch_sizes=(1,)):
+    def save_aot(self, dirname, batch_sizes=(1,), platforms=None):
         """Export the inference computation for the given batch sizes so
-        a new process can serve without rebuilding or retracing."""
+        a new process can serve without rebuilding or retracing.
+
+        `platforms` (e.g. ("cpu", "tpu")) embeds lowerings for several
+        targets in ONE artifact — export on a CPU build host, serve on
+        a TPU pod (jax.export multi-platform modules). Default: the
+        current platform only."""
         import os
         import jax
         import jax.numpy as jnp
@@ -246,7 +251,10 @@ class Predictor:
                 s = [bs if d == -1 else d for d in shape]
                 feeds_spec[name] = jax.ShapeDtypeStruct(
                     tuple(s), np.dtype(dt))
-            exp = jax_export.export(jax.jit(fwd))(state_spec, feeds_spec)
+            exp = jax_export.export(
+                jax.jit(fwd),
+                platforms=list(platforms) if platforms else None)(
+                state_spec, feeds_spec)
             fname = "aot_b%d.bin" % bs
             with open(os.path.join(dirname, fname), "wb") as f:
                 f.write(exp.serialize())

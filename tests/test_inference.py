@@ -241,3 +241,33 @@ def test_aot_export_rejects_non_batch_dynamic_dims(tmp_path):
         p = create_paddle_predictor(NativeConfig(model_dir=md))
         with pytest.raises(ValueError, match="non-batch dynamic"):
             p.save_aot(str(tmp_path / "aot"), batch_sizes=(4,))
+
+
+def test_multi_platform_aot_predictor(tmp_path):
+    from jax import export as jax_export
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[4], dtype="float32")
+        pred = fluid.layers.fc(input=img, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = str(tmp_path / "m")
+        fluid.save_inference_model(md, ["img"], [pred], exe,
+                                   main_program=main)
+        p = create_paddle_predictor(NativeConfig(model_dir=md))
+        aot = str(tmp_path / "aot")
+        p.save_aot(aot, batch_sizes=(4,), platforms=("cpu", "tpu"))
+        x = rng.randn(4, 4).astype(np.float32)
+        ref, = p.run({"img": x})
+    with open(os.path.join(aot, "aot_b4.bin"), "rb") as f:
+        exp = jax_export.deserialize(f.read())
+    assert set(pl.lower() for pl in exp.platforms) == {"cpu", "tpu"}
+    from paddle_tpu.inference import load_aot_predictor
+    got, = load_aot_predictor(aot).run({"img": x})
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-6)

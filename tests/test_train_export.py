@@ -191,3 +191,28 @@ def test_c_trainer_matches_python(train_demo_bin, tmp_path):
     assert sorted(got) == list(range(steps))
     np.testing.assert_allclose(ref, [got[s] for s in range(steps)],
                                rtol=1e-4, atol=1e-6)
+
+
+def test_multi_platform_artifact_serves_on_cpu(tmp_path):
+    """platforms=("cpu","tpu") embeds both lowerings in ONE artifact:
+    exported on this CPU host it must still train here, and the stored
+    module must declare both platforms (so a TPU host accepts it)."""
+    from jax import export as jax_export
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feeds = _feeds(2)
+    art = str(tmp_path / "art")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        save_aot_trainer(art, main, ["x", "y"], [loss], scope=scope,
+                         batch_size=4, platforms=("cpu", "tpu"))
+        ref = [float(np.asarray(exe.run(main, feed=f,
+                                        fetch_list=[loss])[0]).ravel()[0])
+               for f in feeds]
+    with open(os.path.join(art, "train_step.bin"), "rb") as f:
+        exp = jax_export.deserialize(f.read())
+    assert set(p.lower() for p in exp.platforms) == {"cpu", "tpu"}
+    t = load_aot_trainer(art)
+    got = [float(t.step(f)[0].ravel()[0]) for f in feeds]
+    np.testing.assert_allclose(ref, got, rtol=1e-5)

@@ -153,13 +153,13 @@ def main():
                 flush=True)
             traceback.print_exc(file=sys.stderr)
 
-    for name, model, kwargs, batch, amp, remat in CONFIGS:
+    entries = [(cfg[0], (lambda c=cfg: check(*c)))
+               for cfg in CONFIGS]
+    entries.append(("resnet50_dp16_pod", check_spmd_dp16))
+    for name, thunk in entries:
         if wanted and not any(w in name for w in wanted):
             continue
-        run_one(name, lambda: check(name, model, kwargs, batch, amp,
-                                    remat))
-    if not wanted or any(w in "resnet50_dp16_pod" for w in wanted):
-        run_one("resnet50_dp16_pod", check_spmd_dp16)
+        run_one(name, thunk)
     sys.exit(1 if failures else 0)
 
 

@@ -33,8 +33,9 @@ def save_aot_trainer(dirname, program, feed_names, fetch_names,
 
     `fetch_names` are the per-step fetches (losses/metrics); the full
     persistable state is threaded and saved automatically. `platforms`
-    (e.g. ("cpu", "tpu")) embeds lowerings for several targets in one
-    artifact — export on a CPU build host, train on TPU."""
+    selects the target(s): ("tpu",) cross-compiles from a CPU build
+    host; ("cpu", "tpu") embeds both lowerings in one artifact (for
+    Pallas-free programs — see Predictor.save_aot)."""
     import jax
     from jax import export as jax_export
     from . import functionalizer
@@ -84,6 +85,9 @@ def save_aot_trainer(dirname, program, feed_names, fetch_names,
                   for n, v in state.items()}
     feeds_spec = {n: jax.ShapeDtypeStruct(s, np.dtype(dt))
                   for n, (s, dt) in feed_specs.items()}
+    if isinstance(platforms, str):
+        # list("tpu") would become ['t','p','u'] and fail far away
+        platforms = (platforms,)
     step_spec = jax.ShapeDtypeStruct((), np.uint32)
     exp = jax_export.export(
         jax.jit(step_fn),

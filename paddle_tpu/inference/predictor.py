@@ -205,10 +205,13 @@ class Predictor:
         """Export the inference computation for the given batch sizes so
         a new process can serve without rebuilding or retracing.
 
-        `platforms` (e.g. ("cpu", "tpu")) embeds lowerings for several
-        targets in ONE artifact — export on a CPU build host, serve on
-        a TPU pod (jax.export multi-platform modules). Default: the
-        current platform only."""
+        `platforms` selects the artifact's target(s): ("tpu",) CROSS-
+        COMPILES from a CPU build host with the real Mosaic kernels
+        embedded; ("cpu", "tpu") embeds both lowerings in one artifact
+        but only for Pallas-free programs (jax lowers every
+        platform_dependent branch on every platform when the platform
+        index is dynamic, and Pallas has no non-interpret CPU
+        lowering). Default: the current platform only."""
         import os
         import jax
         import jax.numpy as jnp
@@ -217,6 +220,9 @@ class Predictor:
         from paddle_tpu.native import wire
 
         os.makedirs(dirname, exist_ok=True)
+        if isinstance(platforms, str):
+            # list("tpu") would become ['t','p','u'] and fail far away
+            platforms = (platforms,)
         gb = self._program.global_block()
         feed_specs = {}
         for name in self._feed_names:

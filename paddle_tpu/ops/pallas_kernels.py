@@ -21,6 +21,20 @@ __all__ = ["flash_attention", "fused_bottleneck", "bottleneck_reference"]
 _NEG_INF = -1e30
 
 
+def _interpret_dispatch(call, interpret, *ops):
+    """Kernel-vs-interpret dispatch shared by every Pallas entry point:
+    an explicit `interpret` wins; None defers to LOWERING-time platform
+    selection so cross-/multi-platform exports embed the real Mosaic
+    kernel for tpu and interpret emulation elsewhere."""
+    import jax
+    if interpret is not None:
+        return call(interpret, *ops)
+    return jax.lax.platform_dependent(
+        *ops,
+        tpu=functools.partial(call, False),
+        default=functools.partial(call, True))
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
             block_k):
     """One (batch*head, q-block) program: fori_loop over K/V blocks with
@@ -88,20 +102,25 @@ def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k,
     grid = (BH, nq)
     kern = functools.partial(_kernel, scale=scale, causal=causal,
                              block_q=block_q, block_k=block_k)
-    return pl.pallas_call(
-        kern,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
-        interpret=interpret,
-    )(q, k, v)
+
+    def call(interp, *ops):
+        return pl.pallas_call(
+            kern,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D),
+                                   lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interp,
+        )(*ops)
+
+    return _interpret_dispatch(call, interpret, q, k, v)
 
 
 def _softmax_stats(q, k, scale, causal, block_k):
@@ -187,8 +206,9 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     if S % bq or S % bk:
         from ..parallel.ring_attention import local_attention
         return local_attention(q, k, v, causal=causal, scale=scale)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    # interpret=None defers the interpret-vs-Mosaic choice to LOWERING
+    # time (_flash_fwd_pallas platform_dependent), so cross-platform
+    # exports embed the real kernel for tpu
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
@@ -439,8 +459,6 @@ def fused_bottleneck(x, w0, b0, w1, b1, w2, b2, ws=None, bs=None,
                     has_branch) <= _VMEM_CAP)
     if not tileable:
         return bottleneck_reference(x, w0, b0, w1, b1, w2, b2, ws, bs, s)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
 
     xp = jnp.pad(x, ((0, 0), (1, 1), (0, 0), (0, 0)))
     w1f = w1.reshape(9, F, F)
@@ -453,17 +471,23 @@ def fused_bottleneck(x, w0, b0, w1, b1, w2, b2, ws=None, bs=None,
     args = (w0, b0.reshape(1, F), w1f, b1.reshape(1, F), w2,
             b2.reshape(1, C4), wsx,
             bsx.reshape(1, -1))
-    return pl.pallas_call(
-        kern,
-        grid=(N, Ho // bh),
-        in_specs=[pl.BlockSpec((1, H + 2, W, C), lambda b, i: (b, 0, 0, 0))]
-        + [full(a) for a in args],
-        out_specs=pl.BlockSpec((1, bh, Wo, C4), lambda b, i: (b, i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((N, Ho, Wo, C4), x.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
-        interpret=interpret,
-    )(xp, *args)
+
+    def call(interp, *ops):
+        return pl.pallas_call(
+            kern,
+            grid=(N, Ho // bh),
+            in_specs=[pl.BlockSpec((1, H + 2, W, C),
+                                   lambda b, i: (b, 0, 0, 0))]
+            + [full(a) for a in args],
+            out_specs=pl.BlockSpec((1, bh, Wo, C4),
+                                   lambda b, i: (b, i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((N, Ho, Wo, C4), x.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interp,
+        )(*ops)
+
+    return _interpret_dispatch(call, interpret, xp, *args)
 
 
 def _oihw_to_mat(w):

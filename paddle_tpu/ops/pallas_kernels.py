@@ -242,6 +242,19 @@ def _flash_attention_op(ctx):
     q = ctx.input("Q")
     k = ctx.input("K")
     v = ctx.input("V")
+    if ctx.mesh is not None and ctx.mesh.size > 1:
+        # Mosaic kernels cannot be auto-partitioned by the SPMD
+        # partitioner; under a multi-device mesh the program uses the
+        # plain-XLA composition (partitionable, numerically equivalent)
+        # — sharded long-context attention is served by the dedicated
+        # ring/Ulysses paths (parallel/ring_attention.py), not by
+        # auto-sharding this kernel
+        from ..parallel.ring_attention import local_attention
+        return _attention_via(ctx, q, k, v, local_attention)
+    return _attention_via(ctx, q, k, v, None)
+
+
+def _attention_via(ctx, q, k, v, attn_fn):
     reshaped = False
     if q.ndim == 3:           # [B, S, D] with num_heads attr
         H = int(ctx.attr("num_heads", 1))
@@ -254,7 +267,11 @@ def _flash_attention_op(ctx):
         k = k.reshape(B, S, H, Dm // H)
         v = v.reshape(B, S, H, Dm // H)
         reshaped = True
-    out = flash_attention(q, k, v, causal=bool(ctx.attr("causal", False)))
+    causal = bool(ctx.attr("causal", False))
+    if attn_fn is not None:
+        out = attn_fn(q, k, v, causal=causal)
+    else:
+        out = flash_attention(q, k, v, causal=causal)
     if reshaped:
         out = out.reshape(B, S, Dm)
     return {"Out": out}

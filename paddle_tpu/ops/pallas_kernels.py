@@ -242,16 +242,20 @@ def _flash_attention_op(ctx):
     q = ctx.input("Q")
     k = ctx.input("K")
     v = ctx.input("V")
-    if ctx.mesh is not None and ctx.mesh.size > 1:
+    if ctx.mesh is not None:
         # Mosaic kernels cannot be auto-partitioned by the SPMD
-        # partitioner; under a multi-device mesh the program uses the
-        # plain-XLA composition (partitionable, numerically equivalent)
-        # — sharded long-context attention is served by the dedicated
-        # ring/Ulysses paths (parallel/ring_attention.py), not by
-        # auto-sharding this kernel
+        # partitioner; ANY mesh-built program uses the plain-XLA
+        # composition (partitionable, numerically equivalent). The
+        # TRACE mesh's device count is deliberately not consulted —
+        # programs are traced on small virtual meshes and exported
+        # against bigger abstract ones, so mesh-present is the only
+        # reliable "will be partitioned" signal. Sharded long-context
+        # attention is served by the dedicated ring/Ulysses paths
+        # (parallel/ring_attention.py), not by auto-sharding this
+        # kernel; the mesh-free (single-device) path keeps Mosaic.
         from ..parallel.ring_attention import local_attention
         return _attention_via(ctx, q, k, v, local_attention)
-    return _attention_via(ctx, q, k, v, None)
+    return _attention_via(ctx, q, k, v, flash_attention)
 
 
 def _attention_via(ctx, q, k, v, attn_fn):
@@ -267,11 +271,7 @@ def _attention_via(ctx, q, k, v, attn_fn):
         k = k.reshape(B, S, H, Dm // H)
         v = v.reshape(B, S, H, Dm // H)
         reshaped = True
-    causal = bool(ctx.attr("causal", False))
-    if attn_fn is not None:
-        out = attn_fn(q, k, v, causal=causal)
-    else:
-        out = flash_attention(q, k, v, causal=causal)
+    out = attn_fn(q, k, v, causal=bool(ctx.attr("causal", False)))
     if reshaped:
         out = out.reshape(B, S, Dm)
     return {"Out": out}

@@ -125,6 +125,52 @@ def check_spmd_dp16():
     return len(exp.mlir_module_serialized)
 
 
+def check_fused_serving():
+    """The fusion-transpiled ResNet-50 NHWC serving graph: all 16
+    bottlenecks collapsed onto the Pallas kernel, exported for TPU —
+    the module must carry the Mosaic custom calls (the kernel-geometry
+    guards live in tests/test_fused_bottleneck.py; this is the
+    full-model version)."""
+    import jax
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import functionalizer
+    from paddle_tpu.models.resnet import resnet_imagenet
+
+    # AMP is process-global and the preceding training checks enable
+    # it — pin explicitly so --only runs and full sweeps trace the
+    # SAME module (serving precision is the artifact's own, fp32 here;
+    # bf16 serving casts are bench_infer's explicit job)
+    fluid.set_amp(False)
+    with fluid.unique_name.guard():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        main_prog.random_seed = startup.random_seed = 17
+        with fluid.program_guard(main_prog, startup):
+            img = fluid.layers.data(name="data", shape=[224, 224, 3],
+                                    dtype="float32")
+            pred = resnet_imagenet(img, class_dim=1000, depth=50,
+                                   is_train=False, layout="NHWC")
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        infer = main_prog.clone(for_test=True)._prune(["data"],
+                                                      [pred.name])
+        from paddle_tpu.fluid.transpiler import InferenceTranspiler
+        InferenceTranspiler().transpile(infer, scope=scope)
+        n_fused = sum(1 for op in infer.global_block().ops
+                      if op.type == "fused_bottleneck")
+        assert n_fused == 16, n_fused
+        sn = tuple(functionalizer.persistable_names(infer))
+        state = {n: scope.get(n) for n in sn
+                 if scope.get(n) is not None}
+    step_fn = functionalizer.build_step_fn(
+        infer, ("data",), (pred.name,), tuple(state.keys()))
+    exp = functionalizer.export_step_for_tpu(
+        step_fn, state, {"data": ((8, 224, 224, 3), np.float32)})
+    n_calls = exp.mlir_module().count("tpu_custom_call")
+    assert n_calls >= 1, "no Mosaic kernel in the serving module"
+    return len(exp.mlir_module_serialized)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", default="",
@@ -156,6 +202,7 @@ def main():
     entries = [(cfg[0], (lambda c=cfg: check(*c)))
                for cfg in CONFIGS]
     entries.append(("resnet50_dp16_pod", check_spmd_dp16))
+    entries.append(("resnet50_infer_fused", check_fused_serving))
     for name, thunk in entries:
         if wanted and not any(w in name for w in wanted):
             continue

@@ -55,12 +55,36 @@ def run_logged(cmd, env_extra, log, timeout):
         return False, ""
 
 
+_LOCK_FH = None    # must outlive main(): the flock dies with the process
+
+
+def _claim_singleton(lockfile):
+    """Refuse to run two watchers: concurrent sweeps on recovery put
+    two heavy compile streams on the relay at once — the suspected
+    wedge trigger (a stale watcher from a previous session survived
+    into round 4's third session exactly this way). An exclusive flock
+    held for the process lifetime is atomic, immune to PID reuse, and
+    vanishes with the process — no stale state to clean up."""
+    import fcntl
+    global _LOCK_FH
+    _LOCK_FH = open(lockfile, "w")
+    try:
+        fcntl.flock(_LOCK_FH, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        print("tpu_watch already running (lock held on %s); exiting"
+              % lockfile, file=sys.stderr)
+        sys.exit(1)
+    _LOCK_FH.write(str(os.getpid()))
+    _LOCK_FH.flush()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=int, default=600)
     ap.add_argument("--once", action="store_true")
     ap.add_argument("--log", default=os.path.join(REPO, "tpu_watch.log"))
     args = ap.parse_args()
+    _claim_singleton(os.path.join(REPO, ".tpu_watch.lock"))
 
     results = []
     remat_failures = 0

@@ -47,6 +47,13 @@ def _send_msg(sock, obj):
     no pickle anywhere on the socket path (the reference's typed
     VariableMessage serde, grpc_serde.cc, not arbitrary object streams)."""
     payload = _wire_encode(obj)
+    if len(payload) > _MAX_FRAME:
+        # the peer's receive loop enforces the same cap; failing here
+        # names the fix instead of leaving the peer to drop the socket
+        raise WireError(
+            "outgoing frame is %d bytes, above the %d-byte cap; export "
+            "PADDLE_TPU_MAX_RPC_FRAME on both ends to raise it"
+            % (len(payload), _MAX_FRAME))
     sock.sendall(_HDR.pack(len(payload)) + payload)
 
 
@@ -162,10 +169,20 @@ class VariableServer:
                             _send_msg(self.request, {"ok": True})
                             break
                         if reply is not None:
-                            _send_msg(self.request, reply)
+                            try:
+                                _send_msg(self.request, reply)
+                            except WireError as e:
+                                # outgoing frame over the cap (e.g. a Get
+                                # of a pserver-initialized jumbo var): the
+                                # stream is still in sync, so surface the
+                                # actionable PADDLE_TPU_MAX_RPC_FRAME
+                                # message to the client instead of
+                                # silently dropping the connection
+                                _send_msg(self.request,
+                                          {"error": str(e)})
                 except WireError:
-                    # malformed frame: the stream is desynced — drop the
-                    # connection (never crash the server)
+                    # malformed INCOMING frame: the stream is desynced —
+                    # drop the connection (never crash the server)
                     pass
                 except (ConnectionError, EOFError):
                     pass

@@ -453,6 +453,11 @@ class FuseBottleneckPass(Pass):
         """conv2d op is a plain kxk NHWC conv with the given geometry."""
         if op.attrs.get("data_format", "NCHW") != "NHWC":
             return None
+        if op.inputs.get("Bias"):
+            # the fused kernel has no slot for an inline conv bias (the
+            # B0/B1/B2 inputs come from the BN-fold elementwise_adds);
+            # rewriting would silently drop it and change numerics
+            return None
         if int(op.attrs.get("groups", 1) or 1) != 1:
             return None
         if self._norm2(op.attrs.get("dilations"), 1) != (1, 1):

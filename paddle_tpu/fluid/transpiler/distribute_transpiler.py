@@ -242,6 +242,27 @@ class DistributeTranspiler:
         self.grad_blocks = [b for bs in grad_blocks for b in bs]
         self.param_blocks = [b for bs in param_blocks for b in bs]
 
+        # endpoint placement is whole-var granularity, so every param/grad
+        # crosses the wire as ONE frame; a var bigger than the RPC frame
+        # cap would fail deep in the socket layer at step time — fail here
+        # instead, naming the variable and the env var that raises the cap
+        from ...distributed.rpc import _MAX_FRAME
+        for var in params + grads:
+            if var is None or var.shape is None:
+                continue
+            numel = 1
+            for d in var.shape:
+                numel *= max(int(d), 1)
+            frame = numel * var.np_dtype.itemsize + 1024  # wire header
+            if frame > _MAX_FRAME:
+                raise ValueError(
+                    "variable %r needs a ~%d-byte wire frame, above the "
+                    "RPC frame cap of %d; export "
+                    "PADDLE_TPU_MAX_RPC_FRAME=%d (in every trainer AND "
+                    "pserver process) to send it unsliced"
+                    % (var.name, frame, _MAX_FRAME,
+                       1 << frame.bit_length()))
+
         self._ep_by_param = {}
         eplist = dispatcher.dispatch(
             [bs[0] for bs in param_blocks])  # one ep per var (first block)

@@ -104,18 +104,30 @@ class InferenceTranspiler:
         var = scope.get(bn_op.input("Variance")[0])
         if any(v is None for v in (w, scale, bias, mean, var)):
             return None
+        conv_bias = None
+        if conv_op.inputs.get("Bias"):
+            # BN(conv + b) = inv_std*conv + (beta + (b - mean)*inv_std):
+            # the inline bias folds into the new per-channel add and the
+            # conv's Bias input is dropped. Without its value the fold
+            # would change numerics — decline instead.
+            conv_bias = scope.get(conv_op.input("Bias")[0])
+            if conv_bias is None:
+                return None
         import jax.numpy as jnp
         eps = float(bn_op.attrs.get("epsilon", 1e-5))
         w = jnp.asarray(w)
         inv_std = jnp.asarray(scale) / jnp.sqrt(jnp.asarray(var) + eps)
         # conv filter layout OIHW: fold per output channel O
         scope.set(w_name, w * inv_std.reshape(-1, 1, 1, 1))
-        new_bias = jnp.asarray(bias) - jnp.asarray(mean) * inv_std
+        shift = jnp.asarray(mean) if conv_bias is None else \
+            jnp.asarray(mean) - jnp.asarray(conv_bias).reshape(-1)
+        new_bias = jnp.asarray(bias) - shift * inv_std
+        if conv_bias is not None:
+            conv_op.inputs.pop("Bias", None)   # absorbed into new_bias
         bias_name = w_name + "@bn_folded_bias"
-        bias_var = block.create_var(
+        block.create_var(
             name=bias_name, shape=tuple(new_bias.shape), dtype="float32",
             persistable=True)
-        bias_var.persistable = True
         scope.set(bias_name, new_bias)
         # BN becomes a per-channel bias add on the conv's raw output;
         # the broadcast axis follows the conv's activation layout (the

@@ -396,15 +396,23 @@ def _pick_block_h(Ho):
 def _bottleneck_vmem_bytes(H, W, C, F, C4, stride, block_h, dtype_bytes,
                            has_branch=True):
     """Rough VMEM budget for one program: the padded input image, the
-    fp32 conv0 window, and all weight operands (the identity case passes
-    w0 aliased in the ws slot, so its footprint is C*F, not C*C4)."""
+    fp32 conv0 window, all weight operands (the identity case passes
+    w0 aliased in the ws slot, so its footprint is C*F, not C*C4), and
+    the fp32 accumulator/shortcut/output tiles of the epilogue — a
+    geometry that passes the gate without those could clear the estimate
+    yet fail Mosaic VMEM allocation on chip instead of taking the XLA
+    fallback."""
     ext = stride * block_h + 2
     ws_elems = C * C4 if has_branch else C * F
+    Wo = W // stride
     return ((H + 2) * W * C * dtype_bytes            # x image block
             + ext * W * F * 4                        # a1 window (fp32)
             + ext * (W + 2) * F * dtype_bytes        # a1p
             + C * F * dtype_bytes + 9 * F * F * dtype_bytes
-            + F * C4 * dtype_bytes + ws_elems * dtype_bytes)
+            + F * C4 * dtype_bytes + ws_elems * dtype_bytes
+            + block_h * Wo * F * 4                   # conv1 acc (fp32)
+            + block_h * Wo * C4 * 4 * 2              # y + shortcut (fp32)
+            + block_h * Wo * C4 * dtype_bytes)       # output block
 
 
 def bottleneck_reference(x, w0, b0, w1, b1, w2, b2, ws, bs, stride):

@@ -2,9 +2,26 @@
 python/paddle/fluid/tests/book/test_label_semantic_roles.py and
 test_rnn_encoder_decoder.py): SRL with embeddings + LSTM + CRF over the
 conll05 reader, and a seq2seq encoder-decoder over wmt16 — both train
-end-to-end (loss decreases) through the ragged-LoD pipeline."""
+end-to-end through the ragged-LoD pipeline TO A THRESHOLD.
+
+The reference book tests train on real data until an accuracy/cost gate
+(test_recognize_digits.py stops at avg_cost < 100 / acc > 0.01). The
+datasets here are synthetic (no egress), so the analogous contract is
+overfit-to-threshold on a fixed batch: each config below must reach its
+recorded loss (and accuracy, where defined) gate within max_steps, with
+early stopping — "last < first" alone would pass a broken optimizer that
+merely twitched downhill."""
 
 import numpy as np
+
+# Per-config convergence contracts. Margins are ~25-40% above measured
+# convergence (SRL/Adam reaches 0.36x initial in 40 steps; seq2seq
+# reaches CE 0.38 and ~0.9 next-token accuracy in 60).
+THRESHOLDS = {
+    "label_semantic_roles": {"max_steps": 40, "loss_ratio": 0.5},
+    "rnn_encoder_decoder": {"max_steps": 60, "loss_abs": 1.0,
+                            "token_acc": 0.75},
+}
 
 import paddle_tpu.fluid as fluid
 import paddle_tpu.dataset as dataset
@@ -57,7 +74,7 @@ def test_label_semantic_roles_trains():
             emission, label,
             param_attr=fluid.ParamAttr(name="crfw"))
         loss = fluid.layers.mean(crf_cost)
-        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
     exe = fluid.Executor(fluid.CPUPlace())
 
     rng = np.random.RandomState(0)
@@ -72,14 +89,20 @@ def test_label_semantic_roles_trains():
     feed["label"] = _to_lod(
         [[min(t, n_labels - 1) for t in s[8]] for s in batch])
 
+    gate = THRESHOLDS["label_semantic_roles"]
     losses = []
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)
-        for _ in range(6):
+        for _ in range(gate["max_steps"]):
             (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
             losses.append(float(np.asarray(lv).flatten()[0]))
-    assert np.isfinite(losses).all(), losses
-    assert losses[-1] < losses[0], losses
+            assert np.isfinite(losses[-1]), losses
+            if losses[-1] < losses[0] * gate["loss_ratio"]:
+                break
+    assert losses[-1] < losses[0] * gate["loss_ratio"], \
+        "CRF loss did not reach %.2fx initial within %d steps: %s" % (
+            gate["loss_ratio"], gate["max_steps"],
+            [round(l, 2) for l in losses])
 
 
 def test_rnn_encoder_decoder_trains():
@@ -132,14 +155,28 @@ def test_rnn_encoder_decoder_trains():
         "nxt": _to_lod([[min(t, trg_v - 1) for t in s[2]]
                         for s in batch]),
     }
-    losses = []
+    gate = THRESHOLDS["rnn_encoder_decoder"]
+    want = np.asarray(feed["nxt"]._data).flatten()
+    losses, acc = [], 0.0
     with fluid.scope_guard(fluid.Scope()):
         exe.run(startup)
-        for _ in range(8):
+        for _ in range(gate["max_steps"]):
             (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
             losses.append(float(np.asarray(lv).flatten()[0]))
-    assert np.isfinite(losses).all(), losses
-    assert losses[-1] < losses[0] - 0.1, losses
+            assert np.isfinite(losses[-1]), losses
+            if losses[-1] >= gate["loss_abs"]:
+                continue
+            # both gates must hold before stopping: the overfit model
+            # must actually predict the next tokens, not just shave CE
+            (pv,) = exe.run(main, feed=feed, fetch_list=[prob.name])
+            acc = float(np.mean(np.argmax(np.asarray(pv), -1) == want))
+            if acc >= gate["token_acc"]:
+                break
+    assert losses[-1] < gate["loss_abs"] and acc >= gate["token_acc"], \
+        "did not reach CE<%.2f with acc>=%.2f within %d steps " \
+        "(CE %.3f, acc %.3f): %s" % (
+            gate["loss_abs"], gate["token_acc"], gate["max_steps"],
+            losses[-1], acc, [round(l, 2) for l in losses])
 
 
 def test_new_dataset_readers_shapes():

@@ -155,6 +155,96 @@ def test_nhwc_bn_fold_bias_axis():
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
 
 
+def test_biased_conv_declines_fusion():
+    """A conv2d carrying an inline Bias input has no slot in the fused
+    kernel; the PASS must leave that block unfused (and numerically
+    intact) instead of silently dropping the bias. The transpiler's own
+    BN fold absorbs inline biases before the pass runs (tested below),
+    so this models a LOADED, already-folded program with a stray inline
+    bias — the pass is applied directly."""
+    main, startup, out = _build_resnet_tail("NHWC")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(6)
+    x = rng.randn(4, 8, 8, 16).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        infer = main.clone(for_test=True)
+        from paddle_tpu.fluid.transpiler import InferenceTranspiler
+        it = InferenceTranspiler()
+        it._remove_dropout(infer)
+        it._fuse_batch_norm(infer, scope)   # folded, not yet fused
+        blk = infer.global_block()
+        conv = next(op for op in blk.ops if op.type == "conv2d")
+        w = blk._find_var_recursive(conv.inputs["Filter"][0])
+        bias_name = "inline_conv_bias"
+        blk.create_var(name=bias_name, shape=(int(w.shape[0]),),
+                       dtype="float32", persistable=True)
+        scope.set(bias_name,
+                  rng.randn(int(w.shape[0])).astype(np.float32))
+        conv.inputs["Bias"] = [bias_name]
+        want, = exe.run(infer, feed={"img": x}, fetch_list=[out.name])
+        from paddle_tpu.fluid.ir_passes import apply_passes
+        apply_passes(infer, ["fuse_bottleneck_pass"])
+        types = [op.type for op in infer.global_block().ops]
+        # the biased block stays on loose ops; the clean block still fuses
+        assert types.count("fused_bottleneck") == 1, types
+        assert "conv2d" in types, types
+        got, = exe.run(infer, feed={"img": x}, fetch_list=[out.name])
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_bn_fold_absorbs_inline_conv_bias():
+    """BN(conv + b) folds to inv_std*conv + (beta + (b - mean)*inv_std):
+    the inline bias must be scaled into the folded add and removed from
+    the conv, not left to double-apply (or silently drop)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[6, 6, 5],
+                                dtype="float32")
+        conv = fluid.layers.conv2d(input=img, num_filters=7, filter_size=3,
+                                   padding=1, act=None, bias_attr=False,
+                                   data_format="NHWC")
+        out = fluid.layers.batch_norm(input=conv, act=None, is_test=True,
+                                      data_layout="NHWC")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 6, 6, 5).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        blk = main.global_block()
+        conv_op = next(op for op in blk.ops if op.type == "conv2d")
+        blk.create_var(name="cb", shape=(7,), dtype="float32",
+                       persistable=True)
+        scope.set("cb", rng.randn(7).astype(np.float32))
+        conv_op.inputs["Bias"] = ["cb"]
+        # non-trivial running stats so a wrong fold is numerically loud
+        for v in blk.vars.values():
+            n, a = v.name, scope.get(v.name)
+            if a is None or np.asarray(a).ndim != 1 or n == "cb" or \
+                    "batch_norm" not in n:
+                continue
+            a = np.asarray(a)
+            if n.split(".")[-1].startswith("var"):
+                scope.set(n, (0.05 + rng.rand(*a.shape) * 2.0)
+                          .astype(a.dtype))
+            else:
+                scope.set(n, rng.randn(*a.shape).astype(a.dtype) * 0.5)
+        want, = exe.run(main, feed={"img": x}, fetch_list=[out.name])
+        infer = main.clone(for_test=True)
+        from paddle_tpu.fluid.transpiler import InferenceTranspiler
+        InferenceTranspiler().transpile(infer, scope=scope)
+        iblk = infer.global_block()
+        itypes = [op.type for op in iblk.ops]
+        assert "batch_norm" not in itypes, itypes
+        iconv = next(op for op in iblk.ops if op.type == "conv2d")
+        assert not iconv.inputs.get("Bias"), iconv.inputs
+        got, = exe.run(infer, feed={"img": x}, fetch_list=[out.name])
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
 def test_fused_program_exports_aot(tmp_path):
     """The AnalysisPredictor path (BN fold + block fusion) must still
     AOT-export and serve in a fresh predictor: the fused op's kernel has

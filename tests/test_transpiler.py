@@ -107,6 +107,20 @@ class TestDistTranspilerStructure:
         send_op = trainer.global_block().ops[-4]
         assert all(n.endswith("@GRAD") for n in send_op.input("X"))
 
+    def test_oversize_var_fails_at_transpile(self, monkeypatch):
+        """A param bigger than the RPC frame cap travels whole-var over
+        the wire; the transpiler must fail up front naming the variable
+        and the env var, not deep in the socket layer at step time."""
+        import paddle_tpu.distributed.rpc as rpc
+        monkeypatch.setattr(rpc, "_MAX_FRAME", 1 << 10)
+        main, startup, _ = _build_net()
+        t = DistributeTranspiler()
+        with pytest.raises(ValueError) as ei:
+            t.transpile(trainer_id=0, program=main,
+                        pservers="127.0.0.1:6174", trainers=2,
+                        startup_program=startup)
+        assert "PADDLE_TPU_MAX_RPC_FRAME" in str(ei.value)
+
     def test_pserver_program(self):
         main, startup, _ = _build_net()
         t = DistributeTranspiler()

@@ -131,6 +131,42 @@ def test_non_dict_protocol_message_rejected():
         server.stop()
 
 
+def test_oversize_outgoing_frame_names_env_var(monkeypatch):
+    """The sender fails with a message naming PADDLE_TPU_MAX_RPC_FRAME
+    instead of shipping a frame the peer's cap will reject mid-stream."""
+    import paddle_tpu.distributed.rpc as rpc
+    monkeypatch.setattr(rpc, "_MAX_FRAME", 1 << 10)
+
+    class _Sock:
+        def sendall(self, data):
+            raise AssertionError("oversize frame must not hit the socket")
+
+    with pytest.raises(wire.WireError) as ei:
+        rpc._send_msg(_Sock(), {"cmd": "send",
+                                "var": np.zeros(4096, np.float32)})
+    assert "PADDLE_TPU_MAX_RPC_FRAME" in str(ei.value)
+
+
+def test_server_oversize_reply_sends_error_not_drop(monkeypatch):
+    """A Get whose reply frame exceeds the cap must come back as an
+    error message naming the env var — the stream is still in sync, so
+    dropping the connection would hide the actionable diagnostic."""
+    import paddle_tpu.distributed.rpc as rpc
+    server = rpc.VariableServer("127.0.0.1:0").start()
+    try:
+        # plant a var directly on the server (never crossed the wire),
+        # then shrink the cap so only the reply trips it
+        server.store["jumbo"] = np.zeros(4096, np.float32)
+        monkeypatch.setattr(rpc, "_MAX_FRAME", 1 << 10)
+        client = rpc.RPCClient()
+        with pytest.raises(RuntimeError) as ei:
+            client.async_get_var(server.endpoint, "jumbo")
+        assert "PADDLE_TPU_MAX_RPC_FRAME" in str(ei.value)
+        client.close()
+    finally:
+        server.stop()
+
+
 def test_truncated_valid_frame_rejected():
     frame = wire.encode({"cmd": "send", "var": np.arange(100.0)})
     for cut in (9, len(frame) // 2, len(frame) - 1):

@@ -67,13 +67,17 @@ def _claim_singleton(lockfile):
     vanishes with the process — no stale state to clean up."""
     import fcntl
     global _LOCK_FH
-    _LOCK_FH = open(lockfile, "w")
+    # append mode: opening with "w" would truncate the running watcher's
+    # recorded PID before our flock attempt fails, losing the diagnostic
+    _LOCK_FH = open(lockfile, "a")
     try:
         fcntl.flock(_LOCK_FH, fcntl.LOCK_EX | fcntl.LOCK_NB)
     except OSError:
         print("tpu_watch already running (lock held on %s); exiting"
               % lockfile, file=sys.stderr)
         sys.exit(1)
+    _LOCK_FH.truncate(0)
+    _LOCK_FH.seek(0)
     _LOCK_FH.write(str(os.getpid()))
     _LOCK_FH.flush()
 
@@ -125,7 +129,7 @@ def main():
                     # still committed by the end-of-round auto-commit
                     payload = json.dumps(results, indent=1)
                     for name in ("BENCH_watch.json",
-                                 "BENCH_recovery_r04.json"):
+                                 "BENCH_recovery_r05.json"):
                         with open(os.path.join(REPO, name), "w") as f:
                             f.write(payload)
 
@@ -139,9 +143,13 @@ def main():
                     # ceiling is 1800s with a 2-consecutive-timeout
                     # abort, and --require_tpu fails fast if the
                     # transport wedged after the flagship run.
+                    # tracked output file: bench_zoo flushes after every
+                    # config, so a mid-sweep wedge still leaves each
+                    # completed stage in a file the end-of-round
+                    # auto-commit preserves
                     zoo_ok, _ = run_logged(
                         [sys.executable, "tools/bench_zoo.py",
-                         "--out", "BENCH_zoo.json",
+                         "--out", "BENCH_zoo_r05.json",
                          "--require_tpu"], {}, log, 14400)
                     if not zoo_ok:
                         # transport wedged again between flagship and

@@ -71,3 +71,18 @@ def test_dp8_one_allreduce_of_exact_param_volume():
     ratio = flops8 / (flops1 / 8.0)
     assert 0.9 < ratio < 1.15, \
         "per-chip FLOPs not ~1/8 of single-chip: ratio %.3f" % ratio
+
+
+def test_strategy_census_sp_pp_ep_contract():
+    """The sp/pp/ep dryrun computations must compile to the collectives
+    their designs promise (VERDICT r4 #4): all-to-all for Ulysses
+    head/seq resharding, collective-permute for the GPipe ring, a
+    cross-expert reduction for MoE combine. Runs the same census hook
+    tools/scaling_analysis.py --strategies uses, at n=4 for speed."""
+    import __graft_entry__ as g
+    census = {}
+    g._dryrun_spe_impl(4, census=census)
+    coll = {k: collective_census(v["hlo"]) for k, v in census.items()}
+    assert "all-to-all" in coll["ulysses_sp4"], coll["ulysses_sp4"]
+    assert "collective-permute" in coll["gpipe_pp4"], coll["gpipe_pp4"]
+    assert "all-reduce" in coll["moe_ep4"], coll["moe_ep4"]

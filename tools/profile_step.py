@@ -1,4 +1,10 @@
-"""Profile one ResNet-50 train step on the real TPU; print top XLA ops."""
+"""Profile one ResNet-50 train step on the real TPU; print top XLA ops.
+
+Usage: profile_step.py [NHWC|NCHW] [batch] [remat]
+The optional third arg profiles the rematerialized whole-graph-AD step
+(ROOFLINE.md remat lever) so the measured per-step op time / HBM
+arithmetic intensity under remat can be compared against the baseline.
+Emits a trailing PROFILE_JSON line for the watcher to archive."""
 import glob
 import gzip
 import json
@@ -10,7 +16,11 @@ from collections import defaultdict
 import numpy as np
 
 
-def main(layout="NHWC", batch=256):
+def main(layout="NHWC", batch=256, remat=False):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import init_backend
+    init_backend(require_tpu=True, tool="profile_step")
     import jax
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import functionalizer
@@ -26,8 +36,16 @@ def main(layout="NHWC", batch=256):
     with fluid.scope_guard(scope):
         exe.run(startup)
         state_names = tuple(functionalizer.persistable_names(main_prog))
-        step_fn = functionalizer.build_step_fn(
-            main_prog, ("data", "label"), (loss.name,), state_names)
+        if remat:
+            step_fn = functionalizer.build_whole_graph_step_fn(
+                main_prog, ("data", "label"), (loss.name,), state_names,
+                remat_policy="conv_out")
+            if step_fn is None:
+                raise RuntimeError("program ineligible for whole-graph "
+                                   "AD; remat profile would be a lie")
+        else:
+            step_fn = functionalizer.build_step_fn(
+                main_prog, ("data", "label"), (loss.name,), state_names)
         jitted = jax.jit(step_fn, donate_argnums=(0,))
         state = {n: scope.get(n) for n in state_names
                  if scope.get(n) is not None}
@@ -85,9 +103,14 @@ def main(layout="NHWC", batch=256):
     print("%-64s %10s %6s" % ("op", "ms", "%"))
     for name, ms in items[:40]:
         print("%-64s %10.3f %5.1f%%" % (name[:64], ms, ms / total * 100))
+    print("PROFILE_JSON " + json.dumps({
+        "layout": layout, "batch": batch, "remat": remat,
+        "ms_per_step": round(total / 3, 2),
+        "top_ops": [{"op": n[:96], "ms": round(t, 3)}
+                    for n, t in items[:12]]}))
 
 
 if __name__ == "__main__":
     layout = sys.argv[1] if len(sys.argv) > 1 else "NHWC"
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
-    main(layout, batch)
+    main(layout, batch, remat="remat" in sys.argv[3:])

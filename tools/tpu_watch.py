@@ -214,6 +214,8 @@ def main():
                         # --require_tpu keeps CPU fallbacks out of the
                         # records
                         for cmd, sweep_name in (
+                                (["tools/convergence_run.py",
+                                  "--require_tpu"], "convergence"),
                                 (["tools/tune_bottleneck.py",
                                   "--require_tpu"], "tune_bottleneck"),
                                 (["tools/bench_attention.py",
@@ -222,6 +224,19 @@ def main():
                                 [sys.executable] + cmd, {}, log, 3600)
                             if ex_ok:
                                 parse_lines(ex_out, sweep_name)
+                            flush_results()
+                        # remat profile LAST (a second heavy remat
+                        # compile): the measured-arithmetic-intensity
+                        # read ROOFLINE.md wants, archived raw
+                        pr_ok, pr_out = run_logged(
+                            [sys.executable, "tools/profile_step.py",
+                             "NHWC", "256", "remat"], {}, log, 3600)
+                        if pr_ok:
+                            for line in pr_out.splitlines():
+                                if line.startswith("PROFILE_JSON "):
+                                    results.append(dict(
+                                        json.loads(line[13:]),
+                                        sweep="profile_remat"))
                         flush_results()
                         log.write("[%s] extras done\n"
                                   % time.strftime("%H:%M:%S"))

@@ -127,11 +127,15 @@ def main():
     # policy genuinely drops BN/activation tails and recomputes them in
     # the backward (ROOFLINE.md remat lever).
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    # "conv_out" keeps every conv output (recompute BN/relu tails);
+    # "block_out" keeps only residual-block boundaries (recompute block
+    # interiors) — the larger projected lever (tools/fused_block_traffic.py)
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "conv_out")
     whole_graph = os.environ.get("BENCH_WHOLEGRAPH", "1") == "1"
     if whole_graph or remat:
         step_fn = functionalizer.build_whole_graph_step_fn(
             main_prog, ("data", "label"), (loss.name,), state_names,
-            remat_policy="conv_out" if remat else None)
+            remat_policy=remat_policy if remat else None)
         if step_fn is None and remat:
             # never mislabel a baseline run as a remat measurement
             raise RuntimeError(

@@ -532,13 +532,18 @@ def _resolve_remat_policy(policy):
     if policy is None or callable(policy):
         return policy
     # string shorthands (flag-friendly)
-    if policy == "conv_out":
-        return jax.checkpoint_policies.save_only_these_names("conv_out")
     if policy == "nothing":
         return jax.checkpoint_policies.nothing_saveable
     if policy == "dots":
         return jax.checkpoint_policies.checkpoint_dots
-    raise ValueError("unknown remat policy %r" % (policy,))
+    # one or more checkpoint_name tags, comma-separated: "conv_out"
+    # (per-conv, ops/nn_ops.py), "block_out" (residual-block boundary,
+    # models/resnet.py _tag_block_out — the block-granularity remat
+    # ROOFLINE.md quantifies), or any custom remat_tag the model placed
+    names = [n.strip() for n in policy.split(",") if n.strip()]
+    if not names:
+        raise ValueError("unknown remat policy %r" % (policy,))
+    return jax.checkpoint_policies.save_only_these_names(*names)
 
 
 def build_whole_graph_step_fn(program, feed_names, fetch_names, state_names,

@@ -60,6 +60,18 @@ def _assign(ctx):
     return {"Out": ctx.input("X")}
 
 
+@register_op("remat_tag")
+def _remat_tag(ctx):
+    """Identity carrying a jax.ad_checkpoint name tag (attr 'tag').
+    Under whole-graph AD a save_only_these_names(tag) policy keeps the
+    tagged value and rematerializes everything between tags in the
+    backward (the block-granularity remat lever in ROOFLINE.md);
+    in normal execution XLA elides it entirely."""
+    from jax.ad_checkpoint import checkpoint_name
+    return {"Out": checkpoint_name(ctx.input("X"),
+                                   ctx.attr("tag", "block_out"))}
+
+
 @register_op("assign_value")
 def _assign_value(ctx):
     jnp = _jnp()

@@ -196,3 +196,70 @@ def test_remat_step_lowers_for_tpu_offchip(amp):
         assert len(exp.mlir_module_serialized) > 0
     finally:
         fluid.set_amp(False)
+
+def test_block_out_remat_recomputes_convs():
+    """remat_policy='block_out' saves only the residual-block boundary
+    tags (models/resnet.py _tag_block_out) and recomputes block
+    INTERIORS — so conv ops must be duplicated into the backward (unlike
+    'conv_out', which pins every conv output), while numerics stay
+    exact."""
+    fluid.set_amp(False)
+    from paddle_tpu.models import resnet
+    with fluid.unique_name.guard():
+        main, startup, feeds, loss, acc, predict = resnet.get_model(
+            batch_size=4, class_dim=10, depth=20, dataset="cifar10",
+            lr=0.1, is_train=True, layout="NHWC")
+    assert any(op.type == "remat_tag"
+               for op in main.global_block().ops), "blocks must be tagged"
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sn = tuple(functionalizer.persistable_names(main))
+        state = {n: scope.get(n) for n in sn if scope.get(n) is not None}
+    wg = functionalizer.build_whole_graph_step_fn(
+        main, ("data", "label"), (loss.name,), sn)
+    wg_blk = functionalizer.build_whole_graph_step_fn(
+        main, ("data", "label"), (loss.name,), sn,
+        remat_policy="block_out")
+    assert wg is not None and wg_blk is not None
+    rng = np.random.RandomState(3)
+    b = {"data": rng.randn(4, 32, 32, 3).astype(np.float32),
+         "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+    n_plain = jax.jit(wg).lower(state, b, np.uint32(0)).as_text().count(
+        "stablehlo.convolution")
+    n_blk = jax.jit(wg_blk).lower(state, b, np.uint32(0)).as_text().count(
+        "stablehlo.convolution")
+    assert n_blk > n_plain, (n_plain, n_blk)
+    # recompute is exact math, but the different save-set changes XLA's
+    # fusion schedule, so parity is float-rounding-tight, not bitwise
+    f_a, _ = jax.jit(wg)(state, b, np.uint32(0))
+    f_b, _ = jax.jit(wg_blk)(state, b, np.uint32(0))
+    np.testing.assert_allclose(np.asarray(f_a[0]), np.asarray(f_b[0]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_remat_tag_transparent_to_per_op_and_inference():
+    """The remat_tag identity must not change per-op execution, and the
+    is_train=False graph must not contain it."""
+    from paddle_tpu.models import resnet
+    with fluid.unique_name.guard():
+        main, startup, feeds, loss, acc, predict = resnet.get_model(
+            batch_size=2, class_dim=10, depth=20, dataset="cifar10",
+            is_train=False, layout="NHWC")
+    assert not any(op.type == "remat_tag"
+                   for op in main.global_block().ops)
+    with fluid.unique_name.guard():
+        main_t, startup_t, _, loss_t, _, _ = resnet.get_model(
+            batch_size=2, class_dim=10, depth=20, dataset="cifar10",
+            is_train=True, layout="NHWC")
+    scope = fluid.Scope()
+    rng = np.random.RandomState(4)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup_t)
+        (lv,) = exe.run(main_t, feed={
+            "data": rng.randn(2, 32, 32, 3).astype(np.float32),
+            "label": rng.randint(0, 10, (2, 1)).astype(np.int64)},
+            fetch_list=[loss_t])
+        assert np.isfinite(float(np.asarray(lv).flatten()[0]))

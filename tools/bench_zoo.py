@@ -65,6 +65,12 @@ CONFIGS = [
     ("resnet50_imagenet_remat",
      ["--model", "resnet", "--data_set", "imagenet", "--layout", "NHWC",
       "--whole_graph_ad", "--remat_policy", "conv_out"], 256, 8),
+    # block-granularity remat: save only residual-block boundaries,
+    # recompute block interiors in the backward — the biggest projected
+    # HBM lever (tools/fused_block_traffic.py: ~94 FLOP/byte)
+    ("resnet50_imagenet_remat_blk",
+     ["--model", "resnet", "--data_set", "imagenet", "--layout", "NHWC",
+      "--whole_graph_ad", "--remat_policy", "block_out"], 256, 8),
     ("vgg16_cifar10_remat",
      ["--model", "vgg", "--data_set", "cifar10",
       "--whole_graph_ad", "--remat_policy", "conv_out"], 128, 8),

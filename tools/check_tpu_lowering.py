@@ -34,6 +34,9 @@ CONFIGS = [
     ("resnet50_nhwc_remat", "resnet", {"dataset": "imagenet",
                                        "layout": "NHWC"}, 8, True,
      "conv_out"),
+    ("resnet50_nhwc_remat_blk", "resnet", {"dataset": "imagenet",
+                                           "layout": "NHWC"}, 8, True,
+     "block_out"),
     ("se_resnext_nhwc", "se_resnext", {"layout": "NHWC"}, 4, True, None),
     ("vgg16_cifar10", "vgg", {"dataset": "cifar10"}, 8, True, None),
     ("vgg16_cifar10_remat", "vgg", {"dataset": "cifar10"}, 8, True,

@@ -1,0 +1,126 @@
+"""Paper quantification of the cross-layer fused TRAINING block
+(VERDICT r4 next #3): projected per-image HBM activation traffic for
+ResNet-50 under four execution designs, with the modeling assumptions
+explicit, so ROOFLINE.md can reject (or fund) the 3-pass-stats Pallas
+training kernel with a number instead of "saves little".
+
+Designs compared (activation traffic only; weight/optimizer traffic is
+identical across designs and small, ~0.4 MB/image at batch 256):
+
+  baseline   — XLA per-conv fusion. Each conv output crosses HBM 3x in
+               the forward (write raw; read for the batch-stat
+               reduction; read for normalize+relu, the normalized write
+               fusing into the next conv's input read... counted as a
+               write) => 4 crossings counting that write, and the
+               backward re-reads the saved normalized activation AND
+               the raw conv output for the BN grad (2 crossings), plus
+               writes/reads each activation gradient once (2).
+  remat      — whole-graph AD + save_only_these_names("conv_out") (the
+               implemented BENCH_REMAT lever): forward identical to
+               baseline, but only raw conv outputs are saved; the
+               backward re-reads those once and recomputes BN/relu
+               in-register; activation grads still cross twice.
+  remat_blk  — jax.checkpoint at BLOCK granularity (save only each
+               block's output; expressible today with a policy change,
+               no new kernel): backward recomputes the whole block from
+               its input, re-reading the block input twice (fwd-in-bwd
+               chain) and the saved block outputs once.
+  fused3pass — the hypothetical Pallas training block: 3 stats passes
+               re-read the block input (once per BN), intermediates
+               live in VMEM, one raw output write + a normalize pass at
+               the end; backward = remat_blk's (the kernel does not
+               change what the backward must read).
+
+All designs write the final normalized block output once (it feeds the
+next block). Shortcut traffic: the elementwise add reads the shortcut
+branch (block input or projected input) once in fwd and adds one grad
+crossing in bwd — identical across designs, included for absolute
+honesty of the per-image total.
+"""
+
+import json
+
+BF16 = 2
+
+# (n_blocks, S_in=HxW at block input, C_in, F, C4, stride) per stage —
+# ResNet-50: conv1+pool stem then 3/4/6/3 bottlenecks
+STAGES = [
+    (3, 56 * 56, 256, 64, 256, 1),     # stage2 (first block C_in=64)
+    (4, 56 * 56, 512, 128, 512, 2),    # stage3 (stride on first block)
+    (6, 28 * 28, 1024, 256, 1024, 2),  # stage4
+    (3, 14 * 14, 2048, 512, 2048, 2),  # stage5
+]
+
+
+def block_traffic(S_in, C_in, F, C4, stride):
+    """Per-image activation bytes crossing HBM for one bottleneck,
+    per design. S_out = spatial after the (possibly strided) 3x3."""
+    S_mid = S_in                   # after 1x1 reduce (stride lives on 3x3)
+    S_out = S_in // (stride * stride)
+    a0 = S_mid * F * BF16          # conv0 out
+    a1 = S_out * F * BF16          # conv1 out
+    a2 = S_out * C4 * BF16         # conv2 out (pre-BN)
+    x = S_in * C_in * BF16         # block input
+    out = S_out * C4 * BF16        # normalized block output
+    convs = [a0, a1, a2]
+
+    # forward
+    fwd_per_conv_baseline = 4      # write raw, read stats, read norm, write norm
+    fwd_baseline = sum(c * fwd_per_conv_baseline for c in convs) + x
+    fwd_fused = 3 * x + a2 * 2 + out  # 3 stats passes + raw out w/r + out
+
+    # backward (activation grads: write+read once per conv boundary)
+    grads = sum(convs) * 2 + out
+    bwd_baseline = sum(c * 2 for c in convs) + grads   # norm+raw re-reads
+    bwd_remat = sum(convs) + grads                     # raw re-read only
+    bwd_blk = 2 * x + out + grads                      # recompute from x
+
+    return {
+        "baseline": fwd_baseline + bwd_baseline,
+        "remat": fwd_baseline + bwd_remat,
+        "remat_blk": sum(c * 4 for c in convs) + x - sum(convs) * 3
+        + 2 * x + out + grads,     # fwd saves nothing extra vs baseline*
+        "fused3pass": fwd_fused + bwd_blk,
+        "out_bytes": out,
+    }
+
+
+def main():
+    totals = {"baseline": 0, "remat": 0, "remat_blk": 0, "fused3pass": 0}
+    for n, S_in, C_in, F, C4, stride in STAGES:
+        for b in range(n):
+            s = stride if b == 0 else 1
+            S = S_in if b == 0 else S_in // (stride * stride)
+            C = C_in if b > 0 else (64 if S_in == 56 * 56 and C4 == 256
+                                    else C_in)
+            t = block_traffic(S, C if b == 0 else C4, F, C4, s)
+            for k in totals:
+                totals[k] += t[k]
+    # stem + head, identical across designs: conv1 (112^2*64 out, x4
+    # crossings) + pool + fc activations; grads double it
+    stem = 112 * 112 * 64 * BF16 * 4 * 2 + 224 * 224 * 3 * 4
+    for k in totals:
+        totals[k] += stem
+    flops = 12.3e9                 # per image, fwd+bwd
+    recompute = {"baseline": 1.0, "remat": 1.04,  # BN/relu recompute
+                 "remat_blk": 1.33, "fused3pass": 1.55}  # fwd re-runs
+    print("%-11s %14s %12s %10s %12s" % (
+        "design", "MB/image", "FLOP/byte", "MFU cap", "recompute"))
+    rows = {}
+    for k in ("baseline", "remat", "remat_blk", "fused3pass"):
+        mb = totals[k] / 1e6
+        # +weights/optimizer ~0.4 MB/image
+        mb_total = mb + 0.4
+        intensity = flops / (mb_total * 1e6)
+        cap = intensity / 240.0    # v5e: 197e12/819e9 FLOP/byte balance
+        print("%-11s %14.1f %12.0f %9.1f%% %11.2fx" % (
+            k, mb_total, intensity, cap * 100, recompute[k]))
+        rows[k] = {"mb_per_image": round(mb_total, 1),
+                   "flop_per_byte": round(intensity, 1),
+                   "mfu_cap_pct": round(cap * 100, 1),
+                   "recompute_factor": recompute[k]}
+    print("TRAFFIC_JSON " + json.dumps(rows))
+
+
+if __name__ == "__main__":
+    main()

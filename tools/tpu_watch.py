@@ -205,6 +205,17 @@ def main():
                                          remat_failures))
                         else:
                             parse_lines(out2, "nhwc+remat")
+                            # block-granularity remat (the bigger
+                            # projected lever, ROOFLINE.md): only after
+                            # the conv_out run survived — same compile
+                            # risk class
+                            okb, outb = run_logged(
+                                [sys.executable, "bench.py"],
+                                {"BENCH_REMAT": "1",
+                                 "BENCH_REMAT_POLICY": "block_out"},
+                                log, 1800)
+                            if okb:
+                                parse_lines(outb, "nhwc+remat_blk")
                         flush_results()
                         log.write("[%s] sweep complete\n"
                                   % time.strftime("%H:%M:%S"))

@@ -39,7 +39,7 @@ __all__ = [
     "bilinear_tensor_product", "crop", "selu", "spp", "shuffle_channel",
     "psroi_pool", "scatter_nd_add", "scatter_nd", "squared_l2_distance",
     "l2_norm_layer", "fsp_matrix", "gather_tree", "pad_constant_like",
-    "flash_attention",
+    "flash_attention", "remat_checkpoint",
 ]
 
 
@@ -1430,6 +1430,24 @@ def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
                             "output_dim_idx": output_dim_idx,
                             "mean": mean, "std": std, "seed": seed,
                             "dtype": out.dtype})
+    return out
+
+
+def remat_checkpoint(x, tag="block_out", name=None):
+    """Identity carrying a rematerialization name tag.
+
+    Under whole-graph AD (functionalizer.build_whole_graph_step_fn) a
+    remat_policy naming this tag (e.g. "block_out") saves ONLY tagged
+    values and recomputes everything between tags in the backward,
+    trading recompute FLOPs for HBM traffic — the block-granularity
+    remat lever quantified in ROOFLINE.md. In normal execution (and
+    inference) XLA elides the identity. TPU-idiomatic replacement for
+    the reference's recompute/forward-recomputation machinery
+    (paddle/fluid memory_optimization passes)."""
+    helper = LayerHelper("remat_tag", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="remat_tag", inputs={"X": x},
+                     outputs={"Out": out}, attrs={"tag": tag})
     return out
 
 

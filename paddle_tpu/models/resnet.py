@@ -32,21 +32,13 @@ def _channels(v, layout):
 
 
 def _tag_block_out(x, is_train):
-    """Identity remat tag at the residual-block boundary. With the
-    whole-graph-AD policy remat_policy="block_out" the backward saves
-    ONLY these values and recomputes each block's interior from its
-    input — the biggest projected HBM-traffic lever on the training
-    roofline (tools/fused_block_traffic.py: ~94 FLOP/byte vs the
-    baseline's measured 40). Inference programs keep the op; XLA
-    elides the identity."""
-    if not is_train:
-        return x
-    from paddle_tpu.fluid.layer_helper import LayerHelper
-    helper = LayerHelper("remat_tag")
-    out = helper.create_variable_for_type_inference(x.dtype)
-    helper.append_op(type="remat_tag", inputs={"X": x},
-                     outputs={"Out": out}, attrs={"tag": "block_out"})
-    return out
+    """Remat tag at the residual-block boundary: with
+    remat_policy="block_out" the backward saves ONLY these values and
+    recomputes each block's interior from its input — the biggest
+    projected HBM-traffic lever on the training roofline
+    (tools/fused_block_traffic.py: ~94 FLOP/byte vs the baseline's
+    measured 40)."""
+    return fluid.layers.remat_checkpoint(x) if is_train else x
 
 
 def shortcut(input, ch_out, stride, is_train=True, layout="NCHW"):

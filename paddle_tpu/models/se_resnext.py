@@ -80,7 +80,9 @@ def bottleneck_block(input, num_filters, stride, cardinality,
                                layout=layout)
     short = shortcut(input, num_filters * 2, stride, is_train=is_train,
                      remove_bn=remove_bn, layout=layout)
-    return fluid.layers.elementwise_add(x=short, y=scale, act="relu")
+    out = fluid.layers.elementwise_add(x=short, y=scale, act="relu")
+    # block-boundary remat tag (ROOFLINE.md block_out lever)
+    return fluid.layers.remat_checkpoint(out) if is_train else out
 
 
 def build(img, layers=50, class_dim=1000, is_train=True, remove_bn=False,

@@ -54,7 +54,11 @@ def transformer_block(x, d_model, n_heads, d_ff, prefix, is_train=True):
         input=h, size=d_model, num_flatten_dims=2,
         param_attr=fluid.ParamAttr(name=prefix + "_ff2_w"),
         bias_attr=fluid.ParamAttr(name=prefix + "_ff2_b"))
-    return fluid.layers.elementwise_add(x, h)
+    out = fluid.layers.elementwise_add(x, h)
+    # layer-boundary remat tag: remat_policy="block_out" recomputes each
+    # transformer layer from its input in the backward (the standard
+    # per-layer checkpointing for long-sequence training)
+    return fluid.layers.remat_checkpoint(out) if is_train else out
 
 
 def build(tokens, vocab_size, seq_len, d_model=512, n_heads=8, n_layers=6,

@@ -93,9 +93,15 @@ def test_se_resnext_bn_semantic_parity():
     feeds = _se_resnext_feeds(2)
     local = run_executor(build, feeds, None, 2)
     pe = run_parallel_executor(build, feeds, None, 2)
-    # measured chaos floor: [5.5e-5, 1.1e-3]; a per-shard-stats bug gives
-    # O(0.1) at step 0
-    np.testing.assert_allclose(local, pe, atol=1e-2, err_msg=
+    # The semantic guard is STEP 0: a per-shard-stats/mask bug diverges
+    # by O(0.1) before any update lands, while correct global stats
+    # agree to reduction-reassociation noise (measured ~6e-5). Later
+    # steps only bound the chaotic amplification of that noise through
+    # the BN stack, which moves whenever XLA's fusion schedule does
+    # (e.g. the inert remat_tag identity shifted step-1 from 1.1e-3 to
+    # 1.06e-2) — so step 1+ gets the loose bound, step 0 the tight one.
+    assert abs(local[0] - pe[0]) < 1e-3, (local, pe)
+    np.testing.assert_allclose(local, pe, atol=3e-2, err_msg=
                                "BN-kept Executor vs PE diverged beyond the "
                                "reassociation-noise bound")
 

@@ -142,7 +142,21 @@ def main():
                          "smoke when the chip does not answer (the "
                          "watcher's recovery flow wants chip numbers "
                          "or nothing)")
+    ap.add_argument("--resume", action="store_true",
+                    help="skip configs that already have an error-free "
+                         "record in --out (mid-sweep transport wedges "
+                         "must not cost completed hour-scale runs)")
     args = ap.parse_args()
+
+    prior = {}
+    if args.resume and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                for rec in json.load(f).get("configs", []):
+                    if rec.get("config") and not rec.get("error"):
+                        prior[rec["config"]] = rec
+        except (ValueError, OSError):
+            prior = {}
 
     backend = probe_backend()
     force_cpu = backend != "tpu"
@@ -159,6 +173,13 @@ def main():
     consecutive_timeouts = 0
     for name, extra, tpu_batch, cpu_batch in CONFIGS:
         if wanted and name not in wanted:
+            continue
+        if name in prior:
+            print("== %s: kept prior record (--resume) ==" % name,
+                  flush=True)
+            results["configs"].append(prior[name])
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=2)
             continue
         batch = cpu_batch if force_cpu else tpu_batch
         print("== %s (batch %d) ==" % (name, batch), flush=True)

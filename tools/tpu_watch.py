@@ -90,8 +90,62 @@ def main():
     args = ap.parse_args()
     _claim_singleton(os.path.join(REPO, ".tpu_watch.lock"))
 
+    # Sweep stages in VERDICT-r4 priority order: the remat flagship runs
+    # are "the single most valuable unmeasured number in the repo" and go
+    # RIGHT AFTER the flagship confirm, before the multi-hour zoo — if
+    # the remat compile wedges the transport (it did in r3 and r4), the
+    # zoo was never reachable in that window anyway, and the probe loop
+    # resumes the sweep from the first incomplete stage on recovery.
+    # (name, argv, env, timeout). bench_zoo writes its own tracked file
+    # and flushes per config; PROFILE_JSON is parsed specially.
+    stages = [
+        ("nhwc", ["bench.py"], {}, 1800),
+        ("nhwc+remat", ["bench.py"], {"BENCH_REMAT": "1"}, 1800),
+        ("nhwc+remat_blk", ["bench.py"],
+         {"BENCH_REMAT": "1", "BENCH_REMAT_POLICY": "block_out"}, 1800),
+        ("zoo", ["tools/bench_zoo.py", "--out", "BENCH_zoo_r05.json",
+                 "--require_tpu", "--resume"], {}, 14400),
+        ("infer", ["tools/bench_infer.py", "--require_tpu"], {}, 1800),
+        ("convergence", ["tools/convergence_run.py", "--require_tpu"],
+         {}, 3600),
+        ("tune_bottleneck", ["tools/tune_bottleneck.py", "--require_tpu"],
+         {}, 3600),
+        ("attention", ["tools/bench_attention.py", "--require_tpu"],
+         {}, 3600),
+        ("profile_remat", ["tools/profile_step.py", "NHWC", "256",
+                           "remat"], {}, 3600),
+    ]
+    MAX_FAILURES = 3   # per stage; then it is skipped, not retried forever
+
     results = []
-    remat_failures = 0
+    done = set()
+    failures = {}
+
+    def parse_lines(out, sweep):
+        # a re-run replaces that stage's earlier rows instead of
+        # duplicating them; `sweep` labels the stage and must NOT
+        # clobber a record's own "variant" field
+        results[:] = [r for r in results if r.get("sweep") != sweep]
+        for line in out.splitlines():
+            if line.startswith("PROFILE_JSON "):
+                line = line[len("PROFILE_JSON "):]
+            if not line.startswith("{"):
+                continue
+            try:
+                results.append(dict(json.loads(line), sweep=sweep))
+            except ValueError:
+                pass  # '{'-prefixed non-JSON debug line
+
+    def flush_results():
+        # BENCH_watch.json is the live (gitignored) scratch file; the
+        # round-stamped copy is tracked so a recovery sweep landing
+        # after the session ends is still committed by the end-of-round
+        # auto-commit
+        payload = json.dumps(results, indent=1)
+        for name in ("BENCH_watch.json", "BENCH_recovery_r05.json"):
+            with open(os.path.join(REPO, name), "w") as f:
+                f.write(payload)
+
     with open(args.log, "a") as log:
         while True:
             backend = probe()
@@ -99,160 +153,38 @@ def main():
             log.write("[%s] probe -> %s\n" % (stamp, backend))
             log.flush()
             if backend == "tpu":
-                # Chip is answering: flagship number first (20-min
-                # ceiling covers a slow relay compile), then the zoo
-                # sweep, then the remat flagship variant last (its
-                # compile is what wedged the transport in r4).
-                ok, out = run_logged(
-                    [sys.executable, "bench.py"], {}, log, 1800)
-                def parse_lines(out, sweep):
-                    # a re-run after a mid-sweep wedge replaces that
-                    # sweep stage's earlier rows instead of duplicating
-                    # them; `sweep` labels the stage and must NOT clobber
-                    # a record's own "variant" field (bench_infer emits
-                    # fused/unfused rows)
-                    results[:] = [r for r in results
-                                  if r.get("sweep") != sweep]
-                    for line in out.splitlines():
-                        if not line.startswith("{"):
-                            continue
-                        try:
-                            results.append(
-                                dict(json.loads(line), sweep=sweep))
-                        except ValueError:
-                            pass  # '{'-prefixed non-JSON debug line
-
-                def flush_results():
-                    # BENCH_watch.json is the live (gitignored) scratch
-                    # file; the round-stamped copy is tracked so a
-                    # recovery sweep landing after the session ends is
-                    # still committed by the end-of-round auto-commit
-                    payload = json.dumps(results, indent=1)
-                    for name in ("BENCH_watch.json",
-                                 "BENCH_recovery_r05.json"):
-                        with open(os.path.join(REPO, name), "w") as f:
-                            f.write(payload)
-
-                if ok:
-                    parse_lines(out, "nhwc")
-                    flush_results()
-                    # zoo BEFORE the remat flagship: the BENCH_REMAT
-                    # compile is what wedged the transport at the r4
-                    # session start — the riskiest run goes last so a
-                    # wedge there cannot cost the zoo. Per-config
-                    # ceiling is 1800s with a 2-consecutive-timeout
-                    # abort, and --require_tpu fails fast if the
-                    # transport wedged after the flagship run.
-                    # tracked output file: bench_zoo flushes after every
-                    # config, so a mid-sweep wedge still leaves each
-                    # completed stage in a file the end-of-round
-                    # auto-commit preserves
-                    zoo_ok, _ = run_logged(
-                        [sys.executable, "tools/bench_zoo.py",
-                         "--out", "BENCH_zoo_r05.json",
-                         "--require_tpu"], {}, log, 14400)
-                    if not zoo_ok:
-                        # transport wedged again between flagship and
-                        # zoo: keep probing instead of declaring the
-                        # sweep complete with zero zoo numbers
-                        log.write("[%s] zoo failed; resuming probe "
-                                  "loop\n" % time.strftime("%H:%M:%S"))
-                        log.flush()
-                    else:
-                        # inference fused-vs-unfused after the zoo: a
-                        # fresh Pallas compile, riskier than the zoo but
-                        # less than remat
-                        inf_ok, inf_out = run_logged(
-                            [sys.executable, "tools/bench_infer.py",
-                             "--require_tpu"], {}, log, 1800)
-                        if not inf_ok:
-                            # same policy as a zoo failure: the transport
-                            # wedged mid-sweep — keep probing so the
-                            # fused-vs-unfused numbers are retried, do
-                            # not fall through and declare completion
-                            log.write("[%s] bench_infer failed; resuming "
-                                      "probe loop\n"
-                                      % time.strftime("%H:%M:%S"))
-                            log.flush()
-                            if args.once:
-                                return
-                            time.sleep(args.interval)
-                            continue
-                        parse_lines(inf_out, "infer")
+                wedged = False
+                for name, argv, env, timeout in stages:
+                    if name in done or failures.get(name, 0) >= \
+                            MAX_FAILURES:
+                        continue
+                    ok, out = run_logged(
+                        [sys.executable] + argv, env, log, timeout)
+                    if ok:
+                        done.add(name)
+                        parse_lines(out, name)
                         flush_results()
-                        ok2, out2 = run_logged(
-                            [sys.executable, "bench.py"],
-                            {"BENCH_REMAT": "1"}, log, 1800)
-                        if not ok2:
-                            # remat is the riskiest compile; a wedge here
-                            # is retried like the zoo/infer stages — but
-                            # bounded, so a deterministic compile error
-                            # cannot cycle the full sweep forever
-                            remat_failures += 1
-                            if remat_failures < 3:
-                                log.write("[%s] remat run failed (%d); "
-                                          "resuming probe loop\n"
-                                          % (time.strftime("%H:%M:%S"),
-                                             remat_failures))
-                                log.flush()
-                                if args.once:
-                                    return
-                                time.sleep(args.interval)
-                                continue
-                            log.write("[%s] remat failed %d times; "
-                                      "completing sweep without it\n"
-                                      % (time.strftime("%H:%M:%S"),
-                                         remat_failures))
-                        else:
-                            parse_lines(out2, "nhwc+remat")
-                            # block-granularity remat (the bigger
-                            # projected lever, ROOFLINE.md): only after
-                            # the conv_out run survived — same compile
-                            # risk class
-                            okb, outb = run_logged(
-                                [sys.executable, "bench.py"],
-                                {"BENCH_REMAT": "1",
-                                 "BENCH_REMAT_POLICY": "block_out"},
-                                log, 1800)
-                            if okb:
-                                parse_lines(outb, "nhwc+remat_blk")
-                        flush_results()
-                        log.write("[%s] sweep complete\n"
-                                  % time.strftime("%H:%M:%S"))
-                        log.flush()
-                        # best-effort extras AFTER the sweep is safely
-                        # recorded: a wedge here costs nothing, and
-                        # --require_tpu keeps CPU fallbacks out of the
-                        # records
-                        for cmd, sweep_name in (
-                                (["tools/convergence_run.py",
-                                  "--require_tpu"], "convergence"),
-                                (["tools/tune_bottleneck.py",
-                                  "--require_tpu"], "tune_bottleneck"),
-                                (["tools/bench_attention.py",
-                                  "--require_tpu"], "attention")):
-                            ex_ok, ex_out = run_logged(
-                                [sys.executable] + cmd, {}, log, 3600)
-                            if ex_ok:
-                                parse_lines(ex_out, sweep_name)
-                            flush_results()
-                        # remat profile LAST (a second heavy remat
-                        # compile): the measured-arithmetic-intensity
-                        # read ROOFLINE.md wants, archived raw
-                        pr_ok, pr_out = run_logged(
-                            [sys.executable, "tools/profile_step.py",
-                             "NHWC", "256", "remat"], {}, log, 3600)
-                        if pr_ok:
-                            for line in pr_out.splitlines():
-                                if line.startswith("PROFILE_JSON "):
-                                    results.append(dict(
-                                        json.loads(line[13:]),
-                                        sweep="profile_remat"))
-                        flush_results()
-                        log.write("[%s] extras done\n"
-                                  % time.strftime("%H:%M:%S"))
-                        log.flush()
-                        return
+                        continue
+                    failures[name] = failures.get(name, 0) + 1
+                    log.write("[%s] stage %s failed (%d/%d); probing "
+                              "before the next attempt\n"
+                              % (time.strftime("%H:%M:%S"), name,
+                                 failures[name], MAX_FAILURES))
+                    log.flush()
+                    # a stage failure usually means the transport wedged
+                    # mid-sweep: go back to probing; recovery resumes at
+                    # the first incomplete stage (completed work is kept)
+                    wedged = True
+                    break
+                if not wedged:
+                    log.write("[%s] sweep complete: %d stages done, "
+                              "skipped %r\n"
+                              % (time.strftime("%H:%M:%S"), len(done),
+                                 sorted(n for n, c in failures.items()
+                                        if c >= MAX_FAILURES
+                                        and n not in done)))
+                    log.flush()
+                    return
             if args.once:
                 return
             time.sleep(args.interval)

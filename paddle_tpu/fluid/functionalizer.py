@@ -537,12 +537,20 @@ def _resolve_remat_policy(policy):
     if policy == "dots":
         return jax.checkpoint_policies.checkpoint_dots
     # one or more checkpoint_name tags, comma-separated: "conv_out"
-    # (per-conv, ops/nn_ops.py), "block_out" (residual-block boundary,
-    # models/resnet.py _tag_block_out — the block-granularity remat
-    # ROOFLINE.md quantifies), or any custom remat_tag the model placed
+    # (per-conv, ops/nn_ops.py), "block_out" (residual-block /
+    # transformer-layer boundary, fluid.layers.remat_checkpoint).
+    # Names are VALIDATED: a typo'd tag would silently match nothing,
+    # save nothing, and record a maximal-recompute run under a remat
+    # label — the mislabeling bench.py explicitly guards against.
+    # Custom tags go through a callable policy
+    # (jax.checkpoint_policies.save_only_these_names(...)).
+    known = {"conv_out", "block_out"}
     names = [n.strip() for n in policy.split(",") if n.strip()]
-    if not names:
-        raise ValueError("unknown remat policy %r" % (policy,))
+    if not names or not set(names) <= known:
+        raise ValueError(
+            "unknown remat policy %r; expected 'nothing', 'dots', a "
+            "comma-separated subset of %s, or a callable jax "
+            "checkpoint policy" % (policy, sorted(known)))
     return jax.checkpoint_policies.save_only_these_names(*names)
 
 

@@ -34,10 +34,10 @@ def _channels(v, layout):
 def _tag_block_out(x, is_train):
     """Remat tag at the residual-block boundary: with
     remat_policy="block_out" the backward saves ONLY these values and
-    recomputes each block's interior from its input — the biggest
-    projected HBM-traffic lever on the training roofline
-    (tools/fused_block_traffic.py: ~94 FLOP/byte vs the baseline's
-    measured 40)."""
+    recomputes each block's interior from its input — a ~3x
+    activation-memory-capacity lever at flagship batch (ROOFLINE.md
+    quantified ladder; BN-stats materialization makes it
+    capacity-oriented, not traffic-oriented, for conv stacks)."""
     return fluid.layers.remat_checkpoint(x) if is_train else x
 
 

@@ -81,7 +81,7 @@ def bottleneck_block(input, num_filters, stride, cardinality,
     short = shortcut(input, num_filters * 2, stride, is_train=is_train,
                      remove_bn=remove_bn, layout=layout)
     out = fluid.layers.elementwise_add(x=short, y=scale, act="relu")
-    # block-boundary remat tag (ROOFLINE.md block_out lever)
+    # block-boundary remat tag (ROOFLINE.md block_out capacity lever)
     return fluid.layers.remat_checkpoint(out) if is_train else out
 
 

@@ -263,3 +263,15 @@ def test_remat_tag_transparent_to_per_op_and_inference():
             "label": rng.randint(0, 10, (2, 1)).astype(np.int64)},
             fetch_list=[loss_t])
         assert np.isfinite(float(np.asarray(lv).flatten()[0]))
+
+
+def test_remat_policy_typos_rejected():
+    """A typo'd policy string must raise, not silently compile a
+    save-nothing policy recorded under a remat label."""
+    from paddle_tpu.fluid.functionalizer import _resolve_remat_policy
+    for bad in ("blockout", "conv-out", "conv_out,typo", ""):
+        with pytest.raises(ValueError):
+            _resolve_remat_policy(bad)
+    for good in ("conv_out", "block_out", "conv_out,block_out",
+                 "nothing", "dots", None):
+        _resolve_remat_policy(good)

@@ -66,8 +66,10 @@ CONFIGS = [
      ["--model", "resnet", "--data_set", "imagenet", "--layout", "NHWC",
       "--whole_graph_ad", "--remat_policy", "conv_out"], 256, 8),
     # block-granularity remat: save only residual-block boundaries,
-    # recompute block interiors in the backward — the biggest projected
-    # HBM lever (tools/fused_block_traffic.py: ~94 FLOP/byte)
+    # recompute block interiors in the backward — the ~3x
+    # activation-capacity lever; the measured row arbitrates the
+    # ROOFLINE.md traffic model (which projects it traffic-NEUTRAL at
+    # best for conv stacks at this batch)
     ("resnet50_imagenet_remat_blk",
      ["--model", "resnet", "--data_set", "imagenet", "--layout", "NHWC",
       "--whole_graph_ad", "--remat_policy", "block_out"], 256, 8),
@@ -206,6 +208,16 @@ def main():
             break
 
     print("wrote %s" % args.out)
+    if args.require_tpu:
+        # an aborted or partially-failed real-chip sweep must NOT look
+        # like success: the watcher marks a stage done on rc 0 and
+        # would otherwise never resume the missing configs (--resume
+        # exists precisely to finish them on the next window)
+        bad = [r["config"] for r in results["configs"] if r.get("error")]
+        if results.get("aborted") or bad:
+            print("sweep incomplete: aborted=%r failed=%r"
+                  % (results.get("aborted"), bad))
+            raise SystemExit(5)
 
 
 if __name__ == "__main__":

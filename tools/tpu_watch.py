@@ -87,8 +87,14 @@ def main():
     ap.add_argument("--interval", type=int, default=600)
     ap.add_argument("--once", action="store_true")
     ap.add_argument("--log", default=os.path.join(REPO, "tpu_watch.log"))
+    ap.add_argument("--lock", default=os.path.join(REPO,
+                                                   ".tpu_watch.lock"))
+    ap.add_argument("--results_dir", default=REPO,
+                    help="where BENCH_watch.json / the round-stamped "
+                         "recovery record land (tests point this at a "
+                         "tmpdir)")
     args = ap.parse_args()
-    _claim_singleton(os.path.join(REPO, ".tpu_watch.lock"))
+    _claim_singleton(args.lock)
 
     # Sweep stages in VERDICT-r4 priority order: the remat flagship runs
     # are "the single most valuable unmeasured number in the repo" and go
@@ -143,7 +149,7 @@ def main():
         # auto-commit
         payload = json.dumps(results, indent=1)
         for name in ("BENCH_watch.json", "BENCH_recovery_r05.json"):
-            with open(os.path.join(REPO, name), "w") as f:
+            with open(os.path.join(args.results_dir, name), "w") as f:
                 f.write(payload)
 
     with open(args.log, "a") as log:

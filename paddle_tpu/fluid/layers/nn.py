@@ -454,35 +454,56 @@ def accuracy(input, label, k=1, correct=None, total=None):
     return acc_out
 
 
-def auc(input, label, curve="ROC", num_thresholds=200, topk=1):
-    """Streaming in-graph AUC (reference metrics/auc_op.h). StatPos/StatNeg
-    are persistable state threaded through the op like batch_norm's moving
-    stats. Returns (auc_out, [stat_pos, stat_neg])."""
+def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1, topk=1,
+        slide_steps=1):
+    """Streaming in-graph AUC (reference metrics/auc_op.h +
+    layers/metric_op.py:81). Two op instances like the reference: a
+    sliding-window "batch" AUC over the last `slide_steps` batches
+    (slide_steps=0 degenerates to all steps) and an all-steps "global"
+    AUC. Stats are persistable float32 [S, num_thresholds+1] windows
+    (the reference's int64; float32 keeps the op TPU-native).
+
+    Returns (auc_out, batch_auc_out,
+             [batch_stat_pos, batch_stat_neg, stat_pos, stat_neg]) —
+    the reference's 3-tuple."""
     if topk != 1:
         raise ValueError("auc: only topk=1 is supported (as in the "
                          "reference kernel, metrics/auc_op.h)")
     helper = LayerHelper("auc")
     from .. import unique_name as _un
     gb = helper.main_program.global_block()
-    stat_shape = [num_thresholds + 1]
-    stat_pos = gb.create_var(name=_un.generate("auc_stat_pos"),
-                             shape=stat_shape, dtype="float32",
-                             persistable=True, stop_gradient=True)
-    stat_neg = gb.create_var(name=_un.generate("auc_stat_neg"),
-                             shape=stat_shape, dtype="float32",
-                             persistable=True, stop_gradient=True)
-    helper.set_variable_initializer(stat_pos, Constant(0.0))
-    helper.set_variable_initializer(stat_neg, Constant(0.0))
-    auc_out = helper.create_variable_for_type_inference(
-        "float32", stop_gradient=True)
-    helper.append_op(
-        type="auc",
-        inputs={"Predict": input, "Label": label, "StatPos": stat_pos,
-                "StatNeg": stat_neg},
-        outputs={"AUC": auc_out, "StatPosOut": stat_pos,
-                 "StatNegOut": stat_neg},
-        attrs={"curve": curve, "num_thresholds": num_thresholds})
-    return auc_out, [stat_pos, stat_neg]
+
+    def _stat(tag, rows):
+        v = gb.create_var(name=_un.generate("auc_stat_%s" % tag),
+                          shape=[rows, num_thresholds + 1],
+                          dtype="float32", persistable=True,
+                          stop_gradient=True)
+        helper.set_variable_initializer(v, Constant(0.0))
+        return v
+
+    batch_rows = max(int(slide_steps), 1)
+    batch_stat_pos = _stat("batch_pos", batch_rows)
+    batch_stat_neg = _stat("batch_neg", batch_rows)
+    stat_pos = _stat("pos", 1)
+    stat_neg = _stat("neg", 1)
+
+    def _auc_op(sp, sn, steps):
+        out = helper.create_variable_for_type_inference(
+            "float32", stop_gradient=True)
+        helper.append_op(
+            type="auc",
+            inputs={"Predict": input, "Label": label, "StatPos": sp,
+                    "StatNeg": sn},
+            outputs={"AUC": out, "StatPosOut": sp, "StatNegOut": sn},
+            attrs={"curve": curve, "num_thresholds": num_thresholds,
+                   "slide_steps": steps})
+        return out
+
+    batch_auc_out = _auc_op(batch_stat_pos, batch_stat_neg,
+                            int(slide_steps))
+    auc_out = _auc_op(stat_pos, stat_neg, 0)
+    return auc_out, batch_auc_out, [batch_stat_pos, batch_stat_neg,
+                                    stat_pos, stat_neg]
 
 
 def one_hot(input, depth):

@@ -344,31 +344,61 @@ def _edit_distance(ctx):
 
 @register_op("auc", stateful=True)
 def _auc(ctx):
-    """Threshold-bucketed streaming AUC. StatPos/StatNeg [num_thresholds+1]
-    persistable state threaded through like batch_norm's mean/var."""
+    """Threshold-bucketed streaming AUC (metrics/auc_op.h).
+
+    StatPos/StatNeg are persistable state threaded through like
+    batch_norm's mean/var, shaped [S, num_thresholds+1]: S=1 rows
+    accumulated forever for slide_steps=0 (the reference's "global"
+    op instance), S=slide_steps rows used as a ring of per-batch
+    histograms otherwise (statAuc:88-127 — each batch shifts the
+    window and the AUC integrates the window SUM). The integration
+    matches calcAuc:130-157 exactly, including the top trapezoid from
+    (0,0) to the bucket-n point (r5 audit: the earlier version dropped
+    it, biasing AUC when predictions hit 1.0)."""
     jnp = _jnp()
     pred = ctx.input("Predict")
     label = ctx.input("Label")
     stat_pos = ctx.input("StatPos")
     stat_neg = ctx.input("StatNeg")
     n = ctx.attr("num_thresholds", 200)
+    slide = int(ctx.attr("slide_steps", 0) or 0)
+    if stat_pos.ndim == 1:          # legacy flat state
+        stat_pos = stat_pos[None, :]
+        stat_neg = stat_neg[None, :]
     if label.ndim == 2:
         label = label[:, 0]
     p1 = pred[:, -1] if pred.ndim == 2 else pred
     bucket = jnp.clip((p1 * n).astype(jnp.int32), 0, n)
     is_pos = (label > 0).astype(stat_pos.dtype)
-    stat_pos = stat_pos.at[bucket].add(is_pos)
-    stat_neg = stat_neg.at[bucket].add(1 - is_pos)
-    # integrate: for threshold i, TP = sum_{b>=i} pos, FP = sum_{b>=i} neg
-    tp = jnp.cumsum(stat_pos[::-1])[::-1].astype(jnp.float32)
-    fp = jnp.cumsum(stat_neg[::-1])[::-1].astype(jnp.float32)
+    hist_pos = jnp.zeros((n + 1,), stat_pos.dtype).at[bucket].add(is_pos)
+    hist_neg = jnp.zeros((n + 1,), stat_neg.dtype).at[bucket].add(
+        1 - is_pos)
+    if slide <= 0:
+        # "global" mode: accumulate forever in the single row
+        stat_pos = stat_pos.at[0].add(hist_pos)
+        stat_neg = stat_neg.at[0].add(hist_neg)
+    else:
+        # ring of per-batch histograms; slide==1 replaces the window
+        stat_pos = jnp.concatenate([stat_pos[1:], hist_pos[None]], axis=0)
+        stat_neg = jnp.concatenate([stat_neg[1:], hist_neg[None]], axis=0)
+    win_pos = jnp.sum(stat_pos, axis=0)
+    win_neg = jnp.sum(stat_neg, axis=0)
+    # for threshold i, TP = sum_{b>=i} pos, FP = sum_{b>=i} neg; pad a
+    # trailing 0 so the trapezoid from (0,0) to the bucket-n point is
+    # included (calcAuc walks idx = n..0 starting from zero totals)
+    tp = jnp.concatenate([jnp.cumsum(win_pos[::-1])[::-1],
+                          jnp.zeros((1,), win_pos.dtype)]) \
+        .astype(jnp.float32)
+    fp = jnp.concatenate([jnp.cumsum(win_neg[::-1])[::-1],
+                          jnp.zeros((1,), win_neg.dtype)]) \
+        .astype(jnp.float32)
     if ctx.attr("curve", "ROC") == "PR":
-        # trapezoid over (recall, precision) points i = 0..n
+        # trapezoid over (recall, precision) points — a superset: the
+        # reference kernel ignores `curve` and always integrates ROC
         rec = tp / jnp.maximum(tp[0], 1.0)
         prec = jnp.where(tp + fp > 0, tp / jnp.maximum(tp + fp, 1.0), 1.0)
         auc_val = jnp.sum((rec[:-1] - rec[1:]) * (prec[:-1] + prec[1:]) / 2.0)
     else:
-        # trapezoid over (fp, tp) curve points i = 0..n
         area = jnp.sum((fp[:-1] - fp[1:]) * (tp[:-1] + tp[1:]) / 2.0)
         denom = tp[0] * fp[0]
         auc_val = jnp.where(denom > 0, area / jnp.maximum(denom, 1.0), 0.0)
@@ -670,22 +700,33 @@ def _sampling_id(ctx):
 # chunk_eval (chunk_eval_op.h) — chunk F1 for sequence labeling
 # ---------------------------------------------------------------------------
 
+# scheme -> (num_tag_types, tag_begin, tag_inside, tag_end, tag_single);
+# -1 = the scheme has no such tag (chunk_eval_op.h Compute:110-141)
+_CHUNK_SCHEMES = {
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
 def _chunk_bounds(tags, mask, scheme, num_types, jnp):
     """Per-position chunk start/end flags + chunk type, vectorised.
-    Tag encoding (chunk_eval_op.h): tag = type * num_tag + tag_pos where
-    IOB: {B=0, I=1}, IOE: {I=0, E=1}, IOBES: {B=0, I=1, E=2, S=3},
-    plain: every tag is a single-token chunk of its own type."""
-    if scheme == "plain":
-        typ = tags
-        inside = mask & (tags >= 0) & (tags < num_types)
-        start = inside
-        end = inside
-        return start, end, typ
-    ntag = {"IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    Tag encoding (chunk_eval_op.h): tag = type * num_tag + tag_pos.
+
+    The flags implement the reference's GENERIC ChunkBegin/ChunkEnd
+    transition rules (chunk_eval_op.h:83-106) parameterized by the
+    scheme's tag constants — not per-scheme shortcuts. The r5 oracle
+    audit (test_chunk_eval_matches_reference_oracle) caught two
+    divergences in the shortcut version: a bare E/I tag entered from
+    'other' or a different type still BEGINS a chunk, and 'plain'
+    chunks are runs of equal types, not single tokens."""
+    ntag, tb, ti, te, ts = _CHUNK_SCHEMES[scheme]
     typ = jnp.where(tags >= 0, tags // ntag, -1)
     pos = jnp.where(tags >= 0, tags % ntag, -1)
     inside = mask & (typ >= 0) & (typ < num_types)
     typ = jnp.where(inside, typ, -1)
+    pos = jnp.where(inside, pos, -1)
 
     prev_typ = jnp.concatenate([jnp.full_like(typ[:, :1], -1),
                                 typ[:, :-1]], axis=1)
@@ -695,17 +736,20 @@ def _chunk_bounds(tags, mask, scheme, num_types, jnp):
                                 jnp.full_like(typ[:, :1], -1)], axis=1)
     next_pos = jnp.concatenate([pos[:, 1:],
                                 jnp.full_like(pos[:, :1], -1)], axis=1)
-    if scheme == "IOB":
-        start = inside & ((pos == 0) | (prev_typ != typ))
-        end = inside & ((next_typ != typ) | (next_pos == 0))
-    elif scheme == "IOE":
-        start = inside & ((prev_typ != typ) | (prev_pos == 1))
-        end = inside & ((pos == 1) | (next_typ != typ))
-    else:  # IOBES
-        start = inside & ((pos == 0) | (pos == 3) |
-                          ((pos == 1) & (prev_typ != typ)))
-        end = inside & ((pos == 2) | (pos == 3) |
-                        ((pos == 1) & (next_typ != typ)))
+
+    # ChunkBegin at t: type transition (incl. from 'other'/padding,
+    # where prev_typ is -1) always begins; within a same-type run,
+    # B/S begin, and I/E begin only after E/S.
+    same_prev = prev_typ == typ
+    start = inside & (~same_prev | (pos == tb) | (pos == ts) |
+                      (((pos == ti) | (pos == te)) &
+                       ((prev_pos == te) | (prev_pos == ts))))
+    # ChunkEnd at t (ChunkEnd(prev=t, cur=t+1)): type transition ends;
+    # within a same-type run, E/S end, and B/I end before B/S.
+    same_next = next_typ == typ
+    end = inside & (~same_next | (pos == te) | (pos == ts) |
+                    (((pos == tb) | (pos == ti)) &
+                     ((next_pos == tb) | (next_pos == ts))))
     return start, end, typ
 
 

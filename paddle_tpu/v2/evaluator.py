@@ -31,7 +31,7 @@ def auc(input, label, name=None):
     """streaming AUC metric node (v1 auc_evaluator)."""
 
     def build(pv, lv):
-        out, _ = F.auc(input=pv, label=lv)
+        out, _, _ = F.auc(input=pv, label=lv)
         return out
 
     return Layer(name=name, parents=[input, label], build_fn=build,

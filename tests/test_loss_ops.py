@@ -262,7 +262,8 @@ def test_auc_streaming():
     with fluid.program_guard(main, startup):
         pred = fluid.layers.data("pred", shape=[2], dtype="float32")
         lab = fluid.layers.data("lab", shape=[1], dtype="int64")
-        auc_out, _ = fluid.layers.auc(pred, lab, num_thresholds=4096)
+        auc_out, batch_auc_out, _ = fluid.layers.auc(
+            pred, lab, num_thresholds=4096)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
     # perfectly separable -> AUC ~ 1
@@ -443,7 +444,7 @@ def test_auc_pr_curve_runs():
     with fluid.program_guard(main, startup):
         pred = fluid.layers.data("pred", shape=[2], dtype="float32")
         lab = fluid.layers.data("lab", shape=[1], dtype="int64")
-        auc_out, _ = fluid.layers.auc(pred, lab, curve="PR",
+        auc_out, _batch, _ = fluid.layers.auc(pred, lab, curve="PR",
                                       num_thresholds=1024)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
@@ -520,3 +521,205 @@ def test_hsigmoid_preout_holds_softrelu_values():
             np.testing.assert_allclose(pre_out[i, j],
                                        np.logaddexp(0.0, pre), rtol=1e-5)
         assert np.all(pre_out[i, code_len:] == 0)
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval randomized oracle audit (r5): faithful python restatement of
+# chunk_eval_op.h GetSegments/ChunkBegin/ChunkEnd/EvalOneSeq
+# ---------------------------------------------------------------------------
+
+_SCHEMES = {
+    # scheme: (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _ref_segments(labels, scheme, num_types):
+    ntag, tb, ti, te, ts = _SCHEMES[scheme]
+    other = num_types
+
+    def chunk_end(ptag, ptype, tag, typ):
+        if ptype == other:
+            return False
+        if typ == other or typ != ptype:
+            return True
+        if ptag == tb or ptag == ti:
+            return tag == tb or tag == ts
+        return ptag in (te, ts)
+
+    def chunk_begin(ptag, ptype, tag, typ):
+        if ptype == other:
+            return typ != other
+        if typ == other:
+            return False
+        if typ != ptype:
+            return True
+        if tag == tb or tag == ts:
+            return True
+        if tag in (ti, te):
+            return ptag in (te, ts)
+        return False
+
+    segs, in_chunk, start = [], False, 0
+    tag, typ = -1, other
+    for i, lab in enumerate(labels):
+        ptag, ptype = tag, typ
+        tag, typ = lab % ntag, lab // ntag
+        if in_chunk and chunk_end(ptag, ptype, tag, typ):
+            segs.append((start, i - 1, ptype))
+            in_chunk = False
+        if chunk_begin(ptag, ptype, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, len(labels) - 1, typ))
+    return segs
+
+
+def _ref_chunk_eval(seqs_inf, seqs_lab, scheme, num_types, excluded):
+    ex = set(excluded)
+    ni = nl = nc = 0
+    for inf, lab in zip(seqs_inf, seqs_lab):
+        si = _ref_segments(inf, scheme, num_types)
+        sl = _ref_segments(lab, scheme, num_types)
+        i = j = 0
+        while i < len(si) and j < len(sl):
+            if si[i] == sl[j] and si[i][2] not in ex:
+                nc += 1
+            if si[i][1] < sl[j][1]:
+                i += 1
+            elif si[i][1] > sl[j][1]:
+                j += 1
+            else:
+                i += 1
+                j += 1
+        nl += sum(1 for s in sl if s[2] not in ex)
+        ni += sum(1 for s in si if s[2] not in ex)
+    prec = 0.0 if not ni else nc / ni
+    rec = 0.0 if not nl else nc / nl
+    f1 = 0.0 if not nc else 2 * prec * rec / (prec + rec)
+    return prec, rec, f1, ni, nl, nc
+
+
+@pytest.mark.parametrize("scheme", ["IOB", "IOE", "IOBES", "plain"])
+def test_chunk_eval_matches_reference_oracle(scheme):
+    """Randomized parity vs the reference C++ algorithm restated in
+    python (chunk_eval_op.h:41-239): multi-sequence LoD, 'other' tags,
+    excluded chunk types."""
+    rng = np.random.RandomState(hash(scheme) % (2 ** 31))
+    ntag = _SCHEMES[scheme][0]
+    for trial in range(8):
+        num_types = int(rng.randint(1, 4))
+        max_label = num_types * ntag          # == the 'other' label
+        lens = [int(rng.randint(1, 9)) for _ in range(rng.randint(1, 4))]
+        seqs_i = [rng.randint(0, max_label + 1, (n,)).tolist()
+                  for n in lens]
+        seqs_l = [rng.randint(0, max_label + 1, (n,)).tolist()
+                  for n in lens]
+        excluded = ([0] if num_types > 1 and trial % 2 else [])
+
+        want = _ref_chunk_eval(seqs_i, seqs_l, scheme, num_types,
+                               excluded)
+
+        flat_i = np.concatenate(seqs_i).reshape(-1, 1).astype(np.int64)
+        flat_l = np.concatenate(seqs_l).reshape(-1, 1).astype(np.int64)
+        main, startup = Program(), Program()
+        with fluid.program_guard(main, startup):
+            iv = fluid.layers.data("i", shape=[1], dtype="int64",
+                                   lod_level=1)
+            lv = fluid.layers.data("l", shape=[1], dtype="int64",
+                                   lod_level=1)
+            outs = fluid.layers.chunk_eval(
+                iv, lv, chunk_scheme=scheme, num_chunk_types=num_types,
+                excluded_chunk_types=excluded)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            res = exe.run(main,
+                          feed={"i": create_lod_tensor(flat_i, [lens]),
+                                "l": create_lod_tensor(flat_l, [lens])},
+                          fetch_list=list(outs))
+        got = (float(np.asarray(res[0])[0]), float(np.asarray(res[1])[0]),
+               float(np.asarray(res[2])[0]), int(np.asarray(res[3])[0]),
+               int(np.asarray(res[4])[0]), int(np.asarray(res[5])[0]))
+        assert got[3:] == want[3:], (scheme, trial, seqs_i, seqs_l,
+                                     excluded, got, want)
+        np.testing.assert_allclose(got[:3], want[:3], atol=1e-6,
+                                   err_msg=str((scheme, trial)))
+
+
+def _ref_auc(batches, n, slide_steps):
+    """Python restatement of metrics/auc_op.h statAuc+calcAuc."""
+    window = []
+    global_pos = np.zeros(n + 1, np.int64)
+    global_neg = np.zeros(n + 1, np.int64)
+    out = []
+    for preds, labels in batches:
+        hp = np.zeros(n + 1, np.int64)
+        hn = np.zeros(n + 1, np.int64)
+        for p, l in zip(preds, labels):
+            b = int(p * n)
+            if l:
+                hp[b] += 1
+            else:
+                hn[b] += 1
+        if slide_steps == 0:
+            global_pos += hp
+            global_neg += hn
+            sp, sn = global_pos, global_neg
+        else:
+            window.append((hp, hn))
+            window = window[-slide_steps:]
+            sp = np.sum([w[0] for w in window], axis=0)
+            sn = np.sum([w[1] for w in window], axis=0)
+        tot_pos = tot_neg = auc = 0.0
+        pp = nn_ = 0.0
+        for idx in range(n, -1, -1):
+            pp, nn_ = tot_pos, tot_neg
+            tot_pos += sp[idx]
+            tot_neg += sn[idx]
+            auc += abs(tot_neg - nn_) * (tot_pos + pp) / 2.0
+        out.append(auc / tot_pos / tot_neg
+                   if tot_pos > 0 and tot_neg > 0 else auc)
+    return out
+
+
+@pytest.mark.parametrize("slide_steps", [0, 1, 3])
+def test_auc_matches_reference_oracle(slide_steps):
+    """Randomized parity vs metrics/auc_op.h across batches, including
+    predictions that hit bucket n exactly (the top trapezoid) and the
+    sliding-window batch-AUC mode."""
+    rng = np.random.RandomState(7 + slide_steps)
+    n = 32
+    batches = []
+    for _ in range(5):
+        preds = rng.rand(16)
+        preds[rng.rand(16) < 0.1] = 1.0       # exercise bucket n
+        labels = (rng.rand(16) < 0.5).astype(np.int64)
+        batches.append((preds, labels))
+
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        pred = fluid.layers.data("pred", shape=[2], dtype="float32")
+        lab = fluid.layers.data("lab", shape=[1], dtype="int64")
+        g_auc, b_auc, _states = fluid.layers.auc(
+            pred, lab, num_thresholds=n, slide_steps=slide_steps)
+    exe = fluid.Executor(fluid.CPUPlace())
+    want_global = _ref_auc(batches, n, 0)
+    want_batch = _ref_auc(batches, n, slide_steps)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for i, (preds, labels) in enumerate(batches):
+            p2 = np.stack([1 - preds, preds], axis=1).astype(np.float32)
+            g, b = exe.run(main,
+                           feed={"pred": p2,
+                                 "lab": labels.reshape(-1, 1)},
+                           fetch_list=[g_auc, b_auc])
+            np.testing.assert_allclose(float(np.asarray(g)[0]),
+                                       want_global[i], atol=1e-5,
+                                       err_msg="global step %d" % i)
+            np.testing.assert_allclose(float(np.asarray(b)[0]),
+                                       want_batch[i], atol=1e-5,
+                                       err_msg="batch step %d" % i)

@@ -562,10 +562,19 @@ def _detection_map(ctx):
                     o = iou(r[2:6], gb)
                     if o > best:
                         best, bi = o, j
-                if best >= overlap_t and bi >= 0 and not taken[bi]:
-                    taken[bi] = True
-                    if counted[bi]:
+                # STRICT > like the reference (detection_map_op.h:391)
+                if best > overlap_t and bi >= 0:
+                    if not counted[bi]:
+                        # matched a difficult gt under
+                        # evaluate_difficult=False: ignored entirely --
+                        # no TP, no FP, and the box stays unvisited
+                        # (detection_map_op.h:392-404)
+                        continue
+                    if not taken[bi]:
+                        taken[bi] = True
                         tp_rows.append((c, float(r[1]), 1))
+                    else:
+                        fp_rows.append((c, float(r[1]), 1))
                 else:
                     fp_rows.append((c, float(r[1]), 1))
 
@@ -576,7 +585,9 @@ def _detection_map(ctx):
         scored = [(s, 1) for cc, s, n in tp_rows if int(cc) == c] + \
                  [(s, 0) for cc, s, n in fp_rows if int(cc) == c]
         if not scored:
-            aps.append(0.0)
+            # a class with positives but no detections anywhere has no
+            # true_pos entry in the reference and is EXCLUDED from the
+            # mAP average, not scored 0 (detection_map_op.h:437-440)
             continue
         scored.sort(key=lambda t: -t[0])
         tps = np.cumsum([t[1] for t in scored])

@@ -272,3 +272,125 @@ def test_detection_map_counts_fp_for_unlabeled_class():
     # class-2 FP must be recorded in the accumulators
     fp = np.asarray(r["AccumFalsePos"])
     assert any(int(row[0]) == 2 for row in fp), fp
+
+
+# ---------------------------------------------------------------------------
+# detection_map randomized oracle audit (r5): restatement of
+# detection_map_op.h CalcTrueAndFalsePositive + CalcMAP
+# ---------------------------------------------------------------------------
+
+def _ref_map(images, overlap_t, ap_type, evaluate_difficult):
+    """images: list of (gt_rows [label, difficult, x1,y1,x2,y2],
+    det_rows [label, score, x1,y1,x2,y2])."""
+    def iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    npos, tp, fp = {}, {}, {}
+    for gt_rows, det_rows in images:
+        for r in gt_rows:
+            c, diff = int(r[0]), bool(r[1])
+            if evaluate_difficult or not diff:
+                npos[c] = npos.get(c, 0) + 1
+        for c in sorted({int(r[0]) for r in det_rows}):
+            gts = [r for r in gt_rows if int(r[0]) == c]
+            dets = sorted([r for r in det_rows if int(r[0]) == c],
+                          key=lambda r: -r[1])
+            visited = [False] * len(gts)
+            for d in dets:
+                best, bi = -1.0, 0
+                for j, g in enumerate(gts):
+                    o = iou(d[2:6], g[2:6])
+                    if o > best:
+                        best, bi = o, j
+                if best > overlap_t and gts:
+                    if not (evaluate_difficult or not bool(gts[bi][1])):
+                        continue                      # ignored entirely
+                    if not visited[bi]:
+                        visited[bi] = True
+                        tp.setdefault(c, []).append((d[1], 1))
+                        fp.setdefault(c, []).append((d[1], 0))
+                    else:
+                        tp.setdefault(c, []).append((d[1], 0))
+                        fp.setdefault(c, []).append((d[1], 1))
+                else:
+                    tp.setdefault(c, []).append((d[1], 0))
+                    fp.setdefault(c, []).append((d[1], 1))
+
+    m_ap, count = 0.0, 0
+    for c, n in sorted(npos.items()):
+        if n == 0 or c not in tp:
+            continue
+        rows_tp = sorted(tp[c], key=lambda t: -t[0])
+        rows_fp = sorted(fp[c], key=lambda t: -t[0])
+        tps = np.cumsum([t[1] for t in rows_tp])
+        fps = np.cumsum([t[1] for t in rows_fp])
+        rec = tps / float(n)
+        prec = tps / np.maximum(tps + fps, 1e-12)
+        if ap_type == "11point":
+            ap = sum(max([p for r_, p in zip(rec, prec)
+                          if r_ >= j / 10.0] or [0.0])
+                     for j in range(11)) / 11.0
+        else:
+            ap, prev = 0.0, 0.0
+            for r_, p in zip(rec, prec):
+                if abs(r_ - prev) > 1e-6:
+                    ap += p * abs(r_ - prev)
+                prev = r_
+        m_ap += ap
+        count += 1
+    return m_ap / count if count else 0.0
+
+
+@pytest.mark.parametrize("ap_type", ["integral", "11point"])
+@pytest.mark.parametrize("evaluate_difficult", [True, False])
+def test_detection_map_matches_reference_oracle(ap_type,
+                                                evaluate_difficult):
+    rng = np.random.RandomState(11 if ap_type == "integral" else 13)
+    for trial in range(6):
+        n_img = int(rng.randint(1, 4))
+        images, det_rows, gt_rows, det_lens, gt_lens = [], [], [], [], []
+        for _ in range(n_img):
+            ng, nd = int(rng.randint(0, 5)), int(rng.randint(0, 6))
+            g = []
+            for _ in range(ng):
+                c = int(rng.randint(1, 4))
+                x, y = rng.rand(2) * 4
+                w, h = 0.5 + rng.rand(2)
+                g.append([c, int(rng.rand() < 0.3), x, y, x + w, y + h])
+            d = []
+            for _ in range(nd):
+                c = int(rng.randint(1, 4))
+                if g and rng.rand() < 0.6:        # near a gt box
+                    base = g[rng.randint(len(g))]
+                    x, y = base[2] + rng.randn() * 0.2, \
+                        base[3] + rng.randn() * 0.2
+                    x2, y2 = base[4] + rng.randn() * 0.2, \
+                        base[5] + rng.randn() * 0.2
+                else:
+                    x, y = rng.rand(2) * 4
+                    x2, y2 = x + 0.5 + rng.rand(), y + 0.5 + rng.rand()
+                d.append([c, float(rng.rand()), x, y, max(x2, x + .01),
+                          max(y2, y + .01)])
+            images.append((g, d))
+            gt_rows += g
+            det_rows += d
+            gt_lens.append(len(g))
+            det_lens.append(len(d))
+        if not det_rows or not gt_rows:
+            continue
+        want = _ref_map(images, 0.5, ap_type, evaluate_difficult)
+        r = _run("detection_map",
+                 {"DetectRes": np.array(det_rows, np.float32),
+                  "Label": np.array(gt_rows, np.float32),
+                  "DetectRes@LOD_LEN": np.array(det_lens, np.int32),
+                  "Label@LOD_LEN": np.array(gt_lens, np.int32)},
+                 {"overlap_threshold": 0.5, "ap_type": ap_type,
+                  "evaluate_difficult": evaluate_difficult})
+        got = float(np.asarray(r["MAP"])[0])
+        assert abs(got - want) < 1e-5, (ap_type, evaluate_difficult,
+                                        trial, got, want, images)

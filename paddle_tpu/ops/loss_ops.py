@@ -439,9 +439,13 @@ def _precision_recall(ctx):
                          1.0)
         rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12),
                         1.0)
-        f1 = jnp.where(prec + rec > 0,
-                       2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0)
-        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        # macro F1 is the F1 OF the macro-averaged P/R
+        # (precision_recall_op.h:144 CalcF1Score(macro_p, macro_r)),
+        # NOT the mean of per-class F1s (r5 audit)
+        mp, mr = jnp.mean(prec), jnp.mean(rec)
+        mf = jnp.where(mp + mr > 0,
+                       2 * mp * mr / jnp.maximum(mp + mr, 1e-12), 0.0)
+        macro = jnp.stack([mp, mr, mf])
         stp, sfp, sfn = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
         mprec = jnp.where(stp + sfp > 0, stp / jnp.maximum(stp + sfp, 1e-12),
                           1.0)

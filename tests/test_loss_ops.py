@@ -723,3 +723,112 @@ def test_auc_matches_reference_oracle(slide_steps):
             np.testing.assert_allclose(float(np.asarray(b)[0]),
                                        want_batch[i], atol=1e-5,
                                        err_msg="batch step %d" % i)
+
+
+def _ref_precision_recall(samples, C, prior=None):
+    """precision_recall_op.h restated: samples = (idx, label, w)."""
+    st = np.zeros((C, 4))                 # TP FP TN FN
+    TP, FP, TN, FN = 0, 1, 2, 3
+    for idx, lab, w in samples:
+        if idx == lab:
+            st[idx, TP] += w
+            st[:, TN] += w
+            st[idx, TN] -= w
+        else:
+            st[lab, FN] += w
+            st[idx, FP] += w
+            st[:, TN] += w
+            st[idx, TN] -= w
+            st[lab, TN] -= w
+
+    def compute(states):
+        def p(tp, fp):
+            return tp / (tp + fp) if tp > 0 or fp > 0 else 1.0
+        mp = np.mean([p(states[i, TP], states[i, FP]) for i in range(C)])
+        mr = np.mean([p(states[i, TP], states[i, FN]) for i in range(C)])
+        mf = 2 * mp * mr / (mp + mr) if mp > 0 or mr > 0 else 0.0
+        tp_, fp_, fn_ = states[:, TP].sum(), states[:, FP].sum(), \
+            states[:, FN].sum()
+        up, ur = p(tp_, fp_), p(tp_, fn_)
+        uf = 2 * up * ur / (up + ur) if up > 0 or ur > 0 else 0.0
+        return [mp, mr, mf, up, ur, uf]
+
+    batch = compute(st)
+    if prior is not None:
+        st = st + prior
+    return batch, compute(st), st
+
+
+def test_precision_recall_matches_reference_oracle():
+    rng = np.random.RandomState(17)
+    C = 4
+    prior = None
+    for step in range(3):
+        n = 20
+        idx = rng.randint(0, C, n)
+        lab = rng.randint(0, C, n)
+        w = rng.rand(n).astype(np.float32)
+        want_b, want_a, want_st = _ref_precision_recall(
+            list(zip(idx, lab, w)), C,
+            prior if prior is not None else np.zeros((C, 4)))
+        from paddle_tpu.ops.registry import get_op_def, ExecContext
+        import jax.numpy as jnp
+
+        class _Op:
+            type = "precision_recall"
+            outputs = {}
+            attrs = {"class_number": C}
+        vals = {"Indices": [jnp.asarray(idx.reshape(-1, 1))],
+                "Labels": [jnp.asarray(lab.reshape(-1, 1))],
+                "Weights": [jnp.asarray(w.reshape(-1, 1))],
+                "StatesInfo": [jnp.asarray(
+                    prior if prior is not None
+                    else np.zeros((C, 4), np.float32))]}
+        r = get_op_def("precision_recall").lower(ExecContext(_Op(), vals))
+        np.testing.assert_allclose(np.asarray(r["BatchMetrics"]), want_b,
+                                   atol=1e-5, err_msg="batch %d" % step)
+        np.testing.assert_allclose(np.asarray(r["AccumMetrics"]), want_a,
+                                   atol=1e-5, err_msg="accum %d" % step)
+        prior = np.asarray(r["AccumStatesInfo"])
+        np.testing.assert_allclose(prior, want_st, atol=1e-4)
+
+
+def test_positive_negative_pair_matches_reference_oracle():
+    """positive_negative_pair_op.h: same-query different-label pairs;
+    ties add to neutral AND negative."""
+    rng = np.random.RandomState(19)
+    n = 24
+    score = rng.rand(n).astype(np.float32)
+    score[rng.rand(n) < 0.2] = 0.5                  # force ties
+    label = rng.randint(0, 3, n).astype(np.float32)
+    query = rng.randint(0, 4, n).astype(np.int64)
+    w = rng.rand(n).astype(np.float32)
+    pos = neg = neu = 0.0
+    for i in range(n):
+        for j in range(i + 1, n):
+            if query[i] != query[j] or label[i] == label[j]:
+                continue
+            pw = (w[i] + w[j]) / 2.0
+            if score[i] == score[j]:
+                neu += pw
+            if (score[i] - score[j]) * (label[i] - label[j]) > 0:
+                pos += pw
+            else:
+                neg += pw
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import jax.numpy as jnp
+
+    class _Op:
+        type = "positive_negative_pair"
+        outputs = {}
+        attrs = {"column": 0}
+    vals = {"Score": [jnp.asarray(score.reshape(-1, 1))],
+            "Label": [jnp.asarray(label.reshape(-1, 1))],
+            "QueryID": [jnp.asarray(query.reshape(-1, 1))],
+            "Weight": [jnp.asarray(w.reshape(-1, 1))]}
+    r = get_op_def("positive_negative_pair").lower(ExecContext(_Op(), vals))
+    np.testing.assert_allclose(
+        [float(np.asarray(r["PositivePair"]).reshape(-1)[0]),
+         float(np.asarray(r["NegativePair"]).reshape(-1)[0]),
+         float(np.asarray(r["NeutralPair"]).reshape(-1)[0])],
+        [pos, neg, neu], atol=1e-4)

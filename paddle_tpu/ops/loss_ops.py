@@ -488,6 +488,11 @@ def _mean_iou(ctx):
     iou = jnp.where(valid, inter_t / jnp.maximum(union_t, 1.0), 0.0)
     mean_iou = jnp.sum(iou) / jnp.maximum(
         jnp.sum(valid.astype(jnp.float32)), 1.0)
+    # streaming mean accumulators ADD into the output
+    # (mean_iou_op.h:77-80,:112 — out_mean_iou starts at sum(InMeanIou)
+    # and the batch mean is added on top)
+    for extra_m in ctx.inputs("InMeanIou"):
+        mean_iou = mean_iou + extra_m.reshape(-1)[0]
     return {"OutMeanIou": mean_iou.reshape((1,)),
             "OutWrong": wrong, "OutCorrect": correct}
 

@@ -832,3 +832,93 @@ def test_positive_negative_pair_matches_reference_oracle():
          float(np.asarray(r["NegativePair"]).reshape(-1)[0]),
          float(np.asarray(r["NeutralPair"]).reshape(-1)[0])],
         [pos, neg, neu], atol=1e-4)
+
+
+def test_mean_iou_streaming_inputs_match_reference():
+    """mean_iou_op.h: InWrongs/InCorrects fold into the counts BEFORE
+    the divide; InMeanIou sums ADD to the output mean."""
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import jax.numpy as jnp
+    rng = np.random.RandomState(23)
+    C = 5
+    pred = rng.randint(0, C, 40)
+    lab = rng.randint(0, C, 40)
+    in_wrong = rng.randint(0, 6, C).astype(np.int32)
+    in_correct = rng.randint(0, 6, C).astype(np.int32)
+    in_mean = np.array([0.25], np.float32)
+
+    wrong = in_wrong.copy()
+    correct = in_correct.copy()
+    for p, l in zip(pred, lab):
+        if p == l:
+            correct[p] += 1
+        else:
+            wrong[l] += 1
+            wrong[p] += 1
+    denom = wrong + correct
+    valid = (denom > 0).sum()
+    iou_sum = float(np.sum(correct / np.maximum(denom, 1)))
+    want = in_mean[0] + iou_sum / valid
+
+    class _Op:
+        type = "mean_iou"
+        outputs = {}
+        attrs = {"num_classes": C}
+    vals = {"Predictions": [jnp.asarray(pred.astype(np.int32))],
+            "Labels": [jnp.asarray(lab.astype(np.int32))],
+            "InWrongs": [jnp.asarray(in_wrong)],
+            "InCorrects": [jnp.asarray(in_correct)],
+            "InMeanIou": [jnp.asarray(in_mean)]}
+    r = get_op_def("mean_iou").lower(ExecContext(_Op(), vals))
+    np.testing.assert_allclose(
+        float(np.asarray(r["OutMeanIou"])[0]), want, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(r["OutWrong"]), wrong)
+    np.testing.assert_array_equal(np.asarray(r["OutCorrect"]), correct)
+
+
+def _levenshtein(a, b):
+    m, n = len(a), len(b)
+    d = np.zeros((m + 1, n + 1), np.int64)
+    d[:, 0] = np.arange(m + 1)
+    d[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + cost)
+    return d[m, n]
+
+
+@pytest.mark.parametrize("normalized", [False, True])
+def test_edit_distance_matches_levenshtein_oracle(normalized):
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import jax.numpy as jnp
+    rng = np.random.RandomState(29 + normalized)
+    B, Th, Tr = 6, 9, 8
+    hl = rng.randint(0, Th + 1, B)
+    rl = rng.randint(1, Tr + 1, B)          # refs non-empty like the ref op
+    hyp = rng.randint(0, 5, (B, Th)).astype(np.int64)
+    ref = rng.randint(0, 5, (B, Tr)).astype(np.int64)
+    ignored = [0]
+
+    want = []
+    for b in range(B):
+        h = [t for t in hyp[b, :hl[b]] if t not in ignored]
+        r = [t for t in ref[b, :rl[b]] if t not in ignored]
+        d = float(len(h) if not r else
+                  (len(r) if not h else _levenshtein(h, r)))
+        if normalized and r:
+            d /= len(r)
+        want.append(d)
+
+    class _Op:
+        type = "edit_distance"
+        outputs = {}
+        attrs = {"normalized": normalized, "ignored_tokens": ignored}
+    vals = {"Hyps": [jnp.asarray(hyp)], "Refs": [jnp.asarray(ref)],
+            "Hyps@LOD_LEN": [jnp.asarray(hl.astype(np.int32))],
+            "Refs@LOD_LEN": [jnp.asarray(rl.astype(np.int32))]}
+    r = get_op_def("edit_distance").lower(ExecContext(_Op(), vals))
+    got = np.asarray(r["Out"]).reshape(-1)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    assert int(np.asarray(r["SequenceNum"])[0]) == B

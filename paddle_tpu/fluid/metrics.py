@@ -1,4 +1,14 @@
-"""Streaming Python-side metric accumulators (reference python/paddle/fluid/metrics.py)."""
+"""Streaming Python-side metric accumulators (reference
+python/paddle/fluid/metrics.py).
+
+Deliberate deviation (r5 audit): the reference's binary Precision and
+Recall classes are buggy in this era — Precision.update conditions on
+``label == 1`` (measuring something closer to recall) and Recall counts
+false negatives from ``label != 1`` samples; both also misread
+``labels[0]`` as the sample count. This module implements the textbook
+definitions instead (precision conditions on predicted positives,
+recall on actual positives); the in-graph `precision_recall` op is
+audited against its reference kernel, which is correct."""
 
 import numpy as np
 

@@ -496,18 +496,24 @@ def nms_mask(boxes, scores, valid, iou_threshold, top_k, normalized=True,
     eta = float(eta)
 
     def body(i, state):
-        keep, sup, thr = state
-        ok = vs[i] & ~sup[i]
+        # candidate-time evaluation (NMSFast:173-205): box i is kept iff
+        # its overlap with every ALREADY-KEPT box is <= the CURRENT
+        # adaptive threshold — under eta < 1 the threshold has decayed
+        # once per prior keep, so deciding suppression at keep time with
+        # the older threshold (the r5 audit's previous formulation)
+        # under-suppresses
+        keep, thr = state
+        max_ov = jnp.max(jnp.where(keep & (jnp.arange(M) < i),
+                                   iou[i], 0.0))
+        ok = vs[i] & (max_ov <= thr)
         keep = keep.at[i].set(ok)
-        row_sup = (iou[i] > thr) & (jnp.arange(M) > i) & ok
         if eta < 1.0:
             thr = jnp.where(ok & (thr > 0.5), thr * eta, thr)
-        return keep, sup | row_sup, thr
+        return keep, thr
 
     keep0 = jnp.zeros((M,), bool)
-    sup0 = jnp.zeros((M,), bool)
     thr0 = jnp.asarray(iou_threshold, jnp.float32)
-    keep_sorted, _, _ = jax.lax.fori_loop(0, M, body, (keep0, sup0, thr0))
+    keep_sorted, _ = jax.lax.fori_loop(0, M, body, (keep0, thr0))
     keep = jnp.zeros((M,), bool).at[order].set(keep_sorted)
     return keep
 

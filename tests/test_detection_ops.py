@@ -542,3 +542,87 @@ def test_rpn_target_assign():
     # fg rows decode to (near-)zero offsets since gt == anchor
     np.testing.assert_allclose(tb_np[:n_fg], 0.0, atol=1e-4)
     assert pl_np.shape[1] == 4
+
+
+# ---------------------------------------------------------------------------
+# multiclass_nms randomized oracle audit (r5): restatement of
+# multiclass_nms_op.cc NMSFast (adaptive eta) + keep_top_k
+# ---------------------------------------------------------------------------
+
+def _ref_iou(a, b):
+    if b[0] > a[2] or b[2] < a[0] or b[1] > a[3] or b[3] < a[1]:
+        return 0.0
+    ix = min(a[2], b[2]) - max(a[0], b[0])
+    iy = min(a[3], b[3]) - max(a[1], b[1])
+    inter = ix * iy
+    ua = ((a[2] - a[0]) * (a[3] - a[1])
+          + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+    return inter / ua if ua > 0 else 0.0
+
+
+def _ref_nms_fast(boxes, scores, score_thr, nms_thr, eta, top_k):
+    cand = [(s, i) for i, s in enumerate(scores) if s > score_thr]
+    cand.sort(key=lambda t: -t[0])
+    if top_k > -1:
+        cand = cand[:top_k]
+    selected = []
+    thr = nms_thr
+    for _, idx in cand:
+        keep = all(_ref_iou(boxes[idx], boxes[k]) <= thr
+                   for k in selected)
+        if keep:
+            selected.append(idx)
+            if eta < 1 and thr > 0.5:
+                thr *= eta
+    return selected
+
+
+def _ref_multiclass_nms(scores, boxes, bg, score_thr, nms_top_k, nms_thr,
+                        keep_top_k, eta):
+    C, M = scores.shape
+    rows = []
+    for c in range(C):
+        if c == bg:
+            continue
+        for i in _ref_nms_fast(boxes, scores[c], score_thr, nms_thr, eta,
+                               nms_top_k):
+            rows.append((c, float(scores[c, i]), i))
+    if keep_top_k > -1 and len(rows) > keep_top_k:
+        rows.sort(key=lambda r: -r[1])
+        rows = rows[:keep_top_k]
+    return {(c, round(s, 5)) + tuple(np.round(boxes[i], 5))
+            for c, s, i in rows}
+
+
+@pytest.mark.parametrize("eta", [1.0, 0.9])
+def test_multiclass_nms_matches_reference_oracle(eta):
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import jax.numpy as jnp
+    rng = np.random.RandomState(31 if eta == 1.0 else 37)
+    B, C, M = 2, 4, 12
+    for trial in range(4):
+        boxes = np.zeros((B, M, 4), np.float32)
+        xy = rng.rand(B, M, 2) * 3
+        wh = 0.5 + rng.rand(B, M, 2) * 1.5
+        boxes[..., :2] = xy
+        boxes[..., 2:] = xy + wh
+        scores = rng.rand(B, C, M).astype(np.float32)
+
+        class _Op:
+            type = "multiclass_nms"
+            outputs = {}
+            attrs = {"background_label": 0, "score_threshold": 0.1,
+                     "nms_top_k": 8, "nms_threshold": 0.45,
+                     "keep_top_k": 6, "normalized": True, "nms_eta": eta}
+        vals = {"Scores": [jnp.asarray(scores)],
+                "BBoxes": [jnp.asarray(boxes)]}
+        r = get_op_def("multiclass_nms").lower(ExecContext(_Op(), vals))
+        out = np.asarray(r["Out"])
+        cnt = np.asarray(r["Out@LOD_LEN"])
+        for b in range(B):
+            want = _ref_multiclass_nms(scores[b], boxes[b], 0, 0.1, 8,
+                                       0.45, 6, eta)
+            got = {(int(row[0]), round(float(row[1]), 5))
+                   + tuple(np.round(row[2:6], 5))
+                   for row in out[b][:cnt[b]]}
+            assert got == want, (eta, trial, b, got, want)

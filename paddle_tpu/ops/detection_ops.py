@@ -249,12 +249,14 @@ def _encode_center_size(target, prior, pvar, wh_offset=0.0):
     jnp = _jnp()
     pw = prior[:, 2] - prior[:, 0] + wh_offset
     ph = prior[:, 3] - prior[:, 1] + wh_offset
-    pcx = prior[:, 0] + pw * 0.5
-    pcy = prior[:, 1] + ph * 0.5
+    # centers are (min+max)/2 in BOTH normalized modes -- the +1 width
+    # does not shift the center (box_coder_op.h:55-57)
+    pcx = (prior[:, 0] + prior[:, 2]) * 0.5
+    pcy = (prior[:, 1] + prior[:, 3]) * 0.5
     tw = target[:, None, 2] - target[:, None, 0] + wh_offset
     th = target[:, None, 3] - target[:, None, 1] + wh_offset
-    tcx = target[:, None, 0] + tw * 0.5
-    tcy = target[:, None, 1] + th * 0.5
+    tcx = (target[:, None, 0] + target[:, None, 2]) * 0.5
+    tcy = (target[:, None, 1] + target[:, None, 3]) * 0.5
     ox = (tcx - pcx[None, :]) / pw[None, :]
     oy = (tcy - pcy[None, :]) / ph[None, :]
     ow = jnp.log(jnp.abs(tw / pw[None, :]))
@@ -275,8 +277,10 @@ def _decode_center_size(target, prior, pvar, wh_offset=0.0):
         target = target[None]
     pw = prior[:, 2] - prior[:, 0] + wh_offset
     ph = prior[:, 3] - prior[:, 1] + wh_offset
-    pcx = prior[:, 0] + pw * 0.5
-    pcy = prior[:, 1] + ph * 0.5
+    # (min+max)/2, NOT min + (w+1)/2: the earlier form shifted decoded
+    # pixel-coordinate boxes by +0.5 (r5 audit vs box_coder_op.h:118)
+    pcx = (prior[:, 0] + prior[:, 2]) * 0.5
+    pcy = (prior[:, 1] + prior[:, 3]) * 0.5
     if pvar is not None:
         target = target * pvar[None, :, :]
     cx = target[..., 0] * pw[None, :] + pcx[None, :]
@@ -366,7 +370,9 @@ def _bipartite_match(ctx):
         thr = float(ctx.attr("dist_threshold", 0.5))
         best_row = jnp.argmax(dist, axis=1).astype(jnp.int32)   # [B, M]
         best_val = jnp.max(dist, axis=1)
-        fill = (midx < 0) & (best_val > thr)
+        # >= like ArgMaxMatch (bipartite_match_op.cc:160: dist >=
+        # overlap_threshold), not strict >
+        fill = (midx < 0) & (best_val >= thr)
         midx = jnp.where(fill, best_row, midx)
         mdist = jnp.where(fill, best_val, mdist)
     return {"ColToRowMatchIndices": midx, "ColToRowMatchDist": mdist}

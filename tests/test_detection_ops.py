@@ -503,7 +503,14 @@ def test_box_coder_pixel_roundtrip():
     enc, dec = np.asarray(enc), np.asarray(dec)
     # reference semantics: tw = xmax-xmin+1 = 8, pw = 8
     np.testing.assert_allclose(enc[0, 0, 2], np.log(8.0 / 8.0), atol=1e-5)
-    np.testing.assert_allclose(dec[0, 0], gt[0], atol=1e-4)
+    # the REFERENCE coder's pixel-box roundtrip is intentionally NOT
+    # exact: centers are (min+max)/2 while widths carry the +1, so
+    # decode(encode(gt)) lands half a pixel low (box_coder_op.h:55,:139
+    # — enc: ox = (5.5-7.5)/8; dec: xmin = 5.5-4 = 1.5, xmax = 5.5+4-1).
+    # Bug-for-bug parity here is what reference-trained SSD checkpoints
+    # decode with.
+    np.testing.assert_allclose(dec[0, 0], [1.5, 2.5, 8.5, 11.5],
+                               atol=1e-4)
 
 
 def test_rpn_target_assign():
@@ -626,3 +633,63 @@ def test_multiclass_nms_matches_reference_oracle(eta):
                    + tuple(np.round(row[2:6], 5))
                    for row in out[b][:cnt[b]]}
             assert got == want, (eta, trial, b, got, want)
+
+
+def _ref_bipartite(dist, match_type, thr):
+    """bipartite_match_op.cc restated (small-N greedy path + ArgMaxMatch)."""
+    N, M = dist.shape
+    midx = np.full(M, -1, np.int32)
+    mdist = np.zeros(M, np.float32)
+    row_used = np.zeros(N, bool)
+    while True:
+        best, bi, bj = -1.0, -1, -1
+        for j in range(M):
+            if midx[j] != -1:
+                continue
+            for i in range(N):
+                if row_used[i] or dist[i, j] < 1e-6:
+                    continue
+                if dist[i, j] > best:
+                    best, bi, bj = dist[i, j], i, j
+        if bi < 0:
+            break
+        midx[bj], mdist[bj] = bi, best
+        row_used[bi] = True
+    if match_type == "per_prediction":
+        for j in range(M):
+            if midx[j] != -1:
+                continue
+            best, bi = -1.0, -1
+            for i in range(N):
+                d = dist[i, j]
+                if d >= 1e-6 and d >= thr and d > best:
+                    best, bi = d, i
+            if bi != -1:
+                midx[j], mdist[j] = bi, best
+    return midx, mdist
+
+
+@pytest.mark.parametrize("match_type", ["bipartite", "per_prediction"])
+def test_bipartite_match_matches_reference_oracle(match_type):
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import jax.numpy as jnp
+    rng = np.random.RandomState(41)
+    for trial in range(5):
+        N, M = rng.randint(2, 6), rng.randint(2, 8)
+        dist = (rng.rand(N, M) * 0.9).astype(np.float32)
+        dist[rng.rand(N, M) < 0.3] = 0.0          # no-edge entries
+        dist[0, 0] = 0.5                          # exact threshold row
+        want_i, want_d = _ref_bipartite(dist, match_type, 0.5)
+
+        class _Op:
+            type = "bipartite_match"
+            outputs = {}
+            attrs = {"match_type": match_type, "dist_threshold": 0.5}
+        vals = {"DistMat": [jnp.asarray(dist[None])]}
+        r = get_op_def("bipartite_match").lower(ExecContext(_Op(), vals))
+        got_i = np.asarray(r["ColToRowMatchIndices"])[0]
+        got_d = np.asarray(r["ColToRowMatchDist"])[0]
+        np.testing.assert_array_equal(got_i, want_i,
+                                      err_msg=str((match_type, trial,
+                                                   dist)))
+        np.testing.assert_allclose(got_d, want_d, atol=1e-6)

@@ -445,37 +445,55 @@ def _mine_hard_examples(ctx):
     dist_thr = float(ctx.attr("neg_dist_threshold", 0.5))
     sample_size = int(ctx.attr("sample_size", 0))
     mining_type = ctx.attr("mining_type", "max_negative")
-    loss = cls_loss if loc_loss is None else cls_loss + loc_loss
     B, P = midx.shape
-    is_neg_cand = (midx < 0) & (mdist < dist_thr)
+    import jax
+
+    def _ascending_pack(sel, cap):
+        # reference emits neg indices from a std::set<int> — ascending
+        # prior-index order, NOT loss order (mine_hard_examples_op.cc
+        # sel_indices copy)
+        key = jnp.where(sel, jnp.arange(P)[None, :], P)
+        asc = jnp.argsort(key, axis=1).astype(jnp.int32)
+        keep = jnp.arange(P)[None, :] < cap[:, None]
+        return jnp.where(keep, asc, 0)
+
+    def _top_sel(loss_masked, cap):
+        # boolean mask of the top-`cap` eligible priors by loss desc
+        order = jnp.argsort(-loss_masked, axis=1).astype(jnp.int32)
+        keep = jnp.arange(P)[None, :] < cap[:, None]
+        return jax.vmap(
+            lambda o, r: jnp.zeros((P,), bool).at[o].set(r))(order, keep)
 
     if mining_type == "hard_example":
+        # eligibility is ALL priors; ranking loss is cls (+loc when
+        # given); selected unmatched priors become negatives with NO
+        # dist filter (IsEligibleMining kHardExample returns true)
+        loss = cls_loss if loc_loss is None else cls_loss + loc_loss
         S = sample_size if sample_size > 0 else P
-        order = jnp.argsort(-loss, axis=1).astype(jnp.int32)   # [B, P]
-        sel_rank = jnp.arange(P)[None, :] < S
-        import jax
-        selected = jax.vmap(
-            lambda o, r: jnp.zeros((P,), bool).at[o].set(r))(order, sel_rank)
-        neg_sel = selected & is_neg_cand
+        cap_all = jnp.minimum(jnp.full((B,), S, jnp.int32),
+                              jnp.full((B,), P, jnp.int32))
+        selected = _top_sel(loss, cap_all)
+        neg_sel = selected & (midx < 0)
         cap = jnp.sum(neg_sel.astype(jnp.int32), axis=1)
-        masked = jnp.where(neg_sel, loss, -jnp.inf)
-        neg_order = jnp.argsort(-masked, axis=1).astype(jnp.int32)
-        keep = jnp.arange(P)[None, :] < cap[:, None]
-        neg_idx = jnp.where(keep, neg_order, 0)
         updated = jnp.where(selected | (midx < 0), midx, -1)
-        return {"NegIndices": neg_idx, "NegIndices@LOD_LEN": cap,
+        return {"NegIndices": _ascending_pack(neg_sel, cap),
+                "NegIndices@LOD_LEN": cap,
                 "UpdatedMatchIndices": updated}
 
+    # max_negative: eligibility = unmatched & dist < threshold; ranking
+    # loss is cls ONLY (the reference adds loc_loss only in
+    # hard_example mode, mine_hard_examples_op.cc:99-101)
+    loss = cls_loss
+    is_neg_cand = (midx < 0) & (mdist < dist_thr)
     num_pos = jnp.sum((midx >= 0).astype(jnp.int32), axis=1)
     cap = (num_pos.astype(jnp.float32) * ratio).astype(jnp.int32)
     if sample_size > 0:
         cap = jnp.full_like(cap, sample_size)
     cap = jnp.minimum(cap, jnp.sum(is_neg_cand.astype(jnp.int32), axis=1))
     masked = jnp.where(is_neg_cand, loss, -jnp.inf)
-    order = jnp.argsort(-masked, axis=1).astype(jnp.int32)    # [B, P]
-    keep = jnp.arange(P)[None, :] < cap[:, None]
-    neg_idx = jnp.where(keep, order, 0)
-    return {"NegIndices": neg_idx, "NegIndices@LOD_LEN": cap,
+    neg_sel = _top_sel(masked, cap) & is_neg_cand
+    return {"NegIndices": _ascending_pack(neg_sel, cap),
+            "NegIndices@LOD_LEN": cap,
             "UpdatedMatchIndices": midx}
 
 

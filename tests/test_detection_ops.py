@@ -693,3 +693,68 @@ def test_bipartite_match_matches_reference_oracle(match_type):
                                       err_msg=str((match_type, trial,
                                                    dist)))
         np.testing.assert_allclose(got_d, want_d, atol=1e-6)
+
+
+def _ref_mine(cls_loss, loc_loss, midx, mdist, mining_type, ratio,
+              dist_thr, sample_size):
+    """mine_hard_examples_op.cc restated for one image."""
+    P = len(midx)
+    if mining_type == "max_negative":
+        elig = [(cls_loss[m], m) for m in range(P)
+                if midx[m] == -1 and mdist[m] < dist_thr]
+        num_pos = sum(1 for m in midx if m != -1)
+        neg_sel = min(int(num_pos * ratio), len(elig))
+        if sample_size > 0:
+            neg_sel = min(sample_size, len(elig))
+        elig.sort(key=lambda t: -t[0])
+        sel = sorted(m for _, m in elig[:neg_sel])
+        return sel, list(midx)
+    # hard_example: all priors eligible, loss = cls + loc
+    loss = [cls_loss[m] + (loc_loss[m] if loc_loss is not None else 0.0)
+            for m in range(P)]
+    elig = sorted(((loss[m], m) for m in range(P)), key=lambda t: -t[0])
+    neg_sel = min(sample_size if sample_size > 0 else P, P)
+    sel = {m for _, m in elig[:neg_sel]}
+    updated = [(-1 if (midx[m] > -1 and m not in sel) else midx[m])
+               for m in range(P)]
+    negs = sorted(m for m in sel if midx[m] == -1)
+    return negs, updated
+
+
+@pytest.mark.parametrize("mining_type", ["max_negative", "hard_example"])
+def test_mine_hard_examples_matches_reference_oracle(mining_type):
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import jax.numpy as jnp
+    rng = np.random.RandomState(43)
+    for trial in range(5):
+        B, P = 2, 12
+        cls = rng.rand(B, P).astype(np.float32)
+        loc = rng.rand(B, P).astype(np.float32)
+        midx = np.where(rng.rand(B, P) < 0.3,
+                        rng.randint(0, 4, (B, P)), -1).astype(np.int32)
+        mdist = (rng.rand(B, P) * 0.8).astype(np.float32)
+        ss = 5 if mining_type == "hard_example" else 0
+
+        class _Op:
+            type = "mine_hard_examples"
+            outputs = {}
+            attrs = {"mining_type": mining_type, "neg_pos_ratio": 2.0,
+                     "neg_dist_threshold": 0.5, "sample_size": ss}
+        vals = {"ClsLoss": [jnp.asarray(cls)],
+                "LocLoss": [jnp.asarray(loc)],
+                "MatchIndices": [jnp.asarray(midx)],
+                "MatchDist": [jnp.asarray(mdist)]}
+        r = get_op_def("mine_hard_examples").lower(ExecContext(_Op(), vals))
+        negs = np.asarray(r["NegIndices"])
+        lens = np.asarray(r["NegIndices@LOD_LEN"])
+        upd = np.asarray(r["UpdatedMatchIndices"])
+        for b in range(B):
+            want_negs, want_upd = _ref_mine(
+                cls[b], loc[b], midx[b], mdist[b], mining_type, 2.0,
+                0.5, ss)
+            assert list(negs[b][:lens[b]]) == want_negs, \
+                (mining_type, trial, b, list(negs[b][:lens[b]]),
+                 want_negs)
+            np.testing.assert_array_equal(upd[b], want_upd,
+                                          err_msg=str((mining_type,
+                                                       trial, b)))

@@ -235,7 +235,7 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     encoded = box_coder(prior_box=prior_box, prior_box_var=prior_box_var,
                         target_box=gt_box, code_type="encode_center_size")
     loc_target, loc_weight = target_assign(
-        encoded, updated_match, mismatch_value=0)
+        encoded, updated_match, mismatch_value=background_label)
     label_target, conf_weight = target_assign(
         gt_label, updated_match, negative_indices=neg_indices,
         mismatch_value=background_label)
@@ -251,11 +251,14 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     conf_loss = _per_prior(conf_loss)
     conf_loss = conf_loss * _per_prior(conf_weight)
     loss = loc_loss_weight * loc_loss + conf_loss_weight * conf_loss
+    # per-IMAGE sum over priors like the reference (detection.py:895
+    # reduce_sum(dim=1, keep_dim=True) -> [N, 1]); returning per-prior
+    # loss here made downstream means P-times smaller (r5 audit)
+    loss = nn.reduce_sum(loss, dim=1, keep_dim=True)
     if normalize:
-        # normalize by number of matched (positive) priors, >= 1; the
-        # result stays per-prior [N, P] like the reference (detection.py
-        # ssd_loss returns the reshaped per-prior loss / normalizer), so a
-        # downstream mean() gives the same magnitude as reference configs
+        # normalize by number of matched (positive) priors; clamped >= 1
+        # (deliberate deviation: the reference divides by a possibly-zero
+        # normalizer and NaNs out a batch with no positives)
         denom = nn.reduce_sum(nn.reduce_sum(loc_weight, dim=1), dim=0)
         denom = nn.elementwise_max(
             denom, tensor_layers.fill_constant([1], "float32", 1.0))

@@ -469,10 +469,8 @@ def _mine_hard_examples(ctx):
         # given); selected unmatched priors become negatives with NO
         # dist filter (IsEligibleMining kHardExample returns true)
         loss = cls_loss if loc_loss is None else cls_loss + loc_loss
-        S = sample_size if sample_size > 0 else P
-        cap_all = jnp.minimum(jnp.full((B,), S, jnp.int32),
-                              jnp.full((B,), P, jnp.int32))
-        selected = _top_sel(loss, cap_all)
+        S = min(sample_size if sample_size > 0 else P, P)
+        selected = _top_sel(loss, jnp.full((B,), S, jnp.int32))
         neg_sel = selected & (midx < 0)
         cap = jnp.sum(neg_sel.astype(jnp.int32), axis=1)
         updated = jnp.where(selected | (midx < 0), midx, -1)

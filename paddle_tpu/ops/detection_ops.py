@@ -698,10 +698,13 @@ def _roi_pool(ctx):
     wi = jnp.arange(W)
 
     def one_roi(feat, roi):
-        x1 = jnp.round(roi[0] * scale)
-        y1 = jnp.round(roi[1] * scale)
-        x2 = jnp.round(roi[2] * scale)
-        y2 = jnp.round(roi[3] * scale)
+        # C round() is half-away-from-zero, not numpy's half-to-even —
+        # spatial_scale=0.5 with odd pixel coords lands on .5 exactly
+        # (roi_pool_op.h:78-81); coords are non-negative so floor(x+0.5)
+        x1 = jnp.floor(roi[0] * scale + 0.5)
+        y1 = jnp.floor(roi[1] * scale + 0.5)
+        x2 = jnp.floor(roi[2] * scale + 0.5)
+        y2 = jnp.floor(roi[3] * scale + 0.5)
         rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
         rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
         bin_h = rh / ph

@@ -758,3 +758,49 @@ def test_mine_hard_examples_matches_reference_oracle(mining_type):
             np.testing.assert_array_equal(upd[b], want_upd,
                                           err_msg=str((mining_type,
                                                        trial, b)))
+
+
+def test_roi_pool_matches_reference_oracle():
+    """roi_pool_op.h restated: round-half-away quantization (the .5 cases
+    from spatial_scale=0.5 with odd coords), floor/ceil bin grids, empty
+    bins -> 0."""
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import jax.numpy as jnp
+    rng = np.random.RandomState(47)
+    B, C, H, W, R = 1, 2, 8, 8, 4
+    ph_, pw_ = 2, 2
+    scale = 0.5
+    x = rng.randn(B, C, H, W).astype(np.float32)
+    rois = np.array([[1, 1, 5, 5], [3, 1, 5, 7],
+                     [0, 0, 15, 15], [7, 7, 7, 7]], np.float32)[None]
+
+    def ref_one(feat, roi):
+        import math
+        rs = [int(math.floor(v * scale + 0.5)) for v in roi]
+        x1, y1, x2, y2 = rs
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        out = np.zeros((C, ph_, pw_), np.float32)
+        for i in range(ph_):
+            for j in range(pw_):
+                hs = min(max(int(math.floor(i * rh / ph_)) + y1, 0), H)
+                he = min(max(int(math.ceil((i + 1) * rh / ph_)) + y1, 0), H)
+                ws = min(max(int(math.floor(j * rw / pw_)) + x1, 0), W)
+                we = min(max(int(math.ceil((j + 1) * rw / pw_)) + x1, 0), W)
+                if he <= hs or we <= ws:
+                    out[:, i, j] = 0.0
+                else:
+                    out[:, i, j] = feat[:, hs:he, ws:we].max(axis=(1, 2))
+        return out
+
+    class _Op:
+        type = "roi_pool"
+        outputs = {}
+        attrs = {"pooled_height": ph_, "pooled_width": pw_,
+                 "spatial_scale": scale}
+    vals = {"X": [jnp.asarray(x)], "ROIs": [jnp.asarray(rois)]}
+    r = get_op_def("roi_pool").lower(ExecContext(_Op(), vals))
+    got = np.asarray(r["Out"])[0]
+    for k in range(R):
+        np.testing.assert_allclose(got[k], ref_one(x[0], rois[0, k]),
+                                   atol=1e-5, err_msg="roi %d" % k)

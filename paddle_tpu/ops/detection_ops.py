@@ -224,11 +224,18 @@ def _anchor_generator(ctx):
             base_h = np.round(base_w * ar)
             scale_w = s / stride[0]
             scale_h = s / stride[1]
-            half.append((scale_w * base_w / 2.0, scale_h * base_h / 2.0))
+            # pixel-inclusive extents: +/- (w-1)/2, not w/2
+            # (anchor_generator_op.h:74-81)
+            half.append(((scale_w * base_w - 1.0) / 2.0,
+                         (scale_h * base_h - 1.0) / 2.0))
     half = np.asarray(half, np.float32)
     A = len(half)
-    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
-    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    # centers at idx*stride + offset*(stride - 1) — the reference's
+    # pixel-grid convention, NOT (idx + offset)*stride
+    cx = jnp.arange(W, dtype=jnp.float32) * stride[0] + \
+        offset * (stride[0] - 1.0)
+    cy = jnp.arange(H, dtype=jnp.float32) * stride[1] + \
+        offset * (stride[1] - 1.0)
     cxg = jnp.broadcast_to(cx[None, :, None], (H, W, A))
     cyg = jnp.broadcast_to(cy[:, None, None], (H, W, A))
     hw = jnp.asarray(half[:, 0])[None, None, :]

@@ -287,10 +287,13 @@ def test_anchor_generator():
     a, v = _run(build, {"f": feat})
     a = np.asarray(a)
     assert a.shape == (2, 2, 2, 4)
-    # ar=1, size 32, stride 16: base 16x16 scaled by 2 -> 32x32 at center 8,8
-    np.testing.assert_allclose(a[0, 0, 0], [8 - 16, 8 - 16, 8 + 16, 8 + 16])
+    # reference pixel-grid convention (anchor_generator_op.h:55,:74):
+    # center = 0*16 + 0.5*(16-1) = 7.5; size-32 extents are
+    # +/-(32-1)/2 = +/-15.5 -> inclusive widths of 31
+    np.testing.assert_allclose(a[0, 0, 0], [7.5 - 15.5, 7.5 - 15.5,
+                                            7.5 + 15.5, 7.5 + 15.5])
     widths = a[..., 2] - a[..., 0]
-    assert set(np.unique(widths)) == {32.0, 64.0}
+    assert set(np.unique(widths)) == {31.0, 63.0}
 
 
 def test_generate_proposals_shapes():
@@ -804,3 +807,44 @@ def test_roi_pool_matches_reference_oracle():
     for k in range(R):
         np.testing.assert_allclose(got[k], ref_one(x[0], rois[0, k]),
                                    atol=1e-5, err_msg="roi %d" % k)
+
+
+def test_anchor_generator_matches_reference_oracle():
+    """anchor_generator_op.h restated: centers at idx*stride +
+    offset*(stride-1), extents +/-(w-1)/2, rounded base sizes,
+    ar-major anchor order."""
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import jax.numpy as jnp
+    H, W = 3, 4
+    sizes, ars, stride, offset = [32.0, 64.0], [0.5, 1.0, 2.0], \
+        [16.0, 16.0], 0.5
+    feat = np.zeros((1, 8, H, W), np.float32)
+
+    want = np.zeros((H, W, len(ars) * len(sizes), 4), np.float32)
+    for hi in range(H):
+        for wi in range(W):
+            xc = wi * stride[0] + offset * (stride[0] - 1)
+            yc = hi * stride[1] + offset * (stride[1] - 1)
+            idx = 0
+            for ar in ars:
+                for s in sizes:
+                    area = stride[0] * stride[1]
+                    base_w = round(np.sqrt(area / ar))
+                    base_h = round(base_w * ar)
+                    aw = s / stride[0] * base_w
+                    ah = s / stride[1] * base_h
+                    want[hi, wi, idx] = [xc - 0.5 * (aw - 1),
+                                         yc - 0.5 * (ah - 1),
+                                         xc + 0.5 * (aw - 1),
+                                         yc + 0.5 * (ah - 1)]
+                    idx += 1
+
+    class _Op:
+        type = "anchor_generator"
+        outputs = {}
+        attrs = {"anchor_sizes": sizes, "aspect_ratios": ars,
+                 "stride": stride, "offset": offset,
+                 "variances": [0.1, 0.1, 0.2, 0.2]}
+    vals = {"Input": [jnp.asarray(feat)]}
+    r = get_op_def("anchor_generator").lower(ExecContext(_Op(), vals))
+    np.testing.assert_allclose(np.asarray(r["Anchors"]), want, atol=1e-4)

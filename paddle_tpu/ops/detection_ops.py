@@ -660,10 +660,12 @@ def _generate_proposals(ctx):
             jnp.clip(boxes[:, 1], 0.0, imh - 1.0),
             jnp.clip(boxes[:, 2], 0.0, imw - 1.0),
             jnp.clip(boxes[:, 3], 0.0, imh - 1.0)], axis=1)
-        # filter boxes smaller than min_size (scaled by im scale info[2])
-        ms = jnp.maximum(min_size * info[2], 1.0)
-        keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1.0) >= ms) & \
-                  ((boxes[:, 3] - boxes[:, 1] + 1.0) >= ms)
+        # min-size filter in ORIGIN-image scale: width/im_scale + 1 >=
+        # max(min_size, 1) (generate_proposals_op.cc FilterBoxes:168-183;
+        # scaling min_size up instead diverges whenever im_scale != 1)
+        ms = jnp.maximum(min_size, 1.0)
+        keep_sz = (((boxes[:, 2] - boxes[:, 0]) / info[2] + 1.0) >= ms) & \
+                  (((boxes[:, 3] - boxes[:, 1]) / info[2] + 1.0) >= ms)
         keep = nms_mask(boxes, top_s, keep_sz, nms_thr, -1, normalized=False)
         sc_kept = jnp.where(keep, top_s, -jnp.inf)
         out_s, out_i = jax.lax.top_k(sc_kept, post_n)

@@ -46,8 +46,10 @@ def test_precision_recall_micro_macro():
     fn = np.float32([0, 0, 1])
     prec = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1e-12), 1.0)
     rec = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1e-12), 1.0)
-    f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-12)
-    macro = [prec.mean(), rec.mean(), f1.mean()]
+    # macro F1 is the F1 OF the macro-averaged P/R
+    # (precision_recall_op.h:144), not the mean of per-class F1s
+    mpr, mrc = prec.mean(), rec.mean()
+    macro = [mpr, mrc, 2 * mpr * mrc / (mpr + mrc)]
     stp, sfp, sfn = tp.sum(), fp.sum(), fn.sum()
     mp, mr = stp / (stp + sfp), stp / (stp + sfn)
     micro = [mp, mr, 2 * mp * mr / (mp + mr)]

@@ -96,6 +96,8 @@ class TruncatedNormalInitializer(Initializer):
 
 def _fan_in_out(var):
     shape = var.shape
+    if not shape:
+        return 1, 1
     if len(shape) == 1:
         return shape[0], shape[0]
     if len(shape) == 2:

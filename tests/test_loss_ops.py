@@ -922,3 +922,87 @@ def test_edit_distance_matches_levenshtein_oracle(normalized):
     got = np.asarray(r["Out"]).reshape(-1)
     np.testing.assert_allclose(got, want, atol=1e-5)
     assert int(np.asarray(r["SequenceNum"])[0]) == B
+
+
+def test_linear_chain_crf_bruteforce_oracle():
+    """Exact nll via path enumeration: logZ - score over ALL tag paths
+    (the reference's scaled forward algorithm computes the same
+    quantity, linear_chain_crf_op.h ll accumulation)."""
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import itertools
+    import jax.numpy as jnp
+    rng = np.random.RandomState(53)
+    B, T, D = 3, 4, 3
+    lens = np.array([4, 2, 3], np.int32)
+    e = rng.randn(B, T, D).astype(np.float32)
+    w = rng.randn(D + 2, D).astype(np.float32)
+    lab = rng.randint(0, D, (B, T, 1)).astype(np.int64)
+
+    start, end, pair = w[0], w[1], w[2:]
+    want = []
+    for b in range(B):
+        L = lens[b]
+        def path_score(path):
+            s = start[path[0]] + end[path[-1]]
+            for t, tag in enumerate(path):
+                s += e[b, t, tag]
+            for t in range(1, L):
+                s += pair[path[t - 1], path[t]]
+            return s
+        scores = [path_score(p)
+                  for p in itertools.product(range(D), repeat=int(L))]
+        m = max(scores)
+        log_z = m + np.log(sum(np.exp(s - m) for s in scores))
+        gold = path_score(tuple(lab[b, :L, 0]))
+        want.append(log_z - gold)
+
+    class _Op:
+        type = "linear_chain_crf"
+        outputs = {}
+        attrs = {}
+    vals = {"Emission": [jnp.asarray(e)],
+            "Emission@LOD_LEN": [jnp.asarray(lens)],
+            "Transition": [jnp.asarray(w)],
+            "Label": [jnp.asarray(lab)]}
+    r = get_op_def("linear_chain_crf").lower(ExecContext(_Op(), vals))
+    got = np.asarray(r["LogLikelihood"]).reshape(-1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_decoding_bruteforce_oracle():
+    """Viterbi path == brute-force argmax over all paths (ragged lens;
+    padded positions emit 0)."""
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import itertools
+    import jax.numpy as jnp
+    rng = np.random.RandomState(59)
+    B, T, D = 3, 4, 3
+    lens = np.array([4, 1, 3], np.int32)
+    e = rng.randn(B, T, D).astype(np.float32)
+    w = rng.randn(D + 2, D).astype(np.float32)
+    start, end, pair = w[0], w[1], w[2:]
+
+    want = np.zeros((B, T), np.int64)
+    for b in range(B):
+        L = int(lens[b])
+        best, best_p = -np.inf, None
+        for p in itertools.product(range(D), repeat=L):
+            s = start[p[0]] + end[p[-1]]
+            for t, tag in enumerate(p):
+                s += e[b, t, tag]
+            for t in range(1, L):
+                s += pair[p[t - 1], p[t]]
+            if s > best:
+                best, best_p = s, p
+        want[b, :L] = best_p
+
+    class _Op:
+        type = "crf_decoding"
+        outputs = {}
+        attrs = {}
+    vals = {"Emission": [jnp.asarray(e)],
+            "Emission@LOD_LEN": [jnp.asarray(lens)],
+            "Transition": [jnp.asarray(w)]}
+    r = get_op_def("crf_decoding").lower(ExecContext(_Op(), vals))
+    got = np.asarray(r["ViterbiPath"]).reshape(B, T)
+    np.testing.assert_array_equal(got, want)

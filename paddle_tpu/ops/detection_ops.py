@@ -220,8 +220,11 @@ def _anchor_generator(ctx):
         for s in sizes:
             area = stride[0] * stride[1]
             area_ratios = area / ar
-            base_w = np.round(np.sqrt(area_ratios))
-            base_h = np.round(base_w * ar)
+            # C round() is half-away-from-zero (same fix as roi_pool);
+            # np.round's half-to-even gives 22 for 22.5 where the
+            # reference gives 23
+            base_w = np.floor(np.sqrt(area_ratios) + 0.5)
+            base_h = np.floor(base_w * ar + 0.5)
             scale_w = s / stride[0]
             scale_h = s / stride[1]
             # pixel-inclusive extents: +/- (w-1)/2, not w/2

@@ -816,8 +816,8 @@ def test_anchor_generator_matches_reference_oracle():
     from paddle_tpu.ops.registry import get_op_def, ExecContext
     import jax.numpy as jnp
     H, W = 3, 4
-    sizes, ars, stride, offset = [32.0, 64.0], [0.5, 1.0, 2.0], \
-        [16.0, 16.0], 0.5
+    sizes, ars, stride, offset = [32.0, 64.0], [0.5, 1.0, 1.5, 2.0], \
+        [18.0, 18.0], 0.5
     feat = np.zeros((1, 8, H, W), np.float32)
 
     want = np.zeros((H, W, len(ars) * len(sizes), 4), np.float32)
@@ -829,8 +829,10 @@ def test_anchor_generator_matches_reference_oracle():
             for ar in ars:
                 for s in sizes:
                     area = stride[0] * stride[1]
-                    base_w = round(np.sqrt(area / ar))
-                    base_h = round(base_w * ar)
+                    # C round(): half-away-from-zero (python round()
+                    # is half-to-even and would hide the divergence)
+                    base_w = np.floor(np.sqrt(area / ar) + 0.5)
+                    base_h = np.floor(base_w * ar + 0.5)
                     aw = s / stride[0] * base_w
                     ah = s / stride[1] * base_h
                     want[hi, wi, idx] = [xc - 0.5 * (aw - 1),

@@ -4,15 +4,13 @@ save_inference_model + AnalysisPredictor + AOT export — the user-surface
 drive for the round-5 detection parity fixes (conftest forces the CPU
 mesh)."""
 
-import tempfile
-
 import numpy as np
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid.lod import create_lod_tensor
 
 
-def test_ssd_train_serve_aot_pipeline():
+def test_ssd_train_serve_aot_pipeline(tmp_path):
     rng = np.random.RandomState(6)
     N, C = 4, 5
 
@@ -61,7 +59,7 @@ def test_ssd_train_serve_aot_pipeline():
         print("ssd train: loss %.4f -> %.4f" % (losses[0], losses[-1]))
 
         # ---- serve: save_inference_model -> predictor -> AOT ----
-        md = tempfile.mkdtemp()
+        md = str(tmp_path / "model")
         infer_prog = main.clone(for_test=True)
         fluid.save_inference_model(md, ["img"], [nmsed], exe,
                                    main_program=infer_prog)
@@ -76,7 +74,7 @@ def test_ssd_train_serve_aot_pipeline():
         assert np.all(valid[:, 1] >= 0.0) and np.all(valid[:, 1] <= 1.0)
         print("serving: %d detections across %d images, shape %s"
               % (len(valid), N, det.shape))
-        ad = md + "_aot"
+        ad = str(tmp_path / "aot")
         pred.save_aot(ad, batch_sizes=(N,))
         out2 = load_aot_predictor(ad).run({"img": feed["img"]})
         np.testing.assert_allclose(np.asarray(out2[0]), det, atol=1e-5)

@@ -162,23 +162,26 @@ def _density_prior_box(ctx):
     densities = [int(d) for d in ctx.attr("densities", [])]
     variances = [float(v) for v in
                  ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])]
-    clip = bool(ctx.attr("clip", False))
     offset = float(ctx.attr("offset", 0.5))
     step_w = float(ctx.attr("step_w", 0.0) or 0.0) or im_w / float(W)
     step_h = float(ctx.attr("step_h", 0.0) or 0.0) or im_h / float(H)
 
-    # per-cell offsets/sizes computed in numpy (static), broadcast on device
+    # per-cell offsets/sizes computed in numpy (static), broadcast on
+    # device. The density grid spans STEP_AVERAGE (integer), shifted by
+    # the integer quotient step_average // density — not the fixed_size
+    # (density_prior_box_op.h:67,:82-90; r5 audit)
+    step_average = int((step_w + step_h) * 0.5)
     offs = []  # (dx, dy, w/2, h/2) relative to cell center
     for k, fs in enumerate(fixed_sizes):
         d = densities[k]
-        shift = fs / d
+        shift = step_average // d
         for ar in fixed_ratios:
             bw = fs * np.sqrt(ar)
             bh = fs / np.sqrt(ar)
             for di in range(d):
                 for dj in range(d):
-                    dx = -fs / 2.0 + shift / 2.0 + dj * shift
-                    dy = -fs / 2.0 + shift / 2.0 + di * shift
+                    dx = -step_average / 2.0 + shift / 2.0 + dj * shift
+                    dy = -step_average / 2.0 + shift / 2.0 + di * shift
                     offs.append((dx, dy, bw / 2.0, bh / 2.0))
     offs = np.asarray(offs, np.float32)   # [P, 4]
     P = len(offs)
@@ -194,8 +197,9 @@ def _density_prior_box(ctx):
     hh = jnp.broadcast_to(jnp.asarray(offs[:, 3])[None, None, :], (H, W, P))
     boxes = jnp.stack([(cxg - hw) / im_w, (cyg - hh) / im_h,
                        (cxg + hw) / im_w, (cyg + hh) / im_h], axis=-1)
-    if clip:
-        boxes = jnp.clip(boxes, 0.0, 1.0)
+    # the reference clamps density boxes to [0,1] UNCONDITIONALLY
+    # (density_prior_box_op.h:92-105 ternaries), independent of `clip`
+    boxes = jnp.clip(boxes, 0.0, 1.0)
     var = jnp.broadcast_to(jnp.asarray(variances, boxes.dtype),
                            (H, W, P, 4))
     return {"Boxes": boxes, "Variances": var}

@@ -850,3 +850,52 @@ def test_anchor_generator_matches_reference_oracle():
     vals = {"Input": [jnp.asarray(feat)]}
     r = get_op_def("anchor_generator").lower(ExecContext(_Op(), vals))
     np.testing.assert_allclose(np.asarray(r["Anchors"]), want, atol=1e-4)
+
+
+def test_density_prior_box_matches_reference_oracle():
+    """density_prior_box_op.h restated: integer step_average window,
+    integer shift quotient, unconditional [0,1] clamp."""
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import jax.numpy as jnp
+    H, W, im_h, im_w = 3, 3, 24, 24
+    fixed_sizes, fixed_ratios, densities = [8.0, 4.0], [1.0, 2.0], [2, 3]
+    offset = 0.5
+    step_w, step_h = im_w / W, im_h / H
+    step_avg = int((step_w + step_h) * 0.5)
+
+    P = sum(len(fixed_ratios) * d * d for d in densities)
+    want = np.zeros((H, W, P, 4), np.float32)
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            idx = 0
+            for fs, d in zip(fixed_sizes, densities):
+                shift = step_avg // d
+                for ar in fixed_ratios:
+                    bw = fs * np.sqrt(ar)
+                    bh = fs / np.sqrt(ar)
+                    for di in range(d):
+                        for dj in range(d):
+                            cxt = cx - step_avg / 2. + shift / 2. + \
+                                dj * shift
+                            cyt = cy - step_avg / 2. + shift / 2. + \
+                                di * shift
+                            want[h, w, idx] = [
+                                max((cxt - bw / 2.) / im_w, 0),
+                                max((cyt - bh / 2.) / im_h, 0),
+                                min((cxt + bw / 2.) / im_w, 1),
+                                min((cyt + bh / 2.) / im_h, 1)]
+                            idx += 1
+
+    class _Op:
+        type = "density_prior_box"
+        outputs = {}
+        attrs = {"fixed_sizes": fixed_sizes, "fixed_ratios": fixed_ratios,
+                 "densities": densities, "offset": offset,
+                 "variances": [0.1, 0.1, 0.2, 0.2], "clip": False}
+    vals = {"Input": [jnp.asarray(np.zeros((1, 4, H, W), np.float32))],
+            "Image": [jnp.asarray(np.zeros((1, 3, im_h, im_w),
+                                           np.float32))]}
+    r = get_op_def("density_prior_box").lower(ExecContext(_Op(), vals))
+    np.testing.assert_allclose(np.asarray(r["Boxes"]), want, atol=1e-5)

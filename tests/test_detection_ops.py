@@ -899,3 +899,61 @@ def test_density_prior_box_matches_reference_oracle():
                                            np.float32))]}
     r = get_op_def("density_prior_box").lower(ExecContext(_Op(), vals))
     np.testing.assert_allclose(np.asarray(r["Boxes"]), want, atol=1e-5)
+
+
+@pytest.mark.parametrize("min_max_order", [False, True])
+def test_prior_box_matches_reference_oracle(min_max_order):
+    """prior_box_op.h restated full-grid (ExpandAspectRatios + both
+    emission orders + clip)."""
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import jax.numpy as jnp
+    H, W, im_h, im_w = 2, 3, 24, 24
+    min_sizes, max_sizes = [4.0, 8.0], [8.0, 16.0]
+    in_ars, flip, offset, clip = [1.0, 2.0], True, 0.5, True
+    step_w, step_h = im_w / W, im_h / H
+
+    ars = [1.0]
+    for ar in in_ars:
+        if all(abs(ar - e) > 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    ars = [a for i, a in enumerate(ars)
+           if all(abs(a - b) > 1e-6 for b in ars[:i])]
+
+    rows = []
+    for s, ms in enumerate(min_sizes):
+        if min_max_order:
+            rows.append((ms / 2., ms / 2.))
+            rows.append((np.sqrt(ms * max_sizes[s]) / 2.,) * 2)
+            for ar in ars:
+                if abs(ar - 1.) < 1e-6:
+                    continue
+                rows.append((ms * np.sqrt(ar) / 2., ms / np.sqrt(ar) / 2.))
+        else:
+            for ar in ars:
+                rows.append((ms * np.sqrt(ar) / 2., ms / np.sqrt(ar) / 2.))
+            rows.append((np.sqrt(ms * max_sizes[s]) / 2.,) * 2)
+    P = len(rows)
+    want = np.zeros((H, W, P, 4), np.float32)
+    for h in range(H):
+        for w in range(W):
+            cx, cy = (w + offset) * step_w, (h + offset) * step_h
+            for i, (bw, bh) in enumerate(rows):
+                want[h, w, i] = [(cx - bw) / im_w, (cy - bh) / im_h,
+                                 (cx + bw) / im_w, (cy + bh) / im_h]
+    want = np.clip(want, 0.0, 1.0)
+
+    class _Op:
+        type = "prior_box"
+        outputs = {}
+        attrs = {"min_sizes": min_sizes, "max_sizes": max_sizes,
+                 "aspect_ratios": in_ars, "flip": flip, "clip": clip,
+                 "offset": offset,
+                 "min_max_aspect_ratios_order": min_max_order,
+                 "variances": [0.1, 0.1, 0.2, 0.2]}
+    vals = {"Input": [jnp.asarray(np.zeros((1, 4, H, W), np.float32))],
+            "Image": [jnp.asarray(np.zeros((1, 3, im_h, im_w),
+                                           np.float32))]}
+    r = get_op_def("prior_box").lower(ExecContext(_Op(), vals))
+    np.testing.assert_allclose(np.asarray(r["Boxes"]), want, atol=1e-5)

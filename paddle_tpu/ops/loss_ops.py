@@ -246,8 +246,13 @@ def _warpctc(ctx):
     ll = jnp.where(lab_lens > 0, jnp.logaddexp(at_last, at_prev), at_last)
     loss = -ll[:, None]
     if ctx.attr("norm_by_times", False):
-        loss = loss / jnp.maximum(in_lens, 1).astype(
-            jnp.float32)[:, None]
+        # the reference scales only the GRADIENT by 1/T (warpctc_op.h
+        # grad kernel UnpaddingLoDTensorFunctor norm_by_times); the Loss
+        # output stays raw. value = raw, d/dlogits = raw_grad / T:
+        import jax
+        t = jnp.maximum(in_lens, 1).astype(jnp.float32)[:, None]
+        normed = loss / t
+        loss = jax.lax.stop_gradient(loss - normed) + normed
     return {"Loss": loss.astype(logits.dtype)}
 
 

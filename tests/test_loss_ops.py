@@ -1006,3 +1006,39 @@ def test_crf_decoding_bruteforce_oracle():
     r = get_op_def("crf_decoding").lower(ExecContext(_Op(), vals))
     got = np.asarray(r["ViterbiPath"]).reshape(B, T)
     np.testing.assert_array_equal(got, want)
+
+
+def test_warpctc_norm_by_times_scales_grad_not_loss():
+    """warpctc_op.h: norm_by_times scales the GRADIENT by 1/T in the
+    grad kernel; the Loss output stays raw."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    rng = np.random.RandomState(61)
+    B, T, D = 2, 6, 5
+    logits = rng.randn(B, T, D).astype(np.float32)
+    labels = rng.randint(1, D, (B, 3)).astype(np.int32)
+    in_lens = np.array([6, 4], np.int32)
+    lab_lens = np.array([3, 2], np.int32)
+
+    def run(x, norm):
+        class _Op:
+            type = "warpctc"
+            outputs = {}
+            attrs = {"norm_by_times": norm, "blank": 0}
+        vals = {"Logits": [x], "Label": [jnp.asarray(labels)],
+                "Logits@LOD_LEN": [jnp.asarray(in_lens)],
+                "Label@LOD_LEN": [jnp.asarray(lab_lens)]}
+        return get_op_def("warpctc").lower(
+            ExecContext(_Op(), vals))["Loss"]
+
+    raw = np.asarray(run(jnp.asarray(logits), False))
+    normed = np.asarray(run(jnp.asarray(logits), True))
+    np.testing.assert_allclose(normed, raw, atol=1e-5)   # value unscaled
+
+    g_raw = jax.grad(lambda x: jnp.sum(run(x, False)))(jnp.asarray(logits))
+    g_norm = jax.grad(lambda x: jnp.sum(run(x, True)))(jnp.asarray(logits))
+    for b in range(B):
+        np.testing.assert_allclose(np.asarray(g_norm[b]),
+                                   np.asarray(g_raw[b]) / in_lens[b],
+                                   atol=1e-6)

@@ -431,12 +431,17 @@ def _lrn(ctx):
     alpha = ctx.attr("alpha", 1e-4)
     beta = ctx.attr("beta", 0.75)
     sq = jnp.square(x)
-    half = n // 2
+    # channel window [c - (n-1)//2, c + n-1 - (n-1)//2] — asymmetric
+    # for even n (lrn_op.cc pre_pad = (n-1)/2)
+    pre = (n - 1) // 2
     acc = jax.lax.reduce_window(
         sq, np.asarray(0, x.dtype), jax.lax.add,
-        (1, n, 1, 1), (1, 1, 1, 1), ((0, 0), (half, half), (0, 0), (0, 0)))
-    mid = (k + alpha * acc) ** beta
-    return {"Out": x / mid, "MidOut": mid}
+        (1, n, 1, 1), (1, 1, 1, 1),
+        ((0, 0), (pre, n - 1 - pre), (0, 0), (0, 0)))
+    # MidOut is the PRE-power scale k + alpha*sum (the reference's grad
+    # kernel consumes it in that form); the power lives only in Out
+    mid = k + alpha * acc
+    return {"Out": x * mid ** (-beta), "MidOut": mid}
 
 
 # ---------------------------------------------------------------------------

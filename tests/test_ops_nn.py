@@ -198,3 +198,31 @@ class TestGather(OpTest):
 
     def test_output(self):
         self.check_output()
+
+
+def test_lrn_matches_reference_oracle():
+    """lrn_op.cc restated: window [c-(n-1)//2, c+n-1-(n-1)//2], MidOut
+    is the pre-power scale, Out = x * mid^-beta."""
+    from paddle_tpu.ops.registry import get_op_def, ExecContext
+    import jax.numpy as jnp
+    rng = np.random.RandomState(67)
+    N, C, H, W = 2, 7, 3, 3
+    n, k, alpha, beta = 5, 2.0, 1e-2, 0.75
+    x = rng.randn(N, C, H, W).astype(np.float32)
+
+    sq = x ** 2
+    mid = np.full_like(x, k)
+    pre = (n - 1) // 2
+    for c in range(C):
+        lo, hi = c - pre, c - pre + n
+        for cc in range(max(lo, 0), min(hi, C)):
+            mid[:, c] += alpha * sq[:, cc]
+    want_out = x * mid ** (-beta)
+
+    class _Op:
+        type = "lrn"
+        outputs = {}
+        attrs = {"n": n, "k": k, "alpha": alpha, "beta": beta}
+    r = get_op_def("lrn").lower(ExecContext(_Op(), {"X": [jnp.asarray(x)]}))
+    np.testing.assert_allclose(np.asarray(r["MidOut"]), mid, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(r["Out"]), want_out, atol=1e-5)

@@ -62,6 +62,15 @@ def parse_args():
                    help="fetch loss (host sync) every N steps; 1 = the "
                         "reference's per-step methodology, >1 lets async "
                         "dispatch pipeline the steps between fetches")
+    p.add_argument("--staged_feed", type=int, default=0,
+                   help="pre-stage K synthetic batches on device before "
+                        "the timed loop and cycle through them (bench.py "
+                        "flagship methodology). Measures the training "
+                        "step with host->device transfer amortized away; "
+                        "essential when the chip sits behind a slow "
+                        "relay whose feed bandwidth would otherwise "
+                        "dominate every step. 0 = per-step host feed "
+                        "(reference fluid_benchmark methodology)")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--use_fake_data", action="store_true", default=True)
     p.add_argument("--whole_graph_ad", action="store_true",
@@ -199,6 +208,36 @@ def main():
             use_cuda=False, loss_name=loss.name, main_program=main_prog)
 
     fetch = [loss.name] + ([acc.name] if acc is not None else [])
+
+    staged = None
+    if args.staged_feed > 0:
+        # Pre-stage K distinct batches on device and fence the transfers
+        # so none of the H2D cost lands inside the timed window. Passing
+        # the prepared dict back through Executor.run is safe: its
+        # prepare_feeds keeps jax.Array values as-is (the PyReader
+        # double-buffer fast path). The ParallelExecutor commits shards
+        # itself, so for --parallel the staging only amortizes batch
+        # *generation*, not the transfer.
+        from paddle_tpu.fluid.executor import prepare_feeds
+        staged = [prepare_feeds(main_prog,
+                                synth_feed(feeds, batch, rng,
+                                           program=main_prog),
+                                device_put=(pe is None))
+                  for _ in range(args.staged_feed)]
+        jax.block_until_ready([a for d in staged for a in d.values()
+                               if isinstance(a, jax.Array)])
+        # through the axon relay block_until_ready alone does not
+        # reliably fence remote execution (bench.py's measured finding);
+        # force one host round-trip per staged dict so no H2D transfer
+        # can leak into the profiler window or the timed region
+        for d in staged:
+            for a in d.values():
+                if isinstance(a, jax.Array):
+                    np.asarray(a.ravel()[:1])
+                    break
+
+    # staging completes BEFORE the profiler window opens so the fenced
+    # H2D transfers are excluded from the trace the flag exists to clean
     if args.profile:
         prof.start_profiler("All")
 
@@ -211,7 +250,8 @@ def main():
         # (including jit compile when n_warm == 0) is in the denominator
         if i == n_warm:
             t0 = time.perf_counter()
-        feed = synth_feed(feeds, batch, rng, program=main_prog)
+        feed = (staged[i % len(staged)] if staged
+                else synth_feed(feeds, batch, rng, program=main_prog))
         # --fetch_every N: fetch (= host sync) only every Nth step and on
         # the last, letting XLA's async dispatch pipeline the steps in
         # between. Default 1 keeps the reference methodology (the
@@ -278,6 +318,13 @@ def main():
         "update_method": args.update_method,
         **({"device_loop": args.device_loop}
            if args.device_loop > 0 else {}),
+        # staged_transfer says whether staging actually amortized the
+        # H2D transfer: the ParallelExecutor re-commits shards from host
+        # per step, so a --parallel run's staging only amortizes batch
+        # generation and its record must not read as a framework number
+        **({"staged_feed": args.staged_feed,
+            "staged_transfer": pe is None}
+           if args.staged_feed > 0 else {}),
         "whole_graph_ad": bool(args.whole_graph_ad or args.remat_policy),
         "remat_policy": args.remat_policy,
         # only models that honor --layout get the field; recording it

@@ -164,6 +164,15 @@ DEFINE_string(
     "Rematerialization policy for whole_graph_ad: '' (save everything), "
     "'conv_out' (keep conv outputs, recompute BN/activation tails — "
     "ROOFLINE.md's remat lever), 'dots', or 'nothing'.")
+DEFINE_int(
+    "fuse_bottleneck_max_width", 128,
+    "FuseBottleneckPass fuses only bottlenecks whose width F (the 3x3 "
+    "conv's channel count) is <= this. The r05 chip sweep "
+    "(BENCH_recovery_r05.json tune_bottleneck stages) measured the "
+    "Pallas kernel beating XLA at F=64 (+12%) and F=128, and losing at "
+    "F=256/512 where per-conv XLA scheduling wins — fusing everything "
+    "made inference net-SLOWER. 0 disables fusion; a large value "
+    "restores fuse-all for experiments.")
 DEFINE_bool(
     "cpu_deterministic", False,
     "Prefer deterministic reduction order (reference FLAGS_cpu_deterministic, "

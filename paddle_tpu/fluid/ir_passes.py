@@ -549,6 +549,14 @@ class FuseBottleneckPass(Pass):
         F, C = f0[0], f0[1]
         if f1[:2] != (F, F) or f2[1] != F:
             return False
+        # measured-geometry gate: the Pallas kernel wins only for
+        # narrow bottlenecks (chip sweep BENCH_recovery_r05.json,
+        # tune_bottleneck: F=64 +12% vs XLA, F=128 parity-plus,
+        # F=256/512 LOSE). Fusing the losing geometries made the whole
+        # inference graph slower, so wide blocks stay with XLA.
+        from paddle_tpu.flags import FLAGS
+        if F > FLAGS.fuse_bottleneck_max_width:
+            return False
         C4 = f2[0]
         if branch:
             fs = self._filter_shape(blk, m["convs"])

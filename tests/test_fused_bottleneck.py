@@ -126,6 +126,42 @@ def test_transpiler_fuses_nhwc_blocks(layout):
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
 
 
+def test_wide_bottleneck_declines_fusion():
+    """Measured-geometry gate: the r05 chip sweep (tune_bottleneck
+    stages in BENCH_recovery_r05.json) showed the Pallas kernel LOSES
+    to XLA for wide bottlenecks (F=256/512), so the pass must fuse only
+    blocks with F <= FLAGS.fuse_bottleneck_max_width and leave wide
+    ones (numerically intact) to XLA."""
+    from paddle_tpu.flags import set_flags, get_flags
+    main, startup, out = _build_resnet_tail("NHWC")   # width F = 8
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(11)
+    x = rng.randn(4, 8, 8, 16).astype(np.float32)
+    old = get_flags("fuse_bottleneck_max_width")
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            want, = exe.run(main, feed={"img": x}, fetch_list=[out.name])
+            from paddle_tpu.fluid.transpiler import InferenceTranspiler
+            # cap below this model's width: nothing may fuse
+            set_flags({"fuse_bottleneck_max_width": 4})
+            infer = main.clone(for_test=True)
+            InferenceTranspiler().transpile(infer, scope=scope)
+            types = [op.type for op in infer.global_block().ops]
+            assert "fused_bottleneck" not in types, types
+            got, = exe.run(infer, feed={"img": x}, fetch_list=[out.name])
+            np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+            # cap at the width: both blocks fuse again
+            set_flags({"fuse_bottleneck_max_width": 8})
+            infer2 = main.clone(for_test=True)
+            InferenceTranspiler().transpile(infer2, scope=scope)
+            types2 = [op.type for op in infer2.global_block().ops]
+            assert types2.count("fused_bottleneck") == 2, types2
+    finally:
+        set_flags(old)
+
+
 def test_nhwc_bn_fold_bias_axis():
     # regression: the folded BN bias add must broadcast over the channel
     # axis of the conv's layout — for NHWC that is the trailing dim, and

@@ -42,6 +42,14 @@ def main():
     ap.add_argument("--bf16", type=int, default=1,
                     help="cast params + input to bf16 (TPU-idiomatic "
                          "serving precision)")
+    ap.add_argument("--staged_feed", type=int, default=1,
+                    help="stage the input batch on device once and "
+                         "reuse it (default): measures the serving "
+                         "computation rather than the axon relay's "
+                         "~20 MB/s host link. 0 = per-request H2D "
+                         "(the realistic serving path on LOCAL "
+                         "hardware; behind the relay it times the "
+                         "tunnel)")
     args = ap.parse_args()
 
     from bench import init_backend
@@ -99,6 +107,13 @@ def main():
             # var's dtype, so a bf16 array fed at a float32 var would be
             # silently cast BACK to fp32
             main_prog.global_block().var("data").dtype = "bfloat16"
+        if args.staged_feed:
+            # one H2D, reused every request (Executor's prepare_feeds
+            # keeps jax.Array feeds as-is); host round-trip fences the
+            # transfer out of the timed window — block_until_ready does
+            # not reliably fence over the relay (bench.py's finding)
+            feed_x = jax.device_put(feed_x)
+            np.asarray(feed_x.ravel()[:1])
 
         infer = main_prog.clone(for_test=True)._prune(["data"],
                                                       [pred.name])
@@ -116,7 +131,8 @@ def main():
         results.append({"metric": "resnet50_infer_images_per_sec_per_chip",
                         "variant": "unfused", "value": round(v, 2),
                         "unit": "images/sec", "batch": batch,
-                        "fused_blocks": 0})
+                        "fused_blocks": 0,
+                        "staged_feed": bool(args.staged_feed)})
 
         fused = unfused.clone(for_test=True)
         from paddle_tpu.fluid.ir_passes import apply_passes
@@ -127,7 +143,8 @@ def main():
         results.append({"metric": "resnet50_infer_images_per_sec_per_chip",
                         "variant": "fused", "value": round(v, 2),
                         "unit": "images/sec", "batch": batch,
-                        "fused_blocks": nf})
+                        "fused_blocks": nf,
+                        "staged_feed": bool(args.staged_feed)})
 
     for rec in results:
         if backend_label:

@@ -165,14 +165,16 @@ DEFINE_string(
     "'conv_out' (keep conv outputs, recompute BN/activation tails — "
     "ROOFLINE.md's remat lever), 'dots', or 'nothing'.")
 DEFINE_int(
-    "fuse_bottleneck_max_width", 128,
+    "fuse_bottleneck_max_width", 0,
     "FuseBottleneckPass fuses only bottlenecks whose width F (the 3x3 "
-    "conv's channel count) is <= this. The r05 chip sweep "
-    "(BENCH_recovery_r05.json tune_bottleneck stages) measured the "
-    "Pallas kernel beating XLA at F=64 (+12%) and F=128, and losing at "
-    "F=256/512 where per-conv XLA scheduling wins — fusing everything "
-    "made inference net-SLOWER. 0 disables fusion; a large value "
-    "restores fuse-all for experiments.")
+    "conv's channel count) is <= this; 0 (default) disables the pass. "
+    "The r05 chip measurements set this default: standalone, the Pallas "
+    "kernel beats XLA at F=64 (+12%) and F=128 (tune_bottleneck stages, "
+    "BENCH_recovery_r05.json), but IN-GRAPH the custom-call boundary "
+    "around each fused block costs more than the kernel saves — "
+    "end-to-end ResNet-50 serving measured slower at every gate "
+    "(F<=128, 7 blocks: 1354 vs 1599 img/s; F<=64, 3 blocks: 1526 vs "
+    "1584; fuse-all was worst). Set a width to opt in for experiments.")
 DEFINE_bool(
     "cpu_deterministic", False,
     "Prefer deterministic reduction order (reference FLAGS_cpu_deterministic, "

@@ -41,6 +41,8 @@ CONFIGS = [
       "--device_loop", "10"], 256, 8),
     ("mnist_cnn_deviceloop", ["--model", "mnist", "--device_loop", "10"],
      512, 64),
+    ("transformer_deviceloop",
+     ["--model", "transformer", "--device_loop", "10"], 32, 2),
     ("stacked_dynamic_lstm_deviceloop",
      ["--model", "stacked_dynamic_lstm", "--device_loop", "10"], 64, 8),
     ("machine_translation_wmt", ["--model", "machine_translation"], 16, 4),

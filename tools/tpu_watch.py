@@ -111,6 +111,12 @@ def main():
          {"BENCH_REMAT": "1", "BENCH_REMAT_POLICY": "block_out"}, 1800),
         ("zoo", ["tools/bench_zoo.py", "--out", "BENCH_zoo_r05.json",
                  "--require_tpu", "--resume"], {}, 14400),
+        # device-staged pass: the framework numbers (per-step host
+        # feeds above time the ~20 MB/s relay; both sets are kept,
+        # records self-describe via staged_feed)
+        ("zoo_staged", ["tools/bench_zoo.py", "--out",
+                        "BENCH_zoo_r05.json", "--require_tpu",
+                        "--resume", "--staged", "4"], {}, 14400),
         ("infer", ["tools/bench_infer.py", "--require_tpu"], {}, 1800),
         ("convergence", ["tools/convergence_run.py", "--require_tpu"],
          {}, 3600),

@@ -153,18 +153,27 @@ def check_fused_serving():
             pred = resnet_imagenet(img, class_dim=1000, depth=50,
                                    is_train=False, layout="NHWC")
     scope = fluid.Scope()
-    with fluid.scope_guard(scope):
-        fluid.Executor(fluid.CPUPlace()).run(startup)
-        infer = main_prog.clone(for_test=True)._prune(["data"],
-                                                      [pred.name])
-        from paddle_tpu.fluid.transpiler import InferenceTranspiler
-        InferenceTranspiler().transpile(infer, scope=scope)
-        n_fused = sum(1 for op in infer.global_block().ops
-                      if op.type == "fused_bottleneck")
-        assert n_fused == 16, n_fused
-        sn = tuple(functionalizer.persistable_names(infer))
-        state = {n: scope.get(n) for n in sn
-                 if scope.get(n) is not None}
+    from paddle_tpu.flags import set_flags, get_flags
+    old_width = get_flags("fuse_bottleneck_max_width")
+    try:
+        with fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(startup)
+            infer = main_prog.clone(for_test=True)._prune(["data"],
+                                                          [pred.name])
+            from paddle_tpu.fluid.transpiler import InferenceTranspiler
+            # fusion defaults OFF (measured slower end-to-end,
+            # ROOFLINE.md); this check validates the OPT-IN path still
+            # lowers every geometry through Mosaic, so fuse-all
+            set_flags({"fuse_bottleneck_max_width": 1 << 30})
+            InferenceTranspiler().transpile(infer, scope=scope)
+            n_fused = sum(1 for op in infer.global_block().ops
+                          if op.type == "fused_bottleneck")
+            assert n_fused == 16, n_fused
+    finally:
+        set_flags(old_width)
+    sn = tuple(functionalizer.persistable_names(infer))
+    state = {n: scope.get(n) for n in sn
+             if scope.get(n) is not None}
     step_fn = functionalizer.build_step_fn(
         infer, ("data",), (pred.name,), tuple(state.keys()))
     exp = functionalizer.export_step_for_tpu(

@@ -194,19 +194,54 @@ def _adaptive_pool2d_masked(x, bins_h, bins_w, ptype):
     return jnp.sum(big, axis=(4, 5)) / counts[None, None]
 
 
+def _spp_level_bounds(size, bins):
+    """spp_op.h level geometry: kernel = ceil(size/bins), stride =
+    kernel, symmetric padding (kernel*bins - size + 1)/2, windows
+    clipped to the input (math/pooling.cc) — NOT adaptive integer
+    bins; the partitions differ whenever size % bins != 0."""
+    k = -(-size // bins)
+    p = (k * bins - size + 1) // 2
+    starts = [max(i * k - p, 0) for i in range(bins)]
+    ends = [min(i * k - p + k, size) for i in range(bins)]
+    return starts, ends
+
+
 @register_op("spp")
 def _spp(ctx):
-    """Spatial pyramid pooling (spp_op.cc): levels 0..pyramid_height-1,
-    each adaptively pooled to 2^l x 2^l and flattened, concat over levels."""
+    """Spatial pyramid pooling (spp_op.h): levels 0..pyramid_height-1,
+    each pooled to 2^l x 2^l on the reference's ceil-kernel grid and
+    flattened, concat over levels; avg is exclusive (clipped-window
+    counts). Pinned by tests/test_spp_oracle.py. Documented deviation:
+    the reference grid can produce EMPTY edge windows (pad >= remaining
+    extent, e.g. H=5 at bins=4) which its kernel fills with accumulator
+    initials (-FLT_MAX / 0-divided-by-0); this lowering emits -inf/NaN
+    sentinels there instead."""
     jnp = _jnp()
     x = ctx.input("X")
     height = int(ctx.attr("pyramid_height", 1))
     ptype = ctx.attr("pooling_type", "max")
-    N = x.shape[0]
+    N, _, H, W = x.shape
+    hi = jnp.arange(H)
+    wi = jnp.arange(W)
     outs = []
     for l in range(height):
         bins = 2 ** l
-        p = _adaptive_pool2d_masked(x, bins, bins, ptype)
+        hs, he = _spp_level_bounds(H, bins)
+        ws, we = _spp_level_bounds(W, bins)
+        hmask = (hi[None, :] >= np.asarray(hs)[:, None]) & \
+                (hi[None, :] < np.asarray(he)[:, None])      # [bins, H]
+        wmask = (wi[None, :] >= np.asarray(ws)[:, None]) & \
+                (wi[None, :] < np.asarray(we)[:, None])      # [bins, W]
+        m = hmask[:, None, :, None] & wmask[None, :, None, :]
+        xb = x[:, :, None, None, :, :]                       # [N,C,1,1,H,W]
+        if ptype == "max":
+            big = jnp.where(m[None, None], xb,
+                            jnp.asarray(-np.inf, x.dtype))
+            p = jnp.max(big, axis=(4, 5))
+        else:
+            big = jnp.where(m[None, None], xb, jnp.asarray(0, x.dtype))
+            counts = m.sum(axis=(2, 3)).astype(x.dtype)
+            p = jnp.sum(big, axis=(4, 5)) / counts[None, None]
         outs.append(p.reshape(N, -1))
     return {"Out": jnp.concatenate(outs, axis=1)}
 

@@ -1,0 +1,78 @@
+"""spp reference-kernel oracle (spp_op.h restated).
+
+The reference does NOT use adaptive integer-boundary bins: each pyramid
+level pools with kernel = ceil(H/bins), stride = kernel and symmetric
+padding (kernel*bins - H + 1)/2, windows clipped to the input
+(math/pooling.cc Pool2dFunctor), avg in EXCLUSIVE mode (divide by the
+clipped window count). The partitions differ from adaptive binning
+whenever H or W is not a multiple of 2^level — this oracle pins the
+reference grid on non-divisible sizes.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+
+
+def _run(build_fn, feed):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        fetches = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    res = exe.run(main, feed=feed, fetch_list=list(fetches))
+    return [np.asarray(r) for r in res]
+
+
+def spp_oracle(x, pyramid_height, ptype):
+    """spp_op.h: per level, pool with kernel=ceil(H/bins) stride=kernel
+    pad=(kernel*bins-H+1)/2 over clipped windows, then flatten+concat."""
+    N, C, H, W = x.shape
+    levels = []
+    for p in range(pyramid_height):
+        bins = 2 ** p
+        kh = -(-H // bins)
+        kw = -(-W // bins)
+        ph = (kh * bins - H + 1) // 2
+        pw = (kw * bins - W + 1) // 2
+        out = np.zeros((N, C, bins, bins), x.dtype)
+        for i in range(bins):
+            hs, he = max(i * kh - ph, 0), min(i * kh - ph + kh, H)
+            for j in range(bins):
+                ws, we = max(j * kw - pw, 0), min(j * kw - pw + kw, W)
+                win = x[:, :, hs:he, ws:we]
+                if win.size == 0:
+                    # the reference grid CAN produce empty edge windows
+                    # (pad >= remaining extent, e.g. H=5 at bins=4); the
+                    # reference kernel then emits its accumulator
+                    # initial (-FLT_MAX for max, 0/0 for exclusive avg).
+                    # Documented deviation: the lowering's sentinels are
+                    # -inf / NaN — same "garbage, never meaningful"
+                    # contract without pretending -FLT_MAX is a value.
+                    out[:, :, i, j] = (-np.inf if ptype == "max"
+                                       else np.nan)
+                    continue
+                if ptype == "max":
+                    out[:, :, i, j] = win.max(axis=(2, 3))
+                else:
+                    out[:, :, i, j] = (win.sum(axis=(2, 3))
+                                       / ((he - hs) * (we - ws)))
+        levels.append(out.reshape(N, -1))
+    return np.concatenate(levels, axis=1)
+
+
+@pytest.mark.parametrize("H,W", [(8, 8), (7, 7), (6, 10), (5, 9)])
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_spp_matches_reference_grid(H, W, ptype):
+    x = np.random.RandomState(7).randn(2, 3, H, W).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[3, H, W], dtype="float32")
+        return [fluid.layers.spp(xv, pyramid_height=3, pool_type=ptype)]
+
+    (out,) = _run(build, {"x": x})
+    want = spp_oracle(x, 3, ptype)
+    assert out.shape == want.shape
+    np.testing.assert_allclose(out, want, atol=1e-5, rtol=1e-5)

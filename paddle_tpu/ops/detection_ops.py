@@ -752,9 +752,19 @@ def _roi_pool(ctx):
     return {"Out": out, "Argmax": None}
 
 
+_ROI_ALIGN_ADAPTIVE_CAP = 8
+
+
 @register_op("roi_align")
 def _roi_align(ctx):
-    """RoI Align (roi_align_op.cc): average of bilinear samples per bin."""
+    """RoI Align (roi_align_op.h): average of bilinear samples per bin.
+    sampling_ratio > 0 is a fixed grid; <= 0 is the reference's
+    per-roi ADAPTIVE grid of ceil(roi_h/ph) x ceil(roi_w/pw) points —
+    emulated exactly under static shapes by evaluating a capped
+    [S_max, S_max] grid and masking samples beyond the roi's own count
+    (cap 8: a roi would need to span >8 bins' worth of feature rows
+    per pooled cell to clip, and the cap then degrades gracefully to
+    an 8x8 subsample). Pinned by tests/test_roi_align_oracle.py."""
     import jax
     jnp = _jnp()
     x = ctx.input("X")
@@ -769,7 +779,7 @@ def _roi_align(ctx):
     if squeeze:
         rois = rois[None]
     R = rois.shape[1]
-    S = ratio if ratio > 0 else 2
+    S = ratio if ratio > 0 else _ROI_ALIGN_ADAPTIVE_CAP
 
     def bilinear(feat, ys, xs):
         """feat [C, H, W]; ys/xs [...]: bilinear sample -> [C, ...]"""
@@ -799,14 +809,21 @@ def _roi_align(ctx):
         rh = jnp.maximum(roi[3] * scale - y1, 1.0)
         bin_h = rh / ph
         bin_w = rw / pw
+        if ratio > 0:
+            gh = gw = jnp.asarray(float(ratio), feat.dtype)
+        else:
+            gh = jnp.clip(jnp.ceil(rh / ph), 1, S)
+            gw = jnp.clip(jnp.ceil(rw / pw), 1, S)
         ib = jnp.arange(ph, dtype=feat.dtype)[:, None, None, None]
         jb = jnp.arange(pw, dtype=feat.dtype)[None, :, None, None]
         si = jnp.arange(S, dtype=feat.dtype)[None, None, :, None]
         sj = jnp.arange(S, dtype=feat.dtype)[None, None, None, :]
-        ys = y1 + ib * bin_h + (si + 0.5) * bin_h / S    # [ph,pw,S,S]
-        xs = x1 + jb * bin_w + (sj + 0.5) * bin_w / S
+        ys = y1 + ib * bin_h + (si + 0.5) * bin_h / gh   # [ph,pw,S,S]
+        xs = x1 + jb * bin_w + (sj + 0.5) * bin_w / gw
+        live = (si < gh) & (sj < gw)                      # [1,1,S,S]
         vals = bilinear(feat, ys, xs)                     # [C,ph,pw,S,S]
-        return jnp.mean(vals, axis=(3, 4))
+        vals = vals * live.astype(feat.dtype)[None]
+        return jnp.sum(vals, axis=(3, 4)) / (gh * gw)
 
     out = jax.vmap(lambda feat, rs: jax.vmap(
         lambda r: one_roi(feat, r))(rs))(x, rois)

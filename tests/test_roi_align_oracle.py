@@ -1,0 +1,108 @@
+"""roi_align reference-kernel oracle (roi_align_op.h restated).
+
+Pins the details the generic description misses: coords scaled with NO
+rounding, roi w/h floored at 1.0, per-bin sample grid of
+sampling_ratio^2 points at (i+0.5)/n offsets — or, when
+sampling_ratio <= 0, an ADAPTIVE per-roi grid of ceil(roi_h/ph) x
+ceil(roi_w/pw) points — each bilinearly interpolated with the
+reference's edge handling (oob beyond [-1, size] -> 0, negatives
+clamped to 0, high edge collapsed), averaged over the FULL grid count.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.lod import create_lod_tensor
+
+
+def _run(build_fn, feed):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        fetches = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    res = exe.run(main, feed=feed, fetch_list=list(fetches))
+    return [np.asarray(r) for r in res]
+
+
+def _bilinear(feat, y, x):
+    """roi_align_op.h PreCalcForBilinearInterpolate, one point."""
+    C, H, W = feat.shape
+    if y < -1.0 or y > H or x < -1.0 or x > W:
+        return np.zeros(C, feat.dtype)
+    y = max(y, 0.0)
+    x = max(x, 0.0)
+    y_low, x_low = int(y), int(x)
+    if y_low >= H - 1:
+        y_high = y_low = H - 1
+        y = float(y_low)
+    else:
+        y_high = y_low + 1
+    if x_low >= W - 1:
+        x_high = x_low = W - 1
+        x = float(x_low)
+    else:
+        x_high = x_low + 1
+    ly, lx = y - y_low, x - x_low
+    hy, hx = 1.0 - ly, 1.0 - lx
+    return (feat[:, y_low, x_low] * hy * hx +
+            feat[:, y_low, x_high] * hy * lx +
+            feat[:, y_high, x_low] * ly * hx +
+            feat[:, y_high, x_high] * ly * lx)
+
+
+def roi_align_oracle(x, rois, batch_ids, ph, pw, scale, ratio):
+    B, C, H, W = x.shape
+    out = np.zeros((len(rois), C, ph, pw), x.dtype)
+    for n, (roi, b) in enumerate(zip(rois, batch_ids)):
+        xmin, ymin, xmax, ymax = (v * scale for v in roi)
+        rw = max(xmax - xmin, 1.0)
+        rh = max(ymax - ymin, 1.0)
+        bh, bw = rh / ph, rw / pw
+        gh = ratio if ratio > 0 else int(np.ceil(rh / ph))
+        gw = ratio if ratio > 0 else int(np.ceil(rw / pw))
+        count = gh * gw
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(C, x.dtype)
+                for iy in range(gh):
+                    yy = ymin + i * bh + (iy + 0.5) * bh / gh
+                    for ix in range(gw):
+                        xx = xmin + j * bw + (ix + 0.5) * bw / gw
+                        acc += _bilinear(x[b], yy, xx)
+                out[n, :, i, j] = acc / count
+    return out
+
+
+@pytest.mark.parametrize("ratio", [-1, 2, 3])
+def test_roi_align_matches_reference(ratio):
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 12, 16).astype(np.float32)
+    # mix of small, large (adaptive grid > 2), edge-hugging and
+    # out-of-range rois; raw coords (spatial_scale rescales them)
+    rois = np.array([[1.2, 2.1, 9.7, 8.8],
+                     [0.0, 0.0, 31.0, 23.0],     # big: ceil grid 4x4
+                     [14.5, 10.2, 15.9, 11.9],   # tiny: w/h floor at 1
+                     [-3.0, -2.0, 4.0, 35.0],    # spills every edge
+                     [30.0, 20.0, 30.5, 20.5]], np.float32)
+    lens = [3, 2]
+    batch_ids = [0, 0, 0, 1, 1]
+    ph, pw, scale = 3, 4, 0.5
+
+    def build():
+        xv = fluid.layers.data("x", shape=[3, 12, 16], dtype="float32")
+        rv = fluid.layers.data("rois", shape=[4], dtype="float32",
+                               lod_level=1)
+        return [fluid.layers.roi_align(
+            xv, rv, pooled_height=ph, pooled_width=pw,
+            spatial_scale=scale, sampling_ratio=ratio)]
+
+    rois_lod = create_lod_tensor(rois, [lens])
+    (got,) = _run(build, {"x": x, "rois": rois_lod})
+    want = roi_align_oracle(x, rois, batch_ids, ph, pw, scale, ratio)
+    # repo returns [B, R, C, ph, pw] padded; flatten valid rows
+    if got.ndim == 5:
+        got = np.concatenate([got[b, :l] for b, l in enumerate(lens)])
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)

@@ -382,10 +382,15 @@ def _average_accumulates(ctx):
     move = (num_upd % k_max) == 0
     s2 = jnp.where(move, s2 + s1, s2)
     s1 = jnp.where(move, jnp.zeros_like(s1), s1)
+    # the reference's std::min<int64_t>(max_window, num_updates *
+    # average_window) TRUNCATES the float product toward zero before
+    # the compare, so the roll fires at num_acc == floor(product) —
+    # one step earlier than a float compare would
     window = jnp.minimum(
-        jnp.asarray(max_w, jnp.float32),
-        num_upd.astype(jnp.float32) * np.float32(avg_window))
-    roll = (num_acc >= min_w) & (num_acc.astype(jnp.float32) >= window)
+        jnp.asarray(max_w, jnp.int32),
+        jnp.floor(num_upd.astype(jnp.float32)
+                  * np.float32(avg_window)).astype(jnp.int32))
+    roll = (num_acc >= min_w) & (num_acc >= window)
     s3 = jnp.where(roll, s1 + s2, s3)
     s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
     s2 = jnp.where(roll, jnp.zeros_like(s2), s2)

@@ -1,0 +1,66 @@
+"""average_accumulates reference-kernel oracle
+(average_accumulates_op.h restated, stepped over a trajectory).
+
+The subtle part: the roll condition is
+  num_accumulates >= min_average_window AND
+  num_accumulates >= std::min<int64_t>(max_average_window,
+                                       num_updates * average_window)
+where the C++ min FORCES the float product to int64, truncating toward
+zero — so the window rolls at num_acc == floor(num_updates *
+average_window), one step earlier than an un-truncated float compare.
+"""
+
+import numpy as np
+
+from tests.test_op_tail import run_op
+
+
+def oracle_step(state, p, avg_window, max_w, min_w, k_max=16384):
+    s1, s2, s3, num_acc, old_num, num_upd = state
+    num_upd += 1
+    num_acc += 1
+    s1 = s1 + p
+    if num_upd % k_max == 0:
+        s2 = s2 + s1
+        s1 = np.zeros_like(s1)
+    window = min(max_w, int(num_upd * avg_window))   # int64 truncation
+    if num_acc >= min_w and num_acc >= window:
+        s3 = s1 + s2
+        s1 = np.zeros_like(s1)
+        s2 = np.zeros_like(s2)
+        old_num = num_acc
+        num_acc = 0
+    return s1, s2, s3, num_acc, old_num, num_upd
+
+
+def test_trajectory_matches_reference_including_truncation():
+    rng = np.random.RandomState(3)
+    n = 5
+    s1 = np.zeros(n, np.float32)
+    s2 = np.zeros(n, np.float32)
+    s3 = np.zeros(n, np.float32)
+    num_acc = old_num = num_upd = 0
+    attrs = {"average_window": 0.5, "max_average_window": 100,
+             "min_average_window": 1}
+    state = (s1, s2, s3, num_acc, old_num, num_upd)
+    for step in range(14):
+        p = rng.randn(n).astype(np.float32)
+        out = run_op("average_accumulates", {
+            "param": p,
+            "in_sum_1": state[0], "in_sum_2": state[1],
+            "in_sum_3": state[2],
+            "in_num_accumulates": np.array([state[3]], np.int64),
+            "in_old_num_accumulates": np.array([state[4]], np.int64),
+            "in_num_updates": np.array([state[5]], np.int64),
+        }, attrs)
+        state = oracle_step(state, p, 0.5, 100, 1)
+        for got, want, name in [
+                (out["out_sum_1"], state[0], "sum_1"),
+                (out["out_sum_2"], state[1], "sum_2"),
+                (out["out_sum_3"], state[2], "sum_3")]:
+            np.testing.assert_allclose(
+                np.asarray(got), want, atol=1e-5,
+                err_msg="%s diverged at step %d" % (name, step))
+        assert int(np.asarray(out["out_num_accumulates"])) == state[3], step
+        assert int(np.asarray(out["out_old_num_accumulates"])) == state[4]
+        assert int(np.asarray(out["out_num_updates"])) == state[5]

@@ -396,24 +396,39 @@ def _sequence_erase(ctx):
 
 @register_op("sequence_reshape")
 def _sequence_reshape(ctx):
+    """sequence_reshape_op.h: each sequence's flat payload (seq_len *
+    in_width row-major values) re-chunks into rows of new_dim; the
+    reference only requires PER-SEQUENCE divisibility of seq_len *
+    in_width by new_dim (in_width itself need not divide, and a
+    narrowing reshape must not swallow padding between sequences).
+    Padded-dense form: gather through the flat index remap
+    out[t', d'] = seq_flat[t'*new_dim + d'], masked past each
+    sequence's own payload. Pinned by
+    tests/test_sequence_reshape_oracle.py."""
     jnp = _jnp()
     x = ctx.input("X")  # [B, T, D]
     lens = ctx.lod_len("X")
-    new_dim = ctx.attr("new_dim")
+    new_dim = int(ctx.attr("new_dim"))
     B, T, D = x.shape
-    factor = D // new_dim if D >= new_dim else 1
-    if D % new_dim == 0:
-        out = x.reshape(B, T * (D // new_dim), new_dim)
-        new_lens = (lens * (D // new_dim)) if lens is not None else None
-    else:
-        assert new_dim % D == 0
-        k = new_dim // D
-        out = x.reshape(B, T // k, new_dim)
-        new_lens = (lens // k) if lens is not None else None
-    r = {"Out": out}
-    if new_lens is not None:
-        r["Out@LOD_LEN"] = new_lens
-    return r
+    if D == new_dim:
+        r = {"Out": x}
+        if lens is not None:
+            r["Out@LOD_LEN"] = lens
+        return r
+    # static padded output length: the longest possible re-chunked row
+    # count given T timesteps of D values
+    T_out = -(-(T * D) // new_dim)
+    flat_idx = (jnp.arange(T_out)[:, None] * new_dim
+                + jnp.arange(new_dim)[None, :])          # [T_out, new_dim]
+    t_old = flat_idx // D
+    d_old = flat_idx % D
+    out = x[:, jnp.clip(t_old, 0, T - 1), d_old]          # [B,T_out,new_dim]
+    if lens is not None:
+        valid = flat_idx[None] < (lens[:, None, None] * D)
+        out = jnp.where(valid, out, 0)
+        new_lens = (lens * D) // new_dim
+        return {"Out": out, "Out@LOD_LEN": new_lens}
+    return {"Out": out}
 
 
 def _seq_context_matrix(x, lens, ctx_len, ctx_start):

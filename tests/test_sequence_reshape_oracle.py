@@ -1,0 +1,54 @@
+"""sequence_reshape reference oracle (sequence_reshape_op.h restated):
+each sequence's flat payload (seq_len * in_width values, row-major) is
+re-chunked into rows of new_dim; the only requirement is per-sequence
+divisibility of seq_len * in_width by new_dim — in_width itself need
+not divide (e.g. D=3 -> new_dim=2 with even-length sequences)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.fluid.lod import create_lod_tensor, LoDTensor
+
+
+def _run(build_fn, feed):
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        fetches = build_fn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=list(fetches))
+
+
+def oracle(rows, lens, new_dim):
+    outs, new_lens, start = [], [], 0
+    for l in lens:
+        flat = rows[start:start + l].reshape(-1)
+        assert flat.size % new_dim == 0
+        outs.append(flat.reshape(-1, new_dim))
+        new_lens.append(flat.size // new_dim)
+        start += l
+    return np.concatenate(outs, axis=0), new_lens
+
+
+@pytest.mark.parametrize("D,new_dim,lens", [
+    (6, 2, [3, 1]),    # widening factor: D % new_dim == 0
+    (2, 6, [3, 6]),    # narrowing: new_dim % D == 0, lens divisible
+    (3, 2, [4, 2]),    # NEITHER divides; per-sequence payload does
+    (4, 4, [2, 3]),    # identity
+])
+def test_sequence_reshape_matches_reference(D, new_dim, lens):
+    rng = np.random.RandomState(1)
+    rows = rng.randn(sum(lens), D).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", shape=[D], dtype="float32",
+                               lod_level=1)
+        return [fluid.layers.sequence_reshape(xv, new_dim)]
+
+    (got,) = _run(build, {"x": create_lod_tensor(rows, [lens])})
+    want_rows, want_lens = oracle(rows, lens, new_dim)
+    assert isinstance(got, LoDTensor)
+    assert got.recursive_sequence_lengths()[0] == want_lens
+    np.testing.assert_allclose(got.numpy(), want_rows, atol=1e-6)

@@ -921,13 +921,19 @@ def _rpn_target_assign(ctx):
 
 @register_op("polygon_box_transform")
 def _polygon_box_transform(ctx):
-    """out[n,c,h,w] = 4*w - x for even c (x offsets), 4*h - x for odd c."""
+    """polygon_box_transform_op.cc: out = 4*w - x on even planes (x
+    offsets), 4*h - x on odd. The reference's parity index is the
+    COMBINED n*C + c counter (its loop runs over batch*channels), so
+    with an odd channel count the parity flips per batch item —
+    replicated bug-for-bug; for the even C every real geometry uses
+    this equals plain channel parity."""
     jnp = _jnp()
     x = ctx.input("Input")
     N, C, H, W = x.shape
     wgrid = jnp.broadcast_to(jnp.arange(W, dtype=x.dtype), (H, W))
     hgrid = jnp.broadcast_to(jnp.arange(H, dtype=x.dtype)[:, None], (H, W))
-    even = (jnp.arange(C) % 2 == 0)[None, :, None, None]
+    nc = (jnp.arange(N)[:, None] * C + jnp.arange(C)[None, :])
+    even = (nc % 2 == 0)[:, :, None, None]
     base = jnp.where(even, wgrid[None, None], hgrid[None, None])
     return {"Output": 4.0 * base - x}
 

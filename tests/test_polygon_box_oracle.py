@@ -1,0 +1,29 @@
+"""polygon_box_transform reference oracle: the parity that picks the
+4*w vs 4*h base is the reference's COMBINED n*C + c loop counter
+(polygon_box_transform_op.cc:39-47), which differs from channel parity
+whenever C is odd — pinned bug-for-bug."""
+
+import numpy as np
+import pytest
+
+from tests.test_op_tail import run_op
+
+
+def oracle(x):
+    N, C, H, W = x.shape
+    out = np.empty_like(x)
+    for n in range(N):
+        for c in range(C):
+            for h in range(H):
+                for w in range(W):
+                    base = w * 4 if (n * C + c) % 2 == 0 else h * 4
+                    out[n, c, h, w] = base - x[n, c, h, w]
+    return out
+
+
+@pytest.mark.parametrize("C", [8, 3])   # even (real geometry) and odd
+def test_polygon_box_transform_matches_reference(C):
+    x = np.random.RandomState(2).randn(2, C, 3, 4).astype(np.float32)
+    out = run_op("polygon_box_transform", {"Input": x}, {})
+    np.testing.assert_allclose(np.asarray(out["Output"]), oracle(x),
+                               atol=1e-5)

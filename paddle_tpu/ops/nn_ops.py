@@ -702,12 +702,18 @@ def _nearest_interp(ctx):
 
 @register_op("pad2d")
 def _pad2d(ctx):
+    """pad2d_op.cc: [top, bottom, left, right] spatial padding in
+    constant/reflect/edge mode, honoring data_format (the NHWC kernel
+    pads axes 1-2, not 2-3)."""
     jnp = _jnp()
     x = ctx.input("X")
     p = ctx.attr("paddings", [0, 0, 0, 0])
     mode = ctx.attr("mode", "constant")
     value = ctx.attr("pad_value", 0.0)
-    pads = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]))
+    fmt = ctx.attr("data_format", "NCHW")
+    hw = ((p[0], p[1]), (p[2], p[3]))
+    pads = ((0, 0), (0, 0)) + hw if fmt == "NCHW" else \
+        ((0, 0),) + hw + ((0, 0),)
     if mode == "constant":
         return {"Out": jnp.pad(x, pads, constant_values=value)}
     jmode = {"reflect": "reflect", "edge": "edge"}[mode]

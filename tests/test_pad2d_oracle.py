@@ -1,0 +1,30 @@
+"""pad2d reference oracle (pad2d_op.cc): [top,bottom,left,right]
+padding in constant/reflect/edge mode under BOTH data formats — the
+NHWC kernel pads the spatial axes 1-2, not 2-3."""
+
+import numpy as np
+import pytest
+
+from tests.test_op_tail import run_op
+
+
+def oracle(x, p, mode, value, fmt):
+    hw = ((p[0], p[1]), (p[2], p[3]))
+    pads = (((0, 0), (0, 0)) + hw if fmt == "NCHW"
+            else ((0, 0),) + hw + ((0, 0),))
+    if mode == "constant":
+        return np.pad(x, pads, constant_values=value)
+    return np.pad(x, pads, mode={"reflect": "reflect",
+                                 "edge": "edge"}[mode])
+
+
+@pytest.mark.parametrize("fmt", ["NCHW", "NHWC"])
+@pytest.mark.parametrize("mode", ["constant", "reflect", "edge"])
+def test_pad2d_matches_reference(fmt, mode):
+    x = np.random.RandomState(0).randn(2, 3, 4, 5).astype(np.float32)
+    p = [1, 2, 2, 1]
+    out = run_op("pad2d", {"X": x},
+                 {"paddings": p, "mode": mode, "pad_value": 1.5,
+                  "data_format": fmt})
+    np.testing.assert_allclose(np.asarray(out["Out"]),
+                               oracle(x, p, mode, 1.5, fmt), atol=1e-6)

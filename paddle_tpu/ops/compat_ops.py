@@ -89,11 +89,13 @@ def _add_position_encoding(ctx):
 @register_op("conv_shift")
 def _conv_shift(ctx):
     """Circular correlation (conv_shift_op.cc): out[b,i] =
-    sum_j x[b,(i+j-M//2) mod N] * y[b,j]."""
+    sum_j x[b,(i+j-half) mod N] * y[b,j] with half = (M-1)//2 — the
+    reference's y_half_width floors (M-1)/2, which differs from M//2
+    for EVEN filter widths."""
     jnp = _jnp()
     x, y = ctx.input("X"), ctx.input("Y")
     N, M = x.shape[1], y.shape[1]
-    half = M // 2
+    half = (M - 1) // 2
     out = jnp.zeros_like(x)
     for j in range(M):
         out = out + jnp.roll(x, half - j, axis=1) * y[:, j:j + 1]

@@ -1,0 +1,30 @@
+"""conv_shift reference oracle (conv_shift_op.cc): circular
+correlation out[k,i] = sum_j x[k, (i + j - (M-1)//2) mod N] * y[k,j].
+The half-width floors (M-1)/2 — off by one from M//2 for even M."""
+
+import numpy as np
+import pytest
+
+from tests.test_op_tail import run_op
+
+
+def oracle(x, y):
+    B, N = x.shape
+    M = y.shape[1]
+    half = (M - 1) // 2
+    out = np.zeros_like(x)
+    for k in range(B):
+        for i in range(N):
+            for j in range(M):
+                out[k, i] += x[k, (i + j - half) % N] * y[k, j]
+    return out
+
+
+@pytest.mark.parametrize("M", [3, 4, 5])   # odd and EVEN widths
+def test_conv_shift_matches_reference(M):
+    rng = np.random.RandomState(M)
+    x = rng.randn(2, 7).astype(np.float32)
+    y = rng.randn(2, M).astype(np.float32)
+    out = run_op("conv_shift", {"X": x, "Y": y}, {})
+    np.testing.assert_allclose(np.asarray(out["Out"]), oracle(x, y),
+                               atol=1e-5)

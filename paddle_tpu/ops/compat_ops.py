@@ -69,19 +69,29 @@ def _depthwise_conv2d_transpose(ctx):
 
 @register_op("add_position_encoding")
 def _add_position_encoding(ctx):
-    """out = alpha*x + beta*sinusoid(pos) (add_position_encoding_op.cc)."""
+    """out = alpha*x + beta*sinusoid(pos) (add_position_encoding_op.h).
+    The reference's frequency exponent is k/(half_size-1) — reaching
+    exactly 1/10000 at the last sin/cos pair — NOT the transformer
+    paper's 2k/D; half_size == 1 divides by 10000 directly, and the
+    encode size must be even (the reference ENFORCEs it). Positions
+    restart at 0 per sequence, which the padded-dense layout gives for
+    free. Pinned by tests/test_position_encoding_oracle.py."""
     jnp = _jnp()
     x = ctx.input("X")      # [B, T, D]
     alpha = ctx.attr("alpha", 1.0)
     beta = ctx.attr("beta", 1.0)
     B, T, D = x.shape
+    if D % 2:
+        raise ValueError(
+            "add_position_encoding: encode size must be even "
+            "(reference add_position_encoding_op.h:61), got %d" % D)
+    half = D // 2
     pos = jnp.arange(T, dtype=jnp.float32)[:, None]
-    i = jnp.arange(D // 2, dtype=jnp.float32)[None, :]
-    freq = pos / jnp.power(10000.0, 2.0 * i / D)
-    # reference layout: first half sin, second half cos
-    enc = jnp.concatenate([jnp.sin(freq), jnp.cos(freq)], axis=1)
-    if enc.shape[1] < D:    # odd D: pad the tail
-        enc = jnp.pad(enc, ((0, 0), (0, D - enc.shape[1])))
+    k = jnp.arange(half, dtype=jnp.float32)[None, :]
+    denom = jnp.power(10000.0, k / (half - 1)) if half > 1 else \
+        jnp.full((1, 1), 10000.0, jnp.float32)
+    val = pos / denom
+    enc = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=1)
     return {"Out": (alpha * x + beta * enc[None].astype(x.dtype))
             .astype(x.dtype)}
 

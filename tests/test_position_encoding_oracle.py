@@ -1,0 +1,38 @@
+"""add_position_encoding reference oracle
+(add_position_encoding_op.h restated): dst[k] = x*alpha +
+sin(j / 10000^(k/(half-1)))*beta for the first half, cos of the same
+for the second half — the exponent divides by half_size-1, not half."""
+
+import numpy as np
+import pytest
+
+from tests.test_op_tail import run_op
+
+
+def oracle(x, alpha, beta):
+    B, T, D = x.shape
+    half = D // 2
+    out = np.empty_like(x)
+    for j in range(T):
+        for k in range(half):
+            val = (j / (10000.0 ** (k / (half - 1))) if half > 1
+                   else j / 10000.0)
+            out[:, j, k] = x[:, j, k] * alpha + np.sin(val) * beta
+            out[:, j, half + k] = (x[:, j, half + k] * alpha
+                                   + np.cos(val) * beta)
+    return out
+
+
+@pytest.mark.parametrize("D", [8, 2])    # half > 1 and half == 1
+def test_add_position_encoding_matches_reference(D):
+    x = np.random.RandomState(0).randn(2, 5, D).astype(np.float32)
+    out = run_op("add_position_encoding", {"X": x},
+                 {"alpha": 0.7, "beta": 1.3})
+    np.testing.assert_allclose(np.asarray(out["Out"]),
+                               oracle(x, 0.7, 1.3), atol=1e-5)
+
+
+def test_odd_encode_size_rejected():
+    x = np.zeros((1, 2, 3), np.float32)
+    with pytest.raises(Exception):
+        run_op("add_position_encoding", {"X": x}, {})

@@ -1,0 +1,47 @@
+"""grid_sampler reference oracle (grid_sampler_op.h restated):
+coords unnormalized by 0.5*(size-1)*(g+1) (align-corners), bilinear
+with each corner fetched through the isInBound zero-padding check —
+including grids beyond [-1,1] and exact-edge samples."""
+
+import numpy as np
+
+from tests.test_op_tail import run_op
+
+
+def oracle(x, grid):
+    N, C, H, W = x.shape
+    _, Hg, Wg, _ = grid.shape
+    out = np.zeros((N, C, Hg, Wg), x.dtype)
+
+    def at(n, yy, xx):
+        if yy < 0 or yy > H - 1 or xx < 0 or xx > W - 1:
+            return np.zeros(C, x.dtype)
+        return x[n, :, int(yy), int(xx)]
+
+    for n in range(N):
+        for i in range(Hg):
+            for j in range(Wg):
+                gx = 0.5 * (W - 1) * (grid[n, i, j, 0] + 1.0)
+                gy = 0.5 * (H - 1) * (grid[n, i, j, 1] + 1.0)
+                x_w, y_n = np.floor(gx), np.floor(gy)
+                dw, dn = gx - x_w, gy - y_n
+                out[n, :, i, j] = (
+                    at(n, y_n, x_w) * (1 - dw) * (1 - dn)
+                    + at(n, y_n, x_w + 1) * dw * (1 - dn)
+                    + at(n, y_n + 1, x_w) * (1 - dw) * dn
+                    + at(n, y_n + 1, x_w + 1) * dw * dn)
+    return out
+
+
+def test_grid_sampler_matches_reference():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 5, 7).astype(np.float32)
+    grid = rng.uniform(-1.4, 1.4, (2, 4, 6, 2)).astype(np.float32)
+    # plant exact corners/edges and fully out-of-range points
+    grid[0, 0, 0] = [-1.0, -1.0]
+    grid[0, 0, 1] = [1.0, 1.0]
+    grid[0, 1, 0] = [2.5, 0.0]
+    grid[1, 0, 0] = [0.0, -2.5]
+    out = run_op("grid_sampler", {"X": x, "Grid": grid}, {})
+    np.testing.assert_allclose(np.asarray(out["Output"]),
+                               oracle(x, grid), atol=1e-4, rtol=1e-4)

@@ -1,0 +1,44 @@
+"""hierarchical_sigmoid reference oracle (hierarchical_sigmoid_op.h +
+matrix_bit_code.h SimpleCode restated): node id c = label +
+num_classes, path length = FindLastSet(c) - 1, edge j has internal
+node (c >> (j+1)) - 1 and branch bit c & (1 << j); per-edge loss is
+softplus(pre) - bit*pre with pre clipped to [-40, 40]."""
+
+import numpy as np
+import pytest
+
+from tests.test_op_tail import run_op
+
+
+def oracle(x, w, bias, labels, C):
+    B = x.shape[0]
+    loss = np.zeros(B, np.float64)
+    for b in range(B):
+        c = int(labels[b]) + C
+        length = c.bit_length() - 1          # FindLastSet(c) - 1
+        for j in range(length):
+            node = (c >> (j + 1)) - 1
+            bit = 1 if (c & (1 << j)) else 0
+            pre = float(x[b] @ w[node])
+            if bias is not None:
+                pre += float(bias[node])
+            pre = np.clip(pre, -40.0, 40.0)
+            loss[b] += np.log1p(np.exp(pre)) - bit * pre
+    return loss.astype(np.float32)
+
+
+@pytest.mark.parametrize("C", [6, 8, 13])   # non-powers and a power of 2
+def test_hsigmoid_matches_bit_code_reference(C):
+    rng = np.random.RandomState(C)
+    B, D = 5, 4
+    x = rng.randn(B, D).astype(np.float32)
+    w = rng.randn(C - 1, D).astype(np.float32)
+    bias = rng.randn(C - 1, 1).astype(np.float32)
+    labels = np.arange(B).astype(np.int64) % C
+    out = run_op("hierarchical_sigmoid",
+                 {"X": x, "W": w, "Bias": bias,
+                  "Label": labels[:, None]},
+                 {"num_classes": C})
+    np.testing.assert_allclose(np.asarray(out["Out"]).ravel(),
+                               oracle(x, w, bias.ravel(), labels, C),
+                               atol=1e-4, rtol=1e-4)

@@ -702,6 +702,13 @@ def _multiplex(ctx):
 
 @register_op("sampling_id")
 def _sampling_id(ctx):
+    """sampling_id_op.h: draw one class id per row from the row's
+    probability vector. Documented deviation: the reference walks the
+    unnormalized CDF against u ~ U(min,max) (attrs, default 0..1), so
+    rows not summing to 1 skew toward the last class; this lowering
+    samples the NORMALIZED categorical (jax.random.categorical), which
+    is the distribution the op documents. Draw-for-draw equality is
+    impossible anyway (different generators)."""
     import jax
     jnp = _jnp()
     x = ctx.input("X")                                  # [B, C] probs

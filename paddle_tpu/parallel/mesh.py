@@ -26,15 +26,28 @@ PIPE_AXIS = "pipe"
 EXPERT_AXIS = "expert"
 
 
+def _accel_devices():
+    """Device list behind the fluid `use_cuda` flag: ALWAYS the default
+    JAX backend (TPU on silicon, CPU on the virtual test mesh). The
+    reference's flag picks CUDA vs host-CPU places; this framework has
+    no CUDA backend, and `use_cuda=False` (the only spelling the fluid
+    API has for "no CUDA") must NOT silently demote a TPU program to
+    host-CPU execution — that bug cost 195x on the measured
+    ParallelExecutor throughput. Callers that genuinely want a host-CPU
+    mesh on an accelerator host pass an explicit mesh (see
+    tools/debug_parity.py)."""
+    import jax
+    return jax.devices()
+
+
 def local_device_count(use_cuda=True):
     """Device count, honoring CPU_NUM like the reference's parallel_executor.py
     (python wrapper :32 builds places from CUDA_VISIBLE_DEVICES / CPU_NUM)."""
-    import jax
-    if not use_cuda:
-        n_cpu = len(jax.devices("cpu"))
-        cpu_num = int(os.environ.get("CPU_NUM", n_cpu))
-        return min(cpu_num, n_cpu) or 1
-    return len(jax.devices())
+    devs = _accel_devices()
+    if not use_cuda and devs and devs[0].platform == "cpu":
+        cpu_num = int(os.environ.get("CPU_NUM", len(devs)))
+        return min(cpu_num, len(devs)) or 1
+    return len(devs)
 
 
 def make_mesh(axis_sizes, devices=None):
@@ -54,8 +67,7 @@ def make_mesh(axis_sizes, devices=None):
 
 
 def data_parallel_mesh(num_devices=None, use_cuda=True):
-    import jax
-    devs = jax.devices() if use_cuda else jax.devices("cpu")
+    devs = _accel_devices()
     if num_devices is None:
         num_devices = local_device_count(use_cuda)
     return make_mesh({DATA_AXIS: num_devices}, devs[:num_devices])

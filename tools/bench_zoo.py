@@ -43,6 +43,13 @@ CONFIGS = [
      512, 64),
     ("transformer_deviceloop",
      ["--model", "transformer", "--device_loop", "10"], 32, 2),
+    # ParallelExecutor path on silicon (degenerate 1-device mesh on the
+    # single exposed chip; the SPMD step + collective insertion is the
+    # code under test, the virtual-mesh suite covers >1 devices). Only
+    # the small-feed config: PE re-commits host shards per dispatch, so
+    # a vision-scale batch through the ~20 MB/s relay times the tunnel
+    ("mnist_cnn_pe", ["--model", "mnist", "--parallel",
+                      "--device_loop", "10"], 512, 64),
     ("stacked_dynamic_lstm_deviceloop",
      ["--model", "stacked_dynamic_lstm", "--device_loop", "10"], 64, 8),
     ("machine_translation_wmt", ["--model", "machine_translation"], 16, 4),

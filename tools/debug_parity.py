@@ -29,7 +29,7 @@ import numpy as np  # noqa: E402
 
 import paddle_tpu.fluid as fluid  # noqa: E402
 from paddle_tpu.fluid import functionalizer  # noqa: E402
-from paddle_tpu.parallel.mesh import data_parallel_mesh, DATA_AXIS  # noqa
+from paddle_tpu.parallel.mesh import make_mesh, DATA_AXIS  # noqa
 
 
 def build(lr=0.01):
@@ -98,8 +98,15 @@ def mode_scope():
     with fluid.scope_guard(s2):
         exe2 = fluid.Executor(fluid.CPUPlace())
         exe2.run(startup)
+        # pin the PE to a HOST-CPU mesh: this tool isolates framework
+        # bugs by comparing against the CPU Executor run above, so both
+        # sides must share a platform (on silicon use_cuda=False follows
+        # the default TPU backend and would add cross-platform noise)
+        from paddle_tpu.parallel.mesh import make_mesh
+        cpu_mesh = make_mesh({DATA_AXIS: len(jax.devices("cpu"))},
+                             jax.devices("cpu"))
         pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
-                                    main_program=main)
+                                    main_program=main, mesh=cpu_mesh)
         (l2,) = pe.run(fetch_list=[loss.name], feed=feed64)
     print("pe loss:", float(np.asarray(l2).flatten()[0]))
     diff_report({k: s1.get(k) for k in s1.keys()},
@@ -113,7 +120,8 @@ def mode_sharding():
     persist = tuple(functionalizer.persistable_names(main))
     jfn = jax.jit(functionalizer.build_step_fn(
         main, ("data", "label"), (loss.name,), persist))
-    mesh = data_parallel_mesh(use_cuda=False)
+    mesh = make_mesh({DATA_AXIS: len(jax.devices("cpu"))},
+                     jax.devices("cpu"))
     rep = NamedSharding(mesh, P())
     f = feeds_np()[0]
 
@@ -133,7 +141,8 @@ def mode_trajectory(lr=1e-4, steps=5):
     persist = tuple(functionalizer.persistable_names(main))
     jfn = jax.jit(functionalizer.build_step_fn(
         main, ("data", "label"), (loss.name,), persist))
-    mesh = data_parallel_mesh(use_cuda=False)
+    mesh = make_mesh({DATA_AXIS: len(jax.devices("cpu"))},
+                     jax.devices("cpu"))
     rep = NamedSharding(mesh, P())
     fs = feeds_np(steps)
 

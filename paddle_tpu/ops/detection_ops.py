@@ -117,8 +117,8 @@ def _prior_box(ctx):
     min_sizes = [float(s) for s in ctx.attr("min_sizes")]
     max_sizes = [float(s) for s in ctx.attr("max_sizes", []) or []]
     ars = [float(a) for a in ctx.attr("aspect_ratios", [1.0]) or [1.0]]
-    flip = bool(ctx.attr("flip", False))
-    clip = bool(ctx.attr("clip", False))
+    flip = bool(ctx.attr("flip", True))
+    clip = bool(ctx.attr("clip", True))
     variances = [float(v) for v in
                  ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])]
     step_w = float(ctx.attr("step_w", 0.0) or 0.0)
@@ -455,7 +455,7 @@ def _mine_hard_examples(ctx):
     loc_loss = ctx.input("LocLoss")
     midx = ctx.input("MatchIndices")
     mdist = ctx.input("MatchDist")
-    ratio = float(ctx.attr("neg_pos_ratio", 3.0))
+    ratio = float(ctx.attr("neg_pos_ratio", 1.0))
     dist_thr = float(ctx.attr("neg_dist_threshold", 0.5))
     sample_size = int(ctx.attr("sample_size", 0))
     mining_type = ctx.attr("mining_type", "max_negative")
@@ -971,8 +971,8 @@ def _roi_perspective_transform(ctx):
     x = ctx.input("X")          # [B, C, H, W]
     rois = ctx.input("ROIs")    # [N, 8]
     lens = ctx.lod_len("ROIs")
-    out_h = int(ctx.attr("transformed_height", 8))
-    out_w = int(ctx.attr("transformed_width", 8))
+    out_h = int(ctx.attr("transformed_height", 1))
+    out_w = int(ctx.attr("transformed_width", 1))
     scale = float(ctx.attr("spatial_scale", 1.0))
     B, C, H, W = x.shape
     N = rois.shape[0]

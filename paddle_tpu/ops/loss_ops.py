@@ -337,7 +337,7 @@ def _edit_distance(ctx):
         return d
 
     dist = jax.vmap(one)(hyp, ref, hlens, rlens)
-    if ctx.attr("normalized", True):
+    if ctx.attr("normalized", False):
         dist = dist / jnp.maximum(rlens, 1).astype(jnp.float32)
     return {"Out": dist[:, None],
             "SequenceNum": jnp.asarray([B], jnp.int64)}

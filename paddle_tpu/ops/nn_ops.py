@@ -84,7 +84,7 @@ def _grouped_conv(strides, padding, dilations, groups, layout):
         p = jax.lax.conv_general_dilated_patches(
             x, (kh, kw), strides, padding, rhs_dilation=dilations,
             dimension_numbers=dn)
-        if layout == "NCHW":
+        if layout != "NHWC":
             s = p.shape[2] * p.shape[3]
             dw = jnp.einsum(
                 "ngis,ngos->goi",
@@ -130,7 +130,7 @@ def _conv2d(ctx):
             dimension_numbers=(layout, "OIHW", layout))
     out = out.astype(x.dtype)
     if ctx.has_input("Bias"):
-        bshape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)
+        bshape = (1, -1, 1, 1) if layout != "NHWC" else (1, 1, 1, -1)
         out = out + ctx.input("Bias").reshape(bshape)
     # named checkpoint: identity in normal execution; lets a rematerialized
     # step (jax.checkpoint + save_only_these_names("conv_out")) keep conv
@@ -290,7 +290,7 @@ def _pool2d(ctx):
     pads = _pair(ctx.attr("paddings", [0, 0]))
     ceil_mode = bool(ctx.attr("ceil_mode", False))
     layout = _img_layout(ctx)
-    hw = (2, 3) if layout == "NCHW" else (1, 2)
+    hw = (2, 3) if layout != "NHWC" else (1, 2)
     if ctx.attr("global_pooling", False):
         ksize = (x.shape[hw[0]], x.shape[hw[1]])
         strides = ksize
@@ -303,7 +303,7 @@ def _pool2d(ctx):
         ceil_mode = False
     extras = [ceil_extra_pad(x.shape[hw[i]], ksize[i], strides[i], pads[i])
               if ceil_mode else 0 for i in range(2)]
-    if layout == "NCHW":
+    if layout != "NHWC":
         window = (1, 1) + ksize
         stride = (1, 1) + strides
         padding = ((0, 0), (0, 0), (pads[0], pads[0] + extras[0]),
@@ -712,7 +712,7 @@ def _pad2d(ctx):
     value = ctx.attr("pad_value", 0.0)
     fmt = ctx.attr("data_format", "NCHW")
     hw = ((p[0], p[1]), (p[2], p[3]))
-    pads = ((0, 0), (0, 0)) + hw if fmt == "NCHW" else \
+    pads = ((0, 0), (0, 0)) + hw if fmt != "NHWC" else \
         ((0, 0),) + hw + ((0, 0),)
     if mode == "constant":
         return {"Out": jnp.pad(x, pads, constant_values=value)}

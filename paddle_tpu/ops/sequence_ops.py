@@ -459,7 +459,7 @@ def _sequence_conv(ctx):
     w = ctx.input("Filter")         # [ctx_len*D, M]
     lens = ctx.lod_len("X")
     ctx_len = ctx.attr("contextLength", 3)
-    ctx_start = ctx.attr("contextStart", -1)
+    ctx_start = ctx.attr("contextStart", 0)
     B, T, D = x.shape
     if lens is None:
         lens = jnp.full((B,), T, jnp.int32)
@@ -1071,7 +1071,7 @@ def _fusion_lstm(ctx):
     if bias_x is not None:
         # fc_lstm_fuse: the folded fc's bias applies to the x-projection
         xx = xx + bias_x.reshape(1, 1, -1)
-    use_peepholes = ctx.attr("use_peepholes", False) and \
+    use_peepholes = ctx.attr("use_peepholes", True) and \
         bias.shape[-1] == 7 * D
     hidden, cell = _lstm_scan(xx, lens, wh, bias, h0, c0, use_peepholes,
                               ctx.attr("is_reverse", False))
@@ -1222,7 +1222,7 @@ def _fusion_seqconv_eltadd_relu(ctx):
     bias = ctx.input("Bias")        # [1, M]
     lens = ctx.lod_len("X")
     ctx_len = ctx.attr("contextLength", 3)
-    ctx_start = ctx.attr("contextStart", -1)
+    ctx_start = ctx.attr("contextStart", 0)
     B, T, D = x.shape
     if lens is None:
         lens = jnp.full((B,), T, jnp.int32)
@@ -1289,7 +1289,7 @@ def _fused_embedding_fc_lstm(ctx):
         h0 = jnp.zeros((B, D), xx.dtype)
     if c0 is None:
         c0 = jnp.zeros((B, D), xx.dtype)
-    use_peepholes = ctx.attr("use_peepholes", False) and \
+    use_peepholes = ctx.attr("use_peepholes", True) and \
         bias.shape[-1] == 7 * D
     hidden, cell = _lstm_scan(xx, lens, wh, bias, h0, c0, use_peepholes,
                               ctx.attr("is_reverse", False))

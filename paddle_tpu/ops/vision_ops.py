@@ -93,7 +93,7 @@ def _affine_grid(ctx):
 def _affine_channel(ctx):
     x = ctx.input("X")
     layout = ctx.attr("data_layout", "NCHW")
-    cshape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)
+    cshape = (1, -1, 1, 1) if layout != "NHWC" else (1, 1, 1, -1)
     scale = ctx.input("Scale")
     bias = ctx.input("Bias")
     out = x

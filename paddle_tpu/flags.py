@@ -175,6 +175,43 @@ DEFINE_int(
     "end-to-end ResNet-50 serving measured slower at every gate "
     "(F<=128, 7 blocks: 1354 vs 1599 img/s; F<=64, 3 blocks: 1526 vs "
     "1584; fuse-all was worst). Set a width to opt in for experiments.")
+DEFINE_int(
+    "flash_block_q", 0,
+    "Flash-attention forward q-block edge; 0 (default) resolves per shape "
+    "via the tune cache (FLAGS.attention_tune_cache) then the MXU-aligned "
+    "heuristic (ops/attention_tuning.py). Nonzero overrides both — the "
+    "process-wide expert knob; per-call block args override even this.")
+DEFINE_int(
+    "flash_block_kv", 0,
+    "Flash-attention forward k/v-block edge; 0 = auto (see flash_block_q).")
+DEFINE_int(
+    "flash_block_q_bwd", 0,
+    "Flash-attention backward (dq/dkv kernels) q-block edge; 0 = auto.")
+DEFINE_int(
+    "flash_block_kv_bwd", 0,
+    "Flash-attention backward (dq/dkv kernels) k/v-block edge; 0 = auto.")
+DEFINE_string(
+    "attention_tune_cache", "",
+    "Path of the flash-attention shape->block-config tune cache written "
+    "by `tools/bench_attention.py --tune` and consulted at trace time; "
+    "empty means <repo>/tools/attention_tune_cache.json.")
+DEFINE_bool(
+    "ring_use_flash", True,
+    "Ring attention (parallel/ring_attention.py) computes each hop's "
+    "block with the tuned Pallas flash kernel and merges hops by "
+    "logsumexp, instead of the plain-XLA online-softmax update. The "
+    "kernel path never materializes the [S_loc, S_loc] score tile; "
+    "disable to A/B against the composition the r5 numbers were "
+    "recorded on.")
+DEFINE_int(
+    "roi_align_adaptive_cap", 8,
+    "roi_align adaptive-grid cap (sampling_ratio <= 0): the reference's "
+    "per-roi ceil(roi_h/ph) x ceil(roi_w/pw) sample grid is emulated "
+    "under static shapes by evaluating a [cap, cap] grid and masking; a "
+    "roi needing more samples per bin degrades to a cap x cap uniform "
+    "subsample (a one-time warning fires when eager inputs actually "
+    "clip). Raise for detection heads pooling very large rois; cost is "
+    "quadratic in the cap.")
 DEFINE_bool(
     "cpu_deterministic", False,
     "Prefer deterministic reduction order (reference FLAGS_cpu_deterministic, "

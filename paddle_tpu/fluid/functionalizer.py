@@ -385,8 +385,12 @@ def export_step_for_tpu(step_fn, state, feed_specs):
                   else jax.ShapeDtypeStruct(tuple(v[0]),
                                             _np.dtype(v[1]))
                   for n, v in feed_specs.items()}
-    return jax_export.export(jax.jit(step_fn), platforms=["tpu"])(
-        state_spec, feeds_spec, jax.ShapeDtypeStruct((), _np.uint32))
+    from ..ops.pallas_kernels import mosaic_lowering
+    with mosaic_lowering():
+        # interpret=None Pallas call sites resolve to the real Mosaic
+        # kernels while this trace runs (the export targets TPU only)
+        return jax_export.export(jax.jit(step_fn), platforms=["tpu"])(
+            state_spec, feeds_spec, jax.ShapeDtypeStruct((), _np.uint32))
 
 
 def jit_loop(step_fn, donate_state):

@@ -89,10 +89,15 @@ def save_aot_trainer(dirname, program, feed_names, fetch_names,
         # list("tpu") would become ['t','p','u'] and fail far away
         platforms = (platforms,)
     step_spec = jax.ShapeDtypeStruct((), np.uint32)
-    exp = jax_export.export(
-        jax.jit(step_fn),
-        platforms=list(platforms) if platforms else None)(
-        state_spec, feeds_spec, step_spec)
+    from ..ops.pallas_kernels import mosaic_lowering
+    with mosaic_lowering(bool(platforms) and "tpu" in platforms
+                         and "cpu" not in platforms):
+        # pure-TPU targets embed the real Mosaic kernels from a CPU
+        # build host; cpu-including targets keep interpret emulation
+        exp = jax_export.export(
+            jax.jit(step_fn),
+            platforms=list(platforms) if platforms else None)(
+            state_spec, feeds_spec, step_spec)
     with open(os.path.join(dirname, "train_step.bin"), "wb") as f:
         f.write(exp.serialize())
     with open(os.path.join(dirname, "train_state.bin"), "wb") as f:

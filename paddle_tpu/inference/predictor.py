@@ -257,10 +257,16 @@ class Predictor:
                 s = [bs if d == -1 else d for d in shape]
                 feeds_spec[name] = jax.ShapeDtypeStruct(
                     tuple(s), np.dtype(dt))
-            exp = jax_export.export(
-                jax.jit(fwd),
-                platforms=list(platforms) if platforms else None)(
-                state_spec, feeds_spec)
+            from paddle_tpu.ops.pallas_kernels import mosaic_lowering
+            # a pure-TPU target embeds the real Mosaic kernels even from
+            # a CPU build host; any cpu target keeps interpret emulation
+            with mosaic_lowering(bool(platforms)
+                                 and "tpu" in platforms
+                                 and "cpu" not in platforms):
+                exp = jax_export.export(
+                    jax.jit(fwd),
+                    platforms=list(platforms) if platforms else None)(
+                    state_spec, feeds_spec)
             fname = "aot_b%d.bin" % bs
             with open(os.path.join(dirname, fname), "wb") as f:
                 f.write(exp.serialize())

@@ -1,0 +1,198 @@
+"""Shape -> block-config autotuner cache for the Pallas flash-attention
+kernels.
+
+Reference analogue: none — the reference's CUDA kernels hard-code launch
+geometry per architecture. On TPU the MXU-aligned (block_q, block_kv)
+tiling choice decides whether the kernel lands near its roofline (the
+Tensor Processing Primitives observation, PAPERS.md), so the choice is
+data: ``tools/bench_attention.py --tune`` sweeps configs on the real chip
+and persists the winners here; ``flash_attention`` consults the cache at
+TRACE time, so every later jit/export of the same shape rides the tuned
+geometry with zero runtime cost.
+
+Precedence (deterministic, trace-time):
+  1. explicit block args at the call site (expert override)
+  2. nonzero ``FLAGS.flash_block_*`` (process-wide override, per field)
+  3. the tune-cache entry for (seq_len, head_dim, causal, dtype)
+  4. the MXU-aligned heuristic default
+
+The cache is one JSON file (``FLAGS.attention_tune_cache``; empty means
+<repo>/tools/attention_tune_cache.json). Entries are keyed by
+``S{seq}_D{head_dim}_c{0|1}_{dtype}`` and invalidated by file mtime, so a
+fresh ``--tune`` run takes effect without a process restart.
+"""
+
+import json
+import os
+import threading
+
+__all__ = ["AttentionConfig", "get_config", "default_config", "lookup",
+           "record", "cache_path", "config_key", "attention_vmem_bytes",
+           "MIN_LANES"]
+
+MIN_LANES = 128     # TPU lane width: the last-dim alignment quantum
+_SUBLANES = 8       # f32 sublane quantum; bf16 wants 16
+
+# candidate block edges, largest first; all MXU/VPU aligned down to the
+# interpret-mode floor (tiny CPU-suite shapes legitimately use 4/2/1)
+_CANDIDATES = (512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
+
+
+class AttentionConfig(object):
+    """Immutable block geometry for one attention shape."""
+
+    __slots__ = ("block_q", "block_kv", "block_q_bwd", "block_kv_bwd")
+
+    def __init__(self, block_q, block_kv, block_q_bwd=None,
+                 block_kv_bwd=None):
+        object.__setattr__(self, "block_q", int(block_q))
+        object.__setattr__(self, "block_kv", int(block_kv))
+        object.__setattr__(self, "block_q_bwd",
+                           int(block_q_bwd or block_q))
+        object.__setattr__(self, "block_kv_bwd",
+                           int(block_kv_bwd or block_kv))
+
+    def __setattr__(self, *a):
+        raise AttributeError("AttentionConfig is immutable")
+
+    def asdict(self):
+        return {"block_q": self.block_q, "block_kv": self.block_kv,
+                "block_q_bwd": self.block_q_bwd,
+                "block_kv_bwd": self.block_kv_bwd}
+
+    def __repr__(self):
+        return ("AttentionConfig(bq=%d, bkv=%d, bq_bwd=%d, bkv_bwd=%d)"
+                % (self.block_q, self.block_kv, self.block_q_bwd,
+                   self.block_kv_bwd))
+
+    def __eq__(self, other):
+        return (isinstance(other, AttentionConfig)
+                and self.asdict() == other.asdict())
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+
+def config_key(seq_len, head_dim, causal, dtype):
+    return "S%d_D%d_c%d_%s" % (int(seq_len), int(head_dim),
+                               1 if causal else 0, str(dtype))
+
+
+def cache_path():
+    from ..flags import FLAGS
+    p = FLAGS.attention_tune_cache
+    if p:
+        return os.path.expanduser(p)
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "tools",
+        "attention_tune_cache.json")
+
+
+# path -> (mtime, entries); a --tune run in another process shows up via
+# the mtime check, a record() in this one invalidates explicitly
+_memo = {}
+_memo_lock = threading.Lock()
+
+
+def _load(path):
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return {}
+    with _memo_lock:
+        hit = _memo.get(path)
+        if hit is not None and hit[0] == mtime:
+            return hit[1]
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+        entries = raw.get("configs", raw) if isinstance(raw, dict) else {}
+    except (OSError, ValueError):
+        entries = {}
+    with _memo_lock:
+        _memo[path] = (mtime, entries)
+    return entries
+
+
+def lookup(seq_len, head_dim, causal, dtype):
+    """Tune-cache entry for the shape, or None on a miss."""
+    entries = _load(cache_path())
+    rec = entries.get(config_key(seq_len, head_dim, causal, dtype))
+    if not isinstance(rec, dict):
+        return None
+    try:
+        return AttentionConfig(rec["block_q"], rec["block_kv"],
+                               rec.get("block_q_bwd"),
+                               rec.get("block_kv_bwd"))
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def record(seq_len, head_dim, causal, dtype, config, extra=None,
+           path=None):
+    """Persist a tuned config (read-modify-write; bench_attention --tune)."""
+    path = path or cache_path()
+    entries = dict(_load(path))
+    rec = config.asdict()
+    if extra:
+        rec.update(extra)
+    entries[config_key(seq_len, head_dim, causal, dtype)] = rec
+    d = os.path.dirname(path)
+    if d and not os.path.isdir(d):
+        os.makedirs(d)
+    with open(path, "w") as f:
+        json.dump(entries, f, indent=2, sort_keys=True)
+    with _memo_lock:
+        _memo.pop(path, None)
+    return path
+
+
+def _pick_block(seq_len, cap):
+    for b in _CANDIDATES:
+        if b <= cap and seq_len % b == 0:
+            # a 1-row block only ever makes sense for a 1-row sequence;
+            # a prime length degrades to the XLA path instead
+            if b == 1 and seq_len > 1:
+                return None
+            return b
+    return None
+
+
+def default_config(seq_len, head_dim, dtype="bfloat16"):
+    """MXU-aligned heuristic: the largest candidate edge <= 128 that
+    divides the sequence (128 = one MXU pass per tile edge; larger tiles
+    only win when --tune proves it on the target shape). Returns None
+    when no candidate divides seq_len (caller falls back to plain
+    attention)."""
+    b = _pick_block(seq_len, MIN_LANES)
+    if b is None:
+        return None
+    return AttentionConfig(b, b, b, b)
+
+
+def get_config(seq_len, head_dim, causal, dtype):
+    """Trace-time config resolution: FLAGS override > cache > heuristic.
+    Fields are resolved independently so a single-flag override rides the
+    cache for the rest. Returns None when no geometry divides seq_len."""
+    from ..flags import FLAGS
+    base = lookup(seq_len, head_dim, causal, dtype) \
+        or default_config(seq_len, head_dim, dtype)
+    if base is None:
+        return None
+    picked = {}
+    for field in ("block_q", "block_kv", "block_q_bwd", "block_kv_bwd"):
+        v = int(getattr(FLAGS, "flash_" + field))
+        picked[field] = v if v > 0 else getattr(base, field)
+    return AttentionConfig(**picked)
+
+
+def attention_vmem_bytes(head_dim, block_q, block_kv, itemsize=2):
+    """Rough single-program VMEM footprint of the forward kernel: the
+    q/k/v tiles, the fp32 scores tile, and the fp32 accumulator + m/l
+    state (lane-replicated). The tuner skips configs past the budget
+    instead of discovering Mosaic allocation failures on chip."""
+    return (block_q * head_dim * itemsize          # q tile
+            + 2 * block_kv * head_dim * itemsize   # k + v tiles
+            + block_q * block_kv * 4               # scores/p (fp32)
+            + block_q * head_dim * 4               # acc (fp32)
+            + 2 * block_q * MIN_LANES * 4)         # m + l (fp32)

@@ -752,7 +752,33 @@ def _roi_pool(ctx):
     return {"Out": out, "Argmax": None}
 
 
+# default for FLAGS.roi_align_adaptive_cap (kept as a module constant so
+# existing imports keep meaning "the built-in default")
 _ROI_ALIGN_ADAPTIVE_CAP = 8
+
+_roi_cap_warned = [False]
+
+
+def _warn_roi_cap_clip(rois, ph, pw, scale, cap):
+    """One-time warning when a CONCRETE roi's adaptive grid actually
+    exceeds the cap (traced rois are data-dependent; nothing to check)."""
+    import jax
+    if _roi_cap_warned[0] or isinstance(rois, jax.core.Tracer):
+        return
+    import warnings
+    r = np.asarray(rois, np.float64).reshape(-1, rois.shape[-1])
+    rw = np.maximum(r[:, 2] * scale - r[:, 0] * scale, 1.0)
+    rh = np.maximum(r[:, 3] * scale - r[:, 1] * scale, 1.0)
+    need = max(float(np.max(np.ceil(rh / ph), initial=0.0)),
+               float(np.max(np.ceil(rw / pw), initial=0.0)))
+    if need > cap:
+        _roi_cap_warned[0] = True
+        warnings.warn(
+            "roi_align: a roi's adaptive sampling grid needs %d points "
+            "per bin but FLAGS.roi_align_adaptive_cap=%d clips it to a "
+            "%dx%d uniform subsample; raise the flag for exact "
+            "reference parity on large rois (warning fires once)"
+            % (int(need), cap, cap, cap))
 
 
 @register_op("roi_align")
@@ -761,10 +787,12 @@ def _roi_align(ctx):
     sampling_ratio > 0 is a fixed grid; <= 0 is the reference's
     per-roi ADAPTIVE grid of ceil(roi_h/ph) x ceil(roi_w/pw) points —
     emulated exactly under static shapes by evaluating a capped
-    [S_max, S_max] grid and masking samples beyond the roi's own count
-    (cap 8: a roi would need to span >8 bins' worth of feature rows
-    per pooled cell to clip, and the cap then degrades gracefully to
-    an 8x8 subsample). Pinned by tests/test_roi_align_oracle.py."""
+    [S_max, S_max] grid and masking samples beyond the roi's own count.
+    The cap is FLAGS.roi_align_adaptive_cap (default 8: a roi would need
+    to span >8 bins' worth of feature rows per pooled cell to clip, and
+    the cap then degrades gracefully to a cap x cap subsample; a one-time
+    warning fires when eager inputs actually clip). Pinned by
+    tests/test_roi_align_oracle.py."""
     import jax
     jnp = _jnp()
     x = ctx.input("X")
@@ -779,7 +807,12 @@ def _roi_align(ctx):
     if squeeze:
         rois = rois[None]
     R = rois.shape[1]
-    S = ratio if ratio > 0 else _ROI_ALIGN_ADAPTIVE_CAP
+    if ratio > 0:
+        S = ratio
+    else:
+        from ..flags import FLAGS
+        S = int(FLAGS.roi_align_adaptive_cap)
+        _warn_roi_cap_clip(rois, ph, pw, scale, S)
 
     def bilinear(feat, ys, xs):
         """feat [C, H, W]; ys/xs [...]: bilinear sample -> [C, ...]"""

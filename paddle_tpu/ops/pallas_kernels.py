@@ -7,208 +7,412 @@ chains into matmuls. Flash attention is the headline case: the [S, S] score
 matrix never leaves VMEM, with online-softmax accumulation over K/V blocks
 (see /opt/skills/guides/pallas_guide.md).
 
-The kernel runs in interpret mode off-TPU so the same code path is unit
-tested on the CPU mesh. Gradients via jax.custom_vjp: the backward pass is
-a blockwise (flash-style) recomputation in plain XLA — O(S * block) memory.
+All three attention kernels (forward, backward-dq, backward-dkv) are
+block-size-parameterized and stream their non-resident operand through
+the grid pipeline — K/V tiles for the q-stationary kernels, Q/dO tiles
+for the kv-stationary one — with MXU-aligned tiles, bf16-native matmuls
+and fp32 accumulation in VMEM scratch. Block geometry resolves per shape
+at trace time through ops/attention_tuning.py (FLAGS override > tune
+cache > heuristic); `tools/bench_attention.py --tune` writes the cache.
+
+The kernels run in interpret mode off-TPU so the same code paths are unit
+tested on the CPU mesh; `interpret=None` defers the choice to lowering
+time so cross-platform exports embed the real Mosaic modules for tpu.
 """
 
+import contextlib
 import functools
+import threading
 
 import numpy as np
 
-__all__ = ["flash_attention", "fused_bottleneck", "bottleneck_reference"]
+from . import attention_tuning
 
+__all__ = ["flash_attention", "fused_bottleneck", "bottleneck_reference",
+           "mosaic_lowering"]
+
+# Finite mask value (not -inf): exp(_NEG_INF - finite) underflows to an
+# exact 0, and the logsumexp of a fully-masked row stays finite, so the
+# ring-hop merge (parallel/ring_attention.py) never sees inf - inf.
 _NEG_INF = -1e30
+_TINY = 1e-20
+_MIN_LANES = attention_tuning.MIN_LANES
+
+
+def _compiler_params(**kw):
+    """jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5;
+    resolve whichever this install ships."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
+_DISPATCH = threading.local()
+
+
+@contextlib.contextmanager
+def mosaic_lowering(enable=True):
+    """Force the interpret-vs-Mosaic choice for ``interpret=None`` call
+    sites in this thread. functionalizer.export_step_for_tpu enters this
+    while tracing, so off-chip TPU exports from a CPU-only host embed the
+    real Mosaic kernels."""
+    prev = getattr(_DISPATCH, "force_kernel", None)
+    _DISPATCH.force_kernel = bool(enable)
+    try:
+        yield
+    finally:
+        _DISPATCH.force_kernel = prev
 
 
 def _interpret_dispatch(call, interpret, *ops):
     """Kernel-vs-interpret dispatch shared by every Pallas entry point:
-    an explicit `interpret` wins; None defers to LOWERING-time platform
-    selection so cross-/multi-platform exports embed the real Mosaic
-    kernel for tpu and interpret emulation elsewhere."""
+    an explicit `interpret` wins; None resolves at TRACE time — the real
+    kernel when the trace targets TPU (tpu backend, or inside a
+    mosaic_lowering() export context), interpret emulation elsewhere.
+
+    This jax's lax.platform_dependent cannot serve here: it stages the
+    dead Mosaic branch into single-platform CPU jits, whose pallas
+    lowering rejects interpret=False outright."""
     import jax
-    if interpret is not None:
-        return call(interpret, *ops)
-    return jax.lax.platform_dependent(
-        *ops,
-        tpu=functools.partial(call, False),
-        default=functools.partial(call, True))
+    if interpret is None:
+        force = getattr(_DISPATCH, "force_kernel", None)
+        interpret = (jax.default_backend() != "tpu") if force is None \
+            else not force
+    return call(interpret, *ops)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
-            block_k):
-    """One (batch*head, q-block) program: fori_loop over K/V blocks with
-    the online-softmax state held in registers/VMEM values (no scratch
-    round-trips)."""
+def _causal_tile_live(iq, ik, block_q, block_kv):
+    """A (q-tile, kv-tile) pair intersects the causal lower triangle iff
+    the tile's first k row is <= its last q row."""
+    return ik * block_kv <= (iq + 1) * block_q - 1
+
+
+def _causal_tile_mask(s, iq, ik, block_q, block_kv):
+    import jax
+    import jax.numpy as jnp
+    qpos = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0)
+    kpos = ik * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1)
+    return jnp.where(kpos > qpos, _NEG_INF, s)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                l_ref, *, scale, causal, block_q, block_kv):
+    """One (batch*head, q-block, kv-block) grid step. Q and the fp32
+    accumulator/m/l state stay resident across the innermost kv axis
+    (the pipeline streams K/V tiles); the finished tile normalizes into
+    o and emits the row logsumexp residual for the fused backward."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
-    S = k_ref.shape[1]
-    nk = S // block_k
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    q = q_ref[0]                      # [BQ, D]
-    qpos = iq * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def compute(ik, state):
-        o, l, m = state
-        k = k_ref[0, pl.ds(ik * block_k, block_k), :]
-        v = v_ref[0, pl.ds(ik * block_k, block_k), :]
+    def compute():
+        q = q_ref[0]                                   # [BQ, D]
+        k = k_ref[0]                                   # [BKV, D]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            kpos = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos > qpos, _NEG_INF, s)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)                    # [BQ, BK]
-        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+            s = _causal_tile_mask(s, iq, ik, block_q, block_kv)
+        m_prev = m_ref[...]                            # [BQ, LANES]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+        alpha = jnp.exp(m_prev - m_new)                # [BQ, LANES]
+        p = jnp.exp(s - m_new[:, :1])                  # [BQ, BKV] f32
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1)[:, None]
         pv = jax.lax.dot_general(p.astype(v.dtype), v,
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        o = o * alpha + pv
-        return o, l, m_new
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
+        m_ref[...] = m_new
 
     if causal:
-        # fixed trip count (keeps the loop pipelineable); blocks entirely
-        # above the diagonal are skipped with a cheap predicate
-        def body(ik, state):
-            return jax.lax.cond(
-                ik * block_k <= (iq + 1) * block_q - 1,
-                lambda st: compute(ik, st), lambda st: st, state)
+        # tiles entirely above the diagonal skip compute (the DMA for the
+        # tile is already in flight; the MXU work is what matters)
+        @pl.when(_causal_tile_live(iq, ik, block_q, block_kv))
+        def _():
+            compute()
     else:
-        body = compute
+        compute()
 
-    o0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    o, l, _ = jax.lax.fori_loop(0, nk, body, (o0, l0, m0))
-    o_ref[0] = (o / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], _TINY)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, :1] + jnp.log(l)
 
 
-def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_k,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
+                   acc_ref, *, scale, causal, block_q, block_kv):
+    """dQ kernel, q-stationary: stream K/V tiles under a resident
+    (q, do, lse, di) block, accumulate dq in fp32 scratch."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                               # [BQ, 1]
+        di = di_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_tile_mask(s, iq, ik, block_q, block_kv)
+        p = jnp.exp(s - lse)                           # [BQ, BKV] f32
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - di) * scale).astype(k.dtype)
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(_causal_tile_live(iq, ik, block_q, block_kv))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref, dk_ref,
+                    dv_ref, dk_acc, dv_acc, *, scale, causal, block_q,
+                    block_kv):
+    """dK/dV kernel, kv-stationary: stream (q, do, lse, di) tiles under a
+    resident K/V block — the transposed iteration order of the dq kernel,
+    so neither gradient needs a cross-program reduction."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q = q_ref[0]
+        do = do_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        lse = lse_ref[0]
+        di = di_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_tile_mask(s, iq, ik, block_q, block_kv)
+        p = jnp.exp(s - lse)                           # [BQ, BKV] f32
+        pv = p.astype(do.dtype)
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+            pv, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - di) * scale).astype(q.dtype)
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(_causal_tile_live(iq, ik, block_q, block_kv))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_kv,
                       interpret):
-    """q,k,v [BH, S, D] -> o [BH, S, D]."""
+    """q,k,v [BH, S, D] -> (o [BH, S, D], lse [BH, S] f32)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     BH, S, D = q.shape
-    nq = S // block_q
-    grid = (BH, nq)
-    kern = functools.partial(_kernel, scale=scale, causal=causal,
-                             block_q=block_q, block_k=block_k)
+    grid = (BH, S // block_q, S // block_kv)
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             block_q=block_q, block_kv=block_kv)
 
     def call(interp, *ops):
         return pl.pallas_call(
             kern,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, D),
-                                   lambda b, i: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "arbitrary")),
+            out_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+                jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, D), jnp.float32),
+                pltpu.VMEM((block_q, _MIN_LANES), jnp.float32),
+                pltpu.VMEM((block_q, _MIN_LANES), jnp.float32),
+            ],
+            compiler_params=_compiler_params(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=interp,
         )(*ops)
 
-    return _interpret_dispatch(call, interpret, q, k, v)
+    o, lse = _interpret_dispatch(call, interpret, q, k, v)
+    return o, lse[..., 0]
 
 
-def _softmax_stats(q, k, scale, causal, block_k):
-    """Recompute per-row logsumexp L [BH, S] blockwise — only [S, block_k]
-    score tiles live, matching the O(S*block) memory of the rest of the
-    backward."""
+def _flash_bwd_pallas(q, k, v, do, lse, di, scale, causal, block_q,
+                      block_kv, interpret):
+    """Fused backward: two kernels with transposed stationarity.
+    di = rowsum(do * o) - dlse (the dlse term folds the lse output's
+    cotangent into the same ds formula: d lse_i / d s_ij = p_ij)."""
     import jax
     import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
     BH, S, D = q.shape
-    nb = S // block_k
-    qpos = jnp.arange(S)
+    nq, nk = S // block_q, S // block_kv
+    lse = lse[..., None]
+    di = di[..., None]
+    qspec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    rowspec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    kvspec = pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0))
+    dq_kern = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                                block_q=block_q, block_kv=block_kv)
 
-    def block(carry, jb):
-        m, l = carry
-        ks = jax.lax.dynamic_slice_in_dim(k, jb * block_k, block_k, 1)
-        s = jnp.einsum("bqd,bkd->bqk", q, ks) * scale
-        if causal:
-            kpos = jb * block_k + jnp.arange(block_k)
-            s = jnp.where((kpos[None, :] > qpos[:, None])[None],
-                          _NEG_INF, s)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        l = l * jnp.exp(m - m_new) + jnp.sum(
-            jnp.exp(s - m_new[..., None]), axis=-1)
-        return (m_new, l), None
+    def call_dq(interp, *ops):
+        return pl.pallas_call(
+            dq_kern,
+            grid=(BH, nq, nk),
+            in_specs=[qspec, kvspec, kvspec, qspec, rowspec, rowspec],
+            out_specs=qspec,
+            out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+            compiler_params=_compiler_params(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interp,
+        )(*ops)
 
-    m0 = jnp.full((BH, S), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((BH, S), jnp.float32)
-    (m, l), _ = jax.lax.scan(block, (m0, l0), jnp.arange(nb))
-    return m + jnp.log(jnp.maximum(l, 1e-20))
+    dq = _interpret_dispatch(call_dq, interpret, q, k, v, do, lse, di)
+
+    # kv-stationary: grid axis 1 walks KV blocks, innermost streams Q
+    qspec_t = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
+    rowspec_t = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+    kvspec_t = pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0))
+    dkv_kern = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                 causal=causal, block_q=block_q,
+                                 block_kv=block_kv)
+
+    def call_dkv(interp, *ops):
+        return pl.pallas_call(
+            dkv_kern,
+            grid=(BH, nk, nq),
+            in_specs=[qspec_t, qspec_t, rowspec_t, rowspec_t, kvspec_t,
+                      kvspec_t],
+            out_specs=[kvspec_t, kvspec_t],
+            out_shape=[jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+                       jax.ShapeDtypeStruct((BH, S, D), v.dtype)],
+            scratch_shapes=[pltpu.VMEM((block_kv, D), jnp.float32),
+                            pltpu.VMEM((block_kv, D), jnp.float32)],
+            compiler_params=_compiler_params(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interp,
+        )(*ops)
+
+    dk, dv = _interpret_dispatch(call_dkv, interpret, q, do, lse, di, k, v)
+    return dq, dk, dv
 
 
-def _flash_bwd(scale, causal, block_k, res, do):
-    """Blockwise flash backward in plain XLA: scan over K/V blocks, keeping
-    only [S, block] score tiles live."""
-    import jax
+def _reference_lse(q, k, scale, causal):
+    """Plain-XLA row logsumexp for the non-tileable fallback path (same
+    finite-mask convention as the kernels)."""
     import jax.numpy as jnp
-    q, k, v, o = res
-    BH, S, D = q.shape
-    L = _softmax_stats(q, k, scale, causal, block_k)   # [BH, S]
-    Drow = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                   axis=-1)                        # [BH, S]
-    nb = S // block_k
-    qpos = jnp.arange(S)
-
-    def block(carry, jb):
-        dq = carry
-        ks = jax.lax.dynamic_slice_in_dim(k, jb * block_k, block_k, 1)
-        vs = jax.lax.dynamic_slice_in_dim(v, jb * block_k, block_k, 1)
-        s = jnp.einsum("bqd,bkd->bqk", q, ks) * scale
-        if causal:
-            kpos = jb * block_k + jnp.arange(block_k)
-            s = jnp.where((kpos[None, :] > qpos[:, None])[None],
-                          _NEG_INF, s)
-        p = jnp.exp(s - L[..., None])              # [BH, S, BK]
-        dv = jnp.einsum("bqk,bqd->bkd", p, do.astype(p.dtype))
-        dp = jnp.einsum("bqd,bkd->bqk", do.astype(p.dtype), vs)
-        ds = p * (dp - Drow[..., None])
-        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, ks) * scale
-        dk = jnp.einsum("bqk,bqd->bkd", ds, q) * scale
-        return dq, (dk, dv)
-
-    dq0 = jnp.zeros(q.shape, jnp.float32)
-    dq, (dks, dvs) = jax.lax.scan(block, dq0, jnp.arange(nb))
-    dk = jnp.moveaxis(dks, 0, 1).reshape(BH, S, D)
-    dv = jnp.moveaxis(dvs, 0, 1).reshape(BH, S, D)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.arange(Sk)[None, :] > jnp.arange(Sq)[:, None]
+        s = jnp.where(mask[None, :, None, :], _NEG_INF, s)
+    m = jnp.max(s, axis=-1)
+    return m + jnp.log(jnp.maximum(
+        jnp.sum(jnp.exp(s - m[..., None]), axis=-1), _TINY))
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
-                    block_k=None, interpret=None):
-    """Fused attention: q,k,v [B, S, H, D] -> [B, S, H, D].
+                    block_kv=None, block_q_bwd=None, block_kv_bwd=None,
+                    interpret=None, return_lse=False, block_k=None):
+    """Fused attention: q,k,v [B, S, H, D] -> [B, S, H, D]
+    (or (out, lse [B, S, H] f32) with return_lse — the residual the
+    ring-attention hop merge consumes).
 
-    Pallas kernel on TPU (interpret-mode elsewhere); differentiable via a
-    blockwise custom VJP. Falls back to plain attention when S is not
-    divisible by the block size."""
+    Pallas kernel pair on TPU (interpret-mode elsewhere): a tiled
+    forward emitting the row logsumexp, and a fused backward (dq +
+    dkv kernels) via custom VJP. Block geometry defaults per shape
+    through ops/attention_tuning.py (FLAGS override > tune cache >
+    MXU-aligned heuristic); explicit block args win over all of it.
+    Falls back to plain attention when no geometry divides S.
+    `block_k` is the pre-tuning alias of `block_kv`."""
     import jax
     import jax.numpy as jnp
 
     B, S, H, D = q.shape
     scale = float(scale if scale is not None else 1.0 / np.sqrt(D))
-    bq = block_q or min(128, S)
-    bk = block_k or min(128, S)
-    if S % bq or S % bk:
+    block_kv = block_kv or block_k
+    cfg = attention_tuning.get_config(S, D, causal,
+                                      jnp.dtype(q.dtype).name)
+    bq = int(block_q or (cfg.block_q if cfg else 0))
+    bkv = int(block_kv or (cfg.block_kv if cfg else 0))
+    bq_b = int(block_q_bwd or (cfg.block_q_bwd if cfg else 0)) or bq
+    bkv_b = int(block_kv_bwd or (cfg.block_kv_bwd if cfg else 0)) or bkv
+    if (not bq or not bkv or S % bq or S % bkv or S % bq_b or S % bkv_b):
         from ..parallel.ring_attention import local_attention
-        return local_attention(q, k, v, causal=causal, scale=scale)
+        out = local_attention(q, k, v, causal=causal, scale=scale)
+        if return_lse:
+            return out, _reference_lse(q, k, scale, causal)
+        return out
     # interpret=None defers the interpret-vs-Mosaic choice to LOWERING
-    # time (_flash_fwd_pallas platform_dependent), so cross-platform
-    # exports embed the real kernel for tpu
+    # time (_interpret_dispatch platform_dependent), so cross-platform
+    # exports embed the real kernels for tpu
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
@@ -218,16 +422,30 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
 
     @jax.custom_vjp
     def _fa(qb, kb, vb):
-        return _flash_fwd_pallas(qb, kb, vb, scale, causal, bq, bk,
+        return _flash_fwd_pallas(qb, kb, vb, scale, causal, bq, bkv,
                                  interpret)
 
     def _fa_fwd(qb, kb, vb):
-        o = _flash_fwd_pallas(qb, kb, vb, scale, causal, bq, bk, interpret)
-        return o, (qb, kb, vb, o)
+        o, lse = _flash_fwd_pallas(qb, kb, vb, scale, causal, bq, bkv,
+                                   interpret)
+        return (o, lse), (qb, kb, vb, o, lse)
 
-    _fa.defvjp(_fa_fwd, functools.partial(_flash_bwd, scale, causal, bk))
+    def _fa_bwd(res, cts):
+        qb, kb, vb, o, lse = res
+        do, dlse = cts
+        di = (jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                      axis=-1)
+              - dlse.astype(jnp.float32))              # [BH, S]
+        return _flash_bwd_pallas(qb, kb, vb, do.astype(qb.dtype), lse,
+                                 di, scale, causal, bq_b, bkv_b,
+                                 interpret)
 
-    return from_bh(_fa(to_bh(q), to_bh(k), to_bh(v)))
+    _fa.defvjp(_fa_fwd, _fa_bwd)
+
+    o, lse = _fa(to_bh(q), to_bh(k), to_bh(v))
+    if return_lse:
+        return from_bh(o), lse.reshape(B, H, S).transpose(0, 2, 1)
+    return from_bh(o)
 
 
 # ---------------------------------------------------------------------------
@@ -507,7 +725,7 @@ def fused_bottleneck(x, w0, b0, w1, b1, w2, b2, ws=None, bs=None,
             out_specs=pl.BlockSpec((1, bh, Wo, C4),
                                    lambda b, i: (b, i, 0, 0)),
             out_shape=jax.ShapeDtypeStruct((N, Ho, Wo, C4), x.dtype),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("parallel", "arbitrary")),
             interpret=interp,
         )(*ops)

@@ -415,6 +415,14 @@ def _sequence_reshape(ctx):
         if lens is not None:
             r["Out@LOD_LEN"] = lens
         return r
+    if lens is None and (T * D) % new_dim != 0:
+        # dense path: every row is one full T-step sequence, so the
+        # reference's per-sequence PADDLE_ENFORCE(seq_len * in_width %
+        # new_dim == 0) applies to T*D directly — refuse rather than
+        # silently padding a final partial row (sequence_reshape_op.h)
+        raise ValueError(
+            "sequence_reshape: T*D = %d*%d = %d not divisible by "
+            "new_dim %d" % (T, D, T * D, new_dim))
     # static padded output length: the longest possible re-chunked row
     # count given T timesteps of D values
     T_out = -(-(T * D) // new_dim)

@@ -51,7 +51,16 @@ def local_device_count(use_cuda=True):
 
 
 def make_mesh(axis_sizes, devices=None):
-    """axis_sizes: dict axis-name -> size (row-major over the device list)."""
+    """axis_sizes: dict axis-name -> size (row-major over the device list).
+
+    RNG caveat (jax 0.4.x, legacy threefry): jax.random bits CHANGE with
+    an array's sharding, so a seeded op (dropout) computes a different
+    mask on a mesh than replicated on one device. Harnesses that assert
+    replicated-vs-sharded trajectory PARITY must flip
+    ``jax_threefry_partitionable`` first (see __graft_entry__.py) — not
+    done here because the flag redefines every seeded stream
+    process-wide, and flipping it lazily at first-mesh-use makes RNG
+    order-dependent across a test session."""
     import jax
     from jax.sharding import Mesh
     if devices is None:
@@ -81,3 +90,16 @@ def get_shard_map():
     except ImportError:       # older jax
         from jax.experimental.shard_map import shard_map
     return shard_map
+
+
+def shard_map_no_rep_check(fn, mesh, in_specs, out_specs):
+    """shard_map with replication checking disabled — required for shard
+    bodies that invoke Pallas kernels (jax has no replication rule for
+    pallas_call). The kwarg was renamed across jax versions."""
+    sm = get_shard_map()
+    try:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+    except TypeError:          # jax >= 0.8
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)

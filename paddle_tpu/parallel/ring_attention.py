@@ -9,6 +9,15 @@ materializes the full [S, S] score matrix or the full K/V.
 
 Use inside shard_map over a mesh with a `seq` axis (helper
 `ring_attention_sharded` wraps that), sequence sharded as [B, S/n, H, D].
+
+Each hop's local block is computed by the TUNED Pallas flash kernel
+(ops/pallas_kernels.py, geometry via ops/attention_tuning.py) when
+FLAGS.ring_use_flash is set (default): the kernel returns the block's
+normalized output plus its row logsumexp, and hops merge by the
+numerically-stable logsumexp combine — so multi-chip sequence
+parallelism rides the same kernel single-chip attention does, and no
+hop ever materializes its [S_loc, S_loc] score tile. The plain-XLA
+online-softmax update remains as the flag-off / non-tileable path.
 """
 
 import functools
@@ -16,6 +25,23 @@ import functools
 import numpy as np
 
 __all__ = ["ring_attention", "ring_attention_sharded", "local_attention"]
+
+_NEG_INF = -1e30   # finite: matches the kernel's mask value, keeps the
+                   # fully-masked-hop merge free of inf - inf
+
+
+def _merge_hops(o, lse, o_t, lse_t):
+    """Combine two normalized partial attentions over disjoint K sets:
+    (o, lse) <- logsumexp merge. A hop with lse_t = _NEG_INF (fully
+    masked) contributes weight exp(_NEG_INF - finite) = 0 exactly."""
+    import jax.numpy as jnp
+    m = jnp.maximum(lse, lse_t)
+    wa = jnp.exp(lse - m)
+    wb = jnp.exp(lse_t - m)
+    # both-empty rows: lse == lse_t == _NEG_INF -> wa = wb = 1, no 0/0
+    o_new = (o * wa[..., None] + o_t.astype(o.dtype) * wb[..., None]) \
+        / (wa + wb)[..., None]
+    return o_new, m + jnp.log(wa + wb)
 
 
 def _online_block_update(o, l, m, q, k, v, mask, scale):
@@ -60,21 +86,63 @@ def local_attention(q, k, v, causal=False, q_offset=0, k_offset=0,
     return jnp.einsum("bhqk,bkhd->bqhd", p / denom, v)
 
 
-def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+def _ring_flash(q, k, v, axis_name, causal, scale):
+    """Flash-kernel ring body: every hop runs the tuned Pallas kernel on
+    its local block and merges by logsumexp. For causal, hop 0 is the
+    diagonal (causal kernel); later hops are either fully visible
+    (origin strictly behind this rank — non-causal kernel) or fully
+    masked (origin ahead — contribution zeroed via lse = -inf), so no
+    hop needs cross-shard mask coordinates inside the kernel."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.pallas_kernels import flash_attention
+
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    B, S_loc, H, D = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full((B, S_loc, H), _NEG_INF, jnp.float32)
+    kb, vb = k, v
+    for t in range(n):                 # static: n is a mesh constant
+        src = (rank - t) % n           # block origin
+        o_t, lse_t = flash_attention(q, kb, vb, causal=causal and t == 0,
+                                     scale=scale, return_lse=True)
+        if causal and t > 0:
+            # whole hop visible iff the K/V block originated behind us
+            lse_t = jnp.where(src < rank, lse_t, _NEG_INF)
+        o, lse = _merge_hops(o, lse, o_t, lse_t)
+        if t + 1 < n:
+            kb = jax.lax.ppermute(kb, axis_name, perm)
+            vb = jax.lax.ppermute(vb, axis_name, perm)
+    return o.astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   use_flash=None):
     """Per-shard body: call INSIDE shard_map/pjit with q,k,v local blocks
     [B, S_loc, H, D] sharded over `axis_name`. Returns the local output
     block [B, S_loc, H, D].
 
     K/V make a full trip around the ring (n hops); hop t processes the
     block that originated on device (rank - t) mod n, with the causal mask
-    evaluated in global coordinates."""
+    evaluated in global coordinates. `use_flash=None` defers to
+    FLAGS.ring_use_flash (trace-time): the flash path computes each hop
+    with the tuned Pallas kernel and merges by logsumexp."""
     import jax
     import jax.numpy as jnp
+
+    scale = scale if scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    if use_flash is None:
+        from ..flags import FLAGS
+        use_flash = bool(FLAGS.ring_use_flash)
+    if use_flash:
+        return _ring_flash(q, k, v, axis_name, causal, scale)
 
     n = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     B, S_loc, H, D = q.shape
-    scale = scale if scale is not None else 1.0 / np.sqrt(D)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     q_pos = rank * S_loc + jnp.arange(S_loc)                 # global q rows
@@ -112,11 +180,10 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="seq", causal=False,
     ring_attention under shard_map with S sharded over `axis_name`."""
     import jax
     from jax.sharding import PartitionSpec as P
-    from .mesh import get_shard_map
-    shard_map = get_shard_map()
+    from .mesh import shard_map_no_rep_check
 
     spec = P(None, axis_name, None, None)
-    fn = shard_map(
+    fn = shard_map_no_rep_check(
         functools.partial(ring_attention, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

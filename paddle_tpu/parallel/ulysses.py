@@ -38,8 +38,16 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
     qh = seq_to_heads(q)      # [B, S, H/n, D]
     kh = seq_to_heads(k)
     vh = seq_to_heads(v)
-    out = local_attention(qh, kh, vh, causal=causal, q_offset=0, k_offset=0,
-                          scale=scale)
+    from ..flags import FLAGS
+    if FLAGS.ring_use_flash:
+        # after the reshard every device holds FULL sequences for its
+        # head group — exactly the tuned flash kernel's shape (it falls
+        # back to local_attention itself when S doesn't tile)
+        from ..ops.pallas_kernels import flash_attention
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    else:
+        out = local_attention(qh, kh, vh, causal=causal, q_offset=0,
+                              k_offset=0, scale=scale)
     return heads_to_seq(out)
 
 
@@ -48,11 +56,10 @@ def ulysses_attention_sharded(q, k, v, mesh, axis_name="seq", causal=False,
     """q,k,v GLOBAL [B, S, H, D]; S sharded over `axis_name` in/out."""
     import jax
     from jax.sharding import PartitionSpec as P
-    from .mesh import get_shard_map
-    shard_map = get_shard_map()
+    from .mesh import shard_map_no_rep_check
 
     spec = P(None, axis_name, None, None)
-    fn = shard_map(
+    fn = shard_map_no_rep_check(
         functools.partial(ulysses_attention, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

@@ -1,5 +1,12 @@
-"""Pallas flash-attention kernel tests (interpret mode on the CPU mesh);
-numeric parity + gradient parity against plain attention."""
+"""Pallas flash-attention kernel tests (interpret mode on the CPU mesh):
+numeric + gradient parity against plain attention, off-chip TPU lowering
+of the forward AND fused backward kernels, autotuner-cache semantics,
+and the bench_attention --smoke/--tune plumbing."""
+
+import json
+import os
+import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -7,6 +14,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.flags import FLAGS, set_flags, get_flags
+from paddle_tpu.ops import attention_tuning
 from paddle_tpu.ops.pallas_kernels import flash_attention
 from paddle_tpu.parallel import local_attention
 
@@ -18,13 +27,15 @@ def test_flash_attention_matches_reference(causal):
     q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
     k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
     v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
-    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_kv=64)
     ref = local_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_grads(causal):
+    """The fused backward kernel pair (dq + dkv) against plain-XLA AD —
+    asymmetric fwd/bwd blocks so all four geometry knobs engage."""
     rng = np.random.RandomState(1)
     B, S, H, D = 1, 64, 2, 16
     q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
@@ -32,13 +43,49 @@ def test_flash_attention_grads(causal):
     v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
 
     def loss_flash(q, k, v):
-        o = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        o = flash_attention(q, k, v, causal=causal, block_q=32,
+                            block_kv=16, block_q_bwd=16, block_kv_bwd=32)
         return jnp.sum(o * o)
 
     def loss_ref(q, k, v):
         return jnp.sum(local_attention(q, k, v, causal=causal) ** 2)
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   err_msg="d" + name)
+
+
+def test_flash_attention_lse_residual():
+    """return_lse (the ring-hop merge residual) matches a dense
+    logsumexp, and its cotangent flows through the fused backward."""
+    rng = np.random.RandomState(7)
+    B, S, H, D = 1, 64, 2, 16
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    out, lse = flash_attention(q, k, v, causal=True, return_lse=True,
+                               block_q=32, block_kv=32)
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k) / np.sqrt(D)
+    mask = jnp.arange(S)[None, :] > jnp.arange(S)[:, None]
+    s = jnp.where(mask[None, :, None, :], -1e30, s)
+    ref = jax.nn.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref), atol=2e-5)
+
+    def loss(q, k, v):
+        o, lse = flash_attention(q, k, v, causal=True, return_lse=True,
+                                 block_q=32, block_kv=32)
+        return jnp.sum(o * o) + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q, k, v):
+        o = local_attention(q, k, v, causal=True)
+        s_ = jnp.einsum("bqhd,bkhd->bqhk", q, k) / np.sqrt(D)
+        s_ = jnp.where(mask[None, :, None, :], -1e30, s_)
+        return jnp.sum(o * o) + jnp.sum(
+            jnp.sin(jax.nn.logsumexp(s_, axis=-1)))
+
+    gf = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b, name in zip(gf, gr, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
@@ -53,7 +100,7 @@ def test_flash_attention_fallback_odd_length():
     v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
     # explicit block 64 does not divide S=10 -> the local_attention
     # fallback branch must run (and honor causal + scale)
-    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_kv=64)
     ref = local_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
@@ -65,7 +112,195 @@ def test_flash_attention_under_jit():
     k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
     v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
     f = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=True,
-                                                block_q=32, block_k=32))
+                                                block_q=32, block_kv=32))
     out = f(q, k, v)
     ref = local_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_op_shape_inference():
+    """The op's output shape must resolve at BUILD time (jax.eval_shape
+    through the lowering): a flash_attention feeding an fc is exactly
+    the transformer-block composition, and with the old
+    platform_dependent dispatch eval_shape threw, the output var kept
+    shape None, and the downstream fc crashed — the transformer could
+    not even be built on a CPU host."""
+    import paddle_tpu.fluid as fluid
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16, 32], dtype="float32")
+        att = fluid.layers.flash_attention(x, x, x, num_heads=4,
+                                           causal=True)
+        assert tuple(att.shape) == (-1, 16, 32), att.shape
+        proj = fluid.layers.fc(att, size=8, num_flatten_dims=2)
+        assert tuple(proj.shape) == (-1, 16, 8), proj.shape
+
+
+def test_flash_attention_block_k_alias():
+    """block_k (pre-tuning API) keeps meaning block_kv."""
+    rng = np.random.RandomState(4)
+    B, S, H, D = 1, 64, 2, 16
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    a = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    b = flash_attention(q, k, v, causal=True, block_q=32, block_kv=32)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+
+# ---------------------------------------------------------------------------
+# off-chip TPU lowering: forward AND fused backward must produce Mosaic
+# custom calls across causal/dtype/block-geometry axes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "causal,dtype,bq,bkv",
+    [(True, "bfloat16", 128, 128),
+     (True, "bfloat16", 256, 128),
+     (True, "bfloat16", 128, 256),
+     (False, "bfloat16", 128, 128),
+     (True, "float32", 128, 128),
+     (False, "float32", 256, 256)])
+def test_fwd_and_bwd_kernels_lower_for_tpu_offchip(causal, dtype, bq, bkv):
+    """Pallas -> Mosaic conversion happens at LOWERING time, so the whole
+    kernel pair is checkable without a chip: a TPU export of the
+    gradient must carry THREE tpu_custom_calls (fwd + bwd-dq + bwd-dkv),
+    each with a serialized Mosaic module."""
+    from jax import export as jax_export
+
+    def fn(q, k, v):
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, block_q=bq,
+                                block_kv=bkv, interpret=False)
+            return jnp.sum(o.astype(jnp.float32))
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    spec = jax.ShapeDtypeStruct((2, 512, 2, 128), jnp.dtype(dtype))
+    exp = jax_export.export(jax.jit(fn), platforms=["tpu"])(
+        spec, spec, spec)
+    n = exp.mlir_module().count("tpu_custom_call")
+    assert n >= 3, "expected fwd+dq+dkv Mosaic kernels, found %d" % n
+
+
+# ---------------------------------------------------------------------------
+# autotuner cache: hit/miss, flag override, deterministic selection,
+# trace-time consultation
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def tune_cache(tmp_path):
+    old = get_flags(["attention_tune_cache", "flash_block_q",
+                     "flash_block_kv", "flash_block_q_bwd",
+                     "flash_block_kv_bwd"])
+    path = str(tmp_path / "attn_cache.json")
+    set_flags({"attention_tune_cache": path, "flash_block_q": 0,
+               "flash_block_kv": 0, "flash_block_q_bwd": 0,
+               "flash_block_kv_bwd": 0})
+    yield path
+    set_flags(old)
+
+
+def test_tune_cache_miss_falls_back_to_heuristic(tune_cache):
+    cfg = attention_tuning.get_config(1024, 128, True, "bfloat16")
+    assert cfg == attention_tuning.default_config(1024, 128)
+    assert cfg.block_q == 128 and cfg.block_kv == 128
+    # heuristic degrades with the sequence, never fails to divide
+    assert attention_tuning.get_config(96, 64, False, "float32").block_q \
+        == 32
+    # nothing divides a prime length -> None (caller takes the XLA path)
+    assert attention_tuning.get_config(97, 64, False, "float32") is None
+
+
+def test_tune_cache_hit_and_invalidation(tune_cache):
+    assert attention_tuning.lookup(2048, 128, True, "bfloat16") is None
+    cfg = attention_tuning.AttentionConfig(256, 512, 128, 256)
+    attention_tuning.record(2048, 128, True, "bfloat16", cfg,
+                            extra={"fwd_bwd_ms": 1.0})
+    got = attention_tuning.get_config(2048, 128, True, "bfloat16")
+    assert got == cfg
+    # key is exact: other causal/dtype/shape stay misses
+    assert attention_tuning.lookup(2048, 128, False, "bfloat16") is None
+    assert attention_tuning.lookup(2048, 128, True, "float32") is None
+    assert attention_tuning.lookup(1024, 128, True, "bfloat16") is None
+    # a second record (fresh mtime) supersedes without a process restart
+    cfg2 = attention_tuning.AttentionConfig(512, 512)
+    os.utime(tune_cache, (0, 0))   # force an mtime change on rewrite
+    attention_tuning.record(2048, 128, True, "bfloat16", cfg2)
+    assert attention_tuning.get_config(2048, 128, True, "bfloat16") == cfg2
+
+
+def test_tune_cache_flag_override(tune_cache):
+    cfg = attention_tuning.AttentionConfig(256, 256, 256, 256)
+    attention_tuning.record(4096, 128, True, "bfloat16", cfg)
+    set_flags({"flash_block_q": 512})
+    got = attention_tuning.get_config(4096, 128, True, "bfloat16")
+    # the overridden field wins; the rest still comes from the cache
+    assert got.block_q == 512
+    assert (got.block_kv, got.block_q_bwd, got.block_kv_bwd) \
+        == (256, 256, 256)
+
+
+def test_tune_cache_deterministic_selection(tune_cache):
+    a = attention_tuning.get_config(1024, 64, True, "float32")
+    b = attention_tuning.get_config(1024, 64, True, "float32")
+    assert a == b and a is not b
+
+
+def test_flash_attention_consults_cache_at_trace_time(tune_cache,
+                                                      monkeypatch):
+    """The kernel launch must ride the cached geometry when no explicit
+    blocks are passed."""
+    import paddle_tpu.ops.pallas_kernels as pk
+    attention_tuning.record(
+        64, 16, True, "float32",
+        attention_tuning.AttentionConfig(16, 32, 32, 16))
+    seen = {}
+    real = pk._flash_fwd_pallas
+
+    def spy(q, k, v, scale, causal, block_q, block_kv, interpret):
+        seen["blocks"] = (block_q, block_kv)
+        return real(q, k, v, scale, causal, block_q, block_kv, interpret)
+
+    monkeypatch.setattr(pk, "_flash_fwd_pallas", spy)
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 64, 2, 16).astype(np.float32))
+    flash_attention(q, q, q, causal=True)
+    assert seen["blocks"] == (16, 32)
+    # explicit per-call args still beat the cache
+    flash_attention(q, q, q, causal=True, block_q=32, block_kv=32)
+    assert seen["blocks"] == (32, 32)
+
+
+# ---------------------------------------------------------------------------
+# bench_attention --smoke: the full bench/tune/cache plumbing on CPU —
+# kernel-perf tooling regressions surface in tier-1, chip not required
+# ---------------------------------------------------------------------------
+
+
+def test_bench_attention_smoke_tune_writes_cache(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "bench_attention.py"),
+         "--smoke", "--tune", "--tune_cache", cache, "--seq_lens", "64"],
+        capture_output=True, text=True, timeout=420, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    recs = [json.loads(l) for l in proc.stdout.splitlines()
+            if l.startswith("{")]
+    metrics = {r["metric"] for r in recs}
+    assert {"attention_tune", "attention_tuned",
+            "attention_fwd_bwd_ms"} <= metrics, metrics
+    flash_rows = [r for r in recs if r["metric"] == "attention_fwd_bwd_ms"
+                  and r["variant"] == "flash"]
+    assert flash_rows and all(r["value"] is not None for r in flash_rows)
+    with open(cache) as f:
+        entries = json.load(f)
+    # smoke geometry: B,H,D forced to 2,2,64; one causal f32 entry at S=64
+    (key,) = entries.keys()
+    assert key == "S64_D64_c1_float32", key
+    e = entries[key]
+    assert 64 % e["block_q"] == 0 and 64 % e["block_kv"] == 0
+    assert e["backend"] == "cpu-interpret"

@@ -33,6 +33,16 @@ CONFIGS = [
     ("resnet50_imagenet", ["--model", "resnet", "--data_set", "imagenet",
                            "--layout", "NHWC"], 256, 8),
     ("transformer_base_s512", ["--model", "transformer"], 32, 2),
+    # long-context transformer lanes: the seq-1k/4k rows measure the
+    # tuned Pallas flash-attention kernel pair (fwd + fused bwd) inside
+    # a full training step — the end-to-end check that the attention
+    # roofline work (ROOFLINE.md attention section) composes in-graph,
+    # the lesson fused_bottleneck taught. Run bench_attention --tune
+    # first on a fresh chip so these rows ride tuned geometry.
+    ("transformer_flash_s1024",
+     ["--model", "transformer", "--seq_len", "1024"], 16, 2),
+    ("transformer_flash_s4096",
+     ["--model", "transformer", "--seq_len", "4096"], 4, 1),
     # device-side loop: 10 steps per dispatch (lax.fori_loop over the
     # jitted step) — measures chip throughput with host/relay round
     # trips amortized away entirely

@@ -45,6 +45,11 @@ def parse_args():
     p.add_argument("--data_set", default=None,
                    help="imagenet|cifar10|flowers for the vision models")
     p.add_argument("--layout", default="NCHW", choices=["NCHW", "NHWC"])
+    p.add_argument("--seq_len", type=int, default=0,
+                   help="sequence length for the transformer model "
+                        "(0 = the model default); the bench_zoo "
+                        "long-context lanes use this to measure the "
+                        "tuned flash-attention kernel at seq >= 1k")
     p.add_argument("--learning_rate", type=float, default=0.0)
     p.add_argument("--parallel", action="store_true",
                    help="train through ParallelExecutor (all devices)")
@@ -95,6 +100,8 @@ def build_model(args):
         kwargs["dataset"] = args.data_set
     if args.model in ("resnet", "se_resnext"):
         kwargs["layout"] = args.layout
+    if args.model == "transformer" and args.seq_len:
+        kwargs["seq_len"] = args.seq_len
     return mod.get_model(**kwargs)
 
 

@@ -122,8 +122,13 @@ def main():
          {}, 3600),
         ("tune_bottleneck", ["tools/tune_bottleneck.py", "--require_tpu"],
          {}, 3600),
-        ("attention", ["tools/bench_attention.py", "--require_tpu"],
-         {}, 3600),
+        # --tune sweeps (block_q, block_kv) geometries per seq len and
+        # persists winners to tools/attention_tune_cache.json BEFORE the
+        # flash-vs-xla rows, so those rows (and any later zoo
+        # transformer_flash lane in a following window) ride measured
+        # geometry rather than the heuristic default
+        ("attention", ["tools/bench_attention.py", "--require_tpu",
+                       "--tune"], {}, 3600),
         ("profile_remat", ["tools/profile_step.py", "NHWC", "256",
                            "remat"], {}, 3600),
     ]

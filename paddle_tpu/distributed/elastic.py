@@ -57,17 +57,43 @@ class Task:
 def save_state_snapshot(path, state):
     """Atomic CRC-framed typed snapshot (the etcd-snapshot analogue,
     go/master/service.go:207; format = native/wire.cc, same codec as the
-    socket path — no pickle on disk either)."""
+    socket path — no pickle on disk either).
+
+    Durability details a master crash must not break: the temp name is
+    unique per writer (a concurrent or killed writer can never splice
+    bytes into another's file), the payload is fsynced BEFORE the rename
+    (an os.replace of un-synced data can survive as an empty/partial
+    file after power loss — exactly the corruption _recover() would then
+    trip over), and the parent dir is fsynced after so the rename itself
+    is durable."""
     payload = _wire_encode(state)
     crc = binascii.crc32(payload) & 0xFFFFFFFF
-    tmp = path + ".tmp"
+    tmp = "%s.tmp.%d.%x" % (path, os.getpid(), threading.get_ident())
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(tmp, "wb") as f:
-        f.write(crc.to_bytes(4, "little"))
-        f.write(payload)
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(crc.to_bytes(4, "little"))
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if d:
+        try:
+            fd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass  # some filesystems refuse directory fsync
 
 
 def load_state_snapshot(path):
@@ -349,46 +375,58 @@ class MasterClient:
     backoff so a master restart (recovering from its snapshot) is
     transparent to workers."""
 
-    def __init__(self, endpoint, worker="?", dial_timeout=30.0):
+    def __init__(self, endpoint, worker="?", dial_timeout=30.0,
+                 retry_policy=None):
         self.endpoint = endpoint
         self.worker = worker
         self.dial_timeout = float(dial_timeout)
+        self.retry_policy = retry_policy
         self._sock = None
         self._req_counter = 0
+
+    def _policy(self):
+        if self.retry_policy is not None:
+            return self.retry_policy
+        from ..utils.retry import default_rpc_policy
+        # the deadline, not the attempt count, bounds a master restart
+        # wait; jittered exponential backoff paces the re-dials
+        return default_rpc_policy(max_attempts=1 << 30, max_delay=1.0)
 
     def _call(self, msg, deadline=None):
         """Returns (reply, resent): resent=True when the request was
         re-sent after a connection failure — the master may have already
         processed the first copy (at-least-once delivery), so callers of
-        non-idempotent commands must tolerate already-applied errors."""
+        non-idempotent commands must tolerate already-applied errors.
+        Re-dial pacing rides the shared jittered RetryPolicy
+        (utils/retry.py) so a restarting master isn't stampeded."""
         deadline = deadline or (time.monotonic() + self.dial_timeout)
-        backoff = 0.05
-        resent = False
-        sent_once = False
-        while True:
-            try:
-                if self._sock is None:
-                    host, port = self.endpoint.rsplit(":", 1)
-                    self._sock = socket.create_connection(
-                        (host, int(port)), timeout=10.0)
-                if sent_once:
-                    resent = True
-                    msg = dict(msg, resend=True)
-                _send_msg(self._sock, msg)
-                sent_once = True
-                return _recv_msg(self._sock), resent
-            except (ConnectionError, OSError, EOFError):
-                # master died/restarting: drop the conn, back off, retry
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
-                if time.monotonic() > deadline:
-                    raise
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 1.0)
+        state = {"resent": False, "sent_once": False}
+
+        def _attempt():
+            if self._sock is None:
+                host, port = self.endpoint.rsplit(":", 1)
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=10.0)
+            m = msg
+            if state["sent_once"]:
+                state["resent"] = True
+                m = dict(msg, resend=True)
+            _send_msg(self._sock, m)
+            state["sent_once"] = True
+            return _recv_msg(self._sock), state["resent"]
+
+        def _drop_conn(exc, attempt):
+            # master died/restarting: drop the conn before the backoff
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+        return self._policy().call(
+            _attempt, retry_on=(ConnectionError, OSError, EOFError),
+            on_retry=_drop_conn, deadline=deadline)
 
     def set_dataset(self, payloads):
         r, _ = self._call({"cmd": "set_dataset",

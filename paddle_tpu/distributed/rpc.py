@@ -90,13 +90,20 @@ def deserialize_array(msg):
     return np.asarray(msg)
 
 
-def wait_server_ready(endpoints, timeout=60.0):
+def wait_server_ready(endpoints, timeout=60.0, policy=None):
     """Block until every endpoint accepts TCP connections (reference
     transpiler/details/checkport.py:21 — trainers poll pserver ports
-    instead of racing the server's bind)."""
+    instead of racing the server's bind).  The poll cadence is the
+    shared jittered-backoff RetryPolicy (utils/retry.py), unbounded in
+    attempts but bounded by `timeout`: many workers polling a restarting
+    pserver must not stampede it in lockstep."""
     import time
+    if policy is None:
+        from ..utils.retry import default_rpc_policy
+        policy = default_rpc_policy(max_attempts=1 << 30, max_delay=1.0)
     deadline = time.monotonic() + timeout
     pending = list(endpoints)
+    delays = policy.delays()
     while pending:
         ep = pending[0]
         host, port = ep.rsplit(":", 1)
@@ -104,11 +111,13 @@ def wait_server_ready(endpoints, timeout=60.0):
             s = socket.create_connection((host, int(port)), timeout=1.0)
             s.close()
             pending.pop(0)
+            delays = policy.delays()  # fresh backoff per endpoint
         except OSError:
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     "server %s not ready within %.0fs" % (ep, timeout))
-            time.sleep(0.05)
+            policy.sleep(min(next(delays, 1.0),
+                             max(deadline - time.monotonic(), 0.0)))
 
 
 class VariableServer:
@@ -487,7 +496,31 @@ class RPCClient:
                 return self._call_impl(ep, msg)
         return self._call_impl(ep, msg)
 
+    # Commands safe to replay after a connection failure: pure reads and
+    # absolute writes.  Barriers/sends mutate counters server-side — a
+    # blind replay could double-count, so those surface the error.
+    _IDEMPOTENT = frozenset(["get", "prefetch", "put", "load_checkpoint",
+                             "checkpoint", "register_trainer"])
+
     def _call_impl(self, ep, msg):
+        attempt_one = self._call_once
+        if msg.get("cmd") in self._IDEMPOTENT:
+            from ..utils.retry import default_rpc_policy
+
+            def _drop_conn(exc, attempt):
+                conns = getattr(self._tls, "conns", None)
+                s = conns.pop(ep, None) if conns else None
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+            return default_rpc_policy().call(
+                lambda: attempt_one(ep, msg), on_retry=_drop_conn)
+        return attempt_one(ep, msg)
+
+    def _call_once(self, ep, msg):
         s = self._conn(ep)
         _send_msg(s, msg)
         reply = _recv_msg(s)

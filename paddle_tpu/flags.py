@@ -237,6 +237,49 @@ DEFINE_float(
     "rpc_deadline", 180.0,
     "Parameter-server RPC timeout in seconds (reference FLAGS_rpc_deadline).")
 DEFINE_int(
+    "rpc_retry_times", 5,
+    "Attempts for the jittered-backoff retry wrappers on the distributed "
+    "control plane (MasterClient._call re-dials, wait_server_ready polls, "
+    "RPCClient idempotent-command reconnects). 1 disables retries.")
+DEFINE_float(
+    "rpc_retry_backoff", 0.05,
+    "Base delay (seconds) of the retry wrappers' exponential backoff; "
+    "each attempt doubles it up to 2s with +/-50% jitter so restarting "
+    "peers are not stampeded (utils/retry.py RetryPolicy).")
+DEFINE_bool(
+    "sentinel_nan_check", False,
+    "Anomaly sentinel: screen each Trainer step's fetched losses (and "
+    "params with sentinel_check_params) for NaN/Inf at the step boundary "
+    "— cheap, jit-preserving, unlike check_nan_inf's eager per-op mode. "
+    "A bad step is reverted (immutable-array snapshot restore) and, "
+    "after sentinel_max_bad_steps consecutive bad steps, the policy "
+    "decides: raise, or roll back to the last-good checkpoint.")
+DEFINE_string(
+    "sentinel_policy", "skip",
+    "What the sentinel does after sentinel_max_bad_steps consecutive "
+    "non-finite steps: 'skip' raises SentinelError; 'rollback' reloads "
+    "the last-good checkpoint from the Trainer's checkpoint dir and "
+    "keeps training (raising only if training re-diverges right after).")
+DEFINE_int(
+    "sentinel_max_bad_steps", 3,
+    "Consecutive non-finite steps the sentinel absorbs by skipping "
+    "before escalating to its policy (K in the rollback design).")
+DEFINE_bool(
+    "sentinel_check_params", False,
+    "Sentinel also screens every persistable (params + optimizer "
+    "accumulators) each step, not just the fetched losses. Catches "
+    "corruption the loss hasn't seen yet; costs a host transfer of the "
+    "full state per step.")
+DEFINE_float(
+    "step_watchdog_secs", 0.0,
+    "Wall-clock watchdog on each Executor.run/run_loop dispatch: the "
+    "device computation runs on a worker thread and a step exceeding "
+    "this many seconds raises StepWatchdogTimeout instead of blocking "
+    "forever (generalizes bench.py's subprocess wedge-probe — the r03 "
+    "TPU transport outage hung jax inside C, unkillable from Python). "
+    "0 disables; enabling forces a block_until_ready per step, so this "
+    "is a hang-detection mode, not a fast path.")
+DEFINE_int(
     "dist_threadpool_size", 0,
     "Reference distributed thread pool size. Advisory.")
 DEFINE_bool(

@@ -63,6 +63,8 @@ from . import average
 from . import evaluator
 from . import debugger
 from . import contrib
+from . import checkpoint  # noqa: F401  (atomic CRC checkpoint vault)
+from . import sentinel    # noqa: F401  (NaN/Inf anomaly sentinel)
 
 __all__ = [
     "Program", "Operator", "Variable", "Parameter",
@@ -82,7 +84,7 @@ __all__ = [
     "concurrency", "Go", "make_channel", "channel_send", "channel_recv",
     "channel_close", "LoDTensorArray", "Tensor", "recordio_writer",
     "learning_rate_decay", "create_random_int_lodtensor", "Trainer",
-    "Inferencer",
+    "Inferencer", "checkpoint", "sentinel",
 ]
 
 # reference top-level aliases: the fluid package re-exported the contrib

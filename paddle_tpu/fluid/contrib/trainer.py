@@ -44,17 +44,22 @@ class EndStepEvent:
 
 
 class CheckpointConfig:
-    """reference contrib/trainer.py:100."""
+    """reference contrib/trainer.py:100.  `max_num_checkpoints` drives
+    the vault's keep-N rotation (fluid/checkpoint.py); `async_save`
+    commits checkpoints on the background saver thread so the train loop
+    doesn't stall on IO (Trainer joins pending saves at train() exit)."""
 
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
-                 epoch_interval=1, step_interval=10):
+                 epoch_interval=1, step_interval=10, async_save=False):
         self.checkpoint_dir = checkpoint_dir or os.path.join(
             ".", "checkpoints")
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = max(int(epoch_interval), 1)
         self.step_interval = max(int(step_interval), 1)
+        self.async_save = bool(async_save)
         self.epoch_id = 0
         self.step_id = 0
+        self.epoch_step = 0
         self.load_serial = None
 
 
@@ -91,19 +96,89 @@ class Trainer:
         if self.checkpoint_cfg and os.path.isdir(
                 self.checkpoint_cfg.checkpoint_dir):
             try:
-                meta = fluid_io.load_checkpoint(
-                    self.exe, self.checkpoint_cfg.checkpoint_dir,
-                    main_program=self.train_program)
-                if meta:
-                    self.checkpoint_cfg.epoch_id = int(
-                        meta.get("epoch", 0))
-                    self.checkpoint_cfg.step_id = int(meta.get("step", 0))
+                self._restore_checkpoint()
             except FileNotFoundError:
-                pass
+                pass  # empty dir: fresh run; corruption still raises
         self._stop = False
+
+    def _restore_checkpoint(self):
+        """Load the last-good checkpoint and adopt its canonical
+        {"epoch", "step"} meta (+ optional "epoch_step" for exact
+        mid-epoch resume).  load_checkpoint always returns that schema —
+        the legacy int-step metas are normalized on the way out, so both
+        sides of the round-trip speak one format."""
+        meta = fluid_io.load_checkpoint(
+            self.exe, self.checkpoint_cfg.checkpoint_dir,
+            main_program=self.train_program)
+        self.checkpoint_cfg.epoch_id = int(meta.get("epoch", 0))
+        self.checkpoint_cfg.step_id = int(meta.get("step", 0))
+        self.checkpoint_cfg.epoch_step = int(meta.get("epoch_step", 0))
+        return meta
 
     def stop(self):
         self._stop = True
+
+    def _make_sentinel(self):
+        from ...flags import FLAGS
+        if not FLAGS.sentinel_nan_check:
+            return None
+        from .. import sentinel as sentinel_mod
+        return sentinel_mod.AnomalySentinel(
+            max_bad_steps=FLAGS.sentinel_max_bad_steps,
+            policy=FLAGS.sentinel_policy,
+            check_params=FLAGS.sentinel_check_params)
+
+    def _run_step(self, feed, fetch, sentinel):
+        """One executor step, optionally screened by the anomaly
+        sentinel: on a non-finite step the pre-step persistable refs are
+        restored (jax arrays are immutable, so the snapshot is free) and
+        after K consecutive bad steps the policy escalates to a reload
+        of the last-good checkpoint (or SentinelError)."""
+        if sentinel is None:
+            return self.exe.run(self.train_program, feed=feed,
+                                fetch_list=fetch)
+        import warnings
+        from .. import functionalizer, sentinel as sentinel_mod
+        scope = global_scope()
+        names = functionalizer.persistable_names(self.train_program)
+        pre = {n: scope.get(n) for n in names if scope.has(n)}
+        metrics = self.exe.run(self.train_program, feed=feed,
+                               fetch_list=fetch)
+        named = list(zip((getattr(f, "name", str(f)) for f in fetch),
+                         metrics))
+        if sentinel.check_params:
+            named += [(n, scope.get(n)) for n in names if scope.has(n)]
+        verdict = sentinel.observe(named)
+        if verdict == sentinel_mod.SKIP:
+            for n, v in pre.items():
+                scope.set(n, v)
+            warnings.warn(
+                "sentinel: non-finite step (%s) reverted — %d/%d "
+                "consecutive" % (", ".join(sentinel.last_bad_names),
+                                 sentinel.consecutive_bad,
+                                 sentinel.max_bad_steps))
+        elif verdict == sentinel_mod.ROLLBACK:
+            if not self.checkpoint_cfg:
+                raise sentinel_mod.SentinelError(
+                    "sentinel policy 'rollback' needs a checkpoint_config "
+                    "with a last-good checkpoint, and this Trainer has "
+                    "none")
+            try:
+                meta = fluid_io.load_checkpoint(
+                    self.exe, self.checkpoint_cfg.checkpoint_dir,
+                    main_program=self.train_program)
+            except FileNotFoundError:
+                raise sentinel_mod.SentinelError(
+                    "sentinel: rollback requested but no checkpoint "
+                    "exists yet under %s"
+                    % self.checkpoint_cfg.checkpoint_dir)
+            sentinel.note_rollback_done()
+            warnings.warn(
+                "sentinel: %d consecutive non-finite steps — rolled back "
+                "to last-good checkpoint (epoch %s, step %s)"
+                % (sentinel.consecutive_bad, meta.get("epoch"),
+                   meta.get("step")))
+        return metrics
 
     def train(self, num_epochs, event_handler, reader=None, feed_order=None):
         from ..data_feeder import DataFeeder
@@ -111,30 +186,40 @@ class Trainer:
             self.train_program.global_block().var(n) for n in feed_order],
             place=self.place, program=self.train_program) \
             if feed_order else None
-        start_epoch = (self.checkpoint_cfg.epoch_id
-                       if self.checkpoint_cfg else 0)
-        global_step = (self.checkpoint_cfg.step_id
-                       if self.checkpoint_cfg else 0)
-        for epoch_id in range(start_epoch, num_epochs):
-            event_handler(BeginEpochEvent(epoch_id))
-            for step_id, data in enumerate(reader()):
-                if self._stop:
-                    return
-                begin = BeginStepEvent(epoch_id, step_id)
-                event_handler(begin)
-                fetch = self.train_outputs if begin.fetch_metrics else []
-                feed = feeder.feed(data) if feeder else data
-                metrics = self.exe.run(self.train_program, feed=feed,
-                                       fetch_list=fetch)
-                event_handler(EndStepEvent(epoch_id, step_id, metrics))
-                global_step += 1
-                if self.checkpoint_cfg and \
-                        global_step % self.checkpoint_cfg.step_interval == 0:
-                    self._save_checkpoint(epoch_id, global_step)
-            event_handler(EndEpochEvent(epoch_id))
-            if self.checkpoint_cfg and \
-                    (epoch_id + 1) % self.checkpoint_cfg.epoch_interval == 0:
-                self._save_checkpoint(epoch_id + 1, global_step)
+        cfg = self.checkpoint_cfg
+        start_epoch = cfg.epoch_id if cfg else 0
+        global_step = cfg.step_id if cfg else 0
+        # exact mid-epoch resume: the checkpoint records how many steps
+        # of its epoch were already trained; replaying the (deterministic)
+        # reader and skipping them reproduces the uninterrupted trajectory
+        resume_skip = cfg.epoch_step if cfg else 0
+        sentinel = self._make_sentinel()
+        try:
+            for epoch_id in range(start_epoch, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if epoch_id == start_epoch and step_id < resume_skip:
+                        continue
+                    if self._stop:
+                        return
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    fetch = self.train_outputs if begin.fetch_metrics \
+                        else []
+                    feed = feeder.feed(data) if feeder else data
+                    metrics = self._run_step(feed, fetch, sentinel)
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    global_step += 1
+                    if cfg and global_step % cfg.step_interval == 0:
+                        self._save_checkpoint(epoch_id, global_step,
+                                              step_id + 1)
+                event_handler(EndEpochEvent(epoch_id))
+                if cfg and (epoch_id + 1) % cfg.epoch_interval == 0:
+                    self._save_checkpoint(epoch_id + 1, global_step, 0)
+        finally:
+            if cfg and cfg.async_save:
+                from .. import checkpoint as _ckpt
+                _ckpt.wait_for_async_saves()
 
     def test(self, reader, feed_order):
         test_program = self.train_program.clone(for_test=True)
@@ -163,8 +248,11 @@ class Trainer:
             [self.train_outputs[i] for i in target_var_indexes],
             self.exe, main_program=self.train_program)
 
-    def _save_checkpoint(self, epoch_id, step_id):
+    def _save_checkpoint(self, epoch_id, step_id, epoch_step=0):
+        cfg = self.checkpoint_cfg
         fluid_io.save_checkpoint(
-            self.exe, self.checkpoint_cfg.checkpoint_dir,
+            self.exe, cfg.checkpoint_dir,
             main_program=self.train_program,
-            step={"epoch": epoch_id, "step": step_id})
+            step=step_id, epoch=epoch_id, epoch_step=epoch_step,
+            max_num_checkpoints=cfg.max_num_checkpoints,
+            async_save=cfg.async_save)

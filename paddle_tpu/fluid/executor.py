@@ -23,7 +23,45 @@ from . import core
 from .framework import Program, Variable, default_main_program
 from . import functionalizer
 
-__all__ = ["Executor", "Scope", "global_scope", "scope_guard", "as_numpy"]
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard", "as_numpy",
+           "StepWatchdogTimeout"]
+
+
+class StepWatchdogTimeout(TimeoutError):
+    """An executor step exceeded FLAGS.step_watchdog_secs of wall clock.
+    The backend may be wedged (the r03 TPU transport outage blocked jax
+    inside C forever); the hung dispatch keeps its worker thread, but the
+    train loop gets an exception it can act on instead of hanging."""
+
+
+def _watchdog_call(call, timeout, what="executor step"):
+    """Run `call` on a worker thread and give up after `timeout` seconds
+    — the in-process generalization of bench.py's subprocess wedge-probe
+    (a hung XLA dispatch cannot be interrupted from Python, but it CAN be
+    abandoned).  Zero overhead path is the caller's: only invoked when
+    the watchdog flag is set."""
+    box = {}
+    done = threading.Event()
+
+    def _worker():
+        try:
+            box["value"] = call()
+        except BaseException as e:
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_worker, daemon=True,
+                         name="paddle-tpu-step-watchdog")
+    t.start()
+    if not done.wait(timeout):
+        raise StepWatchdogTimeout(
+            "%s still running after %.1fs (FLAGS.step_watchdog_secs) — "
+            "backend wedged or step pathologically slow; the dispatch "
+            "thread is abandoned" % (what, timeout))
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
 
 
 class _TensorView:
@@ -264,6 +302,21 @@ class Executor:
     def _prepare_feeds(self, program, feed):
         return prepare_feeds(program, feed)
 
+    @staticmethod
+    def _dispatch(call, watchdog_secs, what="executor step"):
+        """Run one device dispatch, under the wall-clock watchdog when
+        FLAGS.step_watchdog_secs is set.  The watchdog forces a
+        block_until_ready inside the watched call — async dispatch would
+        otherwise return before the hang."""
+        if watchdog_secs and watchdog_secs > 0:
+            def _synced():
+                import jax
+                out = call()
+                jax.block_until_ready(out)
+                return out
+            return _watchdog_call(_synced, watchdog_secs, what)
+        return call()
+
 
     def run_loop(self, program=None, feed=None, fetch_list=None,
                  steps=1, scope=None, return_numpy=True):
@@ -331,8 +384,11 @@ class Executor:
             fn = functionalizer.jit_loop(
                 step_fn, dev is not None and dev.platform == "tpu")
             self._cache[key] = fn
-        fetches, new_state = fn(state_in, feeds, np.uint32(step0),
-                                np.int32(steps))
+        # watchdog budget scales with the loop length: wd secs per step
+        fetches, new_state = self._dispatch(
+            lambda: fn(state_in, feeds, np.uint32(step0), np.int32(steps)),
+            FLAGS.step_watchdog_secs * steps,
+            "run_loop dispatch (%d steps)" % steps)
         # only a successful dispatch advances the counter — a build or
         # compile failure must not skew the RNG step fold for later runs
         self._step_counters[id(program)] = step0 + steps
@@ -394,7 +450,9 @@ class Executor:
                 fn = functionalizer.build_step_fn(
                     program, feed_key, fetch_ext, persistables)
                 self._cache[ekey] = fn
-            fetches, new_state = fn(state_in, feeds, np.uint32(step))
+            fetches, new_state = self._dispatch(
+                lambda: fn(state_in, feeds, np.uint32(step)),
+                FLAGS.step_watchdog_secs, "eager executor step")
         elif has_host:
             # RPC / IO host ops do side effects, but the compute BETWEEN
             # them still runs from the XLA jit cache: the segmented runner
@@ -409,12 +467,17 @@ class Executor:
             env = {}
             env.update(state_in)
             env.update(feeds)
-            runner.run(env, np.uint32(step), fetch_names=fetch_ext)
+            self._dispatch(
+                lambda: runner.run(env, np.uint32(step),
+                                   fetch_names=fetch_ext),
+                FLAGS.step_watchdog_secs, "segmented executor step")
             fetches = [env.get(n) for n in fetch_ext]
             new_state = {n: env[n] for n in persistables if n in env}
         else:
             fn = self._get_jitted(program, feed_key, fetch_ext, persistables)
-            fetches, new_state = fn(state_in, feeds, np.uint32(step))
+            fetches, new_state = self._dispatch(
+                lambda: fn(state_in, feeds, np.uint32(step)),
+                FLAGS.step_watchdog_secs, "jitted executor step")
         if FLAGS.benchmark:
             # reference FLAGS_benchmark: force device sync per step so
             # wall-clock timing around run() is honest (scope.cc:25)

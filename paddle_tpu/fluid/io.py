@@ -157,22 +157,83 @@ def load_inference_model(dirname, executor, model_filename=None,
     return program, meta["feed_names"], fetch_vars
 
 
-def save_checkpoint(executor, dirname, main_program=None, step=None):
-    """Checkpoint with metadata (reference CheckpointConfig/contrib
-    trainer.py:100 auto-save; Go pserver CRC checkpoint go/pserver/
-    service.go:119)."""
-    os.makedirs(dirname, exist_ok=True)
-    save_persistables(executor, dirname, main_program,
-                      filename="__checkpoint__.npz")
-    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
-        json.dump({"step": step}, f)
+def _persistable_arrays(main_program, scope):
+    if main_program is None:
+        main_program = default_main_program()
+    arrays = {}
+    for v in _var_list(main_program, is_persistable):
+        val = scope.get(v.name)
+        if val is not None:
+            arrays[v.name] = val
+    return arrays
+
+
+def save_checkpoint(executor, dirname, main_program=None, step=None,
+                    epoch=None, epoch_step=None, max_num_checkpoints=None,
+                    async_save=False):
+    """Atomic CRC-manifest checkpoint into `dirname` (the vault root):
+    `checkpoint_<step>/` + `latest` pointer + keep-N rotation — see
+    fluid/checkpoint.py for the commit protocol (reference
+    CheckpointConfig auto-save, contrib trainer.py:100; Go pserver CRC
+    checkpoint go/pserver/service.go:119).
+
+    `step` may be the canonical int global step, or a legacy
+    ``{"epoch", "step"}`` dict (normalized); `epoch`/`epoch_step`
+    override/extend the meta.  With `async_save`, the commit happens on
+    the background saver thread (checkpoint.wait_for_async_saves joins).
+    Returns the meta dict actually written."""
+    from . import checkpoint as ckpt
+    meta = ckpt.normalize_meta(step)
+    if epoch is not None:
+        meta["epoch"] = int(epoch)
+    if epoch_step is not None:
+        meta["epoch_step"] = int(epoch_step)
+    arrays = _persistable_arrays(main_program, global_scope())
+    if async_save:
+        ckpt.async_saver().submit(dirname, arrays, meta,
+                                  max_num_checkpoints=max_num_checkpoints)
+    else:
+        ckpt.save_checkpoint_dir(dirname, arrays, meta,
+                                 max_num_checkpoints=max_num_checkpoints)
+    return meta
 
 
 def load_checkpoint(executor, dirname, main_program=None):
-    load_persistables(executor, dirname, main_program,
-                      filename="__checkpoint__.npz")
-    meta_path = os.path.join(dirname, "__meta__.json")
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            return json.load(f).get("step")
-    return None
+    """Load the newest committed checkpoint under `dirname` (or `dirname`
+    itself when it is a single checkpoint_<n> dir, or a legacy flat
+    `__checkpoint__.npz` layout), CRC-verifying every shard.  Returns the
+    normalized ``{"epoch", "step", ...}`` meta dict.  Raises
+    FileNotFoundError when nothing is there and
+    CheckpointCorruptionError when a shard fails verification."""
+    from . import checkpoint as ckpt
+    import jax.numpy as jnp
+    if main_program is None:
+        main_program = default_main_program()
+    target = None
+    if os.path.exists(os.path.join(dirname, ckpt.MANIFEST_NAME)):
+        target = dirname
+    else:
+        target = ckpt.latest_checkpoint(dirname)
+    if target is None:
+        # legacy flat layout (pre-vault saves)
+        legacy = os.path.join(dirname, "__checkpoint__.npz")
+        if not os.path.exists(legacy):
+            raise FileNotFoundError(
+                "no checkpoint under %s (no 'latest' pointer, no "
+                "checkpoint_<step>/ dir, no legacy __checkpoint__.npz)"
+                % dirname)
+        load_persistables(executor, dirname, main_program,
+                          filename="__checkpoint__.npz")
+        meta_path = os.path.join(dirname, "__meta__.json")
+        raw = None
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                raw = json.load(f).get("step")
+        return ckpt.normalize_meta(raw)
+    scope = global_scope()
+    wanted = frozenset(
+        v.name for v in _var_list(main_program, is_persistable))
+    arrays, meta = ckpt.load_checkpoint_dir(target, names=wanted)
+    for name, arr in arrays.items():
+        scope.set(name, jnp.asarray(arr))
+    return meta

@@ -1,0 +1,104 @@
+"""Anomaly sentinel: NaN/Inf step screening with skip / rollback policy.
+
+Reference analogue: FLAGS_check_nan_inf's per-op re-check
+(framework/operator.cc:29) was a debugging mode — it names the offending
+op but costs eager per-op dispatch.  Production fault tolerance needs the
+opposite trade: a cheap step-boundary check on the values the train loop
+already fetched (losses, optionally params), plus a *policy* for what to
+do when training goes non-finite — the checkpoint-rollback recovery the
+TF fault-tolerance design built around periodic checkpoints
+(arXiv:1605.08695 §4.2) and our own round-3 outage notes motivate.
+
+The sentinel is a small state machine the Trainer drives each step:
+
+    verdict = sentinel.observe(named_values)   # OK / SKIP / ROLLBACK
+
+* finite values reset the consecutive-bad counter (OK);
+* a non-finite value is a bad step: SKIP (revert to the pre-step state
+  and move on) while fewer than `max_bad_steps` consecutive bad steps
+  have been seen, then ROLLBACK (reload last-good checkpoint) when the
+  policy allows it;
+* under policy "skip" (no checkpoint to fall back on) the K-th
+  consecutive bad step raises SentinelError instead — silent divergence
+  is never an option.
+
+Because the functional executor keeps every persistable as an immutable
+jax Array, "revert the step" is literally restoring the pre-step dict of
+array references — no copies, no device traffic.
+"""
+
+import numpy as np
+
+__all__ = ["OK", "SKIP", "ROLLBACK", "SentinelError", "AnomalySentinel",
+           "non_finite_names"]
+
+OK = "ok"
+SKIP = "skip"
+ROLLBACK = "rollback"
+
+POLICIES = ("skip", "rollback")
+
+
+class SentinelError(FloatingPointError):
+    """Training is non-finite beyond what the policy can absorb."""
+
+
+def non_finite_names(named_values):
+    """Names (in order) whose float values contain NaN/Inf.  Accepts an
+    iterable of (name, array-like); None values are ignored."""
+    bad = []
+    for name, val in named_values:
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        if arr.dtype.kind == "f" and not np.all(np.isfinite(arr)):
+            bad.append(name)
+    return bad
+
+
+class AnomalySentinel:
+    def __init__(self, max_bad_steps=3, policy="skip", check_params=False):
+        if policy not in POLICIES:
+            raise ValueError("sentinel policy must be one of %s, got %r"
+                             % (POLICIES, policy))
+        self.max_bad_steps = max(int(max_bad_steps), 1)
+        self.policy = policy
+        self.check_params = bool(check_params)
+        self.consecutive_bad = 0
+        self.total_bad = 0
+        self.total_rollbacks = 0
+        self.last_bad_names = []
+
+    def observe(self, named_values):
+        """Screen one step's fetched values; returns OK, SKIP or
+        ROLLBACK.  Raises SentinelError when the bad-step budget is
+        exhausted and the policy has no rollback (or rollback already
+        happened for this bad streak — a checkpoint that itself diverges
+        must not loop forever)."""
+        bad = non_finite_names(named_values)
+        self.last_bad_names = bad
+        if not bad:
+            self.consecutive_bad = 0
+            return OK
+        self.consecutive_bad += 1
+        self.total_bad += 1
+        if self.consecutive_bad < self.max_bad_steps:
+            return SKIP
+        if self.policy == "rollback":
+            if self.total_rollbacks >= 1 and \
+                    self.consecutive_bad >= 2 * self.max_bad_steps:
+                raise SentinelError(
+                    "sentinel: still non-finite (%s) after a rollback to "
+                    "the last-good checkpoint — giving up"
+                    % ", ".join(bad))
+            self.total_rollbacks += 1
+            return ROLLBACK
+        raise SentinelError(
+            "sentinel: %d consecutive non-finite steps (%s) under policy "
+            "'skip' with no rollback target — raising instead of "
+            "training on garbage" % (self.consecutive_bad,
+                                     ", ".join(bad)))
+
+    def note_rollback_done(self):
+        """The caller restored the last-good checkpoint; the bad streak
+        counter keeps running so a re-diverging rollback can give up."""

@@ -8,8 +8,39 @@ import threading
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
     "xmap_readers", "cache", "ComposeNotAligned",
-    "multiprocess_reader", "PipeReader", "Fake",
+    "multiprocess_reader", "PipeReader", "Fake", "retry_reader",
+    "ReaderWorkerFailed",
 ]
+
+
+class ReaderWorkerFailed(RuntimeError):
+    """A reader worker (thread or process) died mid-stream.  Raised to
+    the consumer instead of hanging on a sentinel that will never come
+    or silently truncating the epoch; `cause_repr` carries the worker's
+    exception (string form — it may have crossed a process boundary)."""
+
+    def __init__(self, message, cause_repr=None):
+        super(ReaderWorkerFailed, self).__init__(message)
+        self.cause_repr = cause_repr
+
+
+class _WorkerError(object):
+    """In-band error marker a failing worker emits before exiting; must
+    be pickle-stable so it survives the multiprocessing pipe/queue."""
+
+    def __init__(self, exc):
+        self.exc_repr = repr(exc)
+
+    def __reduce__(self):
+        w = _WorkerError.__new__(_WorkerError)
+        w.exc_repr = self.exc_repr
+        return (_rebuild_worker_error, (self.exc_repr,))
+
+
+def _rebuild_worker_error(exc_repr):
+    w = _WorkerError.__new__(_WorkerError)
+    w.exc_repr = exc_repr
+    return w
 
 
 class ComposeNotAligned(ValueError):
@@ -152,10 +183,17 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         out_q = queue.Queue(buffer_size)
 
         def feed():
-            for i, sample in enumerate(reader()):
-                in_q.put((i, sample))
-            for _ in range(process_num):
-                in_q.put(_End)
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except Exception as e:
+                # the source reader died: tell the CONSUMER directly —
+                # workers may be blocked on in_q and the consumer must
+                # not wait forever for sentinels that will never come
+                out_q.put(_WorkerError(e))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_End)
 
         def work():
             while True:
@@ -164,7 +202,21 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     out_q.put(_End)
                     return
                 i, sample = item
-                out_q.put((i, mapper(sample)))
+                try:
+                    mapped = mapper(sample)
+                except Exception as e:
+                    # a mapper crash mid-stream surfaces to the consumer
+                    # (reference xmap handled exceptions by re-raising in
+                    # the output thread) — never a silent short epoch
+                    out_q.put(_WorkerError(e))
+                    out_q.put(_End)
+                    return
+                out_q.put((i, mapped))
+
+        def _raise(err):
+            raise ReaderWorkerFailed(
+                "xmap_readers worker failed mid-stream: %s" % err.exc_repr,
+                cause_repr=err.exc_repr)
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
@@ -177,6 +229,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if item is _End:
                     finished += 1
                     continue
+                if isinstance(item, _WorkerError):
+                    _raise(item)
                 yield item[1]
         else:
             next_id = 0
@@ -195,6 +249,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if item is _End:
                     finished += 1
                     continue
+                if isinstance(item, _WorkerError):
+                    _raise(item)
                 i, mapped = item
                 if i == next_id:
                     yield mapped
@@ -216,15 +272,26 @@ class _EndOfStream(object):
 def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     """Merge readers, one OS process each (reference decorator.py:338).
     Each child streams items; the parent interleaves until every child
-    has sent its end sentinel."""
+    has sent its end sentinel.  A child whose reader raises ships the
+    exception in-band (a `_WorkerError` before its sentinel) and the
+    parent raises ReaderWorkerFailed; a child that dies without ANY
+    sentinel (kill -9, segfault) is detected at EOF and also raises —
+    an epoch is never silently truncated."""
     import multiprocessing
     import sys
     assert isinstance(readers, (list, tuple)) and len(readers) > 0
+
+    def _raise(err):
+        raise ReaderWorkerFailed(
+            "multiprocess_reader worker failed mid-stream: %s"
+            % err.exc_repr, cause_repr=err.exc_repr)
 
     def _feed(reader, q):
         try:
             for item in reader():
                 q.put(item)
+        except Exception as e:
+            q.put(_WorkerError(e))
         finally:
             q.put(_EndOfStream())
 
@@ -240,6 +307,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             item = q.get()
             if isinstance(item, _EndOfStream):
                 finished += 1
+            elif isinstance(item, _WorkerError):
+                _raise(item)
             else:
                 yield item
         for p in procs:
@@ -256,10 +325,17 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                 try:
                     for item in reader():
                         conn.send(item)
+                except Exception as e:
+                    try:
+                        conn.send(_WorkerError(e))
+                    except (ValueError, OSError):
+                        pass  # unpicklable/broken pipe: EOF path catches
                 finally:
-                    conn.send(_EndOfStream())
-                    conn.close()
-
+                    try:
+                        conn.send(_EndOfStream())
+                        conn.close()
+                    except OSError:
+                        pass
             p = multiprocessing.Process(target=_feed_pipe,
                                         args=(r, child))
             p.daemon = True
@@ -273,10 +349,17 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                 try:
                     item = conn.recv()
                 except EOFError:   # child died before its sentinel
-                    live.remove(conn)
-                    continue
+                    idx = conns.index(conn)
+                    procs[idx].join(timeout=5.0)
+                    code = procs[idx].exitcode
+                    raise ReaderWorkerFailed(
+                        "multiprocess_reader worker %d died before its "
+                        "end-of-stream sentinel (exitcode %r) — epoch "
+                        "would have been silently truncated" % (idx, code))
                 if isinstance(item, _EndOfStream):
                     live.remove(conn)
+                elif isinstance(item, _WorkerError):
+                    _raise(item)
                 else:
                     yield item
         for p in procs:
@@ -285,6 +368,45 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     if sys.platform == "win32":
         raise NotImplementedError("multiprocess_reader: POSIX only")
     return pipe_reader if use_pipe else queue_reader
+
+
+def retry_reader(reader, policy=None, retry_on=(Exception,)):
+    """Wrap a reader with the fault-tolerance RetryPolicy (the SAME
+    policy object family as the RPC re-dial wrappers — utils/retry.py):
+    when the underlying reader raises mid-stream, back off with jitter,
+    re-open it, skip the samples already delivered, and continue the
+    epoch from where it broke.  Exhausting the policy's attempts
+    re-raises the reader's exception.
+
+    Correct only for deterministic re-openable sources (files, object
+    stores, PipeReader commands) — the skip replays the prefix to find
+    the resume point."""
+    if policy is None:
+        from ..utils.retry import RetryPolicy
+        policy = RetryPolicy(max_attempts=3, base_delay=0.05,
+                             retry_on=retry_on)
+    retry_on = tuple(retry_on)
+
+    def data_reader():
+        delivered = 0
+        delays = policy.delays()
+        while True:
+            try:
+                for i, item in enumerate(reader()):
+                    if i < delivered:
+                        continue  # replaying the already-yielded prefix
+                    yield item
+                    delivered += 1
+                return
+            except retry_on:
+                # next() must not raise StopIteration inside a generator
+                # (PEP 479 would mask the reader's exception)
+                delay = next(delays, None)
+                if delay is None:
+                    raise
+                policy.sleep(delay)
+
+    return data_reader
 
 
 class PipeReader:

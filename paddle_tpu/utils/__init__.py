@@ -7,6 +7,8 @@ from ..v2.plot import Ploter
 from . import image_util   # noqa: F401
 from .dump_config import dump_config, dump_v2_config
 from .merge_model import merge_v2_model
+from . import retry       # noqa: F401
+from .retry import RetryPolicy
 
 __all__ = ["dump_config", "Ploter", "dump_v2_config", "merge_v2_model",
-           "image_util"]
+           "image_util", "retry", "RetryPolicy"]

@@ -134,6 +134,43 @@ def test_snapshot_crc_detects_corruption():
         load_state_snapshot(path)
 
 
+def test_master_boots_past_corrupted_snapshot():
+    """A master restarting onto a corrupt/truncated snapshot must warn
+    and start a FRESH queue (go/master proceeds when the etcd snapshot
+    is unusable), then overwrite the bad snapshot on first mutation."""
+    snap = os.path.join(tempfile.mkdtemp(), "master.snap")
+    with open(snap, "wb") as f:
+        f.write(b"\x00\x01garbage-that-is-not-a-snapshot")
+    with pytest.warns(UserWarning, match="unreadable master snapshot"):
+        m = _master(snapshot_path=snap)
+    try:
+        c = MasterClient(m.endpoint, worker="w0")
+        c.set_dataset(["a", "b"])          # fresh queue accepts a dataset
+        tid, p = c.get_task()
+        assert p in ("a", "b")
+        c.task_finished(tid)
+    finally:
+        m.stop()
+    # the rewrite is loadable again (atomic temp+fsync+rename path)
+    st = load_state_snapshot(snap)
+    assert st["dataset_set"]
+
+
+def test_snapshot_interrupted_writer_cannot_corrupt():
+    """A writer killed mid-write leaves only its unique temp file; the
+    committed snapshot keeps serving, and a later writer is unaffected
+    by the stale temp (satellite: atomicity of save_state_snapshot)."""
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "s.snap")
+    save_state_snapshot(path, {"v": 1})
+    # a killed writer's half-written temp (pid that can't collide)
+    with open(path + ".tmp.99999999.dead", "wb") as f:
+        f.write(b"\xde\xad partial")
+    assert load_state_snapshot(path)["v"] == 1
+    save_state_snapshot(path, {"v": 2})
+    assert load_state_snapshot(path)["v"] == 2
+
+
 def test_pserver_checkpoint_crc_and_restore():
     """go/pserver/service.go:145 parameterCheckpoint + :174
     LoadCheckpoint: CRC-verified save/restore of the full store."""

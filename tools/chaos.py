@@ -1,0 +1,514 @@
+"""Chaos harness: fault injection for the fault-tolerant runtime.
+
+Scenarios (each is library API + CLI; the CLI prints PASS/FAIL lines and
+exits nonzero on failure):
+
+  crash-save   spawn a training child that checkpoints every step with a
+               chaos pause inside the commit protocol, `kill -9` it mid-
+               save for real, then prove the vault still serves a fully-
+               committed last-good checkpoint (latest pointer intact,
+               every CRC verifies, meta step == last committed step).
+  bit-flip     commit a checkpoint, flip one bit in an array shard, and
+               prove the load is REJECTED with an error naming exactly
+               that array.
+  nan-poison   train with the anomaly sentinel on and a poisoned batch
+               (NaN features) injected mid-epoch; prove the bad steps
+               are skipped (params revert) and the K-th consecutive bad
+               step rolls back to the last-good checkpoint.
+  drop-rpc     run a MasterClient conversation through a TCP proxy that
+               kills the first connection mid-flight; prove the jittered
+               retry re-dials and the lease protocol's resend/req_id
+               dedup hands back exactly-once work.
+
+  --smoke      crash-save (deterministic `exit` fault at every commit
+               point) + bit-flip, fast enough for tier-1.
+
+The injection points live in paddle_tpu/fluid/checkpoint.py (`_chaos`,
+env `PADDLE_TPU_CHAOS="<point>=<action>[@<n>]"`); this tool is the
+driver.  Reference motivation: the Go pserver/master survived worker
+churn and crash-mid-checkpoint by construction (go/pserver/service.go
+temp+fsync+rename, go/master lease recovery); these scenarios are the
+repro's proof of the same properties.
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+CHAOS_POINTS = ("array_written", "arrays_written", "manifest_written",
+                "committed", "latest_updated")
+
+
+# ---------------------------------------------------------------------------
+# shard corruption
+# ---------------------------------------------------------------------------
+
+def bit_flip(path, offset=None, bit=3):
+    """Flip one bit of the file at `path` (default: middle byte) —
+    the minimal corruption a CRC32 manifest must catch."""
+    with open(path, "rb") as f:
+        raw = bytearray(f.read())
+    if not raw:
+        raise ValueError("cannot bit-flip empty file %s" % path)
+    if offset is None:
+        offset = len(raw) // 2
+    raw[offset] ^= (1 << bit)
+    with open(path, "wb") as f:
+        f.write(raw)
+    return offset
+
+
+def corrupt_array(ckpt_dir, array_name):
+    """Bit-flip the named array's shard inside a committed checkpoint."""
+    from paddle_tpu.fluid import checkpoint as ckpt
+    manifest = ckpt.read_manifest(ckpt_dir)
+    ent = manifest["arrays"][array_name]
+    path = os.path.join(ckpt_dir, ent["file"])
+    bit_flip(path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# NaN poisoning
+# ---------------------------------------------------------------------------
+
+def nan_poison_reader(reader, poison_steps, nan_value=float("nan")):
+    """Wrap a reader creator: batches whose index is in `poison_steps`
+    have every float array replaced by NaN — the data-side gradient
+    poisoning fault (a flaky preprocessing job, a corrupt shard read)."""
+    import numpy as np
+    poison_steps = frozenset(poison_steps)
+
+    def _poison(sample):
+        out = []
+        for part in sample:
+            arr = np.asarray(part)
+            if arr.dtype.kind == "f":
+                arr = np.full_like(arr, nan_value)
+            out.append(arr)
+        return tuple(out)
+
+    def poisoned():
+        for i, batch in enumerate(reader()):
+            if i in poison_steps:
+                yield [_poison(s) for s in batch]
+            else:
+                yield batch
+
+    return poisoned
+
+
+# ---------------------------------------------------------------------------
+# RPC drop: a TCP proxy that kills connections on demand
+# ---------------------------------------------------------------------------
+
+class FlakyProxy:
+    """Forward TCP to `target`, killing the first `drop_first`
+    connections after `drop_after_bytes` of server->client traffic —
+    the client sees a mid-conversation connection reset, exactly what a
+    master/pserver crash looks like from the wire."""
+
+    def __init__(self, target, drop_first=1, drop_after_bytes=0):
+        self.target = target
+        self.drop_first = drop_first
+        self.drop_after_bytes = drop_after_bytes
+        self.dropped = 0
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(8)
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+
+    @property
+    def endpoint(self):
+        host, port = self._lsock.getsockname()
+        return "%s:%d" % (host, port)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                cli, _ = self._lsock.accept()
+            except OSError:
+                return
+            drop_this = self.dropped < self.drop_first
+            if drop_this:
+                self.dropped += 1
+            threading.Thread(target=self._pump, args=(cli, drop_this),
+                             daemon=True).start()
+
+    def _pump(self, cli, drop_this):
+        host, port = self.target.rsplit(":", 1)
+        try:
+            srv = socket.create_connection((host, int(port)), timeout=10)
+        except OSError:
+            cli.close()
+            return
+        seen = [0]
+
+        def one_way(src, dst, count_down):
+            try:
+                while True:
+                    data = src.recv(1 << 16)
+                    if not data:
+                        break
+                    if count_down and drop_this:
+                        seen[0] += len(data)
+                        if seen[0] > self.drop_after_bytes:
+                            # kill BOTH sides mid-flight; shutdown (not
+                            # just close) so the victim's blocked recv
+                            # wakes on FIN now, not at its socket timeout
+                            for s in (cli, srv):
+                                try:
+                                    s.shutdown(socket.SHUT_RDWR)
+                                except OSError:
+                                    pass
+                                s.close()
+                            return
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                try:
+                    dst.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=one_way, args=(srv, cli, True),
+                             daemon=True)
+        t.start()
+        one_way(cli, srv, False)
+
+
+# ---------------------------------------------------------------------------
+# the training child (subprocess target for crash-save)
+# ---------------------------------------------------------------------------
+
+def _child_train(workdir, steps, chaos_spec=None, chaos_at_save=0):
+    """Tiny deterministic fc-regression that checkpoints EVERY step into
+    `workdir` — the victim process for kill-mid-save scenarios.  The
+    chaos spec is armed only for save number `chaos_at_save` (1-based),
+    so earlier saves commit cleanly and there IS a last-good to
+    recover."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 4).astype(np.float32)
+    ys = xs.sum(axis=1, keepdims=True)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for step in range(1, steps + 1):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        if chaos_spec and step == chaos_at_save:
+            os.environ["PADDLE_TPU_CHAOS"] = chaos_spec
+        fluid.io.save_checkpoint(exe, workdir, main_program=main,
+                                 step=step, epoch=0,
+                                 max_num_checkpoints=3)
+        os.environ.pop("PADDLE_TPU_CHAOS", None)
+        print("SAVED %d" % step, flush=True)
+    print("DONE", flush=True)
+
+
+def _spawn_child(workdir, steps, chaos_spec, chaos_at_save,
+                 extra_env=None):
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_CHAOS", None)  # armed by the child at the step
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child-train",
+         workdir, "--steps", str(steps), "--chaos-spec", chaos_spec,
+         "--chaos-at-save", str(chaos_at_save)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+
+
+def _verify_last_good(workdir, min_step=None, max_step=None):
+    """The recovery invariant: whatever the crash point, the vault must
+    resolve to a FULLY-COMMITTED checkpoint whose every CRC verifies."""
+    from paddle_tpu.fluid import checkpoint as ckpt
+    latest = ckpt.latest_checkpoint(workdir)
+    assert latest is not None, "no loadable checkpoint under %s" % workdir
+    manifest = ckpt.verify_checkpoint_dir(latest)
+    meta = ckpt.normalize_meta(manifest["meta"])
+    if min_step is not None:
+        assert meta["step"] >= min_step, \
+            "last-good step %d < expected %d" % (meta["step"], min_step)
+    if max_step is not None:
+        assert meta["step"] <= max_step, \
+            "last-good step %d > committed %d" % (meta["step"], max_step)
+    return meta
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+def scenario_crash_save(workdir, point="manifest_written",
+                        crash_at_save=2, real_kill=True, steps=6,
+                        verbose=True):
+    """kill -9 a child mid-save at `point` during save number
+    `crash_at_save`, then verify the vault.  With real_kill the child
+    pauses at the point and the parent delivers SIGKILL; otherwise the
+    child os._exit(137)s itself at the point (deterministic, no
+    timing)."""
+    os.makedirs(workdir, exist_ok=True)
+    action = "pause:120" if real_kill else "exit"
+    spec = "%s=%s" % (point, action)
+    proc = _spawn_child(workdir, steps, spec, crash_at_save)
+    saved = 0
+    try:
+        if real_kill:
+            for line in proc.stdout:
+                line = line.strip()
+                if line.startswith("SAVED"):
+                    saved = int(line.split()[1])
+                if line.startswith("CHAOS_PAUSE"):
+                    os.kill(proc.pid, signal.SIGKILL)
+                    break
+            proc.wait(timeout=30)
+        else:
+            out, _ = proc.communicate(timeout=120)
+            for line in out.splitlines():
+                if line.startswith("SAVED"):
+                    saved = int(line.split()[1])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    rc = proc.returncode
+    assert rc != 0, "child survived the kill (rc=0) — no fault injected"
+    assert saved == crash_at_save - 1, \
+        "expected the crash during save %d (after %d clean saves), " \
+        "child reported %d" % (crash_at_save, crash_at_save - 1, saved)
+    # after a crash at any pre-commit point, last-good == last SAVED line;
+    # a crash after commit-but-before-latest may legitimately expose the
+    # newer committed step (both are fully-verified checkpoints)
+    meta = _verify_last_good(
+        workdir, min_step=saved if saved else None,
+        max_step=saved + 1 if point in ("committed", "latest_updated")
+        else saved)
+    if verbose:
+        print("PASS crash-save point=%s save#%d kill=%s: child rc=%s, "
+              "last SAVED=%d, recovered last-good step=%d"
+              % (point, crash_at_save, real_kill, rc, saved,
+                 meta["step"]))
+    return meta
+
+
+def scenario_bit_flip(workdir, verbose=True):
+    """Commit a checkpoint, flip one bit in one shard, and require the
+    load to fail NAMING that array (and verify_checkpoint to exit 2)."""
+    import numpy as np
+    from paddle_tpu.fluid import checkpoint as ckpt
+    root = os.path.join(workdir, "bitflip")
+    arrays = {"fc_w": np.arange(24, dtype=np.float32).reshape(4, 6),
+              "fc_b": np.ones(6, np.float32)}
+    path = ckpt.save_checkpoint_dir(root, arrays, {"epoch": 0, "step": 1})
+    corrupt_array(path, "fc_w")
+    try:
+        ckpt.load_checkpoint_dir(path)
+    except ckpt.CheckpointCorruptionError as e:
+        assert "fc_w" in str(e), \
+            "corruption error does not name the array: %s" % e
+    else:
+        raise AssertionError("bit-flipped shard loaded without error")
+    if verbose:
+        print("PASS bit-flip: load rejected, error names fc_w")
+    return True
+
+
+def scenario_nan_poison(verbose=True):
+    """Sentinel end-to-end: poisoned batches are skipped (params revert)
+    and K consecutive poisoned steps roll back to last-good."""
+    import tempfile
+    import warnings
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+
+    rng = np.random.RandomState(0)
+    data = [(x, np.array([x.sum()], np.float32))
+            for x in [rng.randn(4).astype(np.float32) for _ in range(10)]]
+
+    def train_func():
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+    def optimizer_func():
+        return fluid.optimizer.SGD(learning_rate=0.05)
+
+    def reader():
+        for x, y in data:
+            yield [(x, y)]
+
+    workdir = tempfile.mkdtemp(prefix="chaos_nan_")
+    fluid.set_flags({"sentinel_nan_check": True,
+                     "sentinel_policy": "rollback",
+                     "sentinel_max_bad_steps": 2})
+    try:
+        with fluid.scope_guard(fluid.Scope()):
+            cfg = fluid.contrib.CheckpointConfig(
+                checkpoint_dir=workdir, step_interval=3)
+            trainer = fluid.contrib.Trainer(
+                train_func, optimizer_func, place=fluid.CPUPlace(),
+                checkpoint_config=cfg)
+            poisoned = nan_poison_reader(reader, poison_steps={5, 6})
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                trainer.train(num_epochs=1, event_handler=lambda ev: None,
+                              reader=poisoned, feed_order=["x", "y"])
+        msgs = [str(w.message) for w in caught]
+        assert any("reverted" in m for m in msgs), \
+            "no skip-step warning: %s" % msgs
+        assert any("rolled back" in m for m in msgs), \
+            "no rollback warning: %s" % msgs
+    finally:
+        fluid.set_flags({"sentinel_nan_check": False,
+                         "sentinel_policy": "skip",
+                         "sentinel_max_bad_steps": 3})
+    if verbose:
+        print("PASS nan-poison: skip then rollback observed")
+    return True
+
+
+def scenario_drop_rpc(verbose=True):
+    """MasterClient through a connection-killing proxy: the retry
+    wrapper re-dials and the lease req_id dedup keeps work exactly-once.
+    """
+    from paddle_tpu.distributed.elastic import MasterService, MasterClient
+    master = MasterService("127.0.0.1:0").start()
+    proxy = FlakyProxy(master.endpoint, drop_first=1).start()
+    try:
+        cli = MasterClient(proxy.endpoint, worker="w0", dial_timeout=20.0)
+        cli.set_dataset(["task-%d" % i for i in range(4)])
+        got = []
+        while True:
+            t = cli.get_task(block=True, timeout=20.0)
+            if t is None or master.num_passes > 0:
+                break
+            got.append(t[1])
+            cli.task_finished(t[0])
+            if len(got) >= 4:
+                break
+        assert sorted(got) == ["task-%d" % i for i in range(4)], \
+            "leases not exactly-once through the drop: %s" % got
+        assert proxy.dropped >= 1, "proxy never injected a drop"
+        cli.close()
+    finally:
+        proxy.stop()
+        master.stop()
+    if verbose:
+        print("PASS drop-rpc: %d connection(s) killed, 4 tasks "
+              "exactly-once" % proxy.dropped)
+    return True
+
+
+def run_smoke(workdir):
+    """Tier-1 smoke: deterministic crash at every commit point + the
+    bit-flip rejection — no timing races, CPU-only, a few seconds."""
+    ok = True
+    for point in CHAOS_POINTS:
+        d = os.path.join(workdir, "crash_%s" % point)
+        try:
+            scenario_crash_save(d, point=point, crash_at_save=2,
+                                real_kill=False, steps=4)
+        except AssertionError as e:
+            ok = False
+            print("FAIL crash-save %s: %s" % (point, e))
+    try:
+        scenario_bit_flip(workdir)
+    except AssertionError as e:
+        ok = False
+        print("FAIL bit-flip: %s" % e)
+    print("CHAOS SMOKE %s" % ("PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", choices=["crash-save", "bit-flip",
+                                           "nan-poison", "drop-rpc",
+                                           "all"])
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast deterministic subset for CI")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--point", default="manifest_written",
+                    choices=CHAOS_POINTS)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--no-real-kill", action="store_true",
+                    help="child os._exit(137)s at the point instead of "
+                         "being SIGKILLed while paused there")
+    ap.add_argument("--child-train", metavar="DIR",
+                    help=argparse.SUPPRESS)  # internal subprocess target
+    ap.add_argument("--chaos-spec", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--chaos-at-save", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child_train:
+        _child_train(args.child_train, args.steps, args.chaos_spec,
+                     args.chaos_at_save)
+        return 0
+
+    import tempfile
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_")
+    if args.smoke:
+        return run_smoke(workdir)
+    if args.scenario in (None, "all"):
+        scenarios = ["crash-save", "bit-flip", "nan-poison", "drop-rpc"]
+    else:
+        scenarios = [args.scenario]
+    rc = 0
+    for s in scenarios:
+        try:
+            if s == "crash-save":
+                scenario_crash_save(
+                    os.path.join(workdir, "crash"), point=args.point,
+                    real_kill=not args.no_real_kill, steps=args.steps)
+            elif s == "bit-flip":
+                scenario_bit_flip(workdir)
+            elif s == "nan-poison":
+                scenario_nan_poison()
+            elif s == "drop-rpc":
+                scenario_drop_rpc()
+        except AssertionError as e:
+            rc = 1
+            print("FAIL %s: %s" % (s, e))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""Validate a checkpoint vault directory: manifest, CRCs, shapes.
+
+    python tools/verify_checkpoint.py <dir> [--quiet] [--all]
+
+<dir> may be a vault root (the `latest` pointer / newest committed
+checkpoint is verified; --all verifies every committed checkpoint) or a
+single checkpoint_<step>/ directory.  Exit codes: 0 verified, 1 usage /
+nothing to verify, 2 corruption detected (the message names the array).
+
+This is the CI-side twin of go/pserver/service.go:174 LoadCheckpoint's
+CRC check — the same verification fluid.io.load_checkpoint performs at
+restore time, runnable without loading a program or touching a device.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _human(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return "%.1f %s" % (n, unit) if unit != "B" else "%d B" % n
+        n /= 1024.0
+
+
+def verify_one(dirname, quiet=False):
+    from paddle_tpu.fluid import checkpoint as ckpt
+    manifest = ckpt.verify_checkpoint_dir(dirname)
+    meta = ckpt.normalize_meta(manifest.get("meta"))
+    arrays = manifest["arrays"]
+    total = sum(e["nbytes"] for e in arrays.values())
+    if not quiet:
+        print("checkpoint: %s" % dirname)
+        print("  meta: epoch=%d step=%d%s" % (
+            meta["epoch"], meta["step"],
+            "".join(" %s=%r" % (k, v) for k, v in sorted(meta.items())
+                    if k not in ("epoch", "step"))))
+        print("  %d arrays, %s, all CRC32 verified"
+              % (len(arrays), _human(total)))
+        width = max((len(n) for n in arrays), default=0)
+        for name in sorted(arrays):
+            e = arrays[name]
+            print("    %-*s  %-10s %-18s crc32=%08x" % (
+                width, name, e["dtype"], tuple(e["shape"]), e["crc32"]))
+    return manifest
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="CRC-verify a paddle_tpu checkpoint directory")
+    ap.add_argument("dir", help="vault root or checkpoint_<step> dir")
+    ap.add_argument("--quiet", action="store_true",
+                    help="no per-array listing; exit code only")
+    ap.add_argument("--all", action="store_true",
+                    help="verify every committed checkpoint in the vault,"
+                         " not just the latest")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.fluid import checkpoint as ckpt
+    targets = []
+    if os.path.exists(os.path.join(args.dir, ckpt.MANIFEST_NAME)):
+        targets = [args.dir]
+    elif args.all:
+        targets = [p for _, p in ckpt.list_checkpoints(args.dir)]
+    else:
+        latest = ckpt.latest_checkpoint(args.dir)
+        targets = [latest] if latest else []
+    if not targets:
+        print("verify_checkpoint: no committed checkpoint under %s"
+              % args.dir, file=sys.stderr)
+        return 1
+    rc = 0
+    for t in targets:
+        try:
+            verify_one(t, quiet=args.quiet)
+        except ckpt.CheckpointError as e:
+            print("verify_checkpoint: FAILED: %s" % e, file=sys.stderr)
+            rc = 2
+    if rc == 0 and not args.quiet:
+        print("OK (%d checkpoint%s verified)"
+              % (len(targets), "" if len(targets) == 1 else "s"))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

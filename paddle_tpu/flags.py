@@ -279,6 +279,25 @@ DEFINE_float(
     "TPU transport outage hung jax inside C, unkillable from Python). "
     "0 disables; enabling forces a block_until_ready per step, so this "
     "is a hang-detection mode, not a fast path.")
+DEFINE_float(
+    "serving_batch_deadline_ms", 5.0,
+    "Serving micro-batcher coalescing window: after the first request of "
+    "a dispatch group arrives, wait at most this many milliseconds for "
+    "more compatible requests before dispatching (paddle_tpu/serving/"
+    "batcher.py). 0 dispatches immediately — no cross-request batching "
+    "beyond what is already queued.")
+DEFINE_int(
+    "serving_max_queue", 256,
+    "Serving admission control: maximum requests waiting in a model's "
+    "batcher queue. A submit beyond this depth is shed with an explicit "
+    "ServerOverloaded instead of growing an unbounded backlog "
+    "(shed-not-hang; see SERVING.md overload semantics).")
+DEFINE_int(
+    "serving_workers", 1,
+    "Dispatch worker threads per served model: each worker coalesces one "
+    "micro-batch and runs it; >1 allows overlapping micro-batches of the "
+    "same model (useful when the runner releases the GIL during XLA "
+    "execution).")
 DEFINE_int(
     "dist_threadpool_size", 0,
     "Reference distributed thread pool size. Advisory.")

@@ -15,11 +15,23 @@ engine analogue. Batch-size bucketing bounds recompiles the way TRT
 profiles bounded engine shapes.
 """
 
+import warnings
+
 import numpy as np
 
 __all__ = ["NativeConfig", "AnalysisConfig", "PaddleTensor", "Predictor",
            "create_paddle_predictor", "AotPredictor",
            "load_aot_predictor"]
+
+
+def _var_is_batch_major(gb, name):
+    """True when the program var's recorded shape leads with -1 — the
+    marker save_aot already persists for AOT artifacts; the live
+    Predictor reads the same ground truth instead of guessing from
+    runtime shapes."""
+    v = gb._find_var_recursive(name)
+    return bool(v is not None and v.shape is not None
+                and len(v.shape) >= 1 and int(v.shape[0]) == -1)
 
 
 class PaddleTensor:
@@ -84,6 +96,15 @@ class Predictor:
         self._state = {n: self._scope.get(n) for n in self._state_names
                        if self._scope.get(n) is not None}
         self._compiled = {}  # feed shape signature -> compiled fn
+        # batch-major markers from the program vars (-1 leading dim),
+        # the same ground truth save_aot records in aot_meta.bin: only
+        # these feeds get bucket-padded and only these fetches un-padded
+        gb = program.global_block()
+        self._batched_feed = {n: _var_is_batch_major(gb, n)
+                              for n in self._feed_names}
+        self._fetch_batched = [_var_is_batch_major(gb, n)
+                               for n in self._fetch_names]
+        self._overflow_warned = set()
 
     # ------------------------------------------------------------------
     def _get_compiled(self, feeds):
@@ -111,20 +132,34 @@ class Predictor:
         self._compiled[sig] = jitted
         return jitted
 
-    def _bucket_batch(self, arr):
-        """Pad the batch dim up to a bucket so many request sizes share one
-        compiled computation."""
+    def _bucket_cap(self, b):
+        """Smallest configured batch bucket >= b, or None when bucketing
+        is off (NativeConfig) or `b` overflows every bucket.  The
+        overflow fall-through compiles a one-off computation per exact
+        size — fine for a notebook, a recompile storm in serving — so it
+        warns ONCE per overflow size, naming it."""
         if not isinstance(self._config, AnalysisConfig):
-            return arr, arr.shape[0]
+            return None
         buckets = self._config.batch_size_buckets
-        b = arr.shape[0]
         for cap in buckets:
             if b <= cap:
-                if b == cap:
-                    return arr, b
-                pad = np.zeros((cap - b,) + arr.shape[1:], arr.dtype)
-                return np.concatenate([arr, pad], axis=0), b
-        return arr, b
+                return cap
+        if b not in self._overflow_warned:
+            self._overflow_warned.add(b)
+            warnings.warn(
+                "batch %d exceeds every configured bucket %s — falling "
+                "through to an unbucketed per-size compile; raise "
+                "batch_size_buckets (or split the request) to avoid a "
+                "recompile per distinct oversize batch in serving"
+                % (b, tuple(buckets)), RuntimeWarning, stacklevel=3)
+        return None
+
+    def _is_batched_feed(self, name):
+        cached = self._batched_feed.get(name)
+        if cached is None:
+            cached = self._batched_feed[name] = _var_is_batch_major(
+                self._program.global_block(), name)
+        return cached
 
     def run(self, inputs):
         """inputs: dict name->array, list of PaddleTensor, or list of arrays
@@ -141,7 +176,14 @@ class Predictor:
                 else:
                     named[self._feed_names[i]] = np.asarray(t)
 
-        real_batch = None
+        # the batch is read from (and padding applied to) BATCH-MAJOR
+        # feeds only — a fixed-shape side feed goes through untouched,
+        # the same contract AotPredictor.run already enforces
+        real_batch = next(
+            (arr.shape[0] for name, arr in named.items()
+             if arr.ndim >= 1 and self._is_batched_feed(name)), None)
+        cap = self._bucket_cap(real_batch) if real_batch is not None \
+            else None
         feeds = {}
         gb = self._program.global_block()
         for name, arr in named.items():
@@ -150,17 +192,25 @@ class Predictor:
                 want = v.np_dtype
                 if arr.dtype != want:
                     arr = arr.astype(want)
-            arr, rb = self._bucket_batch(arr)
-            real_batch = rb if real_batch is None else real_batch
+            if cap is not None and cap > real_batch and \
+                    self._is_batched_feed(name):
+                pad = np.zeros((cap - real_batch,) + arr.shape[1:],
+                               arr.dtype)
+                arr = np.concatenate([arr, pad], axis=0)
             feeds[name] = jnp.asarray(arr)
 
         fn = self._get_compiled(feeds)
         fetches = fn(self._state, feeds)
         out = []
-        for f in fetches:
+        for i, f in enumerate(fetches):
             a = np.asarray(f)
-            if real_batch is not None and a.ndim >= 1 and \
-                    a.shape[0] >= real_batch:
+            # un-pad only batch-major fetches (program-var -1 leading
+            # dim), never a global output whose leading dim happens to
+            # equal the padded bucket
+            batched = (i < len(self._fetch_batched)
+                       and self._fetch_batched[i])
+            if cap is not None and cap > real_batch and batched and \
+                    a.ndim >= 1 and a.shape[0] == cap:
                 a = a[:real_batch]
             out.append(a)
         return out
@@ -181,7 +231,40 @@ class Predictor:
         p._state_names = self._state_names
         p._state = self._state
         p._compiled = {}
+        p._batched_feed = dict(self._batched_feed)
+        p._fetch_batched = list(self._fetch_batched)
+        p._overflow_warned = set()
         return p
+
+    # ------------------------------------------------------------------
+    # serving introspection (paddle_tpu/serving): the batcher needs the
+    # same three facts from a live Predictor and an AotPredictor — batch
+    # buckets, feed specs, batch-major markers — in one shape.
+    # ------------------------------------------------------------------
+
+    def batch_buckets(self):
+        """Sorted batch-size buckets this predictor pads requests into;
+        () when bucketing is off (NativeConfig)."""
+        if isinstance(self._config, AnalysisConfig):
+            return tuple(sorted(self._config.batch_size_buckets))
+        return ()
+
+    def feed_specs(self):
+        """name -> (shape list with -1 dynamic dims, dtype str)."""
+        gb = self._program.global_block()
+        out = {}
+        for name in self._feed_names:
+            v = gb._find_var_recursive(name)
+            out[name] = ([int(d) for d in v.shape],
+                         str(np.dtype(v.np_dtype)))
+        return out
+
+    def batched_feed_names(self):
+        return frozenset(n for n in self._feed_names
+                         if self._is_batched_feed(n))
+
+    def fetch_batched_flags(self):
+        return list(self._fetch_batched)
 
 
     # ------------------------------------------------------------------
@@ -359,7 +442,7 @@ class AotPredictor:
                     [arr, np.zeros((cap - b,) + arr.shape[1:],
                                    arr.dtype)], axis=0)
             feeds[name] = jnp.asarray(arr)
-        fetches = self._fns[cap](self._state, feeds)
+        fetches = self._run_export(cap, feeds)
         out = []
         for i, f in enumerate(fetches):
             a = np.asarray(f)
@@ -378,6 +461,30 @@ class AotPredictor:
         return out
 
     Run = run
+
+    def _run_export(self, cap, feeds):
+        """One seam around the stored executable call (tests inject
+        slow/faulty models here without touching the jax.export path)."""
+        return self._fns[cap](self._state, feeds)
+
+    # ---- serving introspection (mirrors Predictor's) ----
+
+    def batch_buckets(self):
+        return tuple(sorted(self._fns))
+
+    def feed_specs(self):
+        return {n: (list(spec["shape"]), str(spec["dtype"]))
+                for n, spec in self._feed_specs.items()}
+
+    def batched_feed_names(self):
+        return frozenset(
+            n for n, spec in self._feed_specs.items()
+            if spec["shape"] and int(spec["shape"][0]) == -1)
+
+    def fetch_batched_flags(self):
+        if self._fetch_batched is None:
+            return None  # pre-marker artifact: scatter falls back to shape
+        return list(self._fetch_batched)
 
 
 def load_aot_predictor(dirname):

@@ -1,0 +1,30 @@
+"""paddle_tpu.serving — the multi-tenant inference serving runtime.
+
+Turns a directory of `save_inference_model` / `save_aot` artifacts into
+a trafficable service (SERVING.md): cross-request dynamic micro-batching
+onto the compiled batch buckets (batcher.py), named/versioned models
+with warm atomic hot swap (model_registry.py), a threaded wire-protocol
+front with admission control and graceful drain (server.py), and
+per-model serving metrics (metrics.py).
+
+Reference analogue: paddle/fluid/inference/api/ stops at a synchronous
+per-caller predictor; the serving layer the TensorFlow system paper
+treats as a distinct subsystem (arXiv:1605.08695 §4.3, TF Serving) is
+this module's territory — distinct scheduling needs (latency SLOs,
+coalescing, load shedding) from the training runtime's.
+"""
+
+from .batcher import (BatcherClosed, DeadlineExceeded, DynamicBatcher,
+                      ServerOverloaded, set_dispatch_delay)
+from .metrics import (Counter, ModelMetrics, ReservoirHistogram,
+                      ServingMetrics)
+from .model_registry import ModelEntry, ModelRegistry, open_predictor
+from .server import InferenceServer, ServingClient, ServingError
+
+__all__ = [
+    "DynamicBatcher", "ServerOverloaded", "DeadlineExceeded",
+    "BatcherClosed", "set_dispatch_delay",
+    "Counter", "ReservoirHistogram", "ModelMetrics", "ServingMetrics",
+    "ModelRegistry", "ModelEntry", "open_predictor",
+    "InferenceServer", "ServingClient", "ServingError",
+]

@@ -1,0 +1,376 @@
+"""Cross-request dynamic micro-batcher.
+
+The serving front's core mechanism: many callers each submit a small
+(often batch-1) request; TPU executables want the biggest batch bucket
+they were compiled for (the MLPerf TPU-pod study's lesson — batch
+geometry IS the utilization lever).  The batcher closes that gap by
+coalescing waiting requests into one padded bucket dispatch:
+
+  * bounded queue per model (admission control: a submit past
+    `max_queue` is shed with `ServerOverloaded`, never parked on an
+    unbounded backlog — shed-not-hang);
+  * a dispatch worker takes the head request, then greedily pulls
+    compatible queued requests until the largest bucket is full or a
+    `FLAGS.serving_batch_deadline_ms` window expires;
+  * batch-major feeds (the program-var -1 leading-dim markers the AOT
+    meta records and the live Predictor now exposes the same way) are
+    concatenated; fixed-shape side feeds must be byte-identical to
+    coalesce and ride through whole;
+  * the underlying predictor pads the merged batch up to its bucket and
+    un-pads batch-major fetches (that parity is the predictor's existing
+    contract); the batcher scatters per-request row slices back to each
+    caller's Future.
+
+Compatibility grouping: requests only coalesce when their feed names,
+trailing shapes, dtypes, and side-feed bytes agree — everything else
+dispatches as its own group, correct but uncoalesced.
+
+Chaos: `set_dispatch_delay(secs)` (or env
+`PADDLE_TPU_SERVING_CHAOS="dispatch_delay=<secs>"`) injects a slow-worker
+stall inside dispatch — the overload scenarios in tools/chaos.py and
+tests/test_serving.py drive admission control with it.
+"""
+
+import binascii
+import collections
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..flags import FLAGS
+
+__all__ = ["DynamicBatcher", "ServerOverloaded", "DeadlineExceeded",
+           "BatcherClosed", "set_dispatch_delay"]
+
+_CHAOS_ENV = "PADDLE_TPU_SERVING_CHAOS"
+
+
+class ServerOverloaded(RuntimeError):
+    """Admission control shed: the model's request queue is full.
+    Explicit and immediate — the client can back off and retry
+    (utils/retry.py jitter) instead of waiting on a hidden backlog."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before its dispatch completed."""
+
+
+class BatcherClosed(RuntimeError):
+    """Submit on a draining/retired batcher (e.g. mid hot-swap retire)."""
+
+
+_dispatch_delay = 0.0
+
+
+def set_dispatch_delay(secs):
+    """Chaos hook: every subsequent dispatch sleeps `secs` first —
+    the in-process slow-worker fault (0 clears)."""
+    global _dispatch_delay
+    _dispatch_delay = float(secs)
+
+
+def _chaos_delay():
+    if _dispatch_delay:
+        return _dispatch_delay
+    spec = os.environ.get(_CHAOS_ENV)
+    if spec:
+        for part in spec.split(","):
+            name, _, val = part.partition("=")
+            if name.strip() == "dispatch_delay":
+                try:
+                    return float(val)
+                except ValueError:
+                    pass
+    return 0.0
+
+
+class _Request:
+    __slots__ = ("feeds", "batch", "future", "group_key", "enqueued",
+                 "deadline")
+
+    def __init__(self, feeds, batch, group_key, deadline):
+        self.feeds = feeds
+        self.batch = batch
+        self.group_key = group_key
+        self.deadline = deadline
+        self.future = Future()
+        self.enqueued = time.monotonic()
+
+
+class DynamicBatcher:
+    """Micro-batcher over one predictor (a `Predictor` or
+    `AotPredictor` — anything with `.run(dict)->list` plus the serving
+    introspection quartet: `batch_buckets`, `feed_specs`,
+    `batched_feed_names`, `fetch_batched_flags`)."""
+
+    def __init__(self, predictor, max_queue=None, deadline_ms=None,
+                 workers=None, metrics=None, max_batch=None):
+        self.predictor = predictor
+        self.max_queue = int(FLAGS.serving_max_queue
+                             if max_queue is None else max_queue)
+        self.deadline_s = (FLAGS.serving_batch_deadline_ms
+                           if deadline_ms is None else
+                           float(deadline_ms)) / 1000.0
+        self.metrics = metrics
+        self.buckets = tuple(predictor.batch_buckets())
+        if max_batch is not None:
+            self.max_batch = int(max_batch)
+        elif self.buckets:
+            self.max_batch = self.buckets[-1]
+        else:
+            self.max_batch = 64  # unbucketed predictor: a sane coalesce cap
+        self._batched_feeds = frozenset(predictor.batched_feed_names())
+        self._fetch_flags = predictor.fetch_batched_flags()
+        self._cv = threading.Condition()
+        self._pending = collections.deque()
+        self._inflight = 0
+        self._closing = False
+        self._stopped = False
+        if metrics is not None:
+            metrics.queue_depth_fn = lambda: len(self._pending)
+        n_workers = int(FLAGS.serving_workers if workers is None
+                        else workers)
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name="paddle-tpu-serving-batcher-%d" % i)
+            for i in range(max(n_workers, 1))]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------------
+    # submit side
+    # ------------------------------------------------------------------
+
+    def _build_request(self, feeds, deadline):
+        named = {k: np.asarray(v) for k, v in feeds.items()}
+        batch = None
+        key_parts = []
+        for name in sorted(named):
+            arr = named[name]
+            if name in self._batched_feeds and arr.ndim >= 1:
+                b = arr.shape[0]
+                if batch is None:
+                    batch = b
+                elif b != batch:
+                    raise ValueError(
+                        "inconsistent request batch: feed %r has leading "
+                        "dim %d, another batch-major feed has %d"
+                        % (name, b, batch))
+                key_parts.append((name, arr.shape[1:], str(arr.dtype)))
+            else:
+                # side feeds must be byte-identical to share a dispatch
+                key_parts.append((name, arr.shape, str(arr.dtype),
+                                  binascii.crc32(
+                                      np.ascontiguousarray(arr).tobytes())))
+        if batch is not None and batch > self.max_batch:
+            raise ValueError(
+                "request batch %d exceeds the largest servable bucket %d "
+                "(buckets %s) — split the request"
+                % (batch, self.max_batch, self.buckets or "(none)"))
+        return _Request(named, batch, tuple(key_parts), deadline)
+
+    def submit(self, feeds, deadline=None):
+        """Enqueue one request (dict name->array).  Returns a Future
+        resolving to the fetch list (this request's rows only).
+        `deadline` is an absolute time.monotonic() instant or None.
+        Raises ServerOverloaded / BatcherClosed / ValueError
+        synchronously — admission decisions are immediate."""
+        req = self._build_request(feeds, deadline)
+        with self._cv:
+            if self._closing:
+                raise BatcherClosed("model batcher is draining/retired")
+            if len(self._pending) >= self.max_queue:
+                if self.metrics is not None:
+                    self.metrics.shed.add()
+                raise ServerOverloaded(
+                    "request queue full (%d waiting, max_queue=%d) — "
+                    "request shed; back off and retry"
+                    % (len(self._pending), self.max_queue))
+            self._pending.append(req)
+            if self.metrics is not None:
+                self.metrics.requests.add()
+            self._cv.notify()
+        return req.future
+
+    def queue_depth(self):
+        return len(self._pending)
+
+    # ------------------------------------------------------------------
+    # dispatch side
+    # ------------------------------------------------------------------
+
+    def _bucket_cap(self, total):
+        for cap in self.buckets:
+            if total <= cap:
+                return cap
+        return total
+
+    def _take_group(self):
+        """Pop the head request plus every compatible queued request up
+        to the largest bucket, waiting up to the coalescing deadline for
+        stragglers.  Returns None only at shutdown."""
+        with self._cv:
+            while not self._pending:
+                if self._stopped or self._closing:
+                    return None
+                self._cv.wait(0.1)
+            head = self._pending.popleft()
+            group = [head]
+            if head.batch is None:
+                # no batch-major feed: nothing to coalesce on
+                self._inflight += 1
+                return group
+            total = head.batch
+            window = time.monotonic() + self.deadline_s
+            while total < self.max_batch:
+                took = False
+                for i, r in enumerate(self._pending):
+                    if r.group_key == head.group_key and \
+                            total + r.batch <= self.max_batch:
+                        del self._pending[i]
+                        group.append(r)
+                        total += r.batch
+                        took = True
+                        break
+                if took:
+                    continue
+                if self._pending:
+                    # only incompatible (or non-fitting) requests wait —
+                    # dispatch now rather than head-of-line block them
+                    break
+                remaining = window - time.monotonic()
+                if remaining <= 0 or self._stopped or self._closing:
+                    break
+                self._cv.wait(min(remaining, 0.05))
+            self._inflight += 1
+            return group
+
+    def _merge_feeds(self, group):
+        first = group[0]
+        if len(group) == 1:
+            return dict(first.feeds)
+        merged = {}
+        for name, arr in first.feeds.items():
+            if name in self._batched_feeds and arr.ndim >= 1:
+                merged[name] = np.concatenate(
+                    [r.feeds[name] for r in group], axis=0)
+            else:
+                merged[name] = arr  # group key proved byte-equality
+        return merged
+
+    def _scatter(self, group, fetches, total):
+        flags = self._fetch_flags
+        offset = 0
+        now = time.monotonic()
+        for r in group:
+            outs = []
+            for i, a in enumerate(fetches):
+                if flags is not None:
+                    batched = i < len(flags) and flags[i]
+                else:  # pre-marker AOT artifact: shape heuristic
+                    batched = a.ndim >= 1 and a.shape[0] == total
+                if batched and r.batch is not None:
+                    outs.append(a[offset:offset + r.batch])
+                else:
+                    outs.append(a)
+            offset += r.batch or 0
+            if not r.future.set_running_or_notify_cancel():
+                continue  # caller cancelled while queued
+            r.future.set_result(outs)
+            if self.metrics is not None:
+                self.metrics.note_completion(
+                    latency_ms=(now - r.enqueued) * 1000.0)
+
+    def _dispatch(self, group):
+        delay = _chaos_delay()
+        if delay:
+            time.sleep(delay)
+        now = time.monotonic()
+        live = []
+        for r in group:
+            if r.deadline is not None and now > r.deadline:
+                if self.metrics is not None:
+                    self.metrics.deadline_expired.add()
+                    self.metrics.errors.add()
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(DeadlineExceeded(
+                        "deadline passed after %.1f ms in queue"
+                        % ((now - r.enqueued) * 1000.0)))
+            else:
+                live.append(r)
+        if not live:
+            return
+        feeds = self._merge_feeds(live)
+        total = sum(r.batch or 0 for r in live)
+        fetches = self.predictor.run(feeds)
+        if self.metrics is not None:
+            cap = self._bucket_cap(total) if total else 0
+            self.metrics.note_dispatch(
+                n_requests=len(live), real_rows=total,
+                padded_rows=max(cap - total, 0))
+        self._scatter(live, fetches, total)
+
+    def _worker(self):
+        while True:
+            group = self._take_group()
+            if group is None:
+                return
+            try:
+                self._dispatch(group)
+            except BaseException as e:
+                for r in group:
+                    if not r.future.done() and \
+                            r.future.set_running_or_notify_cancel():
+                        r.future.set_exception(e)
+                if self.metrics is not None:
+                    self.metrics.errors.add(len(group))
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def drain(self, timeout=None):
+        """Block until every queued and in-flight request has resolved."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            self._cv.notify_all()
+            while self._pending or self._inflight:
+                rem = None if deadline is None else \
+                    max(deadline - time.monotonic(), 0.0)
+                if rem == 0.0:
+                    raise TimeoutError(
+                        "batcher still has %d queued + %d in-flight "
+                        "requests after %.1fs"
+                        % (len(self._pending), self._inflight, timeout))
+                self._cv.wait(0.05 if rem is None else min(rem, 0.05))
+
+    def close(self, drain=True, timeout=30.0):
+        """Stop accepting; optionally finish everything queued first
+        (the graceful-drain half of a hot swap or shutdown), then stop
+        the workers.  With drain=False, queued requests fail with
+        BatcherClosed."""
+        with self._cv:
+            self._closing = True
+            self._cv.notify_all()
+        if drain:
+            self.drain(timeout)
+        with self._cv:
+            self._stopped = True
+            leftovers = list(self._pending)
+            self._pending.clear()
+            self._cv.notify_all()
+        for r in leftovers:
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(
+                    BatcherClosed("server shut down before dispatch"))
+            if self.metrics is not None:
+                self.metrics.errors.add()
+        for t in self._threads:
+            t.join(timeout=5.0)

@@ -1,0 +1,225 @@
+"""Serving metrics: per-model counters and reservoir histograms.
+
+Reference analogue: the serving-side telemetry TensorFlow Serving exposes
+per servable (request count, latency percentiles, batch padding ratio) —
+the numbers an operator needs to size batch buckets and admission limits.
+Everything here is a plain in-process structure whose `snapshot()` is
+wire-encodable (str keys, numbers, lists), so the same dict travels over
+the `stats` RPC, lands in `tools/serving_top.py`, and rides bench lane
+JSON untouched.
+
+Histogram design: fixed-capacity reservoir sampling (Vitter's algorithm
+R) — O(1) memory however long the server runs, percentiles over an
+unbiased sample of the whole stream.  QPS is reported two ways: lifetime
+average and a sliding recent window (completion timestamps ring), since
+an idle-then-bursty server makes the lifetime number meaningless.
+"""
+
+import collections
+import random
+import threading
+import time
+
+__all__ = ["Counter", "ReservoirHistogram", "ModelMetrics",
+           "ServingMetrics"]
+
+
+class Counter:
+    """Monotonic counter; `add` returns the new total."""
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, n=1):
+        with self._lock:
+            self._value += n
+            return self._value
+
+    @property
+    def value(self):
+        return self._value
+
+
+class ReservoirHistogram:
+    """Fixed-memory histogram over an unbounded stream: keeps a uniform
+    random sample of `capacity` observations (reservoir sampling), plus
+    exact count/sum/min/max.  Percentiles interpolate over the sorted
+    reservoir — accurate to the sample, never unbounded in memory."""
+
+    def __init__(self, capacity=512, seed=0):
+        self.capacity = int(capacity)
+        self._samples = []
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def record(self, value):
+        v = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            self._min = v if self._min is None else min(self._min, v)
+            self._max = v if self._max is None else max(self._max, v)
+            if len(self._samples) < self.capacity:
+                self._samples.append(v)
+            else:
+                j = self._rng.randrange(self._count)
+                if j < self.capacity:
+                    self._samples[j] = v
+
+    @property
+    def count(self):
+        return self._count
+
+    def percentile(self, q):
+        """Linear-interpolated percentile (q in [0,100]) over the
+        reservoir; None when empty."""
+        with self._lock:
+            s = sorted(self._samples)
+        if not s:
+            return None
+        if len(s) == 1:
+            return s[0]
+        pos = (len(s) - 1) * (float(q) / 100.0)
+        lo = int(pos)
+        hi = min(lo + 1, len(s) - 1)
+        frac = pos - lo
+        return s[lo] * (1.0 - frac) + s[hi] * frac
+
+    def summary(self):
+        with self._lock:
+            n, total = self._count, self._sum
+            mn, mx = self._min, self._max
+        out = {"count": n}
+        if n:
+            out.update({
+                "mean": total / n, "min": mn, "max": mx,
+                "p50": self.percentile(50), "p95": self.percentile(95),
+                "p99": self.percentile(99),
+            })
+        return out
+
+
+class ModelMetrics:
+    """One served model's telemetry: request/response/shed counters, a
+    latency + queue-wait histogram, and dispatch geometry (how full each
+    micro-batch ran).  The batcher installs `queue_depth_fn` so depth is
+    read live at snapshot time rather than sampled."""
+
+    QPS_WINDOW_SECS = 60.0
+
+    def __init__(self, name):
+        self.name = name
+        self.requests = Counter()        # accepted submits
+        self.responses = Counter()       # futures resolved with a result
+        self.errors = Counter()          # futures resolved with an error
+        self.shed = Counter()            # rejected at admission
+        self.deadline_expired = Counter()  # dropped overdue pre-dispatch
+        self.dispatches = Counter()      # micro-batches executed
+        self.coalesced = Counter()       # requests carried by dispatches
+        self.batch_slots = Counter()     # real rows dispatched
+        self.padded_slots = Counter()    # pad rows added to reach bucket
+        self.latency_ms = ReservoirHistogram()
+        self.queue_wait_ms = ReservoirHistogram()
+        self.queue_depth_fn = None
+        self._started = time.monotonic()
+        self._completions = collections.deque()
+        self._lock = threading.Lock()
+
+    def note_completion(self, latency_ms, queue_wait_ms=None):
+        self.responses.add()
+        self.latency_ms.record(latency_ms)
+        if queue_wait_ms is not None:
+            self.queue_wait_ms.record(queue_wait_ms)
+        now = time.monotonic()
+        with self._lock:
+            self._completions.append(now)
+            horizon = now - self.QPS_WINDOW_SECS
+            while self._completions and self._completions[0] < horizon:
+                self._completions.popleft()
+
+    def note_dispatch(self, n_requests, real_rows, padded_rows):
+        self.dispatches.add()
+        self.coalesced.add(n_requests)
+        self.batch_slots.add(real_rows)
+        self.padded_slots.add(padded_rows)
+
+    def recent_qps(self):
+        now = time.monotonic()
+        with self._lock:
+            horizon = now - self.QPS_WINDOW_SECS
+            while self._completions and self._completions[0] < horizon:
+                self._completions.popleft()
+            n = len(self._completions)
+            if not n:
+                return 0.0
+            span = min(self.QPS_WINDOW_SECS, now - self._started)
+        return n / max(span, 1e-9)
+
+    def snapshot(self):
+        uptime = time.monotonic() - self._started
+        dispatches = self.dispatches.value
+        slots = self.batch_slots.value
+        padded = self.padded_slots.value
+        snap = {
+            "model": self.name,
+            "uptime_sec": round(uptime, 3),
+            "requests": self.requests.value,
+            "responses": self.responses.value,
+            "errors": self.errors.value,
+            "shed": self.shed.value,
+            "deadline_expired": self.deadline_expired.value,
+            "dispatches": dispatches,
+            "qps_recent": round(self.recent_qps(), 3),
+            "qps_lifetime": round(self.responses.value / max(uptime, 1e-9),
+                                  3),
+            # requests per dispatch: > 1 means cross-request coalescing
+            # is actually happening (the acceptance criterion's number)
+            "batch_fill": round(self.coalesced.value / dispatches, 3)
+            if dispatches else 0.0,
+            # real rows / (real + pad) rows: how much of each bucket the
+            # traffic filled — the TPU-utilization lever
+            "bucket_fill_ratio": round(slots / (slots + padded), 3)
+            if (slots + padded) else 0.0,
+            "latency_ms": self.latency_ms.summary(),
+            "queue_wait_ms": self.queue_wait_ms.summary(),
+        }
+        if self.queue_depth_fn is not None:
+            try:
+                snap["queue_depth"] = int(self.queue_depth_fn())
+            except Exception:
+                snap["queue_depth"] = -1
+        return snap
+
+
+class ServingMetrics:
+    """The server-wide registry: one ModelMetrics per model name (shared
+    across that model's versions — a hot swap does not reset counters)."""
+
+    def __init__(self):
+        self._models = {}
+        self._lock = threading.Lock()
+        self._started = time.monotonic()
+
+    def model(self, name):
+        with self._lock:
+            m = self._models.get(name)
+            if m is None:
+                m = self._models[name] = ModelMetrics(name)
+            return m
+
+    def drop(self, name):
+        with self._lock:
+            self._models.pop(name, None)
+
+    def snapshot(self):
+        with self._lock:
+            models = dict(self._models)
+        return {
+            "uptime_sec": round(time.monotonic() - self._started, 3),
+            "models": {name: m.snapshot() for name, m in models.items()},
+        }

@@ -1,0 +1,185 @@
+"""Named, versioned model registry with atomic hot swap.
+
+The multi-tenant half of the serving runtime: each model name maps to
+versioned entries (predictor + its DynamicBatcher); requests route
+through a `latest` pointer.  A hot swap follows the same commit
+discipline as the checkpoint vault (fluid/checkpoint.py): build the new
+version completely — load artifact, construct batcher, WARM it with a
+dummy batch per bucket so the first real request never eats a compile
+stall — then flip `latest` under the routing lock, and only afterwards
+drain and retire the displaced version.  A request that resolved the old
+version before the flip completes on it (the drain waits); a request
+after the flip runs the new one; no request is dropped or answered
+twice.
+
+Artifact detection: a directory containing `aot_meta.bin` is a
+`save_aot` artifact (AotPredictor — no Program rebuild, no trace); any
+other directory is treated as a `save_inference_model` dir served by a
+live `Predictor` under `AnalysisConfig` (IR rewrites + AOT jit compile,
+bucketed).
+"""
+
+import os
+import threading
+
+import numpy as np
+
+from .batcher import DynamicBatcher
+from .metrics import ServingMetrics
+
+__all__ = ["ModelRegistry", "ModelEntry", "open_predictor"]
+
+
+def open_predictor(path, buckets=None):
+    """Open a serving artifact directory as the right predictor type."""
+    from ..inference import AnalysisConfig, Predictor, load_aot_predictor
+    if os.path.exists(os.path.join(path, "aot_meta.bin")):
+        return load_aot_predictor(path)
+    if not os.path.isdir(path):
+        raise FileNotFoundError("no model artifact directory at %r" % path)
+    config = AnalysisConfig(model_dir=path)
+    if buckets:
+        config.batch_size_buckets = tuple(sorted(int(b) for b in buckets))
+    return Predictor(config)
+
+
+class ModelEntry:
+    """One (name, version): the predictor, its batcher, and its path."""
+
+    def __init__(self, name, version, path, predictor, batcher):
+        self.name = name
+        self.version = version
+        self.path = path
+        self.predictor = predictor
+        self.batcher = batcher
+
+    def warm(self):
+        """Run one zero dummy batch per bucket DIRECTLY on the predictor
+        (not through the batcher — warming must not mix with traffic).
+        After this, every bucket's executable is compiled/loaded and the
+        first real request at any size runs at steady-state latency."""
+        specs = self.predictor.feed_specs()
+        buckets = self.predictor.batch_buckets() or (1,)
+        batched = self.predictor.batched_feed_names()
+        for cap in buckets:
+            feeds = {}
+            for fname, (shape, dtype) in specs.items():
+                if fname in batched:
+                    s = [cap if d == -1 else d for d in shape]
+                else:
+                    s = [1 if d == -1 else d for d in shape]
+                feeds[fname] = np.zeros(tuple(s), dtype=np.dtype(dtype))
+            self.predictor.run(feeds)
+        return self
+
+
+class ModelRegistry:
+    """name -> {versions, latest} with hot swap and drain-on-retire."""
+
+    def __init__(self, metrics=None, max_queue=None, deadline_ms=None,
+                 workers=None):
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._max_queue = max_queue
+        self._deadline_ms = deadline_ms
+        self._workers = workers
+        self._lock = threading.Lock()
+        self._models = {}  # name -> {"versions": {v: entry}, "latest": v}
+
+    # ------------------------------------------------------------------
+
+    def load_model(self, name, path, version=None, warm=True,
+                   buckets=None, drain_timeout=30.0):
+        """Load (or hot-swap in) `path` as `name`.  Returns the entry.
+        The displaced latest version, if any, is drained and retired
+        AFTER the flip — in-flight requests on it complete."""
+        predictor = open_predictor(path, buckets=buckets)
+        batcher = DynamicBatcher(
+            predictor, max_queue=self._max_queue,
+            deadline_ms=self._deadline_ms, workers=self._workers,
+            metrics=self.metrics.model(name))
+        entry = ModelEntry(name, version, path, predictor, batcher)
+        if warm:
+            try:
+                entry.warm()
+            except BaseException:
+                batcher.close(drain=False, timeout=1.0)
+                raise
+        displaced = None
+        with self._lock:
+            slot = self._models.setdefault(
+                name, {"versions": {}, "latest": None})
+            if version is None:
+                prev = [v for v in slot["versions"] if isinstance(v, int)]
+                version = entry.version = (max(prev) + 1) if prev else 1
+            old_latest = slot["latest"]
+            if old_latest is not None and old_latest != version:
+                displaced = slot["versions"].get(old_latest)
+            replaced_same = slot["versions"].get(version)
+            slot["versions"][version] = entry
+            slot["latest"] = version  # the atomic flip
+        for old in (displaced, replaced_same):
+            if old is not None and old is not entry:
+                old.batcher.close(drain=True, timeout=drain_timeout)
+                with self._lock:
+                    slot = self._models.get(name)
+                    if slot and slot["versions"].get(old.version) is old:
+                        del slot["versions"][old.version]
+        return entry
+
+    def unload_model(self, name, drain_timeout=30.0):
+        """Remove `name` entirely: new requests fail immediately,
+        in-flight/queued ones drain first."""
+        with self._lock:
+            slot = self._models.pop(name, None)
+        if slot is None:
+            raise KeyError("no model %r" % name)
+        for entry in slot["versions"].values():
+            entry.batcher.close(drain=True, timeout=drain_timeout)
+        self.metrics.drop(name)
+
+    def model_names(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def describe(self):
+        with self._lock:
+            return {
+                name: {"latest": slot["latest"],
+                       "versions": sorted(slot["versions"]),
+                       "buckets": list(
+                           slot["versions"][slot["latest"]]
+                           .predictor.batch_buckets())
+                       if slot["latest"] in slot["versions"] else []}
+                for name, slot in self._models.items()}
+
+    # ------------------------------------------------------------------
+
+    def submit(self, name, feeds, version=None, deadline=None):
+        """Route one request; returns the batcher Future.  Resolution
+        and submit happen under ONE lock acquisition so a concurrent hot
+        swap can never retire a version between the two (the no-dropped-
+        request guarantee: the swap's drain only starts after the flip,
+        and every pre-flip submit is already queued)."""
+        with self._lock:
+            slot = self._models.get(name)
+            if slot is None:
+                raise KeyError("no model %r" % name)
+            v = slot["latest"] if version is None else version
+            entry = slot["versions"].get(v)
+            if entry is None:
+                raise KeyError("model %r has no version %r" % (name, v))
+            return entry.batcher.submit(feeds, deadline=deadline)
+
+    def infer(self, name, feeds, version=None, deadline=None,
+              timeout=None):
+        """Blocking submit+wait convenience for in-process callers."""
+        return self.submit(name, feeds, version=version,
+                           deadline=deadline).result(timeout=timeout)
+
+    def close_all(self, drain=True, timeout=30.0):
+        with self._lock:
+            slots = list(self._models.values())
+            self._models.clear()
+        for slot in slots:
+            for entry in slot["versions"].values():
+                entry.batcher.close(drain=drain, timeout=timeout)

@@ -1,0 +1,340 @@
+"""Threaded inference server + client over the native wire protocol.
+
+The serving front: the same length-prefixed typed-wire framing as the
+parameter-server transport (distributed/rpc.py over native/wire.py — no
+pickle ever touches a socket), carrying four commands:
+
+  infer         {"cmd","model","feeds"{name->ndarray},"deadline_ms"?,
+                 "version"?} -> {"ok","fetches"[ndarray...]} or
+                 {"error","code"} with code in {"overloaded","deadline",
+                 "no_model","bad_request","internal"}
+  load_model    {"cmd","name","path","version"?} — hot swap
+  unload_model  {"cmd","name"} — drain then remove
+  stats         {"cmd"} -> the ServingMetrics snapshot
+  shutdown      graceful drain, then the server stops accepting
+
+Admission control is the batcher's bounded queue: a request past
+`FLAGS.serving_max_queue` is answered immediately with an "overloaded"
+error (shed-not-hang).  Per-request deadlines bound BOTH queue wait and
+the reply wait server-side; the client's `infer` reuses the shared
+jittered-backoff RetryPolicy (utils/retry.py) to re-offer shed requests
+until its deadline — jitter matters for the same reason it does on the
+pserver plane: synchronized retries stampede a recovering server.
+
+Graceful drain on shutdown: stop admitting, finish every queued
+request, answer it, then exit — chaos-tested (tools/chaos.py FlakyProxy
++ slow-worker injection) in tests/test_serving.py.
+"""
+
+import os
+import socket
+import socketserver
+import threading
+import time
+
+import numpy as np
+
+from ..distributed.rpc import _recv_msg, _send_msg
+from ..flags import FLAGS
+from ..native.wire import WireError
+from .batcher import BatcherClosed, DeadlineExceeded, ServerOverloaded
+from .metrics import ServingMetrics
+from .model_registry import ModelRegistry
+
+__all__ = ["InferenceServer", "ServingClient", "ServingError"]
+
+_CLOSE = object()
+
+
+class ServingError(RuntimeError):
+    """Server-side failure reported over the wire (non-typed codes)."""
+
+
+def _error_reply(exc):
+    if isinstance(exc, ServerOverloaded):
+        return {"error": str(exc), "code": "overloaded"}
+    if isinstance(exc, (DeadlineExceeded, TimeoutError)):
+        return {"error": str(exc), "code": "deadline"}
+    if isinstance(exc, KeyError):
+        return {"error": str(exc.args[0]) if exc.args else str(exc),
+                "code": "no_model"}
+    if isinstance(exc, (ValueError, TypeError, BatcherClosed)):
+        return {"error": str(exc), "code": "bad_request"}
+    return {"error": "%s: %s" % (type(exc).__name__, exc),
+            "code": "internal"}
+
+
+class InferenceServer:
+    """One serving endpoint over a ModelRegistry.
+
+    `model_root`: optional directory whose immediate subdirectories are
+    loaded at start as models (subdir name == model name) — the
+    "directory of artifacts -> multi-tenant service" contract."""
+
+    def __init__(self, endpoint="127.0.0.1:0", model_root=None,
+                 max_queue=None, deadline_ms=None, workers=None,
+                 buckets=None):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self.metrics = ServingMetrics()
+        self.registry = ModelRegistry(
+            metrics=self.metrics, max_queue=max_queue,
+            deadline_ms=deadline_ms, workers=workers)
+        self._default_buckets = buckets
+        self._model_root = model_root
+        self._stopped = False
+        self._draining = False
+        self._server = None
+        self._thread = None
+
+    # ------------------------------------------------------------------
+
+    def _load_root(self):
+        root = self._model_root
+        if not root:
+            return
+        for name in sorted(os.listdir(root)):
+            path = os.path.join(root, name)
+            if os.path.isdir(path):
+                self.registry.load_model(name, path,
+                                         buckets=self._default_buckets)
+
+    def start(self, background=True):
+        self._load_root()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        try:
+                            reply = outer._dispatch(msg)
+                        except BaseException as e:
+                            reply = _error_reply(e)
+                        if reply is _CLOSE:
+                            _send_msg(self.request, {"ok": True})
+                            break
+                        try:
+                            _send_msg(self.request, reply)
+                        except WireError as e:
+                            # oversize outgoing frame: stream still in
+                            # sync, surface the actionable message
+                            _send_msg(self.request, {"error": str(e),
+                                                     "code": "internal"})
+                except WireError:
+                    pass  # desynced incoming stream: drop the connection
+                except (ConnectionError, EOFError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+            # socketserver's default listen backlog of 5 makes a client
+            # burst stall on SYN retransmits (seconds each) before the
+            # request even reaches admission control; admission belongs
+            # to the batcher's queue, not the kernel's
+            request_queue_size = 128
+
+        self._server = Server(self._addr, Handler)
+        self._addr = self._server.server_address
+        if background:
+            self._thread = threading.Thread(target=self._serve,
+                                            daemon=True)
+            self._thread.start()
+        else:
+            self._serve()
+        return self
+
+    @property
+    def endpoint(self):
+        return "%s:%d" % (self._addr[0], self._addr[1])
+
+    def _serve(self):
+        self._server.timeout = 0.2
+        with self._server:
+            while not self._stopped:
+                self._server.handle_request()
+
+    def shutdown(self, drain=True, timeout=30.0):
+        """Graceful stop: refuse new work, drain every queued request,
+        then stop accepting connections."""
+        self._draining = True
+        self.registry.close_all(drain=drain, timeout=timeout)
+        self._stopped = True
+        try:
+            s = socket.create_connection(self._addr, timeout=1)
+            s.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------------
+
+    def _dispatch(self, msg):
+        cmd = msg.get("cmd")
+        if cmd == "infer":
+            return self._handle_infer(msg)
+        if cmd == "stats":
+            return {"ok": True, "stats": self.metrics.snapshot(),
+                    "models": self.registry.describe()}
+        if cmd == "load_model":
+            if self._draining:
+                raise BatcherClosed("server is draining")
+            entry = self.registry.load_model(
+                msg["name"], msg["path"], version=msg.get("version"),
+                buckets=msg.get("buckets") or self._default_buckets)
+            return {"ok": True, "name": entry.name,
+                    "version": entry.version,
+                    "buckets": list(entry.predictor.batch_buckets())}
+        if cmd == "unload_model":
+            self.registry.unload_model(msg["name"])
+            return {"ok": True}
+        if cmd == "shutdown":
+            # drain BEFORE replying so the client's ok means "all prior
+            # requests answered"; the accept loop stops right after
+            threading.Thread(target=self.shutdown, daemon=True).start()
+            return {"ok": True, "draining": True}
+        if cmd == "exit":
+            self._stopped = True
+            return _CLOSE
+        return {"error": "unknown cmd %r" % cmd, "code": "bad_request"}
+
+    def _handle_infer(self, msg):
+        name = msg["model"]
+        feeds = msg["feeds"]
+        if not isinstance(feeds, dict) or not feeds:
+            raise ValueError("infer needs a non-empty feeds dict")
+        if self._draining:
+            raise ServerOverloaded("server is draining — request refused")
+        deadline_ms = msg.get("deadline_ms")
+        deadline = None
+        wait = 120.0  # never park a handler thread forever
+        if deadline_ms is not None:
+            deadline = time.monotonic() + float(deadline_ms) / 1000.0
+            wait = float(deadline_ms) / 1000.0 + 5.0
+        future = self.registry.submit(name, feeds,
+                                      version=msg.get("version"),
+                                      deadline=deadline)
+        try:
+            fetches = future.result(timeout=wait)
+        except DeadlineExceeded:
+            raise
+        except TimeoutError:
+            raise DeadlineExceeded(
+                "request did not complete within its %.0f ms deadline"
+                % (deadline_ms if deadline_ms is not None else wait * 1e3))
+        return {"ok": True,
+                "fetches": [np.ascontiguousarray(a) for a in fetches]}
+
+
+class ServingClient:
+    """Wire client for InferenceServer.  Connections are thread-local
+    (same rationale as RPCClient: a blocking round-trip per call, one
+    socket per (thread, endpoint)).
+
+    `infer` semantics: with a deadline, shed ("overloaded") replies and
+    connection failures are retried under the shared jittered-backoff
+    RetryPolicy until the deadline; without one, a shed surfaces
+    immediately as ServerOverloaded so the caller owns the policy."""
+
+    def __init__(self, endpoint, deadline_ms=None, retry_policy=None):
+        self.endpoint = endpoint
+        self.deadline_ms = deadline_ms
+        self._policy = retry_policy
+        self._tls = threading.local()
+
+    def _conn(self):
+        s = getattr(self._tls, "sock", None)
+        if s is None:
+            host, port = self.endpoint.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=FLAGS.rpc_deadline)
+            self._tls.sock = s
+        return s
+
+    def _drop_conn(self):
+        s = getattr(self._tls, "sock", None)
+        self._tls.sock = None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _call_once(self, msg):
+        s = self._conn()
+        try:
+            _send_msg(s, msg)
+            reply = _recv_msg(s)
+        except (ConnectionError, EOFError, OSError, WireError):
+            self._drop_conn()
+            raise
+        if "error" in reply:
+            code = reply.get("code")
+            if code == "overloaded":
+                raise ServerOverloaded(reply["error"])
+            if code == "deadline":
+                raise DeadlineExceeded(reply["error"])
+            raise ServingError("%s (code=%s)" % (reply["error"], code))
+        return reply
+
+    def _call(self, msg, retry_deadline=None, retry_on=()):
+        if retry_deadline is None:
+            return self._call_once(msg)
+        from ..utils.retry import default_rpc_policy
+        policy = self._policy or default_rpc_policy(
+            max_attempts=1 << 20, max_delay=0.5)
+        return policy.call(
+            lambda: self._call_once(msg),
+            retry_on=(ConnectionError, OSError, EOFError) + tuple(retry_on),
+            on_retry=lambda e, attempt: self._drop_conn()
+            if isinstance(e, (ConnectionError, OSError, EOFError))
+            else None,
+            deadline=retry_deadline)
+
+    def infer(self, model, feeds, deadline_ms=None, version=None,
+              retry_sheds=None):
+        deadline_ms = self.deadline_ms if deadline_ms is None \
+            else deadline_ms
+        msg = {"cmd": "infer", "model": model,
+               "feeds": {k: np.ascontiguousarray(np.asarray(v))
+                         for k, v in feeds.items()}}
+        if version is not None:
+            msg["version"] = version
+        retry_deadline = None
+        retry_on = ()
+        if deadline_ms is not None:
+            msg["deadline_ms"] = float(deadline_ms)
+            retry_deadline = time.monotonic() + float(deadline_ms) / 1000.0
+            if retry_sheds is None or retry_sheds:
+                retry_on = (ServerOverloaded,)
+        elif retry_sheds:
+            raise ValueError("retry_sheds needs a deadline_ms to bound it")
+        reply = self._call(msg, retry_deadline=retry_deadline,
+                           retry_on=retry_on)
+        return list(reply["fetches"])
+
+    def load_model(self, name, path, version=None, buckets=None):
+        msg = {"cmd": "load_model", "name": name, "path": path}
+        if version is not None:
+            msg["version"] = version
+        if buckets is not None:
+            msg["buckets"] = [int(b) for b in buckets]
+        return self._call(msg)
+
+    def unload_model(self, name):
+        return self._call({"cmd": "unload_model", "name": name})
+
+    def stats(self):
+        return self._call({"cmd": "stats"})
+
+    def shutdown_server(self, drain=True):
+        try:
+            return self._call({"cmd": "shutdown", "drain": bool(drain)})
+        except (ConnectionError, OSError, EOFError):
+            return None
+
+    def close(self):
+        self._drop_conn()

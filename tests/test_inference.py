@@ -225,6 +225,93 @@ def test_aot_fixed_shape_side_feed_not_padded(tmp_path):
     assert res.shape == (1, 4)
 
 
+def test_predictor_fixed_shape_side_feed_not_padded(tmp_path):
+    """The live Predictor honors the same batch-major markers as the AOT
+    path (PR 3 satellite): a fixed-shape side feed must NOT be bucket-
+    padded, and the request batch comes from a batch-major feed
+    regardless of dict order."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = startup.random_seed = 23
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[4], dtype="float32")
+        aux = fluid.layers.data(name="aux", shape=[4], dtype="float32",
+                                append_batch_size=False)
+        out = fluid.layers.elementwise_add(
+            fluid.layers.fc(input=img, size=4), aux, axis=-1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = str(tmp_path / "m")
+        fluid.save_inference_model(md, ["img", "aux"], [out], exe,
+                                   main_program=main)
+    p = create_paddle_predictor(AnalysisConfig(model_dir=md))
+    x = rng.randn(1, 4).astype(np.float32)  # pads to bucket 1... batch 1
+    a = rng.randn(4).astype(np.float32)
+    # aux first in dict order: the old code read the batch from (and
+    # padded) the first feed seen — a silently padded side feed
+    res, = p.run({"aux": a, "img": x})
+    assert res.shape == (1, 4)
+    res3, = p.run({"aux": a, "img": rng.randn(3, 4).astype(np.float32)})
+    assert res3.shape == (3, 4)  # batch 3 pads to bucket 4, unpads back
+
+
+def test_predictor_unpad_spares_global_fetches(tmp_path):
+    """Un-padding in the live Predictor keys off the program-var -1
+    marker, not the shape>=batch heuristic: a reduced output whose
+    leading dim equals the padded bucket comes back whole."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = startup.random_seed = 24
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[4], dtype="float32")
+        pred = fluid.layers.fc(input=img, size=8, act="softmax")
+        colsum = fluid.layers.reduce_sum(pred, dim=0)  # shape [8]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = str(tmp_path / "m")
+        fluid.save_inference_model(md, ["img"], [pred, colsum], exe,
+                                   main_program=main)
+    cfg = AnalysisConfig(model_dir=md)
+    cfg.batch_size_buckets = (8,)
+    p = create_paddle_predictor(cfg)
+    x = rng.randn(1, 4).astype(np.float32)  # b=1, padded to cap=8
+    got_pred, got_colsum = p.run({"img": x})
+    assert got_pred.shape == (1, 8)      # batch-major: un-padded
+    assert got_colsum.shape == (8,), got_colsum.shape  # global: whole
+
+
+def test_predictor_bucket_overflow_warns_once(trained_model):
+    """A batch above every bucket falls through to a per-size compile;
+    serving observability demands a one-time warning naming the size."""
+    import warnings
+    model_dir, x, ref = trained_model
+    cfg = AnalysisConfig(model_dir=model_dir)
+    cfg.batch_size_buckets = (2,)
+    pred = create_paddle_predictor(cfg)
+    big = np.concatenate([x, x], axis=0)  # batch 8 > bucket cap 2
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out, = pred.run({"img": big})
+        pred.run({"img": big})  # same size again: no second warning
+    assert out.shape[0] == 8
+    msgs = [str(w.message) for w in caught
+            if "exceeds every configured bucket" in str(w.message)]
+    assert len(msgs) == 1, msgs
+    assert "batch 8" in msgs[0]
+    # a different overflow size warns again (it names each size once)
+    bigger = np.concatenate([big, x], axis=0)
+    with warnings.catch_warnings(record=True) as caught2:
+        warnings.simplefilter("always")
+        pred.run({"img": bigger})
+    assert any("batch 12" in str(w.message) for w in caught2)
+
+
 def test_aot_export_rejects_non_batch_dynamic_dims(tmp_path):
     main = fluid.Program()
     startup = fluid.Program()

@@ -29,8 +29,8 @@ def _stage_key(cmd, env_extra):
         return "remat"
     if "bench_zoo" in joined:
         return "bench_zoo"
-    for tool in ("bench_infer", "convergence_run", "tune_bottleneck",
-                 "bench_attention"):
+    for tool in ("bench_infer", "bench_serving", "convergence_run",
+                 "tune_bottleneck", "bench_attention"):
         if tool in joined:
             return tool
     return "bench.py"
@@ -105,6 +105,45 @@ def test_persistent_failure_skips_after_cap(monkeypatch, tmp_path):
     # the rest of the sweep still completed
     assert "bench_zoo" in calls
     assert "nhwc+remat" not in {r["sweep"] for r in rec}
+
+
+@pytest.mark.parametrize("watchdog,expect", [("123", "123.0"),
+                                             ("0", None)])
+def test_watchdog_exported_to_every_stage(monkeypatch, tmp_path,
+                                          watchdog, expect):
+    """Satellite of PR 3 (ROADMAP open item from PR 2): every sweep
+    stage runs with FLAGS.step_watchdog_secs exported so a wedged
+    dispatch self-reports via StepWatchdogTimeout; 0 disables."""
+    captured = []
+
+    class Cap(_Script):
+        def __call__(self, cmd, env_extra, log, timeout):
+            captured.append(dict(env_extra))
+            return _Script.__call__(self, cmd, env_extra, log, timeout)
+
+    sc = Cap({})
+    monkeypatch.setattr(tpu_watch, "run_logged", sc)
+    monkeypatch.setattr(tpu_watch, "probe", lambda timeout=120: "tpu")
+    monkeypatch.setattr(tpu_watch.time, "sleep", lambda s: None)
+    monkeypatch.setattr(sys, "argv", [
+        "tpu_watch.py", "--log", str(tmp_path / "w.log"),
+        "--lock", str(tmp_path / "w.lock"),
+        "--results_dir", str(tmp_path),
+        "--watchdog_secs", watchdog])
+    tpu_watch.main()
+    assert captured
+    for env_extra in captured:
+        assert env_extra.get("PADDLE_TPU_FLAGS_step_watchdog_secs") \
+            == expect
+    # stage-specific env vars must survive the merge
+    assert any(e.get("BENCH_REMAT") == "1" for e in captured)
+
+
+def test_serving_stage_in_sweep_after_infer(monkeypatch, tmp_path):
+    calls, _ = _run(monkeypatch, tmp_path, {}, ["tpu"])
+    assert "bench_serving" in calls
+    assert calls.index("bench_serving") > calls.index("bench_infer")
+    assert calls.index("bench_serving") < calls.index("profile")
 
 
 def test_flagship_flushed_before_zoo_runs(monkeypatch, tmp_path):

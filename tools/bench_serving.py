@@ -1,0 +1,266 @@
+"""Serving benchmark: open-loop load generator for the inference server.
+
+Open-loop matters: a closed-loop client (send, wait, send) slows down
+exactly when the server does, hiding queueing collapse. Here request
+arrivals are a Poisson process at a target QPS, generated on schedule
+whether or not earlier requests returned — so an overloaded server shows
+up as latency blowup + sheds, never as a flattered throughput number.
+
+Per target-QPS point it prints ONE JSON line compatible with the
+bench_zoo lane format:
+
+  {"metric": "serving_qps", "model": ..., "target_qps": ...,
+   "achieved_qps": ..., "p50_ms": ..., "p95_ms": ..., "p99_ms": ...,
+   "shed_rate": ..., "batch_fill": ..., "bucket_fill_ratio": ...,
+   "errors": ..., "backend": ...}
+
+The server runs in-process (threads, same machine) on a model exported
+fresh: `--model fc` (tiny, the CPU/CI path), `--model mnist`, or
+`--model resnet` (the TPU serving flagship). `--smoke` forces the tiny
+fc model with a short sweep — tier-1 CI proof that the whole
+client->wire->batcher->predictor->scatter path works.
+
+Chaos: --chaos_proxy routes traffic through tools/chaos.py's FlakyProxy
+(connection kills mid-flight), --chaos_slow_ms injects a slow-worker
+stall per dispatch — the shed-not-hang proof under real overload.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def build_model(kind, model_dir, seed=17):
+    """Train-free export of an inference artifact; returns
+    (model_dir, feed_name, feed_shape_per_sample, dtype)."""
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        if kind == "fc":
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            h = fluid.layers.fc(input=x, size=32, act="relu")
+            pred = fluid.layers.fc(input=h, size=10, act="softmax")
+            shape = (16,)
+        elif kind == "mnist":
+            x = fluid.layers.data(name="x", shape=[1, 28, 28],
+                                  dtype="float32")
+            conv = fluid.layers.conv2d(input=x, num_filters=8,
+                                       filter_size=3, padding=1,
+                                       act="relu")
+            pool = fluid.layers.pool2d(input=conv, pool_size=2,
+                                       pool_stride=2)
+            pred = fluid.layers.fc(input=pool, size=10, act="softmax")
+            shape = (1, 28, 28)
+        elif kind == "resnet":
+            from paddle_tpu.models.resnet import resnet_imagenet
+            x = fluid.layers.data(name="x", shape=[224, 224, 3],
+                                  dtype="float32")
+            pred = resnet_imagenet(x, class_dim=1000, depth=50,
+                                   is_train=False, layout="NHWC")
+            shape = (224, 224, 3)
+        else:
+            raise ValueError("unknown model kind %r" % kind)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.save_inference_model(model_dir, ["x"], [pred], exe,
+                                   main_program=main)
+    return model_dir, "x", shape, "float32"
+
+
+def run_point(endpoint, model, feed_name, sample_shape, dtype,
+              target_qps, duration, req_batch, deadline_ms, seed=0):
+    """One open-loop measurement point at `target_qps` for `duration`s."""
+    from paddle_tpu.serving import DeadlineExceeded, ServerOverloaded
+    rng = random.Random(seed)
+    data = np.asarray(
+        np.random.RandomState(seed).randn(req_batch, *sample_shape),
+        dtype=dtype)
+    lat_lock = threading.Lock()
+    latencies = []
+    counters = {"ok": 0, "shed": 0, "deadline": 0, "error": 0}
+
+    def fire(scheduled):
+        cli = _pool_client(endpoint)
+        # open-loop latency: measured from the SCHEDULED arrival, so
+        # time lost waiting for a free connection counts against the
+        # server, not the harness
+        try:
+            cli.infer(model, {feed_name: data}, deadline_ms=deadline_ms,
+                      retry_sheds=False)
+            key = "ok"
+        except ServerOverloaded:
+            key = "shed"
+        except DeadlineExceeded:
+            key = "deadline"
+        except Exception:
+            key = "error"
+        done = time.monotonic()
+        with lat_lock:
+            counters[key] += 1
+            if key == "ok":
+                latencies.append((done - scheduled) * 1000.0)
+
+    clients = {}
+
+    def _pool_client(ep):
+        tid = threading.get_ident()
+        c = clients.get(tid)
+        if c is None:
+            from paddle_tpu.serving import ServingClient as SC
+            c = clients[tid] = SC(ep)
+        return c
+
+    threads = []
+    t_end = time.monotonic() + duration
+    next_t = time.monotonic()
+    while next_t < t_end:
+        now = time.monotonic()
+        if next_t > now:
+            time.sleep(next_t - now)
+        th = threading.Thread(target=fire, args=(next_t,), daemon=True)
+        th.start()
+        threads.append(th)
+        next_t += rng.expovariate(target_qps)
+    for th in threads:
+        th.join(timeout=max(deadline_ms / 1000.0, 1.0) + 10.0)
+    sent = sum(counters.values())
+    with lat_lock:
+        ls = sorted(latencies)
+
+    def pct(q):
+        if not ls:
+            return None
+        return round(ls[min(int(len(ls) * q / 100.0), len(ls) - 1)], 3)
+
+    return {
+        "metric": "serving_qps",
+        "target_qps": target_qps,
+        "sent": sent,
+        "ok": counters["ok"],
+        "achieved_qps": round(counters["ok"] / duration, 2),
+        "shed_rate": round(counters["shed"] / sent, 4) if sent else 0.0,
+        "deadline_rate": round(counters["deadline"] / sent, 4)
+        if sent else 0.0,
+        "errors": counters["error"],
+        "p50_ms": pct(50), "p95_ms": pct(95), "p99_ms": pct(99),
+        "req_batch": req_batch,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="fc",
+                    choices=["fc", "mnist", "resnet"])
+    ap.add_argument("--qps", default="50,200",
+                    help="comma-separated target-QPS sweep")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="seconds per QPS point")
+    ap.add_argument("--req_batch", type=int, default=1,
+                    help="rows per client request (the batcher coalesces "
+                         "across requests on top of this)")
+    ap.add_argument("--max_bucket", type=int, default=32,
+                    help="largest compiled batch bucket; the bucket set "
+                         "is {max/4, max/2, max}")
+    ap.add_argument("--deadline_ms", type=float, default=2000.0)
+    ap.add_argument("--deadline_batch_ms", type=float, default=None,
+                    help="batcher coalescing window override "
+                         "(default FLAGS.serving_batch_deadline_ms)")
+    ap.add_argument("--max_queue", type=int, default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fc model, short sweep (CI path)")
+    ap.add_argument("--require_tpu", action="store_true")
+    ap.add_argument("--chaos_proxy", action="store_true",
+                    help="route through a FlakyProxy that kills the "
+                         "first connection mid-flight (shed-not-hang "
+                         "under transport chaos)")
+    ap.add_argument("--chaos_slow_ms", type=float, default=0.0,
+                    help="slow-worker injection: stall every dispatch "
+                         "this many ms")
+    args = ap.parse_args()
+
+    from bench import init_backend
+    on_tpu, backend_label = init_backend(
+        smoke=args.smoke, require_tpu=args.require_tpu,
+        tool="bench_serving")
+
+    kind = args.model
+    qps_points = [float(q) for q in args.qps.split(",") if q]
+    duration = args.duration
+    max_bucket = args.max_bucket
+    if args.smoke or not on_tpu:
+        # CPU path: tiny fc model, short points — proves the serving
+        # path end-to-end, never mistakable for a chip number
+        kind = "fc"
+        if args.smoke:
+            qps_points = [100.0]
+        duration = min(duration, 2.0)
+        max_bucket = min(max_bucket, 8)
+
+    buckets = sorted({max(max_bucket // 4, 1), max(max_bucket // 2, 1),
+                      max_bucket})
+    workdir = tempfile.mkdtemp(prefix="bench_serving_")
+    model_dir, feed_name, shape, dtype = build_model(
+        kind, os.path.join(workdir, kind))
+
+    from paddle_tpu.serving import InferenceServer, set_dispatch_delay
+    server = InferenceServer(
+        max_queue=args.max_queue, deadline_ms=args.deadline_batch_ms,
+        buckets=buckets).start()
+    endpoint = server.endpoint
+    proxy = None
+    if args.chaos_proxy:
+        from tools.chaos import FlakyProxy
+        proxy = FlakyProxy(server.endpoint, drop_first=1).start()
+        endpoint = proxy.endpoint
+    if args.chaos_slow_ms:
+        set_dispatch_delay(args.chaos_slow_ms / 1000.0)
+
+    try:
+        from paddle_tpu.serving import ServingClient
+        boot = ServingClient(endpoint)
+        boot.load_model(kind, model_dir, buckets=buckets)
+        # one warm request outside the timed window
+        warm = np.zeros((1,) + shape, dtype=dtype)
+        boot.infer(kind, {feed_name: warm}, deadline_ms=60000.0)
+        for q in qps_points:
+            rec = run_point(endpoint, kind, feed_name, shape, dtype,
+                            target_qps=q, duration=duration,
+                            req_batch=args.req_batch,
+                            deadline_ms=args.deadline_ms)
+            stats = boot.stats()["stats"]["models"].get(kind, {})
+            rec.update({
+                "model": kind,
+                "buckets": buckets,
+                "batch_fill": stats.get("batch_fill"),
+                "bucket_fill_ratio": stats.get("bucket_fill_ratio"),
+                "shed_total": stats.get("shed"),
+                "chaos_proxy": bool(proxy),
+                "chaos_slow_ms": args.chaos_slow_ms,
+            })
+            if backend_label:
+                rec["backend"] = backend_label
+            print(json.dumps(rec), flush=True)
+    finally:
+        set_dispatch_delay(0.0)
+        if proxy is not None:
+            proxy.stop()
+        server.shutdown(drain=True)
+
+
+if __name__ == "__main__":
+    main()

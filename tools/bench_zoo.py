@@ -63,6 +63,18 @@ CONFIGS = [
     ("stacked_dynamic_lstm_deviceloop",
      ["--model", "stacked_dynamic_lstm", "--device_loop", "10"], 64, 8),
     ("machine_translation_wmt", ["--model", "machine_translation"], 16, 4),
+    # serving lanes (SERVING.md): open-loop Poisson load through the
+    # dynamic micro-batcher onto bucketed executables — measures the
+    # serving FRONT (coalescing, padding, admission) where bench_infer
+    # measures the raw per-batch compute it dispatches onto. The batch
+    # column is the largest bucket; the "@serving" marker routes the
+    # lane to tools/bench_serving.py instead of fluid_benchmark.
+    ("serving_resnet_b32",
+     ["@serving", "--model", "resnet", "--qps", "100,400",
+      "--duration", "20"], 32, 4),
+    ("serving_resnet_b128",
+     ["@serving", "--model", "resnet", "--qps", "400,1600",
+      "--duration", "20"], 128, 4),
     # pipelined variants: fetch (host sync) every 10 steps instead of
     # each one — shows the small-model throughput with async dispatch
     # allowed to overlap steps (bench.py's flagship methodology); the
@@ -113,6 +125,44 @@ def probe_backend(timeout=120):
 
 
 def run_config(name, extra, batch, iterations, force_cpu):
+    if extra and extra[0] == "@serving":
+        # serving lane: bench_serving owns its own sweep protocol; batch
+        # is the largest compiled bucket, and the CPU fallback runs its
+        # self-describing smoke mode
+        cmd = [sys.executable, os.path.join(HERE, "bench_serving.py")] \
+            + extra[1:] + ["--max_bucket", str(batch)]
+        if force_cpu:
+            cmd += ["--smoke"]
+        t0 = time.time()
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=1800, cwd=REPO)
+        except subprocess.TimeoutExpired:
+            return {"config": name, "error": "timeout after 1800s",
+                    "timeout": True,
+                    "wall_sec": round(time.time() - t0, 1)}
+        wall = time.time() - t0
+        if proc.returncode != 0:
+            return {"config": name, "error": proc.stderr[-800:],
+                    "wall_sec": round(wall, 1)}
+        points = []
+        for line in proc.stdout.splitlines():
+            if line.startswith("{"):
+                try:
+                    points.append(json.loads(line))
+                except ValueError:
+                    pass
+        if not points:
+            return {"config": name, "wall_sec": round(wall, 1),
+                    "error": "no JSON record on stdout; tail: %r"
+                             % proc.stdout[-400:]}
+        # one zoo record per lane: the highest-QPS point headlines, the
+        # full sweep rides along
+        rec = dict(points[-1])
+        rec["config"] = name
+        rec["sweep_points"] = points
+        rec["wall_sec"] = round(wall, 1)
+        return rec
     if force_cpu and "--device_loop" in extra:
         # smoke mode only checks the path works; a 10-deep loop of
         # resnet-class steps on CPU blows the per-config timeout

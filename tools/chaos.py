@@ -19,6 +19,12 @@ exits nonzero on failure):
                kills the first connection mid-flight; prove the jittered
                retry re-dials and the lease protocol's resend/req_id
                dedup hands back exactly-once work.
+  serving-overload
+               flood an inference server (paddle_tpu/serving) through a
+               FlakyProxy with slow-worker injection and a tiny
+               admission queue; prove overflow is shed with an explicit
+               ServerOverloaded and EVERY request resolves — shed, not
+               hang (SERVING.md overload semantics).
 
   --smoke      crash-save (deterministic `exit` fault at every commit
                point) + bit-flip, fast enough for tier-1.
@@ -436,6 +442,90 @@ def scenario_drop_rpc(verbose=True):
     return True
 
 
+def scenario_serving_overload(verbose=True):
+    """Serving shed-not-hang: an in-process inference server behind a
+    connection-killing FlakyProxy, with slow-worker injection and a tiny
+    admission queue, takes a burst far past capacity.  Required
+    invariants: (1) some requests succeed, (2) overflow is shed with an
+    explicit ServerOverloaded, (3) EVERY request resolves — success,
+    shed, or deadline — within a bound; nothing hangs."""
+    import tempfile
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.serving import (DeadlineExceeded, InferenceServer,
+                                    ServerOverloaded, ServingClient,
+                                    set_dispatch_delay)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = os.path.join(tempfile.mkdtemp(prefix="chaos_srv_"), "m")
+        fluid.save_inference_model(md, ["x"], [pred], exe,
+                                   main_program=main_p)
+
+    server = InferenceServer(max_queue=4, buckets=(2, 4)).start()
+    proxy = FlakyProxy(server.endpoint, drop_first=2,
+                       drop_after_bytes=64).start()
+    x_req = np.zeros((1, 8), np.float32)
+    outcomes = {"ok": 0, "shed": 0, "deadline": 0, "conn": 0}
+    lock = threading.Lock()
+
+    def one_request(i):
+        cli = ServingClient(proxy.endpoint)
+        try:
+            cli.infer("m", {"x": x_req}, deadline_ms=500.0,
+                      retry_sheds=False)
+            key = "ok"
+        except ServerOverloaded:
+            key = "shed"
+        except DeadlineExceeded:
+            key = "deadline"
+        except (ConnectionError, OSError, EOFError, RuntimeError):
+            key = "conn"
+        finally:
+            cli.close()
+        with lock:
+            outcomes[key] += 1
+
+    try:
+        boot = ServingClient(server.endpoint)  # not via the proxy
+        boot.load_model("m", md, buckets=[2, 4])
+        boot.infer("m", {"x": x_req})  # warm through the real endpoint
+        set_dispatch_delay(0.15)       # slow worker: force a backlog
+        threads = [threading.Thread(target=one_request, args=(i,))
+                   for i in range(32)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        wall = time.time() - t0
+        alive = [t for t in threads if t.is_alive()]
+        assert not alive, "%d requests HUNG under overload" % len(alive)
+        total = sum(outcomes.values())
+        assert total == 32, "lost requests: %s" % outcomes
+        assert outcomes["ok"] >= 1, "nothing succeeded: %s" % outcomes
+        assert outcomes["shed"] >= 1, \
+            "queue never shed (admission control dead): %s" % outcomes
+        assert proxy.dropped >= 1, "proxy never injected a drop"
+    finally:
+        set_dispatch_delay(0.0)
+        proxy.stop()
+        server.shutdown(drain=False, timeout=5.0)
+    if verbose:
+        print("PASS serving-overload: %d ok / %d shed / %d deadline / "
+              "%d conn-killed in %.1fs, %d proxy drops, zero hangs"
+              % (outcomes["ok"], outcomes["shed"], outcomes["deadline"],
+                 outcomes["conn"], wall, proxy.dropped))
+    return outcomes
+
+
 def run_smoke(workdir):
     """Tier-1 smoke: deterministic crash at every commit point + the
     bit-flip rejection — no timing races, CPU-only, a few seconds."""
@@ -461,7 +551,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", choices=["crash-save", "bit-flip",
                                            "nan-poison", "drop-rpc",
-                                           "all"])
+                                           "serving-overload", "all"])
     ap.add_argument("--smoke", action="store_true",
                     help="fast deterministic subset for CI")
     ap.add_argument("--workdir", default=None)
@@ -488,7 +578,8 @@ def main(argv=None):
     if args.smoke:
         return run_smoke(workdir)
     if args.scenario in (None, "all"):
-        scenarios = ["crash-save", "bit-flip", "nan-poison", "drop-rpc"]
+        scenarios = ["crash-save", "bit-flip", "nan-poison", "drop-rpc",
+                     "serving-overload"]
     else:
         scenarios = [args.scenario]
     rc = 0
@@ -504,6 +595,8 @@ def main(argv=None):
                 scenario_nan_poison()
             elif s == "drop-rpc":
                 scenario_drop_rpc()
+            elif s == "serving-overload":
+                scenario_serving_overload()
         except AssertionError as e:
             rc = 1
             print("FAIL %s: %s" % (s, e))

@@ -37,6 +37,8 @@ MODULES = [
     "paddle_tpu.fluid.contrib",
     "paddle_tpu.fluid.nets",
     "paddle_tpu.reader",
+    "paddle_tpu.inference",
+    "paddle_tpu.serving",
     "paddle_tpu.v2.layer",
     "paddle_tpu.v2.networks",
     "paddle_tpu.v2.optimizer",

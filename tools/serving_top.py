@@ -1,0 +1,81 @@
+"""serving_top — one-shot stats dump for a running inference server.
+
+Connects to an InferenceServer endpoint, issues the `stats` RPC, and
+prints a per-model table (QPS, latency percentiles, batch fill, queue
+depth, sheds) — the operator's glance at whether the batch buckets and
+admission limits fit the traffic.  `--json` dumps the raw snapshot for
+scripts.
+
+Usage: python tools/serving_top.py HOST:PORT [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _fmt(v, unit=""):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return "%.1f%s" % (v, unit)
+    return "%s%s" % (v, unit)
+
+
+def render(reply):
+    stats = reply.get("stats", {})
+    models = stats.get("models", {})
+    desc = reply.get("models", {})
+    lines = ["server uptime %.0fs, %d model(s)"
+             % (stats.get("uptime_sec", 0.0), len(models)), ""]
+    hdr = ("%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s"
+           % ("MODEL", "VER", "QPS", "REQS", "p50ms", "p95ms", "p99ms",
+              "FILL", "BKT%", "QUEUE", "SHED"))
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for name in sorted(models):
+        m = models[name]
+        lat = m.get("latency_ms", {})
+        d = desc.get(name, {})
+        lines.append(
+            "%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s"
+            % (name[:14], _fmt(d.get("latest")),
+               _fmt(m.get("qps_recent")), _fmt(m.get("requests")),
+               _fmt(lat.get("p50")), _fmt(lat.get("p95")),
+               _fmt(lat.get("p99")), _fmt(m.get("batch_fill")),
+               _fmt(round(100.0 * m.get("bucket_fill_ratio", 0.0), 1)),
+               _fmt(m.get("queue_depth")), _fmt(m.get("shed"))))
+        if d.get("buckets"):
+            lines.append("    buckets=%s versions=%s"
+                         % (d["buckets"], d.get("versions")))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("endpoint", help="HOST:PORT of the inference server")
+    ap.add_argument("--json", action="store_true",
+                    help="raw snapshot JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.serving import ServingClient
+    cli = ServingClient(args.endpoint)
+    try:
+        reply = cli.stats()
+    finally:
+        cli.close()
+    if args.json:
+        print(json.dumps(reply, indent=1, default=str))
+    else:
+        print(render(reply))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

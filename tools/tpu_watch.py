@@ -93,8 +93,21 @@ def main():
                     help="where BENCH_watch.json / the round-stamped "
                          "recovery record land (tests point this at a "
                          "tmpdir)")
+    ap.add_argument("--watchdog_secs", type=float, default=900.0,
+                    help="export FLAGS.step_watchdog_secs into every "
+                         "stage so a wedged dispatch raises "
+                         "StepWatchdogTimeout (named, fast) instead of "
+                         "burning the stage's full subprocess timeout "
+                         "silently (ROADMAP open item from PR 2). Adds "
+                         "a per-step block_until_ready — hang detection "
+                         "mode, so recovery-sweep numbers carry that "
+                         "sync; 0 disables")
     args = ap.parse_args()
     _claim_singleton(args.lock)
+    watchdog_env = {}
+    if args.watchdog_secs > 0:
+        watchdog_env["PADDLE_TPU_FLAGS_step_watchdog_secs"] = \
+            str(args.watchdog_secs)
 
     # Sweep stages in VERDICT-r4 priority order: the remat flagship runs
     # are "the single most valuable unmeasured number in the repo" and go
@@ -118,6 +131,12 @@ def main():
                         "BENCH_zoo_r05.json", "--require_tpu",
                         "--resume", "--staged", "4"], {}, 14400),
         ("infer", ["tools/bench_infer.py", "--require_tpu"], {}, 1800),
+        # serving front throughput/latency (SERVING.md): dynamic
+        # micro-batching over the AOT buckets under open-loop load;
+        # after bench_infer (the raw compute ceiling it batches onto),
+        # before the remat flagship profile (riskiest compile last)
+        ("serving", ["tools/bench_serving.py", "--require_tpu"], {},
+         1800),
         ("convergence", ["tools/convergence_run.py", "--require_tpu"],
          {}, 3600),
         ("tune_bottleneck", ["tools/tune_bottleneck.py", "--require_tpu"],
@@ -176,7 +195,8 @@ def main():
                             MAX_FAILURES:
                         continue
                     ok, out = run_logged(
-                        [sys.executable] + argv, env, log, timeout)
+                        [sys.executable] + argv,
+                        dict(watchdog_env, **env), log, timeout)
                     if ok:
                         done.add(name)
                         parse_lines(out, name)

@@ -279,6 +279,27 @@ DEFINE_float(
     "TPU transport outage hung jax inside C, unkillable from Python). "
     "0 disables; enabling forces a block_until_ready per step, so this "
     "is a hang-detection mode, not a fast path.")
+DEFINE_int(
+    "async_dispatch_depth", 0,
+    "Asynchronous step dispatch: the Trainer (and the bench harnesses) "
+    "keep up to this many steps' fetches in flight as live device "
+    "arrays (Executor.run(as_future=True) -> FetchFuture) and resolve "
+    "them at the pipeline tail with one batched jax.device_get each — "
+    "loss bookkeeping, sentinel NaN/Inf screening and event callbacks "
+    "lag dispatch by <= depth steps (PIPELINE.md). 0 (default) keeps "
+    "the fully synchronous per-step behavior. The async trajectory is "
+    "bit-exact vs sync on finite runs (same RNG step folds, same "
+    "donation discipline); after a non-finite step the sentinel's "
+    "recovery re-dispatches the in-flight batches from the reverted "
+    "state, so post-anomaly trajectories legitimately differ.")
+DEFINE_int(
+    "reader_prefetch_depth", 0,
+    "Device prefetch queue depth for the Trainer's reader path "
+    "(reader.prefetch_to_device): a bounded background thread runs "
+    "prepare_feeds + the device_put for the NEXT batch while the "
+    "current step computes — the double_buffer/py_reader infeed "
+    "overlap (operators/reader/buffered_reader.cc). 0 (default) feeds "
+    "on the main thread each step.")
 DEFINE_float(
     "serving_batch_deadline_ms", 5.0,
     "Serving micro-batcher coalescing window: after the first request of "

@@ -13,6 +13,8 @@ from .framework import (  # noqa: F401
 )
 from . import executor
 from .executor import Executor, global_scope, scope_guard, Scope  # noqa: F401
+from . import pipeline  # noqa: F401  (async step pipeline, PIPELINE.md)
+from .pipeline import FetchFuture, DispatchPipeline  # noqa: F401
 from . import layers
 from . import initializer
 from . import optimizer

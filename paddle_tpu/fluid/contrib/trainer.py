@@ -4,8 +4,21 @@ Reference analogue: python/paddle/fluid/contrib/trainer.py — Trainer (:169),
 train loop with events (BeginEpochEvent/EndEpochEvent/BeginStepEvent/
 EndStepEvent :40-:94), CheckpointConfig auto-save/resume (:100), Tester, and
 env-driven distributed transpile (:324).
+
+Async training pipeline (PIPELINE.md): with
+``FLAGS.async_dispatch_depth > 0`` the train loop keeps up to N steps in
+flight as FetchFutures (Executor.run(as_future=True)) and drains loss
+bookkeeping, the sentinel's NaN/Inf screen and EndStepEvent callbacks
+from the pipeline tail — host sync happens once per drain (one batched
+jax.device_get), not once per step.  ``FLAGS.reader_prefetch_depth > 0``
+additionally stages the NEXT batch on device from a background thread
+(reader.prefetch_to_device) while the current step computes.  The async
+trajectory is bit-exact vs the sync loop on finite runs: the feeds, the
+dispatch order, and the executor's RNG step folds are identical — only
+WHEN the host looks at the results changes.
 """
 
+import collections
 import os
 
 import numpy as np
@@ -63,6 +76,26 @@ class CheckpointConfig:
         self.load_serial = None
 
 
+class _PendingStep:
+    """One dispatched-but-undrained step of the async pipeline: the
+    FetchFuture plus everything the drain needs — the (epoch, step) ids
+    for the deferred EndStepEvent, the feed/fetch lists so a sentinel
+    recovery can re-dispatch this batch from a restored state, and the
+    pre/post persistable ref snapshots (immutable jax arrays: snapshots
+    are free) that make the skip/rollback machinery depth-aware."""
+
+    __slots__ = ("epoch", "step", "feed", "fetch", "future", "pre", "post")
+
+    def __init__(self, epoch, step, feed, fetch, future, pre, post):
+        self.epoch = epoch
+        self.step = step
+        self.feed = feed
+        self.fetch = fetch
+        self.future = future
+        self.pre = pre
+        self.post = post
+
+
 class Trainer:
     """reference contrib/trainer.py:169. `train_func` builds the loss (and
     optionally extra metrics) in the current program; `optimizer_func`
@@ -118,7 +151,7 @@ class Trainer:
     def stop(self):
         self._stop = True
 
-    def _make_sentinel(self):
+    def _make_sentinel(self, pipeline_depth=0):
         from ...flags import FLAGS
         if not FLAGS.sentinel_nan_check:
             return None
@@ -126,9 +159,10 @@ class Trainer:
         return sentinel_mod.AnomalySentinel(
             max_bad_steps=FLAGS.sentinel_max_bad_steps,
             policy=FLAGS.sentinel_policy,
-            check_params=FLAGS.sentinel_check_params)
+            check_params=FLAGS.sentinel_check_params,
+            pipeline_depth=pipeline_depth)
 
-    def _run_step(self, feed, fetch, sentinel):
+    def _run_step(self, feed, fetch, sentinel, step_id=None):
         """One executor step, optionally screened by the anomaly
         sentinel: on a non-finite step the pre-step persistable refs are
         restored (jax arrays are immutable, so the snapshot is free) and
@@ -137,7 +171,6 @@ class Trainer:
         if sentinel is None:
             return self.exe.run(self.train_program, feed=feed,
                                 fetch_list=fetch)
-        import warnings
         from .. import functionalizer, sentinel as sentinel_mod
         scope = global_scope()
         names = functionalizer.persistable_names(self.train_program)
@@ -148,39 +181,123 @@ class Trainer:
                          metrics))
         if sentinel.check_params:
             named += [(n, scope.get(n)) for n in names if scope.has(n)]
-        verdict = sentinel.observe(named)
+        verdict = sentinel.observe(named, step=step_id)
         if verdict == sentinel_mod.SKIP:
             for n, v in pre.items():
                 scope.set(n, v)
-            warnings.warn(
-                "sentinel: non-finite step (%s) reverted — %d/%d "
-                "consecutive" % (", ".join(sentinel.last_bad_names),
-                                 sentinel.consecutive_bad,
-                                 sentinel.max_bad_steps))
+            self._warn_skip(sentinel, 0)
         elif verdict == sentinel_mod.ROLLBACK:
-            if not self.checkpoint_cfg:
-                raise sentinel_mod.SentinelError(
-                    "sentinel policy 'rollback' needs a checkpoint_config "
-                    "with a last-good checkpoint, and this Trainer has "
-                    "none")
-            try:
-                meta = fluid_io.load_checkpoint(
-                    self.exe, self.checkpoint_cfg.checkpoint_dir,
-                    main_program=self.train_program)
-            except FileNotFoundError:
-                raise sentinel_mod.SentinelError(
-                    "sentinel: rollback requested but no checkpoint "
-                    "exists yet under %s"
-                    % self.checkpoint_cfg.checkpoint_dir)
-            sentinel.note_rollback_done()
-            warnings.warn(
-                "sentinel: %d consecutive non-finite steps — rolled back "
-                "to last-good checkpoint (epoch %s, step %s)"
-                % (sentinel.consecutive_bad, meta.get("epoch"),
-                   meta.get("step")))
+            self._rollback_last_good(sentinel)
         return metrics
 
+    @staticmethod
+    def _warn_skip(sentinel, discarded):
+        import warnings
+        extra = ""
+        if discarded:
+            extra = (" (pipeline: %d in-flight step(s) discarded "
+                     "un-observed and re-dispatched from the reverted "
+                     "state)" % discarded)
+        warnings.warn(
+            "sentinel: non-finite step (%s) reverted — %d/%d "
+            "consecutive%s" % (", ".join(sentinel.last_bad_names),
+                               sentinel.consecutive_bad,
+                               sentinel.max_bad_steps, extra))
+
+    def _rollback_last_good(self, sentinel):
+        import warnings
+        from .. import sentinel as sentinel_mod
+        if not self.checkpoint_cfg:
+            raise sentinel_mod.SentinelError(
+                "sentinel policy 'rollback' needs a checkpoint_config "
+                "with a last-good checkpoint, and this Trainer has "
+                "none")
+        try:
+            meta = fluid_io.load_checkpoint(
+                self.exe, self.checkpoint_cfg.checkpoint_dir,
+                main_program=self.train_program)
+        except FileNotFoundError:
+            raise sentinel_mod.SentinelError(
+                "sentinel: rollback requested but no checkpoint "
+                "exists yet under %s"
+                % self.checkpoint_cfg.checkpoint_dir)
+        sentinel.note_rollback_done()
+        warnings.warn(
+            "sentinel: %d consecutive non-finite steps — rolled back "
+            "to last-good checkpoint (epoch %s, step %s)"
+            % (sentinel.consecutive_bad, meta.get("epoch"),
+               meta.get("step")))
+        return meta
+
+    # ---- async pipeline: in-flight dispatch + deferred drain --------
+
+    def _dispatch_step(self, epoch_id, step_id, feed, fetch, sentinel):
+        """Dispatch one step WITHOUT host sync (Executor.run as_future)
+        and record what its eventual drain needs.  The pre/post scope
+        snapshots bracket this step's persistable refs: `pre` is the
+        restore target if THIS step turns out non-finite, `post` is the
+        state the sentinel screens under check_params (at drain time
+        the live scope already holds later in-flight steps' state, so
+        screening it would attribute a later step's corruption here)."""
+        scope = global_scope()
+        pre = post = None
+        names = None
+        if sentinel is not None:
+            from .. import functionalizer
+            names = functionalizer.persistable_names(self.train_program)
+            pre = {n: scope.get(n) for n in names if scope.has(n)}
+        future = self.exe.run(self.train_program, feed=feed,
+                              fetch_list=fetch, as_future=True)
+        if sentinel is not None:
+            post = {n: scope.get(n) for n in names if scope.has(n)}
+        return _PendingStep(epoch_id, step_id, feed, fetch, future,
+                            pre, post)
+
+    def _discard_and_redispatch(self, pending, sentinel):
+        """Depth-aware recovery: every in-flight step was dispatched
+        from state downstream of the step just reverted/rolled back, so
+        its results must never be observed.  Drop them un-resolved and
+        re-dispatch the SAME batches (same feeds, original event ids)
+        from the restored state — no data is lost to a bad step; only
+        the RNG step folds of the replayed steps differ, exactly as the
+        sync loop's post-anomaly trajectory would differ anyway."""
+        dropped = list(pending)
+        pending.clear()
+        if dropped:
+            sentinel.note_inflight_discarded(len(dropped))
+        for d in dropped:
+            pending.append(self._dispatch_step(
+                d.epoch, d.step, d.feed, d.fetch, sentinel))
+        return len(dropped)
+
+    def _drain_step(self, pending, sentinel):
+        """Resolve the OLDEST in-flight step (ONE batched host sync via
+        FetchFuture.result — the watchdog wraps this drain, scaled by
+        how many steps the resolve may be waiting behind) and run the
+        sentinel screen that dispatch deferred."""
+        from .. import sentinel as sentinel_mod
+        ent = pending.popleft()
+        metrics = ent.future.result(watchdog_scale=len(pending) + 2)
+        if sentinel is None:
+            return ent, metrics
+        scope = global_scope()
+        named = list(zip((getattr(f, "name", str(f)) for f in ent.fetch),
+                         metrics))
+        if sentinel.check_params:
+            named += sorted(ent.post.items())
+        verdict = sentinel.observe(named, step=ent.step)
+        if verdict == sentinel_mod.SKIP:
+            for n, v in ent.pre.items():
+                scope.set(n, v)
+            discarded = self._discard_and_redispatch(pending, sentinel)
+            self._warn_skip(sentinel, discarded)
+        elif verdict == sentinel_mod.ROLLBACK:
+            self._rollback_last_good(sentinel)
+            self._discard_and_redispatch(pending, sentinel)
+        return ent, metrics
+
     def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        from ...flags import FLAGS
         from ..data_feeder import DataFeeder
         feeder = DataFeeder(feed_list=[
             self.train_program.global_block().var(n) for n in feed_order],
@@ -193,26 +310,91 @@ class Trainer:
         # of its epoch were already trained; replaying the (deterministic)
         # reader and skipping them reproduces the uninterrupted trajectory
         resume_skip = cfg.epoch_step if cfg else 0
-        sentinel = self._make_sentinel()
+        depth = max(int(FLAGS.async_dispatch_depth), 0)
+        if FLAGS.check_nan_inf or FLAGS.benchmark:
+            # both modes force a per-step host sync by definition — the
+            # pipeline would only defer what they exist to observe
+            depth = 0
+        sentinel = self._make_sentinel(pipeline_depth=depth)
+        feed_fn = feeder.feed if feeder else (lambda d: d)
+        prefetch = max(int(FLAGS.reader_prefetch_depth), 0)
+        if prefetch > 0 and reader is not None:
+            # device prefetch queue: prepare_feeds (dtype casts, LoD
+            # padding, async device_put) for the NEXT batch runs on the
+            # prefetch thread while the current step computes; items
+            # arrive device-staged, so the per-step feed path below is
+            # a pass-through
+            from ...reader import prefetch_to_device
+            from ..executor import prepare_feeds
+            prog, make_feed = self.train_program, feed_fn
+            reader = prefetch_to_device(
+                reader, prefetch,
+                prepare=lambda d: prepare_feeds(prog, make_feed(d)))
+            feed_fn = lambda d: d  # noqa: E731
+        pending = collections.deque()
+
+        def drain_one():
+            nonlocal global_step
+            ent, metrics = self._drain_step(pending, sentinel)
+            event_handler(EndStepEvent(ent.epoch, ent.step, metrics))
+            global_step += 1
+            return ent
+
+        def drain_and_maybe_checkpoint():
+            ent = drain_one()
+            if cfg and global_step % cfg.step_interval == 0:
+                # a checkpoint is a sync boundary: flush the window so
+                # the scope state matches the step ids the vault
+                # records (saves coalesce when step_interval < depth)
+                while pending:
+                    ent = drain_one()
+                self._save_checkpoint(ent.epoch, global_step,
+                                      ent.step + 1)
+
         try:
             for epoch_id in range(start_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
-                for step_id, data in enumerate(reader()):
-                    if epoch_id == start_epoch and step_id < resume_skip:
-                        continue
-                    if self._stop:
-                        return
-                    begin = BeginStepEvent(epoch_id, step_id)
-                    event_handler(begin)
-                    fetch = self.train_outputs if begin.fetch_metrics \
-                        else []
-                    feed = feeder.feed(data) if feeder else data
-                    metrics = self._run_step(feed, fetch, sentinel)
-                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
-                    global_step += 1
-                    if cfg and global_step % cfg.step_interval == 0:
-                        self._save_checkpoint(epoch_id, global_step,
-                                              step_id + 1)
+                batches = reader()
+                try:
+                    for step_id, data in enumerate(batches):
+                        if epoch_id == start_epoch and \
+                                step_id < resume_skip:
+                            continue
+                        if self._stop:
+                            # stop() lands within <= depth steps: the
+                            # in-flight window still drains (its events
+                            # fire; state already includes those steps)
+                            while pending:
+                                drain_one()
+                            return
+                        begin = BeginStepEvent(epoch_id, step_id)
+                        event_handler(begin)
+                        fetch = self.train_outputs if begin.fetch_metrics \
+                            else []
+                        feed = feed_fn(data)
+                        if depth == 0:
+                            metrics = self._run_step(feed, fetch, sentinel,
+                                                     step_id=step_id)
+                            event_handler(EndStepEvent(epoch_id, step_id,
+                                                       metrics))
+                            global_step += 1
+                            if cfg and global_step % cfg.step_interval == 0:
+                                self._save_checkpoint(epoch_id, global_step,
+                                                      step_id + 1)
+                        else:
+                            pending.append(self._dispatch_step(
+                                epoch_id, step_id, feed, fetch, sentinel))
+                            while len(pending) > depth:
+                                drain_and_maybe_checkpoint()
+                finally:
+                    # explicit close, not GC: the prefetch worker (and
+                    # any generator-held resource) must die with the
+                    # epoch even when the loop exits early
+                    close = getattr(batches, "close", None)
+                    if close is not None:
+                        close()
+                while pending:
+                    drain_and_maybe_checkpoint()
                 event_handler(EndEpochEvent(epoch_id))
                 if cfg and (epoch_id + 1) % cfg.epoch_interval == 0:
                     self._save_checkpoint(epoch_id + 1, global_step, 0)
@@ -223,18 +405,36 @@ class Trainer:
 
     def test(self, reader, feed_order):
         test_program = self.train_program.clone(for_test=True)
+        from ...flags import FLAGS
         from ..data_feeder import DataFeeder
         feeder = DataFeeder(feed_list=[
             test_program.global_block().var(n) for n in feed_order],
             place=self.place, program=test_program)
+        # deferred-drain eval: dispatch up to async_dispatch_depth
+        # batches before resolving; each drain converts the step's
+        # fetches with ONE batched device_get (FetchFuture.result), not
+        # a per-item float64 asarray loop per step
+        depth = max(int(FLAGS.async_dispatch_depth), 0)
+        pending = collections.deque()
         accum, count = None, 0
-        for data in reader():
-            res = self.exe.run(test_program, feed=feeder.feed(data),
-                               fetch_list=self.train_outputs)
+
+        def drain():
+            nonlocal accum, count
+            fut = pending.popleft()
+            res = fut.result(watchdog_scale=len(pending) + 2)
             vals = [np.asarray(r).astype(np.float64) for r in res]
             accum = vals if accum is None else [
                 a + v for a, v in zip(accum, vals)]
             count += 1
+
+        for data in reader():
+            pending.append(self.exe.run(
+                test_program, feed=feeder.feed(data),
+                fetch_list=self.train_outputs, as_future=True))
+            while len(pending) > depth:
+                drain()
+        while pending:
+            drain()
         return [a / max(count, 1) for a in accum] if accum else []
 
     def save_params(self, param_path):

@@ -22,9 +22,10 @@ import numpy as np
 from . import core
 from .framework import Program, Variable, default_main_program
 from . import functionalizer
+from .pipeline import FetchFuture
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard", "as_numpy",
-           "StepWatchdogTimeout"]
+           "StepWatchdogTimeout", "FetchFuture"]
 
 
 class StepWatchdogTimeout(TimeoutError):
@@ -401,7 +402,18 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name="feed", fetch_var_name="fetch", scope=None,
-            return_numpy=True, use_program_cache=True):
+            return_numpy=True, use_program_cache=True, as_future=False):
+        """One training/eval step.  With `as_future=True` the step is
+        DISPATCHED but not resolved: the return value is a FetchFuture
+        holding the fetches as live device arrays, and the host sync
+        (one batched jax.device_get) happens when the caller drains it
+        via `.result()` — the in-flight dispatch mode of the async
+        training pipeline (PIPELINE.md).  State updates land in the
+        scope immediately as (unresolved) device arrays, so back-to-back
+        dispatches chain on device without host round-trips.  Paths
+        that are inherently synchronous (FLAGS.check_nan_inf, host-op
+        programs, FLAGS.benchmark) still honor the contract by
+        returning an already-resolved future."""
         if program is None:
             program = default_main_program()
         if feed is None:
@@ -475,9 +487,13 @@ class Executor:
             new_state = {n: env[n] for n in persistables if n in env}
         else:
             fn = self._get_jitted(program, feed_key, fetch_ext, persistables)
+            # in-flight mode: the dispatch is non-blocking by design and
+            # the watchdog wraps the DRAIN (FetchFuture.result) instead
+            # of forcing a block_until_ready inside every dispatch
+            wd = 0 if as_future else FLAGS.step_watchdog_secs
             fetches, new_state = self._dispatch(
                 lambda: fn(state_in, feeds, np.uint32(step)),
-                FLAGS.step_watchdog_secs, "jitted executor step")
+                wd, "jitted executor step")
         if FLAGS.benchmark:
             # reference FLAGS_benchmark: force device sync per step so
             # wall-clock timing around run() is honest (scope.cc:25)
@@ -487,6 +503,18 @@ class Executor:
             _check_nan_inf(fetch_names, fetches, new_state)
         for n, val in new_state.items():
             scope.set(n, val)
+        if as_future:
+            post = (lambda vals, rn: self._post_fetches(
+                fetch_names, lod_fetch, seg_fetch, vals, rn))
+            fut = FetchFuture(fetches, post=post,
+                              return_numpy=return_numpy,
+                              what="executor step drain")
+            if FLAGS.benchmark or FLAGS.check_nan_inf:
+                # these modes already forced per-step sync semantics —
+                # hand back a resolved future so the caller's drain is
+                # a no-op rather than a second conversion site
+                fut.result()
+            return fut
         return self._post_fetches(fetch_names, lod_fetch, seg_fetch,
                                   fetches, return_numpy)
 
@@ -494,7 +522,13 @@ class Executor:
     def _post_fetches(fetch_names, lod_fetch, seg_fetch, fetches,
                       return_numpy):
         """Reassemble fetched values; ragged ones (with @LOD_LEN
-        companions) become LoDTensors, nested levels from @LOD_SEG."""
+        companions) become LoDTensors, nested levels from @LOD_SEG.
+        The device->host copy is ONE batched jax.device_get over every
+        fetch of the step, not a per-item np.asarray loop — serial
+        transfers cost a host round-trip each."""
+        if return_numpy and any(f is not None for f in fetches):
+            import jax
+            fetches = jax.device_get(list(fetches))
         n_names = len(fetch_names)
         lens_by_name = dict(zip(lod_fetch,
                                 fetches[n_names:n_names + len(lod_fetch)]))

@@ -24,6 +24,7 @@ import numpy as np
 
 from . import core
 from .executor import global_scope, as_numpy, _fetch_name
+from .pipeline import FetchFuture
 from .framework import default_main_program
 from . import functionalizer
 from ..parallel.mesh import data_parallel_mesh, DATA_AXIS
@@ -329,16 +330,25 @@ class ParallelExecutor:
         for n, val in new_state.items():
             self._scope.set(n, val)
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            # one batched device->host copy for the whole fetch list —
+            # a per-item np.asarray loop would serialize the transfers
+            import jax
+            return jax.device_get(list(fetches))
         return list(fetches)
 
-    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True,
+            as_future=False):
         """reference parallel_executor.py:169. `feed` may be one dict (full
         global batch, split across devices — the reference's split path) or a
         list of per-device dicts (concatenated here, then sharded). In
         nccl2 multi-trainer mode each array is this trainer's LOCAL
         batch; the global array spans num_trainers x local (the
-        reference's per-trainer reader semantics)."""
+        reference's per-trainer reader semantics).
+
+        `as_future=True` dispatches the SPMD step without resolving:
+        the FetchFuture keeps the fetches as live (sharded) device
+        arrays and the host sync is deferred to `.result()` — same
+        in-flight contract as Executor.run (PIPELINE.md)."""
         fetch_names = tuple(_fetch_name(f) for f in fetch_list)
         feeds = self._prepare_feeds(feed, feed_dict)
         feed_key = tuple(sorted(feeds.keys()))
@@ -352,6 +362,12 @@ class ParallelExecutor:
         self._step += 1
         for n, val in new_state.items():
             self._scope.set(n, val)
+        if as_future:
+            return FetchFuture(fetches, return_numpy=return_numpy,
+                               what="parallel executor step drain")
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            # one batched device->host copy for the whole fetch list —
+            # per-item np.asarray would serialize the gathers
+            import jax
+            return jax.device_get(list(fetches))
         return list(fetches)

@@ -1,0 +1,175 @@
+"""Asynchronous step pipeline: in-flight dispatch + deferred host sync.
+
+Reference analogue: the Fluid stack kept accelerators busy by decoupling
+the feed path from the step loop — `double_buffer` / `py_reader` reader
+ops fed the device while the previous batch computed
+(operators/reader/buffered_reader.cc), and the C++ executor's fetch ops
+only forced a device->host copy when the train loop actually read the
+LoDTensor.  TF's input pipelining (arXiv:1605.08695 §4.4) and the MLPerf
+TPU-v3 scaling work (arXiv:1909.09756) both identify host->device infeed
+overlap and async dispatch as first-order throughput levers.
+
+TPU redesign: jax dispatch is already asynchronous — the jitted step
+returns *future-backed* device arrays immediately and the host only
+blocks when it converts one to numpy.  What the runtime was missing is
+the discipline to EXPLOIT that: `Executor.run(..., as_future=True)`
+returns a `FetchFuture` (the fetched values as live device arrays) and
+the train loop keeps up to `FLAGS.async_dispatch_depth` of them in
+flight, resolving each at the pipeline tail with ONE batched
+`jax.device_get` instead of a per-item `np.asarray` loop.  The step
+watchdog wraps the *drain* (`FetchFuture.result`), not the dispatch, so
+hang detection no longer forces a per-step device sync.
+
+The pieces:
+
+* `FetchFuture` — one dispatched step's fetches; `result()` resolves
+  them (once, cached) with a single batched transfer, optionally under
+  the wall-clock watchdog.
+* `DispatchPipeline` — a bounded in-flight window: `submit` enqueues a
+  future (plus caller metadata), and once more than `depth` steps are
+  live the oldest is drained — backpressure that bounds device-side
+  queueing and host staleness alike.
+
+PIPELINE.md documents the prefetch -> dispatch -> drain stages end to
+end, including the Trainer's sentinel-lag semantics.
+"""
+
+import collections
+
+import numpy as np
+
+__all__ = ["FetchFuture", "DispatchPipeline"]
+
+_UNSET = object()
+
+
+class FetchFuture:
+    """One dispatched step's fetched values, kept as live device arrays
+    until `result()` resolves them to host.  Resolution happens at most
+    once (the value is cached); it performs ONE `jax.device_get` over
+    every fetch — the batched replacement for per-item `np.asarray`
+    device->host round-trips — and then runs the caller's `post` hook
+    (LoD reassembly, numpy conversion).
+
+    When `FLAGS.step_watchdog_secs` is set the watchdog wraps the
+    resolve: a wedged backend raises `StepWatchdogTimeout` out of the
+    drain instead of blocking the train loop forever.  `watchdog_scale`
+    lets the caller scale the budget by how many steps the drain is
+    actually waiting on (resolving the oldest of N in-flight steps may
+    legitimately take N steps of wall clock)."""
+
+    def __init__(self, fetches, post=None, return_numpy=True,
+                 what="pipeline drain"):
+        self._fetches = list(fetches)
+        self._post = post
+        self._return_numpy = return_numpy
+        self._what = what
+        self._value = _UNSET
+
+    @classmethod
+    def resolved(cls, value):
+        """A future that is already resolved (sync execution paths that
+        must still honor the `as_future=True` return contract)."""
+        fut = cls(())
+        fut._value = value
+        return fut
+
+    def done(self):
+        """True once `result()` has resolved (no device query)."""
+        return self._value is not _UNSET
+
+    def ready(self):
+        """True when every fetched device array has its value ready —
+        i.e. `result()` would not block.  Non-array fetches (eager-path
+        numpy, None LoD companions) are always ready."""
+        if self._value is not _UNSET:
+            return True
+        for f in self._fetches:
+            is_ready = getattr(f, "is_ready", None)
+            if is_ready is not None and not is_ready():
+                return False
+        return True
+
+    def _resolve(self):
+        if self._post is not None:
+            # the post hook owns the (batched) device->host transfer —
+            # Executor._post_fetches issues one jax.device_get for the
+            # whole step
+            return self._post(self._fetches, self._return_numpy)
+        if self._return_numpy:
+            import jax
+            # ONE batched transfer for every fetch of the step (None
+            # LoD companions pass through untouched)
+            vals = jax.device_get(self._fetches)
+            return [None if v is None else np.asarray(v) for v in vals]
+        return list(self._fetches)
+
+    def result(self, watchdog_scale=1):
+        """Resolve (host sync) and return the step's fetches.  This is
+        the pipeline's ONLY mandatory host<->device synchronization
+        point; the watchdog — when enabled — wraps exactly this."""
+        if self._value is not _UNSET:
+            return self._value
+        from ..flags import FLAGS
+        wd = FLAGS.step_watchdog_secs
+        if wd and wd > 0:
+            from .executor import _watchdog_call
+            self._value = _watchdog_call(
+                self._resolve, wd * max(int(watchdog_scale), 1),
+                self._what)
+        else:
+            self._value = self._resolve()
+        return self._value
+
+
+class DispatchPipeline:
+    """Bounded window of in-flight steps.  `submit(future, **meta)`
+    enqueues; once more than `depth` steps are live the OLDEST is
+    resolved and returned — backpressure, so the host never runs more
+    than `depth` steps ahead of the device and fetch buffers cannot
+    accumulate without bound.  `depth=0` degenerates to fully
+    synchronous execution (every submit drains immediately): the flag
+    default keeps today's behavior."""
+
+    def __init__(self, depth):
+        self.depth = max(int(depth), 0)
+        self._inflight = collections.deque()
+
+    def __len__(self):
+        return len(self._inflight)
+
+    def submit(self, future, **meta):
+        """Enqueue one dispatched step; returns the list of (result,
+        meta) pairs drained to honor the depth bound (empty, or one)."""
+        self._inflight.append((future, meta))
+        drained = []
+        while len(self._inflight) > self.depth:
+            drained.append(self.drain())
+        return drained
+
+    def drain(self):
+        """Resolve and return the oldest in-flight step as (result,
+        meta); None when nothing is in flight."""
+        if not self._inflight:
+            return None
+        future, meta = self._inflight.popleft()
+        # the oldest of N queued steps may need N steps of wall clock
+        return future.result(watchdog_scale=len(self._inflight) + 1), meta
+
+    def drain_all(self):
+        """Flush the window: resolve everything in flight, oldest
+        first.  The pipeline's sync boundary (epoch end, checkpoint,
+        shutdown)."""
+        out = []
+        while self._inflight:
+            out.append(self.drain())
+        return out
+
+    def discard_inflight(self):
+        """Drop every in-flight step WITHOUT resolving it and return
+        the (future, meta) pairs — the sentinel's recovery path: steps
+        dispatched downstream of a reverted step were computed from
+        poisoned state and their results must never be observed."""
+        dropped = list(self._inflight)
+        self._inflight.clear()
+        return dropped

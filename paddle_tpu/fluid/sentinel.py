@@ -25,6 +25,19 @@ The sentinel is a small state machine the Trainer drives each step:
 Because the functional executor keeps every persistable as an immutable
 jax Array, "revert the step" is literally restoring the pre-step dict of
 array references — no copies, no device traffic.
+
+Pipeline-depth awareness (PIPELINE.md): under async dispatch
+(FLAGS.async_dispatch_depth > 0) the Trainer drains fetches from the
+pipeline tail, so the sentinel observes step t while steps t+1..t+k
+(k <= depth) are already in flight — `pipeline_depth` records the
+configured lag and `observe(..., step=)` tracks which step was actually
+screened (`last_step_observed`, `max_observe_lag`).  When a bad step is
+reverted, those in-flight steps were computed FROM the poisoned state:
+the Trainer discards them un-observed and re-dispatches their batches
+from the restored state, reporting the count via
+`note_inflight_discarded` (`total_discarded`).  The consecutive-bad
+streak is unaffected by discards — a discarded step was never screened,
+so it neither extends nor resets the streak.
 """
 
 import numpy as np
@@ -57,24 +70,37 @@ def non_finite_names(named_values):
 
 
 class AnomalySentinel:
-    def __init__(self, max_bad_steps=3, policy="skip", check_params=False):
+    def __init__(self, max_bad_steps=3, policy="skip", check_params=False,
+                 pipeline_depth=0):
         if policy not in POLICIES:
             raise ValueError("sentinel policy must be one of %s, got %r"
                              % (POLICIES, policy))
         self.max_bad_steps = max(int(max_bad_steps), 1)
         self.policy = policy
         self.check_params = bool(check_params)
+        # async-pipeline lag bound: checks run at the drain, <= this
+        # many steps behind dispatch (0 = fully synchronous screening)
+        self.pipeline_depth = max(int(pipeline_depth), 0)
         self.consecutive_bad = 0
         self.total_bad = 0
         self.total_rollbacks = 0
+        self.total_discarded = 0
         self.last_bad_names = []
+        self.last_step_observed = None
+        self.steps_observed = 0
+        self.max_observe_lag = 0
 
-    def observe(self, named_values):
+    def observe(self, named_values, step=None):
         """Screen one step's fetched values; returns OK, SKIP or
         ROLLBACK.  Raises SentinelError when the bad-step budget is
         exhausted and the policy has no rollback (or rollback already
         happened for this bad streak — a checkpoint that itself diverges
-        must not loop forever)."""
+        must not loop forever).  `step` is the dispatch-order step id
+        being screened (the async Trainer drains behind dispatch, so
+        this lags the newest dispatched step by <= pipeline_depth)."""
+        self.steps_observed += 1
+        if step is not None:
+            self.last_step_observed = step
         bad = non_finite_names(named_values)
         self.last_bad_names = bad
         if not bad:
@@ -102,3 +128,15 @@ class AnomalySentinel:
     def note_rollback_done(self):
         """The caller restored the last-good checkpoint; the bad streak
         counter keeps running so a re-diverging rollback can give up."""
+
+    def note_inflight_discarded(self, count, newest_step=None):
+        """The caller reverted a bad step and dropped `count` in-flight
+        steps un-observed (they were dispatched from the poisoned
+        state).  Pure bookkeeping: discarded steps were never screened,
+        so the consecutive-bad streak is untouched; the count feeds the
+        Trainer's recovery warning and the max_observe_lag statistic."""
+        count = int(count)
+        self.total_discarded += count
+        if count > self.max_observe_lag:
+            self.max_observe_lag = count
+        return self.total_discarded

@@ -10,14 +10,14 @@ plus the multithreaded xmap_readers and the batching wrapper
 from .decorator import (
     map_readers, buffered, compose, chain, shuffle, firstn, xmap_readers,
     cache, ComposeNotAligned, multiprocess_reader, PipeReader, Fake,
-    retry_reader, ReaderWorkerFailed,
+    retry_reader, prefetch_to_device, ReaderWorkerFailed,
 )
 from . import creator
 
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
     "xmap_readers", "cache", "batch", "ComposeNotAligned", "creator",
-    "retry_reader", "ReaderWorkerFailed",
+    "retry_reader", "prefetch_to_device", "ReaderWorkerFailed",
 ]
 
 

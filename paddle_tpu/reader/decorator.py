@@ -9,7 +9,7 @@ __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
     "xmap_readers", "cache", "ComposeNotAligned",
     "multiprocess_reader", "PipeReader", "Fake", "retry_reader",
-    "ReaderWorkerFailed",
+    "prefetch_to_device", "ReaderWorkerFailed",
 ]
 
 
@@ -368,6 +368,115 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     if sys.platform == "win32":
         raise NotImplementedError("multiprocess_reader: POSIX only")
     return pipe_reader if use_pipe else queue_reader
+
+
+def _default_device_prepare(item):
+    """Stage one batch on device: feed dicts get a (async, non-blocking)
+    jax.device_put per array value; anything else passes through so the
+    prefetch thread still overlaps the host-side work of producing it."""
+    import numpy as np
+    import jax
+    if isinstance(item, dict):
+        out = {}
+        for k, v in item.items():
+            if isinstance(v, jax.Array):
+                out[k] = v          # already on device
+            elif isinstance(v, np.ndarray) or np.isscalar(v):
+                out[k] = jax.device_put(v)
+            else:
+                out[k] = v          # LoDTensor etc: caller's prepare job
+        return out
+    return item
+
+
+def prefetch_to_device(reader, depth=2, prepare=None):
+    """Device prefetch queue (the tentpole of the async training
+    pipeline, PIPELINE.md): a bounded background thread pulls batches
+    from `reader` and runs `prepare` — by default a per-array
+    jax.device_put; the Trainer passes ``prepare_feeds`` so dtype casts,
+    LoD padding and the (sharded) device_put for the NEXT batch all
+    happen while the current step computes.  jax device_put is
+    asynchronous, so the H2D copy itself overlaps device execution —
+    the reference's double_buffer / py_reader infeed overlap
+    (operators/reader/create_double_buffer_reader_op.cc,
+    buffered_reader.cc) rebuilt host-side.
+
+    Semantics the tests pin down:
+
+    * bounded backpressure — at most `depth` prepared batches wait in
+      the queue (plus one in the worker's hand), so prefetch cannot run
+      away from a slow consumer or pin unbounded device memory;
+    * clean shutdown — closing the returned generator (or just letting
+      the epoch end) stops the worker and joins it; a half-consumed
+      epoch leaks no thread;
+    * worker death — an exception in the source reader OR in `prepare`
+      surfaces to the consumer as ReaderWorkerFailed, never a hang on a
+      sentinel that will never come or a silently short epoch.
+    """
+    depth = max(int(depth), 1)
+    prep = prepare if prepare is not None else _default_device_prepare
+
+    class _End(object):
+        pass
+
+    def data_reader():
+        q = queue.Queue(maxsize=depth)
+        stop = threading.Event()
+
+        def _put(item):
+            # bounded put that still honors shutdown: a worker blocked
+            # on a full queue must notice the consumer has gone away
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for item in reader():
+                    if stop.is_set():
+                        return
+                    if not _put(prep(item)):
+                        return
+            except Exception as e:
+                _put(_WorkerError(e))
+                return
+            _put(_End)
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="paddle-tpu-prefetch")
+        t.start()
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=1.0)
+                except queue.Empty:
+                    if not t.is_alive():
+                        raise ReaderWorkerFailed(
+                            "prefetch_to_device worker died without an "
+                            "end-of-stream sentinel — epoch would have "
+                            "been silently truncated")
+                    continue
+                if item is _End:
+                    return
+                if isinstance(item, _WorkerError):
+                    raise ReaderWorkerFailed(
+                        "prefetch_to_device worker failed mid-stream: %s"
+                        % item.exc_repr, cause_repr=item.exc_repr)
+                yield item
+        finally:
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5.0)
+
+    return data_reader
 
 
 def retry_reader(reader, policy=None, retry_on=(Exception,)):

@@ -75,6 +75,18 @@ CONFIGS = [
     ("serving_resnet_b128",
      ["@serving", "--model", "resnet", "--qps", "400,1600",
       "--duration", "20"], 128, 4),
+    # async-training-pipeline A/B (PIPELINE.md): same model, same
+    # 40 ms/batch host stall (deterministic stand-in for host-side
+    # preprocessing — the host-BOUND lane), prefetch + in-flight
+    # dispatch off vs on. The sync lane pays the stall + feed transfer
+    # + fetch sync inside every step; the async lane hides the stall on
+    # the prefetch thread and lets the loss fetch lag dispatch by 4
+    # steps, so the delta between the two rows IS the pipeline win.
+    ("pipeline_sync",
+     ["--model", "mnist", "--host_stall_ms", "40"], 512, 64),
+    ("pipeline_async",
+     ["--model", "mnist", "--host_stall_ms", "40",
+      "--prefetch_depth", "4", "--async_depth", "4"], 512, 64),
     # pipelined variants: fetch (host sync) every 10 steps instead of
     # each one — shows the small-model throughput with async dispatch
     # allowed to overlap steps (bench.py's flagship methodology); the

@@ -114,6 +114,22 @@ def nan_poison_reader(reader, poison_steps, nan_value=float("nan")):
     return poisoned
 
 
+def slow_host_reader(reader, stall_ms):
+    """Slow-host injection: every batch costs `stall_ms` of host wall
+    clock before it is yielded — the training-side analogue of
+    bench_serving's --chaos_slow_ms knob, a deterministic stand-in for
+    expensive host preprocessing (decode, augment, a slow shard read).
+    Feeding a trainer through this wrapped reader WITHOUT prefetch
+    serializes the stall with every step; through
+    reader.prefetch_to_device the stall lands on the prefetch thread
+    and the pipeline hides it (tests/test_pipeline.py pins the delta)."""
+    def slowed():
+        for item in reader():
+            time.sleep(stall_ms / 1000.0)
+            yield item
+    return slowed
+
+
 # ---------------------------------------------------------------------------
 # RPC drop: a TCP proxy that kills connections on demand
 # ---------------------------------------------------------------------------

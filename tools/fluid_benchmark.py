@@ -67,6 +67,31 @@ def parse_args():
                    help="fetch loss (host sync) every N steps; 1 = the "
                         "reference's per-step methodology, >1 lets async "
                         "dispatch pipeline the steps between fetches")
+    p.add_argument("--prefetch_depth", type=int, default=0,
+                   help="feed the timed loop through "
+                        "reader.prefetch_to_device with this queue "
+                        "depth: batch synthesis + prepare_feeds + the "
+                        "device_put for the NEXT batch run on a "
+                        "background thread while the current step "
+                        "computes (PIPELINE.md). 0 = synthesize and "
+                        "transfer on the main thread each step")
+    p.add_argument("--async_depth", type=int, default=0,
+                   help="in-flight step dispatch: keep up to N steps' "
+                        "fetches live on device (run(as_future=True)) "
+                        "and resolve each at the pipeline tail — the "
+                        "host sync lags dispatch by N steps. 0 = "
+                        "resolve every step's loss before the next "
+                        "dispatch (reference methodology)")
+    p.add_argument("--host_stall_ms", type=float, default=0.0,
+                   help="sleep this long on the feed path per batch — "
+                        "a deterministic stand-in for host-side "
+                        "preprocessing cost (decode/augment; the "
+                        "chaos-harness slow-host injection). With "
+                        "--prefetch_depth the stall runs on the "
+                        "prefetch thread and is hidden by the pipeline; "
+                        "without it, it serializes with every step — "
+                        "the bench_zoo pipeline_sync/pipeline_async "
+                        "lane pair measures exactly this delta")
     p.add_argument("--staged_feed", type=int, default=0,
                    help="pre-stage K synthetic batches on device before "
                         "the timed loop and cycle through them (bench.py "
@@ -167,6 +192,16 @@ def main():
         # under a device_loop label (same contract as the remat guard)
         raise SystemExit(
             "--device_loop not supported with --update_method pserver")
+    if args.async_depth > 0 and args.device_loop > 0:
+        raise SystemExit(
+            "--async_depth not supported with --device_loop (the device "
+            "loop is already one dispatch per N steps; there is no "
+            "per-step fetch to defer)")
+    if args.async_depth > 0 and args.update_method == "pserver":
+        raise SystemExit(
+            "--async_depth not supported with --update_method pserver "
+            "(RPC host ops force per-step sync; the record would carry "
+            "an async label over a sync run)")
     main_prog, startup, feeds, loss, acc, _ = build_model(args)
     feeds = [main_prog.global_block().var(f) if isinstance(f, str) else f
              for f in feeds]
@@ -249,16 +284,58 @@ def main():
         prof.start_profiler("All")
 
     n_warm, n_timed = args.skip_batch_num, args.iterations
+
+    def make_batch():
+        # --host_stall_ms: deterministic host-side preprocessing cost;
+        # on the prefetch thread it overlaps the step, on the main
+        # thread it serializes with it
+        if args.host_stall_ms > 0:
+            time.sleep(args.host_stall_ms / 1000.0)
+        return synth_feed(feeds, batch, rng, program=main_prog)
+
+    feeds_it = None
+    if args.prefetch_depth > 0:
+        if staged:
+            raise SystemExit(
+                "--prefetch_depth and --staged_feed are mutually "
+                "exclusive feed paths (staging already amortizes the "
+                "transfer the prefetch queue overlaps)")
+        from paddle_tpu import reader as reader_mod
+        from paddle_tpu.fluid.executor import prepare_feeds as _prep
+        total_batches = n_warm + n_timed
+
+        def batch_source():
+            for _ in range(total_batches):
+                yield make_batch()
+
+        # same device_put discipline as --staged_feed: the PE commits
+        # its own sharded transfer, so prefetch only stages host-side
+        feeds_it = reader_mod.prefetch_to_device(
+            batch_source, args.prefetch_depth,
+            prepare=lambda d: _prep(main_prog, d,
+                                    device_put=(pe is None)))()
+
+    pending = []
     examples = 0
     t0 = time.perf_counter()
     last = None
+
+    def drain_oldest():
+        vals = pending.pop(0).result(watchdog_scale=len(pending) + 2)
+        return float(np.asarray(vals[0]).ravel()[0])
+
     for i in range(n_warm + n_timed):
         # start timing BEFORE the first timed batch so its runtime
         # (including jit compile when n_warm == 0) is in the denominator
         if i == n_warm:
+            # async mode: warmup dispatches must fully resolve before
+            # the clock starts or their compute leaks into the window
+            while pending:
+                last = drain_oldest()
             t0 = time.perf_counter()
         feed = (staged[i % len(staged)] if staged
-                else synth_feed(feeds, batch, rng, program=main_prog))
+                else next(feeds_it) if feeds_it is not None
+                else make_batch())
         # --fetch_every N: fetch (= host sync) only every Nth step and on
         # the last, letting XLA's async dispatch pipeline the steps in
         # between. Default 1 keeps the reference methodology (the
@@ -289,6 +366,20 @@ def main():
             if i >= n_warm:
                 examples += batch * args.device_loop
             continue
+        if args.async_depth > 0:
+            # in-flight dispatch: fetch EVERY step, resolve each at the
+            # pipeline tail — the host sync lags dispatch by N steps
+            # instead of fencing every one (PIPELINE.md)
+            fut = (pe.run(fetch_list=fetch, feed=feed, as_future=True)
+                   if pe is not None else
+                   exe.run(main_prog, feed=feed, fetch_list=fetch,
+                           as_future=True))
+            pending.append(fut)
+            while len(pending) > args.async_depth:
+                last = drain_oldest()
+            if i >= n_warm:
+                examples += batch
+            continue
         if pe is not None:
             outs = pe.run(fetch_list=fetch if do_fetch else [], feed=feed)
         else:
@@ -298,6 +389,10 @@ def main():
             last = float(np.asarray(outs[0]).ravel()[0])  # host sync fence
         if i >= n_warm:
             examples += batch
+    while pending:
+        # drain the pipeline tail: the timed window must include every
+        # timed step's compute, not leave the last N steps in flight
+        last = drain_oldest()
     dt = time.perf_counter() - t0
 
     if args.profile:
@@ -332,6 +427,15 @@ def main():
         **({"staged_feed": args.staged_feed,
             "staged_transfer": pe is None}
            if args.staged_feed > 0 else {}),
+        # pipeline lanes: the record self-describes its feed/dispatch
+        # path so pipeline_sync vs pipeline_async deltas are readable
+        # from BENCH_zoo json alone
+        **({"prefetch_depth": args.prefetch_depth}
+           if args.prefetch_depth > 0 else {}),
+        **({"async_depth": args.async_depth}
+           if args.async_depth > 0 else {}),
+        **({"host_stall_ms": args.host_stall_ms}
+           if args.host_stall_ms > 0 else {}),
         "whole_graph_ad": bool(args.whole_graph_ad or args.remat_policy),
         "remat_policy": args.remat_policy,
         # only models that honor --layout get the field; recording it

@@ -130,6 +130,14 @@ def main():
         ("zoo_staged", ["tools/bench_zoo.py", "--out",
                         "BENCH_zoo_r05.json", "--require_tpu",
                         "--resume", "--staged", "4"], {}, 14400),
+        # async-pipeline A/B (PIPELINE.md): the pipeline_sync /
+        # pipeline_async lane pair under a deterministic host stall —
+        # cheap, and the steps/sec delta is the one number that says
+        # whether prefetch + in-flight dispatch survive the relay's
+        # latency profile on real silicon
+        ("pipeline", ["tools/bench_zoo.py", "--out", "BENCH_r06.json",
+                      "--require_tpu", "--resume", "--only",
+                      "pipeline_sync,pipeline_async"], {}, 3600),
         ("infer", ["tools/bench_infer.py", "--require_tpu"], {}, 1800),
         # serving front throughput/latency (SERVING.md): dynamic
         # micro-batching over the AOT buckets under open-loop load;

@@ -315,10 +315,30 @@ DEFINE_int(
     "(shed-not-hang; see SERVING.md overload semantics).")
 DEFINE_int(
     "serving_workers", 1,
-    "Dispatch worker threads per served model: each worker coalesces one "
-    "micro-batch and runs it; >1 allows overlapping micro-batches of the "
-    "same model (useful when the runner releases the GIL during XLA "
+    "Dispatch worker threads per replica execution lane: each worker "
+    "takes one coalesced micro-batch group off its lane and runs it on "
+    "that lane's replica; >1 allows overlapping micro-batches of the "
+    "same replica (useful when the runner releases the GIL during XLA "
     "execution).")
+DEFINE_string(
+    "serving_replicas", "1",
+    "Default replica placement spec for served models (SERVING.md "
+    "multi-chip serving): an integer N places N device-resident replicas "
+    "round-robin over the local devices (1 keeps the single default-"
+    "device replica — the pre-multichip behavior); 'auto' places one "
+    "replica per local device; an explicit comma list names devices "
+    "('0,2' = local device indices, 'cpu:0,tpu:3' = platform:index). "
+    "Each replica's params live on its device and its batch buckets "
+    "compile and warm there; a router assigns each coalesced micro-"
+    "batch group to the least-loaded replica.")
+DEFINE_int(
+    "serving_lane_depth", 1,
+    "Per-replica dispatch lane bound: at most this many coalesced "
+    "groups wait behind each replica's in-flight dispatches. When every "
+    "lane is full the router holds the next group (sticky back-"
+    "pressure), the admission queue fills, and submits shed with "
+    "ServerOverloaded — overload still sheds at the front instead of "
+    "queueing unboundedly behind slow replicas.")
 DEFINE_int(
     "dist_threadpool_size", 0,
     "Reference distributed thread pool size. Advisory.")

@@ -167,6 +167,14 @@ class ParallelExecutor:
     def device_count(self):
         return self._num_devices
 
+    @property
+    def mesh(self):
+        """The jax.sharding.Mesh this executor shards over — handed to
+        reader.prefetch_to_device(mesh=...) so the prefetch thread
+        commits pre-sharded feeds (the sharded-prefetch pipeline mode,
+        PIPELINE.md)."""
+        return self._mesh
+
     def _replicated_sharding(self):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -273,9 +281,17 @@ class ParallelExecutor:
             # double-buffer) go straight to the sharded device_put —
             # no host round-trip — except in multi-trainer mode, where
             # make_array_from_process_local_data wants host data.
-            if isinstance(arr, jax.Array) and self._num_trainers > 1:
-                arr = np.asarray(arr)
-            feeds[name] = self._put(arr, self._batch_sharding(arr.ndim))
+            target = self._batch_sharding(arr.ndim)
+            if isinstance(arr, jax.Array):
+                if arr.sharding == target:
+                    # sharded prefetch (prefetch_to_device mesh mode)
+                    # already committed this array on the mesh — the
+                    # whole point is skipping the per-dispatch commit
+                    feeds[name] = arr
+                    continue
+                if self._num_trainers > 1:
+                    arr = np.asarray(arr)
+            feeds[name] = self._put(arr, target)
         return feeds
 
     def run_loop(self, fetch_list, feed=None, steps=1, return_numpy=True):

@@ -15,6 +15,7 @@ engine analogue. Batch-size bucketing bounds recompiles the way TRT
 profiles bounded engine shapes.
 """
 
+import threading
 import warnings
 
 import numpy as np
@@ -69,8 +70,24 @@ class AnalysisConfig(NativeConfig):
         self.batch_size_buckets = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
+def _device_label(device):
+    """Stable wire-encodable device id ('cpu:0', 'tpu:3') for metrics
+    and the per-replica stats the serving layer surfaces; 'default' when
+    the predictor floats on jax's default device."""
+    if device is None:
+        return "default"
+    return "%s:%d" % (getattr(device, "platform", "dev"),
+                      getattr(device, "id", 0))
+
+
 class Predictor:
-    def __init__(self, config):
+    """`device`: optional jax.Device this predictor is pinned to — its
+    params are `jax.device_put` there, feeds are committed there per
+    run, and every bucket executable AOT-compiles for it.  The serving
+    registry places one replica Predictor per device this way (SERVING.md
+    multi-chip serving); None keeps jax's default-device behavior."""
+
+    def __init__(self, config, device=None):
         import paddle_tpu.fluid as fluid
         from paddle_tpu.fluid import functionalizer
 
@@ -95,7 +112,16 @@ class Predictor:
             functionalizer.persistable_names(program))
         self._state = {n: self._scope.get(n) for n in self._state_names
                        if self._scope.get(n) is not None}
+        self._device = device
+        if device is not None:
+            import jax
+            self._state = {n: jax.device_put(np.asarray(v), device)
+                           for n, v in self._state.items()}
         self._compiled = {}  # feed shape signature -> compiled fn
+        # serializes compile-and-cache and the overflow warn-once set:
+        # concurrent dispatch lanes must neither double-compile one
+        # bucket signature nor double-warn one overflow size
+        self._lock = threading.Lock()
         # batch-major markers from the program vars (-1 leading dim),
         # the same ground truth save_aot records in aot_meta.bin: only
         # these feeds get bucket-padded and only these fetches un-padded
@@ -115,22 +141,32 @@ class Predictor:
         fn = self._compiled.get(sig)
         if fn is not None:
             return fn
-        step_fn = functionalizer.build_step_fn(
-            self._program, tuple(sorted(feeds)), tuple(self._fetch_names),
-            ())
+        with self._lock:
+            # re-check under the lock: another dispatch lane may have
+            # compiled this signature while we waited — without the
+            # recheck both lanes would pay the compile and the loser's
+            # executable would be silently thrown away
+            fn = self._compiled.get(sig)
+            if fn is not None:
+                return fn
+            step_fn = functionalizer.build_step_fn(
+                self._program, tuple(sorted(feeds)),
+                tuple(self._fetch_names), ())
 
-        def fwd(state, feed_dict):
-            fetches, _ = step_fn(state, feed_dict, np.uint32(0))
-            return fetches
+            def fwd(state, feed_dict):
+                fetches, _ = step_fn(state, feed_dict, np.uint32(0))
+                return fetches
 
-        jitted = jax.jit(fwd)
-        if isinstance(self._config, AnalysisConfig) and \
-                self._config.aot_compile:
-            # AOT: lower+compile now so first Run has no compile stall
-            # (the TRT build-engine-at-init analogue)
-            jitted = jitted.lower(self._state, feeds).compile()
-        self._compiled[sig] = jitted
-        return jitted
+            jitted = jax.jit(fwd)
+            if isinstance(self._config, AnalysisConfig) and \
+                    self._config.aot_compile:
+                # AOT: lower+compile now so first Run has no compile
+                # stall (the TRT build-engine-at-init analogue); with
+                # `self._state` committed to this replica's device, the
+                # executable compiles for that device
+                jitted = jitted.lower(self._state, feeds).compile()
+            self._compiled[sig] = jitted
+            return jitted
 
     def _bucket_cap(self, b):
         """Smallest configured batch bucket >= b, or None when bucketing
@@ -145,7 +181,13 @@ class Predictor:
             if b <= cap:
                 return cap
         if b not in self._overflow_warned:
-            self._overflow_warned.add(b)
+            with self._lock:
+                # re-check under the lock: concurrent dispatch lanes
+                # racing the same overflow size must produce exactly one
+                # warning, not one per lane
+                if b in self._overflow_warned:
+                    return None
+                self._overflow_warned.add(b)
             warnings.warn(
                 "batch %d exceeds every configured bucket %s — falling "
                 "through to an unbucketed per-size compile; raise "
@@ -197,7 +239,14 @@ class Predictor:
                 pad = np.zeros((cap - real_batch,) + arr.shape[1:],
                                arr.dtype)
                 arr = np.concatenate([arr, pad], axis=0)
-            feeds[name] = jnp.asarray(arr)
+            if self._device is not None:
+                # commit the feed to this replica's device so the
+                # computation (and the AOT executable) run there, not on
+                # jax's default device
+                import jax
+                feeds[name] = jax.device_put(arr, self._device)
+            else:
+                feeds[name] = jnp.asarray(arr)
 
         fn = self._get_compiled(feeds)
         fetches = fn(self._state, feeds)
@@ -230,11 +279,33 @@ class Predictor:
         p._fetch_vars = self._fetch_vars
         p._state_names = self._state_names
         p._state = self._state
+        p._device = self._device
         p._compiled = {}
+        p._lock = threading.Lock()
         p._batched_feed = dict(self._batched_feed)
         p._fetch_batched = list(self._fetch_batched)
         p._overflow_warned = set()
         return p
+
+    def clone_to(self, device):
+        """Replica placement: a clone whose param copy lives on `device`
+        and whose bucket executables compile for it.  The Program parse
+        + InferenceTranspiler work is shared (done once at load); only
+        the device commit and the per-device compile cache are new —
+        this is how the serving registry builds N device-resident
+        replicas from one artifact load."""
+        import jax
+        p = self.clone()
+        p._device = device
+        if device is not None:
+            p._state = {n: jax.device_put(np.asarray(v), device)
+                        for n, v in self._state.items()}
+        return p
+
+    @property
+    def device(self):
+        """The jax.Device this predictor is pinned to, or None."""
+        return self._device
 
     # ------------------------------------------------------------------
     # serving introspection (paddle_tpu/serving): the batcher needs the
@@ -384,9 +455,14 @@ class Predictor:
 
 class AotPredictor:
     """Serve a `save_aot` artifact: no Program, no trace — the stored
-    StableHLO modules are deserialized and compiled directly by XLA."""
+    StableHLO modules are deserialized and compiled directly by XLA.
 
-    def __init__(self, dirname):
+    `device`: optional jax.Device to pin this instance to (state +
+    per-run feeds committed there) — the replica-per-device serving
+    placement; `clone_to` shares the deserialized modules across
+    replicas so only the first replica pays the artifact read."""
+
+    def __init__(self, dirname, device=None):
         import os
         from jax import export as jax_export
         from paddle_tpu.native import wire
@@ -405,6 +481,11 @@ class AotPredictor:
             with open(os.path.join(dirname, fname), "rb") as f:
                 self._fns[int(bs)] = jax_export.deserialize(
                     f.read()).call
+        self._device = device
+        if device is not None:
+            import jax
+            self._state = {n: jax.device_put(np.asarray(v), device)
+                           for n, v in self._state.items()}
 
     def run(self, inputs):
         import jax.numpy as jnp
@@ -441,7 +522,11 @@ class AotPredictor:
                 arr = np.concatenate(
                     [arr, np.zeros((cap - b,) + arr.shape[1:],
                                    arr.dtype)], axis=0)
-            feeds[name] = jnp.asarray(arr)
+            if self._device is not None:
+                import jax
+                feeds[name] = jax.device_put(arr, self._device)
+            else:
+                feeds[name] = jnp.asarray(arr)
         fetches = self._run_export(cap, feeds)
         out = []
         for i, f in enumerate(fetches):
@@ -466,6 +551,28 @@ class AotPredictor:
         """One seam around the stored executable call (tests inject
         slow/faulty models here without touching the jax.export path)."""
         return self._fns[cap](self._state, feeds)
+
+    def clone_to(self, device):
+        """Replica placement: share the deserialized StableHLO modules,
+        re-commit the state copy to `device`."""
+        import jax
+        p = object.__new__(AotPredictor)
+        p._feed_names = list(self._feed_names)
+        p._fetch_names = list(self._fetch_names)
+        p._feed_specs = self._feed_specs
+        p._fetch_batched = self._fetch_batched
+        p._fns = self._fns
+        p._device = device
+        if device is not None:
+            p._state = {n: jax.device_put(np.asarray(v), device)
+                        for n, v in self._state.items()}
+        else:
+            p._state = self._state
+        return p
+
+    @property
+    def device(self):
+        return self._device
 
     # ---- serving introspection (mirrors Predictor's) ----
 

@@ -2,10 +2,13 @@
 
 Turns a directory of `save_inference_model` / `save_aot` artifacts into
 a trafficable service (SERVING.md): cross-request dynamic micro-batching
-onto the compiled batch buckets (batcher.py), named/versioned models
-with warm atomic hot swap (model_registry.py), a threaded wire-protocol
-front with admission control and graceful drain (server.py), and
-per-model serving metrics (metrics.py).
+onto the compiled batch buckets with N device-placed replicas per model
+fronted by per-replica execution lanes and a least-loaded router
+(batcher.py), named/versioned models with placement specs and warm
+atomic hot swap of whole replica sets (model_registry.py), a threaded
+wire-protocol front with priority-class admission control and graceful
+drain (server.py), and per-model + per-replica serving metrics
+(metrics.py).
 
 Reference analogue: paddle/fluid/inference/api/ stops at a synchronous
 per-caller predictor; the serving layer the TensorFlow system paper
@@ -18,7 +21,8 @@ from .batcher import (BatcherClosed, DeadlineExceeded, DynamicBatcher,
                       ServerOverloaded, set_dispatch_delay)
 from .metrics import (Counter, ModelMetrics, ReservoirHistogram,
                       ServingMetrics)
-from .model_registry import ModelEntry, ModelRegistry, open_predictor
+from .model_registry import (ModelEntry, ModelRegistry, open_predictor,
+                             resolve_placement)
 from .server import InferenceServer, ServingClient, ServingError
 
 __all__ = [
@@ -26,5 +30,6 @@ __all__ = [
     "BatcherClosed", "set_dispatch_delay",
     "Counter", "ReservoirHistogram", "ModelMetrics", "ServingMetrics",
     "ModelRegistry", "ModelEntry", "open_predictor",
+    "resolve_placement",
     "InferenceServer", "ServingClient", "ServingError",
 ]

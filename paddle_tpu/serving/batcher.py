@@ -1,24 +1,45 @@
-"""Cross-request dynamic micro-batcher.
+"""Cross-request dynamic micro-batcher with per-replica dispatch lanes.
 
 The serving front's core mechanism: many callers each submit a small
 (often batch-1) request; TPU executables want the biggest batch bucket
 they were compiled for (the MLPerf TPU-pod study's lesson — batch
 geometry IS the utilization lever).  The batcher closes that gap by
-coalescing waiting requests into one padded bucket dispatch:
+coalescing waiting requests into one padded bucket dispatch, and — the
+multi-chip half — fans the coalesced groups out across N device-placed
+model replicas so all chips on the host serve one model name:
 
   * bounded queue per model (admission control: a submit past
     `max_queue` is shed with `ServerOverloaded`, never parked on an
-    unbounded backlog — shed-not-hang);
-  * a dispatch worker takes the head request, then greedily pulls
-    compatible queued requests until the largest bucket is full or a
-    `FLAGS.serving_batch_deadline_ms` window expires;
+    unbounded backlog — shed-not-hang). Requests carry a `priority`
+    class: under overload the queue sheds lowest-priority-first (an
+    arriving request evicts the lowest strictly-lower-priority queued
+    request rather than being refused), and the ServerOverloaded a shed
+    request receives names the priority class that was dropped;
+  * a router thread takes the head request, greedily pulls compatible
+    queued requests until the largest bucket is full or a
+    `FLAGS.serving_batch_deadline_ms` window expires, then hands the
+    group to the LEAST-LOADED replica lane (fewest in-flight batches,
+    then shortest lane queue) — the replica-per-accelerator pattern of
+    the Clipper/TF-Serving lineage, with the reference
+    ParallelExecutor's shape (one program, N device-resident copies,
+    work fanned out by the runtime) applied to serving;
+  * each lane is a bounded deque in front of one replica predictor plus
+    its own dispatch worker(s), so two replicas can be mid-`dispatch`
+    concurrently — the PR 4 pipeline lesson (keep the device busy,
+    drain asynchronously) turned into cross-chip parallelism. When
+    every lane is full the router holds the group (sticky back-
+    pressure): the admission queue fills and new submits shed, so
+    overload still sheds at the front instead of hiding in per-lane
+    backlogs;
   * batch-major feeds (the program-var -1 leading-dim markers the AOT
-    meta records and the live Predictor now exposes the same way) are
+    meta records and the live Predictor exposes the same way) are
     concatenated; fixed-shape side feeds must be byte-identical to
     coalesce and ride through whole;
   * the underlying predictor pads the merged batch up to its bucket and
     un-pads batch-major fetches (that parity is the predictor's existing
-    contract); the batcher scatters per-request row slices back to each
+    contract and holds identically on every replica — replies are
+    bit-exact vs a direct Predictor.run regardless of which lane served
+    them); the lane worker scatters per-request row slices back to each
     caller's Future.
 
 Compatibility grouping: requests only coalesce when their feed names,
@@ -27,8 +48,10 @@ dispatches as its own group, correct but uncoalesced.
 
 Chaos: `set_dispatch_delay(secs)` (or env
 `PADDLE_TPU_SERVING_CHAOS="dispatch_delay=<secs>"`) injects a slow-worker
-stall inside dispatch — the overload scenarios in tools/chaos.py and
-tests/test_serving.py drive admission control with it.
+stall inside every lane's dispatch — the overload scenarios in
+tools/chaos.py and tests/test_serving.py drive admission control with
+it, and tools/bench_serving.py reuses it as the deterministic per-
+dispatch device-cost stand-in for the replica-scaling lanes.
 """
 
 import binascii
@@ -51,7 +74,13 @@ _CHAOS_ENV = "PADDLE_TPU_SERVING_CHAOS"
 class ServerOverloaded(RuntimeError):
     """Admission control shed: the model's request queue is full.
     Explicit and immediate — the client can back off and retry
-    (utils/retry.py jitter) instead of waiting on a hidden backlog."""
+    (utils/retry.py jitter) instead of waiting on a hidden backlog.
+    `priority` names the class of the request that was shed (the
+    arriving one, or a lower-priority queued request it evicted)."""
+
+    def __init__(self, message, priority=None):
+        super().__init__(message)
+        self.priority = priority
 
 
 class DeadlineExceeded(TimeoutError):
@@ -67,7 +96,11 @@ _dispatch_delay = 0.0
 
 def set_dispatch_delay(secs):
     """Chaos hook: every subsequent dispatch sleeps `secs` first —
-    the in-process slow-worker fault (0 clears)."""
+    the in-process slow-worker fault (0 clears).  The sleep happens in
+    the lane worker thread with the GIL released, so concurrent
+    replica lanes overlap their stalls — which is also what makes it
+    the deterministic stand-in for per-dispatch device time in
+    bench_serving's replica-scaling lanes."""
     global _dispatch_delay
     _dispatch_delay = float(secs)
 
@@ -87,64 +120,116 @@ def _chaos_delay():
     return 0.0
 
 
+def _predictor_device_label(predictor):
+    from ..inference.predictor import _device_label
+    return _device_label(getattr(predictor, "device", None))
+
+
 class _Request:
     __slots__ = ("feeds", "batch", "future", "group_key", "enqueued",
-                 "deadline")
+                 "deadline", "priority")
 
-    def __init__(self, feeds, batch, group_key, deadline):
+    def __init__(self, feeds, batch, group_key, deadline, priority):
         self.feeds = feeds
         self.batch = batch
         self.group_key = group_key
         self.deadline = deadline
+        self.priority = priority
         self.future = Future()
         self.enqueued = time.monotonic()
 
 
+class _Lane:
+    """One replica's execution lane: a bounded ready deque feeding this
+    replica's dispatch worker(s), plus the load counters the router's
+    least-loaded choice reads (in-flight batches first, then queue
+    length)."""
+
+    __slots__ = ("index", "predictor", "device", "ready", "inflight",
+                 "batches", "rows")
+
+    def __init__(self, index, predictor):
+        self.index = index
+        self.predictor = predictor
+        self.device = _predictor_device_label(predictor)
+        self.ready = collections.deque()
+        self.inflight = 0   # groups a worker is currently dispatching
+        self.batches = 0    # micro-batches this replica executed
+        self.rows = 0       # real rows it served
+
+    def load(self):
+        return (self.inflight, len(self.ready), self.index)
+
+
 class DynamicBatcher:
-    """Micro-batcher over one predictor (a `Predictor` or
-    `AotPredictor` — anything with `.run(dict)->list` plus the serving
-    introspection quartet: `batch_buckets`, `feed_specs`,
-    `batched_feed_names`, `fetch_batched_flags`)."""
+    """Micro-batcher over one or more replica predictors (each a
+    `Predictor` or `AotPredictor` — anything with `.run(dict)->list`
+    plus the serving introspection quartet: `batch_buckets`,
+    `feed_specs`, `batched_feed_names`, `fetch_batched_flags`).
+
+    `replicas`: optional list of device-placed predictors sharing one
+    model's weights (the registry builds them via `clone_to`); the
+    batcher runs one execution lane per replica and routes each
+    coalesced group to the least-loaded lane.  Without it, the single
+    `predictor` forms the only lane — the pre-multichip behavior."""
 
     def __init__(self, predictor, max_queue=None, deadline_ms=None,
-                 workers=None, metrics=None, max_batch=None):
-        self.predictor = predictor
+                 workers=None, metrics=None, max_batch=None,
+                 replicas=None, lane_depth=None):
+        preds = list(replicas) if replicas else [predictor]
+        self.predictor = predictor if predictor is not None else preds[0]
         self.max_queue = int(FLAGS.serving_max_queue
                              if max_queue is None else max_queue)
         self.deadline_s = (FLAGS.serving_batch_deadline_ms
                            if deadline_ms is None else
                            float(deadline_ms)) / 1000.0
+        self.lane_depth = max(int(FLAGS.serving_lane_depth
+                                  if lane_depth is None else lane_depth),
+                              1)
         self.metrics = metrics
-        self.buckets = tuple(predictor.batch_buckets())
+        self.buckets = tuple(self.predictor.batch_buckets())
         if max_batch is not None:
             self.max_batch = int(max_batch)
         elif self.buckets:
             self.max_batch = self.buckets[-1]
         else:
             self.max_batch = 64  # unbucketed predictor: a sane coalesce cap
-        self._batched_feeds = frozenset(predictor.batched_feed_names())
-        self._fetch_flags = predictor.fetch_batched_flags()
+        self._batched_feeds = frozenset(
+            self.predictor.batched_feed_names())
+        self._fetch_flags = self.predictor.fetch_batched_flags()
         self._cv = threading.Condition()
         self._pending = collections.deque()
-        self._inflight = 0
+        self._lanes = [_Lane(i, p) for i, p in enumerate(preds)]
+        self._carrying = False  # router holds a taken-but-unrouted group
         self._closing = False
         self._stopped = False
         if metrics is not None:
             metrics.queue_depth_fn = lambda: len(self._pending)
-        n_workers = int(FLAGS.serving_workers if workers is None
-                        else workers)
+            metrics.replica_stats_fn = self.replica_stats
+        n_workers = max(int(FLAGS.serving_workers if workers is None
+                            else workers), 1)
+        self._router = threading.Thread(
+            target=self._route, daemon=True,
+            name="paddle-tpu-serving-router")
         self._threads = [
-            threading.Thread(target=self._worker, daemon=True,
-                             name="paddle-tpu-serving-batcher-%d" % i)
-            for i in range(max(n_workers, 1))]
+            threading.Thread(target=self._worker, args=(lane,),
+                             daemon=True,
+                             name="paddle-tpu-serving-lane%d-%d"
+                                  % (lane.index, i))
+            for lane in self._lanes for i in range(n_workers)]
+        self._router.start()
         for t in self._threads:
             t.start()
 
+    @property
+    def num_replicas(self):
+        return len(self._lanes)
+
     # ------------------------------------------------------------------
-    # submit side
+    # submit side (admission control)
     # ------------------------------------------------------------------
 
-    def _build_request(self, feeds, deadline):
+    def _build_request(self, feeds, deadline, priority):
         named = {k: np.asarray(v) for k, v in feeds.items()}
         batch = None
         key_parts = []
@@ -170,36 +255,74 @@ class DynamicBatcher:
                 "request batch %d exceeds the largest servable bucket %d "
                 "(buckets %s) — split the request"
                 % (batch, self.max_batch, self.buckets or "(none)"))
-        return _Request(named, batch, tuple(key_parts), deadline)
+        return _Request(named, batch, tuple(key_parts), deadline,
+                        int(priority))
 
-    def submit(self, feeds, deadline=None):
+    def submit(self, feeds, deadline=None, priority=0):
         """Enqueue one request (dict name->array).  Returns a Future
         resolving to the fetch list (this request's rows only).
         `deadline` is an absolute time.monotonic() instant or None.
-        Raises ServerOverloaded / BatcherClosed / ValueError
-        synchronously — admission decisions are immediate."""
-        req = self._build_request(feeds, deadline)
+        `priority`: larger = more important; under overload the queue
+        sheds lowest-priority-first.  Raises ServerOverloaded /
+        BatcherClosed / ValueError synchronously — admission decisions
+        are immediate."""
+        req = self._build_request(feeds, deadline, priority)
+        evicted = None
         with self._cv:
             if self._closing:
                 raise BatcherClosed("model batcher is draining/retired")
             if len(self._pending) >= self.max_queue:
-                if self.metrics is not None:
-                    self.metrics.shed.add()
-                raise ServerOverloaded(
-                    "request queue full (%d waiting, max_queue=%d) — "
-                    "request shed; back off and retry"
-                    % (len(self._pending), self.max_queue))
+                # priority shed: evict the lowest strictly-lower-priority
+                # queued request (earliest such) in favor of this one;
+                # with no lower class queued, the arrival itself sheds
+                victim = None
+                for r in self._pending:
+                    if r.priority < req.priority and \
+                            (victim is None
+                             or r.priority < victim.priority):
+                        victim = r
+                if victim is None:
+                    if self.metrics is not None:
+                        self.metrics.note_shed(priority=req.priority)
+                    raise ServerOverloaded(
+                        "request queue full (%d waiting, max_queue=%d) — "
+                        "priority-%d request shed; back off and retry"
+                        % (len(self._pending), self.max_queue,
+                           req.priority),
+                        priority=req.priority)
+                self._pending.remove(victim)
+                evicted = victim
             self._pending.append(req)
             if self.metrics is not None:
                 self.metrics.requests.add()
             self._cv.notify()
+        if evicted is not None:
+            if self.metrics is not None:
+                self.metrics.note_shed(priority=evicted.priority)
+            if evicted.future.set_running_or_notify_cancel():
+                evicted.future.set_exception(ServerOverloaded(
+                    "priority-%d request shed from a full queue by a "
+                    "priority-%d arrival (lowest-priority-first "
+                    "overload policy)"
+                    % (evicted.priority, req.priority),
+                    priority=evicted.priority))
         return req.future
 
     def queue_depth(self):
         return len(self._pending)
 
+    def replica_stats(self):
+        """Per-replica lane snapshot (device id, in-flight batches,
+        lane queue depth, batches/rows executed) — the skew-visibility
+        numbers `stats` and serving_top surface."""
+        with self._cv:
+            return [{"replica": l.index, "device": l.device,
+                     "inflight": l.inflight, "queue": len(l.ready),
+                     "batches": l.batches, "rows": l.rows}
+                    for l in self._lanes]
+
     # ------------------------------------------------------------------
-    # dispatch side
+    # coalescing front-end + least-loaded router
     # ------------------------------------------------------------------
 
     def _bucket_cap(self, total):
@@ -211,7 +334,8 @@ class DynamicBatcher:
     def _take_group(self):
         """Pop the head request plus every compatible queued request up
         to the largest bucket, waiting up to the coalescing deadline for
-        stragglers.  Returns None only at shutdown."""
+        stragglers.  Returns None only at shutdown.  Marks the router as
+        carrying the group so drain() sees it between queue and lane."""
         with self._cv:
             while not self._pending:
                 if self._stopped or self._closing:
@@ -221,7 +345,7 @@ class DynamicBatcher:
             group = [head]
             if head.batch is None:
                 # no batch-major feed: nothing to coalesce on
-                self._inflight += 1
+                self._carrying = True
                 return group
             total = head.batch
             window = time.monotonic() + self.deadline_s
@@ -245,8 +369,47 @@ class DynamicBatcher:
                 if remaining <= 0 or self._stopped or self._closing:
                     break
                 self._cv.wait(min(remaining, 0.05))
-            self._inflight += 1
+            self._carrying = True
             return group
+
+    def _assign(self, group):
+        """Hand `group` to the least-loaded lane: fewest in-flight
+        batches, then shortest lane queue, then lowest index.  When
+        every lane's queue is at `lane_depth` the router WAITS here
+        (sticky back-pressure) — the admission queue upstream fills and
+        sheds, rather than any lane queue growing unboundedly.  Returns
+        False only on hard stop (group unrouted)."""
+        with self._cv:
+            while True:
+                if self._stopped:
+                    self._carrying = False
+                    return False
+                lane = min(self._lanes, key=_Lane.load)
+                if len(lane.ready) < self.lane_depth:
+                    lane.ready.append(group)
+                    self._carrying = False
+                    self._cv.notify_all()
+                    return True
+                self._cv.wait(0.05)
+
+    def _route(self):
+        while True:
+            group = self._take_group()
+            if group is None:
+                return
+            if not self._assign(group):
+                # hard stop with a group in hand: fail it explicitly
+                for r in group:
+                    if r.future.set_running_or_notify_cancel():
+                        r.future.set_exception(BatcherClosed(
+                            "server shut down before dispatch"))
+                    if self.metrics is not None:
+                        self.metrics.errors.add()
+                return
+
+    # ------------------------------------------------------------------
+    # lane dispatch side
+    # ------------------------------------------------------------------
 
     def _merge_feeds(self, group):
         first = group[0]
@@ -284,7 +447,7 @@ class DynamicBatcher:
                 self.metrics.note_completion(
                     latency_ms=(now - r.enqueued) * 1000.0)
 
-    def _dispatch(self, group):
+    def _dispatch(self, group, lane):
         delay = _chaos_delay()
         if delay:
             time.sleep(delay)
@@ -305,7 +468,10 @@ class DynamicBatcher:
             return
         feeds = self._merge_feeds(live)
         total = sum(r.batch or 0 for r in live)
-        fetches = self.predictor.run(feeds)
+        fetches = lane.predictor.run(feeds)
+        with self._cv:
+            lane.batches += 1
+            lane.rows += total
         if self.metrics is not None:
             cap = self._bucket_cap(total) if total else 0
             self.metrics.note_dispatch(
@@ -313,13 +479,18 @@ class DynamicBatcher:
                 padded_rows=max(cap - total, 0))
         self._scatter(live, fetches, total)
 
-    def _worker(self):
+    def _worker(self, lane):
         while True:
-            group = self._take_group()
-            if group is None:
-                return
+            with self._cv:
+                while not lane.ready:
+                    if self._stopped:
+                        return
+                    self._cv.wait(0.1)
+                group = lane.ready.popleft()
+                lane.inflight += 1
+                self._cv.notify_all()
             try:
-                self._dispatch(group)
+                self._dispatch(group, lane)
             except BaseException as e:
                 for r in group:
                     if not r.future.done() and \
@@ -329,33 +500,41 @@ class DynamicBatcher:
                     self.metrics.errors.add(len(group))
             finally:
                 with self._cv:
-                    self._inflight -= 1
+                    lane.inflight -= 1
                     self._cv.notify_all()
 
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
 
+    def _busy(self):
+        return (self._pending or self._carrying
+                or any(l.ready or l.inflight for l in self._lanes))
+
     def drain(self, timeout=None):
-        """Block until every queued and in-flight request has resolved."""
+        """Block until every queued, routed, and in-flight request has
+        resolved — across all replica lanes."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             self._cv.notify_all()
-            while self._pending or self._inflight:
+            while self._busy():
                 rem = None if deadline is None else \
                     max(deadline - time.monotonic(), 0.0)
                 if rem == 0.0:
                     raise TimeoutError(
-                        "batcher still has %d queued + %d in-flight "
-                        "requests after %.1fs"
-                        % (len(self._pending), self._inflight, timeout))
+                        "batcher still has %d queued + %d lane-queued + "
+                        "%d in-flight requests after %.1fs"
+                        % (len(self._pending),
+                           sum(len(l.ready) for l in self._lanes),
+                           sum(l.inflight for l in self._lanes),
+                           timeout))
                 self._cv.wait(0.05 if rem is None else min(rem, 0.05))
 
     def close(self, drain=True, timeout=30.0):
         """Stop accepting; optionally finish everything queued first
         (the graceful-drain half of a hot swap or shutdown), then stop
-        the workers.  With drain=False, queued requests fail with
-        BatcherClosed."""
+        the router and lane workers.  With drain=False, queued requests
+        fail with BatcherClosed."""
         with self._cv:
             self._closing = True
             self._cv.notify_all()
@@ -365,6 +544,9 @@ class DynamicBatcher:
             self._stopped = True
             leftovers = list(self._pending)
             self._pending.clear()
+            for lane in self._lanes:
+                while lane.ready:
+                    leftovers.extend(lane.ready.popleft())
             self._cv.notify_all()
         for r in leftovers:
             if r.future.set_running_or_notify_cancel():
@@ -372,5 +554,6 @@ class DynamicBatcher:
                     BatcherClosed("server shut down before dispatch"))
             if self.metrics is not None:
                 self.metrics.errors.add()
+        self._router.join(timeout=5.0)
         for t in self._threads:
             t.join(timeout=5.0)

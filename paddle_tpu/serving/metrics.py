@@ -126,9 +126,22 @@ class ModelMetrics:
         self.latency_ms = ReservoirHistogram()
         self.queue_wait_ms = ReservoirHistogram()
         self.queue_depth_fn = None
+        # installed by the batcher: live per-replica lane snapshot
+        # (device id, in-flight, lane queue, batches/rows executed)
+        self.replica_stats_fn = None
+        self._shed_by_priority = {}      # priority class -> shed count
         self._started = time.monotonic()
         self._completions = collections.deque()
         self._lock = threading.Lock()
+
+    def note_shed(self, priority=0):
+        """One admission shed of the given priority class (lowest-
+        priority-first overload policy — SERVING.md)."""
+        self.shed.add()
+        with self._lock:
+            key = int(priority)
+            self._shed_by_priority[key] = \
+                self._shed_by_priority.get(key, 0) + 1
 
     def note_completion(self, latency_ms, queue_wait_ms=None):
         self.responses.add()
@@ -193,6 +206,17 @@ class ModelMetrics:
                 snap["queue_depth"] = int(self.queue_depth_fn())
             except Exception:
                 snap["queue_depth"] = -1
+        with self._lock:
+            if self._shed_by_priority:
+                # str keys: the snapshot must stay wire-encodable
+                snap["shed_by_priority"] = {
+                    str(k): v
+                    for k, v in sorted(self._shed_by_priority.items())}
+        if self.replica_stats_fn is not None:
+            try:
+                snap["replicas"] = list(self.replica_stats_fn())
+            except Exception:
+                snap["replicas"] = []
         return snap
 
 

@@ -1,16 +1,30 @@
-"""Named, versioned model registry with atomic hot swap.
+"""Named, versioned model registry with device-placed replicas and
+atomic hot swap.
 
 The multi-tenant half of the serving runtime: each model name maps to
-versioned entries (predictor + its DynamicBatcher); requests route
-through a `latest` pointer.  A hot swap follows the same commit
-discipline as the checkpoint vault (fluid/checkpoint.py): build the new
-version completely — load artifact, construct batcher, WARM it with a
-dummy batch per bucket so the first real request never eats a compile
-stall — then flip `latest` under the routing lock, and only afterwards
-drain and retire the displaced version.  A request that resolved the old
-version before the flip completes on it (the drain waits); a request
-after the flip runs the new one; no request is dropped or answered
-twice.
+versioned entries — now each entry holding N device-resident replica
+predictors fronted by one DynamicBatcher whose router fans coalesced
+micro-batch groups to the least-loaded replica lane; requests route
+through a `latest` pointer.
+
+Placement spec (`resolve_placement`): `FLAGS.serving_replicas` or a
+per-load override — an int N (round-robin over local devices; 1 keeps
+the single default-device replica), 'auto' (one replica per local
+device — the whole-host serving shape), or an explicit device list
+('0,2' local indices / 'cpu:0,tpu:3' platform:index / jax.Device
+objects).  Each replica's params are `jax.device_put` on its assigned
+device and its batch buckets compile and WARM there, so the first real
+request on any replica runs at steady-state latency.
+
+A hot swap follows the same commit discipline as the checkpoint vault
+(fluid/checkpoint.py), extended per replica set: build ALL new replicas
+completely — load artifact, clone+place per device, construct batcher,
+warm every bucket on every replica — then flip `latest` under the
+routing lock, and only afterwards drain and retire the displaced
+replica set.  A request that resolved the old version before the flip
+completes on whichever old replica its group was routed to (the drain
+waits); a request after the flip runs the new set; no request is
+dropped or answered twice.
 
 Artifact detection: a directory containing `aot_meta.bin` is a
 `save_aot` artifact (AotPredictor — no Program rebuild, no trace); any
@@ -24,52 +38,140 @@ import threading
 
 import numpy as np
 
+from ..flags import FLAGS
 from .batcher import DynamicBatcher
 from .metrics import ServingMetrics
 
-__all__ = ["ModelRegistry", "ModelEntry", "open_predictor"]
+__all__ = ["ModelRegistry", "ModelEntry", "open_predictor",
+           "resolve_placement"]
 
 
-def open_predictor(path, buckets=None):
-    """Open a serving artifact directory as the right predictor type."""
-    from ..inference import AnalysisConfig, Predictor, load_aot_predictor
+def resolve_placement(spec=None):
+    """Turn a replica placement spec into a list of jax.Device (or
+    [None] for the single default-device replica).
+
+    spec: None -> FLAGS.serving_replicas; int or digit-string N -> N
+    replicas round-robin over jax.local_devices() (N == 1 -> [None],
+    the pre-multichip single-replica behavior on the default device);
+    'auto' -> one replica per local device; a comma list / sequence of
+    local indices ('0,2'), 'platform:index' names ('cpu:0', 'tpu:3'),
+    or jax.Device objects -> exactly those devices."""
+    import jax
+    if spec is None:
+        spec = FLAGS.serving_replicas
+    if isinstance(spec, (list, tuple)):
+        local = list(jax.local_devices())
+        by_key = {(d.platform, d.id): d for d in local}
+        devs = []
+        for item in spec:
+            if hasattr(item, "platform") and hasattr(item, "id"):
+                devs.append(item)  # already a jax.Device
+                continue
+            s = str(item).strip()
+            if ":" in s:
+                plat, _, idx = s.partition(":")
+                dev = by_key.get((plat.strip(), int(idx)))
+                if dev is None:
+                    raise ValueError(
+                        "no local device %r (have %s)" % (
+                            s, sorted("%s:%d" % k for k in by_key)))
+                devs.append(dev)
+            else:
+                i = int(s)
+                if i >= len(local):
+                    raise ValueError(
+                        "device index %d out of range: %d local "
+                        "device(s)" % (i, len(local)))
+                devs.append(local[i])
+        if not devs:
+            raise ValueError("empty replica device list")
+        return devs
+    if isinstance(spec, str):
+        s = spec.strip()
+        if s == "auto":
+            return list(jax.local_devices())
+        if "," in s or ":" in s:
+            return resolve_placement(
+                [p for p in s.split(",") if p.strip()])
+        spec = int(s)
+    n = int(spec)
+    if n < 1:
+        raise ValueError("replica count must be >= 1, got %d" % n)
+    if n == 1:
+        # the pre-multichip contract: one replica floating on jax's
+        # default device (uncommitted state, no forced transfers)
+        return [None]
+    local = list(jax.local_devices())
+    return [local[i % len(local)] for i in range(n)]
+
+
+def open_predictor(path, buckets=None, device=None):
+    """Open a serving artifact directory as the right predictor type,
+    optionally pinned to `device` (a jax.Device)."""
+    from ..inference import AnalysisConfig, Predictor, AotPredictor
     if os.path.exists(os.path.join(path, "aot_meta.bin")):
-        return load_aot_predictor(path)
+        return AotPredictor(path, device=device)
     if not os.path.isdir(path):
         raise FileNotFoundError("no model artifact directory at %r" % path)
     config = AnalysisConfig(model_dir=path)
     if buckets:
         config.batch_size_buckets = tuple(sorted(int(b) for b in buckets))
-    return Predictor(config)
+    return Predictor(config, device=device)
+
+
+def _build_replicas(path, buckets, devices):
+    """One artifact load + (N-1) clone_to placements: the Program parse
+    / StableHLO deserialize happens once, each replica gets its own
+    device-committed param copy and compile cache."""
+    first = open_predictor(path, buckets=buckets, device=devices[0])
+    preds = [first]
+    for dev in devices[1:]:
+        preds.append(first.clone_to(dev))
+    return preds
 
 
 class ModelEntry:
-    """One (name, version): the predictor, its batcher, and its path."""
+    """One (name, version): its replica predictors (device-placed), the
+    batcher fronting them, and its path.  `predictor` stays the first
+    replica — the introspection surface (buckets, feed specs) is
+    identical across replicas by construction."""
 
-    def __init__(self, name, version, path, predictor, batcher):
+    def __init__(self, name, version, path, predictor, batcher,
+                 replicas=None, devices=None):
         self.name = name
         self.version = version
         self.path = path
         self.predictor = predictor
         self.batcher = batcher
+        self.replicas = list(replicas) if replicas else [predictor]
+        self.devices = list(devices) if devices else [None]
+
+    def device_labels(self):
+        from ..inference.predictor import _device_label
+        return [_device_label(d) for d in self.devices]
 
     def warm(self):
-        """Run one zero dummy batch per bucket DIRECTLY on the predictor
-        (not through the batcher — warming must not mix with traffic).
-        After this, every bucket's executable is compiled/loaded and the
-        first real request at any size runs at steady-state latency."""
+        """Run one zero dummy batch per bucket DIRECTLY on EVERY
+        replica predictor (not through the batcher — warming must not
+        mix with traffic).  After this, every bucket's executable is
+        compiled/loaded on every replica's device and the first real
+        request at any size on any lane runs at steady-state latency.
+        The hot-swap commit discipline hinges on this covering the
+        whole replica set BEFORE the `latest` flip."""
         specs = self.predictor.feed_specs()
         buckets = self.predictor.batch_buckets() or (1,)
         batched = self.predictor.batched_feed_names()
-        for cap in buckets:
-            feeds = {}
-            for fname, (shape, dtype) in specs.items():
-                if fname in batched:
-                    s = [cap if d == -1 else d for d in shape]
-                else:
-                    s = [1 if d == -1 else d for d in shape]
-                feeds[fname] = np.zeros(tuple(s), dtype=np.dtype(dtype))
-            self.predictor.run(feeds)
+        for pred in self.replicas:
+            for cap in buckets:
+                feeds = {}
+                for fname, (shape, dtype) in specs.items():
+                    if fname in batched:
+                        s = [cap if d == -1 else d for d in shape]
+                    else:
+                        s = [1 if d == -1 else d for d in shape]
+                    feeds[fname] = np.zeros(tuple(s),
+                                            dtype=np.dtype(dtype))
+                pred.run(feeds)
         return self
 
 
@@ -77,27 +179,36 @@ class ModelRegistry:
     """name -> {versions, latest} with hot swap and drain-on-retire."""
 
     def __init__(self, metrics=None, max_queue=None, deadline_ms=None,
-                 workers=None):
+                 workers=None, replicas=None):
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self._max_queue = max_queue
         self._deadline_ms = deadline_ms
         self._workers = workers
+        self._replicas = replicas  # default placement spec for loads
         self._lock = threading.Lock()
         self._models = {}  # name -> {"versions": {v: entry}, "latest": v}
 
     # ------------------------------------------------------------------
 
     def load_model(self, name, path, version=None, warm=True,
-                   buckets=None, drain_timeout=30.0):
+                   buckets=None, drain_timeout=30.0, replicas=None,
+                   devices=None):
         """Load (or hot-swap in) `path` as `name`.  Returns the entry.
-        The displaced latest version, if any, is drained and retired
-        AFTER the flip — in-flight requests on it complete."""
-        predictor = open_predictor(path, buckets=buckets)
+        `replicas`/`devices` override the registry's default placement
+        spec (see resolve_placement).  ALL replicas are built and
+        warmed before the flip; the displaced latest version's replica
+        set, if any, is drained and retired AFTER the flip — in-flight
+        requests on it complete."""
+        spec = devices if devices is not None else (
+            replicas if replicas is not None else self._replicas)
+        placement = resolve_placement(spec)
+        preds = _build_replicas(path, buckets, placement)
         batcher = DynamicBatcher(
-            predictor, max_queue=self._max_queue,
+            preds[0], max_queue=self._max_queue,
             deadline_ms=self._deadline_ms, workers=self._workers,
-            metrics=self.metrics.model(name))
-        entry = ModelEntry(name, version, path, predictor, batcher)
+            metrics=self.metrics.model(name), replicas=preds)
+        entry = ModelEntry(name, version, path, preds[0], batcher,
+                           replicas=preds, devices=placement)
         if warm:
             try:
                 entry.warm()
@@ -117,6 +228,8 @@ class ModelRegistry:
             replaced_same = slot["versions"].get(version)
             slot["versions"][version] = entry
             slot["latest"] = version  # the atomic flip
+        # the new batcher owns the live replica/queue-depth hooks from
+        # here on; the displaced set still drains below
         for old in (displaced, replaced_same):
             if old is not None and old is not entry:
                 old.batcher.close(drain=True, timeout=drain_timeout)
@@ -143,18 +256,25 @@ class ModelRegistry:
 
     def describe(self):
         with self._lock:
-            return {
-                name: {"latest": slot["latest"],
-                       "versions": sorted(slot["versions"]),
-                       "buckets": list(
-                           slot["versions"][slot["latest"]]
-                           .predictor.batch_buckets())
-                       if slot["latest"] in slot["versions"] else []}
-                for name, slot in self._models.items()}
+            out = {}
+            for name, slot in self._models.items():
+                info = {"latest": slot["latest"],
+                        "versions": sorted(slot["versions"])}
+                latest = slot["versions"].get(slot["latest"])
+                if latest is not None:
+                    info["buckets"] = list(
+                        latest.predictor.batch_buckets())
+                    info["replicas"] = len(latest.replicas)
+                    info["devices"] = latest.device_labels()
+                else:
+                    info["buckets"] = []
+                out[name] = info
+            return out
 
     # ------------------------------------------------------------------
 
-    def submit(self, name, feeds, version=None, deadline=None):
+    def submit(self, name, feeds, version=None, deadline=None,
+               priority=0):
         """Route one request; returns the batcher Future.  Resolution
         and submit happen under ONE lock acquisition so a concurrent hot
         swap can never retire a version between the two (the no-dropped-
@@ -168,13 +288,15 @@ class ModelRegistry:
             entry = slot["versions"].get(v)
             if entry is None:
                 raise KeyError("model %r has no version %r" % (name, v))
-            return entry.batcher.submit(feeds, deadline=deadline)
+            return entry.batcher.submit(feeds, deadline=deadline,
+                                        priority=priority)
 
     def infer(self, name, feeds, version=None, deadline=None,
-              timeout=None):
+              timeout=None, priority=0):
         """Blocking submit+wait convenience for in-process callers."""
         return self.submit(name, feeds, version=version,
-                           deadline=deadline).result(timeout=timeout)
+                           deadline=deadline,
+                           priority=priority).result(timeout=timeout)
 
     def close_all(self, drain=True, timeout=30.0):
         with self._lock:

@@ -5,12 +5,17 @@ parameter-server transport (distributed/rpc.py over native/wire.py — no
 pickle ever touches a socket), carrying four commands:
 
   infer         {"cmd","model","feeds"{name->ndarray},"deadline_ms"?,
-                 "version"?} -> {"ok","fetches"[ndarray...]} or
-                 {"error","code"} with code in {"overloaded","deadline",
-                 "no_model","bad_request","internal"}
-  load_model    {"cmd","name","path","version"?} — hot swap
+                 "version"?,"priority"?} -> {"ok","fetches"[ndarray...]}
+                 or {"error","code"} with code in {"overloaded",
+                 "deadline","no_model","bad_request","internal"};
+                 an "overloaded" reply carries "shed_priority" — the
+                 class the lowest-priority-first policy dropped
+  load_model    {"cmd","name","path","version"?,"replicas"?,"devices"?}
+                 — hot swap; replicas/devices are the device placement
+                 spec (N, 'auto', or explicit device names)
   unload_model  {"cmd","name"} — drain then remove
-  stats         {"cmd"} -> the ServingMetrics snapshot
+  stats         {"cmd"} -> the ServingMetrics snapshot (now with
+                 per-replica lane stats per model)
   shutdown      graceful drain, then the server stops accepting
 
 Admission control is the batcher's bounded queue: a request past
@@ -52,7 +57,12 @@ class ServingError(RuntimeError):
 
 def _error_reply(exc):
     if isinstance(exc, ServerOverloaded):
-        return {"error": str(exc), "code": "overloaded"}
+        reply = {"error": str(exc), "code": "overloaded"}
+        if getattr(exc, "priority", None) is not None:
+            # which priority class was shed (the arrival, or the queued
+            # request it evicted) — the client re-raises with it
+            reply["shed_priority"] = int(exc.priority)
+        return reply
     if isinstance(exc, (DeadlineExceeded, TimeoutError)):
         return {"error": str(exc), "code": "deadline"}
     if isinstance(exc, KeyError):
@@ -73,13 +83,16 @@ class InferenceServer:
 
     def __init__(self, endpoint="127.0.0.1:0", model_root=None,
                  max_queue=None, deadline_ms=None, workers=None,
-                 buckets=None):
+                 buckets=None, replicas=None):
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
         self.metrics = ServingMetrics()
+        # `replicas`: default placement spec for every model this server
+        # loads (int N / 'auto' / explicit device list — SERVING.md
+        # multi-chip serving); a load_model RPC can override per model
         self.registry = ModelRegistry(
             metrics=self.metrics, max_queue=max_queue,
-            deadline_ms=deadline_ms, workers=workers)
+            deadline_ms=deadline_ms, workers=workers, replicas=replicas)
         self._default_buckets = buckets
         self._model_root = model_root
         self._stopped = False
@@ -184,10 +197,14 @@ class InferenceServer:
                 raise BatcherClosed("server is draining")
             entry = self.registry.load_model(
                 msg["name"], msg["path"], version=msg.get("version"),
-                buckets=msg.get("buckets") or self._default_buckets)
+                buckets=msg.get("buckets") or self._default_buckets,
+                replicas=msg.get("replicas"),
+                devices=msg.get("devices"))
             return {"ok": True, "name": entry.name,
                     "version": entry.version,
-                    "buckets": list(entry.predictor.batch_buckets())}
+                    "buckets": list(entry.predictor.batch_buckets()),
+                    "replicas": len(entry.replicas),
+                    "devices": entry.device_labels()}
         if cmd == "unload_model":
             self.registry.unload_model(msg["name"])
             return {"ok": True}
@@ -216,7 +233,9 @@ class InferenceServer:
             wait = float(deadline_ms) / 1000.0 + 5.0
         future = self.registry.submit(name, feeds,
                                       version=msg.get("version"),
-                                      deadline=deadline)
+                                      deadline=deadline,
+                                      priority=int(msg.get("priority",
+                                                           0)))
         try:
             fetches = future.result(timeout=wait)
         except DeadlineExceeded:
@@ -274,7 +293,8 @@ class ServingClient:
         if "error" in reply:
             code = reply.get("code")
             if code == "overloaded":
-                raise ServerOverloaded(reply["error"])
+                raise ServerOverloaded(reply["error"],
+                                       priority=reply.get("shed_priority"))
             if code == "deadline":
                 raise DeadlineExceeded(reply["error"])
             raise ServingError("%s (code=%s)" % (reply["error"], code))
@@ -295,7 +315,7 @@ class ServingClient:
             deadline=retry_deadline)
 
     def infer(self, model, feeds, deadline_ms=None, version=None,
-              retry_sheds=None):
+              retry_sheds=None, priority=None):
         deadline_ms = self.deadline_ms if deadline_ms is None \
             else deadline_ms
         msg = {"cmd": "infer", "model": model,
@@ -303,6 +323,10 @@ class ServingClient:
                          for k, v in feeds.items()}}
         if version is not None:
             msg["version"] = version
+        if priority is not None:
+            # forwarded to admission control: larger = more important;
+            # under overload the server sheds lowest-priority-first
+            msg["priority"] = int(priority)
         retry_deadline = None
         retry_on = ()
         if deadline_ms is not None:
@@ -316,12 +340,19 @@ class ServingClient:
                            retry_on=retry_on)
         return list(reply["fetches"])
 
-    def load_model(self, name, path, version=None, buckets=None):
+    def load_model(self, name, path, version=None, buckets=None,
+                   replicas=None, devices=None):
         msg = {"cmd": "load_model", "name": name, "path": path}
         if version is not None:
             msg["version"] = version
         if buckets is not None:
             msg["buckets"] = [int(b) for b in buckets]
+        if replicas is not None:
+            # placement spec: int N, 'auto', or 'cpu:0,cpu:1' string
+            msg["replicas"] = replicas if isinstance(replicas, str) \
+                else int(replicas)
+        if devices is not None:
+            msg["devices"] = [str(d) for d in devices]
         return self._call(msg)
 
     def unload_model(self, name):

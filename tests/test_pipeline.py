@@ -165,6 +165,76 @@ def test_prefetch_hides_slow_host_stall():
         (t_pre, t_sync)
 
 
+def test_prefetch_mesh_mode_commits_sharded_arrays():
+    """Sharded prefetch (PIPELINE.md follow-up): with a mesh, the
+    prefetch thread commits each batch array as a mesh-global sharded
+    jax.Array (make_array_from_process_local_data) — batch dim on the
+    data axis, scalars replicated, values bit-equal to the source."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.parallel.mesh import data_parallel_mesh, DATA_AXIS
+
+    mesh = data_parallel_mesh(4, use_cuda=False)
+
+    def src():
+        for i in range(6):
+            yield {"x": np.arange(8 * 3, dtype=np.float32)
+                        .reshape(8, 3) + i,
+                   "lr": np.float32(0.5)}
+
+    out = list(reader_mod.prefetch_to_device(src, 2, mesh=mesh)())
+    assert len(out) == 6
+    for i, item in enumerate(out):
+        x = item["x"]
+        assert isinstance(x, jax.Array)
+        assert x.sharding == NamedSharding(mesh, P(DATA_AXIS, None)), \
+            "batch feed not sharded on the mesh data axis: %r" \
+            % (x.sharding,)
+        np.testing.assert_array_equal(
+            np.asarray(x),
+            np.arange(8 * 3, dtype=np.float32).reshape(8, 3) + i)
+        lr = item["lr"]
+        assert isinstance(lr, jax.Array)
+        assert lr.sharding == NamedSharding(mesh, P())
+
+
+def test_pe_run_accepts_presharded_prefetch_feeds():
+    """ParallelExecutor fed pre-sharded arrays (prefetch mesh mode)
+    computes the same losses as host feeds, and its feed prep passes
+    the already-committed array through unchanged (no per-dispatch
+    re-commit — the point of sharding on the prefetch thread)."""
+    xs, ys = _xy(8)
+
+    def build_pe():
+        main, startup, loss = _build_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    main_program=main)
+        return pe, loss
+
+    with fluid.scope_guard(fluid.Scope()):
+        pe, loss = build_pe()
+        host = [pe.run(fetch_list=[loss], feed={"x": xs, "y": ys})[0]
+                for _ in range(3)]
+    with fluid.scope_guard(fluid.Scope()):
+        pe2, loss2 = build_pe()
+
+        def src():
+            for _ in range(3):
+                yield {"x": xs, "y": ys}
+
+        feeds = list(reader_mod.prefetch_to_device(
+            src, 2, mesh=pe2.mesh)())
+        prepped = pe2._prepare_feeds(feeds[0])
+        assert prepped["x"] is feeds[0]["x"], \
+            "pre-sharded feed was re-committed on the dispatch path"
+        sharded = [pe2.run(fetch_list=[loss2], feed=f)[0]
+                   for f in feeds]
+    for a, b in zip(host, sharded):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 # ---------------------------------------------------------------------------
 # FetchFuture / executor futures
 # ---------------------------------------------------------------------------

@@ -1,0 +1,429 @@
+"""Multi-chip serving tests (SERVING.md "Multi-chip serving").
+
+Pins the replica-per-device contracts: placement spec resolution,
+least-loaded routing that starves no replica under skewed request
+sizes, bit-exact replies regardless of which replica served them, hot
+swap of a whole replica set under concurrent load with zero dropped or
+double-answered requests, lowest-priority-first admission shedding
+with the shed class on the reply, the warn-once overflow fix under
+concurrent lanes, and a tier-1 smoke of the serving_mc_r1 bench lane.
+Everything CPU-safe under JAX_PLATFORMS=cpu + the conftest's 8 forced
+host devices.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.serving import (
+    DynamicBatcher, InferenceServer, ModelRegistry, ServerOverloaded,
+    ServingClient, ServingMetrics, resolve_placement,
+    set_dispatch_delay)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos():
+    yield
+    set_dispatch_delay(0.0)
+
+
+def _export_fc(tmp_path, seed, name="m", size=6):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=size, act="relu")
+        pred = fluid.layers.fc(input=h, size=size, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = str(tmp_path / name)
+        fluid.save_inference_model(md, ["x"], [pred], exe,
+                                   main_program=main)
+    return md
+
+
+def _direct(md, buckets=(2, 4)):
+    from paddle_tpu.inference import AnalysisConfig, Predictor
+    cfg = AnalysisConfig(model_dir=md)
+    cfg.batch_size_buckets = tuple(buckets)
+    return Predictor(cfg)
+
+
+# ---------------------------------------------------------------------------
+# placement spec
+# ---------------------------------------------------------------------------
+
+class TestPlacement:
+    def test_auto_is_one_replica_per_local_device(self):
+        import jax
+        devs = resolve_placement("auto")
+        assert devs == list(jax.local_devices())
+        assert len(devs) >= 4  # conftest forces 8 host devices
+
+    def test_count_round_robins_and_one_stays_default(self):
+        import jax
+        assert resolve_placement(1) == [None]  # pre-multichip behavior
+        assert resolve_placement("1") == [None]
+        devs = resolve_placement(3)
+        assert devs == list(jax.local_devices())[:3]
+
+    def test_explicit_device_lists(self):
+        import jax
+        local = list(jax.local_devices())
+        assert resolve_placement("cpu:1,cpu:3") == [local[1], local[3]]
+        assert resolve_placement([0, 2]) == [local[0], local[2]]
+        with pytest.raises(ValueError, match="out of range"):
+            resolve_placement([len(local)])
+        with pytest.raises(ValueError):
+            resolve_placement(0)
+
+    def test_replicas_live_on_their_devices(self, tmp_path):
+        """Each replica's params are committed to its assigned device
+        — the thing that makes this multi-CHIP and not just
+        multi-thread."""
+        import jax
+        md = _export_fc(tmp_path, seed=1)
+        reg = ModelRegistry(deadline_ms=1)
+        try:
+            entry = reg.load_model("m", md, buckets=(2,), replicas=4)
+            local = list(jax.local_devices())
+            for pred, want in zip(entry.replicas, local[:4]):
+                devs = {next(iter(v.devices())) if hasattr(v, "devices")
+                        else None for v in pred._state.values()}
+                assert devs == {want}, \
+                    "replica state not on %r: %r" % (want, devs)
+        finally:
+            reg.close_all()
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+class TestRouting:
+    def test_skewed_sizes_no_starved_replica(self, tmp_path):
+        """Skewed request sizes across 4 replicas: every replica lane
+        executes batches (no starved replica) and the least-loaded
+        policy keeps the spread bounded — no lane hoards the work."""
+        md = _export_fc(tmp_path, seed=2)
+        metrics = ServingMetrics().model("m")
+        batcher = DynamicBatcher(
+            _direct(md), max_queue=256, deadline_ms=0,
+            metrics=metrics,
+            replicas=[_direct(md).clone_to(d)
+                      for d in resolve_placement(4)])
+        set_dispatch_delay(0.01)  # uniform per-dispatch lane cost
+        rng = np.random.RandomState(3)
+        try:
+            futs = []
+            for i in range(48):
+                b = [1, 1, 1, 2, 3, 4][i % 6]  # skewed toward tiny
+                futs.append(batcher.submit(
+                    {"x": rng.randn(b, 4).astype(np.float32)}))
+            outs = [f.result(timeout=60) for f in futs]
+            assert all(o is not None for o in outs)
+            stats = batcher.replica_stats()
+            batches = [s["batches"] for s in stats]
+            total = sum(batches)
+            assert min(batches) >= 1, \
+                "starved replica under skewed sizes: %r" % (stats,)
+            # least-loaded invariant, observed statistically: with
+            # uniform per-batch cost no lane may take more than half
+            # of all groups while another idles
+            assert max(batches) <= max(total - 3, total // 2 + 3), \
+                "load hoarding across lanes: %r" % (stats,)
+            assert {s["device"] for s in stats} == \
+                {"cpu:0", "cpu:1", "cpu:2", "cpu:3"}
+        finally:
+            set_dispatch_delay(0.0)
+            batcher.close()
+
+    def test_replies_bit_exact_vs_direct_on_every_replica(self,
+                                                          tmp_path):
+        """Whatever lane a group lands on, the reply bits must equal a
+        direct single-predictor run — device placement and routing are
+        invisible in the payload."""
+        md = _export_fc(tmp_path, seed=4)
+        direct = _direct(md)
+        reg = ModelRegistry(deadline_ms=2)
+        rng = np.random.RandomState(5)
+        try:
+            entry = reg.load_model("m", md, buckets=(2, 4),
+                                   replicas="auto")
+            assert len(entry.replicas) >= 4
+            inputs = [rng.randn(1 + i % 4, 4).astype(np.float32)
+                      for i in range(24)]
+            refs = [direct.run({"x": x})[0] for x in inputs]
+            futs = [reg.submit("m", {"x": x}) for x in inputs]
+            for f, ref in zip(futs, refs):
+                out = f.result(timeout=60)[0]
+                assert np.array_equal(out, ref), \
+                    "replica reply differs from direct Predictor.run"
+            stats = entry.batcher.replica_stats()
+            assert sum(s["batches"] for s in stats) >= 1
+        finally:
+            reg.close_all()
+
+
+# ---------------------------------------------------------------------------
+# hot swap under multi-replica load (acceptance pin)
+# ---------------------------------------------------------------------------
+
+class TestHotSwapMultiReplica:
+    def test_swap_under_4_replica_traffic_no_drops_no_doubles(
+            self, tmp_path):
+        """Hammer one model from 6 threads while hot-swapping a
+        4-replica set for another 4-replica set: every request
+        resolves exactly once (zero dropped), every answer is exactly
+        v1's or v2's output (zero mixed/double-answered), and post-swap
+        traffic serves v2."""
+        md1 = _export_fc(tmp_path, seed=31, name="v1")
+        md2 = _export_fc(tmp_path, seed=32, name="v2")
+        x = np.random.RandomState(6).randn(2, 4).astype(np.float32)
+        r1 = _direct(md1).run({"x": x})[0]
+        r2 = _direct(md2).run({"x": x})[0]
+        reg = ModelRegistry(deadline_ms=2)
+        reg.load_model("m", md1, buckets=(2, 4), replicas=4)
+        stop = threading.Event()
+        wrong, errors, answered = [], [], [0]
+        lock = threading.Lock()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    out = reg.infer("m", {"x": x}, timeout=30)[0]
+                except Exception as e:  # no exception is acceptable
+                    errors.append(e)
+                    return
+                with lock:
+                    answered[0] += 1
+                    if not (np.array_equal(out, r1)
+                            or np.array_equal(out, r2)):
+                        wrong.append(out)
+
+        threads = [threading.Thread(target=hammer) for _ in range(6)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.25)
+            reg.load_model("m", md2, buckets=(2, 4), replicas=4)
+            time.sleep(0.25)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        assert not errors, errors[:3]
+        assert not wrong, "%d responses matched neither version" \
+            % len(wrong)
+        assert answered[0] > 20
+        out_after = reg.infer("m", {"x": x}, timeout=30)[0]
+        assert np.array_equal(out_after, r2), \
+            "post-swap traffic must serve the new replica set"
+        entry = reg._models["m"]["versions"][2]
+        assert len(entry.replicas) == 4
+        reg.close_all()
+
+
+# ---------------------------------------------------------------------------
+# priority classes in admission control
+# ---------------------------------------------------------------------------
+
+class TestPriorityShedding:
+    def test_lowest_priority_first_shed_ordering(self, tmp_path):
+        """Full queue + arriving priorities: each higher-priority
+        arrival evicts the earliest lowest strictly-lower-priority
+        queued request; equal-or-lower arrivals shed themselves; the
+        ServerOverloaded always names the class actually dropped."""
+        md = _export_fc(tmp_path, seed=7)
+        metrics = ServingMetrics().model("m")
+        batcher = DynamicBatcher(_direct(md), max_queue=3,
+                                 deadline_ms=5, metrics=metrics)
+        set_dispatch_delay(0.5)  # pin the lane so the queue stays full
+        x = np.zeros((1, 4), np.float32)
+        try:
+            head = batcher.submit({"x": x})        # occupies the lane
+            time.sleep(0.1)
+            a0 = batcher.submit({"x": x}, priority=0)
+            b0 = batcher.submit({"x": x}, priority=0)
+            c1 = batcher.submit({"x": x}, priority=1)
+            # queue full: a priority-2 arrival evicts a0 (earliest of
+            # the lowest class), NOT c1
+            d2 = batcher.submit({"x": x}, priority=2)
+            with pytest.raises(ServerOverloaded) as ei:
+                a0.result(timeout=5)
+            assert ei.value.priority == 0
+            assert not b0.done() and not c1.done()
+            # another priority-1 arrival evicts b0 (still a 0 queued)
+            e1 = batcher.submit({"x": x}, priority=1)
+            with pytest.raises(ServerOverloaded):
+                b0.result(timeout=5)
+            # a priority-0 arrival has no lower class to evict: it
+            # sheds itself, synchronously, carrying its own class
+            with pytest.raises(ServerOverloaded) as ei:
+                batcher.submit({"x": x}, priority=0)
+            assert ei.value.priority == 0
+            # an arrival equal to the lowest queued class also sheds
+            # itself (only STRICTLY lower classes are evicted)
+            with pytest.raises(ServerOverloaded) as ei:
+                batcher.submit({"x": x}, priority=1)
+            assert ei.value.priority == 1
+            set_dispatch_delay(0.0)
+            for f in (head, c1, d2, e1):
+                assert f.result(timeout=30) is not None
+            snap = metrics.snapshot()
+            assert snap["shed_by_priority"] == {"0": 3, "1": 1}
+            assert snap["shed"] == 4
+        finally:
+            set_dispatch_delay(0.0)
+            batcher.close()
+
+    def test_priority_rides_the_wire_and_shed_class_returns(
+            self, tmp_path):
+        """ServingClient forwards `priority`; an overloaded reply
+        carries the shed class and the client re-raises with it."""
+        md = _export_fc(tmp_path, seed=8)
+        server = InferenceServer(max_queue=2, buckets=(2,)).start()
+        x = np.zeros((1, 4), np.float32)
+        boot = ServingClient(server.endpoint)
+        try:
+            boot.load_model("m", md, buckets=[2])
+            boot.infer("m", {"x": x})  # warm
+            set_dispatch_delay(0.4)
+            sheds = []
+            lock = threading.Lock()
+
+            def one(prio):
+                cli = ServingClient(server.endpoint)
+                try:
+                    cli.infer("m", {"x": x}, priority=prio,
+                              retry_sheds=False)
+                except ServerOverloaded as e:
+                    with lock:
+                        sheds.append(e.priority)
+                except Exception:
+                    pass
+                finally:
+                    cli.close()
+
+            threads = [threading.Thread(target=one, args=(i % 3,))
+                       for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            set_dispatch_delay(0.0)
+            assert sheds, "no shed under a 2-deep queue and 16 clients"
+            assert all(p is not None for p in sheds), \
+                "shed reply lost its priority class: %r" % (sheds,)
+            # lowest-priority-first: the majority of dropped classes
+            # must be the lowest offered
+            assert min(sheds) == 0
+        finally:
+            set_dispatch_delay(0.0)
+            boot.close()
+            server.shutdown(drain=False, timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# warn-once overflow under concurrent lanes (bugfix pin)
+# ---------------------------------------------------------------------------
+
+def test_overflow_warns_exactly_once_across_threads(tmp_path):
+    """Concurrent lanes hitting the same unlisted bucket size must
+    produce exactly ONE overflow warning (the warn-once set is checked
+    under the predictor lock)."""
+    md = _export_fc(tmp_path, seed=9)
+    pred = _direct(md, buckets=(2,))
+    calls = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(8)
+
+    def counting_warn(*a, **k):
+        with lock:
+            calls.append(a[0] if a else k)
+
+    def hit():
+        barrier.wait()
+        pred._bucket_cap(9)
+
+    orig = warnings.warn
+    warnings.warn = counting_warn
+    try:
+        threads = [threading.Thread(target=hit) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        warnings.warn = orig
+    assert len(calls) == 1, \
+        "overflow size 9 warned %d times across 8 lanes" % len(calls)
+    assert pred._overflow_warned == {9}
+
+
+# ---------------------------------------------------------------------------
+# stats / tools surfaces
+# ---------------------------------------------------------------------------
+
+def test_stats_and_serving_top_show_replica_lanes(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import serving_top
+    md = _export_fc(tmp_path, seed=10)
+    server = InferenceServer(buckets=(2,), deadline_ms=1).start()
+    cli = ServingClient(server.endpoint)
+    try:
+        reply = cli.load_model("demo", md, buckets=[2], replicas=2)
+        assert reply["replicas"] == 2
+        assert reply["devices"] == ["cpu:0", "cpu:1"]
+        for _ in range(4):
+            cli.infer("demo", {"x": np.zeros((1, 4), np.float32)})
+        stats = cli.stats()
+        lanes = stats["stats"]["models"]["demo"]["replicas"]
+        assert [r["device"] for r in lanes] == ["cpu:0", "cpu:1"]
+        assert sum(r["batches"] for r in lanes) >= 1
+        assert stats["models"]["demo"]["replicas"] == 2
+        serving_top.main([server.endpoint])
+        out = capsys.readouterr().out
+        assert "r0" in out and "cpu:0" in out and "replicas=2" in out
+    finally:
+        cli.close()
+        server.shutdown(drain=True)
+
+
+def test_bench_serving_mc_smoke_subprocess():
+    """Tier-1 smoke of the serving_mc bench lane: fresh process, 4
+    forced host devices, 4 replicas, per-dispatch cost stand-in —
+    JSON record with all requests answered and bit_exact vs direct."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_serving.py"),
+         "--smoke", "--replicas", "4", "--force_host_devices", "4",
+         "--dispatch_cost_ms", "10", "--qps", "120", "--duration", "2",
+         "--max_bucket", "1", "--max_queue", "64",
+         "--deadline_ms", "5000"],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert lines, proc.stdout[-500:]
+    rec = json.loads(lines[-1])
+    assert rec["metric"] == "serving_qps"
+    assert rec["replicas"] == 4
+    assert rec["bit_exact"] is True
+    assert rec["ok"] > 0 and rec["errors"] == 0
+    assert len(rec["replica_stats"]) == 4
+    assert {r["device"] for r in rec["replica_stats"]} == \
+        {"cpu:0", "cpu:1", "cpu:2", "cpu:3"}

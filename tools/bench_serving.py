@@ -6,19 +6,33 @@ arrivals are a Poisson process at a target QPS, generated on schedule
 whether or not earlier requests returned — so an overloaded server shows
 up as latency blowup + sheds, never as a flattered throughput number.
 
-Per target-QPS point it prints ONE JSON line compatible with the
-bench_zoo lane format:
+Per (replica-count, target-QPS) point it prints ONE JSON line
+compatible with the bench_zoo lane format:
 
   {"metric": "serving_qps", "model": ..., "target_qps": ...,
    "achieved_qps": ..., "p50_ms": ..., "p95_ms": ..., "p99_ms": ...,
    "shed_rate": ..., "batch_fill": ..., "bucket_fill_ratio": ...,
-   "errors": ..., "backend": ...}
+   "errors": ..., "replicas": ..., "bit_exact": ..., "backend": ...}
 
 The server runs in-process (threads, same machine) on a model exported
 fresh: `--model fc` (tiny, the CPU/CI path), `--model mnist`, or
 `--model resnet` (the TPU serving flagship). `--smoke` forces the tiny
 fc model with a short sweep — tier-1 CI proof that the whole
-client->wire->batcher->predictor->scatter path works.
+client->wire->router->lane->predictor->scatter path works.
+
+Multi-chip serving (SERVING.md): `--replicas` takes a placement spec
+('auto', an explicit device list) or a comma sweep of counts ('1,4' —
+each count gets a fresh server, so the scaling curve is apples to
+apples). `--force_host_devices N` splits the CPU backend into N XLA
+host devices (the dryrun_multichip trick) so replica placement and
+routing run for real without silicon. `--dispatch_cost_ms` injects a
+deterministic per-dispatch stall in the lane worker (GIL released, the
+same methodology as fluid_benchmark's --host_stall_ms): it stands in
+for per-batch device time, so the r1 -> rN throughput ratio measures
+the router/lane parallelism honestly even on a single host core.
+Every point also replays a few requests against a direct in-process
+Predictor.run and records `bit_exact` — replica routing must never
+change a single bit of any reply.
 
 Chaos: --chaos_proxy routes traffic through tools/chaos.py's FlakyProxy
 (connection kills mid-flight), --chaos_slow_ms injects a slow-worker
@@ -162,25 +176,79 @@ def run_point(endpoint, model, feed_name, sample_shape, dtype,
     }
 
 
+def _parse_replica_sweep(spec):
+    """'1,4' -> sweep of counts; 'auto' / '4' / 'cpu:0,cpu:1' -> one
+    placement spec point (a comma list containing ':' is a device list,
+    not a sweep)."""
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    if len(parts) > 1 and all(p.isdigit() or p == "auto" for p in parts):
+        return parts
+    return [spec.strip()]
+
+
+def _verify_bit_exact(endpoint, model, model_dir, buckets, feed_name,
+                      shape, dtype, n=3, seed=123):
+    """Replay `n` random requests through the served replica set and
+    against a direct in-process Predictor.run on the same artifact —
+    routing across device-placed replicas must not change one bit."""
+    from paddle_tpu.inference import AnalysisConfig, Predictor
+    from paddle_tpu.serving import ServingClient
+    cfg = AnalysisConfig(model_dir=model_dir)
+    cfg.batch_size_buckets = tuple(buckets)
+    direct = Predictor(cfg)
+    rng = np.random.RandomState(seed)
+    cli = ServingClient(endpoint)
+    try:
+        for i in range(n):
+            x = rng.randn(1 + i % buckets[0], *shape).astype(dtype)
+            served = cli.infer(model, {feed_name: x},
+                               deadline_ms=60000.0)
+            ref = direct.run({feed_name: x})
+            if len(served) != len(ref) or any(
+                    not np.array_equal(a, b)
+                    for a, b in zip(served, ref)):
+                return False
+        return True
+    finally:
+        cli.close()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="fc",
                     choices=["fc", "mnist", "resnet"])
-    ap.add_argument("--qps", default="50,200",
-                    help="comma-separated target-QPS sweep")
-    ap.add_argument("--duration", type=float, default=10.0,
-                    help="seconds per QPS point")
+    ap.add_argument("--qps", default=None,
+                    help="comma-separated target-QPS sweep "
+                         "(default 50,200; smoke default 100)")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="seconds per QPS point (default 10, smoke 2)")
     ap.add_argument("--req_batch", type=int, default=1,
                     help="rows per client request (the batcher coalesces "
                          "across requests on top of this)")
-    ap.add_argument("--max_bucket", type=int, default=32,
+    ap.add_argument("--max_bucket", type=int, default=None,
                     help="largest compiled batch bucket; the bucket set "
-                         "is {max/4, max/2, max}")
+                         "is {max/4, max/2, max} (default 32, smoke 8)")
     ap.add_argument("--deadline_ms", type=float, default=2000.0)
     ap.add_argument("--deadline_batch_ms", type=float, default=None,
                     help="batcher coalescing window override "
                          "(default FLAGS.serving_batch_deadline_ms)")
     ap.add_argument("--max_queue", type=int, default=None)
+    ap.add_argument("--replicas", default="1",
+                    help="replica placement spec per point: a count, "
+                         "'auto' (one replica per local device), an "
+                         "explicit device list ('cpu:0,cpu:1'), or a "
+                         "comma sweep of counts ('1,4') — each sweep "
+                         "point gets a fresh server so the scaling "
+                         "curve is honest")
+    ap.add_argument("--force_host_devices", type=int, default=0,
+                    help="split the CPU backend into N XLA host "
+                         "devices (xla_force_host_platform_device_count"
+                         ") so replica placement runs without silicon")
+    ap.add_argument("--dispatch_cost_ms", type=float, default=0.0,
+                    help="deterministic per-dispatch stall in the lane "
+                         "worker (GIL released): the stand-in for "
+                         "per-batch device time that makes the replica-"
+                         "scaling ratio measurable on a 1-core host")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fc model, short sweep (CI path)")
     ap.add_argument("--require_tpu", action="store_true")
@@ -193,23 +261,41 @@ def main():
                          "this many ms")
     args = ap.parse_args()
 
+    if args.force_host_devices > 0:
+        # must land before jax backend init (init_backend below); the
+        # site hook may have imported jax already, but XLA_FLAGS is
+        # still honored at backend init (tests/conftest.py note)
+        import re
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       "", flags)
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d"
+            % args.force_host_devices).strip()
+
     from bench import init_backend
     on_tpu, backend_label = init_backend(
         smoke=args.smoke, require_tpu=args.require_tpu,
         tool="bench_serving")
 
     kind = args.model
-    qps_points = [float(q) for q in args.qps.split(",") if q]
-    duration = args.duration
-    max_bucket = args.max_bucket
+    qps_points = [float(q) for q in args.qps.split(",") if q] \
+        if args.qps else [50.0, 200.0]
+    duration = 10.0 if args.duration is None else args.duration
+    max_bucket = 32 if args.max_bucket is None else args.max_bucket
     if args.smoke or not on_tpu:
         # CPU path: tiny fc model, short points — proves the serving
-        # path end-to-end, never mistakable for a chip number
+        # path end-to-end, never mistakable for a chip number.
+        # Explicit --qps/--duration/--max_bucket survive (the
+        # multi-chip lanes drive their own small sweeps through the
+        # smoke path)
         kind = "fc"
-        if args.smoke:
+        if args.smoke and args.qps is None:
             qps_points = [100.0]
-        duration = min(duration, 2.0)
-        max_bucket = min(max_bucket, 8)
+        if args.duration is None:
+            duration = 2.0
+        if args.max_bucket is None:
+            max_bucket = 8
 
     buckets = sorted({max(max_bucket // 4, 1), max(max_bucket // 2, 1),
                       max_bucket})
@@ -217,49 +303,67 @@ def main():
     model_dir, feed_name, shape, dtype = build_model(
         kind, os.path.join(workdir, kind))
 
-    from paddle_tpu.serving import InferenceServer, set_dispatch_delay
-    server = InferenceServer(
-        max_queue=args.max_queue, deadline_ms=args.deadline_batch_ms,
-        buckets=buckets).start()
-    endpoint = server.endpoint
-    proxy = None
-    if args.chaos_proxy:
-        from tools.chaos import FlakyProxy
-        proxy = FlakyProxy(server.endpoint, drop_first=1).start()
-        endpoint = proxy.endpoint
-    if args.chaos_slow_ms:
-        set_dispatch_delay(args.chaos_slow_ms / 1000.0)
+    from paddle_tpu.serving import (InferenceServer, ServingClient,
+                                    set_dispatch_delay)
 
-    try:
-        from paddle_tpu.serving import ServingClient
-        boot = ServingClient(endpoint)
-        boot.load_model(kind, model_dir, buckets=buckets)
-        # one warm request outside the timed window
-        warm = np.zeros((1,) + shape, dtype=dtype)
-        boot.infer(kind, {feed_name: warm}, deadline_ms=60000.0)
-        for q in qps_points:
-            rec = run_point(endpoint, kind, feed_name, shape, dtype,
-                            target_qps=q, duration=duration,
-                            req_batch=args.req_batch,
-                            deadline_ms=args.deadline_ms)
-            stats = boot.stats()["stats"]["models"].get(kind, {})
-            rec.update({
-                "model": kind,
-                "buckets": buckets,
-                "batch_fill": stats.get("batch_fill"),
-                "bucket_fill_ratio": stats.get("bucket_fill_ratio"),
-                "shed_total": stats.get("shed"),
-                "chaos_proxy": bool(proxy),
-                "chaos_slow_ms": args.chaos_slow_ms,
-            })
-            if backend_label:
-                rec["backend"] = backend_label
-            print(json.dumps(rec), flush=True)
-    finally:
-        set_dispatch_delay(0.0)
-        if proxy is not None:
-            proxy.stop()
-        server.shutdown(drain=True)
+    for replica_spec in _parse_replica_sweep(args.replicas):
+        server = InferenceServer(
+            max_queue=args.max_queue,
+            deadline_ms=args.deadline_batch_ms,
+            buckets=buckets).start()
+        endpoint = server.endpoint
+        proxy = None
+        if args.chaos_proxy:
+            from tools.chaos import FlakyProxy
+            proxy = FlakyProxy(server.endpoint, drop_first=1).start()
+            endpoint = proxy.endpoint
+        if args.chaos_slow_ms:
+            set_dispatch_delay(args.chaos_slow_ms / 1000.0)
+
+        try:
+            boot = ServingClient(endpoint)
+            loaded = boot.load_model(kind, model_dir, buckets=buckets,
+                                     replicas=replica_spec)
+            n_replicas = int(loaded.get("replicas", 1))
+            devices = loaded.get("devices", [])
+            # one warm request outside the timed window
+            warm = np.zeros((1,) + shape, dtype=dtype)
+            boot.infer(kind, {feed_name: warm}, deadline_ms=60000.0)
+            # routing must be invisible in the bits (acceptance
+            # criterion) — checked before the dispatch-cost chaos is on
+            bit_exact = _verify_bit_exact(
+                endpoint, kind, model_dir, buckets, feed_name, shape,
+                dtype)
+            if args.dispatch_cost_ms:
+                set_dispatch_delay(args.dispatch_cost_ms / 1000.0)
+            for q in qps_points:
+                rec = run_point(endpoint, kind, feed_name, shape, dtype,
+                                target_qps=q, duration=duration,
+                                req_batch=args.req_batch,
+                                deadline_ms=args.deadline_ms)
+                stats = boot.stats()["stats"]["models"].get(kind, {})
+                rec.update({
+                    "model": kind,
+                    "buckets": buckets,
+                    "replicas": n_replicas,
+                    "devices": devices,
+                    "bit_exact": bool(bit_exact),
+                    "batch_fill": stats.get("batch_fill"),
+                    "bucket_fill_ratio": stats.get("bucket_fill_ratio"),
+                    "shed_total": stats.get("shed"),
+                    "replica_stats": stats.get("replicas"),
+                    "dispatch_cost_ms": args.dispatch_cost_ms,
+                    "chaos_proxy": bool(proxy),
+                    "chaos_slow_ms": args.chaos_slow_ms,
+                })
+                if backend_label:
+                    rec["backend"] = backend_label
+                print(json.dumps(rec), flush=True)
+        finally:
+            set_dispatch_delay(0.0)
+            if proxy is not None:
+                proxy.stop()
+            server.shutdown(drain=True)
 
 
 if __name__ == "__main__":

@@ -75,6 +75,28 @@ CONFIGS = [
     ("serving_resnet_b128",
      ["@serving", "--model", "resnet", "--qps", "400,1600",
       "--duration", "20"], 128, 4),
+    # multi-chip serving lanes (SERVING.md "Multi-chip serving"): same
+    # model, same offered load, 1 vs 4 device-placed replicas behind
+    # the least-loaded router. On CPU the 4 "chips" are forced XLA host
+    # devices and --dispatch_cost_ms stands in for per-batch device
+    # time (deterministic, GIL-released — the same stand-in discipline
+    # as the pipeline lanes' --host_stall_ms), so the r1 -> r4
+    # achieved-QPS ratio IS the router/lane-parallelism number; on real
+    # silicon the replicas land on actual chips and the cost stand-in
+    # still bounds the routing overhead measurement. bucket=1 keeps
+    # coalescing out of the comparison (bench the lanes, not the
+    # batcher). Each record carries bit_exact: replica routing must
+    # not change one reply bit vs direct Predictor.run.
+    ("serving_mc_r1",
+     ["@serving", "--model", "fc", "--replicas", "1",
+      "--force_host_devices", "4", "--dispatch_cost_ms", "20",
+      "--qps", "250", "--duration", "8", "--deadline_ms", "4000",
+      "--max_queue", "32"], 1, 1),
+    ("serving_mc_r4",
+     ["@serving", "--model", "fc", "--replicas", "4",
+      "--force_host_devices", "4", "--dispatch_cost_ms", "20",
+      "--qps", "250", "--duration", "8", "--deadline_ms", "4000",
+      "--max_queue", "32"], 1, 1),
     # async-training-pipeline A/B (PIPELINE.md): same model, same
     # 40 ms/batch host stall (deterministic stand-in for host-side
     # preprocessing — the host-BOUND lane), prefetch + in-flight

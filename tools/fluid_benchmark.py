@@ -308,12 +308,16 @@ def main():
             for _ in range(total_batches):
                 yield make_batch()
 
-        # same device_put discipline as --staged_feed: the PE commits
-        # its own sharded transfer, so prefetch only stages host-side
+        # single-device path: prefetch stages host prep + device_put.
+        # ParallelExecutor path (sharded prefetch, PIPELINE.md): the
+        # prefetch thread ALSO commits the mesh-sharded global array
+        # (make_array_from_process_local_data), so the PE's dispatch
+        # sees pre-sharded feeds and pays no per-step shard commit
         feeds_it = reader_mod.prefetch_to_device(
             batch_source, args.prefetch_depth,
             prepare=lambda d: _prep(main_prog, d,
-                                    device_put=(pe is None)))()
+                                    device_put=(pe is None)),
+            mesh=(pe.mesh if pe is not None else None))()
 
     pending = []
     examples = 0

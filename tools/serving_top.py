@@ -2,9 +2,11 @@
 
 Connects to an InferenceServer endpoint, issues the `stats` RPC, and
 prints a per-model table (QPS, latency percentiles, batch fill, queue
-depth, sheds) — the operator's glance at whether the batch buckets and
-admission limits fit the traffic.  `--json` dumps the raw snapshot for
-scripts.
+depth, sheds) plus one sub-row per replica execution lane (device id,
+in-flight batches, lane queue depth, batches/rows executed) — the
+operator's glance at whether the batch buckets and admission limits fit
+the traffic and whether load is skewing across the device-placed
+replicas.  `--json` dumps the raw snapshot for scripts.
 
 Usage: python tools/serving_top.py HOST:PORT [--json]
 """
@@ -52,8 +54,22 @@ def render(reply):
                _fmt(round(100.0 * m.get("bucket_fill_ratio", 0.0), 1)),
                _fmt(m.get("queue_depth")), _fmt(m.get("shed"))))
         if d.get("buckets"):
-            lines.append("    buckets=%s versions=%s"
-                         % (d["buckets"], d.get("versions")))
+            lines.append("    buckets=%s versions=%s replicas=%s"
+                         % (d["buckets"], d.get("versions"),
+                            d.get("replicas", 1)))
+        shed_pri = m.get("shed_by_priority")
+        if shed_pri:
+            lines.append("    shed_by_priority=%s" % (shed_pri,))
+        for r in m.get("replicas") or []:
+            # one sub-row per replica lane: load skew across devices
+            # must be visible at a glance
+            lines.append(
+                "    r%-3s %-10s %9s %9s %10s %12s"
+                % (r.get("replica"), r.get("device"),
+                   "inflt=%s" % _fmt(r.get("inflight")),
+                   "queue=%s" % _fmt(r.get("queue")),
+                   "batches=%s" % _fmt(r.get("batches")),
+                   "rows=%s" % _fmt(r.get("rows"))))
     return "\n".join(lines)
 
 

@@ -145,6 +145,15 @@ def main():
         # before the remat flagship profile (riskiest compile last)
         ("serving", ["tools/bench_serving.py", "--require_tpu"], {},
          1800),
+        # multi-chip serving (SERVING.md "Multi-chip serving"): one
+        # replica per local chip behind the least-loaded router vs the
+        # single-replica baseline — the replica-scaling curve on real
+        # silicon (the CPU curve lives in the bench_zoo serving_mc_r1/
+        # serving_mc_r4 lanes and BENCH_r07.json)
+        ("serving_mc", ["tools/bench_serving.py", "--require_tpu",
+                        "--replicas", "1,auto", "--model", "resnet",
+                        "--qps", "200,800", "--duration", "15"], {},
+         3600),
         ("convergence", ["tools/convergence_run.py", "--require_tpu"],
          {}, 3600),
         ("tune_bottleneck", ["tools/tune_bottleneck.py", "--require_tpu"],

@@ -24,6 +24,7 @@ _warnings.filterwarnings(
     "ignore",
     message=r"Explicitly requested dtype .*int64.* is not available")
 
+from . import compile_cache  # noqa: F401,E402  (stdlib-only at import)
 from . import fluid  # noqa: F401,E402
 from . import reader  # noqa: F401,E402
 from . import dataset  # noqa: F401,E402

@@ -339,6 +339,40 @@ DEFINE_int(
     "pressure), the admission queue fills, and submits shed with "
     "ServerOverloaded — overload still sheds at the front instead of "
     "queueing unboundedly behind slow replicas.")
+DEFINE_bool(
+    "compile_cache", True,
+    "Persistent compile/artifact cache (COMPILE_CACHE.md): Predictor "
+    "AOT bucket compiles are keyed by a content fingerprint (program "
+    "hash, feed/state shapes+dtypes, device kind, jax+lib versions) and "
+    "their serialized jax.export executables committed to the on-disk "
+    "store with the checkpoint vault's write-temp->fsync->rename "
+    "discipline, so a later server boot or hot-swap flip of the same "
+    "(model, bucket, device-kind) deserializes instead of re-tracing "
+    "and re-compiling. jax's own persistent XLA-executable cache is "
+    "pointed at <store>/xla so the XLA compile is a disk hit too. "
+    "Corrupt/truncated entries are silently recompiled; disable to "
+    "force fresh compilation everywhere.")
+DEFINE_string(
+    "compile_cache_dir", "",
+    "Root directory of the persistent compile cache + kernel-tuning "
+    "registry; empty means $XDG_CACHE_HOME/paddle_tpu "
+    "(~/.cache/paddle_tpu). The store is cross-process shared: every "
+    "commit is atomic and readers verify CRC32s, so concurrent servers "
+    "and a killed writer cannot poison each other.")
+DEFINE_int(
+    "compile_cache_max_mb", 1024,
+    "Size cap (MiB) of the compile cache store; a put past the cap "
+    "evicts least-recently-used entries (manifest mtime, touched on "
+    "every hit) across both the AOT entries and jax's xla/ files. The "
+    "entry just written is never the victim.")
+DEFINE_bool(
+    "executor_compile_cache", False,
+    "Opt-in: Executor.run also consults the persistent compile cache "
+    "for INFERENCE-SHAPED programs (single block, no *_grad ops, no "
+    "optimizer ops, no host ops) whose fingerprint is derivable from "
+    "the Program serialization. Off by default: training steps donate "
+    "buffers and change shape rarely, so the win is serving-side; "
+    "enable for executor-driven batch inference over a fixed program.")
 DEFINE_int(
     "dist_threadpool_size", 0,
     "Reference distributed thread pool size. Advisory.")

@@ -54,7 +54,7 @@ __all__ = [
     "verify_checkpoint_dir", "read_manifest", "list_checkpoints",
     "latest_checkpoint", "rotate_checkpoints", "normalize_meta",
     "AsyncCheckpointSaver", "async_saver", "wait_for_async_saves",
-    "set_chaos_hook",
+    "set_chaos_hook", "atomic_write",
 ]
 
 MANIFEST_NAME = "__manifest__.json"
@@ -142,15 +142,28 @@ def _fsync_dir(path):
         os.close(fd)
 
 
-def _atomic_write(path, data, fsync=True):
-    """Write bytes to `path` via temp + fsync + rename."""
+def _atomic_write(path, data, fsync=True, chaos_point=None):
+    """Write bytes to `path` via temp + fsync + rename.  `chaos_point`
+    names an optional fault-injection point fired between the durable
+    temp write and the rename — a crash there must leave the previous
+    file intact plus a stale `.tmp.*`, never a truncated target (the
+    kill-mid-write scenarios in tools/chaos.py)."""
     tmp = "%s.tmp.%d.%x" % (path, os.getpid(), threading.get_ident())
     with open(tmp, "wb") as f:
         f.write(data)
         if fsync:
             f.flush()
             os.fsync(f.fileno())
+    if chaos_point:
+        _chaos(chaos_point)
     os.replace(tmp, path)
+    if fsync:
+        _fsync_dir(os.path.dirname(path) or ".")
+
+
+# the SHARED commit helper: compile_cache.py (AOT store + kernel-tuning
+# registry) and ops/attention_tuning.py ride the same discipline
+atomic_write = _atomic_write
 
 
 def checkpoint_dir_name(step):

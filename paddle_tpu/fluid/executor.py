@@ -289,6 +289,104 @@ class Executor:
             self._cache[key] = fn
         return fn
 
+    def _aot_cache_eligible(self, program):
+        """True when the program is inference-shaped — single block, no
+        *_grad ops, no optimizer ops (host ops are excluded by the
+        caller's branch) — so its executable is a pure function of the
+        Program content and safe to reuse from the persistent compile
+        cache (COMPILE_CACHE.md; gated by FLAGS.executor_compile_cache).
+        Memoized per (program identity, version)."""
+        key = ("aot_ok", id(program), program._version)
+        cached = self._host_op_cache.get(key)
+        if cached is None:
+            cached = len(program.blocks) == 1
+            if cached:
+                from ..ops.optimizer_ops import MERGEABLE_OPT_OPS
+                opt = frozenset(MERGEABLE_OPT_OPS)
+                for op in program.blocks[0].ops:
+                    if op.type.endswith("_grad") or op.type in opt:
+                        cached = False
+                        break
+            self._host_op_cache[key] = cached
+        return cached
+
+    def _get_aot_cached(self, program, feed_key, fetch_ext, persistables,
+                        state_in, feeds):
+        """Persistent-cache resolution for the jitted executor step:
+        fingerprint the Program content + feed/state specs, deserialize
+        a stored executable on a hit, export+commit on a miss.  Returns
+        the step fn or None (caller falls back to _get_jitted) — the
+        cache can only ever cost a recompile, never a failure."""
+        import time as _time
+        import jax
+        from jax import export as jax_export
+        from paddle_tpu import compile_cache as cc
+        from ..ops.registry import amp_enabled
+        if not cc.cache_enabled() or not self._aot_cache_eligible(program):
+            return None
+        dev = self._device()
+        if dev is not None and dev.platform != jax.default_backend():
+            return None
+        wga, remat = functionalizer.flags_ad_config()
+        sig = tuple((n, np.shape(v), str(np.asarray(v).dtype))
+                    for n, v in sorted(feeds.items()))
+        ssig = tuple((n, np.shape(v), str(v.dtype))
+                     for n, v in sorted(state_in.items()))
+        mkey = ("aotcc", id(program), program._version, sig, ssig,
+                fetch_ext, persistables, amp_enabled(), wga, remat)
+        fn = self._cache.get(mkey)
+        if fn is False:
+            return None
+        if fn is not None:
+            return fn
+        try:
+            fp = {
+                "kind": "executor_step",
+                "program": cc.program_fingerprint(program),
+                "feeds": [[n, list(s), d] for n, s, d in sig],
+                "state": [[n, list(s), d] for n, s, d in ssig],
+                "fetches": list(fetch_ext),
+                "persistables": list(persistables),
+                "amp": bool(amp_enabled()),
+                "wga": bool(wga),
+                "remat": remat or "",
+                "env": cc.environment_fingerprint(dev),
+            }
+            cache = cc.default_cache()
+            blob = cache.get(fp) if cache is not None else None
+            if blob is not None:
+                try:
+                    t0 = _time.monotonic()
+                    fn = jax.jit(jax_export.deserialize(blob).call)
+                    cc.note_deserialize_ms(
+                        (_time.monotonic() - t0) * 1000.0)
+                except Exception:
+                    blob = None
+            if blob is None:
+                t0 = _time.monotonic()
+                step_fn = functionalizer.build_step_fn(
+                    program, feed_key, fetch_ext, persistables,
+                    whole_graph_ad=wga, remat_policy=remat)
+                f_spec = {n: jax.ShapeDtypeStruct(np.shape(v),
+                                                  np.asarray(v).dtype)
+                          for n, v in feeds.items()}
+                s_spec = {n: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+                          for n, v in state_in.items()}
+                exp = jax_export.export(jax.jit(step_fn))(
+                    s_spec, f_spec,
+                    jax.ShapeDtypeStruct((), np.uint32))
+                cc.note_compile_ms((_time.monotonic() - t0) * 1000.0)
+                if cache is not None:
+                    cache.put(fp, exp.serialize())
+                fn = jax.jit(exp.call)
+        except Exception:
+            # ineligible in practice (host callback, exotic lowering):
+            # remember per signature and fall back silently
+            self._cache[mkey] = False
+            return None
+        self._cache[mkey] = fn
+        return fn
+
     def _host_ops_cached(self, program):
         """(contains_host_ops, has_subblock_host_ops) memoized per
         (program identity, version)."""
@@ -486,7 +584,16 @@ class Executor:
             fetches = [env.get(n) for n in fetch_ext]
             new_state = {n: env[n] for n in persistables if n in env}
         else:
-            fn = self._get_jitted(program, feed_key, fetch_ext, persistables)
+            fn = None
+            if FLAGS.executor_compile_cache:
+                # inference-side persistent compile cache (opt-in): a
+                # program whose fingerprint derives from its content
+                # rides a stored executable across processes
+                fn = self._get_aot_cached(program, feed_key, fetch_ext,
+                                          persistables, state_in, feeds)
+            if fn is None:
+                fn = self._get_jitted(program, feed_key, fetch_ext,
+                                      persistables)
             # in-flight mode: the dispatch is non-blocking by design and
             # the watchdog wraps the DRAIN (FetchFuture.result) instead
             # of forcing a block_until_ready inside every dispatch

@@ -25,6 +25,17 @@ __all__ = ["NativeConfig", "AnalysisConfig", "PaddleTensor", "Predictor",
            "load_aot_predictor"]
 
 
+# sentinel in the shared export map: this program cannot ride the
+# export/serialize path (host callbacks, exotic lowering) — every
+# replica falls back to direct compilation without retrying the export
+_UNEXPORTABLE = object()
+
+
+def _amp_enabled():
+    from paddle_tpu.ops.registry import amp_enabled
+    return bool(amp_enabled())
+
+
 def _var_is_batch_major(gb, name):
     """True when the program var's recorded shape leads with -1 — the
     marker save_aot already persists for AOT artifacts; the live
@@ -122,6 +133,15 @@ class Predictor:
         # concurrent dispatch lanes must neither double-compile one
         # bucket signature nor double-warn one overflow size
         self._lock = threading.Lock()
+        # (device_kind, sig) -> jitted exported call, SHARED BY REFERENCE
+        # across clone()/clone_to() replicas: N replicas of the same
+        # device kind deserialize/export one executable, not N
+        # (COMPILE_CACHE.md). _UNEXPORTABLE marks programs the export
+        # path cannot serve (fall back to lower().compile() once, not
+        # once per replica).
+        self._shared_exports = {}
+        self._shared_lock = threading.Lock()
+        self._program_fp = None  # lazy sha256 of the transpiled program
         # batch-major markers from the program vars (-1 leading dim),
         # the same ground truth save_aot records in aot_meta.bin: only
         # these feeds get bucket-padded and only these fetches un-padded
@@ -133,9 +153,117 @@ class Predictor:
         self._overflow_warned = set()
 
     # ------------------------------------------------------------------
+    def _device_kind(self):
+        """Executable-compatibility label of this replica's target: two
+        replicas with the same kind can share one AOT executable."""
+        import jax
+        d = self._device
+        if d is None:
+            devs = jax.devices()
+            d = devs[0] if devs else None
+        return "%s/%s" % (getattr(d, "platform", "cpu"),
+                          getattr(d, "device_kind", ""))
+
+    def _build_fwd(self, feed_names):
+        from paddle_tpu.fluid import functionalizer
+        step_fn = functionalizer.build_step_fn(
+            self._program, tuple(feed_names),
+            tuple(self._fetch_names), ())
+
+        def fwd(state, feed_dict):
+            fetches, _ = step_fn(state, feed_dict, np.uint32(0))
+            return fetches
+
+        return fwd
+
+    def _aot_fingerprint(self, feeds):
+        from paddle_tpu import compile_cache as cc
+        if self._program_fp is None:
+            self._program_fp = cc.program_fingerprint(self._program)
+        return {
+            "kind": "predictor_aot",
+            "program": self._program_fp,
+            "feeds": cc._spec_sig(feeds),
+            "fetches": list(self._fetch_names),
+            "state": cc._spec_sig(self._state),
+            "amp": _amp_enabled(),
+            "env": cc.environment_fingerprint(self._device),
+        }
+
+    def _get_aot_fn(self, sig, feeds):
+        """Cached-executable resolution for the AnalysisConfig AOT path
+        (called under self._lock).  Order: in-process shared map (one
+        deserialize per device kind across all replica clones) -> the
+        persistent store (hit: deserialize, no trace/lower) -> fresh
+        export (miss: trace+lower once, serialize, commit).  Any failure
+        returns None and the caller falls back to the legacy
+        lower().compile() — the cache can only ever cost a recompile."""
+        import time as _time
+        import jax
+        from paddle_tpu import compile_cache as cc
+        if not cc.cache_enabled():
+            return None
+        if self._device is not None and \
+                self._device.platform != jax.default_backend():
+            # cross-platform pinning (e.g. a cpu replica on a tpu host):
+            # trace-time kernel dispatch follows the default backend, so
+            # an export here could embed the wrong lowering — keep the
+            # legacy per-device compile for this exotic case
+            return None
+        skey = (self._device_kind(), sig)
+        with self._shared_lock:
+            ent = self._shared_exports.get(skey)
+        if ent is _UNEXPORTABLE:
+            return None
+        if ent is not None:
+            return ent
+        from jax import export as jax_export
+        cache = cc.default_cache()
+        fn = None
+        try:
+            fp = self._aot_fingerprint(feeds)
+            blob = cache.get(fp) if cache is not None else None
+            if blob is not None:
+                try:
+                    t0 = _time.monotonic()
+                    exp = jax_export.deserialize(blob)
+                    fn = jax.jit(exp.call)
+                    cc.note_deserialize_ms(
+                        (_time.monotonic() - t0) * 1000.0)
+                except Exception:
+                    blob = None  # truncated/alien entry: recompile
+            if fn is None:
+                t0 = _time.monotonic()
+                fwd = self._build_fwd(sorted(feeds))
+                state_spec = {
+                    n: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+                    for n, v in self._state.items()}
+                feeds_spec = {
+                    n: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+                    for n, v in feeds.items()}
+                exp = jax_export.export(jax.jit(fwd))(state_spec,
+                                                      feeds_spec)
+                cc.note_compile_ms((_time.monotonic() - t0) * 1000.0)
+                if cache is not None:
+                    cache.put(fp, exp.serialize())
+                fn = jax.jit(exp.call)
+        except Exception as e:
+            with self._shared_lock:
+                already = self._shared_exports.get(skey)
+                self._shared_exports[skey] = _UNEXPORTABLE
+            if already is not _UNEXPORTABLE:
+                warnings.warn(
+                    "compile cache disabled for this program (export "
+                    "failed: %s: %s) — falling back to direct "
+                    "compilation" % (type(e).__name__, e),
+                    RuntimeWarning, stacklevel=3)
+            return None
+        with self._shared_lock:
+            self._shared_exports[skey] = fn
+        return fn
+
     def _get_compiled(self, feeds):
         import jax
-        from paddle_tpu.fluid import functionalizer
         sig = tuple((n, feeds[n].shape, str(feeds[n].dtype))
                     for n in sorted(feeds))
         fn = self._compiled.get(sig)
@@ -149,22 +277,17 @@ class Predictor:
             fn = self._compiled.get(sig)
             if fn is not None:
                 return fn
-            step_fn = functionalizer.build_step_fn(
-                self._program, tuple(sorted(feeds)),
-                tuple(self._fetch_names), ())
-
-            def fwd(state, feed_dict):
-                fetches, _ = step_fn(state, feed_dict, np.uint32(0))
-                return fetches
-
-            jitted = jax.jit(fwd)
-            if isinstance(self._config, AnalysisConfig) and \
-                    self._config.aot_compile:
-                # AOT: lower+compile now so first Run has no compile
-                # stall (the TRT build-engine-at-init analogue); with
-                # `self._state` committed to this replica's device, the
-                # executable compiles for that device
-                jitted = jitted.lower(self._state, feeds).compile()
+            aot = isinstance(self._config, AnalysisConfig) and \
+                self._config.aot_compile
+            jitted = self._get_aot_fn(sig, feeds) if aot else None
+            if jitted is None:
+                jitted = jax.jit(self._build_fwd(sorted(feeds)))
+                if aot:
+                    # AOT: lower+compile now so first Run has no compile
+                    # stall (the TRT build-engine-at-init analogue); with
+                    # `self._state` committed to this replica's device,
+                    # the executable compiles for that device
+                    jitted = jitted.lower(self._state, feeds).compile()
             self._compiled[sig] = jitted
             return jitted
 
@@ -282,6 +405,11 @@ class Predictor:
         p._device = self._device
         p._compiled = {}
         p._lock = threading.Lock()
+        # shared BY REFERENCE: replicas of the same device kind reuse
+        # one exported executable instead of re-tracing per clone
+        p._shared_exports = self._shared_exports
+        p._shared_lock = self._shared_lock
+        p._program_fp = self._program_fp
         p._batched_feed = dict(self._batched_feed)
         p._fetch_batched = list(self._fetch_batched)
         p._overflow_warned = set()
@@ -466,6 +594,15 @@ class AotPredictor:
         import os
         from jax import export as jax_export
         from paddle_tpu.native import wire
+        from paddle_tpu import compile_cache as cc
+
+        if cc.cache_enabled():
+            # the artifact IS a pre-serialized AOT cache; flipping the
+            # store on points jax's persistent XLA cache at it, so even
+            # the first .call per bucket skips the XLA compile on a
+            # warm boot (counted as artifact_loads, not hits — the
+            # hit/miss ratio stays about the fingerprint store)
+            cc.default_cache()
 
         with open(os.path.join(dirname, "aot_meta.bin"), "rb") as f:
             meta = wire.decode(f.read())
@@ -481,6 +618,7 @@ class AotPredictor:
             with open(os.path.join(dirname, fname), "rb") as f:
                 self._fns[int(bs)] = jax_export.deserialize(
                     f.read()).call
+        cc.note_artifact_load(len(self._fns))
         self._device = device
         if device is not None:
             import jax

@@ -16,15 +16,25 @@ Precedence (deterministic, trace-time):
   3. the tune-cache entry for (seq_len, head_dim, causal, dtype)
   4. the MXU-aligned heuristic default
 
-The cache is one JSON file (``FLAGS.attention_tune_cache``; empty means
-<repo>/tools/attention_tune_cache.json). Entries are keyed by
-``S{seq}_D{head_dim}_c{0|1}_{dtype}`` and invalidated by file mtime, so a
-fresh ``--tune`` run takes effect without a process restart.
+Storage rides the repo-wide kernel-tuning registry
+(paddle_tpu/compile_cache.py, namespace ``flash_attention`` under
+``FLAGS.compile_cache_dir``/tuning/) — the same atomic
+write-temp→fsync→rename commit discipline and FLAGS-configurable store
+as the AOT compile cache, invalidated by file mtime so a fresh
+``--tune`` run takes effect without a process restart.  A nonzero
+``FLAGS.attention_tune_cache`` (or an explicit ``record(path=...)``)
+keeps the legacy single-JSON behavior for that path — the expert/test
+override; otherwise the legacy default JSON
+(<repo>/tools/attention_tune_cache.json) remains a READ-ONLY fallback
+so pre-registry tune files keep working.  Entries are keyed by
+``S{seq}_D{head_dim}_c{0|1}_{dtype}``.
 """
 
 import json
 import os
 import threading
+
+TUNING_NAMESPACE = "flash_attention"
 
 __all__ = ["AttentionConfig", "get_config", "default_config", "lookup",
            "record", "cache_path", "config_key", "attention_vmem_bytes",
@@ -114,10 +124,14 @@ def _load(path):
     return entries
 
 
-def lookup(seq_len, head_dim, causal, dtype):
-    """Tune-cache entry for the shape, or None on a miss."""
-    entries = _load(cache_path())
-    rec = entries.get(config_key(seq_len, head_dim, causal, dtype))
+def _legacy_override():
+    """Nonzero FLAGS.attention_tune_cache pins the legacy single-JSON
+    path exclusively (expert/test override)."""
+    from ..flags import FLAGS
+    return bool(FLAGS.attention_tune_cache)
+
+
+def _to_config(rec):
     if not isinstance(rec, dict):
         return None
     try:
@@ -128,20 +142,48 @@ def lookup(seq_len, head_dim, causal, dtype):
         return None
 
 
+def lookup(seq_len, head_dim, causal, dtype):
+    """Tune-cache entry for the shape, or None on a miss.  Resolution:
+    the legacy path exclusively when FLAGS.attention_tune_cache is set;
+    otherwise the kernel-tuning registry first, then the legacy default
+    JSON as a read-only fallback."""
+    key = config_key(seq_len, head_dim, causal, dtype)
+    if _legacy_override():
+        return _to_config(_load(cache_path()).get(key))
+    from .. import compile_cache as cc
+    cfg = _to_config(cc.tuning_lookup(TUNING_NAMESPACE, key))
+    if cfg is not None:
+        return cfg
+    return _to_config(_load(cache_path()).get(key))
+
+
 def record(seq_len, head_dim, causal, dtype, config, extra=None,
            path=None):
-    """Persist a tuned config (read-modify-write; bench_attention --tune)."""
-    path = path or cache_path()
-    entries = dict(_load(path))
+    """Persist a tuned config (read-modify-write; bench_attention --tune).
+
+    Default: one record committed to the repo-wide kernel-tuning
+    registry (namespace ``flash_attention``).  With an explicit `path`
+    or FLAGS.attention_tune_cache set, the legacy single-JSON file is
+    written instead — atomically, via the shared write-temp→fsync→rename
+    helper: a tuner killed mid-record leaves the previous file intact
+    plus a stale tmp, never a truncated JSON that poisons later traces."""
     rec = config.asdict()
     if extra:
         rec.update(extra)
-    entries[config_key(seq_len, head_dim, causal, dtype)] = rec
+    key = config_key(seq_len, head_dim, causal, dtype)
+    if path is None and not _legacy_override():
+        from .. import compile_cache as cc
+        return cc.tuning_record(TUNING_NAMESPACE, key, rec)
+    path = path or cache_path()
+    entries = dict(_load(path))
+    entries[key] = rec
     d = os.path.dirname(path)
     if d and not os.path.isdir(d):
         os.makedirs(d)
-    with open(path, "w") as f:
-        json.dump(entries, f, indent=2, sort_keys=True)
+    from ..fluid import checkpoint
+    checkpoint.atomic_write(
+        path, json.dumps(entries, indent=2, sort_keys=True).encode(),
+        chaos_point="tuning_tmp_written")
     with _memo_lock:
         _memo.pop(path, None)
     return path

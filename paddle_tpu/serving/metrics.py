@@ -125,6 +125,12 @@ class ModelMetrics:
         self.padded_slots = Counter()    # pad rows added to reach bucket
         self.latency_ms = ReservoirHistogram()
         self.queue_wait_ms = ReservoirHistogram()
+        # persistent-compile-cache telemetry for THIS model's loads /
+        # hot swaps (the registry attributes the process-global
+        # compile_cache counter delta of each build+warm here)
+        self.compile_cache_hits = Counter()
+        self.compile_cache_misses = Counter()
+        self.compile_ms = Counter()
         self.queue_depth_fn = None
         # installed by the batcher: live per-replica lane snapshot
         # (device id, in-flight, lane queue, batches/rows executed)
@@ -154,6 +160,13 @@ class ModelMetrics:
             horizon = now - self.QPS_WINDOW_SECS
             while self._completions and self._completions[0] < horizon:
                 self._completions.popleft()
+
+    def note_compile(self, delta):
+        """Attribute one load/hot-swap's compile-cache counter delta
+        (compile_cache.stats_delta) to this model."""
+        self.compile_cache_hits.add(int(delta.get("hits", 0)))
+        self.compile_cache_misses.add(int(delta.get("misses", 0)))
+        self.compile_ms.add(int(round(delta.get("compile_ms", 0.0))))
 
     def note_dispatch(self, n_requests, real_rows, padded_rows):
         self.dispatches.add()
@@ -200,6 +213,13 @@ class ModelMetrics:
             if (slots + padded) else 0.0,
             "latency_ms": self.latency_ms.summary(),
             "queue_wait_ms": self.queue_wait_ms.summary(),
+            # did this model's boots/flips reuse stored executables or
+            # pay fresh compiles? (serving_top's CCH/CCM column)
+            "compile_cache": {
+                "hits": self.compile_cache_hits.value,
+                "misses": self.compile_cache_misses.value,
+                "compile_ms": self.compile_ms.value,
+            },
         }
         if self.queue_depth_fn is not None:
             try:
@@ -243,7 +263,15 @@ class ServingMetrics:
     def snapshot(self):
         with self._lock:
             models = dict(self._models)
-        return {
+        out = {
             "uptime_sec": round(time.monotonic() - self._started, 3),
             "models": {name: m.snapshot() for name, m in models.items()},
         }
+        try:
+            # process-wide store counters (hits/misses/compile_ms/...):
+            # the cold-start-vs-warm-boot story at a glance in `stats`
+            from .. import compile_cache
+            out["compile_cache"] = compile_cache.stats()
+        except Exception:
+            pass
+        return out

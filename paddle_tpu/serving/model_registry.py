@@ -145,6 +145,10 @@ class ModelEntry:
         self.batcher = batcher
         self.replicas = list(replicas) if replicas else [predictor]
         self.devices = list(devices) if devices else [None]
+        # what THIS build+warm cost against the persistent compile
+        # cache (compile_cache.stats_delta, set by load_model): a warm
+        # flip shows misses == 0 — zero fresh compilations
+        self.compile_cache = {}
 
     def device_labels(self):
         from ..inference.predictor import _device_label
@@ -199,9 +203,11 @@ class ModelRegistry:
         warmed before the flip; the displaced latest version's replica
         set, if any, is drained and retired AFTER the flip — in-flight
         requests on it complete."""
+        from .. import compile_cache
         spec = devices if devices is not None else (
             replicas if replicas is not None else self._replicas)
         placement = resolve_placement(spec)
+        cc_before = compile_cache.stats()
         preds = _build_replicas(path, buckets, placement)
         batcher = DynamicBatcher(
             preds[0], max_queue=self._max_queue,
@@ -215,6 +221,11 @@ class ModelRegistry:
             except BaseException:
                 batcher.close(drain=False, timeout=1.0)
                 raise
+        # build+warm covered every (bucket, replica) executable — the
+        # counter delta is exactly what this load/flip cost against the
+        # persistent compile cache (load_model reply + metrics)
+        entry.compile_cache = compile_cache.stats_delta(cc_before)
+        self.metrics.model(name).note_compile(entry.compile_cache)
         displaced = None
         with self._lock:
             slot = self._models.setdefault(

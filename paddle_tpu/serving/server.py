@@ -204,7 +204,10 @@ class InferenceServer:
                     "version": entry.version,
                     "buckets": list(entry.predictor.batch_buckets()),
                     "replicas": len(entry.replicas),
-                    "devices": entry.device_labels()}
+                    "devices": entry.device_labels(),
+                    # what THIS load/flip cost against the persistent
+                    # compile cache: a warm flip reads hits=N, misses=0
+                    "compile_cache": dict(entry.compile_cache)}
         if cmd == "unload_model":
             self.registry.unload_model(msg["name"])
             return {"ok": True}

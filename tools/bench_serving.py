@@ -12,7 +12,18 @@ compatible with the bench_zoo lane format:
   {"metric": "serving_qps", "model": ..., "target_qps": ...,
    "achieved_qps": ..., "p50_ms": ..., "p95_ms": ..., "p99_ms": ...,
    "shed_rate": ..., "batch_fill": ..., "bucket_fill_ratio": ...,
-   "errors": ..., "replicas": ..., "bit_exact": ..., "backend": ...}
+   "errors": ..., "replicas": ..., "bit_exact": ..., "backend": ...,
+   "cold_start_ms": ..., "swap_flip_ms": ..., "compile_cache": {...}}
+
+Compile-cache columns (COMPILE_CACHE.md): `cold_start_ms` is server
+start -> model loaded+warmed -> first reply; `swap_flip_ms` is a full
+hot-swap flip of the same model (build + warm every bucket on every
+replica, then the atomic latest flip). Run the tool twice with the same
+--compile_cache_dir to measure the before/after: the first run compiles
+and commits (cold), the second deserializes stored executables for
+every (model, bucket, device-kind) triple (warm — the BENCH_r08.json
+acceptance pair). --compile_cache off disables the cache entirely for
+a no-cache baseline.
 
 The server runs in-process (threads, same machine) on a model exported
 fresh: `--model fc` (tiny, the CPU/CI path), `--model mnist`, or
@@ -66,6 +77,17 @@ def build_model(kind, model_dir, seed=17):
         if kind == "fc":
             x = fluid.layers.data(name="x", shape=[16], dtype="float32")
             h = fluid.layers.fc(input=x, size=32, act="relu")
+            pred = fluid.layers.fc(input=h, size=10, act="softmax")
+            shape = (16,)
+        elif kind == "fc_deep":
+            # CPU-safe but compile-heavy: 8 hidden layers make the
+            # trace+lower+XLA share of a boot dominate the fixed costs,
+            # so the compile-cache cold/warm pair measures the cache,
+            # not the wire overhead (COMPILE_CACHE.md bench lane)
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            h = x
+            for _ in range(8):
+                h = fluid.layers.fc(input=h, size=128, act="relu")
             pred = fluid.layers.fc(input=h, size=10, act="softmax")
             shape = (16,)
         elif kind == "mnist":
@@ -216,7 +238,7 @@ def _verify_bit_exact(endpoint, model, model_dir, buckets, feed_name,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="fc",
-                    choices=["fc", "mnist", "resnet"])
+                    choices=["fc", "fc_deep", "mnist", "resnet"])
     ap.add_argument("--qps", default=None,
                     help="comma-separated target-QPS sweep "
                          "(default 50,200; smoke default 100)")
@@ -249,6 +271,14 @@ def main():
                          "worker (GIL released): the stand-in for "
                          "per-batch device time that makes the replica-"
                          "scaling ratio measurable on a 1-core host")
+    ap.add_argument("--compile_cache_dir", default=None,
+                    help="persistent compile-cache store root "
+                         "(FLAGS.compile_cache_dir); point two runs at "
+                         "the same dir for the cold/warm pair")
+    ap.add_argument("--compile_cache", choices=["on", "off"],
+                    default="on",
+                    help="'off' disables the persistent compile cache "
+                         "(the no-cache baseline)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fc model, short sweep (CI path)")
     ap.add_argument("--require_tpu", action="store_true")
@@ -278,6 +308,13 @@ def main():
         smoke=args.smoke, require_tpu=args.require_tpu,
         tool="bench_serving")
 
+    from paddle_tpu.flags import set_flags
+    if args.compile_cache == "off":
+        set_flags({"compile_cache": False})
+    elif args.compile_cache_dir:
+        set_flags({"compile_cache": True,
+                   "compile_cache_dir": args.compile_cache_dir})
+
     kind = args.model
     qps_points = [float(q) for q in args.qps.split(",") if q] \
         if args.qps else [50.0, 200.0]
@@ -288,8 +325,10 @@ def main():
         # path end-to-end, never mistakable for a chip number.
         # Explicit --qps/--duration/--max_bucket survive (the
         # multi-chip lanes drive their own small sweeps through the
-        # smoke path)
-        kind = "fc"
+        # smoke path); fc_deep stays — it is the CPU-safe compile-heavy
+        # lane the compile-cache cold/warm pair is measured on
+        if kind != "fc_deep":
+            kind = "fc"
         if args.smoke and args.qps is None:
             qps_points = [100.0]
         if args.duration is None:
@@ -307,6 +346,7 @@ def main():
                                     set_dispatch_delay)
 
     for replica_spec in _parse_replica_sweep(args.replicas):
+        t_boot = time.monotonic()
         server = InferenceServer(
             max_queue=args.max_queue,
             deadline_ms=args.deadline_batch_ms,
@@ -326,9 +366,22 @@ def main():
                                      replicas=replica_spec)
             n_replicas = int(loaded.get("replicas", 1))
             devices = loaded.get("devices", [])
-            # one warm request outside the timed window
+            # first reply closes the cold-start window: server boot +
+            # load + every-bucket warm on every replica + one infer
             warm = np.zeros((1,) + shape, dtype=dtype)
             boot.infer(kind, {feed_name: warm}, deadline_ms=60000.0)
+            cold_start_ms = round(
+                (time.monotonic() - t_boot) * 1000.0, 1)
+            cold_cc = loaded.get("compile_cache", {})
+            # a full hot-swap flip of the same model: build + warm a
+            # new version of the whole replica set, atomic latest flip,
+            # drain the displaced set (the autoscaling-path number)
+            t_flip = time.monotonic()
+            flipped = boot.load_model(kind, model_dir, buckets=buckets,
+                                      replicas=replica_spec)
+            swap_flip_ms = round(
+                (time.monotonic() - t_flip) * 1000.0, 1)
+            flip_cc = flipped.get("compile_cache", {})
             # routing must be invisible in the bits (acceptance
             # criterion) — checked before the dispatch-cost chaos is on
             bit_exact = _verify_bit_exact(
@@ -348,6 +401,12 @@ def main():
                     "replicas": n_replicas,
                     "devices": devices,
                     "bit_exact": bool(bit_exact),
+                    "cold_start_ms": cold_start_ms,
+                    "swap_flip_ms": swap_flip_ms,
+                    "compile_cache": {"cold": cold_cc,
+                                      "flip": flip_cc,
+                                      "enabled":
+                                      args.compile_cache == "on"},
                     "batch_fill": stats.get("batch_fill"),
                     "bucket_fill_ratio": stats.get("bucket_fill_ratio"),
                     "shed_total": stats.get("shed"),

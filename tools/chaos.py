@@ -25,6 +25,13 @@ exits nonzero on failure):
                admission queue; prove overflow is shed with an explicit
                ServerOverloaded and EVERY request resolves — shed, not
                hang (SERVING.md overload semantics).
+  cache-commit kill -9 a child mid-commit of a persistent compile-cache
+               entry (COMPILE_CACHE.md): the first bucket's entry
+               commits cleanly, the second is interrupted at a named
+               commit point.  Prove the store is left with the clean
+               entry + only a stale _tmp dir, and that the next boot
+               serves correctly, recompiles ONLY the interrupted entry
+               (hit=1 miss=1), and sweeps the stale tmp.
 
   --smoke      crash-save (deterministic `exit` fault at every commit
                point) + bit-flip, fast enough for tier-1.
@@ -38,6 +45,7 @@ repro's proof of the same properties.
 """
 
 import argparse
+import json
 import os
 import signal
 import socket
@@ -53,6 +61,8 @@ if REPO not in sys.path:
 
 CHAOS_POINTS = ("array_written", "arrays_written", "manifest_written",
                 "committed", "latest_updated")
+# compile-cache store commit points (paddle_tpu/compile_cache.py)
+CACHE_POINTS = ("cc_exec_written", "cc_committed")
 
 
 # ---------------------------------------------------------------------------
@@ -291,6 +301,144 @@ def _verify_last_good(workdir, min_step=None, max_step=None):
         assert meta["step"] <= max_step, \
             "last-good step %d > committed %d" % (meta["step"], max_step)
     return meta
+
+
+# ---------------------------------------------------------------------------
+# the compile-cache child (subprocess target for cache-commit)
+# ---------------------------------------------------------------------------
+
+def _child_cache(store_dir):
+    """Compile-cache victim: a tiny fc Predictor with two batch buckets
+    whose executables commit to the store at `store_dir` one after the
+    other — the parent arms PADDLE_TPU_CHAOS with `@2` so commit #1 is
+    clean and commit #2 is interrupted at the named point."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import compile_cache as cc
+    from paddle_tpu.inference import AnalysisConfig, Predictor
+
+    fluid.set_flags({"compile_cache_dir": store_dir,
+                     "compile_cache": True})
+    md = os.path.join(store_dir, "model")
+    if not os.path.isdir(md):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=4, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            fluid.save_inference_model(md, ["x"], [pred], exe,
+                                       main_program=main)
+    cfg = AnalysisConfig(model_dir=md)
+    cfg.batch_size_buckets = (2, 4)
+    p = Predictor(cfg)
+    rng = np.random.RandomState(0)
+    for i, b in enumerate((2, 4)):
+        out, = p.run({"x": rng.randn(b, 8).astype(np.float32)})
+        print("COMMITTED %d sum=%.6f" % (i + 1, float(out.sum())),
+              flush=True)
+    print("STATS %s" % json.dumps(cc.stats()), flush=True)
+    print("DONE", flush=True)
+
+
+def _spawn_cache_child(store_dir, chaos_spec=None):
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_CHAOS", None)
+    if chaos_spec:
+        env["PADDLE_TPU_CHAOS"] = chaos_spec
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child-cache",
+         store_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+
+
+def scenario_cache_commit(workdir, point="cc_exec_written",
+                          real_kill=True, verbose=True):
+    """Kill a child mid-commit of compile-cache entry #2 at `point`,
+    then prove the store invariants: (1) the interrupted commit left
+    only a stale _tmp dir next to the intact entry #1, (2) a fresh boot
+    serves bit-identical replies, recompiles ONLY the interrupted entry
+    (hits=1, misses=1), and sweeps the stale tmp."""
+    import json as _json
+    from paddle_tpu import compile_cache as cc
+    store = os.path.join(workdir, "cc_store")
+    os.makedirs(store, exist_ok=True)
+    action = "pause:120" if real_kill else "exit"
+    spec = "%s=%s@2" % (point, action)
+    proc = _spawn_cache_child(store, chaos_spec=spec)
+    committed, sums = 0, []
+    try:
+        if real_kill:
+            for line in proc.stdout:
+                line = line.strip()
+                if line.startswith("COMMITTED"):
+                    committed = int(line.split()[1])
+                    sums.append(line.split("sum=")[1])
+                if line.startswith("CHAOS_PAUSE"):
+                    os.kill(proc.pid, signal.SIGKILL)
+                    break
+            proc.wait(timeout=30)
+        else:
+            out, _ = proc.communicate(timeout=240)
+            for line in out.splitlines():
+                if line.startswith("COMMITTED"):
+                    committed = int(line.split()[1])
+                    sums.append(line.split("sum=")[1])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert proc.returncode != 0, \
+        "child survived the kill (rc=0) — no fault injected"
+    assert committed == 1, \
+        "expected the crash during commit #2 (after 1 clean commit), " \
+        "child reported %d" % committed
+    store_cc = cc.CompileCache(root=store, xla_cache=False)
+    entries = store_cc.entries()
+    tmps = store_cc.stale_tmp_dirs()
+    committed_ok = point == "cc_committed"
+    want_entries = 2 if committed_ok else 1
+    assert len(entries) == want_entries, \
+        "store has %d committed entries after kill at %s, want %d" \
+        % (len(entries), point, want_entries)
+    assert committed_ok or len(tmps) >= 1, \
+        "no stale _tmp dir left by the interrupted commit"
+    bad = [k for k, err, _ in store_cc.verify() if err]
+    assert not bad, "kill corrupted committed entries: %s" % bad
+    # recovery boot: same store, no chaos — serves, recompiles only the
+    # interrupted entry, sweeps the tmp
+    proc2 = _spawn_cache_child(store)
+    out2, _ = proc2.communicate(timeout=240)
+    assert proc2.returncode == 0, out2[-2000:]
+    assert "DONE" in out2, out2[-2000:]
+    stats_line = [ln for ln in out2.splitlines()
+                  if ln.startswith("STATS ")]
+    st = _json.loads(stats_line[0][len("STATS "):])
+    want_miss = 0 if committed_ok else 1
+    assert st["hits"] == 2 - want_miss and st["misses"] == want_miss, \
+        "recovery boot should recompile only the interrupted entry " \
+        "(want hits=%d misses=%d), got %s" \
+        % (2 - want_miss, want_miss, st)
+    sums2 = [line.split("sum=")[1] for line in out2.splitlines()
+             if line.startswith("COMMITTED")]
+    assert sums and sums2[0] == sums[0], \
+        "recovery reply differs from pre-kill reply: %s vs %s" \
+        % (sums2[0], sums[0])
+    assert len(store_cc.entries()) == 2, "entry not recompiled"
+    assert not store_cc.stale_tmp_dirs(), \
+        "stale tmp dirs not swept on recovery: %s" \
+        % store_cc.stale_tmp_dirs()
+    bad = [k for k, err, _ in store_cc.verify() if err]
+    assert not bad, "recovered store fails verification: %s" % bad
+    if verbose:
+        print("PASS cache-commit point=%s kill=%s: 1 clean entry kept, "
+              "recovery hits=%d misses=%d, tmp swept, store verifies"
+              % (point, real_kill, st["hits"], st["misses"]))
+    return st
 
 
 # ---------------------------------------------------------------------------
@@ -567,17 +715,20 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", choices=["crash-save", "bit-flip",
                                            "nan-poison", "drop-rpc",
-                                           "serving-overload", "all"])
+                                           "serving-overload",
+                                           "cache-commit", "all"])
     ap.add_argument("--smoke", action="store_true",
                     help="fast deterministic subset for CI")
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--point", default="manifest_written",
-                    choices=CHAOS_POINTS)
+                    choices=CHAOS_POINTS + CACHE_POINTS)
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--no-real-kill", action="store_true",
                     help="child os._exit(137)s at the point instead of "
                          "being SIGKILLed while paused there")
     ap.add_argument("--child-train", metavar="DIR",
+                    help=argparse.SUPPRESS)  # internal subprocess target
+    ap.add_argument("--child-cache", metavar="DIR",
                     help=argparse.SUPPRESS)  # internal subprocess target
     ap.add_argument("--chaos-spec", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--chaos-at-save", type=int, default=0,
@@ -588,6 +739,9 @@ def main(argv=None):
         _child_train(args.child_train, args.steps, args.chaos_spec,
                      args.chaos_at_save)
         return 0
+    if args.child_cache:
+        _child_cache(args.child_cache)
+        return 0
 
     import tempfile
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_")
@@ -595,16 +749,24 @@ def main(argv=None):
         return run_smoke(workdir)
     if args.scenario in (None, "all"):
         scenarios = ["crash-save", "bit-flip", "nan-poison", "drop-rpc",
-                     "serving-overload"]
+                     "serving-overload", "cache-commit"]
     else:
         scenarios = [args.scenario]
     rc = 0
     for s in scenarios:
         try:
             if s == "crash-save":
+                point = args.point if args.point in CHAOS_POINTS \
+                    else "manifest_written"
                 scenario_crash_save(
-                    os.path.join(workdir, "crash"), point=args.point,
+                    os.path.join(workdir, "crash"), point=point,
                     real_kill=not args.no_real_kill, steps=args.steps)
+            elif s == "cache-commit":
+                point = args.point if args.point in CACHE_POINTS \
+                    else "cc_exec_written"
+                scenario_cache_commit(
+                    os.path.join(workdir, "cache"), point=point,
+                    real_kill=not args.no_real_kill)
             elif s == "bit-flip":
                 scenario_bit_flip(workdir)
             elif s == "nan-poison":

@@ -39,6 +39,7 @@ MODULES = [
     "paddle_tpu.reader",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.compile_cache",
     "paddle_tpu.v2.layer",
     "paddle_tpu.v2.networks",
     "paddle_tpu.v2.optimizer",

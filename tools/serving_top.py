@@ -36,23 +36,29 @@ def render(reply):
     desc = reply.get("models", {})
     lines = ["server uptime %.0fs, %d model(s)"
              % (stats.get("uptime_sec", 0.0), len(models)), ""]
-    hdr = ("%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s"
+    hdr = ("%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s"
            % ("MODEL", "VER", "QPS", "REQS", "p50ms", "p95ms", "p99ms",
-              "FILL", "BKT%", "QUEUE", "SHED"))
+              "FILL", "BKT%", "QUEUE", "SHED", "CCH/M"))
     lines.append(hdr)
     lines.append("-" * len(hdr))
     for name in sorted(models):
         m = models[name]
         lat = m.get("latency_ms", {})
         d = desc.get(name, {})
+        cc = m.get("compile_cache", {})
+        # compile-cache hits/misses across this model's loads + flips:
+        # "N/0" on a warm boot means zero fresh compilations
+        cc_col = "%s/%s" % (cc.get("hits", 0), cc.get("misses", 0)) \
+            if cc else "-"
         lines.append(
-            "%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s"
+            "%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s"
             % (name[:14], _fmt(d.get("latest")),
                _fmt(m.get("qps_recent")), _fmt(m.get("requests")),
                _fmt(lat.get("p50")), _fmt(lat.get("p95")),
                _fmt(lat.get("p99")), _fmt(m.get("batch_fill")),
                _fmt(round(100.0 * m.get("bucket_fill_ratio", 0.0), 1)),
-               _fmt(m.get("queue_depth")), _fmt(m.get("shed"))))
+               _fmt(m.get("queue_depth")), _fmt(m.get("shed")),
+               cc_col))
         if d.get("buckets"):
             lines.append("    buckets=%s versions=%s replicas=%s"
                          % (d["buckets"], d.get("versions"),

@@ -1,0 +1,105 @@
+"""Validate a persistent compile-cache store: manifests, CRCs, tuning.
+
+    python tools/verify_compile_cache.py [<dir>] [--quiet]
+
+<dir> is a store root (the directory FLAGS.compile_cache_dir names —
+containing aot/ and tuning/); omitted, the flag-configured default
+store is verified.  Exit codes: 0 verified, 1 usage / nothing to
+verify, 2 corruption detected (the message names the corrupt entry).
+
+This is the compile-cache twin of tools/verify_checkpoint.py — the same
+walk a Predictor's `get()` performs per entry (manifest parses, exec.bin
+CRC32 + size match, fingerprint hashes back to the entry's content
+address), runnable over the whole store without loading a model or
+touching a device.  Tuning registry JSONs are checked to parse; a
+corrupt one is reported (a live process would read it as empty).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _human(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return "%.1f %s" % (n, unit) if unit != "B" else "%d B" % n
+        n /= 1024.0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="verify a paddle_tpu compile-cache store")
+    ap.add_argument("dir", nargs="?", default=None,
+                    help="store root (default: FLAGS.compile_cache_dir "
+                         "resolution)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="no per-entry listing; exit code only")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import compile_cache as cc
+    root = os.path.abspath(args.dir) if args.dir else cc.cache_root()
+    aot = os.path.join(root, cc.AOT_SUBDIR)
+    tuning = os.path.join(root, cc.TUNING_SUBDIR)
+    if not os.path.isdir(aot) and not os.path.isdir(tuning):
+        print("verify_compile_cache: no store under %s" % root,
+              file=sys.stderr)
+        return 1
+
+    rc = 0
+    results = cc.verify_store(root)
+    n_bytes = 0
+    for key, err, manifest in results:
+        if err is not None:
+            print("verify_compile_cache: FAILED: entry %s: %s"
+                  % (key, err), file=sys.stderr)
+            rc = 2
+            continue
+        n_bytes += manifest["nbytes"]
+        if not args.quiet:
+            fp = manifest.get("fingerprint", {})
+            env = fp.get("env", {})
+            print("  %s  %-14s %-8s %-10s %s" % (
+                key[:16], fp.get("kind", "?"),
+                env.get("platform", "?"), _human(manifest["nbytes"]),
+                "jax=%s" % env.get("jax", "?")))
+
+    store = cc.CompileCache(root=root, xla_cache=False)
+    tmps = store.stale_tmp_dirs()
+    if tmps and not args.quiet:
+        print("  %d stale _tmp dir(s) (swept on next commit of the "
+              "same entry)" % len(tmps))
+
+    n_tune = 0
+    if os.path.isdir(tuning):
+        for name in sorted(os.listdir(tuning)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(tuning, name)
+            try:
+                with open(path) as f:
+                    raw = json.load(f)
+                configs = raw.get("configs", raw) \
+                    if isinstance(raw, dict) else {}
+                n_tune += len(configs)
+                if not args.quiet:
+                    print("  tuning/%s: %d config(s)"
+                          % (name, len(configs)))
+            except (OSError, ValueError) as e:
+                print("verify_compile_cache: FAILED: tuning/%s does "
+                      "not parse (%s)" % (name, e), file=sys.stderr)
+                rc = 2
+
+    if rc == 0 and not args.quiet:
+        print("OK (%d AOT entr%s, %s; %d tuning config%s)"
+              % (len(results), "y" if len(results) == 1 else "ies",
+                 _human(n_bytes), n_tune, "" if n_tune == 1 else "s"))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -373,6 +373,69 @@ DEFINE_bool(
     "the Program serialization. Off by default: training steps donate "
     "buffers and change shape rarely, so the win is serving-side; "
     "enable for executor-driven batch inference over a fixed program.")
+def _trace_changed(v):
+    from .obs import tracing
+    tracing.configure(enabled=v)
+
+
+def _trace_buffer_changed(v):
+    from .obs import tracing
+    tracing.configure(capacity=v)
+
+
+def _event_log_changed(v):
+    from .obs import events
+    events.configure(path=v)
+
+
+def _event_log_max_changed(v):
+    from .obs import events
+    events.configure(max_kb=v)
+
+
+# NOTE: companion flags (buffer size / rotation cap) are defined BEFORE
+# the flags whose on_change hooks read them, so an env override firing
+# mid-import finds them registered.
+DEFINE_int(
+    "trace_buffer_events", 4096,
+    "Capacity of the obs span ring buffer (paddle_tpu/obs/tracing.py): "
+    "completed spans land in a fixed-size ring; the oldest fall off "
+    "silently under load (the drop count rides the metrics surface). "
+    "Sized so the slowest recent requests/steps tools/trace_top.py "
+    "prints are always resolvable; memory cost is ~200 bytes/span.",
+    on_change=_trace_buffer_changed)
+DEFINE_float(
+    "trace_slow_ms", 0.0,
+    "Slow-request/step log gate: a serving request (root span) or train "
+    "step whose duration exceeds this many milliseconds is also emitted "
+    "as a 'slow' structured event (event log), carrying its trace_id / "
+    "step id so the outlier is findable after the span ring wrapped. "
+    "0 disables the slow log.")
+DEFINE_bool(
+    "trace", True,
+    "End-to-end span tracing (OBSERVABILITY.md): serving requests get "
+    "per-stage spans (admission, queue wait, coalesce, lane routing, "
+    "device compute, reply scatter) under a reply-visible trace_id; "
+    "training steps get prefetch_wait/dispatch/drain/ckpt spans. "
+    "Overhead is pinned <3% on the bench smoke lanes (BENCH_r09.json); "
+    "disable to make the tracer a no-op (spans, not metrics — counters "
+    "keep working).", on_change=_trace_changed)
+DEFINE_int(
+    "event_log_max_kb", 1024,
+    "Rotation threshold (KiB) of the structured event log file: past "
+    "this size the file is fsynced and atomically renamed to <path>.1 "
+    "(vault commit discipline — tools/chaos.py --scenario "
+    "trace-overflow kills a writer mid-rotation to prove the old log "
+    "survives intact).", on_change=_event_log_max_changed)
+DEFINE_string(
+    "event_log", "",
+    "Path of the append-only JSONL structured event log "
+    "(paddle_tpu/obs/events.py): discrete lifecycle events — hot-swap "
+    "flips, compile-cache deltas, sentinel skips/rollbacks, sheds with "
+    "priority, watchdog fires, checkpoint commits — each stamped with "
+    "trace/step ids so logs, metrics and traces cross-reference. "
+    "Empty (default) keeps events in the bounded in-memory ring only.",
+    on_change=_event_log_changed)
 DEFINE_int(
     "dist_threadpool_size", 0,
     "Reference distributed thread pool size. Advisory.")

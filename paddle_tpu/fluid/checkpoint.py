@@ -304,6 +304,12 @@ def save_checkpoint_dir(root, arrays, meta, max_num_checkpoints=None,
     _atomic_write(os.path.join(root, LATEST_NAME),
                   (checkpoint_dir_name(step) + "\n").encode(), fsync=fsync)
     _chaos("latest_updated")
+    # lifecycle record (OBSERVABILITY.md): the commit is durable and
+    # the `latest` pointer names it — stamped with the step id so the
+    # event log cross-references the train-side ckpt spans
+    from ..obs import events as _obs_events
+    _obs_events.emit("checkpoint_committed", step=int(step),
+                     epoch=meta.get("epoch"), path=final)
     if max_num_checkpoints:
         rotate_checkpoints(root, max_num_checkpoints)
     return final

@@ -168,15 +168,19 @@ class Trainer:
         restored (jax arrays are immutable, so the snapshot is free) and
         after K consecutive bad steps the policy escalates to a reload
         of the last-good checkpoint (or SentinelError)."""
+        from ...obs import tracing as obs_tracing
         if sentinel is None:
-            return self.exe.run(self.train_program, feed=feed,
-                                fetch_list=fetch)
+            with obs_tracing.trace("train/step", kind="train",
+                                   step=step_id):
+                return self.exe.run(self.train_program, feed=feed,
+                                    fetch_list=fetch)
         from .. import functionalizer, sentinel as sentinel_mod
         scope = global_scope()
         names = functionalizer.persistable_names(self.train_program)
         pre = {n: scope.get(n) for n in names if scope.has(n)}
-        metrics = self.exe.run(self.train_program, feed=feed,
-                               fetch_list=fetch)
+        with obs_tracing.trace("train/step", kind="train", step=step_id):
+            metrics = self.exe.run(self.train_program, feed=feed,
+                                   fetch_list=fetch)
         named = list(zip((getattr(f, "name", str(f)) for f in fetch),
                          metrics))
         if sentinel.check_params:
@@ -239,6 +243,7 @@ class Trainer:
         state the sentinel screens under check_params (at drain time
         the live scope already holds later in-flight steps' state, so
         screening it would attribute a later step's corruption here)."""
+        from ...obs import tracing as obs_tracing
         scope = global_scope()
         pre = post = None
         names = None
@@ -246,8 +251,10 @@ class Trainer:
             from .. import functionalizer
             names = functionalizer.persistable_names(self.train_program)
             pre = {n: scope.get(n) for n in names if scope.has(n)}
-        future = self.exe.run(self.train_program, feed=feed,
-                              fetch_list=fetch, as_future=True)
+        with obs_tracing.trace("train/dispatch", kind="train",
+                               step=step_id):
+            future = self.exe.run(self.train_program, feed=feed,
+                                  fetch_list=fetch, as_future=True)
         if sentinel is not None:
             post = {n: scope.get(n) for n in names if scope.has(n)}
         return _PendingStep(epoch_id, step_id, feed, fetch, future,
@@ -277,7 +284,8 @@ class Trainer:
         sentinel screen that dispatch deferred."""
         from .. import sentinel as sentinel_mod
         ent = pending.popleft()
-        metrics = ent.future.result(watchdog_scale=len(pending) + 2)
+        metrics = ent.future.result(watchdog_scale=len(pending) + 2,
+                                    step=ent.step)
         if sentinel is None:
             return ent, metrics
         scope = global_scope()
@@ -450,9 +458,15 @@ class Trainer:
 
     def _save_checkpoint(self, epoch_id, step_id, epoch_step=0):
         cfg = self.checkpoint_cfg
-        fluid_io.save_checkpoint(
-            self.exe, cfg.checkpoint_dir,
-            main_program=self.train_program,
-            step=step_id, epoch=epoch_id, epoch_step=epoch_step,
-            max_num_checkpoints=cfg.max_num_checkpoints,
-            async_save=cfg.async_save)
+        from ...obs import tracing as obs_tracing
+        # the ckpt ms of the per-step breakdown: what the train loop
+        # actually pays at the sync boundary (async_save hides the
+        # commit itself; the vault emits its own committed event)
+        with obs_tracing.trace("train/ckpt", kind="train", step=step_id,
+                               epoch=epoch_id):
+            fluid_io.save_checkpoint(
+                self.exe, cfg.checkpoint_dir,
+                main_program=self.train_program,
+                step=step_id, epoch=epoch_id, epoch_step=epoch_step,
+                max_num_checkpoints=cfg.max_num_checkpoints,
+                async_save=cfg.async_save)

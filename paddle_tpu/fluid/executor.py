@@ -56,6 +56,9 @@ def _watchdog_call(call, timeout, what="executor step"):
                          name="paddle-tpu-step-watchdog")
     t.start()
     if not done.wait(timeout):
+        from ..obs import events as _obs_events
+        _obs_events.emit("watchdog_fire", what=str(what),
+                         budget_s=round(float(timeout), 3))
         raise StepWatchdogTimeout(
             "%s still running after %.1fs (FLAGS.step_watchdog_secs) — "
             "backend wedged or step pathologically slow; the dispatch "

@@ -104,21 +104,28 @@ class FetchFuture:
             return [None if v is None else np.asarray(v) for v in vals]
         return list(self._fetches)
 
-    def result(self, watchdog_scale=1):
+    def result(self, watchdog_scale=1, step=None):
         """Resolve (host sync) and return the step's fetches.  This is
         the pipeline's ONLY mandatory host<->device synchronization
-        point; the watchdog — when enabled — wraps exactly this."""
+        point; the watchdog — when enabled — wraps exactly this, and the
+        obs `train/drain` span measures exactly this (the per-step drain
+        milliseconds of PIPELINE.md's breakdown).  `step` labels the
+        span with the dispatch-order step id when the caller knows it."""
         if self._value is not _UNSET:
             return self._value
         from ..flags import FLAGS
+        from ..obs import tracing as obs_tracing
         wd = FLAGS.step_watchdog_secs
-        if wd and wd > 0:
-            from .executor import _watchdog_call
-            self._value = _watchdog_call(
-                self._resolve, wd * max(int(watchdog_scale), 1),
-                self._what)
-        else:
-            self._value = self._resolve()
+        with obs_tracing.trace("train/drain", kind="train",
+                               **({} if step is None else
+                                  {"step": step})):
+            if wd and wd > 0:
+                from .executor import _watchdog_call
+                self._value = _watchdog_call(
+                    self._resolve, wd * max(int(watchdog_scale), 1),
+                    self._what)
+            else:
+                self._value = self._resolve()
         return self._value
 
 
@@ -154,7 +161,8 @@ class DispatchPipeline:
             return None
         future, meta = self._inflight.popleft()
         # the oldest of N queued steps may need N steps of wall clock
-        return future.result(watchdog_scale=len(self._inflight) + 1), meta
+        return future.result(watchdog_scale=len(self._inflight) + 1,
+                             step=meta.get("step")), meta
 
     def drain_all(self):
         """Flush the window: resolve everything in flight, oldest

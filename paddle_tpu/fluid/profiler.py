@@ -16,6 +16,7 @@ import glob
 import gzip
 import json
 import os
+import threading
 import time
 
 __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
@@ -24,6 +25,12 @@ __all__ = ["cuda_profiler", "reset_profiler", "profiler", "start_profiler",
 _trace_dir = None
 _tracing = False
 _host_events = {}    # name -> [calls, total_ms, min_ms, max_ms]
+# _host_events is mutated from every instrumented thread (batcher lanes,
+# prefetch workers, the train loop); the read-modify-write in _record is
+# NOT atomic under the GIL, so concurrent RecordEvents corrupted the
+# summary table before this lock existed (two threads could both see the
+# same e[0] and lose a call).  tests/test_profiler.py hammers this.
+_events_lock = threading.Lock()
 _enabled = False
 
 
@@ -36,18 +43,20 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 def reset_profiler():
     """reference profiler.py reset_profiler: clear collected events."""
-    _host_events.clear()
+    with _events_lock:
+        _host_events.clear()
 
 
 def _record(name, ms):
-    e = _host_events.get(name)
-    if e is None:
-        _host_events[name] = [1, ms, ms, ms]
-    else:
-        e[0] += 1
-        e[1] += ms
-        e[2] = min(e[2], ms)
-        e[3] = max(e[3], ms)
+    with _events_lock:
+        e = _host_events.get(name)
+        if e is None:
+            _host_events[name] = [1, ms, ms, ms]
+        else:
+            e[0] += 1
+            e[1] += ms
+            e[2] = min(e[2], ms)
+            e[3] = max(e[3], ms)
 
 
 def start_profiler(state="All", tracer_option=None, output_dir=None):
@@ -177,7 +186,9 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     if not _enabled:
         return _trace_dir
     _enabled = False
-    rows = [(n, e[0], e[1], e[2], e[3]) for n, e in _host_events.items()]
+    with _events_lock:
+        rows = [(n, e[0], e[1], e[2], e[3])
+                for n, e in _host_events.items()]
     if _trace_dir:
         rows += [(n, e[0], e[1], e[2], e[3])
                  for n, e in _device_events(_trace_dir).items()]
@@ -192,10 +203,16 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     return _trace_dir
 
 
-def export_chrome_tracing(trace_dir=None, output_path=None):
+def export_chrome_tracing(trace_dir=None, output_path=None,
+                          merge_obs=True):
     """tools/timeline.py:115 analogue: surface the captured trace as a
     chrome://tracing-loadable JSON file. jax already records chrome-trace
-    JSON inside the XPlane dump; this decompresses the newest one."""
+    JSON inside the XPlane dump; this decompresses the newest one and —
+    with ``merge_obs`` (default) — appends the obs tracing ring's spans
+    as their own process rows, so the host-side request/step stage spans
+    (OBSERVABILITY.md) line up against the XLA device timeline in one
+    view.  A trace whose JSON cannot be parsed is exported raw (the jax
+    bytes are never lost to the merge)."""
     trace_dir = trace_dir or _trace_dir
     if trace_dir is None:
         raise ValueError("no trace captured; run the profiler first")
@@ -204,8 +221,21 @@ def export_chrome_tracing(trace_dir=None, output_path=None):
     if not files:
         raise FileNotFoundError("no trace.json.gz under %s" % trace_dir)
     output_path = output_path or os.path.join(trace_dir, "timeline.json")
-    with gzip.open(files[-1], "rb") as src, open(output_path, "wb") as dst:
-        dst.write(src.read())
+    with gzip.open(files[-1], "rb") as src:
+        raw = src.read()
+    if merge_obs:
+        try:
+            from ..obs import tracing as obs_tracing
+            obs_spans = obs_tracing.recent_spans()
+            if obs_spans:
+                data = json.loads(raw)
+                events = data.setdefault("traceEvents", [])
+                events.extend(obs_tracing.chrome_events(obs_spans))
+                raw = json.dumps(data).encode()
+        except ValueError:
+            pass  # unparseable device trace: export the raw bytes
+    with open(output_path, "wb") as dst:
+        dst.write(raw)
     return output_path
 
 

@@ -98,6 +98,7 @@ class AnomalySentinel:
         must not loop forever).  `step` is the dispatch-order step id
         being screened (the async Trainer drains behind dispatch, so
         this lags the newest dispatched step by <= pipeline_depth)."""
+        from ..obs import events as obs_events
         self.steps_observed += 1
         if step is not None:
             self.last_step_observed = step
@@ -109,16 +110,29 @@ class AnomalySentinel:
         self.consecutive_bad += 1
         self.total_bad += 1
         if self.consecutive_bad < self.max_bad_steps:
+            # structured lifecycle record: skips/rollbacks stamped with
+            # the step id so the event log cross-references the train
+            # spans and the checkpoint commits (OBSERVABILITY.md)
+            obs_events.emit("sentinel_skip", step=step,
+                            bad=",".join(bad),
+                            consecutive=self.consecutive_bad)
             return SKIP
         if self.policy == "rollback":
             if self.total_rollbacks >= 1 and \
                     self.consecutive_bad >= 2 * self.max_bad_steps:
+                obs_events.emit("sentinel_giveup", step=step,
+                                bad=",".join(bad))
                 raise SentinelError(
                     "sentinel: still non-finite (%s) after a rollback to "
                     "the last-good checkpoint — giving up"
                     % ", ".join(bad))
             self.total_rollbacks += 1
+            obs_events.emit("sentinel_rollback", step=step,
+                            bad=",".join(bad),
+                            consecutive=self.consecutive_bad)
             return ROLLBACK
+        obs_events.emit("sentinel_giveup", step=step, bad=",".join(bad),
+                        consecutive=self.consecutive_bad)
         raise SentinelError(
             "sentinel: %d consecutive non-finite steps (%s) under policy "
             "'skip' with no rollback target — raising instead of "
@@ -139,4 +153,9 @@ class AnomalySentinel:
         self.total_discarded += count
         if count > self.max_observe_lag:
             self.max_observe_lag = count
+        if count:
+            from ..obs import events as obs_events
+            obs_events.emit("sentinel_discard", count=count,
+                            newest_step=newest_step,
+                            total=self.total_discarded)
         return self.total_discarded

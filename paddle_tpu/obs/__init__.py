@@ -1,0 +1,37 @@
+"""paddle_tpu.obs — end-to-end tracing + unified telemetry core.
+
+The observability seam shared by training and serving
+(OBSERVABILITY.md):
+
+* ``tracing`` — ``Span``/``trace()`` over a fixed ring buffer
+  (``FLAGS.trace_buffer_events``); serving requests carry a ``trace_id``
+  minted at admission, training spans carry ``step`` attrs;
+* ``events`` — append-only structured JSONL event log (hot-swap flips,
+  compile-cache deltas, sentinel skips/rollbacks, sheds, watchdog
+  fires) with vault-discipline rotation;
+* ``registry`` — ``MetricsRegistry``: one Prometheus-style exposition
+  absorbing ServingMetrics, training counters and span aggregates,
+  served by the ``metrics`` RPC verb and ``tools/metrics_dump.py``.
+
+Importing this package installs the default registry as the span
+ring's listener, so per-stage time aggregates accumulate from the very
+first instrumented span — training-only processes included (the
+registry itself is import-light; serving classes load lazily).
+"""
+
+from . import events, tracing  # noqa: F401
+from .tracing import (Span, new_trace_id, recent_spans,  # noqa: F401
+                      spans_for_trace, trace)
+from .events import emit, recent_events  # noqa: F401
+from . import registry  # noqa: F401
+from .registry import MetricsRegistry  # noqa: F401
+from .registry import default as default_registry  # noqa: F401
+
+__all__ = ["tracing", "events", "registry", "trace", "Span",
+           "new_trace_id", "recent_spans", "spans_for_trace", "emit",
+           "recent_events", "MetricsRegistry", "default_registry"]
+
+# wire the span listener now: aggregates must not depend on who asks
+# for the registry first (a training run before any server boot still
+# feeds paddle_tpu_span_ms_total)
+default_registry()

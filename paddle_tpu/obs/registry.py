@@ -1,0 +1,293 @@
+"""Unified metrics surface: one registry across training + serving.
+
+Before this module, telemetry was fragmented per subsystem: serving kept
+``ServingMetrics`` counters/histograms behind the ``stats`` RPC,
+training kept an unsynchronized profiler table, and nothing exported
+either in a form a scraper could ingest.  ``MetricsRegistry`` absorbs
+them all into ONE exposition:
+
+* its own counters / gauges / histograms (training-side code registers
+  here directly);
+* every attached ``ServingMetrics`` (the server attaches at start,
+  detaches at shutdown) — their ``snapshot()`` dicts are flattened into
+  labeled metric families at render time, so there is no double
+  bookkeeping and a hot swap keeps its no-counter-reset semantics;
+* span aggregates: the registry listens to the tracing ring
+  (tracing.set_span_listener) and keeps per-(kind, name) call counts and
+  total milliseconds — the per-step prefetch_wait / dispatch / drain /
+  ckpt breakdown and the per-stage serving totals fall out of the spans
+  already being recorded, no extra instrumentation;
+* event-log totals, compile-cache store counters, and the tracing
+  ring's own health (buffered/dropped).
+
+``prometheus_text()`` renders the whole thing Prometheus-style
+(``# TYPE`` headers, ``name{label="v"} value`` samples) — served by the
+new ``metrics`` RPC verb on the inference server and by
+``tools/metrics_dump.py``.
+"""
+
+import threading
+
+__all__ = ["MetricsRegistry", "default"]
+
+_PREFIX = "paddle_tpu_"
+
+# ServingMetrics snapshot ints rendered as labeled counters
+_SERVING_COUNTERS = ("requests", "responses", "errors", "shed",
+                     "deadline_expired", "dispatches")
+# ... and floats rendered as labeled gauges
+_SERVING_GAUGES = ("qps_recent", "qps_lifetime", "batch_fill",
+                   "bucket_fill_ratio", "queue_depth")
+_QUANTILES = ("p50", "p95", "p99")
+
+
+def _esc(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                 .replace("\n", "\\n")
+
+
+def _labels(d):
+    if not d:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, _esc(v))
+                             for k, v in sorted(d.items()))
+
+
+def _num(v):
+    if isinstance(v, float):
+        return repr(round(v, 6))
+    return str(v)
+
+
+class MetricsRegistry(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}    # (name, labels-tuple) -> Counter
+        self._gauges = {}      # name -> callable() -> value|dict|None
+        self._hists = {}       # (name, labels-tuple) -> ReservoirHistogram
+        self._serving = []     # attached ServingMetrics
+        self._span_agg = {}    # (kind, name) -> [count, total_ms]
+
+    # -- primitive instruments ---------------------------------------
+
+    @staticmethod
+    def _key(name, labels):
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def counter(self, name, labels=None):
+        # Counter/ReservoirHistogram live in serving.metrics but are
+        # stdlib-only; importing them lazily keeps `import
+        # paddle_tpu.obs` (and therefore every instrumented training
+        # module) from dragging the serving package in
+        from ..serving.metrics import Counter
+        key = self._key(name, labels)
+        with self._lock:
+            c = self._counters.get(key)
+            if c is None:
+                c = self._counters[key] = Counter()
+            return c
+
+    def gauge(self, name, fn):
+        """Register a live-read gauge: ``fn()`` -> number, or a dict of
+        labels-tuple-free {label_value: number} rendered with one
+        ``key`` label, or None to skip."""
+        with self._lock:
+            self._gauges[name] = fn
+
+    def histogram(self, name, labels=None):
+        from ..serving.metrics import ReservoirHistogram
+        key = self._key(name, labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = ReservoirHistogram()
+            return h
+
+    # -- absorbed sources --------------------------------------------
+
+    def attach_serving(self, serving_metrics):
+        with self._lock:
+            if serving_metrics not in self._serving:
+                self._serving.append(serving_metrics)
+
+    def detach_serving(self, serving_metrics):
+        with self._lock:
+            if serving_metrics in self._serving:
+                self._serving.remove(serving_metrics)
+
+    def note_span(self, span):
+        """Tracing-ring listener: fold one completed span into the
+        per-(kind, name) totals."""
+        key = (span.kind, span.name)
+        with self._lock:
+            rec = self._span_agg.get(key)
+            if rec is None:
+                self._span_agg[key] = [1, span.dur_ms]
+            else:
+                rec[0] += 1
+                rec[1] += span.dur_ms
+
+    def span_totals(self, kind=None):
+        """{(kind, name): {"count", "total_ms"}} — the per-stage time
+        budget (trace_top's aggregate view reads this via metrics)."""
+        with self._lock:
+            return {k: {"count": v[0], "total_ms": round(v[1], 3)}
+                    for k, v in self._span_agg.items()
+                    if kind is None or k[0] == kind}
+
+    # -- exposition ---------------------------------------------------
+
+    def _render_serving(self, lines):
+        snaps = []
+        with self._lock:
+            serving = list(self._serving)
+        for sm in serving:
+            try:
+                snaps.append(sm.snapshot())
+            except Exception:
+                continue
+        for field in _SERVING_COUNTERS:
+            mname = _PREFIX + "serving_%s_total" % field
+            samples = []
+            for snap in snaps:
+                for model, m in sorted(snap.get("models", {}).items()):
+                    if field in m:
+                        samples.append((mname, {"model": model},
+                                        m[field]))
+            _family(lines, mname, "counter", samples)
+        for field in _SERVING_GAUGES:
+            mname = _PREFIX + "serving_" + field
+            samples = []
+            for snap in snaps:
+                for model, m in sorted(snap.get("models", {}).items()):
+                    if field in m:
+                        samples.append((mname, {"model": model},
+                                        m[field]))
+            _family(lines, mname, "gauge", samples)
+        for hist_field in ("latency_ms", "queue_wait_ms"):
+            mname = _PREFIX + "serving_" + hist_field
+            samples = []
+            for snap in snaps:
+                for model, m in sorted(snap.get("models", {}).items()):
+                    h = m.get(hist_field) or {}
+                    for q in _QUANTILES:
+                        if h.get(q) is not None:
+                            samples.append((mname, {"model": model,
+                                                    "quantile": q},
+                                            h[q]))
+                    samples.append((mname + "_count", {"model": model},
+                                    h.get("count", 0)))
+            _family(lines, mname, "summary", samples)
+        # priority-shed + per-model compile-cache attribution
+        samples = []
+        for snap in snaps:
+            for model, m in sorted(snap.get("models", {}).items()):
+                for pri, n in sorted(
+                        (m.get("shed_by_priority") or {}).items()):
+                    samples.append((_PREFIX + "serving_shed_by_priority_"
+                                    "total",
+                                    {"model": model, "priority": pri}, n))
+        _family(lines, _PREFIX + "serving_shed_by_priority_total",
+                "counter", samples)
+        samples = []
+        for snap in snaps:
+            for model, m in sorted(snap.get("models", {}).items()):
+                cc = m.get("compile_cache") or {}
+                for f in ("hits", "misses"):
+                    samples.append((_PREFIX + "serving_compile_cache_%s_"
+                                    "total" % f,
+                                    {"model": model}, cc.get(f, 0)))
+        _family(lines, _PREFIX + "serving_compile_cache_total", "counter",
+                samples)
+
+    def prometheus_text(self):
+        """The one metrics surface, Prometheus text exposition."""
+        lines = []
+        # span aggregates: training per-stage breakdown + serving stages
+        with self._lock:
+            agg = sorted((k, list(v)) for k, v in self._span_agg.items())
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(self._hists.items())
+        _family(lines, _PREFIX + "span_ms_total", "counter",
+                [(_PREFIX + "span_ms_total",
+                  {"kind": k or "none", "span": n}, round(v[1], 3))
+                 for (k, n), v in agg])
+        _family(lines, _PREFIX + "span_count_total", "counter",
+                [(_PREFIX + "span_count_total",
+                  {"kind": k or "none", "span": n}, v[0])
+                 for (k, n), v in agg])
+        for (name, labels), c in counters:
+            _family(lines, _PREFIX + name, "counter",
+                    [(_PREFIX + name, dict(labels), c.value)])
+        for name, fn in gauges:
+            try:
+                v = fn()
+            except Exception:
+                continue
+            if v is None:
+                continue
+            if isinstance(v, dict):
+                _family(lines, _PREFIX + name, "gauge",
+                        [(_PREFIX + name, {"key": k}, x)
+                         for k, x in sorted(v.items())])
+            else:
+                _family(lines, _PREFIX + name, "gauge",
+                        [(_PREFIX + name, {}, v)])
+        for (name, labels), h in hists:
+            s = h.summary()
+            samples = [(_PREFIX + name + "_count", dict(labels),
+                        s.get("count", 0))]
+            for q in _QUANTILES:
+                if s.get(q) is not None:
+                    samples.append((_PREFIX + name,
+                                    dict(labels, quantile=q), s[q]))
+            _family(lines, _PREFIX + name, "summary", samples)
+        self._render_serving(lines)
+        # subsystem health: tracing ring, event log, compile-cache store
+        from . import events, tracing
+        ts = tracing.stats()
+        _family(lines, _PREFIX + "trace_spans_total", "counter",
+                [(_PREFIX + "trace_spans_total", {}, ts["spans_total"])])
+        _family(lines, _PREFIX + "trace_buffered", "gauge",
+                [(_PREFIX + "trace_buffered", {}, ts["buffered"])])
+        _family(lines, _PREFIX + "trace_dropped_total", "counter",
+                [(_PREFIX + "trace_dropped_total", {}, ts["dropped"])])
+        _family(lines, _PREFIX + "events_total", "counter",
+                [(_PREFIX + "events_total", {}, events.events_total())])
+        try:
+            from .. import compile_cache
+            cc = compile_cache.stats()
+            for k, v in sorted(cc.items()):
+                if isinstance(v, (int, float)):
+                    n = _PREFIX + "compile_cache_%s" % k
+                    _family(lines, n, "counter", [(n, {}, v)])
+        except Exception:
+            pass
+        return "\n".join(lines) + "\n"
+
+
+def _family(lines, name, mtype, samples):
+    if not samples:
+        return
+    lines.append("# TYPE %s %s" % (name, mtype))
+    for sname, labels, value in samples:
+        lines.append("%s%s %s" % (sname, _labels(labels), _num(value)))
+
+
+_default = None
+_default_lock = threading.Lock()
+
+
+def default():
+    """The process-wide registry; first use wires it as the tracing
+    ring's span listener so train/serving stage totals accumulate."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                reg = MetricsRegistry()
+                from . import tracing
+                tracing.set_span_listener(reg.note_span)
+                _default = reg
+    return _default

@@ -498,6 +498,13 @@ def prefetch_to_device(reader, depth=2, prepare=None, mesh=None):
                              name="paddle-tpu-prefetch")
         t.start()
         try:
+            import time as _time
+            from ..obs import tracing as _obs_tracing
+            # prefetch_wait: how long the train loop blocked on the
+            # queue per batch (0 when prefetch is hiding the host work
+            # — the per-step breakdown's first column, PIPELINE.md /
+            # OBSERVABILITY.md)
+            wait_t0 = _time.perf_counter()
             while True:
                 try:
                     item = q.get(timeout=1.0)
@@ -514,7 +521,14 @@ def prefetch_to_device(reader, depth=2, prepare=None, mesh=None):
                     raise ReaderWorkerFailed(
                         "prefetch_to_device worker failed mid-stream: %s"
                         % item.exc_repr, cause_repr=item.exc_repr)
+                if _obs_tracing.enabled():
+                    wait_ms = (_time.perf_counter() - wait_t0) * 1e3
+                    _obs_tracing.add_span(_obs_tracing.Span(
+                        "train/prefetch_wait", kind="train",
+                        ts=_time.time() - wait_ms / 1e3,
+                        dur_ms=wait_ms))
                 yield item
+                wait_t0 = _time.perf_counter()
         finally:
             stop.set()
             try:

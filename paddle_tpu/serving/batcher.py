@@ -64,6 +64,8 @@ from concurrent.futures import Future
 import numpy as np
 
 from ..flags import FLAGS
+from ..obs import events as obs_events
+from ..obs import tracing as obs_tracing
 
 __all__ = ["DynamicBatcher", "ServerOverloaded", "DeadlineExceeded",
            "BatcherClosed", "set_dispatch_delay"]
@@ -127,9 +129,11 @@ def _predictor_device_label(predictor):
 
 class _Request:
     __slots__ = ("feeds", "batch", "future", "group_key", "enqueued",
-                 "deadline", "priority")
+                 "deadline", "priority", "trace_id", "t_taken",
+                 "t_grouped")
 
-    def __init__(self, feeds, batch, group_key, deadline, priority):
+    def __init__(self, feeds, batch, group_key, deadline, priority,
+                 trace_id=None):
         self.feeds = feeds
         self.batch = batch
         self.group_key = group_key
@@ -137,6 +141,13 @@ class _Request:
         self.priority = priority
         self.future = Future()
         self.enqueued = time.monotonic()
+        # observability (OBSERVABILITY.md): the request's trace id plus
+        # the monotonic stamps the stage spans are cut from — contiguous
+        # by construction, so queue_wait + coalesce + lane_wait +
+        # dispatch + compute + scatter sums to the root span exactly
+        self.trace_id = trace_id or obs_tracing.new_trace_id()
+        self.t_taken = None     # router popped/pulled it off the queue
+        self.t_grouped = None   # its dispatch group closed coalescing
 
 
 class _Lane:
@@ -229,7 +240,11 @@ class DynamicBatcher:
     # submit side (admission control)
     # ------------------------------------------------------------------
 
-    def _build_request(self, feeds, deadline, priority):
+    @property
+    def _model_name(self):
+        return self.metrics.name if self.metrics is not None else None
+
+    def _build_request(self, feeds, deadline, priority, trace_id=None):
         named = {k: np.asarray(v) for k, v in feeds.items()}
         batch = None
         key_parts = []
@@ -256,17 +271,21 @@ class DynamicBatcher:
                 "(buckets %s) — split the request"
                 % (batch, self.max_batch, self.buckets or "(none)"))
         return _Request(named, batch, tuple(key_parts), deadline,
-                        int(priority))
+                        int(priority), trace_id=trace_id)
 
-    def submit(self, feeds, deadline=None, priority=0):
+    def submit(self, feeds, deadline=None, priority=0, trace_id=None):
         """Enqueue one request (dict name->array).  Returns a Future
         resolving to the fetch list (this request's rows only).
         `deadline` is an absolute time.monotonic() instant or None.
         `priority`: larger = more important; under overload the queue
         sheds lowest-priority-first.  Raises ServerOverloaded /
         BatcherClosed / ValueError synchronously — admission decisions
-        are immediate."""
-        req = self._build_request(feeds, deadline, priority)
+        are immediate.  `trace_id` carries a caller-minted id (the wire
+        `"trace_id"` field); one is minted here otherwise, and the
+        returned future exposes it (plus the server-measured stage
+        timings) as ``future.obs_info`` once resolved."""
+        req = self._build_request(feeds, deadline, priority,
+                                  trace_id=trace_id)
         evicted = None
         with self._cv:
             if self._closing:
@@ -284,6 +303,10 @@ class DynamicBatcher:
                 if victim is None:
                     if self.metrics is not None:
                         self.metrics.note_shed(priority=req.priority)
+                    obs_events.emit("shed", model=self._model_name,
+                                    priority=req.priority,
+                                    trace_id=req.trace_id,
+                                    queue=len(self._pending))
                     raise ServerOverloaded(
                         "request queue full (%d waiting, max_queue=%d) — "
                         "priority-%d request shed; back off and retry"
@@ -295,10 +318,20 @@ class DynamicBatcher:
             self._pending.append(req)
             if self.metrics is not None:
                 self.metrics.requests.add()
-            self._cv.notify()
+            # notify_all, not notify: the router AND the lane workers
+            # share this condition — a single notify could wake a lane
+            # worker (predicate false) and leave the router sleeping
+            # out its 0.1s poll, which the new queue_wait span exposed
+            # as a ~100ms floor on idle-server latency
+            self._cv.notify_all()
+        req.future.trace_id = req.trace_id
         if evicted is not None:
             if self.metrics is not None:
                 self.metrics.note_shed(priority=evicted.priority)
+            obs_events.emit("shed", model=self._model_name,
+                            priority=evicted.priority,
+                            trace_id=evicted.trace_id, evicted=True,
+                            by_priority=req.priority)
             if evicted.future.set_running_or_notify_cancel():
                 evicted.future.set_exception(ServerOverloaded(
                     "priority-%d request shed from a full queue by a "
@@ -342,6 +375,7 @@ class DynamicBatcher:
                     return None
                 self._cv.wait(0.1)
             head = self._pending.popleft()
+            head.t_taken = time.monotonic()
             group = [head]
             if head.batch is None:
                 # no batch-major feed: nothing to coalesce on
@@ -355,6 +389,7 @@ class DynamicBatcher:
                     if r.group_key == head.group_key and \
                             total + r.batch <= self.max_batch:
                         del self._pending[i]
+                        r.t_taken = time.monotonic()
                         group.append(r)
                         total += r.batch
                         took = True
@@ -397,6 +432,9 @@ class DynamicBatcher:
             group = self._take_group()
             if group is None:
                 return
+            t_grouped = time.monotonic()
+            for r in group:
+                r.t_grouped = t_grouped
             if not self._assign(group):
                 # hard stop with a group in hand: fail it explicitly
                 for r in group:
@@ -424,10 +462,49 @@ class DynamicBatcher:
                 merged[name] = arr  # group key proved byte-equality
         return merged
 
-    def _scatter(self, group, fetches, total):
+    def _emit_request_spans(self, r, lane, t_start, t_run, t_run_end,
+                            now, n_live, total):
+        """Land one request's stage span set in the tracing ring.  The
+        stamps are contiguous monotonic instants, so the stages tile the
+        root `serving/request` span exactly: a p99 outlier decomposes
+        into WHICH stage ate the time (OBSERVABILITY.md).  Wall-clock
+        `ts` per span is reconstructed from one time.time() anchor."""
+        wall_now = time.time()
+        model = self._model_name
+        tid = r.trace_id
+        t_taken = r.t_taken if r.t_taken is not None else t_start
+        t_grouped = r.t_grouped if r.t_grouped is not None else t_start
+
+        def _mk(name, t0, t1, **attrs):
+            if t1 < t0:
+                t1 = t0
+            a = {"model": model} if model else {}
+            a.update(attrs)
+            obs_tracing.add_span(obs_tracing.Span(
+                name, kind="serving", trace_id=tid,
+                ts=wall_now - (now - t0), dur_ms=(t1 - t0) * 1e3,
+                attrs=a))
+
+        _mk("serving/queue_wait", r.enqueued, t_taken)
+        _mk("serving/coalesce", t_taken, t_grouped)
+        _mk("serving/lane_wait", t_grouped, t_start, replica=lane.index)
+        _mk("serving/dispatch", t_start, t_run, replica=lane.index)
+        _mk("serving/compute", t_run, t_run_end, replica=lane.index,
+            rows=total, batch_fill=n_live)
+        _mk("serving/scatter", t_run_end, now)
+        _mk("serving/request", r.enqueued, now, replica=lane.index,
+            batch=r.batch or 0, batch_fill=n_live, priority=r.priority)
+
+    def _scatter(self, group, fetches, total, lane, t_start, t_run,
+                 t_run_end):
         flags = self._fetch_flags
         offset = 0
         now = time.monotonic()
+        traced = obs_tracing.enabled()
+        try:
+            slow_ms = float(FLAGS.trace_slow_ms)
+        except Exception:
+            slow_ms = 0.0
         for r in group:
             outs = []
             for i, a in enumerate(fetches):
@@ -440,14 +517,47 @@ class DynamicBatcher:
                 else:
                     outs.append(a)
             offset += r.batch or 0
+            total_ms = (now - r.enqueued) * 1000.0
+            queue_wait_ms = ((r.t_taken if r.t_taken is not None else now)
+                             - r.enqueued) * 1000.0
+            if traced:
+                self._emit_request_spans(r, lane, t_start, t_run,
+                                         t_run_end, now, len(group),
+                                         total)
+            if slow_ms and total_ms >= slow_ms:
+                # the slow-request log: findable after the ring wrapped
+                obs_events.emit("slow", model=self._model_name,
+                                trace_id=r.trace_id,
+                                total_ms=round(total_ms, 3),
+                                queue_wait_ms=round(queue_wait_ms, 3),
+                                compute_ms=round(
+                                    (t_run_end - t_run) * 1e3, 3))
             if not r.future.set_running_or_notify_cancel():
                 continue  # caller cancelled while queued
+            # server-measured latency attribution, readable by the
+            # caller (ServingClient debug replies) without server access
+            r.future.obs_info = {
+                "trace_id": r.trace_id,
+                "queue_wait_ms": round(queue_wait_ms, 3),
+                "coalesce_ms": round(
+                    ((r.t_grouped or now) -
+                     (r.t_taken if r.t_taken is not None else now))
+                    * 1e3, 3),
+                "lane_wait_ms": round(
+                    (t_start - (r.t_grouped or t_start)) * 1e3, 3),
+                "compute_ms": round((t_run_end - t_run) * 1e3, 3),
+                "server_ms": round(total_ms, 3),
+                "batch_fill": len(group),
+                "batch_rows": total,
+                "replica": lane.index,
+            }
             r.future.set_result(outs)
             if self.metrics is not None:
                 self.metrics.note_completion(
-                    latency_ms=(now - r.enqueued) * 1000.0)
+                    latency_ms=total_ms, queue_wait_ms=queue_wait_ms)
 
     def _dispatch(self, group, lane):
+        t_start = time.monotonic()
         delay = _chaos_delay()
         if delay:
             time.sleep(delay)
@@ -458,6 +568,11 @@ class DynamicBatcher:
                 if self.metrics is not None:
                     self.metrics.deadline_expired.add()
                     self.metrics.errors.add()
+                obs_events.emit("deadline_expired",
+                                model=self._model_name,
+                                trace_id=r.trace_id,
+                                waited_ms=round(
+                                    (now - r.enqueued) * 1000.0, 3))
                 if r.future.set_running_or_notify_cancel():
                     r.future.set_exception(DeadlineExceeded(
                         "deadline passed after %.1f ms in queue"
@@ -468,7 +583,9 @@ class DynamicBatcher:
             return
         feeds = self._merge_feeds(live)
         total = sum(r.batch or 0 for r in live)
+        t_run = time.monotonic()
         fetches = lane.predictor.run(feeds)
+        t_run_end = time.monotonic()
         with self._cv:
             lane.batches += 1
             lane.rows += total
@@ -477,7 +594,8 @@ class DynamicBatcher:
             self.metrics.note_dispatch(
                 n_requests=len(live), real_rows=total,
                 padded_rows=max(cap - total, 0))
-        self._scatter(live, fetches, total)
+        self._scatter(live, fetches, total, lane, t_start, t_run,
+                      t_run_end)
 
     def _worker(self, lane):
         while True:

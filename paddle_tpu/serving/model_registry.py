@@ -39,6 +39,7 @@ import threading
 import numpy as np
 
 from ..flags import FLAGS
+from ..obs import events as obs_events
 from .batcher import DynamicBatcher
 from .metrics import ServingMetrics
 
@@ -226,6 +227,12 @@ class ModelRegistry:
         # persistent compile cache (load_model reply + metrics)
         entry.compile_cache = compile_cache.stats_delta(cc_before)
         self.metrics.model(name).note_compile(entry.compile_cache)
+        # the compile-cache delta is a lifecycle fact worth keeping: a
+        # warm flip reads hits=N misses=0 in the event log forever,
+        # even after the stats counters blur across later loads
+        obs_events.emit("compile_cache_delta", model=name,
+                        hits=int(entry.compile_cache.get("hits", 0)),
+                        misses=int(entry.compile_cache.get("misses", 0)))
         displaced = None
         with self._lock:
             slot = self._models.setdefault(
@@ -239,8 +246,12 @@ class ModelRegistry:
             replaced_same = slot["versions"].get(version)
             slot["versions"][version] = entry
             slot["latest"] = version  # the atomic flip
+            flipped_from = old_latest
         # the new batcher owns the live replica/queue-depth hooks from
         # here on; the displaced set still drains below
+        obs_events.emit("hot_swap", model=name, version=version,
+                        from_version=flipped_from,
+                        replicas=len(entry.replicas))
         for old in (displaced, replaced_same):
             if old is not None and old is not entry:
                 old.batcher.close(drain=True, timeout=drain_timeout)
@@ -260,6 +271,7 @@ class ModelRegistry:
         for entry in slot["versions"].values():
             entry.batcher.close(drain=True, timeout=drain_timeout)
         self.metrics.drop(name)
+        obs_events.emit("model_unloaded", model=name)
 
     def model_names(self):
         with self._lock:
@@ -285,12 +297,13 @@ class ModelRegistry:
     # ------------------------------------------------------------------
 
     def submit(self, name, feeds, version=None, deadline=None,
-               priority=0):
+               priority=0, trace_id=None):
         """Route one request; returns the batcher Future.  Resolution
         and submit happen under ONE lock acquisition so a concurrent hot
         swap can never retire a version between the two (the no-dropped-
         request guarantee: the swap's drain only starts after the flip,
-        and every pre-flip submit is already queued)."""
+        and every pre-flip submit is already queued).  `trace_id` rides
+        through to the batcher's stage spans (OBSERVABILITY.md)."""
         with self._lock:
             slot = self._models.get(name)
             if slot is None:
@@ -300,7 +313,8 @@ class ModelRegistry:
             if entry is None:
                 raise KeyError("model %r has no version %r" % (name, v))
             return entry.batcher.submit(feeds, deadline=deadline,
-                                        priority=priority)
+                                        priority=priority,
+                                        trace_id=trace_id)
 
     def infer(self, name, feeds, version=None, deadline=None,
               timeout=None, priority=0):

@@ -42,6 +42,7 @@ import numpy as np
 from ..distributed.rpc import _recv_msg, _send_msg
 from ..flags import FLAGS
 from ..native.wire import WireError
+from ..obs import tracing as obs_tracing
 from .batcher import BatcherClosed, DeadlineExceeded, ServerOverloaded
 from .metrics import ServingMetrics
 from .model_registry import ModelRegistry
@@ -87,6 +88,12 @@ class InferenceServer:
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
         self.metrics = ServingMetrics()
+        # the unified telemetry surface (OBSERVABILITY.md): this
+        # server's counters join the process-wide MetricsRegistry the
+        # `metrics` RPC verb and tools/metrics_dump.py render
+        from ..obs import registry as obs_registry
+        self._obs_registry = obs_registry.default()
+        self._obs_registry.attach_serving(self.metrics)
         # `replicas`: default placement spec for every model this server
         # loads (int N / 'auto' / explicit device list — SERVING.md
         # multi-chip serving); a load_model RPC can override per model
@@ -175,6 +182,7 @@ class InferenceServer:
         self._draining = True
         self.registry.close_all(drain=drain, timeout=timeout)
         self._stopped = True
+        self._obs_registry.detach_serving(self.metrics)
         try:
             s = socket.create_connection(self._addr, timeout=1)
             s.close()
@@ -192,6 +200,22 @@ class InferenceServer:
         if cmd == "stats":
             return {"ok": True, "stats": self.metrics.snapshot(),
                     "models": self.registry.describe()}
+        if cmd == "metrics":
+            # Prometheus-style text across training + serving — ONE
+            # exposition (tools/metrics_dump.py renders it verbatim)
+            return {"ok": True,
+                    "text": self._obs_registry.prometheus_text()}
+        if cmd == "trace":
+            # span ring readout: a reply-visible trace_id resolves here
+            # to its stage span tree (tools/trace_top.py)
+            if msg.get("trace_id"):
+                spans = obs_tracing.spans_for_trace(msg["trace_id"])
+            else:
+                spans = obs_tracing.recent_spans(
+                    limit=int(msg.get("limit", 2048)),
+                    kind=msg.get("kind") or None)
+            return {"ok": True, "spans": spans,
+                    "tracing": obs_tracing.stats()}
         if cmd == "load_model":
             if self._draining:
                 raise BatcherClosed("server is draining")
@@ -228,27 +252,44 @@ class InferenceServer:
             raise ValueError("infer needs a non-empty feeds dict")
         if self._draining:
             raise ServerOverloaded("server is draining — request refused")
+        # trace id: carried in on the wire ("trace_id" field) or minted
+        # at admission; echoed in the reply either way, so the caller
+        # can resolve its latency into the span tree via the `trace`
+        # verb / tools/trace_top.py (OBSERVABILITY.md)
+        trace_id = str(msg.get("trace_id") or obs_tracing.new_trace_id())
         deadline_ms = msg.get("deadline_ms")
         deadline = None
         wait = 120.0  # never park a handler thread forever
         if deadline_ms is not None:
             deadline = time.monotonic() + float(deadline_ms) / 1000.0
             wait = float(deadline_ms) / 1000.0 + 5.0
-        future = self.registry.submit(name, feeds,
-                                      version=msg.get("version"),
-                                      deadline=deadline,
-                                      priority=int(msg.get("priority",
-                                                           0)))
-        try:
-            fetches = future.result(timeout=wait)
-        except DeadlineExceeded:
-            raise
-        except TimeoutError:
-            raise DeadlineExceeded(
-                "request did not complete within its %.0f ms deadline"
-                % (deadline_ms if deadline_ms is not None else wait * 1e3))
-        return {"ok": True,
-                "fetches": [np.ascontiguousarray(a) for a in fetches]}
+        with obs_tracing.trace("serving/rpc", kind="serving",
+                               trace_id=trace_id, model=name):
+            future = self.registry.submit(
+                name, feeds, version=msg.get("version"),
+                deadline=deadline,
+                priority=int(msg.get("priority", 0)),
+                trace_id=trace_id)
+            try:
+                fetches = future.result(timeout=wait)
+            except DeadlineExceeded:
+                raise
+            except TimeoutError:
+                raise DeadlineExceeded(
+                    "request did not complete within its %.0f ms "
+                    "deadline"
+                    % (deadline_ms if deadline_ms is not None
+                       else wait * 1e3))
+        reply = {"ok": True, "trace_id": trace_id,
+                 "fetches": [np.ascontiguousarray(a) for a in fetches]}
+        if msg.get("debug"):
+            # opt-in latency attribution: the server-measured stage
+            # timings ride back on the reply, so a client can see where
+            # its time went without server access (queue_wait vs
+            # compute vs batch_fill)
+            reply["debug"] = dict(getattr(future, "obs_info", None)
+                                  or {"trace_id": trace_id})
+        return reply
 
 
 class ServingClient:
@@ -264,6 +305,7 @@ class ServingClient:
     def __init__(self, endpoint, deadline_ms=None, retry_policy=None):
         self.endpoint = endpoint
         self.deadline_ms = deadline_ms
+        self.last_trace_id = None
         self._policy = retry_policy
         self._tls = threading.local()
 
@@ -318,7 +360,16 @@ class ServingClient:
             deadline=retry_deadline)
 
     def infer(self, model, feeds, deadline_ms=None, version=None,
-              retry_sheds=None, priority=None):
+              retry_sheds=None, priority=None, debug=False,
+              trace_id=None):
+        """Run one request.  Returns the fetch list; with
+        ``debug=True`` returns ``(fetches, info)`` where ``info`` is
+        the server-measured latency attribution (trace_id,
+        queue_wait_ms, compute_ms, batch_fill, replica ...) — the
+        client-side half of OBSERVABILITY.md's latency story.
+        ``trace_id`` pins a caller-minted id (propagated end to end and
+        echoed back); the reply's id is also kept on
+        ``self.last_trace_id`` for the plain return shape."""
         deadline_ms = self.deadline_ms if deadline_ms is None \
             else deadline_ms
         msg = {"cmd": "infer", "model": model,
@@ -330,6 +381,10 @@ class ServingClient:
             # forwarded to admission control: larger = more important;
             # under overload the server sheds lowest-priority-first
             msg["priority"] = int(priority)
+        if debug:
+            msg["debug"] = True
+        if trace_id is not None:
+            msg["trace_id"] = str(trace_id)
         retry_deadline = None
         retry_on = ()
         if deadline_ms is not None:
@@ -341,7 +396,11 @@ class ServingClient:
             raise ValueError("retry_sheds needs a deadline_ms to bound it")
         reply = self._call(msg, retry_deadline=retry_deadline,
                            retry_on=retry_on)
-        return list(reply["fetches"])
+        self.last_trace_id = reply.get("trace_id")
+        fetches = list(reply["fetches"])
+        if debug:
+            return fetches, dict(reply.get("debug") or {})
+        return fetches
 
     def load_model(self, name, path, version=None, buckets=None,
                    replicas=None, devices=None):
@@ -363,6 +422,20 @@ class ServingClient:
 
     def stats(self):
         return self._call({"cmd": "stats"})
+
+    def metrics_text(self):
+        """The server's unified Prometheus-style exposition."""
+        return self._call({"cmd": "metrics"})["text"]
+
+    def trace(self, trace_id=None, limit=2048, kind=None):
+        """Span-ring readout: all spans of one trace_id, or the most
+        recent `limit` (optionally filtered by kind)."""
+        msg = {"cmd": "trace", "limit": int(limit)}
+        if trace_id is not None:
+            msg["trace_id"] = str(trace_id)
+        if kind is not None:
+            msg["kind"] = str(kind)
+        return self._call(msg)
 
     def shutdown_server(self, drain=True):
         try:

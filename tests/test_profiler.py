@@ -67,3 +67,33 @@ def test_reset_profiler():
     # ensure the internal table is empty)
     from paddle_tpu.fluid.profiler import _host_events
     assert "r1" not in _host_events
+
+
+def test_concurrent_record_events_no_lost_updates():
+    """Thread-safety (OBSERVABILITY.md satellite): _host_events was
+    mutated without a lock, so concurrent batcher lanes / prefetch
+    threads could lose calls (two threads read the same count, both
+    write count+1).  The hammer makes that race near-certain without
+    the lock: every call must be counted exactly once."""
+    import threading
+    from paddle_tpu.fluid import profiler
+
+    profiler.reset_profiler()
+    n_threads, n_calls = 4, 400
+
+    def hammer():
+        for _ in range(n_calls):
+            profiler._record("hammered", 1.0)
+
+    threads = [threading.Thread(target=hammer)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    calls, total_ms, mn, mx = profiler._host_events["hammered"]
+    assert calls == n_threads * n_calls, \
+        "lost %d updates to the race" % (n_threads * n_calls - calls)
+    assert total_ms == float(n_threads * n_calls)
+    assert mn == mx == 1.0
+    profiler.reset_profiler()

@@ -30,7 +30,7 @@ def _stage_key(cmd, env_extra):
     if "bench_zoo" in joined:
         return "bench_zoo"
     for tool in ("bench_infer", "bench_serving", "convergence_run",
-                 "tune_bottleneck", "bench_attention"):
+                 "tune_bottleneck", "bench_attention", "trace_top"):
         if tool in joined:
             return tool
     return "bench.py"
@@ -144,6 +144,19 @@ def test_serving_stage_in_sweep_after_infer(monkeypatch, tmp_path):
     assert "bench_serving" in calls
     assert calls.index("bench_serving") > calls.index("bench_infer")
     assert calls.index("bench_serving") < calls.index("profile")
+
+
+def test_obs_capture_stage_in_sweep(monkeypatch, tmp_path):
+    """The obs stage (traced resnet serving run + traced train step,
+    merged chrome trace archived — OBSERVABILITY.md) rides the sweep
+    after serving_mc and its JSON summary lands in the record."""
+    calls, rec = _run(monkeypatch, tmp_path, {}, ["tpu"])
+    assert "trace_top" in calls
+    serving_calls = [i for i, c in enumerate(calls)
+                     if c == "bench_serving"]
+    assert calls.index("trace_top") > max(serving_calls)
+    assert calls.index("trace_top") < calls.index("profile")
+    assert "obs" in {r["sweep"] for r in rec}
 
 
 def test_flagship_flushed_before_zoo_runs(monkeypatch, tmp_path):

@@ -279,6 +279,11 @@ def main():
                     default="on",
                     help="'off' disables the persistent compile cache "
                          "(the no-cache baseline)")
+    ap.add_argument("--trace", choices=["on", "off"], default=None,
+                    help="force FLAGS.trace for the run — the tracing-"
+                         "overhead A/B pair (OBSERVABILITY.md pins "
+                         "<3%% throughput delta on this smoke lane, "
+                         "BENCH_r09.json)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fc model, short sweep (CI path)")
     ap.add_argument("--require_tpu", action="store_true")
@@ -308,12 +313,14 @@ def main():
         smoke=args.smoke, require_tpu=args.require_tpu,
         tool="bench_serving")
 
-    from paddle_tpu.flags import set_flags
+    from paddle_tpu.flags import FLAGS, set_flags
     if args.compile_cache == "off":
         set_flags({"compile_cache": False})
     elif args.compile_cache_dir:
         set_flags({"compile_cache": True,
                    "compile_cache_dir": args.compile_cache_dir})
+    if args.trace is not None:
+        set_flags({"trace": args.trace == "on"})
 
     kind = args.model
     qps_points = [float(q) for q in args.qps.split(",") if q] \
@@ -414,6 +421,7 @@ def main():
                     "dispatch_cost_ms": args.dispatch_cost_ms,
                     "chaos_proxy": bool(proxy),
                     "chaos_slow_ms": args.chaos_slow_ms,
+                    "trace": bool(FLAGS.trace),
                 })
                 if backend_label:
                     rec["backend"] = backend_label

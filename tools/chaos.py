@@ -690,6 +690,123 @@ def scenario_serving_overload(verbose=True):
     return outcomes
 
 
+def scenario_trace_overflow(workdir, verbose=True):
+    """Observability hot-path safety (OBSERVABILITY.md): the span ring
+    wraps under concurrent load and the event log rotates mid-write —
+    tracing must never block, never raise into the instrumented code,
+    and every log generation must stay valid JSONL.
+
+    Phase A — overflow: 4 threads hammer spans + events through a tiny
+    ring (64) and a ~2 KiB rotation threshold; asserts (1) zero emitter
+    exceptions, (2) the ring wrapped (dropped > 0) and holds exactly
+    its capacity, (3) every line of every log generation parses as
+    JSON, (4) at least one rotation happened, (5) no single emit took
+    >250 ms (the never-blocks bound, generous for CI).
+
+    Phase B — fault mid-rotation: the vault chaos hook raises at the
+    `obs_rotated` point (between the fsync and the atomic rename);
+    emitters must swallow it (warn-once, drop to memory-only), the
+    pre-rotation file must survive intact, and the memory ring must
+    keep recording."""
+    import glob
+    import json as _json
+    import warnings
+    from paddle_tpu.flags import set_flags, get_flags
+    from paddle_tpu.fluid.checkpoint import set_chaos_hook
+    from paddle_tpu.obs import events as obs_events
+    from paddle_tpu.obs import tracing as obs_tracing
+
+    os.makedirs(workdir, exist_ok=True)
+    log_path = os.path.join(workdir, "events.jsonl")
+    saved = get_flags(["trace", "trace_buffer_events", "event_log",
+                       "event_log_max_kb"])
+    errors = []
+    slow = [0.0]
+
+    def hammer(tid, n=400):
+        try:
+            for i in range(n):
+                t0 = time.time()
+                with obs_tracing.trace("chaos/span", kind="serving",
+                                       trace_id="t%d" % tid, i=i):
+                    pass
+                obs_events.emit("chaos", thread=tid, i=i)
+                dt = time.time() - t0
+                if dt > slow[0]:
+                    slow[0] = dt
+        except BaseException as e:   # emitters must never raise
+            errors.append(e)
+
+    try:
+        set_flags({"trace": True, "trace_buffer_events": 64,
+                   "event_log_max_kb": 2, "event_log": log_path})
+        obs_tracing.clear()
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), \
+            "emitter thread hung — tracing blocked the hot path"
+        assert not errors, "emitter raised: %r" % errors[0]
+        st = obs_tracing.stats()
+        assert st["buffered"] == 64, \
+            "ring holds %d spans, capacity 64" % st["buffered"]
+        assert st["dropped"] > 0, "ring never wrapped: %s" % st
+        assert slow[0] < 0.25, \
+            "an emit blocked for %.0f ms" % (slow[0] * 1e3)
+        obs_events.get_log().flush()
+        gens = sorted(glob.glob(log_path + "*"))
+        assert os.path.exists(log_path + ".1"), \
+            "no rotation happened: %s" % gens
+        n_lines = 0
+        for g in gens:
+            with open(g) as f:
+                for line in f:
+                    rec = _json.loads(line)   # raises = corrupt log
+                    assert rec.get("kind") == "chaos"
+                    n_lines += 1
+        assert n_lines > 0
+
+        # phase B: rotation faults mid-commit
+        fault_log = os.path.join(workdir, "fault.jsonl")
+        set_flags({"event_log": fault_log})
+
+        def _boom(point):
+            if point == "obs_rotated":
+                raise RuntimeError("chaos: fault mid-rotation")
+
+        set_chaos_hook(_boom)
+        before = obs_events.events_total()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for i in range(4000):   # enough to cross 2 KiB
+                obs_events.emit("chaos_b", i=i)
+        set_chaos_hook(None)
+        assert obs_events.events_total() - before == 4000, \
+            "events lost across the rotation fault"
+        assert any("memory-only" in str(w.message) for w in caught), \
+            "sink death was silent"
+        assert os.path.exists(fault_log), \
+            "pre-rotation log vanished (rotation not atomic)"
+        with open(fault_log) as f:
+            for line in f:
+                _json.loads(line)
+        assert obs_events.recent_events(1, kind="chaos_b"), \
+            "memory ring stopped recording after sink death"
+    finally:
+        set_chaos_hook(None)
+        set_flags(saved)
+    if verbose:
+        print("PASS trace-overflow: ring wrapped (%d dropped), %d "
+              "rotated JSONL lines valid, max emit %.1f ms, "
+              "mid-rotation fault absorbed memory-only"
+              % (st["dropped"], n_lines, slow[0] * 1e3))
+    return {"dropped": st["dropped"], "lines": n_lines,
+            "max_emit_ms": slow[0] * 1e3}
+
+
 def run_smoke(workdir):
     """Tier-1 smoke: deterministic crash at every commit point + the
     bit-flip rejection — no timing races, CPU-only, a few seconds."""
@@ -716,7 +833,8 @@ def main(argv=None):
     ap.add_argument("--scenario", choices=["crash-save", "bit-flip",
                                            "nan-poison", "drop-rpc",
                                            "serving-overload",
-                                           "cache-commit", "all"])
+                                           "cache-commit",
+                                           "trace-overflow", "all"])
     ap.add_argument("--smoke", action="store_true",
                     help="fast deterministic subset for CI")
     ap.add_argument("--workdir", default=None)
@@ -749,7 +867,8 @@ def main(argv=None):
         return run_smoke(workdir)
     if args.scenario in (None, "all"):
         scenarios = ["crash-save", "bit-flip", "nan-poison", "drop-rpc",
-                     "serving-overload", "cache-commit"]
+                     "serving-overload", "cache-commit",
+                     "trace-overflow"]
     else:
         scenarios = [args.scenario]
     rc = 0
@@ -775,6 +894,9 @@ def main(argv=None):
                 scenario_drop_rpc()
             elif s == "serving-overload":
                 scenario_serving_overload()
+            elif s == "trace-overflow":
+                scenario_trace_overflow(
+                    os.path.join(workdir, "trace_overflow"))
         except AssertionError as e:
             rc = 1
             print("FAIL %s: %s" % (s, e))

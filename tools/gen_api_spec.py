@@ -39,6 +39,10 @@ MODULES = [
     "paddle_tpu.reader",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.obs",
+    "paddle_tpu.obs.tracing",
+    "paddle_tpu.obs.events",
+    "paddle_tpu.obs.registry",
     "paddle_tpu.compile_cache",
     "paddle_tpu.v2.layer",
     "paddle_tpu.v2.networks",

@@ -1,0 +1,54 @@
+"""metrics_dump — the unified telemetry surface, one shot.
+
+Issues the `metrics` RPC verb against a running InferenceServer and
+prints the Prometheus-style text exposition the process-wide
+MetricsRegistry renders (OBSERVABILITY.md): serving counters/latency
+quantiles per model, training span totals (prefetch_wait / dispatch /
+drain / ckpt), compile-cache store counters, tracing-ring health, event
+counts — everything, one surface, scraper-ready.
+
+With no endpoint, dumps the CURRENT process's registry instead — the
+in-process mode training scripts and notebooks use
+(`python tools/metrics_dump.py --local` after an import that ran work
+makes no sense from a fresh CLI, but the flag keeps the code path one
+and the same for embedding).
+
+Usage: python tools/metrics_dump.py HOST:PORT
+       python tools/metrics_dump.py --local
+"""
+
+import argparse
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("endpoint", nargs="?", default=None,
+                    help="HOST:PORT of a running inference server")
+    ap.add_argument("--local", action="store_true",
+                    help="render THIS process's MetricsRegistry instead "
+                         "of calling a server")
+    args = ap.parse_args(argv)
+    if args.local or not args.endpoint:
+        if not args.local:
+            ap.error("need an endpoint (or --local)")
+        from paddle_tpu.obs import registry
+        print(registry.default().prometheus_text(), end="")
+        return 0
+    from paddle_tpu.serving import ServingClient
+    cli = ServingClient(args.endpoint)
+    try:
+        print(cli.metrics_text(), end="")
+    finally:
+        cli.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

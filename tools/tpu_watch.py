@@ -154,6 +154,14 @@ def main():
                         "--replicas", "1,auto", "--model", "resnet",
                         "--qps", "200,800", "--duration", "15"], {},
          3600),
+        # observability capture (OBSERVABILITY.md): one traced resnet
+        # serving run + one traced train step on silicon, archiving the
+        # MERGED chrome trace (obs stage spans + XLA device timeline)
+        # next to the bench records — the JSON line carries the archive
+        # path and the request/step stage breakdowns
+        ("obs", ["tools/trace_top.py", "--capture", "--model", "resnet",
+                 "--out_dir", os.path.join(args.results_dir,
+                                           "obs_trace_r09")], {}, 1800),
         ("convergence", ["tools/convergence_run.py", "--require_tpu"],
          {}, 3600),
         ("tune_bottleneck", ["tools/tune_bottleneck.py", "--require_tpu"],

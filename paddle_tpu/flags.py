@@ -339,6 +339,29 @@ DEFINE_int(
     "pressure), the admission queue fills, and submits shed with "
     "ServerOverloaded — overload still sheds at the front instead of "
     "queueing unboundedly behind slow replicas.")
+DEFINE_int(
+    "serving_decode_slots", 8,
+    "Slot-table size of each replica's decode lane (SERVING.md "
+    "continuous batching): the fixed-shape decode step XLA compiles "
+    "once runs over this many KV-cache slots per lane, so it is also "
+    "the per-replica cap on concurrently generating requests. A new "
+    "request joins the RUNNING decode batch the step after any slot "
+    "frees (EOS / max-tokens / deadline / disconnect) — no coalesce "
+    "window. Larger tables raise aggregate tokens/sec under load at "
+    "the cost of KV-cache HBM (slots x max_seq_len x layers).")
+DEFINE_int(
+    "serving_max_new_tokens", 128,
+    "Default generation budget per streaming request: a decode slot is "
+    "reclaimed after this many generated tokens when the request does "
+    "not set its own max_new_tokens (which is still clamped to this "
+    "server-side ceiling — one runaway prompt must not pin a slot "
+    "forever).")
+DEFINE_int(
+    "serving_stream_chunk_tokens", 1,
+    "Streaming reply granularity: the server flushes a token-delta "
+    "frame to the client every this many generated tokens (and always "
+    "at end of stream). 1 streams every token as it decodes; larger "
+    "values trade time-to-token for fewer wire frames.")
 DEFINE_bool(
     "compile_cache", True,
     "Persistent compile/artifact cache (COMPILE_CACHE.md): Predictor "

@@ -2,8 +2,14 @@ from .predictor import (
     NativeConfig, AnalysisConfig, PaddleTensor, Predictor,
     create_paddle_predictor, AotPredictor, load_aot_predictor,
 )
+from .decode import (
+    GenerativePredictor, DecodeSession, save_decode_model,
+    build_tiny_decode_model, load_decode_predictor, greedy_decode,
+)
 
 __all__ = [
     "NativeConfig", "AnalysisConfig", "PaddleTensor", "Predictor",
     "create_paddle_predictor", "AotPredictor", "load_aot_predictor",
+    "GenerativePredictor", "DecodeSession", "save_decode_model",
+    "build_tiny_decode_model", "load_decode_predictor", "greedy_decode",
 ]

@@ -1,0 +1,600 @@
+"""Autoregressive generation: decode artifacts, prefill/decode phase
+split, and the slot-table KV cache the serving layer batches over.
+
+The one-shot Predictor serves classifier-shaped programs: fixed-shape
+in, fixed-shape out, stateless between calls.  Generation breaks that
+contract — each request carries growing state (the KV cache) across
+many tiny steps, and the chip idles unless many requests decode
+TOGETHER.  This module is the inference-side half of the answer
+(SERVING.md "Continuous batching & streaming" is the serving half):
+
+* a **decode artifact** (`save_decode_model` / `build_tiny_decode_model`)
+  — a directory holding a causal-transformer LM's weights plus a meta
+  record (vocab, layers, heads, max_seq_len, eos id, prefill buckets)
+  in the typed wire format, detected by `decode_meta.bin` the way the
+  AOT predictor is detected by `aot_meta.bin`;
+* a **prefill / decode phase split** (`GenerativePredictor`): prefill
+  runs the whole prompt through the causal forward once per padded
+  *prompt bucket* (each bucket's executable rides the persistent
+  compile cache, COMPILE_CACHE.md, so a warm boot deserializes instead
+  of retracing), emitting the prompt's K/V and the first generated
+  token; decode is ONE fixed-shape step function over the WHOLE slot
+  table — XLA compiles it exactly once per (n_slots) geometry, and
+  every later step, whatever mix of requests occupies the slots, reuses
+  that executable;
+* a **slot-indexed KV cache** (`DecodeSession`): [layers, n_slots,
+  max_seq_len, heads, head_dim] arrays resident on the session's
+  device.  A request owns one slot from prefill to finish; freeing a
+  slot ZEROES its cache lines before reuse (no cross-request KV
+  leakage — pinned by tests/test_decode_serving.py), and the decode
+  step's cache writes are gated by the active mask so a dead slot
+  stays zero.  Per-slot math is independent by construction, which is
+  what makes batched decode bit-exact vs a single-request session:
+  requests joining or leaving the running batch cannot move another
+  request's tokens by one bit.
+
+Decode attention gathers K/V from the slot cache through the Pallas
+decode kernel (`ops/pallas_kernels.decode_attention` — block geometry
+from the shared kernel-tuning registry); sampling is greedy argmax
+(deterministic — the parity contract above is exact equality, not
+"close").
+"""
+
+import hashlib
+import json
+import os
+import threading
+import warnings
+
+import numpy as np
+
+__all__ = ["GenerativePredictor", "DecodeSession", "save_decode_model",
+           "build_tiny_decode_model", "load_decode_predictor",
+           "greedy_decode", "DECODE_META"]
+
+DECODE_META = "decode_meta.bin"
+_DECODE_STATE = "decode_state.bin"
+
+# shared-map sentinel, same contract as predictor._UNEXPORTABLE: this
+# function cannot ride the export/serialize path — every clone falls
+# back to direct jit without retrying the export
+_UNEXPORTABLE = object()
+
+
+def _default_prefill_buckets(max_seq_len):
+    """Powers of two up to max_seq_len (min 8): the prompt-length
+    buckets prefill compiles for.  Deterministic by prompt length, so
+    two decodes of the same prompt always ride the same executable —
+    the bit-exactness contract leans on this."""
+    buckets, b = [], 8
+    while b < max_seq_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(max_seq_len))
+    return buckets
+
+
+def save_decode_model(dirname, state, meta):
+    """Write a decode artifact: `meta` (vocab_size, d_model, n_heads,
+    n_layers, max_seq_len, eos_id, dtype, prefill_buckets) +  `state`
+    (the weight dict) in the typed wire format — no pickle, same
+    discipline as save_aot."""
+    from paddle_tpu.native import wire
+    os.makedirs(dirname, exist_ok=True)
+    meta = dict(meta)
+    meta.setdefault("arch", "causal_lm")
+    meta.setdefault("version", 1)
+    meta.setdefault("dtype", "float32")
+    meta.setdefault("prefill_buckets",
+                    _default_prefill_buckets(meta["max_seq_len"]))
+    with open(os.path.join(dirname, _DECODE_STATE), "wb") as f:
+        f.write(wire.encode({n: np.asarray(v) for n, v in state.items()}))
+    with open(os.path.join(dirname, DECODE_META), "wb") as f:
+        f.write(wire.encode(meta))
+    return dirname
+
+
+def build_tiny_decode_model(dirname, vocab_size=32, d_model=16,
+                            n_heads=2, n_layers=2, max_seq_len=64,
+                            eos_id=0, seed=7):
+    """Deterministic random-weight tiny causal LM — the CPU-smoke /
+    test fixture (the decode analogue of bench_serving's `fc` model).
+    Same seed -> bit-identical artifact."""
+    if d_model % n_heads:
+        raise ValueError("d_model %d not divisible by n_heads %d"
+                         % (d_model, n_heads))
+    rng = np.random.RandomState(seed)
+    scale = 1.0 / np.sqrt(d_model)
+
+    def w(*shape):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    state = {"embed": w(vocab_size, d_model),
+             "pos": w(max_seq_len, d_model),
+             "lnf_g": np.ones(d_model, np.float32),
+             "lnf_b": np.zeros(d_model, np.float32),
+             "lm_head": w(d_model, vocab_size)}
+    for i in range(n_layers):
+        p = "l%d_" % i
+        state[p + "ln1_g"] = np.ones(d_model, np.float32)
+        state[p + "ln1_b"] = np.zeros(d_model, np.float32)
+        state[p + "wq"] = w(d_model, d_model)
+        state[p + "wk"] = w(d_model, d_model)
+        state[p + "wv"] = w(d_model, d_model)
+        state[p + "wo"] = w(d_model, d_model)
+        state[p + "ln2_g"] = np.ones(d_model, np.float32)
+        state[p + "ln2_b"] = np.zeros(d_model, np.float32)
+        state[p + "w1"] = w(d_model, 4 * d_model)
+        state[p + "b1"] = np.zeros(4 * d_model, np.float32)
+        state[p + "w2"] = w(4 * d_model, d_model)
+        state[p + "b2"] = np.zeros(d_model, np.float32)
+    meta = {"vocab_size": int(vocab_size), "d_model": int(d_model),
+            "n_heads": int(n_heads), "n_layers": int(n_layers),
+            "max_seq_len": int(max_seq_len), "eos_id": int(eos_id)}
+    return save_decode_model(dirname, state, meta)
+
+
+def _ln(x, g, b):
+    import jax.numpy as jnp
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-5) * g + b
+
+
+def _causal_attention(q, k, v, scale):
+    """Prefill attention oracle: [B, T, H, D] causal, same finite-mask
+    convention as the kernels."""
+    import jax.numpy as jnp
+    T = q.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = jnp.arange(T)[None, :] < jnp.arange(T)[:, None] + 1
+    s = jnp.where(mask[None, None], s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)) \
+        / jnp.maximum(jnp.sum(p, axis=-1), 1e-20).transpose(0, 2, 1)[
+            ..., None]
+    return o
+
+
+class GenerativePredictor:
+    """A decode artifact opened for serving: weights + meta + the two
+    compiled phases (per-bucket prefill, one fixed-shape decode step
+    per slot-table size).  `device` pins state and compute to one
+    jax.Device — the serving registry's replica placement; `clone_to`
+    shares the artifact read and the in-process export map so N
+    same-device-kind replicas deserialize ONE executable each
+    (COMPILE_CACHE.md)."""
+
+    def __init__(self, dirname, device=None, _clone_of=None):
+        from paddle_tpu.native import wire
+        if _clone_of is not None:
+            src = _clone_of
+            self.meta = src.meta
+            self._state_host = src._state_host
+            self._shared_exports = src._shared_exports
+            self._shared_lock = src._shared_lock
+            self._model_fp = src._model_fp
+        else:
+            with open(os.path.join(dirname, DECODE_META), "rb") as f:
+                self.meta = wire.decode(f.read())
+            with open(os.path.join(dirname, _DECODE_STATE), "rb") as f:
+                self._state_host = wire.decode(f.read())
+            # (device_kind, phase-key) -> jitted call, shared BY
+            # REFERENCE across clone_to replicas
+            self._shared_exports = {}
+            self._shared_lock = threading.Lock()
+            self._model_fp = hashlib.sha256(json.dumps(
+                {k: self.meta[k] for k in sorted(self.meta)},
+                sort_keys=True, default=str).encode()).hexdigest()
+        self._device = device
+        if device is not None:
+            import jax
+            self._state = {n: jax.device_put(np.asarray(v), device)
+                           for n, v in self._state_host.items()}
+        else:
+            self._state = {n: np.asarray(v)
+                           for n, v in self._state_host.items()}
+        self._fns = {}          # per-instance resolved callables
+        self._lock = threading.Lock()
+
+    # -- meta surface ---------------------------------------------------
+
+    @property
+    def device(self):
+        return self._device
+
+    @property
+    def vocab_size(self):
+        return int(self.meta["vocab_size"])
+
+    @property
+    def max_seq_len(self):
+        return int(self.meta["max_seq_len"])
+
+    @property
+    def eos_id(self):
+        return int(self.meta["eos_id"])
+
+    @property
+    def is_decode(self):
+        return True
+
+    def prefill_buckets(self):
+        return tuple(int(b) for b in self.meta["prefill_buckets"])
+
+    def batch_buckets(self):
+        """Serving introspection parity with Predictor/AotPredictor:
+        for a decode model the 'buckets' are the prompt-length prefill
+        buckets."""
+        return self.prefill_buckets()
+
+    def prompt_bucket(self, prompt_len):
+        """Smallest prefill bucket >= prompt_len (deterministic by
+        length — the parity contract rides this)."""
+        for b in self.prefill_buckets():
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            "prompt of %d tokens exceeds the largest prefill bucket %d "
+            "(max_seq_len %d)" % (prompt_len,
+                                  self.prefill_buckets()[-1],
+                                  self.max_seq_len))
+
+    def clone_to(self, device):
+        return GenerativePredictor(None, device=device, _clone_of=self)
+
+    # -- model math -----------------------------------------------------
+
+    def _dims(self):
+        m = self.meta
+        return (int(m["n_layers"]), int(m["n_heads"]),
+                int(m["d_model"]) // int(m["n_heads"]),
+                int(m["d_model"]))
+
+    def _prefill_math(self, state, tokens, true_len):
+        """tokens [1, B] int32, true_len scalar int32 -> (first_token
+        [] int32, k/v [L, 1, B, H, Dh] with pad positions zeroed)."""
+        import jax.numpy as jnp
+        L, H, Dh, D = self._dims()
+        B = tokens.shape[1]
+        scale = 1.0 / np.sqrt(Dh)
+        x = state["embed"][tokens] + state["pos"][:B][None]
+        ks, vs = [], []
+        for i in range(L):
+            p = "l%d_" % i
+            h = _ln(x, state[p + "ln1_g"], state[p + "ln1_b"])
+            q = (h @ state[p + "wq"]).reshape(1, B, H, Dh)
+            k = (h @ state[p + "wk"]).reshape(1, B, H, Dh)
+            v = (h @ state[p + "wv"]).reshape(1, B, H, Dh)
+            att = _causal_attention(q, k, v, scale).reshape(1, B, D)
+            x = x + att @ state[p + "wo"]
+            h2 = _ln(x, state[p + "ln2_g"], state[p + "ln2_b"])
+            x = x + jnp.maximum(h2 @ state[p + "w1"] + state[p + "b1"],
+                                0.0) @ state[p + "w2"] + state[p + "b2"]
+            ks.append(k)
+            vs.append(v)
+        logits = _ln(x, state["lnf_g"], state["lnf_b"]) @ state["lm_head"]
+        first = jnp.argmax(logits[0, true_len - 1], axis=-1).astype(
+            jnp.int32)
+        # zero the pad positions: the slot cache must hold exact zeros
+        # past the live length (free() zeroes, writes are length-gated —
+        # this keeps prefill on the same contract)
+        live = (jnp.arange(B)[None, :, None, None]
+                < true_len)[None]            # [1, 1, B, 1, 1]
+        kc = jnp.where(live, jnp.stack(ks), 0.0)
+        vc = jnp.where(live, jnp.stack(vs), 0.0)
+        return first, kc, vc
+
+    def _step_math(self, state, kc, vc, lengths, last_tokens, active):
+        """One fixed-shape decode step over the whole slot table.
+        kc/vc [L, N, S, H, Dh], lengths [N] i32 (live cached positions),
+        last_tokens [N] i32, active [N] bool -> (new_tokens [N] i32,
+        kc', vc').  Cache writes are gated by `active`, so a freed
+        (zeroed) slot stays zero and per-slot independence is exact."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas_kernels import decode_attention
+        L, H, Dh, D = self._dims()
+        N, S = kc.shape[1], kc.shape[2]
+        scale = 1.0 / np.sqrt(Dh)
+        x = state["embed"][last_tokens] + state["pos"][lengths]  # [N, D]
+        write = (jnp.arange(S)[None, :] == lengths[:, None]) \
+            & active[:, None]                                   # [N, S]
+        wmask = write[:, :, None, None]
+        kcs, vcs = [], []
+        for i in range(L):
+            p = "l%d_" % i
+            h = _ln(x, state[p + "ln1_g"], state[p + "ln1_b"])
+            q = (h @ state[p + "wq"]).reshape(N, H, Dh)
+            k_new = (h @ state[p + "wk"]).reshape(N, H, Dh)
+            v_new = (h @ state[p + "wv"]).reshape(N, H, Dh)
+            kci = jnp.where(wmask, k_new[:, None], kc[i])
+            vci = jnp.where(wmask, v_new[:, None], vc[i])
+            att = decode_attention(q, kci, vci, lengths + 1,
+                                   scale=scale)
+            x = x + att.reshape(N, D) @ state[p + "wo"]
+            h2 = _ln(x, state[p + "ln2_g"], state[p + "ln2_b"])
+            x = x + jnp.maximum(h2 @ state[p + "w1"] + state[p + "b1"],
+                                0.0) @ state[p + "w2"] + state[p + "b2"]
+            kcs.append(kci)
+            vcs.append(vci)
+        logits = _ln(x, state["lnf_g"], state["lnf_b"]) @ state["lm_head"]
+        new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return new_tok, jnp.stack(kcs), jnp.stack(vcs)
+
+    # -- compiled-phase resolution (the PR 6 compile-cache ride) --------
+
+    def _fingerprint(self, phase_key, arg_specs):
+        from paddle_tpu import compile_cache as cc
+        return {
+            "kind": "decode_phase",
+            "model": self._model_fp,
+            "phase": list(phase_key),
+            "state": cc._spec_sig(self._state_host),
+            "args": [[list(s.shape), str(s.dtype)] for s in arg_specs],
+            "env": cc.environment_fingerprint(self._device),
+        }
+
+    def _device_kind(self):
+        import jax
+        d = self._device
+        if d is None:
+            devs = jax.devices()
+            d = devs[0] if devs else None
+        return "%s/%s" % (getattr(d, "platform", "cpu"),
+                          getattr(d, "device_kind", ""))
+
+    def _resolve(self, phase_key, math_fn, arg_specs):
+        """Persistent-cache-first compile of one phase (same order as
+        Predictor._get_aot_fn: in-process shared map -> store hit ->
+        fresh export+commit -> legacy jit fallback)."""
+        import time as _time
+        import jax
+        fn = self._fns.get(phase_key)
+        if fn is not None:
+            return fn
+        with self._lock:
+            fn = self._fns.get(phase_key)
+            if fn is not None:
+                return fn
+            fn = self._resolve_locked(phase_key, math_fn, arg_specs,
+                                      _time, jax)
+            self._fns[phase_key] = fn
+            return fn
+
+    def _resolve_locked(self, phase_key, math_fn, arg_specs, _time, jax):
+        from paddle_tpu import compile_cache as cc
+        state_spec = {n: jax.ShapeDtypeStruct(np.shape(v),
+                                              np.asarray(v).dtype)
+                      for n, v in self._state_host.items()}
+        if cc.cache_enabled() and not (
+                self._device is not None
+                and self._device.platform != jax.default_backend()):
+            skey = (self._device_kind(), phase_key)
+            with self._shared_lock:
+                ent = self._shared_exports.get(skey)
+            if ent is _UNEXPORTABLE:
+                return self._jit_fallback(math_fn, state_spec, arg_specs)
+            if ent is not None:
+                return ent
+            from jax import export as jax_export
+            cache = cc.default_cache()
+            fn = None
+            try:
+                fp = self._fingerprint(phase_key, arg_specs)
+                blob = cache.get(fp) if cache is not None else None
+                if blob is not None:
+                    try:
+                        t0 = _time.monotonic()
+                        exp = jax_export.deserialize(blob)
+                        fn = jax.jit(exp.call)
+                        cc.note_deserialize_ms(
+                            (_time.monotonic() - t0) * 1000.0)
+                    except Exception:
+                        blob = None
+                if fn is None:
+                    t0 = _time.monotonic()
+                    exp = jax_export.export(jax.jit(math_fn))(
+                        state_spec, *arg_specs)
+                    cc.note_compile_ms(
+                        (_time.monotonic() - t0) * 1000.0)
+                    if cache is not None:
+                        cache.put(fp, exp.serialize())
+                    fn = jax.jit(exp.call)
+            except Exception as e:
+                with self._shared_lock:
+                    already = self._shared_exports.get(skey)
+                    self._shared_exports[skey] = _UNEXPORTABLE
+                if already is not _UNEXPORTABLE:
+                    warnings.warn(
+                        "compile cache disabled for decode phase %r "
+                        "(export failed: %s: %s) — falling back to "
+                        "direct compilation"
+                        % (phase_key, type(e).__name__, e),
+                        RuntimeWarning, stacklevel=4)
+                return self._jit_fallback(math_fn, state_spec, arg_specs)
+            with self._shared_lock:
+                self._shared_exports[skey] = fn
+            return fn
+        return self._jit_fallback(math_fn, state_spec, arg_specs)
+
+    @staticmethod
+    def _jit_fallback(math_fn, state_spec, arg_specs):
+        import jax
+        # compile NOW (not on first call) so warm() covers the stall
+        return jax.jit(math_fn).lower(state_spec, *arg_specs).compile()
+
+    def prefill_fn(self, bucket):
+        import jax
+        bucket = int(bucket)
+        specs = (jax.ShapeDtypeStruct((1, bucket), np.dtype(np.int32)),
+                 jax.ShapeDtypeStruct((), np.dtype(np.int32)))
+        return self._resolve(("prefill", bucket), self._prefill_math,
+                             specs)
+
+    def step_fn(self, n_slots):
+        import jax
+        L, H, Dh, _ = self._dims()
+        S = self.max_seq_len
+        cache = jax.ShapeDtypeStruct((L, int(n_slots), S, H, Dh),
+                                     np.dtype(np.float32))
+        specs = (cache, cache,
+                 jax.ShapeDtypeStruct((int(n_slots),),
+                                      np.dtype(np.int32)),
+                 jax.ShapeDtypeStruct((int(n_slots),),
+                                      np.dtype(np.int32)),
+                 jax.ShapeDtypeStruct((int(n_slots),), np.dtype(bool)))
+        return self._resolve(("step", int(n_slots)), self._step_math,
+                             specs)
+
+    def new_session(self, n_slots):
+        return DecodeSession(self, n_slots)
+
+
+class DecodeSession:
+    """One slot table: the per-lane KV cache + occupancy bookkeeping.
+    NOT thread-safe — a serving lane owns its session exclusively (the
+    decode loop is single-threaded per replica by design: the step
+    function is one executable over the whole table)."""
+
+    def __init__(self, predictor, n_slots):
+        import jax
+        import jax.numpy as jnp
+        self.predictor = predictor
+        self.n_slots = int(n_slots)
+        L, H, Dh, _ = predictor._dims()
+        S = predictor.max_seq_len
+        shape = (L, self.n_slots, S, H, Dh)
+        z = jnp.zeros(shape, jnp.float32)
+        if predictor.device is not None:
+            z = jax.device_put(z, predictor.device)
+        self._kc = z
+        self._vc = z
+        self.lengths = np.zeros(self.n_slots, np.int32)
+        self.last_tokens = np.zeros(self.n_slots, np.int32)
+        self.active = np.zeros(self.n_slots, bool)
+        self.steps = 0
+
+    # -- occupancy ------------------------------------------------------
+
+    def free_slots(self):
+        return [i for i in range(self.n_slots) if not self.active[i]]
+
+    def occupancy(self):
+        return int(self.active.sum())
+
+    # -- phases ---------------------------------------------------------
+
+    def _put(self, arr):
+        import jax
+        if self.predictor.device is not None:
+            return jax.device_put(arr, self.predictor.device)
+        return arr
+
+    def prefill(self, slot, tokens):
+        """Run the prompt through the bucketed prefill, land its K/V in
+        `slot`, and return the first generated token (greedy).  The
+        slot must be free (and therefore zeroed)."""
+        import jax.lax
+        if self.active[slot]:
+            raise ValueError("slot %d is occupied" % slot)
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = tokens.size
+        if n < 1:
+            raise ValueError("empty prompt")
+        bucket = self.predictor.prompt_bucket(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = tokens
+        fn = self.predictor.prefill_fn(bucket)
+        first, kc, vc = fn(self.predictor._state, self._put(padded),
+                           self._put(np.int32(n)))
+        # land the bucket-length K/V at the slot; positions past the
+        # bucket are already zero (the slot was zeroed on free)
+        at = (0, slot, 0, 0, 0)
+        self._kc = jax.lax.dynamic_update_slice(self._kc, kc, at)
+        self._vc = jax.lax.dynamic_update_slice(self._vc, vc, at)
+        tok = int(first)
+        self.lengths[slot] = n
+        self.last_tokens[slot] = tok
+        self.active[slot] = True
+        return tok
+
+    def decode(self):
+        """ONE fixed-shape step over the whole slot table; returns the
+        np.int32 [n_slots] token vector (only entries of slots active
+        at call time are meaningful).  Bumps each active slot's length
+        and last token."""
+        fn = self.predictor.step_fn(self.n_slots)
+        new_tok, self._kc, self._vc = fn(
+            self.predictor._state, self._kc, self._vc,
+            self._put(self.lengths), self._put(self.last_tokens),
+            self._put(self.active))
+        toks = np.asarray(new_tok)
+        act = self.active
+        self.lengths = self.lengths + act.astype(np.int32)
+        self.last_tokens = np.where(act, toks, self.last_tokens).astype(
+            np.int32)
+        self.steps += 1
+        return toks
+
+    def room(self, slot):
+        """Generated tokens this slot can still hold (cache positions
+        left)."""
+        return int(self.predictor.max_seq_len - self.lengths[slot])
+
+    def free(self, slot):
+        """Release a slot: its KV lines are ZEROED before it can be
+        reused — a later occupant starts from exact zeros, never from a
+        previous request's keys (the no-leakage contract the chaos
+        decode-disconnect scenario pins)."""
+        import jax.lax
+        import jax.numpy as jnp
+        L = self._kc.shape[0]
+        S, H, Dh = self._kc.shape[2], self._kc.shape[3], self._kc.shape[4]
+        z = self._put(jnp.zeros((L, 1, S, H, Dh), jnp.float32))
+        at = (0, int(slot), 0, 0, 0)
+        self._kc = jax.lax.dynamic_update_slice(self._kc, z, at)
+        self._vc = jax.lax.dynamic_update_slice(self._vc, z, at)
+        self.lengths[slot] = 0
+        self.last_tokens[slot] = 0
+        self.active[slot] = False
+
+    def slot_is_zero(self, slot):
+        """True when the slot's K and V cache lines are exact zeros —
+        the test hook for the zero-before-reuse contract."""
+        k = np.asarray(self._kc[:, slot])
+        v = np.asarray(self._vc[:, slot])
+        return bool(not k.any() and not v.any())
+
+
+def load_decode_predictor(dirname):
+    """Open a `save_decode_model` artifact (fresh-process serving)."""
+    return GenerativePredictor(dirname)
+
+
+def greedy_decode(predictor, tokens, max_new_tokens, n_slots=1,
+                  slot=0, session=None):
+    """Single-request reference decode: prefill + step loop on a
+    dedicated session — the unbatched oracle the continuous-batching
+    parity tests (and bench_serving's bit_exact replay) compare
+    against.  Returns (generated_tokens, finish_reason)."""
+    sess = session if session is not None \
+        else predictor.new_session(n_slots)
+    out = []
+    reason = "length"
+    tok = sess.prefill(slot, tokens)
+    out.append(tok)
+    eos = predictor.eos_id
+    try:
+        while len(out) < max_new_tokens and out[-1] != eos:
+            if sess.room(slot) <= 0:
+                break
+            toks = sess.decode()
+            out.append(int(toks[slot]))
+    finally:
+        sess.free(slot)
+    if out[-1] == eos:
+        reason = "eos"
+    return out, reason

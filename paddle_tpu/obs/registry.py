@@ -34,10 +34,16 @@ _PREFIX = "paddle_tpu_"
 
 # ServingMetrics snapshot ints rendered as labeled counters
 _SERVING_COUNTERS = ("requests", "responses", "errors", "shed",
-                     "deadline_expired", "dispatches")
+                     "deadline_expired", "dispatches",
+                     # generation counters (absent for one-shot models)
+                     "streams", "prefills", "decode_tokens",
+                     "decode_steps")
 # ... and floats rendered as labeled gauges
 _SERVING_GAUGES = ("qps_recent", "qps_lifetime", "batch_fill",
-                   "bucket_fill_ratio", "queue_depth")
+                   "bucket_fill_ratio", "queue_depth",
+                   # continuous-batching decode gauges (SERVING.md)
+                   "tokens_per_sec", "slot_occupancy")
+_SERVING_HISTS = ("latency_ms", "queue_wait_ms", "ttft_ms")
 _QUANTILES = ("p50", "p95", "p99")
 
 
@@ -164,11 +170,13 @@ class MetricsRegistry(object):
                         samples.append((mname, {"model": model},
                                         m[field]))
             _family(lines, mname, "gauge", samples)
-        for hist_field in ("latency_ms", "queue_wait_ms"):
+        for hist_field in _SERVING_HISTS:
             mname = _PREFIX + "serving_" + hist_field
             samples = []
             for snap in snaps:
                 for model, m in sorted(snap.get("models", {}).items()):
+                    if hist_field not in m:
+                        continue  # e.g. ttft_ms on a one-shot model
                     h = m.get(hist_field) or {}
                     for q in _QUANTILES:
                         if h.get(q) is not None:

@@ -38,6 +38,7 @@ TUNING_NAMESPACE = "flash_attention"
 
 __all__ = ["AttentionConfig", "get_config", "default_config", "lookup",
            "record", "cache_path", "config_key", "attention_vmem_bytes",
+           "decode_config_key", "get_decode_config", "record_decode",
            "MIN_LANES"]
 
 MIN_LANES = 128     # TPU lane width: the last-dim alignment quantum
@@ -226,6 +227,73 @@ def get_config(seq_len, head_dim, causal, dtype):
         v = int(getattr(FLAGS, "flash_" + field))
         picked[field] = v if v > 0 else getattr(base, field)
     return AttentionConfig(**picked)
+
+
+def decode_config_key(seq_len, head_dim, dtype):
+    """Tuning key of the decode-attention kernel's kv-block edge for one
+    (slot-cache length, head_dim, dtype) shape — same registry namespace
+    as the training kernels, distinct key family."""
+    return "DEC_S%d_D%d_%s" % (int(seq_len), int(head_dim), str(dtype))
+
+
+def _decode_block(rec):
+    if isinstance(rec, dict):
+        try:
+            return int(rec["block_kv"]) or None
+        except (KeyError, TypeError, ValueError):
+            return None
+    return None
+
+
+def get_decode_config(seq_len, head_dim, dtype):
+    """kv-block edge for the decode-attention kernel (the serving decode
+    step gathers K/V from the slot cache in blocks of this many cached
+    positions).  Resolution mirrors get_config: nonzero
+    ``FLAGS.flash_block_kv`` > tune-registry entry > the MXU-aligned
+    heuristic.  None when no candidate divides the cache length (the
+    caller falls back to the plain-XLA gather)."""
+    from ..flags import FLAGS
+    v = int(FLAGS.flash_block_kv)
+    if v > 0:
+        return v if seq_len % v == 0 else None
+    key = decode_config_key(seq_len, head_dim, dtype)
+    if _legacy_override():
+        b = _decode_block(_load(cache_path()).get(key))
+    else:
+        from .. import compile_cache as cc
+        b = _decode_block(cc.tuning_lookup(TUNING_NAMESPACE, key))
+        if b is None:
+            b = _decode_block(_load(cache_path()).get(key))
+    if b is not None and seq_len % b == 0:
+        return b
+    return _pick_block(seq_len, MIN_LANES)
+
+
+def record_decode(seq_len, head_dim, dtype, block_kv, extra=None,
+                  path=None):
+    """Persist a tuned decode kv-block edge (bench_serving --decode
+    --tune writes these) through the same store/legacy resolution as
+    record()."""
+    rec = {"block_kv": int(block_kv)}
+    if extra:
+        rec.update(extra)
+    key = decode_config_key(seq_len, head_dim, dtype)
+    if path is None and not _legacy_override():
+        from .. import compile_cache as cc
+        return cc.tuning_record(TUNING_NAMESPACE, key, rec)
+    path = path or cache_path()
+    entries = dict(_load(path))
+    entries[key] = rec
+    d = os.path.dirname(path)
+    if d and not os.path.isdir(d):
+        os.makedirs(d)
+    from ..fluid import checkpoint
+    checkpoint.atomic_write(
+        path, json.dumps(entries, indent=2, sort_keys=True).encode(),
+        chaos_point="tuning_tmp_written")
+    with _memo_lock:
+        _memo.pop(path, None)
+    return path
 
 
 def attention_vmem_bytes(head_dim, block_q, block_kv, itemsize=2):

@@ -28,8 +28,9 @@ import numpy as np
 
 from . import attention_tuning
 
-__all__ = ["flash_attention", "fused_bottleneck", "bottleneck_reference",
-           "mosaic_lowering"]
+__all__ = ["flash_attention", "decode_attention",
+           "decode_attention_reference", "fused_bottleneck",
+           "bottleneck_reference", "mosaic_lowering"]
 
 # Finite mask value (not -inf): exp(_NEG_INF - finite) underflows to an
 # exact 0, and the logsumexp of a fully-masked row stays finite, so the
@@ -446,6 +447,145 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     if return_lse:
         return from_bh(o), lse.reshape(B, H, S).transpose(0, 2, 1)
     return from_bh(o)
+
+
+# ---------------------------------------------------------------------------
+# decode attention: the serving-side kernel (SERVING.md continuous
+# batching). One new query token per KV-cache slot attends over that
+# slot's cached prefix — the memory-roofline-bound shape ROOFLINE.md
+# names for generation: ~zero FLOP reuse, the win is streaming the K/V
+# slot cache through VMEM exactly once per step. The kernel is
+# q-stationary per slot (all heads resident) and streams kv-cache blocks
+# through the innermost grid axis with online-softmax accumulation;
+# positions at or past the slot's live length are masked with the same
+# finite _NEG_INF convention as the training kernels. Block geometry
+# resolves through the shared kernel-tuning registry
+# (attention_tuning.get_decode_config — FLAGS override > tuned entry >
+# MXU-aligned heuristic).
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, scale, block_kv):
+    """One (slot, kv-block) grid step.  q (all heads of one slot) and
+    the fp32 accumulator/m/l state stay resident across the innermost
+    kv axis; cached positions >= the slot's live length are masked."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]                                   # [H, D]
+    k = k_ref[0].transpose(1, 0, 2)                # [H, BKV, D]
+    v = v_ref[0].transpose(1, 0, 2)
+    length = len_ref[0, 0]
+    H = q.shape[0]
+    # elementwise-multiply + lane reduction instead of a matmul: one
+    # query row per head makes this VPU work, and the step is
+    # memory-bound on the K/V stream anyway (ROOFLINE.md decode shape)
+    s = jnp.sum(q[:, None, :].astype(jnp.float32)
+                * k.astype(jnp.float32), axis=-1) * scale   # [H, BKV]
+    kpos = ik * block_kv + jax.lax.broadcasted_iota(
+        jnp.int32, (H, block_kv), 1)
+    s = jnp.where(kpos >= length, _NEG_INF, s)
+    m_prev = m_ref[...]                            # [H, LANES]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])                  # [H, BKV] f32
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1)[:, None]
+    pv = jnp.sum(p[:, :, None] * v.astype(jnp.float32), axis=1)
+    acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
+    m_ref[...] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], _TINY)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def decode_attention_reference(q, k_cache, v_cache, lengths, scale=None):
+    """Plain-XLA oracle/fallback with identical masking semantics:
+    q [N, H, D] one new token per slot, k/v caches [N, S, H, D],
+    lengths [N] live cached positions per slot -> [N, H, D]."""
+    import jax.numpy as jnp
+    N, S = k_cache.shape[0], k_cache.shape[1]
+    D = q.shape[-1]
+    scale = float(scale if scale is not None else 1.0 / np.sqrt(D))
+    s = jnp.einsum("nhd,nshd->nhs", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    mask = jnp.arange(S)[None, None, :] >= \
+        jnp.asarray(lengths).astype(jnp.int32)[:, None, None]
+    s = jnp.where(mask, _NEG_INF, s)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.maximum(jnp.sum(p, axis=-1), _TINY)
+    o = jnp.einsum("nhs,nshd->nhd", p,
+                   v_cache.astype(jnp.float32)) / l[..., None]
+    return o.astype(q.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, lengths, scale=None,
+                     block_kv=None, interpret=None):
+    """Slot-cache decode attention: q [N, H, D] (the one new token of
+    each of N slots), k_cache/v_cache [N, S, H, D] (the slot table's
+    cached keys/values, time-major), lengths [N] int32 (live positions
+    per slot — cached positions >= length are masked out) -> [N, H, D].
+
+    Pallas kernel on TPU (interpret emulation elsewhere) streaming
+    kv-cache blocks under resident per-slot queries; block geometry via
+    attention_tuning.get_decode_config (FLAGS.flash_block_kv override >
+    kernel-tuning registry > heuristic). Falls back to the plain-XLA
+    composition when no block edge divides the cache length. A slot
+    with length 0 produces well-defined garbage (every position masked)
+    — the decode step gates dead slots out downstream."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, H, D = q.shape
+    S = k_cache.shape[1]
+    scale = float(scale if scale is not None else 1.0 / np.sqrt(D))
+    bkv = int(block_kv or attention_tuning.get_decode_config(
+        S, D, jnp.dtype(q.dtype).name) or 0)
+    if not bkv or S % bkv:
+        return decode_attention_reference(q, k_cache, v_cache, lengths,
+                                          scale=scale)
+    lengths2d = jnp.asarray(lengths).astype(jnp.int32).reshape(N, 1)
+    kern = functools.partial(_decode_kernel, scale=scale, block_kv=bkv)
+
+    def call(interp, *ops):
+        return pl.pallas_call(
+            kern,
+            grid=(N, S // bkv),
+            in_specs=[
+                pl.BlockSpec((1, H, D), lambda b, j: (b, 0, 0)),
+                pl.BlockSpec((1, bkv, H, D), lambda b, j: (b, j, 0, 0)),
+                pl.BlockSpec((1, bkv, H, D), lambda b, j: (b, j, 0, 0)),
+                pl.BlockSpec((1, 1), lambda b, j: (b, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, H, D), lambda b, j: (b, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((N, H, D), q.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((H, D), jnp.float32),
+                pltpu.VMEM((H, _MIN_LANES), jnp.float32),
+                pltpu.VMEM((H, _MIN_LANES), jnp.float32),
+            ],
+            compiler_params=_compiler_params(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interp,
+        )(*ops)
+
+    return _interpret_dispatch(call, interpret, q, k_cache, v_cache,
+                               lengths2d)
 
 
 # ---------------------------------------------------------------------------

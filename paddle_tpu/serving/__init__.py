@@ -17,8 +17,9 @@ this module's territory — distinct scheduling needs (latency SLOs,
 coalescing, load shedding) from the training runtime's.
 """
 
-from .batcher import (BatcherClosed, DeadlineExceeded, DynamicBatcher,
-                      ServerOverloaded, set_dispatch_delay)
+from .batcher import (BatcherClosed, DeadlineExceeded, DecodeBatcher,
+                      DecodeStream, DynamicBatcher, ServerOverloaded,
+                      set_dispatch_delay)
 from .metrics import (Counter, ModelMetrics, ReservoirHistogram,
                       ServingMetrics)
 from .model_registry import (ModelEntry, ModelRegistry, open_predictor,
@@ -26,7 +27,8 @@ from .model_registry import (ModelEntry, ModelRegistry, open_predictor,
 from .server import InferenceServer, ServingClient, ServingError
 
 __all__ = [
-    "DynamicBatcher", "ServerOverloaded", "DeadlineExceeded",
+    "DynamicBatcher", "DecodeBatcher", "DecodeStream",
+    "ServerOverloaded", "DeadlineExceeded",
     "BatcherClosed", "set_dispatch_delay",
     "Counter", "ReservoirHistogram", "ModelMetrics", "ServingMetrics",
     "ModelRegistry", "ModelEntry", "open_predictor",

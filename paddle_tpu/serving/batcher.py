@@ -57,6 +57,7 @@ dispatch device-cost stand-in for the replica-scaling lanes.
 import binascii
 import collections
 import os
+import queue as queue_mod
 import threading
 import time
 from concurrent.futures import Future
@@ -67,8 +68,9 @@ from ..flags import FLAGS
 from ..obs import events as obs_events
 from ..obs import tracing as obs_tracing
 
-__all__ = ["DynamicBatcher", "ServerOverloaded", "DeadlineExceeded",
-           "BatcherClosed", "set_dispatch_delay"]
+__all__ = ["DynamicBatcher", "DecodeBatcher", "DecodeStream",
+           "ServerOverloaded", "DeadlineExceeded", "BatcherClosed",
+           "set_dispatch_delay"]
 
 _CHAOS_ENV = "PADDLE_TPU_SERVING_CHAOS"
 
@@ -675,3 +677,560 @@ class DynamicBatcher:
         self._router.join(timeout=5.0)
         for t in self._threads:
             t.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# continuous batching for autoregressive decode (SERVING.md "Continuous
+# batching & streaming").  The DynamicBatcher above coalesces ONE-SHOT
+# requests into one dispatch; generation inverts the shape — each
+# request is MANY tiny steps over growing state, so the utilization
+# lever is slot occupancy over time, not batch fill per dispatch.  The
+# DecodeBatcher keeps one DecodeSession (slot-indexed KV cache,
+# inference/decode.py) per replica lane and runs a continuous loop: a
+# waiting request joins the RUNNING decode batch the step after any
+# slot frees (EOS / max-new-tokens / deadline / client disconnect) —
+# never a coalesce window, never waiting for the batch to drain.  The
+# decode step is one fixed-shape executable over the whole slot table,
+# so XLA compiles it once and every mix of requests reuses it.
+# ---------------------------------------------------------------------------
+
+
+class DecodeStream:
+    """The caller's handle on one streaming generation: an event queue
+    the owning lane feeds (token chunks, then exactly one terminal
+    event), iterable as token-chunk lists.  ``result()`` collects the
+    whole stream — the Future-shaped surface the server's one-shot
+    `infer` path uses unchanged on decode models."""
+
+    def __init__(self, trace_id, prompt_len, max_new_tokens):
+        self.trace_id = trace_id
+        self.prompt_len = int(prompt_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self.obs_info = None     # stage timing attribution, at finish
+        self.finish_reason = None
+        self._q = queue_mod.Queue()
+        self._tokens = []
+        self._done = threading.Event()
+        self._cancel = threading.Event()
+        self._error = None
+
+    # -- lane side ------------------------------------------------------
+
+    def _put_tokens(self, toks):
+        self._tokens.extend(int(t) for t in toks)
+        self._q.put(("tokens", [int(t) for t in toks]))
+
+    def _finish(self, reason, obs_info=None):
+        self.finish_reason = reason
+        self.obs_info = obs_info
+        self._done.set()
+        self._q.put(("done", reason))
+
+    def _fail(self, exc):
+        self._error = exc
+        self.finish_reason = "error"
+        self._done.set()
+        self._q.put(("error", exc))
+
+    # -- caller side ----------------------------------------------------
+
+    def cancel(self):
+        """Ask the owning lane to evict this request; the slot is freed
+        (and zeroed) within one decode step.  The server's stream
+        handler calls this when the client connection dies mid-reply."""
+        self._cancel.set()
+
+    def cancelled(self):
+        return self._cancel.is_set()
+
+    def done(self):
+        return self._done.is_set()
+
+    @property
+    def tokens(self):
+        """Tokens generated so far (grows while streaming)."""
+        return list(self._tokens)
+
+    def events(self, timeout=None):
+        """Yield ("tokens", [ints]) chunks then one terminal ("done",
+        reason) / ("error", exc) event.  `timeout` bounds the wait for
+        EACH event."""
+        while True:
+            ev = self._q.get(timeout=timeout)
+            yield ev
+            if ev[0] != "tokens":
+                return
+
+    def __iter__(self):
+        """Token-chunk iterator; raises the stream's typed error at the
+        point of failure."""
+        for kind, payload in self.events():
+            if kind == "tokens":
+                yield payload
+            elif kind == "error":
+                raise payload
+
+    def result(self, timeout=None):
+        """Block to completion; returns the fetch-shaped reply (one
+        int32 array of every generated token) or raises the stream's
+        typed error — duck-typed as the batcher Future so the registry
+        and the one-shot `infer` verb serve decode models unchanged."""
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(
+                "decode stream still running after %.1fs (%d tokens)"
+                % (timeout or 0.0, len(self._tokens)))
+        # drain keeps events() consumers and result() callers equivalent
+        if self._error is not None:
+            raise self._error
+        return [np.asarray(self._tokens, np.int32)]
+
+
+class _DecodeRequest:
+    __slots__ = ("prompt", "max_new", "chunk", "deadline", "priority",
+                 "trace_id", "stream", "enqueued", "t_admitted",
+                 "t_first", "buf", "gen")
+
+    def __init__(self, prompt, max_new, chunk, deadline, priority,
+                 trace_id):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.chunk = max(int(chunk), 1)
+        self.deadline = deadline
+        self.priority = int(priority)
+        self.trace_id = trace_id or obs_tracing.new_trace_id()
+        self.stream = DecodeStream(self.trace_id, len(prompt), max_new)
+        self.enqueued = time.monotonic()
+        self.t_admitted = None
+        self.t_first = None
+        self.buf = []
+        self.gen = []
+
+
+class _DecodeLane:
+    """One replica's decode lane: its slot-table session plus the
+    slot -> request assignment the continuous loop walks."""
+
+    __slots__ = ("index", "predictor", "session", "assigned", "steps",
+                 "tokens")
+
+    def __init__(self, index, predictor, n_slots):
+        self.index = index
+        self.predictor = predictor
+        self.session = predictor.new_session(n_slots)
+        self.assigned = {}   # slot -> _DecodeRequest
+        self.steps = 0
+        self.tokens = 0
+
+
+class DecodeBatcher:
+    """Slot-based continuous batching over one or more replica
+    GenerativePredictors.  Admission control matches the DynamicBatcher
+    contract (bounded queue, lowest-priority-first shed, shed-not-hang);
+    past admission the lifecycle is streaming: prefill into a free slot,
+    then ride the lane's running decode loop until EOS / max-new-tokens
+    / deadline / cancel frees the slot for the next waiting request.
+
+    ``continuous=False`` is the STATIC-batching baseline the bench
+    lanes compare against: a lane only admits when it is idle, takes a
+    full batch, and decodes until the LAST member finishes — the
+    pre-continuous-batching serving shape (bench_zoo
+    serving_decode_static)."""
+
+    def __init__(self, predictor, replicas=None, n_slots=None,
+                 max_queue=None, metrics=None, max_new_tokens=None,
+                 continuous=True):
+        preds = list(replicas) if replicas else [predictor]
+        self.predictor = predictor if predictor is not None else preds[0]
+        self.n_slots = max(int(FLAGS.serving_decode_slots
+                               if n_slots is None else n_slots), 1)
+        self.max_queue = int(FLAGS.serving_max_queue
+                             if max_queue is None else max_queue)
+        self.max_new_cap = max(int(FLAGS.serving_max_new_tokens
+                                   if max_new_tokens is None
+                                   else max_new_tokens), 1)
+        self.continuous = bool(continuous)
+        self.metrics = metrics
+        self._cv = threading.Condition()
+        self._pending = collections.deque()
+        self._lanes = [_DecodeLane(i, p, self.n_slots)
+                       for i, p in enumerate(preds)]
+        self._closing = False
+        self._stopped = False
+        if metrics is not None:
+            metrics.queue_depth_fn = lambda: len(self._pending)
+            metrics.replica_stats_fn = self.replica_stats
+            metrics.slot_occupancy_fn = self.slot_occupancy
+        self._threads = [
+            threading.Thread(target=self._lane_loop, args=(lane,),
+                             daemon=True,
+                             name="paddle-tpu-decode-lane%d" % lane.index)
+            for lane in self._lanes]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------------
+
+    @property
+    def num_replicas(self):
+        return len(self._lanes)
+
+    @property
+    def _model_name(self):
+        return self.metrics.name if self.metrics is not None else None
+
+    def batch_buckets(self):
+        return self.predictor.prefill_buckets()
+
+    def queue_depth(self):
+        return len(self._pending)
+
+    def slot_occupancy(self):
+        """(occupied, total) across every lane — the occupancy gauge."""
+        occupied = sum(len(l.assigned) for l in self._lanes)
+        return occupied, self.n_slots * len(self._lanes)
+
+    def replica_stats(self):
+        with self._cv:
+            out = []
+            for l in self._lanes:
+                from ..inference.predictor import _device_label
+                out.append({"replica": l.index,
+                            "device": _device_label(
+                                getattr(l.predictor, "device", None)),
+                            "inflight": len(l.assigned),
+                            "queue": 0,
+                            "batches": l.steps,
+                            "rows": l.tokens})
+            return out
+
+    # ------------------------------------------------------------------
+    # submit side: the same admission-control contract as DynamicBatcher
+    # ------------------------------------------------------------------
+
+    def submit(self, tokens, max_new_tokens=None, deadline=None,
+               priority=0, trace_id=None, chunk_tokens=None):
+        """Enqueue one generation request.  Returns a DecodeStream.
+        `max_new_tokens` is clamped to the server-side cap; `deadline`
+        is an absolute time.monotonic() instant covering queue wait,
+        prefill AND in-decode time — a streaming request past it is
+        evicted from its slot mid-generation (the PR 8 deadline fix)."""
+        prompt = np.asarray(tokens, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        # reject unservable prompts synchronously (admission decisions
+        # are immediate); also guarantees >= 1 generated token fits
+        self.predictor.prompt_bucket(int(prompt.size))
+        if prompt.size >= self.predictor.max_seq_len:
+            raise ValueError(
+                "prompt of %d tokens leaves no cache room to generate "
+                "(max_seq_len %d)" % (prompt.size,
+                                      self.predictor.max_seq_len))
+        max_new = self.max_new_cap if max_new_tokens is None else \
+            max(min(int(max_new_tokens), self.max_new_cap), 1)
+        chunk = int(FLAGS.serving_stream_chunk_tokens
+                    if chunk_tokens is None else chunk_tokens)
+        req = _DecodeRequest(list(int(t) for t in prompt), max_new,
+                             chunk, deadline, priority, trace_id)
+        evicted = None
+        with self._cv:
+            if self._closing:
+                raise BatcherClosed("model batcher is draining/retired")
+            if len(self._pending) >= self.max_queue:
+                victim = None
+                for r in self._pending:
+                    if r.priority < req.priority and \
+                            (victim is None
+                             or r.priority < victim.priority):
+                        victim = r
+                if victim is None:
+                    if self.metrics is not None:
+                        self.metrics.note_shed(priority=req.priority)
+                    obs_events.emit("shed", model=self._model_name,
+                                    priority=req.priority,
+                                    trace_id=req.trace_id,
+                                    queue=len(self._pending))
+                    raise ServerOverloaded(
+                        "decode queue full (%d waiting, max_queue=%d) — "
+                        "priority-%d request shed; back off and retry"
+                        % (len(self._pending), self.max_queue,
+                           req.priority),
+                        priority=req.priority)
+                self._pending.remove(victim)
+                evicted = victim
+            self._pending.append(req)
+            if self.metrics is not None:
+                self.metrics.requests.add()
+                self.metrics.streams.add()
+            self._cv.notify_all()
+        if evicted is not None:
+            if self.metrics is not None:
+                self.metrics.note_shed(priority=evicted.priority)
+            obs_events.emit("shed", model=self._model_name,
+                            priority=evicted.priority,
+                            trace_id=evicted.trace_id, evicted=True,
+                            by_priority=req.priority)
+            evicted.stream._fail(ServerOverloaded(
+                "priority-%d request shed from a full decode queue by "
+                "a priority-%d arrival (lowest-priority-first overload "
+                "policy)" % (evicted.priority, req.priority),
+                priority=evicted.priority))
+        return req.stream
+
+    # ------------------------------------------------------------------
+    # the continuous loop (one thread per replica lane)
+    # ------------------------------------------------------------------
+
+    def _admissible(self, lane):
+        if not self._pending:
+            return False
+        if self.continuous:
+            return len(lane.assigned) < self.n_slots
+        # static baseline: only an IDLE lane admits (then decodes the
+        # whole batch to completion before admitting again)
+        return not lane.assigned
+
+    def _take_admits(self, lane):
+        """Pop the requests this lane admits right now (under _cv)."""
+        room = self.n_slots - len(lane.assigned)
+        out = []
+        while self._pending and room > 0:
+            out.append(self._pending.popleft())
+            room -= 1
+        return out
+
+    def _emit_request_spans(self, req, lane, now):
+        """Stage spans cut from contiguous monotonic stamps so
+        queue_wait + prefill + decode tile serving/request exactly —
+        the same tiling contract as the one-shot stage spans
+        (OBSERVABILITY.md)."""
+        wall_now = time.time()
+        model = self._model_name
+        t_adm = req.t_admitted if req.t_admitted is not None \
+            else req.enqueued
+        t_first = req.t_first if req.t_first is not None else t_adm
+
+        def _mk(name, t0, t1, **attrs):
+            if t1 < t0:
+                t1 = t0
+            a = {"model": model} if model else {}
+            a.update(attrs)
+            obs_tracing.add_span(obs_tracing.Span(
+                name, kind="serving", trace_id=req.trace_id,
+                ts=wall_now - (now - t0), dur_ms=(t1 - t0) * 1e3,
+                attrs=a))
+
+        _mk("serving/queue_wait", req.enqueued, t_adm)
+        _mk("serving/prefill", t_adm, t_first, replica=lane.index,
+            prompt=len(req.prompt))
+        _mk("serving/decode", t_first, now, replica=lane.index,
+            tokens=len(req.gen))
+        _mk("serving/request", req.enqueued, now, replica=lane.index,
+            prompt=len(req.prompt), tokens=len(req.gen),
+            priority=req.priority)
+
+    def _obs_info(self, req, lane, now):
+        t_adm = req.t_admitted or now
+        t_first = req.t_first or t_adm
+        return {
+            "trace_id": req.trace_id,
+            "queue_wait_ms": round((t_adm - req.enqueued) * 1e3, 3),
+            "prefill_ms": round((t_first - t_adm) * 1e3, 3),
+            "decode_ms": round((now - t_first) * 1e3, 3),
+            "server_ms": round((now - req.enqueued) * 1e3, 3),
+            "ttft_ms": round((t_first - req.enqueued) * 1e3, 3),
+            "tokens": len(req.gen),
+            "replica": lane.index,
+        }
+
+    def _finish(self, lane, slot, req, reason, exc=None):
+        """Terminal transition: flush, emit spans/metrics, free (and
+        therefore ZERO) the slot so the next admit starts clean."""
+        now = time.monotonic()
+        if req.buf:
+            req.stream._put_tokens(req.buf)
+            req.buf = []
+        if slot is not None:
+            lane.session.free(slot)
+            lane.assigned.pop(slot, None)
+        if obs_tracing.enabled():
+            self._emit_request_spans(req, lane, now)
+        info = self._obs_info(req, lane, now)
+        info["finish_reason"] = reason
+        if exc is not None:
+            if self.metrics is not None:
+                self.metrics.errors.add()
+                if isinstance(exc, DeadlineExceeded):
+                    self.metrics.deadline_expired.add()
+            req.stream.obs_info = info
+            req.stream._fail(exc)
+        else:
+            if self.metrics is not None and reason != "cancelled":
+                self.metrics.note_completion(
+                    latency_ms=info["server_ms"],
+                    queue_wait_ms=info["queue_wait_ms"])
+            req.stream._finish(reason, obs_info=info)
+
+    def _expire(self, lane, slot, req, now):
+        """Deadline eviction — in queue, at prefill, or MID-DECODE: the
+        deadline covers in-decode time (the PR 8 admission-control
+        fix), so a streaming request past it frees its slot within one
+        step instead of pinning it to max_new_tokens."""
+        obs_events.emit("deadline_expired", model=self._model_name,
+                        trace_id=req.trace_id,
+                        tokens=len(req.gen),
+                        waited_ms=round((now - req.enqueued) * 1e3, 3))
+        self._finish(lane, slot, req, "deadline", exc=DeadlineExceeded(
+            "deadline passed after %.1f ms (%d tokens generated)"
+            % ((now - req.enqueued) * 1e3, len(req.gen))))
+
+    def _prefill(self, lane, req):
+        """Admit one request into a free slot: prefill the prompt,
+        stream the first token (the TTFT instant)."""
+        now = time.monotonic()
+        req.t_admitted = now
+        if req.stream.cancelled():
+            self._finish(lane, None, req, "cancelled")
+            return
+        if req.deadline is not None and now > req.deadline:
+            self._expire(lane, None, req, now)
+            return
+        sess = lane.session
+        slot = sess.free_slots()[0]
+        try:
+            with obs_tracing.trace("serving/prefill_compute",
+                                   kind="serving", trace_id=req.trace_id,
+                                   model=self._model_name,
+                                   replica=lane.index,
+                                   prompt=len(req.prompt)):
+                first = sess.prefill(slot, req.prompt)
+        except BaseException as e:
+            self._finish(lane, None, req, "error", exc=e)
+            return
+        req.t_first = time.monotonic()
+        if self.metrics is not None:
+            self.metrics.note_prefill(
+                ttft_ms=(req.t_first - req.enqueued) * 1e3)
+            self.metrics.note_tokens(1)
+        lane.tokens += 1
+        req.gen.append(first)
+        req.buf.append(first)
+        lane.assigned[slot] = req
+        if first == self.predictor.eos_id:
+            self._finish(lane, slot, req, "eos")
+        elif req.max_new <= 1 or sess.room(slot) <= 0:
+            self._finish(lane, slot, req, "length")
+        elif len(req.buf) >= req.chunk:
+            req.stream._put_tokens(req.buf)
+            req.buf = []
+
+    def _lane_loop(self, lane):
+        sess = lane.session
+        eos = self.predictor.eos_id
+        while True:
+            with self._cv:
+                while not lane.assigned and not self._admissible(lane):
+                    if self._stopped:
+                        return
+                    self._cv.wait(0.1)
+                if self._stopped and not lane.assigned:
+                    return
+                admits = self._take_admits(lane) \
+                    if self._admissible(lane) else []
+            # prefill OUTSIDE the lock: other lanes keep decoding
+            for req in admits:
+                self._prefill(lane, req)
+            if not lane.assigned:
+                continue
+            t0 = time.monotonic()
+            delay = _chaos_delay()
+            if delay:
+                # the same slow-worker chaos hook / deterministic
+                # per-step device-cost stand-in as the one-shot lanes
+                # (set_dispatch_delay — bench_serving --step_cost_ms)
+                time.sleep(delay)
+            toks = sess.decode()
+            now = time.monotonic()
+            lane.steps += 1
+            if self.metrics is not None:
+                self.metrics.decode_steps.add()
+            if obs_tracing.enabled():
+                obs_tracing.add_span(obs_tracing.Span(
+                    "serving/decode_step", kind="serving",
+                    ts=time.time() - (now - t0),
+                    dur_ms=(now - t0) * 1e3,
+                    attrs={"model": self._model_name or "",
+                           "replica": lane.index,
+                           "slots": len(lane.assigned)}))
+            emitted = 0
+            for slot, req in list(lane.assigned.items()):
+                tok = int(toks[slot])
+                req.gen.append(tok)
+                req.buf.append(tok)
+                emitted += 1
+                if req.stream.cancelled():
+                    # client gone: nobody reads the flush — just free
+                    req.buf = []
+                    self._finish(lane, slot, req, "cancelled")
+                    continue
+                if req.deadline is not None and now > req.deadline:
+                    self._expire(lane, slot, req, now)
+                    continue
+                if tok == eos:
+                    self._finish(lane, slot, req, "eos")
+                elif len(req.gen) >= req.max_new or \
+                        sess.room(slot) <= 0:
+                    self._finish(lane, slot, req, "length")
+                elif len(req.buf) >= req.chunk:
+                    req.stream._put_tokens(req.buf)
+                    req.buf = []
+            lane.tokens += emitted
+            if self.metrics is not None and emitted:
+                self.metrics.note_tokens(emitted)
+            with self._cv:
+                self._cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def _busy(self):
+        return bool(self._pending
+                    or any(l.assigned for l in self._lanes))
+
+    def drain(self, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            self._cv.notify_all()
+            while self._busy():
+                rem = None if deadline is None else \
+                    max(deadline - time.monotonic(), 0.0)
+                if rem == 0.0:
+                    raise TimeoutError(
+                        "decode batcher still has %d queued + %d "
+                        "in-slot requests after %.1fs"
+                        % (len(self._pending),
+                           sum(len(l.assigned) for l in self._lanes),
+                           timeout))
+                self._cv.wait(0.05 if rem is None else min(rem, 0.05))
+
+    def close(self, drain=True, timeout=30.0):
+        with self._cv:
+            self._closing = True
+            self._cv.notify_all()
+        if drain:
+            self.drain(timeout)
+        with self._cv:
+            self._stopped = True
+            leftovers = list(self._pending)
+            self._pending.clear()
+            for lane in self._lanes:
+                for req in lane.assigned.values():
+                    req.stream.cancel()
+            self._cv.notify_all()
+        for req in leftovers:
+            req.stream._fail(
+                BatcherClosed("server shut down before dispatch"))
+            if self.metrics is not None:
+                self.metrics.errors.add()
+        for t in self._threads:
+            t.join(timeout=10.0)

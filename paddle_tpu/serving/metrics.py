@@ -131,10 +131,22 @@ class ModelMetrics:
         self.compile_cache_hits = Counter()
         self.compile_cache_misses = Counter()
         self.compile_ms = Counter()
+        # generation telemetry (SERVING.md continuous batching): one
+        # stream = one autoregressive request; tokens are the decode
+        # throughput unit, TTFT the decode latency unit
+        self.streams = Counter()         # streaming requests admitted
+        self.prefills = Counter()        # prefill phases run
+        self.decode_tokens = Counter()   # generated tokens emitted
+        self.decode_steps = Counter()    # whole-slot-table step launches
+        self.ttft_ms = ReservoirHistogram()  # time to first token
+        self._token_stamps = collections.deque()  # (t, n) recent window
         self.queue_depth_fn = None
         # installed by the batcher: live per-replica lane snapshot
         # (device id, in-flight, lane queue, batches/rows executed)
         self.replica_stats_fn = None
+        # installed by the decode batcher: live (occupied, total) slot
+        # count across this model's lanes — the occupancy gauge
+        self.slot_occupancy_fn = None
         self._shed_by_priority = {}      # priority class -> shed count
         self._started = time.monotonic()
         self._completions = collections.deque()
@@ -167,6 +179,41 @@ class ModelMetrics:
         self.compile_cache_hits.add(int(delta.get("hits", 0)))
         self.compile_cache_misses.add(int(delta.get("misses", 0)))
         self.compile_ms.add(int(round(delta.get("compile_ms", 0.0))))
+
+    def note_prefill(self, ttft_ms):
+        """One prefill completed: the request's first token exists —
+        the TTFT instant (time_to_first_token satellite metric)."""
+        self.prefills.add()
+        self.ttft_ms.record(ttft_ms)
+
+    def note_tokens(self, n):
+        """`n` generated tokens emitted (across whatever slots the step
+        served); feeds both the lifetime counter and the recent
+        tokens/sec window."""
+        self.decode_tokens.add(n)
+        now = time.monotonic()
+        with self._lock:
+            self._token_stamps.append((now, int(n)))
+            horizon = now - self.QPS_WINDOW_SECS
+            while self._token_stamps and \
+                    self._token_stamps[0][0] < horizon:
+                self._token_stamps.popleft()
+
+    def tokens_per_sec(self):
+        """Recent-window aggregate generation rate — the continuous-
+        batching acceptance number (>= 2x static batching on the mixed-
+        length lane)."""
+        now = time.monotonic()
+        with self._lock:
+            horizon = now - self.QPS_WINDOW_SECS
+            while self._token_stamps and \
+                    self._token_stamps[0][0] < horizon:
+                self._token_stamps.popleft()
+            total = sum(n for _, n in self._token_stamps)
+            if not total:
+                return 0.0
+            span = min(self.QPS_WINDOW_SECS, now - self._started)
+        return total / max(span, 1e-9)
 
     def note_dispatch(self, n_requests, real_rows, padded_rows):
         self.dispatches.add()
@@ -221,6 +268,24 @@ class ModelMetrics:
                 "compile_ms": self.compile_ms.value,
             },
         }
+        if self.streams.value or self.slot_occupancy_fn is not None:
+            # generation telemetry, flat keys so the Prometheus render
+            # and serving_top pick them up with zero schema plumbing
+            snap["streams"] = self.streams.value
+            snap["prefills"] = self.prefills.value
+            snap["decode_tokens"] = self.decode_tokens.value
+            snap["decode_steps"] = self.decode_steps.value
+            snap["tokens_per_sec"] = round(self.tokens_per_sec(), 3)
+            snap["ttft_ms"] = self.ttft_ms.summary()
+            if self.slot_occupancy_fn is not None:
+                try:
+                    occupied, total = self.slot_occupancy_fn()
+                    snap["slot_occupancy"] = round(
+                        occupied / total, 3) if total else 0.0
+                    snap["decode_slots"] = int(total)
+                    snap["decode_slots_busy"] = int(occupied)
+                except Exception:
+                    snap["slot_occupancy"] = -1.0
         if self.queue_depth_fn is not None:
             try:
                 snap["queue_depth"] = int(self.queue_depth_fn())

@@ -40,7 +40,7 @@ import numpy as np
 
 from ..flags import FLAGS
 from ..obs import events as obs_events
-from .batcher import DynamicBatcher
+from .batcher import DecodeBatcher, DynamicBatcher
 from .metrics import ServingMetrics
 
 __all__ = ["ModelRegistry", "ModelEntry", "open_predictor",
@@ -108,8 +108,15 @@ def resolve_placement(spec=None):
 
 def open_predictor(path, buckets=None, device=None):
     """Open a serving artifact directory as the right predictor type,
-    optionally pinned to `device` (a jax.Device)."""
+    optionally pinned to `device` (a jax.Device).  Detection: a
+    `decode_meta.bin` dir is an autoregressive decode artifact
+    (GenerativePredictor — continuous-batching generation); an
+    `aot_meta.bin` dir a save_aot artifact; anything else a
+    save_inference_model dir."""
     from ..inference import AnalysisConfig, Predictor, AotPredictor
+    from ..inference.decode import DECODE_META, GenerativePredictor
+    if os.path.exists(os.path.join(path, DECODE_META)):
+        return GenerativePredictor(path, device=device)
     if os.path.exists(os.path.join(path, "aot_meta.bin")):
         return AotPredictor(path, device=device)
     if not os.path.isdir(path):
@@ -155,6 +162,10 @@ class ModelEntry:
         from ..inference.predictor import _device_label
         return [_device_label(d) for d in self.devices]
 
+    @property
+    def is_decode(self):
+        return bool(getattr(self.predictor, "is_decode", False))
+
     def warm(self):
         """Run one zero dummy batch per bucket DIRECTLY on EVERY
         replica predictor (not through the batcher — warming must not
@@ -162,7 +173,25 @@ class ModelEntry:
         compiled/loaded on every replica's device and the first real
         request at any size on any lane runs at steady-state latency.
         The hot-swap commit discipline hinges on this covering the
-        whole replica set BEFORE the `latest` flip."""
+        whole replica set BEFORE the `latest` flip.
+
+        Decode models warm BOTH phases: every prompt-bucket prefill
+        plus the fixed-shape slot-table decode step, on a scratch
+        session per replica (the lane sessions share the resolved
+        executables, so the first real stream pays no compile)."""
+        if self.is_decode:
+            n_slots = self.batcher.n_slots
+            for pred in self.replicas:
+                sess = pred.new_session(n_slots)
+                for bucket in pred.prefill_buckets():
+                    # a prompt filling the whole cache is unservable
+                    # (no room to generate), so the largest bucket is
+                    # warmed with the longest SERVABLE prompt length
+                    n = min(bucket, pred.max_seq_len - 1)
+                    sess.prefill(0, [0] * n)
+                    sess.decode()
+                    sess.free(0)
+            return self
         specs = self.predictor.feed_specs()
         buckets = self.predictor.batch_buckets() or (1,)
         batched = self.predictor.batched_feed_names()
@@ -197,23 +226,36 @@ class ModelRegistry:
 
     def load_model(self, name, path, version=None, warm=True,
                    buckets=None, drain_timeout=30.0, replicas=None,
-                   devices=None):
+                   devices=None, decode_slots=None, decode_mode=None):
         """Load (or hot-swap in) `path` as `name`.  Returns the entry.
         `replicas`/`devices` override the registry's default placement
         spec (see resolve_placement).  ALL replicas are built and
         warmed before the flip; the displaced latest version's replica
         set, if any, is drained and retired AFTER the flip — in-flight
-        requests on it complete."""
+        requests on it complete.
+
+        A decode artifact (decode_meta.bin) is fronted by a
+        DecodeBatcher instead: per-replica slot tables of
+        `decode_slots` (default FLAGS.serving_decode_slots) with
+        continuous batching; `decode_mode="static"` keeps the
+        static-batch baseline (bench comparison only)."""
         from .. import compile_cache
         spec = devices if devices is not None else (
             replicas if replicas is not None else self._replicas)
         placement = resolve_placement(spec)
         cc_before = compile_cache.stats()
         preds = _build_replicas(path, buckets, placement)
-        batcher = DynamicBatcher(
-            preds[0], max_queue=self._max_queue,
-            deadline_ms=self._deadline_ms, workers=self._workers,
-            metrics=self.metrics.model(name), replicas=preds)
+        if getattr(preds[0], "is_decode", False):
+            batcher = DecodeBatcher(
+                preds[0], replicas=preds, n_slots=decode_slots,
+                max_queue=self._max_queue,
+                metrics=self.metrics.model(name),
+                continuous=(decode_mode != "static"))
+        else:
+            batcher = DynamicBatcher(
+                preds[0], max_queue=self._max_queue,
+                deadline_ms=self._deadline_ms, workers=self._workers,
+                metrics=self.metrics.model(name), replicas=preds)
         entry = ModelEntry(name, version, path, preds[0], batcher,
                            replicas=preds, devices=placement)
         if warm:
@@ -289,6 +331,14 @@ class ModelRegistry:
                         latest.predictor.batch_buckets())
                     info["replicas"] = len(latest.replicas)
                     info["devices"] = latest.device_labels()
+                    if latest.is_decode:
+                        # decode entry: buckets above are the PROMPT
+                        # prefill buckets; surface the generation shape
+                        info["decode"] = True
+                        info["decode_slots"] = latest.batcher.n_slots
+                        info["max_seq_len"] = \
+                            latest.predictor.max_seq_len
+                        info["eos_id"] = latest.predictor.eos_id
                 else:
                     info["buckets"] = []
                 out[name] = info
@@ -296,25 +346,64 @@ class ModelRegistry:
 
     # ------------------------------------------------------------------
 
+    def _entry_locked(self, name, version):
+        slot = self._models.get(name)
+        if slot is None:
+            raise KeyError("no model %r" % name)
+        v = slot["latest"] if version is None else version
+        entry = slot["versions"].get(v)
+        if entry is None:
+            raise KeyError("model %r has no version %r" % (name, v))
+        return entry
+
     def submit(self, name, feeds, version=None, deadline=None,
-               priority=0, trace_id=None):
+               priority=0, trace_id=None, max_new_tokens=None,
+               chunk_tokens=None):
         """Route one request; returns the batcher Future.  Resolution
         and submit happen under ONE lock acquisition so a concurrent hot
         swap can never retire a version between the two (the no-dropped-
         request guarantee: the swap's drain only starts after the flip,
         and every pre-flip submit is already queued).  `trace_id` rides
-        through to the batcher's stage spans (OBSERVABILITY.md)."""
+        through to the batcher's stage spans (OBSERVABILITY.md).
+
+        On a DECODE entry, `feeds` must carry the prompt as "tokens";
+        the returned DecodeStream duck-types the batcher Future
+        (`result()` -> [generated int32 tokens]), so one-shot `infer`
+        callers work unchanged — streaming callers use submit_stream."""
         with self._lock:
-            slot = self._models.get(name)
-            if slot is None:
-                raise KeyError("no model %r" % name)
-            v = slot["latest"] if version is None else version
-            entry = slot["versions"].get(v)
-            if entry is None:
-                raise KeyError("model %r has no version %r" % (name, v))
+            entry = self._entry_locked(name, version)
+            if entry.is_decode:
+                if not isinstance(feeds, dict) or "tokens" not in feeds:
+                    raise ValueError(
+                        "decode model %r takes feeds {'tokens': "
+                        "int array}, got %s"
+                        % (name, sorted(feeds) if isinstance(feeds, dict)
+                           else type(feeds).__name__))
+                return entry.batcher.submit(
+                    feeds["tokens"], max_new_tokens=max_new_tokens,
+                    deadline=deadline, priority=priority,
+                    trace_id=trace_id, chunk_tokens=chunk_tokens)
             return entry.batcher.submit(feeds, deadline=deadline,
                                         priority=priority,
                                         trace_id=trace_id)
+
+    def submit_stream(self, name, tokens, version=None,
+                      max_new_tokens=None, deadline=None, priority=0,
+                      trace_id=None, chunk_tokens=None):
+        """Streaming generation entry point: returns the DecodeStream
+        whose token chunks the server's `infer_stream` verb flushes to
+        the wire as they decode.  Same single-lock resolution contract
+        as submit()."""
+        with self._lock:
+            entry = self._entry_locked(name, version)
+            if not entry.is_decode:
+                raise ValueError(
+                    "model %r is not a decode model — infer_stream "
+                    "serves autoregressive artifacts only" % name)
+            return entry.batcher.submit(
+                tokens, max_new_tokens=max_new_tokens,
+                deadline=deadline, priority=priority,
+                trace_id=trace_id, chunk_tokens=chunk_tokens)
 
     def infer(self, name, feeds, version=None, deadline=None,
               timeout=None, priority=0):

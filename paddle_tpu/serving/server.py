@@ -128,6 +128,13 @@ class InferenceServer:
                 try:
                     while True:
                         msg = _recv_msg(self.request)
+                        if msg.get("cmd") == "infer_stream":
+                            # chunked reply: the stream handler owns the
+                            # socket until its final frame (or the
+                            # connection dies — which cancels the
+                            # stream so its slot frees within one step)
+                            outer._handle_infer_stream(msg, self.request)
+                            continue
                         try:
                             reply = outer._dispatch(msg)
                         except BaseException as e:
@@ -223,15 +230,23 @@ class InferenceServer:
                 msg["name"], msg["path"], version=msg.get("version"),
                 buckets=msg.get("buckets") or self._default_buckets,
                 replicas=msg.get("replicas"),
-                devices=msg.get("devices"))
-            return {"ok": True, "name": entry.name,
-                    "version": entry.version,
-                    "buckets": list(entry.predictor.batch_buckets()),
-                    "replicas": len(entry.replicas),
-                    "devices": entry.device_labels(),
-                    # what THIS load/flip cost against the persistent
-                    # compile cache: a warm flip reads hits=N, misses=0
-                    "compile_cache": dict(entry.compile_cache)}
+                devices=msg.get("devices"),
+                decode_slots=msg.get("decode_slots"),
+                decode_mode=msg.get("decode_mode"))
+            reply = {"ok": True, "name": entry.name,
+                     "version": entry.version,
+                     "buckets": list(entry.predictor.batch_buckets()),
+                     "replicas": len(entry.replicas),
+                     "devices": entry.device_labels(),
+                     # what THIS load/flip cost against the persistent
+                     # compile cache: a warm flip reads hits=N, misses=0
+                     "compile_cache": dict(entry.compile_cache)}
+            if entry.is_decode:
+                reply["decode"] = True
+                reply["decode_slots"] = entry.batcher.n_slots
+                reply["max_seq_len"] = entry.predictor.max_seq_len
+                reply["eos_id"] = entry.predictor.eos_id
+            return reply
         if cmd == "unload_model":
             self.registry.unload_model(msg["name"])
             return {"ok": True}
@@ -269,7 +284,8 @@ class InferenceServer:
                 name, feeds, version=msg.get("version"),
                 deadline=deadline,
                 priority=int(msg.get("priority", 0)),
-                trace_id=trace_id)
+                trace_id=trace_id,
+                max_new_tokens=msg.get("max_new_tokens"))
             try:
                 fetches = future.result(timeout=wait)
             except DeadlineExceeded:
@@ -282,6 +298,10 @@ class InferenceServer:
                        else wait * 1e3))
         reply = {"ok": True, "trace_id": trace_id,
                  "fetches": [np.ascontiguousarray(a) for a in fetches]}
+        if getattr(future, "finish_reason", None):
+            # decode model served through the one-shot verb: the whole
+            # greedy stream comes back as fetches[0] plus why it ended
+            reply["finish_reason"] = str(future.finish_reason)
         if msg.get("debug"):
             # opt-in latency attribution: the server-measured stage
             # timings ride back on the reply, so a client can see where
@@ -290,6 +310,72 @@ class InferenceServer:
             reply["debug"] = dict(getattr(future, "obs_info", None)
                                   or {"trace_id": trace_id})
         return reply
+
+    def _handle_infer_stream(self, msg, sock):
+        """Chunked streaming generation (`infer_stream` verb): token
+        deltas flush to the wire as the decode loop emits them —
+        {"chunk": True, "seq": i, "tokens": [...], "trace_id"} frames,
+        then exactly one terminal frame ({"ok": True, "done": True,
+        "finish_reason", "new_tokens", ...} or {"error", "code",
+        "done": True}).  Every frame carries the trace_id.  A dead
+        client connection (send failure) CANCELS the stream, so its
+        decode slot frees — and zeroes — within one step."""
+        trace_id = str(msg.get("trace_id") or obs_tracing.new_trace_id())
+        stream = None
+        try:
+            if self._draining:
+                raise ServerOverloaded(
+                    "server is draining — request refused")
+            tokens = msg.get("tokens")
+            if tokens is None:
+                raise ValueError(
+                    "infer_stream needs a 'tokens' prompt array")
+            deadline_ms = msg.get("deadline_ms")
+            deadline = None
+            if deadline_ms is not None:
+                deadline = time.monotonic() + float(deadline_ms) / 1000.0
+            stream = self.registry.submit_stream(
+                msg["model"], tokens, version=msg.get("version"),
+                max_new_tokens=msg.get("max_new_tokens"),
+                deadline=deadline,
+                priority=int(msg.get("priority", 0)),
+                trace_id=trace_id,
+                chunk_tokens=msg.get("stream_chunk_tokens"))
+        except BaseException as e:
+            reply = _error_reply(e)
+            reply["done"] = True
+            reply["trace_id"] = trace_id
+            _send_msg(sock, reply)
+            return
+        seq = 0
+        try:
+            for kind, payload in stream.events():
+                if kind == "tokens":
+                    _send_msg(sock, {"chunk": True, "seq": seq,
+                                     "tokens": [int(t) for t in payload],
+                                     "trace_id": trace_id})
+                    seq += 1
+                elif kind == "error":
+                    reply = _error_reply(payload)
+                    reply["done"] = True
+                    reply["trace_id"] = trace_id
+                    reply["new_tokens"] = len(stream.tokens)
+                    _send_msg(sock, reply)
+                else:  # done
+                    final = {"ok": True, "done": True,
+                             "trace_id": trace_id,
+                             "finish_reason": str(payload),
+                             "new_tokens": len(stream.tokens)}
+                    if msg.get("debug"):
+                        final["debug"] = dict(stream.obs_info
+                                              or {"trace_id": trace_id})
+                    _send_msg(sock, final)
+        except (ConnectionError, EOFError, OSError, WireError):
+            # client went away mid-stream: evict the request so its
+            # slot is reclaimed for waiting traffic (chaos scenario
+            # decode-disconnect pins the one-step bound)
+            stream.cancel()
+            raise
 
 
 class ServingClient:
@@ -306,6 +392,7 @@ class ServingClient:
         self.endpoint = endpoint
         self.deadline_ms = deadline_ms
         self.last_trace_id = None
+        self.last_stream_info = None  # final infer_stream frame metadata
         self._policy = retry_policy
         self._tls = threading.local()
 
@@ -359,9 +446,91 @@ class ServingClient:
             else None,
             deadline=retry_deadline)
 
+    def infer_stream(self, model, tokens, max_new_tokens=None,
+                     deadline_ms=None, version=None, priority=None,
+                     trace_id=None, chunk_tokens=None, debug=False):
+        """Streaming generation: returns an iterator yielding token-
+        delta lists as the server decodes them (the `infer_stream`
+        verb's chunk frames).  The final frame's metadata lands on
+        ``self.last_stream_info`` (finish_reason, new_tokens, trace_id,
+        + server stage timings with ``debug=True``) when the iterator
+        completes.  A mid-stream error surfaces as the typed exception
+        (ServerOverloaded / DeadlineExceeded / ServingError) at the
+        point of failure — tokens already yielded are real.  Closing
+        the iterator early drops the connection, which tells the server
+        to evict the request from its decode slot.
+
+        The streaming reply uses a dedicated connection (frames would
+        desync the request/reply socket), torn down when the stream
+        ends or the iterator is closed."""
+        msg = {"cmd": "infer_stream", "model": model,
+               "tokens": np.ascontiguousarray(
+                   np.asarray(tokens, np.int32))}
+        if max_new_tokens is not None:
+            msg["max_new_tokens"] = int(max_new_tokens)
+        if deadline_ms is not None:
+            msg["deadline_ms"] = float(deadline_ms)
+        if version is not None:
+            msg["version"] = version
+        if priority is not None:
+            msg["priority"] = int(priority)
+        if trace_id is not None:
+            msg["trace_id"] = str(trace_id)
+        if chunk_tokens is not None:
+            msg["stream_chunk_tokens"] = int(chunk_tokens)
+        if debug:
+            msg["debug"] = True
+        self.last_stream_info = None
+
+        def _gen():
+            host, port = self.endpoint.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=FLAGS.rpc_deadline)
+            finished = False
+            try:
+                _send_msg(s, msg)
+                while True:
+                    reply = _recv_msg(s)
+                    if "error" in reply:
+                        finished = True
+                        self.last_stream_info = {
+                            k: reply[k] for k in
+                            ("trace_id", "new_tokens", "code")
+                            if k in reply}
+                        self.last_trace_id = reply.get("trace_id")
+                        code = reply.get("code")
+                        if code == "overloaded":
+                            raise ServerOverloaded(
+                                reply["error"],
+                                priority=reply.get("shed_priority"))
+                        if code == "deadline":
+                            raise DeadlineExceeded(reply["error"])
+                        raise ServingError("%s (code=%s)"
+                                           % (reply["error"], code))
+                    if reply.get("chunk"):
+                        yield [int(t) for t in reply["tokens"]]
+                        continue
+                    finished = True
+                    self.last_stream_info = {
+                        k: v for k, v in reply.items() if k != "ok"}
+                    self.last_trace_id = reply.get("trace_id")
+                    return
+            finally:
+                # early close (or any exit): this connection never
+                # carries another request — a dropped socket is also
+                # the eviction signal for an abandoned stream
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                if not finished:
+                    pass  # server notices the dead socket on next flush
+
+        return _gen()
+
     def infer(self, model, feeds, deadline_ms=None, version=None,
               retry_sheds=None, priority=None, debug=False,
-              trace_id=None):
+              trace_id=None, max_new_tokens=None):
         """Run one request.  Returns the fetch list; with
         ``debug=True`` returns ``(fetches, info)`` where ``info`` is
         the server-measured latency attribution (trace_id,
@@ -377,6 +546,10 @@ class ServingClient:
                          for k, v in feeds.items()}}
         if version is not None:
             msg["version"] = version
+        if max_new_tokens is not None:
+            # decode models through the one-shot verb: the whole greedy
+            # stream returns as fetches[0]
+            msg["max_new_tokens"] = int(max_new_tokens)
         if priority is not None:
             # forwarded to admission control: larger = more important;
             # under overload the server sheds lowest-priority-first
@@ -403,7 +576,8 @@ class ServingClient:
         return fetches
 
     def load_model(self, name, path, version=None, buckets=None,
-                   replicas=None, devices=None):
+                   replicas=None, devices=None, decode_slots=None,
+                   decode_mode=None):
         msg = {"cmd": "load_model", "name": name, "path": path}
         if version is not None:
             msg["version"] = version
@@ -415,6 +589,11 @@ class ServingClient:
                 else int(replicas)
         if devices is not None:
             msg["devices"] = [str(d) for d in devices]
+        if decode_slots is not None:
+            msg["decode_slots"] = int(decode_slots)
+        if decode_mode is not None:
+            # "static" = the static-batch baseline (bench lanes only)
+            msg["decode_mode"] = str(decode_mode)
         return self._call(msg)
 
     def unload_model(self, name):

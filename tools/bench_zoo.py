@@ -97,6 +97,24 @@ CONFIGS = [
       "--force_host_devices", "4", "--dispatch_cost_ms", "20",
       "--qps", "250", "--duration", "8", "--deadline_ms", "4000",
       "--max_queue", "32"], 1, 1),
+    # continuous-batching decode lanes (SERVING.md "Continuous batching
+    # & streaming"): identical seeded mixed-output-length streaming
+    # workloads against the slot-table decode path, static whole-batch
+    # scheduling vs continuous backfill. --step_cost_ms 20 is the
+    # deterministic per-decode-step device-time stand-in (GIL released,
+    # same discipline as --dispatch_cost_ms) that makes capacity
+    # slot-bound, so the cb/static tokens_per_sec ratio IS the
+    # scheduling win (>= 2x acceptance, BENCH_r10.json); offered load
+    # saturates both. Each record carries bit_exact: greedy streams
+    # replayed against a direct single-slot DecodeSession.
+    ("serving_decode_static",
+     ["@serving", "--decode", "--decode_mode", "static",
+      "--decode_slots", "8", "--step_cost_ms", "20", "--qps", "30",
+      "--duration", "8"], 8, 1),
+    ("serving_decode_cb",
+     ["@serving", "--decode", "--decode_mode", "cb",
+      "--decode_slots", "8", "--step_cost_ms", "20", "--qps", "30",
+      "--duration", "8"], 8, 1),
     # async-training-pipeline A/B (PIPELINE.md): same model, same
     # 40 ms/batch host stall (deterministic stand-in for host-side
     # preprocessing — the host-BOUND lane), prefetch + in-flight

@@ -32,6 +32,14 @@ exits nonzero on failure):
                entry + only a stale _tmp dir, and that the next boot
                serves correctly, recompiles ONLY the interrupted entry
                (hit=1 miss=1), and sweeps the stale tmp.
+  decode-disconnect
+               streaming-generation chaos (SERVING.md continuous
+               batching): a client disconnect mid-stream and a deadline
+               expiring MID-DECODE must each free the decode slot
+               within a few steps (typed error frame + deadline_expired
+               event for the latter), with zero wedged lanes and zero
+               cross-request KV leakage — reused slots serve bit-exact
+               greedy streams because freed slots are zeroed.
 
   --smoke      crash-save (deterministic `exit` fault at every commit
                point) + bit-flip, fast enough for tier-1.
@@ -690,6 +698,152 @@ def scenario_serving_overload(verbose=True):
     return outcomes
 
 
+def scenario_decode_disconnect(verbose=True):
+    """Continuous-batching decode chaos (SERVING.md "Continuous
+    batching & streaming"): streaming requests that die mid-generation
+    must not wedge the slot table.
+
+    Phase A — client disconnect mid-stream: a victim opens an
+    `infer_stream`, reads a few chunks, and drops the connection.  The
+    server's flush failure cancels the stream; required invariants:
+    (1) the slot frees within a handful of decode steps (the flush of
+    the NEXT token notices the dead socket, the step after that
+    reclaims the slot), (2) zero wedged lanes — later traffic on the
+    same (tiny) slot table completes.
+
+    Phase B — deadline expiry mid-decode: a stream whose deadline
+    expires while GENERATING (the PR 8 fix: deadlines cover in-decode
+    time, not just queue+reply wait) is evicted from its slot with a
+    typed error frame on the stream and a `deadline_expired` event
+    carrying its trace_id.
+
+    Phase C — no cross-request KV leakage: the victims' slots are
+    reused by fresh requests whose greedy token streams must be
+    IDENTICAL to a direct single-slot DecodeSession on the same
+    artifact — possible only if freed slots were zeroed before reuse.
+    """
+    import tempfile
+    from paddle_tpu.inference.decode import (GenerativePredictor,
+                                             build_tiny_decode_model,
+                                             greedy_decode)
+    from paddle_tpu.obs import events as obs_events
+    from paddle_tpu.serving import (DeadlineExceeded, InferenceServer,
+                                    ServingClient, set_dispatch_delay)
+
+    md = build_tiny_decode_model(
+        os.path.join(tempfile.mkdtemp(prefix="chaos_decode_"), "lm"),
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+        max_seq_len=64, eos_id=-1, seed=21)
+    pred = GenerativePredictor(md)
+    server = InferenceServer().start()
+    boot = ServingClient(server.endpoint)
+    step_ms = 20.0
+
+    def occupancy():
+        snap = boot.stats()["stats"]["models"]["lm"]
+        return snap.get("decode_slots_busy", 0), snap.get(
+            "decode_steps", 0)
+
+    try:
+        boot.load_model("lm", md, decode_slots=2)
+        # slow, deterministic steps so "mid-stream" is unambiguous
+        set_dispatch_delay(step_ms / 1000.0)
+
+        # ---- phase A: disconnect mid-stream ------------------------
+        victim = ServingClient(server.endpoint)
+        it = victim.infer_stream("lm", [3, 5, 7], max_new_tokens=48)
+        got = [t for _, t in zip(range(3), it)]
+        assert len(got) == 3, "victim stream never started"
+        busy_before, steps_at_drop = occupancy()
+        assert busy_before >= 1, "victim not occupying a slot"
+        it.close()       # drops the connection mid-stream
+        victim.close()
+        t0 = time.time()
+        freed_steps = None
+        while time.time() - t0 < 10.0:
+            busy, steps = occupancy()
+            if busy == 0:
+                freed_steps = steps - steps_at_drop
+                break
+            time.sleep(0.01)
+        assert freed_steps is not None, \
+            "slot still occupied 10s after client disconnect (wedged)"
+        # flush-of-next-token notices the dead socket, the step after
+        # reclaims; polling adds slack — a small step bound still
+        # proves the slot freed promptly, not at max_new_tokens
+        assert freed_steps <= 6, \
+            "slot took %d decode steps to free after disconnect" \
+            % freed_steps
+
+        # ---- phase B: deadline expires mid-decode ------------------
+        cli = ServingClient(server.endpoint)
+        tokens_before_expiry = 0
+        expired = False
+        try:
+            for chunk in cli.infer_stream("lm", [9, 4], deadline_ms=200.0,
+                                          max_new_tokens=60,
+                                          trace_id="chaosdl"):
+                tokens_before_expiry += len(chunk)
+        except DeadlineExceeded:
+            expired = True
+        finally:
+            cli.close()
+        assert expired, "deadline never expired mid-stream"
+        assert tokens_before_expiry >= 1, \
+            "stream expired before generating (not an IN-DECODE expiry)"
+        ev = [e for e in obs_events.recent_events(kind="deadline_expired")
+              if e.get("trace_id") == "chaosdl"]
+        assert ev, "no deadline_expired event with the stream's trace_id"
+        assert ev[-1].get("tokens", 0) >= 1, \
+            "deadline_expired event missing in-decode token count"
+
+        # ---- phase C: slot reuse, zero leakage, zero wedged lanes --
+        set_dispatch_delay(0.0)
+        prompts = [[3, 5, 7], [9, 4], [11, 12, 13, 14], [2]]
+        refs = [greedy_decode(pred, p, 12)[0] for p in prompts]
+        outs = [None] * len(prompts)
+        errs = []
+
+        def rerun(i):
+            c = ServingClient(server.endpoint)
+            try:
+                outs[i] = [t for ch in c.infer_stream(
+                    "lm", prompts[i], max_new_tokens=12,
+                    deadline_ms=60000.0) for t in ch]
+            except Exception as e:
+                errs.append(e)
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=rerun, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), \
+            "post-chaos traffic hung (wedged lane)"
+        assert not errs, "post-chaos traffic failed: %r" % errs[:2]
+        for i, (out, ref) in enumerate(zip(outs, refs)):
+            assert out == ref, \
+                ("KV leakage: reused slot changed request %d's tokens "
+                 "(%s vs %s)" % (i, out, ref))
+        busy, _ = occupancy()
+        assert busy == 0, "slots still occupied after drain"
+    finally:
+        set_dispatch_delay(0.0)
+        boot.close()
+        server.shutdown(drain=False, timeout=10.0)
+    if verbose:
+        print("PASS decode-disconnect: slot freed in %d step(s) after "
+              "disconnect, deadline evicted mid-decode after %d "
+              "token(s) with event, %d post-chaos streams bit-exact "
+              "on reused slots"
+              % (freed_steps, tokens_before_expiry, len(prompts)))
+    return {"freed_steps": freed_steps,
+            "expired_tokens": tokens_before_expiry}
+
+
 def scenario_trace_overflow(workdir, verbose=True):
     """Observability hot-path safety (OBSERVABILITY.md): the span ring
     wraps under concurrent load and the event log rotates mid-write —
@@ -834,7 +988,8 @@ def main(argv=None):
                                            "nan-poison", "drop-rpc",
                                            "serving-overload",
                                            "cache-commit",
-                                           "trace-overflow", "all"])
+                                           "trace-overflow",
+                                           "decode-disconnect", "all"])
     ap.add_argument("--smoke", action="store_true",
                     help="fast deterministic subset for CI")
     ap.add_argument("--workdir", default=None)
@@ -868,7 +1023,7 @@ def main(argv=None):
     if args.scenario in (None, "all"):
         scenarios = ["crash-save", "bit-flip", "nan-poison", "drop-rpc",
                      "serving-overload", "cache-commit",
-                     "trace-overflow"]
+                     "trace-overflow", "decode-disconnect"]
     else:
         scenarios = [args.scenario]
     rc = 0
@@ -897,6 +1052,8 @@ def main(argv=None):
             elif s == "trace-overflow":
                 scenario_trace_overflow(
                     os.path.join(workdir, "trace_overflow"))
+            elif s == "decode-disconnect":
+                scenario_decode_disconnect()
         except AssertionError as e:
             rc = 1
             print("FAIL %s: %s" % (s, e))

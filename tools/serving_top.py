@@ -36,9 +36,11 @@ def render(reply):
     desc = reply.get("models", {})
     lines = ["server uptime %.0fs, %d model(s)"
              % (stats.get("uptime_sec", 0.0), len(models)), ""]
-    hdr = ("%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s"
+    hdr = ("%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
+           "%7s %7s %5s"
            % ("MODEL", "VER", "QPS", "REQS", "p50ms", "p95ms", "p99ms",
-              "FILL", "BKT%", "QUEUE", "SHED", "CCH/M"))
+              "FILL", "BKT%", "QUEUE", "SHED", "CCH/M",
+              "TTFT95", "TPS", "OCC%"))
     lines.append(hdr)
     lines.append("-" * len(hdr))
     for name in sorted(models):
@@ -50,19 +52,31 @@ def render(reply):
         # "N/0" on a warm boot means zero fresh compilations
         cc_col = "%s/%s" % (cc.get("hits", 0), cc.get("misses", 0)) \
             if cc else "-"
+        # decode models (SERVING.md continuous batching): TTFT p95,
+        # aggregate tokens/sec, and slot occupancy; "-" otherwise
+        ttft = (m.get("ttft_ms") or {}).get("p95")
+        tps = m.get("tokens_per_sec")
+        occ = m.get("slot_occupancy")
         lines.append(
-            "%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s"
+            "%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
+            "%7s %7s %5s"
             % (name[:14], _fmt(d.get("latest")),
                _fmt(m.get("qps_recent")), _fmt(m.get("requests")),
                _fmt(lat.get("p50")), _fmt(lat.get("p95")),
                _fmt(lat.get("p99")), _fmt(m.get("batch_fill")),
                _fmt(round(100.0 * m.get("bucket_fill_ratio", 0.0), 1)),
                _fmt(m.get("queue_depth")), _fmt(m.get("shed")),
-               cc_col))
+               cc_col, _fmt(ttft), _fmt(tps),
+               _fmt(round(100.0 * occ, 1) if isinstance(occ, float)
+                    and occ >= 0 else None)))
         if d.get("buckets"):
-            lines.append("    buckets=%s versions=%s replicas=%s"
+            extra = ""
+            if d.get("decode"):
+                extra = " decode_slots=%s max_seq_len=%s" % (
+                    d.get("decode_slots"), d.get("max_seq_len"))
+            lines.append("    buckets=%s versions=%s replicas=%s%s"
                          % (d["buckets"], d.get("versions"),
-                            d.get("replicas", 1)))
+                            d.get("replicas", 1), extra))
         shed_pri = m.get("shed_by_priority")
         if shed_pri:
             lines.append("    shed_by_priority=%s" % (shed_pri,))

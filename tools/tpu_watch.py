@@ -154,6 +154,16 @@ def main():
                         "--replicas", "1,auto", "--model", "resnet",
                         "--qps", "200,800", "--duration", "15"], {},
          3600),
+        # continuous-batching decode on silicon (SERVING.md "Continuous
+        # batching & streaming"): the cb/static tokens_per_sec pair
+        # with REAL per-step device time (no --step_cost_ms stand-in —
+        # on chip the Pallas decode-attention kernel is the step cost),
+        # re-measuring the BENCH_r10.json CPU-smoke ratio; larger slot
+        # table since HBM, not host RAM, holds the slot caches
+        ("decode", ["tools/bench_serving.py", "--require_tpu",
+                    "--decode", "--decode_mode", "both",
+                    "--decode_slots", "16", "--qps", "60",
+                    "--duration", "15"], {}, 3600),
         # observability capture (OBSERVABILITY.md): one traced resnet
         # serving run + one traced train step on silicon, archiving the
         # MERGED chrome trace (obs stage spans + XLA device timeline)

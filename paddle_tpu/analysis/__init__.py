@@ -1,0 +1,47 @@
+"""Static program analysis — verifier passes over the Program IR.
+
+The ProgramDesc is a static graph the framework can inspect *before*
+execution (the paper's premise, and the pre-execution graph analysis the
+TensorFlow system paper exploits; the Julia-to-TPU paper treats whole-
+program shape inference as a compilability precondition).  This package
+turns that property into a checked contract: a suite of read-only
+analysis passes riding the fluid/ir_passes.py Pass substrate that catch
+graph bugs — uninitialized reads, shape/dtype conflicts, dead ops,
+unreachable fetches, programs that will silently miss the AOT compile
+cache — at build/load time instead of as runtime stack traces (or
+silent staleness) N steps in.
+
+Surfaces:
+  verify_program(program, feeds=, fetches=)  -> [Diagnostic]
+  check_program(...)        -> raises ProgramVerificationError on errors
+  FLAGS.verify_program      -> opt-in pre-run check in Executor /
+                               ParallelExecutor / Predictor (memoized per
+                               program version — build/load cost, never
+                               per-step)
+  save_inference_model / load_inference_model verify unconditionally —
+  the artifact boundary is where a broken graph becomes someone else's
+  3am page (ANALYSIS.md documents the policy).
+
+CLI twin: tools/lint_program.py (artifact dirs + the model zoo); the
+runtime-side concurrency lint lives in tools/lint_runtime.py.
+"""
+
+from .verifier import (
+    ANALYSIS_PASSES,
+    Diagnostic,
+    ProgramVerificationError,
+    check_program,
+    check_serialized_cached,
+    verify_program,
+    verify_program_cached,
+)
+
+__all__ = [
+    "ANALYSIS_PASSES",
+    "Diagnostic",
+    "ProgramVerificationError",
+    "check_program",
+    "check_serialized_cached",
+    "verify_program",
+    "verify_program_cached",
+]

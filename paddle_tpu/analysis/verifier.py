@@ -1,0 +1,654 @@
+"""Program verifier passes.
+
+Five read-only analysis passes over the Program IR, registered on the
+fluid/ir_passes.py Pass substrate (so ``get_pass("verify_shapes_pass")``
+works like any rewrite pass) but subclassing :class:`AnalysisPass`,
+which collects :class:`Diagnostic` records instead of mutating the graph
+— and deliberately does NOT bump the program version, so verifying a
+program never invalidates an executor's compiled-step cache.
+
+Checks and their diagnostic ids:
+
+  verify_use_before_def_pass   use-before-def [error]    a var read by an
+      op before any op defined it (and it is not a feed / data var /
+      persistable); undefined-var [error] when the name resolves nowhere
+      in the block hierarchy.  Cross-block: sub-blocks see what their
+      parent defined *before* the owning op; writes a sub-block makes to
+      parent vars count as definitions after the owning op.  Loop bodies
+      (while / recurrent) are seeded with every name the body writes —
+      iteration N legitimately reads what iteration N-1 wrote, so only
+      reads no iteration could satisfy are flagged.
+
+  verify_shapes_pass   shape-mismatch [error], dtype-mismatch [error],
+      unregistered-op [error].  Static shape/dtype propagation: each op
+      whose input shapes are fully recorded is abstractly evaluated via
+      its registered lowering under jax.eval_shape (the registry's
+      infer_shape machinery, run in *checking* mode: a lowering that
+      raises, or disagrees with the recorded output var, is a diagnostic
+      instead of a silent skip).
+
+  verify_dead_code_pass   dead-op [warning], unused-var [warning].
+      With fetches known, backward reachability from fetches +
+      side-effecting ops (host / stateful / persistable-writing /
+      control-flow); without fetches, only vars that no op touches are
+      reported (any terminal op could be somebody's fetch target).
+
+  verify_fetch_reachability_pass   unknown-fetch [error],
+      unreachable-fetch [error], unused-feed [warning].  Forward
+      dataflow from feeds + persistables + data vars.
+
+  verify_aot_export_pass   aot-unexportable [warning], aot-ineligible
+      [warning].  Predicts — before any tracing — the compile cache's
+      ``_UNEXPORTABLE`` fallback (host ops cannot ride jax.export, see
+      inference/predictor.py) and the executor's ``_aot_cache_eligible``
+      gate (multi-block / *_grad / optimizer ops, executor.py), so a
+      serving artifact that will silently recompile every boot is
+      flagged at build time (COMPILE_CACHE.md).
+"""
+
+import collections
+
+from ..fluid.ir_passes import Pass, register_pass
+
+__all__ = ["Diagnostic", "ProgramVerificationError", "AnalysisPass",
+           "verify_program", "verify_program_cached", "check_program",
+           "ANALYSIS_PASSES"]
+
+
+class Diagnostic:
+    """One finding, locatable: block idx / op index / op type / var."""
+
+    __slots__ = ("check", "severity", "block", "op_index", "op_type",
+                 "var", "message")
+
+    def __init__(self, check, severity, message, block=None, op_index=None,
+                 op_type=None, var=None):
+        self.check = check
+        self.severity = severity          # "error" | "warning"
+        self.message = message
+        self.block = block
+        self.op_index = op_index
+        self.op_type = op_type
+        self.var = var
+
+    @property
+    def is_error(self):
+        return self.severity == "error"
+
+    def where(self):
+        parts = []
+        if self.block is not None:
+            parts.append("block %d" % self.block)
+        if self.op_index is not None:
+            parts.append("op %d" % self.op_index)
+        if self.op_type:
+            parts.append("(%s)" % self.op_type)
+        if self.var:
+            parts.append("var '%s'" % self.var)
+        return " ".join(parts)
+
+    def __repr__(self):
+        w = self.where()
+        return "%s[%s] %s%s" % (self.severity, self.check,
+                                w + ": " if w else "", self.message)
+
+    __str__ = __repr__
+
+
+class ProgramVerificationError(RuntimeError):
+    """The program verifier found error-severity findings.  Carries the
+    full diagnostic list (``.diagnostics``)."""
+
+    def __init__(self, diagnostics, what="program"):
+        self.diagnostics = list(diagnostics)
+        errs = [d for d in self.diagnostics if d.is_error]
+        lines = ["%s failed verification: %d error(s), %d warning(s)"
+                 % (what, len(errs), len(self.diagnostics) - len(errs))]
+        lines += ["  " + str(d) for d in self.diagnostics]
+        super().__init__("\n".join(lines))
+
+
+class AnalysisPass(Pass):
+    """Read-only pass: collects diagnostics, never mutates the program —
+    and never bumps the program version (a verify must not invalidate
+    the executor's (id, version)-keyed compiled-step cache)."""
+
+    def apply(self, program):
+        diags = self.attrs.setdefault("diagnostics", [])
+        self.analyze(program, diags)
+        return program
+
+    def analyze(self, program, diagnostics):
+        raise NotImplementedError
+
+    def diagnostics(self):
+        return list(self.attrs.get("diagnostics", ()))
+
+    # -- shared graph helpers ------------------------------------------
+
+    @staticmethod
+    def _known_defined(block, name, feeds):
+        """Defined without any op running: a feed, a data var, or a
+        persistable (params/buffers the scope carries across steps)."""
+        if feeds and name in feeds:
+            return True
+        v = block._find_var_recursive(name)
+        if v is None:
+            return None                       # resolves nowhere
+        return bool(v.persistable or v.is_data)
+
+    @staticmethod
+    def _subtree_writes(block, acc=None):
+        """Every name written by any op in `block` or its sub-blocks."""
+        acc = acc if acc is not None else set()
+        for op in block.ops:
+            acc.update(n for n in op.output_arg_names if n)
+            sub = op.attrs.get("sub_block")
+            if sub is not None:
+                AnalysisPass._subtree_writes(sub, acc)
+        return acc
+
+    @staticmethod
+    def _external_reads(block):
+        """Names `block`'s subtree reads that no earlier op in the same
+        subtree wrote — i.e. reads satisfied by the parent scope."""
+        local = set()
+        reads = []
+        for op in block.ops:
+            for n in op.input_arg_names:
+                if n and n not in local:
+                    reads.append(n)
+            sub = op.attrs.get("sub_block")
+            if sub is not None:
+                reads.extend(n for n in AnalysisPass._external_reads(sub)
+                             if n not in local)
+            local.update(n for n in op.output_arg_names if n)
+        return reads
+
+
+# loop-shaped sub-block owners: iteration N reads what iteration N-1
+# wrote, so ordered-walk use-before-def does not apply inside the body
+_LOOP_OPS = frozenset(["while", "recurrent"])
+
+# sub-block vars the owning op's execution harness injects into the step
+# environment (they are defined by the lowering, not by any op): the
+# recurrent op's per-step sequence slices, previous-state memories, and
+# pass-through external params (ops/control_flow_ops.py _recurrent)
+_SUB_BLOCK_INJECTED_ATTRS = {
+    "recurrent": ("seq_input_names", "state_prev_names", "param_names"),
+}
+
+
+def _is_side_effecting(op):
+    """Ops that must stay live regardless of dataflow: host side effects
+    (RPC/IO/py_func), stateful lowerings, control flow (its sub-block
+    may write parent vars the op does not declare), optimizer updates."""
+    from ..fluid import functionalizer
+    from ..ops import registry as op_registry
+    if op.attrs.get("sub_block") is not None:
+        return True
+    if functionalizer.is_host_op(op):
+        return True
+    od = op_registry._REGISTRY.get(op.type)
+    if od is not None and od.stateful:
+        return True
+    return False
+
+
+@register_pass
+class VerifyUseBeforeDefPass(AnalysisPass):
+    name = "verify_use_before_def_pass"
+
+    def analyze(self, program, diagnostics):
+        feeds = frozenset(self.get("feeds") or ())
+        self._walk(program.global_block(), set(), feeds, diagnostics)
+
+    def _walk(self, block, defined, feeds, out):
+        defined = set(defined)
+        for idx, op in enumerate(block.ops):
+            for slot, names in op.inputs.items():
+                for name in names:
+                    if not name or name in defined:
+                        continue
+                    known = self._known_defined(block, name, feeds)
+                    if known:
+                        defined.add(name)
+                        continue
+                    if known is None:
+                        out.append(Diagnostic(
+                            "undefined-var", "error",
+                            "input %s reads '%s', which exists nowhere "
+                            "in the block hierarchy" % (slot, name),
+                            block=block.idx, op_index=idx,
+                            op_type=op.type, var=name))
+                    else:
+                        out.append(Diagnostic(
+                            "use-before-def", "error",
+                            "input %s read before any op defines it "
+                            "(not a feed/data var, not persistable)"
+                            % slot,
+                            block=block.idx, op_index=idx,
+                            op_type=op.type, var=name))
+                    defined.add(name)     # report each name once
+            sub = op.attrs.get("sub_block")
+            if sub is not None:
+                inner = defined | {n for n in op.input_arg_names if n}
+                for attr in _SUB_BLOCK_INJECTED_ATTRS.get(op.type, ()):
+                    inner.update(n for n in (op.attrs.get(attr) or ())
+                                 if n)
+                if op.type in _LOOP_OPS:
+                    inner |= self._subtree_writes(sub)
+                self._walk(sub, inner, feeds, out)
+                # writes the sub-block makes to parent-scope vars are
+                # visible after the owning op (conditional_block outputs
+                # are undeclared on the op itself)
+                defined |= self._subtree_writes(sub)
+            defined.update(n for n in op.output_arg_names if n)
+
+
+# op types verify_shapes skips: their lowerings need the interpreter
+# environment (arrays / control flow write results into env), concrete
+# index values, or host execution — the registry's infer_shape skips
+# them for the same reason (each entry names why)
+_EVAL_SKIP_TYPES = frozenset([
+    "while", "conditional_block", "recurrent",   # env-mutating control flow
+    "while_grad_dynamic",                        # host replay
+    "write_to_array", "read_from_array",         # env arrays + concrete I
+    "array_length", "array_to_lod_tensor",       # env arrays
+    "lod_tensor_to_array", "max_sequence_len",   # env arrays / lod companion
+    "go", "channel_create", "channel_send",      # CSP: real channels/threads
+    "channel_recv", "channel_close",
+])
+
+
+def _dtype_family(np_dtype):
+    import numpy as np
+    k = np.dtype(np_dtype).kind
+    if k == "f":
+        return "float"
+    if k in "iub":
+        return "int"           # int/uint/bool interchange is tolerated
+    return k
+
+
+@register_pass
+class VerifyShapesPass(AnalysisPass):
+    name = "verify_shapes_pass"
+
+    def analyze(self, program, diagnostics):
+        # vars with multiple writers (assign-style re-binding) carry the
+        # LAST writer's recorded shape — comparing an earlier writer's
+        # inferred output against it would be a false conflict
+        writers = collections.Counter()
+        for block in program.blocks:
+            for op in block.ops:
+                writers.update(n for n in op.output_arg_names if n)
+        for block in program.blocks:
+            for idx, op in enumerate(block.ops):
+                self._check_op(block, idx, op, writers, diagnostics)
+
+    @staticmethod
+    def _dims_conflict(rec, inf):
+        if rec is None or inf is None:
+            return False
+        # squeeze unit dims before comparing: the IR tolerates rank-0 vs
+        # rank-1 scalars (mean's () loss vs fill_constant's (1,) seed)
+        # and keepdim variations — those execute fine under broadcasting
+        rec = [d for d in rec if d is None or int(d) != 1]
+        inf = [d for d in inf if d is None or int(d) != 1]
+        if len(rec) != len(inf):
+            return True
+        for a, b in zip(rec, inf):
+            if a is None or b is None or int(a) < 0 or int(b) < 0:
+                continue        # dynamic dim matches anything
+            if int(a) != int(b):
+                return True
+        return False
+
+    def _check_op(self, block, idx, op, writers, out):
+        from ..fluid import core as fcore
+        from ..fluid import functionalizer
+        from ..ops import registry as op_registry
+        from ..ops.optimizer_ops import MERGEABLE_OPT_OPS
+
+        if functionalizer.is_host_op(op) or \
+                op.attrs.get("sub_block") is not None:
+            return      # interpreted by the host/segmented path
+        od = op_registry._REGISTRY.get(op.type)
+        if od is None:
+            if op.type.endswith("_grad") and (
+                    "fwd_uid" in op.attrs
+                    or op_registry.has_op(op.type[:-len("_grad")])):
+                # generic vjp-based grad op: executed from the forward
+                # op's stashed closure, no standalone lowering to check
+                return
+            out.append(Diagnostic(
+                "unregistered-op", "error",
+                "op type has no registered lowering — the executor "
+                "will refuse this program", block=block.idx,
+                op_index=idx, op_type=op.type))
+            return
+        if (op.type in _EVAL_SKIP_TYPES or op.type in MERGEABLE_OPT_OPS
+                or od.custom_infer_shape is not None):
+            return
+        import jax
+        dummy = op_registry._pick_dummy(op, block)
+        in_structs = {}
+        for slot, names in op.inputs.items():
+            vals = []
+            for n in names:
+                v = block._find_var_recursive(n)
+                if v is None or v.shape is None:
+                    return          # inputs not fully recorded: no claim
+                vals.append(jax.ShapeDtypeStruct(
+                    op_registry._subst_dummy(v.shape, dummy),
+                    fcore.convert_dtype_to_np(v.dtype)))
+            in_structs[slot] = vals
+        try:
+            inferred = jax.eval_shape(
+                lambda ins: od.lower(op_registry.ExecContext(
+                    op, ins, step=0, seed=0)), in_structs)
+        except Exception as e:
+            msg = str(e).strip().splitlines()
+            out.append(Diagnostic(
+                "shape-mismatch", "error",
+                "lowering rejects the recorded input shapes/dtypes: "
+                "%s: %s" % (type(e).__name__,
+                            msg[0] if msg else "<no message>"),
+                block=block.idx, op_index=idx, op_type=op.type,
+                var=(op.input_arg_names or [None])[0]))
+            return
+        if inferred is None:
+            return
+        for slot, vals in inferred.items():
+            names = op.outputs.get(slot, [])
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for n, s in zip(names, vals):
+                v = block._find_var_recursive(n)
+                if v is None or s is None or v.shape is None or \
+                        writers[n] > 1:
+                    continue
+                inf_shape = op_registry._restore_dummy(
+                    s.shape, True, dummy)
+                if self._dims_conflict(v.shape, inf_shape):
+                    out.append(Diagnostic(
+                        "shape-mismatch", "error",
+                        "output %s: recorded shape %s but the lowering "
+                        "produces %s" % (slot, tuple(v.shape),
+                                         tuple(inf_shape)),
+                        block=block.idx, op_index=idx, op_type=op.type,
+                        var=n))
+                    continue
+                rec_np = fcore.convert_dtype_to_np(v.dtype)
+                if _dtype_family(rec_np) != _dtype_family(s.dtype):
+                    out.append(Diagnostic(
+                        "dtype-mismatch", "error",
+                        "output %s: recorded dtype %s but the lowering "
+                        "produces %s" % (slot, rec_np.__name__
+                                         if hasattr(rec_np, "__name__")
+                                         else rec_np, s.dtype),
+                        block=block.idx, op_index=idx, op_type=op.type,
+                        var=n))
+
+
+@register_pass
+class VerifyDeadCodePass(AnalysisPass):
+    name = "verify_dead_code_pass"
+
+    def analyze(self, program, diagnostics):
+        fetches = tuple(self.get("fetches") or ())
+        feeds = frozenset(self.get("feeds") or ())
+        blk = program.global_block()
+        if fetches:
+            self._dead_ops(blk, fetches, diagnostics)
+        self._unused_vars(program, feeds, fetches, diagnostics)
+
+    def _dead_ops(self, blk, fetches, out):
+        needed = set(fetches)
+        live = [False] * len(blk.ops)
+        for i in range(len(blk.ops) - 1, -1, -1):
+            op = blk.ops[i]
+            outputs = set(n for n in op.output_arg_names if n)
+            writes_persistable = any(
+                getattr(blk._find_var_recursive(n), "persistable", False)
+                for n in outputs)
+            if (outputs & needed) or writes_persistable or \
+                    _is_side_effecting(op):
+                live[i] = True
+                needed.update(n for n in op.input_arg_names if n)
+                sub = op.attrs.get("sub_block")
+                if sub is not None:
+                    needed.update(self._external_reads(sub))
+        for i, op in enumerate(blk.ops):
+            if not live[i]:
+                out.append(Diagnostic(
+                    "dead-op", "warning",
+                    "no fetch is reachable from its outputs %s — the "
+                    "op costs compile time and (if not DCE'd by XLA) "
+                    "step time for nothing"
+                    % sorted(n for n in op.output_arg_names if n),
+                    block=blk.idx, op_index=i, op_type=op.type,
+                    var=(op.output_arg_names or [None])[0]))
+
+    def _unused_vars(self, program, feeds, fetches, out):
+        fetch_set = set(fetches)
+        for block in program.blocks:
+            touched = set()
+            for op in block.ops:
+                touched.update(n for n in op.input_arg_names if n)
+                touched.update(n for n in op.output_arg_names if n)
+                sub = op.attrs.get("sub_block")
+                if sub is not None:
+                    touched.update(self._external_reads(sub))
+                    touched.update(self._subtree_writes(sub))
+            for name, v in block.vars.items():
+                if name in touched or name in feeds or \
+                        name in fetch_set or v.persistable or v.is_data:
+                    continue
+                out.append(Diagnostic(
+                    "unused-var", "warning",
+                    "declared but no op reads or writes it (stale var "
+                    "table entry)", block=block.idx, var=name))
+
+
+@register_pass
+class VerifyFetchReachabilityPass(AnalysisPass):
+    name = "verify_fetch_reachability_pass"
+
+    def analyze(self, program, diagnostics):
+        feeds = tuple(self.get("feeds") or ())
+        fetches = tuple(self.get("fetches") or ())
+        if not fetches:
+            return
+        blk = program.global_block()
+        defined = set(feeds)
+        consumed = set()
+        for v in program.list_vars():
+            if v.persistable or v.is_data:
+                defined.add(v.name)
+        for op in blk.ops:
+            ins = [n for n in op.input_arg_names if n]
+            consumed.update(ins)
+            sub = op.attrs.get("sub_block")
+            if sub is not None:
+                consumed.update(self._external_reads(sub))
+            if all(n in defined for n in ins):
+                defined.update(n for n in op.output_arg_names if n)
+                if sub is not None:
+                    defined |= self._subtree_writes(sub)
+        for f in fetches:
+            if blk._find_var_recursive(f) is None:
+                diagnostics.append(Diagnostic(
+                    "unknown-fetch", "error",
+                    "fetch target exists nowhere in the program",
+                    block=blk.idx, var=f))
+            elif f not in defined:
+                diagnostics.append(Diagnostic(
+                    "unreachable-fetch", "error",
+                    "no dataflow path from the feeds/persistables "
+                    "produces this fetch", block=blk.idx, var=f))
+        for f in feeds:
+            if f not in consumed and f not in fetches:
+                diagnostics.append(Diagnostic(
+                    "unused-feed", "warning",
+                    "declared as a feed but no op consumes it",
+                    block=blk.idx, var=f))
+
+
+@register_pass
+class VerifyAotExportPass(AnalysisPass):
+    name = "verify_aot_export_pass"
+
+    def analyze(self, program, diagnostics):
+        from ..fluid import functionalizer
+        from ..ops.optimizer_ops import MERGEABLE_OPT_OPS
+        opt = frozenset(MERGEABLE_OPT_OPS)
+        training = []            # (block, idx, type) — summarized as ONE
+        for block in program.blocks:
+            for idx, op in enumerate(block.ops):
+                if functionalizer.is_host_op(op):
+                    diagnostics.append(Diagnostic(
+                        "aot-unexportable", "warning",
+                        "host op: jax.export cannot serialize it, so "
+                        "the persistent compile cache will fall back "
+                        "to direct compilation (_UNEXPORTABLE) and the "
+                        "executor takes the segmented eager path",
+                        block=block.idx, op_index=idx, op_type=op.type))
+                elif op.type.endswith("_grad") or op.type in opt:
+                    training.append((block.idx, idx, op.type))
+        if training:
+            b, i, t = training[0]
+            diagnostics.append(Diagnostic(
+                "aot-ineligible", "warning",
+                "%d training op(s): the executor's persistent compile "
+                "cache only serves inference-shaped programs "
+                "(_aot_cache_eligible gate)" % len(training),
+                block=b, op_index=i, op_type=t))
+        if program.num_blocks > 1:
+            diagnostics.append(Diagnostic(
+                "aot-ineligible", "warning",
+                "%d blocks: the executor's persistent compile cache "
+                "requires a single-block program (_aot_cache_eligible "
+                "gate)" % program.num_blocks))
+
+
+ANALYSIS_PASSES = (
+    "verify_use_before_def_pass",
+    "verify_shapes_pass",
+    "verify_dead_code_pass",
+    "verify_fetch_reachability_pass",
+    "verify_aot_export_pass",
+)
+
+
+def verify_program(program, feeds=None, fetches=None, passes=None,
+                   emit_events=True, what=None):
+    """Run the analysis passes over `program`; returns [Diagnostic].
+
+    `feeds`/`fetches` sharpen the analysis (dead-op and reachability
+    need fetch roots; use-before-def treats feeds as defined).  Each
+    finding is also emitted as a ``verify_finding`` obs event so the
+    structured log records what the verifier said about an artifact at
+    its build/load boundary (OBSERVABILITY.md)."""
+    from ..fluid.ir_passes import get_pass
+    feeds = tuple(feeds or ())
+    fetches = tuple(fetches or ())
+    diags = []
+    for name in (passes or ANALYSIS_PASSES):
+        p = get_pass(name, feeds=feeds, fetches=fetches)
+        p.apply(program)
+        diags.extend(p.diagnostics())
+    if emit_events and diags:
+        from ..obs import events as obs_events
+        for d in diags:
+            obs_events.emit("verify_finding", check=d.check,
+                            severity=d.severity, what=what,
+                            block=d.block, op_index=d.op_index,
+                            op_type=d.op_type, var=d.var,
+                            message=d.message)
+    return diags
+
+
+def check_program(program, feeds=None, fetches=None, passes=None,
+                  what="program", warn=True):
+    """verify_program + policy: error findings raise
+    ProgramVerificationError; warnings go to warnings.warn (once per
+    call).  Returns the diagnostics on success."""
+    import warnings as _warnings
+    diags = verify_program(program, feeds=feeds, fetches=fetches,
+                           passes=passes, what=what)
+    if any(d.is_error for d in diags):
+        raise ProgramVerificationError(diags, what=what)
+    if warn and diags:
+        _warnings.warn(
+            "program verifier: %d warning(s) for %s:\n%s"
+            % (len(diags), what,
+               "\n".join("  " + str(d) for d in diags)),
+            RuntimeWarning, stacklevel=2)
+    return diags
+
+
+# bounded memo for the FLAGS.verify_program pre-run check: verification
+# happens at build/load, never per step — keyed by program identity +
+# version + the feed/fetch signature of the run
+_VERIFY_MEMO = collections.OrderedDict()
+_VERIFY_MEMO_CAP = 128
+
+
+def verify_program_cached(program, feeds=None, fetches=None,
+                          what="program"):
+    """Memoized check_program for executor hot paths: the first run of a
+    (program version, feeds, fetches) signature pays the analysis; every
+    later step is one dict hit.  Raises ProgramVerificationError on
+    error findings (and re-raises the cached error on repeat runs —
+    a failing program stays failing until it changes)."""
+    key = (id(program), program._version, tuple(feeds or ()),
+           tuple(fetches or ()))
+    hit = _VERIFY_MEMO.get(key)
+    if hit is not None:
+        _VERIFY_MEMO.move_to_end(key)
+        if isinstance(hit, ProgramVerificationError):
+            raise hit
+        return hit
+    try:
+        diags = check_program(program, feeds=feeds, fetches=fetches,
+                              what=what)
+    except ProgramVerificationError as e:
+        _VERIFY_MEMO[key] = e
+        raise
+    finally:
+        while len(_VERIFY_MEMO) > _VERIFY_MEMO_CAP:
+            _VERIFY_MEMO.popitem(last=False)
+    _VERIFY_MEMO[key] = diags
+    return diags
+
+
+def check_serialized_cached(program, content, feeds=None, fetches=None,
+                            what="program"):
+    """Artifact-boundary memo keyed by the program's serialized CONTENT
+    (sha256) — save/load_inference_model verify unconditionally, but a
+    serving registry warm, hot-swap flip, or replica build loads the
+    same artifact many times: one analysis per distinct
+    (artifact bytes, feeds, fetches), every repeat a dict hit.  Raises
+    the memoized ProgramVerificationError on repeat failures."""
+    import hashlib
+    key = ("sha", hashlib.sha256(content.encode()).hexdigest(),
+           tuple(feeds or ()), tuple(fetches or ()))
+    hit = _VERIFY_MEMO.get(key)
+    if hit is not None:
+        _VERIFY_MEMO.move_to_end(key)
+        if isinstance(hit, ProgramVerificationError):
+            raise hit
+        return hit
+    try:
+        diags = check_program(program, feeds=feeds, fetches=fetches,
+                              what=what)
+    except ProgramVerificationError as e:
+        _VERIFY_MEMO[key] = e
+        raise
+    finally:
+        while len(_VERIFY_MEMO) > _VERIFY_MEMO_CAP:
+            _VERIFY_MEMO.popitem(last=False)
+    _VERIFY_MEMO[key] = diags
+    return diags

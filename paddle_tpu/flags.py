@@ -389,6 +389,19 @@ DEFINE_int(
     "every hit) across both the AOT entries and jax's xla/ files. The "
     "entry just written is never the victim.")
 DEFINE_bool(
+    "verify_program", False,
+    "Pre-run program verification (ANALYSIS.md): before an Executor / "
+    "ParallelExecutor compiles a program (or a Predictor loads one), run "
+    "the static analysis passes — use-before-def, shape/dtype "
+    "propagation, dead-op and fetch-reachability, AOT-exportability — "
+    "and raise ProgramVerificationError on error findings instead of "
+    "letting the bug surface as a runtime backend trace N steps in. "
+    "Memoized per (program version, feeds, fetches): the check runs at "
+    "build/load, never per step, so the hot path cost is one dict hit. "
+    "The save_inference_model / load_inference_model artifact "
+    "boundaries verify unconditionally — this flag adds the in-process "
+    "executor surfaces.")
+DEFINE_bool(
     "executor_compile_cache", False,
     "Opt-in: Executor.run also consults the persistent compile cache "
     "for INFERENCE-SHAPED programs (single block, no *_grad ops, no "

@@ -67,6 +67,8 @@ from . import debugger
 from . import contrib
 from . import checkpoint  # noqa: F401  (atomic CRC checkpoint vault)
 from . import sentinel    # noqa: F401  (NaN/Inf anomaly sentinel)
+from .. import analysis   # noqa: F401  (registers the verify_* passes
+#                                        on the ir_passes substrate)
 
 __all__ = [
     "Program", "Operator", "Variable", "Parameter",

@@ -3,57 +3,140 @@
 Reference analogue: python/paddle/fluid/debugger.py (+ graphviz.py,
 net_drawer.py, and the C++ graph_viz_pass ir/graph_viz_pass.cc) — renders a
 Program's op/var graph to graphviz dot text and pretty-prints program code.
+
+Analyzer integration (ANALYSIS.md): both renderers accept the
+``diagnostics`` list paddle_tpu.analysis.verify_program returns and
+annotate the output instead of printing the bare program — dead ops are
+dimmed, shape/dtype-mismatch sites highlighted, and every other finding
+lands as a ``!`` / colored marker on its op or var, so "why does the
+verifier hate my program" is answerable by looking at the graph.
 """
 
 __all__ = ["pprint_program_codes", "pprint_block_codes",
            "draw_block_graphviz"]
 
+# checks rendered as "dead" (dimmed) vs "broken" (highlighted)
+_DEAD_CHECKS = frozenset(["dead-op", "unused-var"])
+_ERROR_STYLE_CHECKS = frozenset([
+    "shape-mismatch", "dtype-mismatch", "use-before-def",
+    "undefined-var", "unregistered-op", "unknown-fetch",
+    "unreachable-fetch"])
 
-def pprint_program_codes(program):
-    return "\n".join(pprint_block_codes(b) for b in program.blocks)
+
+def _index_diags(block, diagnostics):
+    """(by_op_index, by_var) for the diagnostics landing in `block`."""
+    by_op, by_var = {}, {}
+    for d in diagnostics or ():
+        if d.block is not None and d.block != block.idx:
+            continue
+        if d.op_index is not None:
+            by_op.setdefault(d.op_index, []).append(d)
+        elif d.var:
+            by_var.setdefault(d.var, []).append(d)
+    return by_op, by_var
 
 
-def pprint_block_codes(block):
+def pprint_program_codes(program, diagnostics=None):
+    return "\n".join(pprint_block_codes(b, diagnostics=diagnostics)
+                     for b in program.blocks)
+
+
+def pprint_block_codes(block, diagnostics=None):
+    by_op, by_var = _index_diags(block, diagnostics)
     lines = ["# block %d (parent %d)" % (block.idx, block.parent_idx)]
     for var in block.vars.values():
-        lines.append("var %s : %s shape=%s%s" % (
+        line = "var %s : %s shape=%s%s" % (
             var.name, var.dtype, var.shape,
-            " persistable" if var.persistable else ""))
-    for op in block.ops:
+            " persistable" if var.persistable else "")
+        for d in by_var.get(var.name, ()):
+            line += "   # %s[%s] %s" % (d.severity, d.check, d.message)
+        lines.append(line)
+    for i, op in enumerate(block.ops):
         ins = ", ".join("%s=%s" % (k, v) for k, v in op.inputs.items())
         outs = ", ".join("%s=%s" % (k, v) for k, v in op.outputs.items())
-        lines.append("%s(%s) -> %s" % (op.type, ins, outs))
+        line = "%s(%s) -> %s" % (op.type, ins, outs)
+        marks = by_op.get(i, ())
+        if any(d.check in _DEAD_CHECKS for d in marks):
+            line = "# [dead] " + line       # dimmed: commented out
+        for d in marks:
+            if d.check not in _DEAD_CHECKS:
+                line += "   # !%s[%s] %s" % (d.severity, d.check,
+                                             d.message)
+        lines.append(line)
     return "\n".join(lines)
 
 
-def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+def draw_block_graphviz(block, highlights=None, path="./temp.dot",
+                        diagnostics=None):
     """Write the op/var graph of `block` as graphviz dot (reference
-    debugger.py draw_block_graphviz; C++ analogue graph_viz_pass)."""
+    debugger.py draw_block_graphviz; C++ analogue graph_viz_pass).
+
+    With `diagnostics`, analyzer findings restyle the graph: dead ops
+    render dimmed (gray, dashed), shape/dtype-mismatch and other error
+    sites render highlighted (red) with the finding in the tooltip, and
+    flagged vars (unused/undefined) pick up the same treatment."""
     highlights = set(highlights or [])
+    by_op, by_var = _index_diags(block, diagnostics)
     lines = ["digraph G {", "  rankdir=TB;"]
     var_ids = {}
+
+    def _esc(s):
+        return str(s).replace("\\", "\\\\").replace('"', '\\"')
 
     def vid(name):
         if name not in var_ids:
             var_ids[name] = "var_%d" % len(var_ids)
-            color = ', style=filled, fillcolor="lightblue"' \
+            style = ', style=filled, fillcolor="lightblue"' \
                 if name in highlights else ""
+            diags = by_var.get(name, ())
+            if any(d.check in _DEAD_CHECKS for d in diags):
+                style = (', style="filled,dashed", fillcolor="gray90", '
+                         'fontcolor="gray50"')
+            elif diags:
+                style = ', style=filled, fillcolor="lightcoral"'
+            if diags:
+                style += ', tooltip="%s"' % _esc(
+                    "; ".join(str(d) for d in diags))
             lines.append('  %s [label="%s", shape=oval%s];' %
-                         (var_ids[name], name, color))
+                         (var_ids[name], name, style))
         return var_ids[name]
 
     for i, op in enumerate(block.ops):
         op_id = "op_%d" % i
-        lines.append('  %s [label="%s", shape=box, style=filled, '
-                     'fillcolor="lightgray"];' % (op_id, op.type))
+        diags = by_op.get(i, ())
+        fill, extra = "lightgray", ""
+        if any(d.check in _DEAD_CHECKS for d in diags):
+            # dead op: dimmed out of the dataflow picture
+            fill, extra = "gray90", ', fontcolor="gray50", style="filled,dashed"'
+        elif any(d.check in _ERROR_STYLE_CHECKS or d.is_error
+                 for d in diags):
+            # mismatch/error site: highlighted
+            fill, extra = "lightcoral", ', color="red", penwidth=2'
+        if diags:
+            extra += ', tooltip="%s"' % _esc(
+                "; ".join(str(d) for d in diags))
+        style = 'style=filled, fillcolor="%s"%s' % (fill, extra) \
+            if "style" not in extra else 'fillcolor="%s"%s' % (fill, extra)
+        lines.append('  %s [label="%s", shape=box, %s];'
+                     % (op_id, op.type, style))
+        err_edges = any(d.check in ("shape-mismatch", "dtype-mismatch")
+                        for d in diags)
         for names in op.inputs.values():
             for n in names:
                 if n:
-                    lines.append("  %s -> %s;" % (vid(n), op_id))
+                    # a shape/dtype mismatch is a property of the edge
+                    # between the recorded var and the op — paint it
+                    lines.append("  %s -> %s%s;" % (
+                        vid(n), op_id,
+                        ' [color="red", penwidth=2]' if err_edges
+                        else ""))
         for names in op.outputs.values():
             for n in names:
                 if n:
-                    lines.append("  %s -> %s;" % (op_id, vid(n)))
+                    lines.append("  %s -> %s%s;" % (
+                        op_id, vid(n),
+                        ' [color="red", penwidth=2]' if err_edges
+                        else ""))
     lines.append("}")
     dot = "\n".join(lines)
     with open(path, "w") as f:

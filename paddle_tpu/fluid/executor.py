@@ -450,6 +450,12 @@ class Executor:
         if steps < 1:
             raise ValueError("run_loop: steps must be >= 1")
         from ..flags import FLAGS
+        if FLAGS.verify_program:
+            from ..analysis import verify_program_cached
+            verify_program_cached(
+                program, feeds=sorted(feed),
+                fetches=[_fetch_name(f) for f in fetch_list],
+                what="executor run_loop program")
         if FLAGS.check_nan_inf:
             raise RuntimeError(
                 "run_loop: FLAGS.check_nan_inf needs per-op attribution, "
@@ -544,6 +550,14 @@ class Executor:
         has_host, has_sub_host = self._host_ops_cached(program)
         hkey = (id(program), program._version)
         from ..flags import FLAGS
+        if FLAGS.verify_program:
+            # opt-in pre-run verification (ANALYSIS.md): memoized per
+            # (program version, feeds, fetches) — the analysis runs at
+            # build time, every later step costs one dict hit
+            from ..analysis import verify_program_cached
+            verify_program_cached(program, feeds=sorted(feed),
+                                  fetches=fetch_names,
+                                  what="executor program")
         state_in = {n: scope.get(n) for n in persistables
                     if scope.has(n) and scope.get(n) is not None}
         step = self._step_counters.get(id(program), 0)

@@ -124,8 +124,19 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     pruned = main_program.clone(for_test=True)
     pruned = pruned._prune(feeded_var_names,
                            [v.name for v in target_vars])
+    # the artifact boundary verifies unconditionally (ANALYSIS.md): a
+    # broken graph must fail HERE, at build time, not in whatever server
+    # loads the artifact later — error findings raise, warnings warn.
+    # Memoized on the serialized content: re-saving identical bytes
+    # (bench loops, registry round-trips) costs one dict hit.
+    serialized = pruned.serialize_to_string()
+    from ..analysis import check_serialized_cached
+    check_serialized_cached(pruned, serialized,
+                            feeds=feeded_var_names,
+                            fetches=[v.name for v in target_vars],
+                            what="save_inference_model(%r)" % dirname)
     meta = {
-        "program": pruned.serialize_to_string(),
+        "program": serialized,
         "feed_names": list(feeded_var_names),
         "fetch_names": [v.name for v in target_vars],
     }
@@ -147,6 +158,16 @@ def load_inference_model(dirname, executor, model_filename=None,
     with open(os.path.join(dirname, model_filename)) as f:
         meta = json.load(f)
     program = Program.parse_from_string(meta["program"])
+    # verify unconditionally at the load boundary: an artifact edited,
+    # truncated, or produced by an older/divergent builder must be
+    # rejected with block/op/var diagnostics before any compile is paid.
+    # Content-memoized: a hot-swap flip / replica build re-loading the
+    # same artifact bytes verifies once, every repeat is a dict hit.
+    from ..analysis import check_serialized_cached
+    check_serialized_cached(program, meta["program"],
+                            feeds=meta["feed_names"],
+                            fetches=meta["fetch_names"],
+                            what="load_inference_model(%r)" % dirname)
     # load params into scope under the program's var names
     vars = [v for v in program.global_block().vars.values()
             if isinstance(v, Parameter) or v.persistable]

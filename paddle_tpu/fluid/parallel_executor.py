@@ -312,6 +312,13 @@ class ParallelExecutor:
                 "run_loop: FLAGS.check_nan_inf needs per-op attribution, "
                 "which requires per-step execution — use "
                 "ParallelExecutor.run")
+        if FLAGS.verify_program:
+            from ..analysis import verify_program_cached
+            verify_program_cached(
+                self._main_program,
+                feeds=sorted(feed) if isinstance(feed, dict) else None,
+                fetches=[_fetch_name(f) for f in fetch_list],
+                what="parallel executor run_loop program")
         hkey = self._main_program._version
         if self._host_ops_flag.get(hkey) is None:
             self._host_ops_flag[hkey] = \
@@ -366,6 +373,13 @@ class ParallelExecutor:
         arrays and the host sync is deferred to `.result()` — same
         in-flight contract as Executor.run (PIPELINE.md)."""
         fetch_names = tuple(_fetch_name(f) for f in fetch_list)
+        from ..flags import FLAGS
+        if FLAGS.verify_program:
+            from ..analysis import verify_program_cached
+            verify_program_cached(
+                self._main_program,
+                feeds=sorted(feed) if isinstance(feed, dict) else None,
+                fetches=fetch_names, what="parallel executor program")
         feeds = self._prepare_feeds(feed, feed_dict)
         feed_key = tuple(sorted(feeds.keys()))
 

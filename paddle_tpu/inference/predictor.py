@@ -115,6 +115,15 @@ class Predictor:
             if isinstance(config, AnalysisConfig) and config.ir_optim:
                 fluid.InferenceTranspiler().transpile(program,
                                                       scope=self._scope)
+        from paddle_tpu.flags import FLAGS
+        if FLAGS.verify_program:
+            # load_inference_model already verified the artifact; this
+            # re-checks AFTER the transpiler rewrites (BN fold, fusion)
+            # — a buggy rewrite is exactly what the shape pass catches
+            from paddle_tpu.analysis import check_program
+            check_program(program, feeds=feed_names,
+                          fetches=[v.name for v in fetch_vars],
+                          what="predictor program (post-transpile)")
         self._program = program
         self._feed_names = list(feed_names)
         self._fetch_names = [v.name for v in fetch_vars]

@@ -51,7 +51,8 @@ class EventLog(object):
 
     # -- file sink ----------------------------------------------------
 
-    def _open(self):
+    def _open_locked(self):
+        # caller holds self._lock (emit / _rotate_locked)
         if self._f is None:
             d = os.path.dirname(self.path)
             if d:
@@ -95,7 +96,7 @@ class EventLog(object):
         try:
             line = (json.dumps(rec, sort_keys=True) + "\n").encode()
             with self._lock:
-                f = self._open()
+                f = self._open_locked()
                 f.write(line)
                 # flush (no fsync) per record: lifecycle events are
                 # low-rate and an operator tailing the file must see

@@ -989,8 +989,9 @@ class DecodeBatcher:
         # whole batch to completion before admitting again)
         return not lane.assigned
 
-    def _take_admits(self, lane):
-        """Pop the requests this lane admits right now (under _cv)."""
+    def _take_admits_locked(self, lane):
+        """Pop the requests this lane admits right now (caller holds
+        _cv — the `_locked` suffix is the lint-checked convention)."""
         room = self.n_slots - len(lane.assigned)
         out = []
         while self._pending and room > 0:
@@ -1134,7 +1135,7 @@ class DecodeBatcher:
                     self._cv.wait(0.1)
                 if self._stopped and not lane.assigned:
                     return
-                admits = self._take_admits(lane) \
+                admits = self._take_admits_locked(lane) \
                     if self._admissible(lane) else []
             # prefill OUTSIDE the lock: other lanes keep decoding
             for req in admits:

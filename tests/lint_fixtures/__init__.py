@@ -1,0 +1,4 @@
+# Seeded-defect corpus for tools/lint_runtime.py — each module contains
+# exactly the hazard its name says, and tests/test_analysis.py pins that
+# the lint flags it with file:line.  NEVER import these into runtime
+# code; they exist to keep the checkers honest.

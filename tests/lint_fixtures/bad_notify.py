@@ -1,0 +1,28 @@
+"""Seeded defect: single notify() on a condition with two waiter
+classes — the exact shape of the PR 7 DynamicBatcher.submit bug (router
++ lane workers on one cv; one notify wakes an arbitrary one and leaves
+the other sleeping its poll interval)."""
+
+import threading
+
+
+class TwoWaiterQueue:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+        self._closed = False
+
+    def router_loop(self):
+        with self._cv:
+            while not self._items and not self._closed:
+                self._cv.wait(0.1)
+
+    def lane_loop(self):
+        with self._cv:
+            while not self._items and not self._closed:
+                self._cv.wait(0.1)
+
+    def submit(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()       # BUG: two waiter classes share the cv

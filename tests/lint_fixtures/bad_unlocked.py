@@ -1,0 +1,18 @@
+"""Seeded defect: state protected by the lock in one method and mutated
+bare in another — the PR 5 double-compile-race shape (Predictor._compiled
+written by concurrent lanes without the re-check under the lock)."""
+
+import threading
+
+
+class SharedCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+
+    def put(self, key, value):
+        with self._lock:
+            self._cache[key] = value
+
+    def put_fast(self, key, value):
+        self._cache[key] = value        # BUG: same state, no lock

@@ -1,0 +1,10 @@
+"""Seeded defect: raw in-place write with no atomic commit — the PR 6
+attention_tuning.record() bug shape (kill mid-write leaves a truncated
+JSON where readers expect a committed record)."""
+
+import json
+
+
+def record_tuning(path, records):
+    with open(path, "w") as f:      # BUG: no temp + os.replace commit
+        json.dump(records, f)

@@ -1,0 +1,13 @@
+"""Seeded defect: deadline arithmetic on the wall clock — an NTP step
+makes the request expire early (or never)."""
+
+import time
+
+
+class DeadlineQueue:
+    def __init__(self, deadline_ms):
+        self.t0 = time.time()               # BUG: wall-clock anchor
+        self.deadline_ms = deadline_ms
+
+    def expired(self):
+        return (time.time() - self.t0) * 1e3 > self.deadline_ms   # BUG

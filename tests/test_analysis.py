@@ -1,0 +1,470 @@
+"""Program verifier + runtime concurrency lint (ANALYSIS.md).
+
+Seeded defect corpus: every checker class — use-before-def, shape/dtype
+mismatch, dead op, unexportable op, fetch reachability on the program
+side; notify-on-shared-cv, non-atomic vault write, non-monotonic
+timing, unlocked shared mutation on the runtime side — has a fixture it
+flags with block/op-index/var (or file:line), and the clean repo / model
+zoo passes with exit 0 (suppressions documented in the tools).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.framework import Program
+from paddle_tpu.analysis import (ProgramVerificationError, check_program,
+                                 verify_program, verify_program_cached)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+
+def _checks(diags):
+    return [d.check for d in diags]
+
+
+def _find(diags, check):
+    out = [d for d in diags if d.check == check]
+    assert out, "no %r finding in %s" % (check, list(map(str, diags)))
+    return out[0]
+
+
+# ---------------------------------------------------------------------------
+# program verifier — seeded defects, one per checker class
+# ---------------------------------------------------------------------------
+
+def test_use_before_def_names_block_op_var():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="a", shape=[4], dtype="float32")
+    blk.create_var(name="b", shape=[4], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["a"]},
+                  outputs={"Out": ["b"]}, infer_shape=False)
+    d = _find(verify_program(p), "use-before-def")
+    assert (d.block, d.op_index, d.op_type, d.var) == (0, 0, "relu", "a")
+    assert d.is_error
+
+
+def test_undefined_var_flagged():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="out", shape=[4], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["ghost"]},
+                  outputs={"Out": ["out"]}, infer_shape=False)
+    d = _find(verify_program(p), "undefined-var")
+    assert d.var == "ghost" and d.is_error
+
+
+def test_feeds_and_persistables_are_defined():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[4], dtype="float32")  # via feeds=
+    blk.create_var(name="w", shape=[4], dtype="float32", persistable=True)
+    blk.create_var(name="o", shape=[4], dtype="float32")
+    blk.append_op(type="elementwise_add", inputs={"X": ["x"], "Y": ["w"]},
+                  outputs={"Out": ["o"]}, infer_shape=False)
+    assert verify_program(p, feeds=["x"], fetches=["o"]) == []
+
+
+def test_shape_mismatch_on_broadcast_reject():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[4, 8], dtype="float32", is_data=True)
+    blk.create_var(name="y", shape=[4, 7], dtype="float32", is_data=True)
+    blk.create_var(name="z", shape=[4, 8], dtype="float32")
+    blk.append_op(type="elementwise_add", inputs={"X": ["x"], "Y": ["y"]},
+                  outputs={"Out": ["z"]}, infer_shape=False)
+    d = _find(verify_program(p, feeds=["x", "y"], fetches=["z"]),
+              "shape-mismatch")
+    assert (d.block, d.op_index, d.op_type) == (0, 0, "elementwise_add")
+
+
+def test_shape_mismatch_recorded_vs_inferred():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[4, 8], dtype="float32", is_data=True)
+    blk.create_var(name="z", shape=[4, 9], dtype="float32")  # lie: relu keeps 8
+    blk.append_op(type="relu", inputs={"X": ["x"]},
+                  outputs={"Out": ["z"]}, infer_shape=False)
+    d = _find(verify_program(p, feeds=["x"], fetches=["z"]),
+              "shape-mismatch")
+    assert d.var == "z" and "(4, 9)" in d.message
+
+
+def test_dtype_mismatch():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    blk.create_var(name="z", shape=[4], dtype="int32")
+    blk.append_op(type="relu", inputs={"X": ["x"]},
+                  outputs={"Out": ["z"]}, infer_shape=False)
+    d = _find(verify_program(p, feeds=["x"], fetches=["z"]),
+              "dtype-mismatch")
+    assert d.var == "z" and d.is_error
+
+
+def test_dead_op_and_unused_var():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    blk.create_var(name="z", shape=[4], dtype="float32")
+    blk.create_var(name="dead", shape=[4], dtype="float32")
+    blk.create_var(name="stale", shape=[2], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["x"]},
+                  outputs={"Out": ["z"]}, infer_shape=False)
+    blk.append_op(type="scale", inputs={"X": ["x"]},
+                  outputs={"Out": ["dead"]}, attrs={"scale": 2.0},
+                  infer_shape=False)
+    diags = verify_program(p, feeds=["x"], fetches=["z"])
+    d = _find(diags, "dead-op")
+    assert (d.op_index, d.op_type, d.var) == (1, "scale", "dead")
+    assert not d.is_error                  # warnings: report, don't fail
+    assert _find(diags, "unused-var").var == "stale"
+    # the same program with BOTH outputs fetched is clean
+    diags2 = verify_program(p, feeds=["x"], fetches=["z", "dead"])
+    assert "dead-op" not in _checks(diags2)
+
+
+def test_dead_op_spares_side_effects_and_persistable_writers():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    blk.create_var(name="z", shape=[4], dtype="float32")
+    blk.create_var(name="buf", shape=[4], dtype="float32",
+                   persistable=True)
+    blk.append_op(type="relu", inputs={"X": ["x"]},
+                  outputs={"Out": ["z"]}, infer_shape=False)
+    # writes a persistable: live even though nothing fetches it
+    blk.append_op(type="assign", inputs={"X": ["z"]},
+                  outputs={"Out": ["buf"]}, infer_shape=False)
+    assert "dead-op" not in _checks(
+        verify_program(p, feeds=["x"], fetches=["z"]))
+
+
+def test_fetch_reachability_and_unused_feed():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    blk.create_var(name="orphan", shape=[4], dtype="float32")
+    diags = verify_program(p, feeds=["x"], fetches=["nope", "orphan"])
+    assert _find(diags, "unknown-fetch").var == "nope"
+    assert _find(diags, "unreachable-fetch").var == "orphan"
+    assert _find(diags, "unused-feed").var == "x"
+
+
+def test_aot_export_lint_predicts_unexportable_and_ineligible():
+    # host op -> _UNEXPORTABLE prediction
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    blk.create_var(name="z", shape=[4], dtype="float32")
+    blk.append_op(type="py_func", inputs={"X": ["x"]},
+                  outputs={"Out": ["z"]}, infer_shape=False)
+    d = _find(verify_program(p, feeds=["x"], fetches=["z"]),
+              "aot-unexportable")
+    assert d.op_type == "py_func" and not d.is_error
+
+    # training program -> executor _aot_cache_eligible gate prediction
+    from paddle_tpu.models import mnist
+    main, _s, feeds, loss, acc, _p = mnist.get_model(batch_size=4)
+    diags = verify_program(main, feeds=[f.name for f in feeds],
+                           fetches=[loss.name, acc.name])
+    assert "aot-ineligible" in _checks(diags)
+    # and that is the ONLY finding class on the zoo training program
+    assert set(_checks(diags)) == {"aot-ineligible"}
+
+
+def test_cross_block_def_use():
+    # a conditional_block's sub-block reads a parent var defined BEFORE
+    # the op (ok) and one defined only AFTER it (flagged, cross-block)
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="cond", shape=[1], dtype="bool", is_data=True)
+    blk.create_var(name="before", shape=[4], dtype="float32",
+                   is_data=True)
+    blk.create_var(name="late", shape=[4], dtype="float32")
+    sub = p._create_block()
+    sub.create_var(name="tmp", shape=[4], dtype="float32")
+    sub.append_op(type="elementwise_add",
+                  inputs={"X": ["before"], "Y": ["late"]},
+                  outputs={"Out": ["tmp"]}, infer_shape=False)
+    p._rollback()
+    blk.append_op(type="conditional_block", inputs={"Cond": ["cond"]},
+                  outputs={}, attrs={"sub_block": sub},
+                  infer_shape=False)
+    blk.append_op(type="scale", inputs={"X": ["before"]},
+                  outputs={"Out": ["late"]}, attrs={"scale": 1.0},
+                  infer_shape=False)
+    d = _find(verify_program(p, feeds=["cond", "before"]),
+              "use-before-def")
+    assert d.var == "late" and d.block == 1
+
+
+def test_while_loop_body_not_false_positive():
+    """Loop bodies read carries written later in the body (iteration
+    N-1 -> N); the walker must not flag them."""
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=3)
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        acc = fluid.layers.fill_constant(shape=[1, 4], dtype="float32",
+                                         value=0.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond, max_iters=3)
+        with w.block():
+            acc2 = fluid.layers.elementwise_add(acc, x)
+            fluid.layers.assign(acc2, acc)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+    diags = verify_program(main, feeds=["x"], fetches=[acc.name])
+    assert not any(d.is_error for d in diags), list(map(str, diags))
+
+
+def test_dynamic_rnn_recurrent_injected_vars_not_flagged():
+    from paddle_tpu.models import machine_translation as mt
+    out = mt.get_model(batch_size=2, embedding_dim=16, encoder_size=16,
+                       decoder_size=16, dict_size=64)
+    main, _, feeds, loss, _, pred = out
+    diags = verify_program(
+        main, feeds=[f if isinstance(f, str) else f.name for f in feeds],
+        fetches=[loss.name, pred.name])
+    assert not any(d.is_error for d in diags), list(map(str, diags))
+
+
+# ---------------------------------------------------------------------------
+# policy surfaces: check_program / memoized cache / executor flag /
+# artifact boundaries
+# ---------------------------------------------------------------------------
+
+def _broken_program():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="a", shape=[4], dtype="float32")
+    blk.create_var(name="b", shape=[4], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["a"]},
+                  outputs={"Out": ["b"]}, infer_shape=False)
+    return p
+
+
+def test_check_program_raises_with_locations():
+    with pytest.raises(ProgramVerificationError) as ei:
+        check_program(_broken_program(), fetches=["b"], what="seeded")
+    msg = str(ei.value)
+    assert "use-before-def" in msg and "block 0 op 0" in msg
+    assert "'a'" in msg
+    assert any(d.check == "use-before-def"
+               for d in ei.value.diagnostics)
+
+
+def test_verify_memo_caches_and_invalidates_on_version():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    blk.create_var(name="z", shape=[4], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["x"]},
+                  outputs={"Out": ["z"]}, infer_shape=False)
+    d1 = verify_program_cached(p, feeds=["x"], fetches=["z"])
+    assert verify_program_cached(p, feeds=["x"], fetches=["z"]) is d1
+    # mutating the program bumps the version -> fresh analysis
+    blk.append_op(type="scale", inputs={"X": ["ghost"]},
+                  outputs={"Out": ["z2"]}, attrs={"scale": 1.0},
+                  infer_shape=False)
+    blk.create_var(name="z2", shape=[4], dtype="float32")
+    with pytest.raises(ProgramVerificationError):
+        verify_program_cached(p, feeds=["x"], fetches=["z"])
+    # the failure is memoized too: same error object class on repeat
+    with pytest.raises(ProgramVerificationError):
+        verify_program_cached(p, feeds=["x"], fetches=["z"])
+
+
+def test_flag_gates_executor_and_raises_on_broken_program():
+    exe = fluid.Executor(fluid.CPUPlace())
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=4)
+    fluid.set_flags({"verify_program": True})
+    try:
+        exe.run(startup)
+        (out,) = exe.run(main, feed={"x": np.ones((2, 8), np.float32)},
+                         fetch_list=[h])
+        assert np.asarray(out).shape == (2, 4)
+        with pytest.raises(ProgramVerificationError):
+            exe.run(_broken_program(), feed={}, fetch_list=["b"])
+    finally:
+        fluid.set_flags({"verify_program": False})
+
+
+def test_verify_events_land_in_obs_log():
+    from paddle_tpu.obs import events as obs_events
+    before = obs_events.events_total()
+    verify_program(_broken_program(), fetches=["b"], what="evt-test")
+    evs = [e for e in obs_events.recent_events(kind="verify_finding")
+           if e.get("what") == "evt-test"]
+    assert obs_events.events_total() > before
+    assert any(e.get("check") == "use-before-def" and
+               e.get("op_type") == "relu" for e in evs)
+
+
+def test_save_inference_model_rejects_broken_graph(tmp_path):
+    # build a valid program, then surgically break the pruned subgraph:
+    # the op computing the fetch reads a var nothing defines
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    gb = main.global_block()
+    mul_op = next(op for op in gb.ops if op.type == "mul")
+    mul_op.inputs["X"] = ["never_defined"]
+    with pytest.raises(ProgramVerificationError):
+        fluid.io.save_inference_model(str(tmp_path / "m"), ["x"],
+                                      [gb.var(h.name)], exe,
+                                      main_program=main)
+
+
+def test_load_inference_model_rejects_tampered_artifact(tmp_path):
+    # a good artifact round-trips; hand-tampering its program JSON to
+    # read an undefined var is rejected AT LOAD with named diagnostics
+    main, startup = Program(), Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = str(tmp_path / "art")
+    fluid.io.save_inference_model(d, ["x"], [main.global_block().var(h.name)],
+                                  exe, main_program=main)
+    prog, feeds, fetch_vars = fluid.io.load_inference_model(d, exe)
+    assert feeds == ["x"]
+    meta = json.load(open(os.path.join(d, "__model__")))
+    pdata = json.loads(meta["program"])
+    for op in pdata["blocks"][0]["ops"]:
+        if op["type"] == "mul":
+            op["inputs"]["X"] = ["never_defined"]
+    meta["program"] = json.dumps(pdata)
+    with open(os.path.join(d, "__model__"), "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ProgramVerificationError) as ei:
+        fluid.io.load_inference_model(d, exe)
+    assert "never_defined" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# debugger annotations (satellite)
+# ---------------------------------------------------------------------------
+
+def _dead_and_mismatch_program():
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[4, 8], dtype="float32", is_data=True)
+    blk.create_var(name="y", shape=[4, 7], dtype="float32", is_data=True)
+    blk.create_var(name="z", shape=[4, 8], dtype="float32")
+    blk.create_var(name="dead", shape=[4, 8], dtype="float32")
+    blk.append_op(type="elementwise_add", inputs={"X": ["x"], "Y": ["y"]},
+                  outputs={"Out": ["z"]}, infer_shape=False)
+    blk.append_op(type="scale", inputs={"X": ["x"]},
+                  outputs={"Out": ["dead"]}, attrs={"scale": 2.0},
+                  infer_shape=False)
+    return p, verify_program(p, feeds=["x", "y"], fetches=["z"])
+
+
+def test_pprint_annotates_findings():
+    p, diags = _dead_and_mismatch_program()
+    txt = fluid.debugger.pprint_program_codes(p, diagnostics=diags)
+    assert "# [dead] scale" in txt                      # dimmed dead op
+    assert "!error[shape-mismatch]" in txt              # mismatch marker
+    # without diagnostics the output is the bare program (old contract)
+    bare = fluid.debugger.pprint_program_codes(p)
+    assert "dead" in bare and "[dead]" not in bare
+
+
+def test_graphviz_annotates_findings(tmp_path):
+    p, diags = _dead_and_mismatch_program()
+    path = str(tmp_path / "g.dot")
+    dot = fluid.debugger.draw_block_graphviz(
+        p.global_block(), path=path, diagnostics=diags)
+    assert os.path.exists(path)
+    assert 'fillcolor="gray90"' in dot          # dead op dimmed
+    assert "dashed" in dot
+    assert 'fillcolor="lightcoral"' in dot      # mismatch highlighted
+    assert '[color="red", penwidth=2]' in dot   # mismatch edges painted
+
+
+# ---------------------------------------------------------------------------
+# CLIs (tier-1 exit-code pins): 0 on the clean repo/zoo, 2 with the
+# offending file:line / block/op on the seeded-defect fixtures
+# ---------------------------------------------------------------------------
+
+def _run_tool(args, timeout=600):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def test_lint_runtime_cli_clean_repo_exit_0():
+    r = _run_tool([os.path.join(REPO, "tools", "lint_runtime.py"),
+                   "--smoke"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+    # the suppression table is in force, not empty-by-accident
+    assert "suppressed" in r.stdout
+
+
+def test_lint_runtime_cli_flags_seeded_defects_exit_2():
+    fixtures = [os.path.join(FIXTURES, f) for f in
+                ("bad_notify.py", "bad_vault_write.py",
+                 "bad_wallclock.py", "bad_unlocked.py")]
+    r = _run_tool([os.path.join(REPO, "tools", "lint_runtime.py")]
+                  + fixtures)
+    assert r.returncode == 2, r.stdout + r.stderr
+    out = r.stdout
+    for check, path in (
+            ("notify-shared-cv", "bad_notify.py"),
+            ("nonatomic-vault-write", "bad_vault_write.py"),
+            ("nonmonotonic-time", "bad_wallclock.py"),
+            ("unlocked-shared-mutation", "bad_unlocked.py")):
+        line = next((ln for ln in out.splitlines() if check in ln), None)
+        assert line and path in line, (check, out)
+        # file:line format
+        assert ":" in line.split(" ", 1)[0]
+        assert line.split(":")[1].isdigit(), line
+
+
+def test_lint_program_cli_smoke_zoo_clean_exit_0():
+    r = _run_tool([os.path.join(REPO, "tools", "lint_program.py"),
+                   "--smoke"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "zoo:mnist:main" in r.stdout
+    assert "FAIL" not in r.stdout
+
+
+def test_lint_program_cli_flags_bad_artifact_exit_2(tmp_path):
+    # seeded-defect artifact: a program whose only op reads an
+    # undefined var, written in the save_inference_model layout
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    blk.create_var(name="z", shape=[4], dtype="float32")
+    blk.append_op(type="relu", inputs={"X": ["ghost"]},
+                  outputs={"Out": ["z"]}, infer_shape=False)
+    art = tmp_path / "bad_art"
+    art.mkdir()
+    with open(str(art / "__model__"), "w") as f:
+        json.dump({"program": p.serialize_to_string(),
+                   "feed_names": ["x"], "fetch_names": ["z"]}, f)
+    r = _run_tool([os.path.join(REPO, "tools", "lint_program.py"),
+                   str(art)])
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "undefined-var" in r.stdout
+    assert "block 0 op 0" in r.stdout and "ghost" in r.stdout

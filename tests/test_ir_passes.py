@@ -221,3 +221,116 @@ def test_fuse_elewise_add_act_keeps_act_attrs():
         fused = exe.run(main2, feed={"a": x, "b": y},
                         fetch_list=[out2])[0]
     np.testing.assert_allclose(unfused, fused, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# cross-block def/use (the analysis verifier passes lean on use_count and
+# apply_passes being right over control-flow programs — ANALYSIS.md)
+# ---------------------------------------------------------------------------
+
+def _two_block_program():
+    """Parent: add -> relu chain; sub-block (conditional) ALSO reads the
+    add's intermediate output."""
+    from paddle_tpu.fluid.framework import Operator
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="cond", shape=[1], dtype="bool", is_data=True)
+    blk.create_var(name="a", shape=[4], dtype="float32", is_data=True)
+    blk.create_var(name="b", shape=[4], dtype="float32", is_data=True)
+    blk.create_var(name="mid", shape=[4], dtype="float32")
+    blk.create_var(name="out", shape=[4], dtype="float32")
+    sub = p._create_block()
+    sub.create_var(name="sub_out", shape=[4], dtype="float32")
+    sub.append_op(type="scale", inputs={"X": ["mid"]},
+                  outputs={"Out": ["sub_out"]}, attrs={"scale": 2.0},
+                  infer_shape=False)
+    p._rollback()
+    blk.append_op(type="elementwise_add",
+                  inputs={"X": ["a"], "Y": ["b"]},
+                  outputs={"Out": ["mid"]}, infer_shape=False)
+    blk.append_op(type="relu", inputs={"X": ["mid"]},
+                  outputs={"Out": ["out"]}, infer_shape=False)
+    blk.append_op(type="conditional_block", inputs={"Cond": ["cond"]},
+                  outputs={}, attrs={"sub_block": sub},
+                  infer_shape=False)
+    return p
+
+
+def test_use_count_sees_sub_block_reads():
+    """use_count must count reads hidden inside nested sub-blocks — a
+    fusion deleting an op whose output a sub-block still reads would
+    produce an undefined-var at runtime."""
+    p = _two_block_program()
+    blk = p.global_block()
+    # 1 parent read (relu) + 1 sub-block read (scale)
+    assert ir_passes.use_count(blk, "mid") == 2
+    # counting from the sub-block itself sees only its own read
+    assert ir_passes.use_count(p.blocks[1], "mid") == 1
+    # a name nobody reads
+    assert ir_passes.use_count(blk, "out") == 0
+
+
+def test_use_count_handles_sub_block_cycles():
+    """A sub-block graph with a shared (diamond) sub-block reference
+    must not double-count or loop (the _seen guard)."""
+    p = _two_block_program()
+    blk = p.global_block()
+    sub = p.blocks[1]
+    # second control-flow op sharing the SAME sub-block object
+    blk.append_op(type="conditional_block", inputs={"Cond": ["cond"]},
+                  outputs={}, attrs={"sub_block": sub},
+                  infer_shape=False)
+    # the shared sub-block's read counts ONCE (id-based _seen set)
+    assert ir_passes.use_count(blk, "mid") == 2
+
+
+def test_fusion_declines_when_sub_block_reads_intermediate():
+    """fuse_elewise_add_act must NOT fuse add+relu here: the add's
+    output 'mid' is also read by the conditional sub-block, so deleting
+    the intermediate would break the sub-block (single-use rule across
+    blocks)."""
+    p = _two_block_program()
+    before = [op.type for op in p.global_block().ops]
+    ir_passes.get_pass("fuse_elewise_add_act_pass").apply(p)
+    after = [op.type for op in p.global_block().ops]
+    assert before == after, "fused across a live sub-block read"
+
+
+def test_apply_passes_multi_block_is_test():
+    """apply_passes drives passes over EVERY block: is_test_pass must
+    flip dropout/batch_norm inside control-flow sub-blocks too."""
+    p = Program()
+    blk = p.global_block()
+    blk.create_var(name="cond", shape=[1], dtype="bool", is_data=True)
+    blk.create_var(name="x", shape=[4], dtype="float32", is_data=True)
+    sub = p._create_block()
+    sub.create_var(name="d", shape=[4], dtype="float32")
+    sub.append_op(type="dropout", inputs={"X": ["x"]},
+                  outputs={"Out": ["d"]},
+                  attrs={"dropout_prob": 0.5}, infer_shape=False)
+    p._rollback()
+    blk.append_op(type="conditional_block", inputs={"Cond": ["cond"]},
+                  outputs={}, attrs={"sub_block": sub},
+                  infer_shape=False)
+    v0 = p._version
+    ir_passes.apply_passes(p, ["is_test_pass"])
+    assert p.blocks[1].ops[0].attrs.get("is_test") is True
+    assert p._version > v0     # rewrite passes bump the version...
+
+
+def test_analysis_passes_ride_pass_registry_without_version_bump():
+    """...while the read-only analysis passes are registered on the same
+    substrate but must NOT bump the version (a verify must never
+    invalidate the executor's compiled-step cache)."""
+    for name in ("verify_use_before_def_pass", "verify_shapes_pass",
+                 "verify_dead_code_pass",
+                 "verify_fetch_reachability_pass",
+                 "verify_aot_export_pass"):
+        assert name in ir_passes.registered_passes()
+    p = _two_block_program()
+    v0 = p._version
+    pas = ir_passes.get_pass("verify_dead_code_pass",
+                             feeds=("a", "b", "cond"), fetches=("out",))
+    pas.apply(p)
+    assert p._version == v0
+    assert isinstance(pas.diagnostics(), list)

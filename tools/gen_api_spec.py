@@ -44,6 +44,7 @@ MODULES = [
     "paddle_tpu.obs.events",
     "paddle_tpu.obs.registry",
     "paddle_tpu.compile_cache",
+    "paddle_tpu.analysis",
     "paddle_tpu.v2.layer",
     "paddle_tpu.v2.networks",
     "paddle_tpu.v2.optimizer",

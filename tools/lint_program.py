@@ -1,0 +1,151 @@
+"""Program verifier CLI — run the static analysis passes over saved
+inference artifacts and/or the model zoo.
+
+    python tools/lint_program.py <artifact_dir>... [--strict]
+    python tools/lint_program.py --zoo [--strict]
+    python tools/lint_program.py --smoke
+
+An artifact dir containing ``__model__`` (save_inference_model layout)
+is verified from its serialized Program + recorded feed/fetch names —
+no executor, no weights, no device.  AOT artifact dirs (aot_meta.bin /
+decode_meta.bin) carry serialized StableHLO instead of a Program IR and
+are reported as skipped.  ``--zoo`` builds every paddle_tpu/models
+program (small configs) and verifies main + startup with the model's
+real feeds/fetches; ``--smoke`` is the fast tier-1 subset.
+
+Exit codes: 0 clean (warnings allowed unless --strict), 2 error
+findings (each printed with block/op-index/var), 1 usage error.
+
+The ANALYSIS.md "zoo sweep" table is this tool's --zoo output.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# (name, module, small-config kwargs) — small geometries keep a full
+# sweep in seconds; the analysis is geometry-independent (shapes
+# propagate symbolically around the batch dim)
+ZOO = [
+    ("mnist", "paddle_tpu.models.mnist", dict(batch_size=8)),
+    ("vgg", "paddle_tpu.models.vgg", dict(batch_size=4)),
+    ("resnet", "paddle_tpu.models.resnet",
+     dict(batch_size=2, dataset="cifar10", depth=20, class_dim=10)),
+    ("se_resnext", "paddle_tpu.models.se_resnext",
+     dict(batch_size=2, img_size=64, class_dim=10)),
+    ("transformer", "paddle_tpu.models.transformer",
+     dict(batch_size=2, seq_len=32, vocab_size=100, d_model=64,
+          n_heads=4, n_layers=2)),
+    ("stacked_dynamic_lstm", "paddle_tpu.models.stacked_dynamic_lstm",
+     dict(batch_size=2, emb_dim=32, hid_dim=32)),
+    ("machine_translation", "paddle_tpu.models.machine_translation",
+     dict(batch_size=2, embedding_dim=32, encoder_size=32,
+          decoder_size=32, dict_size=200)),
+]
+
+SMOKE_ZOO = ("mnist", "vgg")
+
+
+def _name(x):
+    return x if isinstance(x, str) else x.name
+
+
+def lint_artifact(path, verbose=True):
+    """Verify one artifact dir; returns the diagnostics (or None when
+    the dir carries no Program IR)."""
+    from paddle_tpu.analysis import verify_program
+    from paddle_tpu.fluid.framework import Program
+    for aot in ("aot_meta.bin", "decode_meta.bin"):
+        if os.path.exists(os.path.join(path, aot)):
+            if verbose:
+                print("%s: AOT artifact (%s) — serialized StableHLO, "
+                      "no Program IR to verify" % (path, aot))
+            return None
+    model_file = os.path.join(path, "__model__")
+    if not os.path.exists(model_file):
+        raise FileNotFoundError(
+            "%s: no __model__ (not a save_inference_model dir)" % path)
+    with open(model_file) as f:
+        meta = json.load(f)
+    program = Program.parse_from_string(meta["program"])
+    return verify_program(program, feeds=meta["feed_names"],
+                          fetches=meta["fetch_names"],
+                          emit_events=False, what=path)
+
+
+def lint_zoo_model(name):
+    """Build one zoo model and verify main + startup.  Returns
+    {"main": [...], "startup": [...], "ops": N}."""
+    import importlib
+    from paddle_tpu.analysis import verify_program
+    spec = next((z for z in ZOO if z[0] == name), None)
+    if spec is None:
+        raise KeyError("unknown zoo model %r (have %s)"
+                       % (name, [z[0] for z in ZOO]))
+    _, mod, kw = spec
+    m = importlib.import_module(mod)
+    main, startup, feeds, loss, acc, predict = m.get_model(**kw)
+    fetches = [_name(v) for v in (loss, acc, predict) if v is not None]
+    return {
+        "main": verify_program(main, feeds=[_name(f) for f in feeds],
+                               fetches=fetches, emit_events=False,
+                               what="zoo:%s:main" % name),
+        "startup": verify_program(startup, emit_events=False,
+                                  what="zoo:%s:startup" % name),
+        "ops": sum(len(b.ops) for b in main.blocks),
+    }
+
+
+def _report(label, diags, strict):
+    errs = [d for d in diags if d.is_error]
+    warns = [d for d in diags if not d.is_error]
+    status = "FAIL" if errs or (strict and warns) else "ok"
+    print("%s: %s (%d error(s), %d warning(s))"
+          % (label, status, len(errs), len(warns)))
+    for d in errs + warns:
+        print("  " + str(d))
+    return bool(errs or (strict and warns))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="static program verifier over artifacts / the zoo")
+    ap.add_argument("paths", nargs="*",
+                    help="save_inference_model artifact dirs")
+    ap.add_argument("--zoo", action="store_true",
+                    help="build + verify every models/ zoo program")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast tier-1 subset of --zoo (%s)"
+                         % ", ".join(SMOKE_ZOO))
+    ap.add_argument("--strict", action="store_true",
+                    help="warnings also fail (exit 2)")
+    args = ap.parse_args(argv)
+    if not args.paths and not args.zoo and not args.smoke:
+        ap.error("nothing to lint: give artifact dirs, --zoo or --smoke")
+
+    failed = False
+    for path in args.paths:
+        try:
+            diags = lint_artifact(path)
+        except FileNotFoundError as e:
+            print(str(e))
+            return 1
+        if diags is not None:
+            failed |= _report(path, diags, args.strict)
+    names = [z[0] for z in ZOO] if args.zoo else \
+        (list(SMOKE_ZOO) if args.smoke else [])
+    for name in names:
+        r = lint_zoo_model(name)
+        failed |= _report("zoo:%s:main (%d ops)" % (name, r["ops"]),
+                          r["main"], args.strict)
+        failed |= _report("zoo:%s:startup" % name, r["startup"],
+                          args.strict)
+    return 2 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

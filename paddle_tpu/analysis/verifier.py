@@ -250,6 +250,15 @@ class VerifyUseBeforeDefPass(AnalysisPass):
 # environment (arrays / control flow write results into env), concrete
 # index values, or host execution — the registry's infer_shape skips
 # them for the same reason (each entry names why)
+#
+# NOT here by design: the quantized-inference ops (dequant_mul,
+# dequant_conv2d, dequant_lookup_table — ops/quant_ops.py).  They are
+# ordinary registry lowerings that evaluate abstractly (the int8 weight
+# and fp32 scale are plain ShapeDtypeStructs; the Pallas dequant-matmul
+# traces in interpret mode off-TPU), so quantized artifacts go through
+# verify_shapes_pass like any other program — no `unregistered-op`
+# findings and full shape/dtype checking of the PTQ rewrite
+# (QUANTIZE.md; tools/lint_program.py additionally CRCs the payloads).
 _EVAL_SKIP_TYPES = frozenset([
     "while", "conditional_block", "recurrent",   # env-mutating control flow
     "while_grad_dynamic",                        # host replay

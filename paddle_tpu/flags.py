@@ -388,6 +388,21 @@ DEFINE_int(
     "evicts least-recently-used entries (manifest mtime, touched on "
     "every hit) across both the AOT entries and jax's xla/ files. The "
     "entry just written is never the victim.")
+DEFINE_int(
+    "quantize_min_weight_elems", 1024,
+    "PTQ size floor (inference/quantize.py): a weight with fewer "
+    "elements than this stays fp32 — biases, norm scales and small "
+    "embeddings are not worth the dequant plumbing (their bytes are "
+    "noise on the HBM roofline) and are the numerically riskiest to "
+    "quantize. Applies to mul/conv filters and embedding tables alike.")
+DEFINE_int(
+    "quantize_calib_batches", 4,
+    "How many user-supplied calibration batches the PTQ pass consumes "
+    "(inference/quantize.py): per-channel int8 scales start at absmax "
+    "and a small clip-ratio search refines them against the calibration "
+    "activations (fc layers) or the weight-quantization MSE (conv); "
+    "extra batches beyond this are ignored so a big feed list cannot "
+    "turn quantization into a training run.")
 DEFINE_bool(
     "verify_program", False,
     "Pre-run program verification (ANALYSIS.md): before an Executor / "

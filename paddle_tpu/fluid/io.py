@@ -168,6 +168,13 @@ def load_inference_model(dirname, executor, model_filename=None,
                             feeds=meta["feed_names"],
                             fetches=meta["fetch_names"],
                             what="load_inference_model(%r)" % dirname)
+    # quantized artifact (QUANTIZE.md): the int8 payloads and scale
+    # tables CRC-verify against quant_meta.bin BEFORE any weight loads
+    # — a tampered payload is rejected naming the corrupt file, the
+    # same at-load discipline the verifier gives the Program half
+    if os.path.exists(os.path.join(dirname, "quant_meta.bin")):
+        from ..inference.quantize import check_quantized_dir
+        check_quantized_dir(dirname)
     # load params into scope under the program's var names
     vars = [v for v in program.global_block().vars.values()
             if isinstance(v, Parameter) or v.persistable]

@@ -6,10 +6,18 @@ from .decode import (
     GenerativePredictor, DecodeSession, save_decode_model,
     build_tiny_decode_model, load_decode_predictor, greedy_decode,
 )
+from .quantize import (
+    quantize_inference_model, read_quant_meta, is_quantized_dir,
+    verify_quantized_dir, check_quantized_dir, artifact_precision,
+    QuantizedArtifactError,
+)
 
 __all__ = [
     "NativeConfig", "AnalysisConfig", "PaddleTensor", "Predictor",
     "create_paddle_predictor", "AotPredictor", "load_aot_predictor",
     "GenerativePredictor", "DecodeSession", "save_decode_model",
     "build_tiny_decode_model", "load_decode_predictor", "greedy_decode",
+    "quantize_inference_model", "read_quant_meta", "is_quantized_dir",
+    "verify_quantized_dir", "check_quantized_dir", "artifact_precision",
+    "QuantizedArtifactError",
 ]

@@ -125,6 +125,13 @@ class Predictor:
                           fetches=[v.name for v in fetch_vars],
                           what="predictor program (post-transpile)")
         self._program = program
+        # the numerics lane this artifact serves (QUANTIZE.md): 'int8'
+        # when the PTQ pass rewrote its contractions to dequant_* ops,
+        # else 'fp32'.  Read from the program (not the dir) so clones
+        # and registry replicas agree by construction.
+        self._precision = "int8" if any(
+            op.type.startswith("dequant_")
+            for op in program.global_block().ops) else "fp32"
         self._feed_names = list(feed_names)
         self._fetch_names = [v.name for v in fetch_vars]
         self._fetch_vars = fetch_vars
@@ -196,6 +203,10 @@ class Predictor:
             "fetches": list(self._fetch_names),
             "state": cc._spec_sig(self._state),
             "amp": _amp_enabled(),
+            # the numerics lane is an explicit fingerprint field: an
+            # int8 and an fp32 build of the same model must NEVER share
+            # an executable, whatever else collides (COMPILE_CACHE.md)
+            "precision": self._precision,
             "env": cc.environment_fingerprint(self._device),
         }
 
@@ -406,6 +417,7 @@ class Predictor:
         p._scope = self._scope
         p._exe = self._exe
         p._program = self._program
+        p._precision = self._precision
         p._feed_names = list(self._feed_names)
         p._fetch_names = list(self._fetch_names)
         p._fetch_vars = self._fetch_vars
@@ -443,6 +455,12 @@ class Predictor:
     def device(self):
         """The jax.Device this predictor is pinned to, or None."""
         return self._device
+
+    @property
+    def precision(self):
+        """The numerics lane this predictor serves: 'fp32' or 'int8'
+        (the serving registry's precision axis, QUANTIZE.md)."""
+        return self._precision
 
     # ------------------------------------------------------------------
     # serving introspection (paddle_tpu/serving): the batcher needs the
@@ -720,6 +738,13 @@ class AotPredictor:
     @property
     def device(self):
         return self._device
+
+    @property
+    def precision(self):
+        """AOT artifacts are exported from the fp32 path today; the
+        attribute exists so the serving registry's precision axis reads
+        one surface across predictor types."""
+        return "fp32"
 
     # ---- serving introspection (mirrors Predictor's) ----
 

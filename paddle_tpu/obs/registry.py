@@ -143,6 +143,19 @@ class MetricsRegistry(object):
 
     # -- exposition ---------------------------------------------------
 
+    @staticmethod
+    def _model_labels(model_key, m, **extra):
+        """Label set of one serving lane: the plain model name plus a
+        ``precision`` label for non-fp32 lanes (the QUANTIZE.md A/B
+        axis — an int8 lane keys as 'name@int8' in the snapshot but
+        scrapes as model='name', precision='int8')."""
+        labels = {"model": m.get("model", model_key)}
+        prec = m.get("precision")
+        if prec and prec != "fp32":
+            labels["precision"] = prec
+        labels.update(extra)
+        return labels
+
     def _render_serving(self, lines):
         snaps = []
         with self._lock:
@@ -158,8 +171,9 @@ class MetricsRegistry(object):
             for snap in snaps:
                 for model, m in sorted(snap.get("models", {}).items()):
                     if field in m:
-                        samples.append((mname, {"model": model},
-                                        m[field]))
+                        samples.append(
+                            (mname, self._model_labels(model, m),
+                             m[field]))
             _family(lines, mname, "counter", samples)
         for field in _SERVING_GAUGES:
             mname = _PREFIX + "serving_" + field
@@ -167,8 +181,9 @@ class MetricsRegistry(object):
             for snap in snaps:
                 for model, m in sorted(snap.get("models", {}).items()):
                     if field in m:
-                        samples.append((mname, {"model": model},
-                                        m[field]))
+                        samples.append(
+                            (mname, self._model_labels(model, m),
+                             m[field]))
             _family(lines, mname, "gauge", samples)
         for hist_field in _SERVING_HISTS:
             mname = _PREFIX + "serving_" + hist_field
@@ -180,10 +195,13 @@ class MetricsRegistry(object):
                     h = m.get(hist_field) or {}
                     for q in _QUANTILES:
                         if h.get(q) is not None:
-                            samples.append((mname, {"model": model,
-                                                    "quantile": q},
-                                            h[q]))
-                    samples.append((mname + "_count", {"model": model},
+                            samples.append(
+                                (mname,
+                                 self._model_labels(model, m,
+                                                    quantile=q),
+                                 h[q]))
+                    samples.append((mname + "_count",
+                                    self._model_labels(model, m),
                                     h.get("count", 0)))
             _family(lines, mname, "summary", samples)
         # priority-shed + per-model compile-cache attribution
@@ -194,7 +212,9 @@ class MetricsRegistry(object):
                         (m.get("shed_by_priority") or {}).items()):
                     samples.append((_PREFIX + "serving_shed_by_priority_"
                                     "total",
-                                    {"model": model, "priority": pri}, n))
+                                    self._model_labels(model, m,
+                                                       priority=pri),
+                                    n))
         _family(lines, _PREFIX + "serving_shed_by_priority_total",
                 "counter", samples)
         samples = []
@@ -204,7 +224,8 @@ class MetricsRegistry(object):
                 for f in ("hits", "misses"):
                     samples.append((_PREFIX + "serving_compile_cache_%s_"
                                     "total" % f,
-                                    {"model": model}, cc.get(f, 0)))
+                                    self._model_labels(model, m),
+                                    cc.get(f, 0)))
         _family(lines, _PREFIX + "serving_compile_cache_total", "counter",
                 samples)
 

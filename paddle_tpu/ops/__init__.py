@@ -21,6 +21,7 @@ from . import io_ops            # noqa: F401
 from . import compat_ops        # noqa: F401
 from . import csp_ops           # noqa: F401
 from . import pallas_kernels    # noqa: F401
+from . import quant_ops         # noqa: F401
 
 from .registry import (  # noqa: F401
     register_op, get_op_def, has_op, registered_ops, infer_shape, ExecContext,

@@ -35,10 +35,12 @@ import os
 import threading
 
 TUNING_NAMESPACE = "flash_attention"
+DEQUANT_NAMESPACE = "dequant_matmul"
 
 __all__ = ["AttentionConfig", "get_config", "default_config", "lookup",
            "record", "cache_path", "config_key", "attention_vmem_bytes",
            "decode_config_key", "get_decode_config", "record_decode",
+           "dequant_config_key", "get_dequant_config", "record_dequant",
            "MIN_LANES"]
 
 MIN_LANES = 128     # TPU lane width: the last-dim alignment quantum
@@ -294,6 +296,64 @@ def record_decode(seq_len, head_dim, dtype, block_kv, extra=None,
     with _memo_lock:
         _memo.pop(path, None)
     return path
+
+
+def dequant_config_key(m, k, n, dtype):
+    """Tuning key of the fused dequant-matmul kernel's block geometry for
+    one (rows, reduce, channels, activation-dtype) shape — its own
+    registry namespace (``dequant_matmul``), one JSON under
+    <store>/tuning/ like every other kernel family."""
+    return "M%d_K%d_N%d_%s" % (int(m), int(k), int(n), str(dtype))
+
+
+def _dequant_blocks(rec):
+    if not isinstance(rec, dict):
+        return None
+    try:
+        bm, bk, bn = (int(rec["block_m"]), int(rec["block_k"]),
+                      int(rec["block_n"]))
+    except (KeyError, TypeError, ValueError):
+        return None
+    return (bm, bk, bn) if bm > 0 and bk > 0 and bn > 0 else None
+
+
+def get_dequant_config(m, k, n, dtype):
+    """(block_m, block_k, block_n) for the fused dequant-matmul kernel,
+    or None when no candidate geometry tiles the shape (the caller falls
+    back to the plain-XLA dequant composition).  Resolution mirrors the
+    attention kernels: tuned registry entry first, then the MXU-aligned
+    heuristic — block edges <= 128 that divide each dim, with the row
+    block allowed down to 1 (serving buckets legitimately run batch 1,
+    and one padded bucket row tile is still a full-lane MXU pass)."""
+    from .. import compile_cache as cc
+    key = dequant_config_key(m, k, n, dtype)
+    tuned = _dequant_blocks(cc.tuning_lookup(DEQUANT_NAMESPACE, key))
+    if tuned is not None:
+        bm, bk, bn = tuned
+        if m % bm == 0 and k % bk == 0 and n % bn == 0:
+            return tuned
+    bm = next((b for b in _CANDIDATES if b <= MIN_LANES and m % b == 0),
+              None)
+    bk = _pick_block(k, MIN_LANES * 4)
+    bn = _pick_block(n, MIN_LANES * 2)
+    if bm is None or bk is None or bn is None:
+        return None
+    return (bm, bk, bn)
+
+
+def record_dequant(m, k, n, dtype, block_m, block_k, block_n,
+                   extra=None):
+    """Persist a tuned dequant-matmul geometry to the kernel-tuning
+    registry (namespace ``dequant_matmul``) with the shared atomic
+    commit discipline; a killed tuner leaves the previous registry
+    intact."""
+    rec = {"block_m": int(block_m), "block_k": int(block_k),
+           "block_n": int(block_n)}
+    if extra:
+        rec.update(extra)
+    from .. import compile_cache as cc
+    return cc.tuning_record(DEQUANT_NAMESPACE,
+                            dequant_config_key(m, k, n, dtype), rec)
 
 
 def attention_vmem_bytes(head_dim, block_q, block_kv, itemsize=2):

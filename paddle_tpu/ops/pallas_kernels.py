@@ -30,7 +30,8 @@ from . import attention_tuning
 
 __all__ = ["flash_attention", "decode_attention",
            "decode_attention_reference", "fused_bottleneck",
-           "bottleneck_reference", "mosaic_lowering"]
+           "bottleneck_reference", "dequant_matmul",
+           "dequant_matmul_reference", "mosaic_lowering"]
 
 # Finite mask value (not -inf): exp(_NEG_INF - finite) underflows to an
 # exact 0, and the logsumexp of a fully-masked row stays finite, so the
@@ -586,6 +587,115 @@ def decode_attention(q, k_cache, v_cache, lengths, scale=None,
 
     return _interpret_dispatch(call, interpret, q, k_cache, v_cache,
                                lengths2d)
+
+
+# ---------------------------------------------------------------------------
+# fused dequant-matmul: the quantized-inference contraction (QUANTIZE.md).
+# The serving flagship sits at 97% of HBM peak (bench.py MFU note) — on
+# that roofline, weight BYTES are the step time, so the int8 weight tile
+# is streamed from HBM as int8 and dequantized in-register against the
+# resident activation tile (Tensor Processing Primitives' fused
+# dequant-contraction shape, PAPERS.md): fp32/bf16 weights never touch
+# HBM. Per-OUTPUT-channel scales distribute over the K reduction, so
+# dequantization folds into the finalize step: acc[m, n] * scale[n] —
+# one multiply per output element, not one per weight element.
+# ---------------------------------------------------------------------------
+
+
+def _dequant_matmul_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref):
+    """One (m-block, n-block, k-block) grid step. The activation tile
+    and the fp32 accumulator stay resident across the innermost k axis;
+    int8 weight tiles stream through, cast to the activation dtype in
+    VMEM (the in-register dequant — the scale waits for finalize)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                               # [BM, BK] activation
+    w = w_ref[...].astype(x.dtype)               # [BK, BN] int8 -> act
+    acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        o_ref[...] = (acc_ref[...]
+                      * s_ref[0].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def dequant_matmul_reference(x, w_q, scale, out_dtype=None):
+    """Plain-XLA oracle/fallback with identical numerics contract:
+    x [M, K] float, w_q [K, N] int8, scale [N] f32 per-output-channel ->
+    [M, N].  The weight dequantizes through the ACTIVATION dtype (bf16
+    activations see a bf16 weight — the same cast the kernel makes
+    in-register) and the scale applies to the fp32 accumulator."""
+    import jax
+    import jax.numpy as jnp
+    acc = jax.lax.dot_general(
+        x, w_q.astype(x.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    out = acc * scale.astype(jnp.float32)
+    return out.astype(out_dtype or x.dtype)
+
+
+def dequant_matmul(x, w_q, scale, out_dtype=None, block_m=None,
+                   block_k=None, block_n=None, interpret=None):
+    """Fused dequant-matmul: x [M, K] (fp32/bf16 activations), w_q
+    [K, N] int8 per-output-channel-quantized weights, scale [N] f32 ->
+    [M, N] in `out_dtype` (default: x.dtype).
+
+    Pallas kernel on TPU (interpret emulation elsewhere) streaming int8
+    weight tiles under a resident activation tile with fp32 accumulation;
+    block geometry resolves through the kernel-tuning registry namespace
+    ``dequant_matmul`` (attention_tuning.get_dequant_config: tuned entry
+    > MXU-aligned heuristic; explicit block args override).  Falls back
+    to the plain-XLA composition when no geometry tiles the shape —
+    channel counts not divisible by any candidate block edge included."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, K = x.shape
+    N = w_q.shape[1]
+    cfg = attention_tuning.get_dequant_config(
+        M, K, N, jnp.dtype(x.dtype).name)
+    bm = int(block_m or (cfg[0] if cfg else 0))
+    bk = int(block_k or (cfg[1] if cfg else 0))
+    bn = int(block_n or (cfg[2] if cfg else 0))
+    if (not bm or not bk or not bn
+            or M % bm or K % bk or N % bn):
+        return dequant_matmul_reference(x, w_q, scale,
+                                        out_dtype=out_dtype)
+    scale2d = scale.reshape(1, N).astype(jnp.float32)
+
+    def call(interp, *ops):
+        return pl.pallas_call(
+            _dequant_matmul_kernel,
+            grid=(M // bm, N // bn, K // bk),
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+                pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+                pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+            out_shape=jax.ShapeDtypeStruct(
+                (M, N), jnp.dtype(out_dtype or x.dtype)),
+            scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+            compiler_params=_compiler_params(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+            interpret=interp,
+        )(*ops)
+
+    return _interpret_dispatch(call, interpret, x, w_q, scale2d)
 
 
 # ---------------------------------------------------------------------------

@@ -112,8 +112,12 @@ class ModelMetrics:
 
     QPS_WINDOW_SECS = 60.0
 
-    def __init__(self, name):
+    def __init__(self, name, precision="fp32"):
         self.name = name
+        # the numerics lane these counters meter (QUANTIZE.md): an int8
+        # A/B sibling of the same model name gets its OWN ModelMetrics,
+        # so per-precision QPS/latency/compile-cache rows never blur
+        self.precision = str(precision or "fp32")
         self.requests = Counter()        # accepted submits
         self.responses = Counter()       # futures resolved with a result
         self.errors = Counter()          # futures resolved with an error
@@ -240,6 +244,7 @@ class ModelMetrics:
         padded = self.padded_slots.value
         snap = {
             "model": self.name,
+            "precision": self.precision,
             "uptime_sec": round(uptime, 3),
             "requests": self.requests.value,
             "responses": self.responses.value,
@@ -314,16 +319,26 @@ class ServingMetrics:
         self._lock = threading.Lock()
         self._started = time.monotonic()
 
-    def model(self, name):
+    def model(self, name, precision=None):
+        """One ModelMetrics per (name, precision lane).  The fp32 lane
+        keeps the bare-name key (and so the pre-quantization wire
+        schema); other lanes key as ``name@precision`` — two lanes of
+        one model render as two rows in stats/serving_top/Prometheus."""
+        key = name if precision in (None, "fp32") \
+            else "%s@%s" % (name, precision)
         with self._lock:
-            m = self._models.get(name)
+            m = self._models.get(key)
             if m is None:
-                m = self._models[name] = ModelMetrics(name)
+                m = self._models[key] = ModelMetrics(
+                    name, precision=precision or "fp32")
             return m
 
     def drop(self, name):
         with self._lock:
             self._models.pop(name, None)
+            for key in [k for k in self._models
+                        if k.startswith(name + "@")]:
+                self._models.pop(key, None)
 
     def snapshot(self):
         with self._lock:

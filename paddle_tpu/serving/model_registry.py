@@ -145,7 +145,7 @@ class ModelEntry:
     identical across replicas by construction."""
 
     def __init__(self, name, version, path, predictor, batcher,
-                 replicas=None, devices=None):
+                 replicas=None, devices=None, precision="fp32"):
         self.name = name
         self.version = version
         self.path = path
@@ -153,6 +153,10 @@ class ModelEntry:
         self.batcher = batcher
         self.replicas = list(replicas) if replicas else [predictor]
         self.devices = list(devices) if devices else [None]
+        # the numerics lane this version serves (QUANTIZE.md): 'int8'
+        # for a PTQ artifact, 'fp32' otherwise — the axis the router
+        # splits on and the metrics lane files under
+        self.precision = str(precision or "fp32")
         # what THIS build+warm cost against the persistent compile
         # cache (compile_cache.stats_delta, set by load_model): a warm
         # flip shows misses == 0 — zero fresh compilations
@@ -226,13 +230,24 @@ class ModelRegistry:
 
     def load_model(self, name, path, version=None, warm=True,
                    buckets=None, drain_timeout=30.0, replicas=None,
-                   devices=None, decode_slots=None, decode_mode=None):
+                   devices=None, decode_slots=None, decode_mode=None,
+                   precision=None, ab_weight=None):
         """Load (or hot-swap in) `path` as `name`.  Returns the entry.
         `replicas`/`devices` override the registry's default placement
         spec (see resolve_placement).  ALL replicas are built and
-        warmed before the flip; the displaced latest version's replica
-        set, if any, is drained and retired AFTER the flip — in-flight
-        requests on it complete.
+        warmed before the flip; the displaced latest version OF THE
+        SAME PRECISION LANE, if any, is drained and retired AFTER the
+        flip — in-flight requests on it complete.  Loading an int8
+        sibling never touches the live fp32 lane (and vice versa):
+        that's the A/B axis, not a hot swap.
+
+        `precision` overrides the artifact's own lane (auto-detected
+        from quant_meta.bin / the rewritten program — 'int8' vs
+        'fp32'); `ab_weight` sets this lane's share of DEFAULT-routed
+        traffic (requests carrying no explicit precision), e.g. 0.1
+        canaries the quantized lane at 10%.  Without weights, default
+        traffic stays on the fp32 lane — loading a quantized sibling
+        must not silently move traffic.
 
         A decode artifact (decode_meta.bin) is fronted by a
         DecodeBatcher instead: per-replica slot tables of
@@ -245,19 +260,23 @@ class ModelRegistry:
         placement = resolve_placement(spec)
         cc_before = compile_cache.stats()
         preds = _build_replicas(path, buckets, placement)
+        precision = str(precision or getattr(preds[0], "precision",
+                                             "fp32"))
+        lane_metrics = self.metrics.model(name, precision)
         if getattr(preds[0], "is_decode", False):
             batcher = DecodeBatcher(
                 preds[0], replicas=preds, n_slots=decode_slots,
                 max_queue=self._max_queue,
-                metrics=self.metrics.model(name),
+                metrics=lane_metrics,
                 continuous=(decode_mode != "static"))
         else:
             batcher = DynamicBatcher(
                 preds[0], max_queue=self._max_queue,
                 deadline_ms=self._deadline_ms, workers=self._workers,
-                metrics=self.metrics.model(name), replicas=preds)
+                metrics=lane_metrics, replicas=preds)
         entry = ModelEntry(name, version, path, preds[0], batcher,
-                           replicas=preds, devices=placement)
+                           replicas=preds, devices=placement,
+                           precision=precision)
         if warm:
             try:
                 entry.warm()
@@ -268,31 +287,38 @@ class ModelRegistry:
         # counter delta is exactly what this load/flip cost against the
         # persistent compile cache (load_model reply + metrics)
         entry.compile_cache = compile_cache.stats_delta(cc_before)
-        self.metrics.model(name).note_compile(entry.compile_cache)
+        lane_metrics.note_compile(entry.compile_cache)
         # the compile-cache delta is a lifecycle fact worth keeping: a
         # warm flip reads hits=N misses=0 in the event log forever,
         # even after the stats counters blur across later loads
         obs_events.emit("compile_cache_delta", model=name,
+                        precision=precision,
                         hits=int(entry.compile_cache.get("hits", 0)),
                         misses=int(entry.compile_cache.get("misses", 0)))
         displaced = None
         with self._lock:
             slot = self._models.setdefault(
-                name, {"versions": {}, "latest": None})
+                name, {"versions": {}, "latest": None,
+                       "latest_prec": {}, "ab": {}, "ab_credit": {}})
             if version is None:
                 prev = [v for v in slot["versions"] if isinstance(v, int)]
                 version = entry.version = (max(prev) + 1) if prev else 1
-            old_latest = slot["latest"]
-            if old_latest is not None and old_latest != version:
-                displaced = slot["versions"].get(old_latest)
+            # hot swap is per precision LANE: the displaced set is the
+            # old latest of THIS lane, never the A/B sibling
+            old_lane = slot.setdefault("latest_prec", {}).get(precision)
+            if old_lane is not None and old_lane != version:
+                displaced = slot["versions"].get(old_lane)
             replaced_same = slot["versions"].get(version)
             slot["versions"][version] = entry
             slot["latest"] = version  # the atomic flip
-            flipped_from = old_latest
+            slot["latest_prec"][precision] = version
+            if ab_weight is not None:
+                slot.setdefault("ab", {})[precision] = float(ab_weight)
+            flipped_from = old_lane
         # the new batcher owns the live replica/queue-depth hooks from
         # here on; the displaced set still drains below
         obs_events.emit("hot_swap", model=name, version=version,
-                        from_version=flipped_from,
+                        from_version=flipped_from, precision=precision,
                         replicas=len(entry.replicas))
         for old in (displaced, replaced_same):
             if old is not None and old is not entry:
@@ -302,6 +328,23 @@ class ModelRegistry:
                     if slot and slot["versions"].get(old.version) is old:
                         del slot["versions"][old.version]
         return entry
+
+    def set_ab_weights(self, name, weights):
+        """Set the default-traffic split across precision lanes, e.g.
+        ``{"fp32": 0.5, "int8": 0.5}``.  Requests carrying an explicit
+        `precision` (or `version`) bypass the split.  Weights are
+        absolute traffic fractions: a lane absent from the dict shares
+        whatever fraction the named lanes leave unassigned (so one
+        ``{"int8": 0.1}`` entry canaries int8 at 10% with fp32 keeping
+        90%); weights summing >= 1 leave absent lanes nothing."""
+        clean = {str(k): float(v) for k, v in dict(weights).items()
+                 if float(v) > 0.0}
+        with self._lock:
+            slot = self._models.get(name)
+            if slot is None:
+                raise KeyError("no model %r" % name)
+            slot["ab"] = clean
+            slot["ab_credit"] = {}
 
     def unload_model(self, name, drain_timeout=30.0):
         """Remove `name` entirely: new requests fail immediately,
@@ -325,12 +368,21 @@ class ModelRegistry:
             for name, slot in self._models.items():
                 info = {"latest": slot["latest"],
                         "versions": sorted(slot["versions"])}
+                lanes = slot.get("latest_prec") or {}
+                if lanes:
+                    # the precision axis: which version each numerics
+                    # lane routes to, plus the default-traffic split
+                    info["precisions"] = dict(sorted(lanes.items()))
+                    if slot.get("ab"):
+                        info["ab_weights"] = dict(
+                            sorted(slot["ab"].items()))
                 latest = slot["versions"].get(slot["latest"])
                 if latest is not None:
                     info["buckets"] = list(
                         latest.predictor.batch_buckets())
                     info["replicas"] = len(latest.replicas)
                     info["devices"] = latest.device_labels()
+                    info["precision"] = latest.precision
                     if latest.is_decode:
                         # decode entry: buckets above are the PROMPT
                         # prefill buckets; surface the generation shape
@@ -346,32 +398,79 @@ class ModelRegistry:
 
     # ------------------------------------------------------------------
 
-    def _entry_locked(self, name, version):
+    def _entry_locked(self, name, version, precision=None):
         slot = self._models.get(name)
         if slot is None:
             raise KeyError("no model %r" % name)
-        v = slot["latest"] if version is None else version
+        if version is None:
+            v = self._route_version_locked(slot, name, precision)
+        else:
+            v = version
         entry = slot["versions"].get(v)
         if entry is None:
             raise KeyError("model %r has no version %r" % (name, v))
         return entry
 
+    def _route_version_locked(self, slot, name, precision):
+        """The precision router (QUANTIZE.md A/B axis).  An explicit
+        `precision` resolves to that lane's latest (KeyError when the
+        lane was never loaded).  Default traffic: with A/B weights set
+        (set_ab_weights / load_model ab_weight) the pick is a smooth
+        weighted round-robin over the live lanes — deterministic, no
+        RNG, exact shares over any window; without weights it stays on
+        the fp32 lane when one exists (loading a quantized sibling
+        must not move traffic by itself), else the overall latest."""
+        lanes = slot.get("latest_prec") or {}
+        if precision is not None:
+            v = lanes.get(str(precision))
+            if v is None:
+                raise KeyError(
+                    "model %r has no %r precision lane (have %s)"
+                    % (name, precision, sorted(lanes) or ["fp32"]))
+            return v
+        ab = {p: w for p, w in (slot.get("ab") or {}).items()
+              if p in lanes and w > 0.0}
+        if len(lanes) > 1 and ab:
+            # weights are absolute traffic fractions: lanes left out of
+            # the dict share the UNASSIGNED remainder, so
+            # load_model(ab_weight=0.1) canaries the new lane at 10%
+            # with the fp32 lane keeping the other 90% (weights summing
+            # >= 1 leave nothing for unweighted lanes)
+            others = [p for p in lanes if p not in ab]
+            rem = max(0.0, 1.0 - sum(ab.values()))
+            if others and rem > 0.0:
+                for p in others:
+                    ab[p] = rem / len(others)
+            credit = slot.setdefault("ab_credit", {})
+            total = sum(ab.values())
+            for p, w in ab.items():
+                credit[p] = credit.get(p, 0.0) + w
+            pick = max(sorted(ab), key=lambda p: credit.get(p, 0.0))
+            credit[pick] -= total
+            return lanes[pick]
+        if len(lanes) > 1 and "fp32" in lanes:
+            return lanes["fp32"]
+        return slot["latest"]
+
     def submit(self, name, feeds, version=None, deadline=None,
                priority=0, trace_id=None, max_new_tokens=None,
-               chunk_tokens=None):
+               chunk_tokens=None, precision=None):
         """Route one request; returns the batcher Future.  Resolution
         and submit happen under ONE lock acquisition so a concurrent hot
         swap can never retire a version between the two (the no-dropped-
         request guarantee: the swap's drain only starts after the flip,
         and every pre-flip submit is already queued).  `trace_id` rides
         through to the batcher's stage spans (OBSERVABILITY.md).
+        `precision` pins the request to one numerics lane ('fp32' /
+        'int8'); None routes by the A/B weights (see load_model).
 
         On a DECODE entry, `feeds` must carry the prompt as "tokens";
         the returned DecodeStream duck-types the batcher Future
         (`result()` -> [generated int32 tokens]), so one-shot `infer`
         callers work unchanged — streaming callers use submit_stream."""
         with self._lock:
-            entry = self._entry_locked(name, version)
+            entry = self._entry_locked(name, version,
+                                       precision=precision)
             if entry.is_decode:
                 if not isinstance(feeds, dict) or "tokens" not in feeds:
                     raise ValueError(
@@ -406,11 +505,11 @@ class ModelRegistry:
                 trace_id=trace_id, chunk_tokens=chunk_tokens)
 
     def infer(self, name, feeds, version=None, deadline=None,
-              timeout=None, priority=0):
+              timeout=None, priority=0, precision=None):
         """Blocking submit+wait convenience for in-process callers."""
         return self.submit(name, feeds, version=version,
-                           deadline=deadline,
-                           priority=priority).result(timeout=timeout)
+                           deadline=deadline, priority=priority,
+                           precision=precision).result(timeout=timeout)
 
     def close_all(self, drain=True, timeout=30.0):
         with self._lock:

@@ -232,12 +232,17 @@ class InferenceServer:
                 replicas=msg.get("replicas"),
                 devices=msg.get("devices"),
                 decode_slots=msg.get("decode_slots"),
-                decode_mode=msg.get("decode_mode"))
+                decode_mode=msg.get("decode_mode"),
+                precision=msg.get("precision"),
+                ab_weight=msg.get("ab_weight"))
             reply = {"ok": True, "name": entry.name,
                      "version": entry.version,
                      "buckets": list(entry.predictor.batch_buckets()),
                      "replicas": len(entry.replicas),
                      "devices": entry.device_labels(),
+                     # which numerics lane this version serves
+                     # (QUANTIZE.md A/B axis)
+                     "precision": entry.precision,
                      # what THIS load/flip cost against the persistent
                      # compile cache: a warm flip reads hits=N, misses=0
                      "compile_cache": dict(entry.compile_cache)}
@@ -285,7 +290,8 @@ class InferenceServer:
                 deadline=deadline,
                 priority=int(msg.get("priority", 0)),
                 trace_id=trace_id,
-                max_new_tokens=msg.get("max_new_tokens"))
+                max_new_tokens=msg.get("max_new_tokens"),
+                precision=msg.get("precision"))
             try:
                 fetches = future.result(timeout=wait)
             except DeadlineExceeded:
@@ -530,7 +536,7 @@ class ServingClient:
 
     def infer(self, model, feeds, deadline_ms=None, version=None,
               retry_sheds=None, priority=None, debug=False,
-              trace_id=None, max_new_tokens=None):
+              trace_id=None, max_new_tokens=None, precision=None):
         """Run one request.  Returns the fetch list; with
         ``debug=True`` returns ``(fetches, info)`` where ``info`` is
         the server-measured latency attribution (trace_id,
@@ -546,6 +552,10 @@ class ServingClient:
                          for k, v in feeds.items()}}
         if version is not None:
             msg["version"] = version
+        if precision is not None:
+            # pin the request to one numerics lane ('fp32' / 'int8');
+            # without it the server's A/B weights route (QUANTIZE.md)
+            msg["precision"] = str(precision)
         if max_new_tokens is not None:
             # decode models through the one-shot verb: the whole greedy
             # stream returns as fetches[0]
@@ -577,10 +587,16 @@ class ServingClient:
 
     def load_model(self, name, path, version=None, buckets=None,
                    replicas=None, devices=None, decode_slots=None,
-                   decode_mode=None):
+                   decode_mode=None, precision=None, ab_weight=None):
         msg = {"cmd": "load_model", "name": name, "path": path}
         if version is not None:
             msg["version"] = version
+        if precision is not None:
+            # lane override; normally auto-detected from the artifact
+            msg["precision"] = str(precision)
+        if ab_weight is not None:
+            # this lane's share of default-routed traffic (A/B canary)
+            msg["ab_weight"] = float(ab_weight)
         if buckets is not None:
             msg["buckets"] = [int(b) for b in buckets]
         if replicas is not None:

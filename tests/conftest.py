@@ -18,3 +18,10 @@ os.environ.setdefault("CPU_NUM", "8")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: the marker gates subprocess-heavy
+    # bench smokes that have their own standalone entry points
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 sweep")

@@ -1,0 +1,504 @@
+"""Quantized inference path (QUANTIZE.md): PTQ pass, fused
+dequant-matmul kernel parity, tamper rejection, the serving precision
+axis (A/B routing + per-precision metrics), compile-cache fingerprint
+isolation, and the CLI / chaos surfaces."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.flags import FLAGS
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture
+def no_cc():
+    """Compile cache off: these tests measure numerics/routing, not the
+    store (the store-facing tests manage their own fresh root)."""
+    old = fluid.get_flags(["compile_cache"])
+    fluid.set_flags({"compile_cache": False})
+    yield
+    fluid.set_flags(old)
+
+
+@pytest.fixture
+def store(tmp_path):
+    from paddle_tpu import compile_cache as cc
+    old = fluid.get_flags(["compile_cache", "compile_cache_dir"])
+    root = str(tmp_path / "cc_store")
+    fluid.set_flags({"compile_cache": True, "compile_cache_dir": root})
+    cc.reset_stats()
+    yield root
+    fluid.set_flags(old)
+    cc.reset_stats()
+
+
+def _export_fc(tmp_path, name="fc", seed=7, in_dim=16, hidden=64,
+               classes=10):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[in_dim], dtype="float32")
+        h = fluid.layers.fc(input=x, size=hidden, act="relu")
+        pred = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = str(tmp_path / name)
+        fluid.save_inference_model(md, ["x"], [pred], exe,
+                                   main_program=main)
+    return md, (in_dim,)
+
+
+def _export_mnist_cnn(tmp_path, name="cnn", seed=11):
+    """conv2d + fc: exercises the dequant_conv2d path too."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[1, 12, 12],
+                              dtype="float32")
+        conv = fluid.layers.conv2d(input=x, num_filters=8,
+                                   filter_size=3, padding=1, act="relu")
+        pool = fluid.layers.pool2d(input=conv, pool_size=2,
+                                   pool_stride=2)
+        pred = fluid.layers.fc(input=pool, size=10, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = str(tmp_path / name)
+        fluid.save_inference_model(md, ["x"], [pred], exe,
+                                   main_program=main)
+    return md, (1, 12, 12)
+
+
+def _calib(shape, n=3, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.randn(batch, *shape).astype(np.float32)}
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# kernel parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("M,K,N,bm,bk,bn,act", [
+    (8, 16, 32, 4, 8, 16, np.float32),
+    (16, 64, 128, 8, 32, 64, "bfloat16"),
+    (4, 24, 10, 2, 8, 2, np.float32),     # tiny-lane channel count
+    (1, 32, 16, 1, 16, 8, np.float32),    # batch-1 serving bucket
+])
+def test_dequant_matmul_kernel_parity(M, K, N, bm, bk, bn, act):
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import (dequant_matmul,
+                                               dequant_matmul_reference)
+    rng = np.random.RandomState(M * 31 + N)
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32)).astype(act)
+    wq = jnp.asarray(rng.randint(-127, 128, (K, N)).astype(np.int8))
+    s = jnp.asarray(rng.rand(N).astype(np.float32) * 0.1 + 0.01)
+    out_k = dequant_matmul(x, wq, s, block_m=bm, block_k=bk,
+                           block_n=bn, out_dtype=np.float32)
+    out_r = dequant_matmul_reference(x, wq, s, out_dtype=np.float32)
+    assert out_k.shape == (M, N)
+    assert float(jnp.abs(out_k - out_r).max()) < 1e-3
+
+
+def test_dequant_matmul_non_divisible_falls_back():
+    """Channel counts no candidate block divides must take the XLA
+    reference path and still be exact."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import (dequant_matmul,
+                                               dequant_matmul_reference)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(3, 7).astype(np.float32))
+    wq = jnp.asarray(rng.randint(-127, 128, (7, 13)).astype(np.int8))
+    s = jnp.asarray(np.full(13, 0.02, np.float32))
+    assert np.array_equal(
+        np.asarray(dequant_matmul(x, wq, s)),
+        np.asarray(dequant_matmul_reference(x, wq, s)))
+
+
+def test_dequant_tuning_registry_roundtrip(store):
+    from paddle_tpu.ops import attention_tuning as at
+    assert at.get_dequant_config(16, 64, 128, "float32") is not None
+    at.record_dequant(16, 64, 128, "float32", 8, 32, 64,
+                      extra={"ms": 1.5})
+    assert at.get_dequant_config(16, 64, 128, "float32") == (8, 32, 64)
+    # a tuned record that no longer tiles the shape is ignored
+    at.record_dequant(16, 64, 128, "float32", 7, 32, 64)
+    cfg = at.get_dequant_config(16, 64, 128, "float32")
+    assert cfg is not None and cfg != (7, 32, 64)
+    # the namespace is its own file in the shared registry
+    from paddle_tpu import compile_cache as cc
+    assert os.path.exists(cc.tuning_path(at.DEQUANT_NAMESPACE))
+
+
+# ---------------------------------------------------------------------------
+# the PTQ pass
+# ---------------------------------------------------------------------------
+
+def test_quantize_roundtrip_and_bytes(tmp_path, no_cc):
+    from paddle_tpu.inference import (AnalysisConfig, Predictor,
+                                      quantize_inference_model,
+                                      read_quant_meta)
+    md, shape = _export_fc(tmp_path)
+    s = quantize_inference_model(md, calib_feeds=_calib(shape),
+                                 min_weight_elems=64)
+    assert s["n_quantized"] == 2
+    # acceptance: quantized artifact weight bytes <= 0.5x fp32
+    assert s["bytes"]["ratio"] <= 0.5
+    meta = read_quant_meta(s["dst"])
+    assert meta["schema"] == 1 and meta["precision"] == "int8"
+    assert meta["crc32"]  # payload CRC table non-empty
+    assert meta["calibration"]["batches"] == 3
+    cfg = AnalysisConfig(model_dir=md)
+    cfg.batch_size_buckets = (4, 8)
+    cfgq = AnalysisConfig(model_dir=s["dst"])
+    cfgq.batch_size_buckets = (4, 8)
+    p32, pq = Predictor(cfg), Predictor(cfgq)
+    assert p32.precision == "fp32" and pq.precision == "int8"
+    x = np.random.RandomState(5).randn(4, 16).astype(np.float32)
+    o32, = p32.run({"x": x})
+    oq, = pq.run({"x": x})
+    # pinned accuracy delta: softmax outputs within 0.05, top-1 agrees
+    assert float(np.abs(o32 - oq).max()) < 0.05
+    assert (o32.argmax(1) == oq.argmax(1)).all()
+    # bit-stable per lane: the same request twice is identical
+    oq2, = pq.run({"x": x})
+    assert np.array_equal(oq, oq2)
+
+
+def test_quantize_mnist_cnn_pinned_delta(tmp_path, no_cc):
+    """The conv path (dequant_conv2d): per-model pinned accuracy delta
+    on a conv+fc zoo-shaped model."""
+    from paddle_tpu.inference import (AnalysisConfig, Predictor,
+                                      quantize_inference_model)
+    md, shape = _export_mnist_cnn(tmp_path)
+    s = quantize_inference_model(md, calib_feeds=_calib(shape, batch=4),
+                                 min_weight_elems=64)
+    kinds = {l["op_type"] for l in s["layers"]}
+    assert "conv2d" in kinds and "mul" in kinds
+    assert s["bytes"]["ratio"] <= 0.5
+    cfg = AnalysisConfig(model_dir=md)
+    cfgq = AnalysisConfig(model_dir=s["dst"])
+    x = np.random.RandomState(9).randn(4, 1, 12, 12).astype(np.float32)
+    o32, = Predictor(cfg).run({"x": x})
+    oq, = Predictor(cfgq).run({"x": x})
+    assert float(np.abs(o32 - oq).max()) < 0.1
+    assert (o32.argmax(1) == oq.argmax(1)).mean() >= 0.75
+
+
+def test_quantize_size_floor(tmp_path, no_cc):
+    from paddle_tpu.inference import quantize_inference_model
+    md, shape = _export_fc(tmp_path)
+    # floor between the two layers: 64*10=640 < 1024 <= 16*64
+    s = quantize_inference_model(md, min_weight_elems=1024,
+                                 dst_dir=str(tmp_path / "q_floor"))
+    assert s["n_quantized"] == 1
+    # floor above everything: nothing to quantize is an explicit error
+    with pytest.raises(ValueError, match="floor"):
+        quantize_inference_model(md, min_weight_elems=10 ** 9,
+                                 dst_dir=str(tmp_path / "q_none"))
+
+
+def test_tampered_payload_rejected_at_load(tmp_path, no_cc):
+    from paddle_tpu.inference import (AnalysisConfig, Predictor,
+                                      QuantizedArtifactError,
+                                      quantize_inference_model,
+                                      read_quant_meta,
+                                      verify_quantized_dir)
+    md, shape = _export_fc(tmp_path)
+    s = quantize_inference_model(md, min_weight_elems=64)
+    meta = read_quant_meta(s["dst"])
+    victim = sorted(meta["crc32"])[0]
+    path = os.path.join(s["dst"], victim)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0x10
+    with open(path, "wb") as f:
+        f.write(raw)
+    bad = [(f_, e) for f_, e in verify_quantized_dir(s["dst"]) if e]
+    assert bad and bad[0][0] == victim
+    with pytest.raises(QuantizedArtifactError, match=victim):
+        Predictor(AnalysisConfig(model_dir=s["dst"]))
+
+
+def test_verifier_clean_on_quantized_artifact(tmp_path, no_cc):
+    """The PR 9 verifier runs the dequant lowerings abstractly: no
+    unregistered-op, no shape findings on a quantized artifact — and
+    lint_artifact CRCs the payloads on top."""
+    from paddle_tpu.inference import quantize_inference_model
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from tools.lint_program import lint_artifact
+    md, shape = _export_fc(tmp_path)
+    s = quantize_inference_model(md, min_weight_elems=64)
+    diags = lint_artifact(s["dst"], verbose=False)
+    assert not [d for d in diags if d.is_error], diags
+
+
+# ---------------------------------------------------------------------------
+# serving precision axis
+# ---------------------------------------------------------------------------
+
+def test_registry_ab_routing_and_metrics(tmp_path, no_cc):
+    from paddle_tpu.inference import quantize_inference_model
+    from paddle_tpu.serving.metrics import ServingMetrics
+    from paddle_tpu.serving.model_registry import ModelRegistry
+    md, shape = _export_fc(tmp_path)
+    s = quantize_inference_model(md, min_weight_elems=64)
+    metrics = ServingMetrics()
+    reg = ModelRegistry(metrics=metrics)
+    try:
+        e32 = reg.load_model("fc", md, buckets=(4,))
+        eq = reg.load_model("fc", s["dst"], buckets=(4,))
+        assert e32.precision == "fp32" and eq.precision == "int8"
+        x = np.random.RandomState(2).randn(4, 16).astype(np.float32)
+        r32 = reg.infer("fc", {"x": x}, precision="fp32", timeout=60)
+        rq = reg.infer("fc", {"x": x}, precision="int8", timeout=60)
+        rdef = reg.infer("fc", {"x": x}, timeout=60)
+        # explicit lanes are bit-stable; default stays on fp32
+        assert np.array_equal(
+            r32[0], reg.infer("fc", {"x": x}, precision="fp32",
+                              timeout=60)[0])
+        assert np.array_equal(
+            rq[0], reg.infer("fc", {"x": x}, precision="int8",
+                             timeout=60)[0])
+        assert np.array_equal(rdef[0], r32[0])
+        assert not np.array_equal(rq[0], r32[0])
+        # missing lane is a named error
+        with pytest.raises(KeyError, match="precision lane"):
+            reg.infer("fc", {"x": x}, precision="bf16", timeout=60)
+        # weighted default split: 50/50 over 8 requests = 4/4
+        reg.set_ab_weights("fc", {"fp32": 0.5, "int8": 0.5})
+        before32 = metrics.model("fc").requests.value
+        before8 = metrics.model("fc", "int8").requests.value
+        for _ in range(8):
+            reg.infer("fc", {"x": x}, timeout=60)
+        assert metrics.model("fc").requests.value - before32 == 4
+        assert metrics.model("fc", "int8").requests.value - before8 == 4
+        snap = metrics.snapshot()["models"]
+        assert snap["fc"]["precision"] == "fp32"
+        assert snap["fc@int8"]["precision"] == "int8"
+        assert snap["fc@int8"]["model"] == "fc"
+        desc = reg.describe()["fc"]
+        assert desc["precisions"] == {"fp32": e32.version,
+                                      "int8": eq.version}
+        assert desc["ab_weights"] == {"fp32": 0.5, "int8": 0.5}
+        # unload drops BOTH metric lanes
+        reg.unload_model("fc")
+        assert "fc@int8" not in metrics.snapshot()["models"]
+    finally:
+        reg.close_all(timeout=10)
+
+
+def test_ab_canary_weight_leaves_remainder_on_fp32(tmp_path, no_cc):
+    """load_model(ab_weight=0.25) on the int8 lane alone must canary
+    int8 at 25% with fp32 keeping the unassigned 75% — NOT shift all
+    default traffic to the only weighted lane (the bug the end-to-end
+    drive caught)."""
+    from paddle_tpu.inference import quantize_inference_model
+    from paddle_tpu.serving.metrics import ServingMetrics
+    from paddle_tpu.serving.model_registry import ModelRegistry
+    md, shape = _export_fc(tmp_path)
+    s = quantize_inference_model(md, min_weight_elems=64)
+    metrics = ServingMetrics()
+    reg = ModelRegistry(metrics=metrics)
+    try:
+        reg.load_model("fc", md, buckets=(4,))
+        reg.load_model("fc", s["dst"], buckets=(4,), ab_weight=0.25)
+        x = np.random.RandomState(8).randn(4, 16).astype(np.float32)
+        for _ in range(8):
+            reg.infer("fc", {"x": x}, timeout=60)
+        assert metrics.model("fc", "int8").requests.value == 2
+        assert metrics.model("fc").requests.value == 6
+    finally:
+        reg.close_all(timeout=10)
+
+
+def test_hot_swap_is_per_lane(tmp_path, no_cc):
+    """Reloading the int8 lane must not drain/retire the fp32 lane —
+    the A/B sibling is not a hot-swap target."""
+    from paddle_tpu.inference import quantize_inference_model
+    from paddle_tpu.serving.model_registry import ModelRegistry
+    md, shape = _export_fc(tmp_path)
+    s = quantize_inference_model(md, min_weight_elems=64)
+    reg = ModelRegistry()
+    try:
+        e32 = reg.load_model("fc", md, buckets=(4,))
+        eq1 = reg.load_model("fc", s["dst"], buckets=(4,))
+        eq2 = reg.load_model("fc", s["dst"], buckets=(4,))  # lane swap
+        desc = reg.describe()["fc"]
+        # fp32 version survives; int8 lane flipped to the new version
+        assert desc["precisions"]["fp32"] == e32.version
+        assert desc["precisions"]["int8"] == eq2.version
+        assert eq1.version not in desc["versions"]
+        x = np.random.RandomState(4).randn(2, 16).astype(np.float32)
+        assert reg.infer("fc", {"x": x}, precision="fp32",
+                         timeout=60) is not None
+    finally:
+        reg.close_all(timeout=10)
+
+
+def test_wire_precision_and_serving_top(tmp_path, no_cc):
+    from paddle_tpu.inference import quantize_inference_model
+    from paddle_tpu.serving import InferenceServer, ServingClient
+    md, shape = _export_fc(tmp_path)
+    s = quantize_inference_model(md, min_weight_elems=64)
+    srv = InferenceServer(buckets=(4,)).start()
+    cli = ServingClient(srv.endpoint)
+    try:
+        l32 = cli.load_model("fc", md, buckets=[4])
+        lq = cli.load_model("fc", s["dst"], buckets=[4], ab_weight=0.5)
+        assert l32["precision"] == "fp32" and lq["precision"] == "int8"
+        x = np.random.RandomState(6).randn(2, 16).astype(np.float32)
+        a = cli.infer("fc", {"x": x}, precision="int8",
+                      deadline_ms=60000)
+        b = cli.infer("fc", {"x": x}, precision="int8",
+                      deadline_ms=60000)
+        assert np.array_equal(a[0], b[0])
+        st = cli.stats()
+        models = st["stats"]["models"]
+        assert "fc@int8" in models and models["fc@int8"]["requests"] >= 2
+        # per-precision rows render in serving_top and Prometheus
+        from tools.serving_top import render
+        text = render(st)
+        assert "int8" in text and "PREC" in text
+        prom = cli.metrics_text()
+        assert 'precision="int8"' in prom
+        assert 'model="fc"' in prom
+    finally:
+        cli.shutdown_server()
+        cli.close()
+
+
+# ---------------------------------------------------------------------------
+# compile-cache fingerprint isolation + warm reload
+# ---------------------------------------------------------------------------
+
+def test_precision_in_fingerprint_and_warm_reload(tmp_path, store):
+    from paddle_tpu import compile_cache as cc
+    from paddle_tpu.inference import (AnalysisConfig, Predictor,
+                                      quantize_inference_model)
+    md, shape = _export_fc(tmp_path)
+    s = quantize_inference_model(md, min_weight_elems=64)
+
+    def load(path):
+        cfg = AnalysisConfig(model_dir=path)
+        cfg.batch_size_buckets = (4,)
+        p = Predictor(cfg)
+        p.run({"x": np.zeros((4, 16), np.float32)})
+        return p
+
+    p32 = load(md)
+    fp = p32._aot_fingerprint({"x": np.zeros((4, 16), np.float32)})
+    assert fp["precision"] == "fp32"
+    cold32 = cc.stats()
+    assert cold32["misses"] >= 1
+    # the int8 build must MISS (no cross-lane executable collision)
+    pq = load(s["dst"])
+    fpq = pq._aot_fingerprint({"x": np.zeros((4, 16), np.float32)})
+    assert fpq["precision"] == "int8"
+    delta = cc.stats_delta(cold32)
+    assert delta["misses"] >= 1 and delta["hits"] == 0
+    # warm reload of the quantized artifact: hits:N, misses:0
+    warm_before = cc.stats()
+    load(s["dst"])
+    warm = cc.stats_delta(warm_before)
+    assert warm["hits"] >= 1 and warm["misses"] == 0, warm
+
+
+# ---------------------------------------------------------------------------
+# CLI + chaos surfaces
+# ---------------------------------------------------------------------------
+
+def _run_tool(argv, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_CHAOS", None)
+    return subprocess.run([sys.executable] + argv, capture_output=True,
+                          text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def test_quantize_model_cli(tmp_path, no_cc):
+    md, shape = _export_fc(tmp_path)
+    out = str(tmp_path / "cli_int8")
+    proc = _run_tool(["tools/quantize_model.py", md, "--out", out,
+                      "--calib_random", "2", "--min_elems", "64"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["dst"] == out and os.path.isdir(out)
+    assert summary["bytes"]["ratio"] <= 0.5
+    # not-an-artifact dir is a usage error, not a traceback
+    proc = _run_tool(["tools/quantize_model.py", str(tmp_path)])
+    assert proc.returncode == 1
+
+
+def test_verify_quantized_cli_exit_codes(tmp_path, no_cc):
+    from paddle_tpu.inference import (quantize_inference_model,
+                                      read_quant_meta)
+    md, shape = _export_fc(tmp_path)
+    s = quantize_inference_model(md, min_weight_elems=64)
+    proc = _run_tool(["tools/verify_quantized.py", s["dst"]])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # not a quantized dir -> 1
+    proc = _run_tool(["tools/verify_quantized.py", md])
+    assert proc.returncode == 1
+    # corrupt one scale table -> 2, naming the file
+    victim = sorted(read_quant_meta(s["dst"])["crc32"])[-1]
+    path = os.path.join(s["dst"], victim)
+    raw = bytearray(open(path, "rb").read())
+    raw[-1] ^= 0x01
+    with open(path, "wb") as f:
+        f.write(raw)
+    proc = _run_tool(["tools/verify_quantized.py", s["dst"]])
+    assert proc.returncode == 2
+    assert victim in proc.stderr
+
+
+def test_lint_program_cli_on_quantized_dir(tmp_path, no_cc):
+    from paddle_tpu.inference import (quantize_inference_model,
+                                      read_quant_meta)
+    md, shape = _export_fc(tmp_path)
+    s = quantize_inference_model(md, min_weight_elems=64)
+    proc = _run_tool(["tools/lint_program.py", s["dst"]])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "quantized artifact" in proc.stdout
+    victim = sorted(read_quant_meta(s["dst"])["crc32"])[0]
+    path = os.path.join(s["dst"], victim)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0x40
+    with open(path, "wb") as f:
+        f.write(raw)
+    proc = _run_tool(["tools/lint_program.py", s["dst"]])
+    assert proc.returncode == 2
+    assert "quant-payload" in proc.stdout
+
+
+def test_chaos_quantize_commit_scenario():
+    proc = _run_tool(["tools/chaos.py", "--scenario", "quantize-commit",
+                      "--no-real-kill"], timeout=400)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert "PASS quantize-commit" in proc.stdout
+
+
+@pytest.mark.slow
+def test_bench_serving_precision_smoke():
+    proc = _run_tool(["tools/bench_serving.py", "--precision", "both",
+                      "--smoke", "--qps", "30", "--duration", "1.5"],
+                     timeout=500)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    recs = [json.loads(l) for l in proc.stdout.splitlines()
+            if l.startswith("{")]
+    precs = {r["precision"] for r in recs}
+    assert precs == {"fp32", "int8"}
+    for r in recs:
+        assert r["bit_stable"] is True
+        assert r["quant_bytes"]["ratio"] <= 0.5
+        if r["precision"] == "int8":
+            assert r["accuracy_delta"]["top1_agreement"] >= 0.9

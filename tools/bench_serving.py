@@ -119,8 +119,11 @@ def build_model(kind, model_dir, seed=17):
 
 
 def run_point(endpoint, model, feed_name, sample_shape, dtype,
-              target_qps, duration, req_batch, deadline_ms, seed=0):
-    """One open-loop measurement point at `target_qps` for `duration`s."""
+              target_qps, duration, req_batch, deadline_ms, seed=0,
+              precision=None):
+    """One open-loop measurement point at `target_qps` for `duration`s.
+    `precision` pins every request to one numerics lane (the fp32-vs-
+    int8 A/B drives identical seeded workloads through each)."""
     from paddle_tpu.serving import DeadlineExceeded, ServerOverloaded
     rng = random.Random(seed)
     data = np.asarray(
@@ -137,7 +140,7 @@ def run_point(endpoint, model, feed_name, sample_shape, dtype,
         # server, not the harness
         try:
             cli.infer(model, {feed_name: data}, deadline_ms=deadline_ms,
-                      retry_sheds=False)
+                      retry_sheds=False, precision=precision)
             key = "ok"
         except ServerOverloaded:
             key = "shed"
@@ -489,6 +492,148 @@ def _verify_bit_exact(endpoint, model, model_dir, buckets, feed_name,
         cli.close()
 
 
+# ---------------------------------------------------------------------------
+# quantized A/B lanes (QUANTIZE.md): one server, both numerics lanes of
+# ONE model name (fp32 + the PTQ int8 sibling), identical seeded
+# open-loop workloads routed per-request by the `precision` field.  The
+# roofline argument says int8 weight bytes are the speedup on a memory-
+# bound chip; on CPU smoke the lanes mostly prove the axis end to end
+# (routing, per-precision metrics, bit-stability, pinned accuracy
+# delta) — the tpu_watch "quant" stage re-measures throughput on
+# silicon.
+# ---------------------------------------------------------------------------
+
+
+def _verify_precision_lanes(endpoint, model, model_dir, buckets,
+                            feed_name, shape, dtype, lanes, n=3,
+                            seed=321):
+    """Per-lane bit-stability + the pinned accuracy delta: each lane
+    must answer the SAME request bit-identically every time (replay
+    twice), and the int8 lane's outputs must sit within a small delta
+    of the served fp32 lane / the direct fp32 Predictor."""
+    from paddle_tpu.inference import AnalysisConfig, Predictor
+    from paddle_tpu.serving import ServingClient
+    cfg = AnalysisConfig(model_dir=model_dir)
+    cfg.batch_size_buckets = tuple(buckets)
+    direct = Predictor(cfg)
+    rng = np.random.RandomState(seed)
+    cli = ServingClient(endpoint)
+    out = {"bit_stable": {lane: True for lane in lanes},
+           "max_abs_delta": 0.0, "top1_agreement": None}
+    agree, total = 0, 0
+    try:
+        for i in range(n):
+            x = rng.randn(1 + i % buckets[0], *shape).astype(dtype)
+            ref = direct.run({feed_name: x})
+            per_lane = {}
+            for lane in lanes:
+                a = cli.infer(model, {feed_name: x}, precision=lane,
+                              deadline_ms=60000.0)
+                b = cli.infer(model, {feed_name: x}, precision=lane,
+                              deadline_ms=60000.0)
+                if any(not np.array_equal(u, v) for u, v in zip(a, b)):
+                    out["bit_stable"][lane] = False
+                per_lane[lane] = a
+            if "fp32" in per_lane and any(
+                    not np.array_equal(u, v)
+                    for u, v in zip(per_lane["fp32"], ref)):
+                out["bit_stable"]["fp32"] = False
+            if "int8" in per_lane:
+                for u, v in zip(per_lane["int8"], ref):
+                    u = np.asarray(u, np.float32)
+                    v = np.asarray(v, np.float32)
+                    out["max_abs_delta"] = max(
+                        out["max_abs_delta"],
+                        float(np.abs(u - v).max()) if u.size else 0.0)
+                    if u.ndim == 2 and u.shape[1] > 1:
+                        agree += int((u.argmax(1) == v.argmax(1)).sum())
+                        total += u.shape[0]
+        if total:
+            out["top1_agreement"] = round(agree / total, 4)
+        out["max_abs_delta"] = round(out["max_abs_delta"], 6)
+        return out
+    finally:
+        cli.close()
+
+
+def run_precision_lanes(args, backend_label, kind, qps_points, duration,
+                        buckets):
+    """The --precision entry point: export the fp32 artifact, PTQ it
+    into the int8 sibling, load both lanes behind ONE model name, and
+    drive identical seeded open-loop sweeps through each requested
+    lane.  One JSON record per (precision, qps) point."""
+    from paddle_tpu.inference import (quantize_inference_model,
+                                      read_quant_meta)
+    from paddle_tpu.serving import InferenceServer, ServingClient
+    lanes = {"fp32": ["fp32"], "int8": ["int8"],
+             "both": ["fp32", "int8"]}[args.precision]
+    workdir = tempfile.mkdtemp(prefix="bench_serving_quant_")
+    model_dir, feed_name, shape, dtype = build_model(
+        kind, os.path.join(workdir, kind))
+    rng = np.random.RandomState(17)
+    calib = [{feed_name: rng.randn(buckets[0], *shape).astype(dtype)}
+             for _ in range(4)]
+    summary = quantize_inference_model(model_dir, calib_feeds=calib,
+                                       min_weight_elems=64)
+    qmeta = read_quant_meta(summary["dst"])
+
+    server = InferenceServer(max_queue=args.max_queue,
+                             deadline_ms=args.deadline_batch_ms,
+                             buckets=buckets).start()
+    boot = ServingClient(server.endpoint)
+    try:
+        loaded = {}
+        t0 = time.monotonic()
+        loaded["fp32"] = boot.load_model(kind, model_dir,
+                                         buckets=buckets)
+        t1 = time.monotonic()
+        loaded["int8"] = boot.load_model(kind, summary["dst"],
+                                         buckets=buckets)
+        load_ms = {"fp32": round((t1 - t0) * 1e3, 1),
+                   "int8": round((time.monotonic() - t1) * 1e3, 1)}
+        checks = _verify_precision_lanes(
+            server.endpoint, kind, model_dir, buckets, feed_name,
+            shape, dtype, lanes)
+        for lane in lanes:
+            for q in qps_points:
+                rec = run_point(server.endpoint, kind, feed_name,
+                                shape, dtype, target_qps=q,
+                                duration=duration,
+                                req_batch=args.req_batch,
+                                deadline_ms=args.deadline_ms,
+                                precision=lane)
+                stats = boot.stats()["stats"]["models"]
+                lane_key = kind if lane == "fp32" \
+                    else "%s@%s" % (kind, lane)
+                lane_stats = stats.get(lane_key, {})
+                rec.update({
+                    "model": kind,
+                    "precision": lane,
+                    "buckets": buckets,
+                    "bit_stable": checks["bit_stable"].get(lane),
+                    "accuracy_delta": {
+                        "max_abs": checks["max_abs_delta"],
+                        "top1_agreement": checks["top1_agreement"],
+                        "calibration": dict(
+                            qmeta.get("calibration", {})),
+                    } if lane == "int8" else None,
+                    "quant_bytes": dict(qmeta.get("bytes", {})),
+                    "load_ms": load_ms.get(lane),
+                    "compile_cache": dict(
+                        loaded[lane].get("compile_cache", {})),
+                    "lane_requests": lane_stats.get("requests"),
+                    "lane_qps_recent": lane_stats.get("qps_recent"),
+                    "lane_latency_p95":
+                        (lane_stats.get("latency_ms") or {}).get("p95"),
+                })
+                if backend_label:
+                    rec["backend"] = backend_label
+                print(json.dumps(rec), flush=True)
+    finally:
+        boot.close()
+        server.shutdown(drain=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="fc",
@@ -508,6 +653,16 @@ def main():
                     help="per-request deadline (default 2000; decode "
                          "lanes 60000 — the deadline now covers the "
                          "whole stream's decode time)")
+    ap.add_argument("--precision", choices=["fp32", "int8", "both"],
+                    default=None,
+                    help="quantized A/B lane (QUANTIZE.md): PTQ the "
+                         "exported model into an int8 sibling, load "
+                         "BOTH numerics lanes behind one model name, "
+                         "and drive identical seeded sweeps through "
+                         "the requested lane(s) via the per-request "
+                         "precision field; records carry per-lane "
+                         "bit-stability, the pinned accuracy delta, "
+                         "and the weight-bytes ratio")
     ap.add_argument("--decode", action="store_true",
                     help="streaming-generation lane: serve a tiny "
                          "decode artifact and drive open-loop Poisson "
@@ -633,6 +788,10 @@ def main():
 
     buckets = sorted({max(max_bucket // 4, 1), max(max_bucket // 2, 1),
                       max_bucket})
+    if args.precision:
+        run_precision_lanes(args, backend_label, kind, qps_points,
+                            duration, buckets)
+        return
     workdir = tempfile.mkdtemp(prefix="bench_serving_")
     model_dir, feed_name, shape, dtype = build_model(
         kind, os.path.join(workdir, kind))

@@ -97,6 +97,20 @@ CONFIGS = [
       "--force_host_devices", "4", "--dispatch_cost_ms", "20",
       "--qps", "250", "--duration", "8", "--deadline_ms", "4000",
       "--max_queue", "32"], 1, 1),
+    # quantized-serving A/B lanes (QUANTIZE.md): the SAME model name
+    # served fp32 and PTQ-int8 behind the registry's precision axis,
+    # identical seeded open-loop load routed per-request. On the
+    # HBM-roofline-bound chip the int8 lane's weight bytes are the
+    # speedup; the CPU smoke rows prove the axis end to end (per-lane
+    # bit-stability, pinned accuracy delta, weight-bytes ratio <= 0.5x,
+    # per-precision metrics) and the tpu_watch "quant" stage re-measures
+    # throughput on silicon.
+    ("serving_quant_fp32",
+     ["@serving", "--model", "fc", "--precision", "fp32",
+      "--qps", "150", "--duration", "8"], 8, 4),
+    ("serving_quant_int8",
+     ["@serving", "--model", "fc", "--precision", "int8",
+      "--qps", "150", "--duration", "8"], 8, 4),
     # continuous-batching decode lanes (SERVING.md "Continuous batching
     # & streaming"): identical seeded mixed-output-length streaming
     # workloads against the slot-table decode path, static whole-batch

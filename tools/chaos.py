@@ -32,6 +32,13 @@ exits nonzero on failure):
                entry + only a stale _tmp dir, and that the next boot
                serves correctly, recompiles ONLY the interrupted entry
                (hit=1 miss=1), and sweeps the stale tmp.
+  quantize-commit
+               SIGKILL a child mid-PTQ-write of a quantized artifact
+               (QUANTIZE.md): commit #1 lands cleanly, commit #2 is
+               interrupted at a named point.  Prove the fp32 source AND
+               the prior quantized artifact survive intact (every
+               payload CRC verifies, probe replies bit-identical) and a
+               recovery run re-commits and sweeps the stale tmp.
   decode-disconnect
                streaming-generation chaos (SERVING.md continuous
                batching): a client disconnect mid-stream and a deadline
@@ -71,6 +78,8 @@ CHAOS_POINTS = ("array_written", "arrays_written", "manifest_written",
                 "committed", "latest_updated")
 # compile-cache store commit points (paddle_tpu/compile_cache.py)
 CACHE_POINTS = ("cc_exec_written", "cc_committed")
+# PTQ artifact commit points (paddle_tpu/inference/quantize.py)
+QUANT_POINTS = ("quant_arrays_written", "quant_committed")
 
 
 # ---------------------------------------------------------------------------
@@ -447,6 +456,147 @@ def scenario_cache_commit(workdir, point="cc_exec_written",
               "recovery hits=%d misses=%d, tmp swept, store verifies"
               % (point, real_kill, st["hits"], st["misses"]))
     return st
+
+
+# ---------------------------------------------------------------------------
+# PTQ commit chaos (QUANTIZE.md)
+# ---------------------------------------------------------------------------
+
+_QUANT_PROBE = None  # lazy: the fixed reply probe batch
+
+
+def _quant_probe_batch():
+    import numpy as np
+    return np.arange(32, dtype=np.float32).reshape(4, 8) / 32.0
+
+
+def _child_quant(workdir):
+    """Subprocess target (--child-quant): build (or reuse) a tiny fp32
+    fc artifact, quantize it TWICE into the same sibling dir (commit #1
+    clean, commit #2 is where the parent injects the fault), then serve
+    one probe batch from the quantized artifact and print its sum."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.flags import set_flags
+    set_flags({"compile_cache": False})
+    src = os.path.join(workdir, "fc")
+    if not os.path.exists(os.path.join(src, "__model__")):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            h = fluid.layers.fc(input=x, size=32, act="relu")
+            pred = fluid.layers.fc(input=h, size=10, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            fluid.save_inference_model(src, ["x"], [pred], exe,
+                                       main_program=main)
+    from paddle_tpu.inference import (AnalysisConfig, Predictor,
+                                      quantize_inference_model)
+    s = None
+    for i in range(2):
+        s = quantize_inference_model(src, min_weight_elems=64)
+        print("QUANTIZED %d ratio=%.4f" % (i + 1, s["bytes"]["ratio"]),
+              flush=True)
+    cfg = AnalysisConfig(model_dir=s["dst"])
+    cfg.batch_size_buckets = (4,)
+    out, = Predictor(cfg).run({"x": _quant_probe_batch()})
+    print("REPLY sum=%.6f" % float(np.asarray(out, np.float64).sum()),
+          flush=True)
+    print("DONE", flush=True)
+
+
+def _spawn_quant_child(workdir, chaos_spec=None):
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_CHAOS", None)
+    if chaos_spec:
+        env["PADDLE_TPU_CHAOS"] = chaos_spec
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child-quant",
+         workdir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+
+
+def scenario_quantize_commit(workdir, point="quant_arrays_written",
+                             real_kill=True, verbose=True):
+    """SIGKILL a child mid-PTQ-write at `point` during quantized-commit
+    #2, then prove: (1) the fp32 source artifact still loads and
+    serves, (2) the PRIOR quantized artifact is intact (every payload
+    CRC verifies, the probe reply is bit-identical to commit #1's), and
+    (3) a recovery run re-quantizes cleanly, sweeps the stale tmp, and
+    serves the same reply."""
+    import glob as _glob
+    import numpy as np
+    os.makedirs(workdir, exist_ok=True)
+    action = "pause:120" if real_kill else "exit"
+    spec = "%s=%s@2" % (point, action)
+    proc = _spawn_quant_child(workdir, chaos_spec=spec)
+    committed = 0
+    try:
+        if real_kill:
+            for line in proc.stdout:
+                line = line.strip()
+                if line.startswith("QUANTIZED"):
+                    committed = int(line.split()[1])
+                if line.startswith("CHAOS_PAUSE"):
+                    os.kill(proc.pid, signal.SIGKILL)
+                    break
+            proc.wait(timeout=30)
+        else:
+            out, _ = proc.communicate(timeout=240)
+            for line in out.splitlines():
+                if line.startswith("QUANTIZED"):
+                    committed = int(line.split()[1])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert proc.returncode != 0, \
+        "child survived the kill (rc=0) — no fault injected"
+    assert committed == 1, \
+        "expected the crash during quantized commit #2 (after 1 clean " \
+        "commit), child reported %d" % committed
+    src = os.path.join(workdir, "fc")
+    dst = src + "_int8"
+    # (1) the fp32 source never moved — it still loads and serves
+    from paddle_tpu.inference import AnalysisConfig, Predictor
+    from paddle_tpu.inference import quantize as q
+    cfg = AnalysisConfig(model_dir=src)
+    cfg.batch_size_buckets = (4,)
+    Predictor(cfg).run({"x": _quant_probe_batch()})
+    # (2) the prior quantized artifact is intact, whatever the point
+    bad = [(f, e) for f, e in q.verify_quantized_dir(dst) if e]
+    assert not bad, "kill corrupted the quantized artifact: %s" % bad
+    committed_ok = point == "quant_committed"
+    tmps = _glob.glob(dst + ".tmp.*")
+    assert committed_ok or tmps, \
+        "no stale tmp dir left by the interrupted commit"
+    cfgq = AnalysisConfig(model_dir=dst)
+    cfgq.batch_size_buckets = (4,)
+    out, = Predictor(cfgq).run({"x": _quant_probe_batch()})
+    prior_sum = "%.6f" % float(np.asarray(out, np.float64).sum())
+    # (3) recovery: re-quantize cleanly, sweep the tmp, same reply
+    proc2 = _spawn_quant_child(workdir)
+    out2, _ = proc2.communicate(timeout=240)
+    assert proc2.returncode == 0, out2[-2000:]
+    assert "DONE" in out2, out2[-2000:]
+    reply = [ln for ln in out2.splitlines() if ln.startswith("REPLY ")]
+    assert reply and reply[0].split("sum=")[1] == prior_sum, \
+        "recovery reply differs from the intact artifact: %s vs %s" \
+        % (reply, prior_sum)
+    assert not _glob.glob(dst + ".tmp.*"), \
+        "stale tmp dirs not swept on recovery"
+    bad = [(f, e) for f, e in q.verify_quantized_dir(dst) if e]
+    assert not bad, "recovered artifact fails verification: %s" % bad
+    if verbose:
+        print("PASS quantize-commit point=%s kill=%s: fp32 + prior "
+              "quantized artifact intact, recovery reply bit-identical, "
+              "tmp swept" % (point, real_kill))
+    return {"committed": committed, "reply_sum": prior_sum}
 
 
 # ---------------------------------------------------------------------------
@@ -988,13 +1138,14 @@ def main(argv=None):
                                            "nan-poison", "drop-rpc",
                                            "serving-overload",
                                            "cache-commit",
+                                           "quantize-commit",
                                            "trace-overflow",
                                            "decode-disconnect", "all"])
     ap.add_argument("--smoke", action="store_true",
                     help="fast deterministic subset for CI")
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--point", default="manifest_written",
-                    choices=CHAOS_POINTS + CACHE_POINTS)
+                    choices=CHAOS_POINTS + CACHE_POINTS + QUANT_POINTS)
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--no-real-kill", action="store_true",
                     help="child os._exit(137)s at the point instead of "
@@ -1002,6 +1153,8 @@ def main(argv=None):
     ap.add_argument("--child-train", metavar="DIR",
                     help=argparse.SUPPRESS)  # internal subprocess target
     ap.add_argument("--child-cache", metavar="DIR",
+                    help=argparse.SUPPRESS)  # internal subprocess target
+    ap.add_argument("--child-quant", metavar="DIR",
                     help=argparse.SUPPRESS)  # internal subprocess target
     ap.add_argument("--chaos-spec", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--chaos-at-save", type=int, default=0,
@@ -1015,6 +1168,9 @@ def main(argv=None):
     if args.child_cache:
         _child_cache(args.child_cache)
         return 0
+    if args.child_quant:
+        _child_quant(args.child_quant)
+        return 0
 
     import tempfile
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_")
@@ -1023,7 +1179,8 @@ def main(argv=None):
     if args.scenario in (None, "all"):
         scenarios = ["crash-save", "bit-flip", "nan-poison", "drop-rpc",
                      "serving-overload", "cache-commit",
-                     "trace-overflow", "decode-disconnect"]
+                     "quantize-commit", "trace-overflow",
+                     "decode-disconnect"]
     else:
         scenarios = [args.scenario]
     rc = 0
@@ -1040,6 +1197,12 @@ def main(argv=None):
                     else "cc_exec_written"
                 scenario_cache_commit(
                     os.path.join(workdir, "cache"), point=point,
+                    real_kill=not args.no_real_kill)
+            elif s == "quantize-commit":
+                point = args.point if args.point in QUANT_POINTS \
+                    else "quant_arrays_written"
+                scenario_quantize_commit(
+                    os.path.join(workdir, "quant"), point=point,
                     real_kill=not args.no_real_kill)
             elif s == "bit-flip":
                 scenario_bit_flip(workdir)

@@ -56,8 +56,11 @@ def _name(x):
 
 def lint_artifact(path, verbose=True):
     """Verify one artifact dir; returns the diagnostics (or None when
-    the dir carries no Program IR)."""
-    from paddle_tpu.analysis import verify_program
+    the dir carries no Program IR).  A quantized artifact dir
+    (quant_meta.bin — QUANTIZE.md) additionally CRC-verifies its int8
+    payloads and scale tables: a corrupt payload is an error finding,
+    the same rejection the load boundary enforces."""
+    from paddle_tpu.analysis import Diagnostic, verify_program
     from paddle_tpu.fluid.framework import Program
     for aot in ("aot_meta.bin", "decode_meta.bin"):
         if os.path.exists(os.path.join(path, aot)):
@@ -72,9 +75,23 @@ def lint_artifact(path, verbose=True):
     with open(model_file) as f:
         meta = json.load(f)
     program = Program.parse_from_string(meta["program"])
-    return verify_program(program, feeds=meta["feed_names"],
-                          fetches=meta["fetch_names"],
-                          emit_events=False, what=path)
+    diags = verify_program(program, feeds=meta["feed_names"],
+                           fetches=meta["fetch_names"],
+                           emit_events=False, what=path)
+    from paddle_tpu.inference import quantize as q
+    if q.is_quantized_dir(path):
+        n_q = sum(1 for op in program.global_block().ops
+                  if op.type.startswith("dequant_"))
+        if verbose:
+            print("%s: quantized artifact (int8), %d dequant op(s)"
+                  % (path, n_q))
+        for fname, err in q.verify_quantized_dir(path):
+            if err is not None:
+                diags.append(Diagnostic(
+                    "quant-payload", "error",
+                    "quantized payload %s: %s" % (fname, err),
+                    var=fname))
+    return diags
 
 
 def lint_zoo_model(name):

@@ -1,0 +1,103 @@
+"""Post-training quantization CLI (QUANTIZE.md).
+
+    python tools/quantize_model.py SRC_DIR [--out DST_DIR]
+        [--calib feeds.npz ...] [--calib_random N] [--min_elems E]
+
+Quantizes a ``save_inference_model`` artifact into a sibling int8
+artifact (per-channel int8 weights + fp32 scale tables, bf16
+activations — inference/quantize.py) and prints ONE summary JSON line:
+layer table, fp32-vs-int8 weight bytes, and the pinned accuracy delta
+on the calibration batches.
+
+Calibration feeds: each ``--calib`` file is an .npz whose arrays are
+keyed by feed name (one batch per file); ``--calib_random N`` generates
+N deterministic random batches from the artifact's feed specs instead —
+the smoke path, also what the bench lanes use.  At most
+``FLAGS.quantize_calib_batches`` batches are consumed.
+
+Exit codes: 0 committed, 1 usage / nothing to quantize.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def random_calib_feeds(model_dir, n, seed=1234, batch=8):
+    """Deterministic random batches shaped from the artifact's feed
+    specs (-1 dims -> `batch`); float feeds draw N(0,1), int feeds
+    draw small non-negative ids."""
+    with open(os.path.join(model_dir, "__model__")) as f:
+        meta = json.load(f)
+    from paddle_tpu.fluid.framework import Program
+    program = Program.parse_from_string(meta["program"])
+    gb = program.global_block()
+    rng = np.random.RandomState(seed)
+    feeds = []
+    for _ in range(int(n)):
+        feed = {}
+        for name in meta["feed_names"]:
+            v = gb._find_var_recursive(name)
+            shape = tuple(batch if d is None or int(d) < 0 else int(d)
+                          for d in (v.shape or (batch,)))
+            dt = v.np_dtype
+            if np.issubdtype(dt, np.floating):
+                feed[name] = rng.randn(*shape).astype(dt)
+            else:
+                feed[name] = rng.randint(0, 8, shape).astype(dt)
+        feeds.append(feed)
+    return feeds
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="post-training int8 quantization over a saved "
+                    "inference artifact")
+    ap.add_argument("src", help="save_inference_model artifact dir")
+    ap.add_argument("--out", default=None,
+                    help="quantized artifact dir (default <src>_int8)")
+    ap.add_argument("--calib", nargs="*", default=None,
+                    help=".npz calibration batches (arrays keyed by "
+                         "feed name, one batch per file)")
+    ap.add_argument("--calib_random", type=int, default=0,
+                    help="generate N deterministic random calibration "
+                         "batches from the feed specs instead")
+    ap.add_argument("--min_elems", type=int, default=None,
+                    help="size floor override "
+                         "(FLAGS.quantize_min_weight_elems)")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(os.path.join(args.src, "__model__")):
+        print("quantize_model: %s has no __model__ (not a "
+              "save_inference_model dir)" % args.src, file=sys.stderr)
+        return 1
+
+    calib = None
+    if args.calib:
+        calib = []
+        for path in args.calib:
+            with np.load(path) as z:
+                calib.append({k: z[k] for k in z.files})
+    elif args.calib_random:
+        calib = random_calib_feeds(args.src, args.calib_random)
+
+    from paddle_tpu.inference import quantize_inference_model
+    try:
+        summary = quantize_inference_model(
+            args.src, dst_dir=args.out, calib_feeds=calib,
+            min_weight_elems=args.min_elems)
+    except ValueError as e:
+        print("quantize_model: %s" % e, file=sys.stderr)
+        return 1
+    print(json.dumps(summary, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

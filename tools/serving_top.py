@@ -36,17 +36,24 @@ def render(reply):
     desc = reply.get("models", {})
     lines = ["server uptime %.0fs, %d model(s)"
              % (stats.get("uptime_sec", 0.0), len(models)), ""]
-    hdr = ("%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
+    hdr = ("%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
            "%7s %7s %5s"
-           % ("MODEL", "VER", "QPS", "REQS", "p50ms", "p95ms", "p99ms",
-              "FILL", "BKT%", "QUEUE", "SHED", "CCH/M",
+           % ("MODEL", "PREC", "VER", "QPS", "REQS", "p50ms", "p95ms",
+              "p99ms", "FILL", "BKT%", "QUEUE", "SHED", "CCH/M",
               "TTFT95", "TPS", "OCC%"))
     lines.append(hdr)
     lines.append("-" * len(hdr))
+    described = set()
     for name in sorted(models):
+        # lanes key as 'name@precision' for non-fp32 (QUANTIZE.md):
+        # render the plain model name + a PREC column, and resolve the
+        # describe() info (and the lane's routed version) by plain name
         m = models[name]
         lat = m.get("latency_ms", {})
-        d = desc.get(name, {})
+        plain = m.get("model", name)
+        prec = m.get("precision", "fp32")
+        d = desc.get(plain, {})
+        ver = (d.get("precisions") or {}).get(prec, d.get("latest"))
         cc = m.get("compile_cache", {})
         # compile-cache hits/misses across this model's loads + flips:
         # "N/0" on a warm boot means zero fresh compilations
@@ -58,9 +65,9 @@ def render(reply):
         tps = m.get("tokens_per_sec")
         occ = m.get("slot_occupancy")
         lines.append(
-            "%-14s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
+            "%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
             "%7s %7s %5s"
-            % (name[:14], _fmt(d.get("latest")),
+            % (plain[:14], prec[:5], _fmt(ver),
                _fmt(m.get("qps_recent")), _fmt(m.get("requests")),
                _fmt(lat.get("p50")), _fmt(lat.get("p95")),
                _fmt(lat.get("p99")), _fmt(m.get("batch_fill")),
@@ -69,11 +76,16 @@ def render(reply):
                cc_col, _fmt(ttft), _fmt(tps),
                _fmt(round(100.0 * occ, 1) if isinstance(occ, float)
                     and occ >= 0 else None)))
-        if d.get("buckets"):
+        if d.get("buckets") and plain not in described:
+            described.add(plain)
             extra = ""
             if d.get("decode"):
                 extra = " decode_slots=%s max_seq_len=%s" % (
                     d.get("decode_slots"), d.get("max_seq_len"))
+            if d.get("precisions"):
+                extra += " precisions=%s" % (d["precisions"],)
+            if d.get("ab_weights"):
+                extra += " ab=%s" % (d["ab_weights"],)
             lines.append("    buckets=%s versions=%s replicas=%s%s"
                          % (d["buckets"], d.get("versions"),
                             d.get("replicas", 1), extra))

@@ -164,6 +164,15 @@ def main():
                     "--decode", "--decode_mode", "both",
                     "--decode_slots", "16", "--qps", "60",
                     "--duration", "15"], {}, 3600),
+        # quantized serving A/B on silicon (QUANTIZE.md): resnet fp32
+        # vs PTQ-int8 behind the precision axis — on the HBM-roofline-
+        # bound chip the int8 lane's halved weight bytes should show up
+        # directly in QPS/latency, which the CPU-smoke rows
+        # (BENCH_r11.json) cannot measure; records carry per-lane
+        # bit-stability + the pinned accuracy delta
+        ("quant", ["tools/bench_serving.py", "--require_tpu",
+                   "--precision", "both", "--model", "resnet",
+                   "--qps", "200,800", "--duration", "15"], {}, 3600),
         # observability capture (OBSERVABILITY.md): one traced resnet
         # serving run + one traced train step on silicon, archiving the
         # MERGED chrome trace (obs stage spans + XLA device timeline)

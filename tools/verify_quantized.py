@@ -1,0 +1,78 @@
+"""Validate a quantized inference artifact: payload CRCs + program IR.
+
+    python tools/verify_quantized.py <artifact_dir> [--quiet]
+
+The quantized-artifact twin of tools/verify_checkpoint.py and
+tools/verify_compile_cache.py — the same walk
+``load_inference_model`` performs before it will serve the artifact:
+every int8 payload and fp32 scale table CRC32-verifies against the
+``quant_meta.bin`` table, and the rewritten Program runs the PR 9
+verifier passes with the artifact's recorded feeds/fetches.
+
+Exit codes: 0 verified, 1 usage / not a quantized artifact dir,
+2 corruption detected (the message names the corrupt array/file).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="verify a quantized inference artifact dir")
+    ap.add_argument("dir", help="quantized artifact dir (quant_meta.bin)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="no per-file listing; exit code only")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.inference import quantize as q
+    if not q.is_quantized_dir(args.dir):
+        print("verify_quantized: %s has no %s — not a quantized "
+              "artifact dir" % (args.dir, q.QUANT_META),
+              file=sys.stderr)
+        return 1
+
+    rc = 0
+    n_ok = 0
+    for fname, err in q.verify_quantized_dir(args.dir):
+        if err is not None:
+            print("verify_quantized: FAILED: %s: %s" % (fname, err),
+                  file=sys.stderr)
+            rc = 2
+        else:
+            n_ok += 1
+            if not args.quiet:
+                print("  %s: ok" % fname)
+
+    # the Program half: parse + run the analysis passes exactly as the
+    # load boundary would (a tampered graph must fail here too)
+    try:
+        from tools.lint_program import lint_artifact
+        diags = lint_artifact(args.dir, verbose=False) or []
+        errs = [d for d in diags if d.is_error]
+        for d in errs:
+            print("verify_quantized: FAILED: program: %s" % d,
+                  file=sys.stderr)
+            rc = 2
+    except Exception as e:
+        print("verify_quantized: FAILED: program does not verify: %s: %s"
+              % (type(e).__name__, e), file=sys.stderr)
+        rc = 2
+
+    if rc == 0:
+        meta = q.read_quant_meta(args.dir)
+        b = meta.get("bytes", {})
+        if not args.quiet:
+            print("OK (%d payload file(s); %s -> %s weight bytes, "
+                  "%.2fx)" % (n_ok, b.get("fp32_weight_bytes", "?"),
+                              b.get("quant_weight_bytes", "?"),
+                              float(b.get("ratio", 0.0))))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

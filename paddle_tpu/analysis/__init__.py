@@ -22,8 +22,19 @@ Surfaces:
   the artifact boundary is where a broken graph becomes someone else's
   3am page (ANALYSIS.md documents the policy).
 
-CLI twin: tools/lint_program.py (artifact dirs + the model zoo); the
-runtime-side concurrency lint lives in tools/lint_runtime.py.
+Resource analysis (the predictive side — ANALYSIS.md "Resource
+analysis"):
+  analyze_program(program, ...)   -> ResourceReport (liveness-based
+                                     peak-HBM plan + FLOP/byte roofline)
+  analyze_artifact(dir, ...)      -> same for saved artifact dirs
+                                     (quantized/decode/aot aware)
+  check_fit(report, device=)      -> serving admission gate; raises
+                                     ResourceFitError naming the
+                                     estimated vs available bytes
+
+CLI twin: tools/lint_program.py (artifact dirs + the model zoo; --report
+renders the resource tables); the runtime-side concurrency lint lives
+in tools/lint_runtime.py.
 """
 
 from .verifier import (
@@ -35,13 +46,31 @@ from .verifier import (
     verify_program,
     verify_program_cached,
 )
+from .resources import (
+    RESOURCE_PASSES,
+    ResourceFitError,
+    ResourceReport,
+    analyze_artifact,
+    analyze_program,
+    check_fit,
+    device_memory_bytes,
+    device_peaks,
+)
 
 __all__ = [
     "ANALYSIS_PASSES",
     "Diagnostic",
     "ProgramVerificationError",
+    "RESOURCE_PASSES",
+    "ResourceFitError",
+    "ResourceReport",
+    "analyze_artifact",
+    "analyze_program",
+    "check_fit",
     "check_program",
     "check_serialized_cached",
+    "device_memory_bytes",
+    "device_peaks",
     "verify_program",
     "verify_program_cached",
 ]

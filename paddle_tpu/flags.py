@@ -340,6 +340,17 @@ DEFINE_int(
     "ServerOverloaded — overload still sheds at the front instead of "
     "queueing unboundedly behind slow replicas.")
 DEFINE_int(
+    "serving_device_mem_mb", 0,
+    "Per-replica device memory budget (MiB) for the serving admission "
+    "fit check (ANALYSIS.md resource analysis): load_model statically "
+    "estimates each replica's peak HBM (params + activation peak + "
+    "decode KV slot table) and rejects an un-fittable placement with a "
+    "ResourceFitError BEFORE any build/warm work — naming the "
+    "estimated and available bytes. 0 (default) resolves the budget "
+    "from the device itself (memory_stats bytes_limit, else the known "
+    "TPU HBM capacity table); on CPU with no configured budget the "
+    "check passes trivially.")
+DEFINE_int(
     "serving_decode_slots", 8,
     "Slot-table size of each replica's decode lane (SERVING.md "
     "continuous batching): the fixed-shape decode step XLA compiles "

@@ -138,3 +138,13 @@ def convert_dtype_to_np(dtype):
     if isinstance(dtype, int):
         return np.dtype(_DTYPE_TO_NP[dtype])
     return np.dtype(dtype)
+
+
+def dtype_size(dtype):
+    """Bytes per element of a VarType enum / numpy dtype / string —
+    the static byte accounting the resource analyzer (analysis/
+    resources.py) sums var shapes with.  BF16 is 2 bytes, INT8 one (the
+    quantized lane's weight-footprint win reads straight from this)."""
+    if dtype == VarDesc.VarType.BF16 or str(dtype) == "bfloat16":
+        return 2
+    return int(convert_dtype_to_np(dtype).itemsize)

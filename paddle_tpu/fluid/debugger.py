@@ -10,6 +10,12 @@ annotate the output instead of printing the bare program — dead ops are
 dimmed, shape/dtype-mismatch sites highlighted, and every other finding
 lands as a ``!`` / colored marker on its op or var, so "why does the
 verifier hate my program" is answerable by looking at the graph.
+
+Resource integration: both renderers also accept ``costs=`` — a
+paddle_tpu.analysis.ResourceReport (or its ``ops`` row list) — and
+grow a per-op ``est_bytes``/``est_flops`` column on the same
+indexing machinery, so "where do the bytes go" reads off the printed
+program the way the findings do.
 """
 
 __all__ = ["pprint_program_codes", "pprint_block_codes",
@@ -36,13 +42,36 @@ def _index_diags(block, diagnostics):
     return by_op, by_var
 
 
-def pprint_program_codes(program, diagnostics=None):
-    return "\n".join(pprint_block_codes(b, diagnostics=diagnostics)
+def _index_costs(block, costs):
+    """op_index -> (est_flops, est_bytes) for `block` from a
+    ResourceReport (or its .ops row list); {} without costs."""
+    if costs is None:
+        return {}
+    rows = getattr(costs, "ops", costs)
+    out = {}
+    for row in rows:
+        if row.get("block") == block.idx:
+            out[row["index"]] = (row.get("est_flops", 0),
+                                 row.get("est_bytes", 0))
+    return out
+
+
+def _fmt_units(n, unit):
+    for scale, suffix in ((1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if n >= scale:
+            return "%.1f%s%s" % (n / scale, suffix, unit)
+    return "%d%s" % (n, unit)
+
+
+def pprint_program_codes(program, diagnostics=None, costs=None):
+    return "\n".join(pprint_block_codes(b, diagnostics=diagnostics,
+                                        costs=costs)
                      for b in program.blocks)
 
 
-def pprint_block_codes(block, diagnostics=None):
+def pprint_block_codes(block, diagnostics=None, costs=None):
     by_op, by_var = _index_diags(block, diagnostics)
+    by_cost = _index_costs(block, costs)
     lines = ["# block %d (parent %d)" % (block.idx, block.parent_idx)]
     for var in block.vars.values():
         line = "var %s : %s shape=%s%s" % (
@@ -58,6 +87,10 @@ def pprint_block_codes(block, diagnostics=None):
         marks = by_op.get(i, ())
         if any(d.check in _DEAD_CHECKS for d in marks):
             line = "# [dead] " + line       # dimmed: commented out
+        cost = by_cost.get(i)
+        if cost is not None:
+            line += "   # est_flops=%s est_bytes=%s" % (
+                _fmt_units(cost[0], "F"), _fmt_units(cost[1], "B"))
         for d in marks:
             if d.check not in _DEAD_CHECKS:
                 line += "   # !%s[%s] %s" % (d.severity, d.check,
@@ -67,16 +100,19 @@ def pprint_block_codes(block, diagnostics=None):
 
 
 def draw_block_graphviz(block, highlights=None, path="./temp.dot",
-                        diagnostics=None):
+                        diagnostics=None, costs=None):
     """Write the op/var graph of `block` as graphviz dot (reference
     debugger.py draw_block_graphviz; C++ analogue graph_viz_pass).
 
     With `diagnostics`, analyzer findings restyle the graph: dead ops
     render dimmed (gray, dashed), shape/dtype-mismatch and other error
     sites render highlighted (red) with the finding in the tooltip, and
-    flagged vars (unused/undefined) pick up the same treatment."""
+    flagged vars (unused/undefined) pick up the same treatment.  With
+    `costs` (a ResourceReport), each op node's label carries its
+    est_flops/est_bytes line."""
     highlights = set(highlights or [])
     by_op, by_var = _index_diags(block, diagnostics)
+    by_cost = _index_costs(block, costs)
     lines = ["digraph G {", "  rankdir=TB;"]
     var_ids = {}
 
@@ -117,8 +153,13 @@ def draw_block_graphviz(block, highlights=None, path="./temp.dot",
                 "; ".join(str(d) for d in diags))
         style = 'style=filled, fillcolor="%s"%s' % (fill, extra) \
             if "style" not in extra else 'fillcolor="%s"%s' % (fill, extra)
+        label = op.type
+        cost = by_cost.get(i)
+        if cost is not None:
+            label += "\\n%s %s" % (_fmt_units(cost[0], "F"),
+                                   _fmt_units(cost[1], "B"))
         lines.append('  %s [label="%s", shape=box, %s];'
-                     % (op_id, op.type, style))
+                     % (op_id, label, style))
         err_edges = any(d.check in ("shape-mismatch", "dtype-mismatch")
                         for d in diags)
         for names in op.inputs.values():

@@ -109,6 +109,27 @@ class Variable:
     def np_dtype(self):
         return core.convert_dtype_to_np(self.dtype)
 
+    # ---- static shape metadata (analysis/resources.py) ----
+    def numel_hint(self, batch=1):
+        """Static element count with every dynamic dim (-1/None)
+        substituted by `batch` — the size the resource analyzer plans
+        memory with.  None when the shape was never recorded."""
+        if self.shape is None:
+            return None
+        n = 1
+        for d in self.shape:
+            n *= int(batch) if (d is None or int(d) < 0) else int(d)
+        return int(n)
+
+    def nbytes_hint(self, batch=1):
+        """Static byte size under the `batch` hint (numel_hint x dtype
+        size; int8 vars read one byte/elem — the quantized lane's
+        footprint falls out of the recorded dtype)."""
+        n = self.numel_hint(batch=batch)
+        if n is None:
+            return None
+        return n * core.dtype_size(self.dtype)
+
     def to_string(self, throw_on_error=True, with_details=False):
         return repr(self)
 
@@ -225,19 +246,29 @@ class Operator:
 
     __str__ = __repr__
 
+    @staticmethod
+    def _encode_attr(v):
+        """JSON-encodable form of one attr value.  Recursive: a Block
+        (or ndarray / numpy scalar) may sit INSIDE a container attr —
+        e.g. recurrent_grad's stashed fwd_attrs dict carries the
+        forward sub_block — and a program holding one must still
+        clone/serialize."""
+        if isinstance(v, Block):
+            return {"__block__": v.idx}
+        if isinstance(v, np.ndarray):
+            return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, np.floating):
+            return float(v)
+        if isinstance(v, dict):
+            return {k: Operator._encode_attr(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [Operator._encode_attr(x) for x in v]
+        return v
+
     def _serialize(self):
-        attrs = {}
-        for k, v in self.attrs.items():
-            if isinstance(v, Block):
-                attrs[k] = {"__block__": v.idx}
-            elif isinstance(v, np.ndarray):
-                attrs[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
-            elif isinstance(v, (np.integer,)):
-                attrs[k] = int(v)
-            elif isinstance(v, (np.floating,)):
-                attrs[k] = float(v)
-            else:
-                attrs[k] = v
+        attrs = {k: self._encode_attr(v) for k, v in self.attrs.items()}
         # uid round-trips so per-op RNG streams (registry.ExecContext.rng_key
         # folds in op.uid) are identical in clones — the reference's per-op
         # `seed` attr semantics under Program.clone
@@ -488,16 +519,21 @@ class Program:
                 if trainable is not None:
                     v.trainable = trainable
                 blk.vars[v.name] = v
+            def _decode_attr(av):
+                if isinstance(av, dict):
+                    if "__block__" in av:
+                        return p.blocks[av["__block__"]]
+                    if "__ndarray__" in av:
+                        return np.array(av["__ndarray__"],
+                                        dtype=av["dtype"])
+                    return {k: _decode_attr(x) for k, x in av.items()}
+                if isinstance(av, list):
+                    return [_decode_attr(x) for x in av]
+                return av
+
             for od in bdata["ops"]:
-                attrs = {}
-                for k, av in od["attrs"].items():
-                    if isinstance(av, dict) and "__block__" in av:
-                        attrs[k] = p.blocks[av["__block__"]]
-                    elif isinstance(av, dict) and "__ndarray__" in av:
-                        attrs[k] = np.array(av["__ndarray__"],
-                                            dtype=av["dtype"])
-                    else:
-                        attrs[k] = av
+                attrs = {k: _decode_attr(av)
+                         for k, av in od["attrs"].items()}
                 op = Operator(blk, od["type"], od["inputs"], od["outputs"],
                               attrs)
                 if "uid" in od:

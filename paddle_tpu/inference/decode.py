@@ -246,6 +246,21 @@ class GenerativePredictor:
     def clone_to(self, device):
         return GenerativePredictor(None, device=device, _clone_of=self)
 
+    # -- static byte accounting (ANALYSIS.md resource analysis) ---------
+
+    def kv_cache_bytes(self, n_slots):
+        """Closed-form slot-table KV cache footprint for an `n_slots`
+        session: K and V, [L, n_slots, S, H, Dh] fp32 each — the HBM
+        term that bounds decode slots (FLAGS.serving_decode_slots) and
+        the number the admission fit check adds per replica."""
+        L, H, Dh, _ = self._dims()
+        return 2 * L * int(n_slots) * self.max_seq_len * H * Dh * 4
+
+    def param_bytes(self):
+        """Static weight footprint (host-state nbytes sum)."""
+        return sum(int(np.asarray(v).nbytes)
+                   for v in self._state_host.values())
+
     # -- model math -----------------------------------------------------
 
     def _dims(self):

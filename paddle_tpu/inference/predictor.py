@@ -462,6 +462,22 @@ class Predictor:
         (the serving registry's precision axis, QUANTIZE.md)."""
         return self._precision
 
+    def resource_report(self, batch=None):
+        """Static ResourceReport of the program THIS predictor actually
+        serves — post-transpile, so BN folds / fusions / the PTQ
+        dequant rewrite are priced as they will run (sharper than
+        analysis.analyze_artifact, which reads the artifact as saved).
+        `batch` defaults to the largest configured bucket."""
+        from paddle_tpu.analysis import analyze_program
+        if batch is None:
+            buckets = self.batch_buckets()
+            batch = buckets[-1] if buckets else 1
+        return analyze_program(self._program, feeds=self._feed_names,
+                               fetches=self._fetch_names, batch=batch,
+                               device=self._device,
+                               what="predictor(%s)"
+                                    % (self._config.model_dir,))
+
     # ------------------------------------------------------------------
     # serving introspection (paddle_tpu/serving): the batcher needs the
     # same three facts from a live Predictor and an AotPredictor — batch

@@ -217,6 +217,21 @@ class MetricsRegistry(object):
                                     n))
         _family(lines, _PREFIX + "serving_shed_by_priority_total",
                 "counter", samples)
+        # static resource estimates (ANALYSIS.md): the placement-by-
+        # cost gauges the fleet controller scrapes — per-replica peak
+        # HBM estimate and one-step FLOPs, set by the admission check
+        for field, mname in (("est_peak_mb",
+                              _PREFIX + "model_est_peak_mb"),
+                             ("est_flops",
+                              _PREFIX + "model_est_flops")):
+            samples = []
+            for snap in snaps:
+                for model, m in sorted(snap.get("models", {}).items()):
+                    if field in m:
+                        samples.append(
+                            (mname, self._model_labels(model, m),
+                             m[field]))
+            _family(lines, mname, "gauge", samples)
         samples = []
         for snap in snaps:
             for model, m in sorted(snap.get("models", {}).items()):

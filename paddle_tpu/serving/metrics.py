@@ -152,6 +152,12 @@ class ModelMetrics:
         # count across this model's lanes — the occupancy gauge
         self.slot_occupancy_fn = None
         self._shed_by_priority = {}      # priority class -> shed count
+        # static resource estimates (ANALYSIS.md): set once per load /
+        # hot swap by the registry's note_resource — the placement-by-
+        # cost signal the fleet controller scrapes (model_est_peak_mb /
+        # model_est_flops Prometheus gauges)
+        self.est_peak_mb = None
+        self.est_flops = None
         self._started = time.monotonic()
         self._completions = collections.deque()
         self._lock = threading.Lock()
@@ -183,6 +189,13 @@ class ModelMetrics:
         self.compile_cache_hits.add(int(delta.get("hits", 0)))
         self.compile_cache_misses.add(int(delta.get("misses", 0)))
         self.compile_ms.add(int(round(delta.get("compile_ms", 0.0))))
+
+    def note_resource(self, est_peak_mb, est_flops):
+        """Record this lane's static resource estimate (the admission
+        fit check's numbers — registry load_model calls this once per
+        load; a hot swap overwrites with the new artifact's)."""
+        self.est_peak_mb = float(est_peak_mb)
+        self.est_flops = int(est_flops)
 
     def note_prefill(self, ttft_ms):
         """One prefill completed: the request's first token exists —
@@ -273,6 +286,12 @@ class ModelMetrics:
                 "compile_ms": self.compile_ms.value,
             },
         }
+        if self.est_peak_mb is not None:
+            # static resource estimate (set at load by the admission
+            # fit check) — flat keys so Prometheus/serving_top pick
+            # them up with zero schema plumbing
+            snap["est_peak_mb"] = round(self.est_peak_mb, 3)
+            snap["est_flops"] = int(self.est_flops or 0)
         if self.streams.value or self.slot_occupancy_fn is not None:
             # generation telemetry, flat keys so the Prometheus render
             # and serving_top pick them up with zero schema plumbing

@@ -145,7 +145,8 @@ class ModelEntry:
     identical across replicas by construction."""
 
     def __init__(self, name, version, path, predictor, batcher,
-                 replicas=None, devices=None, precision="fp32"):
+                 replicas=None, devices=None, precision="fp32",
+                 resource=None):
         self.name = name
         self.version = version
         self.path = path
@@ -161,6 +162,11 @@ class ModelEntry:
         # cache (compile_cache.stats_delta, set by load_model): a warm
         # flip shows misses == 0 — zero fresh compilations
         self.compile_cache = {}
+        # the static ResourceReport the admission fit check ran on
+        # (ANALYSIS.md) — what describe()/stats/Prometheus expose so a
+        # fleet controller can place by cost; None when the artifact
+        # could not be analyzed
+        self.resource = resource
 
     def device_labels(self):
         from ..inference.predictor import _device_label
@@ -228,6 +234,46 @@ class ModelRegistry:
 
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _fit_check(name, path, placement, decode_slots=None):
+        """Static admission gate (ANALYSIS.md): analyze the artifact,
+        then check the per-replica peak estimate against every
+        placement device's memory budget.  Returns the ResourceReport
+        (None when the artifact defies analysis — advisory only);
+        raises ResourceFitError on a placement that cannot fit.
+
+        Replicas sharing one device (the [None] default-device spec
+        with N > 1 never happens; explicit duplicate devices can)
+        multiply the estimate on that device."""
+        from ..analysis import check_fit, resources
+        try:
+            report = resources.analyze_artifact(
+                path, decode_slots=decode_slots)
+        except Exception:
+            return None
+        by_dev = {}
+        for dev in placement:
+            key = id(dev) if dev is not None else None
+            by_dev[key] = (dev, by_dev.get(key, (dev, 0))[1] + 1)
+        from ..analysis import ResourceFitError
+        for dev, n in by_dev.values():
+            try:
+                est, avail = check_fit(
+                    report, device=dev,
+                    what="model %r (%s)" % (name, path), replicas=n)
+            except ResourceFitError as e:
+                obs_events.emit(
+                    "model_fit_rejected", model=name, path=path,
+                    est_bytes=e.estimated_bytes,
+                    available_bytes=e.available_bytes)
+                raise
+            if avail is not None:
+                obs_events.emit(
+                    "model_fit_check", model=name, path=path,
+                    est_bytes=int(est), available_bytes=int(avail),
+                    replicas=int(n))
+        return report
+
     def load_model(self, name, path, version=None, warm=True,
                    buckets=None, drain_timeout=30.0, replicas=None,
                    devices=None, decode_slots=None, decode_mode=None,
@@ -258,6 +304,15 @@ class ModelRegistry:
         spec = devices if devices is not None else (
             replicas if replicas is not None else self._replicas)
         placement = resolve_placement(spec)
+        # admission fit check (ANALYSIS.md resource analysis): the
+        # static per-replica peak estimate is checked against each
+        # placement device's budget BEFORE any artifact build / clone /
+        # warm work — an un-fittable placement fails fast with a
+        # ResourceFitError naming the estimated and available bytes.
+        # Analysis failures (not fit failures) must never block a load:
+        # the estimate is advisory when it cannot be computed.
+        report = self._fit_check(name, path, placement,
+                                 decode_slots=decode_slots)
         cc_before = compile_cache.stats()
         preds = _build_replicas(path, buckets, placement)
         precision = str(precision or getattr(preds[0], "precision",
@@ -276,7 +331,10 @@ class ModelRegistry:
                 metrics=lane_metrics, replicas=preds)
         entry = ModelEntry(name, version, path, preds[0], batcher,
                            replicas=preds, devices=placement,
-                           precision=precision)
+                           precision=precision, resource=report)
+        if report is not None:
+            lane_metrics.note_resource(report.peak_mb,
+                                       report.total_flops)
         if warm:
             try:
                 entry.warm()
@@ -383,6 +441,14 @@ class ModelRegistry:
                     info["replicas"] = len(latest.replicas)
                     info["devices"] = latest.device_labels()
                     info["precision"] = latest.precision
+                    if latest.resource is not None:
+                        # the static cost the fleet controller places
+                        # by (ANALYSIS.md): per-replica peak estimate
+                        # + one-step FLOPs
+                        info["est_peak_mb"] = round(
+                            latest.resource.peak_mb, 3)
+                        info["est_flops"] = int(
+                            latest.resource.total_flops)
                     if latest.is_decode:
                         # decode entry: buckets above are the PROMPT
                         # prefill buckets; surface the generation shape

@@ -1,0 +1,24 @@
+"""Seeded defect: two locks acquired nested in OPPOSITE orders across
+methods — the classic lock-order deadlock (lint_runtime
+``nested-lock-order``).  Two threads running transfer_out and
+transfer_in concurrently can each hold one lock and block forever on
+the other."""
+
+import threading
+
+
+class Account:
+    def __init__(self):
+        self._debit_lock = threading.Lock()
+        self._credit_lock = threading.Lock()
+        self.balance = 0
+
+    def transfer_out(self, n):
+        with self._debit_lock:          # A then B
+            with self._credit_lock:
+                self.balance -= n
+
+    def transfer_in(self, n):
+        with self._credit_lock:        # B then A — opposite order
+            with self._debit_lock:
+                self.balance += n

@@ -441,6 +441,62 @@ def test_lint_runtime_cli_flags_seeded_defects_exit_2():
         assert line.split(":")[1].isdigit(), line
 
 
+def test_lint_runtime_cli_flags_lock_order_fixture_exit_2():
+    # nested-lock-order: two locks taken in opposite orders across
+    # methods — the deadlock-shape check added with the resource
+    # analyzer PR; the repo itself must stay clean of it (the --smoke
+    # exit-0 test above covers that side)
+    r = _run_tool([os.path.join(REPO, "tools", "lint_runtime.py"),
+                   os.path.join(FIXTURES, "bad_lock_order.py")])
+    assert r.returncode == 2, r.stdout + r.stderr
+    line = next((ln for ln in r.stdout.splitlines()
+                 if "nested-lock-order" in ln), None)
+    assert line and "bad_lock_order.py" in line, r.stdout
+    assert line.split(":")[1].isdigit()
+    # the message names BOTH sites of the inversion
+    assert "transfer_out" in line and "Account.transfer_in" in line
+
+
+# ---------------------------------------------------------------------------
+# tools/ci_checks.sh — the one-command CI gate; its per-gate exit codes
+# are the contract a CI wrapper keys on (10 lint_runtime,
+# 11 lint_program, 12 apispec, 1 usage, 0 clean)
+# ---------------------------------------------------------------------------
+
+def _run_ci(args, env_extra=None, timeout=600):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(env_extra or {})
+    return subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "ci_checks.sh")] + args,
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=env)
+
+
+def test_ci_checks_clean_gate_exit_0():
+    r = _run_ci(["lint_runtime"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ci_checks: OK" in r.stdout
+
+
+def test_ci_checks_unknown_gate_exit_1():
+    r = _run_ci(["no_such_gate"])
+    assert r.returncode == 1, r.stdout + r.stderr
+
+
+def test_ci_checks_apispec_drift_exit_12(tmp_path):
+    # point the gate at a stale spec copy: drift must exit 12 and name
+    # the regeneration command — the committed API.spec itself is
+    # covered by test_api_spec.py
+    stale = tmp_path / "API.stale"
+    with open(os.path.join(REPO, "API.spec")) as f:
+        lines = f.read().splitlines()
+    stale.write_text("\n".join(lines[:-1] + ["ghost.symbol (x)"]) + "\n")
+    r = _run_ci(["apispec"], env_extra={"API_SPEC": str(stale)})
+    assert r.returncode == 12, r.stdout + r.stderr
+    assert "drifted" in r.stdout
+
+
 def test_lint_program_cli_smoke_zoo_clean_exit_0():
     r = _run_tool([os.path.join(REPO, "tools", "lint_program.py"),
                    "--smoke"])

@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# One CI gate (ANALYSIS.md): the runtime concurrency lint, the program
+# verifier smoke sweep, and the API.spec drift check — the three static
+# gates every PR must clear, runnable as one command.
+#
+#     bash tools/ci_checks.sh              # all gates
+#     bash tools/ci_checks.sh lint_runtime # one gate by name
+#     bash tools/ci_checks.sh lint_program apispec
+#
+# Gates and their DISTINCT exit codes (pinned by tests/test_analysis.py
+# in a tier-1 subprocess — a CI wrapper can tell WHICH gate broke from
+# the code alone):
+#
+#     10  lint_runtime   concurrency/durability AST lint over paddle_tpu/
+#     11  lint_program   verifier --smoke zoo sweep (mnist, vgg)
+#     12  apispec        tools/gen_api_spec.py output != committed spec
+#      1  usage          unknown gate name
+#      0  all requested gates clean
+#
+# Env: PYTHON overrides the interpreter; API_SPEC overrides the spec
+# file compared against (the failure-path test points it at a stale
+# copy); JAX_PLATFORMS defaults to cpu so the gate never needs a chip.
+
+set -u
+cd "$(dirname "$0")/.."
+PY="${PYTHON:-python}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+SPEC="${API_SPEC:-API.spec}"
+
+gates=("$@")
+if [ ${#gates[@]} -eq 0 ]; then
+    gates=(lint_runtime lint_program apispec)
+fi
+
+for gate in "${gates[@]}"; do
+    case "$gate" in
+        lint_runtime)
+            echo "== ci_checks: lint_runtime =="
+            "$PY" tools/lint_runtime.py --smoke || exit 10
+            ;;
+        lint_program)
+            echo "== ci_checks: lint_program --smoke =="
+            "$PY" tools/lint_program.py --smoke || exit 11
+            ;;
+        apispec)
+            echo "== ci_checks: API.spec drift =="
+            tmp="$(mktemp)"
+            trap 'rm -f "$tmp"' EXIT
+            "$PY" tools/gen_api_spec.py > "$tmp" || exit 12
+            if ! diff -u "$SPEC" "$tmp" > /dev/null; then
+                diff -u "$SPEC" "$tmp" | head -40
+                echo "ci_checks: API surface drifted from $SPEC —" \
+                     "regenerate: python tools/gen_api_spec.py > API.spec"
+                exit 12
+            fi
+            ;;
+        *)
+            echo "ci_checks: unknown gate '$gate'" \
+                 "(have: lint_runtime lint_program apispec)"
+            exit 1
+            ;;
+    esac
+done
+echo "ci_checks: OK (${gates[*]})"
+exit 0

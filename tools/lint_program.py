@@ -1,8 +1,8 @@
 """Program verifier CLI — run the static analysis passes over saved
 inference artifacts and/or the model zoo.
 
-    python tools/lint_program.py <artifact_dir>... [--strict]
-    python tools/lint_program.py --zoo [--strict]
+    python tools/lint_program.py <artifact_dir>... [--strict] [--report]
+    python tools/lint_program.py --zoo [--strict] [--report]
     python tools/lint_program.py --smoke
 
 An artifact dir containing ``__model__`` (save_inference_model layout)
@@ -13,8 +13,19 @@ are reported as skipped.  ``--zoo`` builds every paddle_tpu/models
 program (small configs) and verifies main + startup with the model's
 real feeds/fetches; ``--smoke`` is the fast tier-1 subset.
 
+``--report`` adds the static RESOURCE analysis (ANALYSIS.md "Resource
+analysis"): per artifact dir, the liveness-based peak-HBM plan, the
+FLOP/byte roofline estimate and the est-vs-actual weight-byte delta;
+with ``--zoo``, each model is initialized, saved as a real inference
+artifact into a scratch dir and analyzed the same way — the committed
+est-vs-actual table in ANALYSIS.md is this mode's output (the mnist row
+additionally quantizes its artifact and reports the int8 twin's static
+weight-footprint ratio).  ``--batch`` sets the dynamic-dim hint.
+
 Exit codes: 0 clean (warnings allowed unless --strict), 2 error
 findings (each printed with block/op-index/var), 1 usage error.
+--report adds exit 2 when a zoo weight-byte estimate drifts more than
+10% from the saved artifact's actual bytes (the acceptance bound).
 
 The ANALYSIS.md "zoo sweep" table is this tool's --zoo output.
 """
@@ -117,6 +128,124 @@ def lint_zoo_model(name):
     }
 
 
+def _zoo_batch(name):
+    spec = next(z for z in ZOO if z[0] == name)
+    return int(spec[2].get("batch_size", 1))
+
+
+def save_zoo_artifact(name, out_dir):
+    """Build one zoo model, initialize its weights and save the REAL
+    inference artifact (save_inference_model) into `out_dir`; returns
+    the artifact path.  This is what makes the --report est-vs-actual
+    column honest: the actual bytes are the committed .npy payloads."""
+    import importlib
+    import paddle_tpu.fluid as fluid
+    spec = next((z for z in ZOO if z[0] == name), None)
+    if spec is None:
+        raise KeyError("unknown zoo model %r" % name)
+    _, mod, kw = spec
+    m = importlib.import_module(mod)
+    main, startup, feeds, loss, acc, predict = m.get_model(**kw)
+    target = predict if predict is not None else loss
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed_names = [_name(f) for f in feeds]
+        gb = main.global_block()
+        tv = gb.var(_name(target))
+        # feed only what the inference subgraph consumes: the label
+        # feed of a training main prunes away and would otherwise land
+        # an unused-feed warning on every saved artifact
+        pruned = main.clone(for_test=True)._prune(feed_names,
+                                                  [_name(target)])
+        used = set()
+        for op in pruned.global_block().ops:
+            used.update(op.input_arg_names)
+        feed_names = [n for n in feed_names if n in used] or feed_names
+        fluid.save_inference_model(out_dir, feed_names, [tv], exe,
+                                   main_program=main)
+    return out_dir
+
+
+def report_resources(paths, batch=1):
+    """Render the static resource report for artifact dirs; returns
+    the list of (path, ResourceReport)."""
+    from paddle_tpu.analysis import analyze_artifact
+    out = []
+    for path in paths:
+        rep = analyze_artifact(path, batch=batch)
+        print(rep.render())
+        print()
+        out.append((path, rep))
+    return out
+
+
+def report_zoo(names, scratch=None):
+    """The --report --zoo mode: save every zoo model as a real
+    artifact, analyze it, and print the est-vs-actual markdown table
+    ANALYSIS.md commits.  Returns True when any weight-byte estimate
+    drifts past the 10% acceptance bound.  The mnist artifact is also
+    quantized so the int8 lane's static footprint ratio is pinned in
+    the same table."""
+    import tempfile
+    from paddle_tpu.analysis import analyze_artifact
+    scratch = scratch or tempfile.mkdtemp(prefix="lint_report_")
+    drifted = False
+    rows = []
+    for name in names:
+        art = os.path.join(scratch, name)
+        try:
+            save_zoo_artifact(name, art)
+        except Exception as e:
+            print("%s: artifact save failed (%s: %s) — skipping report"
+                  % (name, type(e).__name__, e))
+            continue
+        bs = _zoo_batch(name)
+        rep = analyze_artifact(art, batch=bs)
+        delta = None
+        if rep.actual_param_bytes:
+            delta = 100.0 * (rep.param_bytes - rep.actual_param_bytes) \
+                / rep.actual_param_bytes
+            drifted |= abs(delta) > 10.0
+        rows.append((name, bs, rep, delta, ""))
+        if name == "mnist":
+            try:
+                from paddle_tpu.inference.quantize import \
+                    quantize_inference_model
+                q = quantize_inference_model(art, art + "_int8")
+                qrep = analyze_artifact(q["dst"], batch=bs)
+                ratio = qrep.param_bytes / max(rep.param_bytes, 1)
+                qd = None
+                if qrep.actual_param_bytes:
+                    qd = 100.0 * (qrep.param_bytes
+                                  - qrep.actual_param_bytes) \
+                        / qrep.actual_param_bytes
+                    drifted |= abs(qd) > 10.0
+                rows.append(("mnist (int8 twin)", bs, qrep, qd,
+                             "%.2fx fp32" % ratio))
+            except Exception as e:
+                print("mnist quantized twin failed: %s: %s"
+                      % (type(e).__name__, e))
+    print("| model | batch | est weight MiB | actual MiB | delta | "
+          "peak MiB | GFLOP/step | FLOP/B | roofline ms |")
+    print("|---|---|---|---|---|---|---|---|---|")
+    for name, bs, rep, delta, note in rows:
+        print("| %s | %d | %.3f | %s | %s | %.2f | %.3f | %.1f | "
+              "%.3f |"
+              % (name, bs, rep.param_bytes / (1 << 20),
+                 "%.3f" % (rep.actual_param_bytes / (1 << 20))
+                 if rep.actual_param_bytes else "—",
+                 ("%+.1f%%" % delta if delta is not None else "—")
+                 + ((" " + note) if note else ""),
+                 rep.peak_mb, rep.total_flops / 1e9,
+                 rep.arithmetic_intensity, rep.est_step_ms))
+    if drifted:
+        print("report: FAIL (a weight-byte estimate drifted past the "
+              "10%% acceptance bound)")
+    return drifted
+
+
 def _report(label, diags, strict):
     errs = [d for d in diags if d.is_error]
     warns = [d for d in diags if not d.is_error]
@@ -140,6 +269,14 @@ def main(argv=None):
                          % ", ".join(SMOKE_ZOO))
     ap.add_argument("--strict", action="store_true",
                     help="warnings also fail (exit 2)")
+    ap.add_argument("--report", action="store_true",
+                    help="add the static resource report (peak HBM, "
+                         "FLOP/byte roofline, est-vs-actual weight "
+                         "bytes) — ANALYSIS.md 'Resource analysis'")
+    ap.add_argument("--batch", type=int, default=1,
+                    help="dynamic-dim hint for --report on artifact "
+                         "dirs (zoo rows use each model's configured "
+                         "batch)")
     args = ap.parse_args(argv)
     if not args.paths and not args.zoo and not args.smoke:
         ap.error("nothing to lint: give artifact dirs, --zoo or --smoke")
@@ -153,6 +290,8 @@ def main(argv=None):
             return 1
         if diags is not None:
             failed |= _report(path, diags, args.strict)
+    if args.report and args.paths:
+        report_resources(args.paths, batch=args.batch)
     names = [z[0] for z in ZOO] if args.zoo else \
         (list(SMOKE_ZOO) if args.smoke else [])
     for name in names:
@@ -161,6 +300,8 @@ def main(argv=None):
                           r["main"], args.strict)
         failed |= _report("zoo:%s:startup" % name, r["startup"],
                           args.strict)
+    if args.report and names:
+        failed |= report_zoo(names)
     return 2 if failed else 0
 
 

@@ -34,6 +34,15 @@ runtime; the lint catches the pattern mechanically, before it runs:
       written by concurrent lanes) and PR 6's tuning-record rewrite are
       this class.
 
+  nested-lock-order        two of a class's locks acquired NESTED in
+      opposite orders across methods (A then B in one, B then A in
+      another).  Two threads taking the two paths concurrently can each
+      hold one lock and wait forever on the other — the classic
+      lock-order deadlock, and exactly the hazard shape the registry's
+      routing-lock + batcher-lane-lock layering must never grow.  Fix:
+      one canonical acquisition order (or release the outer lock before
+      taking the inner).
+
 Scope: with no file arguments the lint walks paddle_tpu/ and applies
 each check to its hazard-relevant modules (vault modules for the write
 check, span/deadline modules for the clock check, serving/ for the lock
@@ -384,6 +393,58 @@ def check_wallclock(relpath, tree, findings):
     scan(tree, "")
 
 
+class _LockOrderScan(ast.NodeVisitor):
+    """One method: ordered (outer, inner, line) acquisition pairs of
+    the class's self-attr locks — both nested ``with self._a:`` /
+    ``with self._b:`` blocks and multi-item ``with self._a, self._b:``
+    statements count, in lexical order."""
+
+    def __init__(self, lock_attrs):
+        self.lock_attrs = lock_attrs
+        self.held = []          # acquisition stack of lock attr names
+        self.pairs = []         # (outer, inner, line)
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            ce = item.context_expr
+            if _is_self_attr(ce) and ce.attr in self.lock_attrs:
+                for outer in self.held + acquired:
+                    self.pairs.append((outer, ce.attr, node.lineno))
+                acquired.append(ce.attr)
+        self.held.extend(acquired)
+        self.generic_visit(node)
+        del self.held[len(self.held) - len(acquired):]
+
+
+def check_lock_order(relpath, tree, findings):
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        lock_attrs, _conds = _lock_attrs_of_class(cls)
+        if len(lock_attrs) < 2:
+            continue
+        order = {}     # (outer, inner) -> (method, line) first site
+        for m in _method_iter(cls):
+            scan = _LockOrderScan(lock_attrs)
+            scan.visit(m)
+            for outer, inner, line in scan.pairs:
+                if outer != inner:
+                    order.setdefault((outer, inner), (m.name, line))
+        for (a, b), (meth, line) in sorted(order.items()):
+            if a > b:
+                continue          # report each unordered pair once
+            rev = order.get((b, a))
+            if rev is None:
+                continue
+            findings.append(Finding(
+                relpath, line, "nested-lock-order",
+                "%s.%s" % (cls.name, meth),
+                "self.%s is taken inside self.%s here, but %s (line "
+                "%d) nests them the other way around — two threads on "
+                "the two paths can each hold one lock and wait forever "
+                "on the other; pick one canonical order" % (
+                    b, a, rev[0], rev[1])))
+
+
 def check_unlocked_mutation(relpath, tree, findings):
     for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
         lock_attrs, _conds = _lock_attrs_of_class(cls)
@@ -422,6 +483,9 @@ CHECKS = (
     ("nonatomic-vault-write", VAULT_MODULES, check_vault_write),
     ("nonmonotonic-time", TIME_MODULES, check_wallclock),
     ("unlocked-shared-mutation", LOCK_MODULES, check_unlocked_mutation),
+    # the deadlock-shape check is cheap and precise — repo-wide, like
+    # the notify check
+    ("nested-lock-order", NOTIFY_MODULES, check_lock_order),
 )
 
 

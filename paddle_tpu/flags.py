@@ -373,6 +373,25 @@ DEFINE_int(
     "frame to the client every this many generated tokens (and always "
     "at end of stream). 1 streams every token as it decodes; larger "
     "values trade time-to-token for fewer wire frames.")
+DEFINE_int(
+    "serving_spec_k", 4,
+    "Speculative-decoding draft depth (SERVING.md): when a decode "
+    "model is loaded WITH a draft artifact (load_model(draft=...) or "
+    "FLAGS.serving_spec_draft), each round the draft proposes this "
+    "many tokens and the fp32 target verifies all k+1 positions in one "
+    "fixed-shape batched step; the longest greedily-agreeing prefix "
+    "commits, so slots advance 1..k+1 tokens per target step while the "
+    "stream stays bit-identical to target-only decode. Only meaningful "
+    "with a draft configured; < 1 disables speculation outright.")
+DEFINE_string(
+    "serving_spec_draft", "",
+    "Default draft artifact directory for speculative decoding: a "
+    "decode artifact sharing the target's vocab/eos (canonically the "
+    "int8 twin of the same model — QUANTIZE.md, the int8 lane's second "
+    "job). Every decode load_model without an explicit draft= uses it; "
+    "empty (default) serves decode models without speculation. The "
+    "draft is fit-checked by the ANALYSIS.md admission gate alongside "
+    "the target (both KV slot tables count).")
 DEFINE_bool(
     "compile_cache", True,
     "Persistent compile/artifact cache (COMPILE_CACHE.md): Predictor "

@@ -3,8 +3,9 @@ from .predictor import (
     create_paddle_predictor, AotPredictor, load_aot_predictor,
 )
 from .decode import (
-    GenerativePredictor, DecodeSession, save_decode_model,
-    build_tiny_decode_model, load_decode_predictor, greedy_decode,
+    GenerativePredictor, DecodeSession, SpeculativeDecodeSession,
+    save_decode_model, build_tiny_decode_model, load_decode_predictor,
+    greedy_decode, set_draft_poison,
 )
 from .quantize import (
     quantize_inference_model, read_quant_meta, is_quantized_dir,
@@ -15,7 +16,8 @@ from .quantize import (
 __all__ = [
     "NativeConfig", "AnalysisConfig", "PaddleTensor", "Predictor",
     "create_paddle_predictor", "AotPredictor", "load_aot_predictor",
-    "GenerativePredictor", "DecodeSession", "save_decode_model",
+    "GenerativePredictor", "DecodeSession", "SpeculativeDecodeSession",
+    "save_decode_model", "set_draft_poison",
     "build_tiny_decode_model", "load_decode_predictor", "greedy_decode",
     "quantize_inference_model", "read_quant_meta", "is_quantized_dir",
     "verify_quantized_dir", "check_quantized_dir", "artifact_precision",

@@ -38,19 +38,38 @@ decode kernel (`ops/pallas_kernels.decode_attention` — block geometry
 from the shared kernel-tuning registry); sampling is greedy argmax
 (deterministic — the parity contract above is exact equality, not
 "close").
+
+**Speculative decoding** (`SpeculativeDecodeSession`, SERVING.md
+"Speculative decoding"): a cheap *draft* GenerativePredictor (the int8
+twin of the same artifact, or any vocab-compatible decode artifact)
+autoregressively proposes k tokens per round, and the fp32 *target*
+scores all k+1 positions in ONE fixed-shape batched verify step (its
+executable is one new compile-cache fingerprint per (n_slots, k)).
+The longest greedily-agreeing prefix commits to the target's KV slot
+cache; rejected suffixes roll the slot's length pointer back with the
+stale KV rows zeroed in-graph.  Greedy acceptance keeps the committed
+stream BIT-IDENTICAL to the fp32-only plain-step stream: every emitted
+token is a target argmax, and the verify step attends through the SAME
+`decode_attention` kernel the plain step runs (each chunk position is
+a pseudo-slot with its own length mask), so verify logits round
+exactly like sequential step logits.  A draft failure mid-round
+degrades the session to target-only plain decode within that same
+step (`degraded`), never wedging or corrupting a stream.
 """
 
 import hashlib
 import json
 import os
 import threading
+import time
 import warnings
 
 import numpy as np
 
-__all__ = ["GenerativePredictor", "DecodeSession", "save_decode_model",
+__all__ = ["GenerativePredictor", "DecodeSession",
+           "SpeculativeDecodeSession", "save_decode_model",
            "build_tiny_decode_model", "load_decode_predictor",
-           "greedy_decode", "DECODE_META"]
+           "greedy_decode", "set_draft_poison", "DECODE_META"]
 
 DECODE_META = "decode_meta.bin"
 _DECODE_STATE = "decode_state.bin"
@@ -59,6 +78,31 @@ _DECODE_STATE = "decode_state.bin"
 # function cannot ride the export/serialize path — every clone falls
 # back to direct jit without retrying the export
 _UNEXPORTABLE = object()
+
+# chaos hook (tools/chaos.py spec-fallback scenario): once armed, the
+# draft side of every SpeculativeDecodeSession raises after the given
+# number of further draft steps — the in-process stand-in for a dead /
+# poisoned draft predictor.  The session must degrade to target-only
+# decode within the same round, bit-exact and un-wedged.
+_DRAFT_POISON = {"after": None, "steps": 0}
+
+
+def set_draft_poison(after_steps=0):
+    """Arm (int: poison fires once `after_steps` more draft steps have
+    run) or disarm (None) the draft-failure chaos injection."""
+    _DRAFT_POISON["after"] = None if after_steps is None \
+        else int(after_steps)
+    _DRAFT_POISON["steps"] = 0
+
+
+def _check_draft_poison():
+    after = _DRAFT_POISON["after"]
+    if after is None:
+        return
+    _DRAFT_POISON["steps"] += 1
+    if _DRAFT_POISON["steps"] > after:
+        raise RuntimeError("chaos: draft predictor poisoned "
+                           "(set_draft_poison)")
 
 
 def _default_prefill_buckets(max_seq_len):
@@ -199,6 +243,10 @@ class GenerativePredictor:
                            for n, v in self._state_host.items()}
         self._fns = {}          # per-instance resolved callables
         self._lock = threading.Lock()
+        # prompt lengths past every configured prefill bucket that have
+        # already warned (once per size, under _lock — the Predictor
+        # batch-bucket overflow parity)
+        self._overflow_warned = set()
 
     # -- meta surface ---------------------------------------------------
 
@@ -233,15 +281,34 @@ class GenerativePredictor:
 
     def prompt_bucket(self, prompt_len):
         """Smallest prefill bucket >= prompt_len (deterministic by
-        length — the parity contract rides this)."""
-        for b in self.prefill_buckets():
+        length — the parity contract rides this).  A prompt past every
+        configured bucket but still inside the cache falls through to
+        an exact-length one-off prefill compile, warning ONCE per
+        overflow size — the same contract as the Predictor batch-bucket
+        overflow path (SERVING.md)."""
+        buckets = self.prefill_buckets()
+        for b in buckets:
             if prompt_len <= b:
                 return b
-        raise ValueError(
-            "prompt of %d tokens exceeds the largest prefill bucket %d "
-            "(max_seq_len %d)" % (prompt_len,
-                                  self.prefill_buckets()[-1],
-                                  self.max_seq_len))
+        if prompt_len > self.max_seq_len:
+            raise ValueError(
+                "prompt of %d tokens exceeds max_seq_len %d"
+                % (prompt_len, self.max_seq_len))
+        if prompt_len not in self._overflow_warned:
+            with self._lock:
+                # concurrent lanes racing the same overflow size must
+                # produce exactly one warning (the PR 5 warn-once race)
+                if prompt_len in self._overflow_warned:
+                    return int(prompt_len)
+                self._overflow_warned.add(prompt_len)
+            warnings.warn(
+                "prompt of %d tokens exceeds every configured prefill "
+                "bucket %s — falling through to an unbucketed exact-"
+                "length prefill compile; extend prefill_buckets to "
+                "avoid a compile per distinct overflow length"
+                % (prompt_len, tuple(buckets)), RuntimeWarning,
+                stacklevel=3)
+        return int(prompt_len)
 
     def clone_to(self, device):
         return GenerativePredictor(None, device=device, _clone_of=self)
@@ -338,6 +405,81 @@ class GenerativePredictor:
         logits = _ln(x, state["lnf_g"], state["lnf_b"]) @ state["lm_head"]
         new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return new_tok, jnp.stack(kcs), jnp.stack(vcs)
+
+    def _verify_math(self, state, kc, vc, lengths, tokens, active):
+        """One speculative VERIFY step over the whole slot table:
+        tokens [N, C] = [pending last token, draft d1..dk] (C = k+1),
+        -> (g [N, C] target greedy tokens per position, m [N] accepted
+        draft counts 0..k, kc', vc').
+
+        Scores all C positions in one fixed-shape launch: the chunk's
+        Q/K/V come from ONE batched projection (weights stream once for
+        all C positions — the step-latency/bandwidth win), all C rows
+        land in the slot cache first (the step path's write-before-
+        attend order), and every chunk position then attends through
+        the SAME `decode_attention` kernel the plain decode step runs —
+        position j is a pseudo-slot over the same S-length cache axis
+        masked to length+j+1.  Same kernel, same axis geometry, same
+        masking semantics => verify logits round exactly like the
+        sequential plain-step logits, which is what makes greedy
+        acceptance bit-exact against the fp32-only stream.
+
+        Acceptance and rollback are in-graph: m = longest prefix with
+        d_i == g_{i-1}; rows past length+m (the rejected suffix) are
+        zeroed before the caches return, so stale draft K/V never
+        survives into the committed cache."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas_kernels import decode_attention
+        L, H, Dh, D = self._dims()
+        N, C = tokens.shape
+        S = kc.shape[2]
+        scale = 1.0 / np.sqrt(Dh)
+        pos_idx = lengths[:, None] + jnp.arange(C)[None]        # [N, C]
+        x = state["embed"][tokens] + state["pos"][pos_idx]      # [N,C,D]
+        write = (jnp.arange(S)[None, None, :]
+                 == pos_idx[:, :, None]) & active[:, None, None]
+        written = jnp.any(write, axis=1)[:, :, None, None]      # [N,S,1,1]
+        qlens = (pos_idx + 1).reshape(N * C).astype(jnp.int32)
+        kcs, vcs = [], []
+        for i in range(L):
+            p = "l%d_" % i
+            h = _ln(x, state[p + "ln1_g"], state[p + "ln1_b"])
+            q = (h @ state[p + "wq"]).reshape(N, C, H, Dh)
+            k_new = (h @ state[p + "wk"]).reshape(N, C, H, Dh)
+            v_new = (h @ state[p + "wv"]).reshape(N, C, H, Dh)
+            # land all C rows (positions are distinct, so the scatter
+            # contraction adds exact zeros around one exact value)
+            wf = write.astype(k_new.dtype)
+            kci = jnp.where(written,
+                            jnp.einsum("ncs,nchd->nshd", wf, k_new),
+                            kc[i])
+            vci = jnp.where(written,
+                            jnp.einsum("ncs,nchd->nshd", wf, v_new),
+                            vc[i])
+            kx = jnp.broadcast_to(
+                kci[:, None], (N, C, S, H, Dh)).reshape(N * C, S, H, Dh)
+            vx = jnp.broadcast_to(
+                vci[:, None], (N, C, S, H, Dh)).reshape(N * C, S, H, Dh)
+            att = decode_attention(q.reshape(N * C, H, Dh), kx, vx,
+                                   qlens, scale=scale)
+            x = x + att.reshape(N, C, D) @ state[p + "wo"]
+            h2 = _ln(x, state[p + "ln2_g"], state[p + "ln2_b"])
+            x = x + jnp.maximum(h2 @ state[p + "w1"] + state[p + "b1"],
+                                0.0) @ state[p + "w2"] + state[p + "b2"]
+            kcs.append(kci)
+            vcs.append(vci)
+        logits = _ln(x, state["lnf_g"], state["lnf_b"]) @ state["lm_head"]
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [N, C]
+        match = (tokens[:, 1:] == g[:, :C - 1]).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(match, axis=1), axis=1).astype(jnp.int32)
+        # rejected suffix: the committed cache keeps rows for the
+        # pending token + the m accepted drafts (length + m + 1 rows
+        # total); everything this step wrote past that is zeroed
+        posS = jnp.arange(S)[None, :]
+        stale = (posS >= (lengths + m + 1)[:, None]) \
+            & (posS < (lengths + C)[:, None]) & active[:, None]
+        keep = (~stale)[None, :, :, None, None].astype(jnp.float32)
+        return g, m, jnp.stack(kcs) * keep, jnp.stack(vcs) * keep
 
     # -- compiled-phase resolution (the PR 6 compile-cache ride) --------
 
@@ -464,6 +606,24 @@ class GenerativePredictor:
         return self._resolve(("step", int(n_slots)), self._step_math,
                              specs)
 
+    def verify_fn(self, n_slots, spec_k):
+        """The speculative-verify executable for a (slot table,
+        draft depth) pair: scores k+1 positions per slot in one launch.
+        One new compile-cache fingerprint per (n_slots, k) — a warm
+        boot of a spec-configured server deserializes it like every
+        other phase (COMPILE_CACHE.md)."""
+        import jax
+        L, H, Dh, _ = self._dims()
+        S = self.max_seq_len
+        n, C = int(n_slots), int(spec_k) + 1
+        cache = jax.ShapeDtypeStruct((L, n, S, H, Dh),
+                                     np.dtype(np.float32))
+        specs = (cache, cache,
+                 jax.ShapeDtypeStruct((n,), np.dtype(np.int32)),
+                 jax.ShapeDtypeStruct((n, C), np.dtype(np.int32)),
+                 jax.ShapeDtypeStruct((n,), np.dtype(bool)))
+        return self._resolve(("verify", n, C), self._verify_math, specs)
+
     def new_session(self, n_slots):
         return DecodeSession(self, n_slots)
 
@@ -576,12 +736,275 @@ class DecodeSession:
         self.last_tokens[slot] = 0
         self.active[slot] = False
 
+    def rollback(self, slot, n, last_token=None):
+        """Roll `slot` back by `n` cached positions: the length pointer
+        retreats and the rolled-back KV rows are ZEROED, so the slot is
+        bit-identical to one that never advanced past the restored
+        length (pinned by tests/test_spec_decode.py).  `last_token`,
+        when given, restores the slot's pending token alongside — a
+        full rewind needs both, since the pending token is the one
+        committed token whose K/V is not in the cache yet.
+
+        The speculative decoder's draft-side sync is built on this: a
+        partially-accepted round rolls the draft's rejected rows back
+        and re-pins its pending token to the target's correction."""
+        import jax.lax
+        import jax.numpy as jnp
+        slot, n = int(slot), int(n)
+        if n < 0:
+            raise ValueError("rollback of %d positions" % n)
+        length = int(self.lengths[slot])
+        if n > length:
+            raise ValueError(
+                "rollback of %d positions on slot %d with only %d "
+                "cached" % (n, slot, length))
+        if n > 0:
+            L = self._kc.shape[0]
+            H, Dh = self._kc.shape[3], self._kc.shape[4]
+            z = self._put(jnp.zeros((L, 1, n, H, Dh), jnp.float32))
+            at = (0, slot, length - n, 0, 0)
+            self._kc = jax.lax.dynamic_update_slice(self._kc, z, at)
+            self._vc = jax.lax.dynamic_update_slice(self._vc, z, at)
+            self.lengths[slot] = length - n
+        if last_token is not None:
+            self.last_tokens[slot] = np.int32(last_token)
+
     def slot_is_zero(self, slot):
         """True when the slot's K and V cache lines are exact zeros —
         the test hook for the zero-before-reuse contract."""
         k = np.asarray(self._kc[:, slot])
         v = np.asarray(self._vc[:, slot])
         return bool(not k.any() and not v.any())
+
+
+class SpeculativeDecodeSession:
+    """Draft-and-verify generation over one slot table (SERVING.md
+    "Speculative decoding"): pairs the fp32 *target* predictor with a
+    cheap *draft* predictor (the int8 twin of the same artifact, or any
+    decode artifact sharing its vocab/eos) and advances every occupied
+    slot 1..k+1 committed tokens per round:
+
+      1. DRAFT: k batched draft decode steps propose d1..dk per slot
+         (the draft keeps its own KV slot table, mirroring the
+         committed stream);
+      2. VERIFY: the target scores all k+1 positions in ONE fixed-shape
+         batched step (`GenerativePredictor.verify_fn`) — acceptance
+         and stale-row zeroing happen in-graph;
+      3. COMMIT: the longest greedily-agreeing prefix (plus the
+         target's correction/bonus token) commits to the target cache;
+         the draft rolls its rejected rows back (`DecodeSession.
+         rollback`) — or runs one catch-up step after a fully-accepted
+         round — so both tables mirror the committed stream again.
+
+    Every committed token is a TARGET argmax, so the stream is
+    bit-identical to target-only plain decode; the draft only ever
+    changes how many steps that stream costs.  Any draft failure
+    (`set_draft_poison`, a dead predictor, an incompatible state)
+    degrades the session to target-only plain rounds within the same
+    step — `degraded` latches, the stream never stalls or corrupts.
+
+    Duck-types the DecodeSession surface the DecodeBatcher drives
+    (prefill/free/room/free_slots/occupancy/decode), plus `step()` —
+    the variable-accept round returning (tokens [N, k+1], counts [N]).
+    NOT thread-safe, same single-owner contract as DecodeSession."""
+
+    def __init__(self, target, draft, n_slots, spec_k):
+        if int(spec_k) < 1:
+            raise ValueError("spec_k must be >= 1, got %r" % (spec_k,))
+        if draft.vocab_size != target.vocab_size:
+            raise ValueError(
+                "draft vocab %d != target vocab %d — not a compatible "
+                "draft artifact" % (draft.vocab_size, target.vocab_size))
+        if draft.eos_id != target.eos_id:
+            raise ValueError(
+                "draft eos_id %d != target eos_id %d"
+                % (draft.eos_id, target.eos_id))
+        if draft.max_seq_len < target.max_seq_len:
+            raise ValueError(
+                "draft max_seq_len %d < target max_seq_len %d — the "
+                "draft cache cannot mirror the committed stream"
+                % (draft.max_seq_len, target.max_seq_len))
+        self.predictor = target
+        self.draft_predictor = draft
+        self.spec_k = int(spec_k)
+        self.n_slots = int(n_slots)
+        self.session = target.new_session(n_slots)
+        self.draft_session = draft.new_session(n_slots)
+        self._degraded = False
+        self.degrade_error = None
+        # accept telemetry the serving layer rolls up per round
+        self.rounds = 0          # verify launches
+        self.plain_steps = 0     # fallback/degraded plain rounds
+        self.proposed = 0        # draft tokens offered to verify
+        self.accepted = 0        # draft tokens accepted
+        self.last_spec = False   # did the latest round verify?
+        self.last_draft_end = None   # monotonic draft->verify boundary
+
+    # -- DecodeSession surface (the batcher's contract) -----------------
+
+    @property
+    def steps(self):
+        return self.session.steps
+
+    @property
+    def degraded(self):
+        return self._degraded
+
+    def free_slots(self):
+        return self.session.free_slots()
+
+    def occupancy(self):
+        return self.session.occupancy()
+
+    def room(self, slot):
+        return self.session.room(slot)
+
+    def slot_is_zero(self, slot):
+        return self.session.slot_is_zero(slot)
+
+    def _degrade(self, exc):
+        self._degraded = True
+        if self.degrade_error is None:
+            self.degrade_error = "%s: %s" % (type(exc).__name__, exc)
+
+    def prefill(self, slot, tokens):
+        """Prefill BOTH tables; the draft's own first-token prediction
+        is discarded — its pending token is re-pinned to the target's
+        (the committed stream is always the target's)."""
+        first = self.session.prefill(slot, tokens)
+        if not self._degraded:
+            try:
+                _check_draft_poison()
+                self.draft_session.prefill(slot, tokens)
+                self.draft_session.last_tokens[slot] = np.int32(first)
+            except BaseException as e:
+                self._degrade(e)
+        return first
+
+    def free(self, slot):
+        self.session.free(slot)
+        if self.draft_session.active[slot]:
+            self.draft_session.free(slot)
+
+    def decode(self):
+        """Plain target-only step (the greedy_decode/static-baseline
+        surface); keeps the draft synced so a later spec round starts
+        from a mirrored table."""
+        toks, _ = self.step(force_plain=True)
+        return toks[:, 0]
+
+    # -- the speculative round ------------------------------------------
+
+    def _draft_catchup(self, mask, pins, draft_delay=0.0):
+        """Advance the draft one step for `mask` slots (consuming their
+        pending token, landing its KV row) and re-pin their pending
+        tokens to the committed stream's (`pins` [N])."""
+        ds = self.draft_session
+        saved = ds.active
+        try:
+            _check_draft_poison()
+            if draft_delay:
+                time.sleep(draft_delay)
+            ds.active = mask
+            ds.decode()
+        except BaseException as e:
+            self._degrade(e)
+            return
+        finally:
+            ds.active = saved
+        for s in np.nonzero(mask)[0]:
+            ds.last_tokens[s] = np.int32(pins[s])
+
+    def step(self, step_delay=0.0, draft_delay=0.0, force_plain=False):
+        """One round over the slot table.  Returns (tokens [N, k+1]
+        int32, counts [N] int32): slot s committed `counts[s]` tokens
+        this round, `tokens[s, :counts[s]]` in stream order (counts is
+        0 for inactive slots, 1 for plain rounds, 1..k+1 for spec
+        rounds).  `step_delay`/`draft_delay` are the bench/chaos
+        per-launch device-cost stand-ins (GIL-released sleeps before
+        the verify/plain step and before each draft step).
+
+        A round runs speculatively unless the session is degraded,
+        `force_plain` is set, or some occupied slot lacks the k+1 cache
+        rows a verify writes — those rounds fall back to ONE plain
+        target step for every slot (progress is never blocked by a
+        nearly-full slot), with a draft catch-up step keeping the
+        tables mirrored."""
+        ts = self.session
+        k = self.spec_k
+        C = k + 1
+        N = self.n_slots
+        active = ts.active.copy()
+        occupied = np.nonzero(active)[0]
+        spec_ok = (not force_plain and not self._degraded
+                   and occupied.size > 0
+                   and all(ts.room(int(s)) >= C for s in occupied))
+        self.last_spec = False
+        drafts = []
+        if spec_ok:
+            ds = self.draft_session
+            try:
+                for _ in range(k):
+                    _check_draft_poison()
+                    if draft_delay:
+                        time.sleep(draft_delay)
+                    drafts.append(np.asarray(ds.decode()))
+            except BaseException as e:
+                # draft died mid-round: discard its proposals and keep
+                # the stream moving with a plain target step THIS round
+                self._degrade(e)
+                spec_ok = False
+        if spec_ok:
+            self.last_draft_end = time.monotonic()
+            if step_delay:
+                time.sleep(step_delay)
+            chunk = np.zeros((N, C), np.int32)
+            chunk[:, 0] = ts.last_tokens
+            for j in range(k):
+                chunk[:, j + 1] = drafts[j]
+            fn = self.predictor.verify_fn(N, k)
+            g, m, ts._kc, ts._vc = fn(
+                self.predictor._state, ts._kc, ts._vc,
+                ts._put(ts.lengths), ts._put(chunk),
+                ts._put(active))
+            g = np.asarray(g)
+            m = np.where(active, np.asarray(m), 0).astype(np.int32)
+            counts = np.where(active, m + 1, 0).astype(np.int32)
+            ts.lengths = (ts.lengths + counts).astype(np.int32)
+            ts.last_tokens = np.where(
+                active, g[np.arange(N), np.minimum(m, k)],
+                ts.last_tokens).astype(np.int32)
+            ts.steps += 1
+            # draft sync: rejected rows roll back; fully-accepted slots
+            # owe the draft one catch-up row (it emitted d_k without
+            # ever consuming it)
+            if not self._degraded:
+                for s in occupied:
+                    s = int(s)
+                    if m[s] < k:
+                        self.draft_session.rollback(
+                            s, k - 1 - int(m[s]),
+                            last_token=int(g[s, m[s]]))
+                full = active & (m == k)
+                if full.any():
+                    self._draft_catchup(full, g[:, k],
+                                        draft_delay=draft_delay)
+            self.rounds += 1
+            self.proposed += k * occupied.size
+            self.accepted += int(m[occupied].sum())
+            self.last_spec = True
+            return g, counts
+        # plain fallback round: one target step, every occupied slot
+        # advances exactly one token (degraded mode lives here)
+        if step_delay:
+            time.sleep(step_delay)
+        toks1 = ts.decode()
+        self.plain_steps += 1
+        if not self._degraded and active.any():
+            self._draft_catchup(active, toks1, draft_delay=draft_delay)
+        out = np.zeros((N, C), np.int32)
+        out[:, 0] = toks1
+        return out, active.astype(np.int32)
 
 
 def load_decode_predictor(dirname):

@@ -37,12 +37,18 @@ _SERVING_COUNTERS = ("requests", "responses", "errors", "shed",
                      "deadline_expired", "dispatches",
                      # generation counters (absent for one-shot models)
                      "streams", "prefills", "decode_tokens",
-                     "decode_steps")
+                     "decode_steps",
+                     # speculative decoding (absent without a draft)
+                     "spec_rounds", "draft_tokens", "accepted_tokens",
+                     "spec_degraded")
 # ... and floats rendered as labeled gauges
 _SERVING_GAUGES = ("qps_recent", "qps_lifetime", "batch_fill",
                    "bucket_fill_ratio", "queue_depth",
                    # continuous-batching decode gauges (SERVING.md)
-                   "tokens_per_sec", "slot_occupancy")
+                   "tokens_per_sec", "slot_occupancy",
+                   # lifetime draft accept fraction (SERVING.md
+                   # speculative decoding — the speedup dial)
+                   "spec_accept_rate")
 _SERVING_HISTS = ("latency_ms", "queue_wait_ms", "ttft_ms")
 _QUANTILES = ("p50", "p95", "p99")
 

@@ -19,7 +19,7 @@ coalescing, load shedding) from the training runtime's.
 
 from .batcher import (BatcherClosed, DeadlineExceeded, DecodeBatcher,
                       DecodeStream, DynamicBatcher, ServerOverloaded,
-                      set_dispatch_delay)
+                      set_dispatch_delay, set_draft_delay)
 from .metrics import (Counter, ModelMetrics, ReservoirHistogram,
                       ServingMetrics)
 from .model_registry import (ModelEntry, ModelRegistry, open_predictor,
@@ -29,7 +29,7 @@ from .server import InferenceServer, ServingClient, ServingError
 __all__ = [
     "DynamicBatcher", "DecodeBatcher", "DecodeStream",
     "ServerOverloaded", "DeadlineExceeded",
-    "BatcherClosed", "set_dispatch_delay",
+    "BatcherClosed", "set_dispatch_delay", "set_draft_delay",
     "Counter", "ReservoirHistogram", "ModelMetrics", "ServingMetrics",
     "ModelRegistry", "ModelEntry", "open_predictor",
     "resolve_placement",

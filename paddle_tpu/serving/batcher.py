@@ -70,7 +70,7 @@ from ..obs import tracing as obs_tracing
 
 __all__ = ["DynamicBatcher", "DecodeBatcher", "DecodeStream",
            "ServerOverloaded", "DeadlineExceeded", "BatcherClosed",
-           "set_dispatch_delay"]
+           "set_dispatch_delay", "set_draft_delay"]
 
 _CHAOS_ENV = "PADDLE_TPU_SERVING_CHAOS"
 
@@ -109,19 +109,39 @@ def set_dispatch_delay(secs):
     _dispatch_delay = float(secs)
 
 
-def _chaos_delay():
-    if _dispatch_delay:
-        return _dispatch_delay
+def _chaos_delay(key="dispatch_delay", direct=None):
+    if direct is None:
+        direct = _dispatch_delay
+    if direct:
+        return direct
     spec = os.environ.get(_CHAOS_ENV)
     if spec:
         for part in spec.split(","):
             name, _, val = part.partition("=")
-            if name.strip() == "dispatch_delay":
+            if name.strip() == key:
                 try:
                     return float(val)
                 except ValueError:
                     pass
     return 0.0
+
+
+_draft_delay = 0.0
+
+
+def set_draft_delay(secs):
+    """Per-DRAFT-step stand-in cost for speculative decode lanes (the
+    companion of set_dispatch_delay, which prices the target/verify
+    step): every draft decode step sleeps `secs` first, GIL released.
+    bench_serving --draft_cost_ms rides this — with the int8 twin as
+    the draft, ~0.3x the target step cost is the honest BENCH_r11
+    weight-bytes ratio (0 clears)."""
+    global _draft_delay
+    _draft_delay = float(secs)
+
+
+def _draft_chaos_delay():
+    return _chaos_delay(key="draft_delay", direct=_draft_delay)
 
 
 def _predictor_device_label(predictor):
@@ -808,18 +828,29 @@ class _DecodeRequest:
 
 class _DecodeLane:
     """One replica's decode lane: its slot-table session plus the
-    slot -> request assignment the continuous loop walks."""
+    slot -> request assignment the continuous loop walks.  With a
+    draft replica and spec_k >= 1 the session is a
+    SpeculativeDecodeSession — the lane advances slots 1..k+1 tokens
+    per round instead of exactly one."""
 
     __slots__ = ("index", "predictor", "session", "assigned", "steps",
-                 "tokens")
+                 "tokens", "spec", "degraded_noted")
 
-    def __init__(self, index, predictor, n_slots):
+    def __init__(self, index, predictor, n_slots, draft=None, spec_k=0):
         self.index = index
         self.predictor = predictor
-        self.session = predictor.new_session(n_slots)
+        if draft is not None and int(spec_k) >= 1:
+            from ..inference.decode import SpeculativeDecodeSession
+            self.session = SpeculativeDecodeSession(
+                predictor, draft, n_slots, spec_k)
+            self.spec = True
+        else:
+            self.session = predictor.new_session(n_slots)
+            self.spec = False
         self.assigned = {}   # slot -> _DecodeRequest
         self.steps = 0
         self.tokens = 0
+        self.degraded_noted = False
 
 
 class DecodeBatcher:
@@ -834,11 +865,22 @@ class DecodeBatcher:
     lanes compare against: a lane only admits when it is idle, takes a
     full batch, and decodes until the LAST member finishes — the
     pre-continuous-batching serving shape (bench_zoo
-    serving_decode_static)."""
+    serving_decode_static).
+
+    With ``draft_replicas``/``spec_k`` (SERVING.md "Speculative
+    decoding") each lane runs a SpeculativeDecodeSession: per round the
+    draft proposes k tokens, one batched target verify step scores all
+    k+1 positions, and slots advance 1..k+1 committed tokens — the
+    per-slot variable-accept bookkeeping below consumes each commit
+    list in stream order with per-token EOS/max-new cuts, so the wire
+    stream is bit-identical to the one-token-per-step path.  Draft
+    failure degrades the lane to target-only decode within one round
+    (`spec_degraded` event + counter), never wedging a stream."""
 
     def __init__(self, predictor, replicas=None, n_slots=None,
                  max_queue=None, metrics=None, max_new_tokens=None,
-                 continuous=True):
+                 continuous=True, draft=None, draft_replicas=None,
+                 spec_k=None):
         preds = list(replicas) if replicas else [predictor]
         self.predictor = predictor if predictor is not None else preds[0]
         self.n_slots = max(int(FLAGS.serving_decode_slots
@@ -850,9 +892,26 @@ class DecodeBatcher:
                                    else max_new_tokens), 1)
         self.continuous = bool(continuous)
         self.metrics = metrics
+        # speculative decoding (SERVING.md): one draft predictor per
+        # replica lane (`draft_replicas`, or one shared `draft` for the
+        # single-lane shape); spec_k is the draft depth per round
+        self.spec_k = int(FLAGS.serving_spec_k if spec_k is None
+                          else spec_k)
+        drafts = list(draft_replicas) if draft_replicas else (
+            [draft] * len(preds) if draft is not None else None)
+        if drafts is not None and len(drafts) != len(preds):
+            raise ValueError(
+                "%d draft replicas for %d target replicas — the spec "
+                "lanes pair one draft per target"
+                % (len(drafts), len(preds)))
+        if not drafts or self.spec_k < 1:
+            drafts, self.spec_k = None, 0
+        self.draft_replicas = drafts
         self._cv = threading.Condition()
         self._pending = collections.deque()
-        self._lanes = [_DecodeLane(i, p, self.n_slots)
+        self._lanes = [_DecodeLane(i, p, self.n_slots,
+                                   draft=(drafts[i] if drafts else None),
+                                   spec_k=self.spec_k)
                        for i, p in enumerate(preds)]
         self._closing = False
         self._stopped = False
@@ -1124,6 +1183,45 @@ class DecodeBatcher:
             req.stream._put_tokens(req.buf)
             req.buf = []
 
+    def _emit_step_spans(self, lane, t0, t_draft_end, now, n_slots,
+                         accepted=None):
+        """Per-round step spans: `serving/decode_step` always; on a
+        speculative round its `serving/draft` + `serving/verify`
+        children are cut from the same contiguous monotonic stamps so
+        they TILE the round exactly (draft end == verify start).  One
+        time.time() anchor places them on the wall-clock axis; every
+        duration rides the monotonic stamps."""
+        wall_now = time.time()
+        attrs = {"model": self._model_name or "", "replica": lane.index,
+                 "slots": n_slots}
+
+        def _mk(name, a, b, **extra):
+            at = dict(attrs)
+            at.update(extra)
+            obs_tracing.add_span(obs_tracing.Span(
+                name, kind="serving", ts=wall_now - (now - a),
+                dur_ms=(max(b, a) - a) * 1e3, attrs=at))
+
+        if t_draft_end is not None:
+            _mk("serving/draft", t0, t_draft_end,
+                spec_k=lane.session.spec_k)
+            _mk("serving/verify", t_draft_end, now, accepted=accepted)
+        _mk("serving/decode_step", t0, now)
+
+    def _note_degraded(self, lane):
+        """First observation of a degraded spec session: latch the obs
+        event + counter exactly once per lane (the chaos spec-fallback
+        scenario pins both)."""
+        if lane.degraded_noted or not lane.spec \
+                or not lane.session.degraded:
+            return
+        lane.degraded_noted = True
+        if self.metrics is not None:
+            self.metrics.spec_degraded.add()
+        obs_events.emit("spec_degraded", model=self._model_name,
+                        replica=lane.index,
+                        error=str(lane.session.degrade_error or ""))
+
     def _lane_loop(self, lane):
         sess = lane.session
         eos = self.predictor.eos_id
@@ -1141,33 +1239,64 @@ class DecodeBatcher:
             for req in admits:
                 self._prefill(lane, req)
             if not lane.assigned:
+                self._note_degraded(lane)
                 continue
+            n_act = len(lane.assigned)
             t0 = time.monotonic()
+            # the same slow-worker chaos hook / deterministic per-step
+            # device-cost stand-in as the one-shot lanes
+            # (set_dispatch_delay — bench_serving --step_cost_ms; the
+            # draft steps of a spec round price separately via
+            # set_draft_delay — bench_serving --draft_cost_ms)
             delay = _chaos_delay()
-            if delay:
-                # the same slow-worker chaos hook / deterministic
-                # per-step device-cost stand-in as the one-shot lanes
-                # (set_dispatch_delay — bench_serving --step_cost_ms)
-                time.sleep(delay)
-            toks = sess.decode()
+            if lane.spec:
+                toks2d, counts = sess.step(
+                    step_delay=delay,
+                    draft_delay=_draft_chaos_delay())
+                spec_round = sess.last_spec
+            else:
+                if delay:
+                    time.sleep(delay)
+                toks = sess.decode()
+                spec_round = False
             now = time.monotonic()
             lane.steps += 1
             if self.metrics is not None:
                 self.metrics.decode_steps.add()
+                if spec_round:
+                    # per-round accept telemetry: k proposals per
+                    # occupied slot, counts[s]-1 of them accepted
+                    proposed = sess.spec_k * n_act
+                    accepted = int(counts.sum()) - n_act
+                    self.metrics.note_spec(proposed, accepted)
+            self._note_degraded(lane)
             if obs_tracing.enabled():
-                obs_tracing.add_span(obs_tracing.Span(
-                    "serving/decode_step", kind="serving",
-                    ts=time.time() - (now - t0),
-                    dur_ms=(now - t0) * 1e3,
-                    attrs={"model": self._model_name or "",
-                           "replica": lane.index,
-                           "slots": len(lane.assigned)}))
+                self._emit_step_spans(
+                    lane, t0,
+                    sess.last_draft_end if spec_round else None, now,
+                    n_act,
+                    accepted=(int(counts.sum()) - n_act)
+                    if spec_round else None)
             emitted = 0
             for slot, req in list(lane.assigned.items()):
-                tok = int(toks[slot])
-                req.gen.append(tok)
-                req.buf.append(tok)
-                emitted += 1
+                # a spec round commits 1..k+1 tokens per slot; consume
+                # them in stream order with per-token EOS/max-new cuts
+                # so the emitted stream is bit-identical to the plain
+                # one-token-per-step path
+                slot_toks = [int(toks2d[slot, j])
+                             for j in range(int(counts[slot]))] \
+                    if lane.spec else [int(toks[slot])]
+                finished = None
+                for tok in slot_toks:
+                    req.gen.append(tok)
+                    req.buf.append(tok)
+                    emitted += 1
+                    if tok == eos:
+                        finished = "eos"
+                        break
+                    if len(req.gen) >= req.max_new:
+                        finished = "length"
+                        break
                 if req.stream.cancelled():
                     # client gone: nobody reads the flush — just free
                     req.buf = []
@@ -1176,11 +1305,10 @@ class DecodeBatcher:
                 if req.deadline is not None and now > req.deadline:
                     self._expire(lane, slot, req, now)
                     continue
-                if tok == eos:
-                    self._finish(lane, slot, req, "eos")
-                elif len(req.gen) >= req.max_new or \
-                        sess.room(slot) <= 0:
-                    self._finish(lane, slot, req, "length")
+                if finished is None and sess.room(slot) <= 0:
+                    finished = "length"
+                if finished is not None:
+                    self._finish(lane, slot, req, finished)
                 elif len(req.buf) >= req.chunk:
                     req.stream._put_tokens(req.buf)
                     req.buf = []

@@ -143,6 +143,16 @@ class ModelMetrics:
         self.decode_tokens = Counter()   # generated tokens emitted
         self.decode_steps = Counter()    # whole-slot-table step launches
         self.ttft_ms = ReservoirHistogram()  # time to first token
+        # speculative decoding (SERVING.md): drafts/accepts telemetry —
+        # the accept rate IS the speedup dial (tokens per verify step =
+        # 1 + accepted/round), and with a same-weights draft it doubles
+        # as a bit-exactness probe (any verify-vs-step numeric drift
+        # shows up as a rejected draft before it shows up anywhere else)
+        self.spec_rounds = Counter()     # draft->verify rounds run
+        self.draft_tokens = Counter()    # draft proposals offered
+        self.accepted_tokens = Counter()  # proposals accepted by verify
+        self.spec_degraded = Counter()   # lanes fallen back target-only
+        self.accept_rate = ReservoirHistogram()  # per-round accept frac
         self._token_stamps = collections.deque()  # (t, n) recent window
         self.queue_depth_fn = None
         # installed by the batcher: live per-replica lane snapshot
@@ -196,6 +206,15 @@ class ModelMetrics:
         load; a hot swap overwrites with the new artifact's)."""
         self.est_peak_mb = float(est_peak_mb)
         self.est_flops = int(est_flops)
+
+    def note_spec(self, proposed, accepted):
+        """One speculative round: `proposed` draft tokens offered to
+        the verify step, `accepted` of them greedily accepted."""
+        self.spec_rounds.add()
+        if proposed:
+            self.draft_tokens.add(int(proposed))
+            self.accepted_tokens.add(int(accepted))
+            self.accept_rate.record(accepted / proposed)
 
     def note_prefill(self, ttft_ms):
         """One prefill completed: the request's first token exists —
@@ -310,6 +329,18 @@ class ModelMetrics:
                     snap["decode_slots_busy"] = int(occupied)
                 except Exception:
                     snap["slot_occupancy"] = -1.0
+        if self.spec_rounds.value or self.spec_degraded.value:
+            # speculative decoding telemetry (serving_top's ACC%
+            # column, Prometheus spec_* families)
+            proposed = self.draft_tokens.value
+            snap["spec_rounds"] = self.spec_rounds.value
+            snap["draft_tokens"] = proposed
+            snap["accepted_tokens"] = self.accepted_tokens.value
+            snap["spec_degraded"] = self.spec_degraded.value
+            snap["spec_accept_rate"] = round(
+                self.accepted_tokens.value / proposed, 4) \
+                if proposed else 0.0
+            snap["accept_rate"] = self.accept_rate.summary()
         if self.queue_depth_fn is not None:
             try:
                 snap["queue_depth"] = int(self.queue_depth_fn())

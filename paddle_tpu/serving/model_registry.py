@@ -146,7 +146,7 @@ class ModelEntry:
 
     def __init__(self, name, version, path, predictor, batcher,
                  replicas=None, devices=None, precision="fp32",
-                 resource=None):
+                 resource=None, draft_path=None):
         self.name = name
         self.version = version
         self.path = path
@@ -167,6 +167,9 @@ class ModelEntry:
         # fleet controller can place by cost; None when the artifact
         # could not be analyzed
         self.resource = resource
+        # speculative decoding (SERVING.md): the draft artifact this
+        # entry's lanes draft with, or None for target-only decode
+        self.draft_path = draft_path
 
     def device_labels(self):
         from ..inference.predictor import _device_label
@@ -191,7 +194,9 @@ class ModelEntry:
         executables, so the first real stream pays no compile)."""
         if self.is_decode:
             n_slots = self.batcher.n_slots
-            for pred in self.replicas:
+            spec_k = getattr(self.batcher, "spec_k", 0)
+            drafts = getattr(self.batcher, "draft_replicas", None)
+            for i, pred in enumerate(self.replicas):
                 sess = pred.new_session(n_slots)
                 for bucket in pred.prefill_buckets():
                     # a prompt filling the whole cache is unservable
@@ -201,6 +206,17 @@ class ModelEntry:
                     sess.prefill(0, [0] * n)
                     sess.decode()
                     sess.free(0)
+                if drafts and spec_k:
+                    # spec lanes: force-resolve the verify executable
+                    # plus the draft's phases so the first real stream
+                    # pays no compile on EITHER side of the flip
+                    pred.verify_fn(n_slots, spec_k)
+                    dsess = drafts[i].new_session(n_slots)
+                    for bucket in drafts[i].prefill_buckets():
+                        n = min(bucket, drafts[i].max_seq_len - 1)
+                        dsess.prefill(0, [0] * n)
+                        dsess.decode()
+                        dsess.free(0)
             return self
         specs = self.predictor.feed_specs()
         buckets = self.predictor.batch_buckets() or (1,)
@@ -235,7 +251,8 @@ class ModelRegistry:
     # ------------------------------------------------------------------
 
     @staticmethod
-    def _fit_check(name, path, placement, decode_slots=None):
+    def _fit_check(name, path, placement, decode_slots=None,
+                   draft_path=None):
         """Static admission gate (ANALYSIS.md): analyze the artifact,
         then check the per-replica peak estimate against every
         placement device's memory budget.  Returns the ResourceReport
@@ -244,32 +261,53 @@ class ModelRegistry:
 
         Replicas sharing one device (the [None] default-device spec
         with N > 1 never happens; explicit duplicate devices can)
-        multiply the estimate on that device."""
-        from ..analysis import check_fit, resources
+        multiply the estimate on that device.
+
+        `draft_path` (speculative decoding) adds the draft artifact's
+        estimate — its weights AND its own KV slot table — to every
+        replica's footprint: the draft lives on the same device as its
+        target, so both must fit TOGETHER or the load is rejected
+        before any build/warm work."""
+        from ..analysis import ResourceFitError, check_fit, resources
         try:
             report = resources.analyze_artifact(
                 path, decode_slots=decode_slots)
         except Exception:
             return None
+        draft_report = None
+        if draft_path:
+            try:
+                draft_report = resources.analyze_artifact(
+                    draft_path, decode_slots=decode_slots)
+            except Exception:
+                draft_report = None
         by_dev = {}
         for dev in placement:
             key = id(dev) if dev is not None else None
             by_dev[key] = (dev, by_dev.get(key, (dev, 0))[1] + 1)
-        from ..analysis import ResourceFitError
+        what = "model %r (%s)" % (name, path)
+        if draft_report is not None:
+            what += " + draft (%s)" % (draft_path,)
         for dev, n in by_dev.values():
             try:
                 est, avail = check_fit(
-                    report, device=dev,
-                    what="model %r (%s)" % (name, path), replicas=n)
+                    report, device=dev, what=what, replicas=n)
+                if draft_report is not None and avail is not None:
+                    est += int(draft_report.peak_bytes) * int(n)
+                    if est > avail:
+                        raise ResourceFitError(what, est, avail,
+                                               device=dev)
             except ResourceFitError as e:
                 obs_events.emit(
                     "model_fit_rejected", model=name, path=path,
+                    draft=draft_path or None,
                     est_bytes=e.estimated_bytes,
                     available_bytes=e.available_bytes)
                 raise
             if avail is not None:
                 obs_events.emit(
                     "model_fit_check", model=name, path=path,
+                    draft=draft_path or None,
                     est_bytes=int(est), available_bytes=int(avail),
                     replicas=int(n))
         return report
@@ -277,7 +315,8 @@ class ModelRegistry:
     def load_model(self, name, path, version=None, warm=True,
                    buckets=None, drain_timeout=30.0, replicas=None,
                    devices=None, decode_slots=None, decode_mode=None,
-                   precision=None, ab_weight=None):
+                   precision=None, ab_weight=None, draft=None,
+                   spec_k=None):
         """Load (or hot-swap in) `path` as `name`.  Returns the entry.
         `replicas`/`devices` override the registry's default placement
         spec (see resolve_placement).  ALL replicas are built and
@@ -299,11 +338,31 @@ class ModelRegistry:
         DecodeBatcher instead: per-replica slot tables of
         `decode_slots` (default FLAGS.serving_decode_slots) with
         continuous batching; `decode_mode="static"` keeps the
-        static-batch baseline (bench comparison only)."""
+        static-batch baseline (bench comparison only).
+
+        `draft`/`spec_k` (SERVING.md "Speculative decoding", decode
+        artifacts only): `draft` names a vocab-compatible decode
+        artifact (default FLAGS.serving_spec_draft — canonically the
+        int8 twin) built on the SAME placement, one draft replica per
+        target replica; each lane then drafts `spec_k` (default
+        FLAGS.serving_spec_k) tokens per round and the target verifies
+        them in one batched step, streams staying bit-identical to
+        target-only decode.  The draft is fit-checked alongside the
+        target before any build work."""
         from .. import compile_cache
         spec = devices if devices is not None else (
             replicas if replicas is not None else self._replicas)
         placement = resolve_placement(spec)
+        is_decode_path = os.path.exists(
+            os.path.join(path, "decode_meta.bin"))
+        draft_path, spec_depth = None, 0
+        if is_decode_path:
+            spec_depth = int(FLAGS.serving_spec_k if spec_k is None
+                             else spec_k)
+            draft_path = draft if draft is not None \
+                else (FLAGS.serving_spec_draft or None)
+            if not draft_path or spec_depth < 1:
+                draft_path, spec_depth = None, 0
         # admission fit check (ANALYSIS.md resource analysis): the
         # static per-replica peak estimate is checked against each
         # placement device's budget BEFORE any artifact build / clone /
@@ -312,18 +371,22 @@ class ModelRegistry:
         # Analysis failures (not fit failures) must never block a load:
         # the estimate is advisory when it cannot be computed.
         report = self._fit_check(name, path, placement,
-                                 decode_slots=decode_slots)
+                                 decode_slots=decode_slots,
+                                 draft_path=draft_path)
         cc_before = compile_cache.stats()
         preds = _build_replicas(path, buckets, placement)
         precision = str(precision or getattr(preds[0], "precision",
                                              "fp32"))
         lane_metrics = self.metrics.model(name, precision)
         if getattr(preds[0], "is_decode", False):
+            draft_preds = _build_replicas(draft_path, None, placement) \
+                if draft_path else None
             batcher = DecodeBatcher(
                 preds[0], replicas=preds, n_slots=decode_slots,
                 max_queue=self._max_queue,
                 metrics=lane_metrics,
-                continuous=(decode_mode != "static"))
+                continuous=(decode_mode != "static"),
+                draft_replicas=draft_preds, spec_k=spec_depth)
         else:
             batcher = DynamicBatcher(
                 preds[0], max_queue=self._max_queue,
@@ -331,7 +394,8 @@ class ModelRegistry:
                 metrics=lane_metrics, replicas=preds)
         entry = ModelEntry(name, version, path, preds[0], batcher,
                            replicas=preds, devices=placement,
-                           precision=precision, resource=report)
+                           precision=precision, resource=report,
+                           draft_path=draft_path)
         if report is not None:
             lane_metrics.note_resource(report.peak_mb,
                                        report.total_flops)
@@ -457,6 +521,11 @@ class ModelRegistry:
                         info["max_seq_len"] = \
                             latest.predictor.max_seq_len
                         info["eos_id"] = latest.predictor.eos_id
+                        if getattr(latest.batcher, "spec_k", 0):
+                            # speculative lanes: the draft + depth the
+                            # operator tuned (SERVING.md)
+                            info["spec_k"] = latest.batcher.spec_k
+                            info["draft"] = latest.draft_path
                 else:
                     info["buckets"] = []
                 out[name] = info

@@ -234,7 +234,9 @@ class InferenceServer:
                 decode_slots=msg.get("decode_slots"),
                 decode_mode=msg.get("decode_mode"),
                 precision=msg.get("precision"),
-                ab_weight=msg.get("ab_weight"))
+                ab_weight=msg.get("ab_weight"),
+                draft=msg.get("draft"),
+                spec_k=msg.get("spec_k"))
             reply = {"ok": True, "name": entry.name,
                      "version": entry.version,
                      "buckets": list(entry.predictor.batch_buckets()),
@@ -251,6 +253,10 @@ class InferenceServer:
                 reply["decode_slots"] = entry.batcher.n_slots
                 reply["max_seq_len"] = entry.predictor.max_seq_len
                 reply["eos_id"] = entry.predictor.eos_id
+                if getattr(entry.batcher, "spec_k", 0):
+                    # speculative lanes armed: depth + draft artifact
+                    reply["spec_k"] = entry.batcher.spec_k
+                    reply["draft"] = entry.draft_path
             return reply
         if cmd == "unload_model":
             self.registry.unload_model(msg["name"])
@@ -587,8 +593,15 @@ class ServingClient:
 
     def load_model(self, name, path, version=None, buckets=None,
                    replicas=None, devices=None, decode_slots=None,
-                   decode_mode=None, precision=None, ab_weight=None):
+                   decode_mode=None, precision=None, ab_weight=None,
+                   draft=None, spec_k=None):
         msg = {"cmd": "load_model", "name": name, "path": path}
+        if draft is not None:
+            # speculative decoding: draft artifact path (SERVING.md);
+            # the server pairs one draft replica per target replica
+            msg["draft"] = str(draft)
+        if spec_k is not None:
+            msg["spec_k"] = int(spec_k)
         if version is not None:
             msg["version"] = version
         if precision is not None:

@@ -388,71 +388,122 @@ def run_decode_point(endpoint, model, vocab, target_qps, duration,
 
 def run_decode_lane(args, backend_label):
     """The --decode entry point: fresh in-process server per decode
-    mode (cb = continuous batching, static = whole-batch baseline),
-    identical seeded arrival schedule and per-request workloads, one
-    JSON record per (mode, qps) point."""
+    mode (cb = continuous batching, static = whole-batch baseline) and
+    per `--spec_k` sweep point, identical seeded arrival schedule and
+    per-request workloads, one JSON record per (mode, spec_k, qps)
+    point.
+
+    Speculative sweep (SERVING.md "Speculative decoding"): `--spec_k
+    0,2,4,8` serves the same workload with draft depths 0 (target-only
+    baseline) through 8.  The draft defaults to the SAME artifact
+    (`--spec_draft twin`), the synthetic high-accept workload: accept
+    rate ~1.0, so the accept-rate x speedup table reads the scheduling
+    ceiling.  `--draft_cost_ms` prices each draft step (default 0.3x
+    `--step_cost_ms` — the BENCH_r11 int8 weight-bytes ratio, what the
+    int8-twin draft would cost on a bandwidth-bound chip); the verify
+    step costs one `--step_cost_ms` like any target step.  Every point
+    replays prompts against the fp32-only greedy stream and records
+    `bit_exact` — speculation must never move one token.  Headline:
+    `tokens_per_sec_per_slot` at equal step cost, spec_k=N vs 0."""
     from paddle_tpu.serving import (InferenceServer, ServingClient,
-                                    set_dispatch_delay)
+                                    set_dispatch_delay, set_draft_delay)
     vocab = 64
     workdir = tempfile.mkdtemp(prefix="bench_serving_decode_")
     model_dir = build_decode_model(os.path.join(workdir, "lm"))
     modes = {"cb": ["cb"], "static": ["static"],
              "both": ["static", "cb"]}[args.decode_mode]
+    spec_points = [int(s) for s in args.spec_k.split(",")
+                   if s.strip() != ""] if args.spec_k else [0]
+    draft_cost_ms = args.draft_cost_ms if args.draft_cost_ms is not None \
+        else 0.3 * args.step_cost_ms
     qps_points = [float(q) for q in args.qps.split(",") if q] \
         if args.qps else [8.0]
     duration = 6.0 if args.duration is None else args.duration
     for mode in modes:
-        server = InferenceServer(max_queue=args.max_queue).start()
-        boot = ServingClient(server.endpoint)
-        try:
-            t_boot = time.monotonic()
-            loaded = boot.load_model(
-                "lm", model_dir, decode_slots=args.decode_slots,
-                decode_mode="static" if mode == "static" else None,
-                replicas=args.replicas
-                if not args.replicas.isdigit() or args.replicas != "1"
-                else None)
-            # idle-server TTFT (loaded + warm, zero traffic): the
-            # baseline the under-load TTFT p95 bound compares against
-            idle_ttft = _measure_idle_ttft(server.endpoint, "lm", vocab)
-            cold_start_ms = round((time.monotonic() - t_boot) * 1e3, 1)
-            bit_exact = _verify_decode_bit_exact(
-                server.endpoint, "lm", model_dir, seed=11, vocab=vocab)
-            if args.step_cost_ms:
-                # after the bit-exact replay and idle-TTFT baseline:
-                # the stand-in slows steps, not correctness
-                set_dispatch_delay(args.step_cost_ms / 1000.0)
-            for q in qps_points:
-                rec = run_decode_point(
-                    server.endpoint, "lm", vocab, target_qps=q,
-                    duration=duration, deadline_ms=args.deadline_ms,
-                    seed=3)
-                stats = boot.stats()["stats"]["models"].get("lm", {})
-                rec.update({
-                    "model": "tiny_lm",
-                    "mode": mode,
-                    "step_cost_ms": args.step_cost_ms,
-                    "decode_slots": int(loaded.get("decode_slots", 0)),
-                    "replicas": int(loaded.get("replicas", 1)),
-                    "idle_ttft_ms": idle_ttft,
-                    "ttft_ratio_vs_idle": round(
-                        rec["ttft_p95_ms"] / idle_ttft, 3)
-                    if rec.get("ttft_p95_ms") and idle_ttft else None,
-                    "bit_exact": bool(bit_exact),
-                    "cold_start_ms": cold_start_ms,
-                    "slot_occupancy": stats.get("slot_occupancy"),
-                    "decode_steps": stats.get("decode_steps"),
-                    "server_tokens_per_sec": stats.get("tokens_per_sec"),
-                    "compile_cache": loaded.get("compile_cache", {}),
-                    "len_mix": [list(m) for m in DECODE_LEN_MIX],
-                })
-                if backend_label:
-                    rec["backend"] = backend_label
-                print(json.dumps(rec), flush=True)
-        finally:
-            set_dispatch_delay(0.0)
-            boot.close()
-            server.shutdown(drain=True)
+        for spec_k in spec_points:
+            server = InferenceServer(max_queue=args.max_queue).start()
+            boot = ServingClient(server.endpoint)
+            try:
+                t_boot = time.monotonic()
+                draft_dir = None
+                if spec_k > 0:
+                    draft_dir = model_dir if args.spec_draft == "twin" \
+                        else args.spec_draft
+                loaded = boot.load_model(
+                    "lm", model_dir, decode_slots=args.decode_slots,
+                    decode_mode="static" if mode == "static" else None,
+                    draft=draft_dir, spec_k=spec_k if draft_dir else 0,
+                    replicas=args.replicas
+                    if not args.replicas.isdigit()
+                    or args.replicas != "1"
+                    else None)
+                # idle-server TTFT (loaded + warm, zero traffic): the
+                # baseline the under-load TTFT p95 bound compares with
+                idle_ttft = _measure_idle_ttft(server.endpoint, "lm",
+                                               vocab)
+                cold_start_ms = round(
+                    (time.monotonic() - t_boot) * 1e3, 1)
+                bit_exact = _verify_decode_bit_exact(
+                    server.endpoint, "lm", model_dir, seed=11,
+                    vocab=vocab)
+                if args.step_cost_ms:
+                    # after the bit-exact replay and idle-TTFT
+                    # baseline: the stand-in slows steps, not
+                    # correctness
+                    set_dispatch_delay(args.step_cost_ms / 1000.0)
+                    if spec_k > 0:
+                        set_draft_delay(draft_cost_ms / 1000.0)
+                for q in qps_points:
+                    rec = run_decode_point(
+                        server.endpoint, "lm", vocab, target_qps=q,
+                        duration=duration,
+                        deadline_ms=args.deadline_ms, seed=3)
+                    stats = boot.stats()["stats"]["models"].get(
+                        "lm", {})
+                    slots_total = int(loaded.get("decode_slots", 0)) \
+                        * int(loaded.get("replicas", 1))
+                    rec.update({
+                        "model": "tiny_lm",
+                        "mode": mode,
+                        "step_cost_ms": args.step_cost_ms,
+                        "decode_slots": int(
+                            loaded.get("decode_slots", 0)),
+                        "replicas": int(loaded.get("replicas", 1)),
+                        "idle_ttft_ms": idle_ttft,
+                        "ttft_ratio_vs_idle": round(
+                            rec["ttft_p95_ms"] / idle_ttft, 3)
+                        if rec.get("ttft_p95_ms") and idle_ttft
+                        else None,
+                        "bit_exact": bool(bit_exact),
+                        "cold_start_ms": cold_start_ms,
+                        "slot_occupancy": stats.get("slot_occupancy"),
+                        "decode_steps": stats.get("decode_steps"),
+                        "server_tokens_per_sec": stats.get(
+                            "tokens_per_sec"),
+                        "compile_cache": loaded.get(
+                            "compile_cache", {}),
+                        "len_mix": [list(m) for m in DECODE_LEN_MIX],
+                        # speculative-decoding columns: the accept-rate
+                        # x speedup table keys on these (BENCH_r12)
+                        "spec_k": spec_k,
+                        "draft": draft_dir,
+                        "draft_cost_ms": draft_cost_ms
+                        if spec_k else 0.0,
+                        "tokens_per_sec_per_slot": round(
+                            rec["tokens_per_sec"] / slots_total, 3)
+                        if slots_total else None,
+                        "accept_rate": stats.get("spec_accept_rate"),
+                        "spec_rounds": stats.get("spec_rounds"),
+                        "spec_degraded": stats.get("spec_degraded", 0),
+                    })
+                    if backend_label:
+                        rec["backend"] = backend_label
+                    print(json.dumps(rec), flush=True)
+            finally:
+                set_dispatch_delay(0.0)
+                set_draft_delay(0.0)
+                boot.close()
+                server.shutdown(drain=True)
 
 
 def _parse_replica_sweep(spec):
@@ -685,7 +736,28 @@ def main():
                          "lane loop (GIL released — the same stand-in "
                          "discipline as --dispatch_cost_ms): makes the "
                          "cb-vs-static throughput ratio measurable on "
-                         "a 1-core host by making capacity slot-bound")
+                         "a 1-core host by making capacity slot-bound; "
+                         "a speculative VERIFY step costs exactly one "
+                         "of these, like any target step")
+    ap.add_argument("--spec_k", default=None,
+                    help="speculative-decoding sweep: comma list of "
+                         "draft depths ('0,2,4,8'); 0 = target-only "
+                         "baseline, each point gets a fresh server and "
+                         "a per-point bit-exact replay vs the "
+                         "fp32-only greedy stream (SERVING.md)")
+    ap.add_argument("--spec_draft", default="twin",
+                    help="draft artifact for the spec sweep: 'twin' "
+                         "(default) drafts with the SAME artifact — "
+                         "the synthetic high-accept workload, accept "
+                         "rate ~1.0 — or a path to any vocab-"
+                         "compatible decode artifact (e.g. the int8 "
+                         "sibling)")
+    ap.add_argument("--draft_cost_ms", type=float, default=None,
+                    help="deterministic per-DRAFT-step stall (GIL "
+                         "released); default 0.3x --step_cost_ms — "
+                         "the BENCH_r11 int8 weight-bytes ratio, i.e. "
+                         "what the int8-twin draft costs on a "
+                         "bandwidth-bound chip")
     ap.add_argument("--deadline_batch_ms", type=float, default=None,
                     help="batcher coalescing window override "
                          "(default FLAGS.serving_batch_deadline_ms)")

@@ -129,6 +129,18 @@ CONFIGS = [
      ["@serving", "--decode", "--decode_mode", "cb",
       "--decode_slots", "8", "--step_cost_ms", "20", "--qps", "30",
       "--duration", "8"], 8, 1),
+    # speculative-decoding lane (SERVING.md "Speculative decoding"):
+    # same continuous-batching workload, draft depth 0 (target-only
+    # baseline) vs 4 on one sweep — the same-weights twin draft makes
+    # accept ~1.0, --draft_cost_ms defaults to 0.3x the step cost (the
+    # BENCH_r11 int8 weight-bytes ratio), so tokens_per_sec_per_slot
+    # k4/k0 reads the speculative scheduling win at equal step cost
+    # (>= 1.5x acceptance, BENCH_r12.json); every point carries a
+    # bit-exact replay vs the fp32-only greedy stream
+    ("serving_specdec",
+     ["@serving", "--decode", "--decode_mode", "cb",
+      "--decode_slots", "4", "--step_cost_ms", "25",
+      "--spec_k", "0,4", "--qps", "40", "--duration", "8"], 8, 1),
     # async-training-pipeline A/B (PIPELINE.md): same model, same
     # 40 ms/batch host stall (deterministic stand-in for host-side
     # preprocessing — the host-BOUND lane), prefetch + in-flight

@@ -47,6 +47,14 @@ exits nonzero on failure):
                event for the latter), with zero wedged lanes and zero
                cross-request KV leakage — reused slots serve bit-exact
                greedy streams because freed slots are zeroed.
+  spec-fallback
+               speculative-decoding chaos (SERVING.md): poison the
+               draft predictor MID-STREAM (set_draft_poison) — the
+               serving lane must degrade to target-only decode within
+               that same round, the victim stream completes its full
+               budget bit-identical to the fp32-only greedy decode,
+               a spec_degraded event + counter fire, and post-degrade
+               traffic keeps serving with zero wedged lanes.
 
   --smoke      crash-save (deterministic `exit` fault at every commit
                point) + bit-flip, fast enough for tier-1.
@@ -994,6 +1002,107 @@ def scenario_decode_disconnect(verbose=True):
             "expired_tokens": tokens_before_expiry}
 
 
+def scenario_spec_fallback(verbose=True):
+    """Speculative-decoding chaos (SERVING.md "Speculative decoding"):
+    the draft predictor dies MID-STREAM and the serving lane must
+    degrade to target-only decode without dropping or corrupting one
+    token.
+
+    A server loads a decode model with a same-weights draft (spec_k=4,
+    accept ~1.0).  A victim stream starts, reads a few chunks riding
+    speculative rounds, then `set_draft_poison(0)` kills every further
+    draft step.  Required invariants: (1) the victim stream completes
+    to its full token budget — the poisoned round itself falls back to
+    a plain target step, so the stream never stalls; (2) every token of
+    the victim AND of fresh post-degrade streams is bit-identical to a
+    direct fp32-only greedy decode (degradation must not touch the
+    committed KV state); (3) a `spec_degraded` obs event fires and the
+    `spec_degraded` stats counter reads >= 1; (4) zero wedged lanes —
+    the slot table drains clean."""
+    import tempfile
+    from paddle_tpu.inference.decode import (GenerativePredictor,
+                                             build_tiny_decode_model,
+                                             greedy_decode,
+                                             set_draft_poison)
+    from paddle_tpu.obs import events as obs_events
+    from paddle_tpu.serving import (InferenceServer, ServingClient,
+                                    set_dispatch_delay)
+
+    md = build_tiny_decode_model(
+        os.path.join(tempfile.mkdtemp(prefix="chaos_spec_"), "lm"),
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+        max_seq_len=64, eos_id=-1, seed=23)
+    pred = GenerativePredictor(md)
+    server = InferenceServer().start()
+    boot = ServingClient(server.endpoint)
+    set_draft_poison(None)
+    try:
+        boot.load_model("lm", md, decode_slots=2, draft=md, spec_k=4)
+        # slow, deterministic steps so "mid-stream" is unambiguous
+        set_dispatch_delay(0.01)
+        victim = ServingClient(server.endpoint)
+        prompt, budget = [3, 5, 7], 32
+        ref, _ = greedy_decode(pred, prompt, budget)
+        it = victim.infer_stream("lm", prompt, max_new_tokens=budget,
+                                 deadline_ms=60000.0)
+        got = []
+        poisoned = False
+        for chunk in it:
+            got.extend(chunk)
+            if not poisoned and len(got) >= 6:
+                # a few speculative rounds in: kill the draft
+                set_draft_poison(0)
+                poisoned = True
+        victim.close()
+        assert poisoned, "stream finished before the poison armed"
+        assert len(got) == budget, \
+            "victim stream stalled/truncated after draft death: " \
+            "%d of %d tokens" % (len(got), budget)
+        assert got == ref, \
+            "draft death corrupted the victim stream (%s vs %s)" \
+            % (got[:8], ref[:8])
+        ev = [e for e in obs_events.recent_events(kind="spec_degraded")]
+        assert ev, "no spec_degraded event after draft poison"
+        assert "poison" in str(ev[-1].get("error", "")), ev[-1]
+        snap = boot.stats()["stats"]["models"]["lm"]
+        assert snap.get("spec_degraded", 0) >= 1, snap
+        accept = snap.get("spec_accept_rate")
+        # fresh post-degrade traffic: target-only, still bit-exact
+        set_dispatch_delay(0.0)
+        prompts = [[9, 4], [11, 12, 13, 14], [2]]
+        for p in prompts:
+            cli = ServingClient(server.endpoint)
+            try:
+                out = [t for ch in cli.infer_stream(
+                    "lm", p, max_new_tokens=12, deadline_ms=60000.0)
+                    for t in ch]
+            finally:
+                cli.close()
+            assert out == greedy_decode(pred, p, 12)[0], \
+                "post-degrade stream not bit-exact for %s" % (p,)
+        t0 = time.time()
+        while time.time() - t0 < 10.0:
+            if boot.stats()["stats"]["models"]["lm"].get(
+                    "decode_slots_busy", 0) == 0:
+                break
+            time.sleep(0.01)
+        busy = boot.stats()["stats"]["models"]["lm"].get(
+            "decode_slots_busy", 0)
+        assert busy == 0, "slots still occupied after drain (wedged)"
+    finally:
+        set_draft_poison(None)
+        set_dispatch_delay(0.0)
+        boot.close()
+        server.shutdown(drain=False, timeout=10.0)
+    if verbose:
+        print("PASS spec-fallback: draft poisoned mid-stream after 6+ "
+              "tokens, victim completed all %d tokens bit-exact, "
+              "spec_degraded event + counter fired (accept rate before "
+              "death %s), %d post-degrade streams bit-exact, slots "
+              "drained" % (budget, accept, len(prompts)))
+    return {"victim_tokens": len(got), "accept_rate": accept}
+
+
 def scenario_trace_overflow(workdir, verbose=True):
     """Observability hot-path safety (OBSERVABILITY.md): the span ring
     wraps under concurrent load and the event log rotates mid-write —
@@ -1140,7 +1249,8 @@ def main(argv=None):
                                            "cache-commit",
                                            "quantize-commit",
                                            "trace-overflow",
-                                           "decode-disconnect", "all"])
+                                           "decode-disconnect",
+                                           "spec-fallback", "all"])
     ap.add_argument("--smoke", action="store_true",
                     help="fast deterministic subset for CI")
     ap.add_argument("--workdir", default=None)
@@ -1180,7 +1290,7 @@ def main(argv=None):
         scenarios = ["crash-save", "bit-flip", "nan-poison", "drop-rpc",
                      "serving-overload", "cache-commit",
                      "quantize-commit", "trace-overflow",
-                     "decode-disconnect"]
+                     "decode-disconnect", "spec-fallback"]
     else:
         scenarios = [args.scenario]
     rc = 0
@@ -1217,6 +1327,8 @@ def main(argv=None):
                     os.path.join(workdir, "trace_overflow"))
             elif s == "decode-disconnect":
                 scenario_decode_disconnect()
+            elif s == "spec-fallback":
+                scenario_spec_fallback()
         except AssertionError as e:
             rc = 1
             print("FAIL %s: %s" % (s, e))

@@ -14,6 +14,9 @@
 #     10  lint_runtime   concurrency/durability AST lint over paddle_tpu/
 #     11  lint_program   verifier --smoke zoo sweep (mnist, vgg)
 #     12  apispec        tools/gen_api_spec.py output != committed spec
+#     13  specdec        speculative-decode smoke (the bench subprocess
+#                        test: draft/verify/commit path + bit-exact
+#                        replay, tests/test_spec_decode.py)
 #      1  usage          unknown gate name
 #      0  all requested gates clean
 #
@@ -29,7 +32,7 @@ SPEC="${API_SPEC:-API.spec}"
 
 gates=("$@")
 if [ ${#gates[@]} -eq 0 ]; then
-    gates=(lint_runtime lint_program apispec)
+    gates=(lint_runtime lint_program apispec specdec)
 fi
 
 for gate in "${gates[@]}"; do
@@ -54,9 +57,14 @@ for gate in "${gates[@]}"; do
                 exit 12
             fi
             ;;
+        specdec)
+            echo "== ci_checks: specdec smoke =="
+            "$PY" -m pytest tests/test_spec_decode.py -q \
+                -k "bench_smoke" -p no:cacheprovider || exit 13
+            ;;
         *)
             echo "ci_checks: unknown gate '$gate'" \
-                 "(have: lint_runtime lint_program apispec)"
+                 "(have: lint_runtime lint_program apispec specdec)"
             exit 1
             ;;
     esac

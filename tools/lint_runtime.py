@@ -127,9 +127,12 @@ SUPPRESSIONS = [
      "ride monotonic stamps, time.time() only places them on the "
      "wall-clock axis"),
     ("paddle_tpu/serving/batcher.py", "nonmonotonic-time",
-     "DecodeBatcher._lane_loop",
-     "decode_step span anchor: wall `ts` = now_wall - monotonic "
-     "elapsed; the dur_ms itself is pure time.monotonic()"),
+     "DecodeBatcher._emit_step_spans",
+     "decode_step/draft/verify span anchors: one time.time() reading "
+     "minus the monotonic elapsed places each span on the wall axis; "
+     "every dur_ms rides the contiguous monotonic round stamps (the "
+     "draft->verify boundary included), so the tiling contract never "
+     "touches the wall clock"),
 ]
 
 

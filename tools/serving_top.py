@@ -37,10 +37,10 @@ def render(reply):
     lines = ["server uptime %.0fs, %d model(s)"
              % (stats.get("uptime_sec", 0.0), len(models)), ""]
     hdr = ("%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
-           "%7s %7s %5s"
+           "%7s %7s %5s %5s"
            % ("MODEL", "PREC", "VER", "QPS", "REQS", "p50ms", "p95ms",
               "p99ms", "FILL", "BKT%", "QUEUE", "SHED", "CCH/M",
-              "TTFT95", "TPS", "OCC%"))
+              "TTFT95", "TPS", "OCC%", "ACC%"))
     lines.append(hdr)
     lines.append("-" * len(hdr))
     described = set()
@@ -60,13 +60,16 @@ def render(reply):
         cc_col = "%s/%s" % (cc.get("hits", 0), cc.get("misses", 0)) \
             if cc else "-"
         # decode models (SERVING.md continuous batching): TTFT p95,
-        # aggregate tokens/sec, and slot occupancy; "-" otherwise
+        # aggregate tokens/sec, and slot occupancy; "-" otherwise.
+        # ACC% is the speculative-decoding lifetime draft accept rate
+        # (absent without a draft — target-only lanes show "-")
         ttft = (m.get("ttft_ms") or {}).get("p95")
         tps = m.get("tokens_per_sec")
         occ = m.get("slot_occupancy")
+        acc = m.get("spec_accept_rate")
         lines.append(
             "%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
-            "%7s %7s %5s"
+            "%7s %7s %5s %5s"
             % (plain[:14], prec[:5], _fmt(ver),
                _fmt(m.get("qps_recent")), _fmt(m.get("requests")),
                _fmt(lat.get("p50")), _fmt(lat.get("p95")),
@@ -75,13 +78,18 @@ def render(reply):
                _fmt(m.get("queue_depth")), _fmt(m.get("shed")),
                cc_col, _fmt(ttft), _fmt(tps),
                _fmt(round(100.0 * occ, 1) if isinstance(occ, float)
-                    and occ >= 0 else None)))
+                    and occ >= 0 else None),
+               _fmt(round(100.0 * acc, 1)
+                    if isinstance(acc, float) else None)))
         if d.get("buckets") and plain not in described:
             described.add(plain)
             extra = ""
             if d.get("decode"):
                 extra = " decode_slots=%s max_seq_len=%s" % (
                     d.get("decode_slots"), d.get("max_seq_len"))
+                if d.get("spec_k"):
+                    extra += " spec_k=%s draft=%s" % (
+                        d["spec_k"], d.get("draft"))
             if d.get("precisions"):
                 extra += " precisions=%s" % (d["precisions"],)
             if d.get("ab_weights"):

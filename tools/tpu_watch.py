@@ -164,6 +164,17 @@ def main():
                     "--decode", "--decode_mode", "both",
                     "--decode_slots", "16", "--qps", "60",
                     "--duration", "15"], {}, 3600),
+        # speculative decoding on silicon (SERVING.md "Speculative
+        # decoding"): the --spec_k accept-rate x speedup sweep with
+        # REAL step costs — no --step_cost_ms/--draft_cost_ms
+        # stand-ins, so the verify step's true cost (one batched
+        # k+1-position launch vs k+1 sequential steps) and the twin
+        # draft's true cost price themselves; re-measures the
+        # BENCH_r12.json CPU-smoke table, bit-exact replay per point
+        ("specdec", ["tools/bench_serving.py", "--require_tpu",
+                     "--decode", "--decode_mode", "cb",
+                     "--decode_slots", "16", "--spec_k", "0,2,4,8",
+                     "--qps", "60", "--duration", "15"], {}, 3600),
         # quantized serving A/B on silicon (QUANTIZE.md): resnet fp32
         # vs PTQ-int8 behind the precision axis — on the HBM-roofline-
         # bound chip the int8 lane's halved weight bytes should show up

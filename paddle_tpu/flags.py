@@ -474,6 +474,11 @@ def _event_log_max_changed(v):
     events.configure(max_kb=v)
 
 
+def _flight_changed(v):
+    from .obs import flightrec
+    flightrec.configure()
+
+
 # NOTE: companion flags (buffer size / rotation cap) are defined BEFORE
 # the flags whose on_change hooks read them, so an env override firing
 # mid-import finds them registered.
@@ -517,6 +522,54 @@ DEFINE_string(
     "trace/step ids so logs, metrics and traces cross-reference. "
     "Empty (default) keeps events in the bounded in-memory ring only.",
     on_change=_event_log_changed)
+DEFINE_bool(
+    "slo_monitor", True,
+    "Run the SLO monitor thread on every InferenceServer "
+    "(paddle_tpu/obs/slo.py): samples the serving counters every "
+    "slo_eval_interval_ms into a bounded time-series ring and "
+    "evaluates declared SLOs (serving_slo) with Google-SRE-style "
+    "multi-window burn rates into the ok/degraded/breach state "
+    "machine the `health` RPC verb renders. Overhead is a counter "
+    "read per model per interval (<3% pinned, BENCH_r13.json); "
+    "disable only to rule the monitor out while debugging.")
+DEFINE_float(
+    "slo_eval_interval_ms", 1000.0,
+    "SLO monitor sampling/evaluation interval in milliseconds. Each "
+    "tick appends one sample per served model lane to the timeline "
+    "ring (also the flight-recorder bundle's metrics timeline) and "
+    "re-evaluates the burn-rate windows; detection latency for a "
+    "hard breach is ~2 fast-window ticks.")
+DEFINE_string(
+    "serving_slo", "",
+    "Declared SLOs (OBSERVABILITY.md \"SLOs & burn rates\"): "
+    "semicolon-separated '[model:]key=val,key=val' declarations; no "
+    "model prefix (or '*') sets the default for every model. Keys: "
+    "p95_ms, ttft_p95_ms, error_rate, shed_rate, spec_accept "
+    "(objectives) plus budget, fast_window, slow_window, fast_burn, "
+    "slow_burn, breach_evals, recover_evals (tuning). Example: "
+    "'p95_ms=250,error_rate=0.01;llm:ttft_p95_ms=400'. Empty = "
+    "sample-only (timeline for the flight recorder, no evaluation).")
+DEFINE_string(
+    "flight_dir", "",
+    "Flight-recorder bundle root (paddle_tpu/obs/flightrec.py): on "
+    "trigger (watchdog_fire, sentinel giveup/rollback, slo_breach, "
+    "serving thread death, manual `flight` RPC) a post-mortem bundle "
+    "— spans, events, metrics, SLO timeline, all-thread stacks, "
+    "resolved flags, server snapshots — is committed atomically "
+    "(write-temp -> fsync -> rename, vault discipline) under this "
+    "directory. Empty (default) disables the recorder.",
+    on_change=_flight_changed)
+DEFINE_int(
+    "flight_keep", 8,
+    "Keep-N rotation for flight-recorder bundles: after each commit "
+    "the oldest bundles beyond this count are deleted.",
+    on_change=_flight_changed)
+DEFINE_float(
+    "flight_cooldown_s", 30.0,
+    "Per-trigger-reason cooldown (seconds) on the flight recorder: a "
+    "breach storm writes ONE bundle per reason per window, not "
+    "hundreds. The manual `flight` RPC bypasses it (force).",
+    on_change=_flight_changed)
 DEFINE_int(
     "dist_threadpool_size", 0,
     "Reference distributed thread pool size. Advisory.")

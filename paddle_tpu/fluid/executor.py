@@ -57,8 +57,14 @@ def _watchdog_call(call, timeout, what="executor step"):
     t.start()
     if not done.wait(timeout):
         from ..obs import events as _obs_events
+        from ..obs import flightrec as _obs_flightrec
         _obs_events.emit("watchdog_fire", what=str(what),
                          budget_s=round(float(timeout), 3))
+        # a wedged backend is exactly what the flight recorder exists
+        # for: the bundle's thread stacks show WHERE the abandoned
+        # dispatch thread is stuck (no-op while FLAGS.flight_dir unset)
+        _obs_flightrec.trigger("watchdog_fire", what=str(what),
+                               budget_s=round(float(timeout), 3))
         raise StepWatchdogTimeout(
             "%s still running after %.1fs (FLAGS.step_watchdog_secs) — "
             "backend wedged or step pathologically slow; the dispatch "

@@ -122,6 +122,9 @@ class AnomalySentinel:
                     self.consecutive_bad >= 2 * self.max_bad_steps:
                 obs_events.emit("sentinel_giveup", step=step,
                                 bad=",".join(bad))
+                from ..obs import flightrec
+                flightrec.trigger("sentinel_giveup", step=step,
+                                  bad=",".join(bad))
                 raise SentinelError(
                     "sentinel: still non-finite (%s) after a rollback to "
                     "the last-good checkpoint — giving up"
@@ -130,9 +133,18 @@ class AnomalySentinel:
             obs_events.emit("sentinel_rollback", step=step,
                             bad=",".join(bad),
                             consecutive=self.consecutive_bad)
+            # the pre-rollback evidence (which fetches went non-finite,
+            # what the pipeline was doing) evaporates with the restore
+            # — bundle it now (no-op while FLAGS.flight_dir unset)
+            from ..obs import flightrec
+            flightrec.trigger("sentinel_rollback", step=step,
+                              bad=",".join(bad))
             return ROLLBACK
         obs_events.emit("sentinel_giveup", step=step, bad=",".join(bad),
                         consecutive=self.consecutive_bad)
+        from ..obs import flightrec
+        flightrec.trigger("sentinel_giveup", step=step,
+                          bad=",".join(bad))
         raise SentinelError(
             "sentinel: %d consecutive non-finite steps (%s) under policy "
             "'skip' with no rollback target — raising instead of "

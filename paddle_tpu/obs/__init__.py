@@ -11,7 +11,14 @@ The observability seam shared by training and serving
   fires) with vault-discipline rotation;
 * ``registry`` — ``MetricsRegistry``: one Prometheus-style exposition
   absorbing ServingMetrics, training counters and span aggregates,
-  served by the ``metrics`` RPC verb and ``tools/metrics_dump.py``.
+  served by the ``metrics`` RPC verb and ``tools/metrics_dump.py``;
+* ``slo`` — declared per-model SLOs with multi-window burn-rate
+  evaluation and the ok/degraded/breach health state machine the
+  ``health`` RPC verb renders;
+* ``flightrec`` — the flight recorder: on trigger (watchdog, sentinel
+  give-up, SLO breach, thread death, manual RPC) dumps spans + events
+  + metrics timeline + all-thread stacks + flags as one atomically
+  committed post-mortem bundle (``FLAGS.flight_dir``).
 
 Importing this package installs the default registry as the span
 ring's listener, so per-stage time aggregates accumulate from the very
@@ -26,10 +33,14 @@ from .events import emit, recent_events  # noqa: F401
 from . import registry  # noqa: F401
 from .registry import MetricsRegistry  # noqa: F401
 from .registry import default as default_registry  # noqa: F401
+from . import flightrec, slo  # noqa: F401
+from .flightrec import FlightRecorder  # noqa: F401
+from .slo import SLO, SLOMonitor  # noqa: F401
 
 __all__ = ["tracing", "events", "registry", "trace", "Span",
            "new_trace_id", "recent_spans", "spans_for_trace", "emit",
-           "recent_events", "MetricsRegistry", "default_registry"]
+           "recent_events", "MetricsRegistry", "default_registry",
+           "slo", "flightrec", "SLO", "SLOMonitor", "FlightRecorder"]
 
 # wire the span listener now: aggregates must not depend on who asks
 # for the registry first (a training run before any server boot still

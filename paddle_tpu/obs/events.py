@@ -29,7 +29,7 @@ import time
 import warnings
 
 __all__ = ["EventLog", "emit", "recent_events", "configure", "get_log",
-           "events_total"]
+           "events_total", "stats"]
 
 _lock = threading.Lock()
 _log = None          # the process-default EventLog (lazy, flag-config'd)
@@ -44,6 +44,8 @@ class EventLog(object):
         self.max_bytes = int(max_bytes)
         self._mem = collections.deque(maxlen=max(int(ring), 1))
         self._total = 0
+        self._dropped = 0     # ring-overflow evictions (oldest-first)
+        self._rotations = 0   # committed file rotations
         self._lock = threading.Lock()
         self._f = None
         self._size = 0
@@ -77,6 +79,7 @@ class EventLog(object):
         os.replace(self.path, self.path + ".1")
         _fsync_dir(os.path.dirname(self.path) or ".")
         self._size = 0
+        self._rotations += 1
 
     # -- emit ---------------------------------------------------------
 
@@ -89,6 +92,8 @@ class EventLog(object):
                 continue
             rec[k] = v if isinstance(v, (str, int, float, bool)) \
                 else str(v)
+        if len(self._mem) == self._mem.maxlen:
+            self._dropped += 1  # GIL-atomic bump, same as the append
         self._mem.append(rec)
         self._total += 1
         if not self.path or self._sink_dead:
@@ -140,6 +145,17 @@ class EventLog(object):
     @property
     def total(self):
         return self._total
+
+    def stats(self):
+        """Ring + sink health (the metrics surface's first-class
+        event-log families, OBSERVABILITY.md)."""
+        return {"events_total": self._total,
+                "buffered": len(self._mem),
+                "dropped": self._dropped,
+                "rotations": self._rotations,
+                "sink": ("none" if not self.path
+                         else "dead" if self._sink_dead else "ok"),
+                "sink_dead": bool(self.path and self._sink_dead)}
 
 
 # ---------------------------------------------------------------------------
@@ -203,3 +219,8 @@ def recent_events(n=None, kind=None):
 
 def events_total():
     return get_log().total
+
+
+def stats():
+    """Health of the process-default event log."""
+    return get_log().stats()

@@ -78,6 +78,7 @@ class MetricsRegistry(object):
         self._gauges = {}      # name -> callable() -> value|dict|None
         self._hists = {}       # (name, labels-tuple) -> ReservoirHistogram
         self._serving = []     # attached ServingMetrics
+        self._slo = []         # attached SLOMonitors (obs/slo.py)
         self._span_agg = {}    # (kind, name) -> [count, total_ms]
 
     # -- primitive instruments ---------------------------------------
@@ -126,6 +127,19 @@ class MetricsRegistry(object):
         with self._lock:
             if serving_metrics in self._serving:
                 self._serving.remove(serving_metrics)
+
+    def attach_slo(self, monitor):
+        """Absorb one SLOMonitor: its burn-rate / compliance / state
+        gauges render as first-class families (the fleet controller's
+        health scrape — OBSERVABILITY.md "SLOs & burn rates")."""
+        with self._lock:
+            if monitor not in self._slo:
+                self._slo.append(monitor)
+
+    def detach_slo(self, monitor):
+        with self._lock:
+            if monitor in self._slo:
+                self._slo.remove(monitor)
 
     def note_span(self, span):
         """Tracing-ring listener: fold one completed span into the
@@ -250,6 +264,23 @@ class MetricsRegistry(object):
         _family(lines, _PREFIX + "serving_compile_cache_total", "counter",
                 samples)
 
+    def _render_slo(self, lines):
+        """Burn-rate / compliance / state families from every attached
+        SLOMonitor (obs/slo.py export rows)."""
+        with self._lock:
+            monitors = list(self._slo)
+        by_name = {}
+        for mon in monitors:
+            try:
+                rows = mon.export()
+            except Exception:
+                continue
+            for metric, labels, value, mtype in rows:
+                by_name.setdefault((metric, mtype), []).append(
+                    (_PREFIX + metric, labels, value))
+        for (metric, mtype), samples in sorted(by_name.items()):
+            _family(lines, _PREFIX + metric, mtype, samples)
+
     def prometheus_text(self):
         """The one metrics surface, Prometheus text exposition."""
         lines = []
@@ -294,7 +325,10 @@ class MetricsRegistry(object):
                                     dict(labels, quantile=q), s[q]))
             _family(lines, _PREFIX + name, "summary", samples)
         self._render_serving(lines)
+        self._render_slo(lines)
         # subsystem health: tracing ring, event log, compile-cache store
+        # — each a FIRST-CLASS family (span drops, event drops, sink
+        # state) so a scraper can alert on telemetry loss directly
         from . import events, tracing
         ts = tracing.stats()
         _family(lines, _PREFIX + "trace_spans_total", "counter",
@@ -303,8 +337,34 @@ class MetricsRegistry(object):
                 [(_PREFIX + "trace_buffered", {}, ts["buffered"])])
         _family(lines, _PREFIX + "trace_dropped_total", "counter",
                 [(_PREFIX + "trace_dropped_total", {}, ts["dropped"])])
+        es = events.stats()
         _family(lines, _PREFIX + "events_total", "counter",
-                [(_PREFIX + "events_total", {}, events.events_total())])
+                [(_PREFIX + "events_total", {}, es["events_total"])])
+        _family(lines, _PREFIX + "events_buffered", "gauge",
+                [(_PREFIX + "events_buffered", {}, es["buffered"])])
+        _family(lines, _PREFIX + "events_dropped_total", "counter",
+                [(_PREFIX + "events_dropped_total", {}, es["dropped"])])
+        _family(lines, _PREFIX + "events_rotations_total", "counter",
+                [(_PREFIX + "events_rotations_total", {},
+                  es["rotations"])])
+        # 1 = a configured file sink has died (memory-only fallback);
+        # 0 covers both "healthy sink" and "no sink configured"
+        _family(lines, _PREFIX + "events_sink_dead", "gauge",
+                [(_PREFIX + "events_sink_dead", {},
+                  int(es["sink_dead"]))])
+        try:
+            from . import flightrec
+            rec = flightrec.get_recorder()
+            if rec is not None:
+                fs = rec.stats()
+                _family(lines, _PREFIX + "flight_dumps_total", "counter",
+                        [(_PREFIX + "flight_dumps_total", {},
+                          fs["dumps"])])
+                _family(lines, _PREFIX + "flight_bundles", "gauge",
+                        [(_PREFIX + "flight_bundles", {},
+                          fs["bundles"])])
+        except Exception:
+            pass
         try:
             from .. import compile_cache
             cc = compile_cache.stats()

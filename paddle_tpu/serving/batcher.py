@@ -149,6 +149,30 @@ def _predictor_device_label(predictor):
     return _device_label(getattr(predictor, "device", None))
 
 
+def _guarded(fn, model_name_fn, thread_kind):
+    """Wrap a batcher thread main: an exception escaping the loop is a
+    dead router/lane — a request-eating wedge that used to die silently
+    as a daemon thread.  Now it lands a `server_thread_death` event and
+    arms the flight recorder (obs/flightrec.py) before re-raising, so
+    the post-mortem bundle holds the stack that killed it."""
+    def _run(*args):
+        try:
+            fn(*args)
+        except BaseException as e:
+            name = threading.current_thread().name
+            obs_events.emit("server_thread_death",
+                            model=model_name_fn(), thread=name,
+                            thread_kind=thread_kind,
+                            error="%s: %s" % (type(e).__name__, e))
+            from ..obs import flightrec
+            flightrec.trigger("thread_death", thread=name,
+                              thread_kind=thread_kind,
+                              model=model_name_fn() or "",
+                              error="%s: %s" % (type(e).__name__, e))
+            raise
+    return _run
+
+
 class _Request:
     __slots__ = ("feeds", "batch", "future", "group_key", "enqueued",
                  "deadline", "priority", "trace_id", "t_taken",
@@ -179,7 +203,7 @@ class _Lane:
     length)."""
 
     __slots__ = ("index", "predictor", "device", "ready", "inflight",
-                 "batches", "rows")
+                 "batches", "rows", "last_t")
 
     def __init__(self, index, predictor):
         self.index = index
@@ -189,6 +213,7 @@ class _Lane:
         self.inflight = 0   # groups a worker is currently dispatching
         self.batches = 0    # micro-batches this replica executed
         self.rows = 0       # real rows it served
+        self.last_t = None  # monotonic end of this lane's last dispatch
 
     def load(self):
         return (self.inflight, len(self.ready), self.index)
@@ -242,14 +267,21 @@ class DynamicBatcher:
         n_workers = max(int(FLAGS.serving_workers if workers is None
                             else workers), 1)
         self._router = threading.Thread(
-            target=self._route, daemon=True,
-            name="paddle-tpu-serving-router")
-        self._threads = [
-            threading.Thread(target=self._worker, args=(lane,),
-                             daemon=True,
-                             name="paddle-tpu-serving-lane%d-%d"
-                                  % (lane.index, i))
-            for lane in self._lanes for i in range(n_workers)]
+            target=_guarded(self._route, lambda: self._model_name,
+                            "router"),
+            daemon=True, name="paddle-tpu-serving-router")
+        self._lane_threads = {lane.index: [] for lane in self._lanes}
+        self._threads = []
+        for lane in self._lanes:
+            for i in range(n_workers):
+                t = threading.Thread(
+                    target=_guarded(self._worker,
+                                    lambda: self._model_name, "lane"),
+                    args=(lane,), daemon=True,
+                    name="paddle-tpu-serving-lane%d-%d"
+                         % (lane.index, i))
+                self._threads.append(t)
+                self._lane_threads[lane.index].append(t)
         self._router.start()
         for t in self._threads:
             t.start()
@@ -325,10 +357,14 @@ class DynamicBatcher:
                 if victim is None:
                     if self.metrics is not None:
                         self.metrics.note_shed(priority=req.priority)
+                    # a shed happens BEFORE lane routing, so no replica
+                    # owns it; the lane-occupancy context says whether
+                    # the lanes were saturated or just the queue
                     obs_events.emit("shed", model=self._model_name,
                                     priority=req.priority,
                                     trace_id=req.trace_id,
-                                    queue=len(self._pending))
+                                    queue=len(self._pending),
+                                    inflight=self._inflight_total())
                     raise ServerOverloaded(
                         "request queue full (%d waiting, max_queue=%d) — "
                         "priority-%d request shed; back off and retry"
@@ -353,7 +389,8 @@ class DynamicBatcher:
             obs_events.emit("shed", model=self._model_name,
                             priority=evicted.priority,
                             trace_id=evicted.trace_id, evicted=True,
-                            by_priority=req.priority)
+                            by_priority=req.priority,
+                            inflight=self._inflight_total())
             if evicted.future.set_running_or_notify_cancel():
                 evicted.future.set_exception(ServerOverloaded(
                     "priority-%d request shed from a full queue by a "
@@ -375,6 +412,32 @@ class DynamicBatcher:
                      "inflight": l.inflight, "queue": len(l.ready),
                      "batches": l.batches, "rows": l.rows}
                     for l in self._lanes]
+
+    def lane_liveness(self):
+        """Thread-level health of this batcher (the `health` RPC verb's
+        per-model section): is the router alive, is each lane's worker
+        set alive, and how long since each lane last finished a
+        dispatch (None = never dispatched yet)."""
+        now = time.monotonic()
+        with self._cv:
+            lanes = []
+            for l in self._lanes:
+                threads = self._lane_threads.get(l.index, [])
+                lanes.append({
+                    "replica": l.index, "device": l.device,
+                    "alive": sum(1 for t in threads if t.is_alive()),
+                    "workers": len(threads),
+                    "inflight": l.inflight, "queue": len(l.ready),
+                    "last_dispatch_age_s":
+                        round(now - l.last_t, 3)
+                        if l.last_t is not None else None})
+            return {"kind": "batch",
+                    "router_alive": self._router.is_alive(),
+                    "queue_depth": len(self._pending),
+                    "closing": self._closing, "lanes": lanes}
+
+    def _inflight_total(self):
+        return sum(l.inflight + len(l.ready) for l in self._lanes)
 
     # ------------------------------------------------------------------
     # coalescing front-end + least-loaded router
@@ -547,9 +610,12 @@ class DynamicBatcher:
                                          t_run_end, now, len(group),
                                          total)
             if slow_ms and total_ms >= slow_ms:
-                # the slow-request log: findable after the ring wrapped
+                # the slow-request log: findable after the ring
+                # wrapped, attributed to the lane that served it so
+                # per-replica triage works from the event log alone
                 obs_events.emit("slow", model=self._model_name,
                                 trace_id=r.trace_id,
+                                replica=lane.index, device=lane.device,
                                 total_ms=round(total_ms, 3),
                                 queue_wait_ms=round(queue_wait_ms, 3),
                                 compute_ms=round(
@@ -593,6 +659,7 @@ class DynamicBatcher:
                 obs_events.emit("deadline_expired",
                                 model=self._model_name,
                                 trace_id=r.trace_id,
+                                replica=lane.index, device=lane.device,
                                 waited_ms=round(
                                     (now - r.enqueued) * 1000.0, 3))
                 if r.future.set_running_or_notify_cancel():
@@ -611,6 +678,7 @@ class DynamicBatcher:
         with self._cv:
             lane.batches += 1
             lane.rows += total
+            lane.last_t = t_run_end
         if self.metrics is not None:
             cap = self._bucket_cap(total) if total else 0
             self.metrics.note_dispatch(
@@ -834,9 +902,10 @@ class _DecodeLane:
     per round instead of exactly one."""
 
     __slots__ = ("index", "predictor", "session", "assigned", "steps",
-                 "tokens", "spec", "degraded_noted")
+                 "tokens", "spec", "degraded_noted", "last_step_t")
 
     def __init__(self, index, predictor, n_slots, draft=None, spec_k=0):
+        self.last_step_t = None  # monotonic end of the last decode step
         self.index = index
         self.predictor = predictor
         if draft is not None and int(spec_k) >= 1:
@@ -920,9 +989,11 @@ class DecodeBatcher:
             metrics.replica_stats_fn = self.replica_stats
             metrics.slot_occupancy_fn = self.slot_occupancy
         self._threads = [
-            threading.Thread(target=self._lane_loop, args=(lane,),
-                             daemon=True,
-                             name="paddle-tpu-decode-lane%d" % lane.index)
+            threading.Thread(
+                target=_guarded(self._lane_loop,
+                                lambda: self._model_name, "decode-lane"),
+                args=(lane,), daemon=True,
+                name="paddle-tpu-decode-lane%d" % lane.index)
             for lane in self._lanes]
         for t in self._threads:
             t.start()
@@ -947,6 +1018,33 @@ class DecodeBatcher:
         """(occupied, total) across every lane — the occupancy gauge."""
         occupied = sum(len(l.assigned) for l in self._lanes)
         return occupied, self.n_slots * len(self._lanes)
+
+    def lane_liveness(self):
+        """Thread-level health (the `health` RPC verb): per decode
+        lane, is its loop thread alive, how many slots are busy, and
+        the age of its last completed decode step — a wedged lane
+        reads as a growing last_step_age_s with busy slots."""
+        now = time.monotonic()
+        with self._cv:
+            lanes = []
+            for i, l in enumerate(self._lanes):
+                t = self._threads[i] if i < len(self._threads) else None
+                lanes.append({
+                    "replica": l.index,
+                    "alive": int(bool(t is not None and t.is_alive())),
+                    "workers": 1,
+                    "slots_busy": len(l.assigned),
+                    "slots": self.n_slots,
+                    "steps": l.steps,
+                    "last_step_age_s":
+                        round(now - l.last_step_t, 3)
+                        if l.last_step_t is not None else None})
+            return {"kind": "decode", "router_alive": True,
+                    "queue_depth": len(self._pending),
+                    "closing": self._closing, "lanes": lanes}
+
+    def _slots_busy_total(self):
+        return sum(len(l.assigned) for l in self._lanes)
 
     def replica_stats(self):
         with self._cv:
@@ -1007,7 +1105,8 @@ class DecodeBatcher:
                     obs_events.emit("shed", model=self._model_name,
                                     priority=req.priority,
                                     trace_id=req.trace_id,
-                                    queue=len(self._pending))
+                                    queue=len(self._pending),
+                                    slots_busy=self._slots_busy_total())
                     raise ServerOverloaded(
                         "decode queue full (%d waiting, max_queue=%d) — "
                         "priority-%d request shed; back off and retry"
@@ -1027,7 +1126,8 @@ class DecodeBatcher:
             obs_events.emit("shed", model=self._model_name,
                             priority=evicted.priority,
                             trace_id=evicted.trace_id, evicted=True,
-                            by_priority=req.priority)
+                            by_priority=req.priority,
+                            slots_busy=self._slots_busy_total())
             evicted.stream._fail(ServerOverloaded(
                 "priority-%d request shed from a full decode queue by "
                 "a priority-%d arrival (lowest-priority-first overload "
@@ -1137,6 +1237,7 @@ class DecodeBatcher:
         step instead of pinning it to max_new_tokens."""
         obs_events.emit("deadline_expired", model=self._model_name,
                         trace_id=req.trace_id,
+                        replica=lane.index,
                         tokens=len(req.gen),
                         waited_ms=round((now - req.enqueued) * 1e3, 3))
         self._finish(lane, slot, req, "deadline", exc=DeadlineExceeded(
@@ -1261,6 +1362,7 @@ class DecodeBatcher:
                 spec_round = False
             now = time.monotonic()
             lane.steps += 1
+            lane.last_step_t = now
             if self.metrics is not None:
                 self.metrics.decode_steps.add()
                 if spec_round:

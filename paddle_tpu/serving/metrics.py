@@ -169,7 +169,12 @@ class ModelMetrics:
         self.est_peak_mb = None
         self.est_flops = None
         self._started = time.monotonic()
+        # (t, latency_ms) completion stamps: one deque feeds BOTH the
+        # recent-QPS window and the SLO monitor's interval-windowed
+        # p95 (obs/slo.py) — the lifetime reservoir would blur a fresh
+        # regression under hours of healthy history
         self._completions = collections.deque()
+        self._ttft_stamps = collections.deque()  # (t, ttft_ms) recent
         self._lock = threading.Lock()
 
     def note_shed(self, priority=0):
@@ -188,9 +193,10 @@ class ModelMetrics:
             self.queue_wait_ms.record(queue_wait_ms)
         now = time.monotonic()
         with self._lock:
-            self._completions.append(now)
+            self._completions.append((now, float(latency_ms)))
             horizon = now - self.QPS_WINDOW_SECS
-            while self._completions and self._completions[0] < horizon:
+            while self._completions and \
+                    self._completions[0][0] < horizon:
                 self._completions.popleft()
 
     def note_compile(self, delta):
@@ -221,6 +227,13 @@ class ModelMetrics:
         the TTFT instant (time_to_first_token satellite metric)."""
         self.prefills.add()
         self.ttft_ms.record(ttft_ms)
+        now = time.monotonic()
+        with self._lock:
+            self._ttft_stamps.append((now, float(ttft_ms)))
+            horizon = now - self.QPS_WINDOW_SECS
+            while self._ttft_stamps and \
+                    self._ttft_stamps[0][0] < horizon:
+                self._ttft_stamps.popleft()
 
     def note_tokens(self, n):
         """`n` generated tokens emitted (across whatever slots the step
@@ -261,13 +274,44 @@ class ModelMetrics:
         now = time.monotonic()
         with self._lock:
             horizon = now - self.QPS_WINDOW_SECS
-            while self._completions and self._completions[0] < horizon:
+            while self._completions and \
+                    self._completions[0][0] < horizon:
                 self._completions.popleft()
             n = len(self._completions)
             if not n:
                 return 0.0
             span = min(self.QPS_WINDOW_SECS, now - self._started)
         return n / max(span, 1e-9)
+
+    @staticmethod
+    def _window_p95(stamps, window_s):
+        now = time.monotonic()
+        horizon = now - max(float(window_s), 1e-3)
+        vals = sorted(v for t, v in stamps if t >= horizon)
+        if not vals:
+            return None
+        if len(vals) == 1:
+            return vals[0]
+        pos = (len(vals) - 1) * 0.95
+        lo = int(pos)
+        hi = min(lo + 1, len(vals) - 1)
+        frac = pos - lo
+        return vals[lo] * (1.0 - frac) + vals[hi] * frac
+
+    def recent_latency_p95(self, window_s):
+        """p95 latency over completions in the last `window_s` seconds
+        (None with no traffic) — the SLO monitor's interval SLI; the
+        window is capped by QPS_WINDOW_SECS of retained stamps."""
+        with self._lock:
+            stamps = list(self._completions)
+        return self._window_p95(stamps, window_s)
+
+    def recent_ttft_p95(self, window_s):
+        """p95 time-to-first-token over prefills in the last
+        `window_s` seconds (None for one-shot models / no streams)."""
+        with self._lock:
+            stamps = list(self._ttft_stamps)
+        return self._window_p95(stamps, window_s)
 
     def snapshot(self):
         uptime = time.monotonic() - self._started

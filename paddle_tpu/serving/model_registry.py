@@ -531,6 +531,50 @@ class ModelRegistry:
                 out[name] = info
             return out
 
+    def health(self):
+        """Per-model liveness readout (the `health` RPC verb's
+        ``models`` section): for each precision lane's routed version,
+        the batcher's thread/lane liveness (router alive, workers
+        alive, last-dispatch / last-decode-step age) plus queue depth.
+        Snapshot the slots under the lock, read the batchers outside it
+        — liveness reads must not serialize against a hot swap."""
+        with self._lock:
+            snap = []
+            for name, slot in self._models.items():
+                lanes = dict(slot.get("latest_prec") or {})
+                if not lanes and slot["latest"] is not None:
+                    lanes = {"fp32": slot["latest"]}
+                snap.append((name, slot["latest"],
+                             sorted(slot["versions"]),
+                             [(prec, v, slot["versions"].get(v))
+                              for prec, v in sorted(lanes.items())]))
+        out = {}
+        for name, latest, versions, lanes in snap:
+            minfo = {"latest": latest, "versions": versions,
+                     "lanes": {}}
+            for prec, v, entry in lanes:
+                if entry is None:
+                    continue
+                li = {"version": v,
+                      "queue_depth": entry.batcher.queue_depth(),
+                      "decode": entry.is_decode}
+                try:
+                    li["liveness"] = entry.batcher.lane_liveness()
+                except Exception as e:
+                    li["liveness"] = {"error": "%s: %s"
+                                      % (type(e).__name__, e)}
+                if entry.is_decode:
+                    # the freshest decode-step age across this lane set
+                    # — the "is anything still making progress" number
+                    ages = [l.get("last_step_age_s")
+                            for l in li["liveness"].get("lanes", [])
+                            if l.get("last_step_age_s") is not None]
+                    li["last_decode_step_age_s"] = min(ages) \
+                        if ages else None
+                minfo["lanes"][prec] = li
+            out[name] = minfo
+        return out
+
     # ------------------------------------------------------------------
 
     def _entry_locked(self, name, version, precision=None):

@@ -16,6 +16,11 @@ pickle ever touches a socket), carrying four commands:
   unload_model  {"cmd","name"} — drain then remove
   stats         {"cmd"} -> the ServingMetrics snapshot (now with
                  per-replica lane stats per model)
+  health        {"cmd"} -> per-model SLO state (ok/degraded/breach,
+                 burn rates) + lane/thread liveness + last-decode-step
+                 age (OBSERVABILITY.md "SLOs & burn rates")
+  flight        {"cmd","reason"?,"force"?} -> trigger a flight-recorder
+                 post-mortem bundle; reply carries the committed path
   shutdown      graceful drain, then the server stops accepting
 
 Admission control is the batcher's bounded queue: a request past
@@ -94,6 +99,17 @@ class InferenceServer:
         from ..obs import registry as obs_registry
         self._obs_registry = obs_registry.default()
         self._obs_registry.attach_serving(self.metrics)
+        # the judgment layer (OBSERVABILITY.md "SLOs & burn rates"):
+        # a background monitor samples this server's counters into a
+        # bounded time-series ring and evaluates declared SLOs
+        # (FLAGS.serving_slo / slo.declare) into the ok/degraded/
+        # breach state machine the `health` verb renders; breaches arm
+        # the flight recorder.  FLAGS.slo_monitor=false opts out.
+        self.slo = None
+        if FLAGS.slo_monitor:
+            from ..obs import slo as obs_slo
+            self.slo = obs_slo.SLOMonitor.from_flags(self.metrics)
+        self._flight_provider = None
         # `replicas`: default placement spec for every model this server
         # loads (int N / 'auto' / explicit device list — SERVING.md
         # multi-chip serving); a load_model RPC can override per model
@@ -165,6 +181,18 @@ class InferenceServer:
 
         self._server = Server(self._addr, Handler)
         self._addr = self._server.server_address
+        if self.slo is not None:
+            self.slo.name = self.endpoint
+            self.slo.start()
+            self._obs_registry.attach_slo(self.slo)
+        # flight-recorder provider: every post-mortem bundle carries
+        # this server's stats + registry/lane liveness + SLO timeline
+        # (no-op while FLAGS.flight_dir is unset)
+        from ..obs import flightrec
+        self._flight_provider = "serving_%s" % \
+            self.endpoint.replace(":", "_").replace(".", "-")
+        flightrec.add_provider(self._flight_provider,
+                               self._flight_snapshot)
         if background:
             self._thread = threading.Thread(target=self._serve,
                                             daemon=True)
@@ -189,6 +217,13 @@ class InferenceServer:
         self._draining = True
         self.registry.close_all(drain=drain, timeout=timeout)
         self._stopped = True
+        if self.slo is not None:
+            self.slo.stop()
+            self._obs_registry.detach_slo(self.slo)
+        if self._flight_provider is not None:
+            from ..obs import flightrec
+            flightrec.remove_provider(self._flight_provider)
+            self._flight_provider = None
         self._obs_registry.detach_serving(self.metrics)
         try:
             s = socket.create_connection(self._addr, timeout=1)
@@ -200,6 +235,34 @@ class InferenceServer:
 
     # ------------------------------------------------------------------
 
+    def _health_snapshot(self):
+        """The `health` verb payload: per-model SLO state + lane/thread
+        liveness + last-decode-step age — the fleet controller's (and
+        serving_top's) is-it-actually-serving readout, cheap enough to
+        poll every second."""
+        h = {"draining": bool(self._draining),
+             "models": self.registry.health()}
+        if self.slo is not None:
+            h["slo"] = self.slo.state()
+            h["slo_monitor"] = {"running": self.slo.running,
+                                "interval_s": self.slo.interval_s}
+        from ..obs import flightrec
+        rec = flightrec.get_recorder()
+        if rec is not None:
+            h["flight"] = rec.stats()
+        return h
+
+    def _flight_snapshot(self):
+        """Flight-recorder provider: what this server looked like at
+        dump time (bundle file serving_<endpoint>.json)."""
+        snap = {"endpoint": self.endpoint,
+                "stats": self.metrics.snapshot(),
+                "describe": self.registry.describe(),
+                "health": self._health_snapshot()}
+        if self.slo is not None:
+            snap["slo_timeline"] = self.slo.timeline()
+        return snap
+
     def _dispatch(self, msg):
         cmd = msg.get("cmd")
         if cmd == "infer":
@@ -207,6 +270,19 @@ class InferenceServer:
         if cmd == "stats":
             return {"ok": True, "stats": self.metrics.snapshot(),
                     "models": self.registry.describe()}
+        if cmd == "health":
+            return {"ok": True, "health": self._health_snapshot()}
+        if cmd == "flight":
+            # manual post-mortem: dump a bundle NOW (cooldown bypassed
+            # unless the caller asks otherwise); None = recorder
+            # disabled (FLAGS.flight_dir unset) or dump failed
+            from ..obs import flightrec
+            path = flightrec.trigger(
+                str(msg.get("reason") or "manual_rpc"),
+                force=bool(msg.get("force", True)),
+                endpoint=self.endpoint)
+            return {"ok": True, "bundle": path,
+                    "enabled": flightrec.get_recorder() is not None}
         if cmd == "metrics":
             # Prometheus-style text across training + serving — ONE
             # exposition (tools/metrics_dump.py renders it verbatim)
@@ -630,6 +706,19 @@ class ServingClient:
 
     def stats(self):
         return self._call({"cmd": "stats"})
+
+    def health(self):
+        """Per-model SLO state + lane liveness (the `health` verb's
+        payload): {"draining", "models": {...}, "slo": {...},
+        "flight": {...}} — see SERVING.md."""
+        return self._call({"cmd": "health"})["health"]
+
+    def flight(self, reason="manual_rpc", force=True):
+        """Trigger a flight-recorder bundle on the server; returns the
+        committed bundle path, or None while the recorder is disabled
+        (server-side FLAGS.flight_dir unset)."""
+        return self._call({"cmd": "flight", "reason": str(reason),
+                           "force": bool(force)}).get("bundle")
 
     def metrics_text(self):
         """The server's unified Prometheus-style exposition."""

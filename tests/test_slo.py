@@ -1,0 +1,595 @@
+"""SLO engine + flight recorder tests (paddle_tpu/obs/slo.py,
+paddle_tpu/obs/flightrec.py — OBSERVABILITY.md "SLOs & burn rates" /
+"Flight recorder").
+
+Pins the judgment-layer contracts on SYNTHETIC metric timelines (the
+monitor's tick() is driven directly, no thread, no sleeps): fast burn
+trips within two evaluations of a hard outage, slow burn needs a full
+slow window (trips late, by design), hysteresis prevents state
+flapping, and a recovery emits exactly one `slo_recovered`.  The
+flight recorder's cooldown survives a 4-thread trigger hammer
+(exactly one bundle), bundles validate deeply (manifest CRC walk) and
+corruption is named, keep-N rotates, and the serving surfaces (`health`
+RPC + ServingClient.health, serving_top SLO/LIVE columns, Prometheus
+slo_*/events_* families, metrics_dump ring-health row) carry the new
+signals.  Everything CPU-safe under JAX_PLATFORMS=cpu.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.flags import FLAGS, set_flags
+from paddle_tpu.obs import events as obs_events
+from paddle_tpu.obs import flightrec
+from paddle_tpu.obs import slo as obs_slo
+from paddle_tpu.obs import tracing as obs_tracing
+from paddle_tpu.serving import (InferenceServer, ServingClient,
+                                ServingMetrics, set_dispatch_delay)
+from paddle_tpu.serving.batcher import _guarded
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import flight_inspect  # noqa: E402
+import serving_top  # noqa: E402
+
+_OBS_DEFAULTS = {"trace": True, "trace_buffer_events": 4096,
+                 "trace_slow_ms": 0.0, "event_log": "",
+                 "event_log_max_kb": 1024, "serving_slo": "",
+                 "slo_monitor": True, "slo_eval_interval_ms": 1000.0,
+                 "flight_dir": "", "flight_keep": 8,
+                 "flight_cooldown_s": 30.0}
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    set_flags(dict(_OBS_DEFAULTS))
+    obs_tracing.configure()
+    obs_tracing.clear()
+    obs_events.configure()
+    flightrec.configure()
+    yield
+    set_dispatch_delay(0.0)
+    set_flags(dict(_OBS_DEFAULTS))
+    obs_tracing.configure()
+    obs_tracing.clear()
+    obs_events.configure()
+    flightrec.configure()
+
+
+def _mk_monitor(**slo_kwargs):
+    """A monitor over one synthetic model lane, stepped by hand."""
+    sm = ServingMetrics()
+    mm = sm.model("m")
+    kwargs = dict(error_rate=0.1, fast_window=4, slow_window=12,
+                  fast_burn=10.0, slow_burn=2.0, breach_evals=2,
+                  recover_evals=3)
+    kwargs.update(slo_kwargs)
+    mon = obs_slo.SLOMonitor(sm, slos={"m": obs_slo.SLO(**kwargs)},
+                             interval_s=0.05)
+    return mon, mm
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math on synthetic timelines
+# ---------------------------------------------------------------------------
+
+class TestBurnRate:
+    def test_fast_burn_trips_early_on_hard_outage(self):
+        """100% errors against a 10% budget burns at 10x: degraded on
+        the first evaluatable tick, breach on the second (breach_evals
+        hysteresis) — detection within 2 evaluation windows."""
+        mon, mm = _mk_monitor()
+        kinds = []
+        for i in range(4):
+            mm.requests.add(10)
+            mm.errors.add(10)
+            kinds += [k for k, _ in mon.tick()]
+        assert kinds == ["slo_degraded", "slo_breach"]
+        st = mon.state()["m"]
+        assert st["state"] == "breach"
+        assert st["tripped_by"] == "error_rate"
+        assert st["burn"]["error_rate"]["fast"] == pytest.approx(10.0)
+
+    def test_slow_burn_trips_late_needs_full_window(self):
+        """A 30% error rate (burn 3x: under fast_burn 10, over
+        slow_burn 2) must NOT trip until the slow window is full —
+        low-grade burns prove themselves over the whole window."""
+        mon, mm = _mk_monitor(slow_window=10)
+        states = []
+        for i in range(14):
+            mm.requests.add(10)
+            mm.errors.add(3)
+            mm.responses.add(7)
+            mon.tick()
+            states.append(mon.state()["m"]["state"])
+        # ticks 1..10 (9 intervals < slow_window samples): still ok
+        assert set(states[:10]) == {"ok"}, states
+        # once the slow window fills, the 3x burn trips -> escalates
+        assert states[-1] == "breach", states
+
+    def test_no_traffic_is_not_a_burn(self):
+        mon, mm = _mk_monitor()
+        for _ in range(8):
+            assert mon.tick() == []
+        assert mon.state()["m"]["state"] == "ok"
+
+    def test_latency_objective_uses_windowed_p95(self):
+        """The p95 SLI is the interval window's percentile, not the
+        lifetime reservoir: a fresh regression trips even after a long
+        healthy history."""
+        # budget 0.2 caps the indicator burn at 1/0.2 = 5x, so
+        # fast_burn must sit at or under that to be reachable
+        mon, mm = _mk_monitor(error_rate=None, p95_ms=50.0, budget=0.2,
+                              fast_burn=5.0)
+        for i in range(6):   # healthy history
+            mm.note_completion(latency_ms=5.0)
+            mon.tick()
+        assert mon.state()["m"]["state"] == "ok"
+        kinds = []
+        for i in range(7):   # regression: every completion 200ms
+            mm.note_completion(latency_ms=200.0)
+            mm.note_completion(latency_ms=210.0)
+            kinds += [k for k, _ in mon.tick()]
+        assert "slo_breach" in kinds
+        assert mon.state()["m"]["tripped_by"] == "p95_ms"
+
+    def test_hysteresis_prevents_flapping(self):
+        """A flapping workload — breach bursts separated by clean gaps
+        shorter than recover_evals — must produce ONE degraded + ONE
+        breach event and ZERO recoveries: no event storm, state pinned
+        at breach until a real sustained recovery."""
+        mon, mm = _mk_monitor(fast_window=2, breach_evals=2,
+                              recover_evals=3)
+        # bad=True marks the counters mutated before that tick
+        pattern = [True, True, True, True,   # burst: degraded, breach
+                   False, True, True,        # 1-clean gap, burst again
+                   False, True, True]        # ... and again
+        events = []
+        for bad in pattern:
+            mm.requests.add(10)
+            (mm.errors if bad else mm.responses).add(10)
+            events += [k for k, _ in mon.tick()]
+        assert events == ["slo_degraded", "slo_breach"], events
+        assert mon.state()["m"]["state"] == "breach"
+
+    def test_recovery_emits_exactly_one_slo_recovered(self):
+        mon, mm = _mk_monitor(recover_evals=3)
+        for _ in range(4):   # tick 1 is the baseline sample
+            mm.requests.add(10)
+            mm.errors.add(10)
+            mon.tick()
+        assert mon.state()["m"]["state"] == "breach"
+        kinds = []
+        for _ in range(10):
+            mm.requests.add(10)
+            mm.responses.add(10)
+            kinds += [k for k, _ in mon.tick()]
+        assert kinds == ["slo_recovered"], kinds
+        st = mon.state()["m"]
+        assert st["state"] == "ok" and st["recoveries"] == 1
+
+    def test_shed_rate_and_spec_accept_objectives(self):
+        mon, mm = _mk_monitor(error_rate=None, shed_rate=0.05,
+                              spec_accept=0.8, fast_window=3)
+        for _ in range(4):   # half the offered load sheds: burn 10x
+            mm.requests.add(10)
+            mm.shed.add(10)
+            mm.draft_tokens.add(10)
+            mm.accepted_tokens.add(3)  # accept 0.3 < 0.8 floor
+            mon.tick()
+        st = mon.state()["m"]
+        assert st["state"] == "breach"
+        burns = st["burn"]
+        assert burns["shed_rate"]["fast"] == pytest.approx(10.0)
+        # spec accept is an indicator objective against SLO.budget
+        assert burns["spec_accept"]["fast"] == pytest.approx(10.0)
+
+    def test_parse_slo_spec_forms(self):
+        spec = ("p95_ms=250,error_rate=0.01;"
+                "llm:ttft_p95_ms=400,spec_accept=0.5,fast_window=8")
+        slos = obs_slo.parse_slo_spec(spec)
+        assert slos["*"].p95_ms == 250.0
+        assert slos["*"].error_rate == 0.01
+        assert slos["llm"].ttft_p95_ms == 400.0
+        assert slos["llm"].fast_window == 8
+        assert obs_slo.parse_slo_spec("") == {}
+        with pytest.raises(ValueError):
+            obs_slo.parse_slo_spec("bogus_key=1")
+
+    def test_lane_key_resolution_prefers_specific(self):
+        mon = obs_slo.SLOMonitor(
+            ServingMetrics(),
+            slos={"*": obs_slo.SLO(p95_ms=1),
+                  "m": obs_slo.SLO(p95_ms=2),
+                  "m@int8": obs_slo.SLO(p95_ms=3)},
+            interval_s=0.05)
+        assert mon.slo_for("m@int8").p95_ms == 3
+        assert mon.slo_for("m").p95_ms == 2
+        assert mon.slo_for("other").p95_ms == 1
+
+    def test_timeline_ring_is_bounded(self):
+        sm = ServingMetrics()
+        sm.model("m")
+        mon = obs_slo.SLOMonitor(sm, slos={}, interval_s=0.01,
+                                 timeline_samples=16)
+        for _ in range(40):
+            mon.tick()
+        tl = mon.timeline()["m"]
+        assert len(tl) == 16
+        assert set(tl[-1]) >= {"ts", "requests", "responses", "errors"}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_bundle_complete_and_valid(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), keep=4,
+                                       cooldown_s=30.0)
+        rec.add_provider("demo", lambda: {"answer": 42})
+        with obs_tracing.trace("t", kind="serving"):
+            pass
+        obs_events.emit("probe", x=1)
+        path = rec.trigger("watchdog_fire", what="step")
+        assert path and os.path.isdir(path)
+        assert flightrec.validate_bundle(path) == []
+        manifest = flightrec.read_manifest(path)
+        assert manifest["reason"] == "watchdog_fire"
+        assert manifest["context"]["what"] == "step"
+        names = set(manifest["files"])
+        assert set(flightrec.REQUIRED_FILES) <= names
+        assert "demo.json" in names
+        with open(os.path.join(path, "demo.json")) as f:
+            assert json.load(f) == {"answer": 42}
+        with open(os.path.join(path, "threads.txt")) as f:
+            assert "--- thread" in f.read()
+        # the trigger also lands a flight_dumped event
+        assert obs_events.recent_events(kind="flight_dumped")
+
+    def test_cooldown_under_4_thread_trigger_hammer(self, tmp_path):
+        """The breach-storm contract: 4 threads x 25 triggers of one
+        reason within the cooldown produce exactly ONE bundle; a
+        different reason gets its own."""
+        rec = flightrec.FlightRecorder(str(tmp_path), keep=16,
+                                       cooldown_s=60.0)
+        paths = []
+        lock = threading.Lock()
+
+        def hammer():
+            for _ in range(25):
+                p = rec.trigger("slo_breach", model="m")
+                if p is not None:
+                    with lock:
+                        paths.append(p)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        assert len(paths) == 1, \
+            "cooldown leaked: %d bundles from one storm" % len(paths)
+        assert len(rec.list_bundles()) == 1
+        # a different reason has its own cooldown bucket
+        assert rec.trigger("thread_death") is not None
+        assert len(rec.list_bundles()) == 2
+
+    def test_keep_n_rotation(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), keep=2,
+                                       cooldown_s=0.0)
+        paths = [rec.dump("r%d" % i) for i in range(4)]
+        kept = rec.list_bundles()
+        assert len(kept) == 2
+        assert kept == sorted(paths[-2:])
+
+    def test_validation_names_corruption(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path))
+        path = rec.dump("probe")
+        target = os.path.join(path, "flags.json")
+        with open(target, "ab") as f:
+            f.write(b"tampered")
+        problems = flightrec.validate_bundle(path)
+        assert any("flags.json" in p for p in problems)
+
+    def test_disabled_trigger_is_noop(self):
+        assert flightrec.get_recorder() is None
+        assert flightrec.trigger("slo_breach") is None
+
+    def test_flag_configures_default_recorder(self, tmp_path):
+        set_flags({"flight_dir": str(tmp_path / "fl"),
+                   "flight_cooldown_s": 0.0, "flight_keep": 3})
+        rec = flightrec.get_recorder()
+        assert rec is not None and rec.keep == 3
+        p = flightrec.trigger("manual")
+        assert p is not None and flightrec.validate_bundle(p) == []
+
+    def test_thread_death_guard_emits_and_triggers(self, tmp_path):
+        """A batcher thread dying un-handled must land a
+        server_thread_death event and a flight bundle before
+        re-raising — the wedge post-mortem."""
+        set_flags({"flight_dir": str(tmp_path / "fl"),
+                   "flight_cooldown_s": 0.0})
+
+        def boom():
+            raise RuntimeError("lane exploded")
+
+        wrapped = _guarded(boom, lambda: "m", "lane")
+
+        def runner():
+            try:
+                wrapped()
+            except RuntimeError:
+                pass  # the guard re-raises after recording
+
+        t = threading.Thread(target=runner, daemon=True)
+        t.start()
+        t.join(timeout=30)
+        (ev,) = obs_events.recent_events(kind="server_thread_death")
+        assert ev["model"] == "m" and "lane exploded" in ev["error"]
+        bundles = flightrec.get_recorder().list_bundles()
+        assert len(bundles) == 1
+        manifest = flightrec.read_manifest(bundles[0])
+        assert manifest["reason"] == "thread_death"
+
+
+# ---------------------------------------------------------------------------
+# serving surfaces
+# ---------------------------------------------------------------------------
+
+def _export_fc(tmp_path, seed=3, name="m", size=6):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=size, act="relu")
+        pred = fluid.layers.fc(input=h, size=size, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = str(tmp_path / name)
+        fluid.save_inference_model(md, ["x"], [pred], exe,
+                                   main_program=main)
+    return md
+
+
+@pytest.fixture()
+def slo_server(tmp_path):
+    set_flags({"serving_slo": ("m:p95_ms=25,budget=0.2,fast_window=3,"
+                               "slow_window=10,fast_burn=5,"
+                               "breach_evals=2,recover_evals=2"),
+               "slo_eval_interval_ms": 80.0,
+               "flight_dir": str(tmp_path / "flight"),
+               "flight_cooldown_s": 30.0})
+    md = _export_fc(tmp_path)
+    srv = InferenceServer(endpoint="127.0.0.1:0").start()
+    srv.registry.load_model("m", md, buckets=[2, 4])
+    cli = ServingClient(srv.endpoint)
+    try:
+        yield srv, cli, md
+    finally:
+        set_dispatch_delay(0.0)
+        cli.close()
+        srv.shutdown(drain=False, timeout=5.0)
+
+
+class TestServingHealth:
+    def test_health_rpc_shape_and_liveness(self, slo_server):
+        srv, cli, md = slo_server
+        cli.infer("m", {"x": np.zeros((1, 4), np.float32)},
+                  deadline_ms=10000)
+        h = cli.health()
+        assert set(h) >= {"draining", "models", "slo", "slo_monitor",
+                          "flight"}
+        assert h["draining"] is False
+        assert h["slo_monitor"]["running"] is True
+        lane = h["models"]["m"]["lanes"]["fp32"]
+        assert lane["decode"] is False
+        live = lane["liveness"]
+        assert live["kind"] == "batch" and live["router_alive"]
+        assert live["lanes"][0]["alive"] >= 1
+        assert live["lanes"][0]["last_dispatch_age_s"] is not None
+        assert h["flight"]["bundles"] == 0
+
+    def test_breach_detected_and_bundle_fires_end_to_end(
+            self, slo_server):
+        """The acceptance loop in-process: injected latency -> breach
+        within 2 evaluation windows -> exactly one valid bundle ->
+        recovery emits one slo_recovered -> replies bit-exact."""
+        srv, cli, md = slo_server
+        x = np.linspace(-1, 1, 4, dtype=np.float32).reshape(1, 4)
+        ref = cli.infer("m", {"x": x}, deadline_ms=10000)
+        set_dispatch_delay(0.06)
+        budget_s = (2 * 3 + 1) * 0.08  # 2 fast windows + 1 tick slack
+        t0 = time.monotonic()
+        breach_at = None
+        while time.monotonic() - t0 < budget_s + 3.0:
+            cli.infer("m", {"x": x}, deadline_ms=10000)
+            if obs_events.recent_events(kind="slo_breach"):
+                breach_at = time.monotonic() - t0
+                break
+        assert breach_at is not None, "breach never detected"
+        assert breach_at <= budget_s, \
+            "detected after %.2fs > 2-window budget %.2fs" \
+            % (breach_at, budget_s)
+        assert cli.health()["slo"]["m"]["state"] == "breach"
+        deadline = time.monotonic() + 10.0
+        bundles = []
+        while time.monotonic() < deadline and not bundles:
+            bundles = flightrec.get_recorder().list_bundles()
+            time.sleep(0.02)
+        assert len(bundles) == 1
+        assert flightrec.validate_bundle(bundles[0]) == []
+        set_dispatch_delay(0.0)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0:
+            cli.infer("m", {"x": x}, deadline_ms=10000)
+            if obs_events.recent_events(kind="slo_recovered"):
+                break
+            time.sleep(0.04)
+        assert len(obs_events.recent_events(kind="slo_recovered")) == 1
+        assert cli.health()["slo"]["m"]["state"] == "ok"
+        out = cli.infer("m", {"x": x}, deadline_ms=10000)
+        assert np.array_equal(out[0], ref[0]), \
+            "SLO monitoring changed reply bits"
+
+    def test_flight_rpc_manual_dump(self, slo_server):
+        srv, cli, md = slo_server
+        path = cli.flight(reason="operator_probe")
+        assert path is not None
+        assert flightrec.validate_bundle(path) == []
+        manifest = flightrec.read_manifest(path)
+        assert manifest["reason"] == "operator_probe"
+        # the bundle carries this server's snapshot provider file
+        assert any(n.startswith("serving_") for n in manifest["files"])
+
+    def test_prometheus_families_and_serving_top_columns(
+            self, slo_server):
+        srv, cli, md = slo_server
+        cli.infer("m", {"x": np.zeros((1, 4), np.float32)},
+                  deadline_ms=10000)
+        time.sleep(0.3)  # a couple of monitor ticks
+        text = cli.metrics_text()
+        assert 'paddle_tpu_slo_state{model="m"}' in text
+        assert "paddle_tpu_events_dropped_total" in text
+        assert "paddle_tpu_events_sink_dead" in text
+        assert "paddle_tpu_events_rotations_total" in text
+        table = serving_top.render(cli.stats(), health=cli.health())
+        hdr = table.splitlines()[2]
+        assert "SLO" in hdr and "LIVE" in hdr
+        row = next(l for l in table.splitlines() if l.startswith("m "))
+        assert " ok " in row or row.rstrip().endswith("ok") \
+            or "1/1" in row
+
+    def test_slo_monitor_flag_off_no_thread(self, tmp_path):
+        set_flags({"slo_monitor": False})
+        md = _export_fc(tmp_path, name="m2")
+        srv = InferenceServer(endpoint="127.0.0.1:0").start()
+        try:
+            srv.registry.load_model("m2", md, buckets=[2])
+            cli = ServingClient(srv.endpoint)
+            h = cli.health()
+            assert "slo" not in h
+            assert "models" in h  # liveness still served
+            cli.close()
+        finally:
+            srv.shutdown(drain=False, timeout=5.0)
+
+
+class TestEventAttribution:
+    def test_deadline_and_slow_events_carry_replica(self, slo_server):
+        srv, cli, md = slo_server
+        set_flags({"trace_slow_ms": 1.0})
+        x = np.zeros((1, 4), np.float32)
+        set_dispatch_delay(0.05)
+        cli.infer("m", {"x": x}, deadline_ms=10000)
+        (slow,) = obs_events.recent_events(n=1, kind="slow")
+        assert slow["replica"] == 0 and "device" in slow
+        # deadline so short the dispatch screen expires it in-lane
+        set_dispatch_delay(0.15)
+        with pytest.raises(Exception):
+            cli.infer("m", {"x": x}, deadline_ms=60.0,
+                      retry_sheds=False)
+        deadline = time.monotonic() + 10.0
+        evs = []
+        while time.monotonic() < deadline and not evs:
+            evs = obs_events.recent_events(kind="deadline_expired")
+            time.sleep(0.02)
+        assert evs and evs[-1]["replica"] == 0
+        set_dispatch_delay(0.0)
+
+    def test_shed_event_carries_lane_occupancy(self, tmp_path):
+        md = _export_fc(tmp_path, name="m3")
+        srv = InferenceServer(endpoint="127.0.0.1:0",
+                              max_queue=1).start()
+        try:
+            srv.registry.load_model("m3", md, buckets=[2])
+            set_dispatch_delay(0.2)
+            x = np.zeros((1, 4), np.float32)
+            futs = []
+            from paddle_tpu.serving import ServerOverloaded
+            with pytest.raises(ServerOverloaded):
+                for _ in range(8):
+                    futs.append(srv.registry.submit("m3", {"x": x}))
+            (shed,) = obs_events.recent_events(n=1, kind="shed")
+            assert "inflight" in shed and "queue" in shed
+            set_dispatch_delay(0.0)
+            for f in futs:
+                f.result(timeout=30)
+        finally:
+            set_dispatch_delay(0.0)
+            srv.shutdown(drain=False, timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# CLIs + chaos
+# ---------------------------------------------------------------------------
+
+def _run_cli(args, **kw):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.update(kw.pop("env", {}))
+    return subprocess.run([sys.executable] + args, cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=300,
+                          **kw)
+
+
+class TestCLIs:
+    def test_flight_inspect_list_validate_show_exit_codes(
+            self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), cooldown_s=0.0)
+        rec.add_provider("demo", lambda: {"n": 1})
+        p1 = rec.dump("probe_a")
+        rec.dump("probe_b")
+        # in-process main(): list + validate clean
+        assert flight_inspect.main([str(tmp_path)]) == 0
+        assert flight_inspect.main([str(tmp_path), "--validate"]) == 0
+        assert flight_inspect.main([p1, "--show"]) == 0
+        # corrupt one payload: validate exits 2 and names it
+        with open(os.path.join(p1, "demo.json"), "ab") as f:
+            f.write(b"x")
+        assert flight_inspect.main([str(tmp_path), "--validate"]) == 2
+        assert flight_inspect.main(
+            [str(tmp_path / "nowhere")]) == 1
+
+    def test_flight_inspect_cli_subprocess_json(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), cooldown_s=0.0)
+        rec.dump("probe")
+        proc = _run_cli([os.path.join("tools", "flight_inspect.py"),
+                         str(tmp_path), "--validate", "--json"])
+        assert proc.returncode == 0, proc.stderr
+        rows = json.loads(proc.stdout)
+        assert rows and rows[0]["reason"] == "probe"
+        assert rows[0]["valid"] is True
+
+    def test_metrics_dump_local_ring_health_row(self):
+        proc = _run_cli([os.path.join("tools", "metrics_dump.py"),
+                         "--local"])
+        assert proc.returncode == 0, proc.stderr
+        assert "# ring-health: spans buffered=" in proc.stdout
+        assert "sink=none" in proc.stdout
+
+    def test_chaos_slo_breach_scenario_inprocess(self, tmp_path):
+        """The tier-1 subset of the acceptance scenario (the SIGKILL
+        phase runs in the ci_checks `slo` gate)."""
+        import chaos
+        res = chaos.scenario_slo_breach(str(tmp_path), verbose=False,
+                                        kill_phase=False)
+        assert res["breach_s"] <= res["budget_s"]
+
+    def test_ci_checks_has_slo_gate(self):
+        with open(os.path.join(REPO, "tools", "ci_checks.sh")) as f:
+            src = f.read()
+        assert "slo)" in src and "exit 14" in src
+        assert "flight_inspect.py" in src

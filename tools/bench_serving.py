@@ -791,6 +791,12 @@ def main():
                          "overhead A/B pair (OBSERVABILITY.md pins "
                          "<3%% throughput delta on this smoke lane, "
                          "BENCH_r09.json)")
+    ap.add_argument("--slo", choices=["on", "off"], default=None,
+                    help="force the SLO monitor for the run: 'on' also "
+                         "declares a default p95/error-rate SLO so the "
+                         "monitor does real evaluation work — the "
+                         "monitor-overhead A/B pair (<3%% delta "
+                         "acceptance, BENCH_r13.json)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fc model, short sweep (CI path)")
     ap.add_argument("--require_tpu", action="store_true")
@@ -828,6 +834,16 @@ def main():
                    "compile_cache_dir": args.compile_cache_dir})
     if args.trace is not None:
         set_flags({"trace": args.trace == "on"})
+    if args.slo is not None:
+        if args.slo == "on":
+            # a real SLO so every tick samples AND evaluates burn
+            # windows — the honest monitor-ON configuration (targets
+            # generous enough that the bench itself never breaches)
+            set_flags({"slo_monitor": True,
+                       "slo_eval_interval_ms": 250.0,
+                       "serving_slo": "p95_ms=10000,error_rate=0.05"})
+        else:
+            set_flags({"slo_monitor": False, "serving_slo": ""})
 
     if args.decode:
         if args.deadline_ms is None:
@@ -941,6 +957,7 @@ def main():
                     "chaos_proxy": bool(proxy),
                     "chaos_slow_ms": args.chaos_slow_ms,
                     "trace": bool(FLAGS.trace),
+                    "slo_monitor": bool(FLAGS.slo_monitor),
                 })
                 if backend_label:
                     rec["backend"] = backend_label

@@ -88,6 +88,8 @@ CHAOS_POINTS = ("array_written", "arrays_written", "manifest_written",
 CACHE_POINTS = ("cc_exec_written", "cc_committed")
 # PTQ artifact commit points (paddle_tpu/inference/quantize.py)
 QUANT_POINTS = ("quant_arrays_written", "quant_committed")
+# flight-recorder bundle commit point (paddle_tpu/obs/flightrec.py)
+FLIGHT_POINTS = ("flight_committed",)
 
 
 # ---------------------------------------------------------------------------
@@ -1220,6 +1222,232 @@ def scenario_trace_overflow(workdir, verbose=True):
             "max_emit_ms": slow[0] * 1e3}
 
 
+def _child_flight(workdir):
+    """Subprocess target for the SIGKILL-mid-dump half of the
+    slo-breach scenario: commit one clean bundle, then trigger a
+    second — PADDLE_TPU_CHAOS='flight_committed=exit@2' kills this
+    process between the tmp fsync and the publishing rename, so the
+    parent must find bundle #1 intact + at most a stale _tmp dir."""
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.obs import flightrec
+    set_flags({"flight_dir": workdir, "flight_cooldown_s": 0.0,
+               "flight_keep": 8})
+    rec = flightrec.get_recorder()
+    rec.add_provider("probe", lambda: {"child": os.getpid()})
+    p1 = rec.trigger("chaos_a", force=True)
+    print("CHILD_BUNDLE_1 %s" % p1, flush=True)
+    rec.trigger("chaos_b", force=True)  # chaos point fires here
+    print("CHILD_BUNDLE_2_COMMITTED", flush=True)
+
+
+def scenario_slo_breach(workdir, verbose=True, kill_phase=True):
+    """The SLO engine + flight recorder, end to end (OBSERVABILITY.md
+    "SLOs & burn rates" / "Flight recorder"):
+
+    1. an in-process server with a declared p95 SLO serves clean
+       traffic (state ok; replies captured for the bit-exactness
+       check);
+    2. injected dispatch latency (set_dispatch_delay) pushes every
+       interval past the target: the breach must be DETECTED within 2
+       fast-burn evaluation windows, flip the health state machine to
+       'breach', and fire the flight recorder exactly once (cooldown
+       absorbs the storm);
+    3. the produced bundle must be complete and valid
+       (flight_inspect's deep validation: manifest CRC walk, required
+       files, JSONL parse);
+    4. clearing the latency must recover the state machine with
+       exactly ONE slo_recovered event, and replies must be
+       bit-identical to the pre-chaos captures — monitoring never
+       touches the bits;
+    5. a REAL kill mid-dump (subprocess at the flight_committed chaos
+       point) leaves prior bundles intact + only a stale tmp dir,
+       and the next dump sweeps it."""
+    import glob
+    import numpy as np
+    import tempfile
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.flags import set_flags, get_flags
+    from paddle_tpu.obs import events as obs_events
+    from paddle_tpu.obs import flightrec
+    from paddle_tpu.serving import (InferenceServer, ServingClient,
+                                    set_dispatch_delay)
+    sys.path.insert(0, HERE)
+    import flight_inspect
+
+    os.makedirs(workdir, exist_ok=True)
+    flight_dir = os.path.join(workdir, "flight")
+    interval_ms = 100.0
+    fast_window = 3
+    saved = get_flags(["serving_slo", "slo_eval_interval_ms",
+                       "slo_monitor", "flight_dir", "flight_keep",
+                       "flight_cooldown_s"])
+    set_flags({
+        "slo_monitor": True,
+        "slo_eval_interval_ms": interval_ms,
+        # p95 target far under the injected 60 ms stall; budget 0.2
+        # means a fully-bad fast window burns at 5x (>= the scaled
+        # fast_burn threshold below) — trips in 2 evaluations
+        "serving_slo": ("m:p95_ms=25,budget=0.2,fast_window=%d,"
+                        "slow_window=10,fast_burn=5,breach_evals=2,"
+                        "recover_evals=2" % fast_window),
+        "flight_dir": flight_dir,
+        "flight_keep": 8,
+        "flight_cooldown_s": 30.0,
+    })
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = os.path.join(tempfile.mkdtemp(prefix="chaos_slo_"), "m")
+        fluid.save_inference_model(md, ["x"], [pred], exe,
+                                   main_program=main_p)
+
+    server = InferenceServer(max_queue=64).start()
+    cli = ServingClient(server.endpoint)
+    x_req = np.linspace(-1, 1, 8, dtype=np.float32).reshape(1, 8)
+    try:
+        cli.load_model("m", md, buckets=[2, 4])
+        ref = cli.infer("m", {"x": x_req}, deadline_ms=10000)
+        # let a couple of clean evaluations land: state must be ok
+        time.sleep(3 * interval_ms / 1000.0)
+        h = cli.health()
+        assert h["slo"]["m"]["state"] == "ok", \
+            "clean traffic reads %r" % h["slo"]["m"]
+        assert h["models"]["m"]["lanes"]["fp32"]["liveness"][
+            "router_alive"], "router not alive in health readout"
+
+        # phase 2: inject latency, drive traffic, require detection
+        # within 2 evaluation windows (2 * fast_window ticks) + one
+        # interval of sampling slack
+        set_dispatch_delay(0.06)
+        detect_budget = (2 * fast_window + 1) * interval_ms / 1000.0
+        t0 = time.monotonic()
+        breach_at = None
+        while time.monotonic() - t0 < detect_budget + 2.0:
+            cli.infer("m", {"x": x_req}, deadline_ms=10000)
+            if obs_events.recent_events(kind="slo_breach"):
+                breach_at = time.monotonic() - t0
+                break
+        assert breach_at is not None, \
+            "no slo_breach within %.1fs" % (detect_budget + 2.0)
+        assert breach_at <= detect_budget, \
+            "breach detected after %.2fs — budget is 2 evaluation " \
+            "windows (%.2fs)" % (breach_at, detect_budget)
+        assert cli.health()["slo"]["m"]["state"] == "breach"
+
+        # phase 3: exactly one bundle (cooldown absorbs the storm),
+        # complete and valid
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            bundles = flightrec.list_bundles(flight_dir)
+            if bundles:
+                break
+            time.sleep(0.05)
+        assert bundles, "breach never produced a flight bundle"
+        # keep breaching a while longer: still one bundle
+        for _ in range(10):
+            cli.infer("m", {"x": x_req}, deadline_ms=10000)
+        assert len(flightrec.list_bundles(flight_dir)) == 1, \
+            "cooldown failed: breach storm wrote %d bundles" \
+            % len(flightrec.list_bundles(flight_dir))
+        problems = flightrec.validate_bundle(bundles[0])
+        assert not problems, "bundle invalid: %s" % problems
+        assert flight_inspect.main([flight_dir, "--validate"]) == 0, \
+            "flight_inspect --validate rejected a fresh bundle"
+        manifest = flightrec.read_manifest(bundles[0])
+        assert manifest["reason"] == "slo_breach"
+        # the bundle must carry the server snapshot + SLO timeline
+        server_files = [n for n in manifest["files"]
+                        if n.startswith("serving_")]
+        assert server_files, "bundle missing the server snapshot"
+        with open(os.path.join(bundles[0], server_files[0])) as f:
+            snap = json.load(f)
+        assert snap.get("slo_timeline", {}).get("m"), \
+            "bundle missing the SLO metrics timeline"
+
+        # phase 4: recovery — exactly one slo_recovered, bits intact
+        set_dispatch_delay(0.0)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0:
+            cli.infer("m", {"x": x_req}, deadline_ms=10000)
+            if obs_events.recent_events(kind="slo_recovered"):
+                break
+            time.sleep(0.05)
+        recovered = obs_events.recent_events(kind="slo_recovered")
+        assert len(recovered) == 1, \
+            "expected exactly one slo_recovered, got %d" % len(recovered)
+        assert cli.health()["slo"]["m"]["state"] == "ok"
+        out = cli.infer("m", {"x": x_req}, deadline_ms=10000)
+        assert np.array_equal(out[0], ref[0]), \
+            "SLO monitoring changed reply bits"
+    finally:
+        set_dispatch_delay(0.0)
+        try:
+            cli.close()
+        finally:
+            server.shutdown(drain=False, timeout=5.0)
+            set_flags(saved)
+
+    # phase 5: REAL kill mid-dump — prior bundles survive intact
+    # (kill_phase=False = the tier-1 in-process subset; the ci_checks
+    # `slo` gate always runs the kill)
+    if not kill_phase:
+        if verbose:
+            print("PASS slo-breach (no-kill subset): detected in "
+                  "%.2fs (budget %.2fs)" % (breach_at, detect_budget))
+        return {"breach_s": breach_at, "budget_s": detect_budget}
+    kill_dir = os.path.join(workdir, "flight_kill")
+    env = dict(os.environ)
+    env["PADDLE_TPU_CHAOS"] = "flight_committed=exit@2"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--child-flight", kill_dir],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 137, \
+        "child should die at flight_committed@2 (rc=%d, out=%s)" \
+        % (proc.returncode, proc.stdout + proc.stderr)
+    assert "CHILD_BUNDLE_1" in proc.stdout
+    assert "CHILD_BUNDLE_2_COMMITTED" not in proc.stdout
+    survivors = flightrec.list_bundles(kill_dir)
+    assert len(survivors) == 1, \
+        "kill mid-dump should leave exactly the prior bundle: %s" \
+        % survivors
+    assert not flightrec.validate_bundle(survivors[0]), \
+        "prior bundle corrupted by the mid-dump kill"
+    stale = glob.glob(os.path.join(kill_dir, "_tmp.flight_*"))
+    assert len(stale) == 1, "expected one stale tmp dir, got %s" % stale
+    # recovery: a fresh dump sweeps the stale tmp and commits
+    env.pop("PADDLE_TPU_CHAOS")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--child-flight", kill_dir],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert not glob.glob(os.path.join(kill_dir, "_tmp.flight_*")), \
+        "recovery dump did not sweep the stale tmp dir"
+    survivors = flightrec.list_bundles(kill_dir)
+    assert len(survivors) == 3, \
+        "recovery should add 2 bundles to the survivor: %s" % survivors
+    for b in survivors:
+        assert not flightrec.validate_bundle(b)
+
+    if verbose:
+        print("PASS slo-breach: detected in %.2fs (budget %.2fs), "
+              "state ok->breach->ok, 1 bundle under cooldown "
+              "(valid, with server snapshot + SLO timeline), exactly "
+              "1 slo_recovered, replies bit-exact, kill@"
+              "flight_committed left prior bundle intact + tmp swept"
+              % (breach_at, detect_budget))
+    return {"breach_s": breach_at, "budget_s": detect_budget}
+
+
 def run_smoke(workdir):
     """Tier-1 smoke: deterministic crash at every commit point + the
     bit-flip rejection — no timing races, CPU-only, a few seconds."""
@@ -1250,12 +1478,14 @@ def main(argv=None):
                                            "quantize-commit",
                                            "trace-overflow",
                                            "decode-disconnect",
-                                           "spec-fallback", "all"])
+                                           "spec-fallback",
+                                           "slo-breach", "all"])
     ap.add_argument("--smoke", action="store_true",
                     help="fast deterministic subset for CI")
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--point", default="manifest_written",
-                    choices=CHAOS_POINTS + CACHE_POINTS + QUANT_POINTS)
+                    choices=CHAOS_POINTS + CACHE_POINTS + QUANT_POINTS
+                    + FLIGHT_POINTS)
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--no-real-kill", action="store_true",
                     help="child os._exit(137)s at the point instead of "
@@ -1265,6 +1495,8 @@ def main(argv=None):
     ap.add_argument("--child-cache", metavar="DIR",
                     help=argparse.SUPPRESS)  # internal subprocess target
     ap.add_argument("--child-quant", metavar="DIR",
+                    help=argparse.SUPPRESS)  # internal subprocess target
+    ap.add_argument("--child-flight", metavar="DIR",
                     help=argparse.SUPPRESS)  # internal subprocess target
     ap.add_argument("--chaos-spec", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--chaos-at-save", type=int, default=0,
@@ -1281,6 +1513,9 @@ def main(argv=None):
     if args.child_quant:
         _child_quant(args.child_quant)
         return 0
+    if args.child_flight:
+        _child_flight(args.child_flight)
+        return 0
 
     import tempfile
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_")
@@ -1290,7 +1525,8 @@ def main(argv=None):
         scenarios = ["crash-save", "bit-flip", "nan-poison", "drop-rpc",
                      "serving-overload", "cache-commit",
                      "quantize-commit", "trace-overflow",
-                     "decode-disconnect", "spec-fallback"]
+                     "decode-disconnect", "spec-fallback",
+                     "slo-breach"]
     else:
         scenarios = [args.scenario]
     rc = 0
@@ -1329,6 +1565,8 @@ def main(argv=None):
                 scenario_decode_disconnect()
             elif s == "spec-fallback":
                 scenario_spec_fallback()
+            elif s == "slo-breach":
+                scenario_slo_breach(os.path.join(workdir, "slo_breach"))
         except AssertionError as e:
             rc = 1
             print("FAIL %s: %s" % (s, e))

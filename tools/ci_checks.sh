@@ -17,6 +17,11 @@
 #     13  specdec        speculative-decode smoke (the bench subprocess
 #                        test: draft/verify/commit path + bit-exact
 #                        replay, tests/test_spec_decode.py)
+#     14  slo            SLO engine + flight recorder smoke: the
+#                        slo-breach chaos scenario (injected latency ->
+#                        breach within 2 windows -> bundle) plus
+#                        flight_inspect --validate on the produced
+#                        bundle (OBSERVABILITY.md)
 #      1  usage          unknown gate name
 #      0  all requested gates clean
 #
@@ -32,7 +37,7 @@ SPEC="${API_SPEC:-API.spec}"
 
 gates=("$@")
 if [ ${#gates[@]} -eq 0 ]; then
-    gates=(lint_runtime lint_program apispec specdec)
+    gates=(lint_runtime lint_program apispec specdec slo)
 fi
 
 for gate in "${gates[@]}"; do
@@ -62,9 +67,25 @@ for gate in "${gates[@]}"; do
             "$PY" -m pytest tests/test_spec_decode.py -q \
                 -k "bench_smoke" -p no:cacheprovider || exit 13
             ;;
+        slo)
+            echo "== ci_checks: slo gate =="
+            slodir="$(mktemp -d)"
+            "$PY" tools/chaos.py --scenario slo-breach \
+                --workdir "$slodir" || { rm -rf "$slodir"; exit 14; }
+            # deep-validate BOTH bundle roots the scenario produced
+            # (the breach bundle + the kill-recovery survivors)
+            "$PY" tools/flight_inspect.py \
+                "$slodir/slo_breach/flight" --validate \
+                || { rm -rf "$slodir"; exit 14; }
+            "$PY" tools/flight_inspect.py \
+                "$slodir/slo_breach/flight_kill" --validate \
+                || { rm -rf "$slodir"; exit 14; }
+            rm -rf "$slodir"
+            ;;
         *)
             echo "ci_checks: unknown gate '$gate'" \
-                 "(have: lint_runtime lint_program apispec specdec)"
+                 "(have: lint_runtime lint_program apispec specdec" \
+                 "slo)"
             exit 1
             ;;
     esac

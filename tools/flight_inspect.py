@@ -1,0 +1,164 @@
+"""flight_inspect — list, validate, and pretty-print flight-recorder
+bundles (paddle_tpu/obs/flightrec.py, OBSERVABILITY.md "Flight
+recorder").
+
+    python tools/flight_inspect.py <flight_dir>             # list
+    python tools/flight_inspect.py <flight_dir> --validate  # CRC walk
+    python tools/flight_inspect.py <bundle_dir> --show      # one bundle
+    python tools/flight_inspect.py <path> --json
+
+`<path>` may be the recorder root (containing `flight_*` bundle dirs)
+or one bundle directory (containing MANIFEST.json).  Validation
+deep-checks every bundle: manifest parses, every listed file exists
+with matching size + CRC32, the required files are present, and every
+JSONL/JSON payload parses — the same checks the slo-breach chaos
+scenario and the ci_checks `slo` gate run on freshly-produced bundles.
+
+Exit codes: 0 all good, 2 validation problems (each printed as
+`bundle: problem`), 1 usage / path errors.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _is_bundle(path):
+    return os.path.exists(os.path.join(path, "MANIFEST.json"))
+
+
+def _bundle_row(path, manifest):
+    files = manifest.get("files") or {}
+    return {
+        "bundle": os.path.basename(path),
+        "reason": manifest.get("reason"),
+        "ts": manifest.get("ts"),
+        "pid": manifest.get("pid"),
+        "files": len(files),
+        "bytes": sum(int(m.get("bytes", 0)) for m in files.values()),
+        "dump_ms": manifest.get("dump_ms"),
+        "context": manifest.get("context") or {},
+    }
+
+
+def _show(path, manifest):
+    from paddle_tpu.obs import flightrec
+    print("bundle   %s" % os.path.basename(path))
+    print("reason   %s" % manifest.get("reason"))
+    print("ts       %s" % manifest.get("ts"))
+    print("pid      %s   dump_ms %s" % (manifest.get("pid"),
+                                        manifest.get("dump_ms")))
+    ctx = manifest.get("context") or {}
+    if ctx:
+        print("context  %s" % json.dumps(ctx, sort_keys=True))
+    print("files:")
+    for name, meta in sorted((manifest.get("files") or {}).items()):
+        print("  %-28s %8d bytes  crc32 %s"
+              % (name, meta.get("bytes", 0), meta.get("crc32")))
+    problems = flightrec.validate_bundle(path)
+    print("validate %s" % ("OK" if not problems
+                           else "; ".join(problems)))
+    # the quick-look excerpts an on-call actually wants first
+    ev_path = os.path.join(path, "events.jsonl")
+    if os.path.exists(ev_path):
+        with open(ev_path) as f:
+            events = [json.loads(l) for l in f if l.strip()]
+        print("last events (%d total):" % len(events))
+        for e in events[-8:]:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("ts", "kind")}
+            print("  %-22s %s" % (e.get("kind"),
+                                  json.dumps(extra, sort_keys=True)))
+    th_path = os.path.join(path, "threads.txt")
+    if os.path.exists(th_path):
+        with open(th_path) as f:
+            heads = [l for l in f if l.startswith("--- thread")]
+        print("threads (%d):" % len(heads))
+        for h in heads:
+            print("  %s" % h.strip())
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="inspect flight-recorder post-mortem bundles")
+    ap.add_argument("path",
+                    help="flight-recorder root dir, or one bundle dir")
+    ap.add_argument("--validate", action="store_true",
+                    help="deep-validate (manifest CRC walk + JSONL "
+                         "parse); exit 2 on any problem")
+    ap.add_argument("--show", action="store_true",
+                    help="pretty-print one bundle (path must be a "
+                         "bundle dir; with a root, shows the newest)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.obs import flightrec
+    path = os.path.abspath(args.path)
+    if not os.path.isdir(path):
+        print("flight_inspect: no such directory %r" % args.path,
+              file=sys.stderr)
+        return 1
+    if _is_bundle(path):
+        bundles = [path]
+    else:
+        bundles = flightrec.list_bundles(path)
+        if not bundles:
+            # stale tmp dirs are worth naming: a crash mid-dump leaves
+            # one, the next dump sweeps it
+            tmps = [n for n in os.listdir(path)
+                    if n.startswith("_tmp.flight_")]
+            print("flight_inspect: no committed bundles under %s%s"
+                  % (path, " (%d stale tmp dir(s))" % len(tmps)
+                     if tmps else ""))
+            return 0
+
+    if args.show:
+        problems = _show(bundles[-1], flightrec.read_manifest(bundles[-1]))
+        return 2 if problems else 0
+
+    rows, all_problems = [], []
+    for b in bundles:
+        try:
+            manifest = flightrec.read_manifest(b)
+        except (OSError, ValueError) as e:
+            all_problems.append((b, "manifest unreadable: %s" % e))
+            rows.append({"bundle": os.path.basename(b),
+                         "error": str(e)})
+            continue
+        row = _bundle_row(b, manifest)
+        if args.validate:
+            problems = flightrec.validate_bundle(b)
+            row["valid"] = not problems
+            all_problems.extend((b, p) for p in problems)
+        rows.append(row)
+
+    if args.json:
+        print(json.dumps(rows, indent=1, sort_keys=True, default=str))
+    else:
+        for row in rows:
+            line = "%-48s %-18s %3s files %9s bytes" % (
+                row.get("bundle"), row.get("reason", "?"),
+                row.get("files", "?"), row.get("bytes", "?"))
+            if args.validate:
+                line += "  %s" % ("OK" if row.get("valid") else "INVALID")
+            print(line)
+    for b, p in all_problems:
+        print("%s: %s" % (os.path.basename(b), p), file=sys.stderr)
+    if all_problems:
+        print("flight_inspect: FAIL (%d problem(s) across %d bundle(s))"
+              % (len(all_problems), len(bundles)), file=sys.stderr)
+        return 2
+    if args.validate and not args.json:
+        print("flight_inspect: OK (%d bundle(s) valid)" % len(bundles))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,8 @@ MODULES = [
     "paddle_tpu.obs.tracing",
     "paddle_tpu.obs.events",
     "paddle_tpu.obs.registry",
+    "paddle_tpu.obs.slo",
+    "paddle_tpu.obs.flightrec",
     "paddle_tpu.compile_cache",
     "paddle_tpu.analysis",
     "paddle_tpu.v2.layer",

@@ -75,6 +75,7 @@ VAULT_MODULES = (
     "paddle_tpu/compile_cache.py",
     "paddle_tpu/distributed/elastic.py",
     "paddle_tpu/obs/events.py",
+    "paddle_tpu/obs/flightrec.py",
     "paddle_tpu/ops/attention_tuning.py",
 )
 
@@ -133,6 +134,16 @@ SUPPRESSIONS = [
      "every dur_ms rides the contiguous monotonic round stamps (the "
      "draft->verify boundary included), so the tiling contract never "
      "touches the wall clock"),
+    ("paddle_tpu/obs/slo.py", "nonmonotonic-time",
+     "SLOMonitor._read_lane",
+     "sample `ts` is the wall-clock RECORD stamp the timeline/bundle "
+     "files carry for operators; every interval/age computation rides "
+     "the sample's separate monotonic `mono` field"),
+    ("paddle_tpu/obs/flightrec.py", "nonmonotonic-time",
+     "FlightRecorder.dump",
+     "manifest `ts` is the wall-clock record stamp operators correlate "
+     "bundles with logs by; cooldown and dump_ms durations ride "
+     "time.monotonic()"),
 ]
 
 

@@ -38,8 +38,17 @@ def main(argv=None):
     if args.local or not args.endpoint:
         if not args.local:
             ap.error("need an endpoint (or --local)")
-        from paddle_tpu.obs import registry
+        from paddle_tpu.obs import events, registry, tracing
         print(registry.default().prometheus_text(), end="")
+        # ring-health at a glance (a '#' comment line is legal in the
+        # Prometheus text format): did telemetry itself drop anything,
+        # and is the event-log file sink still alive?
+        ts, es = tracing.stats(), events.stats()
+        print("# ring-health: spans buffered=%d dropped=%d | events "
+              "total=%d buffered=%d dropped=%d rotations=%d sink=%s"
+              % (ts["buffered"], ts["dropped"], es["events_total"],
+                 es["buffered"], es["dropped"], es["rotations"],
+                 es["sink"]))
         return 0
     from paddle_tpu.serving import ServingClient
     cli = ServingClient(args.endpoint)

@@ -6,7 +6,12 @@ depth, sheds) plus one sub-row per replica execution lane (device id,
 in-flight batches, lane queue depth, batches/rows executed) — the
 operator's glance at whether the batch buckets and admission limits fit
 the traffic and whether load is skewing across the device-placed
-replicas.  `--json` dumps the raw snapshot for scripts.
+replicas.  The SLO column shows the burn-rate state machine's verdict
+(ok / degr / BREACH — OBSERVABILITY.md "SLOs & burn rates") with one
+sub-row per burning objective, and LIVE shows alive/total lane worker
+threads ('!' marks a dead router or lane — the wedge indicator), both
+from the `health` RPC verb.  `--json` dumps the raw snapshot (plus a
+sibling "health" key) for scripts.
 
 Usage: python tools/serving_top.py HOST:PORT [--json]
 """
@@ -30,17 +35,49 @@ def _fmt(v, unit=""):
     return "%s%s" % (v, unit)
 
 
-def render(reply):
+def _health_cols(name, health):
+    """(SLO, LIVE) for one metrics lane key: the SLO state machine's
+    verdict (ok/degr/BREACH, '-' when unmonitored) and thread liveness
+    as alive/total worker threads across the model's lanes ('!' when a
+    router or lane thread has died — the wedge indicator)."""
+    if not health:
+        return "-", "-"
+    slo_col = "-"
+    st = (health.get("slo") or {}).get(name)
+    if st and st.get("monitored"):
+        state = st.get("state") or "ok"
+        slo_col = {"ok": "ok", "degraded": "degr",
+                   "breach": "BREACH"}.get(state, state)
+    plain = name.split("@", 1)[0]
+    minfo = (health.get("models") or {}).get(plain)
+    if not minfo:
+        return slo_col, "-"
+    alive = total = 0
+    dead_router = False
+    for lane in (minfo.get("lanes") or {}).values():
+        live = lane.get("liveness") or {}
+        if live.get("router_alive") is False:
+            dead_router = True
+        for l in live.get("lanes") or []:
+            alive += int(l.get("alive", 0))
+            total += int(l.get("workers", 0))
+    live_col = "%d/%d" % (alive, total) if total else "-"
+    if dead_router or (total and alive < total):
+        live_col += "!"
+    return slo_col, live_col
+
+
+def render(reply, health=None):
     stats = reply.get("stats", {})
     models = stats.get("models", {})
     desc = reply.get("models", {})
     lines = ["server uptime %.0fs, %d model(s)"
              % (stats.get("uptime_sec", 0.0), len(models)), ""]
     hdr = ("%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
-           "%7s %7s %5s %5s"
+           "%7s %7s %5s %5s %7s %6s"
            % ("MODEL", "PREC", "VER", "QPS", "REQS", "p50ms", "p95ms",
               "p99ms", "FILL", "BKT%", "QUEUE", "SHED", "CCH/M",
-              "TTFT95", "TPS", "OCC%", "ACC%"))
+              "TTFT95", "TPS", "OCC%", "ACC%", "SLO", "LIVE"))
     lines.append(hdr)
     lines.append("-" * len(hdr))
     described = set()
@@ -67,9 +104,10 @@ def render(reply):
         tps = m.get("tokens_per_sec")
         occ = m.get("slot_occupancy")
         acc = m.get("spec_accept_rate")
+        slo_col, live_col = _health_cols(name, health)
         lines.append(
             "%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
-            "%7s %7s %5s %5s"
+            "%7s %7s %5s %5s %7s %6s"
             % (plain[:14], prec[:5], _fmt(ver),
                _fmt(m.get("qps_recent")), _fmt(m.get("requests")),
                _fmt(lat.get("p50")), _fmt(lat.get("p95")),
@@ -80,7 +118,18 @@ def render(reply):
                _fmt(round(100.0 * occ, 1) if isinstance(occ, float)
                     and occ >= 0 else None),
                _fmt(round(100.0 * acc, 1)
-                    if isinstance(acc, float) else None)))
+                    if isinstance(acc, float) else None),
+               slo_col, live_col))
+        st = (health or {}).get("slo", {}).get(name)
+        if st and st.get("monitored") and st.get("burn"):
+            # one sub-row per burning objective: which SLI is eating
+            # the error budget and how fast (burn 1.0 = sustainable)
+            for objective, b in sorted(st["burn"].items()):
+                if any(v for v in b.values() if v):
+                    lines.append(
+                        "    slo %-12s fast=%-8s slow=%-8s"
+                        % (objective, _fmt(b.get("fast"), "x"),
+                           _fmt(b.get("slow"), "x")))
         if d.get("buckets") and plain not in described:
             described.add(plain)
             extra = ""
@@ -124,12 +173,20 @@ def main(argv=None):
     cli = ServingClient(args.endpoint)
     try:
         reply = cli.stats()
+        try:
+            health = cli.health()
+        except Exception:
+            health = None  # pre-health server: columns degrade to '-'
     finally:
         cli.close()
     if args.json:
+        if health is not None:
+            # rides as a SIBLING key: the pinned stats schema the
+            # dashboards scrape is untouched
+            reply = dict(reply, health=health)
         print(json.dumps(reply, indent=1, default=str))
     else:
-        print(render(reply))
+        print(render(reply, health=health))
     return 0
 
 

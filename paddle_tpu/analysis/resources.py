@@ -730,17 +730,30 @@ def analyze_program(program, feeds=None, fetches=None, batch=1,
     return rep
 
 
-def _decode_report(path, meta, decode_slots, device, what):
+def _decode_report(path, meta, decode_slots, device, what,
+                   kv_cache_dtype=None):
     """Resource report for a decode artifact (no Program IR): weights
     from the state payload, the slot-table KV cache from the meta
-    geometry — the bytes that bound decode slots (SERVING.md)."""
+    geometry — the bytes that bound decode slots (SERVING.md).
+
+    The cache prices at its DTYPE's width (QUANTIZE.md "Quantized KV
+    cache"): `kv_cache_dtype` (a load_model override) > the artifact's
+    decode_meta pin > FLAGS.serving_kv_cache_dtype > fp32 — the same
+    resolution the GenerativePredictor makes, so the admission fit
+    check statically reads ~0.25x KV bytes for an int8-cache load
+    (int8 slots + the per-(layer,head) fp32 scale table)."""
     from ..flags import FLAGS
+    from ..inference.decode import normalize_kv_dtype
     n_slots = int(decode_slots or FLAGS.serving_decode_slots)
     L = int(meta["n_layers"])
     H = int(meta["n_heads"])
     D = int(meta["d_model"])
     S = int(meta["max_seq_len"])
     dh = D // H
+    kv_dtype = normalize_kv_dtype(
+        kv_cache_dtype if kv_cache_dtype is not None
+        else (meta.get("kv_cache_dtype")
+              or FLAGS.serving_kv_cache_dtype))
     rep = ResourceReport(what=what, batch=n_slots)
     rep.device = device_peaks(device)
     state_path = os.path.join(path, "decode_state.bin")
@@ -758,8 +771,14 @@ def _decode_report(path, meta, decode_slots, device, what):
             if os.path.exists(state_path) else 0
         rep.actual_param_bytes = rep.param_bytes
         n_params = rep.param_bytes // 4
-    # K and V, [L, n_slots, S, H, Dh] fp32 each
-    rep.kv_cache_bytes = 2 * L * n_slots * S * H * dh * 4
+    # K and V, [L, n_slots, S, H, Dh] each at the cache dtype's width
+    # (4 B fp32, 1 B int8 + the fp32 scale table) — must match
+    # GenerativePredictor.kv_cache_bytes exactly (pinned by
+    # tests/test_resources.py)
+    kv_elem = 1 if kv_dtype == "int8" else 4
+    kv_scales = 2 * L * H * 4 if kv_dtype == "int8" else 0
+    rep.kv_cache_bytes = (2 * L * n_slots * S * H * dh * kv_elem
+                          + kv_scales)
     # decode-step working set: one token's activations per slot
     rep.activation_peak_bytes = n_slots * D * 4 * (L + 2)
     # one decode step: every weight multiplies once per slot, and the
@@ -770,22 +789,26 @@ def _decode_report(path, meta, decode_slots, device, what):
     return rep
 
 
-def analyze_artifact(path, batch=1, decode_slots=None, device=None):
+def analyze_artifact(path, batch=1, decode_slots=None, device=None,
+                     kv_cache_dtype=None):
     """Static resource report for a saved artifact dir — the admission
     gate's input, and lint_program --report's row source.
 
     save_inference_model dirs (fp32 or quantized) analyze their
     serialized Program and also total the on-disk payload bytes into
     ``actual_param_bytes``; decode artifacts (decode_meta.bin) come
-    from their meta geometry + KV slot table; save_aot dirs
-    (aot_meta.bin) from their state payload + feed specs."""
+    from their meta geometry + KV slot table priced at the cache dtype
+    (`kv_cache_dtype` overrides the artifact's pin — the load_model
+    knob); save_aot dirs (aot_meta.bin) from their state payload +
+    feed specs."""
     from ..inference.decode import DECODE_META
     dm = os.path.join(path, DECODE_META)
     if os.path.exists(dm):
         from ..native import wire
         with open(dm, "rb") as f:
             meta = wire.decode(f.read())
-        return _decode_report(path, meta, decode_slots, device, path)
+        return _decode_report(path, meta, decode_slots, device, path,
+                              kv_cache_dtype=kv_cache_dtype)
     am = os.path.join(path, "aot_meta.bin")
     if os.path.exists(am):
         from ..native import wire

@@ -373,6 +373,14 @@ DEFINE_int(
     "frame to the client every this many generated tokens (and always "
     "at end of stream). 1 streams every token as it decodes; larger "
     "values trade time-to-token for fewer wire frames.")
+DEFINE_string(
+    "serving_kv_cache_dtype", "",
+    "Default KV-cache numerics for decode artifacts that do not pin "
+    "one in decode_meta (QUANTIZE.md \"Quantized KV cache\"): '' or "
+    "'fp32'/'float32' keeps the fp32 slot table; 'int8' stores K/V "
+    "slots as int8 with per-(layer,head) fp32 scales — ~0.25x cache "
+    "bytes per slot, greedy streams bit-stable against themselves. "
+    "Per-load override: load_model(kv_cache_dtype=...).")
 DEFINE_int(
     "serving_spec_k", 4,
     "Speculative-decoding draft depth (SERVING.md): when a decode "

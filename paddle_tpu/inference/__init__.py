@@ -5,7 +5,7 @@ from .predictor import (
 from .decode import (
     GenerativePredictor, DecodeSession, SpeculativeDecodeSession,
     save_decode_model, build_tiny_decode_model, load_decode_predictor,
-    greedy_decode, set_draft_poison,
+    greedy_decode, set_draft_poison, normalize_kv_dtype,
 )
 from .quantize import (
     quantize_inference_model, read_quant_meta, is_quantized_dir,
@@ -17,7 +17,7 @@ __all__ = [
     "NativeConfig", "AnalysisConfig", "PaddleTensor", "Predictor",
     "create_paddle_predictor", "AotPredictor", "load_aot_predictor",
     "GenerativePredictor", "DecodeSession", "SpeculativeDecodeSession",
-    "save_decode_model", "set_draft_poison",
+    "save_decode_model", "set_draft_poison", "normalize_kv_dtype",
     "build_tiny_decode_model", "load_decode_predictor", "greedy_decode",
     "quantize_inference_model", "read_quant_meta", "is_quantized_dir",
     "verify_quantized_dir", "check_quantized_dir", "artifact_precision",

@@ -33,6 +33,24 @@ TOGETHER.  This module is the inference-side half of the answer
   requests joining or leaving the running batch cannot move another
   request's tokens by one bit.
 
+**Quantized KV cache** (QUANTIZE.md "Quantized KV cache"): decode is
+HBM-bound and the slot table is its dominant byte stream — every step
+re-reads the whole cache.  `kv_cache_dtype="int8"` (a `load_model` /
+`decode_meta` knob, default FLAGS.serving_kv_cache_dtype) stores K/V
+slots as int8 with per-(layer, head) symmetric fp32 scales calibrated
+once per artifact from a deterministic probe prefill: cache WRITES
+quantize in-graph (prefill, step, and verify all land
+`clip(round(x / scale))` rows), and the decode/verify kernels stream
+int8 tiles dequantized in-register (`ops/pallas_kernels.
+decode_attention` — float KV never materializes in HBM), cutting cache
+bytes 4x at equal slots.  The scales are baked constants of the traced
+phases, and `kv_cache_dtype` is a compile-cache fingerprint field, so
+fp32/int8 executables never collide.  Greedy int8 streams are
+bit-stable against themselves (every row quantizes identically in
+every path — the slot-reuse / rollback / spec-verify contracts all
+survive unchanged); vs the fp32 cache they agree to quantization
+error, not bit-exactly.
+
 Decode attention gathers K/V from the slot cache through the Pallas
 decode kernel (`ops/pallas_kernels.decode_attention` — block geometry
 from the shared kernel-tuning registry); sampling is greedy argmax
@@ -69,7 +87,8 @@ import numpy as np
 __all__ = ["GenerativePredictor", "DecodeSession",
            "SpeculativeDecodeSession", "save_decode_model",
            "build_tiny_decode_model", "load_decode_predictor",
-           "greedy_decode", "set_draft_poison", "DECODE_META"]
+           "greedy_decode", "set_draft_poison", "normalize_kv_dtype",
+           "DECODE_META"]
 
 DECODE_META = "decode_meta.bin"
 _DECODE_STATE = "decode_state.bin"
@@ -105,6 +124,20 @@ def _check_draft_poison():
                            "(set_draft_poison)")
 
 
+def normalize_kv_dtype(value):
+    """Canonical KV-cache dtype: ''/None/'fp32'/'f32'/'float32' ->
+    'float32', 'int8' -> 'int8'; anything else is a typed error (the
+    serving wire validates through this too)."""
+    v = str(value or "").strip().lower()
+    if v in ("", "fp32", "f32", "float32"):
+        return "float32"
+    if v == "int8":
+        return "int8"
+    raise ValueError(
+        "unsupported kv_cache_dtype %r (expected float32|int8)"
+        % (value,))
+
+
 def _default_prefill_buckets(max_seq_len):
     """Powers of two up to max_seq_len (min 8): the prompt-length
     buckets prefill compiles for.  Deterministic by prompt length, so
@@ -129,6 +162,13 @@ def save_decode_model(dirname, state, meta):
     meta.setdefault("arch", "causal_lm")
     meta.setdefault("version", 1)
     meta.setdefault("dtype", "float32")
+    # the per-artifact KV-cache dtype pin (QUANTIZE.md "Quantized KV
+    # cache"); load_model's kv_cache_dtype knob overrides per load,
+    # and an artifact with NO pin defers to FLAGS.serving_kv_cache_dtype
+    # at open time — so only normalize a pin the caller actually set
+    if meta.get("kv_cache_dtype"):
+        meta["kv_cache_dtype"] = normalize_kv_dtype(
+            meta["kv_cache_dtype"])
     meta.setdefault("prefill_buckets",
                     _default_prefill_buckets(meta["max_seq_len"]))
     with open(os.path.join(dirname, _DECODE_STATE), "wb") as f:
@@ -210,9 +250,16 @@ class GenerativePredictor:
     jax.Device — the serving registry's replica placement; `clone_to`
     shares the artifact read and the in-process export map so N
     same-device-kind replicas deserialize ONE executable each
-    (COMPILE_CACHE.md)."""
+    (COMPILE_CACHE.md).
 
-    def __init__(self, dirname, device=None, _clone_of=None):
+    `kv_cache_dtype` picks the slot-table cache numerics per OPEN
+    (explicit arg > the artifact's decode_meta pin >
+    FLAGS.serving_kv_cache_dtype > float32); 'int8' calibrates
+    per-(layer, head) scales once and every session this predictor
+    vends quantizes its cache writes in-graph."""
+
+    def __init__(self, dirname, device=None, kv_cache_dtype=None,
+                 _clone_of=None):
         from paddle_tpu.native import wire
         if _clone_of is not None:
             src = _clone_of
@@ -221,6 +268,8 @@ class GenerativePredictor:
             self._shared_exports = src._shared_exports
             self._shared_lock = src._shared_lock
             self._model_fp = src._model_fp
+            self._kv_dtype = src._kv_dtype
+            self._kv_scales = src._kv_scales
         else:
             with open(os.path.join(dirname, DECODE_META), "rb") as f:
                 self.meta = wire.decode(f.read())
@@ -233,6 +282,21 @@ class GenerativePredictor:
             self._model_fp = hashlib.sha256(json.dumps(
                 {k: self.meta[k] for k in sorted(self.meta)},
                 sort_keys=True, default=str).encode()).hexdigest()
+            if kv_cache_dtype is not None:
+                self._kv_dtype = normalize_kv_dtype(kv_cache_dtype)
+            elif self.meta.get("kv_cache_dtype"):
+                self._kv_dtype = normalize_kv_dtype(
+                    self.meta["kv_cache_dtype"])
+            else:
+                from paddle_tpu.flags import FLAGS
+                self._kv_dtype = normalize_kv_dtype(
+                    FLAGS.serving_kv_cache_dtype)
+            # per-(layer, head) symmetric fp32 scales [2, L, H, 1]
+            # (K row 0, V row 1), a deterministic function of the
+            # weights — baked into the traced phases as constants
+            # (kv_cache_dtype is a compile-cache fingerprint field)
+            self._kv_scales = self._calibrate_kv_scales() \
+                if self._kv_dtype == "int8" else None
         self._device = device
         if device is not None:
             import jax
@@ -269,6 +333,24 @@ class GenerativePredictor:
     @property
     def is_decode(self):
         return True
+
+    @property
+    def kv_cache_dtype(self):
+        """'float32' or 'int8' — the slot-table cache numerics every
+        session of this predictor allocates and the serving layer
+        reports (SERVING.md kv_cache_dtype rows)."""
+        return self._kv_dtype
+
+    @property
+    def _kv_quant(self):
+        return self._kv_dtype == "int8"
+
+    def kv_scales(self):
+        """The calibrated per-(layer, head) fp32 dequant scales
+        [2, L, H] (K row 0, V row 1); None for a float32 cache."""
+        if self._kv_scales is None:
+            return None
+        return np.asarray(self._kv_scales)[..., 0]
 
     def prefill_buckets(self):
         return tuple(int(b) for b in self.meta["prefill_buckets"])
@@ -317,11 +399,17 @@ class GenerativePredictor:
 
     def kv_cache_bytes(self, n_slots):
         """Closed-form slot-table KV cache footprint for an `n_slots`
-        session: K and V, [L, n_slots, S, H, Dh] fp32 each — the HBM
-        term that bounds decode slots (FLAGS.serving_decode_slots) and
-        the number the admission fit check adds per replica."""
+        session: K and V, [L, n_slots, S, H, Dh] each at the CACHE
+        dtype's width (4 B fp32, 1 B int8 — plus the int8 cache's
+        per-(layer, head) fp32 scale table) — the HBM term that bounds
+        decode slots (FLAGS.serving_decode_slots) and the number the
+        admission fit check adds per replica.  Matches
+        analysis/resources.py's `_decode_report` pricing exactly."""
         L, H, Dh, _ = self._dims()
-        return 2 * L * int(n_slots) * self.max_seq_len * H * Dh * 4
+        elem = 1 if self._kv_quant else 4
+        scales = 2 * L * H * 4 if self._kv_quant else 0
+        return (2 * L * int(n_slots) * self.max_seq_len * H * Dh * elem
+                + scales)
 
     def param_bytes(self):
         """Static weight footprint (host-state nbytes sum)."""
@@ -336,9 +424,60 @@ class GenerativePredictor:
                 int(m["d_model"]) // int(m["n_heads"]),
                 int(m["d_model"]))
 
+    # -- int8 KV cache: quantization epilogues --------------------------
+
+    @staticmethod
+    def _quantize_kv(x, scale):
+        """Symmetric int8 quantization of fresh K/V rows against the
+        calibrated per-head scale: clip(round(x / scale)) as EXACT
+        integer values in fp32 (the caller casts to int8, directly or
+        after the verify path's one-hot scatter — both land the same
+        byte, which is what keeps step and verify rows bit-identical
+        and spec-decode acceptance at 1.0 under the quantized cache)."""
+        import jax.numpy as jnp
+        return jnp.clip(jnp.round(x / scale), -127.0, 127.0)
+
+    def _calibrate_kv_scales(self):
+        """Per-(layer, head) symmetric scales for the int8 KV cache:
+        amax of |K| / |V| over a deterministic vocab-cycling probe
+        prompt run through the fp32 prefill math eagerly on the host
+        state, x1.25 headroom for decode-time rows the probe never
+        saw, /127.  Deterministic by construction, so every clone /
+        replica / reopen of the artifact quantizes identically (the
+        bit-stability contract rides this).  Returns [2, L, H, 1]."""
+        T = int(min(self.max_seq_len - 1, 64))
+        vocab = max(self.vocab_size, 1)
+        tokens = ((np.arange(T, dtype=np.int64) * 7 + 1)
+                  % vocab).astype(np.int32).reshape(1, T)
+        state = {n: np.asarray(v) for n, v in self._state_host.items()}
+        _, kc, vc = self._prefill_core(state, tokens, np.int32(T))
+
+        def sc(x):
+            amax = np.abs(np.asarray(x)).max(axis=(1, 2, 4))   # [L, H]
+            return (np.maximum(amax, 1e-6) * 1.25
+                    / 127.0).astype(np.float32)
+
+        return np.stack([sc(kc), sc(vc)])[..., None]
+
     def _prefill_math(self, state, tokens, true_len):
+        """The traced prefill phase: `_prefill_core` plus the int8
+        cache-write quantization epilogue (zeros quantize to exact
+        int8 zeros, so the zero-slot contract is dtype-blind)."""
+        import jax.numpy as jnp
+        first, kc, vc = self._prefill_core(state, tokens, true_len)
+        if not self._kv_quant:
+            return first, kc, vc
+        sc = self._kv_scales                     # [2, L, H, 1] np
+        kq = self._quantize_kv(
+            kc, sc[0][:, None, None]).astype(jnp.int8)
+        vq = self._quantize_kv(
+            vc, sc[1][:, None, None]).astype(jnp.int8)
+        return first, kq, vq
+
+    def _prefill_core(self, state, tokens, true_len):
         """tokens [1, B] int32, true_len scalar int32 -> (first_token
-        [] int32, k/v [L, 1, B, H, Dh] with pad positions zeroed)."""
+        [] int32, k/v [L, 1, B, H, Dh] fp32 with pad positions
+        zeroed)."""
         import jax.numpy as jnp
         L, H, Dh, D = self._dims()
         B = tokens.shape[1]
@@ -372,14 +511,19 @@ class GenerativePredictor:
 
     def _step_math(self, state, kc, vc, lengths, last_tokens, active):
         """One fixed-shape decode step over the whole slot table.
-        kc/vc [L, N, S, H, Dh], lengths [N] i32 (live cached positions),
-        last_tokens [N] i32, active [N] bool -> (new_tokens [N] i32,
-        kc', vc').  Cache writes are gated by `active`, so a freed
-        (zeroed) slot stays zero and per-slot independence is exact."""
+        kc/vc [L, N, S, H, Dh] (fp32, or int8 under the quantized
+        cache), lengths [N] i32 (live cached positions), last_tokens
+        [N] i32, active [N] bool -> (new_tokens [N] i32, kc', vc').
+        Cache writes are gated by `active`, so a freed (zeroed) slot
+        stays zero and per-slot independence is exact.  Under int8,
+        fresh K/V rows quantize in-graph before landing and the
+        attention dequantizes in-register — float KV rows never reach
+        the cache arrays."""
         import jax.numpy as jnp
         from paddle_tpu.ops.pallas_kernels import decode_attention
         L, H, Dh, D = self._dims()
         N, S = kc.shape[1], kc.shape[2]
+        quant = self._kv_quant
         scale = 1.0 / np.sqrt(Dh)
         x = state["embed"][last_tokens] + state["pos"][lengths]  # [N, D]
         write = (jnp.arange(S)[None, :] == lengths[:, None]) \
@@ -392,10 +536,17 @@ class GenerativePredictor:
             q = (h @ state[p + "wq"]).reshape(N, H, Dh)
             k_new = (h @ state[p + "wk"]).reshape(N, H, Dh)
             v_new = (h @ state[p + "wv"]).reshape(N, H, Dh)
+            if quant:
+                k_new = self._quantize_kv(
+                    k_new, self._kv_scales[0, i]).astype(jnp.int8)
+                v_new = self._quantize_kv(
+                    v_new, self._kv_scales[1, i]).astype(jnp.int8)
             kci = jnp.where(wmask, k_new[:, None], kc[i])
             vci = jnp.where(wmask, v_new[:, None], vc[i])
             att = decode_attention(q, kci, vci, lengths + 1,
-                                   scale=scale)
+                                   scale=scale,
+                                   kv_scales=self._kv_scales[:, i]
+                                   if quant else None)
             x = x + att.reshape(N, D) @ state[p + "wo"]
             h2 = _ln(x, state[p + "ln2_g"], state[p + "ln2_b"])
             x = x + jnp.maximum(h2 @ state[p + "w1"] + state[p + "b1"],
@@ -433,6 +584,7 @@ class GenerativePredictor:
         L, H, Dh, D = self._dims()
         N, C = tokens.shape
         S = kc.shape[2]
+        quant = self._kv_quant
         scale = 1.0 / np.sqrt(Dh)
         pos_idx = lengths[:, None] + jnp.arange(C)[None]        # [N, C]
         x = state["embed"][tokens] + state["pos"][pos_idx]      # [N,C,D]
@@ -447,21 +599,31 @@ class GenerativePredictor:
             q = (h @ state[p + "wq"]).reshape(N, C, H, Dh)
             k_new = (h @ state[p + "wk"]).reshape(N, C, H, Dh)
             v_new = (h @ state[p + "wv"]).reshape(N, C, H, Dh)
+            if quant:
+                # quantize BEFORE the scatter: the one-hot contraction
+                # moves exact fp32 integer values, so the int8 cast
+                # lands the same byte a sequential step write would —
+                # verify rows == step rows bit-for-bit
+                k_new = self._quantize_kv(k_new, self._kv_scales[0, i])
+                v_new = self._quantize_kv(v_new, self._kv_scales[1, i])
             # land all C rows (positions are distinct, so the scatter
             # contraction adds exact zeros around one exact value)
             wf = write.astype(k_new.dtype)
-            kci = jnp.where(written,
-                            jnp.einsum("ncs,nchd->nshd", wf, k_new),
-                            kc[i])
-            vci = jnp.where(written,
-                            jnp.einsum("ncs,nchd->nshd", wf, v_new),
-                            vc[i])
+            ksc = jnp.einsum("ncs,nchd->nshd", wf, k_new)
+            vsc = jnp.einsum("ncs,nchd->nshd", wf, v_new)
+            if quant:
+                ksc = ksc.astype(jnp.int8)
+                vsc = vsc.astype(jnp.int8)
+            kci = jnp.where(written, ksc, kc[i])
+            vci = jnp.where(written, vsc, vc[i])
             kx = jnp.broadcast_to(
                 kci[:, None], (N, C, S, H, Dh)).reshape(N * C, S, H, Dh)
             vx = jnp.broadcast_to(
                 vci[:, None], (N, C, S, H, Dh)).reshape(N * C, S, H, Dh)
             att = decode_attention(q.reshape(N * C, H, Dh), kx, vx,
-                                   qlens, scale=scale)
+                                   qlens, scale=scale,
+                                   kv_scales=self._kv_scales[:, i]
+                                   if quant else None)
             x = x + att.reshape(N, C, D) @ state[p + "wo"]
             h2 = _ln(x, state[p + "ln2_g"], state[p + "ln2_b"])
             x = x + jnp.maximum(h2 @ state[p + "w1"] + state[p + "b1"],
@@ -478,8 +640,14 @@ class GenerativePredictor:
         posS = jnp.arange(S)[None, :]
         stale = (posS >= (lengths + m + 1)[:, None]) \
             & (posS < (lengths + C)[:, None]) & active[:, None]
-        keep = (~stale)[None, :, :, None, None].astype(jnp.float32)
-        return g, m, jnp.stack(kcs) * keep, jnp.stack(vcs) * keep
+        stale_m = stale[None, :, :, None, None]
+        kall = jnp.stack(kcs)
+        vall = jnp.stack(vcs)
+        # select, not multiply-by-mask: exact zeros either way for
+        # fp32, and int8 caches cannot ride a float multiply
+        zero = jnp.zeros((), kall.dtype)
+        return (g, m, jnp.where(stale_m, zero, kall),
+                jnp.where(stale_m, zero, vall))
 
     # -- compiled-phase resolution (the PR 6 compile-cache ride) --------
 
@@ -489,6 +657,13 @@ class GenerativePredictor:
             "kind": "decode_phase",
             "model": self._model_fp,
             "phase": list(phase_key),
+            # the cache dtype changes the traced math (quantize-on-
+            # write epilogues, baked dequant scales) without changing
+            # the prefill arg specs — fingerprinting it keeps fp32 and
+            # int8 executables from ever colliding (COMPILE_CACHE.md);
+            # rev bumps when the phase math itself changes shape
+            "kv_dtype": self._kv_dtype,
+            "rev": 2,
             "state": cc._spec_sig(self._state_host),
             "args": [[list(s.shape), str(s.dtype)] for s in arg_specs],
             "env": cc.environment_fingerprint(self._device),
@@ -591,12 +766,15 @@ class GenerativePredictor:
         return self._resolve(("prefill", bucket), self._prefill_math,
                              specs)
 
+    def _cache_np_dtype(self):
+        return np.dtype(np.int8 if self._kv_quant else np.float32)
+
     def step_fn(self, n_slots):
         import jax
         L, H, Dh, _ = self._dims()
         S = self.max_seq_len
         cache = jax.ShapeDtypeStruct((L, int(n_slots), S, H, Dh),
-                                     np.dtype(np.float32))
+                                     self._cache_np_dtype())
         specs = (cache, cache,
                  jax.ShapeDtypeStruct((int(n_slots),),
                                       np.dtype(np.int32)),
@@ -617,7 +795,7 @@ class GenerativePredictor:
         S = self.max_seq_len
         n, C = int(n_slots), int(spec_k) + 1
         cache = jax.ShapeDtypeStruct((L, n, S, H, Dh),
-                                     np.dtype(np.float32))
+                                     self._cache_np_dtype())
         specs = (cache, cache,
                  jax.ShapeDtypeStruct((n,), np.dtype(np.int32)),
                  jax.ShapeDtypeStruct((n, C), np.dtype(np.int32)),
@@ -642,7 +820,11 @@ class DecodeSession:
         L, H, Dh, _ = predictor._dims()
         S = predictor.max_seq_len
         shape = (L, self.n_slots, S, H, Dh)
-        z = jnp.zeros(shape, jnp.float32)
+        # the cache allocates at the predictor's kv_cache_dtype width:
+        # int8 slot tables hold exact int8 zeros when free (QUANTIZE.md
+        # "Quantized KV cache" — the zero-slot contract is dtype-blind)
+        z = jnp.zeros(shape, jnp.int8 if predictor._kv_quant
+                      else jnp.float32)
         if predictor.device is not None:
             z = jax.device_put(z, predictor.device)
         self._kc = z
@@ -659,6 +841,16 @@ class DecodeSession:
 
     def occupancy(self):
         return int(self.active.sum())
+
+    def cache_bytes(self):
+        """MEASURED slot-table footprint: the K + V device arrays'
+        nbytes plus the int8 cache's fp32 scale table — what
+        bench_serving's --kv_dtype A/B reports against the closed-form
+        `GenerativePredictor.kv_cache_bytes`."""
+        n = int(self._kc.nbytes) + int(self._vc.nbytes)
+        if self.predictor._kv_quant:
+            n += int(np.asarray(self.predictor._kv_scales).nbytes)
+        return n
 
     # -- phases ---------------------------------------------------------
 
@@ -728,7 +920,7 @@ class DecodeSession:
         import jax.numpy as jnp
         L = self._kc.shape[0]
         S, H, Dh = self._kc.shape[2], self._kc.shape[3], self._kc.shape[4]
-        z = self._put(jnp.zeros((L, 1, S, H, Dh), jnp.float32))
+        z = self._put(jnp.zeros((L, 1, S, H, Dh), self._kc.dtype))
         at = (0, int(slot), 0, 0, 0)
         self._kc = jax.lax.dynamic_update_slice(self._kc, z, at)
         self._vc = jax.lax.dynamic_update_slice(self._vc, z, at)
@@ -761,7 +953,7 @@ class DecodeSession:
         if n > 0:
             L = self._kc.shape[0]
             H, Dh = self._kc.shape[3], self._kc.shape[4]
-            z = self._put(jnp.zeros((L, 1, n, H, Dh), jnp.float32))
+            z = self._put(jnp.zeros((L, 1, n, H, Dh), self._kc.dtype))
             at = (0, slot, length - n, 0, 0)
             self._kc = jax.lax.dynamic_update_slice(self._kc, z, at)
             self._vc = jax.lax.dynamic_update_slice(self._vc, z, at)
@@ -1007,9 +1199,10 @@ class SpeculativeDecodeSession:
         return out, active.astype(np.int32)
 
 
-def load_decode_predictor(dirname):
-    """Open a `save_decode_model` artifact (fresh-process serving)."""
-    return GenerativePredictor(dirname)
+def load_decode_predictor(dirname, kv_cache_dtype=None):
+    """Open a `save_decode_model` artifact (fresh-process serving);
+    `kv_cache_dtype` overrides the artifact's cache-numerics pin."""
+    return GenerativePredictor(dirname, kv_cache_dtype=kv_cache_dtype)
 
 
 def greedy_decode(predictor, tokens, max_new_tokens, n_slots=1,

@@ -46,6 +46,10 @@ _SERVING_GAUGES = ("qps_recent", "qps_lifetime", "batch_fill",
                    "bucket_fill_ratio", "queue_depth",
                    # continuous-batching decode gauges (SERVING.md)
                    "tokens_per_sec", "slot_occupancy",
+                   # measured KV slot-table bytes across lanes — reads
+                   # ~0.25x under kv_cache_dtype=int8 (QUANTIZE.md
+                   # "Quantized KV cache")
+                   "kv_cache_bytes",
                    # lifetime draft accept fraction (SERVING.md
                    # speculative decoding — the speedup dial)
                    "spec_accept_rate")

@@ -7,13 +7,19 @@ chains into matmuls. Flash attention is the headline case: the [S, S] score
 matrix never leaves VMEM, with online-softmax accumulation over K/V blocks
 (see /opt/skills/guides/pallas_guide.md).
 
-All three attention kernels (forward, backward-dq, backward-dkv) are
-block-size-parameterized and stream their non-resident operand through
-the grid pipeline — K/V tiles for the q-stationary kernels, Q/dO tiles
-for the kv-stationary one — with MXU-aligned tiles, bf16-native matmuls
-and fp32 accumulation in VMEM scratch. Block geometry resolves per shape
-at trace time through ops/attention_tuning.py (FLAGS override > tune
-cache > heuristic); `tools/bench_attention.py --tune` writes the cache.
+Every contraction family here — flash attention fwd/bwd, decode
+attention, fused dequant-matmul — instantiates ONE tiled-contraction
+driver (`tiled_contraction`, the Tensor Processing Primitives shape,
+PAPERS.md): the driver owns the grid/BlockSpec plumbing, the streamed
+operand staging, fp32 accumulator init on the first reduction tile and
+finalize on the last, compiler dimension semantics, and the
+interpret-vs-Mosaic dispatch; a family plugs in a small epilogue pair
+(`tile`/`finalize`) — online softmax for flash fwd + decode, transposed-
+stationarity gradient folds for flash bwd, in-register dequant with a
+per-channel (or per-head, for the int8 KV cache) scale at finalize for
+the quantized families.  Block geometry resolves per shape at trace time
+through ops/attention_tuning.py (FLAGS override > tuning registry >
+heuristic); `tools/tune_kernels.py` sweeps and writes every namespace.
 
 The kernels run in interpret mode off-TPU so the same code paths are unit
 tested on the CPU mesh; `interpret=None` defers the choice to lowering
@@ -28,7 +34,7 @@ import numpy as np
 
 from . import attention_tuning
 
-__all__ = ["flash_attention", "decode_attention",
+__all__ = ["tiled_contraction", "flash_attention", "decode_attention",
            "decode_attention_reference", "fused_bottleneck",
            "bottleneck_reference", "dequant_matmul",
            "dequant_matmul_reference", "mosaic_lowering"]
@@ -100,212 +106,215 @@ def _causal_tile_mask(s, iq, ik, block_q, block_kv):
     return jnp.where(kpos > qpos, _NEG_INF, s)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                l_ref, *, scale, causal, block_q, block_kv):
-    """One (batch*head, q-block, kv-block) grid step. Q and the fp32
-    accumulator/m/l state stay resident across the innermost kv axis
-    (the pipeline streams K/V tiles); the finished tile normalizes into
-    o and emits the row logsumexp residual for the fused backward."""
-    import jax
+# ---------------------------------------------------------------------------
+# tiled-contraction substrate (the TPP refactor, PAPERS.md / ROOFLINE.md
+# "Kernel substrate"): one parameterized driver owns everything the
+# kernel families used to hand-copy — grid/BlockSpec plumbing, streamed
+# operand staging, accumulator init on the first reduction tile,
+# finalize on the last, compiler dimension semantics, interpret
+# dispatch.  A family is a `tile`/`finalize` epilogue pair plugged into
+# the driver; the shared epilogue helpers below (online softmax,
+# softmax finalize, in-register dequant staging) are the reusable
+# pieces those pairs compose from.
+# ---------------------------------------------------------------------------
+
+
+class _TileCtx(object):
+    """What one grid step of a tiled contraction sees: the staged
+    operand refs, the output refs, the accumulator scratch refs, and
+    the grid coordinates (`ids`; `reduce_id`/`n_reduce` index the
+    streamed reduction axis)."""
+
+    __slots__ = ("ins", "outs", "scratch", "ids", "reduce_id",
+                 "n_reduce")
+
+    def __init__(self, ins, outs, scratch, ids, reduce_id, n_reduce):
+        self.ins = ins
+        self.outs = outs
+        self.scratch = scratch
+        self.ids = ids
+        self.reduce_id = reduce_id
+        self.n_reduce = n_reduce
+
+
+def tiled_contraction(operands, *, grid, reduce_axis, in_specs,
+                      out_specs, out_shape, scratch=(), scratch_fill=(),
+                      tile=None, finalize=None, tile_live=None,
+                      interpret=None):
+    """THE tiled-contraction core every kernel family instantiates.
+
+    `grid` runs with "parallel" semantics on every axis except
+    `reduce_axis` (the streamed axis, "arbitrary"): whatever operand
+    re-stages along that axis streams through the pipeline while the
+    rest stay resident — the staging IS the BlockSpec index map.  Each
+    scratch buffer resets to its `scratch_fill` value on the first
+    reduction tile and `finalize(ctx)` writes the outputs from the
+    accumulators on the last (normalization, per-channel dequant
+    scales, and dtype casts live there).  `tile(ctx)` folds one
+    reduction tile into the accumulators; `tile_live(ids)` optionally
+    gates dead tiles (the causal upper triangle) out of the MXU work —
+    the tile's DMA is already in flight, the compute is what matters.
+    `interpret=None` resolves interpret-vs-Mosaic at trace time
+    (_interpret_dispatch), like every kernel here always has."""
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    iq = pl.program_id(1)
-    ik = pl.program_id(2)
-    nk = pl.num_programs(2)
+    n_in = len(operands)
+    n_out = len(out_shape) if isinstance(out_shape, (list, tuple)) else 1
+    fills = tuple(scratch_fill) + (0.0,) * (len(scratch)
+                                            - len(scratch_fill))
 
-    @pl.when(ik == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
+    def kern(*refs):
+        ids = tuple(pl.program_id(i) for i in range(len(grid)))
+        ctx = _TileCtx(refs[:n_in], refs[n_in:n_in + n_out],
+                       refs[n_in + n_out:], ids, ids[reduce_axis],
+                       pl.num_programs(reduce_axis))
 
-    def compute():
-        q = q_ref[0]                                   # [BQ, D]
-        k = k_ref[0]                                   # [BKV, D]
-        v = v_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_tile_mask(s, iq, ik, block_q, block_kv)
-        m_prev = m_ref[...]                            # [BQ, LANES]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
-        alpha = jnp.exp(m_prev - m_new)                # [BQ, LANES]
-        p = jnp.exp(s - m_new[:, :1])                  # [BQ, BKV] f32
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1)[:, None]
-        pv = jax.lax.dot_general(p.astype(v.dtype), v,
-                                 (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
-        m_ref[...] = m_new
+        if ctx.scratch:
+            @pl.when(ctx.reduce_id == 0)
+            def _init():
+                for ref, fill in zip(ctx.scratch, fills):
+                    ref[...] = jnp.full_like(ref, fill)
 
-    if causal:
-        # tiles entirely above the diagonal skip compute (the DMA for the
-        # tile is already in flight; the MXU work is what matters)
-        @pl.when(_causal_tile_live(iq, ik, block_q, block_kv))
-        def _():
-            compute()
-    else:
-        compute()
+        if tile_live is not None:
+            @pl.when(tile_live(ids))
+            def _tile():
+                tile(ctx)
+        else:
+            tile(ctx)
 
-    @pl.when(ik == nk - 1)
-    def _finalize():
-        l = jnp.maximum(l_ref[:, :1], _TINY)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:, :1] + jnp.log(l)
+        @pl.when(ctx.reduce_id == ctx.n_reduce - 1)
+        def _finalize():
+            finalize(ctx)
+
+    sem = tuple("arbitrary" if i == reduce_axis else "parallel"
+                for i in range(len(grid)))
+
+    def call(interp, *ops):
+        return pl.pallas_call(
+            kern, grid=grid, in_specs=list(in_specs),
+            out_specs=out_specs, out_shape=out_shape,
+            scratch_shapes=list(scratch),
+            compiler_params=_compiler_params(dimension_semantics=sem),
+            interpret=interp,
+        )(*ops)
+
+    return _interpret_dispatch(call, interpret, *operands)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
-                   acc_ref, *, scale, causal, block_q, block_kv):
-    """dQ kernel, q-stationary: stream K/V tiles under a resident
-    (q, do, lse, di) block, accumulate dq in fp32 scratch."""
-    import jax
+def _online_softmax_tile(s, pv_of, acc_ref, m_ref, l_ref):
+    """Online-softmax epilogue shared by flash forward and decode
+    attention: fold one masked f32 score tile `s` [R, BKV] into the
+    running row max / normalizer / accumulator, rescaling prior
+    contributions by alpha.  `pv_of(p)` contracts the tile
+    probabilities against the resident value tile — an MXU matmul for
+    flash, a VPU lane reduction for decode."""
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-
-    iq = pl.program_id(1)
-    ik = pl.program_id(2)
-    nk = pl.num_programs(2)
-
-    @pl.when(ik == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    def compute():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0]                               # [BQ, 1]
-        di = di_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_tile_mask(s, iq, ik, block_q, block_kv)
-        p = jnp.exp(s - lse)                           # [BQ, BKV] f32
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - di) * scale).astype(k.dtype)
-        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    if causal:
-        @pl.when(_causal_tile_live(iq, ik, block_q, block_kv))
-        def _():
-            compute()
-    else:
-        compute()
-
-    @pl.when(ik == nk - 1)
-    def _finalize():
-        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+    m_prev = m_ref[...]                            # [R, LANES]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, :1])                  # [R, BKV] f32
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1)[:, None]
+    acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv_of(p)
+    m_ref[...] = m_new
 
 
-def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref, dk_ref,
-                    dv_ref, dk_acc, dv_acc, *, scale, causal, block_q,
-                    block_kv):
-    """dK/dV kernel, kv-stationary: stream (q, do, lse, di) tiles under a
-    resident K/V block — the transposed iteration order of the dq kernel,
-    so neither gradient needs a cross-program reduction."""
-    import jax
+def _softmax_finalize(acc_ref, m_ref, l_ref):
+    """Normalize a finished online-softmax accumulator; returns
+    (o_f32, lse) for the caller to cast/write — any constant per-row
+    scale (the int8 KV epilogue's per-head V scale) folds in after the
+    divide, once per output element."""
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
+    l = jnp.maximum(l_ref[:, :1], _TINY)
+    return acc_ref[...] / l, m_ref[:, :1] + jnp.log(l)
 
-    ik = pl.program_id(1)
-    iq = pl.program_id(2)
-    nq = pl.num_programs(2)
 
-    @pl.when(iq == 0)
-    def _init():
-        dk_acc[...] = jnp.zeros_like(dk_acc)
-        dv_acc[...] = jnp.zeros_like(dv_acc)
-
-    def compute():
-        q = q_ref[0]
-        do = do_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        lse = lse_ref[0]
-        di = di_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _causal_tile_mask(s, iq, ik, block_q, block_kv)
-        p = jnp.exp(s - lse)                           # [BQ, BKV] f32
-        pv = p.astype(do.dtype)
-        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
-            pv, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - di) * scale).astype(q.dtype)
-        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    if causal:
-        @pl.when(_causal_tile_live(iq, ik, block_q, block_kv))
-        def _():
-            compute()
-    else:
-        compute()
-
-    @pl.when(iq == nq - 1)
-    def _finalize():
-        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+def _stage_dequant(w, dtype):
+    """In-register dequant staging (QUANTIZE.md; TPP's fused
+    dequant-contraction shape): an int8 tile streamed from HBM is cast
+    to the compute dtype the moment it lands in VMEM — float weights /
+    KV rows never exist in HBM.  Symmetric per-channel (or per-head)
+    scales distribute over the reduction, so they apply ONCE at
+    finalize, never per streamed element."""
+    return w.astype(dtype)
 
 
 def _flash_fwd_pallas(q, k, v, scale, causal, block_q, block_kv,
                       interpret):
-    """q,k,v [BH, S, D] -> (o [BH, S, D], lse [BH, S] f32)."""
+    """q,k,v [BH, S, D] -> (o [BH, S, D], lse [BH, S] f32): the
+    online-softmax instantiation — Q and the (acc, m, l) state resident
+    per (bh, q-block) output tile, K/V tiles streamed on the reduction
+    axis."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     BH, S, D = q.shape
-    grid = (BH, S // block_q, S // block_kv)
-    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                             block_q=block_q, block_kv=block_kv)
 
-    def call(interp, *ops):
-        return pl.pallas_call(
-            kern,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
-                pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-                jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((block_q, D), jnp.float32),
-                pltpu.VMEM((block_q, _MIN_LANES), jnp.float32),
-                pltpu.VMEM((block_q, _MIN_LANES), jnp.float32),
-            ],
-            compiler_params=_compiler_params(
-                dimension_semantics=("parallel", "parallel", "arbitrary")),
-            interpret=interp,
-        )(*ops)
+    def tile(ctx):
+        q_ref, k_ref, v_ref = ctx.ins
+        acc_ref, m_ref, l_ref = ctx.scratch
+        qb = q_ref[0]                                  # [BQ, D]
+        kb = k_ref[0]                                  # [BKV, D]
+        vb = v_ref[0]
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_tile_mask(s, ctx.ids[1], ctx.ids[2], block_q,
+                                  block_kv)
+        _online_softmax_tile(
+            s,
+            lambda p: jax.lax.dot_general(
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32),
+            acc_ref, m_ref, l_ref)
 
-    o, lse = _interpret_dispatch(call, interpret, q, k, v)
+    def finalize(ctx):
+        o_ref, lse_ref = ctx.outs
+        acc_ref, m_ref, l_ref = ctx.scratch
+        o, lse = _softmax_finalize(acc_ref, m_ref, l_ref)
+        o_ref[0] = o.astype(o_ref.dtype)
+        lse_ref[0] = lse
+
+    live = None
+    if causal:
+        live = lambda ids: _causal_tile_live(  # noqa: E731
+            ids[1], ids[2], block_q, block_kv)
+    o, lse = tiled_contraction(
+        (q, k, v),
+        grid=(BH, S // block_q, S // block_kv),
+        reduce_axis=2,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
+        ],
+        scratch=[pltpu.VMEM((block_q, D), jnp.float32),
+                 pltpu.VMEM((block_q, _MIN_LANES), jnp.float32),
+                 pltpu.VMEM((block_q, _MIN_LANES), jnp.float32)],
+        scratch_fill=(0.0, _NEG_INF, 0.0),
+        tile=tile, finalize=finalize, tile_live=live,
+        interpret=interpret)
     return o, lse[..., 0]
 
 
 def _flash_bwd_pallas(q, k, v, do, lse, di, scale, causal, block_q,
                       block_kv, interpret):
-    """Fused backward: two kernels with transposed stationarity.
-    di = rowsum(do * o) - dlse (the dlse term folds the lse output's
-    cotangent into the same ds formula: d lse_i / d s_ij = p_ij)."""
+    """Fused backward: two instantiations with transposed stationarity
+    (the dq pass streams K/V under resident q/do rows; the dkv pass
+    streams q/do rows under a resident K/V block, so neither gradient
+    needs a cross-program reduction).  di = rowsum(do * o) - dlse (the
+    dlse term folds the lse output's cotangent into the same ds
+    formula: d lse_i / d s_ij = p_ij)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -315,52 +324,105 @@ def _flash_bwd_pallas(q, k, v, do, lse, di, scale, causal, block_q,
     nq, nk = S // block_q, S // block_kv
     lse = lse[..., None]
     di = di[..., None]
+
+    def dq_tile(ctx):
+        q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref = ctx.ins
+        (acc_ref,) = ctx.scratch
+        qb = q_ref[0]
+        kb = k_ref[0]
+        vb = v_ref[0]
+        dob = do_ref[0]
+        lseb = lse_ref[0]                              # [BQ, 1]
+        dib = di_ref[0]
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_tile_mask(s, ctx.ids[1], ctx.ids[2], block_q,
+                                  block_kv)
+        p = jnp.exp(s - lseb)                          # [BQ, BKV] f32
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - dib) * scale).astype(kb.dtype)
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def dq_finalize(ctx):
+        dq_ref = ctx.outs[0]
+        dq_ref[0] = ctx.scratch[0][...].astype(dq_ref.dtype)
+
     qspec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
     rowspec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
     kvspec = pl.BlockSpec((1, block_kv, D), lambda b, i, j: (b, j, 0))
-    dq_kern = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                                block_q=block_q, block_kv=block_kv)
+    live = None
+    if causal:
+        live = lambda ids: _causal_tile_live(  # noqa: E731
+            ids[1], ids[2], block_q, block_kv)
+    dq = tiled_contraction(
+        (q, k, v, do, lse, di),
+        grid=(BH, nq, nk),
+        reduce_axis=2,
+        in_specs=[qspec, kvspec, kvspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch=[pltpu.VMEM((block_q, D), jnp.float32)],
+        tile=dq_tile, finalize=dq_finalize, tile_live=live,
+        interpret=interpret)
 
-    def call_dq(interp, *ops):
-        return pl.pallas_call(
-            dq_kern,
-            grid=(BH, nq, nk),
-            in_specs=[qspec, kvspec, kvspec, qspec, rowspec, rowspec],
-            out_specs=qspec,
-            out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-            compiler_params=_compiler_params(
-                dimension_semantics=("parallel", "parallel", "arbitrary")),
-            interpret=interp,
-        )(*ops)
+    # kv-stationary twin: grid axis 1 walks KV blocks, the reduction
+    # axis streams Q/dO/lse/di row tiles under the resident K/V block
+    def dkv_tile(ctx):
+        q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref = ctx.ins
+        dk_acc, dv_acc = ctx.scratch
+        qb = q_ref[0]
+        dob = do_ref[0]
+        kb = k_ref[0]
+        vb = v_ref[0]
+        lseb = lse_ref[0]
+        dib = di_ref[0]
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_tile_mask(s, ctx.ids[2], ctx.ids[1], block_q,
+                                  block_kv)
+        p = jnp.exp(s - lseb)                          # [BQ, BKV] f32
+        pv = p.astype(dob.dtype)
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
+            pv, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - dib) * scale).astype(qb.dtype)
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dq = _interpret_dispatch(call_dq, interpret, q, k, v, do, lse, di)
+    def dkv_finalize(ctx):
+        dk_ref, dv_ref = ctx.outs
+        dk_acc, dv_acc = ctx.scratch
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
-    # kv-stationary: grid axis 1 walks KV blocks, innermost streams Q
     qspec_t = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
     rowspec_t = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
     kvspec_t = pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0))
-    dkv_kern = functools.partial(_bwd_dkv_kernel, scale=scale,
-                                 causal=causal, block_q=block_q,
-                                 block_kv=block_kv)
-
-    def call_dkv(interp, *ops):
-        return pl.pallas_call(
-            dkv_kern,
-            grid=(BH, nk, nq),
-            in_specs=[qspec_t, qspec_t, rowspec_t, rowspec_t, kvspec_t,
-                      kvspec_t],
-            out_specs=[kvspec_t, kvspec_t],
-            out_shape=[jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-                       jax.ShapeDtypeStruct((BH, S, D), v.dtype)],
-            scratch_shapes=[pltpu.VMEM((block_kv, D), jnp.float32),
-                            pltpu.VMEM((block_kv, D), jnp.float32)],
-            compiler_params=_compiler_params(
-                dimension_semantics=("parallel", "parallel", "arbitrary")),
-            interpret=interp,
-        )(*ops)
-
-    dk, dv = _interpret_dispatch(call_dkv, interpret, q, do, lse, di, k, v)
+    live_t = None
+    if causal:
+        live_t = lambda ids: _causal_tile_live(  # noqa: E731
+            ids[2], ids[1], block_q, block_kv)
+    dk, dv = tiled_contraction(
+        (q, do, lse, di, k, v),
+        grid=(BH, nk, nq),
+        reduce_axis=2,
+        in_specs=[qspec_t, qspec_t, rowspec_t, rowspec_t, kvspec_t,
+                  kvspec_t],
+        out_specs=[kvspec_t, kvspec_t],
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, S, D), v.dtype)],
+        scratch=[pltpu.VMEM((block_kv, D), jnp.float32),
+                 pltpu.VMEM((block_kv, D), jnp.float32)],
+        tile=dkv_tile, finalize=dkv_finalize, tile_live=live_t,
+        interpret=interpret)
     return dq, dk, dv
 
 
@@ -455,73 +517,42 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
 # batching). One new query token per KV-cache slot attends over that
 # slot's cached prefix — the memory-roofline-bound shape ROOFLINE.md
 # names for generation: ~zero FLOP reuse, the win is streaming the K/V
-# slot cache through VMEM exactly once per step. The kernel is
-# q-stationary per slot (all heads resident) and streams kv-cache blocks
-# through the innermost grid axis with online-softmax accumulation;
-# positions at or past the slot's live length are masked with the same
-# finite _NEG_INF convention as the training kernels. Block geometry
-# resolves through the shared kernel-tuning registry
-# (attention_tuning.get_decode_config — FLAGS override > tuned entry >
-# MXU-aligned heuristic).
+# slot cache through VMEM exactly once per step. The instantiation is
+# q-stationary per slot (all heads resident) with kv-cache blocks
+# streamed on the reduction axis under the shared online-softmax
+# epilogue; positions at or past the slot's live length are masked with
+# the same finite _NEG_INF convention as the training kernels.
+#
+# The int8 KV-cache variant (QUANTIZE.md "Quantized KV cache") streams
+# the SAME tiles at one byte per element: `kv_scales` carries the
+# per-head symmetric fp32 scales of the quantized cache, int8 tiles
+# dequantize in-register via _stage_dequant, the K scale folds into the
+# per-head score scale and the V scale applies once at finalize — a 4x
+# cut of the byte stream that bounds decode (ROOFLINE.md), same kernel
+# skeleton.  Block geometry resolves through the shared kernel-tuning
+# registry keyed by the CACHE dtype (attention_tuning.get_decode_config
+# — FLAGS override > tuned entry > MXU-aligned heuristic), so int8 and
+# fp32 caches tune independently (DEC_*_int8 vs DEC_*_float32 keys).
 # ---------------------------------------------------------------------------
 
 
-def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, acc_ref, m_ref,
-                   l_ref, *, scale, block_kv):
-    """One (slot, kv-block) grid step.  q (all heads of one slot) and
-    the fp32 accumulator/m/l state stay resident across the innermost
-    kv axis; cached positions >= the slot's live length are masked."""
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-
-    ik = pl.program_id(1)
-    nk = pl.num_programs(1)
-
-    @pl.when(ik == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-
-    q = q_ref[0]                                   # [H, D]
-    k = k_ref[0].transpose(1, 0, 2)                # [H, BKV, D]
-    v = v_ref[0].transpose(1, 0, 2)
-    length = len_ref[0, 0]
-    H = q.shape[0]
-    # elementwise-multiply + lane reduction instead of a matmul: one
-    # query row per head makes this VPU work, and the step is
-    # memory-bound on the K/V stream anyway (ROOFLINE.md decode shape)
-    s = jnp.sum(q[:, None, :].astype(jnp.float32)
-                * k.astype(jnp.float32), axis=-1) * scale   # [H, BKV]
-    kpos = ik * block_kv + jax.lax.broadcasted_iota(
-        jnp.int32, (H, block_kv), 1)
-    s = jnp.where(kpos >= length, _NEG_INF, s)
-    m_prev = m_ref[...]                            # [H, LANES]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new[:, :1])                  # [H, BKV] f32
-    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1)[:, None]
-    pv = jnp.sum(p[:, :, None] * v.astype(jnp.float32), axis=1)
-    acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
-    m_ref[...] = m_new
-
-    @pl.when(ik == nk - 1)
-    def _finalize():
-        l = jnp.maximum(l_ref[:, :1], _TINY)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-
-
-def decode_attention_reference(q, k_cache, v_cache, lengths, scale=None):
+def decode_attention_reference(q, k_cache, v_cache, lengths, scale=None,
+                               kv_scales=None):
     """Plain-XLA oracle/fallback with identical masking semantics:
     q [N, H, D] one new token per slot, k/v caches [N, S, H, D],
-    lengths [N] live cached positions per slot -> [N, H, D]."""
+    lengths [N] live cached positions per slot -> [N, H, D].
+    `kv_scales` [2, H] f32 (required iff the caches are int8) applies
+    the same per-head dequant algebra as the kernel: K scale on the
+    scores, V scale after the normalizing divide."""
     import jax.numpy as jnp
     N, S = k_cache.shape[0], k_cache.shape[1]
-    D = q.shape[-1]
+    H, D = q.shape[1], q.shape[-1]
     scale = float(scale if scale is not None else 1.0 / np.sqrt(D))
     s = jnp.einsum("nhd,nshd->nhs", q.astype(jnp.float32),
                    k_cache.astype(jnp.float32)) * scale
+    if kv_scales is not None:
+        sc = jnp.asarray(kv_scales, jnp.float32).reshape(2, H)
+        s = s * sc[0][None, :, None]
     mask = jnp.arange(S)[None, None, :] >= \
         jnp.asarray(lengths).astype(jnp.int32)[:, None, None]
     s = jnp.where(mask, _NEG_INF, s)
@@ -530,23 +561,33 @@ def decode_attention_reference(q, k_cache, v_cache, lengths, scale=None):
     l = jnp.maximum(jnp.sum(p, axis=-1), _TINY)
     o = jnp.einsum("nhs,nshd->nhd", p,
                    v_cache.astype(jnp.float32)) / l[..., None]
+    if kv_scales is not None:
+        o = o * sc[1][None, :, None]
     return o.astype(q.dtype)
 
 
 def decode_attention(q, k_cache, v_cache, lengths, scale=None,
-                     block_kv=None, interpret=None):
+                     block_kv=None, interpret=None, kv_scales=None):
     """Slot-cache decode attention: q [N, H, D] (the one new token of
     each of N slots), k_cache/v_cache [N, S, H, D] (the slot table's
-    cached keys/values, time-major), lengths [N] int32 (live positions
-    per slot — cached positions >= length are masked out) -> [N, H, D].
+    cached keys/values, time-major; fp32 or int8), lengths [N] int32
+    (live positions per slot — cached positions >= length are masked
+    out) -> [N, H, D] in q's dtype.
 
-    Pallas kernel on TPU (interpret emulation elsewhere) streaming
-    kv-cache blocks under resident per-slot queries; block geometry via
-    attention_tuning.get_decode_config (FLAGS.flash_block_kv override >
-    kernel-tuning registry > heuristic). Falls back to the plain-XLA
-    composition when no block edge divides the cache length. A slot
-    with length 0 produces well-defined garbage (every position masked)
-    — the decode step gates dead slots out downstream."""
+    With int8 caches, `kv_scales` [2, H] f32 (k-scales row 0, v-scales
+    row 1 — the per-(layer,head) scales of the quantized slot table,
+    sliced per layer by the decode step) is required: tiles dequantize
+    in-register, float KV never materializes in HBM.
+
+    Pallas instantiation of the tiled-contraction core on TPU
+    (interpret emulation elsewhere) streaming kv-cache blocks under
+    resident per-slot queries; block geometry via
+    attention_tuning.get_decode_config keyed by the CACHE dtype
+    (FLAGS.flash_block_kv override > kernel-tuning registry >
+    heuristic). Falls back to the plain-XLA composition when no block
+    edge divides the cache length. A slot with length 0 produces
+    well-defined garbage (every position masked) — the decode step
+    gates dead slots out downstream."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -555,38 +596,77 @@ def decode_attention(q, k_cache, v_cache, lengths, scale=None,
     N, H, D = q.shape
     S = k_cache.shape[1]
     scale = float(scale if scale is not None else 1.0 / np.sqrt(D))
+    kv_dtype = jnp.dtype(k_cache.dtype)
+    quant = kv_dtype == jnp.dtype(jnp.int8)
+    if quant and kv_scales is None:
+        raise ValueError(
+            "decode_attention: int8 KV caches need kv_scales [2, H] "
+            "(per-head fp32 dequant scales)")
     bkv = int(block_kv or attention_tuning.get_decode_config(
-        S, D, jnp.dtype(q.dtype).name) or 0)
+        S, D, kv_dtype.name) or 0)
     if not bkv or S % bkv:
         return decode_attention_reference(q, k_cache, v_cache, lengths,
-                                          scale=scale)
+                                          scale=scale,
+                                          kv_scales=kv_scales)
     lengths2d = jnp.asarray(lengths).astype(jnp.int32).reshape(N, 1)
-    kern = functools.partial(_decode_kernel, scale=scale, block_kv=bkv)
 
-    def call(interp, *ops):
-        return pl.pallas_call(
-            kern,
-            grid=(N, S // bkv),
-            in_specs=[
-                pl.BlockSpec((1, H, D), lambda b, j: (b, 0, 0)),
-                pl.BlockSpec((1, bkv, H, D), lambda b, j: (b, j, 0, 0)),
-                pl.BlockSpec((1, bkv, H, D), lambda b, j: (b, j, 0, 0)),
-                pl.BlockSpec((1, 1), lambda b, j: (b, 0)),
-            ],
-            out_specs=pl.BlockSpec((1, H, D), lambda b, j: (b, 0, 0)),
-            out_shape=jax.ShapeDtypeStruct((N, H, D), q.dtype),
-            scratch_shapes=[
-                pltpu.VMEM((H, D), jnp.float32),
-                pltpu.VMEM((H, _MIN_LANES), jnp.float32),
-                pltpu.VMEM((H, _MIN_LANES), jnp.float32),
-            ],
-            compiler_params=_compiler_params(
-                dimension_semantics=("parallel", "arbitrary")),
-            interpret=interp,
-        )(*ops)
+    def tile(ctx):
+        q_ref, k_ref, v_ref, len_ref = ctx.ins[:4]
+        acc_ref, m_ref, l_ref = ctx.scratch
+        qb = q_ref[0]                              # [H, D]
+        kb = _stage_dequant(k_ref[0].transpose(1, 0, 2),
+                            jnp.float32)           # [H, BKV, D]
+        vb = _stage_dequant(v_ref[0].transpose(1, 0, 2), jnp.float32)
+        length = len_ref[0, 0]
+        # elementwise-multiply + lane reduction instead of a matmul:
+        # one query row per head makes this VPU work, and the step is
+        # memory-bound on the K/V stream anyway (ROOFLINE.md)
+        s = jnp.sum(qb[:, None, :].astype(jnp.float32) * kb,
+                    axis=-1) * scale               # [H, BKV]
+        if quant:
+            # per-head K scale folds into the score scale, once per
+            # score element — never per streamed cache element
+            s = s * ctx.ins[4][0]                  # [H, 1] broadcast
+        kpos = ctx.reduce_id * bkv + jax.lax.broadcasted_iota(
+            jnp.int32, (H, bkv), 1)
+        s = jnp.where(kpos >= length, _NEG_INF, s)
+        _online_softmax_tile(
+            s, lambda p: jnp.sum(p[:, :, None] * vb, axis=1),
+            acc_ref, m_ref, l_ref)
 
-    return _interpret_dispatch(call, interpret, q, k_cache, v_cache,
-                               lengths2d)
+    def finalize(ctx):
+        o_ref = ctx.outs[0]
+        acc_ref, m_ref, l_ref = ctx.scratch
+        o, _ = _softmax_finalize(acc_ref, m_ref, l_ref)
+        if quant:
+            o = o * ctx.ins[4][1]                  # per-head V scale
+        o_ref[0] = o.astype(o_ref.dtype)
+
+    operands = [q, k_cache, v_cache, lengths2d]
+    in_specs = [
+        pl.BlockSpec((1, H, D), lambda b, j: (b, 0, 0)),
+        pl.BlockSpec((1, bkv, H, D), lambda b, j: (b, j, 0, 0)),
+        pl.BlockSpec((1, bkv, H, D), lambda b, j: (b, j, 0, 0)),
+        pl.BlockSpec((1, 1), lambda b, j: (b, 0)),
+    ]
+    if quant:
+        operands.append(jnp.asarray(kv_scales, jnp.float32).reshape(
+            2, H, 1))
+        in_specs.append(pl.BlockSpec((2, H, 1),
+                                     lambda b, j: (0, 0, 0)))
+    return tiled_contraction(
+        tuple(operands),
+        grid=(N, S // bkv),
+        reduce_axis=1,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, H, D), lambda b, j: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, H, D), q.dtype),
+        scratch=[pltpu.VMEM((H, D), jnp.float32),
+                 pltpu.VMEM((H, _MIN_LANES), jnp.float32),
+                 pltpu.VMEM((H, _MIN_LANES), jnp.float32)],
+        scratch_fill=(0.0, _NEG_INF, 0.0),
+        tile=tile, finalize=finalize,
+        interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -600,34 +680,6 @@ def decode_attention(q, k_cache, v_cache, lengths, scale=None,
 # dequantization folds into the finalize step: acc[m, n] * scale[n] —
 # one multiply per output element, not one per weight element.
 # ---------------------------------------------------------------------------
-
-
-def _dequant_matmul_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref):
-    """One (m-block, n-block, k-block) grid step. The activation tile
-    and the fp32 accumulator stay resident across the innermost k axis;
-    int8 weight tiles stream through, cast to the activation dtype in
-    VMEM (the in-register dequant — the scale waits for finalize)."""
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import pallas as pl
-
-    ik = pl.program_id(2)
-    nk = pl.num_programs(2)
-
-    @pl.when(ik == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    x = x_ref[...]                               # [BM, BK] activation
-    w = w_ref[...].astype(x.dtype)               # [BK, BN] int8 -> act
-    acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
-        x, w, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-
-    @pl.when(ik == nk - 1)
-    def _finalize():
-        o_ref[...] = (acc_ref[...]
-                      * s_ref[0].astype(jnp.float32)).astype(o_ref.dtype)
 
 
 def dequant_matmul_reference(x, w_q, scale, out_dtype=None):
@@ -651,13 +703,16 @@ def dequant_matmul(x, w_q, scale, out_dtype=None, block_m=None,
     [K, N] int8 per-output-channel-quantized weights, scale [N] f32 ->
     [M, N] in `out_dtype` (default: x.dtype).
 
-    Pallas kernel on TPU (interpret emulation elsewhere) streaming int8
-    weight tiles under a resident activation tile with fp32 accumulation;
-    block geometry resolves through the kernel-tuning registry namespace
-    ``dequant_matmul`` (attention_tuning.get_dequant_config: tuned entry
-    > MXU-aligned heuristic; explicit block args override).  Falls back
-    to the plain-XLA composition when no geometry tiles the shape —
-    channel counts not divisible by any candidate block edge included."""
+    Pallas instantiation of the tiled-contraction core on TPU
+    (interpret emulation elsewhere) streaming int8 weight tiles under a
+    resident activation tile with fp32 accumulation — the in-register
+    dequant is the _stage_dequant cast, the per-channel scale applies
+    once at finalize; block geometry resolves through the kernel-tuning
+    registry namespace ``dequant_matmul``
+    (attention_tuning.get_dequant_config: tuned entry > MXU-aligned
+    heuristic; explicit block args override).  Falls back to the
+    plain-XLA composition when no geometry tiles the shape — channel
+    counts not divisible by any candidate block edge included."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -676,26 +731,36 @@ def dequant_matmul(x, w_q, scale, out_dtype=None, block_m=None,
                                         out_dtype=out_dtype)
     scale2d = scale.reshape(1, N).astype(jnp.float32)
 
-    def call(interp, *ops):
-        return pl.pallas_call(
-            _dequant_matmul_kernel,
-            grid=(M // bm, N // bn, K // bk),
-            in_specs=[
-                pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
-                pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
-                pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
-            ],
-            out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
-            out_shape=jax.ShapeDtypeStruct(
-                (M, N), jnp.dtype(out_dtype or x.dtype)),
-            scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-            compiler_params=_compiler_params(
-                dimension_semantics=("parallel", "parallel",
-                                     "arbitrary")),
-            interpret=interp,
-        )(*ops)
+    def tile(ctx):
+        x_ref, w_ref = ctx.ins[:2]
+        (acc_ref,) = ctx.scratch
+        xb = x_ref[...]                          # [BM, BK] activation
+        wb = _stage_dequant(w_ref[...], xb.dtype)  # [BK, BN] int8->act
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
+            xb, wb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    return _interpret_dispatch(call, interpret, x, w_q, scale2d)
+    def finalize(ctx):
+        s_ref = ctx.ins[2]
+        o_ref = ctx.outs[0]
+        o_ref[...] = (ctx.scratch[0][...]
+                      * s_ref[0].astype(jnp.float32)).astype(o_ref.dtype)
+
+    return tiled_contraction(
+        (x, w_q, scale2d),
+        grid=(M // bm, N // bn, K // bk),
+        reduce_axis=2,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct(
+            (M, N), jnp.dtype(out_dtype or x.dtype)),
+        scratch=[pltpu.VMEM((bm, bn), jnp.float32)],
+        tile=tile, finalize=finalize,
+        interpret=interpret)
 
 
 # ---------------------------------------------------------------------------

@@ -988,6 +988,7 @@ class DecodeBatcher:
             metrics.queue_depth_fn = lambda: len(self._pending)
             metrics.replica_stats_fn = self.replica_stats
             metrics.slot_occupancy_fn = self.slot_occupancy
+            metrics.kv_cache_fn = self.kv_cache_info
         self._threads = [
             threading.Thread(
                 target=_guarded(self._lane_loop,
@@ -1042,6 +1043,24 @@ class DecodeBatcher:
             return {"kind": "decode", "router_alive": True,
                     "queue_depth": len(self._pending),
                     "closing": self._closing, "lanes": lanes}
+
+    def kv_cache_info(self):
+        """(kv_cache_dtype, MEASURED slot-table bytes summed across
+        this batcher's lanes) — the stats surface of the quantized-KV
+        axis (QUANTIZE.md "Quantized KV cache"); bench_serving's
+        --kv_dtype A/B reads the measured number against the static
+        closed form."""
+        dtype = str(getattr(self.predictor, "kv_cache_dtype",
+                            "float32"))
+        total = 0
+        for lane in self._lanes:
+            # a speculative lane wraps the target session; its cache
+            # is the one the committed stream lives in
+            sess = getattr(lane.session, "session", lane.session)
+            cb = getattr(sess, "cache_bytes", None)
+            if cb is not None:
+                total += int(cb())
+        return dtype, total
 
     def _slots_busy_total(self):
         return sum(len(l.assigned) for l in self._lanes)

@@ -161,6 +161,10 @@ class ModelMetrics:
         # installed by the decode batcher: live (occupied, total) slot
         # count across this model's lanes — the occupancy gauge
         self.slot_occupancy_fn = None
+        # installed by the decode batcher: (kv_cache_dtype, measured
+        # cache bytes across lanes) — the quantized-KV-cache axis the
+        # bench A/B and serving_top read (QUANTIZE.md)
+        self.kv_cache_fn = None
         self._shed_by_priority = {}      # priority class -> shed count
         # static resource estimates (ANALYSIS.md): set once per load /
         # hot swap by the registry's note_resource — the placement-by-
@@ -373,6 +377,13 @@ class ModelMetrics:
                     snap["decode_slots_busy"] = int(occupied)
                 except Exception:
                     snap["slot_occupancy"] = -1.0
+            if self.kv_cache_fn is not None:
+                try:
+                    kv_dtype, kv_bytes = self.kv_cache_fn()
+                    snap["kv_cache_dtype"] = str(kv_dtype)
+                    snap["kv_cache_bytes"] = int(kv_bytes)
+                except Exception:
+                    pass
         if self.spec_rounds.value or self.spec_degraded.value:
             # speculative decoding telemetry (serving_top's ACC%
             # column, Prometheus spec_* families)

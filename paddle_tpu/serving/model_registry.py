@@ -106,17 +106,21 @@ def resolve_placement(spec=None):
     return [local[i % len(local)] for i in range(n)]
 
 
-def open_predictor(path, buckets=None, device=None):
+def open_predictor(path, buckets=None, device=None,
+                   kv_cache_dtype=None):
     """Open a serving artifact directory as the right predictor type,
     optionally pinned to `device` (a jax.Device).  Detection: a
     `decode_meta.bin` dir is an autoregressive decode artifact
     (GenerativePredictor — continuous-batching generation); an
     `aot_meta.bin` dir a save_aot artifact; anything else a
-    save_inference_model dir."""
+    save_inference_model dir.  `kv_cache_dtype` (decode artifacts
+    only) overrides the artifact's KV-cache numerics pin
+    (QUANTIZE.md "Quantized KV cache")."""
     from ..inference import AnalysisConfig, Predictor, AotPredictor
     from ..inference.decode import DECODE_META, GenerativePredictor
     if os.path.exists(os.path.join(path, DECODE_META)):
-        return GenerativePredictor(path, device=device)
+        return GenerativePredictor(path, device=device,
+                                   kv_cache_dtype=kv_cache_dtype)
     if os.path.exists(os.path.join(path, "aot_meta.bin")):
         return AotPredictor(path, device=device)
     if not os.path.isdir(path):
@@ -127,11 +131,12 @@ def open_predictor(path, buckets=None, device=None):
     return Predictor(config, device=device)
 
 
-def _build_replicas(path, buckets, devices):
+def _build_replicas(path, buckets, devices, kv_cache_dtype=None):
     """One artifact load + (N-1) clone_to placements: the Program parse
     / StableHLO deserialize happens once, each replica gets its own
     device-committed param copy and compile cache."""
-    first = open_predictor(path, buckets=buckets, device=devices[0])
+    first = open_predictor(path, buckets=buckets, device=devices[0],
+                           kv_cache_dtype=kv_cache_dtype)
     preds = [first]
     for dev in devices[1:]:
         preds.append(first.clone_to(dev))
@@ -252,7 +257,7 @@ class ModelRegistry:
 
     @staticmethod
     def _fit_check(name, path, placement, decode_slots=None,
-                   draft_path=None):
+                   draft_path=None, kv_cache_dtype=None):
         """Static admission gate (ANALYSIS.md): analyze the artifact,
         then check the per-replica peak estimate against every
         placement device's memory budget.  Returns the ResourceReport
@@ -271,7 +276,8 @@ class ModelRegistry:
         from ..analysis import ResourceFitError, check_fit, resources
         try:
             report = resources.analyze_artifact(
-                path, decode_slots=decode_slots)
+                path, decode_slots=decode_slots,
+                kv_cache_dtype=kv_cache_dtype)
         except Exception:
             return None
         draft_report = None
@@ -316,7 +322,7 @@ class ModelRegistry:
                    buckets=None, drain_timeout=30.0, replicas=None,
                    devices=None, decode_slots=None, decode_mode=None,
                    precision=None, ab_weight=None, draft=None,
-                   spec_k=None):
+                   spec_k=None, kv_cache_dtype=None):
         """Load (or hot-swap in) `path` as `name`.  Returns the entry.
         `replicas`/`devices` override the registry's default placement
         spec (see resolve_placement).  ALL replicas are built and
@@ -348,7 +354,16 @@ class ModelRegistry:
         FLAGS.serving_spec_k) tokens per round and the target verifies
         them in one batched step, streams staying bit-identical to
         target-only decode.  The draft is fit-checked alongside the
-        target before any build work."""
+        target before any build work.
+
+        `kv_cache_dtype` (decode artifacts only, QUANTIZE.md
+        "Quantized KV cache"): 'int8' stores this load's KV slot
+        tables quantized (~0.25x cache bytes, in-graph quantized
+        writes, in-register dequant reads); default resolves from the
+        artifact's decode_meta pin then FLAGS.serving_kv_cache_dtype.
+        The admission fit check prices the requested cache dtype, and
+        the compile cache fingerprints it, so fp32 and int8 loads
+        never share an executable."""
         from .. import compile_cache
         spec = devices if devices is not None else (
             replicas if replicas is not None else self._replicas)
@@ -357,12 +372,19 @@ class ModelRegistry:
             os.path.join(path, "decode_meta.bin"))
         draft_path, spec_depth = None, 0
         if is_decode_path:
+            # normalize/validate at admission so a bad wire value is a
+            # typed error before any analysis or build work
+            from ..inference.decode import normalize_kv_dtype
+            if kv_cache_dtype is not None:
+                kv_cache_dtype = normalize_kv_dtype(kv_cache_dtype)
             spec_depth = int(FLAGS.serving_spec_k if spec_k is None
                              else spec_k)
             draft_path = draft if draft is not None \
                 else (FLAGS.serving_spec_draft or None)
             if not draft_path or spec_depth < 1:
                 draft_path, spec_depth = None, 0
+        else:
+            kv_cache_dtype = None
         # admission fit check (ANALYSIS.md resource analysis): the
         # static per-replica peak estimate is checked against each
         # placement device's budget BEFORE any artifact build / clone /
@@ -372,9 +394,11 @@ class ModelRegistry:
         # the estimate is advisory when it cannot be computed.
         report = self._fit_check(name, path, placement,
                                  decode_slots=decode_slots,
-                                 draft_path=draft_path)
+                                 draft_path=draft_path,
+                                 kv_cache_dtype=kv_cache_dtype)
         cc_before = compile_cache.stats()
-        preds = _build_replicas(path, buckets, placement)
+        preds = _build_replicas(path, buckets, placement,
+                                kv_cache_dtype=kv_cache_dtype)
         precision = str(precision or getattr(preds[0], "precision",
                                              "fp32"))
         lane_metrics = self.metrics.model(name, precision)
@@ -521,6 +545,9 @@ class ModelRegistry:
                         info["max_seq_len"] = \
                             latest.predictor.max_seq_len
                         info["eos_id"] = latest.predictor.eos_id
+                        info["kv_cache_dtype"] = str(getattr(
+                            latest.predictor, "kv_cache_dtype",
+                            "float32"))
                         if getattr(latest.batcher, "spec_k", 0):
                             # speculative lanes: the draft + depth the
                             # operator tuned (SERVING.md)

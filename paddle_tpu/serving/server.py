@@ -312,7 +312,8 @@ class InferenceServer:
                 precision=msg.get("precision"),
                 ab_weight=msg.get("ab_weight"),
                 draft=msg.get("draft"),
-                spec_k=msg.get("spec_k"))
+                spec_k=msg.get("spec_k"),
+                kv_cache_dtype=msg.get("kv_cache_dtype"))
             reply = {"ok": True, "name": entry.name,
                      "version": entry.version,
                      "buckets": list(entry.predictor.batch_buckets()),
@@ -329,6 +330,10 @@ class InferenceServer:
                 reply["decode_slots"] = entry.batcher.n_slots
                 reply["max_seq_len"] = entry.predictor.max_seq_len
                 reply["eos_id"] = entry.predictor.eos_id
+                # the slot-table cache numerics this load serves
+                # (QUANTIZE.md "Quantized KV cache")
+                reply["kv_cache_dtype"] = str(getattr(
+                    entry.predictor, "kv_cache_dtype", "float32"))
                 if getattr(entry.batcher, "spec_k", 0):
                     # speculative lanes armed: depth + draft artifact
                     reply["spec_k"] = entry.batcher.spec_k
@@ -670,8 +675,12 @@ class ServingClient:
     def load_model(self, name, path, version=None, buckets=None,
                    replicas=None, devices=None, decode_slots=None,
                    decode_mode=None, precision=None, ab_weight=None,
-                   draft=None, spec_k=None):
+                   draft=None, spec_k=None, kv_cache_dtype=None):
         msg = {"cmd": "load_model", "name": name, "path": path}
+        if kv_cache_dtype is not None:
+            # decode artifacts: slot-table cache numerics for this
+            # load — 'fp32'/'float32' or 'int8' (QUANTIZE.md)
+            msg["kv_cache_dtype"] = str(kv_cache_dtype)
         if draft is not None:
             # speculative decoding: draft artifact path (SERVING.md);
             # the server pairs one draft replica per target replica

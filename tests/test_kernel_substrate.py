@@ -1,0 +1,529 @@
+"""Unified oracle/parity harness for the tiled-contraction kernel
+substrate (ROOFLINE.md "Kernel substrate") + the int8 KV-cache decode
+path (QUANTIZE.md "Quantized KV cache").
+
+Every Pallas family — flash fwd/bwd, decode attention (fp32 AND int8
+cache), fused dequant-matmul — instantiates ONE driver
+(ops/pallas_kernels.tiled_contraction); this file sweeps each family
+against its plain-XLA oracle across dtypes x geometries (tileable,
+untileable-fallback, batch-1), then pins the int8 KV-cache contracts:
+cache bytes <= 0.27x fp32 at equal slots, greedy self-bit-stability,
+fp32-vs-int8 top-1 agreement >= 0.99 on the tiny fixture, slot-reuse
+zero-leakage, rollback bit-identity, and spec-decode accept rate 1.0
+for the same-cache-dtype twin.
+
+The *_smoke tests are the ci_checks.sh `kernels` gate (exit 15)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture
+def store(tmp_path):
+    from paddle_tpu import compile_cache as cc
+    old = fluid.get_flags(["compile_cache", "compile_cache_dir"])
+    root = str(tmp_path / "cc_store")
+    fluid.set_flags({"compile_cache": True, "compile_cache_dir": root})
+    cc.reset_stats()
+    yield root
+    fluid.set_flags(old)
+    cc.reset_stats()
+
+
+def _qkv(B, S, H, D, dtype, seed=0):
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.randn(B, S, H, D).astype(np.float32) * 0.3).astype(dtype)
+    return mk(), mk(), mk()
+
+
+def _decode_operands(N, S, H, D, kv_dtype, seed=1):
+    """(q, k_cache, v_cache, lengths, kv_scales) for one decode shape;
+    int8 caches come with matching per-head scales."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(N, H, D).astype(np.float32))
+    kf = rng.randn(N, S, H, D).astype(np.float32)
+    vf = rng.randn(N, S, H, D).astype(np.float32)
+    lengths = np.concatenate([[S], rng.randint(1, S + 1, size=N - 1)]) \
+        .astype(np.int32) if N > 1 else np.array([S], np.int32)
+    if kv_dtype != "int8":
+        return q, jnp.asarray(kf), jnp.asarray(vf), lengths, None
+    ks = np.abs(kf).max(axis=(0, 1, 3)) * 1.25 / 127.0
+    vs = np.abs(vf).max(axis=(0, 1, 3)) * 1.25 / 127.0
+    k8 = jnp.asarray(np.clip(np.round(
+        kf / ks[None, None, :, None]), -127, 127).astype(np.int8))
+    v8 = jnp.asarray(np.clip(np.round(
+        vf / vs[None, None, :, None]), -127, 127).astype(np.int8))
+    return q, k8, v8, lengths, np.stack([ks, vs]).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# the parity matrix: every family x dtype x geometry vs its oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype,causal,S,blocks", [
+    ("float32", False, 64, (16, 16)),
+    ("float32", True, 64, (16, 32)),
+    ("bfloat16", True, 64, (32, 16)),
+    ("float32", True, 63, None),       # prime-ish S: XLA fallback path
+    ("float32", False, 64, (64, 64)),  # single-tile degenerate grid
+])
+def test_flash_family_parity(dtype, causal, S, blocks):
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import flash_attention
+    from paddle_tpu.parallel.ring_attention import local_attention
+    q, k, v = _qkv(2, S, 2, 16, dtype)
+    kw = dict(zip(("block_q", "block_kv"), blocks)) if blocks else {}
+    out = flash_attention(q, k, v, causal=causal, **kw)
+    ref = local_attention(q, k, v, causal=causal)
+    tol = 2e-2 if dtype == "bfloat16" else 2e-5
+    assert float(jnp.abs(out.astype(jnp.float32)
+                         - ref.astype(jnp.float32)).max()) < tol
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bwd_family_parity(causal):
+    """The two transposed-stationarity bwd instantiations against the
+    XLA-autodiff oracle."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import flash_attention
+    from paddle_tpu.parallel.ring_attention import local_attention
+    q, k, v = _qkv(1, 32, 2, 8, "float32", seed=3)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v, causal=causal).astype(jnp.float32) ** 2)
+
+    gk = jax.grad(loss(lambda *a, **kw: flash_attention(
+        *a, block_q=8, block_kv=8, **kw)), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(local_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr):
+        assert float(jnp.abs(a - b).max()) < 5e-4
+
+
+@pytest.mark.parametrize("kv_dtype,N,S,bkv", [
+    ("float32", 3, 32, 8),
+    ("float32", 1, 32, 16),            # batch-1 slot table
+    ("float32", 3, 31, None),          # untileable S: fallback
+    ("int8", 3, 32, 8),
+    ("int8", 1, 32, 32),               # batch-1, whole-cache tile
+    ("int8", 3, 31, None),             # int8 fallback path
+])
+def test_decode_family_parity(kv_dtype, N, S, bkv):
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import (
+        decode_attention, decode_attention_reference)
+    q, kc, vc, lengths, scales = _decode_operands(N, S, 2, 8, kv_dtype)
+    out = decode_attention(q, kc, vc, lengths, block_kv=bkv,
+                           kv_scales=scales)
+    ref = decode_attention_reference(q, kc, vc, lengths,
+                                     kv_scales=scales)
+    assert out.shape == (N, 2, 8)
+    assert float(jnp.abs(out - ref).max()) < 2e-5
+
+
+def test_decode_int8_requires_scales():
+    from paddle_tpu.ops.pallas_kernels import decode_attention
+    q, kc, vc, lengths, _ = _decode_operands(2, 32, 2, 8, "int8")
+    with pytest.raises(ValueError, match="kv_scales"):
+        decode_attention(q, kc, vc, lengths)
+
+
+@pytest.mark.parametrize("M,K,N,blocks,act", [
+    (8, 16, 32, (4, 8, 16), "float32"),
+    (1, 32, 16, (1, 16, 8), "float32"),   # batch-1 serving bucket
+    (8, 32, 64, (4, 16, 32), "bfloat16"),
+    (3, 7, 13, None, "float32"),          # nothing tiles: fallback
+])
+def test_dequant_family_parity(M, K, N, blocks, act):
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import (
+        dequant_matmul, dequant_matmul_reference)
+    rng = np.random.RandomState(M + N)
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32)).astype(act)
+    wq = jnp.asarray(rng.randint(-127, 128, (K, N)).astype(np.int8))
+    s = jnp.asarray(rng.rand(N).astype(np.float32) * 0.1 + 0.01)
+    kw = dict(zip(("block_m", "block_k", "block_n"), blocks)) \
+        if blocks else {}
+    out = dequant_matmul(x, wq, s, out_dtype=np.float32, **kw)
+    ref = dequant_matmul_reference(x, wq, s, out_dtype=np.float32)
+    assert float(jnp.abs(out - ref).max()) < 1e-3
+
+
+def test_substrate_parity_smoke():
+    """The ci_checks `kernels` gate body: one tileable pass per family
+    against its oracle on the shared core — fast, no fixtures."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_kernels import (
+        decode_attention, decode_attention_reference, dequant_matmul,
+        dequant_matmul_reference, flash_attention)
+    from paddle_tpu.parallel.ring_attention import local_attention
+    q, k, v = _qkv(1, 32, 2, 8, "float32", seed=9)
+    assert float(jnp.abs(
+        flash_attention(q, k, v, causal=True, block_q=8, block_kv=8)
+        - local_attention(q, k, v, causal=True)).max()) < 2e-5
+    for kv_dtype in ("float32", "int8"):
+        dq, kc, vc, lengths, scales = _decode_operands(
+            2, 32, 2, 8, kv_dtype)
+        assert float(jnp.abs(
+            decode_attention(dq, kc, vc, lengths, block_kv=8,
+                             kv_scales=scales)
+            - decode_attention_reference(dq, kc, vc, lengths,
+                                         kv_scales=scales)).max()) \
+            < 2e-5
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(4, 16).astype(np.float32))
+    wq = jnp.asarray(rng.randint(-127, 128, (16, 32)).astype(np.int8))
+    s = jnp.asarray(np.full(32, 0.02, np.float32))
+    assert float(jnp.abs(
+        dequant_matmul(x, wq, s, block_m=4, block_k=8, block_n=16)
+        - dequant_matmul_reference(x, wq, s)).max()) < 1e-4
+
+
+# ---------------------------------------------------------------------------
+# tuned block-geometry entries resolve across every namespace
+# ---------------------------------------------------------------------------
+
+
+def test_tuned_entries_resolve_every_namespace(store):
+    """The substrate consolidation must not orphan the tuning
+    registry: a recorded winner in each namespace (flash, DEC_* fp32,
+    DEC_* int8, dequant) still resolves at trace time."""
+    from paddle_tpu.ops import attention_tuning as at
+    cfg = at.AttentionConfig(16, 32, 8, 8)
+    at.record(64, 16, True, "float32", cfg)
+    assert at.get_config(64, 16, True, "float32") == cfg
+    at.record_decode(32, 8, "float32", 16)
+    assert at.get_decode_config(32, 8, "float32") == 16
+    at.record_decode(32, 8, "int8", 32)
+    assert at.get_decode_config(32, 8, "int8") == 32
+    # the two cache dtypes tune independently (distinct key families)
+    assert at.get_decode_config(32, 8, "float32") == 16
+    at.record_dequant(8, 32, 16, "float32", 4, 16, 8)
+    assert at.get_dequant_config(8, 32, 16, "float32") == (4, 16, 8)
+
+
+@pytest.mark.slow
+def test_tune_kernels_driver_smoke(tmp_path):
+    """The unified autotuner sweeps all three families, records
+    winners into the registry, and each resolves (`"resolves": true`
+    rows + DEC_*_int8 keys present).  slow-marked subprocess (the
+    PR 12 rule) — the ci_checks `kernels` gate still runs it."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tune_kernels.py"),
+         "--smoke", "--cache_dir", str(tmp_path / "reg")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rows = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
+    tuned = [r for r in rows if r.get("metric") == "tuned"]
+    assert {r["family"] for r in tuned} == {"flash", "decode",
+                                            "dequant"}
+    assert all(r["resolves"] for r in tuned)
+    assert any(r.get("kv_dtype") == "int8" for r in tuned
+               if r["family"] == "decode")
+
+
+# ---------------------------------------------------------------------------
+# int8 KV cache: the session-level contracts
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_lm(tmp_path_factory):
+    from paddle_tpu.inference.decode import build_tiny_decode_model
+    d = str(tmp_path_factory.mktemp("kvlm") / "lm")
+    build_tiny_decode_model(d, vocab_size=32, d_model=16, n_heads=2,
+                            n_layers=2, max_seq_len=64)
+    return d
+
+
+_PRED_CACHE = {}
+
+
+def _open(tiny_lm, kv):
+    """Module-cached predictors: every phase compiles once per
+    (artifact, cache dtype) across the whole file — tier-1 budget is
+    tight (the compile, not the math, is the cost here)."""
+    from paddle_tpu.inference.decode import GenerativePredictor
+    key = (tiny_lm, kv)
+    if key not in _PRED_CACHE:
+        _PRED_CACHE[key] = GenerativePredictor(tiny_lm,
+                                               kv_cache_dtype=kv)
+    return _PRED_CACHE[key]
+
+
+def test_kv_dtype_resolution_and_normalize(tiny_lm):
+    from paddle_tpu.inference.decode import (GenerativePredictor,
+                                             normalize_kv_dtype)
+    assert normalize_kv_dtype(None) == "float32"
+    assert normalize_kv_dtype("fp32") == "float32"
+    assert normalize_kv_dtype("int8") == "int8"
+    with pytest.raises(ValueError):
+        normalize_kv_dtype("int4")
+    # artifact default is fp32; the explicit knob wins; clones inherit
+    assert GenerativePredictor(tiny_lm).kv_cache_dtype == "float32"
+    q8 = _open(tiny_lm, "int8")
+    assert q8.kv_cache_dtype == "int8"
+    assert q8.clone_to(None).kv_cache_dtype == "int8"
+    # the FLAGS default kicks in when nothing pins the dtype
+    old = fluid.get_flags(["serving_kv_cache_dtype"])
+    try:
+        fluid.set_flags({"serving_kv_cache_dtype": "int8"})
+        assert GenerativePredictor(tiny_lm).kv_cache_dtype == "int8"
+    finally:
+        fluid.set_flags(old)
+
+
+def test_int8_cache_bytes_smoke(tiny_lm):
+    """Static AND measured cache bytes <= 0.27x fp32 at equal slots
+    (the acceptance bound), and the closed form matches the live
+    session's arrays."""
+    fp, q8 = _open(tiny_lm, "float32"), _open(tiny_lm, "int8")
+    assert q8.kv_cache_bytes(8) <= 0.27 * fp.kv_cache_bytes(8)
+    sf, s8 = fp.new_session(8), q8.new_session(8)
+    assert s8.cache_bytes() <= 0.27 * sf.cache_bytes()
+    assert s8.cache_bytes() == q8.kv_cache_bytes(8)
+    assert sf.cache_bytes() == fp.kv_cache_bytes(8)
+
+
+def test_int8_top1_agreement_and_bit_stability_smoke(tiny_lm):
+    """fp32-vs-int8 greedy top-1 agreement >= 0.99 on the tiny decode
+    fixture, and the int8 stream is bit-stable against itself."""
+    from paddle_tpu.inference.decode import greedy_decode
+    fp, q8 = _open(tiny_lm, "float32"), _open(tiny_lm, "int8")
+    prompts = [[3, 5, 7], [9, 4], [1, 2, 3, 4, 5], [8], [6, 6, 2, 9],
+               [12, 30], [21, 7, 14]]
+    agree = total = 0
+    for p in prompts:
+        a, _ = greedy_decode(fp, p, 16)
+        b, _ = greedy_decode(q8, p, 16)
+        assert b == greedy_decode(q8, p, 16)[0], \
+            "int8 stream not bit-stable against itself"
+        m = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            m += 1
+        agree += m
+        total += max(len(a), len(b))
+    assert agree / total >= 0.99, \
+        "fp32-vs-int8 top-1 agreement %.3f < 0.99" % (agree / total)
+
+
+def test_int8_slot_reuse_zero_leakage(tiny_lm):
+    """A freed int8 slot holds exact int8 zeros and its next occupant
+    streams bit-exactly vs a fresh single-slot session — the chaos
+    decode-disconnect invariant under the quantized cache."""
+    from paddle_tpu.inference.decode import greedy_decode
+    q8 = _open(tiny_lm, "int8")
+    sess = q8.new_session(2)
+    # occupy, advance, free — then check exact zeros at the byte level
+    sess.prefill(0, [3, 5, 7])
+    sess.prefill(1, [4, 4])
+    for _ in range(3):
+        sess.decode()
+    sess.free(0)
+    assert sess.slot_is_zero(0)
+    assert np.asarray(sess._kc).dtype == np.int8
+    # reuse slot 0 while slot 1 keeps decoding; parity vs fresh session
+    t0 = sess.prefill(0, [9, 4])
+    out = [t0]
+    while len(out) < 6:
+        out.append(int(sess.decode()[0]))
+    ref, _ = greedy_decode(q8, [9, 4], 6)
+    assert out == ref
+
+
+def test_int8_rollback_bit_identity(tiny_lm):
+    """DecodeSession.rollback under the quantized cache: rolled-back
+    slots are bit-identical to never-advanced ones (the spec-decode
+    draft-sync primitive survives quantization)."""
+    q8 = _open(tiny_lm, "int8")
+    sess = q8.new_session(2)
+    sess.prefill(0, [3, 5, 7])
+    kc0 = np.asarray(sess._kc).copy()
+    vc0 = np.asarray(sess._vc).copy()
+    last0 = int(sess.last_tokens[0])
+    sess.decode()
+    sess.decode()
+    sess.rollback(0, 2, last_token=last0)
+    assert (np.asarray(sess._kc) == kc0).all()
+    assert (np.asarray(sess._vc) == vc0).all()
+    assert int(sess.lengths[0]) == 3
+
+
+def test_int8_spec_twin_accept_rate_one(tiny_lm):
+    """The spec-decode accept-rate probe: with target AND draft on the
+    int8 cache (same artifact twin) every drafted token verifies —
+    accept rate reads exactly 1.0, streams match target-only decode."""
+    from paddle_tpu.inference.decode import (SpeculativeDecodeSession,
+                                             greedy_decode)
+    q8 = _open(tiny_lm, "int8")
+    twin = _open(tiny_lm, "int8")
+    sess = SpeculativeDecodeSession(q8, twin, 2, 3)
+    sess.prefill(0, [3, 5, 7])
+    sess.prefill(1, [9, 4])
+    committed = {0: [], 1: []}
+    for _ in range(4):
+        toks, counts = sess.step()
+        for slot in (0, 1):
+            committed[slot] += list(toks[slot, :counts[slot]])
+    assert not sess.degraded
+    assert sess.proposed > 0 and sess.accepted == sess.proposed
+    # the committed stream (after the prefill token) must be the plain
+    # greedy continuation of the same prompt on the same cache dtype
+    for slot, prompt in ((0, [3, 5, 7]), (1, [9, 4])):
+        ref, _ = greedy_decode(q8, prompt, 32)
+        n = min(len(committed[slot]), len(ref) - 1)
+        assert n > 0 and committed[slot][:n] == ref[1:1 + n]
+
+
+# ---------------------------------------------------------------------------
+# static pricing + serving surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_resources_price_kv_dtype(tiny_lm):
+    """Satellite pin: the decode KV closed form prices the cache dtype
+    — analyze_artifact statically reads ~0.25x KV bytes for an
+    int8-cache load, exactly matching the predictor's accounting."""
+    from paddle_tpu.analysis import analyze_artifact
+    r_fp = analyze_artifact(tiny_lm, decode_slots=4)
+    r_q8 = analyze_artifact(tiny_lm, decode_slots=4,
+                            kv_cache_dtype="int8")
+    # fp32: 2 * L * slots * S * H * Dh * 4; int8: /4 + scale table
+    assert r_fp.kv_cache_bytes == 2 * 2 * 4 * 64 * 2 * 8 * 4
+    assert r_q8.kv_cache_bytes == 2 * 2 * 4 * 64 * 2 * 8 + 2 * 2 * 2 * 4
+    assert r_q8.kv_cache_bytes <= 0.27 * r_fp.kv_cache_bytes
+    assert r_q8.peak_bytes < r_fp.peak_bytes
+    # both closed forms agree with the predictor's own accounting
+    assert _open(tiny_lm, "float32").kv_cache_bytes(4) \
+        == r_fp.kv_cache_bytes
+    assert _open(tiny_lm, "int8").kv_cache_bytes(4) \
+        == r_q8.kv_cache_bytes
+    # a decode_meta pin prices itself with no override
+    from paddle_tpu.inference.decode import (build_tiny_decode_model,
+                                             save_decode_model)
+    from paddle_tpu.native import wire
+    import tempfile
+    d2 = os.path.join(tempfile.mkdtemp(), "lm8")
+    build_tiny_decode_model(d2, vocab_size=32, d_model=16, n_heads=2,
+                            n_layers=2, max_seq_len=64)
+    with open(os.path.join(d2, "decode_meta.bin"), "rb") as f:
+        meta = wire.decode(f.read())
+    meta["kv_cache_dtype"] = "int8"
+    with open(os.path.join(d2, "decode_meta.bin"), "wb") as f:
+        f.write(wire.encode(meta))
+    assert analyze_artifact(d2, decode_slots=4).kv_cache_bytes \
+        == r_q8.kv_cache_bytes
+
+
+def test_serving_int8_kv_end_to_end(tiny_lm, tmp_path):
+    """The full wire: load_model(kv_cache_dtype='int8') -> reply +
+    describe carry the dtype, stats carry measured cache bytes at
+    ~0.25x, streams are bit-exact vs a direct int8 session, and the
+    fp32 twin loaded beside it stays fp32 (no collision)."""
+    from paddle_tpu.inference.decode import greedy_decode
+    from paddle_tpu.serving import InferenceServer, ServingClient
+    server = InferenceServer().start()
+    cli = ServingClient(server.endpoint)
+    try:
+        loaded = cli.load_model("lm8", tiny_lm, decode_slots=2,
+                                kv_cache_dtype="int8")
+        assert loaded["kv_cache_dtype"] == "int8"
+        loaded_fp = cli.load_model("lmfp", tiny_lm, decode_slots=2)
+        assert loaded_fp["kv_cache_dtype"] == "float32"
+        reply = cli.stats()
+        assert reply["models"]["lm8"]["kv_cache_dtype"] == "int8"
+        assert reply["models"]["lmfp"]["kv_cache_dtype"] == "float32"
+        stats = reply["stats"]["models"]
+        q8 = _open(tiny_lm, "int8")
+        fp = _open(tiny_lm, "float32")
+        assert stats["lm8"]["kv_cache_dtype"] == "int8"
+        assert stats["lm8"]["kv_cache_bytes"] == q8.kv_cache_bytes(2)
+        assert stats["lmfp"]["kv_cache_bytes"] == fp.kv_cache_bytes(2)
+        assert stats["lm8"]["kv_cache_bytes"] \
+            <= 0.27 * stats["lmfp"]["kv_cache_bytes"]
+        # served int8 stream == direct int8 session, token for token
+        got = [t for ch in cli.infer_stream("lm8", [3, 5, 7],
+                                            max_new_tokens=8,
+                                            deadline_ms=60000.0)
+               for t in ch]
+        ref, _ = greedy_decode(q8, [3, 5, 7], 8)
+        assert got == ref
+        with pytest.raises(Exception):
+            cli.load_model("bad", tiny_lm, kv_cache_dtype="int4")
+    finally:
+        cli.close()
+        server.shutdown(drain=False, timeout=10.0)
+
+
+def test_int8_kv_phase_fingerprints_isolated(tiny_lm, store):
+    """fp32 and int8 executables never collide in the persistent
+    compile cache: the same artifact opened both ways produces
+    disjoint fingerprints (kv_dtype is a fingerprint field)."""
+    fp, q8 = _open(tiny_lm, "float32"), _open(tiny_lm, "int8")
+    import jax
+    L, H, Dh, _ = fp._dims()
+    specs = (jax.ShapeDtypeStruct((1, 8), np.dtype(np.int32)),
+             jax.ShapeDtypeStruct((), np.dtype(np.int32)))
+    fp_a = fp._fingerprint(("prefill", 8), specs)
+    fp_b = q8._fingerprint(("prefill", 8), specs)
+    assert fp_a != fp_b and fp_a["kv_dtype"] == "float32" \
+        and fp_b["kv_dtype"] == "int8"
+
+
+@pytest.mark.slow
+def test_chaos_decode_disconnect_int8_smoke():
+    """The chaos scenario under the quantized cache, as a subprocess
+    (the CI re-run satellite): freed slots zeroed, zero leakage.
+    slow-marked (the PR 12 rule) — runs in the ci_checks `kernels`
+    gate, which invokes pytest without -m."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "--scenario", "decode-disconnect-int8"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS decode-disconnect (kv=int8)" in out.stdout
+
+
+@pytest.mark.slow
+def test_bench_kv_dtype_ab_smoke():
+    """bench_serving --decode --kv_dtype both: records carry the
+    kv columns with the ratio and agreement bounds met."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "bench_serving.py"),
+         "--decode", "--decode_mode", "cb", "--kv_dtype", "both",
+         "--decode_slots", "2", "--qps", "6", "--duration", "2",
+         "--smoke"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rows = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    by_kv = {r.get("kv_cache_dtype"): r for r in rows
+             if r.get("metric") == "serving_decode"}
+    assert set(by_kv) == {"float32", "int8"}
+    for r in by_kv.values():
+        assert r["bit_exact"] is True
+    q8 = by_kv["int8"]
+    assert q8["kv_bytes_ratio_vs_fp32"] <= 0.27
+    assert q8["kv_measured_ratio_vs_fp32"] <= 0.27
+    assert q8["kv_top1_agreement"] >= 0.99

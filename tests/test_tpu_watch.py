@@ -30,7 +30,8 @@ def _stage_key(cmd, env_extra):
     if "bench_zoo" in joined:
         return "bench_zoo"
     for tool in ("bench_infer", "bench_serving", "convergence_run",
-                 "tune_bottleneck", "bench_attention", "trace_top"):
+                 "tune_bottleneck", "tune_kernels", "bench_attention",
+                 "trace_top"):
         if tool in joined:
             return tool
     return "bench.py"

@@ -278,15 +278,17 @@ def _measure_idle_ttft(endpoint, model, vocab, seed=99, n=40):
 
 
 def _verify_decode_bit_exact(endpoint, model, model_dir, seed, vocab,
-                             n=3):
+                             n=3, kv_cache_dtype=None):
     """Replay a few prompts through the served continuous batch and
-    against a direct single-slot DecodeSession on the same artifact —
-    requests joining/leaving the running batch must not move one token
-    (greedy parity acceptance)."""
+    against a direct single-slot DecodeSession on the same artifact
+    (opened with the SAME kv_cache_dtype — an int8-cache server must be
+    bit-exact against an int8-cache direct session) — requests
+    joining/leaving the running batch must not move one token (greedy
+    parity acceptance)."""
     from paddle_tpu.inference.decode import (GenerativePredictor,
                                              greedy_decode)
     from paddle_tpu.serving import ServingClient
-    pred = GenerativePredictor(model_dir)
+    pred = GenerativePredictor(model_dir, kv_cache_dtype=kv_cache_dtype)
     cli = ServingClient(endpoint)
     try:
         for i in range(n):
@@ -386,6 +388,31 @@ def run_decode_point(endpoint, model, vocab, target_qps, duration,
     }
 
 
+def _kv_top1_agreement(model_dir, seed, vocab, n=5, max_new=12):
+    """Greedy-stream top-1 agreement of the int8-cache twin vs the
+    fp32-cache stream on identical prompts: matched-prefix tokens over
+    total tokens (a first divergence charges the whole tail — the
+    honest metric for greedy streams).  The acceptance bound is
+    >= 0.99 on the tiny fixture."""
+    from paddle_tpu.inference.decode import (GenerativePredictor,
+                                             greedy_decode)
+    fp = GenerativePredictor(model_dir, kv_cache_dtype="float32")
+    q8 = GenerativePredictor(model_dir, kv_cache_dtype="int8")
+    agree = total = 0
+    for i in range(n):
+        prompt, _ = _decode_request(seed + 5000, i, vocab)
+        a, _ = greedy_decode(fp, prompt, max_new)
+        b, _ = greedy_decode(q8, prompt, max_new)
+        m = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            m += 1
+        agree += m
+        total += max(len(a), len(b))
+    return round(agree / float(total), 4) if total else None
+
+
 def run_decode_lane(args, backend_label):
     """The --decode entry point: fresh in-process server per decode
     mode (cb = continuous batching, static = whole-batch baseline) and
@@ -414,13 +441,28 @@ def run_decode_lane(args, backend_label):
              "both": ["static", "cb"]}[args.decode_mode]
     spec_points = [int(s) for s in args.spec_k.split(",")
                    if s.strip() != ""] if args.spec_k else [0]
+    # KV-cache dtype A/B (QUANTIZE.md "Quantized KV cache"): one fresh
+    # server per cache dtype, identical seeded workloads — the ratio
+    # columns read the 4x cache-byte cut directly
+    kv_points = {"fp32": ["float32"], "int8": ["int8"],
+                 "both": ["float32", "int8"]}[args.kv_dtype]
+    top1_agreement = _kv_top1_agreement(model_dir, seed=11,
+                                        vocab=vocab) \
+        if "int8" in kv_points else None
+    # closed-form slot-table bytes per cache dtype (the static half of
+    # the <= 0.27x acceptance ratio; measured comes from server stats)
+    from paddle_tpu.inference.decode import GenerativePredictor
+    _kv_closed = {kv: GenerativePredictor(
+        model_dir, kv_cache_dtype=kv).kv_cache_bytes
+        for kv in set(kv_points) | {"float32"}}
     draft_cost_ms = args.draft_cost_ms if args.draft_cost_ms is not None \
         else 0.3 * args.step_cost_ms
     qps_points = [float(q) for q in args.qps.split(",") if q] \
         if args.qps else [8.0]
     duration = 6.0 if args.duration is None else args.duration
     for mode in modes:
-        for spec_k in spec_points:
+        for spec_k, kv_dtype in [(s, kv) for s in spec_points
+                                 for kv in kv_points]:
             server = InferenceServer(max_queue=args.max_queue).start()
             boot = ServingClient(server.endpoint)
             try:
@@ -433,6 +475,7 @@ def run_decode_lane(args, backend_label):
                     "lm", model_dir, decode_slots=args.decode_slots,
                     decode_mode="static" if mode == "static" else None,
                     draft=draft_dir, spec_k=spec_k if draft_dir else 0,
+                    kv_cache_dtype=kv_dtype,
                     replicas=args.replicas
                     if not args.replicas.isdigit()
                     or args.replicas != "1"
@@ -445,7 +488,7 @@ def run_decode_lane(args, backend_label):
                     (time.monotonic() - t_boot) * 1e3, 1)
                 bit_exact = _verify_decode_bit_exact(
                     server.endpoint, "lm", model_dir, seed=11,
-                    vocab=vocab)
+                    vocab=vocab, kv_cache_dtype=kv_dtype)
                 if args.step_cost_ms:
                     # after the bit-exact replay and idle-TTFT
                     # baseline: the stand-in slows steps, not
@@ -460,8 +503,14 @@ def run_decode_lane(args, backend_label):
                         deadline_ms=args.deadline_ms, seed=3)
                     stats = boot.stats()["stats"]["models"].get(
                         "lm", {})
+                    n_rep = int(loaded.get("replicas", 1))
                     slots_total = int(loaded.get("decode_slots", 0)) \
-                        * int(loaded.get("replicas", 1))
+                        * n_rep
+                    slots_per = int(loaded.get("decode_slots",
+                                               args.decode_slots))
+                    kv_static = _kv_closed[kv_dtype](slots_per) * n_rep
+                    kv_fp32_static = _kv_closed["float32"](
+                        slots_per) * n_rep
                     rec.update({
                         "model": "tiny_lm",
                         "mode": mode,
@@ -489,6 +538,23 @@ def run_decode_lane(args, backend_label):
                         "draft": draft_dir,
                         "draft_cost_ms": draft_cost_ms
                         if spec_k else 0.0,
+                        # quantized-KV-cache columns (QUANTIZE.md):
+                        # static closed form + the MEASURED slot-table
+                        # bytes from stats, both ratioed against the
+                        # fp32 closed form at equal slots
+                        "kv_cache_dtype": loaded.get("kv_cache_dtype"),
+                        "kv_cache_bytes_static": kv_static,
+                        "kv_cache_bytes": stats.get("kv_cache_bytes"),
+                        "kv_bytes_ratio_vs_fp32": round(
+                            kv_static / kv_fp32_static, 4)
+                        if kv_fp32_static else None,
+                        "kv_measured_ratio_vs_fp32": round(
+                            stats.get("kv_cache_bytes", 0)
+                            / kv_fp32_static, 4)
+                        if kv_fp32_static
+                        and stats.get("kv_cache_bytes") else None,
+                        "kv_top1_agreement": top1_agreement
+                        if kv_dtype == "int8" else None,
                         "tokens_per_sec_per_slot": round(
                             rec["tokens_per_sec"] / slots_total, 3)
                         if slots_total else None,
@@ -731,6 +797,14 @@ def main():
     ap.add_argument("--decode_slots", type=int, default=4,
                     help="slot-table size per replica lane "
                          "(FLAGS.serving_decode_slots override)")
+    ap.add_argument("--kv_dtype", choices=["fp32", "int8", "both"],
+                    default="fp32",
+                    help="decode lane KV-cache dtype A/B (QUANTIZE.md "
+                         "\"Quantized KV cache\"): fresh server per "
+                         "dtype, identical seeded workloads; records "
+                         "carry static+measured cache bytes vs fp32, "
+                         "per-dtype bit-exact replay, and the "
+                         "fp32-vs-int8 greedy top-1 agreement")
     ap.add_argument("--step_cost_ms", type=float, default=0.0,
                     help="deterministic per-decode-step stall in the "
                          "lane loop (GIL released — the same stand-in "

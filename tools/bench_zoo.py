@@ -129,6 +129,17 @@ CONFIGS = [
      ["@serving", "--decode", "--decode_mode", "cb",
       "--decode_slots", "8", "--step_cost_ms", "20", "--qps", "30",
       "--duration", "8"], 8, 1),
+    # quantized-KV-cache A/B (QUANTIZE.md "Quantized KV cache"): the
+    # same continuous-batching decode workload served with the fp32 vs
+    # the int8 slot table (fresh server per dtype).  The records carry
+    # static + measured cache bytes vs fp32 (<= 0.27x acceptance), a
+    # per-dtype bit-exact replay (int8 streams are bit-stable against
+    # an int8 direct session), and the fp32-vs-int8 greedy top-1
+    # agreement (>= 0.99 acceptance) — BENCH_r14.json headline
+    ("serving_decode_int8kv",
+     ["@serving", "--decode", "--decode_mode", "cb",
+      "--decode_slots", "8", "--step_cost_ms", "20", "--qps", "30",
+      "--kv_dtype", "both", "--duration", "8"], 8, 1),
     # speculative-decoding lane (SERVING.md "Speculative decoding"):
     # same continuous-batching workload, draft depth 0 (target-only
     # baseline) vs 4 on one sweep — the same-weights twin draft makes

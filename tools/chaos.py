@@ -47,6 +47,12 @@ exits nonzero on failure):
                event for the latter), with zero wedged lanes and zero
                cross-request KV leakage — reused slots serve bit-exact
                greedy streams because freed slots are zeroed.
+  decode-disconnect-int8
+               the same scenario under the QUANTIZED slot table
+               (QUANTIZE.md "Quantized KV cache", kv_cache_dtype=int8):
+               freed slots hold exact int8 zeros, replays compare
+               against a direct int8-cache session — zero leakage and
+               bit-stability survive quantization.
   spec-fallback
                speculative-decoding chaos (SERVING.md): poison the
                draft predictor MID-STREAM (set_draft_poison) — the
@@ -858,10 +864,16 @@ def scenario_serving_overload(verbose=True):
     return outcomes
 
 
-def scenario_decode_disconnect(verbose=True):
+def scenario_decode_disconnect(verbose=True, kv_dtype=None):
     """Continuous-batching decode chaos (SERVING.md "Continuous
     batching & streaming"): streaming requests that die mid-generation
     must not wedge the slot table.
+
+    `kv_dtype="int8"` re-runs the whole scenario under the QUANTIZED
+    slot table (QUANTIZE.md "Quantized KV cache"): the invariants are
+    identical — freed slots must hold exact int8 zeros before reuse,
+    and phase C's replay (vs a direct int8-cache session) proves zero
+    cross-request leakage survives quantization.
 
     Phase A — client disconnect mid-stream: a victim opens an
     `infer_stream`, reads a few chunks, and drops the connection.  The
@@ -894,7 +906,10 @@ def scenario_decode_disconnect(verbose=True):
         os.path.join(tempfile.mkdtemp(prefix="chaos_decode_"), "lm"),
         vocab_size=64, d_model=32, n_heads=4, n_layers=2,
         max_seq_len=64, eos_id=-1, seed=21)
-    pred = GenerativePredictor(md)
+    # the reference session runs the SAME cache dtype as the server:
+    # int8 streams are bit-exact against int8 sessions (self-stable),
+    # not against fp32 ones
+    pred = GenerativePredictor(md, kv_cache_dtype=kv_dtype)
     server = InferenceServer().start()
     boot = ServingClient(server.endpoint)
     step_ms = 20.0
@@ -905,7 +920,7 @@ def scenario_decode_disconnect(verbose=True):
             "decode_steps", 0)
 
     try:
-        boot.load_model("lm", md, decode_slots=2)
+        boot.load_model("lm", md, decode_slots=2, kv_cache_dtype=kv_dtype)
         # slow, deterministic steps so "mid-stream" is unambiguous
         set_dispatch_delay(step_ms / 1000.0)
 
@@ -995,13 +1010,15 @@ def scenario_decode_disconnect(verbose=True):
         boot.close()
         server.shutdown(drain=False, timeout=10.0)
     if verbose:
-        print("PASS decode-disconnect: slot freed in %d step(s) after "
-              "disconnect, deadline evicted mid-decode after %d "
+        print("PASS decode-disconnect%s: slot freed in %d step(s) "
+              "after disconnect, deadline evicted mid-decode after %d "
               "token(s) with event, %d post-chaos streams bit-exact "
               "on reused slots"
-              % (freed_steps, tokens_before_expiry, len(prompts)))
+              % (" (kv=%s)" % kv_dtype if kv_dtype else "",
+                 freed_steps, tokens_before_expiry, len(prompts)))
     return {"freed_steps": freed_steps,
-            "expired_tokens": tokens_before_expiry}
+            "expired_tokens": tokens_before_expiry,
+            "kv_dtype": kv_dtype or "float32"}
 
 
 def scenario_spec_fallback(verbose=True):
@@ -1478,6 +1495,7 @@ def main(argv=None):
                                            "quantize-commit",
                                            "trace-overflow",
                                            "decode-disconnect",
+                                           "decode-disconnect-int8",
                                            "spec-fallback",
                                            "slo-breach", "all"])
     ap.add_argument("--smoke", action="store_true",
@@ -1525,8 +1543,8 @@ def main(argv=None):
         scenarios = ["crash-save", "bit-flip", "nan-poison", "drop-rpc",
                      "serving-overload", "cache-commit",
                      "quantize-commit", "trace-overflow",
-                     "decode-disconnect", "spec-fallback",
-                     "slo-breach"]
+                     "decode-disconnect", "decode-disconnect-int8",
+                     "spec-fallback", "slo-breach"]
     else:
         scenarios = [args.scenario]
     rc = 0
@@ -1563,6 +1581,9 @@ def main(argv=None):
                     os.path.join(workdir, "trace_overflow"))
             elif s == "decode-disconnect":
                 scenario_decode_disconnect()
+            elif s == "decode-disconnect-int8":
+                # the same invariants under the QUANTIZED slot table
+                scenario_decode_disconnect(kv_dtype="int8")
             elif s == "spec-fallback":
                 scenario_spec_fallback()
             elif s == "slo-breach":

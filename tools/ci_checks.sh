@@ -22,6 +22,12 @@
 #                        breach within 2 windows -> bundle) plus
 #                        flight_inspect --validate on the produced
 #                        bundle (OBSERVABILITY.md)
+#     15  kernels        kernel-substrate parity smoke: every Pallas
+#                        family (flash fwd/bwd, decode fp32+int8-KV,
+#                        dequant-matmul) against its plain-XLA oracle
+#                        on the shared tiled-contraction core
+#                        (ROOFLINE.md "Kernel substrate",
+#                        tests/test_kernel_substrate.py)
 #      1  usage          unknown gate name
 #      0  all requested gates clean
 #
@@ -37,7 +43,7 @@ SPEC="${API_SPEC:-API.spec}"
 
 gates=("$@")
 if [ ${#gates[@]} -eq 0 ]; then
-    gates=(lint_runtime lint_program apispec specdec slo)
+    gates=(lint_runtime lint_program apispec specdec slo kernels)
 fi
 
 for gate in "${gates[@]}"; do
@@ -82,10 +88,15 @@ for gate in "${gates[@]}"; do
                 || { rm -rf "$slodir"; exit 14; }
             rm -rf "$slodir"
             ;;
+        kernels)
+            echo "== ci_checks: kernels gate =="
+            "$PY" -m pytest tests/test_kernel_substrate.py -q \
+                -k "smoke" -p no:cacheprovider || exit 15
+            ;;
         *)
             echo "ci_checks: unknown gate '$gate'" \
                  "(have: lint_runtime lint_program apispec specdec" \
-                 "slo)"
+                 "slo kernels)"
             exit 1
             ;;
     esac

@@ -164,6 +164,21 @@ def main():
                     "--decode", "--decode_mode", "both",
                     "--decode_slots", "16", "--qps", "60",
                     "--duration", "15"], {}, 3600),
+        # quantized-KV-cache A/B on silicon (QUANTIZE.md "Quantized KV
+        # cache"): decode with the fp32 vs int8 slot table at REAL step
+        # cost — on the HBM-bound decode roofline the 0.25x cache bytes
+        # should read directly in tokens/sec at large slot tables,
+        # which the CPU-smoke lane (BENCH_r14.json) cannot measure;
+        # records carry measured cache bytes + fp32-vs-int8 top-1
+        # agreement.  tools/tune_kernels.py --families decode sweeps
+        # the DEC_*_int8 block geometry beforehand
+        ("decode_int8kv", ["tools/tune_kernels.py", "--require_tpu",
+                           "--families", "decode"], {}, 3600),
+        ("decode_int8kv_ab", ["tools/bench_serving.py", "--require_tpu",
+                              "--decode", "--decode_mode", "cb",
+                              "--decode_slots", "16", "--qps", "60",
+                              "--kv_dtype", "both",
+                              "--duration", "15"], {}, 3600),
         # speculative decoding on silicon (SERVING.md "Speculative
         # decoding"): the --spec_k accept-rate x speedup sweep with
         # REAL step costs — no --step_cost_ms/--draft_cost_ms

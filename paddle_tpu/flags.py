@@ -578,6 +578,49 @@ DEFINE_float(
     "breach storm writes ONE bundle per reason per window, not "
     "hundreds. The manual `flight` RPC bypasses it (force).",
     on_change=_flight_changed)
+DEFINE_bool(
+    "fleet_controller", False,
+    "Run the fleet controller on every InferenceServer "
+    "(paddle_tpu/serving/fleet.py, SERVING.md \"Fleet controller\"): a "
+    "background loop that closes the loop from the SLO burn/queue/"
+    "occupancy/shed sensors to the registry's actuators — scaling a "
+    "model's replica set within its declared [min,max] policy (every "
+    "resize rides the build-warm-flip hot swap, so scaling is zero-"
+    "drop by construction, and the resource fit check gates every "
+    "grow), paging idle-past-TTL models out to their artifact paths "
+    "(they fault back in on the next request — a reload, not a "
+    "recompile, under the warm compile cache), and degrading under "
+    "sustained burn by shifting ab_weight toward the int8 lane BEFORE "
+    "admission sheds. Off (default) keeps replica counts, residency "
+    "and lane weights fully operator-driven.")
+DEFINE_float(
+    "fleet_eval_interval_ms", 1000.0,
+    "Fleet-controller evaluation interval in milliseconds: each tick "
+    "reads the per-model sensors (SLO state/burn, queue depth, slot "
+    "occupancy, shed/request deltas, idle age) and decides at most a "
+    "few cooldown-bounded actions. Detection-to-actuation latency for "
+    "a hard breach is roughly one SLO fast window plus one tick.")
+DEFINE_string(
+    "fleet_policy", "",
+    "Declared fleet policies (SERVING.md \"Fleet controller\"): "
+    "semicolon-separated '[model:]key=val,key=val' declarations; no "
+    "model prefix (or '*') sets the default for every model. Keys: "
+    "min_replicas, max_replicas (the scale range; max_replicas=1 "
+    "disables scaling), page_ttl_s (idle seconds before a model pages "
+    "out to its artifact path; 0 never pages), scale_up_queue (queued "
+    "requests per live replica that trigger a grow), "
+    "scale_down_idle_s, degrade_weight (the int8 lane's ab share "
+    "under sustained burn), restore_evals (clean ticks before the "
+    "weight restores — hysteresis), scale_cooldown_s, page_cooldown_s, "
+    "degrade_cooldown_s. Example: 'max_replicas=4;llm:page_ttl_s=600,"
+    "scale_up_queue=8'. Empty = observe-only (no policy, no actions).")
+DEFINE_bool(
+    "fleet_dry_run", False,
+    "Fleet-controller dry-run: every tick still senses and decides, "
+    "and every decision is logged as a fleet_decision event with its "
+    "triggering signal, but NO action touches the registry — replica "
+    "counts, residency and ab weights stay untouched. The rehearsal "
+    "mode for a new policy spec against live traffic.")
 DEFINE_int(
     "dist_threadpool_size", 0,
     "Reference distributed thread pool size. Advisory.")

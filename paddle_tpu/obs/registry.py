@@ -83,6 +83,7 @@ class MetricsRegistry(object):
         self._hists = {}       # (name, labels-tuple) -> ReservoirHistogram
         self._serving = []     # attached ServingMetrics
         self._slo = []         # attached SLOMonitors (obs/slo.py)
+        self._fleet = []       # attached FleetControllers (serving/fleet)
         self._span_agg = {}    # (kind, name) -> [count, total_ms]
 
     # -- primitive instruments ---------------------------------------
@@ -144,6 +145,20 @@ class MetricsRegistry(object):
         with self._lock:
             if monitor in self._slo:
                 self._slo.remove(monitor)
+
+    def attach_fleet(self, controller):
+        """Absorb one FleetController (serving/fleet.py): its
+        fleet_replicas / fleet_state / fault_in_ms gauges render as
+        first-class families — the actuation-side twin of the slo_*
+        judgment families."""
+        with self._lock:
+            if controller not in self._fleet:
+                self._fleet.append(controller)
+
+    def detach_fleet(self, controller):
+        with self._lock:
+            if controller in self._fleet:
+                self._fleet.remove(controller)
 
     def note_span(self, span):
         """Tracing-ring listener: fold one completed span into the
@@ -270,9 +285,12 @@ class MetricsRegistry(object):
 
     def _render_slo(self, lines):
         """Burn-rate / compliance / state families from every attached
-        SLOMonitor (obs/slo.py export rows)."""
+        SLOMonitor (obs/slo.py export rows), and the fleet families
+        (fleet_replicas / fleet_state / fault_in_ms) from every
+        attached FleetController — both speak the same
+        [(metric, labels, value, type)] export row shape."""
         with self._lock:
-            monitors = list(self._slo)
+            monitors = list(self._slo) + list(self._fleet)
         by_name = {}
         for mon in monitors:
             try:

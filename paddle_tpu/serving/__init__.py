@@ -7,8 +7,10 @@ fronted by per-replica execution lanes and a least-loaded router
 (batcher.py), named/versioned models with placement specs and warm
 atomic hot swap of whole replica sets (model_registry.py), a threaded
 wire-protocol front with priority-class admission control and graceful
-drain (server.py), and per-model + per-replica serving metrics
-(metrics.py).
+drain (server.py), per-model + per-replica serving metrics
+(metrics.py), and the fleet controller closing the loop from the
+SLO/queue/occupancy sensors to replica-set scaling, cold-model paging
+and pressure degradation (fleet.py — SERVING.md "Fleet controller").
 
 Reference analogue: paddle/fluid/inference/api/ stops at a synchronous
 per-caller predictor; the serving layer the TensorFlow system paper
@@ -20,6 +22,8 @@ coalescing, load shedding) from the training runtime's.
 from .batcher import (BatcherClosed, DeadlineExceeded, DecodeBatcher,
                       DecodeStream, DynamicBatcher, ServerOverloaded,
                       set_dispatch_delay, set_draft_delay)
+from .fleet import (FleetAction, FleetController, FleetPolicy,
+                    ModelSensors, parse_fleet_spec)
 from .metrics import (Counter, ModelMetrics, ReservoirHistogram,
                       ServingMetrics)
 from .model_registry import (ModelEntry, ModelRegistry, open_predictor,
@@ -33,5 +37,7 @@ __all__ = [
     "Counter", "ReservoirHistogram", "ModelMetrics", "ServingMetrics",
     "ModelRegistry", "ModelEntry", "open_predictor",
     "resolve_placement",
+    "FleetController", "FleetPolicy", "FleetAction", "ModelSensors",
+    "parse_fleet_spec",
     "InferenceServer", "ServingClient", "ServingError",
 ]

@@ -1,0 +1,644 @@
+"""Fleet controller: SLO-driven autoscaling, cold-model paging, and
+pressure degradation for the multi-model zoo.
+
+PR 13 landed the *judgment* layer (obs/slo.py burn-rate states, the
+`health` RPC's lane liveness, the flight recorder) but nothing ACTED on
+those signals: replica counts, lane weights, and which models are
+resident were all static operator choices.  This module is the control
+plane above the registry — a per-server background loop
+(``FLAGS.fleet_controller`` / ``fleet_eval_interval_ms``) that each
+tick reads the per-model sensors and closes the loop through three
+actuators the serving stack already guarantees safe:
+
+* **scale** — grow/shrink a model's replica set within its declared
+  ``[min_replicas, max_replicas]`` policy via
+  ``ModelRegistry.resize_model``, which replays the model's persisted
+  load spec at the new placement through ``load_model`` — i.e. every
+  resize rides the build-warm-flip hot-swap discipline (SERVING.md),
+  so scaling is zero-drop by construction, and the ANALYSIS.md
+  resource fit check gates every grow before any build work;
+* **page** — a model idle past ``page_ttl_s`` unloads to its artifact
+  path (``ModelRegistry.page_out`` keeps the load spec + A/B weights)
+  and faults back in on the next request — or from here on rising
+  burn — with the COMPILE_CACHE.md store making fault-in a reload,
+  not a recompile; time-to-fault-in is measured and pinned
+  (``fault_in_ms`` gauge, ``fleet_fault_in`` event);
+* **degrade** — under sustained burn, shift default-traffic
+  ``ab_weight`` toward the int8 lane (when a quantized peer exists —
+  QUANTIZE.md) *before* admission starts shedding; restore the saved
+  weights only after ``restore_evals`` consecutive clean ticks
+  (hysteresis — the weight must not flap with the burn).
+
+Every action is emitted as a structured obs event carrying the
+triggering signal, per-mechanism cooldowns bound the actuation rate,
+and ``dry_run`` logs each decision (``fleet_decision`` events) without
+touching the registry.
+
+The decision core is a PURE function — ``decide(sensors, policy,
+state, now)`` maps one model's sensor snapshot + controller state to a
+list of :class:`FleetAction` — so the policy is testable from seeded
+snapshots without a live server (tests/test_fleet.py).
+
+Policy grammar (``FLAGS.fleet_policy`` / the ``fleet`` RPC's
+``set_policy``): the serving_slo spec syntax —
+``[model:]key=val,key=val;...`` with ``*`` (or no prefix) as the
+default applied to every model without its own declaration.
+"""
+
+import collections
+import threading
+import time
+
+__all__ = ["FleetPolicy", "FleetAction", "ModelSensors",
+           "FleetController", "parse_fleet_spec", "decide",
+           "FLEET_ACTIVE", "FLEET_DEGRADED", "FLEET_PAGED"]
+
+# fleet_state gauge codes (obs/registry.py fleet families)
+FLEET_ACTIVE = "active"
+FLEET_DEGRADED = "degraded"
+FLEET_PAGED = "paged"
+_STATE_CODE = {FLEET_ACTIVE: 0, FLEET_DEGRADED: 1, FLEET_PAGED: 2}
+
+# SLO health states the sensors carry (obs/slo.py)
+_SLO_DEGRADED = "degraded"
+_SLO_BREACH = "breach"
+
+_POLICY_INTS = ("min_replicas", "max_replicas", "scale_up_queue",
+                "restore_evals")
+_POLICY_FLOATS = ("page_ttl_s", "scale_down_idle_s", "degrade_weight",
+                  "scale_cooldown_s", "page_cooldown_s",
+                  "degrade_cooldown_s")
+_POLICY_KEYS = _POLICY_INTS + _POLICY_FLOATS
+
+
+class FleetPolicy(object):
+    """One model's declared scaling/paging/degradation envelope.  The
+    controller never acts outside it: ``max_replicas=1`` (default)
+    disables scaling, ``page_ttl_s=0`` disables paging, and a model
+    with no policy at all (and no ``*`` default) is observe-only."""
+
+    __slots__ = ("min_replicas", "max_replicas", "page_ttl_s",
+                 "scale_up_queue", "scale_down_idle_s",
+                 "degrade_weight", "restore_evals", "scale_cooldown_s",
+                 "page_cooldown_s", "degrade_cooldown_s")
+
+    def __init__(self, min_replicas=1, max_replicas=1, page_ttl_s=0.0,
+                 scale_up_queue=4, scale_down_idle_s=30.0,
+                 degrade_weight=0.9, restore_evals=3,
+                 scale_cooldown_s=15.0, page_cooldown_s=30.0,
+                 degrade_cooldown_s=10.0):
+        self.min_replicas = max(int(min_replicas), 1)
+        self.max_replicas = max(int(max_replicas), self.min_replicas)
+        self.page_ttl_s = max(float(page_ttl_s), 0.0)
+        self.scale_up_queue = max(int(scale_up_queue), 1)
+        self.scale_down_idle_s = max(float(scale_down_idle_s), 0.0)
+        self.degrade_weight = min(max(float(degrade_weight), 0.0), 1.0)
+        self.restore_evals = max(int(restore_evals), 1)
+        self.scale_cooldown_s = max(float(scale_cooldown_s), 0.0)
+        self.page_cooldown_s = max(float(page_cooldown_s), 0.0)
+        self.degrade_cooldown_s = max(float(degrade_cooldown_s), 0.0)
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in _POLICY_KEYS}
+
+    def __repr__(self):
+        return "FleetPolicy(%s)" % ", ".join(
+            "%s=%s" % (k, v) for k, v in sorted(self.to_dict().items()))
+
+
+def parse_fleet_spec(spec):
+    """Parse ``FLAGS.fleet_policy`` into {model_or_*: FleetPolicy} —
+    the serving_slo grammar: ``[model:]key=val,key=val;...``."""
+    out = {}
+    if not spec:
+        return out
+    for decl in str(spec).split(";"):
+        decl = decl.strip()
+        if not decl:
+            continue
+        model, body = "*", decl
+        head, sep, rest = decl.partition(":")
+        if sep and "=" not in head:
+            model, body = (head.strip() or "*"), rest
+        kwargs = {}
+        for part in body.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, eq, val = part.partition("=")
+            key = key.strip()
+            if not eq or key not in _POLICY_KEYS:
+                raise ValueError(
+                    "bad fleet policy entry %r (model %r) — keys are %s"
+                    % (part, model, ", ".join(_POLICY_KEYS)))
+            kwargs[key] = int(float(val)) if key in _POLICY_INTS \
+                else float(val)
+        out[model] = FleetPolicy(**kwargs)
+    return out
+
+
+class ModelSensors(object):
+    """One model's sensor snapshot for one evaluation tick — plain
+    data, so seeded instances drive ``decide()`` in tests without a
+    live server."""
+
+    __slots__ = ("model", "replicas", "paged", "queue_depth",
+                 "occupancy", "slo_state", "burn_fast",
+                 "requests_delta", "shed_delta", "idle_s",
+                 "has_int8_peer", "ab", "decode")
+
+    def __init__(self, model, replicas=1, paged=False, queue_depth=0,
+                 occupancy=None, slo_state=None, burn_fast=None,
+                 requests_delta=0, shed_delta=0, idle_s=0.0,
+                 has_int8_peer=False, ab=None, decode=False):
+        self.model = str(model)
+        self.replicas = int(replicas)
+        self.paged = bool(paged)
+        self.queue_depth = int(queue_depth)
+        self.occupancy = occupancy
+        self.slo_state = slo_state
+        self.burn_fast = burn_fast
+        self.requests_delta = int(requests_delta)
+        self.shed_delta = int(shed_delta)
+        self.idle_s = float(idle_s)
+        self.has_int8_peer = bool(has_int8_peer)
+        self.ab = dict(ab or {})
+        self.decode = bool(decode)
+
+    def to_dict(self):
+        d = {"model": self.model, "replicas": self.replicas,
+             "paged": self.paged, "queue_depth": self.queue_depth,
+             "requests_delta": self.requests_delta,
+             "shed_delta": self.shed_delta,
+             "idle_s": round(self.idle_s, 3)}
+        if self.slo_state is not None:
+            d["slo_state"] = self.slo_state
+        if self.burn_fast is not None:
+            d["burn_fast"] = round(self.burn_fast, 3)
+        if self.occupancy is not None:
+            d["occupancy"] = round(self.occupancy, 3)
+        if self.has_int8_peer:
+            d["has_int8_peer"] = True
+            if self.ab:
+                d["ab"] = dict(self.ab)
+        return d
+
+
+class FleetAction(object):
+    """One decided actuation: what to do, to which model, with which
+    parameters, and the SENSOR SIGNAL that triggered it (the signal
+    rides the emitted event — acceptance: every action is evented with
+    its triggering signal)."""
+
+    __slots__ = ("kind", "model", "params", "signal")
+
+    def __init__(self, kind, model, params=None, signal=None):
+        self.kind = str(kind)
+        self.model = str(model)
+        self.params = dict(params or {})
+        self.signal = dict(signal or {})
+
+    def to_dict(self):
+        return {"kind": self.kind, "model": self.model,
+                "params": dict(self.params),
+                "signal": dict(self.signal)}
+
+    def __repr__(self):
+        return "FleetAction(%s, %s, %s)" % (self.kind, self.model,
+                                            self.params)
+
+
+def _cool(state, key, now, cooldown_s):
+    """True when the mechanism's cooldown has elapsed (or never
+    fired)."""
+    last = state.get(key)
+    return last is None or (now - last) >= cooldown_s
+
+
+def decide(sensors, policy, state, now):
+    """The pure decision core: one model's sensors + controller state
+    -> ordered FleetAction list.  ``state`` is read-only here — the
+    controller stamps cooldowns/streaks only after an action actually
+    executes.  Ordering is the execution order, and encodes
+    degrade-before-shed: under breach the cheap capacity (the int8
+    lane) is engaged before (or alongside) the expensive one (a new
+    replica set), and always before admission starts shedding.
+    """
+    acts = []
+    if policy is None or sensors is None:
+        return acts
+    s = sensors
+    if s.paged:
+        # paged models act on DEMAND only: traffic/sheds arriving (the
+        # registry's request path usually faults in first — this
+        # covers the rising-burn / shed-while-paged case)
+        if (s.requests_delta > 0 or s.shed_delta > 0
+                or s.slo_state in (_SLO_DEGRADED, _SLO_BREACH)):
+            acts.append(FleetAction(
+                "fault_in", s.model,
+                signal=dict(s.to_dict(), trigger="demand")))
+        return acts
+    pressure = s.slo_state in (_SLO_DEGRADED, _SLO_BREACH) or (
+        s.queue_depth >= policy.scale_up_queue * max(s.replicas, 1))
+    if (s.slo_state == _SLO_BREACH and s.has_int8_peer
+            and not state.get("degraded")
+            and s.ab.get("int8", 0.0) < policy.degrade_weight
+            and _cool(state, "last_degrade_t", now,
+                      policy.degrade_cooldown_s)):
+        acts.append(FleetAction(
+            "degrade", s.model,
+            params={"weight": policy.degrade_weight,
+                    "saved_ab": dict(s.ab)},
+            signal=dict(s.to_dict(), trigger="sustained_burn")))
+    if (pressure and s.replicas < policy.max_replicas
+            and _cool(state, "last_scale_t", now,
+                      policy.scale_cooldown_s)):
+        acts.append(FleetAction(
+            "scale_up", s.model,
+            params={"replicas": s.replicas + 1},
+            signal=dict(s.to_dict(),
+                        trigger="slo" if s.slo_state in
+                        (_SLO_DEGRADED, _SLO_BREACH) else "queue")))
+    if (state.get("degraded") and s.slo_state not in
+            (_SLO_DEGRADED, _SLO_BREACH)
+            and state.get("clean_streak", 0) >= policy.restore_evals):
+        acts.append(FleetAction(
+            "restore", s.model,
+            params={"ab": dict(state.get("saved_ab") or {})},
+            signal=dict(s.to_dict(), trigger="recovered",
+                        clean_streak=state.get("clean_streak", 0))))
+    if pressure:
+        return acts
+    # idle-side actions: paging supersedes shrinking (the whole model
+    # leaves the device — no point resizing what is about to unload)
+    if (policy.page_ttl_s > 0 and s.idle_s >= policy.page_ttl_s
+            and not state.get("degraded")
+            and _cool(state, "last_page_t", now, policy.page_cooldown_s)):
+        acts.append(FleetAction(
+            "page_out", s.model,
+            signal=dict(s.to_dict(), trigger="idle_ttl",
+                        ttl_s=policy.page_ttl_s)))
+        return acts
+    if (s.replicas > policy.min_replicas
+            and s.idle_s >= policy.scale_down_idle_s
+            and _cool(state, "last_scale_t", now,
+                      policy.scale_cooldown_s)):
+        acts.append(FleetAction(
+            "scale_down", s.model,
+            params={"replicas": s.replicas - 1},
+            signal=dict(s.to_dict(), trigger="idle")))
+    return acts
+
+
+class FleetController(object):
+    """The per-server control loop: senses (registry + metrics + SLO
+    monitor), decides (the pure ``decide``), and actuates through the
+    registry — on a daemon thread every ``interval_s``, or stepped by
+    hand via ``tick()`` (tests, synthetic drivers).
+
+    ``dry_run`` logs every decision as a ``fleet_decision`` event and
+    changes NOTHING (and stamps no cooldowns — a rehearsal keeps
+    re-announcing what it would do)."""
+
+    ACTIONS_KEPT = 64
+
+    def __init__(self, registry, metrics, slo=None, policies=None,
+                 interval_s=None, dry_run=None, name="server"):
+        from ..flags import FLAGS
+        self.registry = registry
+        self.metrics = metrics
+        self.slo = slo
+        self.name = str(name)
+        self.interval_s = (float(FLAGS.fleet_eval_interval_ms) / 1000.0
+                           if interval_s is None else float(interval_s))
+        self.interval_s = max(self.interval_s, 0.01)
+        self.dry_run = (bool(FLAGS.fleet_dry_run) if dry_run is None
+                        else bool(dry_run))
+        self._lock = threading.Lock()
+        self._policies = dict(policies or {})  # model (or '*') -> policy
+        self._state = {}           # model -> controller bookkeeping
+        self._last_sensors = {}    # model -> ModelSensors (last tick)
+        self._actions = collections.deque(maxlen=self.ACTIONS_KEPT)
+        self._stop = threading.Event()
+        self._thread = None
+        self._ticks = 0
+
+    @classmethod
+    def from_flags(cls, registry, metrics, slo=None, name="server"):
+        from ..flags import FLAGS
+        return cls(registry, metrics, slo=slo,
+                   policies=parse_fleet_spec(FLAGS.fleet_policy),
+                   name=name)
+
+    # -- policies ------------------------------------------------------
+
+    def set_policy(self, model, policy=None, **kwargs):
+        """Declare (or replace) one model's policy: a FleetPolicy, a
+        spec-body string ('min_replicas=1,max_replicas=4,...'), or
+        kwargs."""
+        if isinstance(policy, str):
+            parsed = parse_fleet_spec(policy)
+            policy = parsed.get("*") or parsed.get(str(model))
+            if policy is None:
+                raise ValueError("fleet policy spec %r declared no "
+                                 "usable body" % model)
+        if policy is None:
+            policy = FleetPolicy(**kwargs)
+        with self._lock:
+            self._policies[str(model)] = policy
+        return policy
+
+    def policy_for(self, model):
+        with self._lock:
+            return (self._policies.get(str(model))
+                    or self._policies.get("*"))
+
+    # -- sensing -------------------------------------------------------
+
+    def _lane_keys(self, lanes, model):
+        return [k for k in lanes
+                if k == model or k.startswith(model + "@")]
+
+    def _collect_sensors_locked(self, now):
+        """One ModelSensors per model (live or paged), aggregated
+        across its precision lanes (caller holds self._lock — the
+        `_locked` suffix is the lint-checked convention)."""
+        desc = self.registry.describe()
+        paged = self.registry.paged_models()
+        slo_state = self.slo.state() if self.slo is not None else {}
+        with self.metrics._lock:
+            lanes = dict(self.metrics._models)
+        out = {}
+        for model in sorted(set(desc) | set(paged)):
+            d = desc.get(model) or {}
+            is_paged = bool(d.get("paged")) or (
+                model in paged and "latest" not in d)
+            requests = shed = queue_depth = 0
+            occ_busy = occ_total = 0
+            for key in self._lane_keys(lanes, model):
+                mm = lanes[key]
+                requests += mm.requests.value
+                shed += mm.shed.value
+                if mm.queue_depth_fn is not None:
+                    try:
+                        queue_depth += int(mm.queue_depth_fn())
+                    except Exception:
+                        pass
+                if mm.slot_occupancy_fn is not None:
+                    try:
+                        busy, total = mm.slot_occupancy_fn()
+                        occ_busy += int(busy)
+                        occ_total += int(total)
+                    except Exception:
+                        pass
+            st = self._state.setdefault(
+                model, {"requests": requests, "shed": shed,
+                        "last_traffic_t": now, "clean_streak": 0,
+                        "degraded": False, "saved_ab": None})
+            req_delta = max(requests - st.get("requests", 0), 0)
+            shed_delta = max(shed - st.get("shed", 0), 0)
+            st["requests"], st["shed"] = requests, shed
+            if req_delta > 0 or shed_delta > 0:
+                st["last_traffic_t"] = now
+            idle_s = max(now - st.get("last_traffic_t", now), 0.0)
+            worst, burn_fast = None, None
+            for key in self._lane_keys(slo_state, model):
+                info = slo_state.get(key) or {}
+                lane_st = info.get("state")
+                if lane_st is not None:
+                    order = {None: -1, "ok": 0, _SLO_DEGRADED: 1,
+                             _SLO_BREACH: 2}
+                    if order.get(lane_st, 0) > order.get(worst, -1):
+                        worst = lane_st
+                for b in (info.get("burn") or {}).values():
+                    f = b.get("fast")
+                    if f is not None and (burn_fast is None
+                                          or f > burn_fast):
+                        burn_fast = f
+            precisions = d.get("precisions") or {}
+            out[model] = ModelSensors(
+                model,
+                replicas=int(d.get("replicas", 0) or 0),
+                paged=is_paged,
+                queue_depth=queue_depth,
+                occupancy=(occ_busy / occ_total) if occ_total else None,
+                slo_state=worst,
+                burn_fast=burn_fast,
+                requests_delta=req_delta,
+                shed_delta=shed_delta,
+                idle_s=idle_s,
+                has_int8_peer="int8" in precisions,
+                ab=d.get("ab_weights") or {},
+                decode=bool(d.get("decode")))
+        return out
+
+    # -- actuation -----------------------------------------------------
+
+    def _execute(self, action, now):
+        """Run one decided action against the registry; returns an
+        error string (None on success).  Events for scale/page/fault
+        actions are emitted by the registry actuators themselves (they
+        carry the measured facts); degrade/restore emit here."""
+        from ..obs import events as obs_events
+        reg = self.registry
+        with self._lock:
+            st = self._state.setdefault(action.model, {})
+        if action.kind in ("scale_up", "scale_down"):
+            reg.resize_model(action.model, action.params["replicas"],
+                             signal=action.signal)
+            st["last_scale_t"] = now
+        elif action.kind == "page_out":
+            reg.page_out(action.model, signal=action.signal)
+            st["last_page_t"] = now
+        elif action.kind == "fault_in":
+            reg.fault_in(action.model, trigger="controller",
+                         signal=action.signal)
+            st["last_page_t"] = now
+        elif action.kind == "degrade":
+            st["saved_ab"] = dict(action.params.get("saved_ab") or {})
+            reg.set_ab_weights(
+                action.model, {"int8": action.params["weight"]})
+            st["degraded"] = True
+            st["last_degrade_t"] = now
+            fields = dict(action.signal)
+            fields.update(model=action.model,
+                          weight=action.params["weight"])
+            obs_events.emit("fleet_degraded", **fields)
+        elif action.kind == "restore":
+            reg.set_ab_weights(action.model,
+                               dict(action.params.get("ab") or {}))
+            st["degraded"] = False
+            st["clean_streak"] = 0
+            st["last_degrade_t"] = now
+            fields = dict(action.signal)
+            fields.update(model=action.model,
+                          ab=dict(action.params.get("ab") or {}))
+            obs_events.emit("fleet_restored", **fields)
+        else:
+            return "unknown action kind %r" % action.kind
+        return None
+
+    def tick(self):
+        """One sense -> decide -> act pass.  Returns the list of
+        (action, error_or_None) pairs it processed (dry-run decisions
+        return error "dry_run")."""
+        from ..analysis import ResourceFitError
+        from ..obs import events as obs_events
+        now = time.monotonic()
+        processed = []
+        with self._lock:
+            self._ticks += 1
+            sensors = self._collect_sensors_locked(now)
+            self._last_sensors = sensors
+            # drop state for models that left entirely (unloaded, not
+            # paged) so a re-load starts fresh
+            for gone in [m for m in self._state if m not in sensors]:
+                self._state.pop(gone, None)
+            plan = []
+            for model, s in sensors.items():
+                policy = (self._policies.get(model)
+                          or self._policies.get("*"))
+                st = self._state.setdefault(model, {})
+                if s.slo_state in (_SLO_DEGRADED, _SLO_BREACH):
+                    st["clean_streak"] = 0
+                else:
+                    st["clean_streak"] = st.get("clean_streak", 0) + 1
+                plan.extend(
+                    (a, policy) for a in decide(s, policy,
+                                                dict(st), now))
+            dry = self.dry_run
+        # actuate OUTSIDE the lock: a resize is a full build+warm+flip
+        # and status()/export() reads must not serialize behind it
+        for action, _policy in plan:
+            if dry:
+                fields = dict(action.signal)
+                fields.update(model=action.model, action=action.kind,
+                              dry_run=True)
+                obs_events.emit("fleet_decision", **fields)
+                processed.append((action, "dry_run"))
+                continue
+            try:
+                err = self._execute(action, now)
+            except ResourceFitError as e:
+                # the fit check gated a grow: event it, stamp the
+                # cooldown so the controller does not hammer the gate
+                err = "fit_rejected: %s" % e
+                fields = dict(action.signal)
+                fields.update(model=action.model, error=str(e))
+                obs_events.emit("fleet_scale_rejected", **fields)
+                with self._lock:
+                    self._state.setdefault(action.model, {})[
+                        "last_scale_t"] = now
+            except Exception as e:  # one bad actuation never stops the loop
+                err = "%s: %s" % (type(e).__name__, e)
+            processed.append((action, err))
+        if processed:
+            with self._lock:
+                for action, err in processed:
+                    rec = action.to_dict()
+                    rec["age_s"] = 0.0
+                    rec["t_mono"] = now
+                    if err:
+                        rec["error"] = err
+                    self._actions.append(rec)
+        return processed
+
+    # -- thread lifecycle ----------------------------------------------
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="paddle-tpu-fleet-%s" % self.name)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:
+                # the control plane must never take down the serving
+                # process; a broken tick retries next interval
+                pass
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        self._thread = None
+
+    @property
+    def running(self):
+        t = self._thread
+        return bool(t is not None and t.is_alive())
+
+    # -- readouts ------------------------------------------------------
+
+    def _model_state(self, model, sensors):
+        st = self._state.get(model) or {}
+        if sensors is not None and sensors.paged:
+            return FLEET_PAGED
+        if st.get("degraded"):
+            return FLEET_DEGRADED
+        return FLEET_ACTIVE
+
+    def status(self):
+        """Wire-encodable controller readout (the `fleet` RPC payload
+        and serving_top's --json "fleet" key)."""
+        now = time.monotonic()
+        fault = dict(getattr(self.registry, "last_fault_in", {}) or {})
+        with self._lock:
+            models = {}
+            for model, s in sorted(self._last_sensors.items()):
+                st = self._state.get(model) or {}
+                info = {"state": self._model_state(model, s),
+                        "replicas": s.replicas,
+                        "paged": s.paged,
+                        "queue_depth": s.queue_depth,
+                        "idle_s": round(s.idle_s, 3),
+                        "degraded": bool(st.get("degraded"))}
+                if s.slo_state is not None:
+                    info["slo_state"] = s.slo_state
+                fi = fault.get(model)
+                if fi:
+                    info["fault_in_ms"] = fi.get("ms")
+                    info["fault_in_trigger"] = fi.get("trigger")
+                models[model] = info
+            actions = []
+            for rec in list(self._actions):
+                r = {k: v for k, v in rec.items() if k != "t_mono"}
+                r["age_s"] = round(max(now - rec["t_mono"], 0.0), 3)
+                actions.append(r)
+            return {"enabled": True, "dry_run": self.dry_run,
+                    "running": self.running,
+                    "interval_s": self.interval_s,
+                    "ticks": self._ticks,
+                    "policies": {k: p.to_dict() for k, p in
+                                 sorted(self._policies.items())},
+                    "models": models,
+                    "actions": actions}
+
+    def export(self):
+        """Prometheus samples for the registry render:
+        [(metric, labels, value, type)] — fleet_replicas, fleet_state
+        (0 active / 1 degraded / 2 paged), fault_in_ms (last measured
+        fault-in, absent until one happened)."""
+        fault = dict(getattr(self.registry, "last_fault_in", {}) or {})
+        with self._lock:
+            rows = []
+            for model, s in sorted(self._last_sensors.items()):
+                labels = {"model": model}
+                rows.append(("fleet_replicas", dict(labels),
+                             0 if s.paged else s.replicas, "gauge"))
+                rows.append(("fleet_state", dict(labels),
+                             _STATE_CODE[self._model_state(model, s)],
+                             "gauge"))
+                fi = fault.get(model)
+                if fi and fi.get("ms") is not None:
+                    rows.append(("fault_in_ms", dict(labels),
+                                 round(float(fi["ms"]), 3), "gauge"))
+            return rows

@@ -153,6 +153,12 @@ class ModelMetrics:
         self.accepted_tokens = Counter()  # proposals accepted by verify
         self.spec_degraded = Counter()   # lanes fallen back target-only
         self.accept_rate = ReservoirHistogram()  # per-round accept frac
+        # fleet paging (SERVING.md "Fleet controller"): how many times
+        # this model faulted back in from a paged-out spec, and how
+        # long each rebuild (reload + warm, all lanes) took — the
+        # cold-start tax the warm compile cache is supposed to shrink
+        self.fault_ins = Counter()
+        self.fault_in_ms = ReservoirHistogram()
         self._token_stamps = collections.deque()  # (t, n) recent window
         self.queue_depth_fn = None
         # installed by the batcher: live per-replica lane snapshot
@@ -225,6 +231,12 @@ class ModelMetrics:
             self.draft_tokens.add(int(proposed))
             self.accepted_tokens.add(int(accepted))
             self.accept_rate.record(accepted / proposed)
+
+    def note_fault_in(self, ms):
+        """One fault-in completed: the paged model is resident again
+        after `ms` of reload+warm across its lane set."""
+        self.fault_ins.add()
+        self.fault_in_ms.record(ms)
 
     def note_prefill(self, ttft_ms):
         """One prefill completed: the request's first token exists —
@@ -353,6 +365,11 @@ class ModelMetrics:
                 "compile_ms": self.compile_ms.value,
             },
         }
+        if self.fault_ins.value:
+            # fleet paging telemetry: count + rebuild-time summary
+            # (flat keys — serving_top/bench read them unchanged)
+            snap["fault_ins"] = self.fault_ins.value
+            snap["fault_in_ms"] = self.fault_in_ms.summary()
         if self.est_peak_mb is not None:
             # static resource estimate (set at load by the admission
             # fit check) — flat keys so Prometheus/serving_top pick

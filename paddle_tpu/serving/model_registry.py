@@ -35,6 +35,7 @@ bucketed).
 
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -252,6 +253,17 @@ class ModelRegistry:
         self._replicas = replicas  # default placement spec for loads
         self._lock = threading.Lock()
         self._models = {}  # name -> {"versions": {v: entry}, "latest": v}
+        # unload-to-spec (SERVING.md "Fleet controller"): every unload
+        # persists how to REBUILD the exact lane set (per-lane load
+        # specs + A/B weights); paged models additionally fault back in
+        # on the next request.  One per-name lock serializes fault-ins
+        # so a request burst rebuilds the model once.
+        self._unload_specs = {}   # name -> {"lanes": [...], "ab": {...}}
+        self._paged = {}          # name -> same record + "paged_at"
+        self._fault_locks = {}    # name -> threading.Lock
+        # last measured fault-in per model: {"ms", "trigger", "t_mono"}
+        # — the fleet controller's fault_in_ms gauge reads this
+        self.last_fault_in = {}
 
     # ------------------------------------------------------------------
 
@@ -420,6 +432,29 @@ class ModelRegistry:
                            replicas=preds, devices=placement,
                            precision=precision, resource=report,
                            draft_path=draft_path)
+        # unload-to-spec record (SERVING.md "Fleet controller"): the
+        # RESOLVED kwargs that rebuild exactly this lane — what
+        # unload_model persists, fault_in replays, and resize_model
+        # replays at a new placement.  Values are resolved (not the
+        # FLAGS-dependent None defaults) so a later flag change cannot
+        # silently rebuild a different lane.
+        entry.load_spec = {
+            "path": path,
+            "buckets": list(buckets) if buckets else None,
+            "precision": precision,
+            "draft": draft_path,
+            "spec_k": spec_depth,
+            "decode_slots": (batcher.n_slots
+                             if entry.is_decode else None),
+            "decode_mode": decode_mode,
+            "kv_cache_dtype": (str(getattr(preds[0], "kv_cache_dtype",
+                                           "float32"))
+                               if entry.is_decode else None),
+        }
+        if placement == [None]:
+            entry.load_spec["replicas"] = 1
+        else:
+            entry.load_spec["devices"] = entry.device_labels()
         if report is not None:
             lane_metrics.note_resource(report.peak_mb,
                                        report.total_flops)
@@ -461,6 +496,10 @@ class ModelRegistry:
             if ab_weight is not None:
                 slot.setdefault("ab", {})[precision] = float(ab_weight)
             flipped_from = old_lane
+            # the model is resident again: a load supersedes any
+            # paged/unloaded spec record
+            self._paged.pop(name, None)
+            self._unload_specs.pop(name, None)
         # the new batcher owns the live replica/queue-depth hooks from
         # here on; the displaced set still drains below
         obs_events.emit("hot_swap", model=name, version=version,
@@ -492,17 +531,170 @@ class ModelRegistry:
             slot["ab"] = clean
             slot["ab_credit"] = {}
 
-    def unload_model(self, name, drain_timeout=30.0):
-        """Remove `name` entirely: new requests fail immediately,
-        in-flight/queued ones drain first."""
+    def _retire(self, name, drain_timeout, page):
+        """Drop `name` from the routing table, persist its REBUILD
+        record {"lanes": [per-lane load specs in route order], "ab":
+        weights}, then drain the batchers.  The pop and the record
+        insert happen under ONE lock acquisition, so a request racing
+        a page-out always sees either the live entry or the paged
+        record — never a no_model gap.  The load-spec persistence is
+        the unload contract (SERVING.md "Fleet controller"): before
+        it, an unloaded model kept no record of how to rebuild its
+        lane set."""
         with self._lock:
             slot = self._models.pop(name, None)
-        if slot is None:
-            raise KeyError("no model %r" % name)
+            if slot is None:
+                raise KeyError("no model %r" % name)
+            record = {"lanes": [], "ab": dict(slot.get("ab") or {})}
+            lanes = slot.get("latest_prec") or {}
+            if not lanes and slot["latest"] is not None:
+                lanes = {"fp32": slot["latest"]}
+            # fp32 first (sorted), so the replay's default-routing
+            # shape matches the original load order
+            for prec, v in sorted(lanes.items()):
+                entry = slot["versions"].get(v)
+                spec = getattr(entry, "load_spec", None)
+                if spec:
+                    record["lanes"].append(dict(spec))
+            if page:
+                record["paged_at"] = time.monotonic()
+                self._paged[name] = record
+                self._unload_specs.pop(name, None)
+            else:
+                self._unload_specs[name] = record
+                self._paged.pop(name, None)
         for entry in slot["versions"].values():
             entry.batcher.close(drain=True, timeout=drain_timeout)
+        return record
+
+    def unload_model(self, name, drain_timeout=30.0):
+        """Remove `name`: new requests fail immediately, in-flight/
+        queued ones drain first.  The load spec of every precision
+        lane (artifact path, placement, precision, kv_cache_dtype,
+        draft/spec_k) plus the A/B weights are persisted, so
+        `fault_in` can reconstruct the exact lane set later — but an
+        unloaded model does NOT fault in on traffic (that is
+        `page_out`'s contract)."""
+        record = self._retire(name, drain_timeout, page=False)
         self.metrics.drop(name)
-        obs_events.emit("model_unloaded", model=name)
+        obs_events.emit("model_unloaded", model=name,
+                        lanes=len(record["lanes"]))
+
+    def page_out(self, name, drain_timeout=30.0, signal=None):
+        """Page `name` out to its artifact path(s): the replica sets
+        drain and free their device memory, the rebuild record is kept
+        PAGED, and the next request (or the fleet controller, on
+        rising burn) faults the exact lane set back in.  Metrics lanes
+        survive paging — counters must not reset across a page/fault
+        cycle."""
+        record = self._retire(name, drain_timeout, page=True)
+        # the triggering signal rides the event; the emitter's own
+        # fields win on key collisions (e.g. the signal's 'model')
+        fields = dict(signal or {})
+        fields.update(model=name, lanes=len(record["lanes"]))
+        obs_events.emit("fleet_paged_out", **fields)
+
+    def paged_models(self):
+        """{name: {"age_s", "lanes"}} for every currently-paged
+        model."""
+        now = time.monotonic()
+        with self._lock:
+            return {n: {"age_s": round(now - r.get("paged_at", now), 3),
+                        "lanes": len(r["lanes"])}
+                    for n, r in self._paged.items()}
+
+    def fault_in(self, name, trigger="request", signal=None):
+        """Rebuild a paged/unloaded model from its persisted load
+        specs: every precision lane replays through load_model (fit
+        check, build, warm, flip — the COMPILE_CACHE.md store makes
+        this a reload, not a recompile) and the A/B weights are
+        restored, so the reconstructed lane set answers bit-exactly
+        like the original.  Idempotent and burst-safe: one per-name
+        lock serializes concurrent fault-ins, later arrivals find the
+        model live and return immediately.  The measured wall time
+        lands in `last_fault_in` (the fleet fault_in_ms gauge) and on
+        the model's metrics lane."""
+        with self._lock:
+            if name in self._models:
+                return self._entry_locked(name, None)
+            lock = self._fault_locks.setdefault(name, threading.Lock())
+        with lock:
+            with self._lock:
+                if name in self._models:  # a concurrent fault-in won
+                    return self._entry_locked(name, None)
+                rec = self._paged.get(name)
+                if rec is None and str(trigger) != "request":
+                    # traffic only resurrects PAGED models; an
+                    # operator unload stays unloaded until an explicit
+                    # fault_in/load — but its spec is still here
+                    rec = self._unload_specs.get(name)
+            if rec is None or not rec["lanes"]:
+                raise KeyError(
+                    "no model %r (and no persisted load spec to fault "
+                    "in)" % name)
+            t0 = time.monotonic()
+            entry = None
+            for lane_spec in rec["lanes"]:
+                kw = dict(lane_spec)
+                entry = self.load_model(name, kw.pop("path"), **kw)
+            if rec.get("ab"):
+                self.set_ab_weights(name, rec["ab"])
+            ms = (time.monotonic() - t0) * 1e3
+            with self._lock:
+                self._paged.pop(name, None)
+                self._unload_specs.pop(name, None)
+            self.last_fault_in[name] = {"ms": round(ms, 3),
+                                        "trigger": str(trigger),
+                                        "t_mono": time.monotonic()}
+            first_prec = rec["lanes"][0].get("precision") or "fp32"
+            self.metrics.model(name, first_prec).note_fault_in(ms)
+            fields = dict(signal or {})
+            fields.update(model=name, trigger=str(trigger),
+                          fault_in_ms=round(ms, 3),
+                          lanes=len(rec["lanes"]))
+            obs_events.emit("fleet_fault_in", **fields)
+            return entry
+
+    def resize_model(self, name, replicas, precision=None, signal=None):
+        """Scale one model's replica set to `replicas` by replaying
+        its persisted load spec at the new placement through
+        load_model — so every resize rides the build-warm-flip
+        hot-swap discipline (zero-drop by construction) and the
+        ANALYSIS.md fit check gates every grow BEFORE any build work.
+        Returns the new entry (the current one when already at size)."""
+        n = int(replicas)
+        if n < 1:
+            raise ValueError("replica count must be >= 1, got %d" % n)
+        with self._lock:
+            slot = self._models.get(name)
+            if slot is None:
+                raise KeyError("no model %r" % name)
+            lanes = slot.get("latest_prec") or {}
+            prec = str(precision) if precision is not None else (
+                "fp32" if "fp32" in lanes
+                else (sorted(lanes)[0] if lanes else None))
+            v = lanes.get(prec, slot["latest"])
+            entry = slot["versions"].get(v)
+        spec = getattr(entry, "load_spec", None) if entry is not None \
+            else None
+        if not spec:
+            raise KeyError("model %r has no rebuildable load spec"
+                           % name)
+        old_n = len(entry.replicas)
+        if n == old_n:
+            return entry
+        kw = dict(spec)
+        path = kw.pop("path")
+        kw.pop("devices", None)
+        kw["replicas"] = n
+        new_entry = self.load_model(name, path, **kw)
+        fields = dict(signal or {})
+        fields.update(model=name, precision=new_entry.precision,
+                      from_replicas=old_n, to_replicas=n)
+        obs_events.emit(
+            "fleet_scale_up" if n > old_n else "fleet_scale_down",
+            **fields)
+        return new_entry
 
     def model_names(self):
         with self._lock:
@@ -556,6 +748,18 @@ class ModelRegistry:
                 else:
                     info["buckets"] = []
                 out[name] = info
+            now = time.monotonic()
+            for name, rec in self._paged.items():
+                if name in out:
+                    continue
+                # paged models stay visible (SERVING.md "Fleet
+                # controller"): resident nowhere, but one request away
+                out[name] = {
+                    "paged": True,
+                    "paged_age_s": round(
+                        now - rec.get("paged_at", now), 3),
+                    "lanes": [s.get("precision", "fp32")
+                              for s in rec["lanes"]]}
             return out
 
     def health(self):
@@ -658,6 +862,34 @@ class ModelRegistry:
             return lanes["fp32"]
         return slot["latest"]
 
+    def _fault_pending(self, name):
+        """True when `name` can be (or is being) faulted in by
+        traffic: it is paged, or another thread's fault-in of it is in
+        flight right now (the submit that lost the race must WAIT on
+        the fault lock, not bounce with no_model)."""
+        with self._lock:
+            if name in self._paged:
+                return True
+            lock = self._fault_locks.get(name)
+        return lock is not None and lock.locked()
+
+    def _submit_entry(self, entry, name, feeds, deadline, priority,
+                      trace_id, max_new_tokens, chunk_tokens):
+        if entry.is_decode:
+            if not isinstance(feeds, dict) or "tokens" not in feeds:
+                raise ValueError(
+                    "decode model %r takes feeds {'tokens': "
+                    "int array}, got %s"
+                    % (name, sorted(feeds) if isinstance(feeds, dict)
+                       else type(feeds).__name__))
+            return entry.batcher.submit(
+                feeds["tokens"], max_new_tokens=max_new_tokens,
+                deadline=deadline, priority=priority,
+                trace_id=trace_id, chunk_tokens=chunk_tokens)
+        return entry.batcher.submit(feeds, deadline=deadline,
+                                    priority=priority,
+                                    trace_id=trace_id)
+
     def submit(self, name, feeds, version=None, deadline=None,
                priority=0, trace_id=None, max_new_tokens=None,
                chunk_tokens=None, precision=None):
@@ -670,27 +902,33 @@ class ModelRegistry:
         `precision` pins the request to one numerics lane ('fp32' /
         'int8'); None routes by the A/B weights (see load_model).
 
+        A PAGED model (SERVING.md "Fleet controller") faults back in
+        here: the first request pays the reload (warm compile cache —
+        a deserialize, not a recompile), concurrent arrivals wait on
+        the same per-name fault lock, and the rebuilt lane set answers
+        every one of them.
+
         On a DECODE entry, `feeds` must carry the prompt as "tokens";
         the returned DecodeStream duck-types the batcher Future
         (`result()` -> [generated int32 tokens]), so one-shot `infer`
         callers work unchanged — streaming callers use submit_stream."""
+        try:
+            with self._lock:
+                entry = self._entry_locked(name, version,
+                                           precision=precision)
+                return self._submit_entry(entry, name, feeds, deadline,
+                                          priority, trace_id,
+                                          max_new_tokens, chunk_tokens)
+        except KeyError:
+            if not self._fault_pending(name):
+                raise
+        self.fault_in(name, trigger="request")
         with self._lock:
             entry = self._entry_locked(name, version,
                                        precision=precision)
-            if entry.is_decode:
-                if not isinstance(feeds, dict) or "tokens" not in feeds:
-                    raise ValueError(
-                        "decode model %r takes feeds {'tokens': "
-                        "int array}, got %s"
-                        % (name, sorted(feeds) if isinstance(feeds, dict)
-                           else type(feeds).__name__))
-                return entry.batcher.submit(
-                    feeds["tokens"], max_new_tokens=max_new_tokens,
-                    deadline=deadline, priority=priority,
-                    trace_id=trace_id, chunk_tokens=chunk_tokens)
-            return entry.batcher.submit(feeds, deadline=deadline,
-                                        priority=priority,
-                                        trace_id=trace_id)
+            return self._submit_entry(entry, name, feeds, deadline,
+                                      priority, trace_id,
+                                      max_new_tokens, chunk_tokens)
 
     def submit_stream(self, name, tokens, version=None,
                       max_new_tokens=None, deadline=None, priority=0,
@@ -698,17 +936,35 @@ class ModelRegistry:
         """Streaming generation entry point: returns the DecodeStream
         whose token chunks the server's `infer_stream` verb flushes to
         the wire as they decode.  Same single-lock resolution contract
-        as submit()."""
+        (and paged-model fault-in) as submit()."""
+        try:
+            with self._lock:
+                entry = self._entry_locked(name, version)
+                return self._stream_entry(entry, name, tokens,
+                                          max_new_tokens, deadline,
+                                          priority, trace_id,
+                                          chunk_tokens)
+        except KeyError:
+            if not self._fault_pending(name):
+                raise
+        self.fault_in(name, trigger="request")
         with self._lock:
             entry = self._entry_locked(name, version)
-            if not entry.is_decode:
-                raise ValueError(
-                    "model %r is not a decode model — infer_stream "
-                    "serves autoregressive artifacts only" % name)
-            return entry.batcher.submit(
-                tokens, max_new_tokens=max_new_tokens,
-                deadline=deadline, priority=priority,
-                trace_id=trace_id, chunk_tokens=chunk_tokens)
+            return self._stream_entry(entry, name, tokens,
+                                      max_new_tokens, deadline,
+                                      priority, trace_id, chunk_tokens)
+
+    @staticmethod
+    def _stream_entry(entry, name, tokens, max_new_tokens, deadline,
+                      priority, trace_id, chunk_tokens):
+        if not entry.is_decode:
+            raise ValueError(
+                "model %r is not a decode model — infer_stream "
+                "serves autoregressive artifacts only" % name)
+        return entry.batcher.submit(
+            tokens, max_new_tokens=max_new_tokens,
+            deadline=deadline, priority=priority,
+            trace_id=trace_id, chunk_tokens=chunk_tokens)
 
     def infer(self, name, feeds, version=None, deadline=None,
               timeout=None, priority=0, precision=None):

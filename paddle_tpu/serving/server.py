@@ -21,6 +21,11 @@ pickle ever touches a socket), carrying four commands:
                  age (OBSERVABILITY.md "SLOs & burn rates")
   flight        {"cmd","reason"?,"force"?} -> trigger a flight-recorder
                  post-mortem bundle; reply carries the committed path
+  fleet         {"cmd","set_policy"?,"dry_run"?} -> fleet-controller
+                 status (per-model state/replicas/paged, recent
+                 actions, policies); set_policy maps model -> policy
+                 body, dry_run flips rehearsal mode (SERVING.md
+                 "Fleet controller")
   shutdown      graceful drain, then the server stops accepting
 
 Admission control is the batcher's bounded queue: a request past
@@ -109,6 +114,12 @@ class InferenceServer:
         if FLAGS.slo_monitor:
             from ..obs import slo as obs_slo
             self.slo = obs_slo.SLOMonitor.from_flags(self.metrics)
+        # the control plane above the judgment layer (SERVING.md
+        # "Fleet controller"): acts on the SLO/queue/occupancy/shed
+        # signals through the registry's actuators — replica-set
+        # scaling, cold-model paging, pressure degradation.
+        # FLAGS.fleet_controller=false (default) keeps it off.
+        self.fleet = None
         self._flight_provider = None
         # `replicas`: default placement spec for every model this server
         # loads (int N / 'auto' / explicit device list — SERVING.md
@@ -185,6 +196,13 @@ class InferenceServer:
             self.slo.name = self.endpoint
             self.slo.start()
             self._obs_registry.attach_slo(self.slo)
+        if FLAGS.fleet_controller:
+            from .fleet import FleetController
+            self.fleet = FleetController.from_flags(
+                self.registry, self.metrics, slo=self.slo,
+                name=self.endpoint)
+            self.fleet.start()
+            self._obs_registry.attach_fleet(self.fleet)
         # flight-recorder provider: every post-mortem bundle carries
         # this server's stats + registry/lane liveness + SLO timeline
         # (no-op while FLAGS.flight_dir is unset)
@@ -215,6 +233,11 @@ class InferenceServer:
         """Graceful stop: refuse new work, drain every queued request,
         then stop accepting connections."""
         self._draining = True
+        if self.fleet is not None:
+            # stop acting BEFORE the drain: the controller must not
+            # resize/page models the shutdown is retiring
+            self.fleet.stop()
+            self._obs_registry.detach_fleet(self.fleet)
         self.registry.close_all(drain=drain, timeout=timeout)
         self._stopped = True
         if self.slo is not None:
@@ -246,6 +269,10 @@ class InferenceServer:
             h["slo"] = self.slo.state()
             h["slo_monitor"] = {"running": self.slo.running,
                                 "interval_s": self.slo.interval_s}
+        if self.fleet is not None:
+            # controller readout rides health too, so one poll (and
+            # every flight bundle's server snapshot) carries it
+            h["fleet"] = self.fleet.status()
         from ..obs import flightrec
         rec = flightrec.get_recorder()
         if rec is not None:
@@ -272,6 +299,23 @@ class InferenceServer:
                     "models": self.registry.describe()}
         if cmd == "health":
             return {"ok": True, "health": self._health_snapshot()}
+        if cmd == "fleet":
+            # controller readout + policy/dry-run administration
+            # (SERVING.md "Fleet controller"); reading works with the
+            # controller disabled, administering it does not
+            if msg.get("set_policy") or msg.get("dry_run") is not None:
+                if self.fleet is None:
+                    raise ValueError(
+                        "fleet controller disabled — start the server "
+                        "with FLAGS.fleet_controller=true")
+                for model, spec in dict(
+                        msg.get("set_policy") or {}).items():
+                    self.fleet.set_policy(str(model), str(spec))
+                if msg.get("dry_run") is not None:
+                    self.fleet.dry_run = bool(msg["dry_run"])
+            return {"ok": True,
+                    "fleet": (self.fleet.status() if self.fleet
+                              is not None else {"enabled": False})}
         if cmd == "flight":
             # manual post-mortem: dump a bundle NOW (cooldown bypassed
             # unless the caller asks otherwise); None = recorder
@@ -302,6 +346,12 @@ class InferenceServer:
         if cmd == "load_model":
             if self._draining:
                 raise BatcherClosed("server is draining")
+            if msg.get("fleet_policy") and self.fleet is None:
+                # typed rejection BEFORE any build work: a policy that
+                # nothing will enforce is an operator error
+                raise ValueError(
+                    "load_model carried fleet_policy but the fleet "
+                    "controller is disabled (FLAGS.fleet_controller)")
             entry = self.registry.load_model(
                 msg["name"], msg["path"], version=msg.get("version"),
                 buckets=msg.get("buckets") or self._default_buckets,
@@ -314,6 +364,9 @@ class InferenceServer:
                 draft=msg.get("draft"),
                 spec_k=msg.get("spec_k"),
                 kv_cache_dtype=msg.get("kv_cache_dtype"))
+            if msg.get("fleet_policy"):
+                self.fleet.set_policy(entry.name,
+                                      str(msg["fleet_policy"]))
             reply = {"ok": True, "name": entry.name,
                      "version": entry.version,
                      "buckets": list(entry.predictor.batch_buckets()),
@@ -675,8 +728,13 @@ class ServingClient:
     def load_model(self, name, path, version=None, buckets=None,
                    replicas=None, devices=None, decode_slots=None,
                    decode_mode=None, precision=None, ab_weight=None,
-                   draft=None, spec_k=None, kv_cache_dtype=None):
+                   draft=None, spec_k=None, kv_cache_dtype=None,
+                   fleet_policy=None):
         msg = {"cmd": "load_model", "name": name, "path": path}
+        if fleet_policy is not None:
+            # per-model fleet policy body riding the load (SERVING.md
+            # "Fleet controller"), e.g. 'max_replicas=4,page_ttl_s=600'
+            msg["fleet_policy"] = str(fleet_policy)
         if kv_cache_dtype is not None:
             # decode artifacts: slot-table cache numerics for this
             # load — 'fp32'/'float32' or 'int8' (QUANTIZE.md)
@@ -721,6 +779,25 @@ class ServingClient:
         payload): {"draining", "models": {...}, "slo": {...},
         "flight": {...}} — see SERVING.md."""
         return self._call({"cmd": "health"})["health"]
+
+    def fleet(self, set_policy=None, dry_run=None):
+        """Fleet-controller readout/administration (the `fleet` verb):
+        returns the controller status dict ({"enabled": False} when
+        the server runs without one).  `set_policy` maps model name ->
+        policy body ('min_replicas=1,max_replicas=4,page_ttl_s=600');
+        `dry_run` flips rehearsal mode.  Both require the controller
+        to be enabled server-side."""
+        msg = {"cmd": "fleet"}
+        if set_policy:
+            msg["set_policy"] = {str(k): str(v)
+                                 for k, v in dict(set_policy).items()}
+        if dry_run is not None:
+            msg["dry_run"] = bool(dry_run)
+        return self._call(msg)["fleet"]
+
+    def set_fleet_policy(self, model, spec):
+        """Declare one model's fleet policy body on the server."""
+        return self.fleet(set_policy={model: spec})
 
     def flight(self, reason="manual_rpc", force=True):
         """Trigger a flight-recorder bundle on the server; returns the

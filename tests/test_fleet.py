@@ -1,0 +1,612 @@
+"""Fleet-controller tests (paddle_tpu/serving/fleet.py — SERVING.md
+"Fleet controller").
+
+The policy core is pinned as a PURE function: seeded ModelSensors
+snapshots + controller state -> expected FleetAction lists (scale up
+on breach and on queue pressure, scale down on idle, page on TTL,
+degrade-BEFORE-shed ordering, restore hysteresis, cooldown
+suppression) — no server, no threads, no sleeps.  The actuator layer
+is pinned on a live registry: unload persists the load spec and
+fault_in reconstructs the exact lane set bit-exactly (the PR's bugfix
+satellite), a paged model faults in on the next request with the
+rebuild time measured, resize rides the hot-swap discipline and the
+resource fit check gates every grow, and dry_run decides without
+acting.  The wire surfaces (fleet RPC, set_fleet_policy, serving_top
+REPL/FLEET columns + --json "fleet" key, Prometheus fleet_* families)
+are pinned through one in-process server.  Everything CPU-safe under
+JAX_PLATFORMS=cpu.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.flags import FLAGS, set_flags
+from paddle_tpu.obs import events as obs_events
+from paddle_tpu.obs import tracing as obs_tracing
+from paddle_tpu.serving import (InferenceServer, ModelRegistry,
+                                ServingClient, ServingError,
+                                ServingMetrics)
+from paddle_tpu.serving.fleet import (FLEET_ACTIVE, FLEET_PAGED,
+                                      FleetController, FleetPolicy,
+                                      ModelSensors, decide,
+                                      parse_fleet_spec)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import serving_top  # noqa: E402
+
+_DEFAULTS = {"serving_slo": "", "slo_monitor": True,
+             "slo_eval_interval_ms": 1000.0,
+             "fleet_controller": False,
+             "fleet_eval_interval_ms": 1000.0,
+             "fleet_policy": "", "fleet_dry_run": False,
+             "serving_device_mem_mb": 0}
+
+
+@pytest.fixture(autouse=True)
+def _fleet_reset():
+    set_flags(dict(_DEFAULTS))
+    obs_events.configure()
+    obs_tracing.configure()
+    yield
+    set_flags(dict(_DEFAULTS))
+    obs_events.configure()
+
+
+def _save_fc(tag, seed=5):
+    """Tiny fc artifact; distinct seeds give distinct weights."""
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = os.path.join(tempfile.mkdtemp(prefix="fleet_t_"), tag)
+        fluid.save_inference_model(md, ["x"], [pred], exe,
+                                   main_program=main_p)
+    return md
+
+
+@pytest.fixture(scope="module")
+def fc_dir():
+    return _save_fc("m", seed=5)
+
+
+@pytest.fixture(scope="module")
+def fc_big_dir():
+    """~1.5 MiB of weights — big enough that a 1 MiB device budget
+    (the serving_device_mem_mb floor) rejects it in the fit check."""
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data(name="x", shape=[256], dtype="float32")
+        h = fluid.layers.fc(input=x, size=512, act="relu")
+        h = fluid.layers.fc(input=h, size=512, act="relu")
+        pred = fluid.layers.fc(input=h, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = os.path.join(tempfile.mkdtemp(prefix="fleet_big_"), "big")
+        fluid.save_inference_model(md, ["x"], [pred], exe,
+                                   main_program=main_p)
+    return md
+
+
+X = np.linspace(-1, 1, 8, dtype=np.float32).reshape(1, 8)
+
+
+# ---------------------------------------------------------------------------
+# policy spec grammar
+# ---------------------------------------------------------------------------
+
+class TestPolicySpec:
+    def test_parse_default_and_per_model(self):
+        out = parse_fleet_spec(
+            "max_replicas=4;llm:page_ttl_s=600,scale_up_queue=8")
+        assert out["*"].max_replicas == 4
+        assert out["*"].page_ttl_s == 0.0
+        assert out["llm"].page_ttl_s == 600.0
+        assert out["llm"].scale_up_queue == 8
+        assert out["llm"].max_replicas == 1  # per-model, not inherited
+
+    def test_bad_key_raises(self):
+        with pytest.raises(ValueError, match="bad fleet policy"):
+            parse_fleet_spec("llm:replica_count=4")
+
+    def test_bounds(self):
+        p = FleetPolicy(min_replicas=3, max_replicas=1,
+                        degrade_weight=7.0)
+        assert p.max_replicas >= p.min_replicas == 3
+        assert p.degrade_weight == 1.0
+
+
+# ---------------------------------------------------------------------------
+# the pure decision core: seeded sensors -> expected actions
+# ---------------------------------------------------------------------------
+
+_POL = dict(min_replicas=1, max_replicas=3, page_ttl_s=5.0,
+            scale_up_queue=4, scale_down_idle_s=2.0,
+            degrade_weight=0.9, restore_evals=3)
+
+
+class TestDecide:
+    def test_scale_up_on_breach(self):
+        acts = decide(ModelSensors("m", replicas=1, slo_state="breach"),
+                      FleetPolicy(**_POL), {}, 100.0)
+        assert [a.kind for a in acts] == ["scale_up"]
+        assert acts[0].params["replicas"] == 2
+        assert acts[0].signal["trigger"] == "slo"
+
+    def test_scale_up_on_queue_pressure(self):
+        # queue >= scale_up_queue * replicas trips without any SLO
+        acts = decide(ModelSensors("m", replicas=2, queue_depth=8),
+                      FleetPolicy(**_POL), {}, 100.0)
+        assert [a.kind for a in acts] == ["scale_up"]
+        assert acts[0].params["replicas"] == 3
+        assert acts[0].signal["trigger"] == "queue"
+        # one short of the threshold: no action
+        assert decide(ModelSensors("m", replicas=2, queue_depth=7),
+                      FleetPolicy(**_POL), {}, 100.0) == []
+
+    def test_scale_up_respects_max(self):
+        acts = decide(ModelSensors("m", replicas=3, slo_state="breach"),
+                      FleetPolicy(**_POL), {}, 100.0)
+        assert acts == []
+
+    def test_scale_down_on_idle(self):
+        acts = decide(ModelSensors("m", replicas=2, idle_s=3.0),
+                      FleetPolicy(**_POL), {}, 100.0)
+        assert [a.kind for a in acts] == ["scale_down"]
+        assert acts[0].params["replicas"] == 1
+        # min_replicas floors the shrink
+        assert decide(ModelSensors("m", replicas=1, idle_s=3.0),
+                      FleetPolicy(**dict(_POL, page_ttl_s=0.0)),
+                      {}, 100.0) == []
+
+    def test_page_on_ttl_supersedes_scale_down(self):
+        acts = decide(ModelSensors("m", replicas=2, idle_s=6.0),
+                      FleetPolicy(**_POL), {}, 100.0)
+        assert [a.kind for a in acts] == ["page_out"]
+        assert acts[0].signal["trigger"] == "idle_ttl"
+
+    def test_page_ttl_zero_never_pages(self):
+        pol = FleetPolicy(**dict(_POL, page_ttl_s=0.0,
+                                 scale_down_idle_s=0.5))
+        acts = decide(ModelSensors("m", replicas=1, idle_s=1e6),
+                      pol, {}, 100.0)
+        assert acts == []
+
+    def test_degrade_before_shed_ordering(self):
+        """Under breach with a quantized peer, the FIRST action is the
+        ab-weight shift toward int8 — the cheap capacity engages
+        before a new replica set is built (and before admission would
+        shed)."""
+        acts = decide(ModelSensors("m", replicas=1, slo_state="breach",
+                                   has_int8_peer=True),
+                      FleetPolicy(**_POL), {}, 100.0)
+        assert [a.kind for a in acts] == ["degrade", "scale_up"]
+        assert acts[0].params["weight"] == 0.9
+        assert acts[0].signal["trigger"] == "sustained_burn"
+
+    def test_no_degrade_without_int8_peer(self):
+        acts = decide(ModelSensors("m", replicas=1, slo_state="breach"),
+                      FleetPolicy(**_POL), {}, 100.0)
+        assert [a.kind for a in acts] == ["scale_up"]
+
+    def test_restore_needs_clean_streak(self):
+        pol = FleetPolicy(**_POL)
+        st = {"degraded": True, "saved_ab": {"int8": 0.1}}
+        # still burning: anything but a restore (the scale-up half of
+        # the response is free to proceed)
+        kinds = [a.kind for a in
+                 decide(ModelSensors("m", slo_state="breach",
+                                     has_int8_peer=True,
+                                     ab={"int8": 0.9}),
+                        pol, dict(st, clean_streak=0), 100.0)]
+        assert "restore" not in kinds
+        # clean but under the hysteresis streak: no restore
+        assert decide(ModelSensors("m", slo_state="ok",
+                                   has_int8_peer=True,
+                                   ab={"int8": 0.9}),
+                      pol, dict(st, clean_streak=2), 100.0) == []
+        acts = decide(ModelSensors("m", slo_state="ok",
+                                   has_int8_peer=True,
+                                   ab={"int8": 0.9}),
+                      pol, dict(st, clean_streak=3), 100.0)
+        assert [a.kind for a in acts] == ["restore"]
+        assert acts[0].params["ab"] == {"int8": 0.1}
+
+    def test_cooldown_suppression(self):
+        pol = FleetPolicy(**dict(_POL, scale_cooldown_s=15.0,
+                                 page_cooldown_s=30.0))
+        s_up = ModelSensors("m", replicas=1, slo_state="breach")
+        assert decide(s_up, pol, {"last_scale_t": 90.0}, 100.0) == []
+        assert [a.kind for a in decide(s_up, pol,
+                                       {"last_scale_t": 80.0},
+                                       100.0)] == ["scale_up"]
+        s_page = ModelSensors("m", replicas=1, idle_s=6.0)
+        assert decide(s_page, pol, {"last_page_t": 80.0}, 100.0) == []
+
+    def test_paged_model_faults_in_on_demand_only(self):
+        pol = FleetPolicy(**_POL)
+        idle = ModelSensors("m", paged=True)
+        assert decide(idle, pol, {}, 100.0) == []
+        for kw in ({"requests_delta": 2}, {"shed_delta": 1},
+                   {"slo_state": "breach"}):
+            acts = decide(ModelSensors("m", paged=True, **kw),
+                          pol, {}, 100.0)
+            assert [a.kind for a in acts] == ["fault_in"], kw
+
+    def test_no_policy_no_actions(self):
+        assert decide(ModelSensors("m", slo_state="breach"),
+                      None, {}, 100.0) == []
+
+
+# ---------------------------------------------------------------------------
+# unload-to-spec + fault-in (the bugfix satellite): round trip bit-exact
+# ---------------------------------------------------------------------------
+
+class TestUnloadFaultInRoundTrip:
+    def test_unload_persists_spec_and_fault_in_rebuilds_lanes(self,
+                                                             fc_dir):
+        reg = ModelRegistry(metrics=ServingMetrics())
+        try:
+            reg.load_model("m", fc_dir, buckets=[2])
+            reg.load_model("m", fc_dir, buckets=[2], precision="int8",
+                           ab_weight=0.25)
+            ref_fp = reg.infer("m", {"x": X}, precision="fp32")
+            ref_i8 = reg.infer("m", {"x": X}, precision="int8")
+            d0 = reg.describe()["m"]
+            reg.unload_model("m")
+            # unloaded = gone: traffic must NOT resurrect it
+            with pytest.raises(KeyError):
+                reg.infer("m", {"x": X})
+            assert "m" not in reg.paged_models()
+            # ... but the spec survived: fault_in rebuilds the EXACT
+            # lane set — precisions, buckets, ab split — bit-exactly
+            reg.fault_in("m", trigger="manual")
+            d1 = reg.describe()["m"]
+            assert d1["precisions"].keys() == d0["precisions"].keys()
+            assert d1["ab_weights"] == d0["ab_weights"] == {
+                "int8": 0.25}
+            assert d1["buckets"] == d0["buckets"]
+            out_fp = reg.infer("m", {"x": X}, precision="fp32")
+            out_i8 = reg.infer("m", {"x": X}, precision="int8")
+            assert np.array_equal(out_fp[0], ref_fp[0])
+            assert np.array_equal(out_i8[0], ref_i8[0])
+        finally:
+            reg.close_all(drain=False)
+
+    def test_paged_model_faults_in_on_request(self, fc_dir):
+        reg = ModelRegistry(metrics=ServingMetrics())
+        try:
+            reg.load_model("m", fc_dir, buckets=[2])
+            ref = reg.infer("m", {"x": X})
+            reqs_before = reg.metrics.model("m").requests.value
+            reg.page_out("m")
+            assert reg.paged_models()["m"]["lanes"] == 1
+            assert reg.describe()["m"]["paged"]
+            # the next request faults the model back in transparently
+            out = reg.infer("m", {"x": X})
+            assert np.array_equal(out[0], ref[0])
+            assert "m" not in reg.paged_models()
+            fi = reg.last_fault_in["m"]
+            assert fi["trigger"] == "request" and fi["ms"] > 0
+            mm = reg.metrics.model("m")
+            # metrics lane SURVIVED the page (counters never reset)
+            # and carries the fault-in telemetry
+            assert mm.requests.value > reqs_before
+            assert mm.fault_ins.value == 1
+            assert mm.snapshot()["fault_in_ms"]["count"] == 1
+            ev = obs_events.recent_events(kind="fleet_fault_in")
+            assert ev and ev[-1]["model"] == "m"
+            assert ev[-1]["fault_in_ms"] == fi["ms"]
+            assert obs_events.recent_events(kind="fleet_paged_out")
+        finally:
+            reg.close_all(drain=False)
+
+    def test_decode_spec_round_trip(self):
+        """A decode artifact's spec (slots, kv dtype) survives the
+        page/fault cycle — greedy streams bit-exact."""
+        from paddle_tpu.inference.decode import build_tiny_decode_model
+        md = os.path.join(tempfile.mkdtemp(prefix="fleet_dec_"), "d")
+        build_tiny_decode_model(md, seed=7)
+        reg = ModelRegistry(metrics=ServingMetrics())
+        try:
+            reg.load_model("d", md, decode_slots=2)
+            prompt = [3, 1, 4]
+            ref = reg.infer("d", {"tokens": prompt})
+            reg.page_out("d")
+            out = reg.infer("d", {"tokens": prompt})
+            assert np.array_equal(out[0], ref[0])
+            d = reg.describe()["d"]
+            assert d["decode"] and d["decode_slots"] == 2
+        finally:
+            reg.close_all(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# resize: hot-swap discipline + the fit gate on growth
+# ---------------------------------------------------------------------------
+
+class TestResize:
+    def test_resize_up_down_bit_exact(self, fc_dir):
+        reg = ModelRegistry(metrics=ServingMetrics())
+        try:
+            reg.load_model("m", fc_dir, buckets=[2])
+            ref = reg.infer("m", {"x": X})
+            e2 = reg.resize_model("m", 2)
+            assert len(e2.replicas) == 2
+            assert np.array_equal(reg.infer("m", {"x": X})[0], ref[0])
+            ups = obs_events.recent_events(kind="fleet_scale_up")
+            assert ups[-1]["from_replicas"] == 1
+            assert ups[-1]["to_replicas"] == 2
+            e1 = reg.resize_model("m", 1)
+            assert len(e1.replicas) == 1
+            assert np.array_equal(reg.infer("m", {"x": X})[0], ref[0])
+            assert obs_events.recent_events(kind="fleet_scale_down")
+            # no-op resize returns the live entry untouched
+            assert reg.resize_model("m", 1) is e1
+        finally:
+            reg.close_all(drain=False)
+
+    def test_fit_check_gates_growth(self, fc_big_dir):
+        from paddle_tpu.analysis import ResourceFitError
+        reg = ModelRegistry(metrics=ServingMetrics())
+        xb = np.zeros((1, 256), np.float32)
+        try:
+            reg.load_model("m", fc_big_dir, buckets=[2])
+            ref = reg.infer("m", {"x": xb})
+            # a 1 MiB budget cannot hold the ~1.5 MiB replica set: the
+            # grow must be REJECTED before any build work, with the
+            # live single-replica set untouched
+            set_flags({"serving_device_mem_mb": 1})
+            with pytest.raises(ResourceFitError):
+                reg.resize_model("m", 2)
+            set_flags({"serving_device_mem_mb": 0})
+            assert len(reg._entry_locked("m", None).replicas) == 1
+            assert np.array_equal(reg.infer("m", {"x": xb})[0], ref[0])
+        finally:
+            set_flags({"serving_device_mem_mb": 0})
+            reg.close_all(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# the live controller: tick-driven actuation, hysteresis, dry-run
+# ---------------------------------------------------------------------------
+
+class _FakeSLO:
+    """Stands in for SLOMonitor: state() returns whatever the test
+    scripts — the controller only reads state/burn."""
+
+    def __init__(self):
+        self.states = {}
+
+    def state(self):
+        return {k: {"state": v, "monitored": True,
+                    "burn": {"p95_ms": {"fast": 12.0, "slow": None}}}
+                for k, v in self.states.items()}
+
+
+def _mk_controller(reg, slo=None, **policy):
+    ctl = FleetController(reg, reg.metrics, slo=slo, interval_s=999.0)
+    if policy:
+        ctl.set_policy("m", **policy)
+    return ctl
+
+
+class TestControllerLive:
+    def test_queue_pressure_scales_up_with_cooldown(self, fc_dir):
+        from paddle_tpu.serving import set_dispatch_delay
+        reg = ModelRegistry(metrics=ServingMetrics(), max_queue=64)
+        ctl = _mk_controller(reg, max_replicas=2, scale_up_queue=2,
+                             scale_cooldown_s=3600.0)
+        try:
+            reg.load_model("m", fc_dir, buckets=[1])
+            set_dispatch_delay(0.2)
+            futs = [reg.submit("m", {"x": X}) for _ in range(6)]
+            out = ctl.tick()
+            assert [a.kind for a, err in out] == ["scale_up"]
+            assert out[0][1] is None, out
+            assert len(reg._entry_locked("m", None).replicas) == 2
+            # signal rides the event: which sensor pulled the trigger
+            ev = obs_events.recent_events(kind="fleet_scale_up")[-1]
+            assert ev["trigger"] == "queue"
+            assert ev["queue_depth"] >= 2
+            # the cooldown rate-limits: an immediate second tick under
+            # the same pressure decides NOTHING
+            assert ctl.tick() == []
+            set_dispatch_delay(0.0)
+            for f in futs:
+                f.result(timeout=30)
+        finally:
+            set_dispatch_delay(0.0)
+            reg.close_all(drain=False)
+
+    def test_degrade_then_restore_with_hysteresis(self, fc_dir):
+        reg = ModelRegistry(metrics=ServingMetrics())
+        slo = _FakeSLO()
+        ctl = _mk_controller(reg, slo=slo, max_replicas=1,
+                             degrade_weight=0.8, restore_evals=2,
+                             degrade_cooldown_s=0.0)
+        try:
+            reg.load_model("m", fc_dir, buckets=[2])
+            reg.load_model("m", fc_dir, buckets=[2], precision="int8")
+            slo.states["m"] = "breach"
+            out = ctl.tick()
+            kinds = [a.kind for a, _ in out]
+            assert kinds == ["degrade"], out
+            assert reg.describe()["m"]["ab_weights"] == {"int8": 0.8}
+            assert obs_events.recent_events(kind="fleet_degraded")
+            # recovery: the weight must NOT flap back on the first
+            # clean tick (restore_evals=2 hysteresis)
+            slo.states["m"] = "ok"
+            assert ctl.tick() == []
+            out = ctl.tick()
+            assert [a.kind for a, _ in out] == ["restore"]
+            assert not reg.describe()["m"].get("ab_weights")
+            assert obs_events.recent_events(kind="fleet_restored")
+        finally:
+            reg.close_all(drain=False)
+
+    def test_dry_run_decides_without_acting(self, fc_dir):
+        reg = ModelRegistry(metrics=ServingMetrics())
+        ctl = _mk_controller(reg, page_ttl_s=0.01, page_cooldown_s=0.0)
+        ctl.dry_run = True
+        try:
+            reg.load_model("m", fc_dir, buckets=[2])
+            ctl.tick()
+            time.sleep(0.05)  # idle past the TTL
+            out = ctl.tick()
+            assert out and all(err == "dry_run" for _, err in out)
+            # decisions are EVENTED ...
+            ev = obs_events.recent_events(kind="fleet_decision")
+            assert ev and ev[-1]["action"] == "page_out"
+            assert ev[-1]["dry_run"] is True
+            # ... but NOTHING acted: still resident, not paged
+            assert not reg.paged_models()
+            assert not reg.describe()["m"].get("paged")
+            assert not obs_events.recent_events(kind="fleet_paged_out")
+            # flipping dry_run off: the same decision now actuates
+            ctl.dry_run = False
+            out = ctl.tick()
+            assert [a.kind for a, err in out] == ["page_out"]
+            assert reg.paged_models()
+        finally:
+            reg.close_all(drain=False)
+
+    def test_fit_rejected_grow_events_and_cools_down(self, fc_big_dir):
+        reg = ModelRegistry(metrics=ServingMetrics(), max_queue=64)
+        slo = _FakeSLO()
+        ctl = _mk_controller(reg, slo=slo, max_replicas=4,
+                             scale_cooldown_s=3600.0)
+        try:
+            reg.load_model("m", fc_big_dir, buckets=[2])
+            slo.states["m"] = "breach"
+            set_flags({"serving_device_mem_mb": 1})
+            out = ctl.tick()
+            assert len(out) == 1 and "fit_rejected" in out[0][1]
+            ev = obs_events.recent_events(kind="fleet_scale_rejected")
+            assert ev and ev[-1]["model"] == "m"
+            # registry untouched, cooldown stamped (no hammering)
+            assert len(reg._entry_locked("m", None).replicas) == 1
+            set_flags({"serving_device_mem_mb": 0})
+            assert ctl.tick() == []
+        finally:
+            set_flags({"serving_device_mem_mb": 0})
+            reg.close_all(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# wire + tools: fleet RPC, policy fields, serving_top, Prometheus
+# ---------------------------------------------------------------------------
+
+class TestWireAndTools:
+    def test_fleet_rpc_policy_and_surfaces(self, fc_dir):
+        set_flags({"fleet_controller": True,
+                   "fleet_eval_interval_ms": 50.0})
+        server = InferenceServer(max_queue=32).start()
+        cli = ServingClient(server.endpoint)
+        try:
+            cli.load_model("m", fc_dir, buckets=[2],
+                           fleet_policy="max_replicas=2,page_ttl_s=600")
+            cli.infer("m", {"x": X}, deadline_ms=10000)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                st = cli.fleet()
+                if st["models"].get("m"):
+                    break
+                time.sleep(0.05)
+            assert st["enabled"] and st["running"]
+            assert st["policies"]["m"]["max_replicas"] == 2
+            m = st["models"]["m"]
+            assert m["state"] == FLEET_ACTIVE
+            assert m["replicas"] == 1 and m["paged"] is False
+            # set_fleet_policy updates the declared envelope
+            cli.set_fleet_policy("m", "min_replicas=1,max_replicas=3")
+            assert cli.fleet()["policies"]["m"]["max_replicas"] == 3
+            # dry-run flips over the wire
+            assert cli.fleet(dry_run=True)["dry_run"] is True
+            assert cli.fleet(dry_run=False)["dry_run"] is False
+            # health carries the controller readout too
+            assert cli.health()["fleet"]["enabled"]
+            # Prometheus families (obs/registry.py render)
+            text = cli.metrics_text()
+            assert 'paddle_tpu_fleet_replicas{model="m"} 1' in text
+            assert 'paddle_tpu_fleet_state{model="m"} 0' in text
+            # serving_top: REPL/FLEET columns + the --json fleet key
+            reply = cli.stats()
+            out = serving_top.render(reply, health=cli.health(),
+                                     fleet=cli.fleet())
+            hdr = out.splitlines()[2]
+            assert "REPL" in hdr and "FLEET" in hdr
+            row = [l for l in out.splitlines()
+                   if l.startswith("m ")][0]
+            assert " act" in row
+            # page it server-side: the row flips to PAGED, 0 replicas
+            server.registry.page_out("m")
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                fst = cli.fleet()  # next controller tick sees the page
+                if (fst["models"].get("m") or {}).get("paged"):
+                    break
+                time.sleep(0.05)
+            assert fst["models"]["m"]["state"] == FLEET_PAGED
+            out = serving_top.render(cli.stats(), health=cli.health(),
+                                     fleet=fst)
+            row = [l for l in out.splitlines()
+                   if l.startswith("m ")][0]
+            assert "PAGED" in row
+            text = cli.metrics_text()
+            assert 'paddle_tpu_fleet_replicas{model="m"} 0' in text
+            assert 'paddle_tpu_fleet_state{model="m"} 2' in text
+        finally:
+            cli.close()
+            server.shutdown(drain=False, timeout=5.0)
+
+    def test_serving_top_json_fleet_key(self, fc_dir, capsys):
+        set_flags({"fleet_controller": True,
+                   "fleet_eval_interval_ms": 50.0})
+        server = InferenceServer(max_queue=32).start()
+        try:
+            boot = ServingClient(server.endpoint)
+            boot.load_model("m", fc_dir, buckets=[2])
+            boot.close()
+            assert serving_top.main([server.endpoint, "--json"]) == 0
+            blob = json.loads(capsys.readouterr().out)
+            # sibling keys: pinned stats schema untouched
+            assert "stats" in blob and "health" in blob
+            assert blob["fleet"]["enabled"] is True
+            assert "policies" in blob["fleet"]
+        finally:
+            server.shutdown(drain=False, timeout=5.0)
+
+    def test_fleet_policy_rejected_without_controller(self, fc_dir):
+        server = InferenceServer(max_queue=32).start()  # fleet off
+        cli = ServingClient(server.endpoint)
+        try:
+            st = cli.fleet()
+            assert st == {"enabled": False}
+            with pytest.raises(ServingError, match="disabled"):
+                cli.load_model("m", fc_dir, buckets=[2],
+                               fleet_policy="max_replicas=2")
+            with pytest.raises(ServingError, match="disabled"):
+                cli.set_fleet_policy("m", "max_replicas=2")
+            # the typed rejection left nothing half-loaded
+            assert "m" not in server.registry.model_names()
+        finally:
+            cli.close()
+            server.shutdown(drain=False, timeout=5.0)

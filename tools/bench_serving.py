@@ -572,6 +572,170 @@ def run_decode_lane(args, backend_label):
                 server.shutdown(drain=True)
 
 
+def _fleet_drive(endpoint, model, feed_name, shape, dtype, qps,
+                 duration, deadline_ms):
+    """Open-loop burst on one model: fire `qps*duration` requests on
+    schedule, account every one exactly once.  Returns ok/dropped
+    counts, latency percentiles, and the FIRST request's reply latency
+    (the fault-in TTFR when the model was paged)."""
+    from paddle_tpu.serving import (DeadlineExceeded, ServerOverloaded,
+                                    ServingClient, ServingError)
+    k = max(int(round(qps * duration)), 1)
+    x = np.zeros((1,) + shape, dtype=dtype)
+    results = [None] * k
+    threads = []
+
+    def fire(i):
+        cli = ServingClient(endpoint)
+        time.sleep(i / qps)
+        t0 = time.monotonic()
+        try:
+            cli.infer(model, {feed_name: x}, deadline_ms=deadline_ms)
+            results[i] = ("ok", (time.monotonic() - t0) * 1e3)
+        except (ServerOverloaded, DeadlineExceeded, ServingError,
+                ConnectionError, OSError, EOFError) as e:
+            results[i] = ("fail", type(e).__name__)
+        finally:
+            cli.close()
+
+    for i in range(k):
+        t = threading.Thread(target=fire, args=(i,), daemon=True)
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    oks = [r[1] for r in results if r and r[0] == "ok"]
+    lat = sorted(oks)
+
+    def pct(q):
+        if not lat:
+            return None
+        return round(lat[min(int(q / 100.0 * (len(lat) - 1)),
+                             len(lat) - 1)], 1)
+
+    return {"sent": k, "ok": len(oks), "dropped": k - len(oks),
+            "p50_ms": pct(50), "p95_ms": pct(95),
+            "ttfr_ms": round(oks[0], 1) if oks else None}
+
+
+def run_fleet_lane(args, backend_label):
+    """The fleet-controller A/B (SERVING.md "Fleet controller"): the
+    SAME shifting-traffic schedule — warm two models, idle the cold
+    one past its page TTL, then flash-crowd it — once with the
+    controller on (pages out, faults in, scales within [1,3]) and once
+    with the static placement.  Per phase the record carries achieved
+    ok/dropped/p95 per model, plus the fault-in time-to-first-reply
+    and the server-measured fault_in_ms for the controller run
+    (BENCH_r15.json)."""
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.obs import events as obs_events
+    from paddle_tpu.serving import (InferenceServer, ServingClient,
+                                    set_dispatch_delay)
+    workdir = tempfile.mkdtemp(prefix="bench_fleet_")
+    hot_dir, feed_name, shape, dtype = build_model(
+        "fc", os.path.join(workdir, "hot"), seed=17)
+    cold_dir, _, _, _ = build_model(
+        "fc", os.path.join(workdir, "cold"), seed=29)
+    step_ms = args.dispatch_cost_ms or 50.0
+    lane_qps = 1000.0 / step_ms          # one replica's capacity
+    flash_qps = 3.0 * lane_qps           # past one lane, at three
+    flash_s = args.duration if args.duration is not None \
+        else (1.0 if args.smoke else 2.0)
+    warm_s = min(flash_s, 2.0)
+    page_ttl_s = 0.6 if args.smoke else 1.0
+    deadline_ms = args.deadline_ms or 2500.0
+    modes = {"on": (True,), "off": (False,),
+             "both": (True, False)}[args.fleet]
+
+    for fleet_on in modes:
+        set_flags({
+            "fleet_controller": bool(fleet_on),
+            "fleet_eval_interval_ms": 100.0,
+            "slo_monitor": True,
+            "slo_eval_interval_ms": 100.0,
+            "serving_slo": (("cold:p95_ms=%d,budget=0.2,fast_window=3,"
+                             "slow_window=10,fast_burn=5,"
+                             "breach_evals=2,recover_evals=2"
+                             % int(4 * step_ms)) if fleet_on else ""),
+        })
+        server = InferenceServer(max_queue=args.max_queue or 24,
+                                 buckets=[1]).start()
+        cli = ServingClient(server.endpoint)
+        rec = {"metric": "serving_fleet",
+               "fleet": "on" if fleet_on else "off",
+               "step_cost_ms": step_ms, "flash_qps": flash_qps,
+               "deadline_ms": deadline_ms, "phases": {}}
+        try:
+            cli.load_model("hot", hot_dir, buckets=[1])
+            cli.load_model(
+                "cold", cold_dir, buckets=[1],
+                fleet_policy=("min_replicas=1,max_replicas=3,"
+                              "page_ttl_s=%g,page_cooldown_s=0.5,"
+                              "scale_up_queue=3,scale_cooldown_s=0.4,"
+                              "scale_down_idle_s=60" % page_ttl_s)
+                if fleet_on else None)
+            ref = cli.infer("cold",
+                            {feed_name: np.zeros((1,) + shape,
+                                                 dtype=dtype)},
+                            deadline_ms=10000)
+            set_dispatch_delay(step_ms / 1000.0)
+            # phase 1 — diurnal warm: both models lightly loaded
+            rec["phases"]["warm"] = {
+                "hot": _fleet_drive(server.endpoint, "hot", feed_name,
+                                    shape, dtype, 0.3 * lane_qps,
+                                    warm_s, deadline_ms),
+                "cold": _fleet_drive(server.endpoint, "cold",
+                                     feed_name, shape, dtype,
+                                     0.2 * lane_qps, warm_s,
+                                     deadline_ms)}
+            # phase 2 — idle: hot-only traffic; with the controller on
+            # the cold model pages out past its TTL
+            t0 = time.monotonic()
+            idle = _fleet_drive(server.endpoint, "hot", feed_name,
+                                shape, dtype, 0.3 * lane_qps,
+                                page_ttl_s + 1.0, deadline_ms)
+            while fleet_on and time.monotonic() - t0 < 8.0 \
+                    and not server.registry.paged_models():
+                time.sleep(0.05)
+            idle["cold_paged"] = bool(server.registry.paged_models())
+            rec["phases"]["idle"] = {"hot": idle}
+            # phase 3 — flash crowd on the (possibly paged) cold model
+            flash = _fleet_drive(server.endpoint, "cold", feed_name,
+                                 shape, dtype, flash_qps, flash_s,
+                                 deadline_ms)
+            rec["phases"]["flash"] = {"cold": flash}
+            rec["flash_ttfr_ms"] = flash.get("ttfr_ms")
+            rec["dropped"] = flash["dropped"]
+            stats = cli.stats()["stats"]["models"]
+            rec["shed_total"] = sum(
+                (m.get("shed") or 0) for m in stats.values())
+            if fleet_on:
+                fi = server.registry.last_fault_in.get("cold") or {}
+                rec["fault_in_ms"] = fi.get("ms")
+                rec["scale_ups"] = len(
+                    obs_events.recent_events(kind="fleet_scale_up"))
+                rec["paged_out"] = bool(
+                    obs_events.recent_events(kind="fleet_paged_out"))
+                fleet_status = cli.fleet()
+                rec["fleet_models"] = sorted(fleet_status["models"])
+            # replies stay bit-exact through page/fault/scale
+            set_dispatch_delay(0.0)
+            out = cli.infer("cold",
+                            {feed_name: np.zeros((1,) + shape,
+                                                 dtype=dtype)},
+                            deadline_ms=10000)
+            rec["bit_exact"] = bool(np.array_equal(out[0], ref[0]))
+        finally:
+            set_dispatch_delay(0.0)
+            try:
+                cli.close()
+            finally:
+                server.shutdown(drain=False, timeout=5.0)
+        if backend_label:
+            rec["backend"] = backend_label
+        print(json.dumps(rec), flush=True)
+
+
 def _parse_replica_sweep(spec):
     """'1,4' -> sweep of counts; 'auto' / '4' / 'cpu:0,cpu:1' -> one
     placement spec point (a comma list containing ':' is a device list,
@@ -871,6 +1035,16 @@ def main():
                          "monitor does real evaluation work — the "
                          "monitor-overhead A/B pair (<3%% delta "
                          "acceptance, BENCH_r13.json)")
+    ap.add_argument("--fleet", choices=["on", "off", "both"],
+                    default=None,
+                    help="fleet-controller A/B (SERVING.md \"Fleet "
+                         "controller\"): run the shifting-traffic "
+                         "schedule — warm two models, idle the cold "
+                         "one past its page TTL, flash-crowd it — "
+                         "with the controller on and/or off; records "
+                         "carry per-phase ok/dropped/p95, fault-in "
+                         "TTFR + server-measured fault_in_ms, and "
+                         "scale-up counts (BENCH_r15.json)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fc model, short sweep (CI path)")
     ap.add_argument("--require_tpu", action="store_true")
@@ -919,6 +1093,9 @@ def main():
         else:
             set_flags({"slo_monitor": False, "serving_slo": ""})
 
+    if args.fleet:
+        run_fleet_lane(args, backend_label)
+        return
     if args.decode:
         if args.deadline_ms is None:
             args.deadline_ms = 60000.0

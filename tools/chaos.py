@@ -1465,6 +1465,249 @@ def scenario_slo_breach(workdir, verbose=True, kill_phase=True):
     return {"breach_s": breach_at, "budget_s": detect_budget}
 
 
+def scenario_flash_crowd(verbose=True):
+    """The fleet controller, end to end (SERVING.md "Fleet
+    controller"): diurnal two-model traffic, then a flash crowd on the
+    COLD model — a pattern a static single-replica placement provably
+    sheds on, which the controller must hold the SLO across.
+
+    1. two models serve (hot + cold, distinct weights); the cold model
+       declares an SLO + a fleet policy ([1,3] replicas, ~1s page
+       TTL); reference replies are captured for the bit-exactness
+       check;
+    2. diurnal phase: traffic stays on the hot model — the idle cold
+       model must PAGE OUT (fleet_paged_out event, load spec
+       persisted, hot traffic untouched);
+    3. flash crowd: an open-loop burst on the cold model at ~3x one
+       lane's capacity.  The first request FAULTS the model back in
+       (fleet_fault_in event, measured fault_in_ms, warm compile
+       cache), queue pressure + the SLO breach drive scale-up within
+       the [min,max] policy, and EVERY request must be answered
+       exactly once, bit-identical to the pre-page captures — zero
+       dropped, zero double-answered;
+    4. the breach must RECOVER (slo_recovered) once the crowd drains —
+       breach-without-recovery fails the scenario;
+    5. the STATIC control: the same burst against the same serving
+       shape without the controller (one pinned replica, no paging)
+       must drop requests — proving the traffic pattern actually
+       exceeds a static placement, so the hold in (3) is the
+       controller's doing."""
+    import tempfile
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.flags import set_flags, get_flags
+    from paddle_tpu.obs import events as obs_events
+    from paddle_tpu.serving import (DeadlineExceeded, InferenceServer,
+                                    ServerOverloaded, ServingClient,
+                                    ServingError, set_dispatch_delay)
+
+    def build(seed, tag):
+        main_p, startup = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main_p, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=4, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            md = os.path.join(tempfile.mkdtemp(prefix="chaos_fleet_"),
+                              tag)
+            fluid.save_inference_model(md, ["x"], [pred], exe,
+                                       main_program=main_p)
+        return md
+
+    md_hot, md_cold = build(5, "hot"), build(11, "cold")
+    x_req = np.linspace(-1, 1, 8, dtype=np.float32).reshape(1, 8)
+    STEP_S = 0.1          # injected per-dispatch cost: 10 rps per lane
+    FLASH_K = 60          # burst size
+    FLASH_QPS = 30.0      # ~3x one lane, <= the 3-replica policy cap
+    DEADLINE_MS = 2500.0
+
+    def open_loop(endpoint, model, k, qps, deadline_ms):
+        """Fire k requests on an open-loop schedule; every request is
+        accounted exactly once: (ok latencies in fire order, failures).
+        Clients retry sheds under their deadline — a DROP is a request
+        that never got an answer."""
+        results = [None] * k
+        threads = []
+
+        def fire(i):
+            cli = ServingClient(endpoint)
+            delay = i / qps
+            time.sleep(delay)
+            t0 = time.monotonic()
+            try:
+                out = cli.infer(model, {"x": x_req},
+                                deadline_ms=deadline_ms)
+                results[i] = ("ok", (time.monotonic() - t0) * 1e3,
+                              out[0])
+            except (ServerOverloaded, DeadlineExceeded, ServingError,
+                    ConnectionError, OSError, EOFError) as e:
+                results[i] = ("fail", type(e).__name__, None)
+            finally:
+                cli.close()
+
+        for i in range(k):
+            t = threading.Thread(target=fire, args=(i,), daemon=True)
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), \
+            "flash requests HUNG"
+        assert all(r is not None for r in results), "lost accounting"
+        return results
+
+    saved = get_flags(["serving_slo", "slo_eval_interval_ms",
+                       "slo_monitor", "fleet_controller",
+                       "fleet_eval_interval_ms", "fleet_policy",
+                       "fleet_dry_run", "flight_dir"])
+    set_flags({
+        "slo_monitor": True,
+        "slo_eval_interval_ms": 100.0,
+        # p95 far under the queue wait a backlog builds; budget 0.2
+        # makes a fully-bad fast window burn at 5x (= fast_burn)
+        "serving_slo": ("cold:p95_ms=200,budget=0.2,fast_window=3,"
+                        "slow_window=10,fast_burn=5,breach_evals=2,"
+                        "recover_evals=2"),
+        "fleet_controller": True,
+        "fleet_eval_interval_ms": 100.0,
+        "fleet_dry_run": False,
+        "flight_dir": "",
+    })
+
+    # ---- the controller run -------------------------------------------
+    server = InferenceServer(max_queue=24).start()
+    cli = ServingClient(server.endpoint)
+    flash = None
+    try:
+        cli.load_model("hot", md_hot, buckets=[1])
+        cli.load_model(
+            "cold", md_cold, buckets=[1],
+            fleet_policy=("min_replicas=1,max_replicas=3,"
+                          "page_ttl_s=1.0,page_cooldown_s=0.5,"
+                          "scale_up_queue=3,scale_cooldown_s=0.4,"
+                          "scale_down_idle_s=60"))
+        ref_hot = cli.infer("hot", {"x": x_req}, deadline_ms=10000)
+        ref_cold = cli.infer("cold", {"x": x_req}, deadline_ms=10000)
+        assert not np.array_equal(ref_hot[0], ref_cold[0]), \
+            "hot/cold fixtures degenerate (same weights)"
+        set_dispatch_delay(STEP_S)
+
+        # phase 2: diurnal — hot-only traffic; the idle cold model
+        # must page out within its TTL (+ a couple of ticks of slack)
+        t0 = time.monotonic()
+        paged = False
+        while time.monotonic() - t0 < 8.0:
+            cli.infer("hot", {"x": x_req}, deadline_ms=10000)
+            if server.registry.paged_models().get("cold"):
+                paged = True
+                break
+            time.sleep(0.05)
+        assert paged, "idle cold model never paged out"
+        assert obs_events.recent_events(kind="fleet_paged_out"), \
+            "page-out not evented"
+        desc = server.registry.describe().get("cold") or {}
+        assert desc.get("paged") and desc.get("lanes") == ["fp32"], \
+            "paged record lost the lane set: %r" % (desc,)
+        # hot is untouched by the page
+        out = cli.infer("hot", {"x": x_req}, deadline_ms=10000)
+        assert np.array_equal(out[0], ref_hot[0])
+
+        # phase 3: flash crowd on the paged cold model
+        results = open_loop(server.endpoint, "cold", FLASH_K,
+                            FLASH_QPS, DEADLINE_MS)
+        oks = [r for r in results if r[0] == "ok"]
+        fails = [r for r in results if r[0] == "fail"]
+        assert not fails, \
+            "controller run DROPPED %d/%d requests: %s" \
+            % (len(fails), FLASH_K,
+               sorted(set(f[1] for f in fails)))
+        assert len(oks) == FLASH_K, "request accounting broke"
+        for r in oks:  # answered once, bit-exact vs pre-page captures
+            assert np.array_equal(r[2], ref_cold[0]), \
+                "flash reply diverged from the pre-page reference"
+        flash = {"ttfr_ms": round(oks[0][1], 1),
+                 "p95_ms": round(sorted(r[1] for r in oks)[
+                     int(0.95 * (len(oks) - 1))], 1)}
+        fi = obs_events.recent_events(kind="fleet_fault_in")
+        assert fi, "flash crowd never faulted the cold model in"
+        assert fi[-1].get("fault_in_ms") is not None
+        flash["fault_in_ms"] = fi[-1]["fault_in_ms"]
+        ups = obs_events.recent_events(kind="fleet_scale_up")
+        assert ups, "controller never scaled the cold model up"
+        assert all(u.get("to_replicas", 0) <= 3 for u in ups), \
+            "scale-up escaped the max_replicas policy"
+        breaches = obs_events.recent_events(kind="slo_breach")
+        assert any(b.get("model") == "cold" for b in breaches), \
+            "flash crowd never breached the declared SLO"
+
+        # phase 4: recovery — light traffic until the state machine
+        # returns to ok; breach-without-recovery is the failure mode
+        set_dispatch_delay(0.0)
+        t0 = time.monotonic()
+        recovered = False
+        while time.monotonic() - t0 < 12.0:
+            cli.infer("cold", {"x": x_req}, deadline_ms=10000)
+            if any(e.get("model") == "cold" for e in
+                   obs_events.recent_events(kind="slo_recovered")):
+                recovered = True
+                break
+            time.sleep(0.1)
+        assert recovered, "SLO breached and never recovered"
+        out = cli.infer("cold", {"x": x_req}, deadline_ms=10000)
+        assert np.array_equal(out[0], ref_cold[0]), \
+            "post-recovery reply bits diverged"
+        fleet_status = cli.fleet()
+        assert fleet_status.get("enabled") and fleet_status["models"]
+    finally:
+        set_dispatch_delay(0.0)
+        try:
+            cli.close()
+        finally:
+            server.shutdown(drain=False, timeout=5.0)
+
+    # ---- the static control -------------------------------------------
+    # same serving shape, no controller: one pinned replica, no paging.
+    # The same burst must DROP requests — the pattern really does
+    # exceed a static placement.
+    set_flags({"fleet_controller": False, "serving_slo": ""})
+    server2 = InferenceServer(max_queue=24).start()
+    cli2 = ServingClient(server2.endpoint)
+    try:
+        cli2.load_model("cold", md_cold, buckets=[1])
+        cli2.infer("cold", {"x": x_req}, deadline_ms=10000)  # warm
+        set_dispatch_delay(STEP_S)
+        results = open_loop(server2.endpoint, "cold", FLASH_K,
+                            FLASH_QPS, DEADLINE_MS)
+        static_fails = [r for r in results if r[0] == "fail"]
+        assert static_fails, \
+            "static placement survived the flash crowd — the scenario " \
+            "no longer proves anything; raise the burst"
+    finally:
+        set_dispatch_delay(0.0)
+        try:
+            cli2.close()
+        finally:
+            server2.shutdown(drain=False, timeout=5.0)
+            set_flags(saved)
+
+    if verbose:
+        print("PASS flash-crowd: paged out on TTL, fault-in %.0fms, "
+              "flash %d/%d answered bit-exact (TTFR %.0fms, p95 "
+              "%.0fms), breach -> recovered, scale-up within [1,3]; "
+              "static control dropped %d/%d"
+              % (flash["fault_in_ms"], FLASH_K, FLASH_K,
+                 flash["ttfr_ms"], flash["p95_ms"],
+                 len(static_fails), FLASH_K))
+    return {"fault_in_ms": flash["fault_in_ms"],
+            "flash_ttfr_ms": flash["ttfr_ms"],
+            "flash_p95_ms": flash["p95_ms"],
+            "static_dropped": len(static_fails),
+            "flash_k": FLASH_K}
+
+
 def run_smoke(workdir):
     """Tier-1 smoke: deterministic crash at every commit point + the
     bit-flip rejection — no timing races, CPU-only, a few seconds."""
@@ -1497,7 +1740,8 @@ def main(argv=None):
                                            "decode-disconnect",
                                            "decode-disconnect-int8",
                                            "spec-fallback",
-                                           "slo-breach", "all"])
+                                           "slo-breach",
+                                           "flash-crowd", "all"])
     ap.add_argument("--smoke", action="store_true",
                     help="fast deterministic subset for CI")
     ap.add_argument("--workdir", default=None)
@@ -1544,7 +1788,7 @@ def main(argv=None):
                      "serving-overload", "cache-commit",
                      "quantize-commit", "trace-overflow",
                      "decode-disconnect", "decode-disconnect-int8",
-                     "spec-fallback", "slo-breach"]
+                     "spec-fallback", "slo-breach", "flash-crowd"]
     else:
         scenarios = [args.scenario]
     rc = 0
@@ -1588,6 +1832,8 @@ def main(argv=None):
                 scenario_spec_fallback()
             elif s == "slo-breach":
                 scenario_slo_breach(os.path.join(workdir, "slo_breach"))
+            elif s == "flash-crowd":
+                scenario_flash_crowd()
         except AssertionError as e:
             rc = 1
             print("FAIL %s: %s" % (s, e))

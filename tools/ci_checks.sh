@@ -28,6 +28,13 @@
 #                        on the shared tiled-contraction core
 #                        (ROOFLINE.md "Kernel substrate",
 #                        tests/test_kernel_substrate.py)
+#     16  fleet          fleet-controller flash-crowd scenario: diurnal
+#                        two-model traffic then a burst on the paged
+#                        cold model — page-out on TTL, measured
+#                        fault-in, SLO breach -> scale-up -> recovery,
+#                        zero dropped requests, where the static
+#                        control provably sheds (SERVING.md "Fleet
+#                        controller")
 #      1  usage          unknown gate name
 #      0  all requested gates clean
 #
@@ -43,7 +50,7 @@ SPEC="${API_SPEC:-API.spec}"
 
 gates=("$@")
 if [ ${#gates[@]} -eq 0 ]; then
-    gates=(lint_runtime lint_program apispec specdec slo kernels)
+    gates=(lint_runtime lint_program apispec specdec slo kernels fleet)
 fi
 
 for gate in "${gates[@]}"; do
@@ -93,10 +100,14 @@ for gate in "${gates[@]}"; do
             "$PY" -m pytest tests/test_kernel_substrate.py -q \
                 -k "smoke" -p no:cacheprovider || exit 15
             ;;
+        fleet)
+            echo "== ci_checks: fleet gate =="
+            "$PY" tools/chaos.py --scenario flash-crowd || exit 16
+            ;;
         *)
             echo "ci_checks: unknown gate '$gate'" \
                  "(have: lint_runtime lint_program apispec specdec" \
-                 "slo kernels)"
+                 "slo kernels fleet)"
             exit 1
             ;;
     esac

@@ -39,6 +39,7 @@ MODULES = [
     "paddle_tpu.reader",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.serving.fleet",
     "paddle_tpu.obs",
     "paddle_tpu.obs.tracing",
     "paddle_tpu.obs.events",

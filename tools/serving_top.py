@@ -10,8 +10,12 @@ replicas.  The SLO column shows the burn-rate state machine's verdict
 (ok / degr / BREACH — OBSERVABILITY.md "SLOs & burn rates") with one
 sub-row per burning objective, and LIVE shows alive/total lane worker
 threads ('!' marks a dead router or lane — the wedge indicator), both
-from the `health` RPC verb.  `--json` dumps the raw snapshot (plus a
-sibling "health" key) for scripts.
+from the `health` RPC verb.  REPL is the live replica count and FLEET
+the fleet controller's per-model verdict (act / degr / PAGED, '-'
+without a controller — SERVING.md "Fleet controller"), from the
+`fleet` RPC verb; paged models keep their row (zero replicas, one
+request from residency).  `--json` dumps the raw snapshot (plus
+sibling "health" and "fleet" keys) for scripts.
 
 Usage: python tools/serving_top.py HOST:PORT [--json]
 """
@@ -67,17 +71,41 @@ def _health_cols(name, health):
     return slo_col, live_col
 
 
-def render(reply, health=None):
+def _fleet_cols(name, desc, fleet):
+    """(REPL, FLEET) for one metrics lane key: live replica count (0
+    when paged) and the controller's per-model state — act / degr /
+    PAGED, '-' when the server runs without a controller."""
+    plain = name.split("@", 1)[0]
+    d = desc.get(plain) or {}
+    repl = 0 if d.get("paged") else d.get("replicas")
+    fleet_col = "-"
+    if fleet and fleet.get("enabled"):
+        info = (fleet.get("models") or {}).get(plain)
+        if info:
+            fleet_col = {"active": "act", "degraded": "degr",
+                         "paged": "PAGED"}.get(info.get("state"),
+                                               info.get("state"))
+        elif d.get("paged"):
+            fleet_col = "PAGED"
+        if fleet.get("dry_run") and fleet_col != "-":
+            fleet_col += "?"
+    elif d.get("paged"):
+        fleet_col = "PAGED"
+    return _fmt(repl), fleet_col
+
+
+def render(reply, health=None, fleet=None):
     stats = reply.get("stats", {})
     models = stats.get("models", {})
     desc = reply.get("models", {})
     lines = ["server uptime %.0fs, %d model(s)"
              % (stats.get("uptime_sec", 0.0), len(models)), ""]
     hdr = ("%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
-           "%7s %7s %5s %5s %7s %6s"
+           "%7s %7s %5s %5s %7s %6s %5s %6s"
            % ("MODEL", "PREC", "VER", "QPS", "REQS", "p50ms", "p95ms",
               "p99ms", "FILL", "BKT%", "QUEUE", "SHED", "CCH/M",
-              "TTFT95", "TPS", "OCC%", "ACC%", "SLO", "LIVE"))
+              "TTFT95", "TPS", "OCC%", "ACC%", "SLO", "LIVE", "REPL",
+              "FLEET"))
     lines.append(hdr)
     lines.append("-" * len(hdr))
     described = set()
@@ -105,9 +133,10 @@ def render(reply, health=None):
         occ = m.get("slot_occupancy")
         acc = m.get("spec_accept_rate")
         slo_col, live_col = _health_cols(name, health)
+        repl_col, fleet_col = _fleet_cols(name, desc, fleet)
         lines.append(
             "%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
-            "%7s %7s %5s %5s %7s %6s"
+            "%7s %7s %5s %5s %7s %6s %5s %6s"
             % (plain[:14], prec[:5], _fmt(ver),
                _fmt(m.get("qps_recent")), _fmt(m.get("requests")),
                _fmt(lat.get("p50")), _fmt(lat.get("p95")),
@@ -119,7 +148,7 @@ def render(reply, health=None):
                     and occ >= 0 else None),
                _fmt(round(100.0 * acc, 1)
                     if isinstance(acc, float) else None),
-               slo_col, live_col))
+               slo_col, live_col, repl_col, fleet_col))
         st = (health or {}).get("slo", {}).get(name)
         if st and st.get("monitored") and st.get("burn"):
             # one sub-row per burning objective: which SLI is eating
@@ -130,6 +159,15 @@ def render(reply, health=None):
                         "    slo %-12s fast=%-8s slow=%-8s"
                         % (objective, _fmt(b.get("fast"), "x"),
                            _fmt(b.get("slow"), "x")))
+        fm = ((fleet or {}).get("models") or {}).get(plain)
+        if fm and fm.get("fault_in_ms") is not None \
+                and plain not in described:
+            # last fault-in: what the page/fault cycle cost (reload +
+            # warm across the lane set, warm compile cache)
+            lines.append("    fleet fault_in=%sms (%s) idle=%ss"
+                         % (_fmt(fm["fault_in_ms"]),
+                            fm.get("fault_in_trigger", "?"),
+                            _fmt(fm.get("idle_s"))))
         if d.get("buckets") and plain not in described:
             described.add(plain)
             extra = ""
@@ -177,16 +215,22 @@ def main(argv=None):
             health = cli.health()
         except Exception:
             health = None  # pre-health server: columns degrade to '-'
+        try:
+            fleet = cli.fleet()
+        except Exception:
+            fleet = None  # pre-fleet server: columns degrade to '-'
     finally:
         cli.close()
     if args.json:
+        # both ride as SIBLING keys: the pinned stats schema the
+        # dashboards scrape is untouched
         if health is not None:
-            # rides as a SIBLING key: the pinned stats schema the
-            # dashboards scrape is untouched
             reply = dict(reply, health=health)
+        if fleet is not None:
+            reply = dict(reply, fleet=fleet)
         print(json.dumps(reply, indent=1, default=str))
     else:
-        print(render(reply, health=health))
+        print(render(reply, health=health, fleet=fleet))
     return 0
 
 

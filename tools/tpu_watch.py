@@ -190,6 +190,19 @@ def main():
                      "--decode", "--decode_mode", "cb",
                      "--decode_slots", "16", "--spec_k", "0,2,4,8",
                      "--qps", "60", "--duration", "15"], {}, 3600),
+        # fleet controller on silicon (SERVING.md "Fleet controller"):
+        # the shifting-traffic schedule — warm two models, idle the
+        # cold one past its page TTL, flash-crowd it — controller on
+        # vs static placement.  The REAL on-silicon numbers here are
+        # the page/fault-in cycle: device-memory release on page-out
+        # and the measured fault_in_ms / TTFR of a warm-compile-cache
+        # reload+warm on chip (the CPU smoke in BENCH_r15.json can
+        # only time host-side reloads); overload capacity stays on
+        # the deterministic --dispatch_cost_ms stand-in so the A/B
+        # drop/shed comparison is load-calibrated, not model-bound
+        ("fleet", ["tools/bench_serving.py", "--require_tpu",
+                   "--fleet", "both", "--dispatch_cost_ms", "20",
+                   "--duration", "15"], {}, 3600),
         # quantized serving A/B on silicon (QUANTIZE.md): resnet fp32
         # vs PTQ-int8 behind the precision axis — on the HBM-roofline-
         # bound chip the int8 lane's halved weight bytes should show up

@@ -731,10 +731,17 @@ def analyze_program(program, feeds=None, fetches=None, batch=1,
 
 
 def _decode_report(path, meta, decode_slots, device, what,
-                   kv_cache_dtype=None):
+                   kv_cache_dtype=None, fuse_steps=None):
     """Resource report for a decode artifact (no Program IR): weights
     from the state payload, the slot-table KV cache from the meta
     geometry — the bytes that bound decode slots (SERVING.md).
+
+    ``fuse_steps`` prices the FUSED decode dispatch (SERVING.md "Fused
+    multi-step decode"): one dispatch runs up to N steps on-device, so
+    ``total_flops`` / ``total_bytes`` scale by N while the PEAK is
+    unchanged — the while_loop carries the same one-token working set
+    and the same slot table through every trip, so fusing never moves
+    the admission gate, only the per-dispatch work it amortizes.
 
     The cache prices at its DTYPE's width (QUANTIZE.md "Quantized KV
     cache"): `kv_cache_dtype` (a load_model override) > the artifact's
@@ -782,15 +789,17 @@ def _decode_report(path, meta, decode_slots, device, what,
     # decode-step working set: one token's activations per slot
     rep.activation_peak_bytes = n_slots * D * 4 * (L + 2)
     # one decode step: every weight multiplies once per slot, and the
-    # whole KV cache streams through the attention gather
-    rep.total_flops = 2 * n_params * n_slots
-    rep.total_bytes = rep.param_bytes + rep.kv_cache_bytes
+    # whole KV cache streams through the attention gather; a fused
+    # dispatch is N such steps back-to-back at the same peak
+    fuse = max(int(fuse_steps or 1), 1)
+    rep.total_flops = 2 * n_params * n_slots * fuse
+    rep.total_bytes = (rep.param_bytes + rep.kv_cache_bytes) * fuse
     rep.n_ops = 0
     return rep
 
 
 def analyze_artifact(path, batch=1, decode_slots=None, device=None,
-                     kv_cache_dtype=None):
+                     kv_cache_dtype=None, fuse_steps=None):
     """Static resource report for a saved artifact dir — the admission
     gate's input, and lint_program --report's row source.
 
@@ -799,8 +808,9 @@ def analyze_artifact(path, batch=1, decode_slots=None, device=None,
     ``actual_param_bytes``; decode artifacts (decode_meta.bin) come
     from their meta geometry + KV slot table priced at the cache dtype
     (`kv_cache_dtype` overrides the artifact's pin — the load_model
-    knob); save_aot dirs (aot_meta.bin) from their state payload +
-    feed specs."""
+    knob, and ``fuse_steps`` prices the N-step fused dispatch at N·step
+    FLOPs/bytes with the peak unchanged); save_aot dirs (aot_meta.bin)
+    from their state payload + feed specs."""
     from ..inference.decode import DECODE_META
     dm = os.path.join(path, DECODE_META)
     if os.path.exists(dm):
@@ -808,7 +818,8 @@ def analyze_artifact(path, batch=1, decode_slots=None, device=None,
         with open(dm, "rb") as f:
             meta = wire.decode(f.read())
         return _decode_report(path, meta, decode_slots, device, path,
-                              kv_cache_dtype=kv_cache_dtype)
+                              kv_cache_dtype=kv_cache_dtype,
+                              fuse_steps=fuse_steps)
     am = os.path.join(path, "aot_meta.bin")
     if os.path.exists(am):
         from ..native import wire

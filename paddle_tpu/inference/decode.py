@@ -73,6 +73,23 @@ a pseudo-slot with its own length mask), so verify logits round
 exactly like sequential step logits.  A draft failure mid-round
 degrades the session to target-only plain decode within that same
 step (`degraded`), never wedging or corrupting a stream.
+
+**Fused multi-step decode** (SERVING.md "Fused multi-step decode"):
+every plain decode step is one host->device dispatch, so at real
+silicon step costs the HOST becomes the tokens/sec ceiling long
+before the HBM roofline does.  `fused_step_fn(n_slots, n_steps)`
+compiles up to N steps as ONE executable — a `lax.while_loop`
+carrying {cache, lengths, last_tokens, running masks} through
+step+argmax+KV-write per trip with in-graph early exit — and
+`DecodeSession.decode_fused` drives it, returning a [n_slots,
+n_steps] token block per dispatch.  The speculative path rides the
+same discipline: `fused_spec_fn` runs k draft steps + batched verify
++ in-graph accept/rollback/catch-up as one dispatch
+(`SpeculativeDecodeSession.step(fused=True)`).  Because the per-trip
+body IS the plain step math and per-slot math is independent, fused
+streams are bit-identical to N=1 streams token-for-token — the
+serving layer (FLAGS.serving_decode_fuse_steps) moves slot
+joins/leaves to window boundaries without moving a single token.
 """
 
 import hashlib
@@ -274,14 +291,23 @@ class GenerativePredictor:
             with open(os.path.join(dirname, DECODE_META), "rb") as f:
                 self.meta = wire.decode(f.read())
             with open(os.path.join(dirname, _DECODE_STATE), "rb") as f:
-                self._state_host = wire.decode(f.read())
+                raw_state = f.read()
+            self._state_host = wire.decode(raw_state)
             # (device_kind, phase-key) -> jitted call, shared BY
             # REFERENCE across clone_to replicas
             self._shared_exports = {}
             self._shared_lock = threading.Lock()
+            # the fingerprint must cover the WEIGHTS, not just the
+            # meta: the int8 phases bake the weight-derived kv scales
+            # as trace constants, so two same-shape artifacts with
+            # different weights must never resolve each other's
+            # persisted executables (a meta-only fingerprint let a
+            # stale ("step", n) int8 blob quantize with another
+            # model's scales)
             self._model_fp = hashlib.sha256(json.dumps(
                 {k: self.meta[k] for k in sorted(self.meta)},
-                sort_keys=True, default=str).encode()).hexdigest()
+                sort_keys=True, default=str).encode()
+                + hashlib.sha256(raw_state).digest()).hexdigest()
             if kv_cache_dtype is not None:
                 self._kv_dtype = normalize_kv_dtype(kv_cache_dtype)
             elif self.meta.get("kv_cache_dtype"):
@@ -649,7 +675,157 @@ class GenerativePredictor:
         return (g, m, jnp.where(stale_m, zero, kall),
                 jnp.where(stale_m, zero, vall))
 
+    def _fused_step_math(self, n_steps):
+        """Build the FUSED multi-step decode phase (SERVING.md "Fused
+        multi-step decode"): up to `n_steps` plain decode steps run as
+        ONE compiled executable — a `lax.while_loop` carrying {KV
+        cache, lengths, last_tokens, per-slot running masks} through
+        step+argmax+KV-write per trip, with in-graph early exit the
+        moment no slot is still running.  Per-trip the body is EXACTLY
+        `_step_math` (same kernel, same masking, same write order), so
+        a fused stream is bit-identical to `n_steps` sequential
+        `decode()` calls — the per-slot independence that makes batched
+        decode bit-exact makes fusion bit-exact too.
+
+        Runtime args (the executable stays one fingerprint per
+        (n_slots, n_steps) geometry):
+          * `budget` [N] i32 — tokens each slot may still emit (its
+            max_new / cache-room headroom); a slot stops running when
+            its budget is met, without stopping the others;
+          * `max_trips` [] i32 — dispatch-wide trip clamp (<= n_steps),
+            the serving deadline governor (a lane about to expire runs
+            a short window instead of recompiling a new geometry).
+
+        A slot stops running after emitting EOS, exhausting its
+        budget, or filling its cache; tokens land in a [N, n_steps]
+        block, `emitted[s]` of them valid per slot, in stream order."""
+        import jax
+        import jax.numpy as jnp
+        n_steps = int(n_steps)
+        eos = self.eos_id
+
+        def fused(state, kc, vc, lengths, last_tokens, active, budget,
+                  max_trips):
+            S = kc.shape[2]
+            N = kc.shape[1]
+            toks0 = jnp.zeros((N, n_steps), jnp.int32)
+            emitted0 = jnp.zeros((N,), jnp.int32)
+            running0 = active & (budget > 0) \
+                & (lengths < jnp.int32(S))
+            trips = jnp.minimum(max_trips, jnp.int32(n_steps))
+
+            def cond(carry):
+                i, _kc, _vc, _len, _last, _em, _tk, running = carry
+                return (i < trips) & jnp.any(running)
+
+            def body(carry):
+                i, kc, vc, lengths, last, emitted, toks, running = carry
+                tok, kc, vc = self._step_math(state, kc, vc, lengths,
+                                              last, running)
+                # land this trip's tokens at column i (one-hot select —
+                # stopped slots keep their block rows untouched)
+                col = (jnp.arange(n_steps)[None, :] == i) \
+                    & running[:, None]
+                toks = jnp.where(col, tok[:, None], toks)
+                adv = running.astype(jnp.int32)
+                emitted = emitted + adv
+                lengths = lengths + adv
+                last = jnp.where(running, tok, last)
+                running = running & (tok != jnp.int32(eos)) \
+                    & (emitted < budget) & (lengths < jnp.int32(S))
+                return (i + 1, kc, vc, lengths, last, emitted, toks,
+                        running)
+
+            carry = (jnp.int32(0), kc, vc, lengths, last_tokens,
+                     emitted0, toks0, running0)
+            (i, kc, vc, lengths, last, emitted, toks,
+             _running) = jax.lax.while_loop(cond, body, carry)
+            return toks, emitted, i, kc, vc, lengths, last
+
+        return fused
+
+    def _fused_spec_math(self, draft, spec_k):
+        """Build the FUSED speculative round: k draft decode steps +
+        the batched k+1-position verify + in-graph accept / draft-
+        rollback / draft-catch-up bookkeeping, all ONE executable (one
+        dispatch instead of k draft dispatches + one verify).  The
+        draft's state dict rides as a traced ARGUMENT (its weights are
+        not baked), and the phase key carries the draft's model
+        fingerprint + cache dtype so two different drafts never collide
+        on one executable.
+
+        Every sub-phase is the same traced math the host-driven round
+        runs (`draft._step_math` per draft trip, `self._verify_math`
+        for scoring, the rollback zeroing mirrors `DecodeSession.
+        rollback`), so committed streams stay bit-identical to the
+        fp32-only plain stream and twin-draft acceptance stays exactly
+        1.0."""
+        import jax.numpy as jnp
+        k = int(spec_k)
+
+        def fused(state, dstate, t_kc, t_vc, t_len, t_last,
+                  d_kc, d_vc, d_len, d_last, active):
+            N = t_kc.shape[1]
+            Sd = d_kc.shape[2]
+            adv = active.astype(jnp.int32)
+            rows = jnp.arange(N)
+            # 1. DRAFT: k steps on the draft table (unrolled — k is a
+            # geometry constant of this executable)
+            drafts = []
+            for _ in range(k):
+                dtok, d_kc, d_vc = draft._step_math(
+                    dstate, d_kc, d_vc, d_len, d_last, active)
+                d_len = d_len + adv
+                d_last = jnp.where(active, dtok, d_last)
+                drafts.append(dtok)
+            # 2. VERIFY: score [pending, d1..dk] in one batched step
+            chunk = jnp.stack([t_last] + drafts, axis=1)      # [N, C]
+            g, m, t_kc, t_vc = self._verify_math(
+                state, t_kc, t_vc, t_len, chunk, active)
+            m = jnp.where(active, m, 0)
+            # 3. COMMIT: target bookkeeping (mirrors the host round)
+            counts = jnp.where(active, m + 1, 0).astype(jnp.int32)
+            t_len = t_len + counts
+            t_last = jnp.where(active, g[rows, jnp.minimum(m, k)],
+                               t_last)
+            # draft sync, in-graph: partially-accepted slots roll the
+            # rejected rows back (zeroed, length pointer retreats,
+            # pending token re-pins to the target's correction)...
+            part = active & (m < k)
+            nback = jnp.where(part, k - 1 - m, 0)
+            newlen = d_len - nback
+            posS = jnp.arange(Sd)[None, :]
+            stale = (posS >= newlen[:, None]) & (posS < d_len[:, None])
+            stale_m = stale[None, :, :, None, None]
+            zero = jnp.zeros((), d_kc.dtype)
+            d_kc = jnp.where(stale_m, zero, d_kc)
+            d_vc = jnp.where(stale_m, zero, d_vc)
+            d_len = newlen
+            d_last = jnp.where(part, g[rows, jnp.minimum(m, k)], d_last)
+            # ...and fully-accepted slots owe the draft one catch-up
+            # step (it emitted d_k without ever consuming it), pending
+            # token re-pinned to the target's bonus token
+            full = active & (m == k)
+            _cu, d_kc, d_vc = draft._step_math(
+                dstate, d_kc, d_vc, d_len, d_last, full)
+            d_len = d_len + full.astype(jnp.int32)
+            d_last = jnp.where(full, g[:, k], d_last)
+            return (g, m, t_kc, t_vc, t_len, t_last,
+                    d_kc, d_vc, d_len, d_last)
+
+        return fused
+
     # -- compiled-phase resolution (the PR 6 compile-cache ride) --------
+
+    @staticmethod
+    def _argsig(spec):
+        """Fingerprint encoding of one arg spec: a plain ShapeDtype
+        leaf, or a dict of them (the fused-speculative phase passes the
+        DRAFT predictor's state dict as a traced argument)."""
+        if isinstance(spec, dict):
+            return {k: [list(v.shape), str(v.dtype)]
+                    for k, v in sorted(spec.items())}
+        return [list(spec.shape), str(spec.dtype)]
 
     def _fingerprint(self, phase_key, arg_specs):
         from paddle_tpu import compile_cache as cc
@@ -665,7 +841,7 @@ class GenerativePredictor:
             "kv_dtype": self._kv_dtype,
             "rev": 2,
             "state": cc._spec_sig(self._state_host),
-            "args": [[list(s.shape), str(s.dtype)] for s in arg_specs],
+            "args": [self._argsig(s) for s in arg_specs],
             "env": cc.environment_fingerprint(self._device),
         }
 
@@ -802,6 +978,65 @@ class GenerativePredictor:
                  jax.ShapeDtypeStruct((n,), np.dtype(bool)))
         return self._resolve(("verify", n, C), self._verify_math, specs)
 
+    def fused_step_fn(self, n_slots, n_steps):
+        """The fused multi-step decode executable for a (slot table,
+        window) geometry: up to `n_steps` tokens per slot per dispatch
+        with in-graph early exit (`_fused_step_math`).  One new
+        compile-cache fingerprint per (n_slots, n_steps) — warm boots
+        of a fused-configured server deserialize it like every other
+        phase (COMPILE_CACHE.md)."""
+        import jax
+        L, H, Dh, _ = self._dims()
+        S = self.max_seq_len
+        n, T = int(n_slots), int(n_steps)
+        if T < 1:
+            raise ValueError("fuse window must be >= 1, got %d" % T)
+        cache = jax.ShapeDtypeStruct((L, n, S, H, Dh),
+                                     self._cache_np_dtype())
+        i32 = np.dtype(np.int32)
+        specs = (cache, cache,
+                 jax.ShapeDtypeStruct((n,), i32),
+                 jax.ShapeDtypeStruct((n,), i32),
+                 jax.ShapeDtypeStruct((n,), np.dtype(bool)),
+                 jax.ShapeDtypeStruct((n,), i32),
+                 jax.ShapeDtypeStruct((), i32))
+        return self._resolve(("fused_step", n, T),
+                             self._fused_step_math(T), specs)
+
+    def fused_spec_fn(self, draft, n_slots, spec_k):
+        """The fused speculative-round executable: k draft steps +
+        batched verify + in-graph accept/rollback/catch-up as ONE
+        dispatch (`_fused_spec_math`).  Keyed per (n_slots, k, draft
+        identity) — the draft's model fingerprint and cache dtype ride
+        the phase key, so swapping drafts can never resolve a stale
+        executable."""
+        import jax
+        L, H, Dh, _ = self._dims()
+        S = self.max_seq_len
+        dL, dH, dDh, _ = draft._dims()
+        dS = draft.max_seq_len
+        n, C = int(n_slots), int(spec_k) + 1
+        i32 = np.dtype(np.int32)
+        cache = jax.ShapeDtypeStruct((L, n, S, H, Dh),
+                                     self._cache_np_dtype())
+        dcache = jax.ShapeDtypeStruct((dL, n, dS, dH, dDh),
+                                      draft._cache_np_dtype())
+        dstate = {name: jax.ShapeDtypeStruct(np.shape(v),
+                                             np.asarray(v).dtype)
+                  for name, v in draft._state_host.items()}
+        specs = (dstate, cache, cache,
+                 jax.ShapeDtypeStruct((n,), i32),
+                 jax.ShapeDtypeStruct((n,), i32),
+                 dcache, dcache,
+                 jax.ShapeDtypeStruct((n,), i32),
+                 jax.ShapeDtypeStruct((n,), i32),
+                 jax.ShapeDtypeStruct((n,), np.dtype(bool)))
+        key = ("fused_spec", n, C, draft._model_fp[:16],
+               draft._kv_dtype)
+        return self._resolve(key,
+                             self._fused_spec_math(draft, int(spec_k)),
+                             specs)
+
     def new_session(self, n_slots):
         return DecodeSession(self, n_slots)
 
@@ -905,6 +1140,43 @@ class DecodeSession:
             np.int32)
         self.steps += 1
         return toks
+
+    def decode_fused(self, n_steps, budget=None, max_trips=None):
+        """Up to `n_steps` decode steps in ONE dispatch (SERVING.md
+        "Fused multi-step decode").  Returns (tokens [n_slots, n_steps]
+        int32, counts [n_slots] int32, trips int): slot s emitted
+        `counts[s]` tokens this dispatch, `tokens[s, :counts[s]]` in
+        stream order; `trips` is how many loop iterations actually ran
+        (in-graph early exit — all slots hitting EOS/budget ends the
+        window early).  `budget` [n_slots] caps each slot's emissions
+        (max_new / cache-room headroom; clipped to [0, n_steps], zero
+        for inactive slots); `max_trips` clamps the whole dispatch (the
+        serving deadline governor) without changing the compiled
+        geometry.  Bit-exact vs `n_steps` sequential `decode()` calls
+        — per-slot math is independent and the per-trip body IS the
+        plain step math."""
+        T = int(n_steps)
+        if T < 1:
+            raise ValueError("n_steps must be >= 1, got %d" % T)
+        act = self.active
+        if budget is None:
+            b = np.where(act, T, 0).astype(np.int32)
+        else:
+            b = np.asarray(budget, np.int32).reshape(self.n_slots)
+            b = np.clip(np.where(act, b, 0), 0, T).astype(np.int32)
+        mt = T if max_trips is None else max(1, min(int(max_trips), T))
+        fn = self.predictor.fused_step_fn(self.n_slots, T)
+        toks, counts, trips, self._kc, self._vc, lengths, last = fn(
+            self.predictor._state, self._kc, self._vc,
+            self._put(self.lengths), self._put(self.last_tokens),
+            self._put(act), self._put(b), self._put(np.int32(mt)))
+        # lengths/last_tokens come back from the device: pure integer
+        # bookkeeping, so device round-trip is exact
+        self.lengths = np.asarray(lengths).astype(np.int32)
+        self.last_tokens = np.asarray(last).astype(np.int32)
+        trips = int(trips)
+        self.steps += trips
+        return np.asarray(toks), np.asarray(counts), trips
 
     def room(self, slot):
         """Generated tokens this slot can still hold (cache positions
@@ -1107,7 +1379,8 @@ class SpeculativeDecodeSession:
         for s in np.nonzero(mask)[0]:
             ds.last_tokens[s] = np.int32(pins[s])
 
-    def step(self, step_delay=0.0, draft_delay=0.0, force_plain=False):
+    def step(self, step_delay=0.0, draft_delay=0.0, force_plain=False,
+             fused=False):
         """One round over the slot table.  Returns (tokens [N, k+1]
         int32, counts [N] int32): slot s committed `counts[s]` tokens
         this round, `tokens[s, :counts[s]]` in stream order (counts is
@@ -1121,7 +1394,16 @@ class SpeculativeDecodeSession:
         rows a verify writes — those rounds fall back to ONE plain
         target step for every slot (progress is never blocked by a
         nearly-full slot), with a draft catch-up step keeping the
-        tables mirrored."""
+        tables mirrored.
+
+        `fused=True` runs the whole round as ONE dispatch (SERVING.md
+        "Fused multi-step decode"): k draft steps + verify + accept /
+        rollback / catch-up ride `GenerativePredictor.fused_spec_fn`
+        instead of k+1 host-driven launches.  Committed streams are
+        bit-identical either way — the fused program is the same
+        traced math; only the dispatch count changes.  Draft-poison
+        chaos still fires per logical draft step (checked host-side
+        before the dispatch), degrading to the same plain round."""
         ts = self.session
         k = self.spec_k
         C = k + 1
@@ -1133,6 +1415,51 @@ class SpeculativeDecodeSession:
                    and all(ts.room(int(s)) >= C for s in occupied))
         self.last_spec = False
         drafts = []
+        if spec_ok and fused:
+            # host-side chaos parity: the poison counter advances once
+            # per LOGICAL draft step (and the draft-cost stand-in
+            # sleeps k times), exactly like the host-driven round — a
+            # poisoned draft degrades this round to plain before the
+            # fused dispatch ever launches
+            try:
+                for _ in range(k):
+                    _check_draft_poison()
+                    if draft_delay:
+                        time.sleep(draft_delay)
+            except BaseException as e:
+                self._degrade(e)
+                spec_ok = False
+        if spec_ok and fused:
+            ds = self.draft_session
+            self.last_draft_end = time.monotonic()
+            if step_delay:
+                time.sleep(step_delay)
+            fn = self.predictor.fused_spec_fn(self.draft_predictor,
+                                              N, k)
+            (g, m, ts._kc, ts._vc, t_len, t_last,
+             ds._kc, ds._vc, d_len, d_last) = fn(
+                self.predictor._state, self.draft_predictor._state,
+                ts._kc, ts._vc, ts._put(ts.lengths),
+                ts._put(ts.last_tokens), ds._kc, ds._vc,
+                ds._put(ds.lengths), ds._put(ds.last_tokens),
+                ts._put(active))
+            g = np.asarray(g)
+            m = np.where(active, np.asarray(m), 0).astype(np.int32)
+            counts = np.where(active, m + 1, 0).astype(np.int32)
+            # integer bookkeeping round-trips the device exactly
+            ts.lengths = np.asarray(t_len).astype(np.int32)
+            ts.last_tokens = np.asarray(t_last).astype(np.int32)
+            ds.lengths = np.asarray(d_len).astype(np.int32)
+            ds.last_tokens = np.asarray(d_last).astype(np.int32)
+            ts.steps += 1
+            # the draft table advanced k steps (+1 catch-up when any
+            # slot fully accepted), same as the host-driven round
+            ds.steps += k + (1 if bool((m[occupied] == k).any()) else 0)
+            self.rounds += 1
+            self.proposed += k * occupied.size
+            self.accepted += int(m[occupied].sum())
+            self.last_spec = True
+            return g, counts
         if spec_ok:
             ds = self.draft_session
             try:

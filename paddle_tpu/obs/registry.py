@@ -38,6 +38,9 @@ _SERVING_COUNTERS = ("requests", "responses", "errors", "shed",
                      # generation counters (absent for one-shot models)
                      "streams", "prefills", "decode_tokens",
                      "decode_steps",
+                     # fused multi-step decode (SERVING.md): dispatches
+                     # issued — tokens/dispatches is the amortization
+                     "decode_dispatches",
                      # speculative decoding (absent without a draft)
                      "spec_rounds", "draft_tokens", "accepted_tokens",
                      "spec_degraded")
@@ -53,7 +56,8 @@ _SERVING_GAUGES = ("qps_recent", "qps_lifetime", "batch_fill",
                    # lifetime draft accept fraction (SERVING.md
                    # speculative decoding — the speedup dial)
                    "spec_accept_rate")
-_SERVING_HISTS = ("latency_ms", "queue_wait_ms", "ttft_ms")
+_SERVING_HISTS = ("latency_ms", "queue_wait_ms", "ttft_ms",
+                  "tokens_per_dispatch")
 _QUANTILES = ("p50", "p95", "p99")
 
 

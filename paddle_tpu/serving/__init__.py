@@ -21,7 +21,8 @@ coalescing, load shedding) from the training runtime's.
 
 from .batcher import (BatcherClosed, DeadlineExceeded, DecodeBatcher,
                       DecodeStream, DynamicBatcher, ServerOverloaded,
-                      set_dispatch_delay, set_draft_delay)
+                      set_dispatch_delay, set_draft_delay,
+                      set_host_delay)
 from .fleet import (FleetAction, FleetController, FleetPolicy,
                     ModelSensors, parse_fleet_spec)
 from .metrics import (Counter, ModelMetrics, ReservoirHistogram,
@@ -34,6 +35,7 @@ __all__ = [
     "DynamicBatcher", "DecodeBatcher", "DecodeStream",
     "ServerOverloaded", "DeadlineExceeded",
     "BatcherClosed", "set_dispatch_delay", "set_draft_delay",
+    "set_host_delay",
     "Counter", "ReservoirHistogram", "ModelMetrics", "ServingMetrics",
     "ModelRegistry", "ModelEntry", "open_predictor",
     "resolve_placement",

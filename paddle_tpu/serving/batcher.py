@@ -70,7 +70,7 @@ from ..obs import tracing as obs_tracing
 
 __all__ = ["DynamicBatcher", "DecodeBatcher", "DecodeStream",
            "ServerOverloaded", "DeadlineExceeded", "BatcherClosed",
-           "set_dispatch_delay", "set_draft_delay"]
+           "set_dispatch_delay", "set_draft_delay", "set_host_delay"]
 
 _CHAOS_ENV = "PADDLE_TPU_SERVING_CHAOS"
 
@@ -142,6 +142,26 @@ def set_draft_delay(secs):
 
 def _draft_chaos_delay():
     return _chaos_delay(key="draft_delay", direct=_draft_delay)
+
+
+_host_delay = 0.0
+
+
+def set_host_delay(secs):
+    """Per-DISPATCH host-side cost stand-in (SERVING.md "Fused
+    multi-step decode"): every decode dispatch sleeps `secs` once
+    before launching, GIL released — the deterministic model of the
+    host round-trip (Python scheduling + launch + sync) that fused
+    decode amortizes.  At N=1 a stream pays host+step per token; at
+    fuse_steps=N it pays host once per N tokens — bench_serving
+    --host_cost_ms rides this to show the dispatch-amortization win
+    at real step costs (0 clears)."""
+    global _host_delay
+    _host_delay = float(secs)
+
+
+def _host_chaos_delay():
+    return _chaos_delay(key="host_cost", direct=_host_delay)
 
 
 def _predictor_device_label(predictor):
@@ -902,10 +922,14 @@ class _DecodeLane:
     per round instead of exactly one."""
 
     __slots__ = ("index", "predictor", "session", "assigned", "steps",
-                 "tokens", "spec", "degraded_noted", "last_step_t")
+                 "tokens", "spec", "degraded_noted", "last_step_t",
+                 "step_ewma")
 
     def __init__(self, index, predictor, n_slots, draft=None, spec_k=0):
         self.last_step_t = None  # monotonic end of the last decode step
+        # EWMA seconds per decode STEP (per trip under fusion) — the
+        # deadline governor's estimate for clamping fused trip counts
+        self.step_ewma = None
         self.index = index
         self.predictor = predictor
         if draft is not None and int(spec_k) >= 1:
@@ -944,12 +968,25 @@ class DecodeBatcher:
     list in stream order with per-token EOS/max-new cuts, so the wire
     stream is bit-identical to the one-token-per-step path.  Draft
     failure degrades the lane to target-only decode within one round
-    (`spec_degraded` event + counter), never wedging a stream."""
+    (`spec_degraded` event + counter), never wedging a stream.
+
+    ``fuse_steps`` > 1 (SERVING.md "Fused multi-step decode",
+    FLAGS.serving_decode_fuse_steps) runs each lane iteration as ONE
+    fused dispatch of up to N decode steps (`DecodeSession.
+    decode_fused`): slot joins/leaves/deadline evictions move to the
+    N-step window boundary, per-token EOS/max-new cuts still land in
+    stream order from the returned token block, and spec lanes fuse
+    the whole draft+verify round into one dispatch
+    (`SpeculativeDecodeSession.step(fused=True)`).  Streams stay
+    bit-identical to N=1 whatever joins or leaves; a per-lane EWMA of
+    step time clamps the trip count so no deadline overshoots by more
+    than one dispatch (the overshoot lands on the `deadline_expired`
+    event)."""
 
     def __init__(self, predictor, replicas=None, n_slots=None,
                  max_queue=None, metrics=None, max_new_tokens=None,
                  continuous=True, draft=None, draft_replicas=None,
-                 spec_k=None):
+                 spec_k=None, fuse_steps=None):
         preds = list(replicas) if replicas else [predictor]
         self.predictor = predictor if predictor is not None else preds[0]
         self.n_slots = max(int(FLAGS.serving_decode_slots
@@ -961,6 +998,12 @@ class DecodeBatcher:
                                    else max_new_tokens), 1)
         self.continuous = bool(continuous)
         self.metrics = metrics
+        # fused multi-step decode window (1 = the classic one-dispatch-
+        # per-token loop; the default rides the flag so existing
+        # servers keep N=1 behavior bit-for-bit)
+        self.fuse_steps = max(int(FLAGS.serving_decode_fuse_steps
+                                  if fuse_steps is None
+                                  else fuse_steps), 1)
         # speculative decoding (SERVING.md): one draft predictor per
         # replica lane (`draft_replicas`, or one shared `draft` for the
         # single-lane shape); spec_k is the draft depth per round
@@ -1253,12 +1296,17 @@ class DecodeBatcher:
         """Deadline eviction — in queue, at prefill, or MID-DECODE: the
         deadline covers in-decode time (the PR 8 admission-control
         fix), so a streaming request past it frees its slot within one
-        step instead of pinning it to max_new_tokens."""
+        step instead of pinning it to max_new_tokens.  `overshoot_ms`
+        stamps how far past the deadline the eviction landed — under
+        fused decode the check fires at window boundaries, and the
+        trip-count clamp bounds this to about one dispatch."""
         obs_events.emit("deadline_expired", model=self._model_name,
                         trace_id=req.trace_id,
                         replica=lane.index,
                         tokens=len(req.gen),
-                        waited_ms=round((now - req.enqueued) * 1e3, 3))
+                        waited_ms=round((now - req.enqueued) * 1e3, 3),
+                        overshoot_ms=round((now - req.deadline) * 1e3, 3)
+                        if req.deadline is not None else None)
         self._finish(lane, slot, req, "deadline", exc=DeadlineExceeded(
             "deadline passed after %.1f ms (%d tokens generated)"
             % ((now - req.enqueued) * 1e3, len(req.gen))))
@@ -1304,13 +1352,15 @@ class DecodeBatcher:
             req.buf = []
 
     def _emit_step_spans(self, lane, t0, t_draft_end, now, n_slots,
-                         accepted=None):
-        """Per-round step spans: `serving/decode_step` always; on a
-        speculative round its `serving/draft` + `serving/verify`
-        children are cut from the same contiguous monotonic stamps so
-        they TILE the round exactly (draft end == verify start).  One
-        time.time() anchor places them on the wall-clock axis; every
-        duration rides the monotonic stamps."""
+                         accepted=None, tokens=None, trips=None):
+        """Per-round step spans: `serving/decode_step` always (now a
+        per-DISPATCH span: `tokens` emitted and `trips` loop
+        iterations ride as attrs, the tokens-per-dispatch axis of the
+        fused-decode win); on a speculative round its `serving/draft`
+        + `serving/verify` children are cut from the same contiguous
+        monotonic stamps so they TILE the round exactly (draft end ==
+        verify start).  One time.time() anchor places them on the
+        wall-clock axis; every duration rides the monotonic stamps."""
         wall_now = time.time()
         attrs = {"model": self._model_name or "", "replica": lane.index,
                  "slots": n_slots}
@@ -1326,7 +1376,7 @@ class DecodeBatcher:
             _mk("serving/draft", t0, t_draft_end,
                 spec_k=lane.session.spec_k)
             _mk("serving/verify", t_draft_end, now, accepted=accepted)
-        _mk("serving/decode_step", t0, now)
+        _mk("serving/decode_step", t0, now, tokens=tokens, trips=trips)
 
     def _note_degraded(self, lane):
         """First observation of a degraded spec session: latch the obs
@@ -1361,19 +1411,63 @@ class DecodeBatcher:
             if not lane.assigned:
                 self._note_degraded(lane)
                 continue
+            fuse = self.fuse_steps
+            if fuse > 1:
+                # window-boundary housekeeping (SERVING.md "Fused
+                # multi-step decode"): drop cancelled/expired streams
+                # BEFORE burning an N-step window on them — joins and
+                # leaves happen only at dispatch boundaries
+                nowb = time.monotonic()
+                for slot, req in list(lane.assigned.items()):
+                    if req.stream.cancelled():
+                        req.buf = []
+                        self._finish(lane, slot, req, "cancelled")
+                    elif req.deadline is not None \
+                            and nowb > req.deadline:
+                        self._expire(lane, slot, req, nowb)
+                if not lane.assigned:
+                    continue
             n_act = len(lane.assigned)
             t0 = time.monotonic()
             # the same slow-worker chaos hook / deterministic per-step
             # device-cost stand-in as the one-shot lanes
             # (set_dispatch_delay — bench_serving --step_cost_ms; the
             # draft steps of a spec round price separately via
-            # set_draft_delay — bench_serving --draft_cost_ms)
+            # set_draft_delay — bench_serving --draft_cost_ms), plus
+            # the per-DISPATCH host-cost stand-in (set_host_delay —
+            # bench_serving --host_cost_ms) that fusion amortizes 1/N
             delay = _chaos_delay()
+            host_delay = _host_chaos_delay()
+            if host_delay:
+                time.sleep(host_delay)
+            trips = 1
             if lane.spec:
                 toks2d, counts = sess.step(
                     step_delay=delay,
-                    draft_delay=_draft_chaos_delay())
+                    draft_delay=_draft_chaos_delay(),
+                    fused=fuse > 1)
                 spec_round = sess.last_spec
+            elif fuse > 1:
+                # per-slot token budgets (max_new / cache-room
+                # headroom) + the deadline governor: the lane's EWMA
+                # step time clamps the trip count so a deadlined
+                # stream never overshoots by more than ~one dispatch
+                budget = np.zeros(self.n_slots, np.int32)
+                max_trips = fuse
+                for slot, req in lane.assigned.items():
+                    budget[slot] = min(req.max_new - len(req.gen),
+                                       sess.room(slot), fuse)
+                    if req.deadline is not None and lane.step_ewma:
+                        allow = int((req.deadline - t0)
+                                    / lane.step_ewma)
+                        max_trips = min(max_trips, max(allow, 1))
+                toks2d, counts, trips = sess.decode_fused(
+                    fuse, budget=budget, max_trips=max_trips)
+                spec_round = False
+                if delay:
+                    # the device-cost stand-in scales with the trips
+                    # that actually ran (in-graph early exit included)
+                    time.sleep(delay * trips)
             else:
                 if delay:
                     time.sleep(delay)
@@ -1382,8 +1476,13 @@ class DecodeBatcher:
             now = time.monotonic()
             lane.steps += 1
             lane.last_step_t = now
+            # EWMA seconds per logical step (per trip): the fused
+            # deadline governor's clamp input
+            per_step = (now - t0) / max(trips, 1)
+            lane.step_ewma = per_step if lane.step_ewma is None \
+                else 0.5 * lane.step_ewma + 0.5 * per_step
             if self.metrics is not None:
-                self.metrics.decode_steps.add()
+                self.metrics.decode_steps.add(trips)
                 if spec_round:
                     # per-round accept telemetry: k proposals per
                     # occupied slot, counts[s]-1 of them accepted
@@ -1391,22 +1490,17 @@ class DecodeBatcher:
                     accepted = int(counts.sum()) - n_act
                     self.metrics.note_spec(proposed, accepted)
             self._note_degraded(lane)
-            if obs_tracing.enabled():
-                self._emit_step_spans(
-                    lane, t0,
-                    sess.last_draft_end if spec_round else None, now,
-                    n_act,
-                    accepted=(int(counts.sum()) - n_act)
-                    if spec_round else None)
+            fused_plain = not lane.spec and fuse > 1
             emitted = 0
             for slot, req in list(lane.assigned.items()):
-                # a spec round commits 1..k+1 tokens per slot; consume
-                # them in stream order with per-token EOS/max-new cuts
-                # so the emitted stream is bit-identical to the plain
+                # a spec round commits 1..k+1 tokens per slot (a fused
+                # window up to fuse_steps); consume them in stream
+                # order with per-token EOS/max-new cuts so the emitted
+                # stream is bit-identical to the plain
                 # one-token-per-step path
                 slot_toks = [int(toks2d[slot, j])
                              for j in range(int(counts[slot]))] \
-                    if lane.spec else [int(toks[slot])]
+                    if (lane.spec or fused_plain) else [int(toks[slot])]
                 finished = None
                 for tok in slot_toks:
                     req.gen.append(tok)
@@ -1433,9 +1527,22 @@ class DecodeBatcher:
                 elif len(req.buf) >= req.chunk:
                     req.stream._put_tokens(req.buf)
                     req.buf = []
+            if obs_tracing.enabled():
+                self._emit_step_spans(
+                    lane, t0,
+                    sess.last_draft_end if spec_round else None, now,
+                    n_act,
+                    accepted=(int(counts.sum()) - n_act)
+                    if spec_round else None,
+                    tokens=emitted, trips=trips)
             lane.tokens += emitted
-            if self.metrics is not None and emitted:
-                self.metrics.note_tokens(emitted)
+            if self.metrics is not None:
+                # per-dispatch accounting: the tokens-per-dispatch
+                # histogram is the direct readout of the fused-decode
+                # amortization (TPD ~1 at N=1, ~N when fused)
+                self.metrics.note_decode_dispatch(emitted)
+                if emitted:
+                    self.metrics.note_tokens(emitted)
             with self._cv:
                 self._cv.notify_all()
 

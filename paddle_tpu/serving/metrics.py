@@ -143,6 +143,13 @@ class ModelMetrics:
         self.decode_tokens = Counter()   # generated tokens emitted
         self.decode_steps = Counter()    # whole-slot-table step launches
         self.ttft_ms = ReservoirHistogram()  # time to first token
+        # fused multi-step decode (SERVING.md "Fused multi-step
+        # decode"): one dispatch now carries up to fuse_steps tokens
+        # per slot — dispatches and the tokens-per-dispatch histogram
+        # are the direct readout of the host-amortization win (TPD ~1
+        # at N=1, ~N·occupancy when fused; serving_top's TPD column)
+        self.decode_dispatches = Counter()  # device dispatches issued
+        self.tokens_per_dispatch = ReservoirHistogram()
         # speculative decoding (SERVING.md): drafts/accepts telemetry —
         # the accept rate IS the speedup dial (tokens per verify step =
         # 1 + accepted/round), and with a same-weights draft it doubles
@@ -250,6 +257,13 @@ class ModelMetrics:
             while self._ttft_stamps and \
                     self._ttft_stamps[0][0] < horizon:
                 self._ttft_stamps.popleft()
+
+    def note_decode_dispatch(self, tokens):
+        """One decode dispatch completed, having emitted `tokens`
+        stream tokens across its slots (0 counts too — an all-
+        cancelled window is still a dispatch the host paid for)."""
+        self.decode_dispatches.add()
+        self.tokens_per_dispatch.record(float(tokens))
 
     def note_tokens(self, n):
         """`n` generated tokens emitted (across whatever slots the step
@@ -383,6 +397,9 @@ class ModelMetrics:
             snap["prefills"] = self.prefills.value
             snap["decode_tokens"] = self.decode_tokens.value
             snap["decode_steps"] = self.decode_steps.value
+            snap["decode_dispatches"] = self.decode_dispatches.value
+            snap["tokens_per_dispatch"] = \
+                self.tokens_per_dispatch.summary()
             snap["tokens_per_sec"] = round(self.tokens_per_sec(), 3)
             snap["ttft_ms"] = self.ttft_ms.summary()
             if self.slot_occupancy_fn is not None:

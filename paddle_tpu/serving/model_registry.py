@@ -202,6 +202,7 @@ class ModelEntry:
             n_slots = self.batcher.n_slots
             spec_k = getattr(self.batcher, "spec_k", 0)
             drafts = getattr(self.batcher, "draft_replicas", None)
+            fuse = int(getattr(self.batcher, "fuse_steps", 1))
             for i, pred in enumerate(self.replicas):
                 sess = pred.new_session(n_slots)
                 for bucket in pred.prefill_buckets():
@@ -212,11 +213,19 @@ class ModelEntry:
                     sess.prefill(0, [0] * n)
                     sess.decode()
                     sess.free(0)
+                if fuse > 1 and not (drafts and spec_k):
+                    # fused lanes: force-resolve the (n_slots, N)
+                    # window executable so the first real dispatch
+                    # pays no compile (COMPILE_CACHE.md — the fused
+                    # fingerprint rides the warm-reload hits:N pin)
+                    pred.fused_step_fn(n_slots, fuse)
                 if drafts and spec_k:
                     # spec lanes: force-resolve the verify executable
                     # plus the draft's phases so the first real stream
                     # pays no compile on EITHER side of the flip
                     pred.verify_fn(n_slots, spec_k)
+                    if fuse > 1:
+                        pred.fused_spec_fn(drafts[i], n_slots, spec_k)
                     dsess = drafts[i].new_session(n_slots)
                     for bucket in drafts[i].prefill_buckets():
                         n = min(bucket, drafts[i].max_seq_len - 1)
@@ -334,7 +343,7 @@ class ModelRegistry:
                    buckets=None, drain_timeout=30.0, replicas=None,
                    devices=None, decode_slots=None, decode_mode=None,
                    precision=None, ab_weight=None, draft=None,
-                   spec_k=None, kv_cache_dtype=None):
+                   spec_k=None, kv_cache_dtype=None, fuse_steps=None):
         """Load (or hot-swap in) `path` as `name`.  Returns the entry.
         `replicas`/`devices` override the registry's default placement
         spec (see resolve_placement).  ALL replicas are built and
@@ -375,7 +384,15 @@ class ModelRegistry:
         artifact's decode_meta pin then FLAGS.serving_kv_cache_dtype.
         The admission fit check prices the requested cache dtype, and
         the compile cache fingerprints it, so fp32 and int8 loads
-        never share an executable."""
+        never share an executable.
+
+        `fuse_steps` (decode artifacts only, SERVING.md "Fused
+        multi-step decode"): each lane dispatch fuses up to this many
+        decode steps into ONE device executable (default
+        FLAGS.serving_decode_fuse_steps; 1 keeps the classic loop).
+        Streams stay bit-identical to N=1; warm() force-resolves the
+        fused-window executables so the flip pays no first-dispatch
+        compile."""
         from .. import compile_cache
         spec = devices if devices is not None else (
             replicas if replicas is not None else self._replicas)
@@ -395,8 +412,12 @@ class ModelRegistry:
                 else (FLAGS.serving_spec_draft or None)
             if not draft_path or spec_depth < 1:
                 draft_path, spec_depth = None, 0
+            fuse_steps = max(int(FLAGS.serving_decode_fuse_steps
+                                 if fuse_steps is None
+                                 else fuse_steps), 1)
         else:
             kv_cache_dtype = None
+            fuse_steps = None
         # admission fit check (ANALYSIS.md resource analysis): the
         # static per-replica peak estimate is checked against each
         # placement device's budget BEFORE any artifact build / clone /
@@ -422,7 +443,8 @@ class ModelRegistry:
                 max_queue=self._max_queue,
                 metrics=lane_metrics,
                 continuous=(decode_mode != "static"),
-                draft_replicas=draft_preds, spec_k=spec_depth)
+                draft_replicas=draft_preds, spec_k=spec_depth,
+                fuse_steps=fuse_steps)
         else:
             batcher = DynamicBatcher(
                 preds[0], max_queue=self._max_queue,
@@ -450,6 +472,8 @@ class ModelRegistry:
             "kv_cache_dtype": (str(getattr(preds[0], "kv_cache_dtype",
                                            "float32"))
                                if entry.is_decode else None),
+            "fuse_steps": (batcher.fuse_steps
+                           if entry.is_decode else None),
         }
         if placement == [None]:
             entry.load_spec["replicas"] = 1
@@ -740,6 +764,8 @@ class ModelRegistry:
                         info["kv_cache_dtype"] = str(getattr(
                             latest.predictor, "kv_cache_dtype",
                             "float32"))
+                        info["fuse_steps"] = int(getattr(
+                            latest.batcher, "fuse_steps", 1))
                         if getattr(latest.batcher, "spec_k", 0):
                             # speculative lanes: the draft + depth the
                             # operator tuned (SERVING.md)

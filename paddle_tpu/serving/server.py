@@ -363,7 +363,8 @@ class InferenceServer:
                 ab_weight=msg.get("ab_weight"),
                 draft=msg.get("draft"),
                 spec_k=msg.get("spec_k"),
-                kv_cache_dtype=msg.get("kv_cache_dtype"))
+                kv_cache_dtype=msg.get("kv_cache_dtype"),
+                fuse_steps=msg.get("fuse_steps"))
             if msg.get("fleet_policy"):
                 self.fleet.set_policy(entry.name,
                                       str(msg["fleet_policy"]))
@@ -387,6 +388,10 @@ class InferenceServer:
                 # (QUANTIZE.md "Quantized KV cache")
                 reply["kv_cache_dtype"] = str(getattr(
                     entry.predictor, "kv_cache_dtype", "float32"))
+                # fused multi-step decode window this load dispatches
+                # (SERVING.md "Fused multi-step decode"; 1 = classic)
+                reply["fuse_steps"] = int(getattr(
+                    entry.batcher, "fuse_steps", 1))
                 if getattr(entry.batcher, "spec_k", 0):
                     # speculative lanes armed: depth + draft artifact
                     reply["spec_k"] = entry.batcher.spec_k
@@ -729,7 +734,7 @@ class ServingClient:
                    replicas=None, devices=None, decode_slots=None,
                    decode_mode=None, precision=None, ab_weight=None,
                    draft=None, spec_k=None, kv_cache_dtype=None,
-                   fleet_policy=None):
+                   fuse_steps=None, fleet_policy=None):
         msg = {"cmd": "load_model", "name": name, "path": path}
         if fleet_policy is not None:
             # per-model fleet policy body riding the load (SERVING.md
@@ -745,6 +750,10 @@ class ServingClient:
             msg["draft"] = str(draft)
         if spec_k is not None:
             msg["spec_k"] = int(spec_k)
+        if fuse_steps is not None:
+            # fused multi-step decode window per dispatch (SERVING.md
+            # "Fused multi-step decode"; 1 keeps the classic loop)
+            msg["fuse_steps"] = int(fuse_steps)
         if version is not None:
             msg["version"] = version
         if precision is not None:

@@ -498,6 +498,197 @@ class TestDecodeCompileCache:
             fluid.set_flags(old)
             cc.reset_stats()
 
+    def test_fingerprint_covers_weights_not_just_meta(self, tmp_path):
+        """Two artifacts with IDENTICAL meta (same dims/vocab/eos) but
+        different weights must never resolve each other's persisted
+        executables: the int8 phases bake weight-derived kv scales as
+        trace constants, so a meta-only fingerprint let a stale
+        ("step", n) blob quantize one model's rows with another
+        model's scales (the cross-artifact cache-poisoning bug the
+        decode-disconnect-int8 chaos scenario caught)."""
+        a = str(tmp_path / "seed21")
+        b = str(tmp_path / "seed22")
+        kw = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                  max_seq_len=64, eos_id=-1)
+        build_tiny_decode_model(a, seed=21, **kw)
+        build_tiny_decode_model(b, seed=22, **kw)
+        pa = GenerativePredictor(a, kv_cache_dtype="int8")
+        pb = GenerativePredictor(b, kv_cache_dtype="int8")
+        assert pa.meta == pb.meta
+        assert pa._model_fp != pb._model_fp
+        # and the full phase fingerprints diverge too — the store can
+        # never hand one model the other's baked-scale executable
+        import jax
+        spec = (jax.ShapeDtypeStruct((2, 2, 64, 4, 8),
+                                     __import__("numpy").int8),)
+        fpa = pa._fingerprint(("step", 2), spec)
+        fpb = pb._fingerprint(("step", 2), spec)
+        assert fpa != fpb
+        # same artifact reopened: fingerprint is stable (warm reloads
+        # keep deserializing)
+        assert GenerativePredictor(
+            a, kv_cache_dtype="int8")._model_fp == pa._model_fp
+
+
+# ---------------------------------------------------------------------------
+# fused multi-step decode (SERVING.md "Fused multi-step decode")
+# ---------------------------------------------------------------------------
+
+class TestFusedDecode:
+    def test_fused_vs_single_step_churn_parity(self, predictor):
+        """The fused acceptance contract: a batcher dispatching N=8
+        steps per device call, with more requests than slots (joins and
+        leaves land at window boundaries), streams BIT-IDENTICAL tokens
+        to the single-step greedy oracle — and cuts dispatches ~N-fold
+        (decode_dispatches + tokens_per_dispatch tell the story)."""
+        metrics = ServingMetrics().model("lm")
+        b = DecodeBatcher(predictor, n_slots=2, metrics=metrics,
+                          fuse_steps=8)
+        rng = np.random.RandomState(1)
+        reqs = [[int(x) for x in rng.randint(1, 32, size=n)]
+                for n in (2, 5, 3, 7, 1, 4)]
+        budgets = [6, 3, 9, 2, 12, 7]
+        try:
+            streams = [b.submit(p, max_new_tokens=m)
+                       for p, m in zip(reqs, budgets)]
+            outs = [s.result(timeout=60)[0].tolist() for s in streams]
+        finally:
+            b.close()
+        for p, m, out in zip(reqs, budgets, outs):
+            ref, _ = greedy_decode(predictor, p, m)
+            assert out == ref, "fused stream diverged from N=1 oracle"
+        total = sum(len(o) for o in outs)
+        assert metrics.decode_tokens.value == total
+        dispatches = metrics.decode_dispatches.value
+        assert dispatches >= 1
+        # windows amortize: far fewer dispatches than tokens, and the
+        # histogram saw every dispatch
+        assert dispatches < total, (dispatches, total)
+        assert metrics.tokens_per_dispatch.count == dispatches
+
+    def test_fused_eos_early_exit_mid_window(self, predictor):
+        """A slot hitting EOS mid-window stops the while_loop early:
+        the dispatch returns fewer trips than the window, the EOS token
+        itself is emitted, and the stream equals the greedy oracle."""
+        # pick an eos id whose FIRST occurrence in the greedy stream is
+        # mid-window (index >= 4) so the early exit is provoked for
+        # real, not at the prefill token
+        probe, _ = greedy_decode(predictor, [5, 9, 3], 14)
+        j = next(i for i in range(4, len(probe))
+                 if probe[i] not in probe[:i])
+        eos_tok = int(probe[j])
+        import tempfile
+        d = tempfile.mkdtemp()
+        build_tiny_decode_model(d, vocab_size=32, d_model=16,
+                                n_heads=2, n_layers=2, max_seq_len=64,
+                                eos_id=eos_tok, seed=7)
+        p2 = GenerativePredictor(d)
+        ref, reason = greedy_decode(p2, [5, 9, 3], 50)
+        assert reason == "eos" and len(ref) == j + 1
+        sess = p2.new_session(2)
+        first = sess.prefill(0, [5, 9, 3])
+        n_window = j + 6   # EOS lands with trips to spare
+        toks, counts, trips = sess.decode_fused(n_window)
+        assert trips < n_window, \
+            "EOS mid-window did not early-exit the fused loop"
+        out = [first] + [int(toks[0, i]) for i in range(int(counts[0]))]
+        assert out == ref, "fused EOS stream diverged: %s vs %s" \
+            % (out, ref)
+        assert out[-1] == eos_tok
+
+    def test_fused_warm_reload_all_hits(self, artifact, tmp_path):
+        """The fused executables ride the persistent compile cache
+        under their own fingerprints: a second fuse_steps>1 load is
+        all hits, zero fresh compiles, same tokens."""
+        from paddle_tpu import compile_cache as cc
+        from paddle_tpu.serving import ModelRegistry
+        old = fluid.get_flags(["compile_cache", "compile_cache_dir"])
+        fluid.set_flags({"compile_cache": True,
+                         "compile_cache_dir": str(tmp_path / "cc")})
+        cc.reset_stats()
+        try:
+            reg = ModelRegistry()
+            reg.load_model("lm", artifact, decode_slots=2,
+                           fuse_steps=4)
+            cold = cc.stats()
+            assert cold["misses"] >= 2
+            reg.close_all()
+            before = cc.stats()
+            reg2 = ModelRegistry()
+            reg2.load_model("lm", artifact, decode_slots=2,
+                            fuse_steps=4)
+            delta = cc.stats_delta(before)
+            assert delta["misses"] == 0, delta
+            assert delta["hits"] >= cold["misses"], delta
+            out = reg2.submit("lm", {"tokens": [5, 9, 3]},
+                              max_new_tokens=6).result(timeout=60)
+            pred = GenerativePredictor(artifact)
+            ref, _ = greedy_decode(pred, [5, 9, 3], 6)
+            assert out[0].tolist() == ref
+            reg2.close_all()
+        finally:
+            fluid.set_flags(old)
+            cc.reset_stats()
+
+    def test_fused_deadline_overshoot_bounded(self, predictor):
+        """The satellite bugfix: deadline checks only fire between
+        dispatches, so the EWMA trip clamp must bound the overshoot to
+        about ONE fused dispatch — and the deadline_expired event
+        stamps `overshoot_ms`."""
+        from paddle_tpu.obs import events as obs_events
+        b = DecodeBatcher(predictor, n_slots=1, fuse_steps=4)
+        try:
+            # warm the fused executable first: the clamp guarantee is
+            # about steady-state step cost, not the one-off compile
+            b.submit([4, 4], max_new_tokens=8).result(timeout=60)
+            set_dispatch_delay(0.03)
+            s = b.submit([5, 9, 3], max_new_tokens=200,
+                         deadline=time.monotonic() + 0.25,
+                         trace_id="fdl-test")
+            with pytest.raises(DeadlineExceeded):
+                s.result(timeout=30)
+            assert len(s.tokens) >= 1
+            ev = [e for e in obs_events.recent_events(
+                kind="deadline_expired")
+                if e.get("trace_id") == "fdl-test"]
+            assert ev, "no deadline_expired event"
+            over = ev[-1].get("overshoot_ms")
+            assert over is not None, "event missing overshoot_ms"
+            # one fused dispatch is 4 x 30ms; generous host slack on
+            # top still proves the clamp beat the unclamped window tail
+            assert over <= 4 * 30.0 + 500.0, over
+        finally:
+            set_dispatch_delay(0.0)
+            b.close()
+
+
+def test_fused_gate_smoke(artifact, predictor):
+    """The ci_checks.sh `fused_decode` gate body (exit 17): a served
+    fuse_steps=4 stream is BIT-EXACT vs the N=1 greedy oracle and the
+    dispatch count amortizes (~N tokens per dispatch)."""
+    server = InferenceServer().start()
+    cli = ServingClient(server.endpoint)
+    try:
+        loaded = cli.load_model("lm", artifact, decode_slots=2,
+                                fuse_steps=4)
+        assert loaded.get("fuse_steps") == 4
+        for prompt, budget in [([5, 9, 3], 12), ([1, 2, 3, 4], 9)]:
+            ref, _ = greedy_decode(predictor, prompt, budget)
+            out = [t for c in cli.infer_stream(
+                "lm", prompt, max_new_tokens=budget,
+                deadline_ms=60000.0) for t in c]
+            assert out == ref, "fused served stream diverged"
+        snap = cli.stats()["stats"]["models"]["lm"]
+        assert snap["decode_dispatches"] >= 1
+        tpd = snap["decode_tokens"] / float(snap["decode_dispatches"])
+        assert tpd >= 2.0, \
+            "tokens/dispatch %.2f — fusion not amortizing" % tpd
+        desc = cli.stats()["models"]["lm"]
+        assert desc.get("fuse_steps") == 4
+    finally:
+        cli.close()
+        server.shutdown(drain=True)
+
 
 # ---------------------------------------------------------------------------
 # tools
@@ -515,6 +706,7 @@ def test_serving_top_renders_decode_columns(artifact, capsys):
         serving_top.main([server.endpoint])
         out = capsys.readouterr().out
         assert "TTFT95" in out and "TPS" in out and "OCC%" in out
+        assert "TPD" in out
         assert "decode_slots=2" in out
     finally:
         cli.close()
@@ -551,3 +743,15 @@ def test_chaos_decode_disconnect_scenario():
     res = chaos.scenario_decode_disconnect(verbose=False)
     assert res["freed_steps"] <= 6
     assert res["expired_tokens"] >= 1
+
+
+def test_chaos_decode_disconnect_fused_scenario():
+    """The fused-boundary chaos scenario: mid-window disconnects free
+    at the next dispatch boundary, deadline overshoot is clamped to
+    ~one fused dispatch with overshoot_ms stamped, reused slots stream
+    bit-exact (it asserts internally)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import chaos
+    res = chaos.scenario_decode_disconnect_fused(verbose=False)
+    assert res["freed_steps"] <= 3 * res["fuse_steps"]
+    assert res["overshoot_ms"] is not None

@@ -85,10 +85,11 @@ def predictor(artifact):
     return GenerativePredictor(artifact)
 
 
-def _drain_spec(sess, prompts, max_new):
+def _drain_spec(sess, prompts, max_new, fused=False):
     """Drive a SpeculativeDecodeSession to completion for `prompts`
     (slot i = prompt i); returns the per-prompt token streams with the
-    same per-token EOS/max-new cuts the serving loop applies."""
+    same per-token EOS/max-new cuts the serving loop applies.  `fused`
+    runs every round through the single-dispatch fused program."""
     eos = sess.predictor.eos_id
     streams = {i: [sess.prefill(i, p)] for i, p in enumerate(prompts)}
     done = {i for i, s in streams.items()
@@ -99,7 +100,7 @@ def _drain_spec(sess, prompts, max_new):
     while len(done) < len(prompts):
         rounds += 1
         assert rounds < 500, "speculative session wedged"
-        toks, counts = sess.step()
+        toks, counts = sess.step(fused=fused)
         for i in list(streams):
             if i in done:
                 continue
@@ -180,6 +181,39 @@ class TestSpeculativeSession:
         assert sess.proposed > 0
         assert sess.accepted == sess.proposed
         assert sess.rounds > 0 and sess.plain_steps == 0
+
+    def test_fused_round_twin_draft_bit_exact(self, artifact,
+                                              predictor):
+        """The fused speculative round (SERVING.md "Fused multi-step
+        decode"): k draft steps + the batched verify + in-graph
+        commit/rollback/catch-up compile into ONE dispatch.  Streams
+        must equal the host-driven rounds AND the N=1 greedy oracle,
+        with the twin draft accepting EXACTLY 1.0 — the bar that proves
+        the in-graph bookkeeping moved no token."""
+        prompts = [[3, 5, 7], [9, 4]]
+        refs = [greedy_decode(predictor, p, 24)[0] for p in prompts]
+        draft = GenerativePredictor(artifact)
+        sess = SpeculativeDecodeSession(predictor, draft, 2, spec_k=3)
+        streams = _drain_spec(sess, prompts, 24, fused=True)
+        assert streams == refs
+        assert sess.proposed > 0
+        assert sess.accepted == sess.proposed, \
+            "twin-draft accept under fusion must be exactly 1.0"
+        assert sess.rounds > 0 and sess.plain_steps == 0
+
+    def test_fused_round_mismatched_draft_rollback_bit_exact(
+            self, artifact, other_artifact, predictor):
+        """Fused rounds with a DISAGREEING draft: the in-graph rollback
+        (stale draft rows zeroed, pointers rewound) must keep streams
+        bit-exact, and the draft table must end IDENTICAL to the
+        host-driven session's after the same rounds."""
+        prompts = [[11, 12, 13, 14], [2]]
+        refs = [greedy_decode(predictor, p, 16)[0] for p in prompts]
+        draft = GenerativePredictor(other_artifact)
+        sess = SpeculativeDecodeSession(predictor, draft, 2, spec_k=2)
+        streams = _drain_spec(sess, prompts, 16, fused=True)
+        assert streams == refs
+        assert sess.accepted < sess.proposed
 
     def test_mismatched_draft_low_accept_still_bit_exact(
             self, artifact, other_artifact, predictor):
@@ -317,6 +351,31 @@ class TestSpecBatcher:
             assert snap["spec_accept_rate"] == 1.0
             assert snap["accept_rate"]["count"] == snap["spec_rounds"]
             assert snap["spec_degraded"] == 0
+        finally:
+            b.close(drain=False, timeout=5.0)
+
+    def test_spec_rides_fused_batcher_bit_exact(self, artifact,
+                                                predictor):
+        """spec_k>0 + fuse_steps>1: the lane routes rounds through the
+        fused spec program (one dispatch per round) and streams stay
+        bit-exact with accept exactly 1.0 on the twin draft."""
+        metrics = ServingMetrics().model("lm")
+        draft = GenerativePredictor(artifact)
+        b = DecodeBatcher(predictor, n_slots=2, metrics=metrics,
+                          draft=draft, spec_k=2, fuse_steps=4)
+        try:
+            prompts = [[3, 5, 7], [9, 4], [11, 12, 13, 14]]
+            budgets = [12, 7, 9]
+            streams = [b.submit(p, max_new_tokens=n)
+                       for p, n in zip(prompts, budgets)]
+            outs = [s.result(timeout=120)[0].tolist() for s in streams]
+            for p, n, out in zip(prompts, budgets, outs):
+                assert out == greedy_decode(predictor, p, n)[0]
+            snap = metrics.snapshot()
+            assert snap["spec_rounds"] > 0
+            assert snap["spec_accept_rate"] == 1.0
+            assert snap["spec_degraded"] == 0
+            assert snap["decode_dispatches"] > 0
         finally:
             b.close(drain=False, timeout=5.0)
 

@@ -431,9 +431,19 @@ def run_decode_lane(args, backend_label):
     step costs one `--step_cost_ms` like any target step.  Every point
     replays prompts against the fp32-only greedy stream and records
     `bit_exact` — speculation must never move one token.  Headline:
-    `tokens_per_sec_per_slot` at equal step cost, spec_k=N vs 0."""
+    `tokens_per_sec_per_slot` at equal step cost, spec_k=N vs 0.
+
+    Fused-decode sweep (SERVING.md "Fused multi-step decode"):
+    `--fuse_steps 1,4,16` pins the batcher's per-dispatch window per
+    point; `--host_cost_ms` charges the per-DISPATCH host round-trip
+    the window amortizes (once per dispatch, however many trips run).
+    Because the bit-exact replay goes through the loaded server, each
+    fused point PROVES its stream equals the N=1 greedy oracle before
+    any stand-in cost is armed.  Headline pair: tokens_per_sec_per_slot
+    at N vs 1, and `dispatches_per_token` <= 1/N·(1+eps)."""
     from paddle_tpu.serving import (InferenceServer, ServingClient,
-                                    set_dispatch_delay, set_draft_delay)
+                                    set_dispatch_delay, set_draft_delay,
+                                    set_host_delay)
     vocab = 64
     workdir = tempfile.mkdtemp(prefix="bench_serving_decode_")
     model_dir = build_decode_model(os.path.join(workdir, "lm"))
@@ -441,6 +451,10 @@ def run_decode_lane(args, backend_label):
              "both": ["static", "cb"]}[args.decode_mode]
     spec_points = [int(s) for s in args.spec_k.split(",")
                    if s.strip() != ""] if args.spec_k else [0]
+    # fused-decode sweep (SERVING.md "Fused multi-step decode"): one
+    # fresh server per window so the amortization curve is honest
+    fuse_points = [int(s) for s in args.fuse_steps.split(",")
+                   if s.strip() != ""] if args.fuse_steps else [1]
     # KV-cache dtype A/B (QUANTIZE.md "Quantized KV cache"): one fresh
     # server per cache dtype, identical seeded workloads — the ratio
     # columns read the 4x cache-byte cut directly
@@ -461,8 +475,9 @@ def run_decode_lane(args, backend_label):
         if args.qps else [8.0]
     duration = 6.0 if args.duration is None else args.duration
     for mode in modes:
-        for spec_k, kv_dtype in [(s, kv) for s in spec_points
-                                 for kv in kv_points]:
+        for spec_k, kv_dtype, fuse in [(s, kv, f) for s in spec_points
+                                       for kv in kv_points
+                                       for f in fuse_points]:
             server = InferenceServer(max_queue=args.max_queue).start()
             boot = ServingClient(server.endpoint)
             try:
@@ -476,6 +491,7 @@ def run_decode_lane(args, backend_label):
                     decode_mode="static" if mode == "static" else None,
                     draft=draft_dir, spec_k=spec_k if draft_dir else 0,
                     kv_cache_dtype=kv_dtype,
+                    fuse_steps=fuse if fuse > 1 else None,
                     replicas=args.replicas
                     if not args.replicas.isdigit()
                     or args.replicas != "1"
@@ -496,6 +512,11 @@ def run_decode_lane(args, backend_label):
                     set_dispatch_delay(args.step_cost_ms / 1000.0)
                     if spec_k > 0:
                         set_draft_delay(draft_cost_ms / 1000.0)
+                if args.host_cost_ms:
+                    # per-DISPATCH host cost: the round-trip the fused
+                    # window amortizes (charged once per dispatch
+                    # regardless of trips)
+                    set_host_delay(args.host_cost_ms / 1000.0)
                 for q in qps_points:
                     rec = run_decode_point(
                         server.endpoint, "lm", vocab, target_qps=q,
@@ -527,6 +548,22 @@ def run_decode_lane(args, backend_label):
                         "cold_start_ms": cold_start_ms,
                         "slot_occupancy": stats.get("slot_occupancy"),
                         "decode_steps": stats.get("decode_steps"),
+                        # fused-decode columns (SERVING.md "Fused
+                        # multi-step decode"): the dispatch-
+                        # amortization headline pair
+                        "fuse_steps": int(loaded.get("fuse_steps", 1)),
+                        "host_cost_ms": args.host_cost_ms,
+                        "decode_dispatches": stats.get(
+                            "decode_dispatches"),
+                        "tokens_per_dispatch": round(
+                            stats.get("decode_tokens", 0)
+                            / float(stats["decode_dispatches"]), 3)
+                        if stats.get("decode_dispatches") else None,
+                        "dispatches_per_token": round(
+                            stats["decode_dispatches"]
+                            / float(stats["decode_tokens"]), 4)
+                        if stats.get("decode_dispatches")
+                        and stats.get("decode_tokens") else None,
                         "server_tokens_per_sec": stats.get(
                             "tokens_per_sec"),
                         "compile_cache": loaded.get(
@@ -568,6 +605,7 @@ def run_decode_lane(args, backend_label):
             finally:
                 set_dispatch_delay(0.0)
                 set_draft_delay(0.0)
+                set_host_delay(0.0)
                 boot.close()
                 server.shutdown(drain=True)
 
@@ -977,6 +1015,22 @@ def main():
                          "a 1-core host by making capacity slot-bound; "
                          "a speculative VERIFY step costs exactly one "
                          "of these, like any target step")
+    ap.add_argument("--fuse_steps", default=None,
+                    help="fused multi-step decode sweep (SERVING.md "
+                         "\"Fused multi-step decode\"): comma list of "
+                         "per-dispatch windows ('1,4,16'); each point "
+                         "gets a fresh server with the batcher's "
+                         "fuse_steps pinned, a per-point bit-exact "
+                         "replay vs the N=1 greedy stream, and "
+                         "dispatches/tokens-per-dispatch columns — "
+                         "the host-floor amortization curve")
+    ap.add_argument("--host_cost_ms", type=float, default=0.0,
+                    help="deterministic per-DISPATCH host stall (GIL "
+                         "released): the stand-in for the host-side "
+                         "round-trip cost a fused window amortizes — "
+                         "pair with --step_cost_ms to reproduce the "
+                         "host-dominated regime where N-step fusion "
+                         "buys ~N/(1+N·step/host) per-slot throughput")
     ap.add_argument("--spec_k", default=None,
                     help="speculative-decoding sweep: comma list of "
                          "draft depths ('0,2,4,8'); 0 = target-only "

@@ -53,6 +53,15 @@ exits nonzero on failure):
                freed slots hold exact int8 zeros, replays compare
                against a direct int8-cache session — zero leakage and
                bit-stability survive quantization.
+  decode-disconnect-fused
+               fused-decode boundary chaos (SERVING.md "Fused
+               multi-step decode", fuse_steps=4): a disconnect
+               MID-FUSED-WINDOW frees the slot at the next dispatch
+               boundary (<= 3·N steps, zero wedged lanes), a deadline
+               expiry overshoots by at most ~one fused dispatch (the
+               EWMA trip clamp) with overshoot_ms stamped on the
+               deadline_expired event, and boundary-freed slots serve
+               bit-exact streams on reuse.
   spec-fallback
                speculative-decoding chaos (SERVING.md): poison the
                draft predictor MID-STREAM (set_draft_poison) — the
@@ -1021,6 +1030,164 @@ def scenario_decode_disconnect(verbose=True, kv_dtype=None):
             "kv_dtype": kv_dtype or "float32"}
 
 
+def scenario_decode_disconnect_fused(verbose=True, fuse_steps=4):
+    """Fused-decode boundary chaos (SERVING.md "Fused multi-step
+    decode"): with N steps compiled into one dispatch, slot joins,
+    leaves and deadline evictions only land at DISPATCH BOUNDARIES —
+    chaos mid-window must resolve at the next boundary, never wedge.
+
+    Phase A — disconnect mid-fused-window: a victim drops its
+    connection while a fused dispatch is in flight.  The flush of the
+    window's token block notices the dead socket; the NEXT boundary's
+    housekeeping frees the slot.  Invariants: the slot frees within a
+    couple of windows (<= 3·N decode steps), and later traffic on the
+    same slot table completes — zero wedged lanes.
+
+    Phase B — deadline expiry under fusion (the satellite bugfix):
+    deadline checks only fire between dispatches, so the per-dispatch
+    trip count is CLAMPED by the lane's step-EWMA and no stream may
+    overshoot its deadline by more than about one fused dispatch.  The
+    `deadline_expired` event must stamp `overshoot_ms`, and the
+    overshoot must be bounded — not the unclamped N-window tail.
+
+    Phase C — boundary-freed slots are clean: fresh requests reusing
+    the victims' slots stream bit-identical to a direct single-slot
+    session — the fused path zeroes freed rows exactly like N=1."""
+    import tempfile
+    from paddle_tpu.inference.decode import (GenerativePredictor,
+                                             build_tiny_decode_model,
+                                             greedy_decode)
+    from paddle_tpu.obs import events as obs_events
+    from paddle_tpu.serving import (DeadlineExceeded, InferenceServer,
+                                    ServingClient, set_dispatch_delay)
+
+    fuse = max(int(fuse_steps), 2)
+    md = build_tiny_decode_model(
+        os.path.join(tempfile.mkdtemp(prefix="chaos_fused_"), "lm"),
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+        max_seq_len=64, eos_id=-1, seed=21)
+    pred = GenerativePredictor(md)
+    server = InferenceServer().start()
+    boot = ServingClient(server.endpoint)
+    step_ms = 20.0
+
+    def occupancy():
+        snap = boot.stats()["stats"]["models"]["lm"]
+        return snap.get("decode_slots_busy", 0), snap.get(
+            "decode_steps", 0)
+
+    try:
+        boot.load_model("lm", md, decode_slots=2, fuse_steps=fuse)
+        # per-STEP stand-in: a full window stalls fuse*step_ms, so
+        # "mid-window" is unambiguous
+        set_dispatch_delay(step_ms / 1000.0)
+
+        # ---- phase A: disconnect mid-fused-window ------------------
+        victim = ServingClient(server.endpoint)
+        it = victim.infer_stream("lm", [3, 5, 7], max_new_tokens=48)
+        got = [t for _, t in zip(range(3), it)]
+        assert len(got) == 3, "victim stream never started"
+        busy_before, steps_at_drop = occupancy()
+        assert busy_before >= 1, "victim not occupying a slot"
+        it.close()       # drops the connection mid-window
+        victim.close()
+        t0 = time.time()
+        freed_steps = None
+        while time.time() - t0 < 10.0:
+            busy, steps = occupancy()
+            if busy == 0:
+                freed_steps = steps - steps_at_drop
+                break
+            time.sleep(0.01)
+        assert freed_steps is not None, \
+            "slot still occupied 10s after mid-window disconnect"
+        # the in-flight window finishes, its flush fails, the NEXT
+        # boundary's housekeeping frees the slot: a couple of windows
+        # of steps, never the stream's max_new tail
+        assert freed_steps <= 3 * fuse, \
+            ("slot took %d decode steps to free after mid-window "
+             "disconnect (fuse=%d — not boundary-freed)"
+             % (freed_steps, fuse))
+
+        # ---- phase B: deadline expiry at the boundary --------------
+        cli = ServingClient(server.endpoint)
+        tokens_before_expiry = 0
+        expired = False
+        try:
+            for chunk in cli.infer_stream("lm", [9, 4],
+                                          deadline_ms=200.0,
+                                          max_new_tokens=60,
+                                          trace_id="chaosfdl"):
+                tokens_before_expiry += len(chunk)
+        except DeadlineExceeded:
+            expired = True
+        finally:
+            cli.close()
+        assert expired, "deadline never expired mid-stream"
+        assert tokens_before_expiry >= 1, \
+            "stream expired before generating (not an IN-DECODE expiry)"
+        ev = [e for e in
+              obs_events.recent_events(kind="deadline_expired")
+              if e.get("trace_id") == "chaosfdl"]
+        assert ev, "no deadline_expired event with the stream's trace_id"
+        over = ev[-1].get("overshoot_ms")
+        assert over is not None, \
+            "deadline_expired event missing overshoot_ms"
+        # EWMA trip clamp: the overshoot is about ONE fused dispatch
+        # (+ host scheduling slack), not an unclamped fuse-step tail
+        assert over <= fuse * step_ms + 500.0, \
+            ("deadline overshoot %.1fms exceeds one fused dispatch "
+             "(fuse=%d x %.0fms) — trip clamp not engaged"
+             % (over, fuse, step_ms))
+
+        # ---- phase C: boundary-freed slots are clean ---------------
+        set_dispatch_delay(0.0)
+        prompts = [[3, 5, 7], [9, 4], [11, 12, 13, 14], [2]]
+        refs = [greedy_decode(pred, p, 12)[0] for p in prompts]
+        outs = [None] * len(prompts)
+        errs = []
+
+        def rerun(i):
+            c = ServingClient(server.endpoint)
+            try:
+                outs[i] = [t for ch in c.infer_stream(
+                    "lm", prompts[i], max_new_tokens=12,
+                    deadline_ms=60000.0) for t in ch]
+            except Exception as e:
+                errs.append(e)
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=rerun, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), \
+            "post-chaos traffic hung (wedged lane)"
+        assert not errs, "post-chaos traffic failed: %r" % errs[:2]
+        for i, (out, ref) in enumerate(zip(outs, refs)):
+            assert out == ref, \
+                ("KV leakage: reused slot changed request %d's tokens "
+                 "(%s vs %s)" % (i, out, ref))
+        busy, _ = occupancy()
+        assert busy == 0, "slots still occupied after drain"
+    finally:
+        set_dispatch_delay(0.0)
+        boot.close()
+        server.shutdown(drain=False, timeout=10.0)
+    if verbose:
+        print("PASS decode-disconnect-fused (N=%d): slot freed in %d "
+              "step(s) after mid-window disconnect, deadline evicted "
+              "with overshoot %.1fms (<= one dispatch), %d post-chaos "
+              "streams bit-exact on reused slots"
+              % (fuse, freed_steps, over, len(prompts)))
+    return {"freed_steps": freed_steps, "fuse_steps": fuse,
+            "overshoot_ms": over,
+            "expired_tokens": tokens_before_expiry}
+
+
 def scenario_spec_fallback(verbose=True):
     """Speculative-decoding chaos (SERVING.md "Speculative decoding"):
     the draft predictor dies MID-STREAM and the serving lane must
@@ -1739,6 +1906,7 @@ def main(argv=None):
                                            "trace-overflow",
                                            "decode-disconnect",
                                            "decode-disconnect-int8",
+                                           "decode-disconnect-fused",
                                            "spec-fallback",
                                            "slo-breach",
                                            "flash-crowd", "all"])
@@ -1788,6 +1956,7 @@ def main(argv=None):
                      "serving-overload", "cache-commit",
                      "quantize-commit", "trace-overflow",
                      "decode-disconnect", "decode-disconnect-int8",
+                     "decode-disconnect-fused",
                      "spec-fallback", "slo-breach", "flash-crowd"]
     else:
         scenarios = [args.scenario]
@@ -1828,6 +1997,8 @@ def main(argv=None):
             elif s == "decode-disconnect-int8":
                 # the same invariants under the QUANTIZED slot table
                 scenario_decode_disconnect(kv_dtype="int8")
+            elif s == "decode-disconnect-fused":
+                scenario_decode_disconnect_fused()
             elif s == "spec-fallback":
                 scenario_spec_fallback()
             elif s == "slo-breach":

@@ -35,6 +35,11 @@
 #                        zero dropped requests, where the static
 #                        control provably sheds (SERVING.md "Fleet
 #                        controller")
+#     17  fused_decode   fused multi-step decode smoke: the served
+#                        fuse_steps>1 stream must be BIT-EXACT vs the
+#                        N=1 greedy oracle, with dispatches cut ~N-fold
+#                        (SERVING.md "Fused multi-step decode",
+#                        tests/test_decode_serving.py)
 #      1  usage          unknown gate name
 #      0  all requested gates clean
 #
@@ -50,7 +55,8 @@ SPEC="${API_SPEC:-API.spec}"
 
 gates=("$@")
 if [ ${#gates[@]} -eq 0 ]; then
-    gates=(lint_runtime lint_program apispec specdec slo kernels fleet)
+    gates=(lint_runtime lint_program apispec specdec slo kernels fleet
+           fused_decode)
 fi
 
 for gate in "${gates[@]}"; do
@@ -104,10 +110,15 @@ for gate in "${gates[@]}"; do
             echo "== ci_checks: fleet gate =="
             "$PY" tools/chaos.py --scenario flash-crowd || exit 16
             ;;
+        fused_decode)
+            echo "== ci_checks: fused_decode gate =="
+            "$PY" -m pytest tests/test_decode_serving.py -q \
+                -k "fused_gate_smoke" -p no:cacheprovider || exit 17
+            ;;
         *)
             echo "ci_checks: unknown gate '$gate'" \
                  "(have: lint_runtime lint_program apispec specdec" \
-                 "slo kernels fleet)"
+                 "slo kernels fleet fused_decode)"
             exit 1
             ;;
     esac

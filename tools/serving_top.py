@@ -101,11 +101,11 @@ def render(reply, health=None, fleet=None):
     lines = ["server uptime %.0fs, %d model(s)"
              % (stats.get("uptime_sec", 0.0), len(models)), ""]
     hdr = ("%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
-           "%7s %7s %5s %5s %7s %6s %5s %6s"
+           "%7s %7s %5s %5s %5s %7s %6s %5s %6s"
            % ("MODEL", "PREC", "VER", "QPS", "REQS", "p50ms", "p95ms",
               "p99ms", "FILL", "BKT%", "QUEUE", "SHED", "CCH/M",
-              "TTFT95", "TPS", "OCC%", "ACC%", "SLO", "LIVE", "REPL",
-              "FLEET"))
+              "TTFT95", "TPS", "TPD", "OCC%", "ACC%", "SLO", "LIVE",
+              "REPL", "FLEET"))
     lines.append(hdr)
     lines.append("-" * len(hdr))
     described = set()
@@ -127,23 +127,28 @@ def render(reply, health=None, fleet=None):
         # decode models (SERVING.md continuous batching): TTFT p95,
         # aggregate tokens/sec, and slot occupancy; "-" otherwise.
         # ACC% is the speculative-decoding lifetime draft accept rate
-        # (absent without a draft — target-only lanes show "-")
+        # (absent without a draft — target-only lanes show "-").
+        # TPD is lifetime tokens-per-dispatch — the fused-decode
+        # amortization ratio (≈ fuse_steps when windows run full)
         ttft = (m.get("ttft_ms") or {}).get("p95")
         tps = m.get("tokens_per_sec")
+        dispatches = m.get("decode_dispatches")
+        tpd = (round(m.get("decode_tokens", 0) / float(dispatches), 1)
+               if dispatches else None)
         occ = m.get("slot_occupancy")
         acc = m.get("spec_accept_rate")
         slo_col, live_col = _health_cols(name, health)
         repl_col, fleet_col = _fleet_cols(name, desc, fleet)
         lines.append(
             "%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
-            "%7s %7s %5s %5s %7s %6s %5s %6s"
+            "%7s %7s %5s %5s %5s %7s %6s %5s %6s"
             % (plain[:14], prec[:5], _fmt(ver),
                _fmt(m.get("qps_recent")), _fmt(m.get("requests")),
                _fmt(lat.get("p50")), _fmt(lat.get("p95")),
                _fmt(lat.get("p99")), _fmt(m.get("batch_fill")),
                _fmt(round(100.0 * m.get("bucket_fill_ratio", 0.0), 1)),
                _fmt(m.get("queue_depth")), _fmt(m.get("shed")),
-               cc_col, _fmt(ttft), _fmt(tps),
+               cc_col, _fmt(ttft), _fmt(tps), _fmt(tpd),
                _fmt(round(100.0 * occ, 1) if isinstance(occ, float)
                     and occ >= 0 else None),
                _fmt(round(100.0 * acc, 1)
@@ -174,6 +179,8 @@ def render(reply, health=None, fleet=None):
             if d.get("decode"):
                 extra = " decode_slots=%s max_seq_len=%s" % (
                     d.get("decode_slots"), d.get("max_seq_len"))
+                if d.get("fuse_steps") and int(d["fuse_steps"]) > 1:
+                    extra += " fuse_steps=%s" % (d["fuse_steps"],)
                 if d.get("spec_k"):
                     extra += " spec_k=%s draft=%s" % (
                         d["spec_k"], d.get("draft"))

@@ -160,9 +160,14 @@ def main():
         # on chip the Pallas decode-attention kernel is the step cost),
         # re-measuring the BENCH_r10.json CPU-smoke ratio; larger slot
         # table since HBM, not host RAM, holds the slot caches
+        # --fuse_steps 1,4,16 (SERVING.md "Fused multi-step decode"):
+        # on silicon the per-dispatch host round-trip is REAL, so the
+        # fused windows read the true amortization curve — the CPU
+        # smoke (BENCH_r16.json) needs the --host_cost_ms stand-in
         ("decode", ["tools/bench_serving.py", "--require_tpu",
                     "--decode", "--decode_mode", "both",
                     "--decode_slots", "16", "--qps", "60",
+                    "--fuse_steps", "1,4,16",
                     "--duration", "15"], {}, 3600),
         # quantized-KV-cache A/B on silicon (QUANTIZE.md "Quantized KV
         # cache"): decode with the fp32 vs int8 slot table at REAL step

@@ -1,0 +1,24 @@
+"""Federated serving: membership leases, the front-door router/LB,
+and the fleet-of-fleets controller (SERVING.md "Federated serving").
+
+One `FrontendServer` endpoint fronts N `InferenceServer` backends over
+the existing wire protocol — backends register heartbeat-TTL leases
+(`MembershipRegistry`), clients keep using `ServingClient` unchanged,
+and the `GlobalFleetController` places per-model replica budgets and
+cluster-wide paging across hosts by the est_peak_mb capacity signal.
+"""
+
+from .membership import Lease, MembershipRegistry
+from .frontend import FrontendServer
+from .global_fleet import (GlobalFleetController, GlobalSensors,
+                           decide_global, place_by_capacity)
+
+__all__ = [
+    "Lease",
+    "MembershipRegistry",
+    "FrontendServer",
+    "GlobalFleetController",
+    "GlobalSensors",
+    "decide_global",
+    "place_by_capacity",
+]

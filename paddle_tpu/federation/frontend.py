@@ -1,0 +1,817 @@
+"""Front-door router/LB for a federation of InferenceServers.
+
+One endpoint, N backend servers, the SAME wire protocol on both sides:
+clients speak `infer` / `infer_stream` / `stats` / `health` / `flight`
+to the frontend exactly as they would to a single InferenceServer
+(ServingClient works unchanged), and the frontend forwards over the
+same length-prefixed typed framing (distributed/rpc.py) to the backends
+its membership table (membership.py) says are alive and accepting.
+
+Placement policy (SERVING.md "Federated serving"):
+
+* **least-loaded** — candidates are live, accepting leases with the
+  model resident; score = 2 x frontend-tracked in-flight + the
+  heartbeat-fed backend queue depth; ties break on backend id
+  (deterministic).
+* **session affinity** — a decode stream pins to the backend holding
+  its KV slots: the trace_id -> backend pin is taken at placement and
+  honored first on later streams with the same trace_id; a pin onto a
+  lost/draining backend re-pins onto the survivor set (counted —
+  ``repins``).
+* **spillover before shed** — a ``ServerOverloaded`` reply retries on
+  the next-least-loaded candidate carrying the SAME trace_id; only
+  when every candidate sheds does the client see "overloaded"
+  (``spillover`` vs ``shed`` counters).
+* **drain** — `drain backend=<id>`: the lease leaves the placement set
+  immediately (membership.mark_draining + the backend's own `drain`
+  verb), in-flight streams run to completion (the frontend tracks its
+  per-backend in-flight count), then the lease is de-leased
+  (``backend_drained`` event).  Draining is visibly distinct from
+  dead: the lease stays, `health` says accepting=False.
+* **global fault-in** — a request for a model resident on NO live
+  backend faults it in wherever capacity lives (prefer a backend
+  holding it paged — warm) by replaying the lane spec the frontend
+  persisted from `load_model` passthrough (global_fleet.py owns the
+  background version of this decision).
+
+A backend death mid-stream surfaces to the client as ONE terminal
+frame ``{"error", "code": "stream_broken", "done": True}`` carrying
+the chunk count already relayed — ServingClient raises the typed
+StreamBroken; tokens already delivered are real and are never
+replayed.  Subsequent traffic re-places within one heartbeat TTL
+(suspect-on-connect-failure makes it usually immediate).
+"""
+
+import collections
+import socket
+import socketserver
+import threading
+import time
+
+from ..distributed.rpc import _recv_msg, _send_msg
+from ..flags import FLAGS
+from ..native.wire import WireError
+from ..obs import tracing as obs_tracing
+from ..serving.batcher import DeadlineExceeded, ServerOverloaded
+from ..serving.server import (ServingClient, ServingError, StreamBroken,
+                              _error_reply)
+from .membership import MembershipRegistry
+
+__all__ = ["FrontendServer"]
+
+_CLOSE = object()
+
+# counters summed across backends when merging stats snapshots; the
+# histogram quantiles take the elementwise MAX (conservative — a
+# cross-server percentile cannot be recovered from per-server ones)
+_MERGE_SUM = ("requests", "responses", "errors", "shed",
+              "deadline_expired", "dispatches", "streams", "prefills",
+              "decode_tokens", "decode_steps", "decode_dispatches",
+              "spec_rounds", "draft_tokens", "accepted_tokens",
+              "spec_degraded", "queue_depth", "qps_recent",
+              "qps_lifetime", "tokens_per_sec", "kv_cache_bytes")
+_MERGE_MAX_HIST = ("latency_ms", "queue_wait_ms", "ttft_ms",
+                   "tokens_per_dispatch")
+
+
+def _ferror_reply(exc):
+    """Frontend error mapping: the serving table plus the federation
+    codes (a backend's typed reply re-raised by the forwarding client
+    keeps its code end to end)."""
+    if isinstance(exc, StreamBroken):
+        return {"error": str(exc), "code": "stream_broken"}
+    if isinstance(exc, ServingError) and getattr(exc, "code", None):
+        return {"error": str(exc), "code": exc.code}
+    return _error_reply(exc)
+
+
+class FrontendServer:
+    """The front door: membership + routing + the global fleet tier.
+
+    Speaks the backend-facing verbs (`register`/`heartbeat`/
+    `deregister` from _FederationLink) and the client-facing
+    passthrough verbs on ONE endpoint — a backend is just another wire
+    peer."""
+
+    AFFINITY_KEPT = 4096
+
+    def __init__(self, endpoint="127.0.0.1:0", ttl_s=None,
+                 global_fleet=None, global_policy=None,
+                 name="frontend"):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self.name = str(name)
+        self.membership = MembershipRegistry(ttl_s=ttl_s, name=name)
+        self._lock = threading.Lock()
+        self._clients = {}     # backend_id -> ServingClient
+        self._inflight = {}    # backend_id -> frontend in-flight count
+        self._placed = {}      # backend_id -> requests placed (counter)
+        self._counters = {"spillover": 0, "shed": 0,
+                          "streams_broken": 0, "repins": 0,
+                          "faulted": 0}
+        self._affinity = collections.OrderedDict()  # trace_id -> bid
+        self._draining = {}    # backend_id -> drain start (monotonic)
+        self._model_specs = {}  # model -> persisted load_model kwargs
+        self._want_global = (bool(FLAGS.global_fleet)
+                             if global_fleet is None
+                             else bool(global_fleet))
+        self._global_policy = global_policy
+        self.global_fleet = None
+        self._started_t = time.monotonic()
+        self._stopped = False
+        self._server = None
+        self._thread = None
+        self._sweeper = None
+        from ..obs import registry as obs_registry
+        self._obs_registry = obs_registry.default()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self, background=True):
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        if msg.get("cmd") == "infer_stream":
+                            outer._handle_infer_stream(msg, self.request)
+                            continue
+                        try:
+                            reply = outer._dispatch(
+                                msg, peer=self.client_address)
+                        except BaseException as e:
+                            reply = _ferror_reply(e)
+                        if reply is _CLOSE:
+                            _send_msg(self.request, {"ok": True})
+                            break
+                        try:
+                            _send_msg(self.request, reply)
+                        except WireError as e:
+                            _send_msg(self.request, {"error": str(e),
+                                                     "code": "internal"})
+                except WireError:
+                    pass  # desynced stream: drop the connection
+                except (ConnectionError, EOFError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+            request_queue_size = 128
+
+        self._server = Server(self._addr, Handler)
+        self._addr = self._server.server_address
+        self._obs_registry.attach_federation(self)
+        if self._want_global:
+            from .global_fleet import GlobalFleetController
+            self.global_fleet = GlobalFleetController(
+                self, policies=self._global_policy)
+            self.global_fleet.start()
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, daemon=True,
+            name="paddle-tpu-fed-sweeper")
+        self._sweeper.start()
+        if background:
+            self._thread = threading.Thread(target=self._serve,
+                                            daemon=True)
+            self._thread.start()
+        else:
+            self._serve()
+        return self
+
+    @property
+    def endpoint(self):
+        return "%s:%d" % (self._addr[0], self._addr[1])
+
+    def _serve(self):
+        self._server.timeout = 0.2
+        with self._server:
+            while not self._stopped:
+                self._server.handle_request()
+
+    def shutdown(self, timeout=10.0):
+        """Stop the front door (backends keep running — they notice
+        the missing frontend only as failed heartbeats and keep
+        serving direct traffic)."""
+        self._stopped = True
+        if self.global_fleet is not None:
+            self.global_fleet.stop()
+            self.global_fleet = None
+        self._obs_registry.detach_federation(self)
+        with self._lock:
+            clients, self._clients = dict(self._clients), {}
+        for cli in clients.values():
+            cli.close()
+        try:
+            s = socket.create_connection(self._addr, timeout=1)
+            s.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=2.0)
+
+    def _sweep_loop(self):
+        interval = min(max(self.membership.ttl_s / 4.0, 0.05), 1.0)
+        while not self._stopped:
+            time.sleep(interval)
+            try:
+                self.membership.sweep()
+                self._drain_progress()
+            except Exception:
+                pass  # the sweeper must never die
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def _client(self, bid, endpoint=None):
+        with self._lock:
+            cli = self._clients.get(bid)
+            if cli is None and endpoint:
+                cli = self._clients[bid] = ServingClient(endpoint)
+            return cli
+
+    def _drop_client(self, bid):
+        with self._lock:
+            cli = self._clients.pop(bid, None)
+        if cli is not None:
+            cli.close()
+
+    def _bump_inflight(self, bid, delta):
+        with self._lock:
+            self._inflight[bid] = max(
+                self._inflight.get(bid, 0) + delta, 0)
+
+    def _note_placed(self, bid):
+        with self._lock:
+            self._placed[bid] = self._placed.get(bid, 0) + 1
+
+    def _count(self, key, n=1):
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def _pin(self, trace_id, bid):
+        with self._lock:
+            self._affinity[trace_id] = bid
+            self._affinity.move_to_end(trace_id)
+            while len(self._affinity) > self.AFFINITY_KEPT:
+                self._affinity.popitem(last=False)
+
+    def _pinned(self, trace_id):
+        with self._lock:
+            return self._affinity.get(trace_id)
+
+    def _unpin(self, trace_id):
+        with self._lock:
+            self._affinity.pop(trace_id, None)
+
+    # -- placement -----------------------------------------------------
+
+    def _candidates(self, model=None):
+        """Live, accepting backends ordered least-loaded-first; with a
+        model, only backends where it is RESIDENT (a model nowhere
+        resident goes through the global fault-in path instead)."""
+        backs = self.membership.backends(accepting_only=True)
+        scored = []
+        with self._lock:
+            inflight = dict(self._inflight)
+        for bid, lease in backs.items():
+            if model is not None and str(model) not in lease["models"]:
+                continue
+            score = (2 * inflight.get(bid, 0)
+                     + int((lease.get("load") or {})
+                           .get("queue_depth") or 0))
+            scored.append((score, bid))
+        scored.sort()
+        return [bid for _, bid in scored]
+
+    def _fault_in(self, model, trigger="demand"):
+        """The model is resident on NO live backend: place it where
+        capacity lives (warm paged holder first), replaying the
+        persisted lane spec.  Returns the chosen backend as a 1-entry
+        candidate list, [] when nothing can host it."""
+        from ..obs import events as obs_events
+        from .global_fleet import place_by_capacity
+        model = str(model)
+        backs = self.membership.backends(accepting_only=True)
+        if not backs:
+            return []
+        paged = {bid: l for bid, l in backs.items()
+                 if model in (l.get("paged") or [])}
+        with self._lock:
+            spec = dict(self._model_specs.get(model) or {})
+        pool = paged or (backs if spec else {})
+        if not pool:
+            return []
+        bid = place_by_capacity(pool)
+        lease = backs[bid]
+        cli = self._client(bid, lease["endpoint"])
+        try:
+            if bid in paged:
+                cli.call({"cmd": "fault_model", "name": model,
+                          "trigger": "federation_%s" % trigger})
+            else:
+                cli.call(dict(spec, cmd="load_model", name=model))
+        except Exception:
+            return []
+        self._count("faulted")
+        obs_events.emit("global_fault_in", model=model, backend=bid,
+                        trigger=str(trigger), warm=bid in paged)
+        return [bid]
+
+    # -- routing: one-shot ---------------------------------------------
+
+    def _route_infer(self, msg):
+        model = msg.get("model")
+        trace_id = str(msg.get("trace_id")
+                       or obs_tracing.new_trace_id())
+        msg = dict(msg, trace_id=trace_id)
+        cands = self._candidates(model)
+        if not cands:
+            cands = self._fault_in(model)
+        if not cands:
+            raise KeyError("model %r is resident on no live backend"
+                           % (model,))
+        overloaded = None
+        for i, bid in enumerate(cands):
+            lease = self.membership.get(bid)
+            if lease is None:
+                continue
+            cli = self._client(bid, lease["endpoint"])
+            self._bump_inflight(bid, +1)
+            try:
+                reply = cli.call(msg)
+            except ServerOverloaded as e:
+                overloaded = e
+                if i + 1 < len(cands):
+                    # spillover before shed: the SAME trace_id retries
+                    # on the next-least-loaded backend
+                    self._count("spillover")
+                continue
+            except DeadlineExceeded:
+                raise
+            except (ConnectionError, EOFError, OSError,
+                    WireError) as e:
+                # hard transport evidence beats waiting out the TTL
+                self.membership.suspect(
+                    bid, "conn:%s" % type(e).__name__)
+                self._drop_client(bid)
+                continue
+            finally:
+                self._bump_inflight(bid, -1)
+            self._note_placed(bid)
+            reply["backend"] = bid
+            return reply
+        if overloaded is not None:
+            self._count("shed")
+            raise overloaded
+        raise ServingError(
+            "no live backend answered for model %r" % (model,))
+
+    # -- routing: streams ----------------------------------------------
+
+    def _handle_infer_stream(self, msg, sock):
+        """Relay one decode stream: place (affinity first), forward the
+        request on a dedicated backend connection, pump frames to the
+        client annotated with the serving backend id.  Backend death
+        mid-stream -> ONE terminal stream_broken frame (chunks already
+        relayed are committed — never replayed); overloaded before the
+        first chunk -> spillover to the next candidate, same
+        trace_id."""
+        trace_id = str(msg.get("trace_id")
+                       or obs_tracing.new_trace_id())
+        msg = dict(msg, trace_id=trace_id)
+        model = msg.get("model")
+
+        def terminal(exc):
+            reply = _ferror_reply(exc)
+            reply["done"] = True
+            reply["trace_id"] = trace_id
+            try:
+                _send_msg(sock, reply)
+            except (ConnectionError, EOFError, OSError, WireError):
+                pass
+
+        cands = self._candidates(model)
+        pin = self._pinned(trace_id)
+        if pin is not None:
+            if pin in cands:
+                # session affinity: the backend holding this session's
+                # KV slots serves it again
+                cands = [pin] + [b for b in cands if b != pin]
+            else:
+                # pinned backend lost/draining: re-pin onto survivors
+                self._count("repins")
+        if not cands:
+            cands = self._fault_in(model)
+        if not cands:
+            terminal(KeyError("model %r is resident on no live backend"
+                              % (model,)))
+            return
+        overloaded = None
+        for i, bid in enumerate(cands):
+            lease = self.membership.get(bid)
+            if lease is None:
+                continue
+            try:
+                bs = socket.create_connection(
+                    (lease["host"], lease["port"]),
+                    timeout=FLAGS.rpc_deadline)
+            except OSError:
+                self.membership.suspect(bid, "conn_refused")
+                continue
+            self._bump_inflight(bid, +1)
+            relayed = 0
+            try:
+                try:
+                    _send_msg(bs, msg)
+                except (ConnectionError, EOFError, OSError, WireError):
+                    self.membership.suspect(bid, "conn_reset")
+                    continue
+                self._pin(trace_id, bid)
+                while True:
+                    try:
+                        frame = _recv_msg(bs)
+                    except (ConnectionError, EOFError, OSError,
+                            WireError):
+                        # backend died MID-STREAM: its KV slots (and
+                        # this stream) are gone.  One typed terminal
+                        # frame; the relayed chunks stand.
+                        self.membership.suspect(bid, "stream")
+                        self._drop_client(bid)
+                        self._count("streams_broken")
+                        self._unpin(trace_id)
+                        _send_msg(sock, {
+                            "error": "backend %s lost mid-stream "
+                                     "after %d chunk(s)"
+                                     % (bid, relayed),
+                            "code": "stream_broken", "done": True,
+                            "trace_id": trace_id, "backend": bid,
+                            "chunks": relayed})
+                        return
+                    if frame.get("chunk"):
+                        frame["backend"] = bid
+                        # a send failure here = CLIENT died: propagate,
+                        # the finally closes the backend socket, which
+                        # is the backend's eviction signal
+                        _send_msg(sock, frame)
+                        relayed += 1
+                        continue
+                    # terminal frame
+                    if ("error" in frame
+                            and frame.get("code") == "overloaded"
+                            and relayed == 0
+                            and i + 1 < len(cands)):
+                        # nothing streamed yet: spillover, same trace
+                        self._count("spillover")
+                        self._unpin(trace_id)
+                        overloaded = frame
+                        break
+                    frame["backend"] = bid
+                    if "error" in frame:
+                        self._unpin(trace_id)
+                    else:
+                        self._note_placed(bid)
+                    _send_msg(sock, frame)
+                    return
+            finally:
+                self._bump_inflight(bid, -1)
+                try:
+                    bs.close()
+                except OSError:
+                    pass
+        if overloaded is not None:
+            self._count("shed")
+            overloaded = dict(overloaded, trace_id=trace_id, done=True)
+            try:
+                _send_msg(sock, overloaded)
+            except (ConnectionError, EOFError, OSError, WireError):
+                pass
+            return
+        terminal(ServingError(
+            "no live backend accepted stream for model %r" % (model,)))
+
+    # -- drain ---------------------------------------------------------
+
+    def _drain_progress(self):
+        """Sweeper hook: a draining backend whose frontend in-flight
+        count reached zero has finished its streams — de-lease it."""
+        from ..obs import events as obs_events
+        with self._lock:
+            draining = dict(self._draining)
+            inflight = dict(self._inflight)
+        for bid, t0 in draining.items():
+            if self.membership.get(bid) is None:
+                with self._lock:
+                    self._draining.pop(bid, None)
+                continue
+            if inflight.get(bid, 0) > 0:
+                continue
+            self.membership.deregister(bid, reason="drained")
+            self._drop_client(bid)
+            with self._lock:
+                self._draining.pop(bid, None)
+            obs_events.emit("backend_drained", backend=bid,
+                            drain_s=round(time.monotonic() - t0, 3))
+
+    # -- merged readouts -----------------------------------------------
+
+    def _merge_stats(self):
+        """One ServingMetrics-shaped snapshot across the federation:
+        counters sum, queue depths and QPS sum, percentiles take the
+        elementwise max (conservative — exact cross-server quantiles
+        are not recoverable from per-server summaries)."""
+        merged, desc, per_backend = {}, {}, {}
+        for bid, lease in self.membership.backends().items():
+            cli = self._client(bid, lease["endpoint"])
+            if cli is None:
+                continue
+            try:
+                r = cli.call({"cmd": "stats"})
+            except Exception:
+                continue
+            per_backend[bid] = {"endpoint": lease["endpoint"],
+                                "models": sorted(
+                                    (r.get("stats") or {})
+                                    .get("models") or ())}
+            for key, m in ((r.get("stats") or {})
+                           .get("models") or {}).items():
+                if key not in merged:
+                    merged[key] = dict(m)
+                    continue
+                out = merged[key]
+                for f in _MERGE_SUM:
+                    if m.get(f) is not None:
+                        out[f] = (out.get(f) or 0) + m[f]
+                for f in _MERGE_MAX_HIST:
+                    h = m.get(f)
+                    if not isinstance(h, dict):
+                        continue
+                    oh = out.setdefault(f, {})
+                    for q, v in h.items():
+                        if v is None:
+                            continue
+                        if q == "count":
+                            oh[q] = (oh.get(q) or 0) + v
+                        elif oh.get(q) is None or v > oh[q]:
+                            oh[q] = v
+            for name, d in (r.get("models") or {}).items():
+                if name not in desc:
+                    desc[name] = dict(d)
+                else:
+                    od = desc[name]
+                    od["replicas"] = ((od.get("replicas") or 0)
+                                      + (d.get("replicas") or 0))
+                    od["paged"] = bool(od.get("paged")) \
+                        and bool(d.get("paged"))
+                desc[name].setdefault("federated_on", []).append(bid)
+        return merged, desc, per_backend
+
+    def federation_status(self):
+        """The federation readout: membership table + routing counters
+        + per-backend placement/in-flight + the global tier's status —
+        rides the `stats` reply's "federation" key (serving_top) and
+        the `health` payload."""
+        st = self.membership.status()
+        with self._lock:
+            st["inflight"] = dict(self._inflight)
+            st["placed"] = dict(self._placed)
+            st["counters"] = dict(self._counters)
+            st["draining"] = sorted(self._draining)
+            st["models"] = sorted(self._model_specs)
+        st["endpoint"] = self.endpoint
+        if self.global_fleet is not None:
+            st["global_fleet"] = self.global_fleet.status()
+        return st
+
+    # -- verbs ---------------------------------------------------------
+
+    def _dispatch(self, msg, peer=None):
+        cmd = msg.get("cmd")
+        if cmd == "infer":
+            return self._route_infer(msg)
+        if cmd == "register":
+            host = msg.get("host") or (peer[0] if peer else "127.0.0.1")
+            grant = self.membership.register(
+                host, msg["port"], backend_id=msg.get("backend_id"),
+                models=msg.get("models"), paged=msg.get("paged"),
+                capacity_mb=msg.get("capacity_mb") or 0.0)
+            if msg.get("load") is not None:
+                self.membership.heartbeat(
+                    grant["backend_id"], grant["lease_id"],
+                    load=msg["load"])
+            self._client(grant["backend_id"],
+                         "%s:%d" % (host, int(msg["port"])))
+            return dict(grant, ok=True,
+                        heartbeat_ms=float(FLAGS.federation_heartbeat_ms))
+        if cmd == "heartbeat":
+            ok = self.membership.heartbeat(
+                msg["backend_id"], msg["lease_id"],
+                models=msg.get("models"), paged=msg.get("paged"),
+                accepting=msg.get("accepting"), load=msg.get("load"))
+            if not ok:
+                return {"error": "unknown or expired lease — "
+                                 "re-register", "code": "no_lease"}
+            return {"ok": True, "revision": self.membership.revision}
+        if cmd == "deregister":
+            self.membership.deregister(msg["backend_id"])
+            self._drop_client(msg["backend_id"])
+            return {"ok": True}
+        if cmd == "drain":
+            bid = str(msg["backend"])
+            lease = self.membership.get(bid)
+            if lease is None:
+                raise KeyError("no live backend %r" % bid)
+            self.membership.mark_draining(bid, not msg.get("resume"))
+            cli = self._client(bid, lease["endpoint"])
+            try:
+                cli.call({"cmd": "drain",
+                          "resume": bool(msg.get("resume"))})
+            except Exception:
+                pass  # lease state governs placement either way
+            with self._lock:
+                if msg.get("resume"):
+                    self._draining.pop(bid, None)
+                else:
+                    self._draining[bid] = time.monotonic()
+            return {"ok": True, "backend": bid,
+                    "draining": not msg.get("resume")}
+        if cmd == "stats":
+            merged, desc, per_backend = self._merge_stats()
+            fed = self.federation_status()
+            fed["per_backend"] = per_backend
+            return {"ok": True,
+                    "stats": {"uptime_sec": round(
+                        time.monotonic() - self._started_t, 3),
+                        "models": merged},
+                    "models": desc,
+                    "federation": fed}
+        if cmd == "health":
+            backends = {}
+            for bid, lease in self.membership.backends().items():
+                cli = self._client(bid, lease["endpoint"])
+                try:
+                    backends[bid] = cli.call({"cmd": "health"})["health"]
+                except Exception as e:
+                    backends[bid] = {"error": "%s: %s"
+                                     % (type(e).__name__, e)}
+            return {"ok": True, "health": {
+                "accepting": not self._stopped, "draining": False,
+                "frontend": True,
+                "federation": self.federation_status(),
+                "backends": backends}}
+        if cmd == "flight":
+            bundles, enabled = {}, False
+            for bid, lease in self.membership.backends().items():
+                cli = self._client(bid, lease["endpoint"])
+                try:
+                    r = cli.call({"cmd": "flight",
+                                  "reason": str(msg.get("reason")
+                                                or "federation_rpc"),
+                                  "force": bool(msg.get("force",
+                                                        True))})
+                    bundles[bid] = r.get("bundle")
+                    enabled = enabled or bool(r.get("enabled"))
+                except Exception:
+                    bundles[bid] = None
+            return {"ok": True, "bundles": bundles, "enabled": enabled,
+                    # a single-server caller reads "bundle": give it
+                    # the first committed path
+                    "bundle": next((p for p in bundles.values() if p),
+                                   None)}
+        if cmd == "fleet":
+            if msg.get("set_policy") or msg.get("dry_run") is not None:
+                if self.global_fleet is None:
+                    raise ValueError(
+                        "global fleet controller disabled — start the "
+                        "frontend with FLAGS.global_fleet=true")
+                for model, spec in dict(
+                        msg.get("set_policy") or {}).items():
+                    self.global_fleet.set_policy(str(model), str(spec))
+                if msg.get("dry_run") is not None:
+                    self.global_fleet.dry_run = bool(msg["dry_run"])
+            return {"ok": True,
+                    "fleet": (self.global_fleet.status()
+                              if self.global_fleet is not None
+                              else {"enabled": False, "global": True})}
+        if cmd == "metrics":
+            return {"ok": True,
+                    "text": self._obs_registry.prometheus_text()}
+        if cmd == "load_model":
+            return self._load_model(msg)
+        if cmd == "unload_model":
+            replies = {}
+            for bid, lease in self.membership.backends().items():
+                cli = self._client(bid, lease["endpoint"])
+                try:
+                    cli.call({"cmd": "unload_model",
+                              "name": msg["name"]})
+                    replies[bid] = {"ok": True}
+                except Exception as e:
+                    replies[bid] = {"error": str(e)}
+            with self._lock:
+                self._model_specs.pop(str(msg["name"]), None)
+            return {"ok": True, "backends": replies}
+        if cmd == "shutdown":
+            threading.Thread(target=self.shutdown,
+                             daemon=True).start()
+            return {"ok": True, "draining": True}
+        if cmd == "exit":
+            self._stopped = True
+            return _CLOSE
+        return {"error": "unknown cmd %r" % cmd, "code": "bad_request"}
+
+    def _load_model(self, msg):
+        """Fan the load to every live accepting backend (or the one
+        named by "backend") and PERSIST the lane spec — the global
+        fault-in path replays it wherever capacity lives later."""
+        name = str(msg["name"])
+        spec = {k: v for k, v in msg.items()
+                if k not in ("cmd", "backend")}
+        target = msg.get("backend")
+        backs = self.membership.backends(accepting_only=True)
+        if target is not None:
+            if str(target) not in backs:
+                raise KeyError("no live backend %r" % (target,))
+            backs = {str(target): backs[str(target)]}
+        if not backs:
+            raise ServingError("no live backend to load %r onto" % name)
+        replies, ok = {}, 0
+        for bid, lease in sorted(backs.items()):
+            cli = self._client(bid, lease["endpoint"])
+            try:
+                r = cli.call(dict(spec, cmd="load_model"))
+                replies[bid] = {k: v for k, v in r.items()}
+                ok += 1
+            except Exception as e:
+                replies[bid] = {"error": "%s: %s"
+                                % (type(e).__name__, e)}
+        if not ok:
+            raise ServingError(
+                "load_model(%s) failed on every backend: %r"
+                % (name, {b: r.get("error")
+                          for b, r in replies.items()}))
+        with self._lock:
+            self._model_specs[name] = spec
+        return {"ok": True, "name": name, "loaded": ok,
+                "backends": replies}
+
+    # -- exposition ----------------------------------------------------
+
+    def export(self):
+        """[(metric, labels, value, type)] rows for the obs registry's
+        attach_federation render: membership by state, placement /
+        spillover / shed / broken-stream counters, revision — plus the
+        global tier's rows."""
+        st = self.membership.status()
+        live = sum(1 for l in st["backends"].values()
+                   if not l["draining"])
+        draining = sum(1 for l in st["backends"].values()
+                       if l["draining"])
+        rows = [
+            ("federation_backends", {"state": "live"}, live, "gauge"),
+            ("federation_backends", {"state": "draining"}, draining,
+             "gauge"),
+            ("federation_backends", {"state": "lost"},
+             len(st["lost"]), "gauge"),
+            ("federation_revision", {}, st["revision"], "gauge"),
+        ]
+        with self._lock:
+            for bid, n in sorted(self._placed.items()):
+                rows.append(("federation_placed_total",
+                             {"backend": bid}, n, "counter"))
+            for key in sorted(self._counters):
+                rows.append(("federation_%s_total" % key, {},
+                             self._counters[key], "counter"))
+        if self.global_fleet is not None:
+            rows.extend(self.global_fleet.export())
+        return rows
+
+
+def main(argv=None):
+    """Run a front-door router as a process:
+    ``python -m paddle_tpu.federation.frontend --endpoint 0.0.0.0:9500``
+    — backends point FLAGS.federation_frontend at it."""
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--endpoint", default="127.0.0.1:9500")
+    ap.add_argument("--ttl_s", type=float, default=None)
+    ap.add_argument("--global_fleet", action="store_true")
+    ap.add_argument("--global_policy", default=None)
+    args = ap.parse_args(argv)
+    from ..serving.fleet import parse_fleet_spec
+    fe = FrontendServer(
+        endpoint=args.endpoint, ttl_s=args.ttl_s,
+        global_fleet=args.global_fleet or None,
+        global_policy=(parse_fleet_spec(args.global_policy)
+                       if args.global_policy else None))
+    print("federation frontend on %s" % args.endpoint)
+    fe.start(background=False)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,415 @@
+"""Fleet-of-fleets: the PR 15 controller promoted to the global tier.
+
+The per-server FleetController (serving/fleet.py) senses one server's
+queues and actuates one server's registry.  This controller senses the
+WHOLE federation — every backend's heartbeat already carries its
+resident models, per-model replica counts, queue depths, request
+counters and est_peak_mb (membership.py), so sensing is free: no RPC
+fan-out, the lease table IS the sensor bus — and actuates ACROSS
+hosts:
+
+* **global replica budgets** — one `[min_replicas, max_replicas]`
+  envelope per model counts replicas cluster-wide; scale-up places the
+  next replica on the host where capacity lives (`place_by_capacity`,
+  the PR 11 est_peak_mb fit/cost signal summed per lease), preferring
+  a host NOT yet holding the model (spread one model's budget across
+  hosts — the MLPerf TPU-pods idiom, arXiv 1909.09756); scale-down
+  removes from the host holding the most.
+* **cluster-wide paging** — a model idle past `page_ttl_s` everywhere
+  is paged out on EVERY resident backend; demand (or this controller,
+  on rising queues) faults it back in wherever capacity lives via the
+  lane specs the frontend persisted from `load_model` passthrough.
+
+The decision core (`decide_global`) is pure — seeded GlobalSensors in,
+FleetAction list out — mirroring serving/fleet.py's `decide` so tests
+drive it without sockets.  Policies reuse the exact `parse_fleet_spec`
+grammar (`[model:]key=val,...;...`, `*` default); the per-server
+controllers DELEGATE replica/paging actions to this tier when a
+frontend owns them (fleet.py `delegated_to`) so the two tiers never
+fight over the same knob.
+"""
+
+import threading
+import time
+
+from ..flags import FLAGS
+from ..obs import events as obs_events
+from ..serving.fleet import (FleetAction, _cool, parse_fleet_spec)
+
+__all__ = ["GlobalSensors", "GlobalFleetController", "decide_global",
+           "place_by_capacity"]
+
+
+def place_by_capacity(leases, prefer_absent=None):
+    """Pick the backend id where capacity lives: most free HBM
+    (declared capacity minus the Σ est_peak_mb x replicas resident
+    estimate) first; backends that declared NO capacity rank after
+    every declared one, least-resident first (unknown is not
+    infinite).  ``prefer_absent`` names a model — hosts not already
+    holding it win ties (spread the budget across hosts).  Ties break
+    on backend id: deterministic.  ``leases`` is the
+    MembershipRegistry.backends() snapshot {bid: lease_dict}."""
+    best_bid, best_key = None, None
+    for bid in sorted(leases):
+        lease = leases[bid]
+        cap = float(lease.get("capacity_mb") or 0.0)
+        resident = float(lease.get("resident_mb") or 0.0)
+        holds = (prefer_absent is not None
+                 and str(prefer_absent) in (lease.get("models") or {}))
+        if cap > 0.0:
+            key = (0, int(holds), -(cap - resident), bid)
+        else:
+            key = (1, int(holds), resident, bid)
+        if best_key is None or key < best_key:
+            best_bid, best_key = bid, key
+    return best_bid
+
+
+class GlobalSensors(object):
+    """One model's CLUSTER-WIDE sensor snapshot for one tick — plain
+    data so seeded instances drive ``decide_global`` in tests."""
+
+    __slots__ = ("model", "total_replicas", "resident", "paged_on",
+                 "queue_depth", "requests_delta", "idle_s",
+                 "est_peak_mb")
+
+    def __init__(self, model, total_replicas=0, resident=None,
+                 paged_on=(), queue_depth=0, requests_delta=0,
+                 idle_s=0.0, est_peak_mb=0.0):
+        self.model = str(model)
+        self.total_replicas = int(total_replicas)
+        self.resident = dict(resident or {})   # bid -> replicas
+        self.paged_on = sorted(paged_on or ())
+        self.queue_depth = int(queue_depth)
+        self.requests_delta = int(requests_delta)
+        self.idle_s = float(idle_s)
+        self.est_peak_mb = float(est_peak_mb)
+
+    def to_dict(self):
+        return {"model": self.model,
+                "total_replicas": self.total_replicas,
+                "resident": dict(self.resident),
+                "paged_on": list(self.paged_on),
+                "queue_depth": self.queue_depth,
+                "requests_delta": self.requests_delta,
+                "idle_s": round(self.idle_s, 3),
+                "est_peak_mb": round(self.est_peak_mb, 3)}
+
+
+def decide_global(sensors, policy, state, now):
+    """Pure global decision core: cluster sensors + policy envelope +
+    cooldown state -> ordered FleetAction list.  Kinds: ``fault_in``
+    (paged everywhere, demand arriving), ``scale_up``/``scale_down``
+    (global replica total vs the budget), ``page_out`` (idle past TTL
+    everywhere).  ``state`` is read-only here; the controller stamps
+    cooldowns only after an action actually executes."""
+    acts = []
+    if sensors is None or policy is None:
+        return acts
+    s = sensors
+    if s.total_replicas == 0:
+        # cold everywhere: demand faults it in where capacity lives
+        if s.paged_on and (s.requests_delta > 0 or s.queue_depth > 0):
+            acts.append(FleetAction(
+                "fault_in", s.model,
+                signal=dict(s.to_dict(), trigger="demand",
+                            tier="global")))
+        return acts
+    if (s.queue_depth >= policy.scale_up_queue
+            and s.total_replicas < policy.max_replicas
+            and _cool(state, "last_scale_t", now,
+                      policy.scale_cooldown_s)):
+        acts.append(FleetAction(
+            "scale_up", s.model,
+            params={"to": s.total_replicas + 1},
+            signal=dict(s.to_dict(), trigger="queue_depth",
+                        tier="global")))
+    elif (s.idle_s >= policy.scale_down_idle_s
+            and s.total_replicas > policy.min_replicas
+            and s.requests_delta == 0
+            and _cool(state, "last_scale_t", now,
+                      policy.scale_cooldown_s)):
+        acts.append(FleetAction(
+            "scale_down", s.model,
+            params={"to": s.total_replicas - 1},
+            signal=dict(s.to_dict(), trigger="idle",
+                        tier="global")))
+    if (policy.page_ttl_s > 0.0 and s.idle_s >= policy.page_ttl_s
+            and s.requests_delta == 0 and s.queue_depth == 0
+            and _cool(state, "last_page_t", now,
+                      policy.page_cooldown_s)):
+        acts.append(FleetAction(
+            "page_out", s.model,
+            signal=dict(s.to_dict(), trigger="page_ttl",
+                        tier="global")))
+    return acts
+
+
+class GlobalFleetController(object):
+    """Sense from the membership lease table, decide with the pure
+    core, actuate over the wire through the frontend's per-backend
+    clients.  Owned and started by FrontendServer when
+    ``FLAGS.global_fleet`` is set (the `fleet` verb against the
+    frontend reads/configures it)."""
+
+    HISTORY_KEPT = 64
+
+    def __init__(self, frontend, policies=None, eval_interval_s=None,
+                 dry_run=None):
+        self.frontend = frontend
+        if policies is None:
+            policies = parse_fleet_spec(FLAGS.global_fleet_policy)
+        self.policies = dict(policies or {})
+        self.eval_interval_s = (
+            max(float(FLAGS.global_fleet_eval_interval_ms), 10.0)
+            / 1000.0
+            if eval_interval_s is None else float(eval_interval_s))
+        self.dry_run = (bool(FLAGS.fleet_dry_run) if dry_run is None
+                        else bool(dry_run))
+        self._lock = threading.Lock()
+        self._state = {}          # model -> {"last_scale_t", ...}
+        self._last_requests = {}  # model -> cluster request total
+        self._last_active = {}    # model -> monotonic t of last delta
+        self._last_sense = {}     # model -> GlobalSensors.to_dict()
+        self._acted = {}          # kind -> count
+        self._ticks = 0
+        self._history = []
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- policy --------------------------------------------------------
+
+    def policy_for(self, model):
+        return self.policies.get(str(model)) or self.policies.get("*")
+
+    def set_policy(self, model, spec):
+        """`fleet set_policy` against the frontend: one model's (or
+        ``*``'s) envelope, serving_slo grammar, replaces wholesale."""
+        parsed = parse_fleet_spec(spec)
+        with self._lock:
+            if list(parsed) == ["*"] and str(model) != "*":
+                self.policies[str(model)] = parsed["*"]
+            else:
+                self.policies.update(parsed)
+
+    # -- sense ---------------------------------------------------------
+
+    def sense(self, now=None):
+        """{model: GlobalSensors} straight from the lease table — the
+        heartbeats already carried every number this needs."""
+        now = time.monotonic() if now is None else now
+        leases = self.frontend.membership.backends()
+        per = {}
+        for bid, lease in leases.items():
+            for name, m in (lease.get("models") or {}).items():
+                s = per.setdefault(name, GlobalSensors(name))
+                reps = max(int(m.get("replicas") or 1), 1)
+                s.total_replicas += reps
+                s.resident[bid] = reps
+                s.queue_depth += int(m.get("queue_depth") or 0)
+                s.est_peak_mb = max(s.est_peak_mb,
+                                    float(m.get("est_peak_mb") or 0.0))
+            for name in (lease.get("paged") or ()):
+                s = per.setdefault(name, GlobalSensors(name))
+                if bid not in s.paged_on:
+                    s.paged_on = sorted(set(s.paged_on) | {bid})
+        # request deltas + idle clocks from the cluster-wide totals
+        totals = {}
+        for lease in leases.values():
+            for name, m in (lease.get("models") or {}).items():
+                totals[name] = (totals.get(name, 0)
+                                + int(m.get("requests") or 0))
+        for name, s in per.items():
+            total = totals.get(name, 0)
+            prev = self._last_requests.get(name)
+            delta = 0 if prev is None else max(total - prev, 0)
+            self._last_requests[name] = total
+            s.requests_delta = delta
+            if delta > 0 or prev is None:
+                self._last_active[name] = now
+            s.idle_s = now - self._last_active.get(name, now)
+            self._last_sense[name] = s.to_dict()
+        return per
+
+    # -- actuate -------------------------------------------------------
+
+    def _backend_call(self, bid, msg):
+        lease = self.frontend.membership.get(bid)
+        if lease is None:
+            raise KeyError("backend %s lost before actuation" % bid)
+        cli = self.frontend._client(bid, lease["endpoint"])
+        return cli.call(msg)
+
+    def _execute(self, action, sensors):
+        """One decided action, over the wire.  Placement happens HERE
+        (not in decide): the lease table may have changed since the
+        decision, so the capacity ranking reads a fresh snapshot."""
+        kind, model = action.kind, action.model
+        accepting = self.frontend.membership.backends(
+            accepting_only=True)
+        if kind == "fault_in":
+            placed = self.frontend._fault_in(model, trigger="fleet")
+            if not placed:
+                raise KeyError("no host with capacity for %r" % model)
+            return {"backend": placed[0]}
+        if kind == "scale_up":
+            bid = place_by_capacity(accepting, prefer_absent=model)
+            if bid is None:
+                raise KeyError("no accepting backend to scale %r onto"
+                               % model)
+            cur = int(sensors.resident.get(bid, 0))
+            if cur > 0:
+                self._backend_call(bid, {"cmd": "resize_model",
+                                         "name": model,
+                                         "replicas": cur + 1})
+            elif model in (accepting[bid].get("paged") or ()):
+                self._backend_call(bid, {"cmd": "fault_model",
+                                         "name": model,
+                                         "trigger": "global_scale_up"})
+            else:
+                with self.frontend._lock:
+                    spec = dict(self.frontend._model_specs.get(model)
+                                or {})
+                if not spec:
+                    raise KeyError(
+                        "no persisted lane spec to place %r on %s"
+                        % (model, bid))
+                spec.update(cmd="load_model", name=model, replicas=1)
+                self._backend_call(bid, spec)
+            return {"backend": bid, "from": cur}
+        if kind == "scale_down":
+            if not sensors.resident:
+                raise KeyError("%r resident nowhere" % model)
+            # shrink where the most replicas live (ties: backend id)
+            bid = max(sorted(sensors.resident),
+                      key=lambda b: sensors.resident[b])
+            cur = int(sensors.resident[bid])
+            if cur > 1:
+                self._backend_call(bid, {"cmd": "resize_model",
+                                         "name": model,
+                                         "replicas": cur - 1})
+            else:
+                # last replica on this host: page (keeps the spec warm
+                # for a rejoin) rather than unload
+                self._backend_call(bid, {"cmd": "page_model",
+                                         "name": model})
+            return {"backend": bid, "from": cur}
+        if kind == "page_out":
+            paged = []
+            for bid in sorted(sensors.resident):
+                try:
+                    self._backend_call(bid, {"cmd": "page_model",
+                                             "name": model})
+                    paged.append(bid)
+                except Exception:
+                    continue
+            if not paged:
+                raise KeyError("paged %r nowhere" % model)
+            return {"backends": paged}
+        raise ValueError("unknown global action %r" % kind)
+
+    # -- tick ----------------------------------------------------------
+
+    def tick(self, now=None):
+        """One sense -> decide -> act pass; returns the processed
+        [(action, outcome)] list.  Every decision is evented
+        (``global_fleet_decision``) whether executed, dry-run, or
+        failed — the acceptance idiom the per-server tier set."""
+        now = time.monotonic() if now is None else now
+        sensed = self.sense(now)
+        plan = []
+        with self._lock:
+            self._ticks += 1
+            for model, s in sorted(sensed.items()):
+                policy = self.policy_for(model)
+                state = self._state.setdefault(model, {})
+                for act in decide_global(s, policy, state, now):
+                    plan.append((act, s))
+        processed = []
+        for act, s in plan:
+            if self.dry_run:
+                outcome = "dry_run"
+            else:
+                try:
+                    detail = self._execute(act, s)
+                    outcome = "ok"
+                    act.params.update(detail or {})
+                    with self._lock:
+                        st = self._state.setdefault(act.model, {})
+                        if act.kind in ("scale_up", "scale_down"):
+                            st["last_scale_t"] = now
+                        elif act.kind in ("page_out", "fault_in"):
+                            st["last_page_t"] = now
+                except Exception as e:
+                    outcome = "error:%s" % type(e).__name__
+            with self._lock:
+                self._acted[act.kind] = self._acted.get(act.kind, 0) \
+                    + (0 if self.dry_run else 1)
+                self._history.append(
+                    dict(act.to_dict(), outcome=outcome))
+                del self._history[:-self.HISTORY_KEPT]
+            obs_events.emit("global_fleet_decision", tier="global",
+                            action=act.kind, model=act.model,
+                            outcome=outcome, params=dict(act.params),
+                            signal=dict(act.signal))
+            processed.append((act, outcome))
+        return processed
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="paddle-tpu-global-fleet")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.eval_interval_s):
+            try:
+                self.tick()
+            except Exception:
+                pass  # the controller loop must never die
+
+    def stop(self, timeout=2.0):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=timeout)
+
+    # -- exposition ----------------------------------------------------
+
+    def status(self):
+        with self._lock:
+            return {
+                "enabled": True, "global": True,
+                "dry_run": bool(self.dry_run),
+                "interval_ms": round(self.eval_interval_s * 1e3, 3),
+                "ticks": self._ticks,
+                "actions": dict(self._acted),
+                "policies": {m: p.to_dict()
+                             for m, p in sorted(self.policies.items())},
+                "models": {m: dict(d) for m, d in
+                           sorted(self._last_sense.items())},
+                "history": [dict(h) for h in self._history[-8:]]}
+
+    def export(self):
+        """Prometheus rows riding the frontend's attach_federation."""
+        with self._lock:
+            rows = [("global_fleet_ticks_total", {}, self._ticks,
+                     "counter")]
+            for kind in sorted(self._acted):
+                rows.append(("global_fleet_actions_total",
+                             {"kind": kind}, self._acted[kind],
+                             "counter"))
+            for model, d in sorted(self._last_sense.items()):
+                rows.append(("global_fleet_replicas",
+                             {"model": model}, d["total_replicas"],
+                             "gauge"))
+                rows.append(("global_fleet_paged", {"model": model},
+                             int(d["total_replicas"] == 0
+                                 and bool(d["paged_on"])), "gauge"))
+        return rows

@@ -1,0 +1,311 @@
+"""Membership registry: heartbeat-TTL leases for the federation tier.
+
+The etcd-backed go/master + go/pserver membership layer of the
+reference EDL design, rebuilt in-process: a backend server registers a
+**lease** — ``{host, port, models, capacity}`` plus a TTL — and renews
+it by heartbeating.  A lease whose heartbeat goes missing past its TTL
+expires: the backend drops out of the placement set and a
+``backend_lost`` obs event fires (``backend_joined`` on register, with
+``rejoin=True`` when the same backend id returns after a loss — the
+elastic-membership cycle the TensorFlow system paper's dynamic
+discovery design sketches, arXiv 1605.08695).
+
+The registry is deliberately serving-agnostic bones: members are
+``(id, endpoint, ttl, payload)`` with a monotonic **revision** counter
+bumped on every membership change (the etcd idiom — a watcher compares
+revisions instead of diffing tables), so the elastic-training roadmap
+item can lease trainers/pservers through the same class.  The serving
+payload (resident models, paged models, capacity_mb, queue depth) is
+carried opaquely in ``models``/``paged``/``capacity_mb``/``load`` and
+interpreted only by the frontend's placement logic (frontend.py).
+
+Drain is a first-class lease state, distinct from loss: a draining
+backend keeps heartbeating (it is alive, finishing streams) but is
+excluded from placement; de-leasing it after its in-flight work ends
+is the frontend's job (``backend_drained`` event).  `health` carrying
+``accepting: False`` and a live lease means "draining", a missing
+lease means "dead" — serving_top renders the two differently.
+"""
+
+import threading
+import time
+
+__all__ = ["Lease", "MembershipRegistry"]
+
+
+class Lease(object):
+    """One member's registration: identity, endpoint, TTL bookkeeping,
+    and the opaque serving payload the frontend places by."""
+
+    __slots__ = ("backend_id", "lease_id", "host", "port", "models",
+                 "paged", "capacity_mb", "ttl_s", "registered_t",
+                 "renewed_t", "accepting", "draining", "load", "meta")
+
+    def __init__(self, backend_id, lease_id, host, port, models=(),
+                 paged=(), capacity_mb=0.0, ttl_s=3.0, meta=None,
+                 now=None):
+        now = time.monotonic() if now is None else now
+        self.backend_id = str(backend_id)
+        self.lease_id = str(lease_id)
+        self.host = str(host)
+        self.port = int(port)
+        self.models = dict(models or {})   # name -> {"replicas", ...}
+        self.paged = list(paged or ())
+        self.capacity_mb = float(capacity_mb or 0.0)
+        self.ttl_s = float(ttl_s)
+        self.registered_t = now
+        self.renewed_t = now
+        self.accepting = True
+        self.draining = False
+        self.load = {}                     # heartbeat-fed load snapshot
+        self.meta = dict(meta or {})
+
+    @property
+    def endpoint(self):
+        return "%s:%d" % (self.host, self.port)
+
+    def age_s(self, now=None):
+        now = time.monotonic() if now is None else now
+        return max(now - self.renewed_t, 0.0)
+
+    def expired(self, now=None):
+        return self.age_s(now) > self.ttl_s
+
+    def resident_mb(self):
+        """Estimated HBM resident across this backend's models — the
+        PR 11 est_peak_mb cost signal summed over replicas, fed by the
+        heartbeat; the placement-by-capacity input."""
+        total = 0.0
+        for m in self.models.values():
+            per = float(m.get("est_peak_mb") or 0.0)
+            total += per * max(int(m.get("replicas") or 1), 1)
+        return total
+
+    def free_mb(self):
+        """Declared capacity minus resident estimate (None when the
+        backend declared no capacity — unknown, not zero)."""
+        if self.capacity_mb <= 0.0:
+            return None
+        return self.capacity_mb - self.resident_mb()
+
+    def to_dict(self, now=None):
+        return {"backend_id": self.backend_id,
+                "lease_id": self.lease_id,
+                "host": self.host, "port": self.port,
+                "endpoint": self.endpoint,
+                "models": {k: dict(v) for k, v in self.models.items()},
+                "paged": list(self.paged),
+                "capacity_mb": self.capacity_mb,
+                "resident_mb": round(self.resident_mb(), 3),
+                "ttl_s": self.ttl_s,
+                "age_s": round(self.age_s(now), 3),
+                "accepting": bool(self.accepting),
+                "draining": bool(self.draining),
+                "load": dict(self.load),
+                "meta": dict(self.meta)}
+
+
+class MembershipRegistry(object):
+    """TTL-lease member table with a monotonic revision counter.
+
+    Every mutation (join, leave, loss, drain flip) bumps ``revision``;
+    reads sweep expired leases first, so a caller never places onto a
+    lease that stopped heartbeating more than one sweep ago.  Lost
+    members are kept (bounded) in a shadow table so operators can tell
+    "died 4s ago" from "never existed"."""
+
+    LOST_KEPT = 32
+
+    def __init__(self, ttl_s=None, name="frontend"):
+        from ..flags import FLAGS
+        self.ttl_s = (float(FLAGS.federation_ttl_s) if ttl_s is None
+                      else float(ttl_s))
+        self.ttl_s = max(self.ttl_s, 0.05)
+        self.name = str(name)
+        self._lock = threading.Lock()
+        self._leases = {}      # backend_id -> Lease
+        self._lost = {}        # backend_id -> {"reason", "t", ...}
+        self._revision = 0
+        self._seq = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def register(self, host, port, backend_id=None, models=None,
+                 paged=None, capacity_mb=0.0, ttl_s=None, meta=None):
+        """Grant (or re-grant) a lease.  Returns the wire-encodable
+        grant: {"backend_id", "lease_id", "ttl_s", "revision"}.
+        Re-registering an id that is currently LOST is the rejoin path
+        — same id, fresh lease, ``backend_joined`` with rejoin=True."""
+        from ..obs import events as obs_events
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_locked(now)
+            bid = str(backend_id or "%s:%s" % (host, port))
+            self._seq += 1
+            lease = Lease(bid, "ls-%d" % self._seq, host, port,
+                          models=models, paged=paged,
+                          capacity_mb=capacity_mb,
+                          ttl_s=self.ttl_s if ttl_s is None else ttl_s,
+                          meta=meta, now=now)
+            rejoin = bid in self._lost or bid in self._leases
+            self._lost.pop(bid, None)
+            self._leases[bid] = lease
+            self._revision += 1
+            rev = self._revision
+        obs_events.emit("backend_joined", backend=bid,
+                        endpoint=lease.endpoint, rejoin=bool(rejoin),
+                        capacity_mb=lease.capacity_mb, revision=rev)
+        return {"backend_id": bid, "lease_id": lease.lease_id,
+                "ttl_s": lease.ttl_s, "revision": rev}
+
+    def heartbeat(self, backend_id, lease_id, models=None, paged=None,
+                  accepting=None, load=None):
+        """Renew one lease; the serving payload rides along (resident
+        models + est_peak_mb, paged set, queue depth) so placement and
+        the global controller sense without extra RPC fan-out.
+        Returns False for an unknown/stale lease — the backend must
+        re-register (the rejoin path), never silently keep serving on
+        a lease the frontend already declared lost."""
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_locked(now)
+            lease = self._leases.get(str(backend_id))
+            if lease is None or lease.lease_id != str(lease_id):
+                return False
+            lease.renewed_t = now
+            if models is not None:
+                lease.models = {str(k): dict(v)
+                                for k, v in dict(models).items()}
+            if paged is not None:
+                lease.paged = [str(p) for p in paged]
+            if accepting is not None:
+                lease.accepting = bool(accepting)
+            if load is not None:
+                lease.load = dict(load)
+            return True
+
+    def deregister(self, backend_id, reason="deregister"):
+        """Clean leave (drain completed / operator removal): the lease
+        goes away without entering the lost table."""
+        from ..obs import events as obs_events
+        with self._lock:
+            lease = self._leases.pop(str(backend_id), None)
+            if lease is None:
+                return False
+            self._revision += 1
+            rev = self._revision
+        obs_events.emit("backend_left", backend=str(backend_id),
+                        endpoint=lease.endpoint, reason=str(reason),
+                        revision=rev)
+        return True
+
+    def suspect(self, backend_id, reason="conn"):
+        """Immediate expiry on hard evidence (connection refused/reset
+        beats waiting out the TTL): the placement path calls this the
+        moment a forward fails at the socket level."""
+        with self._lock:
+            lease = self._leases.get(str(backend_id))
+            if lease is None:
+                return False
+            self._expire_locked(lease, reason, time.monotonic())
+            return True
+
+    def mark_draining(self, backend_id, draining=True):
+        """Flip one lease's drain state: a draining backend stays
+        leased (alive, finishing streams) but leaves the placement
+        set."""
+        from ..obs import events as obs_events
+        with self._lock:
+            lease = self._leases.get(str(backend_id))
+            if lease is None:
+                return False
+            lease.draining = bool(draining)
+            lease.accepting = not lease.draining
+            self._revision += 1
+            rev = self._revision
+        obs_events.emit("backend_draining", backend=str(backend_id),
+                        endpoint=lease.endpoint, draining=bool(draining),
+                        revision=rev)
+        return True
+
+    # -- expiry --------------------------------------------------------
+
+    def _expire_locked(self, lease, reason, now):
+        from ..obs import events as obs_events
+        self._leases.pop(lease.backend_id, None)
+        self._lost[lease.backend_id] = {
+            "endpoint": lease.endpoint, "reason": str(reason),
+            "t_mono": now, "models": sorted(lease.models)}
+        while len(self._lost) > self.LOST_KEPT:
+            self._lost.pop(next(iter(self._lost)))
+        self._revision += 1
+        obs_events.emit("backend_lost", backend=lease.backend_id,
+                        endpoint=lease.endpoint, reason=str(reason),
+                        age_s=round(lease.age_s(now), 3),
+                        revision=self._revision)
+
+    def _sweep_locked(self, now):
+        for lease in [l for l in self._leases.values()
+                      if l.expired(now)]:
+            self._expire_locked(lease, "ttl", now)
+
+    def sweep(self):
+        """Expire every lease past its TTL (the frontend's background
+        sweeper; reads also sweep lazily)."""
+        with self._lock:
+            self._sweep_locked(time.monotonic())
+
+    # -- readouts ------------------------------------------------------
+
+    def backends(self, accepting_only=False, model=None):
+        """Live member snapshot {backend_id: lease dict}, swept first.
+        ``accepting_only`` drops draining/not-accepting leases (the
+        placement view); ``model`` keeps only backends with that model
+        RESIDENT."""
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_locked(now)
+            out = {}
+            for bid, lease in self._leases.items():
+                if accepting_only and (lease.draining
+                                       or not lease.accepting):
+                    continue
+                if model is not None and str(model) not in lease.models:
+                    continue
+                out[bid] = lease.to_dict(now)
+            return out
+
+    def get(self, backend_id):
+        with self._lock:
+            self._sweep_locked(time.monotonic())
+            lease = self._leases.get(str(backend_id))
+            return None if lease is None else lease.to_dict()
+
+    def lost(self):
+        """{backend_id: {"endpoint","reason","age_s",...}} — recent
+        losses (bounded), for the dead-vs-draining readout."""
+        now = time.monotonic()
+        with self._lock:
+            return {bid: dict(rec, age_s=round(
+                max(now - rec["t_mono"], 0.0), 3))
+                for bid, rec in self._lost.items()}
+
+    @property
+    def revision(self):
+        with self._lock:
+            return self._revision
+
+    def status(self):
+        """Wire-encodable membership table (the frontend's `health`
+        payload carries it; serving_top renders it)."""
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_locked(now)
+            return {
+                "revision": self._revision,
+                "ttl_s": self.ttl_s,
+                "backends": {bid: lease.to_dict(now)
+                             for bid, lease in self._leases.items()},
+                "lost": {bid: {k: v for k, v in rec.items()
+                               if k != "t_mono"}
+                         for bid, rec in self._lost.items()}}

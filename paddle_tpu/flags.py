@@ -635,6 +635,66 @@ DEFINE_bool(
     "triggering signal, but NO action touches the registry — replica "
     "counts, residency and ab weights stay untouched. The rehearsal "
     "mode for a new policy spec against live traffic.")
+DEFINE_string(
+    "federation_frontend", "",
+    "Federation frontend endpoint HOST:PORT (SERVING.md \"Federated "
+    "serving\"): when set, every InferenceServer registers a "
+    "membership lease with that front-door router at start, "
+    "heartbeats its resident-model/queue payload, and deregisters on "
+    "shutdown — the server becomes a BACKEND the frontend places "
+    "traffic onto. Empty (default) keeps the server standalone. An "
+    "InferenceServer(federation=...) argument overrides per server.")
+DEFINE_float(
+    "federation_ttl_s", 3.0,
+    "Membership lease TTL in seconds (paddle_tpu/federation/"
+    "membership.py): a backend whose heartbeat goes missing this long "
+    "expires from the placement set and a backend_lost event fires. "
+    "The frontend re-places subsequent traffic within one TTL of a "
+    "backend death — this is the detection bound the chaos "
+    "backend-kill scenario pins. Must exceed federation_heartbeat_ms "
+    "with slack (3x is a sane floor: one lost beat must not flap the "
+    "lease).")
+DEFINE_float(
+    "federation_heartbeat_ms", 1000.0,
+    "Backend heartbeat interval toward the federation frontend in "
+    "milliseconds. Each beat renews the lease and refreshes the "
+    "serving payload the frontend places by (resident models with "
+    "est_peak_mb, paged set, queue depth, accepting flag), so "
+    "placement staleness is bounded by one beat.")
+DEFINE_float(
+    "federation_capacity_mb", 0.0,
+    "Device-memory capacity this backend advertises on its lease in "
+    "MB — the denominator of the global controller's placement-by-"
+    "capacity signal (free = capacity - sum of resident est_peak_mb). "
+    "0 (default) means unknown: the backend still serves, but "
+    "capacity-aware placement treats it as last resort. An "
+    "InferenceServer(capacity_mb=...) argument overrides per server.")
+DEFINE_bool(
+    "global_fleet", False,
+    "Run the fleet-of-fleets controller on the federation frontend "
+    "(paddle_tpu/federation/global_fleet.py): per-model GLOBAL "
+    "replica budgets within declared [min,max] policies, placed "
+    "across backends by the free-capacity signal (lease capacity_mb "
+    "minus resident est_peak_mb); cold models page out cluster-wide "
+    "past their idle TTL and fault back in wherever capacity lives, "
+    "via the persisted lane specs the frontend records from "
+    "load_model passthrough. Per-backend fleet controllers delegate "
+    "their scale/page decisions to this tier while a federation link "
+    "is up (degrade-before-shed stays local). Off (default) keeps "
+    "cross-host placement operator-driven.")
+DEFINE_string(
+    "global_fleet_policy", "",
+    "Global fleet policies, same grammar as fleet_policy "
+    "('[model:]key=val,...;...', '*' or no prefix = default) but with "
+    "min_replicas/max_replicas read as CLUSTER-WIDE totals across "
+    "backends. Example: 'llm:min_replicas=2,max_replicas=8,"
+    "page_ttl_s=600,scale_up_queue=8'. Empty = observe-only.")
+DEFINE_float(
+    "global_fleet_eval_interval_ms", 1000.0,
+    "Global fleet-of-fleets evaluation interval in milliseconds: "
+    "each tick senses the whole membership table (heartbeat-fed, no "
+    "RPC fan-out) and decides at most a few cooldown-bounded "
+    "cross-host actions.")
 DEFINE_int(
     "dist_threadpool_size", 0,
     "Reference distributed thread pool size. Advisory.")

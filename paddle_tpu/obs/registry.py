@@ -88,6 +88,7 @@ class MetricsRegistry(object):
         self._serving = []     # attached ServingMetrics
         self._slo = []         # attached SLOMonitors (obs/slo.py)
         self._fleet = []       # attached FleetControllers (serving/fleet)
+        self._federation = []  # attached FrontendServers (federation/)
         self._span_agg = {}    # (kind, name) -> [count, total_ms]
 
     # -- primitive instruments ---------------------------------------
@@ -163,6 +164,20 @@ class MetricsRegistry(object):
         with self._lock:
             if controller in self._fleet:
                 self._fleet.remove(controller)
+
+    def attach_federation(self, frontend):
+        """Absorb one federation FrontendServer (federation/frontend):
+        membership-by-state, placement/spillover/shed counters, and —
+        when the global tier runs — the global_fleet_* families, all
+        via the same [(metric, labels, value, type)] export rows."""
+        with self._lock:
+            if frontend not in self._federation:
+                self._federation.append(frontend)
+
+    def detach_federation(self, frontend):
+        with self._lock:
+            if frontend in self._federation:
+                self._federation.remove(frontend)
 
     def note_span(self, span):
         """Tracing-ring listener: fold one completed span into the
@@ -294,7 +309,8 @@ class MetricsRegistry(object):
         attached FleetController — both speak the same
         [(metric, labels, value, type)] export row shape."""
         with self._lock:
-            monitors = list(self._slo) + list(self._fleet)
+            monitors = (list(self._slo) + list(self._fleet)
+                        + list(self._federation))
         by_name = {}
         for mon in monitors:
             try:

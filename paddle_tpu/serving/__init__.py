@@ -29,7 +29,8 @@ from .metrics import (Counter, ModelMetrics, ReservoirHistogram,
                       ServingMetrics)
 from .model_registry import (ModelEntry, ModelRegistry, open_predictor,
                              resolve_placement)
-from .server import InferenceServer, ServingClient, ServingError
+from .server import (InferenceServer, ServingClient, ServingError,
+                     StreamBroken)
 
 __all__ = [
     "DynamicBatcher", "DecodeBatcher", "DecodeStream",
@@ -42,4 +43,5 @@ __all__ = [
     "FleetController", "FleetPolicy", "FleetAction", "ModelSensors",
     "parse_fleet_spec",
     "InferenceServer", "ServingClient", "ServingError",
+    "StreamBroken",
 ]

@@ -314,6 +314,14 @@ class FleetController(object):
         self.interval_s = max(self.interval_s, 0.01)
         self.dry_run = (bool(FLAGS.fleet_dry_run) if dry_run is None
                         else bool(dry_run))
+        # federation endpoint owning replica/paging decisions for this
+        # server (set by InferenceServer.start when federated): the
+        # global tier counts replicas CLUSTER-wide, so the per-server
+        # controller must not fight it over the same knobs — scale and
+        # page actions are logged as delegated instead of executed;
+        # degrade/restore (the int8 pressure valve) and demand fault-in
+        # stay local, they are per-server by nature
+        self.delegated_to = None
         self._lock = threading.Lock()
         self._policies = dict(policies or {})  # model (or '*') -> policy
         self._state = {}           # model -> controller bookkeeping
@@ -510,6 +518,19 @@ class FleetController(object):
         # actuate OUTSIDE the lock: a resize is a full build+warm+flip
         # and status()/export() reads must not serialize behind it
         for action, _policy in plan:
+            if (self.delegated_to
+                    and action.kind in ("scale_up", "scale_down",
+                                        "page_out")):
+                # a federation frontend owns this knob cluster-wide:
+                # record the local signal, leave actuation to the
+                # global tier (SERVING.md "Federated serving")
+                fields = dict(action.signal)
+                fields.update(model=action.model, action=action.kind,
+                              delegated=str(self.delegated_to))
+                obs_events.emit("fleet_decision", **fields)
+                processed.append(
+                    (action, "delegated:%s" % self.delegated_to))
+                continue
             if dry:
                 fields = dict(action.signal)
                 fields.update(model=action.model, action=action.kind,

@@ -57,13 +57,36 @@ from .batcher import BatcherClosed, DeadlineExceeded, ServerOverloaded
 from .metrics import ServingMetrics
 from .model_registry import ModelRegistry
 
-__all__ = ["InferenceServer", "ServingClient", "ServingError"]
+__all__ = ["InferenceServer", "ServingClient", "ServingError",
+           "StreamBroken"]
 
 _CLOSE = object()
 
 
 class ServingError(RuntimeError):
     """Server-side failure reported over the wire (non-typed codes)."""
+
+
+class StreamBroken(ServingError):
+    """An ``infer_stream`` connection died mid-generation.
+
+    ``received`` counts the tokens already yielded — those are REAL
+    (the server committed them); ``trace_id``/``backend`` identify the
+    stream for re-placement.  Deliberately a ServingError subclass and
+    NOT a ConnectionError: a generic reconnect-and-retry wrapper (the
+    one-shot verbs' idiom) must never catch a broken stream and
+    silently restart it from token 0 — that duplicates committed
+    output.  Recovery is a NEW stream: through the federation frontend
+    the same trace_id re-pins onto a live backend (affinity re-pin,
+    paddle_tpu/federation/frontend.py), or the caller restarts
+    explicitly with the received-token prefix in hand."""
+
+    def __init__(self, message, trace_id=None, received=0,
+                 backend=None):
+        super(StreamBroken, self).__init__(message)
+        self.trace_id = trace_id
+        self.received = int(received)
+        self.backend = backend
 
 
 def _error_reply(exc):
@@ -94,9 +117,20 @@ class InferenceServer:
 
     def __init__(self, endpoint="127.0.0.1:0", model_root=None,
                  max_queue=None, deadline_ms=None, workers=None,
-                 buckets=None, replicas=None):
+                 buckets=None, replicas=None, federation=None,
+                 backend_id=None, capacity_mb=None):
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
+        # federation membership (paddle_tpu/federation): a frontend
+        # endpoint to lease against — this server registers at start,
+        # heartbeats its resident-model/queue payload, and deregisters
+        # on shutdown.  None falls back to FLAGS.federation_frontend
+        # (empty = standalone, the default).
+        self._federation = federation if federation is not None \
+            else (FLAGS.federation_frontend or None)
+        self._backend_id = backend_id
+        self._capacity_mb = capacity_mb
+        self._fed_link = None
         self.metrics = ServingMetrics()
         # the unified telemetry surface (OBSERVABILITY.md): this
         # server's counters join the process-wide MetricsRegistry the
@@ -211,6 +245,16 @@ class InferenceServer:
             self.endpoint.replace(":", "_").replace(".", "-")
         flightrec.add_provider(self._flight_provider,
                                self._flight_snapshot)
+        if self._federation:
+            self._fed_link = _FederationLink(
+                self, self._federation, backend_id=self._backend_id,
+                capacity_mb=self._capacity_mb)
+            self._fed_link.start()
+            if self.fleet is not None:
+                # scale/page policy belongs to the global tier once a
+                # frontend owns placement (fleet.py delegation) —
+                # degrade-before-shed stays local
+                self.fleet.delegated_to = self._federation
         if background:
             self._thread = threading.Thread(target=self._serve,
                                             daemon=True)
@@ -233,6 +277,11 @@ class InferenceServer:
         """Graceful stop: refuse new work, drain every queued request,
         then stop accepting connections."""
         self._draining = True
+        if self._fed_link is not None:
+            # de-lease FIRST: the frontend must stop placing before the
+            # registry starts retiring lanes
+            self._fed_link.stop(deregister=True)
+            self._fed_link = None
         if self.fleet is not None:
             # stop acting BEFORE the drain: the controller must not
             # resize/page models the shutdown is retiring
@@ -264,7 +313,18 @@ class InferenceServer:
         serving_top's) is-it-actually-serving readout, cheap enough to
         poll every second."""
         h = {"draining": bool(self._draining),
+             # drain-vs-dead disambiguation (federation): accepting
+             # False + an answering server = draining (streams still
+             # finishing), no answer at all = dead — the frontend and
+             # serving_top key on this instead of inferring from lease
+             # age
+             "accepting": not self._draining,
              "models": self.registry.health()}
+        if self._federation:
+            h["federation"] = {"frontend": self._federation,
+                               "lease": (self._fed_link.lease
+                                         if self._fed_link is not None
+                                         else None)}
         if self.slo is not None:
             h["slo"] = self.slo.state()
             h["slo_monitor"] = {"running": self.slo.running,
@@ -400,6 +460,40 @@ class InferenceServer:
         if cmd == "unload_model":
             self.registry.unload_model(msg["name"])
             return {"ok": True}
+        if cmd == "drain":
+            # federation drain (SERVING.md "Federated serving"): stop
+            # ACCEPTING without stopping — in-flight requests and
+            # decode streams run to completion, new admissions refuse
+            # with "overloaded"; `resume` flips the server back into
+            # the placement set (tests, rolling maintenance)
+            self._draining = not msg.get("resume")
+            if self._fed_link is not None:
+                # push the accepting flip now, not at the next beat
+                self._fed_link.beat_soon()
+            return {"ok": True, "accepting": not self._draining,
+                    "draining": bool(self._draining)}
+        if cmd == "page_model":
+            # cluster-wide paging actuator (federation/global_fleet):
+            # unload to the artifact path, keep the load spec — the
+            # model faults back in on demand or by global decision
+            self.registry.page_out(msg["name"])
+            return {"ok": True, "paged": msg["name"]}
+        if cmd == "resize_model":
+            # the global controller re-placing one model's replica
+            # budget on THIS host (build-warm-flip, fit-gated)
+            entry = self.registry.resize_model(
+                msg["name"], int(msg["replicas"]),
+                precision=msg.get("precision"))
+            return {"ok": True, "name": msg["name"],
+                    "replicas": len(entry.replicas)}
+        if cmd == "fault_model":
+            # explicit fault-in (the global controller placing a cold
+            # model on THIS host): replays the persisted lane spec
+            self.registry.fault_in(
+                msg["name"], trigger=str(msg.get("trigger") or "rpc"))
+            return {"ok": True, "name": msg["name"],
+                    "fault_in": dict(self.registry.last_fault_in.get(
+                        msg["name"]) or {})}
         if cmd == "shutdown":
             # drain BEFORE replying so the client's ok means "all prior
             # requests answered"; the accept loop stops right after
@@ -529,6 +623,148 @@ class InferenceServer:
             raise
 
 
+class _FederationLink:
+    """Backend-side lease maintenance toward a federation frontend
+    (paddle_tpu/federation): register at start, heartbeat every
+    ``FLAGS.federation_heartbeat_ms`` carrying the serving payload
+    (resident models + est_peak_mb + per-model queue/request counters,
+    paged set, accepting flag), deregister on shutdown.  A heartbeat
+    answered with code ``no_lease`` means the frontend already expired
+    (or restarted past) this lease — the link re-registers on the next
+    beat: the rejoin path, never silent serving on a dead lease."""
+
+    def __init__(self, server, frontend, backend_id=None,
+                 capacity_mb=None, heartbeat_s=None):
+        self.server = server
+        self.frontend = str(frontend)
+        self.backend_id = backend_id
+        self.capacity_mb = (float(FLAGS.federation_capacity_mb)
+                            if capacity_mb is None
+                            else float(capacity_mb))
+        self.heartbeat_s = max(
+            (float(FLAGS.federation_heartbeat_ms) / 1000.0
+             if heartbeat_s is None else float(heartbeat_s)), 0.02)
+        self.lease = None       # the granted {"backend_id","lease_id"}
+        self._cli = ServingClient(self.frontend)
+        self._stop = threading.Event()
+        self._kick = threading.Event()
+        self._thread = None
+
+    # -- payload -------------------------------------------------------
+
+    def _payload(self):
+        """(models, paged, load): the lease's serving payload — what
+        the frontend places by and the global controller senses by."""
+        desc = self.server.registry.describe()
+        snap = self.server.metrics.snapshot()
+        models, paged = {}, []
+        for name, d in desc.items():
+            if d.get("paged"):
+                paged.append(name)
+                continue
+            models[name] = {"replicas": int(d.get("replicas") or 1),
+                            "decode": bool(d.get("decode"))}
+        queue_depth = requests = 0
+        for key, m in (snap.get("models") or {}).items():
+            qd = int(m.get("queue_depth") or 0)
+            rq = int(m.get("requests") or 0)
+            queue_depth += qd
+            requests += rq
+            plain = m.get("model", key)
+            info = models.get(plain)
+            if info is not None:
+                info["queue_depth"] = info.get("queue_depth", 0) + qd
+                info["requests"] = info.get("requests", 0) + rq
+                if m.get("est_peak_mb") is not None:
+                    info["est_peak_mb"] = float(m["est_peak_mb"])
+        load = {"queue_depth": queue_depth, "requests": requests}
+        return models, paged, load
+
+    # -- the beat ------------------------------------------------------
+
+    def _register(self, models, paged, load):
+        host, port = self.server._addr
+        if host in ("0.0.0.0", "::"):
+            host = "127.0.0.1"  # wildcard bind: advertise loopback
+        reply = self._cli.call({
+            "cmd": "register", "host": host, "port": int(port),
+            "backend_id": self.backend_id,
+            "capacity_mb": self.capacity_mb,
+            "models": models, "paged": paged, "load": load})
+        self.lease = {"backend_id": reply["backend_id"],
+                      "lease_id": reply["lease_id"],
+                      "ttl_s": reply.get("ttl_s")}
+        self.backend_id = reply["backend_id"]
+
+    def _beat(self):
+        models, paged, load = self._payload()
+        if self.lease is None:
+            self._register(models, paged, load)
+            return
+        try:
+            self._cli.call({
+                "cmd": "heartbeat",
+                "backend_id": self.lease["backend_id"],
+                "lease_id": self.lease["lease_id"],
+                "models": models, "paged": paged,
+                "accepting": not self.server._draining,
+                "load": load})
+        except ServingError as e:
+            if getattr(e, "code", None) == "no_lease":
+                # expired under us (missed beats / frontend restart):
+                # rejoin with a fresh lease right away
+                self.lease = None
+                self._register(models, paged, load)
+            else:
+                raise
+
+    def beat_soon(self):
+        """Wake the loop now (drain flips must not wait out a beat)."""
+        self._kick.set()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._kick.wait(self.heartbeat_s)
+            self._kick.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self._beat()
+            except Exception:
+                # frontend unreachable: drop the socket, retry next
+                # beat — the lease expires frontend-side meanwhile,
+                # which is exactly the contract
+                self._cli.close()
+
+    def start(self):
+        try:
+            self._beat()  # eager first register — placeable at return
+        except Exception:
+            self._cli.close()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="paddle-tpu-fedlink-%s" % self.frontend)
+        self._thread.start()
+        return self
+
+    def stop(self, deregister=False, timeout=2.0):
+        self._stop.set()
+        self._kick.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        self._thread = None
+        if deregister and self.lease is not None:
+            try:
+                self._cli.call({"cmd": "deregister",
+                                "backend_id": self.lease["backend_id"],
+                                "lease_id": self.lease["lease_id"]})
+            except Exception:
+                pass  # frontend gone: the TTL cleans up
+        self.lease = None
+        self._cli.close()
+
+
 class ServingClient:
     """Wire client for InferenceServer.  Connections are thread-local
     (same rationale as RPCClient: a blocking round-trip per call, one
@@ -580,8 +816,16 @@ class ServingClient:
                                        priority=reply.get("shed_priority"))
             if code == "deadline":
                 raise DeadlineExceeded(reply["error"])
-            raise ServingError("%s (code=%s)" % (reply["error"], code))
+            err = ServingError("%s (code=%s)" % (reply["error"], code))
+            err.code = code  # typed dispatch (federation no_lease etc.)
+            raise err
         return reply
+
+    def call(self, msg):
+        """One-shot forward of a raw verb dict — NO retry policy: the
+        federation frontend's forwarding primitive (spillover policy
+        owns the retries, the transport must not)."""
+        return self._call_once(dict(msg))
 
     def _call(self, msg, retry_deadline=None, retry_on=()):
         if retry_deadline is None:
@@ -638,15 +882,45 @@ class ServingClient:
             s = socket.create_connection((host, int(port)),
                                          timeout=FLAGS.rpc_deadline)
             finished = False
+            received = 0  # tokens already yielded — committed output
             try:
-                _send_msg(s, msg)
+                try:
+                    _send_msg(s, msg)
+                except (ConnectionError, EOFError, OSError,
+                        WireError) as e:
+                    raise StreamBroken(
+                        "stream to %s broke before placement: %s"
+                        % (self.endpoint, e),
+                        trace_id=msg.get("trace_id"), received=0)
                 while True:
-                    reply = _recv_msg(s)
+                    try:
+                        reply = _recv_msg(s)
+                    except (ConnectionError, EOFError, OSError,
+                            WireError) as e:
+                        # the connection died MID-STREAM.  This must
+                        # never look like a retryable transport error:
+                        # a reconnect would restart the stream from
+                        # token 0 and duplicate the `received` tokens
+                        # already committed.  Typed StreamBroken makes
+                        # generic (ConnectionError, OSError) retry
+                        # loops pass it through; re-placement is the
+                        # federation frontend's affinity re-pin.
+                        finished = True
+                        self.last_stream_info = {
+                            "code": "stream_broken",
+                            "new_tokens": received,
+                            "trace_id": msg.get("trace_id")}
+                        raise StreamBroken(
+                            "stream to %s broke after %d token(s): %s"
+                            % (self.endpoint, received, e),
+                            trace_id=msg.get("trace_id"),
+                            received=received)
                     if "error" in reply:
                         finished = True
                         self.last_stream_info = {
                             k: reply[k] for k in
-                            ("trace_id", "new_tokens", "code")
+                            ("trace_id", "new_tokens", "code",
+                             "backend")
                             if k in reply}
                         self.last_trace_id = reply.get("trace_id")
                         code = reply.get("code")
@@ -656,10 +930,20 @@ class ServingClient:
                                 priority=reply.get("shed_priority"))
                         if code == "deadline":
                             raise DeadlineExceeded(reply["error"])
+                        if code == "stream_broken":
+                            # frontend-relayed backend death: same
+                            # typed surface as a direct break
+                            raise StreamBroken(
+                                reply["error"],
+                                trace_id=reply.get("trace_id"),
+                                received=received,
+                                backend=reply.get("backend"))
                         raise ServingError("%s (code=%s)"
                                            % (reply["error"], code))
                     if reply.get("chunk"):
-                        yield [int(t) for t in reply["tokens"]]
+                        toks = [int(t) for t in reply["tokens"]]
+                        received += len(toks)
+                        yield toks
                         continue
                     finished = True
                     self.last_stream_info = {
@@ -779,6 +1063,23 @@ class ServingClient:
 
     def unload_model(self, name):
         return self._call({"cmd": "unload_model", "name": name})
+
+    def drain(self, resume=False):
+        """Flip the server out of (or with ``resume=True`` back into)
+        the accepting state: in-flight work finishes, new admissions
+        refuse — the federation drain verb (SERVING.md)."""
+        return self._call({"cmd": "drain", "resume": bool(resume)})
+
+    def page_model(self, name):
+        """Page one model out to its artifact path (load spec kept —
+        it faults back in on demand)."""
+        return self._call({"cmd": "page_model", "name": name})
+
+    def fault_model(self, name, trigger="rpc"):
+        """Fault one paged model back in on this server (the global
+        controller's cross-host placement actuator)."""
+        return self._call({"cmd": "fault_model", "name": name,
+                           "trigger": str(trigger)})
 
     def stats(self):
         return self._call({"cmd": "stats"})

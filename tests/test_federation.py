@@ -1,0 +1,775 @@
+"""Federated serving tests (paddle_tpu/federation — SERVING.md
+"Federated serving").
+
+Pins the new global tier's contracts: heartbeat-TTL membership with
+expiry/rejoin and a monotonic revision counter, the front-door
+router's bit-exactness vs direct backends (one-shot AND streaming),
+deterministic spillover-before-shed, the typed StreamBroken client
+surface (a mid-stream reconnect must never silently restart a stream
+from token 0), drain-vs-dead disambiguation, and the fleet-of-fleets
+controller's pure decision core + capacity-directed page/fault cycle.
+Everything CPU-safe under JAX_PLATFORMS=cpu; socket servers bind
+127.0.0.1:0.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed.rpc import _recv_msg, _send_msg
+from paddle_tpu.federation import (FrontendServer, GlobalFleetController,
+                                   GlobalSensors, MembershipRegistry,
+                                   decide_global, place_by_capacity)
+from paddle_tpu.flags import FLAGS
+from paddle_tpu.obs import events as obs_events
+from paddle_tpu.serving import (FleetPolicy, InferenceServer,
+                                ServerOverloaded, ServingClient,
+                                StreamBroken)
+
+TTL = 0.8
+BEAT_MS = 100.0
+
+
+@pytest.fixture(autouse=True)
+def _fed_flags():
+    ttl, beat = FLAGS.federation_ttl_s, FLAGS.federation_heartbeat_ms
+    FLAGS.federation_ttl_s = TTL
+    FLAGS.federation_heartbeat_ms = BEAT_MS
+    yield
+    FLAGS.federation_ttl_s = ttl
+    FLAGS.federation_heartbeat_ms = beat
+
+
+def _export_fc(tmp_path, seed, name="m"):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(input=x, size=6, act="relu")
+        pred = fluid.layers.fc(input=h, size=6, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        md = str(tmp_path / name)
+        fluid.save_inference_model(md, ["x"], [pred], exe,
+                                   main_program=main)
+    return md
+
+
+def _direct(md, buckets=(2, 4)):
+    from paddle_tpu.inference import AnalysisConfig, Predictor
+    cfg = AnalysisConfig(model_dir=md)
+    cfg.batch_size_buckets = tuple(buckets)
+    return Predictor(cfg)
+
+
+def _events_since(mark, kind):
+    return [e for e in obs_events.recent_events(kind=kind)
+            if e["ts"] >= mark]
+
+
+# ---------------------------------------------------------------------------
+# membership registry (no sockets)
+# ---------------------------------------------------------------------------
+
+class TestMembership:
+    def test_lease_lifecycle_expiry_and_rejoin(self):
+        mark = time.time()
+        reg = MembershipRegistry(ttl_s=0.15)
+        g = reg.register("127.0.0.1", 9001, backend_id="b1",
+                         models={"m": {"replicas": 2,
+                                       "est_peak_mb": 10.0}},
+                         capacity_mb=100.0)
+        assert g["backend_id"] == "b1" and g["ttl_s"] == 0.15
+        rev0 = g["revision"]
+        assert reg.heartbeat("b1", g["lease_id"],
+                             load={"queue_depth": 3})
+        lease = reg.backends()["b1"]
+        assert lease["resident_mb"] == 20.0
+        assert lease["load"]["queue_depth"] == 3
+        # a stale lease id is refused -> the backend must re-register
+        assert not reg.heartbeat("b1", "ls-999")
+        time.sleep(0.2)
+        assert reg.backends() == {}
+        assert reg.lost()["b1"]["reason"] == "ttl"
+        assert reg.revision > rev0
+        lost = _events_since(mark, "backend_lost")
+        assert lost and lost[-1]["backend"] == "b1"
+        # rejoin: same id, fresh lease, evented with rejoin=True
+        g2 = reg.register("127.0.0.1", 9001, backend_id="b1")
+        assert g2["lease_id"] != g["lease_id"]
+        assert "b1" in reg.backends() and "b1" not in reg.lost()
+        joins = _events_since(mark, "backend_joined")
+        assert joins[-1]["rejoin"] is True
+
+    def test_draining_leaves_placement_set_but_stays_leased(self):
+        reg = MembershipRegistry(ttl_s=5.0)
+        reg.register("127.0.0.1", 1, backend_id="a")
+        reg.register("127.0.0.1", 2, backend_id="b")
+        assert reg.mark_draining("a")
+        assert sorted(reg.backends()) == ["a", "b"]
+        assert sorted(reg.backends(accepting_only=True)) == ["b"]
+        assert reg.backends()["a"]["draining"] is True
+        assert reg.mark_draining("a", False)  # resume
+        assert sorted(reg.backends(accepting_only=True)) == ["a", "b"]
+
+    def test_suspect_expires_immediately(self):
+        reg = MembershipRegistry(ttl_s=60.0)
+        reg.register("127.0.0.1", 1, backend_id="a")
+        assert reg.suspect("a", "conn_refused")
+        assert reg.backends() == {}
+        assert reg.lost()["a"]["reason"] == "conn_refused"
+
+    def test_place_by_capacity_ranking(self):
+        leases = {
+            "tight": {"capacity_mb": 100.0, "resident_mb": 90.0,
+                      "models": {}},
+            "roomy": {"capacity_mb": 1000.0, "resident_mb": 10.0,
+                      "models": {}},
+            "unknown": {"capacity_mb": 0.0, "resident_mb": 0.0,
+                        "models": {}},
+        }
+        # declared capacity beats undeclared; most free wins
+        assert place_by_capacity(leases) == "roomy"
+        # spread: a host NOT holding the model outranks the roomy
+        # holder when capacities tie closely enough in rank class
+        leases["roomy"]["models"] = {"m": {}}
+        leases["tight"]["resident_mb"] = 0.0
+        assert place_by_capacity(leases, prefer_absent="m") == "tight"
+
+
+# ---------------------------------------------------------------------------
+# global decision core (pure)
+# ---------------------------------------------------------------------------
+
+class TestDecideGlobal:
+    POL = FleetPolicy(min_replicas=1, max_replicas=4, scale_up_queue=4,
+                      scale_down_idle_s=10.0, page_ttl_s=30.0,
+                      scale_cooldown_s=5.0, page_cooldown_s=5.0)
+
+    def test_paged_everywhere_faults_in_on_demand(self):
+        s = GlobalSensors("m", total_replicas=0, paged_on=["b1"],
+                          requests_delta=3)
+        acts = decide_global(s, self.POL, {}, now=100.0)
+        assert [a.kind for a in acts] == ["fault_in"]
+        assert acts[0].signal["tier"] == "global"
+        # no demand -> stays cold
+        s2 = GlobalSensors("m", total_replicas=0, paged_on=["b1"])
+        assert decide_global(s2, self.POL, {}, now=100.0) == []
+
+    def test_scale_up_on_queue_within_budget_and_cooldown(self):
+        s = GlobalSensors("m", total_replicas=2,
+                          resident={"b1": 2}, queue_depth=9)
+        acts = decide_global(s, self.POL, {}, now=100.0)
+        assert [a.kind for a in acts] == ["scale_up"]
+        assert acts[0].params["to"] == 3
+        # cooldown holds it back
+        assert decide_global(s, self.POL, {"last_scale_t": 98.0},
+                             100.0) == []
+        # at the global budget ceiling: no action
+        s.total_replicas = 4
+        assert decide_global(s, self.POL, {}, 100.0) == []
+
+    def test_scale_down_and_page_out_on_idle(self):
+        s = GlobalSensors("m", total_replicas=2, resident={"b1": 2},
+                          idle_s=50.0)
+        acts = decide_global(s, self.POL, {}, now=100.0)
+        assert [a.kind for a in acts] == ["scale_down", "page_out"]
+        assert acts[0].params["to"] == 1
+        # min_replicas floors the shrink; paging still fires
+        s2 = GlobalSensors("m", total_replicas=1, resident={"b1": 1},
+                          idle_s=50.0)
+        assert [a.kind for a in decide_global(s2, self.POL, {},
+                                              100.0)] == ["page_out"]
+
+
+# ---------------------------------------------------------------------------
+# stub backends (deterministic overload / mid-stream death)
+# ---------------------------------------------------------------------------
+
+class _StubBackend:
+    """Minimal wire peer: registers with a frontend and answers every
+    verb from a scripted table — the deterministic stand-in for 'this
+    backend sheds' / 'this backend dies mid-stream'."""
+
+    def __init__(self, script):
+        self.script = script  # callable(msg, sock) -> reply dict|None
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.host, self.port = self._srv.getsockname()
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                reply = self.script(msg, conn)
+                if reply is not None:
+                    _send_msg(conn, reply)
+        except Exception:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def _register_stub(fe, stub, backend_id, models=("m",), queue_depth=0):
+    g = fe.membership.register(
+        stub.host, stub.port, backend_id=backend_id,
+        models={n: {"replicas": 1} for n in models})
+    fe.membership.heartbeat(g["backend_id"], g["lease_id"],
+                            load={"queue_depth": queue_depth})
+    return g
+
+
+# ---------------------------------------------------------------------------
+# frontend routing over real backends
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fc_md(tmp_path_factory):
+    return _export_fc(tmp_path_factory.mktemp("fed_fc"), seed=3)
+
+
+class TestFrontendRouting:
+    def test_three_backend_mixed_traffic_bit_exact(self, fc_md):
+        fe = FrontendServer().start()
+        backs = [InferenceServer(buckets=(2, 4), federation=fe.endpoint,
+                                 backend_id="b%d" % i).start()
+                 for i in range(3)]
+        cli = ServingClient(fe.endpoint)
+        try:
+            r = cli.load_model("m", fc_md, buckets=[2, 4])
+            assert r["loaded"] == 3
+            deadline = time.monotonic() + 5
+            while (len(fe._candidates("m")) < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)  # heartbeats deliver the model payload
+            assert len(fe._candidates("m")) == 3
+            direct = _direct(fc_md)
+            rng = np.random.RandomState(0)
+            xs = [rng.randn(b, 4).astype(np.float32)
+                  for b in (1, 3, 2, 1, 4, 2)]
+            for x in xs:
+                out = cli.infer("m", {"x": x}, deadline_ms=30000)
+                ref = direct.run({"x": x})[0]
+                assert np.array_equal(out[0], ref), \
+                    "federated reply differs from direct run"
+            assert sum(fe._placed.values()) == len(xs)
+            # merged stats: request total spans the whole federation
+            st = cli.stats()
+            assert st["stats"]["models"]["m"]["requests"] == len(xs)
+            fed = st["federation"]
+            assert len(fed["backends"]) == 3
+            assert fed["counters"]["shed"] == 0
+        finally:
+            cli.close()
+            for b in backs:
+                b.shutdown()
+            fe.shutdown()
+
+    def test_spillover_before_shed_is_deterministic(self, fc_md):
+        """An always-overloaded best-scored backend spills to the next
+        candidate (same trace_id); only all-overloaded sheds."""
+        relayed_traces = []
+
+        def overloaded(msg, sock):
+            if msg.get("cmd") == "infer":
+                relayed_traces.append(msg.get("trace_id"))
+                return {"error": "full", "code": "overloaded"}
+            return {"ok": True}
+
+        fe = FrontendServer().start()
+        stub = _StubBackend(overloaded)
+        real = InferenceServer(buckets=(2, 4), federation=fe.endpoint,
+                               backend_id="zz-real").start()
+        cli = ServingClient(fe.endpoint)
+        try:
+            cli.call({"cmd": "load_model", "name": "m",
+                      "path": fc_md, "buckets": [2, 4],
+                      "backend": "zz-real"})
+            deadline = time.monotonic() + 5
+            while (len(fe._candidates("m")) < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            # the stub scores better (queue 0, registered id 'aa-')
+            _register_stub(fe, stub, "aa-stub", queue_depth=0)
+            assert fe._candidates("m")[0] == "aa-stub"
+            x = np.zeros((1, 4), np.float32)
+            out = cli.infer("m", {"x": x}, trace_id="t-spill",
+                            deadline_ms=30000)
+            assert out[0].shape == (1, 6)
+            # the shed backend saw the SAME trace the winner served
+            assert relayed_traces == ["t-spill"]
+            assert fe._counters["spillover"] == 1
+            assert fe._counters["shed"] == 0
+            assert fe._placed == {"zz-real": 1}
+            # every candidate overloaded -> typed shed to the caller
+            fe.membership.mark_draining("zz-real")
+            with pytest.raises(ServerOverloaded):
+                cli.call({"cmd": "infer", "model": "m",
+                          "feeds": {"x": x}})
+            assert fe._counters["shed"] == 1
+        finally:
+            cli.close()
+            stub.close()
+            real.shutdown()
+            fe.shutdown()
+
+    def test_dead_backend_suspected_and_routed_around(self, fc_md):
+        """Hard connect evidence expires the lease immediately — the
+        next candidate answers, nothing hangs, nothing is lost."""
+        fe = FrontendServer().start()
+        real = InferenceServer(buckets=(2, 4), federation=fe.endpoint,
+                               backend_id="zz-real").start()
+        cli = ServingClient(fe.endpoint)
+        try:
+            cli.load_model("m", fc_md, buckets=[2, 4])
+            deadline = time.monotonic() + 5
+            while (len(fe._candidates("m")) < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            dead = _StubBackend(lambda m, s: {"ok": True})
+            dead.close()  # port is now refused
+            _register_stub(fe, dead, "aa-dead")
+            assert fe._candidates("m")[0] == "aa-dead"
+            out = cli.infer("m", {"x": np.zeros((1, 4), np.float32)},
+                            deadline_ms=30000)
+            assert out[0].shape == (1, 6)
+            assert "aa-dead" in fe.membership.lost()
+        finally:
+            cli.close()
+            real.shutdown()
+            fe.shutdown()
+
+    def test_lost_heartbeat_expires_within_ttl_and_rejoins(self, fc_md):
+        mark = time.time()
+        fe = FrontendServer().start()
+        b0 = InferenceServer(buckets=(2, 4), federation=fe.endpoint,
+                             backend_id="b0").start()
+        b1 = InferenceServer(buckets=(2, 4), federation=fe.endpoint,
+                             backend_id="b1").start()
+        cli = ServingClient(fe.endpoint)
+        try:
+            cli.load_model("m", fc_md, buckets=[2, 4])
+            deadline = time.monotonic() + 5
+            while (len(fe._candidates("m")) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            # stop b1's heartbeats WITHOUT deregistering (a hang, not
+            # a clean leave); keep the link object for the server
+            link, b1._fed_link = b1._fed_link, None
+            link.stop(deregister=False)
+            deadline = time.monotonic() + 3 * TTL
+            while ("b1" not in fe.membership.lost()
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert "b1" in fe.membership.lost(), \
+                "lease should expire within one TTL of silence"
+            # traffic keeps flowing, placed only on the survivor
+            x = np.zeros((2, 4), np.float32)
+            for _ in range(3):
+                cli.infer("m", {"x": x}, deadline_ms=30000)
+            assert fe._placed.get("b1") is None
+            assert fe._placed["b0"] == 3
+            assert _events_since(mark, "backend_lost")
+        finally:
+            cli.close()
+            b0.shutdown()
+            b1.shutdown()
+            fe.shutdown()
+
+    def test_drain_stops_placement_then_deleases(self, fc_md):
+        mark = time.time()
+        fe = FrontendServer().start()
+        b0 = InferenceServer(buckets=(2, 4), federation=fe.endpoint,
+                             backend_id="b0").start()
+        b1 = InferenceServer(buckets=(2, 4), federation=fe.endpoint,
+                             backend_id="b1").start()
+        cli = ServingClient(fe.endpoint)
+        try:
+            cli.load_model("m", fc_md, buckets=[2, 4])
+            deadline = time.monotonic() + 5
+            while (len(fe._candidates("m")) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            r = cli.call({"cmd": "drain", "backend": "b1"})
+            assert r["draining"] is True
+            # placement excludes the draining lease IMMEDIATELY
+            assert fe._candidates("m") == ["b0"]
+            # ... and the backend itself reports not-accepting while
+            # still answering (draining != dead)
+            direct = ServingClient(b1.endpoint)
+            try:
+                assert direct.health()["accepting"] is False
+            finally:
+                direct.close()
+            x = np.zeros((1, 4), np.float32)
+            for _ in range(2):
+                cli.infer("m", {"x": x}, deadline_ms=30000)
+            assert fe._placed == {"b0": 2}
+            # no in-flight work -> the sweeper de-leases it
+            deadline = time.monotonic() + 3 * TTL
+            while (not _events_since(mark, "backend_drained")
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            drained = _events_since(mark, "backend_drained")
+            assert drained and drained[-1]["backend"] == "b1"
+        finally:
+            cli.close()
+            b0.shutdown()
+            b1.shutdown()
+            fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# streaming: relay, affinity, StreamBroken
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def decode_md(tmp_path_factory):
+    from paddle_tpu.inference.decode import build_tiny_decode_model
+    md = str(tmp_path_factory.mktemp("fed_gen") / "gen")
+    build_tiny_decode_model(md, vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, max_seq_len=64, eos_id=-1,
+                            seed=21)
+    return md
+
+
+class TestStreaming:
+    def test_stream_relay_bit_exact_with_affinity(self, decode_md):
+        from paddle_tpu.inference.decode import (GenerativePredictor,
+                                                 greedy_decode)
+        fe = FrontendServer().start()
+        backs = [InferenceServer(federation=fe.endpoint,
+                                 backend_id="b%d" % i).start()
+                 for i in range(2)]
+        cli = ServingClient(fe.endpoint)
+        try:
+            cli.load_model("gen", decode_md, decode_slots=4)
+            deadline = time.monotonic() + 5
+            while (len(fe._candidates("gen")) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            pred = GenerativePredictor(decode_md)
+            prompt = [5, 9, 11]
+            ref = list(greedy_decode(pred, prompt, 12)[0])
+            toks = []
+            for chunk in cli.infer_stream("gen", prompt,
+                                          max_new_tokens=12,
+                                          deadline_ms=30000):
+                toks.extend(chunk)
+            assert toks == ref[:len(toks)] and len(toks) == 12
+            info = cli.last_stream_info
+            first = info["backend"]
+            assert first in ("b0", "b1")
+            # session affinity: the same trace_id lands on the backend
+            # holding the session (its KV locality)
+            for _ in range(2):
+                list(cli.infer_stream("gen", prompt, max_new_tokens=4,
+                                      trace_id=info["trace_id"],
+                                      deadline_ms=30000))
+                assert cli.last_stream_info["backend"] == first
+        finally:
+            cli.close()
+            for b in backs:
+                b.shutdown()
+            fe.shutdown()
+
+    def test_client_raises_stream_broken_on_dead_socket(self):
+        """Satellite bugfix contract: a connection dying mid-stream is
+        a typed StreamBroken carrying the committed token count — not a
+        silent reconnect-and-restart from token 0."""
+        def die_after_two(msg, sock):
+            _send_msg(sock, {"chunk": True, "seq": 0, "tokens": [7],
+                             "trace_id": "t1"})
+            _send_msg(sock, {"chunk": True, "seq": 1, "tokens": [8, 9],
+                             "trace_id": "t1"})
+            sock.close()  # hard death, no terminal frame
+            raise ConnectionError
+
+        stub = _StubBackend(die_after_two)
+        cli = ServingClient("%s:%d" % (stub.host, stub.port))
+        try:
+            got = []
+            with pytest.raises(StreamBroken) as ei:
+                for chunk in cli.infer_stream("gen", [1],
+                                              max_new_tokens=8):
+                    got.extend(chunk)
+            assert got == [7, 8, 9]
+            assert ei.value.received == 3
+            assert cli.last_stream_info["code"] == "stream_broken"
+        finally:
+            cli.close()
+            stub.close()
+
+    def test_typed_stream_broken_frame_from_frontend(self):
+        """The frontend's terminal stream_broken frame surfaces as the
+        same typed exception, naming the lost backend."""
+        def typed_break(msg, sock):
+            _send_msg(sock, {"chunk": True, "seq": 0, "tokens": [4],
+                             "trace_id": "t2", "backend": "bX"})
+            _send_msg(sock, {"error": "backend bX lost mid-stream",
+                             "code": "stream_broken", "done": True,
+                             "trace_id": "t2", "backend": "bX",
+                             "chunks": 1})
+            raise ConnectionError
+
+        stub = _StubBackend(typed_break)
+        cli = ServingClient("%s:%d" % (stub.host, stub.port))
+        try:
+            got = []
+            with pytest.raises(StreamBroken) as ei:
+                for chunk in cli.infer_stream("gen", [1],
+                                              max_new_tokens=8):
+                    got.extend(chunk)
+            assert got == [4]
+            assert ei.value.backend == "bX"
+            assert ei.value.received == 1
+        finally:
+            cli.close()
+            stub.close()
+
+    def test_frontend_converts_backend_death_to_typed_frame(
+            self, decode_md):
+        """A backend socket dying mid-relay surfaces to the CLIENT as
+        one typed stream_broken frame naming the lost backend and the
+        committed chunk count (zero hangs); the frontend suspects the
+        backend and the next stream completes on the survivor."""
+        calls = []
+
+        def victim_script(msg, sock):
+            if msg.get("cmd") != "infer_stream":
+                return {"ok": True}
+            calls.append(msg["trace_id"])
+            tid = msg["trace_id"]
+            _send_msg(sock, {"chunk": True, "seq": 0, "tokens": [1],
+                             "trace_id": tid})
+            if len(calls) == 1:
+                # first stream completes cleanly -> pin lands here
+                _send_msg(sock, {"chunk": True, "seq": 1,
+                                 "tokens": [2], "trace_id": tid})
+                _send_msg(sock, {"ok": True, "done": True,
+                                 "trace_id": tid, "new_tokens": 2,
+                                 "finish_reason": "length"})
+                return None
+            sock.close()  # second stream: die mid-relay
+            raise ConnectionError
+
+        fe = FrontendServer().start()
+        survivor = InferenceServer(federation=fe.endpoint,
+                                   backend_id="zz-survivor").start()
+        cli = ServingClient(fe.endpoint)
+        stub = _StubBackend(victim_script)
+        try:
+            cli.load_model("gen", decode_md, decode_slots=4)
+            deadline = time.monotonic() + 5
+            while (len(fe._candidates("gen")) < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            _register_stub(fe, stub, "aa-victim", models=("gen",))
+            assert fe._candidates("gen")[0] == "aa-victim"
+            got = list(cli.infer_stream("gen", [5, 9],
+                                        max_new_tokens=8,
+                                        trace_id="t-kill",
+                                        deadline_ms=30000))
+            assert got == [[1], [2]]
+            assert cli.last_stream_info["backend"] == "aa-victim"
+            # stream 2, same trace: affinity routes back, backend dies
+            got = []
+            with pytest.raises(StreamBroken) as ei:
+                for chunk in cli.infer_stream("gen", [5, 9],
+                                              max_new_tokens=8,
+                                              trace_id="t-kill",
+                                              deadline_ms=30000):
+                    got.extend(chunk)
+            assert got == [1]  # the committed chunk stands
+            assert ei.value.received == 1
+            assert ei.value.backend == "aa-victim"
+            assert fe._counters["streams_broken"] == 1
+            assert "aa-victim" in fe.membership.lost()
+            # stream 3: the lost pin is gone, the survivor answers a
+            # REAL stream end to end — zero wedged lanes
+            toks = []
+            for chunk in cli.infer_stream("gen", [5, 9],
+                                          max_new_tokens=4,
+                                          trace_id="t-kill",
+                                          deadline_ms=60000):
+                toks.extend(chunk)
+            assert len(toks) == 4
+            assert cli.last_stream_info["backend"] == "zz-survivor"
+        finally:
+            cli.close()
+            stub.close()
+            survivor.shutdown()
+            fe.shutdown()
+
+    def test_repin_counter_on_silent_backend_loss(self, decode_md):
+        """A pin onto a lease that silently expired re-pins onto the
+        survivor set (counted): the KV slots are gone with the
+        backend, the trace is not."""
+        def completing(msg, sock):
+            if msg.get("cmd") != "infer_stream":
+                return {"ok": True}
+            tid = msg["trace_id"]
+            _send_msg(sock, {"chunk": True, "seq": 0, "tokens": [3],
+                             "trace_id": tid})
+            _send_msg(sock, {"ok": True, "done": True,
+                             "trace_id": tid, "new_tokens": 1,
+                             "finish_reason": "length"})
+            return None
+
+        fe = FrontendServer().start()
+        survivor = InferenceServer(federation=fe.endpoint,
+                                   backend_id="zz-survivor").start()
+        cli = ServingClient(fe.endpoint)
+        stub = _StubBackend(completing)
+        try:
+            cli.load_model("gen", decode_md, decode_slots=4)
+            deadline = time.monotonic() + 5
+            while (len(fe._candidates("gen")) < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            _register_stub(fe, stub, "aa-stub", models=("gen",))
+            got = list(cli.infer_stream("gen", [5], max_new_tokens=8,
+                                        trace_id="t-a",
+                                        deadline_ms=30000))
+            assert got == [[3]]
+            assert fe._pinned("t-a") == "aa-stub"
+            fe.membership.suspect("aa-stub", "test")  # silent loss
+            toks = []
+            for chunk in cli.infer_stream("gen", [5],
+                                          max_new_tokens=4,
+                                          trace_id="t-a",
+                                          deadline_ms=60000):
+                toks.extend(chunk)
+            assert len(toks) == 4
+            assert cli.last_stream_info["backend"] == "zz-survivor"
+            assert fe._counters["repins"] == 1
+            assert fe._pinned("t-a") == "zz-survivor"
+        finally:
+            cli.close()
+            stub.close()
+            survivor.shutdown()
+            fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# global fleet over the wire: page-out / fault-in by capacity
+# ---------------------------------------------------------------------------
+
+class TestGlobalFleet:
+    def test_cluster_page_out_then_fault_in_lands_on_capacity(
+            self, fc_md):
+        """Idle past page_ttl everywhere -> paged on EVERY backend;
+        demand faults it back in on the host with the most declared
+        free capacity (acceptance: lands on the capacity host)."""
+        mark = time.time()
+        fe = FrontendServer().start()
+        small = InferenceServer(buckets=(2, 4), federation=fe.endpoint,
+                                backend_id="aa-small",
+                                capacity_mb=50.0).start()
+        big = InferenceServer(buckets=(2, 4), federation=fe.endpoint,
+                              backend_id="zz-big",
+                              capacity_mb=10000.0).start()
+        gf = GlobalFleetController(
+            fe, policies={"*": FleetPolicy(
+                min_replicas=1, max_replicas=2, page_ttl_s=0.2,
+                scale_down_idle_s=9999.0, page_cooldown_s=0.0)},
+            dry_run=False)
+        cli = ServingClient(fe.endpoint)
+        try:
+            cli.load_model("m", fc_md, buckets=[2, 4])
+            deadline = time.monotonic() + 5
+            while (len(fe._candidates("m")) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            cli.infer("m", {"x": np.zeros((1, 4), np.float32)},
+                      deadline_ms=30000)
+            time.sleep(0.25)  # heartbeats carry the request count
+            gf.tick()  # baseline: request deltas + idle clocks
+            time.sleep(0.35)  # idle past page_ttl_s
+            processed = gf.tick()
+            kinds = [a.kind for a, out in processed if out == "ok"]
+            assert kinds == ["page_out"], processed
+            # paged on EVERY backend; heartbeats propagate the flip
+            deadline = time.monotonic() + 3 * TTL
+            while time.monotonic() < deadline:
+                leases = fe.membership.backends()
+                if all("m" in (l.get("paged") or [])
+                       and "m" not in l["models"]
+                       for l in leases.values()):
+                    break
+                time.sleep(0.05)
+            leases = fe.membership.backends()
+            assert all("m" in (l.get("paged") or [])
+                       for l in leases.values()), leases
+            assert fe._candidates("m") == []
+            # demand: the frontend faults in where capacity lives
+            before = dict(fe._placed)
+            out = cli.infer("m", {"x": np.zeros((1, 4), np.float32)},
+                            deadline_ms=60000)
+            assert out[0].shape == (1, 6)
+            assert fe._placed.get("zz-big", 0) \
+                == before.get("zz-big", 0) + 1
+            faults = _events_since(mark, "global_fault_in")
+            assert faults and faults[-1]["backend"] == "zz-big"
+            assert faults[-1]["warm"] is True
+            # the small host is untouched: still paged there
+            deadline = time.monotonic() + 2
+            while ("m" in fe.membership.backends()["aa-small"]["models"]
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert "m" in (fe.membership.backends()["aa-small"]
+                           .get("paged") or [])
+        finally:
+            cli.close()
+            gf.stop()
+            small.shutdown()
+            big.shutdown()
+            fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# health surface
+# ---------------------------------------------------------------------------
+
+class TestHealthAccepting:
+    def test_accepting_flag_tracks_drain_and_resume(self, fc_md):
+        srv = InferenceServer(buckets=(2, 4)).start()
+        cli = ServingClient(srv.endpoint)
+        try:
+            cli.load_model("m", fc_md, buckets=[2, 4])
+            h = cli.health()
+            assert h["accepting"] is True and h["draining"] is False
+            cli.drain()
+            h = cli.health()
+            assert h["accepting"] is False and h["draining"] is True
+            cli.drain(resume=True)
+            assert cli.health()["accepting"] is True
+        finally:
+            cli.close()
+            srv.shutdown()

@@ -774,6 +774,180 @@ def run_fleet_lane(args, backend_label):
         print(json.dumps(rec), flush=True)
 
 
+def _wave_drive(endpoint, model, feed_name, shape, dtype, wave,
+                interval, waves, deadline_ms):
+    """Flash-crowd driver: `waves` bursts of `wave` SIMULTANEOUS
+    requests, `interval` seconds apart, NO client-side shed retries —
+    every request is answered exactly once or definitively dropped
+    (shed / deadline / transport), so `ok` measures ADMISSION under
+    arrival spikes: a single server takes at most queue+lanes of a
+    wave and sheds the rest, the federation spreads the same wave
+    across N queues via least-loaded placement + spillover at equal
+    aggregate compute."""
+    from paddle_tpu.serving import (DeadlineExceeded, ServerOverloaded,
+                                    ServingClient, ServingError)
+    k = wave * waves
+    x = np.zeros((1,) + shape, dtype=dtype)
+    results = [None] * k
+    threads = []
+
+    def fire(i):
+        cli = ServingClient(endpoint)
+        time.sleep((i // wave) * interval)
+        t0 = time.monotonic()
+        try:
+            cli.infer(model, {feed_name: x}, deadline_ms=deadline_ms,
+                      retry_sheds=False)
+            results[i] = ("ok", (time.monotonic() - t0) * 1e3)
+        except ServerOverloaded:
+            results[i] = ("shed", None)
+        except DeadlineExceeded:
+            results[i] = ("deadline", None)
+        except (ServingError, ConnectionError, OSError, EOFError):
+            results[i] = ("conn", None)
+        finally:
+            cli.close()
+
+    for i in range(k):
+        t = threading.Thread(target=fire, args=(i,), daemon=True)
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    oks = sorted(r[1] for r in results if r and r[0] == "ok")
+    outcomes = {}
+    for r in results:
+        key = r[0] if r else "lost"
+        outcomes[key] = outcomes.get(key, 0) + 1
+
+    def pct(q):
+        if not oks:
+            return None
+        return round(oks[min(int(q / 100.0 * (len(oks) - 1)),
+                             len(oks) - 1)], 1)
+
+    first = [r[1] for r in results if r and r[0] == "ok"]
+    return {"sent": k, "ok": len(oks), "dropped": k - len(oks),
+            "shed": outcomes.get("shed", 0),
+            "deadline_expired": outcomes.get("deadline", 0),
+            "conn_failed": outcomes.get("conn", 0),
+            "p50_ms": pct(50), "p95_ms": pct(95),
+            "ttfr_ms": round(first[0], 1) if first else None}
+
+
+def _parse_topology(spec):
+    """'1x4,2x2,4x1' -> [(1, 4), (2, 2), (4, 1)] — N backend servers x
+    R replicas each; every point spends the same total replica
+    budget."""
+    points = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        n, _, r = part.lower().partition("x")
+        points.append((int(n), int(r or 1)))
+    if not points:
+        raise ValueError("empty --topology spec %r" % (spec,))
+    return points
+
+
+def run_topology_lane(args, backend_label):
+    """Federated-serving topology sweep (SERVING.md "Federated
+    serving"): the SAME total replica budget arranged as N backend
+    servers x R replicas each — 1xR is the single-server static
+    control (direct endpoint, no frontend); every N>1 point runs
+    behind the front-door router with per-server leases.  Each point
+    takes the same open-loop flash crowd against deliberately small
+    per-server admission queues: the federated shapes hold N queues
+    plus cross-server spillover where the static control sheds into
+    client retry deadlines, so `ok` — answered exactly once, routing
+    bit-exact — is the headline number (BENCH_r17.json)."""
+    from paddle_tpu.federation import FrontendServer
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.serving import (InferenceServer, ServingClient,
+                                    set_dispatch_delay)
+    workdir = tempfile.mkdtemp(prefix="bench_fed_")
+    model_dir, feed_name, shape, dtype = build_model(
+        "fc", os.path.join(workdir, "m"), seed=17)
+    step_ms = args.dispatch_cost_ms or 25.0
+    lane_qps = 1000.0 / step_ms
+    duration = args.duration if args.duration is not None \
+        else (1.0 if args.smoke else 1.5)
+    deadline_ms = args.deadline_ms or 1500.0
+    queue_per = args.max_queue or 6
+    set_flags({"federation_heartbeat_ms": 150.0})
+
+    for n_srv, n_rep in _parse_topology(args.topology):
+        total = n_srv * n_rep
+        fe, boot, servers = None, None, []
+        rec = {"metric": "serving_federation",
+               "topology": "%dx%d" % (n_srv, n_rep),
+               "servers": n_srv, "replicas_per_server": n_rep,
+               "total_replicas": total, "federated": n_srv > 1,
+               "step_cost_ms": step_ms,
+               "max_queue_per_server": queue_per,
+               "deadline_ms": deadline_ms}
+        try:
+            if n_srv > 1:
+                fe = FrontendServer(ttl_s=2.0).start()
+            for i in range(n_srv):
+                servers.append(InferenceServer(
+                    max_queue=queue_per, buckets=[1],
+                    federation=fe.endpoint if fe else None,
+                    backend_id="b%02d" % i).start())
+            endpoint = fe.endpoint if fe else servers[0].endpoint
+            boot = ServingClient(endpoint)
+            if fe is not None:
+                t0 = time.monotonic()
+                while (time.monotonic() - t0 < 30.0
+                       and len(fe.membership.backends(
+                           accepting_only=True)) < n_srv):
+                    time.sleep(0.02)
+            boot.load_model("m", model_dir, buckets=[1],
+                            replicas=n_rep)  # fans out when federated
+            warm = np.zeros((1,) + shape, dtype=dtype)
+            boot.infer("m", {feed_name: warm}, deadline_ms=60000.0)
+            # routing through the relay must not change one bit —
+            # checked before the dispatch-cost stand-in arms
+            rec["bit_exact"] = bool(_verify_bit_exact(
+                endpoint, "m", model_dir, [1], feed_name, shape,
+                dtype))
+            set_dispatch_delay(step_ms / 1000.0)
+            # flash crowd: simultaneous waves sized past ONE server's
+            # admission (queue + lanes) but under the aggregate
+            # compute — arrival rate at 80% of total capacity, so
+            # what drops is admission, not capacity
+            total_qps = total * lane_qps
+            wave = 24
+            interval = wave / (0.8 * total_qps)
+            waves = max(int(round(duration / interval)), 1)
+            burst = _wave_drive(endpoint, "m", feed_name, shape,
+                                dtype, wave, interval, waves,
+                                deadline_ms)
+            set_dispatch_delay(0.0)
+            rec.update(burst)
+            rec["wave"] = wave
+            rec["wave_interval_ms"] = round(interval * 1e3, 1)
+            rec["target_qps"] = round(wave / interval, 1)
+            rec["answered_rate"] = round(
+                burst["ok"] / float(burst["sent"]), 4)
+            if fe is not None:
+                rec["spillover"] = fe._counters["spillover"]
+                rec["frontend_shed"] = fe._counters["shed"]
+                rec["placed"] = dict(fe._placed)
+        finally:
+            set_dispatch_delay(0.0)
+            if boot is not None:
+                boot.close()
+            for s in servers:
+                s.shutdown(drain=False, timeout=5.0)
+            if fe is not None:
+                fe.shutdown()
+        if backend_label:
+            rec["backend"] = backend_label
+        print(json.dumps(rec), flush=True)
+
+
 def _parse_replica_sweep(spec):
     """'1,4' -> sweep of counts; 'auto' / '4' / 'cpu:0,cpu:1' -> one
     placement spec point (a comma list containing ':' is a device list,
@@ -1054,6 +1228,14 @@ def main():
                     help="batcher coalescing window override "
                          "(default FLAGS.serving_batch_deadline_ms)")
     ap.add_argument("--max_queue", type=int, default=None)
+    ap.add_argument("--topology", default=None,
+                    help="federated topology sweep 'NxR,...': N "
+                         "backend servers x R replicas each behind "
+                         "the front-door router (N=1 = single-server "
+                         "static control, direct endpoint), same "
+                         "total replica budget per point, one flash-"
+                         "crowd burst each (SERVING.md 'Federated "
+                         "serving', BENCH_r17.json)")
     ap.add_argument("--replicas", default="1",
                     help="replica placement spec per point: a count, "
                          "'auto' (one replica per local device), an "
@@ -1147,6 +1329,9 @@ def main():
         else:
             set_flags({"slo_monitor": False, "serving_slo": ""})
 
+    if args.topology:
+        run_topology_lane(args, backend_label)
+        return
     if args.fleet:
         run_fleet_lane(args, backend_label)
         return

@@ -62,6 +62,16 @@ exits nonzero on failure):
                EWMA trip clamp) with overshoot_ms stamped on the
                deadline_expired event, and boundary-freed slots serve
                bit-exact streams on reuse.
+  backend-kill federated-serving chaos (SERVING.md "Federated
+               serving"): N backend subprocesses behind an in-process
+               front-door router, concurrent decode streams pinned
+               across them by session affinity, then SIGKILL one
+               backend mid-stream.  Prove ONLY the victim backend's
+               in-flight streams fail — each with a typed StreamBroken
+               naming the backend and the committed token count, zero
+               hangs — survivors complete bit-exact, the lost lease is
+               evicted within one heartbeat TTL, and a re-placed
+               session lands on a survivor bit-exact with zero sheds.
   spec-fallback
                speculative-decoding chaos (SERVING.md): poison the
                draft predictor MID-STREAM (set_draft_poison) — the
@@ -1875,6 +1885,240 @@ def scenario_flash_crowd(verbose=True):
             "flash_k": FLASH_K}
 
 
+def _child_backend(frontend, backend_id, slow_ms=0.0):
+    """Subprocess target (--child-backend): one federated backend — an
+    InferenceServer that registers with the front-door `frontend` and
+    heartbeats until the parent kills it.  Models arrive via the
+    frontend's load_model fan-out; `slow_ms` stretches every dispatch
+    so "mid-stream" is unambiguous when the parent delivers SIGKILL."""
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.serving import InferenceServer, set_dispatch_delay
+    set_flags({"federation_heartbeat_ms": 200.0,
+               "compile_cache": False})
+    srv = InferenceServer(federation=frontend,
+                          backend_id=backend_id).start()
+    if slow_ms:
+        set_dispatch_delay(slow_ms / 1000.0)
+    print("BACKEND_READY %s %s" % (backend_id, srv.endpoint),
+          flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def _spawn_backend_child(frontend, backend_id, slow_ms):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child-backend",
+         frontend, "--backend-id", backend_id,
+         "--slow-ms", str(slow_ms)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+
+
+def scenario_backend_kill(workdir, verbose=True):
+    """Federated serving under backend loss (SERVING.md "Federated
+    serving"): two backend SUBPROCESSES register with an in-process
+    FrontendServer, concurrent decode streams ride the router's
+    session affinity across both, and one backend takes a real SIGKILL
+    mid-stream.  Required invariants:
+
+    1. blast radius — ONLY streams pinned to the killed backend fail,
+       each with a typed StreamBroken naming that backend and the
+       token count already committed (the relayed chunks are a prefix
+       of the reference, never garbage); streams on the survivor
+       complete bit-identical to a direct greedy decode; NOTHING
+       hangs;
+    2. membership — the lost lease leaves the accepting set within one
+       heartbeat TTL of the kill (transport evidence beats the TTL:
+       the relay's failed read suspects it immediately) and lands in
+       the lost list with a backend_lost event;
+    3. re-placement — a new stream for a broken session re-places on
+       the survivor and answers its FIRST token within one TTL,
+       bit-exact from token 0 (the dead backend's KV is gone; the
+       stream restarts, never resumes);
+    4. accounting — streams_broken == the victim's in-flight streams,
+       shed == 0 (loss must not masquerade as overload)."""
+    import tempfile
+    from paddle_tpu.federation import FrontendServer
+    from paddle_tpu.flags import set_flags, get_flags
+    from paddle_tpu.inference.decode import (GenerativePredictor,
+                                             build_tiny_decode_model,
+                                             greedy_decode)
+    from paddle_tpu.obs import events as obs_events
+    from paddle_tpu.serving import ServingClient, StreamBroken
+
+    TTL = 2.0        # lease TTL; children beat at 200 ms
+    K = 4            # concurrent streams (affinity spreads them 2+2)
+    BUDGET = 48      # tokens per stream
+    STEP_MS = 60.0   # child-side per-dispatch stall
+    os.makedirs(workdir, exist_ok=True)
+    md = build_tiny_decode_model(
+        os.path.join(workdir, "lm"), vocab_size=64, d_model=32,
+        n_heads=4, n_layers=2, max_seq_len=64, eos_id=-1, seed=21)
+    pred = GenerativePredictor(md)
+    prompts = [[3, 5, 7], [9, 4], [11, 12, 13], [2, 6]]
+    refs = [greedy_decode(pred, p, BUDGET)[0] for p in prompts]
+
+    saved = get_flags(["federation_heartbeat_ms"])
+    set_flags({"federation_heartbeat_ms": 200.0})
+    fe = FrontendServer(ttl_s=TTL).start()
+    boot = ServingClient(fe.endpoint)
+    procs = {}
+    try:
+        for bid in ("be0", "be1"):
+            procs[bid] = _spawn_backend_child(fe.endpoint, bid, STEP_MS)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 90.0:
+            if len(fe.membership.backends(accepting_only=True)) == 2:
+                break
+            time.sleep(0.05)
+        live = fe.membership.backends(accepting_only=True)
+        assert len(live) == 2, \
+            "backends never registered with the frontend: %s" \
+            % sorted(live)
+        boot.load_model("lm", md, decode_slots=4)  # fan-out to both
+
+        toks = [[] for _ in range(K)]
+        errors = [None] * K
+
+        def stream(i):
+            c = ServingClient(fe.endpoint)
+            try:
+                for ch in c.infer_stream("lm", prompts[i],
+                                         max_new_tokens=BUDGET,
+                                         deadline_ms=120000.0,
+                                         trace_id="bk%d" % i):
+                    toks[i].extend(ch)
+            except StreamBroken as e:
+                errors[i] = e
+            except Exception as e:   # anything untyped fails the run
+                errors[i] = e
+            finally:
+                c.close()
+
+        threads = []
+        for i in range(K):
+            t = threading.Thread(target=stream, args=(i,), daemon=True)
+            threads.append(t)
+            t.start()
+            time.sleep(0.15)   # let inflight counts settle placement
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30.0:
+            if all(len(ts) >= 2 for ts in toks):
+                break
+            time.sleep(0.02)
+        assert all(len(ts) >= 2 for ts in toks), \
+            "streams never got going: %s" % [len(ts) for ts in toks]
+        pins = {i: fe._affinity.get("bk%d" % i) for i in range(K)}
+        by_bid = {}
+        for i, b in pins.items():
+            by_bid.setdefault(b, []).append(i)
+        assert len(by_bid) == 2 and None not in by_bid, \
+            "placement did not spread the streams: %r" % pins
+        victim_bid = min(by_bid, key=lambda b: (len(by_bid[b]), b))
+        survivor_bid = next(b for b in by_bid if b != victim_bid)
+        victims = by_bid[victim_bid]
+        survivors = by_bid[survivor_bid]
+
+        # ---- the kill: a real SIGKILL mid-stream -------------------
+        kill_t = time.monotonic()
+        os.kill(procs[victim_bid].pid, signal.SIGKILL)
+        procs[victim_bid].wait(timeout=10)
+        evicted_s = None
+        while time.monotonic() - kill_t < TTL + 2.0:
+            if victim_bid not in fe.membership.backends(
+                    accepting_only=True):
+                evicted_s = time.monotonic() - kill_t
+                break
+            time.sleep(0.02)
+        assert evicted_s is not None and evicted_s <= TTL + 0.5, \
+            "lost backend still accepting %.2fs after SIGKILL " \
+            "(TTL %.1fs)" % (evicted_s or -1.0, TTL)
+        for t in threads:
+            t.join(timeout=90)
+        assert not any(t.is_alive() for t in threads), \
+            "streams HUNG after the backend kill"
+
+        # (1) blast radius: typed loss for victims, bit-exact survivors
+        for i in victims:
+            e = errors[i]
+            assert isinstance(e, StreamBroken), \
+                "victim stream %d surfaced %r, want StreamBroken" \
+                % (i, e)
+            assert e.backend == victim_bid, \
+                "StreamBroken names %r, want %r" % (e.backend,
+                                                    victim_bid)
+            assert e.received == len(toks[i]) >= 2, \
+                "committed-token accounting broke: received=%d, " \
+                "yielded=%d" % (e.received, len(toks[i]))
+            assert toks[i] == refs[i][:len(toks[i])], \
+                "victim %d's committed chunks are not a reference " \
+                "prefix" % i
+        for i in survivors:
+            assert errors[i] is None, \
+                "survivor stream %d failed: %r" % (i, errors[i])
+            assert toks[i] == refs[i], \
+                "survivor stream %d not bit-exact" % i
+
+        # (2) membership: lost list + event
+        assert victim_bid in fe.membership.lost(), \
+            "killed backend missing from the lost list"
+        assert any(e.get("backend") == victim_bid for e in
+                   obs_events.recent_events(kind="backend_lost")), \
+            "no backend_lost event for the killed backend"
+
+        # (3) re-placement: the broken session restarts on the
+        # survivor, first token within one TTL, bit-exact from 0
+        rv = victims[0]
+        c = ServingClient(fe.endpoint)
+        try:
+            t0 = time.monotonic()
+            out, first_tok_s = [], None
+            for ch in c.infer_stream("lm", prompts[rv],
+                                     max_new_tokens=BUDGET,
+                                     deadline_ms=120000.0,
+                                     trace_id="bk%d" % rv):
+                if first_tok_s is None:
+                    first_tok_s = time.monotonic() - t0
+                out.extend(ch)
+        finally:
+            c.close()
+        assert first_tok_s is not None and first_tok_s <= TTL, \
+            "re-placed stream's first token took %.2fs (TTL %.1fs)" \
+            % (first_tok_s or -1.0, TTL)
+        assert out == refs[rv], "re-placed stream not bit-exact"
+        assert fe._affinity.get("bk%d" % rv) == survivor_bid, \
+            "re-placed session not pinned to the survivor"
+
+        # (4) accounting: loss is loss, not overload
+        assert fe._counters["streams_broken"] == len(victims), \
+            "streams_broken=%d, want %d" \
+            % (fe._counters["streams_broken"], len(victims))
+        assert fe._counters["shed"] == 0, \
+            "backend loss was shed as overload (%d sheds)" \
+            % fe._counters["shed"]
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        boot.close()
+        fe.shutdown()
+        set_flags(saved)
+    if verbose:
+        print("PASS backend-kill: %d/%d streams on the victim broke "
+              "typed (committed prefixes intact), %d survivor "
+              "stream(s) bit-exact, lease evicted %.2fs after SIGKILL "
+              "(TTL %.1fs), re-placed session first token %.2fs on "
+              "the survivor, shed=0, zero hangs"
+              % (len(victims), K, len(survivors), evicted_s, TTL,
+                 first_tok_s))
+    return {"victims": len(victims), "survivors": len(survivors),
+            "evicted_s": round(evicted_s, 3),
+            "replace_first_token_s": round(first_tok_s, 3)}
+
+
 def run_smoke(workdir):
     """Tier-1 smoke: deterministic crash at every commit point + the
     bit-flip rejection — no timing races, CPU-only, a few seconds."""
@@ -1909,7 +2153,8 @@ def main(argv=None):
                                            "decode-disconnect-fused",
                                            "spec-fallback",
                                            "slo-breach",
-                                           "flash-crowd", "all"])
+                                           "flash-crowd",
+                                           "backend-kill", "all"])
     ap.add_argument("--smoke", action="store_true",
                     help="fast deterministic subset for CI")
     ap.add_argument("--workdir", default=None)
@@ -1928,6 +2173,12 @@ def main(argv=None):
                     help=argparse.SUPPRESS)  # internal subprocess target
     ap.add_argument("--child-flight", metavar="DIR",
                     help=argparse.SUPPRESS)  # internal subprocess target
+    ap.add_argument("--child-backend", metavar="ENDPOINT",
+                    help=argparse.SUPPRESS)  # internal subprocess target
+    ap.add_argument("--backend-id", default=None,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--slow-ms", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
     ap.add_argument("--chaos-spec", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--chaos-at-save", type=int, default=0,
                     help=argparse.SUPPRESS)
@@ -1946,6 +2197,10 @@ def main(argv=None):
     if args.child_flight:
         _child_flight(args.child_flight)
         return 0
+    if args.child_backend:
+        _child_backend(args.child_backend, args.backend_id,
+                       slow_ms=args.slow_ms)
+        return 0
 
     import tempfile
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_")
@@ -1957,7 +2212,8 @@ def main(argv=None):
                      "quantize-commit", "trace-overflow",
                      "decode-disconnect", "decode-disconnect-int8",
                      "decode-disconnect-fused",
-                     "spec-fallback", "slo-breach", "flash-crowd"]
+                     "spec-fallback", "slo-breach", "flash-crowd",
+                     "backend-kill"]
     else:
         scenarios = [args.scenario]
     rc = 0
@@ -2005,6 +2261,9 @@ def main(argv=None):
                 scenario_slo_breach(os.path.join(workdir, "slo_breach"))
             elif s == "flash-crowd":
                 scenario_flash_crowd()
+            elif s == "backend-kill":
+                scenario_backend_kill(
+                    os.path.join(workdir, "backend_kill"))
         except AssertionError as e:
             rc = 1
             print("FAIL %s: %s" % (s, e))

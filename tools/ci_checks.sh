@@ -40,6 +40,13 @@
 #                        N=1 greedy oracle, with dispatches cut ~N-fold
 #                        (SERVING.md "Fused multi-step decode",
 #                        tests/test_decode_serving.py)
+#     18  federation     federated-serving chaos: the backend-kill
+#                        scenario — backend subprocesses behind the
+#                        front-door router, SIGKILL one mid-stream;
+#                        only the victim's streams break (typed, zero
+#                        hangs), survivors bit-exact, lease evicted
+#                        within one TTL, re-placement on the survivor
+#                        (SERVING.md "Federated serving")
 #      1  usage          unknown gate name
 #      0  all requested gates clean
 #
@@ -56,7 +63,7 @@ SPEC="${API_SPEC:-API.spec}"
 gates=("$@")
 if [ ${#gates[@]} -eq 0 ]; then
     gates=(lint_runtime lint_program apispec specdec slo kernels fleet
-           fused_decode)
+           fused_decode federation)
 fi
 
 for gate in "${gates[@]}"; do
@@ -115,10 +122,14 @@ for gate in "${gates[@]}"; do
             "$PY" -m pytest tests/test_decode_serving.py -q \
                 -k "fused_gate_smoke" -p no:cacheprovider || exit 17
             ;;
+        federation)
+            echo "== ci_checks: federation gate =="
+            "$PY" tools/chaos.py --scenario backend-kill || exit 18
+            ;;
         *)
             echo "ci_checks: unknown gate '$gate'" \
                  "(have: lint_runtime lint_program apispec specdec" \
-                 "slo kernels fleet fused_decode)"
+                 "slo kernels fleet fused_decode federation)"
             exit 1
             ;;
     esac

@@ -40,6 +40,10 @@ MODULES = [
     "paddle_tpu.inference",
     "paddle_tpu.serving",
     "paddle_tpu.serving.fleet",
+    "paddle_tpu.federation",
+    "paddle_tpu.federation.membership",
+    "paddle_tpu.federation.frontend",
+    "paddle_tpu.federation.global_fleet",
     "paddle_tpu.obs",
     "paddle_tpu.obs.tracing",
     "paddle_tpu.obs.events",

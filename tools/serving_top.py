@@ -17,6 +17,16 @@ without a controller — SERVING.md "Fleet controller"), from the
 request from residency).  `--json` dumps the raw snapshot (plus
 sibling "health" and "fleet" keys) for scripts.
 
+Pointed at a federation frontend (SERVING.md "Federated serving") the
+same `stats` verb answers with a merged cross-backend snapshot plus a
+"federation" key, rendered as a backend table first: lease state
+(live / DRAINING / LOST — draining is a live lease excluded from
+placement, lost is an expired one), heartbeat age, queue depth,
+frontend in-flight/placed counts, capacity, and the routing counters
+(placed / spillover / shed / broken / repins).  A draining single
+server shows a [DRAINING] banner from the health verb's `accepting`
+flag.
+
 Usage: python tools/serving_top.py HOST:PORT [--json]
 """
 
@@ -94,12 +104,74 @@ def _fleet_cols(name, desc, fleet):
     return _fmt(repl), fleet_col
 
 
+def _federation_lines(fed):
+    """The front-door view (SERVING.md "Federated serving"): one row
+    per leased backend — drain state, lease age vs TTL, heartbeat-fed
+    queue depth, frontend in-flight/placed, capacity — plus recent
+    losses and the routing counters (spillover-before-shed at a
+    glance)."""
+    backs = fed.get("backends") or {}
+    counters = fed.get("counters") or {}
+    inflight = fed.get("inflight") or {}
+    placed = fed.get("placed") or {}
+    lines = ["federation: %d backend(s), revision %s, ttl %ss  "
+             "placed=%s spillover=%s shed=%s broken=%s repins=%s"
+             % (len(backs), fed.get("revision"), fed.get("ttl_s"),
+                sum(placed.values()), counters.get("spillover", 0),
+                counters.get("shed", 0),
+                counters.get("streams_broken", 0),
+                counters.get("repins", 0)), ""]
+    hdr = ("%-12s %-21s %-9s %6s %6s %6s %7s %11s  %s"
+           % ("BACKEND", "ENDPOINT", "STATE", "AGE", "QUEUE",
+              "INFLT", "PLACED", "MB", "MODELS"))
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for bid in sorted(backs):
+        l = backs[bid]
+        # DRAINING is visibly distinct from dead: the lease is still
+        # here (alive, finishing streams), placement just skips it
+        state = "DRAINING" if l.get("draining") else (
+            "live" if l.get("accepting", True) else "no-accept")
+        cap = l.get("capacity_mb") or 0
+        mb = ("%.0f/%.0f" % (l.get("resident_mb", 0), cap)
+              if cap else _fmt(round(l.get("resident_mb", 0))))
+        lines.append(
+            "%-12s %-21s %-9s %6s %6s %6s %7s %11s  %s"
+            % (bid[:12], l.get("endpoint", "-")[:21], state,
+               _fmt(l.get("age_s")),
+               _fmt((l.get("load") or {}).get("queue_depth")),
+               _fmt(inflight.get(bid, 0)), _fmt(placed.get(bid, 0)),
+               mb, ",".join(sorted(l.get("models") or {})) or "-"))
+    for bid, rec in sorted((fed.get("lost") or {}).items()):
+        # dead, not draining: lease expired / hard transport evidence
+        lines.append("%-12s %-21s %-9s %6s  (%s)"
+                     % (bid[:12], rec.get("endpoint", "-")[:21],
+                        "LOST", _fmt(rec.get("age_s")),
+                        rec.get("reason", "?")))
+    gf = fed.get("global_fleet")
+    if gf:
+        lines.append(
+            "global fleet: ticks=%s dry_run=%s actions=%s"
+            % (gf.get("ticks"), gf.get("dry_run"),
+               gf.get("actions") or {}))
+    lines.append("")
+    return lines
+
+
 def render(reply, health=None, fleet=None):
     stats = reply.get("stats", {})
     models = stats.get("models", {})
     desc = reply.get("models", {})
-    lines = ["server uptime %.0fs, %d model(s)"
-             % (stats.get("uptime_sec", 0.0), len(models)), ""]
+    banner = "server uptime %.0fs, %d model(s)" \
+        % (stats.get("uptime_sec", 0.0), len(models))
+    if health is not None and health.get("accepting") is False:
+        # the drain-vs-dead disambiguation the health verb carries:
+        # this server answers but refuses new admissions
+        banner += "  [DRAINING]"
+    lines = [banner, ""]
+    if reply.get("federation"):
+        # stats came from a federation frontend: backend table first
+        lines.extend(_federation_lines(reply["federation"]))
     hdr = ("%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
            "%7s %7s %5s %5s %5s %7s %6s %5s %6s"
            % ("MODEL", "PREC", "VER", "QPS", "REQS", "p50ms", "p95ms",

@@ -208,6 +208,19 @@ def main():
         ("fleet", ["tools/bench_serving.py", "--require_tpu",
                    "--fleet", "both", "--dispatch_cost_ms", "20",
                    "--duration", "15"], {}, 3600),
+        # federated serving (SERVING.md "Federated serving"): the
+        # topology sweep — the same total replica budget as 1 server
+        # x4 replicas, 2x2, and 4x1 behind the front-door router,
+        # flash-crowded.  On silicon the REAL numbers are the relay
+        # hop's added TTFR/p95 (one extra host round-trip per chunk)
+        # and whether N admission queues hold the answered-rate edge
+        # the CPU smoke (BENCH_r17.json) shows; the burst stays on the
+        # deterministic --dispatch_cost_ms stand-in so the topology
+        # A/B is load-calibrated across shapes
+        ("federation", ["tools/bench_serving.py", "--require_tpu",
+                        "--topology", "1x4,2x2,4x1",
+                        "--dispatch_cost_ms", "20",
+                        "--duration", "15"], {}, 3600),
         # quantized serving A/B on silicon (QUANTIZE.md): resnet fp32
         # vs PTQ-int8 behind the precision axis — on the HBM-roofline-
         # bound chip the int8 lane's halved weight bytes should show up

@@ -180,7 +180,8 @@ class ResourceReport:
     __slots__ = ("what", "batch", "param_bytes", "activation_peak_bytes",
                  "kv_cache_bytes", "actual_param_bytes", "total_flops",
                  "total_bytes", "device", "ops", "per_block",
-                 "top_contributors", "peak_op", "n_ops", "precision")
+                 "top_contributors", "peak_op", "n_ops", "precision",
+                 "mesh_size")
 
     def __init__(self, what="program", batch=1):
         self.what = what
@@ -198,6 +199,11 @@ class ResourceReport:
         self.peak_op = None
         self.n_ops = 0
         self.precision = "fp32"
+        # devices per replica (SERVING.md "Mesh replicas"): params + KV
+        # shard at rest over the mesh, so the PER-DEVICE resident
+        # estimate divides by this while activations (replicated
+        # compute) do not
+        self.mesh_size = 1
 
     @property
     def peak_bytes(self):
@@ -207,6 +213,23 @@ class ResourceReport:
     @property
     def peak_mb(self):
         return self.peak_bytes / float(1 << 20)
+
+    def per_device_bytes(self, mesh_size=None):
+        """Estimated resident bytes on EACH member device of a
+        `mesh_size`-device replica (default: the report's own
+        ``mesh_size``): params + KV cache shard ~1/mesh (ceil), the
+        replicated-compute activation peak does not.  mesh_size 1 is
+        exactly ``peak_bytes`` — the single-device admission number."""
+        m = max(int(self.mesh_size if mesh_size is None else mesh_size),
+                1)
+        if m == 1:
+            return int(self.peak_bytes)
+        sharded = int(self.param_bytes) + int(self.kv_cache_bytes)
+        return -(-sharded // m) + int(self.activation_peak_bytes)
+
+    @property
+    def per_device_mb(self):
+        return self.per_device_bytes() / float(1 << 20)
 
     @property
     def arithmetic_intensity(self):
@@ -251,6 +274,9 @@ class ResourceReport:
             "kv_cache_bytes": int(self.kv_cache_bytes),
             "peak_bytes": int(self.peak_bytes),
             "peak_mb": round(self.peak_mb, 3),
+            "mesh_size": int(self.mesh_size),
+            "per_device_bytes": int(self.per_device_bytes()),
+            "per_device_mb": round(self.per_device_mb, 3),
             "actual_param_bytes": self.actual_param_bytes,
             "total_flops": int(self.total_flops),
             "total_bytes": int(self.total_bytes),
@@ -798,8 +824,18 @@ def _decode_report(path, meta, decode_slots, device, what,
     return rep
 
 
+def _with_mesh(rep, mesh_size):
+    """Stamp a replica mesh size on a report (SERVING.md "Mesh
+    replicas") — makes ``per_device_bytes`` the 1/mesh sharded-at-rest
+    estimate the per-member fit check admits on."""
+    if mesh_size:
+        rep.mesh_size = max(int(mesh_size), 1)
+    return rep
+
+
 def analyze_artifact(path, batch=1, decode_slots=None, device=None,
-                     kv_cache_dtype=None, fuse_steps=None):
+                     kv_cache_dtype=None, fuse_steps=None,
+                     mesh_size=None):
     """Static resource report for a saved artifact dir — the admission
     gate's input, and lint_program --report's row source.
 
@@ -810,16 +846,20 @@ def analyze_artifact(path, batch=1, decode_slots=None, device=None,
     (`kv_cache_dtype` overrides the artifact's pin — the load_model
     knob, and ``fuse_steps`` prices the N-step fused dispatch at N·step
     FLOPs/bytes with the peak unchanged); save_aot dirs (aot_meta.bin)
-    from their state payload + feed specs."""
+    from their state payload + feed specs.  ``mesh_size`` stamps a
+    mesh-replica shape on the report: total bytes are unchanged, but
+    ``per_device_bytes`` (what `check_fit` prices per mesh member)
+    reads params + KV at ~1/mesh_size."""
     from ..inference.decode import DECODE_META
     dm = os.path.join(path, DECODE_META)
     if os.path.exists(dm):
         from ..native import wire
         with open(dm, "rb") as f:
             meta = wire.decode(f.read())
-        return _decode_report(path, meta, decode_slots, device, path,
-                              kv_cache_dtype=kv_cache_dtype,
-                              fuse_steps=fuse_steps)
+        return _with_mesh(
+            _decode_report(path, meta, decode_slots, device, path,
+                           kv_cache_dtype=kv_cache_dtype,
+                           fuse_steps=fuse_steps), mesh_size)
     am = os.path.join(path, "aot_meta.bin")
     if os.path.exists(am):
         from ..native import wire
@@ -840,7 +880,7 @@ def analyze_artifact(path, batch=1, decode_slots=None, device=None,
         rep.activation_peak_bytes = act
         rep.total_bytes = rep.param_bytes + act
         rep.total_flops = (rep.param_bytes // 4) * 2 * int(batch)
-        return rep
+        return _with_mesh(rep, mesh_size)
     model_file = os.path.join(path, "__model__")
     if not os.path.exists(model_file):
         raise FileNotFoundError(
@@ -864,19 +904,28 @@ def analyze_artifact(path, batch=1, decode_slots=None, device=None,
             actual += max(os.path.getsize(fpath) - 128, 0)
     if actual:
         rep.actual_param_bytes = actual
-    return rep
+    return _with_mesh(rep, mesh_size)
 
 
-def check_fit(report, device=None, what=None, replicas=1):
+def check_fit(report, device=None, what=None, replicas=1,
+              mesh_size=None):
     """Serving admission gate: raise :class:`ResourceFitError` when the
     report's per-replica peak exceeds the device budget
     (``device_memory_bytes``).  Returns (estimated, available) — with
     available None (no known budget) the check passes trivially.
 
     ``replicas`` multiplies the estimate for placements putting several
-    replicas on ONE device (the [None] single-default-device spec)."""
+    replicas on ONE device (the [None] single-default-device spec).
+
+    ``mesh_size`` > 1 (SERVING.md "Mesh replicas") prices the
+    PER-MEMBER estimate — params + KV shard ~1/mesh at rest, the
+    replicated-compute activation peak does not — against ONE member
+    device's budget (`device` should be that member): how a model too
+    big for any single chip admits on a mesh.  Default: the report's
+    own stamped ``mesh_size``."""
     avail = device_memory_bytes(device)
-    est = int(report.peak_bytes) * max(int(replicas), 1)
+    est = int(report.per_device_bytes(mesh_size)) \
+        * max(int(replicas), 1)
     if avail is not None and est > avail:
         raise ResourceFitError(what or report.what, est, avail,
                                device=device)

@@ -328,9 +328,15 @@ DEFINE_string(
     "device replica — the pre-multichip behavior); 'auto' places one "
     "replica per local device; an explicit comma list names devices "
     "('0,2' = local device indices, 'cpu:0,tpu:3' = platform:index). "
-    "Each replica's params live on its device and its batch buckets "
-    "compile and warm there; a router assigns each coalesced micro-"
-    "batch group to the least-loaded replica.")
+    "Mesh replicas (SERVING.md 'Mesh replicas'): 'mesh:2' or 'mesh:2x2' "
+    "packs the whole host into device meshes of that size, one replica "
+    "per mesh (params + KV cache sharded across the members, replies "
+    "bit-exact vs a single-device replica); '+' inside a comma list "
+    "builds one mesh replica from named members ('tpu:0+tpu:1,"
+    "tpu:2+tpu:3'); a member may not repeat across replicas. "
+    "Each replica's params live on its device (or mesh) and its batch "
+    "buckets compile and warm there; a router assigns each coalesced "
+    "micro-batch group to the least-loaded replica.")
 DEFINE_int(
     "serving_lane_depth", 1,
     "Per-replica dispatch lane bound: at most this many coalesced "

@@ -325,9 +325,11 @@ class GenerativePredictor:
                 if self._kv_dtype == "int8" else None
         self._device = device
         if device is not None:
-            import jax
-            self._state = {n: jax.device_put(np.asarray(v), device)
-                           for n, v in self._state_host.items()}
+            from paddle_tpu.inference.predictor import _put_state
+            # a MeshGroup placement shards every param at rest over the
+            # mesh (SERVING.md "Mesh replicas"); a plain device is the
+            # legacy single-chip pin
+            self._state = _put_state(self._state_host, device)
         else:
             self._state = {n: np.asarray(v)
                            for n, v in self._state_host.items()}
@@ -409,12 +411,15 @@ class GenerativePredictor:
                 if prompt_len in self._overflow_warned:
                     return int(prompt_len)
                 self._overflow_warned.add(prompt_len)
+            from paddle_tpu.inference.predictor import _device_label
             warnings.warn(
                 "prompt of %d tokens exceeds every configured prefill "
-                "bucket %s — falling through to an unbucketed exact-"
-                "length prefill compile; extend prefill_buckets to "
-                "avoid a compile per distinct overflow length"
-                % (prompt_len, tuple(buckets)), RuntimeWarning,
+                "bucket %s on replica device [%s] — falling through to "
+                "an unbucketed exact-length prefill compile; extend "
+                "prefill_buckets to avoid a compile per distinct "
+                "overflow length"
+                % (prompt_len, tuple(buckets),
+                   _device_label(self._device)), RuntimeWarning,
                 stacklevel=3)
         return int(prompt_len)
 
@@ -872,11 +877,50 @@ class GenerativePredictor:
             self._fns[phase_key] = fn
             return fn
 
+    def _mesh_group(self):
+        from paddle_tpu.parallel.mesh import as_mesh_group
+        return as_mesh_group(self._device)
+
+    def _mesh_specs(self, group, state_spec, arg_specs, jax):
+        """Attach the at-rest shardings to the phase's arg specs so the
+        direct lower().compile() matches what the session actually
+        passes: params sharded per `param_sharding`, 5-D KV slot tables
+        per `kv_sharding`, everything else replicated.  Dict-shaped args
+        (the fused-speculative phase's DRAFT state) shard like params —
+        the draft rides the same mesh group as its target lane."""
+        def attach(s, sh):
+            return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)
+
+        def one(spec):
+            if isinstance(spec, dict):
+                return {k: attach(v, group.param_sharding(v.shape))
+                        for k, v in spec.items()}
+            if len(spec.shape) == 5:
+                return attach(spec, group.kv_sharding(spec.shape))
+            return attach(spec, group.replicated())
+
+        state_spec = {n: attach(s, group.param_sharding(s.shape))
+                      for n, s in state_spec.items()}
+        return state_spec, tuple(one(s) for s in arg_specs)
+
     def _resolve_locked(self, phase_key, math_fn, arg_specs, _time, jax):
         from paddle_tpu import compile_cache as cc
         state_spec = {n: jax.ShapeDtypeStruct(np.shape(v),
                                               np.asarray(v).dtype)
                       for n, v in self._state_host.items()}
+        group = self._mesh_group()
+        if group is not None:
+            # meshed phases compile directly against the sharded state
+            # (no export: a serialized blob has a single-device calling
+            # convention).  The math is wrapped in the replicate-compute
+            # contract (predictor._mesh_wrap) so streams stay bit-exact
+            # vs a single-device replica; KV outputs re-shard at rest.
+            from paddle_tpu.inference.predictor import _mesh_wrap
+            state_spec, arg_specs = self._mesh_specs(
+                group, state_spec, arg_specs, jax)
+            return self._jit_fallback(
+                _mesh_wrap(math_fn, group, kv_outputs=True),
+                state_spec, arg_specs)
         if cc.cache_enabled() and not (
                 self._device is not None
                 and self._device.platform != jax.default_backend()):
@@ -1061,7 +1105,15 @@ class DecodeSession:
         z = jnp.zeros(shape, jnp.int8 if predictor._kv_quant
                       else jnp.float32)
         if predictor.device is not None:
-            z = jax.device_put(z, predictor.device)
+            from paddle_tpu.parallel.mesh import as_mesh_group
+            group = as_mesh_group(predictor.device)
+            if group is not None:
+                # the slot table shards AT REST across the mesh (heads
+                # axis first) — per-device resident KV ~ 1/mesh_size,
+                # which is what makes decode slots scale with mesh HBM
+                z = jax.device_put(z, group.kv_sharding(shape))
+            else:
+                z = jax.device_put(z, predictor.device)
         self._kc = z
         self._vc = z
         self.lengths = np.zeros(self.n_slots, np.int32)
@@ -1090,9 +1142,9 @@ class DecodeSession:
     # -- phases ---------------------------------------------------------
 
     def _put(self, arr):
-        import jax
         if self.predictor.device is not None:
-            return jax.device_put(arr, self.predictor.device)
+            from paddle_tpu.inference.predictor import _put_feed
+            return _put_feed(arr, self.predictor.device)
         return arr
 
     def prefill(self, slot, tokens):
@@ -1100,6 +1152,8 @@ class DecodeSession:
         `slot`, and return the first generated token (greedy).  The
         slot must be free (and therefore zeroed)."""
         import jax.lax
+        from paddle_tpu.parallel.mesh import check_member_poison
+        check_member_poison(self.predictor.device)
         if self.active[slot]:
             raise ValueError("slot %d is occupied" % slot)
         tokens = np.asarray(tokens, np.int32).reshape(-1)
@@ -1128,6 +1182,8 @@ class DecodeSession:
         np.int32 [n_slots] token vector (only entries of slots active
         at call time are meaningful).  Bumps each active slot's length
         and last token."""
+        from paddle_tpu.parallel.mesh import check_member_poison
+        check_member_poison(self.predictor.device)
         fn = self.predictor.step_fn(self.n_slots)
         new_tok, self._kc, self._vc = fn(
             self.predictor._state, self._kc, self._vc,
@@ -1158,6 +1214,8 @@ class DecodeSession:
         T = int(n_steps)
         if T < 1:
             raise ValueError("n_steps must be >= 1, got %d" % T)
+        from paddle_tpu.parallel.mesh import check_member_poison
+        check_member_poison(self.predictor.device)
         act = self.active
         if budget is None:
             b = np.where(act, T, 0).astype(np.int32)
@@ -1404,6 +1462,10 @@ class SpeculativeDecodeSession:
         traced math; only the dispatch count changes.  Draft-poison
         chaos still fires per logical draft step (checked host-side
         before the dispatch), degrading to the same plain round."""
+        from paddle_tpu.parallel.mesh import check_member_poison
+        # a lost mesh member kills the TARGET lane whole (typed, never
+        # wedged) — unlike a draft death, which only degrades the round
+        check_member_poison(self.predictor.device)
         ts = self.session
         k = self.spec_k
         C = k + 1

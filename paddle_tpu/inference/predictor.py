@@ -30,6 +30,32 @@ __all__ = ["NativeConfig", "AnalysisConfig", "PaddleTensor", "Predictor",
 # replica falls back to direct compilation without retrying the export
 _UNEXPORTABLE = object()
 
+# mesh placements an AotPredictor has already warned about degrading
+# (once per mesh label per process, not once per replica build)
+_AOT_MESH_WARNED = set()
+
+
+def _aot_degrade_mesh(device):
+    """Serialized AOT exports carry a single-device calling convention —
+    they cannot run sharded.  A mesh placement degrades LOUDLY (warn
+    once per mesh) to the group's primary member so the artifact still
+    serves; use Predictor/GenerativePredictor artifacts for real mesh
+    replicas (SERVING.md "Mesh replicas")."""
+    group = _mesh_of(device)
+    if group is None:
+        return device
+    lbl = group.label()
+    if lbl not in _AOT_MESH_WARNED:
+        _AOT_MESH_WARNED.add(lbl)
+        warnings.warn(
+            "AOT artifacts cannot shard across a mesh — replica "
+            "placement %s degrades to its primary member %s (serialized "
+            "exports have a single-device calling convention; serve a "
+            "Program or decode artifact to use the mesh)"
+            % (lbl, _device_label(group.primary)),
+            RuntimeWarning, stacklevel=3)
+    return group.primary
+
 
 def _amp_enabled():
     from paddle_tpu.ops.registry import amp_enabled
@@ -82,13 +108,82 @@ class AnalysisConfig(NativeConfig):
 
 
 def _device_label(device):
-    """Stable wire-encodable device id ('cpu:0', 'tpu:3') for metrics
+    """Stable wire-encodable device id ('cpu:0', 'tpu:3' — or the
+    '+'-joined member list 'tpu:0+tpu:1' for a mesh group) for metrics
     and the per-replica stats the serving layer surfaces; 'default' when
-    the predictor floats on jax's default device."""
+    the predictor floats on jax's default device.  Mesh labels parse
+    back through `model_registry.resolve_placement`, which is what lets
+    a persisted lane spec replay a mesh placement verbatim."""
     if device is None:
         return "default"
+    group = _mesh_of(device)
+    if group is not None:
+        return group.label()
     return "%s:%d" % (getattr(device, "platform", "dev"),
                       getattr(device, "id", 0))
+
+
+def _mesh_of(device):
+    """The device as a MeshGroup, or None for a plain device."""
+    from paddle_tpu.parallel.mesh import as_mesh_group
+    return as_mesh_group(device)
+
+
+def _put_state(state, device):
+    """Commit a param dict to its placement: plain device -> device_put;
+    mesh group -> every param SHARDED AT REST over the mesh
+    (`MeshGroup.param_sharding` — per-device resident bytes ~
+    1/mesh_size, the whole point of a mesh replica)."""
+    import jax
+    group = _mesh_of(device)
+    if group is not None:
+        return {n: jax.device_put(np.asarray(v),
+                                  group.param_sharding(np.shape(v)))
+                for n, v in state.items()}
+    return {n: jax.device_put(np.asarray(v), device)
+            for n, v in state.items()}
+
+
+def _put_feed(arr, device):
+    """Commit one feed/arg to its placement (replicated on every mesh
+    member — feeds are small; the sharded thing is the resident
+    state)."""
+    import jax
+    group = _mesh_of(device)
+    if group is not None:
+        return jax.device_put(arr, group.replicated())
+    return jax.device_put(arr, device)
+
+
+def _mesh_wrap(math_fn, group, kv_outputs=False):
+    """The mesh-replica compute contract (SERVING.md "Mesh replicas"):
+    gather every operand back to REPLICATED before any math runs, so the
+    traced computation is identical on every member and no float
+    reduction ever reorders across devices — a mesh replica's output is
+    bit-exact vs a single-device replica by construction (the
+    weight-update-sharding blueprint: HBM shards, math does not).
+
+    `kv_outputs=True` re-shards 5-D outputs (the decode KV slot tables)
+    back to their at-rest `kv_sharding` before returning, so the
+    session-resident cache stays ~1/mesh_size per device between
+    dispatches; everything else returns replicated."""
+    import jax
+
+    def _rep(x):
+        return jax.lax.with_sharding_constraint(x, group.replicated())
+
+    def _out(x):
+        if kv_outputs and getattr(x, "ndim", 0) == 5:
+            return jax.lax.with_sharding_constraint(
+                x, group.kv_sharding(x.shape))
+        return _rep(x)
+
+    def wrapped(state, *args):
+        state = jax.tree_util.tree_map(_rep, state)
+        args = jax.tree_util.tree_map(_rep, args)
+        return jax.tree_util.tree_map(_out, math_fn(state, *args))
+
+    return wrapped
 
 
 class Predictor:
@@ -141,9 +236,7 @@ class Predictor:
                        if self._scope.get(n) is not None}
         self._device = device
         if device is not None:
-            import jax
-            self._state = {n: jax.device_put(np.asarray(v), device)
-                           for n, v in self._state.items()}
+            self._state = _put_state(self._state, device)
         self._compiled = {}  # feed shape signature -> compiled fn
         # serializes compile-and-cache and the overflow warn-once set:
         # concurrent dispatch lanes must neither double-compile one
@@ -190,6 +283,9 @@ class Predictor:
             fetches, _ = step_fn(state, feed_dict, np.uint32(0))
             return fetches
 
+        group = _mesh_of(self._device)
+        if group is not None:
+            return _mesh_wrap(fwd, group)
         return fwd
 
     def _aot_fingerprint(self, feeds):
@@ -222,6 +318,14 @@ class Predictor:
         import jax
         from paddle_tpu import compile_cache as cc
         if not cc.cache_enabled():
+            return None
+        if _mesh_of(self._device) is not None:
+            # meshed replicas compile directly (lower().compile() against
+            # the sharded state): a serialized export has no sharding in
+            # its calling convention, so a cached single-device blob
+            # would silently gather the whole model onto one member.
+            # _device_kind carries a '/meshN' suffix, so nothing meshed
+            # ever namespace-collides with a single-device executable.
             return None
         if self._device is not None and \
                 self._device.platform != jax.default_backend():
@@ -332,11 +436,13 @@ class Predictor:
                     return None
                 self._overflow_warned.add(b)
             warnings.warn(
-                "batch %d exceeds every configured bucket %s — falling "
-                "through to an unbucketed per-size compile; raise "
-                "batch_size_buckets (or split the request) to avoid a "
-                "recompile per distinct oversize batch in serving"
-                % (b, tuple(buckets)), RuntimeWarning, stacklevel=3)
+                "batch %d exceeds every configured bucket %s on replica "
+                "device [%s] — falling through to an unbucketed per-size "
+                "compile; raise batch_size_buckets (or split the "
+                "request) to avoid a recompile per distinct oversize "
+                "batch in serving"
+                % (b, tuple(buckets), _device_label(self._device)),
+                RuntimeWarning, stacklevel=3)
         return None
 
     def _is_batched_feed(self, name):
@@ -351,6 +457,11 @@ class Predictor:
         (positional, matching the saved feed order). Returns list of numpy
         arrays in fetch order."""
         import jax.numpy as jnp
+        from paddle_tpu.parallel.mesh import check_member_poison
+        # a mesh replica dies whole: a lost member fails the dispatch
+        # typed (MeshMemberLost) so the serving lane can mark itself
+        # dead instead of wedging (chaos mesh-member-loss)
+        check_member_poison(self._device)
         if isinstance(inputs, dict):
             named = {k: np.asarray(v) for k, v in inputs.items()}
         else:
@@ -383,11 +494,10 @@ class Predictor:
                                arr.dtype)
                 arr = np.concatenate([arr, pad], axis=0)
             if self._device is not None:
-                # commit the feed to this replica's device so the
-                # computation (and the AOT executable) run there, not on
-                # jax's default device
-                import jax
-                feeds[name] = jax.device_put(arr, self._device)
+                # commit the feed to this replica's device (replicated
+                # across a mesh group) so the computation runs there,
+                # not on jax's default device
+                feeds[name] = _put_feed(arr, self._device)
             else:
                 feeds[name] = jnp.asarray(arr)
 
@@ -443,12 +553,10 @@ class Predictor:
         the device commit and the per-device compile cache are new —
         this is how the serving registry builds N device-resident
         replicas from one artifact load."""
-        import jax
         p = self.clone()
         p._device = device
         if device is not None:
-            p._state = {n: jax.device_put(np.asarray(v), device)
-                        for n, v in self._state.items()}
+            p._state = _put_state(self._state, device)
         return p
 
     @property
@@ -662,6 +770,7 @@ class AotPredictor:
                 self._fns[int(bs)] = jax_export.deserialize(
                     f.read()).call
         cc.note_artifact_load(len(self._fns))
+        device = _aot_degrade_mesh(device)
         self._device = device
         if device is not None:
             import jax
@@ -737,6 +846,7 @@ class AotPredictor:
         """Replica placement: share the deserialized StableHLO modules,
         re-commit the state copy to `device`."""
         import jax
+        device = _aot_degrade_mesh(device)
         p = object.__new__(AotPredictor)
         p._feed_names = list(self._feed_names)
         p._fetch_names = list(self._fetch_names)

@@ -290,6 +290,22 @@ class MetricsRegistry(object):
                             (mname, self._model_labels(model, m),
                              m[field]))
             _family(lines, mname, "gauge", samples)
+        # mesh shape per replica lane (SERVING.md "Mesh replicas"):
+        # member-device count of each lane — 1 for a plain single-chip
+        # replica; a dead mesh lane keeps exporting so a scraper can
+        # still see the shape it lost
+        samples = []
+        for snap in snaps:
+            for model, m in sorted(snap.get("models", {}).items()):
+                for row in m.get("replicas") or []:
+                    samples.append(
+                        (_PREFIX + "replica_mesh_size",
+                         self._model_labels(
+                             model, m,
+                             replica=str(row.get("replica", "")),
+                             device=str(row.get("device", ""))),
+                         int(row.get("mesh", 1) or 1)))
+        _family(lines, _PREFIX + "replica_mesh_size", "gauge", samples)
         samples = []
         for snap in snaps:
             for model, m in sorted(snap.get("models", {}).items()):

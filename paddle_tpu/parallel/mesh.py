@@ -17,6 +17,8 @@ import os
 import numpy as np
 
 __all__ = ["make_mesh", "data_parallel_mesh", "local_device_count", "get_shard_map",
+           "MeshGroup", "MeshMemberLost", "as_mesh_group",
+           "set_member_poison", "check_member_poison",
            "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS"]
 
 DATA_AXIS = "data"
@@ -103,3 +105,196 @@ def shard_map_no_rep_check(fn, mesh, in_specs, out_specs):
     except TypeError:          # jax >= 0.8
         return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                   check_vma=False)
+
+
+# ---------------------------------------------------------------------------
+# serving mesh groups: one replica = a device mesh (SERVING.md "Mesh
+# replicas")
+# ---------------------------------------------------------------------------
+
+
+class MeshMemberLost(RuntimeError):
+    """A device inside a serving mesh group stopped answering: the whole
+    group is one logical replica, so losing ONE member kills the lane
+    (marked dead, never wedged) — in-flight requests on that lane fail
+    with this type while sibling lanes keep serving, and the fleet
+    controller rebuilds the lane from its persisted spec (the chaos
+    `mesh-member-loss` scenario pins this contract)."""
+
+
+class MeshGroup:
+    """An ordered group of >= 2 local devices acting as ONE logical
+    serving device: the placement unit `model_registry.resolve_placement`
+    emits for `mesh:RxC` / `a+b` specs and the serving predictors build
+    against.
+
+    Ducks the `jax.Device` attribute surface the serving stack touches
+    (`platform`, `id`, `device_kind`), so everything that merely labels
+    or fingerprints a placement keeps working; code that MOVES data
+    branches on `isinstance(dev, MeshGroup)` and uses the sharding
+    helpers below.
+
+    Sharding discipline (the bit-exactness contract): parameters and the
+    decode KV slot table are SHARDED AT REST over the 1-D `model` axis
+    (per-device resident bytes ~ 1/mesh_size — the fit-check unlock);
+    compute runs REPLICATED — every traced phase gathers its operands
+    back to replicated before any math (see the predictors'
+    `_mesh_wrap`), so no float reduction is ever reordered across
+    members and a mesh replica's stream is bit-identical to a
+    single-device replica's.  This is the MLPerf pods paper's
+    weight-update-sharding blueprint applied to inference: HBM scales
+    with the mesh, math does not move."""
+
+    __slots__ = ("devices", "shape", "_mesh")
+
+    def __init__(self, devices, shape=None):
+        devices = tuple(devices)
+        if len(devices) < 2:
+            raise ValueError(
+                "a mesh group needs >= 2 devices, got %d (a 1-device "
+                "mesh is just the device — resolve_placement collapses "
+                "it)" % len(devices))
+        seen = set()
+        for d in devices:
+            key = (getattr(d, "platform", None), getattr(d, "id", None))
+            if key in seen:
+                raise ValueError(
+                    "duplicate device %s:%s in mesh group" % key)
+            seen.add(key)
+        if shape is None:
+            shape = (len(devices),)
+        shape = tuple(int(s) for s in shape)
+        if int(np.prod(shape)) != len(devices):
+            raise ValueError(
+                "mesh shape %r does not cover %d devices"
+                % (shape, len(devices)))
+        self.devices = devices
+        self.shape = shape
+        self._mesh = None
+
+    # -- jax.Device duck surface (labels / fingerprints only) -----------
+
+    @property
+    def platform(self):
+        return getattr(self.devices[0], "platform", "cpu")
+
+    @property
+    def id(self):
+        return getattr(self.devices[0], "id", 0)
+
+    @property
+    def device_kind(self):
+        # namespaced per mesh size so a meshed executable fingerprint
+        # can never collide with a single-device one
+        return "%s/mesh%d" % (
+            getattr(self.devices[0], "device_kind", ""), len(self.devices))
+
+    # -- group surface --------------------------------------------------
+
+    @property
+    def mesh_size(self):
+        return len(self.devices)
+
+    @property
+    def primary(self):
+        """The first member — where mesh-incapable callers (serialized
+        AOT exports) degrade to."""
+        return self.devices[0]
+
+    def label(self):
+        """'cpu:0+cpu:1' — the wire/spec spelling; resolve_placement
+        parses it back, which is what makes page-out / fault-in / resize
+        replay a mesh lane spec verbatim."""
+        return "+".join("%s:%d" % (getattr(d, "platform", "cpu"),
+                                   getattr(d, "id", 0))
+                        for d in self.devices)
+
+    def member_labels(self):
+        return [lbl for lbl in self.label().split("+")]
+
+    def __repr__(self):
+        return "MeshGroup(%s)" % self.label()
+
+    def __eq__(self, other):
+        return isinstance(other, MeshGroup) and \
+            self.devices == other.devices
+
+    def __hash__(self):
+        return hash(self.devices)
+
+    def mesh(self):
+        """The jax.sharding.Mesh (1-D over MODEL_AXIS, lazily built)."""
+        if self._mesh is None:
+            from jax.sharding import Mesh
+            self._mesh = Mesh(np.array(self.devices), (MODEL_AXIS,))
+        return self._mesh
+
+    def replicated(self):
+        """NamedSharding replicating an array on every member."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh(), P())
+
+    def _axis_sharding(self, ndim, axis):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = [None] * ndim
+        spec[axis] = MODEL_AXIS
+        return NamedSharding(self.mesh(), P(*spec))
+
+    def param_sharding(self, shape):
+        """At-rest sharding for one parameter: the last axis whose size
+        divides the mesh (output-column parallel for the common [in,
+        out] case), scanning right to left; small / indivisible arrays
+        (biases, norms) replicate."""
+        n = self.mesh_size
+        shape = tuple(int(s) for s in shape)
+        for ax in range(len(shape) - 1, -1, -1):
+            if shape[ax] >= n and shape[ax] % n == 0:
+                return self._axis_sharding(len(shape), ax)
+        return self.replicated()
+
+    def kv_sharding(self, shape):
+        """At-rest sharding for a [L, n_slots, S, H, Dh] KV slot table:
+        heads first (the per-head independence axis the decode kernel
+        already respects), then slots, then layers; replicate only when
+        nothing divides."""
+        n = self.mesh_size
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 5:
+            return self.param_sharding(shape)
+        for ax in (3, 1, 0):
+            if shape[ax] >= n and shape[ax] % n == 0:
+                return self._axis_sharding(5, ax)
+        return self.replicated()
+
+
+def as_mesh_group(device):
+    """`device` as (MeshGroup | None): the isinstance probe the
+    predictors use without importing jax at module import time."""
+    return device if isinstance(device, MeshGroup) else None
+
+
+# chaos hook (tools/chaos.py mesh-member-loss scenario): poisoning a
+# member device label makes every dispatch on a mesh group CONTAINING
+# that member raise MeshMemberLost — the in-process stand-in for a chip
+# dropping off the ICI mid-stream.  Lanes on meshes that do not include
+# the member (and plain single-device lanes) are untouched.
+_MEMBER_POISON = {"label": None}
+
+
+def set_member_poison(device_label=None):
+    """Arm (a 'platform:id' member label) or disarm (None) the
+    mesh-member-loss chaos injection."""
+    _MEMBER_POISON["label"] = (str(device_label)
+                               if device_label is not None else None)
+
+
+def check_member_poison(group):
+    """Raise MeshMemberLost if the poisoned member sits in `group`
+    (called at every mesh dispatch edge)."""
+    lbl = _MEMBER_POISON["label"]
+    if lbl is None or not isinstance(group, MeshGroup):
+        return
+    if lbl in group.member_labels():
+        raise MeshMemberLost(
+            "mesh member %s lost (chaos poison) — mesh replica %s is "
+            "down" % (lbl, group.label()))

@@ -4,7 +4,9 @@ Turns a directory of `save_inference_model` / `save_aot` artifacts into
 a trafficable service (SERVING.md): cross-request dynamic micro-batching
 onto the compiled batch buckets with N device-placed replicas per model
 fronted by per-replica execution lanes and a least-loaded router
-(batcher.py), named/versioned models with placement specs and warm
+(batcher.py) — a replica may be a multi-chip device MESH sharding the
+params and KV slot table across its members (parallel/mesh.py,
+SERVING.md "Mesh replicas") while serving as ONE lane —, named/versioned models with placement specs and warm
 atomic hot swap of whole replica sets (model_registry.py), a threaded
 wire-protocol front with priority-class admission control and graceful
 drain (server.py), per-model + per-replica serving metrics
@@ -29,6 +31,7 @@ from .metrics import (Counter, ModelMetrics, ReservoirHistogram,
                       ServingMetrics)
 from .model_registry import (ModelEntry, ModelRegistry, open_predictor,
                              resolve_placement)
+from ..parallel.mesh import MeshGroup, MeshMemberLost
 from .server import (InferenceServer, ServingClient, ServingError,
                      StreamBroken)
 
@@ -39,7 +42,7 @@ __all__ = [
     "set_host_delay",
     "Counter", "ReservoirHistogram", "ModelMetrics", "ServingMetrics",
     "ModelRegistry", "ModelEntry", "open_predictor",
-    "resolve_placement",
+    "resolve_placement", "MeshGroup", "MeshMemberLost",
     "FleetController", "FleetPolicy", "FleetAction", "ModelSensors",
     "parse_fleet_spec",
     "InferenceServer", "ServingClient", "ServingError",

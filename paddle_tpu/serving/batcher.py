@@ -67,6 +67,7 @@ import numpy as np
 from ..flags import FLAGS
 from ..obs import events as obs_events
 from ..obs import tracing as obs_tracing
+from ..parallel.mesh import MeshMemberLost
 
 __all__ = ["DynamicBatcher", "DecodeBatcher", "DecodeStream",
            "ServerOverloaded", "DeadlineExceeded", "BatcherClosed",
@@ -223,7 +224,7 @@ class _Lane:
     length)."""
 
     __slots__ = ("index", "predictor", "device", "ready", "inflight",
-                 "batches", "rows", "last_t")
+                 "batches", "rows", "last_t", "dead")
 
     def __init__(self, index, predictor):
         self.index = index
@@ -234,9 +235,19 @@ class _Lane:
         self.batches = 0    # micro-batches this replica executed
         self.rows = 0       # real rows it served
         self.last_t = None  # monotonic end of this lane's last dispatch
+        # set to the error string when a mesh member died under this
+        # lane (SERVING.md "Mesh replicas"): the router skips it, its
+        # workers exit, sibling lanes keep serving
+        self.dead = None
 
     def load(self):
         return (self.inflight, len(self.ready), self.index)
+
+    @property
+    def mesh(self):
+        """Members behind this lane: 1 for a plain device, N for a
+        mesh-group replica ('a+b' device label)."""
+        return self.device.count("+") + 1 if self.device else 1
 
 
 class DynamicBatcher:
@@ -426,9 +437,12 @@ class DynamicBatcher:
     def replica_stats(self):
         """Per-replica lane snapshot (device id, in-flight batches,
         lane queue depth, batches/rows executed) — the skew-visibility
-        numbers `stats` and serving_top surface."""
+        numbers `stats` and serving_top surface.  `mesh` is the member
+        count behind the lane (1 = plain device); `dead` carries the
+        mesh-member-loss error when the lane died."""
         with self._cv:
             return [{"replica": l.index, "device": l.device,
+                     "mesh": l.mesh, "dead": l.dead,
                      "inflight": l.inflight, "queue": len(l.ready),
                      "batches": l.batches, "rows": l.rows}
                     for l in self._lanes]
@@ -445,6 +459,7 @@ class DynamicBatcher:
                 threads = self._lane_threads.get(l.index, [])
                 lanes.append({
                     "replica": l.index, "device": l.device,
+                    "mesh": l.mesh, "dead": l.dead,
                     "alive": sum(1 for t in threads if t.is_alive()),
                     "workers": len(threads),
                     "inflight": l.inflight, "queue": len(l.ready),
@@ -513,24 +528,40 @@ class DynamicBatcher:
             return group
 
     def _assign(self, group):
-        """Hand `group` to the least-loaded lane: fewest in-flight
+        """Hand `group` to the least-loaded LIVE lane: fewest in-flight
         batches, then shortest lane queue, then lowest index.  When
         every lane's queue is at `lane_depth` the router WAITS here
         (sticky back-pressure) — the admission queue upstream fills and
-        sheds, rather than any lane queue growing unboundedly.  Returns
-        False only on hard stop (group unrouted)."""
-        with self._cv:
-            while True:
+        sheds, rather than any lane queue growing unboundedly.  Lanes
+        killed by mesh-member loss are skipped; with EVERY lane dead
+        the group fails typed (MeshMemberLost) instead of parking
+        forever.  Returns False only on hard stop (group unrouted)."""
+        while True:
+            with self._cv:
                 if self._stopped:
                     self._carrying = False
                     return False
-                lane = min(self._lanes, key=_Lane.load)
-                if len(lane.ready) < self.lane_depth:
-                    lane.ready.append(group)
-                    self._carrying = False
-                    self._cv.notify_all()
-                    return True
-                self._cv.wait(0.05)
+                live = [l for l in self._lanes if l.dead is None]
+                if live:
+                    lane = min(live, key=_Lane.load)
+                    if len(lane.ready) < self.lane_depth:
+                        lane.ready.append(group)
+                        self._carrying = False
+                        self._cv.notify_all()
+                        return True
+                    self._cv.wait(0.05)
+                    continue
+                dead_msg = self._lanes[0].dead
+                self._carrying = False
+                self._cv.notify_all()
+            err = MeshMemberLost(
+                "every replica lane is dead (%s)" % dead_msg)
+            for r in group:
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(err)
+            if self.metrics is not None:
+                self.metrics.errors.add(len(group))
+            return True
 
     def _route(self):
         while True:
@@ -707,11 +738,34 @@ class DynamicBatcher:
         self._scatter(live, fetches, total, lane, t_start, t_run,
                       t_run_end)
 
+    def _lane_dead(self, lane, exc):
+        """Mesh-member loss (SERVING.md "Mesh replicas"): the group is
+        ONE replica, so the lane dies whole — marked dead (the router
+        skips it from here on), its queued groups fail typed, sibling
+        lanes keep serving.  Never wedges: a dead lane's workers exit
+        cleanly instead of raising through _guarded."""
+        with self._cv:
+            if lane.dead is not None:
+                return
+            lane.dead = "%s: %s" % (type(exc).__name__, exc)
+            leftovers = []
+            while lane.ready:
+                leftovers.extend(lane.ready.popleft())
+            self._cv.notify_all()
+        obs_events.emit("mesh_lane_dead", model=self._model_name,
+                        replica=lane.index, device=lane.device,
+                        error=str(exc))
+        for r in leftovers:
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_exception(exc)
+            if self.metrics is not None:
+                self.metrics.errors.add()
+
     def _worker(self, lane):
         while True:
             with self._cv:
                 while not lane.ready:
-                    if self._stopped:
+                    if self._stopped or lane.dead is not None:
                         return
                     self._cv.wait(0.1)
                 group = lane.ready.popleft()
@@ -726,6 +780,8 @@ class DynamicBatcher:
                         r.future.set_exception(e)
                 if self.metrics is not None:
                     self.metrics.errors.add(len(group))
+                if isinstance(e, MeshMemberLost):
+                    self._lane_dead(lane, e)
             finally:
                 with self._cv:
                     lane.inflight -= 1
@@ -923,9 +979,13 @@ class _DecodeLane:
 
     __slots__ = ("index", "predictor", "session", "assigned", "steps",
                  "tokens", "spec", "degraded_noted", "last_step_t",
-                 "step_ewma")
+                 "step_ewma", "dead")
 
     def __init__(self, index, predictor, n_slots, draft=None, spec_k=0):
+        # error string once a mesh member died under this lane
+        # (SERVING.md "Mesh replicas"): loop exited, streams failed
+        # typed, sibling lanes unaffected
+        self.dead = None
         self.last_step_t = None  # monotonic end of the last decode step
         # EWMA seconds per decode STEP (per trip under fusion) — the
         # deadline governor's estimate for clamping fused trip counts
@@ -1059,9 +1119,12 @@ class DecodeBatcher:
         return len(self._pending)
 
     def slot_occupancy(self):
-        """(occupied, total) across every lane — the occupancy gauge."""
+        """(occupied, total) across every LIVE lane — the occupancy
+        gauge (a lane killed by mesh-member loss contributes no
+        capacity)."""
         occupied = sum(len(l.assigned) for l in self._lanes)
-        return occupied, self.n_slots * len(self._lanes)
+        live = sum(1 for l in self._lanes if l.dead is None)
+        return occupied, self.n_slots * live
 
     def lane_liveness(self):
         """Thread-level health (the `health` RPC verb): per decode
@@ -1077,6 +1140,7 @@ class DecodeBatcher:
                     "replica": l.index,
                     "alive": int(bool(t is not None and t.is_alive())),
                     "workers": 1,
+                    "dead": l.dead,
                     "slots_busy": len(l.assigned),
                     "slots": self.n_slots,
                     "steps": l.steps,
@@ -1113,9 +1177,12 @@ class DecodeBatcher:
             out = []
             for l in self._lanes:
                 from ..inference.predictor import _device_label
+                dev = _device_label(getattr(l.predictor, "device",
+                                            None))
                 out.append({"replica": l.index,
-                            "device": _device_label(
-                                getattr(l.predictor, "device", None)),
+                            "device": dev,
+                            "mesh": dev.count("+") + 1 if dev else 1,
+                            "dead": l.dead,
                             "inflight": len(l.assigned),
                             "queue": 0,
                             "batches": l.steps,
@@ -1154,6 +1221,12 @@ class DecodeBatcher:
         with self._cv:
             if self._closing:
                 raise BatcherClosed("model batcher is draining/retired")
+            dead = [l.dead for l in self._lanes if l.dead is not None]
+            if len(dead) == len(self._lanes):
+                # every lane lost a mesh member: fail typed at
+                # admission — nothing is left to ever serve this queue
+                raise MeshMemberLost(
+                    "every replica lane is dead (%s)" % dead[0])
             if len(self._pending) >= self.max_queue:
                 victim = None
                 for r in self._pending:
@@ -1333,6 +1406,10 @@ class DecodeBatcher:
                 first = sess.prefill(slot, req.prompt)
         except BaseException as e:
             self._finish(lane, None, req, "error", exc=e)
+            if isinstance(e, MeshMemberLost):
+                # the request failed typed above; the LANE is dead too —
+                # let the loop's member-loss handler retire it whole
+                raise
             return
         req.t_first = time.monotonic()
         if self.metrics is not None:
@@ -1392,159 +1469,215 @@ class DecodeBatcher:
                         replica=lane.index,
                         error=str(lane.session.degrade_error or ""))
 
+    def _lane_dead(self, lane, exc):
+        """Retire a lane whose mesh group lost a member (SERVING.md
+        "Mesh replicas"): mark it dead, fail its in-flight streams
+        typed — WITHOUT freeing slots, a free dispatches on the dead
+        mesh — and fail everything queued once NO live lane remains to
+        ever admit it.  Sibling lanes keep serving; the fleet
+        controller rebuilds the lane from the model's persisted load
+        spec."""
+        with self._cv:
+            if lane.dead is not None:
+                return
+            lane.dead = "%s: %s" % (type(exc).__name__, exc)
+            victims = list(lane.assigned.values())
+            lane.assigned.clear()
+            pend = []
+            if all(l.dead is not None for l in self._lanes):
+                pend = list(self._pending)
+                self._pending.clear()
+            self._cv.notify_all()
+        obs_events.emit(
+            "mesh_lane_dead", model=self._model_name,
+            replica=lane.index,
+            device=_predictor_device_label(lane.predictor),
+            error=str(exc))
+        if self.metrics is not None and (victims or pend):
+            self.metrics.errors.add(len(victims) + len(pend))
+        for req in victims + pend:
+            req.buf = []
+            req.stream._fail(exc)
+
     def _lane_loop(self, lane):
+        while True:
+            try:
+                if not self._lane_iter(lane):
+                    return
+            except MeshMemberLost as e:
+                # one member of this lane's mesh is gone: the lane
+                # dies WHOLE — typed failures, never a wedge — and
+                # exits cleanly (no server_thread_death); the chaos
+                # mesh-member-loss scenario pins this contract
+                self._lane_dead(lane, e)
+                return
+
+    def _lane_iter(self, lane):
+        """One iteration of the continuous loop: admit + prefill, one
+        decode dispatch, stream bookkeeping.  Returns False to stop."""
         sess = lane.session
         eos = self.predictor.eos_id
-        while True:
-            with self._cv:
-                while not lane.assigned and not self._admissible(lane):
-                    if self._stopped:
-                        return
-                    self._cv.wait(0.1)
-                if self._stopped and not lane.assigned:
-                    return
-                admits = self._take_admits_locked(lane) \
-                    if self._admissible(lane) else []
-            # prefill OUTSIDE the lock: other lanes keep decoding
-            for req in admits:
+        with self._cv:
+            while not lane.assigned and not self._admissible(lane):
+                if self._stopped:
+                    return False
+                self._cv.wait(0.1)
+            if self._stopped and not lane.assigned:
+                return False
+            admits = self._take_admits_locked(lane) \
+                if self._admissible(lane) else []
+        # prefill OUTSIDE the lock: other lanes keep decoding
+        for i, req in enumerate(admits):
+            try:
                 self._prefill(lane, req)
-            if not lane.assigned:
-                self._note_degraded(lane)
-                continue
-            fuse = self.fuse_steps
-            if fuse > 1:
-                # window-boundary housekeeping (SERVING.md "Fused
-                # multi-step decode"): drop cancelled/expired streams
-                # BEFORE burning an N-step window on them — joins and
-                # leaves happen only at dispatch boundaries
-                nowb = time.monotonic()
-                for slot, req in list(lane.assigned.items()):
-                    if req.stream.cancelled():
-                        req.buf = []
-                        self._finish(lane, slot, req, "cancelled")
-                    elif req.deadline is not None \
-                            and nowb > req.deadline:
-                        self._expire(lane, slot, req, nowb)
-                if not lane.assigned:
-                    continue
-            n_act = len(lane.assigned)
-            t0 = time.monotonic()
-            # the same slow-worker chaos hook / deterministic per-step
-            # device-cost stand-in as the one-shot lanes
-            # (set_dispatch_delay — bench_serving --step_cost_ms; the
-            # draft steps of a spec round price separately via
-            # set_draft_delay — bench_serving --draft_cost_ms), plus
-            # the per-DISPATCH host-cost stand-in (set_host_delay —
-            # bench_serving --host_cost_ms) that fusion amortizes 1/N
-            delay = _chaos_delay()
-            host_delay = _host_chaos_delay()
-            if host_delay:
-                time.sleep(host_delay)
-            trips = 1
-            if lane.spec:
-                toks2d, counts = sess.step(
-                    step_delay=delay,
-                    draft_delay=_draft_chaos_delay(),
-                    fused=fuse > 1)
-                spec_round = sess.last_spec
-            elif fuse > 1:
-                # per-slot token budgets (max_new / cache-room
-                # headroom) + the deadline governor: the lane's EWMA
-                # step time clamps the trip count so a deadlined
-                # stream never overshoots by more than ~one dispatch
-                budget = np.zeros(self.n_slots, np.int32)
-                max_trips = fuse
-                for slot, req in lane.assigned.items():
-                    budget[slot] = min(req.max_new - len(req.gen),
-                                       sess.room(slot), fuse)
-                    if req.deadline is not None and lane.step_ewma:
-                        allow = int((req.deadline - t0)
-                                    / lane.step_ewma)
-                        max_trips = min(max_trips, max(allow, 1))
-                toks2d, counts, trips = sess.decode_fused(
-                    fuse, budget=budget, max_trips=max_trips)
-                spec_round = False
-                if delay:
-                    # the device-cost stand-in scales with the trips
-                    # that actually ran (in-graph early exit included)
-                    time.sleep(delay * trips)
-            else:
-                if delay:
-                    time.sleep(delay)
-                toks = sess.decode()
-                spec_round = False
-            now = time.monotonic()
-            lane.steps += 1
-            lane.last_step_t = now
-            # EWMA seconds per logical step (per trip): the fused
-            # deadline governor's clamp input
-            per_step = (now - t0) / max(trips, 1)
-            lane.step_ewma = per_step if lane.step_ewma is None \
-                else 0.5 * lane.step_ewma + 0.5 * per_step
-            if self.metrics is not None:
-                self.metrics.decode_steps.add(trips)
-                if spec_round:
-                    # per-round accept telemetry: k proposals per
-                    # occupied slot, counts[s]-1 of them accepted
-                    proposed = sess.spec_k * n_act
-                    accepted = int(counts.sum()) - n_act
-                    self.metrics.note_spec(proposed, accepted)
+            except MeshMemberLost:
+                # this lane is dying whole; admits not yet prefilled
+                # never touched its mesh — push them back for a
+                # surviving lane (if none survives, _lane_dead fails
+                # the whole queue typed)
+                with self._cv:
+                    for rem in reversed(admits[i + 1:]):
+                        self._pending.appendleft(rem)
+                    self._cv.notify_all()
+                raise
+        if not lane.assigned:
             self._note_degraded(lane)
-            fused_plain = not lane.spec and fuse > 1
-            emitted = 0
+            return True
+        fuse = self.fuse_steps
+        if fuse > 1:
+            # window-boundary housekeeping (SERVING.md "Fused
+            # multi-step decode"): drop cancelled/expired streams
+            # BEFORE burning an N-step window on them — joins and
+            # leaves happen only at dispatch boundaries
+            nowb = time.monotonic()
             for slot, req in list(lane.assigned.items()):
-                # a spec round commits 1..k+1 tokens per slot (a fused
-                # window up to fuse_steps); consume them in stream
-                # order with per-token EOS/max-new cuts so the emitted
-                # stream is bit-identical to the plain
-                # one-token-per-step path
-                slot_toks = [int(toks2d[slot, j])
-                             for j in range(int(counts[slot]))] \
-                    if (lane.spec or fused_plain) else [int(toks[slot])]
-                finished = None
-                for tok in slot_toks:
-                    req.gen.append(tok)
-                    req.buf.append(tok)
-                    emitted += 1
-                    if tok == eos:
-                        finished = "eos"
-                        break
-                    if len(req.gen) >= req.max_new:
-                        finished = "length"
-                        break
                 if req.stream.cancelled():
-                    # client gone: nobody reads the flush — just free
                     req.buf = []
                     self._finish(lane, slot, req, "cancelled")
-                    continue
-                if req.deadline is not None and now > req.deadline:
-                    self._expire(lane, slot, req, now)
-                    continue
-                if finished is None and sess.room(slot) <= 0:
+                elif req.deadline is not None \
+                        and nowb > req.deadline:
+                    self._expire(lane, slot, req, nowb)
+            if not lane.assigned:
+                return True
+        n_act = len(lane.assigned)
+        t0 = time.monotonic()
+        # the same slow-worker chaos hook / deterministic per-step
+        # device-cost stand-in as the one-shot lanes
+        # (set_dispatch_delay — bench_serving --step_cost_ms; the
+        # draft steps of a spec round price separately via
+        # set_draft_delay — bench_serving --draft_cost_ms), plus
+        # the per-DISPATCH host-cost stand-in (set_host_delay —
+        # bench_serving --host_cost_ms) that fusion amortizes 1/N
+        delay = _chaos_delay()
+        host_delay = _host_chaos_delay()
+        if host_delay:
+            time.sleep(host_delay)
+        trips = 1
+        if lane.spec:
+            toks2d, counts = sess.step(
+                step_delay=delay,
+                draft_delay=_draft_chaos_delay(),
+                fused=fuse > 1)
+            spec_round = sess.last_spec
+        elif fuse > 1:
+            # per-slot token budgets (max_new / cache-room
+            # headroom) + the deadline governor: the lane's EWMA
+            # step time clamps the trip count so a deadlined
+            # stream never overshoots by more than ~one dispatch
+            budget = np.zeros(self.n_slots, np.int32)
+            max_trips = fuse
+            for slot, req in lane.assigned.items():
+                budget[slot] = min(req.max_new - len(req.gen),
+                                   sess.room(slot), fuse)
+                if req.deadline is not None and lane.step_ewma:
+                    allow = int((req.deadline - t0)
+                                / lane.step_ewma)
+                    max_trips = min(max_trips, max(allow, 1))
+            toks2d, counts, trips = sess.decode_fused(
+                fuse, budget=budget, max_trips=max_trips)
+            spec_round = False
+            if delay:
+                # the device-cost stand-in scales with the trips
+                # that actually ran (in-graph early exit included)
+                time.sleep(delay * trips)
+        else:
+            if delay:
+                time.sleep(delay)
+            toks = sess.decode()
+            spec_round = False
+        now = time.monotonic()
+        lane.steps += 1
+        lane.last_step_t = now
+        # EWMA seconds per logical step (per trip): the fused
+        # deadline governor's clamp input
+        per_step = (now - t0) / max(trips, 1)
+        lane.step_ewma = per_step if lane.step_ewma is None \
+            else 0.5 * lane.step_ewma + 0.5 * per_step
+        if self.metrics is not None:
+            self.metrics.decode_steps.add(trips)
+            if spec_round:
+                # per-round accept telemetry: k proposals per
+                # occupied slot, counts[s]-1 of them accepted
+                proposed = sess.spec_k * n_act
+                accepted = int(counts.sum()) - n_act
+                self.metrics.note_spec(proposed, accepted)
+        self._note_degraded(lane)
+        fused_plain = not lane.spec and fuse > 1
+        emitted = 0
+        for slot, req in list(lane.assigned.items()):
+            # a spec round commits 1..k+1 tokens per slot (a fused
+            # window up to fuse_steps); consume them in stream
+            # order with per-token EOS/max-new cuts so the emitted
+            # stream is bit-identical to the plain
+            # one-token-per-step path
+            slot_toks = [int(toks2d[slot, j])
+                         for j in range(int(counts[slot]))] \
+                if (lane.spec or fused_plain) else [int(toks[slot])]
+            finished = None
+            for tok in slot_toks:
+                req.gen.append(tok)
+                req.buf.append(tok)
+                emitted += 1
+                if tok == eos:
+                    finished = "eos"
+                    break
+                if len(req.gen) >= req.max_new:
                     finished = "length"
-                if finished is not None:
-                    self._finish(lane, slot, req, finished)
-                elif len(req.buf) >= req.chunk:
-                    req.stream._put_tokens(req.buf)
-                    req.buf = []
-            if obs_tracing.enabled():
-                self._emit_step_spans(
-                    lane, t0,
-                    sess.last_draft_end if spec_round else None, now,
-                    n_act,
-                    accepted=(int(counts.sum()) - n_act)
-                    if spec_round else None,
-                    tokens=emitted, trips=trips)
-            lane.tokens += emitted
-            if self.metrics is not None:
-                # per-dispatch accounting: the tokens-per-dispatch
-                # histogram is the direct readout of the fused-decode
-                # amortization (TPD ~1 at N=1, ~N when fused)
-                self.metrics.note_decode_dispatch(emitted)
-                if emitted:
-                    self.metrics.note_tokens(emitted)
-            with self._cv:
-                self._cv.notify_all()
+                    break
+            if req.stream.cancelled():
+                # client gone: nobody reads the flush — just free
+                req.buf = []
+                self._finish(lane, slot, req, "cancelled")
+                continue
+            if req.deadline is not None and now > req.deadline:
+                self._expire(lane, slot, req, now)
+                continue
+            if finished is None and sess.room(slot) <= 0:
+                finished = "length"
+            if finished is not None:
+                self._finish(lane, slot, req, finished)
+            elif len(req.buf) >= req.chunk:
+                req.stream._put_tokens(req.buf)
+                req.buf = []
+        if obs_tracing.enabled():
+            self._emit_step_spans(
+                lane, t0,
+                sess.last_draft_end if spec_round else None, now,
+                n_act,
+                accepted=(int(counts.sum()) - n_act)
+                if spec_round else None,
+                tokens=emitted, trips=trips)
+        lane.tokens += emitted
+        if self.metrics is not None:
+            # per-dispatch accounting: the tokens-per-dispatch
+            # histogram is the direct readout of the fused-decode
+            # amortization (TPD ~1 at N=1, ~N when fused)
+            self.metrics.note_decode_dispatch(emitted)
+            if emitted:
+                self.metrics.note_tokens(emitted)
+        with self._cv:
+            self._cv.notify_all()
+        return True
 
     # ------------------------------------------------------------------
     # lifecycle

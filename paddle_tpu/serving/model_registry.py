@@ -48,43 +48,132 @@ __all__ = ["ModelRegistry", "ModelEntry", "open_predictor",
            "resolve_placement"]
 
 
+def _pack_mesh_spec(s):
+    """'mesh:N' / 'mesh:RxC' as the WHOLE placement spec: pack as many
+    disjoint consecutive N-device (R*C-device) groups as the host's
+    local devices allow — each group one logical mesh replica.  A
+    1-device mesh is just the legacy one-replica-per-device shape."""
+    import jax
+    from ..parallel.mesh import MeshGroup
+    body = s.split(":", 1)[1].strip()
+    try:
+        dims = tuple(int(p) for p in body.split("x")) if "x" in body \
+            else (int(body),)
+    except ValueError:
+        raise ValueError(
+            "bad mesh placement %r — expected 'mesh:N' or 'mesh:RxC'"
+            % s)
+    g = 1
+    for d in dims:
+        if d < 1:
+            raise ValueError(
+                "bad mesh placement %r — dimensions must be >= 1" % s)
+        g *= d
+    local = list(jax.local_devices())
+    if g == 1:
+        return list(local)
+    n_groups = len(local) // g
+    if n_groups < 1:
+        raise ValueError(
+            "mesh placement %r needs %d devices per replica, host has "
+            "%d local device(s)" % (s, g, len(local)))
+    return [MeshGroup(local[i * g:(i + 1) * g], dims)
+            for i in range(n_groups)]
+
+
 def resolve_placement(spec=None):
-    """Turn a replica placement spec into a list of jax.Device (or
-    [None] for the single default-device replica).
+    """Turn a replica placement spec into a list of jax.Device /
+    MeshGroup (or [None] for the single default-device replica).
 
     spec: None -> FLAGS.serving_replicas; int or digit-string N -> N
     replicas round-robin over jax.local_devices() (N == 1 -> [None],
     the pre-multichip single-replica behavior on the default device);
     'auto' -> one replica per local device; a comma list / sequence of
     local indices ('0,2'), 'platform:index' names ('cpu:0', 'tpu:3'),
-    or jax.Device objects -> exactly those devices."""
+    or jax.Device objects -> exactly those devices.
+
+    Mesh replicas (SERVING.md "Mesh replicas"): 'mesh:N' / 'mesh:RxC'
+    as the WHOLE spec packs the host into as many disjoint consecutive
+    N-device groups as fit, each group ONE logical replica sharding
+    the model across its members; '+'-joined members inside a list
+    element ('tpu:0+tpu:1' or '0+1') place one explicit mesh replica
+    and compose freely with plain elements.  A 1-member group
+    collapses to the plain device.  A device may belong to at most one
+    mesh group and never doubles as a plain replica — overlap is a
+    placement error (plain single-device duplicates stay allowed: they
+    multiply the fit estimate, not the sharding)."""
     import jax
+    from ..parallel.mesh import MeshGroup
     if spec is None:
         spec = FLAGS.serving_replicas
     if isinstance(spec, (list, tuple)):
         local = list(jax.local_devices())
         by_key = {(d.platform, d.id): d for d in local}
-        devs = []
-        for item in spec:
-            if hasattr(item, "platform") and hasattr(item, "id"):
-                devs.append(item)  # already a jax.Device
-                continue
-            s = str(item).strip()
-            if ":" in s:
-                plat, _, idx = s.partition(":")
+
+        def one(tok):
+            if hasattr(tok, "platform") and hasattr(tok, "id") \
+                    and not isinstance(tok, str):
+                return tok  # already a jax.Device
+            t = str(tok).strip()
+            if ":" in t:
+                plat, _, idx = t.partition(":")
                 dev = by_key.get((plat.strip(), int(idx)))
                 if dev is None:
                     raise ValueError(
                         "no local device %r (have %s)" % (
-                            s, sorted("%s:%d" % k for k in by_key)))
-                devs.append(dev)
+                            t, sorted("%s:%d" % k for k in by_key)))
+                return dev
+            i = int(t)
+            if i >= len(local):
+                raise ValueError(
+                    "device index %d out of range: %d local "
+                    "device(s)" % (i, len(local)))
+            return local[i]
+
+        def key_of(d):
+            return (getattr(d, "platform", None), getattr(d, "id", None))
+
+        devs = []
+        mesh_keys = set()   # devices claimed by a mesh group
+        plain_keys = set()  # devices used as plain replicas
+        for item in spec:
+            if isinstance(item, MeshGroup):
+                members = list(item.devices)
+            elif not isinstance(item, str) and \
+                    hasattr(item, "platform") and hasattr(item, "id"):
+                members = [item]
             else:
-                i = int(s)
-                if i >= len(local):
+                s = str(item).strip()
+                if not s:
+                    continue
+                if s.startswith("mesh:"):
                     raise ValueError(
-                        "device index %d out of range: %d local "
-                        "device(s)" % (i, len(local)))
-                devs.append(local[i])
+                        "'mesh:N' packs the WHOLE host and cannot be "
+                        "combined with other placement elements — use "
+                        "explicit '+'-joined groups (e.g. 'tpu:0+"
+                        "tpu:1,tpu:2+tpu:3') to mix")
+                members = [one(t) for t in s.split("+") if t.strip()]
+            if not members:
+                continue
+            if len(members) == 1:
+                dev = members[0]
+                k = key_of(dev)
+                if k in mesh_keys:
+                    raise ValueError(
+                        "device %s:%s is a mesh-group member and "
+                        "cannot double as a plain replica" % k)
+                plain_keys.add(k)
+                devs.append(dev)
+                continue
+            keys = [key_of(d) for d in members]
+            for k in keys:
+                if k in mesh_keys or k in plain_keys:
+                    raise ValueError(
+                        "device %s:%s already placed — mesh-group "
+                        "members must be exclusive" % k)
+            mesh_keys.update(keys)
+            devs.append(item if isinstance(item, MeshGroup)
+                        else MeshGroup(members))
         if not devs:
             raise ValueError("empty replica device list")
         return devs
@@ -92,7 +181,9 @@ def resolve_placement(spec=None):
         s = spec.strip()
         if s == "auto":
             return list(jax.local_devices())
-        if "," in s or ":" in s:
+        if s.startswith("mesh:") and "," not in s:
+            return _pack_mesh_spec(s)
+        if "," in s or ":" in s or "+" in s:
             return resolve_placement(
                 [p for p in s.split(",") if p.strip()])
         spec = int(s)
@@ -180,6 +271,13 @@ class ModelEntry:
     def device_labels(self):
         from ..inference.predictor import _device_label
         return [_device_label(d) for d in self.devices]
+
+    def mesh_sizes(self):
+        """Members per replica, in route order: 1 for a plain device,
+        N for a MeshGroup (SERVING.md "Mesh replicas")."""
+        from ..parallel.mesh import as_mesh_group
+        return [g.mesh_size if (g := as_mesh_group(d)) is not None
+                else 1 for d in self.devices]
 
     @property
     def is_decode(self):
@@ -293,8 +391,16 @@ class ModelRegistry:
         estimate — its weights AND its own KV slot table — to every
         replica's footprint: the draft lives on the same device as its
         target, so both must fit TOGETHER or the load is rejected
-        before any build/warm work."""
+        before any build/warm work.
+
+        A MeshGroup replica (SERVING.md "Mesh replicas") prices PER
+        MEMBER device: params + KV shard at rest (~1/mesh_size each),
+        the replicated-compute activation peak does not — so a model
+        whose whole-footprint estimate exceeds any one chip's budget
+        still ADMITS on a mesh whose members each fit their share.
+        The draft rides the same group, priced the same way."""
         from ..analysis import ResourceFitError, check_fit, resources
+        from ..parallel.mesh import as_mesh_group
         try:
             report = resources.analyze_artifact(
                 path, decode_slots=decode_slots,
@@ -315,28 +421,43 @@ class ModelRegistry:
         what = "model %r (%s)" % (name, path)
         if draft_report is not None:
             what += " + draft (%s)" % (draft_path,)
+        mesh_max = 1
         for dev, n in by_dev.values():
-            try:
-                est, avail = check_fit(
-                    report, device=dev, what=what, replicas=n)
-                if draft_report is not None and avail is not None:
-                    est += int(draft_report.peak_bytes) * int(n)
-                    if est > avail:
-                        raise ResourceFitError(what, est, avail,
-                                               device=dev)
-            except ResourceFitError as e:
-                obs_events.emit(
-                    "model_fit_rejected", model=name, path=path,
-                    draft=draft_path or None,
-                    est_bytes=e.estimated_bytes,
-                    available_bytes=e.available_bytes)
-                raise
+            group = as_mesh_group(dev)
+            m = group.mesh_size if group is not None else 1
+            mesh_max = max(mesh_max, m)
+            members = group.devices if group is not None else (dev,)
+            w = what if group is None else \
+                "%s on mesh replica %s" % (what, group.label())
+            est = avail = None
+            for member in members:
+                try:
+                    est, avail = check_fit(
+                        report, device=member, what=w, replicas=n,
+                        mesh_size=m)
+                    if draft_report is not None and avail is not None:
+                        est += draft_report.per_device_bytes(m) * int(n)
+                        if est > avail:
+                            raise ResourceFitError(w, est, avail,
+                                                   device=member)
+                except ResourceFitError as e:
+                    obs_events.emit(
+                        "model_fit_rejected", model=name, path=path,
+                        draft=draft_path or None,
+                        est_bytes=e.estimated_bytes,
+                        available_bytes=e.available_bytes,
+                        mesh_size=int(m))
+                    raise
             if avail is not None:
                 obs_events.emit(
                     "model_fit_check", model=name, path=path,
                     draft=draft_path or None,
                     est_bytes=int(est), available_bytes=int(avail),
-                    replicas=int(n))
+                    replicas=int(n), mesh_size=int(m))
+        # stamp the placement's mesh shape on the stored report so
+        # describe()/stats (and the fleet's placement-by-capacity math)
+        # read the per-device resident estimate, not the whole-model sum
+        report.mesh_size = int(mesh_max)
         return report
 
     def load_model(self, name, path, version=None, warm=True,
@@ -710,7 +831,25 @@ class ModelRegistry:
         kw = dict(spec)
         path = kw.pop("path")
         kw.pop("devices", None)
-        kw["replicas"] = n
+        m = max(entry.mesh_sizes() or [1])
+        if m > 1:
+            # a mesh entry resizes in whole GROUPS: n replicas of the
+            # entry's mesh size, packed over disjoint consecutive local
+            # devices — the same shard-at-rest shape the original fit
+            # check admitted
+            import jax
+            local = list(jax.local_devices())
+            if n * m > len(local):
+                raise ValueError(
+                    "resize of mesh model %r to %d replicas needs "
+                    "%d x %d = %d devices, host has %d"
+                    % (name, n, n, m, n * m, len(local)))
+            kw["devices"] = [
+                "+".join("%s:%d" % (d.platform, d.id)
+                         for d in local[i * m:(i + 1) * m])
+                for i in range(n)]
+        else:
+            kw["replicas"] = n
         new_entry = self.load_model(name, path, **kw)
         fields = dict(signal or {})
         fields.update(model=name, precision=new_entry.precision,
@@ -745,6 +884,13 @@ class ModelRegistry:
                     info["replicas"] = len(latest.replicas)
                     info["devices"] = latest.device_labels()
                     info["precision"] = latest.precision
+                    sizes = latest.mesh_sizes()
+                    if any(s > 1 for s in sizes):
+                        # mesh replicas (SERVING.md): members per
+                        # replica, in route order — serving_top's MESH
+                        # column and the load reply's resolved shape
+                        info["mesh"] = sizes
+                        info["mesh_size"] = max(sizes)
                     if latest.resource is not None:
                         # the static cost the fleet controller places
                         # by (ANALYSIS.md): per-replica peak estimate
@@ -753,6 +899,12 @@ class ModelRegistry:
                             latest.resource.peak_mb, 3)
                         info["est_flops"] = int(
                             latest.resource.total_flops)
+                        if int(getattr(latest.resource, "mesh_size",
+                                       1)) > 1:
+                            # what each mesh MEMBER holds resident —
+                            # the number the per-device fit admitted on
+                            info["est_per_device_mb"] = round(
+                                latest.resource.per_device_mb, 3)
                     if latest.is_decode:
                         # decode entry: buckets above are the PROMPT
                         # prefill buckets; surface the generation shape

@@ -439,6 +439,12 @@ class InferenceServer:
                      # what THIS load/flip cost against the persistent
                      # compile cache: a warm flip reads hits=N, misses=0
                      "compile_cache": dict(entry.compile_cache)}
+            sizes = entry.mesh_sizes()
+            if any(s > 1 for s in sizes):
+                # the RESOLVED mesh shape (SERVING.md "Mesh replicas"):
+                # members per replica lane, in route order — what a
+                # 'mesh:2' spec actually packed on this host
+                reply["mesh"] = sizes
             if entry.is_decode:
                 reply["decode"] = True
                 reply["decode_slots"] = entry.batcher.n_slots

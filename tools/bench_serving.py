@@ -948,6 +948,125 @@ def run_topology_lane(args, backend_label):
         print(json.dumps(rec), flush=True)
 
 
+def run_mesh_lane(args, backend_label):
+    """Mesh-replica sweep (SERVING.md "Mesh replicas"): `--mesh 1,2,4`
+    serves the SAME decode workload from one replica built as an
+    m-chip device mesh per point — params and the KV slot table
+    sharded across the members, compute replicated, so every point's
+    streams must be BIT-EXACT vs the single-device greedy oracle
+    (checked per point, before any throughput number is read).  Fresh
+    server per point.
+
+    The headline is the FIT column pair, not the QPS column: the
+    static per-member estimate (`est_per_device_mb`, what the
+    admission gate prices each member chip at) drops ~1/m while the
+    whole-model estimate stays flat — the axis along which a model too
+    big for any single chip admits on a mesh.  `fit_headroom_mb` is
+    budget − per-member estimate when a device budget is known
+    (FLAGS.serving_device_mem_mb, or the chip's HBM on recognized
+    TPUs; None on unconfigured CPU smoke).  QPS on the CPU smoke lane
+    reads scheduling overhead only — mesh points pay XLA's
+    cross-device collectives for no compute win on a host core; the
+    tpu_watch "serving_mesh" stage re-measures on silicon where the
+    sharded weights actually buy HBM."""
+    import jax
+    from paddle_tpu.analysis.resources import device_memory_bytes
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.inference.decode import (GenerativePredictor,
+                                             greedy_decode)
+    from paddle_tpu.serving import InferenceServer, ServingClient
+
+    if args.device_mem_mb > 0:
+        set_flags({"serving_device_mem_mb": int(args.device_mem_mb)})
+
+    workdir = tempfile.mkdtemp(prefix="bench_serving_mesh_")
+    model_dir = build_decode_model(os.path.join(workdir, "lm"))
+    budget = 24
+    rng = random.Random(41)
+    prompts = [[rng.randrange(1, 60) for _ in range(rng.randrange(2, 8))]
+               for _ in range(8)]
+    oracle = GenerativePredictor(model_dir)
+    refs = [greedy_decode(oracle, p, budget)[0] for p in prompts]
+    points = [int(p) for p in str(args.mesh).split(",") if p.strip()]
+    devs = jax.devices()
+    n_streams = len(prompts)
+
+    for m in points:
+        if m < 1 or m > len(devs):
+            # no silent caps: a skipped point is announced, not dropped
+            print(json.dumps({"metric": "serving_mesh", "mesh": m,
+                              "skipped": "host has %d device(s)"
+                              % len(devs)}), flush=True)
+            continue
+        spec = "+".join("%s:%d" % (d.platform, d.id) for d in devs[:m])
+        server = InferenceServer().start()
+        cli = ServingClient(server.endpoint)
+        rec = {"metric": "serving_mesh", "mesh": m, "devices": spec,
+               "replicas": 1, "streams": n_streams,
+               "max_new_tokens": budget}
+        try:
+            t0 = time.monotonic()
+            loaded = cli.load_model(
+                "lm", model_dir, replicas=spec,
+                decode_slots=args.decode_slots,
+                kv_cache_dtype=None if args.kv_dtype == "fp32"
+                else "int8" if args.kv_dtype == "int8" else None)
+            rec["cold_start_ms"] = round(
+                (time.monotonic() - t0) * 1e3, 1)
+            rec["resolved_mesh"] = loaded.get("mesh", [1])
+            outs = [None] * n_streams
+            errs = []
+
+            def drive(i):
+                c = ServingClient(server.endpoint)
+                try:
+                    outs[i] = [t for ch in c.infer_stream(
+                        "lm", prompts[i], max_new_tokens=budget,
+                        deadline_ms=120000.0) for t in ch]
+                except Exception as e:
+                    errs.append(e)
+                finally:
+                    c.close()
+
+            t0 = time.monotonic()
+            threads = [threading.Thread(target=drive, args=(i,))
+                       for i in range(n_streams)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            wall = time.monotonic() - t0
+            assert not errs, "mesh=%d streams failed: %r" % (m, errs[:2])
+            rec["wall_s"] = round(wall, 3)
+            rec["qps"] = round(n_streams / wall, 2)
+            rec["tokens_per_sec"] = round(
+                n_streams * budget / wall, 1)
+            # every point replays against the single-device oracle:
+            # sharding must never move one token
+            rec["bit_exact"] = bool(
+                all(outs[i] == refs[i] for i in range(n_streams)))
+            # the fit columns: whole-model vs per-member pricing
+            d = cli.stats()["models"]["lm"]
+            rec["est_peak_mb"] = d.get("est_peak_mb")
+            rec["est_per_device_mb"] = d.get(
+                "est_per_device_mb", d.get("est_peak_mb"))
+            avail = device_memory_bytes(devs[0])
+            if avail is not None and rec["est_per_device_mb"]:
+                rec["device_budget_mb"] = round(avail / float(1 << 20), 1)
+                rec["fit_headroom_mb"] = round(
+                    rec["device_budget_mb"] - rec["est_per_device_mb"],
+                    3)
+            else:
+                rec["device_budget_mb"] = None
+                rec["fit_headroom_mb"] = None
+        finally:
+            cli.close()
+            server.shutdown(drain=False, timeout=10.0)
+        if backend_label:
+            rec["backend"] = backend_label
+        print(json.dumps(rec), flush=True)
+
+
 def _parse_replica_sweep(spec):
     """'1,4' -> sweep of counts; 'auto' / '4' / 'cpu:0,cpu:1' -> one
     placement spec point (a comma list containing ':' is a device list,
@@ -1236,6 +1355,21 @@ def main():
                          "total replica budget per point, one flash-"
                          "crowd burst each (SERVING.md 'Federated "
                          "serving', BENCH_r17.json)")
+    ap.add_argument("--device_mem_mb", type=int, default=0,
+                    help="per-device memory budget (MB) for the "
+                         "admission fit check during the --mesh sweep "
+                         "(sets FLAGS.serving_device_mem_mb; 0 keeps "
+                         "the backend's own budget) — makes the "
+                         "fit_headroom_mb column live on CPU smoke")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh-replica sweep (SERVING.md 'Mesh "
+                         "replicas'): comma list of mesh sizes "
+                         "('1,2,4') — each point serves one replica "
+                         "built as an m-chip device mesh (params + KV "
+                         "sharded) from a FRESH server, replays "
+                         "bit-exact vs the single-device oracle, and "
+                         "records the per-member fit estimate + "
+                         "headroom (BENCH_r18.json)")
     ap.add_argument("--replicas", default="1",
                     help="replica placement spec per point: a count, "
                          "'auto' (one replica per local device), an "
@@ -1293,6 +1427,12 @@ def main():
                          "this many ms")
     args = ap.parse_args()
 
+    if args.mesh and args.force_host_devices == 0:
+        # the mesh sweep needs as many host devices as its widest
+        # point; harmless on real TPU (the flag only splits CPU)
+        args.force_host_devices = max(
+            [4] + [int(p) for p in str(args.mesh).split(",")
+                   if p.strip()])
     if args.force_host_devices > 0:
         # must land before jax backend init (init_backend below); the
         # site hook may have imported jax already, but XLA_FLAGS is
@@ -1329,6 +1469,9 @@ def main():
         else:
             set_flags({"slo_monitor": False, "serving_slo": ""})
 
+    if args.mesh:
+        run_mesh_lane(args, backend_label)
+        return
     if args.topology:
         run_topology_lane(args, backend_label)
         return

@@ -152,6 +152,16 @@ CONFIGS = [
      ["@serving", "--decode", "--decode_mode", "cb",
       "--decode_slots", "4", "--step_cost_ms", "25",
       "--spec_k", "0,4", "--qps", "40", "--duration", "8"], 8, 1),
+    # mesh-replica lane (SERVING.md "Mesh replicas"): one replica as a
+    # 1- vs 2- vs 4-chip device mesh, params + KV slot table sharded
+    # across members, every point replayed bit-exact vs the single-
+    # device greedy oracle.  The CPU rows prove the sharded program +
+    # fit columns end to end (est_per_device_mb ~1/m at flat whole-
+    # model estimate, BENCH_r18.json); the QPS deltas only mean
+    # something on silicon (tpu_watch "serving_mesh" stage)
+    ("serving_mesh",
+     ["@serving", "--mesh", "1,2,4", "--decode_slots", "4",
+      "--device_mem_mb", "16"], 8, 1),
     # async-training-pipeline A/B (PIPELINE.md): same model, same
     # 40 ms/batch host stall (deterministic stand-in for host-side
     # preprocessing — the host-BOUND lane), prefetch + in-flight

@@ -80,6 +80,15 @@ exits nonzero on failure):
                budget bit-identical to the fp32-only greedy decode,
                a spec_degraded event + counter fire, and post-degrade
                traffic keeps serving with zero wedged lanes.
+  mesh-member-loss
+               mesh-replica chaos (SERVING.md "Mesh replicas"): poison
+               one member chip of a 2-chip sharded replica mesh
+               mid-stream.  The victim lane must DIE, not wedge —
+               in-flight streams on it fail typed (naming the lost
+               member), the lane is marked dead (stats/health +
+               mesh_lane_dead event) and skipped by admission, sibling
+               mesh lanes stay bit-exact, and page + fault-in rebuilds
+               the full mesh lane set from the persisted load spec.
 
   --smoke      crash-save (deterministic `exit` fault at every commit
                point) + bit-flip, fast enough for tier-1.
@@ -1299,6 +1308,175 @@ def scenario_spec_fallback(verbose=True):
     return {"victim_tokens": len(got), "accept_rate": accept}
 
 
+def scenario_mesh_member_loss(verbose=True):
+    """Mesh-replica chaos (SERVING.md "Mesh replicas"): one member chip
+    of a sharded replica mesh dies mid-stream.  A mesh lane cannot
+    degrade to fewer chips — its params and KV slot table are sharded
+    across the members — so the required failure shape is lane DEATH,
+    not a wedge:
+
+    (1) every in-flight stream on the victim mesh fails with a TYPED
+        error naming the lost member (zero hangs);
+    (2) the lane is marked dead — stats/health carry the mesh size and
+        the death reason, a `mesh_lane_dead` event fires, and admission
+        skips the corpse;
+    (3) sibling mesh lanes are untouched: their in-flight streams
+        complete BIT-EXACT vs the single-device greedy oracle, and
+        fresh post-loss traffic keeps serving bit-exact on survivors;
+    (4) the persisted load spec replays: page + fault-in rebuilds the
+        FULL mesh lane set (the fleet controller's fault path), and the
+        rebuilt lanes serve bit-exact again.
+    """
+    # the mesh needs >= 4 host devices; when the backend is already up
+    # with fewer (e.g. `--scenario all` after another scenario touched
+    # jax), re-exec as a subprocess with the forced device count
+    import jax
+    if jax.device_count() < 4:
+        env = dict(os.environ)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + ["--xla_force_host_platform_device_count=8"])
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--scenario", "mesh-member-loss"],
+            env=env, cwd=REPO, timeout=900)
+        assert proc.returncode == 0, \
+            "mesh-member-loss subprocess failed (rc=%d)" % proc.returncode
+        return {"reexec": True}
+
+    import tempfile
+    from paddle_tpu.inference.decode import (GenerativePredictor,
+                                             build_tiny_decode_model,
+                                             greedy_decode)
+    from paddle_tpu.obs import events as obs_events
+    from paddle_tpu.parallel.mesh import set_member_poison
+    from paddle_tpu.serving import (InferenceServer, ServingClient,
+                                    set_dispatch_delay)
+
+    md = build_tiny_decode_model(
+        os.path.join(tempfile.mkdtemp(prefix="chaos_mesh_"), "lm"),
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+        max_seq_len=64, eos_id=-1, seed=29)
+    pred = GenerativePredictor(md)
+    budget = 24
+    prompts = [[3, 5, 7], [9, 4], [11, 12, 13, 14], [2, 6]]
+    refs = [greedy_decode(pred, p, budget)[0] for p in prompts]
+    server = InferenceServer().start()
+    boot = ServingClient(server.endpoint)
+    set_member_poison(None)
+    try:
+        # two replica lanes, each a 2-chip mesh (params + KV sharded)
+        rep = boot.load_model("lm", md, decode_slots=4,
+                              replicas="cpu:0+cpu:1,cpu:2+cpu:3")
+        assert rep.get("mesh") == [2, 2], rep
+        set_dispatch_delay(0.02)  # slow steps: "mid-stream" for real
+
+        outs = [None] * len(prompts)
+        errs = [None] * len(prompts)
+        counts = [0] * len(prompts)
+
+        def run(i):
+            c = ServingClient(server.endpoint)
+            try:
+                buf = []
+                for ch in c.infer_stream("lm", prompts[i],
+                                         max_new_tokens=budget,
+                                         deadline_ms=60000.0):
+                    buf.extend(ch)
+                    counts[i] = len(buf)
+                outs[i] = buf
+            except Exception as e:
+                errs[i] = e
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        t0 = time.time()
+        while time.time() - t0 < 30.0:
+            if all(c >= 2 for c in counts):
+                break
+            time.sleep(0.01)
+        assert all(c >= 2 for c in counts), \
+            "streams never got going: %s" % (counts,)
+        # ---- kill one member of the first mesh mid-generation ------
+        set_member_poison("cpu:1")
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), \
+            "stream hung after mesh member loss (wedged lane)"
+        victims = [i for i in range(len(prompts)) if errs[i] is not None]
+        survivors = [i for i in range(len(prompts)) if errs[i] is None]
+        assert victims, "no stream was riding the poisoned mesh"
+        assert survivors, "member loss killed streams on sibling lanes"
+        for i in victims:
+            assert "mesh member" in str(errs[i]), \
+                "victim error not typed: %r" % (errs[i],)
+        for i in survivors:
+            assert outs[i] == refs[i], \
+                ("member loss corrupted a SIBLING lane's stream %d "
+                 "(%s vs %s)" % (i, outs[i][:8], refs[i][:8]))
+
+        # ---- the corpse is marked, observable, and skipped ---------
+        snap = boot.stats()["stats"]["models"]["lm"]
+        rows = snap.get("replicas") or []
+        dead = [r for r in rows if r.get("dead")]
+        live = [r for r in rows if not r.get("dead")]
+        assert len(dead) == 1 and len(live) == 1, rows
+        assert dead[0]["mesh"] == 2 and "cpu:1" in dead[0]["device"], \
+            dead[0]
+        ev = [e for e in obs_events.recent_events(kind="mesh_lane_dead")
+              if e.get("model") == "lm"]
+        assert ev, "no mesh_lane_dead event after member loss"
+        assert "cpu:1" in str(ev[-1].get("error", "")), ev[-1]
+        set_dispatch_delay(0.0)
+        for i, p in enumerate(prompts[:2]):
+            cli = ServingClient(server.endpoint)
+            try:
+                out = [t for ch in cli.infer_stream(
+                    "lm", p, max_new_tokens=budget,
+                    deadline_ms=60000.0) for t in ch]
+            finally:
+                cli.close()
+            assert out == refs[i], \
+                "post-loss stream on survivor not bit-exact for %s" % (p,)
+
+        # ---- rebuild from the persisted spec (fleet fault path) ----
+        set_member_poison(None)  # the "chip" comes back
+        boot.page_model("lm")
+        boot.fault_model("lm", trigger="chaos")
+        rows = boot.stats()["stats"]["models"]["lm"].get("replicas") or []
+        assert len(rows) == 2 and not any(r.get("dead") for r in rows), \
+            rows
+        assert all(r.get("mesh") == 2 for r in rows), rows
+        for i, p in enumerate(prompts):
+            cli = ServingClient(server.endpoint)
+            try:
+                out = [t for ch in cli.infer_stream(
+                    "lm", p, max_new_tokens=budget,
+                    deadline_ms=60000.0) for t in ch]
+            finally:
+                cli.close()
+            assert out == refs[i], \
+                "rebuilt mesh lane not bit-exact for %s" % (p,)
+    finally:
+        set_member_poison(None)
+        set_dispatch_delay(0.0)
+        boot.close()
+        server.shutdown(drain=False, timeout=10.0)
+    if verbose:
+        print("PASS mesh-member-loss: %d victim stream(s) failed typed, "
+              "%d sibling stream(s) bit-exact, dead lane marked + "
+              "mesh_lane_dead event, survivors served post-loss, "
+              "page/fault-in rebuilt both 2-chip mesh lanes bit-exact"
+              % (len(victims), len(survivors)))
+    return {"victims": len(victims), "survivors": len(survivors)}
+
+
 def scenario_trace_overflow(workdir, verbose=True):
     """Observability hot-path safety (OBSERVABILITY.md): the span ring
     wraps under concurrent load and the event log rotates mid-write —
@@ -2152,6 +2330,7 @@ def main(argv=None):
                                            "decode-disconnect-int8",
                                            "decode-disconnect-fused",
                                            "spec-fallback",
+                                           "mesh-member-loss",
                                            "slo-breach",
                                            "flash-crowd",
                                            "backend-kill", "all"])
@@ -2212,7 +2391,8 @@ def main(argv=None):
                      "quantize-commit", "trace-overflow",
                      "decode-disconnect", "decode-disconnect-int8",
                      "decode-disconnect-fused",
-                     "spec-fallback", "slo-breach", "flash-crowd",
+                     "spec-fallback", "mesh-member-loss",
+                     "slo-breach", "flash-crowd",
                      "backend-kill"]
     else:
         scenarios = [args.scenario]
@@ -2257,6 +2437,8 @@ def main(argv=None):
                 scenario_decode_disconnect_fused()
             elif s == "spec-fallback":
                 scenario_spec_fallback()
+            elif s == "mesh-member-loss":
+                scenario_mesh_member_loss()
             elif s == "slo-breach":
                 scenario_slo_breach(os.path.join(workdir, "slo_breach"))
             elif s == "flash-crowd":

@@ -47,6 +47,13 @@
 #                        hangs), survivors bit-exact, lease evicted
 #                        within one TTL, re-placement on the survivor
 #                        (SERVING.md "Federated serving")
+#     19  mesh           mesh-replica chaos: the mesh-member-loss
+#                        scenario — poison one member chip of a 2-chip
+#                        sharded replica mesh mid-stream; the lane dies
+#                        typed (never wedges), siblings stay bit-exact,
+#                        and page/fault-in rebuilds the full mesh lane
+#                        set from the persisted spec (SERVING.md
+#                        "Mesh replicas")
 #      1  usage          unknown gate name
 #      0  all requested gates clean
 #
@@ -63,7 +70,7 @@ SPEC="${API_SPEC:-API.spec}"
 gates=("$@")
 if [ ${#gates[@]} -eq 0 ]; then
     gates=(lint_runtime lint_program apispec specdec slo kernels fleet
-           fused_decode federation)
+           fused_decode federation mesh)
 fi
 
 for gate in "${gates[@]}"; do
@@ -126,10 +133,14 @@ for gate in "${gates[@]}"; do
             echo "== ci_checks: federation gate =="
             "$PY" tools/chaos.py --scenario backend-kill || exit 18
             ;;
+        mesh)
+            echo "== ci_checks: mesh gate =="
+            "$PY" tools/chaos.py --scenario mesh-member-loss || exit 19
+            ;;
         *)
             echo "ci_checks: unknown gate '$gate'" \
                  "(have: lint_runtime lint_program apispec specdec" \
-                 "slo kernels fleet fused_decode federation)"
+                 "slo kernels fleet fused_decode federation mesh)"
             exit 1
             ;;
     esac

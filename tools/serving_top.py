@@ -173,11 +173,11 @@ def render(reply, health=None, fleet=None):
         # stats came from a federation frontend: backend table first
         lines.extend(_federation_lines(reply["federation"]))
     hdr = ("%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
-           "%7s %7s %5s %5s %5s %7s %6s %5s %6s"
+           "%7s %7s %5s %5s %5s %7s %6s %5s %5s %6s"
            % ("MODEL", "PREC", "VER", "QPS", "REQS", "p50ms", "p95ms",
               "p99ms", "FILL", "BKT%", "QUEUE", "SHED", "CCH/M",
               "TTFT95", "TPS", "TPD", "OCC%", "ACC%", "SLO", "LIVE",
-              "REPL", "FLEET"))
+              "REPL", "MESH", "FLEET"))
     lines.append(hdr)
     lines.append("-" * len(hdr))
     described = set()
@@ -211,9 +211,16 @@ def render(reply, health=None, fleet=None):
         acc = m.get("spec_accept_rate")
         slo_col, live_col = _health_cols(name, health)
         repl_col, fleet_col = _fleet_cols(name, desc, fleet)
+        # MESH: member-device count of this model's replica lanes
+        # (SERVING.md "Mesh replicas") — '-' for plain one-chip lanes,
+        # NxM-style counts come from the lane rows (live) or describe()
+        sizes = [int(r.get("mesh", 1) or 1)
+                 for r in m.get("replicas") or []]
+        mesh_max = max(sizes or [int(d.get("mesh_size", 1) or 1)])
+        mesh_col = str(mesh_max) if mesh_max > 1 else "-"
         lines.append(
             "%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
-            "%7s %7s %5s %5s %5s %7s %6s %5s %6s"
+            "%7s %7s %5s %5s %5s %7s %6s %5s %5s %6s"
             % (plain[:14], prec[:5], _fmt(ver),
                _fmt(m.get("qps_recent")), _fmt(m.get("requests")),
                _fmt(lat.get("p50")), _fmt(lat.get("p95")),
@@ -225,7 +232,7 @@ def render(reply, health=None, fleet=None):
                     and occ >= 0 else None),
                _fmt(round(100.0 * acc, 1)
                     if isinstance(acc, float) else None),
-               slo_col, live_col, repl_col, fleet_col))
+               slo_col, live_col, repl_col, mesh_col, fleet_col))
         st = (health or {}).get("slo", {}).get(name)
         if st and st.get("monitored") and st.get("burn"):
             # one sub-row per burning objective: which SLI is eating
@@ -268,14 +275,25 @@ def render(reply, health=None, fleet=None):
             lines.append("    shed_by_priority=%s" % (shed_pri,))
         for r in m.get("replicas") or []:
             # one sub-row per replica lane: load skew across devices
-            # must be visible at a glance
-            lines.append(
-                "    r%-3s %-10s %9s %9s %10s %12s"
-                % (r.get("replica"), r.get("device"),
-                   "inflt=%s" % _fmt(r.get("inflight")),
-                   "queue=%s" % _fmt(r.get("queue")),
-                   "batches=%s" % _fmt(r.get("batches")),
-                   "rows=%s" % _fmt(r.get("rows"))))
+            # must be visible at a glance.  A mesh lane (SERVING.md
+            # "Mesh replicas") renders its member-device count here and
+            # one indented sub-row per member chip; a lane killed by
+            # member loss stays visible with a DEAD marker.
+            dev = str(r.get("device") or "-")
+            mesh = int(r.get("mesh", 1) or 1)
+            label = dev if mesh == 1 else "mesh(%d)" % mesh
+            row = ("    r%-3s %-10s %9s %9s %10s %12s"
+                   % (r.get("replica"), label[:10],
+                      "inflt=%s" % _fmt(r.get("inflight")),
+                      "queue=%s" % _fmt(r.get("queue")),
+                      "batches=%s" % _fmt(r.get("batches")),
+                      "rows=%s" % _fmt(r.get("rows"))))
+            if r.get("dead"):
+                row += "  DEAD(%s)" % str(r["dead"])[:40]
+            lines.append(row)
+            if mesh > 1:
+                for member in dev.split("+"):
+                    lines.append("         + %s" % member)
     return "\n".join(lines)
 
 

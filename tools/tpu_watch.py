@@ -221,6 +221,17 @@ def main():
                         "--topology", "1x4,2x2,4x1",
                         "--dispatch_cost_ms", "20",
                         "--duration", "15"], {}, 3600),
+        # mesh replicas (SERVING.md "Mesh replicas"): the --mesh sweep
+        # on real chips — a replica as a 1/2/4-chip mesh with params +
+        # KV sharded across members.  On silicon the REAL numbers are
+        # the per-member HBM cut (the fit_headroom_mb column against
+        # the chip's actual budget — what admits a model no single
+        # chip can hold) and whether the cross-chip collectives' step
+        # tax stays small; the CPU smoke (BENCH_r18.json) can only
+        # prove bit-exactness and the static fit curve
+        ("serving_mesh", ["tools/bench_serving.py", "--require_tpu",
+                          "--mesh", "1,2,4",
+                          "--decode_slots", "8"], {}, 3600),
         # quantized serving A/B on silicon (QUANTIZE.md): resnet fp32
         # vs PTQ-int8 behind the precision axis — on the HBM-roofline-
         # bound chip the int8 lane's halved weight bytes should show up

@@ -181,7 +181,7 @@ class ResourceReport:
                  "kv_cache_bytes", "actual_param_bytes", "total_flops",
                  "total_bytes", "device", "ops", "per_block",
                  "top_contributors", "peak_op", "n_ops", "precision",
-                 "mesh_size")
+                 "mesh_size", "tp")
 
     def __init__(self, what="program", batch=1):
         self.what = what
@@ -204,6 +204,11 @@ class ResourceReport:
         # estimate divides by this while activations (replicated
         # compute) do not
         self.mesh_size = 1
+        # tensor-parallel compute (SERVING.md "Tensor-parallel
+        # compute"): when True, per-STEP traffic also divides by the
+        # mesh — each member streams only its resident shard per token,
+        # instead of gathering and re-reading the whole model
+        self.tp = False
 
     @property
     def peak_bytes(self):
@@ -230,6 +235,42 @@ class ResourceReport:
     @property
     def per_device_mb(self):
         return self.per_device_bytes() / float(1 << 20)
+
+    def per_device_step_bytes(self, mesh_size=None, tp=None):
+        """Estimated per-STEP HBM traffic on EACH member device of a
+        `mesh_size`-device replica (defaults: the report's own stamped
+        ``mesh_size`` / ``tp``).
+
+        Gather mode (tp False — PR 18's replicate-compute contract):
+        every member materializes and streams the WHOLE model per step,
+        so the per-member traffic is ``total_bytes`` regardless of
+        mesh size — sharding at rest buys capacity, not bandwidth.
+        Tensor-parallel (tp True): the partitioned program touches only
+        the member's resident shard — ceil(total_bytes / m).  This is
+        the decode-bandwidth roofline column (ROOFLINE.md) and the
+        modeled-bytes basis of bench_serving's --mesh_tp A/B."""
+        m = max(int(self.mesh_size if mesh_size is None else mesh_size),
+                1)
+        t = self.tp if tp is None else bool(tp)
+        total = int(self.total_bytes)
+        if m == 1 or not t:
+            return total
+        return -(-total // m)
+
+    def per_device_step_ms(self, mesh_size=None, tp=None):
+        """Per-member roofline time lower bound for one step.  Under
+        tensor parallelism both the FLOPs and the streamed bytes divide
+        by the mesh (each member computes its head/column slice on its
+        resident shard); gather mode keeps the single-device number —
+        every member does the full step."""
+        m = max(int(self.mesh_size if mesh_size is None else mesh_size),
+                1)
+        t = self.tp if tp is None else bool(tp)
+        flops = self.total_flops / float(m if (t and m > 1) else 1)
+        t_flop = flops / max(self.device["peak_flops"], 1.0)
+        t_mem = (self.per_device_step_bytes(m, t)
+                 / max(self.device["hbm_bytes_per_s"], 1.0))
+        return max(t_flop, t_mem) * 1000.0
 
     @property
     def arithmetic_intensity(self):
@@ -275,8 +316,11 @@ class ResourceReport:
             "peak_bytes": int(self.peak_bytes),
             "peak_mb": round(self.peak_mb, 3),
             "mesh_size": int(self.mesh_size),
+            "tp": bool(self.tp),
             "per_device_bytes": int(self.per_device_bytes()),
             "per_device_mb": round(self.per_device_mb, 3),
+            "per_device_step_bytes": int(self.per_device_step_bytes()),
+            "per_device_step_ms": round(self.per_device_step_ms(), 6),
             "actual_param_bytes": self.actual_param_bytes,
             "total_flops": int(self.total_flops),
             "total_bytes": int(self.total_bytes),
@@ -318,6 +362,14 @@ class ResourceReport:
             "  roofline    >= %.3f ms/step, MFU cap %.1f%%"
             % (self.est_step_ms, 100.0 * self.mfu_cap()),
         ]
+        if self.mesh_size > 1:
+            lines.append(
+                "  per member  %10.2f MiB resident, %.2f MiB moved"
+                "/step, >= %.3f ms/step  (mesh=%d, %s)"
+                % (self.per_device_mb,
+                   self.per_device_step_bytes() / (1 << 20),
+                   self.per_device_step_ms(), self.mesh_size,
+                   "tensor-parallel" if self.tp else "gather"))
         if len(self.per_block) > 1:
             lines.append("  per block:")
             for row in self.per_block:
@@ -824,18 +876,22 @@ def _decode_report(path, meta, decode_slots, device, what,
     return rep
 
 
-def _with_mesh(rep, mesh_size):
-    """Stamp a replica mesh size on a report (SERVING.md "Mesh
-    replicas") — makes ``per_device_bytes`` the 1/mesh sharded-at-rest
-    estimate the per-member fit check admits on."""
+def _with_mesh(rep, mesh_size, tp=None):
+    """Stamp a replica mesh size (and tensor-parallel compute mode) on
+    a report (SERVING.md "Mesh replicas" / "Tensor-parallel compute")
+    — makes ``per_device_bytes`` the 1/mesh sharded-at-rest estimate
+    the per-member fit check admits on, and ``per_device_step_bytes``
+    the per-member traffic the bandwidth roofline prices."""
     if mesh_size:
         rep.mesh_size = max(int(mesh_size), 1)
+    if tp is not None:
+        rep.tp = bool(tp)
     return rep
 
 
 def analyze_artifact(path, batch=1, decode_slots=None, device=None,
                      kv_cache_dtype=None, fuse_steps=None,
-                     mesh_size=None):
+                     mesh_size=None, tp=None):
     """Static resource report for a saved artifact dir — the admission
     gate's input, and lint_program --report's row source.
 
@@ -849,7 +905,10 @@ def analyze_artifact(path, batch=1, decode_slots=None, device=None,
     from their state payload + feed specs.  ``mesh_size`` stamps a
     mesh-replica shape on the report: total bytes are unchanged, but
     ``per_device_bytes`` (what `check_fit` prices per mesh member)
-    reads params + KV at ~1/mesh_size."""
+    reads params + KV at ~1/mesh_size.  ``tp`` marks tensor-parallel
+    compute (FLAGS.mesh_tp): ``per_device_step_bytes`` /
+    ``per_device_step_ms`` then divide the per-step traffic roofline
+    by the mesh too."""
     from ..inference.decode import DECODE_META
     dm = os.path.join(path, DECODE_META)
     if os.path.exists(dm):
@@ -859,7 +918,7 @@ def analyze_artifact(path, batch=1, decode_slots=None, device=None,
         return _with_mesh(
             _decode_report(path, meta, decode_slots, device, path,
                            kv_cache_dtype=kv_cache_dtype,
-                           fuse_steps=fuse_steps), mesh_size)
+                           fuse_steps=fuse_steps), mesh_size, tp=tp)
     am = os.path.join(path, "aot_meta.bin")
     if os.path.exists(am):
         from ..native import wire
@@ -880,7 +939,7 @@ def analyze_artifact(path, batch=1, decode_slots=None, device=None,
         rep.activation_peak_bytes = act
         rep.total_bytes = rep.param_bytes + act
         rep.total_flops = (rep.param_bytes // 4) * 2 * int(batch)
-        return _with_mesh(rep, mesh_size)
+        return _with_mesh(rep, mesh_size, tp=tp)
     model_file = os.path.join(path, "__model__")
     if not os.path.exists(model_file):
         raise FileNotFoundError(
@@ -904,7 +963,7 @@ def analyze_artifact(path, batch=1, decode_slots=None, device=None,
             actual += max(os.path.getsize(fpath) - 128, 0)
     if actual:
         rep.actual_param_bytes = actual
-    return _with_mesh(rep, mesh_size)
+    return _with_mesh(rep, mesh_size, tp=tp)
 
 
 def check_fit(report, device=None, what=None, replicas=1,

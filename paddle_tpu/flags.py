@@ -401,6 +401,33 @@ DEFINE_string(
     "slots as int8 with per-(layer,head) fp32 scales — ~0.25x cache "
     "bytes per slot, greedy streams bit-stable against themselves. "
     "Per-load override: load_model(kv_cache_dtype=...).")
+DEFINE_bool(
+    "mesh_tp", False,
+    "Tensor-parallel mesh compute (SERVING.md \"Tensor-parallel "
+    "compute\"): a mesh replica's decode program lowers as ONE "
+    "shard_map'd executable over the replica's MeshGroup — fc/mul "
+    "weights in Megatron column->row pairs with one psum per pair, "
+    "attention head-parallel with the decode kernel running per member "
+    "on its resident KV shard (int8 scales slice along heads too), "
+    "embedding row-sharded over vocab — so params and KV never "
+    "materialize unsharded and per-step HBM traffic per member drops "
+    "~1/mesh_size (the decode-roofline win, ROOFLINE.md). Streams stay "
+    "top-1 identical to a single-device replica; activations carry "
+    "psum-reduction-order noise at float tolerance where a matmul is "
+    "row-split (documented contract, tests/test_mesh_tp.py). False "
+    "(default) keeps PR 18's shard-at-rest gather path — bit-exact by "
+    "construction. Read at predictor build time: registry fault-in / "
+    "hot-swap rebuilds pick up a flip.")
+DEFINE_int(
+    "mesh_tp_prefill_seq", 128,
+    "Minimum prompt bucket for sequence-parallel TP prefill: at or "
+    "above this bucket (and when the bucket divides the mesh), prefill "
+    "shards the SEQUENCE axis across members ulysses-style (all_to_all "
+    "into head-parallel attention, parallel/ulysses.py) with per-layer "
+    "weight all_gathers amortized over the long prompt — bit-exact vs "
+    "the single-device oracle because every position's math runs with "
+    "full weights. Below it, prefill runs head/column-parallel like "
+    "decode (top-1 contract). Only read when FLAGS.mesh_tp is on.")
 DEFINE_int(
     "serving_spec_k", 4,
     "Speculative-decoding draft depth (SERVING.md): when a decode "

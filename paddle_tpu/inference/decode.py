@@ -260,6 +260,69 @@ def _causal_attention(q, k, v, scale):
     return o
 
 
+class _TPContext:
+    """Trace-time handle threaded through the phase math when the
+    program lowers TENSOR-PARALLEL over a mesh replica (`FLAGS.mesh_tp`,
+    SERVING.md "Tensor-parallel compute").  Inside the shard_map'd body
+    every weight/KV operand is this member's LOCAL shard; the context
+    carries the axis grammar plus the handful of collectives the
+    Megatron split needs — one psum per column->row pair, one logits
+    all_gather, the exact masked-gather+psum embedding lookup.
+    `tp=None` (the default everywhere) keeps each math fn's trace
+    byte-identical to the single-device program."""
+
+    __slots__ = ("axis", "size")
+
+    def __init__(self, size, axis=None):
+        from paddle_tpu.parallel.mesh import MODEL_AXIS
+        self.size = int(size)
+        self.axis = axis or MODEL_AXIS
+
+    def index(self):
+        import jax
+        return jax.lax.axis_index(self.axis)
+
+    def psum(self, x):
+        """Close one column->row-parallel pair: sum the members' partial
+        products.  THE tolerance point of the TP contract — reduction
+        order moves across members, so downstream activations agree
+        with the single-device oracle at float tolerance, not
+        bit-exactly (tests/test_mesh_tp.py pins top-1 agreement)."""
+        import jax
+        return jax.lax.psum(x, self.axis)
+
+    def all_gather(self, x, axis):
+        """Tiled all_gather (exact — pure data movement): reassembles
+        the vocab-sharded logits for the replicated argmax."""
+        import jax
+        return jax.lax.all_gather(x, self.axis, axis=axis, tiled=True)
+
+    def head_scales(self, scales, n_local):
+        """This member's head block of a BAKED full-table kv-scale
+        constant [..., H, 1] (sliced on axis -2 at a traced offset):
+        the int8 quantize/dequant stays local to the resident heads."""
+        import jax
+        import jax.numpy as jnp
+        full = jnp.asarray(scales, jnp.float32)
+        off = self.index() * jnp.int32(n_local)
+        return jax.lax.dynamic_slice_in_dim(full, off, int(n_local),
+                                            axis=full.ndim - 2)
+
+    def embed_lookup(self, embed_local, ids):
+        """EXACT embedding gather over the vocab-row-sharded table
+        (parallel/sharded_embedding.py's convention): each member
+        gathers the ids it owns, contributes true zeros for the rest,
+        and the psum adds exactly one nonzero term per row — 0 + v is
+        exact in float, so no tolerance demotion here."""
+        import jax.numpy as jnp
+        vl = int(embed_local.shape[0])
+        off = self.index() * jnp.int32(vl)
+        local = ids - off
+        ok = (local >= 0) & (local < vl)
+        rows = embed_local[jnp.clip(local, 0, vl - 1)]
+        return self.psum(jnp.where(ok[..., None], rows, 0.0))
+
+
 class GenerativePredictor:
     """A decode artifact opened for serving: weights + meta + the two
     compiled phases (per-bucket prefill, one fixed-shape decode step
@@ -324,12 +387,47 @@ class GenerativePredictor:
             self._kv_scales = self._calibrate_kv_scales() \
                 if self._kv_dtype == "int8" else None
         self._device = device
+        # tensor-parallel compute (SERVING.md "Tensor-parallel
+        # compute"): on a MeshGroup with FLAGS.mesh_tp and evenly
+        # dividing dims, the phases lower as ONE shard_map'd partitioned
+        # executable and the state is placed by the TP axis grammar.
+        # Read ONCE here — a registry fault-in / hot-swap rebuild
+        # re-reads the flag; live sessions keep their build's mode.
+        self._tp_size = 0
+        self._tp_prefill_seq = 0
+        group = None
         if device is not None:
-            from paddle_tpu.inference.predictor import _put_state
-            # a MeshGroup placement shards every param at rest over the
-            # mesh (SERVING.md "Mesh replicas"); a plain device is the
-            # legacy single-chip pin
-            self._state = _put_state(self._state_host, device)
+            from paddle_tpu.parallel.mesh import (as_mesh_group,
+                                                  tp_supported)
+            group = as_mesh_group(device)
+        if group is not None:
+            from paddle_tpu.flags import FLAGS
+            if FLAGS.mesh_tp:
+                _, H, _, D = self._dims()
+                if tp_supported(group.mesh_size, H, D,
+                                self.vocab_size, 4 * D):
+                    self._tp_size = group.mesh_size
+                    self._tp_prefill_seq = max(
+                        1, int(FLAGS.mesh_tp_prefill_seq))
+                else:
+                    warnings.warn(
+                        "FLAGS.mesh_tp requested but model dims "
+                        "(heads=%d d_model=%d vocab=%d) do not divide "
+                        "the %d-member mesh — falling back to the "
+                        "shard-at-rest gather path"
+                        % (self._dims()[1], self._dims()[3],
+                           self.vocab_size, group.mesh_size),
+                        RuntimeWarning, stacklevel=2)
+        if device is not None:
+            if self._tp_size:
+                from paddle_tpu.inference.predictor import _put_state_tp
+                self._state = _put_state_tp(self._state_host, group)
+            else:
+                from paddle_tpu.inference.predictor import _put_state
+                # a MeshGroup placement shards every param at rest over
+                # the mesh (SERVING.md "Mesh replicas"); a plain device
+                # is the legacy single-chip pin
+                self._state = _put_state(self._state_host, device)
         else:
             self._state = {n: np.asarray(v)
                            for n, v in self._state_host.items()}
@@ -372,6 +470,19 @@ class GenerativePredictor:
     @property
     def _kv_quant(self):
         return self._kv_dtype == "int8"
+
+    @property
+    def tp_active(self):
+        """True when this predictor's phases compute TENSOR-PARALLEL
+        over its mesh group (FLAGS.mesh_tp at build + evenly dividing
+        dims) — the serving stats / serving_top TP marker reads this."""
+        return bool(self._tp_size)
+
+    @property
+    def tp_size(self):
+        """Members the partitioned program shards over (0 when compute
+        is not tensor-parallel)."""
+        return int(self._tp_size)
 
     def kv_scales(self):
         """The calibrated per-(layer, head) fp32 dequant scales
@@ -490,45 +601,83 @@ class GenerativePredictor:
 
         return np.stack([sc(kc), sc(vc)])[..., None]
 
-    def _prefill_math(self, state, tokens, true_len):
+    def _prefill_math(self, state, tokens, true_len, tp=None):
         """The traced prefill phase: `_prefill_core` plus the int8
         cache-write quantization epilogue (zeros quantize to exact
-        int8 zeros, so the zero-slot contract is dtype-blind)."""
+        int8 zeros, so the zero-slot contract is dtype-blind).  Under
+        TP the K/V are this member's head shard, so the scale constant
+        slices to the resident head block — same per-head scale, same
+        quantized byte as the single-device write."""
         import jax.numpy as jnp
-        first, kc, vc = self._prefill_core(state, tokens, true_len)
+        first, kc, vc = self._prefill_core(state, tokens, true_len,
+                                           tp=tp)
         if not self._kv_quant:
             return first, kc, vc
         sc = self._kv_scales                     # [2, L, H, 1] np
+        if tp is not None:
+            sc = tp.head_scales(sc, kc.shape[3])  # [2, L, Hl, 1]
         kq = self._quantize_kv(
             kc, sc[0][:, None, None]).astype(jnp.int8)
         vq = self._quantize_kv(
             vc, sc[1][:, None, None]).astype(jnp.int8)
         return first, kq, vq
 
-    def _prefill_core(self, state, tokens, true_len):
+    def _tp_seq_parallel(self, bucket):
+        """Does this prompt bucket prefill SEQUENCE-parallel under TP?
+        Long prompts at a bucket the mesh divides shard the sequence
+        axis (ulysses reshard into head-parallel attention, per-layer
+        weight all_gathers amortized over the bucket — bit-exact);
+        short ones run head/column-parallel like decode (top-1
+        contract, no per-layer gathers)."""
+        m = self._tp_size
+        return bool(m and bucket % m == 0
+                    and bucket >= self._tp_prefill_seq)
+
+    def _prefill_core(self, state, tokens, true_len, tp=None):
         """tokens [1, B] int32, true_len scalar int32 -> (first_token
-        [] int32, k/v [L, 1, B, H, Dh] fp32 with pad positions
-        zeroed)."""
+        [] int32, k/v [L, 1, B, H, Dh] fp32 with pad positions zeroed).
+        Under TP (`tp` set, inside shard_map) weights are local shards:
+        the returned K/V carry this member's HEAD block [L, 1, B, H/m,
+        Dh] (the cache's at-rest layout), attention is head-parallel
+        (exact per head), and each column->row pair closes with one
+        psum; long buckets divert to the bit-exact sequence-parallel
+        body instead."""
         import jax.numpy as jnp
         L, H, Dh, D = self._dims()
         B = tokens.shape[1]
         scale = 1.0 / np.sqrt(Dh)
-        x = state["embed"][tokens] + state["pos"][:B][None]
+        if tp is not None and self._tp_seq_parallel(B):
+            return self._prefill_core_seqpar(state, tokens, true_len,
+                                             tp)
+        Hl = H if tp is None else H // tp.size
+        if tp is None:
+            x = state["embed"][tokens] + state["pos"][:B][None]
+        else:
+            x = tp.embed_lookup(state["embed"], tokens) \
+                + state["pos"][:B][None]
         ks, vs = [], []
         for i in range(L):
             p = "l%d_" % i
             h = _ln(x, state[p + "ln1_g"], state[p + "ln1_b"])
-            q = (h @ state[p + "wq"]).reshape(1, B, H, Dh)
-            k = (h @ state[p + "wk"]).reshape(1, B, H, Dh)
-            v = (h @ state[p + "wv"]).reshape(1, B, H, Dh)
-            att = _causal_attention(q, k, v, scale).reshape(1, B, D)
-            x = x + att @ state[p + "wo"]
+            q = (h @ state[p + "wq"]).reshape(1, B, Hl, Dh)
+            k = (h @ state[p + "wk"]).reshape(1, B, Hl, Dh)
+            v = (h @ state[p + "wv"]).reshape(1, B, Hl, Dh)
+            att = _causal_attention(q, k, v, scale).reshape(
+                1, B, Hl * Dh)
+            wo_out = att @ state[p + "wo"]
+            x = x + (wo_out if tp is None else tp.psum(wo_out))
             h2 = _ln(x, state[p + "ln2_g"], state[p + "ln2_b"])
-            x = x + jnp.maximum(h2 @ state[p + "w1"] + state[p + "b1"],
-                                0.0) @ state[p + "w2"] + state[p + "b2"]
+            mlp = jnp.maximum(h2 @ state[p + "w1"] + state[p + "b1"],
+                              0.0) @ state[p + "w2"]
+            x = x + (mlp if tp is None else tp.psum(mlp)) \
+                + state[p + "b2"]
             ks.append(k)
             vs.append(v)
         logits = _ln(x, state["lnf_g"], state["lnf_b"]) @ state["lm_head"]
+        if tp is not None:
+            # vocab-sharded logits reassemble (exact data movement)
+            # before the replicated argmax
+            logits = tp.all_gather(logits, axis=2)
         first = jnp.argmax(logits[0, true_len - 1], axis=-1).astype(
             jnp.int32)
         # zero the pad positions: the slot cache must hold exact zeros
@@ -540,7 +689,73 @@ class GenerativePredictor:
         vc = jnp.where(live, jnp.stack(vs), 0.0)
         return first, kc, vc
 
-    def _step_math(self, state, kc, vc, lengths, last_tokens, active):
+    def _prefill_core_seqpar(self, state, tokens, true_len, tp):
+        """SEQUENCE-parallel TP prefill (parallel/ulysses.py's scheme):
+        each member owns B/m prompt positions; per layer the sharded
+        weights all_gather back whole (exact data movement, amortized
+        over the long bucket — prefill is compute-bound, unlike
+        decode), attention rides the ulysses seq<->heads all_to_all
+        pair around the SAME `_causal_attention` oracle, and K/V
+        all_to_all into the head-sharded cache layout.  Every
+        position's math runs with FULL weights in the single-device
+        reduction order, so this path is BIT-EXACT vs the oracle — no
+        psum ever touches an activation."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.parallel.ulysses import (heads_to_seq,
+                                                 seq_to_heads)
+        L, H, Dh, D = self._dims()
+        B = tokens.shape[1]
+        m = tp.size
+        Bl = B // m
+        scale = 1.0 / np.sqrt(Dh)
+        idx = tp.index()
+        tok_l = jax.lax.dynamic_slice(tokens, (0, idx * Bl), (1, Bl))
+        pos_l = jax.lax.dynamic_slice(
+            state["pos"], (idx * Bl, jnp.int32(0)),
+            (Bl, state["pos"].shape[1]))
+        x = tp.embed_lookup(state["embed"], tok_l) + pos_l[None]
+        ks, vs = [], []
+        for i in range(L):
+            p = "l%d_" % i
+            wq = tp.all_gather(state[p + "wq"], axis=1)
+            wk = tp.all_gather(state[p + "wk"], axis=1)
+            wv = tp.all_gather(state[p + "wv"], axis=1)
+            wo = tp.all_gather(state[p + "wo"], axis=0)
+            w1 = tp.all_gather(state[p + "w1"], axis=1)
+            b1 = tp.all_gather(state[p + "b1"], axis=0)
+            w2 = tp.all_gather(state[p + "w2"], axis=0)
+            h = _ln(x, state[p + "ln1_g"], state[p + "ln1_b"])
+            q = (h @ wq).reshape(1, Bl, H, Dh)
+            k = (h @ wk).reshape(1, Bl, H, Dh)
+            v = (h @ wv).reshape(1, Bl, H, Dh)
+            # seq->heads: full sequence, resident head block (exact)
+            qh = seq_to_heads(q, tp.axis)        # [1, B, H/m, Dh]
+            kh = seq_to_heads(k, tp.axis)
+            vh = seq_to_heads(v, tp.axis)
+            atth = _causal_attention(qh, kh, vh, scale)
+            att = heads_to_seq(atth, tp.axis).reshape(1, Bl, D)
+            x = x + att @ wo
+            h2 = _ln(x, state[p + "ln2_g"], state[p + "ln2_b"])
+            x = x + jnp.maximum(h2 @ w1 + b1, 0.0) @ w2 \
+                + state[p + "b2"]
+            # the cache's at-rest layout IS the post-reshard one: full
+            # sequence, this member's heads
+            ks.append(kh)
+            vs.append(vh)
+        xg = tp.all_gather(x, axis=1)            # [1, B, D] whole
+        lm = tp.all_gather(state["lm_head"], axis=1)
+        logits = _ln(xg, state["lnf_g"], state["lnf_b"]) @ lm
+        first = jnp.argmax(logits[0, true_len - 1], axis=-1).astype(
+            jnp.int32)
+        live = (jnp.arange(B)[None, :, None, None]
+                < true_len)[None]            # [1, 1, B, 1, 1]
+        kc = jnp.where(live, jnp.stack(ks), 0.0)
+        vc = jnp.where(live, jnp.stack(vs), 0.0)
+        return first, kc, vc
+
+    def _step_math(self, state, kc, vc, lengths, last_tokens, active,
+                   tp=None):
         """One fixed-shape decode step over the whole slot table.
         kc/vc [L, N, S, H, Dh] (fp32, or int8 under the quantized
         cache), lengths [N] i32 (live cached positions), last_tokens
@@ -549,14 +764,29 @@ class GenerativePredictor:
         stays zero and per-slot independence is exact.  Under int8,
         fresh K/V rows quantize in-graph before landing and the
         attention dequantizes in-register — float KV rows never reach
-        the cache arrays."""
+        the cache arrays.
+
+        Under TP (`tp` set, inside shard_map) kc/vc are this member's
+        resident HEAD shard and weights are local column/row shards:
+        attention runs the head-sliced decode kernel on the local
+        block (exact per head — heads are independent), each
+        column->row pair closes with ONE psum, and the vocab-sharded
+        logits all_gather before the argmax — params and KV never
+        materialize unsharded, per-step HBM traffic per member
+        ~1/mesh_size."""
         import jax.numpy as jnp
-        from paddle_tpu.ops.pallas_kernels import decode_attention
+        from paddle_tpu.ops.pallas_kernels import (
+            decode_attention, decode_attention_head_slice)
         L, H, Dh, D = self._dims()
         N, S = kc.shape[1], kc.shape[2]
         quant = self._kv_quant
         scale = 1.0 / np.sqrt(Dh)
-        x = state["embed"][last_tokens] + state["pos"][lengths]  # [N, D]
+        Hl = H if tp is None else H // tp.size
+        if tp is None:
+            x = state["embed"][last_tokens] + state["pos"][lengths]
+        else:
+            x = tp.embed_lookup(state["embed"], last_tokens) \
+                + state["pos"][lengths]                         # [N, D]
         write = (jnp.arange(S)[None, :] == lengths[:, None]) \
             & active[:, None]                                   # [N, S]
         wmask = write[:, :, None, None]
@@ -564,31 +794,45 @@ class GenerativePredictor:
         for i in range(L):
             p = "l%d_" % i
             h = _ln(x, state[p + "ln1_g"], state[p + "ln1_b"])
-            q = (h @ state[p + "wq"]).reshape(N, H, Dh)
-            k_new = (h @ state[p + "wk"]).reshape(N, H, Dh)
-            v_new = (h @ state[p + "wv"]).reshape(N, H, Dh)
+            q = (h @ state[p + "wq"]).reshape(N, Hl, Dh)
+            k_new = (h @ state[p + "wk"]).reshape(N, Hl, Dh)
+            v_new = (h @ state[p + "wv"]).reshape(N, Hl, Dh)
             if quant:
+                sc_i = self._kv_scales[:, i] if tp is None \
+                    else tp.head_scales(self._kv_scales[:, i], Hl)
                 k_new = self._quantize_kv(
-                    k_new, self._kv_scales[0, i]).astype(jnp.int8)
+                    k_new, sc_i[0]).astype(jnp.int8)
                 v_new = self._quantize_kv(
-                    v_new, self._kv_scales[1, i]).astype(jnp.int8)
+                    v_new, sc_i[1]).astype(jnp.int8)
             kci = jnp.where(wmask, k_new[:, None], kc[i])
             vci = jnp.where(wmask, v_new[:, None], vc[i])
-            att = decode_attention(q, kci, vci, lengths + 1,
-                                   scale=scale,
-                                   kv_scales=self._kv_scales[:, i]
-                                   if quant else None)
-            x = x + att.reshape(N, D) @ state[p + "wo"]
+            if tp is None:
+                att = decode_attention(q, kci, vci, lengths + 1,
+                                       scale=scale,
+                                       kv_scales=self._kv_scales[:, i]
+                                       if quant else None)
+            else:
+                att = decode_attention_head_slice(
+                    q, kci, vci, lengths + 1, tp.index() * Hl, Hl,
+                    scale=scale,
+                    kv_scales=self._kv_scales[:, i] if quant else None)
+            wo_out = att.reshape(N, Hl * Dh) @ state[p + "wo"]
+            x = x + (wo_out if tp is None else tp.psum(wo_out))
             h2 = _ln(x, state[p + "ln2_g"], state[p + "ln2_b"])
-            x = x + jnp.maximum(h2 @ state[p + "w1"] + state[p + "b1"],
-                                0.0) @ state[p + "w2"] + state[p + "b2"]
+            mlp = jnp.maximum(h2 @ state[p + "w1"] + state[p + "b1"],
+                              0.0) @ state[p + "w2"]
+            x = x + (mlp if tp is None else tp.psum(mlp)) \
+                + state[p + "b2"]
             kcs.append(kci)
             vcs.append(vci)
         logits = _ln(x, state["lnf_g"], state["lnf_b"]) @ state["lm_head"]
+        if tp is not None:
+            logits = tp.all_gather(logits, axis=1)
         new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return new_tok, jnp.stack(kcs), jnp.stack(vcs)
 
-    def _verify_math(self, state, kc, vc, lengths, tokens, active):
+    def _verify_math(self, state, kc, vc, lengths, tokens, active,
+                     tp=None):
         """One speculative VERIFY step over the whole slot table:
         tokens [N, C] = [pending last token, draft d1..dk] (C = k+1),
         -> (g [N, C] target greedy tokens per position, m [N] accepted
@@ -609,16 +853,27 @@ class GenerativePredictor:
         Acceptance and rollback are in-graph: m = longest prefix with
         d_i == g_{i-1}; rows past length+m (the rejected suffix) are
         zeroed before the caches return, so stale draft K/V never
-        survives into the committed cache."""
+        survives into the committed cache.
+
+        Under TP the same head-parallel discipline as `_step_math`
+        applies: local head shards through the head-sliced kernel, one
+        psum per pair, logits all_gather — the spec-decode round's
+        verify rides the partitioned program unchanged."""
         import jax.numpy as jnp
-        from paddle_tpu.ops.pallas_kernels import decode_attention
+        from paddle_tpu.ops.pallas_kernels import (
+            decode_attention, decode_attention_head_slice)
         L, H, Dh, D = self._dims()
         N, C = tokens.shape
         S = kc.shape[2]
         quant = self._kv_quant
         scale = 1.0 / np.sqrt(Dh)
+        Hl = H if tp is None else H // tp.size
         pos_idx = lengths[:, None] + jnp.arange(C)[None]        # [N, C]
-        x = state["embed"][tokens] + state["pos"][pos_idx]      # [N,C,D]
+        if tp is None:
+            x = state["embed"][tokens] + state["pos"][pos_idx]  # [N,C,D]
+        else:
+            x = tp.embed_lookup(state["embed"], tokens) \
+                + state["pos"][pos_idx]
         write = (jnp.arange(S)[None, None, :]
                  == pos_idx[:, :, None]) & active[:, None, None]
         written = jnp.any(write, axis=1)[:, :, None, None]      # [N,S,1,1]
@@ -627,16 +882,18 @@ class GenerativePredictor:
         for i in range(L):
             p = "l%d_" % i
             h = _ln(x, state[p + "ln1_g"], state[p + "ln1_b"])
-            q = (h @ state[p + "wq"]).reshape(N, C, H, Dh)
-            k_new = (h @ state[p + "wk"]).reshape(N, C, H, Dh)
-            v_new = (h @ state[p + "wv"]).reshape(N, C, H, Dh)
+            q = (h @ state[p + "wq"]).reshape(N, C, Hl, Dh)
+            k_new = (h @ state[p + "wk"]).reshape(N, C, Hl, Dh)
+            v_new = (h @ state[p + "wv"]).reshape(N, C, Hl, Dh)
             if quant:
                 # quantize BEFORE the scatter: the one-hot contraction
                 # moves exact fp32 integer values, so the int8 cast
                 # lands the same byte a sequential step write would —
                 # verify rows == step rows bit-for-bit
-                k_new = self._quantize_kv(k_new, self._kv_scales[0, i])
-                v_new = self._quantize_kv(v_new, self._kv_scales[1, i])
+                sc_i = self._kv_scales[:, i] if tp is None \
+                    else tp.head_scales(self._kv_scales[:, i], Hl)
+                k_new = self._quantize_kv(k_new, sc_i[0])
+                v_new = self._quantize_kv(v_new, sc_i[1])
             # land all C rows (positions are distinct, so the scatter
             # contraction adds exact zeros around one exact value)
             wf = write.astype(k_new.dtype)
@@ -648,20 +905,33 @@ class GenerativePredictor:
             kci = jnp.where(written, ksc, kc[i])
             vci = jnp.where(written, vsc, vc[i])
             kx = jnp.broadcast_to(
-                kci[:, None], (N, C, S, H, Dh)).reshape(N * C, S, H, Dh)
+                kci[:, None],
+                (N, C, S, Hl, Dh)).reshape(N * C, S, Hl, Dh)
             vx = jnp.broadcast_to(
-                vci[:, None], (N, C, S, H, Dh)).reshape(N * C, S, H, Dh)
-            att = decode_attention(q.reshape(N * C, H, Dh), kx, vx,
-                                   qlens, scale=scale,
-                                   kv_scales=self._kv_scales[:, i]
-                                   if quant else None)
-            x = x + att.reshape(N, C, D) @ state[p + "wo"]
+                vci[:, None],
+                (N, C, S, Hl, Dh)).reshape(N * C, S, Hl, Dh)
+            if tp is None:
+                att = decode_attention(q.reshape(N * C, Hl, Dh), kx, vx,
+                                       qlens, scale=scale,
+                                       kv_scales=self._kv_scales[:, i]
+                                       if quant else None)
+            else:
+                att = decode_attention_head_slice(
+                    q.reshape(N * C, Hl, Dh), kx, vx, qlens,
+                    tp.index() * Hl, Hl, scale=scale,
+                    kv_scales=self._kv_scales[:, i] if quant else None)
+            wo_out = att.reshape(N, C, Hl * Dh) @ state[p + "wo"]
+            x = x + (wo_out if tp is None else tp.psum(wo_out))
             h2 = _ln(x, state[p + "ln2_g"], state[p + "ln2_b"])
-            x = x + jnp.maximum(h2 @ state[p + "w1"] + state[p + "b1"],
-                                0.0) @ state[p + "w2"] + state[p + "b2"]
+            mlp = jnp.maximum(h2 @ state[p + "w1"] + state[p + "b1"],
+                              0.0) @ state[p + "w2"]
+            x = x + (mlp if tp is None else tp.psum(mlp)) \
+                + state[p + "b2"]
             kcs.append(kci)
             vcs.append(vci)
         logits = _ln(x, state["lnf_g"], state["lnf_b"]) @ state["lm_head"]
+        if tp is not None:
+            logits = tp.all_gather(logits, axis=2)
         g = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [N, C]
         match = (tokens[:, 1:] == g[:, :C - 1]).astype(jnp.int32)
         m = jnp.sum(jnp.cumprod(match, axis=1), axis=1).astype(jnp.int32)
@@ -680,7 +950,7 @@ class GenerativePredictor:
         return (g, m, jnp.where(stale_m, zero, kall),
                 jnp.where(stale_m, zero, vall))
 
-    def _fused_step_math(self, n_steps):
+    def _fused_step_math(self, n_steps, tp=None):
         """Build the FUSED multi-step decode phase (SERVING.md "Fused
         multi-step decode"): up to `n_steps` plain decode steps run as
         ONE compiled executable — a `lax.while_loop` carrying {KV
@@ -726,7 +996,7 @@ class GenerativePredictor:
             def body(carry):
                 i, kc, vc, lengths, last, emitted, toks, running = carry
                 tok, kc, vc = self._step_math(state, kc, vc, lengths,
-                                              last, running)
+                                              last, running, tp=tp)
                 # land this trip's tokens at column i (one-hot select —
                 # stopped slots keep their block rows untouched)
                 col = (jnp.arange(n_steps)[None, :] == i) \
@@ -749,7 +1019,7 @@ class GenerativePredictor:
 
         return fused
 
-    def _fused_spec_math(self, draft, spec_k):
+    def _fused_spec_math(self, draft, spec_k, tp=None):
         """Build the FUSED speculative round: k draft decode steps +
         the batched k+1-position verify + in-graph accept / draft-
         rollback / draft-catch-up bookkeeping, all ONE executable (one
@@ -779,14 +1049,14 @@ class GenerativePredictor:
             drafts = []
             for _ in range(k):
                 dtok, d_kc, d_vc = draft._step_math(
-                    dstate, d_kc, d_vc, d_len, d_last, active)
+                    dstate, d_kc, d_vc, d_len, d_last, active, tp=tp)
                 d_len = d_len + adv
                 d_last = jnp.where(active, dtok, d_last)
                 drafts.append(dtok)
             # 2. VERIFY: score [pending, d1..dk] in one batched step
             chunk = jnp.stack([t_last] + drafts, axis=1)      # [N, C]
             g, m, t_kc, t_vc = self._verify_math(
-                state, t_kc, t_vc, t_len, chunk, active)
+                state, t_kc, t_vc, t_len, chunk, active, tp=tp)
             m = jnp.where(active, m, 0)
             # 3. COMMIT: target bookkeeping (mirrors the host round)
             counts = jnp.where(active, m + 1, 0).astype(jnp.int32)
@@ -812,7 +1082,7 @@ class GenerativePredictor:
             # token re-pinned to the target's bonus token
             full = active & (m == k)
             _cu, d_kc, d_vc = draft._step_math(
-                dstate, d_kc, d_vc, d_len, d_last, full)
+                dstate, d_kc, d_vc, d_len, d_last, full, tp=tp)
             d_len = d_len + full.astype(jnp.int32)
             d_last = jnp.where(full, g[:, k], d_last)
             return (g, m, t_kc, t_vc, t_len, t_last,
@@ -832,9 +1102,9 @@ class GenerativePredictor:
                     for k, v in sorted(spec.items())}
         return [list(spec.shape), str(spec.dtype)]
 
-    def _fingerprint(self, phase_key, arg_specs):
+    def _fingerprint(self, phase_key, arg_specs, extra=None):
         from paddle_tpu import compile_cache as cc
-        return {
+        fp = {
             "kind": "decode_phase",
             "model": self._model_fp,
             "phase": list(phase_key),
@@ -849,6 +1119,13 @@ class GenerativePredictor:
             "args": [self._argsig(s) for s in arg_specs],
             "env": cc.environment_fingerprint(self._device),
         }
+        if extra:
+            # tensor-parallel phases fold the mesh shape in: the
+            # partitioned module's collectives are specialized to the
+            # axis size, so a (2,) and a (4,) executable must never
+            # resolve each other's blobs
+            fp.update(extra)
+        return fp
 
     def _device_kind(self):
         import jax
@@ -859,10 +1136,17 @@ class GenerativePredictor:
         return "%s/%s" % (getattr(d, "platform", "cpu"),
                           getattr(d, "device_kind", ""))
 
-    def _resolve(self, phase_key, math_fn, arg_specs):
+    def _resolve(self, phase_key, math_fn, arg_specs, tp_math=None,
+                 draft=None):
         """Persistent-cache-first compile of one phase (same order as
         Predictor._get_aot_fn: in-process shared map -> store hit ->
-        fresh export+commit -> legacy jit fallback)."""
+        fresh export+commit -> legacy jit fallback).  `tp_math` is the
+        per-member tensor-parallel body (math_fn with a bound
+        _TPContext); when set and the predictor rides a mesh, the phase
+        compiles as ONE shard_map'd partitioned program instead of the
+        replicate-compute gather wrap.  `draft` (fused-spec only) tells
+        the spec builder how the draft's dict-shaped state is actually
+        placed."""
         import time as _time
         import jax
         fn = self._fns.get(phase_key)
@@ -873,7 +1157,8 @@ class GenerativePredictor:
             if fn is not None:
                 return fn
             fn = self._resolve_locked(phase_key, math_fn, arg_specs,
-                                      _time, jax)
+                                      _time, jax, tp_math=tp_math,
+                                      draft=draft)
             self._fns[phase_key] = fn
             return fn
 
@@ -881,46 +1166,121 @@ class GenerativePredictor:
         from paddle_tpu.parallel.mesh import as_mesh_group
         return as_mesh_group(self._device)
 
-    def _mesh_specs(self, group, state_spec, arg_specs, jax):
+    def _tp_ctx(self):
+        return _TPContext(self._tp_size)
+
+    def _tp_math(self, math_fn):
+        """The per-member tensor-parallel body for a phase math fn, or
+        None when this predictor isn't TP-active (single device, gather
+        fallback, or a model the TP grammar can't split)."""
+        if not self._tp_size:
+            return None
+        tp = self._tp_ctx()
+
+        def fn(state, *args):
+            return math_fn(state, *args, tp=tp)
+        return fn
+
+    def _mesh_specs(self, group, state_spec, arg_specs, jax,
+                    draft=None):
         """Attach the at-rest shardings to the phase's arg specs so the
-        direct lower().compile() matches what the session actually
-        passes: params sharded per `param_sharding`, 5-D KV slot tables
-        per `kv_sharding`, everything else replicated.  Dict-shaped args
-        (the fused-speculative phase's DRAFT state) shard like params —
-        the draft rides the same mesh group as its target lane."""
+        compiled executable matches what the session actually passes:
+        params sharded per `param_sharding` (or `tp_param_sharding`
+        when this predictor runs tensor-parallel — AOT executables are
+        strict about input placement), 5-D KV slot tables per
+        `kv_sharding`, everything else replicated.  Dict-shaped args
+        (the fused-speculative phase's DRAFT state) shard per the
+        DRAFT's own placement — it rides the same mesh group as its
+        target lane but may be TP-placed or gather-placed
+        independently."""
         def attach(s, sh):
             return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)
 
+        def params(spec, tp):
+            if tp:
+                return {k: attach(v, group.tp_param_sharding(k, v.shape))
+                        for k, v in spec.items()}
+            return {k: attach(v, group.param_sharding(v.shape))
+                    for k, v in spec.items()}
+
         def one(spec):
             if isinstance(spec, dict):
-                return {k: attach(v, group.param_sharding(v.shape))
-                        for k, v in spec.items()}
+                return params(spec,
+                              draft is not None
+                              and getattr(draft, "_tp_size", 0))
             if len(spec.shape) == 5:
                 return attach(spec, group.kv_sharding(spec.shape))
             return attach(spec, group.replicated())
 
-        state_spec = {n: attach(s, group.param_sharding(s.shape))
-                      for n, s in state_spec.items()}
+        state_spec = params(state_spec, self._tp_size)
         return state_spec, tuple(one(s) for s in arg_specs)
 
-    def _resolve_locked(self, phase_key, math_fn, arg_specs, _time, jax):
+    def _tp_shard_map(self, tp_math, plain_math, state_spec, arg_specs,
+                      group, jax):
+        """Build the partitioned program: ONE shard_map over the
+        group's 1-D "model" axis running the per-member body.  Params
+        enter under the TP grammar (`tp_param_pspec`), 5-D KV slot
+        tables head-sharded (axis 3 — `tp_supported` guarantees heads
+        divide, so this coincides with the at-rest `kv_sharding`),
+        scalars/token tables replicated.  Output specs come from
+        eval_shape of the plain (tp=None) math — the TP body returns
+        the same tree, with 5-D caches staying head-sharded and
+        everything else fully reduced (psum/all_gather) hence
+        replicated."""
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.parallel.mesh import (
+            MODEL_AXIS, shard_map_no_rep_check, tp_param_pspec)
+
+        kv_spec = P(None, None, None, MODEL_AXIS, None)
+
+        def pspec_of(spec):
+            if isinstance(spec, dict):
+                return {k: tp_param_pspec(k, v.shape)
+                        for k, v in spec.items()}
+            if len(spec.shape) == 5:
+                return kv_spec
+            return P()
+
+        in_specs = ({n: tp_param_pspec(n, s.shape)
+                     for n, s in state_spec.items()},)
+        in_specs += tuple(pspec_of(s) for s in arg_specs)
+        out_shape = jax.eval_shape(plain_math, state_spec, *arg_specs)
+        out_specs = jax.tree_util.tree_map(
+            lambda s: kv_spec if len(s.shape) == 5 else P(), out_shape)
+        return shard_map_no_rep_check(tp_math, group.mesh(),
+                                      in_specs=in_specs,
+                                      out_specs=out_specs)
+
+    def _resolve_locked(self, phase_key, math_fn, arg_specs, _time, jax,
+                        tp_math=None, draft=None):
         from paddle_tpu import compile_cache as cc
         state_spec = {n: jax.ShapeDtypeStruct(np.shape(v),
                                               np.asarray(v).dtype)
                       for n, v in self._state_host.items()}
+        fp_extra = None
         group = self._mesh_group()
         if group is not None:
-            # meshed phases compile directly against the sharded state
-            # (no export: a serialized blob has a single-device calling
-            # convention).  The math is wrapped in the replicate-compute
-            # contract (predictor._mesh_wrap) so streams stay bit-exact
-            # vs a single-device replica; KV outputs re-shard at rest.
-            from paddle_tpu.inference.predictor import _mesh_wrap
             state_spec, arg_specs = self._mesh_specs(
-                group, state_spec, arg_specs, jax)
-            return self._jit_fallback(
-                _mesh_wrap(math_fn, group, kv_outputs=True),
-                state_spec, arg_specs)
+                group, state_spec, arg_specs, jax, draft=draft)
+            if tp_math is None:
+                # gather-mode meshed phases compile directly against
+                # the sharded state (no export: the replicate-compute
+                # wrap is a sharding annotation, not program structure).
+                # predictor._mesh_wrap keeps streams bit-exact vs a
+                # single-device replica; KV outputs re-shard at rest.
+                from paddle_tpu.inference.predictor import _mesh_wrap
+                return self._jit_fallback(
+                    _mesh_wrap(math_fn, group, kv_outputs=True),
+                    state_spec, arg_specs)
+            # tensor-parallel: the shard_map'd partitioned program IS
+            # part of the traced module and sharded ShapeDtypeStructs
+            # round-trip through jax.export — so TP phases ride the
+            # persistent cache like single-device ones, with the mesh
+            # shape folded into the fingerprint (warm boots of a TP
+            # server deserialize the partitioned executable).
+            math_fn = self._tp_shard_map(tp_math, math_fn, state_spec,
+                                         arg_specs, group, jax)
+            fp_extra = {"mesh": list(group.shape), "tp": True}
         if cc.cache_enabled() and not (
                 self._device is not None
                 and self._device.platform != jax.default_backend()):
@@ -935,7 +1295,8 @@ class GenerativePredictor:
             cache = cc.default_cache()
             fn = None
             try:
-                fp = self._fingerprint(phase_key, arg_specs)
+                fp = self._fingerprint(phase_key, arg_specs,
+                                       extra=fp_extra)
                 blob = cache.get(fp) if cache is not None else None
                 if blob is not None:
                     try:
@@ -984,7 +1345,8 @@ class GenerativePredictor:
         specs = (jax.ShapeDtypeStruct((1, bucket), np.dtype(np.int32)),
                  jax.ShapeDtypeStruct((), np.dtype(np.int32)))
         return self._resolve(("prefill", bucket), self._prefill_math,
-                             specs)
+                             specs,
+                             tp_math=self._tp_math(self._prefill_math))
 
     def _cache_np_dtype(self):
         return np.dtype(np.int8 if self._kv_quant else np.float32)
@@ -1002,7 +1364,8 @@ class GenerativePredictor:
                                       np.dtype(np.int32)),
                  jax.ShapeDtypeStruct((int(n_slots),), np.dtype(bool)))
         return self._resolve(("step", int(n_slots)), self._step_math,
-                             specs)
+                             specs,
+                             tp_math=self._tp_math(self._step_math))
 
     def verify_fn(self, n_slots, spec_k):
         """The speculative-verify executable for a (slot table,
@@ -1020,7 +1383,8 @@ class GenerativePredictor:
                  jax.ShapeDtypeStruct((n,), np.dtype(np.int32)),
                  jax.ShapeDtypeStruct((n, C), np.dtype(np.int32)),
                  jax.ShapeDtypeStruct((n,), np.dtype(bool)))
-        return self._resolve(("verify", n, C), self._verify_math, specs)
+        return self._resolve(("verify", n, C), self._verify_math, specs,
+                             tp_math=self._tp_math(self._verify_math))
 
     def fused_step_fn(self, n_slots, n_steps):
         """The fused multi-step decode executable for a (slot table,
@@ -1044,8 +1408,11 @@ class GenerativePredictor:
                  jax.ShapeDtypeStruct((n,), np.dtype(bool)),
                  jax.ShapeDtypeStruct((n,), i32),
                  jax.ShapeDtypeStruct((), i32))
+        tp_math = (self._fused_step_math(T, tp=self._tp_ctx())
+                   if self._tp_size else None)
         return self._resolve(("fused_step", n, T),
-                             self._fused_step_math(T), specs)
+                             self._fused_step_math(T), specs,
+                             tp_math=tp_math)
 
     def fused_spec_fn(self, draft, n_slots, spec_k):
         """The fused speculative-round executable: k draft steps +
@@ -1077,9 +1444,17 @@ class GenerativePredictor:
                  jax.ShapeDtypeStruct((n,), np.dtype(bool)))
         key = ("fused_spec", n, C, draft._model_fp[:16],
                draft._kv_dtype)
+        # the fused round partitions only when BOTH sides split under
+        # the TP grammar — a gather-placed draft beside a TP target
+        # falls back to the replicate-compute wrap (whose specs still
+        # reflect each side's actual placement via _mesh_specs)
+        tp_math = (self._fused_spec_math(draft, int(spec_k),
+                                         tp=self._tp_ctx())
+                   if self._tp_size and getattr(draft, "_tp_size", 0)
+                   else None)
         return self._resolve(key,
                              self._fused_spec_math(draft, int(spec_k)),
-                             specs)
+                             specs, tp_math=tp_math, draft=draft)
 
     def new_session(self, n_slots):
         return DecodeSession(self, n_slots)

@@ -144,6 +144,21 @@ def _put_state(state, device):
             for n, v in state.items()}
 
 
+def _put_state_tp(state, group):
+    """Tensor-parallel at-rest placement (SERVING.md "Tensor-parallel
+    compute"): every NAMED decode parameter lands on the mesh axis its
+    role in the partitioned program dictates (`MeshGroup.
+    tp_param_sharding` — column weights split output columns, row
+    weights split input rows, the embedding splits vocab rows) instead
+    of `param_sharding`'s any-divisible-axis scan.  Resident bytes stay
+    ~1/mesh_size like shard-at-rest; the difference is the compute
+    consumes these shards IN PLACE — no gather per dispatch."""
+    import jax
+    return {n: jax.device_put(np.asarray(v),
+                              group.tp_param_sharding(n, np.shape(v)))
+            for n, v in state.items()}
+
+
 def _put_feed(arr, device):
     """Commit one feed/arg to its placement (replicated on every mesh
     member — feeds are small; the sharded thing is the resident
@@ -182,6 +197,35 @@ def _mesh_wrap(math_fn, group, kv_outputs=False):
         state = jax.tree_util.tree_map(_rep, state)
         args = jax.tree_util.tree_map(_rep, args)
         return jax.tree_util.tree_map(_out, math_fn(state, *args))
+
+    return wrapped
+
+
+def _mesh_wrap_tp(math_fn, group):
+    """Partitioned-compute contract for PROGRAM predictors under
+    `FLAGS.mesh_tp` (SERVING.md "Tensor-parallel compute"): instead of
+    gathering operands to replicated, PIN the resident at-rest
+    shardings on the state and let XLA's SPMD partitioner run the math
+    over the shards — a contraction against a sharded weight computes
+    on local columns/rows with the partitioner inserting the reduce,
+    so weights never materialize unsharded and per-dispatch HBM
+    traffic per member drops ~1/mesh_size.  Feeds and outputs stay
+    replicated (the serving wire is host-side either way).  Outputs
+    agree with a single-device replica at float tolerance, not
+    bit-exactly (partitioned reductions reorder), which is exactly why
+    the flag gates it; the decode path (inference/decode.py) carries
+    the explicit shard_map'd program and the top-1 pins."""
+    import jax
+
+    def _rep(x):
+        return jax.lax.with_sharding_constraint(x, group.replicated())
+
+    def wrapped(state, *args):
+        state = {n: jax.lax.with_sharding_constraint(
+            x, group.param_sharding(np.shape(x)))
+            for n, x in state.items()}
+        args = jax.tree_util.tree_map(_rep, args)
+        return jax.tree_util.tree_map(_rep, math_fn(state, *args))
 
     return wrapped
 
@@ -285,6 +329,9 @@ class Predictor:
 
         group = _mesh_of(self._device)
         if group is not None:
+            from paddle_tpu.flags import FLAGS
+            if FLAGS.mesh_tp:
+                return _mesh_wrap_tp(fwd, group)
             return _mesh_wrap(fwd, group)
         return fwd
 

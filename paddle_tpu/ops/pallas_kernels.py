@@ -35,7 +35,8 @@ import numpy as np
 from . import attention_tuning
 
 __all__ = ["tiled_contraction", "flash_attention", "decode_attention",
-           "decode_attention_reference", "fused_bottleneck",
+           "decode_attention_reference", "decode_attention_head_slice",
+           "fused_bottleneck",
            "bottleneck_reference", "dequant_matmul",
            "dequant_matmul_reference", "mosaic_lowering"]
 
@@ -667,6 +668,37 @@ def decode_attention(q, k_cache, v_cache, lengths, scale=None,
         scratch_fill=(0.0, _NEG_INF, 0.0),
         tile=tile, finalize=finalize,
         interpret=interpret)
+
+
+def decode_attention_head_slice(q, k_cache, v_cache, lengths, head_offset,
+                                n_local_heads, scale=None, block_kv=None,
+                                interpret=None, kv_scales=None):
+    """Tensor-parallel entry (SERVING.md "Tensor-parallel compute"):
+    decode attention over one member's RESIDENT head block of the slot
+    table. q/k_cache/v_cache are already the LOCAL head shards
+    ([N, Hl, D] / [N, S, Hl, D], Hl = n_local_heads), but `kv_scales`
+    arrives as the FULL per-layer table [2, H_total] (or [2, H_total,
+    1]) — the scales are baked compile-time constants shared by every
+    member, so each member dynamic-slices its own [2, Hl] window at
+    `head_offset` (a traced `lax.axis_index * Hl` inside shard_map)
+    and the in-register dequant stays local. Heads are independent,
+    so per head the math is identical to `decode_attention` on the
+    full table — bit-exact while XLA preserves the compiled reduction
+    shape of the head block, ULP-level otherwise (a 1-head-wide block
+    schedules the score contraction differently; pinned either way by
+    tests/test_mesh_tp.py)."""
+    import jax
+    import jax.numpy as jnp
+    Hl = int(n_local_heads)
+    sc = None
+    if kv_scales is not None:
+        full = jnp.asarray(kv_scales, jnp.float32)
+        full = full.reshape(2, -1)                  # [2, H_total]
+        sc = jax.lax.dynamic_slice_in_dim(
+            full, jnp.asarray(head_offset, jnp.int32), Hl, axis=1)
+    return decode_attention(q, k_cache, v_cache, lengths, scale=scale,
+                            block_kv=block_kv, interpret=interpret,
+                            kv_scales=sc)
 
 
 # ---------------------------------------------------------------------------

@@ -13,12 +13,14 @@ conventions (used across the framework):
 """
 
 import os
+import re
 
 import numpy as np
 
 __all__ = ["make_mesh", "data_parallel_mesh", "local_device_count", "get_shard_map",
            "MeshGroup", "MeshMemberLost", "as_mesh_group",
            "set_member_poison", "check_member_poison",
+           "tp_param_pspec", "tp_supported",
            "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS"]
 
 DATA_AXIS = "data"
@@ -134,16 +136,31 @@ class MeshGroup:
     branches on `isinstance(dev, MeshGroup)` and uses the sharding
     helpers below.
 
-    Sharding discipline (the bit-exactness contract): parameters and the
-    decode KV slot table are SHARDED AT REST over the 1-D `model` axis
-    (per-device resident bytes ~ 1/mesh_size — the fit-check unlock);
-    compute runs REPLICATED — every traced phase gathers its operands
-    back to replicated before any math (see the predictors'
-    `_mesh_wrap`), so no float reduction is ever reordered across
-    members and a mesh replica's stream is bit-identical to a
-    single-device replica's.  This is the MLPerf pods paper's
-    weight-update-sharding blueprint applied to inference: HBM scales
-    with the mesh, math does not move."""
+    Sharding discipline — two compute modes over the same at-rest
+    layout family (SERVING.md "Mesh replicas"):
+
+    * shard-at-rest (default, PR 18): parameters and the decode KV slot
+      table are SHARDED AT REST over the 1-D `model` axis (per-device
+      resident bytes ~ 1/mesh_size — the fit-check unlock); compute
+      runs REPLICATED — every traced phase gathers its operands back to
+      replicated before any math (see the predictors' `_mesh_wrap`), so
+      no float reduction is ever reordered across members and a mesh
+      replica's stream is bit-identical to a single-device replica's.
+      HBM capacity scales with the mesh; per-step traffic does not.
+
+    * tensor-parallel (`FLAGS.mesh_tp`, SERVING.md "Tensor-parallel
+      compute"): the program lowers as one shard_map'd executable over
+      this mesh — weights placed by `tp_param_pspec` (Megatron
+      column->row pairs, one psum per pair), attention head-parallel
+      on the resident KV shard, embedding row-sharded over vocab.
+      Params and KV never materialize unsharded, so per-step HBM
+      traffic per member drops ~1/mesh_size too (the decode-roofline
+      win). Streams are top-1 identical; activations downstream of a
+      row-split matmul carry psum reduction-order noise at float
+      tolerance (the documented demotion from bit-exact).
+
+    Both are the MLPerf pods paper's weight-update-sharding blueprint
+    applied to inference; TP adds the Megatron intra-layer split."""
 
     __slots__ = ("devices", "shape", "_mesh")
 
@@ -240,6 +257,22 @@ class MeshGroup:
         spec[axis] = MODEL_AXIS
         return NamedSharding(self.mesh(), P(*spec))
 
+    def axis_sharding(self, ndim, axis):
+        """NamedSharding splitting `axis` of an ndim-rank array over the
+        group's `model` axis — the public spelling TP compute uses for
+        activations (e.g. head-sharded q/k/v)."""
+        return self._axis_sharding(int(ndim), int(axis))
+
+    def tp_param_sharding(self, name, shape):
+        """At-rest sharding for one NAMED decode parameter under
+        tensor-parallel compute: `tp_param_pspec`'s axis grammar bound
+        to this group's mesh. Unlike `param_sharding` (which scans for
+        any divisible axis), placement here is dictated by the op's
+        role in the partitioned program — a row-parallel weight MUST
+        shard its input axis or the local matmul shapes are wrong."""
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh(), tp_param_pspec(name, shape))
+
     def param_sharding(self, shape):
         """At-rest sharding for one parameter: the last axis whose size
         divides the mesh (output-column parallel for the common [in,
@@ -265,6 +298,61 @@ class MeshGroup:
             if shape[ax] >= n and shape[ax] % n == 0:
                 return self._axis_sharding(5, ax)
         return self.replicated()
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel compute grammar (SERVING.md "Tensor-parallel compute")
+# ---------------------------------------------------------------------------
+
+# Megatron-style intra-layer split of the decode transformer, by
+# parameter family (the layer prefix 'l<N>_' is stripped before lookup):
+#
+#   column-parallel  [in, out/m]   wq wk wv (head split), w1, lm_head
+#   row-parallel     [in/m, out]   wo, w2 — one psum closes each
+#                                  column->row pair; b2 adds after it
+#   vocab-row        [V/m, D]      embed — local masked gather + psum
+#                                  (exact: one member owns each row,
+#                                  the rest contribute true zeros —
+#                                  parallel/sharded_embedding.py)
+#   sharded bias     [4D/m]        b1 — rides its column pair
+#   replicated                     pos, layer norms, b2, lnf
+_TP_COLUMN = frozenset(("wq", "wk", "wv", "w1", "lm_head"))
+_TP_ROW = frozenset(("wo", "w2", "embed"))
+_TP_BIAS = frozenset(("b1",))
+_LAYER_PREFIX = re.compile(r"^l\d+_")
+
+
+def tp_param_pspec(name, shape):
+    """jax PartitionSpec for one named decode parameter under
+    tensor-parallel compute. Names outside the decode state grammar
+    (and wrong-rank shapes) replicate — the safe default, since the
+    partitioned program only ever consumes local shards of the families
+    above."""
+    from jax.sharding import PartitionSpec as P
+    base = _LAYER_PREFIX.sub("", str(name))
+    ndim = len(tuple(shape))
+    if base in _TP_COLUMN and ndim == 2:
+        return P(None, MODEL_AXIS)
+    if base in _TP_ROW and ndim == 2:
+        return P(MODEL_AXIS, None)
+    if base in _TP_BIAS and ndim == 1:
+        return P(MODEL_AXIS)
+    return P()
+
+
+def tp_supported(mesh_size, n_heads, d_model, vocab_size, d_ff=None):
+    """True when the decode dims split evenly over `mesh_size` members —
+    the gate `GenerativePredictor` checks before placing state TP.
+    Every sharded family must divide exactly: heads for attention/KV,
+    d_model for the row-parallel contractions, vocab for the embedding
+    rows and lm_head columns, d_ff for the MLP pair."""
+    m = int(mesh_size)
+    if m < 2:
+        return False
+    dims = [int(n_heads), int(d_model), int(vocab_size)]
+    if d_ff:
+        dims.append(int(d_ff))
+    return all(d >= m and d % m == 0 for d in dims)
 
 
 def as_mesh_group(device):

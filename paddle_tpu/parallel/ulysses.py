@@ -13,7 +13,26 @@ import functools
 
 import numpy as np
 
-__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+__all__ = ["ulysses_attention", "ulysses_attention_sharded",
+           "seq_to_heads", "heads_to_seq"]
+
+
+def seq_to_heads(x, axis_name):
+    """[B, S/n, H, D] -> all_to_all -> [B, S, H/n, D]: trade the local
+    sequence shard for full sequence over a local head group.  Pure
+    data movement (exact) — also the reshard the tensor-parallel
+    prefill path uses to land sequence-parallel K/V in the
+    head-sharded slot cache (inference/decode.py)."""
+    import jax
+    return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def heads_to_seq(x, axis_name):
+    """Inverse of `seq_to_heads`: [B, S, H/n, D] -> [B, S/n, H, D]."""
+    import jax
+    return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
@@ -26,18 +45,9 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
     n = jax.lax.psum(1, axis_name)
     B, S_loc, H, D = q.shape
 
-    def seq_to_heads(x):
-        # [B, S/n, H, D] -> all_to_all -> [B, S, H/n, D]
-        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                                  tiled=True)
-
-    def heads_to_seq(x):
-        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                                  tiled=True)
-
-    qh = seq_to_heads(q)      # [B, S, H/n, D]
-    kh = seq_to_heads(k)
-    vh = seq_to_heads(v)
+    qh = seq_to_heads(q, axis_name)      # [B, S, H/n, D]
+    kh = seq_to_heads(k, axis_name)
+    vh = seq_to_heads(v, axis_name)
     from ..flags import FLAGS
     if FLAGS.ring_use_flash:
         # after the reshard every device holds FULL sequences for its
@@ -48,7 +58,7 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
     else:
         out = local_attention(qh, kh, vh, causal=causal, q_offset=0,
                               k_offset=0, scale=scale)
-    return heads_to_seq(out)
+    return heads_to_seq(out, axis_name)
 
 
 def ulysses_attention_sharded(q, k, v, mesh, axis_name="seq", causal=False,

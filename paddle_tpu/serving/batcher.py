@@ -224,7 +224,8 @@ class _Lane:
     length)."""
 
     __slots__ = ("index", "predictor", "device", "ready", "inflight",
-                 "batches", "rows", "last_t", "dead")
+                 "batches", "rows", "last_t", "dead", "tp",
+                 "disp_ewma")
 
     def __init__(self, index, predictor):
         self.index = index
@@ -235,6 +236,11 @@ class _Lane:
         self.batches = 0    # micro-batches this replica executed
         self.rows = 0       # real rows it served
         self.last_t = None  # monotonic end of this lane's last dispatch
+        # tensor-parallel lane (SERVING.md "Tensor-parallel compute"):
+        # the replica runs the partitioned program, so dispatch time
+        # tracks per-member (~1/mesh) HBM traffic, not the whole model
+        self.tp = bool(getattr(predictor, "tp_active", False))
+        self.disp_ewma = None  # EWMA seconds per dispatch (run only)
         # set to the error string when a mesh member died under this
         # lane (SERVING.md "Mesh replicas"): the router skips it, its
         # workers exit, sibling lanes keep serving
@@ -439,10 +445,14 @@ class DynamicBatcher:
         lane queue depth, batches/rows executed) — the skew-visibility
         numbers `stats` and serving_top surface.  `mesh` is the member
         count behind the lane (1 = plain device); `dead` carries the
-        mesh-member-loss error when the lane died."""
+        mesh-member-loss error when the lane died; `tp` marks a
+        tensor-parallel lane and `dispatch_ms` its EWMA device time
+        per dispatch (None until the first one)."""
         with self._cv:
             return [{"replica": l.index, "device": l.device,
-                     "mesh": l.mesh, "dead": l.dead,
+                     "mesh": l.mesh, "dead": l.dead, "tp": l.tp,
+                     "dispatch_ms": round(l.disp_ewma * 1000.0, 3)
+                     if l.disp_ewma is not None else None,
                      "inflight": l.inflight, "queue": len(l.ready),
                      "batches": l.batches, "rows": l.rows}
                     for l in self._lanes]
@@ -730,6 +740,9 @@ class DynamicBatcher:
             lane.batches += 1
             lane.rows += total
             lane.last_t = t_run_end
+            dt = t_run_end - t_run
+            lane.disp_ewma = dt if lane.disp_ewma is None \
+                else 0.8 * lane.disp_ewma + 0.2 * dt
         if self.metrics is not None:
             cap = self._bucket_cap(total) if total else 0
             self.metrics.note_dispatch(
@@ -979,7 +992,7 @@ class _DecodeLane:
 
     __slots__ = ("index", "predictor", "session", "assigned", "steps",
                  "tokens", "spec", "degraded_noted", "last_step_t",
-                 "step_ewma", "dead")
+                 "step_ewma", "dead", "tp")
 
     def __init__(self, index, predictor, n_slots, draft=None, spec_k=0):
         # error string once a mesh member died under this lane
@@ -992,6 +1005,9 @@ class _DecodeLane:
         self.step_ewma = None
         self.index = index
         self.predictor = predictor
+        # tensor-parallel lane: decode runs the partitioned program
+        # (FLAGS.mesh_tp + a TP-splittable model on a mesh replica)
+        self.tp = bool(getattr(predictor, "tp_active", False))
         if draft is not None and int(spec_k) >= 1:
             from ..inference.decode import SpeculativeDecodeSession
             self.session = SpeculativeDecodeSession(
@@ -1183,6 +1199,10 @@ class DecodeBatcher:
                             "device": dev,
                             "mesh": dev.count("+") + 1 if dev else 1,
                             "dead": l.dead,
+                            "tp": l.tp,
+                            "dispatch_ms":
+                                round(l.step_ewma * 1000.0, 3)
+                                if l.step_ewma is not None else None,
                             "inflight": len(l.assigned),
                             "queue": 0,
                             "batches": l.steps,

@@ -453,11 +453,16 @@ class ModelRegistry:
                     "model_fit_check", model=name, path=path,
                     draft=draft_path or None,
                     est_bytes=int(est), available_bytes=int(avail),
-                    replicas=int(n), mesh_size=int(m))
-        # stamp the placement's mesh shape on the stored report so
-        # describe()/stats (and the fleet's placement-by-capacity math)
-        # read the per-device resident estimate, not the whole-model sum
+                    replicas=int(n), mesh_size=int(m),
+                    step_bytes=int(report.per_device_step_bytes(
+                        m, tp=bool(FLAGS.mesh_tp))))
+        # stamp the placement's mesh shape (and the tensor-parallel
+        # compute mode) on the stored report so describe()/stats (and
+        # the fleet's placement-by-capacity math) read the per-device
+        # resident estimate + per-member step traffic, not the
+        # whole-model sums
         report.mesh_size = int(mesh_max)
+        report.tp = bool(FLAGS.mesh_tp and mesh_max > 1)
         return report
 
     def load_model(self, name, path, version=None, warm=True,
@@ -891,6 +896,12 @@ class ModelRegistry:
                         # column and the load reply's resolved shape
                         info["mesh"] = sizes
                         info["mesh_size"] = max(sizes)
+                        # tensor-parallel compute (FLAGS.mesh_tp +
+                        # a TP-splittable model): the partitioned
+                        # program instead of gather-and-replicate
+                        info["mesh_tp"] = any(
+                            getattr(p, "tp_active", False)
+                            for p in latest.replicas)
                     if latest.resource is not None:
                         # the static cost the fleet controller places
                         # by (ANALYSIS.md): per-replica peak estimate

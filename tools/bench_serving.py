@@ -968,13 +968,27 @@ def run_mesh_lane(args, backend_label):
     reads scheduling overhead only — mesh points pay XLA's
     cross-device collectives for no compute win on a host core; the
     tpu_watch "serving_mesh" stage re-measures on silicon where the
-    sharded weights actually buy HBM."""
+    sharded weights actually buy HBM.
+
+    `--mesh_tp on|off|both` (SERVING.md "Tensor-parallel compute")
+    A/Bs the compute mode per mesh point: off = PR 18's gather-and-
+    replicate (every member streams the whole model per step), on =
+    the shard_map'd partitioned program (each member streams ~1/m).
+    Each record carries the MODELED per-member step traffic
+    (`step_bytes_per_member`, ResourceReport.per_device_step_bytes)
+    and its ratio vs gather mode; with `--step_cost_ms` the stand-in
+    per-dispatch device cost is scaled by that ratio, so the CPU-smoke
+    QPS curve shows the bandwidth win the model predicts for silicon.
+    Streams stay token-identical to the single-device oracle in BOTH
+    modes (TP's top-1 contract)."""
     import jax
-    from paddle_tpu.analysis.resources import device_memory_bytes
+    from paddle_tpu.analysis.resources import (analyze_artifact,
+                                               device_memory_bytes)
     from paddle_tpu.flags import set_flags
     from paddle_tpu.inference.decode import (GenerativePredictor,
                                              greedy_decode)
-    from paddle_tpu.serving import InferenceServer, ServingClient
+    from paddle_tpu.serving import (InferenceServer, ServingClient,
+                                    set_dispatch_delay)
 
     if args.device_mem_mb > 0:
         set_flags({"serving_device_mem_mb": int(args.device_mem_mb)})
@@ -990,6 +1004,8 @@ def run_mesh_lane(args, backend_label):
     points = [int(p) for p in str(args.mesh).split(",") if p.strip()]
     devs = jax.devices()
     n_streams = len(prompts)
+    tp_modes = {"off": (False,), "on": (True,),
+                "both": (False, True)}[args.mesh_tp]
 
     for m in points:
         if m < 1 or m > len(devs):
@@ -999,72 +1015,117 @@ def run_mesh_lane(args, backend_label):
                               % len(devs)}), flush=True)
             continue
         spec = "+".join("%s:%d" % (d.platform, d.id) for d in devs[:m])
-        server = InferenceServer().start()
-        cli = ServingClient(server.endpoint)
-        rec = {"metric": "serving_mesh", "mesh": m, "devices": spec,
-               "replicas": 1, "streams": n_streams,
-               "max_new_tokens": budget}
-        try:
-            t0 = time.monotonic()
-            loaded = cli.load_model(
-                "lm", model_dir, replicas=spec,
-                decode_slots=args.decode_slots,
-                kv_cache_dtype=None if args.kv_dtype == "fp32"
-                else "int8" if args.kv_dtype == "int8" else None)
-            rec["cold_start_ms"] = round(
-                (time.monotonic() - t0) * 1e3, 1)
-            rec["resolved_mesh"] = loaded.get("mesh", [1])
-            outs = [None] * n_streams
-            errs = []
+        for tp_on in tp_modes:
+            if tp_on and m < 2:
+                # TP needs members to split over — announced, not
+                # silently folded into the gather point
+                print(json.dumps({"metric": "serving_mesh", "mesh": m,
+                                  "mesh_tp": True,
+                                  "skipped": "tp needs mesh >= 2"}),
+                      flush=True)
+                continue
+            _run_mesh_point(args, backend_label, model_dir, m, spec,
+                            tp_on, prompts, refs, budget, devs,
+                            set_flags, set_dispatch_delay,
+                            analyze_artifact, device_memory_bytes,
+                            InferenceServer, ServingClient)
+    set_flags({"mesh_tp": False})
 
-            def drive(i):
-                c = ServingClient(server.endpoint)
-                try:
-                    outs[i] = [t for ch in c.infer_stream(
-                        "lm", prompts[i], max_new_tokens=budget,
-                        deadline_ms=120000.0) for t in ch]
-                except Exception as e:
-                    errs.append(e)
-                finally:
-                    c.close()
 
-            t0 = time.monotonic()
-            threads = [threading.Thread(target=drive, args=(i,))
-                       for i in range(n_streams)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(timeout=300)
-            wall = time.monotonic() - t0
-            assert not errs, "mesh=%d streams failed: %r" % (m, errs[:2])
-            rec["wall_s"] = round(wall, 3)
-            rec["qps"] = round(n_streams / wall, 2)
-            rec["tokens_per_sec"] = round(
-                n_streams * budget / wall, 1)
-            # every point replays against the single-device oracle:
-            # sharding must never move one token
-            rec["bit_exact"] = bool(
-                all(outs[i] == refs[i] for i in range(n_streams)))
-            # the fit columns: whole-model vs per-member pricing
-            d = cli.stats()["models"]["lm"]
-            rec["est_peak_mb"] = d.get("est_peak_mb")
-            rec["est_per_device_mb"] = d.get(
-                "est_per_device_mb", d.get("est_peak_mb"))
-            avail = device_memory_bytes(devs[0])
-            if avail is not None and rec["est_per_device_mb"]:
-                rec["device_budget_mb"] = round(avail / float(1 << 20), 1)
-                rec["fit_headroom_mb"] = round(
-                    rec["device_budget_mb"] - rec["est_per_device_mb"],
-                    3)
-            else:
-                rec["device_budget_mb"] = None
-                rec["fit_headroom_mb"] = None
-        finally:
-            cli.close()
-            server.shutdown(drain=False, timeout=10.0)
-        if backend_label:
-            rec["backend"] = backend_label
-        print(json.dumps(rec), flush=True)
+def _run_mesh_point(args, backend_label, model_dir, m, spec, tp_on,
+                    prompts, refs, budget, devs, set_flags,
+                    set_dispatch_delay, analyze_artifact,
+                    device_memory_bytes, InferenceServer,
+                    ServingClient):
+    """One (mesh size, compute mode) point of the mesh sweep: fresh
+    server, oracle-exact streams, fit + modeled-traffic columns."""
+    n_streams = len(prompts)
+    set_flags({"mesh_tp": bool(tp_on)})
+    # the modeled per-member decode traffic (ROOFLINE.md): gather mode
+    # streams the whole model per member per step, TP streams ~1/m —
+    # the ratio also scales the --step_cost_ms stand-in so the smoke
+    # QPS curve shows the predicted bandwidth win
+    rep = analyze_artifact(model_dir, decode_slots=args.decode_slots,
+                           mesh_size=m, tp=tp_on)
+    gather_bytes = rep.per_device_step_bytes(m, tp=False)
+    member_bytes = rep.per_device_step_bytes(m, tp=tp_on)
+    ratio = member_bytes / float(max(gather_bytes, 1))
+    server = InferenceServer().start()
+    cli = ServingClient(server.endpoint)
+    rec = {"metric": "serving_mesh", "mesh": m, "devices": spec,
+           "mesh_tp": bool(tp_on), "replicas": 1,
+           "streams": n_streams, "max_new_tokens": budget,
+           "step_bytes_per_member": int(member_bytes),
+           "step_bytes_gather": int(gather_bytes),
+           "step_bytes_ratio_vs_gather": round(ratio, 4)}
+    if args.step_cost_ms:
+        rec["step_cost_ms"] = round(args.step_cost_ms * ratio, 4)
+        set_dispatch_delay(args.step_cost_ms * ratio / 1000.0)
+    try:
+        t0 = time.monotonic()
+        loaded = cli.load_model(
+            "lm", model_dir, replicas=spec,
+            decode_slots=args.decode_slots,
+            kv_cache_dtype=None if args.kv_dtype == "fp32"
+            else "int8" if args.kv_dtype == "int8" else None)
+        rec["cold_start_ms"] = round(
+            (time.monotonic() - t0) * 1e3, 1)
+        rec["resolved_mesh"] = loaded.get("mesh", [1])
+        outs = [None] * n_streams
+        errs = []
+
+        def drive(i):
+            c = ServingClient(server.endpoint)
+            try:
+                outs[i] = [t for ch in c.infer_stream(
+                    "lm", prompts[i], max_new_tokens=budget,
+                    deadline_ms=120000.0) for t in ch]
+            except Exception as e:
+                errs.append(e)
+            finally:
+                c.close()
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=drive, args=(i,))
+                   for i in range(n_streams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        wall = time.monotonic() - t0
+        assert not errs, "mesh=%d streams failed: %r" % (m, errs[:2])
+        rec["wall_s"] = round(wall, 3)
+        rec["qps"] = round(n_streams / wall, 2)
+        rec["tokens_per_sec"] = round(
+            n_streams * budget / wall, 1)
+        # every point replays against the single-device oracle:
+        # sharding must never move one token
+        rec["bit_exact"] = bool(
+            all(outs[i] == refs[i] for i in range(n_streams)))
+        # the fit columns: whole-model vs per-member pricing
+        d = cli.stats()["models"]["lm"]
+        rec["est_peak_mb"] = d.get("est_peak_mb")
+        rec["est_per_device_mb"] = d.get(
+            "est_per_device_mb", d.get("est_peak_mb"))
+        # what the server actually built: True only when the flag AND
+        # the TP grammar both admitted the model
+        rec["mesh_tp_active"] = bool(d.get("mesh_tp", False))
+        avail = device_memory_bytes(devs[0])
+        if avail is not None and rec["est_per_device_mb"]:
+            rec["device_budget_mb"] = round(avail / float(1 << 20), 1)
+            rec["fit_headroom_mb"] = round(
+                rec["device_budget_mb"] - rec["est_per_device_mb"],
+                3)
+        else:
+            rec["device_budget_mb"] = None
+            rec["fit_headroom_mb"] = None
+    finally:
+        set_dispatch_delay(0.0)
+        cli.close()
+        server.shutdown(drain=False, timeout=10.0)
+    if backend_label:
+        rec["backend"] = backend_label
+    print(json.dumps(rec), flush=True)
 
 
 def _parse_replica_sweep(spec):
@@ -1370,6 +1431,16 @@ def main():
                          "bit-exact vs the single-device oracle, and "
                          "records the per-member fit estimate + "
                          "headroom (BENCH_r18.json)")
+    ap.add_argument("--mesh_tp", choices=["on", "off", "both"],
+                    default="off",
+                    help="tensor-parallel A/B for the --mesh sweep "
+                         "(SERVING.md 'Tensor-parallel compute'): "
+                         "'on' runs each mesh point as the shard_"
+                         "map'd partitioned program (~1/m per-member "
+                         "step bytes), 'both' runs gather + TP per "
+                         "point; records carry the modeled per-member "
+                         "step traffic and scale --step_cost_ms by "
+                         "the TP/gather byte ratio (BENCH_r20.json)")
     ap.add_argument("--replicas", default="1",
                     help="replica placement spec per point: a count, "
                          "'auto' (one replica per local device), an "

@@ -89,6 +89,9 @@ exits nonzero on failure):
                mesh_lane_dead event) and skipped by admission, sibling
                mesh lanes stay bit-exact, and page + fault-in rebuilds
                the full mesh lane set from the persisted load spec.
+               Runs twice: gather lanes, then FLAGS.mesh_tp lanes
+               (loss lands mid-psum in the partitioned program; the
+               rebuild must come back tensor-parallel).
 
   --smoke      crash-save (deterministic `exit` fault at every commit
                point) + bit-flip, fast enough for tier-1.
@@ -1326,6 +1329,14 @@ def scenario_mesh_member_loss(verbose=True):
     (4) the persisted load spec replays: page + fault-in rebuilds the
         FULL mesh lane set (the fleet controller's fault path), and the
         rebuilt lanes serve bit-exact again.
+
+    The drill runs TWICE: once with shard-at-rest (gather) lanes and
+    once with FLAGS.mesh_tp on (SERVING.md "Tensor-parallel compute"),
+    where the member dies while the partitioned program is executing —
+    mid-psum, not between gathers.  The TP pass additionally asserts
+    that the lanes really are tensor-parallel (stats rows carry
+    tp=True) and that the fault-in rebuild comes back as TP lanes,
+    not silently degraded to gather lanes.
     """
     # the mesh needs >= 4 host devices; when the backend is already up
     # with fewer (e.g. `--scenario all` after another scenario touched
@@ -1346,6 +1357,20 @@ def scenario_mesh_member_loss(verbose=True):
             "mesh-member-loss subprocess failed (rc=%d)" % proc.returncode
         return {"reexec": True}
 
+    from paddle_tpu.flags import get_flags, set_flags
+    saved = get_flags(["mesh_tp"])
+    out = {}
+    try:
+        for tp in (False, True):
+            set_flags({"mesh_tp": tp})
+            out["tp" if tp else "gather"] = \
+                _mesh_member_loss_drill(tp, verbose)
+    finally:
+        set_flags(saved)
+    return out
+
+
+def _mesh_member_loss_drill(tp, verbose=True):
     import tempfile
     from paddle_tpu.inference.decode import (GenerativePredictor,
                                              build_tiny_decode_model,
@@ -1371,6 +1396,10 @@ def scenario_mesh_member_loss(verbose=True):
         rep = boot.load_model("lm", md, decode_slots=4,
                               replicas="cpu:0+cpu:1,cpu:2+cpu:3")
         assert rep.get("mesh") == [2, 2], rep
+        rows = boot.stats()["stats"]["models"]["lm"].get("replicas") or []
+        assert all(bool(r.get("tp")) == tp for r in rows), \
+            "lanes not in the requested compute mode (tp=%s): %s" \
+            % (tp, rows)
         set_dispatch_delay(0.02)  # slow steps: "mid-stream" for real
 
         outs = [None] * len(prompts)
@@ -1453,6 +1482,9 @@ def scenario_mesh_member_loss(verbose=True):
         assert len(rows) == 2 and not any(r.get("dead") for r in rows), \
             rows
         assert all(r.get("mesh") == 2 for r in rows), rows
+        assert all(bool(r.get("tp")) == tp for r in rows), \
+            "fault-in rebuilt lanes in the wrong compute mode " \
+            "(want tp=%s): %s" % (tp, rows)
         for i, p in enumerate(prompts):
             cli = ServingClient(server.endpoint)
             try:
@@ -1469,11 +1501,12 @@ def scenario_mesh_member_loss(verbose=True):
         boot.close()
         server.shutdown(drain=False, timeout=10.0)
     if verbose:
-        print("PASS mesh-member-loss: %d victim stream(s) failed typed, "
-              "%d sibling stream(s) bit-exact, dead lane marked + "
-              "mesh_lane_dead event, survivors served post-loss, "
+        print("PASS mesh-member-loss[%s]: %d victim stream(s) failed "
+              "typed, %d sibling stream(s) bit-exact, dead lane marked "
+              "+ mesh_lane_dead event, survivors served post-loss, "
               "page/fault-in rebuilt both 2-chip mesh lanes bit-exact"
-              % (len(victims), len(survivors)))
+              % ("tensor-parallel" if tp else "gather",
+                 len(victims), len(survivors)))
     return {"victims": len(victims), "survivors": len(survivors)}
 
 

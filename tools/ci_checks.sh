@@ -52,8 +52,11 @@
 #                        sharded replica mesh mid-stream; the lane dies
 #                        typed (never wedges), siblings stay bit-exact,
 #                        and page/fault-in rebuilds the full mesh lane
-#                        set from the persisted spec (SERVING.md
-#                        "Mesh replicas")
+#                        set from the persisted spec.  Runs both lane
+#                        kinds: gather (shard-at-rest) and mesh_tp
+#                        tensor-parallel, where the loss lands mid-psum
+#                        (SERVING.md "Mesh replicas" +
+#                        "Tensor-parallel compute")
 #      1  usage          unknown gate name
 #      0  all requested gates clean
 #

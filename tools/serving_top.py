@@ -217,7 +217,13 @@ def render(reply, health=None, fleet=None):
         sizes = [int(r.get("mesh", 1) or 1)
                  for r in m.get("replicas") or []]
         mesh_max = max(sizes or [int(d.get("mesh_size", 1) or 1)])
-        mesh_col = str(mesh_max) if mesh_max > 1 else "-"
+        # 'NTP' marks tensor-parallel lanes (SERVING.md
+        # "Tensor-parallel compute"): the mesh runs the partitioned
+        # program instead of gather-and-replicate
+        tp_on = any(r.get("tp") for r in m.get("replicas") or []) \
+            or bool(d.get("mesh_tp"))
+        mesh_col = ("%d%s" % (mesh_max, "TP" if tp_on else "")
+                    if mesh_max > 1 else "-")
         lines.append(
             "%-14s %5s %6s %8s %8s %7s %7s %7s %7s %6s %6s %6s %7s "
             "%7s %7s %5s %5s %5s %7s %6s %5s %5s %6s"
@@ -281,19 +287,32 @@ def render(reply, health=None, fleet=None):
             # member loss stays visible with a DEAD marker.
             dev = str(r.get("device") or "-")
             mesh = int(r.get("mesh", 1) or 1)
-            label = dev if mesh == 1 else "mesh(%d)" % mesh
-            row = ("    r%-3s %-10s %9s %9s %10s %12s"
-                   % (r.get("replica"), label[:10],
+            if mesh == 1:
+                label = dev
+            elif r.get("tp"):
+                label = "mesh(%d,tp)" % mesh
+            else:
+                label = "mesh(%d)" % mesh
+            row = ("    r%-3s %-11s %9s %9s %10s %12s"
+                   % (r.get("replica"), label[:11],
                       "inflt=%s" % _fmt(r.get("inflight")),
                       "queue=%s" % _fmt(r.get("queue")),
                       "batches=%s" % _fmt(r.get("batches")),
                       "rows=%s" % _fmt(r.get("rows"))))
+            if r.get("dispatch_ms") is not None:
+                row += "  disp=%sms" % _fmt(r.get("dispatch_ms"))
             if r.get("dead"):
                 row += "  DEAD(%s)" % str(r["dead"])[:40]
             lines.append(row)
             if mesh > 1:
+                # per-member sub-rows: an SPMD dispatch lands on every
+                # member at once, so each shows the lane's dispatch
+                # EWMA — the per-chip time the TP bandwidth model
+                # predicts at ~1/mesh of gather mode
+                disp = ("  disp=%sms" % _fmt(r["dispatch_ms"])
+                        if r.get("dispatch_ms") is not None else "")
                 for member in dev.split("+"):
-                    lines.append("         + %s" % member)
+                    lines.append("         + %s%s" % (member, disp))
     return "\n".join(lines)
 
 

@@ -229,8 +229,12 @@ def main():
         # chip can hold) and whether the cross-chip collectives' step
         # tax stays small; the CPU smoke (BENCH_r18.json) can only
         # prove bit-exactness and the static fit curve
+        # --mesh_tp both A/Bs each point: gather-and-replicate vs the
+        # shard_map'd tensor-parallel program (SERVING.md "Tensor-
+        # parallel compute") — on silicon the TP rows should show the
+        # ~1/m per-member step-bytes cut as real step time
         ("serving_mesh", ["tools/bench_serving.py", "--require_tpu",
-                          "--mesh", "1,2,4",
+                          "--mesh", "1,2,4", "--mesh_tp", "both",
                           "--decode_slots", "8"], {}, 3600),
         # quantized serving A/B on silicon (QUANTIZE.md): resnet fp32
         # vs PTQ-int8 behind the precision axis — on the HBM-roofline-
